@@ -1,0 +1,20 @@
+"""kserve-vllm-mini-tpu: a TPU-native LLM serving benchmark + runtime framework.
+
+A ground-up rebuild of the capability surface of `kserve-vllm-mini`
+(deploy -> load-test -> analyze -> cost -> energy -> report pipelines for LLM
+inference services) designed TPU-first:
+
+- the serving runtime is in-repo (JAX/XLA/Pallas continuous-batching engine,
+  ``kserve_vllm_mini_tpu.runtime``) rather than an external container image;
+- parallelism is real (``jax.sharding.Mesh`` over ICI/DCN with tp/dp/sp/ep
+  axes, ``kserve_vllm_mini_tpu.parallel``) instead of passthrough env knobs;
+- telemetry uses TPU device-plugin / libtpu style metrics with modeled power
+  fallback instead of DCGM/NVML;
+- cost accounting is TPU chip-hour based.
+
+The universal contract mirrors the reference's run-directory pipeline
+(reference: SURVEY.md L1; /root/reference/bench.sh:201-289): every stage
+read-modify-writes ``results.json`` inside ``runs/<id>/``.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,4 @@
+from kserve_vllm_mini_tpu.cli import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

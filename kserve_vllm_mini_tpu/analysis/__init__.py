@@ -1,0 +1,19 @@
+from kserve_vllm_mini_tpu.analysis.metrics import (
+    percentile,
+    compute_histogram,
+    compute_latency_stats,
+    compute_token_timing,
+)
+from kserve_vllm_mini_tpu.analysis.coldwarm import (
+    classify_requests_cold_warm,
+    compute_cold_warm_metrics,
+)
+
+__all__ = [
+    "percentile",
+    "compute_histogram",
+    "compute_latency_stats",
+    "compute_token_timing",
+    "classify_requests_cold_warm",
+    "compute_cold_warm_metrics",
+]

@@ -1,0 +1,60 @@
+"""Cold-vs-warm request attribution.
+
+The reference detects cold starts from pod ``startedAt`` timestamps and tags
+requests that begin within a window after a cold start as "cold"
+(/root/reference/analyze.py:358-460). The mechanism is runtime-agnostic, so we
+keep it: cold-start instants come from the cluster (pod introspection), from
+the in-repo runtime's self-reported engine-ready timestamp, or from synthetic
+fixtures in tests.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+from kserve_vllm_mini_tpu.analysis.metrics import percentile
+from kserve_vllm_mini_tpu.core.rundir import RequestRecord
+
+# Requests starting within this many seconds after a cold-start instant are
+# classified cold (reference analyze.py:402-419 uses 30 s).
+DEFAULT_COLD_WINDOW_S = 30.0
+
+
+def classify_requests_cold_warm(
+    records: Sequence[RequestRecord],
+    cold_start_times: Sequence[float],
+    window_s: float = DEFAULT_COLD_WINDOW_S,
+) -> list[bool]:
+    """Return per-request cold flags, aligned with ``records``."""
+    flags: list[bool] = []
+    for r in records:
+        cold = any(0.0 <= r.start_ts - t <= window_s for t in cold_start_times)
+        flags.append(cold)
+    return flags
+
+
+def compute_cold_warm_metrics(
+    records: Sequence[RequestRecord], cold_flags: Sequence[bool]
+) -> dict[str, Any]:
+    """Cold/warm latency split + cold multiplier (reference analyze.py:422-460)."""
+    cold_lat = [
+        r.latency_ms for r, c in zip(records, cold_flags) if c and r.ok and r.latency_ms > 0
+    ]
+    warm_lat = [
+        r.latency_ms for r, c in zip(records, cold_flags) if not c and r.ok and r.latency_ms > 0
+    ]
+    out: dict[str, Any] = {
+        "cold_requests": sum(1 for c in cold_flags if c),
+        "warm_requests": sum(1 for c in cold_flags if not c),
+    }
+    if cold_lat:
+        out["cold_p50_ms"] = percentile(cold_lat, 50)
+        out["cold_p95_ms"] = percentile(cold_lat, 95)
+        out["cold_mean_ms"] = sum(cold_lat) / len(cold_lat)
+    if warm_lat:
+        out["warm_p50_ms"] = percentile(warm_lat, 50)
+        out["warm_p95_ms"] = percentile(warm_lat, 95)
+        out["warm_mean_ms"] = sum(warm_lat) / len(warm_lat)
+    if cold_lat and warm_lat and out["warm_p95_ms"] > 0:
+        out["cold_multiplier"] = out["cold_p95_ms"] / out["warm_p95_ms"]
+    return out

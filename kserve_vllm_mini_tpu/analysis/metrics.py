@@ -1,0 +1,135 @@
+"""Latency/throughput statistics over per-request records.
+
+Behavior parity with the reference analyzer's math
+(/root/reference/analyze.py:59-180): linear-interpolated percentiles,
+fixed-bucket histograms, and token-timing analysis (TTFT vs per-token
+time), reimplemented as typed pure functions.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Optional, Sequence
+
+from kserve_vllm_mini_tpu.core.rundir import RequestRecord, window_bounds
+
+
+def percentile(values: Sequence[float], pct: float) -> float:
+    """Linear interpolation between closest ranks (reference analyze.py:59-81).
+
+    pct is clamped to [0, 100]. Returns NaN for empty input so that absence of
+    data is never mistaken for a 0 ms latency by downstream gates.
+    """
+    if not values:
+        return math.nan
+    s = sorted(values)
+    if len(s) == 1:
+        return float(s[0])
+    pct = min(max(pct, 0.0), 100.0)
+    rank = (pct / 100.0) * (len(s) - 1)
+    lo = int(math.floor(rank))
+    hi = int(math.ceil(rank))
+    if lo == hi:
+        return float(s[lo])
+    frac = rank - lo
+    return float(s[lo] * (1.0 - frac) + s[hi] * frac)
+
+
+def compute_histogram(values: Sequence[float], num_buckets: int = 20) -> dict[str, Any]:
+    """Fixed-width histogram (reference analyze.py:84-122)."""
+    if not values:
+        return {"buckets": [], "counts": [], "min": 0.0, "max": 0.0}
+    vmin, vmax = min(values), max(values)
+    if vmax <= vmin:
+        return {"buckets": [vmin], "counts": [len(values)], "min": vmin, "max": vmax}
+    width = (vmax - vmin) / num_buckets
+    counts = [0] * num_buckets
+    for v in values:
+        idx = min(int((v - vmin) / width), num_buckets - 1)
+        counts[idx] += 1
+    edges = [vmin + i * width for i in range(num_buckets)]
+    return {"buckets": edges, "counts": counts, "min": vmin, "max": vmax}
+
+
+def compute_latency_stats(records: list[RequestRecord]) -> dict[str, Any]:
+    """Core latency/throughput block of results.json.
+
+    Error rate is over all requests; latency percentiles over successful ones
+    (matching the reference's handling in analyze.py:484-520).
+    """
+    total = len(records)
+    ok = [r for r in records if r.ok]
+    lat = [r.latency_ms for r in ok if r.latency_ms > 0]
+    ttft = [r.ttft_ms for r in ok if r.ttft_ms > 0]
+    t0, t1 = window_bounds(records)
+    duration = max(t1 - t0, 1e-9)
+    tokens_out = sum(r.tokens_out for r in ok)
+    tokens_in = sum(r.tokens_in for r in ok)
+
+    out: dict[str, Any] = {
+        "requests": total,
+        "error_rate": (total - len(ok)) / total if total else 0.0,
+        "throughput_rps": len(ok) / duration if t1 > t0 else 0.0,
+        "tokens_per_sec": tokens_out / duration if t1 > t0 else 0.0,
+        "window": {"start": t0, "end": t1, "duration_s": t1 - t0},
+        "total_tokens_in": tokens_in,
+        "total_tokens_out": tokens_out,
+    }
+    # Latency keys are emitted only when data exists: an all-error run must
+    # not write p95_ms=0.0 that a downstream SLO gate would happily pass.
+    if lat:
+        out.update(
+            {
+                "p50_ms": percentile(lat, 50),
+                "p95_ms": percentile(lat, 95),
+                "p99_ms": percentile(lat, 99),
+                "mean_ms": sum(lat) / len(lat),
+                "latency_histogram": compute_histogram(lat),
+            }
+        )
+    if ttft:
+        out.update(
+            {
+                "ttft_p50_ms": percentile(ttft, 50),
+                "ttft_p95_ms": percentile(ttft, 95),
+                "ttft_avg_ms": sum(ttft) / len(ttft),
+                "ttft_histogram": compute_histogram(ttft),
+            }
+        )
+    return out
+
+
+def compute_token_timing(records: list[RequestRecord]) -> dict[str, Any]:
+    """Streaming token-timing analysis (reference analyze.py:125-180).
+
+    TPOT (time per output token) is measured between client first-token and
+    last-token marks; requests with <2 output tokens or no streaming marks are
+    skipped. When the runtime reported true server-side TTFT we also surface
+    the client-vs-server delta, which the reference cannot (its TTFB-as-TTFT
+    is client-approximate, SURVEY.md §7.3.5).
+    """
+    tpots: list[float] = []
+    stream_ttfts: list[float] = []
+    server_deltas: list[float] = []
+    for r in records:
+        if not r.ok:
+            continue
+        if r.first_token_ts > 0 and r.last_token_ts > r.first_token_ts and r.tokens_out > 1:
+            per_tok = (r.last_token_ts - r.first_token_ts) * 1000.0 / (r.tokens_out - 1)
+            tpots.append(per_tok)
+        if r.ttft_ms > 0 and r.first_token_ts > 0:
+            stream_ttfts.append(r.ttft_ms)
+        if r.server_ttft_ms > 0 and r.ttft_ms > 0:
+            server_deltas.append(r.ttft_ms - r.server_ttft_ms)
+    out: dict[str, Any] = {"streaming_requests": len(stream_ttfts)}
+    if tpots:
+        out.update(
+            {
+                "tpot_p50_ms": percentile(tpots, 50),
+                "tpot_p95_ms": percentile(tpots, 95),
+                "tpot_mean_ms": sum(tpots) / len(tpots),
+            }
+        )
+    if server_deltas:
+        out["client_server_ttft_delta_ms_p50"] = percentile(server_deltas, 50)
+    return out

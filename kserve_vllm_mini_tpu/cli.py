@@ -1,0 +1,91 @@
+"""Unified ``kvmini-tpu`` CLI.
+
+The reference wraps its stages behind a single console script that dispatches
+to per-stage scripts via subprocess (/root/reference/kvmini/cli.py:30-150).
+Here every stage is an importable module with a ``register(subparsers)`` /
+``run(args)`` pair, dispatched in-process — no shelling out, no flag
+reconstruction.
+
+Subcommands are registered lazily so that e.g. ``kvmini-tpu analyze`` works in
+an environment without JAX while ``kvmini-tpu serve`` needs it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import sys
+from typing import Callable, Optional, Sequence
+
+# subcommand -> (module, help). Each module exposes
+#   register(parser: argparse.ArgumentParser) -> None
+#   run(args: argparse.Namespace) -> int
+_SUBCOMMANDS: dict[str, tuple[str, str]] = {
+    "loadtest": ("kserve_vllm_mini_tpu.loadgen.runner", "Generate load against an endpoint"),
+    "analyze": ("kserve_vllm_mini_tpu.analysis.analyzer", "requests.csv -> results.json metrics"),
+    "cost": ("kserve_vllm_mini_tpu.costs.estimator", "Attribute cost from resource-seconds x pricing"),
+    "energy": ("kserve_vllm_mini_tpu.energy.collector", "Collect/integrate chip power into Wh metrics"),
+    "report": ("kserve_vllm_mini_tpu.report.html", "Render HTML report from results.json / sweep CSVs"),
+    "plan": ("kserve_vllm_mini_tpu.costs.planner", "Capacity planning: chips for target RPS at SLO"),
+    "gate": ("kserve_vllm_mini_tpu.gates.slo", "Pass/fail results against SLO budgets"),
+    "canary": ("kserve_vllm_mini_tpu.gates.canary", "Compare candidate vs baseline run"),
+    "serve": ("kserve_vllm_mini_tpu.runtime.server", "Start the in-repo JAX serving runtime"),
+    "bench": ("kserve_vllm_mini_tpu.bench_pipeline", "Full pipeline: validate -> load -> analyze -> cost"),
+    "validate": ("kserve_vllm_mini_tpu.core.validate", "Pre-flight config validation"),
+    "quality": ("kserve_vllm_mini_tpu.quality.evaluator", "Run the mini quality-eval suite"),
+    "sweep": ("kserve_vllm_mini_tpu.sweeps.grid", "Run a parameter sweep"),
+    "compare": ("kserve_vllm_mini_tpu.compare.backends", "A/B/C compare serving backends"),
+    "parity": ("kserve_vllm_mini_tpu.compare.parity", "OpenAI API conformance probe"),
+    "fairness": ("kserve_vllm_mini_tpu.compare.fairness", "Dual-tenant fairness/backpressure run"),
+    "bundle": ("kserve_vllm_mini_tpu.provenance.bundle", "Create a signed reproducible artifact bundle"),
+    "deploy": ("kserve_vllm_mini_tpu.deploy.manifests", "Render/apply KServe TPU manifests"),
+    "probe": ("kserve_vllm_mini_tpu.probes.net_storage", "Network/storage IO probe"),
+    "chaos": ("kserve_vllm_mini_tpu.chaos.harness", "Fault injection + MTTR measurement"),
+}
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="kvmini-tpu",
+        description="TPU-native LLM serving benchmark + runtime framework",
+    )
+    sub = parser.add_subparsers(dest="command", metavar="COMMAND")
+    for name, (module_name, help_text) in sorted(_SUBCOMMANDS.items()):
+        p = sub.add_parser(name, help=help_text)
+        p.set_defaults(_module=module_name)
+        try:
+            mod = importlib.import_module(module_name)
+        except ImportError:
+            # Stage not built / optional deps missing: the subcommand still
+            # lists in --help but errors with a clear message when invoked.
+            p.set_defaults(_unavailable=module_name)
+            continue
+        register = getattr(mod, "register", None)
+        if register is not None:
+            register(p)
+        p.set_defaults(_run=getattr(mod, "run", None))
+    return parser
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if not getattr(args, "command", None):
+        parser.print_help()
+        return 2
+    if getattr(args, "_unavailable", None):
+        print(
+            f"kvmini-tpu: subcommand '{args.command}' is unavailable "
+            f"(module {args._unavailable} failed to import)",
+            file=sys.stderr,
+        )
+        return 2
+    run: Optional[Callable[[argparse.Namespace], int]] = getattr(args, "_run", None)
+    if run is None:
+        print(f"kvmini-tpu: subcommand '{args.command}' has no runner yet", file=sys.stderr)
+        return 2
+    return int(run(args) or 0)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,10 @@
+from kserve_vllm_mini_tpu.core.rundir import RunDir, RequestRecord, REQUEST_CSV_COLUMNS
+from kserve_vllm_mini_tpu.core.schema import Results, merge_results
+
+__all__ = [
+    "RunDir",
+    "RequestRecord",
+    "REQUEST_CSV_COLUMNS",
+    "Results",
+    "merge_results",
+]

@@ -1,0 +1,286 @@
+"""Run-directory contract: the filesystem API every pipeline stage speaks.
+
+A benchmark run lives in ``runs/<run_id>/`` and contains:
+
+- ``requests.csv``          per-request records written by the load generator
+- ``meta.json``             load-generator invocation metadata
+- ``results.json``          the universal merge target every stage updates
+- ``power.json``            sampled chip power (energy collector "collect")
+- ``energy.json``           integrated energy (energy collector "integrate")
+- ``traces/traces.json``    OTLP-shaped client trace spans
+- ``requests_classified.csv``  requests.csv + cold/warm classification column
+- ``io_probe.json``         network/storage probe output
+
+This mirrors the reference's loosely-coupled CLI-stage design (reference
+SURVEY.md L1; /root/reference/analyze.py:606-618, cost_estimator.py:457-484,
+energy/collector.py:187-200) but with one typed implementation instead of
+ad-hoc json.load/dump in each script.
+"""
+
+from __future__ import annotations
+
+import csv
+import dataclasses
+import json
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Iterable, Optional
+
+# Column order of requests.csv. Superset of the reference's column set
+# (/root/reference/scripts/loadtest.py:576-591) with TPU-runtime additions:
+# server-side first/last token timestamps (the in-repo runtime reports true
+# token timing, not just client TTFB approximation) and a prompt_set tag so
+# cache probing is first-class rather than monkeypatched.
+REQUEST_CSV_COLUMNS = [
+    "request_id",
+    "scheduled_ts",   # planned arrival (epoch s, float)
+    "start_ts",       # actual send time
+    "first_token_ts", # client-observed first streamed chunk (0 if non-streaming)
+    "last_token_ts",  # client-observed last streamed chunk (0 if non-streaming)
+    "end_ts",         # response fully received
+    "latency_ms",     # end_ts - start_ts
+    "ttft_ms",        # first_token_ts - start_ts (streaming) else latency_ms
+    "tokens_in",
+    "tokens_out",
+    "status_code",
+    "ok",             # "1"/"0"
+    "error",          # short error string, "" if ok
+    "trace_id",
+    "prompt_set",     # e.g. "default", "repeat", "unique" (cache probe)
+    "tenant",         # multi-tenant fairness runs; "" otherwise
+    "server_ttft_ms", # runtime-reported true first-token latency; 0 if unknown
+]
+
+
+@dataclass
+class RequestRecord:
+    """One load-generator request; one row of requests.csv."""
+
+    request_id: str
+    scheduled_ts: float = 0.0
+    start_ts: float = 0.0
+    first_token_ts: float = 0.0
+    last_token_ts: float = 0.0
+    end_ts: float = 0.0
+    latency_ms: float = 0.0
+    ttft_ms: float = 0.0
+    tokens_in: int = 0
+    tokens_out: int = 0
+    status_code: int = 0
+    ok: bool = False
+    error: str = ""
+    trace_id: str = ""
+    prompt_set: str = "default"
+    tenant: str = ""
+    server_ttft_ms: float = 0.0
+
+    def to_row(self) -> dict[str, Any]:
+        d = dataclasses.asdict(self)
+        d["ok"] = "1" if self.ok else "0"
+        return d
+
+    @classmethod
+    def from_row(cls, row: dict[str, str]) -> "RequestRecord":
+        def _f(key: str) -> float:
+            v = row.get(key, "")
+            try:
+                return float(v) if v != "" else 0.0
+            except ValueError:
+                return 0.0
+
+        def _i(key: str) -> int:
+            v = row.get(key, "")
+            try:
+                return int(float(v)) if v != "" else 0
+            except ValueError:
+                return 0
+
+        return cls(
+            request_id=row.get("request_id", ""),
+            scheduled_ts=_f("scheduled_ts"),
+            start_ts=_f("start_ts"),
+            first_token_ts=_f("first_token_ts"),
+            last_token_ts=_f("last_token_ts"),
+            end_ts=_f("end_ts"),
+            latency_ms=_f("latency_ms"),
+            ttft_ms=_f("ttft_ms"),
+            tokens_in=_i("tokens_in"),
+            tokens_out=_i("tokens_out"),
+            status_code=_i("status_code"),
+            ok=row.get("ok", "0") in ("1", "true", "True"),
+            error=row.get("error", ""),
+            trace_id=row.get("trace_id", ""),
+            prompt_set=row.get("prompt_set", "default") or "default",
+            tenant=row.get("tenant", ""),
+            server_ttft_ms=_f("server_ttft_ms"),
+        )
+
+
+@dataclass
+class RunDir:
+    """Typed handle on a run directory."""
+
+    path: Path
+
+    def __post_init__(self) -> None:
+        self.path = Path(self.path)
+
+    # -- factory -----------------------------------------------------------
+    @classmethod
+    def create(cls, root: str | Path = "runs", run_id: Optional[str] = None) -> "RunDir":
+        if run_id is not None:
+            p = Path(root) / run_id
+            p.mkdir(parents=True, exist_ok=True)
+        else:
+            # Auto-generated ids must never collide: two sweeps launched in the
+            # same second would otherwise silently share (and clobber) one dir.
+            base = time.strftime("%Y%m%d-%H%M%S")
+            for suffix in ("", *(f"-{i}" for i in range(1, 1000))):
+                p = Path(root) / (base + suffix)
+                try:
+                    p.mkdir(parents=True, exist_ok=False)
+                    break
+                except FileExistsError:
+                    continue
+            else:
+                raise RuntimeError(f"could not allocate a unique run dir under {root}")
+        (p / "traces").mkdir(exist_ok=True)
+        return cls(p)
+
+    # -- file paths --------------------------------------------------------
+    @property
+    def requests_csv(self) -> Path:
+        return self.path / "requests.csv"
+
+    @property
+    def requests_classified_csv(self) -> Path:
+        return self.path / "requests_classified.csv"
+
+    @property
+    def meta_json(self) -> Path:
+        return self.path / "meta.json"
+
+    @property
+    def results_json(self) -> Path:
+        return self.path / "results.json"
+
+    @property
+    def power_json(self) -> Path:
+        return self.path / "power.json"
+
+    @property
+    def energy_json(self) -> Path:
+        return self.path / "energy.json"
+
+    @property
+    def traces_json(self) -> Path:
+        return self.path / "traces" / "traces.json"
+
+    @property
+    def io_probe_json(self) -> Path:
+        return self.path / "io_probe.json"
+
+    # -- requests.csv ------------------------------------------------------
+    def write_requests(self, records: Iterable[RequestRecord]) -> None:
+        with self.requests_csv.open("w", newline="") as f:
+            w = csv.DictWriter(f, fieldnames=REQUEST_CSV_COLUMNS)
+            w.writeheader()
+            for r in records:
+                w.writerow(r.to_row())
+
+    def read_requests(self, classified: bool = False) -> list[RequestRecord]:
+        src = self.requests_classified_csv if classified else self.requests_csv
+        if not src.exists():
+            raise FileNotFoundError(f"no {src.name} in {self.path}")
+        with src.open(newline="") as f:
+            return [RequestRecord.from_row(row) for row in csv.DictReader(f)]
+
+    def write_classified(self, records: Iterable[RequestRecord], cold_flags: list[bool]) -> None:
+        """requests.csv plus a trailing `cold` column (reference analyze.py:402-419)."""
+        records = list(records)
+        if len(records) != len(cold_flags):
+            raise ValueError(
+                f"records ({len(records)}) and cold_flags ({len(cold_flags)}) "
+                "must align one-to-one"
+            )
+        cols = REQUEST_CSV_COLUMNS + ["cold"]
+        with self.requests_classified_csv.open("w", newline="") as f:
+            w = csv.DictWriter(f, fieldnames=cols)
+            w.writeheader()
+            for r, cold in zip(records, cold_flags):
+                row = r.to_row()
+                row["cold"] = "1" if cold else "0"
+                w.writerow(row)
+
+    def read_cold_flags(self) -> list[bool]:
+        if not self.requests_classified_csv.exists():
+            return []
+        with self.requests_classified_csv.open(newline="") as f:
+            return [row.get("cold", "0") == "1" for row in csv.DictReader(f)]
+
+    # -- json blobs --------------------------------------------------------
+    def _read_json(self, p: Path) -> dict[str, Any]:
+        if not p.exists():
+            return {}
+        with p.open() as f:
+            return json.load(f)
+
+    def _write_json(self, p: Path, obj: dict[str, Any]) -> None:
+        p.parent.mkdir(parents=True, exist_ok=True)
+        tmp = p.with_suffix(p.suffix + ".tmp")
+        with tmp.open("w") as f:
+            json.dump(obj, f, indent=2, sort_keys=True)
+            f.write("\n")
+        tmp.replace(p)
+
+    def read_meta(self) -> dict[str, Any]:
+        return self._read_json(self.meta_json)
+
+    def write_meta(self, meta: dict[str, Any]) -> None:
+        self._write_json(self.meta_json, meta)
+
+    def read_results(self) -> dict[str, Any]:
+        return self._read_json(self.results_json)
+
+    def merge_into_results(self, update: dict[str, Any]) -> dict[str, Any]:
+        """Read-modify-write results.json — the universal merge the reference
+        performs in every stage (analyze.py:606-618 et al)."""
+        from kserve_vllm_mini_tpu.core.schema import merge_results
+
+        cur = merge_results(self.read_results(), update)
+        self._write_json(self.results_json, cur)
+        return cur
+
+    def read_power(self) -> dict[str, Any]:
+        return self._read_json(self.power_json)
+
+    def write_power(self, obj: dict[str, Any]) -> None:
+        self._write_json(self.power_json, obj)
+
+    def read_energy(self) -> dict[str, Any]:
+        return self._read_json(self.energy_json)
+
+    def write_energy(self, obj: dict[str, Any]) -> None:
+        self._write_json(self.energy_json, obj)
+
+    def write_traces(self, obj: dict[str, Any]) -> None:
+        self._write_json(self.traces_json, obj)
+
+    def read_traces(self) -> dict[str, Any]:
+        return self._read_json(self.traces_json)
+
+    def write_io_probe(self, obj: dict[str, Any]) -> None:
+        self._write_json(self.io_probe_json, obj)
+
+    def read_io_probe(self) -> dict[str, Any]:
+        return self._read_json(self.io_probe_json)
+
+
+def window_bounds(records: list[RequestRecord]) -> tuple[float, float]:
+    """[t0, t1] spanning the active test window (reference analyze.py:183-189)."""
+    starts = [r.start_ts for r in records if r.start_ts > 0]
+    ends = [r.end_ts for r in records if r.end_ts > 0]
+    if not starts or not ends:
+        return (0.0, 0.0)
+    return (min(starts), max(ends))

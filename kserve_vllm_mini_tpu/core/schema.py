@@ -1,0 +1,131 @@
+"""The canonical results.json schema — the framework's real API.
+
+Gate, canary, planner, report, and sweeps all key off this flat dict
+(reference SURVEY.md §5.5; /root/reference/analyze.py:573-595,
+cost_estimator.py:465-482). We keep the reference's key names where the
+semantics are hardware-agnostic (p50_ms, ttft_p95_ms, cost_per_1k_tokens, ...)
+and replace the GPU-specific keys with TPU-native ones:
+
+- gpu_util_avg        -> tpu_duty_cycle_avg   (duty cycle %, libtpu-style)
+- gpu_mem_used_avg    -> tpu_hbm_used_avg_gib
+- gpu_power_watts_avg -> tpu_power_watts_avg  (+ power_provenance)
+
+Only knowingly-populated keys are written; merges are last-writer-wins at key
+granularity, matching the reference's read-modify-write of results.json.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+
+@dataclass
+class Results:
+    """Typed view of results.json. All fields optional; ``to_dict`` drops Nones."""
+
+    # identity / provenance
+    run_id: Optional[str] = None
+    model: Optional[str] = None
+    runtime: Optional[str] = None           # "jax-native" | "jetstream" | "vllm-tpu" | ...
+    accelerator: Optional[str] = None       # e.g. "tpu-v5e-8"
+    pattern: Optional[str] = None
+    requests: Optional[int] = None
+    concurrency: Optional[int] = None
+    streaming: Optional[bool] = None
+
+    # latency (ms)
+    p50_ms: Optional[float] = None
+    p95_ms: Optional[float] = None
+    p99_ms: Optional[float] = None
+    mean_ms: Optional[float] = None
+    ttft_p50_ms: Optional[float] = None
+    ttft_p95_ms: Optional[float] = None
+    ttft_avg_ms: Optional[float] = None
+    tpot_p50_ms: Optional[float] = None     # time-per-output-token
+    tpot_p95_ms: Optional[float] = None
+
+    # throughput
+    throughput_rps: Optional[float] = None
+    tokens_per_sec: Optional[float] = None
+    tokens_per_sec_per_chip: Optional[float] = None
+    error_rate: Optional[float] = None
+
+    # cold/warm split (reference analyze.py:422-460)
+    cold_requests: Optional[int] = None
+    warm_requests: Optional[int] = None
+    cold_p50_ms: Optional[float] = None
+    cold_p95_ms: Optional[float] = None
+    warm_p50_ms: Optional[float] = None
+    warm_p95_ms: Optional[float] = None
+    cold_multiplier: Optional[float] = None
+    cold_start_seconds: Optional[float] = None
+
+    # utilization / telemetry (TPU-native)
+    tpu_duty_cycle_avg: Optional[float] = None
+    tpu_hbm_used_avg_gib: Optional[float] = None
+    tpu_power_watts_avg: Optional[float] = None
+    power_provenance: Optional[str] = None  # "measured" | "modeled"
+    cpu_util_avg: Optional[float] = None
+    host_mem_used_avg_gib: Optional[float] = None
+
+    # cache
+    cache_hit_ratio: Optional[float] = None
+    cache_hit_source: Optional[str] = None  # "metrics" | "logs" | "ttft-inference"
+
+    # energy
+    energy_wh: Optional[float] = None
+    energy_wh_per_request: Optional[float] = None
+    energy_wh_per_1k_tokens: Optional[float] = None
+
+    # cost
+    cost_total: Optional[float] = None
+    cost_per_request: Optional[float] = None
+    cost_per_1k_tokens: Optional[float] = None
+    cost_breakdown: Optional[dict[str, float]] = None
+    cold_cost_total: Optional[float] = None
+    warm_cost_total: Optional[float] = None
+
+    # io probe
+    network_rtt_p50_ms: Optional[float] = None
+    network_rtt_p95_ms: Optional[float] = None
+    storage_fetch_mbps: Optional[float] = None
+
+    # quality
+    quality_score: Optional[float] = None
+    quality_tasks: Optional[dict[str, float]] = None
+
+    # window + distributions
+    window: Optional[dict[str, float]] = None        # {"start": t0, "end": t1, "duration_s": d}
+    latency_histogram: Optional[dict[str, Any]] = None
+    ttft_histogram: Optional[dict[str, Any]] = None
+    token_timing: Optional[dict[str, Any]] = None
+
+    extras: dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> dict[str, Any]:
+        out: dict[str, Any] = {}
+        for f in dataclasses.fields(self):
+            if f.name == "extras":
+                continue
+            v = getattr(self, f.name)
+            if v is not None:
+                out[f.name] = v
+        out.update(self.extras)
+        return out
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "Results":
+        known = {f.name for f in dataclasses.fields(cls)} - {"extras"}
+        kwargs = {k: v for k, v in d.items() if k in known}
+        extras = {k: v for k, v in d.items() if k not in known}
+        return cls(**kwargs, extras=extras)
+
+
+def merge_results(base: dict[str, Any], update: dict[str, Any]) -> dict[str, Any]:
+    """Key-granular merge; nested dicts (cost_breakdown, window, ...) are
+    replaced wholesale like the reference does."""
+    out = dict(base)
+    out.update(update)
+    return out

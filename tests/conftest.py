@@ -1,0 +1,24 @@
+"""Test configuration: force JAX onto a virtual 8-device CPU mesh.
+
+The reference fakes its cluster with a mock kubectl binary (SURVEY.md §4.3);
+we additionally fake the accelerator: 8 virtual CPU devices let every sharding
+test exercise a real Mesh without TPU hardware.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+from tests.synthetic import make_synthetic_run  # noqa: E402
+
+
+@pytest.fixture
+def synthetic_run(tmp_path):
+    """Deterministic synthetic run dir (seed=42, 5% errors, first 10 cold) —
+    the repro-smoke fixture pattern from the reference CI."""
+    return make_synthetic_run(tmp_path / "runs", seed=42)

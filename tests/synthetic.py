@@ -1,0 +1,89 @@
+"""Deterministic synthetic run-directory fixtures.
+
+Mirrors the reference's repro-smoke workflow (SURVEY.md §4.3): seeded RNG,
+known 5% error rate, first N requests cold, so analyzer output is exactly
+reproducible across runs and platforms.
+"""
+
+from __future__ import annotations
+
+import random
+from pathlib import Path
+
+from kserve_vllm_mini_tpu.core.rundir import RequestRecord, RunDir
+
+
+def make_synthetic_records(
+    n: int = 200,
+    seed: int = 42,
+    error_rate: float = 0.05,
+    cold_count: int = 10,
+    start_epoch: float = 1_700_000_000.0,
+    streaming: bool = True,
+) -> list[RequestRecord]:
+    rng = random.Random(seed)
+    records: list[RequestRecord] = []
+    t = start_epoch
+    for i in range(n):
+        # First `cold_count` requests land inside the 30 s post-cold-start
+        # window; a 60 s quiet gap then guarantees the rest classify warm.
+        if i < cold_count:
+            t += 1.0
+        elif i == cold_count:
+            t += 60.0
+        else:
+            t += rng.expovariate(20.0)  # ~20 rps arrivals
+        cold = i < cold_count
+        base_lat = rng.gauss(350.0 if cold else 120.0, 25.0)
+        lat_ms = max(base_lat, 5.0)
+        ttft_ms = max(lat_ms * rng.uniform(0.15, 0.3), 2.0)
+        tokens_out = rng.randint(16, 128)
+        err = rng.random() < error_rate
+        start = t
+        end = start + lat_ms / 1000.0
+        first_tok = start + ttft_ms / 1000.0
+        rec = RequestRecord(
+            request_id=f"req-{i:05d}",
+            scheduled_ts=start - rng.uniform(0, 0.01),
+            start_ts=start,
+            first_token_ts=first_tok if streaming and not err else 0.0,
+            last_token_ts=end if streaming and not err else 0.0,
+            end_ts=end,
+            latency_ms=lat_ms if not err else 0.0,
+            ttft_ms=ttft_ms if not err else 0.0,
+            tokens_in=rng.randint(20, 200),
+            tokens_out=tokens_out if not err else 0,
+            status_code=500 if err else 200,
+            ok=not err,
+            error="synthetic-error" if err else "",
+            trace_id=f"{rng.getrandbits(128):032x}",
+            server_ttft_ms=max(ttft_ms - rng.uniform(1.0, 5.0), 0.5) if not err else 0.0,
+        )
+        records.append(rec)
+    return records
+
+
+def make_synthetic_run(root: Path, seed: int = 42, n: int = 200) -> RunDir:
+    rd = RunDir.create(root, run_id=f"synthetic-{seed}")
+    records = make_synthetic_records(n=n, seed=seed)
+    rd.write_requests(records)
+    rd.write_meta(
+        {
+            "model": "synthetic/llama-tiny",
+            "runtime": "jax-native",
+            "pattern": "poisson",
+            "requests": n,
+            "concurrency": 20,
+            "streaming": True,
+            "accelerator": "tpu-v5e-8",
+            "seed": seed,
+        }
+    )
+    return rd
+
+
+def cold_start_instants(records: list[RequestRecord]) -> list[float]:
+    """The synthetic 'pod startedAt' instant: just before the first request."""
+    if not records:
+        return []
+    return [records[0].start_ts - 1.0]

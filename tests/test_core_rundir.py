@@ -1,0 +1,54 @@
+"""Run-dir contract tests: CSV round-trip, results.json merge semantics."""
+
+from kserve_vllm_mini_tpu.core.rundir import RequestRecord, RunDir, window_bounds
+from tests.synthetic import make_synthetic_records, make_synthetic_run
+
+
+def test_requests_csv_roundtrip(tmp_path):
+    rd = RunDir.create(tmp_path, run_id="rt")
+    recs = make_synthetic_records(n=50)
+    rd.write_requests(recs)
+    back = rd.read_requests()
+    assert len(back) == 50
+    for a, b in zip(recs, back):
+        assert a.request_id == b.request_id
+        assert abs(a.latency_ms - b.latency_ms) < 1e-6
+        assert a.ok == b.ok
+        assert a.tokens_out == b.tokens_out
+        assert a.trace_id == b.trace_id
+
+
+def test_results_merge_is_key_granular(tmp_path):
+    rd = RunDir.create(tmp_path, run_id="merge")
+    rd.merge_into_results({"p95_ms": 100.0, "model": "m"})
+    rd.merge_into_results({"cost_per_request": 0.01})
+    rd.merge_into_results({"p95_ms": 120.0})
+    res = rd.read_results()
+    assert res["p95_ms"] == 120.0
+    assert res["model"] == "m"
+    assert res["cost_per_request"] == 0.01
+
+
+def test_window_bounds():
+    recs = make_synthetic_records(n=20)
+    t0, t1 = window_bounds(recs)
+    assert t0 == min(r.start_ts for r in recs)
+    assert t1 == max(r.end_ts for r in recs)
+    assert t1 > t0
+
+
+def test_classified_csv_roundtrip(tmp_path):
+    rd = RunDir.create(tmp_path, run_id="cls")
+    recs = make_synthetic_records(n=30)
+    flags = [i < 5 for i in range(30)]
+    rd.write_requests(recs)
+    rd.write_classified(recs, flags)
+    assert rd.read_cold_flags() == flags
+    back = rd.read_requests(classified=True)
+    assert len(back) == 30
+
+
+def test_synthetic_run_is_deterministic(tmp_path):
+    rd1 = make_synthetic_run(tmp_path / "a", seed=42)
+    rd2 = make_synthetic_run(tmp_path / "b", seed=42)
+    assert rd1.requests_csv.read_text() == rd2.requests_csv.read_text()

@@ -1,0 +1,93 @@
+"""Analyzer math: percentile interpolation, histograms, token timing, cold/warm."""
+
+import pytest
+
+from kserve_vllm_mini_tpu.analysis.coldwarm import (
+    classify_requests_cold_warm,
+    compute_cold_warm_metrics,
+)
+from kserve_vllm_mini_tpu.analysis.metrics import (
+    compute_histogram,
+    compute_latency_stats,
+    compute_token_timing,
+    percentile,
+)
+from tests.synthetic import cold_start_instants, make_synthetic_records
+
+
+def test_percentile_interpolation():
+    vals = [10.0, 20.0, 30.0, 40.0]
+    assert percentile(vals, 0) == 10.0
+    assert percentile(vals, 100) == 40.0
+    assert percentile(vals, 50) == pytest.approx(25.0)
+    assert percentile(vals, 25) == pytest.approx(17.5)
+
+
+def test_percentile_edges():
+    import math
+
+    assert math.isnan(percentile([], 95))  # absence of data, not 0 ms
+    assert percentile([7.0], 95) == 7.0
+    assert percentile([1.0, 2.0, 3.0], 200) == 3.0  # clamped
+    assert percentile([1.0, 2.0, 3.0], -5) == 1.0
+
+
+def test_all_error_run_omits_latency_keys():
+    from kserve_vllm_mini_tpu.core.rundir import RequestRecord
+
+    recs = [RequestRecord(request_id="e", start_ts=1, end_ts=2, ok=False, status_code=500)]
+    s = compute_latency_stats(recs)
+    assert s["error_rate"] == 1.0
+    assert "p95_ms" not in s  # gates must see absence, not 0.0
+
+
+def test_histogram_counts_sum():
+    vals = [float(i) for i in range(100)]
+    h = compute_histogram(vals, num_buckets=10)
+    assert sum(h["counts"]) == 100
+    assert len(h["buckets"]) == 10
+    assert h["min"] == 0.0 and h["max"] == 99.0
+
+
+def test_histogram_constant_values():
+    h = compute_histogram([5.0] * 7)
+    assert h["counts"] == [7]
+
+
+def test_latency_stats_on_synthetic():
+    recs = make_synthetic_records(n=200, seed=42, error_rate=0.05)
+    stats = compute_latency_stats(recs)
+    assert stats["requests"] == 200
+    assert 0.0 < stats["error_rate"] < 0.15
+    assert stats["p50_ms"] < stats["p95_ms"] <= stats["p99_ms"]
+    assert stats["ttft_p50_ms"] < stats["p50_ms"]
+    assert stats["throughput_rps"] > 0
+    assert stats["tokens_per_sec"] > 0
+    assert stats["window"]["duration_s"] > 0
+
+
+def test_token_timing():
+    recs = make_synthetic_records(n=100, seed=7)
+    tt = compute_token_timing(recs)
+    assert tt["streaming_requests"] > 0
+    assert tt["tpot_p50_ms"] > 0
+    assert tt["tpot_p50_ms"] <= tt["tpot_p95_ms"]
+    # server-reported TTFT is always slightly below client TTFT in fixture
+    assert tt["client_server_ttft_delta_ms_p50"] > 0
+
+
+def test_cold_warm_classification_exact_split():
+    recs = make_synthetic_records(n=100, seed=42, cold_count=10)
+    flags = classify_requests_cold_warm(recs, cold_start_instants(recs))
+    assert sum(flags) == 10
+    assert all(flags[:10]) and not any(flags[10:])
+
+
+def test_cold_warm_metrics():
+    recs = make_synthetic_records(n=100, seed=42, cold_count=10, error_rate=0.0)
+    flags = classify_requests_cold_warm(recs, cold_start_instants(recs))
+    m = compute_cold_warm_metrics(recs, flags)
+    assert m["cold_requests"] == 10
+    assert m["warm_requests"] == 90
+    assert m["cold_p95_ms"] > m["warm_p95_ms"]
+    assert m["cold_multiplier"] > 1.0
