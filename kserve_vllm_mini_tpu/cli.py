@@ -2,12 +2,14 @@
 
 The reference wraps its stages behind a single console script that dispatches
 to per-stage scripts via subprocess (/root/reference/kvmini/cli.py:30-150).
-Here every stage is an importable module with a ``register(subparsers)`` /
+Here every stage is an importable module with a ``register(parser)`` /
 ``run(args)`` pair, dispatched in-process — no shelling out, no flag
 reconstruction.
 
-Subcommands are registered lazily so that e.g. ``kvmini-tpu analyze`` works in
-an environment without JAX while ``kvmini-tpu serve`` needs it.
+Dispatch is genuinely lazy: only the chosen subcommand's module is imported,
+so ``kvmini-tpu analyze`` never pays the JAX/libtpu import that
+``kvmini-tpu serve`` needs, and a broken stage module breaks only its own
+subcommand.
 """
 
 from __future__ import annotations
@@ -15,10 +17,11 @@ from __future__ import annotations
 import argparse
 import importlib
 import sys
-from typing import Callable, Optional, Sequence
+import traceback
+from typing import Optional, Sequence
 
 # subcommand -> (module, help). Each module exposes
-#   register(parser: argparse.ArgumentParser) -> None
+#   register(parser: argparse.ArgumentParser) -> None   (optional)
 #   run(args: argparse.Namespace) -> int
 _SUBCOMMANDS: dict[str, tuple[str, str]] = {
     "loadtest": ("kserve_vllm_mini_tpu.loadgen.runner", "Generate load against an endpoint"),
@@ -44,46 +47,49 @@ _SUBCOMMANDS: dict[str, tuple[str, str]] = {
 }
 
 
-def build_parser() -> argparse.ArgumentParser:
-    parser = argparse.ArgumentParser(
-        prog="kvmini-tpu",
-        description="TPU-native LLM serving benchmark + runtime framework",
-    )
-    sub = parser.add_subparsers(dest="command", metavar="COMMAND")
-    for name, (module_name, help_text) in sorted(_SUBCOMMANDS.items()):
-        p = sub.add_parser(name, help=help_text)
-        p.set_defaults(_module=module_name)
-        try:
-            mod = importlib.import_module(module_name)
-        except ImportError:
-            # Stage not built / optional deps missing: the subcommand still
-            # lists in --help but errors with a clear message when invoked.
-            p.set_defaults(_unavailable=module_name)
-            continue
-        register = getattr(mod, "register", None)
-        if register is not None:
-            register(p)
-        p.set_defaults(_run=getattr(mod, "run", None))
-    return parser
+def _help_text() -> str:
+    lines = [
+        "usage: kvmini-tpu COMMAND [options]",
+        "",
+        "TPU-native LLM serving benchmark + runtime framework",
+        "",
+        "commands:",
+    ]
+    for name, (_, help_text) in sorted(_SUBCOMMANDS.items()):
+        lines.append(f"  {name:<10} {help_text}")
+    lines.append("")
+    lines.append("run 'kvmini-tpu COMMAND --help' for command options")
+    return "\n".join(lines)
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
-    parser = build_parser()
-    args = parser.parse_args(argv)
-    if not getattr(args, "command", None):
-        parser.print_help()
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        print(_help_text())
+        return 0 if argv else 2
+    command, rest = argv[0], argv[1:]
+    if command not in _SUBCOMMANDS:
+        print(f"kvmini-tpu: unknown command {command!r}\n\n{_help_text()}", file=sys.stderr)
         return 2
-    if getattr(args, "_unavailable", None):
+    module_name, help_text = _SUBCOMMANDS[command]
+    try:
+        mod = importlib.import_module(module_name)
+    except Exception:
         print(
-            f"kvmini-tpu: subcommand '{args.command}' is unavailable "
-            f"(module {args._unavailable} failed to import)",
+            f"kvmini-tpu: subcommand '{command}' is unavailable "
+            f"({module_name} failed to import):\n{traceback.format_exc(limit=1)}",
             file=sys.stderr,
         )
         return 2
-    run: Optional[Callable[[argparse.Namespace], int]] = getattr(args, "_run", None)
+    parser = argparse.ArgumentParser(prog=f"kvmini-tpu {command}", description=help_text)
+    register = getattr(mod, "register", None)
+    if register is not None:
+        register(parser)
+    run = getattr(mod, "run", None)
     if run is None:
-        print(f"kvmini-tpu: subcommand '{args.command}' has no runner yet", file=sys.stderr)
+        print(f"kvmini-tpu: subcommand '{command}' has no runner yet", file=sys.stderr)
         return 2
+    args = parser.parse_args(rest)
     return int(run(args) or 0)
 
 

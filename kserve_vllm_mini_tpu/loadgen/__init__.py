@@ -1,0 +1,3 @@
+from kserve_vllm_mini_tpu.loadgen.arrivals import generate_arrival_times, duration_and_rps
+
+__all__ = ["generate_arrival_times", "duration_and_rps"]

@@ -1,0 +1,8 @@
+from kserve_vllm_mini_tpu.loadgen.adapters.base import (
+    CallResult,
+    GenParams,
+    ProtocolAdapter,
+    get_adapter,
+)
+
+__all__ = ["CallResult", "GenParams", "ProtocolAdapter", "get_adapter"]

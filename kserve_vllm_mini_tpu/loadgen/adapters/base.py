@@ -1,0 +1,95 @@
+"""Protocol-adapter interface: one load-generator core, pluggable wire formats.
+
+The reference grew three divergent embedded clients (OpenAI in loadtest.py,
+HF-generate in tgi/invoke.sh:68-227, KServe-v2 in triton/invoke.sh:68-259)
+with drifting metrics — SURVEY.md §7.1 calls this out as the thing NOT to
+replicate. Here every backend implements one async interface and the worker
+pool is shared.
+"""
+
+from __future__ import annotations
+
+import time
+from abc import ABC, abstractmethod
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import httpx
+
+
+@dataclass
+class GenParams:
+    """Generation parameters, superset of the OpenAI knobs the reference
+    forwards (loadtest.py:260-342)."""
+
+    max_tokens: int = 64
+    temperature: float = 0.0
+    top_p: float = 1.0
+    top_k: int = 0
+    n: int = 1
+    presence_penalty: float = 0.0
+    frequency_penalty: float = 0.0
+    stop: Optional[list[str]] = None
+    json_mode: bool = False
+    seed: Optional[int] = None
+    extra: dict[str, Any] = field(default_factory=dict)  # raw passthrough
+
+
+@dataclass
+class CallResult:
+    """Normalized observation of one generate call."""
+
+    status_code: int = 0
+    ok: bool = False
+    error: str = ""
+    tokens_in: int = 0
+    tokens_out: int = 0
+    first_token_ts: float = 0.0   # epoch s of first streamed chunk
+    last_token_ts: float = 0.0    # epoch s of last streamed chunk
+    server_ttft_ms: float = 0.0   # server-reported true TTFT when available
+    text: str = ""
+
+
+class ProtocolAdapter(ABC):
+    """One wire protocol. Instances are stateless; the shared AsyncClient is
+    passed in (fixing the reference's per-request client construction,
+    loadtest.py:407-409)."""
+
+    name: str = "base"
+
+    @abstractmethod
+    async def generate(
+        self,
+        client: httpx.AsyncClient,
+        base_url: str,
+        model: str,
+        prompt: str,
+        params: GenParams,
+        stream: bool,
+        headers: Optional[dict[str, str]] = None,
+    ) -> CallResult:
+        ...
+
+    @staticmethod
+    def _now() -> float:
+        return time.time()
+
+
+_REGISTRY: dict[str, str] = {
+    "openai": "kserve_vllm_mini_tpu.loadgen.adapters.openai_chat",
+    "jax-native": "kserve_vllm_mini_tpu.loadgen.adapters.openai_chat",
+    "vllm-tpu": "kserve_vllm_mini_tpu.loadgen.adapters.openai_chat",
+    "jetstream": "kserve_vllm_mini_tpu.loadgen.adapters.jetstream",
+    "kserve-v2": "kserve_vllm_mini_tpu.loadgen.adapters.kserve_v2",
+    "triton": "kserve_vllm_mini_tpu.loadgen.adapters.kserve_v2",
+}
+
+
+def get_adapter(name: str) -> ProtocolAdapter:
+    import importlib
+
+    key = name.lower()
+    if key not in _REGISTRY:
+        raise ValueError(f"unknown protocol adapter {name!r}; known: {sorted(_REGISTRY)}")
+    mod = importlib.import_module(_REGISTRY[key])
+    return mod.ADAPTER

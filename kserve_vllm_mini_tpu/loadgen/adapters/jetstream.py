@@ -1,0 +1,94 @@
+"""JetStream HTTP adapter.
+
+JetStream (google/JetStream) exposes a gRPC Decode API; its HTTP front-end
+(jetstream http server) accepts ``POST /generate`` with
+``{"prompt": ..., "max_tokens": ...}`` and streams newline-delimited JSON
+events ``{"text": ...}``. This adapter speaks that shape and normalizes to
+the same CallResult as every other backend — the reference's equivalent is
+the per-backend invoke.sh embedded clients (SURVEY.md §2.4 backend adapters).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+import httpx
+
+from kserve_vllm_mini_tpu.loadgen.adapters.base import CallResult, GenParams, ProtocolAdapter
+from kserve_vllm_mini_tpu.loadgen.prompts import approx_token_count
+
+
+class JetStreamAdapter(ProtocolAdapter):
+    name = "jetstream"
+
+    async def generate(
+        self,
+        client: httpx.AsyncClient,
+        base_url: str,
+        model: str,
+        prompt: str,
+        params: GenParams,
+        stream: bool,
+        headers: Optional[dict[str, str]] = None,
+    ) -> CallResult:
+        url = base_url.rstrip("/") + "/generate"
+        body = {
+            "prompt": prompt,
+            "max_tokens": params.max_tokens,
+            "temperature": params.temperature,
+        }
+        if params.top_k:
+            body["top_k"] = params.top_k
+        res = CallResult(tokens_in=approx_token_count(prompt))
+        try:
+            if not stream:
+                resp = await client.post(url, json=body, headers=headers)
+                res.status_code = resp.status_code
+                if resp.status_code != 200:
+                    res.error = f"http-{resp.status_code}"
+                    return res
+                data = resp.json()
+                res.text = data.get("response", data.get("text", "")) or ""
+                res.tokens_out = int(data.get("output_tokens", 0)) or approx_token_count(
+                    res.text
+                )
+                res.ok = True
+                return res
+
+            chunks: list[str] = []
+            async with client.stream(
+                "POST", url, json={**body, "stream": True}, headers=headers
+            ) as resp:
+                res.status_code = resp.status_code
+                if resp.status_code != 200:
+                    res.error = f"http-{resp.status_code}"
+                    await resp.aread()
+                    return res
+                async for line in resp.aiter_lines():
+                    now = self._now()
+                    line = line.strip()
+                    if not line:
+                        continue
+                    if line.startswith("data:"):
+                        line = line[len("data:"):].strip()
+                    try:
+                        evt = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    piece = evt.get("text", evt.get("response", "")) or ""
+                    if piece:
+                        if res.first_token_ts == 0.0:
+                            res.first_token_ts = now
+                        res.last_token_ts = now
+                        chunks.append(piece)
+            res.text = "".join(chunks)
+            res.tokens_out = approx_token_count(res.text)
+            res.ok = True
+            return res
+        except Exception as e:  # record, never abort the whole run
+            res.error = type(e).__name__
+            return res
+
+
+ADAPTER = JetStreamAdapter()

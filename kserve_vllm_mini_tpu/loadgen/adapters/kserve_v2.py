@@ -1,0 +1,107 @@
+"""KServe Open Inference Protocol (v2) generate adapter.
+
+Speaks ``POST /v2/models/<name>/generate`` and ``/generate_stream`` (SSE),
+the protocol the reference's Triton adapter uses
+(/root/reference/runners/backends/triton/invoke.sh:68-259), with token
+counting normalized like scripts/triton_token_utils.py (explicit token
+fields first, len/4 heuristic fallback).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+import httpx
+
+from kserve_vllm_mini_tpu.loadgen.adapters.base import CallResult, GenParams, ProtocolAdapter
+from kserve_vllm_mini_tpu.loadgen.prompts import approx_token_count
+
+
+class KServeV2Adapter(ProtocolAdapter):
+    name = "kserve-v2"
+
+    async def generate(
+        self,
+        client: httpx.AsyncClient,
+        base_url: str,
+        model: str,
+        prompt: str,
+        params: GenParams,
+        stream: bool,
+        headers: Optional[dict[str, str]] = None,
+    ) -> CallResult:
+        suffix = "generate_stream" if stream else "generate"
+        url = f"{base_url.rstrip('/')}/v2/models/{model}/{suffix}"
+        body = {
+            "text_input": prompt,
+            "parameters": {
+                "max_tokens": params.max_tokens,
+                "temperature": params.temperature,
+                **({"top_k": params.top_k} if params.top_k else {}),
+                **({"top_p": params.top_p} if params.top_p != 1.0 else {}),
+            },
+        }
+        res = CallResult(tokens_in=approx_token_count(prompt))
+        try:
+            if not stream:
+                resp = await client.post(url, json=body, headers=headers)
+                res.status_code = resp.status_code
+                if resp.status_code != 200:
+                    res.error = f"http-{resp.status_code}"
+                    return res
+                data = resp.json()
+                res.text = data.get("text_output", "") or ""
+                res.tokens_out = self._count_tokens(data, res.text)
+                res.ok = True
+                return res
+
+            chunks: list[str] = []
+            async with client.stream("POST", url, json=body, headers=headers) as resp:
+                res.status_code = resp.status_code
+                if resp.status_code != 200:
+                    res.error = f"http-{resp.status_code}"
+                    await resp.aread()
+                    return res
+                async for line in resp.aiter_lines():
+                    now = self._now()
+                    line = line.strip()
+                    if not line.startswith("data:"):
+                        continue
+                    try:
+                        evt = json.loads(line[len("data:"):].strip())
+                    except json.JSONDecodeError:
+                        continue
+                    piece = evt.get("text_output", "") or ""
+                    if piece:
+                        if res.first_token_ts == 0.0:
+                            res.first_token_ts = now
+                        res.last_token_ts = now
+                        chunks.append(piece)
+            res.text = "".join(chunks)
+            res.tokens_out = approx_token_count(res.text)
+            res.ok = True
+            return res
+        except Exception as e:  # record, never abort the whole run
+            res.error = type(e).__name__
+            return res
+
+    @staticmethod
+    def _count_tokens(data: dict, text: str) -> int:
+        """Explicit token-count fields first, heuristic fallback
+        (reference scripts/triton_token_utils.py:4-21)."""
+        for key in ("output_token_count", "completion_tokens", "generated_tokens"):
+            v = data.get(key)
+            if isinstance(v, (int, float)) and v > 0:
+                return int(v)
+        out = data.get("outputs")
+        if isinstance(out, list):
+            for o in out:
+                if isinstance(o, dict) and o.get("name") in ("output_token_count", "sequence_length"):
+                    arr = o.get("data")
+                    if isinstance(arr, list) and arr:
+                        return int(arr[0])
+        return approx_token_count(text)
+
+
+ADAPTER = KServeV2Adapter()

@@ -1,0 +1,135 @@
+"""OpenAI chat-completions adapter (vLLM-TPU, JetStream HTTP proxies, and the
+in-repo jax-native runtime all speak this).
+
+Behavioral spec: /root/reference/scripts/loadtest.py:260-342 — streaming SSE
+with client-side first/last chunk marks, usage-based token counts with len/4
+fallback, json_mode via response_format, and raw extra-JSON passthrough.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Optional
+
+import httpx
+
+from kserve_vllm_mini_tpu.loadgen.adapters.base import CallResult, GenParams, ProtocolAdapter
+from kserve_vllm_mini_tpu.loadgen.prompts import approx_token_count
+
+
+def _payload(model: str, prompt: str, params: GenParams, stream: bool) -> dict[str, Any]:
+    body: dict[str, Any] = {
+        "model": model,
+        "messages": [{"role": "user", "content": prompt}],
+        "max_tokens": params.max_tokens,
+        "temperature": params.temperature,
+        "stream": stream,
+    }
+    if stream:
+        body["stream_options"] = {"include_usage": True}
+    if params.top_p != 1.0:
+        body["top_p"] = params.top_p
+    if params.top_k:
+        body["top_k"] = params.top_k
+    if params.n != 1:
+        body["n"] = params.n
+    if params.presence_penalty:
+        body["presence_penalty"] = params.presence_penalty
+    if params.frequency_penalty:
+        body["frequency_penalty"] = params.frequency_penalty
+    if params.stop:
+        body["stop"] = params.stop
+    if params.seed is not None:
+        body["seed"] = params.seed
+    if params.json_mode:
+        body["response_format"] = {"type": "json_object"}
+    body.update(params.extra)
+    return body
+
+
+class OpenAIChatAdapter(ProtocolAdapter):
+    name = "openai"
+
+    async def generate(
+        self,
+        client: httpx.AsyncClient,
+        base_url: str,
+        model: str,
+        prompt: str,
+        params: GenParams,
+        stream: bool,
+        headers: Optional[dict[str, str]] = None,
+    ) -> CallResult:
+        url = base_url.rstrip("/") + "/v1/chat/completions"
+        body = _payload(model, prompt, params, stream)
+        res = CallResult(tokens_in=approx_token_count(prompt))
+        try:
+            if not stream:
+                resp = await client.post(url, json=body, headers=headers)
+                res.status_code = resp.status_code
+                if resp.status_code != 200:
+                    res.error = f"http-{resp.status_code}"
+                    return res
+                data = resp.json()
+                choice = (data.get("choices") or [{}])[0]
+                res.text = (choice.get("message") or {}).get("content", "") or ""
+                usage = data.get("usage") or {}
+                res.tokens_in = usage.get("prompt_tokens", res.tokens_in)
+                res.tokens_out = usage.get(
+                    "completion_tokens", approx_token_count(res.text)
+                )
+                res.server_ttft_ms = float(
+                    (data.get("metrics") or {}).get("server_ttft_ms", 0.0)
+                )
+                res.ok = True
+                return res
+
+            # streaming SSE: data: {...}\n\n frames, terminated by [DONE]
+            chunks: list[str] = []
+            usage: dict[str, Any] = {}
+            async with client.stream("POST", url, json=body, headers=headers) as resp:
+                res.status_code = resp.status_code
+                if resp.status_code != 200:
+                    res.error = f"http-{resp.status_code}"
+                    await resp.aread()
+                    return res
+                buf = ""
+                async for text in resp.aiter_text():
+                    now = self._now()
+                    buf += text
+                    while "\n" in buf:
+                        line, buf = buf.split("\n", 1)
+                        line = line.strip()
+                        if not line.startswith("data:"):
+                            continue
+                        data_str = line[len("data:"):].strip()
+                        if data_str == "[DONE]":
+                            continue
+                        try:
+                            evt = json.loads(data_str)
+                        except json.JSONDecodeError:
+                            continue
+                        if evt.get("usage"):
+                            usage = evt["usage"]
+                        delta = ""
+                        for ch in evt.get("choices") or []:
+                            delta += (ch.get("delta") or {}).get("content", "") or ""
+                        srv = (evt.get("metrics") or {}).get("server_ttft_ms")
+                        if srv:
+                            res.server_ttft_ms = float(srv)
+                        if delta:
+                            if res.first_token_ts == 0.0:
+                                res.first_token_ts = now
+                            res.last_token_ts = now
+                            chunks.append(delta)
+            res.text = "".join(chunks)
+            res.tokens_in = usage.get("prompt_tokens", res.tokens_in)
+            res.tokens_out = usage.get("completion_tokens", approx_token_count(res.text))
+            res.ok = True
+            return res
+        except Exception as e:  # record, never abort the whole run
+            res.error = type(e).__name__
+            return res
+
+
+ADAPTER = OpenAIChatAdapter()

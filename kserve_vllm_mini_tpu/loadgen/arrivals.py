@@ -1,0 +1,98 @@
+"""Open-loop arrival-time schedules for the four traffic patterns.
+
+Behavioral spec is the reference's generator (/root/reference/scripts/
+loadtest.py:178-237): given a request count and target duration, produce a
+sorted list of relative arrival offsets (seconds) per pattern:
+
+- ``steady``  — uniform spacing
+- ``poisson`` — exponential inter-arrivals at the mean rate
+- ``bursty``  — alternating high-rate bursts and idle gaps
+- ``heavy``   — heavy-tailed (Pareto) inter-arrivals: long quiet stretches
+                punctuated by clumps
+
+All randomness is seeded for reproducible runs (the reference's repro-smoke
+CI depends on seeded load, SURVEY.md §4.3).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Optional
+
+PATTERNS = ("steady", "poisson", "bursty", "heavy")
+
+
+def duration_and_rps(
+    num_requests: int,
+    concurrency: int,
+    target_rps: Optional[float] = None,
+    duration_s: Optional[float] = None,
+) -> tuple[float, float]:
+    """Resolve (duration_s, rps) from whichever the caller pinned.
+
+    Mirrors the reference's heuristic (loadtest.py:240-257): if neither is
+    given, assume each in-flight slot sustains ~2 rps.
+    """
+    if target_rps and target_rps > 0:
+        return (num_requests / target_rps, target_rps)
+    if duration_s and duration_s > 0:
+        return (duration_s, num_requests / duration_s)
+    est_rps = max(concurrency * 2.0, 1.0)
+    return (num_requests / est_rps, est_rps)
+
+
+def generate_arrival_times(
+    pattern: str,
+    num_requests: int,
+    duration_s: float,
+    seed: int = 42,
+    burst_factor: float = 5.0,
+    pareto_alpha: float = 1.5,
+) -> list[float]:
+    """Sorted relative arrival offsets in [0, ~duration_s]."""
+    if num_requests <= 0:
+        return []
+    if pattern not in PATTERNS:
+        raise ValueError(f"unknown pattern {pattern!r}; expected one of {PATTERNS}")
+    rng = random.Random(seed)
+    rate = num_requests / max(duration_s, 1e-9)
+
+    if pattern == "steady":
+        step = duration_s / num_requests
+        return [i * step for i in range(num_requests)]
+
+    if pattern == "poisson":
+        t = 0.0
+        out = []
+        for _ in range(num_requests):
+            t += rng.expovariate(rate)
+            out.append(t)
+        return out
+
+    if pattern == "bursty":
+        # bursts at `burst_factor`x the mean rate, separated by idle gaps so
+        # the overall duration still averages out to `duration_s`.
+        out = []
+        t = 0.0
+        burst_len = max(num_requests // 10, 1)
+        burst_rate = rate * burst_factor
+        idle_gap = (duration_s - num_requests / burst_rate) / max(num_requests // burst_len, 1)
+        i = 0
+        while i < num_requests:
+            for _ in range(min(burst_len, num_requests - i)):
+                t += rng.expovariate(burst_rate)
+                out.append(t)
+                i += 1
+            t += max(idle_gap, 0.0)
+        return out
+
+    # heavy: Pareto inter-arrivals scaled so the mean inter-arrival matches
+    # 1/rate. Pareto(alpha) has mean alpha/(alpha-1) for alpha>1.
+    mean_pareto = pareto_alpha / (pareto_alpha - 1.0)
+    scale = (1.0 / rate) / mean_pareto
+    t = 0.0
+    out = []
+    for _ in range(num_requests):
+        t += rng.paretovariate(pareto_alpha) * scale
+        out.append(t)
+    return out

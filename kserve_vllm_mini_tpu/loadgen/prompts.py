@@ -1,0 +1,77 @@
+"""Prompt-set construction — first-class, replacing the reference's
+cache-probe monkeypatching (/root/reference/cache-probe.sh:163-210, noted as a
+defect in SURVEY.md §7.4).
+
+A prompt set is a named, seeded sequence of prompts assigned per request:
+
+- ``default``  — one templated prompt with a varying integer filler
+- ``repeat``   — a small pool of identical prompts (high cache-hit potential)
+- ``unique``   — every prompt distinct (zero cache-hit potential)
+- ``mixed``    — repeat/unique interleaved at a given ratio
+
+The cache probe benches ``repeat`` vs ``unique`` and infers hit ratio from
+the TTFT delta (reference cache-probe.sh:229-364).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Callable
+
+_LOREM = (
+    "Explain the trade-offs between tensor parallelism and pipeline "
+    "parallelism for transformer inference on accelerator meshes"
+)
+
+
+def make_prompt_fn(
+    prompt_set: str,
+    base_prompt: str | None = None,
+    seed: int = 42,
+    pool_size: int = 8,
+    mixed_repeat_ratio: float = 0.8,
+    input_tokens: int = 0,
+) -> Callable[[int], str]:
+    """Return idx -> prompt for the named set.
+
+    ``input_tokens`` pads prompts with filler words to approximate a target
+    prompt length (4 chars/token heuristic shared with token counting).
+    """
+    base = base_prompt or _LOREM
+
+    pad = ""
+    if input_tokens > 0:
+        words = max(input_tokens - len(base) // 4, 0)
+        pad = " " + " ".join(f"w{i % 97}" for i in range(words))
+
+    if prompt_set == "default":
+        return lambda i: f"{base}{pad} (case {i % 100})"
+    if prompt_set == "repeat":
+        pool = [f"{base}{pad} [variant {j}]" for j in range(pool_size)]
+        return lambda i: pool[i % pool_size]
+    # "unique" and "mixed" derive per-index randomness from (seed, i) so the
+    # idx->prompt mapping is independent of the async order in which workers
+    # first call the function — seeded runs must be byte-reproducible.
+    if prompt_set == "unique":
+        def unique(i: int) -> str:
+            salt = random.Random(f"{seed}:{i}").getrandbits(64)
+            return f"{base}{pad} [nonce {salt:016x} #{i}]"
+
+        return unique
+    if prompt_set == "mixed":
+        pool = [f"{base}{pad} [variant {j}]" for j in range(pool_size)]
+
+        def mixed(i: int) -> str:
+            r = random.Random(f"{seed}:{i}")
+            if r.random() < mixed_repeat_ratio:
+                return pool[i % pool_size]
+            return f"{base}{pad} [nonce {i}-{r.getrandbits(32):08x}]"
+
+        return mixed
+    raise ValueError(f"unknown prompt set {prompt_set!r}")
+
+
+def approx_token_count(text: str) -> int:
+    """len/4 heuristic used when the server reports no usage
+    (reference scripts/triton_token_utils.py:4-21)."""
+    return max(len(text) // 4, 1)

@@ -1,0 +1,233 @@
+"""Open-loop async load generator: the framework's L2.
+
+One worker pool for every backend protocol (SURVEY.md §7.1). Behavior spec is
+/root/reference/scripts/loadtest.py:345-623: workers sleep until their
+scheduled arrival, a semaphore caps in-flight concurrency (open-loop: late
+arrivals are NOT rescheduled, queueing shows up as latency), TTFT/TLLT come
+from streamed chunk marks, and everything lands in requests.csv + meta.json +
+traces.json. Fixes over the reference: one shared AsyncClient
+(loadtest.py:407-409 built one per request), first-class prompt sets, and a
+normalized adapter layer.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import httpx
+
+from kserve_vllm_mini_tpu.core.rundir import RequestRecord, RunDir
+from kserve_vllm_mini_tpu.loadgen.adapters.base import GenParams, ProtocolAdapter, get_adapter
+from kserve_vllm_mini_tpu.loadgen.arrivals import duration_and_rps, generate_arrival_times
+from kserve_vllm_mini_tpu.loadgen.prompts import make_prompt_fn
+from kserve_vllm_mini_tpu.loadgen.tracing import TraceCollector, new_trace_id, traceparent
+
+
+@dataclass
+class LoadConfig:
+    url: str
+    model: str = "default"
+    backend: str = "openai"
+    num_requests: int = 100
+    concurrency: int = 10
+    pattern: str = "steady"
+    target_rps: Optional[float] = None
+    duration_s: Optional[float] = None
+    streaming: bool = True
+    max_tokens: int = 64
+    temperature: float = 0.0
+    top_p: float = 1.0
+    top_k: int = 0
+    prompt_set: str = "default"
+    base_prompt: Optional[str] = None
+    input_tokens: int = 0
+    seed: int = 42
+    tenant: str = ""
+    timeout_s: float = 120.0
+    headers: dict[str, str] = field(default_factory=dict)
+    extra_body: dict[str, Any] = field(default_factory=dict)
+
+    def gen_params(self) -> GenParams:
+        return GenParams(
+            max_tokens=self.max_tokens,
+            temperature=self.temperature,
+            top_p=self.top_p,
+            top_k=self.top_k,
+            seed=self.seed,
+            extra=dict(self.extra_body),
+        )
+
+
+async def _worker(
+    idx: int,
+    arrival_offset: float,
+    t_start: float,
+    cfg: LoadConfig,
+    adapter: ProtocolAdapter,
+    client: httpx.AsyncClient,
+    sem: asyncio.Semaphore,
+    prompt_fn,
+    tracer: TraceCollector,
+) -> RequestRecord:
+    trace_id = new_trace_id()
+    rec = RequestRecord(
+        request_id=f"req-{idx:06d}",
+        scheduled_ts=t_start + arrival_offset,
+        trace_id=trace_id,
+        prompt_set=cfg.prompt_set,
+        tenant=cfg.tenant,
+    )
+    root = tracer.span("client.request", trace_id, request_id=rec.request_id, index=idx)
+
+    wait_span = tracer.span("client.wait_scheduled", trace_id, parent=root)
+    delay = rec.scheduled_ts - time.time()
+    if delay > 0:
+        await asyncio.sleep(delay)
+    wait_span.end()
+
+    async with sem:
+        prompt = prompt_fn(idx)
+        http_span = tracer.span(
+            "http.request", trace_id, parent=root, backend=cfg.backend, stream=cfg.streaming
+        )
+        headers = dict(cfg.headers)
+        headers["traceparent"] = traceparent(trace_id, http_span.span_id)
+        rec.start_ts = time.time()
+        try:
+            result = await adapter.generate(
+                client, cfg.url, cfg.model, prompt, cfg.gen_params(), cfg.streaming, headers
+            )
+        except Exception as e:
+            # Adapters record their own errors; this guard ensures even an
+            # adapter bug costs one row, never the whole run's artifacts.
+            from kserve_vllm_mini_tpu.loadgen.adapters.base import CallResult
+
+            result = CallResult(error=f"adapter-{type(e).__name__}")
+        rec.end_ts = time.time()
+        http_span.set("http.status_code", result.status_code)
+        http_span.end(ok=result.ok)
+
+    rec.status_code = result.status_code
+    rec.ok = result.ok
+    rec.error = result.error
+    rec.tokens_in = result.tokens_in
+    rec.tokens_out = result.tokens_out
+    rec.first_token_ts = result.first_token_ts
+    rec.last_token_ts = result.last_token_ts
+    rec.server_ttft_ms = result.server_ttft_ms
+    rec.latency_ms = (rec.end_ts - rec.start_ts) * 1000.0
+    if result.first_token_ts > 0:
+        rec.ttft_ms = (result.first_token_ts - rec.start_ts) * 1000.0
+        ttft_span = tracer.span("server.ttft", trace_id, parent=root)
+        ttft_span.start_ns = int(rec.start_ts * 1e9)
+        ttft_span.end_ns = int(result.first_token_ts * 1e9)
+        if result.last_token_ts > result.first_token_ts:
+            tllt = tracer.span("server.tllt", trace_id, parent=root)
+            tllt.start_ns = int(result.first_token_ts * 1e9)
+            tllt.end_ns = int(result.last_token_ts * 1e9)
+    elif rec.ok:
+        rec.ttft_ms = rec.latency_ms  # non-streaming: whole response is "first token"
+    root.set("tokens_out", rec.tokens_out)
+    root.end(ok=rec.ok)
+    return rec
+
+
+async def run_load_async(cfg: LoadConfig, run_dir: RunDir) -> list[RequestRecord]:
+    dur, rps = duration_and_rps(cfg.num_requests, cfg.concurrency, cfg.target_rps, cfg.duration_s)
+    arrivals = generate_arrival_times(cfg.pattern, cfg.num_requests, dur, seed=cfg.seed)
+    adapter = get_adapter(cfg.backend)
+    prompt_fn = make_prompt_fn(
+        cfg.prompt_set, cfg.base_prompt, seed=cfg.seed, input_tokens=cfg.input_tokens
+    )
+    tracer = TraceCollector()
+    sem = asyncio.Semaphore(cfg.concurrency)
+    t_start = time.time()
+    limits = httpx.Limits(
+        max_connections=cfg.concurrency + 4, max_keepalive_connections=cfg.concurrency
+    )
+    async with httpx.AsyncClient(timeout=cfg.timeout_s, limits=limits) as client:
+        records = await asyncio.gather(
+            *(
+                _worker(i, off, t_start, cfg, adapter, client, sem, prompt_fn, tracer)
+                for i, off in enumerate(arrivals)
+            )
+        )
+    records = sorted(records, key=lambda r: r.start_ts)
+    run_dir.write_requests(records)
+    run_dir.write_meta(
+        {
+            "url": cfg.url,
+            "model": cfg.model,
+            "backend": cfg.backend,
+            "pattern": cfg.pattern,
+            "requests": cfg.num_requests,
+            "concurrency": cfg.concurrency,
+            "streaming": cfg.streaming,
+            "max_tokens": cfg.max_tokens,
+            "prompt_set": cfg.prompt_set,
+            "seed": cfg.seed,
+            "target_rps": rps,
+            "planned_duration_s": dur,
+            "started_at": t_start,
+            "finished_at": time.time(),
+        }
+    )
+    tracer.export(run_dir.traces_json)
+    return list(records)
+
+
+def run_load(cfg: LoadConfig, run_dir: RunDir) -> list[RequestRecord]:
+    return asyncio.run(run_load_async(cfg, run_dir))
+
+
+# -- CLI ---------------------------------------------------------------------
+
+def register(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument("--url", required=True, help="Base URL of the serving endpoint")
+    parser.add_argument("--model", default="default")
+    parser.add_argument("--backend", default="openai", help="Protocol adapter name")
+    parser.add_argument("--requests", type=int, default=100)
+    parser.add_argument("--concurrency", type=int, default=10)
+    parser.add_argument("--pattern", default="steady",
+                        choices=["steady", "poisson", "bursty", "heavy"])
+    parser.add_argument("--rps", type=float, default=None, help="Target requests/sec")
+    parser.add_argument("--duration", type=float, default=None, help="Target duration (s)")
+    parser.add_argument("--max-tokens", type=int, default=64)
+    parser.add_argument("--temperature", type=float, default=0.0)
+    parser.add_argument("--no-stream", action="store_true")
+    parser.add_argument("--prompt-set", default="default",
+                        choices=["default", "repeat", "unique", "mixed"])
+    parser.add_argument("--input-tokens", type=int, default=0)
+    parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument("--run-dir", default=None, help="Existing run dir (default: new under runs/)")
+    parser.add_argument("--tenant", default="")
+
+
+def run(args: argparse.Namespace) -> int:
+    cfg = LoadConfig(
+        url=args.url,
+        model=args.model,
+        backend=args.backend,
+        num_requests=args.requests,
+        concurrency=args.concurrency,
+        pattern=args.pattern,
+        target_rps=args.rps,
+        duration_s=args.duration,
+        streaming=not args.no_stream,
+        max_tokens=args.max_tokens,
+        temperature=args.temperature,
+        prompt_set=args.prompt_set,
+        input_tokens=args.input_tokens,
+        seed=args.seed,
+        tenant=args.tenant,
+    )
+    run_dir = RunDir(args.run_dir) if args.run_dir else RunDir.create()
+    run_dir.path.mkdir(parents=True, exist_ok=True)
+    records = run_load(cfg, run_dir)
+    ok = sum(1 for r in records if r.ok)
+    print(f"load complete: {ok}/{len(records)} ok -> {run_dir.path}")
+    return 0 if ok > 0 else 1

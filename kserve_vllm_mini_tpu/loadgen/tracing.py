@@ -1,0 +1,132 @@
+"""Self-contained client-side tracer with OTLP-shaped JSON export.
+
+Reimplements the behavior of the reference's embedded tracer
+(/root/reference/scripts/loadtest.py:35-175): spans named
+``client.request`` -> ``client.wait_scheduled`` / ``http.request`` ->
+``server.ttft`` / ``server.tllt``, W3C ``traceparent`` propagation to the
+server, and an OTLP/JSON resource-spans document written to
+``runs/<id>/traces/traces.json``. No OpenTelemetry SDK dependency.
+"""
+
+from __future__ import annotations
+
+import json
+import secrets
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Optional
+
+
+def new_trace_id() -> str:
+    return secrets.token_hex(16)
+
+
+def new_span_id() -> str:
+    return secrets.token_hex(8)
+
+
+def traceparent(trace_id: str, span_id: str, sampled: bool = True) -> str:
+    """W3C trace-context header value (reference loadtest.py:64-67)."""
+    return f"00-{trace_id}-{span_id}-{'01' if sampled else '00'}"
+
+
+@dataclass
+class TraceSpan:
+    name: str
+    trace_id: str
+    span_id: str = field(default_factory=new_span_id)
+    parent_span_id: Optional[str] = None
+    start_ns: int = 0
+    end_ns: int = 0
+    attributes: dict[str, Any] = field(default_factory=dict)
+    status_ok: bool = True
+
+    def start(self) -> "TraceSpan":
+        self.start_ns = time.time_ns()
+        return self
+
+    def end(self, ok: bool = True) -> "TraceSpan":
+        self.end_ns = time.time_ns()
+        self.status_ok = ok
+        return self
+
+    def set(self, key: str, value: Any) -> None:
+        self.attributes[key] = value
+
+    def to_otlp(self) -> dict[str, Any]:
+        def _attr(k: str, v: Any) -> dict[str, Any]:
+            if isinstance(v, bool):
+                val = {"boolValue": v}
+            elif isinstance(v, int):
+                val = {"intValue": str(v)}
+            elif isinstance(v, float):
+                val = {"doubleValue": v}
+            else:
+                val = {"stringValue": str(v)}
+            return {"key": k, "value": val}
+
+        return {
+            "traceId": self.trace_id,
+            "spanId": self.span_id,
+            **({"parentSpanId": self.parent_span_id} if self.parent_span_id else {}),
+            "name": self.name,
+            "kind": 3,  # SPAN_KIND_CLIENT
+            "startTimeUnixNano": str(self.start_ns),
+            "endTimeUnixNano": str(self.end_ns),
+            "attributes": [_attr(k, v) for k, v in self.attributes.items()],
+            "status": {"code": 1 if self.status_ok else 2},
+        }
+
+
+class TraceCollector:
+    """Accumulates spans across workers; exports one OTLP/JSON document."""
+
+    def __init__(self, service_name: str = "kvmini-tpu-loadgen") -> None:
+        self.service_name = service_name
+        self.spans: list[TraceSpan] = []
+
+    def span(
+        self,
+        name: str,
+        trace_id: str,
+        parent: Optional[TraceSpan] = None,
+        **attributes: Any,
+    ) -> TraceSpan:
+        s = TraceSpan(
+            name=name,
+            trace_id=trace_id,
+            parent_span_id=parent.span_id if parent else None,
+            attributes=dict(attributes),
+        ).start()
+        self.spans.append(s)
+        return s
+
+    def to_otlp(self) -> dict[str, Any]:
+        return {
+            "resourceSpans": [
+                {
+                    "resource": {
+                        "attributes": [
+                            {
+                                "key": "service.name",
+                                "value": {"stringValue": self.service_name},
+                            }
+                        ]
+                    },
+                    "scopeSpans": [
+                        {
+                            "scope": {"name": "kserve_vllm_mini_tpu.loadgen"},
+                            "spans": [s.to_otlp() for s in self.spans],
+                        }
+                    ],
+                }
+            ]
+        }
+
+    def export(self, path: str | Path) -> None:
+        p = Path(path)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        with p.open("w") as f:
+            json.dump(self.to_otlp(), f, indent=2)
+            f.write("\n")

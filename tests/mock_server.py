@@ -1,0 +1,97 @@
+"""In-process mock OpenAI-compatible server for load-generator tests.
+
+Plays the role of the reference CI's stubbed cluster (SURVEY.md §4.3): a real
+HTTP socket + SSE stream, no model behind it. Supports configurable per-token
+delay so TTFT/TPOT assertions have something to measure.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from dataclasses import dataclass
+
+from aiohttp import web
+
+
+@dataclass
+class MockStats:
+    requests: int = 0
+    streamed: int = 0
+
+
+def make_app(token_delay_s: float = 0.002, n_tokens: int = 8, fail_every: int = 0) -> web.Application:
+    stats = MockStats()
+
+    async def chat(request: web.Request) -> web.StreamResponse:
+        stats.requests += 1
+        if fail_every and stats.requests % fail_every == 0:
+            return web.json_response({"error": "injected"}, status=500)
+        body = await request.json()
+        stream = body.get("stream", False)
+        max_toks = min(int(body.get("max_tokens", 16)), n_tokens)
+        words = [f"tok{i} " for i in range(max_toks)]
+        if not stream:
+            await asyncio.sleep(token_delay_s * max_toks)
+            return web.json_response(
+                {
+                    "id": "mock",
+                    "choices": [
+                        {"index": 0, "message": {"role": "assistant", "content": "".join(words)}}
+                    ],
+                    "usage": {
+                        "prompt_tokens": 5,
+                        "completion_tokens": max_toks,
+                        "total_tokens": 5 + max_toks,
+                    },
+                    "metrics": {"server_ttft_ms": token_delay_s * 1000.0},
+                }
+            )
+        stats.streamed += 1
+        resp = web.StreamResponse(
+            status=200, headers={"Content-Type": "text/event-stream"}
+        )
+        await resp.prepare(request)
+        for i, w in enumerate(words):
+            await asyncio.sleep(token_delay_s)
+            evt = {
+                "id": "mock",
+                "choices": [{"index": 0, "delta": {"content": w}}],
+                **({"metrics": {"server_ttft_ms": token_delay_s * 1000.0}} if i == 0 else {}),
+            }
+            await resp.write(f"data: {json.dumps(evt)}\n\n".encode())
+        usage_evt = {
+            "id": "mock",
+            "choices": [],
+            "usage": {"prompt_tokens": 5, "completion_tokens": max_toks},
+        }
+        await resp.write(f"data: {json.dumps(usage_evt)}\n\n".encode())
+        await resp.write(b"data: [DONE]\n\n")
+        await resp.write_eof()
+        return resp
+
+    app = web.Application()
+    app.router.add_post("/v1/chat/completions", chat)
+    return app
+
+
+class MockServer:
+    """async context manager yielding the base URL of a live mock endpoint."""
+
+    def __init__(self, **kwargs):
+        self.app = make_app(**kwargs)
+        self.runner: web.AppRunner | None = None
+        self.url = ""
+
+    async def __aenter__(self) -> "MockServer":
+        self.runner = web.AppRunner(self.app)
+        await self.runner.setup()
+        site = web.TCPSite(self.runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        self.url = f"http://127.0.0.1:{port}"
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        if self.runner:
+            await self.runner.cleanup()

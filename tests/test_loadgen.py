@@ -1,0 +1,179 @@
+"""Load-generator tests: arrival schedules, prompt sets, end-to-end vs mock server."""
+
+import asyncio
+import json
+
+import pytest
+
+from kserve_vllm_mini_tpu.core.rundir import RunDir
+from kserve_vllm_mini_tpu.loadgen.arrivals import duration_and_rps, generate_arrival_times
+from kserve_vllm_mini_tpu.loadgen.prompts import make_prompt_fn
+from kserve_vllm_mini_tpu.loadgen.runner import LoadConfig, run_load_async
+from kserve_vllm_mini_tpu.loadgen.tracing import TraceCollector, new_trace_id, traceparent
+from tests.mock_server import MockServer
+
+
+# -- arrivals ---------------------------------------------------------------
+
+def test_steady_arrivals_uniform():
+    arr = generate_arrival_times("steady", 10, 10.0)
+    assert len(arr) == 10
+    gaps = [b - a for a, b in zip(arr, arr[1:])]
+    assert all(abs(g - 1.0) < 1e-9 for g in gaps)
+
+
+@pytest.mark.parametrize("pattern", ["poisson", "bursty", "heavy"])
+def test_random_patterns_sorted_and_seeded(pattern):
+    a1 = generate_arrival_times(pattern, 100, 10.0, seed=7)
+    a2 = generate_arrival_times(pattern, 100, 10.0, seed=7)
+    a3 = generate_arrival_times(pattern, 100, 10.0, seed=8)
+    assert a1 == a2
+    assert a1 != a3
+    assert a1 == sorted(a1)
+    assert len(a1) == 100
+
+
+def test_poisson_mean_rate_close():
+    arr = generate_arrival_times("poisson", 2000, 100.0, seed=1)
+    # mean arrival rate should be ~20 rps within 10%
+    assert arr[-1] == pytest.approx(100.0, rel=0.15)
+
+
+def test_bursty_has_bursts():
+    arr = generate_arrival_times("bursty", 100, 50.0, seed=3)
+    gaps = sorted(b - a for a, b in zip(arr, arr[1:]))
+    # burst gaps are much smaller than idle gaps
+    assert gaps[0] < 0.2 and gaps[-1] > 1.0
+
+
+def test_unknown_pattern_rejected():
+    with pytest.raises(ValueError):
+        generate_arrival_times("fractal", 10, 1.0)
+
+
+def test_duration_and_rps_resolution():
+    assert duration_and_rps(100, 10, target_rps=50)[0] == pytest.approx(2.0)
+    assert duration_and_rps(100, 10, duration_s=4.0)[1] == pytest.approx(25.0)
+    dur, rps = duration_and_rps(100, 10)
+    assert dur == pytest.approx(5.0) and rps == pytest.approx(20.0)
+
+
+# -- prompts ----------------------------------------------------------------
+
+def test_prompt_sets():
+    rep = make_prompt_fn("repeat", pool_size=4)
+    uniq = make_prompt_fn("unique")
+    assert rep(0) == rep(4)
+    assert uniq(0) != uniq(1)
+    assert uniq(3) == uniq(3)  # stable per index
+    padded = make_prompt_fn("default", input_tokens=200)
+    assert len(padded(0)) >= 200 * 3
+
+
+def test_unique_prompts_order_independent():
+    # idx->prompt must not depend on call order (async workers race)
+    a = make_prompt_fn("unique", seed=42)
+    b = make_prompt_fn("unique", seed=42)
+    forward = [a(i) for i in range(10)]
+    backward = [b(i) for i in reversed(range(10))]
+    assert forward == list(reversed(backward))
+
+
+# -- tracing ----------------------------------------------------------------
+
+def test_traceparent_format():
+    tid = new_trace_id()
+    tp = traceparent(tid, "a" * 16)
+    parts = tp.split("-")
+    assert parts[0] == "00" and parts[1] == tid and len(parts[1]) == 32 and parts[3] == "01"
+
+
+def test_otlp_export(tmp_path):
+    tc = TraceCollector()
+    tid = new_trace_id()
+    root = tc.span("client.request", tid, request_id="r1")
+    child = tc.span("http.request", tid, parent=root, backend="openai")
+    child.end()
+    root.end()
+    out = tmp_path / "traces.json"
+    tc.export(out)
+    doc = json.loads(out.read_text())
+    spans = doc["resourceSpans"][0]["scopeSpans"][0]["spans"]
+    assert len(spans) == 2
+    assert spans[1]["parentSpanId"] == spans[0]["spanId"]
+    assert spans[0]["status"]["code"] == 1
+
+
+# -- end-to-end vs mock endpoint -------------------------------------------
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+def test_loadgen_end_to_end_streaming(tmp_path):
+    async def go():
+        async with MockServer(token_delay_s=0.001) as srv:
+            cfg = LoadConfig(
+                url=srv.url, num_requests=20, concurrency=5,
+                pattern="poisson", target_rps=200.0, max_tokens=8,
+            )
+            rd = RunDir.create(tmp_path, run_id="e2e")
+            return rd, await run_load_async(cfg, rd)
+
+    rd, records = _run(go())
+    assert len(records) == 20
+    assert all(r.ok for r in records)
+    assert all(r.tokens_out == 8 for r in records)  # usage-reported, not heuristic
+    assert all(r.ttft_ms > 0 for r in records)
+    assert all(r.first_token_ts < r.last_token_ts for r in records)
+    assert all(r.server_ttft_ms > 0 for r in records)
+    # artifacts on disk
+    assert rd.requests_csv.exists() and rd.meta_json.exists() and rd.traces_json.exists()
+    meta = rd.read_meta()
+    assert meta["requests"] == 20 and meta["pattern"] == "poisson"
+    doc = json.loads(rd.traces_json.read_text())
+    span_names = {
+        s["name"]
+        for s in doc["resourceSpans"][0]["scopeSpans"][0]["spans"]
+    }
+    assert {"client.request", "client.wait_scheduled", "http.request", "server.ttft"} <= span_names
+
+
+def test_loadgen_non_streaming_and_errors(tmp_path):
+    async def go():
+        async with MockServer(token_delay_s=0.0, fail_every=4) as srv:
+            cfg = LoadConfig(
+                url=srv.url, num_requests=12, concurrency=4,
+                streaming=False, target_rps=500.0,
+            )
+            rd = RunDir.create(tmp_path, run_id="err")
+            return await run_load_async(cfg, rd)
+
+    records = _run(go())
+    errs = [r for r in records if not r.ok]
+    assert len(errs) == 3  # every 4th of 12
+    assert all(r.status_code == 500 and r.error == "http-500" for r in errs)
+    oks = [r for r in records if r.ok]
+    # non-streaming: ttft equals full latency
+    assert all(abs(r.ttft_ms - r.latency_ms) < 1e-6 for r in oks)
+
+
+def test_loadgen_concurrency_cap(tmp_path):
+    async def go():
+        async with MockServer(token_delay_s=0.02, n_tokens=4) as srv:
+            cfg = LoadConfig(
+                url=srv.url, num_requests=10, concurrency=2,
+                pattern="steady", target_rps=1000.0, max_tokens=4,
+            )
+            rd = RunDir.create(tmp_path, run_id="cap")
+            return await run_load_async(cfg, rd)
+
+    records = _run(go())
+    # with 2-way concurrency and ~80ms per request, requests must serialize:
+    # at most 2 in flight at any instant
+    intervals = sorted((r.start_ts, r.end_ts) for r in records)
+    max_inflight = 0
+    for s, _ in intervals:
+        inflight = sum(1 for s2, e2 in intervals if s2 <= s < e2)
+        max_inflight = max(max_inflight, inflight)
+    assert max_inflight <= 2
