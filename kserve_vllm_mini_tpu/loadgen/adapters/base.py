@@ -74,6 +74,42 @@ class ProtocolAdapter(ABC):
     def _now() -> float:
         return time.time()
 
+    async def _consume_sse(
+        self,
+        resp: "httpx.Response",
+        res: CallResult,
+        parse_event,
+    ) -> None:
+        """Shared streaming loop: all token-timing semantics live here, once.
+
+        ``parse_event(evt, res) -> str`` extracts the text piece from one
+        decoded event and may set usage/server-timing fields on ``res``.
+        Handles SSE ``data:`` frames and bare NDJSON lines; ``aiter_lines``
+        flushes an unterminated final frame on close, so trailing usage
+        records are never lost.
+        """
+        import json
+
+        chunks: list[str] = []
+        async for line in resp.aiter_lines():
+            now = self._now()
+            line = line.strip()
+            if line.startswith("data:"):
+                line = line[len("data:"):].strip()
+            if not line or line == "[DONE]":
+                continue
+            try:
+                evt = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            piece = parse_event(evt, res) or ""
+            if piece:
+                if res.first_token_ts == 0.0:
+                    res.first_token_ts = now
+                res.last_token_ts = now
+                chunks.append(piece)
+        res.text = "".join(chunks)
+
 
 _REGISTRY: dict[str, str] = {
     "openai": "kserve_vllm_mini_tpu.loadgen.adapters.openai_chat",

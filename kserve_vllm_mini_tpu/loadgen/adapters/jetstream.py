@@ -56,7 +56,9 @@ class JetStreamAdapter(ProtocolAdapter):
                 res.ok = True
                 return res
 
-            chunks: list[str] = []
+            def parse_event(evt: dict, r: CallResult) -> str:
+                return evt.get("text", evt.get("response", "")) or ""
+
             async with client.stream(
                 "POST", url, json={**body, "stream": True}, headers=headers
             ) as resp:
@@ -65,24 +67,7 @@ class JetStreamAdapter(ProtocolAdapter):
                     res.error = f"http-{resp.status_code}"
                     await resp.aread()
                     return res
-                async for line in resp.aiter_lines():
-                    now = self._now()
-                    line = line.strip()
-                    if not line:
-                        continue
-                    if line.startswith("data:"):
-                        line = line[len("data:"):].strip()
-                    try:
-                        evt = json.loads(line)
-                    except json.JSONDecodeError:
-                        continue
-                    piece = evt.get("text", evt.get("response", "")) or ""
-                    if piece:
-                        if res.first_token_ts == 0.0:
-                            res.first_token_ts = now
-                        res.last_token_ts = now
-                        chunks.append(piece)
-            res.text = "".join(chunks)
+                await self._consume_sse(resp, res, parse_event)
             res.tokens_out = approx_token_count(res.text)
             res.ok = True
             return res

@@ -56,30 +56,20 @@ class KServeV2Adapter(ProtocolAdapter):
                 res.ok = True
                 return res
 
-            chunks: list[str] = []
+            def parse_event(evt: dict, r: CallResult) -> str:
+                piece = evt.get("text_output", "") or ""
+                r.tokens_out = self._count_tokens(evt, "") or r.tokens_out
+                return piece
+
             async with client.stream("POST", url, json=body, headers=headers) as resp:
                 res.status_code = resp.status_code
                 if resp.status_code != 200:
                     res.error = f"http-{resp.status_code}"
                     await resp.aread()
                     return res
-                async for line in resp.aiter_lines():
-                    now = self._now()
-                    line = line.strip()
-                    if not line.startswith("data:"):
-                        continue
-                    try:
-                        evt = json.loads(line[len("data:"):].strip())
-                    except json.JSONDecodeError:
-                        continue
-                    piece = evt.get("text_output", "") or ""
-                    if piece:
-                        if res.first_token_ts == 0.0:
-                            res.first_token_ts = now
-                        res.last_token_ts = now
-                        chunks.append(piece)
-            res.text = "".join(chunks)
-            res.tokens_out = approx_token_count(res.text)
+                await self._consume_sse(resp, res, parse_event)
+            if not res.tokens_out:
+                res.tokens_out = approx_token_count(res.text)
             res.ok = True
             return res
         except Exception as e:  # record, never abort the whole run
@@ -101,7 +91,7 @@ class KServeV2Adapter(ProtocolAdapter):
                     arr = o.get("data")
                     if isinstance(arr, list) and arr:
                         return int(arr[0])
-        return approx_token_count(text)
+        return approx_token_count(text) if text else 0
 
 
 ADAPTER = KServeV2Adapter()

@@ -85,44 +85,26 @@ class OpenAIChatAdapter(ProtocolAdapter):
                 return res
 
             # streaming SSE: data: {...}\n\n frames, terminated by [DONE]
-            chunks: list[str] = []
             usage: dict[str, Any] = {}
+
+            def parse_event(evt: dict, r: CallResult) -> str:
+                if evt.get("usage"):
+                    usage.update(evt["usage"])
+                srv = (evt.get("metrics") or {}).get("server_ttft_ms")
+                if srv:
+                    r.server_ttft_ms = float(srv)
+                delta = ""
+                for ch in evt.get("choices") or []:
+                    delta += (ch.get("delta") or {}).get("content", "") or ""
+                return delta
+
             async with client.stream("POST", url, json=body, headers=headers) as resp:
                 res.status_code = resp.status_code
                 if resp.status_code != 200:
                     res.error = f"http-{resp.status_code}"
                     await resp.aread()
                     return res
-                buf = ""
-                async for text in resp.aiter_text():
-                    now = self._now()
-                    buf += text
-                    while "\n" in buf:
-                        line, buf = buf.split("\n", 1)
-                        line = line.strip()
-                        if not line.startswith("data:"):
-                            continue
-                        data_str = line[len("data:"):].strip()
-                        if data_str == "[DONE]":
-                            continue
-                        try:
-                            evt = json.loads(data_str)
-                        except json.JSONDecodeError:
-                            continue
-                        if evt.get("usage"):
-                            usage = evt["usage"]
-                        delta = ""
-                        for ch in evt.get("choices") or []:
-                            delta += (ch.get("delta") or {}).get("content", "") or ""
-                        srv = (evt.get("metrics") or {}).get("server_ttft_ms")
-                        if srv:
-                            res.server_ttft_ms = float(srv)
-                        if delta:
-                            if res.first_token_ts == 0.0:
-                                res.first_token_ts = now
-                            res.last_token_ts = now
-                            chunks.append(delta)
-            res.text = "".join(chunks)
+                await self._consume_sse(resp, res, parse_event)
             res.tokens_in = usage.get("prompt_tokens", res.tokens_in)
             res.tokens_out = usage.get("completion_tokens", approx_token_count(res.text))
             res.ok = True

@@ -45,7 +45,8 @@ class LoadConfig:
     prompt_set: str = "default"
     base_prompt: Optional[str] = None
     input_tokens: int = 0
-    seed: int = 42
+    seed: int = 42                          # traffic seed: arrivals + prompts
+    sampling_seed: Optional[int] = None     # server-side sampler seed (off by default)
     tenant: str = ""
     timeout_s: float = 120.0
     headers: dict[str, str] = field(default_factory=dict)
@@ -57,7 +58,7 @@ class LoadConfig:
             temperature=self.temperature,
             top_p=self.top_p,
             top_k=self.top_k,
-            seed=self.seed,
+            seed=self.sampling_seed,
             extra=dict(self.extra_body),
         )
 

@@ -1,0 +1,122 @@
+"""Model architecture configs for the in-repo serving runtime.
+
+The reference never touches model internals (models are opaque strings passed
+to external engines, e.g. /root/reference/deploy.sh:25-39 --model-uri). The
+TPU build owns the runtime, so architecture configs are first-class. The
+family implemented is the Llama-style decoder (RMSNorm, RoPE, SwiGLU, GQA),
+which covers the baseline configs in /root/repo/BASELINE.json (Llama-3.1-8B,
+Llama-3-70B, and an opt-125m-class smoke model).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Optional
+
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    name: str
+    vocab_size: int
+    d_model: int
+    n_layers: int
+    n_heads: int
+    n_kv_heads: int
+    d_ff: int
+    max_seq_len: int = 4096
+    rope_theta: float = 500_000.0
+    rms_eps: float = 1e-5
+    dtype: str = "bfloat16"          # parameter/activation dtype
+    tie_embeddings: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @property
+    def jnp_dtype(self):
+        return jnp.dtype(self.dtype)
+
+    @property
+    def param_count(self) -> int:
+        """Approximate parameter count (embeddings + blocks + head)."""
+        emb = self.vocab_size * self.d_model
+        attn = self.d_model * self.d_model + 2 * self.d_model * (
+            self.n_kv_heads * self.head_dim
+        ) + self.d_model * self.d_model
+        mlp = 3 * self.d_model * self.d_ff
+        norms = 2 * self.d_model
+        head = 0 if self.tie_embeddings else self.vocab_size * self.d_model
+        return emb + self.n_layers * (attn + mlp + norms) + self.d_model + head
+
+    def scaled(self, **kwargs) -> "ModelConfig":
+        return replace(self, **kwargs)
+
+
+# Presets. "llama-tiny" is the CI/test model (runs on CPU in <1s); the 8B and
+# 70B configs match the published Llama-3.x architectures so real checkpoints
+# load onto them; "smoke-125m" plays the role of the reference's
+# facebook/opt-125m cpu-smoke config (BASELINE.json configs[0]).
+PRESETS: dict[str, ModelConfig] = {
+    "llama-tiny": ModelConfig(
+        name="llama-tiny",
+        vocab_size=512,
+        d_model=128,
+        n_layers=2,
+        n_heads=4,
+        n_kv_heads=2,
+        d_ff=256,
+        max_seq_len=256,
+        rope_theta=10_000.0,
+    ),
+    "smoke-125m": ModelConfig(
+        name="smoke-125m",
+        vocab_size=32_000,
+        d_model=768,
+        n_layers=12,
+        n_heads=12,
+        n_kv_heads=12,
+        d_ff=2048,
+        max_seq_len=2048,
+        rope_theta=10_000.0,
+    ),
+    "llama-1b": ModelConfig(
+        name="llama-1b",
+        vocab_size=128_256,
+        d_model=2048,
+        n_layers=16,
+        n_heads=32,
+        n_kv_heads=8,
+        d_ff=8192,
+        max_seq_len=8192,
+    ),
+    "llama-3.1-8b": ModelConfig(
+        name="llama-3.1-8b",
+        vocab_size=128_256,
+        d_model=4096,
+        n_layers=32,
+        n_heads=32,
+        n_kv_heads=8,
+        d_ff=14_336,
+        max_seq_len=8192,
+    ),
+    "llama-3-70b": ModelConfig(
+        name="llama-3-70b",
+        vocab_size=128_256,
+        d_model=8192,
+        n_layers=80,
+        n_heads=64,
+        n_kv_heads=8,
+        d_ff=28_672,
+        max_seq_len=8192,
+    ),
+}
+
+
+def get_config(name: str, **overrides) -> ModelConfig:
+    if name not in PRESETS:
+        raise ValueError(f"unknown model preset {name!r}; known: {sorted(PRESETS)}")
+    cfg = PRESETS[name]
+    return cfg.scaled(**overrides) if overrides else cfg

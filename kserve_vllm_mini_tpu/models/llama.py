@@ -1,0 +1,160 @@
+"""Llama-family decoder in pure functional JAX.
+
+Design (TPU-first, not a port — the reference has no model code at all):
+
+- parameters are a pytree of stacked per-layer arrays with a leading
+  ``n_layers`` axis, walked with ``lax.scan`` so an 80-layer 70B compiles to
+  one rolled loop instead of 80 unrolled blocks;
+- one ``forward`` covers prefill and decode: the KV cache is a static-shape
+  [L, B, KVH, S, D] pair written at per-slot offsets (decode-state slots are
+  pre-allocated; XLA never sees a dynamic shape);
+- attention masking is positional: query at absolute position p attends cache
+  slot j iff j <= p, which subsumes causal prefill, chunked prefill, and
+  decode against ragged slot fills in one formulation;
+- bf16 params/activations feed the MXU; softmax/norm accumulate f32.
+
+Weight layout matches HF Llama naming via models/loader.py so real
+checkpoints (Llama-3.1-8B etc., BASELINE.json configs[1-4]) drop in.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from kserve_vllm_mini_tpu.models.config import ModelConfig
+from kserve_vllm_mini_tpu.ops.attention import attention
+from kserve_vllm_mini_tpu.ops.rmsnorm import rms_norm
+from kserve_vllm_mini_tpu.ops.rope import apply_rope, rope_frequencies
+
+Params = dict[str, Any]
+KVCache = dict[str, jnp.ndarray]  # {"k": [L,B,KVH,S,D], "v": [L,B,KVH,S,D]}
+
+
+def init_params(rng: jax.Array, cfg: ModelConfig) -> Params:
+    """Random-normal init (0.02 std), bf16 — for tests, benches, and as the
+    target pytree structure for checkpoint loading."""
+    dt = cfg.jnp_dtype
+    hd, kvd = cfg.head_dim, cfg.n_kv_heads * cfg.head_dim
+    keys = jax.random.split(rng, 10)
+
+    def nrm(key, shape):
+        return (jax.random.normal(key, shape, dtype=jnp.float32) * 0.02).astype(dt)
+
+    L = cfg.n_layers
+    params: Params = {
+        "embed": nrm(keys[0], (cfg.vocab_size, cfg.d_model)),
+        "layers": {
+            "attn_norm": jnp.ones((L, cfg.d_model), dtype=dt),
+            "wq": nrm(keys[1], (L, cfg.d_model, cfg.n_heads * hd)),
+            "wk": nrm(keys[2], (L, cfg.d_model, kvd)),
+            "wv": nrm(keys[3], (L, cfg.d_model, kvd)),
+            "wo": nrm(keys[4], (L, cfg.n_heads * hd, cfg.d_model)),
+            "mlp_norm": jnp.ones((L, cfg.d_model), dtype=dt),
+            "w_gate": nrm(keys[5], (L, cfg.d_model, cfg.d_ff)),
+            "w_up": nrm(keys[6], (L, cfg.d_model, cfg.d_ff)),
+            "w_down": nrm(keys[7], (L, cfg.d_ff, cfg.d_model)),
+        },
+        "final_norm": jnp.ones((cfg.d_model,), dtype=dt),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = nrm(keys[8], (cfg.vocab_size, cfg.d_model))
+    return params
+
+
+def init_kv_cache(cfg: ModelConfig, batch: int, max_seq: Optional[int] = None) -> KVCache:
+    s = max_seq or cfg.max_seq_len
+    shape = (cfg.n_layers, batch, cfg.n_kv_heads, s, cfg.head_dim)
+    return {
+        "k": jnp.zeros(shape, dtype=cfg.jnp_dtype),
+        "v": jnp.zeros(shape, dtype=cfg.jnp_dtype),
+    }
+
+
+def _write_cache(
+    cache_layer: jnp.ndarray,   # [B, KVH, S, D]
+    new: jnp.ndarray,           # [B, KVH, T, D]
+    offsets: jnp.ndarray,       # [B] int32 — absolute slot of new[:, :, 0]
+) -> jnp.ndarray:
+    def one(c, x, off):
+        return jax.lax.dynamic_update_slice(c, x, (0, off, 0))
+
+    return jax.vmap(one)(cache_layer, new, offsets)
+
+
+def forward(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,         # [B, T] int32
+    positions: jnp.ndarray,      # [B, T] int32 absolute positions
+    kv_cache: Optional[KVCache] = None,
+    cache_offsets: Optional[jnp.ndarray] = None,  # [B] slot where this block starts
+) -> tuple[jnp.ndarray, Optional[KVCache]]:
+    """Returns (logits [B, T, V] float32, updated cache).
+
+    Without a cache this is a plain causal forward (training / compile
+    checks). With a cache, keys/values of this block are written at
+    ``cache_offsets`` and attention runs against the whole cache buffer with
+    positional masking.
+    """
+    B, T = tokens.shape
+    dt = cfg.jnp_dtype
+    x = params["embed"][tokens]  # [B, T, D] gather
+    cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+
+    use_cache = kv_cache is not None
+    if use_cache and cache_offsets is None:
+        cache_offsets = jnp.zeros((B,), dtype=jnp.int32)
+
+    def block(x, layer):
+        p, k_layer, v_layer = layer
+        h = rms_norm(x, p["attn_norm"], cfg.rms_eps)
+        q = (h @ p["wq"]).reshape(B, T, cfg.n_heads, cfg.head_dim).transpose(0, 2, 1, 3)
+        k = (h @ p["wk"]).reshape(B, T, cfg.n_kv_heads, cfg.head_dim).transpose(0, 2, 1, 3)
+        v = (h @ p["wv"]).reshape(B, T, cfg.n_kv_heads, cfg.head_dim).transpose(0, 2, 1, 3)
+        q = apply_rope(q, positions, cos, sin)
+        k = apply_rope(k, positions, cos, sin)
+
+        if use_cache:
+            k_layer = _write_cache(k_layer, k, cache_offsets)
+            v_layer = _write_cache(v_layer, v, cache_offsets)
+            s = k_layer.shape[2]
+            kj = jnp.arange(s)[None, None, :]
+            mask = kj <= positions[:, :, None]          # [B, T, S]
+            mask = mask[:, None, :, :]                  # [B, 1, T, S]
+            o = attention(q, k_layer, v_layer, mask)
+        else:
+            kj = jnp.arange(T)[None, None, :]
+            mask = (kj <= positions[:, :, None])[:, None, :, :]
+            o = attention(q, k, v, mask)
+
+        o = o.transpose(0, 2, 1, 3).reshape(B, T, cfg.n_heads * cfg.head_dim)
+        x = x + o @ p["wo"]
+
+        h = rms_norm(x, p["mlp_norm"], cfg.rms_eps)
+        gated = jax.nn.silu((h @ p["w_gate"]).astype(jnp.float32)).astype(dt) * (h @ p["w_up"])
+        x = x + gated @ p["w_down"]
+        return x, (k_layer, v_layer)
+
+    layers = params["layers"]
+    if use_cache:
+        xs = (layers, kv_cache["k"], kv_cache["v"])
+    else:
+        dummy = jnp.zeros((cfg.n_layers, 0), dtype=dt)
+        xs = (layers, dummy, dummy)
+
+    def scan_body(carry, layer_xs):
+        p, kc, vc = layer_xs
+        y, (nk, nv) = block(carry, (p, kc, vc))
+        return y, (nk, nv)
+
+    x, (new_k, new_v) = jax.lax.scan(scan_body, x, xs)
+
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    head = params["embed"] if cfg.tie_embeddings else params["lm_head"]
+    logits = (x @ head.T).astype(jnp.float32)
+
+    new_cache = {"k": new_k, "v": new_v} if use_cache else None
+    return logits, new_cache

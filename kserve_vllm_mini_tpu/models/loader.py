@@ -1,0 +1,161 @@
+"""HF-checkpoint -> param-pytree loader (offline, zero-copy-ish).
+
+Maps HuggingFace Llama safetensors weights onto the stacked-layer pytree of
+models/llama.py. Works entirely from a local directory (the deployment layer
+mounts checkpoints from GCS the way the reference mounts s3:// model URIs,
+/root/reference/deploy.sh:25-39); no network access is attempted.
+
+HF stores projections as [out, in] matrices applied as x @ W.T; our forward
+computes x @ W, so every projection is transposed once at load. Layer arrays
+are stacked along a leading n_layers axis for lax.scan.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any, Callable, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from kserve_vllm_mini_tpu.models.config import ModelConfig, get_config
+
+# our stacked-layer key -> (HF per-layer key, transpose?)
+_LAYER_MAP = {
+    "attn_norm": ("input_layernorm.weight", False),
+    "wq": ("self_attn.q_proj.weight", True),
+    "wk": ("self_attn.k_proj.weight", True),
+    "wv": ("self_attn.v_proj.weight", True),
+    "wo": ("self_attn.o_proj.weight", True),
+    "mlp_norm": ("post_attention_layernorm.weight", False),
+    "w_gate": ("mlp.gate_proj.weight", True),
+    "w_up": ("mlp.up_proj.weight", True),
+    "w_down": ("mlp.down_proj.weight", True),
+}
+
+
+def config_from_hf(model_dir: str | Path) -> ModelConfig:
+    """Derive a ModelConfig from an HF config.json."""
+    with (Path(model_dir) / "config.json").open() as f:
+        hf = json.load(f)
+    return ModelConfig(
+        name=hf.get("_name_or_path", Path(model_dir).name) or Path(model_dir).name,
+        vocab_size=hf["vocab_size"],
+        d_model=hf["hidden_size"],
+        n_layers=hf["num_hidden_layers"],
+        n_heads=hf["num_attention_heads"],
+        n_kv_heads=hf.get("num_key_value_heads", hf["num_attention_heads"]),
+        d_ff=hf["intermediate_size"],
+        max_seq_len=min(hf.get("max_position_embeddings", 4096), 16384),
+        rope_theta=float(hf.get("rope_theta", 10_000.0)),
+        rms_eps=float(hf.get("rms_norm_eps", 1e-5)),
+        tie_embeddings=bool(hf.get("tie_word_embeddings", False)),
+    )
+
+
+def _open_shards(model_dir: Path) -> Callable[[str], np.ndarray]:
+    """Return tensor_name -> np.ndarray across single-file or sharded
+    safetensors checkpoints."""
+    from safetensors import safe_open
+
+    index_path = model_dir / "model.safetensors.index.json"
+    if index_path.exists():
+        with index_path.open() as f:
+            weight_map: dict[str, str] = json.load(f)["weight_map"]
+        handles: dict[str, Any] = {}
+
+        def get(name: str) -> np.ndarray:
+            shard = weight_map[name]
+            if shard not in handles:
+                handles[shard] = safe_open(model_dir / shard, framework="numpy")
+            return handles[shard].get_tensor(name)
+
+        return get
+
+    single = model_dir / "model.safetensors"
+    if not single.exists():
+        cands = sorted(model_dir.glob("*.safetensors"))
+        if not cands:
+            raise FileNotFoundError(f"no safetensors checkpoint under {model_dir}")
+        single = cands[0]
+    handle = safe_open(single, framework="numpy")
+
+    def get_single(name: str) -> np.ndarray:
+        return handle.get_tensor(name)
+
+    return get_single
+
+
+def load_hf_checkpoint(
+    model_dir: str | Path,
+    cfg: Optional[ModelConfig] = None,
+    dtype: Optional[str] = None,
+) -> tuple[dict[str, Any], ModelConfig]:
+    """Load an HF Llama-family checkpoint into (params, config)."""
+    model_dir = Path(model_dir)
+    cfg = cfg or config_from_hf(model_dir)
+    dt = jnp.dtype(dtype or cfg.dtype)
+    get = _open_shards(model_dir)
+
+    def conv(name: str, transpose: bool) -> jnp.ndarray:
+        x = jnp.asarray(get(name))  # ml_dtypes handles bf16 numpy views
+        if transpose:
+            x = x.T
+        return x.astype(dt)
+
+    layers: dict[str, jnp.ndarray] = {}
+    for ours, (hf_key, tr) in _LAYER_MAP.items():
+        stacked = jnp.stack(
+            [conv(f"model.layers.{i}.{hf_key}", tr) for i in range(cfg.n_layers)]
+        )
+        layers[ours] = stacked
+
+    params: dict[str, Any] = {
+        "embed": conv("model.embed_tokens.weight", False),
+        "layers": layers,
+        "final_norm": conv("model.norm.weight", False),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = conv("lm_head.weight", False)
+    return params, cfg
+
+
+def save_checkpoint(params: dict[str, Any], cfg: ModelConfig, out_dir: str | Path) -> None:
+    """Write our pytree back out as a (single-shard) HF-layout checkpoint, so
+    quantization sweeps can materialize variants."""
+    from safetensors.numpy import save_file
+
+    out_dir = Path(out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    tensors: dict[str, np.ndarray] = {}
+
+    def put(name: str, x: jnp.ndarray, transpose: bool) -> None:
+        arr = np.asarray(x.astype(jnp.float32))
+        if transpose:
+            arr = arr.T
+        tensors[name] = np.ascontiguousarray(arr)
+
+    put("model.embed_tokens.weight", params["embed"], False)
+    put("model.norm.weight", params["final_norm"], False)
+    if "lm_head" in params:
+        put("lm_head.weight", params["lm_head"], False)
+    for ours, (hf_key, tr) in _LAYER_MAP.items():
+        for i in range(cfg.n_layers):
+            put(f"model.layers.{i}.{hf_key}", params["layers"][ours][i], tr)
+    save_file(tensors, str(out_dir / "model.safetensors"))
+    hf_cfg = {
+        "vocab_size": cfg.vocab_size,
+        "hidden_size": cfg.d_model,
+        "num_hidden_layers": cfg.n_layers,
+        "num_attention_heads": cfg.n_heads,
+        "num_key_value_heads": cfg.n_kv_heads,
+        "intermediate_size": cfg.d_ff,
+        "max_position_embeddings": cfg.max_seq_len,
+        "rope_theta": cfg.rope_theta,
+        "rms_norm_eps": cfg.rms_eps,
+        "tie_word_embeddings": cfg.tie_embeddings,
+        "model_type": "llama",
+    }
+    with (out_dir / "config.json").open("w") as f:
+        json.dump(hf_cfg, f, indent=2)

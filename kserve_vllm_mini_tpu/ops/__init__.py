@@ -1,0 +1,5 @@
+from kserve_vllm_mini_tpu.ops.rmsnorm import rms_norm
+from kserve_vllm_mini_tpu.ops.rope import rope_frequencies, apply_rope
+from kserve_vllm_mini_tpu.ops.attention import attention
+
+__all__ = ["rms_norm", "rope_frequencies", "apply_rope", "attention"]

@@ -1,0 +1,62 @@
+"""Scaled dot-product attention with GQA, in XLA-fusable jnp.
+
+This is the reference implementation every optimized kernel (Pallas flash
+attention for prefill, paged decode attention) must match bit-for-bit within
+bf16 tolerance. Softmax runs in float32; the two matmuls stay bf16 for the
+MXU. Shapes follow the [B, heads, T, head_dim] convention throughout the
+framework.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import nn
+
+
+def repeat_kv(k: jnp.ndarray, n_rep: int) -> jnp.ndarray:
+    """[B, KVH, S, D] -> [B, KVH*n_rep, S, D] by head-group broadcast."""
+    if n_rep == 1:
+        return k
+    b, kvh, s, d = k.shape
+    k = k[:, :, None, :, :]
+    k = jnp.broadcast_to(k, (b, kvh, n_rep, s, d))
+    return k.reshape(b, kvh * n_rep, s, d)
+
+
+def attention(
+    q: jnp.ndarray,                      # [B, H, T, D]
+    k: jnp.ndarray,                      # [B, KVH, S, D]
+    v: jnp.ndarray,                      # [B, KVH, S, D]
+    mask: Optional[jnp.ndarray] = None,  # broadcastable to [B, 1|H, T, S]; True = attend
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Returns [B, H, T, D] in q.dtype."""
+    h, kvh = q.shape[1], k.shape[1]
+    if h != kvh:
+        k = repeat_kv(k, h // kvh)
+        v = repeat_kv(v, h // kvh)
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    logits = jnp.einsum("bhtd,bhsd->bhts", q, k).astype(jnp.float32) * scale
+    if mask is not None:
+        logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+    probs = nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhts,bhsd->bhtd", probs, v)
+
+
+def causal_mask(t: int, s: int, offset: int = 0) -> jnp.ndarray:
+    """[T, S] boolean mask: query i attends keys j where j <= i + offset.
+
+    ``offset`` is the number of cached tokens preceding the query block
+    (prefill: 0; chunked prefill/decode: cache length)."""
+    qi = jnp.arange(t)[:, None] + offset
+    kj = jnp.arange(s)[None, :]
+    return kj <= qi
+
+
+def length_mask(lengths: jnp.ndarray, s: int) -> jnp.ndarray:
+    """[B, 1, 1, S] boolean: key j valid where j < lengths[b]. For decode
+    against a static-size cache where each slot has its own fill level."""
+    kj = jnp.arange(s)[None, :]
+    return (kj < lengths[:, None])[:, None, None, :]

@@ -1,0 +1,19 @@
+"""RMSNorm with float32 accumulation.
+
+bf16 inputs are normalized in f32 (TPU VPU does this cheaply; the MXU never
+sees the norm) and cast back, the standard numerically-safe layout for
+bf16-parameter models.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    y = xf * jax.lax.rsqrt(var + eps)
+    return (y * weight.astype(jnp.float32)).astype(dtype)

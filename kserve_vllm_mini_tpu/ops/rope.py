@@ -1,0 +1,38 @@
+"""Rotary position embeddings (RoPE), precomputed-table formulation.
+
+Frequencies are computed once per model config and indexed by position ids,
+so prefill (positions 0..T) and decode (arbitrary per-slot positions) share
+one code path — important under jit where positions are traced values.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_frequencies(head_dim: int, max_seq_len: int, theta: float) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Return (cos, sin) tables of shape [max_seq_len, head_dim//2], float32."""
+    inv_freq = 1.0 / (
+        theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+    pos = jnp.arange(max_seq_len, dtype=jnp.float32)
+    angles = jnp.outer(pos, inv_freq)  # [S, D/2]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(
+    x: jnp.ndarray,          # [B, H, T, D]
+    positions: jnp.ndarray,  # [B, T] int32
+    cos: jnp.ndarray,        # [S, D/2]
+    sin: jnp.ndarray,        # [S, D/2]
+) -> jnp.ndarray:
+    """Rotate pairs (x[..., :D/2], x[..., D/2:]) — the 'split-half' convention
+    used by HF Llama, so converted checkpoints are bit-compatible."""
+    dtype = x.dtype
+    c = cos[positions][:, None, :, :]  # [B, 1, T, D/2]
+    s = sin[positions][:, None, :, :]
+    d2 = x.shape[-1] // 2
+    x1 = x[..., :d2].astype(jnp.float32)
+    x2 = x[..., d2:].astype(jnp.float32)
+    out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return out.astype(dtype)

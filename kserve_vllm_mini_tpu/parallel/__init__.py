@@ -1,0 +1,17 @@
+from kserve_vllm_mini_tpu.parallel.mesh import MeshSpec, make_mesh, TOPOLOGY_PRESETS
+from kserve_vllm_mini_tpu.parallel.sharding import (
+    param_shardings,
+    shard_params,
+    activation_sharding,
+    kv_cache_shardings,
+)
+
+__all__ = [
+    "MeshSpec",
+    "make_mesh",
+    "TOPOLOGY_PRESETS",
+    "param_shardings",
+    "shard_params",
+    "activation_sharding",
+    "kv_cache_shardings",
+]

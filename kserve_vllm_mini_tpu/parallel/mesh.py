@@ -1,0 +1,94 @@
+"""Device-mesh construction: the TPU-native replacement for the reference's
+passthrough parallelism knobs.
+
+The reference forwards TENSOR_PARALLEL_SIZE / PIPELINE_PARALLEL_SIZE env vars
+to external engines (/root/reference/runners/backends/vllm/deploy.sh:78-79,
+triton/deploy.sh:84-86) and never owns a communicator. Here parallelism is a
+``jax.sharding.Mesh`` over ICI/DCN with four named axes:
+
+- ``dp`` — data parallel (request-batch replicas)
+- ``tp`` — tensor parallel (attention heads / FFN columns)
+- ``sp`` — sequence/context parallel (ring attention over long sequences)
+- ``pp`` — pipeline parallel (layer stages)
+
+XLA compiles the collectives (psum / all-gather / reduce-scatter / ppermute)
+onto ICI links; multi-host meshes extend the same axes over DCN via
+``jax.distributed.initialize`` (see parallel/distributed.py).
+
+Topology presets mirror GKE TPU node-pool shapes the deployment layer
+schedules (v5e-1/-4/-8 slices replacing the reference's MIG profiles,
+SURVEY.md §2.2; v5p-16 for the multi-host 70B config, BASELINE.json
+configs[4]).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import jax
+from jax.sharding import Mesh
+
+AXES = ("dp", "sp", "pp", "tp")
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    dp: int = 1
+    sp: int = 1
+    pp: int = 1
+    tp: int = 1
+
+    @property
+    def n_devices(self) -> int:
+        return self.dp * self.sp * self.pp * self.tp
+
+    def axis_sizes(self) -> tuple[int, int, int, int]:
+        return (self.dp, self.sp, self.pp, self.tp)
+
+    @classmethod
+    def fill(cls, n_devices: int, tp: Optional[int] = None, sp: int = 1, pp: int = 1) -> "MeshSpec":
+        """tp defaults to all remaining devices — the serving-friendly layout
+        (TP over ICI minimizes per-token latency)."""
+        rem = n_devices // (sp * pp)
+        tp = tp if tp is not None else rem
+        dp = n_devices // (sp * pp * tp)
+        spec = cls(dp=dp, sp=sp, pp=pp, tp=tp)
+        if spec.n_devices != n_devices:
+            raise ValueError(
+                f"axis sizes {spec.axis_sizes()} do not factor {n_devices} devices"
+            )
+        return spec
+
+
+# name -> (chips, default MeshSpec kwargs)
+TOPOLOGY_PRESETS: dict[str, dict] = {
+    "v5e-1": {"chips": 1, "tp": 1},
+    "v5e-4": {"chips": 4, "tp": 4},
+    "v5e-8": {"chips": 8, "tp": 8},
+    "v5p-8": {"chips": 8, "tp": 8},
+    "v5p-16": {"chips": 16, "tp": 16},   # 2 hosts over ICI (BASELINE configs[4])
+    "cpu-8": {"chips": 8, "tp": 4},      # virtual CPU mesh for tests
+}
+
+
+def make_mesh(spec: MeshSpec, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    if len(devices) < spec.n_devices:
+        raise ValueError(
+            f"mesh spec needs {spec.n_devices} devices, have {len(devices)}"
+        )
+    devices = devices[: spec.n_devices]
+    import numpy as np
+
+    arr = np.array(devices).reshape(spec.axis_sizes())
+    return Mesh(arr, AXES)
+
+
+def mesh_for_topology(name: str, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    if name not in TOPOLOGY_PRESETS:
+        raise ValueError(f"unknown topology {name!r}; known: {sorted(TOPOLOGY_PRESETS)}")
+    p = TOPOLOGY_PRESETS[name]
+    spec = MeshSpec.fill(p["chips"], tp=p.get("tp"))
+    return make_mesh(spec, devices)

@@ -1,0 +1,95 @@
+"""Sharding rules: how Llama params, activations, and KV cache lay out on the
+mesh.
+
+Megatron-style tensor parallelism expressed as NamedShardings and left to XLA
+to lower into collectives (scaling-book recipe: pick a mesh, annotate, let
+XLA insert the all-reduces):
+
+- column-parallel: wq/wk/wv, w_gate/w_up shard their output dim over ``tp``
+- row-parallel: wo, w_down shard their input dim over ``tp`` (XLA inserts the
+  psum on the residual add)
+- embeddings / lm_head shard vocab over ``tp`` (logits all-gathered only if
+  the consumer needs them replicated)
+- KV cache shards batch-slots over ``dp`` and KV heads over ``tp`` when
+  divisible (GQA with tp > n_kv_heads replicates KV, the standard fallback)
+- layer-stacked leading axis shards over ``pp`` when pp > 1
+
+Pytree-shaped rule maps keep this in one place instead of scattering
+with_sharding_constraint calls through the model.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kserve_vllm_mini_tpu.models.config import ModelConfig
+
+
+def _axis(mesh: Mesh, name: str) -> Optional[str]:
+    """Use a mesh axis only if it exists and is >1 (else replicate)."""
+    return name if name in mesh.axis_names and mesh.shape[name] > 1 else None
+
+
+def param_specs(cfg: ModelConfig, mesh: Mesh) -> dict[str, Any]:
+    tp = _axis(mesh, "tp")
+    pp = _axis(mesh, "pp")
+    kv_tp = tp if tp and cfg.n_kv_heads % mesh.shape["tp"] == 0 else None
+    specs: dict[str, Any] = {
+        "embed": P(tp, None),
+        "layers": {
+            "attn_norm": P(pp, None),
+            "wq": P(pp, None, tp),
+            "wk": P(pp, None, kv_tp),
+            "wv": P(pp, None, kv_tp),
+            "wo": P(pp, tp, None),
+            "mlp_norm": P(pp, None),
+            "w_gate": P(pp, None, tp),
+            "w_up": P(pp, None, tp),
+            "w_down": P(pp, tp, None),
+        },
+        "final_norm": P(None),
+    }
+    if not cfg.tie_embeddings:
+        specs["lm_head"] = P(tp, None)
+    return specs
+
+
+def param_shardings(cfg: ModelConfig, mesh: Mesh) -> dict[str, Any]:
+    return jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec),
+        param_specs(cfg, mesh),
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def shard_params(params: dict[str, Any], cfg: ModelConfig, mesh: Mesh) -> dict[str, Any]:
+    """device_put the param pytree onto the mesh per the rules."""
+    shardings = param_shardings(cfg, mesh)
+    return jax.device_put(params, shardings)
+
+
+def activation_sharding(mesh: Mesh, with_seq: bool = False) -> NamedSharding:
+    """[B, T, D] activations: batch over dp, optionally sequence over sp."""
+    dp, sp = _axis(mesh, "dp"), _axis(mesh, "sp")
+    return NamedSharding(mesh, P(dp, sp if with_seq else None, None))
+
+
+def token_sharding(mesh: Mesh) -> NamedSharding:
+    """[B, T] token/position ids: batch over dp."""
+    return NamedSharding(mesh, P(_axis(mesh, "dp"), None))
+
+
+def kv_cache_shardings(cfg: ModelConfig, mesh: Mesh) -> dict[str, NamedSharding]:
+    tp, dp, pp = _axis(mesh, "tp"), _axis(mesh, "dp"), _axis(mesh, "pp")
+    kv_tp = tp if tp and cfg.n_kv_heads % mesh.shape["tp"] == 0 else None
+    spec = P(pp, dp, kv_tp, None, None)  # [L, B, KVH, S, D]
+    s = NamedSharding(mesh, spec)
+    return {"k": s, "v": s}
+
+
+def logits_sharding(mesh: Mesh) -> NamedSharding:
+    """[B, T, V]: batch over dp; vocab gathered (sampling wants full vocab)."""
+    return NamedSharding(mesh, P(_axis(mesh, "dp"), None, None))

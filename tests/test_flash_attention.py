@@ -1,0 +1,47 @@
+"""Pallas flash attention vs the jnp reference oracle (interpret mode on CPU)."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from kserve_vllm_mini_tpu.ops.attention import attention, causal_mask
+from kserve_vllm_mini_tpu.ops.flash_attention import flash_attention
+
+
+def _rand(shape, key, dtype=jnp.float32):
+    return jax.random.normal(jax.random.PRNGKey(key), shape, dtype=dtype)
+
+
+@pytest.mark.parametrize("B,H,KVH,T,D", [(1, 4, 4, 128, 64), (2, 4, 2, 256, 32)])
+def test_flash_matches_dense_causal(B, H, KVH, T, D):
+    q = _rand((B, H, T, D), 0)
+    k = _rand((B, KVH, T, D), 1)
+    v = _rand((B, KVH, T, D), 2)
+    ref = attention(q, k, v, causal_mask(T, T)[None, None])
+    out = flash_attention(q, k, v, causal=True, block_q=64, block_k=64, interpret=True)
+    assert float(jnp.max(jnp.abs(out - ref))) < 2e-5
+
+
+def test_flash_non_causal():
+    B, H, T, D = 1, 2, 128, 32
+    q, k, v = _rand((B, H, T, D), 3), _rand((B, H, T, D), 4), _rand((B, H, T, D), 5)
+    ref = attention(q, k, v, None)
+    out = flash_attention(q, k, v, causal=False, block_q=64, block_k=64, interpret=True)
+    assert float(jnp.max(jnp.abs(out - ref))) < 2e-5
+
+
+def test_flash_rejects_ragged_blocks():
+    q = _rand((1, 2, 100, 32), 6)
+    with pytest.raises(ValueError):
+        flash_attention(q, q, q, block_q=64, block_k=64, interpret=True)
+
+
+def test_flash_bf16():
+    B, H, T, D = 1, 2, 128, 64
+    q = _rand((B, H, T, D), 7, jnp.bfloat16)
+    k = _rand((B, H, T, D), 8, jnp.bfloat16)
+    v = _rand((B, H, T, D), 9, jnp.bfloat16)
+    ref = attention(q, k, v, causal_mask(T, T)[None, None])
+    out = flash_attention(q, k, v, block_q=64, block_k=64, interpret=True)
+    assert out.dtype == jnp.bfloat16
+    assert float(jnp.max(jnp.abs(out.astype(jnp.float32) - ref.astype(jnp.float32)))) < 0.08

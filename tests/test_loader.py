@@ -1,0 +1,31 @@
+"""Checkpoint round-trip: params -> HF-layout safetensors -> params."""
+
+import jax
+import jax.numpy as jnp
+
+from kserve_vllm_mini_tpu.models.config import get_config
+from kserve_vllm_mini_tpu.models.llama import forward, init_params
+from kserve_vllm_mini_tpu.models.loader import (
+    config_from_hf,
+    load_hf_checkpoint,
+    save_checkpoint,
+)
+
+CFG = get_config("llama-tiny")
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    save_checkpoint(params, CFG, tmp_path / "ckpt")
+
+    cfg2 = config_from_hf(tmp_path / "ckpt")
+    assert cfg2.d_model == CFG.d_model
+    assert cfg2.n_kv_heads == CFG.n_kv_heads
+    assert cfg2.rope_theta == CFG.rope_theta
+
+    params2, cfg2 = load_hf_checkpoint(tmp_path / "ckpt")
+    toks = jax.random.randint(jax.random.PRNGKey(1), (1, 8), 0, CFG.vocab_size)
+    pos = jnp.arange(8, dtype=jnp.int32)[None]
+    l1, _ = forward(params, CFG, toks, pos)
+    l2, _ = forward(params2, cfg2, toks, pos)
+    assert float(jnp.max(jnp.abs(l1 - l2))) < 1e-2  # one f32<->bf16 trip

@@ -1,0 +1,95 @@
+"""Model correctness: shapes, causality, cache consistency, determinism."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from kserve_vllm_mini_tpu.models.config import get_config
+from kserve_vllm_mini_tpu.models.llama import forward, init_kv_cache, init_params
+
+CFG = get_config("llama-tiny")
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(jax.random.PRNGKey(0), CFG)
+
+
+def _toks(b, t, seed=1):
+    return jax.random.randint(jax.random.PRNGKey(seed), (b, t), 0, CFG.vocab_size)
+
+
+def _pos(b, t):
+    return jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (b, t))
+
+
+def test_forward_shapes_and_dtype(params):
+    logits, cache = forward(params, CFG, _toks(2, 16), _pos(2, 16))
+    assert logits.shape == (2, 16, CFG.vocab_size)
+    assert logits.dtype == jnp.float32
+    assert cache is None
+
+
+def test_causality(params):
+    toks = _toks(2, 16)
+    logits, _ = forward(params, CFG, toks, _pos(2, 16))
+    toks2 = toks.at[:, 10].set((toks[:, 10] + 1) % CFG.vocab_size)
+    logits2, _ = forward(params, CFG, toks2, _pos(2, 16))
+    assert float(jnp.max(jnp.abs(logits2[:, :10] - logits[:, :10]))) == 0.0
+    assert float(jnp.max(jnp.abs(logits2[:, 10] - logits[:, 10]))) > 0.0
+
+
+def test_prefill_decode_matches_full_forward(params):
+    B, T, split = 2, 16, 8
+    toks, pos = _toks(B, T), _pos(B, T)
+    full, _ = forward(params, CFG, toks, pos)
+
+    cache = init_kv_cache(CFG, B, max_seq=32)
+    _, cache = forward(params, CFG, toks[:, :split], pos[:, :split], cache,
+                       jnp.zeros((B,), jnp.int32))
+    outs = []
+    for t in range(split, T):
+        lt, cache = forward(params, CFG, toks[:, t:t + 1], pos[:, t:t + 1], cache,
+                            jnp.full((B,), t, jnp.int32))
+        outs.append(lt[:, 0])
+    dec = jnp.stack(outs, axis=1)
+    assert float(jnp.max(jnp.abs(dec - full[:, split:]))) < 0.05  # bf16 tolerance
+
+
+def test_ragged_batch_decode(params):
+    """Two slots with different fill levels decode independently and match
+    their own single-sequence results."""
+    B = 2
+    t_a, t_b = 6, 10
+    toks = _toks(1, 12, seed=3)[0]
+    cache = init_kv_cache(CFG, B, max_seq=32)
+    # prefill slot0 with 6 tokens, slot1 with 10 tokens (padded batch prefill)
+    batch_toks = jnp.stack([
+        jnp.pad(toks[:t_a], (0, t_b - t_a)), toks[:t_b]
+    ])
+    pos = _pos(B, t_b)
+    _, cache = forward(params, CFG, batch_toks, pos, cache, jnp.zeros((B,), jnp.int32))
+    # decode next token for each slot at its own offset
+    nxt = jnp.stack([toks[t_a:t_a + 1], toks[t_b:t_b + 1]])
+    dpos = jnp.array([[t_a], [t_b]], dtype=jnp.int32)
+    logits, _ = forward(params, CFG, nxt, dpos, cache, jnp.array([t_a, t_b], jnp.int32))
+
+    # single-sequence ground truth for slot 0
+    solo, _ = forward(params, CFG, toks[None, :t_a + 1], _pos(1, t_a + 1))
+    assert float(jnp.max(jnp.abs(logits[0, 0] - solo[0, -1]))) < 0.05
+
+
+def test_param_count_estimate():
+    cfg8b = get_config("llama-3.1-8b")
+    assert 7.5e9 < cfg8b.param_count < 8.5e9
+    cfg70 = get_config("llama-3-70b")
+    assert 65e9 < cfg70.param_count < 75e9
+
+
+def test_deterministic_init():
+    p1 = init_params(jax.random.PRNGKey(7), CFG)
+    p2 = init_params(jax.random.PRNGKey(7), CFG)
+    assert all(
+        bool(jnp.all(a == b))
+        for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p2))
+    )
