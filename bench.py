@@ -1,0 +1,124 @@
+#!/usr/bin/env python3
+"""Driver benchmark: decode throughput of the in-repo engine on real TPU.
+
+Prints ONE JSON line:
+  {"metric": "...", "value": N, "unit": "...", "vs_baseline": N}
+
+Measures steady-state decode tokens/sec/chip on a Llama-architecture model
+(llama-1b config, bf16, random weights — throughput is weight-value
+independent) with all engine slots busy, jitted decode steps, donated cache.
+Baseline: the north-star >=2000 output tokens/sec/chip
+(/root/repo/BASELINE.json; BASELINE.md north-star table).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from kserve_vllm_mini_tpu.models.config import get_config
+    from kserve_vllm_mini_tpu.models.llama import forward, init_kv_cache, init_params
+    from kserve_vllm_mini_tpu.runtime.sampling import sample_tokens
+
+    model = "llama-1b"
+    slots = 8
+    prompt_len = 128
+    max_seq = 1024
+    decode_steps = 256
+    warmup = 16
+
+    cfg = get_config(model, max_seq_len=max_seq)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+
+    cache = init_kv_cache(cfg, slots, max_seq=max_seq)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (slots, prompt_len), 0, cfg.vocab_size)
+    pos = jnp.broadcast_to(jnp.arange(prompt_len, dtype=jnp.int32), (slots, prompt_len))
+
+    from functools import partial
+
+    @partial(jax.jit, donate_argnums=(1,))
+    def prefill(params, cache, toks, pos):
+        logits, cache = forward(params, cfg, toks, pos, cache,
+                                jnp.zeros((slots,), jnp.int32))
+        return cache, jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+
+    @partial(jax.jit, donate_argnums=(1,))
+    def decode(params, cache, tokens, lengths, rng):
+        logits, cache = forward(params, cfg, tokens[:, None], lengths[:, None],
+                                cache, lengths)
+        nxt = sample_tokens(
+            logits[:, 0, :], rng,
+            jnp.zeros((slots,), jnp.float32),
+            jnp.zeros((slots,), jnp.int32),
+            jnp.ones((slots,), jnp.float32),
+        )
+        return cache, nxt
+
+    import numpy as np
+
+    # NOTE on timing: under the remote-TPU relay, block_until_ready() does not
+    # guarantee device-side completion — only a host readback does, and a
+    # readback pays the tunnel RTT. We therefore time two chained runs of
+    # different lengths, each ended by a readback, and difference them so the
+    # RTT and dispatch overheads cancel.
+    t_pre0 = time.time()
+    cache, tokens = prefill(params, cache, toks, pos)
+    _ = np.asarray(tokens)
+    prefill_s = time.time() - t_pre0
+
+    lengths = jnp.full((slots,), prompt_len, dtype=jnp.int32)
+    rng = jax.random.PRNGKey(2)
+
+    def run_steps(n: int, cache, tokens, lengths, rng):
+        for _ in range(n):
+            rng, sub = jax.random.split(rng)
+            cache, tokens = decode(params, cache, tokens, lengths, sub)
+            lengths = lengths + 1
+        _ = np.asarray(tokens)  # true synchronization point
+        return cache, tokens, lengths, rng
+
+    cache, tokens, lengths, rng = run_steps(warmup, cache, tokens, lengths, rng)
+
+    n_short = decode_steps // 4
+    t0 = time.time()
+    cache, tokens, lengths, rng = run_steps(n_short, cache, tokens, lengths, rng)
+    t_short = time.time() - t0
+
+    t0 = time.time()
+    cache, tokens, lengths, rng = run_steps(decode_steps, cache, tokens, lengths, rng)
+    t_long = time.time() - t0
+
+    dt = max(t_long - t_short, 1e-9)
+    decode_steps = decode_steps - n_short
+
+    n_chips = jax.device_count()
+    toks_per_sec = slots * decode_steps / dt
+    per_chip = toks_per_sec / n_chips
+    baseline = 2000.0  # north-star tokens/sec/chip
+
+    result = {
+        "metric": f"decode_tokens_per_sec_per_chip ({model}, bf16, slots={slots}, ctx~{prompt_len}+)",
+        "value": round(per_chip, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(per_chip / baseline, 3),
+        "detail": {
+            "total_tokens_per_sec": round(toks_per_sec, 1),
+            "decode_step_ms": round(dt / decode_steps * 1000.0, 3),
+            "prefill_first_call_s": round(prefill_s, 2),
+            "n_chips": n_chips,
+            "device": str(jax.devices()[0]),
+            "param_count": cfg.param_count,
+        },
+    }
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

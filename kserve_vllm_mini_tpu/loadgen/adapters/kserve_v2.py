@@ -58,7 +58,9 @@ class KServeV2Adapter(ProtocolAdapter):
 
             def parse_event(evt: dict, r: CallResult) -> str:
                 piece = evt.get("text_output", "") or ""
-                r.tokens_out = self._count_tokens(evt, "") or r.tokens_out
+                # per-chunk counts accumulate (a chunk reports its own tokens,
+                # not a running total — reference triton_token_utils.py:24-52)
+                r.tokens_out += self._count_tokens(evt, "")
                 return piece
 
             async with client.stream("POST", url, json=body, headers=headers) as resp:

@@ -171,6 +171,7 @@ async def run_load_async(cfg: LoadConfig, run_dir: RunDir) -> list[RequestRecord
             "max_tokens": cfg.max_tokens,
             "prompt_set": cfg.prompt_set,
             "seed": cfg.seed,
+            "sampling_seed": cfg.sampling_seed,
             "target_rps": rps,
             "planned_duration_s": dur,
             "started_at": t_start,
@@ -204,6 +205,8 @@ def register(parser: argparse.ArgumentParser) -> None:
                         choices=["default", "repeat", "unique", "mixed"])
     parser.add_argument("--input-tokens", type=int, default=0)
     parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument("--sampling-seed", type=int, default=None,
+                        help="Server-side sampler seed (omitted from requests by default)")
     parser.add_argument("--run-dir", default=None, help="Existing run dir (default: new under runs/)")
     parser.add_argument("--tenant", default="")
 
@@ -224,6 +227,7 @@ def run(args: argparse.Namespace) -> int:
         prompt_set=args.prompt_set,
         input_tokens=args.input_tokens,
         seed=args.seed,
+        sampling_seed=args.sampling_seed,
         tenant=args.tenant,
     )
     run_dir = RunDir(args.run_dir) if args.run_dir else RunDir.create()

@@ -27,6 +27,9 @@ class ModelConfig:
     d_ff: int
     max_seq_len: int = 4096
     rope_theta: float = 500_000.0
+    # Llama-3.1-style RoPE frequency scaling:
+    # (factor, low_freq_factor, high_freq_factor, original_max_position_embeddings)
+    rope_scaling: Optional[tuple[float, float, float, int]] = None
     rms_eps: float = 1e-5
     dtype: str = "bfloat16"          # parameter/activation dtype
     tie_embeddings: bool = False
@@ -91,6 +94,7 @@ PRESETS: dict[str, ModelConfig] = {
         n_kv_heads=8,
         d_ff=8192,
         max_seq_len=8192,
+        rope_scaling=(32.0, 1.0, 4.0, 8192),   # Llama-3.2-1B ships this
     ),
     "llama-3.1-8b": ModelConfig(
         name="llama-3.1-8b",
@@ -101,6 +105,7 @@ PRESETS: dict[str, ModelConfig] = {
         n_kv_heads=8,
         d_ff=14_336,
         max_seq_len=8192,
+        rope_scaling=(8.0, 1.0, 4.0, 8192),    # Llama-3.1 config.json rope_scaling
     ),
     "llama-3-70b": ModelConfig(
         name="llama-3-70b",

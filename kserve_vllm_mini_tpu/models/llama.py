@@ -91,6 +91,8 @@ def forward(
     positions: jnp.ndarray,      # [B, T] int32 absolute positions
     kv_cache: Optional[KVCache] = None,
     cache_offsets: Optional[jnp.ndarray] = None,  # [B] slot where this block starts
+    attention_fn=None,  # optional (q, k, v, positions) -> o override for the
+                        # cache-free path (e.g. parallel.ring_attention for sp)
 ) -> tuple[jnp.ndarray, Optional[KVCache]]:
     """Returns (logits [B, T, V] float32, updated cache).
 
@@ -102,7 +104,9 @@ def forward(
     B, T = tokens.shape
     dt = cfg.jnp_dtype
     x = params["embed"][tokens]  # [B, T, D] gather
-    cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+    cos, sin = rope_frequencies(
+        cfg.head_dim, cfg.max_seq_len, cfg.rope_theta, cfg.rope_scaling
+    )
 
     use_cache = kv_cache is not None
     if use_cache and cache_offsets is None:
@@ -125,6 +129,8 @@ def forward(
             mask = kj <= positions[:, :, None]          # [B, T, S]
             mask = mask[:, None, :, :]                  # [B, 1, T, S]
             o = attention(q, k_layer, v_layer, mask)
+        elif attention_fn is not None:
+            o = attention_fn(q, k, v, positions)
         else:
             kj = jnp.arange(T)[None, None, :]
             mask = (kj <= positions[:, :, None])[:, None, :, :]

@@ -39,7 +39,17 @@ def config_from_hf(model_dir: str | Path) -> ModelConfig:
     """Derive a ModelConfig from an HF config.json."""
     with (Path(model_dir) / "config.json").open() as f:
         hf = json.load(f)
+    rope_scaling = None
+    rs = hf.get("rope_scaling")
+    if isinstance(rs, dict) and rs.get("rope_type", rs.get("type")) == "llama3":
+        rope_scaling = (
+            float(rs.get("factor", 8.0)),
+            float(rs.get("low_freq_factor", 1.0)),
+            float(rs.get("high_freq_factor", 4.0)),
+            int(rs.get("original_max_position_embeddings", 8192)),
+        )
     return ModelConfig(
+        rope_scaling=rope_scaling,
         name=hf.get("_name_or_path", Path(model_dir).name) or Path(model_dir).name,
         vocab_size=hf["vocab_size"],
         d_model=hf["hidden_size"],
@@ -157,5 +167,14 @@ def save_checkpoint(params: dict[str, Any], cfg: ModelConfig, out_dir: str | Pat
         "tie_word_embeddings": cfg.tie_embeddings,
         "model_type": "llama",
     }
+    if cfg.rope_scaling is not None:
+        f_, lo, hi, omax = cfg.rope_scaling
+        hf_cfg["rope_scaling"] = {
+            "rope_type": "llama3",
+            "factor": f_,
+            "low_freq_factor": lo,
+            "high_freq_factor": hi,
+            "original_max_position_embeddings": omax,
+        }
     with (out_dir / "config.json").open("w") as f:
         json.dump(hf_cfg, f, indent=2)

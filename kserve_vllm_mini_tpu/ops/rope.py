@@ -7,14 +7,42 @@ one code path — important under jit where positions are traced values.
 
 from __future__ import annotations
 
+import math
+from typing import Optional
+
 import jax.numpy as jnp
 
 
-def rope_frequencies(head_dim: int, max_seq_len: int, theta: float) -> tuple[jnp.ndarray, jnp.ndarray]:
+def _llama3_scale(inv_freq: jnp.ndarray, scaling: tuple[float, float, float, int]) -> jnp.ndarray:
+    """Llama-3.1 'llama3' rope_scaling: long wavelengths divide by ``factor``,
+    short ones stay, the band between interpolates smoothly (matches HF
+    transformers' _compute_llama3_parameters)."""
+    factor, low_freq_factor, high_freq_factor, orig_max_pos = scaling
+    low_freq_wavelen = orig_max_pos / low_freq_factor
+    high_freq_wavelen = orig_max_pos / high_freq_factor
+    wavelen = 2.0 * math.pi / inv_freq
+    scaled = inv_freq / factor
+    smooth = (orig_max_pos / wavelen - low_freq_factor) / (
+        high_freq_factor - low_freq_factor
+    )
+    mid = (1.0 - smooth) * scaled + smooth * inv_freq
+    out = jnp.where(wavelen > low_freq_wavelen, scaled, inv_freq)
+    is_mid = (wavelen <= low_freq_wavelen) & (wavelen >= high_freq_wavelen)
+    return jnp.where(is_mid, mid, out)
+
+
+def rope_frequencies(
+    head_dim: int,
+    max_seq_len: int,
+    theta: float,
+    rope_scaling: Optional[tuple[float, float, float, int]] = None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Return (cos, sin) tables of shape [max_seq_len, head_dim//2], float32."""
     inv_freq = 1.0 / (
         theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
     )
+    if rope_scaling is not None:
+        inv_freq = _llama3_scale(inv_freq, rope_scaling)
     pos = jnp.arange(max_seq_len, dtype=jnp.float32)
     angles = jnp.outer(pos, inv_freq)  # [S, D/2]
     return jnp.cos(angles), jnp.sin(angles)

@@ -1,0 +1,5 @@
+"""In-repo TPU serving runtime: continuous-batching engine + OpenAI server.
+
+The reference outsources serving to external container images (SURVEY.md
+§2.1); this package is the TPU-native equivalent — the framework works with
+no cluster at all."""

@@ -1,0 +1,377 @@
+"""Continuous-batching serving engine under XLA's static-shape constraint.
+
+Core design (SURVEY.md §7.3.1 — this is the subsystem the reference
+outsources to vLLM/TGI/Triton images):
+
+- **Slots, not dynamic batches**: the KV cache is one static array
+  [L, max_slots, KVH, max_seq, D]; a request occupies a slot from admission
+  to completion, so the decode step is a single jitted call of fixed shape
+  regardless of which requests are live (inactive slots compute padding).
+- **Bucketed prefill**: prompts pad to power-of-two buckets; one compiled
+  executable per bucket, cached. Prefill writes KV directly into the slot's
+  cache region and returns the first sampled token.
+- **Donated decode state**: cache arrays are donated through every jitted
+  step, so XLA updates them in place in HBM — no cache copies per token.
+- **Host scheduler thread**: admission (free slot + pending request ->
+  prefill) interleaved with decode sweeps; tokens stream to per-request
+  thread-safe queues; true server-side TTFT is recorded here and surfaced
+  through the API (the reference can only approximate TTFT client-side,
+  SURVEY.md §7.3.5).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from kserve_vllm_mini_tpu.models.config import ModelConfig
+from kserve_vllm_mini_tpu.models.llama import forward
+from kserve_vllm_mini_tpu.runtime.sampling import sample_tokens
+
+
+@dataclass
+class EngineConfig:
+    max_slots: int = 8
+    max_seq_len: int = 1024           # per-request cap (cache length)
+    max_prefill_len: int = 512
+    min_prefill_bucket: int = 16
+    seed: int = 0
+
+
+@dataclass
+class GenRequest:
+    prompt_tokens: list[int]
+    max_new_tokens: int = 64
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    eos_id: Optional[int] = None
+    request_id: str = field(default_factory=lambda: uuid.uuid4().hex[:16])
+
+
+class RequestHandle:
+    """Streamed results: ('token', id, ts) events then ('done', info)."""
+
+    def __init__(self, req: GenRequest) -> None:
+        self.request = req
+        self.events: "queue.Queue[tuple]" = queue.Queue()
+        self.t_submit = time.time()
+        self.t_first_token: float = 0.0
+        self.t_done: float = 0.0
+        self.tokens: list[int] = []
+        self.finish_reason: str = ""
+
+    @property
+    def server_ttft_ms(self) -> float:
+        if self.t_first_token:
+            return (self.t_first_token - self.t_submit) * 1000.0
+        return 0.0
+
+
+class Engine:
+    """Slot-based continuous-batching engine over a (possibly sharded) model."""
+
+    def __init__(
+        self,
+        params: dict[str, Any],
+        cfg: ModelConfig,
+        engine_cfg: Optional[EngineConfig] = None,
+        mesh=None,
+        pad_id: int = 0,
+    ) -> None:
+        self.cfg = cfg
+        self.ecfg = engine_cfg or EngineConfig()
+        self.ecfg.max_seq_len = min(self.ecfg.max_seq_len, cfg.max_seq_len)
+        # prefill bucket must fit inside the cache with at least one decode slot
+        self.ecfg.max_prefill_len = min(
+            self.ecfg.max_prefill_len, self.ecfg.max_seq_len - 1
+        )
+        self.mesh = mesh
+        self.pad_id = pad_id
+        self.params = params
+
+        S = self.ecfg.max_slots
+        L = cfg.n_layers
+        shape = (L, S, cfg.n_kv_heads, self.ecfg.max_seq_len, cfg.head_dim)
+        self._cache_k = jnp.zeros(shape, dtype=cfg.jnp_dtype)
+        self._cache_v = jnp.zeros(shape, dtype=cfg.jnp_dtype)
+        if mesh is not None:
+            from kserve_vllm_mini_tpu.parallel.sharding import kv_cache_shardings
+
+            sh = kv_cache_shardings(cfg, mesh)
+            self._cache_k = jax.device_put(self._cache_k, sh["k"])
+            self._cache_v = jax.device_put(self._cache_v, sh["v"])
+
+        # host-side slot state
+        self._slot_req: list[Optional[RequestHandle]] = [None] * S
+        self._slot_len = [0] * S
+        self._slot_remaining = [0] * S
+        self._last_tokens = [pad_id] * S
+        self._free = list(range(S))
+
+        self._pending: "queue.Queue[RequestHandle]" = queue.Queue()
+        self._rng = jax.random.PRNGKey(self.ecfg.seed)
+        self._step_counter = 0
+        self._prefill_fns: dict[int, Any] = {}
+        self._decode_fn = None
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+        # sampling-parameter device arrays, rebuilt only on admit/finish —
+        # never on the per-token hot path
+        self._sampling_arrays: Optional[tuple] = None
+
+        # stats for /metrics and duty-cycle telemetry
+        self.stats = {
+            "prefill_tokens": 0,
+            "decode_tokens": 0,
+            "decode_steps": 0,
+            "prefills": 0,
+            "requests_completed": 0,
+            "busy_s": 0.0,
+            "started_at": time.time(),
+            "queue_depth": 0,
+        }
+
+    # -- compiled steps ----------------------------------------------------
+
+    def _bucket(self, n: int) -> int:
+        b = self.ecfg.min_prefill_bucket
+        while b < n:
+            b *= 2
+        return min(b, self.ecfg.max_prefill_len)
+
+    def _get_prefill_fn(self, bucket: int):
+        if bucket in self._prefill_fns:
+            return self._prefill_fns[bucket]
+        cfg = self.cfg
+
+        @partial(jax.jit, donate_argnums=(1, 2), static_argnums=())
+        def prefill(params, cache_k, cache_v, tokens, length, slot):
+            # tokens: [1, bucket]; length: scalar; slot: scalar
+            L, S, KVH, MS, D = cache_k.shape
+            pos = jnp.arange(tokens.shape[1], dtype=jnp.int32)[None]
+            sub_k = jax.lax.dynamic_slice(cache_k, (0, slot, 0, 0, 0), (L, 1, KVH, MS, D))
+            sub_v = jax.lax.dynamic_slice(cache_v, (0, slot, 0, 0, 0), (L, 1, KVH, MS, D))
+            logits, new_cache = forward(
+                params, cfg, tokens, pos,
+                {"k": sub_k, "v": sub_v}, jnp.zeros((1,), jnp.int32),
+            )
+            cache_k = jax.lax.dynamic_update_slice(cache_k, new_cache["k"], (0, slot, 0, 0, 0))
+            cache_v = jax.lax.dynamic_update_slice(cache_v, new_cache["v"], (0, slot, 0, 0, 0))
+            last = jax.lax.dynamic_index_in_dim(logits[0], length - 1, axis=0, keepdims=False)
+            return cache_k, cache_v, last  # last: [V] f32
+
+        self._prefill_fns[bucket] = prefill
+        return prefill
+
+    def _get_decode_fn(self):
+        if self._decode_fn is not None:
+            return self._decode_fn
+        cfg = self.cfg
+
+        @partial(jax.jit, donate_argnums=(1, 2))
+        def decode(params, cache_k, cache_v, tokens, lengths, temps, topks, topps, rng):
+            # tokens: [S] int32 (last token per slot); lengths: [S]
+            toks = tokens[:, None]
+            pos = lengths[:, None]
+            logits, new_cache = forward(
+                params, cfg, toks, pos, {"k": cache_k, "v": cache_v}, lengths
+            )
+            nxt = sample_tokens(logits[:, 0, :], rng, temps, topks, topps)
+            return new_cache["k"], new_cache["v"], nxt
+
+        self._decode_fn = decode
+        return decode
+
+    # -- public API --------------------------------------------------------
+
+    def submit(self, req: GenRequest) -> RequestHandle:
+        if len(req.prompt_tokens) > self.ecfg.max_prefill_len:
+            # keep the tail: the most recent context fits the prefill budget
+            req.prompt_tokens = req.prompt_tokens[-self.ecfg.max_prefill_len:]
+        handle = RequestHandle(req)
+        self._pending.put(handle)
+        self.stats["queue_depth"] = self._pending.qsize()
+        return handle
+
+    def start(self) -> None:
+        if self._running:
+            return
+        self._running = True
+        self._thread = threading.Thread(target=self._loop, daemon=True, name="engine-loop")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._running = False
+        if self._thread:
+            self._thread.join(timeout=10.0)
+
+    # -- scheduler loop ----------------------------------------------------
+
+    def _admit_one(self, handle: RequestHandle) -> None:
+        req = handle.request
+        slot = self._free.pop()
+        n = len(req.prompt_tokens)
+        bucket = self._bucket(n)
+        toks = req.prompt_tokens + [self.pad_id] * (bucket - n)
+        tokens = jnp.asarray(toks, dtype=jnp.int32)[None]
+        prefill = self._get_prefill_fn(bucket)
+        t0 = time.time()
+        self._cache_k, self._cache_v, last_logits = prefill(
+            self.params, self._cache_k, self._cache_v, tokens,
+            jnp.int32(n), jnp.int32(slot),
+        )
+        # first token: sampled from the prompt's last-position logits
+        self._rng, sub = jax.random.split(self._rng)
+        first = sample_tokens(
+            last_logits[None, :], sub,
+            jnp.asarray([req.temperature], jnp.float32),
+            jnp.asarray([req.top_k], jnp.int32),
+            jnp.asarray([req.top_p], jnp.float32),
+        )
+        first_id = int(first[0])
+        self.stats["busy_s"] += time.time() - t0
+        self.stats["prefills"] += 1
+        self.stats["prefill_tokens"] += n
+
+        handle.t_first_token = time.time()
+        handle.tokens.append(first_id)
+        handle.events.put(("token", first_id, handle.t_first_token))
+
+        self._slot_req[slot] = handle
+        self._slot_len[slot] = n
+        self._slot_remaining[slot] = req.max_new_tokens - 1
+        self._last_tokens[slot] = first_id
+        self._sampling_arrays = None  # slot population changed
+        hit_eos = req.eos_id is not None and first_id == req.eos_id
+        if self._slot_remaining[slot] <= 0 or hit_eos:
+            self._finish_slot(slot, "stop" if hit_eos else "length")
+
+    def _get_sampling_arrays(self) -> tuple:
+        if self._sampling_arrays is None:
+            S = self.ecfg.max_slots
+            self._sampling_arrays = (
+                jnp.asarray(
+                    [self._slot_req[i].request.temperature if self._slot_req[i] else 0.0
+                     for i in range(S)], jnp.float32),
+                jnp.asarray(
+                    [self._slot_req[i].request.top_k if self._slot_req[i] else 0
+                     for i in range(S)], jnp.int32),
+                jnp.asarray(
+                    [self._slot_req[i].request.top_p if self._slot_req[i] else 1.0
+                     for i in range(S)], jnp.float32),
+            )
+        return self._sampling_arrays
+
+    def _finish_slot(self, slot: int, reason: str) -> None:
+        handle = self._slot_req[slot]
+        if handle is not None:
+            handle.t_done = time.time()
+            handle.finish_reason = reason
+            handle.events.put(("done", {
+                "finish_reason": reason,
+                "tokens_out": len(handle.tokens),
+                "server_ttft_ms": handle.server_ttft_ms,
+            }))
+            self.stats["requests_completed"] += 1
+        self._slot_req[slot] = None
+        self._free.append(slot)
+        self._sampling_arrays = None  # slot population changed
+
+    def _decode_sweep(self) -> None:
+        S = self.ecfg.max_slots
+        active = [i for i in range(S) if self._slot_req[i] is not None]
+        if not active:
+            return
+        tokens = jnp.asarray(self._last_tokens, dtype=jnp.int32)
+        # The fed token occupies absolute position slot_len (prompt + generated
+        # tokens already written); forward writes its KV there and attends <=.
+        lengths = jnp.asarray(self._slot_len, dtype=jnp.int32)
+        temps, topks, topps = self._get_sampling_arrays()
+        self._rng, sub = jax.random.split(self._rng)
+        decode = self._get_decode_fn()
+        t0 = time.time()
+        self._cache_k, self._cache_v, nxt = decode(
+            self.params, self._cache_k, self._cache_v,
+            tokens, lengths, temps, topks, topps, sub,
+        )
+        nxt_host = list(map(int, nxt))
+        now = time.time()
+        self.stats["busy_s"] += now - t0
+        self.stats["decode_steps"] += 1
+
+        for i in active:
+            handle = self._slot_req[i]
+            req = handle.request
+            tok = nxt_host[i]
+            self._slot_len[i] += 1          # the fed token is now in cache
+            self._last_tokens[i] = tok
+            handle.tokens.append(tok)
+            handle.events.put(("token", tok, now))
+            self.stats["decode_tokens"] += 1
+            self._slot_remaining[i] -= 1
+            hit_eos = req.eos_id is not None and tok == req.eos_id
+            out_of_space = self._slot_len[i] + 1 >= self.ecfg.max_seq_len
+            if self._slot_remaining[i] <= 0 or hit_eos or out_of_space:
+                self._finish_slot(i, "stop" if hit_eos else "length")
+
+    def _fail_all(self, exc: BaseException) -> None:
+        """Push an error 'done' to every live/pending handle so no client
+        blocks forever on a dead scheduler."""
+        info = {"finish_reason": "error", "error": f"{type(exc).__name__}: {exc}"}
+        for slot in range(self.ecfg.max_slots):
+            h = self._slot_req[slot]
+            if h is not None:
+                h.events.put(("done", dict(info)))
+                self._slot_req[slot] = None
+        while True:
+            try:
+                h = self._pending.get_nowait()
+            except queue.Empty:
+                break
+            h.events.put(("done", dict(info)))
+
+    def _loop(self) -> None:
+        while self._running:
+            try:
+                admitted = False
+                while self._free:
+                    try:
+                        handle = self._pending.get_nowait()
+                    except queue.Empty:
+                        break
+                    self._admit_one(handle)
+                    admitted = True
+                self.stats["queue_depth"] = self._pending.qsize()
+                if any(h is not None for h in self._slot_req):
+                    self._decode_sweep()
+                elif not admitted:
+                    try:
+                        handle = self._pending.get(timeout=0.02)
+                    except queue.Empty:
+                        continue
+                    self._admit_one(handle)
+            except Exception as exc:  # scheduler must never die silently
+                import traceback
+
+                traceback.print_exc()
+                self._fail_all(exc)
+                self._running = False
+
+    # -- introspection -----------------------------------------------------
+
+    def snapshot_stats(self) -> dict[str, Any]:
+        s = dict(self.stats)
+        wall = max(time.time() - s["started_at"], 1e-9)
+        s["duty_cycle"] = min(s["busy_s"] / wall, 1.0)
+        s["active_slots"] = sum(1 for h in self._slot_req if h is not None)
+        s["free_slots"] = len(self._free)
+        return s

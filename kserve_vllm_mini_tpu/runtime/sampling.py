@@ -1,0 +1,51 @@
+"""Token sampling under jit with per-slot parameters.
+
+The decode step samples for all engine slots in one fused call: temperature,
+top-k, and top-p are [B] vectors so heterogeneous requests batch together
+(continuous batching must not re-trace when a new request's temperature
+differs). Greedy is temperature == 0 via jnp.where, not Python branching —
+everything stays traceable.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def sample_tokens(
+    logits: jnp.ndarray,        # [B, V] float32
+    rng: jax.Array,
+    temperature: jnp.ndarray,   # [B] float32; 0 => greedy
+    top_k: jnp.ndarray,         # [B] int32; 0 => disabled
+    top_p: jnp.ndarray,         # [B] float32; 1.0 => disabled
+) -> jnp.ndarray:
+    """Returns [B] int32 sampled token ids."""
+    B, V = logits.shape
+    greedy = jnp.argmax(logits, axis=-1)
+
+    # temperature scaling (guard /0 for the greedy rows; they're masked later)
+    safe_t = jnp.where(temperature > 0, temperature, 1.0)
+    scaled = logits / safe_t[:, None]
+
+    # top-k: mask everything below the k-th largest logit per row
+    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]          # [B, V]
+    k_idx = jnp.clip(top_k - 1, 0, V - 1)
+    kth = jnp.take_along_axis(sorted_desc, k_idx[:, None], axis=-1)  # [B,1]
+    scaled = jnp.where(
+        (top_k[:, None] > 0) & (scaled < kth), -jnp.inf, scaled
+    )
+
+    # top-p (nucleus): keep the smallest prefix of the sorted distribution
+    # with cumulative prob >= top_p; always keep the argmax.
+    sorted_desc2 = jnp.sort(scaled, axis=-1)[:, ::-1]
+    probs_sorted = jax.nn.softmax(sorted_desc2, axis=-1)
+    cum = jnp.cumsum(probs_sorted, axis=-1)
+    # threshold logit value: smallest sorted logit still inside the nucleus
+    inside = cum - probs_sorted < top_p[:, None]              # keep while mass before < p
+    # the cut logit = min over kept entries
+    cut = jnp.min(jnp.where(inside, sorted_desc2, jnp.inf), axis=-1)  # [B]
+    scaled = jnp.where(scaled < cut[:, None], -jnp.inf, scaled)
+
+    sampled = jax.random.categorical(rng, scaled, axis=-1)
+    return jnp.where(temperature > 0, sampled, greedy).astype(jnp.int32)

@@ -1,0 +1,279 @@
+"""OpenAI-compatible HTTP server over the in-repo engine.
+
+Endpoints:
+
+- ``POST /v1/chat/completions`` — streaming (SSE) and non-streaming, with a
+  ``metrics.server_ttft_ms`` extension carrying the engine's true first-token
+  latency (the loadgen records it next to the client-side TTFT; the reference
+  can only approximate TTFT client-side, SURVEY.md §7.3.5)
+- ``GET /v1/models`` — model listing
+- ``GET /healthz`` — readiness (KServe-style probe target)
+- ``GET /metrics`` — Prometheus text format: token counters, duty cycle,
+  queue depth, slot occupancy. This is the runtime leg of the telemetry
+  fallback chain (analysis/telemetry.py) replacing DCGM.
+
+Run: ``kvmini-tpu serve --model llama-tiny --port 8000`` (random weights) or
+``--checkpoint /path/to/hf_dir`` for real ones.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import time
+import uuid
+from typing import Any, Optional
+
+from kserve_vllm_mini_tpu.runtime.engine import Engine, EngineConfig, GenRequest
+from kserve_vllm_mini_tpu.runtime.tokenizer import Tokenizer, load_tokenizer
+
+
+def build_engine(
+    model: str = "llama-tiny",
+    checkpoint: Optional[str] = None,
+    tokenizer_path: Optional[str] = None,
+    max_slots: int = 8,
+    max_seq_len: int = 1024,
+    topology: Optional[str] = None,
+    seed: int = 0,
+) -> tuple[Engine, Tokenizer, str]:
+    """Construct (engine, tokenizer, model_name) from a preset or checkpoint."""
+    import jax
+
+    from kserve_vllm_mini_tpu.models.config import get_config
+    from kserve_vllm_mini_tpu.models.llama import init_params
+
+    mesh = None
+    if topology:
+        from kserve_vllm_mini_tpu.parallel.mesh import mesh_for_topology
+
+        mesh = mesh_for_topology(topology)
+
+    tok = load_tokenizer(tokenizer_path or checkpoint)
+    if checkpoint:
+        from kserve_vllm_mini_tpu.models.loader import load_hf_checkpoint
+
+        params, cfg = load_hf_checkpoint(checkpoint)
+        name = cfg.name
+    else:
+        cfg = get_config(model)
+        if tok.vocab_size > cfg.vocab_size:
+            cfg = cfg.scaled(vocab_size=tok.vocab_size)
+        params = init_params(jax.random.PRNGKey(seed), cfg)
+        name = cfg.name
+    if mesh is not None:
+        from kserve_vllm_mini_tpu.parallel.sharding import shard_params
+
+        params = shard_params(params, cfg, mesh)
+    ecfg = EngineConfig(
+        max_slots=max_slots,
+        max_seq_len=min(max_seq_len, cfg.max_seq_len),
+        max_prefill_len=min(max_seq_len, cfg.max_seq_len) // 2,
+        seed=seed,
+    )
+    engine = Engine(params, cfg, ecfg, mesh=mesh, pad_id=tok.pad_id)
+    return engine, tok, name
+
+
+def make_app(engine: Engine, tok: Tokenizer, model_name: str):
+    from aiohttp import web
+
+    started = time.time()
+
+    def _messages_to_prompt(messages: list[dict[str, Any]]) -> str:
+        parts = []
+        for m in messages:
+            parts.append(f"{m.get('role', 'user')}: {m.get('content', '')}")
+        parts.append("assistant:")
+        return "\n".join(parts)
+
+    async def chat(request: "web.Request"):
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response({"error": {"message": "invalid JSON body"}}, status=400)
+        messages = body.get("messages")
+        if not isinstance(messages, list) or not messages:
+            return web.json_response(
+                {"error": {"message": "'messages' must be a non-empty list"}}, status=400
+            )
+        prompt = _messages_to_prompt(messages)
+        prompt_ids = tok.encode(prompt)
+        req = GenRequest(
+            prompt_tokens=prompt_ids or [tok.bos_id],
+            max_new_tokens=int(body.get("max_tokens", 64)),
+            temperature=float(body.get("temperature", 0.0)),
+            top_k=int(body.get("top_k", 0)),
+            top_p=float(body.get("top_p", 1.0)),
+            eos_id=tok.eos_id,
+        )
+        handle = engine.submit(req)
+        rid = f"chatcmpl-{uuid.uuid4().hex[:20]}"
+        created = int(time.time())
+        loop = asyncio.get_running_loop()
+
+        async def next_event():
+            return await loop.run_in_executor(None, handle.events.get)
+
+        if not body.get("stream", False):
+            out_ids: list[int] = []
+            info: dict[str, Any] = {}
+            while True:
+                kind, *rest = await next_event()
+                if kind == "token":
+                    out_ids.append(rest[0])
+                else:
+                    info = rest[0]
+                    break
+            text = tok.decode(out_ids)
+            return web.json_response(
+                {
+                    "id": rid,
+                    "object": "chat.completion",
+                    "created": created,
+                    "model": model_name,
+                    "choices": [
+                        {
+                            "index": 0,
+                            "message": {"role": "assistant", "content": text},
+                            "finish_reason": info.get("finish_reason", "stop"),
+                        }
+                    ],
+                    "usage": {
+                        "prompt_tokens": len(prompt_ids),
+                        "completion_tokens": len(out_ids),
+                        "total_tokens": len(prompt_ids) + len(out_ids),
+                    },
+                    "metrics": {"server_ttft_ms": handle.server_ttft_ms},
+                }
+            )
+
+        resp = web.StreamResponse(
+            status=200,
+            headers={"Content-Type": "text/event-stream", "Cache-Control": "no-cache"},
+        )
+        await resp.prepare(request)
+        n_out = 0
+        sent_first = False
+        try:
+            while True:
+                kind, *rest = await next_event()
+                if kind == "token":
+                    n_out += 1
+                    piece = tok.decode([rest[0]])
+                    evt: dict[str, Any] = {
+                        "id": rid,
+                        "object": "chat.completion.chunk",
+                        "created": created,
+                        "model": model_name,
+                        "choices": [
+                            {"index": 0, "delta": {"content": piece}, "finish_reason": None}
+                        ],
+                    }
+                    if not sent_first:
+                        evt["metrics"] = {"server_ttft_ms": handle.server_ttft_ms}
+                        sent_first = True
+                    await resp.write(f"data: {json.dumps(evt)}\n\n".encode())
+                else:
+                    info = rest[0]
+                    final = {
+                        "id": rid,
+                        "object": "chat.completion.chunk",
+                        "created": created,
+                        "model": model_name,
+                        "choices": [
+                            {"index": 0, "delta": {},
+                             "finish_reason": info.get("finish_reason", "stop")}
+                        ],
+                        "usage": {
+                            "prompt_tokens": len(prompt_ids),
+                            "completion_tokens": n_out,
+                            "total_tokens": len(prompt_ids) + n_out,
+                        },
+                    }
+                    await resp.write(f"data: {json.dumps(final)}\n\n".encode())
+                    await resp.write(b"data: [DONE]\n\n")
+                    break
+        except (ConnectionResetError, asyncio.CancelledError):
+            pass  # client went away; engine finishes the slot on its own
+        await resp.write_eof()
+        return resp
+
+    async def models(_request):
+        return web.json_response(
+            {"object": "list", "data": [
+                {"id": model_name, "object": "model", "created": int(started),
+                 "owned_by": "kvmini-tpu"}
+            ]}
+        )
+
+    async def healthz(_request):
+        return web.json_response({"status": "ok", "uptime_s": time.time() - started})
+
+    async def metrics(_request):
+        s = engine.snapshot_stats()
+        lines = [
+            "# TYPE kvmini_tpu_decode_tokens_total counter",
+            f"kvmini_tpu_decode_tokens_total {s['decode_tokens']}",
+            "# TYPE kvmini_tpu_prefill_tokens_total counter",
+            f"kvmini_tpu_prefill_tokens_total {s['prefill_tokens']}",
+            "# TYPE kvmini_tpu_requests_completed_total counter",
+            f"kvmini_tpu_requests_completed_total {s['requests_completed']}",
+            "# TYPE kvmini_tpu_duty_cycle gauge",
+            f"kvmini_tpu_duty_cycle {s['duty_cycle']:.6f}",
+            "# TYPE kvmini_tpu_queue_depth gauge",
+            f"kvmini_tpu_queue_depth {s['queue_depth']}",
+            "# TYPE kvmini_tpu_active_slots gauge",
+            f"kvmini_tpu_active_slots {s['active_slots']}",
+            "# TYPE kvmini_tpu_free_slots gauge",
+            f"kvmini_tpu_free_slots {s['free_slots']}",
+            "# TYPE kvmini_tpu_decode_steps_total counter",
+            f"kvmini_tpu_decode_steps_total {s['decode_steps']}",
+        ]
+        return web.Response(text="\n".join(lines) + "\n", content_type="text/plain")
+
+    app = web.Application()
+    app.router.add_post("/v1/chat/completions", chat)
+    app.router.add_get("/v1/models", models)
+    app.router.add_get("/healthz", healthz)
+    app.router.add_get("/metrics", metrics)
+    return app
+
+
+# -- CLI ---------------------------------------------------------------------
+
+def register(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument("--model", default="llama-tiny", help="Model preset name")
+    parser.add_argument("--checkpoint", default=None, help="Local HF checkpoint dir")
+    parser.add_argument("--tokenizer", default=None, help="Local tokenizer dir")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=8000)
+    parser.add_argument("--max-slots", type=int, default=8)
+    parser.add_argument("--max-seq-len", type=int, default=1024)
+    parser.add_argument("--topology", default=None,
+                        help="Mesh topology preset (e.g. v5e-8); default single-device")
+    parser.add_argument("--seed", type=int, default=0)
+
+
+def run(args: argparse.Namespace) -> int:
+    from aiohttp import web
+
+    engine, tok, name = build_engine(
+        model=args.model,
+        checkpoint=args.checkpoint,
+        tokenizer_path=args.tokenizer,
+        max_slots=args.max_slots,
+        max_seq_len=args.max_seq_len,
+        topology=args.topology,
+        seed=args.seed,
+    )
+    engine.start()
+    app = make_app(engine, tok, name)
+    print(f"kvmini-tpu serve: {name} on http://{args.host}:{args.port} "
+          f"(slots={args.max_slots}, max_seq={args.max_seq_len})")
+    try:
+        web.run_app(app, host=args.host, port=args.port, print=None)
+    finally:
+        engine.stop()
+    return 0

@@ -1,0 +1,81 @@
+"""Tokenizers for the serving runtime.
+
+Two implementations behind one protocol:
+
+- ``ByteTokenizer`` — self-contained UTF-8 byte-level tokenizer (vocab 256 +
+  specials). Zero external assets, so the runtime serves end-to-end in an
+  air-gapped CI exactly like the reference's mock-cluster tiers (SURVEY.md
+  §4.3). Token counts are real token counts for throughput metrics.
+- ``HFTokenizer`` — wraps a local ``tokenizer.json``/sentencepiece checkpoint
+  directory via ``transformers`` for real-model serving. Never touches the
+  network.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Protocol, Sequence
+
+
+class Tokenizer(Protocol):
+    vocab_size: int
+    bos_id: int
+    eos_id: int
+    pad_id: int
+
+    def encode(self, text: str) -> list[int]: ...
+    def decode(self, ids: Sequence[int]) -> str: ...
+
+
+class ByteTokenizer:
+    """UTF-8 bytes shifted by the special-token count."""
+
+    SPECIALS = 3  # pad=0, bos=1, eos=2
+
+    def __init__(self) -> None:
+        self.pad_id = 0
+        self.bos_id = 1
+        self.eos_id = 2
+        self.vocab_size = 256 + self.SPECIALS
+
+    def encode(self, text: str) -> list[int]:
+        return [b + self.SPECIALS for b in text.encode("utf-8")]
+
+    def decode(self, ids: Sequence[int]) -> str:
+        # ids outside the byte range (possible when the model's vocab exceeds
+        # 259, e.g. random-weight smoke models) are dropped, not crashed on
+        raw = bytes(
+            i - self.SPECIALS for i in ids if self.SPECIALS <= i < 256 + self.SPECIALS
+        )
+        return raw.decode("utf-8", errors="replace")
+
+
+class HFTokenizer:
+    def __init__(self, path: str | Path) -> None:
+        from transformers import AutoTokenizer
+
+        self._tok = AutoTokenizer.from_pretrained(str(path), local_files_only=True)
+        self.vocab_size = int(self._tok.vocab_size)
+        self.bos_id = int(self._tok.bos_token_id or 1)
+        self.eos_id = int(self._tok.eos_token_id or 2)
+        self.pad_id = int(
+            self._tok.pad_token_id if self._tok.pad_token_id is not None else 0
+        )
+
+    def encode(self, text: str) -> list[int]:
+        return list(self._tok.encode(text, add_special_tokens=False))
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return self._tok.decode(list(ids), skip_special_tokens=True)
+
+
+def load_tokenizer(path: str | Path | None) -> Tokenizer:
+    """HF tokenizer when a local directory with tokenizer assets exists,
+    byte-level fallback otherwise."""
+    if path:
+        p = Path(path)
+        if (p / "tokenizer.json").exists() or (p / "tokenizer.model").exists() or (
+            p / "tokenizer_config.json"
+        ).exists():
+            return HFTokenizer(p)
+    return ByteTokenizer()
