@@ -1,0 +1,128 @@
+"""Stage 2: requests.csv -> results.json.
+
+Behavioral parity with the reference analyzer (/root/reference/analyze.py:
+463-618): latency/TTFT percentiles + histograms, throughput, token timing,
+cold/warm attribution from pod startedAt (or explicit instants, or the
+runtime's start time), TPU utilization via the telemetry fallback chain,
+cache-hit ratio, io-probe merge — all merged key-granular into results.json.
+
+Degrades gracefully: with no cluster, no Prometheus, and no endpoint it still
+produces the full latency/throughput block from the CSV alone.
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Any, Optional
+
+from kserve_vllm_mini_tpu.analysis.coldwarm import (
+    classify_requests_cold_warm,
+    compute_cold_warm_metrics,
+)
+from kserve_vllm_mini_tpu.analysis.metrics import (
+    compute_latency_stats,
+    compute_token_timing,
+)
+from kserve_vllm_mini_tpu.analysis import telemetry
+from kserve_vllm_mini_tpu.core.rundir import RunDir, window_bounds
+
+
+def analyze_run(
+    run_dir: RunDir,
+    prom_url: Optional[str] = None,
+    endpoint: Optional[str] = None,
+    namespace: Optional[str] = None,
+    service: Optional[str] = None,
+    cold_start_times: Optional[list[float]] = None,
+    cold_window_s: float = 30.0,
+) -> dict[str, Any]:
+    records = run_dir.read_requests()
+    meta = run_dir.read_meta()
+
+    update: dict[str, Any] = {}
+    for key in ("model", "runtime", "pattern", "concurrency", "streaming", "accelerator"):
+        if key in meta:
+            update[key] = meta[key]
+    update["run_id"] = run_dir.path.name
+
+    update.update(compute_latency_stats(records))
+    update["token_timing"] = compute_token_timing(records)
+    for k in ("tpot_p50_ms", "tpot_p95_ms"):
+        if k in update["token_timing"]:
+            update[k] = update["token_timing"][k]
+
+    # cold/warm: explicit instants > cluster pod introspection > none
+    instants = list(cold_start_times or [])
+    if not instants and namespace and service:
+        from kserve_vllm_mini_tpu.analysis import kube
+
+        pods = kube.get_service_pods(namespace, service)
+        instants = kube.pod_started_times(pods)
+    if instants:
+        flags = classify_requests_cold_warm(records, instants, cold_window_s)
+        run_dir.write_classified(records, flags)
+        update.update(compute_cold_warm_metrics(records, flags))
+
+    t0, t1 = window_bounds(records)
+    update.update(
+        telemetry.collect_utilization(
+            prom_url, endpoint, window_s=max(t1 - t0, 1.0),
+            accelerator=meta.get("accelerator"),
+        )
+    )
+    update.update(telemetry.cache_hit_ratio(prom_url, endpoint))
+
+    io_probe = run_dir.read_io_probe()
+    for key in ("network_rtt_p50_ms", "network_rtt_p95_ms", "storage_fetch_mbps"):
+        if key in io_probe:
+            update[key] = io_probe[key]
+
+    chips = meta.get("chips") or meta.get("tpu_chips")
+    if chips and update.get("tokens_per_sec"):
+        update["tokens_per_sec_per_chip"] = update["tokens_per_sec"] / chips
+
+    return run_dir.merge_into_results(update)
+
+
+# -- CLI ---------------------------------------------------------------------
+
+def register(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument("--run-dir", required=True)
+    parser.add_argument("--prom-url", default=None, help="Prometheus base URL")
+    parser.add_argument("--endpoint", default=None,
+                        help="Runtime base URL for /metrics scrape fallback")
+    parser.add_argument("--namespace", default=None)
+    parser.add_argument("--service", default=None)
+    parser.add_argument("--cold-start-times", default=None,
+                        help="Comma-separated epoch seconds (overrides cluster lookup)")
+    parser.add_argument("--cold-window", type=float, default=30.0)
+
+
+def run(args: argparse.Namespace) -> int:
+    instants = None
+    if args.cold_start_times:
+        instants = [float(x) for x in args.cold_start_times.split(",") if x]
+    results = analyze_run(
+        RunDir(args.run_dir),
+        prom_url=args.prom_url,
+        endpoint=args.endpoint,
+        namespace=args.namespace,
+        service=args.service,
+        cold_start_times=instants,
+        cold_window_s=args.cold_window,
+    )
+    p95 = results.get("p95_ms")
+    ttft = results.get("ttft_p50_ms")
+    print(
+        f"analyze: {results.get('requests', 0)} requests, "
+        f"p95={p95:.1f}ms " if p95 is not None else "analyze: no successful requests ",
+        end="",
+    )
+    if ttft is not None:
+        print(f"ttft_p50={ttft:.1f}ms ", end="")
+    print(
+        f"rps={results.get('throughput_rps', 0):.2f} "
+        f"tok/s={results.get('tokens_per_sec', 0):.1f} "
+        f"err={results.get('error_rate', 0):.1%} -> {RunDir(args.run_dir).results_json}"
+    )
+    return 0

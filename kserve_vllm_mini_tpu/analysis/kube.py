@@ -1,0 +1,138 @@
+"""kubectl introspection with graceful degradation.
+
+Every cluster call is a subprocess (the reference's pattern, analyze.py:29-31)
+that returns empty results rather than raising when no cluster is reachable —
+the analyzer must work on a laptop against a bare run dir, exactly like the
+reference CI running with KUBECONFIG=/dev/null (SURVEY.md §4.2).
+"""
+
+from __future__ import annotations
+
+import json
+import shutil
+import subprocess
+from datetime import datetime, timezone
+from typing import Any, Optional
+
+KUBECTL_TIMEOUT_S = 15
+
+
+def kubectl_available() -> bool:
+    return shutil.which("kubectl") is not None
+
+
+def _run_kubectl(args: list[str]) -> Optional[dict[str, Any]]:
+    if not kubectl_available():
+        return None
+    try:
+        proc = subprocess.run(
+            ["kubectl", *args, "-o", "json"],
+            capture_output=True,
+            timeout=KUBECTL_TIMEOUT_S,
+            text=True,
+        )
+    except (subprocess.TimeoutExpired, OSError):
+        return None
+    if proc.returncode != 0:
+        return None
+    try:
+        return json.loads(proc.stdout)
+    except json.JSONDecodeError:
+        return None
+
+
+def get_service_pods(namespace: str, service: str) -> list[dict[str, Any]]:
+    """Pods belonging to an InferenceService (KServe label convention)."""
+    for selector in (
+        f"serving.kserve.io/inferenceservice={service}",
+        f"app={service}",
+    ):
+        data = _run_kubectl(["get", "pods", "-n", namespace, "-l", selector])
+        if data and data.get("items"):
+            return data["items"]
+    return []
+
+
+def parse_k8s_time(ts: str) -> Optional[float]:
+    try:
+        return datetime.fromisoformat(ts.replace("Z", "+00:00")).timestamp()
+    except (ValueError, AttributeError):
+        return None
+
+
+def pod_started_times(pods: list[dict[str, Any]]) -> list[float]:
+    """container startedAt epochs — the cold-start instants
+    (reference analyze.py:358-395)."""
+    out: list[float] = []
+    for pod in pods:
+        statuses = (pod.get("status") or {}).get("containerStatuses") or []
+        for cs in statuses:
+            started = ((cs.get("state") or {}).get("running") or {}).get("startedAt")
+            t = parse_k8s_time(started) if started else None
+            if t is not None:
+                out.append(t)
+    return out
+
+
+def pod_lifetimes(pods: list[dict[str, Any]]) -> list[tuple[float, Optional[float]]]:
+    """(start, end|None) epochs per pod for resource-second accounting."""
+    out = []
+    for pod in pods:
+        meta = pod.get("metadata") or {}
+        start = parse_k8s_time((pod.get("status") or {}).get("startTime", ""))
+        end = parse_k8s_time(meta.get("deletionTimestamp", "")) if meta.get(
+            "deletionTimestamp"
+        ) else None
+        if start is not None:
+            out.append((start, end))
+    return out
+
+
+def parse_k8s_quantity(q: str) -> float:
+    """K8s resource quantity -> float (cores or bytes). Mirrors the behavior
+    of reference cost_estimator.py:48-83."""
+    if not q:
+        return 0.0
+    q = str(q)
+    suffixes = {
+        "Ki": 1024.0, "Mi": 1024.0**2, "Gi": 1024.0**3, "Ti": 1024.0**4,
+        "k": 1e3, "M": 1e6, "G": 1e9, "T": 1e12,
+    }
+    for suf, mult in suffixes.items():
+        if q.endswith(suf):
+            return float(q[: -len(suf)]) * mult
+    if q.endswith("m"):
+        return float(q[:-1]) / 1000.0
+    try:
+        return float(q)
+    except ValueError:
+        return 0.0
+
+
+def pod_resources(pod: dict[str, Any]) -> dict[str, float]:
+    """Summed container requests/limits: tpu chips, cpu cores, memory bytes.
+
+    ``google.com/tpu`` replaces the reference's ``nvidia.com/gpu`` resource
+    key (SURVEY.md §7.2.5)."""
+    chips = cpu = mem = 0.0
+    for c in (pod.get("spec") or {}).get("containers", []):
+        res = c.get("resources") or {}
+        merged = {**(res.get("requests") or {}), **(res.get("limits") or {})}
+        chips += parse_k8s_quantity(merged.get("google.com/tpu", "0"))
+        cpu += parse_k8s_quantity(merged.get("cpu", "0"))
+        mem += parse_k8s_quantity(merged.get("memory", "0"))
+    return {"tpu_chips": chips, "cpu_cores": cpu, "memory_bytes": mem}
+
+
+def node_accelerator_of_pod(pod: dict[str, Any]) -> Optional[str]:
+    """gke-tpu-accelerator label of the pod's node (pricing key)."""
+    node_name = (pod.get("spec") or {}).get("nodeName")
+    if not node_name:
+        return None
+    data = _run_kubectl(["get", "node", node_name])
+    if not data:
+        return None
+    labels = (data.get("metadata") or {}).get("labels") or {}
+    return labels.get("cloud.google.com/gke-tpu-accelerator") or labels.get(
+        "cloud.google.com/gke-accelerator"
+    )

@@ -1,0 +1,172 @@
+"""TPU telemetry with fallback chains — the DCGM replacement.
+
+The reference queries Prometheus for DCGM GPU metrics with metric-name
+fallbacks (/root/reference/analyze.py:250-309, energy/collector.py:44-48)
+because metric names vary by stack. TPU stacks vary even more, so the same
+pattern applies over three sources, tried in order:
+
+1. **Prometheus** with GKE / tpu-device-plugin metric-name candidates
+   (``kubernetes_io:node_accelerator_tpu_duty_cycle`` et al)
+2. **The runtime's own /metrics endpoint** (kvmini_tpu_* gauges served by
+   runtime/server.py) — works with no cluster at all
+3. **Modeled values** (duty-cycle x TDP) — always available, marked
+   ``provenance: modeled`` per SURVEY.md §7.3.3
+
+All HTTP via urllib (no client dependency for the harness layers).
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.parse
+import urllib.request
+from typing import Any, Optional
+
+# metric-name fallback chains (query templates get .format(window_s=...))
+TPU_DUTY_CYCLE_QUERIES = [
+    "avg(kubernetes_io:node_accelerator_tpu_duty_cycle)",
+    "avg(tpu_duty_cycle)",
+    "avg(duty_cycle)",
+    "avg(kvmini_tpu_duty_cycle)",
+]
+TPU_HBM_QUERIES = [
+    "avg(kubernetes_io:node_accelerator_tpu_memory_used)",
+    "avg(tpu_memory_used_bytes)",
+    "avg(memory_used)",
+]
+TPU_POWER_QUERIES = [
+    "sum(kubernetes_io:node_accelerator_tpu_power_usage)",
+    "sum(tpu_power_usage_watts)",
+    "sum(tpu_power_watts)",
+]
+CPU_UTIL_QUERIES = [
+    'avg(rate(container_cpu_usage_seconds_total{{container!=""}}[{window_s}s]))',
+]
+CACHE_HIT_QUERIES = [
+    "sum(kvmini_tpu_cache_hits_total) / clamp_min(sum(kvmini_tpu_cache_lookups_total), 1)",
+    "sum(vllm:cache_query_hit) / clamp_min(sum(vllm:cache_query_total), 1)",
+]
+
+# Thermal design power per chip (watts) for modeled energy. Public figures:
+# v4 ~170W, v5e ~can be taken ~170W max / typical serving ~120W, v5p ~350W.
+TPU_TDP_WATTS = {
+    "v4": 170.0,
+    "v5e": 170.0,
+    "v5p": 350.0,
+    "v6e": 170.0,
+    "default": 170.0,
+}
+
+
+def tdp_for_accelerator(accelerator: Optional[str]) -> float:
+    if accelerator:
+        for key, w in TPU_TDP_WATTS.items():
+            if key != "default" and key in accelerator.lower():
+                return w
+    return TPU_TDP_WATTS["default"]
+
+
+def prom_instant_query(prom_url: str, query: str, timeout_s: float = 5.0) -> Optional[float]:
+    """Single instant query -> first scalar value, or None."""
+    url = prom_url.rstrip("/") + "/api/v1/query?" + urllib.parse.urlencode({"query": query})
+    try:
+        with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+            data = json.loads(resp.read())
+    except Exception:
+        return None
+    if data.get("status") != "success":
+        return None
+    results = data.get("data", {}).get("result", [])
+    if not results:
+        return None
+    try:
+        return float(results[0]["value"][1])
+    except (KeyError, IndexError, TypeError, ValueError):
+        return None
+
+
+def query_with_fallbacks(
+    prom_url: str, queries: list[str], window_s: float = 60.0
+) -> tuple[Optional[float], Optional[str]]:
+    """Try each query until one answers; returns (value, winning_query)."""
+    for q in queries:
+        v = prom_instant_query(prom_url, q.format(window_s=int(window_s)))
+        if v is not None:
+            return v, q
+    return None, None
+
+
+def scrape_runtime_metrics(endpoint: str, timeout_s: float = 5.0) -> dict[str, float]:
+    """Parse the runtime's Prometheus text exposition into a flat dict."""
+    url = endpoint.rstrip("/") + "/metrics"
+    out: dict[str, float] = {}
+    try:
+        with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+            text = resp.read().decode()
+    except Exception:
+        return out
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        parts = line.split()
+        if len(parts) >= 2:
+            name = parts[0].split("{")[0]
+            try:
+                out[name] = float(parts[-1])
+            except ValueError:
+                continue
+    return out
+
+
+def collect_utilization(
+    prom_url: Optional[str],
+    endpoint: Optional[str],
+    window_s: float,
+    accelerator: Optional[str] = None,
+) -> dict[str, Any]:
+    """The full fallback chain -> utilization block for results.json."""
+    out: dict[str, Any] = {}
+    if prom_url:
+        duty, q = query_with_fallbacks(prom_url, TPU_DUTY_CYCLE_QUERIES, window_s)
+        if duty is not None:
+            out["tpu_duty_cycle_avg"] = duty if duty <= 1.0 else duty / 100.0
+            out["tpu_metrics_source"] = f"prometheus:{q}"
+        hbm, _ = query_with_fallbacks(prom_url, TPU_HBM_QUERIES, window_s)
+        if hbm is not None:
+            out["tpu_hbm_used_avg_gib"] = hbm / (1024**3) if hbm > 1e6 else hbm
+        power, _ = query_with_fallbacks(prom_url, TPU_POWER_QUERIES, window_s)
+        if power is not None:
+            out["tpu_power_watts_avg"] = power
+            out["power_provenance"] = "measured"
+        cpu, _ = query_with_fallbacks(prom_url, CPU_UTIL_QUERIES, window_s)
+        if cpu is not None:
+            out["cpu_util_avg"] = cpu
+    if "tpu_duty_cycle_avg" not in out and endpoint:
+        m = scrape_runtime_metrics(endpoint)
+        if "kvmini_tpu_duty_cycle" in m:
+            out["tpu_duty_cycle_avg"] = m["kvmini_tpu_duty_cycle"]
+            out["tpu_metrics_source"] = "runtime:/metrics"
+    if "tpu_power_watts_avg" not in out and "tpu_duty_cycle_avg" in out:
+        # modeled: duty cycle x TDP (+ ~15% idle floor), marked as such
+        tdp = tdp_for_accelerator(accelerator)
+        duty = out["tpu_duty_cycle_avg"]
+        out["tpu_power_watts_avg"] = tdp * (0.15 + 0.85 * duty)
+        out["power_provenance"] = "modeled"
+    return out
+
+
+def cache_hit_ratio(prom_url: Optional[str], endpoint: Optional[str]) -> dict[str, Any]:
+    """Cache-hit chain: Prometheus counters -> runtime metrics -> absent
+    (the TTFT-inference probe fills this when nothing else can,
+    probes/cache_probe.py)."""
+    if prom_url:
+        v, _ = query_with_fallbacks(prom_url, CACHE_HIT_QUERIES)
+        if v is not None:
+            return {"cache_hit_ratio": v, "cache_hit_source": "metrics"}
+    if endpoint:
+        m = scrape_runtime_metrics(endpoint)
+        hits, total = m.get("kvmini_tpu_cache_hits_total"), m.get("kvmini_tpu_cache_lookups_total")
+        if hits is not None and total:
+            return {"cache_hit_ratio": hits / total, "cache_hit_source": "metrics"}
+    return {}

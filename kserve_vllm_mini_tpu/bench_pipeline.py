@@ -1,0 +1,258 @@
+"""``kvmini-tpu bench`` — the one-command pipeline (reference bench.sh).
+
+Stages (reference bench.sh:201-289): validate -> [serve in-process] ->
+load-test (+ concurrent power sampling) -> io probe -> analyze -> energy
+integrate -> cost -> [gate] — all in-process against a run directory, no
+bash heredocs. With ``--self-serve`` the in-repo runtime is started for the
+duration, so the full pipeline runs with no cluster at all (SURVEY.md §7.1b).
+"""
+
+from __future__ import annotations
+
+import argparse
+import threading
+import time
+from typing import Any, Optional
+
+import yaml
+
+from kserve_vllm_mini_tpu.core.rundir import RunDir
+from kserve_vllm_mini_tpu.core.validate import validate_profile
+
+
+def run_bench(
+    url: Optional[str],
+    profile: dict[str, Any],
+    run_dir: Optional[RunDir] = None,
+    self_serve: bool = False,
+    prom_url: Optional[str] = None,
+    namespace: Optional[str] = None,
+    service: Optional[str] = None,
+    cost_file: Optional[str] = None,
+    chips: Optional[float] = None,
+    slo_file: Optional[str] = None,
+    idle_tax: str = "none",
+) -> tuple[dict[str, Any], int]:
+    """Returns (results, exit_code)."""
+    from kserve_vllm_mini_tpu.analysis.analyzer import analyze_run
+    from kserve_vllm_mini_tpu.costs.estimator import estimate_cost
+    from kserve_vllm_mini_tpu.costs.pricing import load_pricing
+    from kserve_vllm_mini_tpu.energy.collector import collect_power, integrate_energy
+    from kserve_vllm_mini_tpu.loadgen.runner import LoadConfig, run_load
+
+    # Stage 0: validate
+    rep = validate_profile(profile)
+    for w in rep.warnings:
+        print(f"WARNING: {w}")
+    if not rep.ok:
+        for e in rep.errors:
+            print(f"ERROR: {e}")
+        return {}, 1
+
+    run_dir = run_dir or RunDir.create()
+    run_dir.path.mkdir(parents=True, exist_ok=True)
+    print(f"bench: run dir {run_dir.path}")
+
+    server_thread = None
+    engine = None
+    cold_start_instants: list[float] = []
+    if self_serve:
+        # start the in-repo runtime on a free port; its startup IS a cold start
+        import socket
+
+        from aiohttp import web
+
+        from kserve_vllm_mini_tpu.runtime.server import build_engine, make_app
+
+        sock = socket.socket()
+        sock.bind(("127.0.0.1", 0))
+        port = sock.getsockname()[1]
+        sock.close()
+        t_cold0 = time.time()
+        engine, tok, name = build_engine(
+            model=profile.get("model", "llama-tiny"),
+            checkpoint=profile.get("checkpoint"),
+            max_slots=int(profile.get("max_slots", 8)),
+            max_seq_len=int(profile.get("max_model_len", 1024)),
+            topology=profile.get("jax_topology"),
+        )
+        engine.start()
+        app = make_app(engine, tok, name)
+        runner = web.AppRunner(app)
+
+        import asyncio
+
+        loop = asyncio.new_event_loop()
+
+        def _serve():
+            asyncio.set_event_loop(loop)
+            loop.run_until_complete(runner.setup())
+            site = web.TCPSite(runner, "127.0.0.1", port)
+            loop.run_until_complete(site.start())
+            loop.run_forever()
+
+        server_thread = threading.Thread(target=_serve, daemon=True, name="bench-server")
+        server_thread.start()
+        url = f"http://127.0.0.1:{port}"
+        # wait ready
+        import urllib.request
+
+        for _ in range(300):
+            try:
+                urllib.request.urlopen(url + "/healthz", timeout=1)
+                break
+            except Exception:
+                time.sleep(0.2)
+        cold_start_instants = [time.time()]
+        print(f"bench: self-serve runtime up in {time.time() - t_cold0:.1f}s at {url}")
+
+    assert url, "either --url or --self-serve is required"
+
+    # Stage 1: load test with concurrent power sampling
+    stop_sampling = threading.Event()
+    sampler = threading.Thread(
+        target=collect_power,
+        args=(run_dir, prom_url, url),
+        kwargs={
+            "interval_s": 1.0,
+            "accelerator": profile.get("accelerator"),
+            "stop_check": stop_sampling.is_set,
+        },
+        daemon=True,
+        name="power-sampler",
+    )
+    sampler.start()
+
+    cfg = LoadConfig(
+        url=url,
+        model=profile.get("model", "default"),
+        backend=profile.get("backend", "openai"),
+        num_requests=int(profile.get("requests", 100)),
+        concurrency=int(profile.get("concurrency", 10)),
+        pattern=profile.get("pattern", "steady"),
+        target_rps=profile.get("target_rps"),
+        duration_s=profile.get("duration_s"),
+        streaming=bool(profile.get("streaming", True)),
+        max_tokens=int(profile.get("max_tokens", 64)),
+        temperature=float(profile.get("temperature", 0.0)),
+        prompt_set=profile.get("prompt_set", "default"),
+        input_tokens=int(profile.get("input_tokens", 0)),
+        seed=int(profile.get("seed", 42)),
+        extra_body=profile.get("extra_body", {}) or {},
+    )
+    records = run_load(cfg, run_dir)
+    stop_sampling.set()
+    sampler.join(timeout=5.0)
+    ok = sum(1 for r in records if r.ok)
+    print(f"bench: load complete {ok}/{len(records)} ok")
+
+    # annotate meta for downstream stages
+    meta = run_dir.read_meta()
+    meta.update(
+        {
+            "accelerator": profile.get("accelerator"),
+            "chips": chips or profile.get("chips", 1),
+            "runtime": "jax-native" if self_serve else profile.get("backend", "openai"),
+        }
+    )
+    run_dir.write_meta(meta)
+
+    # Stage 2: io probe (best-effort RTT against the endpoint)
+    try:
+        from kserve_vllm_mini_tpu.probes.net_storage import measure_http_rtt
+
+        run_dir.write_io_probe(measure_http_rtt(url))
+    except Exception:
+        pass
+
+    # Stage 3: analyze
+    results = analyze_run(
+        run_dir,
+        prom_url=prom_url,
+        endpoint=url,
+        namespace=namespace,
+        service=service,
+        cold_start_times=cold_start_instants or None,
+    )
+
+    # Stage 4: energy
+    integrate_energy(run_dir, idle_tax=idle_tax)
+
+    # Stage 5: cost
+    estimate_cost(
+        run_dir,
+        load_pricing(cost_file),
+        namespace=namespace,
+        service=service,
+        chips=chips or profile.get("chips"),
+        accelerator=profile.get("accelerator"),
+    )
+    results = run_dir.read_results()
+
+    code = 0
+    if slo_file:
+        from kserve_vllm_mini_tpu.gates.slo import gate_results, load_slo, print_table
+
+        verdicts = gate_results(results, load_slo(slo_file))
+        print_table(verdicts)
+        code = 0 if all(v.ok for v in verdicts) else 3
+
+    if engine is not None:
+        engine.stop()
+    p95 = results.get("p95_ms")
+    print(
+        f"bench: done p95={p95:.1f}ms " if p95 is not None else "bench: done ",
+        end="",
+    )
+    print(
+        f"rps={results.get('throughput_rps', 0):.2f} "
+        f"cost/1Ktok=${results.get('cost_per_1k_tokens', 0):.6f} "
+        f"-> {run_dir.results_json}"
+    )
+    return results, code
+
+
+# -- CLI ---------------------------------------------------------------------
+
+def register(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument("--url", default=None, help="Existing endpoint base URL")
+    parser.add_argument("--self-serve", action="store_true",
+                        help="Start the in-repo runtime for the bench")
+    parser.add_argument("--profile", default=None, help="Profile YAML")
+    parser.add_argument("--requests", type=int, default=None)
+    parser.add_argument("--concurrency", type=int, default=None)
+    parser.add_argument("--pattern", default=None)
+    parser.add_argument("--max-tokens", type=int, default=None)
+    parser.add_argument("--model", default=None)
+    parser.add_argument("--run-dir", default=None)
+    parser.add_argument("--prom-url", default=None)
+    parser.add_argument("--namespace", default=None)
+    parser.add_argument("--service", default=None)
+    parser.add_argument("--cost-file", default=None)
+    parser.add_argument("--chips", type=float, default=None)
+    parser.add_argument("--slo", default=None, help="SLO budgets JSON; exit 3 on violation")
+    parser.add_argument("--idle-tax", choices=["none", "series", "baseline"], default="none")
+
+
+def run(args: argparse.Namespace) -> int:
+    profile: dict[str, Any] = {}
+    if args.profile:
+        with open(args.profile) as f:
+            profile = yaml.safe_load(f) or {}
+    for key in ("requests", "concurrency", "pattern", "max_tokens", "model"):
+        v = getattr(args, key)
+        if v is not None:
+            profile[key] = v
+    _, code = run_bench(
+        url=args.url,
+        profile=profile,
+        run_dir=RunDir(args.run_dir) if args.run_dir else None,
+        self_serve=args.self_serve,
+        prom_url=args.prom_url,
+        namespace=args.namespace,
+        service=args.service,
+        cost_file=args.cost_file,
+        chips=args.chips,
+        slo_file=args.slo,
+    )
+    return code

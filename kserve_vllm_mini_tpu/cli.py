@@ -90,7 +90,19 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         print(f"kvmini-tpu: subcommand '{command}' has no runner yet", file=sys.stderr)
         return 2
     args = parser.parse_args(rest)
-    return int(run(args) or 0)
+    try:
+        return int(run(args) or 0)
+    except FileNotFoundError as e:
+        print(f"kvmini-tpu {command}: file not found: {e}", file=sys.stderr)
+        return 1
+    except BrokenPipeError:
+        # Output pipe (head/less) closed early. Exit 141 (128+SIGPIPE), never
+        # 0 — a truncated gate/canary verdict must not read as a pass.
+        try:
+            sys.stdout.close()
+        except Exception:
+            pass
+        return 141
 
 
 if __name__ == "__main__":

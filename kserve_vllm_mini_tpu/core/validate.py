@@ -1,0 +1,187 @@
+"""Pre-flight config validation — bench stage 0.
+
+TPU rebuild of the reference validator (/root/reference/scripts/
+validate_config.py:16-155): catch known-bad combinations *before* a
+20-minute deploy, with actionable messages. GPU-specific guards map to their
+TPU equivalents:
+
+- quantization compatibility: awq/gptq are CUDA-kernel formats -> error on
+  TPU; int8/aqt/fp8 pass (fp8 warns on v5e which lacks native fp8)
+- GPU-memory heuristic -> HBM-per-chip fit check from model size vs topology
+- nvidia-smi autodetect -> jax.devices() probe (injectable for tests, the
+  reference's fake-the-probe pattern, SURVEY.md §4.1)
+"""
+
+from __future__ import annotations
+
+import argparse
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+import yaml
+
+from kserve_vllm_mini_tpu.loadgen.arrivals import PATTERNS
+
+HBM_GIB_PER_CHIP = {"v4": 32.0, "v5e": 16.0, "v5p": 95.0, "v6e": 32.0}
+TPU_QUANT_OK = {"none", "bf16", "int8", "aqt-int8", "fp8"}
+GPU_ONLY_QUANT = {"awq", "gptq", "autoawq", "marlin", "squeezellm"}
+
+# rough parameter counts for HBM-fit estimates (bf16 bytes = 2/param + ~30%
+# for KV cache and activations at serving batch sizes)
+MODEL_SIZE_B = {"125m": 0.125, "1b": 1.5, "7b": 7.0, "8b": 8.0, "13b": 13.0,
+                "34b": 34.0, "70b": 70.0}
+
+
+@dataclass
+class ValidationReport:
+    errors: list[str] = field(default_factory=list)
+    warnings: list[str] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.errors
+
+
+def _model_size_hint(model: str) -> Optional[float]:
+    m = model.lower()
+    for hint, size in sorted(MODEL_SIZE_B.items(), key=lambda kv: -len(kv[0])):
+        if hint in m:
+            return size
+    return None
+
+
+def _chips_of_topology(topology: str) -> Optional[int]:
+    try:
+        return int(topology.rsplit("-", 1)[1])
+    except (IndexError, ValueError):
+        return None
+
+
+def _generation_of_topology(topology: str) -> str:
+    return topology.split("-")[0]
+
+
+def validate_profile(
+    profile: dict[str, Any],
+    detect_devices: Optional[Callable[[], int]] = None,
+) -> ValidationReport:
+    rep = ValidationReport()
+    pattern = profile.get("pattern", "steady")
+    if pattern not in PATTERNS:
+        rep.errors.append(
+            f"unknown traffic pattern {pattern!r}; choose one of {sorted(PATTERNS)}"
+        )
+    concurrency = int(profile.get("concurrency", 1) or 0)
+    if concurrency <= 0:
+        rep.errors.append("concurrency must be >= 1")
+    requests = int(profile.get("requests", 1) or 0)
+    if requests <= 0:
+        rep.errors.append("requests must be >= 1")
+
+    max_tokens = int(profile.get("max_tokens", 64))
+    max_model_len = int(profile.get("max_model_len", 4096))
+    if max_tokens >= max_model_len:
+        rep.errors.append(
+            f"max_tokens ({max_tokens}) >= max_model_len ({max_model_len}): "
+            "no room for the prompt — lower max_tokens or raise max_model_len"
+        )
+    elif max_tokens > 2048:
+        rep.warnings.append(
+            f"max_tokens={max_tokens} produces long decodes; p95 latency "
+            "will be dominated by generation length — consider streaming SLOs"
+        )
+
+    quant = str(profile.get("quantization", "none")).lower()
+    if quant in GPU_ONLY_QUANT:
+        rep.errors.append(
+            f"quantization '{quant}' requires CUDA kernels and cannot run on "
+            "TPU — use 'int8' (AQT) or 'fp8' (v5p/v6e) instead"
+        )
+    elif quant not in TPU_QUANT_OK:
+        rep.warnings.append(f"unrecognized quantization '{quant}'; proceeding unvalidated")
+
+    topology = profile.get("topology")
+    if topology:
+        gen = _generation_of_topology(topology)
+        chips = _chips_of_topology(topology)
+        if gen not in HBM_GIB_PER_CHIP:
+            rep.errors.append(
+                f"unknown TPU generation in topology {topology!r}; "
+                f"known: {sorted(HBM_GIB_PER_CHIP)}"
+            )
+        elif chips:
+            if quant == "fp8" and gen == "v5e":
+                rep.warnings.append(
+                    "fp8 on v5e lacks native hardware support; expect "
+                    "dequantize-to-bf16 performance"
+                )
+            size_b = _model_size_hint(str(profile.get("model", "")))
+            if size_b is not None:
+                bytes_per_param = 1.0 if quant in ("int8", "aqt-int8", "fp8") else 2.0
+                need_gib = size_b * bytes_per_param * 1.3
+                have_gib = HBM_GIB_PER_CHIP[gen] * chips
+                if need_gib > have_gib:
+                    rep.errors.append(
+                        f"model (~{size_b:.0f}B params, {quant}) needs "
+                        f"~{need_gib:.0f} GiB HBM but {topology} provides "
+                        f"{have_gib:.0f} GiB — use a larger slice "
+                        f"(e.g. {gen}-{chips * 2}) or quantize to int8"
+                    )
+                elif need_gib > 0.8 * have_gib:
+                    rep.warnings.append(
+                        f"model fits {topology} with <20% HBM headroom; "
+                        "KV cache pressure will cap batch size"
+                    )
+            if detect_devices is not None:
+                try:
+                    n = detect_devices()
+                except Exception:
+                    n = 0
+                if n and chips and n < chips:
+                    rep.errors.append(
+                        f"topology {topology} needs {chips} chips but only "
+                        f"{n} TPU device(s) are visible"
+                    )
+
+    spec = profile.get("speculative", {})
+    if spec and spec.get("enabled"):
+        if not spec.get("draft_model"):
+            rep.errors.append("speculative decoding enabled but no draft_model given")
+        k = int(spec.get("num_draft_tokens", 4))
+        if k > 16:
+            rep.warnings.append(
+                f"num_draft_tokens={k} is past the acceptance sweet spot; "
+                "draft overhead usually dominates above ~8"
+            )
+    return rep
+
+
+def jax_device_count() -> int:
+    import jax
+
+    return jax.device_count()
+
+
+# -- CLI ---------------------------------------------------------------------
+
+def register(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument("--profile", required=True, help="Profile YAML path")
+    parser.add_argument("--detect-devices", action="store_true",
+                        help="Also probe visible TPU devices via JAX")
+
+
+def run(args: argparse.Namespace) -> int:
+    with open(args.profile) as f:
+        profile = yaml.safe_load(f) or {}
+    rep = validate_profile(
+        profile, detect_devices=jax_device_count if args.detect_devices else None
+    )
+    for w in rep.warnings:
+        print(f"WARNING: {w}")
+    for e in rep.errors:
+        print(f"ERROR: {e}")
+    if rep.ok:
+        print(f"validate: OK ({len(rep.warnings)} warning(s))")
+        return 0
+    print(f"validate: FAILED with {len(rep.errors)} error(s)")
+    return 1

@@ -1,0 +1,150 @@
+"""Stage 3: cost attribution — resource-seconds x chip-hour pricing.
+
+Reference behavior (/root/reference/cost_estimator.py:343-501): for each pod
+serving the tested model, intersect its lifetime with the test window, sum
+(tpu-chip-seconds, cpu-core-seconds, memory-GiB-seconds), multiply by the
+price sheet, apply overhead, split cold/warm by request fraction, merge
+cost_* keys into results.json. (The reference's mem_gib_seconds=0.2 init bug,
+cost_estimator.py:353, is deliberately NOT replicated.)
+
+Clusterless mode: ``--chips N`` attributes N chips over the whole window —
+how the in-repo runtime benches on bare metal get costed.
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Any, Optional
+
+from kserve_vllm_mini_tpu.analysis import kube
+from kserve_vllm_mini_tpu.core.rundir import RunDir, window_bounds
+from kserve_vllm_mini_tpu.costs.pricing import Pricing, load_pricing
+
+
+def overlap_seconds(
+    a0: float, a1: float, b0: float, b1: Optional[float]
+) -> float:
+    """|[a0,a1] ∩ [b0,b1]| with b1=None meaning 'still running'."""
+    end = min(a1, b1) if b1 is not None else a1
+    return max(end - max(a0, b0), 0.0)
+
+
+def sum_resource_seconds(
+    pods: list[dict[str, Any]],
+    t0: float,
+    t1: float,
+) -> dict[str, float]:
+    totals = {"tpu_chip_seconds": 0.0, "cpu_core_seconds": 0.0, "mem_gib_seconds": 0.0}
+    for pod in pods:
+        res = kube.pod_resources(pod)
+        for start, end in kube.pod_lifetimes([pod]):
+            sec = overlap_seconds(t0, t1, start, end)
+            totals["tpu_chip_seconds"] += res["tpu_chips"] * sec
+            totals["cpu_core_seconds"] += res["cpu_cores"] * sec
+            totals["mem_gib_seconds"] += res["memory_bytes"] / (1024**3) * sec
+    return totals
+
+
+def estimate_cost(
+    run_dir: RunDir,
+    pricing: Pricing,
+    namespace: Optional[str] = None,
+    service: Optional[str] = None,
+    chips: Optional[float] = None,
+    accelerator: Optional[str] = None,
+    region: Optional[str] = None,
+    cpu_cores: float = 0.0,
+    memory_gib: float = 0.0,
+    merge: bool = True,
+) -> dict[str, Any]:
+    records = run_dir.read_requests()
+    meta = run_dir.read_meta()
+    t0, t1 = window_bounds(records)
+    duration = max(t1 - t0, 0.0)
+    accelerator = accelerator or meta.get("accelerator")
+
+    pods: list[dict[str, Any]] = []
+    if namespace and service:
+        pods = kube.get_service_pods(namespace, service)
+    if pods:
+        totals = sum_resource_seconds(pods, t0, t1)
+        if accelerator is None:
+            accelerator = kube.node_accelerator_of_pod(pods[0])
+        source = "cluster"
+    else:
+        n_chips = chips if chips is not None else meta.get("chips", 1)
+        totals = {
+            "tpu_chip_seconds": float(n_chips) * duration,
+            "cpu_core_seconds": cpu_cores * duration,
+            "mem_gib_seconds": memory_gib * duration,
+        }
+        source = "declared"
+
+    chip_price, price_key = pricing.chip_price(accelerator)
+    mult = pricing.region_multiplier(region)
+    breakdown = {
+        "tpu": totals["tpu_chip_seconds"] / 3600.0 * chip_price * mult,
+        "cpu": totals["cpu_core_seconds"] / 3600.0 * pricing.cpu_core_hourly * mult,
+        "memory": totals["mem_gib_seconds"] / 3600.0 * pricing.memory_gib_hourly * mult,
+    }
+    subtotal = sum(breakdown.values())
+    breakdown["overhead"] = subtotal * pricing.overhead_factor
+    total = subtotal + breakdown["overhead"]
+
+    ok = [r for r in records if r.ok]
+    tokens_out = sum(r.tokens_out for r in ok)
+    update: dict[str, Any] = {
+        "cost_total": total,
+        "cost_breakdown": {k: round(v, 6) for k, v in breakdown.items()},
+        "cost_source": source,
+        "cost_price_key": price_key,
+        "cost_chip_hourly": chip_price,
+    }
+    if ok:
+        update["cost_per_request"] = total / len(ok)
+    if tokens_out:
+        update["cost_per_1k_tokens"] = total * 1000.0 / tokens_out
+
+    # cold/warm split by request-count fraction (reference :289-340)
+    cold_flags = run_dir.read_cold_flags()
+    if cold_flags and len(cold_flags) == len(records):
+        n_cold = sum(cold_flags)
+        frac = n_cold / len(records)
+        update["cold_cost_total"] = total * frac
+        update["warm_cost_total"] = total * (1.0 - frac)
+
+    if merge:
+        run_dir.merge_into_results(update)
+    return update
+
+
+# -- CLI ---------------------------------------------------------------------
+
+def register(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument("--run-dir", required=True)
+    parser.add_argument("--cost-file", default=None, help="Pricing YAML (default: tpu-cost.yaml)")
+    parser.add_argument("--namespace", default=None)
+    parser.add_argument("--service", default=None)
+    parser.add_argument("--chips", type=float, default=None,
+                        help="Clusterless: chips used for the whole window")
+    parser.add_argument("--cpu-cores", type=float, default=0.0)
+    parser.add_argument("--memory-gib", type=float, default=0.0)
+    parser.add_argument("--accelerator", default=None)
+    parser.add_argument("--region", default=None)
+
+
+def run(args: argparse.Namespace) -> int:
+    pricing = load_pricing(args.cost_file)
+    update = estimate_cost(
+        RunDir(args.run_dir), pricing,
+        namespace=args.namespace, service=args.service,
+        chips=args.chips, accelerator=args.accelerator, region=args.region,
+        cpu_cores=args.cpu_cores, memory_gib=args.memory_gib,
+    )
+    print(
+        f"cost: total=${update['cost_total']:.6f} "
+        f"(${update.get('cost_per_1k_tokens', 0):.6f}/1K tok, "
+        f"source={update['cost_source']}, chip=${update['cost_chip_hourly']}/h "
+        f"[{update['cost_price_key']}])"
+    )
+    return 0

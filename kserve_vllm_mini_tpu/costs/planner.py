@@ -1,0 +1,238 @@
+"""Capacity planner: "how many TPU chips for N RPS under an SLO, at what
+monthly cost?"
+
+TPU-native rebuild of the reference planner (/root/reference/planner.py:
+17-413): hardcoded per-accelerator baselines (here tokens/sec/chip, not
+RPS/GPU), optional calibration from a sweep CSV or a measured results.json,
+cold-start/burst headroom multipliers, warm-pool sizing, region-multiplied
+monthly costs, ranked recommendations, and a markdown report.
+
+Cold-start defaults are TPU-pool realities: node provisioning + weight
+loading is minutes, not the 45 s GPU assumption baked into the reference
+(planner.py:428; SURVEY.md §7.3.4).
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Optional
+
+from kserve_vllm_mini_tpu.costs.pricing import Pricing, load_pricing
+
+# (accelerator, model-size bucket) -> steady-state decode tokens/sec/chip.
+# The v5e llama-1b figure is measured by this repo's bench.py on real
+# hardware; others are scaled by bandwidth/model-size ratios and should be
+# recalibrated from sweep CSVs as they land.
+BASELINE_TOKENS_PER_SEC_PER_CHIP: dict[tuple[str, str], float] = {
+    ("v5e", "1b"): 1000.0,
+    ("v5e", "8b"): 300.0,
+    ("v5e", "70b"): 35.0,
+    ("v5p", "1b"): 2800.0,
+    ("v5p", "8b"): 850.0,
+    ("v5p", "70b"): 100.0,
+    ("v6e", "8b"): 550.0,
+}
+
+HOURS_PER_MONTH = 730.0
+
+# TPU pools take minutes to provision + load weights (SURVEY.md §7.3.4)
+DEFAULT_COLD_START_S = 300.0
+DEFAULT_COLD_FREQUENCY = 0.05
+
+
+@dataclass
+class PlanInput:
+    target_rps: float
+    p95_budget_ms: float = 1200.0
+    avg_output_tokens: float = 128.0
+    model_size: str = "8b"
+    accelerators: list[str] = field(default_factory=lambda: ["v5e", "v5p"])
+    region: Optional[str] = None
+    burst_headroom: float = 1.3
+    cold_start_s: float = DEFAULT_COLD_START_S
+    cold_frequency: float = DEFAULT_COLD_FREQUENCY
+    calibrated: dict[str, float] = field(default_factory=dict)  # accel -> tok/s/chip
+
+
+@dataclass
+class PlanOption:
+    accelerator: str
+    chips: int
+    warm_pool_chips: int
+    tokens_per_sec_per_chip: float
+    expected_rps_capacity: float
+    utilization_at_target: float
+    monthly_cost_usd: float
+    warm_pool_monthly_usd: float
+    meets_p95: bool
+    notes: list[str] = field(default_factory=list)
+
+    @property
+    def total_monthly_usd(self) -> float:
+        return self.monthly_cost_usd + self.warm_pool_monthly_usd
+
+
+def baseline_for(accel: str, model_size: str, calibrated: dict[str, float]) -> Optional[float]:
+    if accel in calibrated:
+        return calibrated[accel]
+    return BASELINE_TOKENS_PER_SEC_PER_CHIP.get((accel, model_size))
+
+
+def plan(inputs: PlanInput, pricing: Pricing) -> list[PlanOption]:
+    options: list[PlanOption] = []
+    required_tokens_per_sec = inputs.target_rps * inputs.avg_output_tokens
+    for accel in inputs.accelerators:
+        tps_chip = baseline_for(accel, inputs.model_size, inputs.calibrated)
+        if tps_chip is None:
+            continue
+        needed = required_tokens_per_sec * inputs.burst_headroom / tps_chip
+        chips = max(int(needed) + (1 if needed % 1 else 0), 1)
+        capacity_rps = chips * tps_chip / inputs.avg_output_tokens
+        util = inputs.target_rps / capacity_rps if capacity_rps else 1.0
+
+        # warm pool sized to absorb cold-frequency of traffic while a new
+        # slice provisions (reference planner.py:173-202, recalibrated)
+        warm_rps = inputs.target_rps * inputs.cold_frequency
+        warm_chips = max(
+            int(warm_rps * inputs.avg_output_tokens / tps_chip + 0.999), 1
+        ) if inputs.cold_frequency > 0 else 0
+
+        price, _ = pricing.chip_price(accel)
+        mult = pricing.region_multiplier(inputs.region)
+        monthly = chips * price * HOURS_PER_MONTH * mult
+        warm_monthly = warm_chips * price * HOURS_PER_MONTH * mult
+
+        # p95 heuristic: per-token latency must fit the budget for the mean
+        # response; decode dominated by tokens/sec/chip at full batching
+        per_req_ms = inputs.avg_output_tokens / tps_chip * 1000.0 * 1.5
+        meets = per_req_ms <= inputs.p95_budget_ms
+        notes = []
+        if not meets:
+            notes.append(
+                f"estimated per-request decode {per_req_ms:.0f}ms exceeds "
+                f"p95 budget {inputs.p95_budget_ms:.0f}ms — consider a faster "
+                "accelerator or smaller model"
+            )
+        if util > 0.85:
+            notes.append("utilization at target >85%; little burst headroom")
+        options.append(
+            PlanOption(
+                accelerator=accel,
+                chips=chips,
+                warm_pool_chips=warm_chips,
+                tokens_per_sec_per_chip=tps_chip,
+                expected_rps_capacity=capacity_rps,
+                utilization_at_target=util,
+                monthly_cost_usd=monthly,
+                warm_pool_monthly_usd=warm_monthly,
+                meets_p95=meets,
+                notes=notes,
+            )
+        )
+    # ranked: SLO-meeting options first, then by total cost
+    return sorted(options, key=lambda o: (not o.meets_p95, o.total_monthly_usd))
+
+
+def calibrate_from_sweep_csv(path: str | Path) -> dict[str, float]:
+    """accel -> max observed tokens/sec/chip from a sweep CSV with
+    `accelerator` and `tokens_per_sec_per_chip` (or tokens_per_sec + chips)
+    columns (reference planner.py:246-271)."""
+    out: dict[str, float] = {}
+    with Path(path).open(newline="") as f:
+        for row in csv.DictReader(f):
+            accel = (row.get("accelerator") or "").strip()
+            if not accel:
+                continue
+            v = row.get("tokens_per_sec_per_chip")
+            if not v and row.get("tokens_per_sec") and row.get("chips"):
+                try:
+                    v = float(row["tokens_per_sec"]) / float(row["chips"])
+                except (ValueError, ZeroDivisionError):
+                    v = None
+            try:
+                val = float(v)
+            except (TypeError, ValueError):
+                continue
+            key = accel.lower()
+            for frag in ("v5e", "v5p", "v4", "v6e"):
+                if frag in key:
+                    key = frag
+                    break
+            out[key] = max(out.get(key, 0.0), val)
+    return out
+
+
+def markdown_report(inputs: PlanInput, options: list[PlanOption]) -> str:
+    lines = [
+        "# TPU capacity plan",
+        "",
+        f"- target: **{inputs.target_rps:.1f} RPS** at p95 <= {inputs.p95_budget_ms:.0f} ms",
+        f"- model size: {inputs.model_size}, ~{inputs.avg_output_tokens:.0f} output tokens/request",
+        f"- burst headroom x{inputs.burst_headroom}, cold start {inputs.cold_start_s:.0f}s "
+        f"@ {inputs.cold_frequency:.0%} frequency",
+        "",
+        "| rank | accel | chips | warm pool | tok/s/chip | capacity RPS | util | $/month | meets p95 |",
+        "|---|---|---|---|---|---|---|---|---|",
+    ]
+    for i, o in enumerate(options, 1):
+        lines.append(
+            f"| {i} | {o.accelerator} | {o.chips} | {o.warm_pool_chips} | "
+            f"{o.tokens_per_sec_per_chip:.0f} | {o.expected_rps_capacity:.1f} | "
+            f"{o.utilization_at_target:.0%} | ${o.total_monthly_usd:,.0f} | "
+            f"{'yes' if o.meets_p95 else 'NO'} |"
+        )
+    for o in options:
+        for n in o.notes:
+            lines.append(f"- **{o.accelerator}**: {n}")
+    return "\n".join(lines) + "\n"
+
+
+# -- CLI ---------------------------------------------------------------------
+
+def register(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument("--target-rps", type=float, required=True)
+    parser.add_argument("--p95-budget", type=float, default=1200.0, help="ms")
+    parser.add_argument("--avg-output-tokens", type=float, default=128.0)
+    parser.add_argument("--model-size", default="8b", choices=["1b", "8b", "70b"])
+    parser.add_argument("--accelerators", default="v5e,v5p")
+    parser.add_argument("--region", default=None)
+    parser.add_argument("--burst-headroom", type=float, default=1.3)
+    parser.add_argument("--cold-start-s", type=float, default=DEFAULT_COLD_START_S)
+    parser.add_argument("--cold-frequency", type=float, default=DEFAULT_COLD_FREQUENCY)
+    parser.add_argument("--calibrate-csv", default=None,
+                        help="Sweep CSV to calibrate tokens/sec/chip from")
+    parser.add_argument("--cost-file", default=None)
+    parser.add_argument("--output", default=None, help="Write markdown report here")
+    parser.add_argument("--json", action="store_true", dest="as_json")
+
+
+def run(args: argparse.Namespace) -> int:
+    calibrated = calibrate_from_sweep_csv(args.calibrate_csv) if args.calibrate_csv else {}
+    inputs = PlanInput(
+        target_rps=args.target_rps,
+        p95_budget_ms=args.p95_budget,
+        avg_output_tokens=args.avg_output_tokens,
+        model_size=args.model_size,
+        accelerators=[a.strip() for a in args.accelerators.split(",") if a.strip()],
+        region=args.region,
+        burst_headroom=args.burst_headroom,
+        cold_start_s=args.cold_start_s,
+        cold_frequency=args.cold_frequency,
+        calibrated=calibrated,
+    )
+    options = plan(inputs, load_pricing(args.cost_file))
+    if not options:
+        print("plan: no baseline for the requested accelerator/model combination")
+        return 1
+    if args.as_json:
+        print(json.dumps([o.__dict__ for o in options], indent=2, default=str))
+    else:
+        report = markdown_report(inputs, options)
+        print(report)
+        if args.output:
+            Path(args.output).write_text(report)
+    return 0

@@ -1,0 +1,63 @@
+"""TPU pricing sheet loading + accelerator price matching.
+
+tpu-cost.yaml replaces the reference's GPU cost.yaml; chip-hour prices are
+keyed by accelerator-label fragments and matched fuzzily the way the
+reference picks GPU prices from node labels
+(/root/reference/cost_estimator.py:201-213).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Optional
+
+import yaml
+
+DEFAULT_SHEET = Path(__file__).resolve().parents[2] / "tpu-cost.yaml"
+
+
+@dataclass
+class Pricing:
+    tpu_chip_hourly: dict[str, float] = field(default_factory=dict)
+    cpu_core_hourly: float = 0.031
+    memory_gib_hourly: float = 0.0042
+    overhead_factor: float = 0.15
+    region_multipliers: dict[str, float] = field(default_factory=dict)
+    grid_usd_per_kwh: float = 0.12
+
+    def chip_price(self, accelerator: Optional[str]) -> tuple[float, str]:
+        """Fuzzy match an accelerator label (e.g. 'tpu-v5-lite-podslice',
+        'v5e-8') to a chip-hour price; falls back to 'default'."""
+        if accelerator:
+            label = accelerator.lower().replace("-", "").replace("_", "")
+            for key, price in self.tpu_chip_hourly.items():
+                if key == "default":
+                    continue
+                if key.lower().replace("-", "") in label:
+                    return price, key
+        return self.tpu_chip_hourly.get("default", 1.50), "default"
+
+    def region_multiplier(self, region: Optional[str]) -> float:
+        if region and region in self.region_multipliers:
+            return self.region_multipliers[region]
+        return 1.0
+
+
+def load_pricing(path: str | Path | None = None) -> Pricing:
+    p = Path(path) if path else DEFAULT_SHEET
+    with p.open() as f:
+        raw: dict[str, Any] = yaml.safe_load(f) or {}
+    host = raw.get("host") or {}
+    calc = raw.get("calculation") or {}
+    energy = raw.get("energy") or {}
+    return Pricing(
+        tpu_chip_hourly={k: float(v) for k, v in (raw.get("tpu_chip_hourly") or {}).items()},
+        cpu_core_hourly=float(host.get("cpu_core_hourly", 0.031)),
+        memory_gib_hourly=float(host.get("memory_gib_hourly", 0.0042)),
+        overhead_factor=float(calc.get("overhead_factor", 0.15)),
+        region_multipliers={
+            k: float(v) for k, v in (calc.get("region_multipliers") or {}).items()
+        },
+        grid_usd_per_kwh=float(energy.get("grid_usd_per_kwh", 0.12)),
+    )

@@ -1,0 +1,199 @@
+"""Energy collection + integration: Wh, Wh/request, Wh/1K tokens.
+
+The math is the reference's, verbatim in behavior (trapezoidal integration
+over 1 s power samples, idle-tax ``series``/``baseline`` modes —
+/root/reference/energy/collector.py:133-149, 254-381); the *source* chain is
+TPU-native:
+
+1. Prometheus TPU power metrics (measured)
+2. runtime /metrics duty cycle x TDP (modeled)
+3. flat TDP x duty assumption (modeled, worst case)
+
+``energy.json`` always records ``provenance`` so modeled numbers are never
+mistaken for measured ones (SURVEY.md §7.3.3). Two subcommands mirror the
+reference CLI: ``collect`` (sampling daemon) and ``integrate`` (post-run).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+from typing import Any, Optional
+
+from kserve_vllm_mini_tpu.analysis import telemetry
+from kserve_vllm_mini_tpu.core.rundir import RunDir, window_bounds
+
+
+def sample_power_once(
+    prom_url: Optional[str],
+    endpoint: Optional[str],
+    accelerator: Optional[str] = None,
+) -> tuple[Optional[float], str]:
+    """One instantaneous total-power sample -> (watts, provenance)."""
+    if prom_url:
+        v, _ = telemetry.query_with_fallbacks(prom_url, telemetry.TPU_POWER_QUERIES)
+        if v is not None:
+            return v, "measured"
+    if endpoint:
+        m = telemetry.scrape_runtime_metrics(endpoint)
+        duty = m.get("kvmini_tpu_duty_cycle")
+        if duty is not None:
+            tdp = telemetry.tdp_for_accelerator(accelerator)
+            return tdp * (0.15 + 0.85 * duty), "modeled"
+    return None, "unavailable"
+
+
+def collect_power(
+    run_dir: RunDir,
+    prom_url: Optional[str],
+    endpoint: Optional[str],
+    interval_s: float = 1.0,
+    duration_s: Optional[float] = None,
+    accelerator: Optional[str] = None,
+    stop_check=None,
+) -> dict[str, Any]:
+    """Sampling loop -> power.json. Runs until duration elapses or
+    ``stop_check()`` returns True."""
+    samples: list[dict[str, float]] = []
+    provenance = "unavailable"
+    t_start = time.time()
+    while True:
+        now = time.time()
+        if duration_s is not None and now - t_start >= duration_s:
+            break
+        if stop_check is not None and stop_check():
+            break
+        watts, prov = sample_power_once(prom_url, endpoint, accelerator)
+        if watts is not None:
+            samples.append({"t": now, "watts": watts})
+            provenance = prov
+        time.sleep(max(interval_s - (time.time() - now), 0.0))
+    doc = {
+        "samples": samples,
+        "provenance": provenance,
+        "interval_s": interval_s,
+        "started_at": t_start,
+        "finished_at": time.time(),
+    }
+    run_dir.write_power(doc)
+    return doc
+
+
+def trapezoidal_wh(samples: list[dict[str, float]], t0: float, t1: float) -> float:
+    """Integrate watts over [t0, t1] (seconds) -> watt-hours.
+
+    Samples outside the window are clipped; gaps integrate linearly between
+    neighbors (reference collector.py:133-149)."""
+    pts = sorted((s["t"], s["watts"]) for s in samples)
+    pts = [(t, w) for t, w in pts if t0 - 60 <= t <= t1 + 60]
+    if len(pts) < 2 or t1 <= t0:
+        return 0.0
+    total_ws = 0.0
+    for (ta, wa), (tb, wb) in zip(pts, pts[1:]):
+        a, b = max(ta, t0), min(tb, t1)
+        if b <= a or tb == ta:
+            continue
+        # linear interp of watts at the clipped endpoints
+        w_a = wa + (wb - wa) * (a - ta) / (tb - ta)
+        w_b = wa + (wb - wa) * (b - ta) / (tb - ta)
+        total_ws += 0.5 * (w_a + w_b) * (b - a)
+    return total_ws / 3600.0
+
+
+def integrate_energy(
+    run_dir: RunDir,
+    idle_tax: str = "none",            # none | series | baseline
+    idle_baseline_watts: float = 0.0,
+    merge: bool = True,
+) -> dict[str, Any]:
+    """power.json + requests.csv -> energy.json (+ merge into results.json).
+
+    Idle-tax modes (reference collector.py:307-347):
+    - ``series``: subtract the lowest-decile sample power (measured idle) from
+      every sample before integrating — attributes only marginal energy.
+    - ``baseline``: subtract an explicit idle wattage.
+    - ``none``: full draw attributed to the run.
+    """
+    power = run_dir.read_power()
+    samples = power.get("samples", [])
+    records = run_dir.read_requests()
+    t0, t1 = window_bounds(records)
+
+    raw_wh = trapezoidal_wh(samples, t0, t1)
+    idle_w = 0.0
+    if idle_tax == "series" and samples:
+        watts_sorted = sorted(s["watts"] for s in samples)
+        decile = watts_sorted[: max(len(watts_sorted) // 10, 1)]
+        idle_w = sum(decile) / len(decile)
+    elif idle_tax == "baseline":
+        idle_w = idle_baseline_watts
+    active_wh = max(raw_wh - idle_w * (t1 - t0) / 3600.0, 0.0)
+
+    ok = [r for r in records if r.ok]
+    tokens_out = sum(r.tokens_out for r in ok)
+    doc: dict[str, Any] = {
+        "window": {"start": t0, "end": t1, "duration_s": t1 - t0},
+        "energy_wh": active_wh,
+        "energy_wh_raw": raw_wh,
+        "idle_tax_mode": idle_tax,
+        "idle_watts": idle_w,
+        "samples": len(samples),
+        "provenance": power.get("provenance", "unavailable"),
+    }
+    if ok:
+        doc["energy_wh_per_request"] = active_wh / len(ok)
+    if tokens_out:
+        doc["energy_wh_per_1k_tokens"] = active_wh * 1000.0 / tokens_out
+    run_dir.write_energy(doc)
+    if merge and samples:
+        run_dir.merge_into_results(
+            {
+                "energy_wh": doc["energy_wh"],
+                "energy_wh_per_request": doc.get("energy_wh_per_request"),
+                "energy_wh_per_1k_tokens": doc.get("energy_wh_per_1k_tokens"),
+                "power_provenance": doc["provenance"],
+            }
+        )
+    return doc
+
+
+# -- CLI ---------------------------------------------------------------------
+
+def register(parser: argparse.ArgumentParser) -> None:
+    sub = parser.add_subparsers(dest="mode", required=True)
+    c = sub.add_parser("collect", help="Sample chip power into power.json")
+    c.add_argument("--run-dir", required=True)
+    c.add_argument("--prom-url", default=None)
+    c.add_argument("--endpoint", default=None)
+    c.add_argument("--interval", type=float, default=1.0)
+    c.add_argument("--duration", type=float, default=None)
+    c.add_argument("--accelerator", default=None)
+    i = sub.add_parser("integrate", help="power.json -> energy.json")
+    i.add_argument("--run-dir", required=True)
+    i.add_argument("--idle-tax", choices=["none", "series", "baseline"], default="none")
+    i.add_argument("--idle-watts", type=float, default=0.0)
+    i.add_argument("--no-merge", action="store_true")
+
+
+def run(args: argparse.Namespace) -> int:
+    rd = RunDir(args.run_dir)
+    if args.mode == "collect":
+        doc = collect_power(
+            rd, args.prom_url, args.endpoint,
+            interval_s=args.interval, duration_s=args.duration,
+            accelerator=args.accelerator,
+        )
+        print(f"energy collect: {len(doc['samples'])} samples "
+              f"({doc['provenance']}) -> {rd.power_json}")
+        return 0
+    doc = integrate_energy(
+        rd, idle_tax=args.idle_tax, idle_baseline_watts=args.idle_watts,
+        merge=not args.no_merge,
+    )
+    print(
+        f"energy integrate: {doc['energy_wh']:.4f} Wh "
+        f"({doc.get('energy_wh_per_1k_tokens', 0):.3f} Wh/1K tok, "
+        f"{doc['provenance']}) -> {rd.energy_json}"
+    )
+    return 0

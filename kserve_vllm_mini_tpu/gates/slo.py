@@ -1,0 +1,107 @@
+"""SLO gate: CI-friendly pass/fail of results.json against budgets.
+
+Reference behavior (/root/reference/tools/gate.py:26-153): each budget key
+checks one results key against a threshold; missing metrics FAIL (absence of
+data must not pass a gate — see analysis/metrics.py on NaN); prints a table;
+exit 3 on any violation. Budget file is slo.json.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Any, Optional
+
+DEFAULT_SLO_PATH = Path(__file__).resolve().parents[2] / "slo.json"
+
+# budget key -> (results key, direction). "max": value must be <= budget.
+BUDGET_RULES: dict[str, tuple[str, str]] = {
+    "p95_ms_max": ("p95_ms", "max"),
+    "p99_ms_max": ("p99_ms", "max"),
+    "ttft_p95_ms_max": ("ttft_p95_ms", "max"),
+    "error_rate_max": ("error_rate", "max"),
+    "cost_per_1k_tokens_max": ("cost_per_1k_tokens", "max"),
+    "cold_multiplier_max": ("cold_multiplier", "max"),
+    "energy_wh_per_1k_tokens_max": ("energy_wh_per_1k_tokens", "max"),
+    "throughput_rps_min": ("throughput_rps", "min"),
+    "tokens_per_sec_min": ("tokens_per_sec", "min"),
+    "cache_hit_ratio_min": ("cache_hit_ratio", "min"),
+    # fairness budgets (reference gate.py:97-128), fed by compare/fairness.py
+    "fairness_p95_ratio_max": ("fairness_p95_ratio", "max"),
+    "fairness_throughput_share_min": ("fairness_throughput_share_min_tenant", "min"),
+}
+
+
+@dataclass
+class Verdict:
+    budget_key: str
+    metric: str
+    budget: float
+    value: Optional[float]
+    ok: bool
+    note: str = ""
+
+
+def load_slo(path: str | Path | None = None) -> dict[str, float]:
+    p = Path(path) if path else DEFAULT_SLO_PATH
+    with p.open() as f:
+        return {k: float(v) for k, v in json.load(f).items()}
+
+
+def gate_results(results: dict[str, Any], budgets: dict[str, float]) -> list[Verdict]:
+    verdicts: list[Verdict] = []
+    for key, budget in budgets.items():
+        rule = BUDGET_RULES.get(key)
+        if rule is None:
+            verdicts.append(
+                Verdict(key, "?", budget, None, False, "unknown budget key")
+            )
+            continue
+        metric, direction = rule
+        value = results.get(metric)
+        if value is None:
+            verdicts.append(
+                Verdict(key, metric, budget, None, False, "metric missing from results")
+            )
+            continue
+        value = float(value)
+        ok = value <= budget if direction == "max" else value >= budget
+        verdicts.append(Verdict(key, metric, budget, value, ok))
+    return verdicts
+
+
+def print_table(verdicts: list[Verdict]) -> None:
+    print(f"{'budget':<32} {'metric':<28} {'limit':>12} {'value':>12}  verdict")
+    for v in verdicts:
+        val = f"{v.value:.4f}" if v.value is not None else "—"
+        status = "PASS" if v.ok else f"FAIL{' (' + v.note + ')' if v.note else ''}"
+        print(f"{v.budget_key:<32} {v.metric:<28} {v.budget:>12.4f} {val:>12}  {status}")
+
+
+# -- CLI ---------------------------------------------------------------------
+
+def register(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument("--results", required=True, help="results.json path")
+    parser.add_argument("--slo", default=None, help="Budgets JSON (default: repo slo.json)")
+    parser.add_argument("--energy", default=None, help="Optional energy.json to fold in")
+    parser.add_argument("--fairness", default=None,
+                        help="Optional fairness_summary.json to fold in")
+
+
+def run(args: argparse.Namespace) -> int:
+    with open(args.results) as f:
+        results = json.load(f)
+    for extra in (args.energy, args.fairness):
+        if extra:
+            with open(extra) as f:
+                results.update(json.load(f))
+    verdicts = gate_results(results, load_slo(args.slo))
+    print_table(verdicts)
+    failed = [v for v in verdicts if not v.ok]
+    if failed:
+        print(f"gate: FAILED {len(failed)}/{len(verdicts)} budget(s)")
+        return 3
+    print(f"gate: PASSED all {len(verdicts)} budget(s)")
+    return 0

@@ -1,0 +1,89 @@
+"""Network/storage IO probe — feeds the report's headroom classifier.
+
+Reference behavior (/root/reference/tools/net_storage_probe.py:16-77):
+endpoint RTT p50/p95 from repeated small requests, plus model-object fetch
+throughput (MB/s) from a storage URL. GCS paths replace s3://; plain HTTP(S)
+fetches are measured directly.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+import urllib.request
+from typing import Any, Optional
+
+from kserve_vllm_mini_tpu.analysis.metrics import percentile
+
+
+def measure_http_rtt(
+    url: str, samples: int = 20, timeout_s: float = 5.0, path: str = "/healthz"
+) -> dict[str, Any]:
+    """p50/p95 RTT (ms) of small GETs against the endpoint."""
+    rtts: list[float] = []
+    target = url.rstrip("/") + path
+    for _ in range(samples):
+        t0 = time.time()
+        try:
+            with urllib.request.urlopen(target, timeout=timeout_s) as resp:
+                resp.read(64)
+            rtts.append((time.time() - t0) * 1000.0)
+        except Exception:
+            continue
+    out: dict[str, Any] = {"rtt_samples": len(rtts), "rtt_target": path}
+    if rtts:
+        out["network_rtt_p50_ms"] = percentile(rtts, 50)
+        out["network_rtt_p95_ms"] = percentile(rtts, 95)
+    return out
+
+
+def measure_object_fetch(
+    object_url: str, max_bytes: int = 64 * 1024 * 1024, timeout_s: float = 60.0
+) -> dict[str, Any]:
+    """Sequential-read throughput (MB/s) of a model artifact over HTTP(S)/GCS.
+
+    gs:// URLs rewrite to the public GCS HTTP endpoint; private buckets need
+    a pre-signed URL, as with the reference's S3 probe."""
+    url = object_url
+    if url.startswith("gs://"):
+        url = "https://storage.googleapis.com/" + url[len("gs://"):]
+    t0 = time.time()
+    n = 0
+    try:
+        with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+            while n < max_bytes:
+                chunk = resp.read(min(1 << 20, max_bytes - n))
+                if not chunk:
+                    break
+                n += len(chunk)
+    except Exception as e:
+        return {"storage_error": f"{type(e).__name__}: {e}", "storage_bytes": n}
+    dt = max(time.time() - t0, 1e-9)
+    return {
+        "storage_bytes": n,
+        "storage_fetch_mbps": n / dt / (1024 * 1024),
+        "storage_fetch_seconds": dt,
+    }
+
+
+# -- CLI ---------------------------------------------------------------------
+
+def register(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument("--url", required=True, help="Endpoint base URL")
+    parser.add_argument("--object-url", default=None,
+                        help="Model artifact URL (gs:// or https://) for fetch test")
+    parser.add_argument("--samples", type=int, default=20)
+    parser.add_argument("--run-dir", default=None, help="Write io_probe.json here")
+
+
+def run(args: argparse.Namespace) -> int:
+    out = measure_http_rtt(args.url, samples=args.samples)
+    if args.object_url:
+        out.update(measure_object_fetch(args.object_url))
+    print(json.dumps(out, indent=2))
+    if args.run_dir:
+        from kserve_vllm_mini_tpu.core.rundir import RunDir
+
+        RunDir(args.run_dir).write_io_probe(out)
+    return 0

@@ -1,0 +1,247 @@
+"""Measurement stack: analyzer pipeline, energy math, cost, planner, kube parsing."""
+
+import json
+import math
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from kserve_vllm_mini_tpu.analysis.analyzer import analyze_run
+from kserve_vllm_mini_tpu.analysis.kube import parse_k8s_quantity, pod_resources
+from kserve_vllm_mini_tpu.analysis.telemetry import (
+    scrape_runtime_metrics,
+    tdp_for_accelerator,
+)
+from kserve_vllm_mini_tpu.core.rundir import RunDir
+from kserve_vllm_mini_tpu.costs.estimator import estimate_cost, overlap_seconds
+from kserve_vllm_mini_tpu.costs.planner import (
+    PlanInput,
+    calibrate_from_sweep_csv,
+    markdown_report,
+    plan,
+)
+from kserve_vllm_mini_tpu.costs.pricing import load_pricing
+from kserve_vllm_mini_tpu.energy.collector import integrate_energy, trapezoidal_wh
+from tests.synthetic import cold_start_instants, make_synthetic_run
+
+
+# -- analyzer ---------------------------------------------------------------
+
+def test_analyze_graceful_without_cluster(synthetic_run):
+    results = analyze_run(synthetic_run)
+    assert results["requests"] == 200
+    assert results["p50_ms"] < results["p95_ms"]
+    assert results["ttft_p50_ms"] > 0
+    assert results["throughput_rps"] > 0
+    assert "tpu_duty_cycle_avg" not in results  # no telemetry sources
+    assert synthetic_run.results_json.exists()
+
+
+def test_analyze_with_cold_instants(synthetic_run):
+    records = synthetic_run.read_requests()
+    instants = cold_start_instants(records)
+    results = analyze_run(synthetic_run, cold_start_times=instants)
+    assert results["cold_requests"] == 10
+    assert results["cold_multiplier"] > 1.5
+    assert synthetic_run.requests_classified_csv.exists()
+
+
+def test_analyze_is_deterministic(tmp_path):
+    r1 = analyze_run(make_synthetic_run(tmp_path / "a"))
+    r2 = analyze_run(make_synthetic_run(tmp_path / "b"))
+    for k in ("p50_ms", "p95_ms", "ttft_p95_ms", "tokens_per_sec", "error_rate"):
+        assert r1[k] == r2[k], k
+
+
+# -- telemetry --------------------------------------------------------------
+
+METRICS_TEXT = """# TYPE kvmini_tpu_duty_cycle gauge
+kvmini_tpu_duty_cycle 0.75
+kvmini_tpu_decode_tokens_total 12345
+"""
+
+
+class _MetricsHandler(BaseHTTPRequestHandler):
+    def do_GET(self):
+        body = METRICS_TEXT.encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "text/plain")
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture
+def metrics_server():
+    srv = HTTPServer(("127.0.0.1", 0), _MetricsHandler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{srv.server_port}"
+    srv.shutdown()
+
+
+def test_scrape_runtime_metrics(metrics_server):
+    m = scrape_runtime_metrics(metrics_server)
+    assert m["kvmini_tpu_duty_cycle"] == 0.75
+    assert m["kvmini_tpu_decode_tokens_total"] == 12345
+
+
+def test_analyze_with_runtime_endpoint(synthetic_run, metrics_server):
+    results = analyze_run(synthetic_run, endpoint=metrics_server)
+    assert results["tpu_duty_cycle_avg"] == 0.75
+    assert results["tpu_metrics_source"] == "runtime:/metrics"
+    # modeled power from duty x TDP, provenance marked
+    assert results["power_provenance"] == "modeled"
+    expected = tdp_for_accelerator("tpu-v5e-8") * (0.15 + 0.85 * 0.75)
+    assert results["tpu_power_watts_avg"] == pytest.approx(expected)
+
+
+def test_scrape_unreachable_is_empty():
+    assert scrape_runtime_metrics("http://127.0.0.1:1") == {}
+
+
+# -- energy -----------------------------------------------------------------
+
+def test_trapezoidal_constant_power():
+    samples = [{"t": float(t), "watts": 100.0} for t in range(0, 3600, 10)]
+    wh = trapezoidal_wh(samples, 0.0, 3590.0)
+    assert wh == pytest.approx(100.0 * 3590 / 3600, rel=1e-6)
+
+
+def test_trapezoidal_window_clipping():
+    samples = [{"t": 0.0, "watts": 100.0}, {"t": 100.0, "watts": 100.0}]
+    assert trapezoidal_wh(samples, 25.0, 75.0) == pytest.approx(100.0 * 50 / 3600)
+
+
+def test_trapezoidal_empty_and_degenerate():
+    assert trapezoidal_wh([], 0, 10) == 0.0
+    assert trapezoidal_wh([{"t": 1.0, "watts": 50.0}], 0, 10) == 0.0
+
+
+def test_integrate_energy_with_idle_tax(synthetic_run):
+    records = synthetic_run.read_requests()
+    t0 = min(r.start_ts for r in records)
+    t1 = max(r.end_ts for r in records)
+    samples = [
+        {"t": t0 + i * (t1 - t0) / 100, "watts": 50.0 if i < 10 else 150.0}
+        for i in range(101)
+    ]
+    synthetic_run.write_power({"samples": samples, "provenance": "modeled"})
+    doc = integrate_energy(synthetic_run, idle_tax="series")
+    assert doc["provenance"] == "modeled"
+    assert doc["idle_watts"] == pytest.approx(50.0, rel=0.05)
+    assert doc["energy_wh"] < doc["energy_wh_raw"]
+    assert doc["energy_wh_per_1k_tokens"] > 0
+    merged = synthetic_run.read_results()
+    assert merged["energy_wh_per_1k_tokens"] == pytest.approx(
+        doc["energy_wh_per_1k_tokens"]
+    )
+    assert merged["power_provenance"] == "modeled"
+
+
+# -- cost -------------------------------------------------------------------
+
+def test_parse_k8s_quantity():
+    assert parse_k8s_quantity("4") == 4.0
+    assert parse_k8s_quantity("500m") == 0.5
+    assert parse_k8s_quantity("2Gi") == 2 * 1024**3
+    assert parse_k8s_quantity("1M") == 1e6
+    assert parse_k8s_quantity("") == 0.0
+    assert parse_k8s_quantity("garbage") == 0.0
+
+
+def test_pod_resources_tpu_key():
+    pod = {
+        "spec": {
+            "containers": [
+                {"resources": {"limits": {"google.com/tpu": "8", "cpu": "4",
+                                          "memory": "16Gi"}}}
+            ]
+        }
+    }
+    r = pod_resources(pod)
+    assert r["tpu_chips"] == 8.0
+    assert r["cpu_cores"] == 4.0
+    assert r["memory_bytes"] == 16 * 1024**3
+
+
+def test_overlap_seconds():
+    assert overlap_seconds(0, 100, 50, None) == 50.0
+    assert overlap_seconds(0, 100, 50, 80) == 30.0
+    assert overlap_seconds(0, 100, 200, 300) == 0.0
+
+
+def test_pricing_fuzzy_match():
+    pricing = load_pricing()
+    price, key = pricing.chip_price("tpu-v5-lite-podslice")
+    assert key == "v5litepod" and price == 1.20
+    price, key = pricing.chip_price("tpu-v5p-slice")
+    assert key == "v5p"
+    price, key = pricing.chip_price("unknown-thing")
+    assert key == "default"
+
+
+def test_estimate_cost_clusterless(synthetic_run):
+    analyze_run(synthetic_run)  # ensure window merged first
+    pricing = load_pricing()
+    update = estimate_cost(synthetic_run, pricing, chips=8, accelerator="v5e")
+    records = synthetic_run.read_requests()
+    dur = max(r.end_ts for r in records) - min(r.start_ts for r in records)
+    expected_tpu = 8 * dur / 3600.0 * 1.20
+    assert update["cost_breakdown"]["tpu"] == pytest.approx(expected_tpu, rel=1e-4)
+    assert update["cost_total"] == pytest.approx(
+        expected_tpu * (1 + pricing.overhead_factor), rel=1e-4
+    )
+    assert update["cost_per_1k_tokens"] > 0
+    merged = synthetic_run.read_results()
+    assert merged["cost_total"] == update["cost_total"]
+
+
+def test_cost_cold_warm_split(synthetic_run):
+    records = synthetic_run.read_requests()
+    analyze_run(synthetic_run, cold_start_times=cold_start_instants(records))
+    update = estimate_cost(synthetic_run, load_pricing(), chips=1)
+    assert update["cold_cost_total"] + update["warm_cost_total"] == pytest.approx(
+        update["cost_total"]
+    )
+    assert update["cold_cost_total"] == pytest.approx(update["cost_total"] * 10 / 200)
+
+
+# -- planner ----------------------------------------------------------------
+
+def test_plan_ranks_by_cost_among_slo_meeting():
+    pricing = load_pricing()
+    options = plan(PlanInput(target_rps=10.0, model_size="8b",
+                             avg_output_tokens=100.0), pricing)
+    assert options
+    meeting = [o for o in options if o.meets_p95]
+    assert meeting == sorted(meeting, key=lambda o: o.total_monthly_usd)
+    for o in options:
+        assert o.expected_rps_capacity >= 10.0
+        assert o.chips >= 1 and o.monthly_cost_usd > 0
+
+
+def test_plan_calibration_overrides_baseline(tmp_path):
+    csv_path = tmp_path / "sweep.csv"
+    csv_path.write_text(
+        "accelerator,tokens_per_sec_per_chip\n"
+        "tpu-v5e-8,500\n"
+        "tpu-v5e-8,900\n"
+    )
+    calib = calibrate_from_sweep_csv(csv_path)
+    assert calib == {"v5e": 900.0}
+    options = plan(
+        PlanInput(target_rps=1.0, model_size="8b", accelerators=["v5e"],
+                  calibrated=calib),
+        load_pricing(),
+    )
+    assert options[0].tokens_per_sec_per_chip == 900.0
+
+
+def test_markdown_report_renders():
+    options = plan(PlanInput(target_rps=5.0, model_size="8b"), load_pricing())
+    md = markdown_report(PlanInput(target_rps=5.0), options)
+    assert "| rank |" in md and "v5e" in md

@@ -1,0 +1,110 @@
+"""Config validator + SLO gate behavior."""
+
+import json
+
+import pytest
+
+from kserve_vllm_mini_tpu.core.validate import validate_profile
+from kserve_vllm_mini_tpu.gates.slo import gate_results, load_slo
+
+
+# -- validator --------------------------------------------------------------
+
+def test_valid_profile_passes():
+    rep = validate_profile({
+        "pattern": "poisson", "requests": 100, "concurrency": 10,
+        "max_tokens": 128, "model": "llama-3.1-8b", "topology": "v5e-8",
+        "quantization": "int8",
+    })
+    assert rep.ok, rep.errors
+
+
+def test_gpu_only_quantization_rejected():
+    rep = validate_profile({"quantization": "awq"})
+    assert not rep.ok
+    assert any("TPU" in e and "int8" in e for e in rep.errors)
+
+
+def test_hbm_fit_check():
+    # 70B bf16 needs ~182 GiB; v5e-8 has 128 GiB
+    rep = validate_profile({"model": "llama-3-70b", "topology": "v5e-8"})
+    assert not rep.ok
+    assert any("HBM" in e and "v5e-16" in e for e in rep.errors)
+    # int8 halves it: ~91 GiB fits 128 GiB (with headroom warning)
+    rep2 = validate_profile({"model": "llama-3-70b", "topology": "v5e-8",
+                             "quantization": "int8"})
+    assert rep2.ok
+
+
+def test_max_tokens_exceeds_model_len():
+    rep = validate_profile({"max_tokens": 4096, "max_model_len": 4096})
+    assert not rep.ok
+
+
+def test_unknown_pattern_and_topology():
+    rep = validate_profile({"pattern": "sawtooth", "topology": "v9z-4"})
+    assert len(rep.errors) == 2
+
+
+def test_device_autodetect_fake():
+    # the reference's fake-the-probe pattern: inject the detector
+    rep = validate_profile(
+        {"model": "llama-3.1-8b", "topology": "v5e-8"},
+        detect_devices=lambda: 1,
+    )
+    assert any("only 1 TPU device" in e for e in rep.errors)
+    rep2 = validate_profile(
+        {"model": "llama-3.1-8b", "topology": "v5e-8"},
+        detect_devices=lambda: 8,
+    )
+    assert rep2.ok
+
+
+def test_speculative_requires_draft():
+    rep = validate_profile({"speculative": {"enabled": True}})
+    assert any("draft_model" in e for e in rep.errors)
+
+
+# -- gate -------------------------------------------------------------------
+
+GOOD = {
+    "p95_ms": 800.0, "ttft_p95_ms": 100.0, "error_rate": 0.001,
+    "cost_per_1k_tokens": 0.01, "cold_multiplier": 1.5,
+    "energy_wh_per_1k_tokens": 10.0,
+}
+
+
+def test_gate_passes_good_results():
+    verdicts = gate_results(GOOD, load_slo())
+    assert all(v.ok for v in verdicts)
+
+
+def test_gate_fails_bad_results():
+    bad = dict(GOOD, p95_ms=5000.0, error_rate=0.5)
+    verdicts = gate_results(bad, load_slo())
+    failed = {v.metric for v in verdicts if not v.ok}
+    assert failed == {"p95_ms", "error_rate"}
+
+
+def test_gate_missing_metric_fails():
+    results = dict(GOOD)
+    del results["cold_multiplier"]
+    verdicts = gate_results(results, load_slo())
+    v = next(v for v in verdicts if v.budget_key == "cold_multiplier_max")
+    assert not v.ok and "missing" in v.note
+
+
+def test_gate_min_direction():
+    verdicts = gate_results(
+        {"throughput_rps": 5.0}, {"throughput_rps_min": 10.0}
+    )
+    assert not verdicts[0].ok
+    verdicts = gate_results(
+        {"throughput_rps": 15.0}, {"throughput_rps_min": 10.0}
+    )
+    assert verdicts[0].ok
+
+
+def test_gate_unknown_budget_key_fails():
+    verdicts = gate_results(GOOD, {"nonsense_budget": 1.0})
+    assert not verdicts[0].ok
