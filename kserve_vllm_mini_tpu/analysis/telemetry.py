@@ -66,6 +66,17 @@ def tdp_for_accelerator(accelerator: Optional[str]) -> float:
     return TPU_TDP_WATTS["default"]
 
 
+# idle power floor as a fraction of TDP for the modeled-power formula
+MODELED_IDLE_FRACTION = 0.15
+
+
+def modeled_power(duty_cycle: float, accelerator: Optional[str]) -> float:
+    """The single source of truth for duty-cycle -> watts modeling; used by
+    both live sampling (energy/collector.py) and post-hoc utilization."""
+    tdp = tdp_for_accelerator(accelerator)
+    return tdp * (MODELED_IDLE_FRACTION + (1.0 - MODELED_IDLE_FRACTION) * duty_cycle)
+
+
 def prom_instant_query(prom_url: str, query: str, timeout_s: float = 5.0) -> Optional[float]:
     """Single instant query -> first scalar value, or None."""
     url = prom_url.rstrip("/") + "/api/v1/query?" + urllib.parse.urlencode({"query": query})
@@ -109,11 +120,17 @@ def scrape_runtime_metrics(endpoint: str, timeout_s: float = 5.0) -> dict[str, f
         line = line.strip()
         if not line or line.startswith("#"):
             continue
-        parts = line.split()
-        if len(parts) >= 2:
-            name = parts[0].split("{")[0]
+        # `name{labels} value [timestamp]` — labels may contain spaces, and a
+        # trailing timestamp must not be mistaken for the value
+        if "}" in line:
+            name = line.split("{", 1)[0]
+            rest = line[line.rindex("}") + 1:].split()
+        else:
+            parts = line.split()
+            name, rest = parts[0], parts[1:]
+        if rest:
             try:
-                out[name] = float(parts[-1])
+                out[name] = float(rest[0])
             except ValueError:
                 continue
     return out
@@ -148,10 +165,7 @@ def collect_utilization(
             out["tpu_duty_cycle_avg"] = m["kvmini_tpu_duty_cycle"]
             out["tpu_metrics_source"] = "runtime:/metrics"
     if "tpu_power_watts_avg" not in out and "tpu_duty_cycle_avg" in out:
-        # modeled: duty cycle x TDP (+ ~15% idle floor), marked as such
-        tdp = tdp_for_accelerator(accelerator)
-        duty = out["tpu_duty_cycle_avg"]
-        out["tpu_power_watts_avg"] = tdp * (0.15 + 0.85 * duty)
+        out["tpu_power_watts_avg"] = modeled_power(out["tpu_duty_cycle_avg"], accelerator)
         out["power_provenance"] = "modeled"
     return out
 

@@ -40,7 +40,15 @@ def run_bench(
     from kserve_vllm_mini_tpu.energy.collector import collect_power, integrate_energy
     from kserve_vllm_mini_tpu.loadgen.runner import LoadConfig, run_load
 
-    # Stage 0: validate
+    if not url and not self_serve:
+        print("bench: either --url or --self-serve is required", file=__import__("sys").stderr)
+        return {}, 2
+
+    # Stage 0: validate — against the limits the run will actually use (the
+    # self-serve engine defaults max_model_len to 1024, not the validator's
+    # external-backend default)
+    if self_serve:
+        profile.setdefault("max_model_len", 1024)
     rep = validate_profile(profile)
     for w in rep.warnings:
         print(f"WARNING: {w}")
@@ -103,10 +111,10 @@ def run_bench(
                 break
             except Exception:
                 time.sleep(0.2)
-        cold_start_instants = [time.time()]
+        # the cold-start instant is when boot BEGAN (pod-startedAt analog),
+        # not when readiness was observed
+        cold_start_instants = [t_cold0]
         print(f"bench: self-serve runtime up in {time.time() - t_cold0:.1f}s at {url}")
-
-    assert url, "either --url or --self-serve is required"
 
     # Stage 1: load test with concurrent power sampling
     stop_sampling = threading.Event()
@@ -142,7 +150,9 @@ def run_bench(
     )
     records = run_load(cfg, run_dir)
     stop_sampling.set()
-    sampler.join(timeout=5.0)
+    # worst-case iteration = power-query timeouts (~8 s with 2 s timeouts);
+    # power.json must exist before Stage 4 integrates it
+    sampler.join(timeout=30.0)
     ok = sum(1 for r in records if r.ok)
     print(f"bench: load complete {ok}/{len(records)} ok")
 
@@ -254,5 +264,6 @@ def run(args: argparse.Namespace) -> int:
         cost_file=args.cost_file,
         chips=args.chips,
         slo_file=args.slo,
+        idle_tax=args.idle_tax,
     )
     return code

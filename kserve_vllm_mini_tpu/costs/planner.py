@@ -105,6 +105,9 @@ def plan(inputs: PlanInput, pricing: Pricing) -> list[PlanOption]:
         mult = pricing.region_multiplier(inputs.region)
         monthly = chips * price * HOURS_PER_MONTH * mult
         warm_monthly = warm_chips * price * HOURS_PER_MONTH * mult
+        # warm-pool break-even: one warm chip costs price/h; each avoided cold
+        # start saves cold_start_s of wasted chip time (price cancels out)
+        breakeven_events_per_hour = 3600.0 / max(inputs.cold_start_s, 1e-9)
 
         # p95 heuristic: per-token latency must fit the budget for the mean
         # response; decode dominated by tokens/sec/chip at full batching
@@ -119,6 +122,10 @@ def plan(inputs: PlanInput, pricing: Pricing) -> list[PlanOption]:
             )
         if util > 0.85:
             notes.append("utilization at target >85%; little burst headroom")
+        notes.append(
+            f"warm pool pays for itself above ~{breakeven_events_per_hour:.1f} "
+            f"cold starts/hour (each wastes ~{inputs.cold_start_s:.0f}s of chip time)"
+        )
         options.append(
             PlanOption(
                 accelerator=accel,

@@ -29,18 +29,22 @@ def sample_power_once(
     prom_url: Optional[str],
     endpoint: Optional[str],
     accelerator: Optional[str] = None,
+    timeout_s: float = 2.0,
 ) -> tuple[Optional[float], str]:
-    """One instantaneous total-power sample -> (watts, provenance)."""
+    """One instantaneous total-power sample -> (watts, provenance).
+
+    Short timeouts: this runs inside a 1 Hz sampling loop that must remain
+    responsive to its stop signal even when sources are unreachable."""
     if prom_url:
-        v, _ = telemetry.query_with_fallbacks(prom_url, telemetry.TPU_POWER_QUERIES)
-        if v is not None:
-            return v, "measured"
+        for q in telemetry.TPU_POWER_QUERIES:
+            v = telemetry.prom_instant_query(prom_url, q, timeout_s=timeout_s)
+            if v is not None:
+                return v, "measured"
     if endpoint:
-        m = telemetry.scrape_runtime_metrics(endpoint)
+        m = telemetry.scrape_runtime_metrics(endpoint, timeout_s=timeout_s)
         duty = m.get("kvmini_tpu_duty_cycle")
         if duty is not None:
-            tdp = telemetry.tdp_for_accelerator(accelerator)
-            return tdp * (0.15 + 0.85 * duty), "modeled"
+            return telemetry.modeled_power(duty, accelerator), "modeled"
     return None, "unavailable"
 
 

@@ -1,0 +1,134 @@
+"""Canary comparison: candidate run vs baseline run, regression-flagged.
+
+Reference behavior (/root/reference/tools/canary_compare.py:19-134): a
+metric/direction/threshold table drives relative-delta checks; improvements
+always pass; regressions beyond threshold fail; exit 2 on any regression.
+Inputs are run dirs (or bare results.json files); JSON + HTML outputs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Any, Optional
+
+# metric -> (direction, relative threshold). "lower": candidate should not be
+# more than threshold above baseline; "higher": not more than threshold below.
+CANARY_METRICS: dict[str, tuple[str, float]] = {
+    "p95_ms": ("lower", 0.10),
+    "p99_ms": ("lower", 0.10),
+    "ttft_p95_ms": ("lower", 0.10),
+    "error_rate": ("lower", 0.01),          # absolute for rates near zero
+    "throughput_rps": ("higher", 0.10),
+    "tokens_per_sec": ("higher", 0.10),
+    "cost_per_1k_tokens": ("lower", 0.10),
+    "energy_wh_per_1k_tokens": ("lower", 0.10),
+    "cache_hit_ratio": ("higher", 0.10),
+    "quality_score": ("higher", 0.02),
+}
+
+
+@dataclass
+class Delta:
+    metric: str
+    baseline: Optional[float]
+    candidate: Optional[float]
+    rel_delta: Optional[float]
+    verdict: str  # "pass" | "regression" | "skipped"
+    note: str = ""
+
+
+def _load_results(path: str | Path) -> dict[str, Any]:
+    p = Path(path)
+    if p.is_dir():
+        p = p / "results.json"
+    with p.open() as f:
+        return json.load(f)
+
+
+def compare(
+    baseline: dict[str, Any],
+    candidate: dict[str, Any],
+    metrics: Optional[dict[str, tuple[str, float]]] = None,
+) -> list[Delta]:
+    metrics = metrics or CANARY_METRICS
+    out: list[Delta] = []
+    for metric, (direction, threshold) in metrics.items():
+        b, c = baseline.get(metric), candidate.get(metric)
+        if b is None or c is None:
+            out.append(Delta(metric, b, c, None, "skipped", "missing in one side"))
+            continue
+        b, c = float(b), float(c)
+        if metric == "error_rate":
+            # near-zero rates: absolute delta, not relative
+            delta = c - b
+            bad = delta > threshold
+            rel = delta
+        else:
+            if b == 0.0:
+                out.append(Delta(metric, b, c, None, "skipped", "baseline is zero"))
+                continue
+            rel = (c - b) / abs(b)
+            bad = rel > threshold if direction == "lower" else rel < -threshold
+        out.append(
+            Delta(metric, b, c, rel, "regression" if bad else "pass")
+        )
+    return out
+
+
+def summarize(deltas: list[Delta]) -> dict[str, Any]:
+    return {
+        "regressions": [d.metric for d in deltas if d.verdict == "regression"],
+        "passes": [d.metric for d in deltas if d.verdict == "pass"],
+        "skipped": [d.metric for d in deltas if d.verdict == "skipped"],
+        "deltas": [d.__dict__ for d in deltas],
+    }
+
+
+def html_report(deltas: list[Delta]) -> str:
+    rows = []
+    for d in deltas:
+        color = {"pass": "#0a7f3f", "regression": "#c22", "skipped": "#888"}[d.verdict]
+        rel = f"{d.rel_delta:+.1%}" if d.rel_delta is not None else "—"
+        rows.append(
+            f"<tr><td>{d.metric}</td><td>{d.baseline}</td><td>{d.candidate}</td>"
+            f"<td>{rel}</td><td style='color:{color};font-weight:bold'>"
+            f"{d.verdict}{(' (' + d.note + ')') if d.note else ''}</td></tr>"
+        )
+    return (
+        "<html><head><title>Canary comparison</title></head><body>"
+        "<h1>Canary: candidate vs baseline</h1>"
+        "<table border=1 cellpadding=6 style='border-collapse:collapse'>"
+        "<tr><th>metric</th><th>baseline</th><th>candidate</th>"
+        "<th>delta</th><th>verdict</th></tr>"
+        + "".join(rows)
+        + "</table></body></html>"
+    )
+
+
+# -- CLI ---------------------------------------------------------------------
+
+def register(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument("--baseline", required=True, help="Baseline run dir or results.json")
+    parser.add_argument("--candidate", required=True, help="Candidate run dir or results.json")
+    parser.add_argument("--json-out", default=None)
+    parser.add_argument("--html-out", default=None)
+
+
+def run(args: argparse.Namespace) -> int:
+    deltas = compare(_load_results(args.baseline), _load_results(args.candidate))
+    summary = summarize(deltas)
+    for d in deltas:
+        rel = f"{d.rel_delta:+.1%}" if d.rel_delta is not None else "    —"
+        print(f"{d.metric:<28} {rel:>8}  {d.verdict}")
+    if args.json_out:
+        Path(args.json_out).write_text(json.dumps(summary, indent=2))
+    if args.html_out:
+        Path(args.html_out).write_text(html_report(deltas))
+    if summary["regressions"]:
+        print(f"canary: REGRESSION in {', '.join(summary['regressions'])}")
+        return 2
+    print(f"canary: no regressions ({len(summary['passes'])} metrics compared)")
+    return 0

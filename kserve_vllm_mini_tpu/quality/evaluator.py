@@ -1,0 +1,220 @@
+"""Mini quality-eval suite: quantify accuracy loss from quantization/config.
+
+Reference behavior (/root/reference/quality/evaluator.py:20-338): small
+embedded task sets scored 0-100 against an OpenAI-compatible endpoint, a
+Pareto bucket classifier over (quality, latency, cost), and results.json
+integration. The reference's 3-sample toy tasks are a noted weakness
+(SURVEY.md §7.3.6) — sample counts here are 10-16 per task.
+
+Tasks are deterministic and self-contained (no datasets to download):
+- ``copy``        — exact-echo instruction following
+- ``arithmetic``  — 2-3 digit add/sub/mul word problems
+- ``completion``  — high-frequency bigram/world-knowledge cloze
+- ``choice``      — 2-way commonsense multiple choice (A/B parsing)
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import random
+import re
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+import httpx
+
+from kserve_vllm_mini_tpu.loadgen.adapters.base import GenParams
+from kserve_vllm_mini_tpu.loadgen.adapters.openai_chat import OpenAIChatAdapter
+
+
+@dataclass
+class Sample:
+    prompt: str
+    check: Callable[[str], bool]
+
+
+def _arith_samples(rng: random.Random, n: int) -> list[Sample]:
+    out = []
+    for _ in range(n):
+        op = rng.choice(["+", "-", "*"])
+        if op == "*":
+            a, b = rng.randint(2, 19), rng.randint(2, 12)
+        else:
+            a, b = rng.randint(10, 499), rng.randint(10, 499)
+        ans = str(eval(f"{a}{op}{b}"))
+        prompt = (
+            f"What is {a} {op} {b}? Answer with only the number, no other text."
+        )
+        out.append(Sample(prompt, lambda t, ans=ans: _first_number(t) == ans))
+    return out
+
+
+def _first_number(text: str) -> Optional[str]:
+    m = re.search(r"-?\d+", text.replace(",", ""))
+    return m.group(0) if m else None
+
+
+def _copy_samples(rng: random.Random, n: int) -> list[Sample]:
+    out = []
+    for _ in range(n):
+        word = "".join(rng.choice("abcdefghikmnprstuw") for _ in range(6))
+        prompt = f"Repeat exactly this word and nothing else: {word}"
+        out.append(Sample(prompt, lambda t, w=word: w in t.lower()))
+    return out
+
+
+_COMPLETIONS = [
+    ("The capital of France is", "paris"),
+    ("Water freezes at zero degrees", "celsius"),
+    ("The opposite of hot is", "cold"),
+    ("Two plus two equals", "four|4"),
+    ("The sun rises in the", "east"),
+    ("The first month of the year is", "january"),
+    ("A triangle has how many sides? Answer in one word:", "three|3"),
+    ("The chemical symbol for water is", "h2o"),
+    ("The largest planet in our solar system is", "jupiter"),
+    ("The color of a clear daytime sky is", "blue"),
+]
+
+_CHOICES = [
+    ("To cut paper you should use (A) scissors (B) a spoon.", "a"),
+    ("Ice is (A) hot (B) cold.", "b"),
+    ("Fish live in (A) water (B) sand.", "a"),
+    ("At night you can usually see (A) the sun (B) the moon.", "b"),
+    ("Bread is made primarily from (A) flour (B) rocks.", "a"),
+    ("To write you would use (A) a hammer (B) a pen.", "b"),
+    ("Rain falls from (A) clouds (B) the ground.", "a"),
+    ("A dictionary is used to look up (A) recipes (B) word meanings.", "b"),
+]
+
+
+def _completion_samples() -> list[Sample]:
+    out = []
+    for prompt, answer in _COMPLETIONS:
+        pattern = re.compile(rf"\b({answer})\b", re.IGNORECASE)
+        out.append(
+            Sample(prompt + " Answer in one word.", lambda t, p=pattern: bool(p.search(t)))
+        )
+    return out
+
+
+def _choice_samples() -> list[Sample]:
+    out = []
+    for prompt, answer in _CHOICES:
+        def check(t: str, ans=answer) -> bool:
+            m = re.search(r"\b([ab])\b", t.strip().lower())
+            return bool(m and m.group(1) == ans)
+
+        out.append(Sample(prompt + " Answer A or B only.", check))
+    return out
+
+
+def build_tasks(seed: int = 42) -> dict[str, list[Sample]]:
+    rng = random.Random(seed)
+    return {
+        "copy": _copy_samples(rng, 10),
+        "arithmetic": _arith_samples(rng, 16),
+        "completion": _completion_samples(),
+        "choice": _choice_samples(),
+    }
+
+
+async def evaluate_async(
+    url: str,
+    model: str = "default",
+    seed: int = 42,
+    max_tokens: int = 32,
+    timeout_s: float = 60.0,
+) -> dict[str, Any]:
+    tasks = build_tasks(seed)
+    adapter = OpenAIChatAdapter()
+    params = GenParams(max_tokens=max_tokens, temperature=0.0)
+    scores: dict[str, float] = {}
+    n_total = n_correct = 0
+    async with httpx.AsyncClient(timeout=timeout_s) as client:
+        for name, samples in tasks.items():
+            correct = 0
+            for s in samples:
+                res = await adapter.generate(
+                    client, url, model, s.prompt, params, stream=False
+                )
+                if res.ok and s.check(res.text):
+                    correct += 1
+            scores[name] = 100.0 * correct / len(samples)
+            n_total += len(samples)
+            n_correct += correct
+    return {
+        "quality_score": 100.0 * n_correct / n_total if n_total else 0.0,
+        "quality_tasks": scores,
+        "quality_samples": n_total,
+    }
+
+
+def evaluate(url: str, **kwargs) -> dict[str, Any]:
+    return asyncio.run(evaluate_async(url, **kwargs))
+
+
+# -- Pareto bucket classifier (reference evaluator.py:260-314) ---------------
+
+def classify_pareto_bucket(
+    quality: float, p95_ms: float, cost_per_1k: float,
+    quality_floor: float = 90.0, p95_budget_ms: float = 1200.0,
+    cost_budget: float = 0.05,
+) -> str:
+    """3-axis bucket: which constraints does a config satisfy?"""
+    q_ok = quality >= quality_floor
+    l_ok = p95_ms <= p95_budget_ms
+    c_ok = cost_per_1k <= cost_budget
+    if q_ok and l_ok and c_ok:
+        return "sweet-spot"
+    if q_ok and l_ok:
+        return "quality-latency"
+    if q_ok and c_ok:
+        return "quality-cost"
+    if l_ok and c_ok:
+        return "cheap-fast-degraded"
+    if q_ok:
+        return "quality-only"
+    return "dominated"
+
+
+def pareto_frontier(points: list[dict[str, float]],
+                    minimize: tuple[str, ...] = ("p95_ms", "cost_per_1k_tokens"),
+                    maximize: tuple[str, ...] = ("quality_score",)) -> list[int]:
+    """Indices of non-dominated points (O(n^2) dominance, reference
+    quantization_sweep.py:510-549)."""
+    def dominates(a: dict, b: dict) -> bool:
+        no_worse = all(a.get(k, 0) >= b.get(k, 0) for k in maximize) and all(
+            a.get(k, float("inf")) <= b.get(k, float("inf")) for k in minimize
+        )
+        strictly = any(a.get(k, 0) > b.get(k, 0) for k in maximize) or any(
+            a.get(k, float("inf")) < b.get(k, float("inf")) for k in minimize
+        )
+        return no_worse and strictly
+
+    return [
+        i for i, p in enumerate(points)
+        if not any(dominates(q, p) for j, q in enumerate(points) if j != i)
+    ]
+
+
+# -- CLI ---------------------------------------------------------------------
+
+def register(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument("--url", required=True)
+    parser.add_argument("--model", default="default")
+    parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument("--run-dir", default=None,
+                        help="Merge quality_* keys into this run's results.json")
+
+
+def run(args: argparse.Namespace) -> int:
+    result = evaluate(args.url, model=args.model, seed=args.seed)
+    print(json.dumps(result, indent=2))
+    if args.run_dir:
+        from kserve_vllm_mini_tpu.core.rundir import RunDir
+
+        RunDir(args.run_dir).merge_into_results(result)
+    return 0

@@ -1,0 +1,140 @@
+"""matplotlib chart helpers -> base64 <img> tags (self-contained HTML).
+
+Reference pattern (/root/reference/report_generator.py:66-312): every chart
+renders to a base64 PNG embedded inline so reports are single-file
+artifacts. Degrades to a styled placeholder when matplotlib is absent.
+"""
+
+from __future__ import annotations
+
+import base64
+import io
+from typing import Any, Optional
+
+try:
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    HAVE_MPL = True
+except ImportError:  # pragma: no cover
+    HAVE_MPL = False
+
+_PALETTE = {"primary": "#2563eb", "warm": "#f59e0b", "cold": "#60a5fa",
+            "ok": "#16a34a", "bad": "#dc2626", "grid": "#e5e7eb"}
+
+
+def _to_img(fig) -> str:
+    buf = io.BytesIO()
+    fig.savefig(buf, format="png", dpi=110, bbox_inches="tight")
+    plt.close(fig)
+    b64 = base64.b64encode(buf.getvalue()).decode()
+    return f'<img src="data:image/png;base64,{b64}" style="max-width:100%"/>'
+
+
+def _placeholder(title: str) -> str:
+    return (
+        f'<div style="border:1px dashed #aaa;padding:2em;text-align:center;'
+        f'color:#888">chart unavailable (matplotlib not installed): {title}</div>'
+    )
+
+
+def latency_histogram_chart(results: dict[str, Any]) -> str:
+    hist = results.get("latency_histogram") or {}
+    if not HAVE_MPL or not hist.get("buckets"):
+        return _placeholder("latency distribution")
+    fig, ax = plt.subplots(figsize=(7, 3))
+    buckets, counts = hist["buckets"], hist["counts"]
+    width = (buckets[1] - buckets[0]) if len(buckets) > 1 else 1.0
+    ax.bar(buckets, counts, width=width * 0.9, color=_PALETTE["primary"], alpha=0.85)
+    for pct, key in ((50, "p50_ms"), (95, "p95_ms"), (99, "p99_ms")):
+        v = results.get(key)
+        if v is not None:
+            ax.axvline(v, color=_PALETTE["bad"] if pct >= 95 else _PALETTE["ok"],
+                       linestyle="--", linewidth=1)
+            ax.text(v, max(counts) * 0.92, f"p{pct}", fontsize=8, rotation=90)
+    ax.set_xlabel("latency (ms)")
+    ax.set_ylabel("requests")
+    ax.set_title("Latency distribution")
+    ax.grid(color=_PALETTE["grid"], axis="y")
+    return _to_img(fig)
+
+
+def ttft_vs_latency_chart(results: dict[str, Any]) -> str:
+    if not HAVE_MPL:
+        return _placeholder("ttft vs latency")
+    pairs = [
+        ("TTFT p50", results.get("ttft_p50_ms")),
+        ("TTFT p95", results.get("ttft_p95_ms")),
+        ("latency p50", results.get("p50_ms")),
+        ("latency p95", results.get("p95_ms")),
+        ("latency p99", results.get("p99_ms")),
+    ]
+    pairs = [(k, v) for k, v in pairs if v is not None]
+    if not pairs:
+        return _placeholder("ttft vs latency")
+    fig, ax = plt.subplots(figsize=(7, 3))
+    names = [k for k, _ in pairs]
+    vals = [v for _, v in pairs]
+    colors = [_PALETTE["cold"] if "TTFT" in n else _PALETTE["primary"] for n in names]
+    ax.barh(names, vals, color=colors)
+    for i, v in enumerate(vals):
+        ax.text(v, i, f" {v:.0f} ms", va="center", fontsize=9)
+    ax.set_title("Latency percentiles")
+    ax.grid(color=_PALETTE["grid"], axis="x")
+    return _to_img(fig)
+
+
+def cold_warm_chart(results: dict[str, Any]) -> str:
+    cold, warm = results.get("cold_p95_ms"), results.get("warm_p95_ms")
+    if not HAVE_MPL or cold is None or warm is None:
+        return ""
+    fig, ax = plt.subplots(figsize=(5, 3))
+    ax.bar(["warm p50", "warm p95", "cold p50", "cold p95"],
+           [results.get("warm_p50_ms", 0), warm, results.get("cold_p50_ms", 0), cold],
+           color=[_PALETTE["warm"], _PALETTE["warm"], _PALETTE["cold"], _PALETTE["cold"]])
+    mult = results.get("cold_multiplier")
+    ax.set_title(
+        f"Cold vs warm latency (cold multiplier {mult:.2f}x)" if mult else
+        "Cold vs warm latency"
+    )
+    ax.set_ylabel("ms")
+    ax.grid(color=_PALETTE["grid"], axis="y")
+    return _to_img(fig)
+
+
+def cost_breakdown_chart(results: dict[str, Any]) -> str:
+    bd = results.get("cost_breakdown") or {}
+    bd = {k: v for k, v in bd.items() if v and v > 0}
+    if not HAVE_MPL or not bd:
+        return ""
+    fig, ax = plt.subplots(figsize=(4.5, 3))
+    ax.pie(list(bd.values()), labels=list(bd.keys()), autopct="%1.0f%%",
+           colors=[_PALETTE["primary"], _PALETTE["warm"], _PALETTE["cold"], "#a78bfa"])
+    ax.set_title(f"Cost breakdown (total ${results.get('cost_total', 0):.4f})")
+    return _to_img(fig)
+
+
+def heatmap_chart(
+    rows: list[str], cols: list[str], values: list[list[Optional[float]]],
+    title: str, fmt: str = "{:.0f}",
+) -> str:
+    if not HAVE_MPL:
+        return _placeholder(title)
+    import numpy as np
+
+    arr = np.array([[v if v is not None else np.nan for v in row] for row in values],
+                   dtype=float)
+    fig, ax = plt.subplots(figsize=(1.2 + 0.9 * len(cols), 1.0 + 0.6 * len(rows)))
+    im = ax.imshow(arr, cmap="viridis", aspect="auto")
+    ax.set_xticks(range(len(cols)), cols, rotation=30, ha="right", fontsize=8)
+    ax.set_yticks(range(len(rows)), rows, fontsize=8)
+    for i in range(len(rows)):
+        for j in range(len(cols)):
+            if not np.isnan(arr[i, j]):
+                ax.text(j, i, fmt.format(arr[i, j]), ha="center", va="center",
+                        fontsize=8, color="white")
+    ax.set_title(title)
+    fig.colorbar(im, shrink=0.8)
+    return _to_img(fig)

@@ -1,0 +1,279 @@
+"""Executive HTML reports: single run, grid sweep, topology matrix.
+
+Reference surface (/root/reference/report_generator.py:398-827): metric
+cards, embedded charts, cold/warm section, prewarm break-even, bottleneck
+classification, recommendations, a zero-dependency trace viewer deep-linked
+at the p95 request, sweep heatmaps, and the topology (née MIG) matrix.
+Everything inlines into one .html file.
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import html as html_mod
+import json
+from pathlib import Path
+from typing import Any, Optional
+
+from kserve_vllm_mini_tpu.report import charts
+from kserve_vllm_mini_tpu.report.recommendations import (
+    classify_bottleneck,
+    generate_recommendations,
+    prewarm_breakeven,
+)
+
+_CSS = """
+body{font-family:system-ui,-apple-system,sans-serif;margin:2em auto;max-width:1100px;
+     color:#111827;padding:0 1em}
+h1{border-bottom:3px solid #2563eb;padding-bottom:.3em}
+.cards{display:flex;flex-wrap:wrap;gap:12px;margin:1em 0}
+.card{border:1px solid #e5e7eb;border-radius:10px;padding:14px 18px;min-width:150px;
+      box-shadow:0 1px 3px rgba(0,0,0,.06)}
+.card .v{font-size:1.6em;font-weight:700;color:#2563eb}
+.card .l{font-size:.8em;color:#6b7280;text-transform:uppercase;letter-spacing:.05em}
+.warn{color:#b45309}.bad{color:#dc2626}.ok{color:#16a34a}
+section{margin:2em 0}
+ul.recs li{margin:.5em 0}
+pre.trace{background:#0b1020;color:#c9d4ff;padding:1em;border-radius:8px;
+          overflow-x:auto;font-size:.85em}
+table{border-collapse:collapse}td,th{border:1px solid #e5e7eb;padding:6px 10px}
+"""
+
+
+def _card(label: str, value: Any, unit: str = "") -> str:
+    if value is None:
+        return ""
+    if isinstance(value, float):
+        value = f"{value:,.2f}" if value >= 10 else f"{value:.4g}"
+    return (
+        f'<div class="card"><div class="v">{value}{unit}</div>'
+        f'<div class="l">{html_mod.escape(label)}</div></div>'
+    )
+
+
+def _trace_viewer(run_dir: Optional[Path], results: dict[str, Any]) -> str:
+    """Find the request closest to p95 and render its span tree
+    (reference report_generator.py:423-491)."""
+    if run_dir is None:
+        return ""
+    traces_path = run_dir / "traces" / "traces.json"
+    requests_path = run_dir / "requests.csv"
+    if not traces_path.exists() or not requests_path.exists():
+        return ""
+    p95 = results.get("p95_ms")
+    if p95 is None:
+        return ""
+    best: Optional[dict] = None
+    with requests_path.open(newline="") as f:
+        for row in csv.DictReader(f):
+            try:
+                lat = float(row.get("latency_ms") or 0)
+            except ValueError:
+                continue
+            if row.get("ok") != "1" or lat <= 0:
+                continue
+            if best is None or abs(lat - p95) < abs(float(best["latency_ms"]) - p95):
+                best = row
+    if not best:
+        return ""
+    trace_id = best.get("trace_id", "")
+    doc = json.loads(traces_path.read_text())
+    spans = [
+        s
+        for rs in doc.get("resourceSpans", [])
+        for ss in rs.get("scopeSpans", [])
+        for s in ss.get("spans", [])
+        if s.get("traceId") == trace_id
+    ]
+    if not spans:
+        return ""
+    t0 = min(int(s["startTimeUnixNano"]) for s in spans)
+    lines = [f"trace {trace_id}  (request {best['request_id']}, "
+             f"{float(best['latency_ms']):.1f} ms ~ p95)"]
+    for s in sorted(spans, key=lambda s: int(s["startTimeUnixNano"])):
+        start_ms = (int(s["startTimeUnixNano"]) - t0) / 1e6
+        dur_ms = (int(s["endTimeUnixNano"]) - int(s["startTimeUnixNano"])) / 1e6
+        indent = "  " if s.get("parentSpanId") else ""
+        bar = "#" * max(int(dur_ms / max(float(best["latency_ms"]), 1e-9) * 40), 1)
+        lines.append(f"{indent}{s['name']:<24} +{start_ms:8.1f}ms "
+                     f"{dur_ms:8.1f}ms  {bar}")
+    return (
+        "<section><h2>p95 request trace</h2>"
+        f"<pre class='trace'>{html_mod.escape(chr(10).join(lines))}</pre></section>"
+    )
+
+
+def generate_single_run_html(
+    results: dict[str, Any], run_dir: Optional[Path] = None
+) -> str:
+    label, why = classify_bottleneck(results)
+    recs = generate_recommendations(results)
+    breakeven = prewarm_breakeven(results)
+
+    cards = "".join(
+        [
+            _card("p95 latency", results.get("p95_ms"), " ms"),
+            _card("TTFT p50", results.get("ttft_p50_ms"), " ms"),
+            _card("throughput", results.get("throughput_rps"), " rps"),
+            _card("tokens/sec", results.get("tokens_per_sec")),
+            _card("error rate", (results.get("error_rate") or 0) * 100, "%"),
+            _card("$/1K tokens", results.get("cost_per_1k_tokens")),
+            _card("Wh/1K tokens", results.get("energy_wh_per_1k_tokens")),
+            _card("TPU duty", (results.get("tpu_duty_cycle_avg") or 0) * 100
+                  if results.get("tpu_duty_cycle_avg") is not None else None, "%"),
+            _card("cold multiplier", results.get("cold_multiplier"), "x"),
+            _card("quality", results.get("quality_score")),
+        ]
+    )
+
+    sections = [
+        f"<h1>Benchmark report — {html_mod.escape(str(results.get('model', 'run')))}</h1>",
+        f"<p>{html_mod.escape(str(results.get('runtime', '')))} · "
+        f"{html_mod.escape(str(results.get('accelerator', '') or ''))} · "
+        f"pattern {html_mod.escape(str(results.get('pattern', '?')))} · "
+        f"{results.get('requests', '?')} requests</p>",
+        f'<div class="cards">{cards}</div>',
+        f"<section><h2>Bottleneck: {label}</h2><p>{html_mod.escape(why)}</p></section>",
+        "<section><h2>Latency</h2>",
+        charts.latency_histogram_chart(results),
+        charts.ttft_vs_latency_chart(results),
+        "</section>",
+    ]
+    cw = charts.cold_warm_chart(results)
+    if cw:
+        sections.append(f"<section><h2>Cold vs warm</h2>{cw}")
+        if breakeven:
+            sections.append(f"<p>{html_mod.escape(breakeven['explanation'])}</p>")
+        sections.append("</section>")
+    cb = charts.cost_breakdown_chart(results)
+    if cb:
+        sections.append(f"<section><h2>Cost</h2>{cb}</section>")
+    sections.append(
+        "<section><h2>Recommendations</h2><ul class='recs'>"
+        + "".join(f"<li>{html_mod.escape(r)}</li>" for r in recs)
+        + "</ul></section>"
+    )
+    sections.append(_trace_viewer(run_dir, results))
+    sections.append(
+        "<section><h2>Raw results</h2><details><summary>results.json</summary>"
+        f"<pre>{html_mod.escape(json.dumps(results, indent=2, sort_keys=True))}</pre>"
+        "</details></section>"
+    )
+    return (
+        f"<html><head><meta charset='utf-8'><title>kvmini-tpu report</title>"
+        f"<style>{_CSS}</style></head><body>{''.join(sections)}</body></html>"
+    )
+
+
+def _read_sweep_csv(path: Path) -> list[dict[str, str]]:
+    with path.open(newline="") as f:
+        return list(csv.DictReader(f))
+
+
+def generate_grid_sweep_html(csv_path: Path, metric: str = "p95_ms") -> str:
+    """Heatmaps over concurrency x max_tokens per pattern
+    (reference report_generator.py:597-771)."""
+    rows = _read_sweep_csv(csv_path)
+    patterns = sorted({r.get("pattern", "?") for r in rows})
+    sections = [f"<h1>Grid sweep — {html_mod.escape(metric)}</h1>"]
+    for pat in patterns:
+        sub = [r for r in rows if r.get("pattern") == pat]
+        concs = sorted({int(r["concurrency"]) for r in sub if r.get("concurrency")})
+        toks = sorted({int(r["max_tokens"]) for r in sub if r.get("max_tokens")})
+        grid: list[list[Optional[float]]] = []
+        for c in concs:
+            row_vals: list[Optional[float]] = []
+            for t in toks:
+                match = [
+                    r for r in sub
+                    if int(r.get("concurrency", -1)) == c and int(r.get("max_tokens", -1)) == t
+                ]
+                try:
+                    row_vals.append(float(match[0][metric]) if match else None)
+                except (KeyError, ValueError):
+                    row_vals.append(None)
+            grid.append(row_vals)
+        sections.append(f"<section><h2>pattern: {html_mod.escape(pat)}</h2>")
+        sections.append(
+            charts.heatmap_chart(
+                [f"conc {c}" for c in concs],
+                [f"{t} tok" for t in toks],
+                grid,
+                f"{metric} ({pat})",
+                fmt="{:.0f}",
+            )
+        )
+        sections.append("</section>")
+    return (
+        f"<html><head><meta charset='utf-8'><style>{_CSS}</style></head>"
+        f"<body>{''.join(sections)}</body></html>"
+    )
+
+
+def generate_topology_matrix_html(csv_path: Path) -> str:
+    """Topology-slice matrix (v5e-1/-4/-8 ...), the MIG-matrix analog
+    (reference report_generator.py:774-827)."""
+    rows = _read_sweep_csv(csv_path)
+    header = (
+        "<tr><th>topology</th><th>chips</th><th>p95 ms</th><th>TTFT p50 ms</th>"
+        "<th>tokens/s</th><th>tokens/s/chip</th><th>$/1K tok</th><th>verdict</th></tr>"
+    )
+    body = []
+    best_eff: Optional[float] = None
+    for r in rows:
+        try:
+            eff = float(r.get("tokens_per_sec_per_chip") or 0)
+        except ValueError:
+            eff = 0.0
+        best_eff = max(best_eff or 0.0, eff)
+    for r in rows:
+        try:
+            eff = float(r.get("tokens_per_sec_per_chip") or 0)
+        except ValueError:
+            eff = 0.0
+        verdict = "most efficient" if best_eff and eff == best_eff else ""
+        body.append(
+            "<tr>"
+            f"<td>{html_mod.escape(r.get('topology', '?'))}</td>"
+            f"<td>{html_mod.escape(r.get('chips', '?'))}</td>"
+            f"<td>{html_mod.escape(r.get('p95_ms', ''))}</td>"
+            f"<td>{html_mod.escape(r.get('ttft_p50_ms', ''))}</td>"
+            f"<td>{html_mod.escape(r.get('tokens_per_sec', ''))}</td>"
+            f"<td>{eff:.1f}</td>"
+            f"<td>{html_mod.escape(r.get('cost_per_1k_tokens', ''))}</td>"
+            f"<td class='ok'>{verdict}</td></tr>"
+        )
+    return (
+        f"<html><head><meta charset='utf-8'><style>{_CSS}</style></head><body>"
+        "<h1>Topology matrix</h1><table>"
+        + header + "".join(body) + "</table></body></html>"
+    )
+
+
+# -- CLI ---------------------------------------------------------------------
+
+def register(parser: argparse.ArgumentParser) -> None:
+    src = parser.add_mutually_exclusive_group(required=True)
+    src.add_argument("--input", help="results.json or run dir")
+    src.add_argument("--grid-sweep", help="Grid sweep CSV")
+    src.add_argument("--topology-matrix", help="Topology matrix CSV")
+    parser.add_argument("--metric", default="p95_ms", help="Sweep heatmap metric")
+    parser.add_argument("--output", required=True, help="Output .html path")
+
+
+def run(args: argparse.Namespace) -> int:
+    if args.input:
+        p = Path(args.input)
+        run_dir = p if p.is_dir() else p.parent
+        results_path = p / "results.json" if p.is_dir() else p
+        with results_path.open() as f:
+            results = json.load(f)
+        html = generate_single_run_html(results, run_dir=run_dir)
+    elif args.grid_sweep:
+        html = generate_grid_sweep_html(Path(args.grid_sweep), metric=args.metric)
+    else:
+        html = generate_topology_matrix_html(Path(args.topology_matrix))
+    Path(args.output).write_text(html)
+    print(f"report: wrote {args.output} ({len(html)} bytes)")
+    return 0

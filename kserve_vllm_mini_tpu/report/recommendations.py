@@ -1,0 +1,132 @@
+"""Analysis models behind the report: bottleneck classification, prewarm
+break-even, recommendations.
+
+Reference behavior: headroom/bottleneck heuristics
+(report_generator.py:199-245), prewarm break-even RPS model (:131-196), and
+the recommendations engine (:315-395) — recalibrated for TPU serving (cold
+starts are minutes; the bottleneck taxonomy gains an HBM-bound class).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from kserve_vllm_mini_tpu.costs.planner import DEFAULT_COLD_START_S, HOURS_PER_MONTH
+
+
+def classify_bottleneck(results: dict[str, Any]) -> tuple[str, str]:
+    """(label, explanation). Heuristics over the measured signals."""
+    duty = results.get("tpu_duty_cycle_avg")
+    rtt_p95 = results.get("network_rtt_p95_ms")
+    p95 = results.get("p95_ms")
+    ttft_p95 = results.get("ttft_p95_ms")
+    tpot_p95 = results.get("tpot_p95_ms")
+
+    if p95 is None:
+        return "unknown", "no successful requests to classify"
+    if rtt_p95 is not None and p95 and rtt_p95 > 0.3 * p95:
+        return (
+            "network-bound",
+            f"endpoint RTT p95 ({rtt_p95:.0f} ms) is >30% of request p95 — "
+            "move the load generator closer or check the ingress path",
+        )
+    if duty is not None and duty > 0.85:
+        return (
+            "compute-bound",
+            f"TPU duty cycle {duty:.0%}: the chip is saturated — scale out "
+            "(more chips / replicas) or quantize to int8",
+        )
+    if ttft_p95 is not None and p95 and ttft_p95 > 0.6 * p95:
+        return (
+            "scheduler-bound",
+            f"TTFT p95 ({ttft_p95:.0f} ms) dominates request p95 — requests "
+            "queue before prefill; raise engine slots or add replicas",
+        )
+    if duty is not None and duty < 0.3 and tpot_p95 is not None:
+        return (
+            "hbm-bound",
+            f"duty cycle only {duty:.0%} with steady token cadence "
+            f"({tpot_p95:.1f} ms/token p95): decode is HBM-bandwidth bound — "
+            "batch more requests per step or shrink the KV cache (shorter "
+            "max_seq, int8 KV)",
+        )
+    return "balanced", "no single dominant bottleneck detected"
+
+
+def prewarm_breakeven(
+    results: dict[str, Any],
+    cold_start_s: float = DEFAULT_COLD_START_S,
+    chip_hourly_usd: Optional[float] = None,
+) -> Optional[dict[str, Any]]:
+    """At what request rate does keeping a warm replica beat eating cold
+    starts? (reference report_generator.py:131-196, TPU cold-start scale).
+
+    Cold cost per event ~ extra latency cost proxy: (cold_p95 - warm_p95) x
+    requests affected. Monetary comparison: warm replica $/h vs cold events/h
+    x wasted chip-seconds."""
+    cold_p95 = results.get("cold_p95_ms")
+    warm_p95 = results.get("warm_p95_ms")
+    chip_hourly = chip_hourly_usd or results.get("cost_chip_hourly")
+    if cold_p95 is None or warm_p95 is None or not chip_hourly:
+        return None
+    # each cold event wastes ~cold_start_s of one chip
+    cold_event_usd = chip_hourly * cold_start_s / 3600.0
+    warm_replica_usd_per_h = chip_hourly
+    breakeven_events_per_hour = warm_replica_usd_per_h / max(cold_event_usd, 1e-9)
+    return {
+        "cold_event_usd": round(cold_event_usd, 4),
+        "warm_replica_usd_per_hour": round(warm_replica_usd_per_h, 4),
+        "breakeven_cold_events_per_hour": round(breakeven_events_per_hour, 2),
+        "monthly_warm_cost_usd": round(warm_replica_usd_per_h * HOURS_PER_MONTH, 2),
+        "explanation": (
+            f"keep a warm replica when cold starts exceed "
+            f"~{breakeven_events_per_hour:.1f}/hour (each cold start wastes "
+            f"~{cold_start_s:.0f}s of chip time)"
+        ),
+    }
+
+
+def generate_recommendations(results: dict[str, Any]) -> list[str]:
+    recs: list[str] = []
+    label, why = classify_bottleneck(results)
+    if label != "balanced" and label != "unknown":
+        recs.append(f"[{label}] {why}")
+
+    err = results.get("error_rate", 0.0)
+    if err and err > 0.02:
+        recs.append(
+            f"error rate {err:.1%} exceeds 2%: inspect per-request errors in "
+            "requests.csv before trusting latency numbers"
+        )
+    mult = results.get("cold_multiplier")
+    if mult and mult > 3.0:
+        recs.append(
+            f"cold requests are {mult:.1f}x slower than warm: consider minScale>=1 "
+            "or a warm pool (see prewarm break-even)"
+        )
+    cache = results.get("cache_hit_ratio")
+    if cache is not None and cache < 0.2:
+        recs.append(
+            f"prompt-cache hit ratio {cache:.0%}: enable prefix caching or "
+            "normalize system prompts across tenants"
+        )
+    cost = results.get("cost_per_1k_tokens")
+    if cost and cost > 0.05:
+        recs.append(
+            f"cost ${cost:.4f}/1K tokens exceeds the $0.05 budget: try int8 "
+            "quantization (2x density) or a smaller topology slice"
+        )
+    energy = results.get("energy_wh_per_1k_tokens")
+    if energy and energy > 50:
+        recs.append(
+            f"energy {energy:.1f} Wh/1K tokens over budget: raise batch size "
+            "(amortize weight streaming) or use a more efficient slice"
+        )
+    if results.get("power_provenance") == "modeled":
+        recs.append(
+            "energy figures are MODELED (duty-cycle x TDP), not measured — "
+            "deploy the node telemetry agent for measured power"
+        )
+    if not recs:
+        recs.append("all signals within budgets; no action needed")
+    return recs

@@ -1,0 +1,178 @@
+"""Report generation, canary compare, quality evaluator machinery."""
+
+import asyncio
+import json
+from pathlib import Path
+
+import pytest
+
+from kserve_vllm_mini_tpu.analysis.analyzer import analyze_run
+from kserve_vllm_mini_tpu.costs.estimator import estimate_cost
+from kserve_vllm_mini_tpu.costs.pricing import load_pricing
+from kserve_vllm_mini_tpu.gates.canary import CANARY_METRICS, compare, html_report, summarize
+from kserve_vllm_mini_tpu.quality.evaluator import (
+    build_tasks,
+    classify_pareto_bucket,
+    pareto_frontier,
+)
+from kserve_vllm_mini_tpu.report.html import (
+    generate_grid_sweep_html,
+    generate_single_run_html,
+    generate_topology_matrix_html,
+)
+from kserve_vllm_mini_tpu.report.recommendations import (
+    classify_bottleneck,
+    generate_recommendations,
+    prewarm_breakeven,
+)
+from tests.synthetic import cold_start_instants
+
+
+# -- canary -----------------------------------------------------------------
+
+BASE = {"p95_ms": 100.0, "throughput_rps": 50.0, "error_rate": 0.01,
+        "cost_per_1k_tokens": 0.01}
+
+
+def test_canary_passes_identical():
+    deltas = compare(BASE, dict(BASE))
+    assert all(d.verdict in ("pass", "skipped") for d in deltas)
+
+
+def test_canary_flags_latency_regression():
+    cand = dict(BASE, p95_ms=150.0)
+    deltas = compare(BASE, cand)
+    d = next(d for d in deltas if d.metric == "p95_ms")
+    assert d.verdict == "regression" and d.rel_delta == pytest.approx(0.5)
+
+
+def test_canary_improvement_passes():
+    cand = dict(BASE, p95_ms=50.0, throughput_rps=100.0)
+    deltas = compare(BASE, cand)
+    assert all(d.verdict == "pass" for d in deltas
+               if d.metric in ("p95_ms", "throughput_rps"))
+
+
+def test_canary_throughput_drop_fails():
+    deltas = compare(BASE, dict(BASE, throughput_rps=30.0))
+    d = next(d for d in deltas if d.metric == "throughput_rps")
+    assert d.verdict == "regression"
+
+
+def test_canary_error_rate_absolute():
+    # 1% -> 1.5%: +50% relative but only +0.005 absolute => pass
+    deltas = compare(BASE, dict(BASE, error_rate=0.015))
+    d = next(d for d in deltas if d.metric == "error_rate")
+    assert d.verdict == "pass"
+    deltas = compare(BASE, dict(BASE, error_rate=0.05))
+    d = next(d for d in deltas if d.metric == "error_rate")
+    assert d.verdict == "regression"
+
+
+def test_canary_missing_metric_skipped_and_html():
+    deltas = compare(BASE, dict(BASE))
+    s = summarize(deltas)
+    assert "energy_wh_per_1k_tokens" in s["skipped"]
+    html = html_report(deltas)
+    assert "<table" in html and "p95_ms" in html
+
+
+# -- quality ----------------------------------------------------------------
+
+def test_build_tasks_counts_and_determinism():
+    t1, t2 = build_tasks(seed=1), build_tasks(seed=1)
+    assert sum(len(v) for v in t1.values()) >= 40  # not the reference's 3-sample toys
+    assert [s.prompt for s in t1["arithmetic"]] == [s.prompt for s in t2["arithmetic"]]
+
+
+def test_task_checkers():
+    tasks = build_tasks(seed=0)
+    arith = tasks["arithmetic"][0]
+    import re
+
+    m = re.search(r"What is (\d+) (.) (\d+)\?", arith.prompt)
+    a, op, b = int(m.group(1)), m.group(2), int(m.group(3))
+    ans = str(eval(f"{a}{op}{b}"))
+    assert arith.check(f"The answer is {ans}.")
+    assert not arith.check("The answer is 999999.")
+    choice = tasks["choice"][0]
+    assert choice.check("A") and not choice.check("B")
+
+
+def test_pareto_bucket_and_frontier():
+    assert classify_pareto_bucket(95, 800, 0.01) == "sweet-spot"
+    assert classify_pareto_bucket(95, 5000, 0.01) == "quality-cost"
+    assert classify_pareto_bucket(50, 100, 0.001) == "cheap-fast-degraded"
+    points = [
+        {"quality_score": 95, "p95_ms": 100, "cost_per_1k_tokens": 0.02},
+        {"quality_score": 95, "p95_ms": 200, "cost_per_1k_tokens": 0.02},  # dominated
+        {"quality_score": 80, "p95_ms": 50, "cost_per_1k_tokens": 0.01},
+    ]
+    front = pareto_frontier(points)
+    assert 0 in front and 2 in front and 1 not in front
+
+
+# -- recommendations / report ----------------------------------------------
+
+def test_bottleneck_classification():
+    assert classify_bottleneck({"p95_ms": 100, "tpu_duty_cycle_avg": 0.95})[0] == "compute-bound"
+    assert classify_bottleneck(
+        {"p95_ms": 100, "ttft_p95_ms": 80}
+    )[0] == "scheduler-bound"
+    assert classify_bottleneck(
+        {"p95_ms": 100, "network_rtt_p95_ms": 50}
+    )[0] == "network-bound"
+    assert classify_bottleneck(
+        {"p95_ms": 100, "tpu_duty_cycle_avg": 0.2, "tpot_p95_ms": 5.0}
+    )[0] == "hbm-bound"
+    assert classify_bottleneck({})[0] == "unknown"
+
+
+def test_prewarm_breakeven():
+    be = prewarm_breakeven(
+        {"cold_p95_ms": 2000, "warm_p95_ms": 100, "cost_chip_hourly": 1.2},
+        cold_start_s=300,
+    )
+    assert be["breakeven_cold_events_per_hour"] == pytest.approx(12.0)
+    assert prewarm_breakeven({"warm_p95_ms": 100}) is None
+
+
+def test_recommendations_modeled_energy_flagged():
+    recs = generate_recommendations({"p95_ms": 100, "power_provenance": "modeled"})
+    assert any("MODELED" in r for r in recs)
+
+
+def test_single_run_report_from_full_pipeline(synthetic_run):
+    records = synthetic_run.read_requests()
+    analyze_run(synthetic_run, cold_start_times=cold_start_instants(records))
+    estimate_cost(synthetic_run, load_pricing(), chips=8, accelerator="v5e")
+    results = synthetic_run.read_results()
+    html = generate_single_run_html(results, run_dir=synthetic_run.path)
+    assert "Benchmark report" in html
+    assert "cold multiplier" in html.lower()
+    assert "Recommendations" in html
+    # trace viewer absent (synthetic run has no traces.json) but report intact
+    assert "results.json" in html
+
+
+def test_grid_sweep_html(tmp_path):
+    csv_path = tmp_path / "sweep.csv"
+    csv_path.write_text(
+        "pattern,concurrency,max_tokens,p95_ms\n"
+        "steady,5,32,100\nsteady,5,64,150\nsteady,10,32,180\nsteady,10,64,260\n"
+        "poisson,5,32,120\npoisson,10,64,300\n"
+    )
+    html = generate_grid_sweep_html(csv_path)
+    assert "steady" in html and "poisson" in html
+
+
+def test_topology_matrix_html(tmp_path):
+    csv_path = tmp_path / "topo.csv"
+    csv_path.write_text(
+        "topology,chips,p95_ms,ttft_p50_ms,tokens_per_sec,tokens_per_sec_per_chip,cost_per_1k_tokens\n"
+        "v5e-1,1,900,80,300,300,0.01\n"
+        "v5e-4,4,400,40,1000,250,0.015\n"
+        "v5e-8,8,300,30,1800,225,0.02\n"
+    )
+    html = generate_topology_matrix_html(csv_path)
+    assert "most efficient" in html and "v5e-1" in html
