@@ -10,8 +10,8 @@ duration, so the full pipeline runs with no cluster at all (SURVEY.md §7.1b).
 from __future__ import annotations
 
 import argparse
+import sys
 import threading
-import time
 from typing import Any, Optional
 
 import yaml
@@ -41,7 +41,7 @@ def run_bench(
     from kserve_vllm_mini_tpu.loadgen.runner import LoadConfig, run_load
 
     if not url and not self_serve:
-        print("bench: either --url or --self-serve is required", file=__import__("sys").stderr)
+        print("bench: either --url or --self-serve is required", file=sys.stderr)
         return {}, 2
 
     # Stage 0: validate — against the limits the run will actually use (the
@@ -61,60 +61,22 @@ def run_bench(
     run_dir.path.mkdir(parents=True, exist_ok=True)
     print(f"bench: run dir {run_dir.path}")
 
-    server_thread = None
-    engine = None
+    server = None
     cold_start_instants: list[float] = []
+    cold_window_s = 30.0
     if self_serve:
-        # start the in-repo runtime on a free port; its startup IS a cold start
-        import socket
+        # start the in-repo runtime on a free port; its startup IS a cold
+        # start — the cold-start instant is when boot BEGAN (pod-startedAt
+        # analog), not when readiness was observed
+        from kserve_vllm_mini_tpu.runtime.local import start_local_server
 
-        from aiohttp import web
-
-        from kserve_vllm_mini_tpu.runtime.server import build_engine, make_app
-
-        sock = socket.socket()
-        sock.bind(("127.0.0.1", 0))
-        port = sock.getsockname()[1]
-        sock.close()
-        t_cold0 = time.time()
-        engine, tok, name = build_engine(
-            model=profile.get("model", "llama-tiny"),
-            checkpoint=profile.get("checkpoint"),
-            max_slots=int(profile.get("max_slots", 8)),
-            max_seq_len=int(profile.get("max_model_len", 1024)),
-            topology=profile.get("jax_topology"),
-        )
-        engine.start()
-        app = make_app(engine, tok, name)
-        runner = web.AppRunner(app)
-
-        import asyncio
-
-        loop = asyncio.new_event_loop()
-
-        def _serve():
-            asyncio.set_event_loop(loop)
-            loop.run_until_complete(runner.setup())
-            site = web.TCPSite(runner, "127.0.0.1", port)
-            loop.run_until_complete(site.start())
-            loop.run_forever()
-
-        server_thread = threading.Thread(target=_serve, daemon=True, name="bench-server")
-        server_thread.start()
-        url = f"http://127.0.0.1:{port}"
-        # wait ready
-        import urllib.request
-
-        for _ in range(300):
-            try:
-                urllib.request.urlopen(url + "/healthz", timeout=1)
-                break
-            except Exception:
-                time.sleep(0.2)
-        # the cold-start instant is when boot BEGAN (pod-startedAt analog),
-        # not when readiness was observed
-        cold_start_instants = [t_cold0]
-        print(f"bench: self-serve runtime up in {time.time() - t_cold0:.1f}s at {url}")
+        server = start_local_server(profile)
+        url = server.url
+        cold_start_instants = [server.boot_began]
+        # requests can only begin after readiness, so the cold window must
+        # cover boot (weights + XLA compile) plus the usual 30 s of load
+        cold_window_s += server.boot_seconds
+        print(f"bench: self-serve runtime up in {server.boot_seconds:.1f}s at {url}")
 
     # Stage 1: load test with concurrent power sampling
     stop_sampling = threading.Event()
@@ -183,6 +145,7 @@ def run_bench(
         namespace=namespace,
         service=service,
         cold_start_times=cold_start_instants or None,
+        cold_window_s=cold_window_s,
     )
 
     # Stage 4: energy
@@ -207,8 +170,8 @@ def run_bench(
         print_table(verdicts)
         code = 0 if all(v.ok for v in verdicts) else 3
 
-    if engine is not None:
-        engine.stop()
+    if server is not None:
+        server.stop()
     p95 = results.get("p95_ms")
     print(
         f"bench: done p95={p95:.1f}ms " if p95 is not None else "bench: done ",

@@ -36,7 +36,7 @@ _SUBCOMMANDS: dict[str, tuple[str, str]] = {
     "bench": ("kserve_vllm_mini_tpu.bench_pipeline", "Full pipeline: validate -> load -> analyze -> cost"),
     "validate": ("kserve_vllm_mini_tpu.core.validate", "Pre-flight config validation"),
     "quality": ("kserve_vllm_mini_tpu.quality.evaluator", "Run the mini quality-eval suite"),
-    "sweep": ("kserve_vllm_mini_tpu.sweeps.grid", "Run a parameter sweep"),
+    "sweep": ("kserve_vllm_mini_tpu.sweeps.runner", "Run a parameter sweep"),
     "compare": ("kserve_vllm_mini_tpu.compare.backends", "A/B/C compare serving backends"),
     "parity": ("kserve_vllm_mini_tpu.compare.parity", "OpenAI API conformance probe"),
     "fairness": ("kserve_vllm_mini_tpu.compare.fairness", "Dual-tenant fairness/backpressure run"),

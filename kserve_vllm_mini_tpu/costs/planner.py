@@ -76,6 +76,14 @@ class PlanOption:
         return self.monthly_cost_usd + self.warm_pool_monthly_usd
 
 
+def breakeven_events_per_hour(cold_start_s: float) -> float:
+    """Cold-starts/hour above which a warm replica is cheaper: one warm chip
+    costs price/h; each avoided cold start saves ``cold_start_s`` of wasted
+    chip time, so the chip price cancels out. Shared with the report's
+    prewarm-breakeven model so the two user-facing numbers can't drift."""
+    return 3600.0 / max(cold_start_s, 1e-9)
+
+
 def baseline_for(accel: str, model_size: str, calibrated: dict[str, float]) -> Optional[float]:
     if accel in calibrated:
         return calibrated[accel]
@@ -105,9 +113,7 @@ def plan(inputs: PlanInput, pricing: Pricing) -> list[PlanOption]:
         mult = pricing.region_multiplier(inputs.region)
         monthly = chips * price * HOURS_PER_MONTH * mult
         warm_monthly = warm_chips * price * HOURS_PER_MONTH * mult
-        # warm-pool break-even: one warm chip costs price/h; each avoided cold
-        # start saves cold_start_s of wasted chip time (price cancels out)
-        breakeven_events_per_hour = 3600.0 / max(inputs.cold_start_s, 1e-9)
+        breakeven = breakeven_events_per_hour(inputs.cold_start_s)
 
         # p95 heuristic: per-token latency must fit the budget for the mean
         # response; decode dominated by tokens/sec/chip at full batching
@@ -123,7 +129,7 @@ def plan(inputs: PlanInput, pricing: Pricing) -> list[PlanOption]:
         if util > 0.85:
             notes.append("utilization at target >85%; little burst headroom")
         notes.append(
-            f"warm pool pays for itself above ~{breakeven_events_per_hour:.1f} "
+            f"warm pool pays for itself above ~{breakeven:.1f} "
             f"cold starts/hour (each wastes ~{inputs.cold_start_s:.0f}s of chip time)"
         )
         options.append(

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 
 from kserve_vllm_mini_tpu.models.config import ModelConfig
 from kserve_vllm_mini_tpu.ops.attention import attention
+from kserve_vllm_mini_tpu.ops.quant import linear
 from kserve_vllm_mini_tpu.ops.rmsnorm import rms_norm
 from kserve_vllm_mini_tpu.ops.rope import apply_rope, rope_frequencies
 
@@ -79,7 +80,7 @@ def _write_cache(
     offsets: jnp.ndarray,       # [B] int32 — absolute slot of new[:, :, 0]
 ) -> jnp.ndarray:
     def one(c, x, off):
-        return jax.lax.dynamic_update_slice(c, x, (0, off, 0))
+        return jax.lax.dynamic_update_slice(c, x.astype(c.dtype), (0, off, 0))
 
     return jax.vmap(one)(cache_layer, new, offsets)
 
@@ -115,9 +116,9 @@ def forward(
     def block(x, layer):
         p, k_layer, v_layer = layer
         h = rms_norm(x, p["attn_norm"], cfg.rms_eps)
-        q = (h @ p["wq"]).reshape(B, T, cfg.n_heads, cfg.head_dim).transpose(0, 2, 1, 3)
-        k = (h @ p["wk"]).reshape(B, T, cfg.n_kv_heads, cfg.head_dim).transpose(0, 2, 1, 3)
-        v = (h @ p["wv"]).reshape(B, T, cfg.n_kv_heads, cfg.head_dim).transpose(0, 2, 1, 3)
+        q = linear(h, p["wq"]).reshape(B, T, cfg.n_heads, cfg.head_dim).transpose(0, 2, 1, 3)
+        k = linear(h, p["wk"]).reshape(B, T, cfg.n_kv_heads, cfg.head_dim).transpose(0, 2, 1, 3)
+        v = linear(h, p["wv"]).reshape(B, T, cfg.n_kv_heads, cfg.head_dim).transpose(0, 2, 1, 3)
         q = apply_rope(q, positions, cos, sin)
         k = apply_rope(k, positions, cos, sin)
 
@@ -137,11 +138,11 @@ def forward(
             o = attention(q, k, v, mask)
 
         o = o.transpose(0, 2, 1, 3).reshape(B, T, cfg.n_heads * cfg.head_dim)
-        x = x + o @ p["wo"]
+        x = x + linear(o, p["wo"])
 
         h = rms_norm(x, p["mlp_norm"], cfg.rms_eps)
-        gated = jax.nn.silu((h @ p["w_gate"]).astype(jnp.float32)).astype(dt) * (h @ p["w_up"])
-        x = x + gated @ p["w_down"]
+        gated = jax.nn.silu(linear(h, p["w_gate"]).astype(jnp.float32)).astype(dt) * linear(h, p["w_up"])
+        x = x + linear(gated, p["w_down"])
         return x, (k_layer, v_layer)
 
     layers = params["layers"]

@@ -1,0 +1,84 @@
+"""Weight-only int8 quantization for the serving path.
+
+The reference treats quantization as an engine flag it sweeps and measures
+(sweeps/quantization_sweep.py:40-234, runners/profiles/quantization/*.yaml);
+the engines themselves do the work. Here the runtime is in-repo, so the knob
+is real: per-output-channel symmetric int8 on every transformer matmul
+weight, stored as ``{"q": int8 [..., in, out], "s": f32 [..., out]}``.
+
+TPU-first shape of the trick: the int8 tensor halves HBM traffic vs bf16
+(weights are the dominant stream during decode), and the dequantize —
+``(x @ q) * s`` — is a trailing elementwise multiply XLA fuses into the
+matmul's epilogue on the MXU. Activations stay bf16, so accuracy loss is the
+weight rounding only (the usual "W8A16" recipe, cf. AWQ/GPTQ claims at
+reference README.md:119-121).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+
+# A quantized linear leaf is a dict with exactly these keys.
+_QKEYS = frozenset({"q", "s"})
+
+
+def is_quantized(leaf: Any) -> bool:
+    return isinstance(leaf, dict) and set(leaf.keys()) == _QKEYS
+
+
+def quantize_weight(w: jnp.ndarray) -> dict[str, jnp.ndarray]:
+    """Per-output-channel symmetric int8 over the input (second-to-last) axis.
+
+    Works on [in, out] and layer-stacked [L, in, out] alike: the scale is
+    computed over axis -2 and has shape [..., out].
+    """
+    amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=-2, keepdims=True)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -127, 127).astype(jnp.int8)
+    return {"q": q, "s": scale.squeeze(-2).astype(jnp.float32)}
+
+
+def dequantize_weight(qw: dict[str, jnp.ndarray], dtype=jnp.bfloat16) -> jnp.ndarray:
+    return (qw["q"].astype(jnp.float32) * qw["s"][..., None, :].astype(jnp.float32)).astype(dtype)
+
+
+def linear(x: jnp.ndarray, w: Any) -> jnp.ndarray:
+    """``x @ w`` where ``w`` is a plain array or a quantized dict.
+
+    For int8 weights the matmul runs with the int8 tensor cast to the
+    activation dtype (one fused convert feeding the MXU) and the per-channel
+    scale applied to the [..., out] result — an epilogue multiply, not a
+    materialized dequantized weight.
+    """
+    if is_quantized(w):
+        y = x @ w["q"].astype(x.dtype)
+        return y * w["s"].astype(x.dtype)
+    return x @ w
+
+
+# Names of the per-layer matmul weights that quantization applies to
+# (models/llama.py init_params layout). Norms, embeddings, and the lm_head
+# stay high-precision — standard practice, and the embed is a gather anyway.
+QUANTIZABLE = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+def quantize_params(params: dict[str, Any]) -> dict[str, Any]:
+    """Quantize every transformer matmul weight in a Llama param tree."""
+    out = dict(params)
+    out["layers"] = {
+        k: (quantize_weight(v) if k in QUANTIZABLE else v)
+        for k, v in params["layers"].items()
+    }
+    return out
+
+
+def quantized_bytes(params: dict[str, Any]) -> int:
+    """Total parameter bytes, honoring quantized leaves (for /metrics + logs)."""
+    import jax
+
+    total = 0
+    for leaf in jax.tree.leaves(params):
+        total += leaf.size * leaf.dtype.itemsize
+    return total

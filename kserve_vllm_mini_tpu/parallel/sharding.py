@@ -33,7 +33,9 @@ def _axis(mesh: Mesh, name: str) -> Optional[str]:
     return name if name in mesh.axis_names and mesh.shape[name] > 1 else None
 
 
-def param_specs(cfg: ModelConfig, mesh: Mesh) -> dict[str, Any]:
+def param_specs(
+    cfg: ModelConfig, mesh: Mesh, params: Optional[dict[str, Any]] = None
+) -> dict[str, Any]:
     tp = _axis(mesh, "tp")
     pp = _axis(mesh, "pp")
     kv_tp = tp if tp and cfg.n_kv_heads % mesh.shape["tp"] == 0 else None
@@ -54,20 +56,37 @@ def param_specs(cfg: ModelConfig, mesh: Mesh) -> dict[str, Any]:
     }
     if not cfg.tie_embeddings:
         specs["lm_head"] = P(tp, None)
+    if params is not None:
+        _expand_quantized(specs["layers"], params.get("layers", {}))
     return specs
 
 
-def param_shardings(cfg: ModelConfig, mesh: Mesh) -> dict[str, Any]:
+def _expand_quantized(specs: dict[str, Any], leaves: dict[str, Any]) -> None:
+    """Int8 weight leaves are ``{"q": [L,in,out], "s": [L,out]}`` dicts
+    (ops/quant.py): shard ``q`` like the original weight and ``s`` along the
+    output axis (the last entry of the weight spec), so a tp-sharded matmul's
+    epilogue scale is local to each shard — no collective added."""
+    from kserve_vllm_mini_tpu.ops.quant import is_quantized
+
+    for name, leaf in leaves.items():
+        spec = specs.get(name)
+        if is_quantized(leaf) and isinstance(spec, P):
+            specs[name] = {"q": spec, "s": P(spec[0], spec[-1])}
+
+
+def param_shardings(
+    cfg: ModelConfig, mesh: Mesh, params: Optional[dict[str, Any]] = None
+) -> dict[str, Any]:
     return jax.tree.map(
         lambda spec: NamedSharding(mesh, spec),
-        param_specs(cfg, mesh),
+        param_specs(cfg, mesh, params),
         is_leaf=lambda x: isinstance(x, P),
     )
 
 
 def shard_params(params: dict[str, Any], cfg: ModelConfig, mesh: Mesh) -> dict[str, Any]:
     """device_put the param pytree onto the mesh per the rules."""
-    shardings = param_shardings(cfg, mesh)
+    shardings = param_shardings(cfg, mesh, params)
     return jax.device_put(params, shardings)
 
 

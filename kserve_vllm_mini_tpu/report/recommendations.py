@@ -69,18 +69,20 @@ def prewarm_breakeven(
     chip_hourly = chip_hourly_usd or results.get("cost_chip_hourly")
     if cold_p95 is None or warm_p95 is None or not chip_hourly:
         return None
+    from kserve_vllm_mini_tpu.costs.planner import breakeven_events_per_hour
+
     # each cold event wastes ~cold_start_s of one chip
     cold_event_usd = chip_hourly * cold_start_s / 3600.0
     warm_replica_usd_per_h = chip_hourly
-    breakeven_events_per_hour = warm_replica_usd_per_h / max(cold_event_usd, 1e-9)
+    breakeven = breakeven_events_per_hour(cold_start_s)
     return {
         "cold_event_usd": round(cold_event_usd, 4),
         "warm_replica_usd_per_hour": round(warm_replica_usd_per_h, 4),
-        "breakeven_cold_events_per_hour": round(breakeven_events_per_hour, 2),
+        "breakeven_cold_events_per_hour": round(breakeven, 2),
         "monthly_warm_cost_usd": round(warm_replica_usd_per_h * HOURS_PER_MONTH, 2),
         "explanation": (
             f"keep a warm replica when cold starts exceed "
-            f"~{breakeven_events_per_hour:.1f}/hour (each cold start wastes "
+            f"~{breakeven:.1f}/hour (each cold start wastes "
             f"~{cold_start_s:.0f}s of chip time)"
         ),
     }

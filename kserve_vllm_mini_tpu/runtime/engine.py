@@ -44,6 +44,7 @@ class EngineConfig:
     max_prefill_len: int = 512
     min_prefill_bucket: int = 16
     seed: int = 0
+    kv_cache_dtype: Optional[str] = None  # None -> model dtype (e.g. "float32")
 
 
 @dataclass
@@ -101,8 +102,9 @@ class Engine:
         S = self.ecfg.max_slots
         L = cfg.n_layers
         shape = (L, S, cfg.n_kv_heads, self.ecfg.max_seq_len, cfg.head_dim)
-        self._cache_k = jnp.zeros(shape, dtype=cfg.jnp_dtype)
-        self._cache_v = jnp.zeros(shape, dtype=cfg.jnp_dtype)
+        kv_dt = jnp.dtype(self.ecfg.kv_cache_dtype) if self.ecfg.kv_cache_dtype else cfg.jnp_dtype
+        self._cache_k = jnp.zeros(shape, dtype=kv_dt)
+        self._cache_v = jnp.zeros(shape, dtype=kv_dt)
         if mesh is not None:
             from kserve_vllm_mini_tpu.parallel.sharding import kv_cache_shardings
 

@@ -1,0 +1,118 @@
+"""In-process local serving: boot the JAX runtime + OpenAI HTTP server.
+
+One helper shared by the bench pipeline, every sweep, the backend comparator,
+and the chaos harness — the reference has no analog because its engines are
+external container images (SURVEY.md §0); here "deploy" can mean "start a
+thread", which is what makes the whole framework runnable with no cluster.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import socket
+import threading
+import time
+import urllib.request
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Any, Iterator, Optional
+
+
+def _free_port() -> int:
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    port = sock.getsockname()[1]
+    sock.close()
+    return port
+
+
+@dataclass
+class LocalServer:
+    url: str
+    engine: Any
+    tokenizer: Any
+    model_name: str
+    boot_began: float            # cold-start instant (pod-startedAt analog)
+    boot_seconds: float = 0.0
+    _stop: Optional[Any] = field(default=None, repr=False)
+
+    def stop(self) -> None:
+        if self._stop is not None:
+            self._stop()
+            self._stop = None
+
+
+def start_local_server(
+    profile: dict[str, Any],
+    host: str = "127.0.0.1",
+    ready_timeout_s: float = 120.0,
+) -> LocalServer:
+    """Boot engine + aiohttp server from a bench profile dict. The measured
+    boot window (model init + first readiness) is the run's cold start."""
+    from aiohttp import web
+
+    from kserve_vllm_mini_tpu.runtime.server import build_engine, make_app
+
+    port = _free_port()
+    t0 = time.time()
+    engine, tok, name = build_engine(
+        model=profile.get("model", "llama-tiny"),
+        checkpoint=profile.get("checkpoint"),
+        max_slots=int(profile.get("max_slots", 8)),
+        max_seq_len=int(profile.get("max_model_len", 1024)),
+        topology=profile.get("jax_topology"),
+        quantization=profile.get("quantization", "none") or "none",
+        kv_cache_dtype=profile.get("kv_cache_dtype"),
+    )
+    engine.start()
+    app = make_app(engine, tok, name)
+    runner = web.AppRunner(app)
+    loop = asyncio.new_event_loop()
+
+    def _serve() -> None:
+        asyncio.set_event_loop(loop)
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, host, port)
+        loop.run_until_complete(site.start())
+        loop.run_forever()
+
+    thread = threading.Thread(target=_serve, daemon=True, name="local-server")
+    thread.start()
+    url = f"http://{host}:{port}"
+
+    deadline = time.time() + ready_timeout_s
+    last_err: Optional[Exception] = None
+    while time.time() < deadline:
+        try:
+            urllib.request.urlopen(url + "/healthz", timeout=1)
+            break
+        except Exception as e:  # noqa: BLE001 — readiness probe, any failure retries
+            last_err = e
+            time.sleep(0.2)
+    else:
+        engine.stop()
+        raise TimeoutError(f"local server not ready after {ready_timeout_s}s: {last_err}")
+
+    def _stop() -> None:
+        engine.stop()
+        loop.call_soon_threadsafe(loop.stop)
+        thread.join(timeout=5.0)
+
+    return LocalServer(
+        url=url,
+        engine=engine,
+        tokenizer=tok,
+        model_name=name,
+        boot_began=t0,
+        boot_seconds=time.time() - t0,
+        _stop=_stop,
+    )
+
+
+@contextmanager
+def local_server(profile: dict[str, Any], **kwargs: Any) -> Iterator[LocalServer]:
+    srv = start_local_server(profile, **kwargs)
+    try:
+        yield srv
+    finally:
+        srv.stop()

@@ -37,6 +37,8 @@ def build_engine(
     max_seq_len: int = 1024,
     topology: Optional[str] = None,
     seed: int = 0,
+    quantization: str = "none",
+    kv_cache_dtype: Optional[str] = None,
 ) -> tuple[Engine, Tokenizer, str]:
     """Construct (engine, tokenizer, model_name) from a preset or checkpoint."""
     import jax
@@ -62,6 +64,19 @@ def build_engine(
             cfg = cfg.scaled(vocab_size=tok.vocab_size)
         params = init_params(jax.random.PRNGKey(seed), cfg)
         name = cfg.name
+    if quantization not in ("none", "int8"):
+        raise ValueError(f"unknown quantization {quantization!r}; known: none, int8")
+    if kv_cache_dtype not in (None, "bfloat16", "float32", "float16"):
+        # integer KV dtypes would silently truncate activations to zero in
+        # the cache write — reject until int8-KV lands with proper scales
+        raise ValueError(
+            f"unsupported kv_cache_dtype {kv_cache_dtype!r}; "
+            "known: bfloat16, float32, float16"
+        )
+    if quantization == "int8":
+        from kserve_vllm_mini_tpu.ops.quant import quantize_params
+
+        params = quantize_params(params)
     if mesh is not None:
         from kserve_vllm_mini_tpu.parallel.sharding import shard_params
 
@@ -71,6 +86,7 @@ def build_engine(
         max_seq_len=min(max_seq_len, cfg.max_seq_len),
         max_prefill_len=min(max_seq_len, cfg.max_seq_len) // 2,
         seed=seed,
+        kv_cache_dtype=kv_cache_dtype,
     )
     engine = Engine(params, cfg, ecfg, mesh=mesh, pad_id=tok.pad_id)
     return engine, tok, name

@@ -1,0 +1,9 @@
+"""Experiment sweeps (reference L6: grid-sweep.sh, sweeps/*).
+
+All four sweeps share one loop shape — ``for cfg in space: bench -> append
+CSV row -> continue on failure`` (reference grid-sweep.sh:103-174,
+autoscale-sweep.sh:196-333, mig-sweep.sh:163-193,
+quantization_sweep.py:321-341) — factored into sweeps.base here instead of
+four copies. The CSV is flushed after every configuration so an interrupted
+sweep is resumable (reference quantization_sweep.py:343-349 pattern).
+"""

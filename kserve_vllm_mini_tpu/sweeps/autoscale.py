@@ -1,0 +1,110 @@
+"""Autoscaling-knob sweep (reference sweeps/autoscale-sweep.sh).
+
+The reference sweeps Knative autoscaler annotations (containerConcurrency x
+initialScale x scaleToZeroGrace x windows, autoscale-sweep.sh:25-29) and
+records deploy time, cold multiplier, and cost per combination. The TPU
+build keeps that matrix for cluster mode (the annotations render via
+deploy/manifests.py) and gives the knobs real local meaning against the
+in-repo runtime:
+
+- ``container_concurrency`` -> engine decode slots (admission width),
+- ``initial_scale`` 0 -> runtime boots inside the measured window (a true
+  cold start: weights + XLA compile); >=1 -> pre-warmed before load,
+- ``scale_to_zero_grace_s`` -> recorded for the k8s annotation; locally a
+  runtime is torn down after each config regardless.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any, Optional
+
+from kserve_vllm_mini_tpu.sweeps import base
+
+DEFAULT_SPACE: dict[str, list[Any]] = {
+    "container_concurrency": [4, 8],
+    "initial_scale": [0, 1],
+    "scale_to_zero_grace_s": [30, 300],
+}
+
+CONFIG_KEYS = ["container_concurrency", "initial_scale", "scale_to_zero_grace_s"]
+
+
+def knative_annotations(cfg: dict[str, Any]) -> dict[str, str]:
+    """The K8s-mode rendering of one sweep point (reference
+    autoscale-sweep.sh:120-179 deploy_with_config)."""
+    return {
+        "autoscaling.knative.dev/initial-scale": str(cfg.get("initial_scale", 0)),
+        "autoscaling.knative.dev/scale-to-zero-pod-retention-period": (
+            f"{cfg.get('scale_to_zero_grace_s', 30)}s"
+        ),
+        "autoscaling.knative.dev/target": str(cfg.get("container_concurrency", 8)),
+    }
+
+
+def make_local_bench(base_profile: dict[str, Any]) -> base.BenchFn:
+    def bench(cfg: dict[str, Any]) -> dict[str, Any]:
+        from kserve_vllm_mini_tpu.bench_pipeline import run_bench
+
+        profile = {**base_profile}
+        profile["max_slots"] = int(cfg.get("container_concurrency", 8))
+        warm = int(cfg.get("initial_scale", 0)) >= 1
+        if warm:
+            from kserve_vllm_mini_tpu.runtime.local import local_server
+
+            with local_server(profile) as srv:
+                results, code = run_bench(url=srv.url, profile=profile)
+                results.setdefault("deploy_time_s", round(srv.boot_seconds, 2))
+        else:
+            results, code = run_bench(url=None, profile=profile, self_serve=True)
+            results.setdefault("deploy_time_s", results.get("cold_start_seconds"))
+        if not results:
+            raise RuntimeError(f"bench failed with exit code {code}")
+        return results
+
+    return bench
+
+
+def _extra(cfg: dict[str, Any], results: dict[str, Any]) -> dict[str, Any]:
+    return {"deploy_time_s": results.get("deploy_time_s")}
+
+
+def run_autoscale(
+    base_profile: dict[str, Any],
+    out_dir: Path,
+    space: Optional[dict[str, list[Any]]] = None,
+    bench_fn: Optional[base.BenchFn] = None,
+) -> list[dict[str, Any]]:
+    space = space or DEFAULT_SPACE
+    configs = base.grid_product(space)
+    bench = bench_fn or make_local_bench(base_profile)
+    csv_path = Path(out_dir) / "autoscale_results.csv"
+    rows = base.run_sweep(
+        configs, bench, csv_path, CONFIG_KEYS, extra_row_fn=_extra, label="autoscale-sweep"
+    )
+    _print_tradeoff(rows)
+    return rows
+
+
+def _print_tradeoff(rows: list[dict[str, Any]]) -> None:
+    """Scale-to-zero vs pre-warmed tradeoff summary (reference
+    autoscale-sweep.sh:345-415)."""
+    import sys
+
+    cold = [r for r in rows if r.get("status") == "ok" and not int(r.get("initial_scale") or 0)]
+    warm = [r for r in rows if r.get("status") == "ok" and int(r.get("initial_scale") or 0)]
+
+    def avg(rs: list[dict[str, Any]], key: str) -> Optional[float]:
+        vals = [float(r[key]) for r in rs if r.get(key) not in (None, "")]
+        return sum(vals) / len(vals) if vals else None
+
+    for name, rs in (("scale-to-zero", cold), ("pre-warmed", warm)):
+        if not rs:
+            continue
+        p95, mult, cost = avg(rs, "p95_ms"), avg(rs, "cold_multiplier"), avg(rs, "cost_per_1k_tokens")
+        print(
+            f"autoscale-sweep: {name}: avg p95 {p95 and round(p95)} ms,"
+            f" cold multiplier {mult and round(mult, 2)},"
+            f" $/1K tok {cost and round(cost, 6)}",
+            file=sys.stderr,
+        )

@@ -1,0 +1,124 @@
+"""Shared sweep machinery: the bench-per-config loop and CSV accumulation."""
+
+from __future__ import annotations
+
+import csv
+import itertools
+import sys
+import time
+from pathlib import Path
+from typing import Any, Callable, Iterable, Optional
+
+# A bench function takes a merged profile dict and returns a results dict
+# (the flat results.json schema). Injectable so sweep logic is unit-testable
+# without booting the runtime.
+BenchFn = Callable[[dict[str, Any]], dict[str, Any]]
+
+# Metrics every sweep row carries, pulled from results.json when present.
+RESULT_KEYS = (
+    "p50_ms",
+    "p95_ms",
+    "p99_ms",
+    "ttft_p50_ms",
+    "ttft_p95_ms",
+    "throughput_rps",
+    "tokens_per_sec",
+    "tokens_per_sec_per_chip",
+    "error_rate",
+    "cost_per_request",
+    "cost_per_1k_tokens",
+    "energy_wh_per_1k_tokens",
+    "cold_multiplier",
+)
+
+
+def default_bench_fn(
+    base: dict[str, Any],
+    self_serve: bool = True,
+    url: Optional[str] = None,
+    **bench_kwargs: Any,
+) -> BenchFn:
+    """Bench via the in-process pipeline (bench_pipeline.run_bench)."""
+
+    def bench(profile: dict[str, Any]) -> dict[str, Any]:
+        from kserve_vllm_mini_tpu.bench_pipeline import run_bench
+
+        merged = {**base, **profile}
+        results, code = run_bench(
+            url=url, profile=merged, self_serve=self_serve, **bench_kwargs
+        )
+        if not results:
+            raise RuntimeError(f"bench failed with exit code {code}")
+        return results
+
+    return bench
+
+
+def grid_product(grid: dict[str, Iterable[Any]]) -> list[dict[str, Any]]:
+    """{'a': [1,2], 'b': [x]} -> [{'a':1,'b':x}, {'a':2,'b':x}] (sorted keys
+    for deterministic order)."""
+    keys = sorted(grid)
+    return [dict(zip(keys, combo)) for combo in itertools.product(*(grid[k] for k in keys))]
+
+
+def write_row(csv_path: Path, row: dict[str, Any], fieldnames: list[str]) -> None:
+    """Append one row, writing the header iff the file is new. Flushed per
+    row so a killed sweep keeps everything it measured."""
+    csv_path.parent.mkdir(parents=True, exist_ok=True)
+    new = not csv_path.exists()
+    with csv_path.open("a", newline="") as f:
+        w = csv.DictWriter(f, fieldnames=fieldnames, extrasaction="ignore")
+        if new:
+            w.writeheader()
+        w.writerow({k: ("" if row.get(k) is None else row.get(k)) for k in fieldnames})
+
+
+def run_sweep(
+    configs: list[dict[str, Any]],
+    bench_fn: BenchFn,
+    csv_path: Path,
+    config_keys: list[str],
+    extra_row_fn: Optional[Callable[[dict[str, Any], dict[str, Any]], dict[str, Any]]] = None,
+    label: str = "sweep",
+) -> list[dict[str, Any]]:
+    """The one loop all sweeps share. Failure rows record the error and the
+    sweep continues (reference autoscale-sweep.sh:215-224)."""
+    fieldnames = config_keys + list(RESULT_KEYS) + ["status", "error", "elapsed_s"]
+    if extra_row_fn is not None:
+        # extra columns appear between metrics and status
+        probe = extra_row_fn({}, {})
+        fieldnames = config_keys + list(RESULT_KEYS) + sorted(probe) + ["status", "error", "elapsed_s"]
+    rows: list[dict[str, Any]] = []
+    for i, cfg in enumerate(configs):
+        desc = ", ".join(f"{k}={cfg[k]}" for k in sorted(cfg) if k in config_keys)
+        print(f"{label}: [{i + 1}/{len(configs)}] {desc}", file=sys.stderr)
+        t0 = time.time()
+        row: dict[str, Any] = {k: cfg.get(k) for k in config_keys}
+        try:
+            results = bench_fn(cfg)
+            for k in RESULT_KEYS:
+                row[k] = results.get(k)
+            if extra_row_fn is not None:
+                row.update(extra_row_fn(cfg, results))
+            row["status"] = "ok"
+            row["error"] = ""
+        except Exception as e:  # noqa: BLE001 — record-and-continue is the contract
+            if extra_row_fn is not None:
+                row.update(extra_row_fn(cfg, {}))
+            row["status"] = "failed"
+            row["error"] = f"{type(e).__name__}: {e}"[:200]
+            print(f"{label}: config failed: {row['error']}", file=sys.stderr)
+        row["elapsed_s"] = round(time.time() - t0, 2)
+        write_row(csv_path, row, fieldnames)
+        rows.append(row)
+    return rows
+
+
+def summarize_top(
+    rows: list[dict[str, Any]],
+    by: str,
+    minimize: bool,
+    n: int = 3,
+) -> list[dict[str, Any]]:
+    ok = [r for r in rows if r.get("status") == "ok" and r.get(by) is not None]
+    return sorted(ok, key=lambda r: float(r[by]), reverse=not minimize)[:n]

@@ -1,0 +1,54 @@
+"""Grid sweep: concurrency x max_tokens x pattern (reference grid-sweep.sh).
+
+Same matrix as the reference's default grid (grid-sweep.sh:23-25:
+concurrency {5,10,20} x max_tokens {32,64,128} x pattern
+{steady,poisson,bursty}) and the same output contract — one CSV row per
+cell, top-performers summary (grid-sweep.sh:181-198) — but run in-process
+against the self-served TPU runtime or any URL.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+from typing import Any, Optional
+
+from kserve_vllm_mini_tpu.sweeps import base
+
+DEFAULT_GRID: dict[str, list[Any]] = {
+    "concurrency": [5, 10, 20],
+    "max_tokens": [32, 64, 128],
+    "pattern": ["steady", "poisson", "bursty"],
+}
+
+CONFIG_KEYS = ["pattern", "concurrency", "max_tokens"]
+
+
+def run_grid(
+    base_profile: dict[str, Any],
+    out_dir: Path,
+    grid: Optional[dict[str, list[Any]]] = None,
+    bench_fn: Optional[base.BenchFn] = None,
+    url: Optional[str] = None,
+) -> list[dict[str, Any]]:
+    grid = grid or DEFAULT_GRID
+    configs = base.grid_product(grid)
+    bench = bench_fn or base.default_bench_fn(base_profile, self_serve=url is None, url=url)
+    csv_path = Path(out_dir) / "sweep_results.csv"
+    rows = base.run_sweep(configs, bench, csv_path, CONFIG_KEYS, label="grid-sweep")
+
+    print("\ntop throughput:", file=sys.stderr)
+    for r in base.summarize_top(rows, "throughput_rps", minimize=False):
+        print(
+            f"  {r['pattern']} conc={r['concurrency']} tok={r['max_tokens']}"
+            f" -> {float(r['throughput_rps']):.2f} rps, p95 {float(r['p95_ms'] or 0):.0f} ms",
+            file=sys.stderr,
+        )
+    print("lowest p95:", file=sys.stderr)
+    for r in base.summarize_top(rows, "p95_ms", minimize=True):
+        print(
+            f"  {r['pattern']} conc={r['concurrency']} tok={r['max_tokens']}"
+            f" -> p95 {float(r['p95_ms']):.0f} ms",
+            file=sys.stderr,
+        )
+    return rows

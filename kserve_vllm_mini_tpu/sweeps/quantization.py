@@ -1,0 +1,140 @@
+"""Quantization x kv-dtype x decoding sweep with Pareto analysis
+(reference sweeps/quantization_sweep.py).
+
+The reference sweeps vLLM quantization modes (none/fp8/awq/gptq) by
+redeploying container images with env knobs (quantization_sweep.py:40-234).
+Here the quantization is done by our own runtime (ops/quant.py int8
+weight-only; kv-cache dtype is an engine knob), each configuration serves
+once and is measured for latency/cost AND quality on the same server — then
+the multi-objective Pareto frontier (p95, $/1K tok vs quality, tokens/s)
+and 3-axis bucket classification mirror quantization_sweep.py:510-549 via
+quality.evaluator.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+from typing import Any, Optional
+
+from kserve_vllm_mini_tpu.sweeps import base
+
+DEFAULT_SPACE: dict[str, list[Any]] = {
+    "quantization": ["none", "int8"],
+    "kv_cache_dtype": ["model", "float32"],
+    "decoding": ["greedy", "sampled"],
+}
+
+DECODING_PRESETS: dict[str, dict[str, Any]] = {
+    "greedy": {"temperature": 0.0},
+    "sampled": {"temperature": 0.7, "extra_body": {"top_p": 0.95}},
+}
+
+CONFIG_KEYS = ["quantization", "kv_cache_dtype", "decoding"]
+
+
+def make_local_bench(
+    base_profile: dict[str, Any], with_quality: bool = True
+) -> base.BenchFn:
+    def bench(cfg: dict[str, Any]) -> dict[str, Any]:
+        from kserve_vllm_mini_tpu.bench_pipeline import run_bench
+        from kserve_vllm_mini_tpu.runtime.local import local_server
+
+        profile = {**base_profile}
+        profile["quantization"] = cfg["quantization"]
+        if cfg.get("kv_cache_dtype") and cfg["kv_cache_dtype"] != "model":
+            profile["kv_cache_dtype"] = cfg["kv_cache_dtype"]
+        profile.update(DECODING_PRESETS.get(cfg.get("decoding", "greedy"), {}))
+
+        # one server boot serves both the load test and the quality eval —
+        # the reference pays a full redeploy per config (quantization_sweep
+        # .py:226-234); in-process we pay one XLA compile
+        with local_server(profile) as srv:
+            results, code = run_bench(url=srv.url, profile=profile)
+            if not results:
+                raise RuntimeError(f"bench failed with exit code {code}")
+            if with_quality:
+                from kserve_vllm_mini_tpu.quality.evaluator import evaluate
+
+                results.update(evaluate(srv.url, model=profile.get("model", "default")))
+        return results
+
+    return bench
+
+
+def _extra(cfg: dict[str, Any], results: dict[str, Any]) -> dict[str, Any]:
+    return {
+        "quality_score": results.get("quality_score"),
+        "pareto": "",     # filled after the full sweep
+        "bucket": "",
+    }
+
+
+def run_quantization(
+    base_profile: dict[str, Any],
+    out_dir: Path,
+    space: Optional[dict[str, list[Any]]] = None,
+    bench_fn: Optional[base.BenchFn] = None,
+    with_quality: bool = True,
+) -> list[dict[str, Any]]:
+    from kserve_vllm_mini_tpu.quality.evaluator import (
+        classify_pareto_bucket,
+        pareto_frontier,
+    )
+
+    space = space or DEFAULT_SPACE
+    configs = base.grid_product(space)
+    bench = bench_fn or make_local_bench(base_profile, with_quality=with_quality)
+    out_dir = Path(out_dir)
+    csv_path = out_dir / "quant_sweep.csv"
+    rows = base.run_sweep(
+        configs, bench, csv_path, CONFIG_KEYS, extra_row_fn=_extra, label="quant-sweep"
+    )
+
+    # post-pass: Pareto frontier + buckets over the successful rows
+    ok_rows = [r for r in rows if r.get("status") == "ok"]
+    points = [
+        {
+            "p95_ms": float(r.get("p95_ms") or 0),
+            "cost_per_1k_tokens": float(r.get("cost_per_1k_tokens") or 0),
+            "quality_score": float(r.get("quality_score") or 0),
+            "tokens_per_sec": float(r.get("tokens_per_sec") or 0),
+        }
+        for r in ok_rows
+    ]
+    frontier = set(
+        pareto_frontier(
+            points,
+            minimize=("p95_ms", "cost_per_1k_tokens"),
+            maximize=("quality_score", "tokens_per_sec"),
+        )
+    )
+    for i, r in enumerate(ok_rows):
+        r["pareto"] = "yes" if i in frontier else ""
+        r["bucket"] = classify_pareto_bucket(
+            points[i]["quality_score"], points[i]["p95_ms"], points[i]["cost_per_1k_tokens"]
+        )
+
+    # rewrite the CSV with pareto/bucket populated (flush-per-row kept the
+    # partial data safe; this final write is the enriched version)
+    if csv_path.exists():
+        csv_path.unlink()
+    fieldnames = (
+        CONFIG_KEYS + list(base.RESULT_KEYS) + sorted(_extra({}, {})) + ["status", "error", "elapsed_s"]
+    )
+    for r in rows:
+        base.write_row(csv_path, r, fieldnames)
+
+    summary = {
+        "configs": len(rows),
+        "succeeded": len(ok_rows),
+        "pareto_optimal": [
+            {k: ok_rows[i].get(k) for k in CONFIG_KEYS + ["p95_ms", "cost_per_1k_tokens", "quality_score"]}
+            for i in sorted(frontier)
+        ],
+    }
+    (out_dir / "quant_sweep_summary.json").write_text(json.dumps(summary, indent=2))
+    for p in summary["pareto_optimal"]:
+        print(f"quant-sweep: pareto-optimal: {p}", file=sys.stderr)
+    return rows

@@ -1,0 +1,120 @@
+"""``kvmini-tpu sweep {grid,autoscale,topology,quantization}`` CLI."""
+
+from __future__ import annotations
+
+import argparse
+from pathlib import Path
+from typing import Any
+
+import yaml
+
+
+def register(parser: argparse.ArgumentParser) -> None:
+    sub = parser.add_subparsers(dest="kind", required=True)
+
+    common = argparse.ArgumentParser(add_help=False)
+    common.add_argument("--profile", default=None, help="Base profile YAML")
+    common.add_argument("--out-dir", default="runs/sweep", help="CSV/summary output dir")
+    common.add_argument("--model", default=None)
+    common.add_argument("--requests", type=int, default=None)
+    common.add_argument("--concurrency", type=int, default=None)
+    common.add_argument("--url", default=None,
+                        help="Benchmark an existing endpoint instead of self-serving")
+
+    g = sub.add_parser("grid", parents=[common],
+                       help="concurrency x max_tokens x pattern")
+    g.add_argument("--concurrencies", default="5,10,20")
+    g.add_argument("--max-tokens-list", default="32,64,128")
+    g.add_argument("--patterns", default="steady,poisson,bursty")
+
+    a = sub.add_parser("autoscale", parents=[common],
+                       help="capacity knobs: slots x initial-scale x grace")
+    a.add_argument("--container-concurrencies", default="4,8")
+    a.add_argument("--initial-scales", default="0,1")
+    a.add_argument("--grace-periods", default="30,300")
+
+    t = sub.add_parser("topology", parents=[common],
+                       help="TPU slice matrix (v5e-1/-4/-8), the MIG analog")
+    t.add_argument("--topologies", default="v5e-1,v5e-4,v5e-8")
+
+    q = sub.add_parser("quantization", parents=[common],
+                       help="quantization x kv-dtype x decoding, Pareto analysis")
+    q.add_argument("--quantizations", default="none,int8")
+    q.add_argument("--kv-dtypes", default="model,float32")
+    q.add_argument("--decodings", default="greedy,sampled")
+    q.add_argument("--no-quality", action="store_true",
+                   help="Skip the quality-eval pass per config")
+
+
+def _base_profile(args: argparse.Namespace) -> dict[str, Any]:
+    profile: dict[str, Any] = {}
+    if args.profile:
+        with open(args.profile) as f:
+            profile = yaml.safe_load(f) or {}
+    for key in ("model", "requests", "concurrency"):
+        v = getattr(args, key, None)
+        if v is not None:
+            profile[key] = v
+    profile.setdefault("model", "llama-tiny")
+    profile.setdefault("requests", 30)
+    profile.setdefault("concurrency", 8)
+    return profile
+
+
+def _csv_list(s: str, cast=str) -> list:
+    return [cast(x.strip()) for x in s.split(",") if x.strip()]
+
+
+def run(args: argparse.Namespace) -> int:
+    base_profile = _base_profile(args)
+    out_dir = Path(args.out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+
+    if args.kind == "grid":
+        from kserve_vllm_mini_tpu.sweeps.grid import run_grid
+
+        rows = run_grid(
+            base_profile,
+            out_dir,
+            grid={
+                "concurrency": _csv_list(args.concurrencies, int),
+                "max_tokens": _csv_list(args.max_tokens_list, int),
+                "pattern": _csv_list(args.patterns),
+            },
+            url=args.url,
+        )
+    elif args.kind == "autoscale":
+        from kserve_vllm_mini_tpu.sweeps.autoscale import run_autoscale
+
+        rows = run_autoscale(
+            base_profile,
+            out_dir,
+            space={
+                "container_concurrency": _csv_list(args.container_concurrencies, int),
+                "initial_scale": _csv_list(args.initial_scales, int),
+                "scale_to_zero_grace_s": _csv_list(args.grace_periods, int),
+            },
+        )
+    elif args.kind == "topology":
+        from kserve_vllm_mini_tpu.sweeps.topology import run_topology
+
+        rows = run_topology(base_profile, out_dir, topologies=_csv_list(args.topologies))
+    elif args.kind == "quantization":
+        from kserve_vllm_mini_tpu.sweeps.quantization import run_quantization
+
+        rows = run_quantization(
+            base_profile,
+            out_dir,
+            space={
+                "quantization": _csv_list(args.quantizations),
+                "kv_cache_dtype": _csv_list(args.kv_dtypes),
+                "decoding": _csv_list(args.decodings),
+            },
+            with_quality=not args.no_quality,
+        )
+    else:  # pragma: no cover — argparse enforces choices
+        return 2
+
+    failed = sum(1 for r in rows if r.get("status") != "ok")
+    print(f"sweep: {len(rows) - failed}/{len(rows)} configs succeeded -> {out_dir}")
+    return 0 if failed == 0 else 1

@@ -1,0 +1,68 @@
+"""Topology-slice sweep — the TPU analog of the reference MIG sweep.
+
+The reference benchmarks each NVIDIA MIG slice against the full GPU
+(sweeps/mig-sweep.sh:90-193, profiles/mig/*) to answer "how small a slice
+still meets the SLO". On TPU the partitioning axis is the pod slice: v5e-1
+vs v5e-4 vs v5e-8 (SURVEY.md §7.2 step 7). Each point re-serves the model
+over the corresponding ``jax.sharding.Mesh`` and the output matrix keeps the
+mig_matrix.csv shape the report's topology-matrix HTML consumes
+(report/html.py generate_topology_matrix_html).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any, Optional
+
+from kserve_vllm_mini_tpu.sweeps import base
+
+DEFAULT_TOPOLOGIES = ["v5e-1", "v5e-4", "v5e-8"]
+
+CONFIG_KEYS = ["topology", "chips"]
+
+
+def make_local_bench(base_profile: dict[str, Any]) -> base.BenchFn:
+    def bench(cfg: dict[str, Any]) -> dict[str, Any]:
+        from kserve_vllm_mini_tpu.bench_pipeline import run_bench
+
+        profile = {**base_profile}
+        profile["jax_topology"] = cfg["topology"]
+        profile["chips"] = cfg["chips"]
+        profile["accelerator"] = f"tpu-{cfg['topology']}"
+        results, code = run_bench(url=None, profile=profile, self_serve=True)
+        if not results:
+            raise RuntimeError(f"bench failed with exit code {code}")
+        return results
+
+    return bench
+
+
+def run_topology(
+    base_profile: dict[str, Any],
+    out_dir: Path,
+    topologies: Optional[list[str]] = None,
+    bench_fn: Optional[base.BenchFn] = None,
+) -> list[dict[str, Any]]:
+    from kserve_vllm_mini_tpu.parallel.mesh import TOPOLOGY_PRESETS
+
+    names = topologies or DEFAULT_TOPOLOGIES
+    configs = []
+    for name in names:
+        if name not in TOPOLOGY_PRESETS:
+            raise ValueError(f"unknown topology {name!r}; known: {sorted(TOPOLOGY_PRESETS)}")
+        configs.append({"topology": name, "chips": TOPOLOGY_PRESETS[name]["chips"]})
+    bench = bench_fn or make_local_bench(base_profile)
+    csv_path = Path(out_dir) / "topology_matrix.csv"
+    rows = base.run_sweep(configs, bench, csv_path, CONFIG_KEYS, label="topology-sweep")
+
+    import sys
+
+    best = base.summarize_top(rows, "tokens_per_sec_per_chip", minimize=False, n=1)
+    if best:
+        b = best[0]
+        print(
+            f"topology-sweep: most chip-efficient: {b['topology']}"
+            f" ({float(b['tokens_per_sec_per_chip']):.1f} tok/s/chip)",
+            file=sys.stderr,
+        )
+    return rows

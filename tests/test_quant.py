@@ -1,0 +1,94 @@
+"""Int8 weight-only quantization: math, model parity, sharding compat."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kserve_vllm_mini_tpu.models.config import get_config
+from kserve_vllm_mini_tpu.models.llama import forward, init_params
+from kserve_vllm_mini_tpu.ops.quant import (
+    dequantize_weight,
+    is_quantized,
+    linear,
+    quantize_params,
+    quantize_weight,
+    quantized_bytes,
+)
+
+
+def test_quantize_roundtrip_error_small():
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.normal(size=(64, 32)).astype(np.float32))
+    qw = quantize_weight(w)
+    assert qw["q"].dtype == jnp.int8
+    assert qw["s"].shape == (32,)
+    back = dequantize_weight(qw, dtype=jnp.float32)
+    # per-channel symmetric int8: max error is half a step = amax/254
+    amax = np.max(np.abs(np.asarray(w)), axis=0)
+    err = np.max(np.abs(np.asarray(back) - np.asarray(w)), axis=0)
+    assert np.all(err <= amax / 254.0 + 1e-6)
+
+
+def test_quantize_weight_stacked_layers():
+    w = jnp.ones((3, 8, 4)) * jnp.arange(1, 5)  # distinct per-out-channel scales
+    qw = quantize_weight(w)
+    assert qw["q"].shape == (3, 8, 4)
+    assert qw["s"].shape == (3, 4)
+    np.testing.assert_allclose(np.asarray(qw["s"]), np.tile(np.arange(1, 5) / 127.0, (3, 1)))
+
+
+def test_linear_dispatch():
+    x = jnp.ones((2, 8), dtype=jnp.float32)
+    w = jnp.full((8, 4), 0.5, dtype=jnp.float32)
+    plain = linear(x, w)
+    quant = linear(x, quantize_weight(w))
+    np.testing.assert_allclose(np.asarray(plain), np.asarray(quant), rtol=1e-2)
+
+
+def test_zero_weight_channel_no_nan():
+    w = jnp.zeros((8, 4))
+    qw = quantize_weight(w)
+    assert np.all(np.isfinite(np.asarray(qw["s"])))
+    assert np.all(np.asarray(dequantize_weight(qw)) == 0)
+
+
+def test_quantized_forward_close_to_dense():
+    cfg = get_config("llama-tiny", max_seq_len=64)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    qparams = quantize_params(params)
+    assert is_quantized(qparams["layers"]["wq"])
+    assert not is_quantized(qparams["layers"]["attn_norm"])
+
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (1, 16), 0, cfg.vocab_size)
+    positions = jnp.broadcast_to(jnp.arange(16), (1, 16)).astype(jnp.int32)
+    logits, _ = forward(params, cfg, tokens, positions)
+    qlogits, _ = forward(qparams, cfg, tokens, positions)
+    # top-1 agreement on most positions is the practical bar for W8A16
+    top = jnp.argmax(logits, -1)
+    qtop = jnp.argmax(qlogits, -1)
+    agree = float(jnp.mean((top == qtop).astype(jnp.float32)))
+    assert agree >= 0.75, f"greedy agreement {agree}"
+
+
+def test_quantized_bytes_smaller():
+    cfg = get_config("llama-tiny")
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    assert quantized_bytes(quantize_params(params)) < quantized_bytes(params)
+
+
+def test_shard_quantized_params():
+    from kserve_vllm_mini_tpu.parallel.mesh import MeshSpec, make_mesh
+    from kserve_vllm_mini_tpu.parallel.sharding import shard_params
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs the 8-device CPU mesh")
+    cfg = get_config("llama-tiny")
+    mesh = make_mesh(MeshSpec.fill(4, tp=4))
+    qparams = quantize_params(init_params(jax.random.PRNGKey(0), cfg))
+    sharded = shard_params(qparams, cfg, mesh)
+    # q sharded like the weight; s sharded along out — and still computes
+    tokens = jnp.zeros((1, 8), dtype=jnp.int32)
+    positions = jnp.broadcast_to(jnp.arange(8), (1, 8)).astype(jnp.int32)
+    logits, _ = forward(sharded, cfg, tokens, positions)
+    assert np.all(np.isfinite(np.asarray(logits)))
