@@ -34,11 +34,7 @@ def run_bench(
     idle_tax: str = "none",
 ) -> tuple[dict[str, Any], int]:
     """Returns (results, exit_code)."""
-    from kserve_vllm_mini_tpu.analysis.analyzer import analyze_run
-    from kserve_vllm_mini_tpu.costs.estimator import estimate_cost
-    from kserve_vllm_mini_tpu.costs.pricing import load_pricing
-    from kserve_vllm_mini_tpu.energy.collector import collect_power, integrate_energy
-    from kserve_vllm_mini_tpu.loadgen.runner import LoadConfig, run_load
+    from kserve_vllm_mini_tpu.energy.collector import collect_power
 
     if not url and not self_serve:
         print("bench: either --url or --self-serve is required", file=sys.stderr)
@@ -78,7 +74,11 @@ def run_bench(
         cold_window_s += server.boot_seconds
         print(f"bench: self-serve runtime up in {server.boot_seconds:.1f}s at {url}")
 
-    # Stage 1: load test with concurrent power sampling
+    # Stage 1: load test with concurrent power sampling. Everything from here
+    # to the SLO gate runs under try/finally: a failing stage must still stop
+    # the sampler and the self-serve engine (its decode-loop thread and KV
+    # cache would otherwise outlive the run — sweeps record-and-continue on
+    # failure, so a leak here skews every subsequent config).
     stop_sampling = threading.Event()
     sampler = threading.Thread(
         target=collect_power,
@@ -92,6 +92,54 @@ def run_bench(
         name="power-sampler",
     )
     sampler.start()
+
+    try:
+        return _run_stages(
+            profile,
+            url,
+            run_dir,
+            server,
+            cold_start_instants,
+            cold_window_s,
+            sampler,
+            stop_sampling,
+            prom_url=prom_url,
+            namespace=namespace,
+            service=service,
+            cost_file=cost_file,
+            chips=chips,
+            slo_file=slo_file,
+            idle_tax=idle_tax,
+        )
+    finally:
+        stop_sampling.set()
+        if server is not None:
+            server.stop()
+
+
+def _run_stages(
+    profile: dict[str, Any],
+    url: str,
+    run_dir: RunDir,
+    server,
+    cold_start_instants: list[float],
+    cold_window_s: float,
+    sampler: threading.Thread,
+    stop_sampling: threading.Event,
+    *,
+    prom_url: Optional[str],
+    namespace: Optional[str],
+    service: Optional[str],
+    cost_file: Optional[str],
+    chips: Optional[float],
+    slo_file: Optional[str],
+    idle_tax: str,
+) -> tuple[dict[str, Any], int]:
+    from kserve_vllm_mini_tpu.analysis.analyzer import analyze_run
+    from kserve_vllm_mini_tpu.costs.estimator import estimate_cost
+    from kserve_vllm_mini_tpu.costs.pricing import load_pricing
+    from kserve_vllm_mini_tpu.energy.collector import integrate_energy
+    from kserve_vllm_mini_tpu.loadgen.runner import LoadConfig, run_load
 
     cfg = LoadConfig(
         url=url,
@@ -148,6 +196,13 @@ def run_bench(
         cold_window_s=cold_window_s,
     )
 
+    # self-serve boot time is the run's measured cold start; persist it so
+    # downstream consumers (autoscale sweep deploy_time_s) can read it
+    if server is not None:
+        run_dir.merge_into_results(
+            {"cold_start_seconds": round(server.boot_seconds, 2)}
+        )
+
     # Stage 4: energy
     integrate_energy(run_dir, idle_tax=idle_tax)
 
@@ -170,8 +225,6 @@ def run_bench(
         print_table(verdicts)
         code = 0 if all(v.ok for v in verdicts) else 3
 
-    if server is not None:
-        server.stop()
     p95 = results.get("p95_ms")
     print(
         f"bench: done p95={p95:.1f}ms " if p95 is not None else "bench: done ",

@@ -1,0 +1,13 @@
+"""Deployment layer (L1): KServe InferenceService manifests for GKE TPU
+node pools, per-backend deploy specs, and cluster preflight checks.
+
+Replaces the reference's sed-patched isvc.yaml + per-backend deploy.sh
+(/root/reference/deploy.sh:91-99, runners/backends/*/deploy.sh) with
+structured manifest rendering and an injectable kubectl runner so the whole
+layer is unit-testable without a cluster (SURVEY.md §4.3 mock-kubectl
+pattern, §7.4 "no sed-based YAML patching").
+"""
+
+from kserve_vllm_mini_tpu.deploy.topology import TOPOLOGIES, TpuTopology, get_topology
+
+__all__ = ["TOPOLOGIES", "TpuTopology", "get_topology"]

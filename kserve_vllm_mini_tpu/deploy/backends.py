@@ -1,0 +1,154 @@
+"""Serving-backend registry: how each TPU runtime is containerized, flagged,
+and spoken to.
+
+The analog of the reference's runners/backends/{vllm,tgi,triton}/deploy.sh —
+but as data + one renderer instead of three divergent shell scripts
+(the drift between those scripts is called out in SURVEY.md §7.1). Each
+backend declares its image, port, readiness path, loadgen protocol adapter,
+and a function from BackendConfig -> container env, so tensor-parallel size,
+quantization, and context length are explicit knobs the sweeps can drive
+(reference vllm/deploy.sh:78-83 TENSOR_PARALLEL_SIZE / MAX_MODEL_LEN).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from kserve_vllm_mini_tpu.deploy.topology import TpuTopology, total_chips
+
+
+@dataclass
+class BackendConfig:
+    model_uri: str = ""
+    model_id: str = "meta-llama/Llama-3.1-8B-Instruct"
+    tensor_parallel: int = 0          # 0 => all chips in the slice
+    quantization: str = "none"        # none | int8 | fp8 | int4
+    kv_cache_dtype: str = "auto"
+    max_model_len: int = 4096
+    max_batch_size: int = 64
+    drafter_model_id: str = ""        # speculative decoding drafter
+    extra_env: dict[str, str] = field(default_factory=dict)
+
+    def effective_tp(self, topo: TpuTopology) -> int:
+        return self.tensor_parallel or total_chips(topo)
+
+
+@dataclass(frozen=True)
+class Backend:
+    name: str
+    image: str
+    port: int
+    protocol: str                     # loadgen adapter: openai | jetstream | kserve_v2
+    readiness_path: str
+    env_fn: Callable[[BackendConfig, TpuTopology], dict[str, str]]
+    args_fn: Callable[[BackendConfig, TpuTopology], list[str]] = lambda c, t: []
+
+
+def _jetstream_env(cfg: BackendConfig, topo: TpuTopology) -> dict[str, str]:
+    env = {
+        "MODEL_ID": cfg.model_id,
+        "TOKENIZER_PATH": cfg.model_uri or cfg.model_id,
+        "TPU_CHIPS": str(total_chips(topo)),
+        "ICI_TENSOR_PARALLELISM": str(cfg.effective_tp(topo)),
+        "MAX_PREFILL_LENGTH": str(cfg.max_model_len // 2),
+        "MAX_TARGET_LENGTH": str(cfg.max_model_len),
+        "BATCH_SIZE": str(cfg.max_batch_size),
+    }
+    if cfg.quantization != "none":
+        env["QUANTIZATION"] = cfg.quantization   # jetstream int8 weight/kv configs
+        env["QUANTIZE_KVCACHE"] = "true" if cfg.kv_cache_dtype != "auto" else "false"
+    if cfg.drafter_model_id:
+        env["DRAFTER_MODEL_ID"] = cfg.drafter_model_id
+    env.update(cfg.extra_env)
+    return env
+
+
+def _vllm_tpu_env(cfg: BackendConfig, topo: TpuTopology) -> dict[str, str]:
+    env = {
+        "MODEL_ID": cfg.model_id,
+        "VLLM_TENSOR_PARALLEL_SIZE": str(cfg.effective_tp(topo)),
+        "MAX_MODEL_LEN": str(cfg.max_model_len),
+        "VLLM_USE_V1": "1",
+    }
+    if cfg.model_uri:
+        env["MODEL_URI"] = cfg.model_uri
+    if cfg.quantization != "none":
+        env["QUANTIZATION"] = cfg.quantization
+    if cfg.kv_cache_dtype != "auto":
+        env["KV_CACHE_DTYPE"] = cfg.kv_cache_dtype
+    env.update(cfg.extra_env)
+    return env
+
+
+def _vllm_tpu_args(cfg: BackendConfig, topo: TpuTopology) -> list[str]:
+    args = [
+        f"--model={cfg.model_uri or cfg.model_id}",
+        f"--tensor-parallel-size={cfg.effective_tp(topo)}",
+        f"--max-model-len={cfg.max_model_len}",
+        f"--max-num-seqs={cfg.max_batch_size}",
+    ]
+    if cfg.quantization != "none":
+        args.append(f"--quantization={cfg.quantization}")
+    if cfg.kv_cache_dtype != "auto":
+        args.append(f"--kv-cache-dtype={cfg.kv_cache_dtype}")
+    if cfg.drafter_model_id:
+        args.append(f"--speculative-model={cfg.drafter_model_id}")
+    return args
+
+
+def _jax_native_env(cfg: BackendConfig, topo: TpuTopology) -> dict[str, str]:
+    """The in-repo runtime (runtime/server.py) packaged as a container."""
+    env = {
+        "KVMINI_MODEL_ID": cfg.model_id,
+        "KVMINI_MODEL_URI": cfg.model_uri or cfg.model_id,
+        "KVMINI_TP": str(cfg.effective_tp(topo)),
+        "KVMINI_MAX_MODEL_LEN": str(cfg.max_model_len),
+        "KVMINI_MAX_BATCH": str(cfg.max_batch_size),
+        "KVMINI_QUANTIZATION": cfg.quantization,
+    }
+    if cfg.drafter_model_id:
+        env["KVMINI_DRAFTER"] = cfg.drafter_model_id
+    env.update(cfg.extra_env)
+    return env
+
+
+BACKENDS: dict[str, Backend] = {
+    b.name: b
+    for b in (
+        Backend(
+            "jetstream",
+            image="us-docker.pkg.dev/cloud-tpu-images/inference/jetstream-maxtext:latest",
+            port=9000,
+            protocol="jetstream",
+            readiness_path="/v1/health",
+            env_fn=_jetstream_env,
+        ),
+        Backend(
+            "vllm-tpu",
+            image="vllm/vllm-tpu:latest",
+            port=8000,
+            protocol="openai",
+            readiness_path="/health",
+            env_fn=_vllm_tpu_env,
+            args_fn=_vllm_tpu_args,
+        ),
+        Backend(
+            "jax-native",
+            image="kvmini-tpu/runtime:latest",
+            port=8000,
+            protocol="openai",
+            readiness_path="/healthz",   # runtime/server.py registers GET /healthz
+            env_fn=_jax_native_env,
+        ),
+    )
+}
+
+
+def get_backend(name: str) -> Backend:
+    try:
+        return BACKENDS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown backend {name!r} (known: {', '.join(sorted(BACKENDS))})"
+        ) from None

@@ -1,0 +1,122 @@
+"""Injectable kubectl runner for the deploy/compare/chaos layers.
+
+The reference shells out to kubectl everywhere and its CI replaces the
+binary with a stub script (SURVEY.md §4.3) — here the substitution point is
+a Python callable instead, so tests inject a fake without touching PATH.
+All real calls degrade gracefully: no kubectl / no cluster -> KubectlResult
+with ok=False, never an exception (reference analyze.py:29-31 pattern).
+"""
+
+from __future__ import annotations
+
+import shutil
+import subprocess
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional, Sequence
+
+
+@dataclass
+class KubectlResult:
+    ok: bool
+    stdout: str = ""
+    stderr: str = ""
+    returncode: int = -1
+
+
+# signature: (args, stdin_text, timeout_s) -> KubectlResult
+KubectlFn = Callable[[Sequence[str], Optional[str], float], KubectlResult]
+
+
+def real_kubectl(
+    args: Sequence[str], stdin_text: Optional[str] = None, timeout_s: float = 60.0
+) -> KubectlResult:
+    if shutil.which("kubectl") is None:
+        return KubectlResult(False, stderr="kubectl not found on PATH")
+    try:
+        proc = subprocess.run(
+            ["kubectl", *args],
+            input=stdin_text,
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+        )
+    except (subprocess.TimeoutExpired, OSError) as e:
+        return KubectlResult(False, stderr=str(e))
+    return KubectlResult(
+        proc.returncode == 0, proc.stdout, proc.stderr, proc.returncode
+    )
+
+
+class Kubectl:
+    """Thin stateful wrapper bound to one runner function."""
+
+    def __init__(self, runner: KubectlFn = real_kubectl):
+        self._run = runner
+
+    def run(
+        self,
+        args: Sequence[str],
+        stdin_text: Optional[str] = None,
+        timeout_s: float = 60.0,
+    ) -> KubectlResult:
+        return self._run(args, stdin_text, timeout_s)
+
+    def apply(self, manifest_yaml: str, namespace: Optional[str] = None) -> KubectlResult:
+        args = ["apply", "-f", "-"]
+        if namespace:
+            args += ["-n", namespace]
+        return self.run(args, stdin_text=manifest_yaml)
+
+    def delete(
+        self, kind: str, name: str, namespace: str, ignore_not_found: bool = True
+    ) -> KubectlResult:
+        args = ["delete", kind, name, "-n", namespace, "--wait=false"]
+        if ignore_not_found:
+            args.append("--ignore-not-found=true")
+        return self.run(args)
+
+    def ensure_namespace(self, namespace: str) -> KubectlResult:
+        res = self.run(["get", "namespace", namespace])
+        if res.ok:
+            return res
+        return self.run(["create", "namespace", namespace])
+
+    def wait_ready(
+        self, kind: str, name: str, namespace: str, timeout_s: float = 600.0
+    ) -> KubectlResult:
+        return self.run(
+            [
+                "wait",
+                f"--for=condition=Ready",
+                f"{kind}/{name}",
+                "-n",
+                namespace,
+                f"--timeout={int(timeout_s)}s",
+            ],
+            timeout_s=timeout_s + 30.0,
+        )
+
+    def isvc_url(self, name: str, namespace: str) -> Optional[str]:
+        res = self.run(
+            [
+                "get",
+                "inferenceservice",
+                name,
+                "-n",
+                namespace,
+                "-o",
+                "jsonpath={.status.url}",
+            ]
+        )
+        url = res.stdout.strip()
+        return url or None if res.ok else None
+
+    def wait_ready_timed(
+        self, kind: str, name: str, namespace: str, timeout_s: float = 600.0
+    ) -> tuple[KubectlResult, float]:
+        """wait_ready plus elapsed seconds — MTTR / deploy-time instrument
+        (reference chaos_harness.sh:99-109 wall-clocks `kubectl wait`)."""
+        t0 = time.time()
+        res = self.wait_ready(kind, name, namespace, timeout_s)
+        return res, time.time() - t0

@@ -1,0 +1,233 @@
+"""InferenceService manifest rendering + deploy orchestration.
+
+Replaces the reference's sed-patched template (deploy.sh:91-99, isvc.yaml) —
+manifests are built as Python dicts and serialized once, so every knob is a
+typed parameter and nothing depends on the template's line layout. TPU
+scheduling follows GKE conventions: nodeSelector on
+``cloud.google.com/gke-tpu-accelerator`` + ``gke-tpu-topology`` and a
+``google.com/tpu`` chip resource (SURVEY.md §7.2.6).
+
+Deploy flow mirrors reference deploy.sh:86-130: ensure namespace -> apply ->
+wait Ready (timed: TPU pools cold-start in minutes, SURVEY.md §7.3.4) ->
+resolve URL -> smoke request.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import yaml
+
+from kserve_vllm_mini_tpu.deploy.backends import Backend, BackendConfig, get_backend
+from kserve_vllm_mini_tpu.deploy.kubectl import Kubectl
+from kserve_vllm_mini_tpu.deploy.topology import TpuTopology, get_topology
+
+
+@dataclass
+class DeploySpec:
+    name: str
+    namespace: str = "kvmini-tpu"
+    backend: str = "jax-native"
+    topology: str = "v5e-8"
+    config: BackendConfig = field(default_factory=BackendConfig)
+    # Knative autoscaling knobs — the autoscale sweep's dimensions
+    # (reference sweeps/autoscale-sweep.sh:25-29)
+    min_scale: int = 0
+    max_scale: int = 3
+    container_concurrency: int = 0
+    scale_to_zero_grace: str = ""        # e.g. "30s"
+    stable_window: str = ""              # e.g. "60s"
+    panic_window_pct: str = ""           # e.g. "10.0"
+    cpu: str = "8"
+    memory: str = "32Gi"
+    service_account: str = ""
+
+
+def render_isvc(spec: DeploySpec) -> dict[str, Any]:
+    backend = get_backend(spec.backend)
+    topo = get_topology(spec.topology)
+    annotations: dict[str, str] = {
+        "autoscaling.knative.dev/min-scale": str(spec.min_scale),
+        "autoscaling.knative.dev/max-scale": str(spec.max_scale),
+    }
+    if spec.scale_to_zero_grace:
+        annotations["autoscaling.knative.dev/scale-to-zero-grace-period"] = (
+            spec.scale_to_zero_grace
+        )
+    if spec.stable_window:
+        annotations["autoscaling.knative.dev/window"] = spec.stable_window
+    if spec.panic_window_pct:
+        annotations["autoscaling.knative.dev/panic-window-percentage"] = (
+            spec.panic_window_pct
+        )
+
+    env = backend.env_fn(spec.config, topo)
+    args = backend.args_fn(spec.config, topo)
+    container: dict[str, Any] = {
+        "name": "kserve-container",
+        "image": backend.image,
+        "env": [{"name": k, "value": v} for k, v in sorted(env.items())],
+        "ports": [{"containerPort": backend.port, "protocol": "TCP"}],
+        "readinessProbe": {
+            "httpGet": {"path": backend.readiness_path, "port": backend.port},
+            "initialDelaySeconds": 30,
+            "periodSeconds": 10,
+            # model load + XLA compile can take minutes on a fresh pool
+            "failureThreshold": 60,
+        },
+        "resources": {
+            "requests": {
+                "cpu": spec.cpu,
+                "memory": spec.memory,
+                "google.com/tpu": str(topo.chips),
+            },
+            "limits": {"google.com/tpu": str(topo.chips)},
+        },
+    }
+    if args:
+        container["args"] = args
+
+    predictor: dict[str, Any] = {
+        "containers": [container],
+        "nodeSelector": {
+            "cloud.google.com/gke-tpu-accelerator": topo.accelerator,
+            "cloud.google.com/gke-tpu-topology": topo.topology,
+        },
+    }
+    if spec.container_concurrency:
+        predictor["containerConcurrency"] = spec.container_concurrency
+    if spec.service_account:
+        predictor["serviceAccountName"] = spec.service_account
+    if topo.hosts > 1:
+        # multi-host slice: KServe schedules the leader; workers join via
+        # the JobSet/LeaderWorkerSet machinery GKE provides for TPU pods.
+        predictor["workerSpec"] = {
+            "size": topo.hosts - 1,
+            "nodeSelector": dict(predictor["nodeSelector"]),
+        }
+
+    return {
+        "apiVersion": "serving.kserve.io/v1beta1",
+        "kind": "InferenceService",
+        "metadata": {
+            "name": spec.name,
+            "namespace": spec.namespace,
+            "annotations": annotations,
+            "labels": {
+                "app.kubernetes.io/managed-by": "kvmini-tpu",
+                "kvmini-tpu/backend": spec.backend,
+                "kvmini-tpu/topology": spec.topology,
+            },
+        },
+        "spec": {"predictor": predictor},
+    }
+
+
+def render_yaml(spec: DeploySpec) -> str:
+    return yaml.safe_dump(render_isvc(spec), sort_keys=False, default_flow_style=False)
+
+
+@dataclass
+class DeployOutcome:
+    ok: bool
+    url: Optional[str] = None
+    deploy_seconds: float = 0.0
+    error: str = ""
+
+
+def deploy(
+    spec: DeploySpec,
+    kubectl: Optional[Kubectl] = None,
+    wait_timeout_s: float = 900.0,
+) -> DeployOutcome:
+    kc = kubectl or Kubectl()
+    ns = kc.ensure_namespace(spec.namespace)
+    if not ns.ok:
+        return DeployOutcome(False, error=f"namespace: {ns.stderr.strip()}")
+    applied = kc.apply(render_yaml(spec), namespace=spec.namespace)
+    if not applied.ok:
+        return DeployOutcome(False, error=f"apply: {applied.stderr.strip()}")
+    waited, elapsed = kc.wait_ready_timed(
+        "inferenceservice", spec.name, spec.namespace, wait_timeout_s
+    )
+    if not waited.ok:
+        return DeployOutcome(
+            False, deploy_seconds=elapsed, error=f"wait: {waited.stderr.strip()}"
+        )
+    url = kc.isvc_url(spec.name, spec.namespace)
+    return DeployOutcome(True, url=url, deploy_seconds=elapsed)
+
+
+def teardown(spec: DeploySpec, kubectl: Optional[Kubectl] = None) -> bool:
+    kc = kubectl or Kubectl()
+    return kc.delete("inferenceservice", spec.name, spec.namespace).ok
+
+
+def spec_from_args(args: argparse.Namespace) -> DeploySpec:
+    cfg = BackendConfig(
+        model_uri=args.model_uri or "",
+        model_id=args.model_id,
+        tensor_parallel=args.tensor_parallel,
+        quantization=args.quantization,
+        max_model_len=args.max_model_len,
+        drafter_model_id=args.drafter or "",
+    )
+    return DeploySpec(
+        name=args.name,
+        namespace=args.namespace,
+        backend=args.backend,
+        topology=args.topology,
+        config=cfg,
+        min_scale=args.min_scale,
+        max_scale=args.max_scale,
+        container_concurrency=args.container_concurrency,
+    )
+
+
+# -- CLI ---------------------------------------------------------------------
+
+def register(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument("--name", default="kvmini-llm")
+    parser.add_argument("--namespace", default="kvmini-tpu")
+    parser.add_argument("--backend", default="jax-native",
+                        help="jetstream | vllm-tpu | jax-native")
+    parser.add_argument("--topology", default="v5e-8",
+                        help="TPU slice (v5e-1/v5e-4/v5e-8/v5p-8/v5p-16/v6e-8)")
+    parser.add_argument("--model-uri", default=None, help="gs:// or s3:// model store")
+    parser.add_argument("--model-id", default="meta-llama/Llama-3.1-8B-Instruct")
+    parser.add_argument("--tensor-parallel", type=int, default=0,
+                        help="TP size (0 = all chips in the slice)")
+    parser.add_argument("--quantization", default="none")
+    parser.add_argument("--max-model-len", type=int, default=4096)
+    parser.add_argument("--drafter", default=None, help="speculative-decoding draft model")
+    parser.add_argument("--min-scale", type=int, default=0)
+    parser.add_argument("--max-scale", type=int, default=3)
+    parser.add_argument("--container-concurrency", type=int, default=0)
+    parser.add_argument("--render-only", action="store_true",
+                        help="print the manifest, do not touch a cluster")
+    parser.add_argument("--teardown", action="store_true", help="delete the service")
+    parser.add_argument("--wait-timeout", type=float, default=900.0)
+    parser.add_argument("--json", action="store_true", help="machine-readable outcome")
+
+
+def run(args: argparse.Namespace) -> int:
+    spec = spec_from_args(args)
+    if args.render_only:
+        print(render_yaml(spec))
+        return 0
+    if args.teardown:
+        ok = teardown(spec)
+        print(f"deploy: teardown {'ok' if ok else 'FAILED'}")
+        return 0 if ok else 1
+    outcome = deploy(spec, wait_timeout_s=args.wait_timeout)
+    if args.json:
+        print(json.dumps(outcome.__dict__))
+    elif outcome.ok:
+        print(f"deploy: ready in {outcome.deploy_seconds:.1f}s at {outcome.url}")
+    else:
+        print(f"deploy: FAILED: {outcome.error}", file=sys.stderr)
+    return 0 if outcome.ok else 1

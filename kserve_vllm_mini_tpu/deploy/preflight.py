@@ -1,0 +1,115 @@
+"""Pre-deploy environment checks.
+
+Behavioral spec: /root/reference/scripts/preflight-checks.sh:25-53 (kubectl
+present, context reachable, KServe CRDs, accelerator nodes, object-store
+creds) with TPU substitutions: accelerator nodes are located by the
+``cloud.google.com/gke-tpu-accelerator`` label and the local path checks
+that JAX can enumerate devices (there is no nvidia-smi analog — the device
+census IS the probe). Each check is data, so callers (bench stage 0, the
+chaos harness) can gate on severity rather than parsing text.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from dataclasses import dataclass
+from typing import Optional
+
+from kserve_vllm_mini_tpu.deploy.kubectl import Kubectl
+
+
+@dataclass
+class Check:
+    name: str
+    ok: bool
+    required: bool
+    detail: str = ""
+
+
+def _cluster_checks(kc: Kubectl) -> list[Check]:
+    checks: list[Check] = []
+    ctx = kc.run(["config", "current-context"])
+    checks.append(
+        Check("kubectl-context", ctx.ok, True,
+              ctx.stdout.strip() or ctx.stderr.strip())
+    )
+    if not ctx.ok:
+        return checks
+
+    api = kc.run(["get", "--raw", "/healthz"], timeout_s=15.0)
+    checks.append(Check("cluster-reachable", api.ok, True, api.stderr.strip()))
+
+    crd = kc.run(["get", "crd", "inferenceservices.serving.kserve.io"])
+    checks.append(Check("kserve-crd", crd.ok, True, crd.stderr.strip()))
+
+    nodes = kc.run(
+        ["get", "nodes", "-l", "cloud.google.com/gke-tpu-accelerator",
+         "-o", "jsonpath={.items[*].metadata.name}"]
+    )
+    tpu_nodes = nodes.stdout.split() if nodes.ok else []
+    checks.append(
+        Check("tpu-nodes", bool(tpu_nodes), False,
+              f"{len(tpu_nodes)} TPU node(s)" if nodes.ok else nodes.stderr.strip())
+    )
+
+    secret = kc.run(["get", "secret", "storage-config", "-n", "kvmini-tpu"])
+    checks.append(
+        Check("storage-credentials", secret.ok, False,
+              "" if secret.ok else "no storage-config secret (ok for public models)")
+    )
+    return checks
+
+
+def _local_checks() -> list[Check]:
+    checks: list[Check] = []
+    try:
+        import jax
+
+        devices = jax.devices()
+        kinds = sorted({d.platform for d in devices})
+        checks.append(
+            Check("jax-devices", True, True,
+                  f"{len(devices)} device(s): {', '.join(kinds)}")
+        )
+        checks.append(
+            Check("tpu-present", any(d.platform == "tpu" for d in devices), False,
+                  "no TPU attached — runtime will run on " + ",".join(kinds))
+        )
+    except Exception as e:  # jax import or backend init failure
+        checks.append(Check("jax-devices", False, True, f"{type(e).__name__}: {e}"))
+    return checks
+
+
+def preflight(
+    mode: str = "cluster", kubectl: Optional[Kubectl] = None
+) -> list[Check]:
+    """mode: cluster | local | all."""
+    checks: list[Check] = []
+    if mode in ("cluster", "all"):
+        checks += _cluster_checks(kubectl or Kubectl())
+    if mode in ("local", "all"):
+        checks += _local_checks()
+    return checks
+
+
+def passed(checks: list[Check]) -> bool:
+    return all(c.ok for c in checks if c.required)
+
+
+# -- CLI ---------------------------------------------------------------------
+
+def register(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument("--mode", default="cluster", choices=("cluster", "local", "all"))
+    parser.add_argument("--json", action="store_true")
+
+
+def run(args: argparse.Namespace) -> int:
+    checks = preflight(args.mode)
+    if args.json:
+        print(json.dumps([c.__dict__ for c in checks], indent=2))
+    else:
+        for c in checks:
+            flag = "PASS" if c.ok else ("FAIL" if c.required else "warn")
+            print(f"[{flag:>4}] {c.name:<22} {c.detail}")
+    return 0 if passed(checks) else 1

@@ -1,0 +1,58 @@
+"""TPU topology catalog — the analog of the reference's MIG profile sheets.
+
+The reference partitions GPUs into MIG slices (profiles/mig/*.yaml,
+docs/MIG.md); on TPU the unit of partitioning is the *slice topology* of a
+GKE TPU node pool (SURVEY.md §2.2 "MIG's analog is TPU topology slices").
+Each entry maps a human name (``v5e-4``) to the GKE scheduling labels and
+the chip count used for resources, pricing, and the topology sweep.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class TpuTopology:
+    name: str               # human/sweep name, e.g. "v5e-4"
+    accelerator: str        # cloud.google.com/gke-tpu-accelerator label
+    topology: str           # cloud.google.com/gke-tpu-topology label
+    chips: int              # google.com/tpu resource per pod
+    hosts: int = 1          # pods in the multi-host set (>1 => v5p pods span hosts)
+    hbm_gib_per_chip: float = 16.0
+    tdp_w_per_chip: float = 170.0   # modeled-power fallback (energy provenance: modeled)
+
+
+# v5e: 16 GiB HBM/chip, single-host up to 8 chips. v5p: 95 GiB HBM/chip,
+# 4 chips/host, pods scale by adding hosts over ICI.
+TOPOLOGIES: dict[str, TpuTopology] = {
+    t.name: t
+    for t in (
+        TpuTopology("v5e-1", "tpu-v5-lite-podslice", "1x1", 1),
+        TpuTopology("v5e-4", "tpu-v5-lite-podslice", "2x2", 4),
+        TpuTopology("v5e-8", "tpu-v5-lite-podslice", "2x4", 8),
+        TpuTopology("v5p-8", "tpu-v5p-slice", "2x2x1", 4, hosts=2,
+                    hbm_gib_per_chip=95.0, tdp_w_per_chip=350.0),
+        TpuTopology("v5p-16", "tpu-v5p-slice", "2x2x2", 4, hosts=4,
+                    hbm_gib_per_chip=95.0, tdp_w_per_chip=350.0),
+        TpuTopology("v6e-8", "tpu-v6e-slice", "2x4", 8,
+                    hbm_gib_per_chip=32.0, tdp_w_per_chip=200.0),
+    )
+}
+
+
+def get_topology(name: str) -> TpuTopology:
+    try:
+        return TOPOLOGIES[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown TPU topology {name!r} (known: {', '.join(sorted(TOPOLOGIES))})"
+        ) from None
+
+
+def total_chips(t: TpuTopology) -> int:
+    return t.chips * t.hosts
+
+
+def total_hbm_gib(t: TpuTopology) -> float:
+    return total_chips(t) * t.hbm_gib_per_chip

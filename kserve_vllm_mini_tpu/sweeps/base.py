@@ -32,6 +32,20 @@ RESULT_KEYS = (
 )
 
 
+def sweep_fieldnames(
+    config_keys: list[str], extra_keys: Iterable[str] = ()
+) -> list[str]:
+    """Canonical CSV column layout for every sweep (and for post-hoc
+    rewrites of a sweep CSV — single source of truth, nothing reconstructs
+    this by hand)."""
+    return (
+        list(config_keys)
+        + list(RESULT_KEYS)
+        + sorted(extra_keys)
+        + ["status", "error", "elapsed_s"]
+    )
+
+
 def default_bench_fn(
     base: dict[str, Any],
     self_serve: bool = True,
@@ -83,11 +97,11 @@ def run_sweep(
 ) -> list[dict[str, Any]]:
     """The one loop all sweeps share. Failure rows record the error and the
     sweep continues (reference autoscale-sweep.sh:215-224)."""
-    fieldnames = config_keys + list(RESULT_KEYS) + ["status", "error", "elapsed_s"]
+    extra_keys: list[str] = []
     if extra_row_fn is not None:
         # extra columns appear between metrics and status
-        probe = extra_row_fn({}, {})
-        fieldnames = config_keys + list(RESULT_KEYS) + sorted(probe) + ["status", "error", "elapsed_s"]
+        extra_keys = list(extra_row_fn({}, {}))
+    fieldnames = sweep_fieldnames(config_keys, extra_keys)
     rows: list[dict[str, Any]] = []
     for i, cfg in enumerate(configs):
         desc = ", ".join(f"{k}={cfg[k]}" for k in sorted(cfg) if k in config_keys)

@@ -92,8 +92,14 @@ def run_quantization(
         configs, bench, csv_path, CONFIG_KEYS, extra_row_fn=_extra, label="quant-sweep"
     )
 
-    # post-pass: Pareto frontier + buckets over the successful rows
+    # post-pass: Pareto frontier + buckets over the successful rows. Quality
+    # participates only when it was actually measured — with --no-quality the
+    # score is absent and must not enter the frontier as 0.0 or drive bucket
+    # labels ("cheap-fast-degraded" for a quality that was never evaluated).
     ok_rows = [r for r in rows if r.get("status") == "ok"]
+    have_quality = with_quality and any(
+        r.get("quality_score") is not None for r in ok_rows
+    )
     points = [
         {
             "p95_ms": float(r.get("p95_ms") or 0),
@@ -103,26 +109,26 @@ def run_quantization(
         }
         for r in ok_rows
     ]
+    maximize = ("quality_score", "tokens_per_sec") if have_quality else ("tokens_per_sec",)
     frontier = set(
         pareto_frontier(
             points,
             minimize=("p95_ms", "cost_per_1k_tokens"),
-            maximize=("quality_score", "tokens_per_sec"),
+            maximize=maximize,
         )
     )
     for i, r in enumerate(ok_rows):
         r["pareto"] = "yes" if i in frontier else ""
-        r["bucket"] = classify_pareto_bucket(
-            points[i]["quality_score"], points[i]["p95_ms"], points[i]["cost_per_1k_tokens"]
-        )
+        if have_quality:
+            r["bucket"] = classify_pareto_bucket(
+                points[i]["quality_score"], points[i]["p95_ms"], points[i]["cost_per_1k_tokens"]
+            )
 
     # rewrite the CSV with pareto/bucket populated (flush-per-row kept the
     # partial data safe; this final write is the enriched version)
     if csv_path.exists():
         csv_path.unlink()
-    fieldnames = (
-        CONFIG_KEYS + list(base.RESULT_KEYS) + sorted(_extra({}, {})) + ["status", "error", "elapsed_s"]
-    )
+    fieldnames = base.sweep_fieldnames(CONFIG_KEYS, _extra({}, {}))
     for r in rows:
         base.write_row(csv_path, r, fieldnames)
 

@@ -18,11 +18,14 @@ def register(parser: argparse.ArgumentParser) -> None:
     common.add_argument("--model", default=None)
     common.add_argument("--requests", type=int, default=None)
     common.add_argument("--concurrency", type=int, default=None)
-    common.add_argument("--url", default=None,
-                        help="Benchmark an existing endpoint instead of self-serving")
 
     g = sub.add_parser("grid", parents=[common],
                        help="concurrency x max_tokens x pattern")
+    # only the grid sweep varies pure load knobs, so only it can target an
+    # existing endpoint; the other sweeps change server-side configuration
+    # per point and must boot their own runtime
+    g.add_argument("--url", default=None,
+                   help="Benchmark an existing endpoint instead of self-serving")
     g.add_argument("--concurrencies", default="5,10,20")
     g.add_argument("--max-tokens-list", default="32,64,128")
     g.add_argument("--patterns", default="steady,poisson,bursty")

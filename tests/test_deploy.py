@@ -1,0 +1,149 @@
+"""Deploy-layer tests: manifest rendering, topology catalog, deploy flow
+against a fake kubectl (the reference stubs the kubectl *binary* in CI,
+SURVEY.md §4.3; here the stub is an injected callable)."""
+
+from __future__ import annotations
+
+import yaml
+
+from kserve_vllm_mini_tpu.deploy.backends import BackendConfig, get_backend
+from kserve_vllm_mini_tpu.deploy.kubectl import Kubectl, KubectlResult
+from kserve_vllm_mini_tpu.deploy.manifests import (
+    DeploySpec,
+    deploy,
+    render_isvc,
+    render_yaml,
+    teardown,
+)
+from kserve_vllm_mini_tpu.deploy.preflight import Check, passed, preflight
+from kserve_vllm_mini_tpu.deploy.topology import get_topology, total_chips, total_hbm_gib
+
+
+class FakeKubectl:
+    """Records calls; scripted responses by leading verb."""
+
+    def __init__(self, fail_verbs: set[str] | None = None, url: str = "http://svc.example"):
+        self.calls: list[list[str]] = []
+        self.applied: list[str] = []
+        self.fail_verbs = fail_verbs or set()
+        self.url = url
+
+    def __call__(self, args, stdin_text=None, timeout_s=60.0) -> KubectlResult:
+        self.calls.append(list(args))
+        verb = args[0]
+        if verb in self.fail_verbs:
+            return KubectlResult(False, stderr=f"fake failure for {verb}")
+        if verb == "apply" and stdin_text:
+            self.applied.append(stdin_text)
+        if verb == "get" and "jsonpath={.status.url}" in " ".join(args):
+            return KubectlResult(True, stdout=self.url)
+        return KubectlResult(True, stdout="ok")
+
+
+def test_topology_catalog():
+    t = get_topology("v5e-8")
+    assert t.chips == 8 and t.hosts == 1
+    assert total_chips(t) == 8
+    v5p = get_topology("v5p-16")
+    assert total_chips(v5p) == 16
+    assert total_hbm_gib(v5p) == 16 * 95.0
+    try:
+        get_topology("h100")
+        assert False
+    except ValueError as e:
+        assert "unknown TPU topology" in str(e)
+
+
+def test_render_isvc_tpu_scheduling():
+    spec = DeploySpec(name="demo", backend="jax-native", topology="v5e-4")
+    isvc = render_isvc(spec)
+    pred = isvc["spec"]["predictor"]
+    container = pred["containers"][0]
+    assert container["resources"]["limits"]["google.com/tpu"] == "4"
+    assert pred["nodeSelector"]["cloud.google.com/gke-tpu-accelerator"] == "tpu-v5-lite-podslice"
+    assert pred["nodeSelector"]["cloud.google.com/gke-tpu-topology"] == "2x2"
+    assert "workerSpec" not in pred
+    # yaml round-trips
+    assert yaml.safe_load(render_yaml(spec))["metadata"]["name"] == "demo"
+
+
+def test_render_multihost_worker_spec():
+    spec = DeploySpec(name="big", backend="jetstream", topology="v5p-16")
+    pred = render_isvc(spec)["spec"]["predictor"]
+    # 4 hosts -> leader + 3 workers
+    assert pred["workerSpec"]["size"] == 3
+    assert pred["containers"][0]["resources"]["requests"]["google.com/tpu"] == "4"
+
+
+def test_backend_env_knobs():
+    topo = get_topology("v5e-8")
+    cfg = BackendConfig(quantization="int8", tensor_parallel=4,
+                        drafter_model_id="tiny-draft")
+    js = get_backend("jetstream")
+    env = js.env_fn(cfg, topo)
+    assert env["ICI_TENSOR_PARALLELISM"] == "4"
+    assert env["QUANTIZATION"] == "int8"
+    assert env["DRAFTER_MODEL_ID"] == "tiny-draft"
+    vllm = get_backend("vllm-tpu")
+    args = vllm.args_fn(cfg, topo)
+    assert "--tensor-parallel-size=4" in args
+    assert "--quantization=int8" in args
+    # tp defaults to the full slice
+    assert BackendConfig().effective_tp(topo) == 8
+
+
+def test_autoscale_annotations():
+    spec = DeploySpec(name="d", min_scale=1, max_scale=5,
+                      scale_to_zero_grace="30s", stable_window="60s",
+                      panic_window_pct="10.0", container_concurrency=4)
+    isvc = render_isvc(spec)
+    ann = isvc["metadata"]["annotations"]
+    assert ann["autoscaling.knative.dev/min-scale"] == "1"
+    assert ann["autoscaling.knative.dev/scale-to-zero-grace-period"] == "30s"
+    assert ann["autoscaling.knative.dev/window"] == "60s"
+    assert isvc["spec"]["predictor"]["containerConcurrency"] == 4
+
+
+def test_deploy_flow_with_fake_kubectl():
+    fake = FakeKubectl()
+    spec = DeploySpec(name="demo")
+    out = deploy(spec, kubectl=Kubectl(fake))
+    assert out.ok and out.url == "http://svc.example"
+    assert out.deploy_seconds >= 0.0
+    verbs = [c[0] for c in fake.calls]
+    assert "apply" in verbs and "wait" in verbs
+    assert yaml.safe_load(fake.applied[0])["kind"] == "InferenceService"
+    assert teardown(spec, kubectl=Kubectl(fake))
+
+
+def test_deploy_fails_gracefully():
+    fake = FakeKubectl(fail_verbs={"wait"})
+    out = deploy(DeploySpec(name="demo"), kubectl=Kubectl(fake))
+    assert not out.ok and "wait" in out.error
+
+
+def test_preflight_cluster_with_fake():
+    fake = FakeKubectl()
+    checks = preflight("cluster", kubectl=Kubectl(fake))
+    assert passed(checks)
+    names = {c.name for c in checks}
+    assert {"kubectl-context", "kserve-crd", "tpu-nodes"} <= names
+
+
+def test_preflight_no_cluster():
+    fake = FakeKubectl(fail_verbs={"config"})
+    checks = preflight("cluster", kubectl=Kubectl(fake))
+    assert not passed(checks)
+    assert len(checks) == 1  # short-circuits after context failure
+
+
+def test_preflight_local_jax():
+    checks = preflight("local")
+    by_name = {c.name: c for c in checks}
+    assert by_name["jax-devices"].ok  # conftest pins an 8-device CPU mesh
+    assert passed(checks)
+
+
+def test_check_severity():
+    assert passed([Check("a", True, True), Check("b", False, False)])
+    assert not passed([Check("a", False, True)])
