@@ -172,7 +172,7 @@ def _run_stages(
         {
             "accelerator": profile.get("accelerator"),
             "chips": chips or profile.get("chips", 1),
-            "runtime": "jax-native" if self_serve else profile.get("backend", "openai"),
+            "runtime": "jax-native" if server is not None else profile.get("backend", "openai"),
         }
     )
     run_dir.write_meta(meta)

@@ -1,0 +1,7 @@
+"""Backend comparison, API parity, and fairness harnesses (framework L6/L7).
+
+Analogs of the reference's runners/ab-compare.sh, scripts/compare_backends.py,
+scripts/openai_parity_probe.py, and scripts/fairness_dual_tenant.py — as
+typed, testable modules sharing the loadgen core instead of embedded shell
+python.
+"""

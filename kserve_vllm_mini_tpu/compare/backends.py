@@ -1,0 +1,309 @@
+"""A/B/C backend comparison under identical load.
+
+Reference behavior: runners/ab-compare.sh:142-394 deploys each backend
+serially, runs the same profile (optionally once streaming and once not),
+extracts a fixed metric row per run into a unified CSV, then computes
+per-metric winners into comparison_report.json;
+scripts/compare_backends.py:69-90 defines direction-aware winner selection.
+
+TPU-first differences: targets are either live endpoint URLs (any mix of
+jetstream / vllm-tpu / external), or the in-repo JAX runtime booted
+in-process (``self-serve``) — so a full comparison runs with no cluster at
+all. One bench path (bench_pipeline.run_bench) replaces the reference's three
+divergent invoke.sh clients, and the bench function is injectable for tests.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Callable, Optional
+
+# CSV row layout, mirroring the reference's unified CSV (ab-compare.sh:140)
+# with TPU additions (tokens_per_sec_per_chip, energy).
+COMPARE_CSV_COLUMNS = [
+    "backend",
+    "streaming",
+    "requests",
+    "concurrency",
+    "p50_ms",
+    "p95_ms",
+    "p99_ms",
+    "ttft_p50_ms",
+    "ttft_p95_ms",
+    "mean_ttft_ms",
+    "p95_tpot_ms",
+    "throughput_rps",
+    "tokens_per_sec",
+    "tokens_per_sec_per_chip",
+    "error_rate",
+    "cost_per_1k_tokens",
+    "energy_wh_per_1k_tokens",
+    "status",
+    "error",
+    "elapsed_s",
+]
+
+# metric -> direction for winner selection (compare_backends.py:69-90).
+# "min": lower is better.
+WINNER_METRICS: dict[str, str] = {
+    "p50_ms": "min",
+    "p95_ms": "min",
+    "p99_ms": "min",
+    "ttft_p50_ms": "min",
+    "ttft_p95_ms": "min",
+    "mean_ttft_ms": "min",
+    "p95_tpot_ms": "min",
+    "throughput_rps": "max",
+    "tokens_per_sec": "max",
+    "tokens_per_sec_per_chip": "max",
+    "error_rate": "min",
+    "cost_per_1k_tokens": "min",
+    "energy_wh_per_1k_tokens": "min",
+}
+
+
+@dataclass
+class CompareTarget:
+    """One contestant: a named backend and how to reach it."""
+
+    backend: str                     # display/registry name: jetstream | vllm-tpu | jax-native | ...
+    url: str = ""                    # live endpoint; "" => self-serve in-repo runtime
+    protocol: str = "openai"         # loadgen adapter name
+
+
+@dataclass
+class BackendRunResult:
+    """Typed result of one (backend, streaming) bench — the analog of the
+    reference's BackendResult dataclass (compare_backends.py:22-58)."""
+
+    backend: str
+    streaming: bool
+    results: dict[str, Any] = field(default_factory=dict)
+    status: str = "ok"
+    error: str = ""
+    elapsed_s: float = 0.0
+
+    def row(self) -> dict[str, Any]:
+        r = self.results
+        tpot = r.get("tpot_p95_ms", r.get("p95_tpot_ms"))
+        return {
+            "backend": self.backend,
+            "streaming": int(self.streaming),
+            "requests": r.get("requests"),
+            "concurrency": r.get("concurrency"),
+            "p50_ms": r.get("p50_ms"),
+            "p95_ms": r.get("p95_ms"),
+            "p99_ms": r.get("p99_ms"),
+            "ttft_p50_ms": r.get("ttft_p50_ms"),
+            "ttft_p95_ms": r.get("ttft_p95_ms"),
+            "mean_ttft_ms": r.get("ttft_avg_ms", r.get("ttft_p50_ms")),
+            "p95_tpot_ms": tpot,
+            "throughput_rps": r.get("throughput_rps"),
+            "tokens_per_sec": r.get("tokens_per_sec"),
+            "tokens_per_sec_per_chip": r.get("tokens_per_sec_per_chip"),
+            "error_rate": r.get("error_rate"),
+            "cost_per_1k_tokens": r.get("cost_per_1k_tokens"),
+            "energy_wh_per_1k_tokens": r.get("energy_wh_per_1k_tokens"),
+            "status": self.status,
+            "error": self.error,
+            "elapsed_s": round(self.elapsed_s, 2),
+        }
+
+
+# bench function: (target, profile, streaming) -> flat results dict.
+BenchTargetFn = Callable[[CompareTarget, dict[str, Any], bool], dict[str, Any]]
+
+
+def default_bench_target_fn(
+    cost_file: Optional[str] = None, prom_url: Optional[str] = None
+) -> BenchTargetFn:
+    def bench(target: CompareTarget, profile: dict[str, Any], streaming: bool) -> dict[str, Any]:
+        from kserve_vllm_mini_tpu.bench_pipeline import run_bench
+
+        merged = dict(profile)
+        merged["streaming"] = streaming
+        merged.setdefault("backend", target.protocol)
+        results, code = run_bench(
+            url=target.url or None,
+            profile=merged,
+            self_serve=not target.url,
+            cost_file=cost_file,
+            prom_url=prom_url,
+        )
+        if not results:
+            raise RuntimeError(f"bench exit code {code}")
+        return results
+
+    return bench
+
+
+def pick_winners(rows: list[dict[str, Any]]) -> dict[str, Any]:
+    """Per-metric winner across ok rows, split by streaming mode
+    (the reference compares streaming and non-streaming separately,
+    ab-compare.sh:290-394)."""
+    winners: dict[str, Any] = {}
+    for streaming in sorted({r.get("streaming") for r in rows}):
+        mode_rows = [
+            r for r in rows
+            if r.get("streaming") == streaming and r.get("status") == "ok"
+        ]
+        mode: dict[str, Any] = {}
+        for metric, direction in WINNER_METRICS.items():
+            scored = [
+                (float(r[metric]), r["backend"])
+                for r in mode_rows
+                if r.get(metric) not in (None, "")
+            ]
+            if not scored:
+                continue
+            best = min(scored) if direction == "min" else max(scored)
+            mode[metric] = {"backend": best[1], "value": best[0], "direction": direction}
+        if mode:
+            counts: dict[str, int] = {}
+            for w in mode.values():
+                counts[w["backend"]] = counts.get(w["backend"], 0) + 1
+            mode["overall"] = max(counts, key=counts.get)
+        winners[f"streaming={streaming}"] = mode
+    return winners
+
+
+def compare_backends(
+    targets: list[CompareTarget],
+    profile: dict[str, Any],
+    output_dir: Path,
+    streaming_modes: tuple[bool, ...] = (True, False),
+    bench_fn: Optional[BenchTargetFn] = None,
+    quiesce_s: float = 0.0,
+) -> dict[str, Any]:
+    """Run every (target, streaming) cell serially under the identical
+    profile; write comparison.csv + comparison_report.json; return the
+    report dict. Failure cells record-and-continue
+    (ab-compare.sh cleanup/continue behavior :237-248)."""
+    from kserve_vllm_mini_tpu.sweeps.base import write_row
+
+    bench_fn = bench_fn or default_bench_target_fn()
+    output_dir = Path(output_dir)
+    output_dir.mkdir(parents=True, exist_ok=True)
+    csv_path = output_dir / "comparison.csv"
+    # fresh comparison per invocation: stale rows from a previous run into
+    # the same dir must not mix under one header
+    csv_path.unlink(missing_ok=True)
+    runs: list[BackendRunResult] = []
+    for target in targets:
+        for streaming in streaming_modes:
+            label = f"{target.backend} streaming={streaming}"
+            print(f"compare: {label}", file=sys.stderr)
+            t0 = time.time()
+            try:
+                results = bench_fn(target, dict(profile), streaming)
+                run = BackendRunResult(target.backend, streaming, results, elapsed_s=time.time() - t0)
+            except Exception as e:  # noqa: BLE001 — record-and-continue is the contract
+                run = BackendRunResult(
+                    target.backend, streaming, {}, status="failed",
+                    error=f"{type(e).__name__}: {e}", elapsed_s=time.time() - t0,
+                )
+                print(f"compare: {label} FAILED: {run.error}", file=sys.stderr)
+            runs.append(run)
+            write_row(csv_path, run.row(), COMPARE_CSV_COLUMNS)
+            if quiesce_s > 0:
+                time.sleep(quiesce_s)
+
+    rows = [r.row() for r in runs]
+    report = {
+        "targets": [t.backend for t in targets],
+        "profile": {
+            k: profile.get(k)
+            for k in ("model", "requests", "concurrency", "pattern", "max_tokens")
+        },
+        "rows": rows,
+        "winners": pick_winners(rows),
+        "failed": [r.backend for r in runs if r.status != "ok"],
+    }
+    with (output_dir / "comparison_report.json").open("w") as f:
+        json.dump(report, f, indent=2)
+    return report
+
+
+def format_report(report: dict[str, Any]) -> str:
+    lines = [f"backends compared: {', '.join(report['targets'])}"]
+    for mode, winners in report.get("winners", {}).items():
+        lines.append(f"\n[{mode}]")
+        for metric, w in winners.items():
+            if metric == "overall":
+                continue
+            lines.append(f"  {metric:<28} {w['backend']:<14} ({w['value']:.3f})")
+        if "overall" in winners:
+            lines.append(f"  {'OVERALL':<28} {winners['overall']}")
+    if report.get("failed"):
+        lines.append(f"\nfailed cells: {', '.join(report['failed'])}")
+    return "\n".join(lines)
+
+
+# -- CLI ---------------------------------------------------------------------
+
+def register(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument(
+        "--target", action="append", required=True, metavar="NAME[=URL]",
+        help="Backend to compare; repeatable. NAME alone self-serves the "
+             "in-repo runtime; NAME=URL hits a live endpoint. "
+             "Optional protocol suffix NAME:PROTOCOL=URL.",
+    )
+    # None defaults so an explicit flag always beats the profile YAML
+    # (same pattern as bench_pipeline.run)
+    parser.add_argument("--profile", default=None, help="YAML load profile")
+    parser.add_argument("--model", default=None)
+    parser.add_argument("--requests", type=int, default=None)
+    parser.add_argument("--concurrency", type=int, default=None)
+    parser.add_argument("--max-tokens", type=int, default=None)
+    parser.add_argument("--pattern", default=None)
+    parser.add_argument("--streaming", choices=["both", "on", "off"], default="both")
+    parser.add_argument("--output-dir", default="runs/compare")
+    parser.add_argument("--cost-file", default=None)
+    parser.add_argument("--quiesce", type=float, default=0.0,
+                        help="Seconds to sleep between cells (cluster quiesce)")
+
+
+def _parse_target(spec: str) -> CompareTarget:
+    name, _, url = spec.partition("=")
+    name, _, proto = name.partition(":")
+    return CompareTarget(backend=name, url=url, protocol=proto or "openai")
+
+
+def run(args: argparse.Namespace) -> int:
+    profile: dict[str, Any] = {}
+    if args.profile:
+        import yaml
+
+        with open(args.profile) as f:
+            profile = yaml.safe_load(f) or {}
+    overrides = {
+        "model": args.model,
+        "requests": args.requests,
+        "concurrency": args.concurrency,
+        "max_tokens": args.max_tokens,
+        "pattern": args.pattern,
+    }
+    profile.update({k: v for k, v in overrides.items() if v is not None})
+    defaults = {
+        "model": "default", "requests": 100, "concurrency": 10,
+        "max_tokens": 64, "pattern": "steady",
+    }
+    for k, v in defaults.items():
+        profile.setdefault(k, v)
+    modes = {"both": (True, False), "on": (True,), "off": (False,)}[args.streaming]
+    targets = [_parse_target(s) for s in args.target]
+    report = compare_backends(
+        targets,
+        profile,
+        Path(args.output_dir),
+        streaming_modes=modes,
+        bench_fn=default_bench_target_fn(cost_file=args.cost_file),
+        quiesce_s=args.quiesce,
+    )
+    print(format_report(report))
+    return 0 if not report["failed"] else 1

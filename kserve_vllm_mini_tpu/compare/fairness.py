@@ -1,0 +1,370 @@
+"""Dual-tenant fairness / backpressure harness.
+
+Reference behavior (scripts/fairness_dual_tenant.py): two tenants load the
+same endpoint concurrently — tenant A is latency-protected, tenant B is bulk
+traffic. A guard watches tenant A's rolling p95 (:46-65) and throttles
+tenant B while the budget is breached, releasing after a cooldown (:148-174).
+The summary (:177-198) reports per-tenant p50/p95, throughput share, and
+feeds the fairness budgets of the SLO gate (tools/gate.py:97-128).
+
+The workers reuse the loadgen protocol adapters and RunDir contract, so a
+fairness run produces a normal requests.csv (tenant column) that the
+analyzer can process like any other run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import bisect
+import json
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Optional
+
+import httpx
+
+from kserve_vllm_mini_tpu.analysis.metrics import percentile
+from kserve_vllm_mini_tpu.core.rundir import RequestRecord, RunDir
+from kserve_vllm_mini_tpu.loadgen.adapters.base import CallResult, GenParams, get_adapter
+from kserve_vllm_mini_tpu.loadgen.arrivals import generate_arrival_times
+from kserve_vllm_mini_tpu.loadgen.prompts import make_prompt_fn
+
+
+class RollingP95:
+    """p95 over a sliding window of the most recent N latencies
+    (fairness_dual_tenant.py:46-65) — kept sorted for O(log n) insert."""
+
+    def __init__(self, window: int = 50):
+        self.window = window
+        self._recent: list[float] = []    # arrival order
+        self._sorted: list[float] = []
+
+    def add(self, value: float) -> None:
+        self._recent.append(value)
+        bisect.insort(self._sorted, value)
+        if len(self._recent) > self.window:
+            old = self._recent.pop(0)
+            del self._sorted[bisect.bisect_left(self._sorted, old)]
+
+    def p95(self) -> float:
+        if not self._sorted:
+            return 0.0
+        return percentile(self._sorted, 95.0)
+
+    def __len__(self) -> int:
+        return len(self._recent)
+
+
+@dataclass
+class Guard:
+    """Backpressure controller: while tenant A's rolling p95 breaches the
+    budget, tenant B's workers are gated; the gate re-opens ``cooldown_s``
+    after the breach clears (fairness_dual_tenant.py:148-174)."""
+
+    p95_budget_ms: float
+    cooldown_s: float = 2.0
+    min_samples: int = 10
+    rolling: RollingP95 = field(default_factory=RollingP95)
+    throttle_events: int = 0
+    throttled_s: float = 0.0
+    _gate: asyncio.Event = field(default_factory=asyncio.Event)
+    _release_at: float = 0.0
+    _throttling: bool = False
+
+    def __post_init__(self) -> None:
+        self._gate.set()
+        self._throttle_began = 0.0
+
+    def total_throttled_s(self) -> float:
+        """Accumulated gate-closed time, including a window still open now —
+        a run that ends mid-throttle must not report ~0."""
+        if self._throttling:
+            return self.throttled_s + (time.time() - self._throttle_began)
+        return self.throttled_s
+
+    def observe(self, latency_ms: float) -> None:
+        self.rolling.add(latency_ms)
+        now = time.time()
+        breaching = (
+            len(self.rolling) >= self.min_samples
+            and self.rolling.p95() > self.p95_budget_ms
+        )
+        if breaching:
+            self._release_at = now + self.cooldown_s
+            if not self._throttling:
+                self._throttling = True
+                self.throttle_events += 1
+                self._throttle_began = now
+                self._gate.clear()
+        elif self._throttling and now >= self._release_at:
+            self._throttling = False
+            self.throttled_s += now - self._throttle_began
+            self._gate.set()
+
+    async def wait_clear(self) -> None:
+        """Called by tenant-B workers before sending."""
+        if self._throttling and time.time() >= self._release_at:
+            # releases are driven by observations; recover here too so B is
+            # never gated forever when A's traffic has finished
+            self._throttling = False
+            self.throttled_s += time.time() - self._throttle_began
+            self._gate.set()
+        await self._gate.wait()
+
+
+@dataclass
+class TenantConfig:
+    name: str
+    requests: int = 100
+    concurrency: int = 8
+    pattern: str = "poisson"
+    max_tokens: int = 32
+    protected: bool = False     # guard watches this tenant's latency
+
+
+async def _tenant_worker(
+    idx: int,
+    arrival_offset: float,
+    t_start: float,
+    tenant: TenantConfig,
+    url: str,
+    model: str,
+    adapter,
+    client: httpx.AsyncClient,
+    sem: asyncio.Semaphore,
+    prompt_fn,
+    guard: Optional[Guard],
+) -> RequestRecord:
+    rec = RequestRecord(
+        request_id=f"{tenant.name}-{idx:05d}",
+        scheduled_ts=t_start + arrival_offset,
+        tenant=tenant.name,
+    )
+    delay = rec.scheduled_ts - time.time()
+    if delay > 0:
+        await asyncio.sleep(delay)
+    if guard is not None and not tenant.protected:
+        await guard.wait_clear()
+    async with sem:
+        rec.start_ts = time.time()
+        try:
+            result = await adapter.generate(
+                client, url, model, prompt_fn(idx),
+                GenParams(max_tokens=tenant.max_tokens), False, None,
+            )
+        except Exception as e:  # noqa: BLE001
+            result = CallResult(error=f"adapter-{type(e).__name__}")
+        rec.end_ts = time.time()
+    rec.ok = result.ok
+    rec.status_code = result.status_code
+    rec.error = result.error
+    rec.tokens_in = result.tokens_in
+    rec.tokens_out = result.tokens_out
+    rec.latency_ms = (rec.end_ts - rec.start_ts) * 1000.0
+    rec.ttft_ms = rec.latency_ms
+    if guard is not None and tenant.protected and rec.ok:
+        guard.observe(rec.latency_ms)
+    return rec
+
+
+async def run_fairness_async(
+    url: str,
+    tenants: list[TenantConfig],
+    run_dir: RunDir,
+    model: str = "default",
+    backend: str = "openai",
+    duration_s: float = 20.0,
+    guard: Optional[Guard] = None,
+    seed: int = 42,
+    timeout_s: float = 60.0,
+) -> list[RequestRecord]:
+    adapter = get_adapter(backend)
+    t_start = time.time()
+    total_conc = sum(t.concurrency for t in tenants)
+    limits = httpx.Limits(max_connections=total_conc + 4)
+    tasks = []
+    async with httpx.AsyncClient(timeout=timeout_s, limits=limits) as client:
+        for ti, tenant in enumerate(tenants):
+            arrivals = generate_arrival_times(
+                tenant.pattern, tenant.requests, duration_s, seed=seed + ti
+            )
+            sem = asyncio.Semaphore(tenant.concurrency)
+            prompt_fn = make_prompt_fn("default", seed=seed + ti)
+            tasks.extend(
+                _tenant_worker(
+                    i, off, t_start, tenant, url, model, adapter, client, sem,
+                    prompt_fn, guard,
+                )
+                for i, off in enumerate(arrivals)
+            )
+        records = await asyncio.gather(*tasks)
+    records = sorted(records, key=lambda r: r.start_ts)
+    run_dir.path.mkdir(parents=True, exist_ok=True)
+    run_dir.write_requests(records)
+    run_dir.write_meta(
+        {
+            "url": url,
+            "model": model,
+            "mode": "fairness_dual_tenant",
+            "tenants": [t.name for t in tenants],
+            "duration_s": duration_s,
+            "started_at": t_start,
+            "finished_at": time.time(),
+        }
+    )
+    return list(records)
+
+
+def summarize(
+    records: list[RequestRecord], guard: Optional[Guard] = None
+) -> dict[str, Any]:
+    """Per-tenant latency/throughput + the cross-tenant fairness metrics the
+    SLO gate budgets against (fairness_dual_tenant.py:177-198)."""
+    by_tenant: dict[str, list[RequestRecord]] = {}
+    for r in records:
+        by_tenant.setdefault(r.tenant or "default", []).append(r)
+    total_ok = sum(1 for r in records if r.ok)
+    tenants: dict[str, Any] = {}
+    p95s: dict[str, float] = {}
+    shares: dict[str, float] = {}
+    for name, recs in sorted(by_tenant.items()):
+        lats = [r.latency_ms for r in recs if r.ok]
+        ok = len(lats)
+        t0 = min((r.start_ts for r in recs), default=0.0)
+        t1 = max((r.end_ts for r in recs), default=0.0)
+        span = max(t1 - t0, 1e-9)
+        p95s[name] = percentile(lats, 95.0) if lats else float("nan")
+        shares[name] = ok / total_ok if total_ok else 0.0
+        tenants[name] = {
+            "requests": len(recs),
+            "ok": ok,
+            "error_rate": 1.0 - ok / len(recs) if recs else 0.0,
+            "p50_ms": percentile(lats, 50.0) if lats else None,
+            "p95_ms": p95s[name] if lats else None,
+            "throughput_rps": ok / span,
+            "throughput_share": shares[name],
+        }
+    valid_p95 = {k: v for k, v in p95s.items() if v == v}  # drop NaN
+    summary: dict[str, Any] = {"tenants": tenants}
+    if len(valid_p95) >= 2:
+        summary["fairness_p95_ratio"] = max(valid_p95.values()) / max(
+            min(valid_p95.values()), 1e-9
+        )
+    if shares:
+        summary["fairness_throughput_share_min_tenant"] = min(shares.values())
+    if guard is not None:
+        summary["guard"] = {
+            "p95_budget_ms": guard.p95_budget_ms,
+            "throttle_events": guard.throttle_events,
+            "throttled_s": round(guard.total_throttled_s(), 3),
+        }
+    return summary
+
+
+def fairness_html(summary: dict[str, Any]) -> str:
+    from html import escape
+
+    rows = []
+    for raw_name, t in summary["tenants"].items():
+        name = escape(raw_name)
+        rows.append(
+            f"<tr><td>{name}</td><td>{t['requests']}</td>"
+            f"<td>{t['p50_ms']:.1f}</td><td>{t['p95_ms']:.1f}</td>"
+            f"<td>{t['throughput_rps']:.2f}</td>"
+            f"<td>{100 * t['throughput_share']:.1f}%</td></tr>"
+            if t["p50_ms"] is not None
+            else f"<tr><td>{name}</td><td>{t['requests']}</td>"
+                 f"<td>—</td><td>—</td><td>—</td><td>—</td></tr>"
+        )
+    guard_line = ""
+    if "guard" in summary:
+        g = summary["guard"]
+        guard_line = (
+            f"<p>guard: budget {g['p95_budget_ms']:.0f} ms, "
+            f"{g['throttle_events']} throttle events, "
+            f"{g['throttled_s']:.1f}s throttled</p>"
+        )
+    ratio = summary.get("fairness_p95_ratio")
+    return f"""<!DOCTYPE html>
+<html><head><meta charset="utf-8"><title>Fairness report</title>
+<style>body{{font-family:system-ui;margin:2rem}}table{{border-collapse:collapse}}
+td,th{{border:1px solid #ccc;padding:.4rem .8rem;text-align:right}}
+td:first-child,th:first-child{{text-align:left}}</style></head>
+<body><h1>Dual-tenant fairness</h1>
+<p>p95 ratio (worst/best tenant): {f"{ratio:.2f}" if ratio else "—"}</p>{guard_line}
+<table><tr><th>tenant</th><th>requests</th><th>p50 ms</th><th>p95 ms</th>
+<th>RPS</th><th>share</th></tr>
+{''.join(rows)}
+</table></body></html>
+"""
+
+
+# -- CLI ---------------------------------------------------------------------
+
+def register(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument("--url", required=True)
+    parser.add_argument("--model", default="default")
+    parser.add_argument("--backend", default="openai")
+    parser.add_argument("--duration", type=float, default=20.0)
+    parser.add_argument("--requests-a", type=int, default=100)
+    parser.add_argument("--requests-b", type=int, default=100)
+    parser.add_argument("--concurrency-a", type=int, default=4)
+    parser.add_argument("--concurrency-b", type=int, default=16)
+    parser.add_argument("--max-tokens", type=int, default=32)
+    parser.add_argument("--p95-budget-ms", type=float, default=0.0,
+                        help="Enable the backpressure guard at this budget")
+    parser.add_argument("--cooldown", type=float, default=2.0)
+    parser.add_argument("--run-dir", default=None)
+    parser.add_argument("--slo", default=None, help="Gate fairness metrics against slo.json")
+    parser.add_argument("--html", default=None)
+
+
+def run(args: argparse.Namespace) -> int:
+    tenants = [
+        TenantConfig("tenant-a", args.requests_a, args.concurrency_a,
+                     max_tokens=args.max_tokens, protected=True),
+        TenantConfig("tenant-b", args.requests_b, args.concurrency_b,
+                     max_tokens=args.max_tokens),
+    ]
+    guard = Guard(args.p95_budget_ms, args.cooldown) if args.p95_budget_ms > 0 else None
+    run_dir = RunDir(args.run_dir) if args.run_dir else RunDir.create()
+    records = asyncio.run(
+        run_fairness_async(
+            args.url, tenants, run_dir, model=args.model, backend=args.backend,
+            duration_s=args.duration, guard=guard,
+        )
+    )
+    summary = summarize(records, guard)
+    with (run_dir.path / "fairness_summary.json").open("w") as f:
+        json.dump(summary, f, indent=2)
+    run_dir.merge_into_results(
+        {
+            k: summary[k]
+            for k in ("fairness_p95_ratio", "fairness_throughput_share_min_tenant")
+            if k in summary
+        }
+    )
+    if args.html:
+        Path(args.html).write_text(fairness_html(summary))
+    for name, t in summary["tenants"].items():
+        p95 = f"{t['p95_ms']:.1f}" if t["p95_ms"] is not None else "—"
+        print(
+            f"{name}: {t['ok']}/{t['requests']} ok, p95 {p95} ms, "
+            f"{t['throughput_rps']:.2f} rps, share {100 * t['throughput_share']:.0f}%"
+        )
+    if "fairness_p95_ratio" in summary:
+        print(f"p95 ratio: {summary['fairness_p95_ratio']:.2f}")
+    if args.slo:
+        from kserve_vllm_mini_tpu.gates.slo import gate_results, load_slo, print_table
+
+        budgets = {
+            k: v for k, v in load_slo(args.slo).items() if k.startswith("fairness_")
+        }
+        if budgets:
+            verdicts = gate_results(run_dir.read_results(), budgets)
+            print_table(verdicts)
+            if not all(v.ok for v in verdicts):
+                return 3
+    return 0

@@ -1,0 +1,349 @@
+"""OpenAI API conformance probe: which capabilities does an endpoint really
+support?
+
+Reference behavior (scripts/openai_parity_probe.py:32-318): probe five
+capabilities — tool calling, parallel tool calling, JSON mode, logprobs, and
+streaming shape/TTFT — against a /v1/chat/completions endpoint, emit a
+capability matrix as JSON + HTML. Each probe is independent: a failure marks
+the capability unsupported with detail, never aborts the matrix.
+
+TPU relevance: JetStream, vLLM-TPU, and the in-repo runtime differ exactly
+here (JetStream's HTTP server speaks a narrower dialect), so the matrix is
+what tells an operator which profiles (tool-calling.yaml,
+structured-output.yaml) a backend can run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Optional
+
+import httpx
+
+CAPABILITIES = ["tools", "parallel_tools", "json_mode", "logprobs", "streaming"]
+
+_WEATHER_TOOL = {
+    "type": "function",
+    "function": {
+        "name": "get_weather",
+        "description": "Get current weather for a city",
+        "parameters": {
+            "type": "object",
+            "properties": {"city": {"type": "string"}},
+            "required": ["city"],
+        },
+    },
+}
+
+_TIME_TOOL = {
+    "type": "function",
+    "function": {
+        "name": "get_time",
+        "description": "Get current local time for a city",
+        "parameters": {
+            "type": "object",
+            "properties": {"city": {"type": "string"}},
+            "required": ["city"],
+        },
+    },
+}
+
+
+@dataclass
+class CapabilityResult:
+    capability: str
+    supported: bool
+    latency_ms: float = 0.0
+    detail: str = ""
+    extra: dict[str, Any] = field(default_factory=dict)
+
+    def as_dict(self) -> dict[str, Any]:
+        return {
+            "capability": self.capability,
+            "supported": self.supported,
+            "latency_ms": round(self.latency_ms, 1),
+            "detail": self.detail,
+            **self.extra,
+        }
+
+
+class ParityProber:
+    """Async prober bound to one endpoint. One shared client; each probe is
+    a single chat-completions call with capability-specific payload."""
+
+    def __init__(self, base_url: str, model: str = "default", timeout_s: float = 30.0):
+        self.base_url = base_url.rstrip("/")
+        self.model = model
+        self.timeout_s = timeout_s
+
+    async def _chat(
+        self, client: httpx.AsyncClient, payload: dict[str, Any]
+    ) -> tuple[int, dict[str, Any], float]:
+        body = {"model": self.model, **payload}
+        t0 = time.time()
+        resp = await client.post(f"{self.base_url}/v1/chat/completions", json=body)
+        latency = (time.time() - t0) * 1000.0
+        try:
+            data = resp.json()
+        except Exception:
+            data = {}
+        return resp.status_code, data, latency
+
+    @staticmethod
+    def _tool_calls(data: dict[str, Any]) -> list[dict[str, Any]]:
+        try:
+            return data["choices"][0]["message"].get("tool_calls") or []
+        except (KeyError, IndexError, TypeError):
+            return []
+
+    async def probe_tools(self, client: httpx.AsyncClient) -> CapabilityResult:
+        status, data, ms = await self._chat(
+            client,
+            {
+                "messages": [{"role": "user", "content": "What is the weather in Paris?"}],
+                "tools": [_WEATHER_TOOL],
+                "tool_choice": "auto",
+                "max_tokens": 64,
+            },
+        )
+        if status != 200:
+            return CapabilityResult("tools", False, ms, f"HTTP {status}")
+        calls = self._tool_calls(data)
+        if not calls:
+            return CapabilityResult("tools", False, ms, "no tool_calls in response")
+        fn = calls[0].get("function", {})
+        try:
+            args = json.loads(fn.get("arguments", "{}"))
+            args_ok = isinstance(args, dict)
+        except json.JSONDecodeError:
+            args_ok = False
+        if fn.get("name") != "get_weather" or not args_ok:
+            return CapabilityResult(
+                "tools", False, ms, f"malformed tool call: name={fn.get('name')!r}"
+            )
+        return CapabilityResult("tools", True, ms, "returned well-formed tool_calls")
+
+    async def probe_parallel_tools(self, client: httpx.AsyncClient) -> CapabilityResult:
+        status, data, ms = await self._chat(
+            client,
+            {
+                "messages": [
+                    {
+                        "role": "user",
+                        "content": "What are the weather and the local time in Paris? "
+                                   "Use both tools.",
+                    }
+                ],
+                "tools": [_WEATHER_TOOL, _TIME_TOOL],
+                "tool_choice": "auto",
+                "parallel_tool_calls": True,
+                "max_tokens": 128,
+            },
+        )
+        if status != 200:
+            return CapabilityResult("parallel_tools", False, ms, f"HTTP {status}")
+        calls = self._tool_calls(data)
+        names = {c.get("function", {}).get("name") for c in calls}
+        if len(calls) >= 2 and {"get_weather", "get_time"} <= names:
+            return CapabilityResult(
+                "parallel_tools", True, ms, f"{len(calls)} tool calls in one turn"
+            )
+        return CapabilityResult(
+            "parallel_tools", False, ms, f"got {len(calls)} tool call(s): {sorted(filter(None, names))}"
+        )
+
+    async def probe_json_mode(self, client: httpx.AsyncClient) -> CapabilityResult:
+        status, data, ms = await self._chat(
+            client,
+            {
+                "messages": [
+                    {
+                        "role": "user",
+                        "content": 'Return a JSON object with keys "city" and "country" for Paris.',
+                    }
+                ],
+                "response_format": {"type": "json_object"},
+                "max_tokens": 64,
+            },
+        )
+        if status != 200:
+            return CapabilityResult("json_mode", False, ms, f"HTTP {status}")
+        try:
+            content = data["choices"][0]["message"]["content"]
+        except (KeyError, IndexError, TypeError):
+            return CapabilityResult("json_mode", False, ms, "no message content")
+        try:
+            parsed = json.loads(content)
+        except (json.JSONDecodeError, TypeError):
+            return CapabilityResult("json_mode", False, ms, "content is not valid JSON")
+        if not isinstance(parsed, dict):
+            return CapabilityResult("json_mode", False, ms, "content is JSON but not an object")
+        return CapabilityResult("json_mode", True, ms, "content parsed as a JSON object")
+
+    async def probe_logprobs(self, client: httpx.AsyncClient) -> CapabilityResult:
+        status, data, ms = await self._chat(
+            client,
+            {
+                "messages": [{"role": "user", "content": "Say hello."}],
+                "logprobs": True,
+                "top_logprobs": 2,
+                "max_tokens": 8,
+            },
+        )
+        if status != 200:
+            return CapabilityResult("logprobs", False, ms, f"HTTP {status}")
+        try:
+            lp = data["choices"][0].get("logprobs")
+            content = (lp or {}).get("content") or []
+        except (KeyError, IndexError, TypeError):
+            return CapabilityResult("logprobs", False, ms, "malformed choices")
+        if not content:
+            return CapabilityResult("logprobs", False, ms, "no logprobs.content entries")
+        entry = content[0]
+        if "logprob" not in entry:
+            return CapabilityResult("logprobs", False, ms, "entries missing 'logprob'")
+        return CapabilityResult(
+            "logprobs", True, ms, f"{len(content)} token logprob entries"
+        )
+
+    async def probe_streaming(self, client: httpx.AsyncClient) -> CapabilityResult:
+        """SSE shape check + client TTFT (openai_parity_probe.py:214-248):
+        chunks must be `data:` frames of chat.completion.chunk-shaped JSON
+        ending with [DONE]."""
+        body = {
+            "model": self.model,
+            "messages": [{"role": "user", "content": "Count to five."}],
+            "stream": True,
+            "max_tokens": 32,
+        }
+        t0 = time.time()
+        chunks = 0
+        ttft_ms = 0.0
+        saw_done = False
+        malformed = 0
+        try:
+            async with client.stream(
+                "POST", f"{self.base_url}/v1/chat/completions", json=body
+            ) as resp:
+                if resp.status_code != 200:
+                    return CapabilityResult(
+                        "streaming", False, (time.time() - t0) * 1000.0,
+                        f"HTTP {resp.status_code}",
+                    )
+                async for line in resp.aiter_lines():
+                    line = line.strip()
+                    if not line.startswith("data:"):
+                        continue
+                    payload = line[5:].strip()
+                    if payload == "[DONE]":
+                        saw_done = True
+                        break
+                    try:
+                        evt = json.loads(payload)
+                        if "choices" not in evt:
+                            malformed += 1
+                    except json.JSONDecodeError:
+                        malformed += 1
+                        continue
+                    chunks += 1
+                    if chunks == 1:
+                        ttft_ms = (time.time() - t0) * 1000.0
+        except httpx.HTTPError as e:
+            return CapabilityResult(
+                "streaming", False, (time.time() - t0) * 1000.0, f"{type(e).__name__}: {e}"
+            )
+        total_ms = (time.time() - t0) * 1000.0
+        ok = chunks >= 1 and saw_done and malformed == 0
+        detail = (
+            f"{chunks} chunks, DONE={saw_done}, malformed={malformed}"
+        )
+        return CapabilityResult(
+            "streaming", ok, total_ms, detail,
+            extra={"ttft_ms": round(ttft_ms, 1), "chunks": chunks},
+        )
+
+    async def probe_all(self) -> list[CapabilityResult]:
+        async with httpx.AsyncClient(timeout=self.timeout_s) as client:
+            results = []
+            for probe in (
+                self.probe_tools,
+                self.probe_parallel_tools,
+                self.probe_json_mode,
+                self.probe_logprobs,
+                self.probe_streaming,
+            ):
+                try:
+                    results.append(await probe(client))
+                except Exception as e:  # noqa: BLE001 — one probe must not kill the matrix
+                    name = probe.__name__.removeprefix("probe_")
+                    results.append(
+                        CapabilityResult(name, False, 0.0, f"{type(e).__name__}: {e}")
+                    )
+            return results
+
+
+def matrix_dict(url: str, model: str, results: list[CapabilityResult]) -> dict[str, Any]:
+    return {
+        "endpoint": url,
+        "model": model,
+        "capabilities": {r.capability: r.as_dict() for r in results},
+        "supported_count": sum(1 for r in results if r.supported),
+        "total": len(results),
+    }
+
+
+def matrix_html(matrix: dict[str, Any]) -> str:
+    from html import escape
+
+    rows = []
+    for name, r in matrix["capabilities"].items():
+        badge = "✓" if r["supported"] else "✗"
+        color = "#0a7a33" if r["supported"] else "#b3261e"
+        rows.append(
+            f"<tr><td>{escape(name)}</td>"
+            f"<td style='color:{color};font-weight:bold'>{badge}</td>"
+            f"<td>{r['latency_ms']:.0f} ms</td><td>{escape(r['detail'])}</td></tr>"
+        )
+    return f"""<!DOCTYPE html>
+<html><head><meta charset="utf-8"><title>OpenAI parity matrix</title>
+<style>body{{font-family:system-ui;margin:2rem}}table{{border-collapse:collapse}}
+td,th{{border:1px solid #ccc;padding:.4rem .8rem;text-align:left}}</style></head>
+<body><h1>OpenAI API parity matrix</h1>
+<p>endpoint: <code>{escape(matrix['endpoint'])}</code> · model: <code>{escape(matrix['model'])}</code>
+· {matrix['supported_count']}/{matrix['total']} capabilities supported</p>
+<table><tr><th>capability</th><th>supported</th><th>latency</th><th>detail</th></tr>
+{''.join(rows)}
+</table></body></html>
+"""
+
+
+# -- CLI ---------------------------------------------------------------------
+
+def register(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument("--url", required=True)
+    parser.add_argument("--model", default="default")
+    parser.add_argument("--timeout", type=float, default=30.0)
+    parser.add_argument("--output", default=None, help="Write matrix JSON here")
+    parser.add_argument("--html", default=None, help="Write HTML matrix here")
+
+
+def run(args: argparse.Namespace) -> int:
+    prober = ParityProber(args.url, args.model, args.timeout)
+    results = asyncio.run(prober.probe_all())
+    matrix = matrix_dict(args.url, args.model, results)
+    for r in results:
+        mark = "PASS" if r.supported else "FAIL"
+        print(f"{r.capability:<16} {mark}  {r.latency_ms:7.0f} ms  {r.detail}")
+    print(f"{matrix['supported_count']}/{matrix['total']} capabilities supported")
+    if args.output:
+        Path(args.output).parent.mkdir(parents=True, exist_ok=True)
+        Path(args.output).write_text(json.dumps(matrix, indent=2))
+    if args.html:
+        Path(args.html).parent.mkdir(parents=True, exist_ok=True)
+        Path(args.html).write_text(matrix_html(matrix))
+    return 0

@@ -57,7 +57,10 @@ def _jetstream_env(cfg: BackendConfig, topo: TpuTopology) -> dict[str, str]:
     }
     if cfg.quantization != "none":
         env["QUANTIZATION"] = cfg.quantization   # jetstream int8 weight/kv configs
-        env["QUANTIZE_KVCACHE"] = "true" if cfg.kv_cache_dtype != "auto" else "false"
+    if cfg.kv_cache_dtype != "auto":
+        # KV-cache quantization is independent of weight quantization
+        env["QUANTIZE_KVCACHE"] = "true"
+        env["KV_CACHE_DTYPE"] = cfg.kv_cache_dtype
     if cfg.drafter_model_id:
         env["DRAFTER_MODEL_ID"] = cfg.drafter_model_id
     env.update(cfg.extra_env)
@@ -107,6 +110,8 @@ def _jax_native_env(cfg: BackendConfig, topo: TpuTopology) -> dict[str, str]:
         "KVMINI_MAX_BATCH": str(cfg.max_batch_size),
         "KVMINI_QUANTIZATION": cfg.quantization,
     }
+    if cfg.kv_cache_dtype != "auto":
+        env["KVMINI_KV_CACHE_DTYPE"] = cfg.kv_cache_dtype
     if cfg.drafter_model_id:
         env["KVMINI_DRAFTER"] = cfg.drafter_model_id
     env.update(cfg.extra_env)

@@ -103,10 +103,27 @@ def render_isvc(spec: DeploySpec) -> dict[str, Any]:
     if spec.service_account:
         predictor["serviceAccountName"] = spec.service_account
     if topo.hosts > 1:
-        # multi-host slice: KServe schedules the leader; workers join via
-        # the JobSet/LeaderWorkerSet machinery GKE provides for TPU pods.
+        # multi-host slice: KServe schedules the leader; workers run the
+        # same image (the runtime elects roles from TPU_WORKER_ID injected
+        # by the GKE device plugin) and must declare their own PodSpec —
+        # a bare {size} renders worker pods with no containers.
+        worker_container = {
+            "name": "worker-container",
+            "image": backend.image,
+            "env": [{"name": k, "value": v} for k, v in sorted(env.items())],
+            "resources": {
+                # topo.chips is per-pod (per host), matching the leader's
+                "requests": {
+                    "cpu": spec.cpu,
+                    "memory": spec.memory,
+                    "google.com/tpu": str(topo.chips),
+                },
+                "limits": {"google.com/tpu": str(topo.chips)},
+            },
+        }
         predictor["workerSpec"] = {
             "size": topo.hosts - 1,
+            "containers": [worker_container],
             "nodeSelector": dict(predictor["nodeSelector"]),
         }
 

@@ -27,7 +27,7 @@ class Check:
     detail: str = ""
 
 
-def _cluster_checks(kc: Kubectl) -> list[Check]:
+def _cluster_checks(kc: Kubectl, namespace: str = "kvmini-tpu") -> list[Check]:
     checks: list[Check] = []
     ctx = kc.run(["config", "current-context"])
     checks.append(
@@ -53,7 +53,7 @@ def _cluster_checks(kc: Kubectl) -> list[Check]:
               f"{len(tpu_nodes)} TPU node(s)" if nodes.ok else nodes.stderr.strip())
     )
 
-    secret = kc.run(["get", "secret", "storage-config", "-n", "kvmini-tpu"])
+    secret = kc.run(["get", "secret", "storage-config", "-n", namespace])
     checks.append(
         Check("storage-credentials", secret.ok, False,
               "" if secret.ok else "no storage-config secret (ok for public models)")
@@ -72,9 +72,12 @@ def _local_checks() -> list[Check]:
             Check("jax-devices", True, True,
                   f"{len(devices)} device(s): {', '.join(kinds)}")
         )
+        has_tpu = any(d.platform == "tpu" for d in devices)
         checks.append(
-            Check("tpu-present", any(d.platform == "tpu" for d in devices), False,
-                  "no TPU attached — runtime will run on " + ",".join(kinds))
+            Check("tpu-present", has_tpu, False,
+                  f"{sum(d.platform == 'tpu' for d in devices)} TPU device(s)"
+                  if has_tpu
+                  else "no TPU attached — runtime will run on " + ",".join(kinds))
         )
     except Exception as e:  # jax import or backend init failure
         checks.append(Check("jax-devices", False, True, f"{type(e).__name__}: {e}"))
@@ -82,12 +85,14 @@ def _local_checks() -> list[Check]:
 
 
 def preflight(
-    mode: str = "cluster", kubectl: Optional[Kubectl] = None
+    mode: str = "cluster",
+    kubectl: Optional[Kubectl] = None,
+    namespace: str = "kvmini-tpu",
 ) -> list[Check]:
     """mode: cluster | local | all."""
     checks: list[Check] = []
     if mode in ("cluster", "all"):
-        checks += _cluster_checks(kubectl or Kubectl())
+        checks += _cluster_checks(kubectl or Kubectl(), namespace)
     if mode in ("local", "all"):
         checks += _local_checks()
     return checks
@@ -101,11 +106,12 @@ def passed(checks: list[Check]) -> bool:
 
 def register(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--mode", default="cluster", choices=("cluster", "local", "all"))
+    parser.add_argument("--namespace", default="kvmini-tpu")
     parser.add_argument("--json", action="store_true")
 
 
 def run(args: argparse.Namespace) -> int:
-    checks = preflight(args.mode)
+    checks = preflight(args.mode, namespace=args.namespace)
     if args.json:
         print(json.dumps([c.__dict__ for c in checks], indent=2))
     else:

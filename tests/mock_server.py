@@ -20,8 +20,19 @@ class MockStats:
     streamed: int = 0
 
 
-def make_app(token_delay_s: float = 0.002, n_tokens: int = 8, fail_every: int = 0) -> web.Application:
+def make_app(
+    token_delay_s: float = 0.002,
+    n_tokens: int = 8,
+    fail_every: int = 0,
+    capabilities: set[str] | None = None,
+) -> web.Application:
+    """``capabilities`` toggles OpenAI-dialect extras for parity-probe tests:
+    any subset of {"tools", "parallel_tools", "json_mode", "logprobs"}.
+    None means all supported."""
     stats = MockStats()
+    caps = capabilities if capabilities is not None else {
+        "tools", "parallel_tools", "json_mode", "logprobs"
+    }
 
     async def chat(request: web.Request) -> web.StreamResponse:
         stats.requests += 1
@@ -29,6 +40,84 @@ def make_app(token_delay_s: float = 0.002, n_tokens: int = 8, fail_every: int = 
             return web.json_response({"error": "injected"}, status=500)
         body = await request.json()
         stream = body.get("stream", False)
+
+        if body.get("tools") and "tools" in caps:
+            tools = body["tools"]
+            calls = [
+                {
+                    "id": f"call_{i}",
+                    "type": "function",
+                    "function": {
+                        "name": t["function"]["name"],
+                        "arguments": json.dumps({"city": "Paris"}),
+                    },
+                }
+                for i, t in enumerate(tools)
+            ]
+            if len(tools) > 1 and "parallel_tools" not in caps:
+                calls = calls[:1]
+            return web.json_response(
+                {
+                    "id": "mock",
+                    "choices": [
+                        {
+                            "index": 0,
+                            "message": {
+                                "role": "assistant",
+                                "content": None,
+                                "tool_calls": calls,
+                            },
+                            "finish_reason": "tool_calls",
+                        }
+                    ],
+                    "usage": {"prompt_tokens": 5, "completion_tokens": 8},
+                }
+            )
+
+        if body.get("response_format", {}).get("type") == "json_object":
+            if "json_mode" not in caps:
+                return web.json_response({"error": "response_format unsupported"}, status=400)
+            return web.json_response(
+                {
+                    "id": "mock",
+                    "choices": [
+                        {
+                            "index": 0,
+                            "message": {
+                                "role": "assistant",
+                                "content": json.dumps({"city": "Paris", "country": "France"}),
+                            },
+                        }
+                    ],
+                    "usage": {"prompt_tokens": 5, "completion_tokens": 8},
+                }
+            )
+
+        if body.get("logprobs") and "logprobs" in caps and not stream:
+            return web.json_response(
+                {
+                    "id": "mock",
+                    "choices": [
+                        {
+                            "index": 0,
+                            "message": {"role": "assistant", "content": "hello"},
+                            "logprobs": {
+                                "content": [
+                                    {
+                                        "token": "hello",
+                                        "logprob": -0.01,
+                                        "top_logprobs": [
+                                            {"token": "hello", "logprob": -0.01},
+                                            {"token": "hi", "logprob": -4.2},
+                                        ],
+                                    }
+                                ]
+                            },
+                        }
+                    ],
+                    "usage": {"prompt_tokens": 3, "completion_tokens": 1},
+                }
+            )
         max_toks = min(int(body.get("max_tokens", 16)), n_tokens)
         words = [f"tok{i} " for i in range(max_toks)]
         if not stream:

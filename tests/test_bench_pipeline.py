@@ -1,0 +1,51 @@
+"""End-to-end bench pipeline against the mock endpoint — the real stage
+chain (load -> probe -> analyze -> energy -> cost), no stub bench_fn.
+
+Regression coverage for the _run_stages extraction: sweep tests inject fake
+bench functions, so only this test executes the production stage chain."""
+
+import asyncio
+import threading
+
+from kserve_vllm_mini_tpu.bench_pipeline import run_bench
+from kserve_vllm_mini_tpu.core.rundir import RunDir
+from tests.mock_server import MockServer
+
+
+def _serve_mock(started: threading.Event, stop: threading.Event, holder: dict):
+    async def main():
+        async with MockServer(token_delay_s=0.001) as srv:
+            holder["url"] = srv.url
+            started.set()
+            while not stop.is_set():
+                await asyncio.sleep(0.02)
+
+    asyncio.run(main())
+
+
+def test_run_bench_full_stage_chain(tmp_path):
+    started, stop, holder = threading.Event(), threading.Event(), {}
+    t = threading.Thread(target=_serve_mock, args=(started, stop, holder), daemon=True)
+    t.start()
+    assert started.wait(timeout=10)
+    try:
+        run_dir = RunDir.create(root=tmp_path)
+        results, code = run_bench(
+            url=holder["url"],
+            profile={"model": "m", "requests": 12, "concurrency": 4, "max_tokens": 8},
+            run_dir=run_dir,
+        )
+        assert code == 0
+        assert results["requests"] == 12
+        assert results["error_rate"] == 0.0
+        assert results["p95_ms"] > 0
+        assert results["throughput_rps"] > 0
+        # every stage merged its keys into the one results.json
+        persisted = run_dir.read_results()
+        assert "cost_per_request" in persisted
+        assert persisted.get("runtime") != "jax-native"  # external-URL run
+        assert run_dir.requests_csv.exists()
+        assert run_dir.meta_json.exists()
+    finally:
+        stop.set()
+        t.join(timeout=5)

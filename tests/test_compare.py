@@ -1,0 +1,207 @@
+"""Compare-layer tests: A/B/C backend comparison, OpenAI parity probe,
+dual-tenant fairness — all against injected bench functions or the mock
+server, never a cluster (reference test strategy, SURVEY.md §4)."""
+
+import asyncio
+import json
+
+import pytest
+
+from kserve_vllm_mini_tpu.compare.backends import (
+    CompareTarget,
+    compare_backends,
+    format_report,
+    pick_winners,
+)
+from kserve_vllm_mini_tpu.compare.fairness import (
+    Guard,
+    RollingP95,
+    TenantConfig,
+    run_fairness_async,
+    summarize,
+)
+from kserve_vllm_mini_tpu.compare.parity import ParityProber, matrix_dict, matrix_html
+from kserve_vllm_mini_tpu.core.rundir import RequestRecord, RunDir
+from tests.mock_server import MockServer
+
+
+# -- backend comparison -----------------------------------------------------
+
+def _fake_bench(metrics_by_backend):
+    def bench(target, profile, streaming):
+        m = metrics_by_backend[target.backend]
+        if isinstance(m, Exception):
+            raise m
+        return {**m, "requests": profile.get("requests"), "concurrency": profile.get("concurrency")}
+
+    return bench
+
+
+def test_compare_winners_and_report(tmp_path):
+    bench = _fake_bench(
+        {
+            "jetstream": {"p95_ms": 100.0, "throughput_rps": 50.0, "error_rate": 0.0},
+            "vllm-tpu": {"p95_ms": 80.0, "throughput_rps": 40.0, "error_rate": 0.01},
+        }
+    )
+    report = compare_backends(
+        [CompareTarget("jetstream"), CompareTarget("vllm-tpu")],
+        {"requests": 10, "concurrency": 2},
+        tmp_path,
+        streaming_modes=(True,),
+        bench_fn=bench,
+    )
+    winners = report["winners"]["streaming=1"]
+    assert winners["p95_ms"]["backend"] == "vllm-tpu"
+    assert winners["throughput_rps"]["backend"] == "jetstream"
+    assert winners["error_rate"]["backend"] == "jetstream"
+    assert not report["failed"]
+    # artifacts written
+    assert (tmp_path / "comparison.csv").exists()
+    persisted = json.loads((tmp_path / "comparison_report.json").read_text())
+    assert persisted["winners"]["streaming=1"] == winners
+    assert "jetstream" in format_report(report)
+
+
+def test_compare_failure_records_and_continues(tmp_path):
+    bench = _fake_bench(
+        {
+            "good": {"p95_ms": 50.0, "throughput_rps": 10.0},
+            "bad": RuntimeError("deploy timeout"),
+        }
+    )
+    report = compare_backends(
+        [CompareTarget("bad"), CompareTarget("good")],
+        {"requests": 5},
+        tmp_path,
+        streaming_modes=(False,),
+        bench_fn=bench,
+    )
+    assert report["failed"] == ["bad"]
+    assert report["winners"]["streaming=0"]["p95_ms"]["backend"] == "good"
+    rows = (tmp_path / "comparison.csv").read_text().splitlines()
+    assert len(rows) == 3  # header + 2 cells
+
+
+def test_pick_winners_splits_streaming_modes():
+    rows = [
+        {"backend": "a", "streaming": 1, "status": "ok", "p95_ms": 10.0},
+        {"backend": "b", "streaming": 1, "status": "ok", "p95_ms": 20.0},
+        {"backend": "b", "streaming": 0, "status": "ok", "p95_ms": 5.0},
+    ]
+    w = pick_winners(rows)
+    assert w["streaming=1"]["p95_ms"]["backend"] == "a"
+    assert w["streaming=0"]["p95_ms"]["backend"] == "b"
+
+
+# -- parity probe -----------------------------------------------------------
+
+def test_parity_all_capabilities_supported():
+    async def go():
+        async with MockServer() as srv:
+            return await ParityProber(srv.url, timeout_s=5.0).probe_all()
+
+    results = asyncio.run(go())
+    by_name = {r.capability: r for r in results}
+    assert set(by_name) == {"tools", "parallel_tools", "json_mode", "logprobs", "streaming"}
+    for name, r in by_name.items():
+        assert r.supported, f"{name}: {r.detail}"
+    assert by_name["streaming"].extra["chunks"] >= 1
+    assert by_name["streaming"].extra["ttft_ms"] > 0
+
+
+def test_parity_detects_missing_capabilities():
+    async def go():
+        async with MockServer(capabilities={"tools"}) as srv:
+            return await ParityProber(srv.url, timeout_s=5.0).probe_all()
+
+    by_name = {r.capability: r for r in asyncio.run(go())}
+    assert by_name["tools"].supported
+    assert not by_name["parallel_tools"].supported
+    assert not by_name["json_mode"].supported
+    assert not by_name["logprobs"].supported
+    assert by_name["streaming"].supported  # base mock always streams
+
+
+def test_parity_matrix_artifacts():
+    async def go():
+        async with MockServer() as srv:
+            prober = ParityProber(srv.url, model="m")
+            return matrix_dict(srv.url, "m", await prober.probe_all())
+
+    matrix = asyncio.run(go())
+    assert matrix["supported_count"] == matrix["total"] == 5
+    html = matrix_html(matrix)
+    assert "json_mode" in html and "OpenAI API parity" in html
+
+
+def test_parity_unreachable_endpoint_fails_gracefully():
+    results = asyncio.run(
+        ParityProber("http://127.0.0.1:1", timeout_s=0.5).probe_all()
+    )
+    assert len(results) == 5
+    assert not any(r.supported for r in results)
+
+
+# -- fairness ---------------------------------------------------------------
+
+def test_rolling_p95_window():
+    r = RollingP95(window=10)
+    for v in range(100):
+        r.add(float(v))
+    # only the last 10 samples (90..99) are retained
+    assert r.p95() >= 90.0
+    assert len(r) == 10
+
+
+def test_guard_throttles_and_releases():
+    async def go():
+        guard = Guard(p95_budget_ms=10.0, cooldown_s=0.05, min_samples=5)
+        for _ in range(10):
+            guard.observe(100.0)  # breach
+        assert guard.throttle_events == 1
+        t0 = asyncio.get_event_loop().time()
+        # breach clears: fast observations after cooldown elapses
+        await asyncio.sleep(0.06)
+        await asyncio.wait_for(guard.wait_clear(), timeout=1.0)
+        assert asyncio.get_event_loop().time() - t0 < 1.0
+        assert guard.throttled_s > 0
+
+    asyncio.run(go())
+
+
+def test_fairness_end_to_end_and_summary(tmp_path):
+    async def go():
+        async with MockServer(token_delay_s=0.001) as srv:
+            run_dir = RunDir.create(root=tmp_path)
+            tenants = [
+                TenantConfig("tenant-a", requests=20, concurrency=4, protected=True),
+                TenantConfig("tenant-b", requests=20, concurrency=4),
+            ]
+            guard = Guard(p95_budget_ms=10_000.0)
+            records = await run_fairness_async(
+                srv.url, tenants, run_dir, duration_s=0.5, guard=guard
+            )
+            return run_dir, records, guard
+
+    run_dir, records, guard = asyncio.run(go())
+    assert len(records) == 40
+    assert {r.tenant for r in records} == {"tenant-a", "tenant-b"}
+    summary = summarize(records, guard)
+    assert set(summary["tenants"]) == {"tenant-a", "tenant-b"}
+    assert summary["fairness_p95_ratio"] >= 1.0
+    assert 0 < summary["fairness_throughput_share_min_tenant"] <= 0.5
+    assert summary["guard"]["throttle_events"] == 0
+    # requests.csv round-trips through the standard run-dir contract
+    assert len(run_dir.read_requests()) == 40
+
+
+def test_summarize_single_tenant_has_no_ratio():
+    recs = [
+        RequestRecord(f"r{i}", start_ts=i, end_ts=i + 0.1, latency_ms=100.0,
+                      ok=True, tenant="only")
+        for i in range(5)
+    ]
+    s = summarize(recs)
+    assert "fairness_p95_ratio" not in s
+    assert s["fairness_throughput_share_min_tenant"] == 1.0
