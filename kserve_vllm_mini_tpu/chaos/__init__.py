@@ -1,0 +1,1 @@
+"""Fault injection + MTTR measurement (reference tools/chaos_harness.sh)."""

@@ -1,0 +1,347 @@
+"""Chaos harness: inject faults, measure MTTR and p95-under-fault, gate each.
+
+Reference behavior (tools/chaos_harness.sh): five fault scenarios —
+device-plugin restart (:148-161), pod preemption (:163-175), simulated OOM
+via ``kill -9 1`` in the container (:177-190), netem packet loss/delay
+(:192-206), node drain (:208-225). MTTR is the time for the
+InferenceService to report Ready again (:99-109); after recovery a bench
+runs and its results are gated, producing one row per fault in
+``resilience_table.json`` (:227-240).
+
+TPU adaptations: the device-plugin scenario targets the GKE
+``tpu-device-plugin`` DaemonSet (the nvidia-device-plugin analog), and node
+drain targets the TPU node pool — on single-host slices a drain forces a
+full slice reschedule, on multi-host slices it kills the whole pod group,
+so MTTR here includes TPU re-provisioning, which dominates
+(SURVEY.md §7.3 hard part 4).
+
+Everything is injectable (kubectl runner, bench function, sleep/clock) so
+the full scenario matrix runs in unit tests against a scripted fake cluster
+— the reference's mock-kubectl CI pattern (SURVEY.md §4.3), in-process.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Callable, Optional
+
+from kserve_vllm_mini_tpu.deploy.kubectl import Kubectl
+
+FAULTS = ["device-plugin-restart", "pod-kill", "oom-sim", "netem-loss", "node-drain"]
+
+
+@dataclass
+class FaultResult:
+    fault: str
+    injected: bool
+    recovered: bool
+    mttr_s: Optional[float] = None
+    p95_ms: Optional[float] = None
+    error_rate: Optional[float] = None
+    gate_ok: Optional[bool] = None
+    detail: str = ""
+
+    def row(self) -> dict[str, Any]:
+        return {
+            "fault": self.fault,
+            "injected": self.injected,
+            "recovered": self.recovered,
+            "mttr_s": None if self.mttr_s is None else round(self.mttr_s, 1),
+            "p95_ms": self.p95_ms,
+            "error_rate": self.error_rate,
+            "gate_ok": self.gate_ok,
+            "detail": self.detail,
+        }
+
+
+@dataclass
+class ChaosConfig:
+    namespace: str
+    service: str
+    ready_timeout_s: float = 900.0    # TPU pools recover in minutes, not 45 s
+    poll_interval_s: float = 5.0
+    quiesce_s: float = 10.0
+    netem_loss_pct: int = 10
+    netem_delay_ms: int = 50
+    netem_duration_s: float = 30.0
+
+
+class ChaosHarness:
+    def __init__(
+        self,
+        cfg: ChaosConfig,
+        kubectl: Optional[Kubectl] = None,
+        bench_fn: Optional[Callable[[str], dict[str, Any]]] = None,
+        gate_fn: Optional[Callable[[dict[str, Any]], bool]] = None,
+        sleep: Callable[[float], None] = time.sleep,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.cfg = cfg
+        self.kc = kubectl or Kubectl()
+        self.bench_fn = bench_fn        # fault name -> results dict; None skips bench
+        self.gate_fn = gate_fn          # results -> bool; None skips gating
+        self.sleep = sleep
+        self.clock = clock
+
+    # -- cluster helpers ---------------------------------------------------
+
+    def _predictor_pods(self) -> list[str]:
+        res = self.kc.run(
+            [
+                "get", "pods", "-n", self.cfg.namespace,
+                "-l", f"serving.kserve.io/inferenceservice={self.cfg.service}",
+                "-o", "jsonpath={.items[*].metadata.name}",
+            ]
+        )
+        return res.stdout.split() if res.ok else []
+
+    def _pod_node(self, pod: str) -> str:
+        res = self.kc.run(
+            ["get", "pod", pod, "-n", self.cfg.namespace,
+             "-o", "jsonpath={.spec.nodeName}"]
+        )
+        return res.stdout.strip() if res.ok else ""
+
+    def _isvc_ready(self) -> bool:
+        res = self.kc.run(
+            [
+                "get", "inferenceservice", self.cfg.service, "-n", self.cfg.namespace,
+                "-o", "jsonpath={.status.conditions[?(@.type=='Ready')].status}",
+            ]
+        )
+        return res.ok and res.stdout.strip() == "True"
+
+    def wait_ready(self) -> Optional[float]:
+        """MTTR timer (chaos_harness.sh:99-109): seconds until Ready, or
+        None on timeout."""
+        t0 = self.clock()
+        while self.clock() - t0 < self.cfg.ready_timeout_s:
+            if self._isvc_ready():
+                return self.clock() - t0
+            self.sleep(self.cfg.poll_interval_s)
+        return None
+
+    # -- fault injectors ---------------------------------------------------
+    # each returns (injected_ok, detail)
+
+    def _inject_device_plugin_restart(self) -> tuple[bool, str]:
+        res = self.kc.run(
+            ["delete", "pods", "-n", "kube-system",
+             "-l", "k8s-app=tpu-device-plugin", "--wait=false"]
+        )
+        return res.ok, res.stderr.strip() or "tpu-device-plugin pods deleted"
+
+    def _inject_pod_kill(self) -> tuple[bool, str]:
+        pods = self._predictor_pods()
+        if not pods:
+            return False, "no predictor pods found"
+        res = self.kc.run(
+            ["delete", "pod", pods[0], "-n", self.cfg.namespace,
+             "--grace-period=0", "--force", "--wait=false"]
+        )
+        return res.ok, res.stderr.strip() or f"killed {pods[0]}"
+
+    def _inject_oom_sim(self) -> tuple[bool, str]:
+        pods = self._predictor_pods()
+        if not pods:
+            return False, "no predictor pods found"
+        # killing PID 1 in the serving container simulates an engine OOM
+        # crash (chaos_harness.sh:177-190); the kubelet restarts it
+        res = self.kc.run(
+            ["exec", pods[0], "-n", self.cfg.namespace,
+             "-c", "kserve-container", "--", "kill", "-9", "1"]
+        )
+        # exec often reports error 137 as the container dies — that IS success
+        ok = res.ok or "137" in res.stderr or "connection" in res.stderr.lower()
+        return ok, f"kill -9 1 in {pods[0]}"
+
+    def _inject_netem_loss(self) -> tuple[bool, str]:
+        pods = self._predictor_pods()
+        if not pods:
+            return False, "no predictor pods found"
+        res = self.kc.run(
+            [
+                "exec", pods[0], "-n", self.cfg.namespace, "-c", "kserve-container",
+                "--", "tc", "qdisc", "add", "dev", "eth0", "root", "netem",
+                "loss", f"{self.cfg.netem_loss_pct}%",
+                "delay", f"{self.cfg.netem_delay_ms}ms",
+            ]
+        )
+        if not res.ok:
+            return False, f"tc unavailable: {res.stderr.strip()[:120]}"
+        return True, f"netem {self.cfg.netem_loss_pct}% loss on {pods[0]}"
+
+    def _clear_netem(self) -> None:
+        for pod in self._predictor_pods():
+            self.kc.run(
+                ["exec", pod, "-n", self.cfg.namespace, "-c", "kserve-container",
+                 "--", "tc", "qdisc", "del", "dev", "eth0", "root"]
+            )
+
+    def _inject_node_drain(self) -> tuple[bool, str]:
+        pods = self._predictor_pods()
+        node = self._pod_node(pods[0]) if pods else ""
+        if not node:
+            return False, "could not resolve predictor node"
+        self._drained_node = node
+        res = self.kc.run(
+            ["drain", node, "--ignore-daemonsets", "--delete-emptydir-data",
+             "--force", "--grace-period=30"],
+            timeout_s=300.0,
+        )
+        return res.ok, res.stderr.strip() or f"drained {node}"
+
+    def _uncordon(self) -> None:
+        node = getattr(self, "_drained_node", "")
+        if node:
+            self.kc.run(["uncordon", node])
+
+    # -- scenario loop -----------------------------------------------------
+
+    def run_fault(self, fault: str) -> FaultResult:
+        injectors = {
+            "device-plugin-restart": self._inject_device_plugin_restart,
+            "pod-kill": self._inject_pod_kill,
+            "oom-sim": self._inject_oom_sim,
+            "netem-loss": self._inject_netem_loss,
+            "node-drain": self._inject_node_drain,
+        }
+        if fault not in injectors:
+            raise ValueError(f"unknown fault {fault!r} (known: {FAULTS})")
+        if not self._isvc_ready():
+            return FaultResult(fault, False, False, detail="service not Ready before fault")
+
+        injected, detail = injectors[fault]()
+        result = FaultResult(fault, injected, False, detail=detail)
+        if not injected:
+            return result
+
+        try:
+            if fault == "netem-loss":
+                # degradation fault: service stays Ready; bench DURING the
+                # fault, then clear it (chaos_harness.sh:192-206)
+                result.recovered = True
+                result.mttr_s = 0.0
+                self._bench_and_gate(result, during_fault=True)
+                return result
+
+            mttr = self.wait_ready()
+            result.mttr_s = mttr
+            result.recovered = mttr is not None
+            if not result.recovered:
+                result.detail += f"; not Ready after {self.cfg.ready_timeout_s:.0f}s"
+                return result
+            self.sleep(self.cfg.quiesce_s)
+            self._bench_and_gate(result, during_fault=False)
+            return result
+        finally:
+            if fault == "netem-loss":
+                self._clear_netem()
+            elif fault == "node-drain":
+                self._uncordon()
+
+    def _bench_and_gate(self, result: FaultResult, during_fault: bool) -> None:
+        if self.bench_fn is None:
+            return
+        try:
+            results = self.bench_fn(result.fault)
+        except Exception as e:  # noqa: BLE001 — a failed bench is a data point
+            result.detail += f"; bench failed: {type(e).__name__}: {e}"
+            result.gate_ok = False
+            return
+        result.p95_ms = results.get("p95_ms")
+        result.error_rate = results.get("error_rate")
+        if self.gate_fn is not None:
+            result.gate_ok = bool(self.gate_fn(results))
+
+    def run_all(self, faults: Optional[list[str]] = None) -> list[FaultResult]:
+        out = []
+        for fault in faults or FAULTS:
+            print(f"chaos: injecting {fault}", file=sys.stderr)
+            res = self.run_fault(fault)
+            status = (
+                f"MTTR {res.mttr_s:.0f}s" if res.recovered and res.mttr_s is not None
+                else "NOT RECOVERED" if res.injected else "SKIPPED"
+            )
+            print(f"chaos: {fault}: {status} ({res.detail})", file=sys.stderr)
+            out.append(res)
+        return out
+
+
+def write_resilience_table(
+    results: list[FaultResult], path: Path, cfg: ChaosConfig
+) -> dict[str, Any]:
+    table = {
+        "service": cfg.service,
+        "namespace": cfg.namespace,
+        "faults": [r.row() for r in results],
+        "all_recovered": all(r.recovered for r in results if r.injected),
+        "worst_mttr_s": max(
+            (r.mttr_s for r in results if r.mttr_s), default=None
+        ),
+    }
+    path.parent.mkdir(parents=True, exist_ok=True)
+    with path.open("w") as f:
+        json.dump(table, f, indent=2)
+    return table
+
+
+# -- CLI ---------------------------------------------------------------------
+
+def register(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument("--namespace", required=True)
+    parser.add_argument("--service", required=True)
+    parser.add_argument("--faults", default=",".join(FAULTS),
+                        help="Comma-separated subset of: " + ", ".join(FAULTS))
+    parser.add_argument("--url", default=None,
+                        help="Endpoint to bench after each fault (skip bench if unset)")
+    parser.add_argument("--requests", type=int, default=50)
+    parser.add_argument("--concurrency", type=int, default=5)
+    parser.add_argument("--slo", default=None, help="Gate each post-fault bench")
+    parser.add_argument("--ready-timeout", type=float, default=900.0)
+    parser.add_argument("--output", default="resilience_table.json")
+
+
+def run(args: argparse.Namespace) -> int:
+    cfg = ChaosConfig(
+        namespace=args.namespace,
+        service=args.service,
+        ready_timeout_s=args.ready_timeout,
+    )
+
+    bench_fn = None
+    if args.url:
+        def bench_fn(fault: str) -> dict[str, Any]:
+            from kserve_vllm_mini_tpu.bench_pipeline import run_bench
+
+            results, _ = run_bench(
+                url=args.url,
+                profile={
+                    "model": "default",
+                    "requests": args.requests,
+                    "concurrency": args.concurrency,
+                },
+            )
+            if not results:
+                raise RuntimeError("bench produced no results")
+            return results
+
+    gate_fn = None
+    if args.slo:
+        from kserve_vllm_mini_tpu.gates.slo import gate_results, load_slo
+
+        budgets = load_slo(args.slo)
+
+        def gate_fn(results: dict[str, Any]) -> bool:
+            return all(v.ok for v in gate_results(results, budgets))
+
+    harness = ChaosHarness(cfg, bench_fn=bench_fn, gate_fn=gate_fn)
+    results = harness.run_all([f.strip() for f in args.faults.split(",") if f.strip()])
+    table = write_resilience_table(results, Path(args.output), cfg)
+    print(json.dumps(table, indent=2))
+    return 0 if table["all_recovered"] else 1

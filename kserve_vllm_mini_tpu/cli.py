@@ -43,6 +43,11 @@ _SUBCOMMANDS: dict[str, tuple[str, str]] = {
     "bundle": ("kserve_vllm_mini_tpu.provenance.bundle", "Create a signed reproducible artifact bundle"),
     "deploy": ("kserve_vllm_mini_tpu.deploy.manifests", "Render/apply KServe TPU manifests"),
     "probe": ("kserve_vllm_mini_tpu.probes.net_storage", "Network/storage IO probe"),
+    "cache-probe": ("kserve_vllm_mini_tpu.probes.cache", "Infer prompt-cache hit ratio from TTFT deltas"),
+    "preflight": ("kserve_vllm_mini_tpu.deploy.preflight", "Cluster/local environment checks"),
+    "facts": ("kserve_vllm_mini_tpu.provenance.facts", "Collect cluster/local provenance facts"),
+    "matrix": ("kserve_vllm_mini_tpu.matrix.runner", "GA-hardening reference matrix run"),
+    "compile-sweep": ("kserve_vllm_mini_tpu.sweeps.compile_perf", "AOT compile-time vs serving-perf tradeoff"),
     "chaos": ("kserve_vllm_mini_tpu.chaos.harness", "Fault injection + MTTR measurement"),
 }
 

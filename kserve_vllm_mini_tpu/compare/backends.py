@@ -127,7 +127,9 @@ def default_bench_target_fn(
 
         merged = dict(profile)
         merged["streaming"] = streaming
-        merged.setdefault("backend", target.protocol)
+        # the per-target protocol is explicit (--target NAME:PROTOCOL=URL);
+        # it must beat any `backend` key a shared profile YAML carries
+        merged["backend"] = target.protocol
         results, code = run_bench(
             url=target.url or None,
             profile=merged,

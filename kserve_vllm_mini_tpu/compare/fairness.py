@@ -34,7 +34,8 @@ from kserve_vllm_mini_tpu.loadgen.prompts import make_prompt_fn
 
 class RollingP95:
     """p95 over a sliding window of the most recent N latencies
-    (fairness_dual_tenant.py:46-65) — kept sorted for O(log n) insert."""
+    (fairness_dual_tenant.py:46-65). The window is kept sorted so p95 is a
+    direct rank interpolation — no per-observation re-sort."""
 
     def __init__(self, window: int = 50):
         self.window = window
@@ -49,9 +50,16 @@ class RollingP95:
             del self._sorted[bisect.bisect_left(self._sorted, old)]
 
     def p95(self) -> float:
-        if not self._sorted:
+        s = self._sorted
+        if not s:
             return 0.0
-        return percentile(self._sorted, 95.0)
+        # same closest-rank interpolation as analysis.metrics.percentile,
+        # applied to the already-sorted window
+        rank = 0.95 * (len(s) - 1)
+        lo = int(rank)
+        hi = min(lo + 1, len(s) - 1)
+        frac = rank - lo
+        return s[lo] * (1.0 - frac) + s[hi] * frac
 
     def __len__(self) -> int:
         return len(self._recent)
@@ -104,13 +112,22 @@ class Guard:
             self._gate.set()
 
     async def wait_clear(self) -> None:
-        """Called by tenant-B workers before sending."""
-        if self._throttling and time.time() >= self._release_at:
-            # releases are driven by observations; recover here too so B is
-            # never gated forever when A's traffic has finished
-            self._throttling = False
-            self.throttled_s += time.time() - self._throttle_began
-            self._gate.set()
+        """Called by tenant-B workers before sending. Waits with a deadline,
+        not just on the event: releases are normally driven by protected-
+        tenant observations, but if tenant A finishes (or goes quiet) while
+        the gate is closed, a parked worker must wake itself at
+        ``_release_at`` rather than deadlock the run."""
+        while self._throttling:
+            remaining = self._release_at - time.time()
+            if remaining <= 0:
+                self._throttling = False
+                self.throttled_s += time.time() - self._throttle_began
+                self._gate.set()
+                break
+            try:
+                await asyncio.wait_for(self._gate.wait(), timeout=remaining + 0.01)
+            except asyncio.TimeoutError:
+                continue  # deadline passed (or was extended) — re-check
         await self._gate.wait()
 
 

@@ -1,0 +1,1 @@
+"""GA-hardening reference matrix (reference scripts/reference_runner.py)."""

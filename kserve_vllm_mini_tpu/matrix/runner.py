@@ -1,0 +1,222 @@
+"""Reference matrix runner: the GA-hardening acceptance grid.
+
+Reference behavior (scripts/reference_runner.py): run a hardware × model ×
+traffic matrix (:321-349), validate each cell against acceptance thresholds
+(:281-312), generate a BOM.md of everything in play (:65-110, k8s/KServe
+versions :114-137), and write matrix_summary.json (:351-390) plus optionally
+signed bundles. Configured by a YAML sheet (reference-matrix.yaml analog:
+``tpu-matrix.yaml``).
+
+TPU translation: the hardware axis is topology slices (v5e-1/-4/-8, v5p-…)
+instead of GPU SKUs; expected-throughput baselines are tokens/sec/chip; and
+the BOM captures JAX/libtpu versions, which determine XLA codegen, where
+the reference captured driver/CUDA versions.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+from typing import Any, Callable, Optional
+
+import yaml
+
+DEFAULT_MATRIX: dict[str, Any] = {
+    # acceptance thresholds (reference-matrix.yaml:52-57)
+    "thresholds": {
+        "p95_variance_pct": 10.0,       # p95 within ±10% of expectation
+        "error_rate_max": 0.01,
+        "cold_multiplier_max": 3.0,
+        "throughput_min_rps": 5.0,
+    },
+    "topologies": [
+        {"name": "v5e-8", "expected_tokens_per_sec_per_chip": 2000.0},
+    ],
+    "models": [
+        {"name": "llama-tiny", "expected_p95_ms": 2000.0},
+    ],
+    "traffic": [
+        {"pattern": "steady", "requests": 100, "concurrency": 10, "p95_budget_ms": 2000.0},
+        {"pattern": "bursty", "requests": 100, "concurrency": 20, "p95_budget_ms": 3000.0},
+    ],
+}
+
+# cell bench function: merged cell config -> flat results dict
+CellBenchFn = Callable[[dict[str, Any]], dict[str, Any]]
+
+
+def validate_cell(
+    results: dict[str, Any], cell: dict[str, Any], thresholds: dict[str, Any]
+) -> list[str]:
+    """Threshold validation (reference_runner.py:281-312). Returns failure
+    strings; empty means the cell is accepted."""
+    failures: list[str] = []
+
+    p95 = results.get("p95_ms")
+    budget = cell.get("p95_budget_ms") or cell.get("expected_p95_ms")
+    if p95 is None:
+        failures.append("p95_ms missing from results")
+    elif budget:
+        limit = budget * (1 + thresholds.get("p95_variance_pct", 10.0) / 100.0)
+        if p95 > limit:
+            failures.append(f"p95 {p95:.0f}ms > {limit:.0f}ms (budget {budget:.0f} ±var)")
+
+    err = results.get("error_rate")
+    if err is None:
+        failures.append("error_rate missing from results")
+    elif err > thresholds.get("error_rate_max", 0.01):
+        failures.append(f"error_rate {err:.3f} > {thresholds['error_rate_max']}")
+
+    cold = results.get("cold_multiplier")
+    if cold is not None and cold > thresholds.get("cold_multiplier_max", 3.0):
+        failures.append(f"cold_multiplier {cold:.1f} > {thresholds['cold_multiplier_max']}")
+
+    rps = results.get("throughput_rps")
+    if rps is not None and rps < thresholds.get("throughput_min_rps", 0.0):
+        failures.append(f"throughput {rps:.1f} rps < {thresholds['throughput_min_rps']}")
+
+    expected_tps = cell.get("expected_tokens_per_sec_per_chip")
+    tps = results.get("tokens_per_sec_per_chip")
+    if expected_tps and tps is not None and tps < 0.9 * expected_tps:
+        failures.append(
+            f"tokens/sec/chip {tps:.0f} < 90% of expected {expected_tps:.0f}"
+        )
+    return failures
+
+
+def render_bom(facts: dict[str, Any], matrix: dict[str, Any]) -> str:
+    """BOM.md: everything that defines the run (reference_runner.py:65-110)."""
+    git = facts.get("git", {})
+    local = facts.get("local", {})
+    cluster = facts.get("cluster", {})
+    lines = [
+        "# Bill of Materials — reference matrix run",
+        "",
+        "## Harness",
+        f"- commit: {git.get('commit', 'unknown')}{' (dirty)' if git.get('dirty') else ''}",
+        f"- python: {local.get('python')}  platform: {local.get('platform')}",
+        "",
+        "## Runtime stack",
+        f"- jax: {local.get('jax_version')}  jaxlib: {local.get('jaxlib_version')}",
+        f"- devices: {json.dumps(local.get('devices', []))}",
+        "",
+        "## Cluster",
+        f"- reachable: {cluster.get('reachable', False)}",
+        f"- kserve: {cluster.get('kserve_image')}",
+        f"- knative: {cluster.get('knative_image')}",
+        f"- tpu nodes: {len(cluster.get('tpu_nodes', []))}",
+        "",
+        "## Matrix",
+        f"- topologies: {[t['name'] for t in matrix['topologies']]}",
+        f"- models: {[m['name'] for m in matrix['models']]}",
+        f"- traffic: {[t['pattern'] for t in matrix['traffic']]}",
+        f"- thresholds: {json.dumps(matrix['thresholds'])}",
+    ]
+    return "\n".join(lines) + "\n"
+
+
+def run_matrix(
+    matrix: dict[str, Any],
+    bench_fn: CellBenchFn,
+    out_dir: Path,
+    facts: Optional[dict[str, Any]] = None,
+) -> dict[str, Any]:
+    """Execute every (topology, model, traffic) cell; write BOM.md +
+    matrix_summary.json; return the summary."""
+    out_dir.mkdir(parents=True, exist_ok=True)
+    thresholds = matrix.get("thresholds", DEFAULT_MATRIX["thresholds"])
+    if facts is None:
+        from kserve_vllm_mini_tpu.provenance.facts import collect_facts
+
+        facts = collect_facts(include_cluster=False)
+    (out_dir / "BOM.md").write_text(render_bom(facts, matrix))
+
+    cells = []
+    for topo in matrix["topologies"]:
+        for model in matrix["models"]:
+            for traffic in matrix["traffic"]:
+                cell = {**topo, **model, **traffic}
+                cell_id = f"{topo['name']}/{model['name']}/{traffic['pattern']}"
+                print(f"matrix: {cell_id}", file=sys.stderr)
+                t0 = time.time()
+                entry: dict[str, Any] = {
+                    "cell": cell_id,
+                    "topology": topo["name"],
+                    "model": model["name"],
+                    "pattern": traffic["pattern"],
+                }
+                try:
+                    results = bench_fn(dict(cell))
+                    failures = validate_cell(results, cell, thresholds)
+                    entry["results"] = {
+                        k: results.get(k)
+                        for k in ("p95_ms", "ttft_p95_ms", "throughput_rps",
+                                  "tokens_per_sec", "tokens_per_sec_per_chip",
+                                  "error_rate", "cold_multiplier")
+                    }
+                    entry["failures"] = failures
+                    entry["accepted"] = not failures
+                except Exception as e:  # noqa: BLE001 — record-and-continue
+                    entry["failures"] = [f"bench error: {type(e).__name__}: {e}"]
+                    entry["accepted"] = False
+                entry["elapsed_s"] = round(time.time() - t0, 1)
+                cells.append(entry)
+
+    summary = {
+        "schema": "kvmini-tpu/matrix/v1",
+        "cells": cells,
+        "accepted": sum(1 for c in cells if c["accepted"]),
+        "total": len(cells),
+        "all_accepted": all(c["accepted"] for c in cells),
+        "thresholds": thresholds,
+    }
+    with (out_dir / "matrix_summary.json").open("w") as f:
+        json.dump(summary, f, indent=2)
+    return summary
+
+
+def default_cell_bench(url: Optional[str]) -> CellBenchFn:
+    """Bench a cell via the standard pipeline (self-serve when no URL)."""
+
+    def bench(cell: dict[str, Any]) -> dict[str, Any]:
+        from kserve_vllm_mini_tpu.bench_pipeline import run_bench
+
+        profile = {
+            "model": cell["name"] if "llama" in str(cell.get("name")) else "llama-tiny",
+            "requests": cell.get("requests", 100),
+            "concurrency": cell.get("concurrency", 10),
+            "pattern": cell.get("pattern", "steady"),
+        }
+        results, code = run_bench(url=url, profile=profile, self_serve=not url)
+        if not results:
+            raise RuntimeError(f"bench exit {code}")
+        return results
+
+    return bench
+
+
+# -- CLI ---------------------------------------------------------------------
+
+def register(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument("--config", default=None, help="tpu-matrix.yaml (defaults inline)")
+    parser.add_argument("--url", default=None, help="Endpoint (self-serve if unset)")
+    parser.add_argument("--output-dir", default="matrix_results")
+
+
+def run(args: argparse.Namespace) -> int:
+    matrix = DEFAULT_MATRIX
+    if args.config:
+        with open(args.config) as f:
+            matrix = yaml.safe_load(f)
+    summary = run_matrix(
+        matrix, default_cell_bench(args.url), Path(args.output_dir)
+    )
+    for c in summary["cells"]:
+        mark = "PASS" if c["accepted"] else "FAIL"
+        detail = "" if c["accepted"] else " — " + "; ".join(c["failures"])
+        print(f"[{mark}] {c['cell']}{detail}")
+    print(f"matrix: {summary['accepted']}/{summary['total']} cells accepted")
+    return 0 if summary["all_accepted"] else 1

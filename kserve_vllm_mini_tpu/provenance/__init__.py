@@ -1,0 +1,3 @@
+"""Provenance & supply chain (framework L8): reproducible artifact bundles,
+cluster facts, SBOM/signing hooks (reference tools/{bundle_run,
+collect_cluster_facts,sbom,sign}.sh)."""

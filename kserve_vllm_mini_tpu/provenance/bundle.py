@@ -1,0 +1,221 @@
+"""Audit-grade, byte-reproducible artifact bundles of a benchmark run.
+
+Reference behavior (tools/bundle_run.sh): copy the run dir's artifacts
+(:110-137), write provenance.json (:139-173), capture cluster facts
+(:150-151), render a human SUMMARY.md (:254-300), hook SBOM/signing
+(:302-326), and produce a deterministic tar (fixed mtime, sorted names,
+:329-333) so two bundles of the same run are byte-identical.
+
+Implementation notes: tar determinism is done with Python ``tarfile`` by
+sorting members and zeroing per-entry mtime/uid/gid — and gzip with
+``mtime=0`` so the compressed stream is stable too. The bundle id is the
+run id, not a timestamp, for the same reason.
+"""
+
+from __future__ import annotations
+
+import argparse
+import gzip
+import io
+import json
+import tarfile
+import time
+from pathlib import Path
+from typing import Any, Optional
+
+from kserve_vllm_mini_tpu.core.rundir import RunDir
+from kserve_vllm_mini_tpu.provenance.facts import collect_facts
+from kserve_vllm_mini_tpu.provenance.sbom import generate_sboms, sign_artifact
+
+# run-dir files included in every bundle, when present (bundle_run.sh:110-137)
+ARTIFACT_FILES = [
+    "requests.csv",
+    "requests_classified.csv",
+    "meta.json",
+    "results.json",
+    "power.json",
+    "energy.json",
+    "io_probe.json",
+    "fairness_summary.json",
+    "traces/traces.json",
+]
+
+
+def build_provenance(
+    run_dir: RunDir,
+    facts: dict[str, Any],
+    extra: Optional[dict[str, Any]] = None,
+) -> dict[str, Any]:
+    meta = run_dir.read_meta()
+    results = run_dir.read_results()
+    return {
+        "schema": "kvmini-tpu/provenance/v1",
+        "run_id": run_dir.path.name,
+        "created_at": meta.get("finished_at") or meta.get("started_at"),
+        "workload": {
+            k: meta.get(k)
+            for k in ("model", "backend", "runtime", "pattern", "requests",
+                      "concurrency", "streaming", "max_tokens", "seed")
+        },
+        "headline": {
+            k: results.get(k)
+            for k in ("p95_ms", "ttft_p95_ms", "throughput_rps", "tokens_per_sec",
+                      "error_rate", "cost_per_1k_tokens", "energy_wh_per_1k_tokens")
+        },
+        "facts": facts,
+        **(extra or {}),
+    }
+
+
+def render_summary(provenance: dict[str, Any]) -> str:
+    """Human-readable SUMMARY.md (bundle_run.sh:254-300)."""
+    w = provenance["workload"]
+    h = provenance["headline"]
+
+    def fmt(v: Any, suffix: str = "") -> str:
+        return f"{v:.2f}{suffix}" if isinstance(v, (int, float)) else "n/a"
+
+    git = provenance["facts"].get("git", {})
+    lines = [
+        f"# Benchmark bundle: {provenance['run_id']}",
+        "",
+        "## Workload",
+        f"- model: {w.get('model')}  backend: {w.get('backend') or w.get('runtime')}",
+        f"- load: {w.get('requests')} requests @ concurrency {w.get('concurrency')},"
+        f" pattern {w.get('pattern')}, streaming {w.get('streaming')}",
+        f"- seed: {w.get('seed')} (rerun with the same seed for byte-identical load)",
+        "",
+        "## Headline results",
+        f"- p95 latency: {fmt(h.get('p95_ms'), ' ms')}",
+        f"- TTFT p95: {fmt(h.get('ttft_p95_ms'), ' ms')}",
+        f"- throughput: {fmt(h.get('throughput_rps'), ' rps')}"
+        f" ({fmt(h.get('tokens_per_sec'), ' tok/s')})",
+        f"- error rate: {fmt(h.get('error_rate'))}",
+        f"- cost: ${h.get('cost_per_1k_tokens'):.4f}/1K tokens"
+        if isinstance(h.get("cost_per_1k_tokens"), (int, float))
+        else "- cost: n/a",
+        f"- energy: {fmt(h.get('energy_wh_per_1k_tokens'), ' Wh/1K tokens')}",
+        "",
+        "## Provenance",
+        f"- harness commit: {git.get('commit', 'unknown')}"
+        + (" (dirty)" if git.get("dirty") else ""),
+        f"- jax: {provenance['facts'].get('local', {}).get('jax_version')}",
+        "",
+        "## Reproduce",
+        "```",
+        f"kvmini-tpu bench --url <endpoint> --requests {w.get('requests')}"
+        f" --concurrency {w.get('concurrency')} --pattern {w.get('pattern')}"
+        f" --seed {w.get('seed')}",
+        "```",
+    ]
+    return "\n".join(lines) + "\n"
+
+
+def _deterministic_targz(src_dir: Path, dest: Path) -> None:
+    """Sorted members, zeroed mtimes/owners, gzip mtime=0 → byte-stable
+    (the tarfile equivalent of `tar --sort=name --mtime=@0 --owner=0`)."""
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w") as tar:
+        for p in sorted(src_dir.rglob("*")):
+            arcname = f"{dest.stem.removesuffix('.tar')}/{p.relative_to(src_dir)}"
+            info = tar.gettarinfo(p, arcname=arcname)
+            info.mtime = 0
+            info.uid = info.gid = 0
+            info.uname = info.gname = ""
+            if p.is_file():
+                with p.open("rb") as f:
+                    tar.addfile(info, f)
+            else:
+                tar.addfile(info)
+    dest.parent.mkdir(parents=True, exist_ok=True)
+    with dest.open("wb") as f:
+        with gzip.GzipFile(fileobj=f, mode="wb", mtime=0) as gz:
+            gz.write(buf.getvalue())
+
+
+def bundle_run(
+    run_dir: RunDir,
+    out_dir: Path,
+    namespace: str = "",
+    include_cluster: bool = False,
+    sbom: bool = False,
+    sign_key: Optional[str] = None,
+    repo_dir: Optional[str] = None,
+    kubectl=None,
+) -> Path:
+    """Assemble and tar one run. Returns the bundle path."""
+    bundle_id = run_dir.path.name
+    stage = Path(out_dir) / f"stage-{bundle_id}"
+    if stage.exists():
+        import shutil as _sh
+
+        _sh.rmtree(stage)
+    stage.mkdir(parents=True)
+
+    copied = []
+    for rel in ARTIFACT_FILES:
+        src = run_dir.path / rel
+        if src.exists():
+            dest = stage / rel
+            dest.parent.mkdir(parents=True, exist_ok=True)
+            dest.write_bytes(src.read_bytes())
+            copied.append(rel)
+
+    facts = collect_facts(
+        namespace, repo_dir=repo_dir, kubectl=kubectl, include_cluster=include_cluster
+    )
+    sbom_report: dict[str, Any] = {"available": False, "reason": "not requested"}
+    if sbom:
+        images = facts.get("cluster", {}).get("image_digests", [])
+        sbom_report = generate_sboms(list(images), stage / "sbom")
+
+    provenance = build_provenance(
+        run_dir, facts, extra={"artifacts": copied, "sbom": sbom_report}
+    )
+    (stage / "provenance.json").write_text(json.dumps(provenance, indent=2, sort_keys=True))
+    (stage / "SUMMARY.md").write_text(render_summary(provenance))
+
+    bundle_path = Path(out_dir) / f"{bundle_id}.tar.gz"
+    _deterministic_targz(stage, bundle_path)
+    import shutil as _sh
+
+    _sh.rmtree(stage)
+
+    if sign_key is not None:
+        sig = sign_artifact(bundle_path, key=sign_key or None)
+        if sig.get("signed"):
+            print(f"bundle: signed -> {sig['signature']}")
+        elif not sig.get("available"):
+            print(f"bundle: signing skipped ({sig.get('reason')})")
+    return bundle_path
+
+
+# -- CLI ---------------------------------------------------------------------
+
+def register(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument("--run-dir", required=True)
+    parser.add_argument("--output-dir", default="artifacts")
+    parser.add_argument("--namespace", default="")
+    parser.add_argument("--cluster-facts", action="store_true",
+                        help="Query the live cluster for facts (off: local facts only)")
+    parser.add_argument("--sbom", action="store_true")
+    parser.add_argument("--sign", nargs="?", const="", default=None, metavar="KEY",
+                        help="cosign-sign the bundle (optional key path)")
+
+
+def run(args: argparse.Namespace) -> int:
+    run_dir = RunDir(args.run_dir)
+    if not run_dir.results_json.exists():
+        print(f"bundle: no results.json in {run_dir.path} — run analyze first")
+        return 1
+    t0 = time.time()
+    path = bundle_run(
+        run_dir,
+        Path(args.output_dir),
+        namespace=args.namespace,
+        include_cluster=args.cluster_facts,
+        sbom=args.sbom,
+        sign_key=args.sign,
+    )
+    print(f"bundle: {path} ({path.stat().st_size} bytes, {time.time() - t0:.1f}s)")
+    return 0

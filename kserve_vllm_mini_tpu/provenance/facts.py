@@ -1,0 +1,172 @@
+"""Cluster + environment fact collection for reproducibility bundles.
+
+Reference behavior (tools/collect_cluster_facts.sh): capture k8s/KServe/
+Knative/Istio versions (:46-67), accelerator node labels (:52-60), deployed
+pod image digests (:85-89), git state (:95-108), and helm releases
+(:111-121) into one JSON document. Every probe degrades gracefully — a
+missing binary or unreachable cluster yields a null section, never a crash
+(the harness must produce bundles from air-gapped result dirs too).
+
+TPU adaptations: node facts select GKE TPU labels
+(``cloud.google.com/gke-tpu-accelerator``, ``gke-tpu-topology``) instead of
+GPU product labels, and local facts record the JAX/libtpu runtime versions
+that determine XLA codegen.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import platform
+import subprocess
+from typing import Any, Optional
+
+from kserve_vllm_mini_tpu.deploy.kubectl import Kubectl
+
+
+def _git(args: list[str], cwd: Optional[str] = None) -> Optional[str]:
+    try:
+        proc = subprocess.run(
+            ["git", *args], capture_output=True, text=True, timeout=10, cwd=cwd
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    return proc.stdout.strip() if proc.returncode == 0 else None
+
+
+def git_facts(repo_dir: Optional[str] = None) -> dict[str, Any]:
+    """Commit/branch/dirty state of the harness itself
+    (collect_cluster_facts.sh:95-108)."""
+    commit = _git(["rev-parse", "HEAD"], repo_dir)
+    if commit is None:
+        return {"available": False}
+    return {
+        "available": True,
+        "commit": commit,
+        "branch": _git(["rev-parse", "--abbrev-ref", "HEAD"], repo_dir),
+        "describe": _git(["describe", "--always", "--dirty"], repo_dir),
+        "dirty": bool(_git(["status", "--porcelain"], repo_dir)),
+    }
+
+
+def local_facts() -> dict[str, Any]:
+    """Host + JAX runtime facts — the TPU analog of driver/CUDA versions."""
+    facts: dict[str, Any] = {
+        "python": platform.python_version(),
+        "platform": platform.platform(),
+    }
+    try:
+        import jax
+
+        facts["jax_version"] = jax.__version__
+        try:
+            import jaxlib
+
+            facts["jaxlib_version"] = jaxlib.__version__
+        except ImportError:
+            pass
+        # devices() initializes the backend; tolerate init failure on
+        # harness-only installs
+        try:
+            devices = jax.devices()
+            facts["devices"] = [
+                {"platform": d.platform, "kind": getattr(d, "device_kind", "?")}
+                for d in devices
+            ]
+        except Exception as e:  # noqa: BLE001
+            facts["devices_error"] = f"{type(e).__name__}: {e}"
+    except ImportError:
+        facts["jax_version"] = None
+    return facts
+
+
+def cluster_facts(
+    namespace: str = "", kubectl: Optional[Kubectl] = None
+) -> dict[str, Any]:
+    kc = kubectl or Kubectl()
+    facts: dict[str, Any] = {}
+
+    ver = kc.run(["version", "-o", "json"], timeout_s=15.0)
+    if not ver.ok:
+        return {"reachable": False, "error": ver.stderr.strip()[:200]}
+    facts["reachable"] = True
+    try:
+        facts["kubernetes"] = json.loads(ver.stdout)
+    except json.JSONDecodeError:
+        facts["kubernetes"] = {"raw": ver.stdout[:500]}
+
+    # component versions from deployment image tags (reference :46-67)
+    for name, (ns, deploy) in {
+        "kserve": ("kserve", "kserve-controller-manager"),
+        "knative": ("knative-serving", "controller"),
+        "istio": ("istio-system", "istiod"),
+    }.items():
+        res = kc.run(
+            ["get", "deployment", deploy, "-n", ns,
+             "-o", "jsonpath={.spec.template.spec.containers[0].image}"]
+        )
+        facts[f"{name}_image"] = res.stdout.strip() if res.ok else None
+
+    # TPU node inventory by GKE labels (GPU-label analog of :52-60)
+    nodes = kc.run(
+        ["get", "nodes", "-l", "cloud.google.com/gke-tpu-accelerator", "-o", "json"]
+    )
+    tpu_nodes = []
+    if nodes.ok:
+        try:
+            for item in json.loads(nodes.stdout).get("items", []):
+                labels = item["metadata"].get("labels", {})
+                tpu_nodes.append(
+                    {
+                        "name": item["metadata"]["name"],
+                        "accelerator": labels.get("cloud.google.com/gke-tpu-accelerator"),
+                        "topology": labels.get("cloud.google.com/gke-tpu-topology"),
+                        "machine_type": labels.get("node.kubernetes.io/instance-type"),
+                        "tpu_capacity": item.get("status", {})
+                        .get("capacity", {})
+                        .get("google.com/tpu"),
+                    }
+                )
+        except (json.JSONDecodeError, KeyError):
+            pass
+    facts["tpu_nodes"] = tpu_nodes
+
+    # deployed image digests in the benchmark namespace (:85-89)
+    if namespace:
+        pods = kc.run(
+            ["get", "pods", "-n", namespace,
+             "-o", "jsonpath={range .items[*]}{.status.containerStatuses[*].imageID}{'\\n'}{end}"]
+        )
+        if pods.ok:
+            facts["image_digests"] = sorted(
+                {line.strip() for line in pods.stdout.splitlines() if line.strip()}
+            )
+    return facts
+
+
+def collect_facts(
+    namespace: str = "",
+    repo_dir: Optional[str] = None,
+    kubectl: Optional[Kubectl] = None,
+    include_cluster: bool = True,
+) -> dict[str, Any]:
+    return {
+        "git": git_facts(repo_dir),
+        "local": local_facts(),
+        "cluster": cluster_facts(namespace, kubectl) if include_cluster
+        else {"reachable": False, "skipped": True},
+    }
+
+
+# -- CLI (exposed through `kvmini-tpu bundle --facts-only`) ------------------
+
+def register(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument("--namespace", default="")
+    parser.add_argument("--no-cluster", action="store_true")
+
+
+def run(args: argparse.Namespace) -> int:
+    print(json.dumps(
+        collect_facts(args.namespace, include_cluster=not args.no_cluster), indent=2
+    ))
+    return 0

@@ -1,0 +1,51 @@
+"""SBOM generation + artifact signing hooks.
+
+Reference behavior: tools/sbom.sh runs ``syft`` per deployed image into SPDX
+JSON (:60-79); tools/sign.sh signs bundles with ``cosign``. Both tools are
+optional externals — the harness checks availability first and records the
+skip in the bundle instead of failing (the reference's "binary guard" lint
+rule enforces the same, lint-test.yml:267-291)."""
+
+from __future__ import annotations
+
+import shutil
+import subprocess
+from pathlib import Path
+from typing import Any, Optional
+
+
+def _run(cmd: list[str], timeout_s: float = 300.0) -> tuple[bool, str]:
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=timeout_s)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        return False, str(e)
+    return proc.returncode == 0, proc.stderr.strip()[:300]
+
+
+def generate_sboms(images: list[str], out_dir: Path) -> dict[str, Any]:
+    """One SPDX JSON per image under ``out_dir`` (sbom.sh:60-79)."""
+    if shutil.which("syft") is None:
+        return {"available": False, "reason": "syft not on PATH", "generated": []}
+    out_dir.mkdir(parents=True, exist_ok=True)
+    generated, failed = [], []
+    for image in images:
+        safe = image.replace("/", "_").replace(":", "_").replace("@", "_")
+        dest = out_dir / f"{safe}.spdx.json"
+        ok, err = _run(["syft", image, "-o", f"spdx-json={dest}"])
+        (generated if ok else failed).append(
+            {"image": image, "path": str(dest)} if ok else {"image": image, "error": err}
+        )
+    return {"available": True, "generated": generated, "failed": failed}
+
+
+def sign_artifact(path: Path, key: Optional[str] = None) -> dict[str, Any]:
+    """Detached cosign signature next to the artifact (sign.sh)."""
+    if shutil.which("cosign") is None:
+        return {"available": False, "reason": "cosign not on PATH"}
+    sig = path.with_suffix(path.suffix + ".sig")
+    cmd = ["cosign", "sign-blob", "--yes", "--output-signature", str(sig), str(path)]
+    if key:
+        cmd += ["--key", key]
+    ok, err = _run(cmd)
+    return {"available": True, "signed": ok, "signature": str(sig) if ok else None,
+            **({} if ok else {"error": err})}

@@ -1,0 +1,229 @@
+"""Chaos harness + provenance bundle tests against a scripted fake cluster
+(the reference CI's mock-kubectl pattern, SURVEY.md §4.3, in-process)."""
+
+import gzip
+import json
+import tarfile
+
+import pytest
+
+from kserve_vllm_mini_tpu.chaos.harness import (
+    FAULTS,
+    ChaosConfig,
+    ChaosHarness,
+    write_resilience_table,
+)
+from kserve_vllm_mini_tpu.core.rundir import RequestRecord, RunDir
+from kserve_vllm_mini_tpu.deploy.kubectl import Kubectl, KubectlResult
+from kserve_vllm_mini_tpu.provenance.bundle import bundle_run, build_provenance, render_summary
+from kserve_vllm_mini_tpu.provenance.facts import collect_facts, git_facts
+
+
+class FakeCluster:
+    """Scripted kubectl: Ready flag flips false on fault, true after
+    ``recovery_polls`` readiness checks."""
+
+    def __init__(self, recovery_polls: int = 2, has_tc: bool = True):
+        self.ready = True
+        self.recovery_polls = recovery_polls
+        self._polls_left = 0
+        self.has_tc = has_tc
+        self.calls: list[list[str]] = []
+        self.uncordoned: list[str] = []
+
+    def kubectl(self) -> Kubectl:
+        return Kubectl(runner=self._run)
+
+    def _run(self, args, stdin_text=None, timeout_s=60.0) -> KubectlResult:
+        args = list(args)
+        self.calls.append(args)
+        joined = " ".join(args)
+        if "inferenceservice" in joined and "jsonpath" in joined:
+            if not self.ready:
+                self._polls_left -= 1
+                if self._polls_left <= 0:
+                    self.ready = True
+            return KubectlResult(True, "True" if self.ready else "False")
+        if args[:2] == ["get", "pods"] and "jsonpath" in joined:
+            return KubectlResult(True, "predictor-pod-0")
+        if args[:2] == ["get", "pod"] and "nodeName" in joined:
+            return KubectlResult(True, "tpu-node-a")
+        if args[0] == "delete":
+            self._trip()
+            return KubectlResult(True, "deleted")
+        if args[0] == "exec":
+            if "tc" in args:
+                if not self.has_tc:
+                    return KubectlResult(False, stderr="exec failed: tc not found")
+                return KubectlResult(True, "")
+            self._trip()
+            return KubectlResult(False, stderr="command terminated with exit code 137")
+        if args[0] == "drain":
+            self._trip()
+            return KubectlResult(True, "node drained")
+        if args[0] == "uncordon":
+            self.uncordoned.append(args[1])
+            return KubectlResult(True, "uncordoned")
+        return KubectlResult(True, "")
+
+    def _trip(self):
+        self.ready = False
+        self._polls_left = self.recovery_polls
+
+
+def _harness(cluster: FakeCluster, bench_results=None, gate_ok=True) -> ChaosHarness:
+    clock = {"t": 0.0}
+
+    def fake_sleep(s):
+        clock["t"] += s
+
+    def fake_clock():
+        clock["t"] += 0.01
+        return clock["t"]
+
+    bench_fn = (lambda fault: dict(bench_results)) if bench_results else None
+    gate_fn = (lambda results: gate_ok) if bench_results else None
+    return ChaosHarness(
+        ChaosConfig(namespace="ns", service="svc", ready_timeout_s=600.0,
+                    poll_interval_s=1.0, quiesce_s=0.0),
+        kubectl=cluster.kubectl(),
+        bench_fn=bench_fn,
+        gate_fn=gate_fn,
+        sleep=fake_sleep,
+        clock=fake_clock,
+    )
+
+
+def test_pod_kill_measures_mttr():
+    cluster = FakeCluster(recovery_polls=3)
+    h = _harness(cluster, bench_results={"p95_ms": 420.0, "error_rate": 0.0})
+    res = h.run_fault("pod-kill")
+    assert res.injected and res.recovered
+    assert res.mttr_s is not None and res.mttr_s > 0
+    assert res.p95_ms == 420.0
+    assert res.gate_ok is True
+
+
+def test_oom_sim_exit_137_counts_as_injected():
+    cluster = FakeCluster()
+    res = _harness(cluster).run_fault("oom-sim")
+    assert res.injected and res.recovered
+
+
+def test_netem_benches_during_fault_and_clears():
+    cluster = FakeCluster()
+    h = _harness(cluster, bench_results={"p95_ms": 900.0, "error_rate": 0.08},
+                 gate_ok=False)
+    res = h.run_fault("netem-loss")
+    assert res.injected and res.recovered and res.mttr_s == 0.0
+    assert res.gate_ok is False
+    # qdisc cleanup issued
+    assert any("del" in c for c in cluster.calls if c[0] == "exec" and "tc" in c)
+
+
+def test_netem_unavailable_tc_skips_cleanly():
+    cluster = FakeCluster(has_tc=False)
+    res = _harness(cluster).run_fault("netem-loss")
+    assert not res.injected
+    assert "tc unavailable" in res.detail
+
+
+def test_node_drain_uncordons_after():
+    cluster = FakeCluster()
+    res = _harness(cluster).run_fault("node-drain")
+    assert res.injected and res.recovered
+    assert cluster.uncordoned == ["tpu-node-a"]
+
+
+def test_run_all_and_resilience_table(tmp_path):
+    cluster = FakeCluster()
+    h = _harness(cluster, bench_results={"p95_ms": 100.0, "error_rate": 0.0})
+    results = h.run_all()
+    assert [r.fault for r in results] == FAULTS
+    table = write_resilience_table(
+        results, tmp_path / "resilience_table.json", h.cfg
+    )
+    assert table["all_recovered"] is True
+    assert table["worst_mttr_s"] > 0
+    persisted = json.loads((tmp_path / "resilience_table.json").read_text())
+    assert len(persisted["faults"]) == 5
+
+
+def test_not_ready_before_fault_skips():
+    cluster = FakeCluster()
+    cluster.ready = False
+    cluster._polls_left = 10**9
+    res = _harness(cluster).run_fault("pod-kill")
+    assert not res.injected
+    assert "not Ready" in res.detail
+
+
+def test_unknown_fault_rejected():
+    with pytest.raises(ValueError):
+        _harness(FakeCluster()).run_fault("meteor-strike")
+
+
+# -- provenance --------------------------------------------------------------
+
+def _make_run(tmp_path) -> RunDir:
+    rd = RunDir.create(root=tmp_path / "runs")
+    rd.path.mkdir(parents=True, exist_ok=True)
+    recs = [
+        RequestRecord(f"r{i}", start_ts=100.0 + i, end_ts=100.5 + i,
+                      latency_ms=500.0, ok=True, tokens_out=10)
+        for i in range(4)
+    ]
+    rd.write_requests(recs)
+    rd.write_meta({"model": "m", "backend": "openai", "requests": 4,
+                   "concurrency": 2, "pattern": "steady", "streaming": True,
+                   "max_tokens": 16, "seed": 42, "started_at": 100.0,
+                   "finished_at": 104.5})
+    rd.merge_into_results({"p95_ms": 500.0, "throughput_rps": 0.9,
+                           "error_rate": 0.0, "cost_per_1k_tokens": 0.004})
+    return rd
+
+
+def test_bundle_is_byte_reproducible(tmp_path):
+    rd = _make_run(tmp_path)
+    p1 = bundle_run(rd, tmp_path / "a", repo_dir="/root/repo")
+    p2 = bundle_run(rd, tmp_path / "b", repo_dir="/root/repo")
+    assert p1.read_bytes() == p2.read_bytes()
+
+
+def test_bundle_contents(tmp_path):
+    rd = _make_run(tmp_path)
+    bundle = bundle_run(rd, tmp_path / "out", repo_dir="/root/repo")
+    with tarfile.open(bundle, "r:gz") as tar:
+        names = tar.getnames()
+        member = tar.extractfile(f"{rd.path.name}/provenance.json")
+        prov = json.loads(member.read())
+    base = rd.path.name
+    assert f"{base}/results.json" in names
+    assert f"{base}/requests.csv" in names
+    assert f"{base}/SUMMARY.md" in names
+    assert prov["schema"] == "kvmini-tpu/provenance/v1"
+    assert prov["headline"]["p95_ms"] == 500.0
+    assert "requests.csv" in prov["artifacts"]
+    # harness git facts captured from the repo checkout
+    assert prov["facts"]["git"]["available"] is True
+
+
+def test_summary_renders_without_optional_metrics(tmp_path):
+    rd = _make_run(tmp_path)
+    prov = build_provenance(rd, collect_facts(include_cluster=False))
+    text = render_summary(prov)
+    assert "p95 latency: 500.00 ms" in text
+    assert "energy: n/a" in text
+    assert "--seed 42" in text
+
+
+def test_git_facts_outside_repo(tmp_path):
+    facts = git_facts(str(tmp_path))
+    assert facts["available"] is False
+
+
+def test_cluster_facts_unreachable():
+    kc = Kubectl(runner=lambda a, s=None, t=60.0: KubectlResult(False, stderr="no cluster"))
+    facts = collect_facts(namespace="ns", kubectl=kc, include_cluster=True)
+    assert facts["cluster"]["reachable"] is False
+    assert facts["local"]["python"]

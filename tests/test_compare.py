@@ -170,6 +170,21 @@ def test_guard_throttles_and_releases():
     asyncio.run(go())
 
 
+def test_guard_no_deadlock_when_protected_tenant_goes_quiet():
+    """Workers parked on the gate must self-release at the deadline even if
+    no further protected-tenant observation arrives (regression: fairness
+    runs hung when tenant A finished while throttling)."""
+
+    async def go():
+        guard = Guard(p95_budget_ms=10.0, cooldown_s=0.1, min_samples=5)
+        for _ in range(10):
+            guard.observe(100.0)  # breach; tenant A then goes silent
+        await asyncio.wait_for(guard.wait_clear(), timeout=2.0)
+        assert guard.total_throttled_s() >= 0.1
+
+    asyncio.run(go())
+
+
 def test_fairness_end_to_end_and_summary(tmp_path):
     async def go():
         async with MockServer(token_delay_s=0.001) as srv:

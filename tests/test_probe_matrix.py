@@ -1,0 +1,171 @@
+"""Cache probe statistics, compile-perf sweep, reference matrix runner."""
+
+import asyncio
+import json
+import random
+
+import pytest
+
+from kserve_vllm_mini_tpu.matrix.runner import (
+    DEFAULT_MATRIX,
+    render_bom,
+    run_matrix,
+    validate_cell,
+)
+from kserve_vllm_mini_tpu.probes.cache import infer_cache_stats, run_cache_probe, welch_t
+
+
+# -- cache probe statistics --------------------------------------------------
+
+def test_welch_t_detects_difference():
+    rng = random.Random(0)
+    a = [100 + rng.gauss(0, 5) for _ in range(50)]
+    b = [60 + rng.gauss(0, 5) for _ in range(50)]
+    t, p = welch_t(a, b)
+    assert t > 10 and p < 0.001
+
+
+def test_welch_t_no_difference():
+    rng = random.Random(1)
+    a = [100 + rng.gauss(0, 5) for _ in range(50)]
+    b = [100 + rng.gauss(0, 5) for _ in range(50)]
+    _, p = welch_t(a, b)
+    assert p > 0.05
+
+
+def test_infer_cache_active():
+    rng = random.Random(2)
+    unique = [200 + rng.gauss(0, 10) for _ in range(60)]
+    # 80% of repeats hit cache (fast), 20% miss
+    repeat = [30 + rng.gauss(0, 5) for _ in range(48)] + \
+             [200 + rng.gauss(0, 10) for _ in range(12)]
+    stats = infer_cache_stats(repeat, unique)
+    assert stats["valid"] and stats["significant"]
+    assert 0.6 <= stats["inferred_hit_ratio"] <= 0.95
+    assert stats["ttft_speedup"] > 2.0
+
+
+def test_infer_cache_inactive():
+    rng = random.Random(3)
+    unique = [200 + rng.gauss(0, 10) for _ in range(60)]
+    repeat = [200 + rng.gauss(0, 10) for _ in range(60)]
+    stats = infer_cache_stats(repeat, unique)
+    assert stats["valid"]
+    assert not stats["significant"]
+    assert stats["inferred_hit_ratio"] == 0.0
+
+
+def test_infer_cache_empty_invalid():
+    assert infer_cache_stats([], [1.0])["valid"] is False
+
+
+def test_cache_probe_end_to_end(tmp_path):
+    """Against the mock server both sets see identical timing -> no
+    significant effect, and both run dirs persist."""
+    from tests.mock_server import MockServer
+    import threading
+
+    started, stop, holder = threading.Event(), threading.Event(), {}
+
+    def serve():
+        async def main():
+            async with MockServer(token_delay_s=0.001) as srv:
+                holder["url"] = srv.url
+                started.set()
+                while not stop.is_set():
+                    await asyncio.sleep(0.02)
+
+        asyncio.run(main())
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    assert started.wait(10)
+    try:
+        stats = run_cache_probe(
+            holder["url"], requests=12, concurrency=4, max_tokens=4,
+            input_tokens=16, run_root=tmp_path,
+        )
+    finally:
+        stop.set()
+        t.join(timeout=5)
+    assert stats["valid"]
+    assert stats["samples"] == {"repeat": 12, "unique": 12}
+    assert set(stats["run_dirs"]) == {"repeat", "unique"}
+    results = json.loads(
+        (tmp_path / stats["run_dirs"]["repeat"].split("/")[-1] / "results.json").read_text()
+    )
+    assert "cache_hit_ratio" in results
+
+
+# -- compile-perf sweep ------------------------------------------------------
+
+def test_compile_sweep_measures(tmp_path):
+    jax = pytest.importorskip("jax")
+    from kserve_vllm_mini_tpu.sweeps.compile_perf import CompileConfig, run_compile_sweep
+
+    rows = run_compile_sweep(
+        [CompileConfig(model="llama-tiny", slots=2, max_seq=128, prefill_bucket=32),
+         CompileConfig(model="llama-tiny", slots=2, max_seq=128, prefill_bucket=32,
+                       quantization="int8")],
+        tmp_path / "compile_sweep.csv",
+        decode_steps=4,
+    )
+    assert all(r["status"] == "ok" for r in rows), rows
+    for r in rows:
+        assert r["compile_total_s"] > 0
+        assert r["decode_tokens_per_sec"] > 0
+    # int8 params are smaller than bf16
+    assert rows[1]["params_mib"] < rows[0]["params_mib"]
+    text = (tmp_path / "compile_sweep.csv").read_text()
+    assert text.count("\n") == 3  # header + 2 rows
+
+
+# -- matrix runner -----------------------------------------------------------
+
+def _cell_results(p95=1000.0, err=0.0, rps=20.0, cold=1.5, tps_chip=2500.0):
+    return {"p95_ms": p95, "error_rate": err, "throughput_rps": rps,
+            "cold_multiplier": cold, "tokens_per_sec": tps_chip,
+            "tokens_per_sec_per_chip": tps_chip}
+
+
+def test_validate_cell_accepts_within_thresholds():
+    cell = {"p95_budget_ms": 2000.0, "expected_tokens_per_sec_per_chip": 2000.0}
+    assert validate_cell(_cell_results(), cell, DEFAULT_MATRIX["thresholds"]) == []
+
+
+def test_validate_cell_flags_each_violation():
+    cell = {"p95_budget_ms": 500.0, "expected_tokens_per_sec_per_chip": 5000.0}
+    failures = validate_cell(
+        _cell_results(p95=1000.0, err=0.2, cold=5.0, rps=1.0, tps_chip=100.0),
+        cell, DEFAULT_MATRIX["thresholds"],
+    )
+    text = " ".join(failures)
+    assert "p95" in text and "error_rate" in text and "cold_multiplier" in text
+    assert "throughput" in text and "tokens/sec/chip" in text
+
+
+def test_validate_cell_missing_metrics_fail():
+    failures = validate_cell({}, {"p95_budget_ms": 100.0}, DEFAULT_MATRIX["thresholds"])
+    assert any("missing" in f for f in failures)
+
+
+def test_run_matrix_summary_and_bom(tmp_path):
+    calls = []
+
+    def bench(cell):
+        calls.append(cell)
+        if cell["pattern"] == "bursty":
+            raise RuntimeError("endpoint melted")
+        return _cell_results()
+
+    summary = run_matrix(DEFAULT_MATRIX, bench, tmp_path)
+    assert summary["total"] == 2          # 1 topo × 1 model × 2 traffic
+    assert summary["accepted"] == 1
+    assert not summary["all_accepted"]
+    failed = [c for c in summary["cells"] if not c["accepted"]][0]
+    assert "bench error" in failed["failures"][0]
+    assert (tmp_path / "BOM.md").exists()
+    persisted = json.loads((tmp_path / "matrix_summary.json").read_text())
+    assert persisted["schema"] == "kvmini-tpu/matrix/v1"
+    bom = (tmp_path / "BOM.md").read_text()
+    assert "jax:" in bom and "thresholds" in bom
