@@ -1,0 +1,44 @@
+# Developer entry points (reference Makefile). Python-only build; no wheels
+# of native code — the TPU compute path is JAX/XLA compiled at runtime.
+PY ?= python
+
+.PHONY: help test test-fast lint fmt smoke bench dashboards-validate helm-lint airgap clean
+
+help:
+	@grep -E '^[a-z-]+:' Makefile | sed 's/:.*//' | sort | uniq
+
+test:
+	$(PY) -m pytest tests/ -q
+
+test-fast:  ## harness-only tests (skip JAX model/runtime suites)
+	$(PY) -m pytest tests/ -q --ignore=tests/test_model.py \
+	  --ignore=tests/test_parallel.py --ignore=tests/test_flash_attention.py \
+	  --ignore=tests/test_runtime.py --ignore=tests/test_loader.py \
+	  --ignore=tests/test_quant.py
+
+lint:
+	$(PY) -m ruff check kserve_vllm_mini_tpu tests || true
+	$(PY) -c "import yaml,glob;[list(yaml.safe_load_all(open(f))) for f in glob.glob('profiles/**/*.yaml',recursive=True)+glob.glob('policies/**/*.yaml',recursive=True)]"
+	$(PY) -c "import json,glob;[json.load(open(f)) for f in glob.glob('dashboards/*.json')]"
+
+fmt:
+	$(PY) -m ruff format kserve_vllm_mini_tpu tests 2>/dev/null || true
+
+smoke:  ## full pipeline on the CPU-faked mesh, no hardware
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	  $(PY) -m kserve_vllm_mini_tpu bench --self-serve --model llama-tiny \
+	  --requests 20 --concurrency 4 --max-tokens 8
+
+bench:  ## driver benchmark (one JSON line) on the attached accelerator
+	$(PY) bench.py
+
+helm-lint:
+	@command -v helm >/dev/null && helm lint charts/kvmini-tpu || \
+	  echo "helm not installed; skipping"
+
+airgap:  ## wheel + charts + profiles tarball for disconnected installs
+	$(PY) -m pip wheel . -w dist/ --no-deps
+	tar czf dist/kvmini-tpu-airgap.tar.gz dist/*.whl charts profiles policies dashboards slo.json tpu-cost.yaml tpu-matrix.yaml
+
+clean:
+	rm -rf dist build *.egg-info runs artifacts

@@ -1,0 +1,6 @@
+{{- define "kvmini-tpu.labels" -}}
+app.kubernetes.io/managed-by: kvmini-tpu
+app.kubernetes.io/name: {{ .Values.name }}
+kvmini-tpu/backend: {{ .Values.backend.name }}
+kvmini-tpu/topology: {{ .Values.topology.name }}
+{{- end }}

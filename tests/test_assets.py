@@ -1,0 +1,153 @@
+"""Asset-layer validation: profiles, policies, Helm chart, dashboards,
+matrix sheet. The reference lints these in CI (yamllint, helm lint,
+dashboard-JSON validation — lint-test.yml); here the equivalent checks run
+as unit tests so `pytest` alone guards the whole tree."""
+
+import json
+from pathlib import Path
+
+import pytest
+import yaml
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def _load_all(path: Path):
+    with path.open() as f:
+        return list(yaml.safe_load_all(f))
+
+
+# -- profiles ----------------------------------------------------------------
+
+def test_load_profiles_parse_and_validate():
+    from kserve_vllm_mini_tpu.core.validate import validate_profile
+
+    files = sorted((REPO / "profiles" / "load").glob("*.yaml"))
+    assert len(files) >= 7
+    for f in files:
+        profile = yaml.safe_load(f.read_text())
+        assert profile["name"] == f.stem
+        assert profile["pattern"] in ("steady", "poisson", "bursty", "heavy")
+        rep = validate_profile(dict(profile))
+        assert rep.ok, f"{f.name}: {rep.errors}"
+
+
+def test_quantization_profiles_are_tpu_legal():
+    from kserve_vllm_mini_tpu.core.validate import TPU_QUANT_OK
+
+    files = sorted((REPO / "profiles" / "quantization").glob("*.yaml"))
+    assert len(files) >= 4
+    for f in files:
+        q = yaml.safe_load(f.read_text())
+        assert q["quantization"] in TPU_QUANT_OK, f.name
+
+
+def test_topology_profiles_match_registry():
+    from kserve_vllm_mini_tpu.deploy.topology import get_topology
+
+    files = sorted((REPO / "profiles" / "topology").glob("*.yaml"))
+    assert len(files) >= 5
+    for f in files:
+        t = yaml.safe_load(f.read_text())
+        topo = get_topology(t["name"])
+        assert topo.chips * topo.hosts == t["chips"] * t.get("hosts", 1) or \
+            topo.chips == t["chips"], f.name
+
+
+# -- policies ----------------------------------------------------------------
+
+def test_kyverno_policies_shape():
+    files = sorted((REPO / "policies" / "kyverno").glob("*.yaml"))
+    assert len(files) == 4
+    for f in files:
+        for doc in _load_all(f):
+            assert doc["kind"] == "ClusterPolicy"
+            assert doc["spec"]["validationFailureAction"] in ("Audit", "Enforce")
+            assert doc["spec"]["rules"], f.name
+
+
+def test_gatekeeper_policies_shape():
+    templates = _load_all(REPO / "policies" / "gatekeeper" / "constrainttemplates.yaml")
+    constraints = _load_all(REPO / "policies" / "gatekeeper" / "constraints.yaml")
+    template_kinds = {t["spec"]["crd"]["spec"]["names"]["kind"] for t in templates}
+    for c in constraints:
+        assert c["kind"] in template_kinds, f"constraint {c['kind']} has no template"
+    for t in templates:
+        rego = t["spec"]["targets"][0]["rego"]
+        assert "violation[" in rego
+
+
+def test_tpu_policy_uses_tpu_resource_key():
+    text = (REPO / "policies" / "kyverno" / "tpu-requests.yaml").read_text()
+    assert "google.com/tpu" in text
+    assert "nvidia.com/gpu" not in text
+
+
+# -- helm chart --------------------------------------------------------------
+
+def test_chart_values_match_schema():
+    jsonschema = pytest.importorskip("jsonschema")
+    chart = REPO / "charts" / "kvmini-tpu"
+    values = yaml.safe_load((chart / "values.yaml").read_text())
+    schema = json.loads((chart / "values.schema.json").read_text())
+    jsonschema.validate(values, schema)
+
+
+def test_chart_schema_rejects_bad_backend():
+    jsonschema = pytest.importorskip("jsonschema")
+    chart = REPO / "charts" / "kvmini-tpu"
+    values = yaml.safe_load((chart / "values.yaml").read_text())
+    values["backend"]["name"] = "triton-gpu"
+    schema = json.loads((chart / "values.schema.json").read_text())
+    with pytest.raises(jsonschema.ValidationError):
+        jsonschema.validate(values, schema)
+
+
+def test_chart_template_covers_multihost_and_quant():
+    tpl = (REPO / "charts" / "kvmini-tpu" / "templates" / "isvc.yaml").read_text()
+    assert "workerSpec" in tpl
+    assert "google.com/tpu" in tpl
+    assert "gke-tpu-topology" in tpl
+    assert "QUANTIZATION" in tpl
+
+
+# -- dashboards --------------------------------------------------------------
+
+def test_dashboards_valid_and_tpu_native():
+    files = sorted((REPO / "dashboards").glob("*.json"))
+    assert len(files) == 4
+    uids = set()
+    for f in files:
+        d = json.loads(f.read_text())
+        assert d["title"].startswith("kvmini-tpu /")
+        assert d["panels"], f.name
+        uids.add(d["uid"])
+        for p in d["panels"]:
+            assert p["targets"], f"{f.name}:{p['title']} has no queries"
+        text = f.read_text()
+        assert "DCGM" not in text and "nvidia" not in text.lower(), (
+            f"{f.name} references GPU metrics"
+        )
+    assert len(uids) == 4  # unique dashboard uids
+
+
+def test_utilization_dashboard_queries_tpu_metrics():
+    d = (REPO / "dashboards" / "tpu-utilization.json").read_text()
+    assert "accelerator_duty_cycle" in d
+    assert "accelerator_memory_used" in d
+
+
+# -- matrix sheet ------------------------------------------------------------
+
+def test_tpu_matrix_sheet_loads_and_runs_validation():
+    from kserve_vllm_mini_tpu.matrix.runner import validate_cell
+
+    matrix = yaml.safe_load((REPO / "tpu-matrix.yaml").read_text())
+    assert matrix["topologies"] and matrix["models"] and matrix["traffic"]
+    cell = {**matrix["topologies"][0], **matrix["models"][0], **matrix["traffic"][0]}
+    ok = validate_cell(
+        {"p95_ms": 500.0, "error_rate": 0.0, "throughput_rps": 50.0,
+         "tokens_per_sec_per_chip": 5000.0},
+        cell, matrix["thresholds"],
+    )
+    assert ok == []
