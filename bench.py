@@ -27,7 +27,7 @@ def main() -> int:
     from kserve_vllm_mini_tpu.runtime.sampling import sample_tokens
 
     model = "llama-1b"
-    slots = 8
+    slots = 32
     prompt_len = 128
     max_seq = 1024
     decode_steps = 256

@@ -45,3 +45,39 @@ def test_flash_bf16():
     out = flash_attention(q, k, v, block_q=64, block_k=64, interpret=True)
     assert out.dtype == jnp.bfloat16
     assert float(jnp.max(jnp.abs(out.astype(jnp.float32) - ref.astype(jnp.float32)))) < 0.08
+
+
+def test_gqa_grouped_matches_repeat_kv():
+    """The grouped-GQA fast path must equal the materialized repeat_kv
+    reference, for every documented mask shape (the broadcastable contract:
+    2-D [T,S], [1,1,T,S], [B,1,T,S], and full per-head [B,H,T,S])."""
+    from kserve_vllm_mini_tpu.ops.attention import repeat_kv
+
+    B, H, KVH, T, S, D = 2, 8, 2, 4, 16, 32
+    q = _rand((B, H, T, D), 10)
+    k = _rand((B, KVH, S, D), 11)
+    v = _rand((B, KVH, S, D), 12)
+
+    def ref(mask):
+        kk, vv = repeat_kv(k, H // KVH), repeat_kv(v, H // KVH)
+        scale = D ** -0.5
+        logits = jnp.einsum("bhtd,bhsd->bhts", q, kk).astype(jnp.float32) * scale
+        if mask is not None:
+            logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+        probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        return jnp.einsum("bhts,bhsd->bhtd", probs, vv)
+
+    cm = causal_mask(T, S, offset=S - T)
+    masks = [
+        None,
+        cm,                                              # 2-D
+        cm[None, None],                                  # [1, 1, T, S]
+        jnp.broadcast_to(cm[None, None], (B, 1, T, S)),  # [B, 1, T, S]
+        jnp.broadcast_to(cm[None, None], (B, H, T, S)),  # full per-head
+    ]
+    for m in masks:
+        got = attention(q, k, v, m)
+        want = ref(m)
+        err = float(jnp.max(jnp.abs(got - want)))
+        shape = None if m is None else m.shape
+        assert err < 1e-5, f"mask {shape}: err {err}"
