@@ -31,6 +31,7 @@ from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from kserve_vllm_mini_tpu.models.config import ModelConfig
 from kserve_vllm_mini_tpu.models.llama import forward
@@ -45,6 +46,11 @@ class EngineConfig:
     min_prefill_bucket: int = 16
     seed: int = 0
     kv_cache_dtype: Optional[str] = None  # None -> model dtype (e.g. "float32")
+    # decode steps fused into one dispatch. 1 = lowest per-token latency;
+    # larger values amortize host dispatch + readback (the dominant cost
+    # when the accelerator is remote) at the price of streaming granularity
+    # and up to chunk-1 wasted steps when a request finishes mid-chunk.
+    decode_chunk: int = 1
 
 
 @dataclass
@@ -123,7 +129,7 @@ class Engine:
         self._rng = jax.random.PRNGKey(self.ecfg.seed)
         self._step_counter = 0
         self._prefill_fns: dict[int, Any] = {}
-        self._decode_fn = None
+        self._decode_fns: dict[int, Any] = {}
         self._running = False
         self._thread: Optional[threading.Thread] = None
         # sampling-parameter device arrays, rebuilt only on admit/finish —
@@ -174,23 +180,37 @@ class Engine:
         self._prefill_fns[bucket] = prefill
         return prefill
 
-    def _get_decode_fn(self):
-        if self._decode_fn is not None:
-            return self._decode_fn
+    def _get_decode_fn(self, n_steps: int = 1):
+        """Compiled decode of ``n_steps`` sampling steps in ONE dispatch.
+
+        Variants are cached per n_steps. The scan carries (cache, tokens,
+        lengths, rng) and stacks the sampled tokens [n_steps, S]; host state
+        is the source of truth between dispatches, so a request finishing
+        mid-chunk just has its surplus tokens discarded on the host (their
+        KV writes stay inside the slot's own buffer and are overwritten on
+        the next admission)."""
+        fn = self._decode_fns.get(n_steps)
+        if fn is not None:
+            return fn
         cfg = self.cfg
 
         @partial(jax.jit, donate_argnums=(1, 2))
         def decode(params, cache_k, cache_v, tokens, lengths, temps, topks, topps, rng):
-            # tokens: [S] int32 (last token per slot); lengths: [S]
-            toks = tokens[:, None]
-            pos = lengths[:, None]
-            logits, new_cache = forward(
-                params, cfg, toks, pos, {"k": cache_k, "v": cache_v}, lengths
-            )
-            nxt = sample_tokens(logits[:, 0, :], rng, temps, topks, topps)
-            return new_cache["k"], new_cache["v"], nxt
+            def body(carry, _):
+                ck, cv, toks, lens, r = carry
+                r, sub = jax.random.split(r)
+                logits, nc = forward(
+                    params, cfg, toks[:, None], lens[:, None], {"k": ck, "v": cv}, lens
+                )
+                nxt = sample_tokens(logits[:, 0, :], sub, temps, topks, topps)
+                return (nc["k"], nc["v"], nxt, lens + 1, r), nxt
 
-        self._decode_fn = decode
+            (ck, cv, _, _, _), toks_seq = jax.lax.scan(
+                body, (cache_k, cache_v, tokens, lengths, rng), None, length=n_steps
+            )
+            return ck, cv, toks_seq  # toks_seq: [n_steps, S]
+
+        self._decode_fns[n_steps] = decode
         return decode
 
     # -- public API --------------------------------------------------------
@@ -293,37 +313,54 @@ class Engine:
         active = [i for i in range(S) if self._slot_req[i] is not None]
         if not active:
             return
+        # chunk size: fused steps must stay inside every active slot's cache
+        # window (requests finishing mid-chunk are handled by surplus
+        # discard, NOT by shrinking the chunk — shrinking would compile a
+        # fresh scan variant for every distinct remaining-budget value and
+        # let one nearly-done request collapse fusion for the whole batch).
+        # Rounded down to a power of two so at most log2(decode_chunk)+1
+        # decode executables ever exist.
+        window = min(self.ecfg.max_seq_len - 1 - self._slot_len[i] for i in active)
+        chunk = max(1, min(self.ecfg.decode_chunk, window))
+        chunk = 1 << (chunk.bit_length() - 1)
         tokens = jnp.asarray(self._last_tokens, dtype=jnp.int32)
         # The fed token occupies absolute position slot_len (prompt + generated
         # tokens already written); forward writes its KV there and attends <=.
         lengths = jnp.asarray(self._slot_len, dtype=jnp.int32)
         temps, topks, topps = self._get_sampling_arrays()
         self._rng, sub = jax.random.split(self._rng)
-        decode = self._get_decode_fn()
+        decode = self._get_decode_fn(chunk)
         t0 = time.time()
-        self._cache_k, self._cache_v, nxt = decode(
+        self._cache_k, self._cache_v, toks_seq = decode(
             self.params, self._cache_k, self._cache_v,
             tokens, lengths, temps, topks, topps, sub,
         )
-        nxt_host = list(map(int, nxt))
+        # ONE host transfer for the whole [chunk, S] block — per-element
+        # int(row[i]) costs a separate device readback each (chunk x slots
+        # round-trips per sweep; this line was the serving bottleneck, not
+        # the decode math)
+        steps_host = np.asarray(jax.device_get(toks_seq)).tolist()
         now = time.time()
         self.stats["busy_s"] += now - t0
-        self.stats["decode_steps"] += 1
+        self.stats["decode_steps"] += chunk
 
-        for i in active:
-            handle = self._slot_req[i]
-            req = handle.request
-            tok = nxt_host[i]
-            self._slot_len[i] += 1          # the fed token is now in cache
-            self._last_tokens[i] = tok
-            handle.tokens.append(tok)
-            handle.events.put(("token", tok, now))
-            self.stats["decode_tokens"] += 1
-            self._slot_remaining[i] -= 1
-            hit_eos = req.eos_id is not None and tok == req.eos_id
-            out_of_space = self._slot_len[i] + 1 >= self.ecfg.max_seq_len
-            if self._slot_remaining[i] <= 0 or hit_eos or out_of_space:
-                self._finish_slot(i, "stop" if hit_eos else "length")
+        for step_tokens in steps_host:
+            for i in active:
+                handle = self._slot_req[i]
+                if handle is None:
+                    continue  # finished earlier in this chunk; surplus discarded
+                req = handle.request
+                tok = step_tokens[i]
+                self._slot_len[i] += 1      # the fed token is now in cache
+                self._last_tokens[i] = tok
+                handle.tokens.append(tok)
+                handle.events.put(("token", tok, now))
+                self.stats["decode_tokens"] += 1
+                self._slot_remaining[i] -= 1
+                hit_eos = req.eos_id is not None and tok == req.eos_id
+                out_of_space = self._slot_len[i] + 1 >= self.ecfg.max_seq_len
+                if self._slot_remaining[i] <= 0 or hit_eos or out_of_space:
+                    self._finish_slot(i, "stop" if hit_eos else "length")
 
     def _fail_all(self, exc: BaseException) -> None:
         """Push an error 'done' to every live/pending handle so no client

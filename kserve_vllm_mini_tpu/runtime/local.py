@@ -63,6 +63,7 @@ def start_local_server(
         topology=profile.get("jax_topology"),
         quantization=profile.get("quantization", "none") or "none",
         kv_cache_dtype=profile.get("kv_cache_dtype"),
+        decode_chunk=int(profile.get("decode_chunk", 1)),
     )
     engine.start()
     app = make_app(engine, tok, name)

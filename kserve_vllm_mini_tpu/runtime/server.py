@@ -39,6 +39,7 @@ def build_engine(
     seed: int = 0,
     quantization: str = "none",
     kv_cache_dtype: Optional[str] = None,
+    decode_chunk: int = 1,
 ) -> tuple[Engine, Tokenizer, str]:
     """Construct (engine, tokenizer, model_name) from a preset or checkpoint."""
     import jax
@@ -87,6 +88,7 @@ def build_engine(
         max_prefill_len=min(max_seq_len, cfg.max_seq_len) // 2,
         seed=seed,
         kv_cache_dtype=kv_cache_dtype,
+        decode_chunk=decode_chunk,
     )
     engine = Engine(params, cfg, ecfg, mesh=mesh, pad_id=tok.pad_id)
     return engine, tok, name
@@ -270,6 +272,9 @@ def register(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--topology", default=None,
                         help="Mesh topology preset (e.g. v5e-8); default single-device")
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--decode-chunk", type=int, default=1,
+                        help="Decode steps fused per dispatch (throughput vs "
+                             "streaming granularity)")
 
 
 def run(args: argparse.Namespace) -> int:
@@ -280,6 +285,7 @@ def run(args: argparse.Namespace) -> int:
         checkpoint=args.checkpoint,
         tokenizer_path=args.tokenizer,
         max_slots=args.max_slots,
+        decode_chunk=args.decode_chunk,
         max_seq_len=args.max_seq_len,
         topology=args.topology,
         seed=args.seed,

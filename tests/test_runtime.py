@@ -164,3 +164,70 @@ def test_byte_tokenizer_roundtrip():
     s = "hello, TPU éè!"
     assert tok.decode(tok.encode(s)) == s
     assert tok.vocab_size == 259
+
+
+def test_chunked_decode_matches_oracle(params):
+    """decode_chunk > 1 must produce exactly the chunk=1 greedy tokens —
+    fusing steps changes dispatch granularity, never results."""
+    eng = Engine(
+        params, CFG,
+        EngineConfig(max_slots=4, max_seq_len=128, max_prefill_len=64,
+                     min_prefill_bucket=16, decode_chunk=4),
+    )
+    eng.start()
+    try:
+        prompt = [5, 9, 42, 7, 13]
+        ref = greedy_reference(params, prompt, 13)  # 13: not a chunk multiple
+        h = eng.submit(GenRequest(prompt_tokens=prompt, max_new_tokens=13))
+        tokens, info = _drain(h)
+        assert tokens == ref
+        assert info["finish_reason"] == "length"
+        # first token comes from prefill; 12 decode steps yield tokens 2..13
+        assert eng.stats["decode_steps"] >= 12
+    finally:
+        eng.stop()
+
+
+def test_chunked_decode_eos_mid_chunk(params):
+    """EOS inside a fused chunk must stop the request at the right token and
+    discard the surplus."""
+    eng = Engine(
+        params, CFG,
+        EngineConfig(max_slots=4, max_seq_len=128, max_prefill_len=64,
+                     min_prefill_bucket=16, decode_chunk=8),
+    )
+    eng.start()
+    try:
+        prompt = [5, 9, 42, 7, 13]
+        ref = greedy_reference(params, prompt, 30)
+        idx = next(i for i in range(1, len(ref)) if ref[i] not in ref[:i])
+        eos = ref[idx]
+        h = eng.submit(GenRequest(prompt_tokens=prompt, max_new_tokens=30, eos_id=eos))
+        tokens, info = _drain(h)
+        assert tokens == ref[: idx + 1]
+        assert info["finish_reason"] == "stop"
+    finally:
+        eng.stop()
+
+
+def test_chunked_decode_concurrent_mixed_lengths(params):
+    """Two requests with different budgets under chunking: each gets exactly
+    its own tokens (no cross-slot surplus leakage)."""
+    eng = Engine(
+        params, CFG,
+        EngineConfig(max_slots=4, max_seq_len=128, max_prefill_len=64,
+                     min_prefill_bucket=16, decode_chunk=4),
+    )
+    eng.start()
+    try:
+        pa, pb = [5, 9, 42], [100, 3, 77, 4]
+        ra = greedy_reference(params, pa, 6)
+        rb = greedy_reference(params, pb, 11)
+        ha = eng.submit(GenRequest(prompt_tokens=pa, max_new_tokens=6))
+        hb = eng.submit(GenRequest(prompt_tokens=pb, max_new_tokens=11))
+        ta, _ = _drain(ha)
+        tb, _ = _drain(hb)
+        assert ta == ra
+        assert tb == rb
+    finally:
+        eng.stop()
