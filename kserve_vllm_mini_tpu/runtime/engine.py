@@ -51,6 +51,12 @@ class EngineConfig:
     # when the accelerator is remote) at the price of streaming granularity
     # and up to chunk-1 wasted steps when a request finishes mid-chunk.
     decode_chunk: int = 1
+    # speculative decoding: draft tokens proposed per round by the drafter
+    # model (requires a drafter; 0 disables). Greedy requests only — the
+    # accept rule is exact prefix match against the target's argmax, so
+    # output is bit-identical to plain greedy decode; sampled requests fall
+    # back to the normal sweep.
+    spec_tokens: int = 0
 
 
 @dataclass
@@ -93,6 +99,7 @@ class Engine:
         engine_cfg: Optional[EngineConfig] = None,
         mesh=None,
         pad_id: int = 0,
+        drafter: Optional[tuple[dict[str, Any], ModelConfig]] = None,
     ) -> None:
         self.cfg = cfg
         self.ecfg = engine_cfg or EngineConfig()
@@ -118,6 +125,19 @@ class Engine:
             self._cache_k = jax.device_put(self._cache_k, sh["k"])
             self._cache_v = jax.device_put(self._cache_v, sh["v"])
 
+        # speculative decoding: the drafter keeps its own KV cache with the
+        # same slot/seq geometry so slot bookkeeping is shared
+        self._drafter_params: Optional[dict[str, Any]] = None
+        self._drafter_cfg: Optional[ModelConfig] = None
+        if drafter is not None:
+            self._drafter_params, self._drafter_cfg = drafter
+            dcfg = self._drafter_cfg
+            dshape = (dcfg.n_layers, S, dcfg.n_kv_heads,
+                      self.ecfg.max_seq_len, dcfg.head_dim)
+            self._dcache_k = jnp.zeros(dshape, dtype=dcfg.jnp_dtype)
+            self._dcache_v = jnp.zeros(dshape, dtype=dcfg.jnp_dtype)
+        self._spec_fn = None
+
         # host-side slot state
         self._slot_req: list[Optional[RequestHandle]] = [None] * S
         self._slot_len = [0] * S
@@ -128,7 +148,7 @@ class Engine:
         self._pending: "queue.Queue[RequestHandle]" = queue.Queue()
         self._rng = jax.random.PRNGKey(self.ecfg.seed)
         self._step_counter = 0
-        self._prefill_fns: dict[int, Any] = {}
+        self._prefill_fns: dict[tuple[int, bool], Any] = {}
         self._decode_fns: dict[int, Any] = {}
         self._running = False
         self._thread: Optional[threading.Thread] = None
@@ -156,10 +176,11 @@ class Engine:
             b *= 2
         return min(b, self.ecfg.max_prefill_len)
 
-    def _get_prefill_fn(self, bucket: int):
-        if bucket in self._prefill_fns:
-            return self._prefill_fns[bucket]
-        cfg = self.cfg
+    def _get_prefill_fn(self, bucket: int, draft: bool = False):
+        key = (bucket, draft)
+        if key in self._prefill_fns:
+            return self._prefill_fns[key]
+        cfg = self._drafter_cfg if draft else self.cfg
 
         @partial(jax.jit, donate_argnums=(1, 2), static_argnums=())
         def prefill(params, cache_k, cache_v, tokens, length, slot):
@@ -177,7 +198,7 @@ class Engine:
             last = jax.lax.dynamic_index_in_dim(logits[0], length - 1, axis=0, keepdims=False)
             return cache_k, cache_v, last  # last: [V] f32
 
-        self._prefill_fns[bucket] = prefill
+        self._prefill_fns[key] = prefill
         return prefill
 
     def _get_decode_fn(self, n_steps: int = 1):
@@ -212,6 +233,60 @@ class Engine:
 
         self._decode_fns[n_steps] = decode
         return decode
+
+    def _get_spec_fn(self):
+        """One fused dispatch per speculative round: drafter proposes k
+        tokens (scan), the target verifies all of them in a single T=k
+        forward, and acceptance/bonus selection happens on-device. Greedy
+        exact-match acceptance ⇒ emitted tokens are bit-identical to plain
+        greedy decode of the target."""
+        if self._spec_fn is not None:
+            return self._spec_fn
+        cfg_t, cfg_d = self.cfg, self._drafter_cfg
+        k = self.ecfg.spec_tokens
+
+        @partial(jax.jit, donate_argnums=(1, 2, 4, 5))
+        def spec_step(params_t, ck_t, cv_t, params_d, ck_d, cv_d, last, lengths):
+            # drafter: k autoregressive proposals d1..dk
+            def dbody(carry, _):
+                ck, cv, tok, lens = carry
+                logits, nc = forward(
+                    params_d, cfg_d, tok[:, None], lens[:, None],
+                    {"k": ck, "v": cv}, lens,
+                )
+                nxt = jnp.argmax(logits[:, 0, :], axis=-1).astype(jnp.int32)
+                return (nc["k"], nc["v"], nxt, lens + 1), nxt
+
+            (ck_d, cv_d, _, _), drafts = jax.lax.scan(
+                dbody, (ck_d, cv_d, last, lengths), None, length=k
+            )
+            drafts = drafts.T                                   # [S, k]
+            # target verifies [last, d1..d_{k-1}] in one forward
+            fed = jnp.concatenate([last[:, None], drafts[:, :-1]], axis=1)
+            pos = lengths[:, None] + jnp.arange(k, dtype=jnp.int32)[None, :]
+            logits, nc_t = forward(
+                params_t, cfg_t, fed, pos, {"k": ck_t, "v": cv_t}, lengths
+            )
+            preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [S, k]
+            # accepted draft count a in 0..k-1: longest prefix where the
+            # target's argmax agrees with the draft
+            matches = preds[:, : k - 1] == drafts[:, : k - 1]
+            a = jnp.where(
+                jnp.all(matches, axis=1),
+                k - 1,
+                jnp.argmin(matches.astype(jnp.int32), axis=1),
+            ) if k > 1 else jnp.zeros(last.shape, jnp.int32)
+            bonus = jnp.take_along_axis(preds, a[:, None], axis=1)[:, 0]
+            # emit[s, j] = draft j while j < a, the bonus at j == a, -1 after
+            j = jnp.arange(k, dtype=jnp.int32)[None, :]
+            emit = jnp.where(
+                j < a[:, None], drafts,
+                jnp.where(j == a[:, None], bonus[:, None], -1),
+            )
+            return nc_t["k"], nc_t["v"], ck_d, cv_d, emit, a
+
+        self._spec_fn = spec_step
+        return spec_step
 
     # -- public API --------------------------------------------------------
 
@@ -260,6 +335,14 @@ class Engine:
             jnp.asarray([req.top_p], jnp.float32),
         )
         first_id = int(first[0])
+        if self._drafter_params is not None:
+            # drafter prefills the same prompt into its own cache so it can
+            # propose from full context; its output logits are unused
+            dprefill = self._get_prefill_fn(bucket, draft=True)
+            self._dcache_k, self._dcache_v, _ = dprefill(
+                self._drafter_params, self._dcache_k, self._dcache_v, tokens,
+                jnp.int32(n), jnp.int32(slot),
+            )
         self.stats["busy_s"] += time.time() - t0
         self.stats["prefills"] += 1
         self.stats["prefill_tokens"] += n
