@@ -1,52 +1,97 @@
 #!/usr/bin/env python3
-"""Driver benchmark: decode throughput of the in-repo engine on real TPU.
+"""Driver benchmark: the in-repo engine's serving numbers on real TPU,
+measured on the flagship 8B-class config against the north-star targets
+(BASELINE.md: >=2000 output tok/s/chip and p50 TTFT < 30 ms on
+Llama-3.1-8B-class @ v5e).
 
 Prints ONE JSON line:
-  {"metric": "...", "value": N, "unit": "...", "vs_baseline": N}
+  {"metric": "...", "value": N, "unit": "...", "vs_baseline": N, "detail": {...}}
 
-Measures steady-state decode tokens/sec/chip on a Llama-architecture model
-(llama-1b config, bf16, random weights — throughput is weight-value
-independent) with all engine slots busy, jitted decode steps, donated cache.
-Baseline: the north-star >=2000 output tokens/sec/chip
-(/root/repo/BASELINE.json; BASELINE.md north-star table).
+What it measures (honest accounting per VERDICT.md round-1 #4):
+- decode tokens/sec/chip: steady-state fused decode with all slots busy,
+  int8 weights (8B bf16 does not fit one v5e's 16 GB HBM; int8 is the
+  serving config the validator maps to v5e), donated caches.
+- ttft_p50_ms: steady-state single-request prefill latency (128-token
+  bucket, cache-write, flash-attention path) — the server-side TTFT a warm
+  engine adds to a request.
+- hbm_bw_util / mfu: achieved HBM weight+KV streaming as a fraction of v5e
+  peak (819 GB/s) and MXU utilization vs bf16 peak (197 TFLOP/s).
+- flash_prefill_lowered: asserts the prefill executable contains the Pallas
+  kernel custom-call on TPU (the serving path provably executes the kernel,
+  ops/flash_attention.py contract).
+
+Model size is overridable (KVMINI_BENCH_MODEL=llama-1b etc.) so the same
+script smoke-tests on CPU; the driver runs the default 8B config.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
+
+# v5e peak numbers (public spec): 819 GB/s HBM BW, 197 bf16 TFLOP/s
+V5E_HBM_GBPS = 819.0
+V5E_BF16_TFLOPS = 197.0
 
 
 def main() -> int:
     import jax
     import jax.numpy as jnp
+    import numpy as np
+
+    from functools import partial
 
     from kserve_vllm_mini_tpu.models.config import get_config
     from kserve_vllm_mini_tpu.models.llama import forward, init_kv_cache, init_params
+    from kserve_vllm_mini_tpu.ops.quant import quantize_params, quantized_bytes
     from kserve_vllm_mini_tpu.runtime.sampling import sample_tokens
 
-    model = "llama-1b"
-    slots = 32
+    model = os.environ.get("KVMINI_BENCH_MODEL", "llama-3.1-8b")
+    quant = os.environ.get("KVMINI_BENCH_QUANT", "int8")
+    slots = int(os.environ.get("KVMINI_BENCH_SLOTS", "32"))
     prompt_len = 128
-    max_seq = 1024
-    decode_steps = 256
-    warmup = 16
+    max_seq = 512
+    decode_steps = int(os.environ.get("KVMINI_BENCH_STEPS", "128"))
+    warmup = 8
 
+    on_tpu = jax.default_backend() == "tpu"
     cfg = get_config(model, max_seq_len=max_seq)
     params = init_params(jax.random.PRNGKey(0), cfg)
+    if quant == "int8":
+        params = quantize_params(params)
+    param_bytes = quantized_bytes(params)
 
     cache = init_kv_cache(cfg, slots, max_seq=max_seq)
     toks = jax.random.randint(jax.random.PRNGKey(1), (slots, prompt_len), 0, cfg.vocab_size)
     pos = jnp.broadcast_to(jnp.arange(prompt_len, dtype=jnp.int32), (slots, prompt_len))
 
-    from functools import partial
-
+    # -- batch prefill to fill all slots (fresh-prefill / flash path) -------
     @partial(jax.jit, donate_argnums=(1,))
-    def prefill(params, cache, toks, pos):
+    def prefill_batch(params, cache, toks, pos):
         logits, cache = forward(params, cfg, toks, pos, cache,
-                                jnp.zeros((slots,), jnp.int32))
+                                jnp.zeros((slots,), jnp.int32), fresh_prefill=True)
         return cache, jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+
+    # -- single-request prefill: the per-request TTFT cost ------------------
+    cache1 = init_kv_cache(cfg, 1, max_seq=max_seq)
+    toks1, pos1 = toks[:1], pos[:1]
+
+    @jax.jit
+    def prefill_one(params, cache, toks, pos):
+        logits, cache = forward(params, cfg, toks, pos, cache,
+                                jnp.zeros((1,), jnp.int32), fresh_prefill=True)
+        return jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+
+    lowered = prefill_one.lower(params, cache1, toks1, pos1).compile()
+    hlo = lowered.as_text()
+    flash_lowered = "tpu_custom_call" in hlo
+    if on_tpu:
+        assert flash_lowered, (
+            "serving prefill must lower the Pallas flash kernel on TPU "
+            "(ops/flash_attention.prefill_attention dispatch)"
+        )
 
     @partial(jax.jit, donate_argnums=(1,))
     def decode(params, cache, tokens, lengths, rng):
@@ -60,17 +105,25 @@ def main() -> int:
         )
         return cache, nxt
 
-    import numpy as np
-
     # NOTE on timing: under the remote-TPU relay, block_until_ready() does not
     # guarantee device-side completion — only a host readback does, and a
     # readback pays the tunnel RTT. We therefore time two chained runs of
     # different lengths, each ended by a readback, and difference them so the
     # RTT and dispatch overheads cancel.
-    t_pre0 = time.time()
-    cache, tokens = prefill(params, cache, toks, pos)
+    t0 = time.time()
+    cache, tokens = prefill_batch(params, cache, toks, pos)
     _ = np.asarray(tokens)
-    prefill_s = time.time() - t_pre0
+    prefill_first_s = time.time() - t0
+
+    # steady-state single-request prefill p50 (TTFT)
+    ttfts = []
+    _ = np.asarray(prefill_one(params, cache1, toks1, pos1))  # warm (compiled above)
+    for _i in range(15):
+        t0 = time.time()
+        out = prefill_one(params, cache1, toks1, pos1)
+        _ = np.asarray(out)
+        ttfts.append((time.time() - t0) * 1000.0)
+    ttft_p50 = float(np.percentile(ttfts, 50))
 
     lengths = jnp.full((slots,), prompt_len, dtype=jnp.int32)
     rng = jax.random.PRNGKey(2)
@@ -95,25 +148,50 @@ def main() -> int:
     t_long = time.time() - t0
 
     dt = max(t_long - t_short, 1e-9)
-    decode_steps = decode_steps - n_short
+    n_timed = decode_steps - n_short
+    step_ms = dt / n_timed * 1000.0
 
     n_chips = jax.device_count()
-    toks_per_sec = slots * decode_steps / dt
+    toks_per_sec = slots * n_timed / dt
     per_chip = toks_per_sec / n_chips
-    baseline = 2000.0  # north-star tokens/sec/chip
 
+    # achieved HBM streaming: every decode step reads all weights once plus
+    # the live KV prefix per slot (2 tensors, kv-heads, ctx, head_dim)
+    ctx_mid = prompt_len + warmup + n_short + n_timed // 2
+    kv_bytes_step = (
+        2 * cfg.n_layers * slots * cfg.n_kv_heads * ctx_mid * cfg.head_dim
+        * jnp.dtype(cfg.jnp_dtype).itemsize
+    )
+    bytes_step = param_bytes + kv_bytes_step
+    bw_gbps = bytes_step / (dt / n_timed) / 1e9
+    bw_util = bw_gbps / V5E_HBM_GBPS if on_tpu else 0.0
+
+    flops_step = 2.0 * cfg.param_count * slots
+    mfu = (flops_step / (dt / n_timed)) / (V5E_BF16_TFLOPS * 1e12) if on_tpu else 0.0
+
+    baseline = 2000.0  # north-star output tokens/sec/chip
     result = {
-        "metric": f"decode_tokens_per_sec_per_chip ({model}, bf16, slots={slots}, ctx~{prompt_len}+)",
+        "metric": (
+            f"decode_tokens_per_sec_per_chip ({cfg.name}, {quant}, "
+            f"slots={slots}, ctx~{prompt_len}+)"
+        ),
         "value": round(per_chip, 1),
         "unit": "tokens/s/chip",
         "vs_baseline": round(per_chip / baseline, 3),
         "detail": {
             "total_tokens_per_sec": round(toks_per_sec, 1),
-            "decode_step_ms": round(dt / decode_steps * 1000.0, 3),
-            "prefill_first_call_s": round(prefill_s, 2),
+            "decode_step_ms": round(step_ms, 3),
+            "ttft_p50_ms": round(ttft_p50, 2),
+            "ttft_target_ms": 30.0,
+            "prefill_first_call_s": round(prefill_first_s, 2),
+            "flash_prefill_lowered": bool(flash_lowered),
+            "hbm_bw_gbps": round(bw_gbps, 1),
+            "hbm_bw_util": round(bw_util, 3),
+            "mfu": round(mfu, 4),
+            "param_count": cfg.param_count,
+            "param_bytes": int(param_bytes),
             "n_chips": n_chips,
             "device": str(jax.devices()[0]),
-            "param_count": cfg.param_count,
         },
     }
     print(json.dumps(result))

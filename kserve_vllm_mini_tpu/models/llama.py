@@ -76,6 +76,64 @@ def init_kv_cache(cfg: ModelConfig, batch: int, max_seq: Optional[int] = None) -
 
 
 
+def qkv_proj(
+    p: Params,
+    cfg: ModelConfig,
+    h: jnp.ndarray,              # [B, T, D] (already attn-normed)
+    positions: jnp.ndarray,      # [B, T]
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """QKV projections + RoPE -> (q [B,H,T,hd], k [B,KVH,T,hd], v). The one
+    implementation every execution path (scan-rolled, cached, pipelined)
+    shares."""
+    B, T, _ = h.shape
+    q = linear(h, p["wq"]).reshape(B, T, cfg.n_heads, cfg.head_dim).transpose(0, 2, 1, 3)
+    k = linear(h, p["wk"]).reshape(B, T, cfg.n_kv_heads, cfg.head_dim).transpose(0, 2, 1, 3)
+    v = linear(h, p["wv"]).reshape(B, T, cfg.n_kv_heads, cfg.head_dim).transpose(0, 2, 1, 3)
+    return apply_rope(q, positions, cos, sin), apply_rope(k, positions, cos, sin), v
+
+
+def attn_out_and_mlp(
+    p: Params, cfg: ModelConfig, x: jnp.ndarray, o: jnp.ndarray
+) -> jnp.ndarray:
+    """Attention output projection + residual, then SwiGLU MLP + residual
+    (f32 silu accumulation). Shared tail of every layer execution path."""
+    B, T, _ = x.shape
+    dt = cfg.jnp_dtype
+    o = o.transpose(0, 2, 1, 3).reshape(B, T, cfg.n_heads * cfg.head_dim)
+    x = x + linear(o, p["wo"])
+    h = rms_norm(x, p["mlp_norm"], cfg.rms_eps)
+    gated = jax.nn.silu(linear(h, p["w_gate"]).astype(jnp.float32)).astype(dt) * linear(h, p["w_up"])
+    return x + linear(gated, p["w_down"])
+
+
+def layer_forward(
+    p: Params,
+    cfg: ModelConfig,
+    x: jnp.ndarray,              # [B, T, D]
+    positions: jnp.ndarray,      # [B, T] int32 absolute positions
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+    attention_fn=None,
+) -> jnp.ndarray:
+    """One cache-free decoder layer (pre-norm attn + SwiGLU MLP, residuals).
+
+    Shared by the scan-rolled forward below and the pipeline-parallel stage
+    executor (parallel/pipeline.py), so every execution strategy runs the
+    same layer math."""
+    T = x.shape[1]
+    h = rms_norm(x, p["attn_norm"], cfg.rms_eps)
+    q, k, v = qkv_proj(p, cfg, h, positions, cos, sin)
+    if attention_fn is not None:
+        o = attention_fn(q, k, v, positions)
+    else:
+        kj = jnp.arange(T)[None, None, :]
+        mask = (kj <= positions[:, :, None])[:, None, :, :]
+        o = attention(q, k, v, mask)
+    return attn_out_and_mlp(p, cfg, x, o)
+
+
 def forward(
     params: Params,
     cfg: ModelConfig,
@@ -85,13 +143,21 @@ def forward(
     cache_offsets: Optional[jnp.ndarray] = None,  # [B] slot where this block starts
     attention_fn=None,  # optional (q, k, v, positions) -> o override for the
                         # cache-free path (e.g. parallel.ring_attention for sp)
+    fresh_prefill: bool = False,  # static: this cached call writes a new
+                        # request's prompt at offset 0 (positions arange(T)),
+                        # so attention runs block-causal over the fresh
+                        # q/k/v via ops.flash_attention.prefill_attention
+                        # (Pallas kernel on TPU) instead of reading back the
+                        # whole max_seq cache buffer
 ) -> tuple[jnp.ndarray, Optional[KVCache]]:
     """Returns (logits [B, T, V] float32, updated cache).
 
     Without a cache this is a plain causal forward (training / compile
     checks). With a cache, keys/values of this block are written at
     ``cache_offsets`` and attention runs against the whole cache buffer with
-    positional masking.
+    positional masking — or block-causal over the fresh projections when
+    ``fresh_prefill`` (exact for offset-0 prefills; the engine's only
+    prefill shape).
     """
     B, T = tokens.shape
     dt = cfg.jnp_dtype
@@ -103,19 +169,6 @@ def forward(
     use_cache = kv_cache is not None
     if use_cache and cache_offsets is None:
         cache_offsets = jnp.zeros((B,), dtype=jnp.int32)
-
-    def qkv(h, p):
-        q = linear(h, p["wq"]).reshape(B, T, cfg.n_heads, cfg.head_dim).transpose(0, 2, 1, 3)
-        k = linear(h, p["wk"]).reshape(B, T, cfg.n_kv_heads, cfg.head_dim).transpose(0, 2, 1, 3)
-        v = linear(h, p["wv"]).reshape(B, T, cfg.n_kv_heads, cfg.head_dim).transpose(0, 2, 1, 3)
-        return apply_rope(q, positions, cos, sin), apply_rope(k, positions, cos, sin), v
-
-    def attn_out_and_mlp(x, o, p):
-        o = o.transpose(0, 2, 1, 3).reshape(B, T, cfg.n_heads * cfg.head_dim)
-        x = x + linear(o, p["wo"])
-        h = rms_norm(x, p["mlp_norm"], cfg.rms_eps)
-        gated = jax.nn.silu(linear(h, p["w_gate"]).astype(jnp.float32)).astype(dt) * linear(h, p["w_up"])
-        return x + linear(gated, p["w_down"])
 
     layers = params["layers"]
     if use_cache:
@@ -139,13 +192,18 @@ def forward(
             y0, ck, cv = carry
             p, lidx = layer_xs
             h = rms_norm(y0, p["attn_norm"], cfg.rms_eps)
-            q, k, v = qkv(h, p)
+            q, k, v = qkv_proj(p, cfg, h, positions, cos, sin)
             ck = ck.at[lidx, b_idx, h_idx, t_idx].set(k.astype(ck.dtype))
             cv = cv.at[lidx, b_idx, h_idx, t_idx].set(v.astype(cv.dtype))
-            k_layer = jax.lax.dynamic_index_in_dim(ck, lidx, axis=0, keepdims=False)
-            v_layer = jax.lax.dynamic_index_in_dim(cv, lidx, axis=0, keepdims=False)
-            o = attention(q, k_layer.astype(dt), v_layer.astype(dt), mask)
-            return (attn_out_and_mlp(y0, o, p), ck, cv), None
+            if fresh_prefill:
+                from kserve_vllm_mini_tpu.ops.flash_attention import prefill_attention
+
+                o = prefill_attention(q, k, v)
+            else:
+                k_layer = jax.lax.dynamic_index_in_dim(ck, lidx, axis=0, keepdims=False)
+                v_layer = jax.lax.dynamic_index_in_dim(cv, lidx, axis=0, keepdims=False)
+                o = attention(q, k_layer.astype(dt), v_layer.astype(dt), mask)
+            return (attn_out_and_mlp(p, cfg, y0, o), ck, cv), None
 
         (x, new_k, new_v), _ = jax.lax.scan(
             scan_body,
@@ -154,15 +212,7 @@ def forward(
         )
     else:
         def scan_body_nocache(carry, p):
-            h = rms_norm(carry, p["attn_norm"], cfg.rms_eps)
-            q, k, v = qkv(h, p)
-            if attention_fn is not None:
-                o = attention_fn(q, k, v, positions)
-            else:
-                kj = jnp.arange(T)[None, None, :]
-                mask = (kj <= positions[:, :, None])[:, None, :, :]
-                o = attention(q, k, v, mask)
-            return attn_out_and_mlp(carry, o, p), None
+            return layer_forward(p, cfg, carry, positions, cos, sin, attention_fn), None
 
         x, _ = jax.lax.scan(scan_body_nocache, x, layers)
         new_k = new_v = None

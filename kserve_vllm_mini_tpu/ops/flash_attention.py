@@ -8,8 +8,12 @@ BlockSpec index map (query head h reads kv head h // n_rep) so kv blocks are
 never materialized repeated.
 
 The jnp reference (ops/attention.py) is the correctness oracle; tests compare
-against it in interpret mode on CPU and the runtime uses the compiled kernel
-on TPU where the MXU sees [block_q, d] x [d, block_k] bf16 tiles.
+against it in interpret mode on CPU. The serving path reaches the kernel via
+``prefill_attention`` below (models/llama.py ``forward(fresh_prefill=True)``,
+called by runtime/engine.py's prefill step), which compiles the kernel on TPU
+— the MXU sees [block_q, d] x [d, block_k] bf16 tiles — and falls back to the
+jnp oracle on other backends. bench.py asserts the prefill executable
+actually lowers to a tpu_custom_call.
 """
 
 from __future__ import annotations
@@ -118,3 +122,41 @@ def flash_attention(
         ],
         interpret=interpret,
     )(q, k, v)
+
+
+def prefill_attention(
+    q: jnp.ndarray,   # [B, H, T, D]
+    k: jnp.ndarray,   # [B, KVH, T, D]
+    v: jnp.ndarray,   # [B, KVH, T, D]
+    use_flash: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Serving-prefill attention over a freshly projected block.
+
+    The engine's prefill writes a new request's whole prompt at cache offset
+    0, so block-causal attention over (q, k, v) themselves is exact — no
+    cache readback, and attention cost is T x T instead of T x max_seq.
+
+    Dispatch: the compiled Pallas kernel on TPU (prompts pad to power-of-two
+    buckets, so shapes are always block-aligned), the jnp oracle elsewhere.
+    ``use_flash`` forces the choice for tests (interpret mode off-TPU).
+    """
+    T = q.shape[2]
+    bq = min(DEFAULT_BLOCK_Q, T)
+    bk = min(DEFAULT_BLOCK_K, T)
+    # tile-aligned block shapes only: T a power of two >= 16 (the engine's
+    # bucket sizes) or a multiple of the full 128 block — anything else
+    # (e.g. a clamped 99-wide bucket) takes the jnp path rather than handing
+    # Mosaic an unvalidated block shape
+    pow2 = T & (T - 1) == 0
+    aligned = (
+        (T >= 16)
+        and (pow2 or T % DEFAULT_BLOCK_Q == 0)
+        and q.shape[1] % k.shape[1] == 0
+    )
+    if use_flash is None:
+        use_flash = jax.default_backend() == "tpu" and aligned
+    if use_flash:
+        return flash_attention(q, k, v, causal=True, block_q=bq, block_k=bk)
+    from kserve_vllm_mini_tpu.ops.attention import attention, causal_mask
+
+    return attention(q, k, v, causal_mask(T, T)[None, None])

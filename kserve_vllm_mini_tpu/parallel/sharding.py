@@ -38,6 +38,15 @@ def param_specs(
 ) -> dict[str, Any]:
     tp = _axis(mesh, "tp")
     pp = _axis(mesh, "pp")
+    if pp:
+        # Sharding the stacked layer axis under the scan-rolled forward would
+        # drag full activations across stages every layer. Stage-partitioned
+        # execution lives in parallel/pipeline.py (microbatched, one ppermute
+        # per tick) — use it for pp > 1 instead of these annotations.
+        raise ValueError(
+            "pp > 1 requires the pipeline executor "
+            "(kserve_vllm_mini_tpu.parallel.pipeline), not plain sharding rules"
+        )
     kv_tp = tp if tp and cfg.n_kv_heads % mesh.shape["tp"] == 0 else None
     specs: dict[str, Any] = {
         "embed": P(tp, None),

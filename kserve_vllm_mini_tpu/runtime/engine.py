@@ -134,8 +134,9 @@ class Engine:
             dcfg = self._drafter_cfg
             dshape = (dcfg.n_layers, S, dcfg.n_kv_heads,
                       self.ecfg.max_seq_len, dcfg.head_dim)
-            self._dcache_k = jnp.zeros(dshape, dtype=dcfg.jnp_dtype)
-            self._dcache_v = jnp.zeros(dshape, dtype=dcfg.jnp_dtype)
+            d_dt = jnp.dtype(self.ecfg.kv_cache_dtype) if self.ecfg.kv_cache_dtype else dcfg.jnp_dtype
+            self._dcache_k = jnp.zeros(dshape, dtype=d_dt)
+            self._dcache_v = jnp.zeros(dshape, dtype=d_dt)
         self._spec_fn = None
 
         # host-side slot state
@@ -166,6 +167,9 @@ class Engine:
             "busy_s": 0.0,
             "started_at": time.time(),
             "queue_depth": 0,
+            "spec_rounds": 0,       # fused drafter-propose/target-verify rounds
+            "spec_accepted": 0,     # draft tokens accepted across all rounds
+            "spec_proposed": 0,     # draft tokens proposed (rounds x k-1)
         }
 
     # -- compiled steps ----------------------------------------------------
@@ -192,6 +196,7 @@ class Engine:
             logits, new_cache = forward(
                 params, cfg, tokens, pos,
                 {"k": sub_k, "v": sub_v}, jnp.zeros((1,), jnp.int32),
+                fresh_prefill=True,
             )
             cache_k = jax.lax.dynamic_update_slice(cache_k, new_cache["k"], (0, slot, 0, 0, 0))
             cache_v = jax.lax.dynamic_update_slice(cache_v, new_cache["v"], (0, slot, 0, 0, 0))
@@ -283,7 +288,7 @@ class Engine:
                 j < a[:, None], drafts,
                 jnp.where(j == a[:, None], bonus[:, None], -1),
             )
-            return nc_t["k"], nc_t["v"], ck_d, cv_d, emit, a
+            return nc_t["k"], nc_t["v"], ck_d, cv_d, emit
 
         self._spec_fn = spec_step
         return spec_step
@@ -335,7 +340,7 @@ class Engine:
             jnp.asarray([req.top_p], jnp.float32),
         )
         first_id = int(first[0])
-        if self._drafter_params is not None:
+        if self._drafter_params is not None and self.ecfg.spec_tokens > 0:
             # drafter prefills the same prompt into its own cache so it can
             # propose from full context; its output logits are unused
             dprefill = self._get_prefill_fn(bucket, draft=True)
@@ -391,10 +396,82 @@ class Engine:
         self._free.append(slot)
         self._sampling_arrays = None  # slot population changed
 
+    def _emit_token(self, slot: int, tok: int, now: float) -> bool:
+        """Record one generated token for a live slot: cache-length/stat
+        bookkeeping, stream event, and finish handling (EOS / budget / cache
+        space). Returns True if the slot finished. The single state machine
+        both the plain and speculative sweeps share."""
+        handle = self._slot_req[slot]
+        req = handle.request
+        self._slot_len[slot] += 1      # the fed token is now in cache
+        self._last_tokens[slot] = tok
+        handle.tokens.append(tok)
+        handle.events.put(("token", tok, now))
+        self.stats["decode_tokens"] += 1
+        self._slot_remaining[slot] -= 1
+        hit_eos = req.eos_id is not None and tok == req.eos_id
+        out_of_space = self._slot_len[slot] + 1 >= self.ecfg.max_seq_len
+        if self._slot_remaining[slot] <= 0 or hit_eos or out_of_space:
+            self._finish_slot(slot, "stop" if hit_eos else "length")
+            return True
+        return False
+
+    def _can_spec(self, active: list[int]) -> bool:
+        """Speculative rounds run when a drafter is configured, every active
+        request is greedy (the accept rule is exact argmax prefix match, so
+        emitted tokens are bit-identical to plain greedy decode), and every
+        slot has cache room for the full k-token verify write."""
+        k = self.ecfg.spec_tokens
+        if k <= 0 or self._drafter_params is None:
+            return False
+        if any(self._slot_req[i].request.temperature != 0.0 for i in active):
+            return False
+        return all(self._slot_len[i] + k < self.ecfg.max_seq_len for i in active)
+
+    def _spec_sweep(self, active: list[int]) -> None:
+        """One fused speculative round: drafter proposes k-1 tokens, target
+        verifies in a single T=k forward, host emits the accepted prefix plus
+        the target's bonus token. Rejected positions leave garbage KV beyond
+        the new length in both caches; it is overwritten before it can ever
+        be attended (the same overwrite-before-attend invariant that covers
+        prompt padding)."""
+        k = self.ecfg.spec_tokens
+        spec = self._get_spec_fn()
+        tokens = jnp.asarray(self._last_tokens, dtype=jnp.int32)
+        lengths = jnp.asarray(self._slot_len, dtype=jnp.int32)
+        t0 = time.time()
+        (self._cache_k, self._cache_v, self._dcache_k, self._dcache_v,
+         emit) = spec(
+            self.params, self._cache_k, self._cache_v,
+            self._drafter_params, self._dcache_k, self._dcache_v,
+            tokens, lengths,
+        )
+        # one transfer for the whole [S, k] block (same rationale as decode)
+        emit_host = np.asarray(jax.device_get(emit))
+        now = time.time()
+        self.stats["busy_s"] += now - t0
+        self.stats["spec_rounds"] += 1
+        self.stats["spec_proposed"] += (k - 1) * len(active)
+
+        for i in active:
+            n_emitted = 0
+            for j in range(k):
+                tok = int(emit_host[i, j])
+                if tok < 0:
+                    break
+                n_emitted += 1
+                if self._emit_token(i, tok, now):
+                    break
+            # accepted drafts = emitted minus the bonus token
+            self.stats["spec_accepted"] += max(n_emitted - 1, 0)
+
     def _decode_sweep(self) -> None:
         S = self.ecfg.max_slots
         active = [i for i in range(S) if self._slot_req[i] is not None]
         if not active:
+            return
+        if self._can_spec(active):
+            self._spec_sweep(active)
             return
         # chunk size: fused steps must stay inside every active slot's cache
         # window (requests finishing mid-chunk are handled by surplus
@@ -429,21 +506,9 @@ class Engine:
 
         for step_tokens in steps_host:
             for i in active:
-                handle = self._slot_req[i]
-                if handle is None:
+                if self._slot_req[i] is None:
                     continue  # finished earlier in this chunk; surplus discarded
-                req = handle.request
-                tok = step_tokens[i]
-                self._slot_len[i] += 1      # the fed token is now in cache
-                self._last_tokens[i] = tok
-                handle.tokens.append(tok)
-                handle.events.put(("token", tok, now))
-                self.stats["decode_tokens"] += 1
-                self._slot_remaining[i] -= 1
-                hit_eos = req.eos_id is not None and tok == req.eos_id
-                out_of_space = self._slot_len[i] + 1 >= self.ecfg.max_seq_len
-                if self._slot_remaining[i] <= 0 or hit_eos or out_of_space:
-                    self._finish_slot(i, "stop" if hit_eos else "length")
+                self._emit_token(i, step_tokens[i], now)
 
     def _fail_all(self, exc: BaseException) -> None:
         """Push an error 'done' to every live/pending handle so no client
@@ -496,4 +561,7 @@ class Engine:
         s["duty_cycle"] = min(s["busy_s"] / wall, 1.0)
         s["active_slots"] = sum(1 for h in self._slot_req if h is not None)
         s["free_slots"] = len(self._free)
+        s["spec_accept_ratio"] = (
+            s["spec_accepted"] / s["spec_proposed"] if s["spec_proposed"] else 0.0
+        )
         return s

@@ -40,8 +40,15 @@ def build_engine(
     quantization: str = "none",
     kv_cache_dtype: Optional[str] = None,
     decode_chunk: int = 1,
+    drafter: Optional[str] = None,
+    spec_tokens: int = 0,
 ) -> tuple[Engine, Tokenizer, str]:
-    """Construct (engine, tokenizer, model_name) from a preset or checkpoint."""
+    """Construct (engine, tokenizer, model_name) from a preset or checkpoint.
+
+    ``drafter`` is a preset name or checkpoint dir for the speculative-decode
+    draft model (reference knob: runners/profiles/speculative-decoding.yaml);
+    ``spec_tokens`` is the fused propose/verify depth per round (0 disables).
+    """
     import jax
 
     from kserve_vllm_mini_tpu.models.config import get_config
@@ -67,12 +74,16 @@ def build_engine(
         name = cfg.name
     if quantization not in ("none", "int8"):
         raise ValueError(f"unknown quantization {quantization!r}; known: none, int8")
+    if kv_cache_dtype == "auto":
+        # profile sentinel for "model default" (profiles/quantization/*.yaml
+        # mirror the reference's 'auto'); the deploy layer drops it too
+        kv_cache_dtype = None
     if kv_cache_dtype not in (None, "bfloat16", "float32", "float16"):
         # integer KV dtypes would silently truncate activations to zero in
         # the cache write — reject until int8-KV lands with proper scales
         raise ValueError(
             f"unsupported kv_cache_dtype {kv_cache_dtype!r}; "
-            "known: bfloat16, float32, float16"
+            "known: auto, bfloat16, float32, float16"
         )
     if quantization == "int8":
         from kserve_vllm_mini_tpu.ops.quant import quantize_params
@@ -82,6 +93,27 @@ def build_engine(
         from kserve_vllm_mini_tpu.parallel.sharding import shard_params
 
         params = shard_params(params, cfg, mesh)
+
+    drafter_pair = None
+    if drafter and spec_tokens > 0:
+        import os
+
+        if os.path.isdir(drafter):
+            from kserve_vllm_mini_tpu.models.loader import load_hf_checkpoint
+
+            dparams, dcfg = load_hf_checkpoint(drafter)
+        else:
+            dcfg = get_config(drafter)
+            if tok.vocab_size > dcfg.vocab_size:
+                dcfg = dcfg.scaled(vocab_size=tok.vocab_size)
+            dparams = init_params(jax.random.PRNGKey(seed + 1), dcfg)
+        if dcfg.vocab_size != cfg.vocab_size:
+            raise ValueError(
+                f"drafter vocab {dcfg.vocab_size} != target vocab "
+                f"{cfg.vocab_size}; speculative verify compares token ids"
+            )
+        drafter_pair = (dparams, dcfg)
+
     ecfg = EngineConfig(
         max_slots=max_slots,
         max_seq_len=min(max_seq_len, cfg.max_seq_len),
@@ -89,8 +121,11 @@ def build_engine(
         seed=seed,
         kv_cache_dtype=kv_cache_dtype,
         decode_chunk=decode_chunk,
+        spec_tokens=spec_tokens if drafter_pair is not None else 0,
     )
-    engine = Engine(params, cfg, ecfg, mesh=mesh, pad_id=tok.pad_id)
+    engine = Engine(
+        params, cfg, ecfg, mesh=mesh, pad_id=tok.pad_id, drafter=drafter_pair
+    )
     return engine, tok, name
 
 
@@ -248,6 +283,10 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str):
             f"kvmini_tpu_free_slots {s['free_slots']}",
             "# TYPE kvmini_tpu_decode_steps_total counter",
             f"kvmini_tpu_decode_steps_total {s['decode_steps']}",
+            "# TYPE kvmini_tpu_spec_rounds_total counter",
+            f"kvmini_tpu_spec_rounds_total {s['spec_rounds']}",
+            "# TYPE kvmini_tpu_spec_accept_ratio gauge",
+            f"kvmini_tpu_spec_accept_ratio {s['spec_accept_ratio']:.6f}",
         ]
         return web.Response(text="\n".join(lines) + "\n", content_type="text/plain")
 
@@ -275,11 +314,24 @@ def register(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--decode-chunk", type=int, default=1,
                         help="Decode steps fused per dispatch (throughput vs "
                              "streaming granularity)")
+    parser.add_argument("--drafter", default=None,
+                        help="Drafter model preset/checkpoint for speculative "
+                             "decoding (default: $KVMINI_DRAFTER)")
+    parser.add_argument("--spec-tokens", type=int, default=None,
+                        help="Speculative propose/verify depth per round "
+                             "(default: $KVMINI_SPEC_TOKENS or 4 when a "
+                             "drafter is set)")
 
 
 def run(args: argparse.Namespace) -> int:
+    import os
+
     from aiohttp import web
 
+    drafter = args.drafter or os.environ.get("KVMINI_DRAFTER")
+    spec_tokens = args.spec_tokens
+    if spec_tokens is None:
+        spec_tokens = int(os.environ.get("KVMINI_SPEC_TOKENS", "4" if drafter else "0"))
     engine, tok, name = build_engine(
         model=args.model,
         checkpoint=args.checkpoint,
@@ -289,6 +341,8 @@ def run(args: argparse.Namespace) -> int:
         max_seq_len=args.max_seq_len,
         topology=args.topology,
         seed=args.seed,
+        drafter=drafter,
+        spec_tokens=spec_tokens,
     )
     engine.start()
     app = make_app(engine, tok, name)

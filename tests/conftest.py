@@ -24,7 +24,7 @@ except ImportError:
     # JAX is the optional 'runtime' extra; harness-layer tests run without it.
     collect_ignore_glob = [
         "test_model*", "test_parallel*", "test_flash*", "test_loader*",
-        "test_runtime*", "test_graft*",
+        "test_runtime*", "test_graft*", "test_pipeline*", "test_quant*",
     ]
 
 import pytest  # noqa: E402

@@ -258,3 +258,111 @@ def test_sharded_engine_matches_oracle(params):
         assert info["finish_reason"] == "length"
     finally:
         eng.stop()
+
+
+# -- speculative decoding ----------------------------------------------------
+
+DRAFTER_CFG = get_config("llama-tiny")
+
+
+def make_spec_engine(params, drafter_params, spec_tokens=4, slots=4, max_seq=128):
+    eng = Engine(
+        params, CFG,
+        EngineConfig(max_slots=slots, max_seq_len=max_seq, max_prefill_len=64,
+                     min_prefill_bucket=16, spec_tokens=spec_tokens),
+        drafter=(drafter_params, DRAFTER_CFG),
+    )
+    eng.start()
+    return eng
+
+
+@pytest.fixture(scope="module")
+def drafter_params():
+    # seed 1: a *different* tiny model, so acceptance is partial — both the
+    # accept and reject paths get exercised
+    return init_params(jax.random.PRNGKey(1), CFG)
+
+
+@pytest.mark.parametrize("spec_tokens", [1, 3, 4])
+def test_spec_decode_identical_to_greedy(params, drafter_params, spec_tokens):
+    """Greedy exact-match acceptance => the emitted sequence is identical to
+    plain greedy decode, whatever the drafter proposes."""
+    eng = make_spec_engine(params, drafter_params, spec_tokens=spec_tokens)
+    try:
+        prompt = [5, 9, 42, 7, 13]
+        ref = greedy_reference(params, prompt, 12)
+        h = eng.submit(GenRequest(prompt_tokens=prompt, max_new_tokens=12))
+        tokens, info = _drain(h)
+        assert tokens == ref
+        assert info["finish_reason"] == "length"
+        assert eng.stats["spec_rounds"] > 0, "spec path must actually run"
+    finally:
+        eng.stop()
+
+
+def test_spec_decode_self_drafter_accepts_everything(params):
+    """Drafter == target: every draft is accepted, so each round emits the
+    full spec_tokens block and rounds ~= new_tokens / spec_tokens."""
+    eng = make_spec_engine(params, params, spec_tokens=4)
+    try:
+        prompt = [3, 1, 4, 1, 5]
+        ref = greedy_reference(params, prompt, 12)
+        h = eng.submit(GenRequest(prompt_tokens=prompt, max_new_tokens=12))
+        tokens, _ = _drain(h)
+        assert tokens == ref
+        s = eng.snapshot_stats()
+        # the final round is budget-cut at max_new_tokens, so its trailing
+        # accepted drafts are discarded (counted proposed, not accepted)
+        assert s["spec_accept_ratio"] > 0.85
+        # 1 from prefill + 11 via rounds of <=4 -> at most ceil(11/4)+1 rounds
+        assert s["spec_rounds"] <= 4
+    finally:
+        eng.stop()
+
+
+def test_spec_decode_concurrent_matches_oracle(params, drafter_params):
+    eng = make_spec_engine(params, drafter_params, spec_tokens=3)
+    try:
+        prompts = [[7, 8, 9], [100, 50], [1, 2, 3, 4, 5, 6], [11]]
+        refs = [greedy_reference(params, p, 8) for p in prompts]
+        handles = [
+            eng.submit(GenRequest(prompt_tokens=p, max_new_tokens=8)) for p in prompts
+        ]
+        for h, ref in zip(handles, refs):
+            tokens, _ = _drain(h)
+            assert tokens == ref
+    finally:
+        eng.stop()
+
+
+def test_spec_decode_mixed_sampling_falls_back(params, drafter_params):
+    """A sampled request in the batch forces the normal decode sweep (the
+    accept rule is greedy-only) — output still correct for the greedy one."""
+    eng = make_spec_engine(params, drafter_params, spec_tokens=4)
+    try:
+        ref = greedy_reference(params, [5, 6, 7], 8)
+        hg = eng.submit(GenRequest(prompt_tokens=[5, 6, 7], max_new_tokens=8))
+        hs = eng.submit(GenRequest(prompt_tokens=[9, 10], max_new_tokens=8,
+                                   temperature=0.9))
+        tg, _ = _drain(hg)
+        ts, _ = _drain(hs)
+        assert tg == ref
+        assert len(ts) == 8
+    finally:
+        eng.stop()
+
+
+def test_spec_decode_eos_mid_round(params):
+    """EOS inside an accepted block stops the request at the right token."""
+    eng = make_spec_engine(params, params, spec_tokens=4)
+    try:
+        prompt = [2, 4, 6]
+        ref_all = greedy_reference(params, prompt, 30)
+        eos = ref_all[5]
+        want = ref_all[: ref_all.index(eos) + 1]
+        h = eng.submit(GenRequest(prompt_tokens=prompt, max_new_tokens=30, eos_id=eos))
+        tokens, info = _drain(h)
+        assert tokens == want
+        assert info["finish_reason"] == "stop"
+    finally:
+        eng.stop()
