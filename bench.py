@@ -36,6 +36,14 @@ V5E_HBM_GBPS = 819.0
 V5E_BF16_TFLOPS = 197.0
 
 
+def _log(msg: str) -> None:
+    """Stage progress on stderr (stdout carries only the one JSON line)."""
+    print(f"[bench +{time.time() - _T_START:.0f}s] {msg}", file=sys.stderr, flush=True)
+
+
+_T_START = time.time()
+
+
 def main() -> int:
     import jax
     import jax.numpy as jnp
@@ -44,8 +52,13 @@ def main() -> int:
     from functools import partial
 
     from kserve_vllm_mini_tpu.models.config import get_config
-    from kserve_vllm_mini_tpu.models.llama import forward, init_kv_cache, init_params
-    from kserve_vllm_mini_tpu.ops.quant import quantize_params, quantized_bytes
+    from kserve_vllm_mini_tpu.models.llama import (
+        forward,
+        init_kv_cache,
+        init_params,
+        init_params_quantized,
+    )
+    from kserve_vllm_mini_tpu.ops.quant import quantized_bytes
     from kserve_vllm_mini_tpu.runtime.sampling import sample_tokens
 
     model = os.environ.get("KVMINI_BENCH_MODEL", "llama-3.1-8b")
@@ -58,10 +71,17 @@ def main() -> int:
 
     on_tpu = jax.default_backend() == "tpu"
     cfg = get_config(model, max_seq_len=max_seq)
-    params = init_params(jax.random.PRNGKey(0), cfg)
+    _log(f"model={model} quant={quant} slots={slots} backend={jax.default_backend()}")
+    # int8 weights are built layer-by-layer straight into int8 leaves — the
+    # full-precision 8B tree (~16 GB bf16) must NEVER exist on a 16 GB v5e
+    # (round-2 OOM, VERDICT.md Weak #1)
     if quant == "int8":
-        params = quantize_params(params)
+        params = init_params_quantized(jax.random.PRNGKey(0), cfg)
+    else:
+        params = init_params(jax.random.PRNGKey(0), cfg)
+    jax.block_until_ready(params)
     param_bytes = quantized_bytes(params)
+    _log(f"params ready ({param_bytes / 1e9:.2f} GB on device)")
 
     cache = init_kv_cache(cfg, slots, max_seq=max_seq)
     toks = jax.random.randint(jax.random.PRNGKey(1), (slots, prompt_len), 0, cfg.vocab_size)
@@ -70,8 +90,12 @@ def main() -> int:
     # -- batch prefill to fill all slots (fresh-prefill / flash path) -------
     @partial(jax.jit, donate_argnums=(1,))
     def prefill_batch(params, cache, toks, pos):
+        # logit_index: full [slots, T, V] f32 logits for a 128k vocab is
+        # ~2 GB of HBM the sampler never reads
+        last = jnp.full((slots,), prompt_len - 1, dtype=jnp.int32)
         logits, cache = forward(params, cfg, toks, pos, cache,
-                                jnp.zeros((slots,), jnp.int32), fresh_prefill=True)
+                                jnp.zeros((slots,), jnp.int32), fresh_prefill=True,
+                                logit_index=last)
         return cache, jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
 
     # -- single-request prefill: the per-request TTFT cost ------------------
@@ -84,9 +108,11 @@ def main() -> int:
                                 jnp.zeros((1,), jnp.int32), fresh_prefill=True)
         return jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
 
+    _log("compiling single-request prefill")
     lowered = prefill_one.lower(params, cache1, toks1, pos1).compile()
     hlo = lowered.as_text()
     flash_lowered = "tpu_custom_call" in hlo
+    _log(f"prefill compiled (flash_lowered={flash_lowered})")
     if on_tpu:
         assert flash_lowered, (
             "serving prefill must lower the Pallas flash kernel on TPU "
@@ -110,10 +136,12 @@ def main() -> int:
     # readback pays the tunnel RTT. We therefore time two chained runs of
     # different lengths, each ended by a readback, and difference them so the
     # RTT and dispatch overheads cancel.
+    _log("batch prefill (first call: compile + run)")
     t0 = time.time()
     cache, tokens = prefill_batch(params, cache, toks, pos)
     _ = np.asarray(tokens)
     prefill_first_s = time.time() - t0
+    _log(f"batch prefill done in {prefill_first_s:.1f}s")
 
     # steady-state single-request prefill p50 (TTFT)
     ttfts = []
@@ -136,7 +164,9 @@ def main() -> int:
         _ = np.asarray(tokens)  # true synchronization point
         return cache, tokens, lengths, rng
 
+    _log("decode warmup (compile)")
     cache, tokens, lengths, rng = run_steps(warmup, cache, tokens, lengths, rng)
+    _log("decode warmup done; timing")
 
     n_short = decode_steps // 4
     t0 = time.time()

@@ -46,6 +46,13 @@ def analyze_run(
     update["run_id"] = run_dir.path.name
 
     update.update(compute_latency_stats(records))
+    n_truncated = sum(1 for r in records if r.truncated)
+    if n_truncated:
+        # the engine cut these prompts to its prefill budget: the measured
+        # workload differs from the requested one — surface, never hide,
+        # and report severity (5 tokens lost ≠ 5000 tokens lost)
+        update["truncated_requests"] = n_truncated
+        update["truncated_prompt_tokens"] = sum(r.truncated_tokens for r in records)
     update["token_timing"] = compute_token_timing(records)
     for k in ("tpot_p50_ms", "tpot_p95_ms"):
         if k in update["token_timing"]:

@@ -50,6 +50,10 @@ REQUEST_CSV_COLUMNS = [
     "prompt_set",     # e.g. "default", "repeat", "unique" (cache probe)
     "tenant",         # multi-tenant fairness runs; "" otherwise
     "server_ttft_ms", # runtime-reported true first-token latency; 0 if unknown
+    "truncated",      # "1" if the prompt was cut to the engine's prefill
+                      # budget — the run measured a different workload than
+                      # requested, and the analyzer must say so
+    "truncated_tokens",  # how many prompt tokens the engine dropped (severity)
 ]
 
 
@@ -74,10 +78,13 @@ class RequestRecord:
     prompt_set: str = "default"
     tenant: str = ""
     server_ttft_ms: float = 0.0
+    truncated: bool = False
+    truncated_tokens: int = 0
 
     def to_row(self) -> dict[str, Any]:
         d = dataclasses.asdict(self)
         d["ok"] = "1" if self.ok else "0"
+        d["truncated"] = "1" if self.truncated else "0"
         return d
 
     @classmethod
@@ -114,6 +121,8 @@ class RequestRecord:
             prompt_set=row.get("prompt_set", "default") or "default",
             tenant=row.get("tenant", ""),
             server_ttft_ms=_f("server_ttft_ms"),
+            truncated=row.get("truncated", "0") in ("1", "true", "True"),
+            truncated_tokens=_i("truncated_tokens"),
         )
 
 

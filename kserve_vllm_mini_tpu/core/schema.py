@@ -51,6 +51,9 @@ class Results:
     tokens_per_sec: Optional[float] = None
     tokens_per_sec_per_chip: Optional[float] = None
     error_rate: Optional[float] = None
+    truncated_requests: Optional[int] = None  # prompts cut to the prefill
+                                              # budget (workload changed)
+    truncated_prompt_tokens: Optional[int] = None  # total tokens dropped
 
     # cold/warm split (reference analyze.py:422-460)
     cold_requests: Optional[int] = None

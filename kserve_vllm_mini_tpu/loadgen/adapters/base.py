@@ -47,6 +47,9 @@ class CallResult:
     first_token_ts: float = 0.0   # epoch s of first streamed chunk
     last_token_ts: float = 0.0    # epoch s of last streamed chunk
     server_ttft_ms: float = 0.0   # server-reported true TTFT when available
+    truncated: bool = False       # server reported the prompt was cut to its
+                                  # prefill budget (workload differs from sent)
+    truncated_tokens: int = 0     # how many prompt tokens were dropped
     text: str = ""
 
 

@@ -78,9 +78,10 @@ class OpenAIChatAdapter(ProtocolAdapter):
                 res.tokens_out = usage.get(
                     "completion_tokens", approx_token_count(res.text)
                 )
-                res.server_ttft_ms = float(
-                    (data.get("metrics") or {}).get("server_ttft_ms", 0.0)
-                )
+                metrics = data.get("metrics") or {}
+                res.server_ttft_ms = float(metrics.get("server_ttft_ms", 0.0))
+                res.truncated = bool(metrics.get("truncated", False))
+                res.truncated_tokens = int(metrics.get("truncated_tokens", 0))
                 res.ok = True
                 return res
 
@@ -90,9 +91,13 @@ class OpenAIChatAdapter(ProtocolAdapter):
             def parse_event(evt: dict, r: CallResult) -> str:
                 if evt.get("usage"):
                     usage.update(evt["usage"])
-                srv = (evt.get("metrics") or {}).get("server_ttft_ms")
+                metrics = evt.get("metrics") or {}
+                srv = metrics.get("server_ttft_ms")
                 if srv:
                     r.server_ttft_ms = float(srv)
+                if metrics.get("truncated"):
+                    r.truncated = True
+                    r.truncated_tokens = int(metrics.get("truncated_tokens", 0))
                 delta = ""
                 for ch in evt.get("choices") or []:
                     delta += (ch.get("delta") or {}).get("content", "") or ""

@@ -120,6 +120,8 @@ async def _worker(
     rec.first_token_ts = result.first_token_ts
     rec.last_token_ts = result.last_token_ts
     rec.server_ttft_ms = result.server_ttft_ms
+    rec.truncated = result.truncated
+    rec.truncated_tokens = result.truncated_tokens
     rec.latency_ms = (rec.end_ts - rec.start_ts) * 1000.0
     if result.first_token_ts > 0:
         rec.ttft_ms = (result.first_token_ts - rec.start_ts) * 1000.0

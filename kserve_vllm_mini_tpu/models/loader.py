@@ -101,8 +101,15 @@ def load_hf_checkpoint(
     model_dir: str | Path,
     cfg: Optional[ModelConfig] = None,
     dtype: Optional[str] = None,
+    quantize: bool = False,
 ) -> tuple[dict[str, Any], ModelConfig]:
-    """Load an HF Llama-family checkpoint into (params, config)."""
+    """Load an HF Llama-family checkpoint into (params, config).
+
+    ``quantize=True`` converts each matmul weight to int8 **layer by layer
+    during the load**, so the full-precision tree never exists on device —
+    an 8B bf16 tree is ~16 GB, the entire HBM of the v5e this serves on
+    (same rationale as models/llama.py init_params_quantized; quantizing
+    after a full load re-creates the round-2 OOM for real checkpoints)."""
     model_dir = Path(model_dir)
     cfg = cfg or config_from_hf(model_dir)
     dt = jnp.dtype(dtype or cfg.dtype)
@@ -114,12 +121,20 @@ def load_hf_checkpoint(
             x = x.T
         return x.astype(dt)
 
-    layers: dict[str, jnp.ndarray] = {}
+    if quantize:
+        from kserve_vllm_mini_tpu.ops.quant import QUANTIZABLE, quantize_weight
+
+    layers: dict[str, Any] = {}
     for ours, (hf_key, tr) in _LAYER_MAP.items():
-        stacked = jnp.stack(
-            [conv(f"model.layers.{i}.{hf_key}", tr) for i in range(cfg.n_layers)]
-        )
-        layers[ours] = stacked
+        per_layer = (f"model.layers.{i}.{hf_key}" for i in range(cfg.n_layers))
+        if quantize and ours in QUANTIZABLE:
+            qws = [quantize_weight(conv(name, tr)) for name in per_layer]
+            layers[ours] = {
+                "q": jnp.stack([w["q"] for w in qws]),
+                "s": jnp.stack([w["s"] for w in qws]),
+            }
+        else:
+            layers[ours] = jnp.stack([conv(name, tr) for name in per_layer])
 
     params: dict[str, Any] = {
         "embed": conv("model.embed_tokens.weight", False),

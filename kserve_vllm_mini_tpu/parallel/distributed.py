@@ -1,0 +1,150 @@
+"""Multi-host distributed runtime: process initialization and global meshes.
+
+This is the TPU-native replacement for the comm backend the reference never
+owns — its NCCL/MPI lives inside external engine images and the only related
+surface is the PP/TP passthrough env (/root/reference/runners/backends/
+triton/deploy.sh:84-86). Here the runtime is in-repo, so multi-host is real:
+
+- ``initialize()`` wraps ``jax.distributed.initialize`` with environment
+  autodiscovery. On GKE TPU node pools libtpu + the TPU metadata already
+  carry host topology, so a bare ``initialize()`` works; for CPU-based CI
+  (and any explicit deployment) the coordinator/process counts come from
+  arguments or ``KVMINI_COORDINATOR`` / ``KVMINI_NUM_PROCESSES`` /
+  ``KVMINI_PROCESS_ID`` env vars.
+- ``global_mesh(spec)`` builds the serving/training mesh over **all** hosts'
+  devices. Within one TPU slice (e.g. v5p-16 = 16 chips / 4 hosts) every
+  chip pair is ICI-connected, so one flat mesh is correct. Across slices
+  (multi-pod), ``dcn_dp > 1`` lays data-parallel outermost over DCN via
+  ``mesh_utils.create_hybrid_device_mesh`` so only dp-gradient/replica
+  traffic crosses the slow network — tp/sp/pp collectives stay on ICI
+  (scaling-book recipe: DCN-outermost).
+- ``is_primary()`` — the process-0 frontend pattern: exactly one host runs
+  the HTTP server / writes artifacts; the others participate in collectives
+  only (SURVEY.md §7.3.2 "the harness only sees one URL").
+
+The 2-process CPU localhost test in tests/test_distributed.py exercises
+initialize + v5p-16 mesh construction + a psum over DCN without hardware.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+from kserve_vllm_mini_tpu.parallel.mesh import (
+    AXES,
+    TOPOLOGY_PRESETS,
+    MeshSpec,
+    make_mesh,
+)
+
+_initialized = False
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    local_device_ids: Optional[list[int]] = None,
+) -> bool:
+    """Join (or create) the multi-host JAX runtime. Idempotent.
+
+    Returns True if ``jax.distributed.initialize`` was called, False when
+    running single-process (no coordinator configured anywhere) — callers
+    can treat False as "single-host mode" and skip the frontend split.
+
+    Resolution order per field: explicit argument > KVMINI_* env var >
+    JAX/cloud autodiscovery (TPU metadata on GKE). A single process with no
+    coordinator anywhere is the common local case and is NOT an error.
+    """
+    global _initialized
+    if _initialized:
+        return True
+
+    coordinator_address = coordinator_address or os.environ.get("KVMINI_COORDINATOR")
+    if num_processes is None and "KVMINI_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["KVMINI_NUM_PROCESSES"])
+    if process_id is None and "KVMINI_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["KVMINI_PROCESS_ID"])
+
+    on_tpu_pod = bool(
+        os.environ.get("TPU_WORKER_HOSTNAMES") or os.environ.get("MEGASCALE_COORDINATOR_ADDRESS")
+    )
+    if coordinator_address is None and not on_tpu_pod:
+        return False  # single-process mode
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids,
+    )
+    _initialized = True
+    return True
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def is_primary() -> bool:
+    """True on the process that runs the HTTP frontend and writes artifacts
+    (process 0). All processes execute the same jitted computations; only
+    the primary talks to clients."""
+    return jax.process_index() == 0
+
+
+def global_mesh(spec: MeshSpec, dcn_dp: int = 1) -> jax.sharding.Mesh:
+    """Mesh over every device of every host.
+
+    ``spec`` describes the per-slice (ICI) axis sizes. With ``dcn_dp > 1``
+    the data-parallel axis is laid outermost over DCN — each of the
+    ``dcn_dp`` slices holds a full model replica, and only dp collectives
+    (request routing / gradient psum) cross DCN. dp inside the spec
+    multiplies with the DCN replicas.
+    """
+    n_global = len(jax.devices())
+    if dcn_dp <= 1:
+        if spec.n_devices != n_global:
+            raise ValueError(
+                f"mesh spec {spec.axis_sizes()} needs {spec.n_devices} devices; "
+                f"{n_global} present across {jax.process_count()} processes"
+            )
+        return make_mesh(spec)
+
+    from jax.experimental import mesh_utils
+
+    per_slice = (spec.dp, spec.sp, spec.pp, spec.tp)
+    if dcn_dp * spec.n_devices != n_global:
+        raise ValueError(
+            f"dcn_dp={dcn_dp} x per-slice {spec.n_devices} != {n_global} global devices"
+        )
+    # dp outermost over DCN; every other axis confined to one ICI slice
+    devices = mesh_utils.create_hybrid_device_mesh(
+        mesh_shape=per_slice,
+        dcn_mesh_shape=(dcn_dp, 1, 1, 1),
+        devices=jax.devices(),
+        allow_split_physical_axes=True,
+    )
+    return jax.sharding.Mesh(devices, AXES)
+
+
+def mesh_for_topology(name: str, dcn_dp: int = 1) -> jax.sharding.Mesh:
+    """Global (multi-host-aware) mesh for a topology preset.
+
+    Unlike mesh.mesh_for_topology (single-process, local devices), this
+    counts devices across all initialized processes, so ``v5p-16`` (16
+    chips / 4 hosts) builds when 4 hosts of 4 chips — or, in CI, 2 CPU
+    processes of 8 virtual devices — have joined.
+    """
+    if name not in TOPOLOGY_PRESETS:
+        raise ValueError(f"unknown topology {name!r}; known: {sorted(TOPOLOGY_PRESETS)}")
+    p = TOPOLOGY_PRESETS[name]
+    spec = MeshSpec.fill(p["chips"], tp=p.get("tp"))
+    return global_mesh(spec, dcn_dp=dcn_dp)

@@ -68,6 +68,12 @@ class GenRequest:
     top_p: float = 1.0
     eos_id: Optional[int] = None
     request_id: str = field(default_factory=lambda: uuid.uuid4().hex[:16])
+    # set by Engine.submit when the prompt was cut to max_prefill_len: the
+    # request served is not the request sent, and every downstream record
+    # (stream events, requests.csv, results.json) must carry the flag — a
+    # measurement framework must not silently measure a different workload
+    truncated: bool = False
+    truncated_tokens: int = 0
 
 
 class RequestHandle:
@@ -193,15 +199,18 @@ class Engine:
             pos = jnp.arange(tokens.shape[1], dtype=jnp.int32)[None]
             sub_k = jax.lax.dynamic_slice(cache_k, (0, slot, 0, 0, 0), (L, 1, KVH, MS, D))
             sub_v = jax.lax.dynamic_slice(cache_v, (0, slot, 0, 0, 0), (L, 1, KVH, MS, D))
+            # logit_index: only the prompt's last position is sampled — a
+            # full [1, bucket, V] f32 logits tensor is ~2 GB at 128k vocab
+            # for the server-default 4096 bucket, on the per-request path
             logits, new_cache = forward(
                 params, cfg, tokens, pos,
                 {"k": sub_k, "v": sub_v}, jnp.zeros((1,), jnp.int32),
                 fresh_prefill=True,
+                logit_index=(length - 1)[None],
             )
             cache_k = jax.lax.dynamic_update_slice(cache_k, new_cache["k"], (0, slot, 0, 0, 0))
             cache_v = jax.lax.dynamic_update_slice(cache_v, new_cache["v"], (0, slot, 0, 0, 0))
-            last = jax.lax.dynamic_index_in_dim(logits[0], length - 1, axis=0, keepdims=False)
-            return cache_k, cache_v, last  # last: [V] f32
+            return cache_k, cache_v, logits[0, 0]  # [V] f32
 
         self._prefill_fns[key] = prefill
         return prefill
@@ -298,6 +307,8 @@ class Engine:
     def submit(self, req: GenRequest) -> RequestHandle:
         if len(req.prompt_tokens) > self.ecfg.max_prefill_len:
             # keep the tail: the most recent context fits the prefill budget
+            req.truncated = True
+            req.truncated_tokens = len(req.prompt_tokens) - self.ecfg.max_prefill_len
             req.prompt_tokens = req.prompt_tokens[-self.ecfg.max_prefill_len:]
         handle = RequestHandle(req)
         self._pending.put(handle)
@@ -390,6 +401,8 @@ class Engine:
                 "finish_reason": reason,
                 "tokens_out": len(handle.tokens),
                 "server_ttft_ms": handle.server_ttft_ms,
+                "truncated": handle.request.truncated,
+                "truncated_tokens": handle.request.truncated_tokens,
             }))
             self.stats["requests_completed"] += 1
         self._slot_req[slot] = None

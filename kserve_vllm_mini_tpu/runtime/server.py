@@ -52,26 +52,8 @@ def build_engine(
     import jax
 
     from kserve_vllm_mini_tpu.models.config import get_config
-    from kserve_vllm_mini_tpu.models.llama import init_params
+    from kserve_vllm_mini_tpu.models.llama import init_params, init_params_quantized
 
-    mesh = None
-    if topology:
-        from kserve_vllm_mini_tpu.parallel.mesh import mesh_for_topology
-
-        mesh = mesh_for_topology(topology)
-
-    tok = load_tokenizer(tokenizer_path or checkpoint)
-    if checkpoint:
-        from kserve_vllm_mini_tpu.models.loader import load_hf_checkpoint
-
-        params, cfg = load_hf_checkpoint(checkpoint)
-        name = cfg.name
-    else:
-        cfg = get_config(model)
-        if tok.vocab_size > cfg.vocab_size:
-            cfg = cfg.scaled(vocab_size=tok.vocab_size)
-        params = init_params(jax.random.PRNGKey(seed), cfg)
-        name = cfg.name
     if quantization not in ("none", "int8"):
         raise ValueError(f"unknown quantization {quantization!r}; known: none, int8")
     if kv_cache_dtype == "auto":
@@ -85,10 +67,32 @@ def build_engine(
             f"unsupported kv_cache_dtype {kv_cache_dtype!r}; "
             "known: auto, bfloat16, float32, float16"
         )
-    if quantization == "int8":
-        from kserve_vllm_mini_tpu.ops.quant import quantize_params
 
-        params = quantize_params(params)
+    mesh = None
+    if topology:
+        from kserve_vllm_mini_tpu.parallel.mesh import mesh_for_topology
+
+        mesh = mesh_for_topology(topology)
+
+    tok = load_tokenizer(tokenizer_path or checkpoint)
+    if checkpoint:
+        from kserve_vllm_mini_tpu.models.loader import load_hf_checkpoint
+
+        # quantize-as-you-load: the bf16 8B tree must never fully exist on
+        # device (VERDICT.md Weak #1 applies to real checkpoints too)
+        params, cfg = load_hf_checkpoint(checkpoint, quantize=quantization == "int8")
+        name = cfg.name
+    else:
+        cfg = get_config(model)
+        if tok.vocab_size > cfg.vocab_size:
+            cfg = cfg.scaled(vocab_size=tok.vocab_size)
+        # int8 presets init straight into int8 leaves: materializing the bf16
+        # 8B tree first is itself an OOM on a 16 GB v5e (VERDICT.md Weak #1)
+        if quantization == "int8":
+            params = init_params_quantized(jax.random.PRNGKey(seed), cfg)
+        else:
+            params = init_params(jax.random.PRNGKey(seed), cfg)
+        name = cfg.name
     if mesh is not None:
         from kserve_vllm_mini_tpu.parallel.sharding import shard_params
 
@@ -198,7 +202,11 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str):
                         "completion_tokens": len(out_ids),
                         "total_tokens": len(prompt_ids) + len(out_ids),
                     },
-                    "metrics": {"server_ttft_ms": handle.server_ttft_ms},
+                    "metrics": {
+                        "server_ttft_ms": handle.server_ttft_ms,
+                        "truncated": bool(info.get("truncated", False)),
+                        "truncated_tokens": int(info.get("truncated_tokens", 0)),
+                    },
                 }
             )
 
@@ -243,6 +251,10 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str):
                             "prompt_tokens": len(prompt_ids),
                             "completion_tokens": n_out,
                             "total_tokens": len(prompt_ids) + n_out,
+                        },
+                        "metrics": {
+                            "truncated": bool(info.get("truncated", False)),
+                            "truncated_tokens": int(info.get("truncated_tokens", 0)),
                         },
                     }
                     await resp.write(f"data: {json.dumps(final)}\n\n".encode())

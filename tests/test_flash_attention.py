@@ -7,6 +7,9 @@ import pytest
 from kserve_vllm_mini_tpu.ops.attention import attention, causal_mask
 from kserve_vllm_mini_tpu.ops.flash_attention import flash_attention
 
+# compile-heavy: runs in the dedicated slow CI job (lint-test.yml)
+pytestmark = pytest.mark.slow
+
 
 def _rand(shape, key, dtype=jnp.float32):
     return jax.random.normal(jax.random.PRNGKey(key), shape, dtype=dtype)

@@ -29,3 +29,30 @@ def test_checkpoint_roundtrip(tmp_path):
     l1, _ = forward(params, CFG, toks, pos)
     l2, _ = forward(params2, cfg2, toks, pos)
     assert float(jnp.max(jnp.abs(l1 - l2))) < 1e-2  # one f32<->bf16 trip
+
+
+def test_quantize_as_you_load_matches_quantize_after(tmp_path):
+    """loader(quantize=True) (layer-wise, OOM-safe) == quantize_params(load)."""
+    import numpy as np
+
+    from kserve_vllm_mini_tpu.ops.quant import is_quantized, quantize_params
+
+    params = init_params(jax.random.PRNGKey(2), CFG)
+    save_checkpoint(params, CFG, tmp_path / "ckpt")
+
+    loaded, cfg2 = load_hf_checkpoint(tmp_path / "ckpt")
+    oracle = quantize_params(loaded)
+    direct, _ = load_hf_checkpoint(tmp_path / "ckpt", quantize=True)
+
+    assert jax.tree.structure(oracle) == jax.tree.structure(direct)
+    assert is_quantized(direct["layers"]["wq"])
+    for a, b in zip(jax.tree.leaves(oracle), jax.tree.leaves(direct)):
+        assert a.dtype == b.dtype and a.shape == b.shape
+        da, db = np.asarray(a, dtype=np.float64), np.asarray(b, dtype=np.float64)
+        # same data, but quantize math may fuse at different rounding
+        # boundaries per program: allow 1 LSB on a tiny fraction (the
+        # tolerance test_quant.py establishes for the init pair)
+        diff = np.abs(da - db)
+        tol = 1.0 if a.dtype == jnp.int8 else 1e-5 * (np.abs(da).max() + 1e-9)
+        assert diff.max() <= tol
+        assert (diff != 0).mean() <= 1e-3

@@ -17,6 +17,9 @@ from kserve_vllm_mini_tpu.parallel.sharding import (
     token_sharding,
 )
 
+# compile-heavy: runs in the dedicated slow CI job (lint-test.yml)
+pytestmark = pytest.mark.slow
+
 CFG = get_config("llama-tiny")
 
 

@@ -17,6 +17,9 @@ from kserve_vllm_mini_tpu.parallel.pipeline import (
 )
 from kserve_vllm_mini_tpu.parallel.train import loss_fn, sgd_train_step
 
+# compile-heavy: runs in the dedicated slow CI job (lint-test.yml)
+pytestmark = pytest.mark.slow
+
 CFG = get_config("llama-tiny")  # n_layers=2 -> pp in {1, 2}
 
 

@@ -13,6 +13,9 @@ from kserve_vllm_mini_tpu.runtime.engine import Engine, EngineConfig, GenRequest
 from kserve_vllm_mini_tpu.runtime.sampling import sample_tokens
 from kserve_vllm_mini_tpu.runtime.tokenizer import ByteTokenizer
 
+# compile-heavy: runs in the dedicated slow CI job (lint-test.yml)
+pytestmark = pytest.mark.slow
+
 CFG = get_config("llama-tiny")
 
 
@@ -364,5 +367,29 @@ def test_spec_decode_eos_mid_round(params):
         tokens, info = _drain(h)
         assert tokens == want
         assert info["finish_reason"] == "stop"
+    finally:
+        eng.stop()
+
+
+def test_prompt_truncation_flagged(params):
+    """Over-budget prompts are cut to max_prefill_len AND flagged — the
+    engine must never silently measure a different workload (round-2
+    VERDICT Weak #4). The served tail must decode exactly like a prompt
+    that was the tail to begin with."""
+    eng = make_engine(params)  # max_prefill_len=64
+    try:
+        long_prompt = list(range(1, 101))         # 100 tokens > 64 budget
+        ref = greedy_reference(params, long_prompt[-64:], 6)
+        h = eng.submit(GenRequest(prompt_tokens=long_prompt, max_new_tokens=6))
+        tokens, info = _drain(h)
+        assert tokens == ref
+        assert info["truncated"] is True
+        assert info["truncated_tokens"] == 36
+        assert h.request.truncated
+
+        # within-budget prompt stays unflagged
+        h2 = eng.submit(GenRequest(prompt_tokens=[1, 2, 3], max_new_tokens=4))
+        _, info2 = _drain(h2)
+        assert info2["truncated"] is False
     finally:
         eng.stop()
