@@ -1,0 +1,432 @@
+"""Grammar-constrained decoding: byte-level masks for JSON mode and tool calls.
+
+Reference surface: ``response_format: {"type": "json_object"}`` and
+``tools``/``tool_choice`` in the OpenAI dialect, exercised by
+/root/reference/scripts/openai_parity_probe.py:104-186 and the
+structured-output / tool-calling load profiles (which claim "100% format
+compliance", runners/profiles/structured-output.yaml:41). The engines the
+reference benchmarks implement this with token-grammar libraries; here the
+runtime is in-repo, so the mechanism is explicit:
+
+- a host-side **pushdown automaton over bytes** tracks the JSON parse state
+  and yields the set of bytes allowed next;
+- the engine turns that set into an additive logit mask over the byte span
+  of the vocab (ByteTokenizer: one token == one byte, so the automaton and
+  the sampler agree by construction) and applies it **on device** — the
+  hot loop stays jitted; the host only flips mask bits between steps;
+- a **budget guard** forces the shortest legal close when the remaining
+  token budget gets tight, so output is valid JSON even at max_tokens.
+
+The grammar is deliberately a clean JSON subset (objects/arrays/strings
+without escapes/integers/true/false/null, bounded depth and item counts):
+every emission is valid JSON, not every valid JSON is emittable. That is
+the right trade for *format* guarantees — and it makes constrained decoding
+work even on random-weight smoke models, which is exactly what CI needs.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+# printable ASCII minus '"' and '\\' — the characters allowed inside
+# generated strings (no escape sequences => no escape-state machinery)
+_STR_BYTES = bytes(b for b in range(0x20, 0x7F) if b not in (0x22, 0x5C))
+_DIGITS = b"0123456789"
+_SCALAR_STARTS = b'"' + _DIGITS + b"tfn"
+_VALUE_STARTS = b"{[" + _SCALAR_STARTS
+
+_LITERALS = {ord("t"): b"rue", ord("f"): b"alse", ord("n"): b"ull"}
+
+# frame kinds:
+#   value      — want any value start byte
+#   value_obj  — want '{' specifically (root of json_object mode)
+#   obj n      — inside '{', no key yet: '"' opens first key, '}' closes
+#   obj_next n — after a member: ',' continues, '}' closes
+#   key_open   — after ',': '"' must open the next key
+#   key        — inside a key string
+#   colon      — want ':'
+#   arr n      — inside '[', no item yet
+#   arr_next n — after an item: ',' continues, ']' closes
+#   str        — inside a value string
+#   num        — inside an integer (complete at every digit)
+#   lit rest   — finishing true/false/null
+
+
+class JsonMachine:
+    """Incremental generator state for one JSON value.
+
+    ``allowed(budget)`` -> bytes legal next, shrinking to the forced-close
+    set as ``budget`` approaches ``min_close()``; ``advance(b)`` consumes
+    one emitted byte; ``done`` flips when the root value completes.
+    """
+
+    def __init__(
+        self,
+        root: str = "object",
+        max_depth: int = 4,
+        max_str: int = 32,
+        max_items: int = 8,
+    ) -> None:
+        self.max_depth = max_depth
+        self.max_str = max_str
+        self.max_items = max_items
+        self.done = False
+        self.stack: list[list] = [["value_obj" if root == "object" else "value"]]
+        self._str_len = 0
+
+    # -- sizing -------------------------------------------------------------
+
+    def _depth(self) -> int:
+        return sum(1 for f in self.stack if f[0] in ("obj", "obj_next", "arr", "arr_next"))
+
+    def min_close(self) -> int:
+        """Minimal bytes from here to a complete root value."""
+        n = 0
+        for f in reversed(self.stack):
+            kind = f[0]
+            if kind == "value":
+                n += 1            # one digit
+            elif kind == "value_obj":
+                n += 2            # "{}"
+            elif kind in ("obj", "obj_next", "arr", "arr_next"):
+                n += 1            # the close byte
+            elif kind == "key_open":
+                n += 4            # '"' + '"' + ':' + digit
+            elif kind == "key":
+                n += 3            # closing '"' + ':' + digit
+            elif kind == "colon":
+                n += 2            # ':' + digit
+            elif kind == "str":
+                n += 1            # closing '"'
+            elif kind == "num":
+                n += 0            # already complete
+            elif kind == "lit":
+                n += len(f[1])
+        return n
+
+    # -- allowed sets -------------------------------------------------------
+
+    def clone(self) -> "JsonMachine":
+        m = JsonMachine.__new__(JsonMachine)
+        m.max_depth, m.max_str, m.max_items = self.max_depth, self.max_str, self.max_items
+        m.done = self.done
+        m._str_len = self._str_len
+        m.stack = [
+            [f[0], bytearray(f[1])] if f[0] == "lit" else list(f) for f in self.stack
+        ]
+        return m
+
+    def _raw_allowed(self) -> bytes:
+        """Grammar-legal next bytes, honoring size caps but not the budget."""
+        f = self.stack[-1]
+        kind = f[0]
+        if kind == "value_obj":
+            return b"{"
+        if kind == "value":
+            return _VALUE_STARTS if self._depth() < self.max_depth else _SCALAR_STARTS
+        if kind == "obj":
+            return b'"}'
+        if kind == "obj_next":
+            return b"}" if f[1] >= self.max_items else b",}"
+        if kind == "arr":
+            starts = _VALUE_STARTS if self._depth() < self.max_depth else _SCALAR_STARTS
+            return b"]" + starts
+        if kind == "arr_next":
+            return b"]" if f[1] >= self.max_items else b",]"
+        if kind == "key_open":
+            return b'"'
+        if kind in ("key", "str"):
+            return b'"' if self._str_len >= self.max_str else b'"' + _STR_BYTES
+        if kind == "colon":
+            return b":"
+        if kind == "num":
+            parent = self.stack[-2]
+            close = b"}" if parent[0] == "obj" else b"]"
+            cont = close if parent[1] + 1 >= self.max_items else b"," + close
+            # JSON forbids leading zeros: a number that began with '0'
+            # cannot take further digits
+            return cont if f[1] else _DIGITS + cont
+        if kind == "lit":
+            return bytes(f[1][:1])
+        raise AssertionError(f"unknown frame {kind!r}")
+
+    def allowed(self, budget: int) -> bytes:
+        """Bytes legal next AND completable within ``budget`` total bytes.
+
+        Correctness by construction: a byte survives iff one simulated
+        advance leaves ``min_close() <= budget - 1``. The forced-close byte
+        always survives when ``budget >= min_close()``, so the set is never
+        empty while closing remains possible. The simulation is skipped on
+        the fast path (comfortable budget — one byte commits at most ~8
+        more, literals being the worst case)."""
+        if self.done:
+            return b""
+        cands = self._raw_allowed()
+        if budget >= self.min_close() + 16:
+            return cands
+        out = bytearray()
+        for b in cands:
+            m = self.clone()
+            m.advance(b)
+            if m.done or m.min_close() <= budget - 1:
+                out.append(b)
+        return bytes(out)
+
+    # -- transitions --------------------------------------------------------
+
+    def _value_done(self) -> None:
+        """The value on top just completed; fold into the enclosing frame.
+        An empty stack means the root value itself completed."""
+        if not self.stack:
+            self.done = True
+            return
+        parent = self.stack[-1]
+        assert parent[0] in ("obj", "arr"), parent
+        parent[1] += 1
+        parent[0] = "obj_next" if parent[0] == "obj" else "arr_next"
+
+    def advance(self, b: int) -> None:
+        assert not self.done, "advance after completion"
+        f = self.stack[-1]
+        kind = f[0]
+
+        if kind in ("value", "value_obj"):
+            self.stack.pop()
+            if b == ord("{"):
+                self.stack.append(["obj", 0])
+            elif b == ord("["):
+                self.stack.append(["arr", 0])
+            elif b == ord('"'):
+                self._str_len = 0
+                self.stack.append(["str"])
+            elif b in _DIGITS:
+                self.stack.append(["num", b == ord("0")])
+            elif b in _LITERALS:
+                self.stack.append(["lit", bytearray(_LITERALS[b])])
+            else:
+                raise ValueError(f"byte {b!r} is not a value start")
+            return
+        if kind == "obj":
+            if b == ord("}"):
+                self.stack.pop()
+                self._value_done()
+            else:
+                assert b == ord('"'), b
+                self._str_len = 0
+                self.stack.append(["key"])
+            return
+        if kind == "obj_next":
+            if b == ord("}"):
+                self.stack.pop()
+                self._value_done()
+            else:
+                assert b == ord(","), b
+                f[0] = "obj"  # reuse the frame; count kept
+                self.stack.append(["key_open"])
+            return
+        if kind == "key_open":
+            assert b == ord('"'), b
+            self.stack.pop()
+            self._str_len = 0
+            self.stack.append(["key"])
+            return
+        if kind == "arr":
+            if b == ord("]"):
+                self.stack.pop()
+                self._value_done()
+            else:
+                self.stack.append(["value"])
+                self.advance(b)  # re-dispatch the value-start byte
+            return
+        if kind == "arr_next":
+            if b == ord("]"):
+                self.stack.pop()
+                self._value_done()
+            else:
+                assert b == ord(","), b
+                f[0] = "arr"
+                self.stack.append(["value"])
+            return
+        if kind == "key":
+            if b == ord('"'):
+                self.stack.pop()
+                self.stack.append(["colon"])
+            else:
+                self._str_len += 1
+            return
+        if kind == "colon":
+            assert b == ord(":"), b
+            self.stack.pop()
+            self.stack.append(["value"])
+            return
+        if kind == "str":
+            if b == ord('"'):
+                self.stack.pop()
+                self._value_done()
+            else:
+                self._str_len += 1
+            return
+        if kind == "num":
+            if b in _DIGITS:
+                return
+            # implicit end: the byte belongs to the enclosing container
+            self.stack.pop()
+            self._value_done()
+            self.advance(b)
+            return
+        if kind == "lit":
+            assert b == f[1][0], (bytes(f[1]), b)
+            del f[1][:1]
+            if not f[1]:
+                self.stack.pop()
+                self._value_done()
+            return
+        raise AssertionError(f"unknown frame {kind!r}")
+
+
+class TemplateMachine:
+    """Fixed byte template with free JSON holes — the tool-call grammar.
+
+    Parts: ``bytes`` literals, ``("choice", [bytes, ...])`` one-of branches
+    (the tool name under ``tool_choice: auto``), and ``("json",)`` holes
+    filled by a fresh JsonMachine (the tool's free-form arguments).
+    Exposes the same allowed/advance/done/min_close protocol as JsonMachine
+    so the engine treats both uniformly.
+    """
+
+    def __init__(self, parts: Sequence) -> None:
+        self.parts = list(parts)
+        self.idx = 0
+        self.pos = 0
+        self.cands: Optional[list[bytes]] = None  # live choice candidates
+        self.sub: Optional[JsonMachine] = None
+        self.done = not self.parts
+
+    def _next_literal_byte(self) -> Optional[int]:
+        """First byte of the part after the current one (None at the end).
+        Parts following a choice are literals in every grammar we build, so
+        this is the disambiguator for prefix-overlapping tool names."""
+        if self.idx + 1 >= len(self.parts):
+            return None
+        nxt = self.parts[self.idx + 1]
+        if isinstance(nxt, (bytes, bytearray)) and nxt:
+            return nxt[0]
+        return None
+
+    def _part_min(self, i: int) -> int:
+        p = self.parts[i]
+        if isinstance(p, (bytes, bytearray)):
+            return len(p) - (self.pos if i == self.idx else 0)
+        if p[0] == "choice":
+            cands = self.cands if (i == self.idx and self.cands is not None) else p[1]
+            return min(len(c) for c in cands) - (self.pos if i == self.idx else 0)
+        if i == self.idx and self.sub is not None:
+            return self.sub.min_close()
+        return 2  # "{}"
+
+    def min_close(self) -> int:
+        return sum(self._part_min(i) for i in range(self.idx, len(self.parts)))
+
+    def allowed(self, budget: int) -> bytes:
+        if self.done:
+            return b""
+        p = self.parts[self.idx]
+        tail = sum(self._part_min(i) for i in range(self.idx + 1, len(self.parts)))
+        if isinstance(p, (bytes, bytearray)):
+            return bytes(p[self.pos:self.pos + 1])
+        if p[0] == "choice":
+            cands = self.cands if self.cands is not None else list(p[1])
+            out = set()
+            for c in cands:
+                if len(c) > self.pos:
+                    # picking this byte commits to the cheapest candidate
+                    # still compatible with it — must fit the budget
+                    cost = min(
+                        len(c2) for c2 in cands
+                        if len(c2) > self.pos and c2[self.pos] == c[self.pos]
+                    ) - self.pos + tail
+                    if cost <= budget:
+                        out.add(c[self.pos])
+            if any(len(c) == self.pos for c in cands):
+                nb = self._next_literal_byte()
+                if nb is not None:
+                    out.add(nb)
+            return bytes(sorted(out))
+        if self.sub is None:
+            self.sub = JsonMachine(root="object")
+        return self.sub.allowed(budget - tail)
+
+    def advance(self, b: int) -> None:
+        assert not self.done, "advance after completion"
+        p = self.parts[self.idx]
+        if isinstance(p, (bytes, bytearray)):
+            assert p[self.pos] == b, (bytes(p), self.pos, b)
+            self.pos += 1
+            if self.pos == len(p):
+                self._next_part()
+            return
+        if p[0] == "choice":
+            cands = self.cands if self.cands is not None else list(p[1])
+            cont = [c for c in cands if len(c) > self.pos and c[self.pos] == b]
+            if not cont and any(len(c) == self.pos for c in cands):
+                # the byte belongs to the next literal: a candidate just
+                # completed — close the choice and re-dispatch
+                self._next_part()
+                self.advance(b)
+                return
+            assert cont, f"byte {b!r} fits no choice candidate"
+            self.cands = cont
+            self.pos += 1
+            if len(cont) == 1 and self.pos == len(cont[0]):
+                # unambiguous full match with no longer sibling: finish now
+                self._next_part()
+            return
+        if self.sub is None:
+            self.sub = JsonMachine(root="object")
+        self.sub.advance(b)
+        if self.sub.done:
+            self._next_part()
+
+    def _next_part(self) -> None:
+        self.idx += 1
+        self.pos = 0
+        self.cands = None
+        self.sub = None
+        if self.idx >= len(self.parts):
+            self.done = True
+
+
+def json_constraint() -> JsonMachine:
+    """response_format json_object: any object from the emittable subset."""
+    return JsonMachine(root="object")
+
+
+def tool_call_constraint(
+    tool_names: Sequence[str], parallel: bool = False
+) -> TemplateMachine:
+    """Constrain output to our canonical tool-call transcript:
+
+    ``[{"name": "<choice>", "arguments": {...}}, ...]``
+
+    ``parallel=True`` requires one call per provided tool, in order (the
+    deterministic reading of ``parallel_tool_calls`` — the probe asks for
+    "use both tools"); otherwise exactly one call with a model-chosen name.
+    The server parses this JSON back into OpenAI ``tool_calls`` entries.
+    """
+    parts: list = []
+    if parallel:
+        parts.append(b"[")
+        for i, name in enumerate(tool_names):
+            if i:
+                parts.append(b", ")
+            parts.append(b'{"name": "' + name.encode() + b'", "arguments": ')
+            parts.append(("json",))
+            parts.append(b"}")
+        parts.append(b"]")
+    else:
+        parts.append(b'[{"name": "')
+        parts.append(("choice", [n.encode() for n in tool_names]))
+        parts.append(b'", "arguments": ')
+        parts.append(("json",))
+        parts.append(b"}]")
+    return TemplateMachine(parts)

@@ -35,7 +35,12 @@ import numpy as np
 
 from kserve_vllm_mini_tpu.models.config import ModelConfig
 from kserve_vllm_mini_tpu.models.llama import forward
-from kserve_vllm_mini_tpu.runtime.sampling import sample_tokens
+from kserve_vllm_mini_tpu.runtime.sampling import sample_tokens, token_logprobs
+
+# ByteTokenizer id span (256 bytes + 3 specials): constrained-decoding masks
+# cover exactly this prefix of the vocab; everything above is disallowed for
+# constrained slots (those ids decode to nothing byte-wise anyway)
+BYTE_SPAN = 259
 
 
 @dataclass
@@ -74,6 +79,15 @@ class GenRequest:
     # measurement framework must not silently measure a different workload
     truncated: bool = False
     truncated_tokens: int = 0
+    # logprobs in the OpenAI sense: when True, each streamed token event
+    # carries (logprob, top-k ids, top-k logprobs); top_logprobs <= 5
+    logprobs: bool = False
+    top_logprobs: int = 0
+    # grammar-constrained decoding (runtime/constrain.py machine with
+    # allowed/advance/done): json_object mode and tool calls. One token ==
+    # one byte (ByteTokenizer), enforced by the server when it builds the
+    # machine. The engine masks device-side; the machine runs host-side.
+    constraint: Optional[Any] = None
 
 
 class RequestHandle:
@@ -86,6 +100,7 @@ class RequestHandle:
         self.t_first_token: float = 0.0
         self.t_done: float = 0.0
         self.tokens: list[int] = []
+        self.logprobs: list[tuple] = []  # (logprob, [(id, lp) x K]) per token
         self.finish_reason: str = ""
 
     @property
@@ -150,7 +165,9 @@ class Engine:
         self._slot_len = [0] * S
         self._slot_remaining = [0] * S
         self._last_tokens = [pad_id] * S
+        self._slot_machine: list[Optional[Any]] = [None] * S  # constraints
         self._free = list(range(S))
+        self._byte_span = min(cfg.vocab_size, BYTE_SPAN)
 
         self._pending: "queue.Queue[RequestHandle]" = queue.Queue()
         self._rng = jax.random.PRNGKey(self.ecfg.seed)
@@ -237,16 +254,54 @@ class Engine:
                 logits, nc = forward(
                     params, cfg, toks[:, None], lens[:, None], {"k": ck, "v": cv}, lens
                 )
-                nxt = sample_tokens(logits[:, 0, :], sub, temps, topks, topps)
-                return (nc["k"], nc["v"], nxt, lens + 1, r), nxt
+                lg = logits[:, 0, :]
+                nxt = sample_tokens(lg, sub, temps, topks, topps)
+                lp, tids, tlps = token_logprobs(lg, nxt)
+                return (nc["k"], nc["v"], nxt, lens + 1, r), (nxt, lp, tids, tlps)
 
-            (ck, cv, _, _, _), toks_seq = jax.lax.scan(
+            (ck, cv, _, _, _), ys = jax.lax.scan(
                 body, (cache_k, cache_v, tokens, lengths, rng), None, length=n_steps
             )
-            return ck, cv, toks_seq  # toks_seq: [n_steps, S]
+            return ck, cv, ys  # ys: ([n,S], [n,S], [n,S,K], [n,S,K])
 
         self._decode_fns[n_steps] = decode
         return decode
+
+    def _get_masked_decode_fn(self):
+        """Single-step decode with grammar masks: additive mask over the
+        byte span for constrained slots, everything past the span cut off.
+        Logprobs come from the MASKED logits — the true sampling
+        distribution under the constraint. One step per dispatch because
+        the next mask depends on the byte just emitted (the automaton is
+        host-side; only the mask application rides the device)."""
+        fn = self._decode_fns.get("masked")
+        if fn is not None:
+            return fn
+        cfg = self.cfg
+        span = self._byte_span
+
+        @partial(jax.jit, donate_argnums=(1, 2))
+        def decode_masked(params, cache_k, cache_v, tokens, lengths,
+                          temps, topks, topps, rng, mask, use_mask):
+            logits, nc = forward(
+                params, cfg, tokens[:, None], lengths[:, None],
+                {"k": cache_k, "v": cache_v}, lengths,
+            )
+            lg = logits[:, 0, :]
+            lg_masked = jnp.concatenate(
+                [
+                    lg[:, :span] + mask,
+                    jnp.full_like(lg[:, span:], -jnp.inf),
+                ],
+                axis=-1,
+            )
+            lg = jnp.where(use_mask[:, None], lg_masked, lg)
+            nxt = sample_tokens(lg, rng, temps, topks, topps)
+            lp, tids, tlps = token_logprobs(lg, nxt)
+            return nc["k"], nc["v"], (nxt[None], lp[None], tids[None], tlps[None])
+
+        self._decode_fns["masked"] = decode_masked
+        return decode_masked
 
     def _get_spec_fn(self):
         """One fused dispatch per speculative round: drafter proposes k
@@ -311,6 +366,25 @@ class Engine:
             req.truncated_tokens = len(req.prompt_tokens) - self.ecfg.max_prefill_len
             req.prompt_tokens = req.prompt_tokens[-self.ecfg.max_prefill_len:]
         handle = RequestHandle(req)
+        if req.constraint is not None:
+            # the grammar must be closable inside BOTH the token budget and
+            # the slot's remaining KV window — otherwise format compliance
+            # is impossible and the request must fail up front, not emit
+            # truncated pseudo-JSON
+            budget = min(
+                req.max_new_tokens,
+                self.ecfg.max_seq_len - 1 - len(req.prompt_tokens),
+            )
+            need = req.constraint.min_close()
+            if budget < need:
+                handle.events.put(("done", {
+                    "finish_reason": "error",
+                    "error": (
+                        f"constrained format needs >= {need} tokens but only "
+                        f"{budget} fit (max_tokens / cache window)"
+                    ),
+                }))
+                return handle
         self._pending.put(handle)
         self.stats["queue_depth"] = self._pending.qsize()
         return handle
@@ -329,6 +403,39 @@ class Engine:
 
     # -- scheduler loop ----------------------------------------------------
 
+    def _constraint_mask(self, machine, budget: int) -> np.ndarray:
+        """[byte_span] additive f32 mask from the automaton's allowed set.
+        Token id == byte + 3 (ByteTokenizer specials offset)."""
+        mask = np.full((self._byte_span,), -np.inf, dtype=np.float32)
+        for b in machine.allowed(budget):
+            tid = b + 3
+            if tid < self._byte_span:
+                mask[tid] = 0.0
+        return mask
+
+    def _get_first_fn(self):
+        """Jitted first-token sampler over the prefill's last-position
+        logits: mask application + sampling + logprobs in one dispatch."""
+        fn = self._decode_fns.get("first")
+        if fn is not None:
+            return fn
+        span = self._byte_span
+
+        @jax.jit
+        def first(last_logits, rng, temp, topk, topp, mask, use_mask):
+            lg = last_logits[None, :]
+            lg_masked = jnp.concatenate(
+                [lg[:, :span] + mask[None], jnp.full_like(lg[:, span:], -jnp.inf)],
+                axis=-1,
+            )
+            lg = jnp.where(use_mask, lg_masked, lg)
+            tok = sample_tokens(lg, rng, temp[None], topk[None], topp[None])
+            lp, tids, tlps = token_logprobs(lg, tok)
+            return tok[0], lp[0], tids[0], tlps[0]
+
+        self._decode_fns["first"] = first
+        return first
+
     def _admit_one(self, handle: RequestHandle) -> None:
         req = handle.request
         slot = self._free.pop()
@@ -342,15 +449,27 @@ class Engine:
             self.params, self._cache_k, self._cache_v, tokens,
             jnp.int32(n), jnp.int32(slot),
         )
-        # first token: sampled from the prompt's last-position logits
+        # first token: sampled from the prompt's last-position logits,
+        # grammar-masked when the request is constrained
+        machine = req.constraint
+        if machine is not None:
+            # budget = tokens the slot can actually emit: the grammar must
+            # close before max_new_tokens AND before the KV window fills,
+            # else out_of_space cuts the structure mid-emission
+            budget = min(req.max_new_tokens, self.ecfg.max_seq_len - 1 - n)
+            mask = self._constraint_mask(machine, budget)
+        else:
+            mask = np.zeros((self._byte_span,), dtype=np.float32)
         self._rng, sub = jax.random.split(self._rng)
-        first = sample_tokens(
-            last_logits[None, :], sub,
-            jnp.asarray([req.temperature], jnp.float32),
-            jnp.asarray([req.top_k], jnp.int32),
-            jnp.asarray([req.top_p], jnp.float32),
+        first_tok, first_lp, first_tids, first_tlps = self._get_first_fn()(
+            last_logits, sub,
+            jnp.float32(req.temperature),
+            jnp.int32(req.top_k),
+            jnp.float32(req.top_p),
+            jnp.asarray(mask),
+            jnp.bool_(machine is not None),
         )
-        first_id = int(first[0])
+        first_id = int(first_tok)
         if self._drafter_params is not None and self.ecfg.spec_tokens > 0:
             # drafter prefills the same prompt into its own cache so it can
             # propose from full context; its output logits are unused
@@ -365,13 +484,27 @@ class Engine:
 
         handle.t_first_token = time.time()
         handle.tokens.append(first_id)
-        handle.events.put(("token", first_id, handle.t_first_token))
+        lp_info = None
+        if req.logprobs:
+            lp_info = (
+                float(first_lp),
+                list(zip(np.asarray(first_tids).tolist(),
+                         np.asarray(first_tlps).tolist())),
+            )
+            handle.logprobs.append(lp_info)
+        handle.events.put(("token", first_id, handle.t_first_token, lp_info))
 
         self._slot_req[slot] = handle
         self._slot_len[slot] = n
         self._slot_remaining[slot] = req.max_new_tokens - 1
         self._last_tokens[slot] = first_id
+        self._slot_machine[slot] = machine
         self._sampling_arrays = None  # slot population changed
+        if machine is not None:
+            machine.advance(first_id - 3)
+            if machine.done:
+                self._finish_slot(slot, "stop")
+                return
         hit_eos = req.eos_id is not None and first_id == req.eos_id
         if self._slot_remaining[slot] <= 0 or hit_eos:
             self._finish_slot(slot, "stop" if hit_eos else "length")
@@ -406,22 +539,34 @@ class Engine:
             }))
             self.stats["requests_completed"] += 1
         self._slot_req[slot] = None
+        self._slot_machine[slot] = None
         self._free.append(slot)
         self._sampling_arrays = None  # slot population changed
 
-    def _emit_token(self, slot: int, tok: int, now: float) -> bool:
+    def _emit_token(self, slot: int, tok: int, now: float, lp_info=None) -> bool:
         """Record one generated token for a live slot: cache-length/stat
-        bookkeeping, stream event, and finish handling (EOS / budget / cache
-        space). Returns True if the slot finished. The single state machine
-        both the plain and speculative sweeps share."""
+        bookkeeping, stream event, constraint-automaton advance, and finish
+        handling (EOS / budget / cache space / grammar completion). Returns
+        True if the slot finished. The single state machine both the plain
+        and speculative sweeps share."""
         handle = self._slot_req[slot]
         req = handle.request
         self._slot_len[slot] += 1      # the fed token is now in cache
         self._last_tokens[slot] = tok
         handle.tokens.append(tok)
-        handle.events.put(("token", tok, now))
+        if lp_info is not None and req.logprobs:
+            handle.logprobs.append(lp_info)
+        handle.events.put(
+            ("token", tok, now, lp_info if req.logprobs else None)
+        )
         self.stats["decode_tokens"] += 1
         self._slot_remaining[slot] -= 1
+        machine = self._slot_machine[slot]
+        if machine is not None:
+            machine.advance(tok - 3)
+            if machine.done:
+                self._finish_slot(slot, "stop")
+                return True
         hit_eos = req.eos_id is not None and tok == req.eos_id
         out_of_space = self._slot_len[slot] + 1 >= self.ecfg.max_seq_len
         if self._slot_remaining[slot] <= 0 or hit_eos or out_of_space:
@@ -438,6 +583,12 @@ class Engine:
         if k <= 0 or self._drafter_params is None:
             return False
         if any(self._slot_req[i].request.temperature != 0.0 for i in active):
+            return False
+        # constrained slots need a fresh mask per token, and logprob slots
+        # need per-token distributions the spec verify doesn't produce
+        if any(self._slot_machine[i] is not None for i in active):
+            return False
+        if any(self._slot_req[i].request.logprobs for i in active):
             return False
         return all(self._slot_len[i] + k < self.ecfg.max_seq_len for i in active)
 
@@ -486,42 +637,69 @@ class Engine:
         if self._can_spec(active):
             self._spec_sweep(active)
             return
+        constrained = [i for i in active if self._slot_machine[i] is not None]
         # chunk size: fused steps must stay inside every active slot's cache
         # window (requests finishing mid-chunk are handled by surplus
         # discard, NOT by shrinking the chunk — shrinking would compile a
         # fresh scan variant for every distinct remaining-budget value and
         # let one nearly-done request collapse fusion for the whole batch).
         # Rounded down to a power of two so at most log2(decode_chunk)+1
-        # decode executables ever exist.
+        # decode executables ever exist. Grammar-constrained slots force
+        # chunk=1: the next mask depends on the byte just emitted.
         window = min(self.ecfg.max_seq_len - 1 - self._slot_len[i] for i in active)
         chunk = max(1, min(self.ecfg.decode_chunk, window))
         chunk = 1 << (chunk.bit_length() - 1)
+        if constrained:
+            chunk = 1
         tokens = jnp.asarray(self._last_tokens, dtype=jnp.int32)
         # The fed token occupies absolute position slot_len (prompt + generated
         # tokens already written); forward writes its KV there and attends <=.
         lengths = jnp.asarray(self._slot_len, dtype=jnp.int32)
         temps, topks, topps = self._get_sampling_arrays()
         self._rng, sub = jax.random.split(self._rng)
-        decode = self._get_decode_fn(chunk)
         t0 = time.time()
-        self._cache_k, self._cache_v, toks_seq = decode(
-            self.params, self._cache_k, self._cache_v,
-            tokens, lengths, temps, topks, topps, sub,
-        )
-        # ONE host transfer for the whole [chunk, S] block — per-element
+        if constrained:
+            mask = np.zeros((S, self._byte_span), dtype=np.float32)
+            for i in constrained:
+                budget = min(
+                    self._slot_remaining[i],
+                    self.ecfg.max_seq_len - 1 - self._slot_len[i],
+                )
+                mask[i] = self._constraint_mask(self._slot_machine[i], budget)
+            use_mask = np.zeros((S,), dtype=bool)
+            use_mask[constrained] = True
+            decode = self._get_masked_decode_fn()
+            self._cache_k, self._cache_v, ys = decode(
+                self.params, self._cache_k, self._cache_v,
+                tokens, lengths, temps, topks, topps, sub,
+                jnp.asarray(mask), jnp.asarray(use_mask),
+            )
+        else:
+            decode = self._get_decode_fn(chunk)
+            self._cache_k, self._cache_v, ys = decode(
+                self.params, self._cache_k, self._cache_v,
+                tokens, lengths, temps, topks, topps, sub,
+            )
+        # ONE host transfer for the whole chunk block — per-element
         # int(row[i]) costs a separate device readback each (chunk x slots
         # round-trips per sweep; this line was the serving bottleneck, not
         # the decode math)
-        steps_host = np.asarray(jax.device_get(toks_seq)).tolist()
+        toks_h, lps_h, tids_h, tlps_h = (np.asarray(a) for a in jax.device_get(ys))
         now = time.time()
         self.stats["busy_s"] += now - t0
         self.stats["decode_steps"] += chunk
 
-        for step_tokens in steps_host:
+        for step in range(toks_h.shape[0]):
             for i in active:
                 if self._slot_req[i] is None:
                     continue  # finished earlier in this chunk; surplus discarded
-                self._emit_token(i, step_tokens[i], now)
+                lp_info = None
+                if self._slot_req[i].request.logprobs:
+                    lp_info = (
+                        float(lps_h[step, i]),
+                        list(zip(tids_h[step, i].tolist(), tlps_h[step, i].tolist())),
+                    )
+                self._emit_token(i, int(toks_h[step, i]), now, lp_info)
 
     def _fail_all(self, exc: BaseException) -> None:
         """Push an error 'done' to every live/pending handle so no client

@@ -49,3 +49,26 @@ def sample_tokens(
 
     sampled = jax.random.categorical(rng, scaled, axis=-1)
     return jnp.where(temperature > 0, sampled, greedy).astype(jnp.int32)
+
+
+# OpenAI caps top_logprobs at 5; one static K keeps a single decode
+# executable regardless of what each request asked for (the host slices)
+TOP_LOGPROBS_K = 5
+
+
+def token_logprobs(
+    logits: jnp.ndarray,   # [B, V] float32 (post-mask: the real sampling dist)
+    tokens: jnp.ndarray,   # [B] int32 chosen ids
+    k: int = TOP_LOGPROBS_K,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """(chosen logprob [B], top-k ids [B,k], top-k logprobs [B,k]).
+
+    Computed on device inside the decode dispatch: a logsumexp + gather +
+    top_k over [B, V] is noise next to the model forward, and returning it
+    unconditionally keeps one executable (no logprobs-variant recompiles).
+    """
+    lse = jax.scipy.special.logsumexp(logits, axis=-1, keepdims=True)  # [B,1]
+    logp = logits - lse
+    chosen = jnp.take_along_axis(logp, tokens[:, None].astype(jnp.int32), axis=-1)[:, 0]
+    top_vals, top_ids = jax.lax.top_k(logp, k)
+    return chosen, top_ids.astype(jnp.int32), top_vals

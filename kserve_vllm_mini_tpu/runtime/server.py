@@ -145,6 +145,101 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str):
         parts.append("assistant:")
         return "\n".join(parts)
 
+    def _lp_entry(token_id: int, lp_info, top_n: int) -> dict[str, Any]:
+        """OpenAI logprobs.content entry for one emitted token. -inf
+        alternatives (grammar-masked bytes) are dropped: json.dumps would
+        render them as '-Infinity', which is not RFC-valid JSON."""
+        import math
+
+        text = tok.decode([token_id])
+        lp, top = lp_info
+        return {
+            "token": text,
+            "logprob": lp,
+            "bytes": list(text.encode()),
+            "top_logprobs": [
+                {"token": tok.decode([tid]), "logprob": tlp,
+                 "bytes": list(tok.decode([tid]).encode())}
+                for tid, tlp in top[:top_n]
+                if math.isfinite(tlp)
+            ],
+        }
+
+    def _build_constraint(body: dict[str, Any], max_tokens: int):
+        """Constraint machine + tool flag from the request, or an error str.
+
+        Grammar masks assume one token == one byte, i.e. the ByteTokenizer
+        (runtime/constrain.py); BPE checkpoints would need a token-trie
+        grammar compiler — reported honestly as unsupported rather than
+        emitting unvalidated output."""
+        from kserve_vllm_mini_tpu.runtime.constrain import (
+            json_constraint,
+            tool_call_constraint,
+        )
+        from kserve_vllm_mini_tpu.runtime.tokenizer import ByteTokenizer
+
+        tools = body.get("tools") or []
+        tool_choice = body.get("tool_choice", "auto" if tools else "none")
+        wants_tools = bool(tools) and tool_choice != "none"
+        rf = (body.get("response_format") or {}).get("type")
+        wants_json = rf == "json_object"
+        if not (wants_tools or wants_json):
+            return None, False, None
+        if not isinstance(tok, ByteTokenizer):
+            return None, False, (
+                "tools/json_mode require the byte-level tokenizer in this "
+                "build (grammar-constrained decoding)"
+            )
+        if wants_tools:
+            names = [
+                t.get("function", {}).get("name", "")
+                for t in tools if t.get("type") == "function"
+            ]
+            names = [n for n in names if n]
+            if isinstance(tool_choice, dict):  # {"type":"function","function":{"name":...}}
+                forced = tool_choice.get("function", {}).get("name")
+                if forced not in names:
+                    return None, False, (
+                        f"tool_choice names {forced!r} which is not in tools"
+                    )
+                names = [forced]
+            if not names:
+                return None, False, "tools given but no function names"
+            machine = tool_call_constraint(
+                names, parallel=bool(body.get("parallel_tool_calls")) and len(names) > 1
+            )
+        else:
+            machine = json_constraint()
+        if max_tokens < machine.min_close():
+            return None, False, (
+                f"max_tokens={max_tokens} cannot fit the constrained format "
+                f"(needs >= {machine.min_close()})"
+            )
+        return machine, wants_tools, None
+
+    def _tool_calls_from_text(text: str) -> Optional[list[dict[str, Any]]]:
+        """Parse our canonical constrained transcript back into OpenAI
+        tool_calls entries."""
+        try:
+            calls = json.loads(text)
+        except json.JSONDecodeError:
+            return None
+        if not isinstance(calls, list):
+            return None
+        out = []
+        for i, c in enumerate(calls):
+            if not isinstance(c, dict) or "name" not in c:
+                return None
+            out.append({
+                "id": f"call_{uuid.uuid4().hex[:8]}_{i}",
+                "type": "function",
+                "function": {
+                    "name": c["name"],
+                    "arguments": json.dumps(c.get("arguments", {})),
+                },
+            })
+        return out
+
     async def chat(request: "web.Request"):
         try:
             body = await request.json()
@@ -155,15 +250,24 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str):
             return web.json_response(
                 {"error": {"message": "'messages' must be a non-empty list"}}, status=400
             )
+        max_tokens = int(body.get("max_tokens", 64))
+        machine, wants_tools, err = _build_constraint(body, max_tokens)
+        if err:
+            return web.json_response({"error": {"message": err}}, status=400)
+        want_logprobs = bool(body.get("logprobs", False))
+        top_lp = min(int(body.get("top_logprobs", 0) or 0), 5)
         prompt = _messages_to_prompt(messages)
         prompt_ids = tok.encode(prompt)
         req = GenRequest(
             prompt_tokens=prompt_ids or [tok.bos_id],
-            max_new_tokens=int(body.get("max_tokens", 64)),
+            max_new_tokens=max_tokens,
             temperature=float(body.get("temperature", 0.0)),
             top_k=int(body.get("top_k", 0)),
             top_p=float(body.get("top_p", 1.0)),
-            eos_id=tok.eos_id,
+            eos_id=None if machine is not None else tok.eos_id,
+            logprobs=want_logprobs,
+            top_logprobs=top_lp,
+            constraint=machine,
         )
         handle = engine.submit(req)
         rid = f"chatcmpl-{uuid.uuid4().hex[:20]}"
@@ -175,28 +279,40 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str):
 
         if not body.get("stream", False):
             out_ids: list[int] = []
+            lp_entries: list[dict[str, Any]] = []
             info: dict[str, Any] = {}
             while True:
                 kind, *rest = await next_event()
                 if kind == "token":
                     out_ids.append(rest[0])
+                    if want_logprobs and len(rest) > 2 and rest[2] is not None:
+                        lp_entries.append(_lp_entry(rest[0], rest[2], top_lp))
                 else:
                     info = rest[0]
                     break
             text = tok.decode(out_ids)
+            message: dict[str, Any] = {"role": "assistant", "content": text}
+            finish = info.get("finish_reason", "stop")
+            if wants_tools:
+                calls = _tool_calls_from_text(text)
+                if calls is not None:
+                    message = {"role": "assistant", "content": None,
+                               "tool_calls": calls}
+                    finish = "tool_calls"
+            choice: dict[str, Any] = {
+                "index": 0,
+                "message": message,
+                "finish_reason": finish,
+            }
+            if want_logprobs:
+                choice["logprobs"] = {"content": lp_entries}
             return web.json_response(
                 {
                     "id": rid,
                     "object": "chat.completion",
                     "created": created,
                     "model": model_name,
-                    "choices": [
-                        {
-                            "index": 0,
-                            "message": {"role": "assistant", "content": text},
-                            "finish_reason": info.get("finish_reason", "stop"),
-                        }
-                    ],
+                    "choices": [choice],
                     "usage": {
                         "prompt_tokens": len(prompt_ids),
                         "completion_tokens": len(out_ids),
@@ -217,20 +333,43 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str):
         await resp.prepare(request)
         n_out = 0
         sent_first = False
+        tool_ids: list[int] = []
         try:
             while True:
                 kind, *rest = await next_event()
                 if kind == "token":
                     n_out += 1
+                    if wants_tools:
+                        # tool transcripts stream as one delta at the end:
+                        # partial tool-call JSON is useless to clients — but
+                        # the first-token metrics chunk must still go out or
+                        # the loadgen loses the true server TTFT
+                        tool_ids.append(rest[0])
+                        if not sent_first:
+                            ttft_evt = {
+                                "id": rid, "object": "chat.completion.chunk",
+                                "created": created, "model": model_name,
+                                "choices": [{"index": 0, "delta": {},
+                                             "finish_reason": None}],
+                                "metrics": {"server_ttft_ms": handle.server_ttft_ms},
+                            }
+                            await resp.write(f"data: {json.dumps(ttft_evt)}\n\n".encode())
+                            sent_first = True
+                        continue
                     piece = tok.decode([rest[0]])
+                    chunk_choice: dict[str, Any] = {
+                        "index": 0, "delta": {"content": piece}, "finish_reason": None
+                    }
+                    if want_logprobs and len(rest) > 2 and rest[2] is not None:
+                        chunk_choice["logprobs"] = {
+                            "content": [_lp_entry(rest[0], rest[2], top_lp)]
+                        }
                     evt: dict[str, Any] = {
                         "id": rid,
                         "object": "chat.completion.chunk",
                         "created": created,
                         "model": model_name,
-                        "choices": [
-                            {"index": 0, "delta": {"content": piece}, "finish_reason": None}
-                        ],
+                        "choices": [chunk_choice],
                     }
                     if not sent_first:
                         evt["metrics"] = {"server_ttft_ms": handle.server_ttft_ms}
@@ -238,14 +377,21 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str):
                     await resp.write(f"data: {json.dumps(evt)}\n\n".encode())
                 else:
                     info = rest[0]
+                    final_delta: dict[str, Any] = {}
+                    finish = info.get("finish_reason", "stop")
+                    if wants_tools:
+                        calls = _tool_calls_from_text(tok.decode(tool_ids))
+                        if calls is not None:
+                            final_delta = {"tool_calls": calls}
+                            finish = "tool_calls"
                     final = {
                         "id": rid,
                         "object": "chat.completion.chunk",
                         "created": created,
                         "model": model_name,
                         "choices": [
-                            {"index": 0, "delta": {},
-                             "finish_reason": info.get("finish_reason", "stop")}
+                            {"index": 0, "delta": final_delta,
+                             "finish_reason": finish}
                         ],
                         "usage": {
                             "prompt_tokens": len(prompt_ids),
@@ -253,6 +399,7 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str):
                             "total_tokens": len(prompt_ids) + n_out,
                         },
                         "metrics": {
+                            "server_ttft_ms": handle.server_ttft_ms,
                             "truncated": bool(info.get("truncated", False)),
                             "truncated_tokens": int(info.get("truncated_tokens", 0)),
                         },

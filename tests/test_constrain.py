@@ -1,0 +1,128 @@
+"""Grammar-constrained decoding machines: every random walk through the
+allowed-byte sets must terminate within budget and parse as valid JSON —
+the property the engine's "100% format compliance" guarantee rests on."""
+
+import json
+import random
+
+import pytest
+
+from kserve_vllm_mini_tpu.runtime.constrain import (
+    JsonMachine,
+    TemplateMachine,
+    json_constraint,
+    tool_call_constraint,
+)
+
+
+def walk(machine, budget: int, rng: random.Random) -> str:
+    """Emit uniformly-random allowed bytes until the machine completes."""
+    out = bytearray()
+    for _ in range(budget):
+        if machine.done:
+            break
+        allowed = machine.allowed(budget - len(out))
+        assert allowed, f"dead end after {bytes(out)!r}"
+        b = rng.choice(allowed)
+        machine.advance(b)
+        out.append(b)
+    assert machine.done, f"did not complete in {budget}: {bytes(out)!r}"
+    return out.decode()
+
+
+@pytest.mark.parametrize("seed", range(25))
+def test_json_mode_random_walk_always_valid(seed):
+    rng = random.Random(seed)
+    budget = rng.randint(8, 200)
+    text = walk(json_constraint(), budget, rng)
+    parsed = json.loads(text)          # must parse...
+    assert isinstance(parsed, dict)    # ...as an object
+    assert len(text) <= budget
+
+
+@pytest.mark.parametrize("seed", range(25))
+def test_tool_call_random_walk_single(seed):
+    rng = random.Random(1000 + seed)
+    budget = rng.randint(40, 200)
+    m = tool_call_constraint(["get_weather", "get_time"], parallel=False)
+    text = walk(m, budget, rng)
+    calls = json.loads(text)
+    assert isinstance(calls, list) and len(calls) == 1
+    assert calls[0]["name"] in ("get_weather", "get_time")
+    assert isinstance(calls[0]["arguments"], dict)
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_tool_call_random_walk_parallel(seed):
+    rng = random.Random(2000 + seed)
+    m = tool_call_constraint(["get_weather", "get_time"], parallel=True)
+    text = walk(m, 300, rng)
+    calls = json.loads(text)
+    assert [c["name"] for c in calls] == ["get_weather", "get_time"]
+    assert all(isinstance(c["arguments"], dict) for c in calls)
+
+
+def test_prefix_overlapping_tool_names():
+    """Names where one is a prefix of another must still disambiguate."""
+    for seed in range(20):
+        rng = random.Random(3000 + seed)
+        m = tool_call_constraint(["get", "get_all", "get_allocations"])
+        text = walk(m, 200, rng)
+        calls = json.loads(text)
+        assert calls[0]["name"] in ("get", "get_all", "get_allocations")
+
+
+def test_minimal_budget_still_closes():
+    """With budget == min_close the machine must drive straight to the
+    shortest legal JSON."""
+    m = json_constraint()
+    budget = m.min_close()
+    out = bytearray()
+    while not m.done:
+        allowed = m.allowed(budget - len(out))
+        assert allowed
+        m.advance(allowed[0])
+        out.append(allowed[0])
+    assert json.loads(out.decode()) == {}
+
+
+def test_greedy_first_byte_is_brace():
+    m = json_constraint()
+    assert m.allowed(100) == b"{"
+
+
+def test_machine_rejects_disallowed_byte():
+    m = JsonMachine(root="object")
+    m.advance(ord("{"))
+    with pytest.raises((AssertionError, ValueError)):
+        m.advance(ord(":"))
+
+
+def test_template_literal_and_min_close():
+    m = TemplateMachine([b"ab", ("json",), b"c"])
+    assert m.min_close() == 2 + 2 + 1  # "ab" + "{}" + "c"
+    for b in b"ab":
+        m.advance(b)
+    m.advance(ord("{"))
+    m.advance(ord("}"))
+    m.advance(ord("c"))
+    assert m.done
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_deep_nesting_respects_depth_cap(seed):
+    rng = random.Random(4000 + seed)
+    text = walk(json_constraint(), 400, rng)
+    depth = max_depth = 0
+    in_str = False
+    for ch in text:
+        if ch == '"':
+            in_str = not in_str
+        if in_str:
+            continue
+        if ch in "{[":
+            depth += 1
+            max_depth = max(max_depth, depth)
+        elif ch in "}]":
+            depth -= 1
+    assert max_depth <= 4

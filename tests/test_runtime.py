@@ -393,3 +393,132 @@ def test_prompt_truncation_flagged(params):
         assert info2["truncated"] is False
     finally:
         eng.stop()
+
+
+def _decode_bytes(ids):
+    return bytes(i - 3 for i in ids if 3 <= i < 259).decode()
+
+
+def test_constrained_json_mode(params):
+    """Grammar-masked decoding must yield valid JSON from a random-weight
+    model — format compliance comes from the mask, not the weights."""
+    import json as _json
+
+    from kserve_vllm_mini_tpu.runtime.constrain import json_constraint
+
+    eng = make_engine(params)
+    try:
+        h = eng.submit(GenRequest(prompt_tokens=[5, 9, 42], max_new_tokens=60,
+                                  constraint=json_constraint()))
+        tokens, info = _drain(h)
+        parsed = _json.loads(_decode_bytes(tokens))
+        assert isinstance(parsed, dict)
+        assert info["finish_reason"] == "stop"
+    finally:
+        eng.stop()
+
+
+def test_constrained_tool_call(params):
+    import json as _json
+
+    from kserve_vllm_mini_tpu.runtime.constrain import tool_call_constraint
+
+    eng = make_engine(params)
+    try:
+        h = eng.submit(GenRequest(
+            prompt_tokens=[5, 9], max_new_tokens=80,
+            constraint=tool_call_constraint(["get_weather", "get_time"]),
+        ))
+        tokens, info = _drain(h)
+        calls = _json.loads(_decode_bytes(tokens))
+        assert len(calls) == 1
+        assert calls[0]["name"] in ("get_weather", "get_time")
+        assert isinstance(calls[0]["arguments"], dict)
+    finally:
+        eng.stop()
+
+
+def test_constrained_and_plain_coexist(params):
+    """A constrained slot must not perturb an unconstrained neighbor: the
+    plain request still matches its sequential greedy oracle exactly."""
+    import json as _json
+
+    from kserve_vllm_mini_tpu.runtime.constrain import json_constraint
+
+    eng = make_engine(params)
+    try:
+        ref = greedy_reference(params, [3, 1, 4, 1, 5], 10)
+        hc = eng.submit(GenRequest(prompt_tokens=[7, 8], max_new_tokens=40,
+                                   constraint=json_constraint()))
+        hp = eng.submit(GenRequest(prompt_tokens=[3, 1, 4, 1, 5], max_new_tokens=10))
+        tc, _ = _drain(hc)
+        tp, _ = _drain(hp)
+        assert tp == ref
+        assert isinstance(_json.loads(_decode_bytes(tc)), dict)
+    finally:
+        eng.stop()
+
+
+def test_logprobs_emitted(params):
+    """Greedy decode: chosen token is the top-1 alternative and every
+    logprob is a true log-probability (<= 0, top list descending)."""
+    eng = make_engine(params)
+    try:
+        h = eng.submit(GenRequest(prompt_tokens=[5, 9, 42], max_new_tokens=6,
+                                  logprobs=True, top_logprobs=3))
+        tokens, info = _drain(h)
+        assert len(h.logprobs) == len(tokens) == 6
+        for tok, (lp, top) in zip(tokens, h.logprobs):
+            assert lp <= 0.0
+            assert top[0][0] == tok            # greedy: chosen == argmax
+            assert abs(top[0][1] - lp) < 1e-4  # and its lp matches
+            lps = [t[1] for t in top]
+            assert lps == sorted(lps, reverse=True)
+    finally:
+        eng.stop()
+
+
+def test_logprobs_absent_by_default(params):
+    eng = make_engine(params)
+    try:
+        h = eng.submit(GenRequest(prompt_tokens=[5], max_new_tokens=4))
+        _drain(h)
+        assert h.logprobs == []
+    finally:
+        eng.stop()
+
+
+def test_constrained_json_respects_cache_window(params):
+    """The grammar must close inside the slot's KV window, not just the
+    token budget — a 'length' cut mid-object would break the format
+    guarantee."""
+    import json as _json
+
+    from kserve_vllm_mini_tpu.runtime.constrain import json_constraint
+
+    eng = make_engine(params, max_seq=128)  # max_prefill_len=64
+    try:
+        prompt = list(range(1, 61))          # window = 127 - 60 = 67
+        h = eng.submit(GenRequest(prompt_tokens=prompt, max_new_tokens=120,
+                                  constraint=json_constraint()))
+        tokens, info = _drain(h)
+        assert info["finish_reason"] == "stop"
+        assert isinstance(_json.loads(_decode_bytes(tokens)), dict)
+        assert len(prompt) + len(tokens) < 128
+    finally:
+        eng.stop()
+
+
+def test_constrained_impossible_budget_fails_fast(params):
+    from kserve_vllm_mini_tpu.runtime.constrain import json_constraint
+
+    eng = make_engine(params)
+    try:
+        h = eng.submit(GenRequest(prompt_tokens=[1, 2, 3], max_new_tokens=1,
+                                  constraint=json_constraint()))
+        kind, info = h.events.get(timeout=10)
+        assert kind == "done"
+        assert info["finish_reason"] == "error"
+        assert "constrained format" in info["error"]
+    finally:
+        eng.stop()

@@ -1,0 +1,109 @@
+"""The repo's own parity probe against the repo's own server.
+
+Round-2 verdict: the server scored ~1/5 on the five OpenAI capabilities its
+own probe measures (tools, parallel tools, JSON mode, logprobs, streaming).
+With grammar-constrained decoding and device-side logprobs this must now be
+5/5 — probed over a real HTTP socket, not mocked internals.
+"""
+
+import asyncio
+import socket
+import threading
+
+import pytest
+
+from kserve_vllm_mini_tpu.compare.parity import ParityProber
+from kserve_vllm_mini_tpu.runtime.server import build_engine, make_app
+
+pytestmark = pytest.mark.slow
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture(scope="module")
+def server_url():
+    from aiohttp import web
+
+    engine, tok, name = build_engine(model="llama-tiny", max_slots=4, max_seq_len=256)
+    engine.start()
+    app = make_app(engine, tok, name)
+    port = _free_port()
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+
+    def serve():
+        asyncio.set_event_loop(loop)
+        runner = web.AppRunner(app)
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, "127.0.0.1", port)
+        loop.run_until_complete(site.start())
+        started.set()
+        loop.run_forever()
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    assert started.wait(timeout=30)
+    yield f"http://127.0.0.1:{port}"
+    loop.call_soon_threadsafe(loop.stop)
+    engine.stop()
+
+
+def test_parity_probe_scores_5_of_5(server_url):
+    prober = ParityProber(server_url, model="llama-tiny", timeout_s=120.0)
+    results = asyncio.run(prober.probe_all())
+    by_name = {r.capability: r for r in results}
+    for cap, r in by_name.items():
+        assert r.supported, f"{cap}: {r.detail}"
+    assert len(results) == 5
+
+
+def test_json_mode_with_logprobs_is_rfc_valid(server_url):
+    """Masked alternatives are -inf; the response must never serialize
+    '-Infinity' (invalid JSON for strict parsers), and top_logprobs must
+    honor the requested count."""
+    import httpx
+
+    resp = httpx.post(
+        f"{server_url}/v1/chat/completions",
+        json={
+            "messages": [{"role": "user", "content": "Give me JSON."}],
+            "response_format": {"type": "json_object"},
+            "logprobs": True,
+            "top_logprobs": 2,
+            "max_tokens": 40,
+        },
+        timeout=120.0,
+    )
+    assert resp.status_code == 200
+    assert "Infinity" not in resp.text
+    data = resp.json()
+    entries = data["choices"][0]["logprobs"]["content"]
+    assert entries
+    import json as _json
+
+    assert isinstance(_json.loads(data["choices"][0]["message"]["content"]), dict)
+    for e in entries:
+        assert len(e["top_logprobs"]) <= 2
+        assert all(t["logprob"] > -1e30 for t in e["top_logprobs"])
+
+
+def test_forced_tool_choice_not_in_tools_is_400(server_url):
+    import httpx
+
+    resp = httpx.post(
+        f"{server_url}/v1/chat/completions",
+        json={
+            "messages": [{"role": "user", "content": "weather?"}],
+            "tools": [{"type": "function",
+                       "function": {"name": "get_weather", "parameters": {}}}],
+            "tool_choice": {"type": "function", "function": {"name": "get_time"}},
+            "max_tokens": 64,
+        },
+        timeout=60.0,
+    )
+    assert resp.status_code == 400
+    assert "get_time" in resp.json()["error"]["message"]
