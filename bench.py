@@ -63,6 +63,7 @@ def main() -> int:
 
     model = os.environ.get("KVMINI_BENCH_MODEL", "llama-3.1-8b")
     quant = os.environ.get("KVMINI_BENCH_QUANT", "int8")
+    kv_quant = os.environ.get("KVMINI_BENCH_KV", "") == "int8"
     slots = int(os.environ.get("KVMINI_BENCH_SLOTS", "32"))
     prompt_len = 128
     max_seq = 512
@@ -83,7 +84,7 @@ def main() -> int:
     param_bytes = quantized_bytes(params)
     _log(f"params ready ({param_bytes / 1e9:.2f} GB on device)")
 
-    cache = init_kv_cache(cfg, slots, max_seq=max_seq)
+    cache = init_kv_cache(cfg, slots, max_seq=max_seq, quantized=kv_quant)
     toks = jax.random.randint(jax.random.PRNGKey(1), (slots, prompt_len), 0, cfg.vocab_size)
     pos = jnp.broadcast_to(jnp.arange(prompt_len, dtype=jnp.int32), (slots, prompt_len))
 
@@ -99,7 +100,7 @@ def main() -> int:
         return cache, jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
 
     # -- single-request prefill: the per-request TTFT cost ------------------
-    cache1 = init_kv_cache(cfg, 1, max_seq=max_seq)
+    cache1 = init_kv_cache(cfg, 1, max_seq=max_seq, quantized=kv_quant)
     toks1, pos1 = toks[:1], pos[:1]
 
     @jax.jit
@@ -188,10 +189,12 @@ def main() -> int:
     # achieved HBM streaming: every decode step reads all weights once plus
     # the live KV prefix per slot (2 tensors, kv-heads, ctx, head_dim)
     ctx_mid = prompt_len + warmup + n_short + n_timed // 2
-    kv_bytes_step = (
-        2 * cfg.n_layers * slots * cfg.n_kv_heads * ctx_mid * cfg.head_dim
-        * jnp.dtype(cfg.jnp_dtype).itemsize
+    # int8-KV streams 1 byte/element + a 4-byte f32 scale per position
+    kv_elem_bytes = (
+        cfg.head_dim * 1 + 4 if kv_quant
+        else cfg.head_dim * jnp.dtype(cfg.jnp_dtype).itemsize
     )
+    kv_bytes_step = 2 * cfg.n_layers * slots * cfg.n_kv_heads * ctx_mid * kv_elem_bytes
     bytes_step = param_bytes + kv_bytes_step
     bw_gbps = bytes_step / (dt / n_timed) / 1e9
     bw_util = bw_gbps / V5E_HBM_GBPS if on_tpu else 0.0
@@ -202,8 +205,8 @@ def main() -> int:
     baseline = 2000.0  # north-star output tokens/sec/chip
     result = {
         "metric": (
-            f"decode_tokens_per_sec_per_chip ({cfg.name}, {quant}, "
-            f"slots={slots}, ctx~{prompt_len}+)"
+            f"decode_tokens_per_sec_per_chip ({cfg.name}, {quant}"
+            f"{'+int8kv' if kv_quant else ''}, slots={slots}, ctx~{prompt_len}+)"
         ),
         "value": round(per_chip, 1),
         "unit": "tokens/s/chip",

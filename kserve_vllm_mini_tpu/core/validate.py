@@ -6,7 +6,7 @@ validate_config.py:16-155): catch known-bad combinations *before* a
 TPU equivalents:
 
 - quantization compatibility: awq/gptq are CUDA-kernel formats -> error on
-  TPU; int8/aqt/fp8 pass (fp8 warns on v5e which lacks native fp8)
+  TPU; int8/aqt pass; fp8 is rejected (no kernel path in this runtime)
 - GPU-memory heuristic -> HBM-per-chip fit check from model size vs topology
 - nvidia-smi autodetect -> jax.devices() probe (injectable for tests, the
   reference's fake-the-probe pattern, SURVEY.md §4.1)
@@ -23,7 +23,9 @@ import yaml
 from kserve_vllm_mini_tpu.loadgen.arrivals import PATTERNS
 
 HBM_GIB_PER_CHIP = {"v4": 32.0, "v5e": 16.0, "v5p": 95.0, "v6e": 32.0}
-TPU_QUANT_OK = {"none", "bf16", "int8", "aqt-int8", "fp8"}
+# fp8 deliberately NOT advertised: the in-repo runtime has no fp8 kernel
+# path and v5e lacks native fp8 — a knob nothing executes is a lie
+TPU_QUANT_OK = {"none", "bf16", "int8", "aqt-int8"}
 GPU_ONLY_QUANT = {"awq", "gptq", "autoawq", "marlin", "squeezellm"}
 
 # rough parameter counts for HBM-fit estimates (bf16 bytes = 2/param + ~30%
@@ -95,7 +97,7 @@ def validate_profile(
     if quant in GPU_ONLY_QUANT:
         rep.errors.append(
             f"quantization '{quant}' requires CUDA kernels and cannot run on "
-            "TPU — use 'int8' (AQT) or 'fp8' (v5p/v6e) instead"
+            "TPU — use 'int8' (AQT) instead"
         )
     elif quant not in TPU_QUANT_OK:
         rep.warnings.append(f"unrecognized quantization '{quant}'; proceeding unvalidated")
@@ -110,14 +112,9 @@ def validate_profile(
                 f"known: {sorted(HBM_GIB_PER_CHIP)}"
             )
         elif chips:
-            if quant == "fp8" and gen == "v5e":
-                rep.warnings.append(
-                    "fp8 on v5e lacks native hardware support; expect "
-                    "dequantize-to-bf16 performance"
-                )
             size_b = _model_size_hint(str(profile.get("model", "")))
             if size_b is not None:
-                bytes_per_param = 1.0 if quant in ("int8", "aqt-int8", "fp8") else 2.0
+                bytes_per_param = 1.0 if quant in ("int8", "aqt-int8") else 2.0
                 need_gib = size_b * bytes_per_param * 1.3
                 have_gib = HBM_GIB_PER_CHIP[gen] * chips
                 if need_gib > have_gib:

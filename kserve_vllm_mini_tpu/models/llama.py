@@ -31,7 +31,10 @@ from kserve_vllm_mini_tpu.ops.rmsnorm import rms_norm
 from kserve_vllm_mini_tpu.ops.rope import apply_rope, rope_frequencies
 
 Params = dict[str, Any]
-KVCache = dict[str, jnp.ndarray]  # {"k": [L,B,KVH,S,D], "v": [L,B,KVH,S,D]}
+# {"k": [L,B,KVH,S,D], "v": [L,B,KVH,S,D]} — plus, when int8-quantized,
+# per-position scales {"k_s": [L,B,KVH,S], "v_s": [L,B,KVH,S]} (presence of
+# "k_s" is the static flag that selects the quantized cache path)
+KVCache = dict[str, jnp.ndarray]
 
 
 def _stacked_weight_shapes(cfg: ModelConfig) -> dict[str, tuple[int, int]]:
@@ -129,13 +132,63 @@ def init_params_quantized(rng: jax.Array, cfg: ModelConfig) -> Params:
     return _init_impl(rng, cfg, leaf_fn)
 
 
-def init_kv_cache(cfg: ModelConfig, batch: int, max_seq: Optional[int] = None) -> KVCache:
+def init_kv_cache(
+    cfg: ModelConfig,
+    batch: int,
+    max_seq: Optional[int] = None,
+    dtype: Optional[Any] = None,
+    quantized: bool = False,
+) -> KVCache:
+    """``quantized=True`` -> int8 cache with per-(position, head) f32
+    scales: (D+4)/(2D) of the bf16 cache's HBM footprint (~52% at D=128)
+    and the same factor off the bytes streamed per decode step (the KV
+    read is the second-largest stream after the weights). Values are quantized on write with
+    a per-position amax scale — reference analog: the kv-cache-dtype knob
+    the quantization sweep measures (sweeps/quantization_sweep.py:40-234)."""
     s = max_seq or cfg.max_seq_len
     shape = (cfg.n_layers, batch, cfg.n_kv_heads, s, cfg.head_dim)
+    if quantized:
+        return {
+            "k": jnp.zeros(shape, dtype=jnp.int8),
+            "v": jnp.zeros(shape, dtype=jnp.int8),
+            "k_s": jnp.zeros(shape[:-1], dtype=jnp.float32),
+            "v_s": jnp.zeros(shape[:-1], dtype=jnp.float32),
+        }
     return {
-        "k": jnp.zeros(shape, dtype=cfg.jnp_dtype),
-        "v": jnp.zeros(shape, dtype=cfg.jnp_dtype),
+        "k": jnp.zeros(shape, dtype=dtype or cfg.jnp_dtype),
+        "v": jnp.zeros(shape, dtype=dtype or cfg.jnp_dtype),
     }
+
+
+def slice_cache_slots(cache: KVCache, slot, n: int = 1) -> KVCache:
+    """Sub-cache for slots [slot, slot+n) — slot axis is dim 1 on every
+    leaf (value tensors are rank-5, scale tensors rank-4)."""
+    out = {}
+    for key, arr in cache.items():
+        starts = (0, slot) + (0,) * (arr.ndim - 2)
+        sizes = (arr.shape[0], n) + arr.shape[2:]
+        out[key] = jax.lax.dynamic_slice(arr, starts, sizes)
+    return out
+
+
+def update_cache_slots(cache: KVCache, sub: KVCache, slot) -> KVCache:
+    """Write a sub-cache back at ``slot`` (inverse of slice_cache_slots)."""
+    return {
+        key: jax.lax.dynamic_update_slice(
+            arr, sub[key], (0, slot) + (0,) * (arr.ndim - 2)
+        )
+        for key, arr in cache.items()
+    }
+
+
+def _quantize_kv_block(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """[B,KVH,T,D] -> (int8 values, f32 per-position scales [B,KVH,T])."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(
+        jnp.round(x.astype(jnp.float32) / scale[..., None]), -127, 127
+    ).astype(jnp.int8)
+    return q, scale
 
 
 
@@ -251,6 +304,7 @@ def forward(
         #    touches only [B, KVH, T, D] elements — extracting a layer,
         #    patching it, and writing the whole layer back rewrites the full
         #    layer per step instead.
+        quantized_kv = "k_s" in kv_cache  # static: selects the int8 path
         s = kv_cache["k"].shape[3]
         kj = jnp.arange(s)[None, None, :]
         mask = (kj <= positions[:, :, None])[:, None, :, :]      # [B, 1, T, S]
@@ -258,26 +312,51 @@ def forward(
         h_idx = jnp.arange(cfg.n_kv_heads)[None, :, None]        # [1, KVH, 1]
         t_idx = cache_offsets[:, None, None] + jnp.arange(T)[None, None, :]  # [B, 1, T]
 
+        def _read_layer(cache, name, lidx):
+            vals = jax.lax.dynamic_index_in_dim(cache[name], lidx, axis=0, keepdims=False)
+            if quantized_kv:
+                sc = jax.lax.dynamic_index_in_dim(
+                    cache[name + "_s"], lidx, axis=0, keepdims=False
+                )
+                # dequantize on read: halves the HBM stream vs bf16 and the
+                # multiply fuses into the attention matmul's prologue
+                return vals.astype(dt) * sc.astype(dt)[..., None]
+            return vals.astype(dt)
+
         def scan_body(carry, layer_xs):
-            y0, ck, cv = carry
+            y0, cache = carry
             p, lidx = layer_xs
             h = rms_norm(y0, p["attn_norm"], cfg.rms_eps)
             q, k, v = qkv_proj(p, cfg, h, positions, cos, sin)
-            ck = ck.at[lidx, b_idx, h_idx, t_idx].set(k.astype(ck.dtype))
-            cv = cv.at[lidx, b_idx, h_idx, t_idx].set(v.astype(cv.dtype))
+            cache = dict(cache)
+            if quantized_kv:
+                kq, ks = _quantize_kv_block(k)
+                vq, vs = _quantize_kv_block(v)
+                cache["k"] = cache["k"].at[lidx, b_idx, h_idx, t_idx].set(kq)
+                cache["v"] = cache["v"].at[lidx, b_idx, h_idx, t_idx].set(vq)
+                idx_s = (lidx, b_idx, h_idx, t_idx)
+                cache["k_s"] = cache["k_s"].at[idx_s].set(ks)
+                cache["v_s"] = cache["v_s"].at[idx_s].set(vs)
+            else:
+                cache["k"] = cache["k"].at[lidx, b_idx, h_idx, t_idx].set(
+                    k.astype(cache["k"].dtype)
+                )
+                cache["v"] = cache["v"].at[lidx, b_idx, h_idx, t_idx].set(
+                    v.astype(cache["v"].dtype)
+                )
             if fresh_prefill:
                 from kserve_vllm_mini_tpu.ops.flash_attention import prefill_attention
 
                 o = prefill_attention(q, k, v)
             else:
-                k_layer = jax.lax.dynamic_index_in_dim(ck, lidx, axis=0, keepdims=False)
-                v_layer = jax.lax.dynamic_index_in_dim(cv, lidx, axis=0, keepdims=False)
-                o = attention(q, k_layer.astype(dt), v_layer.astype(dt), mask)
-            return (attn_out_and_mlp(p, cfg, y0, o), ck, cv), None
+                k_layer = _read_layer(cache, "k", lidx)
+                v_layer = _read_layer(cache, "v", lidx)
+                o = attention(q, k_layer, v_layer, mask)
+            return (attn_out_and_mlp(p, cfg, y0, o), cache), None
 
-        (x, new_k, new_v), _ = jax.lax.scan(
+        (x, new_cache_dict), _ = jax.lax.scan(
             scan_body,
-            (x, kv_cache["k"], kv_cache["v"]),
+            (x, dict(kv_cache)),
             (layers, jnp.arange(cfg.n_layers)),
         )
     else:
@@ -285,7 +364,7 @@ def forward(
             return layer_forward(p, cfg, carry, positions, cos, sin, attention_fn), None
 
         x, _ = jax.lax.scan(scan_body_nocache, x, layers)
-        new_k = new_v = None
+        new_cache_dict = None
 
     if logit_index is not None:
         x = x[jnp.arange(B)[:, None], logit_index[:, None]]  # [B, 1, D]
@@ -293,5 +372,4 @@ def forward(
     head = params["embed"] if cfg.tie_embeddings else params["lm_head"]
     logits = (x @ head.T).astype(jnp.float32)
 
-    new_cache = {"k": new_k, "v": new_v} if use_cache else None
-    return logits, new_cache
+    return logits, new_cache_dict

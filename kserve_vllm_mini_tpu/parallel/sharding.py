@@ -110,12 +110,19 @@ def token_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(_axis(mesh, "dp"), None))
 
 
-def kv_cache_shardings(cfg: ModelConfig, mesh: Mesh) -> dict[str, NamedSharding]:
+def kv_cache_shardings(
+    cfg: ModelConfig, mesh: Mesh, quantized: bool = False
+) -> dict[str, NamedSharding]:
     tp, dp, pp = _axis(mesh, "tp"), _axis(mesh, "dp"), _axis(mesh, "pp")
     kv_tp = tp if tp and cfg.n_kv_heads % mesh.shape["tp"] == 0 else None
     spec = P(pp, dp, kv_tp, None, None)  # [L, B, KVH, S, D]
     s = NamedSharding(mesh, spec)
-    return {"k": s, "v": s}
+    out = {"k": s, "v": s}
+    if quantized:
+        # int8-KV scales: same layout minus the head_dim axis
+        s4 = NamedSharding(mesh, P(pp, dp, kv_tp, None))  # [L, B, KVH, S]
+        out["k_s"] = out["v_s"] = s4
+    return out
 
 
 def logits_sharding(mesh: Mesh) -> NamedSharding:

@@ -133,18 +133,25 @@ class Engine:
         self.pad_id = pad_id
         self.params = params
 
+        from kserve_vllm_mini_tpu.models.llama import init_kv_cache
+
         S = self.ecfg.max_slots
-        L = cfg.n_layers
-        shape = (L, S, cfg.n_kv_heads, self.ecfg.max_seq_len, cfg.head_dim)
-        kv_dt = jnp.dtype(self.ecfg.kv_cache_dtype) if self.ecfg.kv_cache_dtype else cfg.jnp_dtype
-        self._cache_k = jnp.zeros(shape, dtype=kv_dt)
-        self._cache_v = jnp.zeros(shape, dtype=kv_dt)
+        kv_quant = self.ecfg.kv_cache_dtype == "int8"
+        kv_dt = (
+            jnp.dtype(self.ecfg.kv_cache_dtype)
+            if (self.ecfg.kv_cache_dtype and not kv_quant)
+            else None
+        )
+        self._cache = init_kv_cache(
+            cfg, S, max_seq=self.ecfg.max_seq_len, dtype=kv_dt, quantized=kv_quant
+        )
         if mesh is not None:
             from kserve_vllm_mini_tpu.parallel.sharding import kv_cache_shardings
 
-            sh = kv_cache_shardings(cfg, mesh)
-            self._cache_k = jax.device_put(self._cache_k, sh["k"])
-            self._cache_v = jax.device_put(self._cache_v, sh["v"])
+            sh = kv_cache_shardings(cfg, mesh, quantized=kv_quant)
+            self._cache = {
+                key: jax.device_put(arr, sh[key]) for key, arr in self._cache.items()
+            }
 
         # speculative decoding: the drafter keeps its own KV cache with the
         # same slot/seq geometry so slot bookkeeping is shared
@@ -152,12 +159,10 @@ class Engine:
         self._drafter_cfg: Optional[ModelConfig] = None
         if drafter is not None:
             self._drafter_params, self._drafter_cfg = drafter
-            dcfg = self._drafter_cfg
-            dshape = (dcfg.n_layers, S, dcfg.n_kv_heads,
-                      self.ecfg.max_seq_len, dcfg.head_dim)
-            d_dt = jnp.dtype(self.ecfg.kv_cache_dtype) if self.ecfg.kv_cache_dtype else dcfg.jnp_dtype
-            self._dcache_k = jnp.zeros(dshape, dtype=d_dt)
-            self._dcache_v = jnp.zeros(dshape, dtype=d_dt)
+            self._dcache = init_kv_cache(
+                self._drafter_cfg, S, max_seq=self.ecfg.max_seq_len,
+                dtype=kv_dt, quantized=kv_quant,
+            )
         self._spec_fn = None
 
         # host-side slot state
@@ -209,25 +214,26 @@ class Engine:
             return self._prefill_fns[key]
         cfg = self._drafter_cfg if draft else self.cfg
 
-        @partial(jax.jit, donate_argnums=(1, 2), static_argnums=())
-        def prefill(params, cache_k, cache_v, tokens, length, slot):
+        @partial(jax.jit, donate_argnums=(1,), static_argnums=())
+        def prefill(params, cache, tokens, length, slot):
             # tokens: [1, bucket]; length: scalar; slot: scalar
-            L, S, KVH, MS, D = cache_k.shape
+            from kserve_vllm_mini_tpu.models.llama import (
+                slice_cache_slots,
+                update_cache_slots,
+            )
+
             pos = jnp.arange(tokens.shape[1], dtype=jnp.int32)[None]
-            sub_k = jax.lax.dynamic_slice(cache_k, (0, slot, 0, 0, 0), (L, 1, KVH, MS, D))
-            sub_v = jax.lax.dynamic_slice(cache_v, (0, slot, 0, 0, 0), (L, 1, KVH, MS, D))
+            sub = slice_cache_slots(cache, slot)
             # logit_index: only the prompt's last position is sampled — a
             # full [1, bucket, V] f32 logits tensor is ~2 GB at 128k vocab
             # for the server-default 4096 bucket, on the per-request path
-            logits, new_cache = forward(
+            logits, new_sub = forward(
                 params, cfg, tokens, pos,
-                {"k": sub_k, "v": sub_v}, jnp.zeros((1,), jnp.int32),
+                sub, jnp.zeros((1,), jnp.int32),
                 fresh_prefill=True,
                 logit_index=(length - 1)[None],
             )
-            cache_k = jax.lax.dynamic_update_slice(cache_k, new_cache["k"], (0, slot, 0, 0, 0))
-            cache_v = jax.lax.dynamic_update_slice(cache_v, new_cache["v"], (0, slot, 0, 0, 0))
-            return cache_k, cache_v, logits[0, 0]  # [V] f32
+            return update_cache_slots(cache, new_sub, slot), logits[0, 0]  # [V] f32
 
         self._prefill_fns[key] = prefill
         return prefill
@@ -246,23 +252,23 @@ class Engine:
             return fn
         cfg = self.cfg
 
-        @partial(jax.jit, donate_argnums=(1, 2))
-        def decode(params, cache_k, cache_v, tokens, lengths, temps, topks, topps, rng):
+        @partial(jax.jit, donate_argnums=(1,))
+        def decode(params, cache, tokens, lengths, temps, topks, topps, rng):
             def body(carry, _):
-                ck, cv, toks, lens, r = carry
+                c, toks, lens, r = carry
                 r, sub = jax.random.split(r)
                 logits, nc = forward(
-                    params, cfg, toks[:, None], lens[:, None], {"k": ck, "v": cv}, lens
+                    params, cfg, toks[:, None], lens[:, None], c, lens
                 )
                 lg = logits[:, 0, :]
                 nxt = sample_tokens(lg, sub, temps, topks, topps)
                 lp, tids, tlps = token_logprobs(lg, nxt)
-                return (nc["k"], nc["v"], nxt, lens + 1, r), (nxt, lp, tids, tlps)
+                return (nc, nxt, lens + 1, r), (nxt, lp, tids, tlps)
 
-            (ck, cv, _, _, _), ys = jax.lax.scan(
-                body, (cache_k, cache_v, tokens, lengths, rng), None, length=n_steps
+            (c, _, _, _), ys = jax.lax.scan(
+                body, (cache, tokens, lengths, rng), None, length=n_steps
             )
-            return ck, cv, ys  # ys: ([n,S], [n,S], [n,S,K], [n,S,K])
+            return c, ys  # ys: ([n,S], [n,S], [n,S,K], [n,S,K])
 
         self._decode_fns[n_steps] = decode
         return decode
@@ -280,12 +286,11 @@ class Engine:
         cfg = self.cfg
         span = self._byte_span
 
-        @partial(jax.jit, donate_argnums=(1, 2))
-        def decode_masked(params, cache_k, cache_v, tokens, lengths,
+        @partial(jax.jit, donate_argnums=(1,))
+        def decode_masked(params, cache, tokens, lengths,
                           temps, topks, topps, rng, mask, use_mask):
             logits, nc = forward(
-                params, cfg, tokens[:, None], lengths[:, None],
-                {"k": cache_k, "v": cache_v}, lengths,
+                params, cfg, tokens[:, None], lengths[:, None], cache, lengths
             )
             lg = logits[:, 0, :]
             lg_masked = jnp.concatenate(
@@ -298,7 +303,7 @@ class Engine:
             lg = jnp.where(use_mask[:, None], lg_masked, lg)
             nxt = sample_tokens(lg, rng, temps, topks, topps)
             lp, tids, tlps = token_logprobs(lg, nxt)
-            return nc["k"], nc["v"], (nxt[None], lp[None], tids[None], tlps[None])
+            return nc, (nxt[None], lp[None], tids[None], tlps[None])
 
         self._decode_fns["masked"] = decode_masked
         return decode_masked
@@ -314,27 +319,26 @@ class Engine:
         cfg_t, cfg_d = self.cfg, self._drafter_cfg
         k = self.ecfg.spec_tokens
 
-        @partial(jax.jit, donate_argnums=(1, 2, 4, 5))
-        def spec_step(params_t, ck_t, cv_t, params_d, ck_d, cv_d, last, lengths):
+        @partial(jax.jit, donate_argnums=(1, 3))
+        def spec_step(params_t, cache_t, params_d, cache_d, last, lengths):
             # drafter: k autoregressive proposals d1..dk
             def dbody(carry, _):
-                ck, cv, tok, lens = carry
+                c, tok, lens = carry
                 logits, nc = forward(
-                    params_d, cfg_d, tok[:, None], lens[:, None],
-                    {"k": ck, "v": cv}, lens,
+                    params_d, cfg_d, tok[:, None], lens[:, None], c, lens
                 )
                 nxt = jnp.argmax(logits[:, 0, :], axis=-1).astype(jnp.int32)
-                return (nc["k"], nc["v"], nxt, lens + 1), nxt
+                return (nc, nxt, lens + 1), nxt
 
-            (ck_d, cv_d, _, _), drafts = jax.lax.scan(
-                dbody, (ck_d, cv_d, last, lengths), None, length=k
+            (cache_d, _, _), drafts = jax.lax.scan(
+                dbody, (cache_d, last, lengths), None, length=k
             )
             drafts = drafts.T                                   # [S, k]
             # target verifies [last, d1..d_{k-1}] in one forward
             fed = jnp.concatenate([last[:, None], drafts[:, :-1]], axis=1)
             pos = lengths[:, None] + jnp.arange(k, dtype=jnp.int32)[None, :]
             logits, nc_t = forward(
-                params_t, cfg_t, fed, pos, {"k": ck_t, "v": cv_t}, lengths
+                params_t, cfg_t, fed, pos, cache_t, lengths
             )
             preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [S, k]
             # accepted draft count a in 0..k-1: longest prefix where the
@@ -352,7 +356,7 @@ class Engine:
                 j < a[:, None], drafts,
                 jnp.where(j == a[:, None], bonus[:, None], -1),
             )
-            return nc_t["k"], nc_t["v"], ck_d, cv_d, emit
+            return nc_t, cache_d, emit
 
         self._spec_fn = spec_step
         return spec_step
@@ -445,9 +449,8 @@ class Engine:
         tokens = jnp.asarray(toks, dtype=jnp.int32)[None]
         prefill = self._get_prefill_fn(bucket)
         t0 = time.time()
-        self._cache_k, self._cache_v, last_logits = prefill(
-            self.params, self._cache_k, self._cache_v, tokens,
-            jnp.int32(n), jnp.int32(slot),
+        self._cache, last_logits = prefill(
+            self.params, self._cache, tokens, jnp.int32(n), jnp.int32(slot),
         )
         # first token: sampled from the prompt's last-position logits,
         # grammar-masked when the request is constrained
@@ -474,8 +477,8 @@ class Engine:
             # drafter prefills the same prompt into its own cache so it can
             # propose from full context; its output logits are unused
             dprefill = self._get_prefill_fn(bucket, draft=True)
-            self._dcache_k, self._dcache_v, _ = dprefill(
-                self._drafter_params, self._dcache_k, self._dcache_v, tokens,
+            self._dcache, _ = dprefill(
+                self._drafter_params, self._dcache, tokens,
                 jnp.int32(n), jnp.int32(slot),
             )
         self.stats["busy_s"] += time.time() - t0
@@ -604,10 +607,9 @@ class Engine:
         tokens = jnp.asarray(self._last_tokens, dtype=jnp.int32)
         lengths = jnp.asarray(self._slot_len, dtype=jnp.int32)
         t0 = time.time()
-        (self._cache_k, self._cache_v, self._dcache_k, self._dcache_v,
-         emit) = spec(
-            self.params, self._cache_k, self._cache_v,
-            self._drafter_params, self._dcache_k, self._dcache_v,
+        self._cache, self._dcache, emit = spec(
+            self.params, self._cache,
+            self._drafter_params, self._dcache,
             tokens, lengths,
         )
         # one transfer for the whole [S, k] block (same rationale as decode)
@@ -669,15 +671,15 @@ class Engine:
             use_mask = np.zeros((S,), dtype=bool)
             use_mask[constrained] = True
             decode = self._get_masked_decode_fn()
-            self._cache_k, self._cache_v, ys = decode(
-                self.params, self._cache_k, self._cache_v,
+            self._cache, ys = decode(
+                self.params, self._cache,
                 tokens, lengths, temps, topks, topps, sub,
                 jnp.asarray(mask), jnp.asarray(use_mask),
             )
         else:
             decode = self._get_decode_fn(chunk)
-            self._cache_k, self._cache_v, ys = decode(
-                self.params, self._cache_k, self._cache_v,
+            self._cache, ys = decode(
+                self.params, self._cache,
                 tokens, lengths, temps, topks, topps, sub,
             )
         # ONE host transfer for the whole chunk block — per-element

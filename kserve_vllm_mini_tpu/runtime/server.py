@@ -60,12 +60,10 @@ def build_engine(
         # profile sentinel for "model default" (profiles/quantization/*.yaml
         # mirror the reference's 'auto'); the deploy layer drops it too
         kv_cache_dtype = None
-    if kv_cache_dtype not in (None, "bfloat16", "float32", "float16"):
-        # integer KV dtypes would silently truncate activations to zero in
-        # the cache write — reject until int8-KV lands with proper scales
+    if kv_cache_dtype not in (None, "bfloat16", "float32", "float16", "int8"):
         raise ValueError(
             f"unsupported kv_cache_dtype {kv_cache_dtype!r}; "
-            "known: auto, bfloat16, float32, float16"
+            "known: auto, bfloat16, float32, float16, int8 (scaled)"
         )
 
     mesh = None
@@ -178,10 +176,16 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str):
         )
         from kserve_vllm_mini_tpu.runtime.tokenizer import ByteTokenizer
 
+        import re
+
         tools = body.get("tools") or []
         tool_choice = body.get("tool_choice", "auto" if tools else "none")
         wants_tools = bool(tools) and tool_choice != "none"
         rf = (body.get("response_format") or {}).get("type")
+        if rf not in (None, "text", "json_object"):
+            # e.g. json_schema: unsupported — reject rather than return
+            # unconstrained output under a structured-output contract
+            return None, False, f"response_format type {rf!r} is not supported"
         wants_json = rf == "json_object"
         if not (wants_tools or wants_json):
             return None, False, None
@@ -196,6 +200,12 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str):
                 for t in tools if t.get("type") == "function"
             ]
             names = [n for n in names if n]
+            bad = [n for n in names if not re.fullmatch(r"[a-zA-Z0-9_-]{1,64}", n)]
+            if bad:
+                # names are interpolated into the byte-template grammar; a
+                # quote or backslash would break the emitted JSON (OpenAI
+                # enforces this same charset)
+                return None, False, f"invalid tool name(s): {bad!r}"
             if isinstance(tool_choice, dict):  # {"type":"function","function":{"name":...}}
                 forced = tool_choice.get("function", {}).get("name")
                 if forced not in names:
@@ -255,7 +265,12 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str):
         if err:
             return web.json_response({"error": {"message": err}}, status=400)
         want_logprobs = bool(body.get("logprobs", False))
-        top_lp = min(int(body.get("top_logprobs", 0) or 0), 5)
+        top_lp = int(body.get("top_logprobs", 0) or 0)
+        if top_lp < 0:
+            return web.json_response(
+                {"error": {"message": "top_logprobs must be >= 0"}}, status=400
+            )
+        top_lp = min(top_lp, 5)
         prompt = _messages_to_prompt(messages)
         prompt_ids = tok.encode(prompt)
         req = GenRequest(
@@ -291,6 +306,13 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str):
                     info = rest[0]
                     break
             text = tok.decode(out_ids)
+            if info.get("finish_reason") == "error":
+                # e.g. the constrained grammar cannot close inside the KV
+                # window — surface the engine's message, don't 200 it away
+                return web.json_response(
+                    {"error": {"message": info.get("error", "engine error")}},
+                    status=400,
+                )
             message: dict[str, Any] = {"role": "assistant", "content": text}
             finish = info.get("finish_reason", "stop")
             if wants_tools:
@@ -326,6 +348,16 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str):
                 }
             )
 
+        # peek the first event before committing to an SSE response: a
+        # submit-time rejection (immediate error 'done') must be a 400,
+        # which is impossible once stream headers have gone out
+        first_event = await next_event()
+        if first_event[0] == "done" and first_event[1].get("finish_reason") == "error":
+            return web.json_response(
+                {"error": {"message": first_event[1].get("error", "engine error")}},
+                status=400,
+            )
+
         resp = web.StreamResponse(
             status=200,
             headers={"Content-Type": "text/event-stream", "Cache-Control": "no-cache"},
@@ -334,9 +366,14 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str):
         n_out = 0
         sent_first = False
         tool_ids: list[int] = []
+        pending_event: Optional[tuple] = tuple(first_event)
         try:
             while True:
-                kind, *rest = await next_event()
+                if pending_event is not None:
+                    kind, *rest = pending_event
+                    pending_event = None
+                else:
+                    kind, *rest = await next_event()
                 if kind == "token":
                     n_out += 1
                     if wants_tools:
@@ -470,6 +507,11 @@ def register(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--topology", default=None,
                         help="Mesh topology preset (e.g. v5e-8); default single-device")
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--quantization", default="none", choices=["none", "int8"],
+                        help="Weight quantization (int8 = W8A16 per-channel)")
+    parser.add_argument("--kv-cache-dtype", default=None,
+                        help="KV cache dtype: bfloat16/float32/float16/int8 "
+                             "(int8 = scaled per-position) or 'auto'")
     parser.add_argument("--decode-chunk", type=int, default=1,
                         help="Decode steps fused per dispatch (throughput vs "
                              "streaming granularity)")
@@ -500,6 +542,8 @@ def run(args: argparse.Namespace) -> int:
         max_seq_len=args.max_seq_len,
         topology=args.topology,
         seed=args.seed,
+        quantization=args.quantization,
+        kv_cache_dtype=args.kv_cache_dtype,
         drafter=drafter,
         spec_tokens=spec_tokens,
     )

@@ -22,7 +22,7 @@ from kserve_vllm_mini_tpu.sweeps import base
 
 DEFAULT_SPACE: dict[str, list[Any]] = {
     "quantization": ["none", "int8"],
-    "kv_cache_dtype": ["model", "float32"],
+    "kv_cache_dtype": ["model", "int8"],   # int8 = scaled int8-KV cache
     "decoding": ["greedy", "sampled"],
 }
 

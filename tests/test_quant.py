@@ -144,3 +144,46 @@ def test_shard_quantized_params():
     positions = jnp.broadcast_to(jnp.arange(8), (1, 8)).astype(jnp.int32)
     logits, _ = forward(sharded, cfg, tokens, positions)
     assert np.all(np.isfinite(np.asarray(logits)))
+
+
+def test_int8_kv_cache_forward_close_to_bf16():
+    """Scaled int8 KV: cached decode logits must track the bf16-cache path
+    (per-position amax scales bound the relative rounding error)."""
+    from kserve_vllm_mini_tpu.models.llama import init_kv_cache
+
+    cfg = get_config("llama-tiny", max_seq_len=64)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 12), 0, cfg.vocab_size)
+    positions = jnp.broadcast_to(jnp.arange(12), (2, 12)).astype(jnp.int32)
+    offs = jnp.zeros((2,), jnp.int32)
+
+    cache_bf = init_kv_cache(cfg, 2, max_seq=64)
+    cache_q = init_kv_cache(cfg, 2, max_seq=64, quantized=True)
+    assert cache_q["k"].dtype == jnp.int8 and cache_q["k_s"].dtype == jnp.float32
+
+    lb, cache_bf = forward(params, cfg, tokens, positions, cache_bf, offs)
+    lq, cache_q = forward(params, cfg, tokens, positions, cache_q, offs)
+    agree = float(jnp.mean((jnp.argmax(lb, -1) == jnp.argmax(lq, -1)).astype(jnp.float32)))
+    assert agree >= 0.9, f"prefill top-1 agreement {agree}"
+
+    # decode one step against each cache
+    nxt = jnp.argmax(lb[:, -1, :], axis=-1).astype(jnp.int32)[:, None]
+    pos1 = jnp.full((2, 1), 12, dtype=jnp.int32)
+    db, _ = forward(params, cfg, nxt, pos1, cache_bf, jnp.full((2,), 12, jnp.int32))
+    dq, _ = forward(params, cfg, nxt, pos1, cache_q, jnp.full((2,), 12, jnp.int32))
+    # distributions must be close in the bulk
+    pb = jax.nn.softmax(db[:, 0], -1)
+    pq = jax.nn.softmax(dq[:, 0], -1)
+    tv = float(0.5 * jnp.sum(jnp.abs(pb - pq), axis=-1).max())
+    assert tv < 0.15, f"total-variation distance {tv}"
+
+
+def test_int8_kv_cache_memory_halves():
+    from kserve_vllm_mini_tpu.models.llama import init_kv_cache
+
+    cfg = get_config("llama-tiny", max_seq_len=64)
+    bf = init_kv_cache(cfg, 4, max_seq=64)
+    q = init_kv_cache(cfg, 4, max_seq=64, quantized=True)
+    bf_bytes = sum(a.size * a.dtype.itemsize for a in bf.values())
+    q_bytes = sum(a.size * a.dtype.itemsize for a in q.values())
+    assert q_bytes < 0.6 * bf_bytes

@@ -522,3 +522,59 @@ def test_constrained_impossible_budget_fails_fast(params):
         assert "constrained format" in info["error"]
     finally:
         eng.stop()
+
+
+def test_engine_int8_kv_decodes_sanely(params):
+    """Engine with the scaled int8 KV cache: greedy decode must track the
+    bf16-cache engine closely (exactness is not expected — the cache is
+    lossy — but early tokens should agree and output must be in-vocab)."""
+    eng_bf = make_engine(params)
+    eng_q = Engine(
+        params, CFG,
+        EngineConfig(max_slots=4, max_seq_len=128, max_prefill_len=64,
+                     min_prefill_bucket=16, kv_cache_dtype="int8"),
+    )
+    eng_q.start()
+    try:
+        prompt = [5, 9, 42, 7]
+        hb = eng_bf.submit(GenRequest(prompt_tokens=prompt, max_new_tokens=8))
+        hq = eng_q.submit(GenRequest(prompt_tokens=prompt, max_new_tokens=8))
+        tb, _ = _drain(hb)
+        tq, _ = _drain(hq)
+        assert len(tq) == 8
+        assert all(0 <= t < CFG.vocab_size for t in tq)
+        agree = sum(a == b for a, b in zip(tb, tq)) / 8
+        assert agree >= 0.5, f"int8-kv agreement {agree} ({tb} vs {tq})"
+    finally:
+        eng_bf.stop()
+        eng_q.stop()
+
+
+def test_every_quantization_profile_boots():
+    """Every file in profiles/quantization/ must execute against the own
+    runtime (round-2 verdict: int8-kv was rejected, fp8 was config-ahead-
+    of-implementation; fp8 is now deleted rather than advertised)."""
+    from pathlib import Path
+
+    import yaml
+
+    from kserve_vllm_mini_tpu.runtime.server import build_engine
+
+    profiles = sorted(Path("profiles/quantization").glob("*.yaml"))
+    assert profiles, "no quantization profiles found"
+    for pf in profiles:
+        knobs = yaml.safe_load(pf.read_text())
+        engine, tok, _ = build_engine(
+            model="llama-tiny", max_slots=2, max_seq_len=128,
+            quantization=str(knobs.get("quantization", "none"))
+            .replace("bf16", "none"),
+            kv_cache_dtype=knobs.get("kv_cache_dtype"),
+        )
+        engine.start()
+        try:
+            h = engine.submit(GenRequest(prompt_tokens=tok.encode("hi"),
+                                         max_new_tokens=4))
+            tokens, info = _drain(h)
+            assert len(tokens) == 4, pf.name
+        finally:
+            engine.stop()
