@@ -202,6 +202,102 @@ def main() -> int:
     flops_step = 2.0 * cfg.param_count * slots
     mfu = (flops_step / (dt / n_timed)) / (V5E_BF16_TFLOPS * 1e12) if on_tpu else 0.0
 
+    # -- speculative decoding measurement (KVMINI_BENCH_SPEC=k) -------------
+    # Reference claim: 20-40% decode improvement at real acceptance rates
+    # (README.md:118). With random weights a small drafter accepts ~0 (its
+    # argmax and the target's agree at chance), so KVMINI_BENCH_DRAFTER=self
+    # (default) measures the accept=1 UPPER BOUND of the fused spec path and
+    # a named preset (e.g. llama-1b) measures the accept~0 overhead floor —
+    # the two brackets real-checkpoint behavior, and accept_ratio is
+    # reported so the bracket is explicit.
+    spec_detail = None
+    spec_k = int(os.environ.get("KVMINI_BENCH_SPEC", "0"))
+    if spec_k > 0:
+        from kserve_vllm_mini_tpu.runtime.engine import build_spec_step
+
+        drafter = os.environ.get("KVMINI_BENCH_DRAFTER", "self")
+        _log(f"spec mode: drafter={drafter} k={spec_k}")
+        if drafter == "self":
+            dcfg, dparams = cfg, params
+        else:
+            dcfg = get_config(drafter, max_seq_len=max_seq)
+            if dcfg.vocab_size != cfg.vocab_size:
+                dcfg = dcfg.scaled(vocab_size=cfg.vocab_size)
+            dparams = (
+                init_params_quantized if quant == "int8" else init_params
+            )(jax.random.PRNGKey(3), dcfg)
+
+        t_cache, last = prefill_batch(
+            params, init_kv_cache(cfg, slots, max_seq=max_seq, quantized=kv_quant),
+            toks, pos,
+        )
+
+        @partial(jax.jit, donate_argnums=(1,))
+        def dprefill(p, c, t, pp):
+            _, c2 = forward(p, dcfg, t, pp, c, jnp.zeros((slots,), jnp.int32),
+                            fresh_prefill=True,
+                            logit_index=jnp.full((slots,), prompt_len - 1, jnp.int32))
+            return c2
+
+        d_cache = dprefill(
+            dparams, init_kv_cache(dcfg, slots, max_seq=max_seq, quantized=kv_quant),
+            toks, pos,
+        )
+        spec = build_spec_step(cfg, dcfg, spec_k)
+        lengths_h = np.full((slots,), prompt_len, dtype=np.int64)
+
+        def spec_rounds(n, t_cache, d_cache, last, lengths_h):
+            emitted = accepted = 0
+            for _ in range(n):
+                t_cache, d_cache, emit = spec(
+                    params, t_cache, dparams, d_cache,
+                    last, jnp.asarray(lengths_h, jnp.int32),
+                )
+                eh = np.asarray(jax.device_get(emit))   # sync point
+                cnt = (eh >= 0).sum(axis=1)
+                emitted += int(cnt.sum())
+                accepted += int(np.maximum(cnt - 1, 0).sum())
+                idx = np.clip(cnt - 1, 0, spec_k - 1)
+                last = jnp.asarray(eh[np.arange(slots), idx].astype(np.int32))
+                lengths_h = lengths_h + cnt
+            return t_cache, d_cache, last, lengths_h, emitted, accepted
+
+        max_rounds = max((max_seq - 1 - prompt_len - 8) // spec_k, 8)
+        n_warm, n_meas = 3, min(24, max_rounds - 3)
+        t_cache, d_cache, last, lengths_h, _, _ = spec_rounds(
+            n_warm, t_cache, d_cache, last, lengths_h
+        )
+        _log("spec warmup done; timing")
+        t0 = time.time()
+        t_cache, d_cache, last, lengths_h, emitted, accepted = spec_rounds(
+            n_meas, t_cache, d_cache, last, lengths_h
+        )
+        dt_spec = max(time.time() - t0, 1e-9)
+        spec_tps = emitted / dt_spec
+        proposed = n_meas * (spec_k - 1) * slots
+        t_round = dt_spec / n_meas
+        t_step = dt / n_timed
+        # speedup is a function of the acceptance rate α: a round costs
+        # t_round and emits (k-1)α + 1 tokens/slot vs 1 per t_step plain.
+        # α itself needs real checkpoints (random-weight drafters accept at
+        # chance), so report the measured α plus the projection at α=0.7 —
+        # the reference's own stated threshold for its 20-40% claim.
+        def speedup_at(alpha: float) -> float:
+            return ((spec_k - 1) * alpha + 1) * t_step / t_round
+
+        spec_detail = {
+            "drafter": drafter,
+            "spec_tokens": spec_k,
+            "accept_ratio": round(accepted / proposed, 4) if proposed else 1.0,
+            "tokens_per_sec_per_chip": round(spec_tps / n_chips, 1),
+            "speedup_vs_plain_measured": round(spec_tps / toks_per_sec, 3),
+            "round_ms": round(t_round * 1000.0, 3),
+            "plain_step_ms": round(t_step * 1000.0, 3),
+            "projected_speedup_at_accept_0.7": round(speedup_at(0.7), 3),
+            "projected_speedup_at_accept_1.0": round(speedup_at(1.0), 3),
+        }
+        _log(f"spec: {spec_detail}")
+
     baseline = 2000.0  # north-star output tokens/sec/chip
     result = {
         "metric": (
@@ -227,6 +323,8 @@ def main() -> int:
             "device": str(jax.devices()[0]),
         },
     }
+    if spec_detail is not None:
+        result["detail"]["speculative"] = spec_detail
     print(json.dumps(result))
     return 0
 

@@ -49,6 +49,7 @@ _SUBCOMMANDS: dict[str, tuple[str, str]] = {
     "matrix": ("kserve_vllm_mini_tpu.matrix.runner", "GA-hardening reference matrix run"),
     "compile-sweep": ("kserve_vllm_mini_tpu.sweeps.compile_perf", "AOT compile-time vs serving-perf tradeoff"),
     "chaos": ("kserve_vllm_mini_tpu.chaos.harness", "Fault injection + MTTR measurement"),
+    "profile": ("kserve_vllm_mini_tpu.runtime.profiler", "Capture a TensorBoard trace of a live runtime"),
 }
 
 

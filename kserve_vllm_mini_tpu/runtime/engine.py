@@ -43,6 +43,59 @@ from kserve_vllm_mini_tpu.runtime.sampling import sample_tokens, token_logprobs
 BYTE_SPAN = 259
 
 
+def build_spec_step(cfg_t: ModelConfig, cfg_d: ModelConfig, k: int):
+    """Jitted fused speculative round, shared by the serving engine and
+    bench.py's drafter measurement: drafter proposes k tokens (scan), the
+    target verifies all of them in ONE T=k forward, and acceptance/bonus
+    selection happens on-device. Greedy exact-match acceptance ⇒ emitted
+    tokens are bit-identical to plain greedy decode of the target.
+
+    Returns ``(new_cache_t, new_cache_d, emit)`` where ``emit[s, j]`` is
+    draft j while accepted, the target's bonus token at the first mismatch,
+    and -1 after (the host emits the >=0 prefix)."""
+
+    @partial(jax.jit, donate_argnums=(1, 3))
+    def spec_step(params_t, cache_t, params_d, cache_d, last, lengths):
+        # drafter: k autoregressive proposals d1..dk
+        def dbody(carry, _):
+            c, tok, lens = carry
+            logits, nc = forward(
+                params_d, cfg_d, tok[:, None], lens[:, None], c, lens
+            )
+            nxt = jnp.argmax(logits[:, 0, :], axis=-1).astype(jnp.int32)
+            return (nc, nxt, lens + 1), nxt
+
+        (cache_d, _, _), drafts = jax.lax.scan(
+            dbody, (cache_d, last, lengths), None, length=k
+        )
+        drafts = drafts.T                                   # [S, k]
+        # target verifies [last, d1..d_{k-1}] in one forward
+        fed = jnp.concatenate([last[:, None], drafts[:, :-1]], axis=1)
+        pos = lengths[:, None] + jnp.arange(k, dtype=jnp.int32)[None, :]
+        logits, nc_t = forward(
+            params_t, cfg_t, fed, pos, cache_t, lengths
+        )
+        preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [S, k]
+        # accepted draft count a in 0..k-1: longest prefix where the
+        # target's argmax agrees with the draft
+        matches = preds[:, : k - 1] == drafts[:, : k - 1]
+        a = jnp.where(
+            jnp.all(matches, axis=1),
+            k - 1,
+            jnp.argmin(matches.astype(jnp.int32), axis=1),
+        ) if k > 1 else jnp.zeros(last.shape, jnp.int32)
+        bonus = jnp.take_along_axis(preds, a[:, None], axis=1)[:, 0]
+        # emit[s, j] = draft j while j < a, the bonus at j == a, -1 after
+        j = jnp.arange(k, dtype=jnp.int32)[None, :]
+        emit = jnp.where(
+            j < a[:, None], drafts,
+            jnp.where(j == a[:, None], bonus[:, None], -1),
+        )
+        return nc_t, cache_d, emit
+
+    return spec_step
+
+
 @dataclass
 class EngineConfig:
     max_slots: int = 8
@@ -309,57 +362,11 @@ class Engine:
         return decode_masked
 
     def _get_spec_fn(self):
-        """One fused dispatch per speculative round: drafter proposes k
-        tokens (scan), the target verifies all of them in a single T=k
-        forward, and acceptance/bonus selection happens on-device. Greedy
-        exact-match acceptance ⇒ emitted tokens are bit-identical to plain
-        greedy decode of the target."""
-        if self._spec_fn is not None:
-            return self._spec_fn
-        cfg_t, cfg_d = self.cfg, self._drafter_cfg
-        k = self.ecfg.spec_tokens
-
-        @partial(jax.jit, donate_argnums=(1, 3))
-        def spec_step(params_t, cache_t, params_d, cache_d, last, lengths):
-            # drafter: k autoregressive proposals d1..dk
-            def dbody(carry, _):
-                c, tok, lens = carry
-                logits, nc = forward(
-                    params_d, cfg_d, tok[:, None], lens[:, None], c, lens
-                )
-                nxt = jnp.argmax(logits[:, 0, :], axis=-1).astype(jnp.int32)
-                return (nc, nxt, lens + 1), nxt
-
-            (cache_d, _, _), drafts = jax.lax.scan(
-                dbody, (cache_d, last, lengths), None, length=k
+        if self._spec_fn is None:
+            self._spec_fn = build_spec_step(
+                self.cfg, self._drafter_cfg, self.ecfg.spec_tokens
             )
-            drafts = drafts.T                                   # [S, k]
-            # target verifies [last, d1..d_{k-1}] in one forward
-            fed = jnp.concatenate([last[:, None], drafts[:, :-1]], axis=1)
-            pos = lengths[:, None] + jnp.arange(k, dtype=jnp.int32)[None, :]
-            logits, nc_t = forward(
-                params_t, cfg_t, fed, pos, cache_t, lengths
-            )
-            preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [S, k]
-            # accepted draft count a in 0..k-1: longest prefix where the
-            # target's argmax agrees with the draft
-            matches = preds[:, : k - 1] == drafts[:, : k - 1]
-            a = jnp.where(
-                jnp.all(matches, axis=1),
-                k - 1,
-                jnp.argmin(matches.astype(jnp.int32), axis=1),
-            ) if k > 1 else jnp.zeros(last.shape, jnp.int32)
-            bonus = jnp.take_along_axis(preds, a[:, None], axis=1)[:, 0]
-            # emit[s, j] = draft j while j < a, the bonus at j == a, -1 after
-            j = jnp.arange(k, dtype=jnp.int32)[None, :]
-            emit = jnp.where(
-                j < a[:, None], drafts,
-                jnp.where(j == a[:, None], bonus[:, None], -1),
-            )
-            return nc_t, cache_d, emit
-
-        self._spec_fn = spec_step
-        return spec_step
+        return self._spec_fn
 
     # -- public API --------------------------------------------------------
 

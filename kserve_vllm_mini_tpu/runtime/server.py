@@ -21,8 +21,10 @@ from __future__ import annotations
 import argparse
 import asyncio
 import json
+import threading
 import time
 import uuid
+from pathlib import Path
 from typing import Any, Optional
 
 from kserve_vllm_mini_tpu.runtime.engine import Engine, EngineConfig, GenRequest
@@ -460,6 +462,66 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str):
     async def healthz(_request):
         return web.json_response({"status": "ok", "uptime_s": time.time() - started})
 
+    profile_lock = threading.Lock()
+    profile_root = Path("runs").resolve()
+
+    async def profile(request: "web.Request"):
+        """Capture a jax.profiler (TensorBoard) trace of the live engine —
+        the runtime-side profiling leg SURVEY.md §5.1 calls for; the
+        client-side OTLP tracer covers the other leg. POST {"seconds": N,
+        "out_dir": runs-relative path}; returns the trace directory. Point
+        TensorBoard's profile plugin at it to see the decode timeline."""
+        try:
+            body = await request.json()
+        except Exception:
+            body = {}
+        try:
+            seconds = float(body.get("seconds", 3.0))
+        except (TypeError, ValueError):
+            return web.json_response(
+                {"error": {"message": "'seconds' must be a number"}}, status=400
+            )
+        if not 0.1 <= seconds <= 60.0:
+            return web.json_response(
+                {"error": {"message": "'seconds' must be in [0.1, 60]"}}, status=400
+            )
+        # traces land under runs/ only: the write path must not be client-
+        # controlled (SECURITY.md input-handling stance)
+        sub = str(body.get("out_dir") or f"profile-{int(time.time())}")
+        out_path = (profile_root / sub).resolve()
+        if not out_path.is_relative_to(profile_root):
+            return web.json_response(
+                {"error": {"message": "'out_dir' must stay under runs/"}}, status=400
+            )
+        if not profile_lock.acquire(blocking=False):
+            return web.json_response(
+                {"error": {"message": "a profile capture is already running"}},
+                status=409,
+            )
+
+        def capture() -> None:
+            import jax
+
+            try:
+                jax.profiler.start_trace(str(out_path))
+                try:
+                    time.sleep(seconds)
+                finally:
+                    jax.profiler.stop_trace()
+            finally:
+                profile_lock.release()
+
+        loop = asyncio.get_running_loop()
+        try:
+            await loop.run_in_executor(None, capture)
+        except Exception as e:  # start_trace can fail on unwritable dirs
+            return web.json_response(
+                {"error": {"message": f"profile capture failed: {e}"}}, status=500
+            )
+        return web.json_response(
+            {"trace_dir": str(out_path), "seconds": seconds, "format": "tensorboard"}
+        )
+
     async def metrics(_request):
         s = engine.snapshot_stats()
         lines = [
@@ -491,6 +553,7 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str):
     app.router.add_get("/v1/models", models)
     app.router.add_get("/healthz", healthz)
     app.router.add_get("/metrics", metrics)
+    app.router.add_post("/profile", profile)
     return app
 
 

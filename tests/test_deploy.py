@@ -147,3 +147,49 @@ def test_preflight_local_jax():
 def test_check_severity():
     assert passed([Check("a", True, True), Check("b", False, False)])
     assert not passed([Check("a", False, True)])
+
+
+def test_harness_chart_renders_and_is_least_privilege():
+    """The in-cluster harness chart (reference charts/kvmini analog) must
+    render to valid manifests: Deployment + namespaced RBAC + PVC. Rendered
+    with a minimal {{ .Values.* }}/{{ .Release.* }} substituter so CI needs
+    no helm binary (the chart deliberately sticks to plain substitutions)."""
+    import re
+    from pathlib import Path
+
+    import yaml
+
+    chart = Path("charts/kvmini-tpu-harness")
+    values = yaml.safe_load((chart / "values.yaml").read_text())
+    ctx = {"Release": {"Name": "bench", "Namespace": "kvmini-tpu"}, "Values": values}
+
+    def resolve(expr: str) -> str:
+        node = ctx
+        for part in expr.strip().lstrip(".").split("."):
+            node = node[part]
+        return str(node)
+
+    docs = []
+    for tpl in sorted(chart.glob("templates/*.yaml")):
+        text = re.sub(r"\{\{\s*([^}]+?)\s*\}\}", lambda m: resolve(m.group(1)),
+                      tpl.read_text())
+        docs.extend(d for d in yaml.safe_load_all(text) if d)
+
+    kinds = {d["kind"] for d in docs}
+    assert {"Deployment", "ServiceAccount", "Role", "RoleBinding",
+            "PersistentVolumeClaim"} <= kinds
+
+    role = next(d for d in docs if d["kind"] == "Role")
+    verbs = {v for rule in role["rules"] for v in rule["verbs"]}
+    assert verbs <= {"get", "list", "watch"}, "harness RBAC must be read-only"
+    assert any("inferenceservices" in rule["resources"] for rule in role["rules"])
+
+    dep = next(d for d in docs if d["kind"] == "Deployment")
+    spec = dep["spec"]["template"]["spec"]
+    assert spec["serviceAccountName"] == values["serviceAccountName"]
+    ctr = spec["containers"][0]
+    assert ctr["securityContext"]["readOnlyRootFilesystem"] is True
+    assert any(m["mountPath"] == "/runs" for m in ctr["volumeMounts"])
+    pvc_names = {v.get("persistentVolumeClaim", {}).get("claimName")
+                 for v in spec["volumes"]}
+    assert "bench-runs" in pvc_names

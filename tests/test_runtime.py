@@ -38,7 +38,10 @@ def greedy_reference(params, prompt: list[int], n_new: int) -> list[int]:
 def _drain(handle):
     out = []
     while True:
-        kind, *rest = handle.events.get(timeout=30)
+        # 120 s: a cold spec-path compile on a busy box exceeded the old
+        # 30 s once in round 2 (VERDICT Weak #5) — a flaky oracle test
+        # erodes exactly the trust it exists to provide
+        kind, *rest = handle.events.get(timeout=120)
         if kind == "token":
             out.append(rest[0])
         else:

@@ -107,3 +107,48 @@ def test_forced_tool_choice_not_in_tools_is_400(server_url):
     )
     assert resp.status_code == 400
     assert "get_time" in resp.json()["error"]["message"]
+
+
+def test_profile_endpoint_captures_trace(server_url):
+    """POST /profile must land a TensorBoard-readable jax.profiler trace
+    while the engine serves (SURVEY.md §5.1 runtime-side profiling). The
+    write path is runs/-relative only — the endpoint must not take an
+    arbitrary filesystem path from the request body."""
+    import shutil
+    import threading
+    import uuid
+    from pathlib import Path
+
+    import httpx
+
+    sub = f"pytest-trace-{uuid.uuid4().hex[:8]}"
+    out = Path("runs") / sub
+
+    def traffic():
+        httpx.post(
+            f"{server_url}/v1/chat/completions",
+            json={"messages": [{"role": "user", "content": "count"}],
+                  "max_tokens": 16},
+            timeout=120.0,
+        )
+
+    t = threading.Thread(target=traffic)
+    t.start()
+    try:
+        resp = httpx.post(f"{server_url}/profile",
+                          json={"seconds": 1.5, "out_dir": sub}, timeout=120.0)
+        t.join()
+        assert resp.status_code == 200
+        data = resp.json()
+        assert data["trace_dir"].endswith(sub)
+        assert any(p.is_file() for p in out.rglob("*")), f"no trace files in {out}"
+    finally:
+        shutil.rmtree(out, ignore_errors=True)
+
+    # escaping runs/ is rejected; so are junk seconds
+    assert httpx.post(f"{server_url}/profile",
+                      json={"out_dir": "../escape"}, timeout=60.0).status_code == 400
+    assert httpx.post(f"{server_url}/profile",
+                      json={"seconds": "abc"}, timeout=60.0).status_code == 400
+    assert httpx.post(f"{server_url}/profile",
+                      json={"seconds": -5}, timeout=60.0).status_code == 400
