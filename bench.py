@@ -262,6 +262,29 @@ def main() -> int:
                 lengths_h = lengths_h + cnt
             return t_cache, d_cache, last, lengths_h, emitted, accepted
 
+        # comparability: the headline t_step is RTT-cancelled by chained-run
+        # differencing, but a spec round inherently pays one host readback
+        # (the next round's `last` depends on emit). Measure a served-style
+        # plain step — one readback per step, like the engine's sweep — so
+        # the spec comparison is methodology-consistent.
+        lengths_p = jnp.full((slots,), prompt_len, dtype=jnp.int32)
+        cache_p = init_kv_cache(cfg, slots, max_seq=max_seq, quantized=kv_quant)
+        cache_p, toks_p = prefill_batch(params, cache_p, toks, pos)
+        rng_p = jax.random.PRNGKey(9)
+        for _ in range(4):  # warm
+            rng_p, sub_p = jax.random.split(rng_p)
+            cache_p, toks_p = decode(params, cache_p, toks_p, lengths_p, sub_p)
+            _ = np.asarray(toks_p)
+            lengths_p = lengths_p + 1
+        n_served = 16
+        t0 = time.time()
+        for _ in range(n_served):
+            rng_p, sub_p = jax.random.split(rng_p)
+            cache_p, toks_p = decode(params, cache_p, toks_p, lengths_p, sub_p)
+            _ = np.asarray(toks_p)  # per-step readback, like a serving sweep
+            lengths_p = lengths_p + 1
+        t_step_served = max(time.time() - t0, 1e-9) / n_served
+
         max_rounds = max((max_seq - 1 - prompt_len - 8) // spec_k, 8)
         n_warm, n_meas = 3, min(24, max_rounds - 3)
         t_cache, d_cache, last, lengths_h, _, _ = spec_rounds(
@@ -276,23 +299,27 @@ def main() -> int:
         spec_tps = emitted / dt_spec
         proposed = n_meas * (spec_k - 1) * slots
         t_round = dt_spec / n_meas
-        t_step = dt / n_timed
         # speedup is a function of the acceptance rate α: a round costs
-        # t_round and emits (k-1)α + 1 tokens/slot vs 1 per t_step plain.
-        # α itself needs real checkpoints (random-weight drafters accept at
-        # chance), so report the measured α plus the projection at α=0.7 —
-        # the reference's own stated threshold for its 20-40% claim.
+        # t_round and emits (k-1)α + 1 tokens/slot vs 1 per served step.
+        # Both sides pay one host readback per dispatch (the chained,
+        # RTT-cancelled headline t_step would bias spec low). α itself needs
+        # real checkpoints (random-weight drafters accept at chance), so
+        # report the measured α plus the projection at α=0.7 — the
+        # reference's own stated threshold for its 20-40% claim.
         def speedup_at(alpha: float) -> float:
-            return ((spec_k - 1) * alpha + 1) * t_step / t_round
+            return ((spec_k - 1) * alpha + 1) * t_step_served / t_round
 
         spec_detail = {
             "drafter": drafter,
             "spec_tokens": spec_k,
             "accept_ratio": round(accepted / proposed, 4) if proposed else 1.0,
             "tokens_per_sec_per_chip": round(spec_tps / n_chips, 1),
-            "speedup_vs_plain_measured": round(spec_tps / toks_per_sec, 3),
+            "speedup_vs_served_measured": round(
+                spec_tps / (slots / t_step_served), 3
+            ),
             "round_ms": round(t_round * 1000.0, 3),
-            "plain_step_ms": round(t_step * 1000.0, 3),
+            "served_step_ms": round(t_step_served * 1000.0, 3),
+            "chained_step_ms": round(dt / n_timed * 1000.0, 3),
             "projected_speedup_at_accept_0.7": round(speedup_at(0.7), 3),
             "projected_speedup_at_accept_1.0": round(speedup_at(1.0), 3),
         }

@@ -475,6 +475,8 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str):
             body = await request.json()
         except Exception:
             body = {}
+        if not isinstance(body, dict):
+            body = {}
         try:
             seconds = float(body.get("seconds", 3.0))
         except (TypeError, ValueError):

@@ -176,3 +176,50 @@ def test_topology_matrix_html(tmp_path):
     )
     html = generate_topology_matrix_html(csv_path)
     assert "most efficient" in html and "v5e-1" in html
+
+
+# -- fidelity (quantization-quality signal that works on random weights) ----
+
+def test_fidelity_metrics_math():
+    from kserve_vllm_mini_tpu.quality.evaluator import fidelity_metrics
+
+    ref = [
+        {"prompt": "p1", "tokens": ["a", "b", "c", "d"], "logprobs": [-0.1, -0.2, -0.3, -0.4]},
+        {"prompt": "p2", "tokens": ["x", "y"], "logprobs": [-0.5, -0.6]},
+    ]
+    same = fidelity_metrics(ref, ref)
+    assert same["quality_fidelity"] == 100.0
+    assert same["fidelity_exact_match"] == 1.0
+    assert same["fidelity_first_logprob_mad"] == 0.0
+
+    cand = [
+        {"prompt": "p1", "tokens": ["a", "b", "Z", "Q"], "logprobs": [-0.3, -0.2, -9, -9]},
+        {"prompt": "p2", "tokens": ["x", "y"], "logprobs": [-0.5, -0.6]},
+    ]
+    diff = fidelity_metrics(ref, cand)
+    # prompt1: prefix 2/4; prompt2: 2/2 -> mean 75
+    assert diff["quality_fidelity"] == 75.0
+    assert diff["fidelity_exact_match"] == 0.5
+    assert abs(diff["fidelity_first_logprob_mad"] - 0.1) < 1e-9
+
+
+@pytest.mark.slow
+def test_fidelity_discriminates_quantization():
+    """The whole point: on a random-weight model, task scores are chance for
+    every config, but fidelity must rank none == 100 > quantized configs."""
+    from kserve_vllm_mini_tpu.quality.evaluator import capture_outputs, fidelity_metrics
+    from kserve_vllm_mini_tpu.runtime.local import local_server
+
+    base = {"model": "llama-tiny", "max_slots": 2, "max_seq_len": 128}
+    with local_server(dict(base)) as ref_srv:
+        ref = capture_outputs(ref_srv.url, max_tokens=16)
+        again = capture_outputs(ref_srv.url, max_tokens=16)
+    self_fid = fidelity_metrics(ref, again)
+    assert self_fid["quality_fidelity"] == 100.0  # greedy is deterministic
+
+    with local_server({**base, "quantization": "int8",
+                       "kv_cache_dtype": "int8"}) as q_srv:
+        cand = capture_outputs(q_srv.url, max_tokens=16)
+    q_fid = fidelity_metrics(ref, cand)
+    assert q_fid["quality_fidelity"] < 100.0      # quantization must cost
+    assert q_fid["quality_fidelity"] > 0.0        # ...but not destroy
