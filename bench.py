@@ -13,7 +13,11 @@ What it measures (honest accounting per VERDICT.md round-1 #4):
   serving config the validator maps to v5e), donated caches.
 - ttft_p50_ms: steady-state single-request prefill latency (128-token
   bucket, cache-write, flash-attention path) — the server-side TTFT a warm
-  engine adds to a request.
+  engine adds to a request. Under the remote-TPU relay every dispatch+
+  readback pays a measured tunnel RTT (~70 ms) that a PCIe-attached serving
+  host does not; the bench times an already-compiled 1-element no-op the
+  same way to isolate it and reports both the raw number and
+  ttft_p50_adjusted_ms = raw - rtt_p50 (the device-side TTFT).
 - hbm_bw_util / mfu: achieved HBM weight+KV streaming as a fraction of v5e
   peak (819 GB/s) and MXU utilization vs bf16 peak (197 TFLOP/s).
 - flash_prefill_lowered: asserts the prefill executable contains the Pallas
@@ -64,7 +68,10 @@ def main() -> int:
     model = os.environ.get("KVMINI_BENCH_MODEL", "llama-3.1-8b")
     quant = os.environ.get("KVMINI_BENCH_QUANT", "int8")
     kv_quant = os.environ.get("KVMINI_BENCH_KV", "") == "int8"
-    slots = int(os.environ.get("KVMINI_BENCH_SLOTS", "32"))
+    # 64 slots: the 9 GB int8 weight stream per decode step amortizes over
+    # 2x the tokens vs 32 (measured 1710 -> 2774 tok/s/chip on the v5e);
+    # 64 x 512-token bf16 KV (4.3 GB) + weights still fit 16 GB HBM
+    slots = int(os.environ.get("KVMINI_BENCH_SLOTS", "64"))
     prompt_len = 128
     max_seq = 512
     decode_steps = int(os.environ.get("KVMINI_BENCH_STEPS", "128"))
@@ -153,6 +160,21 @@ def main() -> int:
         _ = np.asarray(out)
         ttfts.append((time.time() - t0) * 1000.0)
     ttft_p50 = float(np.percentile(ttfts, 50))
+
+    # tunnel RTT floor: dispatch + 1-element readback of a compiled no-op,
+    # timed exactly like the TTFT loop. On a PCIe-attached host this is
+    # sub-ms; under the remote relay it is the fixed per-readback tax every
+    # latency above includes.
+    noop = jax.jit(lambda x: x + 1)
+    xs = jnp.zeros((1,), jnp.int32)
+    _ = np.asarray(noop(xs))
+    rtts = []
+    for _i in range(15):
+        t0 = time.time()
+        _ = np.asarray(noop(xs))
+        rtts.append((time.time() - t0) * 1000.0)
+    rtt_p50 = float(np.percentile(rtts, 50))
+    ttft_adj = max(ttft_p50 - rtt_p50, 0.0)
 
     lengths = jnp.full((slots,), prompt_len, dtype=jnp.int32)
     rng = jax.random.PRNGKey(2)
@@ -338,6 +360,8 @@ def main() -> int:
             "total_tokens_per_sec": round(toks_per_sec, 1),
             "decode_step_ms": round(step_ms, 3),
             "ttft_p50_ms": round(ttft_p50, 2),
+            "tunnel_rtt_p50_ms": round(rtt_p50, 2),
+            "ttft_p50_adjusted_ms": round(ttft_adj, 2),
             "ttft_target_ms": 30.0,
             "prefill_first_call_s": round(prefill_first_s, 2),
             "flash_prefill_lowered": bool(flash_lowered),
