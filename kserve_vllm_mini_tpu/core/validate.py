@@ -31,7 +31,7 @@ GPU_ONLY_QUANT = {"awq", "gptq", "autoawq", "marlin", "squeezellm"}
 # rough parameter counts for HBM-fit estimates (bf16 bytes = 2/param + ~30%
 # for KV cache and activations at serving batch sizes)
 MODEL_SIZE_B = {"125m": 0.125, "1b": 1.5, "7b": 7.0, "8b": 8.0, "13b": 13.0,
-                "34b": 34.0, "70b": 70.0}
+                "34b": 34.0, "70b": 70.0, "8x7b": 47.0}  # 8x7b: Mixtral total params
 
 
 @dataclass

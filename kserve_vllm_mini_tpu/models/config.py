@@ -1,11 +1,18 @@
 """Model architecture configs for the in-repo serving runtime.
 
 The reference never touches model internals (models are opaque strings passed
-to external engines, e.g. /root/reference/deploy.sh:25-39 --model-uri). The
-TPU build owns the runtime, so architecture configs are first-class. The
-family implemented is the Llama-style decoder (RMSNorm, RoPE, SwiGLU, GQA),
-which covers the baseline configs in /root/repo/BASELINE.json (Llama-3.1-8B,
-Llama-3-70B, and an opt-125m-class smoke model).
+to external engines, e.g. /root/reference/deploy.sh:25-39 --model-uri), but it
+ships engine profiles for four model families
+(/root/reference/profiles/tensorrt-llm/{llama-7b,codellama-7b,mistral-7b,
+phi-2.7b}.yaml). The TPU build owns the runtime, so architecture configs are
+first-class. The base family is the Llama-style decoder (RMSNorm, RoPE,
+SwiGLU, GQA) covering BASELINE.json's Llama-3.x configs plus CodeLlama;
+orthogonal architecture axes extend it to the other families:
+
+- ``sliding_window`` — Mistral-style windowed attention;
+- ``attn_bias`` — Qwen2-style q/k/v projection biases;
+- ``n_experts`` / ``n_experts_per_tok`` — Mixtral-style sparse MoE MLP
+  (models/moe.py), sharded over the mesh's ``ep`` axis.
 """
 
 from __future__ import annotations
@@ -33,10 +40,26 @@ class ModelConfig:
     rms_eps: float = 1e-5
     dtype: str = "bfloat16"          # parameter/activation dtype
     tie_embeddings: bool = False
+    # Mistral-style sliding-window attention: a query at absolute position p
+    # attends keys j with p - window < j <= p. None = full causal.
+    sliding_window: Optional[int] = None
+    # Qwen2-style biases on the q/k/v projections (o/mlp stay bias-free).
+    attn_bias: bool = False
+    # Mixtral-style sparse MoE: n_experts > 0 replaces the dense SwiGLU MLP
+    # with a top-k routed expert MLP (models/moe.py).
+    n_experts: int = 0
+    n_experts_per_tok: int = 2
+    # Dispatch buffer head-room: each expert's token capacity per routed
+    # block is ceil(tokens * top_k / n_experts * capacity_factor).
+    expert_capacity_factor: float = 2.0
 
     @property
     def head_dim(self) -> int:
         return self.d_model // self.n_heads
+
+    @property
+    def is_moe(self) -> bool:
+        return self.n_experts > 0
 
     @property
     def jnp_dtype(self):
@@ -50,6 +73,10 @@ class ModelConfig:
             self.n_kv_heads * self.head_dim
         ) + self.d_model * self.d_model
         mlp = 3 * self.d_model * self.d_ff
+        if self.is_moe:
+            mlp = self.n_experts * mlp + self.d_model * self.n_experts
+        if self.attn_bias:
+            attn += self.n_heads * self.head_dim + 2 * self.n_kv_heads * self.head_dim
         norms = 2 * self.d_model
         head = 0 if self.tie_embeddings else self.vocab_size * self.d_model
         return emb + self.n_layers * (attn + mlp + norms) + self.d_model + head
@@ -116,6 +143,94 @@ PRESETS: dict[str, ModelConfig] = {
         n_kv_heads=8,
         d_ff=28_672,
         max_seq_len=8192,
+    ),
+    # -- the reference's other engine-profile families ----------------------
+    # (/root/reference/profiles/tensorrt-llm/codellama-7b.yaml, mistral-7b.yaml)
+    "codellama-7b": ModelConfig(
+        name="codellama-7b",
+        vocab_size=32_016,
+        d_model=4096,
+        n_layers=32,
+        n_heads=32,
+        n_kv_heads=32,               # Llama-2 7B is MHA
+        d_ff=11_008,
+        max_seq_len=8192,
+        rope_theta=1_000_000.0,      # CodeLlama's long-context base
+    ),
+    "mistral-7b": ModelConfig(
+        name="mistral-7b",
+        vocab_size=32_000,
+        d_model=4096,
+        n_layers=32,
+        n_heads=32,
+        n_kv_heads=8,
+        d_ff=14_336,
+        max_seq_len=8192,
+        rope_theta=10_000.0,
+        sliding_window=4096,
+    ),
+    "qwen2-7b": ModelConfig(
+        name="qwen2-7b",
+        vocab_size=152_064,
+        d_model=3584,
+        n_layers=28,
+        n_heads=28,
+        n_kv_heads=4,
+        d_ff=18_944,
+        max_seq_len=8192,
+        rope_theta=1_000_000.0,
+        attn_bias=True,
+    ),
+    "mixtral-8x7b": ModelConfig(
+        name="mixtral-8x7b",
+        vocab_size=32_000,
+        d_model=4096,
+        n_layers=32,
+        n_heads=32,
+        n_kv_heads=8,
+        d_ff=14_336,
+        max_seq_len=8192,
+        rope_theta=1_000_000.0,
+        n_experts=8,
+        n_experts_per_tok=2,
+    ),
+    # -- tiny CI variants (CPU in <1s) exercising each architecture axis ----
+    "mistral-tiny": ModelConfig(
+        name="mistral-tiny",
+        vocab_size=512,
+        d_model=128,
+        n_layers=2,
+        n_heads=4,
+        n_kv_heads=2,
+        d_ff=256,
+        max_seq_len=256,
+        rope_theta=10_000.0,
+        sliding_window=16,           # small enough that tests hit the window
+    ),
+    "qwen-tiny": ModelConfig(
+        name="qwen-tiny",
+        vocab_size=512,
+        d_model=128,
+        n_layers=2,
+        n_heads=4,
+        n_kv_heads=2,
+        d_ff=256,
+        max_seq_len=256,
+        rope_theta=10_000.0,
+        attn_bias=True,
+    ),
+    "mixtral-tiny": ModelConfig(
+        name="mixtral-tiny",
+        vocab_size=512,
+        d_model=128,
+        n_layers=2,
+        n_heads=4,
+        n_kv_heads=2,
+        d_ff=256,
+        max_seq_len=256,
+        rope_theta=10_000.0,
+        n_experts=4,
+        n_experts_per_tok=2,
     ),
 }
 

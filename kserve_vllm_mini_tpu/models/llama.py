@@ -37,27 +37,39 @@ Params = dict[str, Any]
 KVCache = dict[str, jnp.ndarray]
 
 
-def _stacked_weight_shapes(cfg: ModelConfig) -> dict[str, tuple[int, int]]:
-    """Per-layer [in, out] shape of every stacked transformer matmul weight,
-    in a fixed order shared by the bf16 and quantized initializers (the order
+def _stacked_weight_shapes(cfg: ModelConfig) -> dict[str, tuple[int, ...]]:
+    """Per-layer shape of every stacked transformer matmul weight (last two
+    axes are [in, out]; MoE expert weights carry a leading expert axis), in a
+    fixed order shared by the bf16 and quantized initializers (the order
     defines which RNG key each weight gets, so the two inits draw identical
     values)."""
     hd, kvd = cfg.head_dim, cfg.n_kv_heads * cfg.head_dim
-    return {
+    shapes: dict[str, tuple[int, ...]] = {
         "wq": (cfg.d_model, cfg.n_heads * hd),
         "wk": (cfg.d_model, kvd),
         "wv": (cfg.d_model, kvd),
         "wo": (cfg.n_heads * hd, cfg.d_model),
-        "w_gate": (cfg.d_model, cfg.d_ff),
-        "w_up": (cfg.d_model, cfg.d_ff),
-        "w_down": (cfg.d_ff, cfg.d_model),
     }
+    if cfg.is_moe:
+        shapes.update({
+            "w_gate": (cfg.n_experts, cfg.d_model, cfg.d_ff),
+            "w_up": (cfg.n_experts, cfg.d_model, cfg.d_ff),
+            "w_down": (cfg.n_experts, cfg.d_ff, cfg.d_model),
+            "router": (cfg.d_model, cfg.n_experts),
+        })
+    else:
+        shapes.update({
+            "w_gate": (cfg.d_model, cfg.d_ff),
+            "w_up": (cfg.d_model, cfg.d_ff),
+            "w_down": (cfg.d_ff, cfg.d_model),
+        })
+    return shapes
 
 
 def _init_keys(rng: jax.Array, cfg: ModelConfig) -> dict[str, jax.Array]:
-    keys = jax.random.split(rng, 10)
-    named = {"embed": keys[0], "lm_head": keys[8]}
-    for i, name in enumerate(_stacked_weight_shapes(cfg)):
+    keys = jax.random.split(rng, 11)
+    named = {"embed": keys[0], "lm_head": keys[8], "router": keys[9]}
+    for i, name in enumerate(n for n in _stacked_weight_shapes(cfg) if n != "router"):
         named[name] = keys[1 + i]
     return named
 
@@ -82,11 +94,19 @@ def _init_impl(rng: jax.Array, cfg: ModelConfig, leaf_fn) -> Params:
     }
     for name, shape in _stacked_weight_shapes(cfg).items():
         lkeys = jax.random.split(keys[name], L)
+        # the router is accuracy-critical and noise-level bytes — it stays
+        # full precision even in the int8 tree (models/moe.py contract)
+        fn = leaf_fn if name != "router" else (lambda w: w)
 
-        def body(_, k, s=shape):
-            return None, leaf_fn(_nrm(k, s, dt))
+        def body(_, k, s=shape, f=fn):
+            return None, f(_nrm(k, s, dt))
 
         _, layers[name] = jax.lax.scan(body, None, lkeys)
+    if cfg.attn_bias:
+        hd, kvd = cfg.head_dim, cfg.n_kv_heads * cfg.head_dim
+        layers["bq"] = jnp.zeros((L, cfg.n_heads * hd), dtype=dt)
+        layers["bk"] = jnp.zeros((L, kvd), dtype=dt)
+        layers["bv"] = jnp.zeros((L, kvd), dtype=dt)
 
     params: Params = {
         "embed": _nrm(keys["embed"], (cfg.vocab_size, cfg.d_model), dt),
@@ -205,9 +225,12 @@ def qkv_proj(
     implementation every execution path (scan-rolled, cached, pipelined)
     shares."""
     B, T, _ = h.shape
-    q = linear(h, p["wq"]).reshape(B, T, cfg.n_heads, cfg.head_dim).transpose(0, 2, 1, 3)
-    k = linear(h, p["wk"]).reshape(B, T, cfg.n_kv_heads, cfg.head_dim).transpose(0, 2, 1, 3)
-    v = linear(h, p["wv"]).reshape(B, T, cfg.n_kv_heads, cfg.head_dim).transpose(0, 2, 1, 3)
+    q, k, v = linear(h, p["wq"]), linear(h, p["wk"]), linear(h, p["wv"])
+    if cfg.attn_bias:
+        q, k, v = q + p["bq"], k + p["bk"], v + p["bv"]
+    q = q.reshape(B, T, cfg.n_heads, cfg.head_dim).transpose(0, 2, 1, 3)
+    k = k.reshape(B, T, cfg.n_kv_heads, cfg.head_dim).transpose(0, 2, 1, 3)
+    v = v.reshape(B, T, cfg.n_kv_heads, cfg.head_dim).transpose(0, 2, 1, 3)
     return apply_rope(q, positions, cos, sin), apply_rope(k, positions, cos, sin), v
 
 
@@ -221,6 +244,10 @@ def attn_out_and_mlp(
     o = o.transpose(0, 2, 1, 3).reshape(B, T, cfg.n_heads * cfg.head_dim)
     x = x + linear(o, p["wo"])
     h = rms_norm(x, p["mlp_norm"], cfg.rms_eps)
+    if cfg.is_moe:
+        from kserve_vllm_mini_tpu.models.moe import moe_mlp
+
+        return x + moe_mlp(p, cfg, h)
     gated = jax.nn.silu(linear(h, p["w_gate"]).astype(jnp.float32)).astype(dt) * linear(h, p["w_up"])
     return x + linear(gated, p["w_down"])
 
@@ -246,8 +273,11 @@ def layer_forward(
         o = attention_fn(q, k, v, positions)
     else:
         kj = jnp.arange(T)[None, None, :]
-        mask = (kj <= positions[:, :, None])[:, None, :, :]
-        o = attention(q, k, v, mask)
+        qi = positions[:, :, None]
+        mask = kj <= qi
+        if cfg.sliding_window is not None:
+            mask &= kj > qi - cfg.sliding_window
+        o = attention(q, k, v, mask[:, None, :, :])
     return attn_out_and_mlp(p, cfg, x, o)
 
 
@@ -284,6 +314,11 @@ def forward(
     """
     B, T = tokens.shape
     dt = cfg.jnp_dtype
+    if attention_fn is not None and cfg.sliding_window is not None:
+        raise ValueError(
+            "attention_fn overrides (ring attention / sp) do not implement "
+            "sliding-window masking; run windowed models with sp=1"
+        )
     x = params["embed"][tokens]  # [B, T, D] gather
     cos, sin = rope_frequencies(
         cfg.head_dim, cfg.max_seq_len, cfg.rope_theta, cfg.rope_scaling
@@ -307,7 +342,14 @@ def forward(
         quantized_kv = "k_s" in kv_cache  # static: selects the int8 path
         s = kv_cache["k"].shape[3]
         kj = jnp.arange(s)[None, None, :]
-        mask = (kj <= positions[:, :, None])[:, None, :, :]      # [B, 1, T, S]
+        qi = positions[:, :, None]
+        mask = kj <= qi
+        if cfg.sliding_window is not None:
+            # Mistral-style window: key j valid iff p - W < j <= p. Cache
+            # slots are absolute positions, so the window is a second bound
+            # on the same positional mask.
+            mask &= kj > qi - cfg.sliding_window
+        mask = mask[:, None, :, :]                               # [B, 1, T, S]
         b_idx = jnp.arange(B)[:, None, None]                     # [B, 1, 1]
         h_idx = jnp.arange(cfg.n_kv_heads)[None, :, None]        # [1, KVH, 1]
         t_idx = cache_offsets[:, None, None] + jnp.arange(T)[None, None, :]  # [B, 1, T]
@@ -345,9 +387,18 @@ def forward(
                     v.astype(cache["v"].dtype)
                 )
             if fresh_prefill:
-                from kserve_vllm_mini_tpu.ops.flash_attention import prefill_attention
+                # block-causal flash over the fresh block is exact for a
+                # windowed model too as long as T <= window (every causal
+                # key is inside the window); longer prefills take the masked
+                # jnp path. T is static, so this is a trace-time branch.
+                if cfg.sliding_window is not None and T > cfg.sliding_window:
+                    fj = jnp.arange(T)[None, None, :]
+                    fmask = (fj <= qi) & (fj > qi - cfg.sliding_window)
+                    o = attention(q, k, v, fmask[:, None, :, :])
+                else:
+                    from kserve_vllm_mini_tpu.ops.flash_attention import prefill_attention
 
-                o = prefill_attention(q, k, v)
+                    o = prefill_attention(q, k, v)
             else:
                 k_layer = _read_layer(cache, "k", lidx)
                 v_layer = _read_layer(cache, "v", lidx)

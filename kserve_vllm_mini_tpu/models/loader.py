@@ -34,6 +34,22 @@ _LAYER_MAP = {
     "w_down": ("mlp.down_proj.weight", True),
 }
 
+# Qwen2-style q/k/v biases (vectors — no transpose)
+_BIAS_MAP = {
+    "bq": "self_attn.q_proj.bias",
+    "bk": "self_attn.k_proj.bias",
+    "bv": "self_attn.v_proj.bias",
+}
+
+# Mixtral MoE names: the router is ``block_sparse_moe.gate`` and experts use
+# the w1/w3/w2 = gate/up/down convention. Expert weights stack to
+# [E, in, out]; per-layer stacks add the leading L axis.
+_MOE_EXPERT_MAP = {
+    "w_gate": "block_sparse_moe.experts.{e}.w1.weight",
+    "w_up": "block_sparse_moe.experts.{e}.w3.weight",
+    "w_down": "block_sparse_moe.experts.{e}.w2.weight",
+}
+
 
 def config_from_hf(model_dir: str | Path) -> ModelConfig:
     """Derive a ModelConfig from an HF config.json."""
@@ -48,6 +64,15 @@ def config_from_hf(model_dir: str | Path) -> ModelConfig:
             float(rs.get("high_freq_factor", 4.0)),
             int(rs.get("original_max_position_embeddings", 8192)),
         )
+    model_type = hf.get("model_type", "llama")
+    sliding_window = hf.get("sliding_window")
+    # Qwen2 checkpoints ship sliding_window=131072 with
+    # use_sliding_window=false — the window is disabled, not huge. A window
+    # at/past max_position_embeddings is likewise never binding.
+    if not hf.get("use_sliding_window", True):
+        sliding_window = None
+    if sliding_window and sliding_window >= hf.get("max_position_embeddings", 4096):
+        sliding_window = None
     return ModelConfig(
         rope_scaling=rope_scaling,
         name=hf.get("_name_or_path", Path(model_dir).name) or Path(model_dir).name,
@@ -61,6 +86,10 @@ def config_from_hf(model_dir: str | Path) -> ModelConfig:
         rope_theta=float(hf.get("rope_theta", 10_000.0)),
         rms_eps=float(hf.get("rms_norm_eps", 1e-5)),
         tie_embeddings=bool(hf.get("tie_word_embeddings", False)),
+        sliding_window=int(sliding_window) if sliding_window else None,
+        attn_bias=model_type == "qwen2",
+        n_experts=int(hf.get("num_local_experts", 0)),
+        n_experts_per_tok=int(hf.get("num_experts_per_tok", 2)),
     )
 
 
@@ -124,17 +153,44 @@ def load_hf_checkpoint(
     if quantize:
         from kserve_vllm_mini_tpu.ops.quant import QUANTIZABLE, quantize_weight
 
+    def stack_quantized(per_layer_arrays) -> dict[str, Any]:
+        qws = [quantize_weight(a) for a in per_layer_arrays]
+        return {
+            "q": jnp.stack([w["q"] for w in qws]),
+            "s": jnp.stack([w["s"] for w in qws]),
+        }
+
     layers: dict[str, Any] = {}
     for ours, (hf_key, tr) in _LAYER_MAP.items():
-        per_layer = (f"model.layers.{i}.{hf_key}" for i in range(cfg.n_layers))
-        if quantize and ours in QUANTIZABLE:
-            qws = [quantize_weight(conv(name, tr)) for name in per_layer]
-            layers[ours] = {
-                "q": jnp.stack([w["q"] for w in qws]),
-                "s": jnp.stack([w["s"] for w in qws]),
-            }
+        if cfg.is_moe and ours in _MOE_EXPERT_MAP:
+            # expert-stacked [L, E, in, out]: per layer, stack the E experts
+            tmpl = _MOE_EXPERT_MAP[ours]
+            per_layer = (
+                jnp.stack([
+                    conv(f"model.layers.{i}.{tmpl.format(e=e)}", True)
+                    for e in range(cfg.n_experts)
+                ])
+                for i in range(cfg.n_layers)
+            )
         else:
-            layers[ours] = jnp.stack([conv(name, tr) for name in per_layer])
+            per_layer = (
+                conv(f"model.layers.{i}.{hf_key}", tr) for i in range(cfg.n_layers)
+            )
+        if quantize and ours in QUANTIZABLE:
+            layers[ours] = stack_quantized(per_layer)
+        else:
+            layers[ours] = jnp.stack(list(per_layer))
+    if cfg.is_moe:
+        # router ("gate") is [E, d] applied as x @ W.T -> ours is [d, E]
+        layers["router"] = jnp.stack([
+            conv(f"model.layers.{i}.block_sparse_moe.gate.weight", True)
+            for i in range(cfg.n_layers)
+        ])
+    if cfg.attn_bias:
+        for ours, hf_key in _BIAS_MAP.items():
+            layers[ours] = jnp.stack([
+                conv(f"model.layers.{i}.{hf_key}", False) for i in range(cfg.n_layers)
+            ])
 
     params: dict[str, Any] = {
         "embed": conv("model.embed_tokens.weight", False),
@@ -167,8 +223,36 @@ def save_checkpoint(params: dict[str, Any], cfg: ModelConfig, out_dir: str | Pat
         put("lm_head.weight", params["lm_head"], False)
     for ours, (hf_key, tr) in _LAYER_MAP.items():
         for i in range(cfg.n_layers):
-            put(f"model.layers.{i}.{hf_key}", params["layers"][ours][i], tr)
+            if cfg.is_moe and ours in _MOE_EXPERT_MAP:
+                tmpl = _MOE_EXPERT_MAP[ours]
+                for e in range(cfg.n_experts):
+                    put(
+                        f"model.layers.{i}.{tmpl.format(e=e)}",
+                        params["layers"][ours][i][e],
+                        True,
+                    )
+            else:
+                put(f"model.layers.{i}.{hf_key}", params["layers"][ours][i], tr)
+    if cfg.is_moe:
+        for i in range(cfg.n_layers):
+            put(
+                f"model.layers.{i}.block_sparse_moe.gate.weight",
+                params["layers"]["router"][i],
+                True,
+            )
+    if cfg.attn_bias:
+        for ours, hf_key in _BIAS_MAP.items():
+            for i in range(cfg.n_layers):
+                put(f"model.layers.{i}.{hf_key}", params["layers"][ours][i], False)
     save_file(tensors, str(out_dir / "model.safetensors"))
+    if cfg.is_moe:
+        model_type = "mixtral"
+    elif cfg.attn_bias:
+        model_type = "qwen2"
+    elif cfg.sliding_window is not None:
+        model_type = "mistral"
+    else:
+        model_type = "llama"
     hf_cfg = {
         "vocab_size": cfg.vocab_size,
         "hidden_size": cfg.d_model,
@@ -180,8 +264,13 @@ def save_checkpoint(params: dict[str, Any], cfg: ModelConfig, out_dir: str | Pat
         "rope_theta": cfg.rope_theta,
         "rms_norm_eps": cfg.rms_eps,
         "tie_word_embeddings": cfg.tie_embeddings,
-        "model_type": "llama",
+        "model_type": model_type,
     }
+    if cfg.sliding_window is not None:
+        hf_cfg["sliding_window"] = cfg.sliding_window
+    if cfg.is_moe:
+        hf_cfg["num_local_experts"] = cfg.n_experts
+        hf_cfg["num_experts_per_tok"] = cfg.n_experts_per_tok
     if cfg.rope_scaling is not None:
         f_, lo, hi, omax = cfg.rope_scaling
         hf_cfg["rope_scaling"] = {

@@ -1,0 +1,106 @@
+"""Sparse mixture-of-experts MLP (Mixtral-style top-k routing), TPU-first.
+
+The reference has no MoE support at all (SURVEY.md §2.2: EP absent); this
+module adds the family the TPU build owns end-to-end. Design:
+
+- **Routing**: per-token softmax router, ``lax.top_k`` selection of
+  ``n_experts_per_tok`` experts, gates renormalized over the chosen k
+  (Mixtral's convention).
+- **Dispatch**: capacity-bounded scatter into a per-expert token buffer
+  ``[E, C, D]`` — O(tokens · k) memory, unlike the GShard one-hot einsum
+  whose ``[S, E, C]`` dispatch tensor is quadratic in tokens. Position
+  within each expert comes from a cumulative sum over a choice-major
+  flattening, so every token's FIRST choice beats any token's second choice
+  when an expert overflows (GShard priority). Overflowed assignments drop
+  (their gate weight is simply not added — the residual passes through),
+  which is the standard capacity-factor contract.
+- **Expert compute**: one batched SwiGLU over ``[E, C, D]`` — three
+  ``einsum('ecd,edf->ecf')`` matmuls the MXU tiles per expert. Expert
+  weights are stacked ``[L, E, d, ff]`` so the layer scan treats MoE layers
+  exactly like dense ones.
+- **EP sharding**: the expert axis shards over the mesh's ``ep`` axis
+  (parallel/sharding.py): expert weights are P(..., "ep", ...), and XLA
+  lowers the dispatch/return movement to all-to-alls over ICI — the
+  scaling-book recipe, not hand-written collectives.
+
+Quantization: expert matmul weights quantize per-output-channel like dense
+weights (ops/quant.py works on any [..., in, out] stack); the router stays
+bf16 (it is d_model x E — noise-level bytes, accuracy-critical).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from kserve_vllm_mini_tpu.models.config import ModelConfig
+from kserve_vllm_mini_tpu.ops.quant import is_quantized
+
+
+def expert_capacity(cfg: ModelConfig, n_tokens: int) -> int:
+    """Per-expert token capacity for a routed block of ``n_tokens``."""
+    ideal = n_tokens * cfg.n_experts_per_tok / cfg.n_experts
+    return max(int(math.ceil(ideal * cfg.expert_capacity_factor)), cfg.n_experts_per_tok)
+
+
+def _expert_linear(x: jnp.ndarray, w: Any) -> jnp.ndarray:
+    """Batched per-expert matmul ``[E, C, in] @ [E, in, out]``; ``w`` may be
+    a plain array or an int8 dict (scale applied as a fused epilogue, same
+    contract as ops.quant.linear)."""
+    if is_quantized(w):
+        y = jnp.einsum("ecd,edf->ecf", x, w["q"].astype(x.dtype))
+        return y * w["s"].astype(x.dtype)[:, None, :]
+    return jnp.einsum("ecd,edf->ecf", x, w)
+
+
+def moe_mlp(p: dict[str, Any], cfg: ModelConfig, h: jnp.ndarray) -> jnp.ndarray:
+    """Routed SwiGLU MLP. ``h`` is the normed hidden [B, T, D]; returns the
+    MLP delta [B, T, D] (caller adds the residual, mirroring the dense path).
+
+    ``p`` holds this layer's ``router`` [D, E] plus expert-stacked
+    ``w_gate``/``w_up`` [E, D, F] and ``w_down`` [E, F, D].
+    """
+    B, T, D = h.shape
+    S = B * T
+    E, K = cfg.n_experts, cfg.n_experts_per_tok
+    C = expert_capacity(cfg, S)
+    dt = h.dtype
+    x = h.reshape(S, D)
+
+    # -- route (f32 softmax; the router matmul is tiny) ---------------------
+    router_logits = (x.astype(jnp.float32) @ p["router"].astype(jnp.float32))
+    probs = jax.nn.softmax(router_logits, axis=-1)            # [S, E]
+    gates, expert_idx = jax.lax.top_k(probs, K)               # [S, K]
+    gates = gates / jnp.maximum(gates.sum(axis=-1, keepdims=True), 1e-9)
+
+    # -- capacity positions: choice-major cumsum so first choices win -------
+    flat_e = expert_idx.T.reshape(-1)                         # [K*S] choice-major
+    onehot = jax.nn.one_hot(flat_e, E, dtype=jnp.int32)       # [K*S, E]
+    pos = jnp.cumsum(onehot, axis=0) - onehot                 # entries start at 0
+    pos_in_expert = jnp.take_along_axis(pos, flat_e[:, None], axis=1)[:, 0]
+    keep = pos_in_expert < C                                  # [K*S]
+    # dropped assignments scatter to a sentinel row past every expert buffer
+    slot = jnp.where(keep, flat_e * C + jnp.minimum(pos_in_expert, C - 1), E * C)
+
+    # -- dispatch: scatter tokens into [E*C(+1), D] expert buffers ----------
+    x_rep = jnp.broadcast_to(x[None], (K, S, D)).reshape(K * S, D)
+    buf = jnp.zeros((E * C + 1, D), dtype=dt).at[slot].add(x_rep)
+    expert_in = buf[: E * C].reshape(E, C, D)
+
+    # -- batched SwiGLU over experts ----------------------------------------
+    gated = jax.nn.silu(
+        _expert_linear(expert_in, p["w_gate"]).astype(jnp.float32)
+    ).astype(dt) * _expert_linear(expert_in, p["w_up"])
+    expert_out = _expert_linear(gated, p["w_down"])           # [E, C, D]
+
+    # -- return + combine: gather each kept assignment, weight by its gate --
+    out_flat = expert_out.reshape(E * C, D)
+    picked = jnp.where(
+        keep[:, None], jnp.take(out_flat, jnp.minimum(slot, E * C - 1), axis=0), 0.0
+    )                                                         # [K*S, D]
+    gates_flat = gates.T.reshape(-1).astype(dt)               # choice-major [K*S]
+    combined = (picked * gates_flat[:, None]).reshape(K, S, D).sum(axis=0)
+    return combined.reshape(B, T, D)

@@ -120,7 +120,7 @@ def global_mesh(spec: MeshSpec, dcn_dp: int = 1) -> jax.sharding.Mesh:
 
     from jax.experimental import mesh_utils
 
-    per_slice = (spec.dp, spec.sp, spec.pp, spec.tp)
+    per_slice = spec.axis_sizes()
     if dcn_dp * spec.n_devices != n_global:
         raise ValueError(
             f"dcn_dp={dcn_dp} x per-slice {spec.n_devices} != {n_global} global devices"
@@ -128,7 +128,7 @@ def global_mesh(spec: MeshSpec, dcn_dp: int = 1) -> jax.sharding.Mesh:
     # dp outermost over DCN; every other axis confined to one ICI slice
     devices = mesh_utils.create_hybrid_device_mesh(
         mesh_shape=per_slice,
-        dcn_mesh_shape=(dcn_dp, 1, 1, 1),
+        dcn_mesh_shape=(dcn_dp,) + (1,) * (len(per_slice) - 1),
         devices=jax.devices(),
         allow_split_physical_axes=True,
     )

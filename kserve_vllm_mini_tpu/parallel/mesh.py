@@ -10,6 +10,7 @@ triton/deploy.sh:84-86) and never owns a communicator. Here parallelism is a
 - ``tp`` — tensor parallel (attention heads / FFN columns)
 - ``sp`` — sequence/context parallel (ring attention over long sequences)
 - ``pp`` — pipeline parallel (layer stages)
+- ``ep`` — expert parallel (MoE expert shards, models/moe.py)
 
 XLA compiles the collectives (psum / all-gather / reduce-scatter / ppermute)
 onto ICI links; multi-host meshes extend the same axes over DCN via
@@ -30,7 +31,7 @@ from typing import Optional, Sequence
 import jax
 from jax.sharding import Mesh
 
-AXES = ("dp", "sp", "pp", "tp")
+AXES = ("dp", "sp", "pp", "tp", "ep")
 
 
 @dataclass(frozen=True)
@@ -39,22 +40,30 @@ class MeshSpec:
     sp: int = 1
     pp: int = 1
     tp: int = 1
+    ep: int = 1
 
     @property
     def n_devices(self) -> int:
-        return self.dp * self.sp * self.pp * self.tp
+        return self.dp * self.sp * self.pp * self.tp * self.ep
 
-    def axis_sizes(self) -> tuple[int, int, int, int]:
-        return (self.dp, self.sp, self.pp, self.tp)
+    def axis_sizes(self) -> tuple[int, int, int, int, int]:
+        return (self.dp, self.sp, self.pp, self.tp, self.ep)
 
     @classmethod
-    def fill(cls, n_devices: int, tp: Optional[int] = None, sp: int = 1, pp: int = 1) -> "MeshSpec":
+    def fill(
+        cls,
+        n_devices: int,
+        tp: Optional[int] = None,
+        sp: int = 1,
+        pp: int = 1,
+        ep: int = 1,
+    ) -> "MeshSpec":
         """tp defaults to all remaining devices — the serving-friendly layout
         (TP over ICI minimizes per-token latency)."""
-        rem = n_devices // (sp * pp)
+        rem = n_devices // (sp * pp * ep)
         tp = tp if tp is not None else rem
-        dp = n_devices // (sp * pp * tp)
-        spec = cls(dp=dp, sp=sp, pp=pp, tp=tp)
+        dp = n_devices // (sp * pp * tp * ep)
+        spec = cls(dp=dp, sp=sp, pp=pp, tp=tp, ep=ep)
         if spec.n_devices != n_devices:
             raise ValueError(
                 f"axis sizes {spec.axis_sizes()} do not factor {n_devices} devices"

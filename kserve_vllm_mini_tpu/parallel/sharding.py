@@ -57,12 +57,33 @@ def param_specs(
             "wv": P(pp, None, kv_tp),
             "wo": P(pp, tp, None),
             "mlp_norm": P(pp, None),
-            "w_gate": P(pp, None, tp),
-            "w_up": P(pp, None, tp),
-            "w_down": P(pp, tp, None),
         },
         "final_norm": P(None),
     }
+    if cfg.is_moe:
+        # expert-parallel: the expert axis shards over ``ep``; inside each
+        # expert the FFN is Megatron column/row over ``tp`` exactly like the
+        # dense MLP. The router is d_model x E — replicated.
+        ep = _axis(mesh, "ep")
+        ep = ep if ep and cfg.n_experts % mesh.shape["ep"] == 0 else None
+        specs["layers"].update({
+            "w_gate": P(pp, ep, None, tp),
+            "w_up": P(pp, ep, None, tp),
+            "w_down": P(pp, ep, tp, None),
+            "router": P(pp, None, None),
+        })
+    else:
+        specs["layers"].update({
+            "w_gate": P(pp, None, tp),
+            "w_up": P(pp, None, tp),
+            "w_down": P(pp, tp, None),
+        })
+    if cfg.attn_bias:
+        specs["layers"].update({
+            "bq": P(pp, tp),
+            "bk": P(pp, kv_tp),
+            "bv": P(pp, kv_tp),
+        })
     if not cfg.tie_embeddings:
         specs["lm_head"] = P(tp, None)
     if params is not None:
@@ -80,7 +101,9 @@ def _expand_quantized(specs: dict[str, Any], leaves: dict[str, Any]) -> None:
     for name, leaf in leaves.items():
         spec = specs.get(name)
         if is_quantized(leaf) and isinstance(spec, P):
-            specs[name] = {"q": spec, "s": P(spec[0], spec[-1])}
+            # scale shape = weight shape minus the input (second-to-last)
+            # axis: [L, in, out] -> [L, out]; MoE [L, E, in, out] -> [L, E, out]
+            specs[name] = {"q": spec, "s": P(*spec[:-2], spec[-1])}
 
 
 def param_shardings(

@@ -1,0 +1,255 @@
+"""Model-family oracle tests: sliding window (mistral), attention biases
+(qwen2), and sparse MoE (mixtral) — the reference's other engine-profile
+families (/root/reference/profiles/tensorrt-llm/{mistral-7b,codellama-7b}.yaml
+and the MoE/EP axis the TPU build adds on top).
+
+Each architecture axis gets a mathematical oracle, not a smoke test:
+- window: cached decode == full forward; the window provably binds.
+- bias: zero biases reproduce the bias-free model exactly.
+- MoE: identical experts == dense SwiGLU (gates sum to 1, so routing must
+  cancel); capacity drops degrade gracefully; EP-sharded == unsharded.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kserve_vllm_mini_tpu.models.config import get_config
+from kserve_vllm_mini_tpu.models.llama import (
+    forward,
+    init_kv_cache,
+    init_params,
+)
+
+
+def _tok_pos(cfg, B, T, seed=1):
+    toks = jax.random.randint(jax.random.PRNGKey(seed), (B, T), 0, cfg.vocab_size)
+    pos = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+    return toks, pos
+
+
+# ---------------------------------------------------------------- mistral --
+
+def test_sliding_window_binds():
+    """With T > window, windowed logits must differ from full-causal logits
+    (the mask actually cuts context), and dropping the window reproduces
+    llama-tiny exactly (same weights, same math when the window is off)."""
+    cfg = get_config("mistral-tiny")          # window = 16
+    T = 48
+    p = init_params(jax.random.PRNGKey(0), cfg)
+    toks, pos = _tok_pos(cfg, 2, T)
+    lg_win, _ = forward(p, cfg, toks, pos)
+    lg_full, _ = forward(p, cfg.scaled(sliding_window=None), toks, pos)
+    # positions < window see identical context -> identical logits
+    np.testing.assert_allclose(
+        np.asarray(lg_win[:, : cfg.sliding_window]),
+        np.asarray(lg_full[:, : cfg.sliding_window]),
+        rtol=1e-5, atol=1e-5,
+    )
+    # beyond the window the mask must change the result
+    assert not np.allclose(
+        np.asarray(lg_win[:, -1]), np.asarray(lg_full[:, -1]), atol=1e-4
+    )
+
+
+def test_sliding_window_cached_decode_matches_full_forward():
+    """Prefill+decode through the cache reproduces the cache-free windowed
+    forward position-for-position (the cached mask applies the same window
+    against absolute cache slots)."""
+    cfg = get_config("mistral-tiny")
+    T, steps = 24, 8                          # crosses the 16-token window
+    p = init_params(jax.random.PRNGKey(0), cfg)
+    total = T + steps
+    toks, pos = _tok_pos(cfg, 1, total)
+    ref, _ = forward(p, cfg, toks, pos)       # full windowed forward
+
+    cache = init_kv_cache(cfg, 1, max_seq=64)
+    _, cache = forward(
+        p, cfg, toks[:, :T], pos[:, :T], cache,
+        jnp.zeros((1,), jnp.int32), fresh_prefill=True,
+    )
+    for i in range(steps):
+        t = T + i
+        lg, cache = forward(
+            p, cfg, toks[:, t : t + 1], pos[:, t : t + 1],
+            cache, jnp.full((1,), t, jnp.int32),
+        )
+        np.testing.assert_allclose(
+            np.asarray(lg[0, 0]), np.asarray(ref[0, t]), rtol=2e-2, atol=2e-2
+        )
+
+
+def test_windowed_prefill_beyond_window_uses_masked_path():
+    """fresh_prefill with T > window must still be windowed-exact (the flash
+    kernel is block-causal only; forward must fall back to the masked path)."""
+    cfg = get_config("mistral-tiny")
+    T = 32                                    # > window=16
+    p = init_params(jax.random.PRNGKey(0), cfg)
+    toks, pos = _tok_pos(cfg, 2, T)
+    ref, _ = forward(p, cfg, toks, pos)
+    cache = init_kv_cache(cfg, 2, max_seq=64)
+    lg, _ = forward(
+        p, cfg, toks, pos, cache, jnp.zeros((2,), jnp.int32), fresh_prefill=True
+    )
+    np.testing.assert_allclose(np.asarray(lg), np.asarray(ref), rtol=2e-2, atol=2e-2)
+
+
+def test_ring_attention_rejects_window():
+    cfg = get_config("mistral-tiny")
+    p = init_params(jax.random.PRNGKey(0), cfg)
+    toks, pos = _tok_pos(cfg, 1, 16)
+    with pytest.raises(ValueError, match="sliding-window"):
+        forward(p, cfg, toks, pos, attention_fn=lambda q, k, v, pp: q)
+
+
+# ------------------------------------------------------------------ qwen2 --
+
+def test_qwen_zero_bias_equals_no_bias():
+    """Init biases are zero, so qwen-tiny must reproduce the identical
+    bias-free architecture bit-for-bit; a nonzero bias must change logits."""
+    cfg = get_config("qwen-tiny")
+    p = init_params(jax.random.PRNGKey(0), cfg)
+    toks, pos = _tok_pos(cfg, 2, 16)
+    lg_bias, _ = forward(p, cfg, toks, pos)
+
+    cfg_nb = cfg.scaled(attn_bias=False)
+    p_nb = {k: v for k, v in p.items()}
+    p_nb["layers"] = {k: v for k, v in p["layers"].items() if k not in ("bq", "bk", "bv")}
+    lg_nb, _ = forward(p_nb, cfg_nb, toks, pos)
+    np.testing.assert_array_equal(np.asarray(lg_bias), np.asarray(lg_nb))
+
+    p2 = dict(p)
+    p2["layers"] = dict(p["layers"])
+    p2["layers"]["bq"] = jnp.ones_like(p["layers"]["bq"]) * 0.5
+    lg2, _ = forward(p2, cfg, toks, pos)
+    assert not np.allclose(np.asarray(lg2), np.asarray(lg_bias), atol=1e-4)
+
+
+# ---------------------------------------------------------------- mixtral --
+
+def test_moe_identical_experts_equals_dense():
+    """When every expert holds the same weights, top-k routing with
+    renormalized gates must reproduce the dense SwiGLU MLP (whatever the
+    router picks, the result is the same expert output weighted by gates
+    summing to 1)."""
+    cfg = get_config("mixtral-tiny")
+    p = init_params(jax.random.PRNGKey(0), cfg)
+    # copy expert 0 into all experts, each layer
+    layers = dict(p["layers"])
+    for name in ("w_gate", "w_up", "w_down"):
+        w = layers[name]                       # [L, E, in, out]
+        layers[name] = jnp.broadcast_to(w[:, :1], w.shape)
+    p_same = dict(p, layers=layers)
+
+    dense_cfg = get_config("llama-tiny").scaled(
+        vocab_size=cfg.vocab_size, d_ff=cfg.d_ff, max_seq_len=cfg.max_seq_len,
+        rope_theta=cfg.rope_theta,
+    )
+    dense_layers = {
+        k: (v[:, 0] if k in ("w_gate", "w_up", "w_down") else v)
+        for k, v in layers.items()
+        if k != "router"
+    }
+    p_dense = dict(p_same, layers=dense_layers)
+
+    toks, pos = _tok_pos(cfg, 2, 16)
+    lg_moe, _ = forward(p_same, cfg, toks, pos)
+    lg_dense, _ = forward(p_dense, dense_cfg, toks, pos)
+    np.testing.assert_allclose(
+        np.asarray(lg_moe), np.asarray(lg_dense), rtol=2e-2, atol=2e-2
+    )
+
+
+def test_moe_capacity_drop_is_graceful():
+    """A starved capacity factor must drop tokens (output changes) but stay
+    finite — the residual passes through for dropped assignments."""
+    cfg = get_config("mixtral-tiny")
+    p = init_params(jax.random.PRNGKey(0), cfg)
+    toks, pos = _tok_pos(cfg, 2, 32)
+    lg_ample, _ = forward(p, cfg.scaled(expert_capacity_factor=8.0), toks, pos)
+    lg_tight, _ = forward(p, cfg.scaled(expert_capacity_factor=0.25), toks, pos)
+    assert bool(jnp.isfinite(lg_tight).all())
+    assert not np.allclose(np.asarray(lg_ample), np.asarray(lg_tight), atol=1e-5)
+
+
+def test_moe_ample_capacity_invariant():
+    """Raising an already-ample capacity must not change the result (no
+    token is ever dropped, so buffers only gain unused rows)."""
+    cfg = get_config("mixtral-tiny")
+    p = init_params(jax.random.PRNGKey(0), cfg)
+    toks, pos = _tok_pos(cfg, 2, 16)
+    a, _ = forward(p, cfg.scaled(expert_capacity_factor=4.0), toks, pos)
+    b, _ = forward(p, cfg.scaled(expert_capacity_factor=9.0), toks, pos)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5)
+
+
+def test_moe_ep_sharded_matches_unsharded():
+    """Expert-parallel sharding over the ``ep`` mesh axis must be a pure
+    layout change: logits equal to the single-device run."""
+    from kserve_vllm_mini_tpu.parallel.mesh import MeshSpec, make_mesh
+    from kserve_vllm_mini_tpu.parallel.sharding import shard_params
+
+    cfg = get_config("mixtral-tiny")
+    p = init_params(jax.random.PRNGKey(0), cfg)
+    toks, pos = _tok_pos(cfg, 4, 16)
+    ref, _ = forward(p, cfg, toks, pos)
+
+    mesh = make_mesh(MeshSpec(dp=2, ep=2, tp=2))
+    p_sharded = shard_params(p, cfg, mesh)
+    lg, _ = jax.jit(lambda pp, t, ps: forward(pp, cfg, t, ps))(p_sharded, toks, pos)
+    np.testing.assert_allclose(np.asarray(lg), np.asarray(ref), rtol=2e-2, atol=2e-2)
+
+
+def test_moe_quantized_init_runs():
+    from kserve_vllm_mini_tpu.models.llama import init_params_quantized
+
+    cfg = get_config("mixtral-tiny")
+    pq = init_params_quantized(jax.random.PRNGKey(0), cfg)
+    # router must stay full precision; experts must be int8
+    assert pq["layers"]["router"].dtype == cfg.jnp_dtype
+    assert pq["layers"]["w_gate"]["q"].dtype == jnp.int8
+    toks, pos = _tok_pos(cfg, 2, 16)
+    lg, _ = forward(pq, cfg, toks, pos)
+    assert bool(jnp.isfinite(lg).all())
+
+
+# -------------------------------------------------------------- codellama --
+
+def test_codellama_preset_is_llama2_shaped():
+    cfg = get_config("codellama-7b")
+    assert cfg.n_kv_heads == cfg.n_heads        # MHA
+    assert cfg.rope_theta == 1_000_000.0
+    assert cfg.vocab_size == 32_016
+
+
+# ------------------------------------------------------------ loader maps --
+
+def test_loader_roundtrip_new_families(tmp_path):
+    """save_checkpoint -> load_hf_checkpoint is the identity for each new
+    family (bias, window, and MoE leaves all survive the HF name mapping)."""
+    from kserve_vllm_mini_tpu.models.loader import load_hf_checkpoint, save_checkpoint
+
+    for name in ("mistral-tiny", "qwen-tiny", "mixtral-tiny"):
+        cfg = get_config(name)
+        p = init_params(jax.random.PRNGKey(3), cfg)
+        if cfg.attn_bias:  # exercise nonzero biases through the roundtrip
+            p["layers"]["bq"] = p["layers"]["bq"] + 0.25
+        out = tmp_path / name
+        save_checkpoint(p, cfg, out)
+        p2, cfg2 = load_hf_checkpoint(out)
+        assert cfg2.sliding_window == cfg.sliding_window
+        assert cfg2.attn_bias == cfg.attn_bias
+        assert cfg2.n_experts == cfg.n_experts
+        for path, leaf in jax.tree_util.tree_leaves_with_path(p):
+            leaf2 = p2
+            for k in path:
+                leaf2 = leaf2[k.key]
+            np.testing.assert_allclose(
+                np.asarray(leaf, dtype=np.float32),
+                np.asarray(leaf2, dtype=np.float32),
+                rtol=1e-2, atol=1e-2,
+                err_msg=f"{name}: {path}",
+            )
