@@ -80,15 +80,16 @@ def make_local_bench(
 
                 model = profile.get("model", "default")
                 results.update(evaluate(srv.url, model=model))
-                if cfg.get("decoding", "greedy") == "greedy":
-                    cap = capture_outputs(srv.url, model=model)
-                    if _is_baseline(cfg):
-                        ref_capture["outputs"] = cap
-                    if "outputs" in ref_capture:
-                        results.update(
-                            fidelity_metrics(ref_capture["outputs"], cap)
-                        )
-                        results["fidelity_reference"] = "none/model/greedy"
+                # the capture sends temperature=0 per request, so it is
+                # greedy regardless of the config's load-test decoding —
+                # every row gets a fidelity score for its quantization
+                # (run_quantization orders the baseline config first)
+                cap = capture_outputs(srv.url, model=model)
+                if _is_baseline(cfg):
+                    ref_capture["outputs"] = cap
+                if "outputs" in ref_capture:
+                    results.update(fidelity_metrics(ref_capture["outputs"], cap))
+                    results["fidelity_reference"] = "none/model/greedy"
         return results
 
     return bench
@@ -119,6 +120,16 @@ def run_quantization(
 
     space = space or DEFAULT_SPACE
     configs = base.grid_product(space)
+    # the unquantized greedy baseline must bench before any row that wants a
+    # fidelity score against it; stable sort keeps the rest in grid order
+    def _baseline_first(cfg: dict[str, Any]) -> int:
+        return 0 if (
+            cfg.get("quantization") == "none"
+            and cfg.get("kv_cache_dtype", "model") == "model"
+            and cfg.get("decoding", "greedy") == "greedy"
+        ) else 1
+
+    configs = sorted(configs, key=_baseline_first)
     bench = bench_fn or make_local_bench(base_profile, with_quality=with_quality)
     out_dir = Path(out_dir)
     csv_path = out_dir / "quant_sweep.csv"
