@@ -12,7 +12,10 @@ orthogonal architecture axes extend it to the other families:
 - ``sliding_window`` — Mistral-style windowed attention;
 - ``attn_bias`` — Qwen2-style q/k/v projection biases;
 - ``n_experts`` / ``n_experts_per_tok`` — Mixtral-style sparse MoE MLP
-  (models/moe.py), sharded over the mesh's ``ep`` axis.
+  (models/moe.py), sharded over the mesh's ``ep`` axis;
+- ``block="phi"`` — Phi-2-style parallel attention+MLP block: one
+  LayerNorm (with bias) feeds both attention and a GELU MLP, partial
+  rotary embedding, biases on every projection.
 """
 
 from __future__ import annotations
@@ -52,6 +55,11 @@ class ModelConfig:
     # Dispatch buffer head-room: each expert's token capacity per routed
     # block is ceil(tokens * top_k / n_experts * capacity_factor).
     expert_capacity_factor: float = 2.0
+    # Block style: "llama" (pre-norm attn -> pre-norm SwiGLU, RMSNorm) or
+    # "phi" (parallel attn+MLP off one LayerNorm, GELU MLP, all-bias).
+    block: str = "llama"
+    # Fraction of head_dim that receives rotary embedding (phi-2: 0.4).
+    partial_rotary_factor: float = 1.0
 
     @property
     def head_dim(self) -> int:
@@ -60,6 +68,12 @@ class ModelConfig:
     @property
     def is_moe(self) -> bool:
         return self.n_experts > 0
+
+    @property
+    def rotary_dim(self) -> int:
+        """Even number of head dims receiving RoPE (phi uses a prefix)."""
+        d = int(self.head_dim * self.partial_rotary_factor)
+        return d - (d % 2)
 
     @property
     def jnp_dtype(self):
@@ -72,7 +86,7 @@ class ModelConfig:
         attn = self.d_model * self.d_model + 2 * self.d_model * (
             self.n_kv_heads * self.head_dim
         ) + self.d_model * self.d_model
-        mlp = 3 * self.d_model * self.d_ff
+        mlp = (2 if self.block == "phi" else 3) * self.d_model * self.d_ff
         if self.is_moe:
             mlp = self.n_experts * mlp + self.d_model * self.n_experts
         if self.attn_bias:
@@ -194,6 +208,21 @@ PRESETS: dict[str, ModelConfig] = {
         n_experts=8,
         n_experts_per_tok=2,
     ),
+    "phi-2.7b": ModelConfig(
+        name="phi-2.7b",
+        vocab_size=51_200,
+        d_model=2560,
+        n_layers=32,
+        n_heads=32,
+        n_kv_heads=32,               # MHA
+        d_ff=10_240,
+        max_seq_len=2048,
+        rope_theta=10_000.0,
+        block="phi",
+        partial_rotary_factor=0.4,
+        attn_bias=True,
+        rms_eps=1e-5,
+    ),
     # -- tiny CI variants (CPU in <1s) exercising each architecture axis ----
     "mistral-tiny": ModelConfig(
         name="mistral-tiny",
@@ -217,6 +246,20 @@ PRESETS: dict[str, ModelConfig] = {
         d_ff=256,
         max_seq_len=256,
         rope_theta=10_000.0,
+        attn_bias=True,
+    ),
+    "phi-tiny": ModelConfig(
+        name="phi-tiny",
+        vocab_size=512,
+        d_model=128,
+        n_layers=2,
+        n_heads=4,
+        n_kv_heads=4,
+        d_ff=256,
+        max_seq_len=256,
+        rope_theta=10_000.0,
+        block="phi",
+        partial_rotary_factor=0.5,
         attn_bias=True,
     ),
     "mixtral-tiny": ModelConfig(

@@ -27,7 +27,7 @@ import jax.numpy as jnp
 from kserve_vllm_mini_tpu.models.config import ModelConfig
 from kserve_vllm_mini_tpu.ops.attention import attention
 from kserve_vllm_mini_tpu.ops.quant import linear
-from kserve_vllm_mini_tpu.ops.rmsnorm import rms_norm
+from kserve_vllm_mini_tpu.ops.rmsnorm import layer_norm, rms_norm
 from kserve_vllm_mini_tpu.ops.rope import apply_rope, rope_frequencies
 
 Params = dict[str, Any]
@@ -50,6 +50,13 @@ def _stacked_weight_shapes(cfg: ModelConfig) -> dict[str, tuple[int, ...]]:
         "wv": (cfg.d_model, kvd),
         "wo": (cfg.n_heads * hd, cfg.d_model),
     }
+    if cfg.block == "phi":
+        # phi MLP is fc1/fc2 (up/down) with GELU — no gate projection
+        shapes.update({
+            "w_up": (cfg.d_model, cfg.d_ff),
+            "w_down": (cfg.d_ff, cfg.d_model),
+        })
+        return shapes
     if cfg.is_moe:
         shapes.update({
             "w_gate": (cfg.n_experts, cfg.d_model, cfg.d_ff),
@@ -88,10 +95,15 @@ def _init_impl(rng: jax.Array, cfg: ModelConfig, leaf_fn) -> Params:
     keys = _init_keys(rng, cfg)
     L = cfg.n_layers
 
-    layers: Params = {
-        "attn_norm": jnp.ones((L, cfg.d_model), dtype=dt),
-        "mlp_norm": jnp.ones((L, cfg.d_model), dtype=dt),
-    }
+    layers: Params = {"attn_norm": jnp.ones((L, cfg.d_model), dtype=dt)}
+    if cfg.block == "phi":
+        # one LayerNorm (weight + bias) feeds both branches; biased o/fc
+        layers["attn_norm_b"] = jnp.zeros((L, cfg.d_model), dtype=dt)
+        layers["bo"] = jnp.zeros((L, cfg.d_model), dtype=dt)
+        layers["b_up"] = jnp.zeros((L, cfg.d_ff), dtype=dt)
+        layers["b_down"] = jnp.zeros((L, cfg.d_model), dtype=dt)
+    else:
+        layers["mlp_norm"] = jnp.ones((L, cfg.d_model), dtype=dt)
     for name, shape in _stacked_weight_shapes(cfg).items():
         lkeys = jax.random.split(keys[name], L)
         # the router is accuracy-critical and noise-level bytes — it stays
@@ -113,6 +125,9 @@ def _init_impl(rng: jax.Array, cfg: ModelConfig, leaf_fn) -> Params:
         "layers": layers,
         "final_norm": jnp.ones((cfg.d_model,), dtype=dt),
     }
+    if cfg.block == "phi":
+        params["final_norm_b"] = jnp.zeros((cfg.d_model,), dtype=dt)
+        params["lm_head_b"] = jnp.zeros((cfg.vocab_size,), dtype=dt)
     if not cfg.tie_embeddings:
         params["lm_head"] = _nrm(keys["lm_head"], (cfg.vocab_size, cfg.d_model), dt)
     return params
@@ -231,17 +246,51 @@ def qkv_proj(
     q = q.reshape(B, T, cfg.n_heads, cfg.head_dim).transpose(0, 2, 1, 3)
     k = k.reshape(B, T, cfg.n_kv_heads, cfg.head_dim).transpose(0, 2, 1, 3)
     v = v.reshape(B, T, cfg.n_kv_heads, cfg.head_dim).transpose(0, 2, 1, 3)
+    rd = cfg.rotary_dim
+    if rd < cfg.head_dim:
+        # phi-style partial rotary: RoPE on the first rotary_dim dims, the
+        # rest pass through (cos/sin tables are built at rotary_dim width)
+        q = jnp.concatenate(
+            [apply_rope(q[..., :rd], positions, cos, sin), q[..., rd:]], axis=-1
+        )
+        k = jnp.concatenate(
+            [apply_rope(k[..., :rd], positions, cos, sin), k[..., rd:]], axis=-1
+        )
+        return q, k, v
     return apply_rope(q, positions, cos, sin), apply_rope(k, positions, cos, sin), v
 
 
+def block_norm(p: Params, cfg: ModelConfig, x: jnp.ndarray, name: str) -> jnp.ndarray:
+    """The block's norm: RMSNorm (llama family) or biased LayerNorm (phi)."""
+    if cfg.block == "phi":
+        return layer_norm(x, p[name], p[name + "_b"], cfg.rms_eps)
+    return rms_norm(x, p[name], cfg.rms_eps)
+
+
 def attn_out_and_mlp(
-    p: Params, cfg: ModelConfig, x: jnp.ndarray, o: jnp.ndarray
+    p: Params,
+    cfg: ModelConfig,
+    x: jnp.ndarray,
+    o: jnp.ndarray,
+    h: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
-    """Attention output projection + residual, then SwiGLU MLP + residual
-    (f32 silu accumulation). Shared tail of every layer execution path."""
+    """Layer tail shared by every execution path.
+
+    llama block: attention output projection + residual, then a fresh
+    mlp_norm feeds the SwiGLU (or MoE) MLP + residual.
+    phi block: ``h`` is the single LayerNorm output that already fed
+    attention; the GELU MLP reads the same ``h``, and both branch outputs
+    add to the residual in parallel.
+    """
     B, T, _ = x.shape
     dt = cfg.jnp_dtype
     o = o.transpose(0, 2, 1, 3).reshape(B, T, cfg.n_heads * cfg.head_dim)
+    if cfg.block == "phi":
+        attn_out = linear(o, p["wo"]) + p["bo"]
+        up = linear(h, p["w_up"]) + p["b_up"]
+        act = jax.nn.gelu(up.astype(jnp.float32), approximate=True).astype(dt)
+        mlp_out = linear(act, p["w_down"]) + p["b_down"]
+        return x + attn_out + mlp_out
     x = x + linear(o, p["wo"])
     h = rms_norm(x, p["mlp_norm"], cfg.rms_eps)
     if cfg.is_moe:
@@ -267,7 +316,7 @@ def layer_forward(
     executor (parallel/pipeline.py), so every execution strategy runs the
     same layer math."""
     T = x.shape[1]
-    h = rms_norm(x, p["attn_norm"], cfg.rms_eps)
+    h = block_norm(p, cfg, x, "attn_norm")
     q, k, v = qkv_proj(p, cfg, h, positions, cos, sin)
     if attention_fn is not None:
         o = attention_fn(q, k, v, positions)
@@ -278,7 +327,7 @@ def layer_forward(
         if cfg.sliding_window is not None:
             mask &= kj > qi - cfg.sliding_window
         o = attention(q, k, v, mask[:, None, :, :])
-    return attn_out_and_mlp(p, cfg, x, o)
+    return attn_out_and_mlp(p, cfg, x, o, h)
 
 
 def forward(
@@ -321,7 +370,7 @@ def forward(
         )
     x = params["embed"][tokens]  # [B, T, D] gather
     cos, sin = rope_frequencies(
-        cfg.head_dim, cfg.max_seq_len, cfg.rope_theta, cfg.rope_scaling
+        cfg.rotary_dim, cfg.max_seq_len, cfg.rope_theta, cfg.rope_scaling
     )
 
     use_cache = kv_cache is not None
@@ -368,7 +417,7 @@ def forward(
         def scan_body(carry, layer_xs):
             y0, cache = carry
             p, lidx = layer_xs
-            h = rms_norm(y0, p["attn_norm"], cfg.rms_eps)
+            h = block_norm(p, cfg, y0, "attn_norm")
             q, k, v = qkv_proj(p, cfg, h, positions, cos, sin)
             cache = dict(cache)
             if quantized_kv:
@@ -403,7 +452,7 @@ def forward(
                 k_layer = _read_layer(cache, "k", lidx)
                 v_layer = _read_layer(cache, "v", lidx)
                 o = attention(q, k_layer, v_layer, mask)
-            return (attn_out_and_mlp(p, cfg, y0, o), cache), None
+            return (attn_out_and_mlp(p, cfg, y0, o, h), cache), None
 
         (x, new_cache_dict), _ = jax.lax.scan(
             scan_body,
@@ -419,8 +468,13 @@ def forward(
 
     if logit_index is not None:
         x = x[jnp.arange(B)[:, None], logit_index[:, None]]  # [B, 1, D]
-    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    if cfg.block == "phi":
+        x = layer_norm(x, params["final_norm"], params["final_norm_b"], cfg.rms_eps)
+    else:
+        x = rms_norm(x, params["final_norm"], cfg.rms_eps)
     head = params["embed"] if cfg.tie_embeddings else params["lm_head"]
     logits = (x @ head.T).astype(jnp.float32)
+    if cfg.block == "phi":
+        logits = logits + params["lm_head_b"].astype(jnp.float32)
 
     return logits, new_cache_dict

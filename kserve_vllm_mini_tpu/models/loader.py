@@ -50,6 +50,25 @@ _MOE_EXPERT_MAP = {
     "w_down": "block_sparse_moe.experts.{e}.w2.weight",
 }
 
+# Phi-2 layer names: one LayerNorm, ``dense`` o-projection, fc1/fc2 GELU MLP,
+# biases everywhere. (matrix, transpose?) pairs plus a parallel bias table.
+_PHI_LAYER_MAP = {
+    "attn_norm": ("input_layernorm.weight", False),
+    "attn_norm_b": ("input_layernorm.bias", False),
+    "wq": ("self_attn.q_proj.weight", True),
+    "bq": ("self_attn.q_proj.bias", False),
+    "wk": ("self_attn.k_proj.weight", True),
+    "bk": ("self_attn.k_proj.bias", False),
+    "wv": ("self_attn.v_proj.weight", True),
+    "bv": ("self_attn.v_proj.bias", False),
+    "wo": ("self_attn.dense.weight", True),
+    "bo": ("self_attn.dense.bias", False),
+    "w_up": ("mlp.fc1.weight", True),
+    "b_up": ("mlp.fc1.bias", False),
+    "w_down": ("mlp.fc2.weight", True),
+    "b_down": ("mlp.fc2.bias", False),
+}
+
 
 def config_from_hf(model_dir: str | Path) -> ModelConfig:
     """Derive a ModelConfig from an HF config.json."""
@@ -65,6 +84,7 @@ def config_from_hf(model_dir: str | Path) -> ModelConfig:
             int(rs.get("original_max_position_embeddings", 8192)),
         )
     model_type = hf.get("model_type", "llama")
+    block = "phi" if model_type == "phi" else "llama"
     sliding_window = hf.get("sliding_window")
     # Qwen2 checkpoints ship sliding_window=131072 with
     # use_sliding_window=false — the window is disabled, not huge. A window
@@ -84,12 +104,14 @@ def config_from_hf(model_dir: str | Path) -> ModelConfig:
         d_ff=hf["intermediate_size"],
         max_seq_len=min(hf.get("max_position_embeddings", 4096), 16384),
         rope_theta=float(hf.get("rope_theta", 10_000.0)),
-        rms_eps=float(hf.get("rms_norm_eps", 1e-5)),
+        rms_eps=float(hf.get("rms_norm_eps", hf.get("layer_norm_eps", 1e-5))),
         tie_embeddings=bool(hf.get("tie_word_embeddings", False)),
         sliding_window=int(sliding_window) if sliding_window else None,
-        attn_bias=model_type == "qwen2",
+        attn_bias=model_type in ("qwen2", "phi"),
         n_experts=int(hf.get("num_local_experts", 0)),
         n_experts_per_tok=int(hf.get("num_experts_per_tok", 2)),
+        block=block,
+        partial_rotary_factor=float(hf.get("partial_rotary_factor", 1.0)),
     )
 
 
@@ -161,7 +183,8 @@ def load_hf_checkpoint(
         }
 
     layers: dict[str, Any] = {}
-    for ours, (hf_key, tr) in _LAYER_MAP.items():
+    layer_map = _PHI_LAYER_MAP if cfg.block == "phi" else _LAYER_MAP
+    for ours, (hf_key, tr) in layer_map.items():
         if cfg.is_moe and ours in _MOE_EXPERT_MAP:
             # expert-stacked [L, E, in, out]: per layer, stack the E experts
             tmpl = _MOE_EXPERT_MAP[ours]
@@ -186,17 +209,23 @@ def load_hf_checkpoint(
             conv(f"model.layers.{i}.block_sparse_moe.gate.weight", True)
             for i in range(cfg.n_layers)
         ])
-    if cfg.attn_bias:
+    if cfg.attn_bias and cfg.block != "phi":
         for ours, hf_key in _BIAS_MAP.items():
             layers[ours] = jnp.stack([
                 conv(f"model.layers.{i}.{hf_key}", False) for i in range(cfg.n_layers)
             ])
 
+    final_norm_key = (
+        "model.final_layernorm.weight" if cfg.block == "phi" else "model.norm.weight"
+    )
     params: dict[str, Any] = {
         "embed": conv("model.embed_tokens.weight", False),
         "layers": layers,
-        "final_norm": conv("model.norm.weight", False),
+        "final_norm": conv(final_norm_key, False),
     }
+    if cfg.block == "phi":
+        params["final_norm_b"] = conv("model.final_layernorm.bias", False)
+        params["lm_head_b"] = conv("lm_head.bias", False)
     if not cfg.tie_embeddings:
         params["lm_head"] = conv("lm_head.weight", False)
     return params, cfg
@@ -218,10 +247,16 @@ def save_checkpoint(params: dict[str, Any], cfg: ModelConfig, out_dir: str | Pat
         tensors[name] = np.ascontiguousarray(arr)
 
     put("model.embed_tokens.weight", params["embed"], False)
-    put("model.norm.weight", params["final_norm"], False)
+    if cfg.block == "phi":
+        put("model.final_layernorm.weight", params["final_norm"], False)
+        put("model.final_layernorm.bias", params["final_norm_b"], False)
+        put("lm_head.bias", params["lm_head_b"], False)
+    else:
+        put("model.norm.weight", params["final_norm"], False)
     if "lm_head" in params:
         put("lm_head.weight", params["lm_head"], False)
-    for ours, (hf_key, tr) in _LAYER_MAP.items():
+    layer_map = _PHI_LAYER_MAP if cfg.block == "phi" else _LAYER_MAP
+    for ours, (hf_key, tr) in layer_map.items():
         for i in range(cfg.n_layers):
             if cfg.is_moe and ours in _MOE_EXPERT_MAP:
                 tmpl = _MOE_EXPERT_MAP[ours]
@@ -240,12 +275,14 @@ def save_checkpoint(params: dict[str, Any], cfg: ModelConfig, out_dir: str | Pat
                 params["layers"]["router"][i],
                 True,
             )
-    if cfg.attn_bias:
+    if cfg.attn_bias and cfg.block != "phi":
         for ours, hf_key in _BIAS_MAP.items():
             for i in range(cfg.n_layers):
                 put(f"model.layers.{i}.{hf_key}", params["layers"][ours][i], False)
     save_file(tensors, str(out_dir / "model.safetensors"))
-    if cfg.is_moe:
+    if cfg.block == "phi":
+        model_type = "phi"
+    elif cfg.is_moe:
         model_type = "mixtral"
     elif cfg.attn_bias:
         model_type = "qwen2"
@@ -271,6 +308,9 @@ def save_checkpoint(params: dict[str, Any], cfg: ModelConfig, out_dir: str | Pat
     if cfg.is_moe:
         hf_cfg["num_local_experts"] = cfg.n_experts
         hf_cfg["num_experts_per_tok"] = cfg.n_experts_per_tok
+    if cfg.block == "phi":
+        hf_cfg["partial_rotary_factor"] = cfg.partial_rotary_factor
+        hf_cfg["layer_norm_eps"] = cfg.rms_eps
     if cfg.rope_scaling is not None:
         f_, lo, hi, omax = cfg.rope_scaling
         hf_cfg["rope_scaling"] = {

@@ -100,7 +100,7 @@ def pipeline_loss_fn(
         stage = jax.lax.axis_index("pp")
         positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (mb, T))
         cos, sin = rope_frequencies(
-            cfg.head_dim, cfg.max_seq_len, cfg.rope_theta, cfg.rope_scaling
+            cfg.rotary_dim, cfg.max_seq_len, cfg.rope_theta, cfg.rope_scaling
         )
         layers_local = params["layers"]  # [L/P, ...] — this stage's range only
 
@@ -148,9 +148,16 @@ def pipeline_loss_fn(
             jnp.where(stage == n_pp - 1, outputs, jnp.zeros_like(outputs)), "pp"
         )
         h = outputs.reshape(b, T, cfg.d_model)
-        h = rms_norm(h, params["final_norm"], cfg.rms_eps)
+        if cfg.block == "phi":
+            from kserve_vllm_mini_tpu.ops.rmsnorm import layer_norm
+
+            h = layer_norm(h, params["final_norm"], params["final_norm_b"], cfg.rms_eps)
+        else:
+            h = rms_norm(h, params["final_norm"], cfg.rms_eps)
         head = params["embed"] if cfg.tie_embeddings else params["lm_head"]
         logits = (h @ head.T).astype(jnp.float32)
+        if cfg.block == "phi":
+            logits = logits + params["lm_head_b"].astype(jnp.float32)
         logp = jax.nn.log_softmax(logits, axis=-1)
         nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
         return jax.lax.pmean(jnp.mean(nll), "dp")

@@ -56,11 +56,24 @@ def param_specs(
             "wk": P(pp, None, kv_tp),
             "wv": P(pp, None, kv_tp),
             "wo": P(pp, tp, None),
-            "mlp_norm": P(pp, None),
         },
         "final_norm": P(None),
     }
-    if cfg.is_moe:
+    if cfg.block == "phi":
+        # phi: fc1 column-parallel (bias shards with it), fc2 row-parallel
+        # (output bias replicated, like the o-projection bias); biased norms
+        specs["layers"].update({
+            "attn_norm_b": P(pp, None),
+            "bo": P(pp, None),
+            "w_up": P(pp, None, tp),
+            "b_up": P(pp, tp),
+            "w_down": P(pp, tp, None),
+            "b_down": P(pp, None),
+        })
+        specs["final_norm_b"] = P(None)
+        specs["lm_head_b"] = P(tp)
+    elif cfg.is_moe:
+        specs["layers"]["mlp_norm"] = P(pp, None)
         # expert-parallel: the expert axis shards over ``ep``; inside each
         # expert the FFN is Megatron column/row over ``tp`` exactly like the
         # dense MLP. The router is d_model x E — replicated.
@@ -74,6 +87,7 @@ def param_specs(
         })
     else:
         specs["layers"].update({
+            "mlp_norm": P(pp, None),
             "w_gate": P(pp, None, tp),
             "w_up": P(pp, None, tp),
             "w_down": P(pp, tp, None),

@@ -232,7 +232,7 @@ def test_loader_roundtrip_new_families(tmp_path):
     family (bias, window, and MoE leaves all survive the HF name mapping)."""
     from kserve_vllm_mini_tpu.models.loader import load_hf_checkpoint, save_checkpoint
 
-    for name in ("mistral-tiny", "qwen-tiny", "mixtral-tiny"):
+    for name in ("mistral-tiny", "qwen-tiny", "mixtral-tiny", "phi-tiny"):
         cfg = get_config(name)
         p = init_params(jax.random.PRNGKey(3), cfg)
         if cfg.attn_bias:  # exercise nonzero biases through the roundtrip
@@ -253,3 +253,150 @@ def test_loader_roundtrip_new_families(tmp_path):
                 rtol=1e-2, atol=1e-2,
                 err_msg=f"{name}: {path}",
             )
+
+
+# -------------------------------------------------------------------- phi --
+
+def _naive_phi_layer(pl, cfg, x, cos, sin):
+    """Independent straight-line phi block (no scan, no shared helpers
+    beyond rope): LayerNorm -> {attention, GELU MLP} in parallel -> residual.
+    The oracle the production path must match."""
+    from kserve_vllm_mini_tpu.ops.rope import apply_rope
+
+    B, T, D = x.shape
+    xf = x.astype(jnp.float32)
+    mean = xf.mean(-1, keepdims=True)
+    var = ((xf - mean) ** 2).mean(-1, keepdims=True)
+    h = ((xf - mean) / jnp.sqrt(var + cfg.rms_eps)
+         * pl["attn_norm"].astype(jnp.float32)
+         + pl["attn_norm_b"].astype(jnp.float32)).astype(x.dtype)
+
+    hd, rd = cfg.head_dim, cfg.rotary_dim
+    q = (h @ pl["wq"] + pl["bq"]).reshape(B, T, cfg.n_heads, hd).transpose(0, 2, 1, 3)
+    k = (h @ pl["wk"] + pl["bk"]).reshape(B, T, cfg.n_kv_heads, hd).transpose(0, 2, 1, 3)
+    v = (h @ pl["wv"] + pl["bv"]).reshape(B, T, cfg.n_kv_heads, hd).transpose(0, 2, 1, 3)
+    pos = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+    q = jnp.concatenate([apply_rope(q[..., :rd], pos, cos, sin), q[..., rd:]], -1)
+    k = jnp.concatenate([apply_rope(k[..., :rd], pos, cos, sin), k[..., rd:]], -1)
+
+    logits = jnp.einsum("bhtd,bhsd->bhts", q, k).astype(jnp.float32) * hd ** -0.5
+    mask = jnp.tril(jnp.ones((T, T), bool))
+    logits = jnp.where(mask[None, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, -1).astype(x.dtype)
+    o = jnp.einsum("bhts,bhsd->bhtd", probs, v)
+    o = o.transpose(0, 2, 1, 3).reshape(B, T, D)
+    attn_out = o @ pl["wo"] + pl["bo"]
+
+    up = (h @ pl["w_up"] + pl["b_up"]).astype(jnp.float32)
+    mlp_out = (jax.nn.gelu(up, approximate=True).astype(x.dtype) @ pl["w_down"]
+               + pl["b_down"])
+    return x + attn_out + mlp_out
+
+
+def test_phi_forward_matches_naive_block():
+    """Production forward (scan, shared helpers) == straight-line oracle."""
+    from kserve_vllm_mini_tpu.ops.rope import rope_frequencies
+
+    cfg = get_config("phi-tiny")
+    p = init_params(jax.random.PRNGKey(0), cfg)
+    B, T = 2, 16
+    toks, pos = _tok_pos(cfg, B, T)
+    got, _ = forward(p, cfg, toks, pos)
+
+    cos, sin = rope_frequencies(cfg.rotary_dim, cfg.max_seq_len, cfg.rope_theta)
+    x = p["embed"][toks]
+    for li in range(cfg.n_layers):
+        pl = {k: v[li] for k, v in p["layers"].items()}
+        x = _naive_phi_layer(pl, cfg, x, cos, sin)
+    xf = x.astype(jnp.float32)
+    mean = xf.mean(-1, keepdims=True)
+    var = ((xf - mean) ** 2).mean(-1, keepdims=True)
+    x = ((xf - mean) / jnp.sqrt(var + cfg.rms_eps)
+         * p["final_norm"].astype(jnp.float32)
+         + p["final_norm_b"].astype(jnp.float32)).astype(cfg.jnp_dtype)
+    want = (x @ p["lm_head"].T).astype(jnp.float32) + p["lm_head_b"].astype(jnp.float32)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-2, atol=2e-2)
+
+
+def test_phi_cached_decode_matches_full_forward():
+    cfg = get_config("phi-tiny")
+    T, steps = 16, 6
+    p = init_params(jax.random.PRNGKey(0), cfg)
+    total = T + steps
+    toks, pos = _tok_pos(cfg, 1, total)
+    ref, _ = forward(p, cfg, toks, pos)
+
+    cache = init_kv_cache(cfg, 1, max_seq=64)
+    _, cache = forward(
+        p, cfg, toks[:, :T], pos[:, :T], cache,
+        jnp.zeros((1,), jnp.int32), fresh_prefill=True,
+    )
+    for i in range(steps):
+        t = T + i
+        lg, cache = forward(
+            p, cfg, toks[:, t : t + 1], pos[:, t : t + 1],
+            cache, jnp.full((1,), t, jnp.int32),
+        )
+        np.testing.assert_allclose(
+            np.asarray(lg[0, 0]), np.asarray(ref[0, t]), rtol=2e-2, atol=2e-2
+        )
+
+
+def test_phi_partial_rotary_binds():
+    """partial_rotary_factor must matter: full-rotary logits differ."""
+    cfg = get_config("phi-tiny")                # prf = 0.5
+    p = init_params(jax.random.PRNGKey(0), cfg)
+    toks, pos = _tok_pos(cfg, 2, 16)
+    a, _ = forward(p, cfg, toks, pos)
+    b, _ = forward(p, cfg.scaled(partial_rotary_factor=1.0), toks, pos)
+    assert not np.allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_phi_tp_sharded_matches_unsharded():
+    from kserve_vllm_mini_tpu.parallel.mesh import MeshSpec, make_mesh
+    from kserve_vllm_mini_tpu.parallel.sharding import shard_params
+
+    cfg = get_config("phi-tiny")
+    p = init_params(jax.random.PRNGKey(0), cfg)
+    toks, pos = _tok_pos(cfg, 4, 16)
+    ref, _ = forward(p, cfg, toks, pos)
+    mesh = make_mesh(MeshSpec(dp=2, tp=4))
+    p_sharded = shard_params(p, cfg, mesh)
+    lg, _ = jax.jit(lambda pp, t, ps: forward(pp, cfg, t, ps))(p_sharded, toks, pos)
+    np.testing.assert_allclose(np.asarray(lg), np.asarray(ref), rtol=2e-2, atol=2e-2)
+
+
+def test_phi_quantized_init_runs():
+    from kserve_vllm_mini_tpu.models.llama import init_params_quantized
+
+    cfg = get_config("phi-tiny")
+    pq = init_params_quantized(jax.random.PRNGKey(0), cfg)
+    assert pq["layers"]["w_up"]["q"].dtype == jnp.int8
+    toks, pos = _tok_pos(cfg, 2, 16)
+    lg, _ = forward(pq, cfg, toks, pos)
+    assert bool(jnp.isfinite(lg).all())
+
+
+def test_phi_pipeline_executor_matches_forward():
+    """The pipelined executor must run the same math as forward() for the
+    phi block too (rotary_dim-width rope tables, biased final LayerNorm,
+    lm_head bias)."""
+    from kserve_vllm_mini_tpu.parallel.mesh import MeshSpec, make_mesh
+    from kserve_vllm_mini_tpu.parallel.pipeline import pipeline_loss_fn
+
+    cfg = get_config("phi-tiny")
+    p = init_params(jax.random.PRNGKey(0), cfg)
+    B, T = 4, 16
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (B, T + 1), 0, cfg.vocab_size)
+
+    mesh = make_mesh(MeshSpec(dp=2, pp=2))
+    loss_pp = pipeline_loss_fn(p, cfg, tokens, mesh, n_microbatches=2)
+
+    inp, tgt = tokens[:, :-1], tokens[:, 1:]
+    pos = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+    logits, _ = forward(p, cfg, inp, pos)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+    np.testing.assert_allclose(
+        float(loss_pp), float(jnp.mean(nll)), rtol=2e-2, atol=2e-2
+    )
