@@ -238,7 +238,16 @@ def main() -> int:
         from kserve_vllm_mini_tpu.runtime.engine import build_spec_step
 
         drafter = os.environ.get("KVMINI_BENCH_DRAFTER", "self")
-        _log(f"spec mode: drafter={drafter} k={spec_k}")
+        # spec runs at its own (smaller) batch: it needs TWO caches (target
+        # + drafter) resident at once, which at the 64-slot headline default
+        # plus the int8 8B weights exceeds the v5e's 16 GB. The headline
+        # caches are dropped first; speedup math is per-slot-normalized, so
+        # the slot count only needs to match between the spec rounds and the
+        # served-style comparison below.
+        s_slots = min(slots, 32)
+        cache = cache1 = None  # free the headline caches (4.3 GB at 64 slots)
+        toks_s, pos_s = toks[:s_slots], pos[:s_slots]
+        _log(f"spec mode: drafter={drafter} k={spec_k} slots={s_slots}")
         if drafter == "self":
             dcfg, dparams = cfg, params
         else:
@@ -249,24 +258,67 @@ def main() -> int:
                 init_params_quantized if quant == "int8" else init_params
             )(jax.random.PRNGKey(3), dcfg)
 
-        t_cache, last = prefill_batch(
-            params, init_kv_cache(cfg, slots, max_seq=max_seq, quantized=kv_quant),
-            toks, pos,
+        @partial(jax.jit, donate_argnums=(1,))
+        def sprefill(p, c, t, pp):
+            lg, c2 = forward(p, cfg, t, pp, c, jnp.zeros((s_slots,), jnp.int32),
+                             fresh_prefill=True,
+                             logit_index=jnp.full((s_slots,), prompt_len - 1, jnp.int32))
+            return c2, jnp.argmax(lg[:, -1, :], axis=-1).astype(jnp.int32)
+
+        @partial(jax.jit, donate_argnums=(1,))
+        def sdecode(p, c, tokens, lengths, rng):
+            logits, c = forward(p, cfg, tokens[:, None], lengths[:, None], c, lengths)
+            nxt = sample_tokens(
+                logits[:, 0, :], rng,
+                jnp.zeros((s_slots,), jnp.float32),
+                jnp.zeros((s_slots,), jnp.int32),
+                jnp.ones((s_slots,), jnp.float32),
+            )
+            return c, nxt
+
+        # comparability: the headline t_step is RTT-cancelled by chained-run
+        # differencing, but a spec round inherently pays one host readback
+        # (the next round's `last` depends on emit). Measure a served-style
+        # plain step — one readback per step, like the engine's sweep — so
+        # the spec comparison is methodology-consistent. Runs BEFORE the two
+        # spec caches exist so at most two s_slots caches are ever resident.
+        lengths_p = jnp.full((s_slots,), prompt_len, dtype=jnp.int32)
+        cache_p = init_kv_cache(cfg, s_slots, max_seq=max_seq, quantized=kv_quant)
+        cache_p, toks_p = sprefill(params, cache_p, toks_s, pos_s)
+        rng_p = jax.random.PRNGKey(9)
+        for _ in range(4):  # warm
+            rng_p, sub_p = jax.random.split(rng_p)
+            cache_p, toks_p = sdecode(params, cache_p, toks_p, lengths_p, sub_p)
+            _ = np.asarray(toks_p)
+            lengths_p = lengths_p + 1
+        n_served = 16
+        t0 = time.time()
+        for _ in range(n_served):
+            rng_p, sub_p = jax.random.split(rng_p)
+            cache_p, toks_p = sdecode(params, cache_p, toks_p, lengths_p, sub_p)
+            _ = np.asarray(toks_p)  # per-step readback, like a serving sweep
+            lengths_p = lengths_p + 1
+        t_step_served = max(time.time() - t0, 1e-9) / n_served
+        cache_p = None  # make room for the drafter cache
+
+        t_cache, last = sprefill(
+            params, init_kv_cache(cfg, s_slots, max_seq=max_seq, quantized=kv_quant),
+            toks_s, pos_s,
         )
 
         @partial(jax.jit, donate_argnums=(1,))
         def dprefill(p, c, t, pp):
-            _, c2 = forward(p, dcfg, t, pp, c, jnp.zeros((slots,), jnp.int32),
+            _, c2 = forward(p, dcfg, t, pp, c, jnp.zeros((s_slots,), jnp.int32),
                             fresh_prefill=True,
-                            logit_index=jnp.full((slots,), prompt_len - 1, jnp.int32))
+                            logit_index=jnp.full((s_slots,), prompt_len - 1, jnp.int32))
             return c2
 
         d_cache = dprefill(
-            dparams, init_kv_cache(dcfg, slots, max_seq=max_seq, quantized=kv_quant),
-            toks, pos,
+            dparams, init_kv_cache(dcfg, s_slots, max_seq=max_seq, quantized=kv_quant),
+            toks_s, pos_s,
         )
         spec = build_spec_step(cfg, dcfg, spec_k)
-        lengths_h = np.full((slots,), prompt_len, dtype=np.int64)
+        lengths_h = np.full((s_slots,), prompt_len, dtype=np.int64)
 
         def spec_rounds(n, t_cache, d_cache, last, lengths_h):
             emitted = accepted = 0
@@ -280,32 +332,9 @@ def main() -> int:
                 emitted += int(cnt.sum())
                 accepted += int(np.maximum(cnt - 1, 0).sum())
                 idx = np.clip(cnt - 1, 0, spec_k - 1)
-                last = jnp.asarray(eh[np.arange(slots), idx].astype(np.int32))
+                last = jnp.asarray(eh[np.arange(s_slots), idx].astype(np.int32))
                 lengths_h = lengths_h + cnt
             return t_cache, d_cache, last, lengths_h, emitted, accepted
-
-        # comparability: the headline t_step is RTT-cancelled by chained-run
-        # differencing, but a spec round inherently pays one host readback
-        # (the next round's `last` depends on emit). Measure a served-style
-        # plain step — one readback per step, like the engine's sweep — so
-        # the spec comparison is methodology-consistent.
-        lengths_p = jnp.full((slots,), prompt_len, dtype=jnp.int32)
-        cache_p = init_kv_cache(cfg, slots, max_seq=max_seq, quantized=kv_quant)
-        cache_p, toks_p = prefill_batch(params, cache_p, toks, pos)
-        rng_p = jax.random.PRNGKey(9)
-        for _ in range(4):  # warm
-            rng_p, sub_p = jax.random.split(rng_p)
-            cache_p, toks_p = decode(params, cache_p, toks_p, lengths_p, sub_p)
-            _ = np.asarray(toks_p)
-            lengths_p = lengths_p + 1
-        n_served = 16
-        t0 = time.time()
-        for _ in range(n_served):
-            rng_p, sub_p = jax.random.split(rng_p)
-            cache_p, toks_p = decode(params, cache_p, toks_p, lengths_p, sub_p)
-            _ = np.asarray(toks_p)  # per-step readback, like a serving sweep
-            lengths_p = lengths_p + 1
-        t_step_served = max(time.time() - t0, 1e-9) / n_served
 
         max_rounds = max((max_seq - 1 - prompt_len - 8) // spec_k, 8)
         n_warm, n_meas = 3, min(24, max_rounds - 3)
@@ -319,7 +348,7 @@ def main() -> int:
         )
         dt_spec = max(time.time() - t0, 1e-9)
         spec_tps = emitted / dt_spec
-        proposed = n_meas * (spec_k - 1) * slots
+        proposed = n_meas * (spec_k - 1) * s_slots
         t_round = dt_spec / n_meas
         # speedup is a function of the acceptance rate α: a round costs
         # t_round and emits (k-1)α + 1 tokens/slot vs 1 per served step.
@@ -334,10 +363,11 @@ def main() -> int:
         spec_detail = {
             "drafter": drafter,
             "spec_tokens": spec_k,
+            "slots": s_slots,
             "accept_ratio": round(accepted / proposed, 4) if proposed else 1.0,
             "tokens_per_sec_per_chip": round(spec_tps / n_chips, 1),
             "speedup_vs_served_measured": round(
-                spec_tps / (slots / t_step_served), 3
+                spec_tps / (s_slots / t_step_served), 3
             ),
             "round_ms": round(t_round * 1000.0, 3),
             "served_step_ms": round(t_step_served * 1000.0, 3),

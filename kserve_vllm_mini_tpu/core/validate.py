@@ -107,17 +107,16 @@ def validate_profile(
     elif quant not in TPU_QUANT_OK:
         rep.warnings.append(f"unrecognized quantization '{quant}'; proceeding unvalidated")
 
-    # pipeline parallelism is a TRAINING mechanism in this framework
-    # (parallel/pipeline.py GPipe executor); the serving engine decodes with
-    # tp/dp/sp shardings only. Reject pp>1 serving configs up front instead
-    # of letting parallel/sharding.py raise mid-deploy (round-2 VERDICT
-    # Weak #3: scope the claim explicitly).
+    # serving pipeline parallelism: layer-range stages via
+    # parallel/serving_pp.py (pp-pure meshes). pp x tp is not composed —
+    # reject that combination up front instead of letting
+    # parallel/sharding.py raise mid-deploy.
     par = profile.get("parallelism") or {}
-    if int(par.get("pp", 1) or 1) > 1:
+    pp = int(par.get("pp", 1) or 1)
+    if pp > 1 and int(par.get("tp", 1) or 1) > 1:
         rep.errors.append(
-            "pp > 1 is training-only (parallel/pipeline.py GPipe executor); "
-            "the serving engine shards tp/dp/sp — see docs/TOPOLOGY.md "
-            "'Pipeline parallelism'"
+            "pp > 1 composes with dp only (parallel/serving_pp.py layer-range "
+            "stages); set tp=1 — see docs/TOPOLOGY.md 'Pipeline parallelism'"
         )
 
     topology = profile.get("topology")

@@ -330,6 +330,130 @@ def layer_forward(
     return attn_out_and_mlp(p, cfg, x, o, h)
 
 
+def run_cached_layers(
+    layers: Params,              # stacked per-layer tree, leading axis = L (or a
+                                 # local L/pp range under the pipeline executor)
+    cfg: ModelConfig,
+    x: jnp.ndarray,              # [B, T, D] embedded input
+    positions: jnp.ndarray,      # [B, T] int32 absolute positions
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+    kv_cache: KVCache,           # leading axis matches ``layers``
+    cache_offsets: jnp.ndarray,  # [B]
+    fresh_prefill: bool = False,
+    write_gate: Optional[jnp.ndarray] = None,  # scalar bool: when False, the
+                                 # cache write is a no-op (old values are
+                                 # gathered and written back) — lets the
+                                 # SPMD pipeline executor run every stage
+                                 # every tick without corrupting inactive
+                                 # stages' caches (parallel/serving_pp.py)
+) -> tuple[jnp.ndarray, KVCache]:
+    """The cached transformer stack: scan over stacked layers, writing this
+    block's K/V at ``cache_offsets`` and attending with positional masking
+    (or block-causal flash when ``fresh_prefill``). Shared by ``forward``
+    and the serving pipeline executor so both run identical layer math.
+
+    Cache-performance invariants (measured on llama-1b @ v5e; breaking
+    either regresses decode by the full cache size in HBM traffic):
+    1. The cache rides the scan CARRY — XLA aliases loop-carried buffers in
+       place. Routing it through scan xs/ys stacks fresh outputs, i.e.
+       copies the ENTIRE cache every forward call.
+    2. New keys/values land via an indexed scatter (.at[...].set) that
+       touches only [B, KVH, T, D] elements — extracting a layer, patching
+       it, and writing the whole layer back rewrites the full layer per
+       step instead.
+    """
+    B, T = positions.shape
+    dt = cfg.jnp_dtype
+    n_local = kv_cache["k"].shape[0]
+    quantized_kv = "k_s" in kv_cache  # static: selects the int8 path
+    s = kv_cache["k"].shape[3]
+    kj = jnp.arange(s)[None, None, :]
+    qi = positions[:, :, None]
+    mask = kj <= qi
+    if cfg.sliding_window is not None:
+        # Mistral-style window: key j valid iff p - W < j <= p. Cache
+        # slots are absolute positions, so the window is a second bound
+        # on the same positional mask.
+        mask &= kj > qi - cfg.sliding_window
+    mask = mask[:, None, :, :]                               # [B, 1, T, S]
+    b_idx = jnp.arange(B)[:, None, None]                     # [B, 1, 1]
+    h_idx = jnp.arange(cfg.n_kv_heads)[None, :, None]        # [1, KVH, 1]
+    t_idx = cache_offsets[:, None, None] + jnp.arange(T)[None, None, :]  # [B, 1, T]
+
+    def _gate(cache, name, lidx, new):
+        """Value actually scattered: ``new``, or — when write_gate is False —
+        the existing values at the same indices (a same-size gather, so the
+        no-op write stays O(B*KVH*T*D), never a full-cache select)."""
+        if write_gate is None:
+            return new
+        # broadcasting yields [B,KVH,T,D] for values, [B,KVH,T] for scales
+        old = cache[name][lidx, b_idx, h_idx, t_idx]
+        return jnp.where(write_gate, new, old.astype(new.dtype))
+
+    def _read_layer(cache, name, lidx):
+        vals = jax.lax.dynamic_index_in_dim(cache[name], lidx, axis=0, keepdims=False)
+        if quantized_kv:
+            sc = jax.lax.dynamic_index_in_dim(
+                cache[name + "_s"], lidx, axis=0, keepdims=False
+            )
+            # dequantize on read: halves the HBM stream vs bf16 and the
+            # multiply fuses into the attention matmul's prologue
+            return vals.astype(dt) * sc.astype(dt)[..., None]
+        return vals.astype(dt)
+
+    def scan_body(carry, layer_xs):
+        y0, cache = carry
+        p, lidx = layer_xs
+        h = block_norm(p, cfg, y0, "attn_norm")
+        q, k, v = qkv_proj(p, cfg, h, positions, cos, sin)
+        cache = dict(cache)
+        if quantized_kv:
+            kq, ks = _quantize_kv_block(k)
+            vq, vs = _quantize_kv_block(v)
+            idx_s = (lidx, b_idx, h_idx, t_idx)
+            cache["k"] = cache["k"].at[lidx, b_idx, h_idx, t_idx].set(
+                _gate(cache, "k", lidx, kq)
+            )
+            cache["v"] = cache["v"].at[lidx, b_idx, h_idx, t_idx].set(
+                _gate(cache, "v", lidx, vq)
+            )
+            cache["k_s"] = cache["k_s"].at[idx_s].set(_gate(cache, "k_s", lidx, ks))
+            cache["v_s"] = cache["v_s"].at[idx_s].set(_gate(cache, "v_s", lidx, vs))
+        else:
+            cache["k"] = cache["k"].at[lidx, b_idx, h_idx, t_idx].set(
+                _gate(cache, "k", lidx, k.astype(cache["k"].dtype))
+            )
+            cache["v"] = cache["v"].at[lidx, b_idx, h_idx, t_idx].set(
+                _gate(cache, "v", lidx, v.astype(cache["v"].dtype))
+            )
+        if fresh_prefill:
+            # block-causal flash over the fresh block is exact for a
+            # windowed model too as long as T <= window (every causal
+            # key is inside the window); longer prefills take the masked
+            # jnp path. T is static, so this is a trace-time branch.
+            if cfg.sliding_window is not None and T > cfg.sliding_window:
+                fj = jnp.arange(T)[None, None, :]
+                fmask = (fj <= qi) & (fj > qi - cfg.sliding_window)
+                o = attention(q, k, v, fmask[:, None, :, :])
+            else:
+                from kserve_vllm_mini_tpu.ops.flash_attention import prefill_attention
+
+                o = prefill_attention(q, k, v)
+        else:
+            k_layer = _read_layer(cache, "k", lidx)
+            v_layer = _read_layer(cache, "v", lidx)
+            o = attention(q, k_layer, v_layer, mask)
+        return (attn_out_and_mlp(p, cfg, y0, o, h), cache), None
+
+    (x, new_cache), _ = jax.lax.scan(
+        scan_body,
+        (x, dict(kv_cache)),
+        (layers, jnp.arange(n_local)),
+    )
+    return x, new_cache
+
+
 def forward(
     params: Params,
     cfg: ModelConfig,
@@ -379,85 +503,9 @@ def forward(
 
     layers = params["layers"]
     if use_cache:
-        # Cache-performance invariants (measured on llama-1b @ v5e; breaking
-        # either regresses decode by the full cache size in HBM traffic):
-        # 1. The cache rides the scan CARRY — XLA aliases loop-carried
-        #    buffers in place. Routing it through scan xs/ys stacks fresh
-        #    outputs, i.e. copies the ENTIRE cache every forward call.
-        # 2. New keys/values land via an indexed scatter (.at[...].set) that
-        #    touches only [B, KVH, T, D] elements — extracting a layer,
-        #    patching it, and writing the whole layer back rewrites the full
-        #    layer per step instead.
-        quantized_kv = "k_s" in kv_cache  # static: selects the int8 path
-        s = kv_cache["k"].shape[3]
-        kj = jnp.arange(s)[None, None, :]
-        qi = positions[:, :, None]
-        mask = kj <= qi
-        if cfg.sliding_window is not None:
-            # Mistral-style window: key j valid iff p - W < j <= p. Cache
-            # slots are absolute positions, so the window is a second bound
-            # on the same positional mask.
-            mask &= kj > qi - cfg.sliding_window
-        mask = mask[:, None, :, :]                               # [B, 1, T, S]
-        b_idx = jnp.arange(B)[:, None, None]                     # [B, 1, 1]
-        h_idx = jnp.arange(cfg.n_kv_heads)[None, :, None]        # [1, KVH, 1]
-        t_idx = cache_offsets[:, None, None] + jnp.arange(T)[None, None, :]  # [B, 1, T]
-
-        def _read_layer(cache, name, lidx):
-            vals = jax.lax.dynamic_index_in_dim(cache[name], lidx, axis=0, keepdims=False)
-            if quantized_kv:
-                sc = jax.lax.dynamic_index_in_dim(
-                    cache[name + "_s"], lidx, axis=0, keepdims=False
-                )
-                # dequantize on read: halves the HBM stream vs bf16 and the
-                # multiply fuses into the attention matmul's prologue
-                return vals.astype(dt) * sc.astype(dt)[..., None]
-            return vals.astype(dt)
-
-        def scan_body(carry, layer_xs):
-            y0, cache = carry
-            p, lidx = layer_xs
-            h = block_norm(p, cfg, y0, "attn_norm")
-            q, k, v = qkv_proj(p, cfg, h, positions, cos, sin)
-            cache = dict(cache)
-            if quantized_kv:
-                kq, ks = _quantize_kv_block(k)
-                vq, vs = _quantize_kv_block(v)
-                cache["k"] = cache["k"].at[lidx, b_idx, h_idx, t_idx].set(kq)
-                cache["v"] = cache["v"].at[lidx, b_idx, h_idx, t_idx].set(vq)
-                idx_s = (lidx, b_idx, h_idx, t_idx)
-                cache["k_s"] = cache["k_s"].at[idx_s].set(ks)
-                cache["v_s"] = cache["v_s"].at[idx_s].set(vs)
-            else:
-                cache["k"] = cache["k"].at[lidx, b_idx, h_idx, t_idx].set(
-                    k.astype(cache["k"].dtype)
-                )
-                cache["v"] = cache["v"].at[lidx, b_idx, h_idx, t_idx].set(
-                    v.astype(cache["v"].dtype)
-                )
-            if fresh_prefill:
-                # block-causal flash over the fresh block is exact for a
-                # windowed model too as long as T <= window (every causal
-                # key is inside the window); longer prefills take the masked
-                # jnp path. T is static, so this is a trace-time branch.
-                if cfg.sliding_window is not None and T > cfg.sliding_window:
-                    fj = jnp.arange(T)[None, None, :]
-                    fmask = (fj <= qi) & (fj > qi - cfg.sliding_window)
-                    o = attention(q, k, v, fmask[:, None, :, :])
-                else:
-                    from kserve_vllm_mini_tpu.ops.flash_attention import prefill_attention
-
-                    o = prefill_attention(q, k, v)
-            else:
-                k_layer = _read_layer(cache, "k", lidx)
-                v_layer = _read_layer(cache, "v", lidx)
-                o = attention(q, k_layer, v_layer, mask)
-            return (attn_out_and_mlp(p, cfg, y0, o, h), cache), None
-
-        (x, new_cache_dict), _ = jax.lax.scan(
-            scan_body,
-            (x, dict(kv_cache)),
-            (layers, jnp.arange(cfg.n_layers)),
+        x, new_cache_dict = run_cached_layers(
+            layers, cfg, x, positions, cos, sin, kv_cache, cache_offsets,
+            fresh_prefill=fresh_prefill,
         )
     else:
         def scan_body_nocache(carry, p):

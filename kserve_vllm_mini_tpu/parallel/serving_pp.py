@@ -1,0 +1,174 @@
+"""Serving-side pipeline parallelism: a pp-sharded drop-in for forward().
+
+The reference serves multi-stage models by passing PIPELINE_PARALLEL_SIZE to
+Triton (/root/reference/runners/backends/triton/deploy.sh:84-86); here the
+mechanism is owned end-to-end. parallel/pipeline.py covers training; this
+module covers the *serving* engine: ``make_pp_forward(cfg, mesh)`` returns a
+function with forward()'s exact contract (tokens/positions/cache/offsets/
+fresh_prefill/logit_index -> logits, cache), so every engine path — flash
+prefill, chunked-prefill continuation, fused decode, grammar-masked decode —
+runs over a pp mesh unchanged.
+
+TPU-native design:
+
+- **Layer-range sharding**: params["layers"] and the KV cache shard their
+  leading L axis over ``pp`` (the cache memory — the serving-scale reason
+  for PP — is actually split across stages). Everything else is replicated.
+- **SPMD ring, one ppermute per tick**: inside ``shard_map`` each stage
+  runs its local ``run_cached_layers`` every tick; activations move to the
+  next stage with a single collective-permute. Tick t's compute is real on
+  stage t and garbage elsewhere — the standard SPMD bubble.
+- **Gated cache writes**: inactive ticks must not corrupt a stage's cache,
+  and a full-cache select per tick would copy gigabytes. Instead
+  ``run_cached_layers(write_gate=...)`` gathers the existing values at the
+  scatter indices and writes them back when the stage is inactive — the
+  no-op write stays O(B·KVH·T·D), the same traffic as the real write
+  (models/llama.py).
+- **Latency model**: a P-stage forward costs P stage-times + (P-1) hops.
+  Serving PP buys HBM capacity (each chip holds L/P layers + L/P of the
+  cache), not latency — the validator/docs state this tradeoff.
+
+Composition with other axes: ``shard_map`` runs in full-manual mode over
+every mesh axis, with non-pp axes unused by the specs (size-1 in serving
+topologies this module targets). tp-within-stage composes at the GSPMD
+level instead — run tp=1 per stage here, or use the training executor's
+explicit-collective route; the validator only advertises pp x dp serving
+meshes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from kserve_vllm_mini_tpu.models.config import ModelConfig
+from kserve_vllm_mini_tpu.models.llama import run_cached_layers
+from kserve_vllm_mini_tpu.ops.rmsnorm import layer_norm, rms_norm
+from kserve_vllm_mini_tpu.ops.rope import rope_frequencies
+
+try:  # jax >= 0.8
+    from jax import shard_map
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
+
+
+def _replicated_specs(tree: Any) -> Any:
+    return jax.tree.map(lambda leaf: P(*([None] * jnp.ndim(leaf))), tree)
+
+
+def _pp_param_specs(params: dict[str, Any]) -> dict[str, Any]:
+    """Layer stack over pp, everything else replicated (same shape as
+    pipeline._pipeline_specs, duplicated here to keep the serving module
+    free of the training executor's imports)."""
+    def walk(node, under_layers):
+        if isinstance(node, dict):
+            return {k: walk(v, under_layers or k == "layers") for k, v in node.items()}
+        if under_layers:
+            return P("pp", *([None] * (jnp.ndim(node) - 1)))
+        return P(*([None] * jnp.ndim(node)))
+
+    return {k: walk(v, k == "layers") for k, v in params.items()}
+
+
+def _cache_specs(cache: dict[str, Any]) -> dict[str, Any]:
+    return {k: P("pp", *([None] * (v.ndim - 1))) for k, v in cache.items()}
+
+
+def make_pp_forward(cfg: ModelConfig, mesh: Mesh):
+    """Build a pp-sharded function with models.llama.forward's signature.
+
+    Requires cfg.n_layers % pp == 0. The returned function must be called
+    with a cache (the serving engine always has one) whose leading axis is
+    the full n_layers — shard_map hands each stage its L/pp block.
+    """
+    n_pp = int(mesh.shape["pp"])
+    if cfg.n_layers % n_pp:
+        raise ValueError(f"n_layers={cfg.n_layers} not divisible by pp={n_pp}")
+    perm = [(i, (i + 1) % n_pp) for i in range(n_pp)]
+
+    def pp_forward(
+        params: dict[str, Any],
+        cfg_: ModelConfig,
+        tokens: jnp.ndarray,
+        positions: jnp.ndarray,
+        kv_cache: Optional[dict[str, Any]] = None,
+        cache_offsets: Optional[jnp.ndarray] = None,
+        fresh_prefill: bool = False,
+        logit_index: Optional[jnp.ndarray] = None,
+    ):
+        if cfg_ is not cfg:
+            raise ValueError(
+                "pp_forward was built for one specific config (its rope "
+                "tables and stage split are baked in); got a different cfg"
+            )
+        if kv_cache is None:
+            raise ValueError("pp_forward is the serving executor — cache required "
+                             "(training uses parallel/pipeline.py)")
+        B, T = tokens.shape
+        if cache_offsets is None:
+            cache_offsets = jnp.zeros((B,), dtype=jnp.int32)
+
+        p_specs = _pp_param_specs(params)
+        c_specs = _cache_specs(kv_cache)
+        rep = P(None, None)
+
+        has_li = logit_index is not None
+        li = logit_index if has_li else jnp.zeros((B,), dtype=jnp.int32)
+
+        @jax.jit
+        def run(params, tokens, positions, cache, offsets, li):
+            @shard_map(
+                mesh=mesh,
+                in_specs=(p_specs, rep, rep, c_specs, P(None), P(None)),
+                out_specs=(P(None, None, None), c_specs),
+                check_vma=False,
+            )
+            def inner(params, tokens, positions, cache, offsets, li):
+                stage = jax.lax.axis_index("pp")
+                cos, sin = rope_frequencies(
+                    cfg.rotary_dim, cfg.max_seq_len, cfg.rope_theta, cfg.rope_scaling
+                )
+                x = params["embed"][tokens]                   # [B, T, D]
+
+                def tick(carry, t):
+                    state, cache_l = carry
+                    h_in = jnp.where((stage == 0) & (t == 0), x, state)
+                    h_out, cache_l = run_cached_layers(
+                        params["layers"], cfg, h_in, positions, cos, sin,
+                        cache_l, offsets,
+                        fresh_prefill=fresh_prefill,
+                        write_gate=(t == stage),
+                    )
+                    state = jax.lax.ppermute(h_out, "pp", perm)
+                    return (state, cache_l), None
+
+                (state, cache_out), _ = jax.lax.scan(
+                    tick, (jnp.zeros_like(x), cache), jnp.arange(n_pp)
+                )
+                # after P ticks the final hidden has been permuted back onto
+                # stage 0; select it and unembed there, then broadcast
+                h = state
+                if has_li:
+                    h = h[jnp.arange(B)[:, None], li[:, None]]
+                if cfg.block == "phi":
+                    h = layer_norm(
+                        h, params["final_norm"], params["final_norm_b"], cfg.rms_eps
+                    )
+                else:
+                    h = rms_norm(h, params["final_norm"], cfg.rms_eps)
+                head = params["embed"] if cfg.tie_embeddings else params["lm_head"]
+                logits = (h @ head.T).astype(jnp.float32)
+                if cfg.block == "phi":
+                    logits = logits + params["lm_head_b"].astype(jnp.float32)
+                logits = jnp.where(stage == 0, logits, 0.0)
+                return jax.lax.psum(logits, "pp"), cache_out
+
+            return inner(params, tokens, positions, cache, offsets, li)
+
+        return run(params, tokens, positions, kv_cache, cache_offsets, li)
+
+    pp_forward.n_pp = n_pp
+    return pp_forward

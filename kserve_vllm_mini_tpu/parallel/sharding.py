@@ -38,14 +38,16 @@ def param_specs(
 ) -> dict[str, Any]:
     tp = _axis(mesh, "tp")
     pp = _axis(mesh, "pp")
-    if pp:
-        # Sharding the stacked layer axis under the scan-rolled forward would
-        # drag full activations across stages every layer. Stage-partitioned
-        # execution lives in parallel/pipeline.py (microbatched, one ppermute
-        # per tick) — use it for pp > 1 instead of these annotations.
+    if pp and tp:
+        # Layer-range (pp) layouts are executed by the stage-partitioned
+        # executors — parallel/pipeline.py (training) and
+        # parallel/serving_pp.py (serving) — which run shard_map over pp
+        # with everything else replicated. tp-within-stage is not composed
+        # there; reject the combination instead of emitting specs the
+        # scan-rolled forward would silently allgather through.
         raise ValueError(
-            "pp > 1 requires the pipeline executor "
-            "(kserve_vllm_mini_tpu.parallel.pipeline), not plain sharding rules"
+            "pp > 1 composes with dp only (serving_pp/pipeline executors); "
+            "set tp=1 on pipelined meshes"
         )
     kv_tp = tp if tp and cfg.n_kv_heads % mesh.shape["tp"] == 0 else None
     specs: dict[str, Any] = {

@@ -186,6 +186,20 @@ class Engine:
         self.pad_id = pad_id
         self.params = params
 
+        # model executor: plain forward, or the pp-sharded drop-in when the
+        # mesh pipelines layers (parallel/serving_pp.py — same signature, so
+        # every compiled step below is executor-agnostic)
+        self._fwd = forward
+        if mesh is not None and mesh.shape.get("pp", 1) > 1:
+            from kserve_vllm_mini_tpu.parallel.serving_pp import make_pp_forward
+
+            self._fwd = make_pp_forward(cfg, mesh)
+            if drafter is not None:
+                raise ValueError(
+                    "speculative decoding is not supported with serving "
+                    "pipeline parallelism (pp > 1); drop the drafter or pp"
+                )
+
         from kserve_vllm_mini_tpu.models.llama import init_kv_cache
 
         S = self.ecfg.max_slots
@@ -195,16 +209,22 @@ class Engine:
             if (self.ecfg.kv_cache_dtype and not kv_quant)
             else None
         )
-        self._cache = init_kv_cache(
-            cfg, S, max_seq=self.ecfg.max_seq_len, dtype=kv_dt, quantized=kv_quant
-        )
+        def make_cache():
+            return init_kv_cache(
+                cfg, S, max_seq=self.ecfg.max_seq_len, dtype=kv_dt, quantized=kv_quant
+            )
+
         if mesh is not None:
             from kserve_vllm_mini_tpu.parallel.sharding import kv_cache_shardings
 
+            # allocate DIRECTLY into the mesh layout: materializing the full
+            # cache on one device first and device_put-ting after would OOM
+            # exactly the deployments sharding exists for (a pp/tp mesh
+            # because model+cache exceed one chip's HBM)
             sh = kv_cache_shardings(cfg, mesh, quantized=kv_quant)
-            self._cache = {
-                key: jax.device_put(arr, sh[key]) for key, arr in self._cache.items()
-            }
+            self._cache = jax.jit(make_cache, out_shardings=sh)()
+        else:
+            self._cache = make_cache()
 
         # speculative decoding: the drafter keeps its own KV cache with the
         # same slot/seq geometry so slot bookkeeping is shared
@@ -266,6 +286,7 @@ class Engine:
         if key in self._prefill_fns:
             return self._prefill_fns[key]
         cfg = self._drafter_cfg if draft else self.cfg
+        fwd = forward if draft else self._fwd
 
         @partial(jax.jit, donate_argnums=(1,), static_argnums=())
         def prefill(params, cache, tokens, length, slot):
@@ -280,7 +301,7 @@ class Engine:
             # logit_index: only the prompt's last position is sampled — a
             # full [1, bucket, V] f32 logits tensor is ~2 GB at 128k vocab
             # for the server-default 4096 bucket, on the per-request path
-            logits, new_sub = forward(
+            logits, new_sub = fwd(
                 params, cfg, tokens, pos,
                 sub, jnp.zeros((1,), jnp.int32),
                 fresh_prefill=True,
@@ -290,6 +311,39 @@ class Engine:
 
         self._prefill_fns[key] = prefill
         return prefill
+
+    def _get_chunk_prefill_fn(self, bucket: int, draft: bool = False):
+        """Continuation-chunk prefill: writes this chunk's KV at ``offset``
+        inside the slot and attends the whole cache with positional masking
+        (exact for chunked prefill — llama.py forward's cached path). The
+        flash fresh-prefill fn handles chunk 0; this handles the rest, so
+        prompts longer than max_prefill_len no longer truncate."""
+        key = ("chunk", bucket, draft)
+        if key in self._prefill_fns:
+            return self._prefill_fns[key]
+        cfg = self._drafter_cfg if draft else self.cfg
+        fwd = forward if draft else self._fwd
+
+        @partial(jax.jit, donate_argnums=(1,))
+        def chunk_prefill(params, cache, tokens, length, slot, offset):
+            # tokens: [1, bucket]; length = valid tokens in this chunk;
+            # offset = absolute position of the chunk's first token
+            from kserve_vllm_mini_tpu.models.llama import (
+                slice_cache_slots,
+                update_cache_slots,
+            )
+
+            pos = offset + jnp.arange(tokens.shape[1], dtype=jnp.int32)[None]
+            sub = slice_cache_slots(cache, slot)
+            logits, new_sub = fwd(
+                params, cfg, tokens, pos,
+                sub, offset[None],
+                logit_index=(length - 1)[None],
+            )
+            return update_cache_slots(cache, new_sub, slot), logits[0, 0]
+
+        self._prefill_fns[key] = chunk_prefill
+        return chunk_prefill
 
     def _get_decode_fn(self, n_steps: int = 1):
         """Compiled decode of ``n_steps`` sampling steps in ONE dispatch.
@@ -304,13 +358,14 @@ class Engine:
         if fn is not None:
             return fn
         cfg = self.cfg
+        fwd = self._fwd
 
         @partial(jax.jit, donate_argnums=(1,))
         def decode(params, cache, tokens, lengths, temps, topks, topps, rng):
             def body(carry, _):
                 c, toks, lens, r = carry
                 r, sub = jax.random.split(r)
-                logits, nc = forward(
+                logits, nc = fwd(
                     params, cfg, toks[:, None], lens[:, None], c, lens
                 )
                 lg = logits[:, 0, :]
@@ -337,12 +392,13 @@ class Engine:
         if fn is not None:
             return fn
         cfg = self.cfg
+        fwd = self._fwd
         span = self._byte_span
 
         @partial(jax.jit, donate_argnums=(1,))
         def decode_masked(params, cache, tokens, lengths,
                           temps, topks, topps, rng, mask, use_mask):
-            logits, nc = forward(
+            logits, nc = fwd(
                 params, cfg, tokens[:, None], lengths[:, None], cache, lengths
             )
             lg = logits[:, 0, :]
@@ -371,11 +427,15 @@ class Engine:
     # -- public API --------------------------------------------------------
 
     def submit(self, req: GenRequest) -> RequestHandle:
-        if len(req.prompt_tokens) > self.ecfg.max_prefill_len:
-            # keep the tail: the most recent context fits the prefill budget
+        # prompts longer than one prefill bucket run as CHUNKED prefill
+        # (_admit_one), so the only hard cap is the slot's KV window itself
+        # (one position must remain for decode). Only past that does the
+        # tail-keeping truncation — still flagged end-to-end — apply.
+        prompt_cap = self.ecfg.max_seq_len - 1
+        if len(req.prompt_tokens) > prompt_cap:
             req.truncated = True
-            req.truncated_tokens = len(req.prompt_tokens) - self.ecfg.max_prefill_len
-            req.prompt_tokens = req.prompt_tokens[-self.ecfg.max_prefill_len:]
+            req.truncated_tokens = len(req.prompt_tokens) - prompt_cap
+            req.prompt_tokens = req.prompt_tokens[-prompt_cap:]
         handle = RequestHandle(req)
         if req.constraint is not None:
             # the grammar must be closable inside BOTH the token budget and
@@ -447,18 +507,47 @@ class Engine:
         self._decode_fns["first"] = first
         return first
 
+    def _prefill_chunks(self, prompt: list[int], slot: int, draft: bool = False):
+        """Run the prompt through the slot's cache: chunk 0 on the flash
+        fresh-prefill path, continuation chunks (prompts longer than
+        max_prefill_len) on the positional-masked chunk path. Returns the
+        last real position's logits [V] f32."""
+        budget = self.ecfg.max_prefill_len
+        params = self._drafter_params if draft else self.params
+        n = len(prompt)
+        last_logits = None
+        off = 0
+        while off < n:
+            piece = prompt[off : off + budget]
+            m = len(piece)
+            bucket = self._bucket(m)
+            toks = piece + [self.pad_id] * (bucket - m)
+            tokens = jnp.asarray(toks, dtype=jnp.int32)[None]
+            cache_in = self._dcache if draft else self._cache
+            if off == 0:
+                fn = self._get_prefill_fn(bucket, draft=draft)
+                cache, last_logits = fn(
+                    params, cache_in, tokens, jnp.int32(m), jnp.int32(slot)
+                )
+            else:
+                fn = self._get_chunk_prefill_fn(bucket, draft=draft)
+                cache, last_logits = fn(
+                    params, cache_in, tokens,
+                    jnp.int32(m), jnp.int32(slot), jnp.int32(off),
+                )
+            if draft:
+                self._dcache = cache
+            else:
+                self._cache = cache
+            off += m
+        return last_logits
+
     def _admit_one(self, handle: RequestHandle) -> None:
         req = handle.request
         slot = self._free.pop()
         n = len(req.prompt_tokens)
-        bucket = self._bucket(n)
-        toks = req.prompt_tokens + [self.pad_id] * (bucket - n)
-        tokens = jnp.asarray(toks, dtype=jnp.int32)[None]
-        prefill = self._get_prefill_fn(bucket)
         t0 = time.time()
-        self._cache, last_logits = prefill(
-            self.params, self._cache, tokens, jnp.int32(n), jnp.int32(slot),
-        )
+        last_logits = self._prefill_chunks(req.prompt_tokens, slot)
         # first token: sampled from the prompt's last-position logits,
         # grammar-masked when the request is constrained
         machine = req.constraint
@@ -483,11 +572,7 @@ class Engine:
         if self._drafter_params is not None and self.ecfg.spec_tokens > 0:
             # drafter prefills the same prompt into its own cache so it can
             # propose from full context; its output logits are unused
-            dprefill = self._get_prefill_fn(bucket, draft=True)
-            self._dcache, _ = dprefill(
-                self._drafter_params, self._dcache, tokens,
-                jnp.int32(n), jnp.int32(slot),
-            )
+            self._prefill_chunks(req.prompt_tokens, slot, draft=True)
         self.stats["busy_s"] += time.time() - t0
         self.stats["prefills"] += 1
         self.stats["prefill_tokens"] += n

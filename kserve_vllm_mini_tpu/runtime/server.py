@@ -44,6 +44,7 @@ def build_engine(
     decode_chunk: int = 1,
     drafter: Optional[str] = None,
     spec_tokens: int = 0,
+    pp: int = 0,
 ) -> tuple[Engine, Tokenizer, str]:
     """Construct (engine, tokenizer, model_name) from a preset or checkpoint.
 
@@ -69,7 +70,13 @@ def build_engine(
         )
 
     mesh = None
-    if topology:
+    if pp and pp > 1:
+        # serving pipeline parallelism: layer-range stages over a pure-pp
+        # mesh (parallel/serving_pp.py); needs exactly pp devices
+        from kserve_vllm_mini_tpu.parallel.mesh import MeshSpec, make_mesh
+
+        mesh = make_mesh(MeshSpec(pp=pp))
+    elif topology:
         from kserve_vllm_mini_tpu.parallel.mesh import mesh_for_topology
 
         mesh = mesh_for_topology(topology)
@@ -88,12 +95,24 @@ def build_engine(
             cfg = cfg.scaled(vocab_size=tok.vocab_size)
         # int8 presets init straight into int8 leaves: materializing the bf16
         # 8B tree first is itself an OOM on a 16 GB v5e (VERDICT.md Weak #1)
-        if quantization == "int8":
-            params = init_params_quantized(jax.random.PRNGKey(seed), cfg)
+        init_fn = init_params_quantized if quantization == "int8" else init_params
+        if mesh is not None:
+            # init DIRECTLY into the mesh layout (out_shardings on the jitted
+            # init) — a full single-device tree + device_put would OOM the
+            # very deployments the mesh exists for
+            from functools import partial as _partial
+
+            from kserve_vllm_mini_tpu.parallel.sharding import param_shardings
+
+            tree = jax.eval_shape(_partial(init_fn, cfg=cfg), jax.random.PRNGKey(seed))
+            shardings = param_shardings(cfg, mesh, params=tree)
+            params = jax.jit(
+                _partial(init_fn, cfg=cfg), out_shardings=shardings
+            )(jax.random.PRNGKey(seed))
         else:
-            params = init_params(jax.random.PRNGKey(seed), cfg)
+            params = init_fn(jax.random.PRNGKey(seed), cfg)
         name = cfg.name
-    if mesh is not None:
+    if mesh is not None and checkpoint:
         from kserve_vllm_mini_tpu.parallel.sharding import shard_params
 
         params = shard_params(params, cfg, mesh)
@@ -571,6 +590,9 @@ def register(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--max-seq-len", type=int, default=1024)
     parser.add_argument("--topology", default=None,
                         help="Mesh topology preset (e.g. v5e-8); default single-device")
+    parser.add_argument("--pp", type=int, default=0,
+                        help="Serving pipeline-parallel stages (layer-range "
+                             "sharding over a pure-pp mesh; overrides --topology)")
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--quantization", default="none", choices=["none", "int8"],
                         help="Weight quantization (int8 = W8A16 per-channel)")
@@ -606,6 +628,7 @@ def run(args: argparse.Namespace) -> int:
         decode_chunk=args.decode_chunk,
         max_seq_len=args.max_seq_len,
         topology=args.topology,
+        pp=args.pp,
         seed=args.seed,
         quantization=args.quantization,
         kv_cache_dtype=args.kv_cache_dtype,

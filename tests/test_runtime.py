@@ -581,3 +581,100 @@ def test_every_quantization_profile_boots():
             assert len(tokens) == 4, pf.name
         finally:
             engine.stop()
+
+
+def test_chunked_prefill_matches_single_prefill(params):
+    """A prompt longer than max_prefill_len runs as chunked prefill and must
+    emit exactly what a single-bucket prefill of the same prompt emits
+    (greedy, same engine seed) — and must NOT be flagged truncated."""
+    prompt = [(7 * i + 3) % CFG.vocab_size for i in range(40)]
+
+    eng_chunk = Engine(
+        params, CFG,
+        EngineConfig(max_slots=2, max_seq_len=128, max_prefill_len=16,
+                     min_prefill_bucket=16),
+    )
+    eng_chunk.start()
+    try:
+        h = eng_chunk.submit(GenRequest(prompt_tokens=list(prompt),
+                                        max_new_tokens=12, temperature=0.0))
+        toks_chunk, fin = _drain(h)
+        assert not h.request.truncated
+        assert fin["finish_reason"] in ("length", "stop")
+    finally:
+        eng_chunk.stop()
+
+    eng_one = make_engine(params, slots=2)  # max_prefill_len=64 >= prompt
+    try:
+        h2 = eng_one.submit(GenRequest(prompt_tokens=list(prompt),
+                                       max_new_tokens=12, temperature=0.0))
+        toks_one, _ = _drain(h2)
+    finally:
+        eng_one.stop()
+
+    assert toks_chunk == toks_one
+    # the slow oracle agrees too (chunked prefill is exact, not approximate)
+    assert toks_chunk == greedy_reference(params, prompt, 12)
+
+
+def test_over_window_prompt_still_truncates_flagged(params):
+    """Only prompts longer than the KV window itself truncate now (to the
+    window), and the flag survives."""
+    cap = 64
+    prompt = [(5 * i + 1) % CFG.vocab_size for i in range(cap + 30)]
+    eng = Engine(
+        params, CFG,
+        EngineConfig(max_slots=2, max_seq_len=cap, max_prefill_len=16,
+                     min_prefill_bucket=16),
+    )
+    eng.start()
+    try:
+        h = eng.submit(GenRequest(prompt_tokens=list(prompt),
+                                  max_new_tokens=4, temperature=0.0))
+        toks, _ = _drain(h)
+        assert h.request.truncated
+        assert h.request.truncated_tokens == 30 + 1  # cap - 1 kept
+        assert len(toks) >= 1
+    finally:
+        eng.stop()
+
+
+def test_serving_pp_engine_matches_single_device(params):
+    """An engine over a pp=2 mesh (parallel/serving_pp.py executor) must
+    emit exactly what the single-device engine emits — flash prefill,
+    chunked prefill, and fused decode all through the pp-sharded path."""
+    from kserve_vllm_mini_tpu.parallel.mesh import MeshSpec, make_mesh
+    from kserve_vllm_mini_tpu.parallel.sharding import shard_params
+
+    prompt = [(11 * i + 2) % CFG.vocab_size for i in range(40)]
+
+    mesh = make_mesh(MeshSpec(pp=2))
+    eng_pp = Engine(
+        shard_params(params, CFG, mesh), CFG,
+        EngineConfig(max_slots=2, max_seq_len=128, max_prefill_len=16,
+                     min_prefill_bucket=16),
+        mesh=mesh,
+    )
+    eng_pp.start()
+    try:
+        h = eng_pp.submit(GenRequest(prompt_tokens=list(prompt),
+                                     max_new_tokens=10, temperature=0.0))
+        toks_pp, fin = _drain(h)
+        assert fin["finish_reason"] in ("length", "stop")
+    finally:
+        eng_pp.stop()
+
+    assert toks_pp == greedy_reference(params, prompt, 10)
+
+
+def test_serving_pp_rejects_drafter(params):
+    from kserve_vllm_mini_tpu.parallel.mesh import MeshSpec, make_mesh
+
+    mesh = make_mesh(MeshSpec(pp=2))
+    with pytest.raises(ValueError, match="pipeline"):
+        Engine(
+            params, CFG,
+            EngineConfig(max_slots=2, max_seq_len=64, spec_tokens=2),
+            mesh=mesh,
+            drafter=(params, CFG),
+        )
