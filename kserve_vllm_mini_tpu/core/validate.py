@@ -113,11 +113,26 @@ def validate_profile(
     # parallel/sharding.py raise mid-deploy.
     par = profile.get("parallelism") or {}
     pp = int(par.get("pp", 1) or 1)
-    if pp > 1 and int(par.get("tp", 1) or 1) > 1:
-        rep.errors.append(
-            "pp > 1 composes with dp only (parallel/serving_pp.py layer-range "
-            "stages); set tp=1 — see docs/TOPOLOGY.md 'Pipeline parallelism'"
-        )
+    if pp > 1:
+        extra = {
+            a: int(par.get(a, 1) or 1)
+            for a in ("tp", "dp", "sp", "ep")
+            if int(par.get(a, 1) or 1) > 1
+        }
+        if extra:
+            rep.errors.append(
+                f"pp > 1 runs on pure-pp meshes (parallel/serving_pp.py "
+                f"layer-range stages); drop {sorted(extra)} or pp — see "
+                "docs/TOPOLOGY.md 'Pipeline parallelism'"
+            )
+        size_b = _model_size_hint(str(profile.get("model", "")))
+        layers_by_size = {7.0: 32, 8.0: 32, 13.0: 40, 34.0: 48, 47.0: 32, 70.0: 80}
+        n_layers = layers_by_size.get(size_b)
+        if n_layers and n_layers % pp:
+            rep.errors.append(
+                f"pp={pp} does not divide the model's {n_layers} layers — "
+                "the stage executor needs equal layer ranges"
+            )
 
     topology = profile.get("topology")
     if topology:

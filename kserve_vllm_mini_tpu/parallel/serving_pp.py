@@ -55,10 +55,6 @@ except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map
 
 
-def _replicated_specs(tree: Any) -> Any:
-    return jax.tree.map(lambda leaf: P(*([None] * jnp.ndim(leaf))), tree)
-
-
 def _pp_param_specs(params: dict[str, Any]) -> dict[str, Any]:
     """Layer stack over pp, everything else replicated (same shape as
     pipeline._pipeline_specs, duplicated here to keep the serving module
@@ -87,6 +83,14 @@ def make_pp_forward(cfg: ModelConfig, mesh: Mesh):
     n_pp = int(mesh.shape["pp"])
     if cfg.n_layers % n_pp:
         raise ValueError(f"n_layers={cfg.n_layers} not divisible by pp={n_pp}")
+    other = {a: s for a, s in mesh.shape.items() if a != "pp" and s > 1}
+    if other:
+        # the specs below replicate every non-pp axis: a dp>1 mesh would
+        # all-gather the dp-sharded cache every forward and duplicate work
+        raise ValueError(
+            f"serving PP runs on pure-pp meshes; got extra axes {other} — "
+            "scale replicas at the deployment layer (Knative dp) instead"
+        )
     perm = [(i, (i + 1) % n_pp) for i in range(n_pp)]
 
     def pp_forward(

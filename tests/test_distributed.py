@@ -117,4 +117,4 @@ def test_global_mesh_local_topology():
         pytest.skip("needs the 8-device CPU mesh")
     mesh = dist.mesh_for_topology("cpu-8")
     assert mesh.devices.size == 8
-    assert mesh.axis_names == ("dp", "sp", "pp", "tp")
+    assert mesh.axis_names == ("dp", "sp", "pp", "tp", "ep")

@@ -125,14 +125,16 @@ def test_eos_stops_generation(params):
         eng.stop()
 
 
-def test_long_prompt_truncated_to_prefill_budget(params):
+def test_long_prompt_truncated_to_kv_window(params):
+    """Prompts inside the KV window chunk-prefill exactly; only past the
+    window (max_seq=128 -> cap 127) does tail-truncation kick in."""
     eng = make_engine(params)
     try:
-        prompt = list(range(1, 200))  # > max_prefill_len=64
-        h = eng.submit(GenRequest(prompt_tokens=prompt, max_new_tokens=3))
+        prompt = list(range(1, 200))  # 199 tokens > 127 window cap
+        h = eng.submit(GenRequest(prompt_tokens=prompt, max_new_tokens=1))
         tokens, info = _drain(h)
-        assert len(tokens) == 3
-        ref = greedy_reference(params, prompt[-64:], 3)
+        assert len(tokens) == 1
+        ref = greedy_reference(params, prompt[-127:], 1)
         assert tokens == ref
     finally:
         eng.stop()
@@ -375,19 +377,21 @@ def test_spec_decode_eos_mid_round(params):
 
 
 def test_prompt_truncation_flagged(params):
-    """Over-budget prompts are cut to max_prefill_len AND flagged — the
-    engine must never silently measure a different workload (round-2
-    VERDICT Weak #4). The served tail must decode exactly like a prompt
-    that was the tail to begin with."""
-    eng = make_engine(params)  # max_prefill_len=64
+    """Only prompts past the KV window are cut — to the window, flagged —
+    and the served tail decodes exactly like a prompt that was the tail to
+    begin with (round-2 VERDICT Weak #4: never silently measure a
+    different workload). In-window prompts longer than max_prefill_len
+    chunk-prefill unflagged (test_chunked_prefill_matches_single_prefill).
+    """
+    eng = make_engine(params)  # max_seq=128 -> window cap 127
     try:
-        long_prompt = list(range(1, 101))         # 100 tokens > 64 budget
-        ref = greedy_reference(params, long_prompt[-64:], 6)
+        long_prompt = list(range(1, 161))         # 160 tokens > 127 cap
+        ref = greedy_reference(params, long_prompt[-127:], 1)
         h = eng.submit(GenRequest(prompt_tokens=long_prompt, max_new_tokens=6))
         tokens, info = _drain(h)
-        assert tokens == ref
+        assert tokens[:1] == ref                  # window leaves 1 decode slot
         assert info["truncated"] is True
-        assert info["truncated_tokens"] == 36
+        assert info["truncated_tokens"] == 33
         assert h.request.truncated
 
         # within-budget prompt stays unflagged

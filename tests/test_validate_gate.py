@@ -29,7 +29,7 @@ def test_serving_pp_tp_combination_rejected_with_pointer():
         "parallelism": {"tp": 4, "pp": 2},
     })
     assert not rep.ok
-    assert any("tp=1" in e and "TOPOLOGY.md" in e for e in rep.errors)
+    assert any("pure-pp" in e and "TOPOLOGY.md" in e for e in rep.errors)
 
     # pure-pp serving is a supported config now
     rep_pp = validate_profile({
@@ -38,6 +38,15 @@ def test_serving_pp_tp_combination_rejected_with_pointer():
         "parallelism": {"tp": 1, "pp": 8},
     })
     assert not any("pp" in e for e in rep_pp.errors)
+
+    # a pp that does not divide the model's layer count fails up front,
+    # not at Engine construction (32 layers % 3 != 0)
+    rep_bad = validate_profile({
+        "pattern": "steady", "requests": 10, "concurrency": 2,
+        "model": "llama-3.1-8b", "topology": "v5e-8",
+        "parallelism": {"pp": 3},
+    })
+    assert any("does not divide" in e for e in rep_bad.errors)
 
     rep2 = validate_profile({
         "pattern": "steady", "requests": 10, "concurrency": 2,
