@@ -83,8 +83,10 @@ def main() -> int:
     # int8 weights are built layer-by-layer straight into int8 leaves — the
     # full-precision 8B tree (~16 GB bf16) must NEVER exist on a 16 GB v5e
     # (round-2 OOM, VERDICT.md Weak #1)
-    if quant == "int8":
-        params = init_params_quantized(jax.random.PRNGKey(0), cfg)
+    if quant in ("int8", "int4"):
+        params = init_params_quantized(
+            jax.random.PRNGKey(0), cfg, bits=4 if quant == "int4" else 8
+        )
     else:
         params = init_params(jax.random.PRNGKey(0), cfg)
     jax.block_until_ready(params)
@@ -254,9 +256,12 @@ def main() -> int:
             dcfg = get_config(drafter, max_seq_len=max_seq)
             if dcfg.vocab_size != cfg.vocab_size:
                 dcfg = dcfg.scaled(vocab_size=cfg.vocab_size)
-            dparams = (
-                init_params_quantized if quant == "int8" else init_params
-            )(jax.random.PRNGKey(3), dcfg)
+            if quant in ("int8", "int4"):
+                dparams = init_params_quantized(
+                    jax.random.PRNGKey(3), dcfg, bits=4 if quant == "int4" else 8
+                )
+            else:
+                dparams = init_params(jax.random.PRNGKey(3), dcfg)
 
         @partial(jax.jit, donate_argnums=(1,))
         def sprefill(p, c, t, pp):

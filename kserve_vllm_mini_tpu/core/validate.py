@@ -25,7 +25,7 @@ from kserve_vllm_mini_tpu.loadgen.arrivals import PATTERNS
 HBM_GIB_PER_CHIP = {"v4": 32.0, "v5e": 16.0, "v5p": 95.0, "v6e": 32.0}
 # fp8 deliberately NOT advertised: the in-repo runtime has no fp8 kernel
 # path and v5e lacks native fp8 — a knob nothing executes is a lie
-TPU_QUANT_OK = {"none", "bf16", "int8", "aqt-int8"}
+TPU_QUANT_OK = {"none", "bf16", "int8", "aqt-int8", "int4"}
 GPU_ONLY_QUANT = {"awq", "gptq", "autoawq", "marlin", "squeezellm"}
 
 # rough parameter counts for HBM-fit estimates (bf16 bytes = 2/param + ~30%
@@ -125,9 +125,16 @@ def validate_profile(
                 f"layer-range stages); drop {sorted(extra)} or pp — see "
                 "docs/TOPOLOGY.md 'Pipeline parallelism'"
             )
-        size_b = _model_size_hint(str(profile.get("model", "")))
-        layers_by_size = {7.0: 32, 8.0: 32, 13.0: 40, 34.0: 48, 47.0: 32, 70.0: 80}
-        n_layers = layers_by_size.get(size_b)
+        from kserve_vllm_mini_tpu.models.config import PRESETS
+
+        model_name = str(profile.get("model", ""))
+        n_layers = None
+        if model_name in PRESETS:
+            n_layers = PRESETS[model_name].n_layers
+        else:
+            # size-keyed fallback for non-preset names (Llama-family depths)
+            size_b = _model_size_hint(model_name)
+            n_layers = {7.0: 32, 8.0: 32, 13.0: 40, 34.0: 48, 70.0: 80}.get(size_b)
         if n_layers and n_layers % pp:
             rep.errors.append(
                 f"pp={pp} does not divide the model's {n_layers} layers — "
@@ -146,7 +153,11 @@ def validate_profile(
         elif chips:
             size_b = _model_size_hint(str(profile.get("model", "")))
             if size_b is not None:
-                bytes_per_param = 1.0 if quant in ("int8", "aqt-int8") else 2.0
+                bytes_per_param = (
+                    0.5 if quant == "int4"
+                    else 1.0 if quant in ("int8", "aqt-int8")
+                    else 2.0
+                )
                 need_gib = size_b * bytes_per_param * 1.3
                 have_gib = HBM_GIB_PER_CHIP[gen] * chips
                 if need_gib > have_gib:

@@ -23,7 +23,7 @@ class BackendConfig:
     model_uri: str = ""
     model_id: str = "meta-llama/Llama-3.1-8B-Instruct"
     tensor_parallel: int = 0          # 0 => all chips in the slice
-    quantization: str = "none"        # none | int8 (fp8/int4: no kernel path)
+    quantization: str = "none"        # none | int8 | int4 (fp8: no kernel path)
     kv_cache_dtype: str = "auto"
     max_model_len: int = 4096
     max_batch_size: int = 64

@@ -144,8 +144,8 @@ def init_params(rng: jax.Array, cfg: ModelConfig) -> Params:
     return _init_impl(rng, cfg, lambda w: w)
 
 
-def init_params_quantized(rng: jax.Array, cfg: ModelConfig) -> Params:
-    """Random init straight into int8 leaves, one layer at a time.
+def init_params_quantized(rng: jax.Array, cfg: ModelConfig, bits: int = 8) -> Params:
+    """Random init straight into int8 (or int4) leaves, one layer at a time.
 
     Fixes the round-2 flagship failure (VERDICT.md Weak #1): materializing
     the 8B bf16 tree first needs ~16 GB — the whole v5e HBM — before
@@ -162,7 +162,7 @@ def init_params_quantized(rng: jax.Array, cfg: ModelConfig) -> Params:
         # quantize_weight reads them back in f32 — without it XLA fuses
         # the bf16 cast into the quantize math and rounds at a different
         # boundary than quantize-after-init (±1 LSB drift)
-        return quantize_weight(jax.lax.optimization_barrier(w))
+        return quantize_weight(jax.lax.optimization_barrier(w), bits=bits)
 
     return _init_impl(rng, cfg, leaf_fn)
 

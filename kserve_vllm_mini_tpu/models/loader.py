@@ -152,12 +152,12 @@ def load_hf_checkpoint(
     model_dir: str | Path,
     cfg: Optional[ModelConfig] = None,
     dtype: Optional[str] = None,
-    quantize: bool = False,
+    quantize: bool | str = False,
 ) -> tuple[dict[str, Any], ModelConfig]:
     """Load an HF Llama-family checkpoint into (params, config).
 
-    ``quantize=True`` converts each matmul weight to int8 **layer by layer
-    during the load**, so the full-precision tree never exists on device —
+    ``quantize=True`` (or ``"int8"``/``"int4"``) converts each matmul
+    weight to that width **layer by layer during the load**, so the full-precision tree never exists on device —
     an 8B bf16 tree is ~16 GB, the entire HBM of the v5e this serves on
     (same rationale as models/llama.py init_params_quantized; quantizing
     after a full load re-creates the round-2 OOM for real checkpoints)."""
@@ -165,6 +165,8 @@ def load_hf_checkpoint(
     cfg = cfg or config_from_hf(model_dir)
     dt = jnp.dtype(dtype or cfg.dtype)
     get = _open_shards(model_dir)
+    if quantize in ("none", "bf16", ""):
+        quantize = False  # mode strings pass straight through from configs
 
     def conv(name: str, transpose: bool) -> jnp.ndarray:
         x = jnp.asarray(get(name))  # ml_dtypes handles bf16 numpy views
@@ -174,9 +176,10 @@ def load_hf_checkpoint(
 
     if quantize:
         from kserve_vllm_mini_tpu.ops.quant import QUANTIZABLE, quantize_weight
+    q_bits = 4 if quantize == "int4" else 8
 
     def stack_quantized(per_layer_arrays) -> dict[str, Any]:
-        qws = [quantize_weight(a) for a in per_layer_arrays]
+        qws = [quantize_weight(a, bits=q_bits) for a in per_layer_arrays]
         return {
             "q": jnp.stack([w["q"] for w in qws]),
             "s": jnp.stack([w["s"] for w in qws]),

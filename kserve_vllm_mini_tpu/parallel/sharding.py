@@ -46,8 +46,9 @@ def param_specs(
         # there; reject the combination instead of emitting specs the
         # scan-rolled forward would silently allgather through.
         raise ValueError(
-            "pp > 1 composes with dp only (serving_pp/pipeline executors); "
-            "set tp=1 on pipelined meshes"
+            "pp > 1 needs a stage-partitioned executor: serving uses "
+            "pure-pp meshes (parallel/serving_pp.py), training composes "
+            "pp with dp (parallel/pipeline.py); neither composes pp with tp"
         )
     kv_tp = tp if tp and cfg.n_kv_heads % mesh.shape["tp"] == 0 else None
     specs: dict[str, Any] = {

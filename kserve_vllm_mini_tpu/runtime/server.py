@@ -57,8 +57,10 @@ def build_engine(
     from kserve_vllm_mini_tpu.models.config import get_config
     from kserve_vllm_mini_tpu.models.llama import init_params, init_params_quantized
 
-    if quantization not in ("none", "int8"):
-        raise ValueError(f"unknown quantization {quantization!r}; known: none, int8")
+    if quantization not in ("none", "int8", "int4"):
+        raise ValueError(
+            f"unknown quantization {quantization!r}; known: none, int8, int4"
+        )
     if kv_cache_dtype == "auto":
         # profile sentinel for "model default" (profiles/quantization/*.yaml
         # mirror the reference's 'auto'); the deploy layer drops it too
@@ -87,7 +89,7 @@ def build_engine(
 
         # quantize-as-you-load: the bf16 8B tree must never fully exist on
         # device (VERDICT.md Weak #1 applies to real checkpoints too)
-        params, cfg = load_hf_checkpoint(checkpoint, quantize=quantization == "int8")
+        params, cfg = load_hf_checkpoint(checkpoint, quantize=quantization)
         name = cfg.name
     else:
         cfg = get_config(model)
@@ -95,7 +97,13 @@ def build_engine(
             cfg = cfg.scaled(vocab_size=tok.vocab_size)
         # int8 presets init straight into int8 leaves: materializing the bf16
         # 8B tree first is itself an OOM on a 16 GB v5e (VERDICT.md Weak #1)
-        init_fn = init_params_quantized if quantization == "int8" else init_params
+        if quantization in ("int8", "int4"):
+            from functools import partial as _p
+
+            init_fn = _p(init_params_quantized,
+                         bits=4 if quantization == "int4" else 8)
+        else:
+            init_fn = init_params
         if mesh is not None:
             # init DIRECTLY into the mesh layout (out_shardings on the jitted
             # init) — a full single-device tree + device_put would OOM the
@@ -594,8 +602,10 @@ def register(parser: argparse.ArgumentParser) -> None:
                         help="Serving pipeline-parallel stages (layer-range "
                              "sharding over a pure-pp mesh; overrides --topology)")
     parser.add_argument("--seed", type=int, default=0)
-    parser.add_argument("--quantization", default="none", choices=["none", "int8"],
-                        help="Weight quantization (int8 = W8A16 per-channel)")
+    parser.add_argument("--quantization", default="none",
+                        choices=["none", "int8", "int4"],
+                        help="Weight quantization (int8 = W8A16, int4 = W4A16 "
+                             "per-channel; XLA packs int4 two-per-byte in HBM)")
     parser.add_argument("--kv-cache-dtype", default=None,
                         help="KV cache dtype: bfloat16/float32/float16/int8 "
                              "(int8 = scaled per-position) or 'auto'")

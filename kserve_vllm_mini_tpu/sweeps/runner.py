@@ -42,7 +42,7 @@ def register(parser: argparse.ArgumentParser) -> None:
 
     q = sub.add_parser("quantization", parents=[common],
                        help="quantization x kv-dtype x decoding, Pareto analysis")
-    q.add_argument("--quantizations", default="none,int8")
+    q.add_argument("--quantizations", default="none,int8,int4")
     q.add_argument("--kv-dtypes", default="model,float32")
     q.add_argument("--decodings", default="greedy,sampled")
     q.add_argument("--no-quality", action="store_true",

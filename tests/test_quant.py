@@ -187,3 +187,64 @@ def test_int8_kv_cache_memory_halves():
     bf_bytes = sum(a.size * a.dtype.itemsize for a in bf.values())
     q_bytes = sum(a.size * a.dtype.itemsize for a in q.values())
     assert q_bytes < 0.6 * bf_bytes
+
+
+def test_int4_quantize_roundtrip_error_bounded():
+    """int4 per-channel roundtrip error stays within one quantization step
+    (amax/7 per output channel)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kserve_vllm_mini_tpu.ops.quant import dequantize_weight, quantize_weight
+
+    w = jax.random.normal(jax.random.PRNGKey(0), (64, 32), jnp.float32) * 0.1
+    qw = quantize_weight(w, bits=4)
+    assert qw["q"].dtype == jnp.int4
+    err = np.abs(np.asarray(dequantize_weight(qw, jnp.float32)) - np.asarray(w))
+    step = np.asarray(qw["s"])[None, :]
+    assert (err <= step * 0.75 + 1e-6).all()
+
+
+def test_int4_init_equals_quantize_after_init():
+    """bits=4 layer-wise init == quantize_params(init_params(...), bits=4)
+    (same per-layer keys, same scale math — the int8 oracle at 4 bits)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kserve_vllm_mini_tpu.models.config import get_config
+    from kserve_vllm_mini_tpu.models.llama import (
+        forward,
+        init_params,
+        init_params_quantized,
+    )
+    from kserve_vllm_mini_tpu.ops.quant import quantize_params
+
+    cfg = get_config("llama-tiny")
+    direct = init_params_quantized(jax.random.PRNGKey(0), cfg, bits=4)
+    after = quantize_params(init_params(jax.random.PRNGKey(0), cfg), bits=4)
+    for a, b in zip(jax.tree.leaves(direct), jax.tree.leaves(after)):
+        if a.dtype == jnp.int4:
+            d = np.abs(np.asarray(a, np.int32) - np.asarray(b, np.int32))
+            assert d.max() <= 1  # +-1 LSB from the cast boundary
+        else:
+            np.testing.assert_allclose(
+                np.asarray(a, np.float32), np.asarray(b, np.float32),
+                rtol=2e-2, atol=2e-4,
+            )
+
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, cfg.vocab_size)
+    pos = jnp.broadcast_to(jnp.arange(16, dtype=jnp.int32), (2, 16))
+    lg, _ = forward(direct, cfg, toks, pos)
+    assert bool(jnp.isfinite(lg).all())
+
+
+def test_quantized_bytes_counts_int4_as_half():
+    import jax
+    import jax.numpy as jnp
+
+    from kserve_vllm_mini_tpu.ops.quant import quantized_bytes
+
+    tree = {"a": jnp.zeros((10, 10), jnp.int4), "b": jnp.zeros((10,), jnp.float32)}
+    assert quantized_bytes(tree) == 50 + 40
