@@ -226,6 +226,29 @@ def main() -> int:
     flops_step = 2.0 * cfg.param_count * slots
     mfu = (flops_step / (dt / n_timed)) / (V5E_BF16_TFLOPS * 1e12) if on_tpu else 0.0
 
+    # -- north-star economics: $/1K tokens and Wh/1K tokens -----------------
+    # (BASELINE.md asks for both populated on the 8B @ v5e config.) Cost
+    # comes from the chip-hour sheet x the measured throughput; energy is
+    # the telemetry chain's MODELED leg (decode keeps the chip busy, so
+    # duty ~= 1 during the timed window) — provenance marked, same contract
+    # as energy/collector.py's fallback chain.
+    from kserve_vllm_mini_tpu.analysis.telemetry import modeled_power
+    from kserve_vllm_mini_tpu.costs.pricing import load_pricing
+
+    if on_tpu:
+        pricing = load_pricing()
+        chip_hourly, price_key = pricing.chip_price("v5e")
+        overhead = 1.0 + pricing.overhead_factor
+        cost_per_1k = chip_hourly * overhead * n_chips / max(toks_per_sec, 1e-9) / 3.6
+        watts = modeled_power(1.0, "v5e") * n_chips
+        wh_per_1k = watts * (1000.0 / max(toks_per_sec, 1e-9)) / 3600.0
+        cost_basis = f"{price_key} ${chip_hourly}/chip-hr x{overhead:.2f} overhead"
+        energy_prov = "modeled (duty 1.0 x TDP, analysis/telemetry.py)"
+    else:
+        # like mfu/bw_util: a CPU smoke run must not fabricate TPU economics
+        cost_per_1k = wh_per_1k = 0.0
+        cost_basis = energy_prov = "n/a (not on TPU)"
+
     # -- speculative decoding measurement (KVMINI_BENCH_SPEC=k) -------------
     # Reference claim: 20-40% decode improvement at real acceptance rates
     # (README.md:118). With random weights a small drafter accepts ~0 (its
@@ -403,6 +426,10 @@ def main() -> int:
             "hbm_bw_gbps": round(bw_gbps, 1),
             "hbm_bw_util": round(bw_util, 3),
             "mfu": round(mfu, 4),
+            "cost_per_1k_tokens_usd": round(cost_per_1k, 6),
+            "cost_basis": cost_basis,
+            "energy_wh_per_1k_tokens": round(wh_per_1k, 4),
+            "energy_provenance": energy_prov,
             "param_count": cfg.param_count,
             "param_bytes": int(param_bytes),
             "n_chips": n_chips,

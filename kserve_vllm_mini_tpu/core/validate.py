@@ -134,7 +134,7 @@ def validate_profile(
         else:
             # size-keyed fallback for non-preset names (Llama-family depths)
             size_b = _model_size_hint(model_name)
-            n_layers = {7.0: 32, 8.0: 32, 13.0: 40, 34.0: 48, 70.0: 80}.get(size_b)
+            n_layers = {7.0: 32, 8.0: 32, 13.0: 40, 34.0: 48, 47.0: 32, 70.0: 80}.get(size_b)
         if n_layers and n_layers % pp:
             rep.errors.append(
                 f"pp={pp} does not divide the model's {n_layers} layers — "
