@@ -109,7 +109,7 @@ def plan(inputs: PlanInput, pricing: Pricing) -> list[PlanOption]:
         tps_chip = baseline_for(accel, inputs.model_size, inputs.calibrated)
         if tps_chip is None:
             continue
-        if inputs.quantization in ("none", "bf16") and not inputs.calibrated:
+        if inputs.quantization in ("none", "bf16") and accel not in inputs.calibrated:
             tps_chip *= 0.5  # baselines are int8-measured; bf16 doubles bytes
         needed = required_tokens_per_sec * inputs.burst_headroom / tps_chip
         chips = max(int(needed) + (1 if needed % 1 else 0), 1)
@@ -234,6 +234,13 @@ def register(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--cold-frequency", type=float, default=DEFAULT_COLD_FREQUENCY)
     parser.add_argument("--calibrate-csv", default=None,
                         help="Sweep CSV to calibrate tokens/sec/chip from")
+    parser.add_argument("--quantization", default="int8",
+                        choices=["int8", "int4", "bf16", "none"],
+                        help="Weight quantization of the planned deployment "
+                             "(baselines are int8-measured; bf16 halves them)")
+    parser.add_argument("--serving-slots", type=int, default=64,
+                        help="Concurrent decode slots the throughput baseline "
+                             "assumes (per-request p95 speed = baseline/slots)")
     parser.add_argument("--cost-file", default=None)
     parser.add_argument("--output", default=None, help="Write markdown report here")
     parser.add_argument("--json", action="store_true", dest="as_json")
@@ -252,6 +259,8 @@ def run(args: argparse.Namespace) -> int:
         cold_start_s=args.cold_start_s,
         cold_frequency=args.cold_frequency,
         calibrated=calibrated,
+        quantization=args.quantization,
+        serving_slots=args.serving_slots,
     )
     options = plan(inputs, load_pricing(args.cost_file))
     if not options:
