@@ -122,7 +122,12 @@ def main() -> int:
     lowered = prefill_one.lower(params, cache1, toks1, pos1).compile()
     hlo = lowered.as_text()
     flash_lowered = "tpu_custom_call" in hlo
-    _log(f"prefill compiled (flash_lowered={flash_lowered})")
+    # ADVICE.md round-2: "tpu_custom_call" matches ANY TPU custom call; the
+    # Mosaic backend_config embeds the kernel's function name, so also look
+    # for the flash kernel specifically (reported, not asserted — the name
+    # embedding is a lowering detail the assert must not couple to)
+    flash_named = "_flash_kernel" in hlo
+    _log(f"prefill compiled (flash_lowered={flash_lowered}, named={flash_named})")
     if on_tpu:
         assert flash_lowered, (
             "serving prefill must lower the Pallas flash kernel on TPU "
@@ -238,12 +243,13 @@ def main() -> int:
     if on_tpu:
         # price/TDP keyed by the ACTUAL chip generation, not assumed v5e
         kind = jax.devices()[0].device_kind.lower()
-        if "lite" in kind or "v5e" in kind:
+        if "v6" in kind:
+            tpu_gen = "v6e"          # Trillium reports "TPU v6 lite" — check
+                                     # the generation before the "lite" tier
+        elif "lite" in kind or "v5e" in kind:
             tpu_gen = "v5e"
         elif "v5" in kind:
             tpu_gen = "v5p"
-        elif "v6" in kind:
-            tpu_gen = "v6e"
         else:
             tpu_gen = "v4"
         pricing = load_pricing()
@@ -433,6 +439,7 @@ def main() -> int:
             "ttft_target_ms": 30.0,
             "prefill_first_call_s": round(prefill_first_s, 2),
             "flash_prefill_lowered": bool(flash_lowered),
+            "flash_kernel_named_in_hlo": bool(flash_named),
             "hbm_bw_gbps": round(bw_gbps, 1),
             "hbm_bw_util": round(bw_util, 3),
             "mfu": round(mfu, 4),

@@ -41,6 +41,11 @@ BASELINE_TOKENS_PER_SEC_PER_CHIP: dict[tuple[str, str], float] = {
     ("v6e", "8b"): 4400.0,
 }
 
+# rows measured (or scaled from measurements) under int8 weights — the bf16
+# halving applies to these; the 1b rows are bf16-measured already (no int8
+# boost is assumed for them: conservative)
+INT8_MEASURED_SIZES = {"8b", "70b"}
+
 HOURS_PER_MONTH = 730.0
 
 # TPU pools take minutes to provision + load weights (SURVEY.md §7.3.4)
@@ -109,8 +114,12 @@ def plan(inputs: PlanInput, pricing: Pricing) -> list[PlanOption]:
         tps_chip = baseline_for(accel, inputs.model_size, inputs.calibrated)
         if tps_chip is None:
             continue
-        if inputs.quantization in ("none", "bf16") and accel not in inputs.calibrated:
-            tps_chip *= 0.5  # baselines are int8-measured; bf16 doubles bytes
+        if (
+            inputs.quantization in ("none", "bf16")
+            and accel not in inputs.calibrated
+            and inputs.model_size in INT8_MEASURED_SIZES
+        ):
+            tps_chip *= 0.5  # these rows are int8-measured; bf16 doubles bytes
         needed = required_tokens_per_sec * inputs.burst_headroom / tps_chip
         chips = max(int(needed) + (1 if needed % 1 else 0), 1)
         capacity_rps = chips * tps_chip / inputs.avg_output_tokens

@@ -7,6 +7,8 @@ bench functions, so only this test executes the production stage chain."""
 import asyncio
 import threading
 
+import pytest
+
 from kserve_vllm_mini_tpu.bench_pipeline import run_bench
 from kserve_vllm_mini_tpu.core.rundir import RunDir
 from tests.mock_server import MockServer
@@ -49,3 +51,38 @@ def test_run_bench_full_stage_chain(tmp_path):
     finally:
         stop.set()
         t.join(timeout=5)
+
+
+@pytest.mark.slow  # boots the JAX engine (weights init + XLA compile)
+def test_self_serve_long_context_chunked_prefill(tmp_path):
+    """The full self-serve pipeline (engine boot -> loadgen -> analyze ->
+    cost) with prompts several times the prefill bucket: chunked prefill
+    serves them exactly, so results.json must report ZERO truncated
+    requests — the long-context profile's contract
+    (profiles/load/long-context.yaml)."""
+    pytest.importorskip("jax")
+    run_dir = RunDir.create(root=tmp_path)
+    results, code = run_bench(
+        url=None,
+        self_serve=True,
+        profile={
+            "model": "llama-tiny",
+            "requests": 6,
+            "concurrency": 2,
+            "max_tokens": 4,
+            # 40 heuristic tokens = ~200 ByteTokenizer tokens once the chat
+            # wrapper is added: beyond the 128-token prefill bucket (so the
+            # engine must chunk) but inside the 255-token KV window (so
+            # nothing may truncate)
+            "input_tokens": 40,
+            "max_model_len": 256,
+            "max_slots": 4,
+        },
+        run_dir=run_dir,
+    )
+    assert code == 0
+    assert results["requests"] == 6
+    assert results["error_rate"] == 0.0
+    assert results.get("truncated_requests", 0) == 0
+    persisted = run_dir.read_results()
+    assert persisted.get("runtime") == "jax-native"
