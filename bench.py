@@ -78,8 +78,10 @@ def main() -> int:
     warmup = 8
 
     on_tpu = jax.default_backend() == "tpu"
-    cfg = get_config(model, max_seq_len=max_seq)
-    _log(f"model={model} quant={quant} slots={slots} backend={jax.default_backend()}")
+    unroll = int(os.environ.get("KVMINI_BENCH_UNROLL", "1"))
+    cfg = get_config(model, max_seq_len=max_seq, scan_unroll=unroll)
+    _log(f"model={model} quant={quant} slots={slots} unroll={unroll} "
+         f"backend={jax.default_backend()}")
     # int8 weights are built layer-by-layer straight into int8 leaves — the
     # full-precision 8B tree (~16 GB bf16) must NEVER exist on a 16 GB v5e
     # (round-2 OOM, VERDICT.md Weak #1)
@@ -447,6 +449,7 @@ def main() -> int:
             "cost_basis": cost_basis,
             "energy_wh_per_1k_tokens": round(wh_per_1k, 4),
             "energy_provenance": energy_prov,
+            "scan_unroll": unroll,
             "param_count": cfg.param_count,
             "param_bytes": int(param_bytes),
             "n_chips": n_chips,

@@ -58,6 +58,11 @@ class ModelConfig:
     # Block style: "llama" (pre-norm attn -> pre-norm SwiGLU, RMSNorm) or
     # "phi" (parallel attn+MLP off one LayerNorm, GELU MLP, all-bias).
     block: str = "llama"
+    # lax.scan unroll over the layer stack: >1 lets XLA software-pipeline
+    # weight streaming across layer boundaries at the cost of code size.
+    # A schedule knob: numerically equivalent, but XLA may reassociate bf16
+    # fusions so the last bits can differ (oracle-tested within tolerance).
+    scan_unroll: int = 1
     # Fraction of head_dim that receives rotary embedding (phi-2: 0.4).
     partial_rotary_factor: float = 1.0
 

@@ -450,6 +450,7 @@ def run_cached_layers(
         scan_body,
         (x, dict(kv_cache)),
         (layers, jnp.arange(n_local)),
+        unroll=max(cfg.scan_unroll, 1),
     )
     return x, new_cache
 
@@ -511,7 +512,9 @@ def forward(
         def scan_body_nocache(carry, p):
             return layer_forward(p, cfg, carry, positions, cos, sin, attention_fn), None
 
-        x, _ = jax.lax.scan(scan_body_nocache, x, layers)
+        x, _ = jax.lax.scan(
+            scan_body_nocache, x, layers, unroll=max(cfg.scan_unroll, 1)
+        )
         new_cache_dict = None
 
     if logit_index is not None:

@@ -45,6 +45,7 @@ def build_engine(
     drafter: Optional[str] = None,
     spec_tokens: int = 0,
     pp: int = 0,
+    scan_unroll: int = 1,
 ) -> tuple[Engine, Tokenizer, str]:
     """Construct (engine, tokenizer, model_name) from a preset or checkpoint.
 
@@ -90,11 +91,15 @@ def build_engine(
         # quantize-as-you-load: the bf16 8B tree must never fully exist on
         # device (VERDICT.md Weak #1 applies to real checkpoints too)
         params, cfg = load_hf_checkpoint(checkpoint, quantize=quantization)
+        if scan_unroll > 1:
+            cfg = cfg.scaled(scan_unroll=scan_unroll)
         name = cfg.name
     else:
         cfg = get_config(model)
         if tok.vocab_size > cfg.vocab_size:
             cfg = cfg.scaled(vocab_size=tok.vocab_size)
+        if scan_unroll > 1:
+            cfg = cfg.scaled(scan_unroll=scan_unroll)
         # int8 presets init straight into int8 leaves: materializing the bf16
         # 8B tree first is itself an OOM on a 16 GB v5e (VERDICT.md Weak #1)
         if quantization in ("int8", "int4"):
@@ -609,6 +614,9 @@ def register(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--kv-cache-dtype", default=None,
                         help="KV cache dtype: bfloat16/float32/float16/int8 "
                              "(int8 = scaled per-position) or 'auto'")
+    parser.add_argument("--scan-unroll", type=int, default=1,
+                        help="lax.scan unroll over the layer stack (XLA "
+                             "schedule knob; results equivalent)")
     parser.add_argument("--decode-chunk", type=int, default=1,
                         help="Decode steps fused per dispatch (throughput vs "
                              "streaming granularity)")
@@ -639,6 +647,7 @@ def run(args: argparse.Namespace) -> int:
         max_seq_len=args.max_seq_len,
         topology=args.topology,
         pp=args.pp,
+        scan_unroll=args.scan_unroll,
         seed=args.seed,
         quantization=args.quantization,
         kv_cache_dtype=args.kv_cache_dtype,

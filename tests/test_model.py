@@ -93,3 +93,34 @@ def test_deterministic_init():
         bool(jnp.all(a == b))
         for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p2))
     )
+
+
+def test_scan_unroll_is_pure_schedule_knob():
+    """scan_unroll must not change results beyond bf16 fusion reassociation
+    — same weights, equivalent logits, cached and cache-free, at unroll 1
+    vs 2 (llama-tiny has 2 layers)."""
+    import numpy as np
+
+    from kserve_vllm_mini_tpu.models.config import get_config
+    from kserve_vllm_mini_tpu.models.llama import forward, init_kv_cache, init_params
+
+    cfg1 = get_config("llama-tiny")
+    cfg2 = cfg1.scaled(scan_unroll=2)
+    p = init_params(jax.random.PRNGKey(0), cfg1)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, cfg1.vocab_size)
+    pos = jnp.broadcast_to(jnp.arange(16, dtype=jnp.int32), (2, 16))
+
+    a, _ = forward(p, cfg1, toks, pos)
+    b, _ = forward(p, cfg2, toks, pos)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-2, atol=2e-2)
+
+    ca = init_kv_cache(cfg1, 2, max_seq=32)
+    cb = init_kv_cache(cfg2, 2, max_seq=32)
+    la, ca = forward(p, cfg1, toks, pos, ca, jnp.zeros((2,), jnp.int32), fresh_prefill=True)
+    lb, cb = forward(p, cfg2, toks, pos, cb, jnp.zeros((2,), jnp.int32), fresh_prefill=True)
+    np.testing.assert_allclose(np.asarray(la), np.asarray(lb), rtol=2e-2, atol=2e-2)
+    for k in ca:
+        np.testing.assert_allclose(
+            np.asarray(ca[k], np.float32), np.asarray(cb[k], np.float32),
+            rtol=2e-2, atol=2e-2,
+        )
