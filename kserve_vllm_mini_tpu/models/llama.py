@@ -347,6 +347,11 @@ def run_cached_layers(
                                  # SPMD pipeline executor run every stage
                                  # every tick without corrupting inactive
                                  # stages' caches (parallel/serving_pp.py)
+    slot_base: Optional[jnp.ndarray] = None,  # scalar int32: this block is
+                                 # slots [slot_base, slot_base+B) of the
+                                 # cache — the microbatched pipeline
+                                 # executor walks slot groups while the
+                                 # cache keeps the full slot axis
 ) -> tuple[jnp.ndarray, KVCache]:
     """The cached transformer stack: scan over stacked layers, writing this
     block's K/V at ``cache_offsets`` and attending with positional masking
@@ -377,7 +382,8 @@ def run_cached_layers(
         # on the same positional mask.
         mask &= kj > qi - cfg.sliding_window
     mask = mask[:, None, :, :]                               # [B, 1, T, S]
-    b_idx = jnp.arange(B)[:, None, None]                     # [B, 1, 1]
+    base = slot_base if slot_base is not None else jnp.int32(0)
+    b_idx = base + jnp.arange(B)[:, None, None]              # [B, 1, 1]
     h_idx = jnp.arange(cfg.n_kv_heads)[None, :, None]        # [1, KVH, 1]
     t_idx = cache_offsets[:, None, None] + jnp.arange(T)[None, None, :]  # [B, 1, T]
 
@@ -393,10 +399,15 @@ def run_cached_layers(
 
     def _read_layer(cache, name, lidx):
         vals = jax.lax.dynamic_index_in_dim(cache[name], lidx, axis=0, keepdims=False)
+        if slot_base is not None:
+            # attention only needs this slot group's rows
+            vals = jax.lax.dynamic_slice_in_dim(vals, base, B, axis=0)
         if quantized_kv:
             sc = jax.lax.dynamic_index_in_dim(
                 cache[name + "_s"], lidx, axis=0, keepdims=False
             )
+            if slot_base is not None:
+                sc = jax.lax.dynamic_slice_in_dim(sc, base, B, axis=0)
             # dequantize on read: halves the HBM stream vs bf16 and the
             # multiply fuses into the attention matmul's prologue
             return vals.astype(dt) * sc.astype(dt)[..., None]

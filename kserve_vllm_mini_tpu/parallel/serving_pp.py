@@ -73,12 +73,21 @@ def _cache_specs(cache: dict[str, Any]) -> dict[str, Any]:
     return {k: P("pp", *([None] * (v.ndim - 1))) for k, v in cache.items()}
 
 
-def make_pp_forward(cfg: ModelConfig, mesh: Mesh):
+def make_pp_forward(cfg: ModelConfig, mesh: Mesh, microbatches: int = 1):
     """Build a pp-sharded function with models.llama.forward's signature.
 
     Requires cfg.n_layers % pp == 0. The returned function must be called
     with a cache (the serving engine always has one) whose leading axis is
     the full n_layers — shard_map hands each stage its L/pp block.
+
+    ``microbatches > 1`` splits the batch's SLOT axis into M groups and
+    pipelines them GPipe-style: M + P - 1 ticks instead of M * P, so the
+    per-step bubble shrinks from (P-1)/P toward (P-1)/(M+P-1) — decode
+    throughput approaches the single-stage rate while the memory split
+    stays. Each group writes its own cache slot range
+    (run_cached_layers slot_base) and inactive ticks no-op via the write
+    gate. Calls whose batch does not divide M (the engine's B=1 prefills)
+    fall back to M=1 at trace time.
     """
     n_pp = int(mesh.shape["pp"])
     if cfg.n_layers % n_pp:
@@ -114,6 +123,10 @@ def make_pp_forward(cfg: ModelConfig, mesh: Mesh):
         B, T = tokens.shape
         if cache_offsets is None:
             cache_offsets = jnp.zeros((B,), dtype=jnp.int32)
+        # trace-time microbatch choice: B=1 prefills (and any batch that
+        # does not divide M) run unpipelined
+        M = microbatches if microbatches > 1 and B % microbatches == 0 else 1
+        mb = B // M
 
         p_specs = _pp_param_specs(params)
         c_specs = _cache_specs(kv_cache)
@@ -136,25 +149,54 @@ def make_pp_forward(cfg: ModelConfig, mesh: Mesh):
                     cfg.rotary_dim, cfg.max_seq_len, cfg.rope_theta, cfg.rope_scaling
                 )
                 x = params["embed"][tokens]                   # [B, T, D]
+                mbs = x.reshape(M, mb, T, -1)
+                pos_mb = positions.reshape(M, mb, T)
+                off_mb = offsets.reshape(M, mb)
 
                 def tick(carry, t):
-                    state, cache_l = carry
-                    h_in = jnp.where((stage == 0) & (t == 0), x, state)
+                    state, cache_l, outs = carry
+                    m = t - stage                  # this stage's microbatch
+                    m_idx = jnp.clip(m, 0, M - 1)
+                    active = (m >= 0) & (m < M)
+                    # stage 0 ingests microbatch t while any remain
+                    h_in = jnp.where(
+                        (stage == 0) & (t < M),
+                        jax.lax.dynamic_index_in_dim(
+                            mbs, jnp.clip(t, 0, M - 1), axis=0, keepdims=False
+                        ),
+                        state,
+                    )
                     h_out, cache_l = run_cached_layers(
-                        params["layers"], cfg, h_in, positions, cos, sin,
-                        cache_l, offsets,
+                        params["layers"], cfg, h_in,
+                        jax.lax.dynamic_index_in_dim(pos_mb, m_idx, 0, keepdims=False),
+                        cos, sin, cache_l,
+                        jax.lax.dynamic_index_in_dim(off_mb, m_idx, 0, keepdims=False),
                         fresh_prefill=fresh_prefill,
-                        write_gate=(t == stage),
+                        write_gate=active,
+                        slot_base=m_idx * mb,
+                    )
+                    # last stage emits microbatch t-(P-1) once the pipe fills
+                    out_idx = t - (n_pp - 1)
+                    emitted = jax.lax.dynamic_update_index_in_dim(
+                        outs, h_out, jnp.clip(out_idx, 0, M - 1), axis=0
+                    )
+                    outs = jnp.where(
+                        (stage == n_pp - 1) & (out_idx >= 0), emitted, outs
                     )
                     state = jax.lax.ppermute(h_out, "pp", perm)
-                    return (state, cache_l), None
+                    return (state, cache_l, outs), None
 
-                (state, cache_out), _ = jax.lax.scan(
-                    tick, (jnp.zeros_like(x), cache), jnp.arange(n_pp)
+                outs0 = jnp.zeros((M, mb, T, x.shape[-1]), dtype=x.dtype)
+                (_, cache_out, outs), _ = jax.lax.scan(
+                    tick, (jnp.zeros_like(mbs[0]), cache, outs0),
+                    jnp.arange(M + n_pp - 1),
                 )
-                # after P ticks the final hidden has been permuted back onto
-                # stage 0; select it and unembed there, then broadcast
-                h = state
+                # only the last stage holds real outputs; broadcast, then
+                # every stage computes identical (replicated) logits
+                outs = jax.lax.psum(
+                    jnp.where(stage == n_pp - 1, outs, jnp.zeros_like(outs)), "pp"
+                )
+                h = outs.reshape(B, T, -1)
                 if has_li:
                     h = h[jnp.arange(B)[:, None], li[:, None]]
                 if cfg.block == "phi":
@@ -167,8 +209,7 @@ def make_pp_forward(cfg: ModelConfig, mesh: Mesh):
                 logits = (h @ head.T).astype(jnp.float32)
                 if cfg.block == "phi":
                     logits = logits + params["lm_head_b"].astype(jnp.float32)
-                logits = jnp.where(stage == 0, logits, 0.0)
-                return jax.lax.psum(logits, "pp"), cache_out
+                return logits, cache_out
 
             return inner(params, tokens, positions, cache, offsets, li)
 

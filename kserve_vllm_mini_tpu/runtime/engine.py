@@ -115,6 +115,11 @@ class EngineConfig:
     # output is bit-identical to plain greedy decode; sampled requests fall
     # back to the normal sweep.
     spec_tokens: int = 0
+    # serving-PP microbatches: slot groups pipelined GPipe-style through the
+    # stages (parallel/serving_pp.py); 1 = unpipelined. Only used on pp>1
+    # meshes; must divide max_slots or the decode sweep falls back to
+    # unpipelined at trace time.
+    pp_microbatches: int = 1
 
 
 @dataclass
@@ -193,7 +198,9 @@ class Engine:
         if mesh is not None and mesh.shape.get("pp", 1) > 1:
             from kserve_vllm_mini_tpu.parallel.serving_pp import make_pp_forward
 
-            self._fwd = make_pp_forward(cfg, mesh)
+            self._fwd = make_pp_forward(
+                cfg, mesh, microbatches=max(self.ecfg.pp_microbatches, 1)
+            )
             if drafter is not None:
                 raise ValueError(
                     "speculative decoding is not supported with serving "

@@ -45,6 +45,7 @@ def build_engine(
     drafter: Optional[str] = None,
     spec_tokens: int = 0,
     pp: int = 0,
+    pp_microbatches: int = 1,
     scan_unroll: int = 1,
 ) -> tuple[Engine, Tokenizer, str]:
     """Construct (engine, tokenizer, model_name) from a preset or checkpoint.
@@ -158,6 +159,7 @@ def build_engine(
         kv_cache_dtype=kv_cache_dtype,
         decode_chunk=decode_chunk,
         spec_tokens=spec_tokens if drafter_pair is not None else 0,
+        pp_microbatches=pp_microbatches,
     )
     engine = Engine(
         params, cfg, ecfg, mesh=mesh, pad_id=tok.pad_id, drafter=drafter_pair
@@ -606,6 +608,9 @@ def register(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--pp", type=int, default=0,
                         help="Serving pipeline-parallel stages (layer-range "
                              "sharding over a pure-pp mesh; overrides --topology)")
+    parser.add_argument("--pp-microbatches", type=int, default=1,
+                        help="Slot groups pipelined per step with --pp "
+                             "(GPipe-style; shrinks the stage bubble)")
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--quantization", default="none",
                         choices=["none", "int8", "int4"],
@@ -647,6 +652,7 @@ def run(args: argparse.Namespace) -> int:
         max_seq_len=args.max_seq_len,
         topology=args.topology,
         pp=args.pp,
+        pp_microbatches=args.pp_microbatches,
         scan_unroll=args.scan_unroll,
         seed=args.seed,
         quantization=args.quantization,

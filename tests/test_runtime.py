@@ -717,3 +717,32 @@ def test_moe_engine_serves_on_ep_mesh():
     mesh = make_mesh(MeshSpec(dp=2, ep=2, tp=2))
     sharded = run(shard_params(moe_params, moe_cfg, mesh), mesh)
     assert single == sharded
+
+
+def test_serving_pp_microbatched_engine_matches_oracle(params):
+    """pp=2 with 2 pipelined slot groups (GPipe microbatching in
+    parallel/serving_pp.py) must still emit exactly the sequential greedy
+    tokens — concurrent requests, chunked prompts and all."""
+    from kserve_vllm_mini_tpu.parallel.mesh import MeshSpec, make_mesh
+    from kserve_vllm_mini_tpu.parallel.sharding import shard_params
+
+    mesh = make_mesh(MeshSpec(pp=2))
+    eng = Engine(
+        shard_params(params, CFG, mesh), CFG,
+        EngineConfig(max_slots=4, max_seq_len=128, max_prefill_len=32,
+                     min_prefill_bucket=16, pp_microbatches=2),
+        mesh=mesh,
+    )
+    eng.start()
+    try:
+        prompts = [[3, 1, 4, 1, 5], [9, 2, 6, 5], list(range(2, 50)), [27]]
+        refs = [greedy_reference(params, p, 6) for p in prompts]
+        handles = [
+            eng.submit(GenRequest(prompt_tokens=list(p), max_new_tokens=6))
+            for p in prompts
+        ]
+        for h, ref in zip(handles, refs):
+            toks, _ = _drain(h)
+            assert toks == ref
+    finally:
+        eng.stop()
