@@ -23,6 +23,8 @@ class BackendConfig:
     model_uri: str = ""
     model_id: str = "meta-llama/Llama-3.1-8B-Instruct"
     tensor_parallel: int = 0          # 0 => all chips in the slice
+    pipeline_parallel: int = 0        # 0/1 => off; >1 => layer-range stages
+                                      # on a pure-pp mesh (serving_pp.py)
     quantization: str = "none"        # none | int8 | int4 (fp8: no kernel path)
     kv_cache_dtype: str = "auto"
     max_model_len: int = 4096
@@ -109,6 +111,8 @@ def _jax_native_env(cfg: BackendConfig, topo: TpuTopology) -> dict[str, str]:
         "KVMINI_MAX_MODEL_LEN": str(cfg.max_model_len),
         "KVMINI_MAX_BATCH": str(cfg.max_batch_size),
         "KVMINI_QUANTIZATION": cfg.quantization,
+        **({"KVMINI_PP": str(cfg.pipeline_parallel)}
+           if cfg.pipeline_parallel > 1 else {}),
     }
     if cfg.kv_cache_dtype != "auto":
         env["KVMINI_KV_CACHE_DTYPE"] = cfg.kv_cache_dtype

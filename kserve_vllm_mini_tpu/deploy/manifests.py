@@ -189,6 +189,7 @@ def spec_from_args(args: argparse.Namespace) -> DeploySpec:
         model_uri=args.model_uri or "",
         model_id=args.model_id,
         tensor_parallel=args.tensor_parallel,
+        pipeline_parallel=args.pipeline_parallel,
         quantization=args.quantization,
         max_model_len=args.max_model_len,
         drafter_model_id=args.drafter or "",
@@ -216,6 +217,9 @@ def register(parser: argparse.ArgumentParser) -> None:
                         help="TPU slice (v5e-1/v5e-4/v5e-8/v5p-8/v5p-16/v6e-8)")
     parser.add_argument("--model-uri", default=None, help="gs:// or s3:// model store")
     parser.add_argument("--model-id", default="meta-llama/Llama-3.1-8B-Instruct")
+    parser.add_argument("--pipeline-parallel", type=int, default=0,
+                        help="Serving PP stages (layer-range; pure-pp mesh) "
+                             "forwarded to the jax-native runtime as KVMINI_PP")
     parser.add_argument("--tensor-parallel", type=int, default=0,
                         help="TP size (0 = all chips in the slice)")
     parser.add_argument("--quantization", default="none")

@@ -166,14 +166,25 @@ def make_pp_forward(cfg: ModelConfig, mesh: Mesh, microbatches: int = 1):
                         ),
                         state,
                     )
+                    if M == 1:
+                        # keep the unpipelined path free of the per-layer
+                        # dynamic K/V slices slot_base implies (trace-time
+                        # branch; bit-identical to the pre-microbatch code)
+                        pos_t, off_t, base_t = positions, offsets, None
+                    else:
+                        pos_t = jax.lax.dynamic_index_in_dim(
+                            pos_mb, m_idx, 0, keepdims=False
+                        )
+                        off_t = jax.lax.dynamic_index_in_dim(
+                            off_mb, m_idx, 0, keepdims=False
+                        )
+                        base_t = m_idx * mb
                     h_out, cache_l = run_cached_layers(
-                        params["layers"], cfg, h_in,
-                        jax.lax.dynamic_index_in_dim(pos_mb, m_idx, 0, keepdims=False),
-                        cos, sin, cache_l,
-                        jax.lax.dynamic_index_in_dim(off_mb, m_idx, 0, keepdims=False),
+                        params["layers"], cfg, h_in, pos_t,
+                        cos, sin, cache_l, off_t,
                         fresh_prefill=fresh_prefill,
                         write_gate=active,
-                        slot_base=m_idx * mb,
+                        slot_base=base_t,
                     )
                     # last stage emits microbatch t-(P-1) once the pipe fills
                     out_idx = t - (n_pp - 1)

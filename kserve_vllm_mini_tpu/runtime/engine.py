@@ -198,9 +198,14 @@ class Engine:
         if mesh is not None and mesh.shape.get("pp", 1) > 1:
             from kserve_vllm_mini_tpu.parallel.serving_pp import make_pp_forward
 
-            self._fwd = make_pp_forward(
-                cfg, mesh, microbatches=max(self.ecfg.pp_microbatches, 1)
-            )
+            mb = max(self.ecfg.pp_microbatches, 1)
+            if mb > 1 and self.ecfg.max_slots % mb:
+                raise ValueError(
+                    f"pp_microbatches={mb} must divide max_slots="
+                    f"{self.ecfg.max_slots}, or every decode sweep would "
+                    "silently fall back to unpipelined"
+                )
+            self._fwd = make_pp_forward(cfg, mesh, microbatches=mb)
             if drafter is not None:
                 raise ValueError(
                     "speculative decoding is not supported with serving "

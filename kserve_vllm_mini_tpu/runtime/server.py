@@ -640,6 +640,7 @@ def run(args: argparse.Namespace) -> int:
     from aiohttp import web
 
     drafter = args.drafter or os.environ.get("KVMINI_DRAFTER")
+    pp = args.pp or int(os.environ.get("KVMINI_PP", "0") or 0)
     spec_tokens = args.spec_tokens
     if spec_tokens is None:
         spec_tokens = int(os.environ.get("KVMINI_SPEC_TOKENS", "4" if drafter else "0"))
@@ -651,7 +652,7 @@ def run(args: argparse.Namespace) -> int:
         decode_chunk=args.decode_chunk,
         max_seq_len=args.max_seq_len,
         topology=args.topology,
-        pp=args.pp,
+        pp=pp,
         pp_microbatches=args.pp_microbatches,
         scan_unroll=args.scan_unroll,
         seed=args.seed,
