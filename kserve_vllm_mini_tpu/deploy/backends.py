@@ -24,7 +24,9 @@ class BackendConfig:
     model_id: str = "meta-llama/Llama-3.1-8B-Instruct"
     tensor_parallel: int = 0          # 0 => all chips in the slice
     pipeline_parallel: int = 0        # 0/1 => off; >1 => layer-range stages
-                                      # on a pure-pp mesh (serving_pp.py)
+                                      # (jax-native: pure-pp mesh, serving_pp.py;
+                                      # vllm-tpu: --pipeline-parallel-size)
+    pp_microbatches: int = 1          # jax-native: GPipe slot groups per step
     quantization: str = "none"        # none | int8 | int4 (fp8: no kernel path)
     kv_cache_dtype: str = "auto"
     max_model_len: int = 4096
@@ -47,7 +49,16 @@ class Backend:
     args_fn: Callable[[BackendConfig, TpuTopology], list[str]] = lambda c, t: []
 
 
+def _require_no_pp(cfg: BackendConfig, backend: str) -> None:
+    if cfg.pipeline_parallel > 1:
+        raise ValueError(
+            f"{backend} has no pipeline-parallel knob; drop "
+            "--pipeline-parallel or use the jax-native/vllm-tpu backend"
+        )
+
+
 def _jetstream_env(cfg: BackendConfig, topo: TpuTopology) -> dict[str, str]:
+    _require_no_pp(cfg, "jetstream")
     env = {
         "MODEL_ID": cfg.model_id,
         "TOKENIZER_PATH": cfg.model_uri or cfg.model_id,
@@ -99,11 +110,23 @@ def _vllm_tpu_args(cfg: BackendConfig, topo: TpuTopology) -> list[str]:
         args.append(f"--kv-cache-dtype={cfg.kv_cache_dtype}")
     if cfg.drafter_model_id:
         args.append(f"--speculative-model={cfg.drafter_model_id}")
+    if cfg.pipeline_parallel > 1:
+        args.append(f"--pipeline-parallel-size={cfg.pipeline_parallel}")
     return args
 
 
 def _jax_native_env(cfg: BackendConfig, topo: TpuTopology) -> dict[str, str]:
     """The in-repo runtime (runtime/server.py) packaged as a container."""
+    if cfg.pipeline_parallel > 1 and total_chips(topo) != cfg.pipeline_parallel:
+        # the runtime builds a pure-pp mesh of exactly pp devices; a bigger
+        # slice would silently idle the rest (serving_pp.py rejects mixed
+        # meshes, so tp cannot absorb them)
+        raise ValueError(
+            f"pipeline_parallel={cfg.pipeline_parallel} on a "
+            f"{total_chips(topo)}-chip slice would idle "
+            f"{total_chips(topo) - cfg.pipeline_parallel} chips — size the "
+            "topology to exactly pp chips (or drop pp and use tp)"
+        )
     env = {
         "KVMINI_MODEL_ID": cfg.model_id,
         "KVMINI_MODEL_URI": cfg.model_uri or cfg.model_id,
@@ -111,7 +134,8 @@ def _jax_native_env(cfg: BackendConfig, topo: TpuTopology) -> dict[str, str]:
         "KVMINI_MAX_MODEL_LEN": str(cfg.max_model_len),
         "KVMINI_MAX_BATCH": str(cfg.max_batch_size),
         "KVMINI_QUANTIZATION": cfg.quantization,
-        **({"KVMINI_PP": str(cfg.pipeline_parallel)}
+        **({"KVMINI_PP": str(cfg.pipeline_parallel),
+            "KVMINI_PP_MICROBATCHES": str(max(cfg.pp_microbatches, 1))}
            if cfg.pipeline_parallel > 1 else {}),
     }
     if cfg.kv_cache_dtype != "auto":

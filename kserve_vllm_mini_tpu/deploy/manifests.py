@@ -190,6 +190,7 @@ def spec_from_args(args: argparse.Namespace) -> DeploySpec:
         model_id=args.model_id,
         tensor_parallel=args.tensor_parallel,
         pipeline_parallel=args.pipeline_parallel,
+        pp_microbatches=args.pp_microbatches,
         quantization=args.quantization,
         max_model_len=args.max_model_len,
         drafter_model_id=args.drafter or "",
@@ -220,6 +221,9 @@ def register(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--pipeline-parallel", type=int, default=0,
                         help="Serving PP stages (layer-range; pure-pp mesh) "
                              "forwarded to the jax-native runtime as KVMINI_PP")
+    parser.add_argument("--pp-microbatches", type=int, default=1,
+                        help="GPipe slot groups per step with --pipeline-parallel "
+                             "(jax-native; forwarded as KVMINI_PP_MICROBATCHES)")
     parser.add_argument("--tensor-parallel", type=int, default=0,
                         help="TP size (0 = all chips in the slice)")
     parser.add_argument("--quantization", default="none")

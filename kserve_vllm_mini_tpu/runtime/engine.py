@@ -117,8 +117,8 @@ class EngineConfig:
     spec_tokens: int = 0
     # serving-PP microbatches: slot groups pipelined GPipe-style through the
     # stages (parallel/serving_pp.py); 1 = unpipelined. Only used on pp>1
-    # meshes; must divide max_slots or the decode sweep falls back to
-    # unpipelined at trace time.
+    # meshes; Engine rejects values that do not divide max_slots (a
+    # non-dividing M would silently decode unpipelined).
     pp_microbatches: int = 1
 
 

@@ -65,6 +65,8 @@ def start_local_server(
         kv_cache_dtype=profile.get("kv_cache_dtype"),
         decode_chunk=int(profile.get("decode_chunk", 1)),
         scan_unroll=int(profile.get("scan_unroll", 1)),
+        pp=int(profile.get("pp", 0)),
+        pp_microbatches=int(profile.get("pp_microbatches", 1)),
         drafter=profile.get("drafter"),
         spec_tokens=int(
             profile.get("spec_tokens", 4 if profile.get("drafter") else 0)

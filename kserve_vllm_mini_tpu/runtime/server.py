@@ -641,6 +641,11 @@ def run(args: argparse.Namespace) -> int:
 
     drafter = args.drafter or os.environ.get("KVMINI_DRAFTER")
     pp = args.pp or int(os.environ.get("KVMINI_PP", "0") or 0)
+    pp_mb = (
+        args.pp_microbatches
+        if args.pp_microbatches > 1
+        else int(os.environ.get("KVMINI_PP_MICROBATCHES", "1") or 1)
+    )
     spec_tokens = args.spec_tokens
     if spec_tokens is None:
         spec_tokens = int(os.environ.get("KVMINI_SPEC_TOKENS", "4" if drafter else "0"))
@@ -653,7 +658,7 @@ def run(args: argparse.Namespace) -> int:
         max_seq_len=args.max_seq_len,
         topology=args.topology,
         pp=pp,
-        pp_microbatches=args.pp_microbatches,
+        pp_microbatches=pp_mb,
         scan_unroll=args.scan_unroll,
         seed=args.seed,
         quantization=args.quantization,
