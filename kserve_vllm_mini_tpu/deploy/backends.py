@@ -35,7 +35,11 @@ class BackendConfig:
     extra_env: dict[str, str] = field(default_factory=dict)
 
     def effective_tp(self, topo: TpuTopology) -> int:
-        return self.tensor_parallel or total_chips(topo)
+        """tp defaulting to the whole slice — divided by pp when a backend
+        composes both (vllm-tpu), so tp x pp never exceeds the chips."""
+        if self.tensor_parallel:
+            return self.tensor_parallel
+        return total_chips(topo) // max(self.pipeline_parallel, 1)
 
 
 @dataclass(frozen=True)
@@ -126,6 +130,13 @@ def _jax_native_env(cfg: BackendConfig, topo: TpuTopology) -> dict[str, str]:
             f"{total_chips(topo)}-chip slice would idle "
             f"{total_chips(topo) - cfg.pipeline_parallel} chips — size the "
             "topology to exactly pp chips (or drop pp and use tp)"
+        )
+    if cfg.pipeline_parallel > 1 and cfg.drafter_model_id:
+        # the engine rejects this combination at boot; fail at render time
+        # instead of shipping a CrashLoop
+        raise ValueError(
+            "speculative decoding does not compose with serving pipeline "
+            "parallelism — drop the drafter or pipeline_parallel"
         )
     env = {
         "KVMINI_MODEL_ID": cfg.model_id,

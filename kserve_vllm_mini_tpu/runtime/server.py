@@ -601,16 +601,20 @@ def register(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--tokenizer", default=None, help="Local tokenizer dir")
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=8000)
-    parser.add_argument("--max-slots", type=int, default=8)
-    parser.add_argument("--max-seq-len", type=int, default=1024)
+    parser.add_argument("--max-slots", type=int, default=None,
+                        help="Decode slots (default: $KVMINI_MAX_BATCH or 8)")
+    parser.add_argument("--max-seq-len", type=int, default=None,
+                        help="Per-slot KV window (default: $KVMINI_MAX_MODEL_LEN "
+                             "or 1024)")
     parser.add_argument("--topology", default=None,
                         help="Mesh topology preset (e.g. v5e-8); default single-device")
     parser.add_argument("--pp", type=int, default=0,
                         help="Serving pipeline-parallel stages (layer-range "
                              "sharding over a pure-pp mesh; overrides --topology)")
-    parser.add_argument("--pp-microbatches", type=int, default=1,
+    parser.add_argument("--pp-microbatches", type=int, default=None,
                         help="Slot groups pipelined per step with --pp "
-                             "(GPipe-style; shrinks the stage bubble)")
+                             "(GPipe-style; shrinks the stage bubble). "
+                             "Default: $KVMINI_PP_MICROBATCHES or 1")
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--quantization", default="none",
                         choices=["none", "int8", "int4"],
@@ -640,12 +644,25 @@ def run(args: argparse.Namespace) -> int:
     from aiohttp import web
 
     drafter = args.drafter or os.environ.get("KVMINI_DRAFTER")
+    # container contract: the deploy layer (deploy/backends.py _jax_native_env)
+    # configures the runtime through KVMINI_* env; explicit CLI flags win
+    # (including --pp-microbatches 1 to force unpipelined decode)
     pp = args.pp or int(os.environ.get("KVMINI_PP", "0") or 0)
     pp_mb = (
         args.pp_microbatches
-        if args.pp_microbatches > 1
+        if args.pp_microbatches is not None
         else int(os.environ.get("KVMINI_PP_MICROBATCHES", "1") or 1)
     )
+    max_slots = args.max_slots or int(os.environ.get("KVMINI_MAX_BATCH", "8") or 8)
+    max_seq = args.max_seq_len or int(
+        os.environ.get("KVMINI_MAX_MODEL_LEN", "1024") or 1024
+    )
+    quantization = (
+        args.quantization
+        if args.quantization != "none"
+        else os.environ.get("KVMINI_QUANTIZATION", "none")
+    )
+    kv_dtype = args.kv_cache_dtype or os.environ.get("KVMINI_KV_CACHE_DTYPE")
     spec_tokens = args.spec_tokens
     if spec_tokens is None:
         spec_tokens = int(os.environ.get("KVMINI_SPEC_TOKENS", "4" if drafter else "0"))
@@ -653,23 +670,23 @@ def run(args: argparse.Namespace) -> int:
         model=args.model,
         checkpoint=args.checkpoint,
         tokenizer_path=args.tokenizer,
-        max_slots=args.max_slots,
+        max_slots=max_slots,
         decode_chunk=args.decode_chunk,
-        max_seq_len=args.max_seq_len,
+        max_seq_len=max_seq,
         topology=args.topology,
         pp=pp,
         pp_microbatches=pp_mb,
         scan_unroll=args.scan_unroll,
         seed=args.seed,
-        quantization=args.quantization,
-        kv_cache_dtype=args.kv_cache_dtype,
+        quantization=quantization,
+        kv_cache_dtype=kv_dtype,
         drafter=drafter,
         spec_tokens=spec_tokens,
     )
     engine.start()
     app = make_app(engine, tok, name)
     print(f"kvmini-tpu serve: {name} on http://{args.host}:{args.port} "
-          f"(slots={args.max_slots}, max_seq={args.max_seq_len})")
+          f"(slots={max_slots}, max_seq={max_seq})")
     try:
         web.run_app(app, host=args.host, port=args.port, print=None)
     finally:

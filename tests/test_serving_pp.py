@@ -1,0 +1,96 @@
+"""Serving pipeline-parallel executor: direct equivalence against forward().
+
+The engine-level tests (test_runtime.py) prove end-to-end token equality;
+these prove the executor itself — logits AND cache state — for the flash
+prefill, the positional-masked decode, and every microbatch factor,
+including the chunked-prefill continuation path (offset > 0).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kserve_vllm_mini_tpu.models.config import get_config
+from kserve_vllm_mini_tpu.models.llama import forward, init_kv_cache, init_params
+from kserve_vllm_mini_tpu.parallel.mesh import MeshSpec, make_mesh
+from kserve_vllm_mini_tpu.parallel.serving_pp import make_pp_forward
+
+pytestmark = pytest.mark.slow
+
+CFG = get_config("llama-tiny")
+
+
+@pytest.fixture(scope="module")
+def setup():
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    mesh = make_mesh(MeshSpec(pp=2))
+    return params, mesh
+
+
+@pytest.mark.parametrize("m", [1, 2, 4])
+def test_pp_prefill_decode_equivalence(setup, m):
+    params, mesh = setup
+    ppf = make_pp_forward(CFG, mesh, microbatches=m)
+    B, T = 4, 16
+    toks = jax.random.randint(jax.random.PRNGKey(1), (B, T), 0, CFG.vocab_size)
+    pos = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+
+    c1 = init_kv_cache(CFG, B, max_seq=64)
+    c2 = init_kv_cache(CFG, B, max_seq=64)
+    lg1, c1 = forward(params, CFG, toks, pos, c1, jnp.zeros((B,), jnp.int32),
+                      fresh_prefill=True)
+    lg2, c2 = ppf(params, CFG, toks, pos, c2, jnp.zeros((B,), jnp.int32),
+                  fresh_prefill=True)
+    np.testing.assert_allclose(np.asarray(lg1), np.asarray(lg2), rtol=2e-2, atol=2e-2)
+    for k in c1:
+        np.testing.assert_allclose(
+            np.asarray(c1[k]), np.asarray(c2[k]), rtol=2e-2, atol=2e-2, err_msg=k
+        )
+
+    lens = jnp.full((B,), T, jnp.int32)
+    t1 = jnp.argmax(lg1[:, -1], -1).astype(jnp.int32)
+    t2 = jnp.argmax(lg2[:, -1], -1).astype(jnp.int32)
+    for _ in range(4):
+        l1, c1 = forward(params, CFG, t1[:, None], lens[:, None], c1, lens)
+        l2, c2 = ppf(params, CFG, t2[:, None], lens[:, None], c2, lens)
+        t1 = jnp.argmax(l1[:, 0], -1).astype(jnp.int32)
+        t2 = jnp.argmax(l2[:, 0], -1).astype(jnp.int32)
+        assert (np.asarray(t1) == np.asarray(t2)).all()
+        lens = lens + 1
+
+
+def test_pp_chunked_continuation_equivalence(setup):
+    """offset > 0 chunk (the chunked-prefill continuation shape) through the
+    pp executor equals plain forward — per microbatch slot group."""
+    params, mesh = setup
+    ppf = make_pp_forward(CFG, mesh, microbatches=2)
+    B, T1, T2 = 4, 16, 8
+    total = T1 + T2
+    toks = jax.random.randint(jax.random.PRNGKey(2), (B, total), 0, CFG.vocab_size)
+    pos = jnp.broadcast_to(jnp.arange(total, dtype=jnp.int32), (B, total))
+
+    c1 = init_kv_cache(CFG, B, max_seq=64)
+    c2 = init_kv_cache(CFG, B, max_seq=64)
+    _, c1 = forward(params, CFG, toks[:, :T1], pos[:, :T1], c1,
+                    jnp.zeros((B,), jnp.int32), fresh_prefill=True)
+    _, c2 = ppf(params, CFG, toks[:, :T1], pos[:, :T1], c2,
+                jnp.zeros((B,), jnp.int32), fresh_prefill=True)
+    off = jnp.full((B,), T1, jnp.int32)
+    l1, c1 = forward(params, CFG, toks[:, T1:], pos[:, T1:], c1, off)
+    l2, c2 = ppf(params, CFG, toks[:, T1:], pos[:, T1:], c2, off)
+    np.testing.assert_allclose(
+        np.asarray(l1[:, -1]), np.asarray(l2[:, -1]), rtol=2e-2, atol=2e-2
+    )
+    for k in c1:
+        np.testing.assert_allclose(
+            np.asarray(c1[k]), np.asarray(c2[k]), rtol=2e-2, atol=2e-2, err_msg=k
+        )
+
+
+def test_pp_rejects_mixed_mesh_and_bad_layers(setup):
+    params, mesh = setup
+    with pytest.raises(ValueError, match="pure-pp"):
+        make_pp_forward(CFG, make_mesh(MeshSpec(pp=2, dp=2)))
+    with pytest.raises(ValueError, match="divisible"):
+        make_pp_forward(CFG.scaled(n_layers=3), make_mesh(MeshSpec(pp=2)))
