@@ -129,6 +129,15 @@ def generate_recommendations(results: dict[str, Any]) -> list[str]:
             "energy figures are MODELED (duty-cycle x TDP), not measured — "
             "deploy the node telemetry agent for measured power"
         )
+    trunc = results.get("truncated_requests")
+    if trunc:
+        recs.append(
+            f"{trunc} request(s) had prompt HEADS dropped to fit the KV "
+            f"window ({results.get('truncated_prompt_tokens', 0)} tokens cut "
+            "from the beginnings - system prompts/examples go first): "
+            "the measured workload is NOT the submitted workload — raise "
+            "--max-seq-len or shorten prompts before comparing runs"
+        )
     if not recs:
         recs.append("all signals within budgets; no action needed")
     return recs

@@ -223,3 +223,14 @@ def test_fidelity_discriminates_quantization():
     q_fid = fidelity_metrics(ref, cand)
     assert q_fid["quality_fidelity"] < 100.0      # quantization must cost
     assert q_fid["quality_fidelity"] > 0.0        # ...but not destroy
+
+
+def test_truncation_recommendation_surfaces():
+    from kserve_vllm_mini_tpu.report.recommendations import generate_recommendations
+
+    recs = generate_recommendations({
+        "p95_ms": 100.0, "truncated_requests": 3, "truncated_prompt_tokens": 90,
+    })
+    assert any("HEADS dropped" in r and "NOT the submitted workload" in r for r in recs)
+    recs_clean = generate_recommendations({"p95_ms": 100.0})
+    assert not any("HEADS dropped" in r for r in recs_clean)
