@@ -1,0 +1,121 @@
+"""Protocol-adapter unit tests against httpx.MockTransport — the JetStream
+and KServe-v2 request/response shapes and token-counting rules, without a
+live backend (the reference's analog: tests/test_triton_tokens.py covers
+triton_token_utils.py's counting the same way, SURVEY.md §4.1)."""
+
+import asyncio
+import json
+
+import httpx
+import pytest
+
+from kserve_vllm_mini_tpu.loadgen.adapters.base import GenParams
+from kserve_vllm_mini_tpu.loadgen.adapters.jetstream import ADAPTER as JETSTREAM
+from kserve_vllm_mini_tpu.loadgen.adapters.kserve_v2 import ADAPTER as KSERVE
+
+PARAMS = GenParams(max_tokens=16, temperature=0.0)
+
+
+def _call(adapter, handler, stream, model="m"):
+    async def go():
+        transport = httpx.MockTransport(handler)
+        async with httpx.AsyncClient(transport=transport) as client:
+            return await adapter.generate(
+                client, "http://x", model, "hello world", PARAMS, stream
+            )
+
+    return asyncio.run(go())
+
+
+# --------------------------------------------------------------- jetstream --
+
+def test_jetstream_non_stream_counts_explicit_tokens():
+    def handler(request: httpx.Request) -> httpx.Response:
+        assert request.url.path == "/generate"
+        body = json.loads(request.content)
+        assert body["prompt"] == "hello world" and body["max_tokens"] == 16
+        return httpx.Response(200, json={"response": "hi there", "output_tokens": 7})
+
+    res = _call(JETSTREAM, handler, stream=False)
+    assert res.ok and res.text == "hi there" and res.tokens_out == 7
+
+
+def test_jetstream_non_stream_heuristic_fallback():
+    def handler(request):
+        return httpx.Response(200, json={"text": "abcdefgh"})  # no token field
+
+    res = _call(JETSTREAM, handler, stream=False)
+    assert res.ok and res.tokens_out == 2  # len/4 heuristic
+
+
+def test_jetstream_stream_concatenates_sse_events():
+    def handler(request):
+        assert json.loads(request.content)["stream"] is True
+        sse = b"".join(
+            b'data: {"text": "%s"}\n\n' % piece for piece in (b"he", b"llo", b"!")
+        ) + b"data: [DONE]\n\n"
+        return httpx.Response(200, content=sse)
+
+    res = _call(JETSTREAM, handler, stream=True)
+    assert res.ok and res.text == "hello!"
+    assert res.tokens_out >= 1
+
+
+def test_jetstream_http_error_is_recorded_not_raised():
+    def handler(request):
+        return httpx.Response(503, json={"error": "overloaded"})
+
+    res = _call(JETSTREAM, handler, stream=False)
+    assert not res.ok and res.error == "http-503" and res.status_code == 503
+
+
+# --------------------------------------------------------------- kserve-v2 --
+
+def test_kserve_non_stream_model_path_and_tokens():
+    def handler(request):
+        assert request.url.path == "/v2/models/llm/generate"
+        return httpx.Response(
+            200, json={"text_output": "out", "output_token_count": 5}
+        )
+
+    res = _call(KSERVE, handler, stream=False, model="llm")
+    assert res.ok and res.text == "out" and res.tokens_out == 5
+
+
+def test_kserve_triton_outputs_tensor_counting():
+    """Token counts can ride the v2 outputs tensor list
+    (reference scripts/triton_token_utils.py:4-21 shape)."""
+    def handler(request):
+        return httpx.Response(200, json={
+            "text_output": "xyz",
+            "outputs": [
+                {"name": "other", "data": [1]},
+                {"name": "sequence_length", "data": [11]},
+            ],
+        })
+
+    res = _call(KSERVE, handler, stream=False)
+    assert res.ok and res.tokens_out == 11
+
+
+def test_kserve_stream_accumulates_per_chunk_counts():
+    """Chunks report their OWN token counts, which must accumulate —
+    not overwrite (reference triton_token_utils.py:24-52)."""
+    def handler(request):
+        assert request.url.path == "/v2/models/m/generate_stream"
+        sse = (
+            b'data: {"text_output": "a", "output_token_count": 2}\n\n'
+            b'data: {"text_output": "b", "output_token_count": 3}\n\n'
+        )
+        return httpx.Response(200, content=sse)
+
+    res = _call(KSERVE, handler, stream=True)
+    assert res.ok and res.text == "ab" and res.tokens_out == 5
+
+
+def test_kserve_connection_error_recorded():
+    def handler(request):
+        raise httpx.ConnectError("refused")
+
+    res = _call(KSERVE, handler, stream=False)
+    assert not res.ok and res.error == "ConnectError"
