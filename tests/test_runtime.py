@@ -746,3 +746,21 @@ def test_serving_pp_microbatched_engine_matches_oracle(params):
             assert toks == ref
     finally:
         eng.stop()
+
+
+def test_spec_decode_with_chunked_prompt_matches_oracle(params, drafter_params):
+    """A prompt past the prefill bucket chunk-prefills BOTH the target and
+    the drafter cache (engine _prefill_chunks draft=True), and spec rounds
+    from that context still emit exactly the greedy sequence."""
+    eng = make_spec_engine(params, drafter_params, spec_tokens=3)
+    try:
+        prompt = [(13 * i + 7) % CFG.vocab_size for i in range(90)]  # > 64 bucket
+        ref = greedy_reference(params, prompt, 10)
+        h = eng.submit(GenRequest(prompt_tokens=list(prompt), max_new_tokens=10))
+        toks, info = _drain(h)
+        assert toks == ref
+        assert not h.request.truncated
+        stats = eng.snapshot_stats()
+        assert stats.get("spec_rounds", 0) >= 1
+    finally:
+        eng.stop()

@@ -94,3 +94,38 @@ def test_pp_rejects_mixed_mesh_and_bad_layers(setup):
         make_pp_forward(CFG, make_mesh(MeshSpec(pp=2, dp=2)))
     with pytest.raises(ValueError, match="divisible"):
         make_pp_forward(CFG.scaled(n_layers=3), make_mesh(MeshSpec(pp=2)))
+
+
+@pytest.mark.parametrize("m", [1, 2])
+def test_pp_with_int8_kv_cache(setup, m):
+    """The pp executor's gated writes cover the int8-KV scale tensors too:
+    quantized-cache prefill+decode over pp equals the single-device
+    quantized path bit-for-bit on the emitted argmax."""
+    params, mesh = setup
+    ppf = make_pp_forward(CFG, mesh, microbatches=m)
+    B, T = 4, 16
+    toks = jax.random.randint(jax.random.PRNGKey(3), (B, T), 0, CFG.vocab_size)
+    pos = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+
+    c1 = init_kv_cache(CFG, B, max_seq=64, quantized=True)
+    c2 = init_kv_cache(CFG, B, max_seq=64, quantized=True)
+    lg1, c1 = forward(params, CFG, toks, pos, c1, jnp.zeros((B,), jnp.int32),
+                      fresh_prefill=True)
+    lg2, c2 = ppf(params, CFG, toks, pos, c2, jnp.zeros((B,), jnp.int32),
+                  fresh_prefill=True)
+    for k in c1:  # includes k_s / v_s scale tensors
+        np.testing.assert_allclose(
+            np.asarray(c1[k], np.float32), np.asarray(c2[k], np.float32),
+            rtol=2e-2, atol=2e-2, err_msg=k,
+        )
+    lens = jnp.full((B,), T, jnp.int32)
+    t1 = jnp.argmax(lg1[:, -1], -1).astype(jnp.int32)
+    t2 = jnp.argmax(lg2[:, -1], -1).astype(jnp.int32)
+    assert (np.asarray(t1) == np.asarray(t2)).all()
+    for _ in range(3):
+        l1, c1 = forward(params, CFG, t1[:, None], lens[:, None], c1, lens)
+        l2, c2 = ppf(params, CFG, t2[:, None], lens[:, None], c2, lens)
+        t1 = jnp.argmax(l1[:, 0], -1).astype(jnp.int32)
+        t2 = jnp.argmax(l2[:, 0], -1).astype(jnp.int32)
+        assert (np.asarray(t1) == np.asarray(t2)).all()
+        lens = lens + 1
