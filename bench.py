@@ -242,30 +242,38 @@ def main() -> int:
     from kserve_vllm_mini_tpu.analysis.telemetry import modeled_power
     from kserve_vllm_mini_tpu.costs.pricing import load_pricing
 
-    if on_tpu:
-        # price/TDP keyed by the ACTUAL chip generation, not assumed v5e
-        kind = jax.devices()[0].device_kind.lower()
-        if "v6" in kind:
-            tpu_gen = "v6e"          # Trillium reports "TPU v6 lite" — check
+    try:
+        if on_tpu:
+            # price/TDP keyed by the ACTUAL chip generation, not assumed v5e
+            kind = jax.devices()[0].device_kind.lower()
+            if "v6" in kind:
+                tpu_gen = "v6e"      # Trillium reports "TPU v6 lite" — check
                                      # the generation before the "lite" tier
-        elif "lite" in kind or "v5e" in kind:
-            tpu_gen = "v5e"
-        elif "v5" in kind:
-            tpu_gen = "v5p"
+            elif "lite" in kind or "v5e" in kind:
+                tpu_gen = "v5e"
+            elif "v5" in kind:
+                tpu_gen = "v5p"
+            else:
+                tpu_gen = "v4"
+            pricing = load_pricing()
+            chip_hourly, price_key = pricing.chip_price(tpu_gen)
+            overhead = 1.0 + pricing.overhead_factor
+            cost_per_1k = (
+                chip_hourly * overhead * n_chips / max(toks_per_sec, 1e-9) / 3.6
+            )
+            watts = modeled_power(1.0, tpu_gen) * n_chips
+            wh_per_1k = watts * (1000.0 / max(toks_per_sec, 1e-9)) / 3600.0
+            cost_basis = f"{price_key} ${chip_hourly}/chip-hr x{overhead:.2f} overhead"
+            energy_prov = f"modeled ({tpu_gen} duty 1.0 x TDP, analysis/telemetry.py)"
         else:
-            tpu_gen = "v4"
-        pricing = load_pricing()
-        chip_hourly, price_key = pricing.chip_price(tpu_gen)
-        overhead = 1.0 + pricing.overhead_factor
-        cost_per_1k = chip_hourly * overhead * n_chips / max(toks_per_sec, 1e-9) / 3.6
-        watts = modeled_power(1.0, tpu_gen) * n_chips
-        wh_per_1k = watts * (1000.0 / max(toks_per_sec, 1e-9)) / 3600.0
-        cost_basis = f"{price_key} ${chip_hourly}/chip-hr x{overhead:.2f} overhead"
-        energy_prov = f"modeled ({tpu_gen} duty 1.0 x TDP, analysis/telemetry.py)"
-    else:
-        # like mfu/bw_util: a CPU smoke run must not fabricate TPU economics
+            # like mfu/bw_util: a CPU smoke run must not fabricate TPU economics
+            cost_per_1k = wh_per_1k = 0.0
+            cost_basis = energy_prov = "n/a (not on TPU)"
+    except Exception as e:  # noqa: BLE001 — the headline number must survive
+        # a pricing-sheet or device-introspection hiccup
+        _log(f"economics skipped: {type(e).__name__}: {e}")
         cost_per_1k = wh_per_1k = 0.0
-        cost_basis = energy_prov = "n/a (not on TPU)"
+        cost_basis = energy_prov = f"unavailable ({type(e).__name__})"
 
     # -- speculative decoding measurement (KVMINI_BENCH_SPEC=k) -------------
     # Reference claim: 20-40% decode improvement at real acceptance rates
