@@ -26,13 +26,30 @@ What it measures (honest accounting per VERDICT.md round-1 #4):
 
 Model size is overridable (KVMINI_BENCH_MODEL=llama-1b etc.) so the same
 script smoke-tests on CPU; the driver runs the default 8B config.
+
+Wedge-proofing (VERDICT.md round-3 weak #1 — two straight rounds of rc=1):
+the remote-TPU relay can wedge such that every dispatch blocks FOREVER (no
+in-process call can time out of it), and backend init can raise UNAVAILABLE.
+This script therefore runs as a small orchestrator:
+
+  1. probe the backend with a no-op dispatch in a SUBPROCESS under a hard
+     timeout (a wedged relay hangs the child; the parent survives);
+  2. run the actual benchmark in a second subprocess (KVMINI_BENCH_CHILD=1)
+     under its own timeout, so even a mid-run wedge or OOM cannot keep the
+     parent from emitting its one line;
+  3. ALWAYS print exactly one JSON line on stdout and exit 0 — with
+     "status": "ok" and the measurements, or "status":
+     "tpu_unavailable"/"oom"/"timeout"/"error" plus the error tail when the
+     run could not complete.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
+import tempfile
 import time
 
 # v5e peak numbers (public spec): 819 GB/s HBM BW, 197 bf16 TFLOP/s
@@ -48,8 +65,15 @@ def _log(msg: str) -> None:
 _T_START = time.time()
 
 
-def main() -> int:
+def _run_bench() -> dict:
     import jax
+
+    # Same site-hook workaround as _probe: honor JAX_PLATFORMS even though
+    # the axon site imported jax before us (safe pre-device-touch).
+    _plat = os.environ.get("JAX_PLATFORMS")
+    if _plat:
+        jax.config.update("jax_platforms", _plat)
+
     import jax.numpy as jnp
     import numpy as np
 
@@ -440,6 +464,7 @@ def main() -> int:
         "value": round(per_chip, 1),
         "unit": "tokens/s/chip",
         "vs_baseline": round(per_chip / baseline, 3),
+        "status": "ok",
         "detail": {
             "total_tokens_per_sec": round(toks_per_sec, 1),
             "decode_step_ms": round(step_ms, 3),
@@ -466,8 +491,180 @@ def main() -> int:
     }
     if spec_detail is not None:
         result["detail"]["speculative"] = spec_detail
-    print(json.dumps(result))
+    return result
+
+
+# ---------------------------------------------------------------------------
+# Orchestration: probe -> child run -> always one parseable JSON line, rc 0.
+# ---------------------------------------------------------------------------
+
+def _bench_label() -> str:
+    model = os.environ.get("KVMINI_BENCH_MODEL", "llama-3.1-8b")
+    quant = os.environ.get("KVMINI_BENCH_QUANT", "int8")
+    slots = os.environ.get("KVMINI_BENCH_SLOTS", "64")
+    return f"{model}, {quant}, slots={slots}"
+
+
+def _classify(err_text: str) -> str:
+    if "RESOURCE_EXHAUSTED" in err_text:
+        return "oom"
+    if "UNAVAILABLE" in err_text or "Unable to initialize backend" in err_text:
+        return "tpu_unavailable"
+    return "error"
+
+
+def _emit_failure(status: str, stage: str, detail: str) -> None:
+    """The one JSON line for a run that could not measure — still parseable,
+    still carries the metric name, value 0, and the reason."""
+    record = {
+        "metric": f"decode_tokens_per_sec_per_chip ({_bench_label()}) "
+                  f"[NOT MEASURED: {status}]",
+        "value": 0.0,
+        "unit": "tokens/s/chip",
+        "vs_baseline": 0.0,
+        "status": status,
+        "detail": {
+            "stage": stage,
+            "error_tail": detail[-1500:],
+            # Last hardware measurement, for context only — self-reported
+            # (docs/PERFORMANCE.md), NOT a driver-verified value.
+            "last_measured_reference": {
+                "value": 2753.0,
+                "unit": "tokens/s/chip",
+                "config": "llama-3.1-8b int8, 64 slots, v5e",
+                "provenance": "docs/PERFORMANCE.md (builder session 2026-07-30;"
+                              " not from a BENCH_r0X.json)",
+            },
+        },
+    }
+    print(json.dumps(record))
+
+
+def _probe(timeout_s: float) -> tuple[bool, str, str]:
+    """No-op dispatch + readback in a subprocess under a hard timeout.
+
+    A wedged relay blocks the dispatch forever — only a subprocess timeout
+    can detect that (memory: every in-process call blocks with it).
+    Returns (ok, status, detail); status is authoritative ("ok" /
+    "tpu_unavailable" / "oom" / "error"), not re-derived from the text.
+    """
+    # The axon site hook imports jax at interpreter start, so the
+    # JAX_PLATFORMS env var alone is too late — mirror tests/conftest.py and
+    # update jax.config before any device is touched.
+    code = (
+        "import os, jax, numpy as np; "
+        "p = os.environ.get('JAX_PLATFORMS'); "
+        "p and jax.config.update('jax_platforms', p); "
+        "print('backend', jax.default_backend(), "
+        "float(np.asarray(jax.numpy.ones((4,)).sum())))"
+    )
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, errors="replace",
+            timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return False, "tpu_unavailable", (
+            f"probe timed out after {timeout_s:.0f}s — relay wedged "
+            "(dispatch blocks forever; see repo ops notes)"
+        )
+    if p.returncode != 0:
+        detail = f"probe rc={p.returncode}: {p.stderr.strip()[-1200:]}"
+        return False, _classify(detail), detail
+    return True, "ok", p.stdout.strip()
+
+
+def _orchestrate() -> int:
+    probe_timeout = float(os.environ.get("KVMINI_BENCH_PROBE_TIMEOUT", "90"))
+    ok, probe_status, probe_detail = _probe(probe_timeout)
+    if ok:
+        # JAX can fall back to CPU with only a warning when the TPU plugin
+        # fails to init — a "successful" CPU probe in a TPU-expected env
+        # would run the 8B flagship on CPU and produce a misleading artifact.
+        parts = probe_detail.split()
+        backend = parts[1] if len(parts) >= 2 else "?"
+        plat_env = os.environ.get("JAX_PLATFORMS", "").split(",")[0].strip().lower()
+        if plat_env in ("", "axon", "tpu") and backend != "tpu":
+            ok, probe_status = False, "tpu_unavailable"
+            probe_detail = (
+                f"probe fell back to backend {backend!r} (expected tpu; "
+                f"JAX_PLATFORMS={plat_env or '<unset>'}): {probe_detail}"
+            )
+    if not ok:
+        _log(f"backend probe failed: {probe_detail}")
+        _emit_failure(probe_status, "probe", probe_detail)
+        return 0
+    _log(f"backend probe ok: {probe_detail}")
+
+    # The child gets a generous but finite budget: a warm full run is 3-5 min
+    # on the relay; first-compile adds ~1 min. A mid-run wedge hangs the
+    # child, not us.
+    run_timeout = float(os.environ.get("KVMINI_BENCH_TIMEOUT", "900"))
+    env = dict(os.environ, KVMINI_BENCH_CHILD="1")
+    with tempfile.NamedTemporaryFile("w+", suffix=".bench-stderr",
+                                     errors="replace") as errf:
+        try:
+            p = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env=env, stdout=subprocess.PIPE, stderr=errf, text=True,
+                errors="replace", timeout=run_timeout,
+            )
+            rc, out = p.returncode, p.stdout
+        except subprocess.TimeoutExpired as e:
+            # None (not an int) — a signal-killed child reports negative
+            # returncodes that must fall through to _classify, not here
+            rc, out = None, (e.stdout or "")
+            if isinstance(out, bytes):
+                out = out.decode(errors="replace")
+        errf.seek(0)
+        err_text = errf.read()
+    # Re-emit the child's stage log so interactive runs keep their trace.
+    sys.stderr.write(err_text)
+    sys.stderr.flush()
+
+    # The child's LAST parseable JSON line is the result (teardown noise or
+    # a post-print crash must not cost us the measurement).
+    result_line = None
+    for line in out.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                parsed = json.loads(line)
+                if isinstance(parsed, dict) and "metric" in parsed:
+                    result_line = line
+            except ValueError:
+                continue
+    if result_line is not None:
+        print(result_line)
+        return 0
+    if rc is None:
+        _emit_failure(
+            "timeout", "run",
+            f"benchmark child exceeded {run_timeout:.0f}s "
+            f"(likely mid-run relay wedge); stderr tail: {err_text[-1200:]}",
+        )
+        return 0
+    _emit_failure(_classify(err_text), "run",
+                  f"child rc={rc}; stderr tail: {err_text[-1500:]}")
     return 0
+
+
+def main() -> int:
+    if os.environ.get("KVMINI_BENCH_CHILD") == "1":
+        # Child: do the real work; parent structures any failure. flush —
+        # the pipe is block-buffered, and a post-print teardown wedge must
+        # not strand the finished measurement in the buffer when the parent
+        # SIGKILLs the child.
+        print(json.dumps(_run_bench()), flush=True)
+        return 0
+    try:
+        return _orchestrate()
+    except Exception:  # noqa: BLE001 — the one-JSON-line contract is absolute
+        import traceback
+
+        _emit_failure("error", "orchestrator", traceback.format_exc())
+        return 0
 
 
 if __name__ == "__main__":
