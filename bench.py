@@ -57,6 +57,18 @@ V5E_HBM_GBPS = 819.0
 V5E_BF16_TFLOPS = 197.0
 
 
+def _env_model() -> str:
+    return os.environ.get("KVMINI_BENCH_MODEL", "llama-3.1-8b")
+
+
+def _env_quant() -> str:
+    return os.environ.get("KVMINI_BENCH_QUANT", "int8")
+
+
+def _env_slots() -> int:
+    return int(os.environ.get("KVMINI_BENCH_SLOTS", "64"))
+
+
 def _log(msg: str) -> None:
     """Stage progress on stderr (stdout carries only the one JSON line)."""
     print(f"[bench +{time.time() - _T_START:.0f}s] {msg}", file=sys.stderr, flush=True)
@@ -89,13 +101,13 @@ def _run_bench() -> dict:
     from kserve_vllm_mini_tpu.ops.quant import quantized_bytes
     from kserve_vllm_mini_tpu.runtime.sampling import sample_tokens
 
-    model = os.environ.get("KVMINI_BENCH_MODEL", "llama-3.1-8b")
-    quant = os.environ.get("KVMINI_BENCH_QUANT", "int8")
+    model = _env_model()
+    quant = _env_quant()
     kv_quant = os.environ.get("KVMINI_BENCH_KV", "") == "int8"
     # 64 slots: the 9 GB int8 weight stream per decode step amortizes over
     # 2x the tokens vs 32 (measured 1710 -> 2774 tok/s/chip on the v5e);
     # 64 x 512-token bf16 KV (4.3 GB) + weights still fit 16 GB HBM
-    slots = int(os.environ.get("KVMINI_BENCH_SLOTS", "64"))
+    slots = _env_slots()
     prompt_len = 128
     max_seq = 512
     decode_steps = int(os.environ.get("KVMINI_BENCH_STEPS", "128"))
@@ -499,10 +511,7 @@ def _run_bench() -> dict:
 # ---------------------------------------------------------------------------
 
 def _bench_label() -> str:
-    model = os.environ.get("KVMINI_BENCH_MODEL", "llama-3.1-8b")
-    quant = os.environ.get("KVMINI_BENCH_QUANT", "int8")
-    slots = os.environ.get("KVMINI_BENCH_SLOTS", "64")
-    return f"{model}, {quant}, slots={slots}"
+    return f"{_env_model()}, {_env_quant()}, slots={_env_slots()}"
 
 
 def _classify(err_text: str) -> str:

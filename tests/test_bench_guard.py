@@ -129,6 +129,9 @@ def test_main_signal_killed_child_not_timeout(bench, monkeypatch, capsys):
 
 def test_main_reemits_child_json(bench, monkeypatch, capsys, tmp_path):
     """Parent must re-emit the child's last metric line verbatim."""
+    # self-contained: don't rely on conftest's global JAX_PLATFORMS pin to
+    # get the stubbed cpu probe past the TPU-expected fallback guard
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
     good = {"metric": "decode_tokens_per_sec_per_chip (x)", "value": 123.0,
             "unit": "tokens/s/chip", "vs_baseline": 0.06, "status": "ok",
             "detail": {}}
