@@ -283,3 +283,26 @@ def test_markdown_report_renders():
     options = plan(PlanInput(target_rps=5.0, model_size="8b"), load_pricing())
     md = markdown_report(PlanInput(target_rps=5.0), options)
     assert "| rank |" in md and "v5e" in md
+
+
+def test_plan_labels_baseline_provenance():
+    """Extrapolated rows must be labeled in the user-facing report, not
+    only in a source comment: v5e 8b is measured, v5p is scaled, and a
+    calibrated accel says calibrated."""
+    pricing = load_pricing()
+    options = plan(PlanInput(target_rps=10.0, model_size="8b",
+                             accelerators=["v5e", "v5p"]), pricing)
+    by_accel = {o.accelerator: o for o in options}
+    assert by_accel["v5e"].baseline_provenance == "measured"
+    assert by_accel["v5p"].baseline_provenance == "scaled"
+    assert any("SCALED" in n for n in by_accel["v5p"].notes)
+    assert not any("SCALED" in n for n in by_accel["v5e"].notes)
+    md = markdown_report(PlanInput(target_rps=10.0), options)
+    assert "(measured)" in md and "(scaled)" in md
+
+    calib = plan(
+        PlanInput(target_rps=1.0, model_size="8b", accelerators=["v5e"],
+                  calibrated={"v5e": 1234.0}),
+        pricing,
+    )
+    assert calib[0].baseline_provenance == "calibrated"
