@@ -57,16 +57,21 @@ V5E_HBM_GBPS = 819.0
 V5E_BF16_TFLOPS = 197.0
 
 
+_DEFAULT_MODEL = "llama-3.1-8b"
+_DEFAULT_QUANT = "int8"
+_DEFAULT_SLOTS = "64"
+
+
 def _env_model() -> str:
-    return os.environ.get("KVMINI_BENCH_MODEL", "llama-3.1-8b")
+    return os.environ.get("KVMINI_BENCH_MODEL", _DEFAULT_MODEL)
 
 
 def _env_quant() -> str:
-    return os.environ.get("KVMINI_BENCH_QUANT", "int8")
+    return os.environ.get("KVMINI_BENCH_QUANT", _DEFAULT_QUANT)
 
 
 def _env_slots() -> int:
-    return int(os.environ.get("KVMINI_BENCH_SLOTS", "64"))
+    return int(os.environ.get("KVMINI_BENCH_SLOTS", _DEFAULT_SLOTS))
 
 
 def _log(msg: str) -> None:
@@ -511,7 +516,11 @@ def _run_bench() -> dict:
 # ---------------------------------------------------------------------------
 
 def _bench_label() -> str:
-    return f"{_env_model()}, {_env_quant()}, slots={_env_slots()}"
+    # raw env strings only: this runs on the must-never-raise failure path
+    # (a bogus KVMINI_BENCH_SLOTS must yield a labeled failure record, not
+    # an int() crash inside _emit_failure)
+    slots = os.environ.get("KVMINI_BENCH_SLOTS", _DEFAULT_SLOTS)
+    return f"{_env_model()}, {_env_quant()}, slots={slots}"
 
 
 def _classify(err_text: str) -> str:
