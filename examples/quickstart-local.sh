@@ -1,0 +1,46 @@
+#!/usr/bin/env bash
+# 5-minute local quickstart: serve the in-repo JAX runtime, hit it with the
+# OpenAI API, run a small load test, and render the report — no cluster.
+# Works on CPU (tiny preset) or one TPU chip (swap in an 8B preset + int8).
+#
+# Usage: examples/quickstart-local.sh [model-preset]   (default: llama-tiny)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+MODEL="${1:-llama-tiny}"
+PORT=8011
+
+echo "== 1. serve $MODEL on :$PORT"
+python -m kserve_vllm_mini_tpu serve --model "$MODEL" --port "$PORT" \
+  --max-slots 4 --max-seq-len 256 &
+SERVER_PID=$!
+trap 'kill $SERVER_PID 2>/dev/null || true' EXIT
+
+for _ in $(seq 1 60); do
+  curl -sf "http://127.0.0.1:$PORT/v1/models" >/dev/null 2>&1 && break
+  sleep 1
+done
+
+echo "== 2. one OpenAI chat call (streaming)"
+# (head closes the stream early; the || true keeps pipefail happy)
+curl -sN "http://127.0.0.1:$PORT/v1/chat/completions" \
+  -H 'Content-Type: application/json' \
+  -d '{"messages":[{"role":"user","content":"hello"}],"max_tokens":8,"stream":true}' \
+  | head -5 || true
+
+echo "== 3. JSON mode (grammar-constrained decoding)"
+curl -s "http://127.0.0.1:$PORT/v1/chat/completions" \
+  -H 'Content-Type: application/json' \
+  -d '{"messages":[{"role":"user","content":"Give me JSON."}],"response_format":{"type":"json_object"},"max_tokens":40}' \
+  | python -c 'import json,sys; d=json.load(sys.stdin); print(json.loads(d["choices"][0]["message"]["content"]))'
+
+echo "== 4. load test (20 requests, open-loop)"
+python -m kserve_vllm_mini_tpu loadtest --url "http://127.0.0.1:$PORT" \
+  --model "$MODEL" --requests 20 --concurrency 4 --max-tokens 8 \
+  --run-dir runs/quickstart
+
+echo "== 5. analyze + report"
+python -m kserve_vllm_mini_tpu analyze --run-dir runs/quickstart
+python -m kserve_vllm_mini_tpu report --input runs/quickstart \
+  --output runs/quickstart/report.html
+echo "report: runs/quickstart/report.html"

@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Quantization sweep -> Pareto CSV -> calibrated capacity plan.
+# Self-serves the in-repo runtime per config, so it runs anywhere
+# (CPU with the tiny preset; a real TPU chip with an 8B preset).
+#
+# Usage: examples/sweep-and-plan.sh [model-preset]   (default: llama-tiny)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+MODEL="${1:-llama-tiny}"
+OUT=runs/example-sweep
+
+echo "== 1. quantization sweep (bf16 vs int8 weights x kv dtypes)"
+python -m kserve_vllm_mini_tpu sweep quantization \
+  --model "$MODEL" --requests 10 --concurrency 2 \
+  --quantizations none,int8 --kv-dtypes auto \
+  --out-dir "$OUT"
+
+echo "== 2. capacity plan for 20 RPS at p95<=2s on an 8B deployment"
+python -m kserve_vllm_mini_tpu plan --target-rps 20 --model-size 8b \
+  --p95-budget 2000 --accelerators v5e,v5p
+echo "(rows are labeled measured/scaled/calibrated; feed a real sweep CSV"
+echo " via --calibrate-csv to replace the built-in baselines)"
