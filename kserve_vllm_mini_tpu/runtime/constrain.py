@@ -172,6 +172,17 @@ class JsonMachine:
                 out.append(b)
         return bytes(out)
 
+    def str_room(self) -> Optional[int]:
+        """Remaining capacity of the string being generated, or None when
+        not inside a string/key. Token-level masking (token_grammar.py)
+        uses this to admit multi-byte string tokens: string interiors are
+        the one place a token's bytes can advance several automaton steps
+        without ever completing the machine mid-token."""
+        f = self.stack[-1]
+        if f[0] in ("key", "str"):
+            return self.max_str - self._str_len
+        return None
+
     # -- transitions --------------------------------------------------------
 
     def _value_done(self) -> None:
@@ -355,6 +366,17 @@ class TemplateMachine:
         if self.sub is None:
             self.sub = JsonMachine(root="object")
         return self.sub.allowed(budget - tail)
+
+    def str_room(self) -> Optional[int]:
+        """String capacity inside the live JSON hole (see JsonMachine);
+        literal and choice parts are never string interiors."""
+        if self.done:
+            return None
+        p = self.parts[self.idx]
+        if (not isinstance(p, (bytes, bytearray)) and p[0] == "json"
+                and self.sub is not None):
+            return self.sub.str_room()
+        return None
 
     def advance(self, b: int) -> None:
         assert not self.done, "advance after completion"

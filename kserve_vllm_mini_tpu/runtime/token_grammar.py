@@ -1,0 +1,215 @@
+"""Token-level grammar constraints over arbitrary vocabularies.
+
+The byte automata in ``runtime/constrain.py`` (JsonMachine / TemplateMachine)
+define WHAT byte strings are legal; this module maps that onto WHICH TOKENS a
+given tokenizer may emit next — the piece the reference's engines get from
+token-grammar libraries (surface exercised by
+/root/reference/scripts/openai_parity_probe.py:104-186). Two adapters share
+the engine-facing protocol (``token_mask(budget) -> bool[V]``,
+``advance_token(id)``, ``min_close()``, ``done``):
+
+- ``ByteTokenMachine`` — the ByteTokenizer identity case: token id == byte+3.
+- ``HFTokenMachine`` — real HF vocabularies (BPE / sentencepiece / wordlevel).
+  Each token id is pre-expanded to its byte sequence once per tokenizer
+  (``HFVocabTable``); per step the mask enables
+    (a) every single-byte token whose byte the automaton allows, and
+    (b) when the automaton is inside a string, every multi-byte token made
+        purely of string-safe bytes that fits the string's remaining room
+        and leaves the close affordable.
+  (b) is what makes real-model JSON fluent (whole words per step) while (a)
+  alone already guarantees progress and closure: the table is validated at
+  build time to contain a single-byte token for every structural byte the
+  grammar can force, so the masked set can never go empty while closing
+  remains possible.
+
+Budget semantics: the engine's budget is in TOKENS; the automata count
+BYTES. Every token advances the automaton by >= 1 byte, so passing the token
+budget as the byte budget is conservative — closure within N bytes implies
+closure within N single-byte tokens.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from kserve_vllm_mini_tpu.runtime.constrain import _STR_BYTES
+
+# bytes the grammars can force as the ONLY legal continuation: structure,
+# the forced-close digit (min_close counts one digit per open value), the
+# literal bodies of true/false/null, and the tool-call template's fixed
+# literals ('[{"name": "', '", "arguments": ' — constrain.py
+# tool_call_constraint), whose every byte is forced once a call starts
+_REQUIRED_SINGLE_BYTES = bytes(set(
+    b'{}[],:" 0123456789'
+    + b"true" + b"false" + b"null"
+    + b'[{"name": "' + b'", "arguments": ' + b"}]" + b", "
+))
+
+
+class ByteTokenMachine:
+    """Token adapter for the ByteTokenizer (token id == byte + SPECIALS)."""
+
+    SPECIALS = 3
+
+    def __init__(self, machine, vocab_size: int) -> None:
+        self.machine = machine
+        self.vocab_size = vocab_size
+
+    @property
+    def done(self) -> bool:
+        return self.machine.done
+
+    def min_close(self) -> int:
+        return self.machine.min_close()
+
+    def token_mask(self, budget: int) -> np.ndarray:
+        mask = np.zeros((self.vocab_size,), dtype=bool)
+        for b in self.machine.allowed(budget):
+            tid = b + self.SPECIALS
+            if tid < self.vocab_size:
+                mask[tid] = True
+        return mask
+
+    def advance_token(self, tid: int) -> None:
+        self.machine.advance(tid - self.SPECIALS)
+
+
+class HFVocabTable:
+    """Per-tokenizer precomputation: token id -> byte expansion, plus the
+    indexes the per-step mask needs (single-byte map; string-safe
+    multi-byte tokens grouped by length)."""
+
+    def __init__(self, table: Sequence[Optional[bytes]]) -> None:
+        self.table = list(table)
+        self.n_tokens = len(self.table)
+        self.single: dict[int, int] = {}
+        str_ids: list[int] = []
+        str_lens: list[int] = []
+        strset = frozenset(_STR_BYTES)
+        for tid, bs in enumerate(self.table):
+            if not bs:
+                continue
+            if len(bs) == 1:
+                self.single.setdefault(bs[0], tid)
+            elif all(c in strset for c in bs):
+                str_ids.append(tid)
+                str_lens.append(len(bs))
+        self.str_ids = np.asarray(str_ids, dtype=np.int64)
+        self.str_lens = np.asarray(str_lens, dtype=np.int64)
+        missing = [
+            chr(b) for b in sorted(set(_REQUIRED_SINGLE_BYTES))
+            if b not in self.single
+        ]
+        if missing:
+            raise ValueError(
+                "tokenizer lacks single-byte tokens the grammar can force: "
+                f"{missing!r} — constrained decoding could deadlock, refusing"
+            )
+
+
+class HFTokenMachine:
+    """Drives a byte automaton with real-vocabulary tokens.
+
+    ``model_vocab_size`` sizes the mask to the MODEL's logits (may exceed
+    the tokenizer's id space; the excess stays disallowed)."""
+
+    def __init__(self, machine, vocab: HFVocabTable, model_vocab_size: int) -> None:
+        if vocab.n_tokens > model_vocab_size:
+            raise ValueError(
+                f"tokenizer has {vocab.n_tokens} ids but the model only "
+                f"{model_vocab_size} logits"
+            )
+        self.machine = machine
+        self.vocab = vocab
+        self.vocab_size = model_vocab_size
+
+    @property
+    def done(self) -> bool:
+        return self.machine.done
+
+    def min_close(self) -> int:
+        return self.machine.min_close()
+
+    def token_mask(self, budget: int) -> np.ndarray:
+        mask = np.zeros((self.vocab_size,), dtype=bool)
+        for b in self.machine.allowed(budget):
+            tid = self.vocab.single.get(b)
+            if tid is not None:
+                mask[tid] = True
+        # multi-byte tokens: string interiors only — they never complete the
+        # machine mid-token, every byte is string-legal, and one token spends
+        # one unit of the token budget, so the close must fit in budget-1
+        room = self.machine.str_room()
+        if room is not None and budget - 1 >= self.machine.min_close():
+            sel = self.vocab.str_ids[self.vocab.str_lens <= room]
+            mask[sel] = True
+        return mask
+
+    def advance_token(self, tid: int) -> None:
+        bs = self.vocab.table[tid] if tid < self.vocab.n_tokens else None
+        if not bs:
+            raise ValueError(f"token {tid} has no byte expansion (special?)")
+        for b in bs:
+            self.machine.advance(b)
+
+
+# -- token id -> bytes extraction -------------------------------------------
+
+def _bytelevel_decoder() -> dict[str, int]:
+    """The GPT-2 byte-level BPE printable-unicode <-> byte bijection
+    (public algorithm used by every byte-level BPE tokenizer)."""
+    bs = (
+        list(range(ord("!"), ord("~") + 1))
+        + list(range(0xA1, 0xAD))
+        + list(range(0xAE, 0x100))
+    )
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    return {chr(c): b for b, c in zip(bs, cs)}
+
+
+def token_bytes_table(hf_tokenizer) -> list[Optional[bytes]]:
+    """Byte expansion for every id of a transformers tokenizer, handling the
+    three encodings in the wild: byte-level BPE (Ġ-style), sentencepiece
+    (▁-style with <0xNN> byte fallbacks), and plain word/char vocabularies.
+    Specials map to None (never maskable)."""
+    t = getattr(hf_tokenizer, "_tok", hf_tokenizer)
+    n = len(t)
+    tokens = t.convert_ids_to_tokens(list(range(n)))
+    special_ids = set(getattr(t, "all_special_ids", []) or [])
+    sample = [s for s in tokens if s][:2000]
+    bytelevel = any("Ġ" in s or "Ċ" in s for s in sample)
+    spiece = any("▁" in s for s in sample)
+    bl = _bytelevel_decoder() if bytelevel else None
+
+    out: list[Optional[bytes]] = []
+    for tid, s in enumerate(tokens):
+        if s is None or tid in special_ids:
+            out.append(None)
+            continue
+        if bytelevel:
+            try:
+                out.append(bytes(bl[c] for c in s))
+                continue
+            except KeyError:
+                # added token stored verbatim (not byte-encoded)
+                out.append(s.encode("utf-8"))
+                continue
+        if spiece and len(s) == 6 and s.startswith("<0x") and s.endswith(">"):
+            try:
+                out.append(bytes([int(s[3:5], 16)]))
+                continue
+            except ValueError:
+                pass
+        if spiece:
+            out.append(s.replace("▁", " ").encode("utf-8"))
+        else:
+            out.append(s.encode("utf-8"))
+    return out

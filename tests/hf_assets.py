@@ -1,0 +1,48 @@
+"""Offline tiny HF tokenizer builder for real-vocab grammar tests.
+
+Writes a WordLevel fast-tokenizer (tokenizer.json + tokenizer_config.json)
+with single-character tokens for all printable ASCII (so every structural
+byte the JSON grammar can force has a single-token representation) plus a
+handful of multi-character string-safe tokens — enough to exercise the
+token-level grammar masking (runtime/token_grammar.py) without network
+access or real checkpoint assets.
+"""
+
+import json
+from pathlib import Path
+
+MULTI_TOKENS = ["hello", "world", "name", "json", "abc", "the", "value"]
+
+
+def make_tiny_hf_tokenizer(out_dir) -> Path:
+    out = Path(out_dir)
+    out.mkdir(parents=True, exist_ok=True)
+    vocab: dict[str, int] = {}
+    for b in range(0x20, 0x7F):
+        vocab[chr(b)] = len(vocab)
+    for t in MULTI_TOKENS:
+        vocab[t] = len(vocab)
+    specials = {}
+    for s in ("<pad>", "<s>", "</s>"):
+        specials[s] = vocab[s] = len(vocab)
+    tok_json = {
+        "version": "1.0",
+        "truncation": None,
+        "padding": None,
+        "added_tokens": [
+            {"id": i, "content": s, "special": True, "single_word": False,
+             "lstrip": False, "rstrip": False, "normalized": False}
+            for s, i in specials.items()
+        ],
+        "normalizer": None,
+        "pre_tokenizer": None,
+        "post_processor": None,
+        "decoder": None,
+        "model": {"type": "WordLevel", "vocab": vocab, "unk_token": " "},
+    }
+    (out / "tokenizer.json").write_text(json.dumps(tok_json))
+    (out / "tokenizer_config.json").write_text(json.dumps({
+        "tokenizer_class": "PreTrainedTokenizerFast",
+        "bos_token": "<s>", "eos_token": "</s>", "pad_token": "<pad>",
+    }))
+    return out

@@ -343,19 +343,64 @@ def test_spec_decode_concurrent_matches_oracle(params, drafter_params):
         eng.stop()
 
 
-def test_spec_decode_mixed_sampling_falls_back(params, drafter_params):
-    """A sampled request in the batch forces the normal decode sweep (the
-    accept rule is greedy-only) — output still correct for the greedy one."""
-    eng = make_spec_engine(params, drafter_params, spec_tokens=4)
+def test_spec_decode_mixed_sampling_per_slot(params, drafter_params):
+    """Per-slot gating: a sampled neighbor decodes on the plain sweep while
+    the greedy request keeps speculating in the SAME iterations — greedy
+    output bit-exact, sampled output intact, and spec rounds advance
+    (previously one sampled request disabled speculation batch-wide)."""
+    eng = Engine(
+        params, CFG,
+        EngineConfig(max_slots=4, max_seq_len=128, max_prefill_len=64,
+                     min_prefill_bucket=16, spec_tokens=4),
+        drafter=(drafter_params, DRAFTER_CFG),
+    )
+    ref = greedy_reference(params, [5, 6, 7], 12)
+    # submit BEFORE start: both admitted in the first loop pass, so every
+    # sweep — and therefore every spec round counted below — ran mixed
+    hg = eng.submit(GenRequest(prompt_tokens=[5, 6, 7], max_new_tokens=12))
+    hs = eng.submit(GenRequest(prompt_tokens=[9, 10], max_new_tokens=12,
+                               temperature=0.9))
+    eng.start()
     try:
-        ref = greedy_reference(params, [5, 6, 7], 8)
-        hg = eng.submit(GenRequest(prompt_tokens=[5, 6, 7], max_new_tokens=8))
-        hs = eng.submit(GenRequest(prompt_tokens=[9, 10], max_new_tokens=8,
-                                   temperature=0.9))
         tg, _ = _drain(hg)
         ts, _ = _drain(hs)
         assert tg == ref
-        assert len(ts) == 8
+        assert len(ts) == 12
+        assert all(0 <= t < CFG.vocab_size for t in ts)
+        assert eng.stats["spec_rounds"] > 0, (
+            "greedy slot must keep speculating next to a sampled neighbor"
+        )
+    finally:
+        eng.stop()
+
+
+def test_spec_decode_constrained_neighbor_per_slot(params, drafter_params):
+    """A grammar-constrained neighbor (masked single-step sweep) next to a
+    speculating greedy slot: both finish correctly, speculation stays on
+    for the greedy slot, and the constrained output is still valid JSON."""
+    import json as _json
+
+    from kserve_vllm_mini_tpu.runtime.constrain import json_constraint
+
+    eng = Engine(
+        params, CFG,
+        EngineConfig(max_slots=4, max_seq_len=128, max_prefill_len=64,
+                     min_prefill_bucket=16, spec_tokens=4),
+        drafter=(drafter_params, DRAFTER_CFG),
+    )
+    ref = greedy_reference(params, [5, 6, 7], 12)
+    hg = eng.submit(GenRequest(prompt_tokens=[5, 6, 7], max_new_tokens=12))
+    hc = eng.submit(GenRequest(prompt_tokens=[1, 2], max_new_tokens=60,
+                               constraint=json_constraint()))
+    eng.start()
+    try:
+        tg, _ = _drain(hg)
+        tc, info_c = _drain(hc)
+        assert tg == ref
+        parsed = _json.loads(_decode_bytes(tc))
+        assert isinstance(parsed, dict)
+        assert info_c["finish_reason"] == "stop"
+        assert eng.stats["spec_rounds"] > 0
     finally:
         eng.stop()
 
