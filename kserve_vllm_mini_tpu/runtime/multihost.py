@@ -37,10 +37,13 @@ reference's engines handle it too.
 
 from __future__ import annotations
 
+import hmac
+import os
 import pickle
 import socket
 import struct
 import threading
+import time
 from typing import Any, Iterator, Optional
 
 from kserve_vllm_mini_tpu.runtime.engine import Engine, GenRequest, RequestHandle
@@ -48,25 +51,118 @@ from kserve_vllm_mini_tpu.runtime.engine import Engine, GenRequest, RequestHandl
 _LEN = struct.Struct("!I")
 
 
+def _channel_timeout_s() -> float:
+    """Handshake window: every process must finish build_engine (minutes
+    for a sharded 70B weight load) before the channel forms."""
+    return float(os.environ.get("KVMINI_COMMAND_TIMEOUT", "600"))
+
+
+def _channel_token() -> bytes:
+    """Shared channel secret (KVMINI_COMMAND_TOKEN). The empty default
+    still rejects stray scanners via the handshake structure; production
+    deployments set a real token — the admit stream carries user
+    prompts."""
+    return os.environ.get("KVMINI_COMMAND_TOKEN", "").encode()
+
+
+def engine_fingerprint(engine: Engine) -> dict[str, Any]:
+    """Everything that must MATCH across the process group for lockstep
+    replay to produce identical jitted programs and identical state."""
+    import jax
+
+    e = engine.ecfg
+    return {
+        "model": engine.cfg.name,
+        "vocab_size": engine.cfg.vocab_size,
+        "n_layers": engine.cfg.n_layers,
+        "max_slots": e.max_slots,
+        "max_seq_len": e.max_seq_len,
+        "max_prefill_len": e.max_prefill_len,
+        "min_prefill_bucket": e.min_prefill_bucket,
+        "decode_chunk": e.decode_chunk,
+        "seed": e.seed,
+        "kv_cache_dtype": e.kv_cache_dtype,
+        "spec_tokens": e.spec_tokens,
+        "pp_microbatches": e.pp_microbatches,
+        "mesh": dict(engine.mesh.shape) if engine.mesh is not None else None,
+        "jax": jax.__version__,
+    }
+
+
+def _send_msg(conn: socket.socket, obj: Any) -> None:
+    data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    conn.sendall(_LEN.pack(len(data)) + data)
+
+
+def _recv_msg(conn: socket.socket, max_len: int = 1 << 24) -> Any:
+    def read_exact(n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = conn.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("command channel peer closed")
+            buf += chunk
+        return buf
+
+    (n,) = _LEN.unpack(read_exact(_LEN.size))
+    if n > max_len:
+        raise ConnectionError(f"oversized channel message ({n} bytes)")
+    return pickle.loads(read_exact(n))
+
+
 class CommandPublisher:
-    """Primary-side channel: accepts ``n_followers`` connections, then
+    """Primary-side channel: accepts follower connections, verifies each
+    one's handshake (shared token + engine-config fingerprint), then
     publishes pickled commands, length-prefixed, to all of them."""
 
     def __init__(self, host: str, port: int, n_followers: int,
-                 accept_timeout_s: float = 60.0) -> None:
+                 fingerprint: Optional[dict] = None,
+                 accept_timeout_s: Optional[float] = None) -> None:
+        timeout = accept_timeout_s or _channel_timeout_s()
+        token = _channel_token()
         self._srv = socket.create_server((host, port))
-        self._srv.settimeout(accept_timeout_s)
         self._conns: list[socket.socket] = []
-        for _ in range(n_followers):
-            conn, _addr = self._srv.accept()
-            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            self._conns.append(conn)
+        deadline = time.time() + timeout
+        while len(self._conns) < n_followers:
+            self._srv.settimeout(max(deadline - time.time(), 0.1))
+            conn, addr = self._srv.accept()
+            try:
+                conn.settimeout(10.0)
+                hello = _recv_msg(conn)
+                if not (isinstance(hello, dict)
+                        and hmac.compare_digest(
+                            hello.get("token", b""), token)):
+                    conn.close()
+                    continue  # stray scanner / wrong secret: slot not consumed
+                if fingerprint is not None and hello.get("fingerprint") != fingerprint:
+                    diff = {
+                        k: (fingerprint.get(k), hello.get("fingerprint", {}).get(k))
+                        for k in set(fingerprint) | set(hello.get("fingerprint") or {})
+                        if fingerprint.get(k) != (hello.get("fingerprint") or {}).get(k)
+                    }
+                    _send_msg(conn, {"ok": False, "diff": diff})
+                    conn.close()
+                    raise ValueError(
+                        f"follower {addr} engine config mismatches primary: "
+                        f"{diff} — lockstep replay would diverge"
+                    )
+                _send_msg(conn, {"ok": True})
+                conn.settimeout(None)
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                self._conns.append(conn)
+            except (ConnectionError, OSError, pickle.UnpicklingError):
+                conn.close()
         self._lock = threading.Lock()
+        self._stopped = False
 
     def publish(self, cmd: tuple) -> None:
         data = pickle.dumps(cmd, protocol=pickle.HIGHEST_PROTOCOL)
         msg = _LEN.pack(len(data)) + data
         with self._lock:
+            if self._stopped and cmd[0] == "stop":
+                return  # idempotent shutdown
+            if cmd[0] == "stop":
+                self._stopped = True
             for c in self._conns:
                 c.sendall(msg)
 
@@ -81,35 +177,36 @@ class CommandPublisher:
 
 class CommandSubscriber:
     """Follower-side channel: connects (with retries — the primary may not
-    be listening yet) and yields commands in publish order."""
+    be listening yet), handshakes (token + fingerprint), and yields
+    commands in publish order."""
 
-    def __init__(self, host: str, port: int, connect_timeout_s: float = 60.0) -> None:
-        import time as _time
-
-        deadline = _time.time() + connect_timeout_s
+    def __init__(self, host: str, port: int,
+                 fingerprint: Optional[dict] = None,
+                 connect_timeout_s: Optional[float] = None) -> None:
+        timeout = connect_timeout_s or _channel_timeout_s()
+        deadline = time.time() + timeout
         while True:
             try:
                 self._conn = socket.create_connection((host, port), timeout=5.0)
+                self._conn.settimeout(30.0)
+                _send_msg(self._conn, {
+                    "token": _channel_token(), "fingerprint": fingerprint,
+                })
+                ack = _recv_msg(self._conn)
+                if not (isinstance(ack, dict) and ack.get("ok")):
+                    # explicit rejection (config mismatch): NOT retryable —
+                    # ValueError escapes the OSError retry loop
+                    raise ValueError(f"primary rejected handshake: {ack!r}")
                 break
             except OSError:
-                if _time.time() > deadline:
+                if time.time() > deadline:
                     raise
-                _time.sleep(0.2)
+                time.sleep(0.2)
         self._conn.settimeout(None)  # commands may be minutes apart
-
-    def _read_exact(self, n: int) -> bytes:
-        buf = b""
-        while len(buf) < n:
-            chunk = self._conn.recv(n - len(buf))
-            if not chunk:
-                raise ConnectionError("publisher closed the command channel")
-            buf += chunk
-        return buf
 
     def commands(self) -> Iterator[tuple]:
         while True:
-            (n,) = _LEN.unpack(self._read_exact(_LEN.size))
-            yield pickle.loads(self._read_exact(n))
+            yield _recv_msg(self._conn)
 
     def close(self) -> None:
         self._conn.close()
@@ -191,6 +288,35 @@ def run_follower(engine: Engine, subscriber: CommandSubscriber) -> None:
             raise ValueError(f"unknown multihost command {op!r}")
 
 
+class PrimaryHandle:
+    """Lifecycle of the primary's scheduler thread + command channel.
+
+    ``shutdown()`` is SYNCHRONOUS: it publishes the stop command itself
+    (idempotent with the thread's own finally), so followers always get
+    released even when interpreter exit would otherwise freeze the daemon
+    thread mid-``finally``. ``is_alive()`` feeds the HTTP health gate — a
+    dead scheduler must turn the frontend unhealthy, not let requests
+    queue forever."""
+
+    def __init__(self, publisher: CommandPublisher, stop: threading.Event,
+                 thread: threading.Thread) -> None:
+        self._publisher = publisher
+        self._stop = stop
+        self._thread = thread
+
+    def is_alive(self) -> bool:
+        return self._thread.is_alive()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=10.0)
+        try:
+            self._publisher.publish(("stop",))
+        except OSError:
+            pass
+        self._publisher.close()
+
+
 def serve_multihost(
     engine: Engine,
     *,
@@ -198,19 +324,22 @@ def serve_multihost(
     coordinator_host: str,
     command_port: int,
     n_followers: int,
-) -> Optional[threading.Event]:
-    """Start the lockstep drivers. On the primary returns a stop Event (set
-    it to shut down; the HTTP app runs separately); on followers BLOCKS
+) -> Optional[PrimaryHandle]:
+    """Start the lockstep drivers. On the primary returns a PrimaryHandle
+    (call ``shutdown()`` when the HTTP app exits); on followers BLOCKS
     until the primary publishes stop, then returns None."""
+    fp = engine_fingerprint(engine)
     if primary:
-        publisher = CommandPublisher("0.0.0.0", command_port, n_followers)
+        publisher = CommandPublisher(
+            "0.0.0.0", command_port, n_followers, fingerprint=fp
+        )
         stop = threading.Event()
         t = threading.Thread(
             target=run_primary, args=(engine, publisher, stop),
             daemon=True, name="multihost-primary",
         )
         t.start()
-        return stop
-    sub = CommandSubscriber(coordinator_host, command_port)
+        return PrimaryHandle(publisher, stop, t)
+    sub = CommandSubscriber(coordinator_host, command_port, fingerprint=fp)
     run_follower(engine, sub)
     return None

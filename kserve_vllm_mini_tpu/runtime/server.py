@@ -182,7 +182,7 @@ def build_engine(
 
 
 def make_app(engine: Engine, tok: Tokenizer, model_name: str,
-             multihost: bool = False):
+             multihost: bool = False, alive_check=None):
     from aiohttp import web
 
     started = time.time()
@@ -386,6 +386,12 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
         if not isinstance(messages, list) or not messages:
             return web.json_response(
                 {"error": {"message": "'messages' must be a non-empty list"}}, status=400
+            )
+        if alive_check is not None and not alive_check():
+            # a dead scheduler must refuse, not enqueue forever — the load
+            # balancer sees 503 here and on /healthz and rotates the replica
+            return web.json_response(
+                {"error": {"message": "scheduler is not running"}}, status=503
             )
         max_tokens = int(body.get("max_tokens", 64))
         machine, wants_tools, err = _build_constraint(body, max_tokens)
@@ -596,6 +602,11 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
         )
 
     async def healthz(_request):
+        if alive_check is not None and not alive_check():
+            return web.json_response(
+                {"status": "unhealthy", "reason": "scheduler not running"},
+                status=503,
+            )
         return web.json_response({"status": "ok", "uptime_s": time.time() - started})
 
     profile_lock = threading.Lock()
@@ -846,11 +857,12 @@ def run(args: argparse.Namespace) -> int:
         # followers on other hosts must dial that machine
         coord_host = dist.coordinator_host()
         if dist.is_primary():
-            stop = mh.serve_multihost(
+            handle = mh.serve_multihost(
                 engine, primary=True, coordinator_host=coord_host,
                 command_port=cmd_port, n_followers=dist.process_count() - 1,
             )
-            app = make_app(engine, tok, name, multihost=True)
+            app = make_app(engine, tok, name, multihost=True,
+                           alive_check=handle.is_alive)
             print(f"kvmini-tpu serve: {name} on http://{args.host}:{args.port} "
                   f"(slots={max_slots}, max_seq={max_seq}, "
                   f"multihost primary, {dist.process_count()} processes, "
@@ -858,7 +870,9 @@ def run(args: argparse.Namespace) -> int:
             try:
                 web.run_app(app, host=args.host, port=args.port, print=None)
             finally:
-                stop.set()
+                # synchronous: followers must get the stop command even as
+                # the interpreter tears down this daemon thread's world
+                handle.shutdown()
             return 0
         print(f"kvmini-tpu serve: follower {dist.process_index()}/"
               f"{dist.process_count()} (mesh={dict(engine.mesh.shape)})",
@@ -870,7 +884,9 @@ def run(args: argparse.Namespace) -> int:
         return 0
 
     engine.start()
-    app = make_app(engine, tok, name)
+    # same health gate as multihost: a crashed scheduler loop (_running
+    # drops) flips /healthz to 503 instead of queueing requests forever
+    app = make_app(engine, tok, name, alive_check=lambda: engine._running)
     print(f"kvmini-tpu serve: {name} on http://{args.host}:{args.port} "
           f"(slots={max_slots}, max_seq={max_seq})")
     try:

@@ -91,6 +91,72 @@ def _kill(procs: list) -> None:
             pass
 
 
+def test_channel_handshake_rejects_wrong_token_and_config():
+    """The command channel must (a) not hand a follower slot to a peer
+    without the shared token, and (b) fail fast on an engine-config
+    mismatch instead of letting lockstep replay diverge."""
+    import pickle
+    import struct
+    import threading
+
+    from kserve_vllm_mini_tpu.runtime.multihost import (
+        CommandPublisher,
+        CommandSubscriber,
+    )
+
+    port = _free_port()
+    fp = {"model": "llama-tiny", "decode_chunk": 1}
+    result: dict = {}
+
+    def primary():
+        try:
+            pub = CommandPublisher("127.0.0.1", port, 1, fingerprint=fp,
+                                   accept_timeout_s=30.0)
+            result["ok"] = True
+            pub.publish(("stop",))
+            pub.close()
+        except Exception as e:  # noqa: BLE001
+            result["err"] = e
+
+    t = threading.Thread(target=primary, daemon=True)
+    t.start()
+    time.sleep(0.3)
+
+    # stray scanner: connects, sends garbage — must NOT consume the slot
+    s = socket.create_connection(("127.0.0.1", port), timeout=5)
+    junk = pickle.dumps({"token": b"wrong", "fingerprint": fp})
+    s.sendall(struct.pack("!I", len(junk)) + junk)
+    s.close()
+
+    # real follower with matching token (default '') and fingerprint
+    sub = CommandSubscriber("127.0.0.1", port, fingerprint=fp,
+                            connect_timeout_s=30.0)
+    assert next(sub.commands()) == ("stop",)
+    sub.close()
+    t.join(timeout=30)
+    assert result.get("ok"), result.get("err")
+
+    # config mismatch: explicit, non-retryable rejection on the follower
+    port2 = _free_port()
+    result2: dict = {}
+
+    def primary2():
+        try:
+            CommandPublisher("127.0.0.1", port2, 1, fingerprint=fp,
+                             accept_timeout_s=30.0)
+        except Exception as e:  # noqa: BLE001
+            result2["err"] = e
+
+    t2 = threading.Thread(target=primary2, daemon=True)
+    t2.start()
+    time.sleep(0.3)
+    with pytest.raises(ValueError, match="rejected"):
+        CommandSubscriber("127.0.0.1", port2, connect_timeout_s=30.0,
+                          fingerprint={"model": "llama-tiny", "decode_chunk": 4})
+    t2.join(timeout=30)
+    assert isinstance(result2.get("err"), ValueError)
+
+
 def test_multihost_2proc_matches_single_process(tmp_path):
     prompts = ["hello world", "the quick brown fox"]
     logs = {}
