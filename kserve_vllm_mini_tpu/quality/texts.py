@@ -1,0 +1,69 @@
+"""Curated real-text evaluation passages for the perplexity quality axis.
+
+Original prose written for this repository (no external corpus, no network
+fetch — the air-gapped CI constraint). What matters for the metric is that
+the byte statistics are REAL natural language: ordinary words, ordinary
+grammar, varied vocabulary. On text like this, quantization error moves
+next-token likelihoods in measurable ways that the generate-and-check task
+suite cannot detect on small models (SURVEY.md §7.3.6; round-3 verdict
+weak #4).
+"""
+
+EVAL_TEXTS: list[str] = [
+    (
+        "The morning train left the station four minutes late, which was "
+        "enough to miss the connection at the junction. Passengers waited "
+        "on the platform under a gray sky, watching the signal lights "
+        "change from red to amber and back again while the announcer "
+        "apologized twice for the delay."
+    ),
+    (
+        "To make the soup, chop two onions and a carrot, then cook them "
+        "slowly in a little oil until they soften. Add the stock, the "
+        "beans, and a bay leaf, and let everything simmer for half an "
+        "hour. Season with salt near the end, because the stock reduces "
+        "and grows saltier as it cooks."
+    ),
+    (
+        "The bridge was finished in the autumn of the third year. Its two "
+        "towers carried the weight of the deck through long steel cables, "
+        "each spun from thousands of individual wires. Engineers measured "
+        "the sag of the cables every week during construction, comparing "
+        "the numbers against the tables they had computed by hand."
+    ),
+    (
+        "She kept the garden small on purpose: a row of tomatoes, some "
+        "beans on poles, and a border of herbs she could reach from the "
+        "path. In July the basil grew faster than she could use it, and "
+        "the neighbors learned to expect a bundle of it left by the door "
+        "with no note."
+    ),
+    (
+        "A library is a patient kind of place. Books wait decades between "
+        "readers without complaint, and the catalog remembers every title "
+        "long after the shelves have been rearranged. The librarian knew "
+        "the collection the way a pilot knows a coastline, by landmarks "
+        "rather than by the map."
+    ),
+    (
+        "The experiment failed twice before anyone thought to check the "
+        "thermometer itself. It read three degrees high, a small error "
+        "that compounded through every calculation that followed. After "
+        "the instrument was replaced, the results matched the prediction "
+        "within the stated uncertainty."
+    ),
+    (
+        "Rain came early that winter and stayed. The river rose to the "
+        "second mark on the old stone gauge, then to the third, and the "
+        "town moved its market up the hill for the season. By spring the "
+        "water had returned to its usual channel, leaving a line of silt "
+        "on the fences to show where it had been."
+    ),
+    (
+        "He wrote letters the old way, on paper, with a pen that leaked a "
+        "little. Each one took an evening, and most said ordinary things: "
+        "the weather, the dog, a repair to the porch step. Years later, "
+        "those ordinary things were exactly what his granddaughter wanted "
+        "to read."
+    ),
+]

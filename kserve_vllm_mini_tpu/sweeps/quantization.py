@@ -46,6 +46,7 @@ def make_local_bench(
     # the grid or failed, fidelity is skipped rather than silently measured
     # against a quantized "reference" (which would invert the ordering).
     ref_capture: dict[str, Any] = {}
+    nll_cache: dict[str, Any] = {}  # quantization -> eval_text_nll result
 
     def _is_baseline(cfg: dict[str, Any]) -> bool:
         return (
@@ -90,6 +91,27 @@ def make_local_bench(
                 if "outputs" in ref_capture:
                     results.update(fidelity_metrics(ref_capture["outputs"], cap))
                     results["fidelity_reference"] = "none/model/greedy"
+                # likelihood axis: teacher-forced NLL on curated real text,
+                # computed in-process against the SAME params this config
+                # serves — the metric that separates int8 from int4 even
+                # when the task suite scores ~chance (quality/perplexity.py).
+                # Cached per quantization: kv dtype and decoding cannot
+                # change it, and each call pays a fresh jit trace.
+                q = cfg["quantization"]
+                if q not in nll_cache:
+                    from kserve_vllm_mini_tpu.quality.perplexity import (
+                        eval_text_nll,
+                    )
+
+                    nll_cache[q] = eval_text_nll(
+                        srv.engine.params, srv.engine.cfg, srv.tokenizer
+                    )
+                results["quality_nll_per_token"] = round(
+                    nll_cache[q]["nll_per_token"], 5
+                )
+                results["quality_perplexity"] = round(
+                    nll_cache[q]["perplexity"], 3
+                )
         return results
 
     return bench
@@ -99,6 +121,8 @@ def _extra(cfg: dict[str, Any], results: dict[str, Any]) -> dict[str, Any]:
     return {
         "quality_score": results.get("quality_score"),
         "quality_fidelity": results.get("quality_fidelity"),
+        "quality_nll_per_token": results.get("quality_nll_per_token"),
+        "quality_perplexity": results.get("quality_perplexity"),
         "fidelity_exact_match": results.get("fidelity_exact_match"),
         "fidelity_reference": results.get("fidelity_reference"),
         "pareto": "",     # filled after the full sweep
