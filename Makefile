@@ -2,7 +2,7 @@
 # of native code — the TPU compute path is JAX/XLA compiled at runtime.
 PY ?= python
 
-.PHONY: help test test-fast lint fmt smoke bench dashboards-validate helm-lint airgap clean
+.PHONY: help test test-fast test-policy lint fmt smoke bench dashboards-validate helm-lint airgap clean
 
 help:
 	@grep -E '^[a-z-]+:' Makefile | sed 's/:.*//' | sort | uniq
@@ -11,7 +11,7 @@ test:
 	$(PY) -m pytest tests/ -q
 
 test-fast:  ## harness-only tests (skip JAX model/runtime suites)
-	$(PY) -m pytest tests/ -q --ignore=tests/test_model.py \
+	$(PY) -m pytest tests/ -q -m "not slow" --ignore=tests/test_model.py \
 	  --ignore=tests/test_parallel.py --ignore=tests/test_flash_attention.py \
 	  --ignore=tests/test_runtime.py --ignore=tests/test_loader.py \
 	  --ignore=tests/test_quant.py
@@ -31,6 +31,9 @@ smoke:  ## full pipeline on the CPU-faked mesh, no hardware
 
 bench:  ## driver benchmark (one JSON line) on the attached accelerator
 	$(PY) bench.py
+
+test-policy:  ## policies vs a LIVE Gatekeeper (needs kubectl+cluster; skips without)
+	bash tests/policy_admission_test.sh
 
 helm-lint:
 	@command -v helm >/dev/null && helm lint charts/kvmini-tpu || \

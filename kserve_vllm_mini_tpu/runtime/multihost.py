@@ -38,8 +38,8 @@ reference's engines handle it too.
 from __future__ import annotations
 
 import hmac
+import json
 import os
-import pickle
 import socket
 import struct
 import threading
@@ -57,12 +57,12 @@ def _channel_timeout_s() -> float:
     return float(os.environ.get("KVMINI_COMMAND_TIMEOUT", "600"))
 
 
-def _channel_token() -> bytes:
+def _channel_token() -> str:
     """Shared channel secret (KVMINI_COMMAND_TOKEN). The empty default
     still rejects stray scanners via the handshake structure; production
     deployments set a real token — the admit stream carries user
     prompts."""
-    return os.environ.get("KVMINI_COMMAND_TOKEN", "").encode()
+    return os.environ.get("KVMINI_COMMAND_TOKEN", "")
 
 
 def engine_fingerprint(engine: Engine) -> dict[str, Any]:
@@ -90,7 +90,12 @@ def engine_fingerprint(engine: Engine) -> dict[str, Any]:
 
 
 def _send_msg(conn: socket.socket, obj: Any) -> None:
-    data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    # JSON, never pickle: the hello arrives from an UNAUTHENTICATED peer,
+    # and unpickling attacker bytes is arbitrary code execution — a token
+    # check after the fact cannot protect the deserializer itself. Every
+    # payload on this channel (hello, ack, admit/sweep/stop commands) is
+    # JSON-able by construction.
+    data = json.dumps(obj).encode()
     conn.sendall(_LEN.pack(len(data)) + data)
 
 
@@ -107,13 +112,16 @@ def _recv_msg(conn: socket.socket, max_len: int = 1 << 24) -> Any:
     (n,) = _LEN.unpack(read_exact(_LEN.size))
     if n > max_len:
         raise ConnectionError(f"oversized channel message ({n} bytes)")
-    return pickle.loads(read_exact(n))
+    try:
+        return json.loads(read_exact(n).decode("utf-8"))
+    except (ValueError, UnicodeDecodeError) as e:
+        raise ConnectionError(f"malformed channel message: {e}") from e
 
 
 class CommandPublisher:
     """Primary-side channel: accepts follower connections, verifies each
     one's handshake (shared token + engine-config fingerprint), then
-    publishes pickled commands, length-prefixed, to all of them."""
+    publishes JSON commands, length-prefixed, to all of them."""
 
     def __init__(self, host: str, port: int, n_followers: int,
                  fingerprint: Optional[dict] = None,
@@ -126,45 +134,85 @@ class CommandPublisher:
         while len(self._conns) < n_followers:
             self._srv.settimeout(max(deadline - time.time(), 0.1))
             conn, addr = self._srv.accept()
+            mismatch_diff = None
             try:
                 conn.settimeout(10.0)
                 hello = _recv_msg(conn)
-                if not (isinstance(hello, dict)
-                        and hmac.compare_digest(
-                            hello.get("token", b""), token)):
+                peer_tok = (hello or {}).get("token") if isinstance(hello, dict) else None
+                # compare as BYTES: compare_digest on str raises for
+                # non-ASCII, and a random-secret token may well contain it
+                if not (isinstance(peer_tok, str)
+                        and hmac.compare_digest(peer_tok.encode(), token.encode())):
+                    # wrong/garbage secret: explicit rejection so a typo'd
+                    # deployment fails fast on the follower side, slot NOT
+                    # consumed so a scanner can't starve the real follower
+                    _send_msg(conn, {"ok": False, "reason": "token mismatch"})
                     conn.close()
-                    continue  # stray scanner / wrong secret: slot not consumed
-                if fingerprint is not None and hello.get("fingerprint") != fingerprint:
-                    diff = {
-                        k: (fingerprint.get(k), hello.get("fingerprint", {}).get(k))
-                        for k in set(fingerprint) | set(hello.get("fingerprint") or {})
-                        if fingerprint.get(k) != (hello.get("fingerprint") or {}).get(k)
+                    continue
+                peer_fp = hello.get("fingerprint") or {}
+                if fingerprint is not None and peer_fp != fingerprint:
+                    mismatch_diff = {
+                        k: (fingerprint.get(k), peer_fp.get(k))
+                        for k in set(fingerprint) | set(peer_fp)
+                        if fingerprint.get(k) != peer_fp.get(k)
                     }
-                    _send_msg(conn, {"ok": False, "diff": diff})
+                    # ack + close are best-effort: the fatal raise below
+                    # must fire even if the peer already went away
+                    try:
+                        _send_msg(conn, {"ok": False, "reason": "config mismatch",
+                                         "diff": {k: list(v) for k, v in
+                                                  mismatch_diff.items()}})
+                    except OSError:
+                        pass
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
+                else:
+                    _send_msg(conn, {"ok": True})
+                    # finite SEND timeout: publish() must never block the
+                    # scheduler (or shutdown) forever on a silently-dead
+                    # follower — this socket only ever sends
+                    conn.settimeout(30.0)
+                    conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                    self._conns.append(conn)
+            except Exception:  # noqa: BLE001 — garbage traffic must not
+                # take the primary down; authenticated-path errors surface
+                # later on publish
+                try:
                     conn.close()
-                    raise ValueError(
-                        f"follower {addr} engine config mismatches primary: "
-                        f"{diff} — lockstep replay would diverge"
-                    )
-                _send_msg(conn, {"ok": True})
-                conn.settimeout(None)
-                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                self._conns.append(conn)
-            except (ConnectionError, OSError, pickle.UnpicklingError):
-                conn.close()
+                except OSError:
+                    pass
+                continue
+            if mismatch_diff is not None:
+                # an AUTHENTICATED follower with a different engine config
+                # is fatal for the whole group — lockstep would diverge
+                raise ValueError(
+                    f"follower {addr} engine config mismatches primary: "
+                    f"{mismatch_diff}"
+                )
         self._lock = threading.Lock()
         self._stopped = False
 
     def publish(self, cmd: tuple) -> None:
-        data = pickle.dumps(cmd, protocol=pickle.HIGHEST_PROTOCOL)
+        data = json.dumps(cmd).encode()
         msg = _LEN.pack(len(data)) + data
         with self._lock:
             if self._stopped and cmd[0] == "stop":
                 return  # idempotent shutdown
             if cmd[0] == "stop":
                 self._stopped = True
+            # attempt EVERY follower before raising: on a partial failure
+            # the survivors must still get the command (above all 'stop'),
+            # and any failure is fatal for lockstep so it propagates after
+            first_err: Optional[OSError] = None
             for c in self._conns:
-                c.sendall(msg)
+                try:
+                    c.sendall(msg)
+                except OSError as e:
+                    first_err = first_err or e
+            if first_err is not None:
+                raise first_err
 
     def close(self) -> None:
         for c in self._conns:
@@ -206,7 +254,8 @@ class CommandSubscriber:
 
     def commands(self) -> Iterator[tuple]:
         while True:
-            yield _recv_msg(self._conn)
+            msg = _recv_msg(self._conn)
+            yield tuple(msg) if isinstance(msg, list) else msg
 
     def close(self) -> None:
         self._conn.close()

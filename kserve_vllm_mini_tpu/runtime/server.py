@@ -183,6 +183,12 @@ def build_engine(
 
 def make_app(engine: Engine, tok: Tokenizer, model_name: str,
              multihost: bool = False, alive_check=None):
+    # default health gate: the engine's own scheduler liveness — a crashed
+    # _loop drops _running and the frontend must refuse, not enqueue
+    # forever. The multihost primary overrides with its driver thread's
+    # liveness (the engine thread never starts in that mode).
+    if alive_check is None:
+        alive_check = lambda: engine._running  # noqa: E731
     from aiohttp import web
 
     started = time.time()
@@ -387,7 +393,7 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
             return web.json_response(
                 {"error": {"message": "'messages' must be a non-empty list"}}, status=400
             )
-        if alive_check is not None and not alive_check():
+        if not alive_check():
             # a dead scheduler must refuse, not enqueue forever — the load
             # balancer sees 503 here and on /healthz and rotates the replica
             return web.json_response(
@@ -602,7 +608,7 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
         )
 
     async def healthz(_request):
-        if alive_check is not None and not alive_check():
+        if not alive_check():
             return web.json_response(
                 {"status": "unhealthy", "reason": "scheduler not running"},
                 status=503,
@@ -884,9 +890,7 @@ def run(args: argparse.Namespace) -> int:
         return 0
 
     engine.start()
-    # same health gate as multihost: a crashed scheduler loop (_running
-    # drops) flips /healthz to 503 instead of queueing requests forever
-    app = make_app(engine, tok, name, alive_check=lambda: engine._running)
+    app = make_app(engine, tok, name)
     print(f"kvmini-tpu serve: {name} on http://{args.host}:{args.port} "
           f"(slots={max_slots}, max_seq={max_seq})")
     try:

@@ -1,0 +1,122 @@
+#!/usr/bin/env bash
+# Policy admission integration test (opt-in): prove the policies in
+# policies/gatekeeper/ against a LIVE Gatekeeper admission controller, the
+# way the reference proves its policies in KIND (tests/policy_test.sh
+# behavior: violating pod flagged/denied, compliant pod admitted).
+#
+# Opt-in because it needs a cluster: run `make test-policy` with a kubectl
+# context (KIND or real). Without one it SKIPS (exit 0) unless
+# KVMINI_POLICY_TEST_REQUIRED=1, which turns missing prereqs into failure
+# (for the CI job that provisions KIND itself).
+#
+# What it asserts:
+#   1. Gatekeeper installs (or is present) and our ConstraintTemplates +
+#      Constraints apply cleanly.
+#   2. A TPU-pool pod with NO google.com/tpu limit is flagged (warn) or
+#      denied (deny), depending on the constraint's enforcementAction.
+#   3. A hostPath pod is flagged/denied.
+#   4. A compliant TPU pod (tpu request == limit, no hostPath) admits with
+#      no warning.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+NS=kvmini-policy-test
+GK_VERSION="${KVMINI_GATEKEEPER_VERSION:-3.14}"
+REQUIRED="${KVMINI_POLICY_TEST_REQUIRED:-0}"
+
+skip() {
+  echo "SKIP: $1"
+  if [ "$REQUIRED" = "1" ]; then
+    echo "KVMINI_POLICY_TEST_REQUIRED=1 -> failing"
+    exit 1
+  fi
+  exit 0
+}
+
+command -v kubectl >/dev/null 2>&1 || skip "kubectl not found"
+kubectl cluster-info >/dev/null 2>&1 || skip "no reachable cluster (start KIND first: kind create cluster)"
+
+echo "== installing Gatekeeper $GK_VERSION (no-op if present)"
+if ! kubectl get ns gatekeeper-system >/dev/null 2>&1; then
+  kubectl apply -f "https://raw.githubusercontent.com/open-policy-agent/gatekeeper/release-${GK_VERSION}/deploy/gatekeeper.yaml"
+fi
+kubectl wait --for=condition=available --timeout=300s \
+  deployment/gatekeeper-controller-manager -n gatekeeper-system
+
+echo "== applying this repo's templates + constraints"
+kubectl apply -f policies/gatekeeper/constrainttemplates.yaml
+# CRDs from the templates take a moment to register
+for _ in $(seq 1 30); do
+  kubectl get crd k8srequiredtpuresources.constraints.gatekeeper.sh >/dev/null 2>&1 && break
+  sleep 2
+done
+kubectl apply -f policies/gatekeeper/constraints.yaml
+sleep 5  # webhook sync
+
+kubectl create ns "$NS" --dry-run=client -o yaml | kubectl apply -f -
+cleanup() { kubectl delete ns "$NS" --ignore-not-found --wait=false >/dev/null 2>&1 || true; }
+trap cleanup EXIT
+
+check_flagged() { # $1 = manifest, $2 = label
+  local out rc=0
+  out=$(kubectl apply -f "$1" 2>&1) || rc=$?
+  if [ $rc -ne 0 ] && echo "$out" | grep -qi "denied"; then
+    echo "OK: $2 DENIED by admission webhook"
+  elif echo "$out" | grep -qi "warning.*\(tpu\|hostPath\)"; then
+    echo "OK: $2 admitted with policy WARNING (enforcementAction: warn)"
+  else
+    echo "FAIL: $2 was neither denied nor warned:"; echo "$out"; exit 1
+  fi
+}
+
+echo "== violating pod: TPU pool, no google.com/tpu limit"
+cat > /tmp/kvmini-bad-tpu.yaml <<EOF
+apiVersion: v1
+kind: Pod
+metadata: {name: bad-no-tpu-limit, namespace: $NS}
+spec:
+  nodeSelector: {cloud.google.com/gke-tpu-accelerator: tpu-v5-lite-podslice}
+  containers:
+  - name: main
+    image: busybox:1.36
+    command: ["sleep", "60"]
+EOF
+check_flagged /tmp/kvmini-bad-tpu.yaml "no-tpu-limit pod"
+
+echo "== violating pod: hostPath volume"
+cat > /tmp/kvmini-bad-hostpath.yaml <<EOF
+apiVersion: v1
+kind: Pod
+metadata: {name: bad-hostpath, namespace: $NS}
+spec:
+  containers:
+  - name: main
+    image: busybox:1.36
+    command: ["sleep", "60"]
+    volumeMounts: [{name: h, mountPath: /host}]
+  volumes: [{name: h, hostPath: {path: /, type: Directory}}]
+EOF
+check_flagged /tmp/kvmini-bad-hostpath.yaml "hostPath pod"
+
+echo "== compliant TPU pod must admit cleanly"
+cat > /tmp/kvmini-good.yaml <<EOF
+apiVersion: v1
+kind: Pod
+metadata: {name: good-tpu-pod, namespace: $NS}
+spec:
+  nodeSelector: {cloud.google.com/gke-tpu-accelerator: tpu-v5-lite-podslice}
+  containers:
+  - name: main
+    image: busybox:1.36
+    command: ["sleep", "60"]
+    resources:
+      requests: {google.com/tpu: "4"}
+      limits: {google.com/tpu: "4"}
+EOF
+out=$(kubectl apply -f /tmp/kvmini-good.yaml 2>&1)
+if echo "$out" | grep -qi "warning\|denied"; then
+  echo "FAIL: compliant pod was flagged:"; echo "$out"; exit 1
+fi
+echo "OK: compliant pod admitted with no warnings"
+
+echo "== policy admission test PASSED"

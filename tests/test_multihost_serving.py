@@ -95,7 +95,7 @@ def test_channel_handshake_rejects_wrong_token_and_config():
     """The command channel must (a) not hand a follower slot to a peer
     without the shared token, and (b) fail fast on an engine-config
     mismatch instead of letting lockstep replay diverge."""
-    import pickle
+    import json as _json
     import struct
     import threading
 
@@ -122,11 +122,21 @@ def test_channel_handshake_rejects_wrong_token_and_config():
     t.start()
     time.sleep(0.3)
 
-    # stray scanner: connects, sends garbage — must NOT consume the slot
+    # stray scanner #1: wrong token — must be rejected without a slot
     s = socket.create_connection(("127.0.0.1", port), timeout=5)
-    junk = pickle.dumps({"token": b"wrong", "fingerprint": fp})
+    junk = _json.dumps({"token": "wrong", "fingerprint": fp}).encode()
     s.sendall(struct.pack("!I", len(junk)) + junk)
     s.close()
+    # stray scanner #2: raw garbage bytes (not JSON, bogus length) — must
+    # neither crash the primary nor consume the slot
+    s2 = socket.create_connection(("127.0.0.1", port), timeout=5)
+    s2.sendall(struct.pack("!I", 12) + b"\x80\x04\x95junk")
+    s2.close()
+    # stray scanner #3: structurally valid JSON with a non-string token
+    s3 = socket.create_connection(("127.0.0.1", port), timeout=5)
+    junk3 = _json.dumps({"token": 123}).encode()
+    s3.sendall(struct.pack("!I", len(junk3)) + junk3)
+    s3.close()
 
     # real follower with matching token (default '') and fingerprint
     sub = CommandSubscriber("127.0.0.1", port, fingerprint=fp,
