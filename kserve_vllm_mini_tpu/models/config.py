@@ -16,6 +16,11 @@ orthogonal architecture axes extend it to the other families:
 - ``block="phi"`` — Phi-2-style parallel attention+MLP block: one
   LayerNorm (with bias) feeds both attention and a GELU MLP, partial
   rotary embedding, biases on every projection.
+- ``block="gemma2"`` — Gemma-2-style block: sandwich RMSNorms (post-norms
+  on both the attention and MLP branches before their residual adds),
+  (1+w) norm weights, GeGLU MLP, sqrt(d_model)-scaled embeddings,
+  attention/final logit soft-capping, explicit head_dim decoupled from
+  d_model/n_heads, and sliding-window attention on alternating layers.
 """
 
 from __future__ import annotations
@@ -65,9 +70,25 @@ class ModelConfig:
     scan_unroll: int = 1
     # Fraction of head_dim that receives rotary embedding (phi-2: 0.4).
     partial_rotary_factor: float = 1.0
+    # Gemma-2-family axes --------------------------------------------------
+    # head_dim decoupled from d_model/n_heads (gemma-2: 256 while
+    # d_model/n_heads derives 288 for 2b, 224 for 9b); None = derived.
+    explicit_head_dim: Optional[int] = None
+    # tanh soft-capping: attention logits (gemma-2: 50.0) and final lm
+    # logits (gemma-2: 30.0). None disables.
+    attn_softcap: Optional[float] = None
+    final_softcap: Optional[float] = None
+    # attention scale denominator override (gemma-2 query_pre_attn_scalar);
+    # None = head_dim (the standard 1/sqrt(head_dim)).
+    query_pre_attn_scalar: Optional[float] = None
+    # sliding_window applies only to EVEN layers (gemma-2's local/global
+    # alternation); odd layers attend the full causal context.
+    alt_sliding_window: bool = False
 
     @property
     def head_dim(self) -> int:
+        if self.explicit_head_dim is not None:
+            return self.explicit_head_dim
         return self.d_model // self.n_heads
 
     @property
@@ -88,15 +109,14 @@ class ModelConfig:
     def param_count(self) -> int:
         """Approximate parameter count (embeddings + blocks + head)."""
         emb = self.vocab_size * self.d_model
-        attn = self.d_model * self.d_model + 2 * self.d_model * (
-            self.n_kv_heads * self.head_dim
-        ) + self.d_model * self.d_model
+        qo = self.d_model * self.n_heads * self.head_dim  # q and o projections
+        attn = 2 * qo + 2 * self.d_model * (self.n_kv_heads * self.head_dim)
         mlp = (2 if self.block == "phi" else 3) * self.d_model * self.d_ff
         if self.is_moe:
             mlp = self.n_experts * mlp + self.d_model * self.n_experts
         if self.attn_bias:
             attn += self.n_heads * self.head_dim + 2 * self.n_kv_heads * self.head_dim
-        norms = 2 * self.d_model
+        norms = (4 if self.block == "gemma2" else 2) * self.d_model
         head = 0 if self.tie_embeddings else self.vocab_size * self.d_model
         return emb + self.n_layers * (attn + mlp + norms) + self.d_model + head
 
@@ -228,6 +248,49 @@ PRESETS: dict[str, ModelConfig] = {
         attn_bias=True,
         rms_eps=1e-5,
     ),
+    # Gemma-2 (published architecture): sandwich norms, GeGLU, soft-caps,
+    # head_dim 256 decoupled from d_model/n_heads, alternating 4096-token
+    # local / global attention, tied embeddings, 256k vocab.
+    "gemma-2-9b": ModelConfig(
+        name="gemma-2-9b",
+        vocab_size=256_000,
+        d_model=3584,
+        n_layers=42,
+        n_heads=16,
+        n_kv_heads=8,
+        d_ff=14_336,
+        max_seq_len=8192,
+        rope_theta=10_000.0,
+        rms_eps=1e-6,
+        block="gemma2",
+        tie_embeddings=True,
+        explicit_head_dim=256,
+        query_pre_attn_scalar=256.0,
+        attn_softcap=50.0,
+        final_softcap=30.0,
+        sliding_window=4096,
+        alt_sliding_window=True,
+    ),
+    "gemma-2-2b": ModelConfig(
+        name="gemma-2-2b",
+        vocab_size=256_000,
+        d_model=2304,
+        n_layers=26,
+        n_heads=8,
+        n_kv_heads=4,
+        d_ff=9216,
+        max_seq_len=8192,
+        rope_theta=10_000.0,
+        rms_eps=1e-6,
+        block="gemma2",
+        tie_embeddings=True,
+        explicit_head_dim=256,
+        query_pre_attn_scalar=256.0,
+        attn_softcap=50.0,
+        final_softcap=30.0,
+        sliding_window=4096,
+        alt_sliding_window=True,
+    ),
     # -- tiny CI variants (CPU in <1s) exercising each architecture axis ----
     "mistral-tiny": ModelConfig(
         name="mistral-tiny",
@@ -266,6 +329,26 @@ PRESETS: dict[str, ModelConfig] = {
         block="phi",
         partial_rotary_factor=0.5,
         attn_bias=True,
+    ),
+    "gemma-tiny": ModelConfig(
+        name="gemma-tiny",
+        vocab_size=512,
+        d_model=128,
+        n_layers=4,                  # even+odd layers: both mask phases run
+        n_heads=4,
+        n_kv_heads=2,
+        d_ff=256,
+        max_seq_len=256,
+        rope_theta=10_000.0,
+        rms_eps=1e-6,
+        block="gemma2",
+        tie_embeddings=True,
+        explicit_head_dim=48,        # != d_model/n_heads: exercises the override
+        query_pre_attn_scalar=48.0,
+        attn_softcap=50.0,
+        final_softcap=30.0,
+        sliding_window=16,
+        alt_sliding_window=True,
     ),
     "mixtral-tiny": ModelConfig(
         name="mixtral-tiny",

@@ -102,6 +102,14 @@ def _init_impl(rng: jax.Array, cfg: ModelConfig, leaf_fn) -> Params:
         layers["bo"] = jnp.zeros((L, cfg.d_model), dtype=dt)
         layers["b_up"] = jnp.zeros((L, cfg.d_ff), dtype=dt)
         layers["b_down"] = jnp.zeros((L, cfg.d_model), dtype=dt)
+    elif cfg.block == "gemma2":
+        # gemma norm weights are OFFSETS (applied as 1+w, the HF storage
+        # convention), so identity init is zeros; four norms per layer
+        # (sandwich: post-norms on both branches before their residuals)
+        layers["attn_norm"] = jnp.zeros((L, cfg.d_model), dtype=dt)
+        layers["post_attn_norm"] = jnp.zeros((L, cfg.d_model), dtype=dt)
+        layers["mlp_norm"] = jnp.zeros((L, cfg.d_model), dtype=dt)
+        layers["post_mlp_norm"] = jnp.zeros((L, cfg.d_model), dtype=dt)
     else:
         layers["mlp_norm"] = jnp.ones((L, cfg.d_model), dtype=dt)
     for name, shape in _stacked_weight_shapes(cfg).items():
@@ -123,7 +131,10 @@ def _init_impl(rng: jax.Array, cfg: ModelConfig, leaf_fn) -> Params:
     params: Params = {
         "embed": _nrm(keys["embed"], (cfg.vocab_size, cfg.d_model), dt),
         "layers": layers,
-        "final_norm": jnp.ones((cfg.d_model,), dtype=dt),
+        "final_norm": (
+            jnp.zeros((cfg.d_model,), dtype=dt) if cfg.block == "gemma2"
+            else jnp.ones((cfg.d_model,), dtype=dt)
+        ),
     }
     if cfg.block == "phi":
         params["final_norm_b"] = jnp.zeros((cfg.d_model,), dtype=dt)
@@ -260,11 +271,55 @@ def qkv_proj(
     return apply_rope(q, positions, cos, sin), apply_rope(k, positions, cos, sin), v
 
 
+def embed_tokens(params: Params, cfg: ModelConfig, tokens: jnp.ndarray) -> jnp.ndarray:
+    """Embedding lookup + family-specific input transform. EVERY execution
+    path (forward, pipeline trainer, serving-pp executor) must enter the
+    layer stack through this helper — gemma scales embeddings by
+    sqrt(d_model), and an executor that skips it produces silently-wrong
+    activations ~sqrt(d_model)x too small."""
+    x = params["embed"][tokens]
+    if cfg.block == "gemma2":
+        # computed in the model dtype, matching the published
+        # implementation's bf16 rounding
+        x = x * jnp.asarray(cfg.d_model ** 0.5, dtype=cfg.jnp_dtype)
+    return x
+
+
+def final_logits(params: Params, cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
+    """Final norm + LM head + family epilogues (phi bias, gemma (1+w) norm
+    and logit soft-capping), shared by every execution path — the exit
+    twin of ``embed_tokens``. Returns f32 logits."""
+    if cfg.block == "phi":
+        x = layer_norm(x, params["final_norm"], params["final_norm_b"], cfg.rms_eps)
+    elif cfg.block == "gemma2":
+        x = rms_norm(x, 1.0 + params["final_norm"].astype(jnp.float32), cfg.rms_eps)
+    else:
+        x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    head = params["embed"] if cfg.tie_embeddings else params["lm_head"]
+    logits = (x @ head.T).astype(jnp.float32)
+    if cfg.block == "phi":
+        logits = logits + params["lm_head_b"].astype(jnp.float32)
+    if cfg.final_softcap is not None:
+        logits = jnp.tanh(logits / cfg.final_softcap) * cfg.final_softcap
+    return logits
+
+
 def block_norm(p: Params, cfg: ModelConfig, x: jnp.ndarray, name: str) -> jnp.ndarray:
-    """The block's norm: RMSNorm (llama family) or biased LayerNorm (phi)."""
+    """The block's norm: RMSNorm (llama family), biased LayerNorm (phi),
+    or (1+w)-weighted RMSNorm (gemma — weights stored as offsets)."""
     if cfg.block == "phi":
         return layer_norm(x, p[name], p[name + "_b"], cfg.rms_eps)
+    if cfg.block == "gemma2":
+        return rms_norm(x, 1.0 + p[name].astype(jnp.float32), cfg.rms_eps)
     return rms_norm(x, p[name], cfg.rms_eps)
+
+
+def attn_scale_softcap(cfg: ModelConfig) -> tuple[float, Optional[float]]:
+    """(attention scale, attention-logit softcap) for every attention call
+    site — gemma scales by query_pre_attn_scalar and tanh-caps the scores;
+    everyone else uses the standard 1/sqrt(head_dim) with no cap."""
+    denom = cfg.query_pre_attn_scalar or float(cfg.head_dim)
+    return denom ** -0.5, cfg.attn_softcap
 
 
 def attn_out_and_mlp(
@@ -291,6 +346,16 @@ def attn_out_and_mlp(
         act = jax.nn.gelu(up.astype(jnp.float32), approximate=True).astype(dt)
         mlp_out = linear(act, p["w_down"]) + p["b_down"]
         return x + attn_out + mlp_out
+    if cfg.block == "gemma2":
+        # sandwich norms: each branch output is normed BEFORE its residual
+        attn_out = linear(o, p["wo"])
+        x = x + block_norm(p, cfg, attn_out, "post_attn_norm")
+        h2 = block_norm(p, cfg, x, "mlp_norm")
+        gate = jax.nn.gelu(
+            linear(h2, p["w_gate"]).astype(jnp.float32), approximate=True
+        ).astype(dt)
+        mlp_out = linear(gate * linear(h2, p["w_up"]), p["w_down"])
+        return x + block_norm(p, cfg, mlp_out, "post_mlp_norm")
     x = x + linear(o, p["wo"])
     h = rms_norm(x, p["mlp_norm"], cfg.rms_eps)
     if cfg.is_moe:
@@ -309,6 +374,8 @@ def layer_forward(
     cos: jnp.ndarray,
     sin: jnp.ndarray,
     attention_fn=None,
+    layer_idx: Optional[jnp.ndarray] = None,  # global layer index (scalar) —
+                                 # only alt_sliding_window models need it
 ) -> jnp.ndarray:
     """One cache-free decoder layer (pre-norm attn + SwiGLU MLP, residuals).
 
@@ -325,8 +392,18 @@ def layer_forward(
         qi = positions[:, :, None]
         mask = kj <= qi
         if cfg.sliding_window is not None:
-            mask &= kj > qi - cfg.sliding_window
-        o = attention(q, k, v, mask[:, None, :, :])
+            wmask = mask & (kj > qi - cfg.sliding_window)
+            if cfg.alt_sliding_window:
+                if layer_idx is None:
+                    raise ValueError(
+                        "alt_sliding_window models need layer_idx to pick "
+                        "the local/global mask phase"
+                    )
+                mask = jnp.where(layer_idx % 2 == 0, wmask, mask)
+            else:
+                mask = wmask
+        scale, softcap = attn_scale_softcap(cfg)
+        o = attention(q, k, v, mask[:, None, :, :], scale=scale, softcap=softcap)
     return attn_out_and_mlp(p, cfg, x, o, h)
 
 
@@ -352,6 +429,10 @@ def run_cached_layers(
                                  # cache — the microbatched pipeline
                                  # executor walks slot groups while the
                                  # cache keeps the full slot axis
+    layer_offset: int = 0,       # global index of this stack's first layer
+                                 # (pipeline stages pass their range start;
+                                 # alt_sliding_window's local/global phase
+                                 # follows GLOBAL layer parity)
 ) -> tuple[jnp.ndarray, KVCache]:
     """The cached transformer stack: scan over stacked layers, writing this
     block's K/V at ``cache_offsets`` and attending with positional masking
@@ -375,13 +456,19 @@ def run_cached_layers(
     s = kv_cache["k"].shape[3]
     kj = jnp.arange(s)[None, None, :]
     qi = positions[:, :, None]
-    mask = kj <= qi
+    causal = kj <= qi
     if cfg.sliding_window is not None:
         # Mistral-style window: key j valid iff p - W < j <= p. Cache
         # slots are absolute positions, so the window is a second bound
-        # on the same positional mask.
-        mask &= kj > qi - cfg.sliding_window
-    mask = mask[:, None, :, :]                               # [B, 1, T, S]
+        # on the same positional mask. Gemma-style alternation keeps BOTH
+        # masks and selects per layer inside the scan.
+        windowed = causal & (kj > qi - cfg.sliding_window)
+        mask_global = causal[:, None, :, :] if cfg.alt_sliding_window else None
+        mask = windowed[:, None, :, :]
+    else:
+        mask_global = None
+        mask = causal[:, None, :, :]                         # [B, 1, T, S]
+    attn_scale, attn_cap = attn_scale_softcap(cfg)
     base = slot_base if slot_base is not None else jnp.int32(0)
     b_idx = base + jnp.arange(B)[:, None, None]              # [B, 1, 1]
     h_idx = jnp.arange(cfg.n_kv_heads)[None, :, None]        # [1, KVH, 1]
@@ -438,15 +525,32 @@ def run_cached_layers(
             cache["v"] = cache["v"].at[lidx, b_idx, h_idx, t_idx].set(
                 _gate(cache, "v", lidx, v.astype(cache["v"].dtype))
             )
+        glidx = layer_offset + lidx  # global layer index (mask phase)
         if fresh_prefill:
             # block-causal flash over the fresh block is exact for a
             # windowed model too as long as T <= window (every causal
             # key is inside the window); longer prefills take the masked
-            # jnp path. T is static, so this is a trace-time branch.
-            if cfg.sliding_window is not None and T > cfg.sliding_window:
+            # jnp path. T is static, so this is a trace-time branch. The
+            # flash kernel has no softcap, so gemma's capped attention
+            # always takes the masked path.
+            needs_mask_path = (
+                attn_cap is not None
+                or attn_scale != float(cfg.head_dim) ** -0.5
+                or (cfg.sliding_window is not None and T > cfg.sliding_window)
+            )
+            if needs_mask_path:
                 fj = jnp.arange(T)[None, None, :]
-                fmask = (fj <= qi) & (fj > qi - cfg.sliding_window)
-                o = attention(q, k, v, fmask[:, None, :, :])
+                fcausal = fj <= qi
+                if cfg.sliding_window is not None:
+                    fwin = fcausal & (fj > qi - cfg.sliding_window)
+                    if cfg.alt_sliding_window:
+                        fmask = jnp.where(glidx % 2 == 0, fwin, fcausal)
+                    else:
+                        fmask = fwin
+                else:
+                    fmask = fcausal
+                o = attention(q, k, v, fmask[:, None, :, :],
+                              scale=attn_scale, softcap=attn_cap)
             else:
                 from kserve_vllm_mini_tpu.ops.flash_attention import prefill_attention
 
@@ -454,7 +558,12 @@ def run_cached_layers(
         else:
             k_layer = _read_layer(cache, "k", lidx)
             v_layer = _read_layer(cache, "v", lidx)
-            o = attention(q, k_layer, v_layer, mask)
+            m = mask
+            if mask_global is not None:
+                # gemma alternation: even global layers local, odd global
+                m = jnp.where(glidx % 2 == 0, mask, mask_global)
+            o = attention(q, k_layer, v_layer, m,
+                          scale=attn_scale, softcap=attn_cap)
         return (attn_out_and_mlp(p, cfg, y0, o, h), cache), None
 
     (x, new_cache), _ = jax.lax.scan(
@@ -504,7 +613,7 @@ def forward(
             "attention_fn overrides (ring attention / sp) do not implement "
             "sliding-window masking; run windowed models with sp=1"
         )
-    x = params["embed"][tokens]  # [B, T, D] gather
+    x = embed_tokens(params, cfg, tokens)  # [B, T, D]
     cos, sin = rope_frequencies(
         cfg.rotary_dim, cfg.max_seq_len, cfg.rope_theta, cfg.rope_scaling
     )
@@ -520,23 +629,20 @@ def forward(
             fresh_prefill=fresh_prefill,
         )
     else:
-        def scan_body_nocache(carry, p):
-            return layer_forward(p, cfg, carry, positions, cos, sin, attention_fn), None
+        def scan_body_nocache(carry, xs):
+            p, lidx = xs
+            return layer_forward(
+                p, cfg, carry, positions, cos, sin, attention_fn,
+                layer_idx=lidx,
+            ), None
 
         x, _ = jax.lax.scan(
-            scan_body_nocache, x, layers, unroll=max(cfg.scan_unroll, 1)
+            scan_body_nocache, x, (layers, jnp.arange(cfg.n_layers)),
+            unroll=max(cfg.scan_unroll, 1),
         )
         new_cache_dict = None
 
     if logit_index is not None:
         x = x[jnp.arange(B)[:, None], logit_index[:, None]]  # [B, 1, D]
-    if cfg.block == "phi":
-        x = layer_norm(x, params["final_norm"], params["final_norm_b"], cfg.rms_eps)
-    else:
-        x = rms_norm(x, params["final_norm"], cfg.rms_eps)
-    head = params["embed"] if cfg.tie_embeddings else params["lm_head"]
-    logits = (x @ head.T).astype(jnp.float32)
-    if cfg.block == "phi":
-        logits = logits + params["lm_head_b"].astype(jnp.float32)
-
+    logits = final_logits(params, cfg, x)
     return logits, new_cache_dict

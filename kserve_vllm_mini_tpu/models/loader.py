@@ -50,6 +50,24 @@ _MOE_EXPERT_MAP = {
     "w_down": "block_sparse_moe.experts.{e}.w2.weight",
 }
 
+# Gemma-2 layer names: sandwich norms — input_layernorm (pre-attn),
+# post_attention_layernorm (post-attn, pre-residual),
+# pre/post_feedforward_layernorm around the GeGLU MLP. Norm weights are
+# stored as offsets (model applies 1+w); matmuls follow Llama naming.
+_GEMMA2_LAYER_MAP = {
+    "attn_norm": ("input_layernorm.weight", False),
+    "post_attn_norm": ("post_attention_layernorm.weight", False),
+    "mlp_norm": ("pre_feedforward_layernorm.weight", False),
+    "post_mlp_norm": ("post_feedforward_layernorm.weight", False),
+    "wq": ("self_attn.q_proj.weight", True),
+    "wk": ("self_attn.k_proj.weight", True),
+    "wv": ("self_attn.v_proj.weight", True),
+    "wo": ("self_attn.o_proj.weight", True),
+    "w_gate": ("mlp.gate_proj.weight", True),
+    "w_up": ("mlp.up_proj.weight", True),
+    "w_down": ("mlp.down_proj.weight", True),
+}
+
 # Phi-2 layer names: one LayerNorm, ``dense`` o-projection, fc1/fc2 GELU MLP,
 # biases everywhere. (matrix, transpose?) pairs plus a parallel bias table.
 _PHI_LAYER_MAP = {
@@ -84,8 +102,16 @@ def config_from_hf(model_dir: str | Path) -> ModelConfig:
             int(rs.get("original_max_position_embeddings", 8192)),
         )
     model_type = hf.get("model_type", "llama")
-    block = "phi" if model_type == "phi" else "llama"
+    if model_type == "phi":
+        block = "phi"
+    elif model_type == "gemma2":
+        block = "gemma2"
+    else:
+        block = "llama"
     sliding_window = hf.get("sliding_window")
+    # gemma-2 windows apply to alternating layers; the window being smaller
+    # than max_position_embeddings is by design, so skip the disable below
+    alt_sliding = block == "gemma2" and sliding_window is not None
     # Qwen2 checkpoints ship sliding_window=131072 with
     # use_sliding_window=false — the window is disabled, not huge. A window
     # at/past max_position_embeddings is likewise never binding.
@@ -112,6 +138,22 @@ def config_from_hf(model_dir: str | Path) -> ModelConfig:
         n_experts_per_tok=int(hf.get("num_experts_per_tok", 2)),
         block=block,
         partial_rotary_factor=float(hf.get("partial_rotary_factor", 1.0)),
+        explicit_head_dim=(
+            int(hf["head_dim"]) if hf.get("head_dim") is not None else None
+        ),
+        attn_softcap=(
+            float(hf["attn_logit_softcapping"])
+            if hf.get("attn_logit_softcapping") is not None else None
+        ),
+        final_softcap=(
+            float(hf["final_logit_softcapping"])
+            if hf.get("final_logit_softcapping") is not None else None
+        ),
+        query_pre_attn_scalar=(
+            float(hf["query_pre_attn_scalar"])
+            if hf.get("query_pre_attn_scalar") is not None else None
+        ),
+        alt_sliding_window=alt_sliding,
     )
 
 
@@ -186,7 +228,9 @@ def load_hf_checkpoint(
         }
 
     layers: dict[str, Any] = {}
-    layer_map = _PHI_LAYER_MAP if cfg.block == "phi" else _LAYER_MAP
+    layer_map = {"phi": _PHI_LAYER_MAP, "gemma2": _GEMMA2_LAYER_MAP}.get(
+        cfg.block, _LAYER_MAP
+    )
     for ours, (hf_key, tr) in layer_map.items():
         if cfg.is_moe and ours in _MOE_EXPERT_MAP:
             # expert-stacked [L, E, in, out]: per layer, stack the E experts
@@ -258,7 +302,9 @@ def save_checkpoint(params: dict[str, Any], cfg: ModelConfig, out_dir: str | Pat
         put("model.norm.weight", params["final_norm"], False)
     if "lm_head" in params:
         put("lm_head.weight", params["lm_head"], False)
-    layer_map = _PHI_LAYER_MAP if cfg.block == "phi" else _LAYER_MAP
+    layer_map = {"phi": _PHI_LAYER_MAP, "gemma2": _GEMMA2_LAYER_MAP}.get(
+        cfg.block, _LAYER_MAP
+    )
     for ours, (hf_key, tr) in layer_map.items():
         for i in range(cfg.n_layers):
             if cfg.is_moe and ours in _MOE_EXPERT_MAP:
@@ -285,6 +331,8 @@ def save_checkpoint(params: dict[str, Any], cfg: ModelConfig, out_dir: str | Pat
     save_file(tensors, str(out_dir / "model.safetensors"))
     if cfg.block == "phi":
         model_type = "phi"
+    elif cfg.block == "gemma2":
+        model_type = "gemma2"
     elif cfg.is_moe:
         model_type = "mixtral"
     elif cfg.attn_bias:
@@ -314,6 +362,12 @@ def save_checkpoint(params: dict[str, Any], cfg: ModelConfig, out_dir: str | Pat
     if cfg.block == "phi":
         hf_cfg["partial_rotary_factor"] = cfg.partial_rotary_factor
         hf_cfg["layer_norm_eps"] = cfg.rms_eps
+    if cfg.block == "gemma2":
+        if cfg.explicit_head_dim is not None:
+            hf_cfg["head_dim"] = cfg.explicit_head_dim
+        hf_cfg["attn_logit_softcapping"] = cfg.attn_softcap
+        hf_cfg["final_logit_softcapping"] = cfg.final_softcap
+        hf_cfg["query_pre_attn_scalar"] = cfg.query_pre_attn_scalar
     if cfg.rope_scaling is not None:
         f_, lo, hi, omax = cfg.rope_scaling
         hf_cfg["rope_scaling"] = {

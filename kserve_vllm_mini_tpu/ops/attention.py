@@ -31,6 +31,7 @@ def attention(
     v: jnp.ndarray,                      # [B, KVH, S, D]
     mask: Optional[jnp.ndarray] = None,  # broadcastable to [B, 1|H, T, S]; True = attend
     scale: Optional[float] = None,
+    softcap: Optional[float] = None,     # gemma-2: scores -> cap*tanh(s/cap)
 ) -> jnp.ndarray:
     """Returns [B, H, T, D] in q.dtype.
 
@@ -44,6 +45,8 @@ def attention(
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     if h == kvh:
         logits = jnp.einsum("bhtd,bhsd->bhts", q, k).astype(jnp.float32) * scale
+        if softcap is not None:
+            logits = jnp.tanh(logits / softcap) * softcap
         if mask is not None:
             logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
         probs = nn.softmax(logits, axis=-1).astype(q.dtype)
@@ -54,6 +57,8 @@ def attention(
     s = k.shape[2]
     qg = q.reshape(b, kvh, g, t, d)
     logits = jnp.einsum("bkgtd,bksd->bkgts", qg, k).astype(jnp.float32) * scale
+    if softcap is not None:
+        logits = jnp.tanh(logits / softcap) * softcap
     if mask is not None:
         # normalize any broadcastable-to-[B, 1|H, T, S] mask to 4-D first
         m4 = mask if mask.ndim == 4 else mask.reshape((1,) * (4 - mask.ndim) + mask.shape)

@@ -37,8 +37,12 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from kserve_vllm_mini_tpu.models.config import ModelConfig
-from kserve_vllm_mini_tpu.models.llama import layer_forward
-from kserve_vllm_mini_tpu.ops.rmsnorm import rms_norm
+from kserve_vllm_mini_tpu.models.llama import (
+    embed_tokens,
+    final_logits,
+    layer_forward,
+)
+
 from kserve_vllm_mini_tpu.ops.rope import rope_frequencies
 
 try:  # jax >= 0.8
@@ -104,14 +108,24 @@ def pipeline_loss_fn(
         )
         layers_local = params["layers"]  # [L/P, ...] — this stage's range only
 
-        x = params["embed"][inp]                       # [b, T, D]
+        x = embed_tokens(params, cfg, inp)             # [b, T, D]
         mbs = x.reshape(M, mb, T, cfg.d_model)
 
         def run_stage(h):
-            def body(carry, p):
-                return layer_forward(p, cfg, carry, positions, cos, sin), None
+            # global layer indices: alt-sliding-window masks follow global
+            # parity, and this stage owns layers [stage*L/P, (stage+1)*L/P)
+            lbase = stage * (cfg.n_layers // n_pp)
 
-            out, _ = jax.lax.scan(body, h, layers_local)
+            def body(carry, xs):
+                p, li = xs
+                return layer_forward(
+                    p, cfg, carry, positions, cos, sin, layer_idx=li
+                ), None
+
+            out, _ = jax.lax.scan(
+                body, h,
+                (layers_local, lbase + jnp.arange(cfg.n_layers // n_pp)),
+            )
             return out
 
         perm = [(i, (i + 1) % n_pp) for i in range(n_pp)]
@@ -148,16 +162,9 @@ def pipeline_loss_fn(
             jnp.where(stage == n_pp - 1, outputs, jnp.zeros_like(outputs)), "pp"
         )
         h = outputs.reshape(b, T, cfg.d_model)
-        if cfg.block == "phi":
-            from kserve_vllm_mini_tpu.ops.rmsnorm import layer_norm
-
-            h = layer_norm(h, params["final_norm"], params["final_norm_b"], cfg.rms_eps)
-        else:
-            h = rms_norm(h, params["final_norm"], cfg.rms_eps)
-        head = params["embed"] if cfg.tie_embeddings else params["lm_head"]
-        logits = (h @ head.T).astype(jnp.float32)
-        if cfg.block == "phi":
-            logits = logits + params["lm_head_b"].astype(jnp.float32)
+        # family epilogues (phi bias, gemma (1+w) norm + softcap) live in
+        # ONE place — an executor with its own head code drifts silently
+        logits = final_logits(params, cfg, h)
         logp = jax.nn.log_softmax(logits, axis=-1)
         nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
         return jax.lax.pmean(jnp.mean(nll), "dp")

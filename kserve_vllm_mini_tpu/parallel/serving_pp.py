@@ -45,8 +45,12 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from kserve_vllm_mini_tpu.models.config import ModelConfig
-from kserve_vllm_mini_tpu.models.llama import run_cached_layers
-from kserve_vllm_mini_tpu.ops.rmsnorm import layer_norm, rms_norm
+from kserve_vllm_mini_tpu.models.llama import (
+    embed_tokens,
+    final_logits,
+    run_cached_layers,
+)
+
 from kserve_vllm_mini_tpu.ops.rope import rope_frequencies
 
 try:  # jax >= 0.8
@@ -148,7 +152,7 @@ def make_pp_forward(cfg: ModelConfig, mesh: Mesh, microbatches: int = 1):
                 cos, sin = rope_frequencies(
                     cfg.rotary_dim, cfg.max_seq_len, cfg.rope_theta, cfg.rope_scaling
                 )
-                x = params["embed"][tokens]                   # [B, T, D]
+                x = embed_tokens(params, cfg, tokens)         # [B, T, D]
                 mbs = x.reshape(M, mb, T, -1)
                 pos_mb = positions.reshape(M, mb, T)
                 off_mb = offsets.reshape(M, mb)
@@ -185,6 +189,9 @@ def make_pp_forward(cfg: ModelConfig, mesh: Mesh, microbatches: int = 1):
                         fresh_prefill=fresh_prefill,
                         write_gate=active,
                         slot_base=base_t,
+                        # global index of this stage's first layer: the
+                        # alt-sliding-window phase follows GLOBAL parity
+                        layer_offset=stage * (cfg.n_layers // n_pp),
                     )
                     # last stage emits microbatch t-(P-1) once the pipe fills
                     out_idx = t - (n_pp - 1)
@@ -210,16 +217,9 @@ def make_pp_forward(cfg: ModelConfig, mesh: Mesh, microbatches: int = 1):
                 h = outs.reshape(B, T, -1)
                 if has_li:
                     h = h[jnp.arange(B)[:, None], li[:, None]]
-                if cfg.block == "phi":
-                    h = layer_norm(
-                        h, params["final_norm"], params["final_norm_b"], cfg.rms_eps
-                    )
-                else:
-                    h = rms_norm(h, params["final_norm"], cfg.rms_eps)
-                head = params["embed"] if cfg.tie_embeddings else params["lm_head"]
-                logits = (h @ head.T).astype(jnp.float32)
-                if cfg.block == "phi":
-                    logits = logits + params["lm_head_b"].astype(jnp.float32)
+                # shared family epilogue (phi bias, gemma (1+w) norm +
+                # softcap): executor-local head code drifts silently
+                logits = final_logits(params, cfg, h)
                 return logits, cache_out
 
             return inner(params, tokens, positions, cache, offsets, li)

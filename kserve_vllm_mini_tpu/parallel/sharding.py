@@ -95,6 +95,11 @@ def param_specs(
             "w_up": P(pp, None, tp),
             "w_down": P(pp, tp, None),
         })
+    if cfg.block == "gemma2":
+        # sandwich post-norms: vectors, replicated across tp like the
+        # other norm weights
+        specs["layers"]["post_attn_norm"] = P(pp, None)
+        specs["layers"]["post_mlp_norm"] = P(pp, None)
     if cfg.attn_bias:
         specs["layers"].update({
             "bq": P(pp, tp),

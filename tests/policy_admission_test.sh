@@ -113,8 +113,11 @@ spec:
       requests: {google.com/tpu: "4"}
       limits: {google.com/tpu: "4"}
 EOF
-out=$(kubectl apply -f /tmp/kvmini-good.yaml 2>&1)
-if echo "$out" | grep -qi "warning\|denied"; then
+# || rc: under set -e a DENIED compliant pod would abort before the
+# diagnostic below could frame the failure
+rc=0
+out=$(kubectl apply -f /tmp/kvmini-good.yaml 2>&1) || rc=$?
+if [ $rc -ne 0 ] || echo "$out" | grep -qi "warning\|denied"; then
   echo "FAIL: compliant pod was flagged:"; echo "$out"; exit 1
 fi
 echo "OK: compliant pod admitted with no warnings"

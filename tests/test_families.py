@@ -232,7 +232,8 @@ def test_loader_roundtrip_new_families(tmp_path):
     family (bias, window, and MoE leaves all survive the HF name mapping)."""
     from kserve_vllm_mini_tpu.models.loader import load_hf_checkpoint, save_checkpoint
 
-    for name in ("mistral-tiny", "qwen-tiny", "mixtral-tiny", "phi-tiny"):
+    for name in ("mistral-tiny", "qwen-tiny", "mixtral-tiny", "phi-tiny",
+                 "gemma-tiny"):
         cfg = get_config(name)
         p = init_params(jax.random.PRNGKey(3), cfg)
         if cfg.attn_bias:  # exercise nonzero biases through the roundtrip
@@ -243,6 +244,13 @@ def test_loader_roundtrip_new_families(tmp_path):
         assert cfg2.sliding_window == cfg.sliding_window
         assert cfg2.attn_bias == cfg.attn_bias
         assert cfg2.n_experts == cfg.n_experts
+        assert cfg2.block == cfg.block
+        if cfg.block == "gemma2":
+            assert cfg2.explicit_head_dim == cfg.explicit_head_dim
+            assert cfg2.attn_softcap == cfg.attn_softcap
+            assert cfg2.final_softcap == cfg.final_softcap
+            assert cfg2.query_pre_attn_scalar == cfg.query_pre_attn_scalar
+            assert cfg2.alt_sliding_window
         for path, leaf in jax.tree_util.tree_leaves_with_path(p):
             leaf2 = p2
             for k in path:
@@ -400,3 +408,275 @@ def test_phi_pipeline_executor_matches_forward():
     np.testing.assert_allclose(
         float(loss_pp), float(jnp.mean(nll)), rtol=2e-2, atol=2e-2
     )
+
+
+# ----------------------------------------------------------------- gemma --
+
+def _gnorm(t, w, eps):
+    tf = t.astype(jnp.float32)
+    var = (tf * tf).mean(-1, keepdims=True)
+    return (tf / jnp.sqrt(var + eps) * (1.0 + w.astype(jnp.float32))).astype(
+        t.dtype
+    )
+
+
+def _naive_gemma_layer(pl, cfg, x, cos, sin, layer_idx):
+    """Independent straight-line gemma-2 block: sandwich (1+w)-RMSNorms,
+    GeGLU, query_pre_attn scaling, tanh-capped attention scores, and a
+    local mask on even layers — the oracle the production scan must match."""
+    from kserve_vllm_mini_tpu.ops.rope import apply_rope
+
+    B, T, D = x.shape
+    hd = cfg.head_dim
+    h = _gnorm(x, pl["attn_norm"], cfg.rms_eps)
+    q = (h @ pl["wq"]).reshape(B, T, cfg.n_heads, hd).transpose(0, 2, 1, 3)
+    k = (h @ pl["wk"]).reshape(B, T, cfg.n_kv_heads, hd).transpose(0, 2, 1, 3)
+    v = (h @ pl["wv"]).reshape(B, T, cfg.n_kv_heads, hd).transpose(0, 2, 1, 3)
+    pos = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+    q = apply_rope(q, pos, cos, sin)
+    k = apply_rope(k, pos, cos, sin)
+    g = cfg.n_heads // cfg.n_kv_heads
+    k = jnp.repeat(k, g, axis=1)
+    v = jnp.repeat(v, g, axis=1)
+
+    scale = (cfg.query_pre_attn_scalar or float(hd)) ** -0.5
+    logits = jnp.einsum("bhtd,bhsd->bhts", q, k).astype(jnp.float32) * scale
+    logits = jnp.tanh(logits / cfg.attn_softcap) * cfg.attn_softcap
+    qi = jnp.arange(T)[:, None]
+    kj = jnp.arange(T)[None, :]
+    mask = kj <= qi
+    if layer_idx % 2 == 0:
+        mask &= kj > qi - cfg.sliding_window
+    logits = jnp.where(mask[None, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, -1).astype(x.dtype)
+    o = jnp.einsum("bhts,bhsd->bhtd", probs, v)
+    o = o.transpose(0, 2, 1, 3).reshape(B, T, cfg.n_heads * hd)
+    x = x + _gnorm(o @ pl["wo"], pl["post_attn_norm"], cfg.rms_eps)
+
+    h2 = _gnorm(x, pl["mlp_norm"], cfg.rms_eps)
+    gate = jax.nn.gelu(
+        (h2 @ pl["w_gate"]).astype(jnp.float32), approximate=True
+    ).astype(x.dtype)
+    mlp = (gate * (h2 @ pl["w_up"])) @ pl["w_down"]
+    return x + _gnorm(mlp, pl["post_mlp_norm"], cfg.rms_eps)
+
+
+def test_gemma_forward_matches_naive_block():
+    """Production forward (scan, shared helpers, alternating masks,
+    softcaps, tied head) == straight-line oracle, at T past the window so
+    both mask phases bind."""
+    from kserve_vllm_mini_tpu.ops.rope import rope_frequencies
+
+    cfg = get_config("gemma-tiny")
+    T = 24                                     # > window (16)
+    p = init_params(jax.random.PRNGKey(0), cfg)
+    toks, pos = _tok_pos(cfg, 2, T)
+    cos, sin = rope_frequencies(
+        cfg.rotary_dim, cfg.max_seq_len, cfg.rope_theta, cfg.rope_scaling
+    )
+    x = p["embed"][toks] * jnp.asarray(cfg.d_model ** 0.5, cfg.jnp_dtype)
+    for i in range(cfg.n_layers):
+        pl = {k: v[i] for k, v in p["layers"].items()}
+        x = _naive_gemma_layer(pl, cfg, x, cos, sin, i)
+    x = _gnorm(x, p["final_norm"], cfg.rms_eps)
+    ref = (x @ p["embed"].T).astype(jnp.float32)
+    ref = jnp.tanh(ref / cfg.final_softcap) * cfg.final_softcap
+
+    lg, _ = forward(p, cfg, toks, pos)
+    np.testing.assert_allclose(np.asarray(lg), np.asarray(ref),
+                               rtol=3e-2, atol=3e-2)
+
+
+def test_gemma_cached_decode_matches_full_forward():
+    """Prefill+decode through the cache reproduces the cache-free forward
+    position-for-position — across the window boundary, both mask phases,
+    and the capped-score paths."""
+    cfg = get_config("gemma-tiny")
+    T, steps = 20, 8                           # crosses the 16-token window
+    p = init_params(jax.random.PRNGKey(0), cfg)
+    total = T + steps
+    toks, pos = _tok_pos(cfg, 1, total)
+    ref, _ = forward(p, cfg, toks, pos)
+
+    cache = init_kv_cache(cfg, 1, max_seq=64)
+    _, cache = forward(
+        p, cfg, toks[:, :T], pos[:, :T], cache,
+        jnp.zeros((1,), jnp.int32), fresh_prefill=True,
+    )
+    for i in range(steps):
+        t = T + i
+        lg, cache = forward(
+            p, cfg, toks[:, t : t + 1], pos[:, t : t + 1],
+            cache, jnp.full((1,), t, jnp.int32),
+        )
+        np.testing.assert_allclose(
+            np.asarray(lg[:, 0]), np.asarray(ref[:, t]), rtol=3e-2, atol=3e-2,
+            err_msg=f"decode step {i}",
+        )
+
+
+def test_gemma_alternating_window_binds():
+    """The alternation itself must matter: alternating logits differ from
+    both all-local (alt off, window kept) and all-global (window off) at
+    T > window — i.e. both phases are actually running."""
+    cfg = get_config("gemma-tiny")
+    T = 48
+    p = init_params(jax.random.PRNGKey(0), cfg)
+    toks, pos = _tok_pos(cfg, 2, T)
+    alt, _ = forward(p, cfg, toks, pos)
+    all_local, _ = forward(p, cfg.scaled(alt_sliding_window=False), toks, pos)
+    all_global, _ = forward(
+        p, cfg.scaled(sliding_window=None, alt_sliding_window=False), toks, pos
+    )
+    assert not np.allclose(np.asarray(alt[:, -1]),
+                           np.asarray(all_local[:, -1]), atol=1e-4)
+    assert not np.allclose(np.asarray(alt[:, -1]),
+                           np.asarray(all_global[:, -1]), atol=1e-4)
+
+
+def test_gemma_softcaps_bind():
+    """Final logits live strictly inside (-cap, cap), and disabling the
+    attention cap changes the result (the cap is really applied)."""
+    cfg = get_config("gemma-tiny")
+    p = init_params(jax.random.PRNGKey(0), cfg)
+    toks, pos = _tok_pos(cfg, 2, 16)
+    lg, _ = forward(p, cfg, toks, pos)
+    assert float(jnp.max(jnp.abs(lg))) < cfg.final_softcap
+    lg_nocap, _ = forward(p, cfg.scaled(attn_softcap=None), toks, pos)
+    assert not np.allclose(np.asarray(lg), np.asarray(lg_nocap), atol=1e-5)
+
+
+def test_gemma_explicit_head_dim():
+    """head_dim 48 != d_model/n_heads (32): projections must be shaped by
+    the explicit value."""
+    cfg = get_config("gemma-tiny")
+    assert cfg.head_dim == 48
+    p = init_params(jax.random.PRNGKey(0), cfg)
+    assert p["layers"]["wq"].shape == (cfg.n_layers, cfg.d_model, 4 * 48)
+    assert p["layers"]["wo"].shape == (cfg.n_layers, 4 * 48, cfg.d_model)
+    assert "lm_head" not in p                  # tied embeddings
+
+
+def test_gemma_tp_sharded_matches_unsharded():
+    from kserve_vllm_mini_tpu.parallel.mesh import MeshSpec, make_mesh
+    from kserve_vllm_mini_tpu.parallel.sharding import shard_params
+
+    cfg = get_config("gemma-tiny")
+    p = init_params(jax.random.PRNGKey(0), cfg)
+    toks, pos = _tok_pos(cfg, 4, 16)
+    ref, _ = forward(p, cfg, toks, pos)
+    mesh = make_mesh(MeshSpec(dp=4, tp=2))     # kv heads = 2 -> tp = 2
+    p_sharded = shard_params(p, cfg, mesh)
+    lg, _ = jax.jit(lambda pp, t, ps: forward(pp, cfg, t, ps))(p_sharded, toks, pos)
+    np.testing.assert_allclose(np.asarray(lg), np.asarray(ref), rtol=2e-2, atol=2e-2)
+
+
+def test_gemma_quantized_init_runs():
+    from kserve_vllm_mini_tpu.models.llama import init_params_quantized
+
+    cfg = get_config("gemma-tiny")
+    pq = init_params_quantized(jax.random.PRNGKey(0), cfg)
+    assert pq["layers"]["w_up"]["q"].dtype == jnp.int8
+    toks, pos = _tok_pos(cfg, 2, 16)
+    lg, _ = forward(pq, cfg, toks, pos)
+    assert bool(jnp.isfinite(lg).all())
+
+
+def test_gemma_engine_serves_greedy_oracle():
+    """The serving engine (continuous batching, cached decode, first-token
+    sampler) produces the sequential greedy tokens for a gemma model."""
+    from kserve_vllm_mini_tpu.runtime.engine import Engine, EngineConfig, GenRequest
+
+    cfg = get_config("gemma-tiny")
+    p = init_params(jax.random.PRNGKey(0), cfg)
+    prompt = [5, 9, 42, 7]
+    n_new = 8
+    toks = list(prompt)
+    for _ in range(n_new):
+        arr = jnp.asarray(toks, jnp.int32)[None]
+        pos = jnp.arange(len(toks), dtype=jnp.int32)[None]
+        lg, _ = forward(p, cfg, arr, pos)
+        toks.append(int(jnp.argmax(lg[0, -1])))
+    ref = toks[len(prompt):]
+
+    eng = Engine(
+        p, cfg,
+        EngineConfig(max_slots=2, max_seq_len=128, max_prefill_len=64,
+                     min_prefill_bucket=16),
+    )
+    eng.start()
+    try:
+        h = eng.submit(GenRequest(prompt_tokens=prompt, max_new_tokens=n_new))
+        got = []
+        while True:
+            kind, *rest = h.events.get(timeout=120)
+            if kind == "token":
+                got.append(rest[0])
+            else:
+                break
+        assert got == ref
+    finally:
+        eng.stop()
+
+
+def test_gemma_pipeline_executor_matches_forward():
+    """The pipelined training executor must reproduce forward()'s loss for
+    gemma too: sqrt(d_model) embeddings, global-parity alternating masks
+    across stages, (1+w) final norm, capped logits (the shared
+    embed_tokens/final_logits helpers are what keep executors honest)."""
+    from kserve_vllm_mini_tpu.parallel.mesh import MeshSpec, make_mesh
+    from kserve_vllm_mini_tpu.parallel.pipeline import pipeline_loss_fn
+
+    cfg = get_config("gemma-tiny")             # 4 layers -> 2 per stage
+    p = init_params(jax.random.PRNGKey(0), cfg)
+    B, T = 4, 24                               # T > window: both phases bind
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (B, T + 1), 0, cfg.vocab_size)
+
+    mesh = make_mesh(MeshSpec(dp=2, pp=2))
+    loss_pp = pipeline_loss_fn(p, cfg, tokens, mesh, n_microbatches=2)
+
+    inp, tgt = tokens[:, :-1], tokens[:, 1:]
+    pos = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+    logits, _ = forward(p, cfg, inp, pos)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+    np.testing.assert_allclose(
+        float(loss_pp), float(jnp.mean(nll)), rtol=2e-2, atol=2e-2
+    )
+
+
+def test_gemma_serving_pp_matches_single_device_engine():
+    """Gemma through the serving-PP engine emits the same greedy tokens as
+    the single-device engine — alternating masks keep GLOBAL layer parity
+    across the stage split, and the pp head applies gemma's epilogues."""
+    from kserve_vllm_mini_tpu.parallel.mesh import MeshSpec, make_mesh
+    from kserve_vllm_mini_tpu.parallel.sharding import shard_params
+    from kserve_vllm_mini_tpu.runtime.engine import Engine, EngineConfig, GenRequest
+
+    cfg = get_config("gemma-tiny")
+    p = init_params(jax.random.PRNGKey(0), cfg)
+    prompt = [5, 9, 42, 7, 13]
+    n_new = 8
+
+    def run(engine):
+        engine.start()
+        try:
+            h = engine.submit(GenRequest(prompt_tokens=prompt, max_new_tokens=n_new))
+            got = []
+            while True:
+                kind, *rest = h.events.get(timeout=180)
+                if kind == "token":
+                    got.append(rest[0])
+                else:
+                    break
+            return got
+        finally:
+            engine.stop()
+
+    ecfg = EngineConfig(max_slots=2, max_seq_len=64, max_prefill_len=32,
+                        min_prefill_bucket=16)
+    ref = run(Engine(p, cfg, ecfg))
+
+    mesh = make_mesh(MeshSpec(pp=2))
+    got = run(Engine(shard_params(p, cfg, mesh), cfg, ecfg, mesh=mesh))
+    assert got == ref
