@@ -130,6 +130,19 @@ class EngineConfig:
     # meshes; Engine rejects values that do not divide max_slots (a
     # non-dividing M would silently decode unpipelined).
     pp_microbatches: int = 1
+    # Automatic prefix caching: a finished request's slot RETAINS its KV,
+    # and a new request whose prompt shares a token prefix with a retained
+    # slot is admitted INTO that slot, prefilling only the suffix (vLLM's
+    # APC, re-thought for slot-contiguous caches: reuse = slot affinity,
+    # zero copies). Generated tokens are part of the reusable prefix
+    # (multi-turn chat appends to its own transcript). Off by default:
+    # reused rows were computed by whatever executable shape the ORIGINAL
+    # request used, so outputs can differ from a cold run by bf16 rounding
+    # — the oracle tests pin the cold paths bit-exactly and opt in where
+    # reuse itself is under test. Disabled when a drafter is configured
+    # (the drafter cache retains proposal garbage a new request's drafter
+    # would attend).
+    prefix_cache: bool = False
 
 
 @dataclass
@@ -268,6 +281,11 @@ class Engine:
         self._last_tokens = [pad_id] * S
         self._slot_machine: list[Optional[Any]] = [None] * S  # constraints
         self._free = list(range(S))
+        # prefix cache: tokens whose KV occupies the slot's rows 0..len-1
+        # while live, and the retained (trimmed-to-written) prefix once the
+        # slot is freed — matched against new prompts at admission
+        self._slot_tokens: list[list[int]] = [[] for _ in range(S)]
+        self._retained: list[list[int]] = [[] for _ in range(S)]
 
         self._pending: "queue.Queue[RequestHandle]" = queue.Queue()
         self._rng = jax.random.PRNGKey(self.ecfg.seed)
@@ -293,6 +311,8 @@ class Engine:
             "spec_rounds": 0,       # fused drafter-propose/target-verify rounds
             "spec_accepted": 0,     # draft tokens accepted across all rounds
             "spec_proposed": 0,     # draft tokens proposed (rounds x k-1)
+            "prefix_hits": 0,       # admissions that reused a retained prefix
+            "prefix_tokens_reused": 0,  # prompt tokens NOT re-prefilled
         }
 
     # -- compiled steps ----------------------------------------------------
@@ -373,9 +393,12 @@ class Engine:
         Variants are cached per n_steps. The scan carries (cache, tokens,
         lengths, rng) and stacks the sampled tokens [n_steps, S]; host state
         is the source of truth between dispatches, so a request finishing
-        mid-chunk just has its surplus tokens discarded on the host (their
-        KV writes stay inside the slot's own buffer and are overwritten on
-        the next admission)."""
+        mid-chunk just has its surplus tokens discarded on the host. Their
+        KV writes stay inside the slot's own buffer at positions >= the
+        retained/valid length, where the positional attention mask (key j
+        attends iff j <= query position) makes them unreachable — with
+        prefix caching a later admission may SKIP re-prefilling those rows,
+        so the mask, not overwrite-on-admission, is the safety invariant."""
         fn = self._decode_fns.get(n_steps)
         if fn is not None:
             return fn
@@ -530,16 +553,68 @@ class Engine:
         self._decode_fns["first"] = first
         return first
 
-    def _prefill_chunks(self, prompt: list[int], slot: int, draft: bool = False):
+    def _pop_slot_for(self, prompt: list[int]) -> tuple[int, int]:
+        """(slot, reused_prefix_len): with prefix caching on, prefer the
+        free slot whose retained tokens share the longest prefix with the
+        new prompt (capped at len(prompt)-1 — at least one position must
+        run so the last-token logits exist); otherwise plain pop().
+
+        Matches below min_prefill_bucket don't count: reusing k tokens
+        moves the remaining n-k off the flash fresh-prefill path onto the
+        positional-masked chunk path, so a trivial match (a shared chat-
+        template first byte) would make prefill SLOWER while reporting a
+        cache hit. Comparison is slice-equality (C speed) with a bisect on
+        mismatch, not a per-token Python loop — this runs on the scheduler
+        thread."""
+        if (
+            not self.ecfg.prefix_cache
+            or self._drafter_params is not None
+            or not self._free
+        ):
+            return self._free.pop(), 0
+        target = prompt[:-1]
+        best_i, best_k = len(self._free) - 1, 0
+        for i, s in enumerate(self._free):
+            retained = self._retained[s]
+            limit = min(len(retained), len(target))
+            if limit <= best_k:
+                continue  # cannot beat the current best
+            if retained[:limit] == target[:limit]:
+                k = limit
+            else:
+                lo, hi = 0, limit - 1  # [:lo] matches; [:limit] doesn't
+                while lo < hi:
+                    mid = (lo + hi + 1) // 2
+                    if retained[:mid] == target[:mid]:
+                        lo = mid
+                    else:
+                        hi = mid - 1
+                k = lo
+            if k > best_k:
+                best_i, best_k = i, k
+                if best_k == len(target):
+                    break  # perfect match
+        if best_k < self.ecfg.min_prefill_bucket:
+            best_k = 0
+            best_i = len(self._free) - 1
+        slot = self._free.pop(best_i)
+        if best_k > 0:
+            self.stats["prefix_hits"] += 1
+            self.stats["prefix_tokens_reused"] += best_k
+        return slot, best_k
+
+    def _prefill_chunks(self, prompt: list[int], slot: int, draft: bool = False,
+                        start_offset: int = 0):
         """Run the prompt through the slot's cache: chunk 0 on the flash
         fresh-prefill path, continuation chunks (prompts longer than
-        max_prefill_len) on the positional-masked chunk path. Returns the
-        last real position's logits [V] f32."""
+        max_prefill_len, or the suffix after a reused prefix) on the
+        positional-masked chunk path. Returns the last real position's
+        logits [V] f32."""
         budget = self.ecfg.max_prefill_len
         params = self._drafter_params if draft else self.params
         n = len(prompt)
         last_logits = None
-        off = 0
+        off = start_offset
         while off < n:
             piece = prompt[off : off + budget]
             m = len(piece)
@@ -567,10 +642,12 @@ class Engine:
 
     def _admit_one(self, handle: RequestHandle) -> None:
         req = handle.request
-        slot = self._free.pop()
+        slot, reused = self._pop_slot_for(req.prompt_tokens)
         n = len(req.prompt_tokens)
         t0 = time.time()
-        last_logits = self._prefill_chunks(req.prompt_tokens, slot)
+        last_logits = self._prefill_chunks(
+            req.prompt_tokens, slot, start_offset=reused
+        )
         # first token: sampled from the prompt's last-position logits,
         # grammar-masked when the request is constrained
         machine = req.constraint
@@ -598,7 +675,9 @@ class Engine:
             self._prefill_chunks(req.prompt_tokens, slot, draft=True)
         self.stats["busy_s"] += time.time() - t0
         self.stats["prefills"] += 1
-        self.stats["prefill_tokens"] += n
+        # only tokens actually prefilled: reused prefix tokens are counted
+        # in prefix_tokens_reused, not here (throughput math stays honest)
+        self.stats["prefill_tokens"] += n - reused
 
         handle.t_first_token = time.time()
         handle.tokens.append(first_id)
@@ -617,6 +696,10 @@ class Engine:
         self._slot_remaining[slot] = req.max_new_tokens - 1
         self._last_tokens[slot] = first_id
         self._slot_machine[slot] = machine
+        # rows 0..n-1 now hold the prompt's KV; emitted tokens append as
+        # their KV lands (fed on the next step)
+        self._slot_tokens[slot] = list(req.prompt_tokens) + [first_id]
+        self._retained[slot] = []
         self._sampling_arrays = None  # slot population changed
         if machine is not None:
             machine.advance_token(first_id)
@@ -658,6 +741,10 @@ class Engine:
             self.stats["requests_completed"] += 1
         self._slot_req[slot] = None
         self._slot_machine[slot] = None
+        if self.ecfg.prefix_cache:
+            # retain exactly the tokens whose KV is WRITTEN: the last
+            # emitted token was never fed, so trim to slot_len rows
+            self._retained[slot] = self._slot_tokens[slot][: self._slot_len[slot]]
         self._free.append(slot)
         self._sampling_arrays = None  # slot population changed
 
@@ -671,6 +758,7 @@ class Engine:
         req = handle.request
         self._slot_len[slot] += 1      # the fed token is now in cache
         self._last_tokens[slot] = tok
+        self._slot_tokens[slot].append(tok)
         handle.tokens.append(tok)
         if lp_info is not None and req.logprobs:
             handle.logprobs.append(lp_info)

@@ -71,6 +71,7 @@ def start_local_server(
         spec_tokens=int(
             profile.get("spec_tokens", 4 if profile.get("drafter") else 0)
         ),
+        prefix_cache=bool(profile.get("prefix_cache", False)),
     )
     engine.start()
     app = make_app(engine, tok, name)

@@ -48,6 +48,7 @@ def build_engine(
     pp_microbatches: int = 1,
     scan_unroll: int = 1,
     mesh=None,
+    prefix_cache: bool = False,
 ) -> tuple[Engine, Tokenizer, str]:
     """Construct (engine, tokenizer, model_name) from a preset or checkpoint.
 
@@ -174,6 +175,7 @@ def build_engine(
         decode_chunk=decode_chunk,
         spec_tokens=spec_tokens if drafter_pair is not None else 0,
         pp_microbatches=pp_microbatches,
+        prefix_cache=prefix_cache,
     )
     engine = Engine(
         params, cfg, ecfg, mesh=mesh, pad_id=tok.pad_id, drafter=drafter_pair
@@ -700,6 +702,10 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
             f"kvmini_tpu_spec_rounds_total {s['spec_rounds']}",
             "# TYPE kvmini_tpu_spec_accept_ratio gauge",
             f"kvmini_tpu_spec_accept_ratio {s['spec_accept_ratio']:.6f}",
+            "# TYPE kvmini_tpu_prefix_hits_total counter",
+            f"kvmini_tpu_prefix_hits_total {s['prefix_hits']}",
+            "# TYPE kvmini_tpu_prefix_tokens_reused_total counter",
+            f"kvmini_tpu_prefix_tokens_reused_total {s['prefix_tokens_reused']}",
         ]
         return web.Response(text="\n".join(lines) + "\n", content_type="text/plain")
 
@@ -755,6 +761,11 @@ def register(parser: argparse.ArgumentParser) -> None:
                         help="Speculative propose/verify depth per round "
                              "(default: $KVMINI_SPEC_TOKENS or 4 when a "
                              "drafter is set)")
+    parser.add_argument("--prefix-cache", action="store_true",
+                        help="Automatic prefix caching: finished requests "
+                             "retain their KV and new prompts sharing a "
+                             "token prefix reuse it (slot-affinity APC; "
+                             "repeat-heavy traffic skips most prefill)")
     parser.add_argument("--distributed", action="store_true",
                         help="Join a multi-host jax.distributed runtime "
                              "(KVMINI_COORDINATOR / KVMINI_NUM_PROCESSES / "
@@ -849,6 +860,10 @@ def run(args: argparse.Namespace) -> int:
         drafter=drafter,
         spec_tokens=spec_tokens,
         mesh=mesh_override,
+        prefix_cache=bool(
+            args.prefix_cache
+            or os.environ.get("KVMINI_PREFIX_CACHE", "") in ("1", "true")
+        ),
     )
 
     if multihost:

@@ -1,0 +1,184 @@
+"""Automatic prefix caching (Engine.prefix_cache): finished slots retain
+their KV and new prompts sharing a token prefix are admitted into the
+best-matching slot, prefilling only the suffix — correctness must be
+oracle-exact and the reuse must actually happen (stats prove it)."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from kserve_vllm_mini_tpu.models.config import get_config
+from kserve_vllm_mini_tpu.models.llama import forward, init_params
+from kserve_vllm_mini_tpu.runtime.engine import Engine, EngineConfig, GenRequest
+
+pytestmark = pytest.mark.slow
+
+CFG = get_config("llama-tiny")
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(jax.random.PRNGKey(0), CFG)
+
+
+def greedy_reference(params, prompt, n_new):
+    toks = list(prompt)
+    for _ in range(n_new):
+        arr = jnp.asarray(toks, dtype=jnp.int32)[None]
+        pos = jnp.arange(len(toks), dtype=jnp.int32)[None]
+        logits, _ = forward(params, CFG, arr, pos)
+        toks.append(int(jnp.argmax(logits[0, -1])))
+    return toks[len(prompt):]
+
+
+def _drain(handle):
+    out = []
+    while True:
+        kind, *rest = handle.events.get(timeout=120)
+        if kind == "token":
+            out.append(rest[0])
+        else:
+            return out, rest[0]
+
+
+def make_engine(params, prefix_cache=True, slots=2):
+    eng = Engine(
+        params, CFG,
+        EngineConfig(max_slots=slots, max_seq_len=128, max_prefill_len=64,
+                     min_prefill_bucket=16, prefix_cache=prefix_cache),
+    )
+    eng.start()
+    return eng
+
+
+def test_repeat_prompt_reuses_prefix_and_stays_exact(params):
+    """Second identical request must hit the cache (n-1 tokens reused) and
+    emit the same tokens the cold request did. Prompts are longer than
+    min_prefill_bucket — shorter matches deliberately don't reuse."""
+    prompt = list(range(2, 26))                # 24 tokens > bucket floor (16)
+    eng = make_engine(params)
+    try:
+        t1, _ = _drain(eng.submit(GenRequest(prompt_tokens=prompt, max_new_tokens=8)))
+        assert eng.stats["prefix_hits"] == 0
+        t2, _ = _drain(eng.submit(GenRequest(prompt_tokens=prompt, max_new_tokens=8)))
+        assert t2 == t1
+        assert eng.stats["prefix_hits"] == 1
+        assert eng.stats["prefix_tokens_reused"] == len(prompt) - 1
+    finally:
+        eng.stop()
+
+
+def test_partial_prefix_reuse_matches_oracle(params):
+    """A second prompt sharing only a prefix reuses exactly that prefix and
+    still matches its own sequential greedy oracle."""
+    p1 = list(range(2, 26))
+    p2 = p1[:20] + [100, 50, 2]
+    ref2 = greedy_reference(params, p2, 8)
+    eng = make_engine(params)
+    try:
+        _drain(eng.submit(GenRequest(prompt_tokens=p1, max_new_tokens=6)))
+        t2, _ = _drain(eng.submit(GenRequest(prompt_tokens=p2, max_new_tokens=8)))
+        assert t2 == ref2
+        assert eng.stats["prefix_hits"] == 1
+        assert eng.stats["prefix_tokens_reused"] == 20
+    finally:
+        eng.stop()
+
+
+def test_multiturn_transcript_extends_reuse(params):
+    """Generated tokens are part of the retained prefix: a follow-up prompt
+    of (prompt + generated + more) reuses past the first prompt's length —
+    the multi-turn chat pattern."""
+    p1 = list(range(3, 21))                    # 18 tokens
+    eng = make_engine(params)
+    try:
+        t1, _ = _drain(eng.submit(GenRequest(prompt_tokens=p1, max_new_tokens=6)))
+        follow = p1 + t1 + [77, 3]
+        ref = greedy_reference(params, follow, 6)
+        t2, _ = _drain(eng.submit(GenRequest(prompt_tokens=follow, max_new_tokens=6)))
+        assert t2 == ref
+        assert eng.stats["prefix_hits"] == 1
+        # the last generated token's KV was never written (it was never
+        # fed), so reuse covers prompt + all but that token
+        assert eng.stats["prefix_tokens_reused"] == len(p1) + len(t1) - 1
+    finally:
+        eng.stop()
+
+
+def test_no_match_still_correct_and_unreused(params):
+    p1 = list(range(2, 26))
+    p2 = list(range(100, 76, -1))
+    ref2 = greedy_reference(params, p2, 6)
+    eng = make_engine(params)
+    try:
+        _drain(eng.submit(GenRequest(prompt_tokens=p1, max_new_tokens=4)))
+        t2, _ = _drain(eng.submit(GenRequest(prompt_tokens=p2, max_new_tokens=6)))
+        assert t2 == ref2
+        assert eng.stats["prefix_hits"] == 0
+    finally:
+        eng.stop()
+
+
+def test_eviction_pressure_stays_correct(params):
+    """More distinct prompts than slots: retained prefixes churn, every
+    response still matches its oracle."""
+    eng = make_engine(params, slots=2)
+    prompts = [[i, i + 1, i + 2, 7] for i in range(1, 11, 2)]
+    try:
+        for pr in prompts:
+            ref = greedy_reference(params, pr, 5)
+            got, _ = _drain(eng.submit(GenRequest(prompt_tokens=pr, max_new_tokens=5)))
+            assert got == ref, pr
+    finally:
+        eng.stop()
+
+
+def test_disabled_by_default(params):
+    prompt = [5, 9, 42, 7]
+    eng = make_engine(params, prefix_cache=False)
+    try:
+        _drain(eng.submit(GenRequest(prompt_tokens=prompt, max_new_tokens=4)))
+        _drain(eng.submit(GenRequest(prompt_tokens=prompt, max_new_tokens=4)))
+        assert eng.stats["prefix_hits"] == 0
+        assert eng.stats["prefix_tokens_reused"] == 0
+    finally:
+        eng.stop()
+
+
+def test_constrained_request_can_reuse_prompt_prefix(params):
+    """Grammar-constrained requests reuse prompt KV like any other (the
+    constraint only shapes OUTPUT tokens)."""
+    import json as _json
+
+    from kserve_vllm_mini_tpu.runtime.constrain import json_constraint
+
+    prompt = list(range(2, 24))
+    eng = make_engine(params)
+    try:
+        _drain(eng.submit(GenRequest(prompt_tokens=prompt, max_new_tokens=6)))
+        h = eng.submit(GenRequest(prompt_tokens=prompt, max_new_tokens=60,
+                                  constraint=json_constraint()))
+        toks, info = _drain(h)
+        text = bytes(t - 3 for t in toks if 3 <= t < 259).decode()
+        assert isinstance(_json.loads(text), dict)
+        assert info["finish_reason"] == "stop"
+        assert eng.stats["prefix_hits"] == 1
+    finally:
+        eng.stop()
+
+
+def test_short_match_below_bucket_floor_does_not_reuse(params):
+    """A match shorter than min_prefill_bucket must NOT reuse: it would
+    trade the flash fresh-prefill path for the chunk path on almost the
+    whole prompt while reporting a misleading hit."""
+    p1 = list(range(2, 26))
+    p2 = p1[:8] + list(range(200, 216))        # only 8 shared tokens
+    ref2 = greedy_reference(params, p2, 5)
+    eng = make_engine(params)
+    try:
+        _drain(eng.submit(GenRequest(prompt_tokens=p1, max_new_tokens=4)))
+        t2, _ = _drain(eng.submit(GenRequest(prompt_tokens=p2, max_new_tokens=5)))
+        assert t2 == ref2
+        assert eng.stats["prefix_hits"] == 0
+    finally:
+        eng.stop()
