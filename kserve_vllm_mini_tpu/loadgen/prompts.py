@@ -53,9 +53,13 @@ def make_prompt_fn(
     # idx->prompt mapping is independent of the async order in which workers
     # first call the function — seeded runs must be byte-reproducible.
     if prompt_set == "unique":
+        # nonce FIRST: "unique" is the zero-cache-hit control set, and
+        # prefix caches (including this repo's own engine APC) match from
+        # the front — a trailing nonce would leave the whole base+pad
+        # prefix reusable and quietly turn the miss baseline into hits
         def unique(i: int) -> str:
             salt = random.Random(f"{seed}:{i}").getrandbits(64)
-            return f"{base}{pad} [nonce {salt:016x} #{i}]"
+            return f"[nonce {salt:016x} #{i}] {base}{pad}"
 
         return unique
     if prompt_set == "mixed":
@@ -65,7 +69,7 @@ def make_prompt_fn(
             r = random.Random(f"{seed}:{i}")
             if r.random() < mixed_repeat_ratio:
                 return pool[i % pool_size]
-            return f"{base}{pad} [nonce {i}-{r.getrandbits(32):08x}]"
+            return f"[nonce {i}-{r.getrandbits(32):08x}] {base}{pad}"
 
         return mixed
     raise ValueError(f"unknown prompt set {prompt_set!r}")

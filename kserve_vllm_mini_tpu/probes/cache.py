@@ -96,18 +96,24 @@ def run_cache_probe(
     # warmup phase: the first requests to a fresh runtime pay XLA compile /
     # model-load costs; without this the first measured set (repeat) absorbs
     # them and the TTFT comparison is biased toward "no cache effect" or
-    # worse, inverted
-    warmup_dir = RunDir.create(root=run_root or "runs")
-    warmup_dir.path.mkdir(parents=True, exist_ok=True)
-    run_load(
-        LoadConfig(
-            url=url, model=model, backend=backend,
-            num_requests=max(4, concurrency), concurrency=concurrency,
-            max_tokens=max_tokens, input_tokens=input_tokens,
-            prompt_set="unique", seed=seed + 1000,
-        ),
-        warmup_dir,
-    )
+    # worse, inverted. BOTH prompt sets warm up: a caching server executes
+    # different code for a cache hit than a miss (e.g. suffix-only prefill),
+    # and measuring its first-ever hits would charge their compile/setup
+    # costs to exactly the phenomenon under measurement. The repeat warmup
+    # uses the measured pool's seed on purpose — the measurement is of the
+    # STEADY-STATE hit path, which is what capacity math needs.
+    for warm_set, warm_seed in (("unique", seed + 1000), ("repeat", seed)):
+        warmup_dir = RunDir.create(root=run_root or "runs")
+        warmup_dir.path.mkdir(parents=True, exist_ok=True)
+        run_load(
+            LoadConfig(
+                url=url, model=model, backend=backend,
+                num_requests=max(4, concurrency), concurrency=concurrency,
+                max_tokens=max_tokens, input_tokens=input_tokens,
+                prompt_set=warm_set, seed=warm_seed,
+            ),
+            warmup_dir,
+        )
 
     ttfts: dict[str, list[float]] = {}
     run_dirs: dict[str, str] = {}

@@ -573,7 +573,11 @@ class Engine:
         ):
             return self._free.pop(), 0
         target = prompt[:-1]
-        best_i, best_k = len(self._free) - 1, 0
+        # default victim = the OLDEST-freed slot (front of the list): a
+        # no-match admission must evict the least-recently-retained prefix,
+        # not the newest one (pop() from the tail would wipe the freshest
+        # cache entry on every miss)
+        best_i, best_k = 0, 0
         for i, s in enumerate(self._free):
             retained = self._retained[s]
             limit = min(len(retained), len(target))
@@ -594,9 +598,13 @@ class Engine:
                 best_i, best_k = i, k
                 if best_k == len(target):
                     break  # perfect match
-        if best_k < self.ecfg.min_prefill_bucket:
+        # floor: absolute (one full bucket) AND relative (a quarter of the
+        # prompt) — a shared 20-token chat header on a 500-token prompt
+        # must not move the other 480 tokens off the flash prefill path
+        floor = max(self.ecfg.min_prefill_bucket, len(target) // 4)
+        if best_k < floor:
             best_k = 0
-            best_i = len(self._free) - 1
+            best_i = 0  # LRU victim (see above)
         slot = self._free.pop(best_i)
         if best_k > 0:
             self.stats["prefix_hits"] += 1

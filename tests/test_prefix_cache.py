@@ -120,15 +120,22 @@ def test_no_match_still_correct_and_unreused(params):
 
 
 def test_eviction_pressure_stays_correct(params):
-    """More distinct prompts than slots: retained prefixes churn, every
-    response still matches its oracle."""
+    """More distinct prompt families than slots, with reuse-length prompts
+    repeated under churn: retained prefixes are freed, re-admitted, and
+    freed again, reuse actually fires (stats prove it), and every response
+    still matches its oracle."""
     eng = make_engine(params, slots=2)
-    prompts = [[i, i + 1, i + 2, 7] for i in range(1, 11, 2)]
+    families = [list(range(b, b + 20)) for b in (1, 60, 120)]  # 3 > slots
+    # temporal locality: f0 recurs while f2 churns through — LRU eviction
+    # must keep the recurring family's prefix alive
+    order = [0, 1, 0, 2, 0, 1]
     try:
-        for pr in prompts:
+        for fi in order:
+            pr = families[fi]
             ref = greedy_reference(params, pr, 5)
             got, _ = _drain(eng.submit(GenRequest(prompt_tokens=pr, max_new_tokens=5)))
             assert got == ref, pr
+        assert eng.stats["prefix_hits"] >= 2  # both f0 revisits hit
     finally:
         eng.stop()
 
@@ -182,3 +189,38 @@ def test_short_match_below_bucket_floor_does_not_reuse(params):
         assert eng.stats["prefix_hits"] == 0
     finally:
         eng.stop()
+
+
+def test_cache_probe_detects_prefix_cache_end_to_end():
+    """The harness-side cache probe (probes/cache.py TTFT statistics) must
+    detect OUR runtime's prefix cache from the OUTSIDE: repeat-pool TTFTs
+    collapse vs unique-pool TTFTs on a prefix-cached self-serve. This is
+    the loop the reference can only run against external engines."""
+    from kserve_vllm_mini_tpu.probes.cache import run_cache_probe
+    from kserve_vllm_mini_tpu.runtime.local import local_server
+
+    profile = {
+        "model": "llama-tiny",
+        "max_slots": 4,
+        "max_model_len": 1024,   # engine still clamps to the MODEL's 256
+        "prefix_cache": True,
+    }
+    # sizing matters: llama-tiny's window is 256 tokens and the engine
+    # tail-truncates longer prompts — which would cut the LEADING nonce
+    # off the unique set and silently turn the miss baseline into hits.
+    # input_tokens=50 -> ~230 byte-tokens: fits the window, and a miss
+    # (~230-token flash prefill) still dwarfs a hit (1-token chunk).
+    with local_server(profile) as srv:
+        stats = run_cache_probe(
+            srv.url, model="llama-tiny", requests=20, concurrency=2,
+            max_tokens=2, input_tokens=50, run_root="/tmp/cache-probe-e2e",
+        )
+        # the engine's own counters prove reuse actually happened...
+        eng = srv.engine.snapshot_stats()
+        assert eng["prefix_hits"] > 0
+        assert eng["prefix_tokens_reused"] > 0
+        # ...and the probe's black-box inference must see the effect
+        assert stats["valid"]
+        assert stats["repeat_ttft_mean_ms"] < stats["unique_ttft_mean_ms"], stats
+        assert stats["significant"], stats
+        assert stats["inferred_hit_ratio"] > 0, stats
