@@ -138,6 +138,14 @@ def _jax_native_env(cfg: BackendConfig, topo: TpuTopology) -> dict[str, str]:
             "speculative decoding does not compose with serving pipeline "
             "parallelism — drop the drafter or pipeline_parallel"
         )
+    if cfg.pipeline_parallel > 1 and topo.name.endswith("-longctx"):
+        # the runtime's pp branch takes precedence over topology, so the
+        # seq-sharded layout would be silently dropped — reject instead
+        raise ValueError(
+            f"pipeline_parallel does not compose with the {topo.name} "
+            "layout (pure-pp mesh would drop the seq-sharded KV cache); "
+            "pick one"
+        )
     env = {
         "KVMINI_MODEL_ID": cfg.model_id,
         "KVMINI_MODEL_URI": cfg.model_uri or cfg.model_id,
@@ -148,6 +156,11 @@ def _jax_native_env(cfg: BackendConfig, topo: TpuTopology) -> dict[str, str]:
         **({"KVMINI_PP": str(cfg.pipeline_parallel),
             "KVMINI_PP_MICROBATCHES": str(max(cfg.pp_microbatches, 1))}
            if cfg.pipeline_parallel > 1 else {}),
+        # layout-suffixed topologies (v5e-8-longctx: tp x sp with the KV
+        # seq axis sharded) are a runtime MESH choice, not a pod shape —
+        # hand the preset name through so serve builds the right mesh
+        **({"KVMINI_TOPOLOGY": topo.name}
+           if topo.name.endswith("-longctx") else {}),
     }
     if cfg.kv_cache_dtype != "auto":
         env["KVMINI_KV_CACHE_DTYPE"] = cfg.kv_cache_dtype

@@ -41,7 +41,30 @@ TOPOLOGIES: dict[str, TpuTopology] = {
 }
 
 
+# layout-suffixed names the runtime's mesh presets implement. Kept as a
+# literal so the deploy layer stays importable without jax; a test asserts
+# it matches parallel/mesh.py TOPOLOGY_PRESETS.
+RUNTIME_LAYOUT_PRESETS = {"v5e-8-longctx", "v5p-16-longctx"}
+
+
 def get_topology(name: str) -> TpuTopology:
+    # logical-layout suffixes ride on physical slices: "v5e-8-longctx" is
+    # the same 2x4 podslice as "v5e-8" with a tp x sp mesh layout inside
+    # the runtime (parallel/mesh.py TOPOLOGY_PRESETS). Resolve the physical
+    # slice but keep the requested name so the deploy env can hand the
+    # layout to the runtime (KVMINI_TOPOLOGY). Only layouts the RUNTIME
+    # actually knows are accepted — rendering an unknown one would ship a
+    # manifest that CrashLoops at boot instead of failing here.
+    if name.endswith("-longctx"):
+        from dataclasses import replace as _replace
+
+        if name not in RUNTIME_LAYOUT_PRESETS:
+            raise ValueError(
+                f"unknown layout topology {name!r} (runtime presets: "
+                f"{', '.join(sorted(RUNTIME_LAYOUT_PRESETS))})"
+            )
+        base = get_topology(name[: -len("-longctx")])
+        return _replace(base, name=name)
     try:
         return TOPOLOGIES[name]
     except KeyError:

@@ -163,5 +163,5 @@ def mesh_for_topology(name: str, dcn_dp: int = 1) -> jax.sharding.Mesh:
     if name not in TOPOLOGY_PRESETS:
         raise ValueError(f"unknown topology {name!r}; known: {sorted(TOPOLOGY_PRESETS)}")
     p = TOPOLOGY_PRESETS[name]
-    spec = MeshSpec.fill(p["chips"], tp=p.get("tp"))
+    spec = MeshSpec.fill(p["chips"], tp=p.get("tp"), sp=p.get("sp", 1))
     return global_mesh(spec, dcn_dp=dcn_dp)

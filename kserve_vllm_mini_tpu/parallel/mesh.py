@@ -78,6 +78,11 @@ TOPOLOGY_PRESETS: dict[str, dict] = {
     "v5e-8": {"chips": 8, "tp": 8},
     "v5p-8": {"chips": 8, "tp": 8},
     "v5p-16": {"chips": 16, "tp": 16},   # 2 hosts over ICI (BASELINE configs[4])
+    # long-context serving: the KV cache's SEQ axis shards over sp, so each
+    # chip holds max_seq/sp of every slot's cache — 4x the context per HBM
+    # at the same tp width (parallel/sharding.py kv_cache_shardings)
+    "v5e-8-longctx": {"chips": 8, "tp": 2, "sp": 4},
+    "v5p-16-longctx": {"chips": 16, "tp": 4, "sp": 4},
     "cpu-8": {"chips": 8, "tp": 4},      # virtual CPU mesh for tests
 }
 
@@ -99,5 +104,5 @@ def mesh_for_topology(name: str, devices: Optional[Sequence[jax.Device]] = None)
     if name not in TOPOLOGY_PRESETS:
         raise ValueError(f"unknown topology {name!r}; known: {sorted(TOPOLOGY_PRESETS)}")
     p = TOPOLOGY_PRESETS[name]
-    spec = MeshSpec.fill(p["chips"], tp=p.get("tp"))
+    spec = MeshSpec.fill(p["chips"], tp=p.get("tp"), sp=p.get("sp", 1))
     return make_mesh(spec, devices)

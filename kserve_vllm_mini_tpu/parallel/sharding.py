@@ -158,14 +158,20 @@ def token_sharding(mesh: Mesh) -> NamedSharding:
 def kv_cache_shardings(
     cfg: ModelConfig, mesh: Mesh, quantized: bool = False
 ) -> dict[str, NamedSharding]:
+    """[L, B, KVH, S, D] layout. The SEQUENCE axis shards over ``sp`` —
+    long-context serving: each chip holds max_seq/sp of every slot's
+    cache, and decode attention's softmax/contraction over the sharded S
+    axis lowers to XLA-inserted collectives (GSPMD reduction handling;
+    the scaling-book recipe — annotate, let XLA place the psums)."""
     tp, dp, pp = _axis(mesh, "tp"), _axis(mesh, "dp"), _axis(mesh, "pp")
+    sp = _axis(mesh, "sp")
     kv_tp = tp if tp and cfg.n_kv_heads % mesh.shape["tp"] == 0 else None
-    spec = P(pp, dp, kv_tp, None, None)  # [L, B, KVH, S, D]
+    spec = P(pp, dp, kv_tp, sp, None)  # [L, B, KVH, S, D]
     s = NamedSharding(mesh, spec)
     out = {"k": s, "v": s}
     if quantized:
         # int8-KV scales: same layout minus the head_dim axis
-        s4 = NamedSharding(mesh, P(pp, dp, kv_tp, None))  # [L, B, KVH, S]
+        s4 = NamedSharding(mesh, P(pp, dp, kv_tp, sp))  # [L, B, KVH, S]
         out["k_s"] = out["v_s"] = s4
     return out
 

@@ -830,8 +830,25 @@ def run(args: argparse.Namespace) -> int:
             from kserve_vllm_mini_tpu.parallel.mesh import MeshSpec
 
             n_global = len(jax.devices())
+            topo_name = args.topology or os.environ.get("KVMINI_TOPOLOGY")
             if pp and pp > 1:
                 spec = MeshSpec(pp=pp)
+            elif topo_name:
+                # a layout preset (e.g. v5p-16-longctx: tp4 x sp4) names the
+                # GLOBAL mesh across hosts — without this, multi-host serving
+                # would silently fall back to plain tp and drop the layout
+                from kserve_vllm_mini_tpu.parallel.mesh import TOPOLOGY_PRESETS
+
+                if topo_name not in TOPOLOGY_PRESETS:
+                    raise SystemExit(f"unknown topology preset {topo_name!r}")
+                pr = TOPOLOGY_PRESETS[topo_name]
+                if pr["chips"] != n_global:
+                    raise SystemExit(
+                        f"topology {topo_name} is {pr['chips']} chips but the "
+                        f"process group has {n_global} devices"
+                    )
+                spec = MeshSpec.fill(n_global, tp=pr.get("tp"),
+                                     sp=pr.get("sp", 1))
             else:
                 spec = MeshSpec.fill(n_global, tp=args.tp or n_global)
             if spec.dp > 1:
@@ -850,7 +867,7 @@ def run(args: argparse.Namespace) -> int:
         max_slots=max_slots,
         decode_chunk=args.decode_chunk,
         max_seq_len=max_seq,
-        topology=args.topology,
+        topology=args.topology or os.environ.get("KVMINI_TOPOLOGY") or None,
         pp=pp,
         pp_microbatches=pp_mb,
         scan_unroll=args.scan_unroll,

@@ -193,3 +193,27 @@ def test_harness_chart_renders_and_is_least_privilege():
     pvc_names = {v.get("persistentVolumeClaim", {}).get("claimName")
                  for v in spec["volumes"]}
     assert "bench-runs" in pvc_names
+
+
+def test_layout_presets_sync_with_runtime_mesh():
+    """deploy/topology.py's literal RUNTIME_LAYOUT_PRESETS (kept jax-free)
+    must list exactly the layout-suffixed names the runtime mesh presets
+    implement — a drift ships manifests that CrashLoop at boot."""
+    from kserve_vllm_mini_tpu.deploy.topology import (
+        RUNTIME_LAYOUT_PRESETS,
+        get_topology,
+    )
+    from kserve_vllm_mini_tpu.parallel.mesh import TOPOLOGY_PRESETS
+
+    runtime_layouts = {n for n in TOPOLOGY_PRESETS if n.endswith("-longctx")}
+    assert RUNTIME_LAYOUT_PRESETS == runtime_layouts
+
+    topo = get_topology("v5e-8-longctx")
+    assert topo.name == "v5e-8-longctx"
+    assert topo.chips * topo.hosts == 8
+    assert topo.accelerator == get_topology("v5e-8").accelerator
+
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="layout"):
+        get_topology("v6e-8-longctx")

@@ -268,6 +268,36 @@ def test_sharded_engine_matches_oracle(params):
         eng.stop()
 
 
+def test_sp_sharded_engine_long_context_matches_oracle(params):
+    """Long-context serving: the KV cache's SEQUENCE axis shards over sp
+    (each device holds max_seq/sp of every slot), and the engine's greedy
+    output stays bit-exact — prompts chunk-prefill across shard
+    boundaries, decode walks through them, and GSPMD supplies the
+    softmax/contraction collectives (v5e-8-longctx topology layout)."""
+    from kserve_vllm_mini_tpu.parallel.mesh import MeshSpec, make_mesh
+    from kserve_vllm_mini_tpu.parallel.sharding import shard_params
+
+    mesh = make_mesh(MeshSpec(sp=4, tp=2))
+    eng = Engine(
+        shard_params(params, CFG, mesh), CFG,
+        # 128/4 = 32-position shards; the 45-token prompt spans two shards
+        # (chunked at 32) and 50 decode steps cross into the third
+        EngineConfig(max_slots=2, max_seq_len=128, max_prefill_len=32,
+                     min_prefill_bucket=16),
+        mesh=mesh,
+    )
+    eng.start()
+    try:
+        prompt = [(i * 7 + 3) % 500 for i in range(45)]
+        ref = greedy_reference(params, prompt, 50)
+        h = eng.submit(GenRequest(prompt_tokens=prompt, max_new_tokens=50))
+        tokens, info = _drain(h)
+        assert tokens == ref
+        assert info["finish_reason"] == "length"
+    finally:
+        eng.stop()
+
+
 # -- speculative decoding ----------------------------------------------------
 
 DRAFTER_CFG = get_config("llama-tiny")
