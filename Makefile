@@ -8,7 +8,11 @@ help:
 	@grep -E '^[a-z-]+:' Makefile | sed 's/:.*//' | sort | uniq
 
 test:
-	$(PY) -m pytest tests/ -q
+	# >=2 workers REQUIRED, not an optimization: a single process running
+	# the whole suite segfaults around test ~335 (XLA:CPU state
+	# accumulation; see docs/TROUBLESHOOTING.md). xdist keeps each worker
+	# under the threshold.
+	$(PY) -m pytest tests/ -q -n 2
 
 test-fast:  ## harness-only tests (skip JAX model/runtime suites)
 	$(PY) -m pytest tests/ -q -m "not slow" --ignore=tests/test_model.py \

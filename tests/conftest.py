@@ -20,18 +20,17 @@ try:
     import jax  # noqa: E402
 
     jax.config.update("jax_platforms", "cpu")
-    # Persistent compilation cache: the slow lane is compile-bound (dozens
-    # of tiny-model jit variants), and the cache works on the CPU backend
-    # too — a warm cache cuts a cold `make test` by the full compile time.
-    # CI keeps .jax_cache across runs (actions/cache); override the
-    # location with JAX_COMPILATION_CACHE_DIR.
-    _cache_dir = os.environ.get(
-        "JAX_COMPILATION_CACHE_DIR",
-        os.path.join(os.path.dirname(__file__), "..", ".jax_cache"),
-    )
-    jax.config.update("jax_compilation_cache_dir", _cache_dir)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    # Persistent compilation cache: measured to halve warm-suite wall time,
+    # but STRICTLY OPT-IN (set JAX_COMPILATION_CACHE_DIR): jaxlib 0.9.0's
+    # XLA:CPU AOT cache loads entries whose recorded machine features don't
+    # match the host ("prefer-no-scatter ... could lead to SIGILL") and a
+    # full-suite run with a warm shared cache segfaulted at ~94% — a
+    # default-on cache that can SIGSEGV the lane is worse than slow.
+    _cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR")
+    if _cache_dir:
+        jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 except ImportError:
     # JAX is the optional 'runtime' extra; harness-layer tests run without it.
     collect_ignore_glob = [

@@ -1,0 +1,20 @@
+"""The sequential greedy oracle — ONE definition shared by every test that
+asserts engine output equals plain greedy decode (test_runtime,
+test_prefix_cache, sp_oracle_worker). Full recompute per step: slow and
+obviously correct, which is the entire point of an oracle."""
+
+from __future__ import annotations
+
+
+def greedy_reference(params, cfg, prompt: list[int], n_new: int) -> list[int]:
+    import jax.numpy as jnp
+
+    from kserve_vllm_mini_tpu.models.llama import forward
+
+    toks = list(prompt)
+    for _ in range(n_new):
+        arr = jnp.asarray(toks, dtype=jnp.int32)[None]
+        pos = jnp.arange(len(toks), dtype=jnp.int32)[None]
+        logits, _ = forward(params, cfg, arr, pos)
+        toks.append(int(jnp.argmax(logits[0, -1])))
+    return toks[len(prompt):]

@@ -1,0 +1,73 @@
+"""Worker for test_runtime.py's sequence-parallel serving oracle.
+
+Runs in its OWN process: the sp-sharded decode path is exercised against a
+fresh XLA runtime. In-process, the same test segfaulted deterministically
+when run after ~330 other tests (XLA:CPU state accumulation — the crash
+never reproduces in a fresh process, with or without the compilation
+cache), so process isolation is part of the test design, not convenience.
+
+Prints SP_ORACLE_OK on bit-exact match; exits nonzero otherwise.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from kserve_vllm_mini_tpu.models.config import get_config
+    from kserve_vllm_mini_tpu.models.llama import init_params
+    from kserve_vllm_mini_tpu.parallel.mesh import MeshSpec, make_mesh
+    from kserve_vllm_mini_tpu.parallel.sharding import shard_params
+    from kserve_vllm_mini_tpu.runtime.engine import Engine, EngineConfig, GenRequest
+    from tests.oracle import greedy_reference
+
+    cfg = get_config("llama-tiny")
+    params = init_params(jax.random.PRNGKey(0), cfg)
+
+    prompt = [(i * 7 + 3) % 500 for i in range(45)]
+    n_new = 50
+    ref = greedy_reference(params, cfg, prompt, n_new)
+
+    # 128/4 = 32-position shards; the 45-token prompt chunk-prefills across
+    # two shards (max_prefill 32) and 50 decode steps cross into the third
+    mesh = make_mesh(MeshSpec(sp=4, tp=2))
+    eng = Engine(
+        shard_params(params, cfg, mesh), cfg,
+        EngineConfig(max_slots=2, max_seq_len=128, max_prefill_len=32,
+                     min_prefill_bucket=16),
+        mesh=mesh,
+    )
+    eng.start()
+    try:
+        h = eng.submit(GenRequest(prompt_tokens=prompt, max_new_tokens=n_new))
+        got = []
+        while True:
+            kind, *rest = h.events.get(timeout=300)
+            if kind == "token":
+                got.append(rest[0])
+            else:
+                info = rest[0]
+                break
+    finally:
+        eng.stop()
+    assert got == ref, f"sp-sharded engine diverged:\n got={got}\n ref={ref}"
+    assert info["finish_reason"] == "length"
+    print("SP_ORACLE_OK", len(got))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -8,7 +8,7 @@ import jax.numpy as jnp
 import pytest
 
 from kserve_vllm_mini_tpu.models.config import get_config
-from kserve_vllm_mini_tpu.models.llama import forward, init_params
+from kserve_vllm_mini_tpu.models.llama import init_params
 from kserve_vllm_mini_tpu.runtime.engine import Engine, EngineConfig, GenRequest
 
 pytestmark = pytest.mark.slow
@@ -22,13 +22,9 @@ def params():
 
 
 def greedy_reference(params, prompt, n_new):
-    toks = list(prompt)
-    for _ in range(n_new):
-        arr = jnp.asarray(toks, dtype=jnp.int32)[None]
-        pos = jnp.arange(len(toks), dtype=jnp.int32)[None]
-        logits, _ = forward(params, CFG, arr, pos)
-        toks.append(int(jnp.argmax(logits[0, -1])))
-    return toks[len(prompt):]
+    from tests.oracle import greedy_reference as _oracle
+
+    return _oracle(params, CFG, prompt, n_new)
 
 
 def _drain(handle):
