@@ -27,6 +27,7 @@ _SUBCOMMANDS: dict[str, tuple[str, str]] = {
     "loadtest": ("kserve_vllm_mini_tpu.loadgen.runner", "Generate load against an endpoint"),
     "analyze": ("kserve_vllm_mini_tpu.analysis.analyzer", "requests.csv -> results.json metrics"),
     "cost": ("kserve_vllm_mini_tpu.costs.estimator", "Attribute cost from resource-seconds x pricing"),
+    "cost-simple": ("kserve_vllm_mini_tpu.costs.simple", "Back-of-envelope $/1K tokens from latency x chip price"),
     "energy": ("kserve_vllm_mini_tpu.energy.collector", "Collect/integrate chip power into Wh metrics"),
     "report": ("kserve_vllm_mini_tpu.report.html", "Render HTML report from results.json / sweep CSVs"),
     "plan": ("kserve_vllm_mini_tpu.costs.planner", "Capacity planning: chips for target RPS at SLO"),

@@ -1,0 +1,106 @@
+"""Back-of-envelope cost calculator — the reference's simple path
+(/root/reference/cost_calculator.py:11-76), TPU-translated.
+
+The reference averages the latency of HTTP-200 lines in a raw results
+file and multiplies by (GPU $/s x requests-per-1K-tokens). Here the input
+is a run dir's requests.csv (successful rows' latency), the chip price
+comes from tpu-cost.yaml by TPU generation (or an explicit --chip-hourly),
+and requests-per-1K defaults to MEASURED tokens_out instead of an assumed
+constant — with the assumption clearly printed either way.
+
+This is the quick sanity number. The real accounting (`kvmini-tpu cost`,
+costs/estimator.py) attributes resource-seconds over the run window; the
+two should agree within the latency-vs-occupancy approximation, and the
+output says which one to trust.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+from typing import Any, Optional
+
+
+def simple_cost(
+    run_dir: str | Path,
+    chip_hourly_usd: float,
+    chips: int = 1,
+    requests_per_1k_tokens: Optional[float] = None,
+) -> dict[str, Any]:
+    """Pure computation over requests.csv; raises on missing/empty input."""
+    from kserve_vllm_mini_tpu.core.rundir import RunDir
+
+    path = Path(run_dir) / "requests.csv"
+    if not path.exists():
+        raise FileNotFoundError(f"{path} not found")
+    # the same tolerant reader every other consumer of requests.csv uses
+    # (estimator, analyzer, energy) — no second CSV dialect to drift
+    ok_rows = [r for r in RunDir(run_dir).read_requests() if r.ok]
+    if not ok_rows:
+        raise ValueError("no successful requests — cannot calculate cost")
+    lat_ms = [r.latency_ms for r in ok_rows]
+    toks_out = sum(r.tokens_out for r in ok_rows)
+    avg_s = sum(lat_ms) / len(lat_ms) / 1000.0
+    if requests_per_1k_tokens is None:
+        # measured: how many average requests it takes to emit 1K tokens
+        avg_tokens = toks_out / len(lat_ms)
+        if avg_tokens <= 0:
+            raise ValueError(
+                "requests report no tokens_out — pass "
+                "--requests-per-1k-tokens to assume a value"
+            )
+        rp1k = 1000.0 / avg_tokens
+        rp1k_provenance = f"measured ({avg_tokens:.1f} avg tokens_out/request)"
+    else:
+        rp1k = requests_per_1k_tokens
+        rp1k_provenance = "assumed (flag)"
+    per_second = chip_hourly_usd * chips / 3600.0
+    return {
+        "successful_requests": len(lat_ms),
+        "avg_latency_ms": avg_s * 1000.0,
+        "chip_hourly_usd": chip_hourly_usd,
+        "chips": chips,
+        "chip_price_per_second": per_second,
+        "requests_per_1k_tokens": rp1k,
+        "requests_per_1k_provenance": rp1k_provenance,
+        "cost_per_1k_tokens_usd": per_second * avg_s * rp1k,
+    }
+
+
+def register(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument("run_dir", help="Run directory containing requests.csv")
+    parser.add_argument("--chip-hourly", type=float, default=None,
+                        help="Chip $/hour (default: tpu-cost.yaml for --tpu)")
+    parser.add_argument("--tpu", default="v5e",
+                        help="TPU generation for the pricing sheet lookup")
+    parser.add_argument("--chips", type=int, default=1)
+    parser.add_argument("--requests-per-1k-tokens", type=float, default=None,
+                        help="Override the measured tokens_out-based value "
+                             "(the reference assumed a constant 10)")
+    parser.add_argument("--cost-file", default=None)
+
+
+def run(args: argparse.Namespace) -> int:
+    chip_hourly = args.chip_hourly
+    price_key = "flag --chip-hourly"
+    if chip_hourly is None:
+        from kserve_vllm_mini_tpu.costs.pricing import load_pricing
+
+        chip_hourly, price_key = load_pricing(args.cost_file).chip_price(args.tpu)
+    try:
+        r = simple_cost(args.run_dir, chip_hourly, chips=args.chips,
+                        requests_per_1k_tokens=args.requests_per_1k_tokens)
+    except (FileNotFoundError, ValueError) as e:
+        print(f"cost-simple: {e}", file=sys.stderr)
+        return 1
+    print("=== SIMPLE COST (latency x chip-price back-of-envelope) ===")
+    print(f"chip price: ${chip_hourly:.4f}/hr x{args.chips} ({price_key})")
+    print(f"successful requests: {r['successful_requests']}")
+    print(f"average latency: {r['avg_latency_ms']:.2f} ms")
+    print(f"requests per 1K tokens: {r['requests_per_1k_tokens']:.2f} "
+          f"[{r['requests_per_1k_provenance']}]")
+    print(f"cost per 1K tokens: ${r['cost_per_1k_tokens_usd']:.6f}")
+    print("note: latency-occupancy approximation; `kvmini-tpu cost` does the "
+          "resource-seconds accounting")
+    return 0

@@ -306,3 +306,51 @@ def test_plan_labels_baseline_provenance():
         pricing,
     )
     assert calib[0].baseline_provenance == "calibrated"
+
+
+# -- simple cost calculator (reference cost_calculator.py surface) -----------
+
+def test_simple_cost_measured_requests_per_1k(synthetic_run):
+    from kserve_vllm_mini_tpu.costs.simple import simple_cost
+
+    r = simple_cost(synthetic_run.path, chip_hourly_usd=1.2, chips=2)
+    assert r["successful_requests"] > 0
+    assert r["avg_latency_ms"] > 0
+    assert "measured" in r["requests_per_1k_provenance"]
+    # identity: cost = $/s x avg latency x requests-per-1K
+    expect = (1.2 * 2 / 3600.0) * (r["avg_latency_ms"] / 1000.0) * r[
+        "requests_per_1k_tokens"
+    ]
+    assert r["cost_per_1k_tokens_usd"] == pytest.approx(expect)
+
+
+def test_simple_cost_assumed_override(synthetic_run):
+    from kserve_vllm_mini_tpu.costs.simple import simple_cost
+
+    r = simple_cost(synthetic_run.path, chip_hourly_usd=3.6,
+                    requests_per_1k_tokens=10)
+    assert r["requests_per_1k_tokens"] == 10
+    assert "assumed" in r["requests_per_1k_provenance"]
+
+
+def test_simple_cost_no_successes(tmp_path):
+    from kserve_vllm_mini_tpu.costs.simple import simple_cost
+
+    p = tmp_path / "requests.csv"
+    p.write_text("request_id,latency_ms,tokens_out,ok\nreq-0,100,5,0\n")
+    with pytest.raises(ValueError, match="no successful"):
+        simple_cost(tmp_path, 1.0)
+
+
+def test_simple_cost_zero_tokens_requires_assumption(tmp_path):
+    from kserve_vllm_mini_tpu.costs.simple import simple_cost
+
+    rd = make_synthetic_run(tmp_path / "runs")
+    records = rd.read_requests()
+    for r in records:
+        r.tokens_out = 0
+    rd.write_requests(records)
+    with pytest.raises(ValueError, match="tokens_out"):
+        simple_cost(rd.path, 1.0)
+    r = simple_cost(rd.path, 1.0, requests_per_1k_tokens=10)
+    assert r["requests_per_1k_tokens"] == 10
