@@ -59,7 +59,12 @@ V5E_BF16_TFLOPS = 197.0
 
 _DEFAULT_MODEL = "llama-3.1-8b"
 _DEFAULT_QUANT = "int8"
-_DEFAULT_SLOTS = "64"
+# 80 slots measured 3,067 tok/s/chip vs 2,744 at 64 (r4 session) — the KV
+# (80 x 512-token bf16 = 5.4 GB) + int8 weights still fit the v5e's HBM.
+# If the child fails at 80 the orchestrator retries once at the proven 64
+# (_FALLBACK_SLOTS) so a marginal-HBM compile can't cost the headline.
+_DEFAULT_SLOTS = "80"
+_FALLBACK_SLOTS = "64"
 
 
 def _env_model() -> str:
@@ -109,9 +114,9 @@ def _run_bench() -> dict:
     model = _env_model()
     quant = _env_quant()
     kv_quant = os.environ.get("KVMINI_BENCH_KV", "") == "int8"
-    # 64 slots: the 9 GB int8 weight stream per decode step amortizes over
-    # 2x the tokens vs 32 (measured 1710 -> 2774 tok/s/chip on the v5e);
-    # 64 x 512-token bf16 KV (4.3 GB) + weights still fit 16 GB HBM
+    # more slots amortize the 9 GB int8 weight stream over more tokens per
+    # step (measured 1710 @ 32 -> 2744 @ 64 -> 3067 @ 80 tok/s/chip on the
+    # v5e) until the KV stream and HBM capacity push back
     slots = _env_slots()
     prompt_len = 128
     max_seq = 512
@@ -331,12 +336,25 @@ def _run_bench() -> dict:
 
         drafter = os.environ.get("KVMINI_BENCH_DRAFTER", "self")
         # spec runs at its own (smaller) batch: it needs TWO caches (target
-        # + drafter) resident at once, which at the 64-slot headline default
+        # + drafter) resident at once, which at the headline slot default
         # plus the int8 8B weights exceeds the v5e's 16 GB. The headline
         # caches are dropped first; speedup math is per-slot-normalized, so
         # the slot count only needs to match between the spec rounds and the
         # served-style comparison below.
-        s_slots = min(slots, 32)
+        #
+        # KVMINI_BENCH_SPEC_SLOTS: drafter=self at 8B needs headroom for a
+        # second LAYOUT of the whole weight tree (XLA wants different int8
+        # minor-to-major orders for the drafter's T=1 scan vs the target's
+        # T=k verify when they share params — measured +5.9 GB over HBM at
+        # 32 slots on the v5e), so a realistic big-target run uses a NAMED
+        # small drafter (e.g. llama-1b, the deployment shape) where the two
+        # param trees are distinct and no relayout copy exists.
+        s_slots = int(os.environ.get("KVMINI_BENCH_SPEC_SLOTS", str(min(slots, 32))))
+        if s_slots > slots:
+            # toks/pos only have `slots` rows; a larger spec batch would
+            # shape-mismatch deep in the model after the headline already ran
+            _log(f"KVMINI_BENCH_SPEC_SLOTS={s_slots} > slots={slots}; clamping")
+            s_slots = slots
         cache = cache1 = None  # free the headline caches (4.3 GB at 64 slots)
         toks_s, pos_s = toks[:s_slots], pos[:s_slots]
         _log(f"spec mode: drafter={drafter} k={spec_k} slots={s_slots}")
@@ -547,10 +565,11 @@ def _emit_failure(status: str, stage: str, detail: str) -> None:
             # Last hardware measurement, for context only — self-reported
             # (docs/PERFORMANCE.md), NOT a driver-verified value.
             "last_measured_reference": {
-                "value": 2753.0,
+                "value": 3066.7,
                 "unit": "tokens/s/chip",
-                "config": "llama-3.1-8b int8, 64 slots, v5e",
-                "provenance": "docs/PERFORMANCE.md (builder session 2026-07-30;"
+                "config": "llama-3.1-8b int8, 80 slots, v5e",
+                "provenance": "docs/PERFORMANCE.md (builder session 2026-07-31"
+                              " ran this same script end-to-end, status ok;"
                               " not from a BENCH_r0X.json)",
             },
         },
@@ -595,20 +614,41 @@ def _probe(timeout_s: float) -> tuple[bool, str, str]:
 
 def _orchestrate() -> int:
     probe_timeout = float(os.environ.get("KVMINI_BENCH_PROBE_TIMEOUT", "90"))
-    ok, probe_status, probe_detail = _probe(probe_timeout)
-    if ok:
-        # JAX can fall back to CPU with only a warning when the TPU plugin
-        # fails to init — a "successful" CPU probe in a TPU-expected env
-        # would run the 8B flagship on CPU and produce a misleading artifact.
-        parts = probe_detail.split()
-        backend = parts[1] if len(parts) >= 2 else "?"
-        plat_env = os.environ.get("JAX_PLATFORMS", "").split(",")[0].strip().lower()
-        if plat_env in ("", "axon", "tpu") and backend != "tpu":
-            ok, probe_status = False, "tpu_unavailable"
-            probe_detail = (
-                f"probe fell back to backend {backend!r} (expected tpu; "
-                f"JAX_PLATFORMS={plat_env or '<unset>'}): {probe_detail}"
-            )
+    # The relay's wedges are often transient (r4 session: wedged -> answered
+    # -> wedged again within the hour), so a failed probe is retried a few
+    # times before the run is declared unmeasurable — the driver invokes
+    # this exactly once per round, and a 5-minute wait is cheap next to a
+    # round with no number.
+    probe_tries = max(int(os.environ.get("KVMINI_BENCH_PROBE_RETRIES", "3")), 1)
+    probe_wait = float(os.environ.get("KVMINI_BENCH_PROBE_RETRY_WAIT", "75"))
+
+    def _probe_once():
+        ok, status, detail = _probe(probe_timeout)
+        if ok:
+            # JAX can fall back to CPU with only a warning when the TPU
+            # plugin fails to init — a "successful" CPU probe in a
+            # TPU-expected env would run the 8B flagship on CPU and produce
+            # a misleading artifact. This is a relay failure mode (it gets
+            # the same retries as a raising wedge), not a green light.
+            parts = detail.split()
+            backend = parts[1] if len(parts) >= 2 else "?"
+            plat = os.environ.get("JAX_PLATFORMS", "").split(",")[0].strip().lower()
+            if plat in ("", "axon", "tpu") and backend != "tpu":
+                ok, status = False, "tpu_unavailable"
+                detail = (
+                    f"probe fell back to backend {backend!r} (expected tpu; "
+                    f"JAX_PLATFORMS={plat or '<unset>'}): {detail}"
+                )
+        return ok, status, detail
+
+    ok, probe_status, probe_detail = _probe_once()
+    for _try in range(probe_tries - 1):
+        if ok:
+            break
+        _log(f"probe failed ({probe_status}); retrying in {probe_wait:.0f}s "
+             f"({_try + 2}/{probe_tries})")
+        time.sleep(probe_wait)
+        ok, probe_status, probe_detail = _probe_once()
     if not ok:
         _log(f"backend probe failed: {probe_detail}")
         _emit_failure(probe_status, "probe", probe_detail)
@@ -620,39 +660,40 @@ def _orchestrate() -> int:
     # child, not us.
     run_timeout = float(os.environ.get("KVMINI_BENCH_TIMEOUT", "900"))
     env = dict(os.environ, KVMINI_BENCH_CHILD="1")
-    with tempfile.NamedTemporaryFile("w+", suffix=".bench-stderr",
-                                     errors="replace") as errf:
-        try:
-            p = subprocess.run(
-                [sys.executable, os.path.abspath(__file__)],
-                env=env, stdout=subprocess.PIPE, stderr=errf, text=True,
-                errors="replace", timeout=run_timeout,
-            )
-            rc, out = p.returncode, p.stdout
-        except subprocess.TimeoutExpired as e:
-            # None (not an int) — a signal-killed child reports negative
-            # returncodes that must fall through to _classify, not here
-            rc, out = None, (e.stdout or "")
-            if isinstance(out, bytes):
-                out = out.decode(errors="replace")
-        errf.seek(0)
-        err_text = errf.read()
-    # Re-emit the child's stage log so interactive runs keep their trace.
-    sys.stderr.write(err_text)
-    sys.stderr.flush()
+    rc, out, err_text = _run_child(env, run_timeout)
+    result_line = _extract_result(out)
 
-    # The child's LAST parseable JSON line is the result (teardown noise or
-    # a post-print crash must not cost us the measurement).
-    result_line = None
-    for line in out.splitlines():
-        line = line.strip()
-        if line.startswith("{"):
-            try:
-                parsed = json.loads(line)
-                if isinstance(parsed, dict) and "metric" in parsed:
-                    result_line = line
-            except ValueError:
-                continue
+    # The 80-slot default is the measured best but runs nearer the HBM
+    # ceiling than 64; if it OOMs AND the operator did not pin the slot
+    # count, retry once at the proven 64 so a marginal-HBM compile cannot
+    # cost the round its headline number. Only OOM qualifies: a timeout or
+    # unavailable relay fails the same way at any slot count, and a second
+    # 900 s hang would double the damage for nothing.
+    first_status = "timeout" if rc is None else _classify(err_text)
+    if (
+        result_line is None
+        and first_status == "oom"
+        and "KVMINI_BENCH_SLOTS" not in os.environ
+    ):
+        _log(
+            f"child failed at default slots={_DEFAULT_SLOTS} "
+            f"({first_status}); retrying at slots={_FALLBACK_SLOTS}"
+        )
+        rc2, out2, err2 = _run_child(
+            dict(env, KVMINI_BENCH_SLOTS=_FALLBACK_SLOTS), run_timeout
+        )
+        line2 = _extract_result(out2)
+        if line2 is not None:
+            parsed = json.loads(line2)
+            parsed.setdefault("detail", {})["slots_fallback"] = (
+                f"default slots={_DEFAULT_SLOTS} failed ({first_status}: "
+                f"{err_text[-300:]}); this run measured at "
+                f"slots={_FALLBACK_SLOTS}"
+            )
+            print(json.dumps(parsed))
+            return 0
+        # report the ORIGINAL failure (the default config's) below
+
     if result_line is not None:
         print(result_line)
         return 0
@@ -666,6 +707,47 @@ def _orchestrate() -> int:
     _emit_failure(_classify(err_text), "run",
                   f"child rc={rc}; stderr tail: {err_text[-1500:]}")
     return 0
+
+
+def _run_child(env: dict, run_timeout: float) -> tuple:
+    """One benchmark child under a hard timeout. Returns (rc, stdout,
+    stderr_text); rc None means the timeout killed it (a signal-killed
+    child's negative rc must fall through to _classify instead)."""
+    with tempfile.NamedTemporaryFile("w+", suffix=".bench-stderr",
+                                     errors="replace") as errf:
+        try:
+            p = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env=env, stdout=subprocess.PIPE, stderr=errf, text=True,
+                errors="replace", timeout=run_timeout,
+            )
+            rc, out = p.returncode, p.stdout
+        except subprocess.TimeoutExpired as e:
+            rc, out = None, (e.stdout or "")
+            if isinstance(out, bytes):
+                out = out.decode(errors="replace")
+        errf.seek(0)
+        err_text = errf.read()
+    # Re-emit the child's stage log so interactive runs keep their trace.
+    sys.stderr.write(err_text)
+    sys.stderr.flush()
+    return rc, out, err_text
+
+
+def _extract_result(out: str):
+    """The child's LAST parseable JSON line (teardown noise or a post-print
+    crash must not cost us the measurement)."""
+    result_line = None
+    for line in out.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                parsed = json.loads(line)
+                if isinstance(parsed, dict) and "metric" in parsed:
+                    result_line = line
+            except ValueError:
+                continue
+    return result_line
 
 
 def main() -> int:

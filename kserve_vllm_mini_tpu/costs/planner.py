@@ -26,19 +26,19 @@ from kserve_vllm_mini_tpu.costs.pricing import Pricing, load_pricing
 # (accelerator, model-size bucket) -> steady-state decode tokens/sec/chip.
 # The v5e figures are measured by this repo's bench.py on real hardware
 # (docs/PERFORMANCE.md: llama-1b bf16 @ round 1; llama-3.1-8b int8,
-# 64 slots @ round 3). Other rows scale the measured v5e numbers by HBM
+# 80 slots @ round 4). Other rows scale the measured v5e numbers by HBM
 # bandwidth ratio (v5p 2765/819 ≈ 3.4x, v6e 1640/819 ≈ 2x — decode is
 # weight-streaming-bound) discounted ~20% for the unknowns, and the 70B
 # rows additionally by parameter ratio across a tp-sharded slice; all
 # should be recalibrated from sweep CSVs as they land.
 BASELINE_TOKENS_PER_SEC_PER_CHIP: dict[tuple[str, str], float] = {
     ("v5e", "1b"): 4645.0,    # measured (BENCH_r01)
-    ("v5e", "8b"): 2753.0,    # measured (docs/PERFORMANCE.md)
-    ("v5e", "70b"): 250.0,    # scaled: 8B figure x 8/70, tp-efficiency ~0.8
-    ("v5p", "1b"): 12500.0,
-    ("v5p", "8b"): 7400.0,
-    ("v5p", "70b"): 680.0,
-    ("v6e", "8b"): 4400.0,
+    ("v5e", "8b"): 3066.7,    # measured (docs/PERFORMANCE.md, 80 slots r4)
+    ("v5e", "70b"): 280.0,    # scaled: 8B figure x 8/70, tp-efficiency ~0.8
+    ("v5p", "1b"): 12540.0,   # scaled: v5e 1b x (2765/819) x ~0.8
+    ("v5p", "8b"): 8280.0,    # scaled: v5e 8b x (2765/819) x ~0.8
+    ("v5p", "70b"): 760.0,    # scaled: v5p 8b x 8/70 x tp-efficiency ~0.8
+    ("v6e", "8b"): 4900.0,    # scaled: v5e 8b x (1640/819) x ~0.8
 }
 
 # Per-row provenance, surfaced in every plan report (round-3 verdict weak

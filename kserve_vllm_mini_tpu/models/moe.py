@@ -37,7 +37,7 @@ import jax
 import jax.numpy as jnp
 
 from kserve_vllm_mini_tpu.models.config import ModelConfig
-from kserve_vllm_mini_tpu.ops.quant import is_quantized
+from kserve_vllm_mini_tpu.ops.quant import is_quantized, unpacked_q
 
 
 def expert_capacity(cfg: ModelConfig, n_tokens: int) -> int:
@@ -51,7 +51,7 @@ def _expert_linear(x: jnp.ndarray, w: Any) -> jnp.ndarray:
     a plain array or an int8 dict (scale applied as a fused epilogue, same
     contract as ops.quant.linear)."""
     if is_quantized(w):
-        y = jnp.einsum("ecd,edf->ecf", x, w["q"].astype(x.dtype))
+        y = jnp.einsum("ecd,edf->ecf", x, unpacked_q(w).astype(x.dtype))
         return y * w["s"].astype(x.dtype)[:, None, :]
     return jnp.einsum("ecd,edf->ecf", x, w)
 

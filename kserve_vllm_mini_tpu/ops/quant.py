@@ -33,23 +33,76 @@ def quantize_weight(w: jnp.ndarray, bits: int = 8) -> dict[str, jnp.ndarray]:
     axis. Works on [in, out] and layer-stacked [L, in, out] alike: the scale
     is computed over axis -2 and has shape [..., out].
 
-    ``bits=4`` stores ``jnp.int4`` leaves — XLA packs them two-per-byte in
-    TPU HBM, quartering the dominant decode weight stream vs bf16 (the
-    W4A16 recipe; the quality cost is what the quantization sweep's
-    fidelity axis measures).
+    ``bits=4`` stores the nibbles PACKED two-per-``uint8`` along the output
+    axis (``q`` shape [..., in, out//2]) rather than as native ``jnp.int4``
+    leaves: an S4 array at a jit dispatch boundary triggers a relayout
+    ``device_put`` that recurses into jit (measured on the v5e relay —
+    RecursionError at dispatch), while a uint8 leaf crosses cleanly and is
+    bitcast back to int4 *inside* the compiled program (``_unpack_int4``),
+    where XLA's native two-nibbles-per-byte S4 representation takes over.
+    HBM still streams half the int8 bytes — the W4A16 recipe; the quality
+    cost is what the quantization sweep's fidelity axis measures.
     """
     if bits not in (8, 4):
         raise ValueError(f"bits must be 8 or 4, got {bits}")
     qmax = 127.0 if bits == 8 else 7.0
-    qdt = jnp.int8 if bits == 8 else jnp.int4
     amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=-2, keepdims=True)
     scale = jnp.where(amax > 0, amax / qmax, 1.0)
-    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -qmax, qmax).astype(qdt)
-    return {"q": q, "s": scale.squeeze(-2).astype(jnp.float32)}
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -qmax, qmax)
+    if bits == 4:
+        if w.shape[-1] % 2:
+            raise ValueError(f"int4 packing needs an even output dim, got {w.shape}")
+        n = q.astype(jnp.int8)
+        # element 2i -> low nibble of byte i, 2i+1 -> high nibble: the order
+        # jax.lax.bitcast_convert_type(uint8 -> int4) unpacks (pinned by
+        # tests/test_quant.py test_int4_unpack_traced_matches_eager, which
+        # compares the jitted bitcast branch against the host branch)
+        lo = n[..., 0::2] & 0x0F
+        hi = n[..., 1::2] & 0x0F
+        packed = (lo | (hi << 4)).astype(jnp.uint8)
+        return {"q": packed, "s": scale.squeeze(-2).astype(jnp.float32)}
+    return {"q": q.astype(jnp.int8), "s": scale.squeeze(-2).astype(jnp.float32)}
+
+
+def is_packed_int4(qw: dict[str, jnp.ndarray]) -> bool:
+    """Packed-int4 leaves are discriminated by dtype: uint8 holds nibble
+    pairs, int8 holds plain int8 channels."""
+    return qw["q"].dtype == jnp.uint8
+
+
+def _unpack_int4(packed: jnp.ndarray) -> jnp.ndarray:
+    """uint8 [..., out//2] nibble pairs -> [..., out] integer tensor.
+
+    Under a trace the unpack is a bitcast to ``jnp.int4`` — a bit-pattern
+    view matching XLA's native minor-axis S4 packing, so the compiled
+    program streams the packed bytes from HBM. Eagerly (tests, loaders) the
+    S4 intermediate itself would hit the dispatch-relayout recursion, so the
+    nibbles are sign-extended on the host into int8 instead — same values,
+    different dtype, and dequantize casts either to f32 anyway."""
+    import jax
+
+    if isinstance(packed, jax.core.Tracer):
+        nib = jax.lax.bitcast_convert_type(packed, jnp.int4)  # [..., out//2, 2]
+        return nib.reshape(*packed.shape[:-1], packed.shape[-1] * 2)
+    import numpy as np
+
+    a = np.asarray(packed)
+    lo = (a & 0x0F).astype(np.int8)
+    hi = (a >> 4).astype(np.int8)
+    lo[lo > 7] -= 16
+    hi[hi > 7] -= 16
+    out = np.stack([lo, hi], axis=-1).reshape(*a.shape[:-1], a.shape[-1] * 2)
+    return jnp.asarray(out)
+
+
+def unpacked_q(qw: dict[str, jnp.ndarray]) -> jnp.ndarray:
+    """The quantized weight as its logical [..., in, out] integer tensor."""
+    return _unpack_int4(qw["q"]) if is_packed_int4(qw) else qw["q"]
 
 
 def dequantize_weight(qw: dict[str, jnp.ndarray], dtype=jnp.bfloat16) -> jnp.ndarray:
-    return (qw["q"].astype(jnp.float32) * qw["s"][..., None, :].astype(jnp.float32)).astype(dtype)
+    q = unpacked_q(qw)
+    return (q.astype(jnp.float32) * qw["s"][..., None, :].astype(jnp.float32)).astype(dtype)
 
 
 def linear(x: jnp.ndarray, w: Any) -> jnp.ndarray:
@@ -61,7 +114,7 @@ def linear(x: jnp.ndarray, w: Any) -> jnp.ndarray:
     materialized dequantized weight.
     """
     if is_quantized(w):
-        y = x @ w["q"].astype(x.dtype)
+        y = x @ unpacked_q(w).astype(x.dtype)
         return y * w["s"].astype(x.dtype)
     return x @ w
 

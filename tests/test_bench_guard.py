@@ -30,6 +30,14 @@ def bench():
     return _load_bench()
 
 
+@pytest.fixture(autouse=True)
+def _fast_probe_retries(monkeypatch):
+    """The orchestrator's probe-retry loop sleeps 75 s between real-relay
+    attempts; tests exercise the logic, not the wait."""
+    monkeypatch.setenv("KVMINI_BENCH_PROBE_RETRIES", "2")
+    monkeypatch.setenv("KVMINI_BENCH_PROBE_RETRY_WAIT", "0")
+
+
 def test_classify_oom(bench):
     assert bench._classify("xx RESOURCE_EXHAUSTED: out of memory") == "oom"
 
@@ -178,6 +186,68 @@ def test_main_structures_child_timeout(bench, monkeypatch, capsys):
     assert rc == 0
     assert rec["status"] == "timeout"
     assert "mid-run relay wedge" in rec["detail"]["error_tail"]
+
+
+def test_slots_fallback_retries_at_64(bench, monkeypatch, capsys):
+    """Default-slot (80) child failure must trigger ONE retry at the proven
+    64 and emit the retry's record, annotated with the fallback."""
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.delenv("KVMINI_BENCH_SLOTS", raising=False)
+    good = {"metric": "decode_tokens_per_sec_per_chip (x)", "value": 2700.0,
+            "unit": "tokens/s/chip", "vs_baseline": 1.35, "status": "ok",
+            "detail": {}}
+    calls = []
+
+    def fake_run(cmd, env=None, stdout=None, stderr=None, text=None,
+                 errors=None, timeout=None):
+        calls.append(env.get("KVMINI_BENCH_SLOTS"))
+
+        class P:
+            returncode = 0
+            stdout = ""
+        if len(calls) == 1:  # 80-slot attempt OOMs
+            P.returncode = 1
+            if stderr is not None:
+                stderr.write("RESOURCE_EXHAUSTED: Ran out of memory in hbm")
+        else:
+            P.stdout = json.dumps(good) + "\n"
+        return P()
+
+    monkeypatch.setattr(bench, "_probe", lambda t: (True, "ok", "backend cpu 4.0"))
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    rc = bench.main()
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0
+    assert calls == [None, "64"]
+    assert rec["value"] == 2700.0
+    assert "oom" in rec["detail"]["slots_fallback"]
+
+
+def test_slots_fallback_skipped_when_pinned(bench, monkeypatch, capsys):
+    """An operator-pinned slot count must fail as-is — no silent retry at a
+    different config than the one asked for."""
+    monkeypatch.setenv("KVMINI_BENCH_SLOTS", "96")
+    calls = []
+
+    def fake_run(cmd, env=None, stdout=None, stderr=None, text=None,
+                 errors=None, timeout=None):
+        calls.append(1)
+
+        class P:
+            returncode = 1
+            stdout = ""
+        if stderr is not None:
+            stderr.write("RESOURCE_EXHAUSTED: Ran out of memory in hbm")
+        return P()
+
+    monkeypatch.setattr(bench, "_probe", lambda t: (True, "ok", "backend tpu 4.0"))
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    rc = bench.main()
+    rec = json.loads(capsys.readouterr().out.strip())
+    assert rc == 0
+    assert len(calls) == 1
+    assert rec["status"] == "oom"
+    assert "slots=96" in rec["metric"]
 
 
 def test_main_orchestrator_crash_still_emits_json(bench, monkeypatch, capsys):

@@ -200,10 +200,35 @@ def test_int4_quantize_roundtrip_error_bounded():
 
     w = jax.random.normal(jax.random.PRNGKey(0), (64, 32), jnp.float32) * 0.1
     qw = quantize_weight(w, bits=4)
-    assert qw["q"].dtype == jnp.int4
+    # packed representation: nibble pairs in uint8, output axis halved
+    # (native S4 leaves recurse at the dispatch relayout — see quantize_weight)
+    assert qw["q"].dtype == jnp.uint8
+    assert qw["q"].shape == (64, 16)
     err = np.abs(np.asarray(dequantize_weight(qw, jnp.float32)) - np.asarray(w))
     step = np.asarray(qw["s"])[None, :]
     assert (err <= step * 0.75 + 1e-6).all()
+
+
+def test_int4_unpack_traced_matches_eager():
+    """The traced bitcast branch and the eager host branch of _unpack_int4
+    must agree element-for-element — this pins the nibble order the packer
+    assumes (low nibble = even element) against the backend's
+    bitcast_convert_type semantics."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kserve_vllm_mini_tpu.ops.quant import _unpack_int4
+
+    packed = jnp.asarray(
+        np.random.default_rng(0).integers(0, 256, size=(5, 8), dtype=np.uint8)
+    )
+    eager = np.asarray(_unpack_int4(packed), np.int32)
+    traced = np.asarray(
+        jax.jit(lambda p: _unpack_int4(p).astype(jnp.int8))(packed), np.int32
+    )
+    assert eager.shape == traced.shape == (5, 16)
+    np.testing.assert_array_equal(eager, traced)
 
 
 def test_int4_init_equals_quantize_after_init():
@@ -224,10 +249,13 @@ def test_int4_init_equals_quantize_after_init():
     cfg = get_config("llama-tiny")
     direct = init_params_quantized(jax.random.PRNGKey(0), cfg, bits=4)
     after = quantize_params(init_params(jax.random.PRNGKey(0), cfg), bits=4)
+    from kserve_vllm_mini_tpu.ops.quant import _unpack_int4
+
     for a, b in zip(jax.tree.leaves(direct), jax.tree.leaves(after)):
-        if a.dtype == jnp.int4:
-            d = np.abs(np.asarray(a, np.int32) - np.asarray(b, np.int32))
-            assert d.max() <= 1  # +-1 LSB from the cast boundary
+        if a.dtype == jnp.uint8:  # packed int4 nibbles — compare unpacked
+            ua = np.asarray(_unpack_int4(a), np.int32)
+            ub = np.asarray(_unpack_int4(b), np.int32)
+            assert np.abs(ua - ub).max() <= 1  # +-1 LSB from the cast boundary
         else:
             np.testing.assert_allclose(
                 np.asarray(a, np.float32), np.asarray(b, np.float32),
