@@ -107,6 +107,31 @@ def validate_profile(
     elif quant not in TPU_QUANT_OK:
         rep.warnings.append(f"unrecognized quantization '{quant}'; proceeding unvalidated")
 
+    # paged-KV scope (runtime/engine.py kv_layout): fail the combos the
+    # engine would reject BEFORE anything deploys, stage-0 style
+    kv_layout = str(profile.get("kv_layout", "dense"))
+    if kv_layout not in ("dense", "paged"):
+        rep.errors.append(
+            f"unknown kv_layout '{kv_layout}'; known: dense, paged"
+        )
+    elif kv_layout == "paged":
+        if profile.get("drafter"):
+            rep.errors.append(
+                "kv_layout: paged does not support a speculative drafter "
+                "yet — drop 'drafter' or use kv_layout: dense"
+            )
+        if profile.get("prefix_cache"):
+            rep.errors.append(
+                "kv_layout: paged and prefix_cache are mutually exclusive "
+                "for now (block-level sharing is the planned merge)"
+            )
+        pool = profile.get("kv_pool_blocks")
+        if pool is not None and int(pool) < 1:
+            rep.errors.append(f"kv_pool_blocks ({pool}) must be >= 1")
+        blk = profile.get("kv_block_size")
+        if blk is not None and int(blk) < 1:
+            rep.errors.append(f"kv_block_size ({blk}) must be >= 1")
+
     # serving pipeline parallelism: layer-range stages via
     # parallel/serving_pp.py (pp-pure meshes). pp x tp is not composed —
     # reject that combination up front instead of letting

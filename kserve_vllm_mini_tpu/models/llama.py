@@ -206,6 +206,43 @@ def init_kv_cache(
     }
 
 
+def init_paged_kv_cache(
+    cfg: ModelConfig,
+    n_blocks: int,
+    block_size: int = 64,
+    dtype: Optional[Any] = None,
+    quantized: bool = False,
+) -> KVCache:
+    """Block-pool KV cache for paged attention (the TPU answer to vLLM's
+    PagedAttention, the reference stack's namesake mechanism).
+
+    Layout: ``k``/``v`` are [L, P, KVH, BLK, D] pools of P blocks of BLK
+    token positions each; a request owns an ordered list of block ids (its
+    block table) instead of a private [max_seq] stripe. Dense serving must
+    reserve slots x max_seq positions up front — 64 slots x 4096 max_seq
+    of 8B bf16 KV is 34 GB, unservable on a 16 GB v5e — while the pool is
+    sized by TOKENS IN FLIGHT (admission reserves worst-case
+    ceil((prompt+max_new)/BLK) blocks per request), so long max_model_len
+    stops multiplying across slots.
+
+    Same dict contract as ``init_kv_cache`` (`k`/`v` [+ `k_s`/`v_s` int8
+    scales]); the rank-5 value layout moves the slot axis to a block axis.
+    Consumed by ``forward(..., block_table=...)``.
+    """
+    shape = (cfg.n_layers, n_blocks, cfg.n_kv_heads, block_size, cfg.head_dim)
+    if quantized:
+        return {
+            "k": jnp.zeros(shape, dtype=jnp.int8),
+            "v": jnp.zeros(shape, dtype=jnp.int8),
+            "k_s": jnp.zeros(shape[:-1], dtype=jnp.float32),
+            "v_s": jnp.zeros(shape[:-1], dtype=jnp.float32),
+        }
+    return {
+        "k": jnp.zeros(shape, dtype=dtype or cfg.jnp_dtype),
+        "v": jnp.zeros(shape, dtype=dtype or cfg.jnp_dtype),
+    }
+
+
 def slice_cache_slots(cache: KVCache, slot, n: int = 1) -> KVCache:
     """Sub-cache for slots [slot, slot+n) — slot axis is dim 1 on every
     leaf (value tensors are rank-5, scale tensors rank-4)."""
@@ -433,6 +470,14 @@ def run_cached_layers(
                                  # (pipeline stages pass their range start;
                                  # alt_sliding_window's local/global phase
                                  # follows GLOBAL layer parity)
+    block_table: Optional[jnp.ndarray] = None,  # [B, MAXB] int32 block ids:
+                                 # paged-KV mode — ``kv_cache`` holds
+                                 # [L, P, KVH, BLK, D] pools
+                                 # (init_paged_kv_cache) and row b's K/V
+                                 # live in blocks table[b, 0..], in order,
+                                 # so the flattened MAXB*BLK axis is still
+                                 # absolute-position order and every
+                                 # positional mask below applies unchanged
 ) -> tuple[jnp.ndarray, KVCache]:
     """The cached transformer stack: scan over stacked layers, writing this
     block's K/V at ``cache_offsets`` and attending with positional masking
@@ -453,7 +498,17 @@ def run_cached_layers(
     dt = cfg.jnp_dtype
     n_local = kv_cache["k"].shape[0]
     quantized_kv = "k_s" in kv_cache  # static: selects the int8 path
-    s = kv_cache["k"].shape[3]
+    paged = block_table is not None
+    if paged and (write_gate is not None or slot_base is not None):
+        raise ValueError(
+            "paged KV is not supported under the serving pipeline executor "
+            "(write_gate/slot_base); use the dense cache with pp"
+        )
+    if paged:
+        blk = kv_cache["k"].shape[3]          # positions per block
+        s = block_table.shape[1] * blk        # flattened key axis (abs order)
+    else:
+        s = kv_cache["k"].shape[3]
     kj = jnp.arange(s)[None, None, :]
     qi = positions[:, :, None]
     causal = kj <= qi
@@ -470,9 +525,19 @@ def run_cached_layers(
         mask = causal[:, None, :, :]                         # [B, 1, T, S]
     attn_scale, attn_cap = attn_scale_softcap(cfg)
     base = slot_base if slot_base is not None else jnp.int32(0)
-    b_idx = base + jnp.arange(B)[:, None, None]              # [B, 1, 1]
     h_idx = jnp.arange(cfg.n_kv_heads)[None, :, None]        # [1, KVH, 1]
     t_idx = cache_offsets[:, None, None] + jnp.arange(T)[None, None, :]  # [B, 1, T]
+    if paged:
+        # position p of row b lives at pool block table[b, p // blk],
+        # offset p % blk — the scatter's slot axis becomes the block axis
+        blk_of_t = jnp.take_along_axis(
+            block_table, t_idx[:, 0, :] // blk, axis=1
+        )                                                    # [B, T]
+        b_idx = blk_of_t[:, None, :]                         # [B, 1, T]
+        w_idx = t_idx % blk                                  # [B, 1, T]
+    else:
+        b_idx = base + jnp.arange(B)[:, None, None]          # [B, 1, 1]
+        w_idx = t_idx
 
     def _gate(cache, name, lidx, new):
         """Value actually scattered: ``new``, or — when write_gate is False —
@@ -481,19 +546,31 @@ def run_cached_layers(
         if write_gate is None:
             return new
         # broadcasting yields [B,KVH,T,D] for values, [B,KVH,T] for scales
-        old = cache[name][lidx, b_idx, h_idx, t_idx]
+        old = cache[name][lidx, b_idx, h_idx, w_idx]
         return jnp.where(write_gate, new, old.astype(new.dtype))
 
     def _read_layer(cache, name, lidx):
         vals = jax.lax.dynamic_index_in_dim(cache[name], lidx, axis=0, keepdims=False)
-        if slot_base is not None:
+        if paged:
+            # [P, KVH, BLK, D] -> gather this batch's blocks in table order
+            # -> [B, KVH, MAXB*BLK, D]; the flattened axis is absolute
+            # position order, so downstream masking is identical to dense
+            vals = vals[block_table]                  # [B, MAXB, KVH, BLK, D]
+            vals = vals.transpose(0, 2, 1, 3, 4).reshape(
+                B, cfg.n_kv_heads, s, cfg.head_dim
+            )
+        elif slot_base is not None:
             # attention only needs this slot group's rows
             vals = jax.lax.dynamic_slice_in_dim(vals, base, B, axis=0)
         if quantized_kv:
             sc = jax.lax.dynamic_index_in_dim(
                 cache[name + "_s"], lidx, axis=0, keepdims=False
             )
-            if slot_base is not None:
+            if paged:
+                sc = sc[block_table].transpose(0, 2, 1, 3).reshape(
+                    B, cfg.n_kv_heads, s
+                )
+            elif slot_base is not None:
                 sc = jax.lax.dynamic_slice_in_dim(sc, base, B, axis=0)
             # dequantize on read: halves the HBM stream vs bf16 and the
             # multiply fuses into the attention matmul's prologue
@@ -509,20 +586,20 @@ def run_cached_layers(
         if quantized_kv:
             kq, ks = _quantize_kv_block(k)
             vq, vs = _quantize_kv_block(v)
-            idx_s = (lidx, b_idx, h_idx, t_idx)
-            cache["k"] = cache["k"].at[lidx, b_idx, h_idx, t_idx].set(
+            idx_s = (lidx, b_idx, h_idx, w_idx)
+            cache["k"] = cache["k"].at[lidx, b_idx, h_idx, w_idx].set(
                 _gate(cache, "k", lidx, kq)
             )
-            cache["v"] = cache["v"].at[lidx, b_idx, h_idx, t_idx].set(
+            cache["v"] = cache["v"].at[lidx, b_idx, h_idx, w_idx].set(
                 _gate(cache, "v", lidx, vq)
             )
             cache["k_s"] = cache["k_s"].at[idx_s].set(_gate(cache, "k_s", lidx, ks))
             cache["v_s"] = cache["v_s"].at[idx_s].set(_gate(cache, "v_s", lidx, vs))
         else:
-            cache["k"] = cache["k"].at[lidx, b_idx, h_idx, t_idx].set(
+            cache["k"] = cache["k"].at[lidx, b_idx, h_idx, w_idx].set(
                 _gate(cache, "k", lidx, k.astype(cache["k"].dtype))
             )
-            cache["v"] = cache["v"].at[lidx, b_idx, h_idx, t_idx].set(
+            cache["v"] = cache["v"].at[lidx, b_idx, h_idx, w_idx].set(
                 _gate(cache, "v", lidx, v.astype(cache["v"].dtype))
             )
         glidx = layer_offset + lidx  # global layer index (mask phase)
@@ -596,6 +673,9 @@ def forward(
                         # the prompt's last position — a full [B, T, V] f32
                         # logits tensor at 128k vocab is GBs of HBM (and T×
                         # the lm_head matmul) the sampler never reads
+    block_table: Optional[jnp.ndarray] = None,  # [B, MAXB] int32: paged-KV
+                        # mode — kv_cache is an init_paged_kv_cache pool and
+                        # row b's positions live in blocks table[b, :]
 ) -> tuple[jnp.ndarray, Optional[KVCache]]:
     """Returns (logits [B, T, V] float32, updated cache).
 
@@ -626,7 +706,7 @@ def forward(
     if use_cache:
         x, new_cache_dict = run_cached_layers(
             layers, cfg, x, positions, cos, sin, kv_cache, cache_offsets,
-            fresh_prefill=fresh_prefill,
+            fresh_prefill=fresh_prefill, block_table=block_table,
         )
     else:
         def scan_body_nocache(carry, xs):

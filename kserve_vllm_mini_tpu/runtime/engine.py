@@ -143,6 +143,25 @@ class EngineConfig:
     # (the drafter cache retains proposal garbage a new request's drafter
     # would attend).
     prefix_cache: bool = False
+    # Paged KV ("paged") vs per-slot dense stripes ("dense"). Paged is the
+    # TPU re-think of vLLM's PagedAttention (the reference stack's namesake
+    # mechanism, reference README.md:26): the cache is a pool of
+    # kv_block_size-position blocks (models/llama.init_paged_kv_cache) and
+    # each request owns an ordered block list, so HBM is reserved per
+    # TOKENS IN FLIGHT — admission takes ceil((prompt+max_new)/BLK) blocks
+    # — instead of max_slots x max_seq_len up front. 64 slots x 4096
+    # max_seq of 8B bf16 KV is 34 GB (unservable on one v5e); the same
+    # load at 256-token requests pages in ~1 GB. Requests that don't fit
+    # the free pool wait in the queue (admission backpressure, no
+    # mid-flight preemption — reservations are worst-case).
+    # v1 limits: incompatible with meshes (sharded pools), drafters
+    # (spec decode), and prefix_cache (block-level sharing is the planned
+    # merge of the two).
+    kv_layout: str = "dense"
+    kv_block_size: int = 64
+    # pool size in blocks; None sizes it to max_slots x ceil(max_seq/BLK)
+    # (memory-equal to dense — set it LOWER to realize the savings)
+    kv_pool_blocks: Optional[int] = None
 
 
 @dataclass
@@ -236,7 +255,7 @@ class Engine:
                     "pipeline parallelism (pp > 1); drop the drafter or pp"
                 )
 
-        from kserve_vllm_mini_tpu.models.llama import init_kv_cache
+        from kserve_vllm_mini_tpu.models.llama import init_kv_cache, init_paged_kv_cache
 
         S = self.ecfg.max_slots
         kv_quant = self.ecfg.kv_cache_dtype == "int8"
@@ -245,12 +264,65 @@ class Engine:
             if (self.ecfg.kv_cache_dtype and not kv_quant)
             else None
         )
+
+        self.paged = self.ecfg.kv_layout == "paged"
+        if self.ecfg.kv_layout not in ("dense", "paged"):
+            raise ValueError(
+                f"unknown kv_layout {self.ecfg.kv_layout!r}; known: dense, paged"
+            )
+        if self.paged:
+            if mesh is not None:
+                raise ValueError("paged KV does not support meshes yet; "
+                                 "use kv_layout=dense with tp/pp")
+            if drafter is not None:
+                raise ValueError("paged KV does not support speculative "
+                                 "decoding yet; drop the drafter or use dense")
+            if self.ecfg.prefix_cache:
+                raise ValueError("paged KV and prefix_cache are mutually "
+                                 "exclusive for now (block-level sharing is "
+                                 "the planned merge)")
+            blk = self.ecfg.kv_block_size
+            if blk < 1:
+                raise ValueError(f"kv_block_size={blk} must be >= 1")
+            self._blk = blk
+            self._maxb = -(-self.ecfg.max_seq_len // blk)
+            # explicit None check: 0 must be rejected below, not silently
+            # fall back to the memory-equal-to-dense default pool
+            n_user = (
+                self.ecfg.kv_pool_blocks
+                if self.ecfg.kv_pool_blocks is not None
+                else S * self._maxb
+            )
+            if n_user < 1:
+                raise ValueError(f"kv_pool_blocks={n_user} must be >= 1")
+            # a pool smaller than one max-length request is allowed: submit()
+            # error-rejects any request whose worst case exceeds the pool,
+            # so undersizing shrinks the admissible request size, not safety
+            # +1: the last block is SCRATCH — freed slots' table rows point
+            # at it so their harmless in-flight decode writes (the sweep
+            # dispatches all S slots, active or not) can never land in a
+            # block that was reassigned to another request
+            self._scratch_block = n_user
+            self._cache = init_paged_kv_cache(
+                cfg, n_user + 1, blk, dtype=kv_dt, quantized=kv_quant
+            )
+            self._free_blocks: list[int] = list(range(n_user))
+            self._slot_blocks: list[list[int]] = [[] for _ in range(S)]
+            self._block_table = np.full((S, self._maxb), self._scratch_block,
+                                        dtype=np.int32)
+            self._table_dev: Optional[jnp.ndarray] = None  # lazy device mirror
+            # head-of-line request that didn't fit the free pool; retried
+            # first so admission stays FIFO
+            self._deferred: Optional[RequestHandle] = None
+
         def make_cache():
             return init_kv_cache(
                 cfg, S, max_seq=self.ecfg.max_seq_len, dtype=kv_dt, quantized=kv_quant
             )
 
-        if mesh is not None:
+        if self.paged:
+            pass  # pool allocated above
+        elif mesh is not None:
             from kserve_vllm_mini_tpu.parallel.sharding import kv_cache_shardings
 
             # allocate DIRECTLY into the mesh layout: materializing the full
@@ -314,6 +386,45 @@ class Engine:
             "prefix_hits": 0,       # admissions that reused a retained prefix
             "prefix_tokens_reused": 0,  # prompt tokens NOT re-prefilled
         }
+
+    # -- paged-KV block accounting ----------------------------------------
+
+    def _blocks_needed(self, req: GenRequest) -> int:
+        """Worst-case pool blocks a request can touch: prompt + budgeted
+        new tokens, plus up to decode_chunk-1 surplus writes from the fused
+        sweep that logically finishes it, capped by the KV window."""
+        worst = min(
+            len(req.prompt_tokens) + req.max_new_tokens + self.ecfg.decode_chunk,
+            self.ecfg.max_seq_len,
+        )
+        return -(-worst // self._blk)
+
+    def _paged_admit_blocks(self, slot: int, req: GenRequest) -> None:
+        """Reserve the request's worst-case blocks (caller checked fit) and
+        point the slot's table row at them, scratch beyond."""
+        need = self._blocks_needed(req)
+        blks = [self._free_blocks.pop() for _ in range(need)]
+        self._slot_blocks[slot] = blks
+        row = np.full((self._maxb,), self._scratch_block, dtype=np.int32)
+        row[: len(blks)] = blks
+        self._block_table[slot] = row
+        self._table_dev = None
+
+    def _paged_release(self, slot: int) -> None:
+        """Return the slot's blocks and park its row on the scratch block,
+        so the sweep's all-slots dispatch can never write a stale position
+        into a block that was handed to another request."""
+        self._free_blocks.extend(self._slot_blocks[slot])
+        self._slot_blocks[slot] = []
+        self._block_table[slot] = self._scratch_block
+        self._table_dev = None
+
+    def _table(self) -> jnp.ndarray:
+        """Device mirror of the block table, rebuilt only when allocation
+        changed — never on the per-token hot path."""
+        if self._table_dev is None:
+            self._table_dev = jnp.asarray(self._block_table)
+        return self._table_dev
 
     # -- compiled steps ----------------------------------------------------
 
@@ -387,6 +498,51 @@ class Engine:
         self._prefill_fns[key] = chunk_prefill
         return chunk_prefill
 
+    def _get_paged_prefill_fn(self, bucket: int):
+        """Paged fresh prefill: no slot slicing — the pool is global and the
+        slot's table row [1, MAXB] routes the writes to its blocks."""
+        key = ("paged", bucket)
+        if key in self._prefill_fns:
+            return self._prefill_fns[key]
+        cfg = self.cfg
+        fwd = self._fwd
+
+        @partial(jax.jit, donate_argnums=(1,))
+        def prefill(params, cache, tokens, length, trow):
+            pos = jnp.arange(tokens.shape[1], dtype=jnp.int32)[None]
+            logits, nc = fwd(
+                params, cfg, tokens, pos,
+                cache, jnp.zeros((1,), jnp.int32),
+                fresh_prefill=True,
+                logit_index=(length - 1)[None],
+                block_table=trow,
+            )
+            return nc, logits[0, 0]
+
+        self._prefill_fns[key] = prefill
+        return prefill
+
+    def _get_paged_chunk_prefill_fn(self, bucket: int):
+        key = ("paged-chunk", bucket)
+        if key in self._prefill_fns:
+            return self._prefill_fns[key]
+        cfg = self.cfg
+        fwd = self._fwd
+
+        @partial(jax.jit, donate_argnums=(1,))
+        def chunk_prefill(params, cache, tokens, length, offset, trow):
+            pos = offset + jnp.arange(tokens.shape[1], dtype=jnp.int32)[None]
+            logits, nc = fwd(
+                params, cfg, tokens, pos,
+                cache, offset[None],
+                logit_index=(length - 1)[None],
+                block_table=trow,
+            )
+            return nc, logits[0, 0]
+
+        self._prefill_fns[key] = chunk_prefill
+        return chunk_prefill
+
     def _get_decode_fn(self, n_steps: int = 1):
         """Compiled decode of ``n_steps`` sampling steps in ONE dispatch.
 
@@ -399,19 +555,23 @@ class Engine:
         attends iff j <= query position) makes them unreachable — with
         prefix caching a later admission may SKIP re-prefilling those rows,
         so the mask, not overwrite-on-admission, is the safety invariant."""
-        fn = self._decode_fns.get(n_steps)
+        key = ("paged", n_steps) if self.paged else n_steps
+        fn = self._decode_fns.get(key)
         if fn is not None:
             return fn
         cfg = self.cfg
         fwd = self._fwd
+        paged = self.paged
 
         @partial(jax.jit, donate_argnums=(1,))
-        def decode(params, cache, tokens, lengths, temps, topks, topps, rng):
+        def decode(params, cache, tokens, lengths, temps, topks, topps, rng,
+                   table=None):
             def body(carry, _):
                 c, toks, lens, r = carry
                 r, sub = jax.random.split(r)
                 logits, nc = fwd(
-                    params, cfg, toks[:, None], lens[:, None], c, lens
+                    params, cfg, toks[:, None], lens[:, None], c, lens,
+                    **({"block_table": table} if paged else {}),
                 )
                 lg = logits[:, 0, :]
                 nxt = sample_tokens(lg, sub, temps, topks, topps)
@@ -423,7 +583,7 @@ class Engine:
             )
             return c, ys  # ys: ([n,S], [n,S], [n,S,K], [n,S,K])
 
-        self._decode_fns[n_steps] = decode
+        self._decode_fns[key] = decode
         return decode
 
     def _get_masked_decode_fn(self):
@@ -433,17 +593,21 @@ class Engine:
         constraint. One step per dispatch because the next mask depends on
         the token just emitted (the automaton is host-side; only the mask
         application rides the device)."""
-        fn = self._decode_fns.get("masked")
+        key = ("paged", "masked") if self.paged else "masked"
+        fn = self._decode_fns.get(key)
         if fn is not None:
             return fn
         cfg = self.cfg
         fwd = self._fwd
+        paged = self.paged
 
         @partial(jax.jit, donate_argnums=(1,))
         def decode_masked(params, cache, tokens, lengths,
-                          temps, topks, topps, rng, packed_mask, use_mask):
+                          temps, topks, topps, rng, packed_mask, use_mask,
+                          table=None):
             logits, nc = fwd(
-                params, cfg, tokens[:, None], lengths[:, None], cache, lengths
+                params, cfg, tokens[:, None], lengths[:, None], cache, lengths,
+                **({"block_table": table} if paged else {}),
             )
             lg = logits[:, 0, :]
             mask = _unpack_mask(packed_mask, cfg.vocab_size)
@@ -453,7 +617,7 @@ class Engine:
             lp, tids, tlps = token_logprobs(lg, nxt)
             return nc, (nxt[None], lp[None], tids[None], tlps[None])
 
-        self._decode_fns["masked"] = decode_masked
+        self._decode_fns[key] = decode_masked
         return decode_masked
 
     def _get_spec_fn(self):
@@ -500,6 +664,18 @@ class Engine:
                     ),
                 }))
                 return handle
+        if self.paged and self._blocks_needed(req) > self._scratch_block:
+            # can NEVER fit the pool (scratch_block == total user blocks) —
+            # failing now beats deadlocking the admission queue forever
+            handle.events.put(("done", {
+                "finish_reason": "error",
+                "error": (
+                    f"request needs {self._blocks_needed(req)} KV blocks "
+                    f"but the pool has {self._scratch_block}; raise "
+                    "kv_pool_blocks or lower max_tokens"
+                ),
+            }))
+            return handle
         self._pending.put(handle)
         self.stats["queue_depth"] = self._pending.qsize()
         return handle
@@ -630,7 +806,20 @@ class Engine:
             toks = piece + [self.pad_id] * (bucket - m)
             tokens = jnp.asarray(toks, dtype=jnp.int32)[None]
             cache_in = self._dcache if draft else self._cache
-            if off == 0:
+            if self.paged:
+                trow = jnp.asarray(self._block_table[slot : slot + 1])
+                if off == 0:
+                    fn = self._get_paged_prefill_fn(bucket)
+                    cache, last_logits = fn(
+                        params, cache_in, tokens, jnp.int32(m), trow
+                    )
+                else:
+                    fn = self._get_paged_chunk_prefill_fn(bucket)
+                    cache, last_logits = fn(
+                        params, cache_in, tokens,
+                        jnp.int32(m), jnp.int32(off), trow,
+                    )
+            elif off == 0:
                 fn = self._get_prefill_fn(bucket, draft=draft)
                 cache, last_logits = fn(
                     params, cache_in, tokens, jnp.int32(m), jnp.int32(slot)
@@ -651,6 +840,14 @@ class Engine:
     def _admit_one(self, handle: RequestHandle) -> None:
         req = handle.request
         slot, reused = self._pop_slot_for(req.prompt_tokens)
+        if self.paged:
+            # fit is the caller's job: _schedule_once defers a non-fitting
+            # head-of-line request before calling here, and the idle path
+            # only runs with zero active slots, where the whole pool is
+            # free and submit()'s never-fit rejection guarantees the fit.
+            # _paged_admit_blocks pops _free_blocks and would fail loudly
+            # on a (multihost-divergence) violation.
+            self._paged_admit_blocks(slot, req)
         n = len(req.prompt_tokens)
         t0 = time.time()
         last_logits = self._prefill_chunks(
@@ -753,6 +950,8 @@ class Engine:
             # retain exactly the tokens whose KV is WRITTEN: the last
             # emitted token was never fed, so trim to slot_len rows
             self._retained[slot] = self._slot_tokens[slot][: self._slot_len[slot]]
+        if self.paged:
+            self._paged_release(slot)
         self._free.append(slot)
         self._sampling_arrays = None  # slot population changed
 
@@ -907,16 +1106,18 @@ class Engine:
             use_mask = np.zeros((S,), dtype=bool)
             use_mask[constrained] = True
             decode = self._get_masked_decode_fn()
+            extra = (self._table(),) if self.paged else ()
             self._cache, ys = decode(
                 self.params, self._cache,
                 tokens, lengths, temps, topks, topps, sub,
-                jnp.asarray(mask), jnp.asarray(use_mask),
+                jnp.asarray(mask), jnp.asarray(use_mask), *extra,
             )
         else:
             decode = self._get_decode_fn(chunk)
+            extra = (self._table(),) if self.paged else ()
             self._cache, ys = decode(
                 self.params, self._cache,
-                tokens, lengths, temps, topks, topps, sub,
+                tokens, lengths, temps, topks, topps, sub, *extra,
             )
         # ONE host transfer for the whole chunk block — per-element
         # int(row[i]) costs a separate device readback each (chunk x slots
@@ -948,6 +1149,11 @@ class Engine:
             if h is not None:
                 h.events.put(("done", dict(info)))
                 self._slot_req[slot] = None
+        if self.paged and self._deferred is not None:
+            # the backpressure-held head-of-line request is in neither a
+            # slot nor _pending — it must fail too or its client hangs
+            self._deferred.events.put(("done", dict(info)))
+            self._deferred = None
         while True:
             try:
                 h = self._pending.get_nowait()
@@ -965,9 +1171,19 @@ class Engine:
         can replay the identical stream."""
         admitted = False
         while self._free:
-            try:
-                handle = self._pending.get_nowait()
-            except queue.Empty:
+            if self.paged and self._deferred is not None:
+                handle, self._deferred = self._deferred, None
+            else:
+                try:
+                    handle = self._pending.get_nowait()
+                except queue.Empty:
+                    break
+            if (
+                self.paged
+                and self._blocks_needed(handle.request) > len(self._free_blocks)
+            ):
+                # hold at the head of the line until decode frees blocks
+                self._deferred = handle
                 break
             if on_decision is not None:
                 on_decision(("admit", handle.request))
@@ -1006,6 +1222,10 @@ class Engine:
         s["duty_cycle"] = min(s["busy_s"] / wall, 1.0)
         s["active_slots"] = sum(1 for h in self._slot_req if h is not None)
         s["free_slots"] = len(self._free)
+        if self.paged:
+            s["kv_pool_blocks"] = self._scratch_block
+            s["kv_free_blocks"] = len(self._free_blocks)
+            s["kv_block_size"] = self._blk
         s["spec_accept_ratio"] = (
             s["spec_accepted"] / s["spec_proposed"] if s["spec_proposed"] else 0.0
         )

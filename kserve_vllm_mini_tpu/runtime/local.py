@@ -72,6 +72,13 @@ def start_local_server(
             profile.get("spec_tokens", 4 if profile.get("drafter") else 0)
         ),
         prefix_cache=bool(profile.get("prefix_cache", False)),
+        kv_layout=profile.get("kv_layout", "dense"),
+        kv_block_size=int(profile.get("kv_block_size", 64)),
+        kv_pool_blocks=(
+            int(profile["kv_pool_blocks"])
+            if profile.get("kv_pool_blocks") is not None
+            else None
+        ),
     )
     engine.start()
     app = make_app(engine, tok, name)

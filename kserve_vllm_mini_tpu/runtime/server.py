@@ -49,6 +49,9 @@ def build_engine(
     scan_unroll: int = 1,
     mesh=None,
     prefix_cache: bool = False,
+    kv_layout: str = "dense",
+    kv_block_size: int = 64,
+    kv_pool_blocks: Optional[int] = None,
 ) -> tuple[Engine, Tokenizer, str]:
     """Construct (engine, tokenizer, model_name) from a preset or checkpoint.
 
@@ -176,6 +179,9 @@ def build_engine(
         spec_tokens=spec_tokens if drafter_pair is not None else 0,
         pp_microbatches=pp_microbatches,
         prefix_cache=prefix_cache,
+        kv_layout=kv_layout,
+        kv_block_size=kv_block_size,
+        kv_pool_blocks=kv_pool_blocks,
     )
     engine = Engine(
         params, cfg, ecfg, mesh=mesh, pad_id=tok.pad_id, drafter=drafter_pair
@@ -707,6 +713,15 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
             "# TYPE kvmini_tpu_prefix_tokens_reused_total counter",
             f"kvmini_tpu_prefix_tokens_reused_total {s['prefix_tokens_reused']}",
         ]
+        if "kv_pool_blocks" in s:  # paged layout only
+            lines += [
+                "# TYPE kvmini_tpu_kv_pool_blocks gauge",
+                f"kvmini_tpu_kv_pool_blocks {s['kv_pool_blocks']}",
+                "# TYPE kvmini_tpu_kv_free_blocks gauge",
+                f"kvmini_tpu_kv_free_blocks {s['kv_free_blocks']}",
+                "# TYPE kvmini_tpu_kv_block_size gauge",
+                f"kvmini_tpu_kv_block_size {s['kv_block_size']}",
+            ]
         return web.Response(text="\n".join(lines) + "\n", content_type="text/plain")
 
     app = web.Application()
@@ -761,6 +776,19 @@ def register(parser: argparse.ArgumentParser) -> None:
                         help="Speculative propose/verify depth per round "
                              "(default: $KVMINI_SPEC_TOKENS or 4 when a "
                              "drafter is set)")
+    parser.add_argument("--kv-layout", default="dense",
+                        choices=["dense", "paged"],
+                        help="KV cache layout: dense per-slot stripes, or a "
+                             "paged block pool (PagedAttention-style) where "
+                             "admission reserves ceil((prompt+max_tokens)/"
+                             "block) blocks — long --max-seq-len stops "
+                             "multiplying across slots")
+    parser.add_argument("--kv-block-size", type=int, default=64,
+                        help="Positions per paged-KV block")
+    parser.add_argument("--kv-pool-blocks", type=int, default=None,
+                        help="Paged-KV pool size in blocks (default "
+                             "slots x ceil(max_seq/block), memory-equal to "
+                             "dense; set lower to cap KV HBM)")
     parser.add_argument("--prefix-cache", action="store_true",
                         help="Automatic prefix caching: finished requests "
                              "retain their KV and new prompts sharing a "
@@ -881,6 +909,9 @@ def run(args: argparse.Namespace) -> int:
             args.prefix_cache
             or os.environ.get("KVMINI_PREFIX_CACHE", "") in ("1", "true")
         ),
+        kv_layout=args.kv_layout,
+        kv_block_size=args.kv_block_size,
+        kv_pool_blocks=args.kv_pool_blocks,
     )
 
     if multihost:

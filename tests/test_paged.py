@@ -1,0 +1,225 @@
+"""Paged KV cache: block-pool attention (the TPU re-think of vLLM's
+PagedAttention, the reference stack's namesake mechanism — reference
+README.md:26 serves vLLM, whose engine pages its KV).
+
+Invariants under test:
+- model-level forward with a block table is BIT-identical to the dense
+  per-slot cache for the same token stream (greedy argmax parity), for
+  bf16 and int8-quantized KV, with deliberately scattered non-contiguous
+  block ids;
+- the engine serves identical tokens under kv_layout="paged";
+- a pool smaller than the offered load serializes admissions (backpressure)
+  without changing any output, and releases every block;
+- recycled blocks (freed by one request, reserved by a later one) never
+  leak stale KV into the new request's attention;
+- a request that can never fit the pool fails fast with a structured error
+  instead of deadlocking the queue;
+- v1 scope guards: paged + mesh / drafter / prefix_cache raise.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kserve_vllm_mini_tpu.models.config import get_config
+from kserve_vllm_mini_tpu.models.llama import (
+    forward,
+    init_kv_cache,
+    init_paged_kv_cache,
+    init_params,
+)
+from kserve_vllm_mini_tpu.runtime.engine import Engine, EngineConfig, GenRequest
+
+pytestmark = pytest.mark.slow
+
+CFG = get_config("llama-tiny", max_seq_len=64)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(jax.random.PRNGKey(0), CFG)
+
+
+# scattered, non-contiguous, per-row-unique block ids: positions i*BLK..
+# of row b live at pool block TABLE[b, i] — nothing about the layout may
+# assume contiguity
+TABLE = jnp.asarray(
+    [[3, 17, 5, 9, 11, 2, 16, 19], [7, 0, 14, 6, 12, 8, 13, 1]], jnp.int32
+)
+BLK = 8  # 8 blocks x 8 positions = the 64-position window
+
+
+def _greedy_steps(params, caches, tables, toks, n_steps):
+    """Run prefill + n greedy decode steps on (dense, paged) in lockstep,
+    asserting argmax parity at every step. caches/tables are parallel lists."""
+    B, T = toks.shape
+    pos = jnp.broadcast_to(jnp.arange(T), (B, T)).astype(jnp.int32)
+    zero = jnp.zeros((B,), jnp.int32)
+    logits = []
+    for i, c in enumerate(caches):
+        lg, caches[i] = forward(
+            params, CFG, toks, pos, c, zero, fresh_prefill=True,
+            block_table=tables[i],
+        ) if tables[i] is not None else forward(
+            params, CFG, toks, pos, c, zero, fresh_prefill=True
+        )
+        logits.append(lg)
+    nxt = [jnp.argmax(lg[:, -1, :], -1).astype(jnp.int32) for lg in logits]
+    assert (np.asarray(nxt[0]) == np.asarray(nxt[1])).all()
+    lens = jnp.full((B,), T, jnp.int32)
+    for step in range(n_steps):
+        outs = []
+        for i, c in enumerate(caches):
+            kw = {"block_table": tables[i]} if tables[i] is not None else {}
+            lg, caches[i] = forward(
+                params, CFG, nxt[i][:, None], lens[:, None], c, lens, **kw
+            )
+            outs.append(jnp.argmax(lg[:, 0, :], -1).astype(jnp.int32))
+        assert (np.asarray(outs[0]) == np.asarray(outs[1])).all(), f"step {step}"
+        nxt = outs
+        lens = lens + 1
+
+
+def test_forward_paged_matches_dense_bf16(params):
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, CFG.vocab_size)
+    dense = init_kv_cache(CFG, 2, max_seq=64)
+    pool = init_paged_kv_cache(CFG, n_blocks=20, block_size=BLK)
+    _greedy_steps(params, [dense, pool], [None, TABLE], toks, n_steps=6)
+
+
+def test_forward_paged_matches_dense_int8_kv(params):
+    toks = jax.random.randint(jax.random.PRNGKey(2), (2, 16), 0, CFG.vocab_size)
+    dense = init_kv_cache(CFG, 2, max_seq=64, quantized=True)
+    pool = init_paged_kv_cache(CFG, 20, BLK, quantized=True)
+    _greedy_steps(params, [dense, pool], [None, TABLE], toks, n_steps=4)
+
+
+# -- engine level ----------------------------------------------------------
+
+PROMPTS = [[1, 2, 3, 4, 5], [7, 8, 9], [11, 12, 13, 14, 15, 16, 17], [3, 1, 4]]
+
+
+def _run_engine(engine, prompts, max_new=8):
+    handles = [
+        engine.submit(
+            GenRequest(prompt_tokens=p, max_new_tokens=max_new, temperature=0.0)
+        )
+        for p in prompts
+    ]
+    engine.start()
+    outs = []
+    try:
+        for h in handles:
+            toks = []
+            while True:
+                ev = h.events.get(timeout=60)
+                if ev[0] == "token":
+                    toks.append(ev[1])
+                elif ev[0] == "done":
+                    assert ev[1].get("finish_reason") != "error", ev
+                    break
+            outs.append(toks)
+    finally:
+        engine.stop()
+    return outs
+
+
+@pytest.fixture(scope="module")
+def dense_outputs(params):
+    eng = Engine(params, CFG, EngineConfig(max_slots=4, max_seq_len=64))
+    return _run_engine(eng, PROMPTS)
+
+
+def test_engine_paged_matches_dense(params, dense_outputs):
+    eng = Engine(
+        params, CFG,
+        EngineConfig(max_slots=4, max_seq_len=64, kv_layout="paged",
+                     kv_block_size=16),
+    )
+    assert _run_engine(eng, PROMPTS) == dense_outputs
+
+
+def test_engine_tight_pool_backpressure_and_release(params, dense_outputs):
+    """2 blocks of 16 positions: at most ONE of these requests in flight.
+    Outputs must be unchanged, blocks recycled across admissions, and the
+    pool fully free at the end."""
+    eng = Engine(
+        params, CFG,
+        EngineConfig(max_slots=4, max_seq_len=64, kv_layout="paged",
+                     kv_block_size=16, kv_pool_blocks=2),
+    )
+    assert _run_engine(eng, PROMPTS) == dense_outputs
+    st = eng.snapshot_stats()
+    assert st["kv_free_blocks"] == st["kv_pool_blocks"] == 2
+    assert st["requests_completed"] == len(PROMPTS)
+
+
+def test_block_recycling_no_stale_kv(params):
+    """The same engine serving the same prompt twice through recycled
+    blocks must produce identical tokens both times (a stale-KV leak from
+    the interleaved other-request would diverge the second pass)."""
+    eng = Engine(
+        params, CFG,
+        EngineConfig(max_slots=2, max_seq_len=64, kv_layout="paged",
+                     kv_block_size=16, kv_pool_blocks=3),
+    )
+    a1, b1 = _run_engine(eng, [[5, 6, 7, 8], [20, 21, 22]])
+    a2, b2 = _run_engine(eng, [[5, 6, 7, 8], [20, 21, 22]])
+    assert a1 == a2 and b1 == b2
+
+
+def test_never_fit_request_fails_fast(params):
+    eng = Engine(
+        params, CFG,
+        EngineConfig(max_slots=2, max_seq_len=64, kv_layout="paged",
+                     kv_block_size=16, kv_pool_blocks=1),
+    )
+    h = eng.submit(
+        GenRequest(prompt_tokens=list(range(30)), max_new_tokens=20,
+                   temperature=0.0)
+    )
+    ev = h.events.get(timeout=5)
+    assert ev[0] == "done"
+    assert "KV blocks" in ev[1].get("error", "")
+
+
+def test_scope_guards(params):
+    with pytest.raises(ValueError, match="prefix_cache"):
+        Engine(params, CFG, EngineConfig(kv_layout="paged", prefix_cache=True))
+    with pytest.raises(ValueError, match="kv_layout"):
+        Engine(params, CFG, EngineConfig(kv_layout="banana"))
+    with pytest.raises(ValueError, match="kv_pool_blocks"):
+        Engine(params, CFG, EngineConfig(kv_layout="paged", kv_pool_blocks=0))
+    with pytest.raises(ValueError, match="kv_block_size"):
+        Engine(params, CFG, EngineConfig(kv_layout="paged", kv_block_size=0))
+
+
+def test_fail_all_reaches_deferred_request(params):
+    """A backpressure-held (deferred) request sits in neither a slot nor
+    the pending queue; a dying scheduler must fail it too, or its client
+    blocks forever."""
+    eng = Engine(
+        params, CFG,
+        EngineConfig(max_slots=2, max_seq_len=64, kv_layout="paged",
+                     kv_block_size=16, kv_pool_blocks=2),
+    )
+    # A consumes the whole pool; B fits the pool size but not the free pool
+    ha = eng.submit(GenRequest(prompt_tokens=list(range(20)), max_new_tokens=8,
+                               temperature=0.0))
+    hb = eng.submit(GenRequest(prompt_tokens=list(range(10)), max_new_tokens=8,
+                               temperature=0.0))
+    # drive the scheduler by hand (no loop thread): A admits, B defers
+    eng._schedule_once()
+    assert eng._deferred is not None
+    eng._fail_all(RuntimeError("boom"))
+    seen_err = 0
+    for h in (ha, hb):
+        while True:
+            ev = h.events.get(timeout=5)
+            if ev[0] == "done":
+                assert ev[1]["finish_reason"] == "error"
+                seen_err += 1
+                break
+    assert seen_err == 2
+    assert eng._deferred is None

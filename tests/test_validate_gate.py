@@ -110,6 +110,20 @@ def test_speculative_requires_draft():
     assert any("draft_model" in e for e in rep.errors)
 
 
+def test_paged_kv_scope_checks():
+    assert validate_profile({"kv_layout": "paged"}).ok
+    rep = validate_profile({"kv_layout": "banana"})
+    assert any("kv_layout" in e for e in rep.errors)
+    rep = validate_profile({"kv_layout": "paged", "drafter": "llama-1b"})
+    assert any("drafter" in e for e in rep.errors)
+    rep = validate_profile({"kv_layout": "paged", "prefix_cache": True})
+    assert any("prefix_cache" in e for e in rep.errors)
+    rep = validate_profile({"kv_layout": "paged", "kv_pool_blocks": 0})
+    assert any("kv_pool_blocks" in e for e in rep.errors)
+    rep = validate_profile({"kv_layout": "paged", "kv_block_size": 0})
+    assert any("kv_block_size" in e for e in rep.errors)
+
+
 # -- gate -------------------------------------------------------------------
 
 GOOD = {
