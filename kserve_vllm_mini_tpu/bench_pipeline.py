@@ -144,6 +144,7 @@ def _run_stages(
     cfg = LoadConfig(
         url=url,
         model=profile.get("model", "default"),
+        models=profile.get("models"),
         backend=profile.get("backend", "openai"),
         num_requests=int(profile.get("requests", 100)),
         concurrency=int(profile.get("concurrency", 10)),

@@ -54,6 +54,8 @@ REQUEST_CSV_COLUMNS = [
                       # budget — the run measured a different workload than
                       # requested, and the analyzer must say so
     "truncated_tokens",  # how many prompt tokens the engine dropped (severity)
+    "model",          # model/adapter the request was routed to (multi-LoRA
+                      # runs rotate adapters; "" = the run's single model)
 ]
 
 
@@ -80,6 +82,7 @@ class RequestRecord:
     server_ttft_ms: float = 0.0
     truncated: bool = False
     truncated_tokens: int = 0
+    model: str = ""
 
     def to_row(self) -> dict[str, Any]:
         d = dataclasses.asdict(self)

@@ -31,6 +31,10 @@ from kserve_vllm_mini_tpu.loadgen.tracing import TraceCollector, new_trace_id, t
 class LoadConfig:
     url: str
     model: str = "default"
+    # multi-LoRA runs: rotate the request's "model" over these names
+    # (round-robin by request index); empty/None = every request uses
+    # ``model``. Per-request routing lands in requests.csv's model column.
+    models: Optional[list[str]] = None
     backend: str = "openai"
     num_requests: int = 100
     concurrency: int = 10
@@ -92,6 +96,8 @@ async def _worker(
 
     async with sem:
         prompt = prompt_fn(idx)
+        model = cfg.models[idx % len(cfg.models)] if cfg.models else cfg.model
+        rec.model = model
         http_span = tracer.span(
             "http.request", trace_id, parent=root, backend=cfg.backend, stream=cfg.streaming
         )
@@ -100,7 +106,7 @@ async def _worker(
         rec.start_ts = time.time()
         try:
             result = await adapter.generate(
-                client, cfg.url, cfg.model, prompt, cfg.gen_params(), cfg.streaming, headers
+                client, cfg.url, model, prompt, cfg.gen_params(), cfg.streaming, headers
             )
         except Exception as e:
             # Adapters record their own errors; this guard ensures even an
@@ -165,6 +171,7 @@ async def run_load_async(cfg: LoadConfig, run_dir: RunDir) -> list[RequestRecord
         {
             "url": cfg.url,
             "model": cfg.model,
+            "models": cfg.models,
             "backend": cfg.backend,
             "pattern": cfg.pattern,
             "requests": cfg.num_requests,
@@ -193,6 +200,9 @@ def run_load(cfg: LoadConfig, run_dir: RunDir) -> list[RequestRecord]:
 def register(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--url", required=True, help="Base URL of the serving endpoint")
     parser.add_argument("--model", default="default")
+    parser.add_argument("--models", default=None,
+                        help="Comma-separated model/adapter names rotated "
+                             "round-robin across requests (multi-LoRA runs)")
     parser.add_argument("--backend", default="openai", help="Protocol adapter name")
     parser.add_argument("--requests", type=int, default=100)
     parser.add_argument("--concurrency", type=int, default=10)
@@ -217,6 +227,10 @@ def run(args: argparse.Namespace) -> int:
     cfg = LoadConfig(
         url=args.url,
         model=args.model,
+        models=(
+            [m.strip() for m in args.models.split(",") if m.strip()]
+            if args.models else None
+        ),
         backend=args.backend,
         num_requests=args.requests,
         concurrency=args.concurrency,

@@ -283,12 +283,19 @@ def qkv_proj(
     positions: jnp.ndarray,      # [B, T]
     cos: jnp.ndarray,
     sin: jnp.ndarray,
+    lora: Optional[Params] = None,       # one layer's adapter bank slices
+    lora_ids: Optional[jnp.ndarray] = None,  # [B] adapter index per row
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """QKV projections + RoPE -> (q [B,H,T,hd], k [B,KVH,T,hd], v). The one
     implementation every execution path (scan-rolled, cached, pipelined)
-    shares."""
+    shares. With ``lora``/``lora_ids`` each row adds its adapter's low-rank
+    delta (ops/lora.py; index 0 = base)."""
+    from kserve_vllm_mini_tpu.ops.lora import adapted_linear
+
     B, T, _ = h.shape
-    q, k, v = linear(h, p["wq"]), linear(h, p["wk"]), linear(h, p["wv"])
+    q = adapted_linear(h, p["wq"], lora, "wq", lora_ids)
+    k = adapted_linear(h, p["wk"], lora, "wk", lora_ids)
+    v = adapted_linear(h, p["wv"], lora, "wv", lora_ids)
     if cfg.attn_bias:
         q, k, v = q + p["bq"], k + p["bk"], v + p["bv"]
     q = q.reshape(B, T, cfg.n_heads, cfg.head_dim).transpose(0, 2, 1, 3)
@@ -365,6 +372,8 @@ def attn_out_and_mlp(
     x: jnp.ndarray,
     o: jnp.ndarray,
     h: Optional[jnp.ndarray] = None,
+    lora: Optional[Params] = None,
+    lora_ids: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """Layer tail shared by every execution path.
 
@@ -373,34 +382,42 @@ def attn_out_and_mlp(
     phi block: ``h`` is the single LayerNorm output that already fed
     attention; the GELU MLP reads the same ``h``, and both branch outputs
     add to the residual in parallel.
+    With ``lora``/``lora_ids``, every projection the bank covers adds its
+    per-row adapter delta (ops/lora.py).
     """
+    from kserve_vllm_mini_tpu.ops.lora import adapted_linear as _al
+
     B, T, _ = x.shape
     dt = cfg.jnp_dtype
     o = o.transpose(0, 2, 1, 3).reshape(B, T, cfg.n_heads * cfg.head_dim)
     if cfg.block == "phi":
-        attn_out = linear(o, p["wo"]) + p["bo"]
-        up = linear(h, p["w_up"]) + p["b_up"]
+        attn_out = _al(o, p["wo"], lora, "wo", lora_ids) + p["bo"]
+        up = _al(h, p["w_up"], lora, "w_up", lora_ids) + p["b_up"]
         act = jax.nn.gelu(up.astype(jnp.float32), approximate=True).astype(dt)
-        mlp_out = linear(act, p["w_down"]) + p["b_down"]
+        mlp_out = _al(act, p["w_down"], lora, "w_down", lora_ids) + p["b_down"]
         return x + attn_out + mlp_out
     if cfg.block == "gemma2":
         # sandwich norms: each branch output is normed BEFORE its residual
-        attn_out = linear(o, p["wo"])
+        attn_out = _al(o, p["wo"], lora, "wo", lora_ids)
         x = x + block_norm(p, cfg, attn_out, "post_attn_norm")
         h2 = block_norm(p, cfg, x, "mlp_norm")
         gate = jax.nn.gelu(
-            linear(h2, p["w_gate"]).astype(jnp.float32), approximate=True
+            _al(h2, p["w_gate"], lora, "w_gate", lora_ids).astype(jnp.float32),
+            approximate=True,
         ).astype(dt)
-        mlp_out = linear(gate * linear(h2, p["w_up"]), p["w_down"])
+        mlp_out = _al(gate * _al(h2, p["w_up"], lora, "w_up", lora_ids),
+                      p["w_down"], lora, "w_down", lora_ids)
         return x + block_norm(p, cfg, mlp_out, "post_mlp_norm")
-    x = x + linear(o, p["wo"])
+    x = x + _al(o, p["wo"], lora, "wo", lora_ids)
     h = rms_norm(x, p["mlp_norm"], cfg.rms_eps)
     if cfg.is_moe:
         from kserve_vllm_mini_tpu.models.moe import moe_mlp
 
         return x + moe_mlp(p, cfg, h)
-    gated = jax.nn.silu(linear(h, p["w_gate"]).astype(jnp.float32)).astype(dt) * linear(h, p["w_up"])
-    return x + linear(gated, p["w_down"])
+    gated = jax.nn.silu(
+        _al(h, p["w_gate"], lora, "w_gate", lora_ids).astype(jnp.float32)
+    ).astype(dt) * _al(h, p["w_up"], lora, "w_up", lora_ids)
+    return x + _al(gated, p["w_down"], lora, "w_down", lora_ids)
 
 
 def layer_forward(
@@ -478,6 +495,13 @@ def run_cached_layers(
                                  # so the flattened MAXB*BLK axis is still
                                  # absolute-position order and every
                                  # positional mask below applies unchanged
+    lora: Optional[Params] = None,  # ops/lora.py bank LAYER TREE (the
+                                 # bank's "layers" entry — pure arrays, so
+                                 # it can cross jit): {t_A: [L, N, in, r],
+                                 # t_B: [L, N, r, out]}; leading axis L
+                                 # rides the layer scan like the base
+                                 # weights
+    lora_ids: Optional[jnp.ndarray] = None,  # [B] adapter index per row
 ) -> tuple[jnp.ndarray, KVCache]:
     """The cached transformer stack: scan over stacked layers, writing this
     block's K/V at ``cache_offsets`` and attending with positional masking
@@ -579,9 +603,13 @@ def run_cached_layers(
 
     def scan_body(carry, layer_xs):
         y0, cache = carry
-        p, lidx = layer_xs
+        if lora is not None:
+            p, lora_p, lidx = layer_xs
+        else:
+            (p, lidx), lora_p = layer_xs, None
         h = block_norm(p, cfg, y0, "attn_norm")
-        q, k, v = qkv_proj(p, cfg, h, positions, cos, sin)
+        q, k, v = qkv_proj(p, cfg, h, positions, cos, sin,
+                           lora=lora_p, lora_ids=lora_ids)
         cache = dict(cache)
         if quantized_kv:
             kq, ks = _quantize_kv_block(k)
@@ -641,12 +669,20 @@ def run_cached_layers(
                 m = jnp.where(glidx % 2 == 0, mask, mask_global)
             o = attention(q, k_layer, v_layer, m,
                           scale=attn_scale, softcap=attn_cap)
-        return (attn_out_and_mlp(p, cfg, y0, o, h), cache), None
+        return (
+            attn_out_and_mlp(p, cfg, y0, o, h, lora=lora_p, lora_ids=lora_ids),
+            cache,
+        ), None
 
+    xs = (
+        (layers, lora, jnp.arange(n_local))
+        if lora is not None
+        else (layers, jnp.arange(n_local))
+    )
     (x, new_cache), _ = jax.lax.scan(
         scan_body,
         (x, dict(kv_cache)),
-        (layers, jnp.arange(n_local)),
+        xs,
         unroll=max(cfg.scan_unroll, 1),
     )
     return x, new_cache
@@ -676,6 +712,11 @@ def forward(
     block_table: Optional[jnp.ndarray] = None,  # [B, MAXB] int32: paged-KV
                         # mode — kv_cache is an init_paged_kv_cache pool and
                         # row b's positions live in blocks table[b, :]
+    lora: Optional[Params] = None,  # multi-LoRA bank layer tree (the
+                        # ops/lora.py bank's "layers" entry); serving
+                        # (cached) path only — the cache-free training path
+                        # ignores it
+    lora_ids: Optional[jnp.ndarray] = None,  # [B] adapter index per row
 ) -> tuple[jnp.ndarray, Optional[KVCache]]:
     """Returns (logits [B, T, V] float32, updated cache).
 
@@ -707,6 +748,7 @@ def forward(
         x, new_cache_dict = run_cached_layers(
             layers, cfg, x, positions, cos, sin, kv_cache, cache_offsets,
             fresh_prefill=fresh_prefill, block_table=block_table,
+            lora=lora, lora_ids=lora_ids,
         )
     else:
         def scan_body_nocache(carry, xs):

@@ -1,0 +1,234 @@
+"""Multi-LoRA serving: batched low-rank adapter deltas for the decode path.
+
+The reference benchmarks vLLM servers, whose multi-LoRA mode serves many
+fine-tunes behind one base model by routing each request to an adapter
+(per-request ``model`` field). Here the runtime is in-repo, so the
+mechanism is too: every transformer matmul target can carry a bank of N
+adapters, and each slot in the continuous batch picks its adapter by
+index — one jitted step serves heterogeneous adapters.
+
+TPU shape of the trick: the bank is stacked [L, N, in, r] / [L, N, r, out]
+(layer axis first so it rides the layer scan like the base weights); a
+step gathers the batch's adapters ([B, in, r] — a few MB at serving ranks)
+and the delta is two small einsums XLA fuses around the main matmul. The
+``alpha/r`` scale is folded into the B factor at init/load time, so the
+hot path has no per-adapter scalar bookkeeping.
+
+Adapter index 0 is reserved as the BASE (zero) adapter: its A/B factors
+are zeros, so un-adaptered requests run bit-identical to the base model
+without a separate execution path.
+
+PEFT checkpoint loading (the ``adapter_model.safetensors`` layout that HF
+fine-tunes produce) lives in ``load_peft_adapter``; reference analog: the
+``model`` routing surface of scripts/openai_parity_probe.py:71-116 and the
+vLLM ``--enable-lora`` deployments the harness benchmarks.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+# targets that may carry adapters (subset of ops.quant.QUANTIZABLE; the
+# default mirrors common PEFT configs: attention projections only)
+LORA_TARGETS_DEFAULT = ("wq", "wk", "wv", "wo")
+LORA_TARGETS_ALL = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+def _target_dims(cfg, name: str) -> tuple[int, int]:
+    d, h = cfg.d_model, cfg.n_heads * cfg.head_dim
+    kv = cfg.n_kv_heads * cfg.head_dim
+    return {
+        "wq": (d, h),
+        "wk": (d, kv),
+        "wv": (d, kv),
+        "wo": (h, d),
+        "w_gate": (d, cfg.d_ff),
+        "w_up": (d, cfg.d_ff),
+        "w_down": (cfg.d_ff, d),
+    }[name]
+
+
+def init_lora_bank(
+    rng: jax.Array,
+    cfg,
+    n_adapters: int,
+    rank: int = 8,
+    alpha: float = 16.0,
+    targets: Sequence[str] = LORA_TARGETS_DEFAULT,
+    dtype=jnp.bfloat16,
+) -> dict[str, Any]:
+    """Random bank of ``n_adapters`` REAL adapters (+ the reserved zero
+    adapter at index 0, so the bank's N axis is n_adapters + 1).
+
+    A ~ N(0, 1/r) and B = 0 is the standard LoRA init (delta starts at 0);
+    for testing heterogeneous batches a random-B variant is more useful,
+    so B is also drawn and pre-scaled by alpha/rank.
+    """
+    layers: dict[str, jnp.ndarray] = {}
+    n = n_adapters + 1
+    keys = jax.random.split(rng, 2 * len(targets))
+    for i, t in enumerate(targets):
+        din, dout = _target_dims(cfg, t)
+        a = jax.random.normal(keys[2 * i], (cfg.n_layers, n, din, rank)) / rank
+        b = jax.random.normal(keys[2 * i + 1], (cfg.n_layers, n, rank, dout))
+        b = b * (alpha / rank)
+        # index 0 = base: zero delta
+        a = a.at[:, 0].set(0.0)
+        b = b.at[:, 0].set(0.0)
+        layers[t + "_A"] = a.astype(dtype)
+        layers[t + "_B"] = b.astype(dtype)
+    return {"layers": layers, "rank": rank, "targets": tuple(targets)}
+
+
+def zero_lora_bank(
+    cfg,
+    n_adapters: int,
+    rank: int = 8,
+    targets: Sequence[str] = LORA_TARGETS_DEFAULT,
+    dtype=jnp.bfloat16,
+) -> dict[str, Any]:
+    """All-zero bank with slots for ``n_adapters`` adapters to be installed
+    via ``install_adapter`` (index 0 stays the base adapter)."""
+    layers: dict[str, jnp.ndarray] = {}
+    n = n_adapters + 1
+    for t in targets:
+        din, dout = _target_dims(cfg, t)
+        layers[t + "_A"] = jnp.zeros((cfg.n_layers, n, din, rank), dtype)
+        layers[t + "_B"] = jnp.zeros((cfg.n_layers, n, rank, dout), dtype)
+    return {"layers": layers, "rank": rank, "targets": tuple(targets)}
+
+
+def install_adapter(
+    bank: dict[str, Any],
+    index: int,
+    adapter: dict[str, Any],
+) -> dict[str, Any]:
+    """Write one adapter's per-layer factors into bank slot ``index``
+    (1-based for real adapters; 0 is reserved). ``adapter`` maps target ->
+    (A [L, in, r], B [L, r, out]); B must already carry the alpha/r scale
+    (load_peft_adapter does this)."""
+    if index < 1:
+        raise ValueError("adapter index 0 is reserved for the base model")
+    layers = dict(bank["layers"])
+    for t, (a, b) in adapter.items():
+        ka, kb = t + "_A", t + "_B"
+        if ka not in layers:
+            raise ValueError(
+                f"bank has no target {t!r} (targets: {bank['targets']})"
+            )
+        if a.shape[-1] != bank["rank"]:
+            raise ValueError(
+                f"adapter rank {a.shape[-1]} != bank rank {bank['rank']}"
+            )
+        layers[ka] = layers[ka].at[:, index].set(a.astype(layers[ka].dtype))
+        layers[kb] = layers[kb].at[:, index].set(b.astype(layers[kb].dtype))
+    return {**bank, "layers": layers}
+
+
+def lora_delta(
+    x: jnp.ndarray,          # [B, T, in]
+    a_bank: jnp.ndarray,     # [N, in, r]   (one layer's slice)
+    b_bank: jnp.ndarray,     # [N, r, out]
+    ids: jnp.ndarray,        # [B] int32 adapter index per slot
+) -> jnp.ndarray:
+    """Per-slot adapter delta (x @ A_i) @ B_i -> [B, T, out]. The gathers
+    materialize only the BATCH's factors ([B, in, r] — MBs at serving
+    ranks), never the bank."""
+    a = a_bank[ids]                                # [B, in, r]
+    b = b_bank[ids]                                # [B, r, out]
+    mid = jnp.einsum("btd,bdr->btr", x.astype(a.dtype), a)
+    return jnp.einsum("btr,bro->bto", mid, b)
+
+
+def adapted_linear(
+    x: jnp.ndarray,
+    w: Any,
+    lora_layer: Optional[dict[str, jnp.ndarray]],
+    name: str,
+    ids: Optional[jnp.ndarray],
+) -> jnp.ndarray:
+    """ops.quant.linear plus this target's adapter delta when the layer
+    bank carries it (targets not in the bank run the base matmul only)."""
+    from kserve_vllm_mini_tpu.ops.quant import linear
+
+    y = linear(x, w)
+    if lora_layer is None or ids is None or name + "_A" not in lora_layer:
+        return y
+    d = lora_delta(x, lora_layer[name + "_A"], lora_layer[name + "_B"], ids)
+    return y + d.astype(y.dtype)
+
+
+def load_peft_adapter(
+    path: str,
+    cfg,
+    targets: Sequence[str] = LORA_TARGETS_DEFAULT,
+) -> dict[str, Any]:
+    """Read a PEFT ``adapter_model.safetensors`` (+ ``adapter_config.json``)
+    directory into the install_adapter format.
+
+    PEFT names look like
+    ``base_model.model.model.layers.{i}.self_attn.q_proj.lora_A.weight``
+    with torch [out, in] orientation; they are transposed to this repo's
+    [in, out] convention and stacked over layers. The config's
+    ``lora_alpha / r`` scale is folded into B.
+    """
+    import json
+    import os
+
+    import numpy as np
+
+    cfg_path = os.path.join(path, "adapter_config.json")
+    with open(cfg_path) as f:
+        acfg = json.load(f)
+    rank = int(acfg["r"])
+    scale = float(acfg.get("lora_alpha", rank)) / rank
+
+    from safetensors.numpy import load_file
+
+    tensors = load_file(os.path.join(path, "adapter_model.safetensors"))
+
+    peft_name = {
+        "wq": "self_attn.q_proj", "wk": "self_attn.k_proj",
+        "wv": "self_attn.v_proj", "wo": "self_attn.o_proj",
+        "w_gate": "mlp.gate_proj", "w_up": "mlp.up_proj",
+        "w_down": "mlp.down_proj",
+    }
+    out: dict[str, Any] = {}
+    for t in targets:
+        frag = peft_name[t]
+        a_layers, b_layers = [], []
+        for li in range(cfg.n_layers):
+            ka = kb = None
+            for key in tensors:
+                if f"layers.{li}.{frag}.lora_A" in key:
+                    ka = key
+                if f"layers.{li}.{frag}.lora_B" in key:
+                    kb = key
+            if ka is None or kb is None:
+                break  # target absent from layer li onward
+            # torch Linear stores [out, in]; transpose to [in, out] math
+            a_layers.append(np.asarray(tensors[ka]).T)          # [in, r]
+            b_layers.append(np.asarray(tensors[kb]).T * scale)  # [r, out]
+        if len(a_layers) == cfg.n_layers:
+            out[t] = (jnp.asarray(np.stack(a_layers)),
+                      jnp.asarray(np.stack(b_layers)))
+        elif a_layers:
+            # partial coverage must fail LOUDLY: silently dropping the
+            # target would serve the fine-tune with part of its weights
+            # missing (e.g. a layers_to_transform adapter)
+            raise ValueError(
+                f"adapter at {path} covers target {t!r} for only "
+                f"{len(a_layers)}/{cfg.n_layers} layers; per-layer-subset "
+                "(layers_to_transform) adapters are not supported"
+            )
+    if not out:
+        raise ValueError(
+            f"no usable LoRA targets found in {path} "
+            f"(looked for {[peft_name[t] for t in targets]})"
+        )
+    if rank != next(iter(out.values()))[0].shape[-1]:
+        raise ValueError("adapter_config r does not match tensor shapes")
+    return out

@@ -189,6 +189,10 @@ class GenRequest:
     # which submit() wraps with the ByteTokenizer id mapping. The engine
     # masks device-side; the machine runs host-side.
     constraint: Optional[Any] = None
+    # multi-LoRA: adapter name from the engine's bank registry (None = base
+    # model). Resolved to a bank index at submit; each slot decodes with
+    # its own adapter inside the same jitted step (ops/lora.py).
+    adapter: Optional[str] = None
 
 
 class RequestHandle:
@@ -222,6 +226,9 @@ class Engine:
         mesh=None,
         pad_id: int = 0,
         drafter: Optional[tuple[dict[str, Any], ModelConfig]] = None,
+        lora: Optional[dict[str, Any]] = None,  # ops/lora.py bank; its
+                                 # "names" dict maps adapter name -> index
+                                 # (index 0 = base, always available)
     ) -> None:
         self.cfg = cfg
         self.ecfg = engine_cfg or EngineConfig()
@@ -346,6 +353,31 @@ class Engine:
             )
         self._spec_fn = None
 
+        # multi-LoRA bank: per-slot adapter index decoded inside the same
+        # jitted step; index 0 is the base (zero) adapter
+        self._lora = lora
+        self._lora_names: dict[str, int] = dict(lora.get("names", {})) if lora else {}
+        if lora is not None:
+            if mesh is not None:
+                raise ValueError("multi-LoRA does not support meshes yet; "
+                                 "serve adapters on a single-device engine")
+            if drafter is not None:
+                # the drafter proposes from base weights; verification would
+                # accept base-model continuations for adapted slots. The
+                # per-slot gate below excludes adapted slots from spec, but
+                # mixing the features is untested — reject loudly for now.
+                raise ValueError("multi-LoRA with a speculative drafter is "
+                                 "not supported yet")
+            if self.ecfg.prefix_cache:
+                # retained KV is matched by TOKENS only; K/V rows computed
+                # under adapter a's wk/wv deltas must never be reused by a
+                # base or adapter-b request sharing the same prompt prefix
+                raise ValueError("multi-LoRA and prefix_cache are mutually "
+                                 "exclusive: retained KV carries no record "
+                                 "of the adapter that computed it")
+        self._slot_adapter = [0] * S
+        self._adapter_ids_dev: Optional[jnp.ndarray] = None
+
         # host-side slot state
         self._slot_req: list[Optional[RequestHandle]] = [None] * S
         self._slot_len = [0] * S
@@ -426,6 +458,13 @@ class Engine:
             self._table_dev = jnp.asarray(self._block_table)
         return self._table_dev
 
+    def _adapter_ids(self) -> jnp.ndarray:
+        """Device mirror of per-slot adapter indices (multi-LoRA), rebuilt
+        only when the slot population changes."""
+        if self._adapter_ids_dev is None:
+            self._adapter_ids_dev = jnp.asarray(self._slot_adapter, jnp.int32)
+        return self._adapter_ids_dev
+
     # -- compiled steps ----------------------------------------------------
 
     def _bucket(self, n: int) -> int:
@@ -442,8 +481,9 @@ class Engine:
         fwd = forward if draft else self._fwd
 
         @partial(jax.jit, donate_argnums=(1,), static_argnums=())
-        def prefill(params, cache, tokens, length, slot):
-            # tokens: [1, bucket]; length: scalar; slot: scalar
+        def prefill(params, cache, tokens, length, slot, lora=None, ids=None):
+            # tokens: [1, bucket]; length: scalar; slot: scalar; lora/ids:
+            # multi-LoRA bank + [1] adapter index (None = base path)
             from kserve_vllm_mini_tpu.models.llama import (
                 slice_cache_slots,
                 update_cache_slots,
@@ -454,11 +494,13 @@ class Engine:
             # logit_index: only the prompt's last position is sampled — a
             # full [1, bucket, V] f32 logits tensor is ~2 GB at 128k vocab
             # for the server-default 4096 bucket, on the per-request path
+            kw = {"lora": lora, "lora_ids": ids} if lora is not None else {}
             logits, new_sub = fwd(
                 params, cfg, tokens, pos,
                 sub, jnp.zeros((1,), jnp.int32),
                 fresh_prefill=True,
                 logit_index=(length - 1)[None],
+                **kw,
             )
             return update_cache_slots(cache, new_sub, slot), logits[0, 0]  # [V] f32
 
@@ -478,7 +520,8 @@ class Engine:
         fwd = forward if draft else self._fwd
 
         @partial(jax.jit, donate_argnums=(1,))
-        def chunk_prefill(params, cache, tokens, length, slot, offset):
+        def chunk_prefill(params, cache, tokens, length, slot, offset,
+                          lora=None, ids=None):
             # tokens: [1, bucket]; length = valid tokens in this chunk;
             # offset = absolute position of the chunk's first token
             from kserve_vllm_mini_tpu.models.llama import (
@@ -488,10 +531,12 @@ class Engine:
 
             pos = offset + jnp.arange(tokens.shape[1], dtype=jnp.int32)[None]
             sub = slice_cache_slots(cache, slot)
+            kw = {"lora": lora, "lora_ids": ids} if lora is not None else {}
             logits, new_sub = fwd(
                 params, cfg, tokens, pos,
                 sub, offset[None],
                 logit_index=(length - 1)[None],
+                **kw,
             )
             return update_cache_slots(cache, new_sub, slot), logits[0, 0]
 
@@ -508,14 +553,16 @@ class Engine:
         fwd = self._fwd
 
         @partial(jax.jit, donate_argnums=(1,))
-        def prefill(params, cache, tokens, length, trow):
+        def prefill(params, cache, tokens, length, trow, lora=None, ids=None):
             pos = jnp.arange(tokens.shape[1], dtype=jnp.int32)[None]
+            kw = {"lora": lora, "lora_ids": ids} if lora is not None else {}
             logits, nc = fwd(
                 params, cfg, tokens, pos,
                 cache, jnp.zeros((1,), jnp.int32),
                 fresh_prefill=True,
                 logit_index=(length - 1)[None],
                 block_table=trow,
+                **kw,
             )
             return nc, logits[0, 0]
 
@@ -530,13 +577,16 @@ class Engine:
         fwd = self._fwd
 
         @partial(jax.jit, donate_argnums=(1,))
-        def chunk_prefill(params, cache, tokens, length, offset, trow):
+        def chunk_prefill(params, cache, tokens, length, offset, trow,
+                          lora=None, ids=None):
             pos = offset + jnp.arange(tokens.shape[1], dtype=jnp.int32)[None]
+            kw = {"lora": lora, "lora_ids": ids} if lora is not None else {}
             logits, nc = fwd(
                 params, cfg, tokens, pos,
                 cache, offset[None],
                 logit_index=(length - 1)[None],
                 block_table=trow,
+                **kw,
             )
             return nc, logits[0, 0]
 
@@ -565,13 +615,17 @@ class Engine:
 
         @partial(jax.jit, donate_argnums=(1,))
         def decode(params, cache, tokens, lengths, temps, topks, topps, rng,
-                   table=None):
+                   table=None, lora=None, ids=None):
             def body(carry, _):
                 c, toks, lens, r = carry
                 r, sub = jax.random.split(r)
+                kw = {}
+                if paged:
+                    kw["block_table"] = table
+                if lora is not None:
+                    kw["lora"], kw["lora_ids"] = lora, ids
                 logits, nc = fwd(
-                    params, cfg, toks[:, None], lens[:, None], c, lens,
-                    **({"block_table": table} if paged else {}),
+                    params, cfg, toks[:, None], lens[:, None], c, lens, **kw,
                 )
                 lg = logits[:, 0, :]
                 nxt = sample_tokens(lg, sub, temps, topks, topps)
@@ -604,10 +658,15 @@ class Engine:
         @partial(jax.jit, donate_argnums=(1,))
         def decode_masked(params, cache, tokens, lengths,
                           temps, topks, topps, rng, packed_mask, use_mask,
-                          table=None):
+                          table=None, lora=None, ids=None):
+            kw = {}
+            if paged:
+                kw["block_table"] = table
+            if lora is not None:
+                kw["lora"], kw["lora_ids"] = lora, ids
             logits, nc = fwd(
                 params, cfg, tokens[:, None], lengths[:, None], cache, lengths,
-                **({"block_table": table} if paged else {}),
+                **kw,
             )
             lg = logits[:, 0, :]
             mask = _unpack_mask(packed_mask, cfg.vocab_size)
@@ -664,6 +723,15 @@ class Engine:
                     ),
                 }))
                 return handle
+        if req.adapter is not None and req.adapter not in self._lora_names:
+            handle.events.put(("done", {
+                "finish_reason": "error",
+                "error": (
+                    f"unknown adapter {req.adapter!r}; available: "
+                    f"{sorted(self._lora_names) or '(none loaded)'}"
+                ),
+            }))
+            return handle
         if self.paged and self._blocks_needed(req) > self._scratch_block:
             # can NEVER fit the pool (scratch_block == total user blocks) —
             # failing now beats deadlocking the admission queue forever
@@ -788,17 +856,24 @@ class Engine:
         return slot, best_k
 
     def _prefill_chunks(self, prompt: list[int], slot: int, draft: bool = False,
-                        start_offset: int = 0):
+                        start_offset: int = 0, adapter_idx: int = 0):
         """Run the prompt through the slot's cache: chunk 0 on the flash
         fresh-prefill path, continuation chunks (prompts longer than
         max_prefill_len, or the suffix after a reused prefix) on the
         positional-masked chunk path. Returns the last real position's
-        logits [V] f32."""
+        logits [V] f32. ``adapter_idx`` picks the request's LoRA adapter
+        (0 = base) when the engine carries a bank."""
         budget = self.ecfg.max_prefill_len
         params = self._drafter_params if draft else self.params
         n = len(prompt)
         last_logits = None
         off = start_offset
+        lkw = {}
+        if self._lora is not None and not draft:
+            lkw = {
+                "lora": self._lora["layers"],
+                "ids": jnp.asarray([adapter_idx], jnp.int32),
+            }
         while off < n:
             piece = prompt[off : off + budget]
             m = len(piece)
@@ -811,24 +886,25 @@ class Engine:
                 if off == 0:
                     fn = self._get_paged_prefill_fn(bucket)
                     cache, last_logits = fn(
-                        params, cache_in, tokens, jnp.int32(m), trow
+                        params, cache_in, tokens, jnp.int32(m), trow, **lkw
                     )
                 else:
                     fn = self._get_paged_chunk_prefill_fn(bucket)
                     cache, last_logits = fn(
                         params, cache_in, tokens,
-                        jnp.int32(m), jnp.int32(off), trow,
+                        jnp.int32(m), jnp.int32(off), trow, **lkw,
                     )
             elif off == 0:
                 fn = self._get_prefill_fn(bucket, draft=draft)
                 cache, last_logits = fn(
-                    params, cache_in, tokens, jnp.int32(m), jnp.int32(slot)
+                    params, cache_in, tokens, jnp.int32(m), jnp.int32(slot),
+                    **lkw,
                 )
             else:
                 fn = self._get_chunk_prefill_fn(bucket, draft=draft)
                 cache, last_logits = fn(
                     params, cache_in, tokens,
-                    jnp.int32(m), jnp.int32(slot), jnp.int32(off),
+                    jnp.int32(m), jnp.int32(slot), jnp.int32(off), **lkw,
                 )
             if draft:
                 self._dcache = cache
@@ -848,10 +924,12 @@ class Engine:
             # _paged_admit_blocks pops _free_blocks and would fail loudly
             # on a (multihost-divergence) violation.
             self._paged_admit_blocks(slot, req)
+        adapter_idx = self._lora_names.get(req.adapter, 0) if req.adapter else 0
         n = len(req.prompt_tokens)
         t0 = time.time()
         last_logits = self._prefill_chunks(
-            req.prompt_tokens, slot, start_offset=reused
+            req.prompt_tokens, slot, start_offset=reused,
+            adapter_idx=adapter_idx,
         )
         # first token: sampled from the prompt's last-position logits,
         # grammar-masked when the request is constrained
@@ -901,6 +979,8 @@ class Engine:
         self._slot_remaining[slot] = req.max_new_tokens - 1
         self._last_tokens[slot] = first_id
         self._slot_machine[slot] = machine
+        self._slot_adapter[slot] = adapter_idx
+        self._adapter_ids_dev = None
         # rows 0..n-1 now hold the prompt's KV; emitted tokens append as
         # their KV lands (fed on the next step)
         self._slot_tokens[slot] = list(req.prompt_tokens) + [first_id]
@@ -952,6 +1032,12 @@ class Engine:
             self._retained[slot] = self._slot_tokens[slot][: self._slot_len[slot]]
         if self.paged:
             self._paged_release(slot)
+        # reset to the base adapter: the all-slots sweep still computes this
+        # slot's row, and a stale adapter id would gather a real adapter's
+        # factors for discarded garbage (harmless but wasteful) — and the
+        # id array is rebuilt here anyway
+        self._slot_adapter[slot] = 0
+        self._adapter_ids_dev = None
         self._free.append(slot)
         self._sampling_arrays = None  # slot population changed
 
@@ -1014,6 +1100,9 @@ class Engine:
             if self._slot_req[i].request.temperature == 0.0
             and self._slot_machine[i] is None
             and not self._slot_req[i].request.logprobs
+            # adapted slots can't speculate: the drafter proposes from base
+            # weights (defensive — lora+drafter is rejected at init)
+            and self._slot_adapter[i] == 0
         ]
         if not spec:
             return [], active
@@ -1105,19 +1194,24 @@ class Engine:
                 mask[i] = self._constraint_mask(self._slot_machine[i], budget)
             use_mask = np.zeros((S,), dtype=bool)
             use_mask[constrained] = True
+        lkw = {}
+        if self.paged:
+            lkw["table"] = self._table()
+        if self._lora is not None:
+            lkw["lora"] = self._lora["layers"]
+            lkw["ids"] = self._adapter_ids()
+        if constrained:
             decode = self._get_masked_decode_fn()
-            extra = (self._table(),) if self.paged else ()
             self._cache, ys = decode(
                 self.params, self._cache,
                 tokens, lengths, temps, topks, topps, sub,
-                jnp.asarray(mask), jnp.asarray(use_mask), *extra,
+                jnp.asarray(mask), jnp.asarray(use_mask), **lkw,
             )
         else:
             decode = self._get_decode_fn(chunk)
-            extra = (self._table(),) if self.paged else ()
             self._cache, ys = decode(
                 self.params, self._cache,
-                tokens, lengths, temps, topks, topps, sub, *extra,
+                tokens, lengths, temps, topks, topps, sub, **lkw,
             )
         # ONE host transfer for the whole chunk block — per-element
         # int(row[i]) costs a separate device readback each (chunk x slots

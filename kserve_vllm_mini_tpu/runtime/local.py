@@ -79,6 +79,9 @@ def start_local_server(
             if profile.get("kv_pool_blocks") is not None
             else None
         ),
+        lora_adapters=profile.get("lora"),
+        lora_demo=int(profile.get("lora_demo", 0)),
+        lora_rank=int(profile.get("lora_rank", 8)),
     )
     engine.start()
     app = make_app(engine, tok, name)

@@ -52,6 +52,9 @@ def build_engine(
     kv_layout: str = "dense",
     kv_block_size: int = 64,
     kv_pool_blocks: Optional[int] = None,
+    lora_adapters: Optional[dict[str, str]] = None,  # name -> PEFT dir
+    lora_demo: int = 0,       # N random adapters "demo-1..N" (bench/testing)
+    lora_rank: int = 8,       # rank for the demo bank (PEFT dirs carry theirs)
 ) -> tuple[Engine, Tokenizer, str]:
     """Construct (engine, tokenizer, model_name) from a preset or checkpoint.
 
@@ -169,6 +172,49 @@ def build_engine(
             )
         drafter_pair = (dparams, dcfg)
 
+    # multi-LoRA bank: PEFT checkpoint adapters, or a random demo bank so
+    # multi-adapter serving can be benchmarked without fine-tuned weights
+    lora_bank = None
+    if lora_adapters:
+        from kserve_vllm_mini_tpu.ops.lora import (
+            LORA_TARGETS_ALL,
+            install_adapter,
+            load_peft_adapter,
+            zero_lora_bank,
+        )
+
+        loaded = {
+            nm: load_peft_adapter(path, cfg, targets=LORA_TARGETS_ALL)
+            for nm, path in lora_adapters.items()
+        }
+        ranks = {
+            nm: next(iter(ad.values()))[0].shape[-1] for nm, ad in loaded.items()
+        }
+        if len(set(ranks.values())) > 1:
+            # v1: one bank, one rank (padding mixed ranks to max is future
+            # work) — name the offenders instead of crashing inside install
+            raise ValueError(
+                f"all adapters must share one LoRA rank, got {ranks}"
+            )
+        rank = next(iter(ranks.values()))
+        targets = sorted({t for ad in loaded.values() for t in ad})
+        bank = zero_lora_bank(cfg, len(loaded), rank, targets=targets,
+                              dtype=cfg.jnp_dtype)
+        names: dict[str, int] = {}
+        for i, (nm, ad) in enumerate(sorted(loaded.items()), start=1):
+            bank = install_adapter(bank, i, ad)
+            names[nm] = i
+        bank["names"] = names
+        lora_bank = bank
+    elif lora_demo:
+        from kserve_vllm_mini_tpu.ops.lora import init_lora_bank
+
+        lora_bank = init_lora_bank(
+            jax.random.PRNGKey(seed + 1), cfg, lora_demo, rank=lora_rank,
+            dtype=cfg.jnp_dtype,
+        )
+        lora_bank["names"] = {f"demo-{i}": i for i in range(1, lora_demo + 1)}
+
     ecfg = EngineConfig(
         max_slots=max_slots,
         max_seq_len=min(max_seq_len, cfg.max_seq_len),
@@ -184,7 +230,8 @@ def build_engine(
         kv_pool_blocks=kv_pool_blocks,
     )
     engine = Engine(
-        params, cfg, ecfg, mesh=mesh, pad_id=tok.pad_id, drafter=drafter_pair
+        params, cfg, ecfg, mesh=mesh, pad_id=tok.pad_id, drafter=drafter_pair,
+        lora=lora_bank,
     )
     return engine, tok, name
 
@@ -420,6 +467,35 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
         top_lp = min(top_lp, 5)
         prompt = _messages_to_prompt(messages)
         prompt_ids = tok.encode(prompt)
+        # multi-LoRA routing (vLLM convention): "model" names either the
+        # base model or a loaded adapter. The loadgen's placeholder
+        # "default" always means the base, and with NO adapters loaded
+        # unknown names keep the legacy ignore-the-field behavior (every
+        # pre-LoRA profile sends "default"); once adapters exist, a name
+        # that matches nothing 404s — silently serving the base where a
+        # fine-tune was requested would be a measurement lie
+        req_model = body.get("model")
+        adapter = None
+        adapter_names = getattr(engine, "_lora_names", {})
+        if (
+            adapter_names
+            and req_model
+            and req_model not in (model_name, "default")
+        ):
+            if req_model in adapter_names:
+                adapter = req_model
+            else:
+                return web.json_response(
+                    {"error": {
+                        "message": (
+                            f"model {req_model!r} not found; available: "
+                            f"{[model_name, *sorted(adapter_names)]}"
+                        ),
+                        "type": "invalid_request_error",
+                        "code": "model_not_found",
+                    }},
+                    status=404,
+                )
         req = GenRequest(
             prompt_tokens=prompt_ids or [tok.bos_id],
             max_new_tokens=max_tokens,
@@ -430,6 +506,7 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
             logprobs=want_logprobs,
             top_logprobs=top_lp,
             constraint=machine,
+            adapter=adapter,
         )
         handle = engine.submit(req)
         rid = f"chatcmpl-{uuid.uuid4().hex[:20]}"
@@ -608,12 +685,17 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
         return resp
 
     async def models(_request):
-        return web.json_response(
-            {"object": "list", "data": [
-                {"id": model_name, "object": "model", "created": int(started),
-                 "owned_by": "kvmini-tpu"}
-            ]}
-        )
+        data = [
+            {"id": model_name, "object": "model", "created": int(started),
+             "owned_by": "kvmini-tpu"}
+        ]
+        for name in sorted(getattr(engine, "_lora_names", {})):
+            data.append(
+                {"id": name, "object": "model", "created": int(started),
+                 "owned_by": "kvmini-tpu", "parent": model_name,
+                 "root": model_name}
+            )
+        return web.json_response({"object": "list", "data": data})
 
     async def healthz(_request):
         if not alive_check():
@@ -789,6 +871,19 @@ def register(parser: argparse.ArgumentParser) -> None:
                         help="Paged-KV pool size in blocks (default "
                              "slots x ceil(max_seq/block), memory-equal to "
                              "dense; set lower to cap KV HBM)")
+    parser.add_argument("--lora", action="append", default=None,
+                        metavar="NAME=PEFT_DIR",
+                        help="Load a LoRA adapter (PEFT safetensors dir) "
+                             "servable via the request's 'model' field; "
+                             "repeatable — one jitted step serves mixed "
+                             "adapters (ops/lora.py)")
+    parser.add_argument("--lora-demo", type=int, default=0,
+                        help="Create N random adapters 'demo-1..N' for "
+                             "multi-LoRA benchmarking without fine-tuned "
+                             "weights")
+    parser.add_argument("--lora-rank", type=int, default=8,
+                        help="Rank of the --lora-demo bank (PEFT adapters "
+                             "carry their own rank)")
     parser.add_argument("--prefix-cache", action="store_true",
                         help="Automatic prefix caching: finished requests "
                              "retain their KV and new prompts sharing a "
@@ -806,6 +901,19 @@ def register(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--command-port", type=int, default=None,
                         help="Multi-host scheduler-command channel port "
                              "(default: $KVMINI_COMMAND_PORT or 8470)")
+
+
+def _parse_lora_args(items: Optional[list]) -> Optional[dict[str, str]]:
+    """--lora NAME=PEFT_DIR (repeatable) -> {name: dir}."""
+    if not items:
+        return None
+    out: dict[str, str] = {}
+    for it in items:
+        if "=" not in it:
+            raise SystemExit(f"--lora expects NAME=PEFT_DIR, got {it!r}")
+        name, path = it.split("=", 1)
+        out[name] = path
+    return out
 
 
 def run(args: argparse.Namespace) -> int:
@@ -912,6 +1020,9 @@ def run(args: argparse.Namespace) -> int:
         kv_layout=args.kv_layout,
         kv_block_size=args.kv_block_size,
         kv_pool_blocks=args.kv_pool_blocks,
+        lora_adapters=_parse_lora_args(args.lora),
+        lora_demo=args.lora_demo,
+        lora_rank=args.lora_rank,
     )
 
     if multihost:
