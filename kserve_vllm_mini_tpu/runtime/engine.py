@@ -162,6 +162,12 @@ class EngineConfig:
     # pool size in blocks; None sizes it to max_slots x ceil(max_seq/BLK)
     # (memory-equal to dense — set it LOWER to realize the savings)
     kv_pool_blocks: Optional[int] = None
+    # multi-LoRA bank capacity for adapters loaded AT RUNTIME into an
+    # engine that started without a bank (load_adapter creates a zero bank
+    # of this many adapter slots; the bank's array shapes are fixed once
+    # created, so growing past it needs a restart). Engines built with a
+    # preset bank keep that bank's capacity instead.
+    lora_slots: int = 4
 
 
 @dataclass
@@ -193,6 +199,25 @@ class GenRequest:
     # model). Resolved to a bank index at submit; each slot decodes with
     # its own adapter inside the same jitted step (ops/lora.py).
     adapter: Optional[str] = None
+
+
+@dataclass
+class _AdminOp:
+    """Engine-state mutation executed ON the scheduler thread between
+    sweeps (single-writer discipline for bank/registry swaps). ``fn`` runs
+    with no args; the result/error lands in the fields and ``done`` fires."""
+
+    fn: Any
+    done: threading.Event = field(default_factory=threading.Event)
+    error: Optional[str] = None
+
+    def run(self) -> None:
+        try:
+            self.fn()
+        except Exception as e:  # noqa: BLE001 — error goes to the caller
+            self.error = f"{type(e).__name__}: {e}"
+        finally:
+            self.done.set()
 
 
 class RequestHandle:
@@ -377,6 +402,10 @@ class Engine:
                                  "of the adapter that computed it")
         self._slot_adapter = [0] * S
         self._adapter_ids_dev: Optional[jnp.ndarray] = None
+        # live adapter load/unload ops, drained by the scheduler between
+        # sweeps; bank capacity for a runtime-created bank comes from
+        # ecfg.lora_slots
+        self._admin: "queue.Queue[_AdminOp]" = queue.Queue()
 
         # host-side slot state
         self._slot_req: list[Optional[RequestHandle]] = [None] * S
@@ -464,6 +493,134 @@ class Engine:
         if self._adapter_ids_dev is None:
             self._adapter_ids_dev = jnp.asarray(self._slot_adapter, jnp.int32)
         return self._adapter_ids_dev
+
+    # -- live adapter management (vLLM dynamic-LoRA analog) ----------------
+
+    def _run_admin(self, fn, timeout_s: float = 60.0) -> Optional[str]:
+        """Execute ``fn`` on the scheduler thread (between sweeps) and
+        return its error string, or None on success. Direct call when the
+        scheduler isn't running (build-time / tests)."""
+        if not self._running:
+            op = _AdminOp(fn)
+            op.run()
+            return op.error
+        op = _AdminOp(fn)
+        self._admin.put(op)
+        if not op.done.wait(timeout=timeout_s):
+            return f"admin op timed out after {timeout_s:.0f}s"
+        return op.error
+
+    def load_adapter(self, name: str, adapter: dict[str, Any]) -> Optional[str]:
+        """Install a LoRA adapter under ``name`` without restarting the
+        engine. ``adapter`` is the ops/lora.py install format (target ->
+        (A [L, in, r], B [L, r, out]), B pre-scaled). On an engine started
+        without a bank, the first load creates a zero bank with
+        ``ecfg.lora_slots`` capacity and that adapter's rank/targets; the
+        bank's shapes are then fixed (capacity/rank growth = restart).
+        Returns an error string, or None on success."""
+
+        def _apply():
+            from kserve_vllm_mini_tpu.ops.lora import (
+                install_adapter,
+                zero_lora_bank,
+            )
+
+            if self.mesh is not None or self._drafter_params is not None \
+                    or self.ecfg.prefix_cache:
+                raise ValueError(
+                    "multi-LoRA is not supported with meshes, drafters, or "
+                    "prefix_cache"
+                )
+            if self._lora is None:
+                rank = next(iter(adapter.values()))[0].shape[-1]
+                bank = zero_lora_bank(
+                    self.cfg, self.ecfg.lora_slots, rank,
+                    targets=sorted(adapter), dtype=self.cfg.jnp_dtype,
+                )
+                bank["names"] = {}
+                self._lora = bank
+            names = self._lora["names"]
+            if name in names:
+                idx = names[name]
+                why = self._adapter_in_use(idx, name)
+                if why:
+                    raise ValueError(
+                        f"{why}; updating its weights mid-stream would "
+                        "corrupt them"
+                    )
+            else:
+                capacity = next(iter(self._lora["layers"].values())).shape[1] - 1
+                used = set(names.values())
+                free = [i for i in range(1, capacity + 1) if i not in used]
+                if not free:
+                    raise ValueError(
+                        f"adapter bank is full ({capacity} slots, "
+                        f"{sorted(names)}); unload one or restart with a "
+                        "larger bank (lora_slots / --lora-slots)"
+                    )
+                idx = free[0]
+            # zero the index first: the incoming adapter may cover FEWER
+            # targets than the index's previous occupant, and install only
+            # writes the targets it has — leftovers would silently blend
+            # two fine-tunes
+            self._lora = self._zero_bank_index(self._lora, idx)
+            self._lora = install_adapter(self._lora, idx, adapter)
+            self._lora["names"] = dict(names, **{name: idx})
+            self._lora_names = self._lora["names"]
+
+        return self._run_admin(_apply)
+
+    @staticmethod
+    def _zero_bank_index(bank: dict[str, Any], idx: int) -> dict[str, Any]:
+        layers = {
+            k: v.at[:, idx].set(0) for k, v in bank["layers"].items()
+        }
+        return {**bank, "layers": layers}
+
+    def _adapter_in_use(self, idx: int, name: str) -> Optional[str]:
+        """Why adapter ``idx`` can't be replaced/removed right now, or
+        None. Checks live slots AND queued work — a pending request whose
+        adapter vanishes before admission would otherwise be silently
+        served by the base model."""
+        if any(
+            self._slot_adapter[i] == idx
+            for i in range(self.ecfg.max_slots)
+            if self._slot_req[i] is not None
+        ):
+            return f"adapter {name!r} is serving active requests"
+        with self._pending.mutex:
+            queued = any(
+                h.request.adapter == name for h in self._pending.queue
+            )
+        if queued or (
+            self.paged
+            and self._deferred is not None
+            and self._deferred.request.adapter == name
+        ):
+            return f"adapter {name!r} has queued requests waiting for it"
+        return None
+
+    def unload_adapter(self, name: str) -> Optional[str]:
+        """Remove ``name`` from the registry, freeing its bank slot for a
+        future load. Refused while any active request uses it. Returns an
+        error string, or None on success."""
+
+        def _apply():
+            if self._lora is None or name not in self._lora["names"]:
+                raise ValueError(
+                    f"unknown adapter {name!r}; loaded: "
+                    f"{sorted(self._lora['names']) if self._lora else []}"
+                )
+            idx = self._lora["names"][name]
+            why = self._adapter_in_use(idx, name)
+            if why:
+                raise ValueError(why)
+            names = dict(self._lora["names"])
+            del names[name]
+            self._lora["names"] = names
+            self._lora_names = names
+
+        return self._run_admin(_apply)
 
     # -- compiled steps ----------------------------------------------------
 
@@ -759,6 +916,15 @@ class Engine:
         self._running = False
         if self._thread:
             self._thread.join(timeout=10.0)
+        # an admin op enqueued around shutdown would otherwise hang its
+        # caller for the full wait timeout
+        while True:
+            try:
+                op = self._admin.get_nowait()
+            except queue.Empty:
+                break
+            op.error = "engine stopped"
+            op.done.set()
 
     # -- scheduler loop ----------------------------------------------------
 
@@ -924,7 +1090,22 @@ class Engine:
             # _paged_admit_blocks pops _free_blocks and would fail loudly
             # on a (multihost-divergence) violation.
             self._paged_admit_blocks(slot, req)
-        adapter_idx = self._lora_names.get(req.adapter, 0) if req.adapter else 0
+        adapter_idx = 0
+        if req.adapter is not None:
+            if req.adapter not in self._lora_names:
+                # the registry is also checked at submit and unload refuses
+                # while requests are queued — but if the name still vanished
+                # (defensive), failing beats silently serving the base model
+                if self.paged:
+                    self._paged_release(slot)
+                self._free.append(slot)
+                handle.events.put(("done", {
+                    "finish_reason": "error",
+                    "error": f"adapter {req.adapter!r} was unloaded before "
+                             "this request could be admitted",
+                }))
+                return
+            adapter_idx = self._lora_names[req.adapter]
         n = len(req.prompt_tokens)
         t0 = time.time()
         last_logits = self._prefill_chunks(
@@ -1248,6 +1429,13 @@ class Engine:
             # slot nor _pending — it must fail too or its client hangs
             self._deferred.events.put(("done", dict(info)))
             self._deferred = None
+        while True:  # pending adapter ops must error out, not time out
+            try:
+                op = self._admin.get_nowait()
+            except queue.Empty:
+                break
+            op.error = f"engine failed: {info['error']}"
+            op.done.set()
         while True:
             try:
                 h = self._pending.get_nowait()
@@ -1263,6 +1451,15 @@ class Engine:
         ``on_decision``, which receives every state-advancing decision
         (("admit", request) / ("sweep",)) BEFORE it executes, so followers
         can replay the identical stream."""
+        # adapter load/unload ops run here — between sweeps, on this
+        # thread — so the bank/registry never changes under a dispatch
+        while True:
+            try:
+                op = self._admin.get_nowait()
+            except queue.Empty:
+                break
+            op.run()
+
         admitted = False
         while self._free:
             if self.paged and self._deferred is not None:

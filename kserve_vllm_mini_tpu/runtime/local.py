@@ -82,6 +82,7 @@ def start_local_server(
         lora_adapters=profile.get("lora"),
         lora_demo=int(profile.get("lora_demo", 0)),
         lora_rank=int(profile.get("lora_rank", 8)),
+        lora_slots=int(profile.get("lora_slots", 4)),
     )
     engine.start()
     app = make_app(engine, tok, name)

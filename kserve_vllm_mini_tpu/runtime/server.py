@@ -55,6 +55,7 @@ def build_engine(
     lora_adapters: Optional[dict[str, str]] = None,  # name -> PEFT dir
     lora_demo: int = 0,       # N random adapters "demo-1..N" (bench/testing)
     lora_rank: int = 8,       # rank for the demo bank (PEFT dirs carry theirs)
+    lora_slots: int = 4,      # runtime-load bank capacity (load_adapter)
 ) -> tuple[Engine, Tokenizer, str]:
     """Construct (engine, tokenizer, model_name) from a preset or checkpoint.
 
@@ -228,6 +229,7 @@ def build_engine(
         kv_layout=kv_layout,
         kv_block_size=kv_block_size,
         kv_pool_blocks=kv_pool_blocks,
+        lora_slots=lora_slots,
     )
     engine = Engine(
         params, cfg, ecfg, mesh=mesh, pad_id=tok.pad_id, drafter=drafter_pair,
@@ -511,6 +513,9 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
         handle = engine.submit(req)
         rid = f"chatcmpl-{uuid.uuid4().hex[:20]}"
         created = int(time.time())
+        # OpenAI semantics: echo the served model — the adapter name when
+        # the request was routed to one, else the base
+        resp_model = adapter or model_name
         loop = asyncio.get_running_loop()
 
         async def next_event():
@@ -560,7 +565,7 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
                     "id": rid,
                     "object": "chat.completion",
                     "created": created,
-                    "model": model_name,
+                    "model": resp_model,
                     "choices": [choice],
                     "usage": {
                         "prompt_tokens": len(prompt_ids),
@@ -612,7 +617,7 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
                         if not sent_first:
                             ttft_evt = {
                                 "id": rid, "object": "chat.completion.chunk",
-                                "created": created, "model": model_name,
+                                "created": created, "model": resp_model,
                                 "choices": [{"index": 0, "delta": {},
                                              "finish_reason": None}],
                                 "metrics": {"server_ttft_ms": handle.server_ttft_ms},
@@ -635,7 +640,7 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
                         "id": rid,
                         "object": "chat.completion.chunk",
                         "created": created,
-                        "model": model_name,
+                        "model": resp_model,
                         "choices": [chunk_choice],
                     }
                     if not sent_first:
@@ -655,7 +660,7 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
                         "id": rid,
                         "object": "chat.completion.chunk",
                         "created": created,
-                        "model": model_name,
+                        "model": resp_model,
                         "choices": [
                             {"index": 0, "delta": final_delta,
                              "finish_reason": finish}
@@ -806,9 +811,67 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
             ]
         return web.Response(text="\n".join(lines) + "\n", content_type="text/plain")
 
+    async def load_lora(request: "web.Request"):
+        # vLLM dynamic-LoRA surface: {"lora_name": ..., "lora_path": <PEFT dir>}
+        try:
+            body = await request.json()
+        except Exception:
+            return web.json_response({"error": {"message": "invalid JSON"}},
+                                     status=400)
+        name = body.get("lora_name")
+        path = body.get("lora_path")
+        if not name or not path:
+            return web.json_response(
+                {"error": {"message": "lora_name and lora_path are required"}},
+                status=400,
+            )
+        from kserve_vllm_mini_tpu.ops.lora import LORA_TARGETS_ALL, load_peft_adapter
+
+        loop = asyncio.get_running_loop()
+        try:
+            # file IO + host->device transfer + the blocking scheduler-op
+            # wait all leave the event loop (like the chat path) — a slow
+            # load must not freeze in-flight streams or /healthz
+            adapter = await loop.run_in_executor(
+                None,
+                lambda: load_peft_adapter(path, engine.cfg,
+                                          targets=LORA_TARGETS_ALL),
+            )
+        except Exception as e:  # noqa: BLE001 — surfaced to the caller
+            return web.json_response(
+                {"error": {"message": f"loading {path!r}: {e}"}}, status=400
+            )
+        err = await loop.run_in_executor(
+            None, lambda: engine.load_adapter(name, adapter)
+        )
+        if err:
+            return web.json_response({"error": {"message": err}}, status=409)
+        return web.json_response({"status": "ok", "loaded": name})
+
+    async def unload_lora(request: "web.Request"):
+        try:
+            body = await request.json()
+        except Exception:
+            return web.json_response({"error": {"message": "invalid JSON"}},
+                                     status=400)
+        name = body.get("lora_name")
+        if not name:
+            return web.json_response(
+                {"error": {"message": "lora_name is required"}}, status=400
+            )
+        err = await asyncio.get_running_loop().run_in_executor(
+            None, lambda: engine.unload_adapter(name)
+        )
+        if err:
+            status = 404 if "unknown adapter" in err else 409
+            return web.json_response({"error": {"message": err}}, status=status)
+        return web.json_response({"status": "ok", "unloaded": name})
+
     app = web.Application()
     app.router.add_post("/v1/chat/completions", chat)
     app.router.add_get("/v1/models", models)
+    app.router.add_post("/v1/load_lora_adapter", load_lora)
+    app.router.add_post("/v1/unload_lora_adapter", unload_lora)
     app.router.add_get("/healthz", healthz)
     app.router.add_get("/metrics", metrics)
     app.router.add_post("/profile", profile)
@@ -884,6 +947,10 @@ def register(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--lora-rank", type=int, default=8,
                         help="Rank of the --lora-demo bank (PEFT adapters "
                              "carry their own rank)")
+    parser.add_argument("--lora-slots", type=int, default=4,
+                        help="Adapter-bank capacity for adapters loaded at "
+                             "RUNTIME (/v1/load_lora_adapter) on an engine "
+                             "that started without any --lora")
     parser.add_argument("--prefix-cache", action="store_true",
                         help="Automatic prefix caching: finished requests "
                              "retain their KV and new prompts sharing a "
@@ -1023,6 +1090,7 @@ def run(args: argparse.Namespace) -> int:
         lora_adapters=_parse_lora_args(args.lora),
         lora_demo=args.lora_demo,
         lora_rank=args.lora_rank,
+        lora_slots=args.lora_slots,
     )
 
     if multihost:

@@ -221,6 +221,148 @@ def test_mixed_rank_adapters_rejected(tmp_path, params):
                      lora_adapters={"a": str(d8), "b": str(d16)})
 
 
+def test_live_adapter_load_unload(params, tmp_path):
+    """Hot-swap on a bank-less engine: first load creates the bank, the
+    adapter serves immediately, unload frees the slot for a new name, and
+    the bank-full case errors with the capacity."""
+    _write_peft_dir(str(tmp_path / "a"), CFG, rank=4, seed=11)
+    adapter_a = load_peft_adapter(str(tmp_path / "a"), CFG)
+    _write_peft_dir(str(tmp_path / "b"), CFG, rank=4, seed=22)
+    adapter_b = load_peft_adapter(str(tmp_path / "b"), CFG)
+
+    eng = Engine(params, CFG,
+                 EngineConfig(max_slots=2, max_seq_len=64, lora_slots=1))
+    eng.start()
+    try:
+        base = None
+        # base request before any adapter exists
+        hs = eng.submit(_req([1, 2, 3]))
+        base = _drain_tokens(hs)
+
+        assert eng.load_adapter("tune-a", adapter_a) is None
+        out_a = _drain_tokens(eng.submit(_req([1, 2, 3], "tune-a")))
+        assert out_a != base
+
+        # capacity 1: a second NAME must be refused while tune-a is loaded
+        err = eng.load_adapter("tune-b", adapter_b)
+        assert err is not None and "full" in err
+
+        assert eng.unload_adapter("tune-a") is None
+        err = eng.unload_adapter("tune-a")
+        assert err is not None and "unknown adapter" in err
+        # freed slot serves the new adapter — NOT tune-a's stale weights
+        # and not the base: the reused index must carry only tune-b
+        assert eng.load_adapter("tune-b", adapter_b) is None
+        out_b = _drain_tokens(eng.submit(_req([1, 2, 3], "tune-b")))
+        assert out_b != out_a and out_b != base
+        # base path still bit-identical after all the swapping
+        assert _drain_tokens(eng.submit(_req([1, 2, 3]))) == base
+    finally:
+        eng.stop()
+
+
+def _drain_tokens(h):
+    toks = []
+    while True:
+        ev = h.events.get(timeout=60)
+        if ev[0] == "token":
+            toks.append(ev[1])
+        elif ev[0] == "done":
+            assert ev[1].get("finish_reason") != "error", ev
+            return toks
+
+
+def test_unload_refused_while_requests_queued(params, bank):
+    """A pending (not yet admitted) request must pin its adapter: unloading
+    it would silently serve the base model at admission."""
+    eng = Engine(params, CFG, EngineConfig(max_slots=2, max_seq_len=64),
+                 lora=bank)
+    eng.submit(_req([1, 2, 3], "fin-tune"))  # sits in _pending (not started)
+    err = eng.unload_adapter("fin-tune")
+    assert err is not None and "queued requests" in err
+
+
+def test_bank_index_reuse_zeroes_stale_targets(params):
+    """Reusing a freed bank index with an adapter covering FEWER targets
+    must not leave the previous occupant's factors in the others."""
+    import numpy as np
+
+    L, D, r = CFG.n_layers, CFG.d_model, 4
+    up = CFG.d_ff
+    h = CFG.n_heads * CFG.head_dim
+    rng = np.random.default_rng(9)
+
+    def factors(din, dout):
+        return (jnp.asarray(rng.normal(size=(L, din, r)).astype(np.float32)),
+                jnp.asarray(rng.normal(size=(L, r, dout)).astype(np.float32)))
+
+    full = {"wq": factors(D, h), "w_up": factors(D, up)}
+    attn_only = {"wq": factors(D, h)}
+
+    eng = Engine(params, CFG,
+                 EngineConfig(max_slots=2, max_seq_len=64, lora_slots=1))
+    assert eng.load_adapter("full", full) is None
+    idx = eng._lora_names["full"]
+    assert float(jnp.abs(eng._lora["layers"]["w_up_A"][:, idx]).sum()) > 0
+    assert eng.unload_adapter("full") is None
+    assert eng.load_adapter("attn", attn_only) is None
+    idx2 = eng._lora_names["attn"]
+    assert idx2 == idx  # the freed index was reused
+    assert float(jnp.abs(eng._lora["layers"]["w_up_A"][:, idx2]).sum()) == 0.0
+    assert float(jnp.abs(eng._lora["layers"]["wq_A"][:, idx2]).sum()) > 0
+
+
+def test_live_lora_http_endpoints(params, tmp_path):
+    """The vLLM-style dynamic endpoints: load -> listed + servable,
+    unload -> 404 on reuse, bad path -> 400."""
+    import asyncio
+
+    from kserve_vllm_mini_tpu.runtime.server import make_app
+    from kserve_vllm_mini_tpu.runtime.tokenizer import load_tokenizer
+
+    _write_peft_dir(str(tmp_path / "a"), CFG, rank=4, seed=33)
+    tok = load_tokenizer(None)
+    eng = Engine(params, CFG, EngineConfig(max_slots=2, max_seq_len=64))
+    eng.start()
+    try:
+        app = make_app(eng, tok, "llama-tiny")
+
+        async def drive():
+            from aiohttp.test_utils import TestClient, TestServer
+
+            async with TestClient(TestServer(app)) as client:
+                r = await client.post("/v1/load_lora_adapter", json={
+                    "lora_name": "hot", "lora_path": str(tmp_path / "a"),
+                })
+                assert r.status == 200, await r.text()
+                r = await client.get("/v1/models")
+                ids = [m["id"] for m in (await r.json())["data"]]
+                assert "hot" in ids
+
+                r = await client.post("/v1/chat/completions", json={
+                    "model": "hot",
+                    "messages": [{"role": "user", "content": "hi"}],
+                    "max_tokens": 4,
+                })
+                assert r.status == 200
+
+                r = await client.post("/v1/unload_lora_adapter",
+                                      json={"lora_name": "hot"})
+                assert r.status == 200
+                r = await client.post("/v1/unload_lora_adapter",
+                                      json={"lora_name": "hot"})
+                assert r.status == 404
+
+                r = await client.post("/v1/load_lora_adapter", json={
+                    "lora_name": "x", "lora_path": "/does/not/exist",
+                })
+                assert r.status == 400
+
+        asyncio.run(drive())
+    finally:
+        eng.stop()
+
+
 def test_server_routes_model_field(params, bank):
     """The HTTP layer maps 'model' to adapters, 404s unknown names, and
     lists adapters on /v1/models."""
