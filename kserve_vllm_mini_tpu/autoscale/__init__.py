@@ -1,0 +1,6 @@
+from kserve_vllm_mini_tpu.autoscale.controller import (  # noqa: F401
+    Controller,
+    PolicyConfig,
+    Signals,
+    desired_replicas,
+)

@@ -1,0 +1,356 @@
+"""SLO-signal-driven autoscaling controller.
+
+The reference MEASURES Knative's autoscaler from outside (its autoscale
+sweep tunes minScale/maxScale/containerConcurrency knobs and records cold
+starts — sweeps/autoscale-sweep.sh:25-163); this module closes the loop
+the harness already instruments: the runtime's own /metrics signals
+(duty cycle, queue depth — runtime/server.py) plus the SLO gate's verdict
+(gates/slo.py) drive replica counts directly.
+
+Design (HPA-style target tracking, simplified to what the signals
+support):
+
+- **scale up** when duty cycle exceeds its target (the engine is
+  saturated) or queued requests per replica exceed their target (work is
+  waiting) — desired = ceil(current x signal / target), the standard
+  proportional rule; an SLO breach (p95 / TTFT / error-rate over budget)
+  forces at least one step up immediately.
+- **scale down** only when duty sits under a low watermark AND every
+  desired value across the stabilization window agrees — the max of the
+  window wins (Kubernetes HPA's downscale stabilization), so one quiet
+  poll can't shed replicas a burst will need back (cold starts on TPU
+  pools are minutes, docs/TOPOLOGY.md; flapping is far more expensive
+  than holding a replica).
+- **actuation** is pluggable: a KServe patch through deploy.Kubectl
+  (min/max replica fields + Knative min-scale annotation), or dry-run
+  recording. Every decision lands in a JSONL log the report layer can
+  plot against the load timeline.
+
+The policy core is a pure function (``desired_replicas``) so the whole
+behavior matrix is unit-testable without a cluster or clock.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import time
+import urllib.request
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Any, Callable, Optional
+
+
+@dataclass
+class PolicyConfig:
+    min_replicas: int = 1
+    max_replicas: int = 8
+    # duty cycle the fleet should sit at; above it the engines are compute-
+    # saturated and latency grows with queue depth
+    target_duty: float = 0.75
+    # queued requests per replica the fleet may carry before adding one
+    target_queue_per_replica: float = 4.0
+    # below this duty the fleet is idle enough to consider shrinking
+    scale_down_duty: float = 0.30
+    # downscale stabilization: shrink only to the MAX desired seen over
+    # this window (HPA semantics)
+    stabilization_s: float = 120.0
+    # never add more than this many replicas in one step (TPU pools
+    # provision slowly; a huge jump mostly buys pending pods)
+    max_step_up: int = 4
+
+
+@dataclass
+class Signals:
+    """One poll of the fleet's state, already aggregated across replicas."""
+
+    duty_cycle: float = 0.0        # mean across replicas, 0..1
+    queue_depth: float = 0.0       # total queued requests
+    slo_breached: bool = False     # gate verdict on the latest results
+    ts: float = 0.0
+    # False when the poll produced no data (endpoint down / pod churn):
+    # the controller HOLDS the current count — zero-signals must not be
+    # read as "idle" and shed the capacity a restarting fleet needs
+    valid: bool = True
+
+
+def desired_replicas(current: int, sig: Signals, cfg: PolicyConfig) -> int:
+    """Pure target-tracking policy: what the fleet should run RIGHT NOW
+    given one signal sample (stabilization is the controller's job)."""
+    want = current
+    if sig.duty_cycle > cfg.target_duty:
+        want = max(want, math.ceil(current * sig.duty_cycle / cfg.target_duty))
+    queue_per = sig.queue_depth / max(current, 1)
+    if queue_per > cfg.target_queue_per_replica:
+        want = max(
+            want,
+            math.ceil(current * queue_per / cfg.target_queue_per_replica),
+        )
+    if sig.slo_breached:
+        want = max(want, current + 1)
+    if (
+        want <= current
+        and sig.duty_cycle < cfg.scale_down_duty
+        and sig.queue_depth == 0
+        and not sig.slo_breached
+    ):
+        # idle: propose proportional shrink, floored so one replica of
+        # headroom always remains ahead of the next request
+        want = min(
+            want,
+            max(math.ceil(current * sig.duty_cycle / cfg.target_duty), 1),
+        )
+    want = max(cfg.min_replicas, min(cfg.max_replicas, want))
+    if want > current:
+        want = min(want, current + cfg.max_step_up)
+    return want
+
+
+def metrics_signals(url: str, timeout_s: float = 5.0) -> Signals:
+    """Read one replica's /metrics into Signals via the telemetry layer's
+    exposition parser (labels/timestamps handled; fetch errors yield an
+    empty dict, i.e. a zero-signal sample the policy treats as idle). For
+    a multi-replica fleet behind one Service this samples whichever
+    replica answers — duty is representative under round-robin; queue
+    depth is that replica's share (scaled up by the caller if it knows
+    the count)."""
+    from kserve_vllm_mini_tpu.analysis.telemetry import scrape_runtime_metrics
+
+    vals = scrape_runtime_metrics(url, timeout_s=timeout_s)
+    return Signals(
+        duty_cycle=vals.get("kvmini_tpu_duty_cycle", 0.0),
+        queue_depth=vals.get("kvmini_tpu_queue_depth", 0.0),
+        ts=time.time(),
+        valid=bool(vals),
+    )
+
+
+def slo_breach(results: dict[str, Any], slo_path: Optional[str] = None) -> bool:
+    """True when the SLO gate fails a MEASURED budget. Metrics missing from
+    the snapshot fail the CI gate (gates/slo.py — absence of evidence is a
+    red build) but must not drive scaling: a partial results.json would
+    otherwise force a step up on every poll."""
+    from kserve_vllm_mini_tpu.gates.slo import gate_results, load_slo
+
+    budgets = load_slo(slo_path)
+    return any(
+        not v.ok and v.value is not None for v in gate_results(results, budgets)
+    )
+
+
+class Controller:
+    """Polls signals, applies the policy with downscale stabilization, and
+    actuates through a pluggable scaler.
+
+    ``scaler(replicas) -> None`` applies the count (KServe patch, or a
+    recorder in dry runs); ``signal_fn() -> Signals`` supplies each poll.
+    """
+
+    def __init__(
+        self,
+        signal_fn: Callable[[], Signals],
+        scaler: Callable[[int], None],
+        cfg: Optional[PolicyConfig] = None,
+        initial_replicas: int = 1,
+        decision_log: Optional[Path] = None,
+        now_fn: Callable[[], float] = time.time,
+    ) -> None:
+        self.cfg = cfg or PolicyConfig()
+        self.signal_fn = signal_fn
+        self.scaler = scaler
+        self.replicas = initial_replicas
+        self.decision_log = Path(decision_log) if decision_log else None
+        self.now_fn = now_fn
+        # (ts, desired) samples inside the stabilization window — seeded
+        # with the initial count so the first quiet poll can't shed
+        # capacity the controller has no history about
+        self._window: list[tuple[float, int]] = [(self.now_fn(), initial_replicas)]
+        self.decisions: list[dict[str, Any]] = []
+
+    def step(self) -> int:
+        """One control iteration; returns the (possibly new) replica count."""
+        try:
+            sig = self.signal_fn()
+        except Exception as e:  # noqa: BLE001 — the loop must outlive blips
+            sig = Signals(ts=self.now_fn(), valid=False)
+            sig_err = f"{type(e).__name__}: {e}"
+        else:
+            sig_err = None
+        now = self.now_fn()
+        if not sig.valid:
+            decision = {
+                "ts": now, "current": self.replicas,
+                "applied": self.replicas,
+                "note": f"no signal ({sig_err or 'empty scrape'}); holding",
+            }
+            self.decisions.append(decision)
+            if self.decision_log:
+                with self.decision_log.open("a") as f:
+                    f.write(json.dumps(decision) + "\n")
+            return self.replicas
+        raw = desired_replicas(self.replicas, sig, self.cfg)
+        self._window.append((now, raw))
+        cutoff = now - self.cfg.stabilization_s
+        self._window = [(t, d) for t, d in self._window if t >= cutoff]
+        if raw < self.replicas:
+            # downscale stabilization: the max desired over the window wins
+            target = max(d for _, d in self._window)
+            target = min(target, self.replicas)  # never scale UP from here
+        else:
+            target = raw
+        decision = {
+            "ts": now,
+            "duty": round(sig.duty_cycle, 4),
+            "queue": sig.queue_depth,
+            "slo_breached": sig.slo_breached,
+            "current": self.replicas,
+            "raw_desired": raw,
+            "applied": target,
+        }
+        self.decisions.append(decision)
+        if self.decision_log:
+            with self.decision_log.open("a") as f:
+                f.write(json.dumps(decision) + "\n")
+        if target != self.replicas:
+            self.scaler(target)
+            self.replicas = target
+        return self.replicas
+
+    def run(self, interval_s: float = 15.0, max_iterations: int = 0) -> None:
+        i = 0
+        while True:
+            try:
+                self.step()
+            except Exception as e:  # noqa: BLE001 — an autoscaler that dies
+                # on one bad poll/patch stops scaling exactly when pod churn
+                # makes polls flaky; log and keep the loop alive
+                print(f"autoscale: step failed ({type(e).__name__}: {e}); "
+                      "continuing")
+            i += 1
+            if max_iterations and i >= max_iterations:
+                return
+            time.sleep(interval_s)
+
+
+def kserve_scaler(
+    name: str,
+    namespace: str,
+    kubectl=None,
+    max_replicas: int = 8,
+) -> Callable[[int], None]:
+    """Scaler that patches a KServe InferenceService's replica window and
+    Knative min-scale annotation (the knobs the autoscale sweep tunes;
+    deploy/manifests.py writes the same fields). ``maxReplicas`` is pinned
+    to the POLICY ceiling, not the step's desired count — Knative keeps
+    burst headroom above the controller's floor even if the controller
+    later dies."""
+    from kserve_vllm_mini_tpu.deploy.kubectl import Kubectl
+
+    kc = kubectl or Kubectl()
+
+    def scale(replicas: int) -> None:
+        patch = {
+            "metadata": {"annotations": {
+                "autoscaling.knative.dev/min-scale": str(replicas),
+            }},
+            "spec": {"predictor": {
+                "minReplicas": replicas,
+                "maxReplicas": max(max_replicas, replicas, 1),
+            }},
+        }
+        res = kc.run([
+            "patch", "inferenceservice", name,
+            "-n", namespace, "--type=merge",
+            "-p", json.dumps(patch),
+        ])
+        if not res.ok:
+            raise RuntimeError(
+                f"kubectl patch failed rc={res.returncode}: {res.stderr[-500:]}"
+            )
+
+    return scale
+
+
+# -- CLI ---------------------------------------------------------------------
+
+def register(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument("--url", required=True,
+                        help="Runtime base URL whose /metrics drives the loop")
+    parser.add_argument("--service", default=None,
+                        help="InferenceService to scale (omit with --dry-run)")
+    parser.add_argument("--namespace", default="default")
+    parser.add_argument("--min", type=int, default=1)
+    parser.add_argument("--max", type=int, default=8)
+    parser.add_argument("--target-duty", type=float, default=0.75)
+    parser.add_argument("--target-queue", type=float, default=4.0)
+    parser.add_argument("--scale-down-duty", type=float, default=0.30)
+    parser.add_argument("--stabilization", type=float, default=120.0)
+    parser.add_argument("--interval", type=float, default=15.0)
+    parser.add_argument("--iterations", type=int, default=0,
+                        help="Stop after N control steps (0 = run forever)")
+    parser.add_argument("--initial-replicas", type=int, default=1)
+    parser.add_argument("--results", default=None,
+                        help="results.json to gate each step (SLO breach "
+                             "forces a step up)")
+    parser.add_argument("--results-max-age", type=float, default=600.0,
+                        help="Ignore --results older than this many seconds "
+                             "(a stale breached snapshot would ratchet the "
+                             "fleet to max and pin it there)")
+    parser.add_argument("--slo", default=None, help="SLO budgets JSON")
+    parser.add_argument("--decision-log", default=None,
+                        help="JSONL decision log (default: stdout only)")
+    parser.add_argument("--dry-run", action="store_true",
+                        help="Record decisions without patching anything")
+
+
+def run(args: argparse.Namespace) -> int:
+    cfg = PolicyConfig(
+        min_replicas=args.min,
+        max_replicas=args.max,
+        target_duty=args.target_duty,
+        target_queue_per_replica=args.target_queue,
+        scale_down_duty=args.scale_down_duty,
+        stabilization_s=args.stabilization,
+    )
+
+    def signal_fn() -> Signals:
+        sig = metrics_signals(args.url)
+        if args.results:
+            try:
+                p = Path(args.results)
+                fresh = (time.time() - p.stat().st_mtime) <= args.results_max_age
+                if fresh:
+                    sig.slo_breached = slo_breach(
+                        json.loads(p.read_text()), args.slo
+                    )
+            except Exception:  # noqa: BLE001 — a torn mid-rewrite snapshot
+                # or missing file must not kill (or drive) the loop
+                pass
+        return sig
+
+    if args.dry_run or not args.service:
+        def scaler(n: int) -> None:
+            print(f"autoscale: would scale to {n} replicas (dry run)")
+    else:
+        scaler = kserve_scaler(args.service, args.namespace,
+                               max_replicas=cfg.max_replicas)
+
+    ctl = Controller(
+        signal_fn, scaler, cfg,
+        initial_replicas=args.initial_replicas,
+        decision_log=args.decision_log,
+    )
+    print(
+        f"autoscale-controller: url={args.url} "
+        f"replicas {cfg.min_replicas}..{cfg.max_replicas} "
+        f"duty<={cfg.target_duty} queue/replica<={cfg.target_queue_per_replica}"
+    )
+    try:
+        ctl.run(interval_s=args.interval, max_iterations=args.iterations)
+    except KeyboardInterrupt:
+        pass
+    last = ctl.decisions[-1] if ctl.decisions else {}
+    print(f"autoscale-controller: final replicas={ctl.replicas} "
+          f"(last decision: {json.dumps(last)})")
+    return 0

@@ -51,6 +51,8 @@ _SUBCOMMANDS: dict[str, tuple[str, str]] = {
     "compile-sweep": ("kserve_vllm_mini_tpu.sweeps.compile_perf", "AOT compile-time vs serving-perf tradeoff"),
     "chaos": ("kserve_vllm_mini_tpu.chaos.harness", "Fault injection + MTTR measurement"),
     "profile": ("kserve_vllm_mini_tpu.runtime.profiler", "Capture a TensorBoard trace of a live runtime"),
+    "autoscale-controller": ("kserve_vllm_mini_tpu.autoscale.controller",
+                             "SLO/duty-signal-driven replica controller"),
 }
 
 

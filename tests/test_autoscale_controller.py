@@ -1,0 +1,220 @@
+"""SLO/duty-driven autoscale controller (autoscale/controller.py): the
+policy is a pure function, the loop adds downscale stabilization, and
+actuation goes through the injectable Kubectl — everything testable
+without a cluster or a clock (reference analog: the knobs its autoscale
+sweep tunes from outside, sweeps/autoscale-sweep.sh:25-163)."""
+
+import json
+
+from kserve_vllm_mini_tpu.autoscale.controller import (
+    Controller,
+    PolicyConfig,
+    Signals,
+    desired_replicas,
+    kserve_scaler,
+    metrics_signals,
+    slo_breach,
+)
+
+CFG = PolicyConfig(min_replicas=1, max_replicas=8, target_duty=0.75,
+                   target_queue_per_replica=4.0, scale_down_duty=0.30,
+                   stabilization_s=100.0, max_step_up=4)
+
+
+# -- pure policy ------------------------------------------------------------
+
+def test_steady_state_holds():
+    assert desired_replicas(3, Signals(duty_cycle=0.6, queue_depth=2), CFG) == 3
+
+
+def test_duty_saturation_scales_proportionally():
+    # 2 replicas at duty 0.95 -> ceil(2 * 0.95/0.75) = 3
+    assert desired_replicas(2, Signals(duty_cycle=0.95), CFG) == 3
+
+
+def test_queue_pressure_scales():
+    # 2 replicas, 20 queued -> 10/replica vs target 4 -> ceil(2*10/4) = 5
+    assert desired_replicas(2, Signals(duty_cycle=0.5, queue_depth=20), CFG) == 5
+
+
+def test_slo_breach_forces_step_up():
+    assert desired_replicas(
+        2, Signals(duty_cycle=0.4, queue_depth=0, slo_breached=True), CFG
+    ) == 3
+
+
+def test_max_step_up_limits_jump():
+    # 1 replica, huge queue: raw ceil(1*64/4)=16, clamped to 1+4 then max 8
+    got = desired_replicas(1, Signals(duty_cycle=0.5, queue_depth=64), CFG)
+    assert got == 1 + CFG.max_step_up
+
+
+def test_idle_scales_down_to_floor():
+    got = desired_replicas(4, Signals(duty_cycle=0.05, queue_depth=0), CFG)
+    assert got == 1
+    # but never below min_replicas
+    cfg2 = PolicyConfig(min_replicas=2)
+    assert desired_replicas(4, Signals(duty_cycle=0.0), cfg2) == 2
+
+
+def test_no_scale_down_while_queue_nonempty():
+    got = desired_replicas(4, Signals(duty_cycle=0.1, queue_depth=1), CFG)
+    assert got == 4
+
+
+def test_clamped_to_max():
+    cfg = PolicyConfig(max_replicas=4, max_step_up=10)
+    assert desired_replicas(3, Signals(duty_cycle=3.0), cfg) == 4
+
+
+# -- controller loop --------------------------------------------------------
+
+def _controller(signals, cfg=CFG, initial=4, log=None):
+    """Controller over a scripted signal list and a fake clock (10 s per
+    step)."""
+    it = iter(signals)
+    clock = {"t": 1000.0}
+
+    def now():
+        clock["t"] += 10.0
+        return clock["t"]
+
+    applied = []
+    ctl = Controller(lambda: next(it), applied.append, cfg,
+                     initial_replicas=initial, decision_log=log, now_fn=now)
+    return ctl, applied
+
+
+def test_downscale_stabilization_holds_burst_capacity():
+    """After a burst, quiet polls inside the window must NOT shed replicas;
+    once the window forgets the burst, the shrink applies."""
+    burst = Signals(duty_cycle=0.95)          # raw from 4: ceil(4*.95/.75)=6
+    quiet = Signals(duty_cycle=0.05)          # raw desired -> 1
+    cfg = PolicyConfig(stabilization_s=35.0, max_step_up=4)
+    ctl, applied = _controller([burst] + [quiet] * 6, cfg)
+    assert ctl.step() == 6                    # burst scales up immediately
+    assert ctl.step() == 6                    # quiet, but window holds 6
+    assert ctl.step() == 6
+    assert ctl.step() == 6                    # burst sample still in window
+    # burst sample ages out -> only quiet desires remain -> shrink
+    assert ctl.step() == 1
+    assert applied == [6, 1]
+
+
+def test_upscale_is_immediate_not_stabilized():
+    ctl, applied = _controller(
+        [Signals(duty_cycle=0.2), Signals(duty_cycle=1.5)], initial=2
+    )
+    assert ctl.step() == 2                    # window holds initial desire
+    assert ctl.step() == 4                    # ceil(2*1.5/0.75) up instantly
+    assert applied == [4]
+
+
+def test_decision_log_written(tmp_path):
+    log = tmp_path / "decisions.jsonl"
+    ctl, _ = _controller([Signals(duty_cycle=0.9)], initial=2, log=log)
+    ctl.step()
+    rows = [json.loads(x) for x in log.read_text().splitlines()]
+    assert rows[0]["current"] == 2 and rows[0]["applied"] == 3
+    assert "duty" in rows[0] and "ts" in rows[0]
+
+
+# -- actuation / signals ----------------------------------------------------
+
+def test_invalid_signal_holds_capacity():
+    """A failed/empty scrape (pod churn) must HOLD the count, not read
+    zero duty as idle and shed the replicas a restarting fleet needs."""
+    sigs = [Signals(duty_cycle=0.9),          # scale 2 -> 3
+            Signals(valid=False),             # outage: hold
+            Signals(duty_cycle=0.85)]         # back: normal tracking
+    ctl, applied = _controller(sigs, initial=2)
+    assert ctl.step() == 3
+    assert ctl.step() == 3
+    note = ctl.decisions[-1].get("note", "")
+    assert "no signal" in note
+    assert ctl.step() == 4  # ceil(3*0.85/0.75)
+
+
+def test_signal_fn_exception_holds_capacity():
+    def boom():
+        raise OSError("connection refused")
+
+    clock = {"t": 0.0}
+
+    def now():
+        clock["t"] += 10.0
+        return clock["t"]
+
+    ctl = Controller(boom, lambda n: None, CFG, initial_replicas=3, now_fn=now)
+    assert ctl.step() == 3
+    assert "no signal" in ctl.decisions[-1]["note"]
+
+
+def test_kserve_scaler_patches_isvc():
+    from kserve_vllm_mini_tpu.deploy.kubectl import Kubectl, KubectlResult
+
+    calls = []
+
+    def fake_runner(args, stdin_text=None, timeout_s=60.0):
+        calls.append(list(args))
+        return KubectlResult(ok=True, stdout="patched", returncode=0)
+
+    scale = kserve_scaler("demo-llm", "prod", kubectl=Kubectl(fake_runner),
+                          max_replicas=8)
+    scale(3)
+    args = calls[0]
+    assert args[:3] == ["patch", "inferenceservice", "demo-llm"]
+    patch = json.loads(args[args.index("-p") + 1])
+    assert patch["spec"]["predictor"]["minReplicas"] == 3
+    # the ceiling is the POLICY max, not the step's desired count — the
+    # burst window above the floor must survive every patch
+    assert patch["spec"]["predictor"]["maxReplicas"] == 8
+    assert patch["metadata"]["annotations"][
+        "autoscaling.knative.dev/min-scale"] == "3"
+
+
+def test_kserve_scaler_raises_on_failure():
+    import pytest
+
+    from kserve_vllm_mini_tpu.deploy.kubectl import Kubectl, KubectlResult
+
+    scale = kserve_scaler(
+        "x", "ns",
+        kubectl=Kubectl(
+            lambda a, s=None, t=60.0: KubectlResult(
+                ok=False, stderr="forbidden", returncode=1
+            )
+        ),
+    )
+    with pytest.raises(RuntimeError, match="forbidden"):
+        scale(2)
+
+
+def test_metrics_signals_parses_prometheus_text(monkeypatch):
+    import io
+    import urllib.request
+
+    text = (
+        "# TYPE kvmini_tpu_duty_cycle gauge\n"
+        "kvmini_tpu_duty_cycle 0.8125\n"
+        "kvmini_tpu_queue_depth 7\n"
+    )
+
+    class Resp(io.BytesIO):
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *a):
+            return False
+
+    monkeypatch.setattr(urllib.request, "urlopen",
+                        lambda url, timeout: Resp(text.encode()))
+    sig = metrics_signals("http://x:1234")
+    assert sig.duty_cycle == 0.8125 and sig.queue_depth == 7
+
+
+def test_slo_breach_uses_gate():
+    good = {"p95_ms": 100.0, "error_rate": 0.0}
+    bad = {"p95_ms": 10_000_000.0, "error_rate": 0.0}
+    assert not slo_breach(good)
+    assert slo_breach(bad)
