@@ -58,6 +58,24 @@ def analyze_run(
         if k in update["token_timing"]:
             update[k] = update["token_timing"][k]
 
+    # per-model breakdown: a multi-LoRA run rotates requests across
+    # adapters (loadgen `models:` list; requests.csv model column) — the
+    # aggregate alone would hide a slow adapter behind a fast base
+    by_model: dict[str, list] = {}
+    for r in records:
+        if r.model:
+            by_model.setdefault(r.model, []).append(r)
+    if len(by_model) > 1:
+        update["per_model"] = {
+            name: {
+                k: v
+                for k, v in compute_latency_stats(rs).items()
+                if k in ("requests", "p50_ms", "p95_ms", "ttft_p50_ms",
+                         "ttft_p95_ms", "tokens_per_sec", "error_rate")
+            }
+            for name, rs in sorted(by_model.items())
+        }
+
     # cold/warm: explicit instants > cluster pod introspection > none
     instants = list(cold_start_times or [])
     if not instants and namespace and service:

@@ -126,6 +126,7 @@ class RequestRecord:
             server_ttft_ms=_f("server_ttft_ms"),
             truncated=row.get("truncated", "0") in ("1", "true", "True"),
             truncated_tokens=_i("truncated_tokens"),
+            model=row.get("model", ""),
         )
 
 

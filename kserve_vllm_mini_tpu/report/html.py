@@ -140,6 +140,33 @@ def generate_single_run_html(
         charts.ttft_vs_latency_chart(results),
         "</section>",
     ]
+    pm = results.get("per_model")
+    if pm:
+        # multi-LoRA runs: one row per adapter/model so a slow fine-tune
+        # can't hide behind a fast base in the aggregates
+        def _cell(m: dict, key: str) -> str:
+            # an all-error adapter has NO latency keys (metrics.py omits
+            # them on purpose) — absence must render as "—", never 0.0 ms,
+            # or the broken adapter looks like the fastest row
+            return f"{m[key]:.1f}" if key in m else "—"
+
+        rows = "".join(
+            f"<tr><td>{html_mod.escape(name)}</td>"
+            f"<td>{m.get('requests', 0)}</td>"
+            f"<td>{_cell(m, 'p50_ms')}</td>"
+            f"<td>{_cell(m, 'p95_ms')}</td>"
+            f"<td>{_cell(m, 'ttft_p95_ms')}</td>"
+            f"<td>{_cell(m, 'tokens_per_sec')}</td>"
+            f"<td>{100 * m.get('error_rate', 0):.1f}%</td></tr>"
+            for name, m in pm.items()
+        )
+        sections.append(
+            "<section><h2>Per model / adapter</h2><table>"
+            "<tr><th>model</th><th>requests</th><th>p50 ms</th>"
+            "<th>p95 ms</th><th>TTFT p95 ms</th><th>tok/s</th>"
+            "<th>errors</th></tr>" + rows + "</table></section>"
+        )
+
     cw = charts.cold_warm_chart(results)
     if cw:
         sections.append(f"<section><h2>Cold vs warm</h2>{cw}")

@@ -35,7 +35,35 @@ def test_analyze_graceful_without_cluster(synthetic_run):
     assert results["ttft_p50_ms"] > 0
     assert results["throughput_rps"] > 0
     assert "tpu_duty_cycle_avg" not in results  # no telemetry sources
+    assert "per_model" not in results  # single-model run: no breakdown
     assert synthetic_run.results_json.exists()
+
+
+def test_analyze_per_model_breakdown(tmp_path):
+    """A multi-LoRA run (requests routed across adapters) must expose a
+    per-model latency/error breakdown — the aggregate alone would hide a
+    slow adapter behind a fast base."""
+    from tests.synthetic import make_synthetic_records
+
+    rd = make_synthetic_run(tmp_path)
+    records = make_synthetic_records(n=60, seed=7)
+    names = ["base", "tune-a", "tune-b"]
+    for i, r in enumerate(records):
+        r.model = names[i % 3]
+    rd.write_requests(records)
+    results = analyze_run(rd)
+    pm = results["per_model"]
+    assert sorted(pm) == ["base", "tune-a", "tune-b"]
+    assert sum(m["requests"] for m in pm.values()) == 60
+    for m in pm.values():
+        assert m["p50_ms"] > 0 and "p95_ms" in m and "error_rate" in m
+
+    # the report renders the table
+    from kserve_vllm_mini_tpu.report.html import generate_single_run_html
+
+    html = generate_single_run_html(results, run_dir=rd.path)
+    assert "Per model / adapter" in html
+    assert "tune-a" in html
 
 
 def test_analyze_counts_truncated_requests(tmp_path):
