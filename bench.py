@@ -141,7 +141,22 @@ def _run_bench() -> dict:
     param_bytes = quantized_bytes(params)
     _log(f"params ready ({param_bytes / 1e9:.2f} GB on device)")
 
-    cache = init_kv_cache(cfg, slots, max_seq=max_seq, quantized=kv_quant)
+    # KVMINI_BENCH_PAGED=1: run the same workload through the block-pool
+    # cache + the Pallas paged-decode kernel (ops/paged_attention.py) —
+    # measures the kernel against the dense path at identical geometry.
+    # Contiguous per-slot block ranges (the allocator's common case).
+    paged = os.environ.get("KVMINI_BENCH_PAGED", "") == "1"
+    blk = 64  # paged block size, shared by the batch and TTFT caches
+    block_table = None
+    if paged:
+        from kserve_vllm_mini_tpu.models.llama import init_paged_kv_cache
+
+        maxb = max_seq // blk
+        cache = init_paged_kv_cache(cfg, slots * maxb, blk, quantized=kv_quant)
+        block_table = jnp.arange(slots * maxb, dtype=jnp.int32).reshape(slots, maxb)
+    else:
+        cache = init_kv_cache(cfg, slots, max_seq=max_seq, quantized=kv_quant)
+    tkw = {"block_table": block_table} if paged else {}
     toks = jax.random.randint(jax.random.PRNGKey(1), (slots, prompt_len), 0, cfg.vocab_size)
     pos = jnp.broadcast_to(jnp.arange(prompt_len, dtype=jnp.int32), (slots, prompt_len))
 
@@ -153,17 +168,23 @@ def _run_bench() -> dict:
         last = jnp.full((slots,), prompt_len - 1, dtype=jnp.int32)
         logits, cache = forward(params, cfg, toks, pos, cache,
                                 jnp.zeros((slots,), jnp.int32), fresh_prefill=True,
-                                logit_index=last)
+                                logit_index=last, **tkw)
         return cache, jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
 
     # -- single-request prefill: the per-request TTFT cost ------------------
-    cache1 = init_kv_cache(cfg, 1, max_seq=max_seq, quantized=kv_quant)
+    if paged:
+        cache1 = init_paged_kv_cache(cfg, max_seq // blk, blk, quantized=kv_quant)
+        t1kw = {"block_table": jnp.arange(max_seq // blk, dtype=jnp.int32)[None]}
+    else:
+        cache1 = init_kv_cache(cfg, 1, max_seq=max_seq, quantized=kv_quant)
+        t1kw = {}
     toks1, pos1 = toks[:1], pos[:1]
 
     @jax.jit
     def prefill_one(params, cache, toks, pos):
         logits, cache = forward(params, cfg, toks, pos, cache,
-                                jnp.zeros((1,), jnp.int32), fresh_prefill=True)
+                                jnp.zeros((1,), jnp.int32), fresh_prefill=True,
+                                **t1kw)
         return jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
 
     _log("compiling single-request prefill")
@@ -185,7 +206,7 @@ def _run_bench() -> dict:
     @partial(jax.jit, donate_argnums=(1,))
     def decode(params, cache, tokens, lengths, rng):
         logits, cache = forward(params, cfg, tokens[:, None], lengths[:, None],
-                                cache, lengths)
+                                cache, lengths, **tkw)
         nxt = sample_tokens(
             logits[:, 0, :], rng,
             jnp.zeros((slots,), jnp.float32),
@@ -494,7 +515,8 @@ def _run_bench() -> dict:
     result = {
         "metric": (
             f"decode_tokens_per_sec_per_chip ({cfg.name}, {quant}"
-            f"{'+int8kv' if kv_quant else ''}, slots={slots}, ctx~{prompt_len}+)"
+            f"{'+int8kv' if kv_quant else ''}{', paged' if paged else ''}, "
+            f"slots={slots}, ctx~{prompt_len}+)"
         ),
         "value": round(per_chip, 1),
         "unit": "tokens/s/chip",

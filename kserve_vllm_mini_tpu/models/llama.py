@@ -36,6 +36,11 @@ Params = dict[str, Any]
 # "k_s" is the static flag that selects the quantized cache path)
 KVCache = dict[str, jnp.ndarray]
 
+# Test hook: True/False forces the Pallas paged-decode kernel on/off
+# regardless of backend (None = auto: kernel on TPU, gather oracle
+# elsewhere). See run_cached_layers' use_paged_kernel.
+_FORCE_PAGED_KERNEL: Optional[bool] = None
+
 
 def _stacked_weight_shapes(cfg: ModelConfig) -> dict[str, tuple[int, ...]]:
     """Per-layer shape of every stacked transformer matmul weight (last two
@@ -533,6 +538,22 @@ def run_cached_layers(
         s = block_table.shape[1] * blk        # flattened key axis (abs order)
     else:
         s = kv_cache["k"].shape[3]
+    # Pallas paged decode kernel: table-driven block DMA instead of the
+    # gather copy. TPU-only (the gather path stays the CPU oracle every
+    # bit-parity test pins against); plain-causal bf16-KV decode steps
+    # only. _FORCE_PAGED_KERNEL overrides for interpret-mode tests.
+    use_paged_kernel = (
+        paged
+        and positions.shape[1] == 1
+        and not quantized_kv
+        and cfg.attn_softcap is None
+        and cfg.sliding_window is None
+        and (
+            _FORCE_PAGED_KERNEL
+            if _FORCE_PAGED_KERNEL is not None
+            else jax.default_backend() == "tpu"
+        )
+    )
     kj = jnp.arange(s)[None, None, :]
     qi = positions[:, :, None]
     causal = kj <= qi
@@ -660,6 +681,23 @@ def run_cached_layers(
                 from kserve_vllm_mini_tpu.ops.flash_attention import prefill_attention
 
                 o = prefill_attention(q, k, v)
+        elif use_paged_kernel:
+            # Pallas paged decode: the block table drives per-block DMA
+            # straight from the LAYER-STACKED pool — no gathered KV copy,
+            # and no per-layer pool slice either (a dynamic-slice operand
+            # to the custom call would materialize the whole layer pool;
+            # lidx rides the kernel's index map instead)
+            from kserve_vllm_mini_tpu.ops.paged_attention import (
+                paged_decode_attention,
+            )
+
+            G = cfg.n_heads // cfg.n_kv_heads
+            qg = q[:, :, 0, :].reshape(B, cfg.n_kv_heads, G, cfg.head_dim)
+            og = paged_decode_attention(
+                qg, cache["k"], cache["v"], block_table,
+                cache_offsets, layer=lidx, scale=attn_scale,
+            )
+            o = og.reshape(B, cfg.n_heads, 1, cfg.head_dim)
         else:
             k_layer = _read_layer(cache, "k", lidx)
             v_layer = _read_layer(cache, "v", lidx)

@@ -1,0 +1,177 @@
+"""Pallas TPU paged-attention decode kernel.
+
+The gather-based paged read (models/llama.py ``_read_layer``) materializes
+the batch's blocks into a contiguous [B, KVH, S, D] tensor before the
+attention matmuls — 3x the KV bytes in HBM traffic (pool read + gather
+write + attention read). This kernel is the TPU-native version of the trick
+vLLM's namesake PagedAttention kernel does on GPU: the BLOCK TABLE is a
+scalar-prefetch argument whose values drive each grid step's BlockSpec
+index map, so the pool block a slot needs is DMA'd straight from HBM into
+VMEM — per slot, per kv head, per block — and attention runs on it in
+place. No gathered copy exists, and HBM sees exactly one read of the live
+KV prefix.
+
+Layout: grid (slots, kv_heads, max_blocks); the online-softmax m/l/acc
+recurrence lives in VMEM scratch and persists across the block sweep (the
+innermost grid axis, same structure as ops/flash_attention.py). GQA comes
+in pre-grouped: q is [S, KVH, G, D] so each grid step contracts a [G, D]
+query tile against the [BLK, D] key block on the MXU.
+
+Blocks past the slot's live length are skipped (``pl.when``) — their DMA
+still happens (the grid is static), reading whatever block their table
+entry names. The engine parks freed/unwritten table rows on its scratch
+block (runtime/engine.py ``_paged_release``), which is what concentrates
+the dead traffic; the ``jnp.clip`` below is only bounds safety for ids
+outside [0, P).
+
+The kernel takes the LAYER-STACKED pool ([L, P, KVH, BLK, D]) plus the
+layer index as a scalar-prefetch value folded into the index map: slicing
+one layer out before the call would hand XLA a dynamic-slice feeding a
+custom call, which materializes the whole layer pool in HBM per step —
+exactly the copy this kernel exists to avoid.
+
+The jnp gather path is the correctness oracle; tests compare in interpret
+mode on CPU (tests/test_paged_kernel.py). The serving path dispatches to
+the kernel on TPU for plain-causal, bf16-KV configs and keeps the exact
+gather path elsewhere (models/llama.py run_cached_layers).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _paged_decode_kernel(
+    layer_ref,   # [1] int32 layer index (scalar prefetch; used in index maps)
+    table_ref,   # [S, MAXB] int32 (scalar prefetch)
+    qpos_ref,    # [S] int32 query positions (scalar prefetch)
+    q_ref,       # [1, 1, G, D] this slot/head's query tile
+    k_ref,       # [1, 1, 1, BLK, D] the table-selected pool block
+    v_ref,       # [1, 1, 1, BLK, D]
+    o_ref,       # [1, 1, G, D]
+    m_ref, l_ref, acc_ref,
+    *,
+    block_k: int,
+    scale: float,
+):
+    s = pl.program_id(0)
+    b = pl.program_id(2)
+    nb = pl.num_programs(2)
+
+    @pl.when(b == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    qpos = qpos_ref[s]
+    # keys j of block b sit at positions b*BLK + j; the decode query at
+    # position qpos attends j <= qpos, so a block starting past qpos is
+    # all-masked — skip its FLOPs entirely
+    run = b * block_k <= qpos
+
+    @pl.when(run)
+    def _accumulate():
+        q = q_ref[0, 0]                      # [G, D]
+        k = k_ref[0, 0, 0]                   # [BLK, D]
+        v = v_ref[0, 0, 0]
+        logits = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale                            # [G, BLK]
+        kpos = b * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, logits.shape, 1
+        )
+        logits = jnp.where(kpos <= qpos, logits, _NEG_INF)
+        m_prev = m_ref[:]
+        m_cur = jnp.max(logits, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        corr = jnp.exp(m_prev - m_new)
+        p = jnp.exp(logits - m_new)
+        l_ref[:] = l_ref[:] * corr + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[:] = m_new
+
+    @pl.when(b == nb - 1)
+    def _finalize():
+        o_ref[0, 0] = (acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)).astype(o_ref.dtype)
+
+
+def paged_decode_attention(
+    q: jnp.ndarray,        # [S, KVH, G, D] decode queries, GQA pre-grouped
+    k_pool: jnp.ndarray,   # [L, P, KVH, BLK, D] layer-stacked key pool
+                           # (or [P, KVH, BLK, D] for a single layer)
+    v_pool: jnp.ndarray,
+    table: jnp.ndarray,    # [S, MAXB] int32 block ids (position order)
+    qpos: jnp.ndarray,     # [S] int32 current query position per slot
+    layer: jnp.ndarray | int = 0,  # which layer of the stacked pool
+    scale: Optional[float] = None,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Returns [S, KVH, G, D] attention outputs, reading each slot's live
+    blocks straight from the pool (table-driven DMA, no gather copy). The
+    layer index rides the index map so the caller never slices the pool."""
+    if k_pool.ndim == 4:
+        k_pool = k_pool[None]
+        v_pool = v_pool[None]
+    S, KVH, G, D = q.shape
+    L, P, _, BLK, _ = k_pool.shape
+    MAXB = table.shape[1]
+    scale = scale if scale is not None else D ** -0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    # bounds safety only: dead-but-in-range ids DMA whatever they name
+    # (the engine's scratch-row convention concentrates that traffic)
+    safe_table = jnp.clip(table, 0, P - 1).astype(jnp.int32)
+    qpos = qpos.astype(jnp.int32)
+    layer_arr = jnp.asarray(layer, jnp.int32).reshape((1,))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(S, KVH, MAXB),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, G, D),
+                lambda s, h, b, layer, table, qpos: (s, h, 0, 0),
+            ),
+            pl.BlockSpec(
+                (1, 1, 1, BLK, D),
+                lambda s, h, b, layer, table, qpos: (
+                    layer[0], table[s, b], h, 0, 0
+                ),
+            ),
+            pl.BlockSpec(
+                (1, 1, 1, BLK, D),
+                lambda s, h, b, layer, table, qpos: (
+                    layer[0], table[s, b], h, 0, 0
+                ),
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, G, D), lambda s, h, b, layer, table, qpos: (s, h, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((G, 1), jnp.float32),
+            pltpu.VMEM((G, 1), jnp.float32),
+            pltpu.VMEM((G, D), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(_paged_decode_kernel, block_k=BLK, scale=scale)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, KVH, G, D), q.dtype),
+        interpret=interpret,
+    )(layer_arr, safe_table, qpos, q, k_pool, v_pool)
