@@ -120,11 +120,6 @@ def validate_profile(
                 "kv_layout: paged does not support a speculative drafter "
                 "yet — drop 'drafter' or use kv_layout: dense"
             )
-        if profile.get("prefix_cache"):
-            rep.errors.append(
-                "kv_layout: paged and prefix_cache are mutually exclusive "
-                "for now (block-level sharing is the planned merge)"
-            )
         pool = profile.get("kv_pool_blocks")
         if pool is not None and int(pool) < 1:
             rep.errors.append(f"kv_pool_blocks ({pool}) must be >= 1")

@@ -309,10 +309,11 @@ class Engine:
             if drafter is not None:
                 raise ValueError("paged KV does not support speculative "
                                  "decoding yet; drop the drafter or use dense")
-            if self.ecfg.prefix_cache:
-                raise ValueError("paged KV and prefix_cache are mutually "
-                                 "exclusive for now (block-level sharing is "
-                                 "the planned merge)")
+            # prefix_cache + paged = BLOCK-LEVEL prefix sharing (vLLM-style
+            # hash-based APC): full prompt blocks are content-addressed and
+            # shared across requests by table reference. Sharing full
+            # blocks only means writes always land PAST the reused region
+            # in private blocks — no copy-on-write needed. State below.
             blk = self.ecfg.kv_block_size
             if blk < 1:
                 raise ValueError(f"kv_block_size={blk} must be >= 1")
@@ -346,6 +347,18 @@ class Engine:
             # head-of-line request that didn't fit the free pool; retried
             # first so admission stays FIFO
             self._deferred: Optional[RequestHandle] = None
+            # block-level prefix sharing (prefix_cache=True): a FULL prompt
+            # block's content key (sha256 of the token prefix up to its
+            # end) maps to the pool block holding its KV. _block_rc counts
+            # slot ownerships; rc==0 registered blocks park in the
+            # _retained_lru (insertion order = recency) until the
+            # allocator evicts them for fresh allocations.
+            self._hash_block: dict[bytes, int] = {}
+            self._block_hash: dict[int, bytes] = {}
+            self._block_rc: dict[int, int] = {}
+            from collections import OrderedDict
+
+            self._retained_lru: "OrderedDict[int, None]" = OrderedDict()
 
         def make_cache():
             return init_kv_cache(
@@ -460,22 +473,121 @@ class Engine:
         )
         return -(-worst // self._blk)
 
-    def _paged_admit_blocks(self, slot: int, req: GenRequest) -> None:
-        """Reserve the request's worst-case blocks (caller checked fit) and
-        point the slot's table row at them, scratch beyond."""
-        need = self._blocks_needed(req)
-        blks = [self._free_blocks.pop() for _ in range(need)]
+    def _prefix_keys(self, prompt: list[int], n_blocks: int) -> list[bytes]:
+        """Content keys of the prompt's first 1..n_blocks full blocks in
+        ONE incremental pass: the KV of position p depends on ALL tokens
+        <= p, so block i's key hashes the whole prefix up to its end — a
+        running sha256 snapshot per block boundary keeps this O(len), not
+        O(len^2/blk)."""
+        import hashlib
+
+        h = hashlib.sha256()
+        keys: list[bytes] = []
+        for i in range(n_blocks):
+            for t in prompt[i * self._blk : (i + 1) * self._blk]:
+                h.update(t.to_bytes(8, "little", signed=True))
+            keys.append(h.copy().digest())
+        return keys
+
+    def _paged_plan(self, req: GenRequest) -> tuple[list[int], int]:
+        """(reusable shared block ids for the longest cached prompt
+        prefix, new blocks the request still needs). At least the final
+        prompt token always prefills (its last-position logits feed the
+        first sample), so reuse caps at (len-1)//BLK full blocks; and —
+        same rule as the dense APC's slot matching — a match below
+        max(min_prefill_bucket, len/4) doesn't count: it would move the
+        big remainder off the flash fresh-prefill path onto the masked
+        chunk path for a trivial saving."""
+        reuse: list[int] = []
+        prompt = req.prompt_tokens
+        if self.ecfg.prefix_cache:
+            max_b = (len(prompt) - 1) // self._blk
+            for i, key in enumerate(self._prefix_keys(prompt, max_b)):
+                bid = self._hash_block.get(key)
+                if bid is None:
+                    break
+                reuse.append(bid)
+            floor = max(self.ecfg.min_prefill_bucket, len(prompt) // 4)
+            if len(reuse) * self._blk < floor:
+                reuse = []
+        return reuse, self._blocks_needed(req) - len(reuse)
+
+    def _paged_fits(self, req: GenRequest) -> bool:
+        reuse, need_new = self._paged_plan(req)
+        reused_retained = sum(1 for b in reuse if self._block_rc.get(b, 0) == 0)
+        available = (
+            len(self._free_blocks) + len(self._retained_lru) - reused_retained
+        )
+        return need_new <= available
+
+    def _paged_alloc(self) -> int:
+        """One fresh block: free list first, then evict the least-recently
+        retained shared block (dropping its content-key registration)."""
+        if self._free_blocks:
+            return self._free_blocks.pop()
+        bid, _ = self._retained_lru.popitem(last=False)  # oldest
+        key = self._block_hash.pop(bid, None)
+        if key is not None:
+            self._hash_block.pop(key, None)
+        self._block_rc.pop(bid, None)
+        return bid
+
+    def _paged_admit_blocks(self, slot: int, req: GenRequest) -> int:
+        """Reserve the request's blocks (caller checked fit): claim the
+        cached prefix's shared blocks by reference, allocate the rest, and
+        point the slot's table row at them (scratch beyond). Registers the
+        prompt's full blocks for future sharing. Returns the reused token
+        count (the prefill's start offset)."""
+        prompt = req.prompt_tokens
+        reuse, need_new = self._paged_plan(req)
+        # claim shared blocks FIRST: a 0->1 refcount leaves the retained
+        # pool before eviction for the new allocations can touch it
+        for bid in reuse:
+            rc = self._block_rc.get(bid, 0)
+            if rc == 0:
+                self._retained_lru.pop(bid, None)
+            self._block_rc[bid] = rc + 1
+        new_blocks = [self._paged_alloc() for _ in range(need_new)]
+        for bid in new_blocks:
+            self._block_rc[bid] = 1
+        blks = reuse + new_blocks
         self._slot_blocks[slot] = blks
         row = np.full((self._maxb,), self._scratch_block, dtype=np.int32)
         row[: len(blks)] = blks
         self._block_table[slot] = row
         self._table_dev = None
+        if self.ecfg.prefix_cache:
+            # register this prompt's full blocks (content exists once the
+            # synchronous prefill below runs; admissions are serialized on
+            # the scheduler thread, so no reader can arrive earlier)
+            keys = self._prefix_keys(prompt, len(prompt) // self._blk)
+            for i, key in enumerate(keys):
+                if key not in self._hash_block:
+                    self._hash_block[key] = blks[i]
+                    self._block_hash[blks[i]] = key
+        reused_len = len(reuse) * self._blk
+        if reuse:
+            self.stats["prefix_hits"] += 1
+            self.stats["prefix_tokens_reused"] += reused_len
+        return reused_len
 
     def _paged_release(self, slot: int) -> None:
-        """Return the slot's blocks and park its row on the scratch block,
-        so the sweep's all-slots dispatch can never write a stale position
-        into a block that was handed to another request."""
-        self._free_blocks.extend(self._slot_blocks[slot])
+        """Drop the slot's block ownerships and park its row on the scratch
+        block, so the sweep's all-slots dispatch can never write a stale
+        position into a block that was handed to another request. Shared
+        blocks whose refcount reaches zero go to the retained pool (still
+        content-addressed, evictable); unregistered blocks free outright."""
+        for bid in self._slot_blocks[slot]:
+            rc = self._block_rc.get(bid, 1) - 1
+            if rc > 0:
+                self._block_rc[bid] = rc
+                continue
+            if bid in self._block_hash:
+                self._block_rc[bid] = 0
+                self._retained_lru[bid] = None  # most-recent end
+            else:
+                self._block_rc.pop(bid, None)
+                self._free_blocks.append(bid)
         self._slot_blocks[slot] = []
         self._block_table[slot] = self._scratch_block
         self._table_dev = None
@@ -978,6 +1090,7 @@ class Engine:
         thread."""
         if (
             not self.ecfg.prefix_cache
+            or self.paged  # paged reuse is BLOCK-level (_paged_admit_blocks)
             or self._drafter_params is not None
             or not self._free
         ):
@@ -1087,9 +1200,11 @@ class Engine:
             # head-of-line request before calling here, and the idle path
             # only runs with zero active slots, where the whole pool is
             # free and submit()'s never-fit rejection guarantees the fit.
-            # _paged_admit_blocks pops _free_blocks and would fail loudly
-            # on a (multihost-divergence) violation.
-            self._paged_admit_blocks(slot, req)
+            # _paged_alloc pops _free_blocks / evicts retained and would
+            # fail loudly on a (multihost-divergence) violation.
+            # Block-level prefix sharing may cover a prompt prefix; the
+            # prefill below starts after it, exactly like the dense APC.
+            reused = self._paged_admit_blocks(slot, req)
         adapter_idx = 0
         if req.adapter is not None:
             if req.adapter not in self._lora_names:
@@ -1207,9 +1322,11 @@ class Engine:
             self.stats["requests_completed"] += 1
         self._slot_req[slot] = None
         self._slot_machine[slot] = None
-        if self.ecfg.prefix_cache:
-            # retain exactly the tokens whose KV is WRITTEN: the last
-            # emitted token was never fed, so trim to slot_len rows
+        if self.ecfg.prefix_cache and not self.paged:
+            # dense slot-affinity APC: retain exactly the tokens whose KV
+            # is WRITTEN (the last emitted token was never fed, so trim to
+            # slot_len rows). Paged retention is block-level, inside
+            # _paged_release.
             self._retained[slot] = self._slot_tokens[slot][: self._slot_len[slot]]
         if self.paged:
             self._paged_release(slot)
@@ -1469,10 +1586,7 @@ class Engine:
                     handle = self._pending.get_nowait()
                 except queue.Empty:
                     break
-            if (
-                self.paged
-                and self._blocks_needed(handle.request) > len(self._free_blocks)
-            ):
+            if self.paged and not self._paged_fits(handle.request):
                 # hold at the head of the line until decode frees blocks
                 self._deferred = handle
                 break
@@ -1516,6 +1630,7 @@ class Engine:
         if self.paged:
             s["kv_pool_blocks"] = self._scratch_block
             s["kv_free_blocks"] = len(self._free_blocks)
+            s["kv_retained_blocks"] = len(self._retained_lru)
             s["kv_block_size"] = self._blk
         s["spec_accept_ratio"] = (
             s["spec_accepted"] / s["spec_proposed"] if s["spec_proposed"] else 0.0

@@ -14,7 +14,8 @@ Invariants under test:
   leak stale KV into the new request's attention;
 - a request that can never fit the pool fails fast with a structured error
   instead of deadlocking the queue;
-- v1 scope guards: paged + mesh / drafter / prefix_cache raise.
+- scope guards: paged + mesh / drafter raise (paged + prefix_cache is the
+  block-level sharing path, tests/test_paged_prefix.py).
 """
 
 import jax
@@ -185,8 +186,6 @@ def test_never_fit_request_fails_fast(params):
 
 
 def test_scope_guards(params):
-    with pytest.raises(ValueError, match="prefix_cache"):
-        Engine(params, CFG, EngineConfig(kv_layout="paged", prefix_cache=True))
     with pytest.raises(ValueError, match="kv_layout"):
         Engine(params, CFG, EngineConfig(kv_layout="banana"))
     with pytest.raises(ValueError, match="kv_pool_blocks"):

@@ -116,8 +116,8 @@ def test_paged_kv_scope_checks():
     assert any("kv_layout" in e for e in rep.errors)
     rep = validate_profile({"kv_layout": "paged", "drafter": "llama-1b"})
     assert any("drafter" in e for e in rep.errors)
-    rep = validate_profile({"kv_layout": "paged", "prefix_cache": True})
-    assert any("prefix_cache" in e for e in rep.errors)
+    # paged + prefix_cache is VALID: block-level sharing (engine APC)
+    assert validate_profile({"kv_layout": "paged", "prefix_cache": True}).ok
     rep = validate_profile({"kv_layout": "paged", "kv_pool_blocks": 0})
     assert any("kv_pool_blocks" in e for e in rep.errors)
     rep = validate_profile({"kv_layout": "paged", "kv_block_size": 0})
