@@ -540,12 +540,12 @@ def run_cached_layers(
         s = kv_cache["k"].shape[3]
     # Pallas paged decode kernel: table-driven block DMA instead of the
     # gather copy. TPU-only (the gather path stays the CPU oracle every
-    # bit-parity test pins against); plain-causal bf16-KV decode steps
-    # only. _FORCE_PAGED_KERNEL overrides for interpret-mode tests.
+    # bit-parity test pins against); plain-causal decode steps only —
+    # int8-KV pools dequantize in-kernel. _FORCE_PAGED_KERNEL overrides
+    # for interpret-mode tests.
     use_paged_kernel = (
         paged
         and positions.shape[1] == 1
-        and not quantized_kv
         and cfg.attn_softcap is None
         and cfg.sliding_window is None
         and (
@@ -696,6 +696,7 @@ def run_cached_layers(
             og = paged_decode_attention(
                 qg, cache["k"], cache["v"], block_table,
                 cache_offsets, layer=lidx, scale=attn_scale,
+                k_scale=cache.get("k_s"), v_scale=cache.get("v_s"),
             )
             o = og.reshape(B, cfg.n_heads, 1, cfg.head_dim)
         else:

@@ -56,12 +56,16 @@ def _paged_decode_kernel(
     q_ref,       # [1, 1, G, D] this slot/head's query tile
     k_ref,       # [1, 1, 1, BLK, D] the table-selected pool block
     v_ref,       # [1, 1, 1, BLK, D]
-    o_ref,       # [1, 1, G, D]
-    m_ref, l_ref, acc_ref,
-    *,
+    *rest,       # [k_s_ref, v_s_ref,] o_ref, m_ref, l_ref, acc_ref —
+                 # int8-KV mode carries per-position scale blocks
     block_k: int,
     scale: float,
+    quantized: bool,
 ):
+    if quantized:
+        k_s_ref, v_s_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        o_ref, m_ref, l_ref, acc_ref = rest
     s = pl.program_id(0)
     b = pl.program_id(2)
     nb = pl.num_programs(2)
@@ -81,12 +85,16 @@ def _paged_decode_kernel(
     @pl.when(run)
     def _accumulate():
         q = q_ref[0, 0]                      # [G, D]
-        k = k_ref[0, 0, 0]                   # [BLK, D]
+        k = k_ref[0, 0, 0]                   # [BLK, D] (int8 when quantized)
         v = v_ref[0, 0, 0]
         logits = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
+            q, k.astype(q.dtype), (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale                            # [G, BLK]
+        if quantized:
+            # per-position dequant folds into the [G, BLK] intermediates:
+            # (q . k_j s_j) = (q . k_j) * s_j, and p @ (v s) = (p * s) @ v
+            logits = logits * k_s_ref[0, 0, 0][None, :]
         kpos = b * block_k + jax.lax.broadcasted_iota(
             jnp.int32, logits.shape, 1
         )
@@ -97,8 +105,14 @@ def _paged_decode_kernel(
         corr = jnp.exp(m_prev - m_new)
         p = jnp.exp(logits - m_new)
         l_ref[:] = l_ref[:] * corr + jnp.sum(p, axis=1, keepdims=True)
+        if quantized:
+            pv = (p * v_s_ref[0, 0, 0][None, :]).astype(jnp.float32)
+            vv = v.astype(jnp.float32)
+        else:
+            pv = p.astype(v.dtype)
+            vv = v
         acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            pv, vv, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         m_ref[:] = m_new
@@ -117,14 +131,21 @@ def paged_decode_attention(
     qpos: jnp.ndarray,     # [S] int32 current query position per slot
     layer: jnp.ndarray | int = 0,  # which layer of the stacked pool
     scale: Optional[float] = None,
+    k_scale: Optional[jnp.ndarray] = None,  # [L, P, KVH, BLK] f32: int8-KV
+    v_scale: Optional[jnp.ndarray] = None,  # per-position dequant scales
     interpret: Optional[bool] = None,
 ) -> jnp.ndarray:
     """Returns [S, KVH, G, D] attention outputs, reading each slot's live
     blocks straight from the pool (table-driven DMA, no gather copy). The
-    layer index rides the index map so the caller never slices the pool."""
+    layer index rides the index map so the caller never slices the pool.
+    With ``k_scale``/``v_scale`` the pools hold int8 values dequantized
+    in-kernel (the scaled-int8 KV cache layout, models/llama.py)."""
     if k_pool.ndim == 4:
         k_pool = k_pool[None]
         v_pool = v_pool[None]
+        if k_scale is not None:
+            k_scale, v_scale = k_scale[None], v_scale[None]
+    quantized = k_scale is not None
     S, KVH, G, D = q.shape
     L, P, _, BLK, _ = k_pool.shape
     MAXB = table.shape[1]
@@ -138,27 +159,37 @@ def paged_decode_attention(
     qpos = qpos.astype(jnp.int32)
     layer_arr = jnp.asarray(layer, jnp.int32).reshape((1,))
 
+    def _pool_spec():
+        return pl.BlockSpec(
+            (1, 1, 1, BLK, D),
+            lambda s, h, b, layer, table, qpos: (
+                layer[0], table[s, b], h, 0, 0
+            ),
+        )
+
+    def _scale_spec():
+        return pl.BlockSpec(
+            (1, 1, 1, BLK),
+            lambda s, h, b, layer, table, qpos: (layer[0], table[s, b], h, 0),
+        )
+
+    in_specs = [
+        pl.BlockSpec(
+            (1, 1, G, D),
+            lambda s, h, b, layer, table, qpos: (s, h, 0, 0),
+        ),
+        _pool_spec(),
+        _pool_spec(),
+    ]
+    operands = [q, k_pool, v_pool]
+    if quantized:
+        in_specs += [_scale_spec(), _scale_spec()]
+        operands += [k_scale, v_scale]
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(S, KVH, MAXB),
-        in_specs=[
-            pl.BlockSpec(
-                (1, 1, G, D),
-                lambda s, h, b, layer, table, qpos: (s, h, 0, 0),
-            ),
-            pl.BlockSpec(
-                (1, 1, 1, BLK, D),
-                lambda s, h, b, layer, table, qpos: (
-                    layer[0], table[s, b], h, 0, 0
-                ),
-            ),
-            pl.BlockSpec(
-                (1, 1, 1, BLK, D),
-                lambda s, h, b, layer, table, qpos: (
-                    layer[0], table[s, b], h, 0, 0
-                ),
-            ),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, 1, G, D), lambda s, h, b, layer, table, qpos: (s, h, 0, 0)
         ),
@@ -168,10 +199,12 @@ def paged_decode_attention(
             pltpu.VMEM((G, D), jnp.float32),
         ],
     )
-    kernel = functools.partial(_paged_decode_kernel, block_k=BLK, scale=scale)
+    kernel = functools.partial(
+        _paged_decode_kernel, block_k=BLK, scale=scale, quantized=quantized
+    )
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((S, KVH, G, D), q.dtype),
         interpret=interpret,
-    )(layer_arr, safe_table, qpos, q, k_pool, v_pool)
+    )(layer_arr, safe_table, qpos, *operands)

@@ -356,6 +356,11 @@ class Engine:
             self._hash_block: dict[bytes, int] = {}
             self._block_hash: dict[int, bytes] = {}
             self._block_rc: dict[int, int] = {}
+            # bumped whenever the content index CHANGES (registration /
+            # eviction) — plans memoized on requests stay valid between
+            # bumps, so a deferred head-of-line request's per-sweep fit
+            # recheck is O(1) instead of re-hashing its whole prompt
+            self._prefix_epoch = 0
             from collections import OrderedDict
 
             self._retained_lru: "OrderedDict[int, None]" = OrderedDict()
@@ -497,12 +502,22 @@ class Engine:
         same rule as the dense APC's slot matching — a match below
         max(min_prefill_bucket, len/4) doesn't count: it would move the
         big remainder off the flash fresh-prefill path onto the masked
-        chunk path for a trivial saving."""
-        reuse: list[int] = []
+        chunk path for a trivial saving.
+
+        The plan (and the prompt's full key list, reused by registration)
+        memoizes on the request, keyed by _prefix_epoch: stale plans must
+        never survive an index change — an evicted block id in a cached
+        plan would reuse a reallocated block's garbage KV."""
+        cached = getattr(req, "_plan_cache", None)
+        if cached is not None and cached[0] == self._prefix_epoch:
+            return list(cached[2]), cached[3]
         prompt = req.prompt_tokens
+        reuse: list[int] = []
+        keys: list[bytes] = []
         if self.ecfg.prefix_cache:
+            keys = self._prefix_keys(prompt, len(prompt) // self._blk)
             max_b = (len(prompt) - 1) // self._blk
-            for i, key in enumerate(self._prefix_keys(prompt, max_b)):
+            for key in keys[:max_b]:
                 bid = self._hash_block.get(key)
                 if bid is None:
                     break
@@ -510,7 +525,9 @@ class Engine:
             floor = max(self.ecfg.min_prefill_bucket, len(prompt) // 4)
             if len(reuse) * self._blk < floor:
                 reuse = []
-        return reuse, self._blocks_needed(req) - len(reuse)
+        need_new = self._blocks_needed(req) - len(reuse)
+        req._plan_cache = (self._prefix_epoch, keys, list(reuse), need_new)
+        return reuse, need_new
 
     def _paged_fits(self, req: GenRequest) -> bool:
         reuse, need_new = self._paged_plan(req)
@@ -529,6 +546,7 @@ class Engine:
         key = self._block_hash.pop(bid, None)
         if key is not None:
             self._hash_block.pop(key, None)
+            self._prefix_epoch += 1  # index changed: cached plans expire
         self._block_rc.pop(bid, None)
         return bid
 
@@ -559,12 +577,17 @@ class Engine:
         if self.ecfg.prefix_cache:
             # register this prompt's full blocks (content exists once the
             # synchronous prefill below runs; admissions are serialized on
-            # the scheduler thread, so no reader can arrive earlier)
-            keys = self._prefix_keys(prompt, len(prompt) // self._blk)
+            # the scheduler thread, so no reader can arrive earlier). The
+            # key list comes from the memoized plan — no third hash pass.
+            keys = req._plan_cache[1]
+            registered = False
             for i, key in enumerate(keys):
                 if key not in self._hash_block:
                     self._hash_block[key] = blks[i]
                     self._block_hash[blks[i]] = key
+                    registered = True
+            if registered:
+                self._prefix_epoch += 1
         reused_len = len(reuse) * self._blk
         if reuse:
             self.stats["prefix_hits"] += 1
@@ -577,7 +600,11 @@ class Engine:
         position into a block that was handed to another request. Shared
         blocks whose refcount reaches zero go to the retained pool (still
         content-addressed, evictable); unregistered blocks free outright."""
-        for bid in self._slot_blocks[slot]:
+        # reversed: the chain's LEAF blocks enter the LRU first (oldest
+        # end), so eviction takes leaves before roots — evicting a root
+        # first would orphan every still-retained descendant (plans match
+        # prefixes root-outward and stop at the first miss)
+        for bid in reversed(self._slot_blocks[slot]):
             rc = self._block_rc.get(bid, 1) - 1
             if rc > 0:
                 self._block_rc[bid] = rc

@@ -74,6 +74,66 @@ def test_kernel_ignores_dead_table_entries():
     np.testing.assert_array_equal(np.asarray(out_base), np.asarray(out_junk))
 
 
+def test_kernel_int8_kv_dequant():
+    """int8-KV mode: the kernel's in-kernel dequant (scales folded into
+    the [G, BLK] intermediates) must match dequantize-then-attend."""
+    rng = np.random.default_rng(4)
+    S, KVH, G, D, BLK, MAXB, P = 2, 2, 2, 32, 8, 4, 12
+    kq = jnp.asarray(rng.integers(-127, 128, size=(P, KVH, BLK, D)), jnp.int8)
+    vq = jnp.asarray(rng.integers(-127, 128, size=(P, KVH, BLK, D)), jnp.int8)
+    ks = jnp.asarray(rng.uniform(0.01, 0.1, size=(P, KVH, BLK)).astype(np.float32))
+    vs = jnp.asarray(rng.uniform(0.01, 0.1, size=(P, KVH, BLK)).astype(np.float32))
+    table = jnp.asarray(rng.permutation(P)[: S * MAXB].reshape(S, MAXB), jnp.int32)
+    q = jnp.asarray(rng.normal(size=(S, KVH, G, D)).astype(np.float32))
+    qpos = jnp.asarray([10, 27], jnp.int32)
+
+    out = paged_decode_attention(q, kq, vq, table, qpos,
+                                 k_scale=ks, v_scale=vs, interpret=True)
+    kf = kq.astype(jnp.float32) * ks[..., None]
+    vf = vq.astype(jnp.float32) * vs[..., None]
+    ref = _oracle(q, kf, vf, table, qpos)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_model_paged_decode_path_int8_kv(monkeypatch):
+    """Forced kernel through the model's paged int8-KV decode path agrees
+    with the gather path (which dequantizes to model dtype on read)."""
+    from kserve_vllm_mini_tpu.models import llama
+    from kserve_vllm_mini_tpu.models.config import get_config
+    from kserve_vllm_mini_tpu.models.llama import (
+        forward,
+        init_paged_kv_cache,
+        init_params,
+    )
+
+    cfg = get_config("llama-tiny", max_seq_len=64)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    B, T, BLK = 2, 16, 8
+    table = jnp.asarray(
+        [[3, 17, 5, 9, 11, 2, 16, 19], [7, 0, 14, 6, 12, 8, 13, 1]], jnp.int32
+    )
+    toks = jax.random.randint(jax.random.PRNGKey(1), (B, T), 0, cfg.vocab_size)
+    pos = jnp.broadcast_to(jnp.arange(T), (B, T)).astype(jnp.int32)
+    zero = jnp.zeros((B,), jnp.int32)
+
+    def step(force):
+        monkeypatch.setattr(llama, "_FORCE_PAGED_KERNEL", force)
+        pool = init_paged_kv_cache(cfg, 20, BLK, quantized=True)
+        lg, pool = forward(params, cfg, toks, pos, pool, zero,
+                           fresh_prefill=True, block_table=table)
+        nxt = jnp.argmax(lg[:, -1, :], -1).astype(jnp.int32)
+        lens = jnp.full((B,), T, jnp.int32)
+        lg2, _ = forward(params, cfg, nxt[:, None], lens[:, None], pool, lens,
+                         block_table=table)
+        return np.asarray(lg2[:, 0, :])
+
+    gather = step(False)
+    kernel = step(True)
+    # gather dequantizes in model dtype, the kernel in f32 — bf16-level drift
+    np.testing.assert_allclose(kernel, gather, rtol=3e-2, atol=3e-2)
+
+
 def test_model_paged_decode_path_uses_kernel(monkeypatch):
     """Force the kernel through the model's paged decode path and check
     the logits agree with the gather path within kernel tolerance."""
