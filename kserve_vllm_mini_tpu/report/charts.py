@@ -86,6 +86,46 @@ def ttft_vs_latency_chart(results: dict[str, Any]) -> str:
     return _to_img(fig)
 
 
+def autoscale_timeline_chart(decisions: list[dict[str, Any]]) -> str:
+    """Replica count + duty/queue signals over the controller's decision
+    log (autoscale/controller.py JSONL rows)."""
+    rows = [d for d in decisions if "applied" in d and "ts" in d]
+    if len(rows) < 2:
+        return ""  # not enough decisions to plot — caller skips the section
+    if not HAVE_MPL:
+        return _placeholder("autoscale timeline")
+    t0 = rows[0]["ts"]
+    ts = [d["ts"] - t0 for d in rows]
+    fig, ax = plt.subplots(figsize=(7, 3))
+    ax.step(ts, [d["applied"] for d in rows], where="post",
+            color=_PALETTE["primary"], linewidth=2, label="replicas")
+    ax.set_xlabel("time (s)")
+    ax.set_ylabel("replicas")
+    ax.grid(color=_PALETTE["grid"], axis="y")
+    ax2 = ax.twinx()
+    duty_rows = [(t, d["duty"]) for t, d in zip(ts, rows) if "duty" in d]
+    if duty_rows:
+        ax2.plot([t for t, _ in duty_rows], [v for _, v in duty_rows],
+                 color=_PALETTE["warm"], linewidth=1, label="duty")
+    queue_rows = [(t, d["queue"]) for t, d in zip(ts, rows) if "queue" in d]
+    if queue_rows:
+        qmax = max((v for _, v in queue_rows), default=0) or 1
+        ax2.plot([t for t, _ in queue_rows],
+                 [v / qmax for _, v in queue_rows],
+                 color=_PALETTE["cold"], linewidth=1,
+                 label=f"queue (/{qmax:.0f})")
+    ax2.set_ylabel("duty / queue (normalized)")
+    ax2.set_ylim(0, 1.1)
+    breaches = [t for t, d in zip(ts, rows) if d.get("slo_breached")]
+    for b in breaches:
+        ax.axvline(b, color=_PALETTE["bad"], linestyle=":", linewidth=1)
+    lines1, labels1 = ax.get_legend_handles_labels()
+    lines2, labels2 = ax2.get_legend_handles_labels()
+    ax.legend(lines1 + lines2, labels1 + labels2, fontsize=8, loc="upper left")
+    ax.set_title("Autoscale decisions")
+    return _to_img(fig)
+
+
 def cold_warm_chart(results: dict[str, Any]) -> str:
     cold, warm = results.get("cold_p95_ms"), results.get("warm_p95_ms")
     if not HAVE_MPL or cold is None or warm is None:

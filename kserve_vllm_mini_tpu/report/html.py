@@ -167,6 +167,24 @@ def generate_single_run_html(
             "<th>errors</th></tr>" + rows + "</table></section>"
         )
 
+    if run_dir is not None:
+        # convention: the autoscale controller's --decision-log written
+        # into the run dir as autoscale_decisions.jsonl
+        dec_path = run_dir / "autoscale_decisions.jsonl"
+        if dec_path.exists():
+            decisions = []
+            for line in dec_path.read_text().splitlines():
+                try:
+                    decisions.append(json.loads(line))
+                except ValueError:
+                    continue  # a kill mid-append truncates the last line —
+                              # degrade, don't abort the whole report
+            chart = charts.autoscale_timeline_chart(decisions)
+            if chart:
+                sections.append(
+                    f"<section><h2>Autoscale decisions</h2>{chart}</section>"
+                )
+
     cw = charts.cold_warm_chart(results)
     if cw:
         sections.append(f"<section><h2>Cold vs warm</h2>{cw}")

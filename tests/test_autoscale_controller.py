@@ -150,6 +150,49 @@ def test_signal_fn_exception_holds_capacity():
     assert "no signal" in ctl.decisions[-1]["note"]
 
 
+def test_decision_timeline_in_report(tmp_path):
+    """A run dir carrying the controller's decision log gets an autoscale
+    section in the single-run report."""
+    import json as _json
+
+    from kserve_vllm_mini_tpu.report.html import generate_single_run_html
+
+    rows = [
+        {"ts": 1000.0 + 10 * i, "duty": 0.2 + 0.1 * i, "queue": float(i),
+         "slo_breached": i == 3, "current": 1 + i // 2,
+         "raw_desired": 1 + i // 2, "applied": 1 + i // 2}
+        for i in range(6)
+    ]
+    # a torn trailing line (controller killed mid-append) must degrade,
+    # not abort the report
+    (tmp_path / "autoscale_decisions.jsonl").write_text(
+        "\n".join(_json.dumps(r) for r in rows) + '\n{"ts": 12'
+    )
+    html = generate_single_run_html({"p95_ms": 100.0, "requests": 5},
+                                    run_dir=tmp_path)
+    assert "Autoscale decisions" in html
+    # the SECTION's own chart rendered — check the chart function directly
+    # too, so another section's <img> can't mask a regression here
+    from kserve_vllm_mini_tpu.report.charts import (
+        HAVE_MPL,
+        autoscale_timeline_chart,
+    )
+
+    chart = autoscale_timeline_chart(rows)
+    if HAVE_MPL:
+        assert chart.startswith("<img")
+    else:
+        assert "chart unavailable" in chart
+    # <2 decisions: no section at all (not a misleading placeholder)
+    assert autoscale_timeline_chart(rows[:1]) == ""
+    (tmp_path / "autoscale_decisions.jsonl").write_text(
+        _json.dumps(rows[0]) + "\n"
+    )
+    html2 = generate_single_run_html({"p95_ms": 100.0, "requests": 5},
+                                     run_dir=tmp_path)
+    assert "Autoscale decisions" not in html2
+
+
 def test_kserve_scaler_patches_isvc():
     from kserve_vllm_mini_tpu.deploy.kubectl import Kubectl, KubectlResult
 
