@@ -31,7 +31,21 @@ DECODING_PRESETS: dict[str, dict[str, Any]] = {
     "sampled": {"temperature": 0.7, "extra_body": {"top_p": 0.95}},
 }
 
-CONFIG_KEYS = ["quantization", "kv_cache_dtype", "decoding"]
+CONFIG_KEYS = ["quantization", "kv_cache_dtype", "decoding", "kv_layout"]
+
+
+def is_baseline_config(cfg: dict[str, Any]) -> bool:
+    """The fidelity reference config — unquantized, model-dtype dense KV,
+    greedy. ONE predicate shared by capture (make_local_bench) and ordering
+    (run_quantization): if they diverge, the baseline can bench after a row
+    that wanted a fidelity score against it, silently flipping the Pareto
+    quality axis to the ~chance task score."""
+    return (
+        cfg.get("quantization") == "none"
+        and cfg.get("kv_cache_dtype", "model") == "model"
+        and cfg.get("decoding", "greedy") == "greedy"
+        and cfg.get("kv_layout", "dense") == "dense"
+    )
 
 
 def make_local_bench(
@@ -48,12 +62,7 @@ def make_local_bench(
     ref_capture: dict[str, Any] = {}
     nll_cache: dict[str, Any] = {}  # quantization -> eval_text_nll result
 
-    def _is_baseline(cfg: dict[str, Any]) -> bool:
-        return (
-            cfg.get("quantization") == "none"
-            and cfg.get("kv_cache_dtype", "model") == "model"
-            and cfg.get("decoding", "greedy") == "greedy"
-        )
+    _is_baseline = is_baseline_config
 
     def bench(cfg: dict[str, Any]) -> dict[str, Any]:
         from kserve_vllm_mini_tpu.bench_pipeline import run_bench
@@ -63,6 +72,10 @@ def make_local_bench(
         profile["quantization"] = cfg["quantization"]
         if cfg.get("kv_cache_dtype") and cfg["kv_cache_dtype"] != "model":
             profile["kv_cache_dtype"] = cfg["kv_cache_dtype"]
+        if cfg.get("kv_layout"):
+            # paged rows measure the block-pool cache (+ Pallas kernel on
+            # TPU) against dense at the same quant/decoding point
+            profile["kv_layout"] = cfg["kv_layout"]
         profile.update(DECODING_PRESETS.get(cfg.get("decoding", "greedy"), {}))
 
         # one server boot serves both the load test and the quality eval —
@@ -146,14 +159,7 @@ def run_quantization(
     configs = base.grid_product(space)
     # the unquantized greedy baseline must bench before any row that wants a
     # fidelity score against it; stable sort keeps the rest in grid order
-    def _baseline_first(cfg: dict[str, Any]) -> int:
-        return 0 if (
-            cfg.get("quantization") == "none"
-            and cfg.get("kv_cache_dtype", "model") == "model"
-            and cfg.get("decoding", "greedy") == "greedy"
-        ) else 1
-
-    configs = sorted(configs, key=_baseline_first)
+    configs = sorted(configs, key=lambda c: 0 if is_baseline_config(c) else 1)
     bench = bench_fn or make_local_bench(base_profile, with_quality=with_quality)
     out_dir = Path(out_dir)
     csv_path = out_dir / "quant_sweep.csv"

@@ -45,6 +45,10 @@ def register(parser: argparse.ArgumentParser) -> None:
     q.add_argument("--quantizations", default="none,int8,int4")
     q.add_argument("--kv-dtypes", default="model,float32")
     q.add_argument("--decodings", default="greedy,sampled")
+    q.add_argument("--kv-layouts", default="dense",
+                   help="Comma list of cache layouts to sweep (dense,paged) "
+                        "— 'dense,paged' measures the block-pool cache and "
+                        "its Pallas kernel against dense stripes per config")
     q.add_argument("--no-quality", action="store_true",
                    help="Skip the quality-eval pass per config")
 
@@ -112,6 +116,7 @@ def run(args: argparse.Namespace) -> int:
                 "quantization": _csv_list(args.quantizations),
                 "kv_cache_dtype": _csv_list(args.kv_dtypes),
                 "decoding": _csv_list(args.decodings),
+                "kv_layout": _csv_list(args.kv_layouts),
             },
             with_quality=not args.no_quality,
         )
