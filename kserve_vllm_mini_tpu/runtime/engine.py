@@ -599,7 +599,31 @@ class Engine:
         block, so the sweep's all-slots dispatch can never write a stale
         position into a block that was handed to another request. Shared
         blocks whose refcount reaches zero go to the retained pool (still
-        content-addressed, evictable); unregistered blocks free outright."""
+        content-addressed, evictable); unregistered blocks free outright.
+
+        Before releasing, full blocks covering GENERATED tokens register
+        too (prompt blocks registered at admission): KV at position p
+        depends only on tokens <= p, so a multi-turn follow-up whose
+        prompt replays the transcript (old prompt + emitted tokens + new
+        turn) hits the whole previous conversation — the paged analog of
+        the dense APC retaining generated tokens. Only blocks with
+        (i+1)*BLK <= slot_len qualify: the fused sweep's surplus writes
+        land at positions >= slot_len, which is always past the last full
+        block's end."""
+        if self.ecfg.prefix_cache and self._slot_blocks[slot]:
+            tokens = self._slot_tokens[slot][: self._slot_len[slot]]
+            n_full = len(tokens) // self._blk
+            if n_full:
+                keys = self._prefix_keys(tokens, n_full)
+                registered = False
+                for i, key in enumerate(keys):
+                    bid = self._slot_blocks[slot][i]
+                    if key not in self._hash_block and bid not in self._block_hash:
+                        self._hash_block[key] = bid
+                        self._block_hash[bid] = key
+                        registered = True
+                if registered:
+                    self._prefix_epoch += 1
         # reversed: the chain's LEAF blocks enter the LRU first (oldest
         # end), so eviction takes leaves before roots — evicting a root
         # first would orphan every still-retained descendant (plans match

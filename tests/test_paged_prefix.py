@@ -147,6 +147,29 @@ def test_eviction_under_pressure_then_reprefill(params):
     assert eng.stats["prefix_tokens_reused"] <= 2 * BLK
 
 
+def test_multiturn_transcript_reuses_generated_blocks(params):
+    """Generated tokens register at release: a follow-up whose prompt
+    replays the transcript (old prompt + emitted tokens + new turn) must
+    reuse full blocks INCLUDING the generated region — the paged analog
+    of the dense APC's multi-turn retention."""
+    eng = Engine(params, CFG, _ecfg())
+    eng.start()
+    try:
+        prompt = list(range(100, 120))            # 20 tokens
+        # 13 outputs: the LAST emitted token is never fed, so written KV
+        # covers 20 + 12 = 32 positions = exactly 2 full blocks
+        out = _drain(eng.submit(_req(prompt, n=13)))
+        assert len(out) == 13
+        followup = prompt + out + [7]             # 34 tokens
+        _drain(eng.submit(_req(followup, n=4)))
+    finally:
+        eng.stop()
+    assert eng.stats["prefix_hits"] == 1
+    # both full transcript blocks reused — including the generated region
+    # (prompt-only sharing would cap at 16: one full prompt block)
+    assert eng.stats["prefix_tokens_reused"] == 2 * BLK
+
+
 def test_prefix_off_keeps_plain_allocator(params):
     eng = Engine(params, CFG, EngineConfig(
         max_slots=2, max_seq_len=128, kv_layout="paged", kv_block_size=BLK))
