@@ -507,6 +507,10 @@ def run_cached_layers(
                                  # rides the layer scan like the base
                                  # weights
     lora_ids: Optional[jnp.ndarray] = None,  # [B] adapter index per row
+    paged_kernel_ok: bool = True,  # False under GSPMD-sharded pools: a
+                                 # pallas_call inside an auto-partitioned
+                                 # jit would see global shapes; the gather
+                                 # path partitions per kv head instead
 ) -> tuple[jnp.ndarray, KVCache]:
     """The cached transformer stack: scan over stacked layers, writing this
     block's K/V at ``cache_offsets`` and attending with positional masking
@@ -545,6 +549,7 @@ def run_cached_layers(
     # for interpret-mode tests.
     use_paged_kernel = (
         paged
+        and paged_kernel_ok
         and positions.shape[1] == 1
         and cfg.attn_softcap is None
         and cfg.sliding_window is None
@@ -756,6 +761,8 @@ def forward(
                         # (cached) path only — the cache-free training path
                         # ignores it
     lora_ids: Optional[jnp.ndarray] = None,  # [B] adapter index per row
+    paged_kernel_ok: bool = True,  # False for GSPMD-sharded paged pools
+                        # (run_cached_layers docstring)
 ) -> tuple[jnp.ndarray, Optional[KVCache]]:
     """Returns (logits [B, T, V] float32, updated cache).
 
@@ -787,7 +794,7 @@ def forward(
         x, new_cache_dict = run_cached_layers(
             layers, cfg, x, positions, cos, sin, kv_cache, cache_offsets,
             fresh_prefill=fresh_prefill, block_table=block_table,
-            lora=lora, lora_ids=lora_ids,
+            lora=lora, lora_ids=lora_ids, paged_kernel_ok=paged_kernel_ok,
         )
     else:
         def scan_body_nocache(carry, xs):

@@ -176,6 +176,25 @@ def kv_cache_shardings(
     return out
 
 
+def paged_kv_cache_shardings(
+    cfg: ModelConfig, mesh: Mesh, quantized: bool = False
+) -> dict[str, NamedSharding]:
+    """[L, P, KVH, BLK, D] block-pool layout: KV heads shard over ``tp``
+    (the same head partitioning as dense), block/position axes stay
+    replicated — the table-driven gather indexes the P axis identically on
+    every tp shard, so GSPMD partitions the paged read per head with no
+    cross-shard traffic. Paged pools do not compose with dp/sp/pp meshes
+    (the engine rejects them); only the tp axis matters here."""
+    tp = _axis(mesh, "tp")
+    kv_tp = tp if tp and cfg.n_kv_heads % mesh.shape["tp"] == 0 else None
+    s = NamedSharding(mesh, P(None, None, kv_tp, None, None))
+    out = {"k": s, "v": s}
+    if quantized:
+        s4 = NamedSharding(mesh, P(None, None, kv_tp, None))
+        out["k_s"] = out["v_s"] = s4
+    return out
+
+
 def logits_sharding(mesh: Mesh) -> NamedSharding:
     """[B, T, V]: batch over dp; vocab gathered (sampling wants full vocab)."""
     return NamedSharding(mesh, P(_axis(mesh, "dp"), None, None))

@@ -303,9 +303,14 @@ class Engine:
                 f"unknown kv_layout {self.ecfg.kv_layout!r}; known: dense, paged"
             )
         if self.paged:
-            if mesh is not None:
-                raise ValueError("paged KV does not support meshes yet; "
-                                 "use kv_layout=dense with tp/pp")
+            if mesh is not None and any(
+                mesh.shape.get(ax, 1) > 1 for ax in ("dp", "sp", "pp")
+            ):
+                raise ValueError(
+                    "paged KV composes with tp-only meshes; dp/sp/pp need "
+                    "kv_layout=dense (block gathers don't partition over "
+                    "a sharded slot/seq/layer axis)"
+                )
             if drafter is not None:
                 raise ValueError("paged KV does not support speculative "
                                  "decoding yet; drop the drafter or use dense")
@@ -336,9 +341,25 @@ class Engine:
             # dispatches all S slots, active or not) can never land in a
             # block that was reassigned to another request
             self._scratch_block = n_user
-            self._cache = init_paged_kv_cache(
-                cfg, n_user + 1, blk, dtype=kv_dt, quantized=kv_quant
-            )
+            if mesh is not None:
+                # allocate DIRECTLY into the tp layout (same rationale as
+                # the dense mesh cache below: the pool may only fit HBM
+                # sharded)
+                from kserve_vllm_mini_tpu.parallel.sharding import (
+                    paged_kv_cache_shardings,
+                )
+
+                self._cache = jax.jit(
+                    partial(init_paged_kv_cache, cfg, n_user + 1, blk,
+                            dtype=kv_dt, quantized=kv_quant),
+                    out_shardings=paged_kv_cache_shardings(
+                        cfg, mesh, quantized=kv_quant
+                    ),
+                )()
+            else:
+                self._cache = init_paged_kv_cache(
+                    cfg, n_user + 1, blk, dtype=kv_dt, quantized=kv_quant
+                )
             self._free_blocks: list[int] = list(range(n_user))
             self._slot_blocks: list[list[int]] = [[] for _ in range(S)]
             self._block_table = np.full((S, self._maxb), self._scratch_block,
@@ -871,6 +892,7 @@ class Engine:
             return self._prefill_fns[key]
         cfg = self.cfg
         fwd = self._fwd
+        kernel_ok = self.mesh is None  # a 1-token chunk is a decode shape
 
         @partial(jax.jit, donate_argnums=(1,))
         def prefill(params, cache, tokens, length, trow, lora=None, ids=None):
@@ -882,6 +904,7 @@ class Engine:
                 fresh_prefill=True,
                 logit_index=(length - 1)[None],
                 block_table=trow,
+                paged_kernel_ok=kernel_ok,
                 **kw,
             )
             return nc, logits[0, 0]
@@ -895,6 +918,7 @@ class Engine:
             return self._prefill_fns[key]
         cfg = self.cfg
         fwd = self._fwd
+        kernel_ok = self.mesh is None  # a 1-token chunk is a decode shape
 
         @partial(jax.jit, donate_argnums=(1,))
         def chunk_prefill(params, cache, tokens, length, offset, trow,
@@ -906,6 +930,7 @@ class Engine:
                 cache, offset[None],
                 logit_index=(length - 1)[None],
                 block_table=trow,
+                paged_kernel_ok=kernel_ok,
                 **kw,
             )
             return nc, logits[0, 0]
@@ -932,6 +957,7 @@ class Engine:
         cfg = self.cfg
         fwd = self._fwd
         paged = self.paged
+        kernel_ok = self.mesh is None  # GSPMD-sharded pools use the gather
 
         @partial(jax.jit, donate_argnums=(1,))
         def decode(params, cache, tokens, lengths, temps, topks, topps, rng,
@@ -942,6 +968,7 @@ class Engine:
                 kw = {}
                 if paged:
                     kw["block_table"] = table
+                    kw["paged_kernel_ok"] = kernel_ok
                 if lora is not None:
                     kw["lora"], kw["lora_ids"] = lora, ids
                 logits, nc = fwd(
@@ -974,6 +1001,7 @@ class Engine:
         cfg = self.cfg
         fwd = self._fwd
         paged = self.paged
+        kernel_ok = self.mesh is None
 
         @partial(jax.jit, donate_argnums=(1,))
         def decode_masked(params, cache, tokens, lengths,
@@ -982,6 +1010,7 @@ class Engine:
             kw = {}
             if paged:
                 kw["block_table"] = table
+                kw["paged_kernel_ok"] = kernel_ok
             if lora is not None:
                 kw["lora"], kw["lora_ids"] = lora, ids
             logits, nc = fwd(

@@ -185,9 +185,30 @@ def test_never_fit_request_fails_fast(params):
     assert "KV blocks" in ev[1].get("error", "")
 
 
+def test_engine_paged_tp_mesh_matches_dense(params, dense_outputs):
+    """Paged pool sharded over a tp-only mesh (KV heads partitioned, the
+    table gather per-head under GSPMD) serves the same greedy tokens."""
+    from kserve_vllm_mini_tpu.parallel.mesh import MeshSpec, make_mesh
+    from kserve_vllm_mini_tpu.parallel.sharding import shard_params
+
+    mesh = make_mesh(MeshSpec(tp=2))
+    eng = Engine(
+        shard_params(params, CFG, mesh), CFG,
+        EngineConfig(max_slots=4, max_seq_len=64, kv_layout="paged",
+                     kv_block_size=16),
+        mesh=mesh,
+    )
+    assert _run_engine(eng, PROMPTS) == dense_outputs
+
+
 def test_scope_guards(params):
     with pytest.raises(ValueError, match="kv_layout"):
         Engine(params, CFG, EngineConfig(kv_layout="banana"))
+    from kserve_vllm_mini_tpu.parallel.mesh import MeshSpec, make_mesh
+
+    with pytest.raises(ValueError, match="tp-only"):
+        Engine(params, CFG, EngineConfig(kv_layout="paged"),
+               mesh=make_mesh(MeshSpec(dp=2, tp=2)))
     with pytest.raises(ValueError, match="kv_pool_blocks"):
         Engine(params, CFG, EngineConfig(kv_layout="paged", kv_pool_blocks=0))
     with pytest.raises(ValueError, match="kv_block_size"):
