@@ -134,12 +134,18 @@ def lora_delta(
     b_bank: jnp.ndarray,     # [N, r, out]
     ids: jnp.ndarray,        # [B] int32 adapter index per slot
 ) -> jnp.ndarray:
-    """Per-slot adapter delta (x @ A_i) @ B_i -> [B, T, out]. The gathers
-    materialize only the BATCH's factors ([B, in, r] — MBs at serving
-    ranks), never the bank."""
-    a = a_bank[ids]                                # [B, in, r]
-    b = b_bank[ids]                                # [B, r, out]
-    mid = jnp.einsum("btd,bdr->btr", x.astype(a.dtype), a)
+    """Per-slot adapter delta (x @ A_i) @ B_i -> [B, T, out] in f32. The
+    gathers materialize only the BATCH's factors ([B, in, r] — MBs at
+    serving ranks), never the bank.
+
+    The side-path runs in f32 end to end: the rank-r intermediates are
+    tiny (negligible HBM/FLOPs), and a bf16 mid would round BEFORE the
+    cross-shard psum when the contraction axis is tp-sharded (e.g. the
+    wo/w_down deltas on a mesh), compounding into logit drift ~the delta's
+    own magnitude across layers. The caller casts the finished delta once."""
+    a = a_bank[ids].astype(jnp.float32)            # [B, in, r]
+    b = b_bank[ids].astype(jnp.float32)            # [B, r, out]
+    mid = jnp.einsum("btd,bdr->btr", x.astype(jnp.float32), a)
     return jnp.einsum("btr,bro->bto", mid, b)
 
 

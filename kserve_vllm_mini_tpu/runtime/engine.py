@@ -419,12 +419,31 @@ class Engine:
 
         # multi-LoRA bank: per-slot adapter index decoded inside the same
         # jitted step; index 0 is the base (zero) adapter
+        if lora is not None:
+            if mesh is not None and any(
+                mesh.shape.get(ax, 1) > 1 for ax in ("dp", "sp", "pp", "ep")
+            ):
+                raise ValueError(
+                    "multi-LoRA composes with tp-only meshes (replicated "
+                    "banks); dp/sp/pp/ep need a LoRA-free engine"
+                )
+            if mesh is not None:
+                # replicate the bank over the mesh BEFORE it becomes engine
+                # state: factor banks are MBs at serving ranks, and a
+                # replicated delta lets GSPMD join it with the tp-sharded
+                # base projections however each target is partitioned (no
+                # per-target spec bookkeeping to get wrong). Hot-swap stays
+                # single-device (load_adapter).
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                rep = NamedSharding(mesh, PartitionSpec())
+                lora = {
+                    **lora,
+                    "layers": jax.device_put(lora["layers"], rep),
+                }
         self._lora = lora
         self._lora_names: dict[str, int] = dict(lora.get("names", {})) if lora else {}
         if lora is not None:
-            if mesh is not None:
-                raise ValueError("multi-LoRA does not support meshes yet; "
-                                 "serve adapters on a single-device engine")
             if drafter is not None:
                 # the drafter proposes from base weights; verification would
                 # accept base-model continuations for adapted slots. The
@@ -712,8 +731,9 @@ class Engine:
             if self.mesh is not None or self._drafter_params is not None \
                     or self.ecfg.prefix_cache:
                 raise ValueError(
-                    "multi-LoRA is not supported with meshes, drafters, or "
-                    "prefix_cache"
+                    "adapter HOT-SWAP stays single-device (preset --lora "
+                    "banks do serve on tp meshes), and multi-LoRA excludes "
+                    "drafters and prefix_cache"
                 )
             if self._lora is None:
                 rank = next(iter(adapter.values()))[0].shape[-1]
