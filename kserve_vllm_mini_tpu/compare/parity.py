@@ -25,7 +25,10 @@ from typing import Any, Optional
 
 import httpx
 
-CAPABILITIES = ["tools", "parallel_tools", "json_mode", "logprobs", "streaming"]
+CAPABILITIES = [
+    "tools", "parallel_tools", "json_mode", "logprobs", "streaming",
+    "sampling_penalties", "n_choices",
+]
 
 _WEATHER_TOOL = {
     "type": "function",
@@ -267,6 +270,81 @@ class ParityProber:
             extra={"ttft_ms": round(ttft_ms, 1), "chunks": chunks},
         )
 
+    async def probe_sampling_penalties(
+        self, client: httpx.AsyncClient
+    ) -> CapabilityResult:
+        """presence/frequency penalties must be accepted AND change the
+        output (reference scripts/loadtest.py:260-342 sends them; vLLM
+        honors them). Greedy + a large frequency penalty forbids token
+        repetition, so a repeat-y baseline and a penalized run must differ
+        unless the baseline already never repeats a token."""
+        base_body = {
+            "messages": [{"role": "user", "content": "ha ha ha ha ha"}],
+            "max_tokens": 24,
+            "temperature": 0,
+        }
+        status, data, ms = await self._chat(client, base_body)
+        if status != 200:
+            return CapabilityResult("sampling_penalties", False, ms, f"HTTP {status}")
+        baseline = data["choices"][0]["message"].get("content") or ""
+        status2, data2, ms2 = await self._chat(
+            client, {**base_body, "frequency_penalty": 2.0, "presence_penalty": 1.5},
+        )
+        if status2 != 200:
+            return CapabilityResult(
+                "sampling_penalties", False, ms + ms2,
+                f"penalized request HTTP {status2}",
+            )
+        penalized = data2["choices"][0]["message"].get("content") or ""
+        # a server that silently drops the knobs returns the identical
+        # greedy string; identical AND internally repetitive => dropped.
+        # Penalties operate on TOKENS, so whitespace words alone miss
+        # intra-word repetition ("hahahaha" is one word but heavily
+        # token-repetitive) — also flag any 4-char substring occurring 3+
+        # times (a character 4-gram repeated that often implies a repeated
+        # token for every practical tokenizer).
+        words = baseline.split()
+        rep_gram = any(
+            baseline.count(baseline[i:i + 4]) >= 3
+            for i in range(max(len(baseline) - 3, 0))
+        )
+        repetitive = len(words) > len(set(words)) or rep_gram
+        if penalized == baseline and repetitive:
+            return CapabilityResult(
+                "sampling_penalties", False, ms + ms2,
+                "penalties accepted but output unchanged (likely ignored)",
+            )
+        return CapabilityResult(
+            "sampling_penalties", True, ms + ms2,
+            "accepted and output diverged" if penalized != baseline
+            else "accepted (baseline had no repetition to penalize)",
+        )
+
+    async def probe_n_choices(self, client: httpx.AsyncClient) -> CapabilityResult:
+        """n>1 must return n distinct-index choices in one response."""
+        status, data, ms = await self._chat(
+            client,
+            {
+                "messages": [{"role": "user", "content": "Pick a number."}],
+                "max_tokens": 8,
+                "temperature": 0.9,
+                "n": 2,
+            },
+        )
+        if status != 200:
+            return CapabilityResult("n_choices", False, ms, f"HTTP {status}")
+        choices = data.get("choices") or []
+        if len(choices) != 2:
+            return CapabilityResult(
+                "n_choices", False, ms, f"asked n=2, got {len(choices)} choices"
+            )
+        idxs = sorted(c.get("index") for c in choices)
+        if idxs != [0, 1]:
+            return CapabilityResult(
+                "n_choices", False, ms, f"choice indexes {idxs} != [0, 1]"
+            )
+        return CapabilityResult("n_choices", True, ms, "2 choices, indexes [0, 1]")
+
     async def probe_all(self) -> list[CapabilityResult]:
         async with httpx.AsyncClient(timeout=self.timeout_s) as client:
             results = []
@@ -276,6 +354,8 @@ class ParityProber:
                 self.probe_json_mode,
                 self.probe_logprobs,
                 self.probe_streaming,
+                self.probe_sampling_penalties,
+                self.probe_n_choices,
             ):
                 try:
                     results.append(await probe(client))

@@ -35,7 +35,12 @@ import numpy as np
 
 from kserve_vllm_mini_tpu.models.config import ModelConfig
 from kserve_vllm_mini_tpu.models.llama import forward
-from kserve_vllm_mini_tpu.runtime.sampling import sample_tokens, token_logprobs
+from kserve_vllm_mini_tpu.runtime.sampling import (
+    apply_penalties,
+    count_tokens,
+    sample_tokens,
+    token_logprobs,
+)
 
 # Constrained decoding speaks the TOKEN protocol (runtime/token_grammar.py):
 # machines expose token_mask(budget) -> bool[V] / advance_token(id). Raw
@@ -177,6 +182,11 @@ class GenRequest:
     temperature: float = 0.0
     top_k: int = 0
     top_p: float = 1.0
+    # OpenAI presence/frequency penalties over generated tokens (vLLM
+    # semantics: output-only, prompt excluded). Applied device-side from a
+    # per-slot token-count table before sampling; 0.0 = bit-exact identity.
+    presence_penalty: float = 0.0
+    frequency_penalty: float = 0.0
     eos_id: Optional[int] = None
     request_id: str = field(default_factory=lambda: uuid.uuid4().hex[:16])
     # set by Engine.submit when the prompt was cut to max_prefill_len: the
@@ -480,6 +490,13 @@ class Engine:
 
         self._pending: "queue.Queue[RequestHandle]" = queue.Queue()
         self._rng = jax.random.PRNGKey(self.ecfg.seed)
+        # per-slot generated-token counts [S, V] int32, device-resident:
+        # the presence/frequency-penalty state (sampling.apply_penalties).
+        # int32 at the 8B headline geometry (80 x 128k) is 41 MB — 0.5% of
+        # the per-step weight stream, cheap enough to keep unconditional so
+        # the decode executable never re-traces when the first penalized
+        # request arrives. Rows are reset at admission, not at finish.
+        self._counts = jnp.zeros((S, self.cfg.vocab_size), jnp.int32)
         self._step_counter = 0
         self._prefill_fns: dict[tuple[int, bool], Any] = {}
         self._decode_fns: dict[int, Any] = {}
@@ -979,11 +996,11 @@ class Engine:
         paged = self.paged
         kernel_ok = self.mesh is None  # GSPMD-sharded pools use the gather
 
-        @partial(jax.jit, donate_argnums=(1,))
+        @partial(jax.jit, donate_argnums=(1, 8))
         def decode(params, cache, tokens, lengths, temps, topks, topps, rng,
-                   table=None, lora=None, ids=None):
+                   counts, pres, freqs, table=None, lora=None, ids=None):
             def body(carry, _):
-                c, toks, lens, r = carry
+                c, toks, lens, r, cnt = carry
                 r, sub = jax.random.split(r)
                 kw = {}
                 if paged:
@@ -994,15 +1011,19 @@ class Engine:
                 logits, nc = fwd(
                     params, cfg, toks[:, None], lens[:, None], c, lens, **kw,
                 )
-                lg = logits[:, 0, :]
+                lg = apply_penalties(logits[:, 0, :], cnt, pres, freqs)
                 nxt = sample_tokens(lg, sub, temps, topks, topps)
                 lp, tids, tlps = token_logprobs(lg, nxt)
-                return (nc, nxt, lens + 1, r), (nxt, lp, tids, tlps)
+                # counts update INSIDE the scan: the next fused step's
+                # penalty must see this step's emission
+                return (nc, nxt, lens + 1, r, count_tokens(cnt, nxt)), \
+                    (nxt, lp, tids, tlps)
 
-            (c, _, _, _), ys = jax.lax.scan(
-                body, (cache, tokens, lengths, rng), None, length=n_steps
+            (c, _, _, _, cnt), ys = jax.lax.scan(
+                body, (cache, tokens, lengths, rng, counts), None,
+                length=n_steps,
             )
-            return c, ys  # ys: ([n,S], [n,S], [n,S,K], [n,S,K])
+            return c, cnt, ys  # ys: ([n,S], [n,S], [n,S,K], [n,S,K])
 
         self._decode_fns[key] = decode
         return decode
@@ -1023,9 +1044,10 @@ class Engine:
         paged = self.paged
         kernel_ok = self.mesh is None
 
-        @partial(jax.jit, donate_argnums=(1,))
+        @partial(jax.jit, donate_argnums=(1, 8))
         def decode_masked(params, cache, tokens, lengths,
-                          temps, topks, topps, rng, packed_mask, use_mask,
+                          temps, topks, topps, rng, counts, pres, freqs,
+                          packed_mask, use_mask,
                           table=None, lora=None, ids=None):
             kw = {}
             if paged:
@@ -1037,13 +1059,14 @@ class Engine:
                 params, cfg, tokens[:, None], lengths[:, None], cache, lengths,
                 **kw,
             )
-            lg = logits[:, 0, :]
+            lg = apply_penalties(logits[:, 0, :], counts, pres, freqs)
             mask = _unpack_mask(packed_mask, cfg.vocab_size)
             lg_masked = jnp.where(mask, lg, -jnp.inf)
             lg = jnp.where(use_mask[:, None], lg_masked, lg)
             nxt = sample_tokens(lg, rng, temps, topks, topps)
             lp, tids, tlps = token_logprobs(lg, nxt)
-            return nc, (nxt[None], lp[None], tids[None], tlps[None])
+            return nc, count_tokens(counts, nxt), \
+                (nxt[None], lp[None], tids[None], tlps[None])
 
         self._decode_fns[key] = decode_masked
         return decode_masked
@@ -1174,6 +1197,21 @@ class Engine:
 
         self._decode_fns["first"] = first
         return first
+
+    def _get_reset_counts_fn(self):
+        """Jitted admission-time reset of one slot's penalty-count row:
+        zero it, then record the first generated token."""
+        fn = self._decode_fns.get("reset_counts")
+        if fn is not None:
+            return fn
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def reset(counts, slot, tok):
+            row = jnp.zeros((counts.shape[1],), counts.dtype).at[tok].add(1)
+            return jax.lax.dynamic_update_index_in_dim(counts, row, slot, 0)
+
+        self._decode_fns["reset_counts"] = reset
+        return reset
 
     def _pop_slot_for(self, prompt: list[int]) -> tuple[int, int]:
         """(slot, reused_prefix_len): with prefix caching on, prefer the
@@ -1382,6 +1420,12 @@ class Engine:
         self._slot_tokens[slot] = list(req.prompt_tokens) + [first_id]
         self._retained[slot] = []
         self._sampling_arrays = None  # slot population changed
+        # penalty state: clear the previous occupant's generated-token
+        # counts and record the first token (it IS a generated token — the
+        # next step's penalty must already see it)
+        self._counts = self._get_reset_counts_fn()(
+            self._counts, jnp.int32(slot), first_tok
+        )
         if machine is not None:
             machine.advance_token(first_id)
             if machine.done:
@@ -1403,6 +1447,14 @@ class Engine:
                      for i in range(S)], jnp.int32),
                 jnp.asarray(
                     [self._slot_req[i].request.top_p if self._slot_req[i] else 1.0
+                     for i in range(S)], jnp.float32),
+                jnp.asarray(
+                    [self._slot_req[i].request.presence_penalty
+                     if self._slot_req[i] else 0.0
+                     for i in range(S)], jnp.float32),
+                jnp.asarray(
+                    [self._slot_req[i].request.frequency_penalty
+                     if self._slot_req[i] else 0.0
                      for i in range(S)], jnp.float32),
             )
         return self._sampling_arrays
@@ -1496,6 +1548,8 @@ class Engine:
         spec = [
             i for i in active
             if self._slot_req[i].request.temperature == 0.0
+            and self._slot_req[i].request.presence_penalty == 0.0
+            and self._slot_req[i].request.frequency_penalty == 0.0
             and self._slot_machine[i] is None
             and not self._slot_req[i].request.logprobs
             # adapted slots can't speculate: the drafter proposes from base
@@ -1579,7 +1633,7 @@ class Engine:
         # The fed token occupies absolute position slot_len (prompt + generated
         # tokens already written); forward writes its KV there and attends <=.
         lengths = jnp.asarray(self._slot_len, dtype=jnp.int32)
-        temps, topks, topps = self._get_sampling_arrays()
+        temps, topks, topps, pres, freqs = self._get_sampling_arrays()
         self._rng, sub = jax.random.split(self._rng)
         t0 = time.time()
         if constrained:
@@ -1600,16 +1654,18 @@ class Engine:
             lkw["ids"] = self._adapter_ids()
         if constrained:
             decode = self._get_masked_decode_fn()
-            self._cache, ys = decode(
+            self._cache, self._counts, ys = decode(
                 self.params, self._cache,
                 tokens, lengths, temps, topks, topps, sub,
+                self._counts, pres, freqs,
                 jnp.asarray(mask), jnp.asarray(use_mask), **lkw,
             )
         else:
             decode = self._get_decode_fn(chunk)
-            self._cache, ys = decode(
+            self._cache, self._counts, ys = decode(
                 self.params, self._cache,
-                tokens, lengths, temps, topks, topps, sub, **lkw,
+                tokens, lengths, temps, topks, topps, sub,
+                self._counts, pres, freqs, **lkw,
             )
         # ONE host transfer for the whole chunk block — per-element
         # int(row[i]) costs a separate device readback each (chunk x slots

@@ -265,9 +265,19 @@ class CommandSubscriber:
 
 _REQ_FIELDS = (
     "prompt_tokens", "max_new_tokens", "temperature", "top_k", "top_p",
+    "presence_penalty", "frequency_penalty",
     "eos_id", "request_id", "truncated", "truncated_tokens",
     "logprobs", "top_logprobs",
 )
+
+# every sampling-relevant GenRequest field must cross to the followers, or
+# lockstep decode diverges (each process builds its own sampling arrays) —
+# this guard turns "someone added a field" into a loud test failure instead
+# of silent divergence
+_HOST_ONLY_FIELDS = {"constraint", "adapter"}
+assert set(_REQ_FIELDS) | _HOST_ONLY_FIELDS == {
+    f.name for f in __import__("dataclasses").fields(GenRequest)
+}, "GenRequest fields changed: update _REQ_FIELDS (or _HOST_ONLY_FIELDS)"
 
 
 def req_payload(req: GenRequest) -> dict[str, Any]:
@@ -275,6 +285,10 @@ def req_payload(req: GenRequest) -> dict[str, Any]:
         raise ValueError(
             "multi-host serving does not support grammar constraints (v1)"
         )
+    if req.adapter is not None:
+        # the adapter name is resolved against the PRIMARY's bank registry;
+        # followers would silently serve the base model (lockstep divergence)
+        raise ValueError("multi-host serving does not support LoRA (v1)")
     return {f: getattr(req, f) for f in _REQ_FIELDS}
 
 
@@ -293,6 +307,11 @@ def check_multihost_engine(engine: Engine) -> None:
         )
     if engine.ecfg.spec_tokens > 0:
         raise ValueError("multi-host serving does not support a drafter (v1)")
+    if engine._lora is not None:
+        raise ValueError(
+            "multi-host serving does not support LoRA (v1): adapter routing "
+            "is resolved against the primary's bank only"
+        )
 
 
 def run_primary(engine: Engine, publisher: CommandPublisher,

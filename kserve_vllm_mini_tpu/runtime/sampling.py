@@ -13,6 +13,37 @@ import jax
 import jax.numpy as jnp
 
 
+def apply_penalties(
+    logits: jnp.ndarray,      # [B, V] float32
+    counts: jnp.ndarray,      # [B, V] int32: per-slot GENERATED-token counts
+    presence: jnp.ndarray,    # [B] float32; 0 => disabled
+    frequency: jnp.ndarray,   # [B] float32; 0 => disabled
+) -> jnp.ndarray:
+    """OpenAI presence/frequency penalties over the generated-token counts.
+
+    vLLM semantics (the reference's flagship backend, which its load
+    generator exercises with these knobs — reference scripts/loadtest.py:
+    260-342): penalties consider OUTPUT tokens only, not the prompt.
+    ``counts`` is device-resident engine state updated inside the decode
+    scan, so fused multi-step chunks see each step's emission immediately.
+
+    Zero penalties are bit-exact identity (``x - 0.0 == x`` for every
+    float including ±inf), so unpenalized requests keep oracle equality.
+    """
+    cf = counts.astype(logits.dtype)
+    pen = frequency[:, None] * cf + jnp.where(
+        counts > 0, presence[:, None], jnp.zeros_like(presence)[:, None]
+    )
+    return logits - pen
+
+
+def count_tokens(counts: jnp.ndarray, tokens: jnp.ndarray) -> jnp.ndarray:
+    """Record one sampled token per slot in the counts table: [B, V] += 1
+    at (row, tokens[row])."""
+    B = counts.shape[0]
+    return counts.at[jnp.arange(B), tokens].add(1)
+
+
 def sample_tokens(
     logits: jnp.ndarray,        # [B, V] float32
     rng: jax.Array,

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import dataclasses
 import json
 import threading
 import time
@@ -467,6 +468,49 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
                 {"error": {"message": "top_logprobs must be >= 0"}}, status=400
             )
         top_lp = min(top_lp, 5)
+        # OpenAI sampling knobs the reference's loadgen sends to vLLM
+        # (reference scripts/loadtest.py:260-342): presence/frequency
+        # penalties and n/best_of fan-out. The in-repo engine must honor
+        # what the load generator exercises — silently dropping them would
+        # measure a different workload than the one requested.
+        try:
+            pres = float(body.get("presence_penalty", 0.0) or 0.0)
+            freq = float(body.get("frequency_penalty", 0.0) or 0.0)
+        except (TypeError, ValueError):
+            return web.json_response(
+                {"error": {"message": "penalties must be numbers"}}, status=400
+            )
+        if not (-2.0 <= pres <= 2.0 and -2.0 <= freq <= 2.0):
+            return web.json_response(
+                {"error": {"message":
+                           "presence_penalty/frequency_penalty must be in "
+                           "[-2, 2]"}}, status=400
+            )
+        try:
+            _n_raw = body.get("n")
+            n_choices = 1 if _n_raw is None else int(_n_raw)
+            _bo_raw = body.get("best_of")
+            fanout = n_choices if _bo_raw is None else int(_bo_raw)
+        except (TypeError, ValueError):
+            return web.json_response(
+                {"error": {"message": "n/best_of must be integers"}}, status=400
+            )
+        if n_choices < 1 or fanout < n_choices:
+            return web.json_response(
+                {"error": {"message": "need 1 <= n <= best_of"}}, status=400
+            )
+        if fanout > engine.ecfg.max_slots:
+            return web.json_response(
+                {"error": {"message":
+                           f"best_of={fanout} exceeds the engine's "
+                           f"{engine.ecfg.max_slots} slots"}}, status=400
+            )
+        if body.get("stream", False) and fanout > n_choices:
+            # OpenAI semantics: best_of ranking needs every candidate
+            # complete before any can stream
+            return web.json_response(
+                {"error": {"message": "best_of > n cannot stream"}}, status=400
+            )
         prompt = _messages_to_prompt(messages)
         prompt_ids = tok.encode(prompt)
         # multi-LoRA routing (vLLM convention): "model" names either the
@@ -498,19 +542,42 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
                     }},
                     status=404,
                 )
+        # best_of ranking needs per-token logprobs even when the client did
+        # not ask for them (they are stripped from the response)
+        rank_lp = fanout > n_choices
         req = GenRequest(
             prompt_tokens=prompt_ids or [tok.bos_id],
             max_new_tokens=max_tokens,
             temperature=float(body.get("temperature", 0.0)),
             top_k=int(body.get("top_k", 0)),
             top_p=float(body.get("top_p", 1.0)),
+            presence_penalty=pres,
+            frequency_penalty=freq,
             eos_id=None if machine is not None else tok.eos_id,
-            logprobs=want_logprobs,
+            logprobs=want_logprobs or rank_lp,
             top_logprobs=top_lp,
             constraint=machine,
             adapter=adapter,
         )
-        handle = engine.submit(req)
+        all_reqs = [req]
+        for _ in range(fanout - 1):
+            # each candidate needs its OWN grammar machine (stateful) and
+            # its own prompt list (submit rebinds it on truncation)
+            m_i = None
+            if machine is not None:
+                m_i, _, err_i = _build_constraint(body, max_tokens)
+                if err_i:  # cannot happen if the first build succeeded
+                    return web.json_response(
+                        {"error": {"message": err_i}}, status=400
+                    )
+            all_reqs.append(dataclasses.replace(
+                req,
+                prompt_tokens=list(req.prompt_tokens),
+                request_id=uuid.uuid4().hex[:16],
+                constraint=m_i,
+            ))
+        handles = [engine.submit(r) for r in all_reqs]
+        handle = handles[0]
         rid = f"chatcmpl-{uuid.uuid4().hex[:20]}"
         created = int(time.time())
         # OpenAI semantics: echo the served model — the adapter name when
@@ -522,63 +589,228 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
             return await loop.run_in_executor(None, handle.events.get)
 
         if not body.get("stream", False):
-            out_ids: list[int] = []
-            lp_entries: list[dict[str, Any]] = []
-            info: dict[str, Any] = {}
-            while True:
-                kind, *rest = await next_event()
-                if kind == "token":
-                    out_ids.append(rest[0])
-                    if want_logprobs and len(rest) > 2 and rest[2] is not None:
-                        lp_entries.append(_lp_entry(rest[0], rest[2], top_lp))
-                else:
-                    info = rest[0]
-                    break
-            text = (
-                _constrained_text(out_ids) if machine is not None
-                else tok.decode(out_ids)
-            )
-            if info.get("finish_reason") == "error":
-                # e.g. the constrained grammar cannot close inside the KV
-                # window — surface the engine's message, don't 200 it away
-                return web.json_response(
-                    {"error": {"message": info.get("error", "engine error")}},
-                    status=400,
+            async def collect(h: Any) -> tuple:
+                """Drain one candidate: (token ids, logprob entries,
+                cumulative chosen-token logprob, done info)."""
+                ids: list[int] = []
+                entries: list[dict[str, Any]] = []
+                lp_sum = 0.0
+                while True:
+                    kind, *rest = await loop.run_in_executor(
+                        None, h.events.get
+                    )
+                    if kind == "token":
+                        ids.append(rest[0])
+                        if len(rest) > 2 and rest[2] is not None:
+                            lp_sum += rest[2][0]
+                            if want_logprobs:
+                                entries.append(
+                                    _lp_entry(rest[0], rest[2], top_lp)
+                                )
+                    else:
+                        return ids, entries, lp_sum, rest[0]
+
+            # candidates decode concurrently in the engine; draining them
+            # in order only sequences the host-side bookkeeping
+            collected = [await collect(h) for h in handles]
+            for _ids, _e, _lp, info in collected:
+                if info.get("finish_reason") == "error":
+                    # e.g. the constrained grammar cannot close inside the
+                    # KV window — surface the engine's message, don't 200 it
+                    return web.json_response(
+                        {"error": {"message": info.get("error", "engine error")}},
+                        status=400,
+                    )
+            if fanout > n_choices:
+                # best_of: keep the n candidates with the highest log
+                # probability PER TOKEN (OpenAI's documented ranking —
+                # length-normalized, so a short early-EOS candidate cannot
+                # beat a longer, better-average one on raw sum; stable sort
+                # keeps submission order on ties)
+                collected = sorted(
+                    collected, key=lambda c: -c[2] / max(len(c[0]), 1)
+                )[:n_choices]
+            choices: list[dict[str, Any]] = []
+            completion_tokens = 0
+            for idx, (out_ids, lp_entries, _lp_sum, info) in enumerate(collected):
+                completion_tokens += len(out_ids)
+                text = (
+                    _constrained_text(out_ids) if machine is not None
+                    else tok.decode(out_ids)
                 )
-            message: dict[str, Any] = {"role": "assistant", "content": text}
-            finish = info.get("finish_reason", "stop")
-            if wants_tools:
-                calls = _tool_calls_from_text(text)
-                if calls is not None:
-                    message = {"role": "assistant", "content": None,
-                               "tool_calls": calls}
-                    finish = "tool_calls"
-            choice: dict[str, Any] = {
-                "index": 0,
-                "message": message,
-                "finish_reason": finish,
-            }
-            if want_logprobs:
-                choice["logprobs"] = {"content": lp_entries}
+                message: dict[str, Any] = {"role": "assistant", "content": text}
+                finish = info.get("finish_reason", "stop")
+                if wants_tools:
+                    calls = _tool_calls_from_text(text)
+                    if calls is not None:
+                        message = {"role": "assistant", "content": None,
+                                   "tool_calls": calls}
+                        finish = "tool_calls"
+                choice: dict[str, Any] = {
+                    "index": idx,
+                    "message": message,
+                    "finish_reason": finish,
+                }
+                if want_logprobs:
+                    choice["logprobs"] = {"content": lp_entries}
+                choices.append(choice)
+            info0 = collected[0][3]
             return web.json_response(
                 {
                     "id": rid,
                     "object": "chat.completion",
                     "created": created,
                     "model": resp_model,
-                    "choices": [choice],
+                    "choices": choices,
                     "usage": {
                         "prompt_tokens": len(prompt_ids),
-                        "completion_tokens": len(out_ids),
-                        "total_tokens": len(prompt_ids) + len(out_ids),
+                        "completion_tokens": completion_tokens,
+                        "total_tokens": len(prompt_ids) + completion_tokens,
                     },
                     "metrics": {
                         "server_ttft_ms": handle.server_ttft_ms,
-                        "truncated": bool(info.get("truncated", False)),
-                        "truncated_tokens": int(info.get("truncated_tokens", 0)),
+                        "truncated": bool(info0.get("truncated", False)),
+                        "truncated_tokens": int(info0.get("truncated_tokens", 0)),
                     },
                 }
             )
+
+        if len(handles) > 1:
+            # n>1 streaming (best_of == n, enforced above): merge the
+            # candidates' event queues and tag every chunk with its choice
+            # index — the OpenAI interleaved-stream shape. Identical
+            # submit-time parameters mean a submit rejection hits every
+            # candidate, so peeking choice 0 covers the 400-before-SSE case.
+            first_event = await next_event()
+            if (
+                first_event[0] == "done"
+                and first_event[1].get("finish_reason") == "error"
+            ):
+                return web.json_response(
+                    {"error": {"message":
+                               first_event[1].get("error", "engine error")}},
+                    status=400,
+                )
+            merged: asyncio.Queue = asyncio.Queue()
+
+            async def pump(idx: int, h: Any) -> None:
+                while True:
+                    evt = await loop.run_in_executor(None, h.events.get)
+                    await merged.put((idx, evt))
+                    if evt[0] == "done":
+                        return
+
+            # choice 0's first event was consumed by the peek — replay it,
+            # then pump every queue (pump 0 resumes from its second event;
+            # if the peeked event already WAS its 'done', there is nothing
+            # left to pump for it)
+            await merged.put((0, tuple(first_event)))
+            pumps = [asyncio.ensure_future(pump(i, h))
+                     for i, h in enumerate(handles)
+                     if i > 0 or first_event[0] != "done"]
+
+            resp = web.StreamResponse(
+                status=200,
+                headers={"Content-Type": "text/event-stream",
+                         "Cache-Control": "no-cache"},
+            )
+            await resp.prepare(request)
+            per_out = [0] * len(handles)
+            per_first = [False] * len(handles)
+            per_tools: list[list[int]] = [[] for _ in handles]
+            done_count = 0
+            try:
+                while done_count < len(handles):
+                    idx, (kind, *rest) = await merged.get()
+                    if kind == "token":
+                        per_out[idx] += 1
+                        if wants_tools:
+                            per_tools[idx].append(rest[0])
+                            if not per_first[idx]:
+                                await resp.write((
+                                    "data: " + json.dumps({
+                                        "id": rid,
+                                        "object": "chat.completion.chunk",
+                                        "created": created,
+                                        "model": resp_model,
+                                        "choices": [{"index": idx, "delta": {},
+                                                     "finish_reason": None}],
+                                        "metrics": {"server_ttft_ms":
+                                                    handles[idx].server_ttft_ms},
+                                    }) + "\n\n").encode())
+                                per_first[idx] = True
+                            continue
+                        piece = (
+                            _constrained_text([rest[0]]) if machine is not None
+                            else tok.decode([rest[0]])
+                        )
+                        chunk_choice = {
+                            "index": idx, "delta": {"content": piece},
+                            "finish_reason": None,
+                        }
+                        if want_logprobs and len(rest) > 2 and rest[2] is not None:
+                            chunk_choice["logprobs"] = {
+                                "content": [_lp_entry(rest[0], rest[2], top_lp)]
+                            }
+                        evt = {
+                            "id": rid, "object": "chat.completion.chunk",
+                            "created": created, "model": resp_model,
+                            "choices": [chunk_choice],
+                        }
+                        if not per_first[idx]:
+                            evt["metrics"] = {
+                                "server_ttft_ms": handles[idx].server_ttft_ms
+                            }
+                            per_first[idx] = True
+                        await resp.write(f"data: {json.dumps(evt)}\n\n".encode())
+                    else:
+                        done_count += 1
+                        info = rest[0]
+                        final_delta: dict[str, Any] = {}
+                        finish = info.get("finish_reason", "stop")
+                        if wants_tools:
+                            calls = _tool_calls_from_text(
+                                _constrained_text(per_tools[idx])
+                            )
+                            if calls is not None:
+                                final_delta = {"tool_calls": calls}
+                                finish = "tool_calls"
+                        final = {
+                            "id": rid, "object": "chat.completion.chunk",
+                            "created": created, "model": resp_model,
+                            "choices": [{"index": idx, "delta": final_delta,
+                                         "finish_reason": finish}],
+                            # same metrics block as the single-stream final
+                            # chunk: the loadgen must not lose truncation /
+                            # server-TTFT telemetry just because n>1
+                            "metrics": {
+                                "server_ttft_ms": handles[idx].server_ttft_ms,
+                                "truncated": bool(info.get("truncated", False)),
+                                "truncated_tokens": int(
+                                    info.get("truncated_tokens", 0)
+                                ),
+                            },
+                        }
+                        if done_count == len(handles):
+                            total_out = sum(per_out)
+                            final["usage"] = {
+                                "prompt_tokens": len(prompt_ids),
+                                "completion_tokens": total_out,
+                                "total_tokens": len(prompt_ids) + total_out,
+                            }
+                        await resp.write(f"data: {json.dumps(final)}\n\n".encode())
+                await resp.write(b"data: [DONE]\n\n")
+            except (ConnectionResetError, asyncio.CancelledError):
+                pass  # client went away; engine finishes the slots on its own
+            finally:
+                for p in pumps:
+                    if p is not None and not p.done():
+                        p.cancel()
+            try:
+                await resp.write_eof()
+            except ConnectionResetError:
+                pass
+            return resp
 
         # peek the first event before committing to an SSE response: a
         # submit-time rejection (immediate error 'done') must be a 400,

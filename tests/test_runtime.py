@@ -832,3 +832,101 @@ def test_spec_decode_with_chunked_prompt_matches_oracle(params, drafter_params):
         assert stats.get("spec_rounds", 0) >= 1
     finally:
         eng.stop()
+
+
+# -- presence/frequency penalties (OpenAI sampling surface) ------------------
+# The reference's load generator sends these to vLLM, which honors them
+# (reference scripts/loadtest.py:260-342) — the in-repo engine must too.
+
+
+def test_frequency_penalty_prevents_repeats(params):
+    """A huge frequency penalty makes every generated token unique: once
+    emitted, a token's logit drops below everything else. Greedy applies
+    the penalty too (argmax runs over the penalized logits)."""
+    eng = make_engine(params)
+    try:
+        prompt = [5, 9, 42, 7, 13]
+        ref = greedy_reference(params, prompt, 16)  # has repeats (9 of 16)
+        h = eng.submit(GenRequest(prompt_tokens=list(prompt), max_new_tokens=16,
+                                  frequency_penalty=2.0 * 1000))
+        toks, _ = _drain(h)
+        assert len(toks) == 16
+        assert len(set(toks)) == 16, f"penalized output repeated: {toks}"
+        assert toks != ref
+        assert toks[0] == ref[0]  # first token precedes any generated count
+    finally:
+        eng.stop()
+
+
+def test_presence_penalty_breaks_immediate_repeat(params):
+    """Greedy on this prompt emits [53, 53, ...]; any presence penalty big
+    enough to outweigh the logit gap must break the immediate repeat."""
+    eng = make_engine(params)
+    try:
+        prompt = [5, 9, 42, 7, 13]
+        ref = greedy_reference(params, prompt, 8)
+        assert ref[0] == ref[1]  # the oracle's immediate repeat
+        h = eng.submit(GenRequest(prompt_tokens=list(prompt), max_new_tokens=8,
+                                  presence_penalty=1000.0))
+        toks, _ = _drain(h)
+        assert toks[0] == ref[0]
+        assert toks[1] != toks[0]
+    finally:
+        eng.stop()
+
+
+def test_zero_penalties_bit_exact_oracle(params):
+    """Explicit 0.0 penalties take the penalty code path (subtract zero)
+    and must stay bit-identical to the oracle."""
+    eng = make_engine(params)
+    try:
+        prompt = [5, 9, 42, 7, 13]
+        ref = greedy_reference(params, prompt, 12)
+        h = eng.submit(GenRequest(prompt_tokens=list(prompt), max_new_tokens=12,
+                                  presence_penalty=0.0, frequency_penalty=0.0))
+        toks, _ = _drain(h)
+        assert toks == ref
+    finally:
+        eng.stop()
+
+
+def test_penalties_isolated_per_slot(params):
+    """A penalized request must not perturb an unpenalized neighbor (counts
+    are per-slot rows), across admissions reusing the same slot."""
+    eng = make_engine(params, slots=2)
+    try:
+        prompt = [5, 9, 42, 7, 13]
+        ref = greedy_reference(params, prompt, 12)
+        hp = eng.submit(GenRequest(prompt_tokens=list(prompt), max_new_tokens=12,
+                                   frequency_penalty=2000.0))
+        hn = eng.submit(GenRequest(prompt_tokens=list(prompt), max_new_tokens=12))
+        toks_p, _ = _drain(hp)
+        toks_n, _ = _drain(hn)
+        assert toks_n == ref
+        assert len(set(toks_p)) == 12
+        # slot reuse after a penalized occupant: counts row must be reset
+        h2 = eng.submit(GenRequest(prompt_tokens=list(prompt), max_new_tokens=12))
+        h3 = eng.submit(GenRequest(prompt_tokens=list(prompt), max_new_tokens=12))
+        assert _drain(h2)[0] == ref
+        assert _drain(h3)[0] == ref
+    finally:
+        eng.stop()
+
+
+def test_penalties_with_chunked_decode(params):
+    """Fused multi-step chunks update counts INSIDE the scan: a penalty must
+    see tokens emitted earlier in the same chunk."""
+    eng = Engine(
+        params, CFG,
+        EngineConfig(max_slots=2, max_seq_len=128, max_prefill_len=64,
+                     min_prefill_bucket=16, decode_chunk=4),
+    )
+    eng.start()
+    try:
+        prompt = [5, 9, 42, 7, 13]
+        h = eng.submit(GenRequest(prompt_tokens=list(prompt), max_new_tokens=16,
+                                  frequency_penalty=2000.0))
+        toks, _ = _drain(h)
+        assert len(set(toks)) == 16, f"within-chunk repeat: {toks}"
+    finally:
+        eng.stop()
