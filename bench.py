@@ -7,46 +7,49 @@ Llama-3.1-8B-class @ v5e).
 Prints ONE JSON line:
   {"metric": "...", "value": N, "unit": "...", "vs_baseline": N, "detail": {...}}
 
-What it measures (honest accounting per VERDICT.md round-1 #4):
-- decode tokens/sec/chip: steady-state fused decode with all slots busy,
-  int8 weights (8B bf16 does not fit one v5e's 16 GB HBM; int8 is the
-  serving config the validator maps to v5e), donated caches.
-- ttft_p50_ms: steady-state single-request prefill latency (128-token
-  bucket, cache-write, flash-attention path) — the server-side TTFT a warm
-  engine adds to a request. Under the remote-TPU relay every dispatch+
-  readback pays a measured tunnel RTT (~70 ms) that a PCIe-attached serving
-  host does not; the bench times an already-compiled 1-element no-op the
-  same way to isolate it and reports both the raw number and
-  ttft_p50_adjusted_ms = raw - rtt_p50 (the device-side TTFT).
-- hbm_bw_util / mfu: achieved HBM weight+KV streaming as a fraction of v5e
-  peak (819 GB/s) and MXU utilization vs bf16 peak (197 TFLOP/s).
-- flash_prefill_lowered: asserts the prefill executable contains the Pallas
-  kernel custom-call on TPU (the serving path provably executes the kernel,
-  ops/flash_attention.py contract).
+Architecture (reworked for VERDICT round-4 "Next round" #1): the relay to
+the TPU can wedge such that every dispatch blocks FOREVER, with observed
+wedge windows of ~40 minutes (docs/TROUBLESHOOTING.md). Three rounds of
+driver benches died to this. The orchestrator therefore:
+
+  1. probes the backend with a no-op dispatch in a SUBPROCESS under a hard
+     timeout, on an ADAPTIVE retry schedule bounded by a total budget
+     (KVMINI_BENCH_PROBE_BUDGET_S, default 1800 s) instead of a fixed
+     3x75 s that gives up long before a transient wedge clears;
+  2. runs each sub-benchmark (headline decode+TTFT+prefill buckets, paged
+     KV, speculative decode, int4) as its OWN child process under its own
+     timeout, in order of importance — a wedge mid-queue costs only the
+     remaining sub-benches, never the ones already measured;
+  3. persists every completed sub-measurement incrementally (children
+     append to a progress file after each step; the parent folds partial
+     progress into the artifact even when the child dies mid-run);
+  4. ALWAYS prints exactly one JSON line and exits 0 — also on SIGTERM,
+     so a driver-side timeout still lands whatever finished. A failed run
+     reports the failure and the retry plan, nothing else (no re-asserted
+     headline claims from previous sessions).
+
+Sub-benchmark children are selected with KVMINI_BENCH_CHILD=<mode>:
+  headline  decode tok/s/chip (int8, 80 slots), steady-state TTFT p50 with
+            tunnel-RTT correction, prefill throughput+MFU for the
+            128/512/2048 buckets, HBM/MFU accounting, $-and-Wh economics
+  paged     the same decode workload through the block-pool cache + Pallas
+            paged-decode kernel at identical geometry (kernel custom-call
+            asserted in the lowered executable on TPU)
+  spec      speculative decoding with a NAMED small drafter (llama-1b,
+            distinct param trees — no relayout copy; VERDICT round-4 #3):
+            accept ratio + measured speedup vs a served-style step
+  int4      packed-nibble int4 weights at headline geometry (first TPU
+            validation of the nibble workaround)
 
 Model size is overridable (KVMINI_BENCH_MODEL=llama-1b etc.) so the same
 script smoke-tests on CPU; the driver runs the default 8B config.
-
-Wedge-proofing (VERDICT.md round-3 weak #1 — two straight rounds of rc=1):
-the remote-TPU relay can wedge such that every dispatch blocks FOREVER (no
-in-process call can time out of it), and backend init can raise UNAVAILABLE.
-This script therefore runs as a small orchestrator:
-
-  1. probe the backend with a no-op dispatch in a SUBPROCESS under a hard
-     timeout (a wedged relay hangs the child; the parent survives);
-  2. run the actual benchmark in a second subprocess (KVMINI_BENCH_CHILD=1)
-     under its own timeout, so even a mid-run wedge or OOM cannot keep the
-     parent from emitting its one line;
-  3. ALWAYS print exactly one JSON line on stdout and exit 0 — with
-     "status": "ok" and the measurements, or "status":
-     "tpu_unavailable"/"oom"/"timeout"/"error" plus the error tail when the
-     run could not complete.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import signal
 import subprocess
 import sys
 import tempfile
@@ -56,7 +59,6 @@ import time
 V5E_HBM_GBPS = 819.0
 V5E_BF16_TFLOPS = 197.0
 
-
 _DEFAULT_MODEL = "llama-3.1-8b"
 _DEFAULT_QUANT = "int8"
 # 80 slots measured 3,067 tok/s/chip vs 2,744 at 64 (r4 session) — the KV
@@ -65,6 +67,14 @@ _DEFAULT_QUANT = "int8"
 # (_FALLBACK_SLOTS) so a marginal-HBM compile can't cost the headline.
 _DEFAULT_SLOTS = "80"
 _FALLBACK_SLOTS = "64"
+_BASELINE_TOKS = 2000.0  # north-star output tokens/sec/chip
+
+_T_START = time.time()
+
+
+def _log(msg: str) -> None:
+    """Stage progress on stderr (stdout carries only the one JSON line)."""
+    print(f"[bench +{time.time() - _T_START:.0f}s] {msg}", file=sys.stderr, flush=True)
 
 
 def _env_model() -> str:
@@ -79,23 +89,105 @@ def _env_slots() -> int:
     return int(os.environ.get("KVMINI_BENCH_SLOTS", _DEFAULT_SLOTS))
 
 
-def _log(msg: str) -> None:
-    """Stage progress on stderr (stdout carries only the one JSON line)."""
-    print(f"[bench +{time.time() - _T_START:.0f}s] {msg}", file=sys.stderr, flush=True)
+# ---------------------------------------------------------------------------
+# Child-side: incremental progress + the sub-benchmark bodies.
+# ---------------------------------------------------------------------------
+
+def _progress(key: str, data: dict) -> None:
+    """Append one completed sub-measurement to the progress file. The parent
+    reads this when the child dies mid-run — whatever finished still lands
+    in the artifact (VERDICT round-4 #1: the r4 mid-queue wedge cost the
+    session every number after the first)."""
+    path = os.environ.get("KVMINI_BENCH_PROGRESS")
+    if not path:
+        return
+    with open(path, "a") as f:
+        f.write(json.dumps({"key": key, "data": data}) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
 
 
-_T_START = time.time()
-
-
-def _run_bench() -> dict:
+def _child_setup():
+    """Shared child preamble: honor JAX_PLATFORMS despite the site hook
+    having imported jax first (safe pre-device-touch), import the stack."""
     import jax
 
-    # Same site-hook workaround as _probe: honor JAX_PLATFORMS even though
-    # the axon site imported jax before us (safe pre-device-touch).
-    _plat = os.environ.get("JAX_PLATFORMS")
-    if _plat:
-        jax.config.update("jax_platforms", _plat)
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        jax.config.update("jax_platforms", plat)
+    return jax
 
+
+def _timed_readback(fn, *args, n: int = 15):
+    """p50 of n timed dispatch+readback runs of an already-compiled fn."""
+    import numpy as np
+
+    times = []
+    for _ in range(n):
+        t0 = time.time()
+        _ = np.asarray(fn(*args))
+        times.append((time.time() - t0) * 1000.0)
+    return float(np.percentile(times, 50))
+
+
+def _tunnel_rtt(jax, jnp, np) -> float:
+    """Fixed per-readback tax under the remote relay: dispatch + 1-element
+    readback of a compiled no-op, timed exactly like the TTFT loop. Sub-ms
+    on a PCIe-attached host."""
+    noop = jax.jit(lambda x: x + 1)
+    xs = jnp.zeros((1,), jnp.int32)
+    _ = np.asarray(noop(xs))
+    return _timed_readback(noop, xs)
+
+
+def _economics(jax, toks_per_sec: float, n_chips: int, on_tpu: bool) -> dict:
+    """$/1K tokens and Wh/1K tokens from the chip-hour sheet + the modeled
+    telemetry leg (decode keeps the chip busy => duty ~1 during the timed
+    window), provenance-labeled like energy/collector.py's fallback chain."""
+    from kserve_vllm_mini_tpu.analysis.telemetry import modeled_power
+    from kserve_vllm_mini_tpu.costs.pricing import load_pricing
+
+    try:
+        if not on_tpu:
+            # a CPU smoke run must not fabricate TPU economics
+            return {"cost_per_1k_tokens_usd": 0.0, "energy_wh_per_1k_tokens": 0.0,
+                    "cost_basis": "n/a (not on TPU)",
+                    "energy_provenance": "n/a (not on TPU)"}
+        kind = jax.devices()[0].device_kind.lower()
+        if "v6" in kind:
+            tpu_gen = "v6e"   # Trillium reports "TPU v6 lite" — check the
+                              # generation before the "lite" tier
+        elif "lite" in kind or "v5e" in kind:
+            tpu_gen = "v5e"
+        elif "v5" in kind:
+            tpu_gen = "v5p"
+        else:
+            tpu_gen = "v4"
+        pricing = load_pricing()
+        chip_hourly, price_key = pricing.chip_price(tpu_gen)
+        overhead = 1.0 + pricing.overhead_factor
+        cost_per_1k = chip_hourly * overhead * n_chips / max(toks_per_sec, 1e-9) / 3.6
+        watts = modeled_power(1.0, tpu_gen) * n_chips
+        wh_per_1k = watts * (1000.0 / max(toks_per_sec, 1e-9)) / 3600.0
+        return {
+            "cost_per_1k_tokens_usd": round(cost_per_1k, 6),
+            "energy_wh_per_1k_tokens": round(wh_per_1k, 4),
+            "cost_basis": f"{price_key} ${chip_hourly}/chip-hr x{overhead:.2f} overhead",
+            "energy_provenance":
+                f"modeled ({tpu_gen} duty 1.0 x TDP, analysis/telemetry.py)",
+        }
+    except Exception as e:  # noqa: BLE001 — the headline must survive a
+        # pricing-sheet or device-introspection hiccup
+        _log(f"economics skipped: {type(e).__name__}: {e}")
+        return {"cost_per_1k_tokens_usd": 0.0, "energy_wh_per_1k_tokens": 0.0,
+                "cost_basis": f"unavailable ({type(e).__name__})",
+                "energy_provenance": f"unavailable ({type(e).__name__})"}
+
+
+def _run_serving_child(mode: str) -> dict:
+    """headline / paged / int4: decode throughput + TTFT (+ prefill buckets
+    for headline) on the flagship config. `mode` picks cache layout/quant."""
+    jax = _child_setup()
     import jax.numpy as jnp
     import numpy as np
 
@@ -112,7 +204,8 @@ def _run_bench() -> dict:
     from kserve_vllm_mini_tpu.runtime.sampling import sample_tokens
 
     model = _env_model()
-    quant = _env_quant()
+    quant = "int4" if mode == "int4" else _env_quant()
+    paged = mode == "paged" or os.environ.get("KVMINI_BENCH_PAGED", "") == "1"
     kv_quant = os.environ.get("KVMINI_BENCH_KV", "") == "int8"
     # more slots amortize the 9 GB int8 weight stream over more tokens per
     # step (measured 1710 @ 32 -> 2744 @ 64 -> 3067 @ 80 tok/s/chip on the
@@ -126,11 +219,11 @@ def _run_bench() -> dict:
     on_tpu = jax.default_backend() == "tpu"
     unroll = int(os.environ.get("KVMINI_BENCH_UNROLL", "1"))
     cfg = get_config(model, max_seq_len=max_seq, scan_unroll=unroll)
-    _log(f"model={model} quant={quant} slots={slots} unroll={unroll} "
-         f"backend={jax.default_backend()}")
-    # int8 weights are built layer-by-layer straight into int8 leaves — the
-    # full-precision 8B tree (~16 GB bf16) must NEVER exist on a 16 GB v5e
-    # (round-2 OOM, VERDICT.md Weak #1)
+    _log(f"mode={mode} model={model} quant={quant} slots={slots} paged={paged} "
+         f"unroll={unroll} backend={jax.default_backend()}")
+    # int8/int4 weights are built layer-by-layer straight into quantized
+    # leaves — the full-precision 8B tree (~16 GB bf16) must NEVER exist on
+    # a 16 GB v5e (round-2 OOM)
     if quant in ("int8", "int4"):
         params = init_params_quantized(
             jax.random.PRNGKey(0), cfg, bits=4 if quant == "int4" else 8
@@ -141,11 +234,6 @@ def _run_bench() -> dict:
     param_bytes = quantized_bytes(params)
     _log(f"params ready ({param_bytes / 1e9:.2f} GB on device)")
 
-    # KVMINI_BENCH_PAGED=1: run the same workload through the block-pool
-    # cache + the Pallas paged-decode kernel (ops/paged_attention.py) —
-    # measures the kernel against the dense path at identical geometry.
-    # Contiguous per-slot block ranges (the allocator's common case).
-    paged = os.environ.get("KVMINI_BENCH_PAGED", "") == "1"
     blk = 64  # paged block size, shared by the batch and TTFT caches
     block_table = None
     if paged:
@@ -157,8 +245,10 @@ def _run_bench() -> dict:
     else:
         cache = init_kv_cache(cfg, slots, max_seq=max_seq, quantized=kv_quant)
     tkw = {"block_table": block_table} if paged else {}
-    toks = jax.random.randint(jax.random.PRNGKey(1), (slots, prompt_len), 0, cfg.vocab_size)
-    pos = jnp.broadcast_to(jnp.arange(prompt_len, dtype=jnp.int32), (slots, prompt_len))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (slots, prompt_len), 0,
+                              cfg.vocab_size)
+    pos = jnp.broadcast_to(jnp.arange(prompt_len, dtype=jnp.int32),
+                           (slots, prompt_len))
 
     # -- batch prefill to fill all slots (fresh-prefill / flash path) -------
     @partial(jax.jit, donate_argnums=(1,))
@@ -173,6 +263,8 @@ def _run_bench() -> dict:
 
     # -- single-request prefill: the per-request TTFT cost ------------------
     if paged:
+        from kserve_vllm_mini_tpu.models.llama import init_paged_kv_cache
+
         cache1 = init_paged_kv_cache(cfg, max_seq // blk, blk, quantized=kv_quant)
         t1kw = {"block_table": jnp.arange(max_seq // blk, dtype=jnp.int32)[None]}
     else:
@@ -191,9 +283,9 @@ def _run_bench() -> dict:
     lowered = prefill_one.lower(params, cache1, toks1, pos1).compile()
     hlo = lowered.as_text()
     flash_lowered = "tpu_custom_call" in hlo
-    # ADVICE.md round-2: "tpu_custom_call" matches ANY TPU custom call; the
-    # Mosaic backend_config embeds the kernel's function name, so also look
-    # for the flash kernel specifically (reported, not asserted — the name
+    # "tpu_custom_call" matches ANY TPU custom call; the Mosaic
+    # backend_config embeds the kernel's function name, so also look for
+    # the flash kernel specifically (reported, not asserted — the name
     # embedding is a lowering detail the assert must not couple to)
     flash_named = "_flash_kernel" in hlo
     _log(f"prefill compiled (flash_lowered={flash_lowered}, named={flash_named})")
@@ -202,6 +294,80 @@ def _run_bench() -> dict:
             "serving prefill must lower the Pallas flash kernel on TPU "
             "(ops/flash_attention.prefill_attention dispatch)"
         )
+
+    # NOTE on timing: under the remote-TPU relay, block_until_ready() does
+    # not guarantee device-side completion — only a host readback does, and
+    # a readback pays the tunnel RTT. Latencies are timed WITH the readback
+    # and reported next to the separately-measured RTT floor; throughput is
+    # timed over two chained runs of different lengths, differenced, so the
+    # RTT and dispatch overheads cancel.
+    _log("batch prefill (first call: compile + run)")
+    t0 = time.time()
+    cache, tokens = prefill_batch(params, cache, toks, pos)
+    _ = np.asarray(tokens)
+    prefill_first_s = time.time() - t0
+    _log(f"batch prefill done in {prefill_first_s:.1f}s")
+
+    # steady-state single-request prefill p50 (TTFT)
+    _ = np.asarray(prefill_one(params, cache1, toks1, pos1))  # warm
+    ttft_p50 = _timed_readback(prefill_one, params, cache1, toks1, pos1)
+    rtt_p50 = _tunnel_rtt(jax, jnp, np)
+    ttft_adj = max(ttft_p50 - rtt_p50, 0.0)
+    n_chips = jax.device_count()
+    _progress(f"{mode}.ttft", {
+        "ttft_p50_ms": round(ttft_p50, 2),
+        "tunnel_rtt_p50_ms": round(rtt_p50, 2),
+        "ttft_p50_adjusted_ms": round(ttft_adj, 2),
+        "flash_prefill_lowered": bool(flash_lowered),
+    })
+
+    # -- prefill throughput buckets (VERDICT round-4 #8: prefill is the
+    # compute-bound side — tokens/s/chip + MFU, not just TTFT) ------------
+    prefill_rows = {}
+    if mode == "headline":
+        for T in (128, 512, 2048):
+            try:
+                cfgT = cfg if T <= max_seq else get_config(
+                    model, max_seq_len=T, scan_unroll=unroll
+                )
+                cT = init_kv_cache(cfgT, 1, max_seq=max(T, max_seq),
+                                   quantized=kv_quant)
+                tT = jax.random.randint(jax.random.PRNGKey(4), (1, T), 0,
+                                        cfg.vocab_size)
+                pT = jnp.arange(T, dtype=jnp.int32)[None]
+
+                @jax.jit
+                def prefill_T(params, cache, toks, pos, _cfg=cfgT, _T=T):
+                    lg, cache = forward(
+                        params, _cfg, toks, pos, cache,
+                        jnp.zeros((1,), jnp.int32), fresh_prefill=True,
+                        logit_index=jnp.full((1,), _T - 1, jnp.int32),
+                    )
+                    return jnp.argmax(lg[:, -1, :], axis=-1).astype(jnp.int32)
+
+                _ = np.asarray(prefill_T(params, cT, tT, pT))  # compile+warm
+                ms = _timed_readback(prefill_T, params, cT, tT, pT, n=9)
+                dev_ms = max(ms - rtt_p50, 1e-6)
+                tps = T / (dev_ms / 1000.0)
+                # prefill FLOPs: 2*P*T matmul + 2*2*L*H*T^2*hd attention
+                att = 4.0 * cfg.n_layers * cfg.n_heads * T * T * cfg.head_dim
+                flops = 2.0 * cfg.param_count * T + att
+                mfu = (flops / (dev_ms / 1000.0)) / (V5E_BF16_TFLOPS * 1e12) \
+                    if on_tpu else 0.0
+                prefill_rows[str(T)] = {
+                    "ms_p50": round(ms, 2),
+                    "ms_device": round(dev_ms, 2),
+                    "tokens_per_sec_per_chip": round(tps / n_chips, 1),
+                    "mfu": round(mfu, 4),
+                }
+                _log(f"prefill bucket {T}: {prefill_rows[str(T)]}")
+                del cT
+            except Exception as e:  # noqa: BLE001 — a failed long bucket
+                # (e.g. 2048 OOM next to the serving caches) must not cost
+                # the buckets already measured
+                prefill_rows[str(T)] = {"error": f"{type(e).__name__}: {e}"}
+                _log(f"prefill bucket {T} failed: {e}")
+        _progress("headline.prefill_buckets", prefill_rows)
 
     @partial(jax.jit, donate_argnums=(1,))
     def decode(params, cache, tokens, lengths, rng):
@@ -215,42 +381,21 @@ def _run_bench() -> dict:
         )
         return cache, nxt
 
-    # NOTE on timing: under the remote-TPU relay, block_until_ready() does not
-    # guarantee device-side completion — only a host readback does, and a
-    # readback pays the tunnel RTT. We therefore time two chained runs of
-    # different lengths, each ended by a readback, and difference them so the
-    # RTT and dispatch overheads cancel.
-    _log("batch prefill (first call: compile + run)")
-    t0 = time.time()
-    cache, tokens = prefill_batch(params, cache, toks, pos)
-    _ = np.asarray(tokens)
-    prefill_first_s = time.time() - t0
-    _log(f"batch prefill done in {prefill_first_s:.1f}s")
-
-    # steady-state single-request prefill p50 (TTFT)
-    ttfts = []
-    _ = np.asarray(prefill_one(params, cache1, toks1, pos1))  # warm (compiled above)
-    for _i in range(15):
-        t0 = time.time()
-        out = prefill_one(params, cache1, toks1, pos1)
-        _ = np.asarray(out)
-        ttfts.append((time.time() - t0) * 1000.0)
-    ttft_p50 = float(np.percentile(ttfts, 50))
-
-    # tunnel RTT floor: dispatch + 1-element readback of a compiled no-op,
-    # timed exactly like the TTFT loop. On a PCIe-attached host this is
-    # sub-ms; under the remote relay it is the fixed per-readback tax every
-    # latency above includes.
-    noop = jax.jit(lambda x: x + 1)
-    xs = jnp.zeros((1,), jnp.int32)
-    _ = np.asarray(noop(xs))
-    rtts = []
-    for _i in range(15):
-        t0 = time.time()
-        _ = np.asarray(noop(xs))
-        rtts.append((time.time() - t0) * 1000.0)
-    rtt_p50 = float(np.percentile(rtts, 50))
-    ttft_adj = max(ttft_p50 - rtt_p50, 0.0)
+    # paged mode: assert the Pallas paged-decode kernel is in the decode
+    # executable (same contract as flash_prefill_lowered; VERDICT r4 #2)
+    paged_kernel_lowered = None
+    if paged:
+        lengths0 = jnp.full((slots,), prompt_len, dtype=jnp.int32)
+        dhlo = decode.lower(
+            params, cache, tokens, lengths0, jax.random.PRNGKey(2),
+        ).compile().as_text()
+        paged_kernel_lowered = "tpu_custom_call" in dhlo
+        _log(f"paged decode compiled (kernel_lowered={paged_kernel_lowered})")
+        if on_tpu:
+            assert paged_kernel_lowered, (
+                "paged decode must lower the Pallas paged-attention kernel "
+                "on TPU (ops/paged_attention.py dispatch)"
+            )
 
     lengths = jnp.full((slots,), prompt_len, dtype=jnp.int32)
     rng = jax.random.PRNGKey(2)
@@ -279,8 +424,6 @@ def _run_bench() -> dict:
     dt = max(t_long - t_short, 1e-9)
     n_timed = decode_steps - n_short
     step_ms = dt / n_timed * 1000.0
-
-    n_chips = jax.device_count()
     toks_per_sec = slots * n_timed / dt
     per_chip = toks_per_sec / n_chips
 
@@ -296,269 +439,229 @@ def _run_bench() -> dict:
     bytes_step = param_bytes + kv_bytes_step
     bw_gbps = bytes_step / (dt / n_timed) / 1e9
     bw_util = bw_gbps / V5E_HBM_GBPS if on_tpu else 0.0
-
     flops_step = 2.0 * cfg.param_count * slots
     mfu = (flops_step / (dt / n_timed)) / (V5E_BF16_TFLOPS * 1e12) if on_tpu else 0.0
 
-    # -- north-star economics: $/1K tokens and Wh/1K tokens -----------------
-    # (BASELINE.md asks for both populated on the 8B @ v5e config.) Cost
-    # comes from the chip-hour sheet x the measured throughput; energy is
-    # the telemetry chain's MODELED leg (decode keeps the chip busy, so
-    # duty ~= 1 during the timed window) — provenance marked, same contract
-    # as energy/collector.py's fallback chain.
-    from kserve_vllm_mini_tpu.analysis.telemetry import modeled_power
-    from kserve_vllm_mini_tpu.costs.pricing import load_pricing
-
-    try:
-        if on_tpu:
-            # price/TDP keyed by the ACTUAL chip generation, not assumed v5e
-            kind = jax.devices()[0].device_kind.lower()
-            if "v6" in kind:
-                tpu_gen = "v6e"      # Trillium reports "TPU v6 lite" — check
-                                     # the generation before the "lite" tier
-            elif "lite" in kind or "v5e" in kind:
-                tpu_gen = "v5e"
-            elif "v5" in kind:
-                tpu_gen = "v5p"
-            else:
-                tpu_gen = "v4"
-            pricing = load_pricing()
-            chip_hourly, price_key = pricing.chip_price(tpu_gen)
-            overhead = 1.0 + pricing.overhead_factor
-            cost_per_1k = (
-                chip_hourly * overhead * n_chips / max(toks_per_sec, 1e-9) / 3.6
-            )
-            watts = modeled_power(1.0, tpu_gen) * n_chips
-            wh_per_1k = watts * (1000.0 / max(toks_per_sec, 1e-9)) / 3600.0
-            cost_basis = f"{price_key} ${chip_hourly}/chip-hr x{overhead:.2f} overhead"
-            energy_prov = f"modeled ({tpu_gen} duty 1.0 x TDP, analysis/telemetry.py)"
-        else:
-            # like mfu/bw_util: a CPU smoke run must not fabricate TPU economics
-            cost_per_1k = wh_per_1k = 0.0
-            cost_basis = energy_prov = "n/a (not on TPU)"
-    except Exception as e:  # noqa: BLE001 — the headline number must survive
-        # a pricing-sheet or device-introspection hiccup
-        _log(f"economics skipped: {type(e).__name__}: {e}")
-        cost_per_1k = wh_per_1k = 0.0
-        cost_basis = energy_prov = f"unavailable ({type(e).__name__})"
-
-    # -- speculative decoding measurement (KVMINI_BENCH_SPEC=k) -------------
-    # Reference claim: 20-40% decode improvement at real acceptance rates
-    # (README.md:118). With random weights a small drafter accepts ~0 (its
-    # argmax and the target's agree at chance), so KVMINI_BENCH_DRAFTER=self
-    # (default) measures the accept=1 UPPER BOUND of the fused spec path and
-    # a named preset (e.g. llama-1b) measures the accept~0 overhead floor —
-    # the two brackets real-checkpoint behavior, and accept_ratio is
-    # reported so the bracket is explicit.
-    spec_detail = None
-    spec_k = int(os.environ.get("KVMINI_BENCH_SPEC", "0"))
-    if spec_k > 0:
-        from kserve_vllm_mini_tpu.runtime.engine import build_spec_step
-
-        drafter = os.environ.get("KVMINI_BENCH_DRAFTER", "self")
-        # spec runs at its own (smaller) batch: it needs TWO caches (target
-        # + drafter) resident at once, which at the headline slot default
-        # plus the int8 8B weights exceeds the v5e's 16 GB. The headline
-        # caches are dropped first; speedup math is per-slot-normalized, so
-        # the slot count only needs to match between the spec rounds and the
-        # served-style comparison below.
-        #
-        # KVMINI_BENCH_SPEC_SLOTS: drafter=self at 8B needs headroom for a
-        # second LAYOUT of the whole weight tree (XLA wants different int8
-        # minor-to-major orders for the drafter's T=1 scan vs the target's
-        # T=k verify when they share params — measured +5.9 GB over HBM at
-        # 32 slots on the v5e), so a realistic big-target run uses a NAMED
-        # small drafter (e.g. llama-1b, the deployment shape) where the two
-        # param trees are distinct and no relayout copy exists.
-        s_slots = int(os.environ.get("KVMINI_BENCH_SPEC_SLOTS", str(min(slots, 32))))
-        if s_slots > slots:
-            # toks/pos only have `slots` rows; a larger spec batch would
-            # shape-mismatch deep in the model after the headline already ran
-            _log(f"KVMINI_BENCH_SPEC_SLOTS={s_slots} > slots={slots}; clamping")
-            s_slots = slots
-        cache = cache1 = None  # free the headline caches (4.3 GB at 64 slots)
-        toks_s, pos_s = toks[:s_slots], pos[:s_slots]
-        _log(f"spec mode: drafter={drafter} k={spec_k} slots={s_slots}")
-        if drafter == "self":
-            dcfg, dparams = cfg, params
-        else:
-            dcfg = get_config(drafter, max_seq_len=max_seq)
-            if dcfg.vocab_size != cfg.vocab_size:
-                dcfg = dcfg.scaled(vocab_size=cfg.vocab_size)
-            if quant in ("int8", "int4"):
-                dparams = init_params_quantized(
-                    jax.random.PRNGKey(3), dcfg, bits=4 if quant == "int4" else 8
-                )
-            else:
-                dparams = init_params(jax.random.PRNGKey(3), dcfg)
-
-        @partial(jax.jit, donate_argnums=(1,))
-        def sprefill(p, c, t, pp):
-            lg, c2 = forward(p, cfg, t, pp, c, jnp.zeros((s_slots,), jnp.int32),
-                             fresh_prefill=True,
-                             logit_index=jnp.full((s_slots,), prompt_len - 1, jnp.int32))
-            return c2, jnp.argmax(lg[:, -1, :], axis=-1).astype(jnp.int32)
-
-        @partial(jax.jit, donate_argnums=(1,))
-        def sdecode(p, c, tokens, lengths, rng):
-            logits, c = forward(p, cfg, tokens[:, None], lengths[:, None], c, lengths)
-            nxt = sample_tokens(
-                logits[:, 0, :], rng,
-                jnp.zeros((s_slots,), jnp.float32),
-                jnp.zeros((s_slots,), jnp.int32),
-                jnp.ones((s_slots,), jnp.float32),
-            )
-            return c, nxt
-
-        # comparability: the headline t_step is RTT-cancelled by chained-run
-        # differencing, but a spec round inherently pays one host readback
-        # (the next round's `last` depends on emit). Measure a served-style
-        # plain step — one readback per step, like the engine's sweep — so
-        # the spec comparison is methodology-consistent. Runs BEFORE the two
-        # spec caches exist so at most two s_slots caches are ever resident.
-        lengths_p = jnp.full((s_slots,), prompt_len, dtype=jnp.int32)
-        cache_p = init_kv_cache(cfg, s_slots, max_seq=max_seq, quantized=kv_quant)
-        cache_p, toks_p = sprefill(params, cache_p, toks_s, pos_s)
-        rng_p = jax.random.PRNGKey(9)
-        for _ in range(4):  # warm
-            rng_p, sub_p = jax.random.split(rng_p)
-            cache_p, toks_p = sdecode(params, cache_p, toks_p, lengths_p, sub_p)
-            _ = np.asarray(toks_p)
-            lengths_p = lengths_p + 1
-        n_served = 16
-        t0 = time.time()
-        for _ in range(n_served):
-            rng_p, sub_p = jax.random.split(rng_p)
-            cache_p, toks_p = sdecode(params, cache_p, toks_p, lengths_p, sub_p)
-            _ = np.asarray(toks_p)  # per-step readback, like a serving sweep
-            lengths_p = lengths_p + 1
-        t_step_served = max(time.time() - t0, 1e-9) / n_served
-        cache_p = None  # make room for the drafter cache
-
-        t_cache, last = sprefill(
-            params, init_kv_cache(cfg, s_slots, max_seq=max_seq, quantized=kv_quant),
-            toks_s, pos_s,
-        )
-
-        @partial(jax.jit, donate_argnums=(1,))
-        def dprefill(p, c, t, pp):
-            _, c2 = forward(p, dcfg, t, pp, c, jnp.zeros((s_slots,), jnp.int32),
-                            fresh_prefill=True,
-                            logit_index=jnp.full((s_slots,), prompt_len - 1, jnp.int32))
-            return c2
-
-        d_cache = dprefill(
-            dparams, init_kv_cache(dcfg, s_slots, max_seq=max_seq, quantized=kv_quant),
-            toks_s, pos_s,
-        )
-        spec = build_spec_step(cfg, dcfg, spec_k)
-        lengths_h = np.full((s_slots,), prompt_len, dtype=np.int64)
-
-        def spec_rounds(n, t_cache, d_cache, last, lengths_h):
-            emitted = accepted = 0
-            for _ in range(n):
-                t_cache, d_cache, emit = spec(
-                    params, t_cache, dparams, d_cache,
-                    last, jnp.asarray(lengths_h, jnp.int32),
-                )
-                eh = np.asarray(jax.device_get(emit))   # sync point
-                cnt = (eh >= 0).sum(axis=1)
-                emitted += int(cnt.sum())
-                accepted += int(np.maximum(cnt - 1, 0).sum())
-                idx = np.clip(cnt - 1, 0, spec_k - 1)
-                last = jnp.asarray(eh[np.arange(s_slots), idx].astype(np.int32))
-                lengths_h = lengths_h + cnt
-            return t_cache, d_cache, last, lengths_h, emitted, accepted
-
-        max_rounds = max((max_seq - 1 - prompt_len - 8) // spec_k, 8)
-        n_warm, n_meas = 3, min(24, max_rounds - 3)
-        t_cache, d_cache, last, lengths_h, _, _ = spec_rounds(
-            n_warm, t_cache, d_cache, last, lengths_h
-        )
-        _log("spec warmup done; timing")
-        t0 = time.time()
-        t_cache, d_cache, last, lengths_h, emitted, accepted = spec_rounds(
-            n_meas, t_cache, d_cache, last, lengths_h
-        )
-        dt_spec = max(time.time() - t0, 1e-9)
-        spec_tps = emitted / dt_spec
-        proposed = n_meas * (spec_k - 1) * s_slots
-        t_round = dt_spec / n_meas
-        # speedup is a function of the acceptance rate α: a round costs
-        # t_round and emits (k-1)α + 1 tokens/slot vs 1 per served step.
-        # Both sides pay one host readback per dispatch (the chained,
-        # RTT-cancelled headline t_step would bias spec low). α itself needs
-        # real checkpoints (random-weight drafters accept at chance), so
-        # report the measured α plus the projection at α=0.7 — the
-        # reference's own stated threshold for its 20-40% claim.
-        def speedup_at(alpha: float) -> float:
-            return ((spec_k - 1) * alpha + 1) * t_step_served / t_round
-
-        spec_detail = {
-            "drafter": drafter,
-            "spec_tokens": spec_k,
-            "slots": s_slots,
-            "accept_ratio": round(accepted / proposed, 4) if proposed else 1.0,
-            "tokens_per_sec_per_chip": round(spec_tps / n_chips, 1),
-            "speedup_vs_served_measured": round(
-                spec_tps / (s_slots / t_step_served), 3
-            ),
-            "round_ms": round(t_round * 1000.0, 3),
-            "served_step_ms": round(t_step_served * 1000.0, 3),
-            "chained_step_ms": round(dt / n_timed * 1000.0, 3),
-            "projected_speedup_at_accept_0.7": round(speedup_at(0.7), 3),
-            "projected_speedup_at_accept_1.0": round(speedup_at(1.0), 3),
-        }
-        _log(f"spec: {spec_detail}")
-
-    baseline = 2000.0  # north-star output tokens/sec/chip
-    result = {
-        "metric": (
-            f"decode_tokens_per_sec_per_chip ({cfg.name}, {quant}"
-            f"{'+int8kv' if kv_quant else ''}{', paged' if paged else ''}, "
-            f"slots={slots}, ctx~{prompt_len}+)"
-        ),
-        "value": round(per_chip, 1),
-        "unit": "tokens/s/chip",
-        "vs_baseline": round(per_chip / baseline, 3),
-        "status": "ok",
-        "detail": {
-            "total_tokens_per_sec": round(toks_per_sec, 1),
-            "decode_step_ms": round(step_ms, 3),
-            "ttft_p50_ms": round(ttft_p50, 2),
-            "tunnel_rtt_p50_ms": round(rtt_p50, 2),
-            "ttft_p50_adjusted_ms": round(ttft_adj, 2),
-            "ttft_target_ms": 30.0,
-            "prefill_first_call_s": round(prefill_first_s, 2),
-            "flash_prefill_lowered": bool(flash_lowered),
-            "flash_kernel_named_in_hlo": bool(flash_named),
-            "hbm_bw_gbps": round(bw_gbps, 1),
-            "hbm_bw_util": round(bw_util, 3),
-            "mfu": round(mfu, 4),
-            "cost_per_1k_tokens_usd": round(cost_per_1k, 6),
-            "cost_basis": cost_basis,
-            "energy_wh_per_1k_tokens": round(wh_per_1k, 4),
-            "energy_provenance": energy_prov,
-            "scan_unroll": unroll,
-            "param_count": cfg.param_count,
-            "param_bytes": int(param_bytes),
-            "n_chips": n_chips,
-            "device": str(jax.devices()[0]),
-        },
+    data = {
+        "model": cfg.name,
+        "quant": quant + ("+int8kv" if kv_quant else ""),
+        "paged": paged,
+        "slots": slots,
+        "tokens_per_sec_per_chip": round(per_chip, 1),
+        "total_tokens_per_sec": round(toks_per_sec, 1),
+        "decode_step_ms": round(step_ms, 3),
+        "ttft_p50_ms": round(ttft_p50, 2),
+        "tunnel_rtt_p50_ms": round(rtt_p50, 2),
+        "ttft_p50_adjusted_ms": round(ttft_adj, 2),
+        "ttft_target_ms": 30.0,
+        "prefill_first_call_s": round(prefill_first_s, 2),
+        "flash_prefill_lowered": bool(flash_lowered),
+        "flash_kernel_named_in_hlo": bool(flash_named),
+        "hbm_bw_gbps": round(bw_gbps, 1),
+        "hbm_bw_util": round(bw_util, 3),
+        "mfu": round(mfu, 4),
+        "scan_unroll": unroll,
+        "param_count": cfg.param_count,
+        "param_bytes": int(param_bytes),
+        "n_chips": n_chips,
+        "device": str(jax.devices()[0]),
+        **_economics(jax, toks_per_sec, n_chips, on_tpu),
     }
-    if spec_detail is not None:
-        result["detail"]["speculative"] = spec_detail
-    return result
+    if paged_kernel_lowered is not None:
+        data["paged_kernel_lowered"] = bool(paged_kernel_lowered)
+    if prefill_rows:
+        data["prefill_buckets"] = prefill_rows
+    _progress(f"{mode}.decode", data)
+    return data
+
+
+def _run_spec_child() -> dict:
+    """Speculative decoding with a NAMED drafter (default llama-1b): the
+    deployment shape — two distinct param trees, no relayout copy (the 8B
+    self-drafter pays +5.9 GB for a second int8 layout and OOMs a v5e).
+    Reference claim to beat: 20-40% decode improvement (README.md:118).
+
+    With random weights a small drafter accepts ~0 (its argmax agrees with
+    the target's at chance), so alongside the measured accept ratio the
+    child reports the speedup PROJECTION at the reference's own 0.7
+    acceptance threshold plus the measured round/step cost ratio — the
+    bracket real checkpoints land in. KVMINI_BENCH_DRAFTER=self measures
+    the accept=1 upper bound instead."""
+    jax = _child_setup()
+    import jax.numpy as jnp
+    import numpy as np
+
+    from functools import partial
+
+    from kserve_vllm_mini_tpu.models.config import get_config
+    from kserve_vllm_mini_tpu.models.llama import (
+        forward,
+        init_kv_cache,
+        init_params,
+        init_params_quantized,
+    )
+    from kserve_vllm_mini_tpu.runtime.engine import build_spec_step
+    from kserve_vllm_mini_tpu.runtime.sampling import sample_tokens
+
+    model = _env_model()
+    quant = _env_quant()
+    kv_quant = os.environ.get("KVMINI_BENCH_KV", "") == "int8"
+    spec_k = int(os.environ.get("KVMINI_BENCH_SPEC", "4"))
+    drafter = os.environ.get("KVMINI_BENCH_DRAFTER", "llama-1b")
+    # spec needs TWO caches (target + drafter) resident at once; 32 slots
+    # keeps both under the v5e ceiling next to the int8 8B weights
+    s_slots = int(os.environ.get("KVMINI_BENCH_SPEC_SLOTS", "32"))
+    prompt_len = 128
+    max_seq = 512
+    unroll = int(os.environ.get("KVMINI_BENCH_UNROLL", "1"))
+    cfg = get_config(model, max_seq_len=max_seq, scan_unroll=unroll)
+    n_chips = jax.device_count()
+    _log(f"spec: model={model} drafter={drafter} k={spec_k} slots={s_slots} "
+         f"backend={jax.default_backend()}")
+
+    if quant in ("int8", "int4"):
+        params = init_params_quantized(
+            jax.random.PRNGKey(0), cfg, bits=4 if quant == "int4" else 8
+        )
+    else:
+        params = init_params(jax.random.PRNGKey(0), cfg)
+    if drafter == "self":
+        dcfg, dparams = cfg, params
+    else:
+        dcfg = get_config(drafter, max_seq_len=max_seq)
+        if dcfg.vocab_size != cfg.vocab_size:
+            dcfg = dcfg.scaled(vocab_size=cfg.vocab_size)
+        # the drafter is small — bf16 keeps its quality; distinct tree, so
+        # no cross-layout copy of the target's weights exists
+        dparams = init_params(jax.random.PRNGKey(3), dcfg)
+    jax.block_until_ready(params)
+    _log("params ready (target + drafter)")
+
+    toks_s = jax.random.randint(jax.random.PRNGKey(1), (s_slots, prompt_len), 0,
+                                cfg.vocab_size)
+    pos_s = jnp.broadcast_to(jnp.arange(prompt_len, dtype=jnp.int32),
+                             (s_slots, prompt_len))
+
+    @partial(jax.jit, donate_argnums=(1,), static_argnums=(4,))
+    def sprefill(p, c, t, pp, which_cfg_is_target=True):
+        cc = cfg if which_cfg_is_target else dcfg
+        lg, c2 = forward(p, cc, t, pp, c, jnp.zeros((s_slots,), jnp.int32),
+                         fresh_prefill=True,
+                         logit_index=jnp.full((s_slots,), prompt_len - 1, jnp.int32))
+        return c2, jnp.argmax(lg[:, -1, :], axis=-1).astype(jnp.int32)
+
+    @partial(jax.jit, donate_argnums=(1,))
+    def sdecode(p, c, tokens, lengths, rng):
+        logits, c = forward(p, cfg, tokens[:, None], lengths[:, None], c, lengths)
+        nxt = sample_tokens(
+            logits[:, 0, :], rng,
+            jnp.zeros((s_slots,), jnp.float32),
+            jnp.zeros((s_slots,), jnp.int32),
+            jnp.ones((s_slots,), jnp.float32),
+        )
+        return c, nxt
+
+    # served-style plain step baseline — one readback per step, like the
+    # engine's sweep — so the spec comparison is methodology-consistent (a
+    # spec round inherently pays one host readback; the chained RTT-
+    # cancelled headline step would bias spec low). Runs BEFORE the two
+    # spec caches exist so at most two s_slots caches are ever resident.
+    lengths_p = jnp.full((s_slots,), prompt_len, dtype=jnp.int32)
+    cache_p = init_kv_cache(cfg, s_slots, max_seq=max_seq, quantized=kv_quant)
+    cache_p, toks_p = sprefill(params, cache_p, toks_s, pos_s, True)
+    rng_p = jax.random.PRNGKey(9)
+    for _ in range(4):  # warm
+        rng_p, sub_p = jax.random.split(rng_p)
+        cache_p, toks_p = sdecode(params, cache_p, toks_p, lengths_p, sub_p)
+        _ = np.asarray(toks_p)
+        lengths_p = lengths_p + 1
+    n_served = 16
+    t0 = time.time()
+    for _ in range(n_served):
+        rng_p, sub_p = jax.random.split(rng_p)
+        cache_p, toks_p = sdecode(params, cache_p, toks_p, lengths_p, sub_p)
+        _ = np.asarray(toks_p)  # per-step readback, like a serving sweep
+        lengths_p = lengths_p + 1
+    t_step_served = max(time.time() - t0, 1e-9) / n_served
+    _progress("spec.served_baseline", {
+        "served_step_ms": round(t_step_served * 1000.0, 3),
+        "slots": s_slots,
+    })
+    cache_p = None  # make room for the drafter cache
+
+    t_cache, last = sprefill(
+        params, init_kv_cache(cfg, s_slots, max_seq=max_seq, quantized=kv_quant),
+        toks_s, pos_s, True,
+    )
+    d_cache, _ = sprefill(
+        dparams, init_kv_cache(dcfg, s_slots, max_seq=max_seq, quantized=kv_quant),
+        toks_s, pos_s, False,
+    )
+    spec = build_spec_step(cfg, dcfg, spec_k)
+    lengths_h = np.full((s_slots,), prompt_len, dtype=np.int64)
+
+    def spec_rounds(n, t_cache, d_cache, last, lengths_h):
+        emitted = accepted = 0
+        for _ in range(n):
+            t_cache, d_cache, emit = spec(
+                params, t_cache, dparams, d_cache,
+                last, jnp.asarray(lengths_h, jnp.int32),
+            )
+            eh = np.asarray(jax.device_get(emit))   # sync point
+            cnt = (eh >= 0).sum(axis=1)
+            emitted += int(cnt.sum())
+            accepted += int(np.maximum(cnt - 1, 0).sum())
+            idx = np.clip(cnt - 1, 0, spec_k - 1)
+            last = jnp.asarray(eh[np.arange(s_slots), idx].astype(np.int32))
+            lengths_h = lengths_h + cnt
+        return t_cache, d_cache, last, lengths_h, emitted, accepted
+
+    max_rounds = max((max_seq - 1 - prompt_len - 8) // spec_k, 8)
+    n_warm, n_meas = 3, min(24, max_rounds - 3)
+    t_cache, d_cache, last, lengths_h, _, _ = spec_rounds(
+        n_warm, t_cache, d_cache, last, lengths_h
+    )
+    _log("spec warmup done; timing")
+    t0 = time.time()
+    t_cache, d_cache, last, lengths_h, emitted, accepted = spec_rounds(
+        n_meas, t_cache, d_cache, last, lengths_h
+    )
+    dt_spec = max(time.time() - t0, 1e-9)
+    spec_tps = emitted / dt_spec
+    proposed = n_meas * (spec_k - 1) * s_slots
+    t_round = dt_spec / n_meas
+
+    # speedup is a function of the acceptance rate α: a round costs t_round
+    # and emits (k-1)α + 1 tokens/slot vs 1 per served step. α itself needs
+    # real checkpoints (random-weight drafters accept at chance), so report
+    # the measured α plus projections at α=0.7 (the reference's stated
+    # threshold for its 20-40% claim) and α=1.
+    def speedup_at(alpha: float) -> float:
+        return ((spec_k - 1) * alpha + 1) * t_step_served / t_round
+
+    data = {
+        "drafter": drafter,
+        "drafter_params": dcfg.param_count,
+        "spec_tokens": spec_k,
+        "slots": s_slots,
+        "accept_ratio": round(accepted / proposed, 4) if proposed else 1.0,
+        "tokens_per_sec_per_chip": round(spec_tps / n_chips, 1),
+        "speedup_vs_served_measured": round(spec_tps / (s_slots / t_step_served), 3),
+        "round_ms": round(t_round * 1000.0, 3),
+        "served_step_ms": round(t_step_served * 1000.0, 3),
+        "projected_speedup_at_accept_0.7": round(speedup_at(0.7), 3),
+        "projected_speedup_at_accept_1.0": round(speedup_at(1.0), 3),
+    }
+    _progress("spec.result", data)
+    return data
 
 
 # ---------------------------------------------------------------------------
-# Orchestration: probe -> child run -> always one parseable JSON line, rc 0.
+# Orchestration: probe -> sub-bench children -> one JSON line, rc 0 always.
 # ---------------------------------------------------------------------------
 
 def _bench_label() -> str:
     # raw env strings only: this runs on the must-never-raise failure path
-    # (a bogus KVMINI_BENCH_SLOTS must yield a labeled failure record, not
-    # an int() crash inside _emit_failure)
     slots = os.environ.get("KVMINI_BENCH_SLOTS", _DEFAULT_SLOTS)
     return f"{_env_model()}, {_env_quant()}, slots={slots}"
 
@@ -571,42 +674,11 @@ def _classify(err_text: str) -> str:
     return "error"
 
 
-def _emit_failure(status: str, stage: str, detail: str) -> None:
-    """The one JSON line for a run that could not measure — still parseable,
-    still carries the metric name, value 0, and the reason."""
-    record = {
-        "metric": f"decode_tokens_per_sec_per_chip ({_bench_label()}) "
-                  f"[NOT MEASURED: {status}]",
-        "value": 0.0,
-        "unit": "tokens/s/chip",
-        "vs_baseline": 0.0,
-        "status": status,
-        "detail": {
-            "stage": stage,
-            "error_tail": detail[-1500:],
-            # Last hardware measurement, for context only — self-reported
-            # (docs/PERFORMANCE.md), NOT a driver-verified value.
-            "last_measured_reference": {
-                "value": 3066.7,
-                "unit": "tokens/s/chip",
-                "config": "llama-3.1-8b int8, 80 slots, v5e",
-                "provenance": "docs/PERFORMANCE.md (builder session 2026-07-31"
-                              " ran this same script end-to-end, status ok;"
-                              " not from a BENCH_r0X.json)",
-            },
-        },
-    }
-    print(json.dumps(record))
-
-
 def _probe(timeout_s: float) -> tuple[bool, str, str]:
     """No-op dispatch + readback in a subprocess under a hard timeout.
 
     A wedged relay blocks the dispatch forever — only a subprocess timeout
-    can detect that (memory: every in-process call blocks with it).
-    Returns (ok, status, detail); status is authoritative ("ok" /
-    "tpu_unavailable" / "oom" / "error"), not re-derived from the text.
-    """
+    can detect that. Returns (ok, status, detail)."""
     # The axon site hook imports jax at interpreter start, so the
     # JAX_PLATFORMS env var alone is too late — mirror tests/conftest.py and
     # update jax.config before any device is touched.
@@ -626,115 +698,63 @@ def _probe(timeout_s: float) -> tuple[bool, str, str]:
     except subprocess.TimeoutExpired:
         return False, "tpu_unavailable", (
             f"probe timed out after {timeout_s:.0f}s — relay wedged "
-            "(dispatch blocks forever; see repo ops notes)"
+            "(dispatch blocks forever; see docs/TROUBLESHOOTING.md)"
         )
     if p.returncode != 0:
         detail = f"probe rc={p.returncode}: {p.stderr.strip()[-1200:]}"
         return False, _classify(detail), detail
-    return True, "ok", p.stdout.strip()
+    # JAX can fall back to CPU with only a warning when the TPU plugin
+    # fails to init — a "successful" CPU probe in a TPU-expected env would
+    # run the 8B flagship on CPU and produce a misleading artifact.
+    out = p.stdout.strip()
+    parts = out.split()
+    backend = parts[1] if len(parts) >= 2 else "?"
+    plat = os.environ.get("JAX_PLATFORMS", "").split(",")[0].strip().lower()
+    if plat in ("", "axon", "tpu") and backend != "tpu":
+        return False, "tpu_unavailable", (
+            f"probe fell back to backend {backend!r} (expected tpu; "
+            f"JAX_PLATFORMS={plat or '<unset>'}): {out}"
+        )
+    return True, "ok", out
 
 
-def _orchestrate() -> int:
-    probe_timeout = float(os.environ.get("KVMINI_BENCH_PROBE_TIMEOUT", "90"))
-    # The relay's wedges are often transient (r4 session: wedged -> answered
-    # -> wedged again within the hour), so a failed probe is retried a few
-    # times before the run is declared unmeasurable — the driver invokes
-    # this exactly once per round, and a 5-minute wait is cheap next to a
-    # round with no number.
-    probe_tries = max(int(os.environ.get("KVMINI_BENCH_PROBE_RETRIES", "3")), 1)
-    probe_wait = float(os.environ.get("KVMINI_BENCH_PROBE_RETRY_WAIT", "75"))
-
-    def _probe_once():
+def _probe_until(budget_s: float, probe_timeout: float) -> tuple[bool, str, str]:
+    """Adaptive probe loop under a TOTAL budget (VERDICT round-4 #1: the
+    fixed 3x75 s schedule covered ~7 min while documented wedge windows run
+    ~40 min). Waits escalate 30 -> 60 -> 120 -> 240 -> 300 s (then 300 s
+    flat) so a fast recovery is caught fast and a long wedge is out-waited
+    without hammering the relay."""
+    deadline = time.time() + budget_s
+    waits = [30.0, 60.0, 120.0, 240.0]
+    attempt = 0
+    while True:
+        attempt += 1
         ok, status, detail = _probe(probe_timeout)
         if ok:
-            # JAX can fall back to CPU with only a warning when the TPU
-            # plugin fails to init — a "successful" CPU probe in a
-            # TPU-expected env would run the 8B flagship on CPU and produce
-            # a misleading artifact. This is a relay failure mode (it gets
-            # the same retries as a raising wedge), not a green light.
-            parts = detail.split()
-            backend = parts[1] if len(parts) >= 2 else "?"
-            plat = os.environ.get("JAX_PLATFORMS", "").split(",")[0].strip().lower()
-            if plat in ("", "axon", "tpu") and backend != "tpu":
-                ok, status = False, "tpu_unavailable"
-                detail = (
-                    f"probe fell back to backend {backend!r} (expected tpu; "
-                    f"JAX_PLATFORMS={plat or '<unset>'}): {detail}"
-                )
-        return ok, status, detail
-
-    ok, probe_status, probe_detail = _probe_once()
-    for _try in range(probe_tries - 1):
-        if ok:
-            break
-        _log(f"probe failed ({probe_status}); retrying in {probe_wait:.0f}s "
-             f"({_try + 2}/{probe_tries})")
-        time.sleep(probe_wait)
-        ok, probe_status, probe_detail = _probe_once()
-    if not ok:
-        _log(f"backend probe failed: {probe_detail}")
-        _emit_failure(probe_status, "probe", probe_detail)
-        return 0
-    _log(f"backend probe ok: {probe_detail}")
-
-    # The child gets a generous but finite budget: a warm full run is 3-5 min
-    # on the relay; first-compile adds ~1 min. A mid-run wedge hangs the
-    # child, not us.
-    run_timeout = float(os.environ.get("KVMINI_BENCH_TIMEOUT", "900"))
-    env = dict(os.environ, KVMINI_BENCH_CHILD="1")
-    rc, out, err_text = _run_child(env, run_timeout)
-    result_line = _extract_result(out)
-
-    # The 80-slot default is the measured best but runs nearer the HBM
-    # ceiling than 64; if it OOMs AND the operator did not pin the slot
-    # count, retry once at the proven 64 so a marginal-HBM compile cannot
-    # cost the round its headline number. Only OOM qualifies: a timeout or
-    # unavailable relay fails the same way at any slot count, and a second
-    # 900 s hang would double the damage for nothing.
-    first_status = "timeout" if rc is None else _classify(err_text)
-    if (
-        result_line is None
-        and first_status == "oom"
-        and "KVMINI_BENCH_SLOTS" not in os.environ
-    ):
-        _log(
-            f"child failed at default slots={_DEFAULT_SLOTS} "
-            f"({first_status}); retrying at slots={_FALLBACK_SLOTS}"
-        )
-        rc2, out2, err2 = _run_child(
-            dict(env, KVMINI_BENCH_SLOTS=_FALLBACK_SLOTS), run_timeout
-        )
-        line2 = _extract_result(out2)
-        if line2 is not None:
-            parsed = json.loads(line2)
-            parsed.setdefault("detail", {})["slots_fallback"] = (
-                f"default slots={_DEFAULT_SLOTS} failed ({first_status}: "
-                f"{err_text[-300:]}); this run measured at "
-                f"slots={_FALLBACK_SLOTS}"
+            _log(f"backend probe ok (attempt {attempt}): {detail}")
+            return ok, status, detail
+        remaining = deadline - time.time()
+        wait = waits[min(attempt - 1, len(waits) - 1)] if attempt <= len(waits) \
+            else 300.0
+        if remaining <= wait + probe_timeout:
+            _log(f"probe budget exhausted after {attempt} attempts "
+                 f"({budget_s:.0f}s): {detail}")
+            return False, status, (
+                f"{detail} [probe gave up after {attempt} attempts over "
+                f"{budget_s:.0f}s budget; set KVMINI_BENCH_PROBE_BUDGET_S "
+                f"higher to out-wait longer wedges]"
             )
-            print(json.dumps(parsed))
-            return 0
-        # report the ORIGINAL failure (the default config's) below
-
-    if result_line is not None:
-        print(result_line)
-        return 0
-    if rc is None:
-        _emit_failure(
-            "timeout", "run",
-            f"benchmark child exceeded {run_timeout:.0f}s "
-            f"(likely mid-run relay wedge); stderr tail: {err_text[-1200:]}",
-        )
-        return 0
-    _emit_failure(_classify(err_text), "run",
-                  f"child rc={rc}; stderr tail: {err_text[-1500:]}")
-    return 0
+        _log(f"probe failed ({status}); retrying in {wait:.0f}s "
+             f"(attempt {attempt}, {remaining:.0f}s of budget left)")
+        time.sleep(wait)
 
 
-def _run_child(env: dict, run_timeout: float) -> tuple:
-    """One benchmark child under a hard timeout. Returns (rc, stdout,
-    stderr_text); rc None means the timeout killed it (a signal-killed
-    child's negative rc must fall through to _classify instead)."""
+def _run_child(mode: str, env_extra: dict, run_timeout: float,
+               progress_path: str) -> tuple:
+    """One sub-benchmark child under a hard timeout. Returns (rc, stdout,
+    stderr_text); rc None means the timeout killed it."""
+    env = dict(os.environ, KVMINI_BENCH_CHILD=mode,
+               KVMINI_BENCH_PROGRESS=progress_path, **env_extra)
     with tempfile.NamedTemporaryFile("w+", suffix=".bench-stderr",
                                      errors="replace") as errf:
         try:
@@ -750,8 +770,7 @@ def _run_child(env: dict, run_timeout: float) -> tuple:
                 out = out.decode(errors="replace")
         errf.seek(0)
         err_text = errf.read()
-    # Re-emit the child's stage log so interactive runs keep their trace.
-    sys.stderr.write(err_text)
+    sys.stderr.write(err_text)  # keep the child's stage log visible
     sys.stderr.flush()
     return rc, out, err_text
 
@@ -765,27 +784,230 @@ def _extract_result(out: str):
         if line.startswith("{"):
             try:
                 parsed = json.loads(line)
-                if isinstance(parsed, dict) and "metric" in parsed:
-                    result_line = line
+                if isinstance(parsed, dict) and "data" in parsed:
+                    result_line = parsed
             except ValueError:
                 continue
     return result_line
 
 
+def _read_progress(path: str) -> dict:
+    """Fold the child's incremental progress lines into {key: data}."""
+    out: dict = {}
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                    out[rec["key"]] = rec["data"]
+                except (ValueError, KeyError):
+                    continue
+    except OSError:
+        pass
+    return out
+
+
+class _Artifact:
+    """The one-line artifact, assembled incrementally and emittable at any
+    moment (SIGTERM from a driver-side timeout included)."""
+
+    def __init__(self) -> None:
+        self.sub: dict = {}          # mode -> {"status": ..., ...data}
+        self.emitted = False
+
+    def record(self, mode: str, status: str, data: dict | None,
+               failure: str | None = None) -> None:
+        entry: dict = {"status": status}
+        if data:
+            entry.update(data)
+        if failure:
+            entry["failure"] = failure[-1200:]
+        self.sub[mode] = entry
+        # persist next to the run so a SIGKILLed parent still leaves an
+        # inspectable partial on disk
+        try:
+            with open("bench_partial.json", "w") as f:
+                json.dump(self.sub, f, indent=2)
+        except OSError:
+            pass
+
+    def emit(self, top_status: str, top_note: str = "") -> None:
+        if self.emitted:
+            return
+        self.emitted = True
+        head = self.sub.get("headline", {})
+        # a child that measured decode and then died in teardown (the
+        # documented post-print wedge) leaves the full decode record in its
+        # progress file, folded here under head["decode"] — that IS the
+        # measurement, so surface it instead of reporting NOT MEASURED
+        dec = head.get("decode")
+        if (
+            "tokens_per_sec_per_chip" not in head
+            and isinstance(dec, dict)
+            and dec.get("tokens_per_sec_per_chip")
+        ):
+            head = {k: v for k, v in head.items() if k != "decode"}
+            head.update(dec)
+            head["note_headline"] = (
+                "decode measured and persisted via the progress file; the "
+                "child died after the measurement (status carries the "
+                "failure mode)"
+            )
+        value = float(head.get("tokens_per_sec_per_chip", 0.0) or 0.0)
+        ok = head.get("status") in ("ok", "timeout", "error") and value > 0
+        label = _bench_label()
+        metric = f"decode_tokens_per_sec_per_chip ({label})"
+        if not ok:
+            metric += f" [NOT MEASURED: {top_status}]"
+        detail = dict(head)
+        detail.pop("status", None)
+        nested = {"paged": "paged_kv", "spec": "speculative", "int4": "int4"}
+        for mode, key in nested.items():
+            if mode in self.sub:
+                detail[key] = self.sub[mode]
+        if top_note:
+            detail["note"] = top_note
+        record = {
+            "metric": metric,
+            "value": round(value, 1),
+            "unit": "tokens/s/chip",
+            "vs_baseline": round(value / _BASELINE_TOKS, 3),
+            "status": top_status if not ok else "ok",
+            "detail": detail,
+        }
+        print(json.dumps(record), flush=True)
+
+
+def _orchestrate() -> int:
+    probe_timeout = float(os.environ.get("KVMINI_BENCH_PROBE_TIMEOUT", "90"))
+    probe_budget = float(os.environ.get("KVMINI_BENCH_PROBE_BUDGET_S", "1800"))
+    run_timeout = float(os.environ.get("KVMINI_BENCH_TIMEOUT", "900"))
+    # stop launching new children past the deadline so the parent always
+    # has time to print (the driver's own patience is unknown)
+    deadline = _T_START + float(os.environ.get("KVMINI_BENCH_DEADLINE_S", "7200"))
+    modes = os.environ.get("KVMINI_BENCH_MODES", "headline,paged,spec,int4")
+    modes = [m.strip() for m in modes.split(",") if m.strip()]
+
+    art = _Artifact()
+
+    def on_term(signum, frame):  # noqa: ARG001
+        _log(f"signal {signum}: emitting partial artifact")
+        art.emit("timeout", "parent received SIGTERM/SIGINT mid-run; "
+                           "sub-benches recorded so far are included")
+        sys.exit(0)
+
+    signal.signal(signal.SIGTERM, on_term)
+    signal.signal(signal.SIGINT, on_term)
+
+    ok, probe_status, probe_detail = _probe_until(probe_budget, probe_timeout)
+    if not ok:
+        art.record("headline", probe_status, None,
+                   f"probe never succeeded: {probe_detail}")
+        art.emit(probe_status,
+                 "retry plan: driver re-runs bench.py next round; raise "
+                 "KVMINI_BENCH_PROBE_BUDGET_S past the wedge window")
+        return 0
+
+    wedged = False
+    for mode in modes:
+        if wedged:
+            art.record(mode, "skipped", None,
+                       "skipped: backend wedged earlier in the queue")
+            continue
+        left = deadline - time.time()
+        if left < 180:
+            art.record(mode, "skipped", None,
+                       f"skipped: {left:.0f}s left before the deadline")
+            continue
+        budget = min(run_timeout, left - 120)
+        with tempfile.NamedTemporaryFile("w", suffix=f".{mode}.progress",
+                                         delete=False) as pf:
+            progress_path = pf.name
+        _log(f"=== sub-bench {mode} (timeout {budget:.0f}s) ===")
+        rc, out, err_text = _run_child(mode, {}, budget, progress_path)
+        parsed = _extract_result(out)
+        status = ("timeout" if rc is None
+                  else ("ok" if rc == 0 and parsed else _classify(err_text)))
+
+        # headline OOM at the 80-slot default: retry once at the proven 64
+        # (only OOM qualifies — a wedge fails the same way at any slot count)
+        if (
+            mode in ("headline", "paged", "int4")
+            and parsed is None
+            and status == "oom"
+            and "KVMINI_BENCH_SLOTS" not in os.environ
+        ):
+            _log(f"{mode} OOM at slots={_DEFAULT_SLOTS}; retrying at "
+                 f"slots={_FALLBACK_SLOTS}")
+            rc, out, err_text = _run_child(
+                mode, {"KVMINI_BENCH_SLOTS": _FALLBACK_SLOTS},
+                min(run_timeout, deadline - time.time() - 120), progress_path,
+            )
+            parsed = _extract_result(out)
+            if parsed is not None:
+                parsed["data"]["slots_fallback"] = (
+                    f"default slots={_DEFAULT_SLOTS} OOMed; measured at "
+                    f"slots={_FALLBACK_SLOTS}"
+                )
+                status = "ok"
+
+        if parsed is not None:
+            art.record(mode, "ok", parsed["data"])
+            _log(f"{mode} ok: "
+                 f"{parsed['data'].get('tokens_per_sec_per_chip', '-')} tok/s/chip")
+        else:
+            partial = _read_progress(progress_path)
+            failure = (f"child exceeded {budget:.0f}s (likely mid-run relay "
+                       f"wedge)" if rc is None
+                       else f"child rc={rc}: {err_text[-800:]}")
+            data = {}
+            for key, d in partial.items():
+                data[key.split(".", 1)[-1]] = d
+            art.record(mode, status, data or None, failure)
+            _log(f"{mode} failed ({status}); "
+                 f"{len(partial)} partial measurements retained")
+            if status in ("timeout", "tpu_unavailable"):
+                # re-probe quickly: if the relay is wedged, later children
+                # would burn their timeouts for nothing
+                ok2, _s, _d = _probe(probe_timeout)
+                wedged = not ok2
+                if wedged:
+                    _log("relay wedged after child failure; skipping the "
+                         "remaining sub-benches")
+        try:
+            os.unlink(progress_path)
+        except OSError:
+            pass
+
+    head_status = art.sub.get("headline", {}).get("status", "error")
+    art.emit(head_status if head_status != "ok" else "ok")
+    return 0
+
+
 def main() -> int:
-    if os.environ.get("KVMINI_BENCH_CHILD") == "1":
-        # Child: do the real work; parent structures any failure. flush —
-        # the pipe is block-buffered, and a post-print teardown wedge must
-        # not strand the finished measurement in the buffer when the parent
-        # SIGKILLs the child.
-        print(json.dumps(_run_bench()), flush=True)
+    mode = os.environ.get("KVMINI_BENCH_CHILD")
+    if mode:
+        # Child: do the real work; the parent structures any failure.
+        # flush — the pipe is block-buffered, and a post-print teardown
+        # wedge must not strand the finished measurement in the buffer.
+        if mode == "spec":
+            data = _run_spec_child()
+        else:
+            data = _run_serving_child(mode)
+        print(json.dumps({"mode": mode, "status": "ok", "data": data}),
+              flush=True)
         return 0
     try:
         return _orchestrate()
     except Exception:  # noqa: BLE001 — the one-JSON-line contract is absolute
         import traceback
 
-        _emit_failure("error", "orchestrator", traceback.format_exc())
+        art = _Artifact()
+        art.record("headline", "error", None, traceback.format_exc())
+        art.emit("error", "orchestrator crashed; traceback in detail")
         return 0
 
 

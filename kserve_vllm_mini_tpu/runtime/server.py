@@ -621,6 +621,11 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
                         {"error": {"message": info.get("error", "engine error")}},
                         status=400,
                     )
+            # usage counts EVERY candidate actually generated (OpenAI/vLLM
+            # accounting): best_of work that ranking discards was still
+            # decoded, and a benchmark computing tokens/sec from usage must
+            # see the served work, not the kept subset
+            completion_tokens = sum(len(c[0]) for c in collected)
             if fanout > n_choices:
                 # best_of: keep the n candidates with the highest log
                 # probability PER TOKEN (OpenAI's documented ranking —
@@ -631,9 +636,7 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
                     collected, key=lambda c: -c[2] / max(len(c[0]), 1)
                 )[:n_choices]
             choices: list[dict[str, Any]] = []
-            completion_tokens = 0
             for idx, (out_ids, lp_entries, _lp_sum, info) in enumerate(collected):
-                completion_tokens += len(out_ids)
                 text = (
                     _constrained_text(out_ids) if machine is not None
                     else tok.decode(out_ids)
@@ -675,12 +678,15 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
                 }
             )
 
-        if len(handles) > 1:
-            # n>1 streaming (best_of == n, enforced above): merge the
-            # candidates' event queues and tag every chunk with its choice
-            # index — the OpenAI interleaved-stream shape. Identical
-            # submit-time parameters mean a submit rejection hits every
-            # candidate, so peeking choice 0 covers the 400-before-SSE case.
+        if True:
+            # Streaming (n==1 included — ONE emitter for every n, so chunk
+            # shape can never drift between a single- and a multi-choice
+            # path): merge the candidates' event queues and tag every chunk
+            # with its choice index — the OpenAI interleaved-stream shape.
+            # Identical submit-time parameters mean a submit rejection hits
+            # every candidate, so peeking choice 0 covers the
+            # 400-before-SSE case (a 400 is impossible once stream headers
+            # have gone out).
             first_event = await next_event()
             if (
                 first_event[0] == "done"
@@ -693,10 +699,16 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
                 )
             merged: asyncio.Queue = asyncio.Queue()
 
-            async def pump(idx: int, h: Any) -> None:
+            # DEDICATED daemon threads, not the shared default executor: a
+            # pump blocks on events.get for its candidate's whole lifetime,
+            # and a few concurrent n=8 streams would otherwise pin every
+            # worker of the shared pool and stall unrelated handlers. Each
+            # thread exits at its candidate's 'done'; on client disconnect
+            # the engine still finishes the slot, so the thread is bounded.
+            def pump(idx: int, h: Any) -> None:
                 while True:
-                    evt = await loop.run_in_executor(None, h.events.get)
-                    await merged.put((idx, evt))
+                    evt = h.events.get()
+                    loop.call_soon_threadsafe(merged.put_nowait, (idx, evt))
                     if evt[0] == "done":
                         return
 
@@ -705,9 +717,11 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
             # if the peeked event already WAS its 'done', there is nothing
             # left to pump for it)
             await merged.put((0, tuple(first_event)))
-            pumps = [asyncio.ensure_future(pump(i, h))
-                     for i, h in enumerate(handles)
-                     if i > 0 or first_event[0] != "done"]
+            for _i, _h in enumerate(handles):
+                if _i > 0 or first_event[0] != "done":
+                    threading.Thread(
+                        target=pump, args=(_i, _h), daemon=True
+                    ).start()
 
             resp = web.StreamResponse(
                 status=200,
@@ -802,124 +816,11 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
                 await resp.write(b"data: [DONE]\n\n")
             except (ConnectionResetError, asyncio.CancelledError):
                 pass  # client went away; engine finishes the slots on its own
-            finally:
-                for p in pumps:
-                    if p is not None and not p.done():
-                        p.cancel()
             try:
                 await resp.write_eof()
             except ConnectionResetError:
                 pass
             return resp
-
-        # peek the first event before committing to an SSE response: a
-        # submit-time rejection (immediate error 'done') must be a 400,
-        # which is impossible once stream headers have gone out
-        first_event = await next_event()
-        if first_event[0] == "done" and first_event[1].get("finish_reason") == "error":
-            return web.json_response(
-                {"error": {"message": first_event[1].get("error", "engine error")}},
-                status=400,
-            )
-
-        resp = web.StreamResponse(
-            status=200,
-            headers={"Content-Type": "text/event-stream", "Cache-Control": "no-cache"},
-        )
-        await resp.prepare(request)
-        n_out = 0
-        sent_first = False
-        tool_ids: list[int] = []
-        pending_event: Optional[tuple] = tuple(first_event)
-        try:
-            while True:
-                if pending_event is not None:
-                    kind, *rest = pending_event
-                    pending_event = None
-                else:
-                    kind, *rest = await next_event()
-                if kind == "token":
-                    n_out += 1
-                    if wants_tools:
-                        # tool transcripts stream as one delta at the end:
-                        # partial tool-call JSON is useless to clients — but
-                        # the first-token metrics chunk must still go out or
-                        # the loadgen loses the true server TTFT
-                        tool_ids.append(rest[0])
-                        if not sent_first:
-                            ttft_evt = {
-                                "id": rid, "object": "chat.completion.chunk",
-                                "created": created, "model": resp_model,
-                                "choices": [{"index": 0, "delta": {},
-                                             "finish_reason": None}],
-                                "metrics": {"server_ttft_ms": handle.server_ttft_ms},
-                            }
-                            await resp.write(f"data: {json.dumps(ttft_evt)}\n\n".encode())
-                            sent_first = True
-                        continue
-                    piece = (
-                        _constrained_text([rest[0]]) if machine is not None
-                        else tok.decode([rest[0]])
-                    )
-                    chunk_choice: dict[str, Any] = {
-                        "index": 0, "delta": {"content": piece}, "finish_reason": None
-                    }
-                    if want_logprobs and len(rest) > 2 and rest[2] is not None:
-                        chunk_choice["logprobs"] = {
-                            "content": [_lp_entry(rest[0], rest[2], top_lp)]
-                        }
-                    evt: dict[str, Any] = {
-                        "id": rid,
-                        "object": "chat.completion.chunk",
-                        "created": created,
-                        "model": resp_model,
-                        "choices": [chunk_choice],
-                    }
-                    if not sent_first:
-                        evt["metrics"] = {"server_ttft_ms": handle.server_ttft_ms}
-                        sent_first = True
-                    await resp.write(f"data: {json.dumps(evt)}\n\n".encode())
-                else:
-                    info = rest[0]
-                    final_delta: dict[str, Any] = {}
-                    finish = info.get("finish_reason", "stop")
-                    if wants_tools:
-                        calls = _tool_calls_from_text(_constrained_text(tool_ids))
-                        if calls is not None:
-                            final_delta = {"tool_calls": calls}
-                            finish = "tool_calls"
-                    final = {
-                        "id": rid,
-                        "object": "chat.completion.chunk",
-                        "created": created,
-                        "model": resp_model,
-                        "choices": [
-                            {"index": 0, "delta": final_delta,
-                             "finish_reason": finish}
-                        ],
-                        "usage": {
-                            "prompt_tokens": len(prompt_ids),
-                            "completion_tokens": n_out,
-                            "total_tokens": len(prompt_ids) + n_out,
-                        },
-                        "metrics": {
-                            "server_ttft_ms": handle.server_ttft_ms,
-                            "truncated": bool(info.get("truncated", False)),
-                            "truncated_tokens": int(info.get("truncated_tokens", 0)),
-                        },
-                    }
-                    await resp.write(f"data: {json.dumps(final)}\n\n".encode())
-                    await resp.write(b"data: [DONE]\n\n")
-                    break
-        except (ConnectionResetError, asyncio.CancelledError):
-            pass  # client went away; engine finishes the slot on its own
-        try:
-            await resp.write_eof()
-        except ConnectionResetError:
-            # the disconnect can also land here, after the loop broke
-            # cleanly (e.g. the client closed after its last wanted chunk)
-            pass
-        return resp
 
     async def models(_request):
         data = [
