@@ -107,20 +107,23 @@ def desired_replicas(current: int, sig: Signals, cfg: PolicyConfig) -> int:
     return want
 
 
-def metrics_signals(url: str, timeout_s: float = 5.0) -> Signals:
+def metrics_signals(url: str, timeout_s: float = 5.0, replicas: int = 1) -> Signals:
     """Read one replica's /metrics into Signals via the telemetry layer's
     exposition parser (labels/timestamps handled; fetch errors yield an
     empty dict, i.e. a zero-signal sample the policy treats as idle). For
     a multi-replica fleet behind one Service this samples whichever
     replica answers — duty is representative under round-robin; queue
-    depth is that replica's share (scaled up by the caller if it knows
-    the count)."""
+    depth is that replica's SHARE, so it is scaled by ``replicas`` to the
+    fleet total ``Signals.queue_depth`` promises. Without the scaling the
+    policy (which divides by the count again) would see 1/N² of the real
+    queue and the queue trigger would effectively never fire at fleet
+    size (round-4 advisor finding)."""
     from kserve_vllm_mini_tpu.analysis.telemetry import scrape_runtime_metrics
 
     vals = scrape_runtime_metrics(url, timeout_s=timeout_s)
     return Signals(
         duty_cycle=vals.get("kvmini_tpu_duty_cycle", 0.0),
-        queue_depth=vals.get("kvmini_tpu_queue_depth", 0.0),
+        queue_depth=vals.get("kvmini_tpu_queue_depth", 0.0) * max(replicas, 1),
         ts=time.time(),
         valid=bool(vals),
     )
@@ -314,20 +317,33 @@ def run(args: argparse.Namespace) -> int:
         stabilization_s=args.stabilization,
     )
 
+    # breach latch: one breached snapshot steps up ONCE; re-stepping needs
+    # a NEW snapshot that still breaches. Without the latch a single stale
+    # breached results.json inside results_max_age would force +1 on every
+    # 15 s poll and ratchet the fleet to max in ~2 minutes (round-4
+    # advisor finding).
+    _breach_acted = {"mtime": None}
+
     def signal_fn() -> Signals:
-        sig = metrics_signals(args.url)
+        # late-bound: ctl exists by the time the controller polls; the
+        # sampled per-replica queue share is scaled to the fleet total
+        current = ctl.replicas if ctl is not None else args.initial_replicas
+        sig = metrics_signals(args.url, replicas=current)
         if args.results:
             try:
                 p = Path(args.results)
-                fresh = (time.time() - p.stat().st_mtime) <= args.results_max_age
-                if fresh:
-                    sig.slo_breached = slo_breach(
-                        json.loads(p.read_text()), args.slo
-                    )
+                mtime = p.stat().st_mtime
+                fresh = (time.time() - mtime) <= args.results_max_age
+                if fresh and slo_breach(json.loads(p.read_text()), args.slo):
+                    if _breach_acted["mtime"] != mtime:
+                        sig.slo_breached = True
+                        _breach_acted["mtime"] = mtime
             except Exception:  # noqa: BLE001 — a torn mid-rewrite snapshot
                 # or missing file must not kill (or drive) the loop
                 pass
         return sig
+
+    ctl = None
 
     if args.dry_run or not args.service:
         def scaler(n: int) -> None:

@@ -25,7 +25,7 @@ from kserve_vllm_mini_tpu.loadgen.arrivals import PATTERNS
 HBM_GIB_PER_CHIP = {"v4": 32.0, "v5e": 16.0, "v5p": 95.0, "v6e": 32.0}
 # fp8 deliberately NOT advertised: the in-repo runtime has no fp8 kernel
 # path and v5e lacks native fp8 — a knob nothing executes is a lie
-TPU_QUANT_OK = {"none", "bf16", "int8", "aqt-int8", "int4"}
+TPU_QUANT_OK = {"none", "bf16", "int8", "aqt-int8", "int4", "int4-awq"}
 GPU_ONLY_QUANT = {"awq", "gptq", "autoawq", "marlin", "squeezellm"}
 
 # rough parameter counts for HBM-fit estimates (bf16 bytes = 2/param + ~30%
@@ -175,6 +175,11 @@ def validate_profile(
             if size_b is not None:
                 bytes_per_param = (
                     0.5 if quant == "int4"
+                    # int4-awq SERVES at 0.5 B/param, but calibration
+                    # materializes the full-precision tree on device plus
+                    # the quantized output (ops/awq.py memory note) — the
+                    # startup peak, not the steady state, is what OOMs
+                    else 2.5 if quant == "int4-awq"
                     else 1.0 if quant in ("int8", "aqt-int8")
                     else 2.0
                 )
@@ -186,6 +191,10 @@ def validate_profile(
                         f"~{need_gib:.0f} GiB HBM but {topology} provides "
                         f"{have_gib:.0f} GiB — use a larger slice "
                         f"(e.g. {gen}-{chips * 2}) or quantize to int8"
+                        + (" (int4-awq calibration holds the fp tree on "
+                           "device: calibrate off-chip and serve the "
+                           "quantized tree, or use plain int4)"
+                           if quant == "int4-awq" else "")
                     )
                 elif need_gib > 0.8 * have_gib:
                     rep.warnings.append(

@@ -1,0 +1,225 @@
+"""Activation-aware int4 quantization (AWQ-style) for the serving path.
+
+The reference sweeps autoawq/gptq as first-class quant configs
+(reference sweeps/quantization_sweep.py:179-214,
+runners/profiles/quantization/autoawq.yaml) — the engines in its container
+images do the calibration. Here the runtime is in-repo, so the calibration
+loop is too, re-thought for this stack:
+
+1. **Stats** (``collect_activation_stats``): run the model's own
+   ``layer_forward`` eagerly, layer by layer, with the shared matmul entry
+   point (``ops/lora.adapted_linear`` — every quantizable projection goes
+   through it, carrying its target name) temporarily wrapped to record each
+   matmul input's per-channel amax. No hook framework, no second model
+   implementation: the real layer math produces the real activations.
+2. **Scale search** (``awq_scales``): AWQ's insight is that a few input
+   channels with large activations carry most of the output error budget;
+   scaling those channels UP before rounding (and compensating at runtime)
+   shrinks their relative rounding error. Per layer, search the
+   ``s_j = (a_j / gmean(a))^alpha`` family over an alpha grid, scoring by
+   the activation-weighted weight-rounding error
+   ``sum_j a_j^2 * sum_o (deq(Q(W s))_jo / s_j - W_jo)^2`` — the expected
+   output MSE under a diagonal activation covariance, computable without
+   re-running the model per candidate.
+3. **Runtime**: the quantized leaf carries ``a = 1/s`` ([..., in]); the
+   matmul path multiplies activations by it before the int4 matmul — one
+   elementwise op XLA fuses into the matmul's producer, so the HBM story
+   (stream half the int8 bytes) is identical to plain int4.
+
+Acceptance metric: the quantization sweep's likelihood/fidelity axis
+(quality/evaluator.py) — calibrated int4 must beat plain int4 there at
+equal speed, which tests/test_quant.py pins on the CPU-testable models.
+
+Memory note: calibration needs the full-precision tree resident plus one
+eager forward — fine on hosts and CPU CI; on a 16 GB v5e the 8B bf16 tree
+itself does not fit, so calibrate 8B off-chip (CPU host) and ship the
+quantized tree, or calibrate from an int8-resident model (stats shift is
+second-order).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from kserve_vllm_mini_tpu.ops.quant import (
+    QUANTIZABLE,
+    dequantize_weight,
+    quantize_weight,
+)
+
+DEFAULT_ALPHAS = (0.0, 0.25, 0.5, 0.75, 1.0)
+
+
+def collect_activation_stats(
+    params: dict[str, Any],
+    cfg,
+    tokens: jnp.ndarray,          # [B, T] int32 calibration prompt(s)
+) -> dict[str, np.ndarray]:
+    """Per-matmul-input channel amax from one eager cache-free forward.
+
+    Returns ``{name: [L, d_in] float32}`` for every QUANTIZABLE target the
+    model actually routes through ``adapted_linear`` (MoE expert mats are
+    not captured — they fall back to plain quantization).
+
+    Runs layer-by-layer in Python (not under jit/scan) so the recording
+    wrapper sees concrete values; a few hundred calibration tokens take
+    seconds, and the loop reuses ``layer_forward`` — the same math every
+    execution path shares — so the stats are the serving activations.
+    """
+    from kserve_vllm_mini_tpu.models import llama
+    from kserve_vllm_mini_tpu.ops import lora as lora_mod
+
+    stats: dict[str, list[np.ndarray]] = {}
+    real = lora_mod.adapted_linear
+
+    def recording(x, w, lora_layer, name, ids):
+        if name in QUANTIZABLE:
+            a = np.max(
+                np.abs(np.asarray(x, dtype=np.float32)),
+                axis=tuple(range(x.ndim - 1)),
+            )
+            stats.setdefault(name, []).append(a)
+        return real(x, w, lora_layer, name, ids)
+
+    B, T = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+    lora_mod.adapted_linear = recording
+    try:
+        x = llama.embed_tokens(params, cfg, tokens)
+        cos, sin = llama.rope_frequencies(
+            cfg.rotary_dim, cfg.max_seq_len, cfg.rope_theta, cfg.rope_scaling
+        )
+        for layer in range(cfg.n_layers):
+            p_l = jax.tree.map(lambda v: v[layer], params["layers"])
+            x = llama.layer_forward(
+                p_l, cfg, x, positions, cos, sin,
+                layer_idx=jnp.int32(layer),
+            )
+    finally:
+        lora_mod.adapted_linear = real
+    return {k: np.stack(v).astype(np.float32) for k, v in stats.items()}
+
+
+def awq_scales(
+    w: jnp.ndarray,               # [L, in, out] or [in, out] full-precision
+    act_amax: np.ndarray,         # [L, in] or [in]
+    bits: int = 4,
+    alphas: Sequence[float] = DEFAULT_ALPHAS,
+) -> jnp.ndarray:
+    """Per-input-channel AWQ scales ``s`` (same leading shape as act_amax),
+    alpha grid-searched PER LAYER against the activation-weighted rounding
+    error. alpha=0 is plain quantization (s=1), so calibrated int4 can
+    never score worse than plain int4 on the search objective."""
+    w32 = jnp.asarray(w, jnp.float32)
+    single = w32.ndim == 2
+    if single:
+        w32 = w32[None]
+    a = jnp.asarray(act_amax, jnp.float32)
+    if a.ndim == 1:
+        a = a[None]
+    a = jnp.maximum(a, 1e-8)
+    # normalize by the geometric mean so s is scale-free in the activation
+    # units (AWQ's formulation); log-space for stability
+    gmean = jnp.exp(jnp.mean(jnp.log(a), axis=-1, keepdims=True))
+    ratio = a / gmean                                     # [L, in]
+    w_sq_weight = (a * a)[..., None]                      # [L, in, 1]
+
+    best_err: Optional[jnp.ndarray] = None
+    best_alpha = jnp.zeros((w32.shape[0],), jnp.float32)
+    for alpha in alphas:
+        s = jnp.clip(ratio ** alpha, 1e-4, 1e4)           # [L, in]
+        qw = quantize_weight(w32 * s[..., :, None], bits=bits)
+        deq = dequantize_weight(qw, dtype=jnp.float32) / s[..., :, None]
+        err = jnp.sum((deq - w32) ** 2 * w_sq_weight, axis=(-2, -1))  # [L]
+        if best_err is None:
+            best_err, best_alpha = err, jnp.full_like(best_alpha, alpha)
+        else:
+            take = err < best_err
+            best_err = jnp.where(take, err, best_err)
+            best_alpha = jnp.where(take, alpha, best_alpha)
+    s = jnp.clip(ratio ** best_alpha[:, None], 1e-4, 1e4)
+    return s[0] if single else s
+
+
+def quantize_weight_awq(
+    w: jnp.ndarray,
+    act_amax: np.ndarray,
+    bits: int = 4,
+    alphas: Sequence[float] = DEFAULT_ALPHAS,
+) -> dict[str, jnp.ndarray]:
+    """AWQ-calibrated quantized leaf: ``{"q", "s", "a"}`` where ``a = 1/s``
+    is the runtime input-channel multiplier (ops/quant.linear applies it
+    before the matmul; dequantize_weight folds it back)."""
+    s = awq_scales(w, act_amax, bits=bits, alphas=alphas)
+    qw = quantize_weight(jnp.asarray(w, jnp.float32) * s[..., :, None], bits=bits)
+    qw["a"] = (1.0 / s).astype(jnp.float32)
+    return qw
+
+
+def quantize_params_awq(
+    params: dict[str, Any],
+    cfg,
+    tokens: Optional[jnp.ndarray] = None,
+    stats: Optional[dict[str, np.ndarray]] = None,
+    bits: int = 4,
+    alphas: Sequence[float] = DEFAULT_ALPHAS,
+) -> dict[str, Any]:
+    """Quantize a full-precision Llama tree with activation-aware scales.
+
+    Pass calibration ``tokens`` (stats are collected here) or precomputed
+    ``stats``. Targets without stats (e.g. MoE experts) fall back to plain
+    symmetric quantization, so the tree always comes out fully quantized.
+    """
+    if stats is None:
+        if tokens is None:
+            raise ValueError("need calibration tokens or precomputed stats")
+        stats = collect_activation_stats(params, cfg, tokens)
+    out = dict(params)
+    layers = {}
+    for name, leaf in params["layers"].items():
+        if name in QUANTIZABLE:
+            if name in stats:
+                layers[name] = quantize_weight_awq(
+                    leaf, stats[name], bits=bits, alphas=alphas
+                )
+            else:
+                layers[name] = quantize_weight(leaf, bits=bits)
+        else:
+            layers[name] = leaf
+    out["layers"] = layers
+    return out
+
+
+def calibration_tokens(
+    vocab_size: int,
+    tokenizer=None,
+    n_tokens: int = 512,
+    seed: int = 0,
+) -> jnp.ndarray:
+    """Default calibration batch: the embedded perplexity corpus through
+    the live tokenizer when one is available (real token statistics, no
+    network — quality/texts.py exists for exactly this air-gap), else a
+    seeded uniform sample (random-weight CI models have no meaningful
+    token distribution anyway)."""
+    ids: list[int] = []
+    if tokenizer is not None:
+        try:
+            from kserve_vllm_mini_tpu.quality.texts import EVAL_TEXTS
+
+            for text in EVAL_TEXTS:
+                ids.extend(tokenizer.encode(text))
+                if len(ids) >= n_tokens:
+                    break
+        except Exception:  # noqa: BLE001 — fall through to random ids
+            ids = []
+    if len(ids) >= 32:
+        ids = [i for i in ids[:n_tokens] if 0 <= i < vocab_size]
+    if len(ids) < 32:
+        rng = np.random.default_rng(seed)
+        ids = rng.integers(0, vocab_size, size=(n_tokens,)).tolist()
+    return jnp.asarray(ids, jnp.int32)[None, :]

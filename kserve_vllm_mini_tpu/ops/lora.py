@@ -72,7 +72,11 @@ def init_lora_bank(
     keys = jax.random.split(rng, 2 * len(targets))
     for i, t in enumerate(targets):
         din, dout = _target_dims(cfg, t)
-        a = jax.random.normal(keys[2 * i], (cfg.n_layers, n, din, rank)) / rank
+        # std 1/sqrt(r) => variance 1/r, the documented N(0, 1/r) scale
+        # (was /rank, i.e. variance 1/r² — round-4 advisor finding)
+        a = jax.random.normal(
+            keys[2 * i], (cfg.n_layers, n, din, rank)
+        ) / (rank ** 0.5)
         b = jax.random.normal(keys[2 * i + 1], (cfg.n_layers, n, rank, dout))
         b = b * (alpha / rank)
         # index 0 = base: zero delta

@@ -20,12 +20,16 @@ from typing import Any
 
 import jax.numpy as jnp
 
-# A quantized linear leaf is a dict with exactly these keys.
+# A quantized linear leaf is a dict with exactly these keys; the AWQ
+# variant (ops/awq.py) adds "a" — the per-INPUT-channel runtime multiplier
+# (1/s of the calibration scaling), applied to activations before the
+# matmul and folded back by dequantize_weight.
 _QKEYS = frozenset({"q", "s"})
+_QKEYS_AWQ = frozenset({"q", "s", "a"})
 
 
 def is_quantized(leaf: Any) -> bool:
-    return isinstance(leaf, dict) and set(leaf.keys()) == _QKEYS
+    return isinstance(leaf, dict) and set(leaf.keys()) in (_QKEYS, _QKEYS_AWQ)
 
 
 def quantize_weight(w: jnp.ndarray, bits: int = 8) -> dict[str, jnp.ndarray]:
@@ -102,7 +106,12 @@ def unpacked_q(qw: dict[str, jnp.ndarray]) -> jnp.ndarray:
 
 def dequantize_weight(qw: dict[str, jnp.ndarray], dtype=jnp.bfloat16) -> jnp.ndarray:
     q = unpacked_q(qw)
-    return (q.astype(jnp.float32) * qw["s"][..., None, :].astype(jnp.float32)).astype(dtype)
+    deq = q.astype(jnp.float32) * qw["s"][..., None, :].astype(jnp.float32)
+    if "a" in qw:
+        # AWQ leaf: the stored integers encode W*s; fold the input scaling
+        # back (a = 1/s) so this returns the effective weight
+        deq = deq * qw["a"][..., :, None].astype(jnp.float32)
+    return deq.astype(dtype)
 
 
 def linear(x: jnp.ndarray, w: Any) -> jnp.ndarray:
@@ -111,9 +120,13 @@ def linear(x: jnp.ndarray, w: Any) -> jnp.ndarray:
     For int8 weights the matmul runs with the int8 tensor cast to the
     activation dtype (one fused convert feeding the MXU) and the per-channel
     scale applied to the [..., out] result — an epilogue multiply, not a
-    materialized dequantized weight.
+    materialized dequantized weight. AWQ leaves additionally multiply the
+    activations by the per-input-channel compensation (``a``) first — a
+    producer-side elementwise op XLA fuses; HBM traffic is unchanged.
     """
     if is_quantized(w):
+        if "a" in w:
+            x = x * w["a"].astype(x.dtype)
         y = x @ unpacked_q(w).astype(x.dtype)
         return y * w["s"].astype(x.dtype)
     return x @ w

@@ -122,6 +122,15 @@ def _expand_quantized(specs: dict[str, Any], leaves: dict[str, Any]) -> None:
 
     for name, leaf in leaves.items():
         spec = specs.get(name)
+        if is_quantized(leaf) and isinstance(spec, P) and "a" in leaf:
+            # AWQ leaf: q/s as below, plus the input-channel multiplier
+            # sharded along the weight's INPUT axis
+            specs[name] = {
+                "q": spec,
+                "s": P(*spec[:-2], spec[-1]),
+                "a": P(*spec[:-2], spec[-2]),
+            }
+            continue
         if is_quantized(leaf) and isinstance(spec, P):
             # scale shape = weight shape minus the input (second-to-last)
             # axis: [L, in, out] -> [L, out]; MoE [L, E, in, out] -> [L, E, out]

@@ -726,6 +726,22 @@ class Engine:
             return op.error
         op = _AdminOp(fn)
         self._admin.put(op)
+        # stop() can flip _running and drain the queue BETWEEN the check
+        # above and the put — the op would then sit in a dead queue and
+        # hang its caller for the full timeout. Re-check and self-drain:
+        # with the scheduler gone, nothing else will. Only ops still IN
+        # the queue are failed — an op absent from the queue was dequeued
+        # by the scheduler (it either ran or is running right now), so its
+        # own done/error must be awaited below, not overwritten with a
+        # fabricated failure for work that actually applied.
+        if not self._running and not op.done.is_set():
+            while True:
+                try:
+                    q_op = self._admin.get_nowait()
+                except queue.Empty:
+                    break
+                q_op.error = "engine stopped"
+                q_op.done.set()
         if not op.done.wait(timeout=timeout_s):
             return f"admin op timed out after {timeout_s:.0f}s"
         return op.error

@@ -81,9 +81,10 @@ def build_engine(
     from kserve_vllm_mini_tpu.models.config import get_config
     from kserve_vllm_mini_tpu.models.llama import init_params, init_params_quantized
 
-    if quantization not in ("none", "int8", "int4"):
+    if quantization not in ("none", "int8", "int4", "int4-awq"):
         raise ValueError(
-            f"unknown quantization {quantization!r}; known: none, int8, int4"
+            f"unknown quantization {quantization!r}; known: none, int8, "
+            "int4, int4-awq"
         )
     if kv_cache_dtype == "auto":
         # profile sentinel for "model default" (profiles/quantization/*.yaml
@@ -113,8 +114,13 @@ def build_engine(
         from kserve_vllm_mini_tpu.models.loader import load_hf_checkpoint
 
         # quantize-as-you-load: the bf16 8B tree must never fully exist on
-        # device (VERDICT.md Weak #1 applies to real checkpoints too)
-        params, cfg = load_hf_checkpoint(checkpoint, quantize=quantization)
+        # device (VERDICT.md Weak #1 applies to real checkpoints too).
+        # int4-awq is the exception: calibration needs the fp tree + one
+        # eager forward (ops/awq.py memory note) — calibrate 8B off-chip.
+        params, cfg = load_hf_checkpoint(
+            checkpoint,
+            quantize="none" if quantization == "int4-awq" else quantization,
+        )
         if scan_unroll > 1:
             cfg = cfg.scaled(scan_unroll=scan_unroll)
         name = cfg.name
@@ -149,7 +155,19 @@ def build_engine(
         else:
             params = init_fn(jax.random.PRNGKey(seed), cfg)
         name = cfg.name
-    if mesh is not None and checkpoint:
+    if quantization == "int4-awq":
+        # activation-aware calibration (ops/awq.py): stats from one eager
+        # forward of the embedded corpus through the live tokenizer, then
+        # per-layer alpha-searched scales; the fp tree is dropped after
+        from kserve_vllm_mini_tpu.ops.awq import (
+            calibration_tokens,
+            quantize_params_awq,
+        )
+
+        cal = calibration_tokens(cfg.vocab_size, tok)
+        params = quantize_params_awq(params, cfg, tokens=cal, bits=4)
+
+    if mesh is not None and (checkpoint or quantization == "int4-awq"):
         from kserve_vllm_mini_tpu.parallel.sharding import shard_params
 
         params = shard_params(params, cfg, mesh)
@@ -678,149 +696,148 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
                 }
             )
 
-        if True:
-            # Streaming (n==1 included — ONE emitter for every n, so chunk
-            # shape can never drift between a single- and a multi-choice
-            # path): merge the candidates' event queues and tag every chunk
-            # with its choice index — the OpenAI interleaved-stream shape.
-            # Identical submit-time parameters mean a submit rejection hits
-            # every candidate, so peeking choice 0 covers the
-            # 400-before-SSE case (a 400 is impossible once stream headers
-            # have gone out).
-            first_event = await next_event()
-            if (
-                first_event[0] == "done"
-                and first_event[1].get("finish_reason") == "error"
-            ):
-                return web.json_response(
-                    {"error": {"message":
-                               first_event[1].get("error", "engine error")}},
-                    status=400,
-                )
-            merged: asyncio.Queue = asyncio.Queue()
-
-            # DEDICATED daemon threads, not the shared default executor: a
-            # pump blocks on events.get for its candidate's whole lifetime,
-            # and a few concurrent n=8 streams would otherwise pin every
-            # worker of the shared pool and stall unrelated handlers. Each
-            # thread exits at its candidate's 'done'; on client disconnect
-            # the engine still finishes the slot, so the thread is bounded.
-            def pump(idx: int, h: Any) -> None:
-                while True:
-                    evt = h.events.get()
-                    loop.call_soon_threadsafe(merged.put_nowait, (idx, evt))
-                    if evt[0] == "done":
-                        return
-
-            # choice 0's first event was consumed by the peek — replay it,
-            # then pump every queue (pump 0 resumes from its second event;
-            # if the peeked event already WAS its 'done', there is nothing
-            # left to pump for it)
-            await merged.put((0, tuple(first_event)))
-            for _i, _h in enumerate(handles):
-                if _i > 0 or first_event[0] != "done":
-                    threading.Thread(
-                        target=pump, args=(_i, _h), daemon=True
-                    ).start()
-
-            resp = web.StreamResponse(
-                status=200,
-                headers={"Content-Type": "text/event-stream",
-                         "Cache-Control": "no-cache"},
+        # Streaming (n==1 included — ONE emitter for every n, so chunk
+        # shape can never drift between a single- and a multi-choice
+        # path): merge the candidates' event queues and tag every chunk
+        # with its choice index — the OpenAI interleaved-stream shape.
+        # Identical submit-time parameters mean a submit rejection hits
+        # every candidate, so peeking choice 0 covers the
+        # 400-before-SSE case (a 400 is impossible once stream headers
+        # have gone out).
+        first_event = await next_event()
+        if (
+            first_event[0] == "done"
+            and first_event[1].get("finish_reason") == "error"
+        ):
+            return web.json_response(
+                {"error": {"message":
+                           first_event[1].get("error", "engine error")}},
+                status=400,
             )
-            await resp.prepare(request)
-            per_out = [0] * len(handles)
-            per_first = [False] * len(handles)
-            per_tools: list[list[int]] = [[] for _ in handles]
-            done_count = 0
-            try:
-                while done_count < len(handles):
-                    idx, (kind, *rest) = await merged.get()
-                    if kind == "token":
-                        per_out[idx] += 1
-                        if wants_tools:
-                            per_tools[idx].append(rest[0])
-                            if not per_first[idx]:
-                                await resp.write((
-                                    "data: " + json.dumps({
-                                        "id": rid,
-                                        "object": "chat.completion.chunk",
-                                        "created": created,
-                                        "model": resp_model,
-                                        "choices": [{"index": idx, "delta": {},
-                                                     "finish_reason": None}],
-                                        "metrics": {"server_ttft_ms":
-                                                    handles[idx].server_ttft_ms},
-                                    }) + "\n\n").encode())
-                                per_first[idx] = True
-                            continue
-                        piece = (
-                            _constrained_text([rest[0]]) if machine is not None
-                            else tok.decode([rest[0]])
-                        )
-                        chunk_choice = {
-                            "index": idx, "delta": {"content": piece},
-                            "finish_reason": None,
-                        }
-                        if want_logprobs and len(rest) > 2 and rest[2] is not None:
-                            chunk_choice["logprobs"] = {
-                                "content": [_lp_entry(rest[0], rest[2], top_lp)]
-                            }
-                        evt = {
-                            "id": rid, "object": "chat.completion.chunk",
-                            "created": created, "model": resp_model,
-                            "choices": [chunk_choice],
-                        }
+        merged: asyncio.Queue = asyncio.Queue()
+
+        # DEDICATED daemon threads, not the shared default executor: a
+        # pump blocks on events.get for its candidate's whole lifetime,
+        # and a few concurrent n=8 streams would otherwise pin every
+        # worker of the shared pool and stall unrelated handlers. Each
+        # thread exits at its candidate's 'done'; on client disconnect
+        # the engine still finishes the slot, so the thread is bounded.
+        def pump(idx: int, h: Any) -> None:
+            while True:
+                evt = h.events.get()
+                loop.call_soon_threadsafe(merged.put_nowait, (idx, evt))
+                if evt[0] == "done":
+                    return
+
+        # choice 0's first event was consumed by the peek — replay it,
+        # then pump every queue (pump 0 resumes from its second event;
+        # if the peeked event already WAS its 'done', there is nothing
+        # left to pump for it)
+        await merged.put((0, tuple(first_event)))
+        for _i, _h in enumerate(handles):
+            if _i > 0 or first_event[0] != "done":
+                threading.Thread(
+                    target=pump, args=(_i, _h), daemon=True
+                ).start()
+
+        resp = web.StreamResponse(
+            status=200,
+            headers={"Content-Type": "text/event-stream",
+                     "Cache-Control": "no-cache"},
+        )
+        await resp.prepare(request)
+        per_out = [0] * len(handles)
+        per_first = [False] * len(handles)
+        per_tools: list[list[int]] = [[] for _ in handles]
+        done_count = 0
+        try:
+            while done_count < len(handles):
+                idx, (kind, *rest) = await merged.get()
+                if kind == "token":
+                    per_out[idx] += 1
+                    if wants_tools:
+                        per_tools[idx].append(rest[0])
                         if not per_first[idx]:
-                            evt["metrics"] = {
-                                "server_ttft_ms": handles[idx].server_ttft_ms
-                            }
+                            await resp.write((
+                                "data: " + json.dumps({
+                                    "id": rid,
+                                    "object": "chat.completion.chunk",
+                                    "created": created,
+                                    "model": resp_model,
+                                    "choices": [{"index": idx, "delta": {},
+                                                 "finish_reason": None}],
+                                    "metrics": {"server_ttft_ms":
+                                                handles[idx].server_ttft_ms},
+                                }) + "\n\n").encode())
                             per_first[idx] = True
-                        await resp.write(f"data: {json.dumps(evt)}\n\n".encode())
-                    else:
-                        done_count += 1
-                        info = rest[0]
-                        final_delta: dict[str, Any] = {}
-                        finish = info.get("finish_reason", "stop")
-                        if wants_tools:
-                            calls = _tool_calls_from_text(
-                                _constrained_text(per_tools[idx])
-                            )
-                            if calls is not None:
-                                final_delta = {"tool_calls": calls}
-                                finish = "tool_calls"
-                        final = {
-                            "id": rid, "object": "chat.completion.chunk",
-                            "created": created, "model": resp_model,
-                            "choices": [{"index": idx, "delta": final_delta,
-                                         "finish_reason": finish}],
-                            # same metrics block as the single-stream final
-                            # chunk: the loadgen must not lose truncation /
-                            # server-TTFT telemetry just because n>1
-                            "metrics": {
-                                "server_ttft_ms": handles[idx].server_ttft_ms,
-                                "truncated": bool(info.get("truncated", False)),
-                                "truncated_tokens": int(
-                                    info.get("truncated_tokens", 0)
-                                ),
-                            },
+                        continue
+                    piece = (
+                        _constrained_text([rest[0]]) if machine is not None
+                        else tok.decode([rest[0]])
+                    )
+                    chunk_choice = {
+                        "index": idx, "delta": {"content": piece},
+                        "finish_reason": None,
+                    }
+                    if want_logprobs and len(rest) > 2 and rest[2] is not None:
+                        chunk_choice["logprobs"] = {
+                            "content": [_lp_entry(rest[0], rest[2], top_lp)]
                         }
-                        if done_count == len(handles):
-                            total_out = sum(per_out)
-                            final["usage"] = {
-                                "prompt_tokens": len(prompt_ids),
-                                "completion_tokens": total_out,
-                                "total_tokens": len(prompt_ids) + total_out,
-                            }
-                        await resp.write(f"data: {json.dumps(final)}\n\n".encode())
-                await resp.write(b"data: [DONE]\n\n")
-            except (ConnectionResetError, asyncio.CancelledError):
-                pass  # client went away; engine finishes the slots on its own
-            try:
-                await resp.write_eof()
-            except ConnectionResetError:
-                pass
-            return resp
+                    evt = {
+                        "id": rid, "object": "chat.completion.chunk",
+                        "created": created, "model": resp_model,
+                        "choices": [chunk_choice],
+                    }
+                    if not per_first[idx]:
+                        evt["metrics"] = {
+                            "server_ttft_ms": handles[idx].server_ttft_ms
+                        }
+                        per_first[idx] = True
+                    await resp.write(f"data: {json.dumps(evt)}\n\n".encode())
+                else:
+                    done_count += 1
+                    info = rest[0]
+                    final_delta: dict[str, Any] = {}
+                    finish = info.get("finish_reason", "stop")
+                    if wants_tools:
+                        calls = _tool_calls_from_text(
+                            _constrained_text(per_tools[idx])
+                        )
+                        if calls is not None:
+                            final_delta = {"tool_calls": calls}
+                            finish = "tool_calls"
+                    final = {
+                        "id": rid, "object": "chat.completion.chunk",
+                        "created": created, "model": resp_model,
+                        "choices": [{"index": idx, "delta": final_delta,
+                                     "finish_reason": finish}],
+                        # same metrics block as the single-stream final
+                        # chunk: the loadgen must not lose truncation /
+                        # server-TTFT telemetry just because n>1
+                        "metrics": {
+                            "server_ttft_ms": handles[idx].server_ttft_ms,
+                            "truncated": bool(info.get("truncated", False)),
+                            "truncated_tokens": int(
+                                info.get("truncated_tokens", 0)
+                            ),
+                        },
+                    }
+                    if done_count == len(handles):
+                        total_out = sum(per_out)
+                        final["usage"] = {
+                            "prompt_tokens": len(prompt_ids),
+                            "completion_tokens": total_out,
+                            "total_tokens": len(prompt_ids) + total_out,
+                        }
+                    await resp.write(f"data: {json.dumps(final)}\n\n".encode())
+            await resp.write(b"data: [DONE]\n\n")
+        except (ConnectionResetError, asyncio.CancelledError):
+            pass  # client went away; engine finishes the slots on its own
+        try:
+            await resp.write_eof()
+        except ConnectionResetError:
+            pass
+        return resp
 
     async def models(_request):
         data = [
@@ -1035,9 +1052,10 @@ def register(parser: argparse.ArgumentParser) -> None:
                              "Default: $KVMINI_PP_MICROBATCHES or 1")
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--quantization", default="none",
-                        choices=["none", "int8", "int4"],
+                        choices=["none", "int8", "int4", "int4-awq"],
                         help="Weight quantization (int8 = W8A16, int4 = W4A16 "
-                             "per-channel; XLA packs int4 two-per-byte in HBM)")
+                             "per-channel; XLA packs int4 two-per-byte in HBM; "
+                             "int4-awq = activation-aware calibrated scales)")
     parser.add_argument("--kv-cache-dtype", default=None,
                         help="KV cache dtype: bfloat16/float32/float16/int8 "
                              "(int8 = scaled per-position) or 'auto'")

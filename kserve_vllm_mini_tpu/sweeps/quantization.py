@@ -21,7 +21,7 @@ from typing import Any, Optional
 from kserve_vllm_mini_tpu.sweeps import base
 
 DEFAULT_SPACE: dict[str, list[Any]] = {
-    "quantization": ["none", "int8", "int4"],
+    "quantization": ["none", "int8", "int4", "int4-awq"],
     "kv_cache_dtype": ["model", "int8"],   # int8 = scaled int8-KV cache
     "decoding": ["greedy", "sampled"],
 }

@@ -261,3 +261,27 @@ def test_slo_breach_uses_gate():
     bad = {"p95_ms": 10_000_000.0, "error_rate": 0.0}
     assert not slo_breach(good)
     assert slo_breach(bad)
+
+
+def test_metrics_signals_scales_queue_share_to_fleet_total(monkeypatch):
+    """The /metrics sample is ONE replica's queue share; the policy divides
+    by the replica count, so the signal must be scaled UP to the fleet
+    total first — otherwise the queue trigger sees 1/N² of the real queue
+    and never fires at fleet size (round-4 advisor finding)."""
+    from kserve_vllm_mini_tpu.analysis import telemetry
+    from kserve_vllm_mini_tpu.autoscale import controller as mod
+
+    monkeypatch.setattr(
+        telemetry, "scrape_runtime_metrics",
+        lambda url, timeout_s=5.0: {
+            "kvmini_tpu_duty_cycle": 0.5,
+            "kvmini_tpu_queue_depth": 6.0,  # per-replica share
+        },
+    )
+    sig = mod.metrics_signals("http://x", replicas=4)
+    assert sig.queue_depth == 24.0
+    # at 4 replicas and target 4/replica, 24 queued must scale up
+    want = mod.desired_replicas(4, sig, mod.PolicyConfig())
+    assert want > 4
+    # default replicas=1 keeps the raw share (single-replica fleets)
+    assert mod.metrics_signals("http://x").queue_depth == 6.0

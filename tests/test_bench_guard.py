@@ -400,3 +400,27 @@ def test_fully_measured_decode_in_progress_file_counts_as_ok(bench, monkeypatch,
     assert rec["value"] == 3100.0
     assert "NOT MEASURED" not in rec["metric"]
     assert "died after the measurement" in rec["detail"]["note_headline"]
+
+
+def test_modes_without_headline_status_from_selected(bench, monkeypatch, capsys):
+    """KVMINI_BENCH_MODES=spec (a targeted re-run): a successful spec child
+    must yield status ok, not a fabricated headline failure."""
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv("KVMINI_BENCH_MODES", "spec")
+
+    class P:
+        returncode = 0
+        stdout = json.dumps({
+            "mode": "spec", "status": "ok",
+            "data": {"accept_ratio": 1.0, "tokens_per_sec_per_chip": 900.0},
+        }) + "\n"
+
+    monkeypatch.setattr(bench, "_probe", lambda t: (True, "ok", "backend cpu 4.0"))
+    monkeypatch.setattr(subprocess, "run", lambda *a, **k: P())
+    rc = bench.main()
+    rec = json.loads(capsys.readouterr().out.strip())
+    assert rc == 0
+    assert rec["status"] == "ok"
+    assert "NOT MEASURED" not in rec["metric"]
+    assert "headline not selected" in rec["metric"]
+    assert rec["detail"]["speculative"]["accept_ratio"] == 1.0

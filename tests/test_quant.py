@@ -276,3 +276,128 @@ def test_quantized_bytes_counts_int4_as_half():
 
     tree = {"a": jnp.zeros((10, 10), jnp.int4), "b": jnp.zeros((10,), jnp.float32)}
     assert quantized_bytes(tree) == 50 + 40
+
+
+# -- AWQ-style activation-aware int4 (ops/awq.py) ----------------------------
+
+
+def _outlier_model():
+    """llama-tiny with a few 8x-hot norm channels — the real-model
+    activation-outlier phenomenon AWQ exists for (random iid weights have
+    no outliers, so plain and calibrated int4 tie there)."""
+    cfg = get_config("llama-tiny")
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    spread = np.ones(cfg.d_model, np.float32)
+    spread[::16] = 8.0
+    for nm in ("attn_norm", "mlp_norm"):
+        params["layers"][nm] = params["layers"][nm] * jnp.asarray(spread)
+    return cfg, params
+
+
+def test_awq_stats_cover_all_targets():
+    from kserve_vllm_mini_tpu.ops.awq import (
+        calibration_tokens,
+        collect_activation_stats,
+    )
+    from kserve_vllm_mini_tpu.ops.quant import QUANTIZABLE
+
+    cfg, params = _outlier_model()
+    cal = calibration_tokens(cfg.vocab_size, None, n_tokens=64, seed=1)
+    stats = collect_activation_stats(params, cfg, cal)
+    assert set(stats) == set(QUANTIZABLE)
+    for name, a in stats.items():
+        assert a.shape[0] == cfg.n_layers
+        assert a.ndim == 2 and (a >= 0).all(), name
+    # the engineered outliers must be visible in the attn-input stats
+    ratio = stats["wq"][:, ::16].mean() / stats["wq"].mean()
+    assert ratio > 2.0
+
+
+def test_awq_leaf_linear_matches_dequant():
+    from kserve_vllm_mini_tpu.ops.awq import quantize_weight_awq
+    from kserve_vllm_mini_tpu.ops.quant import dequantize_weight, is_quantized, linear
+
+    w = jax.random.normal(jax.random.PRNGKey(0), (64, 32), jnp.float32)
+    a = np.abs(np.random.default_rng(0).normal(size=(64,))).astype(np.float32) + 0.1
+    a[::8] *= 10.0
+    leaf = quantize_weight_awq(w, a, bits=4)
+    assert is_quantized(leaf) and set(leaf) == {"q", "s", "a"}
+    x = jax.random.normal(jax.random.PRNGKey(1), (3, 64), jnp.float32)
+    y = linear(x, leaf)
+    y_ref = x @ dequantize_weight(leaf, dtype=jnp.float32)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref), rtol=2e-2, atol=1e-3)
+
+
+def test_awq_beats_plain_int4_on_outlier_model():
+    """The round-4 verdict's acceptance criterion: calibrated int4 beats
+    plain int4 on the likelihood axis (same speed by construction — the
+    runtime op differs only by a fused elementwise multiply)."""
+    from kserve_vllm_mini_tpu.ops.awq import (
+        calibration_tokens,
+        collect_activation_stats,
+        quantize_params_awq,
+    )
+    from kserve_vllm_mini_tpu.ops.quant import quantize_params
+
+    cfg, params = _outlier_model()
+    cal = calibration_tokens(cfg.vocab_size, None, n_tokens=128, seed=1)
+    stats = collect_activation_stats(params, cfg, cal)
+    p_awq = quantize_params_awq(params, cfg, stats=stats, bits=4)
+    p_int4 = quantize_params(params, bits=4)
+
+    toks = jax.random.randint(jax.random.PRNGKey(7), (2, 32), 0, cfg.vocab_size)
+    pos = jnp.broadcast_to(jnp.arange(32, dtype=jnp.int32), (2, 32))
+    lg_fp, _ = forward(params, cfg, toks, pos)
+    lg_awq, _ = forward(p_awq, cfg, toks, pos)
+    lg_i4, _ = forward(p_int4, cfg, toks, pos)
+
+    mse_awq = float(jnp.mean((lg_awq - lg_fp) ** 2))
+    mse_i4 = float(jnp.mean((lg_i4 - lg_fp) ** 2))
+    assert mse_awq < mse_i4, (mse_awq, mse_i4)
+
+    def avg_ll(lg):
+        lp = jax.nn.log_softmax(lg[:, :-1].astype(jnp.float32), axis=-1)
+        tgt = toks[:, 1:]
+        return float(jnp.mean(jnp.take_along_axis(lp, tgt[..., None], axis=-1)))
+
+    ll_fp = avg_ll(lg_fp)
+    assert abs(avg_ll(lg_awq) - ll_fp) < abs(avg_ll(lg_i4) - ll_fp)
+
+
+def test_awq_alpha_grid_includes_plain_fallback():
+    """alpha=0 (s=1, i.e. plain quantization) is in the search grid, so on
+    a model with NO outliers the search objective can never score worse
+    than plain int4's."""
+    from kserve_vllm_mini_tpu.ops.awq import DEFAULT_ALPHAS, awq_scales
+
+    assert 0.0 in DEFAULT_ALPHAS
+    w = jax.random.normal(jax.random.PRNGKey(0), (3, 32, 16), jnp.float32)
+    a = np.ones((3, 32), np.float32)  # flat activations: s must be ~1
+    s = awq_scales(w, a, bits=4)
+    np.testing.assert_allclose(np.asarray(s), 1.0, rtol=1e-5)
+
+
+def test_awq_engine_generates():
+    """build_engine(quantization='int4-awq') calibrates from the embedded
+    corpus and serves finite tokens end-to-end."""
+    from kserve_vllm_mini_tpu.runtime.engine import GenRequest
+    from kserve_vllm_mini_tpu.runtime.server import build_engine
+
+    engine, tok, _name = build_engine(
+        model="llama-tiny", quantization="int4-awq", max_slots=2,
+        max_seq_len=128,
+    )
+    engine.start()
+    try:
+        h = engine.submit(GenRequest(
+            prompt_tokens=tok.encode("hello there"), max_new_tokens=8,
+        ))
+        out = []
+        while True:
+            kind, *rest = h.events.get(timeout=120)
+            if kind != "token":
+                break
+            out.append(rest[0])
+        assert len(out) == 8
+    finally:
+        engine.stop()
