@@ -329,7 +329,12 @@ def run(args: argparse.Namespace) -> int:
         # sampled per-replica queue share is scaled to the fleet total
         current = ctl.replicas if ctl is not None else args.initial_replicas
         sig = metrics_signals(args.url, replicas=current)
-        if args.results:
+        # latch only on samples the controller will ACT on: an invalid
+        # scrape (pod churn — exactly when breaches happen) is discarded
+        # by step(), and consuming the latch there would swallow the
+        # breach for good. A scaler-patch failure after a valid sample
+        # can still consume it; the next results.json rewrite re-arms.
+        if args.results and sig.valid:
             try:
                 p = Path(args.results)
                 mtime = p.stat().st_mtime
