@@ -51,35 +51,63 @@ def sample_tokens(
     top_k: jnp.ndarray,         # [B] int32; 0 => disabled
     top_p: jnp.ndarray,         # [B] float32; 1.0 => disabled
 ) -> jnp.ndarray:
-    """Returns [B] int32 sampled token ids."""
+    """Returns [B] int32 sampled token ids.
+
+    Expressed over ``filter_logits`` so the sampler and the speculative
+    rejection test share ONE masking pipeline: spec decode's distribution-
+    exactness depends on p/q being exactly this sampler's distribution,
+    and a masking fix applied to only one copy would silently break it.
+    Greedy rows still take the explicit argmax (bit-stable, and rows whose
+    filtered logits are one-hot sample that token with probability 1
+    anyway)."""
+    greedy = jnp.argmax(logits, axis=-1)
+    filtered = filter_logits(logits, temperature, top_k, top_p)
+    sampled = jax.random.categorical(rng, filtered, axis=-1)
+    return jnp.where(temperature > 0, sampled, greedy).astype(jnp.int32)
+
+
+def filter_logits(
+    logits: jnp.ndarray,        # [B, V] float32
+    temperature: jnp.ndarray,   # [B] float32; 0 => greedy (one-hot dist)
+    top_k: jnp.ndarray,         # [B] int32; 0 => disabled
+    top_p: jnp.ndarray,         # [B] float32; 1.0 => disabled
+) -> jnp.ndarray:
+    """The filtered/scaled logits whose softmax IS each row's sampling
+    distribution — the single masking pipeline ``sample_tokens`` samples
+    from and the speculative rejection test computes p/q with (one
+    implementation, so they can never drift apart).
+
+    Temperature-0 rows become a one-hot at the argmax, which makes
+    rejection-sampling verification DEGENERATE to the exact greedy accept
+    rule: accept prob p(x)/q(x) is 1 on an argmax match and 0 otherwise,
+    and the residual distribution is a one-hot at the target's argmax — so
+    greedy requests under the sampled spec path emit bit-identical tokens
+    to plain greedy decode.
+    """
     B, V = logits.shape
     greedy = jnp.argmax(logits, axis=-1)
 
-    # temperature scaling (guard /0 for the greedy rows; they're masked later)
     safe_t = jnp.where(temperature > 0, temperature, 1.0)
     scaled = logits / safe_t[:, None]
 
-    # top-k: mask everything below the k-th largest logit per row
-    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]          # [B, V]
+    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]
     k_idx = jnp.clip(top_k - 1, 0, V - 1)
-    kth = jnp.take_along_axis(sorted_desc, k_idx[:, None], axis=-1)  # [B,1]
+    kth = jnp.take_along_axis(sorted_desc, k_idx[:, None], axis=-1)
     scaled = jnp.where(
         (top_k[:, None] > 0) & (scaled < kth), -jnp.inf, scaled
     )
 
-    # top-p (nucleus): keep the smallest prefix of the sorted distribution
-    # with cumulative prob >= top_p; always keep the argmax.
     sorted_desc2 = jnp.sort(scaled, axis=-1)[:, ::-1]
     probs_sorted = jax.nn.softmax(sorted_desc2, axis=-1)
     cum = jnp.cumsum(probs_sorted, axis=-1)
-    # threshold logit value: smallest sorted logit still inside the nucleus
-    inside = cum - probs_sorted < top_p[:, None]              # keep while mass before < p
-    # the cut logit = min over kept entries
-    cut = jnp.min(jnp.where(inside, sorted_desc2, jnp.inf), axis=-1)  # [B]
+    inside = cum - probs_sorted < top_p[:, None]
+    cut = jnp.min(jnp.where(inside, sorted_desc2, jnp.inf), axis=-1)
     scaled = jnp.where(scaled < cut[:, None], -jnp.inf, scaled)
 
-    sampled = jax.random.categorical(rng, scaled, axis=-1)
-    return jnp.where(temperature > 0, sampled, greedy).astype(jnp.int32)
+    greedy_lg = jnp.where(
+        jax.nn.one_hot(greedy, V, dtype=bool), 0.0, -jnp.inf
+    )
+    return jnp.where(temperature[:, None] > 0, scaled, greedy_lg)
 
 
 # OpenAI caps top_logprobs at 5; one static K keeps a single decode

@@ -930,3 +930,54 @@ def test_penalties_with_chunked_decode(params):
         assert len(set(toks)) == 16, f"within-chunk repeat: {toks}"
     finally:
         eng.stop()
+
+
+def test_spec_decode_sampled_requests_speculate(params):
+    """Rejection sampling: sampled requests ride the spec path now. With
+    drafter == target, p == q at every position, so every draft is
+    accepted regardless of temperature — rounds advance and the output is
+    well-formed sampled text."""
+    eng = make_spec_engine(params, params, spec_tokens=4)
+    try:
+        h1 = eng.submit(GenRequest(prompt_tokens=[1, 2, 3], max_new_tokens=16,
+                                   temperature=1.0, top_p=0.9))
+        h2 = eng.submit(GenRequest(prompt_tokens=[1, 2, 3], max_new_tokens=16,
+                                   temperature=1.0, top_p=0.9))
+        t1, _ = _drain(h1)
+        t2, _ = _drain(h2)
+        assert len(t1) == len(t2) == 16
+        assert all(0 <= t < CFG.vocab_size for t in t1 + t2)
+        assert t1 != t2  # still actually sampling
+        s = eng.snapshot_stats()
+        assert s["spec_rounds"] > 0, "sampled requests must speculate"
+        assert s["spec_accept_ratio"] > 0.8, (
+            "self-drafter (p == q) must accept nearly everything: "
+            f"{s['spec_accept_ratio']}"
+        )
+    finally:
+        eng.stop()
+
+
+def test_spec_decode_sampled_mixed_with_greedy(params, drafter_params):
+    """One spec executable serves a mixed greedy/sampled batch: the greedy
+    slot's output stays bit-exact (temp-0 rows degenerate to the exact
+    argmax accept rule) while the sampled neighbor speculates beside it."""
+    eng = Engine(
+        params, CFG,
+        EngineConfig(max_slots=4, max_seq_len=128, max_prefill_len=64,
+                     min_prefill_bucket=16, spec_tokens=4),
+        drafter=(drafter_params, DRAFTER_CFG),
+    )
+    ref = greedy_reference(params, [5, 6, 7], 12)
+    hg = eng.submit(GenRequest(prompt_tokens=[5, 6, 7], max_new_tokens=12))
+    hs = eng.submit(GenRequest(prompt_tokens=[9, 10], max_new_tokens=12,
+                               temperature=0.9))
+    eng.start()
+    try:
+        tg, _ = _drain(hg)
+        ts, _ = _drain(hs)
+        assert tg == ref
+        assert len(ts) == 12
+        assert eng.stats["spec_rounds"] > 0
+    finally:
+        eng.stop()
