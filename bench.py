@@ -884,15 +884,6 @@ class _Artifact:
 
 
 def _orchestrate() -> int:
-    probe_timeout = float(os.environ.get("KVMINI_BENCH_PROBE_TIMEOUT", "90"))
-    probe_budget = float(os.environ.get("KVMINI_BENCH_PROBE_BUDGET_S", "1800"))
-    run_timeout = float(os.environ.get("KVMINI_BENCH_TIMEOUT", "900"))
-    # stop launching new children past the deadline so the parent always
-    # has time to print (the driver's own patience is unknown)
-    deadline = _T_START + float(os.environ.get("KVMINI_BENCH_DEADLINE_S", "7200"))
-    modes = os.environ.get("KVMINI_BENCH_MODES", "headline,paged,spec,int4")
-    modes = [m.strip() for m in modes.split(",") if m.strip()]
-
     art = _Artifact()
 
     def on_term(signum, frame):  # noqa: ARG001
@@ -901,8 +892,26 @@ def _orchestrate() -> int:
                            "sub-benches recorded so far are included")
         sys.exit(0)
 
-    signal.signal(signal.SIGTERM, on_term)
-    signal.signal(signal.SIGINT, on_term)
+    # restore on exit: guard tests call main() in-process, and a leaked
+    # handler would hijack the TEST runner's SIGINT/SIGTERM
+    old_term = signal.signal(signal.SIGTERM, on_term)
+    old_int = signal.signal(signal.SIGINT, on_term)
+    try:
+        return _orchestrate_body(art)
+    finally:
+        signal.signal(signal.SIGTERM, old_term)
+        signal.signal(signal.SIGINT, old_int)
+
+
+def _orchestrate_body(art: "_Artifact") -> int:
+    probe_timeout = float(os.environ.get("KVMINI_BENCH_PROBE_TIMEOUT", "90"))
+    probe_budget = float(os.environ.get("KVMINI_BENCH_PROBE_BUDGET_S", "1800"))
+    run_timeout = float(os.environ.get("KVMINI_BENCH_TIMEOUT", "900"))
+    # stop launching new children past the deadline so the parent always
+    # has time to print (the driver's own patience is unknown)
+    deadline = _T_START + float(os.environ.get("KVMINI_BENCH_DEADLINE_S", "7200"))
+    modes = os.environ.get("KVMINI_BENCH_MODES", "headline,paged,spec,int4")
+    modes = [m.strip() for m in modes.split(",") if m.strip()]
 
     ok, probe_status, probe_detail = _probe_until(probe_budget, probe_timeout)
     if not ok:

@@ -27,11 +27,12 @@ def make_app(
     capabilities: set[str] | None = None,
 ) -> web.Application:
     """``capabilities`` toggles OpenAI-dialect extras for parity-probe tests:
-    any subset of {"tools", "parallel_tools", "json_mode", "logprobs"}.
-    None means all supported."""
+    any subset of {"tools", "parallel_tools", "json_mode", "logprobs",
+    "sampling_penalties", "n_choices"}. None means all supported."""
     stats = MockStats()
     caps = capabilities if capabilities is not None else {
-        "tools", "parallel_tools", "json_mode", "logprobs"
+        "tools", "parallel_tools", "json_mode", "logprobs",
+        "sampling_penalties", "n_choices",
     }
 
     async def chat(request: web.Request) -> web.StreamResponse:
@@ -120,13 +121,26 @@ def make_app(
             )
         max_toks = min(int(body.get("max_tokens", 16)), n_tokens)
         words = [f"tok{i} " for i in range(max_toks)]
+        # sampling_penalties capability: a penalized request produces
+        # DIFFERENT output than the unpenalized baseline (what the probe
+        # checks); without the capability the knobs are silently ignored
+        penalized = (
+            float(body.get("frequency_penalty", 0) or 0) != 0
+            or float(body.get("presence_penalty", 0) or 0) != 0
+        )
+        if penalized and "sampling_penalties" in caps:
+            words = [f"uniq{i} " for i in range(max_toks)]
+        n = int(body.get("n", 1) or 1)
+        n = n if ("n_choices" in caps and not stream) else 1
         if not stream:
             await asyncio.sleep(token_delay_s * max_toks)
             return web.json_response(
                 {
                     "id": "mock",
                     "choices": [
-                        {"index": 0, "message": {"role": "assistant", "content": "".join(words)}}
+                        {"index": i,
+                         "message": {"role": "assistant", "content": "".join(words)}}
+                        for i in range(n)
                     ],
                     "usage": {
                         "prompt_tokens": 5,

@@ -103,7 +103,10 @@ def test_parity_all_capabilities_supported():
 
     results = asyncio.run(go())
     by_name = {r.capability: r for r in results}
-    assert set(by_name) == {"tools", "parallel_tools", "json_mode", "logprobs", "streaming"}
+    assert set(by_name) == {
+        "tools", "parallel_tools", "json_mode", "logprobs", "streaming",
+        "sampling_penalties", "n_choices",
+    }
     for name, r in by_name.items():
         assert r.supported, f"{name}: {r.detail}"
     assert by_name["streaming"].extra["chunks"] >= 1
@@ -121,6 +124,10 @@ def test_parity_detects_missing_capabilities():
     assert not by_name["json_mode"].supported
     assert not by_name["logprobs"].supported
     assert by_name["streaming"].supported  # base mock always streams
+    # the knob-dropping server: penalties leave the (repetitive) baseline
+    # unchanged, n>1 returns one choice — both must be flagged unsupported
+    assert not by_name["sampling_penalties"].supported
+    assert not by_name["n_choices"].supported
 
 
 def test_parity_matrix_artifacts():
@@ -130,7 +137,7 @@ def test_parity_matrix_artifacts():
             return matrix_dict(srv.url, "m", await prober.probe_all())
 
     matrix = asyncio.run(go())
-    assert matrix["supported_count"] == matrix["total"] == 5
+    assert matrix["supported_count"] == matrix["total"] == 7
     html = matrix_html(matrix)
     assert "json_mode" in html and "OpenAI API parity" in html
 
@@ -139,7 +146,7 @@ def test_parity_unreachable_endpoint_fails_gracefully():
     results = asyncio.run(
         ParityProber("http://127.0.0.1:1", timeout_s=0.5).probe_all()
     )
-    assert len(results) == 5
+    assert len(results) == 7
     assert not any(r.supported for r in results)
 
 

@@ -99,6 +99,7 @@ def test_cache_probe_end_to_end(tmp_path):
 
 # -- compile-perf sweep ------------------------------------------------------
 
+@pytest.mark.slow  # real AOT compiles (~80 s) — slow-lane with its peers
 def test_compile_sweep_measures(tmp_path):
     jax = pytest.importorskip("jax")
     from kserve_vllm_mini_tpu.sweeps.compile_perf import CompileConfig, run_compile_sweep
