@@ -424,3 +424,23 @@ def test_modes_without_headline_status_from_selected(bench, monkeypatch, capsys)
     assert "NOT MEASURED" not in rec["metric"]
     assert "headline not selected" in rec["metric"]
     assert rec["detail"]["speculative"]["accept_ratio"] == 1.0
+
+
+def test_hbm_mode_nests_under_hbm_attribution(bench, monkeypatch, capsys):
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv("KVMINI_BENCH_MODES", "hbm")
+
+    class P:
+        returncode = 0
+        stdout = json.dumps({
+            "mode": "hbm", "status": "ok",
+            "data": {"fit_t_fixed_ms": 11.5, "rows": []},
+        }) + "\n"
+
+    monkeypatch.setattr(bench, "_probe", lambda t: (True, "ok", "backend cpu 4.0"))
+    monkeypatch.setattr(subprocess, "run", lambda *a, **k: P())
+    rc = bench.main()
+    rec = json.loads(capsys.readouterr().out.strip())
+    assert rc == 0
+    assert rec["status"] == "ok"
+    assert rec["detail"]["hbm_attribution"]["fit_t_fixed_ms"] == 11.5
