@@ -347,6 +347,11 @@ class RequestHandle:
         self.tokens: list[int] = []
         self.logprobs: list[tuple] = []  # (logprob, [(id, lp) x K]) per token
         self.finish_reason: str = ""
+        # set by Engine.cancel (any thread): the scheduler finishes the
+        # slot with this reason at its next iteration. Server-side stop-
+        # sequence detection and client disconnects use this — the slot's
+        # remaining budget would otherwise keep decoding into the batch.
+        self.cancelled: Optional[str] = None
 
     @property
     def server_ttft_ms(self) -> float:
@@ -1455,8 +1460,25 @@ class Engine:
             off += m
         return last_logits
 
+    def cancel(self, handle: RequestHandle, reason: str = "stop") -> None:
+        """Finish ``handle``'s generation early (thread-safe; effective at
+        the scheduler's next iteration). Tokens already emitted stand; the
+        'done' event carries ``reason``. A still-queued handle is finished
+        at admission instead of prefilling."""
+        handle.cancelled = reason
+
     def _admit_one(self, handle: RequestHandle) -> None:
         req = handle.request
+        if handle.cancelled is not None:
+            # cancelled while queued: report done without spending a
+            # prefill (no tokens were produced)
+            handle.t_done = time.time()
+            handle.finish_reason = handle.cancelled
+            handle.events.put(("done", {
+                "finish_reason": handle.cancelled,
+                "tokens_out": 0,
+            }))
+            return
         slot, reused = self._pop_slot_for(req.prompt_tokens)
         if self.paged:
             # fit is the caller's job: _schedule_once defers a non-fitting
@@ -1868,6 +1890,17 @@ class Engine:
                 break
             op.run()
 
+        # cancellations first: a cancelled slot must not burn a sweep (and
+        # its freed slot can admit in the same iteration below). Published
+        # as a decision — a follower that kept the slot live would diverge
+        # its free-list from the primary's at the next admission.
+        for slot in range(self.ecfg.max_slots):
+            h = self._slot_req[slot]
+            if h is not None and h.cancelled is not None:
+                if on_decision is not None:
+                    on_decision(("cancel", h.request.request_id, h.cancelled))
+                self._finish_slot(slot, h.cancelled)
+
         admitted = False
         while self._free:
             if self.paged and self._deferred is not None:
@@ -1877,6 +1910,12 @@ class Engine:
                     handle = self._pending.get_nowait()
                 except queue.Empty:
                     break
+            if handle.cancelled is not None:
+                # cancelled while queued: finish locally WITHOUT publishing
+                # an admit (followers would otherwise admit a request the
+                # primary never did and their free-lists would diverge)
+                self._admit_one(handle)  # early-returns with the done event
+                continue
             if self.paged and not self._paged_fits(handle.request):
                 # hold at the head of the line until decode frees blocks
                 self._deferred = handle
@@ -1894,6 +1933,9 @@ class Engine:
             try:
                 handle = self._pending.get(timeout=0.02)
             except queue.Empty:
+                return
+            if handle.cancelled is not None:
+                self._admit_one(handle)  # finish-without-admit, unpublished
                 return
             if on_decision is not None:
                 on_decision(("admit", handle.request))

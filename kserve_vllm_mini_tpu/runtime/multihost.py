@@ -350,6 +350,15 @@ def run_follower(engine: Engine, subscriber: CommandSubscriber) -> None:
             engine._admit_one(RequestHandle(req_from_payload(cmd[1])))
         elif op == "sweep":
             engine._decode_sweep()
+        elif op == "cancel":
+            # mirror the primary's early finish so the follower's slot
+            # free-list stays identical for the replayed admissions
+            _rid, reason = cmd[1], cmd[2]
+            for slot in range(engine.ecfg.max_slots):
+                h = engine._slot_req[slot]
+                if h is not None and h.request.request_id == _rid:
+                    engine._finish_slot(slot, reason)
+                    break
         elif op == "stop":
             return
         else:

@@ -276,6 +276,28 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
         parts.append("assistant:")
         return "\n".join(parts)
 
+    def _stable_len(text: str) -> int:
+        """Chars of ``text`` that no future token can revise: a TRAILING
+        run of U+FFFD is an incomplete multibyte sequence still being
+        assembled (byte-level tokens split UTF-8 chars across tokens) and
+        must not be emitted — the next token may resolve it to the real
+        char. Interior replacements are final (later bytes cannot rewrite
+        already-decoded output) and flush normally; a genuinely invalid
+        trailing sequence flushes in the done-event tail."""
+        n = len(text)
+        while n > 0 and text[n - 1] == "�":
+            n -= 1
+        return n
+
+    def _first_stop_hit(text: str, stops: list[str]) -> Optional[int]:
+        """Character index of the earliest stop-sequence occurrence."""
+        best: Optional[int] = None
+        for s in stops:
+            i = text.find(s)
+            if i >= 0 and (best is None or i < best):
+                best = i
+        return best
+
     def _lp_entry(token_id: int, lp_info, top_n: int) -> dict[str, Any]:
         """OpenAI logprobs.content entry for one emitted token. -inf
         alternatives (grammar-masked bytes) are dropped: json.dumps would
@@ -562,6 +584,34 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
                 )
         # best_of ranking needs per-token logprobs even when the client did
         # not ask for them (they are stripped from the response)
+        # OpenAI stop sequences (vLLM honors them; the loadgen sends them
+        # when a profile sets params.stop — a dropped knob measures a
+        # different workload). Detection is server-side over decoded text;
+        # a hit cancels the engine slot (Engine.cancel) so the remaining
+        # budget isn't decoded into the batch. Grammar-constrained and
+        # tool requests ignore stop: the grammar defines completion.
+        stop_raw = body.get("stop")
+        stops: list[str] = []
+        if stop_raw is not None and machine is None and not wants_tools:
+            if isinstance(stop_raw, str):
+                stops = [stop_raw] if stop_raw else []
+            elif isinstance(stop_raw, list) and all(
+                isinstance(s, str) for s in stop_raw
+            ):
+                stops = [s for s in stop_raw if s]
+            else:
+                return web.json_response(
+                    {"error": {"message":
+                               "'stop' must be a string or list of strings"}},
+                    status=400,
+                )
+            if len(stops) > 4:
+                return web.json_response(
+                    {"error": {"message": "'stop' supports at most 4 sequences"}},
+                    status=400,
+                )
+        max_stop_len = max((len(s) for s in stops), default=0)
+
         rank_lp = fanout > n_choices
         req = GenRequest(
             prompt_tokens=prompt_ids or [tok.bos_id],
@@ -609,15 +659,25 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
         if not body.get("stream", False):
             async def collect(h: Any) -> tuple:
                 """Drain one candidate: (token ids, logprob entries,
-                cumulative chosen-token logprob, done info)."""
+                cumulative chosen-token logprob, done info, stop-cut char
+                index or None). On a stop-sequence hit the engine slot is
+                cancelled — the drain continues (events already queued
+                still arrive) but the budget stops burning device steps."""
                 ids: list[int] = []
                 entries: list[dict[str, Any]] = []
                 lp_sum = 0.0
+                stop_cut: Optional[int] = None
                 while True:
                     kind, *rest = await loop.run_in_executor(
                         None, h.events.get
                     )
                     if kind == "token":
+                        if stop_cut is not None:
+                            # surplus between the stop hit and the
+                            # scheduler processing the cancel: dropped
+                            # everywhere (ids/lp_sum/usage), or best_of
+                            # ranking would depend on scheduler timing
+                            continue
                         ids.append(rest[0])
                         if len(rest) > 2 and rest[2] is not None:
                             lp_sum += rest[2][0]
@@ -625,13 +685,18 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
                                 entries.append(
                                     _lp_entry(rest[0], rest[2], top_lp)
                                 )
+                        if stops:
+                            hit = _first_stop_hit(tok.decode(ids), stops)
+                            if hit is not None:
+                                stop_cut = hit
+                                engine.cancel(h)
                     else:
-                        return ids, entries, lp_sum, rest[0]
+                        return ids, entries, lp_sum, rest[0], stop_cut
 
             # candidates decode concurrently in the engine; draining them
             # in order only sequences the host-side bookkeeping
             collected = [await collect(h) for h in handles]
-            for _ids, _e, _lp, info in collected:
+            for _ids, _e, _lp, info, _cut in collected:
                 if info.get("finish_reason") == "error":
                     # e.g. the constrained grammar cannot close inside the
                     # KV window — surface the engine's message, don't 200 it
@@ -654,13 +719,19 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
                     collected, key=lambda c: -c[2] / max(len(c[0]), 1)
                 )[:n_choices]
             choices: list[dict[str, Any]] = []
-            for idx, (out_ids, lp_entries, _lp_sum, info) in enumerate(collected):
+            for idx, (out_ids, lp_entries, _lp_sum, info, stop_cut) in \
+                    enumerate(collected):
                 text = (
                     _constrained_text(out_ids) if machine is not None
                     else tok.decode(out_ids)
                 )
-                message: dict[str, Any] = {"role": "assistant", "content": text}
                 finish = info.get("finish_reason", "stop")
+                if stop_cut is not None:
+                    # OpenAI semantics: output ends BEFORE the matched stop
+                    # sequence (the match itself is not returned)
+                    text = text[:stop_cut]
+                    finish = "stop"
+                message: dict[str, Any] = {"role": "assistant", "content": text}
                 if wants_tools:
                     calls = _tool_calls_from_text(text)
                     if calls is not None:
@@ -675,7 +746,7 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
                 if want_logprobs:
                     choice["logprobs"] = {"content": lp_entries}
                 choices.append(choice)
-            info0 = collected[0][3]
+            info0 = collected[0][3]  # noqa: E501 — done info of choice 0
             return web.json_response(
                 {
                     "id": rid,
@@ -749,6 +820,26 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
         per_out = [0] * len(handles)
         per_first = [False] * len(handles)
         per_tools: list[list[int]] = [[] for _ in handles]
+        # Incremental detokenization state: the authoritative text is the
+        # FULL re-decode of the ids so far (per-token decode([id]) loses
+        # HF-tokenizer spacing — 'Ġn' decodes alone as 'n' but in context
+        # as ' n' — so piece concatenation would drift from the
+        # non-streaming text). per_sent tracks chars already emitted; with
+        # stop sequences a tail of (max stop length - 1) chars is held
+        # back so a stop split across tokens is never partially emitted.
+        # Full re-decode is O(n²) tokens per request — bounded by
+        # max_seq_len (tens of ms of host work at 2k tokens, in the event
+        # loop, far under the device step time it overlaps); a trailing-
+        # window decode would need per-tokenizer prefix-artifact handling
+        # for chars the window boundary perturbs.
+        per_ids: list[list[int]] = [[] for _ in handles]
+        per_full = [""] * len(handles)
+        per_sent = [0] * len(handles)
+        per_stopped = [False] * len(handles)
+        # logprob entries of tokens whose text is currently held back:
+        # carried to the next chunk that actually emits for the choice, so
+        # the stream's entry count matches the non-streaming response
+        per_lp_pending: list[list[dict[str, Any]]] = [[] for _ in handles]
         done_count = 0
         try:
             while done_count < len(handles):
@@ -771,18 +862,51 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
                                 }) + "\n\n").encode())
                             per_first[idx] = True
                         continue
-                    piece = (
-                        _constrained_text([rest[0]]) if machine is not None
-                        else tok.decode([rest[0]])
-                    )
+                    if per_stopped[idx]:
+                        continue  # surplus beyond the hit: swallowed
+                    if want_logprobs and len(rest) > 2 and rest[2] is not None:
+                        # recorded BEFORE any hold-back: a held token's
+                        # entry rides the next emitted chunk
+                        per_lp_pending[idx].append(
+                            _lp_entry(rest[0], rest[2], top_lp)
+                        )
+                    if machine is not None:
+                        # the byte machine's transcript is byte-exact; stop
+                        # is disabled for constrained requests at parse time
+                        piece = _constrained_text([rest[0]])
+                    else:
+                        per_ids[idx].append(rest[0])
+                        per_full[idx] = tok.decode(per_ids[idx])
+                        hit = (_first_stop_hit(per_full[idx], stops)
+                               if stops else None)
+                        if hit is not None:
+                            per_stopped[idx] = True
+                            engine.cancel(handles[idx])
+                            cut = max(hit, per_sent[idx])
+                            piece = per_full[idx][per_sent[idx]:cut]
+                            per_sent[idx] = cut
+                        else:
+                            holdback = max_stop_len - 1 if stops else 0
+                            safe = min(
+                                len(per_full[idx]) - holdback,
+                                _stable_len(per_full[idx]),
+                            )
+                            if safe > per_sent[idx]:
+                                piece = per_full[idx][per_sent[idx]:safe]
+                                per_sent[idx] = safe
+                            else:
+                                piece = ""
+                        if not piece and per_first[idx]:
+                            continue  # held back; metrics already sent
                     chunk_choice = {
                         "index": idx, "delta": {"content": piece},
                         "finish_reason": None,
                     }
-                    if want_logprobs and len(rest) > 2 and rest[2] is not None:
+                    if want_logprobs and per_lp_pending[idx]:
                         chunk_choice["logprobs"] = {
-                            "content": [_lp_entry(rest[0], rest[2], top_lp)]
+                            "content": per_lp_pending[idx]
                         }
+                        per_lp_pending[idx] = []
                     evt = {
                         "id": rid, "object": "chat.completion.chunk",
                         "created": created, "model": resp_model,
@@ -799,6 +923,15 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
                     info = rest[0]
                     final_delta: dict[str, Any] = {}
                     finish = info.get("finish_reason", "stop")
+                    if per_stopped[idx]:
+                        finish = "stop"
+                    elif machine is None:
+                        # flush the held-back tail (stop never matched) /
+                        # any decode-revision residue
+                        tail = per_full[idx][per_sent[idx]:]
+                        if tail:
+                            final_delta = {"content": tail}
+                            per_sent[idx] = len(per_full[idx])
                     if wants_tools:
                         calls = _tool_calls_from_text(
                             _constrained_text(per_tools[idx])
@@ -806,11 +939,20 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
                         if calls is not None:
                             final_delta = {"tool_calls": calls}
                             finish = "tool_calls"
+                    final_choice: dict[str, Any] = {
+                        "index": idx, "delta": final_delta,
+                        "finish_reason": finish,
+                    }
+                    if want_logprobs and per_lp_pending[idx]:
+                        # entries for tokens whose text only flushes here
+                        final_choice["logprobs"] = {
+                            "content": per_lp_pending[idx]
+                        }
+                        per_lp_pending[idx] = []
                     final = {
                         "id": rid, "object": "chat.completion.chunk",
                         "created": created, "model": resp_model,
-                        "choices": [{"index": idx, "delta": final_delta,
-                                     "finish_reason": finish}],
+                        "choices": [final_choice],
                         # same metrics block as the single-stream final
                         # chunk: the loadgen must not lose truncation /
                         # server-TTFT telemetry just because n>1
@@ -832,7 +974,12 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
                     await resp.write(f"data: {json.dumps(final)}\n\n".encode())
             await resp.write(b"data: [DONE]\n\n")
         except (ConnectionResetError, asyncio.CancelledError):
-            pass  # client went away; engine finishes the slots on its own
+            # client went away mid-stream: cancel every still-running
+            # candidate — nobody is reading, and n big-budget slots would
+            # otherwise burn decode steps and block admissions until their
+            # budgets ran out
+            for h in handles:
+                engine.cancel(h, reason="cancelled")
         try:
             await resp.write_eof()
         except ConnectionResetError:

@@ -957,3 +957,60 @@ def test_spec_decode_sampled_requests_speculate(params):
         )
     finally:
         eng.stop()
+
+
+def test_cancel_live_request(params):
+    """Engine.cancel finishes a live slot at the next scheduler iteration:
+    tokens already emitted stand, the done event carries the reason, and
+    the slot frees for reuse."""
+    eng = make_engine(params, slots=2)
+    try:
+        h = eng.submit(GenRequest(prompt_tokens=[5, 9, 42], max_new_tokens=64))
+        # wait for the first token, then cancel mid-generation
+        kind, *rest = h.events.get(timeout=120)
+        assert kind == "token"
+        eng.cancel(h, reason="stop")
+        got = 1
+        while True:
+            kind, *rest = h.events.get(timeout=120)
+            if kind == "done":
+                info = rest[0]
+                break
+            got += 1
+        assert info["finish_reason"] == "stop"
+        assert got < 64
+        # the slot must be reusable afterwards
+        ref = greedy_reference(params, [3, 1, 4], 6)
+        h2 = eng.submit(GenRequest(prompt_tokens=[3, 1, 4], max_new_tokens=6))
+        toks, _ = _drain(h2)
+        assert toks == ref
+    finally:
+        eng.stop()
+
+
+def test_cancel_queued_request(params):
+    """A handle cancelled while still queued is finished without ever
+    occupying a slot or running a prefill."""
+    eng = Engine(
+        params, CFG,
+        EngineConfig(max_slots=1, max_seq_len=128, max_prefill_len=64,
+                     min_prefill_bucket=16),
+    )
+    # occupy the only slot, keep a long request running
+    blocker = eng.submit(GenRequest(prompt_tokens=[1, 2], max_new_tokens=60))
+    queued = eng.submit(GenRequest(prompt_tokens=[3, 4], max_new_tokens=8))
+    eng.cancel(queued, reason="stop")
+    eng.start()
+    try:
+        out_q = []
+        while True:
+            kind, *rest = queued.events.get(timeout=120)
+            if kind == "done":
+                assert rest[0]["finish_reason"] == "stop"
+                assert rest[0]["tokens_out"] == 0
+                break
+            out_q.append(rest[0])
+        assert out_q == []
+        _drain(blocker)  # the blocker still finishes normally
+    finally:
+        eng.stop()

@@ -262,3 +262,70 @@ def test_profile_endpoint_captures_trace(server_url):
                       json={"seconds": "abc"}, timeout=60.0).status_code == 400
     assert httpx.post(f"{server_url}/profile",
                       json={"seconds": -5}, timeout=60.0).status_code == 400
+
+
+def test_stop_sequences(server_url):
+    """OpenAI stop sequences: output is cut BEFORE the first match
+    (non-streaming), streamed chunks never leak the stop text (holdback),
+    and a never-matching stop returns the identical full text."""
+    import httpx
+    import json as _json
+
+    def post(**extra):
+        r = httpx.post(
+            f"{server_url}/v1/chat/completions",
+            json={"messages": [{"role": "user", "content": "count to five"}],
+                  "max_tokens": 24, "temperature": 0, **extra},
+            timeout=120.0,
+        )
+        assert r.status_code == 200, r.text
+        return r.json()
+
+    base = post()["choices"][0]["message"]["content"]
+    if not base:
+        pytest.skip("model decodes to empty text for this tokenizer")
+
+    same = post(stop=[" -NEVER- "])
+    assert same["choices"][0]["message"]["content"] == base
+
+    needle = base[len(base) // 2]
+    cut = post(stop=[needle])
+    content = cut["choices"][0]["message"]["content"]
+    assert needle not in content
+    assert base.startswith(content)
+    assert cut["choices"][0]["finish_reason"] == "stop"
+
+    streamed = []
+    finish = None
+    with httpx.stream(
+        "POST", f"{server_url}/v1/chat/completions",
+        json={"messages": [{"role": "user", "content": "count to five"}],
+              "max_tokens": 24, "temperature": 0, "stream": True,
+              "stop": [needle]},
+        timeout=120.0,
+    ) as resp:
+        assert resp.status_code == 200
+        for line in resp.iter_lines():
+            line = line.strip()
+            if not line.startswith("data:") or line[5:].strip() == "[DONE]":
+                continue
+            evt = _json.loads(line[5:])
+            for c in evt.get("choices", []):
+                d = c.get("delta", {}).get("content")
+                if d:
+                    streamed.append(d)
+                if c.get("finish_reason"):
+                    finish = c["finish_reason"]
+    text = "".join(streamed)
+    assert needle not in text
+    assert text == content
+    assert finish == "stop"
+
+    for bad in ({"stop": [1, 2]}, {"stop": ["a", "b", "c", "d", "e"]}):
+        r = httpx.post(
+            f"{server_url}/v1/chat/completions",
+            json={"messages": [{"role": "user", "content": "x"}],
+                  "max_tokens": 4, **bad},
+            timeout=60.0,
+        )
+        assert r.status_code == 400, bad
