@@ -129,6 +129,33 @@ def metrics_signals(url: str, timeout_s: float = 5.0, replicas: int = 1) -> Sign
     )
 
 
+def fleet_signals(urls: list[str], timeout_s: float = 5.0) -> Signals:
+    """Aggregate /metrics across EVERY replica endpoint: duty is the mean
+    over replicas that answered, queue depth the true sum (no per-share
+    estimate needed — the exact aggregation the single-URL path can only
+    approximate by scaling). A replica that fails to answer is excluded;
+    the sample is valid while at least one answers. Use when the fleet's
+    pods are individually addressable (headless Service / port-forward
+    list); fall back to ``metrics_signals(url, replicas=N)`` behind a
+    single load-balanced URL."""
+    from kserve_vllm_mini_tpu.analysis.telemetry import scrape_runtime_metrics
+
+    duties: list[float] = []
+    queue_total = 0.0
+    for url in urls:
+        vals = scrape_runtime_metrics(url, timeout_s=timeout_s)
+        if not vals:
+            continue
+        duties.append(vals.get("kvmini_tpu_duty_cycle", 0.0))
+        queue_total += vals.get("kvmini_tpu_queue_depth", 0.0)
+    return Signals(
+        duty_cycle=sum(duties) / len(duties) if duties else 0.0,
+        queue_depth=queue_total,
+        ts=time.time(),
+        valid=bool(duties),
+    )
+
+
 def slo_breach(results: dict[str, Any], slo_path: Optional[str] = None) -> bool:
     """True when the SLO gate fails a MEASURED budget. Metrics missing from
     the snapshot fail the CI gate (gates/slo.py — absence of evidence is a
@@ -279,7 +306,14 @@ def kserve_scaler(
 
 def register(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--url", required=True,
-                        help="Runtime base URL whose /metrics drives the loop")
+                        help="Runtime base URL whose /metrics drives the "
+                             "loop. Comma-separate several replica URLs to "
+                             "aggregate fleet-wide (duty = mean, queue = "
+                             "true sum) instead of estimating from one "
+                             "load-balanced sample; an '{i}' placeholder "
+                             "(e.g. http://pod-{i}.svc:8000) expands to the "
+                             "current replica count every poll, tracking "
+                             "the controller's own scaling")
     parser.add_argument("--service", default=None,
                         help="InferenceService to scale (omit with --dry-run)")
     parser.add_argument("--namespace", default="default")
@@ -324,11 +358,26 @@ def run(args: argparse.Namespace) -> int:
     # advisor finding).
     _breach_acted = {"mtime": None}
 
+    urls = [u.strip() for u in args.url.split(",") if u.strip()]
+
     def signal_fn() -> Signals:
-        # late-bound: ctl exists by the time the controller polls; the
-        # sampled per-replica queue share is scaled to the fleet total
         current = ctl.replicas if ctl is not None else args.initial_replicas
-        sig = metrics_signals(args.url, replicas=current)
+        if len(urls) == 1 and "{i}" in urls[0]:
+            # ordinal template (StatefulSet / headless-Service DNS):
+            # expanded by the CURRENT replica count each poll, so pods the
+            # controller itself added are polled too — a static list would
+            # undercount the fleet after its own scale-up
+            sig = fleet_signals([urls[0].format(i=i) for i in range(current)])
+        elif len(urls) > 1:
+            # explicit per-replica endpoints: exact aggregation over the
+            # LISTED pods only (fine for fixed fleets; use the {i}
+            # template when the controller changes the count)
+            sig = fleet_signals(urls)
+        else:
+            # one load-balanced URL: the sampled per-replica queue share
+            # is scaled to the fleet total (late-bound: ctl exists by the
+            # time the controller polls)
+            sig = metrics_signals(urls[0], replicas=current)
         # latch only on samples the controller will ACT on: an invalid
         # scrape (pod churn — exactly when breaches happen) is discarded
         # by step(), and consuming the latch there would swallow the

@@ -657,20 +657,22 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
             return await loop.run_in_executor(None, handle.events.get)
 
         if not body.get("stream", False):
-            async def collect(h: Any) -> tuple:
+            def collect_sync(h: Any) -> tuple:
                 """Drain one candidate: (token ids, logprob entries,
                 cumulative chosen-token logprob, done info, stop-cut char
                 index or None). On a stop-sequence hit the engine slot is
                 cancelled — the drain continues (events already queued
-                still arrive) but the budget stops burning device steps."""
+                still arrive) but the budget stops burning device steps.
+                Runs in a DEDICATED thread per candidate so every
+                candidate's stop detection is live concurrently — a
+                sequential drain would not cancel candidate k's hit until
+                candidates 0..k-1 finished their whole budgets."""
                 ids: list[int] = []
                 entries: list[dict[str, Any]] = []
                 lp_sum = 0.0
                 stop_cut: Optional[int] = None
                 while True:
-                    kind, *rest = await loop.run_in_executor(
-                        None, h.events.get
-                    )
+                    kind, *rest = h.events.get()
                     if kind == "token":
                         if stop_cut is not None:
                             # surplus between the stop hit and the
@@ -693,9 +695,25 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
                     else:
                         return ids, entries, lp_sum, rest[0], stop_cut
 
-            # candidates decode concurrently in the engine; draining them
-            # in order only sequences the host-side bookkeeping
-            collected = [await collect(h) for h in handles]
+            async def collect_all() -> list:
+                futs = [loop.create_future() for _ in handles]
+
+                def worker(h: Any, fut: Any) -> None:
+                    try:
+                        res = collect_sync(h)
+                    except BaseException as e:  # noqa: BLE001 — must reach
+                        # the awaiting coroutine, not die in the thread
+                        loop.call_soon_threadsafe(fut.set_exception, e)
+                        return
+                    loop.call_soon_threadsafe(fut.set_result, res)
+
+                for h, f in zip(handles, futs):
+                    threading.Thread(
+                        target=worker, args=(h, f), daemon=True
+                    ).start()
+                return list(await asyncio.gather(*futs))
+
+            collected = await collect_all()
             for _ids, _e, _lp, info, _cut in collected:
                 if info.get("finish_reason") == "error":
                     # e.g. the constrained grammar cannot close inside the
@@ -845,6 +863,12 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
             while done_count < len(handles):
                 idx, (kind, *rest) = await merged.get()
                 if kind == "token":
+                    if per_stopped[idx]:
+                        # surplus decoded between the stop hit and the
+                        # scheduler processing the cancel: swallowed AND
+                        # uncounted, so streamed usage matches the
+                        # non-streaming accounting deterministically
+                        continue
                     per_out[idx] += 1
                     if wants_tools:
                         per_tools[idx].append(rest[0])
@@ -862,8 +886,6 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
                                 }) + "\n\n").encode())
                             per_first[idx] = True
                         continue
-                    if per_stopped[idx]:
-                        continue  # surplus beyond the hit: swallowed
                     if want_logprobs and len(rest) > 2 and rest[2] is not None:
                         # recorded BEFORE any hold-back: a held token's
                         # entry rides the next emitted chunk

@@ -285,3 +285,27 @@ def test_metrics_signals_scales_queue_share_to_fleet_total(monkeypatch):
     assert want > 4
     # default replicas=1 keeps the raw share (single-replica fleets)
     assert mod.metrics_signals("http://x").queue_depth == 6.0
+
+
+def test_fleet_signals_aggregates_replicas(monkeypatch):
+    """Multi-URL mode: duty is the mean over answering replicas, queue the
+    true sum; dead replicas are excluded and the sample stays valid while
+    any answers; all dead -> invalid (controller holds)."""
+    from kserve_vllm_mini_tpu.analysis import telemetry
+    from kserve_vllm_mini_tpu.autoscale import controller as mod
+
+    per_url = {
+        "http://a": {"kvmini_tpu_duty_cycle": 0.9, "kvmini_tpu_queue_depth": 6.0},
+        "http://b": {"kvmini_tpu_duty_cycle": 0.5, "kvmini_tpu_queue_depth": 2.0},
+        "http://dead": {},
+    }
+    monkeypatch.setattr(
+        telemetry, "scrape_runtime_metrics",
+        lambda url, timeout_s=5.0: per_url[url],
+    )
+    sig = mod.fleet_signals(["http://a", "http://b", "http://dead"])
+    assert sig.valid
+    assert abs(sig.duty_cycle - 0.7) < 1e-9
+    assert sig.queue_depth == 8.0
+    dead = mod.fleet_signals(["http://dead"])
+    assert not dead.valid
