@@ -53,6 +53,8 @@ _SUBCOMMANDS: dict[str, tuple[str, str]] = {
     "profile": ("kserve_vllm_mini_tpu.runtime.profiler", "Capture a TensorBoard trace of a live runtime"),
     "autoscale-controller": ("kserve_vllm_mini_tpu.autoscale.controller",
                              "SLO/duty-signal-driven replica controller"),
+    "autoscale-sim": ("kserve_vllm_mini_tpu.autoscale.simulate",
+                      "Replay a load timeline against the autoscale policy"),
 }
 
 

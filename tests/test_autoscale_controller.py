@@ -309,3 +309,95 @@ def test_fleet_signals_aggregates_replicas(monkeypatch):
     assert sig.queue_depth == 8.0
     dead = mod.fleet_signals(["http://dead"])
     assert not dead.valid
+
+
+# -- policy simulation harness (autoscale/simulate.py) -----------------------
+
+
+def test_sim_burst_scales_up_and_drains():
+    """A burst beyond one replica's capacity must drive scale-up through
+    the REAL policy, capacity must lag by the provisioning delay, and the
+    queue must drain once it lands."""
+    from kserve_vllm_mini_tpu.autoscale.simulate import (
+        SimConfig,
+        simulate,
+        synthetic_timeline,
+    )
+
+    # 400 requests x 64 work in 60s = ~427 units/s sustained vs 100/s per
+    # replica: needs ~5 replicas
+    tl = synthetic_timeline("steady", 400, 60.0, work_per_request=64.0)
+    res = simulate(tl, SimConfig(
+        rate_per_replica=100.0, poll_interval_s=5.0,
+        provision_delay_s=30.0, initial_replicas=1, drain_s=600.0,
+    ))
+    assert res.summary["peak_replicas"] > 1, res.summary
+    assert res.summary["completed"] == 400
+    assert res.summary["unserved_at_end"] == 0
+    # capacity must not appear before the provisioning delay: every step
+    # before t=30 still runs 1 active replica
+    early = [s for s in res.steps if s["t"] <= 30.0]
+    assert all(s["replicas_active"] == 1 for s in early)
+
+
+def test_sim_provision_delay_costs_wait():
+    """Longer provisioning delay (TPU pools) must show up as strictly
+    higher p95 request wait at identical load and policy — the tradeoff
+    the harness exists to quantify."""
+    from kserve_vllm_mini_tpu.autoscale.simulate import (
+        SimConfig,
+        simulate,
+        synthetic_timeline,
+    )
+
+    tl = synthetic_timeline("steady", 300, 60.0, work_per_request=64.0)
+
+    def p95(delay):
+        return simulate(tl, SimConfig(
+            rate_per_replica=100.0, poll_interval_s=5.0,
+            provision_delay_s=delay, initial_replicas=1, drain_s=900.0,
+        )).summary["wait_p95_s"]
+
+    assert p95(300.0) > p95(10.0)
+
+
+def test_sim_rundir_replay(tmp_path, synthetic_run):
+    """A recorded run dir replays through the CLI path and lands
+    autoscale_sim.json next to the recording."""
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    run_path = str(getattr(synthetic_run, "path", synthetic_run))
+    p = subprocess.run(
+        [sys.executable, "-m", "kserve_vllm_mini_tpu", "autoscale-sim",
+         "--run-dir", run_path, "--rate-per-replica", "50",
+         "--interval", "5", "--provision-delay", "20"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert p.returncode == 0, p.stderr[-500:]
+    art = Path(run_path) / "autoscale_sim.json"
+    assert art.is_file()
+    data = json.loads(art.read_text())
+    assert data["summary"]["requests"] > 0
+    assert data["steps"] and data["decisions"]
+
+
+def test_sim_scale_down_cancels_pending_ups():
+    """The review-reproduced regression: after the queue drains on fewer
+    replicas and the controller shrinks, CANCELLED pending scale-ups must
+    never land later and pin the fleet above desired."""
+    from kserve_vllm_mini_tpu.autoscale.simulate import (
+        SimConfig,
+        simulate,
+        synthetic_timeline,
+    )
+
+    tl = synthetic_timeline("steady", 50, 20.0, work_per_request=64.0)
+    res = simulate(tl, SimConfig(
+        rate_per_replica=100.0, poll_interval_s=5.0,
+        provision_delay_s=600.0, initial_replicas=1, drain_s=900.0,
+    ))
+    tail = res.steps[-1]
+    assert tail["replicas_active"] == tail["replicas_desired"], tail
+    assert res.summary["final_replicas"] == tail["replicas_desired"]
