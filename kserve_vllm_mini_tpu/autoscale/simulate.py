@@ -108,9 +108,12 @@ def simulate(
     policy = policy or PolicyConfig()
     res = SimResult()
 
+    from collections import deque
+
     # fluid queue: FIFO of [remaining_work, arrival_ts]; completed requests
-    # record their wait (arrival -> fully served)
-    queue: list[list[float]] = []
+    # record their wait (arrival -> fully served). deque: a deep
+    # underprovisioned backlog would make list.pop(0) O(n²)
+    queue: "deque[list[float]]" = deque()
     waits: list[float] = []
     clock = {"t": 0.0}
 
@@ -124,12 +127,13 @@ def simulate(
         return clock["t"]
 
     def scaler(n: int) -> None:
+        # ANY new target invalidates in-flight scale-ups above it — also
+        # an intermediate shrink issued while capacity is still
+        # provisioning (active < n < old pending), or the stale pendings
+        # would land later and pin the fleet above desired
+        pending[:] = [(ts, t) for ts, t in pending if t <= n]
         if n <= state["active"]:
             state["active"] = n          # shrink: immediate
-            # pending ups beyond the new target are cancelled (keep only
-            # ones still at-or-under it, or a cancelled burst's capacity
-            # would land later and pin the fleet above desired)
-            pending[:] = [(ts, t) for ts, t in pending if t <= n]
         else:
             pending.append((clock["t"] + sim.provision_delay_s, n))
 
@@ -176,7 +180,7 @@ def simulate(
             capacity -= take
             served += take
             if queue[0][0] <= 1e-9:
-                _, arrived = queue.pop(0)
+                _, arrived = queue.popleft()
                 waits.append(t_end - arrived)
         total_capacity = state["active"] * sim.rate_per_replica * dt
         last_sig["duty"] = min(served / total_capacity, 1.0) if total_capacity else 0.0
@@ -223,7 +227,9 @@ def simulate(
 def register(parser: argparse.ArgumentParser) -> None:
     src = parser.add_mutually_exclusive_group(required=True)
     src.add_argument("--run-dir", help="Replay a recorded requests.csv timeline")
-    src.add_argument("--pattern", choices=["steady", "poisson", "bursty", "heavy"],
+    from kserve_vllm_mini_tpu.loadgen.arrivals import PATTERNS
+
+    src.add_argument("--pattern", choices=sorted(PATTERNS),
                      help="Synthesize arrivals with the loadgen's pattern engine")
     parser.add_argument("--requests", type=int, default=200,
                         help="Synthetic request count (--pattern)")

@@ -184,6 +184,30 @@ def generate_single_run_html(
                 sections.append(
                     f"<section><h2>Autoscale decisions</h2>{chart}</section>"
                 )
+        # the policy simulator's replay (kvmini-tpu autoscale-sim
+        # --run-dir ...) writes the same decision shape plus a summary —
+        # render it beside the live timeline so recorded traffic and its
+        # simulated what-if share one report
+        sim_path = run_dir / "autoscale_sim.json"
+        if sim_path.exists():
+            try:
+                sim = json.loads(sim_path.read_text())
+            except ValueError:
+                sim = None
+            if isinstance(sim, dict) and sim.get("decisions"):
+                chart = charts.autoscale_timeline_chart(sim["decisions"])
+                summ = sim.get("summary", {})
+                facts = " · ".join(
+                    f"{k.replace('_', ' ')}: {v}"
+                    for k, v in summ.items()
+                    if k in ("peak_replicas", "replica_seconds",
+                             "wait_p95_s", "peak_queue", "unserved_at_end")
+                )
+                if chart:
+                    sections.append(
+                        "<section><h2>Autoscale policy simulation</h2>"
+                        f"<p>{html_mod.escape(facts)}</p>{chart}</section>"
+                    )
 
     cw = charts.cold_warm_chart(results)
     if cw:

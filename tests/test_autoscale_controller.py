@@ -401,3 +401,56 @@ def test_sim_scale_down_cancels_pending_ups():
     tail = res.steps[-1]
     assert tail["replicas_active"] == tail["replicas_desired"], tail
     assert res.summary["final_replicas"] == tail["replicas_desired"]
+
+
+def test_sim_results_render_in_report(tmp_path):
+    """A run dir carrying autoscale_sim.json gets a policy-simulation
+    section with its summary facts; junk JSON degrades silently."""
+    import json as _json
+
+    from kserve_vllm_mini_tpu.report.html import generate_single_run_html
+
+    decisions = [
+        {"ts": 5.0 * i, "duty": 0.5, "queue": float(i),
+         "slo_breached": False, "current": 1, "raw_desired": 1 + i,
+         "applied": 1 + i}
+        for i in range(5)
+    ]
+    (tmp_path / "autoscale_sim.json").write_text(_json.dumps({
+        "summary": {"peak_replicas": 5, "replica_seconds": 123.0,
+                    "wait_p95_s": 8.2, "peak_queue": 40,
+                    "unserved_at_end": 0, "requests": 100},
+        "steps": [], "decisions": decisions,
+    }))
+    html = generate_single_run_html({"p95_ms": 100.0, "requests": 5},
+                                    run_dir=tmp_path)
+    assert "Autoscale policy simulation" in html
+    assert "peak replicas: 5" in html
+    (tmp_path / "autoscale_sim.json").write_text("{junk")
+    html2 = generate_single_run_html({"p95_ms": 100.0, "requests": 5},
+                                     run_dir=tmp_path)
+    assert "Autoscale policy simulation" not in html2
+
+
+def test_sim_intermediate_shrink_cancels_stale_pendings():
+    """The review-reproduced case: a PARTIAL scale-down issued while
+    higher scale-ups are still provisioning must cancel them — the fleet
+    must converge to desired, not to a stale burst target."""
+    from kserve_vllm_mini_tpu.autoscale.simulate import SimConfig, simulate
+
+    # burst then a moderate trickle: the controller overshoots during the
+    # burst (pendings in flight at 600s delay), then settles lower
+    tl = [(t * 0.05, 64.0) for t in range(400)]            # 20s hot burst
+    tl += [(25.0 + i * 2.0, 64.0) for i in range(300)]     # long trickle
+    res = simulate(tl, SimConfig(
+        rate_per_replica=100.0, poll_interval_s=5.0,
+        provision_delay_s=600.0, initial_replicas=1, drain_s=1500.0,
+    ))
+    # after everything lands and drains, active must equal desired; no
+    # step may show active exceeding the max desired seen so far
+    tail = res.steps[-1]
+    assert tail["replicas_active"] == tail["replicas_desired"], tail
+    max_desired = 0
+    for s in res.steps:
+        max_desired = max(max_desired, s["replicas_desired"])
+        assert s["replicas_active"] <= max_desired, s
