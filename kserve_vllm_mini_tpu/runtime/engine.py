@@ -335,6 +335,19 @@ class _AdminOp:
             self.done.set()
 
 
+def _require_tp_only_mesh(mesh) -> None:
+    """Multi-LoRA's replicated-bank design assumes tp-only meshes — ONE
+    check shared by Engine init (preset banks) and load_adapter (hot-swap)
+    so the two paths can never drift on which mesh shapes they accept."""
+    if mesh is not None and any(
+        mesh.shape.get(ax, 1) > 1 for ax in ("dp", "sp", "pp", "ep")
+    ):
+        raise ValueError(
+            "multi-LoRA composes with tp-only meshes (replicated "
+            "banks); dp/sp/pp/ep need a LoRA-free engine"
+        )
+
+
 class RequestHandle:
     """Streamed results: ('token', id, ts) events then ('done', info)."""
 
@@ -540,20 +553,14 @@ class Engine:
         # multi-LoRA bank: per-slot adapter index decoded inside the same
         # jitted step; index 0 is the base (zero) adapter
         if lora is not None:
-            if mesh is not None and any(
-                mesh.shape.get(ax, 1) > 1 for ax in ("dp", "sp", "pp", "ep")
-            ):
-                raise ValueError(
-                    "multi-LoRA composes with tp-only meshes (replicated "
-                    "banks); dp/sp/pp/ep need a LoRA-free engine"
-                )
+            _require_tp_only_mesh(mesh)
             if mesh is not None:
                 # replicate the bank over the mesh BEFORE it becomes engine
                 # state: factor banks are MBs at serving ranks, and a
                 # replicated delta lets GSPMD join it with the tp-sharded
                 # base projections however each target is partitioned (no
-                # per-target spec bookkeeping to get wrong). Hot-swap stays
-                # single-device (load_adapter).
+                # per-target spec bookkeeping to get wrong). Hot-swap
+                # (load_adapter) applies the same replication.
                 from jax.sharding import NamedSharding, PartitionSpec
 
                 rep = NamedSharding(mesh, PartitionSpec())
@@ -871,22 +878,25 @@ class Engine:
                 zero_lora_bank,
             )
 
-            if self.mesh is not None or self._drafter_params is not None \
-                    or self.ecfg.prefix_cache:
+            if self._drafter_params is not None or self.ecfg.prefix_cache:
                 raise ValueError(
-                    "adapter HOT-SWAP stays single-device (preset --lora "
-                    "banks do serve on tp meshes), and multi-LoRA excludes "
-                    "drafters and prefix_cache"
+                    "multi-LoRA excludes drafters and prefix_cache"
                 )
-            if self._lora is None:
+            _require_tp_only_mesh(self.mesh)
+            # TRANSACTIONAL: every mutation lands on a local bank and
+            # self._lora is only reassigned after install_adapter succeeds
+            # — a rank/target mismatch raising mid-update must leave the
+            # old adapter's weights serving, not a zeroed slot that is
+            # still routable by name
+            cur = self._lora
+            if cur is None:
                 rank = next(iter(adapter.values()))[0].shape[-1]
-                bank = zero_lora_bank(
+                cur = zero_lora_bank(
                     self.cfg, self.ecfg.lora_slots, rank,
                     targets=sorted(adapter), dtype=self.cfg.jnp_dtype,
                 )
-                bank["names"] = {}
-                self._lora = bank
-            names = self._lora["names"]
+                cur["names"] = {}
+            names = cur["names"]
             if name in names:
                 idx = names[name]
                 why = self._adapter_in_use(idx, name)
@@ -896,7 +906,7 @@ class Engine:
                         "corrupt them"
                     )
             else:
-                capacity = next(iter(self._lora["layers"].values())).shape[1] - 1
+                capacity = next(iter(cur["layers"].values())).shape[1] - 1
                 used = set(names.values())
                 free = [i for i in range(1, capacity + 1) if i not in used]
                 if not free:
@@ -910,10 +920,24 @@ class Engine:
             # targets than the index's previous occupant, and install only
             # writes the targets it has — leftovers would silently blend
             # two fine-tunes
-            self._lora = self._zero_bank_index(self._lora, idx)
-            self._lora = install_adapter(self._lora, idx, adapter)
-            self._lora["names"] = dict(names, **{name: idx})
-            self._lora_names = self._lora["names"]
+            bank = self._zero_bank_index(cur, idx)
+            bank = install_adapter(bank, idx, adapter)
+            if self.mesh is not None:
+                # same replication as the preset-bank init path: the delta
+                # joins the tp-sharded base projections however each
+                # target is partitioned. Eager .at[].set updates preserve
+                # sharding, but the freshly-built bank (first load) and
+                # the host-side adapter arrays do not — normalize here.
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                rep = NamedSharding(self.mesh, PartitionSpec())
+                bank = {
+                    **bank,
+                    "layers": jax.device_put(bank["layers"], rep),
+                }
+            bank["names"] = dict(names, **{name: idx})
+            self._lora = bank
+            self._lora_names = bank["names"]
 
         return self._run_admin(_apply)
 

@@ -1130,8 +1130,25 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
             ]
         return web.Response(text="\n".join(lines) + "\n", content_type="text/plain")
 
+    def _reject_multihost_admin() -> "Optional[web.Response]":
+        """Adapter admin ops run only on the primary and are NOT replayed
+        over the command channel — followers would keep serving the base
+        weights for adapted requests (silent lockstep divergence). Checked
+        BEFORE body parsing so multihost callers get the real reason, not
+        an incidental JSON error."""
+        if multihost:
+            return web.json_response(
+                {"error": {"message":
+                           "adapter hot-swap is not supported under "
+                           "multi-host serving (v1)"}}, status=400,
+            )
+        return None
+
     async def load_lora(request: "web.Request"):
         # vLLM dynamic-LoRA surface: {"lora_name": ..., "lora_path": <PEFT dir>}
+        rej = _reject_multihost_admin()
+        if rej is not None:
+            return rej
         try:
             body = await request.json()
         except Exception:
@@ -1168,6 +1185,9 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
         return web.json_response({"status": "ok", "loaded": name})
 
     async def unload_lora(request: "web.Request"):
+        rej = _reject_multihost_admin()
+        if rej is not None:
+            return rej
         try:
             body = await request.json()
         except Exception:

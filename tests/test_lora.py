@@ -466,3 +466,66 @@ def test_server_routes_model_field(params, bank):
         asyncio.run(drive())
     finally:
         eng.stop()
+
+
+def test_live_adapter_load_on_tp_mesh(params, tmp_path):
+    """Hot-swap on a tp-only MESH (previously single-device only): the
+    first load creates a replicated bank, the adapter serves and differs
+    from base, and dp meshes still reject the load."""
+    from kserve_vllm_mini_tpu.parallel.mesh import MeshSpec, make_mesh
+    from kserve_vllm_mini_tpu.parallel.sharding import shard_params
+
+    _write_peft_dir(str(tmp_path / "a"), CFG, rank=4, seed=11)
+    adapter_a = load_peft_adapter(str(tmp_path / "a"), CFG)
+
+    mesh = make_mesh(MeshSpec(tp=2))
+    eng = Engine(
+        shard_params(params, CFG, mesh), CFG,
+        EngineConfig(max_slots=2, max_seq_len=64, lora_slots=2),
+        mesh=mesh,
+    )
+    eng.start()
+    try:
+        base = _drain_tokens(eng.submit(_req([1, 2, 3])))
+        assert eng.load_adapter("tune-a", adapter_a) is None
+        out_a = _drain_tokens(eng.submit(_req([1, 2, 3], "tune-a")))
+        assert len(out_a) == 6
+        assert out_a != base
+        # base path unchanged after the swap
+        assert _drain_tokens(eng.submit(_req([1, 2, 3]))) == base
+    finally:
+        eng.stop()
+
+    dp_eng = Engine(
+        shard_params(params, CFG, make_mesh(MeshSpec(dp=2))), CFG,
+        EngineConfig(max_slots=2, max_seq_len=64),
+        mesh=make_mesh(MeshSpec(dp=2)),
+    )
+    dp_eng.start()
+    try:
+        err = dp_eng.load_adapter("tune-a", adapter_a)
+        assert err is not None and "tp-only" in err
+    finally:
+        dp_eng.stop()
+
+
+def test_failed_adapter_update_preserves_old_weights(params, tmp_path):
+    """A bad update (rank mismatch) must leave the OLD adapter serving —
+    not a zeroed slot that is still routable by name."""
+    _write_peft_dir(str(tmp_path / "a"), CFG, rank=4, seed=11)
+    adapter_a = load_peft_adapter(str(tmp_path / "a"), CFG)
+    _write_peft_dir(str(tmp_path / "wide"), CFG, rank=8, seed=22)
+    adapter_wide = load_peft_adapter(str(tmp_path / "wide"), CFG)
+
+    eng = Engine(params, CFG,
+                 EngineConfig(max_slots=2, max_seq_len=64, lora_slots=2))
+    eng.start()
+    try:
+        assert eng.load_adapter("tune-a", adapter_a) is None
+        out_before = _drain_tokens(eng.submit(_req([1, 2, 3], "tune-a")))
+        err = eng.load_adapter("tune-a", adapter_wide)  # rank 8 != bank 4
+        assert err is not None
+        out_after = _drain_tokens(eng.submit(_req([1, 2, 3], "tune-a")))
+        assert out_after == out_before
+    finally:
+        eng.stop()
