@@ -105,6 +105,43 @@ def zero_lora_bank(
     return {"layers": layers, "rank": rank, "targets": tuple(targets)}
 
 
+def grow_bank_rank(bank: dict[str, Any], new_rank: int) -> dict[str, Any]:
+    """Zero-pad every factor's rank dimension to ``new_rank``. The delta
+    ``A @ B`` is bit-unchanged for installed adapters (padded rank rows/
+    columns contribute zero), so a live bank grows to accept higher-rank
+    installs WITHOUT a restart — the only cost is one decode retrace on
+    the next dispatch (jit keys on shapes)."""
+    r = bank["rank"]
+    if new_rank <= r:
+        return bank
+    layers: dict[str, Any] = {}
+    for k, v in bank["layers"].items():
+        if k.endswith("_A"):      # [L, N, in, r] — pad the last dim
+            pad = [(0, 0)] * (v.ndim - 1) + [(0, new_rank - r)]
+        else:                     # [L, N, r, out] — pad the rank dim
+            pad = [(0, 0)] * (v.ndim - 2) + [(0, new_rank - r), (0, 0)]
+        layers[k] = jnp.pad(v, pad)
+    return {**bank, "layers": layers, "rank": new_rank}
+
+
+def pad_adapter_rank(adapter: dict[str, Any], rank: int) -> dict[str, Any]:
+    """Zero-pad a lower-rank adapter's factors up to the bank rank (exact:
+    the padding contributes nothing to A @ B). Higher-than-bank ranks are
+    the caller's problem (grow the bank first)."""
+    out: dict[str, Any] = {}
+    for t, (a, b) in adapter.items():
+        r = a.shape[-1]
+        if r > rank:
+            raise ValueError(
+                f"adapter rank {r} exceeds bank rank {rank}; grow the bank"
+            )
+        if r < rank:
+            a = jnp.pad(a, [(0, 0)] * (a.ndim - 1) + [(0, rank - r)])
+            b = jnp.pad(b, [(0, 0)] * (b.ndim - 2) + [(0, rank - r), (0, 0)])
+        out[t] = (a, b)
+    return out
+
+
 def install_adapter(
     bank: dict[str, Any],
     index: int,

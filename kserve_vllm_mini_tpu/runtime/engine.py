@@ -874,7 +874,9 @@ class Engine:
 
         def _apply():
             from kserve_vllm_mini_tpu.ops.lora import (
+                grow_bank_rank,
                 install_adapter,
+                pad_adapter_rank,
                 zero_lora_bank,
             )
 
@@ -916,12 +918,20 @@ class Engine:
                         "larger bank (lora_slots / --lora-slots)"
                     )
                 idx = free[0]
+            # rank flexibility without a restart: a higher-rank adapter
+            # grows the whole bank (zero-padding preserves installed
+            # deltas exactly; the next decode dispatch retraces once), a
+            # lower-rank adapter pads itself up to the bank
+            in_rank = max(a.shape[-1] for a, _b in adapter.values())
+            if in_rank > cur["rank"]:
+                cur = grow_bank_rank(cur, in_rank)
+            padded = pad_adapter_rank(adapter, cur["rank"])
             # zero the index first: the incoming adapter may cover FEWER
             # targets than the index's previous occupant, and install only
             # writes the targets it has — leftovers would silently blend
             # two fine-tunes
             bank = self._zero_bank_index(cur, idx)
-            bank = install_adapter(bank, idx, adapter)
+            bank = install_adapter(bank, idx, padded)
             if self.mesh is not None:
                 # same replication as the preset-bank init path: the delta
                 # joins the tp-sharded base projections however each

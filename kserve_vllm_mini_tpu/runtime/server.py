@@ -208,21 +208,24 @@ def build_engine(
             for nm, path in lora_adapters.items()
         }
         ranks = {
-            nm: next(iter(ad.values()))[0].shape[-1] for nm, ad in loaded.items()
+            # max over EVERY target: PEFT rank_pattern adapters carry
+            # per-target ranks, and the bank must fit the largest (the
+            # engine hot-swap path computes in_rank the same way)
+            nm: max(a.shape[-1] for a, _b in ad.values())
+            for nm, ad in loaded.items()
         }
-        if len(set(ranks.values())) > 1:
-            # v1: one bank, one rank (padding mixed ranks to max is future
-            # work) — name the offenders instead of crashing inside install
-            raise ValueError(
-                f"all adapters must share one LoRA rank, got {ranks}"
-            )
-        rank = next(iter(ranks.values()))
+        # mixed ranks share one bank at the MAX rank: zero-padding a
+        # lower-rank adapter's factors is exact (the padding contributes
+        # nothing to A @ B), same mechanism hot-swap growth uses
+        from kserve_vllm_mini_tpu.ops.lora import pad_adapter_rank
+
+        rank = max(ranks.values())
         targets = sorted({t for ad in loaded.values() for t in ad})
         bank = zero_lora_bank(cfg, len(loaded), rank, targets=targets,
                               dtype=cfg.jnp_dtype)
         names: dict[str, int] = {}
         for i, (nm, ad) in enumerate(sorted(loaded.items()), start=1):
-            bank = install_adapter(bank, i, ad)
+            bank = install_adapter(bank, i, pad_adapter_rank(ad, rank))
             names[nm] = i
         bank["names"] = names
         lora_bank = bank
@@ -1131,11 +1134,13 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
         return web.Response(text="\n".join(lines) + "\n", content_type="text/plain")
 
     def _reject_multihost_admin() -> "Optional[web.Response]":
-        """Adapter admin ops run only on the primary and are NOT replayed
-        over the command channel — followers would keep serving the base
-        weights for adapted requests (silent lockstep divergence). Checked
-        BEFORE body parsing so multihost callers get the real reason, not
-        an incidental JSON error."""
+        """Multi-host serving rejects LoRA entirely at startup
+        (runtime/multihost.check_multihost_engine): admin ops run only on
+        the primary and are NOT replayed over the command channel, so a
+        load would leave followers serving base weights (silent lockstep
+        divergence). These endpoints reject up front — BEFORE body
+        parsing, so multihost callers get the real reason rather than an
+        incidental JSON error."""
         if multihost:
             return web.json_response(
                 {"error": {"message":

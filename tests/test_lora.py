@@ -259,16 +259,29 @@ def test_peft_partial_layer_coverage_rejected(tmp_path, params):
         load_peft_adapter(str(tmp_path), CFG)
 
 
-def test_mixed_rank_adapters_rejected(tmp_path, params):
+def test_mixed_rank_adapters_share_one_padded_bank(tmp_path, params):
+    """Mixed ranks load into ONE bank at the max rank (zero-padded — the
+    delta is exact), and both adapters serve with distinct outputs."""
     from kserve_vllm_mini_tpu.runtime.server import build_engine
 
     d8 = tmp_path / "r8"
     d16 = tmp_path / "r16"
-    _write_peft_dir(str(d8), CFG, rank=4)
-    _write_peft_dir(str(d16), CFG, rank=8)
-    with pytest.raises(ValueError, match="share one LoRA rank"):
-        build_engine(model="llama-tiny", max_slots=2, max_seq_len=64,
-                     lora_adapters={"a": str(d8), "b": str(d16)})
+    _write_peft_dir(str(d8), CFG, rank=4, seed=5)
+    _write_peft_dir(str(d16), CFG, rank=8, seed=6)
+    engine, _tok, _name = build_engine(
+        model="llama-tiny", max_slots=2, max_seq_len=64,
+        lora_adapters={"a": str(d8), "b": str(d16)},
+    )
+    assert engine._lora["rank"] == 8
+    engine.start()
+    try:
+        base = _drain_tokens(engine.submit(_req([1, 2, 3])))
+        out_a = _drain_tokens(engine.submit(_req([1, 2, 3], "a")))
+        out_b = _drain_tokens(engine.submit(_req([1, 2, 3], "b")))
+        assert out_a != base or out_b != base
+        assert out_a != out_b
+    finally:
+        engine.stop()
 
 
 def test_live_adapter_load_unload(params, tmp_path):
@@ -510,8 +523,35 @@ def test_live_adapter_load_on_tp_mesh(params, tmp_path):
 
 
 def test_failed_adapter_update_preserves_old_weights(params, tmp_path):
-    """A bad update (rank mismatch) must leave the OLD adapter serving —
+    """A bad update (unknown target) must leave the OLD adapter serving —
     not a zeroed slot that is still routable by name."""
+    import jax.numpy as jnp
+
+    _write_peft_dir(str(tmp_path / "a"), CFG, rank=4, seed=11)
+    adapter_a = load_peft_adapter(str(tmp_path / "a"), CFG)
+    bogus = {"not_a_target": (
+        jnp.zeros((CFG.n_layers, CFG.d_model, 4)),
+        jnp.zeros((CFG.n_layers, 4, CFG.d_model)),
+    )}
+
+    eng = Engine(params, CFG,
+                 EngineConfig(max_slots=2, max_seq_len=64, lora_slots=2))
+    eng.start()
+    try:
+        assert eng.load_adapter("tune-a", adapter_a) is None
+        out_before = _drain_tokens(eng.submit(_req([1, 2, 3], "tune-a")))
+        err = eng.load_adapter("tune-a", bogus)  # unknown target
+        assert err is not None and "no target" in err
+        out_after = _drain_tokens(eng.submit(_req([1, 2, 3], "tune-a")))
+        assert out_after == out_before
+    finally:
+        eng.stop()
+
+
+def test_hot_swap_rank_growth_without_restart(params, tmp_path):
+    """A higher-rank adapter grows the live bank (zero-padding keeps the
+    installed adapter's delta EXACT — its output must not change), and a
+    lower-rank adapter pads itself into the grown bank."""
     _write_peft_dir(str(tmp_path / "a"), CFG, rank=4, seed=11)
     adapter_a = load_peft_adapter(str(tmp_path / "a"), CFG)
     _write_peft_dir(str(tmp_path / "wide"), CFG, rank=8, seed=22)
@@ -522,10 +562,13 @@ def test_failed_adapter_update_preserves_old_weights(params, tmp_path):
     eng.start()
     try:
         assert eng.load_adapter("tune-a", adapter_a) is None
-        out_before = _drain_tokens(eng.submit(_req([1, 2, 3], "tune-a")))
-        err = eng.load_adapter("tune-a", adapter_wide)  # rank 8 != bank 4
-        assert err is not None
-        out_after = _drain_tokens(eng.submit(_req([1, 2, 3], "tune-a")))
-        assert out_after == out_before
+        out_a = _drain_tokens(eng.submit(_req([1, 2, 3], "tune-a")))
+        assert eng._lora["rank"] == 4
+        assert eng.load_adapter("wide", adapter_wide) is None
+        assert eng._lora["rank"] == 8
+        out_w = _drain_tokens(eng.submit(_req([1, 2, 3], "wide")))
+        # growth preserved the rank-4 adapter bit-exactly
+        assert _drain_tokens(eng.submit(_req([1, 2, 3], "tune-a"))) == out_a
+        assert out_w != out_a
     finally:
         eng.stop()
