@@ -154,6 +154,10 @@ def _run_stages(
         streaming=bool(profile.get("streaming", True)),
         max_tokens=int(profile.get("max_tokens", 64)),
         temperature=float(profile.get("temperature", 0.0)),
+        n=int(profile.get("n", 1)),
+        presence_penalty=float(profile.get("presence_penalty", 0.0)),
+        frequency_penalty=float(profile.get("frequency_penalty", 0.0)),
+        stop=profile.get("stop"),
         prompt_set=profile.get("prompt_set", "default"),
         input_tokens=int(profile.get("input_tokens", 0)),
         seed=int(profile.get("seed", 42)),

@@ -100,7 +100,12 @@ class OpenAIChatAdapter(ProtocolAdapter):
                     r.truncated_tokens = int(metrics.get("truncated_tokens", 0))
                 delta = ""
                 for ch in evt.get("choices") or []:
-                    delta += (ch.get("delta") or {}).get("content", "") or ""
+                    # choice 0 only, matching the non-streaming path: with
+                    # n>1 the server interleaves per-choice chunks, and a
+                    # concatenated mix would feed garbled text to the
+                    # quality checks and double-count fallback tokens
+                    if ch.get("index", 0) == 0:
+                        delta += (ch.get("delta") or {}).get("content", "") or ""
                 return delta
 
             async with client.stream("POST", url, json=body, headers=headers) as resp:

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import sys
 import time
 from dataclasses import dataclass, field
 from typing import Any, Optional
@@ -46,6 +47,13 @@ class LoadConfig:
     temperature: float = 0.0
     top_p: float = 1.0
     top_k: int = 0
+    # the rest of the OpenAI payload the reference's loadtest forwards
+    # (scripts/loadtest.py:260-342) — first-class, not extra_body-only, so
+    # profiles and the CLI exercise the knobs the server now honors
+    n: int = 1
+    presence_penalty: float = 0.0
+    frequency_penalty: float = 0.0
+    stop: Optional[list[str]] = None
     prompt_set: str = "default"
     base_prompt: Optional[str] = None
     input_tokens: int = 0
@@ -57,11 +65,25 @@ class LoadConfig:
     extra_body: dict[str, Any] = field(default_factory=dict)
 
     def gen_params(self) -> GenParams:
+        # a bare-string stop (natural YAML spelling: `stop: "END"`) must
+        # become ONE sequence — list("END") would explode it into
+        # per-character stops and silently measure a ~1-token workload
+        stop = self.stop
+        if isinstance(stop, str):
+            stop = [stop]
+        elif stop:
+            stop = [str(s) for s in stop]
+        else:
+            stop = None
         return GenParams(
             max_tokens=self.max_tokens,
             temperature=self.temperature,
             top_p=self.top_p,
             top_k=self.top_k,
+            n=self.n,
+            presence_penalty=self.presence_penalty,
+            frequency_penalty=self.frequency_penalty,
+            stop=stop,
             seed=self.sampling_seed,
             extra=dict(self.extra_body),
         )
@@ -149,6 +171,26 @@ async def run_load_async(cfg: LoadConfig, run_dir: RunDir) -> list[RequestRecord
     dur, rps = duration_and_rps(cfg.num_requests, cfg.concurrency, cfg.target_rps, cfg.duration_s)
     arrivals = generate_arrival_times(cfg.pattern, cfg.num_requests, dur, seed=cfg.seed)
     adapter = get_adapter(cfg.backend)
+    if cfg.backend != "openai":
+        # the jetstream / kserve_v2 wire formats carry only the basic
+        # knobs; a run that configures OpenAI-only ones would measure a
+        # different workload than asked for — say so LOUDLY up front (the
+        # repo's own server comments call this the silent-drop hazard)
+        dropped = [
+            k for k, v in (
+                ("n", cfg.n != 1),
+                ("presence_penalty", cfg.presence_penalty != 0.0),
+                ("frequency_penalty", cfg.frequency_penalty != 0.0),
+                ("stop", bool(cfg.stop)),
+            ) if v
+        ]
+        if dropped:
+            print(
+                f"loadgen WARNING: backend {cfg.backend!r} cannot express "
+                f"{dropped}; these knobs will NOT reach the server and the "
+                "run measures a different workload than configured",
+                file=sys.stderr,
+            )
     prompt_fn = make_prompt_fn(
         cfg.prompt_set, cfg.base_prompt, seed=cfg.seed, input_tokens=cfg.input_tokens
     )
@@ -212,6 +254,12 @@ def register(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--duration", type=float, default=None, help="Target duration (s)")
     parser.add_argument("--max-tokens", type=int, default=64)
     parser.add_argument("--temperature", type=float, default=0.0)
+    parser.add_argument("--n", type=int, default=1,
+                        help="Choices per request (OpenAI n)")
+    parser.add_argument("--presence-penalty", type=float, default=0.0)
+    parser.add_argument("--frequency-penalty", type=float, default=0.0)
+    parser.add_argument("--stop", action="append", default=None,
+                        help="Stop sequence (repeatable, up to 4)")
     parser.add_argument("--no-stream", action="store_true")
     parser.add_argument("--prompt-set", default="default",
                         choices=["default", "repeat", "unique", "mixed"])
@@ -240,6 +288,10 @@ def run(args: argparse.Namespace) -> int:
         streaming=not args.no_stream,
         max_tokens=args.max_tokens,
         temperature=args.temperature,
+        n=args.n,
+        presence_penalty=args.presence_penalty,
+        frequency_penalty=args.frequency_penalty,
+        stop=args.stop,
         prompt_set=args.prompt_set,
         input_tokens=args.input_tokens,
         seed=args.seed,

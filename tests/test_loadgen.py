@@ -177,3 +177,42 @@ def test_loadgen_concurrency_cap(tmp_path):
         inflight = sum(1 for s2, e2 in intervals if s2 <= s < e2)
         max_inflight = max(max_inflight, inflight)
     assert max_inflight <= 2
+
+
+def test_gen_params_carry_full_openai_surface():
+    """LoadConfig's first-class knobs (n, penalties, stop) reach GenParams
+    — previously only extra_body passthrough could exercise them, so
+    profiles could not drive the knobs the server honors."""
+    from kserve_vllm_mini_tpu.loadgen.runner import LoadConfig
+
+    cfg = LoadConfig(
+        url="http://x", n=3, presence_penalty=0.5, frequency_penalty=1.0,
+        stop=["\n", "END"],
+    )
+    p = cfg.gen_params()
+    assert p.n == 3
+    assert p.presence_penalty == 0.5
+    assert p.frequency_penalty == 1.0
+    assert p.stop == ["\n", "END"]
+
+
+def test_openai_payload_includes_stop_and_penalties():
+    from kserve_vllm_mini_tpu.loadgen.adapters.base import GenParams
+    from kserve_vllm_mini_tpu.loadgen.adapters.openai_chat import _payload
+
+    body = _payload("m", "hi", GenParams(
+        n=2, presence_penalty=0.25, frequency_penalty=0.75, stop=["END"],
+    ), stream=False)
+    assert body["n"] == 2
+    assert body["presence_penalty"] == 0.25
+    assert body["frequency_penalty"] == 0.75
+    assert body["stop"] == ["END"]
+
+
+def test_string_stop_becomes_one_sequence():
+    """YAML `stop: "END"` (a bare string) must be ONE stop sequence, not
+    exploded into per-character stops."""
+    from kserve_vllm_mini_tpu.loadgen.runner import LoadConfig
+
+    cfg = LoadConfig(url="http://x", stop="END")
+    assert cfg.gen_params().stop == ["END"]
