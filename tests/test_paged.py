@@ -243,3 +243,38 @@ def test_fail_all_reaches_deferred_request(params):
                 break
     assert seen_err == 2
     assert eng._deferred is None
+
+
+def test_cancel_reaches_deferred_and_live_paged_requests(params):
+    """Cancellation composed with paged backpressure: a cancelled
+    backpressure-held (deferred) request finishes without ever taking
+    blocks, and cancelling a live request releases its whole reservation
+    back to the pool."""
+    eng = Engine(
+        params, CFG,
+        EngineConfig(max_slots=2, max_seq_len=64, kv_layout="paged",
+                     kv_block_size=16, kv_pool_blocks=2),
+    )
+    ha = eng.submit(GenRequest(prompt_tokens=list(range(20)), max_new_tokens=8,
+                               temperature=0.0))
+    hb = eng.submit(GenRequest(prompt_tokens=list(range(10)), max_new_tokens=8,
+                               temperature=0.0))
+    eng._schedule_once()           # A admits (whole pool), B defers
+    assert eng._deferred is not None
+    eng.cancel(hb)                 # cancel the deferred request
+    eng._schedule_once()
+    ev = hb.events.get(timeout=5)
+    while ev[0] == "token":
+        ev = hb.events.get(timeout=5)
+    assert ev[1]["finish_reason"] == "stop"
+    assert ev[1]["tokens_out"] == 0
+    assert eng._deferred is None
+
+    eng.cancel(ha)                 # cancel the live request mid-generation
+    eng._schedule_once()
+    while True:
+        ev = ha.events.get(timeout=5)
+        if ev[0] == "done":
+            break
+    st = eng.snapshot_stats()
+    assert st["kv_free_blocks"] == st["kv_pool_blocks"] == 2  # all released
