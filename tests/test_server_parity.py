@@ -1,9 +1,10 @@
 """The repo's own parity probe against the repo's own server.
 
 Round-2 verdict: the server scored ~1/5 on the five OpenAI capabilities its
-own probe measures (tools, parallel tools, JSON mode, logprobs, streaming).
-With grammar-constrained decoding and device-side logprobs this must now be
-5/5 — probed over a real HTTP socket, not mocked internals.
+own probe then measured (tools, parallel tools, JSON mode, logprobs,
+streaming). The matrix has since grown to SEVEN (round 5 added sampling
+penalties and n-choices) and the server must score 7/7 — probed over a
+real HTTP socket, not mocked internals.
 """
 
 import asyncio
