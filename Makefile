@@ -2,7 +2,7 @@
 # of native code — the TPU compute path is JAX/XLA compiled at runtime.
 PY ?= python
 
-.PHONY: help test test-fast test-policy lint fmt smoke bench dashboards-validate helm-lint airgap clean
+.PHONY: help test test-fast test-policy lint fmt smoke bench bench-smoke dashboards-validate helm-lint airgap clean
 
 help:
 	@grep -E '^[a-z-]+:' Makefile | sed 's/:.*//' | sort | uniq
@@ -38,6 +38,11 @@ smoke:  ## full pipeline on the CPU-faked mesh, no hardware
 
 bench:  ## driver benchmark (one JSON line) on the attached accelerator
 	$(PY) bench.py
+
+# asserts the decode-pipeline counters (docs/DECODE_PIPELINE.md) land in
+# results.json via the real stage chain — the same tier-1 gate CI runs
+bench-smoke:  ## bench pipeline vs the mock server, tiny budget, no TPU
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_bench_smoke.py -q
 
 test-policy:  ## policies vs a LIVE Gatekeeper (needs kubectl+cluster; skips without)
 	bash tests/policy_admission_test.sh

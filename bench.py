@@ -81,15 +81,15 @@ def _log(msg: str) -> None:
 
 
 def _env_model() -> str:
-    return os.environ.get("KVMINI_BENCH_MODEL", _DEFAULT_MODEL)
+    return _knob("KVMINI_BENCH_MODEL")
 
 
 def _env_quant() -> str:
-    return os.environ.get("KVMINI_BENCH_QUANT", _DEFAULT_QUANT)
+    return _knob("KVMINI_BENCH_QUANT")
 
 
 def _env_slots() -> int:
-    return int(os.environ.get("KVMINI_BENCH_SLOTS", _DEFAULT_SLOTS))
+    return int(_knob("KVMINI_BENCH_SLOTS"))
 
 
 # ---------------------------------------------------------------------------
@@ -208,19 +208,19 @@ def _run_serving_child(mode: str) -> dict:
 
     model = _env_model()
     quant = "int4" if mode == "int4" else _env_quant()
-    paged = mode == "paged" or os.environ.get("KVMINI_BENCH_PAGED", "") == "1"
-    kv_quant = os.environ.get("KVMINI_BENCH_KV", "") == "int8"
+    paged = mode == "paged" or _knob("KVMINI_BENCH_PAGED") == "1"
+    kv_quant = _knob("KVMINI_BENCH_KV") == "int8"
     # more slots amortize the 9 GB int8 weight stream over more tokens per
     # step (measured 1710 @ 32 -> 2744 @ 64 -> 3067 @ 80 tok/s/chip on the
     # v5e) until the KV stream and HBM capacity push back
     slots = _env_slots()
     prompt_len = 128
     max_seq = 512
-    decode_steps = int(os.environ.get("KVMINI_BENCH_STEPS", "128"))
+    decode_steps = int(_knob("KVMINI_BENCH_STEPS"))
     warmup = 8
 
     on_tpu = jax.default_backend() == "tpu"
-    unroll = int(os.environ.get("KVMINI_BENCH_UNROLL", "1"))
+    unroll = int(_knob("KVMINI_BENCH_UNROLL"))
     cfg = get_config(model, max_seq_len=max_seq, scan_unroll=unroll)
     _log(f"mode={mode} model={model} quant={quant} slots={slots} paged={paged} "
          f"unroll={unroll} backend={jax.default_backend()}")
@@ -511,17 +511,15 @@ def _run_hbm_child() -> dict:
 
     model = _env_model()
     quant = _env_quant()
-    kv_quant = os.environ.get("KVMINI_BENCH_KV", "") == "int8"
+    kv_quant = _knob("KVMINI_BENCH_KV") == "int8"
     prompt_len = 128
     max_seq = 512
-    steps = int(os.environ.get("KVMINI_BENCH_STEPS", "64"))
+    steps = int(_knob("KVMINI_BENCH_STEPS", "64"))
     slot_grid = [
-        int(s) for s in os.environ.get(
-            "KVMINI_BENCH_HBM_SLOTS", "16,32,48,64,80"
-        ).split(",")
+        int(s) for s in _knob("KVMINI_BENCH_HBM_SLOTS").split(",")
     ]
     on_tpu = jax.default_backend() == "tpu"
-    unroll = int(os.environ.get("KVMINI_BENCH_UNROLL", "1"))
+    unroll = int(_knob("KVMINI_BENCH_UNROLL"))
     cfg = get_config(model, max_seq_len=max_seq, scan_unroll=unroll)
     if quant in ("int8", "int4"):
         params = init_params_quantized(
@@ -676,15 +674,15 @@ def _run_spec_child() -> dict:
 
     model = _env_model()
     quant = _env_quant()
-    kv_quant = os.environ.get("KVMINI_BENCH_KV", "") == "int8"
-    spec_k = int(os.environ.get("KVMINI_BENCH_SPEC", "4"))
-    drafter = os.environ.get("KVMINI_BENCH_DRAFTER", "llama-1b")
+    kv_quant = _knob("KVMINI_BENCH_KV") == "int8"
+    spec_k = int(_knob("KVMINI_BENCH_SPEC"))
+    drafter = _knob("KVMINI_BENCH_DRAFTER")
     # spec needs TWO caches (target + drafter) resident at once; 32 slots
     # keeps both under the v5e ceiling next to the int8 8B weights
-    s_slots = int(os.environ.get("KVMINI_BENCH_SPEC_SLOTS", "32"))
+    s_slots = int(_knob("KVMINI_BENCH_SPEC_SLOTS"))
     prompt_len = 128
     max_seq = 512
-    unroll = int(os.environ.get("KVMINI_BENCH_UNROLL", "1"))
+    unroll = int(_knob("KVMINI_BENCH_UNROLL"))
     cfg = get_config(model, max_seq_len=max_seq, scan_unroll=unroll)
     n_chips = jax.device_count()
     _log(f"spec: model={model} drafter={drafter} k={spec_k} slots={s_slots} "
@@ -1076,15 +1074,14 @@ def _orchestrate() -> int:
 
 
 def _orchestrate_body(art: "_Artifact") -> int:
-    probe_timeout = float(os.environ.get("KVMINI_BENCH_PROBE_TIMEOUT", "90"))
-    probe_budget = float(os.environ.get("KVMINI_BENCH_PROBE_BUDGET_S", "1800"))
-    run_timeout = float(os.environ.get("KVMINI_BENCH_TIMEOUT", "900"))
+    probe_timeout = float(_knob("KVMINI_BENCH_PROBE_TIMEOUT"))
+    probe_budget = float(_knob("KVMINI_BENCH_PROBE_BUDGET_S"))
+    run_timeout = float(_knob("KVMINI_BENCH_TIMEOUT"))
     # stop launching new children past the deadline so the parent always
     # has time to print (the driver's own patience is unknown)
-    deadline = _T_START + float(os.environ.get("KVMINI_BENCH_DEADLINE_S", "7200"))
-    modes = os.environ.get("KVMINI_BENCH_MODES",
-                           "headline,paged,spec,int4,hbm")
-    modes = [m.strip() for m in modes.split(",") if m.strip()]
+    deadline = _T_START + float(_knob("KVMINI_BENCH_DEADLINE_S"))
+    modes = [m.strip() for m in _knob("KVMINI_BENCH_MODES").split(",")
+             if m.strip()]
 
     ok, probe_status, probe_detail = _probe_until(probe_budget, probe_timeout)
     if not ok:
@@ -1178,7 +1175,141 @@ def _orchestrate_body(art: "_Artifact") -> int:
     return 0
 
 
-def main() -> int:
+# env knob -> (CLI flag, default, help) — ONE table so --help, the flag
+# parser, and the docs can never drift. Flags just set the env var (children
+# inherit the environment, so both spellings reach every subprocess).
+_ENV_KNOBS = {
+    "KVMINI_BENCH_PROBE_BUDGET_S": (
+        "--probe-budget-s", "1800",
+        "total seconds to keep re-probing a wedged/unavailable TPU relay "
+        "before giving up (observed wedge windows run ~40 min; raise past "
+        "the wedge window when rounds die with status tpu_unavailable)",
+    ),
+    "KVMINI_BENCH_PROBE_TIMEOUT": (
+        "--probe-timeout-s", "90",
+        "hard timeout for ONE no-op probe dispatch (a wedged relay blocks "
+        "forever; only a subprocess timeout detects it)",
+    ),
+    "KVMINI_BENCH_TIMEOUT": (
+        "--run-timeout-s", "900",
+        "hard timeout for one sub-benchmark child process",
+    ),
+    "KVMINI_BENCH_DEADLINE_S": (
+        "--deadline-s", "7200",
+        "stop launching new children this many seconds after start, so the "
+        "parent always has time to print its one JSON line",
+    ),
+    "KVMINI_BENCH_MODES": (
+        "--modes", "headline,paged,spec,int4,hbm",
+        "comma-separated sub-benchmarks to run, in order",
+    ),
+    "KVMINI_BENCH_MODEL": (
+        "--model", _DEFAULT_MODEL,
+        "model config to serve (llama-tiny smoke-tests on CPU)",
+    ),
+    "KVMINI_BENCH_QUANT": (
+        "--quant", _DEFAULT_QUANT,
+        "weight quantization for the headline config",
+    ),
+    "KVMINI_BENCH_SLOTS": (
+        "--slots", _DEFAULT_SLOTS,
+        "decode batch slots (OOM at the default retries once at "
+        f"{_FALLBACK_SLOTS})",
+    ),
+    "KVMINI_BENCH_STEPS": (
+        "--steps", "128",
+        "decode steps per timed measurement (the hbm sub-bench defaults "
+        "to 64 when unset)",
+    ),
+    "KVMINI_BENCH_KV": (
+        "--kv", "",
+        "KV-cache quantization: 'int8' for scaled int8 KV, empty for the "
+        "model dtype",
+    ),
+    "KVMINI_BENCH_PAGED": (
+        "--paged", "",
+        "'1' routes the serving sub-benches through the paged KV pool "
+        "even outside the paged mode",
+    ),
+    "KVMINI_BENCH_UNROLL": (
+        "--unroll", "1",
+        "layer-scan unroll factor for the model config",
+    ),
+    "KVMINI_BENCH_SPEC": (
+        "--spec-tokens", "4",
+        "draft tokens per fused speculative round (spec sub-bench)",
+    ),
+    "KVMINI_BENCH_DRAFTER": (
+        "--drafter", "llama-1b",
+        "drafter model for the spec sub-bench ('self' = self-drafting "
+        "upper bound)",
+    ),
+    "KVMINI_BENCH_SPEC_SLOTS": (
+        "--spec-slots", "32",
+        "decode batch slots for the spec sub-bench (two models resident)",
+    ),
+    "KVMINI_BENCH_HBM_SLOTS": (
+        "--hbm-slots", "16,32,48,64,80",
+        "slot grid the hbm sub-bench fits t_fixed + S*t_per_slot over",
+    ),
+}
+# parent<->child plumbing, not operator knobs (set by the orchestrator):
+# KVMINI_BENCH_CHILD selects a sub-benchmark body, KVMINI_BENCH_PROGRESS
+# points at the incremental progress file
+
+
+def _knob(env: str, default: str | None = None) -> str:
+    """Read an env knob with its _ENV_KNOBS default — the read sites MUST
+    come through here or --help and behavior drift apart. ``default``
+    overrides the table for the few mode-dependent cases (documented in
+    the knob's help text), so even those stay greppable via this one
+    function."""
+    return os.environ.get(
+        env, default if default is not None else _ENV_KNOBS[env][1]
+    )
+
+
+def _parse_args(argv: list) -> None:
+    """CLI front over the env knobs. Every flag simply sets its env var,
+    so the child processes and the documented env spellings stay the one
+    source of truth; an env var set by the caller wins unless the flag is
+    passed explicitly."""
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="bench.py",
+        description=(
+            "Driver benchmark: one JSON line of serving numbers on the "
+            "attached accelerator. Always exits 0 with a parseable "
+            "artifact, even on TPU wedge/timeout."
+        ),
+        epilog=(
+            "Every flag mirrors an environment variable (flag wins when "
+            "both are set): "
+            + "; ".join(
+                f"{flag} = {env} (default {default!r})"
+                for env, (flag, default, _h) in _ENV_KNOBS.items()
+            )
+        ),
+    )
+    for env, (flag, default, help_text) in _ENV_KNOBS.items():
+        parser.add_argument(
+            flag, default=None, metavar="V",
+            help=f"{help_text} [env {env}, default {default}]",
+        )
+    args = parser.parse_args(argv)
+    for env, (flag, _default, _h) in _ENV_KNOBS.items():
+        val = getattr(args, flag.lstrip("-").replace("-", "_"))
+        if val is not None:
+            os.environ[env] = str(val)
+
+
+def main(argv: list | None = None) -> int:
+    # argv is only parsed when given (the __main__ path): the orchestration
+    # guard tests call main() in-process under pytest, whose own argv must
+    # not leak into the bench parser
+    if argv is not None:
+        _parse_args(argv)
     mode = os.environ.get("KVMINI_BENCH_CHILD")
     if mode:
         # Child: do the real work; the parent structures any failure.
@@ -1205,4 +1336,4 @@ def main() -> int:
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(main(sys.argv[1:]))

@@ -89,13 +89,27 @@ def analyze_run(
         update.update(compute_cold_warm_metrics(records, flags))
 
     t0, t1 = window_bounds(records)
+    # ONE /metrics scrape shared by the three telemetry consumers below —
+    # a slow endpoint must cost one 5 s timeout, not three
+    runtime_metrics = (
+        telemetry.scrape_runtime_metrics(endpoint) if endpoint else {}
+    )
     update.update(
         telemetry.collect_utilization(
             prom_url, endpoint, window_s=max(t1 - t0, 1.0),
             accelerator=meta.get("accelerator"),
+            runtime_metrics=runtime_metrics,
         )
     )
-    update.update(telemetry.cache_hit_ratio(prom_url, endpoint))
+    update.update(
+        telemetry.cache_hit_ratio(prom_url, endpoint,
+                                  runtime_metrics=runtime_metrics)
+    )
+    # decode-pipeline counters (docs/DECODE_PIPELINE.md): only the in-repo
+    # runtime exports these; absent for external engines
+    update.update(
+        telemetry.pipeline_counters(endpoint, runtime_metrics=runtime_metrics)
+    )
 
     io_probe = run_dir.read_io_probe()
     for key in ("network_rtt_p50_ms", "network_rtt_p95_ms", "storage_fetch_mbps"):

@@ -107,15 +107,9 @@ def query_with_fallbacks(
     return None, None
 
 
-def scrape_runtime_metrics(endpoint: str, timeout_s: float = 5.0) -> dict[str, float]:
-    """Parse the runtime's Prometheus text exposition into a flat dict."""
-    url = endpoint.rstrip("/") + "/metrics"
+def parse_prometheus_text(text: str) -> dict[str, float]:
+    """Prometheus text exposition -> flat {metric_name: value} dict."""
     out: dict[str, float] = {}
-    try:
-        with urllib.request.urlopen(url, timeout=timeout_s) as resp:
-            text = resp.read().decode()
-    except Exception:
-        return out
     for line in text.splitlines():
         line = line.strip()
         if not line or line.startswith("#"):
@@ -136,13 +130,29 @@ def scrape_runtime_metrics(endpoint: str, timeout_s: float = 5.0) -> dict[str, f
     return out
 
 
+def scrape_runtime_metrics(endpoint: str, timeout_s: float = 5.0) -> dict[str, float]:
+    """Parse the runtime's Prometheus text exposition into a flat dict."""
+    url = endpoint.rstrip("/") + "/metrics"
+    try:
+        with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+            text = resp.read().decode()
+    except Exception:
+        return {}
+    return parse_prometheus_text(text)
+
+
 def collect_utilization(
     prom_url: Optional[str],
     endpoint: Optional[str],
     window_s: float,
     accelerator: Optional[str] = None,
+    runtime_metrics: Optional[dict[str, float]] = None,
 ) -> dict[str, Any]:
-    """The full fallback chain -> utilization block for results.json."""
+    """The full fallback chain -> utilization block for results.json.
+
+    ``runtime_metrics``: a pre-scraped /metrics dict, so a caller hitting
+    several telemetry consumers (analyzer) pays ONE scrape, not one per
+    consumer; None = scrape here."""
     out: dict[str, Any] = {}
     if prom_url:
         duty, q = query_with_fallbacks(prom_url, TPU_DUTY_CYCLE_QUERIES, window_s)
@@ -160,7 +170,8 @@ def collect_utilization(
         if cpu is not None:
             out["cpu_util_avg"] = cpu
     if "tpu_duty_cycle_avg" not in out and endpoint:
-        m = scrape_runtime_metrics(endpoint)
+        m = (runtime_metrics if runtime_metrics is not None
+             else scrape_runtime_metrics(endpoint))
         if "kvmini_tpu_duty_cycle" in m:
             out["tpu_duty_cycle_avg"] = m["kvmini_tpu_duty_cycle"]
             out["tpu_metrics_source"] = "runtime:/metrics"
@@ -170,16 +181,53 @@ def collect_utilization(
     return out
 
 
-def cache_hit_ratio(prom_url: Optional[str], endpoint: Optional[str]) -> dict[str, Any]:
+# runtime gauge/counter -> results.json key for the decode-pipeline block
+# (docs/DECODE_PIPELINE.md). Exported by runtime/server.py /metrics and,
+# for parity testing, by tests/mock_server.py.
+PIPELINE_METRIC_KEYS = {
+    "kvmini_tpu_dispatch_depth": "pipeline_dispatch_depth",
+    "kvmini_tpu_pipelined_sweeps_total": "pipeline_pipelined_sweeps",
+    "kvmini_tpu_host_overlap_seconds_total": "pipeline_host_overlap_s",
+    "kvmini_tpu_bubble_seconds_total": "pipeline_bubble_s",
+}
+
+
+def pipeline_counters(
+    endpoint: Optional[str],
+    runtime_metrics: Optional[dict[str, float]] = None,
+) -> dict[str, Any]:
+    """Decode-pipeline counters from the runtime's /metrics, keyed for
+    results.json. Empty when the endpoint doesn't expose them (external
+    engines) — absence, not zeros, so reports can tell 'no pipeline' from
+    'pipeline never engaged'. ``runtime_metrics``: pre-scraped dict (see
+    collect_utilization)."""
+    if not endpoint:
+        return {}
+    m = (runtime_metrics if runtime_metrics is not None
+         else scrape_runtime_metrics(endpoint))
+    return {
+        out_key: m[metric]
+        for metric, out_key in PIPELINE_METRIC_KEYS.items()
+        if metric in m
+    }
+
+
+def cache_hit_ratio(
+    prom_url: Optional[str],
+    endpoint: Optional[str],
+    runtime_metrics: Optional[dict[str, float]] = None,
+) -> dict[str, Any]:
     """Cache-hit chain: Prometheus counters -> runtime metrics -> absent
     (the TTFT-inference probe fills this when nothing else can,
-    probes/cache_probe.py)."""
+    probes/cache_probe.py). ``runtime_metrics``: pre-scraped dict (see
+    collect_utilization)."""
     if prom_url:
         v, _ = query_with_fallbacks(prom_url, CACHE_HIT_QUERIES)
         if v is not None:
             return {"cache_hit_ratio": v, "cache_hit_source": "metrics"}
     if endpoint:
-        m = scrape_runtime_metrics(endpoint)
+        m = (runtime_metrics if runtime_metrics is not None
+             else scrape_runtime_metrics(endpoint))
         hits, total = m.get("kvmini_tpu_cache_hits_total"), m.get("kvmini_tpu_cache_lookups_total")
         if hits is not None and total:
             return {"cache_hit_ratio": hits / total, "cache_hit_source": "metrics"}

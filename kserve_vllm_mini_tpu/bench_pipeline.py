@@ -220,6 +220,19 @@ def _run_stages(
         chips=chips or profile.get("chips"),
         accelerator=profile.get("accelerator"),
     )
+
+    # self-serve: the engine is in-process, so record its decode-pipeline
+    # counters (docs/DECODE_PIPELINE.md) authoritatively — the analyzer's
+    # /metrics scrape covers external endpoints, but a direct snapshot
+    # can't race the server teardown
+    if server is not None:
+        es = server.engine.snapshot_stats()
+        run_dir.merge_into_results({
+            "pipeline_dispatch_depth": es["dispatch_depth"],
+            "pipeline_pipelined_sweeps": es["pipelined_sweeps"],
+            "pipeline_host_overlap_s": round(es["host_overlap_s"], 6),
+            "pipeline_bubble_s": round(es["bubble_s"], 6),
+        })
     results = run_dir.read_results()
 
     code = 0

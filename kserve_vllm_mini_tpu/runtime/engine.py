@@ -272,6 +272,16 @@ class EngineConfig:
     # pool size in blocks; None sizes it to max_slots x ceil(max_seq/BLK)
     # (memory-equal to dense — set it LOWER to realize the savings)
     kv_pool_blocks: Optional[int] = None
+    # Double-buffered decode (docs/DECODE_PIPELINE.md): in steady state the
+    # scheduler dispatches sweep N+1 from the ON-DEVICE sampled-token carry
+    # before retiring sweep N, so host-side token emission/admission work
+    # overlaps device compute instead of serializing with it. Emitted
+    # streams are identical to the synchronous loop's (the dispatch-ahead
+    # guard keeps chunk sizes and the rng split sequence aligned); grammar-
+    # constrained slots, speculative partitions, and iterations where the
+    # active set changes fall back to the synchronous sweep. False forces
+    # the seed's fully synchronous dispatch->readback->emit loop.
+    decode_pipeline: bool = True
     # multi-LoRA bank capacity for adapters loaded AT RUNTIME into an
     # engine that started without a bank (load_adapter creates a zero bank
     # of this many adapter slots; the bank's array shapes are fixed once
@@ -623,6 +633,31 @@ class Engine:
         # never on the per-token hot path
         self._sampling_arrays: Optional[tuple] = None
 
+        # double-buffered decode state (docs/DECODE_PIPELINE.md):
+        # _tokens_dev mirrors _last_tokens on device — in steady state it is
+        # the previous sweep's sampled-token carry, so the per-sweep
+        # host->device token transfer disappears; None = rebuild from host.
+        # _tokens_dev_slots is the set of slots whose carry rows are REAL
+        # (they emitted through the sweep that produced the carry): a slot
+        # outside it — e.g. a spec slot whose round was skipped — has a
+        # garbage row and must be fed from _last_tokens instead.
+        self._tokens_dev: Optional[jnp.ndarray] = None
+        self._tokens_dev_slots: frozenset = frozenset()
+        # dispatched-but-not-retired sweeps, oldest first; each record holds
+        # the stacked per-step device outputs plus the host snapshot needed
+        # to emit them (active slots, handle identities, chunk, rng rewind)
+        self._inflight: list[dict] = []
+        # decode positions the in-flight sweeps have already written past
+        # the host-visible _slot_len (chunk per unretired sweep)
+        self._pending_steps = 0
+        self._t_last_ready = 0.0   # when the device last finished a sweep
+        self._bubble_anchor = 0.0  # device went idle here with work queued
+        # multihost lockstep mode (set by runtime/multihost.py drivers):
+        # disables the retire-time cancelled-handle emission skip, whose
+        # trigger is a host-local race the follower cannot observe —
+        # lockstep cancellation latency is one published decision instead
+        self._lockstep = False
+
         # stats for /metrics and duty-cycle telemetry
         self.stats = {
             "prefill_tokens": 0,
@@ -638,6 +673,15 @@ class Engine:
             "spec_proposed": 0,     # draft tokens proposed (rounds x k-1)
             "prefix_hits": 0,       # admissions that reused a retained prefix
             "prefix_tokens_reused": 0,  # prompt tokens NOT re-prefilled
+            # decode-pipeline telemetry (docs/DECODE_PIPELINE.md):
+            "dispatch_depth": 0,    # high-water concurrently in-flight sweeps
+            "pipelined_sweeps": 0,  # sweeps dispatched ahead of a retire
+            "host_overlap_s": 0.0,  # host emit/bookkeeping under device compute
+            "bubble_s": 0.0,        # device idle between sweeps with work live
+            "pipeline_fallback_constrained": 0,  # grammar mask forced sync
+            "pipeline_fallback_spec": 0,         # spec partition forced sync
+            "pipeline_fallback_active_set": 0,   # admission/cancel forced retire
+            "pipeline_fallback_headroom": 0,     # cache window forced sync
         }
 
     # -- paged-KV block accounting ----------------------------------------
@@ -1179,11 +1223,14 @@ class Engine:
                 return (nc, nxt, lens + 1, r, count_tokens(cnt, nxt)), \
                     (nxt, lp, tids, tlps)
 
-            (c, _, _, _, cnt), ys = jax.lax.scan(
+            (c, toks, _, _, cnt), ys = jax.lax.scan(
                 body, (cache, tokens, lengths, rng, counts), None,
                 length=n_steps,
             )
-            return c, cnt, ys  # ys: ([n,S], [n,S], [n,S,K], [n,S,K])
+            # toks is the final carry: the last sampled token per slot,
+            # returned ON DEVICE so the next dispatch can feed it without a
+            # host round-trip (the double-buffered pipeline's token path)
+            return c, cnt, toks, ys  # ys: ([n,S], [n,S], [n,S,K], [n,S,K])
 
         self._decode_fns[key] = decode
         return decode
@@ -1225,7 +1272,10 @@ class Engine:
             lg = jnp.where(use_mask[:, None], lg_masked, lg)
             nxt = sample_tokens(lg, rng, temps, topks, topps)
             lp, tids, tlps = token_logprobs(lg, nxt)
-            return nc, count_tokens(counts, nxt), \
+            # nxt doubles as the on-device token carry (same contract as
+            # the plain decode fn), so a constrained sweep keeps the device
+            # token buffer warm for the sweeps that follow it
+            return nc, count_tokens(counts, nxt), nxt, \
                 (nxt[None], lp[None], tids[None], tlps[None])
 
         self._decode_fns[key] = decode_masked
@@ -1301,8 +1351,18 @@ class Engine:
             }))
             return handle
         self._pending.put(handle)
-        self.stats["queue_depth"] = self._pending.qsize()
+        self.stats["queue_depth"] = self._queue_depth()
         return handle
+
+    def _queue_depth(self) -> int:
+        """Requests waiting for admission: the pending queue PLUS the
+        paged-backpressure head-of-line handle (_deferred), which sits in
+        neither _pending nor a slot — without it, reported depth is one
+        low whenever paged backpressure is active."""
+        n = self._pending.qsize()
+        if self.paged and self._deferred is not None:
+            n += 1
+        return n
 
     def start(self) -> None:
         if self._running:
@@ -1593,6 +1653,7 @@ class Engine:
         self._slot_len[slot] = n
         self._slot_remaining[slot] = req.max_new_tokens - 1
         self._last_tokens[slot] = first_id
+        self._tokens_dev = None  # host mutation: device token carry is stale
         self._slot_machine[slot] = machine
         self._slot_adapter[slot] = adapter_idx
         self._adapter_ids_dev = None
@@ -1729,26 +1790,31 @@ class Engine:
             return [], active
         if any(self._slot_len[i] + k >= self.ecfg.max_seq_len for i in active):
             return [], active
-        spec = [
-            i for i in active
-            # sampled requests speculate too (rejection sampling keeps
-            # their output distribution exact; greedy rows degenerate to
-            # the exact-match rule). Penalties need the per-step count
-            # table the fused round doesn't carry; constrained slots need
-            # a fresh mask per token; logprob slots need per-token
-            # distributions the verify doesn't produce.
-            if self._slot_req[i].request.presence_penalty == 0.0
-            and self._slot_req[i].request.frequency_penalty == 0.0
-            and self._slot_machine[i] is None
-            and not self._slot_req[i].request.logprobs
-            # adapted slots can't speculate: the drafter proposes from base
-            # weights (defensive — lora+drafter is rejected at init)
-            and self._slot_adapter[i] == 0
-        ]
+        spec = [i for i in active if self._spec_capable(i)]
         if not spec:
             return [], active
         rest = [i for i in active if i not in spec]
         return spec, rest
+
+    def _spec_capable(self, i: int) -> bool:
+        """STATIC per-request spec eligibility (fixed for a slot's whole
+        occupancy — unlike _spec_partition's transient cache-headroom
+        gate). Sampled requests speculate too (rejection sampling keeps
+        their output distribution exact; greedy rows degenerate to the
+        exact-match rule). Penalties need the per-step count table the
+        fused round doesn't carry; constrained slots need a fresh mask
+        per token; logprob slots need per-token distributions the verify
+        doesn't produce; adapted slots can't speculate — the drafter
+        proposes from base weights (defensive: lora+drafter is rejected
+        at init)."""
+        req = self._slot_req[i].request
+        return (
+            req.presence_penalty == 0.0
+            and req.frequency_penalty == 0.0
+            and self._slot_machine[i] is None
+            and not req.logprobs
+            and self._slot_adapter[i] == 0
+        )
 
     def _spec_sweep(self, active: list[int]) -> None:
         """One fused speculative round: drafter proposes k-1 tokens, target
@@ -1787,8 +1853,16 @@ class Engine:
                     break
             # accepted drafts = emitted minus the bonus token
             self.stats["spec_accepted"] += max(n_emitted - 1, 0)
+        # spec emission advanced _last_tokens host-side; the device carry
+        # (if any) predates it, so the next plain dispatch must rebuild
+        self._tokens_dev = None
 
     def _decode_sweep(self) -> None:
+        """One SYNCHRONOUS dispatch->readback->emit sweep (the seed loop's
+        shape). The pipelined steady state goes through _sweep_phase
+        instead; this remains the fallback for spec partitions and
+        grammar-constrained slots, and the follower replay target for the
+        ('sweep',) decision."""
         S = self.ecfg.max_slots
         active = [i for i in range(S) if self._slot_req[i] is not None]
         if not active:
@@ -1797,92 +1871,337 @@ class Engine:
         if spec_slots:
             self._spec_sweep(spec_slots)
         if plain_slots:
-            self._plain_sweep(plain_slots)
+            constrained = [
+                i for i in plain_slots if self._slot_machine[i] is not None
+            ]
+            if constrained:
+                self._masked_sweep(plain_slots, constrained)
+            else:
+                self._dispatch_plain(plain_slots)
+                self._retire_one()
 
-    def _plain_sweep(self, active: list[int]) -> None:
-        """Normal decode sweep over ``active`` slots. The dispatch still
-        covers all S slots (static shapes); slots outside ``active`` —
-        including spec slots already advanced this sweep — get harmless
-        overwritten-before-attend KV writes and their sampled tokens are
-        discarded on the host."""
-        S = self.ecfg.max_slots
-        constrained = [i for i in active if self._slot_machine[i] is not None]
-        # chunk size: fused steps must stay inside every active slot's cache
-        # window (requests finishing mid-chunk are handled by surplus
-        # discard, NOT by shrinking the chunk — shrinking would compile a
-        # fresh scan variant for every distinct remaining-budget value and
-        # let one nearly-done request collapse fusion for the whole batch).
-        # Rounded down to a power of two so at most log2(decode_chunk)+1
-        # decode executables ever exist. Grammar-constrained slots force
-        # chunk=1: the next mask depends on the byte just emitted.
-        window = min(self.ecfg.max_seq_len - 1 - self._slot_len[i] for i in active)
+    # -- double-buffered decode pipeline (docs/DECODE_PIPELINE.md) ---------
+
+    def _feed_tokens(self, active: list[int]) -> jnp.ndarray:
+        """Last-sampled tokens for the next decode dispatch over ``active``.
+        In steady state this is the previous sweep's ON-DEVICE carry, so no
+        host->device transfer happens per sweep. The carry is only usable
+        when every slot being fed emitted through the sweep that produced
+        it (_tokens_dev_slots): a slot outside that set — a spec slot whose
+        fused round was skipped this iteration, say — holds a discarded
+        garbage row, and feeding it would corrupt that slot's context. Any
+        other case (host mutation invalidated the carry, new slot in the
+        mix) rebuilds from _last_tokens, which the emit path keeps
+        authoritative for all S slots."""
+        if (
+            self._tokens_dev is not None
+            and self._tokens_dev_slots.issuperset(active)
+        ):
+            return self._tokens_dev
+        self._tokens_dev = jnp.asarray(self._last_tokens, dtype=jnp.int32)
+        self._tokens_dev_slots = frozenset(range(self.ecfg.max_slots))
+        return self._tokens_dev
+
+    def _chunk_for(self, active: list[int]) -> int:
+        """Fused-step count for the next plain dispatch: decode_chunk
+        clamped into every active slot's REMAINING cache window (minus
+        positions in-flight sweeps have already claimed), rounded down to
+        a power of two so at most log2(decode_chunk)+1 scan variants ever
+        compile. Requests finishing mid-chunk surplus-discard on the host
+        — shrinking instead would recompile per remaining-budget value."""
+        window = min(
+            self.ecfg.max_seq_len - 1 - self._slot_len[i] for i in active
+        ) - self._pending_steps
         chunk = max(1, min(self.ecfg.decode_chunk, window))
-        chunk = 1 << (chunk.bit_length() - 1)
-        if constrained:
-            chunk = 1
-        tokens = jnp.asarray(self._last_tokens, dtype=jnp.int32)
-        # The fed token occupies absolute position slot_len (prompt + generated
-        # tokens already written); forward writes its KV there and attends <=.
-        lengths = jnp.asarray(self._slot_len, dtype=jnp.int32)
+        return 1 << (chunk.bit_length() - 1)
+
+    def _pipeline_eligible(self, active: list[int]) -> tuple[bool, Optional[str]]:
+        """Whether the next sweep may be dispatched ahead (before the
+        previous one retires). The fallback-to-synchronous conditions,
+        each pinned by a test (tests/test_decode_pipeline.py):
+
+        - ``constrained``: a grammar-masked slot's next mask depends on the
+          byte just emitted — the host must see sweep N before building
+          sweep N+1's operands.
+        - ``spec``: speculative rounds interleave drafter/target dispatches
+          and emit a data-dependent number of tokens per round; the plain
+          dispatch-ahead carry doesn't model them.
+        - ``headroom``: the dispatched-ahead sweep must stay inside every
+          active slot's cache window AND use the same chunk size the
+          synchronous loop would pick — otherwise sampled streams diverge
+          (different scan length => different per-step rng folds) and
+          clamped writes could back onto real KV. Requiring a full
+          decode_chunk of window past the in-flight positions guarantees
+          both.
+
+        The fourth condition — ``active_set`` (admission/cancellation
+        landing mid-flight) — is enforced by _schedule_once retiring all
+        in-flight sweeps before mutating the slot population."""
+        if not self.ecfg.decode_pipeline or not active:
+            return False, None
+        if any(self._slot_machine[i] is not None for i in active):
+            return False, "constrained"
+        # STATIC spec capability, deliberately NOT _spec_partition: the
+        # partition's transient cache-headroom gate can flip spec back ON
+        # when a near-window-end slot finishes, and a plain sweep already
+        # dispatched ahead would then replace the spec round the
+        # synchronous loop runs at that index (rejection sampling consumes
+        # rng differently — sampled streams would diverge). A statically
+        # capable slot therefore pins the engine synchronous for its
+        # whole residency.
+        if (
+            self.ecfg.spec_tokens > 0
+            and self._drafter_params is not None
+            and any(self._spec_capable(i) for i in active)
+        ):
+            return False, "spec"
+        full = 1 << (max(1, self.ecfg.decode_chunk).bit_length() - 1)
+        window = min(
+            self.ecfg.max_seq_len - 1 - self._slot_len[i] for i in active
+        ) - self._pending_steps
+        if window < full:
+            return False, "headroom"
+        return True, None
+
+    def _dispatch_plain(self, active: list[int]) -> None:
+        """Dispatch one plain decode sweep WITHOUT waiting for results.
+        The dispatch covers all S slots (static shapes); slots outside
+        ``active`` get harmless overwritten-before-attend KV writes and
+        their sampled tokens are discarded at retire. The sampled-token
+        carry stays on device as the next dispatch's feed; the stacked
+        per-step outputs ride in _inflight until _retire_one() reads them
+        back and emits."""
+        chunk = self._chunk_for(active)
+        tokens = self._feed_tokens(active)
+        # The fed token occupies absolute position slot_len + already-in-
+        # flight steps; forward writes its KV there and attends <=. The cap
+        # only ever binds on inactive rows (eligibility guarantees active
+        # windows), whose writes are masked-garbage either way.
+        lengths = np.minimum(
+            np.asarray(self._slot_len, dtype=np.int32) + self._pending_steps,
+            self.ecfg.max_seq_len - 1,
+        )
         temps, topks, topps, pres, freqs = self._get_sampling_arrays()
+        rng_prev = self._rng
         self._rng, sub = jax.random.split(self._rng)
-        t0 = time.time()
-        if constrained:
-            mask = np.zeros((S, (self.cfg.vocab_size + 7) // 8), dtype=np.uint8)
-            for i in constrained:
-                budget = min(
-                    self._slot_remaining[i],
-                    self.ecfg.max_seq_len - 1 - self._slot_len[i],
-                )
-                mask[i] = self._constraint_mask(self._slot_machine[i], budget)
-            use_mask = np.zeros((S,), dtype=bool)
-            use_mask[constrained] = True
         lkw = {}
         if self.paged:
             lkw["table"] = self._table()
         if self._lora is not None:
             lkw["lora"] = self._lora["layers"]
             lkw["ids"] = self._adapter_ids()
-        if constrained:
-            decode = self._get_masked_decode_fn()
-            self._cache, self._counts, ys = decode(
+        t0 = time.time()
+        if self._bubble_anchor:
+            self.stats["bubble_s"] += max(t0 - self._bubble_anchor, 0.0)
+            self._bubble_anchor = 0.0
+        decode = self._get_decode_fn(chunk)
+        with jax.profiler.TraceAnnotation("kvmini.decode_dispatch"):
+            self._cache, self._counts, next_toks, ys = decode(
                 self.params, self._cache,
-                tokens, lengths, temps, topks, topps, sub,
-                self._counts, pres, freqs,
-                jnp.asarray(mask), jnp.asarray(use_mask), **lkw,
-            )
-        else:
-            decode = self._get_decode_fn(chunk)
-            self._cache, self._counts, ys = decode(
-                self.params, self._cache,
-                tokens, lengths, temps, topks, topps, sub,
+                tokens, jnp.asarray(lengths, dtype=jnp.int32),
+                temps, topks, topps, sub,
                 self._counts, pres, freqs, **lkw,
             )
-        # ONE host transfer for the whole chunk block — per-element
-        # int(row[i]) costs a separate device readback each (chunk x slots
-        # round-trips per sweep; this line was the serving bottleneck, not
-        # the decode math)
-        toks_h, lps_h, tids_h, tlps_h = (np.asarray(a) for a in jax.device_get(ys))
+        self._tokens_dev = next_toks
+        self._tokens_dev_slots = frozenset(active)
+        self._inflight.append({
+            "ys": ys,
+            "active": list(active),
+            # handle identity per slot: retire must never emit into a
+            # handle that replaced the one this sweep was dispatched for
+            "handles": {i: self._slot_req[i] for i in active},
+            "chunk": chunk,
+            "t_dispatch": t0,
+            # rng state BEFORE this dispatch's split: if every slot
+            # finishes before this sweep is retired, the sweep is dropped
+            # and the split rewound, keeping the dispatch/rng sequence
+            # identical to the synchronous loop's
+            "rng_prev": rng_prev,
+        })
+        self._pending_steps += chunk
+        depth = len(self._inflight)
+        if depth > 1:
+            self.stats["pipelined_sweeps"] += 1
+        if depth > self.stats["dispatch_depth"]:
+            self.stats["dispatch_depth"] = depth
+
+    def _retire_one(self) -> None:
+        """Read back and emit the OLDEST in-flight sweep. Emission skips a
+        slot when its handle was cancelled or replaced after the dispatch —
+        in-flight results of a cancelled request must never reach its
+        stream. When every slot finished, any younger in-flight sweep is
+        pure garbage: drop it and rewind the rng split it consumed."""
+        rec = self._inflight.pop(0)
+        with jax.profiler.TraceAnnotation("kvmini.decode_retire"):
+            # ONE host transfer for the whole chunk block — per-element
+            # int(row[i]) costs a separate device readback each (chunk x
+            # slots round-trips per sweep; this line was the serving
+            # bottleneck, not the decode math)
+            toks_h, lps_h, tids_h, tlps_h = (
+                np.asarray(a) for a in jax.device_get(rec["ys"])
+            )
+        t_ready = time.time()
+        self.stats["busy_s"] += t_ready - max(rec["t_dispatch"], self._t_last_ready)
+        self._t_last_ready = t_ready
+        self._pending_steps -= rec["chunk"]
+        self.stats["decode_steps"] += rec["chunk"]
+        overlapped = bool(self._inflight)  # device still computing N+1
+        now = time.time()
+        for step in range(toks_h.shape[0]):
+            for i in rec["active"]:
+                h = self._slot_req[i]
+                if h is None or h is not rec["handles"][i]:
+                    continue  # finished earlier in this chunk, or freed
+                if h.cancelled is not None and not self._lockstep:
+                    # cancelled between dispatch and retire: drop its
+                    # tokens. In lockstep the race is host-local (the
+                    # follower can't see it) — there the cancel DECISION,
+                    # which precedes the retire in the stream, is what
+                    # stops emission on both sides.
+                    continue
+                lp_info = None
+                if h.request.logprobs:
+                    lp_info = (
+                        float(lps_h[step, i]),
+                        list(zip(tids_h[step, i].tolist(),
+                                 tlps_h[step, i].tolist())),
+                    )
+                self._emit_token(i, int(toks_h[step, i]), now, lp_info)
+        if overlapped:
+            # emission ran while the device computed the next sweep — the
+            # host time the synchronous loop would have serialized
+            self.stats["host_overlap_s"] += time.time() - t_ready
+        any_active = any(h is not None for h in self._slot_req)
+        if not any_active and self._inflight:
+            # every slot finished: younger sweeps computed only garbage.
+            # Rewind to the oldest dropped sweep's pre-dispatch rng (their
+            # counts/KV pollution sits in freed rows, reset at admission).
+            self._rng = self._inflight[0]["rng_prev"]
+            self._inflight.clear()
+            self._pending_steps = 0
+            self._tokens_dev = None
+        self._bubble_anchor = (
+            t_ready if (any_active and not self._inflight) else 0.0
+        )
+
+    def _retire_all(self, on_decision=None) -> None:
+        while self._inflight:
+            if on_decision is not None:
+                on_decision(("retire",))
+            self._retire_one()
+
+    def _sweep_phase(self, on_decision=None) -> None:
+        """Dispatch/retire policy for one iteration with live slots. The
+        double-buffered steady state dispatches sweep N+1 from the
+        on-device carry BEFORE retiring sweep N, so emission (and the
+        next iteration's admin/cancel/admission work) runs while the
+        device computes. Ineligible mixes retire what's in flight and run
+        the synchronous sweep, preserving the seed scheduler exactly."""
+        active = [
+            i for i in range(self.ecfg.max_slots)
+            if self._slot_req[i] is not None
+        ]
+        ok, reason = self._pipeline_eligible(active)
+        if not ok and reason is not None:
+            # counted per sweep iteration on pipeline-enabled engines: how
+            # often the steady state COULD NOT engage, and why
+            self.stats[f"pipeline_fallback_{reason}"] += 1
+        if self._inflight:
+            if ok:
+                if on_decision is not None:
+                    on_decision(("dispatch",))
+                self._dispatch_plain(active)
+            if on_decision is not None:
+                on_decision(("retire",))
+            self._retire_one()
+            return
+        if ok:
+            if on_decision is not None:
+                on_decision(("dispatch",))
+            self._dispatch_plain(active)
+            return  # overlap begins: host work rides the device compute
+        if on_decision is not None:
+            on_decision(("sweep",))
+        self._decode_sweep()
+
+    def _replay_dispatch(self) -> None:
+        """Multihost follower side of a published ('dispatch',): the
+        active set is deterministic from the replayed decision stream, so
+        operands and jitted-call order match the primary's."""
+        active = [
+            i for i in range(self.ecfg.max_slots)
+            if self._slot_req[i] is not None
+        ]
+        self._dispatch_plain(active)
+
+    def _masked_sweep(self, active: list[int], constrained: list[int]) -> None:
+        """Grammar-constrained decode sweep: single step, synchronous —
+        the next mask depends on the byte just emitted, so there is
+        nothing to dispatch ahead."""
+        S = self.ecfg.max_slots
+        tokens = self._feed_tokens(active)
+        lengths = jnp.asarray(self._slot_len, dtype=jnp.int32)
+        temps, topks, topps, pres, freqs = self._get_sampling_arrays()
+        self._rng, sub = jax.random.split(self._rng)
+        t0 = time.time()
+        if self._bubble_anchor:
+            self.stats["bubble_s"] += max(t0 - self._bubble_anchor, 0.0)
+            self._bubble_anchor = 0.0
+        mask = np.zeros((S, (self.cfg.vocab_size + 7) // 8), dtype=np.uint8)
+        for i in constrained:
+            budget = min(
+                self._slot_remaining[i],
+                self.ecfg.max_seq_len - 1 - self._slot_len[i],
+            )
+            mask[i] = self._constraint_mask(self._slot_machine[i], budget)
+        use_mask = np.zeros((S,), dtype=bool)
+        use_mask[constrained] = True
+        lkw = {}
+        if self.paged:
+            lkw["table"] = self._table()
+        if self._lora is not None:
+            lkw["lora"] = self._lora["layers"]
+            lkw["ids"] = self._adapter_ids()
+        decode = self._get_masked_decode_fn()
+        self._cache, self._counts, next_toks, ys = decode(
+            self.params, self._cache,
+            tokens, lengths, temps, topks, topps, sub,
+            self._counts, pres, freqs,
+            jnp.asarray(mask), jnp.asarray(use_mask), **lkw,
+        )
+        self._tokens_dev = next_toks
+        self._tokens_dev_slots = frozenset(active)
+        toks_h, lps_h, tids_h, tlps_h = (
+            np.asarray(a) for a in jax.device_get(ys)
+        )
         now = time.time()
         self.stats["busy_s"] += now - t0
-        self.stats["decode_steps"] += chunk
-
+        self._t_last_ready = now
+        self.stats["decode_steps"] += 1
         for step in range(toks_h.shape[0]):
             for i in active:
                 if self._slot_req[i] is None:
-                    continue  # finished earlier in this chunk; surplus discarded
+                    continue  # finished earlier in this chunk
                 lp_info = None
                 if self._slot_req[i].request.logprobs:
                     lp_info = (
                         float(lps_h[step, i]),
-                        list(zip(tids_h[step, i].tolist(), tlps_h[step, i].tolist())),
+                        list(zip(tids_h[step, i].tolist(),
+                                 tlps_h[step, i].tolist())),
                     )
                 self._emit_token(i, int(toks_h[step, i]), now, lp_info)
+        if any(h is not None for h in self._slot_req):
+            self._bubble_anchor = now
 
     def _fail_all(self, exc: BaseException) -> None:
         """Push an error 'done' to every live/pending handle so no client
         blocks forever on a dead scheduler."""
         info = {"finish_reason": "error", "error": f"{type(exc).__name__}: {exc}"}
+        # in-flight sweeps die with the scheduler; drop their bookkeeping so
+        # a post-mortem snapshot_stats doesn't report phantom depth
+        self._inflight.clear()
+        self._pending_steps = 0
+        self._tokens_dev = None
         for slot in range(self.ecfg.max_slots):
             h = self._slot_req[slot]
             if h is not None:
@@ -1909,14 +2228,18 @@ class Engine:
 
     def _schedule_once(self, on_decision=None) -> None:
         """One scheduler iteration: drain admissions into free slots, then
-        one decode sweep (or a short blocking wait when idle). The SINGLE
-        source of scheduling policy — Engine._loop runs it directly and the
-        multi-host primary (runtime/multihost.py) runs it with
-        ``on_decision``, which receives every state-advancing decision
-        (("admit", request) / ("sweep",)) BEFORE it executes, so followers
-        can replay the identical stream."""
-        # adapter load/unload ops run here — between sweeps, on this
-        # thread — so the bank/registry never changes under a dispatch
+        advance decode — pipelined (dispatch sweep N+1, retire sweep N) in
+        steady state, one synchronous sweep otherwise, or a short blocking
+        wait when idle. The SINGLE source of scheduling policy —
+        Engine._loop runs it directly and the multi-host primary
+        (runtime/multihost.py) runs it with ``on_decision``, which receives
+        every state-advancing decision (("admit", request) / ("sweep",) /
+        ("dispatch",) / ("retire",) / ("cancel", ...)) BEFORE it executes,
+        so followers can replay the identical stream."""
+        # adapter load/unload ops run here — between DISPATCHES, on this
+        # thread. An in-flight sweep holds references to the (immutable)
+        # arrays it was dispatched with, so a bank/registry swap here only
+        # affects future dispatches.
         while True:
             try:
                 op = self._admin.get_nowait()
@@ -1927,7 +2250,13 @@ class Engine:
         # cancellations first: a cancelled slot must not burn a sweep (and
         # its freed slot can admit in the same iteration below). Published
         # as a decision — a follower that kept the slot live would diverge
-        # its free-list from the primary's at the next admission.
+        # its free-list from the primary's at the next admission. Finishing
+        # the slot is safe even with a sweep in flight: the retire path
+        # checks handle identity and drops the freed slot's in-flight
+        # tokens — deterministically on primary and follower alike (the
+        # cancel decision precedes the retire decision in the stream), so
+        # a cancelled request never receives a token sampled after its
+        # cancellation landed.
         for slot in range(self.ecfg.max_slots):
             h = self._slot_req[slot]
             if h is not None and h.cancelled is not None:
@@ -1954,16 +2283,28 @@ class Engine:
                 # hold at the head of the line until decode frees blocks
                 self._deferred = handle
                 break
+            if self._inflight:
+                # admission mutates the active set and cache bookkeeping
+                # the in-flight sweep was dispatched under — retire first,
+                # admit against settled state (a newly admitted slot must
+                # never receive a stale token from a sweep dispatched
+                # before its admission)
+                self.stats["pipeline_fallback_active_set"] += 1
+                self._retire_all(on_decision)
             if on_decision is not None:
                 on_decision(("admit", handle.request))
             self._admit_one(handle)
             admitted = True
-        self.stats["queue_depth"] = self._pending.qsize()
+        self.stats["queue_depth"] = self._queue_depth()
         if any(h is not None for h in self._slot_req):
-            if on_decision is not None:
-                on_decision(("sweep",))
-            self._decode_sweep()
+            self._sweep_phase(on_decision)
         elif not admitted:
+            if self._inflight:
+                # every live slot was cancelled this iteration: whatever is
+                # still in flight is garbage — retire (emissions all skip
+                # on the freed slots) so the drop/rewind logic settles the
+                # pipeline before the engine idles
+                self._retire_all(on_decision)
             try:
                 handle = self._pending.get(timeout=0.02)
             except queue.Empty:
@@ -1994,6 +2335,10 @@ class Engine:
         s["duty_cycle"] = min(s["busy_s"] / wall, 1.0)
         s["active_slots"] = sum(1 for h in self._slot_req if h is not None)
         s["free_slots"] = len(self._free)
+        # live recompute: the cached value goes stale between scheduler
+        # iterations, and the deferred head-of-line handle must count
+        s["queue_depth"] = self._queue_depth()
+        s["inflight_sweeps"] = len(self._inflight)
         if self.paged:
             s["kv_pool_blocks"] = self._scratch_block
             s["kv_free_blocks"] = len(self._free_blocks)

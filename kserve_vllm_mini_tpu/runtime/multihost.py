@@ -80,6 +80,7 @@ def engine_fingerprint(engine: Engine) -> dict[str, Any]:
         "max_prefill_len": e.max_prefill_len,
         "min_prefill_bucket": e.min_prefill_bucket,
         "decode_chunk": e.decode_chunk,
+        "decode_pipeline": e.decode_pipeline,
         "seed": e.seed,
         "kv_cache_dtype": e.kv_cache_dtype,
         "spec_tokens": e.spec_tokens,
@@ -320,6 +321,7 @@ def run_primary(engine: Engine, publisher: CommandPublisher,
     state-advancing decision published to the followers before it executes
     locally — one policy, two drivers, no drift."""
     check_multihost_engine(engine)
+    engine._lockstep = True  # host-local-race shortcuts off (see engine)
 
     def publish(decision: tuple) -> None:
         if decision[0] == "admit":
@@ -342,6 +344,7 @@ def run_primary(engine: Engine, publisher: CommandPublisher,
 def run_follower(engine: Engine, subscriber: CommandSubscriber) -> None:
     """Replay the primary's decision stream. Blocks until ('stop',)."""
     check_multihost_engine(engine)
+    engine._lockstep = True
     for cmd in subscriber.commands():
         op = cmd[0]
         if op == "admit":
@@ -350,6 +353,16 @@ def run_follower(engine: Engine, subscriber: CommandSubscriber) -> None:
             engine._admit_one(RequestHandle(req_from_payload(cmd[1])))
         elif op == "sweep":
             engine._decode_sweep()
+        elif op == "dispatch":
+            # double-buffered steady state (docs/DECODE_PIPELINE.md): the
+            # primary dispatched sweep N+1 before retiring sweep N. The
+            # active set is deterministic from the replayed stream, so the
+            # follower issues the identical jitted call with identical
+            # operands (the token feed is the previous sweep's on-device
+            # carry on both sides).
+            engine._replay_dispatch()
+        elif op == "retire":
+            engine._retire_one()
         elif op == "cancel":
             # mirror the primary's early finish so the follower's slot
             # free-list stays identical for the replayed admissions

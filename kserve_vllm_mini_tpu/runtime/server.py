@@ -1030,7 +1030,18 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
                 {"status": "unhealthy", "reason": "scheduler not running"},
                 status=503,
             )
-        return web.json_response({"status": "ok", "uptime_s": time.time() - started})
+        s = engine.snapshot_stats()
+        return web.json_response({
+            "status": "ok",
+            "uptime_s": time.time() - started,
+            # probe-visible pipeline state (docs/DECODE_PIPELINE.md): lets a
+            # readiness/debug probe distinguish "idle" from "pipelining"
+            # without parsing the Prometheus exposition
+            "decode_pipeline": {
+                "dispatch_depth": s["dispatch_depth"],
+                "inflight_sweeps": s["inflight_sweeps"],
+            },
+        })
 
     profile_lock = threading.Lock()
     profile_root = Path("runs").resolve()
@@ -1113,6 +1124,16 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
             f"kvmini_tpu_free_slots {s['free_slots']}",
             "# TYPE kvmini_tpu_decode_steps_total counter",
             f"kvmini_tpu_decode_steps_total {s['decode_steps']}",
+            # decode-pipeline telemetry (docs/DECODE_PIPELINE.md): depth >= 2
+            # + low bubble = the double-buffered steady state is engaged
+            "# TYPE kvmini_tpu_dispatch_depth gauge",
+            f"kvmini_tpu_dispatch_depth {s['dispatch_depth']}",
+            "# TYPE kvmini_tpu_pipelined_sweeps_total counter",
+            f"kvmini_tpu_pipelined_sweeps_total {s['pipelined_sweeps']}",
+            "# TYPE kvmini_tpu_host_overlap_seconds_total counter",
+            f"kvmini_tpu_host_overlap_seconds_total {s['host_overlap_s']:.6f}",
+            "# TYPE kvmini_tpu_bubble_seconds_total counter",
+            f"kvmini_tpu_bubble_seconds_total {s['bubble_s']:.6f}",
             "# TYPE kvmini_tpu_spec_rounds_total counter",
             f"kvmini_tpu_spec_rounds_total {s['spec_rounds']}",
             "# TYPE kvmini_tpu_spec_accept_ratio gauge",

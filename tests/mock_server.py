@@ -25,10 +25,15 @@ def make_app(
     n_tokens: int = 8,
     fail_every: int = 0,
     capabilities: set[str] | None = None,
+    pipeline_metrics: dict[str, float] | None = None,
 ) -> web.Application:
     """``capabilities`` toggles OpenAI-dialect extras for parity-probe tests:
     any subset of {"tools", "parallel_tools", "json_mode", "logprobs",
-    "sampling_penalties", "n_choices"}. None means all supported."""
+    "sampling_penalties", "n_choices"}. None means all supported.
+
+    ``pipeline_metrics`` overrides the decode-pipeline gauges the /metrics
+    endpoint reports (kvmini_tpu_* names, docs/DECODE_PIPELINE.md); the
+    defaults mimic a runtime whose double-buffered steady state engaged."""
     stats = MockStats()
     caps = capabilities if capabilities is not None else {
         "tools", "parallel_tools", "json_mode", "logprobs",
@@ -173,8 +178,29 @@ def make_app(
         await resp.write_eof()
         return resp
 
+    pipe = {
+        "kvmini_tpu_dispatch_depth": 2.0,
+        "kvmini_tpu_pipelined_sweeps_total": 40.0,
+        "kvmini_tpu_host_overlap_seconds_total": 0.25,
+        "kvmini_tpu_bubble_seconds_total": 0.01,
+        **(pipeline_metrics or {}),
+    }
+
+    async def metrics(_request: web.Request) -> web.Response:
+        # the same Prometheus exposition shape runtime/server.py serves, so
+        # the analyzer's pipeline-counter scrape is exercised end-to-end
+        # without booting the JAX engine
+        lines = []
+        for name, value in pipe.items():
+            kind = "counter" if name.endswith("_total") else "gauge"
+            lines.append(f"# TYPE {name} {kind}")
+            lines.append(f"{name} {value}")
+        return web.Response(text="\n".join(lines) + "\n",
+                            content_type="text/plain")
+
     app = web.Application()
     app.router.add_post("/v1/chat/completions", chat)
+    app.router.add_get("/metrics", metrics)
     return app
 
 

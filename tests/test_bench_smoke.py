@@ -1,0 +1,101 @@
+"""Bench-pipeline smoke for the decode-pipeline counters (`make
+bench-smoke`, ISSUE 1 satellite): the REAL stage chain (load -> probe ->
+analyze -> energy -> cost) runs against the mock endpoint with a tiny
+budget and the pipeline counters (docs/DECODE_PIPELINE.md) must land in
+the output results.json — proving the /metrics export, the telemetry
+scrape, and the analyzer merge stay wired without needing a TPU (or even
+the JAX engine: the mock serves the same Prometheus exposition shape
+runtime/server.py does)."""
+
+import asyncio
+import json
+import threading
+
+from kserve_vllm_mini_tpu.analysis import telemetry
+from kserve_vllm_mini_tpu.bench_pipeline import run_bench
+from kserve_vllm_mini_tpu.core.rundir import RunDir
+from tests.mock_server import MockServer
+
+
+def _serve_mock(started: threading.Event, stop: threading.Event, holder: dict,
+                **kwargs):
+    async def main():
+        async with MockServer(token_delay_s=0.001, **kwargs) as srv:
+            holder["url"] = srv.url
+            started.set()
+            while not stop.is_set():
+                await asyncio.sleep(0.02)
+
+    asyncio.run(main())
+
+
+def test_bench_smoke_surfaces_pipeline_counters(tmp_path):
+    started, stop, holder = threading.Event(), threading.Event(), {}
+    t = threading.Thread(
+        target=_serve_mock, args=(started, stop, holder),
+        kwargs={"pipeline_metrics": {
+            "kvmini_tpu_dispatch_depth": 2.0,
+            "kvmini_tpu_host_overlap_seconds_total": 0.125,
+        }},
+        daemon=True,
+    )
+    t.start()
+    assert started.wait(timeout=10)
+    try:
+        run_dir = RunDir.create(root=tmp_path)
+        results, code = run_bench(
+            url=holder["url"],
+            profile={"model": "m", "requests": 4, "concurrency": 2,
+                     "max_tokens": 4},
+            run_dir=run_dir,
+        )
+        assert code == 0
+        assert results["requests"] == 4
+        # the tentpole's counters, scraped from /metrics into results.json
+        assert results["pipeline_dispatch_depth"] == 2.0
+        assert results["pipeline_host_overlap_s"] == 0.125
+        assert "pipeline_bubble_s" in results
+        assert "pipeline_pipelined_sweeps" in results
+        # and they persist (the artifact the driver/CI reads, not just the
+        # in-memory return)
+        persisted = json.loads(run_dir.results_json.read_text())
+        assert persisted["pipeline_dispatch_depth"] == 2.0
+    finally:
+        stop.set()
+        t.join(timeout=5)
+
+
+def test_pipeline_counters_absent_for_external_engines(tmp_path):
+    """An endpoint that doesn't export the kvmini_tpu_* pipeline metrics
+    (any external engine) must yield ABSENT keys, not fabricated zeros."""
+    assert telemetry.pipeline_counters(None) == {}
+    # unreachable endpoint -> scrape fails quietly -> no keys
+    assert telemetry.pipeline_counters("http://127.0.0.1:9") == {}
+
+
+def test_scrape_parses_runtime_metric_shapes():
+    """The REAL parser (telemetry.parse_prometheus_text — the body of
+    scrape_runtime_metrics) must read the exact exposition
+    runtime/server.py emits for the new counters."""
+    text = (
+        "# TYPE kvmini_tpu_dispatch_depth gauge\n"
+        "kvmini_tpu_dispatch_depth 2\n"
+        "# TYPE kvmini_tpu_host_overlap_seconds_total counter\n"
+        "kvmini_tpu_host_overlap_seconds_total 0.031416\n"
+        "# TYPE kvmini_tpu_bubble_seconds_total counter\n"
+        "kvmini_tpu_bubble_seconds_total 0.000000\n"
+        "# TYPE kvmini_tpu_pipelined_sweeps_total counter\n"
+        "kvmini_tpu_pipelined_sweeps_total 17\n"
+    )
+    parsed = telemetry.parse_prometheus_text(text)
+    out = {
+        key: parsed[metric]
+        for metric, key in telemetry.PIPELINE_METRIC_KEYS.items()
+        if metric in parsed
+    }
+    assert out == {
+        "pipeline_dispatch_depth": 2.0,
+        "pipeline_pipelined_sweeps": 17.0,
+        "pipeline_host_overlap_s": 0.031416,
+        "pipeline_bubble_s": 0.0,
+    }
