@@ -40,9 +40,14 @@ bench:  ## driver benchmark (one JSON line) on the attached accelerator
 	$(PY) bench.py
 
 # asserts the decode-pipeline counters (docs/DECODE_PIPELINE.md) land in
-# results.json via the real stage chain — the same tier-1 gate CI runs
+# results.json via the real stage chain — the same tier-1 gate CI runs.
+# Also validates the exported traces.json against core/schema.py's
+# TRACES_JSON_SCHEMA (docs/TRACING.md).
 bench-smoke:  ## bench pipeline vs the mock server, tiny budget, no TPU
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_bench_smoke.py -q
+
+dashboards-validate:  ## dashboard JSON structure + panel/query checks
+	$(PY) -m pytest tests/test_assets.py -q -k "dashboard"
 
 test-policy:  ## policies vs a LIVE Gatekeeper (needs kubectl+cluster; skips without)
 	bash tests/policy_admission_test.sh

@@ -111,6 +111,27 @@ def analyze_run(
         telemetry.pipeline_counters(endpoint, runtime_metrics=runtime_metrics)
     )
 
+    # server-side request traces (docs/TRACING.md): fetch /traces, merge
+    # the server leg into runs/<id>/traces/traces.json joined by trace_id,
+    # and summarize the queue/prefill/decode phases into phase_breakdown.
+    # External engines without /traces degrade to the client-only doc.
+    if endpoint:
+        from kserve_vllm_mini_tpu.analysis import traces as traces_mod
+
+        server_doc = traces_mod.fetch_server_traces(endpoint)
+        if server_doc.get("resourceSpans"):
+            client_doc = run_dir.read_traces()
+            merged, matched = traces_mod.merge_server_traces(
+                client_doc, server_doc
+            )
+            if matched:
+                run_dir.write_traces(merged)
+                pb = traces_mod.phase_breakdown(
+                    matched, merged.get("clockOffsetNanosEstimate")
+                )
+                if pb:
+                    update["phase_breakdown"] = pb
+
     io_probe = run_dir.read_io_probe()
     for key in ("network_rtt_p50_ms", "network_rtt_p95_ms", "storage_fetch_mbps"):
         if key in io_probe:

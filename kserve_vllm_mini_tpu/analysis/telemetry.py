@@ -108,7 +108,14 @@ def query_with_fallbacks(
 
 
 def parse_prometheus_text(text: str) -> dict[str, float]:
-    """Prometheus text exposition -> flat {metric_name: value} dict."""
+    """Prometheus text exposition -> flat {metric_name: value} dict.
+
+    Labeled series sharing one metric name are SUMMED, not last-wins: a
+    runtime exporting ``kvmini_tpu_foo_total{tenant="a"} 3`` and
+    ``{tenant="b"} 4`` must aggregate to 7 — the old overwrite silently
+    reported whichever series the exporter emitted last. Summing is the
+    Prometheus aggregation for counters; consumers needing per-label
+    series should query Prometheus, not this flat scrape."""
     out: dict[str, float] = {}
     for line in text.splitlines():
         line = line.strip()
@@ -124,7 +131,7 @@ def parse_prometheus_text(text: str) -> dict[str, float]:
             name, rest = parts[0], parts[1:]
         if rest:
             try:
-                out[name] = float(rest[0])
+                out[name] = out.get(name, 0.0) + float(rest[0])
             except ValueError:
                 continue
     return out

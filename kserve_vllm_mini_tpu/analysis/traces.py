@@ -1,0 +1,204 @@
+"""Join the server's /traces spans with the loadgen's client traces.
+
+The loadgen exports client-leg spans (``client.request`` ->
+``http.request``) to ``runs/<id>/traces/traces.json`` and propagates W3C
+``traceparent`` headers; the runtime records the server leg
+(``server.queue`` / ``server.prefill`` / ``server.decode``, runtime/
+tracing.py) and serves it at ``GET /traces`` in the same OTLP/JSON shape.
+This module fetches the server document, estimates the client<->server
+clock offset, merges the two legs into one traces.json joined by
+trace_id, and summarizes the server phases into the ``phase_breakdown``
+results.json block (docs/TRACING.md).
+
+Clock-offset method: for every trace present in both legs, the client's
+``http.request`` span necessarily STARTS BEFORE the server's
+``server.queue`` span on a common clock (the request must travel before
+the server can queue it). ``delta = server.queue.start -
+http.request.start`` therefore equals the clock offset plus one-way
+network+parse delay; the MINIMUM delta across requests is the tightest
+upper bound on the offset (the request with the fastest delivery). We
+report that minimum as the estimate — biased high by the fastest one-way
+delay, which on the deployments this targets (same host or same rack) is
+microseconds against millisecond-scale phases.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+from typing import Any, Optional
+
+from kserve_vllm_mini_tpu.runtime.tracing import SERVER_SCOPE, spans_from_otlp
+
+SERVER_PHASE_SPANS = ("server.queue", "server.prefill", "server.decode")
+
+
+def _is_server_leg(rs: dict[str, Any]) -> bool:
+    """A resourceSpans entry previously merged from a /traces export —
+    identified by the scope name every server-leg exporter stamps."""
+    return any(
+        (ss.get("scope") or {}).get("name") == SERVER_SCOPE
+        for ss in rs.get("scopeSpans", []) or []
+    )
+
+
+def strip_server_leg(doc: dict[str, Any]) -> dict[str, Any]:
+    """The client-only view of a (possibly already merged) traces doc.
+    Re-running analyze on an existing run dir reads back the MERGED doc;
+    without this strip each re-run would append a duplicate server block
+    (and the offset estimate would key off stale spans)."""
+    return {
+        **doc,
+        "resourceSpans": [
+            rs for rs in doc.get("resourceSpans", []) or []
+            if not _is_server_leg(rs)
+        ],
+    }
+
+
+def fetch_server_traces(endpoint: str, timeout_s: float = 5.0) -> dict[str, Any]:
+    """GET <endpoint>/traces -> OTLP doc, or {} when the endpoint doesn't
+    serve it (external engines) / is unreachable — absence degrades the
+    merge, never fails the analyze stage."""
+    url = endpoint.rstrip("/") + "/traces"
+    try:
+        with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+            doc = json.loads(resp.read())
+    except Exception:
+        return {}
+    return doc if isinstance(doc, dict) else {}
+
+
+def _span_ns(span: dict[str, Any]) -> tuple[int, int]:
+    try:
+        return (int(span.get("startTimeUnixNano", 0)),
+                int(span.get("endTimeUnixNano", 0)))
+    except (TypeError, ValueError):
+        return (0, 0)
+
+
+def estimate_clock_offset_ns(
+    client_doc: dict[str, Any], server_doc: dict[str, Any]
+) -> Optional[int]:
+    """min over joined traces of (server.queue.start - http.request.start);
+    None when no trace appears in both legs. See the module docstring for
+    why min is the right statistic."""
+    client_http: dict[str, int] = {}
+    for _svc, s in spans_from_otlp(client_doc):
+        if s.get("name") == "http.request":
+            client_http[s.get("traceId", "")] = _span_ns(s)[0]
+    deltas = [
+        _span_ns(s)[0] - client_http[s["traceId"]]
+        for _svc, s in spans_from_otlp(server_doc)
+        if s.get("name") == "server.queue" and s.get("traceId") in client_http
+    ]
+    return min(deltas) if deltas else None
+
+
+def merge_server_traces(
+    client_doc: dict[str, Any], server_doc: dict[str, Any]
+) -> tuple[dict[str, Any], list[dict[str, Any]]]:
+    """(merged OTLP doc, matched server spans).
+
+    Server spans joining a client trace merge as an extra resourceSpans
+    entry; engine-lane spans (``engine.*`` — dispatch->retire windows)
+    ride along when they overlap the run's time window, so the report can
+    show device-occupancy context beside the per-request lanes. Spans of
+    OTHER runs still sitting in the server's ring buffer are dropped.
+    The clock-offset estimate lands doc-level as
+    ``clockOffsetNanosEstimate`` (server clock minus client clock).
+
+    IDEMPOTENT: a previously merged server leg in ``client_doc`` (analyze
+    re-run on the same run dir) is stripped and replaced, never
+    duplicated."""
+    client_doc = strip_server_leg(client_doc)
+    client_ids = {
+        s.get("traceId") for _svc, s in spans_from_otlp(client_doc)
+    }
+    run_bounds = [
+        ns
+        for _svc, s in spans_from_otlp(client_doc)
+        for ns in _span_ns(s)
+        if ns > 0
+    ]
+    offset = estimate_clock_offset_ns(client_doc, server_doc)
+    t0 = min(run_bounds) + (offset or 0) if run_bounds else 0
+    t1 = max(run_bounds) + (offset or 0) if run_bounds else 0
+
+    matched: list[dict[str, Any]] = []
+    server_resource: Optional[dict[str, Any]] = None
+    for rs in server_doc.get("resourceSpans", []) or []:
+        server_resource = rs.get("resource")
+        break
+    for _svc, s in spans_from_otlp(server_doc):
+        if s.get("traceId") in client_ids:
+            matched.append(s)
+        elif str(s.get("name", "")).startswith("engine.") and run_bounds:
+            start, end = _span_ns(s)
+            if end >= t0 and start <= t1:  # overlaps the run window
+                matched.append(s)
+
+    merged = dict(client_doc)
+    merged["resourceSpans"] = list(client_doc.get("resourceSpans", []) or [])
+    if matched:
+        merged["resourceSpans"].append(
+            {
+                "resource": server_resource
+                or {
+                    "attributes": [
+                        {"key": "service.name",
+                         "value": {"stringValue": "kvmini-tpu-runtime"}}
+                    ]
+                },
+                "scopeSpans": [
+                    {
+                        "scope": {"name": SERVER_SCOPE},
+                        "spans": matched,
+                    }
+                ],
+            }
+        )
+    if offset is not None:
+        merged["clockOffsetNanosEstimate"] = offset
+    return merged, matched
+
+
+def phase_breakdown(
+    server_spans: list[dict[str, Any]],
+    clock_offset_ns: Optional[int] = None,
+) -> dict[str, Any]:
+    """Server phase spans -> the results.json ``phase_breakdown`` block:
+    per-phase duration percentiles so the next perf PR knows whether
+    latency is queueing, prefill, or decode. {} when no phase spans."""
+    by_phase: dict[str, list[float]] = {}
+    for s in server_spans:
+        name = s.get("name", "")
+        if name not in SERVER_PHASE_SPANS:
+            continue
+        start, end = _span_ns(s)
+        if end < start:
+            continue
+        by_phase.setdefault(name.split(".", 1)[1], []).append(
+            (end - start) / 1e6
+        )
+    if not by_phase:
+        return {}
+
+    def _pct(vals: list[float], q: float) -> float:
+        vs = sorted(vals)
+        return vs[min(int(q * len(vs)), len(vs) - 1)]
+
+    out: dict[str, Any] = {
+        phase: {
+            "count": len(vals),
+            "mean_ms": sum(vals) / len(vals),
+            "p50_ms": _pct(vals, 0.50),
+            "p95_ms": _pct(vals, 0.95),
+            "max_ms": max(vals),
+        }
+        for phase, vals in sorted(by_phase.items())
+    }
+    if clock_offset_ns is not None:
+        out["clock_offset_ms_est"] = clock_offset_ns / 1e6
+    out["source"] = "server:/traces"
+    return out

@@ -105,6 +105,12 @@ class Results:
     ttft_histogram: Optional[dict[str, Any]] = None
     token_timing: Optional[dict[str, Any]] = None
 
+    # server-side phase attribution (docs/TRACING.md): per-phase duration
+    # stats from the runtime's /traces spans merged by the analyzer —
+    # {"queue"|"prefill"|"decode": {count, mean_ms, p50_ms, p95_ms,
+    # max_ms}, "clock_offset_ms_est": ..., "source": "server:/traces"}
+    phase_breakdown: Optional[dict[str, Any]] = None
+
     extras: dict[str, Any] = field(default_factory=dict)
 
     def to_dict(self) -> dict[str, Any]:
@@ -132,3 +138,137 @@ def merge_results(base: dict[str, Any], update: dict[str, Any]) -> dict[str, Any
     out = dict(base)
     out.update(update)
     return out
+
+
+# -- traces.json schema -------------------------------------------------------
+#
+# The OTLP/JSON subset both trace writers (loadgen/tracing.py, runtime/
+# tracing.py) emit and the analyzer's merge preserves. Expressed as a
+# JSON-Schema document for tooling, enforced by validate_traces (hand-
+# rolled — the validation must not grow a jsonschema dependency for the
+# harness layers). `make bench-smoke` gates on it.
+
+TRACES_JSON_SCHEMA: dict[str, Any] = {
+    "$schema": "http://json-schema.org/draft-07/schema#",
+    "title": "kvmini-tpu traces.json (OTLP/JSON subset)",
+    "type": "object",
+    "required": ["resourceSpans"],
+    "properties": {
+        "resourceSpans": {
+            "type": "array",
+            "items": {
+                "type": "object",
+                "required": ["scopeSpans"],
+                "properties": {
+                    "scopeSpans": {
+                        "type": "array",
+                        "items": {
+                            "type": "object",
+                            "required": ["spans"],
+                            "properties": {
+                                "spans": {
+                                    "type": "array",
+                                    "items": {
+                                        "type": "object",
+                                        "required": [
+                                            "traceId", "spanId", "name",
+                                            "startTimeUnixNano",
+                                            "endTimeUnixNano",
+                                        ],
+                                        "properties": {
+                                            "traceId": {
+                                                "type": "string",
+                                                "pattern": "^[0-9a-f]{32}$",
+                                            },
+                                            "spanId": {
+                                                "type": "string",
+                                                "pattern": "^[0-9a-f]{16}$",
+                                            },
+                                            "parentSpanId": {
+                                                "type": "string",
+                                                "pattern": "^[0-9a-f]{16}$",
+                                            },
+                                            "name": {"type": "string"},
+                                            "startTimeUnixNano": {"type": "string"},
+                                            "endTimeUnixNano": {"type": "string"},
+                                        },
+                                    },
+                                }
+                            },
+                        },
+                    }
+                },
+            },
+        },
+        "clockOffsetNanosEstimate": {"type": "integer"},
+        "droppedSpans": {"type": "integer"},
+    },
+}
+
+
+_HEX_CHARS = frozenset("0123456789abcdef")
+
+
+def _hex_id(v: Any, width: int) -> bool:
+    # the SAME strictness as the schema's ^[0-9a-f]{N}$ patterns
+    # (lowercase-only; int(v, 16) would accept uppercase/'0x'/underscores
+    # and make this gate disagree with the published JSON Schema)
+    return (
+        isinstance(v, str) and len(v) == width and _HEX_CHARS.issuperset(v)
+    )
+
+
+def validate_traces(doc: Any) -> list[str]:
+    """Validate a traces.json document against TRACES_JSON_SCHEMA's
+    contract. Returns a list of violation strings — empty means valid.
+    Checks the invariants downstream consumers rely on: id shapes, the
+    nano-timestamp strings, and end >= start (negative durations were the
+    exact bug the export clamp fixed)."""
+    errs: list[str] = []
+    if not isinstance(doc, dict):
+        return ["document is not an object"]
+    rss = doc.get("resourceSpans")
+    if not isinstance(rss, list):
+        return ["resourceSpans missing or not an array"]
+    for ri, rs in enumerate(rss):
+        if not isinstance(rs, dict):
+            errs.append(f"resourceSpans[{ri}] is not an object")
+            continue
+        sss = rs.get("scopeSpans")
+        if not isinstance(sss, list):
+            errs.append(f"resourceSpans[{ri}].scopeSpans missing")
+            continue
+        for si, ss in enumerate(sss):
+            spans = ss.get("spans") if isinstance(ss, dict) else None
+            if not isinstance(spans, list):
+                errs.append(
+                    f"resourceSpans[{ri}].scopeSpans[{si}].spans missing"
+                )
+                continue
+            for pi, s in enumerate(spans):
+                where = f"resourceSpans[{ri}].scopeSpans[{si}].spans[{pi}]"
+                if not isinstance(s, dict):
+                    errs.append(f"{where} is not an object")
+                    continue
+                if not _hex_id(s.get("traceId"), 32):
+                    errs.append(f"{where}: bad traceId {s.get('traceId')!r}")
+                if not _hex_id(s.get("spanId"), 16):
+                    errs.append(f"{where}: bad spanId {s.get('spanId')!r}")
+                if "parentSpanId" in s and not _hex_id(s["parentSpanId"], 16):
+                    errs.append(
+                        f"{where}: bad parentSpanId {s['parentSpanId']!r}"
+                    )
+                if not isinstance(s.get("name"), str) or not s.get("name"):
+                    errs.append(f"{where}: missing name")
+                try:
+                    start = int(s.get("startTimeUnixNano", ""))
+                    end = int(s.get("endTimeUnixNano", ""))
+                except (TypeError, ValueError):
+                    errs.append(f"{where}: non-integer time stamps")
+                    continue
+                if end < start:
+                    errs.append(
+                        f"{where}: negative duration "
+                        f"({s.get('name')}: {end} < {start})"
+                    )
+    return errs

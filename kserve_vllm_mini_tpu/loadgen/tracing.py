@@ -66,6 +66,13 @@ class TraceSpan:
                 val = {"stringValue": str(v)}
             return {"key": k, "value": val}
 
+        # a span abandoned on an error path (end() never ran) would export
+        # end_ns=0 — a negative duration every viewer renders as garbage.
+        # Clamp to the start instant and mark the status as error; the
+        # exported doc stays valid and the abandonment is visible.
+        end_ns, ok = self.end_ns, self.status_ok
+        if end_ns < self.start_ns:
+            end_ns, ok = self.start_ns, False
         return {
             "traceId": self.trace_id,
             "spanId": self.span_id,
@@ -73,9 +80,9 @@ class TraceSpan:
             "name": self.name,
             "kind": 3,  # SPAN_KIND_CLIENT
             "startTimeUnixNano": str(self.start_ns),
-            "endTimeUnixNano": str(self.end_ns),
+            "endTimeUnixNano": str(end_ns),
             "attributes": [_attr(k, v) for k, v in self.attributes.items()],
-            "status": {"code": 1 if self.status_ok else 2},
+            "status": {"code": 1 if ok else 2},
         }
 
 

@@ -79,25 +79,47 @@ def _trace_viewer(run_dir: Optional[Path], results: dict[str, Any]) -> str:
         return ""
     trace_id = best.get("trace_id", "")
     doc = json.loads(traces_path.read_text())
-    spans = [
-        s
-        for rs in doc.get("resourceSpans", [])
-        for ss in rs.get("scopeSpans", [])
-        for s in ss.get("spans", [])
-        if s.get("traceId") == trace_id
-    ]
-    if not spans:
+    from kserve_vllm_mini_tpu.runtime.tracing import spans_from_otlp
+
+    # two lanes: the loadgen's client spans and — when the analyzer merged
+    # the runtime's /traces leg (docs/TRACING.md) — the server's phase
+    # spans, clock-corrected onto the client timeline by the merge's
+    # offset estimate
+    offset_ns = int(doc.get("clockOffsetNanosEstimate", 0) or 0)
+    client_spans, server_spans = [], []
+    for svc, s in spans_from_otlp(doc):
+        if s.get("traceId") != trace_id:
+            continue
+        (server_spans if s.get("kind") == 2 else client_spans).append(s)
+    if not client_spans and not server_spans:
         return ""
-    t0 = min(int(s["startTimeUnixNano"]) for s in spans)
+
+    def _ns(s: dict, key: str, shift: int = 0) -> int:
+        return int(s.get(key, 0)) - shift
+
+    all_starts = [_ns(s, "startTimeUnixNano") for s in client_spans] + [
+        _ns(s, "startTimeUnixNano", offset_ns) for s in server_spans
+    ]
+    t0 = min(all_starts)
     lines = [f"trace {trace_id}  (request {best['request_id']}, "
              f"{float(best['latency_ms']):.1f} ms ~ p95)"]
-    for s in sorted(spans, key=lambda s: int(s["startTimeUnixNano"])):
-        start_ms = (int(s["startTimeUnixNano"]) - t0) / 1e6
-        dur_ms = (int(s["endTimeUnixNano"]) - int(s["startTimeUnixNano"])) / 1e6
-        indent = "  " if s.get("parentSpanId") else ""
-        bar = "#" * max(int(dur_ms / max(float(best["latency_ms"]), 1e-9) * 40), 1)
-        lines.append(f"{indent}{s['name']:<24} +{start_ms:8.1f}ms "
-                     f"{dur_ms:8.1f}ms  {bar}")
+
+    def _render(spans: list[dict], lane: str, shift: int) -> None:
+        for s in sorted(spans, key=lambda s: int(s["startTimeUnixNano"])):
+            start_ms = (_ns(s, "startTimeUnixNano", shift) - t0) / 1e6
+            dur_ms = (int(s["endTimeUnixNano"]) - int(s["startTimeUnixNano"])) / 1e6
+            indent = "  " if s.get("parentSpanId") else ""
+            bar = "#" * max(
+                int(dur_ms / max(float(best["latency_ms"]), 1e-9) * 40), 1
+            )
+            lines.append(f"{lane}{indent}{s['name']:<24} +{start_ms:8.1f}ms "
+                         f"{dur_ms:8.1f}ms  {bar}")
+
+    _render(client_spans, "", 0)
+    if server_spans:
+        lines.append("")
+        lines.append(f"server lane (clock offset est {offset_ns / 1e6:+.2f} ms)")
+        _render(server_spans, "  ", offset_ns)
     return (
         "<section><h2>p95 request trace</h2>"
         f"<pre class='trace'>{html_mod.escape(chr(10).join(lines))}</pre></section>"

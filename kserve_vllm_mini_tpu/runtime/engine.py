@@ -35,6 +35,7 @@ import numpy as np
 
 from kserve_vllm_mini_tpu.models.config import ModelConfig
 from kserve_vllm_mini_tpu.models.llama import forward
+from kserve_vllm_mini_tpu.runtime import tracing as rt_tracing
 from kserve_vllm_mini_tpu.runtime.sampling import (
     apply_penalties,
     count_tokens,
@@ -288,6 +289,15 @@ class EngineConfig:
     # created, so growing past it needs a restart). Engines built with a
     # preset bank keep that bank's capacity instead.
     lora_slots: int = 4
+    # Request lifecycle tracing (docs/TRACING.md): per-request phase spans
+    # (queue wait, prefill, decode, cancellation) plus engine-lane
+    # dispatch->retire window spans, recorded into a bounded ring buffer
+    # served at GET /traces. On by default — the recorder is post-hoc (at
+    # most tracing.MAX_REQUEST_SPANS tuples per request, never per-token)
+    # and the buffer evicts at trace_buffer spans. False disables span
+    # recording entirely; the phase histograms (plain counters) stay on.
+    request_tracing: bool = True
+    trace_buffer: int = 4096
 
 
 @dataclass
@@ -324,6 +334,14 @@ class GenRequest:
     # model). Resolved to a bank index at submit; each slot decodes with
     # its own adapter inside the same jitted step (ops/lora.py).
     adapter: Optional[str] = None
+    # W3C trace context from the client's traceparent header
+    # (runtime/server.py parses it): the engine's phase spans share
+    # trace_id with the client's trace and parent under parent_span_id,
+    # so /traces output joins the loadgen's traces.json by trace_id
+    # (docs/TRACING.md). None = a fresh trace id is minted at submit when
+    # tracing is enabled (the request still shows up in /traces).
+    trace_id: Optional[str] = None
+    parent_span_id: Optional[str] = None
 
 
 @dataclass
@@ -365,6 +383,7 @@ class RequestHandle:
         self.request = req
         self.events: "queue.Queue[tuple]" = queue.Queue()
         self.t_submit = time.time()
+        self.t_admit: float = 0.0   # queue wait ends / prefill begins
         self.t_first_token: float = 0.0
         self.t_done: float = 0.0
         self.tokens: list[int] = []
@@ -682,6 +701,30 @@ class Engine:
             "pipeline_fallback_spec": 0,         # spec partition forced sync
             "pipeline_fallback_active_set": 0,   # admission/cancel forced retire
             "pipeline_fallback_headroom": 0,     # cache window forced sync
+        }
+
+        # request lifecycle tracing (docs/TRACING.md): bounded ring of
+        # completed phase spans served at GET /traces, plus per-phase
+        # duration histograms for /metrics (kvmini_tpu_phase_seconds).
+        # The histograms are plain counters and stay on even when span
+        # recording is disabled (request_tracing=False).
+        self.tracer: Optional[rt_tracing.SpanRecorder] = (
+            rt_tracing.SpanRecorder(self.ecfg.trace_buffer)
+            if self.ecfg.request_tracing else None
+        )
+        # engine-lane spans (decode dispatch->retire windows) accrue one
+        # PER SWEEP — orders of magnitude faster than request spans. They
+        # get their OWN ring so a long run's sweep spans can never evict
+        # the per-request phase spans the analyzer joins; they share one
+        # synthetic trace per engine lifetime and land in /traces beside
+        # the request spans (traces_otlp merges the two rings).
+        self._engine_tracer: Optional[rt_tracing.SpanRecorder] = (
+            rt_tracing.SpanRecorder(min(1024, self.ecfg.trace_buffer))
+            if self.ecfg.request_tracing else None
+        )
+        self._engine_trace_id = rt_tracing.new_trace_id()
+        self._phase_hist = {
+            p: rt_tracing.PhaseHistogram() for p in rt_tracing.PHASES
         }
 
     # -- paged-KV block accounting ----------------------------------------
@@ -1350,6 +1393,10 @@ class Engine:
                 ),
             }))
             return handle
+        if self.tracer is not None and req.trace_id is None:
+            # no client trace context: mint one so the request still shows
+            # in /traces (it just won't join a client-side trace)
+            req.trace_id = rt_tracing.new_trace_id()
         self._pending.put(handle)
         self.stats["queue_depth"] = self._queue_depth()
         return handle
@@ -1363,6 +1410,71 @@ class Engine:
         if self.paged and self._deferred is not None:
             n += 1
         return n
+
+    # -- request lifecycle tracing (docs/TRACING.md) -----------------------
+
+    def _trace_span(
+        self,
+        handle: RequestHandle,
+        name: str,
+        t0: float,
+        t1: float,
+        ok: bool = True,
+        attrs: Optional[dict[str, Any]] = None,
+    ) -> None:
+        """Record one completed per-request phase span. All phase spans
+        parent DIRECTLY under the client's http.request span
+        (parent_span_id from the traceparent header), so the joined trace
+        reads client http.request -> server queue/prefill/decode. At most
+        tracing.MAX_REQUEST_SPANS of these per request — the recorder-
+        overhead contract."""
+        req = handle.request
+        if self.tracer is None or req.trace_id is None:
+            # trace_id is None only when tracing is off or on a multihost
+            # follower (trace context is host-only in the replay payload)
+            return
+        a = {"request_id": req.request_id}
+        if attrs:
+            a.update(attrs)
+        self.tracer.record(
+            name, req.trace_id, int(t0 * 1e9), int(t1 * 1e9),
+            parent_span_id=req.parent_span_id, ok=ok, attrs=a,
+        )
+
+    def _trace_engine_span(
+        self, name: str, t0: float, t1: float,
+        attrs: Optional[dict[str, Any]] = None,
+    ) -> None:
+        """Engine-lane span (dispatch->retire windows): not tied to one
+        request, recorded under the engine's own trace id into the
+        engine-lane ring (never competes with request spans for slots)."""
+        if self._engine_tracer is None:
+            return
+        self._engine_tracer.record(
+            name, self._engine_trace_id, int(t0 * 1e9), int(t1 * 1e9),
+            attrs=attrs,
+        )
+
+    def _observe_phase(self, phase: str, seconds: float) -> None:
+        self._phase_hist[phase].observe(seconds)
+
+    def snapshot_phase_hist(self) -> dict[str, Any]:
+        """Per-phase histogram snapshots for /metrics
+        (kvmini_tpu_phase_seconds) and tests."""
+        return {p: h.snapshot() for p, h in self._phase_hist.items()}
+
+    def traces_otlp(self) -> dict[str, Any]:
+        """One OTLP doc for GET /traces: the request-span ring plus the
+        engine-lane ring as a second scopeSpans entry (same resource).
+        droppedSpans sums both rings' evictions."""
+        doc = self.tracer.to_otlp()
+        if self._engine_tracer is not None and len(self._engine_tracer):
+            eng_doc = self._engine_tracer.to_otlp()
+            eng_scope = eng_doc["resourceSpans"][0]["scopeSpans"][0]
+            eng_scope["scope"] = {"name": rt_tracing.SERVER_SCOPE + ".engine"}
+            doc["resourceSpans"][0]["scopeSpans"].append(eng_scope)
+            doc["droppedSpans"] += eng_doc["droppedSpans"]
+        return doc
 
     def start(self) -> None:
         if self._running:
@@ -1568,11 +1680,20 @@ class Engine:
             # prefill (no tokens were produced)
             handle.t_done = time.time()
             handle.finish_reason = handle.cancelled
+            self._observe_phase("queue", handle.t_done - handle.t_submit)
+            self._trace_span(
+                handle, "server.queue", handle.t_submit, handle.t_done,
+                ok=False, attrs={"cancelled": handle.cancelled},
+            )
             handle.events.put(("done", {
                 "finish_reason": handle.cancelled,
                 "tokens_out": 0,
             }))
             return
+        handle.t_admit = time.time()
+        # queue phase: submit -> the scheduler picking the request up
+        self._observe_phase("queue", handle.t_admit - handle.t_submit)
+        self._trace_span(handle, "server.queue", handle.t_submit, handle.t_admit)
         slot, reused = self._pop_slot_for(req.prompt_tokens)
         if self.paged:
             # fit is the caller's job: _schedule_once defers a non-fitting
@@ -1638,6 +1759,14 @@ class Engine:
         self.stats["prefill_tokens"] += n - reused
 
         handle.t_first_token = time.time()
+        # prefill phase: admission -> first sampled token (chunked prefill
+        # and the drafter's shadow prefill included)
+        self._observe_phase("prefill", handle.t_first_token - handle.t_admit)
+        self._trace_span(
+            handle, "server.prefill", handle.t_admit, handle.t_first_token,
+            attrs={"prompt_tokens": n, "reused_prefix_tokens": reused,
+                   "slot": slot},
+        )
         handle.tokens.append(first_id)
         lp_info = None
         if req.logprobs:
@@ -1706,6 +1835,23 @@ class Engine:
         if handle is not None:
             handle.t_done = time.time()
             handle.finish_reason = reason
+            # decode phase: first token -> done (a first-token-only request
+            # records a zero-length decode span — the phase still existed)
+            self._observe_phase("decode", handle.t_done - handle.t_first_token)
+            self._trace_span(
+                handle, "server.decode", handle.t_first_token, handle.t_done,
+                ok=reason in ("stop", "length"),
+                attrs={"tokens_out": len(handle.tokens),
+                       "finish_reason": reason},
+            )
+            if handle.cancelled is not None:
+                # cancellation as its own zero-length marker span: the
+                # joined trace shows WHEN the cancel landed, not just that
+                # the decode span ended early
+                self._trace_span(
+                    handle, "server.cancel", handle.t_done, handle.t_done,
+                    ok=False, attrs={"reason": handle.cancelled},
+                )
             handle.events.put(("done", {
                 "finish_reason": reason,
                 "tokens_out": len(handle.tokens),
@@ -1853,6 +1999,11 @@ class Engine:
                     break
             # accepted drafts = emitted minus the bonus token
             self.stats["spec_accepted"] += max(n_emitted - 1, 0)
+        self._trace_engine_span(
+            "engine.decode.window", t0, now,
+            attrs={"chunk": k, "slots": len(active), "mode": "spec"},
+        )
+        self._observe_phase("emit", time.time() - now)
         # spec emission advanced _last_tokens host-side; the device carry
         # (if any) predates it, so the next plain dispatch must rebuild
         self._tokens_dev = None
@@ -2067,10 +2218,19 @@ class Engine:
                                  tlps_h[step, i].tolist())),
                     )
                 self._emit_token(i, int(toks_h[step, i]), now, lp_info)
+        t_emitted = time.time()
+        # emit phase: readback -> host emission done for this window; the
+        # engine-lane span records the dispatch->retire window itself
+        self._observe_phase("emit", t_emitted - t_ready)
+        self._trace_engine_span(
+            "engine.decode.window", rec["t_dispatch"], t_ready,
+            attrs={"chunk": rec["chunk"], "slots": len(rec["active"]),
+                   "pipelined": overlapped},
+        )
         if overlapped:
             # emission ran while the device computed the next sweep — the
             # host time the synchronous loop would have serialized
-            self.stats["host_overlap_s"] += time.time() - t_ready
+            self.stats["host_overlap_s"] += t_emitted - t_ready
         any_active = any(h is not None for h in self._slot_req)
         if not any_active and self._inflight:
             # every slot finished: younger sweeps computed only garbage.
@@ -2190,6 +2350,11 @@ class Engine:
                                  tlps_h[step, i].tolist())),
                     )
                 self._emit_token(i, int(toks_h[step, i]), now, lp_info)
+        self._trace_engine_span(
+            "engine.decode.window", t0, now,
+            attrs={"chunk": 1, "slots": len(active), "mode": "masked"},
+        )
+        self._observe_phase("emit", time.time() - now)
         if any(h is not None for h in self._slot_req):
             self._bubble_anchor = now
 

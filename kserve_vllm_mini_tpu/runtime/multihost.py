@@ -275,7 +275,7 @@ _REQ_FIELDS = (
 # lockstep decode diverges (each process builds its own sampling arrays) —
 # this guard turns "someone added a field" into a loud test failure instead
 # of silent divergence
-_HOST_ONLY_FIELDS = {"constraint", "adapter"}
+_HOST_ONLY_FIELDS = {"constraint", "adapter", "trace_id", "parent_span_id"}
 assert set(_REQ_FIELDS) | _HOST_ONLY_FIELDS == {
     f.name for f in __import__("dataclasses").fields(GenRequest)
 }, "GenRequest fields changed: update _REQ_FIELDS (or _HOST_ONLY_FIELDS)"

@@ -57,6 +57,8 @@ def build_engine(
     lora_demo: int = 0,       # N random adapters "demo-1..N" (bench/testing)
     lora_rank: int = 8,       # rank for the demo bank (PEFT dirs carry theirs)
     lora_slots: int = 4,      # runtime-load bank capacity (load_adapter)
+    request_tracing: bool = True,  # phase-span recorder (docs/TRACING.md)
+    trace_buffer: int = 4096,      # span ring-buffer capacity
 ) -> tuple[Engine, Tokenizer, str]:
     """Construct (engine, tokenizer, model_name) from a preset or checkpoint.
 
@@ -252,6 +254,8 @@ def build_engine(
         kv_block_size=kv_block_size,
         kv_pool_blocks=kv_pool_blocks,
         lora_slots=lora_slots,
+        request_tracing=request_tracing,
+        trace_buffer=trace_buffer,
     )
     engine = Engine(
         params, cfg, ecfg, mesh=mesh, pad_id=tok.pad_id, drafter=drafter_pair,
@@ -615,6 +619,13 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
                 )
         max_stop_len = max((len(s) for s in stops), default=0)
 
+        # W3C trace context: parent the engine's phase spans under the
+        # client's http.request span so /traces joins the loadgen's trace
+        # by trace_id (docs/TRACING.md). Malformed headers are ignored —
+        # the engine mints a local trace id instead.
+        from kserve_vllm_mini_tpu.runtime.tracing import parse_traceparent
+
+        trace_ctx = parse_traceparent(request.headers.get("traceparent"))
         rank_lp = fanout > n_choices
         req = GenRequest(
             prompt_tokens=prompt_ids or [tok.bos_id],
@@ -629,6 +640,8 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
             top_logprobs=top_lp,
             constraint=machine,
             adapter=adapter,
+            trace_id=trace_ctx[0] if trace_ctx else None,
+            parent_span_id=trace_ctx[1] if trace_ctx else None,
         )
         all_reqs = [req]
         for _ in range(fanout - 1):
@@ -1152,7 +1165,24 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
                 "# TYPE kvmini_tpu_kv_block_size gauge",
                 f"kvmini_tpu_kv_block_size {s['kv_block_size']}",
             ]
+        # per-phase latency histograms (docs/TRACING.md): queue / prefill /
+        # decode / emit durations the engine observes at phase transitions
+        from kserve_vllm_mini_tpu.runtime.tracing import render_phase_histograms
+
+        lines += render_phase_histograms(engine._phase_hist)
         return web.Response(text="\n".join(lines) + "\n", content_type="text/plain")
+
+    async def traces(_request):
+        """Runtime-side span buffer, OTLP-shaped JSON (the same schema the
+        loadgen's traces.json uses — analysis/traces.py joins the two by
+        trace_id). The buffer is a bounded ring: spans past the capacity
+        evict oldest-first, and 'droppedSpans' reports how many did. An
+        engine with tracing disabled serves an empty document, not a 404,
+        so scrapers need no capability probe."""
+        if engine.tracer is None:
+            return web.json_response({"resourceSpans": [], "droppedSpans": 0,
+                                      "tracing": "disabled"})
+        return web.json_response(engine.traces_otlp())
 
     def _reject_multihost_admin() -> "Optional[web.Response]":
         """Multi-host serving rejects LoRA entirely at startup
@@ -1239,6 +1269,7 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
     app.router.add_post("/v1/unload_lora_adapter", unload_lora)
     app.router.add_get("/healthz", healthz)
     app.router.add_get("/metrics", metrics)
+    app.router.add_get("/traces", traces)
     app.router.add_post("/profile", profile)
     return app
 
@@ -1317,6 +1348,14 @@ def register(parser: argparse.ArgumentParser) -> None:
                         help="Adapter-bank capacity for adapters loaded at "
                              "RUNTIME (/v1/load_lora_adapter) on an engine "
                              "that started without any --lora")
+    parser.add_argument("--no-request-tracing", action="store_true",
+                        help="Disable the request-lifecycle span recorder "
+                             "(GET /traces; docs/TRACING.md). Also "
+                             "KVMINI_REQUEST_TRACING=0. Phase histograms "
+                             "on /metrics stay on either way")
+    parser.add_argument("--trace-buffer", type=int, default=4096,
+                        help="Span ring-buffer capacity for /traces "
+                             "(bounded memory; oldest spans evict)")
     parser.add_argument("--prefix-cache", action="store_true",
                         help="Automatic prefix caching: finished requests "
                              "retain their KV and new prompts sharing a "
@@ -1457,6 +1496,12 @@ def run(args: argparse.Namespace) -> int:
         lora_demo=args.lora_demo,
         lora_rank=args.lora_rank,
         lora_slots=args.lora_slots,
+        request_tracing=not (
+            args.no_request_tracing
+            or os.environ.get("KVMINI_REQUEST_TRACING", "").lower()
+            in ("0", "false", "off")
+        ),
+        trace_buffer=args.trace_buffer,
     )
 
     if multihost:

@@ -1,0 +1,262 @@
+"""Runtime-side request tracing: bounded ring-buffer span recorder.
+
+The loadgen already traces the client leg (loadgen/tracing.py) and
+propagates W3C ``traceparent`` headers; this module is the SERVER leg.
+The engine stamps per-request phase spans (queue wait, prefill, decode,
+cancellation) plus engine-lane dispatch->retire window spans, and
+runtime/server.py exposes the buffer at ``GET /traces`` in the same
+OTLP/JSON shape the loadgen exports — so the analyzer can join the two
+legs by trace_id into one ``runs/<id>/traces/traces.json``
+(analysis/traces.py, docs/TRACING.md).
+
+Design constraints (the overhead guard, pinned by tests/test_tracing.py):
+
+- **Bounded memory**: spans land in a ``deque(maxlen=capacity)`` — old
+  spans evict, recording never grows the buffer past capacity.
+- **Bounded allocations per request**: the engine stamps at most
+  ``MAX_REQUEST_SPANS`` spans per request (one tuple + one small dict
+  each); no per-token recording ever happens on the decode hot path.
+- **JAX-free**: importable by the harness layers (mock server, analyzer
+  tests) without touching the accelerator stack.
+
+Phase histograms (``kvmini_tpu_phase_seconds``) live here too: plain
+cumulative-bucket counters the engine observes once per phase transition
+and /metrics renders in Prometheus histogram exposition.
+"""
+
+from __future__ import annotations
+
+import secrets
+from collections import deque
+from typing import Any, Iterable, Optional
+
+# the engine's per-request span ceiling: server.queue + server.prefill +
+# server.decode + server.cancel. A request can never allocate more spans
+# than this — the recorder-overhead contract tests pin against it.
+MAX_REQUEST_SPANS = 4
+
+# request phases with /metrics histograms (kvmini_tpu_phase_seconds);
+# "emit" is the per-sweep host emission window of the decode pipeline
+PHASES = ("queue", "prefill", "decode", "emit")
+
+# OTLP scope name every server-leg exporter uses (the real runtime AND the
+# mock); the analyzer's merge keys off it to stay idempotent — re-analyzing
+# a run replaces the previously merged server leg instead of duplicating it
+SERVER_SCOPE = "kserve_vllm_mini_tpu.runtime"
+
+# histogram bucket upper bounds (seconds). Spans request-phase scales from
+# sub-ms queue waits on an idle engine to multi-second long decodes.
+PHASE_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+)
+
+
+def new_trace_id() -> str:
+    return secrets.token_hex(16)
+
+
+def new_span_id() -> str:
+    return secrets.token_hex(8)
+
+
+_HEX_CHARS = frozenset("0123456789abcdef")
+
+
+def is_hex_id(v: Any, width: int) -> bool:
+    """Strict lowercase-hex id of exactly ``width`` chars — the W3C
+    trace-context charset and the TRACES_JSON_SCHEMA pattern. int(v, 16)
+    is NOT equivalent: it accepts uppercase, '0x' prefixes and underscore
+    separators, which would let ids through that the published schema
+    rejects."""
+    return (
+        isinstance(v, str) and len(v) == width and _HEX_CHARS.issuperset(v)
+    )
+
+
+def parse_traceparent(header: Optional[str]) -> Optional[tuple[str, str]]:
+    """W3C trace-context header -> (trace_id, parent_span_id), or None on
+    anything malformed. Accepts the ``00-<32hex>-<16hex>-<2hex>`` shape
+    the loadgen emits (loadgen/tracing.py traceparent()); hex is
+    lowercase-only per the W3C spec."""
+    if not header:
+        return None
+    parts = header.strip().split("-")
+    if len(parts) != 4:
+        return None
+    _ver, trace_id, span_id, _flags = parts
+    if not is_hex_id(trace_id, 32) or not is_hex_id(span_id, 16):
+        return None
+    if trace_id == "0" * 32 or span_id == "0" * 16:
+        return None
+    return trace_id, span_id
+
+
+def _otlp_attr(k: str, v: Any) -> dict[str, Any]:
+    if isinstance(v, bool):
+        val: dict[str, Any] = {"boolValue": v}
+    elif isinstance(v, int):
+        val = {"intValue": str(v)}
+    elif isinstance(v, float):
+        val = {"doubleValue": v}
+    else:
+        val = {"stringValue": str(v)}
+    return {"key": k, "value": val}
+
+
+def span_to_otlp(rec: tuple) -> dict[str, Any]:
+    """One recorded span tuple -> OTLP/JSON span (SPAN_KIND_SERVER)."""
+    name, trace_id, span_id, parent_span_id, start_ns, end_ns, ok, attrs = rec
+    if end_ns < start_ns:
+        # never-ended / clock-skewed record: clamp rather than export a
+        # negative duration (same rule the client tracer applies at export)
+        end_ns, ok = start_ns, False
+    return {
+        "traceId": trace_id,
+        "spanId": span_id,
+        **({"parentSpanId": parent_span_id} if parent_span_id else {}),
+        "name": name,
+        "kind": 2,  # SPAN_KIND_SERVER
+        "startTimeUnixNano": str(start_ns),
+        "endTimeUnixNano": str(end_ns),
+        "attributes": [_otlp_attr(k, v) for k, v in (attrs or {}).items()],
+        "status": {"code": 1 if ok else 2},
+    }
+
+
+class SpanRecorder:
+    """Bounded ring buffer of completed spans.
+
+    Spans are recorded post-hoc (start AND end already known) as flat
+    tuples — no open-span bookkeeping, no growth past ``capacity``. The
+    scheduler thread appends; /traces snapshots from the aiohttp thread
+    (deque append/iteration are atomic enough under the GIL for this
+    monitoring surface — a torn read costs at most one span)."""
+
+    def __init__(self, capacity: int = 4096) -> None:
+        if capacity < 1:
+            raise ValueError(f"trace buffer capacity {capacity} must be >= 1")
+        self.capacity = capacity
+        self._spans: "deque[tuple]" = deque(maxlen=capacity)
+        self.dropped = 0  # evicted span count (buffer wrapped)
+
+    def __len__(self) -> int:
+        return len(self._spans)
+
+    def record(
+        self,
+        name: str,
+        trace_id: str,
+        start_ns: int,
+        end_ns: int,
+        parent_span_id: Optional[str] = None,
+        ok: bool = True,
+        attrs: Optional[dict[str, Any]] = None,
+    ) -> str:
+        """Append one completed span; returns its generated span id."""
+        sid = new_span_id()
+        if len(self._spans) == self.capacity:
+            self.dropped += 1
+        self._spans.append(
+            (name, trace_id, sid, parent_span_id, start_ns, end_ns, ok, attrs)
+        )
+        return sid
+
+    def snapshot(self) -> list[tuple]:
+        return list(self._spans)
+
+    def to_otlp(self, service_name: str = "kvmini-tpu-runtime") -> dict[str, Any]:
+        """Same resourceSpans document shape as loadgen/tracing.py, so the
+        analyzer merges both legs with one parser. Renders from snapshot():
+        iterating the live deque directly would race the scheduler thread's
+        appends (RuntimeError: deque mutated during iteration) — list(deque)
+        is one C-level copy and safe under the GIL."""
+        return {
+            "resourceSpans": [
+                {
+                    "resource": {
+                        "attributes": [
+                            {
+                                "key": "service.name",
+                                "value": {"stringValue": service_name},
+                            }
+                        ]
+                    },
+                    "scopeSpans": [
+                        {
+                            "scope": {"name": SERVER_SCOPE},
+                            "spans": [span_to_otlp(r) for r in self.snapshot()],
+                        }
+                    ],
+                }
+            ],
+            "droppedSpans": self.dropped,
+        }
+
+
+class PhaseHistogram:
+    """Cumulative-bucket histogram (Prometheus semantics) for one phase.
+    ``observe`` is two int increments and a float add — cheap enough to
+    stay on even when span recording is disabled."""
+
+    __slots__ = ("counts", "sum", "count")
+
+    def __init__(self) -> None:
+        self.counts = [0] * (len(PHASE_BUCKETS) + 1)  # +1 = +Inf bucket
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, seconds: float) -> None:
+        if seconds < 0:
+            seconds = 0.0
+        i = 0
+        for i, le in enumerate(PHASE_BUCKETS):  # noqa: B007 — small, fixed
+            if seconds <= le:
+                break
+        else:
+            i = len(PHASE_BUCKETS)
+        self.counts[i] += 1
+        self.sum += seconds
+        self.count += 1
+
+    def snapshot(self) -> dict[str, Any]:
+        cum, total = [], 0
+        for c in self.counts[: len(PHASE_BUCKETS)]:
+            total += c
+            cum.append(total)
+        return {"buckets": cum, "sum": self.sum, "count": self.count}
+
+
+def render_phase_histograms(
+    hists: dict[str, "PhaseHistogram"],
+    metric: str = "kvmini_tpu_phase_seconds",
+) -> list[str]:
+    """Prometheus text-exposition lines for the phase histograms — shared
+    by runtime/server.py /metrics and tests/mock_server.py so the scrape
+    path is exercised end-to-end without the JAX engine."""
+    lines = [f"# TYPE {metric} histogram"]
+    for phase, h in hists.items():
+        snap = h.snapshot()
+        for le, cum in zip(PHASE_BUCKETS, snap["buckets"]):
+            lines.append(
+                f'{metric}_bucket{{phase="{phase}",le="{le}"}} {cum}'
+            )
+        lines.append(
+            f'{metric}_bucket{{phase="{phase}",le="+Inf"}} {snap["count"]}'
+        )
+        lines.append(f'{metric}_sum{{phase="{phase}"}} {snap["sum"]:.6f}')
+        lines.append(f'{metric}_count{{phase="{phase}"}} {snap["count"]}')
+    return lines
+
+
+def spans_from_otlp(doc: dict[str, Any]) -> Iterable[tuple[str, dict[str, Any]]]:
+    """Yield (service_name, span) pairs from an OTLP/JSON document —
+    the one parser both report/html.py and analysis/traces.py use."""
+    for rs in doc.get("resourceSpans", []) or []:
+        service = "unknown"
+        for a in (rs.get("resource") or {}).get("attributes", []) or []:
+            if a.get("key") == "service.name":
+                service = (a.get("value") or {}).get("stringValue", service)
+        for ss in rs.get("scopeSpans", []) or []:
+            for s in ss.get("spans", []) or []:
+                yield service, s

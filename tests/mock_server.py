@@ -3,15 +3,31 @@
 Plays the role of the reference CI's stubbed cluster (SURVEY.md §4.3): a real
 HTTP socket + SSE stream, no model behind it. Supports configurable per-token
 delay so TTFT/TPOT assertions have something to measure.
+
+Request tracing (docs/TRACING.md): the mock ECHOES the received W3C
+``traceparent`` — it records server.queue/prefill/decode spans parented
+under the client's http.request span id into the same ring-buffer
+recorder the real runtime uses (runtime/tracing.py) and serves them at
+``GET /traces``, so the loadgen->analyzer join path is exercised
+end-to-end without booting the JAX engine.
 """
 
 from __future__ import annotations
 
 import asyncio
 import json
+import time
 from dataclasses import dataclass
 
 from aiohttp import web
+
+from kserve_vllm_mini_tpu.runtime.tracing import (
+    PHASES,
+    PhaseHistogram,
+    SpanRecorder,
+    parse_traceparent,
+    render_phase_histograms,
+)
 
 
 @dataclass
@@ -39,11 +55,35 @@ def make_app(
         "tools", "parallel_tools", "json_mode", "logprobs",
         "sampling_penalties", "n_choices",
     }
+    tracer = SpanRecorder(capacity=1024)
+    phase_hist = {p: PhaseHistogram() for p in PHASES}
+
+    def _record_trace(trace_ctx, header, t_arrive_ns, t_first_ns, t_done_ns):
+        """Echo the received traceparent as server phase spans: queue /
+        prefill / decode parented under the client's http.request span —
+        the same span model the real engine stamps."""
+        if trace_ctx is None:
+            return
+        tid, parent = trace_ctx
+        q_end = t_arrive_ns + max((t_first_ns - t_arrive_ns) // 4, 1)
+        tracer.record("server.queue", tid, t_arrive_ns, q_end,
+                      parent_span_id=parent,
+                      attrs={"traceparent": header})
+        tracer.record("server.prefill", tid, q_end, t_first_ns,
+                      parent_span_id=parent)
+        tracer.record("server.decode", tid, t_first_ns, t_done_ns,
+                      parent_span_id=parent)
+        phase_hist["queue"].observe((q_end - t_arrive_ns) / 1e9)
+        phase_hist["prefill"].observe((t_first_ns - q_end) / 1e9)
+        phase_hist["decode"].observe((t_done_ns - t_first_ns) / 1e9)
 
     async def chat(request: web.Request) -> web.StreamResponse:
         stats.requests += 1
         if fail_every and stats.requests % fail_every == 0:
             return web.json_response({"error": "injected"}, status=500)
+        tp_header = request.headers.get("traceparent", "")
+        trace_ctx = parse_traceparent(tp_header)
+        t_arrive_ns = time.time_ns()
         body = await request.json()
         stream = body.get("stream", False)
 
@@ -139,6 +179,10 @@ def make_app(
         n = n if ("n_choices" in caps and not stream) else 1
         if not stream:
             await asyncio.sleep(token_delay_s * max_toks)
+            t_done = time.time_ns()
+            _record_trace(trace_ctx, tp_header, t_arrive_ns,
+                          t_arrive_ns + max((t_done - t_arrive_ns) // 2, 1),
+                          t_done)
             return web.json_response(
                 {
                     "id": "mock",
@@ -160,6 +204,7 @@ def make_app(
             status=200, headers={"Content-Type": "text/event-stream"}
         )
         await resp.prepare(request)
+        t_first_ns = 0
         for i, w in enumerate(words):
             await asyncio.sleep(token_delay_s)
             evt = {
@@ -167,6 +212,8 @@ def make_app(
                 "choices": [{"index": 0, "delta": {"content": w}}],
                 **({"metrics": {"server_ttft_ms": token_delay_s * 1000.0}} if i == 0 else {}),
             }
+            if i == 0:
+                t_first_ns = time.time_ns()
             await resp.write(f"data: {json.dumps(evt)}\n\n".encode())
         usage_evt = {
             "id": "mock",
@@ -176,6 +223,8 @@ def make_app(
         await resp.write(f"data: {json.dumps(usage_evt)}\n\n".encode())
         await resp.write(b"data: [DONE]\n\n")
         await resp.write_eof()
+        _record_trace(trace_ctx, tp_header, t_arrive_ns,
+                      t_first_ns or time.time_ns(), time.time_ns())
         return resp
 
     pipe = {
@@ -195,12 +244,18 @@ def make_app(
             kind = "counter" if name.endswith("_total") else "gauge"
             lines.append(f"# TYPE {name} {kind}")
             lines.append(f"{name} {value}")
+        # phase-latency histograms, same renderer as runtime/server.py
+        lines += render_phase_histograms(phase_hist)
         return web.Response(text="\n".join(lines) + "\n",
                             content_type="text/plain")
+
+    async def traces(_request: web.Request) -> web.Response:
+        return web.json_response(tracer.to_otlp())
 
     app = web.Application()
     app.router.add_post("/v1/chat/completions", chat)
     app.router.add_get("/metrics", metrics)
+    app.router.add_get("/traces", traces)
     return app
 
 

@@ -60,6 +60,26 @@ def test_bench_smoke_surfaces_pipeline_counters(tmp_path):
         # in-memory return)
         persisted = json.loads(run_dir.results_json.read_text())
         assert persisted["pipeline_dispatch_depth"] == 2.0
+
+        # ISSUE 2: the analyzer fetched the mock's /traces, merged the
+        # server leg into traces.json (one doc, both lanes, joined by
+        # trace id) and summarized the phases into phase_breakdown
+        pb = persisted["phase_breakdown"]
+        for phase in ("queue", "prefill", "decode"):
+            assert pb[phase]["count"] == 4
+            assert pb[phase]["p95_ms"] >= pb[phase]["p50_ms"] >= 0
+        assert "clock_offset_ms_est" in pb
+        merged = json.loads(run_dir.traces_json.read_text())
+        # the exported traces.json validates against the canonical schema
+        # (core/schema.py TRACES_JSON_SCHEMA) — the bench-smoke gate
+        from kserve_vllm_mini_tpu.core.schema import validate_traces
+
+        assert validate_traces(merged) == []
+        from kserve_vllm_mini_tpu.runtime.tracing import spans_from_otlp
+
+        names = {s["name"] for _svc, s in spans_from_otlp(merged)}
+        assert {"http.request", "server.queue", "server.prefill",
+                "server.decode"} <= names
     finally:
         stop.set()
         t.join(timeout=5)
