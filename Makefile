@@ -2,7 +2,7 @@
 # of native code — the TPU compute path is JAX/XLA compiled at runtime.
 PY ?= python
 
-.PHONY: help test test-fast test-policy lint fmt smoke bench bench-smoke dashboards-validate helm-lint airgap clean
+.PHONY: help test test-fast test-policy lint lint-invariants fmt smoke bench bench-smoke dashboards-validate helm-lint airgap clean
 
 help:
 	@grep -E '^[a-z-]+:' Makefile | sed 's/:.*//' | sort | uniq
@@ -14,7 +14,7 @@ test:
 	# under the threshold.
 	$(PY) -m pytest tests/ -q -n 2
 
-test-fast:  ## harness-only tests (skip JAX model/runtime suites)
+test-fast: lint-invariants  ## harness-only tests (skip JAX model/runtime suites)
 	# -n 4: the harness lane is embarrassingly parallel; measured 11 min
 	# -> <3 min on this box (the single-process segfault threshold only
 	# bites the FULL suite, and xdist workers stay far under it)
@@ -24,9 +24,15 @@ test-fast:  ## harness-only tests (skip JAX model/runtime suites)
 	  --ignore=tests/test_quant.py
 
 lint:
-	$(PY) -m ruff check kserve_vllm_mini_tpu tests || true
+	$(PY) -m ruff check kserve_vllm_mini_tpu tests
 	$(PY) -c "import yaml,glob;[list(yaml.safe_load_all(open(f))) for f in glob.glob('profiles/**/*.yaml',recursive=True)+glob.glob('policies/**/*.yaml',recursive=True)]"
 	$(PY) -c "import json,glob;[json.load(open(f)) for f in glob.glob('dashboards/*.json')]"
+
+lint-invariants:  ## kvmini-lint: jit purity, lockstep determinism, metrics drift
+	# gates on lint-baseline.json: new findings fail, fixed-but-still-
+	# listed entries fail too (ratchet toward an empty baseline).
+	# Rule table: docs/LINTING.md. JAX-free; runs in ~5s.
+	$(PY) -m kserve_vllm_mini_tpu.lint kserve_vllm_mini_tpu/
 
 fmt:
 	$(PY) -m ruff format kserve_vllm_mini_tpu tests 2>/dev/null || true

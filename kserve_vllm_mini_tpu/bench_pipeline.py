@@ -187,8 +187,8 @@ def _run_stages(
         from kserve_vllm_mini_tpu.probes.net_storage import measure_http_rtt
 
         run_dir.write_io_probe(measure_http_rtt(url))
-    except Exception:
-        pass
+    except Exception:  # kvmini: workload-ok — optional probe; absence shows
+        pass           # up as missing network_rtt_* fields, not silence
 
     # Stage 3: analyze
     results = analyze_run(

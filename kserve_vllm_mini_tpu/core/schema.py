@@ -105,6 +105,16 @@ class Results:
     ttft_histogram: Optional[dict[str, Any]] = None
     token_timing: Optional[dict[str, Any]] = None
 
+    # decode-pipeline telemetry (docs/DECODE_PIPELINE.md): the runtime's
+    # double-buffering counters, scraped from /metrics (analysis/
+    # telemetry.py PIPELINE_METRIC_KEYS) or snapshotted directly in
+    # self-serve runs (bench_pipeline). Declared so gates/reports see
+    # typed fields instead of untyped extras.
+    pipeline_dispatch_depth: Optional[float] = None
+    pipeline_pipelined_sweeps: Optional[float] = None
+    pipeline_host_overlap_s: Optional[float] = None
+    pipeline_bubble_s: Optional[float] = None
+
     # server-side phase attribution (docs/TRACING.md): per-phase duration
     # stats from the runtime's /traces spans merged by the analyzer —
     # {"queue"|"prefill"|"decode": {count, mean_ms, p50_ms, p95_ms,

@@ -1,0 +1,35 @@
+"""kvmini-lint — AST-based invariant checker for the repo's load-bearing
+conventions (docs/LINTING.md "Conventions kvmini-lint enforces").
+
+Four checkers, all stdlib-``ast`` over a small cross-file fact index —
+deliberately JAX-free so the lint gate runs anywhere the harness layers
+do (same contract as loadgen/analysis: no ``runtime`` extra required):
+
+- **jit purity / static shapes** (KVM011-KVM015): no data-dependent
+  Python control flow, wall clocks, host randomness, or host syncs
+  inside code traced by ``jax.jit``/``pjit``/``shard_map`` — or, for
+  syncs, inside the host functions that dispatch jitted callables (the
+  decode hot path, where an unannotated sync silently serializes the
+  double-buffered pipeline, docs/DECODE_PIPELINE.md).
+- **lockstep determinism** (KVM021-KVM022): scheduler paths replayed by
+  runtime/multihost.py must route every state-advancing step through the
+  ``on_decision`` publisher and stay free of host-local nondeterminism
+  (wall-clock control flow, randomness, ``set`` iteration order).
+- **metrics/schema drift** (KVM031-KVM033): every engine stats counter
+  must reach ``/metrics``; every consumed/documented ``kvmini_tpu_*``
+  name must be emitted (and vice versa); every results.json key written
+  by the pipeline must exist in core/schema.py's ``Results``.
+- **workload-change surfacing** (KVM041): truncation / silent drops /
+  fallbacks in loadgen+runtime code must stamp a flag field the
+  analyzer reads (LINTING.md "don't hide workload changes").
+
+CLI: ``python -m kserve_vllm_mini_tpu.lint [paths...]`` — see __main__.py.
+Suppressions: ``# kvmini: <token>`` line comments (diagnostics.RULES maps
+each code to its token); a committed ``lint-baseline.json`` grandfathers
+pre-existing findings while new ones (and stale baseline entries) fail.
+"""
+
+from kserve_vllm_mini_tpu.lint.diagnostics import RULES, Diagnostic
+from kserve_vllm_mini_tpu.lint.runner import LintResult, run_lint
+
+__all__ = ["Diagnostic", "LintResult", "RULES", "run_lint"]

@@ -1,0 +1,114 @@
+"""CLI: ``python -m kserve_vllm_mini_tpu.lint [paths...]``.
+
+Defaults follow the repo layout so the CI/Makefile invocation stays one
+line: scan ``kserve_vllm_mini_tpu/``, read cross-surface docs from
+``./docs`` + ``./dashboards`` when present, gate against
+``./lint-baseline.json`` when present.
+
+Exit codes: 0 clean (vs baseline if one is in play); 1 new findings or
+stale baseline entries; 2 parse/usage errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+from kserve_vllm_mini_tpu.lint import baseline as baseline_mod
+from kserve_vllm_mini_tpu.lint.runner import run_lint
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m kserve_vllm_mini_tpu.lint",
+        description="kvmini-lint: AST invariant checker (jit purity, "
+                    "lockstep determinism, metrics/schema drift, workload "
+                    "surfacing). See docs/LINTING.md for the rule table.",
+    )
+    ap.add_argument("paths", nargs="*", type=Path,
+                    help="files/dirs to lint (default: kserve_vllm_mini_tpu/)")
+    ap.add_argument("--docs", type=Path, action="append", default=None,
+                    help="extra docs/dashboards surfaces for the drift "
+                         "checker (default: ./docs, ./dashboards if present)")
+    ap.add_argument("--baseline", type=Path, default=None,
+                    help="baseline file (default: ./lint-baseline.json if "
+                         "present)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore any baseline; report every finding")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="rewrite the baseline from current findings and exit 0")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    args = ap.parse_args(argv)
+
+    paths = args.paths or [Path("kserve_vllm_mini_tpu")]
+    missing = [p for p in paths if not p.exists()]
+    if missing:
+        print(f"kvmini-lint: no such path: {missing[0]}", file=sys.stderr)
+        return 2
+
+    docs = args.docs
+    if docs is None:
+        docs = [p for p in (Path("docs"), Path("dashboards")) if p.is_dir()]
+
+    baseline_path = None
+    if not args.no_baseline and not args.write_baseline:
+        baseline_path = args.baseline or Path("lint-baseline.json")
+
+    t0 = time.monotonic()
+    result = run_lint(paths, doc_paths=docs, baseline_path=baseline_path)
+    dt = time.monotonic() - t0
+
+    if args.write_baseline:
+        if result.parse_errors:
+            # a baseline written over unparsable files would be silently
+            # missing their findings — refuse and surface the errors
+            for path, line, msg in result.parse_errors:
+                print(f"{path}:{line}: KVM000 parse error: {msg}",
+                      file=sys.stderr)
+            print("kvmini-lint: refusing to write a baseline with parse "
+                  "errors", file=sys.stderr)
+            return 2
+        out = args.baseline or Path("lint-baseline.json")
+        baseline_mod.save(out, result.diagnostics)
+        print(f"kvmini-lint: wrote {out} "
+              f"({len(result.diagnostics)} findings, {dt:.2f}s)")
+        return 0
+
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [
+                {"path": d.path, "line": d.line, "code": d.code,
+                 "message": d.message, "context": d.context}
+                for d in result.diagnostics
+            ],
+            "gating": [d.render() for d in result.gating],
+            "stale_baseline": (result.baseline_diff.stale
+                               if result.baseline_diff else []),
+            "parse_errors": [list(e) for e in result.parse_errors],
+            "elapsed_s": round(dt, 3),
+        }, indent=2))
+        return result.exit_code
+
+    for path, line, msg in result.parse_errors:
+        print(f"{path}:{line}: KVM000 parse error: {msg}")
+    for d in result.gating:
+        print(d.render())
+    if result.baseline_diff is not None:
+        bd = result.baseline_diff
+        for key in bd.stale:
+            print(f"stale baseline entry (fixed — shrink lint-baseline.json "
+                  f"with --write-baseline): {key}")
+        status = "clean" if bd.clean else (
+            f"{len(bd.new)} new, {len(bd.stale)} stale")
+        print(f"kvmini-lint: {status} vs baseline "
+              f"({bd.suppressed} grandfathered, {dt:.2f}s)")
+    else:
+        print(f"kvmini-lint: {len(result.diagnostics)} findings ({dt:.2f}s)")
+    return result.exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

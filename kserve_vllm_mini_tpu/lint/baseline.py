@@ -1,0 +1,82 @@
+"""Committed-baseline support (ratchet semantics).
+
+``lint-baseline.json`` maps stable finding keys (``path::code::context``
+— no line numbers, so unrelated edits don't churn it) to occurrence
+counts. The gate:
+
+- a finding whose key count exceeds the baseline → **new**, fails;
+- a baseline entry with no matching finding anymore → **stale**, also
+  fails (the fix landed; shrink the baseline — that's the ratchet
+  pushing toward empty, ISSUE satellite #1).
+
+``--write-baseline`` regenerates the file from the current findings.
+"""
+
+from __future__ import annotations
+
+import json
+from collections import Counter
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from kserve_vllm_mini_tpu.lint.diagnostics import Diagnostic
+
+BASELINE_VERSION = 1
+
+
+def counts(diags: list[Diagnostic]) -> dict[str, int]:
+    return dict(Counter(d.baseline_key() for d in diags))
+
+
+def load(path: Path) -> dict[str, int]:
+    doc = json.loads(path.read_text(encoding="utf-8"))
+    if doc.get("version") != BASELINE_VERSION:
+        raise ValueError(
+            f"{path}: unsupported baseline version {doc.get('version')!r}")
+    findings = doc.get("findings", {})
+    if not isinstance(findings, dict):
+        raise ValueError(f"{path}: 'findings' must be an object")
+    return {str(k): int(v) for k, v in findings.items()}
+
+
+def save(path: Path, diags: list[Diagnostic]) -> None:
+    doc = {
+        "version": BASELINE_VERSION,
+        "tool": "kvmini-lint",
+        "findings": dict(sorted(counts(diags).items())),
+    }
+    path.write_text(json.dumps(doc, indent=2, sort_keys=False) + "\n",
+                    encoding="utf-8")
+
+
+@dataclass
+class BaselineDiff:
+    new: list[Diagnostic] = field(default_factory=list)
+    stale: list[str] = field(default_factory=list)      # baseline keys gone
+    suppressed: int = 0                                  # grandfathered count
+
+    @property
+    def clean(self) -> bool:
+        return not self.new and not self.stale
+
+
+def diff(diags: list[Diagnostic], baseline: dict[str, int]) -> BaselineDiff:
+    out = BaselineDiff()
+    cur = counts(diags)
+    for key, n in sorted(baseline.items()):
+        if cur.get(key, 0) < n:
+            # fully fixed or partially shrunk: either way the committed
+            # count is stale and must be re-recorded (ratchet down)
+            out.stale.append(key)
+    # grandfather up to the recorded count per key (first occurrences in
+    # file/line order); only the EXCESS is new — a third same-key finding
+    # must not repaint the two pre-existing ones as regressions
+    budget = dict(baseline)
+    for d in sorted(diags, key=lambda d: (d.path, d.line, d.code)):
+        key = d.baseline_key()
+        if budget.get(key, 0) > 0:
+            budget[key] -= 1
+            out.suppressed += 1
+        else:
+            out.new.append(d)
+    return out

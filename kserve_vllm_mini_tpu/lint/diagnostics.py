@@ -1,0 +1,136 @@
+"""Diagnostic records, the rule table, and suppression-comment handling.
+
+A diagnostic renders as ``path:line: KVM0xx message`` (the format the
+Makefile/CI gate greps). Baseline identity deliberately excludes the line
+number — findings keyed ``path::code::context`` survive unrelated edits
+above them, so the committed lint-baseline.json doesn't churn.
+
+Suppressions are ``# kvmini: <token>`` comments on the flagged line or
+the line directly above it. Tokens are per-rule-family (RULES); a
+comment that never matched a firing rule is itself a finding (KVM001),
+so stale annotations can't accumulate — the same hygiene the baseline
+gets from its stale-entry check.
+"""
+
+from __future__ import annotations
+
+import io
+import re
+import tokenize
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class Rule:
+    code: str
+    name: str
+    suppression: str  # the `# kvmini: <token>` that silences it
+    summary: str
+
+
+RULES: dict[str, Rule] = {
+    r.code: r
+    for r in [
+        Rule("KVM001", "stale-suppression", "",
+             "a `# kvmini:` suppression comment that silences nothing"),
+        Rule("KVM011", "jit-data-dependent-if", "static-shape",
+             "data-dependent Python `if` on a traced value inside jitted code"),
+        Rule("KVM012", "jit-data-dependent-loop", "static-shape",
+             "data-dependent Python loop over a traced value inside jitted code"),
+        Rule("KVM013", "jit-wall-clock", "sync-ok",
+             "wall-clock read inside jitted code (baked in at trace time)"),
+        Rule("KVM014", "jit-host-randomness", "sync-ok",
+             "host randomness / nondeterministically-seeded PRNGKey in jitted code"),
+        Rule("KVM015", "host-sync", "sync-ok",
+             "host sync (.item()/float()/np.asarray/device_get) in jitted code "
+             "or a jit-dispatch hot path"),
+        Rule("KVM021", "lockstep-unpublished-mutation", "lockstep-ok",
+             "state-advancing call in a lockstep scheduler path not routed "
+             "through the on_decision publisher"),
+        Rule("KVM022", "lockstep-nondeterminism", "lockstep-ok",
+             "nondeterminism source (wall-clock control flow, randomness, "
+             "set iteration) in lockstep-replayed code"),
+        Rule("KVM031", "stats-key-unexposed", "metrics-ok",
+             "engine stats counter never exported on /metrics"),
+        Rule("KVM032", "metric-name-drift", "metrics-ok",
+             "kvmini_tpu_* name consumed/documented but never emitted, or "
+             "emitted but never documented"),
+        Rule("KVM033", "results-key-not-in-schema", "metrics-ok",
+             "results.json key written that core/schema.py Results doesn't declare"),
+        Rule("KVM041", "workload-change-unsurfaced", "workload-ok",
+             "truncation/drop/fallback that doesn't stamp a flag field the "
+             "analyzer reads"),
+    ]
+}
+
+SUPPRESSION_TOKENS = sorted({r.suppression for r in RULES.values() if r.suppression})
+
+# `kvmini:` may share the comment with other markers (`# noqa: ... kvmini: ...`)
+_KVMINI_COMMENT = re.compile(r"#.*?kvmini:\s*([\w, -]+)")
+
+
+@dataclass(frozen=True)
+class Diagnostic:
+    path: str  # repo-relative, forward slashes
+    line: int
+    code: str
+    message: str
+    context: str = ""  # enclosing qualname / key name — the baseline anchor
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.code} {self.message}"
+
+    def baseline_key(self) -> str:
+        return f"{self.path}::{self.code}::{self.context or self.message}"
+
+
+@dataclass
+class Suppressions:
+    """Per-file `# kvmini:` comment map, with usage tracking."""
+
+    by_line: dict[int, set[str]] = field(default_factory=dict)
+    used: set[int] = field(default_factory=set)
+
+    @classmethod
+    def scan(cls, source: str) -> "Suppressions":
+        sup = cls()
+        try:
+            tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = _KVMINI_COMMENT.search(tok.string)
+                if not m:
+                    continue
+                toks = {t.strip() for t in m.group(1).split(",") if t.strip()}
+                sup.by_line.setdefault(tok.start[0], set()).update(toks)
+        except tokenize.TokenError:
+            pass  # syntax-broken file; the parse error is reported elsewhere
+        return sup
+
+    def is_suppressed(self, line: int, code: str) -> bool:
+        token = RULES[code].suppression
+        if not token:
+            return False
+        for cand in (line, line - 1):
+            if token in self.by_line.get(cand, set()):
+                self.used.add(cand)
+                return True
+        return False
+
+    def stale(self, path: str) -> list[Diagnostic]:
+        """KVM001 for comments that suppressed nothing in this run."""
+        out = []
+        for line, toks in sorted(self.by_line.items()):
+            known = toks & set(SUPPRESSION_TOKENS)
+            if known and line not in self.used:
+                out.append(Diagnostic(
+                    path, line, "KVM001",
+                    f"stale suppression `# kvmini: {', '.join(sorted(known))}` "
+                    "— no rule fires here; delete it",
+                    # token-only context: line numbers would churn the
+                    # baseline key (same-token stale comments share a key,
+                    # disambiguated by the per-key count)
+                    context=",".join(sorted(known)),
+                ))
+        return out

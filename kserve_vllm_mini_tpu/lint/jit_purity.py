@@ -1,0 +1,398 @@
+"""KVM011-KVM015 — jit purity, static shapes, and host-sync hygiene.
+
+Scope is computed from the fact index, not from file names:
+
+- **jit-traced code**: every jit root (``@jax.jit``/``@partial(jax.jit)``
+  inner defs, ``jax.jit(fn)`` wrap sites, ``shard_map``/``pjit``) plus
+  everything reachable from a root's body through the resolved call
+  graph. A cross-function *taint* pass tracks which parameters carry
+  traced values: root params are tainted (minus ``static_argnums`` /
+  ``static_argnames``), and a callee's param is tainted only when some
+  observed callsite passes it a tainted expression — so Python-static
+  trace branches like ``forward(..., fresh_prefill=True)`` stay legal,
+  exactly the convention docs/LINTING.md promises.
+- **jit-dispatch code** (KVM015 only): host functions that *call* a
+  compiled callable (the decode hot path). An unannotated
+  ``jax.device_get``/``.item()``/``.tolist()`` there is a silent
+  pipeline stall (docs/DECODE_PIPELINE.md); intended sync points carry
+  ``# kvmini: sync-ok``.
+
+Shape/structure reads are exempt from taint (``.shape``/``.ndim``/
+``.dtype``, ``len()``, ``isinstance``, ``is None`` checks): they are
+static under trace. Plain iteration over a traced pytree is likewise
+static structure; only ``while <traced>`` and ``for _ in range(<traced>)``
+are data-dependent loops.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from kserve_vllm_mini_tpu.lint.diagnostics import Diagnostic
+from kserve_vllm_mini_tpu.lint.facts import (
+    FactIndex,
+    FunctionInfo,
+    ModuleFacts,
+    _last_attr,
+    iter_scope,
+)
+
+SHAPE_EXEMPT_ATTRS = {"shape", "ndim", "dtype", "size", "sharding", "aval"}
+EXEMPT_CALLS = {"len", "isinstance", "hasattr", "getattr", "type"}
+WALL_CLOCK_ATTRS = {
+    "time", "time_ns", "monotonic", "monotonic_ns",
+    "perf_counter", "perf_counter_ns", "process_time",
+}
+DATETIME_ATTRS = {"now", "utcnow", "today"}
+SYNC_METHOD_ATTRS = {"item", "tolist"}
+
+
+def _module_alias_target(mod: ModuleFacts, name: str) -> Optional[str]:
+    t = mod.import_aliases.get(name)
+    if t is not None:
+        return t
+    fi = mod.from_imports.get(name)
+    if fi is not None:
+        return f"{fi[0]}.{fi[1]}" if fi[0] else fi[1]
+    return None
+
+
+def _is_wall_clock_call(mod: ModuleFacts, call: ast.Call) -> bool:
+    f = call.func
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+        base = _module_alias_target(mod, f.value.id) or f.value.id
+        if base == "time" and f.attr in WALL_CLOCK_ATTRS:
+            return True
+        if base.startswith("datetime") and f.attr in DATETIME_ATTRS:
+            return True
+    if isinstance(f, ast.Name):  # `from time import time` / `... as now`
+        fi = mod.from_imports.get(f.id)
+        if fi is not None:
+            src_mod, orig = fi
+            if src_mod == "time" and orig in WALL_CLOCK_ATTRS:
+                return True
+            if src_mod.startswith("datetime") and orig in DATETIME_ATTRS:
+                return True
+    return False
+
+
+def _is_host_random_call(mod: ModuleFacts, call: ast.Call) -> bool:
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        if isinstance(f.value, ast.Name):
+            base = _module_alias_target(mod, f.value.id) or f.value.id
+            if base == "random" or base == "uuid":
+                return True
+            if base == "os" and f.attr == "urandom":
+                return True
+        if (isinstance(f.value, ast.Attribute) and f.value.attr == "random"
+                and isinstance(f.value.value, ast.Name)):
+            base = _module_alias_target(mod, f.value.value.id) or f.value.value.id
+            if base == "numpy":  # np.random.*
+                return True
+    if isinstance(f, ast.Name):
+        fi = mod.from_imports.get(f.id)
+        if fi is not None and fi[0] == "random":
+            return True
+    return False
+
+
+def _is_numpy_materialize(mod: ModuleFacts, call: ast.Call) -> bool:
+    f = call.func
+    if (isinstance(f, ast.Attribute) and f.attr in {"asarray", "array"}
+            and isinstance(f.value, ast.Name)):
+        return (_module_alias_target(mod, f.value.id) or f.value.id) == "numpy"
+    return False
+
+
+def _is_device_get(mod: ModuleFacts, call: ast.Call) -> bool:
+    f = call.func
+    if isinstance(f, ast.Attribute) and f.attr in {"device_get", "block_until_ready"}:
+        return True
+    if isinstance(f, ast.Name) and f.id == "device_get":
+        return True
+    return False
+
+
+class _Taint:
+    """Per-function local taint over names, seeded from tainted params."""
+
+    def __init__(self, tainted_names: set[str]):
+        self.names = set(tainted_names)
+
+    def expr(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Attribute) and node.attr in SHAPE_EXEMPT_ATTRS:
+            return False
+        if isinstance(node, ast.Call):
+            fname = _last_attr(node.func)
+            if fname in EXEMPT_CALLS:
+                return False
+            # is_quantized(w) / has_lora(p): structure predicates over a
+            # pytree are trace-static, same as `.shape` or key membership
+            if fname and (fname.startswith("is_") or fname.startswith("has_")):
+                return False
+        if isinstance(node, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+                return False
+            # `"k_s" in cache` — membership of an UNTRACED key in a traced
+            # pytree is structure, not data (static under trace)
+            if all(isinstance(op, (ast.In, ast.NotIn)) for op in node.ops) \
+                    and not self.expr(node.left):
+                return False
+        if isinstance(node, ast.Name):
+            return node.id in self.names
+        return any(self.expr(c) for c in ast.iter_child_nodes(node))
+
+    def assign(self, target: ast.AST, tainted: bool) -> None:
+        for n in ast.walk(target):
+            if isinstance(n, ast.Name):
+                if tainted:
+                    self.names.add(n.id)
+                else:
+                    self.names.discard(n.id)
+
+
+class JitPurityChecker:
+    def __init__(self, index: FactIndex):
+        self.index = index
+        self.diags: list[Diagnostic] = []
+        # (path, qualname) -> set of tainted param names (monotonic)
+        self.tainted_params: dict[tuple[str, str], set[str]] = {}
+        self.reachable: set[tuple[str, str]] = set()
+
+    # -- scope construction -------------------------------------------------
+    def _seed_roots(self) -> list[FunctionInfo]:
+        roots = []
+        for fn in self.index.functions():
+            if not fn.jit_root:
+                continue
+            tainted = set()
+            for i, p in enumerate(fn.params):
+                if p in ("self", "cls") or p in fn.annotated_static:
+                    continue
+                if p in fn.static_argnames or i in fn.static_argnums:
+                    continue
+                tainted.add(p)
+            self.tainted_params[fn.key()] = tainted
+            self.reachable.add(fn.key())
+            roots.append(fn)
+        return roots
+
+    def _propagate(self) -> None:
+        """Fixpoint: push taint through resolved callsites."""
+        for _ in range(12):
+            changed = False
+            for key in list(self.reachable):
+                path, qual = key
+                mod = self.index.modules[path]
+                fn = mod.functions[qual]
+                local = self._local_taint(mod, fn)
+                for cs in self.index.call_sites(mod, fn):
+                    # fns passed as values (`lax.scan(body, ...)`) are traced
+                    # when invoked: reachable, params conservatively traced
+                    for arg in list(cs.node.args) + [
+                            kw.value for kw in cs.node.keywords]:
+                        if not isinstance(arg, ast.Name):
+                            continue
+                        for hof in self.index._resolve_name(mod, fn, arg.id):
+                            hk = hof.key()
+                            taints = self.tainted_params.setdefault(hk, set())
+                            want = {p for p in hof.params
+                                    if p not in ("self", "cls")
+                                    and p not in hof.annotated_static}
+                            if hk not in self.reachable or not want <= taints:
+                                self.reachable.add(hk)
+                                taints |= want
+                                changed = True
+                    for callee in cs.callees:
+                        ck = callee.key()
+                        prev = self.tainted_params.setdefault(ck, set())
+                        if ck not in self.reachable:
+                            self.reachable.add(ck)
+                            changed = True
+                        params = callee.params
+                        offset = 1 if params[:1] in (["self"], ["cls"]) and (
+                            isinstance(cs.node.func, ast.Attribute)
+                        ) else 0
+                        for i, arg in enumerate(cs.node.args):
+                            if isinstance(arg, ast.Starred):
+                                continue
+                            pi = i + offset
+                            if (pi < len(params) and local.expr(arg)
+                                    and params[pi] not in callee.annotated_static):
+                                if params[pi] not in prev:
+                                    prev.add(params[pi])
+                                    changed = True
+                        for kw in cs.node.keywords:
+                            if (kw.arg and kw.arg in params
+                                    and kw.arg not in callee.annotated_static
+                                    and local.expr(kw.value)):
+                                if kw.arg not in prev:
+                                    prev.add(kw.arg)
+                                    changed = True
+            if not changed:
+                return
+
+    def _local_taint(self, mod: ModuleFacts, fn: FunctionInfo) -> _Taint:
+        t = _Taint(self.tainted_params.get(fn.key(), set()))
+        # two passes so names assigned late still taint early reads in loops
+        for _ in range(2):
+            for node in iter_scope(fn.node):
+                if isinstance(node, ast.Assign):
+                    tainted = t.expr(node.value)
+                    for tgt in node.targets:
+                        t.assign(tgt, tainted)
+                elif isinstance(node, ast.AugAssign):
+                    if t.expr(node.value):
+                        t.assign(node.target, True)
+                elif isinstance(node, (ast.For, ast.AsyncFor)):
+                    t.assign(node.target, t.expr(node.iter))
+        return t
+
+    # -- checks -------------------------------------------------------------
+    def run(self) -> list[Diagnostic]:
+        self._seed_roots()
+        self._propagate()
+        for key in sorted(self.reachable):
+            path, qual = key
+            mod = self.index.modules[path]
+            self._check_traced_fn(mod, mod.functions[qual])
+        self._check_dispatch_fns()
+        return self.diags
+
+    def _emit(self, mod: ModuleFacts, node: ast.AST, code: str, msg: str,
+              context: str) -> None:
+        line = getattr(node, "lineno", 0)
+        if mod.suppressions.is_suppressed(line, code):
+            return
+        self.diags.append(Diagnostic(mod.path, line, code, msg, context=context))
+
+    def _check_traced_fn(self, mod: ModuleFacts, fn: FunctionInfo) -> None:
+        taint = self._local_taint(mod, fn)
+        ctx = fn.qualname
+        for node in iter_scope(fn.node):
+            if isinstance(node, ast.If) and taint.expr(node.test):
+                self._emit(
+                    mod, node, "KVM011",
+                    f"data-dependent `if` on a traced value inside jitted "
+                    f"`{fn.name}` — use lax.cond / jnp.where, or mark the "
+                    "branch `# kvmini: static-shape` if it is trace-static",
+                    ctx)
+            elif isinstance(node, ast.While) and taint.expr(node.test):
+                self._emit(
+                    mod, node, "KVM012",
+                    f"data-dependent `while` in jitted `{fn.name}` — use "
+                    "lax.while_loop, or mark `# kvmini: static-shape`",
+                    ctx)
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                it = node.iter
+                if (isinstance(it, ast.Call) and _last_attr(it.func)
+                        in {"range", "arange"}
+                        and any(taint.expr(a) for a in it.args)):
+                    self._emit(
+                        mod, node, "KVM012",
+                        f"loop bound depends on a traced value in jitted "
+                        f"`{fn.name}` — use lax.scan/fori_loop, or mark "
+                        "`# kvmini: static-shape`",
+                        ctx)
+            elif isinstance(node, ast.Call):
+                self._check_traced_call(mod, fn, taint, node, ctx)
+
+    def _check_traced_call(self, mod: ModuleFacts, fn: FunctionInfo,
+                           taint: _Taint, node: ast.Call, ctx: str) -> None:
+        if _is_wall_clock_call(mod, node):
+            self._emit(
+                mod, node, "KVM013",
+                f"wall-clock read inside jitted `{fn.name}` is baked in at "
+                "trace time (every retrace changes it; lockstep replicas "
+                "disagree) — pass times in as operands",
+                ctx)
+            return
+        if _is_host_random_call(mod, node):
+            self._emit(
+                mod, node, "KVM014",
+                f"host randomness inside jitted `{fn.name}` — thread a "
+                "jax.random key through the call instead",
+                ctx)
+            return
+        if _last_attr(node.func) == "PRNGKey":
+            for sub in node.args:
+                for inner in ast.walk(sub):
+                    if isinstance(inner, ast.Call) and (
+                        _is_wall_clock_call(mod, inner)
+                        or _is_host_random_call(mod, inner)
+                    ):
+                        self._emit(
+                            mod, node, "KVM014",
+                            f"PRNGKey seeded from a nondeterministic source "
+                            f"in `{fn.name}` — seeds must be explicit "
+                            "operands (lockstep replicas must agree)",
+                            ctx)
+                        return
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in SYNC_METHOD_ATTRS:
+            self._emit(
+                mod, node, "KVM015",
+                f".{f.attr}() inside jitted `{fn.name}` forces a host sync "
+                "(concretizes the tracer) — keep the value on device, or "
+                "mark `# kvmini: sync-ok`",
+                ctx)
+        elif _is_numpy_materialize(mod, node) or _is_device_get(mod, node):
+            self._emit(
+                mod, node, "KVM015",
+                f"host materialization inside jitted `{fn.name}` — use "
+                "jnp on device, or mark `# kvmini: sync-ok`",
+                ctx)
+        elif (isinstance(f, ast.Name) and f.id in {"float", "int", "bool"}
+              and node.args and taint.expr(node.args[0])):
+            self._emit(
+                mod, node, "KVM015",
+                f"{f.id}() of a traced value inside jitted `{fn.name}` "
+                "forces a host sync — keep it a jnp scalar, or mark "
+                "`# kvmini: sync-ok`",
+                ctx)
+
+    # -- dispatch hot path --------------------------------------------------
+    def _check_dispatch_fns(self) -> None:
+        for mod in self.modules_with_jit():
+            for fn in mod.functions.values():
+                if fn.key() in self.reachable:
+                    continue
+                sites = [
+                    n for n in iter_scope(fn.node)
+                    if isinstance(n, ast.Call)
+                    and self.index.calls_jitted_value(mod, fn, n)
+                ]
+                if not sites:
+                    continue
+                for node in iter_scope(fn.node):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    f = node.func
+                    is_sync = (
+                        (isinstance(f, ast.Attribute)
+                         and f.attr in SYNC_METHOD_ATTRS)
+                        or _is_device_get(mod, node)
+                    )
+                    if is_sync:
+                        name = (f.attr if isinstance(f, ast.Attribute)
+                                else "device_get")
+                        self._emit(
+                            mod, node, "KVM015",
+                            f"host sync `{name}` in jit-dispatch function "
+                            f"`{fn.name}` stalls the decode pipeline — move "
+                            "it after dispatch, or mark the intended sync "
+                            "point `# kvmini: sync-ok`",
+                            fn.qualname)
+
+    def modules_with_jit(self) -> list[ModuleFacts]:
+        return [
+            m for m in self.index.modules.values()
+            if m.jitted_names or m.jitted_attrs
+            or any(fn.jit_root or fn.returns_jitted for fn in m.functions.values())
+        ]
+
+
+def check(index: FactIndex) -> list[Diagnostic]:
+    return JitPurityChecker(index).run()

@@ -1,0 +1,226 @@
+"""KVM021-KVM022 — lockstep determinism for multihost decision replay.
+
+runtime/multihost.py's contract: the primary runs the scheduler
+(`_schedule_once(on_decision=publish)`) and **publishes every
+state-advancing decision before executing it**; followers replay the
+identical stream. Two statically checkable hazards follow (the MLPerf
+pod-scale failure mode — divergence discovered hundreds of steps later):
+
+- **KVM021**: inside any function that takes an ``on_decision``
+  parameter (the publisher-threaded scheduler paths), a call to a
+  state-advancing engine method — the set the follower replays, learned
+  from the fact index's ``run_follower`` scan, plus the conventional
+  ``_admit*/_dispatch*/_retire*/_finish*/_cancel*`` prefixes — must be
+  *routed*: the same statement block must reference ``on_decision``
+  (publishing the decision, or forwarding the callback down).
+- **KVM022**: in the replayed methods themselves (what both primary and
+  followers execute) plus the publisher-threaded paths: no
+  wall-clock-derived control flow, no host randomness, no bare ``set``
+  iteration (arbitrary order ⇒ divergent slot choices). ``sorted(...)``
+  over a set is the blessed fix and is exempt.
+
+Suppress a deliberate host-local step with ``# kvmini: lockstep-ok``
+(e.g. stats bookkeeping that followers intentionally skip).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Optional
+
+from kserve_vllm_mini_tpu.lint.diagnostics import Diagnostic
+from kserve_vllm_mini_tpu.lint.facts import (
+    FactIndex,
+    FunctionInfo,
+    ModuleFacts,
+    iter_scope,
+)
+from kserve_vllm_mini_tpu.lint.jit_purity import (
+    _is_host_random_call,
+    _is_wall_clock_call,
+)
+
+STATE_ADVANCING_PREFIX = re.compile(
+    r"^_(admit|dispatch|retire|finish|cancel|decode_sweep|replay|fail)"
+)
+PUBLISHER_PARAM = "on_decision"
+
+
+def _references_name(node: ast.AST, name: str) -> bool:
+    return any(
+        isinstance(n, ast.Name) and n.id == name for n in ast.walk(node)
+    )
+
+
+class _BlockMap(ast.NodeVisitor):
+    """Maps every statement to the statement list (block) containing it."""
+
+    def __init__(self) -> None:
+        self.block_of: dict[ast.AST, list[ast.stmt]] = {}
+        self.stmt_of: dict[ast.AST, ast.stmt] = {}
+
+    def index(self, fn_node: ast.AST) -> None:
+        # ast.walk is breadth-first, so deeper blocks are visited later:
+        # plain assignment (not setdefault) leaves each node mapped to its
+        # INNERMOST enclosing statement — with setdefault every node maps
+        # to its outermost top-level statement and the "same block" check
+        # degenerates to the whole function body (vacuously routed)
+        for node in ast.walk(fn_node):
+            for fname in ("body", "orelse", "finalbody"):
+                block = getattr(node, fname, None)
+                if isinstance(block, list) and block and isinstance(
+                        block[0], ast.stmt):
+                    for stmt in block:
+                        self.block_of[stmt] = block
+                        for sub in ast.walk(stmt):
+                            self.stmt_of[sub] = stmt
+
+
+class LockstepChecker:
+    def __init__(self, index: FactIndex):
+        self.index = index
+        self.diags: list[Diagnostic] = []
+        self.replayed = index.follower_replayed_methods()
+
+    def run(self) -> list[Diagnostic]:
+        publisher_fns = [
+            (mod, fn)
+            for mod in self.index.modules.values()
+            for fn in mod.functions.values()
+            if PUBLISHER_PARAM in fn.params
+        ]
+        for mod, fn in publisher_fns:
+            self._check_routing(mod, fn)
+            self._check_determinism(mod, fn)
+        for mod, fn in self._replayed_scope(publisher_fns):
+            self._check_determinism(mod, fn)
+        return self.diags
+
+    def _replayed_scope(self, publisher_fns) -> list[tuple[ModuleFacts, FunctionInfo]]:
+        """Replayed methods + their same-module callees (both sides run
+        them), excluding the publisher fns already checked."""
+        done = {fn.key() for _, fn in publisher_fns}
+        out: list[tuple[ModuleFacts, FunctionInfo]] = []
+        work: list[tuple[ModuleFacts, FunctionInfo]] = []
+        for mod in self.index.modules.values():
+            for fn in mod.functions.values():
+                if fn.name in self.replayed and fn.class_name is not None:
+                    work.append((mod, fn))
+        seen = set(done)
+        while work:
+            mod, fn = work.pop()
+            if fn.key() in seen:
+                continue
+            seen.add(fn.key())
+            out.append((mod, fn))
+            for cs in self.index.call_sites(mod, fn):
+                for callee in cs.callees:
+                    if callee.path == mod.path and callee.key() not in seen:
+                        work.append((mod, callee))
+        return out
+
+    def _emit(self, mod: ModuleFacts, node: ast.AST, code: str, msg: str,
+              ctx: str) -> None:
+        line = getattr(node, "lineno", 0)
+        if mod.suppressions.is_suppressed(line, code):
+            return
+        self.diags.append(Diagnostic(mod.path, line, code, msg, context=ctx))
+
+    # -- KVM021 -------------------------------------------------------------
+    def _is_state_advancing(self, call: ast.Call) -> Optional[str]:
+        f = call.func
+        if (isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name)
+                and f.value.id == "self"):
+            if f.attr in self.replayed or STATE_ADVANCING_PREFIX.match(f.attr):
+                return f.attr
+        return None
+
+    def _check_routing(self, mod: ModuleFacts, fn: FunctionInfo) -> None:
+        blocks = _BlockMap()
+        blocks.index(fn.node)
+        for node in iter_scope(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            method = self._is_state_advancing(node)
+            if method is None:
+                continue
+            if _references_name(node, PUBLISHER_PARAM):
+                continue  # forwards the callback down — routed
+            stmt = blocks.stmt_of.get(node)
+            block = blocks.block_of.get(stmt, [])
+            if any(_references_name(s, PUBLISHER_PARAM) for s in block):
+                continue  # a publish lives in the same decision block
+            self._emit(
+                mod, node, "KVM021",
+                f"`self.{method}(...)` advances scheduler state in "
+                f"`{fn.name}` without publishing through {PUBLISHER_PARAM} "
+                "— followers replaying the decision stream will diverge; "
+                "publish in the same block or mark `# kvmini: lockstep-ok`",
+                fn.qualname)
+
+    # -- KVM022 -------------------------------------------------------------
+    def _check_determinism(self, mod: ModuleFacts, fn: FunctionInfo) -> None:
+        clock_names: set[str] = set()
+        set_names: set[str] = set()
+        for node in iter_scope(fn.node):
+            if isinstance(node, ast.Assign):
+                v = node.value
+                names = [t.id for t in node.targets if isinstance(t, ast.Name)]
+                if isinstance(v, ast.Call) and _is_wall_clock_call(mod, v):
+                    clock_names.update(names)
+                if (isinstance(v, (ast.Set, ast.SetComp))
+                        or (isinstance(v, ast.Call)
+                            and isinstance(v.func, ast.Name)
+                            and v.func.id in {"set", "frozenset"})):
+                    set_names.update(names)
+        for node in iter_scope(fn.node):
+            if isinstance(node, ast.Call) and _is_host_random_call(mod, node):
+                self._emit(
+                    mod, node, "KVM022",
+                    f"host randomness in lockstep-replayed `{fn.name}` — "
+                    "primary and followers draw different values; derive "
+                    "from the shared engine seed or mark "
+                    "`# kvmini: lockstep-ok`",
+                    fn.qualname)
+            elif isinstance(node, (ast.If, ast.While)):
+                # only clock values COMPARED in the test steer control flow;
+                # a timestamp passed through as a call argument (stats,
+                # span bookkeeping) is host-local and harmless
+                hits = [
+                    n
+                    for cmp_node in ast.walk(node.test)
+                    if isinstance(cmp_node, ast.Compare)
+                    for n in ast.walk(cmp_node)
+                    if (isinstance(n, ast.Name) and n.id in clock_names)
+                    or (isinstance(n, ast.Call) and _is_wall_clock_call(mod, n))
+                ]
+                if hits:
+                    self._emit(
+                        mod, node, "KVM022",
+                        f"wall-clock control flow in lockstep-replayed "
+                        f"`{fn.name}` — hosts read different clocks, so "
+                        "branches diverge; decide on the primary and "
+                        "publish, or mark `# kvmini: lockstep-ok`",
+                        fn.qualname)
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                it = node.iter
+                bare_set = (
+                    isinstance(it, (ast.Set, ast.SetComp))
+                    or (isinstance(it, ast.Call)
+                        and isinstance(it.func, ast.Name)
+                        and it.func.id in {"set", "frozenset"})
+                    or (isinstance(it, ast.Name) and it.id in set_names)
+                )
+                if bare_set:
+                    self._emit(
+                        mod, node, "KVM022",
+                        f"iteration over a `set` in lockstep-replayed "
+                        f"`{fn.name}` — arbitrary order diverges across "
+                        "hosts; wrap in sorted(...) or mark "
+                        "`# kvmini: lockstep-ok`",
+                        fn.qualname)
+
+
+def check(index: FactIndex) -> list[Diagnostic]:
+    return LockstepChecker(index).run()

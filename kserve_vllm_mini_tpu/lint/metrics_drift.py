@@ -1,0 +1,257 @@
+"""KVM031-KVM033 — metrics/schema drift across the four telemetry surfaces.
+
+The same counter exists (or silently doesn't) in four places: the
+engine's ``self.stats``/``snapshot_stats`` dict, the ``/metrics``
+Prometheus exposition, the analysis layer's scrape mappings, and the
+documentation (docs/*.md + dashboards/*.json promql). The energy/
+serving-efficiency methodology (docs/ENERGY_METHOD.md, PAPERS.md) is
+only as truthful as these stay aligned — so drift is a lint failure,
+not a code-review hope.
+
+Surface extraction (all static, all generic over the fact index):
+
+- **stats keys**: string keys of a dict literal assigned to an attribute
+  named ``stats``, plus string-subscript assignments inside a function
+  named ``snapshot_stats`` (the derived gauges).
+- **exposition**: f-strings whose first literal chunk matches
+  ``kvmini_tpu_<name>`` — the formatted ``s['key']`` subscripts inside
+  give the (metric, stats-key) pairing. Any string constant in an
+  *emitter* module (``runtime/``) naming a full metric also counts as
+  emitted (histogram family bases in runtime/tracing.py).
+- **consumers**: every ``kvmini_tpu_*`` token in string constants of
+  *consumer* modules (``analysis/`` et al), docs markdown, and
+  dashboards JSON.
+- **results keys**: dict-literal keys passed to ``merge_into_results``
+  and the string *values* of metric→results mapping dicts, checked
+  against the ``Results`` dataclass fields in core/schema.py.
+
+Checks: KVM031 stats key never exported; KVM032 name consumed or
+documented but never emitted / emitted but never documented; KVM033
+results key not declared in the schema. Suppress deliberate internals
+(raw inputs like ``busy_s`` whose exposition is a derived gauge) with
+``# kvmini: metrics-ok`` on the key's line.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Optional
+
+from kserve_vllm_mini_tpu.lint.diagnostics import Diagnostic
+from kserve_vllm_mini_tpu.lint.facts import FactIndex, ModuleFacts
+
+METRIC_TOKEN = re.compile(r"kvmini_tpu_\w+")
+EXPOSITION_PREFIX = re.compile(r"^(?:#\s*(?:TYPE|HELP)\s+)?(kvmini_tpu_\w+)")
+EMITTER_PATH = re.compile(r"(^|/)runtime/")
+CONSUMER_PATH = re.compile(
+    r"(^|/)(analysis|loadgen|probes|energy|compare|gates|report|costs)/"
+)
+HISTOGRAM_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+@dataclass
+class Surfaces:
+    # metric name -> (path, line) of first sighting per surface
+    emitted: dict[str, tuple[str, int]] = field(default_factory=dict)
+    consumed: dict[str, tuple[str, int]] = field(default_factory=dict)
+    documented: dict[str, tuple[str, int]] = field(default_factory=dict)
+    # stats dict: key -> (path, line)
+    stats_keys: dict[str, tuple[str, int]] = field(default_factory=dict)
+    # stats keys referenced by exposition f-strings
+    exposed_keys: set[str] = field(default_factory=set)
+    # results.json writes: key -> (path, line)
+    results_keys: dict[str, tuple[str, int]] = field(default_factory=dict)
+    schema_fields: set[str] = field(default_factory=set)
+    has_schema: bool = False
+
+
+def _first_const(js: ast.JoinedStr) -> Optional[str]:
+    if js.values and isinstance(js.values[0], ast.Constant) and isinstance(
+            js.values[0].value, str):
+        return js.values[0].value
+    return None
+
+
+def _subscript_keys(node: ast.AST) -> list[str]:
+    out = []
+    for n in ast.walk(node):
+        if isinstance(n, ast.Subscript) and isinstance(n.slice, ast.Constant) \
+                and isinstance(n.slice.value, str):
+            out.append(n.slice.value)
+    return out
+
+
+def _docstring_nodes(tree: ast.Module) -> set[ast.AST]:
+    out: set[ast.AST] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Module, ast.ClassDef, ast.FunctionDef,
+                             ast.AsyncFunctionDef)):
+            body = node.body
+            if (body and isinstance(body[0], ast.Expr)
+                    and isinstance(body[0].value, ast.Constant)
+                    and isinstance(body[0].value.value, str)):
+                out.add(body[0].value)
+    return out
+
+
+def _collect_module(mod: ModuleFacts, s: Surfaces) -> None:
+    is_emitter = bool(EMITTER_PATH.search(mod.path))
+    is_consumer = bool(CONSUMER_PATH.search(mod.path))
+    docstrings = _docstring_nodes(mod.tree)
+    for node in ast.walk(mod.tree):
+        if node in docstrings:
+            continue  # prose examples aren't emitted/consumed names
+        # exposition f-strings pair metric <-> stats key wherever they live
+        if isinstance(node, ast.JoinedStr):
+            head = _first_const(node)
+            m = EXPOSITION_PREFIX.match(head or "")
+            if m:
+                s.emitted.setdefault(m.group(1), (mod.path, node.lineno))
+                for key in _subscript_keys(node):
+                    s.exposed_keys.add(key)
+        elif isinstance(node, ast.Constant) and isinstance(node.value, str):
+            for tok in METRIC_TOKEN.findall(node.value):
+                if is_emitter:
+                    s.emitted.setdefault(tok, (mod.path, node.lineno))
+                elif is_consumer:
+                    s.consumed.setdefault(tok, (mod.path, node.lineno))
+        elif isinstance(node, ast.ClassDef) and node.name == "Results":
+            s.has_schema = True
+            for stmt in node.body:
+                if isinstance(stmt, ast.AnnAssign) and isinstance(
+                        stmt.target, ast.Name):
+                    s.schema_fields.add(stmt.target.id)
+        elif isinstance(node, ast.Assign):
+            _collect_stats_dict(mod, node, s)
+        elif isinstance(node, ast.Call):
+            _collect_merge_call(mod, node, s)
+        elif isinstance(node, ast.Dict):
+            _collect_mapping_dict(mod, node, s)
+    for fn in mod.functions.values():
+        if fn.name != "snapshot_stats":
+            continue
+        for node in ast.walk(fn.node):
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Subscript)):
+                sl = node.targets[0].slice
+                if isinstance(sl, ast.Constant) and isinstance(sl.value, str):
+                    s.stats_keys.setdefault(sl.value, (mod.path, node.lineno))
+
+
+def _collect_stats_dict(mod: ModuleFacts, node: ast.Assign, s: Surfaces) -> None:
+    for tgt in node.targets:
+        if isinstance(tgt, ast.Attribute) and tgt.attr == "stats" \
+                and isinstance(node.value, ast.Dict):
+            for k in node.value.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    s.stats_keys.setdefault(k.value, (mod.path, k.lineno))
+
+
+def _collect_merge_call(mod: ModuleFacts, node: ast.Call, s: Surfaces) -> None:
+    if not (isinstance(node.func, ast.Attribute)
+            and node.func.attr == "merge_into_results"):
+        return
+    for arg in node.args[:1]:
+        if isinstance(arg, ast.Dict):
+            for k in arg.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    s.results_keys.setdefault(k.value, (mod.path, k.lineno))
+
+
+def _collect_mapping_dict(mod: ModuleFacts, node: ast.Dict, s: Surfaces) -> None:
+    """PIPELINE_METRIC_KEYS-style dicts: kvmini_tpu_* keys -> results keys."""
+    keys = [k for k in node.keys if isinstance(k, ast.Constant)
+            and isinstance(k.value, str)]
+    if not keys or not all(METRIC_TOKEN.fullmatch(k.value) for k in keys):
+        return
+    for k, v in zip(node.keys, node.values):
+        if isinstance(v, ast.Constant) and isinstance(v.value, str):
+            s.results_keys.setdefault(v.value, (mod.path, v.lineno))
+
+
+def _scan_text_surface(path: str, text: str, into: dict[str, tuple[str, int]]) -> None:
+    for i, line in enumerate(text.splitlines(), start=1):
+        for tok in METRIC_TOKEN.findall(line):
+            into.setdefault(tok, (path, i))
+
+
+def _emitted_covers(name: str, emitted: set[str]) -> bool:
+    if name in emitted:
+        return True
+    for suf in HISTOGRAM_SUFFIXES:
+        if name.endswith(suf) and name[: -len(suf)] in emitted:
+            return True
+    return False
+
+
+def _documented_covers(name: str, documented: set[str]) -> bool:
+    if name in documented:
+        return True
+    # a histogram base counts as documented if any family member is
+    return any(name + suf in documented for suf in HISTOGRAM_SUFFIXES)
+
+
+def check(index: FactIndex,
+          doc_texts: Optional[dict[str, str]] = None) -> list[Diagnostic]:
+    s = Surfaces()
+    for mod in index.modules.values():
+        _collect_module(mod, s)
+    for path, text in (doc_texts or {}).items():
+        target = s.documented if path.endswith(".md") else s.consumed
+        _scan_text_surface(path, text, target)
+
+    diags: list[Diagnostic] = []
+
+    def emit(where: tuple[str, int], code: str, msg: str, ctx: str) -> None:
+        path, line = where
+        mod = index.modules.get(path)
+        if mod is not None and mod.suppressions.is_suppressed(line, code):
+            return
+        diags.append(Diagnostic(path, line, code, msg, context=ctx))
+
+    # KVM031 — every stats key must reach an exposition line
+    if s.emitted:  # only meaningful when an exposition surface exists
+        for key, where in sorted(s.stats_keys.items()):
+            if key not in s.exposed_keys:
+                emit(where, "KVM031",
+                     f"stats counter '{key}' is never exported on /metrics — "
+                     "operators can't see it; export it or mark the raw "
+                     "input `# kvmini: metrics-ok`",
+                     key)
+
+    # KVM032 — name-level drift between emitted / consumed / documented.
+    # Only meaningful when an exposition surface was scanned: a partial
+    # scan (one fixture dir, one subpackage) has no emitter to drift from.
+    emitted_names = set(s.emitted)
+    if emitted_names:
+        for name, where in sorted(s.consumed.items()):
+            if not _emitted_covers(name, emitted_names):
+                emit(where, "KVM032",
+                     f"'{name}' is consumed here but the runtime never emits "
+                     "it — the fallback silently yields nothing",
+                     name)
+        for name, where in sorted(s.documented.items()):
+            if not _emitted_covers(name, emitted_names):
+                emit(where, "KVM032",
+                     f"'{name}' is documented but the runtime never emits it",
+                     name)
+    if emitted_names and s.documented:  # docs present: require enumeration
+        for name, where in sorted(s.emitted.items()):
+            if not _documented_covers(name, set(s.documented)):
+                emit(where, "KVM032",
+                     f"'{name}' is emitted on /metrics but undocumented — "
+                     "add it to the docs/API.md metrics table",
+                     name)
+
+    # KVM033 — results.json writes must land on declared schema fields
+    if s.has_schema:
+        for key, where in sorted(s.results_keys.items()):
+            if key not in s.schema_fields:
+                emit(where, "KVM033",
+                     f"results.json key '{key}' is not declared in "
+                     "core/schema.py Results — it silently lands in extras, "
+                     "invisible to gates/reports typing",
+                     key)
+    return diags

@@ -1,0 +1,126 @@
+"""Orchestration: file discovery -> fact index -> checkers -> baseline gate."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Iterable, Optional
+
+from kserve_vllm_mini_tpu.lint import (
+    baseline as baseline_mod,
+    jit_purity,
+    lockstep,
+    metrics_drift,
+    workload,
+)
+from kserve_vllm_mini_tpu.lint.diagnostics import Diagnostic
+from kserve_vllm_mini_tpu.lint.facts import FactIndex
+
+EXCLUDED_DIR_NAMES = {"__pycache__", ".git", "node_modules", ".venv"}
+
+CHECKERS = (
+    jit_purity.check,
+    lockstep.check,
+    workload.check,
+)
+
+
+def discover_py_files(paths: Iterable[Path]) -> list[Path]:
+    out: list[Path] = []
+    for p in paths:
+        if p.is_dir():
+            for f in sorted(p.rglob("*.py")):
+                if not any(part in EXCLUDED_DIR_NAMES for part in f.parts):
+                    out.append(f)
+        elif p.suffix == ".py":
+            out.append(p)
+    return out
+
+
+def discover_doc_files(paths: Iterable[Path]) -> list[Path]:
+    out: list[Path] = []
+    for p in paths:
+        if p.is_dir():
+            out += sorted(p.rglob("*.md")) + sorted(p.rglob("*.json"))
+        elif p.suffix in {".md", ".json"}:
+            out.append(p)
+    return out
+
+
+@dataclass
+class LintResult:
+    diagnostics: list[Diagnostic] = field(default_factory=list)
+    parse_errors: list[tuple[str, int, str]] = field(default_factory=list)
+    baseline_diff: Optional[baseline_mod.BaselineDiff] = None
+
+    @property
+    def exit_code(self) -> int:
+        if self.parse_errors:
+            return 2
+        if self.baseline_diff is not None:
+            return 0 if self.baseline_diff.clean else 1
+        return 1 if self.diagnostics else 0
+
+    @property
+    def gating(self) -> list[Diagnostic]:
+        """The findings that actually fail the run."""
+        if self.baseline_diff is not None:
+            return self.baseline_diff.new
+        return self.diagnostics
+
+
+def _rel(root: Path, p: Path) -> Path:
+    try:
+        return p.resolve().relative_to(root.resolve())
+    except ValueError:
+        return p
+
+
+def run_lint(
+    paths: list[Path],
+    doc_paths: Optional[list[Path]] = None,
+    baseline_path: Optional[Path] = None,
+    root: Optional[Path] = None,
+) -> LintResult:
+    root = (root or Path.cwd()).resolve()
+    files = discover_py_files(paths)
+    index = FactIndex.build(root, [root / _rel(root, f) for f in files])
+
+    # cross-surface drift (KVM032 vs docs/dashboards) asserts over the
+    # WHOLE emitter set, so it only runs for directory scans — linting a
+    # single changed file must not fail on metrics that other (unscanned)
+    # emitter modules provide
+    full_scan = bool(paths) and all(p.is_dir() for p in paths)
+    doc_texts: dict[str, str] = {}
+    if full_scan:
+        for doc in discover_doc_files(doc_paths or []):
+            try:
+                doc_texts[_rel(root, doc).as_posix()] = doc.read_text(
+                    encoding="utf-8")
+            except OSError:
+                continue
+
+    diags: list[Diagnostic] = []
+    for checker in CHECKERS:
+        diags += checker(index)
+    diags += metrics_drift.check(index, doc_texts)
+
+    # stale `# kvmini:` comments — only after every rule had its chance
+    for mod in index.modules.values():
+        diags += mod.suppressions.stale(mod.path)
+
+    # nested defs are visited both standalone and inside their enclosing
+    # function's walk; report each site once
+    seen: set[tuple[str, int, str, str]] = set()
+    unique: list[Diagnostic] = []
+    for d in sorted(diags, key=lambda d: (d.path, d.line, d.code)):
+        k = (d.path, d.line, d.code, d.message)
+        if k not in seen:
+            seen.add(k)
+            unique.append(d)
+
+    result = LintResult(diagnostics=unique, parse_errors=index.parse_errors)
+    if baseline_path is not None and baseline_path.exists():
+        result.baseline_diff = baseline_mod.diff(
+            unique, baseline_mod.load(baseline_path))
+    return result

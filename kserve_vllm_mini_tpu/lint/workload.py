@@ -1,0 +1,174 @@
+"""KVM041 — workload changes must be surfaced, not absorbed.
+
+docs/LINTING.md: "anything that alters what a load test measures
+(truncation, drops, fallbacks) must be flagged in the request record and
+surfaced by the analyzer." The engine's prompt-cap truncation does this
+right (``req.truncated = True`` + ``truncated_tokens``); this rule keeps
+every future shortcut honest.
+
+Scope: loadgen/**, runtime/**, and bench_pipeline — the modules that
+stand between the configured workload and the measured one. Two
+patterns are flagged when the enclosing function stamps no flag:
+
+- **silent except-fallback**: a handler that swallows the exception and
+  degrades (``pass``/``continue``/return of a bare default) without a
+  surfacing write. Returning an error response / recording ``.error``
+  counts as surfaced.
+- **unflagged truncation**: rebinding a prompt/token-ish value to a
+  slice of itself (``toks = toks[:cap]``) with no truncation flag
+  written anywhere in the function.
+
+"Surfacing" = assigning an attribute/key matching the flag vocabulary
+(truncated/dropped/fallback/error/skipped...), bumping a stats counter,
+or calling a record/mark/warn/fail-style function. A deliberate
+absorb (e.g. best-effort cache warmup) takes ``# kvmini: workload-ok``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from kserve_vllm_mini_tpu.lint.diagnostics import Diagnostic
+from kserve_vllm_mini_tpu.lint.facts import (
+    FactIndex,
+    FunctionInfo,
+    ModuleFacts,
+    iter_scope,
+)
+
+SCOPE_PATH = re.compile(r"(^|/)(loadgen|runtime)/|(^|/)bench_pipeline\.py$")
+FLAG_NAME = re.compile(
+    r"truncat|dropp?ed|drop_|fallback|flag|error|fail|skip|ok\b|warn", re.I
+)
+SURFACING_CALL = re.compile(
+    r"record|mark|stamp|flag|warn|fail|abort|print|log", re.I
+)
+TRUNCATABLE_NAME = re.compile(r"tok|prompt|text|input|request|batch", re.I)
+# pure control-flow exceptions: catching one drops nothing from the workload
+CONTROL_FLOW_EXC = {
+    "Empty", "QueueEmpty", "Full", "StopIteration", "StopAsyncIteration",
+}
+# teardown runs outside the measured window; best-effort absorbs are fine
+TEARDOWN_FN = re.compile(r"^(close|aclose|stop|shutdown|__del__|__exit__|__aexit__)$")
+
+
+def _writes_flag(node: ast.AST) -> bool:
+    """Does this subtree surface a workload change?"""
+    for n in ast.walk(node):
+        if isinstance(n, (ast.Assign, ast.AugAssign)):
+            targets = n.targets if isinstance(n, ast.Assign) else [n.target]
+            for t in targets:
+                if isinstance(t, ast.Attribute) and FLAG_NAME.search(t.attr):
+                    return True
+                if isinstance(t, ast.Subscript):
+                    base = t.value
+                    if isinstance(base, ast.Attribute) and base.attr == "stats":
+                        return True
+                    sl = t.slice
+                    if (isinstance(sl, ast.Constant)
+                            and isinstance(sl.value, str)
+                            and FLAG_NAME.search(sl.value)):
+                        return True
+        elif isinstance(n, ast.Call):
+            f = n.func
+            name = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else "")
+            if name and SURFACING_CALL.search(name):
+                return True
+        elif isinstance(n, ast.Raise):
+            return True
+    return False
+
+
+def _is_bare_default_return(stmt: ast.Return) -> bool:
+    v = stmt.value
+    if v is None or isinstance(v, ast.Constant):
+        return True
+    if isinstance(v, (ast.Dict, ast.List, ast.Tuple, ast.Set)) and not (
+            getattr(v, "keys", None) or getattr(v, "elts", None)):
+        return True
+    return isinstance(v, ast.Name)
+
+
+def _exc_type_names(handler: ast.ExceptHandler) -> list[str]:
+    t = handler.type
+    parts = t.elts if isinstance(t, ast.Tuple) else ([t] if t else [])
+    out = []
+    for p in parts:
+        if isinstance(p, ast.Attribute):
+            out.append(p.attr)
+        elif isinstance(p, ast.Name):
+            out.append(p.id)
+    return out
+
+
+def _handler_degrades(handler: ast.ExceptHandler) -> bool:
+    """Swallows the exception AND changes what gets measured."""
+    names = _exc_type_names(handler)
+    if names and all(n in CONTROL_FLOW_EXC for n in names):
+        return False  # `except queue.Empty: break` — a drain idiom
+    for n in ast.walk(handler):
+        if isinstance(n, ast.Raise):
+            return False
+        # forwarding the caught exception anywhere (fut.set_exception(e),
+        # rec.error = str(e)) surfaces it
+        if (handler.name and isinstance(n, ast.Name) and n.id == handler.name
+                and isinstance(n.ctx, ast.Load)):
+            return False
+    for stmt in handler.body:
+        if isinstance(stmt, (ast.Pass, ast.Continue, ast.Break)):
+            return True
+        if isinstance(stmt, ast.Return) and _is_bare_default_return(stmt):
+            return True
+    return False
+
+
+def _check_function(mod: ModuleFacts, fn: FunctionInfo,
+                    diags: list[Diagnostic]) -> None:
+    if TEARDOWN_FN.match(fn.name):
+        return
+    fn_surfaces = _writes_flag(fn.node)
+
+    def emit(node: ast.AST, msg: str) -> None:
+        line = getattr(node, "lineno", 0)
+        if mod.suppressions.is_suppressed(line, "KVM041"):
+            return
+        diags.append(Diagnostic(mod.path, line, "KVM041", msg,
+                                context=fn.qualname))
+
+    for node in iter_scope(fn.node):
+        if isinstance(node, ast.ExceptHandler):
+            if _handler_degrades(node) and not _writes_flag(node):
+                emit(node,
+                     f"silent except-fallback in `{fn.name}` changes the "
+                     "measured workload without stamping a flag the "
+                     "analyzer reads — record it (rec.error / stats "
+                     "counter / flag field) or mark `# kvmini: workload-ok`")
+        elif isinstance(node, ast.Assign) and not fn_surfaces:
+            v = node.value
+            if (isinstance(v, ast.Subscript) and isinstance(v.slice, ast.Slice)
+                    and v.slice.upper is not None
+                    and isinstance(v.value, ast.Name)
+                    and TRUNCATABLE_NAME.search(v.value.id)):
+                for t in node.targets:
+                    tname = t.id if isinstance(t, ast.Name) else (
+                        t.attr if isinstance(t, ast.Attribute) else "")
+                    if tname and TRUNCATABLE_NAME.search(tname):
+                        emit(node,
+                             f"`{tname}` is truncated by slicing in "
+                             f"`{fn.name}` but no truncation flag is "
+                             "stamped — the run measures a different "
+                             "workload than configured; set the flag "
+                             "field or mark `# kvmini: workload-ok`")
+                        break
+
+
+def check(index: FactIndex) -> list[Diagnostic]:
+    diags: list[Diagnostic] = []
+    for mod in index.modules.values():
+        if not SCOPE_PATH.search(mod.path):
+            continue
+        for fn in mod.functions.values():
+            _check_function(mod, fn, diags)
+    return diags

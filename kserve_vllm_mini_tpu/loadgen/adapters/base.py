@@ -103,7 +103,9 @@ class ProtocolAdapter(ABC):
                 continue
             try:
                 evt = json.loads(line)
-            except json.JSONDecodeError:
+            except json.JSONDecodeError:  # kvmini: workload-ok — SSE
+                # comments/keepalives; token-carrying events that fail to
+                # parse would also fail the analyzer's token reconciliation
                 continue
             piece = parse_event(evt, res) or ""
             if piece:

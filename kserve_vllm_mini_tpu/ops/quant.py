@@ -90,6 +90,8 @@ def _unpack_int4(packed: jnp.ndarray) -> jnp.ndarray:
         return nib.reshape(*packed.shape[:-1], packed.shape[-1] * 2)
     import numpy as np
 
+    # eager-only branch (the Tracer path returned above); host unpack is
+    # the point: S4 on-device would hit dispatch-relayout  # kvmini: sync-ok
     a = np.asarray(packed)
     lo = (a & 0x0F).astype(np.int8)
     hi = (a >> 4).astype(np.int8)

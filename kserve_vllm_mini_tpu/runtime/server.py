@@ -470,7 +470,9 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
         tool_calls entries."""
         try:
             calls = json.loads(text)
-        except json.JSONDecodeError:
+        except json.JSONDecodeError:  # kvmini: workload-ok — unconstrained
+            # runs may emit free text; the response then carries `content`
+            # instead of tool_calls, which IS the surfaced outcome
             return None
         if not isinstance(calls, list):
             return None
@@ -762,8 +764,9 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
                 finish = info.get("finish_reason", "stop")
                 if stop_cut is not None:
                     # OpenAI semantics: output ends BEFORE the matched stop
-                    # sequence (the match itself is not returned)
-                    text = text[:stop_cut]
+                    # sequence (the match itself is not returned); surfaced
+                    # to the client via finish_reason
+                    text = text[:stop_cut]  # kvmini: workload-ok
                     finish = "stop"
                 message: dict[str, Any] = {"role": "assistant", "content": text}
                 if wants_tools:
@@ -1020,8 +1023,8 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
                 engine.cancel(h, reason="cancelled")
         try:
             await resp.write_eof()
-        except ConnectionResetError:
-            pass
+        except ConnectionResetError:  # kvmini: workload-ok — client already
+            pass                      # gone; the cancel above surfaced it
         return resp
 
     async def models(_request):
@@ -1137,24 +1140,52 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
             f"kvmini_tpu_free_slots {s['free_slots']}",
             "# TYPE kvmini_tpu_decode_steps_total counter",
             f"kvmini_tpu_decode_steps_total {s['decode_steps']}",
+            "# TYPE kvmini_tpu_prefills_total counter",
+            f"kvmini_tpu_prefills_total {s['prefills']}",
             # decode-pipeline telemetry (docs/DECODE_PIPELINE.md): depth >= 2
             # + low bubble = the double-buffered steady state is engaged
             "# TYPE kvmini_tpu_dispatch_depth gauge",
             f"kvmini_tpu_dispatch_depth {s['dispatch_depth']}",
+            "# TYPE kvmini_tpu_inflight_sweeps gauge",
+            f"kvmini_tpu_inflight_sweeps {s['inflight_sweeps']}",
             "# TYPE kvmini_tpu_pipelined_sweeps_total counter",
             f"kvmini_tpu_pipelined_sweeps_total {s['pipelined_sweeps']}",
             "# TYPE kvmini_tpu_host_overlap_seconds_total counter",
             f"kvmini_tpu_host_overlap_seconds_total {s['host_overlap_s']:.6f}",
             "# TYPE kvmini_tpu_bubble_seconds_total counter",
             f"kvmini_tpu_bubble_seconds_total {s['bubble_s']:.6f}",
+            # sync-fallback attribution (docs/DECODE_PIPELINE.md): which
+            # constraint broke the double-buffered steady state, labeled so
+            # PromQL can aggregate (the scrape parser sums label series)
+            "# TYPE kvmini_tpu_pipeline_fallback_total counter",
+            "kvmini_tpu_pipeline_fallback_total"
+            f"{{reason=\"constrained\"}} {s['pipeline_fallback_constrained']}",
+            "kvmini_tpu_pipeline_fallback_total"
+            f"{{reason=\"spec\"}} {s['pipeline_fallback_spec']}",
+            "kvmini_tpu_pipeline_fallback_total"
+            f"{{reason=\"active_set\"}} {s['pipeline_fallback_active_set']}",
+            "kvmini_tpu_pipeline_fallback_total"
+            f"{{reason=\"headroom\"}} {s['pipeline_fallback_headroom']}",
             "# TYPE kvmini_tpu_spec_rounds_total counter",
             f"kvmini_tpu_spec_rounds_total {s['spec_rounds']}",
+            "# TYPE kvmini_tpu_spec_accepted_total counter",
+            f"kvmini_tpu_spec_accepted_total {s['spec_accepted']}",
+            "# TYPE kvmini_tpu_spec_proposed_total counter",
+            f"kvmini_tpu_spec_proposed_total {s['spec_proposed']}",
             "# TYPE kvmini_tpu_spec_accept_ratio gauge",
             f"kvmini_tpu_spec_accept_ratio {s['spec_accept_ratio']:.6f}",
             "# TYPE kvmini_tpu_prefix_hits_total counter",
             f"kvmini_tpu_prefix_hits_total {s['prefix_hits']}",
             "# TYPE kvmini_tpu_prefix_tokens_reused_total counter",
             f"kvmini_tpu_prefix_tokens_reused_total {s['prefix_tokens_reused']}",
+            # prefix-reuse counters under the generic cache names the
+            # analysis fallback chain scrapes (analysis/telemetry.py
+            # cache_hit_ratio) — before these lines the runtime branch of
+            # that chain silently yielded nothing
+            "# TYPE kvmini_tpu_cache_hits_total counter",
+            f"kvmini_tpu_cache_hits_total {s['prefix_hits']}",
+            "# TYPE kvmini_tpu_cache_lookups_total counter",
+            f"kvmini_tpu_cache_lookups_total {s['prefix_lookups']}",
         ]
         if "kv_pool_blocks" in s:  # paged layout only
             lines += [
@@ -1162,6 +1193,8 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
                 f"kvmini_tpu_kv_pool_blocks {s['kv_pool_blocks']}",
                 "# TYPE kvmini_tpu_kv_free_blocks gauge",
                 f"kvmini_tpu_kv_free_blocks {s['kv_free_blocks']}",
+                "# TYPE kvmini_tpu_kv_retained_blocks gauge",
+                f"kvmini_tpu_kv_retained_blocks {s['kv_retained_blocks']}",
                 "# TYPE kvmini_tpu_kv_block_size gauge",
                 f"kvmini_tpu_kv_block_size {s['kv_block_size']}",
             ]

@@ -198,16 +198,16 @@ def token_bytes_table(hf_tokenizer) -> list[Optional[bytes]]:
             try:
                 out.append(bytes(bl[c] for c in s))
                 continue
-            except KeyError:
-                # added token stored verbatim (not byte-encoded)
+            except KeyError:  # kvmini: workload-ok — added tokens are stored
+                # verbatim (not byte-encoded); utf-8 IS their byte form
                 out.append(s.encode("utf-8"))
                 continue
         if spiece and len(s) == 6 and s.startswith("<0x") and s.endswith(">"):
             try:
                 out.append(bytes([int(s[3:5], 16)]))
                 continue
-            except ValueError:
-                pass
+            except ValueError:  # kvmini: workload-ok — not a <0xNN> byte
+                pass            # token after all; falls through to text path
         if spiece:
             out.append(s.replace("▁", " ").encode("utf-8"))
         else:

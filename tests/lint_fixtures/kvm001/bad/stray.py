@@ -1,0 +1,3 @@
+def plain(x):
+    # kvmini: sync-ok
+    return x + 1  # nothing here ever needed suppressing

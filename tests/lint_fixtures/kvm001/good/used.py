@@ -1,0 +1,8 @@
+import jax
+
+
+@jax.jit
+def step(x):
+    if x > 0:  # kvmini: static-shape — trace-static in every caller
+        return x + 1
+    return x - 1

@@ -1,0 +1,8 @@
+import jax
+
+
+@jax.jit
+def step(x):
+    if x > 0:  # data-dependent branch on the traced operand
+        return x + 1
+    return x - 1

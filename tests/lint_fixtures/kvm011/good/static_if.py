@@ -1,0 +1,20 @@
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def step(x, fresh: bool = False):
+    if fresh:  # annotated-static config param: trace-static
+        return jnp.where(x > 0, x + 1, x - 1)
+    if x.shape[0] > 1:  # shape reads are static under trace
+        return x
+    if "k_s" in x:  # structure membership of an untraced key
+        return x["k_s"]
+    return x
+
+
+@jax.jit
+def suppressed(x):
+    if x > 0:  # kvmini: static-shape
+        return x + 1
+    return x - 1

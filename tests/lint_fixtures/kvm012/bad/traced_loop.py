@@ -1,0 +1,9 @@
+import jax
+
+
+@jax.jit
+def unroll(x, n):
+    acc = x
+    for _ in range(n):  # loop bound is a traced operand
+        acc = acc + 1
+    return acc

@@ -1,0 +1,13 @@
+import jax
+
+
+@jax.jit
+def unroll(x, n_steps: int = 4):
+    acc = x
+    for _ in range(n_steps):  # static python unroll count
+        acc = acc + 1
+    for _ in range(x.shape[0]):  # shape-derived bound: static
+        acc = acc + 1
+    for leaf in x:  # pytree iteration is static structure
+        acc = acc + leaf
+    return acc

@@ -1,0 +1,15 @@
+import time
+from time import monotonic as now
+
+import jax
+
+
+@jax.jit
+def step(x):
+    t = time.time()  # baked in at trace time; replicas disagree
+    return x + t
+
+
+@jax.jit
+def step_from_import(x):
+    return x + now()  # from-imported clocks are clocks too

@@ -1,0 +1,12 @@
+import time
+
+import jax
+
+
+@jax.jit
+def step(x, t):
+    return x + t  # time enters as an operand
+
+
+def drive(x):
+    return step(x, time.time())  # host code may read the clock

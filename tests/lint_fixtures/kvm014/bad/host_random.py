@@ -1,0 +1,8 @@
+import random
+
+import jax
+
+
+@jax.jit
+def step(x):
+    return x + random.random()  # host randomness under trace

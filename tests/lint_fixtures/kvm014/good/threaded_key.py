@@ -1,0 +1,10 @@
+import jax
+
+
+@jax.jit
+def step(x, key):
+    return x + jax.random.normal(key, x.shape)
+
+
+def drive(x):
+    return step(x, jax.random.PRNGKey(0))  # explicit, shared seed

@@ -1,0 +1,16 @@
+import jax
+
+
+@jax.jit
+def reduce_to_scalar(x):
+    return x.sum().item()  # concretizes the tracer
+
+
+_step = jax.jit(lambda x: x + 1)
+
+
+def drive_pipeline(x):
+    y = _step(x)
+    read = lambda v: v.item()  # lambda bodies are the enclosing scope
+    read(y)
+    return jax.device_get(y)  # unannotated sync in a dispatch path

@@ -1,0 +1,15 @@
+import jax
+
+
+@jax.jit
+def reduce_to_scalar(x):
+    return x.sum()  # stays a device scalar
+
+
+_step = jax.jit(lambda x: x + 1)
+
+
+def drive_pipeline(x):
+    y = _step(x)
+    # the batch boundary is the intended sync point  # kvmini: sync-ok
+    return jax.device_get(y)

@@ -1,0 +1,18 @@
+class Engine:
+    def _admit_one(self, handle):
+        self.slots.append(handle)
+
+    def _retire_one(self):
+        self.slots.pop()
+
+    def _schedule_once(self, on_decision=None):
+        handle = self.pending.pop()
+        self._admit_one(handle)  # state advances; followers never hear
+
+    def _publishes_elsewhere(self, on_decision=None):
+        if on_decision is not None:
+            on_decision(("sweep",))
+        if self.slots:
+            # publishing somewhere else in the function must NOT excuse an
+            # unpublished mutation in its own decision block
+            self._retire_one()

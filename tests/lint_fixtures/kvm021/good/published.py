@@ -1,0 +1,13 @@
+class Engine:
+    def _admit_one(self, handle):
+        self.slots.append(handle)
+
+    def _retire_all(self, on_decision=None):
+        pass
+
+    def _schedule_once(self, on_decision=None):
+        handle = self.pending.pop()
+        if on_decision is not None:
+            on_decision(("admit", handle))
+        self._admit_one(handle)  # published in the same decision block
+        self._retire_all(on_decision)  # forwarding the callback is routed

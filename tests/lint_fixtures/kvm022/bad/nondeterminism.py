@@ -1,0 +1,18 @@
+import time
+
+
+def run_follower(engine, commands):
+    for cmd in commands:
+        engine._decode_sweep()
+
+
+class Engine:
+    def _decode_sweep(self):
+        ready = {2, 1, 3}
+        for slot in ready:  # set order differs across hosts
+            self._emit(slot)
+        if time.time() - self.t0 > 1.0:  # clocks differ across hosts
+            self._emit(0)
+
+    def _emit(self, slot):
+        self.out.append(slot)

@@ -1,0 +1,18 @@
+import time
+
+
+def run_follower(engine, commands):
+    for cmd in commands:
+        engine._decode_sweep()
+
+
+class Engine:
+    def _decode_sweep(self):
+        t0 = time.time()
+        ready = {2, 1, 3}
+        for slot in sorted(ready):  # deterministic order on every host
+            self._emit(slot)
+        self.stats["busy_s"] += time.time() - t0  # stats-only clock use
+
+    def _emit(self, slot):
+        self.out.append(slot)

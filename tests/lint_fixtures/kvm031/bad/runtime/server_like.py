@@ -1,0 +1,13 @@
+class Engine:
+    def __init__(self):
+        self.stats = {
+            "decode_tokens": 0,
+            "hidden_counter": 0,  # never reaches /metrics
+        }
+
+
+def metrics(s):
+    return [
+        "# TYPE kvmini_tpu_decode_tokens_total counter",
+        f"kvmini_tpu_decode_tokens_total {s['decode_tokens']}",
+    ]

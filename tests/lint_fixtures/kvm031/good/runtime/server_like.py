@@ -1,0 +1,16 @@
+class Engine:
+    def __init__(self):
+        self.stats = {
+            "decode_tokens": 0,
+            "visible_counter": 0,
+            "busy_s": 0.0,  # kvmini: metrics-ok — raw input to a derived gauge
+        }
+
+
+def metrics(s):
+    return [
+        "# TYPE kvmini_tpu_decode_tokens_total counter",
+        f"kvmini_tpu_decode_tokens_total {s['decode_tokens']}",
+        "# TYPE kvmini_tpu_visible_counter_total counter",
+        f"kvmini_tpu_visible_counter_total {s['visible_counter']}",
+    ]

@@ -1,0 +1,2 @@
+def scrape(m):
+    return m.get("kvmini_tpu_widgets_total")

@@ -1,0 +1,5 @@
+def metrics(s):
+    return [
+        "# TYPE kvmini_tpu_widgets_total counter",
+        f"kvmini_tpu_widgets_total {s['widgets']}",
+    ]

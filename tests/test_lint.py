@@ -1,0 +1,198 @@
+"""kvmini-lint: per-rule fixture assertions + the live-codebase baseline pin.
+
+JAX-free by construction (the linter is stdlib-ast only), so this suite
+runs in the harness-only lane. Each KVM0xx rule has a bad/ fixture that
+must produce EXACTLY the expected diagnostics and a good/ fixture (same
+shape, invariant respected or legitimately suppressed) that must lint
+clean — including the ISSUE's seeded mutations: an unpublished lockstep
+mutation (KVM021), a stats key missing from /metrics (KVM031), and
+time.time() inside a jitted fn (KVM013).
+
+The pin test runs the real linter over the real package against the
+committed lint-baseline.json: no new findings, no stale entries, no
+stale suppressions — and inside the <10s budget CI's lint-invariants
+target relies on.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from collections import Counter
+from pathlib import Path
+
+import pytest
+
+from kserve_vllm_mini_tpu.lint import baseline as baseline_mod
+from kserve_vllm_mini_tpu.lint.__main__ import main as lint_main
+from kserve_vllm_mini_tpu.lint.diagnostics import RULES, Diagnostic
+from kserve_vllm_mini_tpu.lint.runner import run_lint
+
+REPO = Path(__file__).resolve().parent.parent
+FIXTURES = REPO / "tests" / "lint_fixtures"
+PACKAGE = REPO / "kserve_vllm_mini_tpu"
+
+
+def lint_fixture(rule: str, case: str) -> list[Diagnostic]:
+    root = FIXTURES / rule / case
+    docs = root / "docs"
+    result = run_lint(
+        [root],
+        doc_paths=[docs] if docs.is_dir() else None,
+        root=REPO,
+    )
+    assert not result.parse_errors, result.parse_errors
+    return result.diagnostics
+
+
+def codes(diags: list[Diagnostic]) -> Counter:
+    return Counter(d.code for d in diags)
+
+
+# -- per-rule fixtures: (rule dir, expected bad-case code counts) -----------
+CASES = [
+    ("kvm001", {"KVM001": 1}),
+    ("kvm011", {"KVM011": 1}),
+    ("kvm012", {"KVM012": 1}),
+    ("kvm013", {"KVM013": 2}),  # ISSUE seeded mutation: time.time() under jit
+    #                             (+ the from-imported-clock spelling)
+    ("kvm014", {"KVM014": 1}),
+    ("kvm015", {"KVM015": 3}),  # traced code, dispatch path, inline lambda
+    ("kvm021", {"KVM021": 2}),  # ISSUE seeded mutation: unpublished admit;
+    #                             publish elsewhere must not excuse a block
+    ("kvm022", {"KVM022": 2}),  # set iteration + wall-clock branch
+    ("kvm031", {"KVM031": 1}),  # ISSUE seeded mutation: stats key not exported
+    ("kvm032", {"KVM032": 3}),  # consumed-, documented-, and emitted-drift
+    ("kvm033", {"KVM033": 1}),
+    ("kvm041", {"KVM041": 2}),  # silent except-fallback + unflagged truncation
+]
+
+
+@pytest.mark.parametrize("rule,expected", CASES, ids=[c[0] for c in CASES])
+def test_bad_fixture_produces_exactly_the_expected_diagnostics(rule, expected):
+    assert dict(codes(lint_fixture(rule, "bad"))) == expected
+
+
+@pytest.mark.parametrize("rule", [c[0] for c in CASES], ids=[c[0] for c in CASES])
+def test_good_fixture_lints_clean(rule):
+    diags = lint_fixture(rule, "good")
+    assert diags == [], [d.render() for d in diags]
+
+
+def test_every_rule_code_has_a_fixture():
+    covered = {c.upper() for c, _ in CASES}
+    assert covered == set(RULES), "fixture coverage must track the rule table"
+
+
+# -- baseline ratchet mechanics ---------------------------------------------
+
+def _diag(path="a.py", code="KVM013", ctx="f") -> Diagnostic:
+    return Diagnostic(path, 1, code, "msg", context=ctx)
+
+
+def test_baseline_grandfathers_exact_matches(tmp_path):
+    bl = tmp_path / "bl.json"
+    baseline_mod.save(bl, [_diag(), _diag(ctx="g")])
+    diff = baseline_mod.diff([_diag(), _diag(ctx="g")], baseline_mod.load(bl))
+    assert diff.clean and diff.suppressed == 2 and not diff.new
+
+
+def test_baseline_flags_new_findings(tmp_path):
+    bl = tmp_path / "bl.json"
+    baseline_mod.save(bl, [_diag()])
+    diff = baseline_mod.diff([_diag(), _diag(ctx="brand_new")],
+                             baseline_mod.load(bl))
+    assert not diff.clean
+    assert [d.context for d in diff.new] == ["brand_new"]
+
+
+def test_baseline_grandfathers_budget_when_count_grows(tmp_path):
+    # a third same-key finding must not repaint the recorded two as new
+    bl = tmp_path / "bl.json"
+    baseline_mod.save(bl, [_diag(), _diag()])
+    three = [Diagnostic("a.py", ln, "KVM013", "msg", context="f")
+             for ln in (1, 5, 9)]
+    diff = baseline_mod.diff(three, baseline_mod.load(bl))
+    assert diff.suppressed == 2
+    assert [d.line for d in diff.new] == [9]
+
+
+def test_out_of_root_paths_lint_without_crashing(tmp_path):
+    # paths outside the lint root keep their absolute identity (no
+    # ValueError from relative_to) and still produce diagnostics
+    src = tmp_path / "probe.py"
+    src.write_text("import jax\n\n@jax.jit\ndef f(x):\n    return x.item()\n")
+    result = run_lint([tmp_path], root=REPO)
+    assert not result.parse_errors
+    assert [d.code for d in result.diagnostics] == ["KVM015"]
+
+
+def test_baseline_flags_stale_entries_as_ratchet(tmp_path):
+    bl = tmp_path / "bl.json"
+    baseline_mod.save(bl, [_diag(), _diag(ctx="fixed_since")])
+    diff = baseline_mod.diff([_diag()], baseline_mod.load(bl))
+    assert not diff.clean
+    assert diff.stale == ["a.py::KVM013::fixed_since"]
+
+
+# -- CLI contract ------------------------------------------------------------
+
+def test_cli_exit_codes_and_json(tmp_path, capsys):
+    bad = str(FIXTURES / "kvm013" / "bad")
+    assert lint_main([bad, "--no-baseline", "--format", "json"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert {f["code"] for f in doc["findings"]} == {"KVM013"}
+
+    bl = tmp_path / "bl.json"
+    assert lint_main([bad, "--write-baseline", "--baseline", str(bl)]) == 0
+    assert lint_main([bad, "--baseline", str(bl)]) == 0  # grandfathered
+    good = str(FIXTURES / "kvm013" / "good")
+    assert lint_main([good, "--baseline", str(bl)]) == 1  # stale entry ratchets
+    assert lint_main([str(tmp_path / "nope")]) == 2
+
+
+def test_single_file_scan_skips_cross_surface_drift():
+    # linting one changed file must not fail on metrics other (unscanned)
+    # emitter modules provide — docs drift is a directory-scan check
+    result = run_lint(
+        [PACKAGE / "runtime" / "server.py"],
+        doc_paths=[REPO / "docs", REPO / "dashboards"],
+        root=REPO,
+    )
+    assert [d.render() for d in result.diagnostics if d.code == "KVM032"] == []
+
+
+def test_write_baseline_refuses_parse_errors(tmp_path, capsys):
+    (tmp_path / "broken.py").write_text("def f(:\n")
+    bl = tmp_path / "bl.json"
+    rc = lint_main([str(tmp_path), "--write-baseline", "--baseline", str(bl)])
+    assert rc == 2 and not bl.exists()
+    assert "parse error" in capsys.readouterr().err
+
+
+# -- the live codebase stays pinned to the committed baseline ----------------
+
+def test_live_codebase_matches_baseline_exactly():
+    """No new findings, no stale baseline entries, no stale suppressions —
+    and within the <10s budget `make lint-invariants` runs under."""
+    t0 = time.perf_counter()
+    result = run_lint(
+        [PACKAGE],
+        doc_paths=[REPO / "docs", REPO / "dashboards"],
+        baseline_path=REPO / "lint-baseline.json",
+        root=REPO,
+    )
+    elapsed = time.perf_counter() - t0
+    assert not result.parse_errors, result.parse_errors
+    assert result.baseline_diff is not None, "lint-baseline.json must exist"
+    assert result.baseline_diff.new == [], [
+        d.render() for d in result.baseline_diff.new
+    ]
+    assert result.baseline_diff.stale == [], (
+        "fixed findings still in lint-baseline.json — regenerate with "
+        "--write-baseline: " + ", ".join(result.baseline_diff.stale)
+    )
+    assert not [d for d in result.diagnostics if d.code == "KVM001"], (
+        "stale `# kvmini:` suppressions in the live tree"
+    )
+    assert elapsed < 10.0, f"kvmini-lint took {elapsed:.1f}s (budget 10s)"
