@@ -48,9 +48,11 @@ bench:  ## driver benchmark (one JSON line) on the attached accelerator
 # asserts the decode-pipeline counters (docs/DECODE_PIPELINE.md) land in
 # results.json via the real stage chain — the same tier-1 gate CI runs.
 # Also validates the exported traces.json against core/schema.py's
-# TRACES_JSON_SCHEMA (docs/TRACING.md).
+# TRACES_JSON_SCHEMA (docs/TRACING.md), and the live monitor's
+# timeline.jsonl + results `monitor` block against TIMELINE_SAMPLE_SCHEMA /
+# MONITOR_JSON_SCHEMA incl. the scripted-stall event (docs/MONITORING.md).
 bench-smoke:  ## bench pipeline vs the mock server, tiny budget, no TPU
-	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_bench_smoke.py -q
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_bench_smoke.py tests/test_monitor.py -q
 
 dashboards-validate:  ## dashboard JSON structure + panel/query checks
 	$(PY) -m pytest tests/test_assets.py -q -k "dashboard"

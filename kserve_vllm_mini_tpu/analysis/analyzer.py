@@ -40,7 +40,8 @@ def analyze_run(
     meta = run_dir.read_meta()
 
     update: dict[str, Any] = {}
-    for key in ("model", "runtime", "pattern", "concurrency", "streaming", "accelerator"):
+    for key in ("model", "runtime", "pattern", "concurrency", "streaming",
+                "accelerator", "aborted_early"):
         if key in meta:
             update[key] = meta[key]
     update["run_id"] = run_dir.path.name
@@ -101,6 +102,22 @@ def analyze_run(
             runtime_metrics=runtime_metrics,
         )
     )
+    # monitor timeline (docs/MONITORING.md): when the run carried the 1 Hz
+    # sampler, derive the TRUE windowed duty cycle and queue-depth
+    # percentiles from it — a lone /metrics snapshot only ever fills the
+    # instant keys above. A measured Prometheus window still outranks the
+    # timeline's modeled power; the queue distribution is timeline-only
+    # either way.
+    timeline = run_dir.read_timeline()
+    if timeline:
+        tl_util = telemetry.timeline_utilization(
+            timeline, accelerator=meta.get("accelerator")
+        )
+        if "tpu_duty_cycle_avg" in update:
+            for k in ("tpu_duty_cycle_avg", "tpu_metrics_source",
+                      "tpu_power_watts_avg", "power_provenance"):
+                tl_util.pop(k, None)
+        update.update(tl_util)
     update.update(
         telemetry.cache_hit_ratio(prom_url, endpoint,
                                   runtime_metrics=runtime_metrics)

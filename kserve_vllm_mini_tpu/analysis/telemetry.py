@@ -180,11 +180,113 @@ def collect_utilization(
         m = (runtime_metrics if runtime_metrics is not None
              else scrape_runtime_metrics(endpoint))
         if "kvmini_tpu_duty_cycle" in m:
-            out["tpu_duty_cycle_avg"] = m["kvmini_tpu_duty_cycle"]
-            out["tpu_metrics_source"] = "runtime:/metrics"
+            # ONE instantaneous scrape is not a window average: it lands
+            # in the instant key with an honest source tag, and the *_avg
+            # key stays absent unless a real window (Prometheus range or
+            # the monitor timeline — timeline_utilization) backs it
+            out["tpu_duty_cycle"] = m["kvmini_tpu_duty_cycle"]
+            out["tpu_metrics_source"] = "runtime:/metrics:instant"
     if "tpu_power_watts_avg" not in out and "tpu_duty_cycle_avg" in out:
         out["tpu_power_watts_avg"] = modeled_power(out["tpu_duty_cycle_avg"], accelerator)
         out["power_provenance"] = "modeled"
+    return out
+
+
+def nearest_rank_percentile(vals: list[float], pct: float) -> float:
+    """Nearest-rank percentile — the ONE implementation shared by the
+    live monitor's burn-rate windows (monitor/burnrate.py) and the
+    timeline summaries below. (analysis/metrics.py keeps its
+    deliberately different interpolated percentile for the post-hoc
+    latency stats.) Empty input yields 0.0."""
+    vals = sorted(vals)
+    if not vals:
+        return 0.0
+    k = max(int(round(pct / 100.0 * len(vals) + 0.5)) - 1, 0)
+    return vals[min(k, len(vals) - 1)]
+
+
+def windowed_duty_series(
+    pts: list[tuple[float, dict[str, Any]]],
+) -> list[tuple[float, float]]:
+    """Per-sample windowed duty cycle from timeline runtime blocks: the
+    delta of the busy-seconds counter over each sample gap (clamped to
+    [0, 1]) assigned to the gap's end; samples without a usable delta
+    fall back to the cumulative duty-cycle gauge. The ONE implementation
+    behind energy integration (power_from_timeline) and the report's
+    timeline lane — counter-reset/gap-handling fixes land once."""
+    out: list[tuple[float, float]] = []
+    prev_t: Optional[float] = None
+    prev_busy: Optional[float] = None
+    for t, rt in pts:
+        duty: Optional[float] = None
+        busy = rt.get("busy_seconds_total")
+        if (
+            busy is not None and prev_busy is not None
+            and prev_t is not None and t > prev_t
+        ):
+            duty = max(min((busy - prev_busy) / (t - prev_t), 1.0), 0.0)
+        elif "duty_cycle" in rt:
+            duty = float(rt["duty_cycle"])
+        if duty is not None:
+            out.append((t, duty))
+        if busy is not None:
+            prev_t, prev_busy = t, float(busy)
+    return out
+
+
+def timeline_utilization(
+    timeline: list[dict[str, Any]],
+    accelerator: Optional[str] = None,
+) -> dict[str, Any]:
+    """True windowed utilization from the monitor's 1 Hz timeline
+    (monitor/sampler.py; docs/MONITORING.md) — the fix for the
+    snapshot-as-average lie: ``tpu_duty_cycle_avg`` here is the delta of
+    the runtime's busy-seconds counter over the sampled span (falling
+    back to time-weighting the instantaneous gauge), and queue-depth
+    percentiles summarize every sample, not one scrape."""
+    pts = [
+        (float(s["t"]), s["runtime"])
+        for s in timeline
+        if isinstance(s.get("t"), (int, float))
+        and isinstance(s.get("runtime"), dict)
+    ]
+    if len(pts) < 2:
+        return {}
+    out: dict[str, Any] = {}
+    t0, t1 = pts[0][0], pts[-1][0]
+    busy0 = pts[0][1].get("busy_seconds_total")
+    busy1 = pts[-1][1].get("busy_seconds_total")
+    duty: Optional[float] = None
+    if busy0 is not None and busy1 is not None and t1 > t0:
+        # full-span counter delta == the gap-length-weighted mean of
+        # windowed_duty_series — one subtraction instead of a fold
+        duty = max(min((busy1 - busy0) / (t1 - t0), 1.0), 0.0)
+    else:
+        gauges = [
+            (t, rt["duty_cycle"]) for t, rt in pts if "duty_cycle" in rt
+        ]
+        if len(gauges) >= 2:
+            # time-weighted mean of the gauge — weaker (the gauge is
+            # cumulative-since-start) but still a span, not a snapshot
+            num = sum(
+                0.5 * (va + vb) * (tb - ta)
+                for (ta, va), (tb, vb) in zip(gauges, gauges[1:])
+            )
+            den = gauges[-1][0] - gauges[0][0]
+            if den > 0:
+                duty = max(min(num / den, 1.0), 0.0)
+    if duty is not None:
+        out["tpu_duty_cycle_avg"] = duty
+        out["tpu_metrics_source"] = (
+            f"timeline:runtime:/metrics ({len(pts)} samples)"
+        )
+        out["tpu_power_watts_avg"] = modeled_power(duty, accelerator)
+        out["power_provenance"] = "modeled"
+    depths = [rt["queue_depth"] for _t, rt in pts if "queue_depth" in rt]
+    if depths:
+        out["queue_depth_p50"] = nearest_rank_percentile(depths, 50.0)
+        out["queue_depth_p95"] = nearest_rank_percentile(depths, 95.0)
+        out["queue_depth_max"] = max(depths)
     return out
 
 

@@ -20,6 +20,18 @@ from kserve_vllm_mini_tpu.core.rundir import RunDir
 from kserve_vllm_mini_tpu.core.validate import validate_profile
 
 
+def _monitor_budgets(monitor_slo: Any) -> dict[str, float]:
+    """Budgets for live burn-rates: a dict is taken as-is, a string is a
+    budgets JSON path (the same file format gates/slo.py loads)."""
+    if isinstance(monitor_slo, dict):
+        return {k: float(v) for k, v in monitor_slo.items()}
+    if isinstance(monitor_slo, str):
+        from kserve_vllm_mini_tpu.gates.slo import load_slo
+
+        return load_slo(monitor_slo)
+    return {}
+
+
 def run_bench(
     url: Optional[str],
     profile: dict[str, Any],
@@ -32,8 +44,17 @@ def run_bench(
     chips: Optional[float] = None,
     slo_file: Optional[str] = None,
     idle_tax: str = "none",
+    monitor: bool = True,
+    monitor_slo: Any = None,
+    monitor_abort: bool = False,
 ) -> tuple[dict[str, Any], int]:
-    """Returns (results, exit_code)."""
+    """Returns (results, exit_code).
+
+    ``monitor`` runs the 1 Hz live sampler (docs/MONITORING.md) for the
+    duration of the load stage: timeline.jsonl, rolling burn-rates
+    against ``monitor_slo`` budgets (path or dict; also profile keys
+    ``monitor_slo`` / ``monitor_abort`` / ``monitor_interval_s``), and —
+    with ``monitor_abort`` — early termination of hopeless runs."""
     from kserve_vllm_mini_tpu.energy.collector import collect_power
 
     if not url and not self_serve:
@@ -74,24 +95,64 @@ def run_bench(
         cold_window_s += server.boot_seconds
         print(f"bench: self-serve runtime up in {server.boot_seconds:.1f}s at {url}")
 
+    # Live monitor (docs/MONITORING.md): profile keys override the
+    # arguments so sweeps can vary monitoring per cell
+    monitor_on = bool(profile.get("monitor", monitor))
+    run_monitor = None
+    live = None
+    abort = None
+    if monitor_on:
+        from kserve_vllm_mini_tpu.loadgen.runner import LiveStats
+        from kserve_vllm_mini_tpu.monitor import (
+            AbortSignal,
+            MonitorConfig,
+            RunMonitor,
+        )
+
+        budgets = _monitor_budgets(profile.get("monitor_slo", monitor_slo))
+        live = LiveStats()
+        abort = AbortSignal()
+        run_monitor = RunMonitor(
+            run_dir.timeline_jsonl,
+            endpoint=url,
+            live=live,
+            cfg=MonitorConfig(
+                interval_s=float(profile.get("monitor_interval_s", 1.0)),
+                budgets=budgets,
+                abort_enabled=bool(profile.get("monitor_abort", monitor_abort)),
+            ),
+            abort=abort,
+        )
+        run_monitor.start()
+
     # Stage 1: load test with concurrent power sampling. Everything from here
     # to the SLO gate runs under try/finally: a failing stage must still stop
     # the sampler and the self-serve engine (its decode-loop thread and KV
     # cache would otherwise outlive the run — sweeps record-and-continue on
     # failure, so a leak here skews every subsequent config).
+    #
+    # With the monitor on and no Prometheus, the dedicated power-sampler
+    # thread is NOT started: the monitor's timeline already carries
+    # duty/busy from the same endpoint at the same 1 Hz, and power.json is
+    # derived from it after the load stage (energy/collector.py
+    # power_from_timeline) — one scrape loop, not two, against the
+    # endpoint being measured. A Prometheus URL still gets the sampling
+    # loop (measured node power beats modeled duty x TDP).
     stop_sampling = threading.Event()
-    sampler = threading.Thread(
-        target=collect_power,
-        args=(run_dir, prom_url, url),
-        kwargs={
-            "interval_s": 1.0,
-            "accelerator": profile.get("accelerator"),
-            "stop_check": stop_sampling.is_set,
-        },
-        daemon=True,
-        name="power-sampler",
-    )
-    sampler.start()
+    sampler: Optional[threading.Thread] = None
+    if run_monitor is None or prom_url:
+        sampler = threading.Thread(
+            target=collect_power,
+            args=(run_dir, prom_url, url),
+            kwargs={
+                "interval_s": 1.0,
+                "accelerator": profile.get("accelerator"),
+                "stop_check": stop_sampling.is_set,
+            },
+            daemon=True,
+            name="power-sampler",
+        )
+        sampler.start()
 
     try:
         return _run_stages(
@@ -110,9 +171,14 @@ def run_bench(
             chips=chips,
             slo_file=slo_file,
             idle_tax=idle_tax,
+            run_monitor=run_monitor,
+            live=live,
+            abort=abort,
         )
     finally:
         stop_sampling.set()
+        if run_monitor is not None:
+            run_monitor.stop()
         if server is not None:
             server.stop()
 
@@ -124,7 +190,7 @@ def _run_stages(
     server,
     cold_start_instants: list[float],
     cold_window_s: float,
-    sampler: threading.Thread,
+    sampler: Optional[threading.Thread],
     stop_sampling: threading.Event,
     *,
     prom_url: Optional[str],
@@ -134,6 +200,9 @@ def _run_stages(
     chips: Optional[float],
     slo_file: Optional[str],
     idle_tax: str,
+    run_monitor=None,
+    live=None,
+    abort=None,
 ) -> tuple[dict[str, Any], int]:
     from kserve_vllm_mini_tpu.analysis.analyzer import analyze_run
     from kserve_vllm_mini_tpu.costs.estimator import estimate_cost
@@ -163,11 +232,27 @@ def _run_stages(
         seed=int(profile.get("seed", 42)),
         extra_body=profile.get("extra_body", {}) or {},
     )
-    records = run_load(cfg, run_dir)
+    records = run_load(cfg, run_dir, live=live, abort=abort)
     stop_sampling.set()
-    # worst-case iteration = power-query timeouts (~8 s with 2 s timeouts);
-    # power.json must exist before Stage 4 integrates it
-    sampler.join(timeout=30.0)
+    monitor_summary: Optional[dict[str, Any]] = None
+    if run_monitor is not None:
+        # stop BEFORE analyze: the analyzer reads timeline.jsonl and the
+        # last line must be flushed
+        monitor_summary = run_monitor.stop()
+        if sampler is None:
+            # the monitor replaced the power-sampling loop — derive
+            # power.json from its timeline (one scrape loop, not two)
+            from kserve_vllm_mini_tpu.energy.collector import collect_power
+
+            collect_power(
+                run_dir, None, None,
+                accelerator=profile.get("accelerator"),
+                timeline=run_monitor.samples,
+            )
+    if sampler is not None:
+        # worst-case iteration = power-query timeouts (~8 s with 2 s
+        # timeouts); power.json must exist before Stage 4 integrates it
+        sampler.join(timeout=30.0)
     ok = sum(1 for r in records if r.ok)
     print(f"bench: load complete {ok}/{len(records)} ok")
 
@@ -207,6 +292,14 @@ def _run_stages(
         run_dir.merge_into_results(
             {"cold_start_seconds": round(server.boot_seconds, 2)}
         )
+
+    # live-monitor summary (docs/MONITORING.md): burn rates, events,
+    # sampler accounting, and — when the abort hook fired — the reason,
+    # which sweeps surface per cell as aborted_early
+    if monitor_summary is not None:
+        run_dir.merge_into_results({"monitor": monitor_summary})
+        if abort is not None and abort.is_set():
+            run_dir.merge_into_results({"aborted_early": abort.reason})
 
     # Stage 4: energy
     integrate_energy(run_dir, idle_tax=idle_tax)
@@ -276,6 +369,17 @@ def register(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--chips", type=float, default=None)
     parser.add_argument("--slo", default=None, help="SLO budgets JSON; exit 3 on violation")
     parser.add_argument("--idle-tax", choices=["none", "series", "baseline"], default="none")
+    parser.add_argument("--no-monitor", action="store_true",
+                        help="Disable the 1 Hz live run monitor "
+                             "(timeline.jsonl, burn rates, events — "
+                             "docs/MONITORING.md)")
+    parser.add_argument("--monitor-slo", default=None,
+                        help="Budgets JSON for LIVE rolling burn-rates "
+                             "(default: none; --slo still gates post-hoc)")
+    parser.add_argument("--monitor-abort", action="store_true",
+                        help="Let the monitor abort the run on sustained "
+                             "budget burn or a decode stall (records "
+                             "aborted_early in results.json)")
 
 
 def run(args: argparse.Namespace) -> int:
@@ -299,5 +403,8 @@ def run(args: argparse.Namespace) -> int:
         chips=args.chips,
         slo_file=args.slo,
         idle_tax=args.idle_tax,
+        monitor=not args.no_monitor,
+        monitor_slo=args.monitor_slo,
+        monitor_abort=args.monitor_abort,
     )
     return code

@@ -7,6 +7,8 @@ A benchmark run lives in ``runs/<run_id>/`` and contains:
 - ``results.json``          the universal merge target every stage updates
 - ``power.json``            sampled chip power (energy collector "collect")
 - ``energy.json``           integrated energy (energy collector "integrate")
+- ``timeline.jsonl``        1 Hz unified monitor samples (monitor/sampler.py,
+                            docs/MONITORING.md) — one JSON object per line
 - ``traces/traces.json``    OTLP-shaped client trace spans
 - ``requests_classified.csv``  requests.csv + cold/warm classification column
 - ``io_probe.json``         network/storage probe output
@@ -194,6 +196,10 @@ class RunDir:
     def io_probe_json(self) -> Path:
         return self.path / "io_probe.json"
 
+    @property
+    def timeline_jsonl(self) -> Path:
+        return self.path / "timeline.jsonl"
+
     # -- requests.csv ------------------------------------------------------
     def write_requests(self, records: Iterable[RequestRecord]) -> None:
         with self.requests_csv.open("w", newline="") as f:
@@ -288,6 +294,25 @@ class RunDir:
 
     def read_io_probe(self) -> dict[str, Any]:
         return self._read_json(self.io_probe_json)
+
+    def read_timeline(self) -> list[dict[str, Any]]:
+        """Monitor samples from timeline.jsonl, oldest first. A kill
+        mid-append truncates the last line — degrade by dropping it, the
+        same tolerance the report applies to decision logs."""
+        if not self.timeline_jsonl.exists():
+            return []
+        out: list[dict[str, Any]] = []
+        for line in self.timeline_jsonl.read_text().splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(obj, dict):
+                out.append(obj)
+        return out
 
 
 def window_bounds(records: list[RequestRecord]) -> tuple[float, float]:

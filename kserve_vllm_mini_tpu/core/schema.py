@@ -65,13 +65,21 @@ class Results:
     cold_multiplier: Optional[float] = None
     cold_start_seconds: Optional[float] = None
 
-    # utilization / telemetry (TPU-native)
+    # utilization / telemetry (TPU-native). `*_avg` keys are only written
+    # when a real window backs them (a Prometheus range or the monitor's
+    # timeline — docs/MONITORING.md); a single runtime /metrics snapshot
+    # lands in the instant keys with tpu_metrics_source saying so.
     tpu_duty_cycle_avg: Optional[float] = None
+    tpu_duty_cycle: Optional[float] = None  # instantaneous, one scrape
     tpu_hbm_used_avg_gib: Optional[float] = None
     tpu_power_watts_avg: Optional[float] = None
     power_provenance: Optional[str] = None  # "measured" | "modeled"
     cpu_util_avg: Optional[float] = None
     host_mem_used_avg_gib: Optional[float] = None
+    # queue-depth distribution over the run, from the monitor timeline
+    queue_depth_p50: Optional[float] = None
+    queue_depth_p95: Optional[float] = None
+    queue_depth_max: Optional[float] = None
 
     # cache
     cache_hit_ratio: Optional[float] = None
@@ -120,6 +128,14 @@ class Results:
     # {"queue"|"prefill"|"decode": {count, mean_ms, p50_ms, p95_ms,
     # max_ms}, "clock_offset_ms_est": ..., "source": "server:/traces"}
     phase_breakdown: Optional[dict[str, Any]] = None
+
+    # live-monitor summary (docs/MONITORING.md): rolling SLO burn-rates,
+    # detected events, sampler accounting and abort info — the shape
+    # validate_monitor checks, backed by runs/<id>/timeline.jsonl
+    monitor: Optional[dict[str, Any]] = None
+    # reason string when the run was early-terminated by the monitor's
+    # abort hook (sweeps record it per cell; absent for completed runs)
+    aborted_early: Optional[str] = None
 
     extras: dict[str, Any] = field(default_factory=dict)
 
@@ -281,4 +297,143 @@ def validate_traces(doc: Any) -> list[str]:
                         f"{where}: negative duration "
                         f"({s.get('name')}: {end} < {start})"
                     )
+    return errs
+
+
+# -- monitor block + timeline.jsonl schemas -----------------------------------
+#
+# The live-monitor surfaces (docs/MONITORING.md): the `monitor` block the
+# sampler merges into results.json and the per-line sample shape of
+# runs/<id>/timeline.jsonl. Hand-rolled validators for the same reason as
+# validate_traces — no jsonschema dependency in the harness layers.
+# `make bench-smoke` gates on both.
+
+MONITOR_JSON_SCHEMA: dict[str, Any] = {
+    "$schema": "http://json-schema.org/draft-07/schema#",
+    "title": "kvmini-tpu results.json `monitor` block",
+    "type": "object",
+    "required": ["interval_s", "samples", "skipped_samples", "events",
+                 "burn_rates", "burn_rates_peak"],
+    "properties": {
+        "interval_s": {"type": "number", "exclusiveMinimum": 0},
+        "window_s": {"type": "number"},
+        "samples": {"type": "integer", "minimum": 0},
+        "skipped_samples": {"type": "integer", "minimum": 0},
+        "events": {
+            "type": "array",
+            "items": {
+                "type": "object",
+                "required": ["t", "type", "detail"],
+                "properties": {
+                    "t": {"type": "number"},
+                    "type": {"type": "string"},
+                    "detail": {"type": "string"},
+                    "data": {"type": "object"},
+                },
+            },
+        },
+        "burn_rates": {
+            "type": "object", "additionalProperties": {"type": "number"}
+        },
+        "burn_rates_peak": {
+            "type": "object", "additionalProperties": {"type": "number"}
+        },
+        "aborted": {"type": "string"},
+    },
+}
+
+TIMELINE_SAMPLE_SCHEMA: dict[str, Any] = {
+    "$schema": "http://json-schema.org/draft-07/schema#",
+    "title": "kvmini-tpu timeline.jsonl sample (one JSON object per line)",
+    "type": "object",
+    "required": ["t"],
+    "properties": {
+        "t": {"type": "number"},
+        "scrape_ms": {"type": "number", "minimum": 0},
+        "runtime": {
+            "type": "object", "additionalProperties": {"type": "number"}
+        },
+        "loadgen": {
+            "type": "object", "additionalProperties": {"type": "number"}
+        },
+        "burn_rates": {
+            "type": "object", "additionalProperties": {"type": "number"}
+        },
+        "events": {"type": "array"},
+    },
+}
+
+
+def _num(v: Any) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def _rate_map_errs(v: Any, where: str) -> list[str]:
+    if not isinstance(v, dict):
+        return [f"{where} is not an object"]
+    return [
+        f"{where}[{k!r}] is not a number"
+        for k, val in v.items() if not _num(val)
+    ]
+
+
+def validate_monitor(doc: Any) -> list[str]:
+    """Validate a results.json ``monitor`` block against
+    MONITOR_JSON_SCHEMA's contract. Returns violations; empty = valid."""
+    errs: list[str] = []
+    if not isinstance(doc, dict):
+        return ["monitor block is not an object"]
+    for key in ("interval_s",):
+        if not _num(doc.get(key)) or doc.get(key) <= 0:
+            errs.append(f"{key} missing or not a positive number")
+    for key in ("samples", "skipped_samples"):
+        v = doc.get(key)
+        if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+            errs.append(f"{key} missing or not a non-negative integer")
+    events = doc.get("events")
+    if not isinstance(events, list):
+        errs.append("events missing or not an array")
+    else:
+        for i, e in enumerate(events):
+            if not isinstance(e, dict):
+                errs.append(f"events[{i}] is not an object")
+                continue
+            if not _num(e.get("t")):
+                errs.append(f"events[{i}].t missing or not a number")
+            for key in ("type", "detail"):
+                if not isinstance(e.get(key), str) or not e.get(key):
+                    errs.append(f"events[{i}].{key} missing or empty")
+    for key in ("burn_rates", "burn_rates_peak"):
+        errs += _rate_map_errs(doc.get(key), key)
+    if "aborted" in doc and not isinstance(doc["aborted"], str):
+        errs.append("aborted is not a string")
+    return errs
+
+
+def validate_timeline(samples: list[Any]) -> list[str]:
+    """Validate parsed timeline.jsonl samples (RunDir.read_timeline)
+    against TIMELINE_SAMPLE_SCHEMA's contract: every line an object with
+    a numeric monotone-friendly ``t``, and the runtime/loadgen/burn_rates
+    blocks flat name->number maps."""
+    errs: list[str] = []
+    prev_t: Optional[float] = None
+    for i, s in enumerate(samples):
+        where = f"sample[{i}]"
+        if not isinstance(s, dict):
+            errs.append(f"{where} is not an object")
+            continue
+        t = s.get("t")
+        if not _num(t):
+            errs.append(f"{where}.t missing or not a number")
+        else:
+            if prev_t is not None and t < prev_t:
+                errs.append(f"{where}.t went backwards ({t} < {prev_t})")
+            prev_t = float(t)
+        if "scrape_ms" in s and not _num(s["scrape_ms"]):
+            errs.append(f"{where}.scrape_ms is not a number")
+        for block in ("runtime", "loadgen", "burn_rates"):
+            if block in s:
+                errs += _rate_map_errs(s[block], f"{where}.{block}")
+        if "events" in s and not isinstance(s["events"], list):
+            errs.append(f"{where}.events is not an array")
     return errs

@@ -48,6 +48,40 @@ def sample_power_once(
     return None, "unavailable"
 
 
+def power_from_timeline(
+    timeline: list[dict[str, Any]],
+    accelerator: Optional[str] = None,
+    interval_s: float = 1.0,
+) -> dict[str, Any]:
+    """Derive a power.json-shaped doc from monitor timeline samples
+    (monitor/sampler.py) instead of running a second 1 Hz scrape loop
+    against the same endpoint during a benchmark (docs/MONITORING.md).
+
+    Per-sample duty prefers the windowed value — delta of the
+    kvmini_tpu_busy_seconds_total counter over the sample gap, falling
+    back to the cumulative duty-cycle gauge (telemetry.
+    windowed_duty_series, shared with the report's timeline lane); watts
+    are always modeled (duty x TDP), provenance says so."""
+    duties = telemetry.windowed_duty_series([
+        (float(s["t"]), s["runtime"])
+        for s in timeline
+        if isinstance(s.get("t"), (int, float))
+        and isinstance(s.get("runtime"), dict)
+    ])
+    pts = [
+        {"t": t, "watts": telemetry.modeled_power(duty, accelerator)}
+        for t, duty in duties
+    ]
+    return {
+        "samples": pts,
+        "provenance": "modeled" if pts else "unavailable",
+        "interval_s": interval_s,
+        "source": "timeline",
+        "started_at": pts[0]["t"] if pts else None,
+        "finished_at": pts[-1]["t"] if pts else None,
+    }
+
+
 def collect_power(
     run_dir: RunDir,
     prom_url: Optional[str],
@@ -56,9 +90,18 @@ def collect_power(
     duration_s: Optional[float] = None,
     accelerator: Optional[str] = None,
     stop_check=None,
+    timeline: Optional[list[dict[str, Any]]] = None,
 ) -> dict[str, Any]:
     """Sampling loop -> power.json. Runs until duration elapses or
-    ``stop_check()`` returns True."""
+    ``stop_check()`` returns True.
+
+    ``timeline``: pre-collected monitor samples — converts them instead
+    of opening a second scrape loop against the same endpoint (the
+    monitor already paid those scrapes; see power_from_timeline)."""
+    if timeline is not None:
+        doc = power_from_timeline(timeline, accelerator, interval_s=interval_s)
+        run_dir.write_power(doc)
+        return doc
     samples: list[dict[str, float]] = []
     provenance = "unavailable"
     t_start = time.time()
@@ -121,6 +164,18 @@ def integrate_energy(
     """
     power = run_dir.read_power()
     samples = power.get("samples", [])
+    if not samples:
+        # no power.json (or an empty one): integrate from the monitor's
+        # timeline when the run has one — the sampler already carried
+        # duty/busy at 1 Hz, there is no reason to report 0 Wh
+        timeline = run_dir.read_timeline()
+        if timeline:
+            power = power_from_timeline(
+                timeline, run_dir.read_meta().get("accelerator")
+            )
+            samples = power.get("samples", [])
+            if samples:
+                run_dir.write_power(power)
     records = run_dir.read_requests()
     t0, t1 = window_bounds(records)
 

@@ -46,7 +46,7 @@ METRIC_TOKEN = re.compile(r"kvmini_tpu_\w+")
 EXPOSITION_PREFIX = re.compile(r"^(?:#\s*(?:TYPE|HELP)\s+)?(kvmini_tpu_\w+)")
 EMITTER_PATH = re.compile(r"(^|/)runtime/")
 CONSUMER_PATH = re.compile(
-    r"(^|/)(analysis|loadgen|probes|energy|compare|gates|report|costs)/"
+    r"(^|/)(analysis|loadgen|probes|energy|compare|gates|report|costs|monitor)/"
 )
 HISTOGRAM_SUFFIXES = ("_bucket", "_sum", "_count")
 

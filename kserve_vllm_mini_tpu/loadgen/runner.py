@@ -15,7 +15,9 @@ from __future__ import annotations
 import argparse
 import asyncio
 import sys
+import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
@@ -26,6 +28,65 @@ from kserve_vllm_mini_tpu.loadgen.adapters.base import GenParams, ProtocolAdapte
 from kserve_vllm_mini_tpu.loadgen.arrivals import duration_and_rps, generate_arrival_times
 from kserve_vllm_mini_tpu.loadgen.prompts import make_prompt_fn
 from kserve_vllm_mini_tpu.loadgen.tracing import TraceCollector, new_trace_id, traceparent
+
+
+class LiveStats:
+    """Thread-safe live view of an in-progress load run.
+
+    Workers (asyncio, one thread) update it; the run monitor
+    (monitor/sampler.py, its own thread) polls ``snapshot()`` and
+    ``completions()`` at ~1 Hz for the timeline and rolling burn-rate
+    windows — hence the lock and the bounded completion deque (the
+    monitor only ever looks back one window, not the whole run)."""
+
+    def __init__(self, max_events: int = 8192) -> None:
+        self._lock = threading.Lock()
+        self.started = 0
+        self.inflight = 0
+        self.completed = 0
+        self.errors = 0
+        self.tokens_out = 0
+        self.skipped = 0  # scheduled requests dropped by an early abort
+        # (end_ts, ok, latency_ms, ttft_ms, tokens_out) per completion
+        self._events: deque[tuple[float, bool, float, float, int]] = deque(
+            maxlen=max_events
+        )
+
+    def record_start(self) -> None:
+        with self._lock:
+            self.started += 1
+            self.inflight += 1
+
+    def record_done(self, rec: RequestRecord) -> None:
+        with self._lock:
+            self.inflight -= 1
+            self.completed += 1
+            if not rec.ok:
+                self.errors += 1
+            self.tokens_out += rec.tokens_out
+            self._events.append(
+                (rec.end_ts, rec.ok, rec.latency_ms, rec.ttft_ms,
+                 rec.tokens_out)
+            )
+
+    def record_skipped(self) -> None:
+        with self._lock:
+            self.skipped += 1
+
+    def snapshot(self) -> dict[str, int]:
+        with self._lock:
+            return {
+                "started": self.started,
+                "inflight": self.inflight,
+                "completed": self.completed,
+                "errors": self.errors,
+                "tokens_out": self.tokens_out,
+                "skipped": self.skipped,
+            }
+
+    def completions(self) -> list[tuple[float, bool, float, float, int]]:
+        with self._lock:
+            return list(self._events)
 
 
 @dataclass
@@ -99,7 +160,9 @@ async def _worker(
     sem: asyncio.Semaphore,
     prompt_fn,
     tracer: TraceCollector,
-) -> RequestRecord:
+    live: Optional[LiveStats] = None,
+    abort_evt: Optional[asyncio.Event] = None,
+) -> Optional[RequestRecord]:
     trace_id = new_trace_id()
     rec = RequestRecord(
         request_id=f"req-{idx:06d}",
@@ -113,10 +176,34 @@ async def _worker(
     wait_span = tracer.span("client.wait_scheduled", trace_id, parent=root)
     delay = rec.scheduled_ts - time.time()
     if delay > 0:
-        await asyncio.sleep(delay)
+        if abort_evt is not None:
+            # an abort wakes every waiting worker immediately instead of
+            # letting the remaining schedule play out
+            try:
+                await asyncio.wait_for(abort_evt.wait(), timeout=delay)
+            except asyncio.TimeoutError:  # kvmini: workload-ok — the timeout
+                pass  # IS the scheduled arrival (no abort happened); the
+                      # abort path below stamps meta aborted_early/skipped
+        else:
+            await asyncio.sleep(delay)
     wait_span.end()
+    if abort_evt is not None and abort_evt.is_set():
+        # not-yet-sent request dropped by an early abort: no record at all
+        # (a fabricated error row would poison error_rate); the drop is
+        # surfaced via meta.json requests_skipped + results aborted_early
+        root.end(ok=False)
+        if live is not None:
+            live.record_skipped()
+        return None
 
     async with sem:
+        if abort_evt is not None and abort_evt.is_set():
+            # aborted while queued on the concurrency cap: same drop as
+            # above — the semaphore wait is queueing, not service
+            root.end(ok=False)
+            if live is not None:
+                live.record_skipped()
+            return None
         prompt = prompt_fn(idx)
         model = cfg.models[idx % len(cfg.models)] if cfg.models else cfg.model
         rec.model = model
@@ -125,6 +212,8 @@ async def _worker(
         )
         headers = dict(cfg.headers)
         headers["traceparent"] = traceparent(trace_id, http_span.span_id)
+        if live is not None:
+            live.record_start()
         rec.start_ts = time.time()
         try:
             result = await adapter.generate(
@@ -164,10 +253,21 @@ async def _worker(
         rec.ttft_ms = rec.latency_ms  # non-streaming: whole response is "first token"
     root.set("tokens_out", rec.tokens_out)
     root.end(ok=rec.ok)
+    if live is not None:
+        live.record_done(rec)
     return rec
 
 
-async def run_load_async(cfg: LoadConfig, run_dir: RunDir) -> list[RequestRecord]:
+async def run_load_async(
+    cfg: LoadConfig,
+    run_dir: RunDir,
+    live: Optional[LiveStats] = None,
+    abort: Optional[Any] = None,
+) -> list[RequestRecord]:
+    """``live``: a LiveStats the run monitor polls; ``abort``: a
+    monitor AbortSignal (monitor/events.py) — when set mid-run, waiting
+    workers wake and drop their un-sent requests (in-flight requests
+    drain normally) so a hopeless sweep cell stops burning wall-clock."""
     dur, rps = duration_and_rps(cfg.num_requests, cfg.concurrency, cfg.target_rps, cfg.duration_s)
     arrivals = generate_arrival_times(cfg.pattern, cfg.num_requests, dur, seed=cfg.seed)
     adapter = get_adapter(cfg.backend)
@@ -196,6 +296,25 @@ async def run_load_async(cfg: LoadConfig, run_dir: RunDir) -> list[RequestRecord
     )
     tracer = TraceCollector()
     sem = asyncio.Semaphore(cfg.concurrency)
+    abort_evt: Optional[asyncio.Event] = None
+    if abort is not None:
+        abort_evt = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        evt = abort_evt
+
+        def _wake_loop() -> None:
+            # the monitor thread sets the signal; hop back onto this
+            # loop. The signal can also fire AFTER this load completed
+            # and asyncio.run closed the loop — then there is nothing
+            # left to wake and the closed-loop error must not propagate
+            # into the monitor thread mid-sample.
+            try:
+                loop.call_soon_threadsafe(evt.set)
+            except RuntimeError:  # kvmini: workload-ok — loop already
+                pass              # closed: the run is over, nothing to
+                                  # abort; the signal flag itself is set
+
+        abort.on_set(_wake_loop)
     t_start = time.time()
     limits = httpx.Limits(
         max_connections=cfg.concurrency + 4, max_keepalive_connections=cfg.concurrency
@@ -203,38 +322,57 @@ async def run_load_async(cfg: LoadConfig, run_dir: RunDir) -> list[RequestRecord
     async with httpx.AsyncClient(timeout=cfg.timeout_s, limits=limits) as client:
         records = await asyncio.gather(
             *(
-                _worker(i, off, t_start, cfg, adapter, client, sem, prompt_fn, tracer)
+                _worker(i, off, t_start, cfg, adapter, client, sem, prompt_fn,
+                        tracer, live=live, abort_evt=abort_evt)
                 for i, off in enumerate(arrivals)
             )
         )
-    records = sorted(records, key=lambda r: r.start_ts)
+    skipped = sum(1 for r in records if r is None)
+    records = sorted((r for r in records if r is not None),
+                     key=lambda r: r.start_ts)
+    aborted_reason = getattr(abort, "reason", None) if abort is not None else None
+    if skipped:
+        # the run measured FEWER requests than configured — say so loudly
+        # (same surfacing contract as the truncation warnings)
+        print(
+            f"loadgen WARNING: aborted early ({aborted_reason}); "
+            f"{skipped}/{cfg.num_requests} scheduled requests were never sent",
+            file=sys.stderr,
+        )
+    meta = {
+        "url": cfg.url,
+        "model": cfg.model,
+        "models": cfg.models,
+        "backend": cfg.backend,
+        "pattern": cfg.pattern,
+        "requests": cfg.num_requests,
+        "concurrency": cfg.concurrency,
+        "streaming": cfg.streaming,
+        "max_tokens": cfg.max_tokens,
+        "prompt_set": cfg.prompt_set,
+        "seed": cfg.seed,
+        "sampling_seed": cfg.sampling_seed,
+        "target_rps": rps,
+        "planned_duration_s": dur,
+        "started_at": t_start,
+        "finished_at": time.time(),
+    }
+    if skipped:
+        meta["requests_skipped"] = skipped
+        meta["aborted_early"] = aborted_reason or "aborted"
+    run_dir.write_meta(meta)
     run_dir.write_requests(records)
-    run_dir.write_meta(
-        {
-            "url": cfg.url,
-            "model": cfg.model,
-            "models": cfg.models,
-            "backend": cfg.backend,
-            "pattern": cfg.pattern,
-            "requests": cfg.num_requests,
-            "concurrency": cfg.concurrency,
-            "streaming": cfg.streaming,
-            "max_tokens": cfg.max_tokens,
-            "prompt_set": cfg.prompt_set,
-            "seed": cfg.seed,
-            "sampling_seed": cfg.sampling_seed,
-            "target_rps": rps,
-            "planned_duration_s": dur,
-            "started_at": t_start,
-            "finished_at": time.time(),
-        }
-    )
     tracer.export(run_dir.traces_json)
     return list(records)
 
 
-def run_load(cfg: LoadConfig, run_dir: RunDir) -> list[RequestRecord]:
-    return asyncio.run(run_load_async(cfg, run_dir))
+def run_load(
+    cfg: LoadConfig,
+    run_dir: RunDir,
+    live: Optional[LiveStats] = None,
+    abort: Optional[Any] = None,
+) -> list[RequestRecord]:
+    return asyncio.run(run_load_async(cfg, run_dir, live=live, abort=abort))
 
 
 # -- CLI ---------------------------------------------------------------------

@@ -1,0 +1,26 @@
+"""Live run monitoring (docs/MONITORING.md).
+
+One background sampler thread per benchmark run unifies the two views the
+post-hoc pipeline previously kept separate — the runtime's ``/metrics``
+exposition and the load generator's in-flight/completed/latency state —
+into ``runs/<id>/timeline.jsonl`` at ~1 Hz, computes rolling-window SLO
+burn-rates from the same budgets ``gates/slo.py`` gates on after the
+fact, detects degradation events (stalls, queue runaway, throughput
+collapse, duty-cycle drop, budget burn) and can raise an
+:class:`AbortSignal` that the load generator and sweeps consume to
+early-terminate hopeless configurations.
+"""
+
+from kserve_vllm_mini_tpu.monitor.burnrate import burn_rates, window_stats
+from kserve_vllm_mini_tpu.monitor.events import AbortSignal, Event, EventDetector
+from kserve_vllm_mini_tpu.monitor.sampler import MonitorConfig, RunMonitor
+
+__all__ = [
+    "AbortSignal",
+    "Event",
+    "EventDetector",
+    "MonitorConfig",
+    "RunMonitor",
+    "burn_rates",
+    "window_stats",
+]

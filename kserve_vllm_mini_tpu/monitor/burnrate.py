@@ -1,0 +1,108 @@
+"""Rolling-window SLO burn-rates over live load-generator completions.
+
+The budgets are the SAME file gates/slo.py gates on post-hoc (slo.json
+keys like ``p95_ms_max`` / ``throughput_rps_min``); the monitor evaluates
+the subset computable from a sliding window of completed requests while
+the run is still going. Burn rate is normalized budget consumption:
+
+- ``max`` budgets (latency, error rate): ``value / budget``
+- ``min`` budgets (throughput): ``budget / value``
+
+so 1.0 means exactly on budget and anything above 1.0 means the current
+window is out of budget — a sustained burn > threshold is grounds to
+abort a sweep cell early (docs/MONITORING.md).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from kserve_vllm_mini_tpu.gates.slo import BUDGET_RULES
+
+# budget keys whose results-metric can be recomputed from a live window of
+# request completions (the rest — cost, energy, cold multiplier, fairness —
+# need post-hoc stages and are gated only at the end)
+LIVE_BUDGET_KEYS = (
+    "p95_ms_max",
+    "p99_ms_max",
+    "ttft_p95_ms_max",
+    "error_rate_max",
+    "throughput_rps_min",
+    "tokens_per_sec_min",
+)
+
+# ceiling for a burn rate (division by ~zero): keeps the serialized
+# monitor block strict JSON — float('inf') would render as Infinity
+BURN_CAP = 1e9
+
+
+def window_stats(
+    events: list[tuple[float, bool, float, float, int]],
+    t_now: float,
+    window_s: float,
+    t_start: Optional[float] = None,
+) -> dict[str, float]:
+    """Live metrics over completions inside ``[t_now - window_s, t_now]``.
+
+    ``events`` rows are ``(end_ts, ok, latency_ms, ttft_ms, tokens_out)``
+    (loadgen LiveStats.completions). Returns only keys the window can
+    honestly back: an empty window yields an empty dict, never zeros
+    that would read as "infinitely fast".
+
+    ``t_start`` (when the run began) shrinks the rate divisor for a
+    window that is only partially populated yet: dividing 2 completions
+    at t=2s of a run by the full 10 s window would read 0.2 rps where the
+    true early throughput is 1 rps — min-direction burn rates would spike
+    and abort perfectly healthy runs at startup.
+    """
+    from kserve_vllm_mini_tpu.analysis.telemetry import nearest_rank_percentile
+
+    cut = t_now - window_s
+    span = window_s
+    if t_start is not None:
+        span = max(min(window_s, t_now - t_start), 1e-9)
+    win = [e for e in events if e[0] >= cut and e[0] <= t_now]
+    if not win:
+        return {}
+    ok = [e for e in win if e[1]]
+    out: dict[str, float] = {
+        "window_s": span,
+        "completed": float(len(win)),
+        "error_rate": (len(win) - len(ok)) / len(win),
+        "throughput_rps": len(win) / span,
+    }
+    if ok:
+        lats = [e[2] for e in ok]
+        out["p95_ms"] = nearest_rank_percentile(lats, 95.0)
+        out["p99_ms"] = nearest_rank_percentile(lats, 99.0)
+        ttfts = [e[3] for e in ok if e[3] > 0]
+        if ttfts:
+            out["ttft_p95_ms"] = nearest_rank_percentile(ttfts, 95.0)
+        out["tokens_per_sec"] = sum(e[4] for e in ok) / span
+    return out
+
+
+def burn_rates(
+    stats: dict[str, float], budgets: dict[str, float]
+) -> dict[str, float]:
+    """Normalized budget consumption per live budget key; keys whose
+    metric the window could not produce are omitted (absence of data is
+    not a pass — but it is not a live abort signal either; the post-hoc
+    gate still fails on missing metrics). Rates are capped at BURN_CAP so
+    a zero-throughput window stays strict JSON (Infinity is not)."""
+    out: dict[str, float] = {}
+    for key in LIVE_BUDGET_KEYS:
+        budget = budgets.get(key)
+        if budget is None:
+            continue
+        metric, direction = BUDGET_RULES[key]
+        value: Optional[Any] = stats.get(metric)
+        if value is None:
+            continue
+        value = float(value)
+        if direction == "max":
+            rate = value / budget if budget > 0 else BURN_CAP
+        else:
+            rate = budget / value if value > 0 else BURN_CAP
+        out[key] = min(rate, BURN_CAP)
+    return out

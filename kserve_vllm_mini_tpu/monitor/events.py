@@ -1,0 +1,304 @@
+"""Typed degradation events over the timeline stream + the abort hook.
+
+The detector is pure host-side state over the sample dicts the sampler
+produces (monitor/sampler.py) — no IO, no clock reads of its own — so
+every rule is unit-testable from synthetic sample lists. Event taxonomy
+and default thresholds are documented in docs/MONITORING.md; a rule only
+ever fires once per run (a stalled run would otherwise emit one event
+per sample and drown the log).
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+EVENT_TYPES = (
+    "throughput_collapse",
+    "decode_stall",
+    "queue_depth_runaway",
+    "duty_cycle_drop",
+    "burn_rate_exceeded",
+)
+
+
+class AbortSignal:
+    """Thread-safe one-shot abort flag with a reason and callbacks.
+
+    The monitor thread sets it; the load generator registers a callback
+    that wakes its asyncio loop (loadgen/runner.py), and sweeps read the
+    reason into the cell's results as ``aborted_early``. ``set`` is
+    idempotent — the first reason wins, later calls are ignored.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._event = threading.Event()
+        self._reason: Optional[str] = None
+        self._callbacks: list[Callable[[], None]] = []
+
+    def set(self, reason: str) -> None:
+        with self._lock:
+            if self._event.is_set():
+                return
+            self._reason = reason
+            self._event.set()
+            callbacks = list(self._callbacks)
+        for cb in callbacks:
+            try:
+                cb()
+            except Exception as e:  # noqa: BLE001 — notification is
+                # best-effort: a dead listener (e.g. a load loop that
+                # already finished) must not crash the monitor thread
+                # mid-sample; the flag itself IS set either way
+                print(f"monitor: abort callback failed: {e}", file=sys.stderr)
+
+    def is_set(self) -> bool:
+        return self._event.is_set()
+
+    @property
+    def reason(self) -> Optional[str]:
+        return self._reason
+
+    def on_set(self, callback: Callable[[], None]) -> None:
+        """Register a callback fired when the signal is set. Fires
+        immediately (in the caller's thread) if already set."""
+        with self._lock:
+            if not self._event.is_set():
+                self._callbacks.append(callback)
+                return
+        callback()
+
+
+@dataclass
+class Event:
+    """One detected degradation; serialized into timeline.jsonl and the
+    results.json ``monitor`` block (core/schema.py validate_monitor)."""
+
+    t: float
+    type: str
+    detail: str
+    data: dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> dict[str, Any]:
+        return {"t": self.t, "type": self.type, "detail": self.detail,
+                "data": self.data}
+
+
+def _runtime(sample: dict[str, Any], key: str) -> Optional[float]:
+    v = (sample.get("runtime") or {}).get(key)
+    return float(v) if v is not None else None
+
+
+def _loadgen(sample: dict[str, Any], key: str) -> Optional[float]:
+    v = (sample.get("loadgen") or {}).get(key)
+    return float(v) if v is not None else None
+
+
+class EventDetector:
+    """Stateful rule evaluation over successive samples.
+
+    ``observe(sample, burn)`` returns newly-fired events (each type at
+    most once per run). Thresholds are constructor args so tests can
+    hand-compute fixtures; defaults documented in docs/MONITORING.md.
+    """
+
+    def __init__(
+        self,
+        stall_samples: int = 5,
+        queue_samples: int = 5,
+        queue_depth_limit: float = 32.0,
+        collapse_fraction: float = 0.3,
+        duty_drop_fraction: float = 0.25,
+        burn_threshold: float = 2.0,
+        burn_samples: int = 3,
+        warmup_s: float = 5.0,
+    ) -> None:
+        self.stall_samples = stall_samples
+        self.queue_samples = queue_samples
+        self.queue_depth_limit = queue_depth_limit
+        self.collapse_fraction = collapse_fraction
+        self.duty_drop_fraction = duty_drop_fraction
+        self.burn_threshold = burn_threshold
+        self.burn_samples = burn_samples
+        self.warmup_s = warmup_s
+        self._fired: set[str] = set()
+        self._t0: Optional[float] = None
+        self._prev: Optional[dict[str, Any]] = None
+        self._decode_progressed = False
+        self._stall_run = 0
+        self._queue_run = 0
+        self._burn_run = 0
+        self._peak_throughput = 0.0
+        self._peak_duty = 0.0
+
+    # -- individual rules --------------------------------------------------
+
+    def _check_decode_stall(self, sample: dict[str, Any]) -> Optional[Event]:
+        """Engine counters frozen across N samples while requests are in
+        flight: the decode loop STOPPED making progress (e.g. a wedged
+        sweep) — wall-clock keeps burning with nothing to show. Armed
+        only after progress has been observed at least once: a cold
+        engine spends its first requests in XLA compile with the
+        counters legitimately frozen at zero (and a server that never
+        progresses at all shows up in the burn rates instead)."""
+        inflight = _loadgen(sample, "inflight")
+        steps = _runtime(sample, "decode_steps_total")
+        prev = self._prev
+        prev_steps = _runtime(prev, "decode_steps_total") if prev else None
+        if steps is not None and prev_steps is not None and steps != prev_steps:
+            self._decode_progressed = True
+        sweeps = _runtime(sample, "pipelined_sweeps_total")
+        if (
+            prev is not None
+            and self._decode_progressed
+            and inflight
+            and steps is not None
+            and steps == prev_steps
+            and (sweeps is None
+                 or sweeps == _runtime(prev, "pipelined_sweeps_total"))
+        ):
+            self._stall_run += 1
+        else:
+            self._stall_run = 0
+        if self._stall_run >= self.stall_samples:
+            return Event(
+                sample["t"], "decode_stall",
+                f"no decode progress for {self._stall_run} consecutive "
+                f"samples with {int(inflight)} request(s) in flight",
+                {"samples": self._stall_run, "inflight": inflight},
+            )
+        return None
+
+    def _check_queue_runaway(self, sample: dict[str, Any]) -> Optional[Event]:
+        depth = _runtime(sample, "queue_depth")
+        prev_depth = (
+            _runtime(self._prev, "queue_depth") if self._prev else None
+        )
+        if (
+            depth is not None
+            and prev_depth is not None
+            and depth > prev_depth
+        ):
+            self._queue_run += 1
+        else:
+            self._queue_run = 0
+        if (
+            depth is not None
+            and depth >= self.queue_depth_limit
+            and self._queue_run >= self.queue_samples
+        ):
+            return Event(
+                sample["t"], "queue_depth_runaway",
+                f"queue depth grew {self._queue_run} samples in a row to "
+                f"{depth:g} (limit {self.queue_depth_limit:g})",
+                {"queue_depth": depth, "samples": self._queue_run},
+            )
+        return None
+
+    def _check_throughput_collapse(self, sample: dict[str, Any]) -> Optional[Event]:
+        rps = _loadgen(sample, "window_throughput_rps")
+        if rps is None or self._t0 is None:
+            return None
+        if sample["t"] - self._t0 < self.warmup_s:
+            self._peak_throughput = max(self._peak_throughput, rps)
+            return None
+        inflight = _loadgen(sample, "inflight")
+        if (
+            self._peak_throughput > 0
+            and inflight
+            and rps < self.collapse_fraction * self._peak_throughput
+        ):
+            return Event(
+                sample["t"], "throughput_collapse",
+                f"window throughput {rps:.2f} rps fell below "
+                f"{self.collapse_fraction:.0%} of peak "
+                f"{self._peak_throughput:.2f} rps",
+                {"window_throughput_rps": rps,
+                 "peak_throughput_rps": self._peak_throughput},
+            )
+        self._peak_throughput = max(self._peak_throughput, rps)
+        return None
+
+    def _check_duty_drop(self, sample: dict[str, Any]) -> Optional[Event]:
+        """Windowed duty cycle (delta busy-seconds / delta wall) collapsed
+        while work was in flight. Needs the kvmini_tpu_busy_seconds_total
+        counter — the cumulative duty gauge flattens mid-run dips."""
+        prev = self._prev
+        busy = _runtime(sample, "busy_seconds_total")
+        if prev is None or busy is None:
+            return None
+        prev_busy = _runtime(prev, "busy_seconds_total")
+        dt = sample["t"] - prev["t"]
+        if prev_busy is None or dt <= 0:
+            return None
+        duty = max(min((busy - prev_busy) / dt, 1.0), 0.0)
+        inflight = _loadgen(sample, "inflight")
+        in_warmup = (
+            self._t0 is not None and sample["t"] - self._t0 < self.warmup_s
+        )
+        if (
+            not in_warmup
+            and self._peak_duty > 0.05
+            and inflight
+            and duty < self.duty_drop_fraction * self._peak_duty
+        ):
+            return Event(
+                sample["t"], "duty_cycle_drop",
+                f"windowed duty cycle {duty:.3f} fell below "
+                f"{self.duty_drop_fraction:.0%} of peak {self._peak_duty:.3f}",
+                {"windowed_duty_cycle": duty, "peak_duty_cycle": self._peak_duty},
+            )
+        self._peak_duty = max(self._peak_duty, duty)
+        return None
+
+    def _check_burn_rate(
+        self, sample: dict[str, Any], burn: dict[str, float]
+    ) -> Optional[Event]:
+        if (
+            self._t0 is not None
+            and sample["t"] - self._t0 < self.warmup_s
+        ):
+            # startup transients (partially-filled windows, first cold
+            # requests) must not abort a run in its first seconds
+            self._burn_run = 0
+            return None
+        over = {k: v for k, v in burn.items() if v > self.burn_threshold}
+        if over:
+            self._burn_run += 1
+        else:
+            self._burn_run = 0
+        if self._burn_run >= self.burn_samples:
+            worst = max(over, key=lambda k: over[k])
+            return Event(
+                sample["t"], "burn_rate_exceeded",
+                f"{worst} burn rate {over[worst]:.2f} > "
+                f"{self.burn_threshold:g} for {self._burn_run} consecutive "
+                "samples",
+                {"burn_rates": over, "samples": self._burn_run},
+            )
+        return None
+
+    # -- driver ------------------------------------------------------------
+
+    def observe(
+        self, sample: dict[str, Any], burn: Optional[dict[str, float]] = None
+    ) -> list[Event]:
+        if self._t0 is None:
+            self._t0 = float(sample["t"])
+        checks: list[tuple[str, Optional[Event]]] = [
+            ("decode_stall", self._check_decode_stall(sample)),
+            ("queue_depth_runaway", self._check_queue_runaway(sample)),
+            ("throughput_collapse", self._check_throughput_collapse(sample)),
+            ("duty_cycle_drop", self._check_duty_drop(sample)),
+            ("burn_rate_exceeded", self._check_burn_rate(sample, burn or {})),
+        ]
+        self._prev = sample
+        fired: list[Event] = []
+        for etype, evt in checks:
+            if evt is not None and etype not in self._fired:
+                self._fired.add(etype)
+                fired.append(evt)
+        return fired

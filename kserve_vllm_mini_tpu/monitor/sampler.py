@@ -1,0 +1,233 @@
+"""The 1 Hz unified sampler: runtime /metrics + loadgen live stats ->
+``runs/<id>/timeline.jsonl`` + burn-rates + events + abort.
+
+One background thread per run. Every tick it scrapes the runtime's
+``/metrics`` (reusing analysis/telemetry.scrape_runtime_metrics — the
+same parser the post-hoc analyzer uses, so names can't drift between
+live and post-hoc views), snapshots the load generator's LiveStats,
+appends one JSON line to the timeline, recomputes rolling-window SLO
+burn-rates (monitor/burnrate.py) and runs event detection
+(monitor/events.py). Overhead contract (docs/MONITORING.md): the scrape
+timeout is strictly below the sample interval, a tick that overruns its
+slot is SKIPPED (counted, never queued), and the thread never blocks the
+benchmark — stopping joins with a bounded timeout and the thread is a
+daemon.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Callable, Optional
+
+from kserve_vllm_mini_tpu.analysis import telemetry
+from kserve_vllm_mini_tpu.monitor import burnrate
+from kserve_vllm_mini_tpu.monitor.events import AbortSignal, Event, EventDetector
+
+# runtime /metrics series carried into each timeline sample, stored under
+# sample["runtime"] with the kvmini_tpu_ prefix stripped. Counters keep
+# their _total suffix so consumers can tell rates from gauges.
+TIMELINE_RUNTIME_METRICS = (
+    "kvmini_tpu_duty_cycle",
+    "kvmini_tpu_busy_seconds_total",
+    "kvmini_tpu_queue_depth",
+    "kvmini_tpu_active_slots",
+    "kvmini_tpu_inflight_sweeps",
+    "kvmini_tpu_decode_tokens_total",
+    "kvmini_tpu_decode_steps_total",
+    "kvmini_tpu_requests_completed_total",
+    "kvmini_tpu_pipelined_sweeps_total",
+    "kvmini_tpu_kv_free_blocks",
+)
+
+_PREFIX = "kvmini_tpu_"
+
+# event types that trigger the abort hook when abort is enabled: sustained
+# budget burn and a wedged decode loop are unrecoverable for the cell;
+# the other events are diagnostic (a bursty pattern legitimately collapses
+# throughput between bursts)
+DEFAULT_ABORT_ON = frozenset({"burn_rate_exceeded", "decode_stall"})
+
+
+@dataclass
+class MonitorConfig:
+    interval_s: float = 1.0
+    # strictly below interval_s: a slow endpoint costs one skipped tick,
+    # never a backlog
+    scrape_timeout_s: float = 0.8
+    window_s: float = 10.0
+    warmup_s: float = 5.0
+    burn_threshold: float = 2.0
+    burn_samples: int = 3
+    stall_samples: int = 5
+    queue_depth_limit: float = 32.0
+    abort_enabled: bool = False
+    abort_on: frozenset[str] = DEFAULT_ABORT_ON
+    budgets: dict[str, float] = field(default_factory=dict)
+
+    def __post_init__(self) -> None:
+        self.scrape_timeout_s = min(
+            self.scrape_timeout_s, max(self.interval_s * 0.8, 0.01)
+        )
+
+
+class RunMonitor:
+    """Background sampler for one benchmark run.
+
+    ``live`` is the loadgen's LiveStats (None for endpoint-only
+    monitoring); ``scrape_fn(endpoint, timeout_s)`` is injectable for
+    tests and defaults to the real /metrics scrape.
+    """
+
+    def __init__(
+        self,
+        timeline_path: Path,
+        endpoint: Optional[str],
+        live: Any = None,
+        cfg: Optional[MonitorConfig] = None,
+        abort: Optional[AbortSignal] = None,
+        scrape_fn: Optional[Callable[..., dict[str, float]]] = None,
+    ) -> None:
+        self.timeline_path = Path(timeline_path)
+        self.endpoint = endpoint
+        self.live = live
+        self.cfg = cfg or MonitorConfig()
+        self.abort = abort
+        self._scrape = scrape_fn or telemetry.scrape_runtime_metrics
+        self.samples: list[dict[str, Any]] = []
+        self.events: list[Event] = []
+        self.skipped = 0
+        self.burn_latest: dict[str, float] = {}
+        self.burn_peak: dict[str, float] = {}
+        self._detector = EventDetector(
+            stall_samples=self.cfg.stall_samples,
+            queue_depth_limit=self.cfg.queue_depth_limit,
+            burn_threshold=self.cfg.burn_threshold,
+            burn_samples=self.cfg.burn_samples,
+            warmup_s=self.cfg.warmup_s,
+        )
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._t_started: Optional[float] = None  # first tick (burn windows)
+
+    # -- one tick ----------------------------------------------------------
+
+    def _runtime_block(self) -> Optional[dict[str, float]]:
+        if not self.endpoint:
+            return None
+        m = self._scrape(self.endpoint, timeout_s=self.cfg.scrape_timeout_s)
+        if not m:
+            return None
+        return {
+            name[len(_PREFIX):]: m[name]
+            for name in TIMELINE_RUNTIME_METRICS
+            if name in m
+        }
+
+    def sample_once(self, fh=None) -> dict[str, Any]:
+        t_tick = time.time()
+        runtime = self._runtime_block()
+        scrape_ms = (time.time() - t_tick) * 1000.0
+        sample: dict[str, Any] = {"t": t_tick, "scrape_ms": round(scrape_ms, 3)}
+        if runtime is not None:
+            sample["runtime"] = runtime
+        if self.live is not None:
+            lg = self.live.snapshot()
+            if self._t_started is None:
+                self._t_started = t_tick
+            win = burnrate.window_stats(
+                self.live.completions(), t_tick, self.cfg.window_s,
+                t_start=self._t_started,
+            )
+            if not win and lg.get("completed"):
+                # completions happened EARLIER but none inside the window:
+                # the current throughput is genuinely zero, not unknown —
+                # without this, a server that wedges mid-run empties the
+                # window and the monitor goes blind exactly when it
+                # matters (no burn, no collapse event, no abort)
+                win = {"throughput_rps": 0.0, "tokens_per_sec": 0.0}
+            if "throughput_rps" in win:
+                lg["window_throughput_rps"] = round(win["throughput_rps"], 4)
+            sample["loadgen"] = lg
+            self.burn_latest = burnrate.burn_rates(win, self.cfg.budgets)
+            for k, v in self.burn_latest.items():
+                self.burn_peak[k] = max(self.burn_peak.get(k, 0.0), v)
+            if self.burn_latest:
+                sample["burn_rates"] = {
+                    k: round(v, 4) for k, v in self.burn_latest.items()
+                }
+        fired = self._detector.observe(sample, self.burn_latest)
+        if fired:
+            sample["events"] = [e.to_dict() for e in fired]
+            self.events.extend(fired)
+            for e in fired:
+                if (
+                    self.abort is not None
+                    and self.cfg.abort_enabled
+                    and e.type in self.cfg.abort_on
+                ):
+                    self.abort.set(f"{e.type}: {e.detail}")
+        self.samples.append(sample)
+        if fh is not None:
+            fh.write(json.dumps(sample, sort_keys=True) + "\n")
+            fh.flush()
+        return sample
+
+    # -- thread ------------------------------------------------------------
+
+    def _loop(self) -> None:
+        self.timeline_path.parent.mkdir(parents=True, exist_ok=True)
+        with self.timeline_path.open("a") as fh:
+            next_tick = time.time()
+            while True:
+                self.sample_once(fh)
+                next_tick += self.cfg.interval_s
+                now = time.time()
+                if now > next_tick:
+                    # the tick overran its slot (slow scrape / loaded
+                    # host): skip the missed slots rather than queue them
+                    # — a backlog of catch-up scrapes would hammer the
+                    # very endpoint the run is measuring
+                    missed = int((now - next_tick) / self.cfg.interval_s) + 1
+                    self.skipped += missed
+                    next_tick = now + self.cfg.interval_s
+                if self._stop.wait(timeout=max(next_tick - time.time(), 0.0)):
+                    return
+
+    def start(self) -> "RunMonitor":
+        if self._thread is not None:
+            raise RuntimeError("monitor already started")
+        self._thread = threading.Thread(
+            target=self._loop, name="run-monitor", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self, join_timeout_s: float = 5.0) -> dict[str, Any]:
+        """Signal the thread, join (bounded — a scrape stuck in its
+        timeout must not stall the pipeline), and return the summary
+        block for results.json."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=join_timeout_s)
+        return self.summary()
+
+    def summary(self) -> dict[str, Any]:
+        """The ``monitor`` block (core/schema.py validate_monitor)."""
+        out: dict[str, Any] = {
+            "interval_s": self.cfg.interval_s,
+            "window_s": self.cfg.window_s,
+            "samples": len(self.samples),
+            "skipped_samples": self.skipped,
+            "events": [e.to_dict() for e in self.events],
+            "burn_rates": {k: round(v, 4) for k, v in self.burn_latest.items()},
+            "burn_rates_peak": {
+                k: round(v, 4) for k, v in self.burn_peak.items()
+            },
+        }
+        if self.abort is not None and self.abort.is_set():
+            out["aborted"] = self.abort.reason
+        return out

@@ -126,6 +126,88 @@ def autoscale_timeline_chart(decisions: list[dict[str, Any]]) -> str:
     return _to_img(fig)
 
 
+def run_timeline_chart(
+    samples: list[dict[str, Any]], events: list[dict[str, Any]] | None = None
+) -> str:
+    """The monitor's 1 Hz timeline (docs/MONITORING.md) as three stacked
+    lanes — completion throughput, windowed duty cycle, queue depth —
+    with detected events as vertical markers. Mirrors the trace viewer's
+    role: the trace explains ONE request, the timeline explains the RUN."""
+    rows = [
+        s for s in samples
+        if isinstance(s.get("t"), (int, float))
+    ]
+    if len(rows) < 2:
+        return ""  # a sub-2-sample run has no timeline to draw — skip
+    if not HAVE_MPL:
+        return _placeholder("run timeline")
+    t0 = rows[0]["t"]
+    ts = [s["t"] - t0 for s in rows]
+
+    def series(block: str, key: str) -> list[tuple[float, float]]:
+        return [
+            (t, s[block][key])
+            for t, s in zip(ts, rows)
+            if isinstance(s.get(block), dict) and key in s[block]
+        ]
+
+    fig, axes = plt.subplots(3, 1, figsize=(7, 5), sharex=True)
+    ax_thr, ax_duty, ax_q = axes
+
+    thr = series("loadgen", "window_throughput_rps")
+    if thr:
+        ax_thr.plot([t for t, _ in thr], [v for _, v in thr],
+                    color=_PALETTE["primary"], linewidth=1.5)
+    ax_thr.set_ylabel("rps")
+    ax_thr.set_title("Run timeline")
+
+    # windowed duty from the busy-seconds counter, cumulative gauge as
+    # fallback — the same derivation energy integration uses
+    from kserve_vllm_mini_tpu.analysis.telemetry import windowed_duty_series
+
+    duty_pts = [
+        (t - t0, d)
+        for t, d in windowed_duty_series([
+            (s["t"], s["runtime"]) for s in rows
+            if isinstance(s.get("runtime"), dict)
+        ])
+    ]
+    if duty_pts:
+        ax_duty.plot([t for t, _ in duty_pts], [v for _, v in duty_pts],
+                     color=_PALETTE["warm"], linewidth=1.5)
+    ax_duty.set_ylabel("duty")
+    ax_duty.set_ylim(0, 1.05)
+
+    q = series("runtime", "queue_depth")
+    infl = series("loadgen", "inflight")
+    if q:
+        ax_q.plot([t for t, _ in q], [v for _, v in q],
+                  color=_PALETTE["cold"], linewidth=1.5, label="queue depth")
+    if infl:
+        ax_q.plot([t for t, _ in infl], [v for _, v in infl],
+                  color=_PALETTE["primary"], linewidth=1, linestyle="--",
+                  label="in flight")
+    if q or infl:
+        ax_q.legend(fontsize=8, loc="upper left")
+    ax_q.set_ylabel("requests")
+    ax_q.set_xlabel("time (s)")
+
+    for ax in axes:
+        ax.grid(color=_PALETTE["grid"], axis="y")
+        for e in events or []:
+            et = e.get("t")
+            if isinstance(et, (int, float)) and et >= t0:
+                ax.axvline(et - t0, color=_PALETTE["bad"], linestyle=":",
+                           linewidth=1)
+    for e in events or []:
+        et = e.get("t")
+        if isinstance(et, (int, float)) and et >= t0:
+            ax_thr.text(et - t0, ax_thr.get_ylim()[1] * 0.9,
+                        str(e.get("type", "event")), fontsize=7, rotation=90,
+                        color=_PALETTE["bad"], va="top")
+    return _to_img(fig)
+
+
 def cold_warm_chart(results: dict[str, Any]) -> str:
     cold, warm = results.get("cold_p95_ms"), results.get("warm_p95_ms")
     if not HAVE_MPL or cold is None or warm is None:

@@ -126,6 +126,63 @@ def _trace_viewer(run_dir: Optional[Path], results: dict[str, Any]) -> str:
     )
 
 
+def _duty_pct(results: dict[str, Any]) -> Optional[float]:
+    """Windowed average when a real window backed it; the instantaneous
+    snapshot otherwise (tpu_metrics_source says which — see
+    docs/MONITORING.md on the *_avg honesty rule)."""
+    duty = results.get("tpu_duty_cycle_avg")
+    if duty is None:
+        duty = results.get("tpu_duty_cycle")
+    return duty * 100 if duty is not None else None
+
+
+def _timeline_section(run_dir: Optional[Path], results: dict[str, Any]) -> str:
+    """Monitor timeline lane (docs/MONITORING.md): throughput / duty /
+    queue over the run with event markers, plus the burn-rate and abort
+    summary from the results `monitor` block. Renders beside the trace
+    viewer — the trace explains one request, this explains the run."""
+    if run_dir is None:
+        return ""
+    from kserve_vllm_mini_tpu.core.rundir import RunDir
+
+    samples = RunDir(run_dir).read_timeline()
+    mon = results.get("monitor") or {}
+    events = mon.get("events") or []
+    chart = charts.run_timeline_chart(samples, events)
+    if not chart and not mon:
+        return ""
+    parts = ["<section><h2>Run timeline</h2>"]
+    facts = []
+    if mon:
+        facts.append(f"{mon.get('samples', 0)} samples "
+                     f"@ {mon.get('interval_s', '?')}s")
+        if mon.get("skipped_samples"):
+            facts.append(f"{mon['skipped_samples']} skipped")
+        for key, label in (("burn_rates", "burn"),
+                           ("burn_rates_peak", "peak burn")):
+            rates = mon.get(key) or {}
+            if rates:
+                facts.append(label + " " + ", ".join(
+                    f"{k}={v:.2f}" for k, v in sorted(rates.items())
+                ))
+    if facts:
+        parts.append(f"<p>{html_mod.escape(' · '.join(facts))}</p>")
+    aborted = results.get("aborted_early") or mon.get("aborted")
+    if aborted:
+        parts.append(
+            f"<p class='bad'>aborted early: {html_mod.escape(str(aborted))}</p>"
+        )
+    for e in events:
+        parts.append(
+            f"<p class='warn'>event @ {e.get('t', 0):.0f}: "
+            f"{html_mod.escape(str(e.get('detail', e.get('type', '?'))))}</p>"
+        )
+    if chart:
+        parts.append(chart)
+    parts.append("</section>")
+    return "".join(parts)
+
+
 def generate_single_run_html(
     results: dict[str, Any], run_dir: Optional[Path] = None
 ) -> str:
@@ -142,8 +199,7 @@ def generate_single_run_html(
             _card("error rate", (results.get("error_rate") or 0) * 100, "%"),
             _card("$/1K tokens", results.get("cost_per_1k_tokens")),
             _card("Wh/1K tokens", results.get("energy_wh_per_1k_tokens")),
-            _card("TPU duty", (results.get("tpu_duty_cycle_avg") or 0) * 100
-                  if results.get("tpu_duty_cycle_avg") is not None else None, "%"),
+            _card("TPU duty", _duty_pct(results), "%"),
             _card("cold multiplier", results.get("cold_multiplier"), "x"),
             _card("quality", results.get("quality_score")),
         ]
@@ -245,6 +301,7 @@ def generate_single_run_html(
         + "".join(f"<li>{html_mod.escape(r)}</li>" for r in recs)
         + "</ul></section>"
     )
+    sections.append(_timeline_section(run_dir, results))
     sections.append(_trace_viewer(run_dir, results))
     sections.append(
         "<section><h2>Raw results</h2><details><summary>results.json</summary>"

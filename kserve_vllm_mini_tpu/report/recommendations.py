@@ -16,7 +16,11 @@ from kserve_vllm_mini_tpu.costs.planner import DEFAULT_COLD_START_S, HOURS_PER_M
 
 def classify_bottleneck(results: dict[str, Any]) -> tuple[str, str]:
     """(label, explanation). Heuristics over the measured signals."""
+    # windowed average when a run carried one (Prometheus or the monitor
+    # timeline); the instantaneous end-of-run snapshot is the fallback
     duty = results.get("tpu_duty_cycle_avg")
+    if duty is None:
+        duty = results.get("tpu_duty_cycle")
     rtt_p95 = results.get("network_rtt_p95_ms")
     p95 = results.get("p95_ms")
     ttft_p95 = results.get("ttft_p95_ms")

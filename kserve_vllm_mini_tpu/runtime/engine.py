@@ -684,7 +684,7 @@ class Engine:
             "decode_steps": 0,
             "prefills": 0,
             "requests_completed": 0,
-            "busy_s": 0.0,        # kvmini: metrics-ok — raw input; exposed as duty_cycle
+            "busy_s": 0.0,        # exported: busy_seconds_total + duty_cycle
             "started_at": time.time(),  # kvmini: metrics-ok — raw input; exposed as duty_cycle
             "queue_depth": 0,
             "spec_rounds": 0,       # fused drafter-propose/target-verify rounds

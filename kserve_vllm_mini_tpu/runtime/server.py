@@ -1132,6 +1132,12 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
             f"kvmini_tpu_requests_completed_total {s['requests_completed']}",
             "# TYPE kvmini_tpu_duty_cycle gauge",
             f"kvmini_tpu_duty_cycle {s['duty_cycle']:.6f}",
+            # raw busy-time as a counter so consumers can compute WINDOWED
+            # duty (delta busy / delta wall) — the gauge above is
+            # cumulative-since-start and flattens mid-run stalls; the live
+            # monitor (docs/MONITORING.md) and Prometheus rate() need this
+            "# TYPE kvmini_tpu_busy_seconds_total counter",
+            f"kvmini_tpu_busy_seconds_total {s['busy_s']:.6f}",
             "# TYPE kvmini_tpu_queue_depth gauge",
             f"kvmini_tpu_queue_depth {s['queue_depth']}",
             "# TYPE kvmini_tpu_active_slots gauge",

@@ -29,6 +29,9 @@ RESULT_KEYS = (
     "cost_per_1k_tokens",
     "energy_wh_per_1k_tokens",
     "cold_multiplier",
+    # monitor early-abort reason (docs/MONITORING.md): a cell the live
+    # monitor terminated records why; blank for cells that ran out
+    "aborted_early",
 )
 
 
@@ -116,6 +119,11 @@ def run_sweep(
                 row.update(extra_row_fn(cfg, results))
             row["status"] = "ok"
             row["error"] = ""
+            if row.get("aborted_early"):
+                # the cell's partial metrics are still recorded, but the
+                # operator must see WHY the cell stopped early
+                print(f"{label}: aborted early: {row['aborted_early']}",
+                      file=sys.stderr)
         except Exception as e:  # noqa: BLE001 — record-and-continue is the contract
             if extra_row_fn is not None:
                 row.update(extra_row_fn(cfg, {}))

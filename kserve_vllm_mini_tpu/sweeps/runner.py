@@ -18,6 +18,13 @@ def register(parser: argparse.ArgumentParser) -> None:
     common.add_argument("--model", default=None)
     common.add_argument("--requests", type=int, default=None)
     common.add_argument("--concurrency", type=int, default=None)
+    common.add_argument("--abort-slo", default=None,
+                        help="Budgets JSON for the live monitor; cells "
+                             "whose rolling burn-rate stays over budget "
+                             "abort early and record aborted_early "
+                             "(docs/MONITORING.md)")
+    common.add_argument("--no-monitor", action="store_true",
+                        help="Disable the per-cell live monitor/timeline")
 
     g = sub.add_parser("grid", parents=[common],
                        help="concurrency x max_tokens x pattern")
@@ -65,6 +72,14 @@ def _base_profile(args: argparse.Namespace) -> dict[str, Any]:
     profile.setdefault("model", "llama-tiny")
     profile.setdefault("requests", 30)
     profile.setdefault("concurrency", 8)
+    # monitor knobs ride the profile: run_bench honors monitor/
+    # monitor_slo/monitor_abort profile keys, so every sweep kind gets
+    # early-abort without threading new parameters through each module
+    if getattr(args, "no_monitor", False):
+        profile["monitor"] = False
+    if getattr(args, "abort_slo", None):
+        profile["monitor_slo"] = args.abort_slo
+        profile["monitor_abort"] = True
     return profile
 
 

@@ -36,12 +36,44 @@ class MockStats:
     streamed: int = 0
 
 
+def scripted_metrics(
+    rates: dict[str, float],
+    base: dict[str, float] | None = None,
+    stall: tuple[float, float] | None = None,
+    stall_values: dict[str, float] | None = None,
+):
+    """Build a ``metrics_script`` callable for time-varying ``/metrics``:
+    each counter in ``rates`` ramps linearly (units/second) from app
+    start, FREEZING inside the ``stall`` window (start_s, end_s) — the
+    scripted mid-run stall the monitor's decode-stall detector must catch
+    (docs/MONITORING.md). ``base`` gauges are served as-is outside the
+    stall; ``stall_values`` overrides them inside it (e.g. a collapsed
+    duty cycle)."""
+
+    def active_seconds(elapsed: float) -> float:
+        if stall is None:
+            return elapsed
+        s0, s1 = stall
+        return elapsed - max(0.0, min(elapsed, s1) - s0)
+
+    def at(elapsed: float) -> dict[str, float]:
+        out = dict(base or {})
+        for name, rate in rates.items():
+            out[name] = rate * active_seconds(elapsed)
+        if stall is not None and stall[0] <= elapsed < stall[1]:
+            out.update(stall_values or {})
+        return out
+
+    return at
+
+
 def make_app(
     token_delay_s: float = 0.002,
     n_tokens: int = 8,
     fail_every: int = 0,
     capabilities: set[str] | None = None,
     pipeline_metrics: dict[str, float] | None = None,
+    metrics_script=None,
 ) -> web.Application:
     """``capabilities`` toggles OpenAI-dialect extras for parity-probe tests:
     any subset of {"tools", "parallel_tools", "json_mode", "logprobs",
@@ -49,7 +81,11 @@ def make_app(
 
     ``pipeline_metrics`` overrides the decode-pipeline gauges the /metrics
     endpoint reports (kvmini_tpu_* names, docs/DECODE_PIPELINE.md); the
-    defaults mimic a runtime whose double-buffered steady state engaged."""
+    defaults mimic a runtime whose double-buffered steady state engaged.
+
+    ``metrics_script``: elapsed-seconds -> {metric: value} overrides
+    merged over the static values per scrape (see scripted_metrics), so
+    monitor event detection is testable without a device."""
     stats = MockStats()
     caps = capabilities if capabilities is not None else {
         "tools", "parallel_tools", "json_mode", "logprobs",
@@ -232,15 +268,24 @@ def make_app(
         "kvmini_tpu_pipelined_sweeps_total": 40.0,
         "kvmini_tpu_host_overlap_seconds_total": 0.25,
         "kvmini_tpu_bubble_seconds_total": 0.01,
+        # monitor-facing gauges/counters (docs/MONITORING.md) so the 1 Hz
+        # sampler's timeline has runtime series without a JAX engine
+        "kvmini_tpu_duty_cycle": 0.8,
+        "kvmini_tpu_queue_depth": 0.0,
+        "kvmini_tpu_active_slots": 2.0,
         **(pipeline_metrics or {}),
     }
+    t_app_start = time.time()
 
     async def metrics(_request: web.Request) -> web.Response:
         # the same Prometheus exposition shape runtime/server.py serves, so
         # the analyzer's pipeline-counter scrape is exercised end-to-end
         # without booting the JAX engine
+        vals = dict(pipe)
+        if metrics_script is not None:
+            vals.update(metrics_script(time.time() - t_app_start))
         lines = []
-        for name, value in pipe.items():
+        for name, value in vals.items():
             kind = "counter" if name.endswith("_total") else "gauge"
             lines.append(f"# TYPE {name} {kind}")
             lines.append(f"{name} {value}")

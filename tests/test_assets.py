@@ -117,7 +117,7 @@ def test_chart_template_covers_multihost_and_quant():
 
 def test_dashboards_valid_and_tpu_native():
     files = sorted((REPO / "dashboards").glob("*.json"))
-    assert len(files) == 4
+    assert len(files) == 5
     uids = set()
     for f in files:
         d = json.loads(f.read_text())
@@ -130,7 +130,17 @@ def test_dashboards_valid_and_tpu_native():
         assert "DCGM" not in text and "nvidia" not in text.lower(), (
             f"{f.name} references GPU metrics"
         )
-    assert len(uids) == 4  # unique dashboard uids
+    assert len(uids) == 5  # unique dashboard uids
+
+
+def test_run_timeline_dashboard_uses_windowed_duty():
+    """The timeline dashboard must compute duty from the busy-seconds
+    COUNTER (rate = windowed), not only the cumulative gauge — the whole
+    point of kvmini_tpu_busy_seconds_total (docs/MONITORING.md)."""
+    d = (REPO / "dashboards" / "run-timeline.json").read_text()
+    assert "rate(kvmini_tpu_busy_seconds_total" in d
+    assert "kvmini_tpu_queue_depth" in d
+    assert "rate(kvmini_tpu_requests_completed_total" in d
 
 
 def test_utilization_dashboard_queries_tpu_metrics():

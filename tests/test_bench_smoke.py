@@ -14,13 +14,16 @@ import threading
 from kserve_vllm_mini_tpu.analysis import telemetry
 from kserve_vllm_mini_tpu.bench_pipeline import run_bench
 from kserve_vllm_mini_tpu.core.rundir import RunDir
-from tests.mock_server import MockServer
+from kserve_vllm_mini_tpu.core.schema import validate_monitor, validate_timeline
+from tests.mock_server import MockServer, scripted_metrics
 
 
 def _serve_mock(started: threading.Event, stop: threading.Event, holder: dict,
                 **kwargs):
+    kwargs.setdefault("token_delay_s", 0.001)
+
     async def main():
-        async with MockServer(token_delay_s=0.001, **kwargs) as srv:
+        async with MockServer(**kwargs) as srv:
             holder["url"] = srv.url
             started.set()
             while not stop.is_set():
@@ -80,6 +83,86 @@ def test_bench_smoke_surfaces_pipeline_counters(tmp_path):
         names = {s["name"] for _svc, s in spans_from_otlp(merged)}
         assert {"http.request", "server.queue", "server.prefill",
                 "server.decode"} <= names
+
+        # ISSUE 4: the run carried the live monitor — a schema-valid
+        # monitor block in results.json plus timeline.jsonl on disk
+        assert validate_monitor(persisted["monitor"]) == []
+        assert validate_timeline(run_dir.read_timeline()) == []
+    finally:
+        stop.set()
+        t.join(timeout=5)
+
+
+def test_bench_smoke_monitor_timeline_and_stall_event(tmp_path):
+    """ISSUE 4 acceptance: a mock-server bench run against SCRIPTED
+    time-varying /metrics (counter ramp, then a mid-run stall) produces a
+    populated runs/<id>/timeline.jsonl, a schema-valid `monitor` block
+    with the detected stall event, and the analyzer derives windowed
+    utilization + queue percentiles from the timeline — all through the
+    real stage chain, no TPU."""
+    started, stop, holder = threading.Event(), threading.Event(), {}
+    t = threading.Thread(
+        target=_serve_mock, args=(started, stop, holder),
+        kwargs={
+            # 0.8 s/request: service-limited at concurrency 2, so requests
+            # stay IN FLIGHT at every monitor tick — the stall rule
+            # requires frozen counters WITH live work
+            "token_delay_s": 0.1,
+            "metrics_script": scripted_metrics(
+                rates={"kvmini_tpu_decode_steps_total": 200.0,
+                       "kvmini_tpu_pipelined_sweeps_total": 100.0,
+                       "kvmini_tpu_busy_seconds_total": 0.8},
+                base={"kvmini_tpu_queue_depth": 2.0},
+                stall=(0.6, 300.0),
+                stall_values={"kvmini_tpu_queue_depth": 6.0},
+            ),
+        },
+        daemon=True,
+    )
+    t.start()
+    assert started.wait(timeout=10)
+    try:
+        run_dir = RunDir.create(root=tmp_path)
+        # ~6 s of load; 0.1 s monitor ticks give the stall detector
+        # plenty of frozen samples past the scripted 0.6 s stall onset
+        results, code = run_bench(
+            url=holder["url"],
+            profile={"model": "m", "requests": 16, "concurrency": 2,
+                     "max_tokens": 8, "monitor_interval_s": 0.1},
+            run_dir=run_dir,
+        )
+        assert code == 0
+
+        mon = results["monitor"]
+        assert validate_monitor(mon) == []
+        assert mon["samples"] >= 5
+        assert "decode_stall" in {e["type"] for e in mon["events"]}
+
+        timeline = run_dir.read_timeline()
+        assert validate_timeline(timeline) == []
+        assert len(timeline) == mon["samples"]
+        with_runtime = [s for s in timeline if "runtime" in s]
+        assert with_runtime and all("loadgen" in s for s in timeline)
+
+        # the snapshot-as-average fix: duty average comes from the
+        # timeline's busy-counter window, labeled as such
+        assert results["tpu_metrics_source"].startswith("timeline:")
+        assert 0.0 < results["tpu_duty_cycle_avg"] <= 1.0
+        assert results["queue_depth_max"] >= results["queue_depth_p50"]
+
+        # power.json was derived from the monitor's timeline (no second
+        # scrape loop) and energy integrated from it
+        power = json.loads(run_dir.power_json.read_text())
+        assert power["source"] == "timeline"
+        assert power["provenance"] == "modeled"
+        assert results["energy_wh"] > 0
+
+        # the report renders the timeline lane with the event marker
+        from kserve_vllm_mini_tpu.report.html import generate_single_run_html
+
+        html = generate_single_run_html(results, run_dir=run_dir.path)
+        assert "Run timeline" in html
+        assert "decode_stall" in html
     finally:
         stop.set()
         t.join(timeout=5)

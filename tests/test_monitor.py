@@ -1,0 +1,553 @@
+"""Live run monitor (docs/MONITORING.md): burn-rate math against
+hand-computed fixtures, event detection over synthetic and scripted
+streams, sampler overhead/skip accounting, timeline schema, analyzer /
+energy consumption of the timeline, and abort propagation through a
+2-cell sweep against the mock server. JAX-free."""
+
+import asyncio
+import json
+import threading
+import time
+
+import pytest
+
+from kserve_vllm_mini_tpu.analysis import telemetry
+from kserve_vllm_mini_tpu.analysis.analyzer import analyze_run
+from kserve_vllm_mini_tpu.core.rundir import RequestRecord, RunDir
+from kserve_vllm_mini_tpu.core.schema import validate_monitor, validate_timeline
+from kserve_vllm_mini_tpu.energy.collector import (
+    integrate_energy,
+    power_from_timeline,
+)
+from kserve_vllm_mini_tpu.loadgen.runner import LiveStats
+from kserve_vllm_mini_tpu.monitor import (
+    AbortSignal,
+    EventDetector,
+    MonitorConfig,
+    RunMonitor,
+    burn_rates,
+    window_stats,
+)
+from tests.mock_server import MockServer, scripted_metrics
+from tests.synthetic import make_synthetic_run
+
+
+# -- burn-rate math vs hand-computed fixtures --------------------------------
+
+def _evt(t, ok=True, lat=100.0, ttft=20.0, toks=10):
+    return (t, ok, lat, ttft, toks)
+
+
+def test_window_stats_hand_computed():
+    # 10 completions inside a 10 s window ending at t=100: latencies
+    # 10,20,...,100 ms; 2 errors (the error rows carry no latency use)
+    events = [_evt(91.0 + i, lat=10.0 * (i + 1)) for i in range(10)]
+    events[3] = _evt(94.0, ok=False, lat=0.0, ttft=0.0, toks=0)
+    events[7] = _evt(98.0, ok=False, lat=0.0, ttft=0.0, toks=0)
+    stats = window_stats(events, t_now=100.0, window_s=10.0)
+    assert stats["completed"] == 10
+    assert stats["error_rate"] == pytest.approx(0.2)
+    assert stats["throughput_rps"] == pytest.approx(1.0)
+    # ok latencies: 10,20,30,50,60,70,90,100 -> nearest-rank p95 = 100
+    assert stats["p95_ms"] == 100.0
+    # tokens: 8 ok x 10 toks over the 10 s window
+    assert stats["tokens_per_sec"] == pytest.approx(8.0)
+
+
+def test_window_stats_excludes_out_of_window():
+    events = [_evt(10.0, lat=999.0), _evt(95.0, lat=50.0)]
+    stats = window_stats(events, t_now=100.0, window_s=10.0)
+    assert stats["completed"] == 1
+    assert stats["p95_ms"] == 50.0
+
+
+def test_window_stats_empty_window_yields_nothing():
+    # absence of data must not read as "infinitely fast"
+    assert window_stats([_evt(1.0)], t_now=100.0, window_s=10.0) == {}
+
+
+def test_burn_rates_hand_computed():
+    stats = {"p95_ms": 150.0, "error_rate": 0.02, "throughput_rps": 5.0}
+    budgets = {"p95_ms_max": 100.0, "error_rate_max": 0.01,
+               "throughput_rps_min": 10.0, "cost_per_1k_tokens_max": 1.0}
+    rates = burn_rates(stats, budgets)
+    assert rates["p95_ms_max"] == pytest.approx(1.5)       # 150/100
+    assert rates["error_rate_max"] == pytest.approx(2.0)   # 0.02/0.01
+    assert rates["throughput_rps_min"] == pytest.approx(2.0)  # 10/5
+    # cost budget is not live-computable -> absent, not zero
+    assert "cost_per_1k_tokens_max" not in rates
+
+
+def test_burn_rates_on_budget_is_one_and_caps_stay_json():
+    assert burn_rates({"p95_ms": 100.0}, {"p95_ms_max": 100.0}) == {
+        "p95_ms_max": 1.0
+    }
+    capped = burn_rates({"throughput_rps": 0.0}, {"throughput_rps_min": 5.0})
+    assert capped["throughput_rps_min"] == 1e9
+    json.dumps(capped)  # strict JSON, no Infinity
+
+
+def test_window_stats_partial_window_uses_elapsed_span():
+    """2 completions 2 s into a run must read ~1 rps, not 2/window_s —
+    the full-window divisor inflated min-direction burn rates at startup
+    and aborted healthy runs."""
+    events = [_evt(100.5, toks=10), _evt(101.5, toks=10)]
+    stats = window_stats(events, t_now=102.0, window_s=10.0, t_start=100.0)
+    assert stats["throughput_rps"] == pytest.approx(1.0)
+    assert stats["tokens_per_sec"] == pytest.approx(10.0)
+    assert stats["window_s"] == pytest.approx(2.0)
+    # once the run outlives the window, the divisor is the window again
+    full = window_stats(events, t_now=102.0, window_s=10.0, t_start=50.0)
+    assert full["throughput_rps"] == pytest.approx(0.2)
+
+
+def test_burn_rates_missing_metric_omitted():
+    # a window with no TTFT (non-streaming) must not burn the TTFT budget
+    assert burn_rates({"p95_ms": 50.0}, {"ttft_p95_ms_max": 10.0}) == {}
+
+
+# -- event detection ---------------------------------------------------------
+
+def _sample(t, runtime=None, loadgen=None):
+    s = {"t": t}
+    if runtime is not None:
+        s["runtime"] = runtime
+    if loadgen is not None:
+        s["loadgen"] = loadgen
+    return s
+
+
+def test_decode_stall_fires_after_n_frozen_samples():
+    det = EventDetector(stall_samples=3)
+    fired = []
+    for i in range(6):
+        steps = 100.0 if i >= 1 else 50.0  # frozen from sample 1 on
+        fired += det.observe(_sample(
+            float(i),
+            runtime={"decode_steps_total": steps, "pipelined_sweeps_total": 10.0},
+            loadgen={"inflight": 4},
+        ))
+    assert [e.type for e in fired] == ["decode_stall"]
+    # frozen pairs: (1,2),(2,3),(3,4) -> fires at t=4
+    assert fired[0].t == 4.0
+
+
+def test_decode_stall_needs_inflight_requests():
+    det = EventDetector(stall_samples=2)
+    fired = []
+    for i in range(6):  # counters frozen but nothing in flight (idle)
+        fired += det.observe(_sample(
+            float(i),
+            runtime={"decode_steps_total": 100.0},
+            loadgen={"inflight": 0},
+        ))
+    assert fired == []
+
+
+def test_decode_stall_not_armed_during_cold_compile():
+    """A cold engine spends its first requests in XLA compile: counters
+    frozen at ZERO with work in flight. That is not a stall — the rule
+    arms only once decode has progressed (found driving the real
+    self-serve runtime; the compile window exceeded stall_samples)."""
+    det = EventDetector(stall_samples=3)
+    fired = []
+    for i in range(10):  # compile: steps never move, requests queued
+        fired += det.observe(_sample(
+            float(i),
+            runtime={"decode_steps_total": 0.0},
+            loadgen={"inflight": 2},
+        ))
+    assert fired == []
+    # compile finishes, decode progresses, THEN wedges -> now it's a stall
+    for i, steps in enumerate([10.0, 20.0, 20.0, 20.0, 20.0], start=10):
+        fired += det.observe(_sample(
+            float(i),
+            runtime={"decode_steps_total": steps},
+            loadgen={"inflight": 2},
+        ))
+    assert [e.type for e in fired] == ["decode_stall"]
+
+
+def test_queue_runaway_fires_on_sustained_growth():
+    det = EventDetector(queue_samples=3, queue_depth_limit=8.0)
+    fired = []
+    for i, depth in enumerate([1, 3, 6, 9, 12, 15]):
+        fired += det.observe(_sample(
+            float(i), runtime={"queue_depth": float(depth)}
+        ))
+    assert [e.type for e in fired] == ["queue_depth_runaway"]
+
+
+def test_queue_runaway_not_fired_when_draining():
+    det = EventDetector(queue_samples=3, queue_depth_limit=8.0)
+    fired = []
+    for i, depth in enumerate([15, 12, 9, 6, 3, 1]):  # high but draining
+        fired += det.observe(_sample(
+            float(i), runtime={"queue_depth": float(depth)}
+        ))
+    assert fired == []
+
+
+def test_throughput_collapse_after_warmup():
+    det = EventDetector(warmup_s=3.0, collapse_fraction=0.5)
+    fired = []
+    rates = [10.0, 10.0, 10.0, 10.0, 9.0, 2.0]  # collapse at t=5
+    for i, r in enumerate(rates):
+        fired += det.observe(_sample(
+            float(i), loadgen={"inflight": 2, "window_throughput_rps": r}
+        ))
+    assert [e.type for e in fired] == ["throughput_collapse"]
+    assert fired[0].t == 5.0
+
+
+def test_duty_drop_uses_windowed_busy_delta():
+    det = EventDetector(warmup_s=2.0, duty_drop_fraction=0.5)
+    fired = []
+    # busy_s ramps at 0.9/s (duty 0.9) then flatlines (duty ~0)
+    busy = [0.0, 0.9, 1.8, 2.7, 2.75, 2.76]
+    for i, b in enumerate(busy):
+        fired += det.observe(_sample(
+            float(i),
+            runtime={"busy_seconds_total": b},
+            loadgen={"inflight": 2},
+        ))
+    assert [e.type for e in fired] == ["duty_cycle_drop"]
+
+
+def test_burn_rate_event_needs_consecutive_samples():
+    det = EventDetector(burn_threshold=2.0, burn_samples=3, warmup_s=0.0)
+    fired = []
+    burns = [{"p95_ms_max": 3.0}, {"p95_ms_max": 3.0}, {},  # reset
+             {"p95_ms_max": 3.0}, {"p95_ms_max": 3.0}, {"p95_ms_max": 3.0}]
+    for i, b in enumerate(burns):
+        fired += det.observe(_sample(float(i)), b)
+    assert [e.type for e in fired] == ["burn_rate_exceeded"]
+    assert fired[0].t == 5.0  # the reset at t=2 restarted the count
+
+
+def test_burn_rate_event_gated_by_warmup():
+    """Startup transients (first cold requests, partially-filled windows)
+    must not abort a run in its first seconds."""
+    det = EventDetector(burn_threshold=2.0, burn_samples=2, warmup_s=4.0)
+    fired = []
+    for i in range(8):  # constant over-budget burn from t=0
+        fired += det.observe(_sample(float(i)), {"p95_ms_max": 5.0})
+    assert [e.type for e in fired] == ["burn_rate_exceeded"]
+    assert fired[0].t == 5.0  # warmup ends at t=4; 2 consecutive -> t=5
+
+
+def test_events_fire_at_most_once_per_run():
+    det = EventDetector(burn_threshold=1.0, burn_samples=1, warmup_s=0.0)
+    n = sum(
+        len(det.observe(_sample(float(i)), {"p95_ms_max": 5.0}))
+        for i in range(10)
+    )
+    assert n == 1
+
+
+# -- abort signal ------------------------------------------------------------
+
+def test_abort_signal_first_reason_wins_and_callbacks_fire():
+    sig = AbortSignal()
+    seen = []
+    sig.on_set(lambda: seen.append("early"))
+    sig.set("reason-1")
+    sig.set("reason-2")
+    assert sig.is_set() and sig.reason == "reason-1"
+    sig.on_set(lambda: seen.append("late"))  # already set -> fires now
+    assert seen == ["early", "late"]
+
+
+# -- sampler -----------------------------------------------------------------
+
+def test_sampler_writes_schema_valid_timeline(tmp_path):
+    live = LiveStats()
+    live.record_start()
+    mon = RunMonitor(
+        tmp_path / "timeline.jsonl", endpoint="http://x", live=live,
+        cfg=MonitorConfig(interval_s=0.05, budgets={"p95_ms_max": 100.0}),
+        scrape_fn=lambda _e, timeout_s: {
+            "kvmini_tpu_duty_cycle": 0.5,
+            "kvmini_tpu_queue_depth": 2.0,
+            "kvmini_tpu_busy_seconds_total": 1.0,
+        },
+    )
+    mon.start()
+    time.sleep(0.3)
+    summary = mon.stop()
+    assert summary["samples"] >= 2
+    assert validate_monitor(summary) == []
+    samples = RunDir(tmp_path).read_timeline()
+    assert len(samples) == summary["samples"]
+    assert validate_timeline(samples) == []
+    rt = samples[0]["runtime"]
+    assert rt["duty_cycle"] == 0.5 and rt["queue_depth"] == 2.0
+    assert samples[0]["loadgen"]["inflight"] == 1
+
+
+def test_sampler_skips_when_scrape_overruns_never_blocks(tmp_path):
+    """Overhead bound (docs/MONITORING.md): a scrape slower than the
+    interval costs SKIPPED ticks (counted), and stop() returns promptly
+    instead of waiting out a backlog."""
+    def slow_scrape(_e, timeout_s):
+        time.sleep(0.25)  # 5x the interval
+        return {"kvmini_tpu_duty_cycle": 0.5}
+
+    mon = RunMonitor(
+        tmp_path / "timeline.jsonl", endpoint="http://x",
+        cfg=MonitorConfig(interval_s=0.05), scrape_fn=slow_scrape,
+    )
+    mon.start()
+    time.sleep(0.6)
+    t0 = time.time()
+    summary = mon.stop()
+    assert time.time() - t0 < 1.0  # bounded join
+    assert summary["skipped_samples"] > 0
+    # ticks were skipped, not queued: far fewer samples than wall/interval
+    assert summary["samples"] < 6
+
+
+def test_sampler_without_endpoint_has_no_runtime_block(tmp_path):
+    mon = RunMonitor(tmp_path / "timeline.jsonl", endpoint=None,
+                     live=LiveStats(), cfg=MonitorConfig(interval_s=0.05))
+    mon.sample_once()
+    assert "runtime" not in mon.samples[0]
+    assert "loadgen" in mon.samples[0]
+
+
+def test_monitor_detects_scripted_stall_via_mock_server(tmp_path):
+    """The mock's scripted /metrics (ramp then mid-run freeze) must drive
+    the REAL scrape -> sample -> detector path to a decode_stall event."""
+    async def main():
+        script = scripted_metrics(
+            rates={"kvmini_tpu_decode_steps_total": 200.0,
+                   "kvmini_tpu_pipelined_sweeps_total": 100.0,
+                   "kvmini_tpu_busy_seconds_total": 0.9},
+            base={"kvmini_tpu_queue_depth": 1.0},
+            stall=(0.25, 60.0),
+            stall_values={"kvmini_tpu_queue_depth": 9.0},
+        )
+        async with MockServer(metrics_script=script) as srv:
+            live = LiveStats()
+            live.record_start()  # inflight=1 for the stall rule
+            mon = RunMonitor(
+                tmp_path / "timeline.jsonl", endpoint=srv.url, live=live,
+                cfg=MonitorConfig(interval_s=0.08, stall_samples=3),
+            )
+            mon.start()
+            await asyncio.sleep(1.2)
+            return mon.stop()
+
+    summary = asyncio.run(main())
+    assert validate_monitor(summary) == []
+    types = {e["type"] for e in summary["events"]}
+    assert "decode_stall" in types
+
+
+def test_monitor_abort_on_burn(tmp_path):
+    live = LiveStats()
+    live.record_start()
+    # completions far over the latency budget, continuously
+    rec = RequestRecord("r", ok=True, latency_ms=500.0, ttft_ms=50.0,
+                        tokens_out=8)
+    rec.end_ts = time.time()  # inside the rolling window for the next ticks
+    for _ in range(5):
+        live.record_start()
+        live.record_done(rec)
+    abort = AbortSignal()
+    mon = RunMonitor(
+        tmp_path / "timeline.jsonl", endpoint=None, live=live,
+        cfg=MonitorConfig(interval_s=0.01, budgets={"p95_ms_max": 100.0},
+                          burn_samples=2, abort_enabled=True, warmup_s=0.0),
+        abort=abort,
+    )
+    for _ in range(3):
+        mon.sample_once()
+    assert abort.is_set()
+    assert abort.reason.startswith("burn_rate_exceeded")
+    assert mon.summary()["aborted"] == abort.reason
+
+
+def test_wedged_server_empties_window_but_monitor_stays_armed(tmp_path):
+    """A server that wedges mid-run empties the completion window; the
+    sampler must report ZERO window throughput (not go blind) so burn
+    rates and throughput_collapse can still fire and abort."""
+    live = LiveStats()
+    old = RequestRecord("r", ok=True, latency_ms=50.0, ttft_ms=5.0,
+                        tokens_out=8)
+    old.end_ts = time.time() - 60.0  # completed long before the window
+    for _ in range(4):
+        live.record_start()
+        live.record_done(old)
+    live.record_start()  # one request wedged in flight
+    abort = AbortSignal()
+    mon = RunMonitor(
+        tmp_path / "timeline.jsonl", endpoint=None, live=live,
+        cfg=MonitorConfig(interval_s=0.01, window_s=1.0,
+                          budgets={"throughput_rps_min": 5.0},
+                          burn_samples=2, abort_enabled=True, warmup_s=0.0),
+        abort=abort,
+    )
+    for _ in range(3):
+        mon.sample_once()
+    assert mon.samples[-1]["loadgen"]["window_throughput_rps"] == 0.0
+    assert abort.is_set()
+    assert abort.reason.startswith("burn_rate_exceeded: throughput_rps_min")
+
+
+def test_abort_callback_failure_does_not_crash_monitor(capsys):
+    """A dead listener (e.g. a load loop whose asyncio loop already
+    closed) must not blow up the monitor thread mid-sample."""
+    sig = AbortSignal()
+    sig.on_set(lambda: (_ for _ in ()).throw(RuntimeError("loop closed")))
+    seen = []
+    sig.on_set(lambda: seen.append("still-notified"))
+    sig.set("reason")
+    assert sig.is_set() and seen == ["still-notified"]
+    assert "abort callback failed" in capsys.readouterr().err
+
+
+# -- timeline consumers: analyzer + energy -----------------------------------
+
+def _write_timeline(rd: RunDir, samples):
+    with rd.timeline_jsonl.open("w") as f:
+        for s in samples:
+            f.write(json.dumps(s) + "\n")
+
+
+def test_analyze_with_timeline_derives_windowed_duty(tmp_path):
+    rd = make_synthetic_run(tmp_path / "runs")
+    t0 = 1_700_000_000.0
+    # busy counter ramps 0.6 s/s over 10 samples -> true windowed duty 0.6;
+    # queue depths 0..9 -> p95 = 9, p50 = 5 (nearest-rank)
+    _write_timeline(rd, [
+        {"t": t0 + i, "runtime": {"busy_seconds_total": 0.6 * i,
+                                  "queue_depth": float(i),
+                                  "duty_cycle": 0.99}}
+        for i in range(10)
+    ])
+    results = analyze_run(rd)
+    assert results["tpu_duty_cycle_avg"] == pytest.approx(0.6)
+    assert results["tpu_metrics_source"].startswith("timeline:")
+    assert results["queue_depth_max"] == 9.0
+    assert results["queue_depth_p95"] == 9.0
+    assert results["queue_depth_p50"] == 5.0
+    assert results["power_provenance"] == "modeled"
+    expected = telemetry.modeled_power(0.6, None)
+    assert results["tpu_power_watts_avg"] == pytest.approx(expected)
+
+
+def test_timeline_utilization_needs_two_samples():
+    assert telemetry.timeline_utilization(
+        [{"t": 1.0, "runtime": {"duty_cycle": 0.5}}]
+    ) == {}
+
+
+def test_power_from_timeline_prefers_windowed_busy():
+    t0 = 100.0
+    samples = [
+        {"t": t0 + i, "runtime": {"busy_seconds_total": 0.5 * i,
+                                  "duty_cycle": 0.99}}
+        for i in range(5)
+    ]
+    doc = power_from_timeline(samples, accelerator="tpu-v5e-8")
+    assert doc["provenance"] == "modeled"
+    assert doc["source"] == "timeline"
+    # first sample has no delta -> falls back to the gauge; the rest use
+    # the 0.5 windowed duty
+    assert len(doc["samples"]) == 5
+    expected = telemetry.modeled_power(0.5, "tpu-v5e-8")
+    for p in doc["samples"][1:]:
+        assert p["watts"] == pytest.approx(expected)
+
+
+def test_integrate_energy_falls_back_to_timeline(tmp_path):
+    rd = make_synthetic_run(tmp_path / "runs")
+    records = rd.read_requests()
+    t0 = min(r.start_ts for r in records)
+    t1 = max(r.end_ts for r in records)
+    _write_timeline(rd, [
+        {"t": t, "runtime": {"busy_seconds_total": 0.8 * (t - t0)}}
+        for t in _frange(t0, t1, 1.0)
+    ])
+    assert not rd.power_json.exists()
+    doc = integrate_energy(rd)
+    assert doc["provenance"] == "modeled"
+    assert doc["energy_wh"] > 0
+    assert rd.power_json.exists()  # derived power persisted for provenance
+
+
+def _frange(a, b, step):
+    out = []
+    while a <= b:
+        out.append(a)
+        a += step
+    return out
+
+
+# -- abort propagation through a 2-cell sweep --------------------------------
+
+def _serve_mock(started: threading.Event, stop: threading.Event, holder: dict,
+                **kwargs):
+    async def main():
+        async with MockServer(**kwargs) as srv:
+            holder["url"] = srv.url
+            started.set()
+            while not stop.is_set():
+                await asyncio.sleep(0.02)
+
+    asyncio.run(main())
+
+
+def test_abort_propagates_through_sweep_cell_and_spares_sibling(tmp_path):
+    """The full chain: run_sweep -> default_bench_fn -> run_bench ->
+    monitor burn-rate abort -> loadgen early termination -> aborted_early
+    in results + the cell's CSV row; the sibling cell (no live budgets)
+    runs to completion untouched."""
+    import csv
+
+    from kserve_vllm_mini_tpu.sweeps import base
+
+    started, stop, holder = threading.Event(), threading.Event(), {}
+    t = threading.Thread(
+        target=_serve_mock, args=(started, stop, holder),
+        kwargs={"token_delay_s": 0.03}, daemon=True,
+    )
+    t.start()
+    assert started.wait(timeout=10)
+    try:
+        # ~0.24 s/request stream; 40 requests over 2 workers ~ 5 s — the
+        # monitor (0.1 s ticks, 1 s window) gets plenty of samples
+        base_profile = {
+            "model": "m", "requests": 40, "concurrency": 2, "max_tokens": 8,
+            "monitor_interval_s": 0.1,
+        }
+        impossible = {"p95_ms_max": 0.001}  # every completion burns ~1000x
+        configs = [
+            {"cell": "doomed", "monitor_slo": impossible,
+             "monitor_abort": True},
+            {"cell": "healthy"},
+        ]
+        rows = base.run_sweep(
+            configs,
+            base.default_bench_fn(base_profile, self_serve=False,
+                                  url=holder["url"]),
+            tmp_path / "sweep.csv",
+            config_keys=["cell"],
+            label="abort-test",
+        )
+    finally:
+        stop.set()
+        t.join(timeout=5)
+
+    by_cell = {r["cell"]: r for r in rows}
+    doomed, healthy = by_cell["doomed"], by_cell["healthy"]
+    assert doomed["status"] == "ok"  # partial metrics recorded, not a failure
+    assert doomed["aborted_early"]
+    assert doomed["aborted_early"].startswith("burn_rate_exceeded")
+    assert healthy["status"] == "ok"
+    assert not healthy.get("aborted_early")
+    with (tmp_path / "sweep.csv").open(newline="") as f:
+        disk = {r["cell"]: r for r in csv.DictReader(f)}
+    assert disk["doomed"]["aborted_early"].startswith("burn_rate_exceeded")
+    assert disk["healthy"]["aborted_early"] == ""
