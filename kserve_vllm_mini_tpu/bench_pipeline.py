@@ -247,7 +247,10 @@ def _run_stages(
             collect_power(
                 run_dir, None, None,
                 accelerator=profile.get("accelerator"),
-                timeline=run_monitor.samples,
+                # snapshot, not the live list: stop()'s join is bounded, so
+                # a wedged scrape can leave the sampler thread appending
+                # while the energy integration iterates (KVM055 bug class)
+                timeline=run_monitor.timeline(),
             )
     if sampler is not None:
         # worst-case iteration = power-query timeouts (~8 s with 2 s

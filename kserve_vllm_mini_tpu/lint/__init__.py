@@ -1,7 +1,7 @@
 """kvmini-lint — AST-based invariant checker for the repo's load-bearing
 conventions (docs/LINTING.md "Conventions kvmini-lint enforces").
 
-Four checkers, all stdlib-``ast`` over a small cross-file fact index —
+Five checkers, all stdlib-``ast`` over a small cross-file fact index —
 deliberately JAX-free so the lint gate runs anywhere the harness layers
 do (same contract as loadgen/analysis: no ``runtime`` extra required):
 
@@ -22,6 +22,12 @@ do (same contract as loadgen/analysis: no ``runtime`` extra required):
 - **workload-change surfacing** (KVM041): truncation / silent drops /
   fallbacks in loadgen+runtime code must stamp a flag field the
   analyzer reads (LINTING.md "don't hide workload changes").
+- **thread-safety / lock discipline** (KVM051-KVM055): thread-root
+  discovery (Thread/executor/HTTP-handler spawn sites propagated through
+  the call graph), guarded-by inference for cross-thread ``self._x``
+  state, lock-order cycle detection, unbounded wait/join, and raw
+  mutable-container publication across the thread boundary
+  (lint/concurrency.py).
 
 CLI: ``python -m kserve_vllm_mini_tpu.lint [paths...]`` — see __main__.py.
 Suppressions: ``# kvmini: <token>`` line comments (diagnostics.RULES maps

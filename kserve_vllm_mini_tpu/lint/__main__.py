@@ -18,7 +18,7 @@ import time
 from pathlib import Path
 
 from kserve_vllm_mini_tpu.lint import baseline as baseline_mod
-from kserve_vllm_mini_tpu.lint.runner import run_lint
+from kserve_vllm_mini_tpu.lint.runner import normalize_families, run_lint
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -26,10 +26,25 @@ def main(argv: list[str] | None = None) -> int:
         prog="python -m kserve_vllm_mini_tpu.lint",
         description="kvmini-lint: AST invariant checker (jit purity, "
                     "lockstep determinism, metrics/schema drift, workload "
-                    "surfacing). See docs/LINTING.md for the rule table.",
+                    "surfacing, thread-safety/lock discipline). See "
+                    "docs/LINTING.md for the rule table.",
     )
     ap.add_argument("paths", nargs="*", type=Path,
                     help="files/dirs to lint (default: kserve_vllm_mini_tpu/)")
+    ap.add_argument("--family", action="append", default=None,
+                    metavar="KVM0x",
+                    help="run only this rule family (repeatable; e.g. "
+                         "KVM05 for the concurrency rules, or a full code "
+                         "like KVM051). The baseline gate and the KVM001 "
+                         "stale-suppression check are filtered to match.")
+    ap.add_argument("--timing", action="store_true",
+                    help="print per-checker wall time (the <10s budget "
+                         "attribution surface; JSON output always carries "
+                         "a 'timings' object)")
+    ap.add_argument("--timing-out", type=Path, default=None, metavar="FILE",
+                    help="also write the timing report as JSON to FILE — "
+                         "lets CI upload the artifact from the SAME run "
+                         "that gated, instead of linting twice")
     ap.add_argument("--docs", type=Path, action="append", default=None,
                     help="extra docs/dashboards surfaces for the drift "
                          "checker (default: ./docs, ./dashboards if present)")
@@ -49,6 +64,19 @@ def main(argv: list[str] | None = None) -> int:
         print(f"kvmini-lint: no such path: {missing[0]}", file=sys.stderr)
         return 2
 
+    try:
+        families = normalize_families(args.family)
+    except ValueError as e:
+        print(f"kvmini-lint: {e}", file=sys.stderr)
+        return 2
+    if families is not None and args.write_baseline:
+        # a family-filtered run only sees a slice of the findings; writing
+        # it out would silently drop every other family from the ratchet
+        print("kvmini-lint: --write-baseline cannot be combined with "
+              "--family (the baseline must cover every rule)",
+              file=sys.stderr)
+        return 2
+
     docs = args.docs
     if docs is None:
         docs = [p for p in (Path("docs"), Path("dashboards")) if p.is_dir()]
@@ -58,8 +86,16 @@ def main(argv: list[str] | None = None) -> int:
         baseline_path = args.baseline or Path("lint-baseline.json")
 
     t0 = time.monotonic()
-    result = run_lint(paths, doc_paths=docs, baseline_path=baseline_path)
+    result = run_lint(paths, doc_paths=docs, baseline_path=baseline_path,
+                      families=families)
     dt = time.monotonic() - t0
+
+    if args.timing_out is not None:
+        args.timing_out.write_text(json.dumps({
+            "elapsed_s": round(dt, 3),
+            "timings": result.timings,
+            "findings": len(result.diagnostics),
+        }, indent=2) + "\n", encoding="utf-8")
 
     if args.write_baseline:
         if result.parse_errors:
@@ -89,6 +125,7 @@ def main(argv: list[str] | None = None) -> int:
                                if result.baseline_diff else []),
             "parse_errors": [list(e) for e in result.parse_errors],
             "elapsed_s": round(dt, 3),
+            "timings": result.timings,
         }, indent=2))
         return result.exit_code
 
@@ -107,6 +144,11 @@ def main(argv: list[str] | None = None) -> int:
               f"({bd.suppressed} grandfathered, {dt:.2f}s)")
     else:
         print(f"kvmini-lint: {len(result.diagnostics)} findings ({dt:.2f}s)")
+    if args.timing:
+        width = max((len(k) for k in result.timings), default=0)
+        for name, secs in sorted(result.timings.items(),
+                                 key=lambda kv: -kv[1]):
+            print(f"kvmini-lint timing: {name:<{width}} {secs * 1000:8.1f} ms")
     return result.exit_code
 
 

@@ -1,0 +1,1033 @@
+"""KVM051-KVM055 — thread-safety and lock discipline.
+
+PRs 1-4 made the toolkit genuinely concurrent: the engine scheduler loop,
+the 1 Hz monitor sampler, loadgen workers sharing ``LiveStats``, the
+multihost drivers, and per-request server threads all touch shared state.
+This family checks the lock discipline those subsystems rely on, in four
+layers:
+
+- **Thread-root discovery.** ``threading.Thread(target=...)`` /
+  ``Timer`` spawn sites, ``executor.submit`` / ``run_in_executor`` /
+  ``asyncio.to_thread`` targets, and HTTP-handler registrations
+  (``router.add_get/add_post`` — aiohttp runs every handler on the
+  server's event-loop thread, one root labeled ``http-handler``).
+  Reachability through the cross-file call graph labels every function
+  with the roots that can execute it; unreached functions carry the
+  implicit ``main`` root. Roots that reach a follower-replayed engine
+  method (the fact index's ``run_follower`` scan) coalesce into ONE
+  ``lockstep-driver`` root: exactly one driver — the engine's own loop,
+  ``run_primary``, or a follower's replay — owns a given engine
+  instance, so driver-vs-driver access is never concurrent.
+- **Guarded-by inference (KVM051/KVM052).** For each ``self._x``
+  touched from >= 2 roots with at least one mutation, infer the lock
+  that consistently guards it: ``with self._lock:`` spans, plus
+  helper-method indirection (a private method called ONLY from under a
+  lock inherits that lock as held-at-entry). No lock anywhere ->
+  KVM051; some accesses guarded, others bare (or a different lock) ->
+  KVM052. One diagnostic per attribute, anchored where the annotation
+  belongs: the foreign access when a single root owns all mutations
+  (the benign-snapshot read), else the first mutation.
+- **Lock-order analysis (KVM053).** The acquires-while-holding digraph
+  across the package (lexical nesting + locks a callee acquires while
+  the caller holds one); any cycle — including a non-reentrant
+  self-acquire — is a potential deadlock.
+- **Primitive misuse (KVM054/KVM055).** ``Event.wait()`` /
+  ``Condition.wait()`` with no timeout (a wedged setter hangs the
+  waiter forever — awaited asyncio waits are exempt, their timeout is
+  ``wait_for``), ``Thread.join()`` with no bound in stop/teardown code
+  or ``finally`` blocks, and bare ``return self._x`` of a mutable
+  container that another thread mutates (the /traces deque-snapshot bug
+  class: iteration races mutation even when every mutation is locked,
+  because the raw reference outlives the lock).
+
+Known approximations (under-, never over-reported): only ``self.<attr>``
+accesses are attributed (cross-object reads are seen inside the owning
+class only); callbacks stored and invoked through untyped fields don't
+create call edges; receiver types come from ``self._x = ClassName(...)``
+bindings and parameter/attribute annotations (string annotations
+included), so an ``Any``-typed receiver contributes no edges.
+
+Suppress intentional single-writer or benign-snapshot designs with
+``# kvmini: thread-ok`` (KVM051/054/055) and deliberate asymmetric
+guarding with ``# kvmini: lock-ok`` (KVM052/053) — with a one-line
+justification, per docs/LINTING.md.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Iterable, Optional
+
+from kserve_vllm_mini_tpu.lint.diagnostics import Diagnostic
+from kserve_vllm_mini_tpu.lint.facts import (
+    FactIndex,
+    FunctionInfo,
+    ModuleFacts,
+    _last_attr,
+    iter_scope,
+)
+
+LOCK_CTORS = {"Lock", "RLock"}
+WAITABLE_CTORS = {"Event", "Condition", "Barrier"}
+# attrs holding these are thread-safe by construction: their methods
+# synchronize internally, so KVM051/052 never fire on them
+THREADSAFE_CTORS = LOCK_CTORS | WAITABLE_CTORS | {
+    "Semaphore", "BoundedSemaphore", "Queue", "SimpleQueue", "LifoQueue",
+    "PriorityQueue", "local",
+}
+THREAD_CTORS = {"Thread", "Timer"}
+CONTAINER_CTORS = {"list", "dict", "set", "deque", "defaultdict",
+                   "OrderedDict", "Counter"}
+MUTATOR_METHODS = {
+    "append", "appendleft", "extend", "extendleft", "insert", "add",
+    "discard", "remove", "pop", "popleft", "popitem", "clear", "update",
+    "setdefault", "sort", "reverse", "rotate",
+}
+HANDLER_REGISTRARS = {"add_get", "add_post", "add_put", "add_delete",
+                      "add_patch", "add_head"}
+TEARDOWN_NAME = re.compile(
+    r"(^|_)(stop|shutdown|close|teardown|finalize|cleanup|exit)", re.I)
+# word-boundary match for a not-statically-typed lock name: a bare
+# substring test would classify `self._block` (KV pool!) as a lock and
+# both invent KVM052s and mask real KVM051s
+_LOCKISH_NAME = re.compile(r"(^|_)(lock|mutex)($|_)", re.I)
+MAIN_ROOT = "main"
+DRIVER_ROOT = "lockstep-driver"
+HTTP_ROOT = "http-handler"
+# functions named like this ARE replay drivers even though nothing spawns
+# them as threads in-package (the follower's main thread runs them) —
+# treat as pseudo-roots so they never pick up the generic `main` label
+REPLAY_DRIVER_PREFIXES = ("run_follower", "run_replica", "run_primary")
+# engine convention (runtime/engine.py _run_admin): a callable handed to an
+# admin-op executor runs ON the scheduler thread, between sweeps — the
+# single-writer discipline bank/registry swaps rely on. Label those
+# callables as the driver so their mutations aren't misattributed to the
+# submitting thread.
+ADMIN_EXECUTOR_METHODS = {"_run_admin"}
+
+_INIT_NAMES = {"__init__", "__post_init__"}
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """``self.x`` -> "x", else None."""
+    if (isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _annotation_class_tokens(ann: ast.AST) -> set[str]:
+    """Every Name/Attribute token in an annotation, including ones inside
+    string annotations ("Optional[LiveStats]")."""
+    out: set[str] = set()
+    for n in ast.walk(ann):
+        if isinstance(n, ast.Name):
+            out.add(n.id)
+        elif isinstance(n, ast.Attribute):
+            out.add(n.attr)
+        elif isinstance(n, ast.Constant) and isinstance(n.value, str):
+            out.update(re.findall(r"[A-Za-z_]\w*", n.value))
+    return out
+
+
+@dataclass
+class ClassInfo:
+    """Per-(module, class) attribute kinds, from __init__/method scans."""
+
+    lock_attrs: dict[str, str] = field(default_factory=dict)  # attr -> ctor
+    waitable_attrs: set[str] = field(default_factory=set)
+    threadsafe_attrs: set[str] = field(default_factory=set)
+    thread_attrs: set[str] = field(default_factory=set)
+    container_attrs: set[str] = field(default_factory=set)
+    instance_types: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class Access:
+    mod: ModuleFacts
+    fn: FunctionInfo
+    attr: str
+    line: int
+    mutation: bool
+    held: frozenset[str]  # lexical with-lock spans at the access site
+
+
+@dataclass
+class CallRecord:
+    mod: ModuleFacts
+    fn: FunctionInfo
+    node: ast.Call
+    held: frozenset[str]
+    in_finally: bool
+    awaited: bool
+
+
+@dataclass
+class AcquireRecord:
+    mod: ModuleFacts
+    fn: FunctionInfo
+    node: ast.AST
+    lock: str
+    held: frozenset[str]  # locks lexically held when this one is taken
+
+
+class _FnScanner:
+    """One recursive walk of a function's own scope (nested defs excluded,
+    lambdas included) tracking held with-locks / finally depth, recording
+    attribute accesses, call sites, and lock acquisitions."""
+
+    def __init__(self, checker: "ConcurrencyChecker", mod: ModuleFacts,
+                 fn: FunctionInfo) -> None:
+        self.c = checker
+        self.mod = mod
+        self.fn = fn
+        self.held: list[str] = []
+        self.finally_depth = 0
+        self.local_locks: set[str] = set()
+        self.local_threads: set[str] = set()
+        self.local_waitables: set[str] = set()
+        # params annotated with a thread type count as thread-ish receivers
+        args = fn.node.args
+        for p in args.posonlyargs + args.args + args.kwonlyargs:
+            if p.annotation is None:
+                continue
+            toks = _annotation_class_tokens(p.annotation)
+            if "threading" in toks:  # `threading.Thread`, not any `Thread`
+                if toks & THREAD_CTORS:
+                    self.local_threads.add(p.arg)
+                if toks & WAITABLE_CTORS:
+                    self.local_waitables.add(p.arg)
+
+    # -- helpers ------------------------------------------------------------
+
+    def _lock_id(self, expr: ast.AST) -> Optional[str]:
+        attr = _self_attr(expr)
+        if attr is not None and self.fn.class_name:
+            ci = self.c.class_info(self.mod.path, self.fn.class_name)
+            if attr in ci.lock_attrs or _LOCKISH_NAME.search(attr):
+                return f"{self.fn.class_name}.{attr}"
+            return None
+        if isinstance(expr, ast.Name):
+            if expr.id in self.local_locks or _LOCKISH_NAME.search(expr.id):
+                return f"{self.mod.path}::{expr.id}"
+        return None
+
+    def _record_access(self, attr: str, node: ast.AST, mutation: bool) -> None:
+        if self.fn.class_name is None:
+            return
+        cls = self.fn.class_name
+        # method/function-alias/jitted attrs are code, not shared data —
+        # but the facts layer records EVERY `self.x = <name>` binding as a
+        # potential alias, so only skip when some alias actually resolves
+        # to a function (`self._reason = reason` must stay shared data)
+        if f"{cls}.{attr}" in self.mod.functions:
+            return
+        if any(
+            self.c.index._resolve_name(self.mod, None, n)
+            for n in self.mod.class_attr_fn_aliases.get((cls, attr), ())
+        ):
+            return
+        if (cls, attr) in self.mod.jitted_attrs:
+            return
+        self.c.accesses.setdefault((self.mod.path, cls, attr), []).append(
+            Access(self.mod, self.fn, attr, getattr(node, "lineno", 0),
+                   mutation, frozenset(self.held))
+        )
+
+    # -- the walk -----------------------------------------------------------
+
+    def scan(self) -> None:
+        for stmt in self.fn.node.body:
+            self._visit(stmt)
+
+    def _visit_all(self, nodes: Iterable[Optional[ast.AST]]) -> None:
+        for n in nodes:
+            if n is not None:
+                self._visit(n)
+
+    def _visit(self, node: ast.AST, awaited: bool = False) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # nested defs are scanned as their own functions
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            acquired: list[str] = []
+            for item in node.items:
+                lock = self._lock_id(item.context_expr)
+                if lock is not None:
+                    self.c.acquires.append(AcquireRecord(
+                        self.mod, self.fn, item.context_expr, lock,
+                        frozenset(self.held)))
+                    acquired.append(lock)
+                else:
+                    self._visit(item.context_expr)
+                if item.optional_vars is not None:
+                    self._visit(item.optional_vars)
+            self.held.extend(acquired)
+            self._visit_all(node.body)
+            del self.held[len(self.held) - len(acquired):len(self.held)]
+            return
+        if isinstance(node, ast.Try):
+            self._visit_all(node.body)
+            for h in node.handlers:
+                self._visit_all(h.body)
+            self._visit_all(node.orelse)
+            self.finally_depth += 1
+            self._visit_all(node.finalbody)
+            self.finally_depth -= 1
+            return
+        if isinstance(node, ast.Await):
+            self._visit(node.value, awaited=True)
+            return
+        if isinstance(node, ast.Assign):
+            self._track_locals(node)
+            for t in node.targets:
+                self._visit_target(t)
+            self._visit(node.value)
+            return
+        if isinstance(node, ast.AugAssign):
+            self._visit_target(node.target)
+            self._visit(node.value)
+            return
+        if isinstance(node, ast.AnnAssign):
+            self._visit_target(node.target)
+            if node.value is not None:
+                self._visit(node.value)
+            return
+        if isinstance(node, ast.Delete):
+            for t in node.targets:
+                self._visit_target(t)
+            return
+        if isinstance(node, ast.Call):
+            self._visit_call(node, awaited)
+            return
+        attr = _self_attr(node)
+        if attr is not None:
+            self._record_access(attr, node, mutation=False)
+            return
+        self._visit_all(ast.iter_child_nodes(node))
+
+    def _visit_target(self, t: ast.AST) -> None:
+        attr = _self_attr(t)
+        if attr is not None:
+            self._record_access(attr, t, mutation=True)
+            return
+        if isinstance(t, ast.Subscript):
+            base = _self_attr(t.value)
+            if base is not None:
+                # self.x[k] = v mutates the container behind self.x
+                self._record_access(base, t, mutation=True)
+            else:
+                self._visit(t.value)
+            self._visit(t.slice)
+            return
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                self._visit_target(e)
+            return
+        if isinstance(t, ast.Starred):
+            self._visit_target(t.value)
+            return
+        self._visit(t)
+
+    def _track_locals(self, node: ast.Assign) -> None:
+        v = node.value
+        names = [t.id for t in node.targets if isinstance(t, ast.Name)]
+        if not names or not isinstance(v, ast.Call):
+            return
+        ctor = _last_attr(v.func)
+        if ctor in LOCK_CTORS:
+            self.local_locks.update(names)
+        elif ctor in THREAD_CTORS:
+            self.local_threads.update(names)
+        elif ctor in WAITABLE_CTORS:
+            self.local_waitables.update(names)
+
+    def _visit_call(self, node: ast.Call, awaited: bool) -> None:
+        self.c.call_records.append(CallRecord(
+            self.mod, self.fn, node, frozenset(self.held),
+            self.finally_depth > 0, awaited))
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            base = f.value
+            if f.attr in MUTATOR_METHODS:
+                attr = _self_attr(base)
+                if attr is None and isinstance(base, ast.Subscript):
+                    # self.x[i].append(...) mutates self.x's contents
+                    attr = _self_attr(base.value)
+                if attr is not None:
+                    self._record_access(attr, node, mutation=True)
+        self._visit_all([f] if not isinstance(f, ast.Attribute)
+                        else [f.value])
+        self._visit_all(node.args)
+        self._visit_all(kw.value for kw in node.keywords)
+
+
+class ConcurrencyChecker:
+    def __init__(self, index: FactIndex):
+        self.index = index
+        self.diags: list[Diagnostic] = []
+        self._class_info: dict[tuple[str, str], ClassInfo] = {}
+        # class name -> modules defining it (for typed method resolution)
+        self._class_defs: dict[str, list[str]] = {}
+        self.accesses: dict[tuple[str, str, str], list[Access]] = {}
+        self.call_records: list[CallRecord] = []
+        self.acquires: list[AcquireRecord] = []
+        self._callee_cache: dict[tuple[str, str], list[FunctionInfo]] = {}
+        # per-callsite resolution is re-requested by the held-propagation
+        # fixpoint and the lock-order pass; memoize on node identity
+        self._site_cache: dict[int, list[FunctionInfo]] = {}
+        self._param_types: dict[tuple[str, str], dict[str, str]] = {}
+        self.labels: dict[tuple[str, str], set[str]] = {}
+        self.root_targets: set[tuple[str, str]] = set()
+        self.entry_held: dict[tuple[str, str], Optional[frozenset[str]]] = {}
+
+    # -- phase 0: class facts ------------------------------------------------
+
+    def class_info(self, path: str, cls: str) -> ClassInfo:
+        return self._class_info.setdefault((path, cls), ClassInfo())
+
+    def _collect_class_facts(self) -> None:
+        # pass 1: register every class first — annotations/ctors in module A
+        # may reference classes defined in module B (scanned later)
+        for mod in self.index.modules.values():
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.ClassDef):
+                    paths = self._class_defs.setdefault(node.name, [])
+                    if mod.path not in paths:
+                        paths.append(mod.path)
+        # pass 2: classify attribute kinds
+        for mod in self.index.modules.values():
+            # class-body annotations (dataclass fields):
+            # `done: threading.Event = field(...)`
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                ci = self.class_info(mod.path, node.name)
+                for stmt in node.body:
+                    if (isinstance(stmt, ast.AnnAssign)
+                            and isinstance(stmt.target, ast.Name)):
+                        self._classify_annotation(
+                            ci, stmt.target.id, stmt.annotation)
+            for fn in mod.functions.values():
+                if fn.class_name is None:
+                    continue
+                ci = self.class_info(mod.path, fn.class_name)
+                for node in iter_scope(fn.node):
+                    if isinstance(node, ast.Assign):
+                        for t in node.targets:
+                            attr = _self_attr(t)
+                            if attr is not None:
+                                self._classify_value(ci, attr, node.value,
+                                                     in_init=fn.name in _INIT_NAMES)
+                                # `self.abort = abort` with an annotated
+                                # ctor param carries the param's type
+                                if isinstance(node.value, ast.Name):
+                                    self._classify_from_param(
+                                        ci, attr, node.value.id, fn)
+                    elif isinstance(node, ast.AnnAssign):
+                        attr = _self_attr(node.target)
+                        if attr is not None:
+                            self._classify_annotation(ci, attr, node.annotation)
+                            if node.value is not None:
+                                self._classify_value(ci, attr, node.value,
+                                                     in_init=fn.name in _INIT_NAMES)
+        # instance types only resolve to classes that actually exist in the
+        # scanned tree — a token matching nothing contributes no edges
+        for ci in self._class_info.values():
+            ci.instance_types = {
+                a: c for a, c in ci.instance_types.items()
+                if c in self._class_defs
+            }
+
+    def _classify_from_param(self, ci: ClassInfo, attr: str, name: str,
+                             fn: FunctionInfo) -> None:
+        args = fn.node.args
+        for p in args.posonlyargs + args.args + args.kwonlyargs:
+            if p.arg == name and p.annotation is not None:
+                self._classify_annotation(ci, attr, p.annotation)
+                return
+
+    def _classify_annotation(self, ci: ClassInfo, attr: str,
+                             ann: ast.AST) -> None:
+        toks = _annotation_class_tokens(ann)
+        # `threading.Thread` / `Optional[threading.Event]` only: a bare
+        # `Event` token may be ANY class named Event (the monitor's own
+        # Event dataclass) — misclassifying it as a threading primitive
+        # would silently exempt real shared state from KVM051/052
+        if "threading" in toks:
+            if toks & THREAD_CTORS:
+                ci.thread_attrs.add(attr)
+            if toks & WAITABLE_CTORS:
+                ci.waitable_attrs.add(attr)
+            if toks & THREADSAFE_CTORS:
+                ci.threadsafe_attrs.add(attr)
+        for t in sorted(toks):
+            if t in self._class_defs:
+                ci.instance_types.setdefault(attr, t)
+                break
+
+    def _classify_value(self, ci: ClassInfo, attr: str, value: ast.AST,
+                        in_init: bool) -> None:
+        if isinstance(value, (ast.List, ast.ListComp, ast.Dict, ast.DictComp,
+                              ast.Set, ast.SetComp)):
+            ci.container_attrs.add(attr)
+            return
+        if not isinstance(value, ast.Call):
+            return
+        ctor = _last_attr(value.func)
+        if ctor is None:
+            return
+        if ctor in LOCK_CTORS:
+            ci.lock_attrs[attr] = ctor
+            ci.threadsafe_attrs.add(attr)
+        elif ctor == "Condition":
+            ci.lock_attrs[attr] = "Condition"
+            ci.waitable_attrs.add(attr)
+            ci.threadsafe_attrs.add(attr)
+        elif ctor in WAITABLE_CTORS:
+            ci.waitable_attrs.add(attr)
+            ci.threadsafe_attrs.add(attr)
+        elif ctor in THREADSAFE_CTORS:
+            ci.threadsafe_attrs.add(attr)
+        elif ctor in THREAD_CTORS:
+            ci.thread_attrs.add(attr)
+        elif ctor in CONTAINER_CTORS:
+            ci.container_attrs.add(attr)
+        elif ctor in self._class_defs:
+            ci.instance_types.setdefault(attr, ctor)
+
+    # -- typed call resolution ----------------------------------------------
+
+    def _methods_of(self, cls: str, name: str) -> list[FunctionInfo]:
+        out = []
+        for path in self._class_defs.get(cls, []):
+            cand = self.index.modules[path].functions.get(f"{cls}.{name}")
+            if cand is not None:
+                out.append(cand)
+        return out
+
+    def _fn_param_types(self, mod: ModuleFacts,
+                        fn: FunctionInfo) -> dict[str, str]:
+        key = fn.key()
+        cached = self._param_types.get(key)
+        if cached is not None:
+            return cached
+        types: dict[str, str] = {}
+        args = fn.node.args
+        for p in args.posonlyargs + args.args + args.kwonlyargs:
+            if p.annotation is None:
+                continue
+            for t in _annotation_class_tokens(p.annotation):
+                if t in self._class_defs:
+                    types[p.arg] = t
+                    break
+        # local `x = ClassName(...)` bindings
+        for node in iter_scope(fn.node):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                ctor = _last_attr(node.value.func)
+                if ctor in self._class_defs:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            types[t.id] = ctor
+        self._param_types[key] = types
+        return types
+
+    def _callees(self, mod: ModuleFacts, fn: FunctionInfo,
+                 call: ast.Call) -> list[FunctionInfo]:
+        cached = self._site_cache.get(id(call))
+        if cached is not None:
+            return cached
+        out = self._callees_uncached(mod, fn, call)
+        self._site_cache[id(call)] = out
+        return out
+
+    def _callees_uncached(self, mod: ModuleFacts, fn: FunctionInfo,
+                          call: ast.Call) -> list[FunctionInfo]:
+        resolved = self.index.resolve_call(mod, fn, call)
+        if resolved:
+            return resolved
+        f = call.func
+        if not isinstance(f, ast.Attribute):
+            return []
+        recv = f.value
+        cls: Optional[str] = None
+        attr = _self_attr(recv)
+        if attr is not None and fn.class_name:
+            ci = self.class_info(mod.path, fn.class_name)
+            cls = ci.instance_types.get(attr)
+        elif isinstance(recv, ast.Name):
+            cls = self._fn_param_types(mod, fn).get(recv.id)
+        if cls is None:
+            return []
+        return self._methods_of(cls, f.attr)
+
+    def _fn_callees(self, mod: ModuleFacts,
+                    fn: FunctionInfo) -> list[FunctionInfo]:
+        key = fn.key()
+        cached = self._callee_cache.get(key)
+        if cached is not None:
+            return cached
+        out: list[FunctionInfo] = []
+        seen: set[tuple[str, str]] = set()
+        for cs in self.index.call_sites(mod, fn):
+            for callee in self._callees(mod, fn, cs.node):
+                if callee.key() not in seen:
+                    seen.add(callee.key())
+                    out.append(callee)
+        self._callee_cache[key] = out
+        return out
+
+    # -- phase 1: thread roots + reachability labels ------------------------
+
+    def _resolve_target(self, mod: ModuleFacts, fn: FunctionInfo,
+                        expr: ast.AST) -> list[FunctionInfo]:
+        if isinstance(expr, ast.Call) and _last_attr(expr.func) == "partial":
+            if expr.args:
+                return self._resolve_target(mod, fn, expr.args[0])
+            return []
+        return self.index._resolve_expr(mod, fn, expr)
+
+    def _discover_roots(self) -> list[tuple[FunctionInfo, str]]:
+        roots: list[tuple[FunctionInfo, str]] = []
+        for mod in self.index.modules.values():
+            for fn in mod.functions.values():
+                if fn.name.startswith(REPLAY_DRIVER_PREFIXES):
+                    roots.append((fn, DRIVER_ROOT))
+                for node in iter_scope(fn.node):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    roots.extend(self._roots_from_call(mod, fn, node))
+        return roots
+
+    def _roots_from_call(self, mod: ModuleFacts, fn: FunctionInfo,
+                         node: ast.Call) -> list[tuple[FunctionInfo, str]]:
+        out: list[tuple[FunctionInfo, str]] = []
+        ctor = _last_attr(node.func)
+        if ctor in THREAD_CTORS:
+            target = None
+            label = None
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    target = kw.value
+                if kw.arg == "name" and isinstance(kw.value, ast.Constant) \
+                        and isinstance(kw.value.value, str):
+                    label = kw.value.value
+            if target is None and ctor == "Timer" and len(node.args) > 1:
+                target = node.args[1]
+            if target is not None:
+                for t in self._resolve_target(mod, fn, target):
+                    out.append((t, label or f"thread:{t.name}"))
+            return out
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            if f.attr == "submit" and node.args:
+                for t in self._resolve_target(mod, fn, node.args[0]):
+                    out.append((t, f"pool:{t.name}"))
+            elif f.attr == "run_in_executor" and len(node.args) > 1:
+                for t in self._resolve_target(mod, fn, node.args[1]):
+                    out.append((t, f"pool:{t.name}"))
+            elif f.attr in HANDLER_REGISTRARS and len(node.args) > 1:
+                for t in self._resolve_target(mod, fn, node.args[1]):
+                    out.append((t, HTTP_ROOT))
+            elif f.attr == "add_route" and len(node.args) > 2:
+                for t in self._resolve_target(mod, fn, node.args[2]):
+                    out.append((t, HTTP_ROOT))
+            elif f.attr in ADMIN_EXECUTOR_METHODS and node.args:
+                for t in self._resolve_target(mod, fn, node.args[0]):
+                    out.append((t, DRIVER_ROOT))
+        if _last_attr(node.func) == "to_thread" and node.args:
+            for t in self._resolve_target(mod, fn, node.args[0]):
+                out.append((t, f"pool:{t.name}"))
+        return out
+
+    def _reach(self, start: FunctionInfo) -> set[tuple[str, str]]:
+        seen = {start.key()}
+        work = [start]
+        while work:
+            fn = work.pop()
+            mod = self.index.modules.get(fn.path)
+            if mod is None:
+                continue
+            for callee in self._fn_callees(mod, fn):
+                ck = callee.key()
+                # a root target's execution context is its own root, not
+                # the caller's — don't propagate through the boundary
+                if ck in seen or ck in self.root_targets:
+                    continue
+                seen.add(ck)
+                work.append(callee)
+        return seen
+
+    def _label_functions(self) -> None:
+        raw_roots = self._discover_roots()
+        self.root_targets = {fn.key() for fn, _ in raw_roots}
+        replayed = self.index.follower_replayed_methods()
+        reach_cache: dict[tuple[str, str], set[tuple[str, str]]] = {}
+        for fn, label in raw_roots:
+            if fn.key() not in reach_cache:
+                reach_cache[fn.key()] = self._reach(fn)
+            reached = reach_cache[fn.key()]
+            # driver coalescing: one engine instance has exactly one driver
+            if any(
+                self.index.modules[p].functions[q].name in replayed
+                and self.index.modules[p].functions[q].class_name is not None
+                for p, q in reached
+            ):
+                label = DRIVER_ROOT
+            for key in reached:
+                self.labels.setdefault(key, set()).add(label)
+        # implicit main: everything no spawned root reaches
+        main_seeds = [
+            fn for fn in self.index.functions()
+            if not self.labels.get(fn.key())
+            and fn.key() not in self.root_targets
+        ]
+        seen: set[tuple[str, str]] = set()
+        work = list(main_seeds)
+        for fn in main_seeds:
+            seen.add(fn.key())
+        while work:
+            fn = work.pop()
+            self.labels.setdefault(fn.key(), set()).add(MAIN_ROOT)
+            mod = self.index.modules.get(fn.path)
+            if mod is None:
+                continue
+            for callee in self._fn_callees(mod, fn):
+                ck = callee.key()
+                if ck in seen or ck in self.root_targets:
+                    continue
+                seen.add(ck)
+                work.append(callee)
+
+    def _fn_labels(self, fn: FunctionInfo) -> frozenset[str]:
+        return frozenset(self.labels.get(fn.key(), {MAIN_ROOT}))
+
+    # -- phase 2: scan function bodies --------------------------------------
+
+    def _scan_functions(self) -> None:
+        for mod in self.index.modules.values():
+            for fn in mod.functions.values():
+                if fn.name in _INIT_NAMES:
+                    continue  # pre-publication: the object isn't shared yet
+                _FnScanner(self, mod, fn).scan()
+
+    # -- phase 3: held-at-entry propagation (helper-method indirection) -----
+
+    def _propagate_held(self) -> None:
+        for _ in range(4):
+            changed = False
+            for rec in self.call_records:
+                eff = rec.held | (self.entry_held.get(rec.fn.key())
+                                  or frozenset())
+                for callee in self._callees(rec.mod, rec.fn, rec.node):
+                    # only private same-class helpers: a public method is
+                    # callable from anywhere, including lock-free paths the
+                    # index never sees
+                    if (callee.class_name is None
+                            or callee.class_name != rec.fn.class_name
+                            or not callee.name.startswith("_")
+                            or callee.key() in self.root_targets):
+                        continue
+                    prev = self.entry_held.get(callee.key())
+                    new = eff if prev is None else (prev & eff)
+                    if new != prev:
+                        self.entry_held[callee.key()] = new
+                        changed = True
+            if not changed:
+                return
+
+    def _guards(self, a: Access) -> frozenset[str]:
+        return a.held | (self.entry_held.get(a.fn.key()) or frozenset())
+
+    # -- emission helpers ---------------------------------------------------
+
+    def _emit(self, mod: ModuleFacts, line: int, code: str, msg: str,
+              ctx: str) -> None:
+        if mod.suppressions.is_suppressed(line, code):
+            return
+        self.diags.append(Diagnostic(mod.path, line, code, msg, context=ctx))
+
+    # -- KVM051 / KVM052 ----------------------------------------------------
+
+    def _check_guarded_by(self) -> None:
+        for (path, cls, attr), accs in sorted(self.accesses.items()):
+            ci = self.class_info(path, cls)
+            if attr in ci.threadsafe_attrs or attr in ci.thread_attrs:
+                continue
+            muts = [a for a in accs if a.mutation]
+            if not muts:
+                continue
+            roots: set[str] = set()
+            for a in accs:
+                roots |= self._fn_labels(a.fn)
+            if len(roots) < 2:
+                continue
+            guard_sets = [self._guards(a) for a in accs]
+            common = frozenset.intersection(*guard_sets)
+            if common:
+                continue  # one lock consistently guards every access
+            accs_sorted = sorted(accs, key=lambda a: (a.mod.path, a.line))
+            ctx = f"{cls}.{attr}"
+            rootlist = ", ".join(sorted(roots))
+            if not any(guard_sets):
+                # no lock anywhere: anchor where the annotation belongs —
+                # the foreign access when one root owns every mutation (the
+                # benign-snapshot read), else the MINORITY root's mutation
+                # (the unusual thread's write, e.g. a gauge updated from the
+                # submit path while the scheduler owns everything else)
+                mut_labels = {self._fn_labels(a.fn) for a in muts}
+                if len(mut_labels) == 1:
+                    anchor = min(
+                        (a for a in accs_sorted
+                         if self._fn_labels(a.fn) != next(iter(mut_labels))),
+                        key=lambda a: (a.mod.path, a.line),
+                        default=min(muts, key=lambda a: (a.mod.path, a.line)),
+                    )
+                else:
+                    groups: dict[frozenset[str], list[Access]] = {}
+                    for a in muts:
+                        groups.setdefault(self._fn_labels(a.fn), []).append(a)
+                    _, minority = min(
+                        groups.items(),
+                        key=lambda kv: (len(kv[1]), tuple(sorted(kv[0]))),
+                    )
+                    anchor = min(minority,
+                                 key=lambda a: (a.mod.path, a.line))
+                self._emit(
+                    anchor.mod, anchor.line, "KVM051",
+                    f"`self.{attr}` is mutated and shared across threads "
+                    f"({rootlist}) with no lock guarding any access — a "
+                    "torn read/lost update is a matter of timing; guard "
+                    "every access with one lock or mark the intentional "
+                    "single-writer design `# kvmini: thread-ok`",
+                    ctx)
+            else:
+                # deterministic tiebreak on the lock name: set iteration
+                # order is hash-randomized, and a flapping `best` would
+                # move the anchored line between runs
+                best = max(
+                    sorted({g for gs in guard_sets for g in gs}),
+                    key=lambda lk: sum(1 for gs in guard_sets if lk in gs),
+                )
+                bare = min(
+                    (a for a, gs in zip(accs_sorted,
+                                        [self._guards(a) for a in accs_sorted])
+                     if best not in gs),
+                    key=lambda a: (a.mod.path, a.line),
+                )
+                kind = "written" if bare.mutation else "read"
+                self._emit(
+                    bare.mod, bare.line, "KVM052",
+                    f"`self.{attr}` is guarded by `{best}` elsewhere but "
+                    f"{kind} bare here (threads: {rootlist}) — inconsistent "
+                    "guarding protects nothing; take the same lock or mark "
+                    "`# kvmini: lock-ok`",
+                    ctx)
+
+    # -- KVM053 -------------------------------------------------------------
+
+    def _acquired_transitive(self) -> dict[tuple[str, str], set[str]]:
+        """Locks each function may acquire, directly or via callees."""
+        direct: dict[tuple[str, str], set[str]] = {}
+        for rec in self.acquires:
+            direct.setdefault(rec.fn.key(), set()).add(rec.lock)
+        trans = {k: set(v) for k, v in direct.items()}
+        for _ in range(6):
+            changed = False
+            for mod in self.index.modules.values():
+                for fn in mod.functions.values():
+                    mine = trans.setdefault(fn.key(), set())
+                    for callee in self._fn_callees(mod, fn):
+                        extra = trans.get(callee.key())
+                        if extra and not extra <= mine:
+                            mine |= extra
+                            changed = True
+            if not changed:
+                break
+        return trans
+
+    def _check_lock_order(self) -> None:
+        edges: dict[tuple[str, str], tuple[ModuleFacts, int]] = {}
+
+        def add_edge(a: str, b: str, mod: ModuleFacts, line: int) -> None:
+            if (a, b) not in edges:
+                edges[(a, b)] = (mod, line)
+
+        rlocks = {
+            f"{cls}.{attr}"
+            for (_p, cls), ci in self._class_info.items()
+            for attr, ctor in ci.lock_attrs.items() if ctor == "RLock"
+        }
+        for rec in self.acquires:
+            held = rec.held | (self.entry_held.get(rec.fn.key())
+                               or frozenset())
+            for h in held:
+                if h == rec.lock and h in rlocks:
+                    continue  # re-entrant self-acquire is legal
+                add_edge(h, rec.lock, rec.mod,
+                         getattr(rec.node, "lineno", 0))
+        trans = self._acquired_transitive()
+        for rec in self.call_records:
+            held = rec.held | (self.entry_held.get(rec.fn.key())
+                               or frozenset())
+            if not held:
+                continue
+            for callee in self._callees(rec.mod, rec.fn, rec.node):
+                for lk in trans.get(callee.key(), ()):
+                    for h in held:
+                        if h == lk and h in rlocks:
+                            continue
+                        add_edge(h, lk, rec.mod,
+                                 getattr(rec.node, "lineno", 0))
+        # cycle detection over the digraph; one diagnostic per cycle
+        adj: dict[str, set[str]] = {}
+        for (a, b) in edges:
+            adj.setdefault(a, set()).add(b)
+        seen_cycles: set[frozenset[str]] = set()
+        for start in sorted(adj):
+            stack = [(start, [start])]
+            while stack:
+                node, pathway = stack.pop()
+                for nxt in sorted(adj.get(node, ())):
+                    if nxt == start:
+                        cyc = frozenset(pathway)
+                        if cyc in seen_cycles:
+                            continue
+                        seen_cycles.add(cyc)
+                        cycle_edges = list(zip(pathway,
+                                               pathway[1:] + [start]))
+                        mod, line = min(
+                            (edges[e] for e in cycle_edges if e in edges),
+                            key=lambda ml: (ml[0].path, ml[1]),
+                        )
+                        order = " -> ".join(pathway + [start])
+                        self._emit(
+                            mod, line, "KVM053",
+                            f"lock-order cycle {order}: two threads taking "
+                            "these locks in opposite order deadlock; pick "
+                            "one global order or mark `# kvmini: lock-ok`",
+                            "->".join(sorted(cyc)))
+                    elif nxt not in pathway and len(pathway) < 6:
+                        stack.append((nxt, pathway + [nxt]))
+
+    # -- KVM054 -------------------------------------------------------------
+
+    def _check_primitives(self) -> None:
+        for rec in self.call_records:
+            f = rec.node.func
+            if not isinstance(f, ast.Attribute):
+                continue
+            has_bound = bool(rec.node.args) or any(
+                kw.arg == "timeout" for kw in rec.node.keywords)
+            if f.attr == "wait" and not has_bound and not rec.awaited:
+                if self._is_waitable(rec):
+                    self._emit(
+                        rec.mod, rec.node.lineno, "KVM054",
+                        f"`{ast.unparse(f.value)}.wait()` without a timeout "
+                        f"in `{rec.fn.name}` — if the setter dies this "
+                        "blocks forever; pass a timeout and handle the "
+                        "False return, or mark `# kvmini: thread-ok`",
+                        rec.fn.qualname)
+            elif f.attr == "join" and not has_bound:
+                if not self._is_threadish(rec):
+                    continue
+                if TEARDOWN_NAME.search(rec.fn.name) or rec.in_finally:
+                    self._emit(
+                        rec.mod, rec.node.lineno, "KVM054",
+                        f"unbounded `{ast.unparse(f.value)}.join()` in "
+                        f"teardown path `{rec.fn.name}` — a wedged worker "
+                        "hangs shutdown; join with a timeout (and surface "
+                        "a still-alive thread), or mark "
+                        "`# kvmini: thread-ok`",
+                        rec.fn.qualname)
+
+    def _is_waitable(self, rec: CallRecord) -> bool:
+        recv = rec.node.func.value  # type: ignore[union-attr]
+        attr = _self_attr(recv)
+        if attr is not None and rec.fn.class_name:
+            ci = self.class_info(rec.mod.path, rec.fn.class_name)
+            return attr in ci.waitable_attrs
+        if isinstance(recv, ast.Name):
+            # conservatively: locally-created Events/Conditions only
+            return any(
+                isinstance(n, ast.Assign)
+                and isinstance(n.value, ast.Call)
+                and _last_attr(n.value.func) in WAITABLE_CTORS
+                and any(isinstance(t, ast.Name) and t.id == recv.id
+                        for t in n.targets)
+                for n in iter_scope(rec.fn.node)
+            )
+        return False
+
+    def _is_threadish(self, rec: CallRecord) -> bool:
+        recv = rec.node.func.value  # type: ignore[union-attr]
+        attr = _self_attr(recv)
+        if attr is not None and rec.fn.class_name:
+            ci = self.class_info(rec.mod.path, rec.fn.class_name)
+            return attr in ci.thread_attrs
+        if isinstance(recv, ast.Name):
+            scanner_types = _FnScanner(self, rec.mod, rec.fn)
+            if recv.id in scanner_types.local_threads:
+                return True
+            return any(
+                isinstance(n, ast.Assign)
+                and isinstance(n.value, ast.Call)
+                and _last_attr(n.value.func) in THREAD_CTORS
+                and any(isinstance(t, ast.Name) and t.id == recv.id
+                        for t in n.targets)
+                for n in iter_scope(rec.fn.node)
+            )
+        return False
+
+    # -- KVM055 -------------------------------------------------------------
+
+    def _check_publication(self) -> None:
+        for mod in self.index.modules.values():
+            for fn in mod.functions.values():
+                if fn.class_name is None or fn.name in _INIT_NAMES:
+                    continue
+                ci = self.class_info(mod.path, fn.class_name)
+                for node in iter_scope(fn.node):
+                    if not isinstance(node, ast.Return) or node.value is None:
+                        continue
+                    attr = _self_attr(node.value)
+                    if attr is None or attr not in ci.container_attrs:
+                        continue
+                    accs = self.accesses.get(
+                        (mod.path, fn.class_name, attr), [])
+                    if not any(a.mutation for a in accs):
+                        continue
+                    roots: set[str] = set()
+                    for a in accs:
+                        roots |= self._fn_labels(a.fn)
+                    roots |= self._fn_labels(fn)
+                    if len(roots) < 2:
+                        continue
+                    self._emit(
+                        mod, node.lineno, "KVM055",
+                        f"`{fn.name}` returns `self.{attr}` — a live "
+                        "mutable container another thread mutates "
+                        f"({', '.join(sorted(roots))}); the raw reference "
+                        "outlives any lock and iteration races mutation "
+                        "(the /traces deque bug class); return a snapshot "
+                        "(`list(...)`) or mark `# kvmini: thread-ok`",
+                        f"{fn.class_name}.{attr}")
+
+    # -- driver --------------------------------------------------------------
+
+    def run(self) -> list[Diagnostic]:
+        self._collect_class_facts()
+        self._label_functions()
+        self._scan_functions()
+        self._propagate_held()
+        self._check_guarded_by()
+        self._check_lock_order()
+        self._check_primitives()
+        self._check_publication()
+        return self.diags
+
+
+def check(index: FactIndex) -> list[Diagnostic]:
+    return ConcurrencyChecker(index).run()

@@ -18,6 +18,7 @@ import io
 import re
 import tokenize
 from dataclasses import dataclass, field
+from typing import Optional
 
 
 @dataclass(frozen=True)
@@ -60,6 +61,20 @@ RULES: dict[str, Rule] = {
         Rule("KVM041", "workload-change-unsurfaced", "workload-ok",
              "truncation/drop/fallback that doesn't stamp a flag field the "
              "analyzer reads"),
+        Rule("KVM051", "unguarded-cross-thread-mutation", "thread-ok",
+             "attribute mutated and shared across thread roots with no lock "
+             "guarding any access"),
+        Rule("KVM052", "inconsistent-lock-guard", "lock-ok",
+             "attribute guarded by a lock on some accesses but touched bare "
+             "on others (read under lock here, written bare there)"),
+        Rule("KVM053", "lock-order-cycle", "lock-ok",
+             "cycle in the acquires-while-holding graph (potential deadlock)"),
+        Rule("KVM054", "unbounded-wait", "thread-ok",
+             "Event/Condition wait() without a timeout, or Thread.join() "
+             "without a bound in stop/teardown code"),
+        Rule("KVM055", "shared-mutable-publication", "thread-ok",
+             "mutable container handed across the thread boundary without "
+             "snapshot (list()/dict() copy) — iteration races mutation"),
     ]
 }
 
@@ -118,12 +133,25 @@ class Suppressions:
                 return True
         return False
 
-    def stale(self, path: str) -> list[Diagnostic]:
-        """KVM001 for comments that suppressed nothing in this run."""
+    def stale(self, path: str,
+              active_tokens: Optional[set[str]] = None) -> list[Diagnostic]:
+        """KVM001 for comments that suppressed nothing in this run.
+
+        ``active_tokens`` restricts the check to the suppression tokens
+        whose rules actually ran — a ``--family KVM05`` scan must not
+        flag a ``sync-ok`` comment as stale just because the jit checker
+        was filtered out this run. The CONTEXT (= baseline key) is still
+        built from every known token on the line, so a family-filtered
+        run produces the same key a full run baselined (a multi-token
+        comment must not flap between 'thread-ok' and
+        'lock-ok,thread-ok' depending on the filter)."""
+        active = set(SUPPRESSION_TOKENS)
+        if active_tokens is not None:
+            active &= active_tokens
         out = []
         for line, toks in sorted(self.by_line.items()):
             known = toks & set(SUPPRESSION_TOKENS)
-            if known and line not in self.used:
+            if known and (known & active) and line not in self.used:
                 out.append(Diagnostic(
                     path, line, "KVM001",
                     f"stale suppression `# kvmini: {', '.join(sorted(known))}` "

@@ -2,27 +2,34 @@
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Iterable, Optional
 
 from kserve_vllm_mini_tpu.lint import (
     baseline as baseline_mod,
+    concurrency,
     jit_purity,
     lockstep,
     metrics_drift,
     workload,
 )
-from kserve_vllm_mini_tpu.lint.diagnostics import Diagnostic
+from kserve_vllm_mini_tpu.lint.diagnostics import RULES, Diagnostic
 from kserve_vllm_mini_tpu.lint.facts import FactIndex
 
 EXCLUDED_DIR_NAMES = {"__pycache__", ".git", "node_modules", ".venv"}
 
+# (family prefix, display name, checker) — `--family KVM05` selects by
+# prefix match on the family column; KVM03 is special-cased below because
+# the drift checker also consumes the docs/dashboards surfaces
 CHECKERS = (
-    jit_purity.check,
-    lockstep.check,
-    workload.check,
+    ("KVM01", "jit_purity", jit_purity.check),
+    ("KVM02", "lockstep", lockstep.check),
+    ("KVM04", "workload", workload.check),
+    ("KVM05", "concurrency", concurrency.check),
 )
+METRICS_FAMILY = "KVM03"
 
 
 def discover_py_files(paths: Iterable[Path]) -> list[Path]:
@@ -47,11 +54,88 @@ def discover_doc_files(paths: Iterable[Path]) -> list[Path]:
     return out
 
 
+def normalize_families(families: Optional[Iterable[str]]) -> Optional[set[str]]:
+    """CLI family args ("KVM05", "kvm051") -> validated prefix set.
+
+    KVM001 (stale suppressions) is meta — it rides along with whatever
+    rules run and cannot be selected on its own; accepting it would
+    select zero checkers and report a green no-op."""
+    if not families:
+        return None
+    out = set()
+    selectable = set(RULES) - {"KVM001"}
+    for f in families:
+        norm = f.strip().upper()
+        if not norm.startswith("KVM") or not any(
+                code.startswith(norm) for code in selectable):
+            raise ValueError(
+                f"unknown rule family {f!r} (families: KVM01..KVM05, or a "
+                "full code like KVM051; KVM001 always rides along and is "
+                "not selectable)")
+        out.add(norm)
+    return out
+
+
+def _family_selected(families: Optional[set[str]], prefix: str) -> bool:
+    if families is None:
+        return True
+    return any(f.startswith(prefix) or prefix.startswith(f) for f in families)
+
+
+def _active_suppression_tokens(families: Optional[set[str]]) -> Optional[set[str]]:
+    """Tokens whose rules actually run under this family filter (None =
+    everything runs; KVM001 staleness then checks all tokens)."""
+    if families is None:
+        return None
+    return {
+        r.suppression for code, r in RULES.items()
+        if r.suppression and any(code.startswith(f) for f in families)
+    }
+
+
+def _code_selected(code: str, families: Optional[set[str]]) -> bool:
+    """Does this diagnostic code fall under the family filter? Handles
+    both directions: ``--family KVM05`` selects KVM051..055, and a full
+    code ``--family KVM051`` selects exactly KVM051 (the checker still
+    RUNS at family granularity, so sibling findings must be dropped
+    after the fact — the help text promises one rule)."""
+    if families is None:
+        return True
+    return any(code.startswith(f) or f.startswith(code) for f in families)
+
+
+def _filter_baseline(baseline: dict[str, int],
+                     families: Optional[set[str]],
+                     active_tokens: Optional[set[str]]) -> dict[str, int]:
+    """With a family filter, only that family's baseline entries are in
+    play — entries for rules that didn't run this pass must not read as
+    stale. Keys are ``path::code::context``; for KVM001 the context IS
+    the suppression token list, so stale-suppression entries stay in
+    play only when their tokens' rules ran."""
+    if families is None:
+        return baseline
+    out = {}
+    for key, n in baseline.items():
+        parts = key.split("::")
+        code = parts[1] if len(parts) > 1 else ""
+        if code == "KVM001":
+            tokens = set((parts[2] if len(parts) > 2 else "").split(","))
+            if active_tokens is None or tokens & active_tokens:
+                out[key] = n
+        elif _code_selected(code, families):
+            out[key] = n
+    return out
+
+
 @dataclass
 class LintResult:
     diagnostics: list[Diagnostic] = field(default_factory=list)
     parse_errors: list[tuple[str, int, str]] = field(default_factory=list)
     baseline_diff: Optional[baseline_mod.BaselineDiff] = None
+    # per-stage wall time (seconds): fact-index build + each checker that
+    # ran — the `--timing` surface the <10s live-codebase pin uses to
+    # attribute regressions to a specific checker
+    timings: dict[str, float] = field(default_factory=dict)
 
     @property
     def exit_code(self) -> int:
@@ -81,10 +165,14 @@ def run_lint(
     doc_paths: Optional[list[Path]] = None,
     baseline_path: Optional[Path] = None,
     root: Optional[Path] = None,
+    families: Optional[set[str]] = None,
 ) -> LintResult:
     root = (root or Path.cwd()).resolve()
     files = discover_py_files(paths)
+    timings: dict[str, float] = {}
+    t0 = time.perf_counter()
     index = FactIndex.build(root, [root / _rel(root, f) for f in files])
+    timings["facts"] = time.perf_counter() - t0
 
     # cross-surface drift (KVM032 vs docs/dashboards) asserts over the
     # WHOLE emitter set, so it only runs for directory scans — linting a
@@ -92,7 +180,7 @@ def run_lint(
     # emitter modules provide
     full_scan = bool(paths) and all(p.is_dir() for p in paths)
     doc_texts: dict[str, str] = {}
-    if full_scan:
+    if full_scan and _family_selected(families, METRICS_FAMILY):
         for doc in discover_doc_files(doc_paths or []):
             try:
                 doc_texts[_rel(root, doc).as_posix()] = doc.read_text(
@@ -101,26 +189,41 @@ def run_lint(
                 continue
 
     diags: list[Diagnostic] = []
-    for checker in CHECKERS:
+    for family, name, checker in CHECKERS:
+        if not _family_selected(families, family):
+            continue
+        t0 = time.perf_counter()
         diags += checker(index)
-    diags += metrics_drift.check(index, doc_texts)
+        timings[name] = time.perf_counter() - t0
+    if _family_selected(families, METRICS_FAMILY):
+        t0 = time.perf_counter()
+        diags += metrics_drift.check(index, doc_texts)
+        timings["metrics_drift"] = time.perf_counter() - t0
 
-    # stale `# kvmini:` comments — only after every rule had its chance
+    # stale `# kvmini:` comments — only after every rule had its chance,
+    # and only for the suppression tokens whose rules ran this pass
+    active_tokens = _active_suppression_tokens(families)
     for mod in index.modules.values():
-        diags += mod.suppressions.stale(mod.path)
+        diags += mod.suppressions.stale(mod.path, active_tokens)
 
     # nested defs are visited both standalone and inside their enclosing
-    # function's walk; report each site once
+    # function's walk; report each site once. A full-code family filter
+    # (--family KVM051) also drops sibling codes the family checker
+    # emitted (KVM001 is already token-restricted above).
     seen: set[tuple[str, int, str, str]] = set()
     unique: list[Diagnostic] = []
     for d in sorted(diags, key=lambda d: (d.path, d.line, d.code)):
+        if d.code != "KVM001" and not _code_selected(d.code, families):
+            continue
         k = (d.path, d.line, d.code, d.message)
         if k not in seen:
             seen.add(k)
             unique.append(d)
 
-    result = LintResult(diagnostics=unique, parse_errors=index.parse_errors)
+    result = LintResult(diagnostics=unique, parse_errors=index.parse_errors,
+                        timings={k: round(v, 4) for k, v in timings.items()})
     if baseline_path is not None and baseline_path.exists():
         result.baseline_diff = baseline_mod.diff(
-            unique, baseline_mod.load(baseline_path))
+            unique, _filter_baseline(baseline_mod.load(baseline_path),
+                                     families, active_tokens))
     return result

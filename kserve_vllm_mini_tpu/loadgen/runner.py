@@ -37,7 +37,11 @@ class LiveStats:
     (monitor/sampler.py, its own thread) polls ``snapshot()`` and
     ``completions()`` at ~1 Hz for the timeline and rolling burn-rate
     windows — hence the lock and the bounded completion deque (the
-    monitor only ever looks back one window, not the whole run)."""
+    monitor only ever looks back one window, not the whole run). This is
+    the locking pattern kvmini-lint's KVM051/052/055 rules enforce
+    package-wide (docs/LINTING.md): every access under ONE lock, and
+    readers get snapshots (``list(self._events)``), never the live
+    container."""
 
     def __init__(self, max_events: int = 8192) -> None:
         self._lock = threading.Lock()

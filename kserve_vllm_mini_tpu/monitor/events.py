@@ -60,7 +60,11 @@ class AbortSignal:
 
     @property
     def reason(self) -> Optional[str]:
-        return self._reason
+        # same lock the writer holds (KVM052): the monitor thread sets the
+        # reason while sweeps/loadgen read it — without the lock a reader
+        # could observe `_event` set but `_reason` still None
+        with self._lock:
+            return self._reason
 
     def on_set(self, callback: Callable[[], None]) -> None:
         """Register a callback fired when the signal is set. Fires

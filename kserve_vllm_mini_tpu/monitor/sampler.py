@@ -21,11 +21,14 @@ import threading
 import time
 from dataclasses import dataclass, field
 from pathlib import Path
-from typing import Any, Callable, Optional
+from typing import TYPE_CHECKING, Any, Callable, Optional
 
 from kserve_vllm_mini_tpu.analysis import telemetry
 from kserve_vllm_mini_tpu.monitor import burnrate
 from kserve_vllm_mini_tpu.monitor.events import AbortSignal, Event, EventDetector
+
+if TYPE_CHECKING:  # type-only: the monitor must not import httpx at runtime
+    from kserve_vllm_mini_tpu.loadgen.runner import LiveStats
 
 # runtime /metrics series carried into each timeline sample, stored under
 # sample["runtime"] with the kvmini_tpu_ prefix stripped. Counters keep
@@ -86,7 +89,7 @@ class RunMonitor:
         self,
         timeline_path: Path,
         endpoint: Optional[str],
-        live: Any = None,
+        live: Optional["LiveStats"] = None,
         cfg: Optional[MonitorConfig] = None,
         abort: Optional[AbortSignal] = None,
         scrape_fn: Optional[Callable[..., dict[str, float]]] = None,
@@ -97,6 +100,11 @@ class RunMonitor:
         self.cfg = cfg or MonitorConfig()
         self.abort = abort
         self._scrape = scrape_fn or telemetry.scrape_runtime_metrics
+        # guards the cross-thread view (samples/events/skipped/burn_*):
+        # the sampler thread mutates while stop()/summary()/timeline()
+        # read — stop()'s join is BOUNDED, so the thread may still be
+        # mid-tick when the summary is taken (KVM051)
+        self._state_lock = threading.Lock()
         self.samples: list[dict[str, Any]] = []
         self.events: list[Event] = []
         self.skipped = 0
@@ -134,6 +142,7 @@ class RunMonitor:
         sample: dict[str, Any] = {"t": t_tick, "scrape_ms": round(scrape_ms, 3)}
         if runtime is not None:
             sample["runtime"] = runtime
+        burn: dict[str, float] = {}
         if self.live is not None:
             lg = self.live.snapshot()
             if self._t_started is None:
@@ -152,25 +161,33 @@ class RunMonitor:
             if "throughput_rps" in win:
                 lg["window_throughput_rps"] = round(win["throughput_rps"], 4)
             sample["loadgen"] = lg
-            self.burn_latest = burnrate.burn_rates(win, self.cfg.budgets)
-            for k, v in self.burn_latest.items():
-                self.burn_peak[k] = max(self.burn_peak.get(k, 0.0), v)
-            if self.burn_latest:
+            burn = burnrate.burn_rates(win, self.cfg.budgets)
+            if burn:
                 sample["burn_rates"] = {
-                    k: round(v, 4) for k, v in self.burn_latest.items()
+                    k: round(v, 4) for k, v in burn.items()
                 }
-        fired = self._detector.observe(sample, self.burn_latest)
+        fired = self._detector.observe(sample, burn)
         if fired:
             sample["events"] = [e.to_dict() for e in fired]
+        # publish the tick atomically: stop()/summary()/timeline() read
+        # from other threads, and the bounded stop-join means they can
+        # overlap a tick still in flight
+        with self._state_lock:
+            if self.live is not None:
+                self.burn_latest = burn
+                for k, v in burn.items():
+                    self.burn_peak[k] = max(self.burn_peak.get(k, 0.0), v)
             self.events.extend(fired)
-            for e in fired:
-                if (
-                    self.abort is not None
-                    and self.cfg.abort_enabled
-                    and e.type in self.cfg.abort_on
-                ):
-                    self.abort.set(f"{e.type}: {e.detail}")
-        self.samples.append(sample)
+            self.samples.append(sample)
+        for e in fired:
+            if (
+                self.abort is not None
+                and self.cfg.abort_enabled
+                and e.type in self.cfg.abort_on
+            ):
+                # outside _state_lock: AbortSignal.set takes its own lock
+                # and fires registered callbacks — keep the lock graph flat
+                self.abort.set(f"{e.type}: {e.detail}")
         if fh is not None:
             fh.write(json.dumps(sample, sort_keys=True) + "\n")
             fh.flush()
@@ -192,7 +209,8 @@ class RunMonitor:
                     # — a backlog of catch-up scrapes would hammer the
                     # very endpoint the run is measuring
                     missed = int((now - next_tick) / self.cfg.interval_s) + 1
-                    self.skipped += missed
+                    with self._state_lock:
+                        self.skipped += missed
                     next_tick = now + self.cfg.interval_s
                 if self._stop.wait(timeout=max(next_tick - time.time(), 0.0)):
                     return
@@ -215,19 +233,29 @@ class RunMonitor:
             self._thread.join(timeout=join_timeout_s)
         return self.summary()
 
+    def timeline(self) -> list[dict[str, Any]]:
+        """Snapshot of the samples recorded so far — the safe way to hand
+        the timeline across threads (the raw ``samples`` list is live
+        while the sampler runs; iterating it races ``append``)."""
+        with self._state_lock:
+            return list(self.samples)
+
     def summary(self) -> dict[str, Any]:
         """The ``monitor`` block (core/schema.py validate_monitor)."""
-        out: dict[str, Any] = {
-            "interval_s": self.cfg.interval_s,
-            "window_s": self.cfg.window_s,
-            "samples": len(self.samples),
-            "skipped_samples": self.skipped,
-            "events": [e.to_dict() for e in self.events],
-            "burn_rates": {k: round(v, 4) for k, v in self.burn_latest.items()},
-            "burn_rates_peak": {
-                k: round(v, 4) for k, v in self.burn_peak.items()
-            },
-        }
+        with self._state_lock:
+            out: dict[str, Any] = {
+                "interval_s": self.cfg.interval_s,
+                "window_s": self.cfg.window_s,
+                "samples": len(self.samples),
+                "skipped_samples": self.skipped,
+                "events": [e.to_dict() for e in self.events],
+                "burn_rates": {
+                    k: round(v, 4) for k, v in self.burn_latest.items()
+                },
+                "burn_rates_peak": {
+                    k: round(v, 4) for k, v in self.burn_peak.items()
+                },
+            }
         if self.abort is not None and self.abort.is_set():
             out["aborted"] = self.abort.reason
         return out

@@ -1378,6 +1378,10 @@ class Engine:
                     ),
                 }))
                 return handle
+        # Registry lookup from the submitting thread; admin ops swap the
+        # whole dict reference atomically, so the worst case is a
+        # just-loaded adapter 404ing for one request.
+        # kvmini: thread-ok — atomic reference swap, benign stale read
         if req.adapter is not None and req.adapter not in self._lora_names:
             handle.events.put(("done", {
                 "finish_reason": "error",
@@ -1404,6 +1408,10 @@ class Engine:
             # in /traces (it just won't join a client-side trace)
             req.trace_id = rt_tracing.new_trace_id()
         self._pending.put(handle)
+        # Gauge write from the submitting thread while the scheduler owns
+        # every other stats key; dict setitem is GIL-atomic and the
+        # scheduler recomputes this key each iteration anyway.
+        # kvmini: thread-ok — GIL-atomic gauge write, scheduler refreshes
         self.stats["queue_depth"] = self._queue_depth()
         return handle
 
@@ -1413,6 +1421,10 @@ class Engine:
         neither _pending nor a slot — without it, reported depth is one
         low whenever paged backpressure is active."""
         n = self._pending.qsize()
+        # Racy read of the scheduler-owned deferred handle from the stats
+        # path; depth is a monitoring gauge and the `is not None` check is
+        # atomic under the GIL.
+        # kvmini: thread-ok — monitoring gauge, GIL-atomic None check
         if self.paged and self._deferred is not None:
             n += 1
         return n
@@ -2501,23 +2513,38 @@ class Engine:
 
                 traceback.print_exc()
                 self._fail_all(exc)
+                # start()/stop() write this flag from the control thread;
+                # the loop only ever clears it on crash, and every reader
+                # tolerates staleness.
+                # kvmini: thread-ok — GIL-atomic bool flag
                 self._running = False
 
     # -- introspection -----------------------------------------------------
 
     def snapshot_stats(self) -> dict[str, Any]:
+        # Deliberately lock-free monitoring snapshot (single-writer engine:
+        # only the scheduler thread mutates this state; list len/iteration
+        # and dict copy are GIL-atomic). A snapshot taken mid-sweep is at
+        # worst one sweep stale — adding a stats lock to the decode hot
+        # path to fix that is the wrong trade. Each read below carries its
+        # own thread-ok so a NEW cross-thread surface still gets flagged.
         s = dict(self.stats)
         wall = max(time.time() - s["started_at"], 1e-9)
         s["duty_cycle"] = min(s["busy_s"] / wall, 1.0)
+        # kvmini: thread-ok — benign racy snapshot (see above)
         s["active_slots"] = sum(1 for h in self._slot_req if h is not None)
+        # kvmini: thread-ok — benign racy snapshot (see above)
         s["free_slots"] = len(self._free)
         # live recompute: the cached value goes stale between scheduler
         # iterations, and the deferred head-of-line handle must count
         s["queue_depth"] = self._queue_depth()
+        # kvmini: thread-ok — benign racy snapshot (see above)
         s["inflight_sweeps"] = len(self._inflight)
         if self.paged:
             s["kv_pool_blocks"] = self._scratch_block
+            # kvmini: thread-ok — benign racy snapshot (see above)
             s["kv_free_blocks"] = len(self._free_blocks)
+            # kvmini: thread-ok — benign racy snapshot (see above)
             s["kv_retained_blocks"] = len(self._retained_lru)
             s["kv_block_size"] = self._blk
         s["spec_accept_ratio"] = (

@@ -141,6 +141,10 @@ class SpanRecorder:
         self.dropped = 0  # evicted span count (buffer wrapped)
 
     def __len__(self) -> int:
+        # Deliberately lock-free monitoring surface (class docstring):
+        # scheduler-thread appends are atomic under the GIL and snapshot()
+        # takes a C-level copy; a torn read costs at most one span.
+        # kvmini: thread-ok — lock-free by contract, torn read is benign
         return len(self._spans)
 
     def record(
@@ -190,6 +194,9 @@ class SpanRecorder:
                     ],
                 }
             ],
+            # Monotonic int bumped only by the recording thread; a stale
+            # read costs an off-by-one drop count in a monitoring doc.
+            # kvmini: thread-ok — single-writer counter, stale read benign
             "droppedSpans": self.dropped,
         }
 

@@ -1,0 +1,19 @@
+"""Seeded race: bare cross-thread counter increment (ISSUE KVM051)."""
+import threading
+
+
+class Stats:
+    def __init__(self):
+        self.count = 0
+        self._thread = None
+
+    def _loop(self):
+        while self.count < 100:
+            self.count += 1  # mutated on the worker thread, no lock
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def read(self):
+        return self.count  # read from the spawning thread, no lock

@@ -1,0 +1,46 @@
+"""Same shape, invariant respected: one lock guards every access, and a
+documented single-writer design carries the suppression."""
+import threading
+
+
+class Stats:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self._thread = None
+
+    def _loop(self):
+        while True:
+            with self._lock:
+                if self.count >= 100:
+                    return
+                self.count += 1
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def read(self):
+        with self._lock:
+            return self.count
+
+
+class Gauge:
+    """Single-writer telemetry gauge: the worker owns the value, readers
+    accept a stale int (GIL-atomic) — the annotated escape hatch."""
+
+    def __init__(self):
+        self.value = 0
+        self._thread = None
+
+    def _loop(self):
+        while True:
+            self.value += 1
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def read(self):
+        # kvmini: thread-ok — single-writer gauge, stale read is benign
+        return self.value

@@ -1,0 +1,20 @@
+"""Inconsistent guarding: read under the lock in one place, written bare
+on the worker thread — the lock protects nothing."""
+import threading
+
+
+class Window:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+
+    def _loop(self):
+        while True:
+            self._items.append(1)  # bare mutation on the worker thread
+
+    def start(self):
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def totals(self):
+        with self._lock:
+            return list(self._items)

@@ -1,0 +1,25 @@
+"""Same shape, consistent guarding — including helper-method indirection:
+`_push` is only ever called with the lock held, so its access inherits
+the guard."""
+import threading
+
+
+class Window:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+
+    def _push(self, v):
+        self._items.append(v)  # guarded: every caller holds _lock
+
+    def _loop(self):
+        while True:
+            with self._lock:
+                self._push(1)
+
+    def start(self):
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def totals(self):
+        with self._lock:
+            return list(self._items)

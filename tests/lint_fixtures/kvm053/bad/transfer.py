@@ -1,0 +1,26 @@
+"""Seeded deadlock: two methods take the same pair of locks in opposite
+order (ISSUE KVM053) — one thread in each and both block forever."""
+import threading
+
+
+class Transfer:
+    def __init__(self):
+        self._accounts = threading.Lock()
+        self._journal = threading.Lock()
+        self.balance = 0
+        self.entries = []
+
+    def debit(self):
+        with self._accounts:
+            with self._journal:
+                self.balance -= 1
+                self.entries.append("debit")
+
+    def audit(self):
+        with self._journal:
+            with self._accounts:
+                self.entries.append(self.balance)
+
+    def start(self):
+        threading.Thread(target=self.debit, daemon=True).start()
+        threading.Thread(target=self.audit, daemon=True).start()

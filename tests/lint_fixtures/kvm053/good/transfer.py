@@ -1,0 +1,28 @@
+"""Same shape, one global lock order (accounts before journal) — and the
+acquire-while-holding edge through a helper method stays acyclic."""
+import threading
+
+
+class Transfer:
+    def __init__(self):
+        self._accounts = threading.Lock()
+        self._journal = threading.Lock()
+        self.balance = 0
+        self.entries = []
+
+    def _log(self, entry):
+        with self._journal:
+            self.entries.append(entry)
+
+    def debit(self):
+        with self._accounts:
+            self.balance -= 1
+            self._log("debit")
+
+    def audit(self):
+        with self._accounts:
+            self._log(self.balance)
+
+    def start(self):
+        threading.Thread(target=self.debit, daemon=True).start()
+        threading.Thread(target=self.audit, daemon=True).start()

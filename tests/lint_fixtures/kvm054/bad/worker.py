@@ -1,0 +1,19 @@
+"""Seeded hang: unbounded Event.wait (ISSUE KVM054) plus an unbounded
+thread join in the stop path — a wedged worker freezes teardown."""
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._done = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        self._done.set()
+
+    def start(self):
+        self._thread.start()
+
+    def stop(self):
+        self._done.wait()  # no timeout: a dead worker blocks forever
+        self._thread.join()  # unbounded join in teardown

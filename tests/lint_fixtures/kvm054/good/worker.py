@@ -1,0 +1,31 @@
+"""Same shape, bounded: wait() with a timeout whose False return is
+handled, join() with a bound — plus the asyncio exemption (awaited
+waits are bounded via wait_for, and asyncio.Event.wait has no timeout
+parameter at all)."""
+import asyncio
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._done = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        self._done.set()
+
+    def start(self):
+        self._thread.start()
+
+    def stop(self) -> bool:
+        finished = self._done.wait(timeout=5.0)
+        self._thread.join(timeout=5.0)
+        return finished and not self._thread.is_alive()
+
+
+class AsyncGate:
+    def __init__(self):
+        self._gate = asyncio.Event()
+
+    async def wait_open(self):
+        await self._gate.wait()  # asyncio: bounded by wait_for at call sites

@@ -1,0 +1,23 @@
+"""Mutable-container publication: every access is locked, but the getter
+hands out the raw deque — the reference outlives the lock and iterating
+it races the worker's appends (the /traces bug class)."""
+import threading
+from collections import deque
+
+
+class Recorder:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._events = deque(maxlen=16)
+
+    def _loop(self):
+        while True:
+            with self._lock:
+                self._events.append(1)
+
+    def start(self):
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def events(self):
+        with self._lock:
+            return self._events  # raw live deque escapes the lock

@@ -1,0 +1,22 @@
+"""Same shape, snapshot semantics: the getter copies under the lock, so
+the caller iterates a private list no other thread can touch."""
+import threading
+from collections import deque
+
+
+class Recorder:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._events = deque(maxlen=16)
+
+    def _loop(self):
+        while True:
+            with self._lock:
+                self._events.append(1)
+
+    def start(self):
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def events(self):
+        with self._lock:
+            return list(self._events)

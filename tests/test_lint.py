@@ -5,8 +5,10 @@ runs in the harness-only lane. Each KVM0xx rule has a bad/ fixture that
 must produce EXACTLY the expected diagnostics and a good/ fixture (same
 shape, invariant respected or legitimately suppressed) that must lint
 clean — including the ISSUE's seeded mutations: an unpublished lockstep
-mutation (KVM021), a stats key missing from /metrics (KVM031), and
-time.time() inside a jitted fn (KVM013).
+mutation (KVM021), a stats key missing from /metrics (KVM031),
+time.time() inside a jitted fn (KVM013), and the KVM05x seeded races
+(bare cross-thread counter increment, lock-order cycle, unbounded
+Event.wait/join).
 
 The pin test runs the real linter over the real package against the
 committed lint-baseline.json: no new findings, no stale entries, no
@@ -65,6 +67,11 @@ CASES = [
     ("kvm032", {"KVM032": 3}),  # consumed-, documented-, and emitted-drift
     ("kvm033", {"KVM033": 1}),
     ("kvm041", {"KVM041": 2}),  # silent except-fallback + unflagged truncation
+    ("kvm051", {"KVM051": 1}),  # ISSUE seeded race: bare cross-thread counter
+    ("kvm052", {"KVM052": 1}),  # locked read here, bare write there
+    ("kvm053", {"KVM053": 1}),  # ISSUE seeded race: lock-order cycle
+    ("kvm054", {"KVM054": 2}),  # ISSUE seeded race: unbounded wait + join
+    ("kvm055", {"KVM055": 1}),  # raw live deque handed across the boundary
 ]
 
 
@@ -162,6 +169,93 @@ def test_single_file_scan_skips_cross_surface_drift():
     assert [d.render() for d in result.diagnostics if d.code == "KVM032"] == []
 
 
+def test_family_filter_selects_checkers(capsys):
+    bad13 = str(FIXTURES / "kvm013" / "bad")
+    # the KVM01 findings vanish under a KVM05-only scan...
+    assert lint_main([bad13, "--no-baseline", "--family", "KVM05"]) == 0
+    capsys.readouterr()
+    # ...and are still there when their own family is selected
+    assert lint_main([bad13, "--no-baseline", "--family", "KVM01"]) == 1
+
+
+def test_family_filter_spares_foreign_suppressions():
+    # kvm001/good holds a USED `static-shape` suppression (a KVM01 token);
+    # a KVM05-only run never fires KVM011, but must not call it stale
+    good = str(FIXTURES / "kvm001" / "good")
+    assert lint_main([good, "--no-baseline", "--family", "KVM05"]) == 0
+
+
+def test_family_filter_full_code_and_validation(capsys):
+    bad51 = str(FIXTURES / "kvm051" / "bad")
+    assert lint_main([bad51, "--no-baseline", "--family", "KVM051"]) == 1
+    capsys.readouterr()
+    assert lint_main([bad51, "--no-baseline", "--family", "KVM09"]) == 2
+    # a family-sliced baseline would silently drop every other family
+    assert lint_main([bad51, "--family", "KVM05", "--write-baseline"]) == 2
+
+
+def test_family_filter_rejects_unselectable_kvm001(capsys):
+    # KVM001 rides along with whatever rules run; selecting it alone
+    # would run zero checkers and report a green no-op — usage error
+    bad51 = str(FIXTURES / "kvm051" / "bad")
+    assert lint_main([bad51, "--no-baseline", "--family", "KVM001"]) == 2
+
+
+def test_lockish_name_is_word_bounded(tmp_path):
+    # `self._block` (a KV pool, not a lock) must NOT count as a guard:
+    # wrapping accesses in a non-lock context manager neither invents a
+    # KVM052 nor masks the real unguarded cross-thread mutation
+    (tmp_path / "pool.py").write_text(
+        "import threading\n\n\n"
+        "class Pool:\n"
+        "    def __init__(self):\n"
+        "        self._block = object()\n"
+        "        self.used = 0\n\n"
+        "    def _loop(self):\n"
+        "        while True:\n"
+        "            with self._block:\n"
+        "                self.used += 1\n\n"
+        "    def start(self):\n"
+        "        threading.Thread(target=self._loop, daemon=True).start()\n\n"
+        "    def read(self):\n"
+        "        with self._block:\n"
+        "            return self.used\n"
+    )
+    result = run_lint([tmp_path], root=REPO)
+    assert [d.code for d in result.diagnostics] == ["KVM051"]
+
+
+def test_family_filter_full_code_drops_sibling_findings(capsys):
+    # `--family KVM051` runs the whole KVM05 checker (family granularity)
+    # but must report ONLY KVM051 — a sibling KVM053 in the scanned tree
+    # stays out of the output, as the help text promises
+    bad51 = str(FIXTURES / "kvm051" / "bad")
+    bad53 = str(FIXTURES / "kvm053" / "bad")
+    rc = lint_main([bad51, bad53, "--no-baseline", "--family", "KVM051",
+                    "--format", "json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert {f["code"] for f in doc["findings"]} == {"KVM051"}
+
+
+def test_timing_report(tmp_path, capsys):
+    bad51 = str(FIXTURES / "kvm051" / "bad")
+    rc = lint_main([bad51, "--no-baseline", "--format", "json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert {"facts", "concurrency"} <= set(doc["timings"])
+    rc = lint_main([bad51, "--no-baseline", "--timing"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "kvmini-lint timing: " in out and "concurrency" in out
+    # --timing-out: the CI artifact comes from the SAME gating run
+    report = tmp_path / "lint-timing.json"
+    assert lint_main([bad51, "--no-baseline",
+                      "--timing-out", str(report)]) == 1
+    doc = json.loads(report.read_text())
+    assert "concurrency" in doc["timings"] and doc["findings"] == 1
+
+
 def test_write_baseline_refuses_parse_errors(tmp_path, capsys):
     (tmp_path / "broken.py").write_text("def f(:\n")
     bl = tmp_path / "bl.json"
@@ -195,4 +289,8 @@ def test_live_codebase_matches_baseline_exactly():
     assert not [d for d in result.diagnostics if d.code == "KVM001"], (
         "stale `# kvmini:` suppressions in the live tree"
     )
+    # every family ran (incl. KVM05x concurrency) and reported its wall
+    # time — the `--timing` surface CI uploads to attribute speed drift
+    assert {"facts", "jit_purity", "lockstep", "workload", "concurrency",
+            "metrics_drift"} <= set(result.timings)
     assert elapsed < 10.0, f"kvmini-lint took {elapsed:.1f}s (budget 10s)"
