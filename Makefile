@@ -2,7 +2,7 @@
 # of native code — the TPU compute path is JAX/XLA compiled at runtime.
 PY ?= python
 
-.PHONY: help test test-fast test-policy lint lint-invariants fmt smoke bench bench-smoke dashboards-validate helm-lint airgap clean
+.PHONY: help test test-fast test-policy lint lint-invariants fmt smoke bench bench-smoke bench-proxy-smoke trajectory dashboards-validate helm-lint airgap clean
 
 help:
 	@grep -E '^[a-z-]+:' Makefile | sed 's/:.*//' | sort | uniq
@@ -57,6 +57,20 @@ bench:  ## driver benchmark (one JSON line) on the attached accelerator
 # MONITOR_JSON_SCHEMA incl. the scripted-stall event (docs/MONITORING.md).
 bench-smoke:  ## bench pipeline vs the mock server, tiny budget, no TPU
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_bench_smoke.py tests/test_monitor.py -q
+
+# the never-dark acceptance gate (docs/PROFILING.md): with no TPU,
+# `python bench.py` must exit 0 with a schema-valid `proxy` block
+# (validate_proxy), a config over mocked HBM headroom must DOWNSHIFT
+# (labeled) instead of RESOURCE_EXHAUSTing, and the trajectory must
+# render the round into its report section. Runs the real bench.py
+# children end-to-end on the forced 8-device host platform.
+bench-proxy-smoke:  ## full CPU-mesh proxy tier end-to-end, no TPU
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	  $(PY) -m pytest tests/test_bench_proxy.py tests/test_profiling.py \
+	  tests/test_trajectory.py -q
+
+trajectory:  ## perf trend table over the committed BENCH_*.json rounds
+	$(PY) -m kserve_vllm_mini_tpu trajectory --glob 'BENCH_*.json'
 
 dashboards-validate:  ## dashboard JSON structure + panel/query checks
 	$(PY) -m pytest tests/test_assets.py -q -k "dashboard"

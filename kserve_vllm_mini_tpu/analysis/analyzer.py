@@ -127,6 +127,10 @@ def analyze_run(
     update.update(
         telemetry.pipeline_counters(endpoint, runtime_metrics=runtime_metrics)
     )
+    # compile-stats block (docs/PROFILING.md): same in-repo-only rule
+    update.update(
+        telemetry.compile_stats_block(endpoint, runtime_metrics=runtime_metrics)
+    )
 
     # server-side request traces (docs/TRACING.md): fetch /traces, merge
     # the server leg into runs/<id>/traces/traces.json joined by trace_id,

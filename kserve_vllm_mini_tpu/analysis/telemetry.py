@@ -321,6 +321,43 @@ def pipeline_counters(
     }
 
 
+# results.json `compile_stats` sub-key -> runtime metric (docs/
+# PROFILING.md). Keyed by SUB-KEY (the inverse of PIPELINE_METRIC_KEYS'
+# orientation) because the whole map lands under the one typed
+# `compile_stats` results field rather than as flat schema fields.
+COMPILE_METRIC_KEYS = {
+    "compiles": "kvmini_tpu_compiles_total",
+    "compile_wall_s": "kvmini_tpu_compile_seconds_total",
+    "flops": "kvmini_tpu_compiled_flops_total",
+    "bytes_accessed": "kvmini_tpu_compiled_bytes_total",
+    "peak_bytes": "kvmini_tpu_compile_peak_bytes",
+}
+
+
+def compile_stats_block(
+    endpoint: Optional[str],
+    runtime_metrics: Optional[dict[str, float]] = None,
+) -> dict[str, Any]:
+    """Compile-stats counters from the runtime's /metrics, nested under
+    the `compile_stats` results key (core/schema.py). Same degradation
+    rule as pipeline_counters: an endpoint that doesn't export them (any
+    external engine) yields NO block, never fabricated zeros. A runtime
+    that exported them but compiled nothing (0 compiles) also yields no
+    block — an all-zero compile report carries no information."""
+    if not endpoint:
+        return {}
+    m = (runtime_metrics if runtime_metrics is not None
+         else scrape_runtime_metrics(endpoint))
+    block = {
+        out_key: m[metric]
+        for out_key, metric in COMPILE_METRIC_KEYS.items()
+        if metric in m
+    }
+    if not block or not block.get("compiles"):
+        return {}
+    return {"compile_stats": block}
+
+
 def cache_hit_ratio(
     prom_url: Optional[str],
     endpoint: Optional[str],

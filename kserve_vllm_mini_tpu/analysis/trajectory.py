@@ -1,0 +1,270 @@
+"""Perf trajectory: every BENCH round in one trend table, never dark.
+
+The driver benches land one artifact per round (``BENCH_r01.json`` ...):
+a wrapper ``{n, cmd, rc, tail, parsed}`` whose ``parsed`` is bench.py's
+one JSON line (or null when the round crashed — the pre-proxy era). This
+module ingests all of them into a trajectory:
+
+- **real** rounds (device throughput measured) and **proxy** rounds (the
+  CPU-mesh fallback tier's compile/cost-model metrics, docs/PROFILING.md)
+  are kept as SEPARATE series — a proxy FLOPs number must never be
+  plotted against a device tokens/s number;
+- **dark** rounds (no payload at all) stay visible as gaps, because a
+  trajectory that hides its holes overstates its coverage;
+- every round carries a **regression delta vs the anchor** — the last
+  round of its own series that produced the metric — so a speed PR reads
+  its effect straight off the table.
+
+CLI: ``kvmini-tpu trajectory [--glob 'BENCH_*.json'] [--html out.html]
+[--json out.json]`` — the HTML is report/html.py's "Perf trajectory"
+section (chart + table), the same rendering the run report embeds.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob as glob_mod
+import json
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Optional
+
+_ROUND_NUM = re.compile(r"r?(\d+)$")
+
+# proxy metrics tracked round-over-round, with direction of "worse"
+# (+1 = an increase is a regression, -1 = a decrease is)
+PROXY_TREND_KEYS = {
+    "compile_wall_s": 1,
+    "step_count_ratio": 1,
+    "flops": 1,
+    "bytes_accessed": 1,
+    "peak_bytes": 1,
+}
+
+
+@dataclass
+class Round:
+    """One BENCH artifact, classified into a trajectory series."""
+
+    name: str                      # "r01" / file stem
+    index: int                     # ordering key (round number when parseable)
+    status: str                    # ok | tpu_unavailable | oom | error | dark
+    series: str                    # "real" | "proxy" | "dark"
+    tokens_per_sec_per_chip: Optional[float] = None
+    vs_baseline: Optional[float] = None
+    label: Optional[str] = None    # bench config label from the metric name
+    downshifted: Optional[str] = None
+    proxy: dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> dict[str, Any]:
+        out: dict[str, Any] = {
+            "name": self.name, "index": self.index, "status": self.status,
+            "series": self.series,
+        }
+        for key in ("tokens_per_sec_per_chip", "vs_baseline", "label",
+                    "downshifted"):
+            v = getattr(self, key)
+            if v is not None:
+                out[key] = v
+        if self.proxy:
+            out["proxy"] = self.proxy
+        return out
+
+
+def _round_name(path: Path) -> tuple[str, int]:
+    stem = path.stem
+    name = stem[6:] if stem.startswith("BENCH_") else stem
+    m = _ROUND_NUM.search(name)
+    return name, int(m.group(1)) if m else 0
+
+
+def _classify_dark(wrapper: dict[str, Any]) -> str:
+    tail = str(wrapper.get("tail", ""))
+    if "RESOURCE_EXHAUSTED" in tail:
+        return "oom"
+    if "UNAVAILABLE" in tail or "Unable to initialize backend" in tail:
+        return "tpu_unavailable"
+    return "error"
+
+
+def load_round(path: Path) -> Round:
+    """Parse one BENCH artifact — the driver wrapper or a bare bench.py
+    line — into a Round. Unreadable files become dark rounds (the
+    trajectory must survive a corrupt artifact)."""
+    name, index = _round_name(path)
+    try:
+        doc = json.loads(path.read_text())
+    except (OSError, ValueError):
+        return Round(name=name, index=index, status="error", series="dark")
+    parsed = doc.get("parsed", doc) if isinstance(doc, dict) else None
+    if not isinstance(parsed, dict) or "metric" not in parsed:
+        return Round(name=name, index=index,
+                     status=_classify_dark(doc if isinstance(doc, dict) else {}),
+                     series="dark")
+    detail = parsed.get("detail") or {}
+    status = str(parsed.get("status", "ok"))
+    value = parsed.get("value")
+    tok_s = float(value) if isinstance(value, (int, float)) and value > 0 \
+        else None
+    proxy = detail.get("proxy") or {}
+    if proxy.get("status") == "ok" or proxy.get("series") == "proxy":
+        proxy = {k: proxy[k] for k in PROXY_TREND_KEYS if k in proxy}
+    else:
+        proxy = {}
+    if tok_s is not None:
+        series = "real"
+    elif proxy:
+        series = "proxy"
+    else:
+        series = "dark"
+    label = None
+    metric = str(parsed.get("metric", ""))
+    if "(" in metric:
+        label = metric.split("(", 1)[1].split(")", 1)[0]
+    return Round(
+        name=name, index=index, status=status, series=series,
+        tokens_per_sec_per_chip=tok_s,
+        vs_baseline=parsed.get("vs_baseline"),
+        label=label,
+        downshifted=detail.get("downshifted"),
+        proxy=proxy,
+    )
+
+
+def load_rounds(paths: list[Path]) -> list[Round]:
+    return sorted((load_round(Path(p)) for p in paths),
+                  key=lambda r: (r.index, r.name))
+
+
+def _delta_pct(value: float, anchor: float) -> Optional[float]:
+    if not anchor:
+        return None
+    return round((value - anchor) / anchor * 100.0, 2)
+
+
+def build_trajectory(rounds: list[Round]) -> dict[str, Any]:
+    """The trend document: per-round rows with same-series regression
+    deltas, the last-real anchor, and coverage accounting."""
+    rows: list[dict[str, Any]] = []
+    last_real: Optional[Round] = None
+    last_proxy: dict[str, float] = {}
+    regressions: list[dict[str, Any]] = []
+    for r in rounds:
+        row = r.to_dict()
+        if r.series == "real" and r.tokens_per_sec_per_chip:
+            if last_real is not None and last_real.tokens_per_sec_per_chip:
+                d = _delta_pct(r.tokens_per_sec_per_chip,
+                               last_real.tokens_per_sec_per_chip)
+                row["delta_vs_last_real_pct"] = d
+                if d is not None and d < 0:
+                    regressions.append({
+                        "round": r.name, "metric": "tokens_per_sec_per_chip",
+                        "value": r.tokens_per_sec_per_chip,
+                        "anchor": last_real.tokens_per_sec_per_chip,
+                        "anchor_round": last_real.name,
+                        "delta_pct": d,
+                    })
+            last_real = r
+        # any round CARRYING proxy data advances the proxy trend — a
+        # healthy round run with KVMINI_BENCH_PROXY=always tracks
+        # compile-time drift exactly like a dark round's fallback does
+        if r.proxy:
+            deltas = {}
+            for key, worse_dir in PROXY_TREND_KEYS.items():
+                v = r.proxy.get(key)
+                a = last_proxy.get(key)
+                if isinstance(v, (int, float)) and a:
+                    d = _delta_pct(float(v), a)
+                    if d is not None:
+                        deltas[key] = d
+                        if d * worse_dir > 10.0:  # >10% in the bad direction
+                            regressions.append({
+                                "round": r.name, "metric": f"proxy:{key}",
+                                "value": v, "anchor": a, "delta_pct": d,
+                            })
+            if deltas:
+                row["proxy_delta_pct"] = deltas
+            for key in PROXY_TREND_KEYS:
+                if isinstance(r.proxy.get(key), (int, float)):
+                    last_proxy[key] = float(r.proxy[key])
+        rows.append(row)
+    n_real = sum(1 for r in rounds if r.series == "real")
+    n_proxy = sum(1 for r in rounds if r.series == "proxy")
+    return {
+        "rounds": rows,
+        "last_real": last_real.to_dict() if last_real else None,
+        "regressions": regressions,
+        "coverage": {
+            "total": len(rounds),
+            "real": n_real,
+            "proxy": n_proxy,
+            "dark": len(rounds) - n_real - n_proxy,
+        },
+    }
+
+
+def render_table(traj: dict[str, Any]) -> str:
+    """Plain-text trend table (the CLI's stdout; markdown-compatible)."""
+    cov = traj["coverage"]
+    lines = [
+        f"Perf trajectory — {cov['total']} rounds: {cov['real']} real, "
+        f"{cov['proxy']} proxy, {cov['dark']} dark",
+        "",
+        "| round | series | status | tok/s/chip | Δ vs last real |"
+        " compile s | step ratio | note |",
+        "|---|---|---|---|---|---|---|---|",
+    ]
+    for row in traj["rounds"]:
+        tok = row.get("tokens_per_sec_per_chip")
+        delta = row.get("delta_vs_last_real_pct")
+        px = row.get("proxy", {})
+        note = row.get("downshifted") or ""
+        lines.append(
+            f"| {row['name']} | {row['series']} | {row['status']} "
+            f"| {tok if tok is not None else '—'} "
+            f"| {f'{delta:+.1f}%' if delta is not None else '—'} "
+            f"| {px.get('compile_wall_s', '—')} "
+            f"| {px.get('step_count_ratio', '—')} | {note} |"
+        )
+    if traj["regressions"]:
+        lines.append("")
+        lines.append("Regressions (vs same-series anchor):")
+        for reg in traj["regressions"]:
+            lines.append(
+                f"  {reg['round']}: {reg['metric']} {reg['value']} "
+                f"vs {reg['anchor']} ({reg['delta_pct']:+.1f}%)"
+            )
+    return "\n".join(lines)
+
+
+# -- CLI ---------------------------------------------------------------------
+
+def register(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument("--glob", default="BENCH_*.json",
+                        help="BENCH artifact glob (driver wrapper or bare "
+                             "bench.py line)")
+    parser.add_argument("--files", nargs="*", default=None,
+                        help="Explicit artifact paths (overrides --glob)")
+    parser.add_argument("--json", default=None,
+                        help="Write the trajectory document here")
+    parser.add_argument("--html", default=None,
+                        help="Write the 'Perf trajectory' HTML page here")
+
+
+def run(args: argparse.Namespace) -> int:
+    paths = [Path(p) for p in (args.files or sorted(glob_mod.glob(args.glob)))]
+    if not paths:
+        print(f"trajectory: no artifacts matched {args.glob!r}")
+        return 1
+    traj = build_trajectory(load_rounds(paths))
+    print(render_table(traj))
+    if args.json:
+        Path(args.json).write_text(json.dumps(traj, indent=2))
+        print(f"trajectory: wrote {args.json}")
+    if args.html:
+        from kserve_vllm_mini_tpu.report.html import generate_trajectory_html
+
+        Path(args.html).write_text(generate_trajectory_html(traj))
+        print(f"trajectory: wrote {args.html}")
+    return 0

@@ -329,6 +329,12 @@ def _run_stages(
             "pipeline_host_overlap_s": round(es["host_overlap_s"], 6),
             "pipeline_bubble_s": round(es["bubble_s"], 6),
         })
+        # compile-stats block (docs/PROFILING.md): the direct snapshot is
+        # authoritative (per-executable entries included) and replaces
+        # whatever the /metrics scrape merged above
+        cs = server.engine.compile_stats_snapshot()
+        if cs.get("compiles"):
+            run_dir.merge_into_results({"compile_stats": cs})
     results = run_dir.read_results()
 
     code = 0

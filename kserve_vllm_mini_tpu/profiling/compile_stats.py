@@ -1,0 +1,272 @@
+"""Compile-stats capture: wrap ``lower().compile()`` and keep the numbers.
+
+XLA already computes an analytic cost model (FLOPs, bytes accessed) and a
+buffer-assignment memory estimate for every executable it builds; the repo
+used to throw both away. This module makes them first-class run metrics:
+
+- ``capture_compile_stats(jfn, *args)`` — explicit AOT compile of a jitted
+  callable, timed, with ``cost_analysis()`` / ``memory_analysis()`` / HLO
+  op histogram extracted into a ``CompileStats`` record. The compiled
+  executable is returned so callers run exactly what was measured.
+- ``InstrumentedJit`` — a drop-in wrapper around a jitted callable: the
+  first call per abstract signature compiles explicitly (stats land in a
+  ``CompileRecorder``), later calls hit the cached executable. Any failure
+  in the AOT path falls back to the plain jit call — instrumentation must
+  never cost correctness.
+- ``CompileRecorder`` — thread-safe accumulator the engine exports through
+  ``snapshot_stats`` / ``/metrics`` (docs/API.md metrics table).
+
+These numbers are the proxy tier's backbone (docs/PROFILING.md): on a
+CPU mesh the cost model is the same analytic function of the program as
+on TPU, so FLOPs/bytes stay comparable across rounds even when no device
+time exists.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+# `%dot.3 = f32[64,64]{1,0} dot(...)` / `ROOT %t = (f32[2]{0}) tuple(...)`:
+# the opcode is the first lowercase identifier directly followed by "(" on
+# the right-hand side of the assignment (types carry brackets, not parens).
+_HLO_OPCODE = re.compile(r"([a-z][a-z0-9_\-]*)\(")
+_TOP_OPS = 16  # histogram cap: top-N opcodes, remainder folded into "other"
+
+
+@dataclass
+class CompileStats:
+    """One executable's compile-time facts (all analytic — no device time)."""
+
+    label: str
+    compile_wall_s: float
+    flops: float                  # cost-model FLOPs per invocation
+    bytes_accessed: float         # cost-model HBM traffic per invocation
+    peak_bytes: int               # buffer-assignment peak estimate (args+temp+out+code)
+    argument_bytes: int
+    output_bytes: int
+    temp_bytes: int
+    generated_code_bytes: int
+    hlo_ops: dict[str, int] = field(default_factory=dict)
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "label": self.label,
+            "compile_wall_s": round(self.compile_wall_s, 4),
+            "flops": self.flops,
+            "bytes_accessed": self.bytes_accessed,
+            "peak_bytes": self.peak_bytes,
+            "argument_bytes": self.argument_bytes,
+            "output_bytes": self.output_bytes,
+            "temp_bytes": self.temp_bytes,
+            "generated_code_bytes": self.generated_code_bytes,
+            "hlo_ops": dict(self.hlo_ops),
+        }
+
+
+def hlo_op_histogram(hlo_text: str, top: int = _TOP_OPS) -> dict[str, int]:
+    """Opcode -> instruction count over an HLO module's ``as_text()`` dump.
+
+    Keeps the ``top`` most frequent opcodes and folds the tail into
+    ``other`` so the histogram stays artifact-sized for big modules."""
+    counts: dict[str, int] = {}
+    for line in hlo_text.splitlines():
+        eq = line.find(" = ")
+        if eq < 0:
+            continue
+        m = _HLO_OPCODE.search(line, eq + 3)
+        if m:
+            counts[m.group(1)] = counts.get(m.group(1), 0) + 1
+    if len(counts) <= top:
+        return counts
+    ranked = sorted(counts.items(), key=lambda kv: (-kv[1], kv[0]))
+    out = dict(ranked[:top])
+    out["other"] = sum(c for _, c in ranked[top:])
+    return out
+
+
+def extract_compile_stats(
+    compiled: Any, compile_wall_s: float, label: str = ""
+) -> CompileStats:
+    """Pull cost/memory/HLO facts out of a ``jax.stages.Compiled``.
+
+    Every extraction is individually best-effort: a backend that lacks one
+    analysis (e.g. no cost model on an exotic plugin) yields zeros there,
+    never an exception — these stats decorate a run, they must not kill it.
+    """
+    flops = bytes_accessed = 0.0
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        flops = float(ca.get("flops", 0.0) or 0.0)
+        bytes_accessed = float(ca.get("bytes accessed", 0.0) or 0.0)
+    except Exception:  # noqa: BLE001 — analysis availability is backend-specific
+        pass
+    arg = out = temp = code = alias = 0
+    try:
+        ma = compiled.memory_analysis()
+        arg = int(getattr(ma, "argument_size_in_bytes", 0) or 0)
+        out = int(getattr(ma, "output_size_in_bytes", 0) or 0)
+        temp = int(getattr(ma, "temp_size_in_bytes", 0) or 0)
+        code = int(getattr(ma, "generated_code_size_in_bytes", 0) or 0)
+        alias = int(getattr(ma, "alias_size_in_bytes", 0) or 0)
+    except Exception:  # noqa: BLE001 — same contract as above
+        pass
+    ops: dict[str, int] = {}
+    try:
+        ops = hlo_op_histogram(compiled.as_text())
+    except Exception:  # noqa: BLE001 — same contract as above
+        pass
+    return CompileStats(
+        label=label,
+        compile_wall_s=compile_wall_s,
+        flops=flops,
+        bytes_accessed=bytes_accessed,
+        # aliased (donated) buffers are counted inside argument bytes but
+        # reuse their input allocation — subtract so the peak isn't double
+        peak_bytes=max(arg + out + temp + code - alias, 0),
+        argument_bytes=arg,
+        output_bytes=out,
+        temp_bytes=temp,
+        generated_code_bytes=code,
+        hlo_ops=ops,
+    )
+
+
+def capture_compile_stats(
+    jfn: Any, *args: Any, label: str = "", **kwargs: Any
+) -> tuple[Any, CompileStats]:
+    """Explicitly ``lower().compile()`` a jitted callable and keep the
+    stats. Arguments may be concrete arrays or ``jax.ShapeDtypeStruct``
+    trees (abstract lowering compiles the real program without ever
+    materializing the weights — the proxy tier's cost-model path).
+
+    Returns ``(compiled_executable, stats)``; the executable accepts the
+    same (concrete) calling convention as the jitted function, donation
+    included."""
+    t0 = time.perf_counter()
+    compiled = jfn.lower(*args, **kwargs).compile()
+    wall = time.perf_counter() - t0
+    return compiled, extract_compile_stats(compiled, wall, label=label)
+
+
+class CompileRecorder:
+    """Thread-safe compile-stats accumulator.
+
+    The engine's scheduler thread records; the server's request threads
+    read ``snapshot()`` — every access is under the one lock (KVM05x
+    discipline), and ``snapshot``/``entries`` return copies."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._entries: list[CompileStats] = []
+        self._total_s = 0.0
+        self._total_flops = 0.0
+        self._total_bytes = 0.0
+        self._peak_bytes = 0
+
+    def record(self, stats: CompileStats) -> None:
+        with self._lock:
+            self._entries.append(stats)
+            self._total_s += stats.compile_wall_s
+            self._total_flops += stats.flops
+            self._total_bytes += stats.bytes_accessed
+            self._peak_bytes = max(self._peak_bytes, stats.peak_bytes)
+
+    def snapshot(self) -> dict[str, Any]:
+        """Flat totals for ``snapshot_stats`` / ``/metrics``."""
+        with self._lock:
+            return {
+                "compiles": len(self._entries),
+                "compile_s": self._total_s,
+                "compiled_flops": self._total_flops,
+                "compiled_bytes": self._total_bytes,
+                "compile_peak_bytes": self._peak_bytes,
+            }
+
+    def entries(self) -> list[CompileStats]:
+        with self._lock:
+            return list(self._entries)
+
+
+def _signature(args: tuple, kwargs: dict) -> tuple:
+    """Abstract signature of a call: tree structure + per-leaf aval.
+
+    Matches jit's own cache key closely enough that one signature maps to
+    one executable (shape, dtype, weak_type per leaf — a Python scalar and
+    a committed array hash differently, exactly like jit retraces)."""
+    import jax
+    from jax.api_util import shaped_abstractify
+
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+    sig = []
+    for leaf in leaves:
+        aval = shaped_abstractify(leaf)
+        sig.append((aval.shape, str(aval.dtype), bool(getattr(aval, "weak_type", False))))
+    return (treedef, tuple(sig))
+
+
+class InstrumentedJit:
+    """AOT-compiling wrapper around a jitted callable.
+
+    The first call per abstract signature runs ``lower().compile()``
+    explicitly (timed, stats into the recorder) and caches the executable;
+    later calls dispatch straight to it — one compile total, same donation
+    semantics as the wrapped jit. Any failure anywhere in the AOT path
+    permanently falls back to the plain jit call for that signature, so
+    instrumentation can degrade but never break serving."""
+
+    def __init__(self, fn: Callable, recorder: CompileRecorder,
+                 label: str = "") -> None:
+        self._fn = fn
+        self._recorder = recorder
+        self._label = label or getattr(fn, "__name__", "jit")
+        self._exes: dict[tuple, Callable] = {}
+        # fast path: an engine step is compiled for exactly ONE signature
+        # in almost every run, so once a single executable exists we
+        # dispatch to it directly instead of re-deriving the abstract key
+        # (a ~300-leaf params flatten per decode dispatch would be real
+        # host overhead on the pipelined hot path). A structure/shape
+        # mismatch raises during the executable's argument VALIDATION —
+        # before any buffer is donated — and drops us back to the keyed
+        # path permanently.
+        self._sole_exe: Any = None
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        if self._sole_exe is not None:
+            try:
+                return self._sole_exe(*args, **kwargs)
+            except (TypeError, ValueError):
+                self._sole_exe = None
+        try:
+            key = _signature(args, kwargs)
+        except Exception:  # noqa: BLE001 — unhashable/exotic leaf: plain path
+            return self._fn(*args, **kwargs)
+        exe = self._exes.get(key)
+        if exe is None:
+            try:
+                compiled, stats = capture_compile_stats(
+                    self._fn, *args, label=self._label, **kwargs
+                )
+                self._recorder.record(stats)
+                exe = compiled
+            except Exception:  # noqa: BLE001 — AOT unsupported here: plain path
+                exe = self._fn
+            self._exes[key] = exe
+            if len(self._exes) == 1 and exe is not self._fn:
+                self._sole_exe = exe
+        return exe(*args, **kwargs)
+
+
+def abstractify(tree: Any) -> Any:
+    """Map a pytree of arrays to ``ShapeDtypeStruct`` leaves for abstract
+    lowering (compile the flagship program without 16 GB of weights)."""
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), tree
+    )

@@ -1,0 +1,198 @@
+"""Admission/headroom guard: downshift, never RESOURCE_EXHAUST.
+
+BENCH_r02 died mid-run with ``RESOURCE_EXHAUSTED`` — the 80-slot headline
+config plus its KV cache didn't fit the v5e's HBM and the whole round
+produced zero signal. The guard pre-flights a serving config's memory
+footprint against device capacity BEFORE any weights are materialized and,
+when it wouldn't fit, *downshifts* (halve slots, then halve context) and
+labels the measurement ``downshifted:`` — a smaller real number beats a
+crashed round every time (docs/PROFILING.md).
+
+The estimate is analytic (weights + KV + logits workspace + a fusion
+margin), so it is deterministic, testable with mocked capacities, and
+costs nothing; when a compiled executable exists its ``memory_analysis``
+peak can be passed in to replace the workspace term with XLA's own
+buffer-assignment number.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+# Public per-chip HBM capacities by device-kind substring. Matched in
+# order: a "v6 lite" (Trillium) kind must hit v6e before the bare "v5"/
+# "lite" checks (same pitfall bench.py's economics leg documents).
+HBM_BYTES_BY_KIND: tuple[tuple[str, int], ...] = (
+    ("v6e", 32_000_000_000),
+    ("v6", 32_000_000_000),
+    ("v5e", 16_000_000_000),
+    ("lite", 16_000_000_000),
+    ("v5", 95_000_000_000),   # v5p
+    ("v4", 32_000_000_000),
+)
+
+# fraction of HBM the plan may fill: XLA needs slack for fusion scratch,
+# infeed buffers, and the donation double-buffer window
+DEFAULT_HEADROOM_FRACTION = 0.9
+
+
+def device_hbm_bytes(device: Any = None) -> Optional[int]:
+    """Per-chip HBM capacity: runtime ``memory_stats`` when the backend
+    reports it, the public spec table by device kind otherwise, ``None``
+    on CPU/unknown (no capacity -> the guard disables itself)."""
+    if device is None:
+        try:
+            import jax
+
+            device = jax.devices()[0]
+        except Exception:  # noqa: BLE001 — no backend at all
+            return None
+    try:
+        stats = device.memory_stats() or {}
+        limit = stats.get("bytes_limit")
+        if limit:
+            return int(limit)
+    except Exception:  # noqa: BLE001 — CPU devices raise/return nothing
+        pass
+    kind = str(getattr(device, "device_kind", "")).lower()
+    if "tpu" not in kind and "cpu" in kind:
+        return None
+    for sub, cap in HBM_BYTES_BY_KIND:
+        if sub in kind:
+            return cap
+    return None
+
+
+def _weight_bytes_per_param(quant: str) -> float:
+    # int8: 1 byte + per-channel f32 scales (~1/256 of elements, rounded
+    # up generously); int4: packed nibbles + scales; else dtype width
+    if quant == "int8":
+        return 1.02
+    if quant == "int4":
+        return 0.52
+    if quant in ("bf16", "fp16", "float16", "bfloat16", ""):
+        return 2.0
+    return 4.0
+
+
+def estimate_serving_bytes(
+    cfg: Any,
+    slots: int,
+    max_seq: int,
+    quant: str = "bf16",
+    kv_quant: bool = False,
+) -> dict[str, int]:
+    """Analytic HBM footprint of the bench serving shape: weights + dense
+    KV + the f32 logits/workspace the prefill and sampling steps need.
+    ``cfg`` is a ``models.config.ModelConfig`` (only dims are read)."""
+    weights = int(cfg.param_count * _weight_bytes_per_param(quant))
+    kv_elem = (1 + 4.0 / cfg.head_dim) if kv_quant else cfg.jnp_dtype.itemsize
+    kv = int(2 * cfg.n_layers * slots * cfg.n_kv_heads * max_seq
+             * cfg.head_dim * kv_elem)
+    # f32 last-position logits for the batch + one full-bucket activation
+    # set; the 1.15 margin covers fusion scratch XLA actually allocates
+    workspace = int(slots * cfg.vocab_size * 4 + slots * max_seq * cfg.d_model * 2)
+    total = int((weights + kv + workspace) * 1.15)
+    return {"weight_bytes": weights, "kv_bytes": kv,
+            "workspace_bytes": workspace, "total_bytes": total}
+
+
+@dataclass
+class HeadroomPlan:
+    """The guard's decision for one config."""
+
+    fits: bool                 # True even after downshifting succeeded
+    slots: int                 # admitted slots (may be < requested)
+    max_seq: int               # admitted context (may be < requested)
+    estimate_bytes: int        # footprint of the ADMITTED shape
+    capacity_bytes: int
+    budget_bytes: int          # capacity * headroom fraction
+    downshifted: Optional[str] = None   # "downshifted: ..." label, or None
+
+    def to_dict(self) -> dict[str, Any]:
+        out: dict[str, Any] = {
+            "fits": self.fits,
+            "slots": self.slots,
+            "max_seq": self.max_seq,
+            "estimate_bytes": self.estimate_bytes,
+            "capacity_bytes": self.capacity_bytes,
+            "budget_bytes": self.budget_bytes,
+        }
+        if self.downshifted:
+            out["downshifted"] = self.downshifted
+        return out
+
+
+def plan_admission(
+    estimate_fn: Callable[[int, int], int],
+    capacity_bytes: int,
+    slots: int,
+    max_seq: int,
+    min_slots: int = 8,
+    min_seq: int = 256,
+    fraction: float = DEFAULT_HEADROOM_FRACTION,
+) -> HeadroomPlan:
+    """Fit ``(slots, max_seq)`` under ``fraction * capacity``.
+
+    Downshift order: halve slots to ``min_slots`` first (throughput knob —
+    the measurement survives at lower batch), then halve context to
+    ``min_seq`` (changes the workload more). The label records every hop
+    so a downshifted round can never masquerade as the requested config.
+    """
+    budget = int(capacity_bytes * fraction)
+    req_slots, req_seq = slots, max_seq
+    est = estimate_fn(slots, max_seq)
+    # clamp the last halving TO the floor rather than refusing it — from
+    # the default 80 the sequence must be able to reach min_slots=8
+    # (80->40->20->10->8), not stop at 10 and needlessly cut context
+    while est > budget and slots > min_slots:
+        slots = max(slots // 2, min_slots)
+        est = estimate_fn(slots, max_seq)
+    while est > budget and max_seq > min_seq:
+        max_seq = max(max_seq // 2, min_seq)
+        est = estimate_fn(slots, max_seq)
+    label = None
+    if (slots, max_seq) != (req_slots, req_seq):
+        hops = []
+        if slots != req_slots:
+            hops.append(f"slots {req_slots}->{slots}")
+        if max_seq != req_seq:
+            hops.append(f"ctx {req_seq}->{max_seq}")
+        label = (
+            f"downshifted: {', '.join(hops)} "
+            f"(est {estimate_fn(req_slots, req_seq) / 1e9:.1f} GB > "
+            f"{fraction:.0%} of {capacity_bytes / 1e9:.1f} GB HBM)"
+        )
+    return HeadroomPlan(
+        fits=est <= budget,
+        slots=slots,
+        max_seq=max_seq,
+        estimate_bytes=est,
+        capacity_bytes=capacity_bytes,
+        budget_bytes=budget,
+        downshifted=label,
+    )
+
+
+def serving_headroom_plan(
+    model: str,
+    slots: int,
+    max_seq: int,
+    quant: str,
+    kv_quant: bool,
+    capacity_bytes: int,
+    **plan_kwargs: Any,
+) -> HeadroomPlan:
+    """``plan_admission`` over the analytic serving estimate for a named
+    model config (context changes rebuild the config — the estimate must
+    price the shape actually admitted)."""
+    from kserve_vllm_mini_tpu.models.config import get_config
+
+    def estimate(s: int, ctx: int) -> int:
+        cfg = get_config(model, max_seq_len=ctx)
+        return estimate_serving_bytes(cfg, s, ctx, quant=quant,
+                                      kv_quant=kv_quant)["total_bytes"]
+
+    return plan_admission(estimate, capacity_bytes, slots, max_seq,
+                          **plan_kwargs)

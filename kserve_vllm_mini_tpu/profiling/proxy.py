@@ -1,0 +1,254 @@
+"""CPU-mesh proxy bench tier: tracked metrics with zero device time.
+
+Three of five driver bench rounds produced no perf signal (wedged TPU
+relay, one OOM). This tier is the fallback bench.py runs when the TPU
+probe fails: on the forced 8-device host platform (the same
+``--xla_force_host_platform_device_count=8`` virtual mesh every tier-1
+test and multichip dryrun uses) it
+
+1. **compiles the flagship program abstractly** — ``lower().compile()``
+   over ``ShapeDtypeStruct`` trees, so the real 8B-class prefill/decode
+   executables are built WITHOUT materializing 16 GB of weights — and
+   extracts the XLA cost model's FLOPs/bytes-accessed, the
+   buffer-assignment peak estimate, HLO op histograms, and compile wall
+   time (``profiling.compile_stats``);
+2. **executes a small config end-to-end** on the host mesh (real params,
+   real prefill + decode loops) and measures the sync-vs-chained
+   step-count ratio — how much per-step host synchronization costs
+   relative to pipelined dispatch, the shape-level signal behind the
+   decode pipeline's benefit;
+3. **pre-flights the flagship against HBM capacity** (the headroom guard)
+   so the round also reports whether the config would have fit.
+
+Everything is labeled ``series: "proxy"`` and kept as its own trajectory
+series (analysis/trajectory.py) — proxy rounds track compile-level and
+cost-model drift, they never claim device throughput
+(docs/PROFILING.md spells out what proxy metrics can and cannot say).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Optional
+
+from kserve_vllm_mini_tpu.profiling.compile_stats import capture_compile_stats
+from kserve_vllm_mini_tpu.profiling.headroom import (
+    estimate_serving_bytes,
+    serving_headroom_plan,
+)
+
+
+def _build_step_fns(cfg, slots: int, prompt_len: int):
+    """The bench serving child's prefill/decode shapes, minimal: batch
+    fresh-prefill (donated cache, last-position logits) and one fused
+    sampling decode step — the two executables every serving number in
+    this repo flows through."""
+    import jax
+    import jax.numpy as jnp
+
+    from functools import partial
+
+    from kserve_vllm_mini_tpu.models.llama import forward
+    from kserve_vllm_mini_tpu.runtime.sampling import sample_tokens
+
+    @partial(jax.jit, donate_argnums=(1,))
+    def prefill(params, cache, toks, pos):
+        last = jnp.full((slots,), prompt_len - 1, dtype=jnp.int32)
+        logits, cache = forward(
+            params, cfg, toks, pos, cache, jnp.zeros((slots,), jnp.int32),
+            fresh_prefill=True, logit_index=last,
+        )
+        return cache, jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+
+    @partial(jax.jit, donate_argnums=(1,))
+    def decode(params, cache, tokens, lengths, rng):
+        logits, cache = forward(params, cfg, tokens[:, None],
+                                lengths[:, None], cache, lengths)
+        nxt = sample_tokens(
+            logits[:, 0, :], rng,
+            jnp.zeros((slots,), jnp.float32),
+            jnp.zeros((slots,), jnp.int32),
+            jnp.ones((slots,), jnp.float32),
+        )
+        return cache, nxt
+
+    return prefill, decode
+
+
+def cost_model_stats(
+    model: str,
+    quant: str,
+    slots: int,
+    max_seq: int,
+    prompt_len: int = 128,
+    kv_quant: bool = False,
+) -> dict[str, Any]:
+    """Abstract-compile the flagship config's prefill + decode and return
+    their compile stats. No weights are ever materialized — ``eval_shape``
+    over the initializers yields the exact parameter/cache avals, and
+    ``lower()`` accepts them directly."""
+    import jax
+    import jax.numpy as jnp
+
+    from kserve_vllm_mini_tpu.models.config import get_config
+    from kserve_vllm_mini_tpu.models.llama import init_kv_cache, init_params
+
+    cfg = get_config(model, max_seq_len=max_seq)
+    abs_params = jax.eval_shape(lambda k: init_params(k, cfg),
+                                jax.random.PRNGKey(0))
+    abs_cache = jax.eval_shape(
+        lambda: init_kv_cache(cfg, slots, max_seq=max_seq, quantized=kv_quant)
+    )
+    prefill, decode = _build_step_fns(cfg, slots, prompt_len)
+
+    toks = jax.ShapeDtypeStruct((slots, prompt_len), jnp.int32)
+    pos = jax.ShapeDtypeStruct((slots, prompt_len), jnp.int32)
+    _, pf_stats = capture_compile_stats(
+        prefill, abs_params, abs_cache, toks, pos,
+        label=f"proxy.prefill[{model}]",
+    )
+    tok1 = jax.ShapeDtypeStruct((slots,), jnp.int32)
+    lens = jax.ShapeDtypeStruct((slots,), jnp.int32)
+    rng = jax.eval_shape(lambda: jax.random.PRNGKey(2))
+    _, dec_stats = capture_compile_stats(
+        decode, abs_params, abs_cache, tok1, lens, rng,
+        label=f"proxy.decode[{model}]",
+    )
+    # NOTE: quant shapes the analytic weight estimate below, not the
+    # abstract tree (init_params' bf16 avals are what lower() saw) — the
+    # cost model therefore prices the bf16 program; the headroom block
+    # prices the quantized deployment. Both labeled, neither conflated.
+    est = estimate_serving_bytes(cfg, slots, max_seq, quant=quant,
+                                 kv_quant=kv_quant)
+    return {
+        "model": cfg.name,
+        "param_count": cfg.param_count,
+        "prefill": pf_stats.to_dict(),
+        "decode": dec_stats.to_dict(),
+        "analytic": est,
+    }
+
+
+def exec_proxy(
+    model: str,
+    slots: int,
+    decode_steps: int,
+    prompt_len: int = 32,
+    max_seq: int = 128,
+) -> dict[str, Any]:
+    """Run a SMALL config's real prefill + decode on the host mesh and
+    measure the sync-vs-chained step ratio.
+
+    ``chained`` dispatches every step back-to-back and synchronizes once
+    (device-limited); ``sync`` reads back after every step (the serving
+    engine's per-sweep shape). ratio = sync/chained >= 1: how many chained
+    steps fit in one served step — a host-overhead number that exists with
+    or without a TPU, tracked per round so a dispatch-path regression
+    shows up even in dark rounds."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kserve_vllm_mini_tpu.models.config import get_config
+    from kserve_vllm_mini_tpu.models.llama import init_kv_cache, init_params
+
+    # the cache must hold EVERY step this run writes (warmup + chained +
+    # sync windows) — a fixed window would let a large --proxy-steps knob
+    # silently clamp writes onto the last position and corrupt the timing
+    total_steps = 4 + decode_steps + max(decode_steps // 2, 4)
+    max_seq = max(max_seq, prompt_len + total_steps + 1)
+    cfg = get_config(model, max_seq_len=max_seq)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    cache = init_kv_cache(cfg, slots, max_seq=max_seq)
+    prefill, decode = _build_step_fns(cfg, slots, prompt_len)
+
+    toks = jax.random.randint(jax.random.PRNGKey(1), (slots, prompt_len), 0,
+                              cfg.vocab_size)
+    pos = jnp.broadcast_to(jnp.arange(prompt_len, dtype=jnp.int32),
+                           (slots, prompt_len))
+    t0 = time.perf_counter()
+    cache, tokens = prefill(params, cache, toks, pos)
+    _ = np.asarray(tokens)
+    prefill_first_s = time.perf_counter() - t0
+
+    lengths = jnp.full((slots,), prompt_len, dtype=jnp.int32)
+    rng = jax.random.PRNGKey(2)
+
+    def run(n: int, cache, tokens, lengths, rng, sync_each: bool):
+        for _ in range(n):
+            rng, sub = jax.random.split(rng)
+            cache, tokens = decode(params, cache, tokens, lengths, sub)
+            lengths = lengths + 1
+            if sync_each:
+                _ = np.asarray(tokens)
+        _ = np.asarray(tokens)
+        return cache, tokens, lengths, rng
+
+    # warm (compiles the decode), then chained and per-step-sync windows
+    cache, tokens, lengths, rng = run(4, cache, tokens, lengths, rng, False)
+    t0 = time.perf_counter()
+    cache, tokens, lengths, rng = run(decode_steps, cache, tokens, lengths,
+                                      rng, False)
+    chained_ms = (time.perf_counter() - t0) / decode_steps * 1000.0
+    n_sync = max(decode_steps // 2, 4)
+    t0 = time.perf_counter()
+    cache, tokens, lengths, rng = run(n_sync, cache, tokens, lengths, rng,
+                                      True)
+    sync_ms = (time.perf_counter() - t0) / n_sync * 1000.0
+    return {
+        "model": cfg.name,
+        "slots": slots,
+        "decode_steps": decode_steps,
+        "prefill_first_s": round(prefill_first_s, 3),
+        "chained_step_ms": round(chained_ms, 3),
+        "sync_step_ms": round(sync_ms, 3),
+        "step_count_ratio": round(sync_ms / max(chained_ms, 1e-9), 3),
+        "proxy_tokens_per_sec": round(slots / max(chained_ms / 1000.0, 1e-9), 1),
+    }
+
+
+def run_proxy_tier(
+    model: str,
+    exec_model: str = "llama-tiny",
+    quant: str = "int8",
+    slots: int = 80,
+    max_seq: int = 512,
+    prompt_len: int = 128,
+    decode_steps: int = 24,
+    kv_quant: bool = False,
+    hbm_bytes: Optional[int] = None,
+) -> dict[str, Any]:
+    """The full proxy round: flagship cost model + headroom pre-flight +
+    executed small-config step ratio. Returns the schema-valid ``proxy``
+    block (core/schema.py ``validate_proxy``)."""
+    import jax
+
+    cost = cost_model_stats(model, quant, slots, max_seq,
+                            prompt_len=prompt_len, kv_quant=kv_quant)
+    execd = exec_proxy(exec_model, min(slots, 8), decode_steps)
+    pf, dec = cost["prefill"], cost["decode"]
+    block: dict[str, Any] = {
+        "series": "proxy",
+        "platform": jax.default_backend(),
+        "n_devices": jax.device_count(),
+        "model": cost["model"],
+        "exec_model": execd["model"],
+        "quant": quant,
+        "slots": slots,
+        "max_seq": max_seq,
+        # acceptance pins: the five headline proxy metrics, flat
+        "flops": dec["flops"],
+        "bytes_accessed": dec["bytes_accessed"],
+        "compile_wall_s": round(pf["compile_wall_s"] + dec["compile_wall_s"], 4),
+        "peak_bytes": max(pf["peak_bytes"], dec["peak_bytes"]),
+        "step_count_ratio": execd["step_count_ratio"],
+        # full detail, per executable
+        "compile_stats": {"prefill": pf, "decode": dec},
+        "analytic_bytes": cost["analytic"],
+        "exec": execd,
+    }
+    if hbm_bytes:
+        block["hbm_headroom"] = serving_headroom_plan(
+            model, slots, max_seq, quant, kv_quant, hbm_bytes
+        ).to_dict()
+    return block

@@ -35,6 +35,10 @@ import numpy as np
 
 from kserve_vllm_mini_tpu.models.config import ModelConfig
 from kserve_vllm_mini_tpu.models.llama import forward
+from kserve_vllm_mini_tpu.profiling.compile_stats import (
+    CompileRecorder,
+    InstrumentedJit,
+)
 from kserve_vllm_mini_tpu.runtime import tracing as rt_tracing
 from kserve_vllm_mini_tpu.runtime.sampling import (
     apply_penalties,
@@ -298,6 +302,15 @@ class EngineConfig:
     # recording entirely; the phase histograms (plain counters) stay on.
     request_tracing: bool = True
     trace_buffer: int = 4096
+    # Compile-stats capture (docs/PROFILING.md): the engine's compiled
+    # steps go through an explicit lower().compile() wrapper
+    # (profiling.compile_stats.InstrumentedJit) so compile wall time, the
+    # XLA cost model's FLOPs/bytes, and peak-buffer estimates accumulate
+    # into snapshot_stats / /metrics. One compile total per executable
+    # (the wrapper caches what it built); any AOT failure falls back to
+    # the plain jit call. Disabled automatically on meshes (AOT calls
+    # don't auto-reshard arguments the way jit does).
+    compile_stats: bool = True
 
 
 @dataclass
@@ -676,6 +689,12 @@ class Engine:
         # trigger is a host-local race the follower cannot observe —
         # lockstep cancellation latency is one published decision instead
         self._lockstep = False
+
+        # compile-stats capture (docs/PROFILING.md): every compiled step
+        # below registers its lower().compile() facts here; exported via
+        # snapshot_stats -> /metrics (compile_* keys). Thread-safe — the
+        # scheduler thread records, server threads snapshot.
+        self._compile_recorder = CompileRecorder()
 
         # stats for /metrics and duty-cycle telemetry
         self.stats = {
@@ -1104,6 +1123,15 @@ class Engine:
             b *= 2
         return min(b, self.ecfg.max_prefill_len)
 
+    def _instrument(self, fn, label: str):
+        """Route a compiled step through the compile-stats wrapper
+        (docs/PROFILING.md). Meshes stay on the plain jit path: an AOT
+        executable requires pre-placed arguments, while jit transparently
+        reshards — the sharded engines keep that behavior."""
+        if not self.ecfg.compile_stats or self.mesh is not None:
+            return fn
+        return InstrumentedJit(fn, self._compile_recorder, label=label)
+
     def _get_prefill_fn(self, bucket: int, draft: bool = False):
         key = (bucket, draft)
         if key in self._prefill_fns:
@@ -1135,6 +1163,8 @@ class Engine:
             )
             return update_cache_slots(cache, new_sub, slot), logits[0, 0]  # [V] f32
 
+        prefill = self._instrument(prefill, f"prefill[{bucket}]"
+                                   + (".draft" if draft else ""))
         self._prefill_fns[key] = prefill
         return prefill
 
@@ -1171,6 +1201,9 @@ class Engine:
             )
             return update_cache_slots(cache, new_sub, slot), logits[0, 0]
 
+        chunk_prefill = self._instrument(
+            chunk_prefill, f"chunk_prefill[{bucket}]"
+            + (".draft" if draft else ""))
         self._prefill_fns[key] = chunk_prefill
         return chunk_prefill
 
@@ -1199,6 +1232,7 @@ class Engine:
             )
             return nc, logits[0, 0]
 
+        prefill = self._instrument(prefill, f"paged_prefill[{bucket}]")
         self._prefill_fns[key] = prefill
         return prefill
 
@@ -1225,6 +1259,8 @@ class Engine:
             )
             return nc, logits[0, 0]
 
+        chunk_prefill = self._instrument(
+            chunk_prefill, f"paged_chunk_prefill[{bucket}]")
         self._prefill_fns[key] = chunk_prefill
         return chunk_prefill
 
@@ -1281,6 +1317,8 @@ class Engine:
             # host round-trip (the double-buffered pipeline's token path)
             return c, cnt, toks, ys  # ys: ([n,S], [n,S], [n,S,K], [n,S,K])
 
+        decode = self._instrument(decode, f"decode[chunk={n_steps}]"
+                                  + (".paged" if paged else ""))
         self._decode_fns[key] = decode
         return decode
 
@@ -1327,6 +1365,8 @@ class Engine:
             return nc, count_tokens(counts, nxt), nxt, \
                 (nxt[None], lp[None], tids[None], tlps[None])
 
+        decode_masked = self._instrument(
+            decode_masked, "decode.masked" + (".paged" if paged else ""))
         self._decode_fns[key] = decode_masked
         return decode_masked
 
@@ -1336,8 +1376,11 @@ class Engine:
         # greedy accept rule (see build_spec_step_sampled), so greedy
         # output stays bit-identical to plain decode
         if self._spec_fn is None:
-            self._spec_fn = build_spec_step_sampled(
-                self.cfg, self._drafter_cfg, self.ecfg.spec_tokens
+            self._spec_fn = self._instrument(
+                build_spec_step_sampled(
+                    self.cfg, self._drafter_cfg, self.ecfg.spec_tokens
+                ),
+                f"spec[k={self.ecfg.spec_tokens}]",
             )
         return self._spec_fn
 
@@ -2550,4 +2593,28 @@ class Engine:
         s["spec_accept_ratio"] = (
             s["spec_accepted"] / s["spec_proposed"] if s["spec_proposed"] else 0.0
         )
+        # compile-stats totals (docs/PROFILING.md): the recorder is
+        # internally locked, so this read is consistent by construction
+        cs = self._compile_recorder.snapshot()
+        s["compiles"] = cs["compiles"]
+        s["compile_s"] = cs["compile_s"]
+        s["compiled_flops"] = cs["compiled_flops"]
+        s["compiled_bytes"] = cs["compiled_bytes"]
+        s["compile_peak_bytes"] = cs["compile_peak_bytes"]
         return s
+
+    def compile_stats_snapshot(self) -> dict[str, Any]:
+        """The results.json ``compile_stats`` block (docs/PROFILING.md):
+        recorder totals keyed the way the analyzer's /metrics scrape maps
+        them, plus the per-executable entries for run artifacts."""
+        cs = self._compile_recorder.snapshot()
+        return {
+            "compiles": cs["compiles"],
+            "compile_wall_s": round(cs["compile_s"], 4),
+            "flops": cs["compiled_flops"],
+            "bytes_accessed": cs["compiled_bytes"],
+            "peak_bytes": cs["compile_peak_bytes"],
+            "executables": [
+                e.to_dict() for e in self._compile_recorder.entries()
+            ],
+        }

@@ -1,44 +1,6 @@
-"""CLI front for the runtime's /profile endpoint: capture a TensorBoard
-trace of a live serving process (SURVEY.md §5.1 runtime-side profiling —
-the reference has only client-side spans; with the runtime in-repo we can
-trace the actual device timeline of the decode loop).
+"""Back-compat shim: the profiler CLI grew into the profiling subsystem
+(kserve_vllm_mini_tpu/profiling/ — docs/PROFILING.md). The ``kvmini-tpu
+profile`` subcommand now dispatches to ``profiling.capture``; this module
+stays importable for anything that referenced the old path."""
 
-Usage: ``kvmini-tpu profile --url http://host:8000 --seconds 3``
-Then: ``tensorboard --logdir <trace_dir>`` -> Profile plugin.
-"""
-
-from __future__ import annotations
-
-import argparse
-import json
-import urllib.request
-
-
-def register(parser: argparse.ArgumentParser) -> None:
-    parser.add_argument("--url", required=True, help="Serving runtime base URL")
-    parser.add_argument("--seconds", type=float, default=3.0,
-                        help="Capture window (server caps at 60)")
-    parser.add_argument("--out-dir", default=None,
-                        help="Trace directory (server default: runs/profile-<ts>)")
-    parser.add_argument("--timeout", type=float, default=120.0)
-
-
-def run(args: argparse.Namespace) -> int:
-    body: dict = {"seconds": args.seconds}
-    if args.out_dir:
-        body["out_dir"] = args.out_dir
-    req = urllib.request.Request(
-        args.url.rstrip("/") + "/profile",
-        data=json.dumps(body).encode(),
-        headers={"Content-Type": "application/json"},
-        method="POST",
-    )
-    try:
-        with urllib.request.urlopen(req, timeout=args.timeout) as resp:
-            data = json.loads(resp.read())
-    except Exception as e:  # noqa: BLE001 — CLI boundary
-        print(f"profile capture failed: {type(e).__name__}: {e}")
-        return 1
-    print(f"trace captured: {data['trace_dir']} ({data['seconds']}s)")
-    print(f"view: tensorboard --logdir {data['trace_dir']}")
-    return 0
+from kserve_vllm_mini_tpu.profiling.capture import register, run  # noqa: F401

@@ -1192,6 +1192,20 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
             f"kvmini_tpu_cache_hits_total {s['prefix_hits']}",
             "# TYPE kvmini_tpu_cache_lookups_total counter",
             f"kvmini_tpu_cache_lookups_total {s['prefix_lookups']}",
+            # compile-stats capture (docs/PROFILING.md): explicit
+            # lower().compile() facts for every executable the engine
+            # built — wall time plus the XLA cost model's per-invocation
+            # FLOPs/bytes and the buffer-assignment peak estimate
+            "# TYPE kvmini_tpu_compiles_total counter",
+            f"kvmini_tpu_compiles_total {s['compiles']}",
+            "# TYPE kvmini_tpu_compile_seconds_total counter",
+            f"kvmini_tpu_compile_seconds_total {s['compile_s']:.6f}",
+            "# TYPE kvmini_tpu_compiled_flops_total counter",
+            f"kvmini_tpu_compiled_flops_total {s['compiled_flops']:.6g}",
+            "# TYPE kvmini_tpu_compiled_bytes_total counter",
+            f"kvmini_tpu_compiled_bytes_total {s['compiled_bytes']:.6g}",
+            "# TYPE kvmini_tpu_compile_peak_bytes gauge",
+            f"kvmini_tpu_compile_peak_bytes {s['compile_peak_bytes']}",
         ]
         if "kv_pool_blocks" in s:  # paged layout only
             lines += [

@@ -117,7 +117,7 @@ def test_chart_template_covers_multihost_and_quant():
 
 def test_dashboards_valid_and_tpu_native():
     files = sorted((REPO / "dashboards").glob("*.json"))
-    assert len(files) == 5
+    assert len(files) == 6
     uids = set()
     for f in files:
         d = json.loads(f.read_text())
@@ -130,7 +130,7 @@ def test_dashboards_valid_and_tpu_native():
         assert "DCGM" not in text and "nvidia" not in text.lower(), (
             f"{f.name} references GPU metrics"
         )
-    assert len(uids) == 5  # unique dashboard uids
+    assert len(uids) == 6  # unique dashboard uids
 
 
 def test_run_timeline_dashboard_uses_windowed_duty():
@@ -141,6 +141,20 @@ def test_run_timeline_dashboard_uses_windowed_duty():
     assert "rate(kvmini_tpu_busy_seconds_total" in d
     assert "kvmini_tpu_queue_depth" in d
     assert "rate(kvmini_tpu_requests_completed_total" in d
+
+
+def test_compile_stats_dashboard_queries_profiling_metrics():
+    """The compile-stats dashboard (docs/PROFILING.md) must query the
+    profiling counters the runtime actually emits — KVM032 keeps the
+    names aligned, this pins the panels themselves: a rate() over
+    compile_seconds (recompile pressure is a RATE signal) plus the
+    FLOPs/bytes cost-model series and the peak-buffer gauge."""
+    d = (REPO / "dashboards" / "compile-stats.json").read_text()
+    assert "rate(kvmini_tpu_compile_seconds_total" in d
+    assert "kvmini_tpu_compiles_total" in d
+    assert "kvmini_tpu_compiled_flops_total" in d
+    assert "kvmini_tpu_compiled_bytes_total" in d
+    assert "kvmini_tpu_compile_peak_bytes" in d
 
 
 def test_utilization_dashboard_queries_tpu_metrics():

@@ -39,6 +39,10 @@ def _fast_orchestration(monkeypatch, tmp_path):
     a tmp cwd so bench_partial.json never lands in the repo."""
     monkeypatch.setenv("KVMINI_BENCH_PROBE_BUDGET_S", "0")
     monkeypatch.setenv("KVMINI_BENCH_MODES", "headline")
+    # these tests pin the PRE-proxy failure contracts; the proxy tier's
+    # own orchestration (auto/always/never, fallback child env) is
+    # covered in tests/test_bench_proxy.py
+    monkeypatch.setenv("KVMINI_BENCH_PROXY", "never")
     monkeypatch.chdir(tmp_path)
 
 

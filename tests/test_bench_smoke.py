@@ -39,6 +39,13 @@ def test_bench_smoke_surfaces_pipeline_counters(tmp_path):
         kwargs={"pipeline_metrics": {
             "kvmini_tpu_dispatch_depth": 2.0,
             "kvmini_tpu_host_overlap_seconds_total": 0.125,
+            # compile-stats counters (docs/PROFILING.md): the analyzer's
+            # scrape must land them under the nested compile_stats block
+            "kvmini_tpu_compiles_total": 3.0,
+            "kvmini_tpu_compile_seconds_total": 41.5,
+            "kvmini_tpu_compiled_flops_total": 1.39e11,
+            "kvmini_tpu_compiled_bytes_total": 9.46e10,
+            "kvmini_tpu_compile_peak_bytes": 2.1e10,
         }},
         daemon=True,
     )
@@ -63,6 +70,14 @@ def test_bench_smoke_surfaces_pipeline_counters(tmp_path):
         # in-memory return)
         persisted = json.loads(run_dir.results_json.read_text())
         assert persisted["pipeline_dispatch_depth"] == 2.0
+
+        # ISSUE 6: the compile-stats block rode the same scrape into the
+        # typed results key (external-endpoint path; self-serve runs get
+        # the richer direct snapshot with per-executable entries)
+        assert persisted["compile_stats"]["compiles"] == 3.0
+        assert persisted["compile_stats"]["compile_wall_s"] == 41.5
+        assert persisted["compile_stats"]["flops"] == 1.39e11
+        assert persisted["compile_stats"]["peak_bytes"] == 2.1e10
 
         # ISSUE 2: the analyzer fetched the mock's /traces, merged the
         # server leg into traces.json (one doc, both lanes, joined by
@@ -174,6 +189,22 @@ def test_pipeline_counters_absent_for_external_engines(tmp_path):
     assert telemetry.pipeline_counters(None) == {}
     # unreachable endpoint -> scrape fails quietly -> no keys
     assert telemetry.pipeline_counters("http://127.0.0.1:9") == {}
+
+
+def test_compile_stats_block_degradation_rules():
+    """Same absent-not-zero contract for the compile-stats block, plus:
+    a runtime that exported the names but compiled NOTHING yields no
+    block (an all-zero compile report carries no information)."""
+    assert telemetry.compile_stats_block(None) == {}
+    assert telemetry.compile_stats_block("http://127.0.0.1:9") == {}
+    zeros = {m: 0.0 for m in telemetry.COMPILE_METRIC_KEYS.values()}
+    assert telemetry.compile_stats_block("http://x", runtime_metrics=zeros) == {}
+    live = dict(zeros)
+    live["kvmini_tpu_compiles_total"] = 2.0
+    live["kvmini_tpu_compile_seconds_total"] = 7.5
+    block = telemetry.compile_stats_block("http://x", runtime_metrics=live)
+    assert block["compile_stats"]["compiles"] == 2.0
+    assert block["compile_stats"]["compile_wall_s"] == 7.5
 
 
 def test_scrape_parses_runtime_metric_shapes():

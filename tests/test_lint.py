@@ -293,4 +293,8 @@ def test_live_codebase_matches_baseline_exactly():
     # time — the `--timing` surface CI uploads to attribute speed drift
     assert {"facts", "jit_purity", "lockstep", "workload", "concurrency",
             "metrics_drift"} <= set(result.timings)
-    assert elapsed < 10.0, f"kvmini-lint took {elapsed:.1f}s (budget 10s)"
+    # 12s: ~7s idle on this box after the profiling subsystem grew the
+    # package (PR 6); the old 10s pin flaked when the full suite's load
+    # rode on top. lint-timing.json (CI artifact) still names the
+    # checker if one of them regresses.
+    assert elapsed < 12.0, f"kvmini-lint took {elapsed:.1f}s (budget 12s)"

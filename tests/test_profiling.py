@@ -1,0 +1,331 @@
+"""Profiling subsystem (docs/PROFILING.md): compile-stats extraction on
+the CPU mesh (pinned keys, monotonic FLOPs with batch), the
+InstrumentedJit compile-once/fallback contract, the headroom downshift
+decision under mocked HBM capacities, the proxy-block validator, and the
+engine's end-to-end compile-stats surface."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from kserve_vllm_mini_tpu.core.schema import validate_proxy
+from kserve_vllm_mini_tpu.profiling.compile_stats import (
+    CompileRecorder,
+    InstrumentedJit,
+    abstractify,
+    capture_compile_stats,
+    extract_compile_stats,
+    hlo_op_histogram,
+)
+from kserve_vllm_mini_tpu.profiling.headroom import (
+    HBM_BYTES_BY_KIND,
+    estimate_serving_bytes,
+    plan_admission,
+    serving_headroom_plan,
+)
+
+
+def _matmul_fn():
+    return jax.jit(lambda a, b: (a @ b).sum())
+
+
+# -- compile-stats extraction -------------------------------------------------
+
+def test_capture_pins_stat_keys():
+    """The CompileStats record must carry every key downstream consumers
+    (artifact, schema, report) read — pinned here so a jax upgrade that
+    drops an analysis surfaces as a test failure, not silent zeros."""
+    fn = _matmul_fn()
+    x = jnp.ones((32, 32))
+    compiled, cs = capture_compile_stats(fn, x, x, label="t")
+    d = cs.to_dict()
+    for key in ("label", "compile_wall_s", "flops", "bytes_accessed",
+                "peak_bytes", "argument_bytes", "output_bytes",
+                "temp_bytes", "generated_code_bytes", "hlo_ops"):
+        assert key in d, key
+    assert d["label"] == "t"
+    assert d["compile_wall_s"] > 0
+    assert d["flops"] > 0
+    assert d["bytes_accessed"] > 0
+    # two f32[32,32] args = 8192 bytes, and they dominate the peak
+    assert d["argument_bytes"] == 2 * 32 * 32 * 4
+    assert d["peak_bytes"] >= d["argument_bytes"]
+    assert d["hlo_ops"].get("dot", 0) >= 1 or d["hlo_ops"].get("fusion", 0) >= 1
+    # the compiled executable actually runs and agrees with the jit path
+    assert float(compiled(x, x)) == float(fn(x, x))
+
+
+def test_cost_model_flops_monotonic_with_batch():
+    """Doubling the batch must not shrink cost-model FLOPs — the analytic
+    invariant the proxy trajectory leans on."""
+    fn = jax.jit(lambda a, w: (a @ w).sum())
+    w = jnp.ones((64, 64))
+    flops = []
+    for batch in (2, 8, 32):
+        _, cs = capture_compile_stats(fn, jnp.ones((batch, 64)), w)
+        flops.append(cs.flops)
+    assert flops[0] < flops[1] < flops[2], flops
+
+
+def test_abstract_lowering_needs_no_weights():
+    """ShapeDtypeStruct args compile the same program as concrete arrays
+    (identical cost-model FLOPs) — the proxy tier's no-materialize path."""
+    fn = _matmul_fn()
+    x = jnp.ones((16, 16))
+    _, concrete = capture_compile_stats(fn, x, x)
+    _, abstract = capture_compile_stats(fn, *abstractify((x, x)))
+    assert abstract.flops == concrete.flops
+    assert abstract.argument_bytes == concrete.argument_bytes
+
+
+def test_hlo_op_histogram_parses_and_caps():
+    text = "\n".join([
+        "HloModule m, is_scheduled=true",
+        "%main.9 (Arg_0.1: f32[4,4]) -> f32[] {",
+        "  %Arg_0.1 = f32[4,4]{1,0} parameter(0)",
+        '  %dot.3 = f32[4,4]{1,0} dot(%Arg_0.1, %Arg_0.1), metadata={op_name="jit(x)/dot_general"}',
+        "  %t = (f32[2]{0}, f32[3]{0}) tuple(%dot.3, %dot.3)",
+        "  ROOT %reduce.8 = f32[] reduce(%dot.3, %c), dimensions={0,1}",
+        "}",
+    ])
+    hist = hlo_op_histogram(text)
+    assert hist == {"parameter": 1, "dot": 1, "tuple": 1, "reduce": 1}
+    # cap: >top opcodes fold into "other", counts preserved
+    many = "\n".join(f"  %x{i} = f32[] op{i}(%a)" for i in range(20))
+    capped = hlo_op_histogram(many, top=4)
+    assert len(capped) == 5 and capped["other"] == 16
+
+
+def test_extract_survives_analysis_free_executable():
+    """A backend object lacking every analysis must yield zeros, never
+    raise — stats decorate a run, they cannot kill it."""
+    class Bare:
+        pass
+
+    cs = extract_compile_stats(Bare(), 0.5, label="bare")
+    assert cs.flops == 0 and cs.peak_bytes == 0 and cs.hlo_ops == {}
+
+
+# -- InstrumentedJit ----------------------------------------------------------
+
+def test_instrumented_jit_compiles_once_per_signature():
+    rec = CompileRecorder()
+    fn = InstrumentedJit(_matmul_fn(), rec, label="mm")
+    x = jnp.ones((8, 8))
+    y = jnp.ones((4, 4))
+    for _ in range(3):
+        out = fn(x, x)
+    assert rec.snapshot()["compiles"] == 1
+    assert float(out) == float(x.sum() * 8)
+    fn(y, y)  # new shape -> one more compile
+    snap = rec.snapshot()
+    assert snap["compiles"] == 2
+    assert snap["compile_s"] > 0
+    assert snap["compiled_flops"] > 0
+    assert snap["compile_peak_bytes"] > 0
+    assert [e.label for e in rec.entries()] == ["mm", "mm"]
+
+
+def test_instrumented_jit_falls_back_when_aot_unsupported():
+    """A callable without .lower must still serve calls (plain path) and
+    record nothing — degradation, never breakage."""
+    rec = CompileRecorder()
+    fn = InstrumentedJit(lambda a: a + 1, rec, label="plain")
+    assert int(fn(jnp.int32(41))) == 42
+    assert rec.snapshot()["compiles"] == 0
+
+
+def test_instrumented_jit_preserves_donation():
+    """donate_argnums through the AOT path: the donated input buffer is
+    consumed exactly like under plain jit."""
+    import functools
+
+    rec = CompileRecorder()
+    base = functools.partial(jax.jit, donate_argnums=(0,))(lambda c, d: c + d)
+    fn = InstrumentedJit(base, rec, label="don")
+    c = jnp.ones((128,))
+    out = fn(c, jnp.ones((128,)))
+    assert float(out[0]) == 2.0
+    assert rec.snapshot()["compiles"] == 1
+    assert c.is_deleted()  # the donation actually happened
+
+
+# -- headroom guard -----------------------------------------------------------
+
+def _linear_estimate(per_slot: int, per_ctx: int):
+    return lambda slots, ctx: slots * per_slot + ctx * per_ctx
+
+
+def test_plan_admission_fits_untouched():
+    plan = plan_admission(_linear_estimate(10, 1), capacity_bytes=10_000,
+                          slots=80, max_seq=512)
+    assert plan.fits and plan.downshifted is None
+    assert (plan.slots, plan.max_seq) == (80, 512)
+
+
+def test_plan_admission_downshifts_slots_first():
+    # 80*100 + 512 = 8512 > 0.9*6000; 40 slots -> 4512 > 5400? no: fits
+    plan = plan_admission(_linear_estimate(100, 1), capacity_bytes=6_000,
+                          slots=80, max_seq=512)
+    assert plan.fits
+    assert plan.slots == 40 and plan.max_seq == 512
+    assert "slots 80->40" in plan.downshifted
+    assert "ctx" not in plan.downshifted
+
+
+def test_plan_admission_downshifts_ctx_after_slot_floor():
+    # even 8 slots * 100 = 800 plus ctx*10: needs ctx cuts too
+    plan = plan_admission(_linear_estimate(100, 10), capacity_bytes=5_000,
+                          slots=64, max_seq=2048)
+    assert plan.fits
+    assert plan.slots == 8
+    assert plan.max_seq == 256
+    assert "slots 64->8" in plan.downshifted and "ctx 2048->256" in plan.downshifted
+
+
+def test_plan_admission_reaches_min_slots_floor_from_default():
+    """80 -> 40 -> 20 -> 10 -> 8: the last halving clamps TO the floor
+    instead of stopping at 10 — a config that fits at 8 slots must be
+    admitted there, not escalated to ctx cuts or 'unfittable'."""
+    # est(8) = 800 fits the 900 budget; est(10) = 1000 does not
+    plan = plan_admission(_linear_estimate(100, 0), capacity_bytes=1_000,
+                          slots=80, max_seq=512)
+    assert plan.fits
+    assert plan.slots == 8 and plan.max_seq == 512
+    assert "slots 80->8" in plan.downshifted
+
+
+def test_plan_admission_ctx_clamps_to_min_seq():
+    """Same clamp rule on the context loop: a custom min_seq floor that
+    is not a power-of-two divisor is still reachable."""
+    plan = plan_admission(_linear_estimate(0, 10), capacity_bytes=3_300,
+                          slots=8, max_seq=2048, min_seq=297)
+    assert plan.fits
+    assert plan.max_seq == 297   # 2048 -> 1024 -> 512 -> max(256, 297)
+    assert "ctx 2048->297" in plan.downshifted
+
+
+def test_plan_admission_reports_unfittable():
+    plan = plan_admission(_linear_estimate(10_000, 10_000), capacity_bytes=1_000,
+                          slots=8, max_seq=256)
+    assert not plan.fits
+    assert plan.estimate_bytes > plan.budget_bytes
+
+
+def test_serving_headroom_plan_mocked_capacities():
+    """The real analytic estimator over llama-tiny: a generous mocked HBM
+    admits the config as-is; a tight one forces a labeled downshift whose
+    admitted shape fits its budget."""
+    from kserve_vllm_mini_tpu.models.config import get_config
+
+    v5e_hbm = dict(HBM_BYTES_BY_KIND)["v5e"]
+    fits = serving_headroom_plan("llama-tiny", 80, 512, "int8", False,
+                                 capacity_bytes=v5e_hbm)
+    assert fits.fits and fits.downshifted is None
+    base = estimate_serving_bytes(
+        get_config("llama-tiny", max_seq_len=512), 80, 512, quant="int8",
+    )["total_bytes"]
+    tight = serving_headroom_plan("llama-tiny", 80, 512, "int8", False,
+                                  capacity_bytes=base // 2)
+    assert tight.downshifted and tight.slots < 80
+    assert tight.estimate_bytes <= tight.budget_bytes
+    d = tight.to_dict()
+    assert d["downshifted"].startswith("downshifted: ")
+
+
+def test_estimate_monotonic_in_slots_and_ctx():
+    from kserve_vllm_mini_tpu.models.config import get_config
+
+    cfg = get_config("llama-tiny", max_seq_len=1024)
+    e = lambda s, c: estimate_serving_bytes(cfg, s, c)["total_bytes"]  # noqa: E731
+    assert e(8, 256) < e(16, 256) < e(16, 512) < e(32, 1024)
+
+
+# -- proxy block validator ----------------------------------------------------
+
+def _good_proxy():
+    return {
+        "series": "proxy", "platform": "cpu", "n_devices": 8,
+        "flops": 1e9, "bytes_accessed": 2e9, "compile_wall_s": 1.5,
+        "peak_bytes": 3e9, "step_count_ratio": 1.2,
+        "compile_stats": {}, "exec": {},
+    }
+
+
+def test_validate_proxy_accepts_good_block():
+    assert validate_proxy(_good_proxy()) == []
+
+
+@pytest.mark.parametrize("mutate,fragment", [
+    (lambda d: d.pop("series"), "series"),
+    (lambda d: d.update(series="real"), "series"),
+    (lambda d: d.pop("flops"), "flops"),
+    (lambda d: d.update(compile_wall_s=0), "compile_wall_s"),
+    (lambda d: d.update(step_count_ratio=-1), "step_count_ratio"),
+    (lambda d: d.update(n_devices=0), "n_devices"),
+    (lambda d: d.update(exec="nope"), "exec"),
+])
+def test_validate_proxy_rejects(mutate, fragment):
+    doc = _good_proxy()
+    mutate(doc)
+    errs = validate_proxy(doc)
+    assert errs and any(fragment in e for e in errs), errs
+
+
+def test_validate_proxy_rejects_non_object():
+    assert validate_proxy(None) == ["proxy block is not an object"]
+
+
+# -- engine surface -----------------------------------------------------------
+
+def test_engine_accumulates_compile_stats():
+    """End-to-end: a tiny engine run records its prefill/decode compiles
+    with labels, snapshot_stats carries the /metrics keys, and the
+    compile_stats_snapshot block is results.json-shaped."""
+    from kserve_vllm_mini_tpu.models.config import get_config
+    from kserve_vllm_mini_tpu.models.llama import init_params
+    from kserve_vllm_mini_tpu.runtime.engine import (
+        Engine,
+        EngineConfig,
+        GenRequest,
+    )
+
+    cfg = get_config("llama-tiny", max_seq_len=128)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    eng = Engine(params, cfg,
+                 EngineConfig(max_slots=2, max_seq_len=128, max_prefill_len=64))
+    eng.start()
+    try:
+        h = eng.submit(GenRequest(prompt_tokens=[1, 2, 3], max_new_tokens=4))
+        while True:
+            ev = h.events.get(timeout=60)
+            if ev[0] == "done":
+                break
+        s = eng.snapshot_stats()
+        for key in ("compiles", "compile_s", "compiled_flops",
+                    "compiled_bytes", "compile_peak_bytes"):
+            assert key in s, key
+        assert s["compiles"] >= 2  # one prefill bucket + one decode chunk
+        assert s["compile_s"] > 0 and s["compiled_flops"] > 0
+        block = eng.compile_stats_snapshot()
+        assert block["compiles"] == s["compiles"]
+        labels = [e["label"] for e in block["executables"]]
+        assert any(lab.startswith("prefill[") for lab in labels)
+        assert any(lab.startswith("decode[") for lab in labels)
+    finally:
+        eng.stop()
+
+
+def test_engine_compile_stats_can_be_disabled():
+    from kserve_vllm_mini_tpu.models.config import get_config
+    from kserve_vllm_mini_tpu.models.llama import init_params
+    from kserve_vllm_mini_tpu.runtime.engine import Engine, EngineConfig
+
+    cfg = get_config("llama-tiny", max_seq_len=128)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    eng = Engine(params, cfg,
+                 EngineConfig(max_slots=2, max_seq_len=128,
+                              max_prefill_len=64, compile_stats=False))
+    fn = eng._get_prefill_fn(16)
+    assert not isinstance(fn, InstrumentedJit)
+    assert eng.snapshot_stats()["compiles"] == 0
