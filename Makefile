@@ -28,15 +28,16 @@ lint:
 	$(PY) -c "import yaml,glob;[list(yaml.safe_load_all(open(f))) for f in glob.glob('profiles/**/*.yaml',recursive=True)+glob.glob('policies/**/*.yaml',recursive=True)]"
 	$(PY) -c "import json,glob;[json.load(open(f)) for f in glob.glob('dashboards/*.json')]"
 
-lint-invariants:  ## kvmini-lint: jit purity, lockstep, metrics drift, thread safety
+lint-invariants:  ## kvmini-lint: jit purity, lockstep, metrics drift, thread safety, dtype flow, buffer lifecycle
 	# gates on lint-baseline.json: new findings fail, fixed-but-still-
 	# listed entries fail too (ratchet toward an empty baseline).
-	# Rule table: docs/LINTING.md. JAX-free; runs in ~6s. --timing prints
+	# Rule table: docs/LINTING.md. JAX-free; runs in ~9s. --timing prints
 	# per-checker wall time so a budget regression names its checker;
 	# --timing-out writes the same report as the lint-timing.json
-	# artifact CI uploads — one run gates AND reports.
+	# artifact CI uploads; --sarif writes the code-scanning doc CI
+	# uploads as PR annotations — one run gates AND reports.
 	$(PY) -m kserve_vllm_mini_tpu.lint kserve_vllm_mini_tpu/ --timing \
-	  --timing-out lint-timing.json
+	  --timing-out lint-timing.json --sarif lint-results.sarif
 
 fmt:
 	$(PY) -m ruff format kserve_vllm_mini_tpu tests 2>/dev/null || true

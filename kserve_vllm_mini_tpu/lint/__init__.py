@@ -1,7 +1,7 @@
 """kvmini-lint — AST-based invariant checker for the repo's load-bearing
 conventions (docs/LINTING.md "Conventions kvmini-lint enforces").
 
-Five checkers, all stdlib-``ast`` over a small cross-file fact index —
+Seven checkers, all stdlib-``ast`` over a small cross-file fact index —
 deliberately JAX-free so the lint gate runs anywhere the harness layers
 do (same contract as loadgen/analysis: no ``runtime`` extra required):
 
@@ -28,6 +28,17 @@ do (same contract as loadgen/analysis: no ``runtime`` extra required):
   state, lock-order cycle detection, unbounded wait/join, and raw
   mutable-container publication across the thread boundary
   (lint/concurrency.py).
+- **numerics / dtype flow** (KVM061-KVM065): an abstract interpretation
+  over dtypes ("the dtype-flow lattice", docs/LINTING.md) flags silent
+  bf16×f32 upcasts on jit hot paths, dequantization that drops the
+  scale/zero-point compensation contract, sub-byte bitcasts and
+  materialized int4 leaves, integer dots without an accumulator dtype,
+  and low-precision accumulations (lint/dtype_flow.py).
+- **buffer lifecycle** (KVM071-KVM074): donation discipline (donated
+  args read after dispatch, cache carries that should donate) and
+  paged-KV block lifecycle (double-free, use-after-free, retained-LRU
+  claims without unpin) with suite-aware, exit-cancelling event
+  ordering (lint/buffer_lifecycle.py).
 
 CLI: ``python -m kserve_vllm_mini_tpu.lint [paths...]`` — see __main__.py.
 Suppressions: ``# kvmini: <token>`` line comments (diagnostics.RULES maps

@@ -18,6 +18,7 @@ import time
 from pathlib import Path
 
 from kserve_vllm_mini_tpu.lint import baseline as baseline_mod
+from kserve_vllm_mini_tpu.lint import sarif as sarif_mod
 from kserve_vllm_mini_tpu.lint.runner import normalize_families, run_lint
 
 
@@ -26,8 +27,9 @@ def main(argv: list[str] | None = None) -> int:
         prog="python -m kserve_vllm_mini_tpu.lint",
         description="kvmini-lint: AST invariant checker (jit purity, "
                     "lockstep determinism, metrics/schema drift, workload "
-                    "surfacing, thread-safety/lock discipline). See "
-                    "docs/LINTING.md for the rule table.",
+                    "surfacing, thread-safety/lock discipline, dtype-flow "
+                    "numerics, buffer lifecycle). See docs/LINTING.md for "
+                    "the rule table.",
     )
     ap.add_argument("paths", nargs="*", type=Path,
                     help="files/dirs to lint (default: kserve_vllm_mini_tpu/)")
@@ -45,6 +47,11 @@ def main(argv: list[str] | None = None) -> int:
                     help="also write the timing report as JSON to FILE — "
                          "lets CI upload the artifact from the SAME run "
                          "that gated, instead of linting twice")
+    ap.add_argument("--sarif", type=Path, default=None, metavar="FILE",
+                    help="also write findings as SARIF 2.1.0 to FILE "
+                         "(GitHub code-scanning annotations; severity "
+                         "mapped from the rule family, suppressed "
+                         "findings omitted)")
     ap.add_argument("--docs", type=Path, action="append", default=None,
                     help="extra docs/dashboards surfaces for the drift "
                          "checker (default: ./docs, ./dashboards if present)")
@@ -89,6 +96,9 @@ def main(argv: list[str] | None = None) -> int:
     result = run_lint(paths, doc_paths=docs, baseline_path=baseline_path,
                       families=families)
     dt = time.monotonic() - t0
+
+    if args.sarif is not None:
+        sarif_mod.save(args.sarif, result.diagnostics)
 
     if args.timing_out is not None:
         args.timing_out.write_text(json.dumps({

@@ -1,0 +1,435 @@
+"""KVM071-KVM074 — donation/aliasing discipline and paged-KV block lifecycle.
+
+Two buffer ownership models meet in the runtime, and both have a
+"surrendered but still referenced" failure mode the type system can't see:
+
+- **XLA donation** (``donate_argnums``): a donated operand's device buffer
+  is handed to the compiled program, which may write outputs into it in
+  place. The Python reference that was passed still exists — reading it
+  after dispatch observes undefined contents (or deadlocks a pending
+  transfer). KVM071 flags reads of a donated argument after the dispatch
+  callsite (rebinding the name to the call's result is the legal pattern:
+  ``cache, logits = step(params, cache, ...)``). KVM072 flags the inverse
+  omission: a jit root that *threads* a cache-like buffer (param in,
+  updated value out) without donating it — both generations stay resident
+  and steady-state HBM doubles (the engine's donated-decode-state
+  convention, runtime/engine.py module docstring).
+- **Paged-KV block ids** (``Engine._paged_*``): integer block ids move
+  between the free list, per-slot block tables, and the retained
+  (content-addressed, evictable) LRU. KVM073 flags a block id freed twice
+  or used as an index after it went back to the free list — the id may
+  already belong to another request, so a stale write corrupts *their* KV.
+  KVM074 flags bumping a block's refcount while the retained LRU is in
+  play without popping the block out of the LRU — eviction scans the LRU
+  and would reap a block in active use.
+
+Donation facts come from the shared FactIndex (decorator, ``partial``,
+``jax.jit(fn, ...)`` wrap — including roots handed out by getter
+functions, the engine's ``_get_*_fn`` idiom). Ordering is *suite-aware
+lexical*: node A is "after" node B only when both sit under a common
+statement suite and A's statement index is strictly greater — sibling
+``if``/``elif`` branches are unordered (mutually exclusive), and an exit
+statement (``return``/``raise``/``continue``/``break``) between the two
+events cancels the pair (the freeing path never reaches the use). Code
+the checker cannot order is never flagged — misses over false alarms,
+like every kvmini-lint family.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, Optional, Union
+
+from kserve_vllm_mini_tpu.lint.diagnostics import Diagnostic
+from kserve_vllm_mini_tpu.lint.facts import (
+    FactIndex,
+    FunctionInfo,
+    ModuleFacts,
+    iter_scope,
+)
+
+BUFFERISH = re.compile(r"cache|kv|buf", re.IGNORECASE)
+FREELIST = re.compile(r"^_?free(_blocks|_list|_slots|list)?$")
+RC_NAME = re.compile(r"(^|_)(block_)?rc$|refcount")
+RETAINED = re.compile(r"retained")
+
+# a donated-arg token: a bare name, or ("self", attr)
+Token = Union[str, tuple[str, str]]
+
+
+def _token_of(node: ast.AST) -> Optional[Token]:
+    if isinstance(node, ast.Name):
+        return node.id
+    if (isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return ("self", node.attr)
+    return None
+
+
+def _token_events(fn_node: ast.AST, token: Token,
+                  skip: set[int]) -> tuple[list[ast.AST], list[ast.AST]]:
+    """(load nodes, store nodes) of `token` in the function scope,
+    skipping nodes whose id() is in `skip` (the dispatch call subtree)."""
+    loads: list[ast.AST] = []
+    stores: list[ast.AST] = []
+    for n in iter_scope(fn_node):
+        if id(n) in skip:
+            continue
+        if isinstance(token, str):
+            hit = isinstance(n, ast.Name) and n.id == token
+        else:
+            hit = (isinstance(n, ast.Attribute) and n.attr == token[1]
+                   and isinstance(n.value, ast.Name) and n.value.id == "self")
+        if not hit:
+            continue
+        if isinstance(n.ctx, ast.Store):
+            stores.append(n)
+        elif isinstance(n.ctx, ast.Load):
+            loads.append(n)
+    return loads, stores
+
+
+Path = tuple[tuple[int, int], ...]  # ((suite id, stmt index), ...)
+
+
+def _positions(fn_node: ast.AST) -> dict[int, Path]:
+    """id(node) -> position path: one (suite id, statement index) entry per
+    enclosing statement suite, innermost last. Two nodes are lexically
+    ordered iff their paths agree up to some suite and differ in index
+    there; sibling branches of one statement share every path entry and
+    are therefore unordered. Nested def/class bodies are skipped (they run
+    at another time, like iter_scope)."""
+    pos: dict[int, Path] = {}
+
+    def visit(node: ast.AST, path: Path) -> None:
+        pos[id(node)] = path
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)) and node is not fn_node:
+            return
+        for _field, value in ast.iter_fields(node):
+            if isinstance(value, list):
+                stmts = [v for v in value if isinstance(v, ast.stmt)]
+                if stmts and len(stmts) == len(value):
+                    for i, child in enumerate(value):
+                        visit(child, path + ((id(value), i),))
+                else:
+                    for child in value:
+                        if isinstance(child, ast.AST):
+                            visit(child, path)
+            elif isinstance(value, ast.AST):
+                visit(value, path)
+
+    visit(fn_node, ())
+    return pos
+
+
+def _after(pos: dict[int, Path], a: ast.AST, b: ast.AST) -> bool:
+    """Does `a` execute strictly after `b` (same-suite lexical order)?"""
+    pa, pb = pos.get(id(a)), pos.get(id(b))
+    if pa is None or pb is None:
+        return False
+    for (sa, ia), (sb, ib) in zip(pa, pb):
+        if sa != sb:
+            return False  # sibling branches: unordered
+        if ia != ib:
+            return ia > ib
+    return False  # one contains the other (or same statement)
+
+
+def _exit_between(pos: dict[int, Path], exits: list[ast.AST],
+                  first: ast.AST, later: ast.AST) -> bool:
+    """An exit statement strictly between the two events means the path
+    that executed `first` never reaches `later`."""
+    return any(_after(pos, x, first) and _after(pos, later, x)
+               for x in exits)
+
+
+def _exits(fn_node: ast.AST) -> list[ast.AST]:
+    return [n for n in iter_scope(fn_node)
+            if isinstance(n, (ast.Return, ast.Raise, ast.Continue, ast.Break))]
+
+
+class BufferLifecycleChecker:
+    def __init__(self, index: FactIndex):
+        self.index = index
+        self.diags: list[Diagnostic] = []
+
+    def run(self) -> list[Diagnostic]:
+        for mod in self.index.modules.values():
+            for fn in mod.functions.values():
+                self._check_donated_reads(mod, fn)
+                if fn.jit_root:
+                    self._check_undonated_carry(mod, fn)
+                self._check_block_lifecycle(mod, fn)
+                self._check_retained_claim(mod, fn)
+        return self.diags
+
+    def _emit(self, mod: ModuleFacts, node: ast.AST, code: str, msg: str,
+              context: str) -> None:
+        line = getattr(node, "lineno", 0)
+        if mod.suppressions.is_suppressed(line, code):
+            return
+        self.diags.append(Diagnostic(mod.path, line, code, msg, context=context))
+
+    # -- KVM071: donated argument read after dispatch ----------------------
+    def _jit_roots_for_call(self, mod: ModuleFacts, fn: FunctionInfo,
+                            call: ast.Call,
+                            callees: list[FunctionInfo]) -> list[FunctionInfo]:
+        roots = [c for c in callees if c.jit_root]
+        f = call.func
+        if isinstance(f, ast.Name):
+            # step = self._get_step_fn(...); step(...): the local alias
+            # binds a getter call whose returned jit roots we know
+            fi: Optional[FunctionInfo] = fn
+            while fi is not None:
+                for aliased in fi.local_aliases.get(f.id, []):
+                    if isinstance(aliased, ast.Call):
+                        for g in self.index._resolve_expr(
+                                mod, fi, aliased.func):
+                            roots += g.returned_jit_roots
+                fi = fi.parent
+        return roots
+
+    def _check_donated_reads(self, mod: ModuleFacts, fn: FunctionInfo) -> None:
+        pos: Optional[dict[int, Path]] = None
+        exits: list[ast.AST] = []
+        for cs in self.index.call_sites(mod, fn):
+            node = cs.node
+            for root in self._jit_roots_for_call(mod, fn, node, cs.callees):
+                if not (root.donated_argnums or root.donated_argnames):
+                    continue
+                if pos is None:
+                    pos = _positions(fn.node)
+                    exits = _exits(fn.node)
+                offset = 1 if root.params[:1] in (["self"], ["cls"]) and (
+                    isinstance(node.func, ast.Attribute)) else 0
+                donated: list[ast.AST] = []
+                for p in root.donated_argnums:
+                    ai = p - offset
+                    if 0 <= ai < len(node.args):
+                        donated.append(node.args[ai])
+                for kw in node.keywords:
+                    if kw.arg in root.donated_argnames:
+                        donated.append(kw.value)
+                skip = {id(n) for n in ast.walk(node)}
+                for arg in donated:
+                    token = _token_of(arg)
+                    if token is None:
+                        continue
+                    loads, stores = _token_events(fn.node, token, skip)
+                    for read in sorted(loads, key=lambda n: n.lineno):
+                        if not _after(pos, read, node):
+                            continue
+                        # a rebind at/after dispatch that isn't after the
+                        # read legalizes it (`cache, y = step(params,
+                        # cache)` rebinding in the dispatch stmt included)
+                        if any(not _after(pos, node, s)
+                               and not _after(pos, s, read)
+                               for s in stores):
+                            continue
+                        if _exit_between(pos, exits, node, read):
+                            continue
+                        label = (token if isinstance(token, str)
+                                 else f"self.{token[1]}")
+                        self._emit(
+                            mod, node, "KVM071",
+                            f"`{label}` is donated to `{root.name}` here "
+                            f"but read again on line {read.lineno} — the "
+                            "buffer was surrendered to XLA (contents "
+                            "undefined after dispatch); rebind it to the "
+                            "call's result, or mark `# kvmini: buffer-ok`",
+                            fn.qualname)
+                        break
+
+    # -- KVM072: buffer threaded through a root without donation ----------
+    def _check_undonated_carry(self, mod: ModuleFacts,
+                               fn: FunctionInfo) -> None:
+        for idx, p in enumerate(fn.params):
+            if not BUFFERISH.search(p):
+                continue
+            if idx in fn.donated_argnums or p in fn.donated_argnames:
+                continue
+            derived = {p}
+            for _ in range(3):
+                grew = False
+                for node in iter_scope(fn.node):
+                    if not isinstance(node, ast.Assign):
+                        continue
+                    if not any(isinstance(n, ast.Name) and n.id in derived
+                               and isinstance(n.ctx, ast.Load)
+                               for n in ast.walk(node.value)):
+                        continue
+                    for tgt in node.targets:
+                        for t in ast.walk(tgt):
+                            if (isinstance(t, ast.Name)
+                                    and BUFFERISH.search(t.id)
+                                    and t.id not in derived):
+                                derived.add(t.id)
+                                grew = True
+                if not grew:
+                    break
+            for node in iter_scope(fn.node):
+                if not (isinstance(node, ast.Return)
+                        and node.value is not None):
+                    continue
+                hit = next((n.id for n in ast.walk(node.value)
+                            if isinstance(n, ast.Name) and n.id in derived),
+                           None)
+                if hit is not None:
+                    self._emit(
+                        mod, node, "KVM072",
+                        f"jit root `{fn.name}` returns updated buffer "
+                        f"`{hit}` but does not donate param `{p}` — both "
+                        "generations stay resident (steady-state HBM "
+                        "doubles); add donate_argnums, or mark "
+                        "`# kvmini: buffer-ok`", fn.qualname)
+                    break
+            else:
+                continue
+            break
+
+    # -- KVM073: free-list double-free / use-after-free --------------------
+    @staticmethod
+    def _free_event(stmt: ast.AST) -> Iterable[tuple[str, ast.Call]]:
+        """(freed bare-name, call node) for `<freelist>.append(x)` sites."""
+        for n in ast.walk(stmt):
+            if not (isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and n.func.attr == "append"
+                    and len(n.args) == 1
+                    and isinstance(n.args[0], ast.Name)):
+                continue
+            base = n.func.value
+            base_name = (base.attr if isinstance(base, ast.Attribute)
+                         else base.id if isinstance(base, ast.Name) else "")
+            if FREELIST.match(base_name):
+                yield n.args[0].id, n
+
+    def _check_block_lifecycle(self, mod: ModuleFacts,
+                               fn: FunctionInfo) -> None:
+        # one cheap pre-scan: almost no function frees blocks, and the
+        # suite machinery below re-walks each nesting level
+        if not any(True for _ in self._free_event(fn.node)):
+            return
+        pos = _positions(fn.node)
+        exits = _exits(fn.node)
+        for suite in self._suites(fn.node):
+            # freed name -> the free call (first wins); cleared on rebind
+            freed: dict[str, ast.Call] = {}
+            for stmt in suite:
+                for name, call in self._free_event(stmt):
+                    first = freed.get(name)
+                    if first is not None:
+                        if not _exit_between(pos, exits, first, call):
+                            self._emit(
+                                mod, call, "KVM073",
+                                f"block id `{name}` freed twice — the "
+                                "first free already returned it to the "
+                                "pool (another request may own it now); "
+                                "drop this one, or mark "
+                                "`# kvmini: buffer-ok`", fn.qualname)
+                    else:
+                        self._use_after_free_scan(mod, fn, suite, stmt,
+                                                  name, call, pos, exits)
+                        freed[name] = call
+                for n in ast.walk(stmt):
+                    if (isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store)
+                            and n.id in freed
+                            and n.lineno > freed[n.id].lineno):
+                        freed.pop(n.id, None)
+
+    def _use_after_free_scan(self, mod: ModuleFacts, fn: FunctionInfo,
+                             suite: list[ast.AST], free_stmt: ast.AST,
+                             name: str, call: ast.Call,
+                             pos: dict[int, Path],
+                             exits: list[ast.AST]) -> None:
+        """Flag `table[<name>]`-style index uses in later sibling stmts."""
+        started = False
+        for stmt in suite:
+            if stmt is free_stmt:
+                started = True
+                continue
+            if not started:
+                continue
+            for n in ast.walk(stmt):
+                if (isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store)
+                        and n.id == name):
+                    return  # rebound: new block id, tracking ends
+                if (isinstance(n, ast.Subscript)
+                        and any(isinstance(s, ast.Name) and s.id == name
+                                for s in ast.walk(n.slice))):
+                    if _exit_between(pos, exits, call, n):
+                        # the freeing path returns/raises before this use
+                        # (early-error cleanup followed by the happy path)
+                        return
+                    self._emit(
+                        mod, n, "KVM073",
+                        f"block id `{name}` used as an index after being "
+                        f"freed on line {call.lineno} — the id may already "
+                        "belong to another request (stale write corrupts "
+                        "their KV); use it before freeing, or mark "
+                        "`# kvmini: buffer-ok`", fn.qualname)
+                    return
+
+    @staticmethod
+    def _suites(fn_node: ast.AST) -> Iterable[list[ast.AST]]:
+        """Every statement suite (ordered sibling list) in the function."""
+        stack = [fn_node]
+        while stack:
+            n = stack.pop()
+            for field in ("body", "orelse", "finalbody"):
+                suite = getattr(n, field, None)
+                if isinstance(suite, list) and suite:
+                    yield suite
+            for c in ast.iter_child_nodes(n):
+                if not isinstance(c, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.ClassDef)):
+                    stack.append(c)
+
+    # -- KVM074: retained-LRU claim without unpin --------------------------
+    def _check_retained_claim(self, mod: ModuleFacts,
+                              fn: FunctionInfo) -> None:
+        touches_retained = False
+        unpins = False
+        claims: list[ast.AST] = []
+        for node in iter_scope(fn.node):
+            if isinstance(node, ast.Attribute) and RETAINED.search(node.attr):
+                touches_retained = True
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in {"pop", "popitem"}):
+                base = node.func.value
+                if (isinstance(base, ast.Attribute)
+                        and RETAINED.search(base.attr)):
+                    unpins = True
+            is_claim = False
+            if (isinstance(node, ast.Assign)
+                    and isinstance(node.targets[0], ast.Subscript)
+                    and any(isinstance(b, ast.BinOp)
+                            and isinstance(b.op, ast.Add)
+                            for b in ast.walk(node.value))):
+                tgt = node.targets[0].value
+                is_claim = isinstance(tgt, ast.Attribute) and bool(
+                    RC_NAME.search(tgt.attr))
+            elif (isinstance(node, ast.AugAssign)
+                    and isinstance(node.op, ast.Add)
+                    and isinstance(node.target, ast.Subscript)):
+                tgt = node.target.value
+                is_claim = isinstance(tgt, ast.Attribute) and bool(
+                    RC_NAME.search(tgt.attr))
+            if is_claim:
+                claims.append(node)
+        if touches_retained and claims and not unpins:
+            for node in claims:
+                self._emit(
+                    mod, node, "KVM074",
+                    f"refcount bumped in `{fn.name}` while the retained "
+                    "LRU is in play, but the block is never popped from "
+                    "the LRU — eviction can reap a block in active use; "
+                    "pop it when claiming, or mark `# kvmini: buffer-ok`",
+                    fn.qualname)
+
+
+def check(index: FactIndex) -> list[Diagnostic]:
+    return BufferLifecycleChecker(index).run()

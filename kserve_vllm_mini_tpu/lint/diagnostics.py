@@ -75,6 +75,35 @@ RULES: dict[str, Rule] = {
         Rule("KVM055", "shared-mutable-publication", "thread-ok",
              "mutable container handed across the thread boundary without "
              "snapshot (list()/dict() copy) — iteration races mutation"),
+        Rule("KVM061", "mixed-precision-arith", "dtype-ok",
+             "arithmetic silently mixing bf16/f16 with f32/f64 on a jit "
+             "hot path (implicit upcast doubles the operand's HBM cost)"),
+        Rule("KVM062", "dequant-drops-compensation", "dtype-ok",
+             "dequantization applies the scale but never reads, tests, or "
+             "writes the leaf's compensation key (zero-point 'z' / AWQ 'a')"),
+        Rule("KVM063", "sub-byte-bitcast", "dtype-ok",
+             "sub-byte dtype (int4/uint4) via bitcast_convert_type or as a "
+             "materialized leaf — byte-shaped at abstract eval, relayout "
+             "recursion at dispatch; unpack arithmetically instead"),
+        Rule("KVM064", "int-dot-accum-dtype", "dtype-ok",
+             "integer-dtype dot/matmul without preferred_element_type — "
+             "the accumulator inherits the narrow input dtype and wraps"),
+        Rule("KVM065", "low-precision-accumulation", "dtype-ok",
+             "softmax/mean/variance family reduction over a bf16/f16 value "
+             "— accumulate in f32 (astype before, astype back after)"),
+        Rule("KVM071", "donated-buffer-read", "buffer-ok",
+             "argument donated to a jitted call is read after dispatch "
+             "(the buffer was surrendered to XLA; contents undefined)"),
+        Rule("KVM072", "undonated-buffer-carry", "buffer-ok",
+             "jit root threads a cache/KV buffer through (param in, "
+             "updated value out) without donating it — both copies stay "
+             "resident and HBM doubles"),
+        Rule("KVM073", "kv-block-lifecycle", "buffer-ok",
+             "KV block id freed twice, or used after it went back to the "
+             "free list (another request may already own it)"),
+        Rule("KVM074", "retained-claim-no-unpin", "buffer-ok",
+             "retained-LRU block claimed (refcount bumped) without popping "
+             "it from the LRU — eviction can reap a block in active use"),
     ]
 }
 

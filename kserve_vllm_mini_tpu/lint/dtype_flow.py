@@ -1,0 +1,574 @@
+"""KVM061-KVM065 — numerics / dtype-flow analysis.
+
+A small abstract interpretation over dtypes ("the dtype-flow lattice",
+docs/LINTING.md): every expression is mapped to an abstract dtype —
+``bf16/f16/f32/f64``, the integer widths, ``bool``, the *weak* Python
+literal kinds (which adapt to the other operand and never widen, JAX's
+weak-type rule), or **unknown** (the lattice top). Facts only ever come
+from places the programmer wrote a dtype down:
+
+- ``x.astype(D)``, ``jnp.asarray(x, D)``, ``dtype=D`` keyword/positional
+  slots on the array constructors (``zeros/ones/full/arange/*_like``);
+- the quant-leaf key contract (ops/quant.py): ``leaf["s"]``/``leaf["a"]``
+  and the int8-KV ``"k_s"``/``"v_s"`` scales are f32 per-channel arrays;
+- dtype-preserving ops propagate their input (elementwise math, reshapes,
+  reductions, ``where``/``maximum`` join their branches);
+- cross-function rounds: a param's dtype is the join of every resolved
+  callsite's argument dtype (conflicts join to unknown), and a call
+  expression takes the callee's joined return dtype.
+
+**Unknown never fires a rule** — every diagnostic requires the operands'
+dtypes to be *provable* from the source, so the checker under-approximates
+(misses) rather than guesses (false alarms).
+
+Rules:
+
+- **KVM061**: arithmetic mixing two different known float widths on a jit
+  hot path (a jit root or anything reachable from one through the call
+  graph). ``bf16_act * f32_scale`` silently upcasts the whole activation
+  tensor to f32 — 2x the bytes on the MXU path, and the op no longer
+  computes what the bf16 serving contract promises. Cast the narrow side
+  up explicitly (KVM065's accumulation rule) or the wide side down.
+- **KVM062**: a consumer that reads both ``"q"`` and ``"s"`` from a quant
+  leaf but never reads, membership-tests, or writes a compensation key
+  (``"z"`` zero-point / ``"a"`` AWQ input-scale) — dequantization that
+  applies the scale and silently drops the offset term. Builders (functions
+  that *write* quant keys) are exempt.
+- **KVM063**: sub-byte dtypes (int4/uint4) via ``lax.bitcast_convert_type``
+  or materialized as array leaves. The sub-byte bitcast keeps the byte
+  shape at abstract eval (no trailing nibble axis — the downstream widen
+  reshape is a width mismatch), and an S4 leaf at a dispatch boundary
+  recurses into relayout (ops/quant.py). Unpack arithmetically.
+- **KVM064**: a dot/matmul whose operand is a known narrow integer dtype
+  without ``preferred_element_type`` — the accumulator inherits int8 and
+  wraps. The ``@`` operator cannot pass it; use ``lax.dot_general``.
+- **KVM065**: softmax-family / mean / variance reductions over a value
+  proven bf16/f16 — accumulate in f32 (``x.astype(jnp.float32)`` in,
+  cast back out), the logits/rmsnorm convention models/llama.py follows.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from kserve_vllm_mini_tpu.lint.diagnostics import Diagnostic
+from kserve_vllm_mini_tpu.lint.facts import (
+    FactIndex,
+    FunctionInfo,
+    ModuleFacts,
+    _last_attr,
+    iter_scope,
+)
+
+# -- lattice values -----------------------------------------------------------
+BF16, F16, F32, F64 = "bf16", "f16", "f32", "f64"
+I4, U4, I8, U8 = "int4", "uint4", "int8", "uint8"
+I16, I32, I64 = "int16", "int32", "int64"
+BOOL = "bool"
+WEAK_F, WEAK_I = "weak_float", "weak_int"
+
+FLOAT_RANK = {F16: 1, BF16: 1, F32: 2, F64: 3}
+INT_RANK = {I4: 0, U4: 0, U8: 1, I8: 1, I16: 2, I32: 3, I64: 4}
+SUB_BYTE = {I4, U4}
+NARROW_INT = {I4, U4, I8, U8}
+
+DTYPE_TOKENS = {
+    "bfloat16": BF16, "float16": F16, "half": F16,
+    "float32": F32, "single": F32, "float64": F64, "double": F64,
+    "int4": I4, "uint4": U4, "int8": I8, "uint8": U8,
+    "int16": I16, "int32": I32, "int64": I64, "bool_": BOOL,
+}
+
+# quant-leaf / int8-KV key contract (ops/quant.py, models/llama.py):
+# per-channel scales are f32 arrays wherever they appear
+SCALE_KEY_DTYPES = {"s": F32, "a": F32, "k_s": F32, "v_s": F32}
+
+QUANT_COMPENSATION_KEYS = {"z", "a"}
+
+# dtype-preserving ops: result carries the first array argument's dtype
+PRESERVE_FIRST = {
+    "exp", "exp2", "log", "log2", "sqrt", "rsqrt", "abs", "square",
+    "negative", "transpose", "reshape", "squeeze", "ravel", "expand_dims",
+    "broadcast_to", "roll", "flip", "tile", "pad", "swapaxes", "moveaxis",
+    "copy", "sum", "mean", "max", "min", "prod", "cumsum", "var", "std",
+    "round", "floor", "ceil", "clip", "tanh", "sigmoid", "relu", "gelu",
+    "silu", "softmax", "log_softmax", "logsumexp", "take",
+    "take_along_axis", "sort", "flatten", "at",
+}
+# ops joining several array args (branch/elementwise merge)
+JOIN_ARGS = {"where", "maximum", "minimum", "stack", "concatenate", "add",
+             "subtract", "multiply", "divide", "dot", "matmul"}
+
+ARITH_OPS = (ast.Add, ast.Sub, ast.Mult, ast.Div, ast.FloorDiv, ast.Mod,
+             ast.Pow)
+DOT_CALL_NAMES = {"dot", "matmul", "tensordot", "dot_general", "einsum"}
+ACCUM_CALL_NAMES = {"softmax", "log_softmax", "logsumexp", "mean", "var",
+                    "std"}
+
+
+def promote(a: Optional[str], b: Optional[str]) -> Optional[str]:
+    """Binary-op result dtype; None (unknown) is absorbing."""
+    if a is None or b is None:
+        return None
+    if a == b:
+        return a
+    if WEAK_F in (a, b):
+        other = b if a == WEAK_F else a
+        if other in FLOAT_RANK or other == WEAK_F:
+            return other
+        if other == WEAK_I:
+            return WEAK_F
+        return None  # weak float with an int array: backend default float
+    if WEAK_I in (a, b):
+        return b if a == WEAK_I else a
+    if a == BOOL:
+        return b
+    if b == BOOL:
+        return a
+    if a in FLOAT_RANK and b in FLOAT_RANK:
+        return a if FLOAT_RANK[a] >= FLOAT_RANK[b] else b
+    if a in INT_RANK and b in INT_RANK:
+        return a if INT_RANK[a] >= INT_RANK[b] else b
+    if a in FLOAT_RANK and b in INT_RANK:
+        return a
+    if b in FLOAT_RANK and a in INT_RANK:
+        return b
+    return None
+
+
+def join(a: Optional[str], b: Optional[str]) -> Optional[str]:
+    """Path/callsite merge: agree or give up (no promotion — a param fed
+    bf16 at one site and f32 at another has no single provable dtype)."""
+    return a if a == b else None
+
+
+def dtype_literal(node: ast.AST) -> Optional[str]:
+    """`jnp.bfloat16` / `np.float32` / `"bfloat16"` -> lattice value."""
+    if isinstance(node, ast.Attribute):
+        return DTYPE_TOKENS.get(node.attr)
+    if isinstance(node, ast.Name):
+        return DTYPE_TOKENS.get(node.id)
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return DTYPE_TOKENS.get(node.value)
+    return None
+
+
+def _dtype_arg(call: ast.Call, pos: Optional[int]) -> Optional[ast.AST]:
+    """The expression in a constructor's dtype slot (kw wins, then pos)."""
+    for kw in call.keywords:
+        if kw.arg == "dtype":
+            return kw.value
+    if pos is not None and len(call.args) > pos:
+        return call.args[pos]
+    return None
+
+
+def _has_kwarg(call: ast.Call, name: str) -> bool:
+    return any(kw.arg == name for kw in call.keywords)
+
+
+class _ScopeNodes:
+    """One iter_scope walk per function, bucketed by what the passes need
+    (env.run is re-run every propagation round — re-walking the AST each
+    time dominated the checker's wall time)."""
+
+    __slots__ = ("stmts", "returns", "checks")
+
+    def __init__(self, fn_node: ast.AST):
+        self.stmts: list[ast.AST] = []
+        self.returns: list[ast.Return] = []
+        self.checks: list[ast.AST] = []
+        for node in iter_scope(fn_node):
+            if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign,
+                                 ast.For, ast.AsyncFor)):
+                self.stmts.append(node)
+            elif isinstance(node, ast.Return):
+                self.returns.append(node)
+            if isinstance(node, (ast.BinOp, ast.Call)):
+                self.checks.append(node)
+
+
+class _DtypeEnv:
+    """Per-function name -> abstract dtype, seeded from param dtypes."""
+
+    def __init__(self, checker: "DtypeFlowChecker", mod: ModuleFacts,
+                 fn: FunctionInfo):
+        self.c = checker
+        self.mod = mod
+        self.fn = fn
+        self.scope = checker.scope_nodes(fn)
+        self.names: dict[str, Optional[str]] = dict(
+            checker.param_dtypes.get(fn.key(), {}))
+
+    # -- expression transfer function ------------------------------------
+    def expr(self, node: ast.AST) -> Optional[str]:
+        if isinstance(node, ast.Name):
+            return self.names.get(node.id)
+        if isinstance(node, ast.Constant):
+            if isinstance(node.value, bool):
+                return BOOL
+            if isinstance(node.value, float):
+                return WEAK_F
+            if isinstance(node.value, int):
+                return WEAK_I
+            return None
+        if isinstance(node, (ast.Compare, ast.BoolOp)):
+            return BOOL
+        if isinstance(node, ast.UnaryOp):
+            return BOOL if isinstance(node.op, ast.Not) else self.expr(node.operand)
+        if isinstance(node, ast.BinOp):
+            return promote(self.expr(node.left), self.expr(node.right))
+        if isinstance(node, ast.IfExp):
+            return join(self.expr(node.body), self.expr(node.orelse))
+        if isinstance(node, ast.Subscript):
+            key = node.slice
+            if isinstance(key, ast.Constant) and isinstance(key.value, str):
+                d = SCALE_KEY_DTYPES.get(key.value)
+                if d is not None:
+                    return d
+                return None  # "q" may be int8 or packed uint8 — unknown
+            return self.expr(node.value)  # indexing preserves dtype
+        if isinstance(node, ast.Call):
+            return self._call(node)
+        if isinstance(node, ast.Attribute):
+            # x.T / x.at[...] style accessors preserve; anything else unknown
+            if node.attr in {"T", "mT", "real"}:
+                return self.expr(node.value)
+            return None
+        return None
+
+    def _call(self, node: ast.Call) -> Optional[str]:
+        f = node.func
+        name = _last_attr(f)
+        # x.astype(D) / x.view(D)
+        if isinstance(f, ast.Attribute) and name in {"astype", "view"}:
+            return self._resolve_dtype_expr(node.args[0]) if node.args else None
+        # np.float32(x) / jnp.bfloat16(x) constructor spellings
+        if name in DTYPE_TOKENS and name not in {"bool_"}:
+            return DTYPE_TOKENS[name]
+        if name in {"asarray", "array"}:
+            d = _dtype_arg(node, 1)
+            if d is not None:
+                return self._resolve_dtype_expr(d)
+            return self.expr(node.args[0]) if node.args else None
+        if name in {"zeros", "ones", "empty"}:
+            d = _dtype_arg(node, 1)
+            return self._resolve_dtype_expr(d) if d is not None else None
+        if name == "full":
+            d = _dtype_arg(node, 2)
+            return self._resolve_dtype_expr(d) if d is not None else None
+        if name in {"zeros_like", "ones_like", "full_like", "empty_like"}:
+            d = _dtype_arg(node, None)
+            if d is not None:
+                return self._resolve_dtype_expr(d)
+            return self.expr(node.args[0]) if node.args else None
+        if name == "arange":
+            d = _dtype_arg(node, None)
+            return self._resolve_dtype_expr(d) if d is not None else None
+        if name in PRESERVE_FIRST:
+            return self.expr(node.args[0]) if node.args else None
+        if name in JOIN_ARGS:
+            arr_args = node.args[1:] if name == "where" else node.args
+            out: Optional[str] = None
+            first = True
+            for a in arr_args:
+                d = self.expr(a)
+                out, first = (d, False) if first else (promote(out, d), False)
+            return out
+        # resolved callee: its joined return dtype
+        for callee in self.c.resolve_call(self.mod, self.fn, node):
+            rd = self.c.return_dtypes.get(callee.key())
+            if rd is not None:
+                return rd
+        return None
+
+    def _resolve_dtype_expr(self, node: ast.AST) -> Optional[str]:
+        d = dtype_literal(node)
+        if d is not None:
+            return d
+        # y.astype(x.dtype): inherit x's inferred dtype
+        if (isinstance(node, ast.Attribute) and node.attr == "dtype"):
+            return self.expr(node.value)
+        return None
+
+    # -- statement walk ---------------------------------------------------
+    def run(self) -> None:
+        # two passes so late assignments reach loop-carried early reads
+        for _ in range(2):
+            for node in self.scope.stmts:
+                if isinstance(node, ast.Assign):
+                    d = self.expr(node.value)
+                    for tgt in node.targets:
+                        self._assign(tgt, node.value, d)
+                elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                    self._assign(node.target, node.value, self.expr(node.value))
+                elif isinstance(node, ast.AugAssign):
+                    if isinstance(node.target, ast.Name):
+                        self.names[node.target.id] = promote(
+                            self.names.get(node.target.id),
+                            self.expr(node.value))
+                elif isinstance(node, (ast.For, ast.AsyncFor)):
+                    if isinstance(node.target, ast.Name):
+                        # iterating an array yields same-dtype rows
+                        self.names[node.target.id] = self.expr(node.iter)
+
+    def _assign(self, tgt: ast.AST, value: ast.AST, d: Optional[str]) -> None:
+        if isinstance(tgt, ast.Name):
+            self.names[tgt.id] = d
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            elts = (value.elts if isinstance(value, (ast.Tuple, ast.List))
+                    and len(value.elts) == len(tgt.elts) else None)
+            for i, t in enumerate(tgt.elts):
+                if isinstance(t, ast.Name):
+                    self.names[t.id] = (self.expr(elts[i])
+                                        if elts is not None else None)
+
+
+class DtypeFlowChecker:
+    def __init__(self, index: FactIndex):
+        self.index = index
+        self.diags: list[Diagnostic] = []
+        # (path, qualname) -> {param: dtype-or-None}; absent param = bottom
+        self.param_dtypes: dict[tuple[str, str], dict[str, Optional[str]]] = {}
+        self.return_dtypes: dict[tuple[str, str], Optional[str]] = {}
+        self.jit_scope: set[tuple[str, str]] = set()
+        self._scopes: dict[tuple[str, str], _ScopeNodes] = {}
+        self._call_memo: dict[int, list[FunctionInfo]] = {}
+
+    def scope_nodes(self, fn: FunctionInfo) -> _ScopeNodes:
+        sc = self._scopes.get(fn.key())
+        if sc is None:
+            sc = self._scopes[fn.key()] = _ScopeNodes(fn.node)
+        return sc
+
+    def resolve_call(self, mod: ModuleFacts, fn: FunctionInfo,
+                     call: ast.Call) -> list[FunctionInfo]:
+        """index.resolve_call memoized by callsite node — env.run re-reads
+        the same call expressions every propagation round."""
+        out = self._call_memo.get(id(call))
+        if out is None:
+            out = self._call_memo[id(call)] = self.index.resolve_call(
+                mod, fn, call)
+        return out
+
+    # -- scope + cross-function rounds -----------------------------------
+    def _seed_jit_scope(self) -> None:
+        frontier = [fn for fn in self.index.functions() if fn.jit_root]
+        self.jit_scope = {fn.key() for fn in frontier}
+        while frontier:
+            fn = frontier.pop()
+            mod = self.index.modules[fn.path]
+            for cs in self.index.call_sites(mod, fn):
+                for callee in cs.callees:
+                    if callee.key() not in self.jit_scope:
+                        self.jit_scope.add(callee.key())
+                        frontier.append(callee)
+
+    def _propagate(self) -> None:
+        """Cross-function rounds: callsite arg dtypes -> callee params,
+        return expressions -> call expressions. Three rounds bound the
+        getter-chain depth this package actually has."""
+        for _ in range(3):
+            changed = False
+            for mod in self.index.modules.values():
+                for fn in mod.functions.values():
+                    env = _DtypeEnv(self, mod, fn)
+                    env.run()
+                    rd: Optional[str] = None
+                    first = True
+                    for node in env.scope.returns:
+                        if node.value is not None:
+                            d = env.expr(node.value)
+                            rd, first = (d, False) if first else (join(rd, d), False)
+                    if not first and self.return_dtypes.get(fn.key(), "⊥") != rd:
+                        # the round cap bounds any oscillation
+                        self.return_dtypes[fn.key()] = rd
+                        changed = True
+                    for cs in self.index.call_sites(mod, fn):
+                        for callee in cs.callees:
+                            if self._bind_args(env, cs.node, callee):
+                                changed = True
+            if not changed:
+                return
+
+    def _bind_args(self, env: _DtypeEnv, call: ast.Call,
+                   callee: FunctionInfo) -> bool:
+        params = callee.params
+        offset = 1 if params[:1] in (["self"], ["cls"]) and isinstance(
+            call.func, ast.Attribute) else 0
+        slots = self.param_dtypes.setdefault(callee.key(), {})
+        changed = False
+        for i, arg in enumerate(call.args):
+            if isinstance(arg, ast.Starred):
+                continue
+            pi = i + offset
+            if pi >= len(params):
+                break
+            changed |= self._join_slot(slots, params[pi], env.expr(arg))
+        for kw in call.keywords:
+            if kw.arg and kw.arg in params:
+                changed |= self._join_slot(slots, kw.arg, env.expr(kw.value))
+        return changed
+
+    @staticmethod
+    def _join_slot(slots: dict[str, Optional[str]], param: str,
+                   d: Optional[str]) -> bool:
+        if param not in slots:
+            slots[param] = d
+            return d is not None
+        if slots[param] != d and slots[param] is not None:
+            slots[param] = None
+            return True
+        return False
+
+    # -- checks -----------------------------------------------------------
+    def run(self) -> list[Diagnostic]:
+        self._seed_jit_scope()
+        self._propagate()
+        for mod in self.index.modules.values():
+            for fn in mod.functions.values():
+                env = _DtypeEnv(self, mod, fn)
+                env.run()
+                self._check_fn(mod, fn, env)
+                self._check_quant_contract(mod, fn)
+        return self.diags
+
+    def _emit(self, mod: ModuleFacts, node: ast.AST, code: str, msg: str,
+              context: str) -> None:
+        line = getattr(node, "lineno", 0)
+        if mod.suppressions.is_suppressed(line, code):
+            return
+        self.diags.append(Diagnostic(mod.path, line, code, msg, context=context))
+
+    def _check_fn(self, mod: ModuleFacts, fn: FunctionInfo,
+                  env: _DtypeEnv) -> None:
+        ctx = fn.qualname
+        on_hot_path = fn.key() in self.jit_scope
+        for node in env.scope.checks:
+            if isinstance(node, ast.BinOp):
+                ld, rd = env.expr(node.left), env.expr(node.right)
+                if isinstance(node.op, ast.MatMult):
+                    narrow = {d for d in (ld, rd) if d in NARROW_INT}
+                    if narrow:
+                        self._emit(
+                            mod, node, "KVM064",
+                            f"`@` over a {'/'.join(sorted(narrow))} operand "
+                            f"in `{fn.name}` accumulates in the narrow int "
+                            "dtype — use lax.dot_general(..., "
+                            "preferred_element_type=jnp.int32), or mark "
+                            "`# kvmini: dtype-ok`", ctx)
+                elif (on_hot_path and isinstance(node.op, ARITH_OPS)
+                        and ld in FLOAT_RANK and rd in FLOAT_RANK
+                        and FLOAT_RANK[ld] != FLOAT_RANK[rd]):
+                    lo, hi = sorted((ld, rd), key=FLOAT_RANK.get)
+                    self._emit(
+                        mod, node, "KVM061",
+                        f"{lo} x {hi} arithmetic in jit-hot `{fn.name}` "
+                        f"silently upcasts the {lo} operand to {hi} — cast "
+                        "one side explicitly (accumulations: astype(f32) "
+                        "in, astype back out), or mark `# kvmini: dtype-ok`",
+                        ctx)
+            elif isinstance(node, ast.Call):
+                self._check_call(mod, fn, env, node, ctx)
+
+    def _check_call(self, mod: ModuleFacts, fn: FunctionInfo, env: _DtypeEnv,
+                    node: ast.Call, ctx: str) -> None:
+        name = _last_attr(node.func)
+        if name == "bitcast_convert_type":
+            d = (self._sub_byte_literal(node.args[1])
+                 if len(node.args) > 1 else None)
+            for kw in node.keywords:
+                if kw.arg == "new_dtype":
+                    d = d or self._sub_byte_literal(kw.value)
+            if d:
+                self._emit(
+                    mod, node, "KVM063",
+                    f"bitcast_convert_type to {d} in `{fn.name}`: sub-byte "
+                    "bitcast keeps the byte shape at abstract eval (the "
+                    "widening reshape downstream is a width mismatch) — "
+                    "unpack with mask/shift arithmetic, or mark "
+                    "`# kvmini: dtype-ok`", ctx)
+            return
+        if name in {"astype", "asarray", "array", "zeros", "ones", "full",
+                    "empty", "arange", "zeros_like", "ones_like", "full_like"}:
+            for sub in list(node.args) + [kw.value for kw in node.keywords]:
+                d = self._sub_byte_literal(sub)
+                if d:
+                    self._emit(
+                        mod, node, "KVM063",
+                        f"materialized {d} leaf in `{fn.name}` recurses "
+                        "into dispatch relayout (ops/quant.py) — store "
+                        "packed nibble pairs in uint8 and unpack "
+                        "arithmetically, or mark `# kvmini: dtype-ok`", ctx)
+                    return
+        if name in DOT_CALL_NAMES and not _has_kwarg(
+                node, "preferred_element_type"):
+            narrow = {env.expr(a) for a in node.args} & NARROW_INT
+            if narrow:
+                self._emit(
+                    mod, node, "KVM064",
+                    f"{name}() over a {'/'.join(sorted(narrow))} operand "
+                    f"in `{fn.name}` without preferred_element_type — the "
+                    "accumulator inherits the narrow int dtype and wraps; "
+                    "pass preferred_element_type=jnp.int32, or mark "
+                    "`# kvmini: dtype-ok`", ctx)
+            return
+        if name in ACCUM_CALL_NAMES and node.args:
+            d = env.expr(node.args[0])
+            if d in {BF16, F16}:
+                self._emit(
+                    mod, node, "KVM065",
+                    f"{name}() accumulates over a {d} value in `{fn.name}` "
+                    "— sum/normalizer precision collapses at long axes; "
+                    "compute in f32 (x.astype(jnp.float32)) and cast the "
+                    "result back, or mark `# kvmini: dtype-ok`", ctx)
+
+    @staticmethod
+    def _sub_byte_literal(node: ast.AST) -> Optional[str]:
+        d = dtype_literal(node)
+        return d if d in SUB_BYTE else None
+
+    # -- KVM062: quant-leaf contract --------------------------------------
+    def _check_quant_contract(self, mod: ModuleFacts, fn: FunctionInfo) -> None:
+        reads: dict[str, dict[str, ast.AST]] = {}
+        handled: dict[str, set[str]] = {}
+        writes: dict[str, set[str]] = {}
+        for node in iter_scope(fn.node):
+            if (isinstance(node, ast.Subscript)
+                    and isinstance(node.value, ast.Name)
+                    and isinstance(node.slice, ast.Constant)
+                    and isinstance(node.slice.value, str)):
+                base, key = node.value.id, node.slice.value
+                if isinstance(node.ctx, ast.Store):
+                    writes.setdefault(base, set()).add(key)
+                else:
+                    reads.setdefault(base, {}).setdefault(key, node)
+            elif (isinstance(node, ast.Compare)
+                    and isinstance(node.left, ast.Constant)
+                    and isinstance(node.left.value, str)
+                    and all(isinstance(op, (ast.In, ast.NotIn))
+                            for op in node.ops)):
+                for comp in node.comparators:
+                    if isinstance(comp, ast.Name):
+                        handled.setdefault(comp.id, set()).add(node.left.value)
+        for base, keymap in reads.items():
+            if not {"q", "s"} <= set(keymap):
+                continue
+            if writes.get(base):
+                continue  # builder: it produces the leaf, contract N/A
+            seen = set(keymap) | handled.get(base, set())
+            if seen & QUANT_COMPENSATION_KEYS:
+                continue
+            self._emit(
+                mod, keymap["s"], "KVM062",
+                f"`{base}` is dequantized (reads 'q' and 's') in "
+                f"`{fn.name}` without reading, testing, or writing a "
+                "compensation key ('z'/'a') — an AWQ/asymmetric leaf "
+                "would silently drop its offset term; handle it "
+                "(`if \"a\" in ...`), or mark `# kvmini: dtype-ok`",
+                fn.qualname)
+
+
+def check(index: FactIndex) -> list[Diagnostic]:
+    return DtypeFlowChecker(index).run()

@@ -66,17 +66,26 @@ def _is_jit_wrapper(node: ast.AST) -> bool:
     return _last_attr(node) in JIT_WRAPPER_NAMES
 
 
-def _static_args_from_call(call: ast.Call) -> tuple[set[int], set[str]]:
+def _argnum_kwargs(call: ast.Call, num_key: str,
+                   name_key: str) -> tuple[set[int], set[str]]:
     nums: set[int] = set()
     names: set[str] = set()
     for kw in call.keywords:
-        if kw.arg == "static_argnums" and isinstance(kw.value, (ast.Tuple, ast.List)):
+        if kw.arg == num_key and isinstance(kw.value, (ast.Tuple, ast.List)):
             nums |= {e.value for e in kw.value.elts
                      if isinstance(e, ast.Constant) and isinstance(e.value, int)}
-        if kw.arg == "static_argnames" and isinstance(kw.value, (ast.Tuple, ast.List)):
+        if kw.arg == name_key and isinstance(kw.value, (ast.Tuple, ast.List)):
             names |= {e.value for e in kw.value.elts
                       if isinstance(e, ast.Constant) and isinstance(e.value, str)}
     return nums, names
+
+
+def _static_args_from_call(call: ast.Call) -> tuple[set[int], set[str]]:
+    return _argnum_kwargs(call, "static_argnums", "static_argnames")
+
+
+def _donate_args_from_call(call: ast.Call) -> tuple[set[int], set[str]]:
+    return _argnum_kwargs(call, "donate_argnums", "donate_argnames")
 
 
 # type-annotation tokens that can carry traced array data; a param whose
@@ -115,7 +124,13 @@ class FunctionInfo:
     jit_root: bool = False
     static_argnums: set[int] = field(default_factory=set)
     static_argnames: set[str] = field(default_factory=set)
+    donated_argnums: set[int] = field(default_factory=set)
+    donated_argnames: set[str] = field(default_factory=set)
     returns_jitted: bool = False
+    # the specific jit-root FunctionInfos a jitted-returning getter hands
+    # out (so donation-aware checkers can map a `fn = self._get_step()`
+    # binding back to the root's donate_argnums)
+    returned_jit_roots: list["FunctionInfo"] = field(default_factory=list)
     # local names / `self.<attr>`s this function binds to other functions
     # (one level of alias, enclosing scopes chained at lookup time)
     local_aliases: dict[str, list[ast.AST]] = field(default_factory=dict)
@@ -195,18 +210,17 @@ class _ModuleWalker(ast.NodeVisitor):
             if _is_jit_wrapper(dec):
                 info.jit_root = True
             elif isinstance(dec, ast.Call):
-                if _is_jit_wrapper(dec.func):
-                    info.jit_root = True
-                    nums, names = _static_args_from_call(dec)
-                    info.static_argnums |= nums
-                    info.static_argnames |= names
-                elif _last_attr(dec.func) == "partial" and any(
-                    _is_jit_wrapper(x) for x in dec.args
+                if _is_jit_wrapper(dec.func) or (
+                    _last_attr(dec.func) == "partial"
+                    and any(_is_jit_wrapper(x) for x in dec.args)
                 ):
                     info.jit_root = True
                     nums, names = _static_args_from_call(dec)
                     info.static_argnums |= nums
                     info.static_argnames |= names
+                    dnums, dnames = _donate_args_from_call(dec)
+                    info.donated_argnums |= dnums
+                    info.donated_argnames |= dnames
         self.f.functions[info.qualname] = info
         self.fn_stack.append(info)
         self.generic_visit(node)
@@ -264,6 +278,12 @@ class _ModuleWalker(ast.NodeVisitor):
                     if isinstance(c, ast.Name):
                         self.f.class_attr_fn_aliases.setdefault(
                             (cls, tgt.attr), []).append(c.id)
+                    elif (isinstance(c, ast.Call) and _is_jit_wrapper(c.func)
+                          and c.args and isinstance(c.args[0], ast.Name)):
+                        # self._step = jax.jit(step, ...): the attr aliases
+                        # the wrapped function (donation facts resolvable)
+                        self.f.class_attr_fn_aliases.setdefault(
+                            (cls, tgt.attr), []).append(c.args[0].id)
                 if jitted:
                     self.f.jitted_attrs.add((cls, tgt.attr))
             elif (isinstance(tgt, ast.Subscript)
@@ -287,6 +307,9 @@ class _ModuleWalker(ast.NodeVisitor):
                     nums, names = _static_args_from_call(call)
                     fn.static_argnums |= nums
                     fn.static_argnames |= names
+                    dnums, dnames = _donate_args_from_call(call)
+                    fn.donated_argnums |= dnums
+                    fn.donated_argnames |= dnames
 
     def visit_Call(self, node: ast.Call) -> None:
         if _is_jit_wrapper(node.func):
@@ -296,7 +319,17 @@ class _ModuleWalker(ast.NodeVisitor):
     def visit_Return(self, node: ast.Return) -> None:
         if (self.fn_stack and node.value is not None
                 and self._value_is_jitted(node.value)):
-            self.fn_stack[-1].returns_jitted = True
+            me = self.fn_stack[-1]
+            me.returns_jitted = True
+            root: Optional[ast.AST] = None
+            if isinstance(node.value, ast.Name):
+                root = node.value
+            elif isinstance(node.value, ast.Call) and node.value.args:
+                root = node.value.args[0]  # return jax.jit(fn, ...)
+            if isinstance(root, ast.Name):
+                fn = self._lookup_fn(root.id)
+                if fn is not None and fn.jit_root and fn not in me.returned_jit_roots:
+                    me.returned_jit_roots.append(fn)
         self.generic_visit(node)
 
 
@@ -358,19 +391,19 @@ class FactIndex:
             changed = False
             for mod in self.modules.values():
                 for fn in mod.functions.values():
-                    if fn.returns_jitted:
-                        continue
                     for node in iter_scope(fn.node):
                         if not (isinstance(node, ast.Return)
                                 and isinstance(node.value, ast.Call)):
                             continue
                         for callee in self._resolve_expr(mod, fn, node.value.func):
-                            if callee.returns_jitted:
+                            if callee.returns_jitted and not fn.returns_jitted:
                                 fn.returns_jitted = True
                                 changed = True
-                                break
-                        if fn.returns_jitted:
-                            break
+                            if callee.returns_jitted:
+                                for root in callee.returned_jit_roots:
+                                    if root not in fn.returned_jit_roots:
+                                        fn.returned_jit_roots.append(root)
+                                        changed = True
             if not changed:
                 return
 

@@ -9,7 +9,9 @@ from typing import Iterable, Optional
 
 from kserve_vllm_mini_tpu.lint import (
     baseline as baseline_mod,
+    buffer_lifecycle,
     concurrency,
+    dtype_flow,
     jit_purity,
     lockstep,
     metrics_drift,
@@ -28,6 +30,8 @@ CHECKERS = (
     ("KVM02", "lockstep", lockstep.check),
     ("KVM04", "workload", workload.check),
     ("KVM05", "concurrency", concurrency.check),
+    ("KVM06", "dtype_flow", dtype_flow.check),
+    ("KVM07", "buffer_lifecycle", buffer_lifecycle.check),
 )
 METRICS_FAMILY = "KVM03"
 
@@ -69,7 +73,7 @@ def normalize_families(families: Optional[Iterable[str]]) -> Optional[set[str]]:
         if not norm.startswith("KVM") or not any(
                 code.startswith(norm) for code in selectable):
             raise ValueError(
-                f"unknown rule family {f!r} (families: KVM01..KVM05, or a "
+                f"unknown rule family {f!r} (families: KVM01..KVM07, or a "
                 "full code like KVM051; KVM001 always rides along and is "
                 "not selectable)")
         out.add(norm)

@@ -1,0 +1,103 @@
+"""SARIF 2.1.0 rendering for GitHub code-scanning annotations.
+
+One static document shape, no dependencies: the CLI's ``--sarif PATH``
+writes ``render(result.diagnostics)`` so findings show up inline on PRs
+via ``github/codeql-action/upload-sarif``. Suppressed findings never
+reach this layer (suppression comments stop the Diagnostic at emit time,
+diagnostics.py), so the uploaded document only carries live findings —
+the same set the baseline ratchet gates on.
+
+Severity maps from the rule family, not per finding: correctness-of-
+served-bytes families (lockstep determinism, thread safety, numerics,
+buffer lifecycle) annotate as ``error``; convention/drift families (jit
+purity, metrics drift, workload surfacing) as ``warning``; the
+suppression-hygiene rule KVM001 as ``note``.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+from kserve_vllm_mini_tpu.lint.diagnostics import RULES, Diagnostic
+
+SCHEMA_URI = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemata/sarif-schema-2.1.0.json"
+)
+INFO_URI = "https://github.com/kserve-vllm-mini-tpu"  # docs/LINTING.md
+
+# family prefix -> SARIF level; longest (most specific) prefix wins so
+# KVM001 can diverge from the rest of a hypothetical KVM00x family
+FAMILY_LEVELS = {
+    "KVM001": "note",     # stale-suppression hygiene
+    "KVM01": "warning",   # jit purity / static shapes
+    "KVM02": "error",     # lockstep determinism
+    "KVM03": "warning",   # metrics/schema drift
+    "KVM04": "warning",   # workload-change surfacing
+    "KVM05": "error",     # thread safety / lock discipline
+    "KVM06": "error",     # numerics / dtype flow
+    "KVM07": "error",     # buffer lifecycle
+}
+
+
+def level_for(code: str) -> str:
+    for prefix in sorted(FAMILY_LEVELS, key=len, reverse=True):
+        if code.startswith(prefix):
+            return FAMILY_LEVELS[prefix]
+    return "warning"
+
+
+def render(diagnostics: list[Diagnostic]) -> dict:
+    """The SARIF run document for one lint invocation."""
+    results = []
+    used_rules = set()
+    for d in diagnostics:
+        used_rules.add(d.code)
+        results.append({
+            "ruleId": d.code,
+            "level": level_for(d.code),
+            "message": {"text": d.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": Path(d.path).as_posix(),
+                        "uriBaseId": "%SRCROOT%",
+                    },
+                    "region": {"startLine": max(1, d.line)},
+                },
+            }],
+        })
+    rules = [
+        {
+            "id": r.code,
+            "name": r.name,
+            "shortDescription": {"text": r.summary},
+            "helpUri": INFO_URI,
+            "defaultConfiguration": {"level": level_for(r.code)},
+        }
+        # the full table rides along (GitHub needs the rule metadata for
+        # every ruleId referenced; shipping all of RULES keeps the doc
+        # stable whether or not a family fired this run)
+        for r in RULES.values()
+    ]
+    assert used_rules <= set(RULES), used_rules - set(RULES)
+    return {
+        "$schema": SCHEMA_URI,
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": "kvmini-lint",
+                    "informationUri": INFO_URI,
+                    "rules": rules,
+                },
+            },
+            "results": results,
+        }],
+    }
+
+
+def save(path: Path, diagnostics: list[Diagnostic]) -> None:
+    path.write_text(json.dumps(render(diagnostics), indent=2) + "\n",
+                    encoding="utf-8")

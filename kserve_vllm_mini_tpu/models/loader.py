@@ -222,10 +222,10 @@ def load_hf_checkpoint(
 
     def stack_quantized(per_layer_arrays) -> dict[str, Any]:
         qws = [quantize_weight(a, bits=q_bits) for a in per_layer_arrays]
-        return {
-            "q": jnp.stack([w["q"] for w in qws]),
-            "s": jnp.stack([w["s"] for w in qws]),
-        }
+        # stack EVERY key the leaf carries, not a hardcoded {"q", "s"}: a
+        # leaf with a compensation term ("z"/"a") stacked key-by-name would
+        # silently drop it and serve the offset-free weight (KVM062)
+        return {k: jnp.stack([w[k] for w in qws]) for k in qws[0]}
 
     layers: dict[str, Any] = {}
     layer_map = {"phi": _PHI_LAYER_MAP, "gemma2": _GEMMA2_LAYER_MAP}.get(

@@ -12,19 +12,43 @@ loop is too, re-thought for this stack:
    through it, carrying its target name) temporarily wrapped to record each
    matmul input's per-channel amax. No hook framework, no second model
    implementation: the real layer math produces the real activations.
-2. **Scale search** (``awq_scales``): AWQ's insight is that a few input
+2. **Scale rule** (``awq_scales``): AWQ's insight is that a few input
    channels with large activations carry most of the output error budget;
    scaling those channels UP before rounding (and compensating at runtime)
-   shrinks their relative rounding error. Per layer, search the
-   ``s_j = (a_j / gmean(a))^alpha`` family over an alpha grid, scoring by
-   the activation-weighted weight-rounding error
-   ``sum_j a_j^2 * sum_o (deq(Q(W s))_jo / s_j - W_jo)^2`` — the expected
-   output MSE under a diagonal activation covariance, computable without
-   re-running the model per candidate.
-3. **Runtime**: the quantized leaf carries ``a = 1/s`` ([..., in]); the
+   shrinks their relative rounding error. The scales here are
+   **exponent-only** (powers of two) and **protect-only** (never < 1):
+   ``s_j = 2^max(0, round(alpha * log2(a_j / gmean(a))))``. Exponent-only
+   matters because the serving path runs bf16 — multiplying an activation
+   by a power of two is an exact exponent shift, so the runtime
+   compensation ``x * (1/s)`` reproduces the calibration-time scaling
+   bit-for-bit. A free-form f32 scale would round every activation it
+   touches (~0.2% relative, per token), silently decorrelating serving
+   from the calibration objective — the dtype-drift bug class kvmini-lint
+   KVM061 exists for (docs/LINTING.md). Protect-only keeps unprotected
+   channels' quantization grids identical to plain int4, so calibration
+   can only refine, never perturb, the baseline rounding.
+3. **Where and how much**: the serving path (``quantize_params_awq``)
+   protects only the norm-fed projections ``AWQ_SERVING_TARGETS``
+   (wq/wk/wv/w_gate/w_up) at the canonical ``AWQ_SERVING_ALPHAS =
+   (0.5,)``. Those inputs are rmsnorm outputs: the norm weight
+   multiplies channelwise, so their outlier pattern is structural —
+   token-independent — which is exactly AWQ's premise that calibration
+   saliency predicts serving saliency. ``wo``/``w_down`` inputs
+   (attention-mixed values, silu-gated products) have data-dependent
+   heavy tails; calibration amax there is token-specific, and protecting
+   on it misallocates the int4 grid (measured on the outlier CI model:
+   it degrades served log-likelihood). The activation-weighted
+   weight-rounding error
+   ``sum_j a_j^2 * sum_o (deq(Q(W s))_jo / s_j - W_jo)^2`` (the expected
+   output MSE under a diagonal activation covariance) remains the scoring
+   surface ``awq_scales`` grid-searches for explicit sweeps — but it is a
+   weight-space proxy too coarse to rank exponent candidates per layer,
+   so serving does not per-layer-search.
+4. **Runtime**: the quantized leaf carries ``a = 1/s`` ([..., in]); the
    matmul path multiplies activations by it before the int4 matmul — one
-   elementwise op XLA fuses into the matmul's producer, so the HBM story
-   (stream half the int8 bytes) is identical to plain int4.
+   exact (power-of-two) elementwise op XLA fuses into the matmul's
+   producer, so the HBM story (stream half the int8 bytes) is identical
+   to plain int4.
 
 Acceptance metric: the quantization sweep's likelihood/fidelity axis
 (quality/evaluator.py) — calibrated int4 must beat plain int4 there at
@@ -53,6 +77,19 @@ from kserve_vllm_mini_tpu.ops.quant import (
 )
 
 DEFAULT_ALPHAS = (0.0, 0.25, 0.5, 0.75, 1.0)
+
+# What the serving path applies (see the module docstring): uniform
+# exponent protection on the norm-fed projections only, no per-layer
+# search — the weight-space proxy mis-ranks exponent candidates, and
+# alpha=0.5 is the measured sweet spot (one-to-two octaves of protection
+# at ~8x outliers, s=1 on flat channels).
+AWQ_SERVING_ALPHAS = (0.5,)
+
+# The projections whose inputs are rmsnorm outputs: channelwise norm
+# weights make their outlier pattern structural (stable across tokens),
+# so calibration amax transfers to serving. wo/w_down inputs are
+# data-dependent (attention mixing, silu gating) and stay plain-int4.
+AWQ_SERVING_TARGETS = ("wq", "wk", "wv", "w_gate", "w_up")
 
 
 def collect_activation_stats(
@@ -111,10 +148,14 @@ def awq_scales(
     bits: int = 4,
     alphas: Sequence[float] = DEFAULT_ALPHAS,
 ) -> jnp.ndarray:
-    """Per-input-channel AWQ scales ``s`` (same leading shape as act_amax),
-    alpha grid-searched PER LAYER against the activation-weighted rounding
-    error. alpha=0 is plain quantization (s=1), so calibrated int4 can
-    never score worse than plain int4 on the search objective."""
+    """Per-input-channel AWQ scales ``s`` (same leading shape as act_amax):
+    exponent-only, protect-only (see the module docstring). With more than
+    one alpha candidate the grid is searched PER LAYER against the
+    activation-weighted rounding error — alpha=0 is plain quantization
+    (s=1), so the selected scales can never score worse than plain int4 on
+    the search objective. The serving path passes the single canonical
+    ``AWQ_SERVING_ALPHAS`` instead of searching (the proxy mis-ranks
+    exponent candidates; see docstring point 3)."""
     w32 = jnp.asarray(w, jnp.float32)
     single = w32.ndim == 2
     if single:
@@ -126,13 +167,22 @@ def awq_scales(
     # normalize by the geometric mean so s is scale-free in the activation
     # units (AWQ's formulation); log-space for stability
     gmean = jnp.exp(jnp.mean(jnp.log(a), axis=-1, keepdims=True))
-    ratio = a / gmean                                     # [L, in]
+    log_ratio = jnp.log2(a / gmean)                       # [L, in]
     w_sq_weight = (a * a)[..., None]                      # [L, in, 1]
+
+    def pow2_scales(alpha: float) -> jnp.ndarray:
+        return jnp.exp2(jnp.maximum(0.0, jnp.round(alpha * log_ratio)))
+
+    if len(alphas) == 1:
+        # no grid to search: the canonical serving path skips the scoring
+        # round-trips entirely
+        s = pow2_scales(alphas[0])
+        return s[0] if single else s
 
     best_err: Optional[jnp.ndarray] = None
     best_alpha = jnp.zeros((w32.shape[0],), jnp.float32)
     for alpha in alphas:
-        s = jnp.clip(ratio ** alpha, 1e-4, 1e4)           # [L, in]
+        s = pow2_scales(alpha)                            # [L, in]
         qw = quantize_weight(w32 * s[..., :, None], bits=bits)
         deq = dequantize_weight(qw, dtype=jnp.float32) / s[..., :, None]
         err = jnp.sum((deq - w32) ** 2 * w_sq_weight, axis=(-2, -1))  # [L]
@@ -142,7 +192,7 @@ def awq_scales(
             take = err < best_err
             best_err = jnp.where(take, err, best_err)
             best_alpha = jnp.where(take, alpha, best_alpha)
-    s = jnp.clip(ratio ** best_alpha[:, None], 1e-4, 1e4)
+    s = jnp.exp2(jnp.maximum(0.0, jnp.round(best_alpha[:, None] * log_ratio)))
     return s[0] if single else s
 
 
@@ -150,11 +200,13 @@ def quantize_weight_awq(
     w: jnp.ndarray,
     act_amax: np.ndarray,
     bits: int = 4,
-    alphas: Sequence[float] = DEFAULT_ALPHAS,
+    alphas: Sequence[float] = AWQ_SERVING_ALPHAS,
 ) -> dict[str, jnp.ndarray]:
     """AWQ-calibrated quantized leaf: ``{"q", "s", "a"}`` where ``a = 1/s``
     is the runtime input-channel multiplier (ops/quant.linear applies it
-    before the matmul; dequantize_weight folds it back)."""
+    before the matmul; dequantize_weight folds it back). ``s`` is a power
+    of two, so ``a`` is exactly representable in every float dtype and the
+    runtime multiply is rounding-free in bf16."""
     s = awq_scales(w, act_amax, bits=bits, alphas=alphas)
     qw = quantize_weight(jnp.asarray(w, jnp.float32) * s[..., :, None], bits=bits)
     qw["a"] = (1.0 / s).astype(jnp.float32)
@@ -167,12 +219,15 @@ def quantize_params_awq(
     tokens: Optional[jnp.ndarray] = None,
     stats: Optional[dict[str, np.ndarray]] = None,
     bits: int = 4,
-    alphas: Sequence[float] = DEFAULT_ALPHAS,
+    alphas: Sequence[float] = AWQ_SERVING_ALPHAS,
+    targets: Sequence[str] = AWQ_SERVING_TARGETS,
 ) -> dict[str, Any]:
     """Quantize a full-precision Llama tree with activation-aware scales.
 
     Pass calibration ``tokens`` (stats are collected here) or precomputed
-    ``stats``. Targets without stats (e.g. MoE experts) fall back to plain
+    ``stats``. Only ``targets`` (default: the norm-fed projections — see
+    the module docstring) get AWQ scales; everything else QUANTIZABLE,
+    and any target without stats (e.g. MoE experts), falls back to plain
     symmetric quantization, so the tree always comes out fully quantized.
     """
     if stats is None:
@@ -183,7 +238,7 @@ def quantize_params_awq(
     layers = {}
     for name, leaf in params["layers"].items():
         if name in QUANTIZABLE:
-            if name in stats:
+            if name in targets and name in stats:
                 layers[name] = quantize_weight_awq(
                     leaf, stats[name], bits=bits, alphas=alphas
                 )

@@ -58,9 +58,9 @@ def quantize_weight(w: jnp.ndarray, bits: int = 8) -> dict[str, jnp.ndarray]:
             raise ValueError(f"int4 packing needs an even output dim, got {w.shape}")
         n = q.astype(jnp.int8)
         # element 2i -> low nibble of byte i, 2i+1 -> high nibble: the order
-        # jax.lax.bitcast_convert_type(uint8 -> int4) unpacks (pinned by
+        # _unpack_int4's mask/shift unpack restores (pinned by
         # tests/test_quant.py test_int4_unpack_traced_matches_eager, which
-        # compares the jitted bitcast branch against the host branch)
+        # compares the jitted unpack against the eager one)
         lo = n[..., 0::2] & 0x0F
         hi = n[..., 1::2] & 0x0F
         packed = (lo | (hi << 4)).astype(jnp.uint8)
@@ -75,30 +75,23 @@ def is_packed_int4(qw: dict[str, jnp.ndarray]) -> bool:
 
 
 def _unpack_int4(packed: jnp.ndarray) -> jnp.ndarray:
-    """uint8 [..., out//2] nibble pairs -> [..., out] integer tensor.
+    """uint8 [..., out//2] nibble pairs -> [..., out] int8 tensor.
 
-    Under a trace the unpack is a bitcast to ``jnp.int4`` — a bit-pattern
-    view matching XLA's native minor-axis S4 packing, so the compiled
-    program streams the packed bytes from HBM. Eagerly (tests, loaders) the
-    S4 intermediate itself would hit the dispatch-relayout recursion, so the
-    nibbles are sign-extended on the host into int8 instead — same values,
-    different dtype, and dequantize casts either to f32 anyway."""
-    import jax
-
-    if isinstance(packed, jax.core.Tracer):
-        nib = jax.lax.bitcast_convert_type(packed, jnp.int4)  # [..., out//2, 2]
-        return nib.reshape(*packed.shape[:-1], packed.shape[-1] * 2)
-    import numpy as np
-
-    # eager-only branch (the Tracer path returned above); host unpack is
-    # the point: S4 on-device would hit dispatch-relayout  # kvmini: sync-ok
-    a = np.asarray(packed)
-    lo = (a & 0x0F).astype(np.int8)
-    hi = (a >> 4).astype(np.int8)
-    lo[lo > 7] -= 16
-    hi[hi > 7] -= 16
-    out = np.stack([lo, hi], axis=-1).reshape(*a.shape[:-1], a.shape[-1] * 2)
-    return jnp.asarray(out)
+    Arithmetic unpack (mask / shift / sign-extend), identical traced and
+    eager. NOT a ``lax.bitcast_convert_type(..., int4)``: on this JAX line
+    the sub-byte bitcast keeps the byte shape at abstract-eval time (no
+    trailing nibble axis), so the following widen-to-[..., out] reshape is
+    a width mismatch — and the lowering fails the MLIR verifier anyway
+    (KVM063's sub-byte-bitcast rule pins this). An S4 intermediate at a
+    dispatch boundary also recurses into relayout (see quantize_weight).
+    The arithmetic form still streams only the packed bytes from HBM: XLA
+    fuses the mask/shift into the consumer's producer epilogue."""
+    lo = (packed & 0x0F).astype(jnp.int8)
+    hi = (packed >> 4).astype(jnp.int8)
+    lo = jnp.where(lo > 7, lo - 16, lo)
+    hi = jnp.where(hi > 7, hi - 16, hi)
+    out = jnp.stack([lo, hi], axis=-1)  # [..., out//2, 2]
+    return out.reshape(*packed.shape[:-1], packed.shape[-1] * 2)
 
 
 def unpacked_q(qw: dict[str, jnp.ndarray]) -> jnp.ndarray:

@@ -29,6 +29,7 @@ are stage-partitioned.
 
 from __future__ import annotations
 
+import inspect
 from functools import partial
 from typing import Any
 
@@ -49,6 +50,13 @@ try:  # jax >= 0.8
     from jax import shard_map
 except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map
+
+# jax renamed the replication-check knob check_rep -> check_vma; resolve
+# the spelling this jax accepts so the executor traces on both lines
+_SM_CHECK_OFF = {
+    ("check_vma" if "check_vma" in inspect.signature(shard_map).parameters
+     else "check_rep"): False
+}
 
 
 def _pipeline_specs(params: dict[str, Any]) -> dict[str, Any]:
@@ -94,7 +102,7 @@ def pipeline_loss_fn(
         mesh=mesh,
         in_specs=(p_specs, tok_spec),
         out_specs=P(),
-        check_vma=False,
+        **_SM_CHECK_OFF,
     )
     def spmd_loss(params, tokens):
         inp, tgt = tokens[:, :-1], tokens[:, 1:]

@@ -25,6 +25,15 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from kserve_vllm_mini_tpu.ops.attention import repeat_kv
 
+try:  # jax >= 0.8
+    from jax import shard_map
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
+
+# jax.lax.pvary landed with the 0.9 shard_map typing rules; on older jax
+# the accumulators need no device-varying declaration — identity is exact
+_pvary = getattr(jax.lax, "pvary", lambda x, axes: x)
+
 
 def _block_accumulate(q, k, v, q_pos, k_pos, m, l, acc, scale):
     """Fold one K/V block into the online-softmax state.
@@ -49,20 +58,23 @@ def _block_accumulate(q, k, v, q_pos, k_pos, m, l, acc, scale):
     return m_new, l_new, acc_new
 
 
-def _ring_attention_local(q, k, v, q_pos, k_pos, axis_name: str, scale: float):
-    """Per-device body run under shard_map. Shapes are the local blocks."""
+def _ring_attention_local(q, k, v, q_pos, k_pos, axis_name: str, scale: float,
+                          sp: int):
+    """Per-device body run under shard_map. Shapes are the local blocks.
+
+    ``sp`` is the ring size, passed statically from the mesh (the perm
+    list needs a Python int; jax.lax.axis_size is not on older jax)."""
     n_rep = q.shape[1] // k.shape[1]
     if n_rep > 1:
         k = repeat_kv(k, n_rep)
         v = repeat_kv(v, n_rep)
-    sp = jax.lax.axis_size(axis_name)
     B, H, Tq, D = q.shape
     # pvary: the accumulators are logically device-varying over the ring axis
     # from step 1 on; JAX 0.9's shard_map typing requires declaring that up
     # front or the fori_loop carry types mismatch.
-    m = jax.lax.pvary(jnp.full((B, H, Tq), -jnp.inf, dtype=jnp.float32), (axis_name,))
-    l = jax.lax.pvary(jnp.zeros((B, H, Tq), dtype=jnp.float32), (axis_name,))
-    acc = jax.lax.pvary(jnp.zeros((B, H, Tq, D), dtype=jnp.float32), (axis_name,))
+    m = _pvary(jnp.full((B, H, Tq), -jnp.inf, dtype=jnp.float32), (axis_name,))
+    l = _pvary(jnp.zeros((B, H, Tq), dtype=jnp.float32), (axis_name,))
+    acc = _pvary(jnp.zeros((B, H, Tq, D), dtype=jnp.float32), (axis_name,))
 
     perm = [(i, (i + 1) % sp) for i in range(sp)]
 
@@ -93,8 +105,9 @@ def ring_attention(
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     seq = P(None, None, "sp", None)
     pos_spec = P(None, "sp")
-    fn = jax.shard_map(
-        partial(_ring_attention_local, axis_name="sp", scale=scale),
+    fn = shard_map(
+        partial(_ring_attention_local, axis_name="sp", scale=scale,
+                sp=int(mesh.shape["sp"])),
         mesh=mesh,
         in_specs=(seq, seq, seq, pos_spec, pos_spec),
         out_specs=seq,

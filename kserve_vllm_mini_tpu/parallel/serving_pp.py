@@ -38,6 +38,8 @@ meshes.
 
 from __future__ import annotations
 
+import inspect
+from functools import partial
 from typing import Any, Optional
 
 import jax
@@ -57,6 +59,13 @@ try:  # jax >= 0.8
     from jax import shard_map
 except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map
+
+# jax renamed the replication-check knob check_rep -> check_vma; resolve
+# the spelling this jax accepts so the executor traces on both lines
+_SM_CHECK_OFF = {
+    ("check_vma" if "check_vma" in inspect.signature(shard_map).parameters
+     else "check_rep"): False
+}
 
 
 def _pp_param_specs(params: dict[str, Any]) -> dict[str, Any]:
@@ -139,13 +148,16 @@ def make_pp_forward(cfg: ModelConfig, mesh: Mesh, microbatches: int = 1):
         has_li = logit_index is not None
         li = logit_index if has_li else jnp.zeros((B,), dtype=jnp.int32)
 
-        @jax.jit
+        @partial(jax.jit, donate_argnums=(3,))
         def run(params, tokens, positions, cache, offsets, li):
-            @shard_map(
+            # partial form: old-jax shard_map takes f positionally (it is
+            # not a decorator factory), new-jax accepts it too
+            @partial(
+                shard_map,
                 mesh=mesh,
                 in_specs=(p_specs, rep, rep, c_specs, P(None), P(None)),
                 out_specs=(P(None, None, None), c_specs),
-                check_vma=False,
+                **_SM_CHECK_OFF,
             )
             def inner(params, tokens, positions, cache, offsets, li):
                 stage = jax.lax.axis_index("pp")
@@ -220,6 +232,8 @@ def make_pp_forward(cfg: ModelConfig, mesh: Mesh, microbatches: int = 1):
                 # shared family epilogue (phi bias, gemma (1+w) norm +
                 # softcap): executor-local head code drifts silently
                 logits = final_logits(params, cfg, h)
+                # shard_map has no donation knob — the enclosing jit (run,
+                # donate_argnums=(3,)) owns the cache  # kvmini: buffer-ok
                 return logits, cache_out
 
             return inner(params, tokens, positions, cache, offsets, li)

@@ -1,0 +1,13 @@
+"""Seeded dtype bug: bf16 activations multiplied by the f32 per-channel
+scale on the jit hot path (ISSUE KVM061) — the whole activation tensor
+silently upcasts to f32, doubling its HBM cost on the MXU path."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def scaled_matmul(x, leaf, w):
+    act = x.astype(jnp.bfloat16)
+    scale = leaf["s"]          # f32 by the quant-leaf scale contract
+    y = act * scale            # bf16 x f32: silent upcast
+    return y @ w

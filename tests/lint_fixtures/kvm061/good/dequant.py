@@ -1,0 +1,23 @@
+"""Same shape, invariant respected: the narrow side is cast up
+explicitly where f32 math is wanted, and the one intentional mixed
+multiply carries the annotation."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def scaled_matmul(x, leaf, w):
+    act = x.astype(jnp.bfloat16)
+    scale = leaf["s"]
+    # accumulate in f32 on purpose: cast in, cast back out
+    y = act.astype(jnp.float32) * scale
+    return y.astype(jnp.bfloat16) @ w
+
+
+@jax.jit
+def logit_softcap(h, cap_table):
+    h16 = h.astype(jnp.bfloat16)
+    caps = cap_table["s"]
+    # final-logits epilogue runs f32 by design (docs/QUANTIZATION.md);
+    # the upcast is the point, not an accident  # kvmini: dtype-ok
+    return h16 * caps

@@ -1,0 +1,9 @@
+"""Seeded quant-contract bug: dequantization applies the scale but never
+reads, tests, or writes a compensation key (ISSUE KVM062) — an
+AWQ/asymmetric leaf would silently drop its offset term."""
+import jax.numpy as jnp
+
+
+def dequantize(leaf):
+    q = leaf["q"]
+    return q.astype(jnp.float32) * leaf["s"]

@@ -1,0 +1,18 @@
+"""Same shape, contract respected: the consumer membership-tests the
+compensation key, and the builder (which WRITES the keys) is exempt."""
+import jax.numpy as jnp
+
+
+def dequantize(leaf):
+    q = leaf["q"]
+    deq = q.astype(jnp.float32) * leaf["s"]
+    if "a" in leaf:
+        deq = deq * leaf["a"]
+    return deq
+
+
+def build_leaf(w, scale):
+    leaf = {}
+    leaf["q"] = jnp.round(w / scale)
+    leaf["s"] = scale
+    return leaf

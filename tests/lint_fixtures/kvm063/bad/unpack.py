@@ -1,0 +1,16 @@
+"""Seeded quant bug — the ops/quant.py seed failure class (ISSUE
+KVM063): sub-byte bitcast unpack. ``bitcast_convert_type(..., int4)``
+keeps the byte shape at abstract eval (no trailing nibble axis), so the
+widening reshape below is a width mismatch; an S4 leaf at a dispatch
+boundary additionally recurses into relayout."""
+import jax
+import jax.numpy as jnp
+
+
+def unpack_int4(packed):
+    nib = jax.lax.bitcast_convert_type(packed, jnp.int4)
+    return nib.reshape(*packed.shape[:-1], packed.shape[-1] * 2)
+
+
+def init_scratch(n):
+    return jnp.zeros((n,), dtype=jnp.int4)

@@ -1,0 +1,17 @@
+"""Same shape, invariant respected: arithmetic mask/shift/sign-extend
+unpack from uint8 nibble pairs — identical traced and eager, streams
+only the packed bytes from HBM (the ops/quant.py fix)."""
+import jax.numpy as jnp
+
+
+def unpack_int4(packed):
+    lo = (packed & 0x0F).astype(jnp.int8)
+    hi = (packed >> 4).astype(jnp.int8)
+    lo = jnp.where(lo > 7, lo - 16, lo)
+    hi = jnp.where(hi > 7, hi - 16, hi)
+    out = jnp.stack([lo, hi], axis=-1)
+    return out.reshape(*packed.shape[:-1], packed.shape[-1] * 2)
+
+
+def init_scratch(n):
+    return jnp.zeros((n,), dtype=jnp.uint8)

@@ -1,0 +1,16 @@
+"""Seeded accumulator bug: int8 dot without preferred_element_type
+(ISSUE KVM064) — the accumulator inherits int8 and wraps at the first
+contraction longer than a few elements."""
+import jax.numpy as jnp
+
+
+def int8_matmul(x, w):
+    xi = x.astype(jnp.int8)
+    wi = w.astype(jnp.int8)
+    return jnp.dot(xi, wi)
+
+
+def int8_operator(x, w):
+    xi = x.astype(jnp.int8)
+    wi = w.astype(jnp.int8)
+    return xi @ wi

@@ -1,0 +1,19 @@
+"""Same shape, invariant respected: the integer dot declares its
+accumulator dtype, so the contraction runs in int32."""
+import jax
+import jax.numpy as jnp
+
+
+def int8_matmul(x, w):
+    xi = x.astype(jnp.int8)
+    wi = w.astype(jnp.int8)
+    return jnp.dot(xi, wi, preferred_element_type=jnp.int32)
+
+
+def int8_dot_general(x, w):
+    xi = x.astype(jnp.int8)
+    wi = w.astype(jnp.int8)
+    return jax.lax.dot_general(
+        xi, wi, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )

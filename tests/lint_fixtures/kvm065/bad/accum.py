@@ -1,0 +1,9 @@
+"""Seeded accumulation bug: softmax over a bf16 value (ISSUE KVM065) —
+the normalizer's running sum collapses at long sequence axes."""
+import jax
+import jax.numpy as jnp
+
+
+def attention_probs(logits):
+    l16 = logits.astype(jnp.bfloat16)
+    return jax.nn.softmax(l16, axis=-1)

@@ -1,0 +1,10 @@
+"""Same shape, invariant respected: accumulate in f32, cast the result
+back to the serving dtype."""
+import jax
+import jax.numpy as jnp
+
+
+def attention_probs(logits):
+    l16 = logits.astype(jnp.bfloat16)
+    p = jax.nn.softmax(l16.astype(jnp.float32), axis=-1)
+    return p.astype(jnp.bfloat16)

@@ -1,0 +1,19 @@
+"""Seeded donation bug: the cache buffer is donated to the jitted step
+and then read again after dispatch (ISSUE KVM071) — the buffer was
+surrendered to XLA, its contents are undefined."""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, donate_argnums=(1,))
+def step(params, cache, tok):
+    new_cache = cache.at[0].set(tok)
+    return new_cache, jnp.sum(new_cache)
+
+
+def decode(params, cache, tok):
+    out_cache, logit = step(params, cache, tok)
+    stale = jnp.sum(cache)
+    return out_cache, logit + stale

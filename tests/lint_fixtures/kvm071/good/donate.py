@@ -1,0 +1,19 @@
+"""Same shape, invariant respected: the donated name is rebound to the
+call's result (the engine's donated-decode-state convention), so every
+later read sees the new generation."""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, donate_argnums=(1,))
+def step(params, cache, tok):
+    new_cache = cache.at[0].set(tok)
+    return new_cache, jnp.sum(new_cache)
+
+
+def decode(params, cache, tok):
+    cache, logit = step(params, cache, tok)
+    checksum = jnp.sum(cache)
+    return cache, logit + checksum

@@ -1,0 +1,11 @@
+"""Seeded HBM bug: a jit root threads the KV cache through (param in,
+updated value out) without donating it (ISSUE KVM072) — both
+generations stay resident and steady-state HBM doubles."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def decode_step(params, kv_cache, tok):
+    new_cache = kv_cache.at[0].set(tok)
+    return new_cache, jnp.sum(new_cache)

@@ -1,0 +1,19 @@
+"""Seeded paged-KV bugs (ISSUE KVM073): a block id freed twice, and a
+block id used as a table index after it went back to the free list —
+the id may already belong to another request."""
+
+
+class Pager:
+    def __init__(self, n):
+        self.free_blocks = list(range(n))
+        self.block_table = {}
+        self.refs = {}
+
+    def double_free(self, block_id):
+        self.refs.pop(block_id, None)
+        self.free_blocks.append(block_id)
+        self.free_blocks.append(block_id)
+
+    def write_after_free(self, block_id, value):
+        self.free_blocks.append(block_id)
+        self.block_table[block_id] = value

@@ -1,0 +1,28 @@
+"""Same shape, lifecycle respected: use before free, one free per id,
+an early-error path that frees and RETURNS before the happy-path use,
+and a rebind that starts a fresh id's lifetime."""
+
+
+class Pager:
+    def __init__(self, n):
+        self.free_blocks = list(range(n))
+        self.block_table = {}
+        self.refs = {}
+
+    def release(self, block_id, value):
+        self.block_table[block_id] = value
+        self.refs.pop(block_id, None)
+        self.free_blocks.append(block_id)
+
+    def admit(self, block_id, value, ok):
+        if not ok:
+            self.free_blocks.append(block_id)
+            return None
+        self.block_table[block_id] = value
+        return block_id
+
+    def recycle(self, block_id, value):
+        self.free_blocks.append(block_id)
+        block_id = self.free_blocks.pop(0)
+        self.block_table[block_id] = value
+        return block_id

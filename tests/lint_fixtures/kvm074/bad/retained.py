@@ -1,0 +1,15 @@
+"""Seeded retained-LRU bug (ISSUE KVM074): a prefix-cache hit bumps the
+block refcounts but never pops the blocks out of the retained LRU —
+eviction scans the LRU and can reap a block in active use."""
+
+
+class PagedKV:
+    def __init__(self):
+        self.retained_lru = {}
+        self.block_rc = {}
+
+    def claim_prefix(self, key):
+        blocks = self.retained_lru[key]
+        for b in blocks:
+            self.block_rc[b] += 1
+        return blocks

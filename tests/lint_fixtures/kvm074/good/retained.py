@@ -1,0 +1,14 @@
+"""Same shape, invariant respected: claiming pops the blocks from the
+retained LRU (re-pinned; eviction can no longer see them)."""
+
+
+class PagedKV:
+    def __init__(self):
+        self.retained_lru = {}
+        self.block_rc = {}
+
+    def claim_prefix(self, key):
+        blocks = self.retained_lru.pop(key)
+        for b in blocks:
+            self.block_rc[b] += 1
+        return blocks

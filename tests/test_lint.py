@@ -6,9 +6,12 @@ must produce EXACTLY the expected diagnostics and a good/ fixture (same
 shape, invariant respected or legitimately suppressed) that must lint
 clean — including the ISSUE's seeded mutations: an unpublished lockstep
 mutation (KVM021), a stats key missing from /metrics (KVM031),
-time.time() inside a jitted fn (KVM013), and the KVM05x seeded races
+time.time() inside a jitted fn (KVM013), the KVM05x seeded races
 (bare cross-thread counter increment, lock-order cycle, unbounded
-Event.wait/join).
+Event.wait/join), and the KVM06x/07x seeded numerics/lifecycle bugs
+(bf16 x f32-scale upcast, dequant dropping the zero-point, the
+ops/quant.py sub-byte bitcast unpack, donated buffer read after
+dispatch, double-free of a KV block id).
 
 The pin test runs the real linter over the real package against the
 committed lint-baseline.json: no new findings, no stale entries, no
@@ -72,6 +75,18 @@ CASES = [
     ("kvm053", {"KVM053": 1}),  # ISSUE seeded race: lock-order cycle
     ("kvm054", {"KVM054": 2}),  # ISSUE seeded race: unbounded wait + join
     ("kvm055", {"KVM055": 1}),  # raw live deque handed across the boundary
+    ("kvm061", {"KVM061": 1}),  # ISSUE seeded bug: bf16 x f32-scale upcast
+    ("kvm062", {"KVM062": 1}),  # ISSUE seeded bug: dequant drops zero-point
+    ("kvm063", {"KVM063": 2}),  # ISSUE seeded bug: the ops/quant.py sub-byte
+    #                             bitcast unpack (+ a materialized int4 leaf)
+    ("kvm064", {"KVM064": 2}),  # int8 dot() and `@` without accum dtype
+    ("kvm065", {"KVM065": 1}),  # softmax over bf16
+    ("kvm071", {"KVM071": 1}),  # ISSUE seeded bug: donated buffer read after
+    #                             dispatch
+    ("kvm072", {"KVM072": 1}),  # KV cache threaded through undonated
+    ("kvm073", {"KVM073": 2}),  # ISSUE seeded bug: double-free of a KV block
+    #                             id (+ a table write after free)
+    ("kvm074", {"KVM074": 1}),  # retained-LRU claim without unpin
 ]
 
 
@@ -256,6 +271,42 @@ def test_timing_report(tmp_path, capsys):
     assert "concurrency" in doc["timings"] and doc["findings"] == 1
 
 
+def test_sarif_output(tmp_path):
+    """--sarif writes a 2.1.0 doc: severity from the rule family, repo-
+    relative URIs, the full rule table, suppressed findings omitted."""
+    sarif = tmp_path / "out.sarif"
+    assert lint_main([str(FIXTURES / "kvm063" / "bad"), "--no-baseline",
+                      "--sarif", str(sarif)]) == 1
+    doc = json.loads(sarif.read_text())
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "kvmini-lint"
+    assert {r["id"] for r in run["tool"]["driver"]["rules"]} == set(RULES)
+    assert [r["ruleId"] for r in run["results"]] == ["KVM063", "KVM063"]
+    # numerics are correctness-of-served-bytes: family maps to error
+    assert {r["level"] for r in run["results"]} == {"error"}
+    loc = run["results"][0]["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"].startswith("tests/lint_fixtures/")
+    assert loc["region"]["startLine"] > 0
+
+    # a good tree's suppressed findings never reach the document
+    assert lint_main([str(FIXTURES / "kvm061" / "good"), "--no-baseline",
+                      "--sarif", str(sarif)]) == 0
+    doc = json.loads(sarif.read_text())
+    assert doc["runs"][0]["results"] == []
+
+
+def test_sarif_family_severity_mapping():
+    from kserve_vllm_mini_tpu.lint.sarif import level_for
+    assert level_for("KVM001") == "note"
+    assert level_for("KVM013") == "warning"   # jit purity: convention
+    assert level_for("KVM032") == "warning"   # drift: convention
+    assert level_for("KVM021") == "error"     # lockstep: served bytes
+    assert level_for("KVM051") == "error"     # thread safety
+    assert level_for("KVM061") == "error"     # numerics
+    assert level_for("KVM073") == "error"     # buffer lifecycle
+
+
 def test_write_baseline_refuses_parse_errors(tmp_path, capsys):
     (tmp_path / "broken.py").write_text("def f(:\n")
     bl = tmp_path / "bl.json"
@@ -287,14 +338,16 @@ def test_live_codebase_matches_baseline_exactly():
         "--write-baseline: " + ", ".join(result.baseline_diff.stale)
     )
     assert not [d for d in result.diagnostics if d.code == "KVM001"], (
-        "stale `# kvmini:` suppressions in the live tree"
+        "stale `# kvmini:` suppressions in the live tree (dtype-ok/"
+        "buffer-ok included — KVM001 tracks every token)"
     )
-    # every family ran (incl. KVM05x concurrency) and reported its wall
-    # time — the `--timing` surface CI uploads to attribute speed drift
+    # every family ran and reported its wall time — all eight timing
+    # entries, the `--timing` surface CI uploads to attribute speed drift
     assert {"facts", "jit_purity", "lockstep", "workload", "concurrency",
-            "metrics_drift"} <= set(result.timings)
-    # 12s: ~7s idle on this box after the profiling subsystem grew the
-    # package (PR 6); the old 10s pin flaked when the full suite's load
-    # rode on top. lint-timing.json (CI artifact) still names the
-    # checker if one of them regresses.
-    assert elapsed < 12.0, f"kvmini-lint took {elapsed:.1f}s (budget 12s)"
+            "metrics_drift", "dtype_flow", "buffer_lifecycle"
+            } <= set(result.timings)
+    # 20s: ~8-9s idle on this box after the KVM06x/07x families landed
+    # (~11.5s under full-suite load — the old 12s pin would flake the
+    # same way the 10s pin did). lint-timing.json (CI artifact) still
+    # names the checker if one of them regresses.
+    assert elapsed < 20.0, f"kvmini-lint took {elapsed:.1f}s (budget 20s)"
