@@ -131,6 +131,12 @@ def analyze_run(
     update.update(
         telemetry.compile_stats_block(endpoint, runtime_metrics=runtime_metrics)
     )
+    # KV-cache & HBM block (docs/TROUBLESHOOTING.md "HBM pressure & KV
+    # thrash") + headroom-model validation when the scrape carried both
+    # the analytic estimate and an observed peak: same in-repo-only rule
+    update.update(
+        telemetry.kv_cache_block(endpoint, runtime_metrics=runtime_metrics)
+    )
 
     # server-side request traces (docs/TRACING.md): fetch /traces, merge
     # the server leg into runs/<id>/traces/traces.json joined by trace_id,

@@ -358,6 +358,79 @@ def compile_stats_block(
     return {"compile_stats": block}
 
 
+# results.json `kv_cache` sub-key -> runtime metric (docs/
+# TROUBLESHOOTING.md "HBM pressure & KV thrash"). Keyed by SUB-KEY, the
+# COMPILE_METRIC_KEYS orientation, because the whole map lands under the
+# one typed `kv_cache` results field. The hbm_* entries are absent on
+# backends whose devices report no memory_stats (CPU) — absence, not
+# zeros, survives the mapping.
+KV_METRIC_KEYS = {
+    "hit_depth_p50": "kvmini_tpu_kv_prefix_hit_depth_p50",
+    "hit_depth_p95": "kvmini_tpu_kv_prefix_hit_depth_p95",
+    "bytes_per_token": "kvmini_tpu_kv_bytes_per_token",
+    "reused_bytes": "kvmini_tpu_kv_reused_bytes_total",
+    "blocks_allocated": "kvmini_tpu_kv_blocks_allocated_total",
+    "retained_evictions": "kvmini_tpu_kv_retained_evictions_total",
+    "share_reclaims": "kvmini_tpu_kv_share_reclaims_total",
+    "prefix_hits": "kvmini_tpu_prefix_hits_total",
+    "prefix_lookups": "kvmini_tpu_cache_lookups_total",
+    "pool_blocks": "kvmini_tpu_kv_pool_blocks",
+    "free_blocks": "kvmini_tpu_kv_free_blocks",
+    "retained_blocks": "kvmini_tpu_kv_retained_blocks",
+    "used_blocks": "kvmini_tpu_kv_used_blocks",
+    "block_size": "kvmini_tpu_kv_block_size",
+    "occupancy": "kvmini_tpu_kv_occupancy",
+    "retained_fraction": "kvmini_tpu_kv_retained_fraction",
+    "fragmentation": "kvmini_tpu_kv_fragmentation",
+    "logical_bytes": "kvmini_tpu_kv_logical_bytes",
+    "physical_bytes": "kvmini_tpu_kv_physical_bytes",
+    "hbm_bytes_in_use": "kvmini_tpu_hbm_bytes_in_use",
+    "hbm_peak_bytes": "kvmini_tpu_hbm_peak_bytes",
+    "hbm_bytes_limit": "kvmini_tpu_hbm_bytes_limit",
+    "headroom_estimate_bytes": "kvmini_tpu_hbm_headroom_estimate_bytes",
+}
+
+
+def kv_cache_block(
+    endpoint: Optional[str],
+    runtime_metrics: Optional[dict[str, float]] = None,
+) -> dict[str, Any]:
+    """KV-cache & HBM telemetry from the runtime's /metrics, nested under
+    the `kv_cache` results key plus a top-level `headroom_error_pct` when
+    both sides of the headroom-model validation are present. Degradation
+    rules as ever: an endpoint that doesn't export the kv_* names (any
+    external engine) yields NO block; a runtime that exported them but
+    saw no cache activity, holds no paged pool, and reports no HBM also
+    yields no block — an all-zero cache report carries no information."""
+    if not endpoint:
+        return {}
+    m = (runtime_metrics if runtime_metrics is not None
+         else scrape_runtime_metrics(endpoint))
+    block: dict[str, Any] = {
+        out_key: m[metric]
+        for out_key, metric in KV_METRIC_KEYS.items()
+        if metric in m
+    }
+    if "hit_depth_p50" not in block:
+        return {}  # the runtime doesn't export the KV observability rail
+    if (
+        not block.get("prefix_lookups")
+        and "pool_blocks" not in block
+        and "hbm_bytes_in_use" not in block
+    ):
+        return {}
+    block["source"] = "metrics:scrape"
+    out: dict[str, Any] = {"kv_cache": block}
+    from kserve_vllm_mini_tpu.profiling.headroom import headroom_error_pct
+
+    err = headroom_error_pct(
+        block.get("headroom_estimate_bytes"), block.get("hbm_peak_bytes")
+    )
+    if err is not None:
+        out["headroom_error_pct"] = err
+    return out
+
+
 def cache_hit_ratio(
     prom_url: Optional[str],
     endpoint: Optional[str],

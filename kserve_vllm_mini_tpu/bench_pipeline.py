@@ -335,6 +335,18 @@ def _run_stages(
         cs = server.engine.compile_stats_snapshot()
         if cs.get("compiles"):
             run_dir.merge_into_results({"compile_stats": cs})
+        # KV-cache & HBM block (docs/TROUBLESHOOTING.md): same
+        # authoritative-direct-snapshot rule, and the headroom-model
+        # validation closes here when the device reported a peak
+        kv = server.engine.kv_cache_snapshot()
+        run_dir.merge_into_results({"kv_cache": kv})
+        from kserve_vllm_mini_tpu.profiling.headroom import headroom_error_pct
+
+        err = headroom_error_pct(
+            kv.get("headroom_estimate_bytes"), kv.get("hbm_peak_bytes")
+        )
+        if err is not None:
+            run_dir.merge_into_results({"headroom_error_pct": err})
     results = run_dir.read_results()
 
     code = 0

@@ -149,6 +149,21 @@ class Results:
     # throughput claims (series is always "proxy")
     proxy: Optional[dict[str, Any]] = None
 
+    # KV-cache & HBM observability block (docs/TROUBLESHOOTING.md "HBM
+    # pressure & KV thrash"): prefix-cache attribution (hit-depth
+    # percentiles, bytes reused), paged-block lifecycle counters
+    # (allocations, retained-LRU evictions, share reclaims), pool
+    # occupancy/fragmentation gauges and HBM watermarks — snapshotted
+    # directly in self-serve runs or scraped from /metrics (analysis/
+    # telemetry.py KV_METRIC_KEYS); shape gated by validate_kv_cache.
+    # Absent for external engines.
+    kv_cache: Optional[dict[str, Any]] = None
+    # headroom-model validation (profiling/headroom.py): signed % error
+    # of the analytic admission estimate vs the observed HBM peak —
+    # negative = the model UNDERESTIMATES (the OOM direction). Present
+    # only when the run observed a real (or mocked) HBM watermark.
+    headroom_error_pct: Optional[float] = None
+
     extras: dict[str, Any] = field(default_factory=dict)
 
     def to_dict(self) -> dict[str, Any]:
@@ -442,6 +457,99 @@ def validate_proxy(doc: Any) -> list[str]:
     for key in ("compile_stats", "analytic_bytes", "exec", "hbm_headroom"):
         if key in doc and not isinstance(doc[key], dict):
             errs.append(f"{key} is not an object")
+    return errs
+
+
+# -- kv_cache block schema ----------------------------------------------------
+#
+# The KV-cache & HBM observability block (docs/TROUBLESHOOTING.md): what
+# the engine's kv_cache_snapshot and the analyzer's KV_METRIC_KEYS scrape
+# both produce under the `kv_cache` results key. Hand-rolled validator
+# like the others — no jsonschema dependency in the harness layers.
+# `make bench-smoke` gates on it.
+
+KV_CACHE_JSON_SCHEMA: dict[str, Any] = {
+    "$schema": "http://json-schema.org/draft-07/schema#",
+    "title": "kvmini-tpu results.json `kv_cache` block",
+    "type": "object",
+    "required": ["hit_depth_p50", "hit_depth_p95", "reused_bytes",
+                 "blocks_allocated", "retained_evictions"],
+    "properties": {
+        "source": {"type": "string"},
+        "hit_depth_p50": {"type": "number", "minimum": 0},
+        "hit_depth_p95": {"type": "number", "minimum": 0},
+        "bytes_per_token": {"type": "number", "minimum": 0},
+        "reused_bytes": {"type": "number", "minimum": 0},
+        "blocks_allocated": {"type": "number", "minimum": 0},
+        "retained_evictions": {"type": "number", "minimum": 0},
+        "share_reclaims": {"type": "number", "minimum": 0},
+        "prefix_hits": {"type": "number", "minimum": 0},
+        "prefix_lookups": {"type": "number", "minimum": 0},
+        "pool_blocks": {"type": "number", "minimum": 0},
+        "free_blocks": {"type": "number", "minimum": 0},
+        "retained_blocks": {"type": "number", "minimum": 0},
+        "used_blocks": {"type": "number", "minimum": 0},
+        "block_size": {"type": "number", "minimum": 1},
+        "occupancy": {"type": "number", "minimum": 0, "maximum": 1},
+        "retained_fraction": {"type": "number", "minimum": 0, "maximum": 1},
+        "fragmentation": {"type": "number", "minimum": 0, "maximum": 1},
+        "logical_bytes": {"type": "number", "minimum": 0},
+        "physical_bytes": {"type": "number", "minimum": 0},
+        "hbm_bytes_in_use": {"type": "number", "minimum": 0},
+        "hbm_peak_bytes": {"type": "number", "minimum": 0},
+        "hbm_bytes_limit": {"type": "number", "minimum": 0},
+        "headroom_estimate_bytes": {"type": "number", "minimum": 0},
+    },
+}
+
+_KV_FRACTIONS = ("occupancy", "retained_fraction", "fragmentation")
+
+
+def validate_kv_cache(doc: Any) -> list[str]:
+    """Validate a results.json ``kv_cache`` block against
+    KV_CACHE_JSON_SCHEMA's contract. Returns violations; empty = valid.
+    The invariants downstream consumers rely on: the required
+    hit-depth/reuse/churn keys present and numeric, every present
+    numeric non-negative, ratios inside [0, 1], p95 >= p50, and the
+    paged pool arithmetic (free + retained + used == pool) when the
+    pool gauges are present."""
+    errs: list[str] = []
+    if not isinstance(doc, dict):
+        return ["kv_cache block is not an object"]
+    for key in KV_CACHE_JSON_SCHEMA["required"]:
+        if not _num(doc.get(key)) or doc[key] < 0:
+            errs.append(f"{key} missing or not a non-negative number")
+    for key, spec in KV_CACHE_JSON_SCHEMA["properties"].items():
+        if key not in doc or spec.get("type") != "number":
+            continue
+        v = doc[key]
+        if not _num(v):
+            errs.append(f"{key} is not a number")
+            continue
+        if v < spec.get("minimum", 0):
+            errs.append(f"{key} below {spec.get('minimum', 0)} ({v})")
+        if key in _KV_FRACTIONS and v > 1:
+            errs.append(f"{key} above 1 ({v})")
+    if (
+        _num(doc.get("hit_depth_p50")) and _num(doc.get("hit_depth_p95"))
+        and doc["hit_depth_p95"] < doc["hit_depth_p50"]
+    ):
+        errs.append(
+            f"hit_depth_p95 < hit_depth_p50 "
+            f"({doc['hit_depth_p95']} < {doc['hit_depth_p50']})"
+        )
+    pool_keys = ("pool_blocks", "free_blocks", "retained_blocks",
+                 "used_blocks")
+    if all(_num(doc.get(k)) for k in pool_keys):
+        total = (doc["free_blocks"] + doc["retained_blocks"]
+                 + doc["used_blocks"])
+        if total != doc["pool_blocks"]:
+            errs.append(
+                f"pool arithmetic broken: free+retained+used={total} "
+                f"!= pool_blocks={doc['pool_blocks']}"
+            )
+    if "source" in doc and not isinstance(doc["source"], str):
+        errs.append("source is not a string")
     return errs
 
 

@@ -21,6 +21,8 @@ EVENT_TYPES = (
     "queue_depth_runaway",
     "duty_cycle_drop",
     "burn_rate_exceeded",
+    "kv_thrash",
+    "hbm_watermark_high",
 )
 
 
@@ -119,6 +121,9 @@ class EventDetector:
         burn_threshold: float = 2.0,
         burn_samples: int = 3,
         warmup_s: float = 5.0,
+        kv_thrash_rate: float = 4.0,
+        kv_thrash_samples: int = 3,
+        hbm_high_fraction: float = 0.92,
     ) -> None:
         self.stall_samples = stall_samples
         self.queue_samples = queue_samples
@@ -128,6 +133,9 @@ class EventDetector:
         self.burn_threshold = burn_threshold
         self.burn_samples = burn_samples
         self.warmup_s = warmup_s
+        self.kv_thrash_rate = kv_thrash_rate
+        self.kv_thrash_samples = kv_thrash_samples
+        self.hbm_high_fraction = hbm_high_fraction
         self._fired: set[str] = set()
         self._t0: Optional[float] = None
         self._prev: Optional[dict[str, Any]] = None
@@ -135,6 +143,7 @@ class EventDetector:
         self._stall_run = 0
         self._queue_run = 0
         self._burn_run = 0
+        self._thrash_run = 0
         self._peak_throughput = 0.0
         self._peak_duty = 0.0
 
@@ -258,6 +267,60 @@ class EventDetector:
         self._peak_duty = max(self._peak_duty, duty)
         return None
 
+    def _check_kv_thrash(self, sample: dict[str, Any]) -> Optional[Event]:
+        """Retained-pool eviction churn (docs/TROUBLESHOOTING.md "HBM
+        pressure & KV thrash"): the windowed rate of the retained-LRU
+        eviction counter stayed above threshold for N consecutive
+        samples — the prefix cache is being torn down as fast as it is
+        built, so every "hit" is paid for with a re-prefill elsewhere.
+        Rate-based (delta/dt), not level-based: a large total after a
+        long run is history, a sustained rate is live thrash."""
+        prev = self._prev
+        evictions = _runtime(sample, "kv_retained_evictions_total")
+        if prev is None or evictions is None:
+            return None
+        prev_ev = _runtime(prev, "kv_retained_evictions_total")
+        dt = sample["t"] - prev["t"]
+        if prev_ev is None or dt <= 0:
+            return None
+        rate = max(evictions - prev_ev, 0.0) / dt
+        if rate >= self.kv_thrash_rate:
+            self._thrash_run += 1
+        else:
+            self._thrash_run = 0
+        if self._thrash_run >= self.kv_thrash_samples:
+            return Event(
+                sample["t"], "kv_thrash",
+                f"retained-block eviction churn {rate:.1f}/s >= "
+                f"{self.kv_thrash_rate:g}/s for {self._thrash_run} "
+                "consecutive samples",
+                {"evictions_per_s": rate, "samples": self._thrash_run},
+            )
+        return None
+
+    def _check_hbm_watermark(self, sample: dict[str, Any]) -> Optional[Event]:
+        """HBM watermark crossed the high-water fraction of the device
+        limit. Level-based and immediate — unlike churn, a watermark is
+        not noisy, and by the time it is this close to the limit the
+        next big prefill can RESOURCE_EXHAUST the run. The headroom
+        guard admits at 90% of capacity, so the default 92% trigger
+        means the plan's margin is already gone."""
+        in_use = _runtime(sample, "hbm_bytes_in_use")
+        limit = _runtime(sample, "hbm_bytes_limit")
+        if in_use is None or not limit:
+            return None
+        frac = in_use / limit
+        if frac >= self.hbm_high_fraction:
+            return Event(
+                sample["t"], "hbm_watermark_high",
+                f"HBM in use {in_use / 1e9:.2f} GB is {frac:.0%} of the "
+                f"{limit / 1e9:.2f} GB limit "
+                f"(threshold {self.hbm_high_fraction:.0%})",
+                {"hbm_bytes_in_use": in_use, "hbm_bytes_limit": limit,
+                 "fraction": frac},
+            )
+        return None
+
     def _check_burn_rate(
         self, sample: dict[str, Any], burn: dict[str, float]
     ) -> Optional[Event]:
@@ -298,6 +361,8 @@ class EventDetector:
             ("throughput_collapse", self._check_throughput_collapse(sample)),
             ("duty_cycle_drop", self._check_duty_drop(sample)),
             ("burn_rate_exceeded", self._check_burn_rate(sample, burn or {})),
+            ("kv_thrash", self._check_kv_thrash(sample)),
+            ("hbm_watermark_high", self._check_hbm_watermark(sample)),
         ]
         self._prev = sample
         fired: list[Event] = []

@@ -44,6 +44,14 @@ TIMELINE_RUNTIME_METRICS = (
     "kvmini_tpu_requests_completed_total",
     "kvmini_tpu_pipelined_sweeps_total",
     "kvmini_tpu_kv_free_blocks",
+    # KV-cache & HBM deep observability (docs/TROUBLESHOOTING.md "HBM
+    # pressure & KV thrash"): pool occupancy + eviction churn feed the
+    # kv_thrash rule, the watermark pair feeds hbm_watermark_high, and
+    # all of them ride into the report's KV/memory timeline lanes
+    "kvmini_tpu_kv_occupancy",
+    "kvmini_tpu_kv_retained_evictions_total",
+    "kvmini_tpu_hbm_bytes_in_use",
+    "kvmini_tpu_hbm_bytes_limit",
 )
 
 _PREFIX = "kvmini_tpu_"
@@ -67,6 +75,9 @@ class MonitorConfig:
     burn_samples: int = 3
     stall_samples: int = 5
     queue_depth_limit: float = 32.0
+    kv_thrash_rate: float = 4.0       # retained evictions/s (docs/MONITORING.md)
+    kv_thrash_samples: int = 3
+    hbm_high_fraction: float = 0.92   # of kvmini_tpu_hbm_bytes_limit
     abort_enabled: bool = False
     abort_on: frozenset[str] = DEFAULT_ABORT_ON
     budgets: dict[str, float] = field(default_factory=dict)
@@ -116,6 +127,9 @@ class RunMonitor:
             burn_threshold=self.cfg.burn_threshold,
             burn_samples=self.cfg.burn_samples,
             warmup_s=self.cfg.warmup_s,
+            kv_thrash_rate=self.cfg.kv_thrash_rate,
+            kv_thrash_samples=self.cfg.kv_thrash_samples,
+            hbm_high_fraction=self.cfg.hbm_high_fraction,
         )
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None

@@ -64,6 +64,64 @@ def device_hbm_bytes(device: Any = None) -> Optional[int]:
     return None
 
 
+def hbm_watermarks(device: Any = None) -> dict[str, int]:
+    """Live HBM watermarks from ``device.memory_stats()``:
+    ``bytes_in_use`` always, ``peak_bytes_in_use``/``bytes_limit`` when
+    the backend reports them. Gracefully ABSENT — ``{}``, never
+    fabricated zeros — on CPU backends (whose devices raise or return
+    None) and when no backend is up at all, so consumers can tell
+    "no HBM telemetry" from "HBM empty" (docs/TROUBLESHOOTING.md)."""
+    if device is None:
+        try:
+            import jax
+
+            device = jax.devices()[0]
+        except Exception:  # noqa: BLE001 — no backend at all
+            return {}
+    try:
+        stats = device.memory_stats() or {}
+    except Exception:  # noqa: BLE001 — CPU devices raise/return nothing
+        return {}
+    in_use = stats.get("bytes_in_use")
+    if in_use is None:
+        return {}
+    out = {"bytes_in_use": int(in_use)}
+    for key in ("peak_bytes_in_use", "bytes_limit"):
+        v = stats.get(key)
+        if v:
+            out[key] = int(v)
+    return out
+
+
+def headroom_error_pct(
+    estimate_bytes: Any, observed_peak_bytes: Any
+) -> Optional[float]:
+    """Headroom-model validation: signed % error of the analytic
+    admission estimate vs the observed HBM peak. Positive = the model
+    overestimates (safe but wasteful headroom); negative = it
+    UNDERESTIMATES — the direction that RESOURCE_EXHAUSTs a run the
+    guard admitted (the BENCH_r02 class). None when either side is
+    missing or non-positive (no peak observed = nothing to validate)."""
+    try:
+        est = float(estimate_bytes)
+        peak = float(observed_peak_bytes)
+    except (TypeError, ValueError):
+        return None
+    if est <= 0 or peak <= 0:
+        return None
+    return round((est - peak) / peak * 100.0, 2)
+
+
+def kv_elem_bytes(head_dim: int, itemsize: float, quantized: bool = False) -> float:
+    """Physical bytes one KV element costs: the raw element, or — for
+    int8-quantized KV — 1 byte plus the per-head f32 scale amortized
+    across head_dim. THE single copy of the quantized-KV price: the
+    admission estimate (estimate_serving_bytes) and the engine's observed
+    bytes gauges (Engine.kv_bytes_per_token) must price identically or
+    headroom_error_pct compares two different models."""
+    return (1.0 + 4.0 / head_dim) if quantized else float(itemsize)
+
+
 def _weight_bytes_per_param(quant: str) -> float:
     # int8: 1 byte + per-channel f32 scales (~1/256 of elements, rounded
     # up generously); int4: packed nibbles + scales; else dtype width
@@ -87,7 +145,7 @@ def estimate_serving_bytes(
     KV + the f32 logits/workspace the prefill and sampling steps need.
     ``cfg`` is a ``models.config.ModelConfig`` (only dims are read)."""
     weights = int(cfg.param_count * _weight_bytes_per_param(quant))
-    kv_elem = (1 + 4.0 / cfg.head_dim) if kv_quant else cfg.jnp_dtype.itemsize
+    kv_elem = kv_elem_bytes(cfg.head_dim, cfg.jnp_dtype.itemsize, kv_quant)
     kv = int(2 * cfg.n_layers * slots * cfg.n_kv_heads * max_seq
              * cfg.head_dim * kv_elem)
     # f32 last-position logits for the batch + one full-bucket activation

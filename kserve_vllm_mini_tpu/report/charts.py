@@ -208,6 +208,100 @@ def run_timeline_chart(
     return _to_img(fig)
 
 
+def kv_timeline_chart(
+    samples: list[dict[str, Any]], events: list[dict[str, Any]] | None = None
+) -> str:
+    """KV-cache & memory over the run (docs/TROUBLESHOOTING.md "HBM
+    pressure & KV thrash") as three stacked lanes: paged-pool occupancy,
+    HBM watermark vs the device limit, and retained-eviction churn rate —
+    with the kv_thrash / hbm_watermark_high markers where they fired.
+    Lanes with no data stay empty rather than suppressing the chart, so
+    a dense-layout run still gets its HBM lane."""
+    rows = [
+        s for s in samples
+        if isinstance(s.get("t"), (int, float))
+        and isinstance(s.get("runtime"), dict)
+    ]
+    kv_keys = ("kv_occupancy", "kv_free_blocks", "hbm_bytes_in_use",
+               "kv_retained_evictions_total")
+    rows = [s for s in rows if any(k in s["runtime"] for k in kv_keys)]
+    if len(rows) < 2:
+        return ""  # no KV/HBM series sampled — nothing to draw
+    if not HAVE_MPL:
+        return _placeholder("KV cache & memory timeline")
+    t0 = rows[0]["t"]
+
+    def series(key: str) -> list[tuple[float, float]]:
+        return [
+            (s["t"] - t0, s["runtime"][key])
+            for s in rows if key in s["runtime"]
+        ]
+
+    fig, axes = plt.subplots(3, 1, figsize=(7, 5), sharex=True)
+    ax_occ, ax_hbm, ax_churn = axes
+
+    occ = series("kv_occupancy")
+    if occ:
+        ax_occ.plot([t for t, _ in occ], [v for _, v in occ],
+                    color=_PALETTE["primary"], linewidth=1.5,
+                    label="occupancy")
+        ax_occ.set_ylim(0, 1.05)
+    free = series("kv_free_blocks")
+    if free:
+        ax_free = ax_occ.twinx()
+        ax_free.plot([t for t, _ in free], [v for _, v in free],
+                     color=_PALETTE["cold"], linewidth=1, linestyle="--",
+                     label="free blocks")
+        ax_free.set_ylabel("free blocks", fontsize=8)
+    ax_occ.set_ylabel("pool occupancy")
+    ax_occ.set_title("KV cache & memory")
+
+    in_use = series("hbm_bytes_in_use")
+    limit = series("hbm_bytes_limit")
+    if in_use:
+        ax_hbm.plot([t for t, _ in in_use],
+                    [v / 1e9 for _, v in in_use],
+                    color=_PALETTE["warm"], linewidth=1.5, label="in use")
+    if limit:
+        ax_hbm.plot([t for t, _ in limit],
+                    [v / 1e9 for _, v in limit],
+                    color=_PALETTE["bad"], linewidth=1, linestyle=":",
+                    label="limit")
+    if in_use or limit:
+        ax_hbm.legend(fontsize=8, loc="upper left")
+    ax_hbm.set_ylabel("HBM (GB)")
+
+    ev = series("kv_retained_evictions_total")
+    churn = [
+        (tb, max(vb - va, 0.0) / (tb - ta))
+        for (ta, va), (tb, vb) in zip(ev, ev[1:]) if tb > ta
+    ]
+    if churn:
+        ax_churn.plot([t for t, _ in churn], [v for _, v in churn],
+                      color=_PALETTE["bad"], linewidth=1.5)
+    ax_churn.set_ylabel("evictions/s")
+    ax_churn.set_xlabel("time (s)")
+
+    kv_events = [
+        e for e in events or []
+        if e.get("type") in ("kv_thrash", "hbm_watermark_high")
+    ]
+    for ax in axes:
+        ax.grid(color=_PALETTE["grid"], axis="y")
+        for e in kv_events:
+            et = e.get("t")
+            if isinstance(et, (int, float)) and et >= t0:
+                ax.axvline(et - t0, color=_PALETTE["bad"], linestyle=":",
+                           linewidth=1)
+    for e in kv_events:
+        et = e.get("t")
+        if isinstance(et, (int, float)) and et >= t0:
+            ax_occ.text(et - t0, ax_occ.get_ylim()[1] * 0.9,
+                        str(e.get("type", "event")), fontsize=7, rotation=90,
+                        color=_PALETTE["bad"], va="top")
+    return _to_img(fig)
+
+
 def perf_trajectory_chart(traj: dict[str, Any]) -> str:
     """The perf trajectory (analysis/trajectory.py) as two stacked lanes:
     device tokens/s/chip for REAL rounds, compile-time + step-ratio for

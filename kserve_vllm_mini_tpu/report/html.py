@@ -136,16 +136,20 @@ def _duty_pct(results: dict[str, Any]) -> Optional[float]:
     return duty * 100 if duty is not None else None
 
 
-def _timeline_section(run_dir: Optional[Path], results: dict[str, Any]) -> str:
+def _timeline_section(
+    run_dir: Optional[Path], results: dict[str, Any],
+    samples: Optional[list[dict[str, Any]]] = None,
+) -> str:
     """Monitor timeline lane (docs/MONITORING.md): throughput / duty /
     queue over the run with event markers, plus the burn-rate and abort
     summary from the results `monitor` block. Renders beside the trace
     viewer — the trace explains one request, this explains the run."""
     if run_dir is None:
         return ""
-    from kserve_vllm_mini_tpu.core.rundir import RunDir
+    if samples is None:
+        from kserve_vllm_mini_tpu.core.rundir import RunDir
 
-    samples = RunDir(run_dir).read_timeline()
+        samples = RunDir(run_dir).read_timeline()
     mon = results.get("monitor") or {}
     events = mon.get("events") or []
     chart = charts.run_timeline_chart(samples, events)
@@ -285,6 +289,82 @@ def _compile_stats_section(results: dict[str, Any]) -> str:
     return "".join(parts)
 
 
+def _kv_cache_section(
+    results: dict[str, Any], run_dir: Optional[Path] = None,
+    samples: Optional[list[dict[str, Any]]] = None,
+) -> str:
+    """The "KV cache & memory" section (docs/TROUBLESHOOTING.md "HBM
+    pressure & KV thrash"): prefix-cache attribution facts, paged-pool
+    occupancy, HBM watermarks, the headroom-model verdict, and the
+    occupancy/watermark/churn timeline lanes with kv_thrash /
+    hbm_watermark_high markers. Rendered only when the run carried the
+    observability rail (kv_cache block or KV timeline series) — an
+    external engine's report simply has no section."""
+    kv = results.get("kv_cache")
+    kv = kv if isinstance(kv, dict) else {}
+    chart = ""
+    if run_dir is not None:
+        if samples is None:
+            from kserve_vllm_mini_tpu.core.rundir import RunDir
+
+            samples = RunDir(run_dir).read_timeline()
+        events = (results.get("monitor") or {}).get("events") or []
+        chart = charts.kv_timeline_chart(samples, events)
+    if not kv and not chart:
+        return ""
+    parts = ["<section><h2>KV cache & memory</h2>"]
+    facts = []
+    if kv.get("prefix_lookups"):
+        hits = kv.get("prefix_hits", 0)
+        facts.append(
+            f"prefix hits {hits:.0f}/{kv['prefix_lookups']:.0f} lookups"
+        )
+    if kv.get("hit_depth_p95"):
+        facts.append(
+            f"hit depth p50/p95 {kv.get('hit_depth_p50', 0):.0f}/"
+            f"{kv['hit_depth_p95']:.0f} tok"
+        )
+    if kv.get("reused_bytes"):
+        facts.append(f"{kv['reused_bytes'] / 1e6:.1f} MB KV reused")
+    if kv.get("blocks_allocated") is not None:
+        facts.append(
+            f"{kv['blocks_allocated']:.0f} blocks allocated · "
+            f"{kv.get('retained_evictions', 0):.0f} retained evictions · "
+            f"{kv.get('share_reclaims', 0):.0f} share reclaims"
+        )
+    if kv.get("occupancy") is not None:
+        facts.append(
+            f"pool occupancy {kv['occupancy']:.0%}"
+            + (f" · fragmentation {kv['fragmentation']:.0%}"
+               if kv.get("fragmentation") is not None else "")
+            + (f" · retained {kv['retained_fraction']:.0%}"
+               if kv.get("retained_fraction") is not None else "")
+        )
+    if kv.get("hbm_peak_bytes"):
+        hbm = f"HBM peak {kv['hbm_peak_bytes'] / 1e9:.2f} GB"
+        if kv.get("hbm_bytes_limit"):
+            hbm += (f" of {kv['hbm_bytes_limit'] / 1e9:.2f} GB "
+                    f"({kv['hbm_peak_bytes'] / kv['hbm_bytes_limit']:.0%})")
+        facts.append(hbm)
+    if facts:
+        parts.append(f"<p>{html_mod.escape(' · '.join(facts))}</p>")
+    err = results.get("headroom_error_pct")
+    if err is not None:
+        # negative = the analytic model UNDERESTIMATED the observed peak —
+        # the direction that RESOURCE_EXHAUSTs a run the guard admitted
+        cls = "bad" if err < 0 else ("warn" if err > 50 else "ok")
+        verdict = ("UNDERESTIMATES the observed peak (OOM risk)" if err < 0
+                   else "overestimates the observed peak")
+        parts.append(
+            f"<p class='{cls}'>headroom model {verdict}: "
+            f"{err:+.1f}% vs observed HBM peak</p>"
+        )
+    if chart:
+        parts.append(chart)
+    parts.append("</section>")
+    return "".join(parts)
+
+
 def generate_single_run_html(
     results: dict[str, Any], run_dir: Optional[Path] = None
 ) -> str:
@@ -404,7 +484,15 @@ def generate_single_run_html(
         + "</ul></section>"
     )
     sections.append(_compile_stats_section(results))
-    sections.append(_timeline_section(run_dir, results))
+    # one timeline.jsonl parse shared by the KV/memory and run-timeline
+    # sections (a long run's 1 Hz timeline is multi-MB)
+    timeline_samples: Optional[list[dict[str, Any]]] = None
+    if run_dir is not None:
+        from kserve_vllm_mini_tpu.core.rundir import RunDir
+
+        timeline_samples = RunDir(run_dir).read_timeline()
+    sections.append(_kv_cache_section(results, run_dir, timeline_samples))
+    sections.append(_timeline_section(run_dir, results, timeline_samples))
     sections.append(_trace_viewer(run_dir, results))
     sections.append(
         "<section><h2>Raw results</h2><details><summary>results.json</summary>"

@@ -371,6 +371,9 @@ class _AdminOp:
         try:
             self.fn()
         except Exception as e:  # noqa: BLE001 — error goes to the caller
+            # Event-ordered handoff: written on the scheduler thread
+            # BEFORE done.set(); callers read only after done.wait()
+            # kvmini: thread-ok — see above
             self.error = f"{type(e).__name__}: {e}"
         finally:
             self.done.set()
@@ -696,6 +699,25 @@ class Engine:
         # scheduler thread records, server threads snapshot.
         self._compile_recorder = CompileRecorder()
 
+        # KV/HBM observability (docs/TROUBLESHOOTING.md "HBM pressure &
+        # KV thrash"): prefix-hit depths (tokens reused per admission) in
+        # a bounded ring, appended on the scheduler thread only; the
+        # p50/p95 gauges are computed ON that thread too, inside the
+        # _kv_admin_snapshot admin op, so no derived ratio is ever built
+        # from torn cross-thread reads. _kv_gauges caches the last
+        # consistent snapshot (served when the admin op can't run, e.g.
+        # mid-shutdown) and _hbm_peak_seen tracks the high-water
+        # bytes_in_use across scrapes for backends whose memory_stats
+        # lacks a native peak counter; both move under _obs_lock because
+        # any scraper thread may update them.
+        from collections import deque
+
+        self._hit_depths: "deque[int]" = deque(maxlen=4096)
+        self._obs_lock = threading.Lock()
+        self._kv_gauges: dict[str, Any] = {}
+        self._kv_gauges_t = 0.0          # last refresh (scheduler clock)
+        self._hbm_peak_seen = 0
+
         # stats for /metrics and duty-cycle telemetry
         self.stats = {
             "prefill_tokens": 0,
@@ -712,6 +734,12 @@ class Engine:
             "prefix_hits": 0,       # admissions that reused a retained prefix
             "prefix_lookups": 0,    # admissions that ATTEMPTED prefix reuse
             "prefix_tokens_reused": 0,  # prompt tokens NOT re-prefilled
+            # paged-block lifecycle (docs/TROUBLESHOOTING.md "HBM pressure
+            # & KV thrash"): allocator churn the point-in-time pool gauges
+            # cannot show — all three only move on the scheduler thread
+            "kv_blocks_allocated": 0,    # fresh pool-block allocations
+            "kv_retained_evictions": 0,  # retained-pool LRU evictions
+            "kv_share_reclaims": 0,      # shared-block 0->1 rc claims
             # decode-pipeline telemetry (docs/DECODE_PIPELINE.md):
             "dispatch_depth": 0,    # high-water concurrently in-flight sweeps
             "pipelined_sweeps": 0,  # sweeps dispatched ahead of a retire
@@ -746,6 +774,40 @@ class Engine:
         self._phase_hist = {
             p: rt_tracing.PhaseHistogram() for p in rt_tracing.PHASES
         }
+
+        # Per-device analytic HBM footprint for headroom-model validation
+        # (profiling/headroom.py; docs/TROUBLESHOOTING.md): the guard's
+        # formula shape — weights + KV + workspace, x1.15 fusion margin —
+        # but with the weights term taken from the ACTUAL loaded tree
+        # (quant guessing validated separately by the guard's own tests)
+        # and the KV term priced by kv_bytes_per_token, so what
+        # headroom_error_pct measures is the analytic KV/workspace/margin
+        # model — the part whose underestimate OOMed BENCH_r02.
+        from kserve_vllm_mini_tpu.profiling.headroom import estimate_serving_bytes
+
+        weight_bytes = sum(
+            int(getattr(leaf, "nbytes", 0))
+            for leaf in jax.tree_util.tree_leaves(self.params)
+        )
+        if drafter is not None:
+            weight_bytes += sum(
+                int(getattr(leaf, "nbytes", 0))
+                for leaf in jax.tree_util.tree_leaves(self._drafter_params)
+            )
+        analytic = estimate_serving_bytes(
+            cfg, S, self.ecfg.max_seq_len, kv_quant=kv_quant
+        )
+        kv_bytes = S * self.ecfg.max_seq_len * self.kv_bytes_per_token()
+        n_dev = self.mesh.size if self.mesh is not None else 1
+        self._headroom_estimate_bytes = int(
+            (weight_bytes + kv_bytes + analytic["workspace_bytes"]) * 1.15
+        ) // n_dev
+
+        # seed the consistent-gauge cache with a build-time snapshot (the
+        # scheduler isn't running yet, so _run_admin executes inline):
+        # /metrics served before the first sweep must still carry the
+        # paged pool gauges rather than an empty fallback dict
+        self._kv_admin_snapshot()
 
     # -- paged-KV block accounting ----------------------------------------
 
@@ -821,9 +883,11 @@ class Engine:
     def _paged_alloc(self) -> int:
         """One fresh block: free list first, then evict the least-recently
         retained shared block (dropping its content-key registration)."""
+        self.stats["kv_blocks_allocated"] += 1
         if self._free_blocks:
             return self._free_blocks.pop()
         bid, _ = self._retained_lru.popitem(last=False)  # oldest
+        self.stats["kv_retained_evictions"] += 1  # LRU churn (kv_thrash)
         key = self._block_hash.pop(bid, None)
         if key is not None:
             self._hash_block.pop(key, None)
@@ -845,6 +909,7 @@ class Engine:
             rc = self._block_rc.get(bid, 0)
             if rc == 0:
                 self._retained_lru.pop(bid, None)
+                self.stats["kv_share_reclaims"] += 1  # 0->1: left the pool
             self._block_rc[bid] = rc + 1
         new_blocks = [self._paged_alloc() for _ in range(need_new)]
         for bid in new_blocks:
@@ -878,6 +943,7 @@ class Engine:
         if reuse:
             self.stats["prefix_hits"] += 1
             self.stats["prefix_tokens_reused"] += reused_len
+            self._hit_depths.append(reused_len)
         return reused_len
 
     def _paged_release(self, slot: int) -> None:
@@ -948,8 +1014,10 @@ class Engine:
     def _run_admin(self, fn, timeout_s: float = 60.0) -> Optional[str]:
         """Execute ``fn`` on the scheduler thread (between sweeps) and
         return its error string, or None on success. Direct call when the
-        scheduler isn't running (build-time / tests)."""
-        if not self._running:
+        scheduler isn't running (build-time / tests) — or when the caller
+        IS the scheduler thread (an op enqueued from the thread that
+        drains the queue would deadlock waiting on itself)."""
+        if not self._running or threading.current_thread() is self._thread:
             op = _AdminOp(fn)
             op.run()
             return op.error
@@ -1664,10 +1732,16 @@ class Engine:
             best_k = 0
             best_i = 0  # LRU victim (see above)
         slot = self._free.pop(best_i)
+        # accounting contract shared with the block-level path
+        # (_paged_admit_blocks): exactly one lookup per admission, a hit
+        # iff reused tokens > 0, and prefix_tokens_reused grows by the
+        # EXACT reused token count — pinned by the cross-path regression
+        # test (tests/test_kv_observability.py)
         self.stats["prefix_lookups"] += 1
         if best_k > 0:
             self.stats["prefix_hits"] += 1
             self.stats["prefix_tokens_reused"] += best_k
+            self._hit_depths.append(best_k)
         return slot, best_k
 
     def _prefill_chunks(self, prompt: list[int], slot: int, draft: bool = False,
@@ -2551,6 +2625,14 @@ class Engine:
         while self._running:
             try:
                 self._schedule_once()
+                # republish the derived KV gauges from THIS thread so
+                # /metrics & /healthz (event-loop handlers) can read a
+                # consistent snapshot without ever blocking on a sweep;
+                # ~4 Hz is plenty for the monitor's 1 Hz scrape
+                with self._obs_lock:
+                    stale = time.time() - self._kv_gauges_t >= 0.25
+                if stale:
+                    self._kv_admin_snapshot()
             except Exception as exc:  # scheduler must never die silently
                 import traceback
 
@@ -2583,13 +2665,47 @@ class Engine:
         s["queue_depth"] = self._queue_depth()
         # kvmini: thread-ok — benign racy snapshot (see above)
         s["inflight_sweeps"] = len(self._inflight)
+        # Derived KV gauges (occupancy, fragmentation, retained fraction,
+        # hit-depth percentiles) come from ONE consistent scheduler-thread
+        # pass (_kv_admin_snapshot): a ratio built from independent
+        # lock-free len() reads could tear between them, which the
+        # single-writer annotations above never had to worry about.
+        kv = self._kv_admin_snapshot()
+        s["kv_prefix_hit_depth_p50"] = kv.get("kv_prefix_hit_depth_p50", 0)
+        s["kv_prefix_hit_depth_p95"] = kv.get("kv_prefix_hit_depth_p95", 0)
+        s["kv_bytes_per_token"] = self.kv_bytes_per_token()
+        # physical bytes the reused prompt tokens did NOT re-write — the
+        # byte-denominated view of prefix_tokens_reused_total
+        s["kv_reused_bytes"] = s["prefix_tokens_reused"] * s["kv_bytes_per_token"]
+        # per-device analytic footprint (computed once at build; see
+        # __init__) — exported so headroom_error_pct can be derived from a
+        # plain /metrics scrape next to the observed watermark
+        s["hbm_headroom_estimate_bytes"] = self._headroom_estimate_bytes
         if self.paged:
-            s["kv_pool_blocks"] = self._scratch_block
-            # kvmini: thread-ok — benign racy snapshot (see above)
-            s["kv_free_blocks"] = len(self._free_blocks)
-            # kvmini: thread-ok — benign racy snapshot (see above)
-            s["kv_retained_blocks"] = len(self._retained_lru)
-            s["kv_block_size"] = self._blk
+            for key in ("kv_pool_blocks", "kv_free_blocks",
+                        "kv_retained_blocks", "kv_used_blocks",
+                        "kv_block_size", "kv_occupancy",
+                        "kv_retained_fraction", "kv_fragmentation",
+                        "kv_logical_bytes", "kv_physical_bytes"):
+                if key in kv:
+                    s[key] = kv[key]
+        # HBM watermarks (docs/TROUBLESHOOTING.md): device memory_stats
+        # when the backend reports them — gracefully absent (no keys, no
+        # fabricated zeros) on CPU backends that don't
+        from kserve_vllm_mini_tpu.profiling.headroom import hbm_watermarks
+
+        hbm = hbm_watermarks()
+        if hbm:
+            s["hbm_bytes_in_use"] = hbm["bytes_in_use"]
+            if "bytes_limit" in hbm:
+                s["hbm_bytes_limit"] = hbm["bytes_limit"]
+            with self._obs_lock:
+                self._hbm_peak_seen = max(
+                    self._hbm_peak_seen,
+                    hbm.get("peak_bytes_in_use", 0),
+                    hbm["bytes_in_use"],
+                )
+                s["hbm_peak_bytes"] = self._hbm_peak_seen
         s["spec_accept_ratio"] = (
             s["spec_accepted"] / s["spec_proposed"] if s["spec_proposed"] else 0.0
         )
@@ -2602,6 +2718,144 @@ class Engine:
         s["compiled_bytes"] = cs["compiled_bytes"]
         s["compile_peak_bytes"] = cs["compile_peak_bytes"]
         return s
+
+    def kv_bytes_per_token(self) -> int:
+        """Physical KV bytes one cached position costs, parameterized by
+        the KV dtype — priced by the SAME kv_elem_bytes formula the
+        admission estimate uses (profiling/headroom.py), so
+        headroom_error_pct never compares two different models and the
+        logical/physical byte gauges keep reading true when quantized
+        KV lands on the paged path (ROADMAP item 3)."""
+        from kserve_vllm_mini_tpu.profiling.headroom import kv_elem_bytes
+
+        cfg = self.cfg
+        if self.ecfg.kv_cache_dtype == "int8":
+            elem = kv_elem_bytes(cfg.head_dim, 0.0, quantized=True)
+        elif self.ecfg.kv_cache_dtype:
+            elem = kv_elem_bytes(
+                cfg.head_dim, jnp.dtype(self.ecfg.kv_cache_dtype).itemsize
+            )
+        else:
+            elem = kv_elem_bytes(cfg.head_dim, cfg.jnp_dtype.itemsize)
+        return int(2 * cfg.n_layers * cfg.n_kv_heads * cfg.head_dim * elem)
+
+    def _kv_admin_snapshot(self, force: bool = False) -> dict[str, Any]:
+        """Every DERIVED KV gauge — pool occupancy, fragmentation,
+        retained fraction, logical/physical bytes, prefix-hit-depth
+        percentiles — computed in ONE pass ON the scheduler thread.
+        Ratios over ``_free_blocks``/``_retained_lru`` built from
+        independent lock-free ``len()`` reads could tear between the
+        reads: the single-writer discipline those attributes live under
+        makes a lone stale length benign, but not a ratio of two lengths
+        from different sweeps.
+
+        While the scheduler runs, cross-thread callers (the aiohttp
+        /metrics and /healthz handlers — which live ON the event loop, so
+        they must never block on a sweep) read the cache the scheduler
+        republishes every ~250 ms from its own loop; ``force=True``
+        (the once-per-run results snapshot, called off the event loop)
+        rendezvouses via ``_run_admin`` for a fully fresh pass, falling
+        back to the cache on timeout/shutdown."""
+        if (
+            self._running
+            and threading.current_thread() is not self._thread
+            and not force
+        ):
+            with self._obs_lock:
+                return dict(self._kv_gauges)
+        fresh: dict[str, Any] = {}
+
+        def _collect() -> None:
+            depths = sorted(self._hit_depths)
+
+            def pct(p: float) -> int:
+                if not depths:
+                    return 0
+                k = max(int(round(p / 100.0 * len(depths) + 0.5)) - 1, 0)
+                return depths[min(k, len(depths) - 1)]
+
+            fresh["kv_prefix_hit_depth_p50"] = pct(50.0)
+            fresh["kv_prefix_hit_depth_p95"] = pct(95.0)
+            if not self.paged:
+                return
+            pool = self._scratch_block
+            free = len(self._free_blocks)
+            retained = len(self._retained_lru)
+            used = pool - free - retained
+            bpt = self.kv_bytes_per_token()
+            live = sum(
+                self._slot_len[i]
+                for i in range(self.ecfg.max_slots)
+                if self._slot_blocks[i]
+            )
+            fresh.update({
+                "kv_pool_blocks": pool,
+                "kv_free_blocks": free,
+                "kv_retained_blocks": retained,
+                "kv_used_blocks": used,
+                "kv_block_size": self._blk,
+                "kv_occupancy": used / pool,
+                "kv_retained_fraction": retained / pool,
+                # allocated-but-unwritten positions inside slot-owned
+                # blocks (reservations are worst-case); shared prefixes
+                # can push live-token totals past used*blk, so clamp
+                "kv_fragmentation": (
+                    min(max(1.0 - live / (used * self._blk), 0.0), 1.0)
+                    if used > 0 else 0.0
+                ),
+                "kv_logical_bytes": live * bpt,
+                "kv_physical_bytes": pool * self._blk * bpt,
+            })
+
+        err = self._run_admin(_collect, timeout_s=2.0)
+        with self._obs_lock:
+            if err is None and fresh:
+                self._kv_gauges = dict(fresh)
+                self._kv_gauges_t = time.time()
+            return dict(self._kv_gauges)
+
+    def kv_cache_snapshot(self) -> dict[str, Any]:
+        """The results.json ``kv_cache`` block (core/schema.py
+        validate_kv_cache): lifecycle counters plus the derived gauges,
+        keyed the way the analyzer's /metrics scrape maps them
+        (analysis/telemetry.py KV_METRIC_KEYS) — snapshotted directly in
+        self-serve runs, where it is authoritative (it cannot race the
+        server teardown the way a post-run scrape can). Called off the
+        event loop once per run, so it can afford the forced scheduler
+        rendezvous for a fully fresh gauge pass."""
+        self._kv_admin_snapshot(force=True)
+        s = self.snapshot_stats()
+        block: dict[str, Any] = {
+            "source": "engine:snapshot",
+            "hit_depth_p50": s["kv_prefix_hit_depth_p50"],
+            "hit_depth_p95": s["kv_prefix_hit_depth_p95"],
+            "bytes_per_token": s["kv_bytes_per_token"],
+            "reused_bytes": s["kv_reused_bytes"],
+            "blocks_allocated": s["kv_blocks_allocated"],
+            "retained_evictions": s["kv_retained_evictions"],
+            "share_reclaims": s["kv_share_reclaims"],
+            "prefix_hits": s["prefix_hits"],
+            "prefix_lookups": s["prefix_lookups"],
+            "headroom_estimate_bytes": s["hbm_headroom_estimate_bytes"],
+        }
+        for stats_key, sub in (
+            ("kv_pool_blocks", "pool_blocks"),
+            ("kv_free_blocks", "free_blocks"),
+            ("kv_retained_blocks", "retained_blocks"),
+            ("kv_used_blocks", "used_blocks"),
+            ("kv_block_size", "block_size"),
+            ("kv_occupancy", "occupancy"),
+            ("kv_retained_fraction", "retained_fraction"),
+            ("kv_fragmentation", "fragmentation"),
+            ("kv_logical_bytes", "logical_bytes"),
+            ("kv_physical_bytes", "physical_bytes"),
+            ("hbm_bytes_in_use", "hbm_bytes_in_use"),
+            ("hbm_peak_bytes", "hbm_peak_bytes"),
+            ("hbm_bytes_limit", "hbm_bytes_limit"),
+        ):
+            if stats_key in s:
+                block[sub] = s[stats_key]
+        return block
 
     def compile_stats_snapshot(self) -> dict[str, Any]:
         """The results.json ``compile_stats`` block (docs/PROFILING.md):

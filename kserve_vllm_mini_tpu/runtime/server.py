@@ -1206,6 +1206,30 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
             f"kvmini_tpu_compiled_bytes_total {s['compiled_bytes']:.6g}",
             "# TYPE kvmini_tpu_compile_peak_bytes gauge",
             f"kvmini_tpu_compile_peak_bytes {s['compile_peak_bytes']}",
+            # KV-cache lifecycle + prefix-cache attribution (docs/
+            # TROUBLESHOOTING.md "HBM pressure & KV thrash"): allocator
+            # churn counters the point-in-time pool gauges cannot show,
+            # hit-depth percentiles from one consistent scheduler-thread
+            # snapshot, and the byte-denominated reuse view
+            "# TYPE kvmini_tpu_kv_blocks_allocated_total counter",
+            f"kvmini_tpu_kv_blocks_allocated_total {s['kv_blocks_allocated']}",
+            "# TYPE kvmini_tpu_kv_retained_evictions_total counter",
+            f"kvmini_tpu_kv_retained_evictions_total {s['kv_retained_evictions']}",
+            "# TYPE kvmini_tpu_kv_share_reclaims_total counter",
+            f"kvmini_tpu_kv_share_reclaims_total {s['kv_share_reclaims']}",
+            "# TYPE kvmini_tpu_kv_prefix_hit_depth_p50 gauge",
+            f"kvmini_tpu_kv_prefix_hit_depth_p50 {s['kv_prefix_hit_depth_p50']}",
+            "# TYPE kvmini_tpu_kv_prefix_hit_depth_p95 gauge",
+            f"kvmini_tpu_kv_prefix_hit_depth_p95 {s['kv_prefix_hit_depth_p95']}",
+            "# TYPE kvmini_tpu_kv_bytes_per_token gauge",
+            f"kvmini_tpu_kv_bytes_per_token {s['kv_bytes_per_token']}",
+            "# TYPE kvmini_tpu_kv_reused_bytes_total counter",
+            f"kvmini_tpu_kv_reused_bytes_total {s['kv_reused_bytes']}",
+            # per-device analytic footprint (profiling/headroom.py): the
+            # admission model's estimate, exported so headroom_error_pct
+            # is derivable from a scrape next to the observed watermark
+            "# TYPE kvmini_tpu_hbm_headroom_estimate_bytes gauge",
+            f"kvmini_tpu_hbm_headroom_estimate_bytes {s['hbm_headroom_estimate_bytes']}",
         ]
         if "kv_pool_blocks" in s:  # paged layout only
             lines += [
@@ -1215,8 +1239,32 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
                 f"kvmini_tpu_kv_free_blocks {s['kv_free_blocks']}",
                 "# TYPE kvmini_tpu_kv_retained_blocks gauge",
                 f"kvmini_tpu_kv_retained_blocks {s['kv_retained_blocks']}",
+                "# TYPE kvmini_tpu_kv_used_blocks gauge",
+                f"kvmini_tpu_kv_used_blocks {s['kv_used_blocks']}",
                 "# TYPE kvmini_tpu_kv_block_size gauge",
                 f"kvmini_tpu_kv_block_size {s['kv_block_size']}",
+                "# TYPE kvmini_tpu_kv_occupancy gauge",
+                f"kvmini_tpu_kv_occupancy {s['kv_occupancy']:.6f}",
+                "# TYPE kvmini_tpu_kv_retained_fraction gauge",
+                f"kvmini_tpu_kv_retained_fraction {s['kv_retained_fraction']:.6f}",
+                "# TYPE kvmini_tpu_kv_fragmentation gauge",
+                f"kvmini_tpu_kv_fragmentation {s['kv_fragmentation']:.6f}",
+                "# TYPE kvmini_tpu_kv_logical_bytes gauge",
+                f"kvmini_tpu_kv_logical_bytes {s['kv_logical_bytes']}",
+                "# TYPE kvmini_tpu_kv_physical_bytes gauge",
+                f"kvmini_tpu_kv_physical_bytes {s['kv_physical_bytes']}",
+            ]
+        if "hbm_bytes_in_use" in s:  # device reports memory_stats only
+            lines += [
+                "# TYPE kvmini_tpu_hbm_bytes_in_use gauge",
+                f"kvmini_tpu_hbm_bytes_in_use {s['hbm_bytes_in_use']}",
+                "# TYPE kvmini_tpu_hbm_peak_bytes gauge",
+                f"kvmini_tpu_hbm_peak_bytes {s['hbm_peak_bytes']}",
+            ]
+        if "hbm_bytes_limit" in s:
+            lines += [
+                "# TYPE kvmini_tpu_hbm_bytes_limit gauge",
+                f"kvmini_tpu_hbm_bytes_limit {s['hbm_bytes_limit']}",
             ]
         # per-phase latency histograms (docs/TRACING.md): queue / prefill /
         # decode / emit durations the engine observes at phase transitions

@@ -273,6 +273,30 @@ def make_app(
         "kvmini_tpu_duty_cycle": 0.8,
         "kvmini_tpu_queue_depth": 0.0,
         "kvmini_tpu_active_slots": 2.0,
+        # KV-cache & HBM rail (docs/TROUBLESHOOTING.md "HBM pressure &
+        # KV thrash"): the gauges the sampler polls into timeline.jsonl
+        # and the analyzer scrapes into the kv_cache block — a mocked-HBM
+        # watermark + estimate pair so headroom_error_pct closes without
+        # a device (estimate 12 GB vs peak 10 GB -> +20%)
+        "kvmini_tpu_kv_prefix_hit_depth_p50": 8.0,
+        "kvmini_tpu_kv_prefix_hit_depth_p95": 16.0,
+        "kvmini_tpu_kv_bytes_per_token": 128.0,
+        "kvmini_tpu_kv_reused_bytes_total": 2048.0,
+        "kvmini_tpu_kv_blocks_allocated_total": 6.0,
+        "kvmini_tpu_kv_retained_evictions_total": 2.0,
+        "kvmini_tpu_kv_share_reclaims_total": 2.0,
+        "kvmini_tpu_prefix_hits_total": 1.0,
+        "kvmini_tpu_cache_lookups_total": 2.0,
+        "kvmini_tpu_kv_pool_blocks": 8.0,
+        "kvmini_tpu_kv_free_blocks": 4.0,
+        "kvmini_tpu_kv_retained_blocks": 0.0,
+        "kvmini_tpu_kv_used_blocks": 4.0,
+        "kvmini_tpu_kv_block_size": 4.0,
+        "kvmini_tpu_kv_occupancy": 0.5,
+        "kvmini_tpu_hbm_bytes_in_use": 9.5e9,
+        "kvmini_tpu_hbm_peak_bytes": 10e9,
+        "kvmini_tpu_hbm_bytes_limit": 16e9,
+        "kvmini_tpu_hbm_headroom_estimate_bytes": 12e9,
         **(pipeline_metrics or {}),
     }
     t_app_start = time.time()

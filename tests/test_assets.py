@@ -117,7 +117,7 @@ def test_chart_template_covers_multihost_and_quant():
 
 def test_dashboards_valid_and_tpu_native():
     files = sorted((REPO / "dashboards").glob("*.json"))
-    assert len(files) == 6
+    assert len(files) == 7
     uids = set()
     for f in files:
         d = json.loads(f.read_text())
@@ -130,7 +130,7 @@ def test_dashboards_valid_and_tpu_native():
         assert "DCGM" not in text and "nvidia" not in text.lower(), (
             f"{f.name} references GPU metrics"
         )
-    assert len(uids) == 6  # unique dashboard uids
+    assert len(uids) == 7  # unique dashboard uids
 
 
 def test_run_timeline_dashboard_uses_windowed_duty():
@@ -155,6 +155,26 @@ def test_compile_stats_dashboard_queries_profiling_metrics():
     assert "kvmini_tpu_compiled_flops_total" in d
     assert "kvmini_tpu_compiled_bytes_total" in d
     assert "kvmini_tpu_compile_peak_bytes" in d
+
+
+def test_kv_cache_dashboard_queries_kv_and_hbm_metrics():
+    """The KV-cache board (docs/TROUBLESHOOTING.md "HBM pressure & KV
+    thrash") must query the series the runtime actually emits — KVM032
+    keeps the names aligned, this pins the panels: churn is a RATE
+    signal (rate() over the eviction/allocation counters, the kv_thrash
+    detector's input), occupancy/fragmentation are level gauges, and the
+    HBM lane shows watermark + limit + the admission-model estimate the
+    headroom_error_pct validation compares against."""
+    d = (REPO / "dashboards" / "kv-cache.json").read_text()
+    assert "rate(kvmini_tpu_kv_retained_evictions_total" in d
+    assert "rate(kvmini_tpu_kv_blocks_allocated_total" in d
+    assert "kvmini_tpu_kv_occupancy" in d
+    assert "kvmini_tpu_kv_fragmentation" in d
+    assert "kvmini_tpu_kv_prefix_hit_depth_p95" in d
+    assert "rate(kvmini_tpu_kv_reused_bytes_total" in d
+    assert "kvmini_tpu_hbm_bytes_in_use" in d
+    assert "kvmini_tpu_hbm_bytes_limit" in d
+    assert "kvmini_tpu_hbm_headroom_estimate_bytes" in d
 
 
 def test_utilization_dashboard_queries_tpu_metrics():

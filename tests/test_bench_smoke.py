@@ -102,7 +102,41 @@ def test_bench_smoke_surfaces_pipeline_counters(tmp_path):
         # ISSUE 4: the run carried the live monitor — a schema-valid
         # monitor block in results.json plus timeline.jsonl on disk
         assert validate_monitor(persisted["monitor"]) == []
-        assert validate_timeline(run_dir.read_timeline()) == []
+        timeline = run_dir.read_timeline()
+        assert validate_timeline(timeline) == []
+
+        # ISSUE 8: the KV-cache & HBM rail rode the same scrape — a
+        # schema-valid kv_cache block with the mock's hit-depth /
+        # reuse / churn gauges, and the headroom-model validation
+        # closed from the mocked estimate-vs-peak pair
+        # (12 GB estimate vs 10 GB observed peak -> +20%)
+        from kserve_vllm_mini_tpu.core.schema import validate_kv_cache
+
+        kv = persisted["kv_cache"]
+        assert validate_kv_cache(kv) == []
+        assert kv["source"] == "metrics:scrape"
+        assert kv["hit_depth_p50"] == 8.0
+        assert kv["hit_depth_p95"] == 16.0
+        assert kv["reused_bytes"] == 2048.0
+        assert kv["retained_evictions"] == 2.0
+        assert persisted["headroom_error_pct"] == 20.0
+
+        # and the monitor's timeline rows carry the HBM/KV keys the
+        # kv_thrash / hbm_watermark_high rules and the report's
+        # KV/memory lanes read (sampler strips the kvmini_tpu_ prefix)
+        with_runtime = [s["runtime"] for s in timeline if "runtime" in s]
+        assert with_runtime
+        assert all("hbm_bytes_in_use" in r for r in with_runtime)
+        assert all("kv_free_blocks" in r for r in with_runtime)
+        assert all("kv_retained_evictions_total" in r for r in with_runtime)
+
+        # the report renders the "KV cache & memory" section from the
+        # block + timeline
+        from kserve_vllm_mini_tpu.report.html import generate_single_run_html
+
+        html = generate_single_run_html(persisted, run_dir=run_dir.path)
+        assert "KV cache & memory" in html
+        assert "headroom model" in html
     finally:
         stop.set()
         t.join(timeout=5)
