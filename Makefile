@@ -11,20 +11,37 @@ test:
 	# >=2 workers REQUIRED, not an optimization: a single process running
 	# the whole suite segfaults around test ~335 (XLA:CPU state
 	# accumulation; see docs/TROUBLESHOOTING.md). xdist keeps each worker
-	# under the threshold.
-	$(PY) -m pytest tests/ -q -n 2
+	# under the threshold; without it (minimal containers), two sequential
+	# half-suite PROCESSES hold the same bound — slower, same signal.
+	@if $(PY) -c "import xdist" 2>/dev/null; then \
+	  $(PY) -m pytest tests/ -q -n 2; \
+	else \
+	  echo "NOTE: pytest-xdist not installed — running the suite as two sequential half-processes (single-process full suite segfaults ~test 335, docs/TROUBLESHOOTING.md)"; \
+	  r=0; $(PY) -m pytest tests/test_[a-l]*.py -q || r=1; \
+	  $(PY) -m pytest tests/test_[m-z]*.py -q || r=1; exit $$r; \
+	fi
 
 test-fast: lint-invariants  ## harness-only tests (skip JAX model/runtime suites)
 	# -n 4: the harness lane is embarrassingly parallel; measured 11 min
 	# -> <3 min on this box (the single-process segfault threshold only
 	# bites the FULL suite, and xdist workers stay far under it)
-	$(PY) -m pytest tests/ -q -m "not slow" -n 4 --ignore=tests/test_model.py \
+	# (without xdist the fast tier runs single-process: it stays far
+	# under the segfault threshold, so only wall time is lost)
+	@if $(PY) -c "import xdist" 2>/dev/null; then XDIST="-n 4"; \
+	else XDIST=""; echo "NOTE: pytest-xdist not installed — fast tier running single-process"; fi; \
+	$(PY) -m pytest tests/ -q -m "not slow" $$XDIST --ignore=tests/test_model.py \
 	  --ignore=tests/test_parallel.py --ignore=tests/test_flash_attention.py \
 	  --ignore=tests/test_runtime.py --ignore=tests/test_loader.py \
 	  --ignore=tests/test_quant.py
 
 lint:
-	$(PY) -m ruff check kserve_vllm_mini_tpu tests
+	# the ruff gate runs wherever ruff exists; a minimal container gets a
+	# LOUD skip line, never a silent pass (tier-1 signal stays honest)
+	@if $(PY) -m ruff --version >/dev/null 2>&1; then \
+	  $(PY) -m ruff check kserve_vllm_mini_tpu tests; \
+	else \
+	  echo "SKIPPED: ruff not installed — the ruff gate DID NOT RUN in this container"; \
+	fi
 	$(PY) -c "import yaml,glob;[list(yaml.safe_load_all(open(f))) for f in glob.glob('profiles/**/*.yaml',recursive=True)+glob.glob('policies/**/*.yaml',recursive=True)]"
 	$(PY) -c "import json,glob;[json.load(open(f)) for f in glob.glob('dashboards/*.json')]"
 

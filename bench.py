@@ -88,6 +88,19 @@ def _env_quant() -> str:
     return _knob("KVMINI_BENCH_QUANT")
 
 
+def _env_quant_mode() -> str:
+    mode = _knob("KVMINI_BENCH_QUANT_MODE")
+    if mode not in ("dequant", "w8a8"):
+        # fail LOUD at the knob, not silently-dequant at the dispatch: a
+        # typo'd mode would bench the wrong program under the requested
+        # label (ops/quant.py linear dispatches on exact "w8a8")
+        raise SystemExit(
+            f"KVMINI_BENCH_QUANT_MODE={mode!r}: known modes are "
+            "'dequant', 'w8a8'"
+        )
+    return mode
+
+
 def _env_slots() -> int:
     return int(_knob("KVMINI_BENCH_SLOTS"))
 
@@ -238,6 +251,7 @@ def _run_serving_child(mode: str) -> dict:
 
     model = _env_model()
     quant = "int4" if mode == "int4" else _env_quant()
+    quant_mode = _env_quant_mode() if quant != "none" else "dequant"
     paged = mode == "paged" or _knob("KVMINI_BENCH_PAGED") == "1"
     kv_quant = _knob("KVMINI_BENCH_KV") == "int8"
     # more slots amortize the 9 GB int8 weight stream over more tokens per
@@ -270,6 +284,7 @@ def _run_serving_child(mode: str) -> dict:
         ctx_need = prompt_len + warmup + decode_steps + decode_steps // 4 + 1
         plan = serving_headroom_plan(
             model, slots, max_seq, quant, kv_quant, capacity,
+            quant_mode=quant_mode,
             min_seq=min(max(256, ctx_need), max_seq),
         )
         headroom = plan.to_dict()
@@ -290,9 +305,10 @@ def _run_serving_child(mode: str) -> dict:
             slots, max_seq = plan.slots, plan.max_seq
             _progress(f"{mode}.headroom", headroom)
 
-    cfg = get_config(model, max_seq_len=max_seq, scan_unroll=unroll)
-    _log(f"mode={mode} model={model} quant={quant} slots={slots} paged={paged} "
-         f"unroll={unroll} backend={backend}")
+    cfg = get_config(model, max_seq_len=max_seq, scan_unroll=unroll,
+                     quant_mode=quant_mode)
+    _log(f"mode={mode} model={model} quant={quant} quant_mode={quant_mode} "
+         f"slots={slots} paged={paged} unroll={unroll} backend={backend}")
     # int8/int4 weights are built layer-by-layer straight into quantized
     # leaves — the full-precision 8B tree (~16 GB bf16) must NEVER exist on
     # a 16 GB v5e (round-2 OOM)
@@ -409,7 +425,8 @@ def _run_serving_child(mode: str) -> dict:
         for T in (128, 512, 2048):
             try:
                 cfgT = cfg if T <= max_seq else get_config(
-                    model, max_seq_len=T, scan_unroll=unroll
+                    model, max_seq_len=T, scan_unroll=unroll,
+                    quant_mode=quant_mode,
                 )
                 cT = init_kv_cache(cfgT, 1, max_seq=max(T, max_seq),
                                    quantized=kv_quant)
@@ -532,6 +549,7 @@ def _run_serving_child(mode: str) -> dict:
     data = {
         "model": cfg.name,
         "quant": quant + ("+int8kv" if kv_quant else ""),
+        "quant_mode": quant_mode,
         "paged": paged,
         "slots": slots,
         "tokens_per_sec_per_chip": round(per_chip, 1),
@@ -615,7 +633,9 @@ def _run_hbm_child() -> dict:
     ]
     on_tpu = _safe_backend(jax) == "tpu"
     unroll = int(_knob("KVMINI_BENCH_UNROLL"))
-    cfg = get_config(model, max_seq_len=max_seq, scan_unroll=unroll)
+    quant_mode = _env_quant_mode() if quant != "none" else "dequant"
+    cfg = get_config(model, max_seq_len=max_seq, scan_unroll=unroll,
+                     quant_mode=quant_mode)
     if quant in ("int8", "int4"):
         params = init_params_quantized(
             jax.random.PRNGKey(0), cfg, bits=4 if quant == "int4" else 8
@@ -625,7 +645,8 @@ def _run_hbm_child() -> dict:
     jax.block_until_ready(params)
     param_bytes = quantized_bytes(params)
     n_chips = jax.device_count()
-    _log(f"hbm: model={model} quant={quant} slot grid={slot_grid}")
+    _log(f"hbm: model={model} quant={quant} quant_mode={quant_mode} "
+         f"slot grid={slot_grid}")
 
     rows = []
     for S in slot_grid:
@@ -778,7 +799,9 @@ def _run_spec_child() -> dict:
     prompt_len = 128
     max_seq = 512
     unroll = int(_knob("KVMINI_BENCH_UNROLL"))
-    cfg = get_config(model, max_seq_len=max_seq, scan_unroll=unroll)
+    quant_mode = _env_quant_mode() if quant != "none" else "dequant"
+    cfg = get_config(model, max_seq_len=max_seq, scan_unroll=unroll,
+                     quant_mode=quant_mode)
     n_chips = jax.device_count()
     _log(f"spec: model={model} drafter={drafter} k={spec_k} slots={s_slots} "
          f"backend={_safe_backend(jax)}")
@@ -948,6 +971,9 @@ def _run_proxy_child() -> dict:
         slots=_env_slots(),
         decode_steps=int(_knob("KVMINI_BENCH_PROXY_STEPS")),
         kv_quant=_knob("KVMINI_BENCH_KV") == "int8",
+        quant_mode=(
+            _env_quant_mode() if _env_quant() != "none" else "dequant"
+        ),
         hbm_bytes=hbm,
     )
     _progress("proxy.block", data)
@@ -1413,8 +1439,16 @@ _ENV_KNOBS = {
     ),
     "KVMINI_BENCH_KV": (
         "--kv", "",
-        "KV-cache quantization: 'int8' for scaled int8 KV, empty for the "
-        "model dtype",
+        "KV-cache quantization (kv_cache_dtype): 'int8' for scaled int8 "
+        "KV (dense decode dequantizes in-kernel on TPU, paged already "
+        "does), empty for the model dtype",
+    ),
+    "KVMINI_BENCH_QUANT_MODE": (
+        "--quant-mode", "dequant",
+        "how quantized matmuls contract (ops/qmatmul.py): 'dequant' casts "
+        "the int weight to bf16 before the dot (W8A16/W4A16), 'w8a8' "
+        "quantizes activations per token and contracts int8 x int8 on the "
+        "MXU; also labels the proxy tier's compile drift",
     ),
     "KVMINI_BENCH_PAGED": (
         "--paged", "",

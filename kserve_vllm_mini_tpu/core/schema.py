@@ -415,6 +415,11 @@ PROXY_JSON_SCHEMA: dict[str, Any] = {
         "n_devices": {"type": "integer", "minimum": 1},
         "model": {"type": "string"},
         "exec_model": {"type": "string"},
+        # quantization labels: a proxy round's compile drift is only
+        # comparable against rounds of the same quant/quant_mode/kv_quant
+        "quant": {"type": "string"},
+        "quant_mode": {"enum": ["dequant", "w8a8"]},
+        "kv_quant": {"type": "boolean"},
         "flops": {"type": "number", "minimum": 0},
         "bytes_accessed": {"type": "number", "minimum": 0},
         "compile_wall_s": {"type": "number", "exclusiveMinimum": 0},
@@ -457,6 +462,10 @@ def validate_proxy(doc: Any) -> list[str]:
     for key in ("compile_stats", "analytic_bytes", "exec", "hbm_headroom"):
         if key in doc and not isinstance(doc[key], dict):
             errs.append(f"{key} is not an object")
+    if "quant_mode" in doc and doc["quant_mode"] not in ("dequant", "w8a8"):
+        errs.append(
+            f"quant_mode must be 'dequant' or 'w8a8' (got {doc['quant_mode']!r})"
+        )
     return errs
 
 

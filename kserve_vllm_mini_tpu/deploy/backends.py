@@ -28,6 +28,8 @@ class BackendConfig:
                                       # vllm-tpu: --pipeline-parallel-size)
     pp_microbatches: int = 1          # jax-native: GPipe slot groups per step
     quantization: str = "none"        # none | int8 | int4 (fp8: no kernel path)
+    quant_mode: str = "dequant"       # jax-native only: dequant | w8a8
+                                      # (int8 MXU contraction, ops/qmatmul.py)
     kv_cache_dtype: str = "auto"
     max_model_len: int = 4096
     max_batch_size: int = 64
@@ -164,6 +166,8 @@ def _jax_native_env(cfg: BackendConfig, topo: TpuTopology) -> dict[str, str]:
     }
     if cfg.kv_cache_dtype != "auto":
         env["KVMINI_KV_CACHE_DTYPE"] = cfg.kv_cache_dtype
+    if cfg.quant_mode != "dequant":
+        env["KVMINI_QUANT_MODE"] = cfg.quant_mode
     if cfg.drafter_model_id:
         env["KVMINI_DRAFTER"] = cfg.drafter_model_id
     env.update(cfg.extra_env)

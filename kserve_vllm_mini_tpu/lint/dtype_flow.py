@@ -552,6 +552,18 @@ class DtypeFlowChecker:
                 for comp in node.comparators:
                     if isinstance(comp, ast.Name):
                         handled.setdefault(comp.id, set()).add(node.left.value)
+            elif (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "get"
+                    and isinstance(node.func.value, ast.Name)
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                # leaf.get("a") reads the key just as leaf["a"] does (the
+                # ops/qmatmul.py qdot convention: pre_scale=qw.get("a"))
+                handled.setdefault(node.func.value.id, set()).add(
+                    node.args[0].value
+                )
         for base, keymap in reads.items():
             if not {"q", "s"} <= set(keymap):
                 continue

@@ -84,6 +84,14 @@ class ModelConfig:
     # sliding_window applies only to EVEN layers (gemma-2's local/global
     # alternation); odd layers attend the full causal context.
     alt_sliding_window: bool = False
+    # How quantized matmul leaves contract (ops/qmatmul.py QUANT_MODES):
+    # "dequant" casts the int weight to the activation dtype before the dot
+    # (W8A16/W4A16); "w8a8" quantizes activations per token and runs the
+    # contraction int8 x int8 on the MXU with scales folded
+    # post-accumulation. A no-op on unquantized params. Static — it
+    # selects the traced program, so it lives on the config every
+    # execution path already threads.
+    quant_mode: str = "dequant"
 
     @property
     def head_dim(self) -> int:

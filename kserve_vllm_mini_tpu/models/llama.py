@@ -41,6 +41,11 @@ KVCache = dict[str, jnp.ndarray]
 # elsewhere). See run_cached_layers' use_paged_kernel.
 _FORCE_PAGED_KERNEL: Optional[bool] = None
 
+# Same hook for the DENSE int8-KV decode kernel (ops/paged_attention.py
+# dense_decode_attention): None = auto (kernel on TPU, the eager
+# dequantize-on-read oracle elsewhere). See use_dense_kernel.
+_FORCE_DENSE_KERNEL: Optional[bool] = None
+
 
 def _stacked_weight_shapes(cfg: ModelConfig) -> dict[str, tuple[int, ...]]:
     """Per-layer shape of every stacked transformer matmul weight (last two
@@ -298,9 +303,10 @@ def qkv_proj(
     from kserve_vllm_mini_tpu.ops.lora import adapted_linear
 
     B, T, _ = h.shape
-    q = adapted_linear(h, p["wq"], lora, "wq", lora_ids)
-    k = adapted_linear(h, p["wk"], lora, "wk", lora_ids)
-    v = adapted_linear(h, p["wv"], lora, "wv", lora_ids)
+    qm = cfg.quant_mode
+    q = adapted_linear(h, p["wq"], lora, "wq", lora_ids, mode=qm)
+    k = adapted_linear(h, p["wk"], lora, "wk", lora_ids, mode=qm)
+    v = adapted_linear(h, p["wv"], lora, "wv", lora_ids, mode=qm)
     if cfg.attn_bias:
         q, k, v = q + p["bq"], k + p["bk"], v + p["bv"]
     q = q.reshape(B, T, cfg.n_heads, cfg.head_dim).transpose(0, 2, 1, 3)
@@ -390,8 +396,11 @@ def attn_out_and_mlp(
     With ``lora``/``lora_ids``, every projection the bank covers adds its
     per-row adapter delta (ops/lora.py).
     """
-    from kserve_vllm_mini_tpu.ops.lora import adapted_linear as _al
+    from functools import partial
 
+    from kserve_vllm_mini_tpu.ops.lora import adapted_linear
+
+    _al = partial(adapted_linear, mode=cfg.quant_mode)
     B, T, _ = x.shape
     dt = cfg.jnp_dtype
     o = o.transpose(0, 2, 1, 3).reshape(B, T, cfg.n_heads * cfg.head_dim)
@@ -559,6 +568,30 @@ def run_cached_layers(
             else jax.default_backend() == "tpu"
         )
     )
+    # Dense int8-KV decode kernel: the dense twin — each BLK stripe of the
+    # per-slot cache is DMA'd int8 and dequantized in-kernel, so the
+    # materialized bf16 [B,KVH,S,D] tensor the eager _read_layer builds
+    # never exists. Plain-causal single-token decode on the full-slot-axis
+    # cache only (the pp executor's write_gate/slot_base sub-views keep
+    # the eager oracle); _read_layer stays the non-kernel fallback.
+    from kserve_vllm_mini_tpu.ops.paged_attention import dense_decode_block
+
+    use_dense_kernel = (
+        (not paged)
+        and quantized_kv
+        and paged_kernel_ok
+        and write_gate is None
+        and slot_base is None
+        and positions.shape[1] == 1
+        and cfg.attn_softcap is None
+        and cfg.sliding_window is None
+        and dense_decode_block(s) is not None
+        and (
+            _FORCE_DENSE_KERNEL
+            if _FORCE_DENSE_KERNEL is not None
+            else jax.default_backend() == "tpu"
+        )
+    )
     kj = jnp.arange(s)[None, None, :]
     qi = positions[:, :, None]
     causal = kj <= qi
@@ -599,16 +632,25 @@ def run_cached_layers(
         old = cache[name][lidx, b_idx, h_idx, w_idx]
         return jnp.where(write_gate, new, old.astype(new.dtype))
 
+    def _gather_blocks(arr):
+        """Pool leaf -> this batch's blocks in table order, flattened to
+        absolute-position order: [P, KVH, BLK, D] values -> [B, KVH, s, D],
+        [P, KVH, BLK] scales -> [B, KVH, s]. ONE transpose/reshape for both
+        layouts so the value and scale gathers can never drift apart."""
+        g = arr[block_table]                     # [B, MAXB, KVH, BLK(, D)]
+        g = g.transpose((0, 2, 1, 3) + ((4,) if g.ndim == 5 else ()))
+        return g.reshape((B, cfg.n_kv_heads, s) + g.shape[4:])
+
     def _read_layer(cache, name, lidx):
+        """Eager (non-kernel) cache read: gather/slice this layer's live
+        view and dequantize on read. The fallback path wherever the Pallas
+        decode kernels don't apply (prefill-against-cache, pp sub-views,
+        windowed/softcap models, CPU oracle)."""
         vals = jax.lax.dynamic_index_in_dim(cache[name], lidx, axis=0, keepdims=False)
         if paged:
-            # [P, KVH, BLK, D] -> gather this batch's blocks in table order
-            # -> [B, KVH, MAXB*BLK, D]; the flattened axis is absolute
-            # position order, so downstream masking is identical to dense
-            vals = vals[block_table]                  # [B, MAXB, KVH, BLK, D]
-            vals = vals.transpose(0, 2, 1, 3, 4).reshape(
-                B, cfg.n_kv_heads, s, cfg.head_dim
-            )
+            # the flattened axis is absolute position order, so downstream
+            # masking is identical to dense
+            vals = _gather_blocks(vals)
         elif slot_base is not None:
             # attention only needs this slot group's rows
             vals = jax.lax.dynamic_slice_in_dim(vals, base, B, axis=0)
@@ -617,9 +659,7 @@ def run_cached_layers(
                 cache[name + "_s"], lidx, axis=0, keepdims=False
             )
             if paged:
-                sc = sc[block_table].transpose(0, 2, 1, 3).reshape(
-                    B, cfg.n_kv_heads, s
-                )
+                sc = _gather_blocks(sc)
             elif slot_base is not None:
                 sc = jax.lax.dynamic_slice_in_dim(sc, base, B, axis=0)
             # dequantize on read: halves the HBM stream vs bf16 and the
@@ -701,6 +741,23 @@ def run_cached_layers(
             og = paged_decode_attention(
                 qg, cache["k"], cache["v"], block_table,
                 cache_offsets, layer=lidx, scale=attn_scale,
+                k_scale=cache.get("k_s"), v_scale=cache.get("v_s"),
+            )
+            o = og.reshape(B, cfg.n_heads, 1, cfg.head_dim)
+        elif use_dense_kernel:
+            # dense int8-KV decode: BLK stripes of the LAYER-STACKED cache
+            # are DMA'd int8 and dequantized in-kernel — no materialized
+            # bf16 KV tensor, and no per-layer cache slice either (lidx
+            # rides the kernel's index map, same contract as paged)
+            from kserve_vllm_mini_tpu.ops.paged_attention import (
+                dense_decode_attention,
+            )
+
+            G = cfg.n_heads // cfg.n_kv_heads
+            qg = q[:, :, 0, :].reshape(B, cfg.n_kv_heads, G, cfg.head_dim)
+            og = dense_decode_attention(
+                qg, cache["k"], cache["v"], cache_offsets,
+                layer=lidx, scale=attn_scale,
                 k_scale=cache.get("k_s"), v_scale=cache.get("v_s"),
             )
             o = og.reshape(B, cfg.n_heads, 1, cfg.head_dim)

@@ -46,11 +46,16 @@ def expert_capacity(cfg: ModelConfig, n_tokens: int) -> int:
     return max(int(math.ceil(ideal * cfg.expert_capacity_factor)), cfg.n_experts_per_tok)
 
 
-def _expert_linear(x: jnp.ndarray, w: Any) -> jnp.ndarray:
+def _expert_linear(x: jnp.ndarray, w: Any, mode: str = "dequant") -> jnp.ndarray:
     """Batched per-expert matmul ``[E, C, in] @ [E, in, out]``; ``w`` may be
     a plain array or an int8 dict (scale applied as a fused epilogue, same
-    contract as ops.quant.linear)."""
+    contract as ops.quant.linear). ``mode="w8a8"`` contracts in int8 with
+    the expert axis as the batch dim (ops/qmatmul.py qdot)."""
     if is_quantized(w):
+        if mode == "w8a8":
+            from kserve_vllm_mini_tpu.ops.qmatmul import qdot
+
+            return qdot(x, w, batch_dims=1)
         y = jnp.einsum("ecd,edf->ecf", x, unpacked_q(w).astype(x.dtype))
         return y * w["s"].astype(x.dtype)[:, None, :]
     return jnp.einsum("ecd,edf->ecf", x, w)
@@ -91,10 +96,11 @@ def moe_mlp(p: dict[str, Any], cfg: ModelConfig, h: jnp.ndarray) -> jnp.ndarray:
     expert_in = buf[: E * C].reshape(E, C, D)
 
     # -- batched SwiGLU over experts ----------------------------------------
+    qm = cfg.quant_mode
     gated = jax.nn.silu(
-        _expert_linear(expert_in, p["w_gate"]).astype(jnp.float32)
-    ).astype(dt) * _expert_linear(expert_in, p["w_up"])
-    expert_out = _expert_linear(gated, p["w_down"])           # [E, C, D]
+        _expert_linear(expert_in, p["w_gate"], mode=qm).astype(jnp.float32)
+    ).astype(dt) * _expert_linear(expert_in, p["w_up"], mode=qm)
+    expert_out = _expert_linear(gated, p["w_down"], mode=qm)  # [E, C, D]
 
     # -- return + combine: gather each kept assignment, weight by its gate --
     out_flat = expert_out.reshape(E * C, D)

@@ -114,14 +114,14 @@ def collect_activation_stats(
     stats: dict[str, list[np.ndarray]] = {}
     real = lora_mod.adapted_linear
 
-    def recording(x, w, lora_layer, name, ids):
+    def recording(x, w, lora_layer, name, ids, mode="dequant"):
         if name in QUANTIZABLE:
             a = np.max(
                 np.abs(np.asarray(x, dtype=np.float32)),
                 axis=tuple(range(x.ndim - 1)),
             )
             stats.setdefault(name, []).append(a)
-        return real(x, w, lora_layer, name, ids)
+        return real(x, w, lora_layer, name, ids, mode=mode)
 
     B, T = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))

@@ -196,12 +196,15 @@ def adapted_linear(
     lora_layer: Optional[dict[str, jnp.ndarray]],
     name: str,
     ids: Optional[jnp.ndarray],
+    mode: str = "dequant",
 ) -> jnp.ndarray:
     """ops.quant.linear plus this target's adapter delta when the layer
-    bank carries it (targets not in the bank run the base matmul only)."""
+    bank carries it (targets not in the bank run the base matmul only).
+    ``mode`` is the base matmul's quant_mode (cfg.quant_mode); the adapter
+    delta itself stays in the bank dtype — it is rank-r noise-level FLOPs."""
     from kserve_vllm_mini_tpu.ops.quant import linear
 
-    y = linear(x, w)
+    y = linear(x, w, mode=mode)
     if lora_layer is None or ids is None or name + "_A" not in lora_layer:
         return y
     d = lora_delta(x, lora_layer[name + "_A"], lora_layer[name + "_B"], ids)

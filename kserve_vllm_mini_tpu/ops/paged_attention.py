@@ -34,6 +34,14 @@ The jnp gather path is the correctness oracle; tests compare in interpret
 mode on CPU (tests/test_paged_kernel.py). The serving path dispatches to
 the kernel on TPU for plain-causal, bf16-KV configs and keeps the exact
 gather path elsewhere (models/llama.py run_cached_layers).
+
+``dense_decode_attention`` is the DENSE-cache twin for the int8-KV
+layout: same shared online-softmax block body, same ``k_scale``/
+``v_scale`` dequant-in-kernel convention, but the key-block sweep walks
+the per-slot [L, B, KVH, S, D] cache stripes directly (no table) — so the
+eager read path's materialized bf16 [B, KVH, S, D] dequantized tensor
+never exists (models/llama.py ``_read_layer`` remains the fallback
+oracle).
 """
 
 from __future__ import annotations
@@ -49,24 +57,25 @@ from jax.experimental.pallas import tpu as pltpu
 _NEG_INF = -1e30
 
 
-def _paged_decode_kernel(
-    layer_ref,   # [1] int32 layer index (scalar prefetch; used in index maps)
-    table_ref,   # [S, MAXB] int32 (scalar prefetch)
-    qpos_ref,    # [S] int32 query positions (scalar prefetch)
+def _decode_block_body(
+    qpos,        # scalar int32: this slot's query position
     q_ref,       # [1, 1, G, D] this slot/head's query tile
-    k_ref,       # [1, 1, 1, BLK, D] the table-selected pool block
+    k_ref,       # [1, 1, 1, BLK, D] this grid step's key block
     v_ref,       # [1, 1, 1, BLK, D]
-    *rest,       # [k_s_ref, v_s_ref,] o_ref, m_ref, l_ref, acc_ref —
+    rest,        # [k_s_ref, v_s_ref,] o_ref, m_ref, l_ref, acc_ref —
                  # int8-KV mode carries per-position scale blocks
     block_k: int,
     scale: float,
     quantized: bool,
 ):
+    """One key-block step of the online-softmax decode recurrence — the
+    body BOTH decode kernels share (paged: the block arrived via the table
+    index map; dense: via the sequential S sweep). The m/l/acc scratch
+    persists across the innermost grid axis."""
     if quantized:
         k_s_ref, v_s_ref, o_ref, m_ref, l_ref, acc_ref = rest
     else:
         o_ref, m_ref, l_ref, acc_ref = rest
-    s = pl.program_id(0)
     b = pl.program_id(2)
     nb = pl.num_programs(2)
 
@@ -76,7 +85,6 @@ def _paged_decode_kernel(
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    qpos = qpos_ref[s]
     # keys j of block b sit at positions b*BLK + j; the decode query at
     # position qpos attends j <= qpos, so a block starting past qpos is
     # all-masked — skip its FLOPs entirely
@@ -120,6 +128,41 @@ def _paged_decode_kernel(
     @pl.when(b == nb - 1)
     def _finalize():
         o_ref[0, 0] = (acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)).astype(o_ref.dtype)
+
+
+def _paged_decode_kernel(
+    layer_ref,   # [1] int32 layer index (scalar prefetch; used in index maps)
+    table_ref,   # [S, MAXB] int32 (scalar prefetch)
+    qpos_ref,    # [S] int32 query positions (scalar prefetch)
+    q_ref,
+    k_ref,       # the table-selected pool block
+    v_ref,
+    *rest,
+    block_k: int,
+    scale: float,
+    quantized: bool,
+):
+    _decode_block_body(
+        qpos_ref[pl.program_id(0)], q_ref, k_ref, v_ref, rest,
+        block_k=block_k, scale=scale, quantized=quantized,
+    )
+
+
+def _dense_decode_kernel(
+    layer_ref,   # [1] int32 layer index (scalar prefetch; used in index maps)
+    qpos_ref,    # [B] int32 query positions (scalar prefetch)
+    q_ref,
+    k_ref,       # this slot's b-th BLK-position stripe of the dense cache
+    v_ref,
+    *rest,
+    block_k: int,
+    scale: float,
+    quantized: bool,
+):
+    _decode_block_body(
+        qpos_ref[pl.program_id(0)], q_ref, k_ref, v_ref, rest,
+        block_k=block_k, scale=scale, quantized=quantized,
+    )
 
 
 def paged_decode_attention(
@@ -208,3 +251,118 @@ def paged_decode_attention(
         out_shape=jax.ShapeDtypeStruct((S, KVH, G, D), q.dtype),
         interpret=interpret,
     )(layer_arr, safe_table, qpos, *operands)
+
+
+def dense_decode_block(seq_len: int) -> Optional[int]:
+    """Key-block size the dense decode kernel sweeps ``seq_len`` with, or
+    None when no supported block divides it (the caller then keeps the
+    eager read path). Powers of two down to 8: the sweep grid must tile
+    the cache's S axis exactly — Pallas pads partial blocks with whatever
+    HBM holds, and while the positional mask would zero those scores, a
+    dense cache length that is not even 8-aligned is a test-only shape
+    not worth the kernel."""
+    for cand in (512, 256, 128, 64, 32, 16, 8):
+        if seq_len % cand == 0:
+            return cand
+    return None
+
+
+def dense_decode_attention(
+    q: jnp.ndarray,        # [B, KVH, G, D] decode queries, GQA pre-grouped
+    k_cache: jnp.ndarray,  # [L, B, KVH, S, D] layer-stacked dense cache
+                           # (or [B, KVH, S, D] for a single layer)
+    v_cache: jnp.ndarray,
+    qpos: jnp.ndarray,     # [B] int32 current query position per slot
+    layer: jnp.ndarray | int = 0,  # which layer of the stacked cache
+    scale: Optional[float] = None,
+    k_scale: Optional[jnp.ndarray] = None,  # [L, B, KVH, S] f32: int8-KV
+    v_scale: Optional[jnp.ndarray] = None,  # per-position dequant scales
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Decode attention straight over the DENSE per-slot cache: the twin of
+    ``paged_decode_attention`` for ``kv_layout="dense"``.
+
+    The eager int8-KV read path (models/llama.py ``_read_layer``)
+    dequantizes the whole [B, KVH, S, D] stripe into a materialized bf16
+    tensor before attention — 3x the live-KV bytes in HBM traffic (int8
+    read + bf16 write + attention read) plus a full dequantized copy in
+    HBM. Here each BLK-position stripe is DMA'd int8 from HBM into VMEM
+    and dequantized in-register inside the online-softmax sweep (the same
+    shared block body as the paged kernel, same ``k_scale``/``v_scale``
+    layout), so the bf16 KV tensor never exists. The layer index rides the
+    index map so the caller never slices the stacked cache (a dynamic-
+    slice operand feeding a custom call would materialize the whole layer
+    in HBM — the copy this kernel exists to avoid).
+
+    Blocks past a slot's live length are skipped by the block body's
+    ``run`` guard; their DMA still happens (static grid) but reads the
+    slot's own dead cache tail, never another slot's data."""
+    if k_cache.ndim == 4:
+        k_cache = k_cache[None]
+        v_cache = v_cache[None]
+        if k_scale is not None:
+            k_scale, v_scale = k_scale[None], v_scale[None]
+    quantized = k_scale is not None
+    B, KVH, G, D = q.shape
+    L, _, _, S, _ = k_cache.shape
+    BLK = dense_decode_block(S)
+    if BLK is None:
+        raise ValueError(
+            f"dense decode kernel needs a power-of-two-tileable seq axis "
+            f"(>= 8); got S={S} — use the eager read path"
+        )
+    scale = scale if scale is not None else D ** -0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    qpos = qpos.astype(jnp.int32)
+    layer_arr = jnp.asarray(layer, jnp.int32).reshape((1,))
+    nb = S // BLK
+
+    def _cache_spec():
+        return pl.BlockSpec(
+            (1, 1, 1, BLK, D),
+            lambda s, h, b, layer, qpos: (layer[0], s, h, b, 0),
+        )
+
+    def _scale_spec():
+        return pl.BlockSpec(
+            (1, 1, 1, BLK),
+            lambda s, h, b, layer, qpos: (layer[0], s, h, b),
+        )
+
+    in_specs = [
+        pl.BlockSpec(
+            (1, 1, G, D),
+            lambda s, h, b, layer, qpos: (s, h, 0, 0),
+        ),
+        _cache_spec(),
+        _cache_spec(),
+    ]
+    operands = [q, k_cache, v_cache]
+    if quantized:
+        in_specs += [_scale_spec(), _scale_spec()]
+        operands += [k_scale, v_scale]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, KVH, nb),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec(
+            (1, 1, G, D), lambda s, h, b, layer, qpos: (s, h, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((G, 1), jnp.float32),
+            pltpu.VMEM((G, 1), jnp.float32),
+            pltpu.VMEM((G, D), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _dense_decode_kernel, block_k=BLK, scale=scale, quantized=quantized
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, KVH, G, D), q.dtype),
+        interpret=interpret,
+    )(layer_arr, qpos, *operands)

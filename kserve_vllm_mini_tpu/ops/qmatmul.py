@@ -1,0 +1,110 @@
+"""Native-quantized matmul: the W8A8 int8 contraction for the decode path.
+
+``ops/quant.py``'s dequant path keeps HBM traffic at int8/int4 but converts
+the weight to the activation dtype before the dot, so the MXU still
+contracts in bf16. Decode is weight-stream-bound (ops/quant.py:10) and the
+MXU's int8 path doubles its per-cycle multiply throughput vs bf16, so the
+remaining lever is keeping int8 *in the contraction*:
+
+- activations are quantized per token (symmetric absmax over the
+  contraction axis -> int8 values + one f32 scale per row) right before
+  the dot — "dynamic" quantization, no calibration state;
+- the contraction is an int8 x int8 ``lax.dot_general`` with
+  ``preferred_element_type=jnp.int32`` (the KVM064 accumulator
+  convention: without it the accumulator inherits int8 and wraps);
+- both scales fold AFTER accumulation:
+  ``(x_q @ w_q) * x_s * w_s == (x_q x_s) @ (w_q w_s)`` exactly, because
+  per-row/per-column scales commute with the contraction sum;
+- packed-int4 weights unpack in the contraction prologue
+  (``_unpack_int4``'s mask/shift arithmetic fuses into the dot's operand
+  producer), so HBM streams the packed uint8 bytes and the int8 operand
+  only ever exists in registers/VMEM;
+- AWQ leaves fold their per-input-channel compensation (``a``) into the
+  activation-quant pass — same one sweep over the activations, no extra
+  op on the weight stream.
+
+The numerics cost vs the dequant path is the activation rounding (<= 1/254
+relative per element); ``quality/perplexity.py`` NLL and the sweep's
+``quality_perplexity_delta_vs_baseline`` gate keep that honest.
+
+Selected by ``quant_mode="w8a8"`` (ModelConfig/EngineConfig/
+``--quant-mode``/``KVMINI_QUANT_MODE``); ``ops.quant.linear`` dispatches.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+# the documented quant_mode axis: "dequant" converts the weight to the
+# activation dtype before the dot (W8A16/W4A16 — ops/quant.py), "w8a8"
+# quantizes activations per token and contracts in int8 (this module)
+QUANT_MODES = ("dequant", "w8a8")
+
+
+def validate_quant_mode(mode: str) -> str:
+    if mode not in QUANT_MODES:
+        raise ValueError(
+            f"unknown quant_mode {mode!r}; known: {', '.join(QUANT_MODES)}"
+        )
+    return mode
+
+
+def quantize_activations(
+    x: jnp.ndarray, pre_scale: Optional[jnp.ndarray] = None
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-token symmetric int8 over the contraction (last) axis.
+
+    Returns ``(q int8 [..., K], s f32 [..., 1])`` with ``q * s ~= x``.
+    ``pre_scale`` is the AWQ per-input-channel compensation ``a`` —
+    applied inside the same f32 pass that computes the row amax, so an
+    AWQ leaf costs no extra sweep. Zero rows get scale 1.0 (no NaNs,
+    mirroring quantize_weight's zero-channel rule)."""
+    xf = x.astype(jnp.float32)
+    if pre_scale is not None:
+        xf = xf * pre_scale.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(xf / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def int8_dot(
+    xq: jnp.ndarray, wq: jnp.ndarray, batch_dims: int = 0
+) -> jnp.ndarray:
+    """int8 x int8 contraction with an int32 accumulator (KVM064).
+
+    Contracts ``xq``'s last axis against ``wq``'s first post-batch axis;
+    ``batch_dims`` leading axes are shared batch dims (the MoE expert
+    axis). Shapes: [*B, ..., K] @ [*B, K, N] -> [*B, ..., N] int32."""
+    b = tuple(range(batch_dims))
+    return jax.lax.dot_general(
+        xq, wq,
+        (((xq.ndim - 1,), (batch_dims,)), (b, b)),
+        preferred_element_type=jnp.int32,
+    )
+
+
+def qdot(x: jnp.ndarray, qw: dict[str, Any], batch_dims: int = 0) -> jnp.ndarray:
+    """``x @ W_eff`` for a quantized leaf, contraction in int8.
+
+    ``qw`` is an ops/quant.py leaf ({q, s[, a]}): int8, packed int4
+    (unpacked in the prologue — HBM streams the packed bytes), or AWQ
+    (``a`` folded into the activation quant). The int32 accumulator is
+    rescaled once post-accumulation — f32 math, then cast to ``x.dtype``
+    so downstream fusions see the model dtype."""
+    from kserve_vllm_mini_tpu.ops.quant import unpacked_q
+
+    wq = unpacked_q(qw)
+    xq, xs = quantize_activations(x, pre_scale=qw.get("a"))
+    acc = int8_dot(xq, wq, batch_dims=batch_dims)
+    # w_s is per-output-channel [*batch, N]; insert the x-side axes so it
+    # broadcasts against the accumulator ([*batch, ..., N]) — all in f32
+    ws = qw["s"].astype(jnp.float32)
+    extra = acc.ndim - ws.ndim
+    if extra:
+        ws = ws.reshape(ws.shape[:batch_dims] + (1,) * extra + ws.shape[batch_dims:])
+    y = acc.astype(jnp.float32) * xs * ws
+    return y.astype(x.dtype)

@@ -109,17 +109,28 @@ def dequantize_weight(qw: dict[str, jnp.ndarray], dtype=jnp.bfloat16) -> jnp.nda
     return deq.astype(dtype)
 
 
-def linear(x: jnp.ndarray, w: Any) -> jnp.ndarray:
+def linear(x: jnp.ndarray, w: Any, mode: str = "dequant") -> jnp.ndarray:
     """``x @ w`` where ``w`` is a plain array or a quantized dict.
 
-    For int8 weights the matmul runs with the int8 tensor cast to the
-    activation dtype (one fused convert feeding the MXU) and the per-channel
-    scale applied to the [..., out] result — an epilogue multiply, not a
-    materialized dequantized weight. AWQ leaves additionally multiply the
-    activations by the per-input-channel compensation (``a``) first — a
-    producer-side elementwise op XLA fuses; HBM traffic is unchanged.
+    ``mode`` is the quant_mode axis (ops/qmatmul.py QUANT_MODES):
+
+    - ``"dequant"`` (default, W8A16/W4A16): the matmul runs with the int
+      tensor cast to the activation dtype (one fused convert feeding the
+      MXU) and the per-channel scale applied to the [..., out] result — an
+      epilogue multiply, not a materialized dequantized weight. AWQ leaves
+      additionally multiply the activations by the per-input-channel
+      compensation (``a``) first — a producer-side elementwise op XLA
+      fuses; HBM traffic is unchanged.
+    - ``"w8a8"``: activations are quantized per token and the contraction
+      runs int8 x int8 on the MXU with an int32 accumulator, scales folded
+      post-accumulation (ops/qmatmul.py qdot). Plain (unquantized) weights
+      are unaffected by the mode.
     """
     if is_quantized(w):
+        if mode == "w8a8":
+            from kserve_vllm_mini_tpu.ops.qmatmul import qdot
+
+            return qdot(x, w)
         if "a" in w:
             x = x * w["a"].astype(x.dtype)
         y = x @ unpacked_q(w).astype(x.dtype)

@@ -140,10 +140,18 @@ def estimate_serving_bytes(
     max_seq: int,
     quant: str = "bf16",
     kv_quant: bool = False,
+    quant_mode: str = "dequant",
 ) -> dict[str, int]:
     """Analytic HBM footprint of the bench serving shape: weights + dense
     KV + the f32 logits/workspace the prefill and sampling steps need.
-    ``cfg`` is a ``models.config.ModelConfig`` (only dims are read)."""
+    ``cfg`` is a ``models.config.ModelConfig`` (only dims are read).
+
+    ``quant_mode="w8a8"`` adds the activation-quant workspace: the int8
+    copy of the widest activation a projection quantizes ([slots, T,
+    max(d_ff, d_model)] for the w_down input) plus one f32 absmax scale
+    per row — a transient XLA may or may not fuse away, priced so the
+    guard can never admit a shape whose quantize step is the allocation
+    that RESOURCE_EXHAUSTs (docs/PROFILING.md)."""
     weights = int(cfg.param_count * _weight_bytes_per_param(quant))
     kv_elem = kv_elem_bytes(cfg.head_dim, cfg.jnp_dtype.itemsize, kv_quant)
     kv = int(2 * cfg.n_layers * slots * cfg.n_kv_heads * max_seq
@@ -151,6 +159,9 @@ def estimate_serving_bytes(
     # f32 last-position logits for the batch + one full-bucket activation
     # set; the 1.15 margin covers fusion scratch XLA actually allocates
     workspace = int(slots * cfg.vocab_size * 4 + slots * max_seq * cfg.d_model * 2)
+    if quant_mode == "w8a8":
+        widest = max(getattr(cfg, "d_ff", cfg.d_model), cfg.d_model)
+        workspace += int(slots * max_seq * (widest + 4))
     total = int((weights + kv + workspace) * 1.15)
     return {"weight_bytes": weights, "kv_bytes": kv,
             "workspace_bytes": workspace, "total_bytes": total}
@@ -240,6 +251,7 @@ def serving_headroom_plan(
     quant: str,
     kv_quant: bool,
     capacity_bytes: int,
+    quant_mode: str = "dequant",
     **plan_kwargs: Any,
 ) -> HeadroomPlan:
     """``plan_admission`` over the analytic serving estimate for a named
@@ -250,7 +262,8 @@ def serving_headroom_plan(
     def estimate(s: int, ctx: int) -> int:
         cfg = get_config(model, max_seq_len=ctx)
         return estimate_serving_bytes(cfg, s, ctx, quant=quant,
-                                      kv_quant=kv_quant)["total_bytes"]
+                                      kv_quant=kv_quant,
+                                      quant_mode=quant_mode)["total_bytes"]
 
     return plan_admission(estimate, capacity_bytes, slots, max_seq,
                           **plan_kwargs)

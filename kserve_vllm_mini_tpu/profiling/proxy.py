@@ -82,19 +82,38 @@ def cost_model_stats(
     max_seq: int,
     prompt_len: int = 128,
     kv_quant: bool = False,
+    quant_mode: str = "dequant",
 ) -> dict[str, Any]:
     """Abstract-compile the flagship config's prefill + decode and return
     their compile stats. No weights are ever materialized — ``eval_shape``
     over the initializers yields the exact parameter/cache avals, and
-    ``lower()`` accepts them directly."""
+    ``lower()`` accepts them directly.
+
+    ``quant`` selects the abstract tree the program compiles against:
+    int8/int4 trees come from ``init_params_quantized``'s avals, so the
+    cost model's bytes_accessed prices the int8/packed-uint8 weight stream
+    the quantized deployment actually reads — the rail the W8A8
+    compiled-bytes acceptance pin rides (tests/test_qmatmul.py).
+    ``quant_mode`` rides cfg (static) and selects the dequant vs int8-MXU
+    contraction in the compiled program."""
     import jax
     import jax.numpy as jnp
 
     from kserve_vllm_mini_tpu.models.config import get_config
-    from kserve_vllm_mini_tpu.models.llama import init_kv_cache, init_params
+    from kserve_vllm_mini_tpu.models.llama import (
+        init_kv_cache,
+        init_params,
+        init_params_quantized,
+    )
 
-    cfg = get_config(model, max_seq_len=max_seq)
-    abs_params = jax.eval_shape(lambda k: init_params(k, cfg),
+    cfg = get_config(model, max_seq_len=max_seq, quant_mode=quant_mode)
+    if quant in ("int8", "int4"):
+        from functools import partial as _p
+
+        init_fn = _p(init_params_quantized, bits=4 if quant == "int4" else 8)
+    else:
+        init_fn = init_params
+    abs_params = jax.eval_shape(lambda k: init_fn(k, cfg),
                                 jax.random.PRNGKey(0))
     abs_cache = jax.eval_shape(
         lambda: init_kv_cache(cfg, slots, max_seq=max_seq, quantized=kv_quant)
@@ -114,15 +133,18 @@ def cost_model_stats(
         decode, abs_params, abs_cache, tok1, lens, rng,
         label=f"proxy.decode[{model}]",
     )
-    # NOTE: quant shapes the analytic weight estimate below, not the
-    # abstract tree (init_params' bf16 avals are what lower() saw) — the
-    # cost model therefore prices the bf16 program; the headroom block
-    # prices the quantized deployment. Both labeled, neither conflated.
+    # quant shapes BOTH the abstract tree (int8/packed-uint8 avals fed to
+    # lower(), so the cost model prices the quantized weight stream) and
+    # the analytic estimate below; quant_mode selects the contraction
+    # (dequant epilogue vs int8 MXU + activation-quant workspace)
     est = estimate_serving_bytes(cfg, slots, max_seq, quant=quant,
-                                 kv_quant=kv_quant)
+                                 kv_quant=kv_quant, quant_mode=quant_mode)
     return {
         "model": cfg.name,
         "param_count": cfg.param_count,
+        "quant": quant,
+        "quant_mode": quant_mode,
+        "kv_quant": kv_quant,
         "prefill": pf_stats.to_dict(),
         "decode": dec_stats.to_dict(),
         "analytic": est,
@@ -216,15 +238,20 @@ def run_proxy_tier(
     prompt_len: int = 128,
     decode_steps: int = 24,
     kv_quant: bool = False,
+    quant_mode: str = "dequant",
     hbm_bytes: Optional[int] = None,
 ) -> dict[str, Any]:
     """The full proxy round: flagship cost model + headroom pre-flight +
     executed small-config step ratio. Returns the schema-valid ``proxy``
-    block (core/schema.py ``validate_proxy``)."""
+    block (core/schema.py ``validate_proxy``). ``quant_mode``/``kv_quant``
+    label the block so dark rounds track QUANTIZED compile drift as their
+    own trajectory points — a w8a8 regression must not hide behind a
+    dequant-round comparison."""
     import jax
 
     cost = cost_model_stats(model, quant, slots, max_seq,
-                            prompt_len=prompt_len, kv_quant=kv_quant)
+                            prompt_len=prompt_len, kv_quant=kv_quant,
+                            quant_mode=quant_mode)
     execd = exec_proxy(exec_model, min(slots, 8), decode_steps)
     pf, dec = cost["prefill"], cost["decode"]
     block: dict[str, Any] = {
@@ -234,6 +261,8 @@ def run_proxy_tier(
         "model": cost["model"],
         "exec_model": execd["model"],
         "quant": quant,
+        "quant_mode": quant_mode,
+        "kv_quant": kv_quant,
         "slots": slots,
         "max_seq": max_seq,
         # acceptance pins: the five headline proxy metrics, flat
@@ -249,6 +278,7 @@ def run_proxy_tier(
     }
     if hbm_bytes:
         block["hbm_headroom"] = serving_headroom_plan(
-            model, slots, max_seq, quant, kv_quant, hbm_bytes
+            model, slots, max_seq, quant, kv_quant, hbm_bytes,
+            quant_mode=quant_mode,
         ).to_dict()
     return block

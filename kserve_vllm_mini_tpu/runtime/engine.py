@@ -227,6 +227,14 @@ class EngineConfig:
     min_prefill_bucket: int = 16
     seed: int = 0
     kv_cache_dtype: Optional[str] = None  # None -> model dtype (e.g. "float32")
+    # How quantized matmul leaves contract (ops/qmatmul.py QUANT_MODES):
+    # "dequant" casts the int weight to the activation dtype before the
+    # dot (W8A16/W4A16); "w8a8" quantizes activations per token and runs
+    # the contraction int8 x int8 on the MXU, scales folded post-
+    # accumulation. No-op on unquantized params. The engine folds it into
+    # cfg.quant_mode so every compiled step sees it as a static config
+    # attribute.
+    quant_mode: str = "dequant"
     # decode steps fused into one dispatch. 1 = lowest per-token latency;
     # larger values amortize host dispatch + readback (the dominant cost
     # when the accelerator is remote) at the price of streaming granularity
@@ -435,6 +443,17 @@ class Engine:
     ) -> None:
         self.cfg = cfg
         self.ecfg = engine_cfg or EngineConfig()
+        from kserve_vllm_mini_tpu.ops.qmatmul import validate_quant_mode
+
+        validate_quant_mode(self.ecfg.quant_mode)
+        if self.ecfg.quant_mode != cfg.quant_mode:
+            # one source of truth at trace time: the config every compiled
+            # step closes over (callers that pre-scaled cfg and left the
+            # EngineConfig default keep their cfg — default never demotes)
+            if self.ecfg.quant_mode != "dequant":
+                self.cfg = cfg = cfg.scaled(quant_mode=self.ecfg.quant_mode)
+            else:
+                self.ecfg.quant_mode = cfg.quant_mode
         self.ecfg.max_seq_len = min(self.ecfg.max_seq_len, cfg.max_seq_len)
         # prefill bucket must fit inside the cache with at least one decode slot
         self.ecfg.max_prefill_len = min(
@@ -795,7 +814,8 @@ class Engine:
                 for leaf in jax.tree_util.tree_leaves(self._drafter_params)
             )
         analytic = estimate_serving_bytes(
-            cfg, S, self.ecfg.max_seq_len, kv_quant=kv_quant
+            cfg, S, self.ecfg.max_seq_len, kv_quant=kv_quant,
+            quant_mode=cfg.quant_mode,
         )
         kv_bytes = S * self.ecfg.max_seq_len * self.kv_bytes_per_token()
         n_dev = self.mesh.size if self.mesh is not None else 1

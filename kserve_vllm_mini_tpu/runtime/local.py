@@ -62,6 +62,7 @@ def start_local_server(
         max_seq_len=int(profile.get("max_model_len", 1024)),
         topology=profile.get("jax_topology"),
         quantization=profile.get("quantization", "none") or "none",
+        quant_mode=profile.get("quant_mode", "dequant") or "dequant",
         kv_cache_dtype=profile.get("kv_cache_dtype"),
         decode_chunk=int(profile.get("decode_chunk", 1)),
         scan_unroll=int(profile.get("scan_unroll", 1)),

@@ -41,6 +41,10 @@ def build_engine(
     topology: Optional[str] = None,
     seed: int = 0,
     quantization: str = "none",
+    quant_mode: str = "dequant",   # how quantized matmuls contract
+                                   # (ops/qmatmul.py QUANT_MODES):
+                                   # "dequant" = cast-to-bf16 epilogue,
+                                   # "w8a8" = int8 MXU contraction
     kv_cache_dtype: Optional[str] = None,
     decode_chunk: int = 1,
     drafter: Optional[str] = None,
@@ -88,6 +92,14 @@ def build_engine(
             f"unknown quantization {quantization!r}; known: none, int8, "
             "int4, int4-awq"
         )
+    from kserve_vllm_mini_tpu.ops.qmatmul import validate_quant_mode
+
+    quant_mode = validate_quant_mode(quant_mode or "dequant")
+    if quantization == "none":
+        # documented no-op: without quantized leaves there is nothing to
+        # contract in int8, and folding w8a8 into cfg anyway would make
+        # the headroom guard price a phantom activation-quant workspace
+        quant_mode = "dequant"
     if kv_cache_dtype == "auto":
         # profile sentinel for "model default" (profiles/quantization/*.yaml
         # mirror the reference's 'auto'); the deploy layer drops it too
@@ -157,6 +169,10 @@ def build_engine(
         else:
             params = init_fn(jax.random.PRNGKey(seed), cfg)
         name = cfg.name
+    if quant_mode != "dequant":
+        # static trace-time knob: every execution path threads cfg, so the
+        # config is where the mode rides (models/config.py quant_mode)
+        cfg = cfg.scaled(quant_mode=quant_mode)
     if quantization == "int4-awq":
         # activation-aware calibration (ops/awq.py): stats from one eager
         # forward of the embedded corpus through the live tokenizer, then
@@ -246,6 +262,7 @@ def build_engine(
         max_prefill_len=min(max_seq_len, cfg.max_seq_len) // 2,
         seed=seed,
         kv_cache_dtype=kv_cache_dtype,
+        quant_mode=quant_mode,
         decode_chunk=decode_chunk,
         spec_tokens=spec_tokens if drafter_pair is not None else 0,
         pp_microbatches=pp_microbatches,
@@ -1406,6 +1423,15 @@ def register(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--kv-cache-dtype", default=None,
                         help="KV cache dtype: bfloat16/float32/float16/int8 "
                              "(int8 = scaled per-position) or 'auto'")
+    parser.add_argument("--quant-mode", default=None,
+                        choices=["dequant", "w8a8"],
+                        help="How quantized matmuls contract: 'dequant' "
+                             "casts the int weight to the activation dtype "
+                             "before the dot (W8A16/W4A16); 'w8a8' "
+                             "quantizes activations per token and runs the "
+                             "contraction int8 x int8 on the MXU "
+                             "(ops/qmatmul.py). Default: $KVMINI_QUANT_MODE "
+                             "or dequant. No-op with --quantization none")
     parser.add_argument("--scan-unroll", type=int, default=1,
                         help="lax.scan unroll over the layer stack (XLA "
                              "schedule knob; results equivalent)")
@@ -1514,6 +1540,9 @@ def run(args: argparse.Namespace) -> int:
         else os.environ.get("KVMINI_QUANTIZATION", "none")
     )
     kv_dtype = args.kv_cache_dtype or os.environ.get("KVMINI_KV_CACHE_DTYPE")
+    quant_mode = (
+        args.quant_mode or os.environ.get("KVMINI_QUANT_MODE") or "dequant"
+    )
     spec_tokens = args.spec_tokens
     if spec_tokens is None:
         spec_tokens = int(os.environ.get("KVMINI_SPEC_TOKENS", "4" if drafter else "0"))
@@ -1582,6 +1611,7 @@ def run(args: argparse.Namespace) -> int:
         scan_unroll=args.scan_unroll,
         seed=args.seed,
         quantization=quantization,
+        quant_mode=quant_mode,
         kv_cache_dtype=kv_dtype,
         drafter=drafter,
         spec_tokens=spec_tokens,

@@ -24,6 +24,11 @@ DEFAULT_SPACE: dict[str, list[Any]] = {
     "quantization": ["none", "int8", "int4", "int4-awq"],
     "kv_cache_dtype": ["model", "int8"],   # int8 = scaled int8-KV cache
     "decoding": ["greedy", "sampled"],
+    # how quantized matmuls contract (ops/qmatmul.py): "dequant" casts to
+    # bf16 before the dot, "w8a8" runs the int8 MXU contraction with
+    # per-token activation quant. A no-op axis for quantization=none rows
+    # (dropped from the grid below to avoid benching duplicates).
+    "quant_mode": ["dequant", "w8a8"],
 }
 
 DECODING_PRESETS: dict[str, dict[str, Any]] = {
@@ -31,7 +36,16 @@ DECODING_PRESETS: dict[str, dict[str, Any]] = {
     "sampled": {"temperature": 0.7, "extra_body": {"top_p": 0.95}},
 }
 
-CONFIG_KEYS = ["quantization", "kv_cache_dtype", "decoding", "kv_layout"]
+CONFIG_KEYS = ["quantization", "kv_cache_dtype", "decoding", "kv_layout",
+               "quant_mode"]
+
+# perplexity gate (docs/FEATURES.md): a quantized cell whose NLL/token
+# exceeds the unquantized greedy baseline's by more than this is a
+# NUMERICS BREAK (e.g. a dropped activation scale), not a quality
+# trade-off — the cell FAILS so the speedup can't ship on broken math.
+# Legit int4 damage on real checkpoints measures well under 0.5 nats;
+# dropping a scale factor blows NLL up by several nats.
+PERPLEXITY_GATE_MAX_NLL_DELTA = 1.0
 
 
 def is_baseline_config(cfg: dict[str, Any]) -> bool:
@@ -40,11 +54,15 @@ def is_baseline_config(cfg: dict[str, Any]) -> bool:
     (run_quantization): if they diverge, the baseline can bench after a row
     that wanted a fidelity score against it, silently flipping the Pareto
     quality axis to the ~chance task score."""
+    # `or default`, not a .get default: sweep ROWS carry every CONFIG_KEY
+    # with None for axes the grid didn't sweep, and the gate post-pass
+    # matches the baseline against rows, not just grid configs
     return (
         cfg.get("quantization") == "none"
-        and cfg.get("kv_cache_dtype", "model") == "model"
-        and cfg.get("decoding", "greedy") == "greedy"
-        and cfg.get("kv_layout", "dense") == "dense"
+        and (cfg.get("kv_cache_dtype") or "model") == "model"
+        and (cfg.get("decoding") or "greedy") == "greedy"
+        and (cfg.get("kv_layout") or "dense") == "dense"
+        and (cfg.get("quant_mode") or "dequant") == "dequant"
     )
 
 
@@ -70,6 +88,8 @@ def make_local_bench(
 
         profile = {**base_profile}
         profile["quantization"] = cfg["quantization"]
+        if cfg.get("quant_mode"):
+            profile["quant_mode"] = cfg["quant_mode"]
         if cfg.get("kv_cache_dtype") and cfg["kv_cache_dtype"] != "model":
             profile["kv_cache_dtype"] = cfg["kv_cache_dtype"]
         if cfg.get("kv_layout"):
@@ -108,9 +128,12 @@ def make_local_bench(
                 # computed in-process against the SAME params this config
                 # serves — the metric that separates int8 from int4 even
                 # when the task suite scores ~chance (quality/perplexity.py).
-                # Cached per quantization: kv dtype and decoding cannot
-                # change it, and each call pays a fresh jit trace.
-                q = cfg["quantization"]
+                # Cached per (quantization, quant_mode): kv dtype and
+                # decoding cannot change it, and each call pays a fresh
+                # jit trace. quant_mode IS in the key — the w8a8
+                # activation rounding is exactly what the NLL gate exists
+                # to measure.
+                q = (cfg["quantization"], cfg.get("quant_mode") or "dequant")
                 if q not in nll_cache:
                     from kserve_vllm_mini_tpu.quality.perplexity import (
                         eval_text_nll,
@@ -136,6 +159,10 @@ def _extra(cfg: dict[str, Any], results: dict[str, Any]) -> dict[str, Any]:
         "quality_fidelity": results.get("quality_fidelity"),
         "quality_nll_per_token": results.get("quality_nll_per_token"),
         "quality_perplexity": results.get("quality_perplexity"),
+        # NLL/token delta vs the unquantized greedy baseline (nats = the
+        # log-perplexity delta); gated post-sweep — past
+        # PERPLEXITY_GATE_MAX_NLL_DELTA the cell FAILS (numerics break)
+        "quality_perplexity_delta_vs_baseline": None,
         "fidelity_exact_match": results.get("fidelity_exact_match"),
         "fidelity_reference": results.get("fidelity_reference"),
         "pareto": "",     # filled after the full sweep
@@ -157,6 +184,20 @@ def run_quantization(
 
     space = space or DEFAULT_SPACE
     configs = base.grid_product(space)
+    # quant_mode is a no-op for unquantized rows: rewrite them to the
+    # canonical "dequant" label and dedup, so the grid never benches the
+    # same program twice — and a w8a8-only grid still gets its
+    # unquantized BASELINE row (the fidelity/perplexity reference)
+    seen: set[tuple] = set()
+    deduped = []
+    for c in configs:
+        if c.get("quantization") == "none" and c.get("quant_mode"):
+            c = {**c, "quant_mode": "dequant"}
+        key = tuple(sorted((k, str(v)) for k, v in c.items()))
+        if key not in seen:
+            seen.add(key)
+            deduped.append(c)
+    configs = deduped
     # the unquantized greedy baseline must bench before any row that wants a
     # fidelity score against it; stable sort keeps the rest in grid order
     configs = sorted(configs, key=lambda c: 0 if is_baseline_config(c) else 1)
@@ -166,6 +207,36 @@ def run_quantization(
     rows = base.run_sweep(
         configs, bench, csv_path, CONFIG_KEYS, extra_row_fn=_extra, label="quant-sweep"
     )
+
+    # perplexity gate (PERPLEXITY_GATE_MAX_NLL_DELTA): every quantized
+    # cell's NLL/token is compared against the unquantized greedy
+    # baseline's. A delta past the threshold is a numerics BREAK (dropped
+    # activation scale, wrapped accumulator, ...) masquerading as a config
+    # — the cell is FAILED before the Pareto pass so broken math can never
+    # land on the frontier. Skipped when the baseline has no NLL
+    # (--no-quality runs measure nothing to gate against).
+    base_row = next(
+        (r for r in rows
+         if is_baseline_config(r) and r.get("status") == "ok"
+         and r.get("quality_nll_per_token") is not None),
+        None,
+    )
+    if base_row is not None:
+        base_nll = float(base_row["quality_nll_per_token"])
+        for r in rows:
+            if r.get("status") != "ok" or r.get("quality_nll_per_token") is None:
+                continue
+            delta = round(float(r["quality_nll_per_token"]) - base_nll, 5)
+            r["quality_perplexity_delta_vs_baseline"] = delta
+            if delta > PERPLEXITY_GATE_MAX_NLL_DELTA:
+                r["status"] = "failed"
+                r["error"] = (
+                    f"perplexity gate: nll_per_token delta {delta} vs "
+                    f"baseline {base_nll} exceeds "
+                    f"{PERPLEXITY_GATE_MAX_NLL_DELTA} (numerics break, "
+                    "not a quality trade-off)"
+                )
+                print(f"quant-sweep: {r['error']}", file=sys.stderr)
 
     # post-pass: Pareto frontier + buckets over the successful rows. Quality
     # participates only when it was actually measured — with --no-quality the

@@ -1,0 +1,177 @@
+"""Environment-precondition guards for tier-1 tests.
+
+PR 8 found 8 tier-1 tests failing in a minimal container (no pytest-xdist
+/ruff, multiprocess-on-CPU-backend unsupported, different XLA:CPU
+codegen) — all byte-identical at HEAD, none regressions. A red FAILED
+that means "this container is small" is dishonest signal: it trains
+people to ignore tier-1 red. These guards PROBE each test's actual
+precondition and ``pytest.skip`` with an explicit reason when it is
+absent; when the probe passes, the test runs and asserts exactly as
+before, so a real regression still fails loudly.
+
+The precondition classes (each verified against the real failure mode,
+reproduced in exactly such a container):
+
+- ``skip_if_multiprocess_unsupported``: jaxlib builds where XLA:CPU
+  raises ``Multiprocess computations aren't implemented on the CPU
+  backend`` the moment a collective spans processes. 1-process
+  ``jax.distributed`` init SUCCEEDS on these builds, so the honest probe
+  is the failure itself: classify the worker output and skip on the
+  backend-support marker; any other worker failure falls through to the
+  test's own assertions and fails loudly.
+- ``require_bitwise_sharded_forward``: mesh-vs-dense token-exact tests
+  assume the GSPMD-partitioned model forward is bitwise-identical to the
+  single-device program — only then is greedy token equality
+  *guaranteed* rather than trajectory luck (a different partial-sum
+  order legitimately flips argmax at the near-ties a random-init model's
+  flat logits are full of). Probed directly: one llama-tiny forward,
+  tp=2-sharded vs dense, compared bitwise. On a backend without the
+  guarantee the test outcome is a coin flip in either direction, so a
+  pass there would not be signal either.
+- ``require_child_jax`` / ``require_devices``: subprocess-worker tests
+  need a child Python that can bring up its own JAX CPU backend; mesh
+  tests need the conftest-forced 8 virtual devices to have taken.
+- Trajectory preconditions (in-test, not in this module): several tests
+  pin properties of a random-init model's greedy trajectory (an
+  immediate repeat, a closes-with-margin length, a capped-vs-uncapped
+  delta above atol). The property IS the precondition; when this
+  backend's trajectory doesn't exhibit it, the test skips naming the
+  numeric it saw rather than failing on a tolerance-edge coin flip.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).parent.parent
+
+# the jaxlib XLA:CPU marker for "cross-process collectives unsupported";
+# single-process jax.distributed init works on these builds, so this
+# only surfaces once a computation actually spans processes
+MULTIPROCESS_UNSUPPORTED = (
+    "Multiprocess computations aren't implemented on the CPU backend"
+)
+
+_CHILD_JAX: tuple[bool, str] | None = None
+_SHARDED_FWD: tuple[bool, str] | None = None
+
+
+def skip_if_multiprocess_unsupported(outputs: list[str]) -> None:
+    """Skip when any worker's output carries the backend-support marker.
+
+    Call AFTER a multi-process run failed, BEFORE asserting on it."""
+    for out in outputs:
+        if MULTIPROCESS_UNSUPPORTED in (out or ""):
+            pytest.skip(
+                "jaxlib's XLA:CPU build does not support cross-process "
+                f"collectives ({MULTIPROCESS_UNSUPPORTED!r}) — "
+                "multiprocess-on-CPU precondition absent (PR 8)"
+            )
+
+
+def require_child_jax() -> None:
+    """Skip unless a child Python process can bring up the JAX CPU
+    backend — the floor every cluster-as-subprocess test stands on."""
+    global _CHILD_JAX
+    if _CHILD_JAX is None:
+        env = {
+            k: v for k, v in os.environ.items()
+            if not k.startswith(("KVMINI_", "JAX_"))
+        }
+        env.pop("XLA_FLAGS", None)
+        env["PYTHONPATH"] = str(REPO)
+        code = (
+            "import os;"
+            "os.environ['JAX_PLATFORMS']='cpu';"
+            "os.environ['XLA_FLAGS']='--xla_force_host_platform_device_count=8';"
+            "import jax; print('CHILD_JAX_OK', jax.device_count())"
+        )
+        try:
+            p = subprocess.run(
+                [sys.executable, "-c", code], env=env, cwd=REPO,
+                capture_output=True, text=True, timeout=240,
+            )
+            ok = p.returncode == 0 and "CHILD_JAX_OK" in p.stdout
+            why = "" if ok else (
+                f"probe rc={p.returncode}: {p.stderr.strip()[-500:]}"
+            )
+        except (subprocess.TimeoutExpired, OSError) as e:
+            ok, why = False, f"probe {type(e).__name__}: {e}"
+        _CHILD_JAX = (ok, why)
+    ok, why = _CHILD_JAX
+    if not ok:
+        pytest.skip(
+            f"subprocess JAX CPU backend unavailable in this environment: {why}"
+        )
+
+
+def require_devices(n: int) -> None:
+    """Skip unless the conftest-forced virtual CPU mesh actually exposes
+    >= n devices (mesh-sharding tests need them)."""
+    import jax
+
+    have = jax.device_count()
+    if have < n:
+        pytest.skip(
+            f"needs a >={n}-device mesh, backend exposes {have} (the "
+            "forced 8-virtual-CPU-device mesh did not take in this "
+            "environment)"
+        )
+
+
+def require_bitwise_sharded_forward() -> None:
+    """Skip unless the GSPMD-sharded llama-tiny forward is
+    bitwise-identical to the single-device program on this backend
+    build — the property that turns token-exact sharded-vs-dense greedy
+    comparisons from trajectory luck into a guarantee."""
+    global _SHARDED_FWD
+    if _SHARDED_FWD is None:
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from kserve_vllm_mini_tpu.models.config import get_config
+        from kserve_vllm_mini_tpu.models.llama import forward, init_params
+        from kserve_vllm_mini_tpu.parallel.mesh import MeshSpec, make_mesh
+        from kserve_vllm_mini_tpu.parallel.sharding import shard_params
+
+        if jax.device_count() < 2:
+            _SHARDED_FWD = (
+                False,
+                f"needs >=2 devices, backend exposes {jax.device_count()}",
+            )
+        else:
+            cfg = get_config("llama-tiny", max_seq_len=32)
+            p = init_params(jax.random.PRNGKey(0), cfg)
+            toks = jax.random.randint(
+                jax.random.PRNGKey(1), (2, 16), 0, cfg.vocab_size
+            )
+            pos = jnp.broadcast_to(
+                jnp.arange(16), (2, 16)
+            ).astype(jnp.int32)
+            lg_dense, _ = forward(p, cfg, toks, pos)
+            mesh = make_mesh(MeshSpec(tp=2))
+            lg_sharded, _ = forward(shard_params(p, cfg, mesh), cfg, toks, pos)
+            ndiff = int(
+                (np.asarray(lg_dense) != np.asarray(lg_sharded)).sum()
+            )
+            _SHARDED_FWD = (
+                ndiff == 0,
+                "" if ndiff == 0 else (
+                    f"tp=2 forward differs from dense in {ndiff}/"
+                    f"{np.asarray(lg_dense).size} logit elements"
+                ),
+            )
+    ok, why = _SHARDED_FWD
+    if not ok:
+        pytest.skip(
+            "GSPMD-partitioned forwards are not bitwise-stable vs the "
+            f"single-device program on this backend build ({why}); "
+            "token-exact sharded-vs-dense comparisons are argmax coin "
+            "flips here, not correctness signal"
+        )

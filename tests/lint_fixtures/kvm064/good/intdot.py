@@ -17,3 +17,19 @@ def int8_dot_general(x, w):
         xi, wi, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.int32,
     )
+
+
+def w8a8_qdot(x, qw):
+    """The serving convention (ops/qmatmul.py qdot): per-row activation
+    quant feeding the int8 x int8 contraction, int32 accumulator declared,
+    BOTH scales folded after accumulation in f32."""
+    xf = x.astype(jnp.float32) * qw.get("a", 1.0)
+    amax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+    xs = jnp.where(amax > 0, amax / 127.0, 1.0)
+    xq = jnp.clip(jnp.round(xf / xs), -127, 127).astype(jnp.int8)
+    acc = jax.lax.dot_general(
+        xq, qw["q"], (((xq.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    y = acc.astype(jnp.float32) * xs * qw["s"].astype(jnp.float32)
+    return y.astype(x.dtype)

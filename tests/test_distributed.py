@@ -16,6 +16,8 @@ from pathlib import Path
 
 import pytest
 
+from tests import env_guards
+
 WORKER = Path(__file__).parent / "distributed_worker.py"
 REPO = Path(__file__).parent.parent
 
@@ -70,7 +72,9 @@ def _run_pair(argv_style: bool) -> list[subprocess.CompletedProcess]:
 
 @pytest.mark.slow
 def test_two_process_mesh_and_psum():
+    env_guards.require_child_jax()
     results = _run_pair(argv_style=True)
+    env_guards.skip_if_multiprocess_unsupported([r.stderr for r in results])
     for i, r in enumerate(results):
         assert r.returncode == 0, f"worker {i} failed:\n{r.stderr[-2000:]}"
     outs = "\n".join(r.stdout for r in results)
@@ -80,7 +84,9 @@ def test_two_process_mesh_and_psum():
 
 @pytest.mark.slow
 def test_env_var_resolution():
+    env_guards.require_child_jax()
     results = _run_pair(argv_style=False)
+    env_guards.skip_if_multiprocess_unsupported([r.stderr for r in results])
     for i, r in enumerate(results):
         assert r.returncode == 0, f"worker {i} failed:\n{r.stderr[-2000:]}"
     assert "WORKER_OK pid=0 primary=True" in "".join(r.stdout for r in results)

@@ -549,6 +549,28 @@ def test_gemma_softcaps_bind():
     lg, _ = forward(p, cfg, toks, pos)
     assert float(jnp.max(jnp.abs(lg))) < cfg.final_softcap
     lg_nocap, _ = forward(p, cfg.scaled(attn_softcap=None), toks, pos)
+    delta = float(np.max(np.abs(
+        np.asarray(lg, dtype=np.float32) - np.asarray(lg_nocap, np.float32)
+    )))
+    if delta <= 1e-3:
+        # Tiny-init attention scores sit deep in tanh's linear region and
+        # the model runs bf16, so on some backend builds the cap is
+        # numerically INVISIBLE end-to-end (delta can be exactly 0.0 —
+        # PR 8's minimal-container failure was this coin flip landing
+        # heads). "The cap is really applied" is then a STRUCTURAL
+        # property: the capped program must carry the extra per-layer
+        # tanh the uncapped one lacks. (The cap's math is pinned
+        # numerically by test_gemma_attn_softcap_matches_reference.)
+        def _tanh_count(c):
+            jp = jax.make_jaxpr(lambda t, q: forward(p, c, t, q))(toks, pos)
+            return str(jp).count("tanh")
+
+        assert _tanh_count(cfg) > _tanh_count(cfg.scaled(attn_softcap=None))
+        pytest.skip(
+            f"attn-softcap delta {delta:.1e} is at the bf16 noise floor "
+            "on this backend build; cap verified present in the traced "
+            "program instead"
+        )
     assert not np.allclose(np.asarray(lg), np.asarray(lg_nocap), atol=1e-5)
 
 

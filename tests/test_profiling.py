@@ -241,6 +241,26 @@ def test_estimate_monotonic_in_slots_and_ctx():
     assert e(8, 256) < e(16, 256) < e(16, 512) < e(32, 1024)
 
 
+def test_estimate_prices_w8a8_activation_workspace():
+    """quant_mode=w8a8 must cost MORE workspace than dequant at the same
+    shape (the int8 activation copy + per-row f32 scales), and the delta
+    must scale with slots — the term exists so the guard can never admit
+    a shape whose activation-quant transient is the OOM allocation."""
+    from kserve_vllm_mini_tpu.models.config import get_config
+
+    cfg = get_config("llama-tiny", max_seq_len=1024)
+    deq = estimate_serving_bytes(cfg, 16, 512, quant="int8")
+    w8 = estimate_serving_bytes(cfg, 16, 512, quant="int8", quant_mode="w8a8")
+    assert w8["weight_bytes"] == deq["weight_bytes"]
+    assert w8["kv_bytes"] == deq["kv_bytes"]
+    extra = w8["workspace_bytes"] - deq["workspace_bytes"]
+    widest = max(cfg.d_ff, cfg.d_model)
+    assert extra == 16 * 512 * (widest + 4)
+    w8_32 = estimate_serving_bytes(cfg, 32, 512, quant="int8", quant_mode="w8a8")
+    deq_32 = estimate_serving_bytes(cfg, 32, 512, quant="int8")
+    assert (w8_32["workspace_bytes"] - deq_32["workspace_bytes"]) == 2 * extra
+
+
 # -- proxy block validator ----------------------------------------------------
 
 def _good_proxy():
@@ -249,6 +269,7 @@ def _good_proxy():
         "flops": 1e9, "bytes_accessed": 2e9, "compile_wall_s": 1.5,
         "peak_bytes": 3e9, "step_count_ratio": 1.2,
         "compile_stats": {}, "exec": {},
+        "quant": "int8", "quant_mode": "w8a8", "kv_quant": True,
     }
 
 
@@ -264,6 +285,7 @@ def test_validate_proxy_accepts_good_block():
     (lambda d: d.update(step_count_ratio=-1), "step_count_ratio"),
     (lambda d: d.update(n_devices=0), "n_devices"),
     (lambda d: d.update(exec="nope"), "exec"),
+    (lambda d: d.update(quant_mode="int8"), "quant_mode"),
 ])
 def test_validate_proxy_rejects(mutate, fragment):
     doc = _good_proxy()

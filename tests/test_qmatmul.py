@@ -1,0 +1,479 @@
+"""W8A8 native-quantized hot path (ops/qmatmul.py + the dense int8-KV
+decode kernel): activation-quant math, qdot-vs-dequant equivalence, the
+llama-tiny W8A8 oracle (logits tolerance + byte-identical greedy engine
+streams), dense-vs-paged int8-KV kernel consistency, the perplexity gate
+tripping on a seeded numerics break, and the compiled-bytes acceptance pin
+(w8a8+int8KV decode <= 60% of the bf16 dequant path's bytes on the
+8-device CPU-mesh proxy)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kserve_vllm_mini_tpu.models.config import get_config
+from kserve_vllm_mini_tpu.models.llama import forward, init_params
+from kserve_vllm_mini_tpu.ops.qmatmul import (
+    int8_dot,
+    qdot,
+    quantize_activations,
+    validate_quant_mode,
+)
+from kserve_vllm_mini_tpu.ops.quant import linear, quantize_params, quantize_weight
+
+
+def test_validate_quant_mode():
+    assert validate_quant_mode("dequant") == "dequant"
+    assert validate_quant_mode("w8a8") == "w8a8"
+    with pytest.raises(ValueError, match="quant_mode"):
+        validate_quant_mode("int8")
+
+
+def test_quantize_activations_roundtrip():
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 7, 64), jnp.float32)
+    q, s = quantize_activations(x)
+    assert q.dtype == jnp.int8 and s.dtype == jnp.float32
+    assert q.shape == x.shape and s.shape == (4, 7, 1)
+    back = q.astype(jnp.float32) * s
+    # symmetric int8 per row: error <= half a step = row_amax / 254
+    amax = np.max(np.abs(np.asarray(x)), axis=-1, keepdims=True)
+    err = np.abs(np.asarray(back) - np.asarray(x))
+    assert (err <= amax / 254.0 + 1e-6).all()
+
+
+def test_quantize_activations_zero_row_no_nan():
+    x = jnp.zeros((3, 16), jnp.float32)
+    q, s = quantize_activations(x)
+    assert np.asarray(q).max() == 0
+    assert np.all(np.asarray(s) == 1.0)  # scale 1.0, never 0/NaN
+
+
+def test_quantize_activations_pre_scale_folds():
+    """AWQ compensation folds into the SAME quant pass: quantizing (x * a)
+    directly equals quantize_activations(x, pre_scale=a)."""
+    x = jax.random.normal(jax.random.PRNGKey(1), (5, 32), jnp.float32)
+    a = jnp.abs(jax.random.normal(jax.random.PRNGKey(2), (32,))) + 0.1
+    q1, s1 = quantize_activations(x * a)
+    q2, s2 = quantize_activations(x, pre_scale=a)
+    np.testing.assert_array_equal(np.asarray(q1), np.asarray(q2))
+    np.testing.assert_allclose(np.asarray(s1), np.asarray(s2), rtol=1e-6)
+
+
+def test_int8_dot_accumulates_in_int32():
+    """The KVM064 convention, checked dynamically: a contraction long
+    enough to wrap an int8 accumulator must come back exact in int32."""
+    xq = jnp.full((1, 1024), 100, jnp.int8)
+    wq = jnp.full((1024, 1), 100, jnp.int8)
+    out = int8_dot(xq, wq)
+    assert out.dtype == jnp.int32
+    assert int(out[0, 0]) == 1024 * 100 * 100  # wraps at int8/int16 widths
+
+
+def test_qdot_matches_dequant_linear_int8():
+    w = jax.random.normal(jax.random.PRNGKey(0), (64, 32), jnp.float32)
+    x = jax.random.normal(jax.random.PRNGKey(1), (3, 5, 64), jnp.float32)
+    qw = quantize_weight(w)
+    y_deq = linear(x, qw)
+    y_w8 = qdot(x, qw)
+    assert y_w8.dtype == x.dtype
+    # activation rounding adds <= ~1/254 relative per element
+    denom = float(jnp.max(jnp.abs(y_deq)))
+    assert float(jnp.max(jnp.abs(y_w8 - y_deq))) / denom < 0.02
+    # and linear() dispatches to the same path
+    np.testing.assert_array_equal(
+        np.asarray(linear(x, qw, mode="w8a8")), np.asarray(y_w8)
+    )
+
+
+def test_qdot_matches_dequant_linear_int4_packed():
+    """Packed-int4 leaves feed the int8 contraction through the prologue
+    unpack — the packed uint8 tensor is the only weight operand."""
+    from kserve_vllm_mini_tpu.ops.quant import is_packed_int4
+
+    w = jax.random.normal(jax.random.PRNGKey(0), (64, 32), jnp.float32)
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 64), jnp.float32)
+    qw = quantize_weight(w, bits=4)
+    assert is_packed_int4(qw)
+    y_deq = linear(x, qw)
+    y_w8 = linear(x, qw, mode="w8a8")
+    denom = float(jnp.max(jnp.abs(y_deq)))
+    assert float(jnp.max(jnp.abs(y_w8 - y_deq))) / denom < 0.02
+
+
+def test_qdot_awq_leaf_matches_dequant():
+    from kserve_vllm_mini_tpu.ops.awq import quantize_weight_awq
+
+    w = jax.random.normal(jax.random.PRNGKey(0), (64, 32), jnp.float32)
+    a = np.abs(np.random.default_rng(0).normal(size=(64,))).astype(np.float32) + 0.1
+    a[::8] *= 10.0
+    leaf = quantize_weight_awq(w, jnp.asarray(a), bits=4)
+    x = jax.random.normal(jax.random.PRNGKey(1), (3, 64), jnp.float32)
+    y_deq = linear(x, leaf)
+    y_w8 = linear(x, leaf, mode="w8a8")
+    denom = float(jnp.max(jnp.abs(y_deq)))
+    assert float(jnp.max(jnp.abs(y_w8 - y_deq))) / denom < 0.03
+
+
+def test_qdot_batched_expert_contraction():
+    """MoE shape: [E, C, in] @ [E, in, out] with the expert axis as the
+    dot_general batch dim (models/moe.py _expert_linear w8a8 branch)."""
+    we = jax.random.normal(jax.random.PRNGKey(2), (4, 48, 16), jnp.float32)
+    xe = jax.random.normal(jax.random.PRNGKey(3), (4, 6, 48), jnp.float32)
+    qe = quantize_weight(we)
+    y = qdot(xe, qe, batch_dims=1)
+    ref = jnp.einsum("ecd,edf->ecf", xe, we)
+    assert y.shape == ref.shape
+    denom = float(jnp.max(jnp.abs(ref)))
+    assert float(jnp.max(jnp.abs(y - ref))) / denom < 0.03
+
+
+def test_qdot_traced_matches_eager():
+    w = jax.random.normal(jax.random.PRNGKey(0), (32, 16), jnp.float32)
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 32), jnp.float32)
+    qw = quantize_weight(w)
+    eager = np.asarray(qdot(x, qw))
+    traced = np.asarray(jax.jit(lambda x: qdot(x, qw))(x))
+    np.testing.assert_allclose(traced, eager, rtol=1e-6, atol=1e-6)
+
+
+# -- the llama-tiny W8A8 oracle ----------------------------------------------
+
+
+def test_w8a8_forward_close_to_dequant():
+    """Full-model logits under quant_mode=w8a8 track the dequant path
+    within activation-quant tolerance, with top-1 agreement on most
+    positions (the W8A16 bar of tests/test_quant.py, held by W8A8)."""
+    cfg = get_config("llama-tiny", max_seq_len=64)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    qparams = quantize_params(params)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, cfg.vocab_size)
+    pos = jnp.broadcast_to(jnp.arange(16), (2, 16)).astype(jnp.int32)
+
+    lg_deq, _ = forward(qparams, cfg, toks, pos)
+    lg_w8, _ = forward(qparams, cfg.scaled(quant_mode="w8a8"), toks, pos)
+    # distributions stay close in the bulk
+    pd = jax.nn.softmax(lg_deq, -1)
+    pw = jax.nn.softmax(lg_w8, -1)
+    tv = float(0.5 * jnp.sum(jnp.abs(pd - pw), axis=-1).max())
+    assert tv < 0.15, f"total-variation distance {tv}"
+    agree = float(jnp.mean(
+        (jnp.argmax(lg_deq, -1) == jnp.argmax(lg_w8, -1)).astype(jnp.float32)
+    ))
+    assert agree >= 0.75, f"greedy agreement {agree}"
+
+
+def test_w8a8_engine_streams_byte_identical_to_dequant():
+    """The engine-level oracle: greedy streams under quant_mode=w8a8 are
+    byte-identical to the dequant path's on llama-tiny int8 (fixed seeds;
+    CPU execution is deterministic, so this is a fixed outcome — a flip
+    here means the w8a8 numerics moved)."""
+    from kserve_vllm_mini_tpu.runtime.engine import GenRequest
+    from kserve_vllm_mini_tpu.runtime.server import build_engine
+
+    def run(mode):
+        engine, tok, _ = build_engine(
+            model="llama-tiny", quantization="int8", quant_mode=mode,
+            max_slots=2, max_seq_len=128,
+        )
+        assert engine.cfg.quant_mode == mode
+        assert engine.ecfg.quant_mode == mode
+        engine.start()
+        try:
+            outs = []
+            for prompt in ("hello there", "the quick brown fox"):
+                h = engine.submit(GenRequest(
+                    prompt_tokens=tok.encode(prompt), max_new_tokens=12,
+                ))
+                toks = []
+                while True:
+                    kind, *rest = h.events.get(timeout=120)
+                    if kind != "token":
+                        break
+                    toks.append(rest[0])
+                outs.append(toks)
+        finally:
+            engine.stop()
+        return outs
+
+    assert run("dequant") == run("w8a8")
+
+
+# -- dense int8-KV decode kernel ----------------------------------------------
+
+
+def test_dense_kernel_matches_eager_oracle():
+    """Direct kernel-vs-oracle in f32: in-kernel dequant over the dense
+    [L, B, KVH, S, D] cache equals dequantize-then-attend."""
+    from kserve_vllm_mini_tpu.ops.attention import attention
+    from kserve_vllm_mini_tpu.ops.paged_attention import dense_decode_attention
+
+    rng = np.random.default_rng(4)
+    L, B, KVH, G, D, S = 2, 3, 2, 2, 32, 64
+    kq = jnp.asarray(rng.integers(-127, 128, size=(L, B, KVH, S, D)), jnp.int8)
+    vq = jnp.asarray(rng.integers(-127, 128, size=(L, B, KVH, S, D)), jnp.int8)
+    ks = jnp.asarray(rng.uniform(0.01, 0.1, size=(L, B, KVH, S)).astype(np.float32))
+    vs = jnp.asarray(rng.uniform(0.01, 0.1, size=(L, B, KVH, S)).astype(np.float32))
+    q = jnp.asarray(rng.normal(size=(B, KVH, G, D)).astype(np.float32))
+    # positions inside block 0, mid-sweep, and the last valid position
+    qpos = jnp.asarray([5, 23, 63], jnp.int32)
+
+    out = dense_decode_attention(q, kq, vq, qpos, layer=1,
+                                 k_scale=ks, v_scale=vs, interpret=True)
+    kf = kq[1].astype(jnp.float32) * ks[1][..., None]
+    vf = vq[1].astype(jnp.float32) * vs[1][..., None]
+    qh = q.reshape(B, KVH * G, 1, D)
+    mask = jnp.arange(S)[None, None, None, :] <= qpos[:, None, None, None]
+    ref = attention(qh, kf, vf, mask).reshape(B, KVH, G, D)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_dense_kernel_block_helper():
+    from kserve_vllm_mini_tpu.ops.paged_attention import dense_decode_block
+
+    assert dense_decode_block(1024) == 512
+    assert dense_decode_block(64) == 64
+    assert dense_decode_block(24) == 8
+    assert dense_decode_block(7) is None  # not 8-aligned: eager fallback
+
+
+def test_model_dense_kernel_matches_eager_path():
+    """Forced dense kernel through the model's int8-KV decode path agrees
+    with the eager dequantize-on-read path (which rounds in bf16 — same
+    tolerance contract as the paged kernel's model test)."""
+    from kserve_vllm_mini_tpu.models import llama
+    from kserve_vllm_mini_tpu.models.llama import init_kv_cache
+
+    cfg = get_config("llama-tiny", max_seq_len=64)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 12), 0, cfg.vocab_size)
+    pos = jnp.broadcast_to(jnp.arange(12), (2, 12)).astype(jnp.int32)
+    offs = jnp.zeros((2,), jnp.int32)
+
+    def one_step(force):
+        old = llama._FORCE_DENSE_KERNEL
+        llama._FORCE_DENSE_KERNEL = force
+        try:
+            cache = init_kv_cache(cfg, 2, max_seq=64, quantized=True)
+            lg, cache = forward(params, cfg, toks, pos, cache, offs,
+                                fresh_prefill=True)
+            nxt = jnp.argmax(lg[:, -1, :], -1).astype(jnp.int32)
+            lens = jnp.full((2,), 12, jnp.int32)
+            lg2, _ = forward(params, cfg, nxt[:, None], lens[:, None],
+                             cache, lens)
+        finally:
+            llama._FORCE_DENSE_KERNEL = old
+        return np.asarray(lg2[:, 0, :])
+
+    eager = one_step(False)
+    kernel = one_step(True)
+    # eager dequantizes in model dtype (bf16), the kernel in f32
+    np.testing.assert_allclose(kernel, eager, rtol=3e-2, atol=3e-2)
+    np.testing.assert_array_equal(eager.argmax(-1), kernel.argmax(-1))
+
+
+def test_dense_vs_paged_kernel_consistency():
+    """The two kernels see the SAME int8-KV stream through different
+    layouts: a dense-cache decode (dense kernel forced) and a paged-pool
+    decode (paged kernel forced) over the same token stream must produce
+    the same greedy tokens, and logits within kernel-vs-kernel rounding
+    (both dequantize in f32 in-kernel; only the sweep order differs)."""
+    from kserve_vllm_mini_tpu.models import llama
+    from kserve_vllm_mini_tpu.models.llama import init_kv_cache, init_paged_kv_cache
+
+    cfg = get_config("llama-tiny", max_seq_len=64)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    B, T, BLK = 2, 16, 8
+    table = jnp.asarray(
+        [[3, 17, 5, 9, 11, 2, 16, 19], [7, 0, 14, 6, 12, 8, 13, 1]], jnp.int32
+    )
+    toks = jax.random.randint(jax.random.PRNGKey(1), (B, T), 0, cfg.vocab_size)
+    pos = jnp.broadcast_to(jnp.arange(T), (B, T)).astype(jnp.int32)
+    zero = jnp.zeros((B,), jnp.int32)
+
+    def run(paged):
+        old_p, old_d = llama._FORCE_PAGED_KERNEL, llama._FORCE_DENSE_KERNEL
+        llama._FORCE_PAGED_KERNEL = paged
+        llama._FORCE_DENSE_KERNEL = not paged
+        try:
+            if paged:
+                cache = init_paged_kv_cache(cfg, 20, BLK, quantized=True)
+                kw = {"block_table": table}
+            else:
+                cache = init_kv_cache(cfg, B, max_seq=64, quantized=True)
+                kw = {}
+            lg, cache = forward(params, cfg, toks, pos, cache, zero,
+                                fresh_prefill=True, **kw)
+            nxt = jnp.argmax(lg[:, -1, :], -1).astype(jnp.int32)
+            lens = jnp.full((B,), T, jnp.int32)
+            steps = []
+            for _ in range(4):
+                lg2, cache = forward(params, cfg, nxt[:, None], lens[:, None],
+                                     cache, lens, **kw)
+                nxt = jnp.argmax(lg2[:, 0, :], -1).astype(jnp.int32)
+                steps.append(np.asarray(nxt))
+                lens = lens + 1
+            return np.stack(steps), np.asarray(lg2[:, 0, :])
+        finally:
+            llama._FORCE_PAGED_KERNEL = old_p
+            llama._FORCE_DENSE_KERNEL = old_d
+
+    toks_d, lg_d = run(paged=False)
+    toks_p, lg_p = run(paged=True)
+    np.testing.assert_array_equal(toks_d, toks_p)
+    np.testing.assert_allclose(lg_d, lg_p, rtol=3e-2, atol=3e-2)
+
+
+def test_dense_kernel_gate_excludes_unsupported_shapes():
+    """Windowed/softcapped models and prefill-against-cache shapes must
+    keep the eager path even when the kernel is forced on — the gate, not
+    the force flag, owns correctness."""
+    from kserve_vllm_mini_tpu.models import llama
+    from kserve_vllm_mini_tpu.models.llama import init_kv_cache
+
+    cfg = get_config("mistral-tiny", max_seq_len=64)  # sliding_window=16
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 24), 0, cfg.vocab_size)
+    pos = jnp.broadcast_to(jnp.arange(24), (2, 24)).astype(jnp.int32)
+    offs = jnp.zeros((2,), jnp.int32)
+    old = llama._FORCE_DENSE_KERNEL
+    llama._FORCE_DENSE_KERNEL = True
+    try:
+        cache = init_kv_cache(cfg, 2, max_seq=64, quantized=True)
+        lg, cache = forward(params, cfg, toks, pos, cache, offs,
+                            fresh_prefill=True)
+        lens = jnp.full((2,), 24, jnp.int32)
+        nxt = jnp.argmax(lg[:, -1, :], -1).astype(jnp.int32)
+        # windowed decode: the gate must route to the masked eager path
+        # (the kernel has no window support); finite logits prove it ran
+        lg2, _ = forward(params, cfg, nxt[:, None], lens[:, None], cache, lens)
+        assert bool(jnp.isfinite(lg2).all())
+    finally:
+        llama._FORCE_DENSE_KERNEL = old
+
+
+# -- perplexity gate -----------------------------------------------------------
+
+
+def test_perplexity_gate_trips_on_dropped_activation_scale(monkeypatch):
+    """The seeded numerics break: dropping the per-row activation scale
+    (returning scale=1 from quantize_activations) must blow the w8a8 NLL
+    past the sweep gate's threshold, while the CORRECT w8a8 path stays
+    well under it — the gate separates quantization noise from broken
+    math.
+
+    A pure random-init model sits AT chance (NLL ~= log V), so no break
+    can move its NLL — the oracle model needs predictive structure. Tied
+    embeddings give it one for free: with 0.02-std layer weights the
+    residual stream stays ~= the input embedding, so logits = x @ E^T
+    predict "next token = current token" — strong (below-chance NLL) on
+    repetitive text, and exactly the structure a broken quantized matmul
+    destroys (the corrupted branch output swamps the residual identity
+    and NLL collapses back to chance)."""
+    from kserve_vllm_mini_tpu.ops import qmatmul
+    from kserve_vllm_mini_tpu.quality.perplexity import eval_text_nll
+    from kserve_vllm_mini_tpu.runtime.tokenizer import load_tokenizer
+    from kserve_vllm_mini_tpu.sweeps.quantization import (
+        PERPLEXITY_GATE_MAX_NLL_DELTA,
+    )
+
+    tok = load_tokenizer(None)
+    cfg = get_config("llama-tiny", max_seq_len=256).scaled(
+        vocab_size=max(512, tok.vocab_size), tie_embeddings=True,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    # sharper self-logits (x @ E^T ~ |E_t|^2): the identity prediction
+    # drops the baseline well below chance, widening the band the gate
+    # discriminates over. Embeddings are not a quantized leaf.
+    params["embed"] = params["embed"] * 4.0
+    qparams = quantize_params(params)
+    texts = [
+        "aaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaa" * 3,
+        "the the the the the the the the the the " * 3,
+    ]
+
+    base = eval_text_nll(qparams, cfg, tok, texts=texts)["nll_per_token"]
+    assert base < float(np.log(cfg.vocab_size)) - 1.0  # real structure
+    w8 = eval_text_nll(qparams, cfg.scaled(quant_mode="w8a8"), tok,
+                       texts=texts)["nll_per_token"]
+    # correct w8a8 is quantization NOISE, far under the gate
+    assert abs(w8 - base) < 0.1
+
+    real_quantize = qmatmul.quantize_activations
+
+    def dropped_scale(x, pre_scale=None):
+        q, s = real_quantize(x, pre_scale=pre_scale)
+        return q, jnp.ones_like(s)  # the seeded break: scale dropped
+
+    monkeypatch.setattr(qmatmul, "quantize_activations", dropped_scale)
+    broken = eval_text_nll(qparams, cfg.scaled(quant_mode="w8a8"), tok,
+                           texts=texts)["nll_per_token"]
+    assert broken - base > PERPLEXITY_GATE_MAX_NLL_DELTA, (broken, base)
+
+
+def test_sweep_gate_fails_cell_past_threshold(tmp_path):
+    """run_quantization's gate column: a cell whose NLL exceeds the
+    baseline's by more than the threshold FAILS with a perplexity-gate
+    error; an in-tolerance cell records its delta and stays ok."""
+    from kserve_vllm_mini_tpu.sweeps.quantization import run_quantization
+
+    def bench(cfg):
+        nll = {"none": 2.0, "int8": 2.1, "int4": 9.0}[cfg["quantization"]]
+        return {
+            "p50_ms": 100.0, "p95_ms": 200.0, "tokens_per_sec": 1000.0,
+            "error_rate": 0.0, "cost_per_1k_tokens": 0.01,
+            "quality_score": 90.0, "quality_nll_per_token": nll,
+            "quality_perplexity": float(np.exp(nll)),
+        }
+
+    rows = run_quantization(
+        {}, tmp_path,
+        space={"quantization": ["none", "int8", "int4"],
+               "kv_cache_dtype": ["model"], "decoding": ["greedy"],
+               "quant_mode": ["w8a8"]},
+        bench_fn=bench,
+    )
+    by_q = {r["quantization"]: r for r in rows}
+    # the unquantized baseline keeps quant_mode=dequant (duplicate filter)
+    assert by_q["none"]["quant_mode"] in (None, "dequant")
+    assert by_q["none"]["status"] == "ok"
+    assert by_q["int8"]["status"] == "ok"
+    assert by_q["int8"]["quality_perplexity_delta_vs_baseline"] == 0.1
+    assert by_q["int4"]["status"] == "failed"
+    assert "perplexity gate" in by_q["int4"]["error"]
+
+
+# -- the compiled-bytes acceptance pin ----------------------------------------
+
+
+def test_w8a8_decode_compiled_bytes_vs_bf16():
+    """THE acceptance criterion: on the 8-device CPU-mesh proxy rail
+    (profiling/proxy.py cost_model_stats — abstract compile, XLA cost
+    model), the fully-quantized decode step (int8 weights contracted
+    W8A8 + int8 KV) must access <= 60% of the bf16 dequant path's bytes
+    on llama-tiny. The quantized abstract trees mean the cost model
+    prices the int8 weight stream the deployment actually reads."""
+    from kserve_vllm_mini_tpu.profiling.proxy import cost_model_stats
+
+    bf16 = cost_model_stats("llama-tiny", "none", slots=8, max_seq=128)
+    w8a8 = cost_model_stats("llama-tiny", "int8", slots=8, max_seq=128,
+                            quant_mode="w8a8", kv_quant=True)
+    assert w8a8["quant_mode"] == "w8a8" and w8a8["kv_quant"] is True
+    ratio = (w8a8["decode"]["bytes_accessed"]
+             / max(bf16["decode"]["bytes_accessed"], 1.0))
+    assert ratio <= 0.60, f"compiled bytes ratio {ratio:.3f} > 0.60"
+    # and the weight stream itself halves (int8 vs bf16 leaves)
+    assert w8a8["analytic"]["weight_bytes"] < 0.6 * bf16["analytic"]["weight_bytes"]
+
+
+def test_proxy_block_carries_quant_labels():
+    from kserve_vllm_mini_tpu.core.schema import validate_proxy
+    from kserve_vllm_mini_tpu.profiling.proxy import run_proxy_tier
+
+    block = run_proxy_tier(
+        "llama-tiny", exec_model="llama-tiny", quant="int8", slots=4,
+        max_seq=128, decode_steps=4, kv_quant=True, quant_mode="w8a8",
+    )
+    assert validate_proxy(block) == []
+    assert block["quant_mode"] == "w8a8"
+    assert block["kv_quant"] is True
