@@ -2,7 +2,7 @@
 # of native code — the TPU compute path is JAX/XLA compiled at runtime.
 PY ?= python
 
-.PHONY: help test test-fast test-policy lint lint-invariants fmt smoke bench bench-smoke bench-proxy-smoke trajectory dashboards-validate helm-lint airgap clean
+.PHONY: help test test-fast test-policy lint lint-invariants fmt smoke bench bench-smoke bench-proxy-smoke chaos-smoke trajectory dashboards-validate helm-lint airgap clean
 
 help:
 	@grep -E '^[a-z-]+:' Makefile | sed 's/:.*//' | sort | uniq
@@ -75,6 +75,15 @@ bench:  ## driver benchmark (one JSON line) on the attached accelerator
 # MONITOR_JSON_SCHEMA incl. the scripted-stall event (docs/MONITORING.md).
 bench-smoke:  ## bench pipeline vs the mock server, tiny budget, no TPU
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_bench_smoke.py tests/test_monitor.py -q
+
+# the resilience acceptance gate (docs/RESILIENCE.md): the local chaos
+# scenario matrix end-to-end against the mock server — one fault per
+# class through POST /faults, MTTR measured from fault-clear to first
+# healthy completion, and a resilience_table.json that validates against
+# core/schema.py RESILIENCE_JSON_SCHEMA — plus the loadgen retry/shed
+# accounting and monitor event rules they feed.
+chaos-smoke:  ## local-mode chaos matrix vs the mock server, no TPU, no cluster
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_chaos_local.py tests/test_resilience.py -q -m "not slow"
 
 # the never-dark acceptance gate (docs/PROFILING.md): with no TPU,
 # `python bench.py` must exit 0 with a schema-valid `proxy` block

@@ -137,6 +137,12 @@ def analyze_run(
     update.update(
         telemetry.kv_cache_block(endpoint, runtime_metrics=runtime_metrics)
     )
+    # resilience block (docs/RESILIENCE.md): sheds/watchdog/degrade from
+    # the runtime rail; the CSV-side shed accounting (shed_requests,
+    # shed_rate, retries_total) already landed via compute_latency_stats
+    update.update(
+        telemetry.resilience_block(endpoint, runtime_metrics=runtime_metrics)
+    )
 
     # server-side request traces (docs/TRACING.md): fetch /traces, merge
     # the server leg into runs/<id>/traces/traces.json joined by trace_id,

@@ -59,6 +59,13 @@ def compute_latency_stats(records: list[RequestRecord]) -> dict[str, Any]:
     """
     total = len(records)
     ok = [r for r in records if r.ok]
+    # shed requests (429 past the retry budget, docs/RESILIENCE.md) are
+    # their OWN outcome class: an overload run shedding by design is not
+    # a broken run, so they never inflate error_rate — and they are never
+    # hidden either (shed_requests/shed_rate count them separately).
+    # Latency percentiles stay over admitted (ok) requests only.
+    shed = sum(1 for r in records if r.shed)
+    retries = sum(r.retries for r in records)
     lat = [r.latency_ms for r in ok if r.latency_ms > 0]
     ttft = [r.ttft_ms for r in ok if r.ttft_ms > 0]
     t0, t1 = window_bounds(records)
@@ -68,13 +75,18 @@ def compute_latency_stats(records: list[RequestRecord]) -> dict[str, Any]:
 
     out: dict[str, Any] = {
         "requests": total,
-        "error_rate": (total - len(ok)) / total if total else 0.0,
+        "error_rate": (total - len(ok) - shed) / total if total else 0.0,
         "throughput_rps": len(ok) / duration if t1 > t0 else 0.0,
         "tokens_per_sec": tokens_out / duration if t1 > t0 else 0.0,
         "window": {"start": t0, "end": t1, "duration_s": t1 - t0},
         "total_tokens_in": tokens_in,
         "total_tokens_out": tokens_out,
     }
+    if shed:
+        out["shed_requests"] = shed
+        out["shed_rate"] = shed / total
+    if retries:
+        out["retries_total"] = retries
     # Latency keys are emitted only when data exists: an all-error run must
     # not write p95_ms=0.0 that a downstream SLO gate would happily pass.
     if lat:

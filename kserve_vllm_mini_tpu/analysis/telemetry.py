@@ -431,6 +431,44 @@ def kv_cache_block(
     return out
 
 
+# results.json `resilience` sub-key -> runtime metric (docs/
+# RESILIENCE.md). Keyed by SUB-KEY (the COMPILE/KV orientation) because
+# the whole map lands under the one typed `resilience` results field.
+RESILIENCE_METRIC_KEYS = {
+    "requests_shed": "kvmini_tpu_requests_shed_total",
+    "watchdog_trips": "kvmini_tpu_watchdog_trips_total",
+    "engine_faults": "kvmini_tpu_engine_faults_total",
+    "degrade_level": "kvmini_tpu_degrade_level",
+    "faults_armed": "kvmini_tpu_faults_armed",
+}
+
+
+def resilience_block(
+    endpoint: Optional[str],
+    runtime_metrics: Optional[dict[str, float]] = None,
+) -> dict[str, Any]:
+    """Resilience counters (sheds, watchdog trips, recovered engine
+    faults, degrade level, armed injection points) from the runtime's
+    /metrics, nested under the `resilience` results key
+    (docs/RESILIENCE.md). Degradation rules as ever: an endpoint that
+    doesn't export the rail (any external engine) yields NO block, and a
+    runtime with zero resilience activity yields no block either — an
+    all-zero resilience report carries no information."""
+    if not endpoint:
+        return {}
+    m = (runtime_metrics if runtime_metrics is not None
+         else scrape_runtime_metrics(endpoint))
+    block = {
+        out_key: m[metric]
+        for out_key, metric in RESILIENCE_METRIC_KEYS.items()
+        if metric in m
+    }
+    if "requests_shed" not in block or not any(block.values()):
+        return {}
+    block["source"] = "metrics:scrape"
+    return {"resilience": block}
+
+
 def cache_hit_ratio(
     prom_url: Optional[str],
     endpoint: Optional[str],

@@ -230,6 +230,13 @@ def _run_stages(
         prompt_set=profile.get("prompt_set", "default"),
         input_tokens=int(profile.get("input_tokens", 0)),
         seed=int(profile.get("seed", 42)),
+        connect_timeout_s=float(profile.get("connect_timeout_s", 10.0)),
+        read_timeout_s=float(profile.get("read_timeout_s", 30.0)),
+        max_retries=int(profile.get("max_retries", 3)),
+        deadline_ms=(
+            float(profile["deadline_ms"])
+            if profile.get("deadline_ms") is not None else None
+        ),
         extra_body=profile.get("extra_body", {}) or {},
     )
     records = run_load(cfg, run_dir, live=live, abort=abort)
@@ -340,6 +347,17 @@ def _run_stages(
         # validation closes here when the device reported a peak
         kv = server.engine.kv_cache_snapshot()
         run_dir.merge_into_results({"kv_cache": kv})
+        # resilience block (docs/RESILIENCE.md): authoritative direct
+        # snapshot, present only when the run saw resilience activity
+        # (same zero-activity absence rule as the /metrics scrape)
+        res = {
+            key: es[key]
+            for key in ("requests_shed", "watchdog_trips", "engine_faults",
+                        "degrade_level", "faults_armed")
+        }
+        if any(res.values()):
+            res["source"] = "engine:snapshot"
+            run_dir.merge_into_results({"resilience": res})
         from kserve_vllm_mini_tpu.profiling.headroom import headroom_error_pct
 
         err = headroom_error_pct(

@@ -43,6 +43,7 @@ class FaultResult:
     mttr_s: Optional[float] = None
     p95_ms: Optional[float] = None
     error_rate: Optional[float] = None
+    shed_rate: Optional[float] = None   # 429-shed fraction under fault
     gate_ok: Optional[bool] = None
     detail: str = ""
 
@@ -51,9 +52,10 @@ class FaultResult:
             "fault": self.fault,
             "injected": self.injected,
             "recovered": self.recovered,
-            "mttr_s": None if self.mttr_s is None else round(self.mttr_s, 1),
+            "mttr_s": None if self.mttr_s is None else round(self.mttr_s, 2),
             "p95_ms": self.p95_ms,
             "error_rate": self.error_rate,
+            "shed_rate": self.shed_rate,
             "gate_ok": self.gate_ok,
             "detail": self.detail,
         }
@@ -213,10 +215,27 @@ class ChaosHarness:
         }
         if fault not in injectors:
             raise ValueError(f"unknown fault {fault!r} (known: {FAULTS})")
-        if not self._isvc_ready():
+        try:
+            ready = self._isvc_ready()
+        except Exception as e:  # noqa: BLE001 — a broken kubectl is a result
+            return FaultResult(
+                fault, False, False,
+                detail=f"readiness check failed: {type(e).__name__}: {e}",
+            )
+        if not ready:
             return FaultResult(fault, False, False, detail="service not Ready before fault")
 
-        injected, detail = injectors[fault]()
+        # A raising injector (kubectl binary missing, cluster gone mid-run)
+        # must SHORT-CIRCUIT to an injected=False row with gate_ok left
+        # None: proceeding to bench-and-gate would bench the healthy
+        # service and stamp a green gate onto a fault that never happened.
+        try:
+            injected, detail = injectors[fault]()
+        except Exception as e:  # noqa: BLE001 — injection failure is a row
+            return FaultResult(
+                fault, False, False,
+                detail=f"injection failed: {type(e).__name__}: {e}",
+            )
         result = FaultResult(fault, injected, False, detail=detail)
         if not injected:
             return result
@@ -274,11 +293,16 @@ class ChaosHarness:
 
 
 def write_resilience_table(
-    results: list[FaultResult], path: Path, cfg: ChaosConfig
+    results: list[FaultResult], path: Path, cfg: ChaosConfig,
+    target: str = "kserve",
 ) -> dict[str, Any]:
+    """The shared resilience_table.json writer — one shape for the
+    cluster harness and `--target local` (core/schema.py
+    validate_resilience; `make chaos-smoke` gates on it)."""
     table = {
         "service": cfg.service,
         "namespace": cfg.namespace,
+        "target": target,
         "faults": [r.row() for r in results],
         "all_recovered": all(r.recovered for r in results if r.injected),
         "worst_mttr_s": max(
@@ -291,57 +315,119 @@ def write_resilience_table(
     return table
 
 
+def table_exit_code(table: dict[str, Any]) -> int:
+    """CI exit for a resilience table: 0 only when every injected fault
+    recovered AND at least one fault was actually injected —
+    ``all_recovered`` is vacuously true over an empty injected set, and
+    a run where every injection failed (broken kubectl, /faults
+    disabled) must not read as a passing chaos matrix."""
+    injected_any = any(r.get("injected") for r in table.get("faults", []))
+    return 0 if table.get("all_recovered") and injected_any else 1
+
+
 # -- CLI ---------------------------------------------------------------------
 
 def register(parser: argparse.ArgumentParser) -> None:
-    parser.add_argument("--namespace", required=True)
-    parser.add_argument("--service", required=True)
-    parser.add_argument("--faults", default=",".join(FAULTS),
-                        help="Comma-separated subset of: " + ", ".join(FAULTS))
+    parser.add_argument("--target", default="kserve",
+                        choices=["kserve", "local"],
+                        help="'kserve' injects at the cluster layer; "
+                             "'local' drives a live local server's "
+                             "in-process injection points via POST /faults "
+                             "(start it with --allow-fault-injection; "
+                             "docs/RESILIENCE.md) — same scenario loop, "
+                             "same resilience_table.json, no cluster")
+    parser.add_argument("--namespace", default=None,
+                        help="Required for --target kserve")
+    parser.add_argument("--service", default=None,
+                        help="Required for --target kserve")
+    parser.add_argument("--faults", default=None,
+                        help="Comma-separated subset. kserve: "
+                             + ", ".join(FAULTS) + ". local: "
+                             "sweep-wedge, device-error, kv-alloc-fail, "
+                             "sse-disconnect, publish-drop")
     parser.add_argument("--url", default=None,
-                        help="Endpoint to bench after each fault (skip bench if unset)")
+                        help="Endpoint to bench after each fault (required "
+                             "for --target local; optional bench for kserve)")
     parser.add_argument("--requests", type=int, default=50)
     parser.add_argument("--concurrency", type=int, default=5)
     parser.add_argument("--slo", default=None, help="Gate each post-fault bench")
     parser.add_argument("--ready-timeout", type=float, default=900.0)
+    parser.add_argument("--recovery-timeout", type=float, default=30.0,
+                        help="Local mode: MTTR budget after a fault clears")
     parser.add_argument("--output", default="resilience_table.json")
 
 
+def _make_bench_fn(url: str, requests: int, concurrency: int):
+    def bench_fn(fault: str) -> dict[str, Any]:
+        from kserve_vllm_mini_tpu.bench_pipeline import run_bench
+
+        results, _ = run_bench(
+            url=url,
+            profile={
+                "model": "default",
+                "requests": requests,
+                "concurrency": concurrency,
+            },
+        )
+        if not results:
+            raise RuntimeError("bench produced no results")
+        return results
+
+    return bench_fn
+
+
+def _make_gate_fn(slo_path: str):
+    from kserve_vllm_mini_tpu.gates.slo import gate_results, load_slo
+
+    budgets = load_slo(slo_path)
+
+    def gate_fn(results: dict[str, Any]) -> bool:
+        return all(v.ok for v in gate_results(results, budgets))
+
+    return gate_fn
+
+
 def run(args: argparse.Namespace) -> int:
+    gate_fn = _make_gate_fn(args.slo) if args.slo else None
+    fault_list = [
+        f.strip() for f in (args.faults or "").split(",") if f.strip()
+    ] or None
+
+    if args.target == "local":
+        from kserve_vllm_mini_tpu.chaos.local import LocalChaosHarness
+
+        if not args.url:
+            print("chaos: --target local requires --url", file=sys.stderr)
+            return 2
+        bench_fn = _make_bench_fn(args.url, args.requests, args.concurrency)
+        harness = LocalChaosHarness(
+            args.url, bench_fn=bench_fn, gate_fn=gate_fn,
+            recovery_timeout_s=args.recovery_timeout,
+        )
+        results = harness.run_all(fault_list)
+        cfg = ChaosConfig(namespace=args.namespace or "-",
+                          service=args.service or "local")
+        table = write_resilience_table(
+            results, Path(args.output), cfg, target="local"
+        )
+        print(json.dumps(table, indent=2))
+        return table_exit_code(table)
+
+    if not args.namespace or not args.service:
+        print("chaos: --target kserve requires --namespace and --service",
+              file=sys.stderr)
+        return 2
     cfg = ChaosConfig(
         namespace=args.namespace,
         service=args.service,
         ready_timeout_s=args.ready_timeout,
     )
-
-    bench_fn = None
-    if args.url:
-        def bench_fn(fault: str) -> dict[str, Any]:
-            from kserve_vllm_mini_tpu.bench_pipeline import run_bench
-
-            results, _ = run_bench(
-                url=args.url,
-                profile={
-                    "model": "default",
-                    "requests": args.requests,
-                    "concurrency": args.concurrency,
-                },
-            )
-            if not results:
-                raise RuntimeError("bench produced no results")
-            return results
-
-    gate_fn = None
-    if args.slo:
-        from kserve_vllm_mini_tpu.gates.slo import gate_results, load_slo
-
-        budgets = load_slo(args.slo)
-
-        def gate_fn(results: dict[str, Any]) -> bool:
-            return all(v.ok for v in gate_results(results, budgets))
-
+    bench_fn = (
+        _make_bench_fn(args.url, args.requests, args.concurrency)
+        if args.url else None
+    )
     harness = ChaosHarness(cfg, bench_fn=bench_fn, gate_fn=gate_fn)
-    results = harness.run_all([f.strip() for f in args.faults.split(",") if f.strip()])
+    results = harness.run_all(fault_list)
     table = write_resilience_table(results, Path(args.output), cfg)
     print(json.dumps(table, indent=2))
-    return 0 if table["all_recovered"] else 1
+    return table_exit_code(table)

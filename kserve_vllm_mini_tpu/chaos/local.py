@@ -1,0 +1,205 @@
+"""`kvmini-tpu chaos --target local`: the scenario matrix against a LIVE
+local server, no cluster (docs/RESILIENCE.md).
+
+The cluster harness (chaos/harness.py) injects at the Kubernetes layer;
+this one drives the runtime's own in-process injection points through
+``POST /faults`` (the server must run with ``--allow-fault-injection``;
+``tests/mock_server.py`` speaks the same wire shape). Per scenario:
+
+1. verify the endpoint is healthy (one tiny completion),
+2. arm the fault,
+3. bench DURING the fault (p95-under-fault, error/shed rates via the
+   injectable ``bench_fn``, or a small built-in probe burst),
+4. clear the fault,
+5. MTTR = time to the FIRST healthy completion after the clear,
+6. optional gate on the during-fault results.
+
+Output is the same ``resilience_table.json`` the cluster harness writes
+(``write_resilience_table``; schema-gated by ``core/schema.py``
+``validate_resilience`` in ``make chaos-smoke``).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Callable, Optional
+
+from kserve_vllm_mini_tpu.chaos.harness import FaultResult
+
+# local fault classes -> the runtime injection point each exercises
+# (runtime/faults.py FAULT_POINTS). One scenario per failure class the
+# tentpole threads through the hot paths. `times: 0` = until cleared.
+LOCAL_FAULTS = [
+    "sweep-wedge",
+    "device-error",
+    "kv-alloc-fail",
+    "sse-disconnect",
+    "publish-drop",
+]
+
+FAULT_ARMS: dict[str, dict[str, Any]] = {
+    "sweep-wedge": {"name": "sweep_stall", "times": 0, "duration": 0.4},
+    # BOUNDED on purpose: each device fault climbs the engine's degrade
+    # ladder one level, and an until-cleared error would walk a real
+    # engine off the end of it (level 4 = give up) before the harness
+    # could clear — 2 faults leaves it serving, degraded, measurable
+    "device-error": {"name": "device_error", "times": 2},
+    "kv-alloc-fail": {"name": "kv_alloc_fail", "times": 0, "duration": 0.5},
+    "sse-disconnect": {"name": "sse_disconnect", "times": 0,
+                       "after_tokens": 1},
+    # publish_drop needs a multihost primary; a single-host target gets
+    # an honest injected=False row, never a skipped-silently scenario
+    "publish-drop": {"name": "publish_drop", "times": 1},
+}
+
+
+class LocalChaosHarness:
+    """In-process chaos against one live endpoint.
+
+    Everything is injectable (probe, bench, gate, clock, sleep) so the
+    full scenario loop runs in unit tests against the mock server —
+    the same design contract as ChaosHarness."""
+
+    def __init__(
+        self,
+        url: str,
+        bench_fn: Optional[Callable[[str], dict[str, Any]]] = None,
+        gate_fn: Optional[Callable[[dict[str, Any]], bool]] = None,
+        probe_fn: Optional[Callable[[], bool]] = None,
+        fault_hold_s: float = 1.0,
+        recovery_timeout_s: float = 30.0,
+        poll_interval_s: float = 0.2,
+        probe_timeout_s: float = 5.0,
+        sleep: Callable[[float], None] = time.sleep,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.url = url.rstrip("/")
+        self.bench_fn = bench_fn      # fault name -> results dict; None = skip
+        self.gate_fn = gate_fn        # results -> bool; None = no gate
+        self.probe_fn = probe_fn or self._default_probe
+        self.fault_hold_s = fault_hold_s
+        self.recovery_timeout_s = recovery_timeout_s
+        self.poll_interval_s = poll_interval_s
+        self.probe_timeout_s = probe_timeout_s
+        self.sleep = sleep
+        self.clock = clock
+
+    # -- endpoint helpers --------------------------------------------------
+
+    def _default_probe(self) -> bool:
+        """One tiny NON-streaming completion = 'healthy'. MTTR is the
+        time to the first of these succeeding after the fault clears."""
+        body = json.dumps({
+            "messages": [{"role": "user", "content": "ping"}],
+            "max_tokens": 2, "stream": False,
+        }).encode()
+        req = urllib.request.Request(
+            self.url + "/v1/chat/completions", data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.probe_timeout_s) as r:
+                return r.status == 200
+        except Exception:  # the probe's failure IS the signal
+            return False   # (recovery not reached yet)
+
+    def _faults_post(self, payload: dict[str, Any]) -> tuple[bool, str]:
+        req = urllib.request.Request(
+            self.url + "/faults", data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.probe_timeout_s) as r:
+                return r.status == 200, ""
+        except urllib.error.HTTPError as e:
+            detail = ""
+            try:
+                detail = e.read().decode()[:200]
+            except Exception:  # detail string is best-effort
+                pass
+            return False, f"HTTP {e.code}: {detail}"
+        except Exception as e:  # noqa: BLE001 — arm failure is a row
+            return False, f"{type(e).__name__}: {e}"
+
+    def _arm(self, fault: str) -> tuple[bool, str]:
+        params = dict(FAULT_ARMS[fault])
+        ok, detail = self._faults_post({"action": "arm", **params})
+        return ok, detail or f"armed {params['name']}"
+
+    def _clear(self, fault: str) -> None:
+        self._faults_post({"action": "clear",
+                           "name": FAULT_ARMS[fault]["name"]})
+
+    # -- scenario loop -----------------------------------------------------
+
+    def run_fault(self, fault: str) -> FaultResult:
+        if fault not in FAULT_ARMS:
+            raise ValueError(
+                f"unknown local fault {fault!r} (known: {LOCAL_FAULTS})"
+            )
+        if not self.probe_fn():
+            return FaultResult(fault, False, False,
+                               detail="endpoint not healthy before fault")
+        if fault == "publish-drop":
+            # the publish path only exists on a multihost primary; the
+            # single-host row stays honest rather than green
+            return FaultResult(
+                fault, False, False,
+                detail="publish_drop needs a multihost primary; covered "
+                       "by the unit-level decision-stream test",
+            )
+        injected, detail = self._arm(fault)
+        result = FaultResult(fault, injected, False, detail=detail)
+        if not injected:
+            return result  # gate_ok stays None: no fault, no verdict
+        try:
+            # bench DURING the fault: p95-under-fault + error/shed rates
+            self.sleep(self.fault_hold_s)
+            if self.bench_fn is not None:
+                try:
+                    bench = self.bench_fn(fault)
+                except Exception as e:  # noqa: BLE001 — a failed bench is
+                    # a data point, same contract as the cluster harness
+                    result.detail += f"; bench failed: {type(e).__name__}: {e}"
+                    result.gate_ok = False
+                    bench = None
+                if bench:
+                    result.p95_ms = bench.get("p95_ms")
+                    result.error_rate = bench.get("error_rate")
+                    result.shed_rate = bench.get("shed_rate")
+                    if self.gate_fn is not None:
+                        result.gate_ok = bool(self.gate_fn(bench))
+        finally:
+            self._clear(fault)
+        # MTTR: fault cleared -> first healthy completion
+        t0 = self.clock()
+        while self.clock() - t0 < self.recovery_timeout_s:
+            if self.probe_fn():
+                result.mttr_s = self.clock() - t0
+                result.recovered = True
+                return result
+            self.sleep(self.poll_interval_s)
+        result.detail += (
+            f"; no healthy completion {self.recovery_timeout_s:.0f}s "
+            "after fault clear"
+        )
+        return result
+
+    def run_all(self, faults: Optional[list[str]] = None) -> list[FaultResult]:
+        out = []
+        for fault in faults or LOCAL_FAULTS:
+            print(f"chaos[local]: injecting {fault}", file=sys.stderr)
+            res = self.run_fault(fault)
+            status = (
+                f"MTTR {res.mttr_s:.2f}s"
+                if res.recovered and res.mttr_s is not None
+                else "NOT RECOVERED" if res.injected else "SKIPPED"
+            )
+            print(f"chaos[local]: {fault}: {status} ({res.detail})",
+                  file=sys.stderr)
+            out.append(res)
+        return out

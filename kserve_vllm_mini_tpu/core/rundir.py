@@ -58,6 +58,12 @@ REQUEST_CSV_COLUMNS = [
     "truncated_tokens",  # how many prompt tokens the engine dropped (severity)
     "model",          # model/adapter the request was routed to (multi-LoRA
                       # runs rotate adapters; "" = the run's single model)
+    "retries",        # 429-shed resends this record absorbed (backoff +
+                      # Retry-After, docs/RESILIENCE.md) — honest retry
+                      # accounting, never fabricated as fresh requests
+    "shed",           # "1" when the server shed the request past the retry
+                      # budget: counted separately from errors by the
+                      # analyzer (an overloaded-by-design run is not broken)
 ]
 
 
@@ -85,11 +91,14 @@ class RequestRecord:
     truncated: bool = False
     truncated_tokens: int = 0
     model: str = ""
+    retries: int = 0
+    shed: bool = False
 
     def to_row(self) -> dict[str, Any]:
         d = dataclasses.asdict(self)
         d["ok"] = "1" if self.ok else "0"
         d["truncated"] = "1" if self.truncated else "0"
+        d["shed"] = "1" if self.shed else "0"
         return d
 
     @classmethod
@@ -129,6 +138,8 @@ class RequestRecord:
             truncated=row.get("truncated", "0") in ("1", "true", "True"),
             truncated_tokens=_i("truncated_tokens"),
             model=row.get("model", ""),
+            retries=_i("retries"),
+            shed=row.get("shed", "0") in ("1", "true", "True"),
         )
 
 

@@ -51,6 +51,13 @@ class Results:
     tokens_per_sec: Optional[float] = None
     tokens_per_sec_per_chip: Optional[float] = None
     error_rate: Optional[float] = None
+    # overload shedding (docs/RESILIENCE.md): requests 429-shed past the
+    # loadgen's retry budget, counted SEPARATELY from errors (error_rate
+    # excludes them — an overload run shedding by design is not broken),
+    # and the total 429 resends absorbed into surviving records
+    shed_requests: Optional[int] = None
+    shed_rate: Optional[float] = None
+    retries_total: Optional[int] = None
     truncated_requests: Optional[int] = None  # prompts cut to the prefill
                                               # budget (workload changed)
     truncated_prompt_tokens: Optional[int] = None  # total tokens dropped
@@ -158,6 +165,13 @@ class Results:
     # telemetry.py KV_METRIC_KEYS); shape gated by validate_kv_cache.
     # Absent for external engines.
     kv_cache: Optional[dict[str, Any]] = None
+    # resilience block (docs/RESILIENCE.md): the runtime's shed /
+    # watchdog / degrade counters — {requests_shed, watchdog_trips,
+    # engine_faults, degrade_level, faults_armed, source} — snapshotted
+    # directly in self-serve runs or scraped from /metrics (analysis/
+    # telemetry.py RESILIENCE_METRIC_KEYS); absent for external engines
+    # and for runs with zero resilience activity.
+    resilience: Optional[dict[str, Any]] = None
     # headroom-model validation (profiling/headroom.py): signed % error
     # of the analytic admission estimate vs the observed HBM peak —
     # negative = the model UNDERESTIMATES (the OOM direction). Present
@@ -601,6 +615,102 @@ def validate_monitor(doc: Any) -> list[str]:
         errs += _rate_map_errs(doc.get(key), key)
     if "aborted" in doc and not isinstance(doc["aborted"], str):
         errs.append("aborted is not a string")
+    return errs
+
+
+# -- resilience_table.json schema ---------------------------------------------
+#
+# The chaos harness's per-fault table (chaos/harness.py + chaos/local.py,
+# docs/RESILIENCE.md): one row per fault scenario with MTTR (time to first
+# healthy completion after the fault cleared), p95-under-fault, and shed/
+# error rates. Hand-rolled validator like the others — no jsonschema
+# dependency in the harness layers. `make chaos-smoke` gates on it.
+
+RESILIENCE_JSON_SCHEMA: dict[str, Any] = {
+    "$schema": "http://json-schema.org/draft-07/schema#",
+    "title": "kvmini-tpu resilience_table.json (chaos harness output)",
+    "type": "object",
+    "required": ["faults", "all_recovered"],
+    "properties": {
+        "service": {"type": "string"},
+        "namespace": {"type": "string"},
+        "target": {"enum": ["kserve", "local"]},
+        "all_recovered": {"type": "boolean"},
+        "worst_mttr_s": {"type": ["number", "null"], "minimum": 0},
+        "faults": {
+            "type": "array",
+            "items": {
+                "type": "object",
+                "required": ["fault", "injected", "recovered"],
+                "properties": {
+                    "fault": {"type": "string"},
+                    "injected": {"type": "boolean"},
+                    "recovered": {"type": "boolean"},
+                    "mttr_s": {"type": ["number", "null"], "minimum": 0},
+                    "p95_ms": {"type": ["number", "null"], "minimum": 0},
+                    "error_rate": {"type": ["number", "null"],
+                                   "minimum": 0, "maximum": 1},
+                    "shed_rate": {"type": ["number", "null"],
+                                  "minimum": 0, "maximum": 1},
+                    # None when injection failed or no gate was configured:
+                    # a broken injector must NEVER read as a green gate
+                    "gate_ok": {"type": ["boolean", "null"]},
+                    "detail": {"type": "string"},
+                },
+            },
+        },
+    },
+}
+
+
+def validate_resilience(doc: Any) -> list[str]:
+    """Validate a resilience_table.json document against
+    RESILIENCE_JSON_SCHEMA's contract. Returns violations; empty = valid.
+    The invariants downstream consumers rely on: per-fault rows typed,
+    rates inside [0, 1], MTTR non-negative, a recovered row carrying a
+    numeric MTTR, and gate_ok left null (never false-green) on rows whose
+    injection failed."""
+    errs: list[str] = []
+    if not isinstance(doc, dict):
+        return ["resilience table is not an object"]
+    faults = doc.get("faults")
+    if not isinstance(faults, list):
+        return ["faults missing or not an array"]
+    if not isinstance(doc.get("all_recovered"), bool):
+        errs.append("all_recovered missing or not a boolean")
+    worst = doc.get("worst_mttr_s")
+    if worst is not None and (not _num(worst) or worst < 0):
+        errs.append(f"worst_mttr_s not a non-negative number ({worst!r})")
+    if "target" in doc and doc["target"] not in ("kserve", "local"):
+        errs.append(f"target must be 'kserve'|'local' (got {doc['target']!r})")
+    for i, row in enumerate(faults):
+        where = f"faults[{i}]"
+        if not isinstance(row, dict):
+            errs.append(f"{where} is not an object")
+            continue
+        if not isinstance(row.get("fault"), str) or not row.get("fault"):
+            errs.append(f"{where}.fault missing or empty")
+        for key in ("injected", "recovered"):
+            if not isinstance(row.get(key), bool):
+                errs.append(f"{where}.{key} missing or not a boolean")
+        for key in ("mttr_s", "p95_ms"):
+            v = row.get(key)
+            if v is not None and (not _num(v) or v < 0):
+                errs.append(f"{where}.{key} not a non-negative number ({v!r})")
+        for key in ("error_rate", "shed_rate"):
+            v = row.get(key)
+            if v is not None and (not _num(v) or not 0 <= v <= 1):
+                errs.append(f"{where}.{key} outside [0, 1] ({v!r})")
+        if row.get("recovered") is True and not _num(row.get("mttr_s")):
+            errs.append(f"{where}: recovered row must carry a numeric mttr_s")
+        if row.get("injected") is False and row.get("gate_ok") is not None:
+            errs.append(
+                f"{where}: gate_ok must be null when injection failed "
+                "(a broken injector must not produce a gate verdict)"
+            )
+        g = row.get("gate_ok")
+        if g is not None and not isinstance(g, bool):
+            errs.append(f"{where}.gate_ok not a boolean/null ({g!r})")
     return errs
 
 

@@ -17,11 +17,19 @@ patterns are flagged when the enclosing function stamps no flag:
 - **unflagged truncation**: rebinding a prompt/token-ish value to a
   slice of itself (``toks = toks[:cap]``) with no truncation flag
   written anywhere in the function.
+- **swallowed shed/retry** (docs/RESILIENCE.md): a branch that handles
+  a 429/shed/retry condition (``status == 429``, a shed/retry-named
+  guard) by silently continuing/passing/returning a bare default, in a
+  function that stamps NO flag at all — shed and retried requests count
+  as surfaced only when the CSV/results carry them (``rec.retries``,
+  ``rec.shed``), never when the client quietly re-sends and the run
+  reports the resend as a fresh healthy request.
 
 "Surfacing" = assigning an attribute/key matching the flag vocabulary
-(truncated/dropped/fallback/error/skipped...), bumping a stats counter,
-or calling a record/mark/warn/fail-style function. A deliberate
-absorb (e.g. best-effort cache warmup) takes ``# kvmini: workload-ok``.
+(truncated/dropped/fallback/error/skipped/shed/retries...), bumping a
+stats counter, or calling a record/mark/warn/fail-style function. A
+deliberate absorb (e.g. best-effort cache warmup) takes
+``# kvmini: workload-ok``.
 """
 
 from __future__ import annotations
@@ -39,8 +47,11 @@ from kserve_vllm_mini_tpu.lint.facts import (
 
 SCOPE_PATH = re.compile(r"(^|/)(loadgen|runtime)/|(^|/)bench_pipeline\.py$")
 FLAG_NAME = re.compile(
-    r"truncat|dropp?ed|drop_|fallback|flag|error|fail|skip|ok\b|warn", re.I
+    r"truncat|dropp?ed|drop_|fallback|flag|error|fail|skip|ok\b|warn"
+    r"|shed|retri|retry|degrad", re.I
 )
+# shed/retry condition vocabulary for the swallowed-429 rule
+SHED_TEST = re.compile(r"shed|retry|retries|too_many|overload", re.I)
 SURFACING_CALL = re.compile(
     r"record|mark|stamp|flag|warn|fail|abort|print|log", re.I
 )
@@ -103,6 +114,29 @@ def _exc_type_names(handler: ast.ExceptHandler) -> list[str]:
     return out
 
 
+def _is_shed_test(test: ast.AST) -> bool:
+    """Does this branch condition look at a 429/shed/retry outcome?"""
+    for n in ast.walk(test):
+        if isinstance(n, ast.Constant) and n.value == 429:
+            return True
+        if isinstance(n, ast.Attribute) and SHED_TEST.search(n.attr):
+            return True
+        if isinstance(n, ast.Name) and SHED_TEST.search(n.id):
+            return True
+    return False
+
+
+def _branch_degrades(body: list) -> bool:
+    """Branch body that silently absorbs: pass/continue/break or a bare
+    default return."""
+    for stmt in body:
+        if isinstance(stmt, (ast.Pass, ast.Continue, ast.Break)):
+            return True
+        if isinstance(stmt, ast.Return) and _is_bare_default_return(stmt):
+            return True
+    return False
+
+
 def _handler_degrades(handler: ast.ExceptHandler) -> bool:
     """Swallows the exception AND changes what gets measured."""
     names = _exc_type_names(handler)
@@ -145,6 +179,15 @@ def _check_function(mod: ModuleFacts, fn: FunctionInfo,
                      "measured workload without stamping a flag the "
                      "analyzer reads — record it (rec.error / stats "
                      "counter / flag field) or mark `# kvmini: workload-ok`")
+        elif (isinstance(node, ast.If) and not fn_surfaces
+                and _is_shed_test(node.test)
+                and _branch_degrades(node.body)):
+            emit(node,
+                 f"`{fn.name}` handles a 429/shed/retry outcome by "
+                 "silently absorbing it — shed/retried requests count as "
+                 "surfaced only when the CSV/results carry them "
+                 "(rec.retries / rec.shed / a stats counter), or mark "
+                 "`# kvmini: workload-ok`")
         elif isinstance(node, ast.Assign) and not fn_surfaces:
             v = node.value
             if (isinstance(v, ast.Subscript) and isinstance(v.slice, ast.Slice)

@@ -51,6 +51,19 @@ class CallResult:
                                   # prefill budget (workload differs from sent)
     truncated_tokens: int = 0     # how many prompt tokens were dropped
     text: str = ""
+    # 429 shed responses (docs/RESILIENCE.md): the server's Retry-After
+    # hint in seconds (0 = none); the runner's backoff honors it
+    retry_after_s: float = 0.0
+
+
+def parse_retry_after(value: Optional[str]) -> float:
+    """Seconds from a Retry-After header (delta-seconds form only; the
+    HTTP-date form degrades to 0 and the caller's backoff applies)."""
+    try:
+        return max(float(value), 0.0) if value else 0.0
+    except (TypeError, ValueError):  # kvmini: workload-ok — an unparsable
+        return 0.0  # hint only loses the HINT; the caller's capped
+        #             backoff still runs and the retry is still counted
 
 
 class ProtocolAdapter(ABC):

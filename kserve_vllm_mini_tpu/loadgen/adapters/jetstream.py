@@ -71,6 +71,11 @@ class JetStreamAdapter(ProtocolAdapter):
             res.tokens_out = approx_token_count(res.text)
             res.ok = True
             return res
+        except httpx.TimeoutException:
+            # split connect/read timeouts (docs/RESILIENCE.md): a stalled
+            # stream fails fast as an honest `timeout` row
+            res.error = "timeout"
+            return res
         except Exception as e:  # record, never abort the whole run
             res.error = type(e).__name__
             return res

@@ -13,7 +13,12 @@ from typing import Any, Optional
 
 import httpx
 
-from kserve_vllm_mini_tpu.loadgen.adapters.base import CallResult, GenParams, ProtocolAdapter
+from kserve_vllm_mini_tpu.loadgen.adapters.base import (
+    CallResult,
+    GenParams,
+    ProtocolAdapter,
+    parse_retry_after,
+)
 from kserve_vllm_mini_tpu.loadgen.prompts import approx_token_count
 
 
@@ -69,6 +74,9 @@ class OpenAIChatAdapter(ProtocolAdapter):
                 res.status_code = resp.status_code
                 if resp.status_code != 200:
                     res.error = f"http-{resp.status_code}"
+                    res.retry_after_s = parse_retry_after(
+                        resp.headers.get("Retry-After")
+                    )
                     return res
                 data = resp.json()
                 choice = (data.get("choices") or [{}])[0]
@@ -112,12 +120,21 @@ class OpenAIChatAdapter(ProtocolAdapter):
                 res.status_code = resp.status_code
                 if resp.status_code != 200:
                     res.error = f"http-{resp.status_code}"
+                    res.retry_after_s = parse_retry_after(
+                        resp.headers.get("Retry-After")
+                    )
                     await resp.aread()
                     return res
                 await self._consume_sse(resp, res, parse_event)
             res.tokens_in = usage.get("prompt_tokens", res.tokens_in)
             res.tokens_out = usage.get("completion_tokens", approx_token_count(res.text))
             res.ok = True
+            return res
+        except httpx.TimeoutException:
+            # connect/read timeout (split timeouts, docs/RESILIENCE.md): a
+            # stalled SSE stream lands here fast as an honest `timeout`
+            # row instead of hanging the worker for the whole budget
+            res.error = "timeout"
             return res
         except Exception as e:  # record, never abort the whole run
             res.error = type(e).__name__

@@ -51,6 +51,9 @@ class LiveStats:
         self.errors = 0
         self.tokens_out = 0
         self.skipped = 0  # scheduled requests dropped by an early abort
+        self.shed = 0     # 429-shed past the retry budget (NOT errors:
+        #                   docs/RESILIENCE.md — sheds count separately)
+        self.retries = 0  # total 429 resends absorbed across requests
         # (end_ts, ok, latency_ms, ttft_ms, tokens_out) per completion
         self._events: deque[tuple[float, bool, float, float, int]] = deque(
             maxlen=max_events
@@ -65,8 +68,11 @@ class LiveStats:
         with self._lock:
             self.inflight -= 1
             self.completed += 1
-            if not rec.ok:
+            if rec.shed:
+                self.shed += 1
+            elif not rec.ok:
                 self.errors += 1
+            self.retries += rec.retries
             self.tokens_out += rec.tokens_out
             self._events.append(
                 (rec.end_ts, rec.ok, rec.latency_ms, rec.ttft_ms,
@@ -86,6 +92,8 @@ class LiveStats:
                 "errors": self.errors,
                 "tokens_out": self.tokens_out,
                 "skipped": self.skipped,
+                "shed": self.shed,
+                "retries": self.retries,
             }
 
     def completions(self) -> list[tuple[float, bool, float, float, int]]:
@@ -125,7 +133,25 @@ class LoadConfig:
     seed: int = 42                          # traffic seed: arrivals + prompts
     sampling_seed: Optional[int] = None     # server-side sampler seed (off by default)
     tenant: str = ""
+    # Split HTTP timeouts (docs/RESILIENCE.md): `timeout_s` bounds the
+    # write/pool phases (and is the legacy whole-request budget);
+    # `connect_timeout_s` bounds dialing and `read_timeout_s` bounds the
+    # gap BETWEEN stream chunks — a stalled SSE stream fails fast as a
+    # `timeout` row instead of hanging a worker for the full budget.
     timeout_s: float = 120.0
+    connect_timeout_s: float = 10.0
+    read_timeout_s: float = 30.0
+    # 429-shed retry policy (docs/RESILIENCE.md): capped exponential
+    # backoff with deterministic per-request jitter, honoring the
+    # server's Retry-After when it is larger. Every resend lands in the
+    # record's `retries` column; a request still shed past the budget
+    # lands as `shed` (separate from errors). 0 disables retries.
+    max_retries: int = 3
+    retry_backoff_s: float = 0.25
+    retry_backoff_max_s: float = 5.0
+    # per-request deadline forwarded as deadline_ms so the server's
+    # deadline-aware admission can shed at the door; None sends nothing
+    deadline_ms: Optional[float] = None
     headers: dict[str, str] = field(default_factory=dict)
     extra_body: dict[str, Any] = field(default_factory=dict)
 
@@ -140,6 +166,11 @@ class LoadConfig:
             stop = [str(s) for s in stop]
         else:
             stop = None
+        extra = dict(self.extra_body)
+        if self.deadline_ms is not None:
+            # rides the raw body so the server's deadline-aware admission
+            # (docs/RESILIENCE.md) sees it
+            extra.setdefault("deadline_ms", float(self.deadline_ms))
         return GenParams(
             max_tokens=self.max_tokens,
             temperature=self.temperature,
@@ -150,7 +181,7 @@ class LoadConfig:
             frequency_penalty=self.frequency_penalty,
             stop=stop,
             seed=self.sampling_seed,
-            extra=dict(self.extra_body),
+            extra=extra,
         )
 
 
@@ -219,23 +250,52 @@ async def _worker(
         if live is not None:
             live.record_start()
         rec.start_ts = time.time()
-        try:
-            result = await adapter.generate(
-                client, cfg.url, model, prompt, cfg.gen_params(), cfg.streaming, headers
-            )
-        except Exception as e:
-            # Adapters record their own errors; this guard ensures even an
-            # adapter bug costs one row, never the whole run's artifacts.
-            from kserve_vllm_mini_tpu.loadgen.adapters.base import CallResult
+        # 429-shed retry loop (docs/RESILIENCE.md): capped exponential
+        # backoff with DETERMINISTIC per-request jitter (seeded from the
+        # traffic seed + index, so two runs of the same scenario resend
+        # at the same offsets), honoring the server's Retry-After when
+        # larger. All resends stay inside this ONE record — retries are
+        # never fabricated as fresh requests (KVM041 contract).
+        import random as _random
 
-            result = CallResult(error=f"adapter-{type(e).__name__}")
+        backoff_rng = _random.Random((cfg.seed << 20) ^ idx)
+        attempt = 0
+        while True:
+            try:
+                result = await adapter.generate(
+                    client, cfg.url, model, prompt, cfg.gen_params(),
+                    cfg.streaming, headers,
+                )
+            except Exception as e:
+                # Adapters record their own errors; this guard ensures even an
+                # adapter bug costs one row, never the whole run's artifacts.
+                from kserve_vllm_mini_tpu.loadgen.adapters.base import CallResult
+
+                result = CallResult(error=f"adapter-{type(e).__name__}")
+            if result.status_code != 429 or attempt >= cfg.max_retries:
+                break
+            if abort_evt is not None and abort_evt.is_set():
+                break  # aborted mid-backoff: the shed row stands as-is
+            rec.retries += 1
+            backoff = min(
+                cfg.retry_backoff_s * (2 ** attempt), cfg.retry_backoff_max_s
+            ) * (0.5 + backoff_rng.random())
+            await asyncio.sleep(max(result.retry_after_s, backoff))
+            attempt += 1
         rec.end_ts = time.time()
         http_span.set("http.status_code", result.status_code)
+        http_span.set("retries", rec.retries)
         http_span.end(ok=result.ok)
 
     rec.status_code = result.status_code
     rec.ok = result.ok
     rec.error = result.error
+    if result.status_code == 429:
+        # shed past the retry budget: its own outcome class — the
+        # analyzer counts sheds separately from errors (an overload run
+        # shedding by design is not a broken run)
+        rec.shed = True
+        rec.error = "shed"
     rec.tokens_in = result.tokens_in
     rec.tokens_out = result.tokens_out
     rec.first_token_ts = result.first_token_ts
@@ -323,7 +383,13 @@ async def run_load_async(
     limits = httpx.Limits(
         max_connections=cfg.concurrency + 4, max_keepalive_connections=cfg.concurrency
     )
-    async with httpx.AsyncClient(timeout=cfg.timeout_s, limits=limits) as client:
+    # split timeouts (docs/RESILIENCE.md): read bounds the gap BETWEEN
+    # stream chunks, so a stalled SSE stream fails fast as a `timeout`
+    # row; the legacy whole-budget value keeps bounding write/pool
+    timeout = httpx.Timeout(
+        cfg.timeout_s, connect=cfg.connect_timeout_s, read=cfg.read_timeout_s
+    )
+    async with httpx.AsyncClient(timeout=timeout, limits=limits) as client:
         records = await asyncio.gather(
             *(
                 _worker(i, off, t_start, cfg, adapter, client, sem, prompt_fn,
@@ -411,6 +477,19 @@ def register(parser: argparse.ArgumentParser) -> None:
                         help="Server-side sampler seed (omitted from requests by default)")
     parser.add_argument("--run-dir", default=None, help="Existing run dir (default: new under runs/)")
     parser.add_argument("--tenant", default="")
+    parser.add_argument("--connect-timeout", type=float, default=10.0,
+                        help="HTTP connect timeout (s)")
+    parser.add_argument("--read-timeout", type=float, default=30.0,
+                        help="Max gap between stream chunks (s) — a stalled "
+                             "SSE stream fails fast as a `timeout` row")
+    parser.add_argument("--max-retries", type=int, default=3,
+                        help="Resends per request on a 429 shed (capped "
+                             "exponential backoff honoring Retry-After; "
+                             "0 disables)")
+    parser.add_argument("--deadline-ms", type=float, default=None,
+                        help="Per-request deadline forwarded as deadline_ms "
+                             "for the server's deadline-aware admission "
+                             "(docs/RESILIENCE.md)")
 
 
 def run(args: argparse.Namespace) -> int:
@@ -439,6 +518,10 @@ def run(args: argparse.Namespace) -> int:
         seed=args.seed,
         sampling_seed=args.sampling_seed,
         tenant=args.tenant,
+        connect_timeout_s=args.connect_timeout,
+        read_timeout_s=args.read_timeout,
+        max_retries=args.max_retries,
+        deadline_ms=args.deadline_ms,
     )
     run_dir = RunDir(args.run_dir) if args.run_dir else RunDir.create()
     run_dir.path.mkdir(parents=True, exist_ok=True)

@@ -23,6 +23,8 @@ EVENT_TYPES = (
     "burn_rate_exceeded",
     "kv_thrash",
     "hbm_watermark_high",
+    "overload_shedding",
+    "engine_fault",
 )
 
 
@@ -321,6 +323,52 @@ class EventDetector:
             )
         return None
 
+    def _check_overload_shedding(self, sample: dict[str, Any]) -> Optional[Event]:
+        """Live shedding observed (docs/RESILIENCE.md): the shed counter
+        — the loadgen's (429s past the retry budget) or the runtime's
+        (admission sheds) — INCREASED across a sample. Delta-based, not
+        level-based: a historical total from an earlier burst is not
+        live shedding. One-shot like every rule; the per-sample shed
+        numbers stay on the timeline for the report."""
+        prev = self._prev
+        if prev is None:
+            return None
+        for src, key in (
+            (_loadgen, "shed"),
+            (_runtime, "requests_shed_total"),
+        ):
+            cur, old = src(sample, key), src(prev, key)
+            if cur is not None and old is not None and cur > old:
+                return Event(
+                    sample["t"], "overload_shedding",
+                    f"{cur - old:g} request(s) shed in the last sample "
+                    f"window ({cur:g} total)",
+                    {"shed_total": cur, "shed_delta": cur - old},
+                )
+        return None
+
+    def _check_engine_fault(self, sample: dict[str, Any]) -> Optional[Event]:
+        """The runtime recovered from an engine fault (watchdog trip or
+        injected/classified device error, docs/RESILIENCE.md): the
+        engine_faults counter moved. Immediate and delta-based — one
+        fault is one event, there is no 'noise floor' for a failed
+        batch."""
+        prev = self._prev
+        faults = _runtime(sample, "engine_faults_total")
+        if prev is None or faults is None:
+            return None
+        prev_faults = _runtime(prev, "engine_faults_total")
+        if prev_faults is None or faults <= prev_faults:
+            return None
+        level = _runtime(sample, "degrade_level")
+        return Event(
+            sample["t"], "engine_fault",
+            f"engine fault recovered ({faults:g} total"
+            + (f", degrade level {level:g})" if level is not None else ")"),
+            {"engine_faults_total": faults,
+             **({"degrade_level": level} if level is not None else {})},
+        )
+
     def _check_burn_rate(
         self, sample: dict[str, Any], burn: dict[str, float]
     ) -> Optional[Event]:
@@ -363,6 +411,8 @@ class EventDetector:
             ("burn_rate_exceeded", self._check_burn_rate(sample, burn or {})),
             ("kv_thrash", self._check_kv_thrash(sample)),
             ("hbm_watermark_high", self._check_hbm_watermark(sample)),
+            ("overload_shedding", self._check_overload_shedding(sample)),
+            ("engine_fault", self._check_engine_fault(sample)),
         ]
         self._prev = sample
         fired: list[Event] = []

@@ -52,6 +52,12 @@ TIMELINE_RUNTIME_METRICS = (
     "kvmini_tpu_kv_retained_evictions_total",
     "kvmini_tpu_hbm_bytes_in_use",
     "kvmini_tpu_hbm_bytes_limit",
+    # resilience rail (docs/RESILIENCE.md): admission sheds feed the
+    # overload_shedding rule, recovered faults feed engine_fault, and
+    # the degrade-ladder position rides into the event detail/report
+    "kvmini_tpu_requests_shed_total",
+    "kvmini_tpu_engine_faults_total",
+    "kvmini_tpu_degrade_level",
 )
 
 _PREFIX = "kvmini_tpu_"

@@ -365,6 +365,65 @@ def _kv_cache_section(
     return "".join(parts)
 
 
+def _resilience_section(results: dict[str, Any]) -> str:
+    """The "Resilience" section (docs/RESILIENCE.md): shed/retry
+    accounting from the per-request CSV, the runtime's watchdog/degrade
+    rail, and the overload_shedding / engine_fault monitor events.
+    Rendered only when the run saw resilience activity — a clean run's
+    report simply has no section."""
+    res = results.get("resilience")
+    res = res if isinstance(res, dict) else {}
+    shed = results.get("shed_requests") or 0
+    retries = results.get("retries_total") or 0
+    events = [
+        e for e in ((results.get("monitor") or {}).get("events") or [])
+        if isinstance(e, dict)
+        and e.get("type") in ("overload_shedding", "engine_fault")
+    ]
+    if not res and not shed and not retries and not events:
+        return ""
+    parts = ["<section><h2>Resilience</h2>"]
+    facts = []
+    if shed:
+        rate = results.get("shed_rate")
+        facts.append(
+            f"{shed} request(s) shed"
+            + (f" ({rate:.1%} of the run)" if rate is not None else "")
+            + " — counted separately from errors"
+        )
+    if retries:
+        facts.append(f"{retries} 429 resend(s) absorbed by client backoff")
+    if res.get("requests_shed"):
+        facts.append(f"server shed {res['requests_shed']:.0f} at admission")
+    if res.get("watchdog_trips"):
+        facts.append(f"{res['watchdog_trips']:.0f} watchdog trip(s)")
+    if res.get("engine_faults"):
+        facts.append(f"{res['engine_faults']:.0f} engine fault(s) recovered")
+    if res.get("faults_armed"):
+        facts.append(
+            f"{res['faults_armed']:.0f} injection point(s) armed (chaos run)"
+        )
+    if facts:
+        parts.append(f"<p>{html_mod.escape(' · '.join(facts))}</p>")
+    level = res.get("degrade_level")
+    if level:
+        ladder = {1: "sync pipeline", 2: "decode chunk 1", 3: "spec off",
+                  4: "gave up"}
+        parts.append(
+            f"<p class='warn'>engine finished DEGRADED at level "
+            f"{level:.0f} ({ladder.get(int(level), '?')}) — each watchdog "
+            "trip/device fault gives up one optimization</p>"
+        )
+    for e in events:
+        parts.append(
+            f"<p>event @{e.get('t', 0):.0f}: "
+            f"<b>{html_mod.escape(str(e.get('type')))}</b> — "
+            f"{html_mod.escape(str(e.get('detail', '')))}</p>"
+        )
+    parts.append("</section>")
+    return "".join(parts)
+
+
 def generate_single_run_html(
     results: dict[str, Any], run_dir: Optional[Path] = None
 ) -> str:
@@ -492,6 +551,7 @@ def generate_single_run_html(
 
         timeline_samples = RunDir(run_dir).read_timeline()
     sections.append(_kv_cache_section(results, run_dir, timeline_samples))
+    sections.append(_resilience_section(results))
     sections.append(_timeline_section(run_dir, results, timeline_samples))
     sections.append(_trace_viewer(run_dir, results))
     sections.append(

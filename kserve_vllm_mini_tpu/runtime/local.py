@@ -84,9 +84,24 @@ def start_local_server(
         lora_demo=int(profile.get("lora_demo", 0)),
         lora_rank=int(profile.get("lora_rank", 8)),
         lora_slots=int(profile.get("lora_slots", 4)),
+        # resilience knobs (docs/RESILIENCE.md): fault injection config,
+        # the wedged-sweep watchdog, and deadline-aware shedding
+        faults=profile.get("faults"),
+        fault_seed=int(profile.get("fault_seed", 0)),
+        watchdog=bool(profile.get("watchdog", False)),
+        default_deadline_s=(
+            float(profile["default_deadline_s"])
+            if profile.get("default_deadline_s") is not None
+            else None
+        ),
     )
+    if profile.get("watchdog_min_s") is not None:
+        engine.ecfg.watchdog_min_s = float(profile["watchdog_min_s"])
     engine.start()
-    app = make_app(engine, tok, name)
+    app = make_app(
+        engine, tok, name,
+        allow_fault_injection=bool(profile.get("allow_fault_injection", False)),
+    )
     runner = web.AppRunner(app)
     loop = asyncio.new_event_loop()
 

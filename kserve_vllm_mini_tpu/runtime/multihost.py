@@ -275,7 +275,11 @@ _REQ_FIELDS = (
 # lockstep decode diverges (each process builds its own sampling arrays) —
 # this guard turns "someone added a field" into a loud test failure instead
 # of silent divergence
-_HOST_ONLY_FIELDS = {"constraint", "adapter", "trace_id", "parent_span_id"}
+_HOST_ONLY_FIELDS = {"constraint", "adapter", "trace_id", "parent_span_id",
+                     # deadline shedding is lockstep-DISABLED (engine
+                     # _admit_one): the wall-clock shed decision is
+                     # host-local, so the field never crosses
+                     "deadline_s"}
 assert set(_REQ_FIELDS) | _HOST_ONLY_FIELDS == {
     f.name for f in __import__("dataclasses").fields(GenRequest)
 }, "GenRequest fields changed: update _REQ_FIELDS (or _HOST_ONLY_FIELDS)"
@@ -324,6 +328,13 @@ def run_primary(engine: Engine, publisher: CommandPublisher,
     engine._lockstep = True  # host-local-race shortcuts off (see engine)
 
     def publish(decision: tuple) -> None:
+        # publish_drop injection point (docs/RESILIENCE.md): an armed
+        # fault silently loses this decision on the wire — the follower
+        # replay diverges exactly the way a dropped packet would make
+        # it, which is what the chaos scenario measures. The registry
+        # is internally locked; un-armed it costs one dict miss.
+        if engine._faults.check("publish_drop"):
+            return
         if decision[0] == "admit":
             publisher.publish(("admit", req_payload(decision[1])))
         else:

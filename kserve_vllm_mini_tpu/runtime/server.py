@@ -63,6 +63,10 @@ def build_engine(
     lora_slots: int = 4,      # runtime-load bank capacity (load_adapter)
     request_tracing: bool = True,  # phase-span recorder (docs/TRACING.md)
     trace_buffer: int = 4096,      # span ring-buffer capacity
+    faults: Optional[str] = None,  # KVMINI_FAULTS-syntax injection config
+    fault_seed: int = 0,           # deterministic fault triggers
+    watchdog: bool = False,        # wedged-sweep watchdog (docs/RESILIENCE.md)
+    default_deadline_s: Optional[float] = None,  # deadline-aware shedding
 ) -> tuple[Engine, Tokenizer, str]:
     """Construct (engine, tokenizer, model_name) from a preset or checkpoint.
 
@@ -273,6 +277,10 @@ def build_engine(
         lora_slots=lora_slots,
         request_tracing=request_tracing,
         trace_buffer=trace_buffer,
+        faults=faults,
+        fault_seed=fault_seed,
+        watchdog=watchdog,
+        default_deadline_s=default_deadline_s,
     )
     engine = Engine(
         params, cfg, ecfg, mesh=mesh, pad_id=tok.pad_id, drafter=drafter_pair,
@@ -282,7 +290,8 @@ def build_engine(
 
 
 def make_app(engine: Engine, tok: Tokenizer, model_name: str,
-             multihost: bool = False, alive_check=None):
+             multihost: bool = False, alive_check=None,
+             allow_fault_injection: bool = False):
     # default health gate: the engine's own scheduler liveness — a crashed
     # _loop drops _running and the frontend must refuse, not enqueue
     # forever. The multihost primary overrides with its driver thread's
@@ -292,6 +301,23 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
     from aiohttp import web
 
     started = time.time()
+
+    def _shed_response(message: str) -> "web.Response":
+        """ONE wire shape for every shed site (docs/RESILIENCE.md): the
+        at-the-door 429, the non-streaming queue-expiry conversion, and
+        the streaming first-event peek all speak this, so the loadgen's
+        retry contract can never fork between them."""
+        return web.json_response(
+            {"error": {
+                "message": message,
+                "type": "overloaded_error",
+                "code": "request_shed",
+            }},
+            status=429,
+            headers={"Retry-After": str(max(
+                1, int(engine.estimate_wait_s() + 0.999)
+            ))},
+        )
 
     def _messages_to_prompt(messages: list[dict[str, Any]]) -> str:
         parts = []
@@ -523,6 +549,39 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
             return web.json_response(
                 {"error": {"message": "scheduler is not running"}}, status=503
             )
+        # Deadline-aware admission (docs/RESILIENCE.md): the client's
+        # deadline_ms (body field or x-request-deadline-ms header) or the
+        # server default. A request whose estimated COMPLETION time —
+        # queue depth x rolling service time — already exceeds its
+        # deadline is shed HERE with 429 + Retry-After instead of timing
+        # out after burning decode steps on work nobody can use.
+        raw_deadline = body.get("deadline_ms")
+        if raw_deadline is None:
+            raw_deadline = request.headers.get("x-request-deadline-ms")
+        deadline_s: Optional[float] = None
+        if raw_deadline is not None:
+            try:
+                deadline_s = float(raw_deadline) / 1000.0
+            except (TypeError, ValueError):
+                return web.json_response(
+                    {"error": {"message": "deadline_ms must be a number"}},
+                    status=400,
+                )
+            if deadline_s <= 0:
+                return web.json_response(
+                    {"error": {"message": "deadline_ms must be > 0"}},
+                    status=400,
+                )
+        if deadline_s is None:
+            deadline_s = engine.ecfg.default_deadline_s
+        if deadline_s is not None:
+            est = engine.estimate_wait_s()
+            if est > deadline_s:
+                engine.count_shed()
+                return _shed_response(
+                    f"shed: estimated completion {est:.2f}s exceeds "
+                    f"the {deadline_s:.2f}s deadline at current load"
+                )
         max_tokens = int(body.get("max_tokens", 64))
         machine, wants_tools, err = _build_constraint(body, max_tokens)
         if err:
@@ -661,6 +720,7 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
             adapter=adapter,
             trace_id=trace_ctx[0] if trace_ctx else None,
             parent_span_id=trace_ctx[1] if trace_ctx else None,
+            deadline_s=deadline_s,
         )
         all_reqs = [req]
         for _ in range(fanout - 1):
@@ -757,6 +817,11 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
                         {"error": {"message": info.get("error", "engine error")}},
                         status=400,
                     )
+                if info.get("finish_reason") == "shed":
+                    # deadline expired while queued (docs/RESILIENCE.md):
+                    # same wire contract as the at-the-door shed — a 200
+                    # with zero tokens would count as a healthy request
+                    return _shed_response(info.get("error", "request shed"))
             # usage counts EVERY candidate actually generated (OpenAI/vLLM
             # accounting): best_of work that ranking discards was still
             # decoded, and a benchmark computing tokens/sec from usage must
@@ -783,7 +848,7 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
                     # OpenAI semantics: output ends BEFORE the matched stop
                     # sequence (the match itself is not returned); surfaced
                     # to the client via finish_reason
-                    text = text[:stop_cut]  # kvmini: workload-ok
+                    text = text[:stop_cut]
                     finish = "stop"
                 message: dict[str, Any] = {"role": "assistant", "content": text}
                 if wants_tools:
@@ -839,6 +904,14 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
                            first_event[1].get("error", "engine error")}},
                 status=400,
             )
+        if (
+            first_event[0] == "done"
+            and first_event[1].get("finish_reason") == "shed"
+        ):
+            # engine-side deadline shed lands BEFORE any token, so the
+            # peek catches it while a 429 can still go out (same
+            # contract as the non-streaming path)
+            return _shed_response(first_event[1].get("error", "request shed"))
         merged: asyncio.Queue = asyncio.Queue()
 
         # DEDICATED daemon threads, not the shared default executor: a
@@ -871,6 +944,15 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
                      "Cache-Control": "no-cache"},
         )
         await resp.prepare(request)
+        # sse_disconnect injection (docs/RESILIENCE.md): when the point
+        # fires for this stream, drop the transport after after_tokens
+        # streamed chunks — a mid-stream network fault, exercised by the
+        # local chaos harness. None on every un-armed server.
+        sse_cut: Optional[int] = None
+        cut_spec = engine.check_fault("sse_disconnect")
+        if cut_spec is not None:
+            sse_cut = max(int(cut_spec.after_tokens), 1)
+        sse_streamed = 0
         per_out = [0] * len(handles)
         per_first = [False] * len(handles)
         per_tools: list[list[int]] = [[] for _ in handles]
@@ -976,6 +1058,14 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
                         }
                         per_first[idx] = True
                     await resp.write(f"data: {json.dumps(evt)}\n\n".encode())
+                    sse_streamed += 1
+                    if sse_cut is not None and sse_streamed >= sse_cut:
+                        # injected mid-stream disconnect: drop the
+                        # transport the way a network fault would, then
+                        # run the normal client-gone cleanup below
+                        if request.transport is not None:
+                            request.transport.close()
+                        raise ConnectionResetError("injected sse_disconnect")
                 else:
                     done_count += 1
                     info = rest[0]
@@ -1223,6 +1313,20 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
             f"kvmini_tpu_compiled_bytes_total {s['compiled_bytes']:.6g}",
             "# TYPE kvmini_tpu_compile_peak_bytes gauge",
             f"kvmini_tpu_compile_peak_bytes {s['compile_peak_bytes']}",
+            # resilience rail (docs/RESILIENCE.md): admission sheds,
+            # watchdog trips, recovered engine faults, the degrade-ladder
+            # position, and the armed-injection-point gauge — the monitor
+            # timeline's overload_shedding / engine_fault event inputs
+            "# TYPE kvmini_tpu_requests_shed_total counter",
+            f"kvmini_tpu_requests_shed_total {s['requests_shed']}",
+            "# TYPE kvmini_tpu_watchdog_trips_total counter",
+            f"kvmini_tpu_watchdog_trips_total {s['watchdog_trips']}",
+            "# TYPE kvmini_tpu_engine_faults_total counter",
+            f"kvmini_tpu_engine_faults_total {s['engine_faults']}",
+            "# TYPE kvmini_tpu_degrade_level gauge",
+            f"kvmini_tpu_degrade_level {s['degrade_level']}",
+            "# TYPE kvmini_tpu_faults_armed gauge",
+            f"kvmini_tpu_faults_armed {s['faults_armed']}",
             # KV-cache lifecycle + prefix-cache attribution (docs/
             # TROUBLESHOOTING.md "HBM pressure & KV thrash"): allocator
             # churn counters the point-in-time pool gauges cannot show,
@@ -1380,6 +1484,52 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
             return web.json_response({"error": {"message": err}}, status=status)
         return web.json_response({"status": "ok", "unloaded": name})
 
+    async def faults_get(_request: "web.Request"):
+        """Armed injection points (docs/RESILIENCE.md). Always readable —
+        an operator must be able to SEE armed faults even on a server
+        that refuses to arm new ones."""
+        return web.json_response({
+            "enabled": allow_fault_injection,
+            "active": engine.active_faults(),
+        })
+
+    async def faults_post(request: "web.Request"):
+        """Arm/clear a named injection point: {"name": ..., "action":
+        "arm"|"clear", <params>}. Gated behind --allow-fault-injection —
+        a production server must not expose a kill switch."""
+        if not allow_fault_injection:
+            return web.json_response(
+                {"error": {"message":
+                           "fault injection is disabled; start the server "
+                           "with --allow-fault-injection"}}, status=403,
+            )
+        try:
+            body = await request.json()
+        except Exception:
+            return web.json_response({"error": {"message": "invalid JSON"}},
+                                     status=400)
+        if not isinstance(body, dict):
+            return web.json_response({"error": {"message": "body must be an "
+                                                "object"}}, status=400)
+        action = body.get("action", "arm")
+        name = body.get("name")
+        if action == "clear":
+            engine.clear_fault(name)
+            return web.json_response({"status": "ok",
+                                      "cleared": name or "all"})
+        if action != "arm" or not name:
+            return web.json_response(
+                {"error": {"message": "need action 'arm'|'clear' and, for "
+                           "arm, a fault 'name'"}}, status=400,
+            )
+        params = {k: v for k, v in body.items() if k not in ("action", "name")}
+        try:
+            spec = engine.arm_fault(name, **params)
+        except (ValueError, TypeError) as e:
+            return web.json_response({"error": {"message": str(e)}},
+                                     status=400)
+        return web.json_response({"status": "ok", "armed": spec})
+
     app = web.Application()
     app.router.add_post("/v1/chat/completions", chat)
     app.router.add_get("/v1/models", models)
@@ -1389,6 +1539,8 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/traces", traces)
     app.router.add_post("/profile", profile)
+    app.router.add_get("/faults", faults_get)
+    app.router.add_post("/faults", faults_post)
     return app
 
 
@@ -1500,6 +1652,44 @@ def register(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--command-port", type=int, default=None,
                         help="Multi-host scheduler-command channel port "
                              "(default: $KVMINI_COMMAND_PORT or 8470)")
+    parser.add_argument("--faults", default=None,
+                        help="Arm in-process fault injection points at "
+                             "startup (docs/RESILIENCE.md), e.g. "
+                             "'sweep_stall:after=50,duration=3;"
+                             "device_error:after=200'. Also $KVMINI_FAULTS. "
+                             "Default: none (zero overhead)")
+    parser.add_argument("--fault-seed", type=int, default=None,
+                        help="Seed for probabilistic fault triggers — a "
+                             "fixed seed makes a scripted chaos scenario "
+                             "deterministic (default: $KVMINI_FAULT_SEED "
+                             "or 0)")
+    parser.add_argument("--watchdog", action="store_true",
+                        help="Arm the wedged-sweep watchdog: no retire "
+                             "within watchdog-factor x the rolling sweep "
+                             "time fails the in-flight batch with "
+                             "finish_reason=engine_fault and degrades "
+                             "(sync pipeline -> chunk 1 -> no spec) "
+                             "instead of hanging clients. Also "
+                             "$KVMINI_WATCHDOG=1 (docs/RESILIENCE.md)")
+    parser.add_argument("--watchdog-min-s", type=float, default=2.0,
+                        help="Watchdog floor: a wedge shorter than this "
+                             "never trips (first compiles excepted — arm "
+                             "the watchdog on warmed servers)")
+    parser.add_argument("--default-deadline-ms", type=float, default=None,
+                        help="Server default per-request deadline for "
+                             "deadline-aware admission: requests that "
+                             "cannot meet it at current load are shed "
+                             "with 429 + Retry-After. Clients override "
+                             "per request via deadline_ms / the "
+                             "x-request-deadline-ms header. Also "
+                             "$KVMINI_DEFAULT_DEADLINE_MS. Default: no "
+                             "shedding")
+    parser.add_argument("--allow-fault-injection", action="store_true",
+                        help="Enable POST /faults (arm/clear injection "
+                             "points at runtime — what `kvmini-tpu chaos "
+                             "--target local` drives). Also "
+                             "$KVMINI_ALLOW_FAULT_INJECTION=1. Never "
+                             "enable on a production server")
 
 
 def _parse_lora_args(items: Optional[list]) -> Optional[dict[str, str]]:
@@ -1546,6 +1736,24 @@ def run(args: argparse.Namespace) -> int:
     spec_tokens = args.spec_tokens
     if spec_tokens is None:
         spec_tokens = int(os.environ.get("KVMINI_SPEC_TOKENS", "4" if drafter else "0"))
+    faults = args.faults or os.environ.get("KVMINI_FAULTS") or None
+    fault_seed = (
+        args.fault_seed
+        if args.fault_seed is not None
+        else int(os.environ.get("KVMINI_FAULT_SEED", "0") or 0)
+    )
+    watchdog = bool(
+        args.watchdog
+        or os.environ.get("KVMINI_WATCHDOG", "") in ("1", "true")
+    )
+    default_deadline_ms = args.default_deadline_ms
+    if default_deadline_ms is None:
+        env_dl = os.environ.get("KVMINI_DEFAULT_DEADLINE_MS")
+        default_deadline_ms = float(env_dl) if env_dl else None
+    allow_faults = bool(
+        args.allow_fault_injection
+        or os.environ.get("KVMINI_ALLOW_FAULT_INJECTION", "") in ("1", "true")
+    )
 
     # multi-host: join the process group BEFORE any device is touched, then
     # shard the engine over the global mesh (runtime/multihost.py lockstep)
@@ -1633,7 +1841,15 @@ def run(args: argparse.Namespace) -> int:
             in ("0", "false", "off")
         ),
         trace_buffer=args.trace_buffer,
+        faults=faults,
+        fault_seed=fault_seed,
+        watchdog=watchdog,
+        default_deadline_s=(
+            default_deadline_ms / 1000.0 if default_deadline_ms else None
+        ),
     )
+    if watchdog and args.watchdog_min_s is not None:
+        engine.ecfg.watchdog_min_s = float(args.watchdog_min_s)
 
     if multihost:
         from kserve_vllm_mini_tpu.parallel import distributed as dist
@@ -1652,7 +1868,8 @@ def run(args: argparse.Namespace) -> int:
                 command_port=cmd_port, n_followers=dist.process_count() - 1,
             )
             app = make_app(engine, tok, name, multihost=True,
-                           alive_check=handle.is_alive)
+                           alive_check=handle.is_alive,
+                           allow_fault_injection=allow_faults)
             print(f"kvmini-tpu serve: {name} on http://{args.host}:{args.port} "
                   f"(slots={max_slots}, max_seq={max_seq}, "
                   f"multihost primary, {dist.process_count()} processes, "
@@ -1674,7 +1891,7 @@ def run(args: argparse.Namespace) -> int:
         return 0
 
     engine.start()
-    app = make_app(engine, tok, name)
+    app = make_app(engine, tok, name, allow_fault_injection=allow_faults)
     print(f"kvmini-tpu serve: {name} on http://{args.host}:{args.port} "
           f"(slots={max_slots}, max_seq={max_seq})")
     try:

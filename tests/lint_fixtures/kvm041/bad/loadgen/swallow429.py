@@ -1,0 +1,10 @@
+async def send_with_silent_retry(client, url, body, rec):
+    """ISSUE 10 seeded bug: a 429 shed is swallowed by re-sending the
+    request with NOTHING stamped on the record — the run reports the
+    resend as a fresh healthy request and the overload never reaches
+    the analyzer."""
+    while True:
+        resp = await client.post(url, json=body)
+        if resp.status_code == 429:
+            continue  # silently re-send; rec.retries/rec.shed never move
+        return resp

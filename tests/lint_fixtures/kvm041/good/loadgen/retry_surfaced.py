@@ -1,0 +1,15 @@
+async def send_with_honest_retry(client, url, body, rec, max_retries=3):
+    """The surfaced twin: every resend lands in the record's retries
+    column and a request shed past the budget is stamped shed — the
+    CSV/results carry the overload (docs/RESILIENCE.md)."""
+    attempt = 0
+    while True:
+        resp = await client.post(url, json=body)
+        if resp.status_code != 429 or attempt >= max_retries:
+            break
+        rec.retries += 1
+        attempt += 1
+    if resp.status_code == 429:
+        rec.shed = True
+        rec.error = "shed"
+    return resp

@@ -94,6 +94,26 @@ def make_app(
     tracer = SpanRecorder(capacity=1024)
     phase_hist = {p: PhaseHistogram() for p in PHASES}
 
+    # In-process fault injection, same wire shape as the real runtime's
+    # POST /faults (docs/RESILIENCE.md) so the local chaos harness and
+    # the loadgen's retry/timeout paths are testable with no JAX engine.
+    # Armed points: sweep_stall (responses HOLD until cleared),
+    # device_error (500), kv_alloc_fail (503), shed (429 + Retry-After),
+    # sse_disconnect (stream transport drops after after_tokens chunks),
+    # sse_stall (stream stops producing chunks without closing — the
+    # read-timeout satellite's prey).
+    faults: dict[str, dict] = {}
+
+    def _fault(name: str) -> dict | None:
+        spec = faults.get(name)
+        if spec is None:
+            return None
+        times = int(spec.get("times", 0) or 0)
+        if times > 0 and spec.get("_fired", 0) >= times:
+            return None
+        spec["_fired"] = spec.get("_fired", 0) + 1
+        return spec
+
     def _record_trace(trace_ctx, header, t_arrive_ns, t_first_ns, t_done_ns):
         """Echo the received traceparent as server phase spans: queue /
         prefill / decode parented under the client's http.request span —
@@ -115,6 +135,34 @@ def make_app(
 
     async def chat(request: web.Request) -> web.StreamResponse:
         stats.requests += 1
+        if "sweep_stall" in faults:
+            # wedged backend: hold every response until the fault clears
+            # (the local chaos harness measures MTTR from the clear to
+            # the first completion that escapes this loop); a client
+            # that gave up releases its handler immediately
+            t_hold = time.time()
+            while "sweep_stall" in faults and time.time() - t_hold < 60.0:
+                if request.transport is None or request.transport.is_closing():
+                    raise ConnectionResetError("client gone during wedge")
+                await asyncio.sleep(0.05)
+        if _fault("device_error") is not None:
+            return web.json_response(
+                {"error": {"message": "injected device error"}}, status=500
+            )
+        if _fault("kv_alloc_fail") is not None:
+            return web.json_response(
+                {"error": {"message": "kv pool exhausted (injected)"}},
+                status=503,
+            )
+        shed_spec = _fault("shed")
+        if shed_spec is not None:
+            return web.json_response(
+                {"error": {"message": "shed (injected)",
+                           "code": "request_shed"}},
+                status=429,
+                headers={"Retry-After":
+                         str(shed_spec.get("retry_after", 1))},
+            )
         if fail_every and stats.requests % fail_every == 0:
             return web.json_response({"error": "injected"}, status=500)
         tp_header = request.headers.get("traceparent", "")
@@ -240,6 +288,12 @@ def make_app(
             status=200, headers={"Content-Type": "text/event-stream"}
         )
         await resp.prepare(request)
+        cut_spec = _fault("sse_disconnect")
+        cut_after = int(cut_spec.get("after_tokens", 1)) if cut_spec else None
+        stall_spec = _fault("sse_stall")
+        stall_after = (
+            int(stall_spec.get("after_tokens", 1)) if stall_spec else None
+        )
         t_first_ns = 0
         for i, w in enumerate(words):
             await asyncio.sleep(token_delay_s)
@@ -251,6 +305,25 @@ def make_app(
             if i == 0:
                 t_first_ns = time.time_ns()
             await resp.write(f"data: {json.dumps(evt)}\n\n".encode())
+            if cut_after is not None and i + 1 >= cut_after:
+                # injected mid-stream disconnect: drop the transport the
+                # way a network fault would (no [DONE], no clean close)
+                if request.transport is not None:
+                    request.transport.close()
+                return resp
+            if stall_after is not None and i + 1 >= stall_after:
+                # injected stream STALL: the connection stays open but no
+                # further chunk ever arrives — only the client's read
+                # timeout can end this (loadgen split-timeout satellite).
+                # A client that gave up releases the handler so server
+                # cleanup never waits out the stall.
+                t_end = time.time() + float(stall_spec.get("duration", 30.0))
+                while time.time() < t_end:
+                    if (request.transport is None
+                            or request.transport.is_closing()):
+                        break
+                    await asyncio.sleep(0.05)
+                break
         usage_evt = {
             "id": "mock",
             "choices": [],
@@ -321,10 +394,51 @@ def make_app(
     async def traces(_request: web.Request) -> web.Response:
         return web.json_response(tracer.to_otlp())
 
+    async def faults_get(_request: web.Request) -> web.Response:
+        return web.json_response({
+            "enabled": True,
+            "active": {
+                n: {k: v for k, v in s.items() if not k.startswith("_")}
+                for n, s in faults.items()
+            },
+        })
+
+    async def faults_post(request: web.Request) -> web.Response:
+        # same wire shape as runtime/server.py POST /faults
+        try:
+            body = await request.json()
+        except Exception:
+            return web.json_response({"error": {"message": "invalid JSON"}},
+                                     status=400)
+        action = body.get("action", "arm")
+        name = body.get("name")
+        if action == "clear":
+            if name is None:
+                faults.clear()
+            else:
+                faults.pop(name, None)
+            return web.json_response({"status": "ok",
+                                      "cleared": name or "all"})
+        if action != "arm" or not name:
+            return web.json_response(
+                {"error": {"message": "need action 'arm'|'clear' and, for "
+                           "arm, a fault 'name'"}}, status=400,
+            )
+        faults[name] = {k: v for k, v in body.items()
+                        if k not in ("action", "name")}
+        return web.json_response({"status": "ok",
+                                  "armed": {"name": name, **faults[name]}})
+
+    async def healthz(_request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok"})
+
     app = web.Application()
     app.router.add_post("/v1/chat/completions", chat)
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/traces", traces)
+    app.router.add_get("/healthz", healthz)
+    app.router.add_get("/faults", faults_get)
+    app.router.add_post("/faults", faults_post)
     return app
 
 

@@ -1,0 +1,261 @@
+"""`kvmini-tpu chaos --target local` (docs/RESILIENCE.md): the scenario
+matrix against the mock server — one fault per class through POST
+/faults, MTTR measured from fault-clear to the first healthy completion,
+a schema-valid resilience_table.json, and the injection-failure
+short-circuit contract shared with the cluster harness.
+
+This is the `make chaos-smoke` gate: JAX-free, no cluster, no TPU.
+"""
+
+import asyncio
+import json
+import threading
+
+import pytest
+
+from kserve_vllm_mini_tpu.chaos.harness import ChaosConfig, write_resilience_table
+from kserve_vllm_mini_tpu.chaos.local import FAULT_ARMS, LOCAL_FAULTS, LocalChaosHarness
+from kserve_vllm_mini_tpu.core.rundir import RunDir
+from kserve_vllm_mini_tpu.core.schema import validate_resilience
+from kserve_vllm_mini_tpu.loadgen.runner import LoadConfig, run_load
+from tests.mock_server import MockServer, make_app
+
+
+class _LiveMock:
+    """MockServer driven from a background thread's event loop so the
+    SYNCHRONOUS chaos harness can run against it."""
+
+    def __init__(self, **kwargs):
+        self.url = ""
+        self._kwargs = kwargs
+        self._ready = threading.Event()
+        self._stop = None
+        self._thread = None
+
+    def __enter__(self):
+        loop = asyncio.new_event_loop()
+
+        async def _serve():
+            from aiohttp import web
+
+            runner = web.AppRunner(make_app(**self._kwargs))
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            port = site._server.sockets[0].getsockname()[1]
+            self.url = f"http://127.0.0.1:{port}"
+            self._ready.set()
+            try:
+                await asyncio.get_event_loop().create_future()  # park
+            finally:
+                await runner.cleanup()
+
+        def _run():
+            asyncio.set_event_loop(loop)
+            task = loop.create_task(_serve())
+            self._stop = lambda: loop.call_soon_threadsafe(task.cancel)
+            try:
+                loop.run_until_complete(task)
+            except asyncio.CancelledError:
+                pass
+            loop.close()
+
+        self._thread = threading.Thread(target=_run, daemon=True)
+        self._thread.start()
+        assert self._ready.wait(10.0), "mock server did not come up"
+        return self
+
+    def __exit__(self, *exc):
+        if self._stop:
+            self._stop()
+        self._thread.join(timeout=10.0)
+
+
+def _bench_fn(url, tmp_path):
+    counter = [0]
+
+    def bench(fault):
+        from kserve_vllm_mini_tpu.analysis.metrics import compute_latency_stats
+
+        counter[0] += 1
+        cfg = LoadConfig(
+            url=url, num_requests=4, concurrency=2, streaming=True,
+            target_rps=200.0, max_tokens=4, max_retries=0,
+            timeout_s=3.0, connect_timeout_s=2.0, read_timeout_s=1.0,
+        )
+        rd = RunDir.create(tmp_path, run_id=f"bench-{fault}-{counter[0]}")
+        return compute_latency_stats(run_load(cfg, rd))
+
+    return bench
+
+
+def test_local_chaos_matrix_end_to_end(tmp_path):
+    """The chaos-smoke acceptance: every fault class runs against a live
+    endpoint, injected faults recover with a measured MTTR, the
+    multihost-only scenario stays honest, and the table validates."""
+    with _LiveMock(token_delay_s=0.001, n_tokens=4) as srv:
+        harness = LocalChaosHarness(
+            srv.url,
+            bench_fn=_bench_fn(srv.url, tmp_path),
+            fault_hold_s=0.05,
+            recovery_timeout_s=10.0,
+            poll_interval_s=0.05,
+            probe_timeout_s=2.0,
+        )
+        results = harness.run_all()
+        table = write_resilience_table(
+            results, tmp_path / "resilience_table.json",
+            ChaosConfig(namespace="-", service="local"), target="local",
+        )
+
+    assert validate_resilience(table) == []
+    assert table["target"] == "local"
+    rows = {r["fault"]: r for r in table["faults"]}
+    assert set(rows) == set(LOCAL_FAULTS)
+    for fault in ("sweep-wedge", "device-error", "kv-alloc-fail",
+                  "sse-disconnect"):
+        row = rows[fault]
+        assert row["injected"] is True, fault
+        assert row["recovered"] is True, fault
+        assert row["mttr_s"] is not None and row["mttr_s"] >= 0.0, fault
+    # faults that error requests during the window measured a real
+    # degraded error rate, not a green bench (device-error is BOUNDED at
+    # 2 fires so a real engine survives its degrade ladder)
+    assert rows["device-error"]["error_rate"] > 0.0
+    assert rows["kv-alloc-fail"]["error_rate"] == 1.0
+    assert rows["sse-disconnect"]["error_rate"] > 0.0
+    # publish_drop needs a multihost primary: honest non-injection, and
+    # gate_ok stays null (never a green verdict for a fault that never
+    # happened)
+    assert rows["publish-drop"]["injected"] is False
+    assert rows["publish-drop"]["gate_ok"] is None
+    assert table["all_recovered"] is True
+    # on-disk artifact round-trips
+    on_disk = json.loads((tmp_path / "resilience_table.json").read_text())
+    assert validate_resilience(on_disk) == []
+
+
+def test_arm_failure_short_circuits_to_uninjected_row(tmp_path):
+    """A target whose /faults is disabled (production default) yields an
+    injected=false row with gate_ok null — the same broken-injector
+    contract the cluster harness satellite pins."""
+    calls = []
+
+    def never_bench(fault):
+        calls.append(fault)
+        return {}
+
+    with _LiveMock(token_delay_s=0.0) as srv:
+        harness = LocalChaosHarness(
+            srv.url, bench_fn=never_bench, recovery_timeout_s=2.0,
+            poll_interval_s=0.05,
+        )
+        # simulate a refusing /faults endpoint by pointing the arm at a
+        # bogus path
+        harness._arm = lambda fault: (False, "HTTP 403: fault injection is "
+                                             "disabled")
+        res = harness.run_fault("device-error")
+    assert res.injected is False
+    assert res.recovered is False
+    assert res.gate_ok is None           # no fault -> no verdict
+    assert calls == []                   # bench-and-gate never ran
+
+
+def test_unhealthy_endpoint_yields_honest_row():
+    harness = LocalChaosHarness(
+        "http://127.0.0.1:9",  # nothing listens here
+        probe_timeout_s=0.2, recovery_timeout_s=0.2,
+    )
+    res = harness.run_fault("sweep-wedge")
+    assert res.injected is False
+    assert "not healthy" in res.detail
+
+
+def test_exit_code_fails_when_nothing_was_injected():
+    """A run where every injection failed (server without
+    --allow-fault-injection, broken kubectl) must NOT exit 0:
+    all_recovered is vacuously true over an empty injected set."""
+    from kserve_vllm_mini_tpu.chaos.harness import table_exit_code
+
+    nothing = {
+        "all_recovered": True,
+        "faults": [
+            {"fault": "device-error", "injected": False, "recovered": False},
+            {"fault": "publish-drop", "injected": False, "recovered": False},
+        ],
+    }
+    assert table_exit_code(nothing) == 1
+    good = {
+        "all_recovered": True,
+        "faults": [
+            {"fault": "device-error", "injected": True, "recovered": True},
+            {"fault": "publish-drop", "injected": False, "recovered": False},
+        ],
+    }
+    assert table_exit_code(good) == 0
+    unrecovered = {
+        "all_recovered": False,
+        "faults": [
+            {"fault": "device-error", "injected": True, "recovered": False},
+        ],
+    }
+    assert table_exit_code(unrecovered) == 1
+
+
+def test_dense_engine_refuses_kv_alloc_fail_arm():
+    """A dense-layout engine must refuse to arm kv_alloc_fail (the point
+    lives in the paged admission path) so a local chaos run gets an
+    honest injected=false row instead of a green verdict for a fault
+    that can never execute."""
+    from kserve_vllm_mini_tpu.runtime.engine import Engine
+    from kserve_vllm_mini_tpu.runtime.faults import FaultRegistry
+
+    eng = Engine.__new__(Engine)
+    eng.paged = False
+    eng._faults = FaultRegistry()
+    with pytest.raises(ValueError, match="kv_layout=paged"):
+        eng.arm_fault("kv_alloc_fail", duration=1.0)
+    eng.paged = True
+    assert eng.arm_fault("kv_alloc_fail", duration=1.0)["name"] == "kv_alloc_fail"
+
+
+def test_unknown_local_fault_rejected():
+    harness = LocalChaosHarness("http://127.0.0.1:9")
+    with pytest.raises(ValueError):
+        harness.run_fault("meteor-strike")
+
+
+def test_fault_arm_map_covers_every_runtime_point():
+    """Every in-process injection point the runtime threads through its
+    hot paths has a local chaos scenario driving it."""
+    from kserve_vllm_mini_tpu.runtime.faults import FAULT_POINTS
+
+    driven = {spec["name"] for spec in FAULT_ARMS.values()}
+    assert driven == set(FAULT_POINTS)
+
+
+def test_mock_faults_endpoint_wire_shape(tmp_path):
+    """GET/POST /faults on the mock speaks the same wire shape as the
+    runtime server, so the harness is target-agnostic."""
+    import urllib.request
+
+    with _LiveMock(token_delay_s=0.0) as srv:
+        req = urllib.request.Request(
+            srv.url + "/faults",
+            data=json.dumps({"action": "arm", "name": "device_error",
+                             "times": 1}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=5.0) as r:
+            body = json.loads(r.read())
+        assert body["armed"]["name"] == "device_error"
+        with urllib.request.urlopen(srv.url + "/faults", timeout=5.0) as r:
+            listing = json.loads(r.read())
+        assert "device_error" in listing["active"]
+        req = urllib.request.Request(
+            srv.url + "/faults",
+            data=json.dumps({"action": "clear"}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=5.0) as r:
+            assert json.loads(r.read())["cleared"] == "all"

@@ -163,6 +163,49 @@ def test_unknown_fault_rejected():
         _harness(FakeCluster()).run_fault("meteor-strike")
 
 
+def test_raising_injector_short_circuits_without_green_gate():
+    """ISSUE 10 satellite: a RAISING injector (kubectl binary missing,
+    cluster gone mid-run) must short-circuit to an injected=False row
+    with gate_ok left None — before this fix the exception escaped
+    run_fault; benching the healthy service after a fault that never
+    happened would stamp a green gate onto nothing."""
+    cluster = FakeCluster()
+    bench_calls = []
+
+    def bench_fn(fault):
+        bench_calls.append(fault)
+        return {"p95_ms": 1.0, "error_rate": 0.0}
+
+    h = _harness(cluster)
+    h.bench_fn = bench_fn
+    h.gate_fn = lambda results: True
+
+    def exploding_kubectl(args, timeout_s=None):
+        if args[0] == "delete":
+            raise FileNotFoundError("kubectl: command not found")
+        return cluster.kubectl().run(args)
+
+    h.kc = type("KC", (), {"run": staticmethod(exploding_kubectl)})()
+    res = h.run_fault("pod-kill")
+    assert res.injected is False
+    assert res.recovered is False
+    assert res.gate_ok is None          # never a verdict for a no-op fault
+    assert "injection failed" in res.detail
+    assert bench_calls == []            # bench-and-gate never ran
+
+
+def test_broken_kubectl_readiness_check_is_a_row_not_a_crash():
+    h = _harness(FakeCluster())
+
+    def broken(args, timeout_s=None):
+        raise OSError("connection refused")
+
+    h.kc = type("KC", (), {"run": staticmethod(broken)})()
+    res = h.run_fault("pod-kill")
+    assert res.injected is False and res.gate_ok is None
+    assert "readiness check failed" in res.detail
+
+
 # -- provenance --------------------------------------------------------------
 
 def _make_run(tmp_path) -> RunDir:

@@ -62,6 +62,12 @@ def _harness(prefix_cache=True, pool=POOL):
     eng._kv_gauges = {}
     eng._running = False
     eng._thread = None
+    # resilience rail (docs/RESILIENCE.md): _paged_fits consults the
+    # fault registry before any plan math
+    from kserve_vllm_mini_tpu.runtime.faults import FaultRegistry
+
+    eng._faults = FaultRegistry()
+    eng._kv_fault_until = 0.0
     eng.stats = {
         "prefix_hits": 0, "prefix_lookups": 0, "prefix_tokens_reused": 0,
         "kv_blocks_allocated": 0, "kv_retained_evictions": 0,

@@ -69,7 +69,8 @@ CASES = [
     ("kvm031", {"KVM031": 1}),  # ISSUE seeded mutation: stats key not exported
     ("kvm032", {"KVM032": 3}),  # consumed-, documented-, and emitted-drift
     ("kvm033", {"KVM033": 1}),
-    ("kvm041", {"KVM041": 2}),  # silent except-fallback + unflagged truncation
+    ("kvm041", {"KVM041": 3}),  # silent except-fallback + unflagged
+    #                             truncation + ISSUE-10 seeded swallowed 429
     ("kvm051", {"KVM051": 1}),  # ISSUE seeded race: bare cross-thread counter
     ("kvm052", {"KVM052": 1}),  # locked read here, bare write there
     ("kvm053", {"KVM053": 1}),  # ISSUE seeded race: lock-order cycle
