@@ -105,6 +105,28 @@ def _env_slots() -> int:
     return int(_knob("KVMINI_BENCH_SLOTS"))
 
 
+def _env_prefill_chunk():
+    """Tokens per interleaved prefill chunk, or None (monolithic). Loud
+    validation at the knob: a garbled value must not silently bench the
+    monolithic path under a chunked label."""
+    raw = _knob("KVMINI_BENCH_PREFILL_CHUNK")
+    if not raw:
+        return None
+    try:
+        chunk = int(raw)
+    except ValueError:
+        raise SystemExit(
+            f"KVMINI_BENCH_PREFILL_CHUNK={raw!r}: must be a positive "
+            "integer token count (empty disables chunked prefill)"
+        ) from None
+    if chunk < 1:
+        raise SystemExit(
+            f"KVMINI_BENCH_PREFILL_CHUNK={chunk}: must be >= 1 (empty "
+            "disables chunked prefill)"
+        )
+    return chunk
+
+
 # ---------------------------------------------------------------------------
 # Child-side: incremental progress + the sub-benchmark bodies.
 # ---------------------------------------------------------------------------
@@ -282,6 +304,13 @@ def _run_serving_child(mode: str) -> dict:
         # below that would clamp KV writes onto the last position and
         # corrupt the measurement instead of shrinking it
         ctx_need = prompt_len + warmup + decode_steps + decode_steps // 4 + 1
+        # deliberately NOT passing prefill_chunk here: this child executes
+        # MONOLITHIC batched/TTFT prefill probes regardless of the chunk
+        # knob (the chunked row below is additional), so the guard must
+        # price the monolithic activation set or it can admit a shape the
+        # batch prefill then RESOURCE_EXHAUSTs on — the BENCH_r02 class.
+        # Per-chunk pricing applies where chunked execution is real: the
+        # Engine's own guard and the proxy tier's serving pre-flight.
         plan = serving_headroom_plan(
             model, slots, max_seq, quant, kv_quant, capacity,
             quant_mode=quant_mode,
@@ -417,6 +446,61 @@ def _run_serving_child(mode: str) -> dict:
         "ttft_p50_adjusted_ms": round(ttft_adj, 2),
         "flash_prefill_lowered": bool(flash_lowered),
     })
+
+    # -- chunked single-request prefill (KVMINI_BENCH_PREFILL_CHUNK): the
+    # same prompt as the TTFT probe split into chunk-token pieces — piece
+    # 0 on the flash fresh-prefill path, continuations on the positional-
+    # masked cached path (int8-KV caches ride the cached-prefill kernel
+    # on TPU). Timed whole-prompt so the row reads next to ttft_p50; the
+    # per-piece wall is the interleaving window a decode sweep rides in.
+    prefill_chunk = _env_prefill_chunk()
+    if prefill_chunk and prefill_chunk < prompt_len:
+        ch = prefill_chunk
+        n_pieces = -(-prompt_len // ch)
+
+        @jax.jit
+        def prefill_c0(params, cache, piece, pos):
+            logits, cache = forward(params, cfg, piece, pos, cache,
+                                    jnp.zeros((1,), jnp.int32),
+                                    fresh_prefill=True, **t1kw)
+            return cache, jnp.argmax(logits[:, -1, :], axis=-1)
+
+        @jax.jit
+        def prefill_cont(params, cache, piece, offset):
+            # offset: [1] absolute position of the piece's first token
+            cpos = offset[:, None] + jnp.arange(piece.shape[1],
+                                                dtype=jnp.int32)[None]
+            logits, cache = forward(params, cfg, piece, cpos, cache,
+                                    offset, **t1kw)
+            return cache, jnp.argmax(logits[:, -1, :], axis=-1)
+
+        def chunked_once():
+            c, tok = cache1, None
+            for i in range(n_pieces):
+                piece = toks1[:, i * ch : (i + 1) * ch]
+                if i == 0:
+                    c, tok = prefill_c0(params, c, piece, pos1[:, :piece.shape[1]])
+                else:
+                    c, tok = prefill_cont(params, c, piece,
+                                          jnp.full((1,), i * ch, jnp.int32))
+            return tok
+
+        _ = np.asarray(chunked_once())  # compile + warm (<= 2 variants)
+        samples = []
+        for _i in range(5):
+            t0 = time.time()
+            _ = np.asarray(chunked_once())
+            samples.append((time.time() - t0) * 1000.0)
+        chunked_ms = sorted(samples)[len(samples) // 2]
+        row = {
+            "chunk": ch,
+            "chunks": n_pieces,
+            "ms_p50": round(chunked_ms, 2),
+            "ms_per_chunk_p50": round(chunked_ms / n_pieces, 2),
+            "monolithic_ttft_p50_ms": round(ttft_p50, 2),
+        }
+        _progress(f"{mode}.prefill_chunked", row)
+        _log(f"chunked prefill ({n_pieces} x {ch}): {row}")
 
     # -- prefill throughput buckets (VERDICT round-4 #8: prefill is the
     # compute-bound side — tokens/s/chip + MFU, not just TTFT) ------------
@@ -975,6 +1059,7 @@ def _run_proxy_child() -> dict:
             _env_quant_mode() if _env_quant() != "none" else "dequant"
         ),
         hbm_bytes=hbm,
+        prefill_chunk=_env_prefill_chunk(),
     )
     _progress("proxy.block", data)
     return data
@@ -1454,6 +1539,15 @@ _ENV_KNOBS = {
         "--paged", "",
         "'1' routes the serving sub-benches through the paged KV pool "
         "even outside the paged mode",
+    ),
+    "KVMINI_BENCH_PREFILL_CHUNK": (
+        "--prefill-chunk", "",
+        "tokens per interleaved prefill chunk (runtime/engine.py "
+        "prefill_chunk): the serving children time a chunked single-"
+        "request prefill next to the monolithic one and the headroom "
+        "guard prices the per-chunk workspace, and the proxy tier sizes "
+        "its chunk-prefill cost entry to match — so sweeps can put "
+        "chunk size on an axis; empty = monolithic prefill",
     ),
     "KVMINI_BENCH_UNROLL": (
         "--unroll", "1",

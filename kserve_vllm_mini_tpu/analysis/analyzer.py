@@ -127,6 +127,11 @@ def analyze_run(
     update.update(
         telemetry.pipeline_counters(endpoint, runtime_metrics=runtime_metrics)
     )
+    # chunked-prefill counters (docs/TROUBLESHOOTING.md "Long prompts
+    # stall streaming"): same in-repo-only, absent-not-zero rule
+    update.update(
+        telemetry.prefill_counters(endpoint, runtime_metrics=runtime_metrics)
+    )
     # compile-stats block (docs/PROFILING.md): same in-repo-only rule
     update.update(
         telemetry.compile_stats_block(endpoint, runtime_metrics=runtime_metrics)

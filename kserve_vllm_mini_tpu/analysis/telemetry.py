@@ -301,24 +301,56 @@ PIPELINE_METRIC_KEYS = {
 }
 
 
-def pipeline_counters(
+def _mapped_counters(
     endpoint: Optional[str],
+    key_map: dict[str, str],
     runtime_metrics: Optional[dict[str, float]] = None,
 ) -> dict[str, Any]:
-    """Decode-pipeline counters from the runtime's /metrics, keyed for
-    results.json. Empty when the endpoint doesn't expose them (external
-    engines) — absence, not zeros, so reports can tell 'no pipeline' from
-    'pipeline never engaged'. ``runtime_metrics``: pre-scraped dict (see
-    collect_utilization)."""
+    """Scrape-and-remap shared by the flat counter rails (decode
+    pipeline, chunked prefill): runtime metric -> results.json key, with
+    the absent-not-zero contract — an endpoint that doesn't expose a
+    metric (external engines) yields NO key, never a fabricated zero.
+    ``runtime_metrics``: pre-scraped dict (see collect_utilization)."""
     if not endpoint:
         return {}
     m = (runtime_metrics if runtime_metrics is not None
          else scrape_runtime_metrics(endpoint))
     return {
         out_key: m[metric]
-        for metric, out_key in PIPELINE_METRIC_KEYS.items()
+        for metric, out_key in key_map.items()
         if metric in m
     }
+
+
+def pipeline_counters(
+    endpoint: Optional[str],
+    runtime_metrics: Optional[dict[str, float]] = None,
+) -> dict[str, Any]:
+    """Decode-pipeline counters from the runtime's /metrics, keyed for
+    results.json. Absence tells 'no pipeline' from 'pipeline never
+    engaged' (_mapped_counters)."""
+    return _mapped_counters(endpoint, PIPELINE_METRIC_KEYS,
+                            runtime_metrics=runtime_metrics)
+
+
+# runtime counter -> results.json key for the chunked-prefill rail
+# (docs/TROUBLESHOOTING.md "Long prompts stall streaming"). Exported by
+# runtime/server.py /metrics and, for parity testing, tests/mock_server.py.
+PREFILL_METRIC_KEYS = {
+    "kvmini_tpu_prefill_chunks_total": "prefill_chunks",
+    "kvmini_tpu_prefill_chunk_stall_seconds_total": "prefill_chunk_stall_s",
+}
+
+
+def prefill_counters(
+    endpoint: Optional[str],
+    runtime_metrics: Optional[dict[str, float]] = None,
+) -> dict[str, Any]:
+    """Chunked-prefill counters from the runtime's /metrics, keyed for
+    results.json (_mapped_counters: same absent-not-zero contract as
+    pipeline_counters)."""
+    return _mapped_counters(endpoint, PREFILL_METRIC_KEYS,
+                            runtime_metrics=runtime_metrics)
 
 
 # results.json `compile_stats` sub-key -> runtime metric (docs/

@@ -335,6 +335,10 @@ def _run_stages(
             "pipeline_pipelined_sweeps": es["pipelined_sweeps"],
             "pipeline_host_overlap_s": round(es["host_overlap_s"], 6),
             "pipeline_bubble_s": round(es["bubble_s"], 6),
+            # chunked-prefill rail (docs/TROUBLESHOOTING.md "Long prompts
+            # stall streaming"): same authoritative-direct-snapshot rule
+            "prefill_chunks": es["prefill_chunks"],
+            "prefill_chunk_stall_s": round(es["prefill_chunk_stall_s"], 6),
         })
         # compile-stats block (docs/PROFILING.md): the direct snapshot is
         # authoritative (per-executable entries included) and replaces

@@ -130,6 +130,14 @@ class Results:
     pipeline_host_overlap_s: Optional[float] = None
     pipeline_bubble_s: Optional[float] = None
 
+    # chunked-prefill telemetry (docs/TROUBLESHOOTING.md "Long prompts
+    # stall streaming"): compiled prefill piece dispatches and the prefill
+    # wall that ran while decode work was live, scraped from /metrics
+    # (analysis/telemetry.py PREFILL_METRIC_KEYS); absent for external
+    # engines
+    prefill_chunks: Optional[float] = None
+    prefill_chunk_stall_s: Optional[float] = None
+
     # server-side phase attribution (docs/TRACING.md): per-phase duration
     # stats from the runtime's /traces spans merged by the analyzer —
     # {"queue"|"prefill"|"decode": {count, mean_ms, p50_ms, p95_ms,

@@ -46,6 +46,12 @@ _FORCE_PAGED_KERNEL: Optional[bool] = None
 # dequantize-on-read oracle elsewhere). See use_dense_kernel.
 _FORCE_DENSE_KERNEL: Optional[bool] = None
 
+# Same hook for the int8-KV CACHED-PREFILL kernel (ops/flash_attention.py
+# cached_prefill_attention — continuation chunks attending the cache):
+# None = auto (kernel on TPU, the eager dequantize-on-read oracle
+# elsewhere). See use_chunk_kernel.
+_FORCE_CHUNK_KERNEL: Optional[bool] = None
+
 
 def _stacked_weight_shapes(cfg: ModelConfig) -> dict[str, tuple[int, ...]]:
     """Per-layer shape of every stacked transformer matmul weight (last two
@@ -592,6 +598,31 @@ def run_cached_layers(
             else jax.default_backend() == "tpu"
         )
     )
+    # Int8-KV cached-prefill kernel (ops/flash_attention.py
+    # cached_prefill_attention): continuation chunks (T > 1 against the
+    # cache, NOT fresh_prefill) stream the int8 stripes with in-kernel
+    # dequant instead of materializing the eager read's bf16 KV tensor.
+    # Plain-causal, full-slot-axis dense caches only — same exclusions as
+    # the dense decode kernel, plus the tiling contract on (T, S).
+    from kserve_vllm_mini_tpu.ops.flash_attention import cached_prefill_blocks
+
+    use_chunk_kernel = (
+        (not paged)
+        and (not fresh_prefill)
+        and quantized_kv
+        and paged_kernel_ok
+        and write_gate is None
+        and slot_base is None
+        and positions.shape[1] > 1
+        and cfg.attn_softcap is None
+        and cfg.sliding_window is None
+        and cached_prefill_blocks(positions.shape[1], s) is not None
+        and (
+            _FORCE_CHUNK_KERNEL
+            if _FORCE_CHUNK_KERNEL is not None
+            else jax.default_backend() == "tpu"
+        )
+    )
     kj = jnp.arange(s)[None, None, :]
     qi = positions[:, :, None]
     causal = kj <= qi
@@ -761,6 +792,21 @@ def run_cached_layers(
                 k_scale=cache.get("k_s"), v_scale=cache.get("v_s"),
             )
             o = og.reshape(B, cfg.n_heads, 1, cfg.head_dim)
+        elif use_chunk_kernel:
+            # int8-KV cached prefill: the chunk's queries attend the whole
+            # cache stripe — earlier chunks' KV plus the rows this scan
+            # step just wrote — with the stripes DMA'd int8 and dequantized
+            # in-kernel (lidx rides the index map, same contract as the
+            # decode kernels)
+            from kserve_vllm_mini_tpu.ops.flash_attention import (
+                cached_prefill_attention,
+            )
+
+            o = cached_prefill_attention(
+                q, cache["k"], cache["v"], cache_offsets,
+                layer=lidx, scale=attn_scale,
+                k_scale=cache.get("k_s"), v_scale=cache.get("v_s"),
+            )
         else:
             k_layer = _read_layer(cache, "k", lidx)
             v_layer = _read_layer(cache, "v", lidx)

@@ -18,6 +18,7 @@ from typing import Any, Callable, Optional
 EVENT_TYPES = (
     "throughput_collapse",
     "decode_stall",
+    "prefill_stall",
     "queue_depth_runaway",
     "duty_cycle_drop",
     "burn_rate_exceeded",
@@ -116,6 +117,7 @@ class EventDetector:
     def __init__(
         self,
         stall_samples: int = 5,
+        prefill_stall_samples: int = 3,
         queue_samples: int = 5,
         queue_depth_limit: float = 32.0,
         collapse_fraction: float = 0.3,
@@ -128,6 +130,7 @@ class EventDetector:
         hbm_high_fraction: float = 0.92,
     ) -> None:
         self.stall_samples = stall_samples
+        self.prefill_stall_samples = prefill_stall_samples
         self.queue_samples = queue_samples
         self.queue_depth_limit = queue_depth_limit
         self.collapse_fraction = collapse_fraction
@@ -143,6 +146,7 @@ class EventDetector:
         self._prev: Optional[dict[str, Any]] = None
         self._decode_progressed = False
         self._stall_run = 0
+        self._prefill_stall_run = 0
         self._queue_run = 0
         self._burn_run = 0
         self._thrash_run = 0
@@ -184,6 +188,51 @@ class EventDetector:
                 f"no decode progress for {self._stall_run} consecutive "
                 f"samples with {int(inflight)} request(s) in flight",
                 {"samples": self._stall_run, "inflight": inflight},
+            )
+        return None
+
+    def _check_prefill_stall(self, sample: dict[str, Any]) -> Optional[Event]:
+        """Decode retire rate COLLAPSED while prefill work ADVANCED with
+        decode requests in flight: the attribution decode_stall alone
+        cannot make — the engine is not wedged, it is running a long
+        monolithic prefill in front of every streaming client (docs/
+        TROUBLESHOOTING.md "Long prompts stall streaming"; the
+        prefill_chunk knob is the fix). Windowed: decode_steps_total
+        frozen across N consecutive samples while prefills_total or
+        prefill_chunks_total moved and >= 2 requests are in flight (the
+        prefilling one plus at least one stalled decode). Armed only
+        after decode progress has been observed once — the same cold-
+        compile immunity rule as decode_stall (a cold engine's first
+        prefill legitimately freezes the counters)."""
+        prev = self._prev
+        steps = _runtime(sample, "decode_steps_total")
+        inflight = _loadgen(sample, "inflight")
+        if prev is None or steps is None:
+            return None
+        prev_steps = _runtime(prev, "decode_steps_total")
+        prefill_moved = False
+        for key in ("prefills_total", "prefill_chunks_total"):
+            cur, old = _runtime(sample, key), _runtime(prev, key)
+            if cur is not None and old is not None and cur > old:
+                prefill_moved = True
+        if (
+            self._decode_progressed
+            and prefill_moved
+            and inflight is not None
+            and inflight >= 2
+            and steps == prev_steps
+        ):
+            self._prefill_stall_run += 1
+        else:
+            self._prefill_stall_run = 0
+        if self._prefill_stall_run >= self.prefill_stall_samples:
+            return Event(
+                sample["t"], "prefill_stall",
+                f"decode retire rate collapsed for {self._prefill_stall_run} "
+                f"consecutive samples while prefill advanced with "
+                f"{int(inflight)} request(s) in flight — long prompts are "
+                "stalling streaming (consider the prefill_chunk knob)",
+                {"samples": self._prefill_stall_run, "inflight": inflight},
             )
         return None
 
@@ -405,6 +454,7 @@ class EventDetector:
             self._t0 = float(sample["t"])
         checks: list[tuple[str, Optional[Event]]] = [
             ("decode_stall", self._check_decode_stall(sample)),
+            ("prefill_stall", self._check_prefill_stall(sample)),
             ("queue_depth_runaway", self._check_queue_runaway(sample)),
             ("throughput_collapse", self._check_throughput_collapse(sample)),
             ("duty_cycle_drop", self._check_duty_drop(sample)),

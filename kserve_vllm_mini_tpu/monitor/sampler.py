@@ -43,6 +43,13 @@ TIMELINE_RUNTIME_METRICS = (
     "kvmini_tpu_decode_steps_total",
     "kvmini_tpu_requests_completed_total",
     "kvmini_tpu_pipelined_sweeps_total",
+    # chunked-prefill rail (docs/TROUBLESHOOTING.md "Long prompts stall
+    # streaming"): prefill progress feeds the prefill_stall rule — decode
+    # frozen WHILE prefill advances is the attribution decode_stall alone
+    # cannot make
+    "kvmini_tpu_prefills_total",
+    "kvmini_tpu_prefill_chunks_total",
+    "kvmini_tpu_prefill_chunk_stall_seconds_total",
     "kvmini_tpu_kv_free_blocks",
     # KV-cache & HBM deep observability (docs/TROUBLESHOOTING.md "HBM
     # pressure & KV thrash"): pool occupancy + eviction churn feed the
@@ -80,6 +87,7 @@ class MonitorConfig:
     burn_threshold: float = 2.0
     burn_samples: int = 3
     stall_samples: int = 5
+    prefill_stall_samples: int = 3    # prefill_stall rule (docs/MONITORING.md)
     queue_depth_limit: float = 32.0
     kv_thrash_rate: float = 4.0       # retained evictions/s (docs/MONITORING.md)
     kv_thrash_samples: int = 3
@@ -129,6 +137,7 @@ class RunMonitor:
         self.burn_peak: dict[str, float] = {}
         self._detector = EventDetector(
             stall_samples=self.cfg.stall_samples,
+            prefill_stall_samples=self.cfg.prefill_stall_samples,
             queue_depth_limit=self.cfg.queue_depth_limit,
             burn_threshold=self.cfg.burn_threshold,
             burn_samples=self.cfg.burn_samples,

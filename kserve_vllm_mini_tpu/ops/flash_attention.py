@@ -14,6 +14,14 @@ called by runtime/engine.py's prefill step), which compiles the kernel on TPU
 — the MXU sees [block_q, d] x [d, block_k] bf16 tiles — and falls back to the
 jnp oracle on other backends. bench.py asserts the prefill executable
 actually lowers to a tpu_custom_call.
+
+``cached_prefill_attention`` below is the CONTINUATION-chunk variant: a
+chunk's queries attend the slot's whole dense cache stripe (earlier
+chunks' cached KV plus the chunk's own just-written rows) with positional
+masking, reading int8-KV stripes with in-kernel dequant — the prefill
+twin of ops/paged_attention.py ``dense_decode_attention``, sharing its
+``k_scale``/``v_scale`` conventions. The eager ``_read_layer`` dequant
+path (models/llama.py) stays the fallback/consistency oracle.
 """
 
 from __future__ import annotations
@@ -122,6 +130,206 @@ def flash_attention(
         ],
         interpret=interpret,
     )(q, k, v)
+
+
+def _cached_prefill_kernel(
+    layer_ref,   # [1] int32 layer index (scalar prefetch; used in index maps)
+    offset_ref,  # [B] int32 absolute position of each row's first query
+    q_ref,       # [1, 1, BQ, D] this (b, h, qi) query tile
+    k_ref,       # [1, 1, 1, BK, D] this grid step's cache stripe (int8 when
+    v_ref,       #                  quantized)
+    *rest,       # [k_s_ref, v_s_ref,] o_ref, m_ref, l_ref, acc_ref
+    scale: float,
+    block_q: int,
+    block_k: int,
+    quantized: bool,
+):
+    """One key-block step of the CACHED-prefill online-softmax recurrence:
+    a chunk of queries at absolute positions offset..offset+T-1 attends the
+    slot's whole cache stripe (earlier chunks' KV plus this chunk's own
+    just-written rows) with positional masking. Same m/l/acc scratch
+    persistence across the innermost grid axis — and the same per-position
+    scale-dequant convention — as ``_decode_block_body``
+    (ops/paged_attention.py): (q . k_j s_j) = (q . k_j) * s_j and
+    p @ (v s) = (p * s) @ v, so the int8 stripes stream straight from HBM
+    and the materialized bf16 KV tensor of the eager read never exists."""
+    if quantized:
+        k_s_ref, v_s_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        o_ref, m_ref, l_ref, acc_ref = rest
+    b = pl.program_id(0)
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+    nk = pl.num_programs(3)
+    off = offset_ref[b]
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # key j of cache block ki sits at absolute position ki*BK + j; the
+    # tile's LAST query sits at off + qi*BQ + BQ - 1 — a block starting
+    # past it is all-masked, skip its FLOPs entirely
+    run = ki * block_k <= off + qi * block_q + block_q - 1
+
+    @pl.when(run)
+    def _accumulate():
+        q = q_ref[0, 0]                      # [BQ, D]
+        k = k_ref[0, 0, 0]                   # [BK, D] (int8 when quantized)
+        v = v_ref[0, 0, 0]
+        logits = jax.lax.dot_general(
+            q, k.astype(q.dtype), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale                            # [BQ, BK]
+        if quantized:
+            logits = logits * k_s_ref[0, 0, 0][None, :]
+        qpos = off + qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        kpos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        logits = jnp.where(kpos <= qpos, logits, _NEG_INF)
+        m_prev = m_ref[:]
+        m_cur = jnp.max(logits, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        corr = jnp.exp(m_prev - m_new)
+        p = jnp.exp(logits - m_new)
+        l_ref[:] = l_ref[:] * corr + jnp.sum(p, axis=1, keepdims=True)
+        if quantized:
+            pv = (p * v_s_ref[0, 0, 0][None, :]).astype(jnp.float32)
+            vv = v.astype(jnp.float32)
+        else:
+            pv = p.astype(v.dtype)
+            vv = v
+        acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
+            pv, vv, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[:] = m_new
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        o_ref[0, 0] = (acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)).astype(o_ref.dtype)
+
+
+def cached_prefill_blocks(t: int, s: int) -> Optional[tuple[int, int]]:
+    """(block_q, block_k) the cached-prefill kernel tiles (T, S) with, or
+    None when either axis has no supported tiling (the caller keeps the
+    eager read path). Same alignment contract as ``prefill_attention``:
+    the chunk axis T is a power-of-two bucket >= 16 or a multiple of the
+    full 128 block; the cache axis S must tile by a power of two >= 8
+    (Pallas pads partial blocks with whatever HBM holds — the positional
+    mask would zero the scores, but an unvalidated ragged block shape is
+    not worth handing Mosaic)."""
+    pow2 = t & (t - 1) == 0
+    if t < 16 or not (pow2 or t % DEFAULT_BLOCK_Q == 0):
+        return None
+    bq = min(DEFAULT_BLOCK_Q, t)
+    for bk in (DEFAULT_BLOCK_K, 64, 32, 16, 8):
+        if s % bk == 0:
+            return bq, bk
+    return None
+
+
+def cached_prefill_attention(
+    q: jnp.ndarray,        # [B, H, T, D] chunk queries
+    k_cache: jnp.ndarray,  # [L, B, KVH, S, D] layer-stacked dense cache
+                           # (or [B, KVH, S, D] for a single layer)
+    v_cache: jnp.ndarray,
+    offsets: jnp.ndarray,  # [B] int32 absolute position of each row's
+                           # first query (the chunk's cache offset)
+    layer: jnp.ndarray | int = 0,  # which layer of the stacked cache
+    scale: Optional[float] = None,
+    k_scale: Optional[jnp.ndarray] = None,  # [L, B, KVH, S] f32: int8-KV
+    v_scale: Optional[jnp.ndarray] = None,  # per-position dequant scales
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Flash prefill OVER THE CACHE: a continuation chunk's T queries
+    attend the slot's whole dense cache stripe — earlier chunks' cached
+    KV plus this chunk's own just-written rows — with positional masking,
+    streaming int8 stripes and dequantizing in-kernel when
+    ``k_scale``/``v_scale`` are given (the scaled-int8 KV layout,
+    models/llama.py). The prefill-side twin of ``dense_decode_attention``:
+    the eager read path (models/llama.py ``_read_layer``) materializes the
+    dequantized bf16 [B, KVH, S, D] tensor before attention — 3x the live
+    KV bytes in HBM traffic; here that tensor never exists. The layer
+    index rides the index map so the caller never slices the stacked
+    cache. GQA is handled in the BlockSpec index map (query head h reads
+    kv head h // n_rep). The jnp gather/dequant path is the correctness
+    oracle; tests compare in interpret mode on CPU."""
+    if k_cache.ndim == 4:
+        k_cache = k_cache[None]
+        v_cache = v_cache[None]
+        if k_scale is not None:
+            k_scale, v_scale = k_scale[None], v_scale[None]
+    quantized = k_scale is not None
+    B, H, T, D = q.shape
+    L, _, KVH, S, _ = k_cache.shape
+    blocks = cached_prefill_blocks(T, S)
+    if blocks is None:
+        raise ValueError(
+            f"cached prefill kernel needs tileable (T={T}, S={S}) — use "
+            "the eager read path (cached_prefill_blocks)"
+        )
+    bq, bk = blocks
+    n_rep = H // KVH
+    scale = scale if scale is not None else D ** -0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    offsets = offsets.astype(jnp.int32)
+    layer_arr = jnp.asarray(layer, jnp.int32).reshape((1,))
+
+    def _cache_spec():
+        return pl.BlockSpec(
+            (1, 1, 1, bk, D),
+            lambda b, h, qi, ki, layer, off: (layer[0], b, h // n_rep, ki, 0),
+        )
+
+    def _scale_spec():
+        return pl.BlockSpec(
+            (1, 1, 1, bk),
+            lambda b, h, qi, ki, layer, off: (layer[0], b, h // n_rep, ki),
+        )
+
+    in_specs = [
+        pl.BlockSpec(
+            (1, 1, bq, D), lambda b, h, qi, ki, layer, off: (b, h, qi, 0)
+        ),
+        _cache_spec(),
+        _cache_spec(),
+    ]
+    operands = [q, k_cache, v_cache]
+    if quantized:
+        in_specs += [_scale_spec(), _scale_spec()]
+        operands += [k_scale, v_scale]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, H, T // bq, S // bk),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec(
+            (1, 1, bq, D), lambda b, h, qi, ki, layer, off: (b, h, qi, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, D), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _cached_prefill_kernel, scale=scale, block_q=bq, block_k=bk,
+        quantized=quantized,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, T, D), q.dtype),
+        interpret=interpret,
+    )(layer_arr, offsets, *operands)
 
 
 def prefill_attention(

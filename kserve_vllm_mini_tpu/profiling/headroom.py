@@ -141,6 +141,7 @@ def estimate_serving_bytes(
     quant: str = "bf16",
     kv_quant: bool = False,
     quant_mode: str = "dequant",
+    prefill_chunk: Optional[int] = None,
 ) -> dict[str, int]:
     """Analytic HBM footprint of the bench serving shape: weights + dense
     KV + the f32 logits/workspace the prefill and sampling steps need.
@@ -151,17 +152,31 @@ def estimate_serving_bytes(
     max(d_ff, d_model)] for the w_down input) plus one f32 absmax scale
     per row — a transient XLA may or may not fuse away, priced so the
     guard can never admit a shape whose quantize step is the allocation
-    that RESOURCE_EXHAUSTs (docs/PROFILING.md)."""
+    that RESOURCE_EXHAUSTs (docs/PROFILING.md).
+
+    ``prefill_chunk`` (EngineConfig.prefill_chunk) bounds the widest
+    compiled prefill call: chunked prefill never materializes more than
+    one chunk bucket of activations, so BOTH sequence-length workspace
+    terms price the chunk instead of the monolithic bucket — chunking
+    WIDENS the admissible configs rather than inheriting the monolithic
+    estimate."""
     weights = int(cfg.param_count * _weight_bytes_per_param(quant))
     kv_elem = kv_elem_bytes(cfg.head_dim, cfg.jnp_dtype.itemsize, kv_quant)
     kv = int(2 * cfg.n_layers * slots * cfg.n_kv_heads * max_seq
              * cfg.head_dim * kv_elem)
+    # widest live activation set tracks the widest compiled call: the
+    # full prefill bucket monolithically, one chunk bucket when chunked
+    prefill_len = (
+        min(int(prefill_chunk), max_seq) if prefill_chunk else max_seq
+    )
     # f32 last-position logits for the batch + one full-bucket activation
     # set; the 1.15 margin covers fusion scratch XLA actually allocates
-    workspace = int(slots * cfg.vocab_size * 4 + slots * max_seq * cfg.d_model * 2)
+    workspace = int(
+        slots * cfg.vocab_size * 4 + slots * prefill_len * cfg.d_model * 2
+    )
     if quant_mode == "w8a8":
         widest = max(getattr(cfg, "d_ff", cfg.d_model), cfg.d_model)
-        workspace += int(slots * max_seq * (widest + 4))
+        workspace += int(slots * prefill_len * (widest + 4))
     total = int((weights + kv + workspace) * 1.15)
     return {"weight_bytes": weights, "kv_bytes": kv,
             "workspace_bytes": workspace, "total_bytes": total}
@@ -252,18 +267,23 @@ def serving_headroom_plan(
     kv_quant: bool,
     capacity_bytes: int,
     quant_mode: str = "dequant",
+    prefill_chunk: Optional[int] = None,
     **plan_kwargs: Any,
 ) -> HeadroomPlan:
     """``plan_admission`` over the analytic serving estimate for a named
     model config (context changes rebuild the config — the estimate must
-    price the shape actually admitted)."""
+    price the shape actually admitted). ``prefill_chunk`` prices the
+    per-chunk prefill workspace instead of the monolithic one
+    (estimate_serving_bytes)."""
     from kserve_vllm_mini_tpu.models.config import get_config
 
     def estimate(s: int, ctx: int) -> int:
         cfg = get_config(model, max_seq_len=ctx)
         return estimate_serving_bytes(cfg, s, ctx, quant=quant,
                                       kv_quant=kv_quant,
-                                      quant_mode=quant_mode)["total_bytes"]
+                                      quant_mode=quant_mode,
+                                      prefill_chunk=prefill_chunk,
+                                      )["total_bytes"]
 
     return plan_admission(estimate, capacity_bytes, slots, max_seq,
                           **plan_kwargs)

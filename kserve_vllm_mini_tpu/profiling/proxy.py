@@ -75,6 +75,32 @@ def _build_step_fns(cfg, slots: int, prompt_len: int):
     return prefill, decode
 
 
+def _build_chunk_prefill_fn(cfg, chunk_len: int):
+    """The engine's CONTINUATION-chunk prefill shape (runtime/engine.py
+    _get_chunk_prefill_fn): one chunk written at a running offset,
+    attending the whole cache with positional masking — the executable
+    whose KV READ is what int8-KV prefill halves (the fresh-prefill path
+    never reads the cache). B=1, the engine's per-request admission
+    shape."""
+    import jax
+    import jax.numpy as jnp
+
+    from functools import partial
+
+    from kserve_vllm_mini_tpu.models.llama import forward
+
+    @partial(jax.jit, donate_argnums=(1,))
+    def chunk_prefill(params, cache, toks, offset):
+        pos = offset + jnp.arange(chunk_len, dtype=jnp.int32)[None]
+        logits, cache = forward(
+            params, cfg, toks, pos, cache, offset[None],
+            logit_index=jnp.full((1,), chunk_len - 1, jnp.int32),
+        )
+        return cache, logits[0, 0]
+
+    return chunk_prefill
+
+
 def cost_model_stats(
     model: str,
     quant: str,
@@ -83,6 +109,7 @@ def cost_model_stats(
     prompt_len: int = 128,
     kv_quant: bool = False,
     quant_mode: str = "dequant",
+    prefill_chunk: int = 64,
 ) -> dict[str, Any]:
     """Abstract-compile the flagship config's prefill + decode and return
     their compile stats. No weights are ever materialized — ``eval_shape``
@@ -95,7 +122,13 @@ def cost_model_stats(
     the quantized deployment actually reads — the rail the W8A8
     compiled-bytes acceptance pin rides (tests/test_qmatmul.py).
     ``quant_mode`` rides cfg (static) and selects the dequant vs int8-MXU
-    contraction in the compiled program."""
+    contraction in the compiled program.
+
+    A third entry, ``chunk_prefill``, compiles the engine's continuation-
+    chunk prefill at ``prefill_chunk`` tokens against a 1-slot cache —
+    the prefill executable that READS the cache, so its bytes_accessed is
+    the rail the int8-KV prefill acceptance pin rides (``kv_quant=True``
+    streams int8 stripes instead of the bf16 read)."""
     import jax
     import jax.numpy as jnp
 
@@ -133,6 +166,17 @@ def cost_model_stats(
         decode, abs_params, abs_cache, tok1, lens, rng,
         label=f"proxy.decode[{model}]",
     )
+    chunk_len = max(min(int(prefill_chunk), max_seq - 1), 1)
+    chunk_fn = _build_chunk_prefill_fn(cfg, chunk_len)
+    abs_cache1 = jax.eval_shape(
+        lambda: init_kv_cache(cfg, 1, max_seq=max_seq, quantized=kv_quant)
+    )
+    ctoks = jax.ShapeDtypeStruct((1, chunk_len), jnp.int32)
+    coff = jax.ShapeDtypeStruct((), jnp.int32)
+    _, ch_stats = capture_compile_stats(
+        chunk_fn, abs_params, abs_cache1, ctoks, coff,
+        label=f"proxy.chunk_prefill[{model}]",
+    )
     # quant shapes BOTH the abstract tree (int8/packed-uint8 avals fed to
     # lower(), so the cost model prices the quantized weight stream) and
     # the analytic estimate below; quant_mode selects the contraction
@@ -147,6 +191,7 @@ def cost_model_stats(
         "kv_quant": kv_quant,
         "prefill": pf_stats.to_dict(),
         "decode": dec_stats.to_dict(),
+        "chunk_prefill": {**ch_stats.to_dict(), "chunk_len": chunk_len},
         "analytic": est,
     }
 
@@ -240,18 +285,25 @@ def run_proxy_tier(
     kv_quant: bool = False,
     quant_mode: str = "dequant",
     hbm_bytes: Optional[int] = None,
+    prefill_chunk: Optional[int] = None,
 ) -> dict[str, Any]:
     """The full proxy round: flagship cost model + headroom pre-flight +
     executed small-config step ratio. Returns the schema-valid ``proxy``
     block (core/schema.py ``validate_proxy``). ``quant_mode``/``kv_quant``
     label the block so dark rounds track QUANTIZED compile drift as their
     own trajectory points — a w8a8 regression must not hide behind a
-    dequant-round comparison."""
+    dequant-round comparison. ``prefill_chunk`` sizes the chunk-prefill
+    cost entry (the executable that READS the cache; the int8-KV prefill
+    rail) so sweeps can put the chunk size on an axis; None keeps the
+    default entry size but prices the headroom pre-flight monolithically
+    (chunking off in the serving config means the guard must not assume
+    the smaller per-chunk workspace)."""
     import jax
 
     cost = cost_model_stats(model, quant, slots, max_seq,
                             prompt_len=prompt_len, kv_quant=kv_quant,
-                            quant_mode=quant_mode)
+                            quant_mode=quant_mode,
+                            prefill_chunk=prefill_chunk or 64)
     execd = exec_proxy(exec_model, min(slots, 8), decode_steps)
     pf, dec = cost["prefill"], cost["decode"]
     block: dict[str, Any] = {
@@ -271,14 +323,17 @@ def run_proxy_tier(
         "compile_wall_s": round(pf["compile_wall_s"] + dec["compile_wall_s"], 4),
         "peak_bytes": max(pf["peak_bytes"], dec["peak_bytes"]),
         "step_count_ratio": execd["step_count_ratio"],
-        # full detail, per executable
-        "compile_stats": {"prefill": pf, "decode": dec},
+        # full detail, per executable (chunk_prefill: the continuation-
+        # chunk executable that reads the cache — the int8-KV prefill
+        # rail and the chunked-prefill sweep axis)
+        "compile_stats": {"prefill": pf, "decode": dec,
+                          "chunk_prefill": cost["chunk_prefill"]},
         "analytic_bytes": cost["analytic"],
         "exec": execd,
     }
     if hbm_bytes:
         block["hbm_headroom"] = serving_headroom_plan(
             model, slots, max_seq, quant, kv_quant, hbm_bytes,
-            quant_mode=quant_mode,
+            quant_mode=quant_mode, prefill_chunk=prefill_chunk,
         ).to_dict()
     return block

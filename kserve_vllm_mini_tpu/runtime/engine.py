@@ -225,6 +225,21 @@ class EngineConfig:
     max_seq_len: int = 1024           # per-request cap (cache length)
     max_prefill_len: int = 512
     min_prefill_bucket: int = 16
+    # Chunked prefill (ROADMAP item 3, the TTFT/ITL-tail fix): prompts
+    # whose un-reused remainder exceeds this many tokens are split into
+    # fixed-size chunks that the SCHEDULER advances one per iteration,
+    # interleaved with decode sweeps — a long prompt no longer freezes
+    # every streaming client behind one monolithic compile+execute. The
+    # chunk writes KV at its running offset (the same continuation-chunk
+    # executables the over-budget path already uses, so greedy streams
+    # stay byte-identical to monolithic admission) and only the final
+    # chunk's last-position logits feed sampling. None = monolithic
+    # admission (the seed behavior). Clamped into
+    # [min_prefill_bucket, max_prefill_len]; lockstep multihost engines
+    # ignore it (chunk advancement is a host-local scheduling decision
+    # the follower replay stream does not carry — same rule as deadline
+    # sheds).
+    prefill_chunk: Optional[int] = None
     seed: int = 0
     kv_cache_dtype: Optional[str] = None  # None -> model dtype (e.g. "float32")
     # How quantized matmul leaves contract (ops/qmatmul.py QUANT_MODES):
@@ -498,6 +513,21 @@ class Engine:
         self.ecfg.max_prefill_len = min(
             self.ecfg.max_prefill_len, self.ecfg.max_seq_len - 1
         )
+        if self.ecfg.prefill_chunk is not None:
+            if self.ecfg.prefill_chunk < 1:
+                raise ValueError(
+                    f"prefill_chunk={self.ecfg.prefill_chunk} must be >= 1 "
+                    "(or None to disable chunked prefill)"
+                )
+            # a chunk below one bucket would pad up to the bucket anyway;
+            # above max_prefill_len it is the monolithic budget — and a
+            # non-bucket value is rounded UP to the bucket its pieces
+            # would compile at, so no piece carries permanent pad waste
+            # (and the headroom estimate prices the real executable width)
+            self.ecfg.prefill_chunk = self._bucket(min(
+                max(self.ecfg.prefill_chunk, self.ecfg.min_prefill_bucket),
+                self.ecfg.max_prefill_len,
+            ))
         self.mesh = mesh
         self.pad_id = pad_id
         self.params = params
@@ -647,6 +677,19 @@ class Engine:
         self._drafter_cfg: Optional[ModelConfig] = None
         if drafter is not None:
             self._drafter_params, self._drafter_cfg = drafter
+            if (
+                self.ecfg.quant_mode != "dequant"
+                and self._drafter_cfg.quant_mode != self.ecfg.quant_mode
+            ):
+                # speculative decoding and quantization COMPOSE: the
+                # drafter's projections ride the same quant_mode as the
+                # target (w8a8 = int8 x int8 on the MXU when its leaves
+                # are quantized; a documented no-op on plain weights —
+                # ops/quant.linear), so spec rounds stream the drafter's
+                # int8 bytes instead of silently excluding each other
+                self._drafter_cfg = self._drafter_cfg.scaled(
+                    quant_mode=self.ecfg.quant_mode
+                )
             self._dcache = init_kv_cache(
                 self._drafter_cfg, S, max_seq=self.ecfg.max_seq_len,
                 dtype=kv_dt, quantized=kv_quant,
@@ -707,6 +750,20 @@ class Engine:
         # slot is freed — matched against new prompts at admission
         self._slot_tokens: list[list[int]] = [[] for _ in range(S)]
         self._retained: list[list[int]] = [[] for _ in range(S)]
+
+        # chunked-prefill state (EngineConfig.prefill_chunk): a slot whose
+        # prompt is being chunk-prefilled is OCCUPIED (_slot_req set, so
+        # cancellation / watchdog / drain all see its handle) but not
+        # decode-ACTIVE — _decode_active() excludes it until the final
+        # chunk's logits feed sampling. _slot_len doubles as the prefill
+        # FRONTIER while the entry is live: concurrent sweeps' garbage
+        # writes land at >= the frontier and the next chunk overwrites
+        # them (dispatch order) before they can ever be attended.
+        # _prefill_fifo orders advancement: the OLDEST admission advances
+        # one chunk per scheduler iteration (completion order matches the
+        # monolithic path's serial admissions). Scheduler-thread-only.
+        self._slot_prefill: list[Optional[dict]] = [None] * S
+        self._prefill_fifo: list[int] = []
 
         self._pending: "queue.Queue[RequestHandle]" = queue.Queue()
         self._rng = jax.random.PRNGKey(self.ecfg.seed)
@@ -817,6 +874,14 @@ class Engine:
             "decode_tokens": 0,
             "decode_steps": 0,
             "prefills": 0,
+            # chunked-prefill telemetry (ROADMAP item 3): compiled prefill
+            # piece dispatches (target + drafter shadow, monolithic and
+            # chunked admissions alike), and the prefill wall that ran
+            # while decode work was live — the direct measurement of the
+            # stall chunking exists to break up (docs/TROUBLESHOOTING.md
+            # "Long prompts stall streaming")
+            "prefill_chunks": 0,
+            "prefill_chunk_stall_s": 0.0,
             "requests_completed": 0,
             "busy_s": 0.0,        # exported: busy_seconds_total + duty_cycle
             "started_at": time.time(),  # kvmini: metrics-ok — raw input; exposed as duty_cycle
@@ -890,6 +955,7 @@ class Engine:
         analytic = estimate_serving_bytes(
             cfg, S, self.ecfg.max_seq_len, kv_quant=kv_quant,
             quant_mode=cfg.quant_mode,
+            prefill_chunk=self.ecfg.prefill_chunk,
         )
         kv_bytes = S * self.ecfg.max_seq_len * self.kv_bytes_per_token()
         n_dev = self.mesh.size if self.mesh is not None else 1
@@ -1875,69 +1941,136 @@ class Engine:
             self._hit_depths.append(best_k)
         return slot, best_k
 
-    def _prefill_chunks(self, prompt: list[int], slot: int, draft: bool = False,
-                        start_offset: int = 0, adapter_idx: int = 0):
-        """Run the prompt through the slot's cache: chunk 0 on the flash
-        fresh-prefill path, continuation chunks (prompts longer than
-        max_prefill_len, or the suffix after a reused prefix) on the
-        positional-masked chunk path. Returns the last real position's
-        logits [V] f32. ``adapter_idx`` picks the request's LoRA adapter
-        (0 = base) when the engine carries a bank."""
-        budget = self.ecfg.max_prefill_len
+    def _prefill_piece(self, piece: list[int], slot: int, off: int,
+                       draft: bool = False, adapter_idx: int = 0):
+        """ONE compiled prefill dispatch: ``piece`` written at absolute
+        offset ``off`` of the slot's cache — offset 0 on the flash
+        fresh-prefill path, continuation pieces on the positional-masked
+        chunk path (int8-KV caches stream through the cached-prefill
+        kernel on TPU, ops/flash_attention.py). Returns the piece's
+        last-position logits [V] f32. ``adapter_idx`` picks the request's
+        LoRA adapter (0 = base) when the engine carries a bank."""
         params = self._drafter_params if draft else self.params
-        n = len(prompt)
-        last_logits = None
-        off = start_offset
+        m = len(piece)
+        bucket = self._bucket(m)
+        toks = piece + [self.pad_id] * (bucket - m)
+        tokens = jnp.asarray(toks, dtype=jnp.int32)[None]
         lkw = {}
         if self._lora is not None and not draft:
             lkw = {
                 "lora": self._lora["layers"],
                 "ids": jnp.asarray([adapter_idx], jnp.int32),
             }
-        while off < n:
-            piece = prompt[off : off + budget]
-            m = len(piece)
-            bucket = self._bucket(m)
-            toks = piece + [self.pad_id] * (bucket - m)
-            tokens = jnp.asarray(toks, dtype=jnp.int32)[None]
-            cache_in = self._dcache if draft else self._cache
-            if self.paged:
-                trow = jnp.asarray(self._block_table[slot : slot + 1])
-                if off == 0:
-                    fn = self._get_paged_prefill_fn(bucket)
-                    cache, last_logits = fn(
-                        params, cache_in, tokens, jnp.int32(m), trow, **lkw
-                    )
-                else:
-                    fn = self._get_paged_chunk_prefill_fn(bucket)
-                    cache, last_logits = fn(
-                        params, cache_in, tokens,
-                        jnp.int32(m), jnp.int32(off), trow, **lkw,
-                    )
-            elif off == 0:
-                fn = self._get_prefill_fn(bucket, draft=draft)
+        cache_in = self._dcache if draft else self._cache
+        if self.paged:
+            trow = jnp.asarray(self._block_table[slot : slot + 1])
+            if off == 0:
+                fn = self._get_paged_prefill_fn(bucket)
                 cache, last_logits = fn(
-                    params, cache_in, tokens, jnp.int32(m), jnp.int32(slot),
-                    **lkw,
+                    params, cache_in, tokens, jnp.int32(m), trow, **lkw
                 )
             else:
-                fn = self._get_chunk_prefill_fn(bucket, draft=draft)
+                fn = self._get_paged_chunk_prefill_fn(bucket)
                 cache, last_logits = fn(
                     params, cache_in, tokens,
-                    jnp.int32(m), jnp.int32(slot), jnp.int32(off), **lkw,
+                    jnp.int32(m), jnp.int32(off), trow, **lkw,
                 )
-            if draft:
-                self._dcache = cache
-            else:
-                self._cache = cache
-            off += m
+        elif off == 0:
+            fn = self._get_prefill_fn(bucket, draft=draft)
+            cache, last_logits = fn(
+                params, cache_in, tokens, jnp.int32(m), jnp.int32(slot),
+                **lkw,
+            )
+        else:
+            fn = self._get_chunk_prefill_fn(bucket, draft=draft)
+            cache, last_logits = fn(
+                params, cache_in, tokens,
+                jnp.int32(m), jnp.int32(slot), jnp.int32(off), **lkw,
+            )
+        if draft:
+            self._dcache = cache
+        else:
+            self._cache = cache
         return last_logits
+
+    def _prefill_step(self, slot: int, st: dict, budget: int) -> bool:
+        """Advance one prefill piece for ``st`` (the per-slot chunked-
+        prefill state): target pieces first, then — once the target cache
+        holds the whole prompt — the drafter's shadow pieces. Blocks until
+        the dispatch completes so the stall accounting is honest wall
+        time, and counts the piece into ``prefill_chunks`` (and into
+        ``prefill_chunk_stall_s`` when decode work was live — the decode
+        tail this piece's execution stood in front of). Returns True when
+        every piece (target and draft) has run."""
+        handle = st["handle"]
+        prompt = handle.request.prompt_tokens
+        n = len(prompt)
+        draft = st["off"] >= n
+        off = st["draft_off"] if draft else st["off"]
+        piece = prompt[off : off + budget]
+        t0 = time.time()
+        last_logits = self._prefill_piece(
+            piece, slot, off, draft=draft, adapter_idx=st["adapter_idx"]
+        )
+        jax.block_until_ready(last_logits)
+        wall = time.time() - t0
+        self.stats["busy_s"] += wall
+        self.stats["prefill_chunks"] += 1
+        if self._inflight or self._decode_active():
+            self.stats["prefill_chunk_stall_s"] += wall
+        if draft:
+            st["draft_off"] = off + len(piece)
+            st["draft_chunks"] += 1
+        else:
+            st["off"] = off + len(piece)
+            st["chunks"] += 1
+            st["logits"] = last_logits
+            if self._slot_prefill[slot] is not None:
+                # interleaved mode: advance the frontier so concurrent
+                # sweeps' garbage writes stay >= it (see _slot_prefill)
+                self._slot_len[slot] = st["off"]
+        return st["off"] >= n and (
+            st["draft_off"] is None or st["draft_off"] >= n
+        )
+
+    def _advance_prefills(self, on_decision=None) -> None:
+        """Advance the OLDEST in-progress chunked prefill by ONE piece
+        this scheduler iteration, so decode sweeps interleave with a long
+        prompt instead of stalling behind it (EngineConfig.prefill_chunk).
+        When the final piece lands, the slot is activated — sampled and
+        joined to the decode set — via _activate_slot. Head-of-line only:
+        FIFO completion order matches the monolithic path's serial
+        admissions."""
+        if not self._prefill_fifo:
+            return
+        slot = self._prefill_fifo[0]
+        st = self._slot_prefill[slot]
+        if st is None or st["handle"].cancelled is not None:
+            # cancelled mid-prefill: the cancel pass in _schedule_once
+            # aborts it (and pops the fifo) — nothing to advance here
+            return
+        if on_decision is not None:
+            # never reached in lockstep (chunked admission is gated off
+            # there), published for the decision-stream convention
+            on_decision(("prefill_chunk", st["handle"].request.request_id))
+        if self._prefill_step(slot, st, self.ecfg.prefill_chunk):
+            self._prefill_fifo.pop(0)
+            self._slot_prefill[slot] = None
+            if self._inflight:
+                # the slot joins the decode active set: in-flight sweeps
+                # were dispatched under the old set, and the global
+                # _pending_steps would misplace its first decode write —
+                # retire against settled state (the admission invariant)
+                self.stats["pipeline_fallback_active_set"] += 1
+                self._retire_all(on_decision)
+            self._activate_slot(slot, st)
 
     def cancel(self, handle: RequestHandle, reason: str = "stop") -> None:
         """Finish ``handle``'s generation early (thread-safe; effective at
         the scheduler's next iteration). Tokens already emitted stand; the
         'done' event carries ``reason``. A still-queued handle is finished
-        at admission instead of prefilling."""
+        at admission instead of prefilling; a handle mid-chunked-prefill
+        is aborted at the scheduler's next iteration (_abort_prefill)."""
         handle.cancelled = reason
 
     def _admit_one(self, handle: RequestHandle) -> None:
@@ -2021,11 +2154,58 @@ class Engine:
                 return
             adapter_idx = self._lora_names[req.adapter]
         n = len(req.prompt_tokens)
+        st = {
+            "handle": handle,
+            "off": reused,          # target-prefill frontier (next position)
+            "reused": reused,
+            "adapter_idx": adapter_idx,
+            "chunks": 0,            # target pieces dispatched
+            "draft_chunks": 0,      # drafter shadow pieces dispatched
+            # None = no drafter shadow prefill; 0 = pending from offset 0
+            # (the drafter cache never carries a reused prefix —
+            # prefix_cache and drafters are mutually exclusive)
+            "draft_off": (
+                0 if self._drafter_params is not None
+                and self.ecfg.spec_tokens > 0 else None
+            ),
+            "logits": None,         # last target piece's [V] f32 logits
+        }
+        chunk = self.ecfg.prefill_chunk
+        if chunk is not None and not self._lockstep and n - reused > chunk:
+            # interleaved chunked prefill: occupy the slot now, advance
+            # one piece per scheduler iteration (_advance_prefills) so
+            # decode sweeps ride between pieces; sampling happens when
+            # the final piece lands (_activate_slot)
+            self._slot_req[slot] = handle
+            self._slot_len[slot] = reused  # prefill frontier (see init)
+            if self.ecfg.prefix_cache and not self.paged:
+                # rows past the reused prefix are being overwritten with
+                # THIS prompt's KV: the old occupant's retained match
+                # must not outlive its rows (an abort mid-prefill re-
+                # retains the new prompt up to the frontier instead)
+                self._retained[slot] = list(req.prompt_tokens[:reused])
+            self._slot_prefill[slot] = st
+            self._prefill_fifo.append(slot)
+            return
+        # monolithic admission: every piece back-to-back (budget =
+        # max_prefill_len), then the drafter's shadow pieces, then sample
+        while not self._prefill_step(slot, st, self.ecfg.max_prefill_len):
+            pass
+        self._activate_slot(slot, st)
+
+    def _activate_slot(self, slot: int, st: dict) -> None:
+        """Prefill is complete: sample the first token from the final
+        piece's last-position logits and join the slot to the decode set.
+        The shared tail of monolithic admission (_admit_one) and chunked-
+        prefill completion (_advance_prefills). Callers settle the
+        in-flight pipeline first: _schedule_once retires before admitting
+        and _advance_prefills retires before activating."""
+        handle: RequestHandle = st["handle"]
+        req = handle.request
+        n = len(req.prompt_tokens)
+        reused = st["reused"]
+        last_logits = st["logits"]
         t0 = time.time()
-        last_logits = self._prefill_chunks(
-            req.prompt_tokens, slot, start_offset=reused,
-            adapter_idx=adapter_idx,
-        )
         # first token: sampled from the prompt's last-position logits,
         # grammar-masked when the request is constrained
         machine = req.constraint
@@ -2047,10 +2227,6 @@ class Engine:
             jnp.bool_(machine is not None),
         )
         first_id = int(first_tok)
-        if self._drafter_params is not None and self.ecfg.spec_tokens > 0:
-            # drafter prefills the same prompt into its own cache so it can
-            # propose from full context; its output logits are unused
-            self._prefill_chunks(req.prompt_tokens, slot, draft=True)
         self.stats["busy_s"] += time.time() - t0
         self.stats["prefills"] += 1
         # only tokens actually prefilled: reused prefix tokens are counted
@@ -2059,12 +2235,14 @@ class Engine:
 
         handle.t_first_token = time.time()
         # prefill phase: admission -> first sampled token (chunked prefill
-        # and the drafter's shadow prefill included)
+        # and the drafter's shadow prefill included; for interleaved
+        # chunking this span also contains the decode sweeps that rode
+        # between pieces — the request's real TTFT anatomy)
         self._observe_phase("prefill", handle.t_first_token - handle.t_admit)
         self._trace_span(
             handle, "server.prefill", handle.t_admit, handle.t_first_token,
             attrs={"prompt_tokens": n, "reused_prefix_tokens": reused,
-                   "slot": slot},
+                   "slot": slot, "prefill_chunks": st["chunks"]},
         )
         handle.tokens.append(first_id)
         lp_info = None
@@ -2083,7 +2261,7 @@ class Engine:
         self._last_tokens[slot] = first_id
         self._tokens_dev = None  # host mutation: device token carry is stale
         self._slot_machine[slot] = machine
-        self._slot_adapter[slot] = adapter_idx
+        self._slot_adapter[slot] = st["adapter_idx"]
         self._adapter_ids_dev = None
         # rows 0..n-1 now hold the prompt's KV; emitted tokens append as
         # their KV lands (fed on the next step)
@@ -2104,6 +2282,46 @@ class Engine:
         hit_eos = req.eos_id is not None and first_id == req.eos_id
         if self._slot_remaining[slot] <= 0 or hit_eos:
             self._finish_slot(slot, "stop" if hit_eos else "length")
+
+    def _abort_prefill(self, slot: int, reason: str) -> None:
+        """Finish a slot that was cancelled (or drained) MID-chunked-
+        prefill: no token was ever sampled, so the whole occupancy is the
+        prefill phase and the stream ends with zero tokens. With the
+        dense APC on, the rows already written hold THIS prompt's KV up
+        to the frontier — retain that (exact) prefix rather than the old
+        occupant's overwritten one."""
+        handle = self._slot_req[slot]
+        st = self._slot_prefill[slot]
+        handle.t_done = time.time()
+        handle.finish_reason = reason
+        self._observe_phase("prefill", handle.t_done - handle.t_admit)
+        self._trace_span(
+            handle, "server.prefill", handle.t_admit, handle.t_done,
+            ok=False,
+            attrs={"cancelled": reason,
+                   "prefill_chunks": st["chunks"] if st else 0},
+        )
+        handle.events.put(("done", {
+            "finish_reason": reason,
+            "tokens_out": 0,
+            "truncated": handle.request.truncated,
+            "truncated_tokens": handle.request.truncated_tokens,
+        }))
+        self.stats["requests_completed"] += 1
+        if self.ecfg.prefix_cache and not self.paged:
+            self._retained[slot] = list(
+                handle.request.prompt_tokens[: self._slot_len[slot]]
+            )
+        self._release_slot(slot)
+
+    def _decode_active(self) -> list[int]:
+        """Slots with a live request that is PAST prefill — the set decode
+        sweeps cover. A slot mid-chunked-prefill is occupied but excluded
+        until _activate_slot samples its first token."""
+        return [
+            i for i in range(self.ecfg.max_slots)
+            if self._slot_req[i] is not None and self._slot_prefill[i] is None
+        ]
 
     def _get_sampling_arrays(self) -> tuple:
         if self._sampling_arrays is None:
@@ -2186,6 +2404,12 @@ class Engine:
         for discarded garbage."""
         self._slot_req[slot] = None
         self._slot_machine[slot] = None
+        if self._slot_prefill[slot] is not None:
+            # releasing a slot mid-chunked-prefill (abort, fault recovery,
+            # drain): drop the advancement state with it
+            self._slot_prefill[slot] = None
+            if slot in self._prefill_fifo:
+                self._prefill_fifo.remove(slot)
         if self.paged:
             self._paged_release(slot)
         self._slot_adapter[slot] = 0
@@ -2328,8 +2552,7 @@ class Engine:
         instead; this remains the fallback for spec partitions and
         grammar-constrained slots, and the follower replay target for the
         ('sweep',) decision."""
-        S = self.ecfg.max_slots
-        active = [i for i in range(S) if self._slot_req[i] is not None]
+        active = self._decode_active()
         if not active:
             return
         spec_slots, plain_slots = self._spec_partition(active)
@@ -2564,9 +2787,9 @@ class Engine:
             # emission ran while the device computed the next sweep — the
             # host time the synchronous loop would have serialized
             self.stats["host_overlap_s"] += t_emitted - t_ready
-        any_active = any(h is not None for h in self._slot_req)
+        any_active = bool(self._decode_active())
         if not any_active and self._inflight:
-            # every slot finished: younger sweeps computed only garbage.
+            # every decode slot finished: younger sweeps computed only garbage.
             # Rewind to the oldest dropped sweep's pre-dispatch rng (their
             # counts/KV pollution sits in freed rows, reset at admission).
             self._rng = self._inflight[0]["rng_prev"]
@@ -2595,10 +2818,7 @@ class Engine:
         # exactly what the watchdog watches for. The sleep runs outside
         # the registry lock.
         self._faults.stall("sweep_stall")
-        active = [
-            i for i in range(self.ecfg.max_slots)
-            if self._slot_req[i] is not None
-        ]
+        active = self._decode_active()
         ok, reason = self._pipeline_eligible(active)
         if not ok and reason is not None:
             # counted per sweep iteration on pipeline-enabled engines: how
@@ -2626,11 +2846,7 @@ class Engine:
         """Multihost follower side of a published ('dispatch',): the
         active set is deterministic from the replayed decision stream, so
         operands and jitted-call order match the primary's."""
-        active = [
-            i for i in range(self.ecfg.max_slots)
-            if self._slot_req[i] is not None
-        ]
-        self._dispatch_plain(active)
+        self._dispatch_plain(self._decode_active())
 
     def _masked_sweep(self, active: list[int], constrained: list[int]) -> None:
         """Grammar-constrained decode sweep: single step, synchronous —
@@ -2702,7 +2918,7 @@ class Engine:
             attrs={"chunk": 1, "slots": len(active), "mode": "masked"},
         )
         self._observe_phase("emit", time.time() - now)
-        if any(h is not None for h in self._slot_req):
+        if self._decode_active():
             self._bubble_anchor = now
 
     def _fail_all(self, exc: BaseException) -> None:
@@ -2714,6 +2930,10 @@ class Engine:
         self._inflight.clear()
         self._pending_steps = 0
         self._tokens_dev = None
+        # half-prefilled slots die with it too (their handles error below
+        # through the same _slot_req sweep)
+        self._slot_prefill = [None] * self.ecfg.max_slots
+        self._prefill_fifo.clear()
         for slot in range(self.ecfg.max_slots):
             h = self._slot_req[slot]
             if h is not None:
@@ -2774,7 +2994,15 @@ class Engine:
             if h is not None and h.cancelled is not None:
                 if on_decision is not None:
                     on_decision(("cancel", h.request.request_id, h.cancelled))
-                self._finish_slot(slot, h.cancelled)
+                if self._slot_prefill[slot] is not None:
+                    # cancelled mid-chunked-prefill: no token was ever
+                    # sampled — abort without a decode span or a sweep
+                    self._abort_prefill(slot, h.cancelled)
+                else:
+                    # the ("cancel") decision published above covers this
+                    # branch too — it only selects the finish shape
+                    # kvmini: lockstep-ok — see above
+                    self._finish_slot(slot, h.cancelled)
 
         admitted = False
         while self._free:
@@ -2816,7 +3044,11 @@ class Engine:
         live_now = [h for h in self._slot_req if h is not None]
         with self._res_lock:
             self._live_handles = live_now
-        if any(h is not None for h in self._slot_req):
+        # chunked prefill rides BETWEEN decode sweeps: one piece of the
+        # oldest in-progress prompt per iteration (docs/TROUBLESHOOTING.md
+        # "Long prompts stall streaming")
+        self._advance_prefills(on_decision)
+        if self._decode_active():
             self._sweep_phase(on_decision)
         elif not admitted:
             if self._inflight:
@@ -2825,6 +3057,10 @@ class Engine:
                 # on the freed slots) so the drop/rewind logic settles the
                 # pipeline before the engine idles
                 self._retire_all(on_decision)
+            if self._prefill_fifo:
+                # chunks still pending with no decode work: loop again
+                # immediately — the next iteration advances the next piece
+                return
             try:
                 handle = self._pending.get(timeout=0.02)
             except queue.Empty:
@@ -2905,10 +3141,14 @@ class Engine:
             if h.request.request_id in faulted:
                 # the watchdog already sent this handle its terminal
                 # event — release the slot without a second 'done'
+                # (_release_slot also drops any chunked-prefill state)
                 self._release_slot(slot)
                 continue
             h.cancelled = h.cancelled or "cancelled"
-            self._finish_slot(slot, h.cancelled)
+            if self._slot_prefill[slot] is not None:
+                self._abort_prefill(slot, h.cancelled)
+            else:
+                self._finish_slot(slot, h.cancelled)
         if self.paged and self._deferred is not None:
             # the backpressure-held head-of-line handle sits in neither
             # a slot nor _pending — it must drain too

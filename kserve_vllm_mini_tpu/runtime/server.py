@@ -47,6 +47,9 @@ def build_engine(
                                    # "w8a8" = int8 MXU contraction
     kv_cache_dtype: Optional[str] = None,
     decode_chunk: int = 1,
+    prefill_chunk: Optional[int] = None,  # tokens per interleaved prefill
+                                   # chunk (EngineConfig.prefill_chunk);
+                                   # None = monolithic admission
     drafter: Optional[str] = None,
     spec_tokens: int = 0,
     pp: int = 0,
@@ -201,12 +204,24 @@ def build_engine(
         if os.path.isdir(drafter):
             from kserve_vllm_mini_tpu.models.loader import load_hf_checkpoint
 
-            dparams, dcfg = load_hf_checkpoint(drafter)
+            # the drafter rides the target's quantization: spec decode and
+            # quantization compose (the engine folds quant_mode into the
+            # drafter cfg too, so w8a8 rounds contract the drafter int8)
+            dparams, dcfg = load_hf_checkpoint(
+                drafter,
+                quantize="none" if quantization == "int4-awq" else quantization,
+            )
         else:
             dcfg = get_config(drafter)
             if tok.vocab_size > dcfg.vocab_size:
                 dcfg = dcfg.scaled(vocab_size=tok.vocab_size)
-            dparams = init_params(jax.random.PRNGKey(seed + 1), dcfg)
+            if quantization in ("int8", "int4"):
+                dparams = init_params_quantized(
+                    jax.random.PRNGKey(seed + 1), dcfg,
+                    bits=4 if quantization == "int4" else 8,
+                )
+            else:
+                dparams = init_params(jax.random.PRNGKey(seed + 1), dcfg)
         if dcfg.vocab_size != cfg.vocab_size:
             raise ValueError(
                 f"drafter vocab {dcfg.vocab_size} != target vocab "
@@ -268,6 +283,7 @@ def build_engine(
         kv_cache_dtype=kv_cache_dtype,
         quant_mode=quant_mode,
         decode_chunk=decode_chunk,
+        prefill_chunk=prefill_chunk,
         spec_tokens=spec_tokens if drafter_pair is not None else 0,
         pp_microbatches=pp_microbatches,
         prefix_cache=prefix_cache,
@@ -1255,6 +1271,14 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
             f"kvmini_tpu_decode_steps_total {s['decode_steps']}",
             "# TYPE kvmini_tpu_prefills_total counter",
             f"kvmini_tpu_prefills_total {s['prefills']}",
+            # chunked-prefill rail (docs/TROUBLESHOOTING.md "Long prompts
+            # stall streaming"): compiled prefill piece dispatches, and
+            # the prefill wall that ran while decode work was live
+            "# TYPE kvmini_tpu_prefill_chunks_total counter",
+            f"kvmini_tpu_prefill_chunks_total {s['prefill_chunks']}",
+            "# TYPE kvmini_tpu_prefill_chunk_stall_seconds_total counter",
+            "kvmini_tpu_prefill_chunk_stall_seconds_total "
+            f"{s['prefill_chunk_stall_s']:.6f}",
             # decode-pipeline telemetry (docs/DECODE_PIPELINE.md): depth >= 2
             # + low bubble = the double-buffered steady state is engaged
             "# TYPE kvmini_tpu_dispatch_depth gauge",
@@ -1590,6 +1614,13 @@ def register(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--decode-chunk", type=int, default=1,
                         help="Decode steps fused per dispatch (throughput vs "
                              "streaming granularity)")
+    parser.add_argument("--prefill-chunk", type=int, default=None,
+                        help="Tokens per interleaved prefill chunk: prompts "
+                             "above this threshold are chunk-prefilled "
+                             "BETWEEN decode sweeps instead of stalling "
+                             "them behind one monolithic call (TTFT/ITL "
+                             "tail; docs/TROUBLESHOOTING.md). Default: "
+                             "$KVMINI_PREFILL_CHUNK or monolithic")
     parser.add_argument("--drafter", default=None,
                         help="Drafter model preset/checkpoint for speculative "
                              "decoding (default: $KVMINI_DRAFTER)")
@@ -1736,6 +1767,10 @@ def run(args: argparse.Namespace) -> int:
     spec_tokens = args.spec_tokens
     if spec_tokens is None:
         spec_tokens = int(os.environ.get("KVMINI_SPEC_TOKENS", "4" if drafter else "0"))
+    prefill_chunk = args.prefill_chunk
+    if prefill_chunk is None:
+        env_pc = os.environ.get("KVMINI_PREFILL_CHUNK")
+        prefill_chunk = int(env_pc) if env_pc else None
     faults = args.faults or os.environ.get("KVMINI_FAULTS") or None
     fault_seed = (
         args.fault_seed
@@ -1812,6 +1847,7 @@ def run(args: argparse.Namespace) -> int:
         tokenizer_path=args.tokenizer,
         max_slots=max_slots,
         decode_chunk=args.decode_chunk,
+        prefill_chunk=prefill_chunk,
         max_seq_len=max_seq,
         topology=args.topology or os.environ.get("KVMINI_TOPOLOGY") or None,
         pp=pp,

@@ -125,8 +125,12 @@ def make_app(
         tracer.record("server.queue", tid, t_arrive_ns, q_end,
                       parent_span_id=parent,
                       attrs={"traceparent": header})
+        # prefill_chunks rides the span like the real engine's
+        # _activate_slot stamp (docs/TROUBLESHOOTING.md "Long prompts
+        # stall streaming") so bench-smoke can pin the attribute contract
         tracer.record("server.prefill", tid, q_end, t_first_ns,
-                      parent_span_id=parent)
+                      parent_span_id=parent,
+                      attrs={"prefill_chunks": 1})
         tracer.record("server.decode", tid, t_first_ns, t_done_ns,
                       parent_span_id=parent)
         phase_hist["queue"].observe((q_end - t_arrive_ns) / 1e9)
@@ -341,6 +345,10 @@ def make_app(
         "kvmini_tpu_pipelined_sweeps_total": 40.0,
         "kvmini_tpu_host_overlap_seconds_total": 0.25,
         "kvmini_tpu_bubble_seconds_total": 0.01,
+        # chunked-prefill rail (docs/TROUBLESHOOTING.md)
+        "kvmini_tpu_prefills_total": 4.0,
+        "kvmini_tpu_prefill_chunks_total": 6.0,
+        "kvmini_tpu_prefill_chunk_stall_seconds_total": 0.125,
         # monitor-facing gauges/counters (docs/MONITORING.md) so the 1 Hz
         # sampler's timeline has runtime series without a JAX engine
         "kvmini_tpu_duty_cycle": 0.8,

@@ -71,6 +71,12 @@ def test_bench_smoke_surfaces_pipeline_counters(tmp_path):
         persisted = json.loads(run_dir.results_json.read_text())
         assert persisted["pipeline_dispatch_depth"] == 2.0
 
+        # ISSUE 11: the chunked-prefill counters rode the same scrape
+        # into their typed results keys (absent-not-zero for external
+        # engines; the mock exports the rail like runtime/server.py)
+        assert persisted["prefill_chunks"] == 6.0
+        assert persisted["prefill_chunk_stall_s"] == 0.125
+
         # ISSUE 6: the compile-stats block rode the same scrape into the
         # typed results key (external-endpoint path; self-serve runs get
         # the richer direct snapshot with per-executable entries)
@@ -98,6 +104,14 @@ def test_bench_smoke_surfaces_pipeline_counters(tmp_path):
         names = {s["name"] for _svc, s in spans_from_otlp(merged)}
         assert {"http.request", "server.queue", "server.prefill",
                 "server.decode"} <= names
+        # ISSUE 11: server.prefill spans carry chunk counts (the engine's
+        # _activate_slot attribute contract, echoed by the mock)
+        pf_spans = [s for _svc, s in spans_from_otlp(merged)
+                    if s["name"] == "server.prefill"]
+        assert pf_spans
+        for span in pf_spans:
+            attrs = {a["key"]: a for a in span.get("attributes", [])}
+            assert "prefill_chunks" in attrs
 
         # ISSUE 4: the run carried the live monitor — a schema-valid
         # monitor block in results.json plus timeline.jsonl on disk

@@ -99,6 +99,101 @@ def test_prefill_attention_flash_matches_jnp():
     assert float(jnp.max(jnp.abs(out - ref))) < 2e-5
 
 
+def test_cached_prefill_kernel_matches_oracle():
+    """Int8-KV cached-prefill kernel (ISSUE 11): in-kernel dequant over
+    the layer-stacked dense cache equals dequantize-then-attend with the
+    chunk's positional mask, at per-row offsets."""
+    import numpy as np
+
+    from kserve_vllm_mini_tpu.ops.flash_attention import cached_prefill_attention
+
+    rng = np.random.default_rng(0)
+    L, B, KVH, S, D, H, T = 3, 2, 2, 64, 32, 4, 16
+    kq = jnp.asarray(rng.integers(-127, 128, size=(L, B, KVH, S, D)), jnp.int8)
+    vq = jnp.asarray(rng.integers(-127, 128, size=(L, B, KVH, S, D)), jnp.int8)
+    ks = jnp.asarray(rng.uniform(0.01, 0.1, size=(L, B, KVH, S)).astype(np.float32))
+    vs = jnp.asarray(rng.uniform(0.01, 0.1, size=(L, B, KVH, S)).astype(np.float32))
+    q = jnp.asarray(rng.standard_normal((B, H, T, D)).astype(np.float32))
+    offsets = jnp.asarray([5, 37], jnp.int32)  # mid-chunk, near the end
+    lidx = 1
+
+    out = cached_prefill_attention(q, kq, vq, offsets, layer=lidx,
+                                   k_scale=ks, v_scale=vs, interpret=True)
+    kf = kq[lidx].astype(jnp.float32) * ks[lidx][..., None]
+    vf = vq[lidx].astype(jnp.float32) * vs[lidx][..., None]
+    kj = jnp.arange(S)[None, None, :]
+    qi = (offsets[:, None] + jnp.arange(T)[None, :])[:, :, None]
+    mask = (kj <= qi)[:, None, :, :]
+    ref = attention(q, kf, vf, mask)
+    assert float(jnp.max(jnp.abs(out - ref))) < 2e-5
+
+
+def test_cached_prefill_kernel_unquantized_path():
+    """The same kernel body without scales (bf16/f32 cache stripes) — the
+    quantized flag only adds the dequant folds."""
+    from kserve_vllm_mini_tpu.ops.flash_attention import cached_prefill_attention
+
+    L, B, KVH, S, D, H, T = 2, 1, 2, 128, 32, 4, 32
+    k = _rand((L, B, KVH, S, D), 30)
+    v = _rand((L, B, KVH, S, D), 31)
+    q = _rand((B, H, T, D), 32)
+    offsets = jnp.asarray([64], jnp.int32)
+    out = cached_prefill_attention(q, k, v, offsets, layer=0, interpret=True)
+    kj = jnp.arange(S)[None, None, :]
+    qi = (offsets[:, None] + jnp.arange(T)[None, :])[:, :, None]
+    ref = attention(q, k[0], v[0], (kj <= qi)[:, None, :, :])
+    assert float(jnp.max(jnp.abs(out - ref))) < 2e-5
+
+
+def test_cached_prefill_blocks_helper():
+    from kserve_vllm_mini_tpu.ops.flash_attention import cached_prefill_blocks
+
+    assert cached_prefill_blocks(128, 1024) == (128, 128)
+    assert cached_prefill_blocks(16, 64) == (16, 64)
+    assert cached_prefill_blocks(32, 24) == (32, 8)
+    assert cached_prefill_blocks(256, 512) == (128, 128)
+    assert cached_prefill_blocks(8, 128) is None    # chunk below a tile
+    assert cached_prefill_blocks(100, 128) is None  # ragged chunk axis
+    assert cached_prefill_blocks(32, 7) is None     # untileable cache axis
+
+
+def test_model_chunk_kernel_matches_eager_path():
+    """Forced cached-prefill kernel through the model's int8-KV
+    continuation-chunk path agrees with the eager dequantize-on-read
+    oracle (same tolerance contract as the dense decode kernel's model
+    test): chunk 0 fresh, chunk 1 attending chunk 0's cached int8 KV."""
+    import numpy as np
+
+    from kserve_vllm_mini_tpu.models import llama
+    from kserve_vllm_mini_tpu.models.config import get_config
+    from kserve_vllm_mini_tpu.models.llama import forward, init_kv_cache, init_params
+
+    cfg = get_config("llama-tiny", max_seq_len=64)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (1, 32), 0, cfg.vocab_size)
+
+    def run(force):
+        old = llama._FORCE_CHUNK_KERNEL
+        llama._FORCE_CHUNK_KERNEL = force
+        try:
+            cache = init_kv_cache(cfg, 1, max_seq=64, quantized=True)
+            p0 = jnp.arange(16, dtype=jnp.int32)[None]
+            _lg, cache = forward(params, cfg, toks[:, :16], p0, cache,
+                                 jnp.zeros((1,), jnp.int32),
+                                 fresh_prefill=True)
+            p1 = 16 + jnp.arange(16, dtype=jnp.int32)[None]
+            lg, _cache = forward(params, cfg, toks[:, 16:], p1, cache,
+                                 jnp.full((1,), 16, jnp.int32))
+        finally:
+            llama._FORCE_CHUNK_KERNEL = old
+        return np.asarray(lg[:, -1, :])
+
+    eager = run(False)
+    kernel = run(True)
+    np.testing.assert_allclose(kernel, eager, rtol=3e-2, atol=3e-2)
+    np.testing.assert_array_equal(eager.argmax(-1), kernel.argmax(-1))
+
+
 def test_forward_fresh_prefill_matches_cached():
     """The serving prefill's block-causal path (the one that dispatches to
     the Pallas kernel on TPU) must produce the same logits and cache as the

@@ -138,6 +138,8 @@ def _harness(slots=2, **ecfg_kw):
     eng._slot_len = [0] * slots
     eng._slot_tokens = [[] for _ in range(slots)]
     eng._retained = [[] for _ in range(slots)]
+    eng._slot_prefill = [None] * slots
+    eng._prefill_fifo = []
     eng._free = []
     eng._inflight = []
     eng._pending_steps = 0
