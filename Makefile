@@ -2,7 +2,7 @@
 # of native code — the TPU compute path is JAX/XLA compiled at runtime.
 PY ?= python
 
-.PHONY: help test test-fast test-policy lint lint-invariants fmt smoke bench bench-smoke bench-proxy-smoke chaos-smoke trajectory dashboards-validate helm-lint airgap clean
+.PHONY: help test test-fast test-policy lint lint-invariants lint-changed fmt smoke bench bench-smoke bench-proxy-smoke chaos-smoke trajectory dashboards-validate helm-lint airgap clean
 
 help:
 	@grep -E '^[a-z-]+:' Makefile | sed 's/:.*//' | sort | uniq
@@ -45,7 +45,7 @@ lint:
 	$(PY) -c "import yaml,glob;[list(yaml.safe_load_all(open(f))) for f in glob.glob('profiles/**/*.yaml',recursive=True)+glob.glob('policies/**/*.yaml',recursive=True)]"
 	$(PY) -c "import json,glob;[json.load(open(f)) for f in glob.glob('dashboards/*.json')]"
 
-lint-invariants:  ## kvmini-lint: jit purity, lockstep, metrics drift, thread safety, dtype flow, buffer lifecycle
+lint-invariants:  ## kvmini-lint: jit purity, lockstep, metrics drift, thread safety, dtype flow, buffer lifecycle, mesh/sharding, resource safety
 	# gates on lint-baseline.json: new findings fail, fixed-but-still-
 	# listed entries fail too (ratchet toward an empty baseline).
 	# Rule table: docs/LINTING.md. JAX-free; runs in ~9s. --timing prints
@@ -55,6 +55,13 @@ lint-invariants:  ## kvmini-lint: jit purity, lockstep, metrics drift, thread sa
 	# uploads as PR annotations — one run gates AND reports.
 	$(PY) -m kserve_vllm_mini_tpu.lint kserve_vllm_mini_tpu/ --timing \
 	  --timing-out lint-timing.json --sarif lint-results.sarif
+
+# the fast pre-commit loop: lint only files changed vs REF (default HEAD)
+# plus their cross-file importers. Directory-scan-only surfaces (KVM032
+# docs drift) stay full-scan — run `make lint-invariants` before merging.
+REF ?= HEAD
+lint-changed:  ## kvmini-lint over `git diff --name-only $(REF)` + importers
+	$(PY) -m kserve_vllm_mini_tpu.lint --changed $(REF)
 
 fmt:
 	$(PY) -m ruff format kserve_vllm_mini_tpu tests 2>/dev/null || true

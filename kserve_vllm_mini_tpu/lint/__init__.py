@@ -1,7 +1,7 @@
 """kvmini-lint — AST-based invariant checker for the repo's load-bearing
 conventions (docs/LINTING.md "Conventions kvmini-lint enforces").
 
-Seven checkers, all stdlib-``ast`` over a small cross-file fact index —
+Nine checkers, all stdlib-``ast`` over a small cross-file fact index —
 deliberately JAX-free so the lint gate runs anywhere the harness layers
 do (same contract as loadgen/analysis: no ``runtime`` extra required):
 
@@ -39,6 +39,18 @@ do (same contract as loadgen/analysis: no ``runtime`` extra required):
   paged-KV block lifecycle (double-free, use-after-free, retained-LRU
   claims without unpin) with suite-aware, exit-cancelling event
   ordering (lint/buffer_lifecycle.py).
+- **mesh & sharding consistency** (KVM081-KVM084): a mesh-axis fact
+  table from construction sites and shard_map scopes flags collectives
+  over unbound axes, ``PartitionSpec`` arity/axis-name mismatches,
+  hidden reshards (``device_put``/``with_sharding_constraint``) on
+  jit-dispatch hot paths, and donated buffers whose sharding changes
+  across the shard_map boundary (lint/mesh_flow.py).
+- **exception-path resource safety** (KVM091-KVM093): learned
+  acquire/release pairs (free-list pops, ``_release_slot``-style
+  releasers, lock/arm toggles) walked over each function's CFG — a
+  path leaking an acquire, a double release on one path, and a
+  ``finally`` re-raising past a pending release all fail
+  (lint/resource_paths.py).
 
 CLI: ``python -m kserve_vllm_mini_tpu.lint [paths...]`` — see __main__.py.
 Suppressions: ``# kvmini: <token>`` line comments (diagnostics.RULES maps

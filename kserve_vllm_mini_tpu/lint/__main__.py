@@ -19,7 +19,12 @@ from pathlib import Path
 
 from kserve_vllm_mini_tpu.lint import baseline as baseline_mod
 from kserve_vllm_mini_tpu.lint import sarif as sarif_mod
-from kserve_vllm_mini_tpu.lint.runner import normalize_families, run_lint
+from kserve_vllm_mini_tpu.lint.runner import (
+    changed_scan_paths,
+    counts_by_checker,
+    normalize_families,
+    run_lint,
+)
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -28,11 +33,19 @@ def main(argv: list[str] | None = None) -> int:
         description="kvmini-lint: AST invariant checker (jit purity, "
                     "lockstep determinism, metrics/schema drift, workload "
                     "surfacing, thread-safety/lock discipline, dtype-flow "
-                    "numerics, buffer lifecycle). See docs/LINTING.md for "
-                    "the rule table.",
+                    "numerics, buffer lifecycle, mesh/sharding consistency, "
+                    "exception-path resource safety). See docs/LINTING.md "
+                    "for the rule table.",
     )
     ap.add_argument("paths", nargs="*", type=Path,
                     help="files/dirs to lint (default: kserve_vllm_mini_tpu/)")
+    ap.add_argument("--changed", default=None, metavar="REF",
+                    help="scan only files that differ from git REF (plus "
+                         "their cross-file importers via the fact index) — "
+                         "the fast pre-commit loop (`make lint-changed`). "
+                         "Directory-scan-only surfaces (KVM032 docs drift) "
+                         "are skipped, same as any single-file scan; the "
+                         "baseline gate is restricted to the scanned files.")
     ap.add_argument("--family", action="append", default=None,
                     metavar="KVM0x",
                     help="run only this rule family (repeatable; e.g. "
@@ -92,9 +105,27 @@ def main(argv: list[str] | None = None) -> int:
     if not args.no_baseline and not args.write_baseline:
         baseline_path = args.baseline or Path("lint-baseline.json")
 
+    if args.changed is not None:
+        if args.write_baseline:
+            print("kvmini-lint: --write-baseline cannot be combined with "
+                  "--changed (the baseline must come from a full scan)",
+                  file=sys.stderr)
+            return 2
+        try:
+            subset = changed_scan_paths(Path.cwd(), paths, args.changed)
+        except RuntimeError as e:
+            print(f"kvmini-lint: --changed: {e}", file=sys.stderr)
+            return 2
+        if not subset:
+            print(f"kvmini-lint: no python files changed vs {args.changed} "
+                  "— nothing to lint")
+            return 0
+        paths = subset
+
     t0 = time.monotonic()
     result = run_lint(paths, doc_paths=docs, baseline_path=baseline_path,
-                      families=families)
+                      families=families,
+                      baseline_scope_to_paths=args.changed is not None)
     dt = time.monotonic() - t0
 
     if args.sarif is not None:
@@ -105,6 +136,11 @@ def main(argv: list[str] | None = None) -> int:
             "elapsed_s": round(dt, 3),
             "timings": result.timings,
             "findings": len(result.diagnostics),
+            # ms alone can't tell "fast because clean" from "fast because
+            # broken": the per-family counts ride along so the uploaded
+            # artifact shows what each checker actually produced
+            "findings_by_checker": counts_by_checker(
+                result.diagnostics, result.timings),
         }, indent=2) + "\n", encoding="utf-8")
 
     if args.write_baseline:

@@ -104,6 +104,34 @@ RULES: dict[str, Rule] = {
         Rule("KVM074", "retained-claim-no-unpin", "buffer-ok",
              "retained-LRU block claimed (refcount bumped) without popping "
              "it from the LRU — eviction can reap a block in active use"),
+        Rule("KVM081", "collective-unbound-axis", "mesh-ok",
+             "collective (psum/ppermute/all_gather/...) names a mesh axis "
+             "no enclosing shard_map scope binds — XLA fails late or "
+             "resolves against the wrong mesh"),
+        Rule("KVM082", "partition-spec-mismatch", "mesh-ok",
+             "PartitionSpec arity disagrees with the annotated array shape "
+             "/ the shard_map'd function's parameters, or names an axis no "
+             "mesh in the package declares"),
+        Rule("KVM083", "resharding-in-dispatch", "mesh-ok",
+             "device_put / with_sharding_constraint in a jit-dispatch hot "
+             "path — a hidden reshard (silent all-gather) on every decode "
+             "step; place data once at setup, or annotate the intent"),
+        Rule("KVM084", "donation-resharded", "mesh-ok",
+             "buffer donated by the enclosing jit changes sharding across "
+             "the shard_map boundary — the donation cannot alias and XLA "
+             "silently copies (HBM doubles exactly where donation was "
+             "meant to prevent it)"),
+        Rule("KVM091", "acquire-leaks-on-path", "resource-ok",
+             "a path (exception, early return, cancellation branch) exits "
+             "the function with an acquired resource (slot, KV block, "
+             "lock, file) neither released nor ownership-transferred"),
+        Rule("KVM092", "double-release-path", "resource-ok",
+             "one control-flow path reaches two releases of the same "
+             "resource — the second release frees another owner's handle"),
+        Rule("KVM093", "finally-reraise-skips-release", "resource-ok",
+             "a `finally` block can raise before a pending release in "
+             "the same block — whenever the raise fires, the release is "
+             "skipped on exactly the failure path that needed it"),
     ]
 }
 

@@ -347,6 +347,13 @@ class FactIndex:
         self.root = root
         self.modules: dict[str, ModuleFacts] = {}
         self.parse_errors: list[tuple[str, int, str]] = []
+        # False when the index covers only a slice of the package (a
+        # single-file or --changed scan): checkers whose rules reason
+        # from the ABSENCE of facts (an axis no scanned mesh binds, a
+        # function no scanned shard_map reaches) must stand down — the
+        # missing fact may live in an unscanned module. Same contract as
+        # the KVM032 docs-drift full-scan rule. run_lint sets it.
+        self.full_scan: bool = True
         # dotted module name -> repo-relative path (for import resolution)
         self._by_dotted: dict[str, str] = {}
         # call_sites is re-requested per taint-fixpoint round and again by

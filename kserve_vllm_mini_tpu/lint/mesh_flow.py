@@ -1,0 +1,761 @@
+"""KVM081-KVM084 — mesh & sharding consistency.
+
+Disaggregated prefill/decode (ROADMAP item 1) multiplies the number of
+``shard_map``/``pjit`` roots, named axes, and cross-mesh transfers — and
+every one of them can fail *silently*: a collective over an axis the
+enclosing mesh never bound, a ``PartitionSpec`` one entry short of the
+array's rank, or a ``device_put`` inside the decode dispatch path each
+lower to a wrong-but-running program whose only symptom is an all-gather
+in the profile. These rules make the mesh contract loud at lint time.
+
+The checker builds a **mesh-axis fact table** and never guesses:
+
+- **Construction sites**: ``Mesh(devices, ("dp", "tp"))`` and the repo's
+  ``make_mesh``/``mesh_for_topology`` factories. Axis tuples resolve from
+  literals or module-level constants (``AXES`` in parallel/mesh.py),
+  through ``from``-imports. Functions *returning* a constructed mesh are
+  mesh sources themselves (small fixpoint, like returns_jitted).
+- **Mesh-typed params** (name ``mesh`` or a ``Mesh`` annotation) join the
+  axis sets their resolved callsites feed in — union over resolved
+  sites; a site the resolver cannot evaluate leaves the set *partial*
+  rather than poisoning it (all of this repo's meshes share one axis
+  vocabulary, so a partial set still catches axis typos).
+- **shard_map scopes**: decorator (``@partial(shard_map, mesh=...)``)
+  and wrap (``shard_map(f, mesh=...)``) sites anchor a scope at the
+  wrapped function; everything reachable from its body through the call
+  graph runs under that scope's axes.
+
+Rules (misses over false alarms, like every kvmini-lint family):
+
+- **KVM081**: a collective (``psum``/``pmean``/``ppermute``/
+  ``all_gather``/``pvary``/...) whose *literal* axis name is not bound
+  by any reaching scope. Complete scopes flag any unbound axis; partial
+  scopes flag only axes absent from the package-wide construction table
+  (the typo class). A collective whose axis is a runtime parameter, or
+  whose scope never resolved, is skipped.
+- **KVM082**: ``PartitionSpec`` consistency — a literal axis name no
+  mesh in the package declares; a spec whose arity disagrees with the
+  ``# [L, B, KVH, S, D]``-style shape annotation on its line; an
+  ``in_specs`` tuple whose length cannot match the shard_map'd
+  function's callable parameters (``partial``-bound args subtracted).
+- **KVM083**: ``device_put``/``with_sharding_constraint`` inside a
+  jit-DISPATCH hot path (a host function that invokes compiled work,
+  jit_purity's dispatch notion) — a hidden reshard serializes the
+  decode pipeline on every step. Setup/loading code (not a dispatch
+  path) is exempt; intended placements carry ``# kvmini: mesh-ok``.
+- **KVM084**: a buffer donated by the enclosing jit root whose
+  ``in_specs`` entry at the shard_map boundary matches no ``out_specs``
+  entry — the donation cannot alias across a sharding change, so XLA
+  silently copies (composes with KVM072's donation facts).
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Optional
+
+from kserve_vllm_mini_tpu.lint.diagnostics import Diagnostic
+from kserve_vllm_mini_tpu.lint.facts import (
+    FactIndex,
+    FunctionInfo,
+    ModuleFacts,
+    _last_attr,
+    iter_scope,
+)
+
+# collectives whose axis argument sits at position 1 (after the operand)
+COLLECTIVES = {
+    "psum", "pmean", "pmax", "pmin", "ppermute", "pshuffle", "all_gather",
+    "all_to_all", "psum_scatter", "pbroadcast", "pvary",
+}
+# ... and the axis-only ones (axis name is argument 0)
+AXIS_ARG0 = {"axis_index", "axis_size"}
+
+SHAPE_COMMENT = re.compile(
+    r"\[\s*([A-Za-z_][\w*]*(?:\s*,\s*[A-Za-z_][\w*]*)+)\s*\]"
+)
+
+
+def _comment_map(source: str) -> dict[int, str]:
+    """line -> comment text (tokenize-accurate: a '#' in a string is not
+    a comment)."""
+    out: dict[int, str] = {}
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                out[tok.start[0]] = tok.string
+    except tokenize.TokenError:
+        pass
+    return out
+
+
+def _literal_axes(node: ast.AST) -> Optional[frozenset[str]]:
+    """A literal axis spec: "tp", ("dp", "tp"), ["dp"]."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return frozenset({node.value})
+    if isinstance(node, (ast.Tuple, ast.List)):
+        vals = []
+        for e in node.elts:
+            if not (isinstance(e, ast.Constant) and isinstance(e.value, str)):
+                return None
+            vals.append(e.value)
+        return frozenset(vals)
+    return None
+
+
+@dataclass
+class AxesInfo:
+    """What we know about one mesh value's axis names."""
+
+    axes: frozenset[str] = frozenset()
+    complete: bool = True  # False: some contributing site didn't resolve
+
+    def join(self, other: "AxesInfo") -> "AxesInfo":
+        return AxesInfo(self.axes | other.axes,
+                        self.complete and other.complete)
+
+
+PARTIAL_UNKNOWN = AxesInfo(frozenset(), False)
+
+
+def _is_partition_spec_name(mod: ModuleFacts, func: ast.AST) -> bool:
+    name = _last_attr(func)
+    if name == "PartitionSpec":
+        return True
+    if isinstance(func, ast.Name):
+        fi = mod.from_imports.get(func.id)
+        return fi is not None and fi[1] == "PartitionSpec"
+    return False
+
+
+def _is_shard_map_func(node: ast.AST) -> bool:
+    return _last_attr(node) == "shard_map"
+
+
+@dataclass
+class SmapSite:
+    """One shard_map application: wrap call or decorator."""
+
+    mod: ModuleFacts
+    enclosing: Optional[FunctionInfo]
+    node: ast.AST  # the shard_map/partial call (diagnostics anchor)
+    targets: list[FunctionInfo]
+    mesh_expr: Optional[ast.AST]
+    in_specs: Optional[ast.AST] = None
+    out_specs: Optional[ast.AST] = None
+    partial_bound: int = 0  # partial()-bound leading positionals
+    partial_kwargs: set[str] = field(default_factory=set)
+
+
+class MeshFlowChecker:
+    def __init__(self, index: FactIndex):
+        self.index = index
+        self.diags: list[Diagnostic] = []
+        # functions that RETURN a mesh -> what we know of its axes
+        self.mesh_returns: dict[tuple[str, str], AxesInfo] = {}
+        # (fn key, param name) -> joined axes info from resolved callsites
+        self.param_axes: dict[tuple[tuple[str, str], str], AxesInfo] = {}
+        # every axis any construction site in the scanned set declares
+        self.global_axes: set[str] = set()
+        self.smap_sites: list[SmapSite] = []
+        self.smap_targets: set[tuple[str, str]] = set()
+        # fn key -> joined scope info (absent = unreached); None = reached
+        # but some reaching scope's mesh never resolved (never flag)
+        self.scope: dict[tuple[str, str], Optional[AxesInfo]] = {}
+        # candidate sites from the one shared package walk (_scan)
+        self._ret_cands: list[tuple[ModuleFacts, FunctionInfo, ast.AST]] = []
+        self._collective_sites: list[tuple[ModuleFacts,
+                                           Optional[FunctionInfo],
+                                           ast.Call]] = []
+        self._pspec_sites: list[tuple[ModuleFacts, Optional[FunctionInfo],
+                                      ast.Call]] = []
+        self._smap_wraps: list[tuple[ModuleFacts, Optional[FunctionInfo],
+                                     ast.Call]] = []
+
+    # -- resolution (facts + two mesh-specific fallbacks) --------------------
+    def _callees_with_offset(
+            self, mod: ModuleFacts, fn: Optional[FunctionInfo],
+            call: ast.Call) -> list[tuple[FunctionInfo, int]]:
+        """Resolved callees with their self-offset. Beyond the FactIndex:
+        `dist.global_mesh(...)` through a from-imported MODULE alias, and
+        `Engine(...)` constructor calls onto `Engine.__init__` — both are
+        how meshes actually travel from builder to engine in this repo."""
+        out = [
+            (c, 1 if c.params[:1] in (["self"], ["cls"])
+             and isinstance(call.func, ast.Attribute) else 0)
+            for c in self.index._resolve_expr(mod, fn, call.func)
+        ]
+        if out:
+            return out
+        f = call.func
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+            fi = mod.from_imports.get(f.value.id)
+            if fi is not None:
+                dotted = f"{fi[0]}.{fi[1]}" if fi[0] else fi[1]
+                target = self.index.module_for_dotted(dotted)
+                if target is not None and f.attr in target.functions:
+                    return [(target.functions[f.attr], 0)]
+        if isinstance(f, ast.Name):
+            ctor = mod.functions.get(f"{f.id}.__init__")
+            if ctor is not None:
+                return [(ctor, 1)]
+            fi = mod.from_imports.get(f.id)
+            if fi is not None:
+                target = self.index.module_for_dotted(fi[0])
+                if target is not None:
+                    ctor = target.functions.get(f"{fi[1]}.__init__")
+                    if ctor is not None:
+                        return [(ctor, 1)]
+        return []
+
+    # -- the mesh-axis fact table -------------------------------------------
+    def _module_const_axes(self, mod: ModuleFacts,
+                           name: str) -> Optional[frozenset[str]]:
+        """A module-level `AXES = ("dp", ...)` constant, via from-imports."""
+        fi = mod.from_imports.get(name)
+        if fi is not None:
+            target = self.index.module_for_dotted(fi[0])
+            if target is not None:
+                return self._module_const_axes(target, fi[1])
+            return None
+        for stmt in mod.tree.body:
+            if isinstance(stmt, ast.Assign):
+                for tgt in stmt.targets:
+                    if isinstance(tgt, ast.Name) and tgt.id == name:
+                        return _literal_axes(stmt.value)
+        return None
+
+    def _axes_spec_of(self, mod: ModuleFacts,
+                      node: ast.AST) -> Optional[frozenset[str]]:
+        axes = _literal_axes(node)
+        if axes is not None:
+            return axes
+        if isinstance(node, ast.Name):
+            return self._module_const_axes(mod, node.id)
+        return None
+
+    def _mesh_construction_axes(self, mod: ModuleFacts,
+                                call: ast.Call) -> Optional[frozenset[str]]:
+        """`Mesh(devices, <axes>)` / `Mesh(devices, axis_names=<axes>)`."""
+        if _last_attr(call.func) != "Mesh":
+            return None
+        spec: Optional[ast.AST] = None
+        if len(call.args) >= 2:
+            spec = call.args[1]
+        for kw in call.keywords:
+            if kw.arg == "axis_names":
+                spec = kw.value
+        if spec is None:
+            return None
+        return self._axes_spec_of(mod, spec)
+
+    def _axes_of_expr(self, mod: ModuleFacts, fn: Optional[FunctionInfo],
+                      expr: ast.AST, _depth: int = 0) -> Optional[AxesInfo]:
+        """What axes does this mesh-valued expression carry? None when the
+        expression is not recognizably a mesh (or recursion bottoms out)."""
+        if _depth > 4:
+            return None
+        if isinstance(expr, ast.Call):
+            cons = self._mesh_construction_axes(mod, expr)
+            if cons is not None:
+                return AxesInfo(cons, True)
+            if _last_attr(expr.func) == "Mesh":
+                return PARTIAL_UNKNOWN  # a mesh, axes not resolvable
+            out: Optional[AxesInfo] = None
+            for callee, _off in self._callees_with_offset(mod, fn, expr):
+                info = self.mesh_returns.get(callee.key())
+                if info is not None:
+                    out = info if out is None else out.join(info)
+            return out
+        if isinstance(expr, ast.Name):
+            fi = fn
+            while fi is not None:
+                if expr.id in fi.params:
+                    return self.param_axes.get((fi.key(), expr.id))
+                for aliased in fi.local_aliases.get(expr.id, []):
+                    got = self._axes_of_expr(mod, fi, aliased, _depth + 1)
+                    if got is not None:
+                        return got
+                if expr.id in fi.local_aliases:
+                    return None
+                fi = fi.parent
+        return None
+
+    def _looks_mesh_param(self, fn: FunctionInfo, param: str) -> bool:
+        if param == "mesh" or param.endswith("_mesh"):
+            return True
+        node = fn.node
+        for a in node.args.posonlyargs + node.args.args + node.args.kwonlyargs:
+            if a.arg == param and a.annotation is not None:
+                return any(
+                    isinstance(n, (ast.Name, ast.Attribute))
+                    and _last_attr(n) == "Mesh"
+                    for n in ast.walk(a.annotation))
+        return False
+
+    def _scan(self) -> None:
+        """ONE walk over every scope, collecting all candidate sites the
+        stages below consume — the package walk dominates checker time, so
+        it must not repeat per rule."""
+        for mod in self.index.modules.values():
+            scopes: list[tuple[Optional[FunctionInfo], object]] = [
+                (fn, iter_scope(fn.node)) for fn in mod.functions.values()
+            ]
+            # module-level statements (constructions/specs outside defs)
+            scopes.append((None, (
+                n for stmt in mod.tree.body
+                if not isinstance(stmt, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef, ast.ClassDef))
+                for n in ast.walk(stmt))))
+            for fn, nodes in scopes:
+                for node in nodes:
+                    if (fn is not None and isinstance(node, ast.Return)
+                            and isinstance(node.value, (ast.Call, ast.Name))):
+                        self._ret_cands.append((mod, fn, node.value))
+                    if not isinstance(node, ast.Call):
+                        continue
+                    axes = self._mesh_construction_axes(mod, node)
+                    if axes is not None:
+                        self.global_axes |= axes
+                    name = _last_attr(node.func)
+                    if name in COLLECTIVES or name in AXIS_ARG0:
+                        self._collective_sites.append((mod, fn, node))
+                    if _is_partition_spec_name(mod, node.func):
+                        self._pspec_sites.append((mod, fn, node))
+                    if _is_shard_map_func(node.func):
+                        self._smap_wraps.append((mod, fn, node))
+
+    def _build_fact_table(self) -> None:
+        # callsite args feeding mesh-looking params (return candidates
+        # come from the shared scan)
+        ret_cands = self._ret_cands
+        feed_cands: list[tuple[ModuleFacts, FunctionInfo, FunctionInfo,
+                               str, ast.AST]] = []
+        for mod in self.index.modules.values():
+            for fn in mod.functions.values():
+                for cs in self.index.call_sites(mod, fn):
+                    for callee, offset in self._callees_with_offset(
+                            mod, fn, cs.node):
+                        params = callee.params
+                        pairs: list[tuple[str, ast.AST]] = []
+                        for i, arg in enumerate(cs.node.args):
+                            pi = i + offset
+                            if (not isinstance(arg, ast.Starred)
+                                    and pi < len(params)):
+                                pairs.append((params[pi], arg))
+                        for kw in cs.node.keywords:
+                            if kw.arg and kw.arg in params:
+                                pairs.append((kw.arg, kw.value))
+                        for pname, arg in pairs:
+                            if self._looks_mesh_param(callee, pname):
+                                feed_cands.append((mod, fn, callee, pname, arg))
+        # Jacobi rounds: each recomputes BOTH maps from scratch against the
+        # previous round's facts, so an early evaluation that missed a
+        # not-yet-known mesh source cannot poison the joined set for good
+        for _ in range(5):
+            new_ret: dict[tuple[str, str], AxesInfo] = {}
+            for mod, fn, expr in ret_cands:
+                info = self._axes_of_expr(mod, fn, expr)
+                if info is None:
+                    continue
+                prev = new_ret.get(fn.key())
+                new_ret[fn.key()] = info if prev is None else prev.join(info)
+            new_par: dict[tuple[tuple[str, str], str], AxesInfo] = {}
+            for mod, fn, callee, pname, arg in feed_cands:
+                info = self._axes_of_expr(mod, fn, arg)
+                if info is None:
+                    # an unresolvable feed leaves the joined set PARTIAL
+                    # (typo-only strictness) instead of poisoning it
+                    info = PARTIAL_UNKNOWN
+                key = (callee.key(), pname)
+                prev = new_par.get(key)
+                new_par[key] = info if prev is None else prev.join(info)
+            if new_ret == self.mesh_returns and new_par == self.param_axes:
+                break
+            self.mesh_returns, self.param_axes = new_ret, new_par
+
+    # -- shard_map scope discovery ------------------------------------------
+    def _resolve_smap_target(self, mod: ModuleFacts,
+                             fn: Optional[FunctionInfo],
+                             expr: ast.AST) -> tuple[list[FunctionInfo], int,
+                                                     set[str]]:
+        """The wrapped callable (through partial), with bound-arg counts."""
+        if isinstance(expr, ast.Call) and _last_attr(expr.func) == "partial":
+            if expr.args:
+                inner, _, _ = self._resolve_smap_target(mod, fn, expr.args[0])
+                return (inner, len(expr.args) - 1,
+                        {kw.arg for kw in expr.keywords if kw.arg})
+            return [], 0, set()
+        return list(self.index._resolve_expr(mod, fn, expr)), 0, set()
+
+    def _smap_call_site(self, mod: ModuleFacts, fn: Optional[FunctionInfo],
+                        call: ast.Call,
+                        target_fn: Optional[FunctionInfo] = None) -> None:
+        """Record one shard_map(...) call. ``target_fn`` is the decorated
+        function when the call is a decorator; else the wrapped callable is
+        the first argument."""
+        mesh_expr = None
+        in_specs = out_specs = None
+        args = list(call.args)
+        if target_fn is None and args:
+            args = args[1:]  # wrap form: args[0] is the callable
+        elif target_fn is not None and args and _is_shard_map_func(args[0]):
+            args = args[1:]  # @partial(shard_map, ...): args[0] is shard_map
+        for i, pos_name in enumerate(("mesh", "in_specs", "out_specs")):
+            if i < len(args):
+                val = args[i]
+                if pos_name == "mesh":
+                    mesh_expr = val
+                elif pos_name == "in_specs":
+                    in_specs = val
+                else:
+                    out_specs = val
+        for kw in call.keywords:
+            if kw.arg == "mesh":
+                mesh_expr = kw.value
+            elif kw.arg == "in_specs":
+                in_specs = kw.value
+            elif kw.arg == "out_specs":
+                out_specs = kw.value
+        bound_n, bound_kw = 0, set()
+        if target_fn is not None:
+            targets = [target_fn]
+        else:
+            targets, bound_n, bound_kw = self._resolve_smap_target(
+                mod, fn, call.args[0]) if call.args else ([], 0, set())
+        self.smap_sites.append(SmapSite(
+            mod=mod, enclosing=fn, node=call, targets=targets,
+            mesh_expr=mesh_expr, in_specs=in_specs, out_specs=out_specs,
+            partial_bound=bound_n, partial_kwargs=bound_kw))
+        for t in targets:
+            self.smap_targets.add(t.key())
+
+    def _collect_smap_sites(self) -> None:
+        # decorator forms: @partial(shard_map, mesh=...) and @shard_map(...)
+        # — the partial's extra args bind nothing (the decorated fn IS the
+        # callable). Wrap-form calls come from the shared scan.
+        decorated: set[int] = set()
+        for mod in self.index.modules.values():
+            for fn in mod.functions.values():
+                for dec in fn.node.decorator_list:
+                    if not isinstance(dec, ast.Call):
+                        continue
+                    if _is_shard_map_func(dec.func) or (
+                            _last_attr(dec.func) == "partial" and dec.args
+                            and _is_shard_map_func(dec.args[0])):
+                        self._smap_call_site(mod, fn.parent, dec,
+                                             target_fn=fn)
+                        decorated.add(id(dec))
+        for mod, fn, node in self._smap_wraps:
+            if id(node) not in decorated:
+                self._smap_call_site(mod, fn, node)
+
+    def _propagate_scopes(self) -> None:
+        """BFS the call graph from each shard_map body: reached functions
+        run under that scope's axes; multiple scopes join (union axes,
+        unknown mesh poisons to never-flag)."""
+        work: list[tuple[tuple[str, str], Optional[AxesInfo]]] = []
+        for site in self.smap_sites:
+            info: Optional[AxesInfo] = None
+            if site.mesh_expr is not None:
+                info = self._axes_of_expr(site.mod, site.enclosing,
+                                          site.mesh_expr)
+            for t in site.targets:
+                work.append((t.key(), info))
+        while work:
+            key, info = work.pop()
+            prev = self.scope.get(key, _UNSET)
+            if prev is _UNSET:
+                new = info
+            elif prev is None or info is None:
+                new = None
+            else:
+                new = prev.join(info)
+            if prev is not _UNSET and new == prev:
+                continue
+            self.scope[key] = new
+            path, qual = key
+            mod = self.index.modules.get(path)
+            fn = mod.functions.get(qual) if mod else None
+            if fn is None:
+                continue
+            for cs in self.index.call_sites(mod, fn):
+                for callee in cs.callees:
+                    work.append((callee.key(), new))
+
+    # -- checks --------------------------------------------------------------
+    def run(self) -> list[Diagnostic]:
+        self._scan()
+        self._build_fact_table()
+        self._collect_smap_sites()
+        self._propagate_scopes()
+        self._check_collectives()
+        self._check_partition_specs()
+        self._check_dispatch_resharding()
+        self._check_donation_across_boundary()
+        return self.diags
+
+    def _emit(self, mod: ModuleFacts, node: ast.AST, code: str, msg: str,
+              context: str) -> None:
+        line = getattr(node, "lineno", 0)
+        if mod.suppressions.is_suppressed(line, code):
+            return
+        self.diags.append(Diagnostic(mod.path, line, code, msg,
+                                     context=context))
+
+    # -- KVM081 --------------------------------------------------------------
+    def _collective_axes(self, mod: ModuleFacts,
+                         call: ast.Call) -> Optional[frozenset[str]]:
+        name = _last_attr(call.func)
+        spec: Optional[ast.AST] = None
+        if name in AXIS_ARG0:
+            if call.args:
+                spec = call.args[0]
+        elif len(call.args) >= 2:
+            spec = call.args[1]
+        for kw in call.keywords:
+            if kw.arg in ("axis_name", "axis_names", "axes"):
+                spec = kw.value
+        if spec is None:
+            return None
+        return self._axes_spec_of(mod, spec)
+
+    def _check_collectives(self) -> None:
+        if not self.index.full_scan:
+            # every KVM081 verdict reasons from ABSENCE ("no scanned
+            # scope binds this axis") — on a single-file/--changed scan
+            # the binding shard_map site may simply be unscanned, so the
+            # rule stands down (the full scan still gates it), same as
+            # the KVM032 docs-drift full-scan rule
+            return
+        for mod, fn, node in self._collective_sites:
+            if fn is None:
+                continue  # module-level collective: no scope to judge
+            scope = self.scope.get(fn.key(), _UNSET)
+            axes = self._collective_axes(mod, node)
+            if not axes:
+                continue  # runtime-parameter axis: not checkable
+            if scope is _UNSET:
+                # never reached from a shard_map body: only a plain-jit
+                # root is provably scope-free (a helper may run under a
+                # caller's mesh we cannot see)
+                if fn.jit_root and fn.key() not in self.smap_targets:
+                    for ax in sorted(axes):
+                        self._emit(
+                            mod, node, "KVM081",
+                            f"collective over axis {ax!r} in jitted "
+                            f"`{fn.name}`, which no shard_map scope "
+                            "reaches — there is no mesh binding the "
+                            "axis here; wrap the call in shard_map, "
+                            "or mark `# kvmini: mesh-ok`",
+                            fn.qualname)
+                continue
+            if scope is None:
+                continue  # scope's mesh never resolved
+            for ax in sorted(axes):
+                if ax in scope.axes:
+                    continue
+                if not scope.complete and ax in self.global_axes:
+                    continue  # partial scope: typo-only strictness
+                known = ", ".join(sorted(scope.axes)) or "none"
+                self._emit(
+                    mod, node, "KVM081",
+                    f"collective over axis {ax!r} in `{fn.name}`, "
+                    "but the enclosing shard_map scope binds only "
+                    f"[{known}] — the axis does not exist on this "
+                    "mesh; fix the axis name or the mesh spec, or "
+                    "mark `# kvmini: mesh-ok`",
+                    fn.qualname)
+
+    # -- KVM082 --------------------------------------------------------------
+    def _check_partition_specs(self) -> None:
+        comment_cache: dict[str, dict[int, str]] = {}
+        for mod, _fn, node in self._pspec_sites:
+            ctx = mod.path
+            # literal axis names must exist on SOME package mesh — an
+            # absence claim, so only a full scan (whole axis vocabulary
+            # in view) may make it; arity checks below are local facts
+            if self.global_axes and self.index.full_scan:
+                for arg in node.args:
+                    for s in self._spec_entry_strings(arg):
+                        if s not in self.global_axes:
+                            self._emit(
+                                mod, node, "KVM082",
+                                f"PartitionSpec names axis {s!r}, "
+                                "which no mesh constructed in the "
+                                "scanned set declares (known: "
+                                f"[{', '.join(sorted(self.global_axes))}]) "
+                                "— an axis typo shards nothing; fix "
+                                "it or mark `# kvmini: mesh-ok`",
+                                ctx)
+            # arity vs the shape comment on the spec's line
+            comments = comment_cache.get(mod.path)
+            if comments is None:
+                comments = comment_cache[mod.path] = _comment_map(mod.source)
+            self._check_spec_arity(mod, node, comments, ctx)
+        self._check_in_specs_arity()
+
+    @staticmethod
+    def _spec_entry_strings(arg: ast.AST):
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            yield arg.value
+        elif isinstance(arg, (ast.Tuple, ast.List)):
+            for e in arg.elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                    yield e.value
+
+    def _check_spec_arity(self, mod: ModuleFacts, node: ast.Call,
+                          comments: dict[int, str], ctx: str) -> None:
+        if any(isinstance(a, ast.Starred) for a in node.args) or not node.args:
+            return
+        for line in (getattr(node, "end_lineno", node.lineno),
+                     node.lineno, node.lineno - 1):
+            comment = comments.get(line)
+            if comment is None:
+                continue
+            m = SHAPE_COMMENT.search(comment)
+            if m is None:
+                continue
+            dims = [d.strip() for d in m.group(1).split(",")]
+            if len(dims) != len(node.args):
+                self._emit(
+                    mod, node, "KVM082",
+                    f"PartitionSpec has {len(node.args)} entries but the "
+                    f"shape annotation `[{', '.join(dims)}]` declares "
+                    f"{len(dims)} dims — a short spec silently replicates "
+                    "the trailing axes; align them or mark "
+                    "`# kvmini: mesh-ok`",
+                    ctx)
+            return
+
+    def _check_in_specs_arity(self) -> None:
+        for site in self.smap_sites:
+            if not isinstance(site.in_specs, ast.Tuple):
+                continue
+            if any(isinstance(e, ast.Starred) for e in site.in_specs.elts):
+                continue
+            n_specs = len(site.in_specs.elts)
+            for target in site.targets:
+                params = [p for p in target.params if p not in ("self", "cls")]
+                a = target.node.args
+                n_defaults = len(a.defaults)
+                avail = [p for p in params
+                         if p not in site.partial_kwargs][site.partial_bound:]
+                required = max(len(avail) - n_defaults, 0)
+                if not (required <= n_specs <= len(avail)):
+                    self._emit(
+                        site.mod, site.node, "KVM082",
+                        f"shard_map in_specs has {n_specs} entries but "
+                        f"`{target.name}` takes {len(avail)} arguments"
+                        + (f" (>= {required} required)" if n_defaults else "")
+                        + " — the spec tuple must mirror the call "
+                        "arguments one-to-one; fix the arity or mark "
+                        "`# kvmini: mesh-ok`",
+                        target.qualname)
+
+    # -- KVM083 --------------------------------------------------------------
+    def _jit_reachable(self) -> set[tuple[str, str]]:
+        seen: set[tuple[str, str]] = set()
+        work = [fn for fn in self.index.functions() if fn.jit_root]
+        seen |= {fn.key() for fn in work}
+        while work:
+            fn = work.pop()
+            mod = self.index.modules[fn.path]
+            for cs in self.index.call_sites(mod, fn):
+                for callee in cs.callees:
+                    if callee.key() not in seen:
+                        seen.add(callee.key())
+                        work.append(callee)
+        return seen
+
+    def _check_dispatch_resharding(self) -> None:
+        traced = self._jit_reachable()
+        for mod in self.index.modules.values():
+            if not (mod.jitted_names or mod.jitted_attrs or any(
+                    f.jit_root or f.returns_jitted
+                    for f in mod.functions.values())):
+                continue
+            for fn in mod.functions.values():
+                if fn.key() in traced:
+                    continue  # traced code: constraints belong there
+                if fn.name == "__init__":
+                    # constructors dispatch compiled warmup but run once —
+                    # placement there IS the "once at setup" the rule asks for
+                    continue
+                if not any(
+                        isinstance(n, ast.Call)
+                        and self.index.calls_jitted_value(mod, fn, n)
+                        for n in iter_scope(fn.node)):
+                    continue
+                for node in iter_scope(fn.node):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    name = _last_attr(node.func)
+                    if name not in {"device_put", "with_sharding_constraint"}:
+                        continue
+                    self._emit(
+                        mod, node, "KVM083",
+                        f"`{name}` in jit-dispatch function `{fn.name}` — "
+                        "a reshard/transfer on the hot path is a silent "
+                        "all-gather every step (place data once at setup); "
+                        "if this placement is intended here, mark "
+                        "`# kvmini: mesh-ok`",
+                        fn.qualname)
+
+    # -- KVM084 --------------------------------------------------------------
+    def _check_donation_across_boundary(self) -> None:
+        sites_by_target: dict[tuple[str, str], SmapSite] = {}
+        for site in self.smap_sites:
+            for t in site.targets:
+                sites_by_target[t.key()] = site
+        for fn in self.index.functions():
+            if not (fn.jit_root and (fn.donated_argnums or fn.donated_argnames)):
+                continue
+            mod = self.index.modules[fn.path]
+            donated_names = {fn.params[i] for i in fn.donated_argnums
+                             if i < len(fn.params)} | set(fn.donated_argnames)
+            for cs in self.index.call_sites(mod, fn):
+                for callee in cs.callees:
+                    site = sites_by_target.get(callee.key())
+                    if site is None or not isinstance(site.in_specs, ast.Tuple):
+                        continue
+                    outs = self._out_spec_strings(site)
+                    if outs is None:
+                        continue
+                    for i, arg in enumerate(cs.node.args):
+                        if not (isinstance(arg, ast.Name)
+                                and arg.id in donated_names):
+                            continue
+                        if i >= len(site.in_specs.elts):
+                            continue
+                        in_str = ast.unparse(site.in_specs.elts[i])
+                        if in_str not in outs:
+                            self._emit(
+                                mod, cs.node, "KVM084",
+                                f"`{arg.id}` is donated by jit root "
+                                f"`{fn.name}` but crosses the shard_map "
+                                f"boundary as `{in_str}` with no matching "
+                                "out_spec — the donation cannot alias "
+                                "across a sharding change and XLA silently "
+                                "copies; thread the buffer out with the "
+                                "same spec, or mark `# kvmini: mesh-ok`",
+                                fn.qualname)
+
+    @staticmethod
+    def _out_spec_strings(site: SmapSite) -> Optional[set[str]]:
+        if site.out_specs is None:
+            return None
+        if isinstance(site.out_specs, ast.Tuple):
+            return {ast.unparse(e) for e in site.out_specs.elts}
+        return {ast.unparse(site.out_specs)}
+
+
+_UNSET = object()
+
+
+def check(index: FactIndex) -> list[Diagnostic]:
+    return MeshFlowChecker(index).run()

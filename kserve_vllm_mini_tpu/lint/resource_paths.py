@@ -1,0 +1,690 @@
+"""KVM091-KVM093 — exception-path resource safety.
+
+The engine's paired acquire/release state grew past what the donation-
+focused KVM07x rules see: slots pop off ``self._free`` and must come
+back (or transfer into the slot tables), paged block ids move between
+the free list, block tables, and the retained LRU, fault-registry arms
+must clear, and the watchdog/chunked-prefill work of PRs 10-11 added
+cancellation branches to almost every one of those lifecycles. The
+failure mode is always the same shape: an *exception path* (or an early
+return, or a cancellation branch) exits the function while the happy
+path still owed a release.
+
+**Learning the pairs.** The checker learns the repo's conventions
+instead of hard-coding method names:
+
+- a *free-list pop* assigned to a name (``slot = self._free.pop()``,
+  ``bid, _ = self._retained_lru.popitem(last=False)``) acquires that
+  name, as does ``open(...)`` and a call to a learned *acquirer* (a
+  function whose return value derives from a free-list pop — the
+  engine's ``_pop_slot_for``);
+- a function that appends one of its *parameters* to a free list is a
+  *releaser* of that parameter; releasing is transitive through the
+  call graph (``_finish_slot`` -> ``_release_slot`` ->
+  ``self._free.append(slot)``), three rounds;
+- *toggle pairs* on one receiver (``lock.acquire()``/``release()``,
+  ``registry.arm()``/``disarm()``/``clear()``, ``f.close()``) are
+  tracked only when BOTH halves appear in the same function — a
+  lone ``arm`` is a deliberate persistent arm (the POST /faults
+  handler), not a leak.
+
+**Ownership transfer** ends a resource's tracked lifetime without a
+release: returning/yielding the token, storing it into object state
+(``self._slot_req[slot] = handle`` — the slot tables ARE the ownership
+record), passing it to any call, ``del``, or rebinding the name. The
+generous transfer rule is the misses-over-false-alarms contract: only
+a path where the token provably goes *nowhere* is a leak.
+
+**The CFG.** Each function gets a statement-level control-flow graph:
+``if``/loops/``with``/``try`` with handler and ``finally`` routing,
+``return``/``raise``/``break``/``continue`` threaded through enclosing
+``finally`` blocks. Implicit exception edges exist only INSIDE ``try``
+bodies (every statement there may jump to each handler, and to the
+``finally``) — outside a ``try``, calls are assumed not to raise, so
+ordinary straight-line code never manufactures phantom leak paths.
+
+- **KVM091**: from each acquire, some CFG path reaches the function
+  exit with no release/transfer of the token — the except branch that
+  returns while the slot is still popped.
+- **KVM092**: a second release of the same token is reachable from a
+  first with no intervening re-acquire/rebind — the drain path that
+  frees a slot another branch already freed. Plain free-list
+  double-appends stay KVM073's (suite-lexical) job; this rule covers
+  the learned releaser *calls* and toggle releases KVM073 cannot see.
+- **KVM093**: a ``finally`` block CAN raise before a release later in
+  the same block — whenever the raise fires (it needs no exceptional
+  entry, and it replaces any in-flight exception) the release is
+  skipped, on exactly the failure path that most needs the cleanup. A
+  conditional raise counts: the engine's deliberate never-retain-
+  poisoned-KV designs annotate ``resource-ok`` instead.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field, replace
+from typing import Optional
+
+from kserve_vllm_mini_tpu.lint.diagnostics import Diagnostic
+from kserve_vllm_mini_tpu.lint.facts import (
+    FactIndex,
+    FunctionInfo,
+    ModuleFacts,
+    iter_scope,
+)
+
+FREELIST = re.compile(r"^_?free(_blocks|_list|_slots|list)?$")
+RETAINED = re.compile(r"retained")
+POP_METHODS = {"pop", "popleft", "popitem"}
+# toggle pairs: acquire method -> release methods on the SAME receiver
+TOGGLES = {
+    "acquire": {"release"},
+    "arm": {"disarm", "clear"},
+    "open": {"close"},  # via the open() builtin, receiver = bound name
+}
+
+TRY_TYPES = (ast.Try,) + ((ast.TryStar,) if hasattr(ast, "TryStar") else ())
+EXIT = 0  # the one virtual exit node every leak path ends at
+
+
+@dataclass(frozen=True)
+class _Ctx:
+    """Where abrupt control flow lands from the current position."""
+
+    on_return: int = EXIT
+    on_raise: tuple[int, ...] = (EXIT,)
+    on_break: Optional[int] = None
+    on_continue: Optional[int] = None
+    exc: tuple[int, ...] = ()  # implicit-exception targets (try bodies only)
+
+
+class CFG:
+    """Statement-level control-flow graph of one function body."""
+
+    def __init__(self, fn_node: ast.AST):
+        self.succ: dict[int, set[int]] = {EXIT: set()}
+        self.exc_succ: dict[int, set[int]] = {}
+        self.stmt_of: dict[int, ast.stmt] = {}
+        self._next = 1
+        entry = self._seq(list(fn_node.body), EXIT, _Ctx())
+        self.entry = entry
+
+    def _new(self, stmt: Optional[ast.stmt]) -> int:
+        nid = self._next
+        self._next += 1
+        if stmt is not None:
+            self.stmt_of[nid] = stmt
+        self.succ[nid] = set()
+        return nid
+
+    def _seq(self, stmts: list[ast.stmt], follow: int, ctx: _Ctx) -> int:
+        entry = follow
+        for stmt in reversed(stmts):
+            entry = self._stmt(stmt, entry, ctx)
+        return entry
+
+    def _stmt(self, stmt: ast.stmt, follow: int, ctx: _Ctx) -> int:
+        nid = self._new(stmt)
+        if isinstance(stmt, ast.Return):
+            self.succ[nid] = {ctx.on_return}
+        elif isinstance(stmt, ast.Raise):
+            self.succ[nid] = set(ctx.on_raise)
+        elif isinstance(stmt, ast.Break):
+            self.succ[nid] = {ctx.on_break if ctx.on_break is not None
+                              else ctx.on_return}
+        elif isinstance(stmt, ast.Continue):
+            self.succ[nid] = {ctx.on_continue if ctx.on_continue is not None
+                              else ctx.on_return}
+        elif isinstance(stmt, ast.If):
+            body = self._seq(stmt.body, follow, ctx)
+            orelse = self._seq(stmt.orelse, follow, ctx) if stmt.orelse else follow
+            self.succ[nid] = {body, orelse}
+        elif isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+            loop_ctx = replace(ctx, on_break=follow, on_continue=nid)
+            body = self._seq(stmt.body, nid, loop_ctx)
+            after = self._seq(stmt.orelse, follow, ctx) if stmt.orelse else follow
+            self.succ[nid] = {body, after}
+            if (isinstance(stmt, ast.While)
+                    and isinstance(stmt.test, ast.Constant) and stmt.test.value):
+                # `while True:` only exits through break (routed above) —
+                # a phantom fall-through edge would manufacture leak paths
+                self.succ[nid] = {body}
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            self.succ[nid] = {self._seq(stmt.body, follow, ctx)}
+        elif isinstance(stmt, TRY_TYPES):
+            self.succ[nid] = {self._try(stmt, follow, ctx)}
+        else:
+            self.succ[nid] = {follow}
+        if ctx.exc and not isinstance(
+                stmt, (ast.Return, ast.Raise, ast.Break, ast.Continue)):
+            self.exc_succ[nid] = set(ctx.exc)
+        return nid
+
+    def _try(self, stmt: ast.stmt, follow: int, ctx: _Ctx) -> int:
+        has_fin = bool(stmt.finalbody)
+        if has_fin:
+            fin_join = self._new(None)
+            conts: set[int] = set()
+            fin_entry = self._seq(stmt.finalbody, fin_join, ctx)
+
+            def route(t: Optional[int]) -> Optional[int]:
+                if t is None:
+                    return None
+                conts.add(t)
+                return fin_entry
+
+            def route_many(ts: tuple[int, ...]) -> tuple[int, ...]:
+                conts.update(ts)
+                return (fin_entry,)
+        else:
+            def route(t: Optional[int]) -> Optional[int]:
+                return t
+
+            def route_many(ts: tuple[int, ...]) -> tuple[int, ...]:
+                return ts
+
+        after = route(follow)
+        out_ctx = replace(
+            ctx,
+            on_return=route(ctx.on_return),
+            on_break=route(ctx.on_break),
+            on_continue=route(ctx.on_continue),
+            on_raise=route_many(ctx.on_raise),
+            exc=route_many(ctx.exc) if ctx.exc else
+                (((fin_entry,) if has_fin else ())),
+        )
+        handler_entries = tuple(
+            self._seq(h.body, after, out_ctx) for h in stmt.handlers)
+        # implicit exceptions in the body reach each handler, and (with a
+        # finally but no handlers) run the finally then propagate out
+        body_exc = handler_entries
+        if has_fin:
+            body_exc = body_exc + route_many(ctx.on_raise)
+        body_ctx = replace(
+            out_ctx,
+            on_raise=handler_entries + out_ctx.on_raise,
+            exc=body_exc,
+        )
+        body_follow = (self._seq(stmt.orelse, after, out_ctx)
+                       if stmt.orelse else after)
+        entry = self._seq(stmt.body, body_follow, body_ctx)
+        if has_fin:
+            self.succ[fin_join] = conts or {follow}
+        return entry
+
+    def all_succ(self, nid: int) -> set[int]:
+        return self.succ.get(nid, set()) | self.exc_succ.get(nid, set())
+
+
+def _own_nodes(stmt: ast.stmt):
+    """Walk a statement's own expressions (headers included) without
+    descending into nested statements or nested defs — those are their
+    own CFG nodes / scopes."""
+    yield stmt
+    stack = [c for c in ast.iter_child_nodes(stmt)
+             if not isinstance(c, (ast.stmt, ast.FunctionDef,
+                                   ast.AsyncFunctionDef, ast.ClassDef))]
+    while stack:
+        n = stack.pop()
+        yield n
+        stack.extend(c for c in ast.iter_child_nodes(n)
+                     if not isinstance(c, ast.stmt))
+
+
+def _base_name(node: ast.AST) -> str:
+    """`self._free.append` -> "_free"; `free_list.append` -> "free_list"."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def _first_target_name(target: ast.AST) -> Optional[str]:
+    if isinstance(target, ast.Name):
+        return target.id
+    if isinstance(target, (ast.Tuple, ast.List)) and target.elts:
+        return _first_target_name(target.elts[0])
+    return None
+
+
+def _receiver_str(node: ast.AST) -> Optional[str]:
+    """Stable text for a toggle receiver: `self._lock`, `reg`."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name):
+        return f"{node.value.id}.{node.attr}"
+    return None
+
+
+@dataclass
+class _Events:
+    """What one CFG statement does to tracked tokens."""
+
+    acquires: list[tuple[str, ast.AST]] = field(default_factory=list)
+    releases: list[tuple[str, ast.AST, str]] = field(default_factory=list)
+    transfers: set[str] = field(default_factory=set)
+    rebinds: set[str] = field(default_factory=set)
+
+
+class ResourcePathChecker:
+    def __init__(self, index: FactIndex):
+        self.index = index
+        self.diags: list[Diagnostic] = []
+        # fn key -> param indices it releases (to a free list, transitively)
+        self.releasers: dict[tuple[str, str], set[int]] = {}
+        # fn key -> True when the return value derives from a pop
+        self.acquirers: set[tuple[str, str]] = set()
+        # per-function scan results (one walk, _scan)
+        self._uncond_calls: dict[tuple[str, str], set[int]] = {}
+        self._interesting: set[tuple[str, str]] = set()
+
+    # -- learning ------------------------------------------------------------
+    @staticmethod
+    def _freelist_pop(node: ast.AST) -> bool:
+        return (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in POP_METHODS
+                and (FREELIST.match(_base_name(node.func.value))
+                     or RETAINED.search(_base_name(node.func.value))))
+
+    @staticmethod
+    def _freelist_append(node: ast.AST) -> Optional[str]:
+        """The freed bare name of a `<freelist>.append(x)` call."""
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in {"append", "appendleft"}
+                and len(node.args) == 1
+                and isinstance(node.args[0], ast.Name)
+                and FREELIST.match(_base_name(node.func.value))):
+            return node.args[0].id
+        return None
+
+    @staticmethod
+    def _unconditional_nodes(fn_node: ast.AST):
+        """Nodes in the function body's top-level straight-line suite — a
+        releaser must free its param UNCONDITIONALLY: `_emit_token`
+        finishing a slot only when it hits EOS is not a releaser, or every
+        per-token call would read as a double release."""
+        for stmt in fn_node.body:
+            for node in ast.walk(stmt):
+                if isinstance(node, (ast.If, ast.For, ast.While, ast.With,
+                                     ast.AsyncWith, ast.AsyncFor,
+                                     ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.ClassDef)) or isinstance(
+                                         node, TRY_TYPES):
+                    break
+                yield node
+
+    # attr names that make a node worth a closer look (gate before regex);
+    # release-only toggle halves (release/disarm/clear) create no events
+    # without their acquire half, so they do not mark a function
+    _MARKER_ATTRS = POP_METHODS | {"append", "appendleft", "close",
+                                   "acquire", "arm"}
+
+    def _scan(self) -> None:
+        """ONE walk per function: seed releasers/acquirers, remember which
+        callsites sit in unconditional position, and mark the (few)
+        functions that touch a tracked resource at all."""
+        for mod in self.index.modules.values():
+            for fn in mod.functions.values():
+                uncond = {id(n) for n in self._unconditional_nodes(fn.node)}
+                interesting = False
+                for node in iter_scope(fn.node):
+                    if isinstance(node, TRY_TYPES) and node.finalbody:
+                        interesting = True
+                    if not isinstance(node, ast.Call):
+                        continue
+                    f = node.func
+                    if isinstance(f, ast.Name) and f.id == "open":
+                        interesting = True
+                        continue
+                    if not (isinstance(f, ast.Attribute)
+                            and f.attr in self._MARKER_ATTRS):
+                        continue
+                    if f.attr in ("append", "appendleft"):
+                        freed = self._freelist_append(node)
+                        if freed is None:
+                            continue  # an ordinary list append
+                        interesting = True
+                        if id(node) in uncond and freed in fn.params:
+                            self.releasers.setdefault(fn.key(), set()).add(
+                                fn.params.index(freed))
+                    elif f.attr in POP_METHODS:
+                        if self._freelist_pop(node):
+                            interesting = True
+                    else:  # close / acquire / arm
+                        interesting = True
+                for node in iter_scope(fn.node) if interesting else ():
+                    if (isinstance(node, ast.Return)
+                            and node.value is not None
+                            and any(self._freelist_pop(n)
+                                    for n in ast.walk(node.value))):
+                        self.acquirers.add(fn.key())
+                self._uncond_calls[fn.key()] = uncond
+                if interesting:
+                    self._interesting.add(fn.key())
+
+    def _learn(self) -> None:
+        self._scan()
+        # transitive closure over the call graph (3 rounds bound the
+        # engine's _finish_slot -> _release_slot -> append chain); the
+        # forwarding call must itself sit in unconditional position
+        for _ in range(3):
+            changed = False
+            for mod in self.index.modules.values():
+                for fn in mod.functions.values():
+                    uncond = self._uncond_calls.get(fn.key(), set())
+                    if not uncond:
+                        continue
+                    for cs in self.index.call_sites(mod, fn):
+                        if id(cs.node) not in uncond:
+                            continue
+                        for callee in cs.callees:
+                            rel = self.releasers.get(callee.key())
+                            if not rel:
+                                continue
+                            offset = 1 if callee.params[:1] in (
+                                ["self"], ["cls"]) and isinstance(
+                                cs.node.func, ast.Attribute) else 0
+                            for ri in rel:
+                                ai = ri - offset
+                                if not (0 <= ai < len(cs.node.args)):
+                                    continue
+                                arg = cs.node.args[ai]
+                                if (isinstance(arg, ast.Name)
+                                        and arg.id in fn.params):
+                                    k = fn.key()
+                                    pi = fn.params.index(arg.id)
+                                    if pi not in self.releasers.setdefault(
+                                            k, set()):
+                                        self.releasers[k].add(pi)
+                                        changed = True
+            if not changed:
+                break
+
+    # -- event extraction ----------------------------------------------------
+    def _toggle_receivers(self, fn: FunctionInfo) -> dict[str, set[str]]:
+        """receiver -> acquire methods tracked (both halves must appear)."""
+        seen: dict[str, set[str]] = {}
+        for node in iter_scope(fn.node):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)):
+                continue
+            recv = _receiver_str(node.func.value)
+            if recv is None:
+                continue
+            seen.setdefault(recv, set()).add(node.func.attr)
+        out: dict[str, set[str]] = {}
+        for recv, methods in seen.items():
+            for acq, rels in TOGGLES.items():
+                if acq != "open" and acq in methods and methods & rels:
+                    out.setdefault(recv, set()).add(acq)
+        return out
+
+    def _stmt_events(self, mod: ModuleFacts, fn: FunctionInfo,
+                     stmt: ast.stmt, callees_of: dict[int, list[FunctionInfo]],
+                     toggles: dict[str, set[str]]) -> _Events:
+        ev = _Events()
+        for node in _own_nodes(stmt):
+            # rebinds (incl. for-targets): a stored name starts a fresh
+            # lifetime for whatever it previously held
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+                ev.rebinds.add(node.id)
+            if isinstance(node, ast.Delete):
+                ev.transfers |= {t.id for t in node.targets
+                                 if isinstance(t, ast.Name)}
+            # acquires: assigned pops / open() / learned acquirer calls
+            if isinstance(node, ast.Assign) and node.targets:
+                tok = _first_target_name(node.targets[0])
+                val = node.value
+                if tok is not None and isinstance(val, ast.Call):
+                    if self._freelist_pop(val):
+                        ev.acquires.append((tok, val))
+                    elif (isinstance(val.func, ast.Name)
+                          and val.func.id == "open"):
+                        ev.acquires.append((tok, val))
+                    elif any(c.key() in self.acquirers
+                             for c in callees_of.get(id(val), [])):
+                        ev.acquires.append((tok, val))
+            if not isinstance(node, ast.Call):
+                continue
+            # releases: free-list appends, learned releaser calls, toggles
+            freed = self._freelist_append(node)
+            if freed is not None:
+                ev.releases.append((freed, node, "append"))
+                continue
+            released_here = False
+            for callee in callees_of.get(id(node), []):
+                rel = self.releasers.get(callee.key())
+                if not rel:
+                    continue
+                offset = 1 if callee.params[:1] in (["self"], ["cls"]) and (
+                    isinstance(node.func, ast.Attribute)) else 0
+                for ri in rel:
+                    ai = ri - offset
+                    if (0 <= ai < len(node.args)
+                            and isinstance(node.args[ai], ast.Name)):
+                        ev.releases.append(
+                            (node.args[ai].id, node, callee.name))
+                        released_here = True
+            if released_here:
+                continue
+            if isinstance(node.func, ast.Attribute):
+                recv = _receiver_str(node.func.value)
+                meth = node.func.attr
+                if recv is not None and recv in toggles:
+                    if meth in toggles[recv]:
+                        ev.acquires.append((f"{recv}.{meth}()", node))
+                        continue
+                    for acq in toggles[recv]:
+                        if meth in TOGGLES[acq]:
+                            ev.releases.append(
+                                (f"{recv}.{acq}()", node, meth))
+                    if any(meth in TOGGLES[a] for a in toggles[recv]):
+                        continue
+                if meth == "close" and recv is not None:
+                    ev.releases.append((recv, node, "close"))
+                    continue
+            # any other call a token rides into transfers ownership
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                for n in ast.walk(arg):
+                    if isinstance(n, ast.Name):
+                        ev.transfers.add(n.id)
+        # stores into object state / subscripts transfer both the value
+        # names and the index names (the slot tables ARE the ownership
+        # record); return/yield transfers whatever rides out
+        for node in _own_nodes(stmt):
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if isinstance(tgt, (ast.Attribute, ast.Subscript)):
+                        ev.transfers |= {
+                            n.id for n in ast.walk(node.value)
+                            if isinstance(n, ast.Name)}
+                    if isinstance(tgt, ast.Subscript):
+                        ev.transfers |= {
+                            n.id for n in ast.walk(tgt.slice)
+                            if isinstance(n, ast.Name)}
+            if isinstance(node, (ast.Return, ast.Yield, ast.YieldFrom)):
+                val = node.value
+                if val is not None:
+                    ev.transfers |= {n.id for n in ast.walk(val)
+                                     if isinstance(n, ast.Name)}
+        return ev
+
+    # -- analysis ------------------------------------------------------------
+    def run(self) -> list[Diagnostic]:
+        self._learn()
+        for mod in self.index.modules.values():
+            for fn in mod.functions.values():
+                self._check_fn(mod, fn)
+        return self.diags
+
+    def _emit(self, mod: ModuleFacts, node: ast.AST, code: str, msg: str,
+              context: str) -> None:
+        line = getattr(node, "lineno", 0)
+        if mod.suppressions.is_suppressed(line, code):
+            return
+        self.diags.append(Diagnostic(mod.path, line, code, msg,
+                                     context=context))
+
+    def _worth_checking(self, fn: FunctionInfo) -> bool:
+        """Cheap gate: almost no function touches a tracked resource."""
+        if fn.key() in self._interesting:
+            return True
+        # learned releaser/acquirer callsites make a function interesting
+        # even without its own markers (dict lookups on the cached sites)
+        mod = self.index.modules[fn.path]
+        return any(
+            c.key() in self.releasers or c.key() in self.acquirers
+            for cs in self.index.call_sites(mod, fn) for c in cs.callees)
+
+    def _check_fn(self, mod: ModuleFacts, fn: FunctionInfo) -> None:
+        if not self._worth_checking(fn):
+            return
+        callees_of = {id(cs.node): cs.callees
+                      for cs in self.index.call_sites(mod, fn)}
+        toggles = self._toggle_receivers(fn)
+        cfg = CFG(fn.node)
+        events = {nid: self._stmt_events(mod, fn, stmt, callees_of, toggles)
+                  for nid, stmt in cfg.stmt_of.items()}
+        self._check_leaks(mod, fn, cfg, events)
+        self._check_double_release(mod, fn, cfg, events)
+        self._check_finally_reraise(mod, fn, callees_of)
+
+    @staticmethod
+    def _node_settles(ev: _Events, token: str) -> bool:
+        return (token in ev.transfers or token in ev.rebinds
+                or any(t == token for t, _, _ in ev.releases)
+                or any(t == token for t, _ in ev.acquires))
+
+    # -- KVM091 --------------------------------------------------------------
+    def _check_leaks(self, mod: ModuleFacts, fn: FunctionInfo, cfg: CFG,
+                     events: dict[int, _Events]) -> None:
+        for nid, ev in events.items():
+            for token, node in ev.acquires:
+                # start from NORMAL successors only: if the acquiring
+                # statement itself raises, nothing was acquired
+                escape = self._find_escape(cfg, events, cfg.succ.get(nid, set()),
+                                           token)
+                if escape is None:
+                    continue
+                where = (f"the path through line {escape}"
+                         if escape > 0 else "a fall-through path")
+                self._emit(
+                    mod, node, "KVM091",
+                    f"`{token}` acquired here can escape `{fn.name}` via "
+                    f"{where} without a release or ownership transfer — "
+                    "an exception/cancellation branch leaks the resource; "
+                    "release it in a `finally`/except path, transfer "
+                    "ownership, or mark `# kvmini: resource-ok`",
+                    fn.qualname)
+
+    def _find_escape(self, cfg: CFG, events: dict[int, _Events],
+                     start: set[int], token: str) -> Optional[int]:
+        """Line of the statement from which EXIT is reached while the
+        token is still live; None when every path settles it."""
+        seen: set[int] = set()
+        # (node, line of the last real statement on the path so far)
+        work: list[tuple[int, int]] = [(n, 0) for n in start]
+        while work:
+            nid, via = work.pop()
+            if nid == EXIT:
+                return via
+            if nid in seen:
+                continue
+            seen.add(nid)
+            ev = events.get(nid)
+            if ev is not None and self._node_settles(ev, token):
+                continue
+            stmt = cfg.stmt_of.get(nid)
+            line = getattr(stmt, "lineno", 0) if stmt is not None else via
+            for s in cfg.all_succ(nid):
+                work.append((s, line or via))
+        return None
+
+    # -- KVM092 --------------------------------------------------------------
+    def _check_double_release(self, mod: ModuleFacts, fn: FunctionInfo,
+                              cfg: CFG, events: dict[int, _Events]) -> None:
+        for nid, ev in events.items():
+            for token, node, kind in ev.releases:
+                if kind == "append":
+                    continue  # plain double-appends are KVM073's job
+                second = self._find_second_release(cfg, events, nid, token)
+                if second is None:
+                    continue
+                tok2, node2, _ = second
+                self._emit(
+                    mod, node2, "KVM092",
+                    f"`{tok2}` is released here but a release on line "
+                    f"{node.lineno} is reachable on the same path with no "
+                    "re-acquire between — the second release frees a "
+                    "handle another owner may already hold; make the "
+                    "paths exclusive, or mark `# kvmini: resource-ok`",
+                    fn.qualname)
+
+    def _find_second_release(self, cfg: CFG, events: dict[int, _Events],
+                             start_nid: int, token: str):
+        seen: set[int] = set()
+        # NORMAL successors only: if the releasing statement itself raises
+        # (a socket close failing into the cleanup handler), the release
+        # may not have happened — that handler's close is not a double one
+        work = list(cfg.succ.get(start_nid, set()))
+        while work:
+            nid = work.pop()
+            if nid in seen or nid == EXIT:
+                continue
+            seen.add(nid)
+            ev = events.get(nid)
+            if ev is not None:
+                hit = next(((t, n, k) for t, n, k in ev.releases
+                            if t == token and k != "append"), None)
+                if hit is not None:
+                    return hit
+                if (token in ev.rebinds
+                        or any(t == token for t, _ in ev.acquires)):
+                    continue
+            work.extend(cfg.all_succ(nid))
+        return None
+
+    # -- KVM093 --------------------------------------------------------------
+    def _check_finally_reraise(self, mod: ModuleFacts, fn: FunctionInfo,
+                               callees_of: dict) -> None:
+        for node in iter_scope(fn.node):
+            if not (isinstance(node, TRY_TYPES) and node.finalbody):
+                continue
+            fin_lines: list[tuple[int, str, ast.AST]] = []
+            for stmt in node.finalbody:
+                for sub in ast.walk(stmt):
+                    if isinstance(sub, ast.Raise):
+                        fin_lines.append((sub.lineno, "raise", sub))
+                    elif isinstance(sub, ast.Call):
+                        freed = self._freelist_append(sub)
+                        if freed is not None:
+                            fin_lines.append((sub.lineno, "release", sub))
+                            continue
+                        if any(self.releasers.get(c.key())
+                               for c in callees_of.get(id(sub), [])):
+                            fin_lines.append((sub.lineno, "release", sub))
+            fin_lines.sort(key=lambda t: t[0])
+            pending_raise: Optional[ast.AST] = None
+            for _line, kind, sub in fin_lines:
+                if kind == "raise":
+                    pending_raise = pending_raise or sub
+                elif pending_raise is not None:
+                    self._emit(
+                        mod, pending_raise, "KVM093",
+                        f"this `finally` can raise before the release on "
+                        f"line {sub.lineno} — whenever the raise fires "
+                        "(normal OR exceptional entry, and it replaces "
+                        "any in-flight exception) the release is "
+                        "skipped, exactly on the failure path that most "
+                        "needs the cleanup; release first, or mark a "
+                        "deliberate leak-on-poison `# kvmini: resource-ok`",
+                        fn.qualname)
+                    break
+
+
+def check(index: FactIndex) -> list[Diagnostic]:
+    return ResourcePathChecker(index).run()

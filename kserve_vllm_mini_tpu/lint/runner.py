@@ -14,7 +14,9 @@ from kserve_vllm_mini_tpu.lint import (
     dtype_flow,
     jit_purity,
     lockstep,
+    mesh_flow,
     metrics_drift,
+    resource_paths,
     workload,
 )
 from kserve_vllm_mini_tpu.lint.diagnostics import RULES, Diagnostic
@@ -32,8 +34,30 @@ CHECKERS = (
     ("KVM05", "concurrency", concurrency.check),
     ("KVM06", "dtype_flow", dtype_flow.check),
     ("KVM07", "buffer_lifecycle", buffer_lifecycle.check),
+    ("KVM08", "mesh_flow", mesh_flow.check),
+    ("KVM09", "resource_paths", resource_paths.check),
 )
 METRICS_FAMILY = "KVM03"
+
+# diagnostic code prefix -> the CHECKERS/timings display name, for the
+# per-family finding counts the --timing-out report carries
+FAMILY_NAMES = {family: name for family, name, _ in CHECKERS}
+FAMILY_NAMES[METRICS_FAMILY] = "metrics_drift"
+FAMILY_NAMES["KVM001"] = "stale_suppressions"
+
+
+def counts_by_checker(diags: list[Diagnostic],
+                      timings: dict[str, float]) -> dict[str, int]:
+    """Finding counts keyed like the timing table (checkers that ran but
+    found nothing report an explicit 0 — absence means 'did not run')."""
+    out = {name: 0 for name in timings if name != "facts"}
+    for d in diags:
+        for prefix in sorted(FAMILY_NAMES, key=len, reverse=True):
+            if d.code.startswith(prefix):
+                name = FAMILY_NAMES[prefix]
+                out[name] = out.get(name, 0) + 1
+                break
+    return out
 
 
 def discover_py_files(paths: Iterable[Path]) -> list[Path]:
@@ -73,7 +97,7 @@ def normalize_families(families: Optional[Iterable[str]]) -> Optional[set[str]]:
         if not norm.startswith("KVM") or not any(
                 code.startswith(norm) for code in selectable):
             raise ValueError(
-                f"unknown rule family {f!r} (families: KVM01..KVM07, or a "
+                f"unknown rule family {f!r} (families: KVM01..KVM09, or a "
                 "full code like KVM051; KVM001 always rides along and is "
                 "not selectable)")
         out.add(norm)
@@ -164,25 +188,133 @@ def _rel(root: Path, p: Path) -> Path:
         return p
 
 
+def changed_scan_paths(root: Path, paths: list[Path],
+                       ref: str) -> list[Path]:
+    """The `--changed` file set: python files under ``paths`` that differ
+    from ``ref`` (``git diff --name-only``) or are untracked (``git
+    ls-files --others`` — a brand-new module must never make the scan
+    silently green), plus their cross-file consumers via a reverse
+    import map — a consumer's facts reference the changed module, so its
+    findings can change too. Git prints paths relative to the repo
+    TOPLEVEL, not the cwd, so they are resolved against it. Raises
+    RuntimeError when git cannot produce the diff (loud, never a
+    silently-empty scan)."""
+    import subprocess
+
+    def git(*args: str) -> str:
+        proc = subprocess.run(["git", *args], cwd=root,
+                              capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"git {' '.join(args)} failed: "
+                f"{proc.stderr.strip() or proc.stdout.strip()}")
+        return proc.stdout
+
+    toplevel = Path(git("rev-parse", "--show-toplevel").strip())
+    # diff prints toplevel-relative paths; ls-files prints CWD-relative
+    # ones unless --full-name forces toplevel — without it, untracked
+    # files are silently missed whenever the scan runs in a subdirectory
+    listed = (git("diff", "--name-only", ref, "--")
+              + git("ls-files", "--others", "--exclude-standard",
+                    "--full-name"))
+    diff = {(toplevel / line.strip()).resolve()
+            for line in listed.splitlines() if line.strip()}
+    scope = discover_py_files(paths)
+    changed = [f for f in scope if f.resolve() in diff]
+    if not changed:
+        return []
+    by_rel = {_rel(root, f).as_posix(): f for f in scope}
+    changed_rel = {_rel(root, f).as_posix() for f in changed}
+    consumer_rel = _reverse_import_deps(root, scope, changed_rel)
+    return sorted(
+        {by_rel[r] for r in (changed_rel | consumer_rel) if r in by_rel})
+
+
+def _reverse_import_deps(root: Path, scope: list[Path],
+                         changed_rel: set[str]) -> set[str]:
+    """Repo-relative paths of scope modules importing a changed module.
+    A parse-imports-only pass (one ``ast.parse`` per file, no function
+    walk) — building the full FactIndex here would cost the `--changed`
+    mode most of the full-scan time it exists to avoid. Resolution
+    mirrors FactIndex.module_for_dotted: exact dotted name, then suffix
+    match inside the scanned package."""
+    import ast
+
+    by_dotted: dict[str, str] = {}
+    for f in scope:
+        rel = _rel(root, f).as_posix()
+        dotted = rel[:-3].replace("/", ".") if rel.endswith(".py") else rel
+        if dotted.endswith(".__init__"):
+            dotted = dotted[: -len(".__init__")]
+        by_dotted[dotted] = rel
+
+    def resolve(dotted: str) -> Optional[str]:
+        rel = by_dotted.get(dotted)
+        if rel is None and dotted:
+            for d, r in by_dotted.items():
+                if d.endswith("." + dotted) or d == dotted:
+                    return r
+        return rel
+
+    out: set[str] = set()
+    for f in scope:
+        rel = _rel(root, f).as_posix()
+        if rel in changed_rel:
+            continue
+        try:
+            tree = ast.parse(f.read_text(encoding="utf-8"))
+        except (OSError, SyntaxError):
+            continue  # the scan itself reports parse errors
+        deps: set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    target = resolve(a.name)
+                    if target:
+                        deps.add(target)
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                target = resolve(mod)
+                if target:
+                    deps.add(target)
+                for a in node.names:
+                    # `from pkg import module` binds a submodule
+                    sub = resolve(f"{mod}.{a.name}" if mod else a.name)
+                    if sub:
+                        deps.add(sub)
+        if deps & changed_rel:
+            out.add(rel)
+    return out
+
+
 def run_lint(
     paths: list[Path],
     doc_paths: Optional[list[Path]] = None,
     baseline_path: Optional[Path] = None,
     root: Optional[Path] = None,
     families: Optional[set[str]] = None,
+    baseline_scope_to_paths: bool = False,
 ) -> LintResult:
+    """``baseline_scope_to_paths``: restrict the baseline gate to entries
+    for the scanned files — a `--changed` subset scan must not call an
+    unscanned file's grandfathered finding stale (the full scan still
+    ratchets it). Ordinary single-file scans keep whole-baseline
+    semantics: a fixed finding flags stale no matter which file you ran."""
     root = (root or Path.cwd()).resolve()
     files = discover_py_files(paths)
     timings: dict[str, float] = {}
     t0 = time.perf_counter()
     index = FactIndex.build(root, [root / _rel(root, f) for f in files])
     timings["facts"] = time.perf_counter() - t0
+    # absence-based rules (mesh scopes, axis vocabulary) stand down on
+    # partial scans — the missing fact may live in an unscanned module
+    index.full_scan = bool(paths) and all(p.is_dir() for p in paths)
 
     # cross-surface drift (KVM032 vs docs/dashboards) asserts over the
     # WHOLE emitter set, so it only runs for directory scans — linting a
     # single changed file must not fail on metrics that other (unscanned)
     # emitter modules provide
-    full_scan = bool(paths) and all(p.is_dir() for p in paths)
+    full_scan = index.full_scan
     doc_texts: dict[str, str] = {}
     if full_scan and _family_selected(families, METRICS_FAMILY):
         for doc in discover_doc_files(doc_paths or []):
@@ -227,7 +359,11 @@ def run_lint(
     result = LintResult(diagnostics=unique, parse_errors=index.parse_errors,
                         timings={k: round(v, 4) for k, v in timings.items()})
     if baseline_path is not None and baseline_path.exists():
-        result.baseline_diff = baseline_mod.diff(
-            unique, _filter_baseline(baseline_mod.load(baseline_path),
-                                     families, active_tokens))
+        base = _filter_baseline(baseline_mod.load(baseline_path),
+                                families, active_tokens)
+        if baseline_scope_to_paths:
+            scanned = {_rel(root, f).as_posix() for f in files}
+            base = {k: n for k, n in base.items()
+                    if k.split("::", 1)[0] in scanned}
+        result.baseline_diff = baseline_mod.diff(unique, base)
     return result

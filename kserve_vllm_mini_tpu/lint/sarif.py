@@ -38,6 +38,8 @@ FAMILY_LEVELS = {
     "KVM05": "error",     # thread safety / lock discipline
     "KVM06": "error",     # numerics / dtype flow
     "KVM07": "error",     # buffer lifecycle
+    "KVM08": "error",     # mesh/sharding consistency (perf-silent wrongness)
+    "KVM09": "error",     # exception-path resource safety
 }
 
 

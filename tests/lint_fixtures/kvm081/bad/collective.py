@@ -1,0 +1,34 @@
+"""Seeded mesh bug (ISSUE KVM081): a psum over an axis the enclosing
+shard_map's mesh never binds — XLA fails at lowering time at best, and
+resolves against the wrong mesh axis at worst. The mesh travels the
+repo's real route: construction site -> builder param -> shard_map
+scope, so the checker's cross-function fact table is exercised
+end-to-end."""
+
+from functools import partial
+
+import jax
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+AXES = ("dp", "tp")
+
+
+def make_mesh(devices):
+    return Mesh(devices, AXES)
+
+
+def build_reduce(mesh: Mesh):
+    @partial(shard_map, mesh=mesh, in_specs=(P("dp", None),),
+             out_specs=P("dp", None))
+    def reduce_local(x):
+        return jax.lax.psum(x, "sp")  # "sp" is not an axis of this mesh
+
+    return reduce_local
+
+
+def main():
+    import numpy as np
+
+    mesh = make_mesh(np.array(jax.devices()).reshape(2, 1))
+    return build_reduce(mesh)

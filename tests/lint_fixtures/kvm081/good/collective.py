@@ -1,0 +1,37 @@
+"""Same shape, axis bound: the collective names an axis the enclosing
+mesh scope declares, and the axis-as-parameter helper shows the legal
+runtime-axis form (checked at its callers, never guessed)."""
+
+from functools import partial
+
+import jax
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+AXES = ("dp", "tp")
+
+
+def make_mesh(devices):
+    return Mesh(devices, AXES)
+
+
+def _local_sum(x, axis_name):
+    # runtime-parameter axis: bound by whatever scope the caller runs
+    # under — not checkable here, so never flagged here
+    return jax.lax.psum(x, axis_name)
+
+
+def build_reduce(mesh: Mesh):
+    @partial(shard_map, mesh=mesh, in_specs=(P("dp", None),),
+             out_specs=P("dp", None))
+    def reduce_local(x):
+        return _local_sum(jax.lax.psum(x, "dp"), "tp")
+
+    return reduce_local
+
+
+def main():
+    import numpy as np
+
+    mesh = make_mesh(np.array(jax.devices()).reshape(2, 1))
+    return build_reduce(mesh)

@@ -1,0 +1,33 @@
+"""Seeded sharding bugs (ISSUE KVM082): a PartitionSpec one entry short
+of its annotated shape (the trailing axis silently replicates), an axis
+typo no mesh declares (shards nothing), and an in_specs tuple whose
+arity cannot match the shard_map'd function's parameters."""
+
+from functools import partial
+
+import jax
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+AXES = ("dp", "tp")
+
+
+def make_mesh(devices):
+    return Mesh(devices, AXES)
+
+
+def kv_spec():
+    return P("dp", None, "tp", None)  # [L, KVH, S] — 4 entries, 3 dims
+
+
+def logits_spec():
+    return P("tpu", None)  # "tpu" is not an axis any mesh declares
+
+
+def build(mesh: Mesh):
+    @partial(shard_map, mesh=mesh, in_specs=(P("dp"), P(None)),
+             out_specs=P(None))
+    def f(x):  # two in_specs, one parameter
+        return x
+
+    return f

@@ -1,0 +1,22 @@
+"""Seeded perf bug (ISSUE KVM083): a device_put inside the decode
+dispatch path. The placement runs again on EVERY step — a hidden
+reshard/transfer (silent all-gather class) that serializes the decode
+pipeline, when the data should be placed once at setup."""
+
+import jax
+
+
+def _step(tokens, state):
+    return tokens + 1, state
+
+
+step = jax.jit(_step)
+
+
+class DecodeLoop:
+    def __init__(self, sharding):
+        self.sharding = sharding
+
+    def decode_once(self, tokens, state):
+        tokens = jax.device_put(tokens, self.sharding)  # reshard per step
+        return step(tokens, state)

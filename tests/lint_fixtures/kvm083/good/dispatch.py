@@ -1,0 +1,26 @@
+"""Same shape, intent annotated: this dispatch-path placement is a
+deliberate once-per-batch host handoff (not a per-step reshard), so it
+carries the suppression with its one-line justification — and the
+setup-path device_put needs nothing (constructors run once)."""
+
+import jax
+
+
+def _step(tokens, state):
+    return tokens + 1, state
+
+
+step = jax.jit(_step)
+
+
+class DecodeLoop:
+    def __init__(self, sharding, tokens):
+        self.sharding = sharding
+        # setup placement: __init__ runs once, not on the decode path
+        self.tokens = jax.device_put(tokens, sharding)
+
+    def decode_once(self, tokens, state):
+        # new batch entering the loop: one placement per admission, not
+        # per step  # kvmini: mesh-ok
+        tokens = jax.device_put(tokens, self.sharding)
+        return step(tokens, state)

@@ -1,0 +1,43 @@
+"""Seeded donation bug (ISSUE KVM084): the cache is donated by the
+enclosing jit root, but its in_spec at the shard_map boundary matches
+no out_spec — the donation cannot alias across a sharding change, so
+XLA silently copies and steady-state HBM doubles exactly where the
+donation was meant to prevent it."""
+
+from functools import partial
+
+import jax
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+AXES = ("dp", "tp")
+
+
+def make_mesh(devices):
+    return Mesh(devices, AXES)
+
+
+def make_forward(mesh: Mesh):
+    @partial(jax.jit, donate_argnums=(1,))
+    def run(params, cache):
+        @partial(
+            shard_map,
+            mesh=mesh,
+            in_specs=(P(None, None), P("tp", None)),
+            out_specs=(P(None, None), P(None, None)),  # cache resharded
+        )
+        def inner(params, cache):
+            # shard_map has no donation knob — the enclosing jit (run,
+            # donate_argnums=(1,)) owns the cache  # kvmini: buffer-ok
+            return params, cache
+
+        return inner(params, cache)
+
+    return run
+
+
+def build():
+    import numpy as np
+
+    mesh = make_mesh(np.array(jax.devices()).reshape(2, 1))
+    return make_forward(mesh)

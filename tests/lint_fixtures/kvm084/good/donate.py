@@ -1,0 +1,41 @@
+"""Same shape, donation aliasable: the cache comes OUT of the shard_map
+boundary with the same spec it went in with, so the enclosing jit's
+donation aliases in place (the parallel/serving_pp.py convention)."""
+
+from functools import partial
+
+import jax
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+AXES = ("dp", "tp")
+
+
+def make_mesh(devices):
+    return Mesh(devices, AXES)
+
+
+def make_forward(mesh: Mesh):
+    @partial(jax.jit, donate_argnums=(1,))
+    def run(params, cache):
+        @partial(
+            shard_map,
+            mesh=mesh,
+            in_specs=(P(None, None), P("tp", None)),
+            out_specs=(P(None, None), P("tp", None)),  # same spec out
+        )
+        def inner(params, cache):
+            # shard_map has no donation knob — the enclosing jit (run,
+            # donate_argnums=(1,)) owns the cache  # kvmini: buffer-ok
+            return params, cache
+
+        return inner(params, cache)
+
+    return run
+
+
+def build():
+    import numpy as np
+
+    mesh = make_mesh(np.array(jax.devices()).reshape(2, 1))
+    return make_forward(mesh)

@@ -1,0 +1,22 @@
+"""Seeded resource bug (ISSUE KVM091): the slot popped off the free
+list leaks when prefill raises — the except branch returns while the
+happy path still owed a release or an ownership transfer (the engine's
+admission-path bug class, runtime/engine.py _admit_one)."""
+
+
+class Engine:
+    def __init__(self, n):
+        self._free = list(range(n))
+        self._slot_req = {}
+
+    def _prefill(self, req):
+        return sum(req)
+
+    def admit(self, req):
+        slot = self._free.pop()
+        try:
+            logits = self._prefill(req)
+        except ValueError:
+            return None  # slot escapes: neither released nor transferred
+        self._slot_req[slot] = (req, logits)
+        return slot

@@ -1,0 +1,33 @@
+"""Same shape, every path settles the slot: the except branch releases,
+the happy path transfers ownership into the slot table (the table IS
+the ownership record), a try/finally variant releases on every path,
+and pop_slot hands the slot to its caller (transfer via return)."""
+
+
+class Engine:
+    def __init__(self, n):
+        self._free = list(range(n))
+        self._slot_req = {}
+
+    def _prefill(self, req):
+        return sum(req)
+
+    def admit(self, req):
+        slot = self._free.pop()
+        try:
+            logits = self._prefill(req)
+        except ValueError:
+            self._free.append(slot)  # error path gives the slot back
+            return None
+        self._slot_req[slot] = (req, logits)  # ownership -> slot table
+        return slot
+
+    def probe(self, req):
+        slot = self._free.pop()
+        try:
+            return self._prefill(req)
+        finally:
+            self._free.append(slot)  # released on EVERY path
+
+    def pop_slot(self):
+        return self._free.pop(), 0  # transfer via return: caller owns it

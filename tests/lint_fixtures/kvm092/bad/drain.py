@@ -1,0 +1,22 @@
+"""Seeded resource bug (ISSUE KVM092): the drain path releases a slot
+the abort branch already released — on the mid-prefill path both
+releases run, and the second one frees a slot the next admission may
+already own (the engine's drain/recovery bug class)."""
+
+
+class Engine:
+    def __init__(self, n):
+        self._free = list(range(n))
+        self._slot_prefill = {}
+
+    def _release_slot(self, slot):
+        self._slot_prefill[slot] = None
+        self._free.append(slot)
+
+    def _abort_prefill(self, slot):
+        self._release_slot(slot)
+
+    def drain(self, slot, mid_prefill):
+        if mid_prefill:
+            self._abort_prefill(slot)
+        self._release_slot(slot)  # second release on the mid-prefill path

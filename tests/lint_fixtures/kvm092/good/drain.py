@@ -1,0 +1,29 @@
+"""Same shape, one release per path: the branches are exclusive, and
+the deliberately-idempotent confirm path carries the suppression with
+its one-line justification (a used `resource-ok`)."""
+
+
+class Engine:
+    def __init__(self, n):
+        self._free = list(range(n))
+        self._slot_prefill = {}
+
+    def _release_slot(self, slot):
+        self._slot_prefill[slot] = None
+        self._free.append(slot)
+
+    def _abort_prefill(self, slot):
+        self._release_slot(slot)
+
+    def drain(self, slot, mid_prefill):
+        if mid_prefill:
+            self._abort_prefill(slot)
+        else:
+            self._release_slot(slot)  # exclusive: one release per path
+
+    def confirm_release(self, slot):
+        self._release_slot(slot)
+        # idempotent by design: the watchdog may have released this slot
+        # already; the drain re-runs the (set-to-None, re-append-guarded)
+        # bookkeeping on purpose  # kvmini: resource-ok
+        self._release_slot(slot)

@@ -1,0 +1,20 @@
+"""Seeded resource bug (ISSUE KVM093): the finally raises before the
+pending release in the same block — the raise wins every path through
+the finally (normal AND exceptional), so the slot never goes back."""
+
+
+class Engine:
+    def __init__(self, n):
+        self._free = list(range(n))
+
+    def _sweep(self, slot):
+        return slot * 2
+
+    def recover(self, slot, poisoned):
+        try:
+            out = self._sweep(slot)
+        finally:
+            if poisoned:
+                raise RuntimeError("engine fault past the degrade ladder")
+            self._free.append(slot)  # skipped whenever the raise fires
+        return out

@@ -1,0 +1,19 @@
+"""Same shape, release first: the slot goes back to the free list
+before the finally decides to re-raise, so no path skips the release."""
+
+
+class Engine:
+    def __init__(self, n):
+        self._free = list(range(n))
+
+    def _sweep(self, slot):
+        return slot * 2
+
+    def recover(self, slot, poisoned):
+        try:
+            out = self._sweep(slot)
+        finally:
+            self._free.append(slot)  # release before any re-raise
+            if poisoned:
+                raise RuntimeError("engine fault past the degrade ladder")
+        return out

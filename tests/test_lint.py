@@ -88,6 +88,19 @@ CASES = [
     ("kvm073", {"KVM073": 2}),  # ISSUE seeded bug: double-free of a KV block
     #                             id (+ a table write after free)
     ("kvm074", {"KVM074": 1}),  # retained-LRU claim without unpin
+    ("kvm081", {"KVM081": 1}),  # ISSUE seeded bug: psum over an axis the
+    #                             enclosing shard_map's mesh never binds
+    ("kvm082", {"KVM082": 3}),  # ISSUE seeded bug: wrong-arity PartitionSpec
+    #                             (+ axis typo + in_specs/param mismatch)
+    ("kvm083", {"KVM083": 1}),  # ISSUE seeded bug: device_put in the decode
+    #                             dispatch path (per-step hidden reshard)
+    ("kvm084", {"KVM084": 1}),  # donated cache resharded across the
+    #                             shard_map boundary (silent copy)
+    ("kvm091", {"KVM091": 1}),  # ISSUE seeded bug: slot acquire leaking
+    #                             through an except branch
+    ("kvm092", {"KVM092": 1}),  # ISSUE seeded bug: double release on the
+    #                             drain path (abort already released)
+    ("kvm093", {"KVM093": 1}),  # finally re-raises past the pending release
 ]
 
 
@@ -205,7 +218,7 @@ def test_family_filter_full_code_and_validation(capsys):
     bad51 = str(FIXTURES / "kvm051" / "bad")
     assert lint_main([bad51, "--no-baseline", "--family", "KVM051"]) == 1
     capsys.readouterr()
-    assert lint_main([bad51, "--no-baseline", "--family", "KVM09"]) == 2
+    assert lint_main([bad51, "--no-baseline", "--family", "KVM99"]) == 2
     # a family-sliced baseline would silently drop every other family
     assert lint_main([bad51, "--family", "KVM05", "--write-baseline"]) == 2
 
@@ -270,6 +283,12 @@ def test_timing_report(tmp_path, capsys):
                       "--timing-out", str(report)]) == 1
     doc = json.loads(report.read_text())
     assert "concurrency" in doc["timings"] and doc["findings"] == 1
+    # per-family counts ride along: ms alone can't tell "fast because
+    # clean" from "fast because broken"
+    counts = doc["findings_by_checker"]
+    assert counts["concurrency"] == 1
+    # every checker that ran reports an explicit 0 (absence = didn't run)
+    assert counts["mesh_flow"] == 0 and counts["resource_paths"] == 0
 
 
 def test_sarif_output(tmp_path):
@@ -316,6 +335,185 @@ def test_write_baseline_refuses_parse_errors(tmp_path, capsys):
     assert "parse error" in capsys.readouterr().err
 
 
+# -- --changed mode: the fast pre-commit subset scan -------------------------
+
+def _git(tmp_path, *args):
+    import subprocess
+
+    subprocess.run(["git", *args], cwd=tmp_path, check=True,
+                   capture_output=True)
+
+
+def test_changed_mode_scans_changed_files_plus_consumers(tmp_path,
+                                                         monkeypatch,
+                                                         capsys):
+    """--changed REF lints only the git-diff files AND their importers
+    (reverse deps through the fact index); untouched non-consumers stay
+    out of the scan even when they carry findings of their own."""
+    (tmp_path / "base.py").write_text(
+        "import jax\n\n\n@jax.jit\ndef f(x):\n    return x\n")
+    (tmp_path / "consumer.py").write_text(
+        "import time\n\nimport jax\n\nfrom base import f\n\n\n"
+        "@jax.jit\ndef g(x):\n    return f(x) * time.time()\n")
+    (tmp_path / "other.py").write_text(
+        "import time\n\nimport jax\n\n\n"
+        "@jax.jit\ndef h(x):\n    return x * time.time()\n")
+    _git(tmp_path, "init", "-q")
+    _git(tmp_path, "config", "user.email", "lint@test")
+    _git(tmp_path, "config", "user.name", "lint test")
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-q", "-m", "seed")
+    # mutate ONLY base.py (introduce its own finding too)
+    (tmp_path / "base.py").write_text(
+        "import time\n\nimport jax\n\n\n"
+        "@jax.jit\ndef f(x):\n    return x * time.time()\n")
+    monkeypatch.chdir(tmp_path)
+    rc = lint_main([".", "--changed", "HEAD", "--no-baseline",
+                    "--format", "json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    paths = {f["path"] for f in doc["findings"]}
+    # base changed; consumer imports base (re-linted); other is untouched
+    assert any(p.endswith("base.py") for p in paths)
+    assert any(p.endswith("consumer.py") for p in paths)
+    assert not any(p.endswith("other.py") for p in paths)
+
+
+def test_changed_mode_nothing_changed_and_bad_ref(tmp_path, monkeypatch,
+                                                  capsys):
+    (tmp_path / "mod.py").write_text("x = 1\n")
+    _git(tmp_path, "init", "-q")
+    _git(tmp_path, "config", "user.email", "lint@test")
+    _git(tmp_path, "config", "user.name", "lint test")
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-q", "-m", "seed")
+    monkeypatch.chdir(tmp_path)
+    assert lint_main([".", "--changed", "HEAD"]) == 0
+    assert "nothing to lint" in capsys.readouterr().out
+    # an unresolvable ref fails LOUDLY (rc 2), never a silently-green scan
+    assert lint_main([".", "--changed", "no-such-ref"]) == 2
+    # the baseline must come from a full scan, never a subset
+    assert lint_main([".", "--changed", "HEAD", "--write-baseline"]) == 2
+
+
+def test_changed_mode_includes_untracked_files(tmp_path, monkeypatch,
+                                               capsys):
+    """A brand-new (untracked) module never shows in `git diff`, but it
+    must still be scanned — 'nothing to lint' on a new file would be the
+    silently-green scan docs/LINTING.md promises never happens."""
+    (tmp_path / "old.py").write_text("x = 1\n")
+    _git(tmp_path, "init", "-q")
+    _git(tmp_path, "config", "user.email", "lint@test")
+    _git(tmp_path, "config", "user.name", "lint test")
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-q", "-m", "seed")
+    (tmp_path / "brandnew.py").write_text(
+        "import time\n\nimport jax\n\n\n"
+        "@jax.jit\ndef h(x):\n    return x * time.time()\n")
+    monkeypatch.chdir(tmp_path)
+    rc = lint_main([".", "--changed", "HEAD", "--no-baseline",
+                    "--format", "json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert any(f["path"].endswith("brandnew.py") for f in doc["findings"])
+
+
+def test_changed_mode_resolves_git_paths_from_a_subdirectory(tmp_path,
+                                                             monkeypatch,
+                                                             capsys):
+    """git prints paths relative to the repo TOPLEVEL; running the scan
+    from a subdirectory must still intersect them with the scope."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text("import jax\n\n\n@jax.jit\ndef f(x):\n    return x\n")
+    _git(tmp_path, "init", "-q")
+    _git(tmp_path, "config", "user.email", "lint@test")
+    _git(tmp_path, "config", "user.name", "lint test")
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-q", "-m", "seed")
+    (pkg / "mod.py").write_text(
+        "import time\n\nimport jax\n\n\n"
+        "@jax.jit\ndef f(x):\n    return x * time.time()\n")
+    # ...and an UNTRACKED file: ls-files prints cwd-relative paths
+    # (unlike diff's toplevel-relative ones) — --full-name must align
+    # them or the combination untracked+subdir is silently missed
+    (pkg / "fresh.py").write_text(
+        "import time\n\nimport jax\n\n\n"
+        "@jax.jit\ndef g(x):\n    return x * time.time()\n")
+    monkeypatch.chdir(pkg)
+    rc = lint_main([".", "--changed", "HEAD", "--no-baseline",
+                    "--format", "json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert any(f["path"].endswith("mod.py") for f in doc["findings"])
+    assert any(f["path"].endswith("fresh.py") for f in doc["findings"])
+
+
+def test_partial_scan_never_invents_mesh_findings(tmp_path, monkeypatch,
+                                                  capsys):
+    """Subset-vs-full soundness for the absence-based mesh rules: helper
+    runs a collective under wrapper.py's shard_map scope; a --changed
+    scan touching only the helper cannot see the scope and must stand
+    DOWN (no KVM081), not misread the helper as scope-free."""
+    (tmp_path / "helper_mod.py").write_text(
+        "import jax\n\n\n@jax.jit\ndef helper(x):\n"
+        "    return jax.lax.psum(x, 'dp')\n")
+    (tmp_path / "wrapper.py").write_text(
+        "from functools import partial\n\n"
+        "import jax\nfrom jax import shard_map\n"
+        "from jax.sharding import Mesh, PartitionSpec as P\n\n"
+        "from helper_mod import helper\n\nAXES = ('dp', 'tp')\n\n\n"
+        "def build(devices):\n"
+        "    mesh = Mesh(devices, AXES)\n\n"
+        "    @partial(shard_map, mesh=mesh, in_specs=(P('dp'),),\n"
+        "             out_specs=P('dp'))\n"
+        "    def run(x):\n"
+        "        return helper(x)\n\n"
+        "    return run\n")
+    _git(tmp_path, "init", "-q")
+    _git(tmp_path, "config", "user.email", "lint@test")
+    _git(tmp_path, "config", "user.name", "lint test")
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-q", "-m", "seed")
+    (tmp_path / "helper_mod.py").write_text(
+        "import jax\n\n\n@jax.jit\ndef helper(x):\n"
+        "    return jax.lax.psum(x, 'dp') + 0\n")
+    monkeypatch.chdir(tmp_path)
+    # full scan: scope resolves, axis bound — clean
+    assert lint_main([".", "--no-baseline"]) == 0
+    # subset scan (helper only — wrapper imports it, so it IS pulled in
+    # as a consumer; the point stands via the single-file form too)
+    assert lint_main([".", "--changed", "HEAD", "--no-baseline"]) == 0
+    capsys.readouterr()
+    # the single-file scan is the pure absence case: no scope in view
+    assert lint_main([str(tmp_path / "helper_mod.py"),
+                      "--no-baseline"]) == 0
+
+
+def test_changed_mode_scopes_baseline_to_scanned_files(tmp_path,
+                                                       monkeypatch):
+    """A subset scan must not call an unscanned file's grandfathered
+    finding stale — only the full scan ratchets the whole baseline."""
+    (tmp_path / "legacy.py").write_text(
+        "import time\n\nimport jax\n\n\n"
+        "@jax.jit\ndef old(x):\n    return x * time.time()\n")
+    (tmp_path / "fresh.py").write_text("import jax\n\n\ndef g(x):\n    return x\n")
+    _git(tmp_path, "init", "-q")
+    _git(tmp_path, "config", "user.email", "lint@test")
+    _git(tmp_path, "config", "user.name", "lint test")
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-q", "-m", "seed")
+    monkeypatch.chdir(tmp_path)
+    bl = tmp_path / "bl.json"
+    assert lint_main([".", "--write-baseline", "--baseline", str(bl)]) == 0
+    # touch ONLY fresh.py: legacy's grandfathered finding is out of scope
+    (tmp_path / "fresh.py").write_text(
+        "import jax\n\n\ndef g(x):\n    return x + 1\n")
+    assert lint_main([".", "--changed", "HEAD", "--baseline", str(bl)]) == 0
+    # the FULL scan still sees the whole baseline (nothing stale yet)
+    assert lint_main([".", "--baseline", str(bl)]) == 0
+
+
 # -- the live codebase stays pinned to the committed baseline ----------------
 
 def test_live_codebase_matches_baseline_exactly():
@@ -340,15 +538,16 @@ def test_live_codebase_matches_baseline_exactly():
     )
     assert not [d for d in result.diagnostics if d.code == "KVM001"], (
         "stale `# kvmini:` suppressions in the live tree (dtype-ok/"
-        "buffer-ok included — KVM001 tracks every token)"
+        "buffer-ok/mesh-ok/resource-ok included — KVM001 tracks every token)"
     )
-    # every family ran and reported its wall time — all eight timing
+    # every family ran and reported its wall time — all TEN timing
     # entries, the `--timing` surface CI uploads to attribute speed drift
     assert {"facts", "jit_purity", "lockstep", "workload", "concurrency",
-            "metrics_drift", "dtype_flow", "buffer_lifecycle"
-            } <= set(result.timings)
-    # 20s: ~8-9s idle on this box after the KVM06x/07x families landed
-    # (~11.5s under full-suite load — the old 12s pin would flake the
-    # same way the 10s pin did). lint-timing.json (CI artifact) still
-    # names the checker if one of them regresses.
+            "metrics_drift", "dtype_flow", "buffer_lifecycle",
+            "mesh_flow", "resource_paths"} <= set(result.timings)
+    # 20s: ~9s idle on this box with all TEN families (KVM08x/09x added
+    # ~1.2s combined; ~12s under full-suite load — a 12s pin would flake
+    # the same way the 10s one did). lint-timing.json (CI artifact, now
+    # with per-family finding counts) still names the checker if one of
+    # them regresses.
     assert elapsed < 20.0, f"kvmini-lint took {elapsed:.1f}s (budget 20s)"
