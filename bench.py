@@ -105,6 +105,22 @@ def _env_slots() -> int:
     return int(_knob("KVMINI_BENCH_SLOTS"))
 
 
+def _env_disagg():
+    """Whether to run the disaggregated-prefill sub-bench rows
+    (runtime/disagg.py; docs/DISAGGREGATION.md). Loud validation at the
+    knob: a garbled value must not silently bench the colocated path
+    under a disagg label."""
+    raw = _knob("KVMINI_BENCH_DISAGG")
+    if not raw:
+        return False
+    if raw not in ("0", "1", "true", "false"):
+        raise SystemExit(
+            f"KVMINI_BENCH_DISAGG={raw!r}: must be '1'/'true' (bench the "
+            "disaggregated prefill lane) or '0'/'false'/empty (colocated)"
+        )
+    return raw in ("1", "true")
+
+
 def _env_prefill_chunk():
     """Tokens per interleaved prefill chunk, or None (monolithic). Loud
     validation at the knob: a garbled value must not silently bench the
@@ -501,6 +517,63 @@ def _run_serving_child(mode: str) -> dict:
         }
         _progress(f"{mode}.prefill_chunked", row)
         _log(f"chunked prefill ({n_pieces} x {ch}): {row}")
+
+    # -- disaggregated prefill lane (KVMINI_BENCH_DISAGG): the same prompt
+    # as the TTFT probe prefilled into a 1-slot STAGING cache (the lane's
+    # executable, runtime/disagg.py) and then handed off — the staged
+    # stripe injected into the serving cache at slot 0 (update_cache_slots,
+    # the engine's inject executable). Timed end-to-end so the row reads
+    # next to ttft_p50: the delta vs monolithic is the handoff tax, and
+    # what it buys is that NONE of the staging wall ran on the decode
+    # lane (docs/DISAGGREGATION.md).
+    if _env_disagg() and not paged:
+        from kserve_vllm_mini_tpu.models.llama import (
+            slice_cache_slots,
+            update_cache_slots,
+        )
+
+        staging = init_kv_cache(cfg, 1, max_seq=max_seq, quantized=kv_quant)
+
+        @jax.jit
+        def lane_prefill(params, cache, toks, pos):
+            logits, cache = forward(
+                params, cfg, toks, pos, cache, jnp.zeros((1,), jnp.int32),
+                fresh_prefill=True,
+                logit_index=jnp.full((1,), prompt_len - 1, jnp.int32),
+            )
+            return cache, jnp.argmax(logits[:, -1, :], axis=-1)
+
+        slice0 = jax.jit(lambda c: slice_cache_slots(c, 0))
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def inject(cache, sub):
+            return update_cache_slots(cache, sub, jnp.int32(0))
+
+        def handoff_once():
+            st, tok = lane_prefill(params, staging, toks1, pos1)
+            sub = slice0(st)
+            nonlocal cache
+            cache = inject(cache, sub)
+            # the row claims the WHOLE handoff (prefill + slice + inject):
+            # tok only depends on the prefill, so block on the injected
+            # cache too or the slice/inject device time leaks out of the
+            # timed window and the handoff tax under-reports
+            jax.block_until_ready(cache)
+            return tok
+
+        _ = np.asarray(handoff_once())  # compile + warm all three
+        samples = []
+        for _i in range(5):
+            t0 = time.time()
+            _ = np.asarray(handoff_once())
+            samples.append((time.time() - t0) * 1000.0)
+        row = {
+            "ms_p50": round(sorted(samples)[len(samples) // 2], 2),
+            "monolithic_ttft_p50_ms": round(ttft_p50, 2),
+            "handoff_blocks": -(-prompt_len // blk),
+        }
+        _progress(f"{mode}.disagg_prefill", row)
+        _log(f"disagg lane prefill + handoff: {row}")
 
     # -- prefill throughput buckets (VERDICT round-4 #8: prefill is the
     # compute-bound side — tokens/s/chip + MFU, not just TTFT) ------------
@@ -1548,6 +1621,15 @@ _ENV_KNOBS = {
         "guard prices the per-chunk workspace, and the proxy tier sizes "
         "its chunk-prefill cost entry to match — so sweeps can put "
         "chunk size on an axis; empty = monolithic prefill",
+    ),
+    "KVMINI_BENCH_DISAGG": (
+        "--disagg", "",
+        "'1' benches the disaggregated prefill lane (runtime/disagg.py, "
+        "docs/DISAGGREGATION.md): the serving children time the lane's "
+        "staging prefill + KV-block handoff injection next to the "
+        "monolithic TTFT probe (the {mode}.disagg_prefill row), and the "
+        "proxy tier's disagg_prefill compile-stats entry tracks the lane "
+        "executable across dark rounds either way; empty = colocated",
     ),
     "KVMINI_BENCH_UNROLL": (
         "--unroll", "1",

@@ -148,6 +148,12 @@ def analyze_run(
     update.update(
         telemetry.resilience_block(endpoint, runtime_metrics=runtime_metrics)
     )
+    # disaggregated-serving block (docs/DISAGGREGATION.md): prefill-lane
+    # handoff counters; only disaggregated in-repo runtimes export the
+    # rail, so the same absent-not-zero rule applies
+    update.update(
+        telemetry.disagg_block(endpoint, runtime_metrics=runtime_metrics)
+    )
 
     # server-side request traces (docs/TRACING.md): fetch /traces, merge
     # the server leg into runs/<id>/traces/traces.json joined by trace_id,

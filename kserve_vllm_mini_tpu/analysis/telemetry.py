@@ -501,6 +501,48 @@ def resilience_block(
     return {"resilience": block}
 
 
+# results.json `disagg` sub-key -> runtime metric (docs/
+# DISAGGREGATION.md). Keyed by SUB-KEY (the COMPILE/KV/RESILIENCE
+# orientation) because the whole map lands under the one typed `disagg`
+# results field. Only disaggregated engines export the series at all.
+DISAGG_METRIC_KEYS = {
+    "handoffs": "kvmini_tpu_kv_handoffs_total",
+    "handoff_blocks": "kvmini_tpu_kv_handoff_blocks_total",
+    "handoff_wait_s": "kvmini_tpu_kv_handoff_wait_seconds_total",
+    "handoff_drops": "kvmini_tpu_kv_handoff_drops_total",
+    "lane_busy_s": "kvmini_tpu_prefill_lane_busy_seconds_total",
+    "colocated_fallbacks": "kvmini_tpu_disagg_colocated_fallbacks_total",
+    "queue_depth": "kvmini_tpu_kv_handoff_queue_depth",
+    "degraded": "kvmini_tpu_disagg_degraded",
+}
+
+
+def disagg_block(
+    endpoint: Optional[str],
+    runtime_metrics: Optional[dict[str, float]] = None,
+) -> dict[str, Any]:
+    """Disaggregated-serving counters (prefill-lane handoffs, drops,
+    lane busy wall, degrade state) from the runtime's /metrics, nested
+    under the `disagg` results key (docs/DISAGGREGATION.md). Degradation
+    rules as ever: a colocated engine (or any external one) doesn't
+    export the rail and yields NO block, and a disaggregated engine with
+    zero handoff activity yields no block either — an all-zero handoff
+    report carries no information."""
+    if not endpoint:
+        return {}
+    m = (runtime_metrics if runtime_metrics is not None
+         else scrape_runtime_metrics(endpoint))
+    block = {
+        out_key: m[metric]
+        for out_key, metric in DISAGG_METRIC_KEYS.items()
+        if metric in m
+    }
+    if "handoffs" not in block or not any(block.values()):
+        return {}
+    block["source"] = "metrics:scrape"
+    return {"disagg": block}
+
+
 def cache_hit_ratio(
     prom_url: Optional[str],
     endpoint: Optional[str],

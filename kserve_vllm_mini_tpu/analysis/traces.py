@@ -30,7 +30,8 @@ from typing import Any, Optional
 
 from kserve_vllm_mini_tpu.runtime.tracing import SERVER_SCOPE, spans_from_otlp
 
-SERVER_PHASE_SPANS = ("server.queue", "server.prefill", "server.decode")
+SERVER_PHASE_SPANS = ("server.queue", "server.handoff", "server.prefill",
+                      "server.decode")
 
 
 def _is_server_leg(rs: dict[str, Any]) -> bool:

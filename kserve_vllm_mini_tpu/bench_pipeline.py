@@ -362,6 +362,15 @@ def _run_stages(
         if any(res.values()):
             res["source"] = "engine:snapshot"
             run_dir.merge_into_results({"resilience": res})
+        # disaggregated-serving block (docs/DISAGGREGATION.md): same
+        # authoritative-direct-snapshot rule; colocated engines (and
+        # disagg runs with zero handoff activity) get no block
+        dg = server.engine.disagg_snapshot()
+        if dg and any(
+            dg[k] for k in ("handoffs", "handoff_drops",
+                            "colocated_fallbacks")
+        ):
+            run_dir.merge_into_results({"disagg": dg})
         from kserve_vllm_mini_tpu.profiling.headroom import headroom_error_pct
 
         err = headroom_error_pct(

@@ -38,6 +38,7 @@ LOCAL_FAULTS = [
     "device-error",
     "kv-alloc-fail",
     "sse-disconnect",
+    "handoff-drop",
     "publish-drop",
 ]
 
@@ -51,6 +52,12 @@ FAULT_ARMS: dict[str, dict[str, Any]] = {
     "kv-alloc-fail": {"name": "kv_alloc_fail", "times": 0, "duration": 0.5},
     "sse-disconnect": {"name": "sse_disconnect", "times": 0,
                        "after_tokens": 1},
+    # every lane handoff lost until cleared: the engine must DEGRADE to
+    # colocated prefill (requests complete, slower) — recovery is the
+    # first healthy completion after the clear, and a colocated server
+    # refuses the arm (honest injected=False row, same contract as
+    # kv_alloc_fail on a dense engine)
+    "handoff-drop": {"name": "kv_handoff_drop", "times": 0},
     # publish_drop needs a multihost primary; a single-host target gets
     # an honest injected=False row, never a skipped-silently scenario
     "publish-drop": {"name": "publish_drop", "times": 1},

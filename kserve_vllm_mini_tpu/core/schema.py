@@ -180,6 +180,14 @@ class Results:
     # telemetry.py RESILIENCE_METRIC_KEYS); absent for external engines
     # and for runs with zero resilience activity.
     resilience: Optional[dict[str, Any]] = None
+    # disaggregated-serving block (docs/DISAGGREGATION.md): the prefill-
+    # lane handoff rail — {handoffs, handoff_blocks, handoff_wait_s,
+    # handoff_drops, lane_busy_s, colocated_fallbacks, queue_depth,
+    # degraded, source} — snapshotted directly in self-serve runs or
+    # scraped from /metrics (analysis/telemetry.py DISAGG_METRIC_KEYS);
+    # absent for colocated engines, external engines, and runs with zero
+    # handoff activity.
+    disagg: Optional[dict[str, Any]] = None
     # headroom-model validation (profiling/headroom.py): signed % error
     # of the analytic admission estimate vs the observed HBM peak —
     # negative = the model UNDERESTIMATES (the OOM direction). Present

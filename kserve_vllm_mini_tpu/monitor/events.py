@@ -19,6 +19,7 @@ EVENT_TYPES = (
     "throughput_collapse",
     "decode_stall",
     "prefill_stall",
+    "handoff_stall",
     "queue_depth_runaway",
     "duty_cycle_drop",
     "burn_rate_exceeded",
@@ -118,6 +119,7 @@ class EventDetector:
         self,
         stall_samples: int = 5,
         prefill_stall_samples: int = 3,
+        handoff_stall_samples: int = 3,
         queue_samples: int = 5,
         queue_depth_limit: float = 32.0,
         collapse_fraction: float = 0.3,
@@ -131,6 +133,7 @@ class EventDetector:
     ) -> None:
         self.stall_samples = stall_samples
         self.prefill_stall_samples = prefill_stall_samples
+        self.handoff_stall_samples = handoff_stall_samples
         self.queue_samples = queue_samples
         self.queue_depth_limit = queue_depth_limit
         self.collapse_fraction = collapse_fraction
@@ -147,6 +150,7 @@ class EventDetector:
         self._decode_progressed = False
         self._stall_run = 0
         self._prefill_stall_run = 0
+        self._handoff_stall_run = 0
         self._queue_run = 0
         self._burn_run = 0
         self._thrash_run = 0
@@ -233,6 +237,44 @@ class EventDetector:
                 f"{int(inflight)} request(s) in flight — long prompts are "
                 "stalling streaming (consider the prefill_chunk knob)",
                 {"samples": self._prefill_stall_run, "inflight": inflight},
+            )
+        return None
+
+    def _check_handoff_stall(self, sample: dict[str, Any]) -> Optional[Event]:
+        """The prefill lane is FALLING BEHIND a healthy decode lane
+        (docs/DISAGGREGATION.md): the handoff queue depth GREW across N
+        consecutive samples while decode retires stayed live
+        (decode_steps_total advancing). That attribution matters — a
+        frozen decode counter is decode_stall's event; a growing handoff
+        backlog with decode humming means prefill capacity, not the
+        engine, is the bottleneck (more lane devices, or raise
+        disagg_min_prompt so short prompts stop queueing behind long
+        ones). Only disaggregated runtimes export the depth gauge, so
+        the rule is inert everywhere else."""
+        prev = self._prev
+        depth = _runtime(sample, "kv_handoff_queue_depth")
+        steps = _runtime(sample, "decode_steps_total")
+        if prev is None or depth is None or steps is None:
+            return None
+        prev_depth = _runtime(prev, "kv_handoff_queue_depth")
+        prev_steps = _runtime(prev, "decode_steps_total")
+        if (
+            prev_depth is not None
+            and depth > prev_depth
+            and prev_steps is not None
+            and steps > prev_steps
+        ):
+            self._handoff_stall_run += 1
+        else:
+            self._handoff_stall_run = 0
+        if self._handoff_stall_run >= self.handoff_stall_samples:
+            return Event(
+                sample["t"], "handoff_stall",
+                f"prefill-lane handoff queue grew {self._handoff_stall_run} "
+                f"consecutive samples to depth {depth:g} while decode "
+                "stayed live — the prefill lane is saturated (add lane "
+                "devices or raise disagg_min_prompt)",
+                {"queue_depth": depth, "samples": self._handoff_stall_run},
             )
         return None
 
@@ -455,6 +497,7 @@ class EventDetector:
         checks: list[tuple[str, Optional[Event]]] = [
             ("decode_stall", self._check_decode_stall(sample)),
             ("prefill_stall", self._check_prefill_stall(sample)),
+            ("handoff_stall", self._check_handoff_stall(sample)),
             ("queue_depth_runaway", self._check_queue_runaway(sample)),
             ("throughput_collapse", self._check_throughput_collapse(sample)),
             ("duty_cycle_drop", self._check_duty_drop(sample)),

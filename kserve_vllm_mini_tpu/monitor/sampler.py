@@ -50,6 +50,14 @@ TIMELINE_RUNTIME_METRICS = (
     "kvmini_tpu_prefills_total",
     "kvmini_tpu_prefill_chunks_total",
     "kvmini_tpu_prefill_chunk_stall_seconds_total",
+    # disaggregated-serving rail (docs/DISAGGREGATION.md): the lane
+    # backlog gauge feeds the handoff_stall rule (decode live while the
+    # handoff queue grows = prefill lane saturated), and the handoff/
+    # drop/lane-busy counters ride into the report's disagg facts
+    "kvmini_tpu_kv_handoffs_total",
+    "kvmini_tpu_kv_handoff_queue_depth",
+    "kvmini_tpu_kv_handoff_drops_total",
+    "kvmini_tpu_prefill_lane_busy_seconds_total",
     "kvmini_tpu_kv_free_blocks",
     # KV-cache & HBM deep observability (docs/TROUBLESHOOTING.md "HBM
     # pressure & KV thrash"): pool occupancy + eviction churn feed the
@@ -88,6 +96,7 @@ class MonitorConfig:
     burn_samples: int = 3
     stall_samples: int = 5
     prefill_stall_samples: int = 3    # prefill_stall rule (docs/MONITORING.md)
+    handoff_stall_samples: int = 3    # handoff_stall rule (docs/MONITORING.md)
     queue_depth_limit: float = 32.0
     kv_thrash_rate: float = 4.0       # retained evictions/s (docs/MONITORING.md)
     kv_thrash_samples: int = 3
@@ -138,6 +147,7 @@ class RunMonitor:
         self._detector = EventDetector(
             stall_samples=self.cfg.stall_samples,
             prefill_stall_samples=self.cfg.prefill_stall_samples,
+            handoff_stall_samples=self.cfg.handoff_stall_samples,
             queue_depth_limit=self.cfg.queue_depth_limit,
             burn_threshold=self.cfg.burn_threshold,
             burn_samples=self.cfg.burn_samples,

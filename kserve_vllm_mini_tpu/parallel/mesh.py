@@ -100,6 +100,50 @@ def make_mesh(spec: MeshSpec, devices: Optional[Sequence[jax.Device]] = None) ->
     return Mesh(arr, AXES)
 
 
+def lane_meshes(
+    prefill_devices: int,
+    devices: Optional[Sequence[jax.Device]] = None,
+    prefill_tp: Optional[int] = None,
+    decode_tp: Optional[int] = None,
+) -> tuple[Mesh, Mesh]:
+    """Split one device set into DISJOINT (prefill, decode) submeshes for
+    disaggregated serving (runtime/disagg.py, docs/DISAGGREGATION.md):
+    the first ``prefill_devices`` devices become the prefill lane's
+    tp-only mesh and the rest the decode engine's — e.g. a 2+6 split of
+    the virtual 8-device CPU test mesh, or 2+6 of a v5e-8 slice. Both
+    lanes default to tp over their whole subset (the serving-friendly
+    layout, and the ONLY shape disagg engines accept — dp/sp/pp decode
+    meshes are rejected at Engine construction); ``prefill_tp``/
+    ``decode_tp`` exist for explicitness but must still cover their
+    subset exactly — when the model's head count doesn't divide a lane,
+    change the SPLIT, not the tp (a dp>1 lane would be refused
+    downstream anyway, so this raises here with the real fix).
+    Disjointness is the point: a prefill running on lane devices can
+    never contend with a decode sweep's collectives."""
+    devices = list(devices if devices is not None else jax.devices())
+    if not 0 < prefill_devices < len(devices):
+        raise ValueError(
+            f"prefill_devices={prefill_devices} must leave both lanes at "
+            f"least one device (have {len(devices)})"
+        )
+    n_decode = len(devices) - prefill_devices
+    pre_spec = MeshSpec.fill(prefill_devices, tp=prefill_tp)
+    dec_spec = MeshSpec.fill(n_decode, tp=decode_tp)
+    for lane, spec, n in (("prefill", pre_spec, prefill_devices),
+                          ("decode", dec_spec, n_decode)):
+        if spec.dp > 1:
+            raise ValueError(
+                f"{lane}_tp={spec.tp} does not cover the {lane} lane's "
+                f"{n} devices (would leave dp={spec.dp}, which disagg "
+                "engines reject); resize the split so tp covers the "
+                "lane exactly"
+            )
+    return (
+        make_mesh(pre_spec, devices[:prefill_devices]),
+        make_mesh(dec_spec, devices[prefill_devices:]),
+    )
+
+
 def mesh_for_topology(name: str, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     if name not in TOPOLOGY_PRESETS:
         raise ValueError(f"unknown topology {name!r}; known: {sorted(TOPOLOGY_PRESETS)}")

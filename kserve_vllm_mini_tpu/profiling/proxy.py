@@ -101,6 +101,33 @@ def _build_chunk_prefill_fn(cfg, chunk_len: int):
     return chunk_prefill
 
 
+def _build_disagg_prefill_fn(cfg, prompt_len: int):
+    """The disaggregated prefill LANE's staging executable
+    (runtime/disagg.py PrefillLane): a B=1 fresh prefill into the lane's
+    single-slot staging cache, last-position logits only — the program
+    that runs on lane devices instead of the decode lane's sweep loop,
+    so its compile stats are the proxy rail the disagg sweep axis and
+    the dark-round trajectory track."""
+    import jax
+    import jax.numpy as jnp
+
+    from functools import partial
+
+    from kserve_vllm_mini_tpu.models.llama import forward
+
+    @partial(jax.jit, donate_argnums=(1,))
+    def disagg_prefill(params, cache, toks):
+        pos = jnp.arange(toks.shape[1], dtype=jnp.int32)[None]
+        logits, cache = forward(
+            params, cfg, toks, pos, cache, jnp.zeros((1,), jnp.int32),
+            fresh_prefill=True,
+            logit_index=jnp.full((1,), prompt_len - 1, jnp.int32),
+        )
+        return cache, logits[0, 0]
+
+    return disagg_prefill
+
+
 def cost_model_stats(
     model: str,
     quant: str,
@@ -177,6 +204,17 @@ def cost_model_stats(
         chunk_fn, abs_params, abs_cache1, ctoks, coff,
         label=f"proxy.chunk_prefill[{model}]",
     )
+    # the disaggregated prefill LANE's staging executable (runtime/
+    # disagg.py; docs/DISAGGREGATION.md): compiled unconditionally so the
+    # dark-round trajectory tracks it whether or not the round ran with
+    # KVMINI_BENCH_DISAGG — drift in the lane program must be visible
+    # before a disagg round ever lands on hardware
+    dg_fn = _build_disagg_prefill_fn(cfg, prompt_len)
+    dtoks = jax.ShapeDtypeStruct((1, prompt_len), jnp.int32)
+    _, dg_stats = capture_compile_stats(
+        dg_fn, abs_params, abs_cache1, dtoks,
+        label=f"proxy.disagg_prefill[{model}]",
+    )
     # quant shapes BOTH the abstract tree (int8/packed-uint8 avals fed to
     # lower(), so the cost model prices the quantized weight stream) and
     # the analytic estimate below; quant_mode selects the contraction
@@ -192,6 +230,7 @@ def cost_model_stats(
         "prefill": pf_stats.to_dict(),
         "decode": dec_stats.to_dict(),
         "chunk_prefill": {**ch_stats.to_dict(), "chunk_len": chunk_len},
+        "disagg_prefill": {**dg_stats.to_dict(), "prompt_len": prompt_len},
         "analytic": est,
     }
 
@@ -325,9 +364,12 @@ def run_proxy_tier(
         "step_count_ratio": execd["step_count_ratio"],
         # full detail, per executable (chunk_prefill: the continuation-
         # chunk executable that reads the cache — the int8-KV prefill
-        # rail and the chunked-prefill sweep axis)
+        # rail and the chunked-prefill sweep axis; disagg_prefill: the
+        # prefill LANE's staging executable — the disaggregated-serving
+        # rail, docs/DISAGGREGATION.md)
         "compile_stats": {"prefill": pf, "decode": dec,
-                          "chunk_prefill": cost["chunk_prefill"]},
+                          "chunk_prefill": cost["chunk_prefill"],
+                          "disagg_prefill": cost["disagg_prefill"]},
         "analytic_bytes": cost["analytic"],
         "exec": execd,
     }

@@ -424,6 +424,56 @@ def _resilience_section(results: dict[str, Any]) -> str:
     return "".join(parts)
 
 
+def _disagg_section(results: dict[str, Any]) -> str:
+    """The "Disaggregated serving" section (docs/DISAGGREGATION.md):
+    prefill-lane handoff volume, wait/busy accounting, drops and the
+    degrade ladder, plus the handoff_stall monitor event. Rendered only
+    for runs that actually handed off — a colocated run's report simply
+    has no section."""
+    dg = results.get("disagg")
+    if not isinstance(dg, dict):
+        return ""
+    parts = ["<section><h2>Disaggregated serving</h2>"]
+    facts = []
+    handoffs = dg.get("handoffs") or 0
+    if handoffs:
+        facts.append(
+            f"{handoffs:.0f} prefill(s) handed off "
+            f"({dg.get('handoff_blocks', 0):.0f} KV blocks)"
+        )
+        wait = dg.get("handoff_wait_s")
+        if wait is not None and handoffs:
+            facts.append(
+                f"mean handoff wait {wait / handoffs * 1000.0:.1f} ms"
+            )
+    busy = dg.get("lane_busy_s")
+    if busy:
+        facts.append(f"prefill lane busy {busy:.2f} s")
+    if dg.get("handoff_drops"):
+        facts.append(f"{dg['handoff_drops']:.0f} handoff(s) dropped")
+    if dg.get("colocated_fallbacks"):
+        facts.append(
+            f"{dg['colocated_fallbacks']:.0f} prefill(s) degraded to "
+            "colocated"
+        )
+    if facts:
+        parts.append(f"<p>{html_mod.escape(' · '.join(facts))}</p>")
+    if dg.get("degraded"):
+        parts.append(
+            "<p class='warn'>engine finished with the prefill lane "
+            "DEGRADED to colocated routing — repeated handoff drops or a "
+            "dead lane (docs/DISAGGREGATION.md degrade ladder)</p>"
+        )
+    for e in ((results.get("monitor") or {}).get("events") or []):
+        if isinstance(e, dict) and e.get("type") == "handoff_stall":
+            parts.append(
+                f"<p>event @{e.get('t', 0):.0f}: <b>handoff_stall</b> — "
+                f"{html_mod.escape(str(e.get('detail', '')))}</p>"
+            )
+    parts.append("</section>")
+    return "".join(parts)
+
+
 def generate_single_run_html(
     results: dict[str, Any], run_dir: Optional[Path] = None
 ) -> str:
@@ -551,6 +601,7 @@ def generate_single_run_html(
 
         timeline_samples = RunDir(run_dir).read_timeline()
     sections.append(_kv_cache_section(results, run_dir, timeline_samples))
+    sections.append(_disagg_section(results))
     sections.append(_resilience_section(results))
     sections.append(_timeline_section(run_dir, results, timeline_samples))
     sections.append(_trace_viewer(run_dir, results))

@@ -240,6 +240,25 @@ class EngineConfig:
     # the follower replay stream does not carry — same rule as deadline
     # sheds).
     prefill_chunk: Optional[int] = None
+    # Disaggregated prefill/decode (docs/DISAGGREGATION.md, ROADMAP
+    # item 1): admissions route to a dedicated prefill lane
+    # (runtime/disagg.py PrefillLane — its own thread, optionally its own
+    # mesh submesh) that stages the prompt's KV out-of-band and hands the
+    # finished stripe back through the versioned KV-block handoff
+    # protocol, so long prefills NEVER execute on the decode lane's
+    # sweep loop. Greedy streams stay byte-identical to the colocated
+    # engine (same forward/params/bucket schedule, stripe injected
+    # verbatim). Every failure mode degrades to colocated prefill —
+    # dropped handoffs tombstone, a dead lane flips routing off — never
+    # a hung request. v1 composes with dense KV only and excludes
+    # drafters, LoRA, and prefix_cache; lockstep multihost engines
+    # reject it (the lane is host-local, same rule as prefill_chunk).
+    disagg: bool = False
+    # prompts whose length is below this many tokens prefill colocated
+    # even with disagg on: a short prefill is cheaper than its handoff
+    # round-trip. 0 = route everything (the measurement-friendly
+    # default; bench/serving set a threshold per deployment).
+    disagg_min_prompt: int = 0
     seed: int = 0
     kv_cache_dtype: Optional[str] = None  # None -> model dtype (e.g. "float32")
     # How quantized matmul leaves contract (ops/qmatmul.py QUANT_MODES):
@@ -494,6 +513,9 @@ class Engine:
         lora: Optional[dict[str, Any]] = None,  # ops/lora.py bank; its
                                  # "names" dict maps adapter name -> index
                                  # (index 0 = base, always available)
+        prefill_mesh=None,       # disaggregated prefill lane's own submesh
+                                 # (parallel/mesh.lane_meshes; needs
+                                 # ecfg.disagg — docs/DISAGGREGATION.md)
     ) -> None:
         self.cfg = cfg
         self.ecfg = engine_cfg or EngineConfig()
@@ -568,6 +590,43 @@ class Engine:
             raise ValueError(
                 f"unknown kv_layout {self.ecfg.kv_layout!r}; known: dense, paged"
             )
+
+        # Disaggregated prefill/decode (docs/DISAGGREGATION.md): validate
+        # compositions up front — the lane is constructed further down,
+        # once the compile recorder and fault registry it threads exist.
+        if self.ecfg.disagg:
+            if self.paged:
+                raise ValueError(
+                    "disagg composes with kv_layout=dense in v1; the "
+                    "paged pool's block-table handoff is the planned "
+                    "merge with block-level APC"
+                )
+            if drafter is not None:
+                raise ValueError(
+                    "disagg does not support speculative drafters yet "
+                    "(the drafter's shadow prefill writes the decode "
+                    "lane's drafter cache); drop the drafter or disagg"
+                )
+            if lora is not None:
+                raise ValueError(
+                    "disagg does not support multi-LoRA yet (the lane "
+                    "would need the adapter bank); drop --lora or disagg"
+                )
+            if self.ecfg.prefix_cache:
+                raise ValueError(
+                    "disagg and prefix_cache are mutually exclusive in "
+                    "v1: reuse matching happens at the decode lane's "
+                    "slot/block index, which the prefill lane cannot see"
+                )
+            if mesh is not None and any(
+                mesh.shape.get(ax, 1) > 1 for ax in ("dp", "sp", "pp")
+            ):
+                raise ValueError(
+                    "disagg composes with tp-only decode meshes; "
+                    "dp/sp/pp need a colocated engine"
+                )
+        elif prefill_mesh is not None:
+            raise ValueError("prefill_mesh requires EngineConfig.disagg=True")
         if self.paged:
             if mesh is not None and any(
                 mesh.shape.get(ax, 1) > 1 for ax in ("dp", "sp", "pp")
@@ -765,6 +824,15 @@ class Engine:
         self._slot_prefill: list[Optional[dict]] = [None] * S
         self._prefill_fifo: list[int] = []
 
+        # disaggregated-prefill state (docs/DISAGGREGATION.md): a slot
+        # whose prompt is prefilling ON THE LANE is OCCUPIED (_slot_req
+        # set — cancellation/watchdog/drain all see the handle) but not
+        # decode-ACTIVE until its handoff is consumed and _activate_slot
+        # samples the first token. The dict holds {"handle", "t_route"}
+        # (route time anchors the server.handoff span and the consume-
+        # side never-hang timeout). Scheduler-thread-only.
+        self._slot_handoff: list[Optional[dict]] = [None] * S
+
         self._pending: "queue.Queue[RequestHandle]" = queue.Queue()
         self._rng = jax.random.PRNGKey(self.ecfg.seed)
         # per-slot generated-token counts [S, V] int32, device-resident:
@@ -868,6 +936,28 @@ class Engine:
         # armed duration
         self._kv_fault_until = 0.0
 
+        # disaggregated prefill lane (docs/DISAGGREGATION.md): built here
+        # so it can thread the compile recorder (its executables land in
+        # the compile-stats rail as disagg_prefill[...]) and the fault
+        # registry (the kv_handoff_drop injection point). Degrade state
+        # is scheduler-owned: consecutive tombstoned handoffs flip
+        # _disagg_degraded and routing falls back to colocated prefill
+        # for the rest of the run.
+        self._disagg = None
+        self._disagg_degraded = False
+        self._disagg_drop_run = 0
+        if self.ecfg.disagg:
+            from kserve_vllm_mini_tpu.runtime.disagg import PrefillLane
+
+            self._disagg = PrefillLane(
+                self.params, cfg, self.ecfg, pad_id=pad_id,
+                instrument=(
+                    self._instrument if prefill_mesh is None else None
+                ),
+                faults=self._faults,
+                prefill_mesh=prefill_mesh,
+            )
+
         # stats for /metrics and duty-cycle telemetry
         self.stats = {
             "prefill_tokens": 0,
@@ -908,6 +998,21 @@ class Engine:
             "pipeline_fallback_active_set": 0,   # admission/cancel forced retire
             "pipeline_fallback_headroom": 0,     # cache window forced sync
         }
+        if self._disagg is not None:
+            # disaggregated-serving rail (docs/DISAGGREGATION.md), present
+            # only on disagg engines (same conditional contract as the
+            # paged pool gauges): handoffs consumed, block/wait/lane-busy
+            # accounting, tombstoned drops, and colocated fallbacks (the
+            # degrade ladder's visible steps). All consumed into stats on
+            # the scheduler thread (_consume_handoffs), single-writer.
+            self.stats.update({
+                "kv_handoffs": 0,            # handoffs consumed into slots
+                "kv_handoff_blocks": 0,      # KV blocks handed across lanes
+                "kv_handoff_wait_s": 0.0,    # lane-done -> consume wall
+                "kv_handoff_drops": 0,       # tombstones (drop/error/timeout)
+                "prefill_lane_busy_s": 0.0,  # lane compute wall
+                "disagg_colocated_fallbacks": 0,  # prefills degraded back
+            })
 
         # request lifecycle tracing (docs/TRACING.md): bounded ring of
         # completed phase spans served at GET /traces, plus per-phase
@@ -1780,6 +1885,8 @@ class Engine:
         if self._running:
             return
         self._running = True
+        if self._disagg is not None:
+            self._disagg.start()
         self._thread = threading.Thread(target=self._loop, daemon=True, name="engine-loop")
         self._thread.start()
         if self.ecfg.watchdog:
@@ -1795,6 +1902,11 @@ class Engine:
         self._watch_stop.set()
         if self._thread:
             self._thread.join(timeout=10.0)
+        if self._disagg is not None:
+            # after the scheduler drained (mid-handoff slots got their
+            # terminal events there); the lane flushes any leftover jobs
+            # as tombstones on its own way out
+            self._disagg.stop()
         if self._watch_thread is not None:
             self._watch_thread.join(timeout=2.0)
         # an admin op enqueued around shutdown would otherwise hang its
@@ -2065,6 +2177,181 @@ class Engine:
                 self._retire_all(on_decision)
             self._activate_slot(slot, st)
 
+    # -- disaggregated prefill: handoff consumption (docs/DISAGGREGATION.md)
+
+    def _get_inject_fn(self):
+        """Jitted KV-handoff injection: write the staged stripe back at
+        the destination slot (``update_cache_slots``, the exact inverse
+        of the lane's staging slice) with the decode cache donated so XLA
+        updates it in place. One executable for every handoff."""
+        fn = self._decode_fns.get("inject")
+        if fn is not None:
+            return fn
+        from kserve_vllm_mini_tpu.models.llama import update_cache_slots
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def inject(cache, sub, slot):
+            return update_cache_slots(cache, sub, slot)
+
+        inject = self._instrument(inject, "disagg_inject")
+        self._decode_fns["inject"] = inject
+        return inject
+
+    def _consume_handoffs(self, on_decision=None) -> None:
+        """Drain finished prefill-lane handoffs between sweeps: inject
+        each staged stripe into its slot's cache region and activate the
+        slot under the existing admission invariant (in-flight sweeps
+        retire first — a newly active slot must never receive a stale
+        token from a sweep dispatched before it joined). Tombstones and
+        version mismatches degrade to colocated prefill; a routed slot
+        whose handoff never arrives at all hits the HANDOFF_TIMEOUT_S
+        last resort — no path leaves a client hanging."""
+        if self._disagg is None:
+            return
+        from kserve_vllm_mini_tpu.runtime.disagg import (
+            DROPS_TO_DEGRADE,
+            HANDOFF_TIMEOUT_S,
+            HANDOFF_VERSION,
+        )
+
+        while True:
+            ho = self._disagg.pop_ready()
+            if ho is None:
+                break
+            slot = next(
+                (i for i in range(self.ecfg.max_slots)
+                 if self._slot_handoff[i] is not None
+                 and self._slot_handoff[i]["handle"] is ho.handle),
+                None,
+            )
+            if slot is None:
+                # the slot was aborted (cancel/drain/fault recovery)
+                # before the handoff landed: the payload is an orphan
+                self.stats["prefill_lane_busy_s"] += ho.busy_s
+                continue
+            handle: RequestHandle = ho.handle
+            if ho.dropped or ho.version != HANDOFF_VERSION:
+                # lost/injected-drop/stale-protocol handoff: count it,
+                # climb the degrade ladder, and re-prefill colocated —
+                # the request completes either way
+                self.stats["kv_handoff_drops"] += 1
+                self.stats["prefill_lane_busy_s"] += ho.busy_s
+                self._disagg_drop_run += 1
+                if self._disagg_drop_run >= DROPS_TO_DEGRADE:
+                    self._disagg_degraded = True
+                self._colocated_fallback(slot, on_decision)
+                continue
+            self._disagg_drop_run = 0
+            if handle.cancelled is not None:
+                # cancelled after the lane finished: the compute still
+                # happened — account it before dropping the payload
+                self.stats["prefill_lane_busy_s"] += ho.busy_s
+                self._abort_handoff(slot, handle.cancelled)
+                continue
+            if self._inflight:
+                # activation joins the decode set — retire against
+                # settled state (the admission invariant)
+                self.stats["pipeline_fallback_active_set"] += 1
+                self._retire_all(on_decision)
+            t_route = self._slot_handoff[slot]["t_route"]
+            now = time.time()
+            wait = max(now - ho.t_enqueued, 0.0)
+            self.stats["kv_handoffs"] += 1
+            self.stats["kv_handoff_blocks"] += ho.n_blocks
+            self.stats["kv_handoff_wait_s"] += wait
+            self.stats["prefill_lane_busy_s"] += ho.busy_s
+            self.stats["prefill_chunks"] += ho.chunks
+            self._cache = self._get_inject_fn()(
+                self._cache, ho.kv, jnp.int32(slot)
+            )
+            self._observe_phase("handoff", now - t_route)
+            self._trace_span(
+                handle, "server.handoff", t_route, now,
+                attrs={"blocks": ho.n_blocks, "version": ho.version,
+                       "wait_s": round(wait, 6),
+                       "lane_busy_s": round(ho.busy_s, 6)},
+            )
+            self._slot_handoff[slot] = None
+            st = {
+                "handle": handle,
+                "off": len(handle.request.prompt_tokens),
+                "reused": ho.reused_prefix_tokens,
+                "adapter_idx": 0,
+                "chunks": ho.chunks,
+                "draft_chunks": 0,
+                "draft_off": None,
+                "logits": jnp.asarray(ho.logits),
+            }
+            self._activate_slot(slot, st)
+        # never-hang last resort: a routed slot whose handoff (payload OR
+        # tombstone) never arrived — lane wedged past even its own flush
+        # machinery — re-prefills colocated after the timeout. Never
+        # reached in lockstep: multihost refuses disaggregated engines
+        # outright (check_multihost_engine), so _disagg is None there
+        # and this method early-returns before any clock read.
+        now = time.time()
+        for slot in range(self.ecfg.max_slots):
+            hstate = self._slot_handoff[slot]
+            # kvmini: lockstep-ok — see above (disagg is host-local only)
+            if hstate is None or now - hstate["t_route"] <= HANDOFF_TIMEOUT_S:
+                continue
+            self.stats["kv_handoff_drops"] += 1
+            self._disagg_drop_run += 1
+            if self._disagg_drop_run >= DROPS_TO_DEGRADE:
+                self._disagg_degraded = True
+            self._colocated_fallback(slot, on_decision)
+
+    def _colocated_fallback(self, slot: int, on_decision=None) -> None:
+        """Degrade-to-colocated (the handoff ladder's recovery step): the
+        routed prompt's handoff was lost, so its prefill runs right here
+        on the scheduler thread — the monolithic piece loop the colocated
+        engine would have used — and the slot activates normally. The
+        request never observes the drop beyond added latency."""
+        handle: RequestHandle = self._slot_handoff[slot]["handle"]
+        if handle.cancelled is not None:
+            self._abort_handoff(slot, handle.cancelled)
+            return
+        self._slot_handoff[slot] = None
+        self.stats["disagg_colocated_fallbacks"] += 1
+        if self._inflight:
+            self.stats["pipeline_fallback_active_set"] += 1
+            self._retire_all(on_decision)
+        st = {
+            "handle": handle,
+            "off": 0,
+            "reused": 0,
+            "adapter_idx": 0,
+            "chunks": 0,
+            "draft_chunks": 0,
+            "draft_off": None,
+            "logits": None,
+        }
+        while not self._prefill_step(slot, st, self.ecfg.max_prefill_len):
+            pass
+        self._activate_slot(slot, st)
+
+    def _abort_handoff(self, slot: int, reason: str) -> None:
+        """Finish a slot cancelled (or drained) while its prompt was on
+        the prefill lane: no token was ever sampled, the stream ends with
+        zero tokens, and the slot frees. The lane's payload, when it
+        lands, is dropped as an orphan by the consume identity check."""
+        handle = self._slot_req[slot]
+        handle.t_done = time.time()
+        handle.finish_reason = reason
+        self._observe_phase("prefill", handle.t_done - handle.t_admit)
+        self._trace_span(
+            handle, "server.prefill", handle.t_admit, handle.t_done,
+            ok=False, attrs={"cancelled": reason, "disagg": True},
+        )
+        handle.events.put(("done", {
+            "finish_reason": reason,
+            "tokens_out": 0,
+            "truncated": handle.request.truncated,
+            "truncated_tokens": handle.request.truncated_tokens,
+        }))
+        self.stats["requests_completed"] += 1
+        self._release_slot(slot)
+
     def cancel(self, handle: RequestHandle, reason: str = "stop") -> None:
         """Finish ``handle``'s generation early (thread-safe; effective at
         the scheduler's next iteration). Tokens already emitted stand; the
@@ -2126,6 +2413,28 @@ class Engine:
         # queue phase: submit -> the scheduler picking the request up
         self._observe_phase("queue", handle.t_admit - handle.t_submit)
         self._trace_span(handle, "server.queue", handle.t_submit, handle.t_admit)
+        if (
+            self._disagg is not None
+            and not self._disagg_degraded
+            and not self._lockstep
+            and len(req.prompt_tokens) >= self.ecfg.disagg_min_prompt
+            and self._disagg.accepts()
+        ):
+            # disaggregated route (docs/DISAGGREGATION.md): occupy a slot
+            # now (cancel/watchdog/drain all see the handle) and hand the
+            # prompt to the prefill lane; _consume_handoffs injects the
+            # staged KV and activates the slot when the handoff lands.
+            # Backpressure/degrade fall through to the colocated path
+            # below — a saturated or dead lane sheds work back to the
+            # decode lane, it never queues requests unboundedly.
+            slot = self._free.pop()
+            self._slot_req[slot] = handle
+            self._slot_len[slot] = 0
+            self._slot_handoff[slot] = {
+                "handle": handle, "t_route": handle.t_admit,
+            }
+            self._disagg.submit(handle)
+            return
         slot, reused = self._pop_slot_for(req.prompt_tokens)
         if self.paged:
             # fit is the caller's job: _schedule_once defers a non-fitting
@@ -2316,11 +2625,14 @@ class Engine:
 
     def _decode_active(self) -> list[int]:
         """Slots with a live request that is PAST prefill — the set decode
-        sweeps cover. A slot mid-chunked-prefill is occupied but excluded
-        until _activate_slot samples its first token."""
+        sweeps cover. A slot mid-chunked-prefill (or awaiting its prefill
+        lane handoff) is occupied but excluded until _activate_slot
+        samples its first token."""
         return [
             i for i in range(self.ecfg.max_slots)
-            if self._slot_req[i] is not None and self._slot_prefill[i] is None
+            if self._slot_req[i] is not None
+            and self._slot_prefill[i] is None
+            and self._slot_handoff[i] is None
         ]
 
     def _get_sampling_arrays(self) -> tuple:
@@ -2410,6 +2722,9 @@ class Engine:
             self._slot_prefill[slot] = None
             if slot in self._prefill_fifo:
                 self._prefill_fifo.remove(slot)
+        # releasing a slot mid-lane-handoff: the payload, when it lands,
+        # is dropped by the consume identity check (orphan)
+        self._slot_handoff[slot] = None
         if self.paged:
             self._paged_release(slot)
         self._slot_adapter[slot] = 0
@@ -2931,9 +3246,11 @@ class Engine:
         self._pending_steps = 0
         self._tokens_dev = None
         # half-prefilled slots die with it too (their handles error below
-        # through the same _slot_req sweep)
+        # through the same _slot_req sweep), and so do slots awaiting a
+        # prefill-lane handoff (their payloads orphan at consume)
         self._slot_prefill = [None] * self.ecfg.max_slots
         self._prefill_fifo.clear()
+        self._slot_handoff = [None] * self.ecfg.max_slots
         for slot in range(self.ecfg.max_slots):
             h = self._slot_req[slot]
             if h is not None:
@@ -2998,6 +3315,11 @@ class Engine:
                     # cancelled mid-chunked-prefill: no token was ever
                     # sampled — abort without a decode span or a sweep
                     self._abort_prefill(slot, h.cancelled)
+                elif self._slot_handoff[slot] is not None:
+                    # cancelled while its prompt was on the prefill lane:
+                    # same zero-token abort; the lane's eventual payload
+                    # orphans at the consume identity check
+                    self._abort_handoff(slot, h.cancelled)
                 else:
                     # the ("cancel") decision published above covers this
                     # branch too — it only selects the finish shape
@@ -3044,6 +3366,10 @@ class Engine:
         live_now = [h for h in self._slot_req if h is not None]
         with self._res_lock:
             self._live_handles = live_now
+        # finished prefill-lane handoffs inject BETWEEN decode sweeps —
+        # the decode lane's only disagg cost is one cache write per
+        # admission (docs/DISAGGREGATION.md)
+        self._consume_handoffs(on_decision)
         # chunked prefill rides BETWEEN decode sweeps: one piece of the
         # oldest in-progress prompt per iteration (docs/TROUBLESHOOTING.md
         # "Long prompts stall streaming")
@@ -3060,6 +3386,16 @@ class Engine:
             if self._prefill_fifo:
                 # chunks still pending with no decode work: loop again
                 # immediately — the next iteration advances the next piece
+                return
+            if not self._free:
+                # every slot occupied but none decode-active: ONLY
+                # possible with all slots awaiting a prefill-lane
+                # handoff (docs/DISAGGREGATION.md) — popping a pending
+                # request here would have no slot to hold it. Wait a
+                # beat for a handoff to land instead (pre-disagg this
+                # state was unreachable: occupied slots were always
+                # decode-active or in the prefill fifo).
+                time.sleep(0.02)
                 return
             try:
                 handle = self._pending.get(timeout=0.02)
@@ -3147,6 +3483,11 @@ class Engine:
             h.cancelled = h.cancelled or "cancelled"
             if self._slot_prefill[slot] is not None:
                 self._abort_prefill(slot, h.cancelled)
+            elif self._slot_handoff[slot] is not None:
+                # drained mid-handoff: zero-token terminal event exactly
+                # once; the lane's payload orphans at consume (or the
+                # lane flushes it as a tombstone on its own stop)
+                self._abort_handoff(slot, h.cancelled)
             else:
                 self._finish_slot(slot, h.cancelled)
         if self.paged and self._deferred is not None:
@@ -3336,6 +3677,13 @@ class Engine:
             raise ValueError(
                 "kv_alloc_fail needs kv_layout=paged; this engine is dense"
             )
+        if name == "kv_handoff_drop" and self._disagg is None:
+            # same honesty rule: the point lives on the prefill lane —
+            # arming it on a colocated engine can never fire
+            raise ValueError(
+                "kv_handoff_drop needs a disaggregated engine (disagg "
+                "/ --disagg); this engine prefills colocated"
+            )
         return self._faults.arm(name, **params).to_dict()
 
     def clear_fault(self, name: Optional[str] = None) -> None:
@@ -3415,6 +3763,14 @@ class Engine:
                     hbm["bytes_in_use"],
                 )
                 s["hbm_peak_bytes"] = self._hbm_peak_seen
+        if self._disagg is not None:
+            # disaggregated-serving gauges (docs/DISAGGREGATION.md): lane
+            # backlog (the handoff_stall monitor rule's input) and the
+            # degrade-ladder position. queue_depth() is internally
+            # locked; the degrade flag is a scheduler-owned bool.
+            s["kv_handoff_queue_depth"] = self._disagg.queue_depth()
+            # kvmini: thread-ok — GIL-atomic bool gauge, scheduler-owned
+            s["disagg_degraded"] = 1 if self._disagg_degraded else 0
         s["spec_accept_ratio"] = (
             s["spec_accepted"] / s["spec_proposed"] if s["spec_proposed"] else 0.0
         )
@@ -3575,6 +3931,28 @@ class Engine:
             if stats_key in s:
                 block[sub] = s[stats_key]
         return block
+
+    def disagg_snapshot(self) -> dict[str, Any]:
+        """The results.json ``disagg`` block (docs/DISAGGREGATION.md):
+        handoff counters keyed the way the analyzer's /metrics scrape
+        maps them (analysis/telemetry.py DISAGG_METRIC_KEYS) —
+        snapshotted directly in self-serve runs, where it is
+        authoritative. Empty on colocated engines (no block, never
+        fabricated zeros — the same absence contract as kv_cache)."""
+        if self._disagg is None:
+            return {}
+        s = self.snapshot_stats()
+        return {
+            "source": "engine:snapshot",
+            "handoffs": s["kv_handoffs"],
+            "handoff_blocks": s["kv_handoff_blocks"],
+            "handoff_wait_s": round(s["kv_handoff_wait_s"], 6),
+            "handoff_drops": s["kv_handoff_drops"],
+            "lane_busy_s": round(s["prefill_lane_busy_s"], 6),
+            "colocated_fallbacks": s["disagg_colocated_fallbacks"],
+            "queue_depth": s["kv_handoff_queue_depth"],
+            "degraded": bool(s["disagg_degraded"]),
+        }
 
     def compile_stats_snapshot(self) -> dict[str, Any]:
         """The results.json ``compile_stats`` block (docs/PROFILING.md):

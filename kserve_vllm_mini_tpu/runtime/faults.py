@@ -32,6 +32,7 @@ Injection points the runtime threads through its hot paths:
 | ``kv_alloc_fail``| paged-KV admission fit check  | admission backpressure for ``duration`` (queue grows, sheds kick in) |
 | ``sse_disconnect``| server streaming loop        | stream transport drops mid-response |
 | ``publish_drop`` | multihost decision publish    | one published decision is silently dropped |
+| ``kv_handoff_drop`` | prefill-lane handoff (runtime/disagg.py) | a finished KV handoff is lost in transit; the engine must degrade to colocated prefill, never hang the request |
 """
 
 from __future__ import annotations
@@ -48,6 +49,7 @@ FAULT_POINTS = (
     "kv_alloc_fail",
     "sse_disconnect",
     "publish_drop",
+    "kv_handoff_drop",
 )
 
 _FLOAT_PARAMS = ("duration", "p")

@@ -65,6 +65,10 @@ def start_local_server(
         quant_mode=profile.get("quant_mode", "dequant") or "dequant",
         kv_cache_dtype=profile.get("kv_cache_dtype"),
         decode_chunk=int(profile.get("decode_chunk", 1)),
+        # disaggregated prefill/decode lanes (docs/DISAGGREGATION.md)
+        disagg=bool(profile.get("disagg", False)),
+        disagg_min_prompt=int(profile.get("disagg_min_prompt", 0)),
+        prefill_lane_devices=int(profile.get("prefill_lane_devices", 0)),
         scan_unroll=int(profile.get("scan_unroll", 1)),
         pp=int(profile.get("pp", 0)),
         pp_microbatches=int(profile.get("pp_microbatches", 1)),

@@ -305,6 +305,17 @@ def check_multihost_engine(engine: Engine) -> None:
     """Fail fast on configurations outside the lockstep contract."""
     if engine.mesh is None:
         raise ValueError("multi-host serving needs a process-spanning mesh")
+    if engine._disagg is not None:
+        # the prefill lane and its handoff queue are host-local state the
+        # decision stream does not carry: a follower replaying ("admit",)
+        # against a lane-routed primary would prefill colocated and
+        # diverge its cache/rng sequence. Loud, not silent — the v2 path
+        # is a PUBLISHED handoff decision (ROADMAP item 1 notes).
+        raise ValueError(
+            "disaggregated prefill (disagg) is not supported under "
+            "multi-host lockstep serving (v1); drop --disagg or "
+            "--distributed"
+        )
     if engine.mesh.shape.get("dp", 1) > 1:
         raise ValueError(
             "multi-host serving requires dp == 1 (per-slot outputs must be "

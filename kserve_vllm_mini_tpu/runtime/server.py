@@ -50,6 +50,15 @@ def build_engine(
     prefill_chunk: Optional[int] = None,  # tokens per interleaved prefill
                                    # chunk (EngineConfig.prefill_chunk);
                                    # None = monolithic admission
+    disagg: bool = False,          # disaggregated prefill/decode lanes
+                                   # (EngineConfig.disagg; docs/
+                                   # DISAGGREGATION.md)
+    disagg_min_prompt: int = 0,    # prompts below this prefill colocated
+    prefill_lane_devices: int = 0, # >0: split the device set into a
+                                   # prefill submesh of this many devices
+                                   # + a decode mesh over the rest
+                                   # (parallel/mesh.lane_meshes); needs
+                                   # disagg and no other mesh source
     drafter: Optional[str] = None,
     spec_tokens: int = 0,
     pp: int = 0,
@@ -117,8 +126,27 @@ def build_engine(
             "known: auto, bfloat16, float32, float16, int8 (scaled)"
         )
 
-    if mesh is not None:
+    prefill_mesh = None
+    if prefill_lane_devices:
+        # disaggregated per-lane meshes (docs/DISAGGREGATION.md): a
+        # disjoint prefill submesh + decode mesh over one device set —
+        # mutually exclusive with every other mesh source, which would
+        # otherwise claim the same devices twice
+        if not disagg:
+            raise ValueError("prefill_lane_devices requires disagg=True")
+        if mesh is not None or (pp and pp > 1) or topology:
+            raise ValueError(
+                "prefill_lane_devices is its own mesh source; drop "
+                "mesh/pp/topology (the lanes split the device set "
+                "themselves via parallel/mesh.lane_meshes)"
+            )
+        from kserve_vllm_mini_tpu.parallel.mesh import lane_meshes
+
+        prefill_mesh, mesh = lane_meshes(prefill_lane_devices)
+    if mesh is not None and prefill_mesh is None:
         pass  # caller-provided (multi-host global mesh)
+    elif prefill_mesh is not None:
+        pass  # lane split above
     elif pp and pp > 1:
         # serving pipeline parallelism: layer-range stages over a pure-pp
         # mesh (parallel/serving_pp.py); needs exactly pp devices
@@ -284,6 +312,8 @@ def build_engine(
         quant_mode=quant_mode,
         decode_chunk=decode_chunk,
         prefill_chunk=prefill_chunk,
+        disagg=disagg,
+        disagg_min_prompt=disagg_min_prompt,
         spec_tokens=spec_tokens if drafter_pair is not None else 0,
         pp_microbatches=pp_microbatches,
         prefix_cache=prefix_cache,
@@ -300,7 +330,7 @@ def build_engine(
     )
     engine = Engine(
         params, cfg, ecfg, mesh=mesh, pad_id=tok.pad_id, drafter=drafter_pair,
-        lora=lora_bank,
+        lora=lora_bank, prefill_mesh=prefill_mesh,
     )
     return engine, tok, name
 
@@ -1376,6 +1406,32 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
             "# TYPE kvmini_tpu_hbm_headroom_estimate_bytes gauge",
             f"kvmini_tpu_hbm_headroom_estimate_bytes {s['hbm_headroom_estimate_bytes']}",
         ]
+        if "kv_handoffs" in s:  # disaggregated engines only (docs/
+            # DISAGGREGATION.md): the prefill-lane handoff rail — volume,
+            # block/wait accounting, tombstoned drops, lane busy wall,
+            # the lane backlog gauge the handoff_stall monitor rule
+            # watches, and the degrade-ladder position
+            lines += [
+                "# TYPE kvmini_tpu_kv_handoffs_total counter",
+                f"kvmini_tpu_kv_handoffs_total {s['kv_handoffs']}",
+                "# TYPE kvmini_tpu_kv_handoff_blocks_total counter",
+                f"kvmini_tpu_kv_handoff_blocks_total {s['kv_handoff_blocks']}",
+                "# TYPE kvmini_tpu_kv_handoff_wait_seconds_total counter",
+                "kvmini_tpu_kv_handoff_wait_seconds_total "
+                f"{s['kv_handoff_wait_s']:.6f}",
+                "# TYPE kvmini_tpu_kv_handoff_drops_total counter",
+                f"kvmini_tpu_kv_handoff_drops_total {s['kv_handoff_drops']}",
+                "# TYPE kvmini_tpu_prefill_lane_busy_seconds_total counter",
+                "kvmini_tpu_prefill_lane_busy_seconds_total "
+                f"{s['prefill_lane_busy_s']:.6f}",
+                "# TYPE kvmini_tpu_disagg_colocated_fallbacks_total counter",
+                "kvmini_tpu_disagg_colocated_fallbacks_total "
+                f"{s['disagg_colocated_fallbacks']}",
+                "# TYPE kvmini_tpu_kv_handoff_queue_depth gauge",
+                f"kvmini_tpu_kv_handoff_queue_depth {s['kv_handoff_queue_depth']}",
+                "# TYPE kvmini_tpu_disagg_degraded gauge",
+                f"kvmini_tpu_disagg_degraded {s['disagg_degraded']}",
+            ]
         if "kv_pool_blocks" in s:  # paged layout only
             lines += [
                 "# TYPE kvmini_tpu_kv_pool_blocks gauge",
@@ -1621,6 +1677,29 @@ def register(parser: argparse.ArgumentParser) -> None:
                              "them behind one monolithic call (TTFT/ITL "
                              "tail; docs/TROUBLESHOOTING.md). Default: "
                              "$KVMINI_PREFILL_CHUNK or monolithic")
+    parser.add_argument("--disagg", action="store_true",
+                        help="Disaggregated prefill/decode serving "
+                             "(docs/DISAGGREGATION.md): prompt prefills "
+                             "run on a dedicated prefill lane and hand "
+                             "finished KV blocks to the decode engine, so "
+                             "long prefills never stall the decode sweep "
+                             "loop. Dense KV only (v1); excludes drafter/"
+                             "LoRA/prefix-cache. Also $KVMINI_DISAGG=1")
+    parser.add_argument("--disagg-min-prompt", type=int, default=None,
+                        help="Prompts shorter than this many tokens "
+                             "prefill colocated even with --disagg (a "
+                             "short prefill is cheaper than its handoff "
+                             "round-trip). Default: "
+                             "$KVMINI_DISAGG_MIN_PROMPT or 0 = route all")
+    parser.add_argument("--prefill-lane-devices", type=int, default=None,
+                        help="With --disagg: split the device set into a "
+                             "prefill submesh of this many devices plus a "
+                             "decode mesh over the rest (parallel/mesh."
+                             "lane_meshes; e.g. 2 on an 8-device slice = "
+                             "a 2+6 split). Default: "
+                             "$KVMINI_PREFILL_LANE_DEVICES or 0 = the "
+                             "lane shares the engine's devices on its "
+                             "own thread")
     parser.add_argument("--drafter", default=None,
                         help="Drafter model preset/checkpoint for speculative "
                              "decoding (default: $KVMINI_DRAFTER)")
@@ -1771,6 +1850,19 @@ def run(args: argparse.Namespace) -> int:
     if prefill_chunk is None:
         env_pc = os.environ.get("KVMINI_PREFILL_CHUNK")
         prefill_chunk = int(env_pc) if env_pc else None
+    disagg = bool(
+        args.disagg or os.environ.get("KVMINI_DISAGG", "") in ("1", "true")
+    )
+    disagg_min_prompt = args.disagg_min_prompt
+    if disagg_min_prompt is None:
+        disagg_min_prompt = int(
+            os.environ.get("KVMINI_DISAGG_MIN_PROMPT", "0") or 0
+        )
+    prefill_lane_devices = args.prefill_lane_devices
+    if prefill_lane_devices is None:
+        prefill_lane_devices = int(
+            os.environ.get("KVMINI_PREFILL_LANE_DEVICES", "0") or 0
+        )
     faults = args.faults or os.environ.get("KVMINI_FAULTS") or None
     fault_seed = (
         args.fault_seed
@@ -1839,6 +1931,12 @@ def run(args: argparse.Namespace) -> int:
                 )
             if drafter:
                 raise SystemExit("--distributed does not support --drafter (v1)")
+            if disagg:
+                # the prefill lane is host-local; a lockstep follower
+                # cannot replay its handoff timing (same rule as
+                # prefill_chunk / deadline sheds — but loud, because
+                # silently colocating would bench the wrong architecture)
+                raise SystemExit("--distributed does not support --disagg (v1)")
             mesh_override = dist.global_mesh(spec)
 
     engine, tok, name = build_engine(
@@ -1848,6 +1946,9 @@ def run(args: argparse.Namespace) -> int:
         max_slots=max_slots,
         decode_chunk=args.decode_chunk,
         prefill_chunk=prefill_chunk,
+        disagg=disagg,
+        disagg_min_prompt=disagg_min_prompt,
+        prefill_lane_devices=prefill_lane_devices,
         max_seq_len=max_seq,
         topology=args.topology or os.environ.get("KVMINI_TOPOLOGY") or None,
         pp=pp,

@@ -30,14 +30,18 @@ import secrets
 from collections import deque
 from typing import Any, Iterable, Optional
 
-# the engine's per-request span ceiling: server.queue + server.prefill +
-# server.decode + server.cancel. A request can never allocate more spans
-# than this — the recorder-overhead contract tests pin against it.
-MAX_REQUEST_SPANS = 4
+# the engine's per-request span ceiling: server.queue + server.handoff
+# (disaggregated admissions only, docs/DISAGGREGATION.md) +
+# server.prefill + server.decode + server.cancel. A request can never
+# allocate more spans than this — the recorder-overhead contract tests
+# pin against it.
+MAX_REQUEST_SPANS = 5
 
 # request phases with /metrics histograms (kvmini_tpu_phase_seconds);
-# "emit" is the per-sweep host emission window of the decode pipeline
-PHASES = ("queue", "prefill", "decode", "emit")
+# "emit" is the per-sweep host emission window of the decode pipeline,
+# "handoff" the prefill-lane route->consume window of disaggregated
+# admissions (zero observations on colocated engines)
+PHASES = ("queue", "handoff", "prefill", "decode", "emit")
 
 # OTLP scope name every server-leg exporter uses (the real runtime AND the
 # mock); the analyzer's merge keys off it to stay idempotent — re-analyzing

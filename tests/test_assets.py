@@ -175,6 +175,15 @@ def test_kv_cache_dashboard_queries_kv_and_hbm_metrics():
     assert "kvmini_tpu_hbm_bytes_in_use" in d
     assert "kvmini_tpu_hbm_bytes_limit" in d
     assert "kvmini_tpu_hbm_headroom_estimate_bytes" in d
+    # disaggregated-serving handoff lane (docs/DISAGGREGATION.md):
+    # handoff volume and drops are RATE signals, the lane backlog is the
+    # level gauge the handoff_stall monitor rule watches, and the lane's
+    # busy/wait walls read as rate() duty fractions
+    assert "rate(kvmini_tpu_kv_handoffs_total" in d
+    assert "rate(kvmini_tpu_kv_handoff_drops_total" in d
+    assert "kvmini_tpu_kv_handoff_queue_depth" in d
+    assert "rate(kvmini_tpu_prefill_lane_busy_seconds_total" in d
+    assert "rate(kvmini_tpu_kv_handoff_wait_seconds_total" in d
 
 
 def test_utilization_dashboard_queries_tpu_metrics():

@@ -113,7 +113,7 @@ def test_local_chaos_matrix_end_to_end(tmp_path):
     rows = {r["fault"]: r for r in table["faults"]}
     assert set(rows) == set(LOCAL_FAULTS)
     for fault in ("sweep-wedge", "device-error", "kv-alloc-fail",
-                  "sse-disconnect"):
+                  "sse-disconnect", "handoff-drop"):
         row = rows[fault]
         assert row["injected"] is True, fault
         assert row["recovered"] is True, fault

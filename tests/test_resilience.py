@@ -87,7 +87,7 @@ def test_parse_faults_syntax_and_unknown_point():
         FaultRegistry().arm("meteor_strike")
     assert set(FAULT_POINTS) == {
         "sweep_stall", "device_error", "kv_alloc_fail", "sse_disconnect",
-        "publish_drop",
+        "publish_drop", "kv_handoff_drop",
     }
 
 
@@ -140,6 +140,10 @@ def _harness(slots=2, **ecfg_kw):
     eng._retained = [[] for _ in range(slots)]
     eng._slot_prefill = [None] * slots
     eng._prefill_fifo = []
+    eng._slot_handoff = [None] * slots
+    eng._disagg = None
+    eng._disagg_degraded = False
+    eng._disagg_drop_run = 0
     eng._free = []
     eng._inflight = []
     eng._pending_steps = 0

@@ -111,10 +111,11 @@ def test_to_otlp_safe_under_concurrent_recording():
 
 
 def test_request_span_ceiling_is_pinned():
-    """The engine stamps queue + prefill + decode + cancel per request and
+    """The engine stamps queue + handoff (disaggregated admissions only,
+    docs/DISAGGREGATION.md) + prefill + decode + cancel per request and
     NOTHING per token; MAX_REQUEST_SPANS is the contract tests and docs
     key off — changing it means re-auditing the engine's stamping sites."""
-    assert MAX_REQUEST_SPANS == 4
+    assert MAX_REQUEST_SPANS == 5
 
 
 def test_recorder_otlp_shape_valid_against_schema():
@@ -404,8 +405,8 @@ def test_engine_tracing_default_on_and_disable_knob():
     h2 = off.submit(GenRequest(prompt_tokens=[1, 2], max_new_tokens=2))
     assert h2.request.trace_id is None  # zero tracing cost on the path
     # phase histograms stay on (plain counters) even with spans disabled
-    assert set(off.snapshot_phase_hist()) == {"queue", "prefill", "decode",
-                                              "emit"}
+    assert set(off.snapshot_phase_hist()) == {"queue", "handoff", "prefill",
+                                              "decode", "emit"}
 
 
 def test_engine_trace_buffer_capacity_knob():
