@@ -2,7 +2,7 @@
 # of native code — the TPU compute path is JAX/XLA compiled at runtime.
 PY ?= python
 
-.PHONY: help test test-fast test-policy lint lint-invariants lint-changed fmt smoke bench bench-smoke bench-proxy-smoke chaos-smoke trajectory dashboards-validate helm-lint airgap clean
+.PHONY: help test test-fast test-policy lint lint-invariants lint-changed fmt smoke bench bench-smoke bench-proxy-smoke chaos-smoke fleet-smoke trajectory dashboards-validate helm-lint airgap clean
 
 help:
 	@grep -E '^[a-z-]+:' Makefile | sed 's/:.*//' | sort | uniq
@@ -91,6 +91,14 @@ bench-smoke:  ## bench pipeline vs the mock server, tiny budget, no TPU
 # accounting and monitor event rules they feed.
 chaos-smoke:  ## local-mode chaos matrix vs the mock server, no TPU, no cluster
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_chaos_local.py tests/test_resilience.py -q -m "not slow"
+
+# the fleet acceptance gate (docs/FLEET.md): supervisor + cache-aware
+# router + local actuator + replica chaos against JAX-free mock replica
+# processes — placement scoring, 429 re-placement, per-replica metric
+# aggregation, replica-kill with zero hung requests, and the
+# resilience-table replica rows, all with no engine and no cluster.
+fleet-smoke:  ## fleet router/supervisor/actuator vs mock replicas, no TPU
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_fleet.py -q -m "not slow"
 
 # the never-dark acceptance gate (docs/PROFILING.md): with no TPU,
 # `python bench.py` must exit 0 with a schema-valid `proxy` block

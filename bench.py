@@ -121,6 +121,28 @@ def _env_disagg():
     return raw in ("1", "true")
 
 
+def _env_fleet():
+    """Replica count for the multi-replica fleet sub-bench row
+    (fleet/; docs/FLEET.md), or 0 (off). Loud validation at the knob: a
+    garbled value must not silently skip the row under a fleet label."""
+    raw = _knob("KVMINI_BENCH_FLEET")
+    if not raw or raw in ("0", "false"):
+        return 0
+    try:
+        n = int(raw)
+    except ValueError:
+        raise SystemExit(
+            f"KVMINI_BENCH_FLEET={raw!r}: must be a replica count >= 2 "
+            "(empty/0 disables the fleet row)"
+        ) from None
+    if n < 2:
+        raise SystemExit(
+            f"KVMINI_BENCH_FLEET={n}: needs >= 2 replicas — a 1-replica "
+            "fleet measures nothing the single-server rows don't"
+        )
+    return n
+
+
 def _env_prefill_chunk():
     """Tokens per interleaved prefill chunk, or None (monolithic). Loud
     validation at the knob: a garbled value must not silently bench the
@@ -141,6 +163,86 @@ def _env_prefill_chunk():
             "disables chunked prefill)"
         )
     return chunk
+
+
+def _run_fleet_row(n_replicas: int) -> dict:
+    """The {mode}.fleet sub-measurement (docs/FLEET.md): spawn
+    ``n_replicas`` CPU-forced llama-tiny serve replicas under the fleet
+    supervisor, front them with the cache-aware router, and drive a
+    small prefix-heavy multi-session burst through it. Reports fleet
+    mechanics only — cold starts, routed p50, placement/reroute mix."""
+    import urllib.request
+
+    from kserve_vllm_mini_tpu.fleet.router import (
+        FleetRouter,
+        RouterConfig,
+        start_router,
+    )
+    from kserve_vllm_mini_tpu.fleet.supervisor import (
+        FleetSupervisor,
+        serve_replica_cmd,
+    )
+    from kserve_vllm_mini_tpu.loadgen.prompts import make_prompt_fn
+
+    sup = FleetSupervisor(
+        replica_cmd=serve_replica_cmd(
+            model="llama-tiny",
+            extra_args=["--max-slots", "4", "--max-seq-len", "512",
+                        "--prefix-cache"],
+            # the fleet row must NEVER claim the accelerator the serving
+            # child is benching — replicas run on CPU by construction
+            env_overrides={"JAX_PLATFORMS": "cpu"},
+        ),
+        ready_timeout_s=300.0,
+    )
+    handle = None
+    try:
+        t0 = time.time()
+        sup.start(n_replicas)
+        boot_s = time.time() - t0
+        router = FleetRouter(supervisor=sup,
+                             cfg=RouterConfig(scrape_interval_s=0.25))
+        handle = start_router(router)
+        prompt_fn = make_prompt_fn("sessions", pool_size=4)
+        lat_ms = []
+        for i in range(16):
+            body = json.dumps({
+                "messages": [{"role": "user", "content": prompt_fn(i)}],
+                "max_tokens": 4,
+                "user": f"session-{i % 4}",
+            }).encode()
+            req = urllib.request.Request(
+                handle.url + "/v1/chat/completions", data=body,
+                headers={"Content-Type": "application/json"},
+            )
+            t1 = time.time()
+            with urllib.request.urlopen(req, timeout=120) as r:
+                r.read()
+            lat_ms.append((time.time() - t1) * 1000.0)
+        counters = sup.counters()
+        colds = sorted(counters["cold_starts_s"])
+        return {
+            "replicas": n_replicas,
+            "boot_s": round(boot_s, 2),
+            "cold_start_p50_s": round(colds[len(colds) // 2], 2)
+            if colds else None,
+            "routed_request_p50_ms": round(
+                sorted(lat_ms)[len(lat_ms) // 2], 2
+            ),
+            "placements": dict(router.placements),
+            "reroutes": router.reroutes,
+            "sheds": router.sheds,
+            "series": "fleet-mechanics-cpu",  # never a TPU throughput claim
+        }
+    finally:
+        # sup.stop() must run even when startup raised (half-spawned
+        # replicas run in their own sessions and would orphan) or
+        # handle.stop() itself fails
+        try:
+            if handle is not None:
+                handle.stop()
+        finally:
+            sup.stop()
 
 
 # ---------------------------------------------------------------------------
@@ -574,6 +676,19 @@ def _run_serving_child(mode: str) -> dict:
         }
         _progress(f"{mode}.disagg_prefill", row)
         _log(f"disagg lane prefill + handoff: {row}")
+
+    # -- multi-replica fleet (KVMINI_BENCH_FLEET): N CPU-forced replica
+    # subprocesses behind the cache-aware router (fleet/, docs/FLEET.md).
+    # Measures the fleet MECHANICS next to this mode's serving numbers —
+    # scale-up cold start, routed-request p50 over a prefix-heavy
+    # multi-session burst, placement mix — never TPU throughput (the
+    # replicas deliberately pin JAX_PLATFORMS=cpu so the accelerator
+    # under test stays exclusively the engine above).
+    n_fleet = _env_fleet()
+    if n_fleet:
+        row = _run_fleet_row(n_fleet)
+        _progress(f"{mode}.fleet", row)
+        _log(f"fleet row ({n_fleet} replicas): {row}")
 
     # -- prefill throughput buckets (VERDICT round-4 #8: prefill is the
     # compute-bound side — tokens/s/chip + MFU, not just TTFT) ------------
@@ -1630,6 +1745,17 @@ _ENV_KNOBS = {
         "monolithic TTFT probe (the {mode}.disagg_prefill row), and the "
         "proxy tier's disagg_prefill compile-stats entry tracks the lane "
         "executable across dark rounds either way; empty = colocated",
+    ),
+    "KVMINI_BENCH_FLEET": (
+        "--fleet", "",
+        "N>=2 runs the multi-replica fleet sub-bench (fleet/, docs/"
+        "FLEET.md): N CPU-forced llama-tiny serve replicas behind the "
+        "cache-aware router — the {mode}.fleet row measures scale-up "
+        "cold start (spawn -> healthy), routed request p50 over a "
+        "prefix-heavy multi-session burst, and the placement/reroute "
+        "mix. Fleet MECHANICS only (replicas pin JAX_PLATFORMS=cpu so "
+        "they never contend for the TPU under test) — the row makes no "
+        "accelerator throughput claims; empty/0 = off",
     ),
     "KVMINI_BENCH_UNROLL": (
         "--unroll", "1",

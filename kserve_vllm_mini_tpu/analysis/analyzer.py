@@ -154,6 +154,12 @@ def analyze_run(
     update.update(
         telemetry.disagg_block(endpoint, runtime_metrics=runtime_metrics)
     )
+    # fleet block (docs/FLEET.md): replica counts, placement/reroute/
+    # shed accounting and scale-step cold starts — present only when the
+    # endpoint was the fleet router's aggregated /metrics
+    update.update(
+        telemetry.fleet_block(endpoint, runtime_metrics=runtime_metrics)
+    )
 
     # server-side request traces (docs/TRACING.md): fetch /traces, merge
     # the server leg into runs/<id>/traces/traces.json joined by trace_id,

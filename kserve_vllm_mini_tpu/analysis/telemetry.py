@@ -543,6 +543,52 @@ def disagg_block(
     return {"disagg": block}
 
 
+# results.json `fleet` sub-key -> router metric (docs/FLEET.md). Keyed
+# by SUB-KEY (the COMPILE/KV/RESILIENCE/DISAGG orientation) because the
+# whole map lands under the one typed `fleet` results field. Only the
+# fleet router (fleet/router.py) exports the series.
+FLEET_METRIC_KEYS = {
+    "replicas_desired": "kvmini_tpu_fleet_replicas_desired",
+    "replicas_live": "kvmini_tpu_fleet_replicas_live",
+    "placements": "kvmini_tpu_fleet_placements_total",
+    "reroutes": "kvmini_tpu_fleet_reroutes_total",
+    "sheds": "kvmini_tpu_fleet_sheds_total",
+    "stream_errors": "kvmini_tpu_fleet_stream_errors_total",
+    "replica_restarts": "kvmini_tpu_fleet_replica_restarts_total",
+    "scale_ups": "kvmini_tpu_fleet_scale_ups_total",
+    "scale_downs": "kvmini_tpu_fleet_scale_downs_total",
+    "last_cold_start_s": "kvmini_tpu_fleet_last_cold_start_seconds",
+}
+
+
+def fleet_block(
+    endpoint: Optional[str],
+    runtime_metrics: Optional[dict[str, float]] = None,
+) -> dict[str, Any]:
+    """Fleet-router counters (replica counts, placements — the labeled
+    reasons arrive summed — reroutes, fleet sheds, restarts, scale
+    steps, last cold start) from the router's aggregated /metrics,
+    nested under the `fleet` results key (docs/FLEET.md). Degradation
+    rules as ever: a single-server endpoint (or any external engine)
+    doesn't export the rail and yields NO block, and a router that never
+    placed anything and holds no replicas yields no block either."""
+    if not endpoint:
+        return {}
+    m = (runtime_metrics if runtime_metrics is not None
+         else scrape_runtime_metrics(endpoint))
+    block = {
+        out_key: m[metric]
+        for out_key, metric in FLEET_METRIC_KEYS.items()
+        if metric in m
+    }
+    if "replicas_live" not in block:
+        return {}
+    if not block.get("replicas_live") and not block.get("placements"):
+        return {}
+    block["source"] = "metrics:scrape"
+    return {"fleet": block}
+
+
 def cache_hit_ratio(
     prom_url: Optional[str],
     endpoint: Optional[str],

@@ -214,12 +214,17 @@ class Controller:
                 "applied": self.replicas,
                 "note": f"no signal ({sig_err or 'empty scrape'}); holding",
             }
-            self.decisions.append(decision)
+            # single-writer: only the thread driving step() appends;
+            # cross-thread readers (FleetAutoscaler.decisions) snapshot
+            self.decisions.append(decision)  # kvmini: thread-ok — above
             if self.decision_log:
                 with self.decision_log.open("a") as f:
                     f.write(json.dumps(decision) + "\n")
             return self.replicas
         raw = desired_replicas(self.replicas, sig, self.cfg)
+        # single-writer: _window lives entirely inside step(), which
+        # exactly one thread drives
+        # kvmini: thread-ok — single-writer window (see above)
         self._window.append((now, raw))
         cutoff = now - self.cfg.stabilization_s
         self._window = [(t, d) for t, d in self._window if t >= cutoff]
@@ -244,6 +249,9 @@ class Controller:
                 f.write(json.dumps(decision) + "\n")
         if target != self.replicas:
             self.scaler(target)
+            # single-writer int assignment (GIL-atomic); cross-thread
+            # readers observe the current-or-previous count
+            # kvmini: thread-ok — single-writer count (see above)
             self.replicas = target
         return self.replicas
 

@@ -40,7 +40,21 @@ LOCAL_FAULTS = [
     "sse-disconnect",
     "handoff-drop",
     "publish-drop",
+    "replica-kill",
+    "replica-wedge",
 ]
+
+# replica-level scenarios (docs/FLEET.md): injected through the fleet
+# router's POST /fleet/chaos, not a single server's /faults. A target
+# that is not a fleet router (404) or has no survivors to fail over to
+# (409 on single-replica fleets) yields an honest injected=False row —
+# the PR-13 handoff-drop pattern. Recovery for replica-kill is the
+# supervisor's self-heal; for replica-wedge the router failing over
+# plus the cleared fault.
+REPLICA_FAULTS: dict[str, dict[str, Any]] = {
+    "replica-kill": {"action": "kill"},
+    "replica-wedge": {"action": "wedge", "duration": 0.4},
+}
 
 FAULT_ARMS: dict[str, dict[str, Any]] = {
     "sweep-wedge": {"name": "sweep_stall", "times": 0, "duration": 0.4},
@@ -114,14 +128,19 @@ class LocalChaosHarness:
         except Exception:  # the probe's failure IS the signal
             return False   # (recovery not reached yet)
 
-    def _faults_post(self, payload: dict[str, Any]) -> tuple[bool, str]:
+    def _post_json(self, path: str,
+                   payload: dict[str, Any]) -> tuple[bool, str]:
+        """ONE POST helper for every injection surface (/faults and the
+        fleet router's /fleet/chaos): (ok, body-or-error snippet). An
+        HTTP error (404 non-fleet target, 409 no survivors, 403 gated)
+        becomes an honest injected=False row upstream."""
         req = urllib.request.Request(
-            self.url + "/faults", data=json.dumps(payload).encode(),
+            self.url + path, data=json.dumps(payload).encode(),
             headers={"Content-Type": "application/json"},
         )
         try:
             with urllib.request.urlopen(req, timeout=self.probe_timeout_s) as r:
-                return r.status == 200, ""
+                return r.status == 200, r.read().decode()[:200]
         except urllib.error.HTTPError as e:
             detail = ""
             try:
@@ -129,8 +148,12 @@ class LocalChaosHarness:
             except Exception:  # detail string is best-effort
                 pass
             return False, f"HTTP {e.code}: {detail}"
-        except Exception as e:  # noqa: BLE001 — arm failure is a row
+        except Exception as e:  # noqa: BLE001 — injection failure is a row
             return False, f"{type(e).__name__}: {e}"
+
+    def _faults_post(self, payload: dict[str, Any]) -> tuple[bool, str]:
+        ok, body = self._post_json("/faults", payload)
+        return ok, "" if ok else body
 
     def _arm(self, fault: str) -> tuple[bool, str]:
         params = dict(FAULT_ARMS[fault])
@@ -141,10 +164,13 @@ class LocalChaosHarness:
         self._faults_post({"action": "clear",
                            "name": FAULT_ARMS[fault]["name"]})
 
+    def _fleet_chaos(self, payload: dict[str, Any]) -> tuple[bool, str]:
+        return self._post_json("/fleet/chaos", payload)
+
     # -- scenario loop -----------------------------------------------------
 
     def run_fault(self, fault: str) -> FaultResult:
-        if fault not in FAULT_ARMS:
+        if fault not in FAULT_ARMS and fault not in REPLICA_FAULTS:
             raise ValueError(
                 f"unknown local fault {fault!r} (known: {LOCAL_FAULTS})"
             )
@@ -159,12 +185,31 @@ class LocalChaosHarness:
                 detail="publish_drop needs a multihost primary; covered "
                        "by the unit-level decision-stream test",
             )
-        injected, detail = self._arm(fault)
+        if fault in REPLICA_FAULTS:
+            # replica-level scenarios go through the fleet router's
+            # POST /fleet/chaos (docs/FLEET.md). The kill's 'clear' is a
+            # no-op (recovery = supervisor self-heal + router failover);
+            # the wedge's clear disarms sweep_stall on every replica.
+            return self._scenario(
+                fault,
+                inject=lambda: self._fleet_chaos(REPLICA_FAULTS[fault]),
+                clear=lambda: self._fleet_chaos({"action": "clear"}),
+            )
+        return self._scenario(
+            fault,
+            inject=lambda: self._arm(fault),
+            clear=lambda: self._clear(fault),
+        )
+
+    def _scenario(self, fault: str, inject, clear) -> FaultResult:
+        """The ONE scenario loop every fault class shares: inject, hold,
+        bench DURING the fault (p95-under-fault + error/shed rates),
+        clear, then MTTR = clear -> first healthy completion."""
+        injected, detail = inject()
         result = FaultResult(fault, injected, False, detail=detail)
         if not injected:
             return result  # gate_ok stays None: no fault, no verdict
         try:
-            # bench DURING the fault: p95-under-fault + error/shed rates
             self.sleep(self.fault_hold_s)
             if self.bench_fn is not None:
                 try:
@@ -181,8 +226,7 @@ class LocalChaosHarness:
                     if self.gate_fn is not None:
                         result.gate_ok = bool(self.gate_fn(bench))
         finally:
-            self._clear(fault)
-        # MTTR: fault cleared -> first healthy completion
+            clear()
         t0 = self.clock()
         while self.clock() - t0 < self.recovery_timeout_s:
             if self.probe_fn():

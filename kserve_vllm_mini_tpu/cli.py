@@ -55,6 +55,9 @@ _SUBCOMMANDS: dict[str, tuple[str, str]] = {
                    "Perf trend over BENCH_*.json rounds (real + proxy series)"),
     "autoscale-controller": ("kserve_vllm_mini_tpu.autoscale.controller",
                              "SLO/duty-signal-driven replica controller"),
+    "fleet": ("kserve_vllm_mini_tpu.fleet.service",
+              "N serving replicas behind the cache-aware router "
+              "(+ optional live local autoscaler)"),
     "autoscale-sim": ("kserve_vllm_mini_tpu.autoscale.simulate",
                       "Replay a load timeline against the autoscale policy"),
 }

@@ -169,7 +169,13 @@ async def _tenant_worker(
         try:
             result = await adapter.generate(
                 client, url, model, prompt_fn(idx),
-                GenParams(max_tokens=tenant.max_tokens), False, None,
+                # the OpenAI `user` field names the tenant: against the
+                # fleet router this is the session-affinity key, so each
+                # tenant's traffic pins to (and thrashes) its own
+                # replica's cache instead of smearing across the fleet
+                GenParams(max_tokens=tenant.max_tokens,
+                          extra={"user": tenant.name}),
+                False, None,
             )
         except Exception as e:  # noqa: BLE001
             result = CallResult(error=f"adapter-{type(e).__name__}")
@@ -177,6 +183,11 @@ async def _tenant_worker(
     rec.ok = result.ok
     rec.status_code = result.status_code
     rec.error = result.error
+    # fleet-level backpressure (docs/FLEET.md): a 429 from the router
+    # (or a single server's door) is ADMISSION CONTROL, not a broken
+    # request — counted as a shed, excluded from the error rate, same
+    # contract as the loadgen's accounting (docs/RESILIENCE.md)
+    rec.shed = result.status_code == 429
     rec.tokens_in = result.tokens_in
     rec.tokens_out = result.tokens_out
     rec.latency_ms = (rec.end_ts - rec.start_ts) * 1000.0
@@ -249,6 +260,7 @@ def summarize(
     for name, recs in sorted(by_tenant.items()):
         lats = [r.latency_ms for r in recs if r.ok]
         ok = len(lats)
+        sheds = sum(1 for r in recs if r.shed)
         t0 = min((r.start_ts for r in recs), default=0.0)
         t1 = max((r.end_ts for r in recs), default=0.0)
         span = max(t1 - t0, 1e-9)
@@ -257,7 +269,15 @@ def summarize(
         tenants[name] = {
             "requests": len(recs),
             "ok": ok,
-            "error_rate": 1.0 - ok / len(recs) if recs else 0.0,
+            # sheds are backpressure doing its job (door-level 429s, or
+            # the fleet router's fleet-level admission) — reported in
+            # their own column, EXCLUDED from the error rate, mirroring
+            # the loadgen's shed/error split (docs/RESILIENCE.md)
+            "sheds": sheds,
+            "shed_rate": sheds / len(recs) if recs else 0.0,
+            "error_rate": (
+                (len(recs) - ok - sheds) / len(recs) if recs else 0.0
+            ),
             "p50_ms": percentile(lats, 50.0) if lats else None,
             "p95_ms": p95s[name] if lats else None,
             "throughput_rps": ok / span,
@@ -321,7 +341,15 @@ td:first-child,th:first-child{{text-align:left}}</style></head>
 # -- CLI ---------------------------------------------------------------------
 
 def register(parser: argparse.ArgumentParser) -> None:
-    parser.add_argument("--url", required=True)
+    parser.add_argument("--url", required=True,
+                        help="Endpoint under test: a single server, or "
+                             "the fleet router (kvmini-tpu fleet) — "
+                             "against the router the probe exercises "
+                             "FLEET-level backpressure: per-replica "
+                             "429s are absorbed by re-placement and "
+                             "only fleet-wide overload sheds, landing "
+                             "in the tenants' shed column "
+                             "(docs/FLEET.md)")
     parser.add_argument("--model", default="default")
     parser.add_argument("--backend", default="openai")
     parser.add_argument("--duration", type=float, default=20.0)

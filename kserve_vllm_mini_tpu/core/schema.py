@@ -188,6 +188,13 @@ class Results:
     # absent for colocated engines, external engines, and runs with zero
     # handoff activity.
     disagg: Optional[dict[str, Any]] = None
+    # fleet block (docs/FLEET.md): the multi-replica router's rail —
+    # {replicas_desired, replicas_live, placements, reroutes, sheds,
+    # stream_errors, replica_restarts, scale_ups, scale_downs,
+    # last_cold_start_s, source} — scraped from the router's aggregated
+    # /metrics (analysis/telemetry.py FLEET_METRIC_KEYS); absent for
+    # single-server runs and external engines.
+    fleet: Optional[dict[str, Any]] = None
     # headroom-model validation (profiling/headroom.py): signed % error
     # of the analytic admission estimate vs the observed HBM peak —
     # negative = the model UNDERESTIMATES (the OOM direction). Present

@@ -1,0 +1,29 @@
+"""Multi-replica serving fleet (docs/FLEET.md).
+
+The paper's autoscale chapter only *measures* Knative's scaler from the
+outside; this subsystem owns the capability: N single-engine server
+replicas (``runtime/server.py`` unchanged, one subprocess per replica)
+behind a cache-aware router, scaled live by a local actuator driven from
+the same signals the monitor already computes.
+
+- ``fleet.supervisor`` — spawns/reaps replica subprocesses, restarts
+  unexpectedly-dead ones, and accounts scale-up cold starts.
+- ``fleet.router`` — asyncio HTTP front: prefix/session-affinity
+  placement scored against each replica's live ``estimate_wait_s`` and
+  queue depth, fleet-level admission (per-replica 429s re-place before
+  the client ever sees them), and an aggregated ``/metrics`` with
+  per-replica labels.
+- ``fleet.actuator`` — wires ``autoscale/controller.py`` to the
+  supervisor so burn-rates/queue pressure add and remove REAL replicas.
+- ``fleet.service`` — the ``kvmini-tpu fleet`` CLI gluing the three.
+"""
+
+from kserve_vllm_mini_tpu.fleet.router import (  # noqa: F401
+    FleetRouter,
+    PrefixIndex,
+    RouterConfig,
+)
+from kserve_vllm_mini_tpu.fleet.supervisor import (  # noqa: F401
+    FleetSupervisor,
+    Replica,
+)

@@ -1,0 +1,156 @@
+"""Local autoscale actuator: the controller's policy, REAL replicas.
+
+``autoscale/controller.py`` stays the policy brain (target tracking,
+downscale stabilization, breach latch); this module supplies the two
+halves it previously only had in dry-run/KServe form for a local fleet:
+
+- **signals** come from the ROUTER's aggregated ``/metrics`` in one
+  scrape: the flat parser sums the per-replica labeled series, so fleet
+  queue depth is the true sum and mean duty is sum/live — the exact
+  aggregation ``fleet_signals`` does with N scrapes, for one. An
+  attached live monitor (docs/MONITORING.md) contributes its rolling
+  SLO burn-rates: any burn at/over the threshold counts as a breach and
+  forces a step up, which is how "scale on burn-rate" becomes a real
+  actuation instead of a dashboard annotation.
+- **actuation** is ``FleetSupervisor.scale_to`` — subprocess replicas
+  spawn (blocking until healthy, so the next poll sees capacity, not
+  promises) and reap, with cold starts measured per scale-up.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from pathlib import Path
+from typing import Any, Callable, Optional
+
+from kserve_vllm_mini_tpu.analysis.telemetry import scrape_runtime_metrics
+from kserve_vllm_mini_tpu.autoscale.controller import (
+    Controller,
+    PolicyConfig,
+    Signals,
+)
+from kserve_vllm_mini_tpu.fleet.supervisor import FleetSupervisor
+
+
+def router_signals(
+    router_url: str,
+    burn_fn: Optional[Callable[[], dict[str, float]]] = None,
+    burn_threshold: float = 2.0,
+    timeout_s: float = 5.0,
+) -> Signals:
+    """One poll of the fleet through the router's aggregated /metrics.
+
+    ``burn_fn`` (e.g. ``monitor_burn_fn(run_monitor)``) supplies the
+    live monitor's rolling burn-rates; any value >= ``burn_threshold``
+    marks the sample SLO-breached, which the policy answers with an
+    immediate step up."""
+    m = scrape_runtime_metrics(router_url, timeout_s=timeout_s)
+    live = m.get("kvmini_tpu_fleet_replicas_live", 0.0)
+    # the router re-emits ratio gauges (duty among them) as ONE
+    # fleet-level mean (router.MEAN_GAUGES); queue_depth arrives as the
+    # per-replica labeled series the flat parser sums = the true total
+    duty = m.get("kvmini_tpu_duty_cycle", 0.0)
+    sig = Signals(
+        duty_cycle=min(duty, 1.0),
+        queue_depth=m.get("kvmini_tpu_queue_depth", 0.0),
+        ts=time.time(),
+        valid=bool(m) and live > 0,
+    )
+    if burn_fn is not None and sig.valid:
+        try:
+            burns = burn_fn() or {}
+        except Exception:  # noqa: BLE001 — a monitor mid-teardown loses
+            burns = {}     # one poll's breach signal, not the loop
+        if any(v >= burn_threshold for v in burns.values()):
+            sig.slo_breached = True
+    return sig
+
+
+def monitor_burn_fn(monitor: Any) -> Callable[[], dict[str, float]]:
+    """Adapt a live ``RunMonitor`` to the actuator's burn source (its
+    ``summary()`` carries the latest rolling burn-rates under the same
+    1.0-=-on-budget convention the burn threshold compares against)."""
+
+    def burns() -> dict[str, float]:
+        return dict(monitor.summary().get("burn_rates", {}))
+
+    return burns
+
+
+def local_scaler(supervisor: FleetSupervisor) -> Callable[[int], None]:
+    """The controller-facing actuation verb. Blocks until new replicas
+    are healthy — cold-start wall lands in the supervisor's counters."""
+
+    def scale(n: int) -> None:
+        supervisor.scale_to(n)
+
+    return scale
+
+
+class FleetAutoscaler:
+    """A Controller polling the router and actuating the supervisor on
+    its own thread — the live loop the paper's autoscale chapter could
+    only sweep from outside.
+
+    ``burn_fn`` is optional; with a live monitor attached the loop
+    scales on SLO burn-rates as well as duty/queue pressure."""
+
+    def __init__(
+        self,
+        supervisor: FleetSupervisor,
+        router_url: str,
+        cfg: Optional[PolicyConfig] = None,
+        interval_s: float = 2.0,
+        burn_fn: Optional[Callable[[], dict[str, float]]] = None,
+        burn_threshold: float = 2.0,
+        decision_log: Optional[Path] = None,
+        initial_replicas: int = 1,
+    ) -> None:
+        self.supervisor = supervisor
+        self.router_url = router_url
+        self.interval_s = interval_s
+        self.controller = Controller(
+            signal_fn=lambda: router_signals(
+                router_url, burn_fn=burn_fn, burn_threshold=burn_threshold
+            ),
+            scaler=local_scaler(supervisor),
+            cfg=cfg,
+            initial_replicas=initial_replicas,
+            decision_log=decision_log,
+        )
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def step(self) -> int:
+        return self.controller.step()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(timeout=self.interval_s):
+            try:
+                self.controller.step()
+            except Exception as e:  # noqa: BLE001 — an autoscaler that
+                # dies on one bad poll stops scaling exactly when churn
+                # makes polls flaky (same contract as Controller.run)
+                print(f"fleet-autoscale: step failed ({type(e).__name__}: "
+                      f"{e}); continuing")
+
+    def start(self) -> "FleetAutoscaler":
+        if self._thread is not None:
+            raise RuntimeError("autoscaler already started")
+        self._thread = threading.Thread(
+            target=self._loop, name="fleet-autoscaler", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+
+    @property
+    def decisions(self) -> list[dict[str, Any]]:
+        # snapshot, not the live list: the controller appends on the
+        # autoscaler thread while callers iterate
+        return list(self.controller.decisions)

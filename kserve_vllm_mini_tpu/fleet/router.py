@@ -1,0 +1,925 @@
+"""Cache-aware fleet router: one HTTP front over N serving replicas.
+
+Placement (docs/FLEET.md) scores every healthy replica with
+
+    score = prefix_affinity(prompt, replica) / len(prompt)
+            - load_weight * (est_wait_s + inflight * inflight_cost_s)
+
+where ``prefix_affinity`` is the deepest chunk-hash chain the router has
+seen that replica serve (``PrefixIndex`` — the router-side mirror of the
+engines' prefix caches: route a prompt to the replica whose retained KV
+already holds its longest prefix), ``est_wait_s`` is each replica's own
+admission burn-rate estimate scraped from ``/metrics``
+(``kvmini_tpu_estimated_wait_seconds`` — the same signal the door-level
+deadline shed uses, promoted to fleet-level placement), and ``inflight``
+is the router's instant count of requests it has proxied there (feedback
+between scrapes). Session affinity (the OpenAI ``user`` field or an
+``x-session-id`` header) pins a session to its replica while that
+replica's load stays reasonable.
+
+Fleet-level admission: a per-replica 429/503/connect failure re-places
+the request on the next-best replica BEFORE the client sees anything;
+only when every candidate sheds does the router answer 429 itself, with
+the PR-10 ``Retry-After`` contract. A replica that dies mid-stream
+cannot hang its clients: bytes-not-yet-sent requests re-place onto
+survivors, mid-stream ones get one honest terminal SSE error event.
+
+``/metrics`` aggregates: the router's own ``kvmini_tpu_fleet_*`` series
+plus every replica's last scrape re-labeled ``{replica="rN"}`` —
+``analysis/telemetry.parse_prometheus_text`` sums duplicate labeled
+series, so every existing post-hoc consumer reads fleet totals with no
+changes, and per-replica views stay one PromQL ``by (replica)`` away.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import queue
+import threading
+import time
+import zlib
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from kserve_vllm_mini_tpu.analysis.telemetry import parse_prometheus_text
+
+# replica /metrics series the scoreboard folds into placement state
+_WAIT_METRIC = "kvmini_tpu_estimated_wait_seconds"
+_QUEUE_METRIC = "kvmini_tpu_queue_depth"
+_SLOTS_METRIC = "kvmini_tpu_active_slots"
+
+PLACEMENT_REASONS = ("affinity", "prefix", "load", "round_robin")
+
+# ratio/percentile gauges whose per-replica values must NOT be summed:
+# the flat scrape parser adds duplicate labeled series (correct for
+# counters and level gauges — fleet totals), but 3 replicas at 0.8 duty
+# are not 2.4 duty. These are stripped from the per-replica passthrough
+# and re-emitted ONCE as the mean over healthy replicas; per-replica
+# duty stays derivable from rate(busy_seconds_total{replica=...}).
+MEAN_GAUGES = frozenset({
+    "kvmini_tpu_duty_cycle",
+    "kvmini_tpu_spec_accept_ratio",
+    "kvmini_tpu_kv_occupancy",
+    "kvmini_tpu_kv_retained_fraction",
+    "kvmini_tpu_kv_fragmentation",
+    "kvmini_tpu_kv_prefix_hit_depth_p50",
+    "kvmini_tpu_kv_prefix_hit_depth_p95",
+    "kvmini_tpu_estimated_wait_seconds",
+})
+
+
+@dataclass
+class RouterConfig:
+    policy: str = "cache_aware"        # "cache_aware" | "round_robin"
+    scrape_interval_s: float = 0.5
+    scrape_timeout_s: float = 0.4
+    unhealthy_after: int = 3           # consecutive scrape failures
+    prefix_chunk_chars: int = 128
+    prefix_index_entries: int = 8192
+    session_entries: int = 4096
+    load_weight: float = 0.2
+    inflight_cost_s: float = 0.05
+    affinity_max_wait_s: float = 5.0   # affinity breaks past this load
+    read_timeout_s: float = 120.0      # upstream silence -> failover
+    connect_timeout_s: float = 2.0
+
+    def __post_init__(self) -> None:
+        if self.policy not in ("cache_aware", "round_robin"):
+            raise ValueError(
+                f"unknown fleet policy {self.policy!r}; known: "
+                "cache_aware, round_robin"
+            )
+
+
+class PrefixIndex:
+    """Chunk-hash chain -> replica affinity, bounded LRU.
+
+    The prompt is cut into fixed-size character chunks and hashed as a
+    CHAIN (crc32 folded left-to-right), so the hash at depth *i* names
+    the exact (i+1)-chunk prefix. Recording a served prompt writes every
+    depth; matching a new prompt walks its own chain and, per replica,
+    keeps the DEEPEST depth that replica owns — the router-side estimate
+    of how many leading characters that replica's prefix cache can
+    reuse. Character-level on purpose: the router has no tokenizer, and
+    the engines' caches match token prefixes that character prefixes
+    conservatively under-approximate."""
+
+    def __init__(self, chunk_chars: int = 128, max_entries: int = 8192) -> None:
+        self.chunk_chars = max(int(chunk_chars), 1)
+        self.max_entries = max(int(max_entries), 1)
+        self._map: OrderedDict[int, str] = OrderedDict()
+
+    def _chain(self, prompt: str) -> list[int]:
+        out: list[int] = []
+        h = 0
+        for i in range(0, len(prompt), self.chunk_chars):
+            piece = prompt[i:i + self.chunk_chars]
+            if len(piece) < self.chunk_chars:
+                break  # only full chunks index — tails rarely repeat
+            h = zlib.crc32(piece.encode("utf-8", "surrogatepass"), h)
+            out.append(h)
+        return out
+
+    def record(self, prompt: str, rid: str) -> None:
+        for h in self._chain(prompt):
+            if h in self._map:
+                self._map.move_to_end(h)
+            # every access (record/best/len) runs on the router's ONE
+            # event loop; there is no second thread
+            self._map[h] = rid
+        while len(self._map) > self.max_entries:
+            self._map.popitem(last=False)
+
+    def best(self, prompt: str) -> dict[str, int]:
+        """replica id -> matched prefix CHARS (deepest owned depth)."""
+        out: dict[str, int] = {}
+        for depth, h in enumerate(self._chain(prompt), start=1):
+            rid = self._map.get(h)
+            if rid is not None:
+                out[rid] = depth * self.chunk_chars
+        return out
+
+    def purge(self, rid: str) -> None:
+        """Forget a replica's affinity — called when it dies: a
+        watchdog respawn reuses the rid with a COLD cache, and stale
+        chains would route 'prefix'-scored traffic at an empty cache."""
+        for h in [h for h, r in self._map.items() if r == rid]:
+            del self._map[h]  # kvmini: thread-ok — same loop (see record)
+
+    def __len__(self) -> int:
+        return len(self._map)
+
+
+@dataclass
+class ReplicaView:
+    """The router's live picture of one replica (event-loop-owned)."""
+
+    rid: str
+    url: str
+    healthy: bool = True
+    est_wait_s: float = 0.0
+    queue_depth: float = 0.0
+    active_slots: float = 0.0
+    inflight: int = 0              # router-side proxied-and-unfinished
+    scrape_failures: int = 0
+    seen_healthy: bool = False
+    metrics_text: str = ""         # last raw exposition, for aggregation
+    metrics_map: dict[str, float] = field(default_factory=dict)
+    last_scrape_t: float = 0.0
+
+    def view(self) -> dict[str, Any]:
+        return {
+            "rid": self.rid, "url": self.url, "healthy": self.healthy,
+            "est_wait_s": round(self.est_wait_s, 4),
+            "queue_depth": self.queue_depth,
+            "inflight": self.inflight,
+        }
+
+
+def relabel_exposition(text: str, rid: str, type_seen: set[str],
+                       skip: frozenset[str] = frozenset()) -> list[str]:
+    """Re-emit one replica's Prometheus exposition with a
+    ``replica="<rid>"`` label on every sample line. ``# TYPE`` comments
+    are kept once per metric family across the whole aggregation
+    (``type_seen`` is shared by the caller). Names in ``skip`` are
+    dropped entirely — the caller re-emits those as fleet-level means
+    (MEAN_GAUGES: ratios must not label-sum)."""
+    out: list[str] = []
+    for line in text.splitlines():
+        line = line.rstrip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split()
+            if len(parts) >= 3 and parts[1] == "TYPE":
+                if parts[2] in type_seen or parts[2] in skip:
+                    continue
+                type_seen.add(parts[2])
+                out.append(line)
+            continue
+        if "{" in line and "}" in line:
+            head, rest = line.split("{", 1)
+            if head in skip:
+                continue
+            labels, tail = rest.rsplit("}", 1)
+            out.append(f'{head}{{{labels},replica="{rid}"}}{tail}')
+        else:
+            parts = line.split(None, 1)
+            if len(parts) == 2:
+                if parts[0] in skip:
+                    continue
+                out.append(f'{parts[0]}{{replica="{rid}"}} {parts[1]}')
+    return out
+
+
+class FleetRouter:
+    """The routing state machine + aiohttp app.
+
+    Replicas come from a ``FleetSupervisor`` (live fleets: the
+    scoreboard re-syncs the set every tick, so actuator scale-ups and
+    watchdog respawns appear without any cross-thread push) or a static
+    ``replicas=[(rid, url), ...]`` list (tests, external fleets). All
+    mutable routing state lives on the event loop; the only cross-thread
+    reads go through the supervisor's own lock."""
+
+    def __init__(
+        self,
+        supervisor: Any = None,
+        replicas: Optional[list[tuple[str, str]]] = None,
+        cfg: Optional[RouterConfig] = None,
+        allow_fault_injection: bool = False,
+    ) -> None:
+        if supervisor is None and not replicas:
+            raise ValueError("need a supervisor or a static replica list")
+        self.supervisor = supervisor
+        self.cfg = cfg or RouterConfig()
+        self.allow_fault_injection = allow_fault_injection
+        self._static = list(replicas or [])
+        self._views: dict[str, ReplicaView] = {}
+        self._prefix = PrefixIndex(self.cfg.prefix_chunk_chars,
+                                   self.cfg.prefix_index_entries)
+        self._sessions: OrderedDict[str, str] = OrderedDict()
+        self._rr = 0
+        self.placements: dict[str, int] = {r: 0 for r in PLACEMENT_REASONS}
+        self.reroutes = 0
+        self.sheds = 0
+        self.stream_errors = 0
+        self._client: Any = None          # aiohttp.ClientSession
+        self._scoreboard_task: Any = None
+        self._started = time.time()
+
+    # -- replica set + scoreboard -----------------------------------------
+
+    def _sync_replicas(self) -> None:
+        pairs = (self.supervisor.live_urls() if self.supervisor is not None
+                 else self._static)
+        want = dict(pairs)
+        for rid, url in want.items():
+            if rid not in self._views:
+                # all router state (views, counters, prefix index,
+                # sessions) is mutated ONLY on the one event loop
+                # (handlers + scoreboard task); the only cross-thread
+                # traffic goes through the supervisor's lock
+                self._views[rid] = ReplicaView(rid=rid, url=url)
+        for rid in [r for r in self._views if r not in want]:
+            del self._views[rid]
+
+    async def _scrape_one(self, r: ReplicaView) -> None:
+        import aiohttp
+
+        try:
+            timeout = aiohttp.ClientTimeout(total=self.cfg.scrape_timeout_s)
+            async with self._client.get(r.url + "/metrics",
+                                        timeout=timeout) as resp:
+                text = await resp.text()
+            if resp.status != 200:
+                raise RuntimeError(f"/metrics HTTP {resp.status}")
+        except Exception:  # noqa: BLE001 — scrape failures ARE the
+            # health signal: K consecutive ones mark the replica
+            # unhealthy and placement routes around it
+            r.scrape_failures += 1
+            if r.scrape_failures >= self.cfg.unhealthy_after:
+                self._mark_unhealthy(r)
+            return
+        m = parse_prometheus_text(text)
+        r.metrics_text = text
+        r.metrics_map = m
+        r.est_wait_s = m.get(_WAIT_METRIC, 0.0)
+        r.queue_depth = m.get(_QUEUE_METRIC, 0.0)
+        r.active_slots = m.get(_SLOTS_METRIC, 0.0)
+        r.scrape_failures = 0
+        r.healthy = True
+        r.seen_healthy = True
+        r.last_scrape_t = time.time()
+
+    async def _scoreboard(self) -> None:
+        while True:
+            self._sync_replicas()
+            # the scoreboard task runs on the SAME event loop as every
+            # handler (see _sync_replicas) — no second thread exists
+            views = list(
+                self._views.values()  # kvmini: thread-ok — same loop
+            )
+            if views:
+                await asyncio.gather(*(self._scrape_one(r) for r in views))
+            await asyncio.sleep(self.cfg.scrape_interval_s)
+
+    async def refresh(self) -> None:
+        """One synchronous scoreboard pass (tests; the background task
+        does this every ``scrape_interval_s``)."""
+        self._sync_replicas()
+        views = list(self._views.values())
+        if views:
+            await asyncio.gather(*(self._scrape_one(r) for r in views))
+
+    def _mark_unhealthy(self, r: ReplicaView) -> None:
+        """Health flip + affinity invalidation in one place: a dead (or
+        soon-respawned-cold) replica must not keep its prefix chains or
+        pinned sessions — they would score a cold cache as warm."""
+        if r.healthy:
+            self._prefix.purge(r.rid)
+            # event-loop-only state: all writers and readers live on
+            # the router's one loop
+            for s in [s for s, rid in self._sessions.items()
+                      if rid == r.rid]:
+                del self._sessions[s]  # kvmini: thread-ok — same loop
+        r.healthy = False
+
+    # -- placement ---------------------------------------------------------
+
+    def _load(self, r: ReplicaView) -> float:
+        return r.est_wait_s + r.inflight * self.cfg.inflight_cost_s
+
+    def place(
+        self, prompt: str, session: Optional[str],
+        exclude: Optional[set[str]] = None,
+    ) -> tuple[Optional[ReplicaView], str]:
+        """Pick a replica for this prompt; returns (view, reason) or
+        (None, "") when no healthy candidate remains."""
+        exclude = exclude or set()
+        cands = sorted(
+            (r for r in self._views.values()
+             if r.healthy and r.rid not in exclude),
+            key=lambda r: r.rid,
+        )
+        if not cands:
+            return None, ""
+        if self.cfg.policy == "round_robin":
+            self._rr += 1
+            return cands[self._rr % len(cands)], "round_robin"
+        if session:
+            rid = self._sessions.get(session)
+            if rid is not None:
+                pinned = next((r for r in cands if r.rid == rid), None)
+                if (pinned is not None
+                        and self._load(pinned) <= self.cfg.affinity_max_wait_s):
+                    return pinned, "affinity"
+        hits = self._prefix.best(prompt)
+        plen = max(len(prompt), 1)
+        best: Optional[ReplicaView] = None
+        best_score = 0.0
+        for r in cands:
+            score = (min(hits.get(r.rid, 0), plen) / plen
+                     - self.cfg.load_weight * self._load(r))
+            if best is None or score > best_score:
+                best, best_score = r, score
+        assert best is not None
+        reason = "prefix" if hits.get(best.rid) else "load"
+        return best, reason
+
+    def _record_success(self, prompt: str, session: Optional[str],
+                        rid: str) -> None:
+        self._prefix.record(prompt, rid)
+        if session:
+            if session in self._sessions:
+                self._sessions.move_to_end(session)
+            self._sessions[session] = rid
+            while len(self._sessions) > self.cfg.session_entries:
+                self._sessions.popitem(last=False)
+
+    def _retry_after_s(self, hints: list[float]) -> int:
+        waits = [r.est_wait_s for r in self._views.values() if r.healthy]
+        base = min(waits) if waits else 1.0
+        return max(1, int(max(hints + [base]) + 0.999))
+
+    # -- aiohttp app -------------------------------------------------------
+
+    def make_app(self):
+        from aiohttp import web
+
+        async def on_startup(_app) -> None:
+            import aiohttp
+
+            # written once at app startup on the event loop, read by
+            # handlers on the same loop
+            self._client = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(
+                    total=None,
+                    sock_connect=self.cfg.connect_timeout_s,
+                    sock_read=self.cfg.read_timeout_s,
+                ),
+            )
+            self._sync_replicas()
+            await self.refresh()
+            self._scoreboard_task = asyncio.create_task(self._scoreboard())
+
+        async def on_cleanup(_app) -> None:
+            if self._scoreboard_task is not None:
+                self._scoreboard_task.cancel()
+                try:
+                    await self._scoreboard_task
+                except asyncio.CancelledError:
+                    pass
+            if self._client is not None:
+                await self._client.close()
+
+        def _shed_response(message: str, hints: list[float]) -> "web.Response":
+            # the PR-10 shed wire shape, promoted to fleet level: same
+            # code, same Retry-After contract the loadgen retry honors
+            self.sheds += 1
+            return web.json_response(
+                {"error": {"message": message, "type": "overloaded_error",
+                           "code": "request_shed"}},
+                status=429,
+                headers={"Retry-After": str(self._retry_after_s(hints))},
+            )
+
+        def _prompt_of(body: dict[str, Any]) -> str:
+            msgs = body.get("messages")
+            if not isinstance(msgs, list):
+                return ""
+            return "\n".join(
+                f"{m.get('role', 'user')}: {m.get('content', '')}"
+                for m in msgs if isinstance(m, dict)
+            )
+
+        async def chat(request: "web.Request"):
+            raw = await request.read()
+            try:
+                body = json.loads(raw)
+                if not isinstance(body, dict):
+                    raise ValueError
+            except ValueError:
+                return web.json_response(
+                    {"error": {"message": "invalid JSON body"}}, status=400
+                )
+            prompt = _prompt_of(body)
+            session = body.get("user") or request.headers.get("x-session-id")
+            streaming = bool(body.get("stream", False))
+            fwd_headers = {"Content-Type": "application/json"}
+            for h in ("traceparent", "x-request-deadline-ms"):
+                if h in request.headers:
+                    fwd_headers[h] = request.headers[h]
+            tried: set[str] = set()
+            retry_hints: list[float] = []
+            while True:
+                r, reason = self.place(prompt, session, exclude=tried)
+                if r is None:
+                    if not any(v.healthy for v in self._views.values()):
+                        return web.json_response(
+                            {"error": {"message":
+                                       "no healthy replica in the fleet"}},
+                            status=503,
+                        )
+                    return _shed_response(
+                        "fleet overloaded: every replica shed or failed "
+                        "this request", retry_hints,
+                    )
+                tried.add(r.rid)
+                self.placements[reason] = self.placements.get(reason, 0) + 1
+                r.inflight += 1
+
+                def on_success(rid=r.rid) -> None:
+                    # recorded ONLY on clean completions (inside
+                    # _proxy_once): a stream that died mid-flight must
+                    # not re-pin its session to the dead replica
+                    self._record_success(prompt, session, rid)
+
+                try:
+                    resp = await _proxy_once(request, r, raw, fwd_headers,
+                                             streaming, retry_hints,
+                                             on_success)
+                finally:
+                    r.inflight -= 1
+                if resp is None:
+                    # per-replica shed/failure absorbed: re-place before
+                    # the client sees anything (fleet-level admission)
+                    self.reroutes += 1
+                    continue
+                return resp
+
+        async def _proxy_once(request, r: ReplicaView, raw: bytes,
+                              fwd_headers: dict[str, str], streaming: bool,
+                              retry_hints: list[float], on_success):
+            """One attempt against one replica. Returns a prepared
+            response to hand the client, or None = absorb and re-place
+            (nothing was sent to the client yet)."""
+            import aiohttp
+            from aiohttp import web
+
+            # the session is written once at app startup; handlers run
+            # on the same event loop — no cross-thread access exists
+            client = self._client  # kvmini: thread-ok — same loop
+            try:
+                async with client.post(
+                    r.url + "/v1/chat/completions", data=raw,
+                    headers=fwd_headers,
+                ) as up:
+                    if up.status == 429:
+                        from kserve_vllm_mini_tpu.loadgen.adapters.base import (
+                            parse_retry_after,
+                        )
+
+                        retry_hints.append(
+                            parse_retry_after(up.headers.get("Retry-After"))
+                        )
+                        await up.read()
+                        return None
+                    if up.status == 503:
+                        # dead scheduler / draining replica: route around
+                        await up.read()
+                        self._mark_unhealthy(r)
+                        return None
+                    ctype = up.headers.get("Content-Type", "")
+                    if not streaming or "text/event-stream" not in ctype:
+                        payload = await up.read()
+                        if up.status < 400:
+                            on_success()
+                        return web.Response(
+                            body=payload, status=up.status,
+                            content_type=ctype.split(";")[0] or
+                            "application/json",
+                            headers={"x-kvmini-replica": r.rid},
+                        )
+                    # SSE passthrough: once the first byte reaches the
+                    # client, failures become honest terminal events,
+                    # never silent hangs and never duplicate streams
+                    resp = web.StreamResponse(
+                        status=200,
+                        headers={"Content-Type": "text/event-stream",
+                                 "Cache-Control": "no-cache",
+                                 "x-kvmini-replica": r.rid},
+                    )
+                    sent_bytes = False
+                    stream_clean = True
+                    try:
+                        async for chunk in up.content.iter_any():
+                            if not sent_bytes:
+                                await resp.prepare(request)
+                                sent_bytes = True
+                            await resp.write(chunk)
+                    except (aiohttp.ClientError, asyncio.TimeoutError,
+                            OSError) as e:
+                        if not sent_bytes:
+                            self._mark_unhealthy(r)
+                            return None  # re-place: client saw nothing
+                        stream_clean = False
+                        self.stream_errors += 1
+                        evt = {"error": {
+                            "message": (
+                                f"replica {r.rid} lost mid-stream "
+                                f"({type(e).__name__}); partial output above"
+                            ),
+                            "type": "server_error",
+                            "code": "replica_lost",
+                        }}
+                        await resp.write(
+                            f"data: {json.dumps(evt)}\n\n".encode()
+                        )
+                    if not sent_bytes:
+                        # a zero-chunk upstream stream (drained before the
+                        # first byte): still hand the client a well-formed
+                        # (empty) SSE response, never an unprepared write
+                        await resp.prepare(request)
+                    if stream_clean:
+                        on_success()
+                    await resp.write_eof()
+                    return resp
+            except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
+                # connect refused / reset before any response: the
+                # replica is gone or wedged — absorb and re-place
+                self._mark_unhealthy(r)
+                return None
+
+        async def models(_request):
+            for r in sorted(self._views.values(), key=lambda v: v.rid):
+                if not r.healthy:
+                    continue
+                try:
+                    async with self._client.get(r.url + "/v1/models") as up:
+                        return web.json_response(await up.json(),
+                                                 status=up.status)
+                except Exception:  # noqa: BLE001 — next healthy
+                    continue       # replica answers instead
+            return web.json_response(
+                {"error": {"message": "no healthy replica"}}, status=503
+            )
+
+        async def healthz(_request):
+            live = sum(1 for r in self._views.values() if r.healthy)
+            desired = (self.supervisor.counters()["desired"]
+                       if self.supervisor is not None else len(self._views))
+            if live == 0:
+                return web.json_response(
+                    {"status": "unhealthy", "replicas_live": 0,
+                     "replicas_desired": desired}, status=503,
+                )
+            return web.json_response({
+                "status": "ok" if live >= desired else "degraded",
+                "replicas_live": live,
+                "replicas_desired": desired,
+                "uptime_s": time.time() - self._started,
+            })
+
+        async def fleet_get(_request):
+            sup = (self.supervisor.counters()
+                   if self.supervisor is not None else {})
+            return web.json_response({
+                "policy": self.cfg.policy,
+                "replicas": [r.view() for r in sorted(
+                    self._views.values(), key=lambda v: v.rid)],
+                "supervisor": sup,
+                "placements": dict(self.placements),
+                "reroutes": self.reroutes,
+                "sheds": self.sheds,
+                "stream_errors": self.stream_errors,
+                "prefix_index_entries": len(self._prefix),
+            })
+
+        async def fleet_scale(request: "web.Request"):
+            if self.supervisor is None:
+                return web.json_response(
+                    {"error": {"message": "static fleet: no supervisor to "
+                               "scale"}}, status=409,
+                )
+            try:
+                body = await request.json()
+                n = int(body["replicas"])
+            except Exception:
+                return web.json_response(
+                    {"error": {"message": "need {\"replicas\": N}"}},
+                    status=400,
+                )
+            loop = asyncio.get_running_loop()
+            # scale_to blocks on replica readiness — run it off the loop
+            # so in-flight streams keep pumping through the cold start
+            applied = await loop.run_in_executor(
+                None, self.supervisor.scale_to, n
+            )
+            self._sync_replicas()
+            return web.json_response(
+                {"status": "ok", "replicas": applied}
+            )
+
+        def _chaos_victim(named: Optional[str]) -> Optional[ReplicaView]:
+            healthy = [r for r in self._views.values() if r.healthy]
+            if named:
+                return next((r for r in healthy if r.rid == named), None)
+            if not healthy:
+                return None
+            # most-disruptive default: the replica carrying the most
+            # router-side in-flight work (ties broken by rid)
+            return sorted(healthy,
+                          key=lambda r: (-r.inflight, r.rid))[0]
+
+        async def fleet_chaos(request: "web.Request"):
+            """Replica-level chaos (docs/FLEET.md failover ladder): kill
+            one replica's process, wedge one replica's sweep loop, or
+            clear wedges. Gated like POST /faults; refuses on a fleet
+            with <= 1 healthy replica — an injection that takes out the
+            only replica measures an outage, not failover, so the chaos
+            row must stay honestly uninjected (the PR-13 handoff-drop
+            pattern)."""
+            if not self.allow_fault_injection:
+                return web.json_response(
+                    {"error": {"message":
+                               "fault injection is disabled; start the "
+                               "router with --allow-fault-injection"}},
+                    status=403,
+                )
+            try:
+                body = await request.json()
+            except Exception:
+                return web.json_response(
+                    {"error": {"message": "invalid JSON"}}, status=400
+                )
+            action = body.get("action")
+            if action == "clear":
+                cleared = 0
+                for r in self._views.values():
+                    try:
+                        async with self._client.post(
+                            r.url + "/faults",
+                            json={"action": "clear", "name": "sweep_stall"},
+                        ) as up:
+                            if up.status == 200:
+                                cleared += 1
+                    except Exception:  # noqa: BLE001 — a dead
+                        continue       # replica has nothing to clear
+                return web.json_response({"status": "ok",
+                                          "cleared": cleared})
+            if action not in ("kill", "wedge"):
+                return web.json_response(
+                    {"error": {"message":
+                               "need action 'kill'|'wedge'|'clear'"}},
+                    status=400,
+                )
+            healthy = sum(1 for r in self._views.values() if r.healthy)
+            if healthy <= 1:
+                return web.json_response(
+                    {"error": {"message":
+                               f"refusing {action}: fleet has {healthy} "
+                               "healthy replica(s) — replica chaos needs "
+                               "survivors to fail over to"}}, status=409,
+                )
+            victim = _chaos_victim(body.get("replica"))
+            if victim is None:
+                return web.json_response(
+                    {"error": {"message": "no such healthy replica"}},
+                    status=404,
+                )
+            if action == "kill":
+                if self.supervisor is None:
+                    return web.json_response(
+                        {"error": {"message": "static fleet: no "
+                                   "supervisor owns the processes"}},
+                        status=409,
+                    )
+                loop = asyncio.get_running_loop()
+                ok = await loop.run_in_executor(
+                    None, self.supervisor.kill_replica, victim.rid
+                )
+                if not ok:
+                    return web.json_response(
+                        {"error": {"message":
+                                   f"kill of {victim.rid} failed"}},
+                        status=500,
+                    )
+                self._mark_unhealthy(victim)
+                return web.json_response({"status": "ok", "killed":
+                                          victim.rid})
+            # wedge: arm sweep_stall on the victim through ITS /faults
+            params = {"action": "arm", "name": "sweep_stall", "times": 0,
+                      "duration": float(body.get("duration", 0.4))}
+            try:
+                async with self._client.post(victim.url + "/faults",
+                                             json=params) as up:
+                    detail = await up.text()
+                    if up.status != 200:
+                        return web.json_response(
+                            {"error": {"message":
+                                       f"replica {victim.rid} refused the "
+                                       f"wedge: HTTP {up.status} "
+                                       f"{detail[:200]}"}},
+                            status=502,
+                        )
+            except Exception as e:  # noqa: BLE001 — surfaced to the caller
+                return web.json_response(
+                    {"error": {"message":
+                               f"wedge of {victim.rid} failed: "
+                               f"{type(e).__name__}: {e}"}}, status=502,
+                )
+            return web.json_response({"status": "ok", "wedged": victim.rid})
+
+        async def metrics(_request):
+            views = sorted(self._views.values(), key=lambda v: v.rid)
+            live = sum(1 for r in views if r.healthy)
+            sup = (self.supervisor.counters()
+                   if self.supervisor is not None else None)
+            desired = sup["desired"] if sup else len(views)
+            s = {
+                "fleet_replicas_desired": desired,
+                "fleet_replicas_live": live,
+                "fleet_reroutes": self.reroutes,
+                "fleet_sheds": self.sheds,
+                "fleet_stream_errors": self.stream_errors,
+                "fleet_replica_restarts": sup["restarts"] if sup else 0,
+                "fleet_scale_ups": sup["scale_ups"] if sup else 0,
+                "fleet_scale_downs": sup["scale_downs"] if sup else 0,
+                "fleet_last_cold_start_s": (
+                    (sup or {}).get("last_cold_start_s") or 0.0
+                ),
+                "fleet_prefix_entries": len(self._prefix),
+            }
+            lines = [
+                "# TYPE kvmini_tpu_fleet_replicas_desired gauge",
+                f"kvmini_tpu_fleet_replicas_desired {s['fleet_replicas_desired']}",
+                "# TYPE kvmini_tpu_fleet_replicas_live gauge",
+                f"kvmini_tpu_fleet_replicas_live {s['fleet_replicas_live']}",
+                "# TYPE kvmini_tpu_fleet_reroutes_total counter",
+                f"kvmini_tpu_fleet_reroutes_total {s['fleet_reroutes']}",
+                "# TYPE kvmini_tpu_fleet_sheds_total counter",
+                f"kvmini_tpu_fleet_sheds_total {s['fleet_sheds']}",
+                "# TYPE kvmini_tpu_fleet_stream_errors_total counter",
+                f"kvmini_tpu_fleet_stream_errors_total {s['fleet_stream_errors']}",
+                "# TYPE kvmini_tpu_fleet_replica_restarts_total counter",
+                "kvmini_tpu_fleet_replica_restarts_total "
+                f"{s['fleet_replica_restarts']}",
+                "# TYPE kvmini_tpu_fleet_scale_ups_total counter",
+                f"kvmini_tpu_fleet_scale_ups_total {s['fleet_scale_ups']}",
+                "# TYPE kvmini_tpu_fleet_scale_downs_total counter",
+                f"kvmini_tpu_fleet_scale_downs_total {s['fleet_scale_downs']}",
+                "# TYPE kvmini_tpu_fleet_last_cold_start_seconds gauge",
+                "kvmini_tpu_fleet_last_cold_start_seconds "
+                f"{s['fleet_last_cold_start_s']:.3f}",
+                "# TYPE kvmini_tpu_fleet_prefix_index_entries gauge",
+                "kvmini_tpu_fleet_prefix_index_entries "
+                f"{s['fleet_prefix_entries']}",
+                "# TYPE kvmini_tpu_fleet_placements_total counter",
+            ]
+            for reason in PLACEMENT_REASONS:
+                lines.append(
+                    "kvmini_tpu_fleet_placements_total"
+                    f"{{reason=\"{reason}\"}} {self.placements.get(reason, 0)}"
+                )
+            # ratio/percentile gauges as ONE fleet-level mean each (over
+            # healthy scraped replicas): label-summing 3 replicas at 0.8
+            # duty would read 2.4 in every flat-scrape consumer
+            for name in sorted(MEAN_GAUGES):
+                vals = [r.metrics_map[name] for r in views
+                        if r.healthy and name in r.metrics_map]
+                if vals:
+                    lines.append(f"# TYPE {name} gauge")
+                    lines.append(f"{name} {sum(vals) / len(vals):.6f}")
+            # per-replica passthrough: every replica's last scrape with a
+            # replica label — the flat-scrape parser SUMS duplicates, so
+            # post-hoc consumers read fleet totals unchanged (counters
+            # and level gauges; the mean-type set above is stripped)
+            type_seen: set[str] = set()
+            for r in views:
+                if r.metrics_text:
+                    lines += relabel_exposition(r.metrics_text, r.rid,
+                                                type_seen,
+                                                skip=MEAN_GAUGES)
+            return web.Response(text="\n".join(lines) + "\n",
+                                content_type="text/plain")
+
+        app = web.Application()
+        app.on_startup.append(on_startup)
+        app.on_cleanup.append(on_cleanup)
+        app.router.add_post("/v1/chat/completions", chat)
+        app.router.add_get("/v1/models", models)
+        app.router.add_get("/healthz", healthz)
+        app.router.add_get("/metrics", metrics)
+        app.router.add_get("/fleet", fleet_get)
+        app.router.add_post("/fleet/scale", fleet_scale)
+        app.router.add_post("/fleet/chaos", fleet_chaos)
+        return app
+
+
+@dataclass
+class RouterHandle:
+    """A router running on its own thread+loop (tests, the bench fleet
+    row, and the ``kvmini-tpu fleet`` CLI's non-blocking mode)."""
+
+    router: FleetRouter
+    url: str
+    _loop: Any
+    _runner: Any
+    _thread: threading.Thread
+    _stopped: bool = field(default=False)
+
+    def stop(self) -> None:
+        if self._stopped:
+            return
+        self._stopped = True
+
+        async def _cleanup() -> None:
+            await self._runner.cleanup()
+
+        fut = asyncio.run_coroutine_threadsafe(_cleanup(), self._loop)
+        try:
+            fut.result(timeout=10.0)
+        finally:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+            self._thread.join(timeout=5.0)
+
+    def __enter__(self) -> "RouterHandle":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.stop()
+
+
+def start_router(
+    router: FleetRouter, host: str = "127.0.0.1", port: int = 0,
+) -> RouterHandle:
+    """Run the router app on a dedicated thread; returns a handle with
+    the bound URL. Synchronous callers (bench row, chaos harness tests)
+    drive it over plain HTTP."""
+    from aiohttp import web
+
+    loop = asyncio.new_event_loop()
+    started: "queue.Queue[Any]" = queue.Queue()
+
+    def run() -> None:
+        asyncio.set_event_loop(loop)
+
+        async def boot() -> Any:
+            runner = web.AppRunner(router.make_app())
+            await runner.setup()
+            site = web.TCPSite(runner, host, port)
+            await site.start()
+            bound = site._server.sockets[0].getsockname()[1]
+            return runner, bound
+
+        try:
+            runner, bound = loop.run_until_complete(boot())
+        except Exception as e:  # noqa: BLE001 — surfaced to the caller
+            started.put(e)
+            return
+        started.put((runner, bound))
+        loop.run_forever()
+
+    thread = threading.Thread(target=run, name="fleet-router", daemon=True)
+    thread.start()
+    got = started.get(timeout=30.0)
+    if isinstance(got, Exception):
+        raise got
+    runner, bound = got
+    return RouterHandle(
+        router=router, url=f"http://{host}:{bound}",
+        _loop=loop, _runner=runner, _thread=thread,
+    )

@@ -1,0 +1,137 @@
+"""``kvmini-tpu fleet`` — N serving replicas behind the cache-aware
+router, optionally autoscaled live (docs/FLEET.md).
+
+One command replaces the paper's outside-in autoscale sweep: the
+supervisor spawns ``--replicas`` unmodified ``kvmini-tpu serve``
+processes, the router fronts them on ``--port``, and ``--autoscale``
+arms the local actuator so queue pressure / duty / SLO burn add and
+remove replicas for real. Point any existing loadgen/bench/fairness
+invocation at the router URL — the wire contract is the single server's.
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import threading
+from pathlib import Path
+
+from kserve_vllm_mini_tpu.autoscale.controller import PolicyConfig
+from kserve_vllm_mini_tpu.fleet.actuator import FleetAutoscaler
+from kserve_vllm_mini_tpu.fleet.router import (
+    FleetRouter,
+    RouterConfig,
+    start_router,
+)
+from kserve_vllm_mini_tpu.fleet.supervisor import (
+    FleetSupervisor,
+    serve_replica_cmd,
+)
+
+
+def register(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument("--model", default="llama-tiny",
+                        help="Model preset each replica serves")
+    parser.add_argument("--replicas", type=int, default=2,
+                        help="Initial replica count")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=8000,
+                        help="Router port (replicas take OS-assigned ports)")
+    parser.add_argument("--policy", default="cache_aware",
+                        choices=["cache_aware", "round_robin"],
+                        help="Placement policy (docs/FLEET.md scoring)")
+    parser.add_argument("--replica-arg", action="append", default=None,
+                        metavar="ARG",
+                        help="Extra flag passed verbatim to every "
+                             "`kvmini-tpu serve` replica (repeatable), "
+                             "e.g. --replica-arg=--prefix-cache")
+    parser.add_argument("--log-dir", default=None,
+                        help="Per-replica stdout/stderr logs (default: "
+                             "discarded)")
+    parser.add_argument("--max-replicas", type=int, default=8)
+    parser.add_argument("--no-restart", action="store_true",
+                        help="Do not respawn replicas that die "
+                             "unexpectedly (default: self-heal)")
+    parser.add_argument("--autoscale", action="store_true",
+                        help="Arm the local actuator: the autoscale "
+                             "policy polls the router's aggregated "
+                             "/metrics and adds/removes replicas live")
+    parser.add_argument("--min", type=int, default=1,
+                        help="Autoscale floor")
+    parser.add_argument("--target-duty", type=float, default=0.75)
+    parser.add_argument("--target-queue", type=float, default=4.0)
+    parser.add_argument("--stabilization", type=float, default=120.0,
+                        help="Downscale stabilization window (s)")
+    parser.add_argument("--autoscale-interval", type=float, default=5.0)
+    parser.add_argument("--decision-log", default=None,
+                        help="JSONL autoscale decision log")
+    parser.add_argument("--allow-fault-injection", action="store_true",
+                        help="Enable POST /fleet/chaos (replica kill/"
+                             "wedge — what `kvmini-tpu chaos --target "
+                             "local` drives against a fleet). Replicas "
+                             "are started with --allow-fault-injection "
+                             "too so wedges can arm. Never enable in "
+                             "production")
+
+
+def run(args: argparse.Namespace) -> int:
+    extra = list(args.replica_arg or [])
+    if args.allow_fault_injection and "--allow-fault-injection" not in extra:
+        extra.append("--allow-fault-injection")
+    sup = FleetSupervisor(
+        replica_cmd=serve_replica_cmd(model=args.model, extra_args=extra),
+        host=args.host,
+        log_dir=Path(args.log_dir) if args.log_dir else None,
+        restart_dead=not args.no_restart,
+        max_replicas=args.max_replicas,
+    )
+    print(f"fleet: starting {args.replicas} replica(s) of {args.model} "
+          "(cold starts measured)...", flush=True)
+    try:
+        sup.start(args.replicas)
+    except Exception as e:  # noqa: BLE001 — a fleet that can't boot must
+        # reap what it spawned, not strand half a fleet of orphans
+        sup.stop()
+        print(f"fleet: startup failed: {e}")
+        return 1
+    router = FleetRouter(
+        supervisor=sup,
+        cfg=RouterConfig(policy=args.policy),
+        allow_fault_injection=args.allow_fault_injection,
+    )
+    handle = start_router(router, host=args.host, port=args.port)
+    scaler = None
+    if args.autoscale:
+        scaler = FleetAutoscaler(
+            sup, handle.url,
+            cfg=PolicyConfig(
+                min_replicas=args.min,
+                max_replicas=args.max_replicas,
+                target_duty=args.target_duty,
+                target_queue_per_replica=args.target_queue,
+                stabilization_s=args.stabilization,
+            ),
+            interval_s=args.autoscale_interval,
+            decision_log=Path(args.decision_log) if args.decision_log
+            else None,
+            initial_replicas=args.replicas,
+        ).start()
+    cs = sup.counters()
+    print(f"kvmini-tpu fleet: router on {handle.url} "
+          f"({cs['live']} replica(s), policy={args.policy}, "
+          f"last cold start "
+          f"{(cs['last_cold_start_s'] or 0.0):.1f}s"
+          f"{', autoscaling' if scaler else ''})", flush=True)
+    stop = threading.Event()
+    signal.signal(signal.SIGINT, lambda *_a: stop.set())
+    signal.signal(signal.SIGTERM, lambda *_a: stop.set())
+    try:
+        while not stop.wait(timeout=1.0):
+            pass  # serve until signalled; the timeout keeps the wait
+            #       interruptible on platforms with flaky signal wakeups
+    finally:
+        if scaler is not None:
+            scaler.stop()
+        handle.stop()
+        sup.stop()
+    return 0

@@ -8,6 +8,9 @@ A prompt set is a named, seeded sequence of prompts assigned per request:
 - ``repeat``   — a small pool of identical prompts (high cache-hit potential)
 - ``unique``   — every prompt distinct (zero cache-hit potential)
 - ``mixed``    — repeat/unique interleaved at a given ratio
+- ``sessions`` — ``pool_size`` concurrent sessions, each with its own LONG
+  shared prefix and a short per-request tail (the prefix-heavy
+  multi-session shape cache-aware fleet routing exists for, docs/FLEET.md)
 
 The cache probe benches ``repeat`` vs ``unique`` and infers hit ratio from
 the TTFT delta (reference cache-probe.sh:229-364).
@@ -72,6 +75,24 @@ def make_prompt_fn(
             return f"[nonce {i}-{r.getrandbits(32):08x}] {base}{pad}"
 
         return mixed
+    if prompt_set == "sessions":
+        # multi-session prefix-heavy workload (docs/FLEET.md): request i
+        # belongs to session i % pool_size; every session carries its own
+        # LONG shared prefix (a system-prompt/history surrogate, salted
+        # FIRST so sessions diverge from token 0 — prefix caches match
+        # from the front) and a short per-turn tail. The shape
+        # cache-aware routing exists for: a session's later turns reuse
+        # deep prefix KV on the replica that served its earlier ones.
+        def sessions(i: int) -> str:
+            s = i % pool_size
+            salt = random.Random(f"{seed}:session:{s}").getrandbits(64)
+            ctx = " ".join(f"ctx{s}-{k % 89}" for k in range(160))
+            return (
+                f"[session {s:03d} {salt:016x}] {base}{pad} {ctx} "
+                f"### turn {i // pool_size}: question {i}"
+            )
+
+        return sessions
     raise ValueError(f"unknown prompt set {prompt_set!r}")
 
 

@@ -470,7 +470,12 @@ def register(parser: argparse.ArgumentParser) -> None:
                         help="Stop sequence (repeatable, up to 4)")
     parser.add_argument("--no-stream", action="store_true")
     parser.add_argument("--prompt-set", default="default",
-                        choices=["default", "repeat", "unique", "mixed"])
+                        choices=["default", "repeat", "unique", "mixed",
+                                 "sessions"],
+                        help="Prompt shape (loadgen/prompts.py); "
+                             "'sessions' = prefix-heavy multi-session "
+                             "traffic, the cache-aware fleet-routing "
+                             "workload (docs/FLEET.md)")
     parser.add_argument("--input-tokens", type=int, default=0)
     parser.add_argument("--seed", type=int, default=42)
     parser.add_argument("--sampling-seed", type=int, default=None,

@@ -27,6 +27,7 @@ EVENT_TYPES = (
     "hbm_watermark_high",
     "overload_shedding",
     "engine_fault",
+    "replica_down",
 )
 
 
@@ -130,6 +131,7 @@ class EventDetector:
         kv_thrash_rate: float = 4.0,
         kv_thrash_samples: int = 3,
         hbm_high_fraction: float = 0.92,
+        replica_down_samples: int = 3,
     ) -> None:
         self.stall_samples = stall_samples
         self.prefill_stall_samples = prefill_stall_samples
@@ -144,6 +146,7 @@ class EventDetector:
         self.kv_thrash_rate = kv_thrash_rate
         self.kv_thrash_samples = kv_thrash_samples
         self.hbm_high_fraction = hbm_high_fraction
+        self.replica_down_samples = replica_down_samples
         self._fired: set[str] = set()
         self._t0: Optional[float] = None
         self._prev: Optional[dict[str, Any]] = None
@@ -154,6 +157,7 @@ class EventDetector:
         self._queue_run = 0
         self._burn_run = 0
         self._thrash_run = 0
+        self._replica_down_run = 0
         self._peak_throughput = 0.0
         self._peak_duty = 0.0
 
@@ -460,6 +464,32 @@ class EventDetector:
              **({"degrade_level": level} if level is not None else {})},
         )
 
+    def _check_replica_down(self, sample: dict[str, Any]) -> Optional[Event]:
+        """The fleet is running BELOW its desired replica count for N
+        consecutive samples (docs/FLEET.md): a replica died (or never
+        came up) and the supervisor hasn't healed it yet. Level-based
+        against the router's own desired gauge — unlike overload, a
+        missing replica is a fact, not a rate. Only the fleet router
+        exports the pair, so the rule is inert everywhere else."""
+        live = _runtime(sample, "fleet_replicas_live")
+        desired = _runtime(sample, "fleet_replicas_desired")
+        if live is None or desired is None:
+            return None
+        if live < desired:
+            self._replica_down_run += 1
+        else:
+            self._replica_down_run = 0
+        if self._replica_down_run >= self.replica_down_samples:
+            return Event(
+                sample["t"], "replica_down",
+                f"fleet at {live:g}/{desired:g} replicas for "
+                f"{self._replica_down_run} consecutive samples — a "
+                "replica is down and not yet healed",
+                {"replicas_live": live, "replicas_desired": desired,
+                 "samples": self._replica_down_run},
+            )
+        return None
+
     def _check_burn_rate(
         self, sample: dict[str, Any], burn: dict[str, float]
     ) -> Optional[Event]:
@@ -506,6 +536,7 @@ class EventDetector:
             ("hbm_watermark_high", self._check_hbm_watermark(sample)),
             ("overload_shedding", self._check_overload_shedding(sample)),
             ("engine_fault", self._check_engine_fault(sample)),
+            ("replica_down", self._check_replica_down(sample)),
         ]
         self._prev = sample
         fired: list[Event] = []

@@ -73,6 +73,13 @@ TIMELINE_RUNTIME_METRICS = (
     "kvmini_tpu_requests_shed_total",
     "kvmini_tpu_engine_faults_total",
     "kvmini_tpu_degrade_level",
+    # fleet rail (docs/FLEET.md): live-vs-desired replica counts feed
+    # the replica_down rule, and the reroute/shed counters attribute a
+    # latency cliff to failover churn vs plain overload
+    "kvmini_tpu_fleet_replicas_desired",
+    "kvmini_tpu_fleet_replicas_live",
+    "kvmini_tpu_fleet_reroutes_total",
+    "kvmini_tpu_fleet_sheds_total",
 )
 
 _PREFIX = "kvmini_tpu_"
@@ -101,6 +108,7 @@ class MonitorConfig:
     kv_thrash_rate: float = 4.0       # retained evictions/s (docs/MONITORING.md)
     kv_thrash_samples: int = 3
     hbm_high_fraction: float = 0.92   # of kvmini_tpu_hbm_bytes_limit
+    replica_down_samples: int = 3     # replica_down rule (docs/FLEET.md)
     abort_enabled: bool = False
     abort_on: frozenset[str] = DEFAULT_ABORT_ON
     budgets: dict[str, float] = field(default_factory=dict)
@@ -155,6 +163,7 @@ class RunMonitor:
             kv_thrash_rate=self.cfg.kv_thrash_rate,
             kv_thrash_samples=self.cfg.kv_thrash_samples,
             hbm_high_fraction=self.cfg.hbm_high_fraction,
+            replica_down_samples=self.cfg.replica_down_samples,
         )
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None

@@ -474,6 +474,59 @@ def _disagg_section(results: dict[str, Any]) -> str:
     return "".join(parts)
 
 
+def _fleet_section(results: dict[str, Any]) -> str:
+    """The "Serving fleet" section (docs/FLEET.md): replica counts,
+    placement mix, re-placements the clients never saw, fleet-level
+    sheds, self-healing restarts and scale-step cold starts. Rendered
+    only for runs that went through the fleet router — a single-server
+    run's report simply has no section."""
+    fl = results.get("fleet")
+    if not isinstance(fl, dict):
+        return ""
+    parts = ["<section><h2>Serving fleet</h2>"]
+    facts = [
+        f"{fl.get('replicas_live', 0):.0f}/{fl.get('replicas_desired', 0):.0f}"
+        " replicas live"
+    ]
+    if fl.get("placements"):
+        facts.append(f"{fl['placements']:.0f} placement(s)")
+    if fl.get("reroutes"):
+        facts.append(
+            f"{fl['reroutes']:.0f} re-placement(s) absorbed before any "
+            "client saw them"
+        )
+    if fl.get("sheds"):
+        facts.append(f"{fl['sheds']:.0f} fleet-level shed(s)")
+    if fl.get("stream_errors"):
+        facts.append(
+            f"{fl['stream_errors']:.0f} mid-stream replica loss(es) "
+            "surfaced as honest terminal events"
+        )
+    if fl.get("replica_restarts"):
+        facts.append(
+            f"{fl['replica_restarts']:.0f} replica(s) self-healed"
+        )
+    scale_steps = (fl.get("scale_ups") or 0) + (fl.get("scale_downs") or 0)
+    if scale_steps:
+        facts.append(
+            f"{fl.get('scale_ups', 0):.0f} scale-up(s) / "
+            f"{fl.get('scale_downs', 0):.0f} scale-down(s)"
+        )
+    if fl.get("last_cold_start_s"):
+        facts.append(
+            f"last scale-up cold start {fl['last_cold_start_s']:.2f} s"
+        )
+    parts.append(f"<p>{html_mod.escape(' · '.join(facts))}</p>")
+    for e in ((results.get("monitor") or {}).get("events") or []):
+        if isinstance(e, dict) and e.get("type") == "replica_down":
+            parts.append(
+                f"<p>event @{e.get('t', 0):.0f}: <b>replica_down</b> — "
+                f"{html_mod.escape(str(e.get('detail', '')))}</p>"
+            )
+    parts.append("</section>")
+    return "".join(parts)
+
+
 def generate_single_run_html(
     results: dict[str, Any], run_dir: Optional[Path] = None
 ) -> str:
@@ -602,6 +655,7 @@ def generate_single_run_html(
         timeline_samples = RunDir(run_dir).read_timeline()
     sections.append(_kv_cache_section(results, run_dir, timeline_samples))
     sections.append(_disagg_section(results))
+    sections.append(_fleet_section(results))
     sections.append(_resilience_section(results))
     sections.append(_timeline_section(run_dir, results, timeline_samples))
     sections.append(_trace_viewer(run_dir, results))

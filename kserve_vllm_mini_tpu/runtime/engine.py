@@ -3784,6 +3784,11 @@ class Engine:
             s["engine_faults"] = self._engine_faults
             s["degrade_level"] = self._degrade_level
         s["faults_armed"] = self._faults.armed_count()
+        # fleet-router placement input (docs/FLEET.md): the same
+        # admission burn-rate estimate the deadline shed gate compares
+        # deadlines against, exported so a router can score replicas
+        # from one /metrics scrape (estimate_wait_s locks internally)
+        s["estimated_wait_s"] = self.estimate_wait_s()
         # compile-stats totals (docs/PROFILING.md): the recorder is
         # internally locked, so this read is consistent by construction
         cs = self._compile_recorder.snapshot()

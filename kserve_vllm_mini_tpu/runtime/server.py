@@ -1381,6 +1381,12 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
             f"kvmini_tpu_degrade_level {s['degrade_level']}",
             "# TYPE kvmini_tpu_faults_armed gauge",
             f"kvmini_tpu_faults_armed {s['faults_armed']}",
+            # fleet-router placement input (docs/FLEET.md): seconds a
+            # request submitted NOW would take to complete at this
+            # replica — the deadline-shed estimate promoted to a scraped
+            # signal so a fleet router can score replicas by load
+            "# TYPE kvmini_tpu_estimated_wait_seconds gauge",
+            f"kvmini_tpu_estimated_wait_seconds {s['estimated_wait_s']:.6f}",
             # KV-cache lifecycle + prefix-cache attribution (docs/
             # TROUBLESHOOTING.md "HBM pressure & KV thrash"): allocator
             # churn counters the point-in-time pool gauges cannot show,

@@ -74,6 +74,7 @@ def make_app(
     capabilities: set[str] | None = None,
     pipeline_metrics: dict[str, float] | None = None,
     metrics_script=None,
+    server_id: str | None = None,
 ) -> web.Application:
     """``capabilities`` toggles OpenAI-dialect extras for parity-probe tests:
     any subset of {"tools", "parallel_tools", "json_mode", "logprobs",
@@ -85,7 +86,14 @@ def make_app(
 
     ``metrics_script``: elapsed-seconds -> {metric: value} overrides
     merged over the static values per scrape (see scripted_metrics), so
-    monitor event detection is testable without a device."""
+    monitor event detection is testable without a device.
+
+    ``server_id`` names this instance (multi-instance fleets,
+    docs/FLEET.md): responses carry it in ``system_fingerprint`` and an
+    ``x-kvmini-mock-replica`` header so router-placement tests can see
+    WHICH replica served without parsing logs; per-instance
+    ``pipeline_metrics``/``metrics_script`` give each port its own
+    scripted /metrics."""
     stats = MockStats()
     caps = capabilities if capabilities is not None else {
         "tools", "parallel_tools", "json_mode", "logprobs",
@@ -274,6 +282,7 @@ def make_app(
             return web.json_response(
                 {
                     "id": "mock",
+                    "system_fingerprint": server_id or "mock",
                     "choices": [
                         {"index": i,
                          "message": {"role": "assistant", "content": "".join(words)}}
@@ -285,11 +294,14 @@ def make_app(
                         "total_tokens": 5 + max_toks,
                     },
                     "metrics": {"server_ttft_ms": token_delay_s * 1000.0},
-                }
+                },
+                headers={"x-kvmini-mock-replica": server_id or "mock"},
             )
         stats.streamed += 1
         resp = web.StreamResponse(
-            status=200, headers={"Content-Type": "text/event-stream"}
+            status=200,
+            headers={"Content-Type": "text/event-stream",
+                     "x-kvmini-mock-replica": server_id or "mock"},
         )
         await resp.prepare(request)
         cut_spec = _fault("sse_disconnect")
@@ -378,6 +390,10 @@ def make_app(
         "kvmini_tpu_hbm_peak_bytes": 10e9,
         "kvmini_tpu_hbm_bytes_limit": 16e9,
         "kvmini_tpu_hbm_headroom_estimate_bytes": 12e9,
+        # fleet-router placement input (docs/FLEET.md): per-instance
+        # overrides let multi-instance tests give each replica a
+        # distinct load picture
+        "kvmini_tpu_estimated_wait_seconds": 0.0,
         **(pipeline_metrics or {}),
     }
     t_app_start = time.time()
@@ -438,7 +454,8 @@ def make_app(
                                   "armed": {"name": name, **faults[name]}})
 
     async def healthz(_request: web.Request) -> web.Response:
-        return web.json_response({"status": "ok"})
+        return web.json_response({"status": "ok",
+                                  "server_id": server_id or "mock"})
 
     app = web.Application()
     app.router.add_post("/v1/chat/completions", chat)
@@ -470,3 +487,70 @@ class MockServer:
     async def __aexit__(self, *exc) -> None:
         if self.runner:
             await self.runner.cleanup()
+
+
+class MockFleet:
+    """N in-process mock endpoints with DISTINCT scripted metrics per
+    port (docs/FLEET.md) — router placement and failover are testable
+    with no JAX engine. ``specs`` is one make_app kwargs dict per
+    replica; each gets ``server_id`` "r<i>" unless the spec names one.
+
+    async with MockFleet([{"pipeline_metrics": {...}}, {...}]) as fleet:
+        fleet.urls        # ["http://127.0.0.1:p0", ...]
+        fleet.replicas()  # [("r0", url0), ...] — FleetRouter's shape
+    """
+
+    def __init__(self, specs: list[dict]):
+        self.servers = [
+            MockServer(**{"server_id": f"r{i}", **spec})
+            for i, spec in enumerate(specs)
+        ]
+        self.ids = [
+            spec.get("server_id", f"r{i}") for i, spec in enumerate(specs)
+        ]
+        self.urls: list[str] = []
+
+    async def __aenter__(self) -> "MockFleet":
+        for s in self.servers:
+            await s.__aenter__()
+        self.urls = [s.url for s in self.servers]
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        for s in self.servers:
+            await s.__aexit__(*exc)
+
+    def replicas(self) -> list[tuple[str, str]]:
+        return list(zip(self.ids, self.urls))
+
+
+def main(argv: list[str] | None = None) -> int:
+    """``python -m tests.mock_server``: one mock endpoint as a real OS
+    process — what the fleet supervisor spawns for JAX-free fleet tests
+    (kill-able, wedge-able via POST /faults, per-instance metrics via
+    --metrics-json)."""
+    import argparse
+
+    parser = argparse.ArgumentParser(prog="tests.mock_server")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, required=True)
+    parser.add_argument("--server-id", default=None)
+    parser.add_argument("--token-delay", type=float, default=0.002)
+    parser.add_argument("--n-tokens", type=int, default=8)
+    parser.add_argument("--metrics-json", default=None,
+                        help="JSON dict merged over the default /metrics "
+                             "gauges (distinct per instance)")
+    args = parser.parse_args(argv)
+    overrides = json.loads(args.metrics_json) if args.metrics_json else None
+    app = make_app(
+        token_delay_s=args.token_delay,
+        n_tokens=args.n_tokens,
+        pipeline_metrics=overrides,
+        server_id=args.server_id,
+    )
+    web.run_app(app, host=args.host, port=args.port, print=None)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

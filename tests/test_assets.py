@@ -117,7 +117,7 @@ def test_chart_template_covers_multihost_and_quant():
 
 def test_dashboards_valid_and_tpu_native():
     files = sorted((REPO / "dashboards").glob("*.json"))
-    assert len(files) == 7
+    assert len(files) == 8
     uids = set()
     for f in files:
         d = json.loads(f.read_text())
@@ -130,7 +130,7 @@ def test_dashboards_valid_and_tpu_native():
         assert "DCGM" not in text and "nvidia" not in text.lower(), (
             f"{f.name} references GPU metrics"
         )
-    assert len(uids) == 7  # unique dashboard uids
+    assert len(uids) == 8  # unique dashboard uids
 
 
 def test_run_timeline_dashboard_uses_windowed_duty():
@@ -184,6 +184,26 @@ def test_kv_cache_dashboard_queries_kv_and_hbm_metrics():
     assert "kvmini_tpu_kv_handoff_queue_depth" in d
     assert "rate(kvmini_tpu_prefill_lane_busy_seconds_total" in d
     assert "rate(kvmini_tpu_kv_handoff_wait_seconds_total" in d
+
+
+def test_fleet_dashboard_queries_replica_labeled_series():
+    """The fleet board (docs/FLEET.md) must query the series the router
+    actually aggregates — per-replica views come from the router's
+    replica-labeled passthrough (`by (replica)`), replica counts from
+    the fleet gauges, failover from the reroute/restart counters (RATE
+    signals), placement mix by reason, and the scale-up cold-start
+    gauge the local actuator's adds are measured by."""
+    d = (REPO / "dashboards" / "fleet.json").read_text()
+    assert "by (replica) (rate(kvmini_tpu_decode_tokens_total" in d
+    assert "by (replica) (kvmini_tpu_queue_depth" in d
+    assert "kvmini_tpu_estimated_wait_seconds" in d
+    assert "kvmini_tpu_fleet_replicas_live" in d
+    assert "kvmini_tpu_fleet_replicas_desired" in d
+    assert "rate(kvmini_tpu_fleet_reroutes_total" in d
+    assert "rate(kvmini_tpu_fleet_replica_restarts_total" in d
+    assert "rate(kvmini_tpu_fleet_sheds_total" in d
+    assert "kvmini_tpu_fleet_last_cold_start_seconds" in d
+    assert "by (reason) (rate(kvmini_tpu_fleet_placements_total" in d
 
 
 def test_utilization_dashboard_queries_tpu_metrics():

@@ -129,6 +129,13 @@ def test_local_chaos_matrix_end_to_end(tmp_path):
     # happened)
     assert rows["publish-drop"]["injected"] is False
     assert rows["publish-drop"]["gate_ok"] is None
+    # replica-level scenarios need a fleet router target with survivors
+    # (docs/FLEET.md): against a single server they stay honestly
+    # uninjected — the same pattern (tests/test_fleet.py drives the
+    # injected=True side against a live fleet)
+    for fault in ("replica-kill", "replica-wedge"):
+        assert rows[fault]["injected"] is False, fault
+        assert rows[fault]["gate_ok"] is None, fault
     assert table["all_recovered"] is True
     # on-disk artifact round-trips
     on_disk = json.loads((tmp_path / "resilience_table.json").read_text())
