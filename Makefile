@@ -2,7 +2,7 @@
 # of native code — the TPU compute path is JAX/XLA compiled at runtime.
 PY ?= python
 
-.PHONY: help test test-fast test-policy lint lint-invariants lint-changed fmt smoke bench bench-smoke bench-proxy-smoke chaos-smoke fleet-smoke trajectory dashboards-validate helm-lint airgap clean
+.PHONY: help test test-fast test-policy lint lint-invariants lint-changed fmt smoke bench bench-smoke bench-proxy-smoke chaos-smoke fleet-smoke kv-economy-smoke trajectory dashboards-validate helm-lint airgap clean
 
 help:
 	@grep -E '^[a-z-]+:' Makefile | sed 's/:.*//' | sort | uniq
@@ -99,6 +99,15 @@ chaos-smoke:  ## local-mode chaos matrix vs the mock server, no TPU, no cluster
 # resilience-table replica rows, all with no engine and no cluster.
 fleet-smoke:  ## fleet router/supervisor/actuator vs mock replicas, no TPU
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_fleet.py -q -m "not slow"
+
+# the KV-block economy acceptance gate (docs/DISAGGREGATION.md v2,
+# docs/FLEET.md warm-from-sibling, docs/TROUBLESHOOTING.md host tier):
+# a mock-server fleet respawn warms the new replica from its
+# deepest-owning sibling (/kv/export -> /kv/import) and the hit-depth
+# gauge recovers in the first scrape window, with schema-valid Results
+# blocks for the handoff/tier counters — no engine, no TPU.
+kv-economy-smoke:  ## zero-copy handoff + prefix migration + host tier, no TPU
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_kv_economy.py -q -m "not slow"
 
 # the never-dark acceptance gate (docs/PROFILING.md): with no TPU,
 # `python bench.py` must exit 0 with a schema-valid `proxy` block

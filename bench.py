@@ -143,6 +143,46 @@ def _env_fleet():
     return n
 
 
+def _env_kv_tier():
+    """Host-RAM KV tier byte cap for the fleet row's paged replicas
+    (--kv-host-tier-bytes; docs/TROUBLESHOOTING.md "Host-RAM KV tier
+    thrash"), or 0 (off). Loud validation at the knob: a garbled value
+    must not silently bench the tierless path under a tier label."""
+    raw = _knob("KVMINI_BENCH_KV_TIER")
+    if not raw or raw in ("0", "false"):
+        return 0
+    try:
+        n = int(raw)
+    except ValueError:
+        raise SystemExit(
+            f"KVMINI_BENCH_KV_TIER={raw!r}: must be a host-RAM byte cap "
+            "(empty/0 disables the tier)"
+        ) from None
+    if n < 0:
+        raise SystemExit(
+            f"KVMINI_BENCH_KV_TIER={n}: byte cap cannot be negative"
+        )
+    return n
+
+
+def _env_migrate():
+    """Whether the fleet row exercises warm-from-sibling prefix
+    migration after a replica kill (docs/FLEET.md). Loud validation at
+    the knob: a garbled value must not silently report a cold respawn
+    under a migrate label."""
+    raw = _knob("KVMINI_BENCH_MIGRATE")
+    if not raw:
+        return False
+    if raw not in ("0", "1", "true", "false"):
+        raise SystemExit(
+            f"KVMINI_BENCH_MIGRATE={raw!r}: must be '1'/'true' (kill a "
+            "replica and warm the respawn from its deepest-owning "
+            "sibling) or '0'/'false'/empty (off); requires "
+            "KVMINI_BENCH_FLEET >= 2"
+        )
+    return raw in ("1", "true")
+
+
 def _env_prefill_chunk():
     """Tokens per interleaved prefill chunk, or None (monolithic). Loud
     validation at the knob: a garbled value must not silently bench the
@@ -165,12 +205,17 @@ def _env_prefill_chunk():
     return chunk
 
 
-def _run_fleet_row(n_replicas: int) -> dict:
+def _run_fleet_row(n_replicas: int, kv_tier_bytes: int = 0,
+                   migrate: bool = False) -> dict:
     """The {mode}.fleet sub-measurement (docs/FLEET.md): spawn
     ``n_replicas`` CPU-forced llama-tiny serve replicas under the fleet
     supervisor, front them with the cache-aware router, and drive a
     small prefix-heavy multi-session burst through it. Reports fleet
-    mechanics only — cold starts, routed p50, placement/reroute mix."""
+    mechanics only — cold starts, routed p50, placement/reroute mix.
+    ``kv_tier_bytes``/``migrate`` flip the replicas to the paged layout
+    to exercise the host-RAM tier and warm-from-sibling prefix
+    migration (a replica kill whose respawn imports the deepest-owning
+    sibling's retained prefix blocks)."""
     import urllib.request
 
     from kserve_vllm_mini_tpu.fleet.router import (
@@ -184,16 +229,23 @@ def _run_fleet_row(n_replicas: int) -> dict:
     )
     from kserve_vllm_mini_tpu.loadgen.prompts import make_prompt_fn
 
+    extra_args = ["--max-slots", "4", "--max-seq-len", "512",
+                  "--prefix-cache"]
+    if kv_tier_bytes or migrate:
+        # tier and /kv/export|import are paged-pool surfaces
+        extra_args += ["--kv-layout", "paged"]
+    if kv_tier_bytes:
+        extra_args += ["--kv-host-tier-bytes", str(kv_tier_bytes)]
     sup = FleetSupervisor(
         replica_cmd=serve_replica_cmd(
             model="llama-tiny",
-            extra_args=["--max-slots", "4", "--max-seq-len", "512",
-                        "--prefix-cache"],
+            extra_args=extra_args,
             # the fleet row must NEVER claim the accelerator the serving
             # child is benching — replicas run on CPU by construction
             env_overrides={"JAX_PLATFORMS": "cpu"},
         ),
         ready_timeout_s=300.0,
+        warm_from_siblings=migrate,
     )
     handle = None
     try:
@@ -203,6 +255,10 @@ def _run_fleet_row(n_replicas: int) -> dict:
         router = FleetRouter(supervisor=sup,
                              cfg=RouterConfig(scrape_interval_s=0.25))
         handle = start_router(router)
+        if migrate:
+            # owners come straight off the in-process prefix index —
+            # no router-URL round trip needed when the router is local
+            sup._owners_fn = router._prefix.owners
         prompt_fn = make_prompt_fn("sessions", pool_size=4)
         lat_ms = []
         for i in range(16):
@@ -219,6 +275,19 @@ def _run_fleet_row(n_replicas: int) -> dict:
             with urllib.request.urlopen(req, timeout=120) as r:
                 r.read()
             lat_ms.append((time.time() - t1) * 1000.0)
+        warm_row = None
+        if migrate:
+            victim = sup.replicas()[0]["rid"]
+            sup.kill_replica(victim)
+            deadline = time.time() + 300.0
+            while time.time() < deadline:
+                c = sup.counters()
+                if c["warmed"] + c["warm_failures"] > 0:
+                    break
+                time.sleep(0.25)
+            c = sup.counters()
+            warm_row = {"warmed": c["warmed"],
+                        "warm_failures": c["warm_failures"]}
         counters = sup.counters()
         colds = sorted(counters["cold_starts_s"])
         return {
@@ -232,6 +301,9 @@ def _run_fleet_row(n_replicas: int) -> dict:
             "placements": dict(router.placements),
             "reroutes": router.reroutes,
             "sheds": router.sheds,
+            **({"kv_host_tier_bytes": kv_tier_bytes} if kv_tier_bytes
+               else {}),
+            **({"migration": warm_row} if warm_row is not None else {}),
             "series": "fleet-mechanics-cpu",  # never a TPU throughput claim
         }
     finally:
@@ -685,8 +757,23 @@ def _run_serving_child(mode: str) -> dict:
     # replicas deliberately pin JAX_PLATFORMS=cpu so the accelerator
     # under test stays exclusively the engine above).
     n_fleet = _env_fleet()
+    kv_tier = _env_kv_tier()
+    migrate = _env_migrate()
+    if migrate and not n_fleet:
+        raise SystemExit(
+            "KVMINI_BENCH_MIGRATE=1 needs KVMINI_BENCH_FLEET >= 2 — "
+            "warm-from-sibling migration is a fleet surface (a donor "
+            "sibling must exist)"
+        )
+    if kv_tier and not n_fleet:
+        raise SystemExit(
+            "KVMINI_BENCH_KV_TIER is wired through the fleet row's "
+            "paged replicas — set KVMINI_BENCH_FLEET >= 2 too, or unset "
+            "it (a silently-ignored tier knob would mislabel the run)"
+        )
     if n_fleet:
-        row = _run_fleet_row(n_fleet)
+        row = _run_fleet_row(n_fleet, kv_tier_bytes=kv_tier,
+                             migrate=migrate)
         _progress(f"{mode}.fleet", row)
         _log(f"fleet row ({n_fleet} replicas): {row}")
 
@@ -1756,6 +1843,23 @@ _ENV_KNOBS = {
         "mix. Fleet MECHANICS only (replicas pin JAX_PLATFORMS=cpu so "
         "they never contend for the TPU under test) — the row makes no "
         "accelerator throughput claims; empty/0 = off",
+    ),
+    "KVMINI_BENCH_KV_TIER": (
+        "--kv-tier", "",
+        "host-RAM KV tier byte cap for the fleet row's paged replicas "
+        "(serve --kv-host-tier-bytes; docs/TROUBLESHOOTING.md 'Host-RAM "
+        "KV tier thrash'): retained-LRU evictions demote to host RAM "
+        "and promote back on prefix match instead of re-prefilling. "
+        "Requires KVMINI_BENCH_FLEET >= 2; empty/0 = no tier",
+    ),
+    "KVMINI_BENCH_MIGRATE": (
+        "--migrate", "",
+        "'1' adds a warm-from-sibling migration leg to the fleet row "
+        "(docs/FLEET.md): after the routed burst, one replica is killed "
+        "and its respawn imports the deepest-owning sibling's retained "
+        "prefix blocks (/kv/export -> /kv/import); the row reports the "
+        "supervisor's warmed/warm_failures counters. Requires "
+        "KVMINI_BENCH_FLEET >= 2; empty = cold respawn",
     ),
     "KVMINI_BENCH_UNROLL": (
         "--unroll", "1",

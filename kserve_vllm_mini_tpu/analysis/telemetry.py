@@ -416,6 +416,16 @@ KV_METRIC_KEYS = {
     "fragmentation": "kvmini_tpu_kv_fragmentation",
     "logical_bytes": "kvmini_tpu_kv_logical_bytes",
     "physical_bytes": "kvmini_tpu_kv_physical_bytes",
+    "tier_demotions": "kvmini_tpu_kv_tier_demotions_total",
+    "tier_promotions": "kvmini_tpu_kv_tier_promotions_total",
+    "tier_hits": "kvmini_tpu_kv_tier_hits_total",
+    "tier_blocks": "kvmini_tpu_kv_tier_blocks",
+    "tier_bytes": "kvmini_tpu_kv_tier_bytes",
+    "tier_capacity_bytes": "kvmini_tpu_kv_tier_capacity_bytes",
+    "tier_disabled": "kvmini_tpu_kv_tier_disabled",
+    "migrated_blocks": "kvmini_tpu_kv_migrated_blocks_total",
+    "migrated_bytes": "kvmini_tpu_kv_migrated_bytes_total",
+    "export_blocks": "kvmini_tpu_kv_export_blocks_total",
     "hbm_bytes_in_use": "kvmini_tpu_hbm_bytes_in_use",
     "hbm_peak_bytes": "kvmini_tpu_hbm_peak_bytes",
     "hbm_bytes_limit": "kvmini_tpu_hbm_bytes_limit",
@@ -510,6 +520,7 @@ DISAGG_METRIC_KEYS = {
     "handoff_blocks": "kvmini_tpu_kv_handoff_blocks_total",
     "handoff_wait_s": "kvmini_tpu_kv_handoff_wait_seconds_total",
     "handoff_drops": "kvmini_tpu_kv_handoff_drops_total",
+    "handoff_bytes_copied": "kvmini_tpu_kv_handoff_bytes_copied_total",
     "lane_busy_s": "kvmini_tpu_prefill_lane_busy_seconds_total",
     "colocated_fallbacks": "kvmini_tpu_disagg_colocated_fallbacks_total",
     "queue_depth": "kvmini_tpu_kv_handoff_queue_depth",

@@ -182,7 +182,8 @@ class Results:
     resilience: Optional[dict[str, Any]] = None
     # disaggregated-serving block (docs/DISAGGREGATION.md): the prefill-
     # lane handoff rail — {handoffs, handoff_blocks, handoff_wait_s,
-    # handoff_drops, lane_busy_s, colocated_fallbacks, queue_depth,
+    # handoff_drops, handoff_bytes_copied, lane_busy_s,
+    # colocated_fallbacks, queue_depth,
     # degraded, source} — snapshotted directly in self-serve runs or
     # scraped from /metrics (analysis/telemetry.py DISAGG_METRIC_KEYS);
     # absent for colocated engines, external engines, and runs with zero
@@ -545,10 +546,21 @@ KV_CACHE_JSON_SCHEMA: dict[str, Any] = {
         "hbm_peak_bytes": {"type": "number", "minimum": 0},
         "hbm_bytes_limit": {"type": "number", "minimum": 0},
         "headroom_estimate_bytes": {"type": "number", "minimum": 0},
+        "tier_demotions": {"type": "number", "minimum": 0},
+        "tier_promotions": {"type": "number", "minimum": 0},
+        "tier_hits": {"type": "number", "minimum": 0},
+        "tier_blocks": {"type": "number", "minimum": 0},
+        "tier_bytes": {"type": "number", "minimum": 0},
+        "tier_capacity_bytes": {"type": "number", "minimum": 0},
+        "tier_disabled": {"type": "number", "minimum": 0, "maximum": 1},
+        "migrated_blocks": {"type": "number", "minimum": 0},
+        "migrated_bytes": {"type": "number", "minimum": 0},
+        "export_blocks": {"type": "number", "minimum": 0},
     },
 }
 
-_KV_FRACTIONS = ("occupancy", "retained_fraction", "fragmentation")
+_KV_FRACTIONS = ("occupancy", "retained_fraction", "fragmentation",
+                 "tier_disabled")
 
 
 def validate_kv_cache(doc: Any) -> list[str]:

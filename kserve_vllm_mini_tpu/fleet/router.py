@@ -110,6 +110,11 @@ class PrefixIndex:
         self.chunk_chars = max(int(chunk_chars), 1)
         self.max_entries = max(int(max_entries), 1)
         self._map: OrderedDict[int, str] = OrderedDict()
+        # entry-hash -> chain depth (1-based chunk index): lets owners()
+        # rank replicas by DEEPEST owned prefix without re-hashing any
+        # prompt — the donor-selection input for cross-replica KV
+        # migration (fleet/supervisor._warm_replica)
+        self._depth: dict[int, int] = {}
 
     def _chain(self, prompt: str) -> list[int]:
         out: list[int] = []
@@ -123,14 +128,16 @@ class PrefixIndex:
         return out
 
     def record(self, prompt: str, rid: str) -> None:
-        for h in self._chain(prompt):
+        for depth, h in enumerate(self._chain(prompt), start=1):
             if h in self._map:
                 self._map.move_to_end(h)
             # every access (record/best/len) runs on the router's ONE
             # event loop; there is no second thread
             self._map[h] = rid
+            self._depth[h] = depth
         while len(self._map) > self.max_entries:
-            self._map.popitem(last=False)
+            old, _ = self._map.popitem(last=False)
+            self._depth.pop(old, None)
 
     def best(self, prompt: str) -> dict[str, int]:
         """replica id -> matched prefix CHARS (deepest owned depth)."""
@@ -141,12 +148,26 @@ class PrefixIndex:
                 out[rid] = depth * self.chunk_chars
         return out
 
+    def owners(self) -> dict[str, int]:
+        """replica id -> deepest owned prefix in CHARS. The donor
+        ranking for cross-replica KV migration: the supervisor warms a
+        respawned replica from the deepest-owning HEALTHY sibling
+        (health is the supervisor's call — the index only knows
+        ownership)."""
+        out: dict[str, int] = {}
+        for h, rid in self._map.items():
+            chars = self._depth.get(h, 1) * self.chunk_chars
+            if chars > out.get(rid, 0):
+                out[rid] = chars
+        return out
+
     def purge(self, rid: str) -> None:
         """Forget a replica's affinity — called when it dies: a
         watchdog respawn reuses the rid with a COLD cache, and stale
         chains would route 'prefix'-scored traffic at an empty cache."""
         for h in [h for h, r in self._map.items() if r == rid]:
             del self._map[h]  # kvmini: thread-ok — same loop (see record)
+            self._depth.pop(h, None)
 
     def __len__(self) -> int:
         return len(self._map)
@@ -626,6 +647,10 @@ class FleetRouter:
                 "sheds": self.sheds,
                 "stream_errors": self.stream_errors,
                 "prefix_index_entries": len(self._prefix),
+                # deepest owned prefix chars per replica — the donor
+                # ranking cross-replica KV migration reads
+                # (fleet/supervisor._warm_replica)
+                "kv_owners": self._prefix.owners(),
             })
 
         async def fleet_scale(request: "web.Request"):

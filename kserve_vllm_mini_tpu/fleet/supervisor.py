@@ -37,6 +37,36 @@ READY = "ready"
 DEAD = "dead"
 REMOVED = "removed"
 
+# default byte budget one warm-from-sibling transfer may ship: enough
+# for a few hundred small blocks of int8 KV, small enough that a respawn
+# storm can't saturate the host loopback
+WARM_BUDGET_BYTES = 16 * 1024 * 1024
+
+
+def select_donor(
+    owners: dict[str, int],
+    candidates: list[tuple[str, str, bool]],
+    exclude: str,
+) -> Optional[tuple[str, str]]:
+    """Pick the KV-migration donor for a cold replica: the DEEPEST-
+    owning healthy sibling (``owners`` is the router's
+    ``PrefixIndex.owners()`` map, rid -> deepest owned prefix chars).
+    ``candidates`` are ``(rid, url, healthy)``; the target itself is
+    excluded, unhealthy replicas never donate, and a replica with no
+    owned prefix (depth 0 — cold itself, e.g. JUST respawned and purged
+    from the index) never donates either: migrating from a cold cache
+    would ship nothing and waste the respawn window. Returns
+    ``(rid, url)`` or None (cold spawn)."""
+    best: Optional[tuple[str, str]] = None
+    best_depth = 0
+    for rid, url, healthy in candidates:
+        if rid == exclude or not healthy:
+            continue
+        depth = int(owners.get(rid, 0))
+        if depth > best_depth:
+            best, best_depth = (rid, url), depth
+    return best
+
 
 def free_port(host: str = "127.0.0.1") -> int:
     """An OS-assigned free TCP port (bind-0 probe; the tiny window
@@ -117,6 +147,10 @@ class FleetSupervisor:
         restart_dead: bool = True,
         max_replicas: int = 8,
         poll_interval_s: float = 0.25,
+        warm_from_siblings: bool = False,
+        router_url: Optional[str] = None,
+        warm_budget_bytes: int = WARM_BUDGET_BYTES,
+        owners_fn: Optional[Callable[[], dict[str, int]]] = None,
     ) -> None:
         self.replica_cmd = replica_cmd or serve_replica_cmd()
         self.host = host
@@ -125,6 +159,21 @@ class FleetSupervisor:
         self.restart_dead = restart_dead
         self.max_replicas = max_replicas
         self.poll_interval_s = poll_interval_s
+        # cross-replica KV migration (docs/FLEET.md): when armed, every
+        # respawn/scale-up warms the fresh replica from the deepest-
+        # owning healthy sibling via POST /kv/export -> /kv/import.
+        # ``owners_fn`` overrides the router scrape (tests / embedded
+        # routers); otherwise the ranking comes from GET
+        # ``router_url``/fleet -> "kv_owners". STRICTLY best-effort: any
+        # failure (donor died mid-export, router down, dense replicas)
+        # counts warm_failures and the replica simply starts cold — the
+        # watchdog must never wedge on a warmup.
+        self.warm_from_siblings = warm_from_siblings
+        self.router_url = router_url.rstrip("/") if router_url else None
+        self.warm_budget_bytes = int(warm_budget_bytes)
+        self._owners_fn = owners_fn
+        self._warmed = 0
+        self._warm_failures = 0
         # one lock for the whole table: watchdog/actuator/router threads
         # all touch it (docs/FLEET.md thread contract)
         self._lock = threading.Lock()
@@ -221,6 +270,7 @@ class FleetSupervisor:
                 with self._lock:
                     self._desired -= 1
                 raise
+            self._warm_replica(rep)
         return rep
 
     def _live(self) -> list[Replica]:
@@ -229,6 +279,12 @@ class FleetSupervisor:
                 if r.state in (STARTING, READY)]
 
     def _reap(self, rep: Replica, deliberate: bool) -> None:
+        if deliberate:
+            # mark BEFORE the kill: a watchdog tick landing between the
+            # signal and a late state write would read the death as
+            # organic and resurrect a deliberate scale-down
+            with self._lock:
+                rep.state = REMOVED
         proc = rep.proc
         if proc is not None and proc.poll() is None:
             try:
@@ -354,6 +410,73 @@ class FleetSupervisor:
             self._restarts_total += 1
         self._spawn(rep)
         self._wait_ready(rep)
+        self._warm_replica(rep)
+
+    # -- cross-replica KV migration (docs/FLEET.md) ------------------------
+
+    def _post_json(self, url: str, body: dict[str, Any],
+                   timeout_s: float = 30.0) -> dict[str, Any]:
+        req = urllib.request.Request(
+            url, data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=timeout_s) as r:
+            return json.loads(r.read().decode())
+
+    def _owners(self) -> dict[str, int]:
+        if self._owners_fn is not None:
+            return dict(self._owners_fn() or {})
+        if not self.router_url:
+            return {}
+        with urllib.request.urlopen(
+            self.router_url + "/fleet", timeout=5.0
+        ) as r:
+            doc = json.loads(r.read().decode())
+        return dict(doc.get("kv_owners") or {})
+
+    def _warm_replica(self, rep: Replica) -> bool:
+        """Warm a freshly-(re)spawned replica's prefix cache from the
+        deepest-owning healthy sibling: GET the router's donor ranking,
+        POST the donor's /kv/export (bounded byte budget), POST the
+        payload into the target's /kv/import. Pure HTTP, pure
+        best-effort: every failure path logs, counts warm_failures, and
+        returns False — a dead donor mid-export degrades to a cold spawn
+        without wedging the watchdog."""
+        if not self.warm_from_siblings:
+            return False
+        try:
+            owners = self._owners()
+            with self._lock:
+                candidates = [
+                    (r.rid, r.url, r.state == READY
+                     and r.proc is not None and r.proc.poll() is None)
+                    for r in self._replicas.values()
+                ]
+            donor = select_donor(owners, candidates, exclude=rep.rid)
+            if donor is None:
+                return False
+            payload = self._post_json(
+                donor[1] + "/kv/export",
+                {"budget_bytes": self.warm_budget_bytes},
+            )
+            if not payload.get("blocks"):
+                return False
+            res = self._post_json(rep.url + "/kv/import", payload)
+            with self._lock:
+                self._warmed += 1
+            print(
+                f"fleet: warmed {rep.rid} from {donor[0]}: "
+                f"{res.get('imported', 0)} blocks, "
+                f"{res.get('bytes', 0)} bytes", file=sys.stderr,
+            )
+            return True
+        except Exception as e:  # noqa: BLE001 — warmup must never wedge
+            # the watchdog or fail a respawn; cold spawn is the fallback
+            with self._lock:
+                self._warm_failures += 1
+            print(f"fleet: warm of {rep.rid} failed (cold spawn): {e}",
+                  file=sys.stderr)
+            return False
 
     # -- introspection -----------------------------------------------------
 
@@ -382,6 +505,8 @@ class FleetSupervisor:
                     self._cold_starts[-1] if self._cold_starts else None
                 ),
                 "cold_starts_s": list(self._cold_starts),
+                "warmed": self._warmed,
+                "warm_failures": self._warm_failures,
             }
 
     def stop(self) -> None:

@@ -65,6 +65,9 @@ TIMELINE_RUNTIME_METRICS = (
     # all of them ride into the report's KV/memory timeline lanes
     "kvmini_tpu_kv_occupancy",
     "kvmini_tpu_kv_retained_evictions_total",
+    # host-RAM tier demotions ride beside eviction churn so the report's
+    # churn lane can split recoverable demotions from true discards
+    "kvmini_tpu_kv_tier_demotions_total",
     "kvmini_tpu_hbm_bytes_in_use",
     "kvmini_tpu_hbm_bytes_limit",
     # resilience rail (docs/RESILIENCE.md): admission sheds feed the

@@ -122,6 +122,32 @@ def kv_elem_bytes(head_dim: int, itemsize: float, quantized: bool = False) -> fl
     return (1.0 + 4.0 / head_dim) if quantized else float(itemsize)
 
 
+def host_tier_block_bytes(cfg: Any, block_size: int,
+                          kv_quant: bool = False) -> int:
+    """Host-RAM bytes ONE demoted KV block occupies in the host tier
+    (Engine._tier, EngineConfig.kv_host_tier_bytes) — the same
+    kv_elem_bytes price the HBM estimate uses, applied to HOST memory.
+    Deliberately a separate function from estimate_serving_bytes: the
+    tier lives in host RAM and must NEVER inflate the HBM admission
+    estimate (pinned in tests) — it only bounds how many evicted blocks
+    the tier's byte budget can catch."""
+    elem = kv_elem_bytes(cfg.head_dim, cfg.jnp_dtype.itemsize, kv_quant)
+    return int(2 * cfg.n_layers * cfg.n_kv_heads * block_size
+               * cfg.head_dim * elem)
+
+
+def host_tier_capacity_blocks(cap_bytes: Optional[int], cfg: Any,
+                              block_size: int,
+                              kv_quant: bool = False) -> int:
+    """How many demoted blocks a kv_host_tier_bytes budget can hold —
+    the analytic sizing companion operators use to pick the knob (0 when
+    the tier is off or the budget is under one block)."""
+    if not cap_bytes:
+        return 0
+    per = host_tier_block_bytes(cfg, block_size, kv_quant)
+    return max(int(cap_bytes) // per, 0) if per > 0 else 0
+
+
 def _weight_bytes_per_param(quant: str) -> float:
     # int8: 1 byte + per-channel f32 scales (~1/256 of elements, rounded
     # up generously); int4: packed nibbles + scales; else dtype width

@@ -271,14 +271,32 @@ def kv_timeline_chart(
         ax_hbm.legend(fontsize=8, loc="upper left")
     ax_hbm.set_ylabel("HBM (GB)")
 
-    ev = series("kv_retained_evictions_total")
-    churn = [
-        (tb, max(vb - va, 0.0) / (tb - ta))
-        for (ta, va), (tb, vb) in zip(ev, ev[1:]) if tb > ta
-    ]
+    def rate(key: str) -> list[tuple[float, float]]:
+        pts = series(key)
+        return [
+            (tb, max(vb - va, 0.0) / (tb - ta))
+            for (ta, va), (tb, vb) in zip(pts, pts[1:]) if tb > ta
+        ]
+
+    # Eviction churn split: an eviction that lands in the host-RAM tier
+    # (kv_tier_demotions_total) is recoverable; the remainder is a true
+    # discard. Both derive from counters the timeline already samples.
+    churn = rate("kv_retained_evictions_total")
+    demo = dict(rate("kv_tier_demotions_total"))
     if churn:
-        ax_churn.plot([t for t, _ in churn], [v for _, v in churn],
-                      color=_PALETTE["bad"], linewidth=1.5)
+        if demo:
+            discard = [(t, max(v - demo.get(t, 0.0), 0.0)) for t, v in churn]
+            ax_churn.plot([t for t, _ in discard], [v for _, v in discard],
+                          color=_PALETTE["bad"], linewidth=1.5,
+                          label="true discards")
+            dpts = sorted(demo.items())
+            ax_churn.plot([t for t, _ in dpts], [v for _, v in dpts],
+                          color=_PALETTE["cold"], linewidth=1.5,
+                          linestyle="--", label="demoted to tier")
+            ax_churn.legend(fontsize=8, loc="upper left")
+        else:
+            ax_churn.plot([t for t, _ in churn], [v for _, v in churn],
+                          color=_PALETTE["bad"], linewidth=1.5)
     ax_churn.set_ylabel("evictions/s")
     ax_churn.set_xlabel("time (s)")
 

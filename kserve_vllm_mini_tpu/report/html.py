@@ -340,6 +340,22 @@ def _kv_cache_section(
             + (f" · retained {kv['retained_fraction']:.0%}"
                if kv.get("retained_fraction") is not None else "")
         )
+    if kv.get("tier_demotions") or kv.get("tier_promotions"):
+        tier = (
+            f"host tier {kv.get('tier_demotions', 0):.0f} demotions · "
+            f"{kv.get('tier_promotions', 0):.0f} promotions · "
+            f"{kv.get('tier_hits', 0):.0f} hits"
+        )
+        if kv.get("tier_bytes"):
+            tier += f" · {kv['tier_bytes'] / 1e6:.1f} MB resident"
+        if kv.get("tier_disabled"):
+            tier += " · DISABLED (thrash guard)"
+        facts.append(tier)
+    if kv.get("migrated_blocks"):
+        facts.append(
+            f"{kv['migrated_blocks']:.0f} blocks migrated in from "
+            f"siblings ({kv.get('migrated_bytes', 0) / 1e6:.1f} MB)"
+        )
     if kv.get("hbm_peak_bytes"):
         hbm = f"HBM peak {kv['hbm_peak_bytes'] / 1e9:.2f} GB"
         if kv.get("hbm_bytes_limit"):
@@ -446,6 +462,11 @@ def _disagg_section(results: dict[str, Any]) -> str:
             facts.append(
                 f"mean handoff wait {wait / handoffs * 1000.0:.1f} ms"
             )
+        copied = dg.get("handoff_bytes_copied")
+        if copied:
+            facts.append(f"{copied / 1e6:.1f} MB KV copied (dense v1 stripe)")
+        elif copied == 0:
+            facts.append("0 B KV copied (paged zero-copy handoff)")
     busy = dg.get("lane_busy_s")
     if busy:
         facts.append(f"prefill lane busy {busy:.2f} s")

@@ -37,14 +37,24 @@ prompt prefills off that thread entirely:
   at all (lane wedged without even a tombstone) hits the consume-side
   ``HANDOFF_TIMEOUT_S`` and takes the same colocated path.
 
-The handoff unit is the SLOT STRIPE because the v1 lane composes with
-dense KV layouts only (the paged pool's block-table handoff — block ids
-into a shared pool — is the planned merge with block-level APC; the
-``n_blocks`` accounting and the versioned protocol are already shaped
-for it). Byte-identity: the lane runs the SAME forward, params, bucket
-shapes, and piece schedule as colocated monolithic admission, and the
-staged stripe is injected verbatim (``update_cache_slots``), so greedy
-streams are byte-identical to the colocated engine's — pinned by
+Two payload formats, negotiated by KV layout (docs/DISAGGREGATION.md):
+
+- **v1 (dense)** — the handoff unit is the SLOT STRIPE: the lane owns a
+  1-slot staging cache, and consume injects the staged stripe verbatim
+  (``update_cache_slots``) — one device-side copy per handoff.
+- **v2 (paged, ``HANDOFF_VERSION``)** — the handoff unit is a BLOCK
+  TABLE: the scheduler reserves blocks from the engine's shared pool at
+  routing time and the lane prefills straight into them through the
+  engine's own compiled paged executables (``Engine._lane_paged_prefill``
+  — per-dispatch ``_cache_lock`` serializes the cache swap against the
+  scheduler; device execution orders by buffer dependencies). The
+  ``KVHandoff`` then carries NO KV bytes at all (``kv=None``): consume
+  installs the slot's table row and the handoff tax is a host-side
+  pointer write.
+
+Byte-identity either way: both paths run the SAME forward, params,
+bucket shapes, and piece schedule as colocated monolithic admission, so
+greedy streams are byte-identical to the colocated engine's — pinned by
 tests/test_disagg.py.
 """
 
@@ -56,11 +66,13 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
-# Protocol version stamped on every payload; bump whenever the staged
-# tree's layout/semantics change. Consume refuses mismatches (tombstone
-# -> colocated re-prefill), so a rolling upgrade can never inject a
-# stale-layout stripe into a new cache.
-HANDOFF_VERSION = 1
+# Protocol versions stamped on every payload; bump whenever a payload's
+# layout/semantics change. Consume accepts exactly the version its KV
+# layout speaks (v2 block tables on paged engines, v1 dense stripes on
+# dense ones) and refuses the rest (tombstone -> colocated re-prefill),
+# so a rolling upgrade can never install a stale-layout payload.
+HANDOFF_VERSION = 2        # paged block-table handoff (zero KV bytes)
+DENSE_HANDOFF_VERSION = 1  # dense staged-stripe handoff
 
 # consecutive tombstoned handoffs before the engine stops routing to the
 # lane entirely (degrade-to-colocated for the rest of the run); one
@@ -136,6 +148,7 @@ class PrefillLane:
         faults: Optional[Any] = None,         # runtime/faults.py FaultRegistry
         prefill_mesh: Optional[Any] = None,   # parallel/mesh.lane_meshes submesh
         max_inflight: Optional[int] = None,
+        paged_prefill: Optional[Callable[[Any, dict], Any]] = None,
     ) -> None:
         self.cfg = cfg
         self.ecfg = ecfg
@@ -143,6 +156,12 @@ class PrefillLane:
         self._instrument = instrument or (lambda fn, label: fn)
         self._faults = faults
         self.prefill_mesh = prefill_mesh
+        # HANDOFF_VERSION=2 hook (paged engines): the engine's
+        # _lane_paged_prefill bound method — (handle, meta) -> (logits,
+        # chunks) — which writes the prompt's KV straight into the
+        # shared-pool blocks meta["row"] names. None = v1 dense lane
+        # with its own staging cache.
+        self._paged_prefill = paged_prefill
         # backpressure bound: jobs routed but not yet handed off. Past it
         # the engine admits colocated (accepts() goes False) — the lane
         # sheds load back to the decode lane instead of queueing unbounded
@@ -203,12 +222,13 @@ class PrefillLane:
         with self._lock:
             return self._inflight
 
-    def submit(self, handle: Any) -> None:
+    def submit(self, handle: Any, meta: Optional[dict] = None) -> None:
         """Route one admission to the lane (scheduler thread; the caller
-        checked ``accepts()``)."""
+        checked ``accepts()``). ``meta`` is the v2 block reservation —
+        ``{"row", "off", "keys"}`` — for paged lanes; None on v1."""
         with self._lock:
             self._inflight += 1
-        self._jobs.put(handle)
+        self._jobs.put((handle, meta))
 
     def pop_ready(self) -> Optional[KVHandoff]:
         """Next finished handoff (payload or tombstone), or None. The
@@ -236,10 +256,10 @@ class PrefillLane:
         try:
             while not self._stop.is_set():
                 try:
-                    handle = self._jobs.get(timeout=0.05)
+                    handle, meta = self._jobs.get(timeout=0.05)
                 except queue.Empty:
                     continue
-                ho = self._one_job(handle)
+                ho = self._one_job(handle, meta)
                 ho.t_enqueued = time.time()
                 self._ready.put(ho)
         finally:
@@ -250,14 +270,14 @@ class PrefillLane:
                 self._dead = True
             while True:
                 try:
-                    h = self._jobs.get_nowait()
+                    h, _ = self._jobs.get_nowait()
                 except queue.Empty:
                     break
                 ho = self._tombstone(h, "prefill lane stopped")
                 ho.t_enqueued = time.time()
                 self._ready.put(ho)
 
-    def _one_job(self, handle: Any) -> KVHandoff:
+    def _one_job(self, handle: Any, meta: Optional[dict] = None) -> KVHandoff:
         """One routed prefill -> exactly one KVHandoff (payload or
         tombstone — every exit path answers, the never-hang contract)."""
         if handle.cancelled is not None:
@@ -265,7 +285,10 @@ class PrefillLane:
             # consume/cancel path already finishes the handle
             return self._tombstone(handle, "cancelled before lane prefill")
         try:
-            ho = self._prefill(handle)
+            if meta is not None and self._paged_prefill is not None:
+                ho = self._paged_job(handle, meta)
+            else:
+                ho = self._prefill(handle)
         except Exception as e:  # noqa: BLE001 — a lane fault must become
             # a tombstone (degrade-to-colocated), never an unanswered job
             with self.stats.lock:
@@ -285,9 +308,38 @@ class PrefillLane:
     def _tombstone(self, handle: Any, error: str,
                    busy_s: float = 0.0) -> KVHandoff:
         return KVHandoff(
-            version=HANDOFF_VERSION,
+            version=(
+                HANDOFF_VERSION if self._paged_prefill is not None
+                else DENSE_HANDOFF_VERSION
+            ),
             request_id=handle.request.request_id,
             handle=handle, busy_s=busy_s, dropped=True, error=error,
+        )
+
+    def _paged_job(self, handle: Any, meta: dict) -> KVHandoff:
+        """HANDOFF_VERSION=2: delegate the compute to the engine's
+        _lane_paged_prefill (same executables as colocated — the KV
+        lands directly in the reserved shared-pool blocks) and hand back
+        a table-only payload: zero KV bytes cross the lanes."""
+        t0 = time.time()
+        logits, chunks = self._paged_prefill(handle, meta)
+        wall = time.time() - t0
+        n = len(handle.request.prompt_tokens)
+        blk = max(getattr(self.ecfg, "kv_block_size", 64), 1)
+        with self.stats.lock:
+            self.stats.prefills += 1
+            self.stats.busy_s += wall
+        return KVHandoff(
+            version=HANDOFF_VERSION,
+            request_id=handle.request.request_id,
+            handle=handle,
+            n_tokens=n,
+            n_blocks=-(-n // blk),
+            reused_prefix_tokens=int(meta.get("off", 0)),
+            chunks=chunks,
+            busy_s=wall,
+            kv=None,
+            logits=logits,
         )
 
     # -- compiled staging prefill (lane thread only) ------------------------
@@ -438,7 +490,7 @@ class PrefillLane:
             self.stats.prefills += 1
             self.stats.busy_s += wall
         return KVHandoff(
-            version=HANDOFF_VERSION,
+            version=DENSE_HANDOFF_VERSION,
             request_id=req.request_id,
             handle=handle,
             n_tokens=n,

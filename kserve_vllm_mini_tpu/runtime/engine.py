@@ -319,6 +319,17 @@ class EngineConfig:
     # pool size in blocks; None sizes it to max_slots x ceil(max_seq/BLK)
     # (memory-equal to dense — set it LOWER to realize the savings)
     kv_pool_blocks: Optional[int] = None
+    # Host-RAM KV tier (docs/TROUBLESHOOTING.md "Host-RAM KV tier
+    # thrash"): byte budget of host memory that catches _retained_lru
+    # evictions instead of discarding them. Evicted registered blocks
+    # DEMOTE to the tier (device -> host copy, content key kept) and
+    # PROMOTE back on a prefix-key match at admission (fresh block +
+    # host -> device upload). Priced by profiling/headroom.py as host
+    # bytes only — never counted against the HBM estimate. 0/None = no
+    # tier. The tier disables itself when eviction churn crosses the
+    # kv_thrash monitor thresholds (demoting under thrash just moves
+    # the churn to PCIe) — the kv_tier_disabled gauge records it.
+    kv_host_tier_bytes: Optional[int] = None
     # Double-buffered decode (docs/DECODE_PIPELINE.md): in steady state the
     # scheduler dispatches sweep N+1 from the ON-DEVICE sampled-token carry
     # before retiring sweep N, so host-side token emission/admission work
@@ -585,6 +596,17 @@ class Engine:
             else None
         )
 
+        # Serializes every `self._cache = fn(self._cache, ...)` read-
+        # dispatch-assign against the paged prefill lane (docs/
+        # DISAGGREGATION.md v2): with HANDOFF_VERSION=2 the lane thread
+        # dispatches paged prefills INTO the shared pool cache, and an
+        # unserialized interleave could dispatch two donations of the
+        # same buffer (the assign is not atomic with the read). JAX
+        # async dispatch keeps the critical section microseconds —
+        # device execution is ordered by buffer dependencies, not the
+        # lock. Uncontended (colocated/dense engines never race it).
+        self._cache_lock = threading.Lock()
+
         self.paged = self.ecfg.kv_layout == "paged"
         if self.ecfg.kv_layout not in ("dense", "paged"):
             raise ValueError(
@@ -595,11 +617,12 @@ class Engine:
         # compositions up front — the lane is constructed further down,
         # once the compile recorder and fault registry it threads exist.
         if self.ecfg.disagg:
-            if self.paged:
+            if self.paged and prefill_mesh is not None:
                 raise ValueError(
-                    "disagg composes with kv_layout=dense in v1; the "
-                    "paged pool's block-table handoff is the planned "
-                    "merge with block-level APC"
+                    "paged disagg (HANDOFF_VERSION=2) shares ONE block "
+                    "pool between the lanes, so the lane must run on the "
+                    "engine's own mesh/devices; per-lane meshes compose "
+                    "with kv_layout=dense only"
                 )
             if drafter is not None:
                 raise ValueError(
@@ -612,11 +635,14 @@ class Engine:
                     "disagg does not support multi-LoRA yet (the lane "
                     "would need the adapter bank); drop --lora or disagg"
                 )
-            if self.ecfg.prefix_cache:
+            if self.ecfg.prefix_cache and not self.paged:
                 raise ValueError(
-                    "disagg and prefix_cache are mutually exclusive in "
-                    "v1: reuse matching happens at the decode lane's "
-                    "slot/block index, which the prefill lane cannot see"
+                    "disagg and the DENSE prefix_cache are mutually "
+                    "exclusive: slot-level reuse matching happens at the "
+                    "decode lane's slot index, which the prefill lane "
+                    "cannot see. The paged layout composes — block "
+                    "reuse is claimed at routing time on the scheduler "
+                    "thread and the lane prefills only the suffix"
                 )
             if mesh is not None and any(
                 mesh.shape.get(ax, 1) > 1 for ax in ("dp", "sp", "pp")
@@ -710,6 +736,35 @@ class Engine:
             from collections import OrderedDict
 
             self._retained_lru: "OrderedDict[int, None]" = OrderedDict()
+            # chain depth (1-based block index within the prompt chain
+            # that registered it) per registered block: the migration
+            # exporter orders blocks root-first by this so a bounded
+            # byte budget truncates the LEAVES of a chain, never its
+            # roots (plans match root-outward and stop at the first
+            # miss — an orphaned leaf would be dead weight on the wire)
+            self._block_depth: dict[int, int] = {}
+            # Host-RAM KV tier (EngineConfig.kv_host_tier_bytes): content
+            # key -> {"depth", "kv": host leaves}, insertion order =
+            # recency (popitem(last=False) evicts the oldest). Scheduler-
+            # thread-only, like every other pool structure. Tier
+            # mutations bump _prefix_epoch: a memoized plan that counted
+            # a tier hit must not survive the entry's eviction.
+            self._tier: "OrderedDict[bytes, dict]" = OrderedDict()
+            self._tier_bytes = 0
+            self._tier_cap_bytes = int(self.ecfg.kv_host_tier_bytes or 0)
+            self._tier_disabled = False
+            # thrash guard window state (rate of kv_retained_evictions
+            # over ~1 s windows, same thresholds as the monitor's
+            # kv_thrash rule): (window start, eviction count at start),
+            # consecutive over-threshold windows
+            self._tier_thrash_win = (time.time(), 0)
+            self._tier_thrash_hits = 0
+            # paged-v2 handoff/abort bookkeeping: blocks owned by a slot
+            # that was aborted while its prompt was still ON the lane.
+            # They must not return to the free pool until the lane's
+            # payload orphans at consume (the lane may still have
+            # dispatches in flight writing them) — keyed by handle id.
+            self._orphan_blocks: dict[int, list[int]] = {}
 
         def make_cache():
             return init_kv_cache(
@@ -956,6 +1011,12 @@ class Engine:
                 ),
                 faults=self._faults,
                 prefill_mesh=prefill_mesh,
+                # paged engines hand the lane the ENGINE's paged prefill
+                # path (shared pool, zero-copy v2 block-table handoff)
+                # instead of a staging cache + stripe
+                paged_prefill=(
+                    self._lane_paged_prefill if self.paged else None
+                ),
             )
 
         # stats for /metrics and duty-cycle telemetry
@@ -998,6 +1059,20 @@ class Engine:
             "pipeline_fallback_active_set": 0,   # admission/cancel forced retire
             "pipeline_fallback_headroom": 0,     # cache window forced sync
         }
+        if self.paged:
+            # KV-block economy rail (ISSUE 16), paged engines only (same
+            # conditional contract as the pool gauges): host-tier
+            # lifecycle and cross-replica migration accounting — all
+            # scheduler-thread writes (demotion/promotion at alloc/admit,
+            # import/export inside _run_admin ops), single-writer.
+            self.stats.update({
+                "kv_tier_demotions": 0,   # evictions caught by the tier
+                "kv_tier_promotions": 0,  # tier blocks uploaded back
+                "kv_tier_hits": 0,        # admissions that matched the tier
+                "kv_migrated_blocks": 0,  # blocks installed via kv_import
+                "kv_migrated_bytes": 0,   # wire bytes installed via kv_import
+                "kv_export_blocks": 0,    # blocks shipped via kv_export
+            })
         if self._disagg is not None:
             # disaggregated-serving rail (docs/DISAGGREGATION.md), present
             # only on disagg engines (same conditional contract as the
@@ -1010,6 +1085,10 @@ class Engine:
                 "kv_handoff_blocks": 0,      # KV blocks handed across lanes
                 "kv_handoff_wait_s": 0.0,    # lane-done -> consume wall
                 "kv_handoff_drops": 0,       # tombstones (drop/error/timeout)
+                # physical KV bytes the consume side copied per landed
+                # handoff: the v1 dense stripe's nbytes, 0 on the v2
+                # block-table path — the handoff-tax byte measurement
+                "kv_handoff_bytes_copied": 0,
                 "prefill_lane_busy_s": 0.0,  # lane compute wall
                 "disagg_colocated_fallbacks": 0,  # prefills degraded back
             })
@@ -1122,6 +1201,7 @@ class Engine:
         prompt = req.prompt_tokens
         reuse: list[int] = []
         keys: list[bytes] = []
+        tier_keys: list[bytes] = []
         if self.ecfg.prefix_cache:
             keys = self._prefix_keys(prompt, len(prompt) // self._blk)
             max_b = (len(prompt) - 1) // self._blk
@@ -1130,11 +1210,27 @@ class Engine:
                 if bid is None:
                     break
                 reuse.append(bid)
+            if self._tier and not self._tier_disabled:
+                # host-tier extension of the chain: demoted blocks whose
+                # keys continue the match beyond the device-resident
+                # prefix promote back at admission (read-only here — the
+                # upload happens in _paged_admit_blocks). Contiguity
+                # matters: a tier hit PAST a miss would leave a KV hole
+                # the prefill would never fill.
+                for key in keys[len(reuse):max_b]:
+                    if key not in self._tier:
+                        break
+                    tier_keys.append(key)
             floor = max(self.ecfg.min_prefill_bucket, len(prompt) // 4)
-            if len(reuse) * self._blk < floor:
+            if (len(reuse) + len(tier_keys)) * self._blk < floor:
                 reuse = []
+                tier_keys = []
+        # tier-promoted blocks still consume FRESH device blocks (the
+        # upload targets a new allocation), so they count in need_new
         need_new = self._blocks_needed(req) - len(reuse)
-        req._plan_cache = (self._prefix_epoch, keys, list(reuse), need_new)
+        req._plan_cache = (
+            self._prefix_epoch, keys, list(reuse), need_new, list(tier_keys),
+        )
         return reuse, need_new
 
     def _paged_fits(self, req: GenRequest) -> bool:
@@ -1158,27 +1254,146 @@ class Engine:
 
     def _paged_alloc(self) -> int:
         """One fresh block: free list first, then evict the least-recently
-        retained shared block (dropping its content-key registration)."""
+        retained shared block (dropping its content-key registration —
+        demoted to the host-RAM tier first when one is configured)."""
         self.stats["kv_blocks_allocated"] += 1
         if self._free_blocks:
             return self._free_blocks.pop()
         bid, _ = self._retained_lru.popitem(last=False)  # oldest
         self.stats["kv_retained_evictions"] += 1  # LRU churn (kv_thrash)
         key = self._block_hash.pop(bid, None)
+        depth = self._block_depth.pop(bid, 0)
         if key is not None:
             self._hash_block.pop(key, None)
             self._prefix_epoch += 1  # index changed: cached plans expire
+            if self._tier_cap_bytes and not self._tier_disabled:
+                self._tier_demote(bid, key, depth)
         self._block_rc.pop(bid, None)
         return bid
 
-    def _paged_admit_blocks(self, slot: int, req: GenRequest) -> int:
+    def _tier_block_bytes(self) -> int:
+        """Host bytes one demoted block occupies (the per-block slice of
+        every cache leaf — int8 caches demote their scales alongside)."""
+        return sum(
+            int(leaf.nbytes) // leaf.shape[1]
+            # aval metadata only (nbytes/shape are static across the
+            # dispatch swaps the lock orders; the dict reference read is
+            # atomic), never the buffer contents
+            for leaf in self._cache.values()  # kvmini: lock-ok
+        )
+
+    def _tier_demote(self, bid: int, key: bytes, depth: int) -> None:
+        """Catch an eviction in the host-RAM tier: copy the block's KV to
+        host (bounded by kv_host_tier_bytes — oldest tier entries make
+        room, a tier too small for even one block stays empty) and file
+        it under its content key for promotion at a future admission.
+        Scheduler-thread-only; the device fetch synchronizes, which is
+        exactly the price the capacity knob exists to bound."""
+        blob_bytes = self._tier_block_bytes()
+        if blob_bytes > self._tier_cap_bytes:
+            return
+        while self._tier_bytes + blob_bytes > self._tier_cap_bytes:
+            _, old = self._tier.popitem(last=False)  # oldest demotion
+            self._tier_bytes -= old["bytes"]
+            self._prefix_epoch += 1
+        self._tier[key] = {
+            "depth": depth,
+            "bytes": blob_bytes,
+            "kv": self._read_block_host(bid),
+        }
+        self._tier_bytes += blob_bytes
+        self._prefix_epoch += 1  # tier keys now match: plans must replan
+        self.stats["kv_tier_demotions"] += 1
+
+    def _tier_thrash_tick(self) -> None:
+        """Self-disabling thrash guard, run from the scheduler loop's
+        gauge-republish cadence: when retained-eviction churn crosses the
+        monitor's kv_thrash thresholds (>= 4.0 evictions/s over 3
+        consecutive ~1 s windows — monitor/events.py's defaults, kept in
+        lockstep so the chart marker and the tier agree on what churn
+        means), demoting is just moving the thrash onto PCIe — the tier
+        empties and disables for the rest of the run (sticky; the
+        kv_tier_disabled gauge records it)."""
+        if not self._tier_cap_bytes or self._tier_disabled:
+            return
+        now = time.time()
+        t0, ev0 = self._tier_thrash_win
+        if now - t0 < 1.0:
+            return
+        rate = (self.stats["kv_retained_evictions"] - ev0) / (now - t0)
+        self._tier_thrash_win = (now, self.stats["kv_retained_evictions"])
+        self._tier_thrash_hits = (
+            self._tier_thrash_hits + 1 if rate >= 4.0 else 0
+        )
+        if self._tier_thrash_hits >= 3:
+            self._tier_disabled = True
+            if self._tier:
+                self._tier.clear()
+                self._tier_bytes = 0
+                self._prefix_epoch += 1
+
+    def _read_block_host(self, bid: int) -> dict[str, Any]:
+        """One pool block's KV as host numpy leaves (block axis sliced
+        out). Used by tier demotion and the migration exporter; stubbed
+        by the JAX-free harness tests."""
+        with self._cache_lock:
+            out = {
+                name: np.asarray(leaf[:, bid])
+                for name, leaf in self._cache.items()
+            }
+        return out
+
+    def _write_block_dev(self, bid: int, leaves: dict[str, Any]) -> None:
+        """Install host KV leaves into pool block ``bid`` (the inverse of
+        _read_block_host) with the cache donated — tier promotion and the
+        migration importer both land through here."""
+        fn = self._decode_fns.get("kv_block_write")
+        if fn is None:
+            from kserve_vllm_mini_tpu.models.llama import update_cache_slots
+
+            @partial(jax.jit, donate_argnums=(0,))
+            def kv_block_write(cache, sub, bid):
+                return update_cache_slots(cache, sub, bid)
+
+            fn = self._instrument(kv_block_write, "kv_block_write")
+            self._decode_fns["kv_block_write"] = fn
+        sub = {
+            name: jnp.asarray(arr)[:, None] for name, arr in leaves.items()
+        }
+        with self._cache_lock:
+            self._cache = fn(self._cache, sub, jnp.int32(bid))
+
+    def _paged_register_keys(
+        self, blks: list[int], keys: list[bytes]
+    ) -> None:
+        """Register content keys for ``blks`` (parallel lists; first
+        registration wins — a key already mapped keeps its block) and
+        record each block's chain depth for the migration exporter."""
+        registered = False
+        for i, key in enumerate(keys):
+            if key not in self._hash_block and blks[i] not in self._block_hash:
+                self._hash_block[key] = blks[i]
+                self._block_hash[blks[i]] = key
+                self._block_depth[blks[i]] = i + 1
+                registered = True
+        if registered:
+            self._prefix_epoch += 1
+
+    def _paged_admit_blocks(
+        self, slot: int, req: GenRequest, register: bool = True
+    ) -> int:
         """Reserve the request's blocks (caller checked fit): claim the
-        cached prefix's shared blocks by reference, allocate the rest, and
-        point the slot's table row at them (scratch beyond). Registers the
-        prompt's full blocks for future sharing. Returns the reused token
-        count (the prefill's start offset)."""
+        cached prefix's shared blocks by reference, promote any host-tier
+        continuation of the chain, allocate the rest, and point the
+        slot's table row at them (scratch beyond). Registers the prompt's
+        full blocks for future sharing — unless ``register=False`` (the
+        disaggregated route: the lane fills the blocks ASYNCHRONOUSLY,
+        so registering at admission would let a later admission reuse KV
+        that does not exist yet; the consume side registers instead).
+        Returns the reused token count (the prefill's start offset)."""
         prompt = req.prompt_tokens
         reuse, need_new = self._paged_plan(req)
+        tier_keys: list[bytes] = list(req._plan_cache[4])
         # claim shared blocks FIRST: a 0->1 refcount leaves the retained
         # pool before eviction for the new allocations can touch it
         for bid in reuse:
@@ -1190,33 +1405,44 @@ class Engine:
         new_blocks = [self._paged_alloc() for _ in range(need_new)]
         for bid in new_blocks:
             self._block_rc[bid] = 1
+        # host-tier promotion: the plan's contiguous tier continuation
+        # uploads into the first fresh blocks — positionally they ARE the
+        # chain's next blocks, so the prefill can start past them. The
+        # plan is epoch-memoized, but an eviction between plan and admit
+        # (the _paged_alloc above can clear the tier under cap pressure)
+        # must degrade to prefilling those positions, never to attending
+        # a hole — re-check membership per key and stop at the first gap.
+        promoted = 0
+        for i, key in enumerate(tier_keys):
+            entry = self._tier.pop(key, None) if not self._tier_disabled else None
+            if entry is None:
+                break
+            self._tier_bytes -= entry["bytes"]
+            self._prefix_epoch += 1
+            self._write_block_dev(new_blocks[i], entry["kv"])
+            promoted += 1
+        if promoted:
+            self.stats["kv_tier_promotions"] += promoted
+            self.stats["kv_tier_hits"] += 1
         blks = reuse + new_blocks
         self._slot_blocks[slot] = blks
         row = np.full((self._maxb,), self._scratch_block, dtype=np.int32)
         row[: len(blks)] = blks
         self._block_table[slot] = row
         self._table_dev = None
-        if self.ecfg.prefix_cache:
+        if self.ecfg.prefix_cache and register:
             # register this prompt's full blocks (content exists once the
             # synchronous prefill below runs; admissions are serialized on
             # the scheduler thread, so no reader can arrive earlier). The
             # key list comes from the memoized plan — no third hash pass.
-            keys = req._plan_cache[1]
-            registered = False
-            for i, key in enumerate(keys):
-                if key not in self._hash_block:
-                    self._hash_block[key] = blks[i]
-                    self._block_hash[blks[i]] = key
-                    registered = True
-            if registered:
-                self._prefix_epoch += 1
-        reused_len = len(reuse) * self._blk
+            self._paged_register_keys(blks, req._plan_cache[1])
+        reused_len = (len(reuse) + promoted) * self._blk
         if self.ecfg.prefix_cache:
             # a lookup only happened if block reuse was attempted at all —
             # counting otherwise would pin cache_hit_ratio to a hard 0
             # instead of letting the TTFT probe fall through
             self.stats["prefix_lookups"] += 1
-        if reuse:
+        if reused_len:
             self.stats["prefix_hits"] += 1
             self.stats["prefix_tokens_reused"] += reused_len
             self._hit_depths.append(reused_len)
@@ -1242,21 +1468,26 @@ class Engine:
             tokens = self._slot_tokens[slot][: self._slot_len[slot]]
             n_full = len(tokens) // self._blk
             if n_full:
-                keys = self._prefix_keys(tokens, n_full)
-                registered = False
-                for i, key in enumerate(keys):
-                    bid = self._slot_blocks[slot][i]
-                    if key not in self._hash_block and bid not in self._block_hash:
-                        self._hash_block[key] = bid
-                        self._block_hash[bid] = key
-                        registered = True
-                if registered:
-                    self._prefix_epoch += 1
-        # reversed: the chain's LEAF blocks enter the LRU first (oldest
-        # end), so eviction takes leaves before roots — evicting a root
-        # first would orphan every still-retained descendant (plans match
-        # prefixes root-outward and stop at the first miss)
-        for bid in reversed(self._slot_blocks[slot]):
+                self._paged_register_keys(
+                    self._slot_blocks[slot][:n_full],
+                    self._prefix_keys(tokens, n_full),
+                )
+        self._paged_release_blocks(self._slot_blocks[slot])
+        self._slot_blocks[slot] = []
+        self._block_table[slot] = self._scratch_block
+        self._table_dev = None
+
+    def _paged_release_blocks(self, blks: list[int]) -> None:
+        """Drop one ownership reference per block in ``blks`` — the
+        shared tail of _paged_release and the orphaned-handoff release
+        (a paged-v2 slot aborted mid-lane frees its blocks only when the
+        lane's payload lands, so in-flight lane writes can never hit a
+        reallocated block). Reversed: the chain's LEAF blocks enter the
+        LRU first (oldest end), so eviction takes leaves before roots —
+        evicting a root first would orphan every still-retained
+        descendant (plans match prefixes root-outward and stop at the
+        first miss)."""
+        for bid in reversed(blks):
             rc = self._block_rc.get(bid, 1) - 1
             if rc > 0:
                 self._block_rc[bid] = rc
@@ -1266,10 +1497,176 @@ class Engine:
                 self._retained_lru[bid] = None  # most-recent end
             else:
                 self._block_rc.pop(bid, None)
+                self._block_depth.pop(bid, None)
                 self._free_blocks.append(bid)
-        self._slot_blocks[slot] = []
-        self._block_table[slot] = self._scratch_block
-        self._table_dev = None
+
+    # -- cross-replica KV migration (docs/FLEET.md; POST /kv/export|import)
+
+    def _wire_encode_block(self, leaves: dict[str, Any]) -> dict[str, Any]:
+        """One block's host leaves -> the JSON wire format: int8 values
+        with f32 per-row wire scales for unquantized k/v (int8-KV on the
+        wire regardless of the resident dtype — migration is a warmup
+        transfer, the same accuracy trade --kv-cache-dtype int8 makes),
+        verbatim bytes for already-int8 leaves and scale leaves."""
+        import base64
+
+        wire: dict[str, Any] = {}
+        for name, arr in leaves.items():
+            a = np.asarray(arr)
+            if name in ("k", "v") and a.dtype != np.int8:
+                f = a.astype(np.float32)
+                amax = np.max(np.abs(f), axis=-1)
+                scale = np.where(amax > 0.0, amax / 127.0, 1.0).astype(
+                    np.float32
+                )
+                q = np.clip(
+                    np.round(f / scale[..., None]), -127, 127
+                ).astype(np.int8)
+                wire[name] = {
+                    "b64": base64.b64encode(q.tobytes()).decode(),
+                    "dtype": "int8",
+                    "shape": list(q.shape),
+                    "wire_scale_b64": base64.b64encode(
+                        scale.tobytes()
+                    ).decode(),
+                }
+            else:
+                wire[name] = {
+                    "b64": base64.b64encode(a.tobytes()).decode(),
+                    "dtype": str(a.dtype),
+                    "shape": list(a.shape),
+                }
+        return wire
+
+    def _wire_decode_block(self, wire: dict[str, Any]) -> dict[str, Any]:
+        """Inverse of _wire_encode_block, validated against THIS engine's
+        cache geometry — a donor with different layer/head/block shapes
+        must fail loudly, never scatter-write garbage."""
+        import base64
+
+        leaves: dict[str, Any] = {}
+        for name, leaf in self._cache.items():
+            spec = wire.get(name)
+            if spec is None:
+                raise ValueError(f"kv wire payload missing leaf {name!r}")
+            want = (leaf.shape[0],) + tuple(leaf.shape[2:])
+            if tuple(spec["shape"]) != want:
+                raise ValueError(
+                    f"kv wire leaf {name!r} shape {spec['shape']} does "
+                    f"not match this engine's block shape {list(want)}"
+                )
+            raw = np.frombuffer(
+                base64.b64decode(spec["b64"]), dtype=np.dtype(spec["dtype"])
+            ).reshape(spec["shape"])
+            if "wire_scale_b64" in spec:
+                scale = np.frombuffer(
+                    base64.b64decode(spec["wire_scale_b64"]), np.float32
+                ).reshape(spec["shape"][:-1])
+                raw = (raw.astype(np.float32) * scale[..., None]).astype(
+                    np.asarray(leaf[:1, :1]).dtype
+                )
+            leaves[name] = raw
+        return leaves
+
+    def kv_export(self, budget_bytes: int) -> dict[str, Any]:
+        """Bounded wire snapshot of this engine's registered (shareable)
+        blocks, root-first by chain depth so budget truncation drops
+        LEAVES (a shipped leaf without its roots could never match —
+        plans walk root-outward and stop at the first miss). Thread-safe:
+        the pool walk and device reads run on the scheduler thread via
+        _run_admin. Raises on dense engines — the caller (POST
+        /kv/export) turns that into a 400."""
+        if not self.paged:
+            raise ValueError("kv_export requires kv_layout=paged")
+        out: dict[str, Any] = {
+            "block_size": self._blk,
+            "blocks": [],
+            "bytes": 0,
+            "truncated": False,
+        }
+
+        def _collect() -> None:
+            budget = max(int(budget_bytes), 0)
+            spent = 0
+            cands = sorted(
+                self._block_hash.items(),
+                key=lambda item: self._block_depth.get(item[0], 0),
+            )
+            for bid, key in cands:
+                wire = self._wire_encode_block(self._read_block_host(bid))
+                nbytes = sum(
+                    len(spec["b64"]) * 3 // 4
+                    + len(spec.get("wire_scale_b64", "")) * 3 // 4
+                    for spec in wire.values()
+                )
+                if spent + nbytes > budget:
+                    out["truncated"] = True
+                    break
+                spent += nbytes
+                out["blocks"].append({
+                    "key": key.hex(),
+                    "depth": self._block_depth.get(bid, 0),
+                    "kv": wire,
+                })
+            out["bytes"] = spent
+            self.stats["kv_export_blocks"] += len(out["blocks"])
+
+        err = self._run_admin(_collect, timeout_s=30.0)
+        if err is not None:
+            raise RuntimeError(f"kv_export failed: {err}")
+        return out
+
+    def kv_import(self, payload: dict[str, Any]) -> dict[str, Any]:
+        """Install a sibling's kv_export payload: each block takes a
+        FREE pool block (never evicts — warming must not thrash the
+        target's own cache), uploads through the block-write executable,
+        and registers as a retained (rc=0, evictable) prefix block.
+        Already-known keys skip; a dry free list stops the import early.
+        Runs on the scheduler thread via _run_admin."""
+        if not self.paged:
+            raise ValueError("kv_import requires kv_layout=paged")
+        if int(payload.get("block_size", -1)) != self._blk:
+            raise ValueError(
+                f"kv_import block_size {payload.get('block_size')} does "
+                f"not match this engine's kv_block_size {self._blk}"
+            )
+        res = {"imported": 0, "skipped": 0, "bytes": 0, "exhausted": False}
+
+        def _install() -> None:
+            registered = False
+            for entry in payload.get("blocks", []):
+                key = bytes.fromhex(entry["key"])
+                if key in self._hash_block:
+                    res["skipped"] += 1
+                    continue
+                if not self._free_blocks:
+                    res["exhausted"] = True
+                    break
+                leaves = self._wire_decode_block(entry["kv"])
+                bid = self._free_blocks.pop()
+                self.stats["kv_blocks_allocated"] += 1
+                self._write_block_dev(bid, leaves)
+                self._hash_block[key] = bid
+                self._block_hash[bid] = key
+                self._block_depth[bid] = int(entry.get("depth", 0))
+                self._block_rc[bid] = 0
+                self._retained_lru[bid] = None
+                registered = True
+                res["imported"] += 1
+                res["bytes"] += sum(
+                    len(spec["b64"]) * 3 // 4
+                    + len(spec.get("wire_scale_b64", "")) * 3 // 4
+                    for spec in entry["kv"].values()
+                )
+            if registered:
+                self._prefix_epoch += 1
+            self.stats["kv_migrated_blocks"] += res["imported"]
+            self.stats["kv_migrated_bytes"] += res["bytes"]
+
+        err = self._run_admin(_install, timeout_s=60.0)
+        if err is not None:
+            raise RuntimeError(f"kv_import failed: {err}")
+        return res
 
     def _table(self) -> jnp.ndarray:
         """Device mirror of the block table, rebuilt only when allocation
@@ -2073,37 +2470,85 @@ class Engine:
                 "lora": self._lora["layers"],
                 "ids": jnp.asarray([adapter_idx], jnp.int32),
             }
-        cache_in = self._dcache if draft else self._cache
-        if self.paged:
-            trow = jnp.asarray(self._block_table[slot : slot + 1])
-            if off == 0:
-                fn = self._get_paged_prefill_fn(bucket)
+        # the read-dispatch-assign below must be atomic against the v2
+        # prefill lane's own cache mutations (_lane_paged_prefill) —
+        # dispatch is async, so the critical section stays tiny
+        with self._cache_lock:
+            cache_in = self._dcache if draft else self._cache
+            if self.paged:
+                trow = jnp.asarray(self._block_table[slot : slot + 1])
+                if off == 0:
+                    fn = self._get_paged_prefill_fn(bucket)
+                    cache, last_logits = fn(
+                        params, cache_in, tokens, jnp.int32(m), trow, **lkw
+                    )
+                else:
+                    fn = self._get_paged_chunk_prefill_fn(bucket)
+                    cache, last_logits = fn(
+                        params, cache_in, tokens,
+                        jnp.int32(m), jnp.int32(off), trow, **lkw,
+                    )
+            elif off == 0:
+                fn = self._get_prefill_fn(bucket, draft=draft)
                 cache, last_logits = fn(
-                    params, cache_in, tokens, jnp.int32(m), trow, **lkw
+                    params, cache_in, tokens, jnp.int32(m), jnp.int32(slot),
+                    **lkw,
                 )
             else:
-                fn = self._get_paged_chunk_prefill_fn(bucket)
+                fn = self._get_chunk_prefill_fn(bucket, draft=draft)
                 cache, last_logits = fn(
                     params, cache_in, tokens,
-                    jnp.int32(m), jnp.int32(off), trow, **lkw,
+                    jnp.int32(m), jnp.int32(slot), jnp.int32(off), **lkw,
                 )
-        elif off == 0:
-            fn = self._get_prefill_fn(bucket, draft=draft)
-            cache, last_logits = fn(
-                params, cache_in, tokens, jnp.int32(m), jnp.int32(slot),
-                **lkw,
-            )
-        else:
-            fn = self._get_chunk_prefill_fn(bucket, draft=draft)
-            cache, last_logits = fn(
-                params, cache_in, tokens,
-                jnp.int32(m), jnp.int32(slot), jnp.int32(off), **lkw,
-            )
-        if draft:
-            self._dcache = cache
-        else:
-            self._cache = cache
+            if draft:
+                self._dcache = cache
+            else:
+                self._cache = cache
         return last_logits
+
+    def _lane_paged_prefill(self, handle: RequestHandle, meta: dict):
+        """HANDOFF_VERSION=2 lane prefill — runs ON the prefill-lane
+        thread. Writes the prompt suffix straight into the shared-pool
+        blocks the scheduler reserved at routing (meta["row"]), through
+        the SAME compiled paged executables and piece schedule the
+        colocated path uses, so greedy streams stay byte-identical and
+        the handoff carries a block table instead of KV bytes. Each
+        read-dispatch-assign of self._cache serializes against the
+        scheduler via _cache_lock; actual device execution orders by
+        buffer dependencies on the single stream. Returns
+        (last_logits, chunks) — the lane wraps them into the handoff."""
+        prompt = handle.request.prompt_tokens
+        row_dev = jnp.asarray(meta["row"][None])
+        pos = int(meta["off"])
+        budget = self.ecfg.max_prefill_len
+        chunks = 0
+        last_logits = None
+        while pos < len(prompt):
+            piece = prompt[pos : pos + budget]
+            m = len(piece)
+            bucket = self._bucket(m)
+            toks = piece + [self.pad_id] * (bucket - m)
+            tokens = jnp.asarray(toks, dtype=jnp.int32)[None]
+            with self._cache_lock:
+                if pos == 0:
+                    fn = self._get_paged_prefill_fn(bucket)
+                    self._cache, last_logits = fn(
+                        self.params, self._cache, tokens, jnp.int32(m),
+                        row_dev,
+                    )
+                else:
+                    fn = self._get_paged_chunk_prefill_fn(bucket)
+                    self._cache, last_logits = fn(
+                        self.params, self._cache, tokens,
+                        jnp.int32(m), jnp.int32(pos), row_dev,
+                    )
+            chunks += 1
+            pos += m
+        # the lane must not report the handoff complete until the block
+        # writes landed: consume swaps the row in with zero copies, so
+        # this sync IS the v2 payload barrier  # kvmini: sync-ok
+        jax.block_until_ready(last_logits)
+        return last_logits, chunks
 
     def _prefill_step(self, slot: int, st: dict, budget: int) -> bool:
         """Advance one prefill piece for ``st`` (the per-slot chunked-
@@ -2209,11 +2654,17 @@ class Engine:
         if self._disagg is None:
             return
         from kserve_vllm_mini_tpu.runtime.disagg import (
+            DENSE_HANDOFF_VERSION,
             DROPS_TO_DEGRADE,
             HANDOFF_TIMEOUT_S,
             HANDOFF_VERSION,
         )
 
+        # version negotiation (docs/DISAGGREGATION.md): each layout
+        # speaks exactly one payload format — paged consumes v2 block
+        # tables, dense consumes v1 staged stripes. Anything else walks
+        # the same degrade ladder as a drop.
+        expected = HANDOFF_VERSION if self.paged else DENSE_HANDOFF_VERSION
         while True:
             ho = self._disagg.pop_ready()
             if ho is None:
@@ -2226,11 +2677,15 @@ class Engine:
             )
             if slot is None:
                 # the slot was aborted (cancel/drain/fault recovery)
-                # before the handoff landed: the payload is an orphan
+                # before the handoff landed: the payload is an orphan.
+                # Paged orphans also return their quarantined blocks to
+                # the pool — only now is it certain no lane write to
+                # them is still in flight.
                 self.stats["prefill_lane_busy_s"] += ho.busy_s
+                self._reap_orphans(ho.handle)
                 continue
             handle: RequestHandle = ho.handle
-            if ho.dropped or ho.version != HANDOFF_VERSION:
+            if ho.dropped or ho.version != expected:
                 # lost/injected-drop/stale-protocol handoff: count it,
                 # climb the degrade ladder, and re-prefill colocated —
                 # the request completes either way
@@ -2240,6 +2695,7 @@ class Engine:
                 if self._disagg_drop_run >= DROPS_TO_DEGRADE:
                     self._disagg_degraded = True
                 self._colocated_fallback(slot, on_decision)
+                self._reap_orphans(handle)
                 continue
             self._disagg_drop_run = 0
             if handle.cancelled is not None:
@@ -2247,6 +2703,7 @@ class Engine:
                 # happened — account it before dropping the payload
                 self.stats["prefill_lane_busy_s"] += ho.busy_s
                 self._abort_handoff(slot, handle.cancelled)
+                self._reap_orphans(handle)
                 continue
             if self._inflight:
                 # activation joins the decode set — retire against
@@ -2261,9 +2718,30 @@ class Engine:
             self.stats["kv_handoff_wait_s"] += wait
             self.stats["prefill_lane_busy_s"] += ho.busy_s
             self.stats["prefill_chunks"] += ho.chunks
-            self._cache = self._get_inject_fn()(
-                self._cache, ho.kv, jnp.int32(slot)
-            )
+            hstate = self._slot_handoff[slot]
+            if self.paged:
+                # v2 block-table handoff: the lane already wrote the KV
+                # into this slot's pool blocks — install the table row
+                # the route parked on scratch and register the prompt's
+                # content keys now that the blocks hold real KV. ZERO
+                # bytes of cache move here.
+                self._block_table[slot] = hstate["row"]
+                self._table_dev = None
+                if self.ecfg.prefix_cache and hstate["keys"]:
+                    self._paged_register_keys(
+                        self._slot_blocks[slot][: len(hstate["keys"])],
+                        hstate["keys"],
+                    )
+            else:
+                # v1 dense staged stripe: one device-side inject copy,
+                # measured so the A/B against v2 is a stats read
+                self.stats["kv_handoff_bytes_copied"] += sum(
+                    int(leaf.nbytes) for leaf in ho.kv.values()
+                )
+                with self._cache_lock:
+                    self._cache = self._get_inject_fn()(
+                        self._cache, ho.kv, jnp.int32(slot)
+                    )
             self._observe_phase("handoff", now - t_route)
             self._trace_span(
                 handle, "server.handoff", t_route, now,
@@ -2301,13 +2779,25 @@ class Engine:
                 self._disagg_degraded = True
             self._colocated_fallback(slot, on_decision)
 
+    def _reap_orphans(self, handle: RequestHandle) -> None:
+        """Return a handle's quarantined v2 blocks to the pool once its
+        lane work is provably finished — the payload (or tombstone) has
+        arrived, so no lane write to them can still be in flight. No-op
+        for dense engines and handles with nothing quarantined."""
+        if not self.paged:
+            return
+        blks = self._orphan_blocks.pop(id(handle), None)
+        if blks:
+            self._paged_release_blocks(blks)
+
     def _colocated_fallback(self, slot: int, on_decision=None) -> None:
         """Degrade-to-colocated (the handoff ladder's recovery step): the
         routed prompt's handoff was lost, so its prefill runs right here
         on the scheduler thread — the monolithic piece loop the colocated
         engine would have used — and the slot activates normally. The
         request never observes the drop beyond added latency."""
-        handle: RequestHandle = self._slot_handoff[slot]["handle"]
+        hstate = self._slot_handoff[slot]
+        handle: RequestHandle = hstate["handle"]
         if handle.cancelled is not None:
             self._abort_handoff(slot, handle.cancelled)
             return
@@ -2316,10 +2806,21 @@ class Engine:
         if self._inflight:
             self.stats["pipeline_fallback_active_set"] += 1
             self._retire_all(on_decision)
+        off = 0
+        if self.paged:
+            # the v2 route already allocated this slot's blocks and
+            # parked the table row on scratch: re-install the row and
+            # re-prefill from the reused frontier right here. If a
+            # wedged (not dead) lane is still writing the same blocks,
+            # both writers produce identical bytes from identical
+            # inputs through the same executables — benign overlap.
+            self._block_table[slot] = hstate["row"]
+            self._table_dev = None
+            off = hstate.get("reused", 0)
         st = {
             "handle": handle,
-            "off": 0,
-            "reused": 0,
+            "off": off,
+            "reused": off,
             "adapter_idx": 0,
             "chunks": 0,
             "draft_chunks": 0,
@@ -2328,6 +2829,13 @@ class Engine:
         }
         while not self._prefill_step(slot, st, self.ecfg.max_prefill_len):
             pass
+        if self.paged and self.ecfg.prefix_cache and hstate.get("keys"):
+            # the route deferred key registration to consume; the
+            # fallback prefill just wrote the real KV, so register here
+            self._paged_register_keys(
+                self._slot_blocks[slot][: len(hstate["keys"])],
+                hstate["keys"],
+            )
         self._activate_slot(slot, st)
 
     def _abort_handoff(self, slot: int, reason: str) -> None:
@@ -2336,6 +2844,13 @@ class Engine:
         zero tokens, and the slot frees. The lane's payload, when it
         lands, is dropped as an orphan by the consume identity check."""
         handle = self._slot_req[slot]
+        if self.paged and self._slot_blocks[slot]:
+            # v2: the lane may still have writes in flight against this
+            # slot's blocks — quarantine them out of the free pool until
+            # the lane's payload (or tombstone) orphans at consume, else
+            # a reallocation could race the lane's stores
+            self._orphan_blocks[id(handle)] = self._slot_blocks[slot]
+            self._slot_blocks[slot] = []
         handle.t_done = time.time()
         handle.finish_reason = reason
         self._observe_phase("prefill", handle.t_done - handle.t_admit)
@@ -2430,10 +2945,36 @@ class Engine:
             slot = self._free.pop()
             self._slot_req[slot] = handle
             self._slot_len[slot] = 0
+            meta = None
+            if self.paged:
+                # HANDOFF_VERSION=2 (docs/DISAGGREGATION.md): allocate
+                # the slot's blocks from the SHARED pool right here on
+                # the scheduler thread (prefix reuse + tier promotion
+                # both settle now), but park the slot's table row on
+                # scratch while the lane writes — decode sweeps dispatch
+                # all S slots, and this len-0 slot's garbage writes must
+                # land in scratch, not in blocks the lane is filling.
+                # register=False: content keys are registered at consume,
+                # after the KV actually exists — registering now would
+                # let a later admission reuse blocks not yet written.
+                reused = self._paged_admit_blocks(slot, req, register=False)
+                blks = list(self._slot_blocks[slot])
+                row = np.full((self._maxb,), self._scratch_block, np.int32)
+                row[: len(blks)] = blks
+                self._block_table[slot] = self._scratch_block
+                self._table_dev = None
+                meta = {
+                    "row": row,
+                    "off": reused,
+                    "keys": list(req._plan_cache[1]),
+                }
             self._slot_handoff[slot] = {
                 "handle": handle, "t_route": handle.t_admit,
+                "reused": (meta or {}).get("off", 0),
+                "row": None if meta is None else meta["row"],
+                "keys": None if meta is None else meta["keys"],
             }
-            self._disagg.submit(handle)
+            self._disagg.submit(handle, meta)
             return
         slot, reused = self._pop_slot_for(req.prompt_tokens)
         if self.paged:
@@ -2829,11 +3370,12 @@ class Engine:
         temps, topks, topps, _pres, _freqs = self._get_sampling_arrays()
         self._rng, sub = jax.random.split(self._rng)
         t0 = time.time()
-        self._cache, self._dcache, emit = spec(
-            self.params, self._cache,
-            self._drafter_params, self._dcache,
-            tokens, lengths, temps, topks, topps, sub,
-        )
+        with self._cache_lock:
+            self._cache, self._dcache, emit = spec(
+                self.params, self._cache,
+                self._drafter_params, self._dcache,
+                tokens, lengths, temps, topks, topps, sub,
+            )
         # one transfer for the whole [S, k] block (same rationale as decode)
         emit_host = np.asarray(jax.device_get(emit))
         now = time.time()
@@ -3008,12 +3550,13 @@ class Engine:
             self._bubble_anchor = 0.0
         decode = self._get_decode_fn(chunk)
         with jax.profiler.TraceAnnotation("kvmini.decode_dispatch"):
-            self._cache, self._counts, next_toks, ys = decode(
-                self.params, self._cache,
-                tokens, jnp.asarray(lengths, dtype=jnp.int32),
-                temps, topks, topps, sub,
-                self._counts, pres, freqs, **lkw,
-            )
+            with self._cache_lock:
+                self._cache, self._counts, next_toks, ys = decode(
+                    self.params, self._cache,
+                    tokens, jnp.asarray(lengths, dtype=jnp.int32),
+                    temps, topks, topps, sub,
+                    self._counts, pres, freqs, **lkw,
+                )
         self._tokens_dev = next_toks
         self._tokens_dev_slots = frozenset(active)
         self._inflight.append({
@@ -3192,12 +3735,13 @@ class Engine:
             lkw["lora"] = self._lora["layers"]
             lkw["ids"] = self._adapter_ids()
         decode = self._get_masked_decode_fn()
-        self._cache, self._counts, next_toks, ys = decode(
-            self.params, self._cache,
-            tokens, lengths, temps, topks, topps, sub,
-            self._counts, pres, freqs,
-            jnp.asarray(mask), jnp.asarray(use_mask), **lkw,
-        )
+        with self._cache_lock:
+            self._cache, self._counts, next_toks, ys = decode(
+                self.params, self._cache,
+                tokens, lengths, temps, topks, topps, sub,
+                self._counts, pres, freqs,
+                jnp.asarray(mask), jnp.asarray(use_mask), **lkw,
+            )
         self._tokens_dev = next_toks
         self._tokens_dev_slots = frozenset(active)
         toks_h, lps_h, tids_h, tlps_h = (
@@ -3436,6 +3980,10 @@ class Engine:
                     stale = time.time() - self._kv_gauges_t >= 0.25
                 if stale:
                     self._kv_admin_snapshot()
+                    if self.paged:
+                        # host-RAM tier thrash guard rides the same
+                        # cadence as the gauge republish (~4 Hz)
+                        self._tier_thrash_tick()
             except DeviceFault as exc:
                 # injected (or classified) dispatch-time device error:
                 # recoverable by design — fail the batch, degrade, keep
@@ -3743,7 +4291,9 @@ class Engine:
                         "kv_retained_blocks", "kv_used_blocks",
                         "kv_block_size", "kv_occupancy",
                         "kv_retained_fraction", "kv_fragmentation",
-                        "kv_logical_bytes", "kv_physical_bytes"):
+                        "kv_logical_bytes", "kv_physical_bytes",
+                        "kv_tier_blocks", "kv_tier_bytes",
+                        "kv_tier_capacity_bytes", "kv_tier_disabled"):
                 if key in kv:
                     s[key] = kv[key]
         # HBM watermarks (docs/TROUBLESHOOTING.md): device memory_stats
@@ -3868,6 +4418,16 @@ class Engine:
                 for i in range(self.ecfg.max_slots)
                 if self._slot_blocks[i]
             )
+            # blocks allocated to a routed slot whose handoff/migration
+            # is still in flight (_slot_len is 0 until activation): they
+            # are BEING written, not fragmented — counting them would
+            # false-fire the gauge on every disagg/migration run
+            in_transit = sum(
+                len(self._slot_blocks[i])
+                for i in range(self.ecfg.max_slots)
+                if self._slot_handoff[i] is not None
+            )
+            settled = used - in_transit
             fresh.update({
                 "kv_pool_blocks": pool,
                 "kv_free_blocks": free,
@@ -3880,11 +4440,17 @@ class Engine:
                 # blocks (reservations are worst-case); shared prefixes
                 # can push live-token totals past used*blk, so clamp
                 "kv_fragmentation": (
-                    min(max(1.0 - live / (used * self._blk), 0.0), 1.0)
-                    if used > 0 else 0.0
+                    min(max(1.0 - live / (settled * self._blk), 0.0), 1.0)
+                    if settled > 0 else 0.0
                 ),
                 "kv_logical_bytes": live * bpt,
                 "kv_physical_bytes": pool * self._blk * bpt,
+                # host-RAM tier gauges (priced as HOST bytes — never in
+                # the HBM headroom estimate)
+                "kv_tier_blocks": len(self._tier),
+                "kv_tier_bytes": self._tier_bytes,
+                "kv_tier_capacity_bytes": self._tier_cap_bytes,
+                "kv_tier_disabled": 1 if self._tier_disabled else 0,
             })
 
         err = self._run_admin(_collect, timeout_s=2.0)
@@ -3929,6 +4495,16 @@ class Engine:
             ("kv_fragmentation", "fragmentation"),
             ("kv_logical_bytes", "logical_bytes"),
             ("kv_physical_bytes", "physical_bytes"),
+            ("kv_tier_demotions", "tier_demotions"),
+            ("kv_tier_promotions", "tier_promotions"),
+            ("kv_tier_hits", "tier_hits"),
+            ("kv_tier_blocks", "tier_blocks"),
+            ("kv_tier_bytes", "tier_bytes"),
+            ("kv_tier_capacity_bytes", "tier_capacity_bytes"),
+            ("kv_tier_disabled", "tier_disabled"),
+            ("kv_migrated_blocks", "migrated_blocks"),
+            ("kv_migrated_bytes", "migrated_bytes"),
+            ("kv_export_blocks", "export_blocks"),
             ("hbm_bytes_in_use", "hbm_bytes_in_use"),
             ("hbm_peak_bytes", "hbm_peak_bytes"),
             ("hbm_bytes_limit", "hbm_bytes_limit"),
@@ -3953,6 +4529,7 @@ class Engine:
             "handoff_blocks": s["kv_handoff_blocks"],
             "handoff_wait_s": round(s["kv_handoff_wait_s"], 6),
             "handoff_drops": s["kv_handoff_drops"],
+            "handoff_bytes_copied": s["kv_handoff_bytes_copied"],
             "lane_busy_s": round(s["prefill_lane_busy_s"], 6),
             "colocated_fallbacks": s["disagg_colocated_fallbacks"],
             "queue_depth": s["kv_handoff_queue_depth"],

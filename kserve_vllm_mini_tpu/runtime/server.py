@@ -69,6 +69,7 @@ def build_engine(
     kv_layout: str = "dense",
     kv_block_size: int = 64,
     kv_pool_blocks: Optional[int] = None,
+    kv_host_tier_bytes: Optional[int] = None,
     lora_adapters: Optional[dict[str, str]] = None,  # name -> PEFT dir
     lora_demo: int = 0,       # N random adapters "demo-1..N" (bench/testing)
     lora_rank: int = 8,       # rank for the demo bank (PEFT dirs carry theirs)
@@ -320,6 +321,7 @@ def build_engine(
         kv_layout=kv_layout,
         kv_block_size=kv_block_size,
         kv_pool_blocks=kv_pool_blocks,
+        kv_host_tier_bytes=kv_host_tier_bytes,
         lora_slots=lora_slots,
         request_tracing=request_tracing,
         trace_buffer=trace_buffer,
@@ -1437,6 +1439,13 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
                 f"kvmini_tpu_kv_handoff_queue_depth {s['kv_handoff_queue_depth']}",
                 "# TYPE kvmini_tpu_disagg_degraded gauge",
                 f"kvmini_tpu_disagg_degraded {s['disagg_degraded']}",
+                # KV bytes the handoff physically copied: the v1 dense
+                # stripe's nbytes per inject; 0 forever on the v2
+                # block-table path — the A/B the ISSUE 16 acceptance
+                # criterion reads straight off this counter
+                "# TYPE kvmini_tpu_kv_handoff_bytes_copied_total counter",
+                "kvmini_tpu_kv_handoff_bytes_copied_total "
+                f"{s['kv_handoff_bytes_copied']}",
             ]
         if "kv_pool_blocks" in s:  # paged layout only
             lines += [
@@ -1460,6 +1469,32 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
                 f"kvmini_tpu_kv_logical_bytes {s['kv_logical_bytes']}",
                 "# TYPE kvmini_tpu_kv_physical_bytes gauge",
                 f"kvmini_tpu_kv_physical_bytes {s['kv_physical_bytes']}",
+                # host-RAM KV tier (docs/TROUBLESHOOTING.md "Host-RAM KV
+                # tier thrash"): demote/promote/hit counters plus the
+                # pool/capacity gauges and the thrash-guard disable flag
+                "# TYPE kvmini_tpu_kv_tier_demotions_total counter",
+                f"kvmini_tpu_kv_tier_demotions_total {s['kv_tier_demotions']}",
+                "# TYPE kvmini_tpu_kv_tier_promotions_total counter",
+                f"kvmini_tpu_kv_tier_promotions_total {s['kv_tier_promotions']}",
+                "# TYPE kvmini_tpu_kv_tier_hits_total counter",
+                f"kvmini_tpu_kv_tier_hits_total {s['kv_tier_hits']}",
+                "# TYPE kvmini_tpu_kv_tier_blocks gauge",
+                f"kvmini_tpu_kv_tier_blocks {s['kv_tier_blocks']}",
+                "# TYPE kvmini_tpu_kv_tier_bytes gauge",
+                f"kvmini_tpu_kv_tier_bytes {s['kv_tier_bytes']}",
+                "# TYPE kvmini_tpu_kv_tier_capacity_bytes gauge",
+                f"kvmini_tpu_kv_tier_capacity_bytes {s['kv_tier_capacity_bytes']}",
+                "# TYPE kvmini_tpu_kv_tier_disabled gauge",
+                f"kvmini_tpu_kv_tier_disabled {s['kv_tier_disabled']}",
+                # cross-replica prefix migration (docs/FLEET.md): what
+                # this replica shipped (/kv/export) and installed
+                # (/kv/import)
+                "# TYPE kvmini_tpu_kv_migrated_blocks_total counter",
+                f"kvmini_tpu_kv_migrated_blocks_total {s['kv_migrated_blocks']}",
+                "# TYPE kvmini_tpu_kv_migrated_bytes_total counter",
+                f"kvmini_tpu_kv_migrated_bytes_total {s['kv_migrated_bytes']}",
+                "# TYPE kvmini_tpu_kv_export_blocks_total counter",
+                f"kvmini_tpu_kv_export_blocks_total {s['kv_export_blocks']}",
             ]
         if "hbm_bytes_in_use" in s:  # device reports memory_stats only
             lines += [
@@ -1616,6 +1651,57 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
                                      status=400)
         return web.json_response({"status": "ok", "armed": spec})
 
+    async def kv_export(request: "web.Request"):
+        """Cross-replica prefix migration, donor side (docs/FLEET.md):
+        {"budget_bytes": N} -> a bounded, root-first wire snapshot of
+        this replica's registered prefix blocks (int8-KV on the wire).
+        The engine walk runs on its scheduler thread; the (possibly
+        slow) rendezvous runs in an executor so the event loop never
+        blocks on a sweep. 400 on dense engines — migration is a paged
+        block-pool operation."""
+        try:
+            body = await request.json()
+        except Exception:
+            body = {}
+        budget = int((body or {}).get("budget_bytes", 16 * 1024 * 1024))
+        loop = asyncio.get_running_loop()
+        try:
+            payload = await loop.run_in_executor(
+                None, engine.kv_export, budget
+            )
+        except ValueError as e:
+            return web.json_response({"error": {"message": str(e)}},
+                                     status=400)
+        except RuntimeError as e:
+            return web.json_response({"error": {"message": str(e)}},
+                                     status=503)
+        return web.json_response(payload)
+
+    async def kv_import(request: "web.Request"):
+        """Cross-replica prefix migration, target side: install a
+        sibling's /kv/export payload into FREE pool blocks (never
+        evicts) and register the keys as retained prefix blocks. 400 on
+        dense engines or geometry mismatches (block_size/leaf shapes)."""
+        try:
+            body = await request.json()
+        except Exception:
+            return web.json_response({"error": {"message": "invalid JSON"}},
+                                     status=400)
+        if not isinstance(body, dict):
+            return web.json_response(
+                {"error": {"message": "body must be an object"}}, status=400
+            )
+        loop = asyncio.get_running_loop()
+        try:
+            res = await loop.run_in_executor(None, engine.kv_import, body)
+        except (ValueError, KeyError) as e:
+            return web.json_response({"error": {"message": str(e)}},
+                                     status=400)
+        except RuntimeError as e:
+            return web.json_response({"error": {"message": str(e)}},
+                                     status=503)
+        return web.json_response(res)
+
     app = web.Application()
     app.router.add_post("/v1/chat/completions", chat)
     app.router.add_get("/v1/models", models)
@@ -1627,6 +1713,8 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
     app.router.add_post("/profile", profile)
     app.router.add_get("/faults", faults_get)
     app.router.add_post("/faults", faults_post)
+    app.router.add_post("/kv/export", kv_export)
+    app.router.add_post("/kv/import", kv_import)
     return app
 
 
@@ -1726,6 +1814,12 @@ def register(parser: argparse.ArgumentParser) -> None:
                         help="Paged-KV pool size in blocks (default "
                              "slots x ceil(max_seq/block), memory-equal to "
                              "dense; set lower to cap KV HBM)")
+    parser.add_argument("--kv-host-tier-bytes", type=int, default=None,
+                        help="Host-RAM KV tier capacity in bytes (paged "
+                             "layout only): retained-LRU evictions demote "
+                             "to host memory and promote back on prefix "
+                             "match; 0/absent disables the tier "
+                             "(docs/TROUBLESHOOTING.md)")
     parser.add_argument("--lora", action="append", default=None,
                         metavar="NAME=PEFT_DIR",
                         help="Load a LoRA adapter (PEFT safetensors dir) "
@@ -1974,6 +2068,7 @@ def run(args: argparse.Namespace) -> int:
         kv_layout=args.kv_layout,
         kv_block_size=args.kv_block_size,
         kv_pool_blocks=args.kv_pool_blocks,
+        kv_host_tier_bytes=args.kv_host_tier_bytes,
         lora_adapters=_parse_lora_args(args.lora),
         lora_demo=args.lora_demo,
         lora_rank=args.lora_rank,

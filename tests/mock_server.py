@@ -390,6 +390,20 @@ def make_app(
         "kvmini_tpu_hbm_peak_bytes": 10e9,
         "kvmini_tpu_hbm_bytes_limit": 16e9,
         "kvmini_tpu_hbm_headroom_estimate_bytes": 12e9,
+        # KV-block economy rail (docs/FLEET.md cross-replica migration +
+        # host-RAM tier): the counters the kv-economy smoke and the
+        # telemetry scrape read without a JAX engine
+        "kvmini_tpu_kv_handoff_bytes_copied_total": 0.0,
+        "kvmini_tpu_kv_tier_demotions_total": 0.0,
+        "kvmini_tpu_kv_tier_promotions_total": 0.0,
+        "kvmini_tpu_kv_tier_hits_total": 0.0,
+        "kvmini_tpu_kv_tier_blocks": 0.0,
+        "kvmini_tpu_kv_tier_bytes": 0.0,
+        "kvmini_tpu_kv_tier_capacity_bytes": 0.0,
+        "kvmini_tpu_kv_tier_disabled": 0.0,
+        "kvmini_tpu_kv_migrated_blocks_total": 0.0,
+        "kvmini_tpu_kv_migrated_bytes_total": 0.0,
+        "kvmini_tpu_kv_export_blocks_total": 0.0,
         # fleet-router placement input (docs/FLEET.md): per-instance
         # overrides let multi-instance tests give each replica a
         # distinct load picture
@@ -457,6 +471,67 @@ def make_app(
         return web.json_response({"status": "ok",
                                   "server_id": server_id or "mock"})
 
+    async def kv_export(request: web.Request) -> web.Response:
+        """Mock donor side of cross-replica KV migration: synthesize one
+        wire block per owned prefix block, derived from this instance's
+        live ``kv_prefix_hit_depth_p50`` gauge — warm replicas ship
+        depth, cold ones ship nothing, no JAX anywhere. Armable fault
+        ``kv_export_fail`` -> 503 (the donor-death-mid-export path)."""
+        if "kv_export_fail" in faults:
+            return web.json_response(
+                {"error": {"message": "injected kv_export_fail"}},
+                status=503,
+            )
+        try:
+            body = await request.json()
+        except Exception:
+            body = {}
+        budget = int((body or {}).get("budget_bytes", 1 << 24))
+        blk = int(pipe["kvmini_tpu_kv_block_size"]) or 1
+        depth = int(pipe["kvmini_tpu_kv_prefix_hit_depth_p50"])
+        n = max(depth // blk, 0)
+        # ~per-block wire cost so budget truncation is exercisable
+        per_block = 1024
+        n = min(n, max(budget // per_block, 0))
+        blocks = [
+            {"key": f"{server_id or 'mock'}-{i:08x}", "depth": i + 1,
+             "kv": {}}
+            for i in range(n)
+        ]
+        pipe["kvmini_tpu_kv_export_blocks_total"] += n
+        return web.json_response({
+            "block_size": blk,
+            "blocks": blocks,
+            "bytes": n * per_block,
+            "truncated": n * per_block + per_block > budget,
+        })
+
+    async def kv_import(request: web.Request) -> web.Response:
+        """Mock target side: installing N blocks of depth D raises this
+        instance's hit-depth gauge to D*block_size — the observable
+        'warm' signal the fleet respawn smoke asserts on."""
+        try:
+            body = await request.json()
+        except Exception:
+            return web.json_response({"error": {"message": "invalid JSON"}},
+                                     status=400)
+        blocks = (body or {}).get("blocks") or []
+        blk = int((body or {}).get(
+            "block_size", pipe["kvmini_tpu_kv_block_size"])) or 1
+        if blocks:
+            depth = max(int(b.get("depth", 0)) for b in blocks)
+            pipe["kvmini_tpu_kv_prefix_hit_depth_p50"] = max(
+                pipe["kvmini_tpu_kv_prefix_hit_depth_p50"],
+                float(depth * blk),
+            )
+        per_block = 1024
+        pipe["kvmini_tpu_kv_migrated_blocks_total"] += len(blocks)
+        pipe["kvmini_tpu_kv_migrated_bytes_total"] += len(blocks) * per_block
+        return web.json_response({
+            "imported": len(blocks), "skipped": 0,
+            "bytes": len(blocks) * per_block, "exhausted": False,
+        })
+
     app = web.Application()
     app.router.add_post("/v1/chat/completions", chat)
     app.router.add_get("/metrics", metrics)
@@ -464,6 +539,8 @@ def make_app(
     app.router.add_get("/healthz", healthz)
     app.router.add_get("/faults", faults_get)
     app.router.add_post("/faults", faults_post)
+    app.router.add_post("/kv/export", kv_export)
+    app.router.add_post("/kv/import", kv_import)
     return app
 
 

@@ -114,10 +114,12 @@ def test_lane_meshes_validation():
 
 
 def test_disagg_composition_validation():
-    """disagg v1 excludes paged KV and prefix_cache, and prefill_mesh
-    needs disagg — all rejected BEFORE any params/cache work."""
-    with pytest.raises(ValueError, match="dense"):
-        Engine(None, CFG, EngineConfig(disagg=True, kv_layout="paged"))
+    """Paged disagg (HANDOFF_VERSION=2) composes — but not with per-lane
+    meshes (one shared block pool); dense prefix_cache and a mesh-less
+    prefill_mesh stay rejected BEFORE any params/cache work."""
+    with pytest.raises(ValueError, match="kv_layout=dense only"):
+        Engine(None, CFG, EngineConfig(disagg=True, kv_layout="paged"),
+               prefill_mesh=object())
     with pytest.raises(ValueError, match="prefix_cache"):
         Engine(None, CFG, EngineConfig(disagg=True, prefix_cache=True))
     with pytest.raises(ValueError, match="disagg=True"):
@@ -142,11 +144,21 @@ def test_multihost_rejects_disagg():
 
 
 def test_handoff_protocol_fields_and_version():
-    from kserve_vllm_mini_tpu.runtime.disagg import HANDOFF_VERSION, KVHandoff
+    from kserve_vllm_mini_tpu.runtime.disagg import (
+        DENSE_HANDOFF_VERSION,
+        HANDOFF_VERSION,
+        KVHandoff,
+    )
 
-    ho = KVHandoff(version=HANDOFF_VERSION, request_id="r1", handle=None,
-                   n_tokens=100, n_blocks=2, reused_prefix_tokens=0)
-    assert ho.version == 1  # bump = layout change; consume refuses drift
+    # two wire formats, one constant each: a paged consumer expects
+    # exactly v2 (block-table, zero-copy), a dense consumer exactly v1
+    # (staged stripe). A bump = layout change; consume refuses drift.
+    assert HANDOFF_VERSION == 2
+    assert DENSE_HANDOFF_VERSION == 1
+    ho = KVHandoff(version=DENSE_HANDOFF_VERSION, request_id="r1",
+                   handle=None, n_tokens=100, n_blocks=2,
+                   reused_prefix_tokens=0)
+    assert ho.version == 1
     assert not ho.dropped and ho.kv is None
 
 
@@ -770,3 +782,150 @@ def test_mixed_workload_ttft_and_itl_better_with_disagg():
         f"ITL p95 with disagg ({itl_on:.1f} ms) not better than "
         f"colocated ({itl_off:.1f} ms)"
     )
+
+
+# -- v2 paged handoff: orphan quarantine + version negotiation (fast) ---------
+
+
+def _paged_harness(slots=2, blk=16):
+    """The dense _harness furnished with just enough paged-pool state
+    for the route/abort/orphan bookkeeping paths (no device arrays)."""
+    from collections import OrderedDict
+
+    import numpy as np
+
+    eng = _harness(slots)
+    eng.ecfg = EngineConfig(max_slots=slots, max_seq_len=64,
+                            kv_layout="paged", kv_block_size=blk)
+    eng.paged = True
+    eng._blk = blk
+    eng._maxb = 4
+    eng._scratch_block = 8
+    eng._block_table = np.full((slots, 4), 8, np.int32)
+    eng._table_dev = None
+    eng._slot_blocks = [[] for _ in range(slots)]
+    eng._free_blocks = [2, 3, 4, 5, 6, 7]
+    eng._orphan_blocks = {}
+    eng._block_rc = {}
+    eng._block_hash = {}
+    eng._block_depth = {}
+    eng._retained_lru = OrderedDict()
+    return eng
+
+
+def test_paged_abort_quarantines_blocks_until_payload_lands():
+    """A paged-v2 slot aborted while its prompt is on the lane must NOT
+    free its blocks — the lane may still have writes in flight against
+    them. They quarantine in _orphan_blocks and return to the pool only
+    when the lane's payload (or tombstone) lands (_reap_orphans)."""
+    eng = _paged_harness()
+    h = _route(eng, 0)
+    eng._slot_blocks[0] = [0, 1]
+    eng._block_rc.update({0: 1, 1: 1})
+    eng._abort_handoff(0, "stop")
+    # quarantined, not freed: a reallocation here could race lane stores
+    assert eng._orphan_blocks == {id(h): [0, 1]}
+    assert 0 not in eng._free_blocks and 1 not in eng._free_blocks
+    assert eng._slot_blocks[0] == [] and 0 in eng._free
+    # the payload lands later (consume identity check) -> blocks free
+    eng._reap_orphans(h)
+    assert eng._orphan_blocks == {}
+    assert 0 in eng._free_blocks and 1 in eng._free_blocks
+
+
+def test_version_negotiation_paged_refuses_v1_stripe():
+    """A paged consumer speaks exactly HANDOFF_VERSION=2: a v1 dense
+    stripe walks the drop ladder (counted, degrade-run bumped) and the
+    slot's quarantined blocks reap — never a mis-shaped injection."""
+    from kserve_vllm_mini_tpu.runtime.disagg import (
+        DENSE_HANDOFF_VERSION,
+        KVHandoff,
+        PrefillLane,
+    )
+
+    eng = _paged_harness()
+    eng.stats.update({"kv_handoffs": 0, "kv_handoff_blocks": 0,
+                      "kv_handoff_wait_s": 0.0, "kv_handoff_drops": 0,
+                      "kv_handoff_bytes_copied": 0,
+                      "prefill_lane_busy_s": 0.0,
+                      "disagg_colocated_fallbacks": 0})
+    lane = PrefillLane({}, CFG, eng.ecfg)
+    eng._disagg = lane
+    h = _route(eng, 0)
+    eng._slot_blocks[0] = [0, 1]
+    eng._block_rc.update({0: 1, 1: 1})
+    # cancelled too, so the fallback takes the lightweight abort path
+    # (the negotiation + reap bookkeeping is what's under test here)
+    h.cancelled = "stop"
+    ho = KVHandoff(version=DENSE_HANDOFF_VERSION, request_id="r1",
+                   handle=h, n_tokens=3, n_blocks=1, busy_s=0.25,
+                   kv={}, logits=None)
+    ho.t_enqueued = time.time()
+    with lane._lock:
+        lane._inflight += 1
+    lane._ready.put(ho)
+    eng._consume_handoffs()
+    assert eng.stats["kv_handoff_drops"] == 1
+    assert eng.stats["kv_handoffs"] == 0
+    assert eng.stats["kv_handoff_bytes_copied"] == 0  # never injected
+    assert eng._disagg_drop_run == 1
+    # abort quarantined the blocks; the very payload that proved the
+    # lane finished also reaped them back to the pool
+    assert eng._orphan_blocks == {}
+    assert 0 in eng._free_blocks and 1 in eng._free_blocks
+    assert eng._slot_handoff[0] is None and 0 in eng._free
+
+
+# -- the v2 acceptance A/B: zero-copy paged handoff (slow) --------------------
+
+
+@pytest.mark.slow
+def test_paged_handoff_zero_copy_byte_identical(params):
+    """The ISSUE 16 tentpole acceptance: at the PR13 mixed config, the
+    paged v2 block-table handoff copies <= 10% of the v1 dense stripe's
+    KV bytes (it copies ZERO — the lane prefills directly into the
+    slot's pool blocks) while greedy streams stay byte-identical. The
+    copy tax is measured, not asserted by construction:
+    kv_handoff_bytes_copied counts the consume-side inject volume."""
+    cfg = get_config("llama-tiny", max_seq_len=2048).scaled(
+        d_model=256, n_heads=8, n_kv_heads=4, n_layers=4, d_ff=1024,
+    )
+    big_params = init_params(jax.random.PRNGKey(0), cfg)
+    prompts = [
+        [(17 * i + 1) % (cfg.vocab_size // 2) for i in range(2000)],
+        [(11 * i + 3) % (cfg.vocab_size // 2) for i in range(700)],
+        [9, 4, 7, 1],  # below disagg_min_prompt: colocated either way
+    ]
+
+    def run(layout):
+        eng = Engine(
+            big_params, cfg,
+            EngineConfig(max_slots=8, max_seq_len=2048,
+                         max_prefill_len=1024, min_prefill_bucket=16,
+                         disagg=True, disagg_min_prompt=64,
+                         kv_layout=layout),
+        )
+        eng.start()
+        try:
+            outs = []
+            for p in prompts:
+                h = eng.submit(GenRequest(prompt_tokens=list(p),
+                                          max_new_tokens=8))
+                toks, info = _drain(h)
+                assert info["finish_reason"] == "length"
+                outs.append(toks)
+            return outs, eng.snapshot_stats()
+        finally:
+            eng.stop()
+
+    v1_streams, s_v1 = run("dense")
+    v2_streams, s_v2 = run("paged")
+    assert v1_streams == v2_streams  # byte-identical greedy either way
+    assert s_v1["kv_handoffs"] == 2 and s_v2["kv_handoffs"] == 2
+    assert s_v1["kv_handoff_drops"] == 0 and s_v2["kv_handoff_drops"] == 0
+    # the tentpole: v1 injects the full staged stripe per handoff; v2
+    # moves block IDs only
+    assert s_v1["kv_handoff_bytes_copied"] > 0
+    assert (s_v2["kv_handoff_bytes_copied"]
+            <= 0.10 * s_v1["kv_handoff_bytes_copied"])
+    assert s_v2["kv_handoff_bytes_copied"] == 0

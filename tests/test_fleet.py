@@ -44,6 +44,7 @@ from kserve_vllm_mini_tpu.fleet.router import (
 from kserve_vllm_mini_tpu.fleet.supervisor import (
     FleetSupervisor,
     mock_replica_cmd,
+    select_donor,
     serve_replica_cmd,
 )
 
@@ -375,6 +376,131 @@ def test_supervisor_scale_and_deliberate_removal_not_resurrected():
         assert c["live"] == 1
         assert c["restarts"] == 0
         assert c["scale_downs"] == 2
+    finally:
+        sup.stop()
+
+
+# -- warm-from-sibling prefix migration (docs/FLEET.md) -----------------------
+
+
+def test_select_donor_deepest_healthy_owner_wins():
+    """Donor ranking under churn: the deepest-owning HEALTHY sibling
+    wins; the target itself, unhealthy replicas, and depth-0 (just-
+    respawned, purged-from-index) replicas never donate."""
+    owners = {"r0": 8, "r1": 64, "r2": 32}
+    cands = [("r0", "u0", True), ("r1", "u1", True), ("r2", "u2", True)]
+    assert select_donor(owners, cands, exclude="r9") == ("r1", "u1")
+    # the target never donates to itself, even as the deepest owner
+    assert select_donor(owners, cands, exclude="r1") == ("r2", "u2")
+    # unhealthy replicas never donate, whatever they own
+    sick = [("r0", "u0", True), ("r1", "u1", False), ("r2", "u2", False)]
+    assert select_donor(owners, sick, exclude="r9") == ("r0", "u0")
+    # depth 0 = cold itself: migrating from it would ship nothing
+    assert select_donor({"r0": 0}, [("r0", "u0", True)], "r9") is None
+    assert select_donor({}, cands, "r9") is None
+    # an owner that died between the index scrape and selection is
+    # simply absent from candidates — cold spawn, not a crash
+    assert select_donor({"gone": 99}, [], "r9") is None
+
+
+WARM_DEPTH = 32.0  # donor's scripted hit-depth: 8 blocks x block_size 4
+
+
+def _hit_depth(url: str) -> float:
+    metrics = parse_prometheus_text(_get_text(url, "/metrics"))
+    return metrics.get("kvmini_tpu_kv_prefix_hit_depth_p50", 0.0)
+
+
+def _warm_fleet(**sup_kw) -> FleetSupervisor:
+    """2-replica mock fleet: r0 scripted warm (hit-depth 32), r1
+    scripted cold (0) — so a respawned r1's gauge moves ONLY if the
+    supervisor's export->import migration actually ran."""
+    return _mock_fleet(
+        2,
+        metrics_per_replica=[
+            {"kvmini_tpu_kv_prefix_hit_depth_p50": WARM_DEPTH},
+            {"kvmini_tpu_kv_prefix_hit_depth_p50": 0.0},
+        ],
+        **sup_kw,
+    )
+
+
+def _wait_respawned(sup: FleetSupervisor, rid: str, pred,
+                    timeout_s: float = 30.0):
+    """Poll until the replica's view is READY again AND the counters
+    satisfy ``pred`` (restarts moves at respawn START; state flips ready
+    only after _wait_ready, so gating on both avoids scrape races)."""
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        c = sup.counters()
+        state = next((r["state"] for r in sup.replicas()
+                      if r["rid"] == rid), None)
+        if state == "ready" and pred(c):
+            return c
+        time.sleep(0.2)
+    return sup.counters()
+
+
+def test_respawn_warms_from_sibling_and_hit_depth_recovers():
+    """The fleet-respawn acceptance A/B, warm side: kill the cold
+    replica; the watchdog respawns it and the warm step replays the
+    donor's /kv/export chain into /kv/import — the respawned replica's
+    FIRST scrape already reads hit-depth >= 50% of the donor's pre-kill
+    depth (here the full chain), instead of the ~0 a cold spawn reads."""
+    sup = _warm_fleet(owners_fn=lambda: {"r0": 4096})
+    # armed AFTER start(): the counters below cover the respawn only,
+    # not the initial scale-up warms
+    sup.warm_from_siblings = True
+    try:
+        assert sup.kill_replica("r1")
+        c = _wait_respawned(
+            sup, "r1", lambda c: c["warmed"] + c["warm_failures"] >= 1)
+        assert c["warmed"] == 1 and c["warm_failures"] == 0
+        assert c["restarts"] == 1
+        url = next(r["url"] for r in sup.replicas() if r["rid"] == "r1")
+        assert _hit_depth(url) >= 0.5 * WARM_DEPTH
+    finally:
+        sup.stop()
+
+
+def test_respawn_without_migration_stays_cold():
+    """The A/B baseline: same fleet, warm_from_siblings off — the
+    respawned replica's first scrape window reads hit-depth 0."""
+    sup = _warm_fleet()
+    try:
+        assert sup.kill_replica("r1")
+        c = _wait_respawned(sup, "r1", lambda c: c["restarts"] >= 1)
+        assert c["restarts"] >= 1 and c["warmed"] == 0
+        url = next(r["url"] for r in sup.replicas() if r["rid"] == "r1")
+        assert _hit_depth(url) == 0.0
+    finally:
+        sup.stop()
+
+
+def test_donor_death_mid_export_degrades_to_cold_spawn():
+    """Best-effort contract: a donor that 503s mid-export (armed
+    ``kv_export_fail``) counts a warm_failure and the replica starts
+    cold — and the watchdog is NOT wedged: a second kill self-heals
+    again through the same path."""
+    sup = _warm_fleet(owners_fn=lambda: {"r0": 4096})
+    sup.warm_from_siblings = True
+    try:
+        donor_url = next(r["url"] for r in sup.replicas()
+                         if r["rid"] == "r0")
+        status, _ = _post(donor_url, "/faults",
+                          {"action": "arm", "name": "kv_export_fail"})
+        assert status == 200
+        assert sup.kill_replica("r1")
+        c = _wait_respawned(sup, "r1", lambda c: c["warm_failures"] >= 1)
+        assert c["warm_failures"] == 1 and c["warmed"] == 0
+        url = next(r["url"] for r in sup.replicas() if r["rid"] == "r1")
+        assert _hit_depth(url) == 0.0  # cold, but healthy and serving
+        status, _ = _chat(url, "post-failure liveness")
+        assert status == 200
+        # the watchdog survived the failed warm: kill again, heal again
+        assert sup.kill_replica("r1")
+        c = _wait_respawned(sup, "r1", lambda c: c["restarts"] >= 2)
+        assert c["restarts"] >= 2
     finally:
         sup.stop()
 

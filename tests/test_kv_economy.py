@@ -1,0 +1,205 @@
+"""KV-block economy acceptance smoke (`make kv-economy-smoke`):
+docs/DISAGGREGATION.md v2 zero-copy handoff counters, docs/FLEET.md
+warm-from-sibling prefix migration, docs/TROUBLESHOOTING.md host-RAM
+tier — against subprocess mock replicas (tests/mock_server.py CLI),
+no engine, no TPU.
+
+Two gates:
+
+1. A mock fleet respawn warms the new replica from its deepest-owning
+   sibling over the REAL wire — the supervisor ranks donors via
+   ``GET <router>/fleet -> kv_owners`` (HTTP, not an in-process
+   shortcut) and replays ``POST /kv/export -> /kv/import`` — and the
+   hit-depth gauge recovers in the first scrape window, with the
+   migration counters visible through the router's aggregated
+   ``/metrics``.
+2. The scraped counters land as schema-valid Results blocks: the
+   ``kv_cache`` block (tier + migration keys) passes validate_kv_cache
+   and the ``disagg`` block carries ``handoff_bytes_copied`` (0 on the
+   paged zero-copy path).
+
+The donor-selection corner cases and the warm/cold A/B pins live in
+tests/test_fleet.py; this module is the end-to-end smoke CI wires in
+beside fleet-smoke.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.request
+
+from kserve_vllm_mini_tpu.analysis.telemetry import (
+    disagg_block,
+    kv_cache_block,
+    parse_prometheus_text,
+)
+from kserve_vllm_mini_tpu.core.schema import validate_kv_cache
+from kserve_vllm_mini_tpu.fleet.router import (
+    FleetRouter,
+    RouterConfig,
+    start_router,
+)
+from kserve_vllm_mini_tpu.fleet.supervisor import (
+    FleetSupervisor,
+    mock_replica_cmd,
+)
+
+DONOR_DEPTH = 32.0  # 8 blocks x block_size 4 on the mock's gauges
+
+
+def _get_json(url: str, path: str, timeout: float = 10.0) -> dict:
+    with urllib.request.urlopen(url + path, timeout=timeout) as r:
+        return json.loads(r.read().decode())
+
+
+def _post_json(url: str, path: str, body: dict, timeout: float = 15.0):
+    req = urllib.request.Request(
+        url + path, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def _scrape(url: str) -> dict[str, float]:
+    with urllib.request.urlopen(url + "/metrics", timeout=10.0) as r:
+        return parse_prometheus_text(r.read().decode())
+
+
+def _fleet(n: int, metrics_per_replica: list[dict] | None = None,
+           **sup_kw) -> FleetSupervisor:
+    base = mock_replica_cmd()
+
+    def cmd(port: int, rid: str):
+        argv, env = base(port, rid)
+        if metrics_per_replica:
+            idx = int(rid[1:]) % len(metrics_per_replica)
+            if metrics_per_replica[idx]:
+                argv += ["--metrics-json",
+                         json.dumps(metrics_per_replica[idx])]
+        return argv, env
+
+    sup = FleetSupervisor(replica_cmd=cmd, ready_timeout_s=60.0, **sup_kw)
+    sup.start(n)
+    return sup
+
+
+def _replica_url(sup: FleetSupervisor, rid: str) -> str:
+    return next(r["url"] for r in sup.replicas() if r["rid"] == rid)
+
+
+def test_respawn_warm_migration_end_to_end_over_router_wire():
+    """Respawn -> warm-from-sibling -> hit-depth recovery, with the
+    donor ranking flowing over the router's real HTTP surface."""
+    sup = _fleet(
+        2,
+        metrics_per_replica=[
+            {"kvmini_tpu_kv_prefix_hit_depth_p50": DONOR_DEPTH},
+            {"kvmini_tpu_kv_prefix_hit_depth_p50": 0.0,
+             "kvmini_tpu_kv_prefix_hit_depth_p95": 0.0},
+        ],
+    )
+    router = FleetRouter(supervisor=sup,
+                         cfg=RouterConfig(scrape_interval_s=0.2))
+    handle = start_router(router)
+    try:
+        # seed the router's ownership index for r0 (the index-population
+        # path itself is pinned by tests/test_fleet.py's prefix-index and
+        # live A/B tests; this smoke is about the migration wire)
+        router._prefix.record("shared-corpus " * 16, "r0")
+        owners = _get_json(handle.url, "/fleet")["kv_owners"]
+        assert owners.get("r0", 0) > 0  # the wire the supervisor reads
+        # arm migration AFTER start so counters cover the respawn only
+        sup.router_url = handle.url
+        sup.warm_from_siblings = True
+
+        assert sup.kill_replica("r1")
+        deadline = time.time() + 30.0
+        while time.time() < deadline:
+            c = sup.counters()
+            state = next((r["state"] for r in sup.replicas()
+                          if r["rid"] == "r1"), None)
+            if state == "ready" and c["warmed"] + c["warm_failures"] >= 1:
+                break
+            time.sleep(0.2)
+        c = sup.counters()
+        assert c["warmed"] == 1 and c["warm_failures"] == 0, c
+        assert c["restarts"] == 1
+
+        # first scrape window: the respawned replica reads warm, and the
+        # migration counters moved on both ends of the wire
+        warmed = _scrape(_replica_url(sup, "r1"))
+        assert (warmed["kvmini_tpu_kv_prefix_hit_depth_p50"]
+                >= 0.5 * DONOR_DEPTH)
+        assert warmed["kvmini_tpu_kv_migrated_blocks_total"] > 0
+        assert warmed["kvmini_tpu_kv_migrated_bytes_total"] > 0
+        donor = _scrape(_replica_url(sup, "r0"))
+        assert donor["kvmini_tpu_kv_export_blocks_total"] > 0
+
+        # the fleet rail: the router's aggregated exposition sums the
+        # migration counters across replicas (dashboards/fleet.json)
+        deadline = time.time() + 10.0
+        while time.time() < deadline:  # let the scoreboard re-scrape
+            agg = _scrape(handle.url)
+            if agg.get("kvmini_tpu_kv_migrated_blocks_total", 0) > 0:
+                break
+            time.sleep(0.3)
+        assert agg["kvmini_tpu_kv_migrated_blocks_total"] > 0
+        assert agg["kvmini_tpu_kv_export_blocks_total"] > 0
+    finally:
+        handle.stop()
+        sup.stop()
+
+
+def test_results_blocks_schema_valid_with_economy_counters():
+    """The scraped Results blocks carry the new rail: kv_cache (tier +
+    migration keys) validates clean, and the disagg block reads 0
+    handoff bytes copied — the paged zero-copy signature — while the
+    dense-stripe counter stays available for v1 engines."""
+    sup = _fleet(1, metrics_per_replica=[{
+        # disagg rail: an active paged v2 lane — handoffs happened,
+        # zero KV bytes crossed (docs/DISAGGREGATION.md v2 payload row)
+        "kvmini_tpu_kv_handoffs_total": 2.0,
+        "kvmini_tpu_kv_handoff_blocks_total": 8.0,
+        "kvmini_tpu_kv_handoff_wait_seconds_total": 0.01,
+        "kvmini_tpu_kv_handoff_drops_total": 0.0,
+        "kvmini_tpu_prefill_lane_busy_seconds_total": 0.5,
+        "kvmini_tpu_disagg_colocated_fallbacks_total": 0.0,
+        "kvmini_tpu_kv_handoff_queue_depth": 0.0,
+        "kvmini_tpu_disagg_degraded": 0.0,
+        # host-RAM tier rail (docs/TROUBLESHOOTING.md)
+        "kvmini_tpu_kv_tier_demotions_total": 3.0,
+        "kvmini_tpu_kv_tier_promotions_total": 2.0,
+        "kvmini_tpu_kv_tier_hits_total": 1.0,
+        "kvmini_tpu_kv_tier_blocks": 1.0,
+        "kvmini_tpu_kv_tier_bytes": 512.0,
+        "kvmini_tpu_kv_tier_capacity_bytes": 4096.0,
+    }])
+    try:
+        url = _replica_url(sup, "r0")
+        # move the migration counters over the real wire (depths <= 2
+        # keep the mock's hit-depth gauges consistent: p50 stays 8)
+        status, res = _post_json(url, "/kv/import", {
+            "block_size": 4,
+            "blocks": [{"key": "k1", "depth": 1, "kv": {}},
+                       {"key": "k2", "depth": 2, "kv": {}}],
+        })
+        assert status == 200 and res["imported"] == 2
+
+        out = kv_cache_block(url)
+        kv = out["kv_cache"]
+        assert validate_kv_cache(kv) == []
+        assert kv["tier_demotions"] == 3.0
+        assert kv["tier_promotions"] == 2.0
+        assert kv["tier_capacity_bytes"] == 4096.0
+        assert kv["tier_disabled"] == 0.0
+        assert kv["migrated_blocks"] == 2.0
+        assert kv["migrated_bytes"] > 0
+
+        dg = disagg_block(url)["disagg"]
+        assert dg["handoffs"] == 2.0
+        assert dg["handoff_bytes_copied"] == 0.0  # zero-copy signature
+        assert dg["source"] == "metrics:scrape"
+    finally:
+        sup.stop()

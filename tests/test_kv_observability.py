@@ -55,6 +55,18 @@ def _harness(prefix_cache=True, pool=POOL):
     eng._block_rc = {}
     eng._prefix_epoch = 0
     eng._retained_lru = OrderedDict()
+    eng._block_depth = {}
+    # in-transit handoff state: _kv_admin_snapshot excludes routed-not-
+    # yet-consumed blocks from the fragmentation denominator
+    eng._slot_handoff = [None] * SLOTS
+    eng._orphan_blocks = {}
+    # host-RAM KV tier (ISSUE 16): off by default in the harness
+    eng._tier = OrderedDict()
+    eng._tier_bytes = 0
+    eng._tier_cap_bytes = 0
+    eng._tier_disabled = False
+    eng._tier_thrash_win = (0.0, 0)
+    eng._tier_thrash_hits = 0
     eng._slot_tokens = [[] for _ in range(SLOTS)]
     eng._slot_len = [0] * SLOTS
     eng._hit_depths = deque(maxlen=4096)
@@ -298,6 +310,28 @@ def test_validate_kv_cache_accepts_good_block():
     assert validate_kv_cache(_good_kv_block()) == []
 
 
+def test_validate_kv_cache_tier_and_migration_keys():
+    """ISSUE 16 optional keys: the tier/migration counters validate as
+    non-negative numbers, tier_disabled is a 0/1 gauge (fraction-style
+    bound), and none of them are required (pre-tier blocks stay valid)."""
+    doc = _good_kv_block()
+    doc.update(
+        tier_demotions=3, tier_promotions=2, tier_hits=1, tier_blocks=2,
+        tier_bytes=1024, tier_capacity_bytes=4096, tier_disabled=0,
+        migrated_blocks=5, migrated_bytes=2560, export_blocks=5,
+    )
+    assert validate_kv_cache(doc) == []
+    for mutate, fragment in [
+        (lambda d: d.update(tier_demotions=-1), "tier_demotions"),
+        (lambda d: d.update(tier_disabled=2), "tier_disabled above 1"),
+        (lambda d: d.update(migrated_bytes="x"), "migrated_bytes"),
+    ]:
+        bad = _good_kv_block()
+        mutate(bad)
+        errs = validate_kv_cache(bad)
+        assert any(fragment in e for e in errs), (fragment, errs)
+
+
 def test_validate_kv_cache_rejects_violations():
     assert validate_kv_cache(None) == ["kv_cache block is not an object"]
     for mutate, fragment in [
@@ -448,3 +482,163 @@ def test_hbm_watermark_high_level_triggered():
     assert det2.observe(_sample(
         1, runtime={"hbm_bytes_in_use": 15e9, "hbm_bytes_limit": 0.0}
     )) == []
+
+
+# -- in-transit handoff blocks vs fragmentation (ISSUE 16, satellite) ---------
+
+def test_fragmentation_excludes_in_transit_handoff_blocks():
+    """A routed-not-yet-consumed v2 slot owns blocks with ZERO live
+    tokens (the lane is still writing them). Counting them in the
+    fragmentation denominator would read the handoff window as waste:
+    hand-computed, slot 0 settled with 9 live tokens over 4 blocks and
+    slot 1 in transit with 2 blocks -> fragmentation stays 1 - 9/16,
+    not 1 - 9/24. Occupancy still counts ALL used blocks honestly."""
+    eng = _harness()
+    eng._paged_admit_blocks(0, _req())
+    eng._slot_tokens[0] = list(PROMPT)
+    eng._slot_len[0] = len(PROMPT)
+    # slot 1: routed to the lane — blocks allocated, handoff pending
+    eng._slot_blocks[1] = [eng._paged_alloc(), eng._paged_alloc()]
+    for bid in eng._slot_blocks[1]:
+        eng._block_rc[bid] = 1
+    eng._slot_handoff[1] = {"handle": object(), "t_route": 0.0}
+    kv = eng._kv_admin_snapshot()
+    assert kv["kv_used_blocks"] == 6
+    assert kv["kv_occupancy"] == 6 / 8
+    assert kv["kv_fragmentation"] == 1.0 - 9 / 16  # settled blocks only
+    # consume lands: the same blocks now count (still 0 live tokens
+    # until activation, but they are no longer in transit)
+    eng._slot_handoff[1] = None
+    kv2 = eng._kv_admin_snapshot()
+    assert kv2["kv_fragmentation"] == 1.0 - 9 / 24
+
+
+# -- host-RAM KV tier (ISSUE 16) ----------------------------------------------
+
+def _tier_harness(cap=4096):
+    """_harness plus an armed tier with stubbed device I/O: demotion
+    'reads' a block as a tagged dict, promotion records its uploads."""
+    eng = _harness()
+    eng._tier_cap_bytes = cap
+    eng.stats.update({"kv_tier_demotions": 0, "kv_tier_promotions": 0,
+                      "kv_tier_hits": 0})
+    writes = []
+    eng._tier_block_bytes = lambda: 128
+    eng._read_block_host = lambda bid: {"from_bid": bid}
+    eng._write_block_dev = lambda bid, leaves: writes.append((bid, leaves))
+    return eng, writes
+
+
+def test_tier_demote_on_eviction_promote_on_readmission():
+    """The tier round trip with hand-computed ids: retained blocks
+    evicted under pool pressure land in the tier (content-keyed, byte
+    accounting exact), and a re-admission of the same prompt promotes
+    the contiguous chain back into its fresh blocks — reuse depth
+    identical to a device-resident hit, one hit counted."""
+    eng, writes = _tier_harness()
+    assert eng._paged_admit_blocks(0, _req()) == 0
+    eng._slot_tokens[0] = list(PROMPT)
+    eng._slot_len[0] = len(PROMPT)
+    eng._paged_release(0)  # 2 prompt blocks retained: LRU [6 (leaf), 7]
+    eng._free_blocks = []
+    eng._paged_alloc()  # evicts 6 -> demoted
+    eng._paged_alloc()  # evicts 7 -> demoted
+    assert eng.stats["kv_retained_evictions"] == 2
+    assert eng.stats["kv_tier_demotions"] == 2
+    assert len(eng._tier) == 2 and eng._tier_bytes == 256
+    assert [e["kv"]["from_bid"] for e in eng._tier.values()] == [6, 7]
+    # re-admission: no device-resident prefix left, but the tier holds
+    # the whole chain — promotion uploads root-first into fresh blocks
+    eng._free_blocks = [0, 1, 2, 3]
+    off = eng._paged_admit_blocks(1, _req())
+    assert off == 2 * BLK  # same reuse depth a device hit would give
+    assert eng.stats["kv_tier_promotions"] == 2
+    assert eng.stats["kv_tier_hits"] == 1
+    assert eng.stats["prefix_tokens_reused"] == 2 * BLK
+    # root (depth 1, was block 7) lands in the chain's first fresh block
+    assert [w[1]["from_bid"] for w in writes] == [7, 6]
+    assert len(eng._tier) == 0 and eng._tier_bytes == 0  # entries moved
+
+
+def test_tier_capacity_bound_evicts_oldest_demotion():
+    """A tier at capacity makes room oldest-first, and a tier smaller
+    than one block stays empty instead of thrashing on every eviction."""
+    eng, _ = _tier_harness(cap=256)  # exactly 2 stub blocks
+    for i, key in enumerate((b"a", b"b", b"c")):
+        eng._tier_demote(i, key, i + 1)
+    assert list(eng._tier) == [b"b", b"c"]  # b"a" was the oldest
+    assert eng._tier_bytes == 256
+    tiny, _ = _tier_harness(cap=64)  # under one block
+    tiny._tier_demote(0, b"x", 1)
+    assert len(tiny._tier) == 0 and tiny._tier_bytes == 0
+
+
+def test_tier_thrash_guard_disables_sticky_and_clears():
+    """Sustained eviction churn at the monitor's kv_thrash thresholds
+    (>= 4/s over 3 consecutive windows) disables the tier for the rest
+    of the run: entries drop, the gauge flips, demotion and promotion
+    both refuse — moving thrash onto PCIe is worse than none."""
+    import time as time_mod
+
+    eng, _ = _tier_harness()
+    eng._tier_demote(5, b"seed", 1)
+    assert len(eng._tier) == 1
+    for _ in range(3):
+        _, ev0 = eng._tier_thrash_win
+        eng._tier_thrash_win = (time_mod.time() - 1.1, ev0)
+        eng.stats["kv_retained_evictions"] = ev0 + 11  # ~10/s >> 4/s
+        eng._tier_thrash_tick()
+    assert eng._tier_disabled
+    assert len(eng._tier) == 0 and eng._tier_bytes == 0
+    # sticky: the eviction path stops demoting from here on
+    eng._free_blocks = []
+    eng._retained_lru[6] = None
+    eng._block_rc[6] = 0
+    eng._block_hash[6] = b"late"
+    eng._hash_block[b"late"] = 6
+    demos = eng.stats["kv_tier_demotions"]
+    assert eng._paged_alloc() == 6  # evicted outright, not demoted
+    assert eng.stats["kv_tier_demotions"] == demos
+    assert len(eng._tier) == 0
+    epoch = eng._prefix_epoch
+    eng._tier_thrash_tick()  # no-op once disabled
+    assert eng._prefix_epoch == epoch
+    kv = eng._kv_admin_snapshot()
+    assert kv["kv_tier_disabled"] == 1
+    assert kv["kv_tier_blocks"] == 0 and kv["kv_tier_bytes"] == 0
+
+
+def test_tier_quiet_churn_never_disables():
+    import time as time_mod
+
+    eng, _ = _tier_harness()
+    for _ in range(5):
+        _, ev0 = eng._tier_thrash_win
+        eng._tier_thrash_win = (time_mod.time() - 1.1, ev0)
+        eng.stats["kv_retained_evictions"] = ev0 + 2  # ~2/s < 4/s
+        eng._tier_thrash_tick()
+    assert not eng._tier_disabled
+
+
+def test_host_tier_pricing_never_touches_hbm_estimate():
+    """profiling/headroom.py companion math: one demoted block of the
+    harness config costs 2*L*KVH*BLK*D*4 = 512 host bytes (the same
+    kv_elem_bytes price as HBM, applied to host RAM), the capacity
+    helper floors, and estimate_serving_bytes has NO tier parameter at
+    all — the tier can never inflate the HBM admission estimate."""
+    import inspect
+
+    from kserve_vllm_mini_tpu.profiling.headroom import (
+        estimate_serving_bytes,
+        host_tier_block_bytes,
+        host_tier_capacity_blocks,
+    )
+
+    cfg = SimpleNamespace(n_layers=2, n_kv_heads=2, head_dim=4,
+                          jnp_dtype=np.dtype("float32"))
+    assert host_tier_block_bytes(cfg, BLK) == 512
+    assert host_tier_capacity_blocks(4096, cfg, BLK) == 8
+    assert host_tier_capacity_blocks(511, cfg, BLK) == 0
+    assert host_tier_capacity_blocks(None, cfg, BLK) == 0
+    params = inspect.signature(estimate_serving_bytes).parameters
+    assert not any("tier" in name for name in params)

@@ -183,3 +183,40 @@ def test_prefix_off_keeps_plain_allocator(params):
     st = eng.snapshot_stats()
     assert st["kv_free_blocks"] == st["kv_pool_blocks"]
     assert st["kv_retained_blocks"] == 0
+
+
+def test_host_tier_ab_recovers_evicted_prefix(params):
+    """The host-RAM tier acceptance A/B (docs/TROUBLESHOOTING.md "Host-
+    RAM KV tier thrash"): the same pressure workload as the eviction
+    test — A, interloper B (forces A's leaf block out of the 4-block
+    pool), repeat A. Tier OFF loses the leaf for good (the repeat
+    reuses only the surviving root block); tier ON demotes it to host
+    RAM at eviction and promotes it back at re-admission — strictly
+    more tokens reused, byte-identical output either way, and the
+    demote/promote/hit counters all move."""
+    def run(tier_bytes):
+        eng = Engine(params, CFG, EngineConfig(
+            max_slots=2, max_seq_len=128, kv_layout="paged",
+            kv_block_size=BLK, kv_pool_blocks=4, prefix_cache=True,
+            min_prefill_bucket=16, kv_host_tier_bytes=tier_bytes,
+        ))
+        eng.start()
+        try:
+            a1 = _drain(eng.submit(_req(PROMPT)))
+            _drain(eng.submit(_req([7] * 37)))
+            a2 = _drain(eng.submit(_req(PROMPT)))
+        finally:
+            eng.stop()
+        assert a2 == a1  # correctness over cache, both arms
+        return dict(eng.stats)
+
+    cold = run(0)
+    warm = run(8 << 20)
+    # the tier recovered exactly the evicted leaf block on the repeat
+    assert warm["prefix_tokens_reused"] > cold["prefix_tokens_reused"]
+    assert warm["prefix_tokens_reused"] == cold["prefix_tokens_reused"] + BLK
+    assert warm["kv_tier_demotions"] >= 1
+    assert warm["kv_tier_promotions"] >= 1
+    assert warm["kv_tier_hits"] >= 1
+    assert cold.get("kv_tier_demotions", 0) == 0
+    assert cold.get("kv_tier_promotions", 0) == 0
