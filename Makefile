@@ -2,7 +2,7 @@
 # of native code — the TPU compute path is JAX/XLA compiled at runtime.
 PY ?= python
 
-.PHONY: help test test-fast test-policy lint lint-invariants lint-changed fmt smoke bench bench-smoke bench-proxy-smoke chaos-smoke fleet-smoke kv-economy-smoke trajectory dashboards-validate helm-lint airgap clean
+.PHONY: help test test-fast test-policy lint lint-invariants lint-changed fmt smoke bench bench-smoke bench-proxy-smoke chaos-smoke fleet-smoke kv-economy-smoke econ-smoke trajectory dashboards-validate helm-lint airgap clean
 
 help:
 	@grep -E '^[a-z-]+:' Makefile | sed 's/:.*//' | sort | uniq
@@ -108,6 +108,16 @@ fleet-smoke:  ## fleet router/supervisor/actuator vs mock replicas, no TPU
 # blocks for the handoff/tier counters — no engine, no TPU.
 kv-economy-smoke:  ## zero-copy handoff + prefix migration + host tier, no TPU
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_kv_economy.py -q -m "not slow"
+
+# the live cost & energy rail acceptance gate (docs/ECONOMICS.md): the
+# rolling-window $/1K-tok agrees with the post-hoc estimator within 10%
+# on a steady run, scripted mock /metrics drive both economics events
+# through the real scrape->sample->detector path, the scraped
+# Results.economics block validates, and the cost-aware policy sheds the
+# unprofitable marginal replica 2->1 while queue pressure and an SLO
+# breach veto the shed — no engine, no TPU.
+econ-smoke:  ## live $/1K-tok + Wh/1K-tok rail, events, cost-aware policy
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_economics.py -q -m "not slow"
 
 # the never-dark acceptance gate (docs/PROFILING.md): with no TPU,
 # `python bench.py` must exit 0 with a schema-valid `proxy` block

@@ -183,6 +183,29 @@ def _env_migrate():
     return raw in ("1", "true")
 
 
+def _env_cost_budget():
+    """$/1K-token budget the serving rows judge their economics against
+    (docs/ECONOMICS.md), or None (no verdict). Loud validation at the
+    knob: a garbled budget must not silently report every row as
+    in-budget."""
+    raw = _knob("KVMINI_BENCH_COST_BUDGET")
+    if not raw:
+        return None
+    try:
+        budget = float(raw)
+    except ValueError:
+        raise SystemExit(
+            f"KVMINI_BENCH_COST_BUDGET={raw!r}: must be a positive "
+            "$/1K-token budget (empty disables the verdict)"
+        ) from None
+    if budget <= 0:
+        raise SystemExit(
+            f"KVMINI_BENCH_COST_BUDGET={budget}: budget must be > 0 "
+            "(empty disables the verdict)"
+        )
+    return budget
+
+
 def _env_prefill_chunk():
     """Tokens per interleaved prefill chunk, or None (monolithic). Loud
     validation at the knob: a garbled value must not silently bench the
@@ -427,13 +450,20 @@ def _economics(jax, toks_per_sec: float, n_chips: int, on_tpu: bool) -> dict:
         cost_per_1k = chip_hourly * overhead * n_chips / max(toks_per_sec, 1e-9) / 3.6
         watts = modeled_power(1.0, tpu_gen) * n_chips
         wh_per_1k = watts * (1000.0 / max(toks_per_sec, 1e-9)) / 3600.0
-        return {
+        out = {
             "cost_per_1k_tokens_usd": round(cost_per_1k, 6),
             "energy_wh_per_1k_tokens": round(wh_per_1k, 4),
             "cost_basis": f"{price_key} ${chip_hourly}/chip-hr x{overhead:.2f} overhead",
             "energy_provenance":
                 f"modeled ({tpu_gen} duty 1.0 x TDP, analysis/telemetry.py)",
         }
+        budget = _env_cost_budget()
+        if budget is not None:
+            # verdict only on REAL TPU economics — the not-on-TPU path
+            # above must not report a fabricated in-budget pass
+            out["cost_budget_usd_per_1k_tok"] = budget
+            out["cost_over_budget"] = cost_per_1k > budget
+        return out
     except Exception as e:  # noqa: BLE001 — the headline must survive a
         # pricing-sheet or device-introspection hiccup
         _log(f"economics skipped: {type(e).__name__}: {e}")
@@ -1907,6 +1937,13 @@ _ENV_KNOBS = {
         "per-chip HBM capacity (GB) for the admission/headroom guard; "
         "empty = detect from the device (guard disabled on CPU without "
         "an override); the proxy tier defaults to the v5e's 16",
+    ),
+    "KVMINI_BENCH_COST_BUDGET": (
+        "--cost-budget", "",
+        "$/1K-token budget the serving rows judge their economics "
+        "against (docs/ECONOMICS.md): each TPU row's cost_per_1k_tokens_"
+        "usd gains a cost_over_budget verdict; empty = no verdict "
+        "(CPU smoke rows never get one — no fabricated passes)",
     ),
 }
 # parent<->child plumbing, not operator knobs (set by the orchestrator):

@@ -160,6 +160,13 @@ def analyze_run(
     update.update(
         telemetry.fleet_block(endpoint, runtime_metrics=runtime_metrics)
     )
+    # live-economics block (docs/ECONOMICS.md): the rolling-window cost/
+    # energy rail from a priced engine or the fleet router's aggregate;
+    # CPU backends without an econ_accelerator export nothing and get no
+    # block — absent, never a fabricated $0
+    update.update(
+        telemetry.economics_block(endpoint, runtime_metrics=runtime_metrics)
+    )
 
     # server-side request traces (docs/TRACING.md): fetch /traces, merge
     # the server leg into runs/<id>/traces/traces.json joined by trace_id,

@@ -600,6 +600,50 @@ def fleet_block(
     return {"fleet": block}
 
 
+# results.json `economics` sub-key -> runtime/router metric (docs/
+# ECONOMICS.md). Keyed by SUB-KEY (the COMPILE/KV/RESILIENCE/DISAGG/
+# FLEET orientation) because the whole map lands under the one typed
+# `economics` results field. Single engines export the first four;
+# `marginal_replica_usd_per_1k_tokens` only exists on a fleet router's
+# aggregated /metrics (fleet/router.py).
+ECON_METRIC_KEYS = {
+    "usd_per_1k_tokens": "kvmini_tpu_econ_usd_per_1k_tokens",
+    "wh_per_1k_tokens": "kvmini_tpu_econ_wh_per_1k_tokens",
+    "usd_per_hour": "kvmini_tpu_econ_usd_per_hour",
+    "tokens_per_sec": "kvmini_tpu_econ_tokens_per_sec",
+    "marginal_replica_usd_per_1k_tokens":
+        "kvmini_tpu_econ_marginal_replica_usd_per_1k_tokens",
+}
+
+
+def economics_block(
+    endpoint: Optional[str],
+    runtime_metrics: Optional[dict[str, float]] = None,
+) -> dict[str, Any]:
+    """Live-economics gauges ($/1K-tok, Wh/1K-tok, $/hr accrual, window
+    token rate, fleet marginal-replica attribution) from the runtime's or
+    router's /metrics, nested under the `economics` results key (docs/
+    ECONOMICS.md). Degradation rules as ever: a CPU backend (or any
+    external engine) doesn't export the rail and yields NO block —
+    absent, never a fabricated $0 — and the gate is the $/hr accrual
+    gauge because it is the one rail member that is non-zero whenever
+    the rail exists at all (rates can legitimately be missing while the
+    window warms up)."""
+    if not endpoint:
+        return {}
+    m = (runtime_metrics if runtime_metrics is not None
+         else scrape_runtime_metrics(endpoint))
+    block = {
+        out_key: m[metric]
+        for out_key, metric in ECON_METRIC_KEYS.items()
+        if metric in m
+    }
+    if "usd_per_hour" not in block or not block.get("usd_per_hour"):
+        return {}
+    block["source"] = "metrics:scrape"
+    return {"economics": block}
+
+
 def cache_hit_ratio(
     prom_url: Optional[str],
     endpoint: Optional[str],

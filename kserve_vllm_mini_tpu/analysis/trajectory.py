@@ -52,6 +52,10 @@ class Round:
     status: str                    # ok | tpu_unavailable | oom | error | dark
     series: str                    # "real" | "proxy" | "dark"
     tokens_per_sec_per_chip: Optional[float] = None
+    # real-round $/1K-tok from the chip-hour sheet (docs/ECONOMICS.md);
+    # None for CPU rows, which report a 0.0 "n/a" that must never track
+    # as a real price
+    cost_per_1k_tokens_usd: Optional[float] = None
     vs_baseline: Optional[float] = None
     label: Optional[str] = None    # bench config label from the metric name
     downshifted: Optional[str] = None
@@ -62,8 +66,8 @@ class Round:
             "name": self.name, "index": self.index, "status": self.status,
             "series": self.series,
         }
-        for key in ("tokens_per_sec_per_chip", "vs_baseline", "label",
-                    "downshifted"):
+        for key in ("tokens_per_sec_per_chip", "cost_per_1k_tokens_usd",
+                    "vs_baseline", "label", "downshifted"):
             v = getattr(self, key)
             if v is not None:
                 out[key] = v
@@ -122,9 +126,13 @@ def load_round(path: Path) -> Round:
     metric = str(parsed.get("metric", ""))
     if "(" in metric:
         label = metric.split("(", 1)[1].split(")", 1)[0]
+    cost = detail.get("cost_per_1k_tokens_usd")
+    cost = float(cost) if isinstance(cost, (int, float)) and cost > 0 \
+        else None
     return Round(
         name=name, index=index, status=status, series=series,
         tokens_per_sec_per_chip=tok_s,
+        cost_per_1k_tokens_usd=cost,
         vs_baseline=parsed.get("vs_baseline"),
         label=label,
         downshifted=detail.get("downshifted"),
@@ -148,6 +156,7 @@ def build_trajectory(rounds: list[Round]) -> dict[str, Any]:
     deltas, the last-real anchor, and coverage accounting."""
     rows: list[dict[str, Any]] = []
     last_real: Optional[Round] = None
+    last_cost: Optional[Round] = None
     last_proxy: dict[str, float] = {}
     regressions: list[dict[str, Any]] = []
     for r in rounds:
@@ -166,6 +175,26 @@ def build_trajectory(rounds: list[Round]) -> dict[str, Any]:
                         "delta_pct": d,
                     })
             last_real = r
+        # $/1K-tok trend (docs/ECONOMICS.md): its own anchor, because a
+        # priced round can follow an unpriced real one (CPU smoke) —
+        # anchoring on last_real would lose the trend across the gap.
+        # A cost INCREASE is the regression (worse direction +1).
+        if r.cost_per_1k_tokens_usd:
+            if last_cost is not None and last_cost.cost_per_1k_tokens_usd:
+                d = _delta_pct(r.cost_per_1k_tokens_usd,
+                               last_cost.cost_per_1k_tokens_usd)
+                if d is not None:
+                    row["cost_delta_pct"] = d
+                    if d > 10.0:
+                        regressions.append({
+                            "round": r.name,
+                            "metric": "cost_per_1k_tokens_usd",
+                            "value": r.cost_per_1k_tokens_usd,
+                            "anchor": last_cost.cost_per_1k_tokens_usd,
+                            "anchor_round": last_cost.name,
+                            "delta_pct": d,
+                        })
+            last_cost = r
         # any round CARRYING proxy data advances the proxy trend — a
         # healthy round run with KVMINI_BENCH_PROXY=always tracks
         # compile-time drift exactly like a dark round's fallback does
@@ -212,18 +241,22 @@ def render_table(traj: dict[str, Any]) -> str:
         f"{cov['proxy']} proxy, {cov['dark']} dark",
         "",
         "| round | series | status | tok/s/chip | Δ vs last real |"
-        " compile s | step ratio | note |",
-        "|---|---|---|---|---|---|---|---|",
+        " $/1K tok | Δ cost | compile s | step ratio | note |",
+        "|---|---|---|---|---|---|---|---|---|---|",
     ]
     for row in traj["rounds"]:
         tok = row.get("tokens_per_sec_per_chip")
         delta = row.get("delta_vs_last_real_pct")
+        cost = row.get("cost_per_1k_tokens_usd")
+        cost_d = row.get("cost_delta_pct")
         px = row.get("proxy", {})
         note = row.get("downshifted") or ""
         lines.append(
             f"| {row['name']} | {row['series']} | {row['status']} "
             f"| {tok if tok is not None else '—'} "
             f"| {f'{delta:+.1f}%' if delta is not None else '—'} "
+            f"| {f'{cost:.4f}' if cost is not None else '—'} "
+            f"| {f'{cost_d:+.1f}%' if cost_d is not None else '—'} "
             f"| {px.get('compile_wall_s', '—')} "
             f"| {px.get('step_count_ratio', '—')} | {note} |"
         )

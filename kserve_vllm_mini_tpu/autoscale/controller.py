@@ -35,6 +35,7 @@ from __future__ import annotations
 import argparse
 import json
 import math
+import sys
 import time
 import urllib.request
 from dataclasses import dataclass
@@ -59,6 +60,14 @@ class PolicyConfig:
     # never add more than this many replicas in one step (TPU pools
     # provision slowly; a huge jump mostly buys pending pods)
     max_step_up: int = 4
+    # cost-aware mode (docs/ECONOMICS.md): when the fleet's MARGINAL
+    # replica prices its own tokens above the $/1K-tok budget, shed it —
+    # one replica per step, vetoed by an SLO breach and by queue
+    # pressure (a queue means the "unprofitable" replica is about to be
+    # needed; cost never outranks the latency SLO). Inert without a
+    # budget so the default policy is unchanged.
+    cost_aware: bool = False
+    cost_budget_usd_per_1k_tok: Optional[float] = None
 
 
 @dataclass
@@ -68,6 +77,11 @@ class Signals:
     duty_cycle: float = 0.0        # mean across replicas, 0..1
     queue_depth: float = 0.0       # total queued requests
     slo_breached: bool = False     # gate verdict on the latest results
+    # live-economics gauges from the SAME scrape that produced duty/queue
+    # (docs/ECONOMICS.md); None when the runtime exports no rail — the
+    # cost-aware rule is inert on missing data, never reads it as free
+    usd_per_1k_tok: Optional[float] = None
+    marginal_usd_per_1k_tok: Optional[float] = None
     ts: float = 0.0
     # False when the poll produced no data (endpoint down / pod churn):
     # the controller HOLDS the current count — zero-signals must not be
@@ -101,6 +115,22 @@ def desired_replicas(current: int, sig: Signals, cfg: PolicyConfig) -> int:
             want,
             max(math.ceil(current * sig.duty_cycle / cfg.target_duty), 1),
         )
+    if (
+        cfg.cost_aware
+        and cfg.cost_budget_usd_per_1k_tok is not None
+        and sig.marginal_usd_per_1k_tok is not None
+        and sig.marginal_usd_per_1k_tok > cfg.cost_budget_usd_per_1k_tok
+        and want <= current
+        and current > 1
+        and not sig.slo_breached
+        and queue_per <= cfg.target_queue_per_replica
+    ):
+        # the marginal replica prices its tokens over budget: shed ONE
+        # replica (never a proportional collapse — each shed re-prices
+        # the survivors, so re-evaluate from the new count next poll).
+        # SLO breach and queue pressure veto: a replica that keeps the
+        # fleet inside its latency budget is worth running at a loss.
+        want = min(want, current - 1)
     want = max(cfg.min_replicas, min(cfg.max_replicas, want))
     if want > current:
         want = min(want, current + cfg.max_step_up)
@@ -124,6 +154,13 @@ def metrics_signals(url: str, timeout_s: float = 5.0, replicas: int = 1) -> Sign
     return Signals(
         duty_cycle=vals.get("kvmini_tpu_duty_cycle", 0.0),
         queue_depth=vals.get("kvmini_tpu_queue_depth", 0.0) * max(replicas, 1),
+        # economics rail from the SAME scrape (docs/ECONOMICS.md): the
+        # fleet router exports the marginal-replica gauge; a bare engine
+        # exports neither and the cost-aware rule stays inert
+        usd_per_1k_tok=vals.get("kvmini_tpu_econ_usd_per_1k_tokens"),
+        marginal_usd_per_1k_tok=vals.get(
+            "kvmini_tpu_econ_marginal_replica_usd_per_1k_tokens"
+        ),
         ts=time.time(),
         valid=bool(vals),
     )
@@ -140,17 +177,35 @@ def fleet_signals(urls: list[str], timeout_s: float = 5.0) -> Signals:
     single load-balanced URL."""
     from kserve_vllm_mini_tpu.analysis.telemetry import scrape_runtime_metrics
 
+    from kserve_vllm_mini_tpu.costs.live import usd_per_1k_tokens
+
     duties: list[float] = []
     queue_total = 0.0
+    per_1ks: list[float] = []
+    marginal: Optional[float] = None
     for url in urls:
         vals = scrape_runtime_metrics(url, timeout_s=timeout_s)
         if not vals:
             continue
         duties.append(vals.get("kvmini_tpu_duty_cycle", 0.0))
         queue_total += vals.get("kvmini_tpu_queue_depth", 0.0)
+        if "kvmini_tpu_econ_usd_per_1k_tokens" in vals:
+            per_1ks.append(vals["kvmini_tpu_econ_usd_per_1k_tokens"])
+        # marginal replica = the priciest tokens any single replica is
+        # producing right now, from each replica's own price/rate pair —
+        # the same derivation the fleet router aggregates
+        price = vals.get("kvmini_tpu_econ_usd_per_hour")
+        rate = vals.get("kvmini_tpu_econ_tokens_per_sec")
+        if price and rate and rate > 0.0:
+            cand = usd_per_1k_tokens(price, rate)
+            marginal = cand if marginal is None else max(marginal, cand)
     return Signals(
         duty_cycle=sum(duties) / len(duties) if duties else 0.0,
         queue_depth=queue_total,
+        usd_per_1k_tok=(
+            sum(per_1ks) / len(per_1ks) if per_1ks else None
+        ),
+        marginal_usd_per_1k_tok=marginal,
         ts=time.time(),
         valid=bool(duties),
     )
@@ -243,6 +298,14 @@ class Controller:
             "raw_desired": raw,
             "applied": target,
         }
+        # economics fields ride into the decision log only when the
+        # scrape carried the rail — absent, never a fabricated $0
+        if sig.usd_per_1k_tok is not None:
+            decision["usd_per_1k_tok"] = round(sig.usd_per_1k_tok, 6)
+        if sig.marginal_usd_per_1k_tok is not None:
+            decision["marginal_usd_per_1k_tok"] = round(
+                sig.marginal_usd_per_1k_tok, 6
+            )
         self.decisions.append(decision)
         if self.decision_log:
             with self.decision_log.open("a") as f:
@@ -331,6 +394,14 @@ def register(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--target-queue", type=float, default=4.0)
     parser.add_argument("--scale-down-duty", type=float, default=0.30)
     parser.add_argument("--stabilization", type=float, default=120.0)
+    parser.add_argument("--cost-aware", action="store_true",
+                        help="Shed the marginal replica when it prices its "
+                             "tokens over --cost-budget-usd-per-1k-tok "
+                             "(docs/ECONOMICS.md; SLO breach and queue "
+                             "pressure veto the shed)")
+    parser.add_argument("--cost-budget-usd-per-1k-tok", type=float,
+                        default=None,
+                        help="$/1K-token budget for --cost-aware")
     parser.add_argument("--interval", type=float, default=15.0)
     parser.add_argument("--iterations", type=int, default=0,
                         help="Stop after N control steps (0 = run forever)")
@@ -357,7 +428,13 @@ def run(args: argparse.Namespace) -> int:
         target_queue_per_replica=args.target_queue,
         scale_down_duty=args.scale_down_duty,
         stabilization_s=args.stabilization,
+        cost_aware=args.cost_aware,
+        cost_budget_usd_per_1k_tok=args.cost_budget_usd_per_1k_tok,
     )
+    if cfg.cost_aware and cfg.cost_budget_usd_per_1k_tok is None:
+        print("autoscale-controller: --cost-aware requires "
+              "--cost-budget-usd-per-1k-tok", file=sys.stderr)
+        return 2
 
     # breach latch: one breached snapshot steps up ONCE; re-stepping needs
     # a NEW snapshot that still breaches. Without the latch a single stale

@@ -47,6 +47,7 @@ def run_bench(
     monitor: bool = True,
     monitor_slo: Any = None,
     monitor_abort: bool = False,
+    cost_budget_usd_per_1k_tok: Optional[float] = None,
 ) -> tuple[dict[str, Any], int]:
     """Returns (results, exit_code).
 
@@ -120,6 +121,11 @@ def run_bench(
                 interval_s=float(profile.get("monitor_interval_s", 1.0)),
                 budgets=budgets,
                 abort_enabled=bool(profile.get("monitor_abort", monitor_abort)),
+                cost_budget_usd_per_1k_tok=(
+                    float(profile["cost_budget_usd_per_1k_tok"])
+                    if profile.get("cost_budget_usd_per_1k_tok") is not None
+                    else cost_budget_usd_per_1k_tok
+                ),
             ),
             abort=abort,
         )
@@ -362,6 +368,12 @@ def _run_stages(
         if any(res.values()):
             res["source"] = "engine:snapshot"
             run_dir.merge_into_results({"resilience": res})
+        # live-economics block (docs/ECONOMICS.md): same authoritative-
+        # direct-snapshot rule; engines without the rail (CPU backends
+        # with no econ_accelerator) get no block — absent, never $0
+        econ = server.engine.economics_snapshot()
+        if econ:
+            run_dir.merge_into_results({"economics": econ})
         # disaggregated-serving block (docs/DISAGGREGATION.md): same
         # authoritative-direct-snapshot rule; colocated engines (and
         # disagg runs with zero handoff activity) get no block
@@ -432,6 +444,13 @@ def register(parser: argparse.ArgumentParser) -> None:
                         help="Let the monitor abort the run on sustained "
                              "budget burn or a decode stall (records "
                              "aborted_early in results.json)")
+    parser.add_argument("--cost-budget-usd-per-1k-tok", type=float,
+                        default=None,
+                        help="Live $/1K-token budget for the "
+                             "cost_burn_exceeded / replica_unprofitable "
+                             "monitor events (docs/ECONOMICS.md; also "
+                             "KVMINI_BENCH_COST_BUDGET and the profile "
+                             "key cost_budget_usd_per_1k_tok)")
 
 
 def run(args: argparse.Namespace) -> int:
@@ -458,5 +477,6 @@ def run(args: argparse.Namespace) -> int:
         monitor=not args.no_monitor,
         monitor_slo=args.monitor_slo,
         monitor_abort=args.monitor_abort,
+        cost_budget_usd_per_1k_tok=args.cost_budget_usd_per_1k_tok,
     )
     return code

@@ -196,6 +196,14 @@ class Results:
     # /metrics (analysis/telemetry.py FLEET_METRIC_KEYS); absent for
     # single-server runs and external engines.
     fleet: Optional[dict[str, Any]] = None
+    # live-economics block (docs/ECONOMICS.md): the rolling-window cost/
+    # energy rail — {usd_per_1k_tokens, wh_per_1k_tokens, usd_per_hour,
+    # tokens_per_sec, marginal_replica_usd_per_1k_tokens, source} —
+    # snapshotted directly in self-serve runs (engine.economics_snapshot)
+    # or scraped from /metrics (analysis/telemetry.py ECON_METRIC_KEYS);
+    # shape gated by validate_economics. Absent for CPU backends without
+    # an econ_accelerator and for external engines — never a $0 block.
+    economics: Optional[dict[str, Any]] = None
     # headroom-model validation (profiling/headroom.py): signed % error
     # of the analytic admission estimate vs the observed HBM peak —
     # negative = the model UNDERESTIMATES (the OOM direction). Present
@@ -605,6 +613,75 @@ def validate_kv_cache(doc: Any) -> list[str]:
             errs.append(
                 f"pool arithmetic broken: free+retained+used={total} "
                 f"!= pool_blocks={doc['pool_blocks']}"
+            )
+    if "source" in doc and not isinstance(doc["source"], str):
+        errs.append("source is not a string")
+    return errs
+
+
+# -- economics block schema ---------------------------------------------------
+#
+# The live cost/energy rail (docs/ECONOMICS.md): what the engine's
+# economics_snapshot and the analyzer's ECON_METRIC_KEYS scrape both
+# produce under the `economics` results key. Hand-rolled validator like
+# the others — no jsonschema dependency in the harness layers. `make
+# econ-smoke` gates on it.
+
+ECONOMICS_JSON_SCHEMA: dict[str, Any] = {
+    "$schema": "http://json-schema.org/draft-07/schema#",
+    "title": "kvmini-tpu results.json `economics` block",
+    "type": "object",
+    "required": ["usd_per_hour"],
+    "properties": {
+        "source": {"type": "string"},
+        "usd_per_hour": {"type": "number", "exclusiveMinimum": 0},
+        "usd_per_1k_tokens": {"type": "number", "minimum": 0},
+        "wh_per_1k_tokens": {"type": "number", "minimum": 0},
+        "tokens_per_sec": {"type": "number", "minimum": 0},
+        "marginal_replica_usd_per_1k_tokens": {
+            "type": "number", "minimum": 0
+        },
+    },
+}
+
+
+def validate_economics(doc: Any) -> list[str]:
+    """Validate a results.json ``economics`` block against
+    ECONOMICS_JSON_SCHEMA's contract. Returns violations; empty = valid.
+    The invariants downstream consumers rely on: the $/hr accrual
+    present and strictly positive (a block that exists but prices the
+    deployment at $0/hr is a pricing-sheet failure, not a cheap fleet),
+    every present rate numeric and non-negative, and — for SINGLE-engine
+    blocks, where all three gauges come from one snapshot window — the
+    derivation closed: the reported $/1K-tok must equal usd_per_hour /
+    (3.6 x tokens_per_sec) to float tolerance. Fleet-scraped blocks are
+    exempt (flagged by the marginal-replica key): there usd_per_hour and
+    tokens_per_sec are label-SUMMED fleet totals while usd_per_1k_tokens
+    is the healthy-replica MEAN of ratios, which legitimately differs
+    from the ratio of sums on a skewed fleet."""
+    errs: list[str] = []
+    if not isinstance(doc, dict):
+        return ["economics block is not an object"]
+    v = doc.get("usd_per_hour")
+    if not _num(v) or v <= 0:
+        errs.append("usd_per_hour missing or not a positive number")
+    for key in ("usd_per_1k_tokens", "wh_per_1k_tokens", "tokens_per_sec",
+                "marginal_replica_usd_per_1k_tokens"):
+        if key in doc and (not _num(doc[key]) or doc[key] < 0):
+            errs.append(f"{key} not a non-negative number ({doc[key]!r})")
+    if (
+        "marginal_replica_usd_per_1k_tokens" not in doc
+        and _num(doc.get("usd_per_hour"))
+        and _num(doc.get("tokens_per_sec")) and doc["tokens_per_sec"] > 0
+        and _num(doc.get("usd_per_1k_tokens"))
+    ):
+        implied = doc["usd_per_hour"] / (3.6 * doc["tokens_per_sec"])
+        if abs(doc["usd_per_1k_tokens"] - implied) > max(
+            1e-6, 0.01 * implied
+        ):
+            errs.append(
+                f"usd_per_1k_tokens={doc['usd_per_1k_tokens']} does not "
+                f"match usd_per_hour/(3.6*tokens_per_sec)={implied:.9f}"
             )
     if "source" in doc and not isinstance(doc["source"], str):
         errs.append("source is not a string")

@@ -1,0 +1,159 @@
+"""Live economics: online $/1K-tok and Wh/1K-tok from counter deltas.
+
+The post-hoc attribution (costs/estimator.py, energy/collector.py) only
+prices a run after it ends. This module derives the SAME quantities
+online, over a rolling window, from the two counters every serving
+surface already exports — ``kvmini_tpu_busy_seconds_total`` and the
+generated-token counter — plus modeled-or-measured watts and the
+tpu-cost.yaml sheet (docs/ECONOMICS.md):
+
+- ``usd_per_hour``     — the accrual rate of the deployment: chips x
+  chip-hour price x region multiplier x (1 + overhead_factor). A level
+  gauge; it accrues whether the chip is busy or idle, exactly like the
+  post-hoc estimator's ``chip_seconds`` leg.
+- ``usd_per_1k_tokens`` — usd_per_hour spread over the window's token
+  output: ``usd_per_hour * (dt/3600) / d_tokens * 1000``.
+- ``wh_per_1k_tokens`` — window watts (modeled from windowed duty via
+  ``analysis/telemetry.modeled_power``, or measured watts when the
+  caller has a power rail) x dt, spread the same way.
+- ``tokens_per_sec``   — the window token rate itself, exported so the
+  fleet router can rank replicas by contribution.
+
+JAX-free on purpose: the engine computes its device info once and hands
+in plain (accelerator, chips); everything here is host arithmetic, so
+the monitor, the router, and tests run it with no accelerator at all.
+
+Window semantics match ``monitor/burnrate.window_stats``: deltas are
+taken between the oldest retained sample and the newest, the retained
+span is ``window_s`` (plus one sample so a full window always has a
+delta), and a window with no token progress yields NO rates — absence
+of output is "can't attribute yet", never "$0/1K tokens".
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Optional
+
+from kserve_vllm_mini_tpu.analysis.telemetry import modeled_power
+from kserve_vllm_mini_tpu.costs.pricing import Pricing, load_pricing
+
+# Spread an hourly rate over a token rate: $/hr / (tok/s x 3600 s/hr
+# / 1000 tok) = $/hr / (3.6 x tok/s) per 1K tokens.
+_TOKENS_PER_1K_PER_HOUR = 3.6
+
+
+def usd_per_1k_tokens(usd_per_hour: float, tokens_per_sec: float) -> float:
+    """Hourly accrual -> $/1K tokens at a token rate (0 rate -> 0.0; the
+    caller gates on token progress before calling)."""
+    if tokens_per_sec <= 0.0:
+        return 0.0
+    return usd_per_hour / (_TOKENS_PER_1K_PER_HOUR * tokens_per_sec)
+
+
+def hourly_usd(pricing: Pricing, accelerator: Optional[str], chips: int,
+               region: Optional[str] = None) -> tuple[float, str]:
+    """The deployment's accrual rate in $/hr and the matched price key —
+    the same chip-hour x region x overhead legs the post-hoc estimator
+    prices (costs/estimator.py), minus the host legs it can only
+    attribute from cluster introspection."""
+    chip_hourly, price_key = pricing.chip_price(accelerator)
+    rate = (chip_hourly * max(int(chips), 1)
+            * pricing.region_multiplier(region)
+            * (1.0 + pricing.overhead_factor))
+    return rate, price_key
+
+
+class LiveEconomics:
+    """Rolling-window economics over (wall clock, busy-seconds, tokens).
+
+    Feed one ``observe(t, busy_s, tokens)`` per snapshot (the engine's
+    ``snapshot_stats`` pass, the monitor tick, or a test loop); each call
+    returns the current gauge dict — ``{}`` until the window holds two
+    samples with token progress, so a CPU backend or an idle engine
+    exports NOTHING rather than a fabricated $0 (absent-not-zero,
+    docs/ECONOMICS.md). Not thread-safe by itself: the engine publishes
+    it under its observability lock, everyone else runs it single-
+    threaded (the PR 8 gauge-cache rule — no new annotations)."""
+
+    def __init__(
+        self,
+        accelerator: Optional[str] = None,
+        chips: int = 1,
+        pricing: Optional[Pricing] = None,
+        region: Optional[str] = None,
+        window_s: float = 10.0,
+        watts_fn: Any = None,
+    ) -> None:
+        self.accelerator = accelerator
+        self.chips = max(int(chips), 1)
+        self.pricing = pricing if pricing is not None else load_pricing()
+        self.window_s = max(float(window_s), 1e-3)
+        # measured-power hook: callable () -> Optional[watts]; None keeps
+        # the modeled chain (duty x TDP, analysis/telemetry.modeled_power)
+        self._watts_fn = watts_fn
+        self.usd_per_hour, self.price_key = hourly_usd(
+            self.pricing, accelerator, self.chips, region
+        )
+        self._samples: deque[tuple[float, float, float]] = deque()
+
+    def observe(self, t: float, busy_s: float,
+                tokens: float) -> dict[str, float]:
+        """Record one (wall, busy-counter, token-counter) sample and
+        return the rolling-window gauges (or ``{}`` — see class doc)."""
+        self._samples.append((float(t), float(busy_s), float(tokens)))
+        # keep window_s of history plus one older anchor so the delta
+        # always spans the full window once the run outlives it
+        while (len(self._samples) > 2
+               and self._samples[1][0] <= t - self.window_s):
+            self._samples.popleft()
+        return self.snapshot()
+
+    def snapshot(self) -> dict[str, float]:
+        if len(self._samples) < 2:
+            return {}
+        t0, busy0, tok0 = self._samples[0]
+        t1, busy1, tok1 = self._samples[-1]
+        dt = t1 - t0
+        d_tokens = tok1 - tok0
+        if dt <= 0.0 or d_tokens <= 0.0:
+            # no wall progress or no token progress: nothing to attribute
+            # (a counter reset also lands here — never a negative rate)
+            return {}
+        tokens_per_sec = d_tokens / dt
+        duty = min(max((busy1 - busy0) / dt, 0.0), 1.0)
+        watts = self._watts_fn() if self._watts_fn is not None else None
+        provenance = "measured"
+        if not isinstance(watts, (int, float)) or watts <= 0.0:
+            watts = modeled_power(duty, self.accelerator) * self.chips
+            provenance = "modeled"
+        wh = watts * dt / 3600.0
+        return {
+            "usd_per_1k_tokens": usd_per_1k_tokens(self.usd_per_hour,
+                                                   tokens_per_sec),
+            "wh_per_1k_tokens": wh / d_tokens * 1000.0,
+            "usd_per_hour": self.usd_per_hour,
+            "tokens_per_sec": tokens_per_sec,
+            "window_s": dt,
+            "duty": duty,
+            "watts": watts,
+            "power_provenance_measured": 1.0 if provenance == "measured"
+            else 0.0,
+        }
+
+
+def marginal_replica_usd_per_1k_tokens(
+    per_replica_tokens_per_sec: list[float],
+    usd_per_hour_per_replica: float,
+) -> Optional[float]:
+    """The fleet's marginal-replica attribution: the LEAST-productive
+    healthy replica's hourly price spread over its own token output.
+    This is the number the cost-aware autoscaler and the
+    ``replica_unprofitable`` monitor rule compare against the $/1K-tok
+    budget — if the marginal replica's tokens are worth less than it
+    costs, the fleet is over-provisioned (docs/ECONOMICS.md). Returns
+    None when no replica shows token progress (absent, not $0)."""
+    rates = [r for r in per_replica_tokens_per_sec if r > 0.0]
+    if not rates or usd_per_hour_per_replica <= 0.0:
+        return None
+    return usd_per_1k_tokens(usd_per_hour_per_replica, min(rates))

@@ -44,20 +44,72 @@ class Pricing:
         return 1.0
 
 
+# top-level sections a pricing sheet may carry; anything else is almost
+# certainly a typo ("tpu_chip_hourli") that would silently price every
+# run at the fallback default — fail LOUD at the load, the same
+# convention bench.py's _ENV_KNOBS validators follow
+_KNOWN_TOP_KEYS = ("tpu_chip_hourly", "host", "calculation", "energy")
+
+
+def _sheet_num(sheet: Path, where: str, v: Any) -> float:
+    """A price that isn't a number must stop the load — ``float("1,20")``
+    raising deep inside an analyzer stage points at nothing."""
+    if isinstance(v, bool) or not isinstance(v, (int, float, str)):
+        raise SystemExit(
+            f"{sheet}: {where} = {v!r} is not a number"
+        )
+    try:
+        return float(v)
+    except ValueError:
+        raise SystemExit(
+            f"{sheet}: {where} = {v!r} is not a number"
+        ) from None
+
+
 def load_pricing(path: str | Path | None = None) -> Pricing:
+    """Load + validate a pricing sheet. Validation is LOUD (SystemExit
+    naming the sheet, the key, and the fix): a garbled sheet silently
+    falling back to defaults would price every run wrong under the
+    operator's own label (docs/ECONOMICS.md "Pricing provenance")."""
     p = Path(path) if path else DEFAULT_SHEET
     with p.open() as f:
-        raw: dict[str, Any] = yaml.safe_load(f) or {}
+        raw = yaml.safe_load(f) or {}
+    if not isinstance(raw, dict):
+        raise SystemExit(
+            f"{p}: pricing sheet must be a mapping, got "
+            f"{type(raw).__name__}"
+        )
+    unknown = sorted(set(raw) - set(_KNOWN_TOP_KEYS))
+    if unknown:
+        raise SystemExit(
+            f"{p}: unknown top-level key(s) {', '.join(map(repr, unknown))}; "
+            f"known: {', '.join(_KNOWN_TOP_KEYS)}"
+        )
+    chip = raw.get("tpu_chip_hourly") or {}
+    if chip and "default" not in chip:
+        raise SystemExit(
+            f"{p}: tpu_chip_hourly has no 'default' entry — unmatched "
+            "accelerators would be priced by a hardcoded fallback "
+            "instead of the sheet; add `default: <usd/chip-hr>`"
+        )
     host = raw.get("host") or {}
     calc = raw.get("calculation") or {}
     energy = raw.get("energy") or {}
     return Pricing(
-        tpu_chip_hourly={k: float(v) for k, v in (raw.get("tpu_chip_hourly") or {}).items()},
-        cpu_core_hourly=float(host.get("cpu_core_hourly", 0.031)),
-        memory_gib_hourly=float(host.get("memory_gib_hourly", 0.0042)),
-        overhead_factor=float(calc.get("overhead_factor", 0.15)),
-        region_multipliers={
-            k: float(v) for k, v in (calc.get("region_multipliers") or {}).items()
+        tpu_chip_hourly={
+            k: _sheet_num(p, f"tpu_chip_hourly.{k}", v)
+            for k, v in chip.items()
         },
-        grid_usd_per_kwh=float(energy.get("grid_usd_per_kwh", 0.12)),
+        cpu_core_hourly=_sheet_num(
+            p, "host.cpu_core_hourly", host.get("cpu_core_hourly", 0.031)),
+        memory_gib_hourly=_sheet_num(
+            p, "host.memory_gib_hourly", host.get("memory_gib_hourly", 0.0042)),
+        overhead_factor=_sheet_num(
+            p, "calculation.overhead_factor", calc.get("overhead_factor", 0.15)),
+        region_multipliers={
+            k: _sheet_num(p, f"calculation.region_multipliers.{k}", v)
+            for k, v in (calc.get("region_multipliers") or {}).items()
+        },
+        grid_usd_per_kwh=_sheet_num(
+            p, "energy.grid_usd_per_kwh", energy.get("grid_usd_per_kwh", 0.12)),
     )

@@ -130,8 +130,13 @@ def collect_power(
 def trapezoidal_wh(samples: list[dict[str, float]], t0: float, t1: float) -> float:
     """Integrate watts over [t0, t1] (seconds) -> watt-hours.
 
-    Samples outside the window are clipped; gaps integrate linearly between
-    neighbors (reference collector.py:133-149)."""
+    Samples outside the window are clipped; gaps integrate linearly
+    between neighbors (reference collector.py:133-149). Unsorted input
+    is sorted first and zero-width segments (duplicate timestamps — two
+    collectors writing the same tick) are skipped, so the integral can
+    never go negative or divide by a zero gap; a single usable sample
+    has no span at all and integrates to 0.0 (the caller records WHY —
+    see integrate_energy's provenance note)."""
     pts = sorted((s["t"], s["watts"]) for s in samples)
     pts = [(t, w) for t, w in pts if t0 - 60 <= t <= t1 + 60]
     if len(pts) < 2 or t1 <= t0:
@@ -145,7 +150,7 @@ def trapezoidal_wh(samples: list[dict[str, float]], t0: float, t1: float) -> flo
         w_a = wa + (wb - wa) * (a - ta) / (tb - ta)
         w_b = wa + (wb - wa) * (b - ta) / (tb - ta)
         total_ws += 0.5 * (w_a + w_b) * (b - a)
-    return total_ws / 3600.0
+    return max(total_ws, 0.0) / 3600.0
 
 
 def integrate_energy(
@@ -179,6 +184,16 @@ def integrate_energy(
     records = run_dir.read_requests()
     t0, t1 = window_bounds(records)
 
+    # degenerate sample sets integrate to 0.0 by construction
+    # (trapezoidal_wh); say WHY in the doc so a 0 Wh row is attributable
+    # instead of looking like a measured-idle run
+    note = None
+    distinct_ts = {float(s["t"]) for s in samples}
+    if len(samples) == 1:
+        note = "single power sample: no span to integrate; energy 0.0"
+    elif samples and len(distinct_ts) < 2:
+        note = ("power samples share one timestamp (duplicate ticks): "
+                "no span to integrate; energy 0.0")
     raw_wh = trapezoidal_wh(samples, t0, t1)
     idle_w = 0.0
     if idle_tax == "series" and samples:
@@ -200,6 +215,8 @@ def integrate_energy(
         "samples": len(samples),
         "provenance": power.get("provenance", "unavailable"),
     }
+    if note:
+        doc["note"] = note
     if ok:
         doc["energy_wh_per_request"] = active_wh / len(ok)
     if tokens_out:

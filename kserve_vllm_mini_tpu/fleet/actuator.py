@@ -54,6 +54,14 @@ def router_signals(
     sig = Signals(
         duty_cycle=min(duty, 1.0),
         queue_depth=m.get("kvmini_tpu_queue_depth", 0.0),
+        # economics rail from the SAME scrape (docs/ECONOMICS.md): the
+        # router re-emits $/1K-tok as a healthy-replica mean and derives
+        # the marginal-replica gauge; a fleet of unpriced engines exports
+        # neither and the cost-aware policy stays inert
+        usd_per_1k_tok=m.get("kvmini_tpu_econ_usd_per_1k_tokens"),
+        marginal_usd_per_1k_tok=m.get(
+            "kvmini_tpu_econ_marginal_replica_usd_per_1k_tokens"
+        ),
         ts=time.time(),
         valid=bool(m) and live > 0,
     )

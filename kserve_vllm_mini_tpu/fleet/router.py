@@ -67,6 +67,12 @@ MEAN_GAUGES = frozenset({
     "kvmini_tpu_kv_prefix_hit_depth_p50",
     "kvmini_tpu_kv_prefix_hit_depth_p95",
     "kvmini_tpu_estimated_wait_seconds",
+    # live-economics per-token rates (docs/ECONOMICS.md) are ratios: 3
+    # replicas each at $0.02/1K-tok are a $0.02/1K-tok fleet, not $0.06.
+    # The level gauges (econ_usd_per_hour, econ_tokens_per_sec) stay on
+    # the summing passthrough — their label-sum IS the fleet total.
+    "kvmini_tpu_econ_usd_per_1k_tokens",
+    "kvmini_tpu_econ_wh_per_1k_tokens",
 })
 
 
@@ -848,6 +854,34 @@ class FleetRouter:
                 if vals:
                     lines.append(f"# TYPE {name} gauge")
                     lines.append(f"{name} {sum(vals) / len(vals):.6f}")
+            # fleet marginal-replica attribution (docs/ECONOMICS.md):
+            # the WORST $/1K-tok any single healthy replica is producing
+            # at — each replica's own hourly accrual spread over its own
+            # windowed token rate. This is the number the cost-aware
+            # autoscaler and the replica_unprofitable monitor rule
+            # compare against the budget: when the marginal replica's
+            # tokens stop paying for its hour, the fleet is over-
+            # provisioned. Absent (no line, never $0) until at least one
+            # priced replica shows token progress.
+            from kserve_vllm_mini_tpu.costs.live import usd_per_1k_tokens
+
+            marginal = None
+            for r in views:
+                if not r.healthy:
+                    continue
+                price = r.metrics_map.get("kvmini_tpu_econ_usd_per_hour")
+                rate = r.metrics_map.get("kvmini_tpu_econ_tokens_per_sec")
+                if price and rate and rate > 0.0:
+                    cand = usd_per_1k_tokens(price, rate)
+                    marginal = cand if marginal is None else max(marginal,
+                                                                 cand)
+            if marginal is not None:
+                lines += [
+                    "# TYPE kvmini_tpu_econ_marginal_replica"
+                    "_usd_per_1k_tokens gauge",
+                    "kvmini_tpu_econ_marginal_replica_usd_per_1k_tokens "
+                    f"{marginal:.6f}",
+                ]
             # per-replica passthrough: every replica's last scrape with a
             # replica label — the flat-scrape parser SUMS duplicates, so
             # post-hoc consumers read fleet totals unchanged (counters

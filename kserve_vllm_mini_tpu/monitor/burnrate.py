@@ -19,14 +19,18 @@ from typing import Any, Optional
 
 from kserve_vllm_mini_tpu.gates.slo import BUDGET_RULES
 
-# budget keys whose results-metric can be recomputed from a live window of
-# request completions (the rest — cost, energy, cold multiplier, fairness —
-# need post-hoc stages and are gated only at the end)
+# budget keys whose results-metric the live window can produce: most come
+# from the rolling window of request completions; cost_per_1k_tokens is
+# injected by the sampler from the runtime's live-economics gauge
+# (kvmini_tpu_econ_usd_per_1k_tokens, docs/ECONOMICS.md) when the engine
+# exports the rail. The rest — energy, cold multiplier, fairness — still
+# need post-hoc stages and are gated only at the end.
 LIVE_BUDGET_KEYS = (
     "p95_ms_max",
     "p99_ms_max",
     "ttft_p95_ms_max",
     "error_rate_max",
+    "cost_per_1k_tokens_max",
     "throughput_rps_min",
     "tokens_per_sec_min",
 )

@@ -28,6 +28,8 @@ EVENT_TYPES = (
     "overload_shedding",
     "engine_fault",
     "replica_down",
+    "cost_burn_exceeded",
+    "replica_unprofitable",
 )
 
 
@@ -132,6 +134,9 @@ class EventDetector:
         kv_thrash_samples: int = 3,
         hbm_high_fraction: float = 0.92,
         replica_down_samples: int = 3,
+        cost_budget_usd_per_1k_tok: Optional[float] = None,
+        cost_burn_samples: int = 3,
+        unprofitable_samples: int = 3,
     ) -> None:
         self.stall_samples = stall_samples
         self.prefill_stall_samples = prefill_stall_samples
@@ -147,6 +152,9 @@ class EventDetector:
         self.kv_thrash_samples = kv_thrash_samples
         self.hbm_high_fraction = hbm_high_fraction
         self.replica_down_samples = replica_down_samples
+        self.cost_budget_usd_per_1k_tok = cost_budget_usd_per_1k_tok
+        self.cost_burn_samples = cost_burn_samples
+        self.unprofitable_samples = unprofitable_samples
         self._fired: set[str] = set()
         self._t0: Optional[float] = None
         self._prev: Optional[dict[str, Any]] = None
@@ -158,6 +166,8 @@ class EventDetector:
         self._burn_run = 0
         self._thrash_run = 0
         self._replica_down_run = 0
+        self._cost_burn_run = 0
+        self._unprofitable_run = 0
         self._peak_throughput = 0.0
         self._peak_duty = 0.0
 
@@ -490,6 +500,93 @@ class EventDetector:
             )
         return None
 
+    def _check_cost_burn(self, sample: dict[str, Any]) -> Optional[Event]:
+        """The live $/1K-tok gauge (kvmini_tpu_econ_usd_per_1k_tokens,
+        docs/ECONOMICS.md) stayed over the --cost-budget-usd-per-1k-tok
+        budget for N consecutive samples. Rides the burn-rate machinery
+        (monitor/burnrate.burn_rates with the cost_per_1k_tokens_max
+        rule — including its capped-at-BURN_CAP zero-budget contract)
+        rather than re-deriving the normalization; burn > 1.0 is the
+        out-of-budget line. Inert without a budget, inert on engines
+        that don't export the rail (no gauge -> no fabricated verdict),
+        and warmup-immune like burn_rate_exceeded — cold-start windows
+        price the first tokens absurdly high by construction."""
+        if self.cost_budget_usd_per_1k_tok is None:
+            return None
+        if (
+            self._t0 is not None
+            and sample["t"] - self._t0 < self.warmup_s
+        ):
+            self._cost_burn_run = 0
+            return None
+        cost = _runtime(sample, "econ_usd_per_1k_tokens")
+        if cost is None:
+            self._cost_burn_run = 0
+            return None
+        from kserve_vllm_mini_tpu.monitor.burnrate import burn_rates
+
+        rate = burn_rates(
+            {"cost_per_1k_tokens": cost},
+            {"cost_per_1k_tokens_max": self.cost_budget_usd_per_1k_tok},
+        ).get("cost_per_1k_tokens_max", 0.0)
+        if rate > 1.0:
+            self._cost_burn_run += 1
+        else:
+            self._cost_burn_run = 0
+        if self._cost_burn_run >= self.cost_burn_samples:
+            return Event(
+                sample["t"], "cost_burn_exceeded",
+                f"windowed cost ${cost:.6f}/1K-tok is {rate:.2f}x the "
+                f"${self.cost_budget_usd_per_1k_tok:g}/1K-tok budget for "
+                f"{self._cost_burn_run} consecutive samples",
+                {"usd_per_1k_tokens": cost, "burn_rate": rate,
+                 "budget_usd_per_1k_tok": self.cost_budget_usd_per_1k_tok,
+                 "samples": self._cost_burn_run},
+            )
+        return None
+
+    def _check_replica_unprofitable(
+        self, sample: dict[str, Any]
+    ) -> Optional[Event]:
+        """The fleet's MARGINAL replica stopped paying for itself for N
+        consecutive windows (docs/ECONOMICS.md): the router's marginal-
+        replica gauge — the least-productive healthy replica's hourly
+        price spread over its own token output — stayed above the
+        $/1K-tok budget while the fleet held >= 2 live replicas. At the
+        budget price, that replica's token contribution is worth less
+        than its hour costs, so the fleet is over-provisioned; the
+        cost-aware autoscaler (autoscale/controller.py) acts on the same
+        comparison. Gated on >= 2 live replicas — the LAST replica is
+        never 'unprofitable', scaling to zero is an availability
+        decision this monitor must not suggest. Only the fleet router
+        exports the gauge, so the rule is inert everywhere else."""
+        if self.cost_budget_usd_per_1k_tok is None:
+            return None
+        marginal = _runtime(
+            sample, "econ_marginal_replica_usd_per_1k_tokens"
+        )
+        live = _runtime(sample, "fleet_replicas_live")
+        if marginal is None or live is None or live < 2:
+            self._unprofitable_run = 0
+            return None
+        if marginal > self.cost_budget_usd_per_1k_tok:
+            self._unprofitable_run += 1
+        else:
+            self._unprofitable_run = 0
+        if self._unprofitable_run >= self.unprofitable_samples:
+            return Event(
+                sample["t"], "replica_unprofitable",
+                f"marginal replica at ${marginal:.6f}/1K-tok > the "
+                f"${self.cost_budget_usd_per_1k_tok:g}/1K-tok budget for "
+                f"{self._unprofitable_run} consecutive samples with "
+                f"{live:g} replicas live — the fleet is over-provisioned",
+                {"marginal_replica_usd_per_1k_tokens": marginal,
+                 "budget_usd_per_1k_tok": self.cost_budget_usd_per_1k_tok,
+                 "replicas_live": live,
+                 "samples": self._unprofitable_run},
+            )
+        return None
+
     def _check_burn_rate(
         self, sample: dict[str, Any], burn: dict[str, float]
     ) -> Optional[Event]:
@@ -537,6 +634,9 @@ class EventDetector:
             ("overload_shedding", self._check_overload_shedding(sample)),
             ("engine_fault", self._check_engine_fault(sample)),
             ("replica_down", self._check_replica_down(sample)),
+            ("cost_burn_exceeded", self._check_cost_burn(sample)),
+            ("replica_unprofitable",
+             self._check_replica_unprofitable(sample)),
         ]
         self._prev = sample
         fired: list[Event] = []

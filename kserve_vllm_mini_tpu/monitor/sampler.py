@@ -83,6 +83,17 @@ TIMELINE_RUNTIME_METRICS = (
     "kvmini_tpu_fleet_replicas_live",
     "kvmini_tpu_fleet_reroutes_total",
     "kvmini_tpu_fleet_sheds_total",
+    # live-economics rail (docs/ECONOMICS.md): the $/1K-tok gauge feeds
+    # the cost_burn_exceeded rule and the sampler's live cost budget,
+    # the router-only marginal gauge feeds replica_unprofitable, and all
+    # five ride into the report's cost/energy timeline lanes. Engines
+    # without a priced accelerator export none of them — the timeline
+    # stays absent, never a fabricated $0.
+    "kvmini_tpu_econ_usd_per_1k_tokens",
+    "kvmini_tpu_econ_wh_per_1k_tokens",
+    "kvmini_tpu_econ_usd_per_hour",
+    "kvmini_tpu_econ_tokens_per_sec",
+    "kvmini_tpu_econ_marginal_replica_usd_per_1k_tokens",
 )
 
 _PREFIX = "kvmini_tpu_"
@@ -112,6 +123,10 @@ class MonitorConfig:
     kv_thrash_samples: int = 3
     hbm_high_fraction: float = 0.92   # of kvmini_tpu_hbm_bytes_limit
     replica_down_samples: int = 3     # replica_down rule (docs/FLEET.md)
+    # economics rules (docs/ECONOMICS.md): both inert without a budget
+    cost_budget_usd_per_1k_tok: Optional[float] = None
+    cost_burn_samples: int = 3
+    unprofitable_samples: int = 3
     abort_enabled: bool = False
     abort_on: frozenset[str] = DEFAULT_ABORT_ON
     budgets: dict[str, float] = field(default_factory=dict)
@@ -167,6 +182,9 @@ class RunMonitor:
             kv_thrash_samples=self.cfg.kv_thrash_samples,
             hbm_high_fraction=self.cfg.hbm_high_fraction,
             replica_down_samples=self.cfg.replica_down_samples,
+            cost_budget_usd_per_1k_tok=self.cfg.cost_budget_usd_per_1k_tok,
+            cost_burn_samples=self.cfg.cost_burn_samples,
+            unprofitable_samples=self.cfg.unprofitable_samples,
         )
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -212,6 +230,12 @@ class RunMonitor:
             if "throughput_rps" in win:
                 lg["window_throughput_rps"] = round(win["throughput_rps"], 4)
             sample["loadgen"] = lg
+            # the live $/1K-tok comes from the runtime's economics gauge,
+            # not from completions — inject it so a slo.json
+            # cost_per_1k_tokens_max budget produces a LIVE burn rate
+            # (docs/ECONOMICS.md) instead of waiting for the post-hoc gate
+            if runtime is not None and "econ_usd_per_1k_tokens" in runtime:
+                win["cost_per_1k_tokens"] = runtime["econ_usd_per_1k_tokens"]
             burn = burnrate.burn_rates(win, self.cfg.budgets)
             if burn:
                 sample["burn_rates"] = {

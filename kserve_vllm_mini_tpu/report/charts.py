@@ -320,6 +320,123 @@ def kv_timeline_chart(
     return _to_img(fig)
 
 
+def econ_timeline_chart(
+    samples: list[dict[str, Any]], events: list[dict[str, Any]] | None = None
+) -> str:
+    """The live economics rail (docs/ECONOMICS.md) over the run as two
+    stacked lanes — $/1K-tok (fleet marginal-replica gauge dashed beside
+    it when the router exported one) and Wh/1K-tok — with the
+    cost_burn_exceeded / replica_unprofitable markers where they fired.
+    Runs whose timeline carried no econ gauges (unpriced engine, CPU
+    backend) draw nothing: absent, never a fabricated $0 lane."""
+    rows = [
+        s for s in samples
+        if isinstance(s.get("t"), (int, float))
+        and isinstance(s.get("runtime"), dict)
+        and "econ_usd_per_1k_tokens" in s["runtime"]
+    ]
+    if len(rows) < 2:
+        return ""  # rail never warmed up (or never existed) — skip
+    if not HAVE_MPL:
+        return _placeholder("cost & energy timeline")
+    t0 = rows[0]["t"]
+
+    def series(key: str) -> list[tuple[float, float]]:
+        return [
+            (s["t"] - t0, s["runtime"][key])
+            for s in rows if key in s["runtime"]
+        ]
+
+    fig, (ax_usd, ax_wh) = plt.subplots(2, 1, figsize=(7, 4), sharex=True)
+
+    usd = series("econ_usd_per_1k_tokens")
+    ax_usd.plot([t for t, _ in usd], [v for _, v in usd],
+                color=_PALETTE["primary"], linewidth=1.5, label="$/1K-tok")
+    marginal = series("econ_marginal_replica_usd_per_1k_tokens")
+    if marginal:
+        ax_usd.plot([t for t, _ in marginal], [v for _, v in marginal],
+                    color=_PALETTE["bad"], linewidth=1.2, linestyle="--",
+                    label="marginal replica $/1K-tok")
+    ax_usd.legend(fontsize=8, loc="upper right")
+    ax_usd.set_ylabel("$ / 1K tok")
+    ax_usd.set_title("Cost & energy")
+
+    wh = series("econ_wh_per_1k_tokens")
+    if wh:
+        ax_wh.plot([t for t, _ in wh], [v for _, v in wh],
+                   color=_PALETTE["warm"], linewidth=1.5)
+    ax_wh.set_ylabel("Wh / 1K tok")
+    ax_wh.set_xlabel("time (s)")
+
+    econ_events = [
+        e for e in events or []
+        if e.get("type") in ("cost_burn_exceeded", "replica_unprofitable")
+    ]
+    for ax in (ax_usd, ax_wh):
+        ax.grid(color=_PALETTE["grid"], axis="y")
+        for e in econ_events:
+            et = e.get("t")
+            if isinstance(et, (int, float)) and et >= t0:
+                ax.axvline(et - t0, color=_PALETTE["bad"], linestyle=":",
+                           linewidth=1)
+    for e in econ_events:
+        et = e.get("t")
+        if isinstance(et, (int, float)) and et >= t0:
+            ax_usd.text(et - t0, ax_usd.get_ylim()[1] * 0.9,
+                        str(e.get("type", "event")), fontsize=7, rotation=90,
+                        color=_PALETTE["bad"], va="top")
+    return _to_img(fig)
+
+
+def cost_pareto_chart(rows: list[dict[str, Any]]) -> str:
+    """Cost vs latency Pareto scatter over sweep cells: $/1K-tok (live
+    economics when the cell carried the rail, post-hoc cost otherwise)
+    against TTFT p95. The Pareto-efficient cells — no other cell both
+    cheaper AND faster — are highlighted and connected; everything
+    northeast of the frontier is paying for latency it isn't getting."""
+    pts = []
+    for r in rows:
+        econ = r.get("economics") if isinstance(r.get("economics"), dict) else {}
+        cost = econ.get("usd_per_1k_tokens", r.get("cost_per_1k_tokens"))
+        ttft = r.get("ttft_p95_ms")
+        # sweep CSV rows carry strings ("" for a cell that never priced)
+        try:
+            pts.append((
+                float(ttft), float(cost),
+                str(r.get("run_id") or r.get("concurrency") or "?"),
+            ))
+        except (TypeError, ValueError):
+            continue
+    if len(pts) < 2:
+        return ""  # a frontier needs at least two priced cells
+    if not HAVE_MPL:
+        return _placeholder("cost vs TTFT Pareto")
+    frontier = sorted(
+        p for p in pts
+        if not any(
+            q[0] <= p[0] and q[1] <= p[1] and q != p for q in pts
+        )
+    )
+    fig, ax = plt.subplots(figsize=(7, 3.6))
+    dominated = [p for p in pts if p not in frontier]
+    if dominated:
+        ax.scatter([p[0] for p in dominated], [p[1] for p in dominated],
+                   color=_PALETTE["cold"], s=36, label="dominated")
+    ax.scatter([p[0] for p in frontier], [p[1] for p in frontier],
+               color=_PALETTE["ok"], s=48, zorder=3, label="Pareto frontier")
+    ax.plot([p[0] for p in frontier], [p[1] for p in frontier],
+            color=_PALETTE["ok"], linewidth=1, linestyle="--", zorder=2)
+    for t, c, name in pts:
+        ax.annotate(name, (t, c), fontsize=7,
+                    xytext=(4, 4), textcoords="offset points")
+    ax.set_xlabel("TTFT p95 (ms)")
+    ax.set_ylabel("$ / 1K tok")
+    ax.set_title("Cost vs TTFT p95")
+    ax.legend(fontsize=8, loc="upper left")
+    ax.grid(color=_PALETTE["grid"])
+    return _to_img(fig)
+
+
 def perf_trajectory_chart(traj: dict[str, Any]) -> str:
     """The perf trajectory (analysis/trajectory.py) as two stacked lanes:
     device tokens/s/chip for REAL rounds, compile-time + step-ratio for

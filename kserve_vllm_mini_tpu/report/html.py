@@ -495,6 +495,63 @@ def _disagg_section(results: dict[str, Any]) -> str:
     return "".join(parts)
 
 
+def _economics_section(
+    results: dict[str, Any], run_dir: Optional[Path] = None,
+    samples: Optional[list[dict[str, Any]]] = None,
+) -> str:
+    """The "Economics" section (docs/ECONOMICS.md): the live rail's
+    rolling $/1K-tok, Wh/1K-tok and hourly burn from the results
+    ``economics`` block, the cost/energy timeline lanes, and the
+    cost_burn_exceeded / replica_unprofitable monitor events. Rendered
+    only when the run priced itself — an unpriced engine's report simply
+    has no section; the post-hoc cost estimate keeps its own card."""
+    econ = results.get("economics")
+    econ = econ if isinstance(econ, dict) else {}
+    chart = ""
+    if samples is not None:
+        events = (results.get("monitor") or {}).get("events") or []
+        chart = charts.econ_timeline_chart(samples, events)
+    if not econ and not chart:
+        return ""
+    parts = ["<section><h2>Economics</h2>"]
+    facts = []
+    if econ.get("usd_per_1k_tokens") is not None:
+        facts.append(f"live ${econ['usd_per_1k_tokens']:.4f}/1K tok")
+    if econ.get("wh_per_1k_tokens") is not None:
+        facts.append(f"{econ['wh_per_1k_tokens']:.3f} Wh/1K tok")
+    if econ.get("usd_per_hour") is not None:
+        facts.append(f"${econ['usd_per_hour']:.2f}/h burn")
+    if econ.get("tokens_per_sec") is not None:
+        facts.append(f"{econ['tokens_per_sec']:.1f} tok/s priced")
+    if econ.get("marginal_replica_usd_per_1k_tokens") is not None:
+        facts.append(
+            "marginal replica "
+            f"${econ['marginal_replica_usd_per_1k_tokens']:.4f}/1K tok"
+        )
+    posthoc = results.get("cost_per_1k_tokens")
+    if posthoc is not None and econ.get("usd_per_1k_tokens"):
+        facts.append(f"post-hoc estimate ${posthoc:.4f}/1K tok")
+    if facts:
+        parts.append(f"<p>{html_mod.escape(' · '.join(facts))}</p>")
+    if econ.get("source"):
+        parts.append(
+            f"<p class='l'>source: {html_mod.escape(str(econ['source']))}</p>"
+        )
+    for e in ((results.get("monitor") or {}).get("events") or []):
+        if isinstance(e, dict) and e.get("type") in (
+            "cost_burn_exceeded", "replica_unprofitable"
+        ):
+            parts.append(
+                f"<p class='warn'>event @{e.get('t', 0):.0f}: "
+                f"<b>{html_mod.escape(str(e.get('type')))}</b> — "
+                f"{html_mod.escape(str(e.get('detail', '')))}</p>"
+            )
+    if chart:
+        parts.append(chart)
+    parts.append("</section>")
+    return "".join(parts)
+
+
 def _fleet_section(results: dict[str, Any]) -> str:
     """The "Serving fleet" section (docs/FLEET.md): replica counts,
     placement mix, re-placements the clients never saw, fleet-level
@@ -675,6 +732,7 @@ def generate_single_run_html(
 
         timeline_samples = RunDir(run_dir).read_timeline()
     sections.append(_kv_cache_section(results, run_dir, timeline_samples))
+    sections.append(_economics_section(results, run_dir, timeline_samples))
     sections.append(_disagg_section(results))
     sections.append(_fleet_section(results))
     sections.append(_resilience_section(results))
@@ -730,6 +788,16 @@ def generate_grid_sweep_html(csv_path: Path, metric: str = "p95_ms") -> str:
             )
         )
         sections.append("</section>")
+    # cost-vs-latency Pareto over the whole sweep (docs/ECONOMICS.md):
+    # cells that carried neither a live nor a post-hoc price drop out of
+    # the scatter; with fewer than two priced cells there is no frontier
+    pareto = charts.cost_pareto_chart(rows)
+    if pareto:
+        sections.append(
+            "<section><h2>Cost vs TTFT p95 (Pareto)</h2>"
+            "<p>Cells northeast of the frontier pay for latency they "
+            f"aren't getting.</p>{pareto}</section>"
+        )
     return (
         f"<html><head><meta charset='utf-8'><style>{_CSS}</style></head>"
         f"<body>{''.join(sections)}</body></html>"

@@ -396,6 +396,14 @@ class EngineConfig:
     # of timing out after burning decode steps. None = no server
     # default; client-supplied deadlines still apply.
     default_deadline_s: Optional[float] = None
+    # Live economics rail (docs/ECONOMICS.md): accelerator label used to
+    # price the deployment against tpu-cost.yaml. None = auto-detect
+    # (the device_kind of TPU backends; CPU backends get NO rail — the
+    # absent-not-zero rule, a fabricated $0/1K-tok on a dev box would
+    # poison fleet aggregation). Setting it explicitly turns the rail on
+    # regardless of backend, which is how tests and mock fleets price a
+    # CPU engine as if it were the named chip.
+    econ_accelerator: Optional[str] = None
 
 
 @dataclass
@@ -955,6 +963,32 @@ class Engine:
         self._kv_gauges: dict[str, Any] = {}
         self._kv_gauges_t = 0.0          # last refresh (scheduler clock)
         self._hbm_peak_seen = 0
+
+        # Live economics rail (docs/ECONOMICS.md): rolling-window $/1K-tok,
+        # Wh/1K-tok, and the $/hr accrual derived from the busy/token
+        # counters this engine already keeps, priced by tpu-cost.yaml.
+        # Auto-detected on TPU backends (device_kind names the chip the
+        # pricing sheet matches fuzzily), forced on any backend by
+        # ecfg.econ_accelerator, and absent — no object, no keys, no
+        # fabricated $0 — everywhere else. Fed/read under _obs_lock only
+        # (the PR 8 gauge-cache discipline: published under a lock, not
+        # annotated away).
+        self._econ = None
+        accel = self.ecfg.econ_accelerator
+        if not accel:
+            try:
+                dev = jax.devices()[0]
+                if getattr(dev, "platform", "") == "tpu":
+                    accel = getattr(dev, "device_kind", "") or "tpu"
+            except Exception:
+                accel = None
+        if accel:
+            from kserve_vllm_mini_tpu.costs.live import LiveEconomics
+
+            self._econ = LiveEconomics(
+                accelerator=accel,
+                chips=self.mesh.size if self.mesh is not None else 1,
+            )
 
         # Resilience state (docs/RESILIENCE.md). ONE lock guards every
         # cross-thread field: the scheduler beats/EMAs, the watchdog's
@@ -4347,6 +4381,23 @@ class Engine:
         s["compiled_flops"] = cs["compiled_flops"]
         s["compiled_bytes"] = cs["compiled_bytes"]
         s["compile_peak_bytes"] = cs["compile_peak_bytes"]
+        # live economics rail (docs/ECONOMICS.md): one rolling-window
+        # observation per snapshot, fed the busy/token values THIS
+        # snapshot already read, under _obs_lock (scrapers from any
+        # thread drive it). The $/hr accrual is a level gauge known from
+        # construction; the per-token rates appear once the window holds
+        # token progress — absent while warming up, never $0. No rail
+        # object (CPU backend, no econ_accelerator) -> no keys at all.
+        if self._econ is not None:
+            with self._obs_lock:
+                econ = self._econ.observe(
+                    time.time(), s["busy_s"], s["decode_tokens"]
+                )
+            s["econ_usd_per_hour"] = self._econ.usd_per_hour
+            if econ:
+                s["econ_usd_per_1k_tokens"] = econ["usd_per_1k_tokens"]
+                s["econ_wh_per_1k_tokens"] = econ["wh_per_1k_tokens"]
+                s["econ_tokens_per_sec"] = econ["tokens_per_sec"]
         return s
 
     def kv_bytes_per_token(self) -> int:
@@ -4535,6 +4586,31 @@ class Engine:
             "queue_depth": s["kv_handoff_queue_depth"],
             "degraded": bool(s["disagg_degraded"]),
         }
+
+    def economics_snapshot(self) -> dict[str, Any]:
+        """The results.json ``economics`` block (docs/ECONOMICS.md):
+        live-rail gauges keyed the way the analyzer's /metrics scrape
+        maps them (analysis/telemetry.py ECON_METRIC_KEYS) — snapshotted
+        directly in self-serve runs, where it is authoritative. Empty on
+        engines without the rail (CPU backends with no econ_accelerator:
+        no block, never fabricated $0 — the same absence contract as
+        kv_cache/disagg). The marginal-replica gauge never appears here:
+        it is a fleet-router aggregate, not a single-engine fact."""
+        if self._econ is None:
+            return {}
+        s = self.snapshot_stats()
+        block: dict[str, Any] = {
+            "source": "engine:snapshot",
+            "usd_per_hour": s["econ_usd_per_hour"],
+        }
+        for stats_key, sub in (
+            ("econ_usd_per_1k_tokens", "usd_per_1k_tokens"),
+            ("econ_wh_per_1k_tokens", "wh_per_1k_tokens"),
+            ("econ_tokens_per_sec", "tokens_per_sec"),
+        ):
+            if stats_key in s:
+                block[sub] = s[stats_key]
+        return block
 
     def compile_stats_snapshot(self) -> dict[str, Any]:
         """The results.json ``compile_stats`` block (docs/PROFILING.md):

@@ -9,6 +9,7 @@ thread", which is what makes the whole framework runnable with no cluster.
 from __future__ import annotations
 
 import asyncio
+import os
 import socket
 import threading
 import time
@@ -83,6 +84,13 @@ def start_local_server(
             int(profile["kv_pool_blocks"])
             if profile.get("kv_pool_blocks") is not None
             else None
+        ),
+        # live economics (docs/ECONOMICS.md): same precedence as the
+        # serve CLI — profile key, then env — so a self-serve bench can
+        # price itself on any backend; TPU backends auto-detect anyway
+        econ_accelerator=(
+            profile.get("econ_accelerator")
+            or os.environ.get("KVMINI_ECON_ACCELERATOR") or None
         ),
         lora_adapters=profile.get("lora"),
         lora_demo=int(profile.get("lora_demo", 0)),

@@ -80,6 +80,9 @@ def build_engine(
     fault_seed: int = 0,           # deterministic fault triggers
     watchdog: bool = False,        # wedged-sweep watchdog (docs/RESILIENCE.md)
     default_deadline_s: Optional[float] = None,  # deadline-aware shedding
+    econ_accelerator: Optional[str] = None,  # price the live economics
+                                   # rail as this chip (docs/ECONOMICS.md);
+                                   # None = TPU auto-detect, no rail on CPU
 ) -> tuple[Engine, Tokenizer, str]:
     """Construct (engine, tokenizer, model_name) from a preset or checkpoint.
 
@@ -329,6 +332,7 @@ def build_engine(
         fault_seed=fault_seed,
         watchdog=watchdog,
         default_deadline_s=default_deadline_s,
+        econ_accelerator=econ_accelerator,
     )
     engine = Engine(
         params, cfg, ecfg, mesh=mesh, pad_id=tok.pad_id, drafter=drafter_pair,
@@ -1508,6 +1512,28 @@ def make_app(engine: Engine, tok: Tokenizer, model_name: str,
                 "# TYPE kvmini_tpu_hbm_bytes_limit gauge",
                 f"kvmini_tpu_hbm_bytes_limit {s['hbm_bytes_limit']}",
             ]
+        if "econ_usd_per_hour" in s:  # live economics rail (docs/
+            # ECONOMICS.md): priced engines only (TPU backend or an
+            # explicit econ_accelerator). The $/hr accrual is always
+            # present once the rail exists; the rolling-window rates
+            # appear only after the window sees token progress — a CPU
+            # dev box or an idle engine never exports a fabricated $0
+            lines += [
+                "# TYPE kvmini_tpu_econ_usd_per_hour gauge",
+                f"kvmini_tpu_econ_usd_per_hour {s['econ_usd_per_hour']:.6f}",
+            ]
+            if "econ_usd_per_1k_tokens" in s:
+                lines += [
+                    "# TYPE kvmini_tpu_econ_usd_per_1k_tokens gauge",
+                    "kvmini_tpu_econ_usd_per_1k_tokens "
+                    f"{s['econ_usd_per_1k_tokens']:.6f}",
+                    "# TYPE kvmini_tpu_econ_wh_per_1k_tokens gauge",
+                    "kvmini_tpu_econ_wh_per_1k_tokens "
+                    f"{s['econ_wh_per_1k_tokens']:.6f}",
+                    "# TYPE kvmini_tpu_econ_tokens_per_sec gauge",
+                    "kvmini_tpu_econ_tokens_per_sec "
+                    f"{s['econ_tokens_per_sec']:.6f}",
+                ]
         # per-phase latency histograms (docs/TRACING.md): queue / prefill /
         # decode / emit durations the engine observes at phase transitions
         from kserve_vllm_mini_tpu.runtime.tracing import render_phase_histograms
@@ -1900,6 +1926,14 @@ def register(parser: argparse.ArgumentParser) -> None:
                              "--target local` drives). Also "
                              "$KVMINI_ALLOW_FAULT_INJECTION=1. Never "
                              "enable on a production server")
+    parser.add_argument("--econ-accelerator", default=None,
+                        help="Price the live economics rail "
+                             "($/1K-tok, Wh/1K-tok on /metrics) as this "
+                             "chip from tpu-cost.yaml (e.g. 'v5e'). "
+                             "Default: auto-detect on TPU backends; CPU "
+                             "backends export NO economics. Also "
+                             "$KVMINI_ECON_ACCELERATOR "
+                             "(docs/ECONOMICS.md)")
 
 
 def _parse_lora_args(items: Optional[list]) -> Optional[dict[str, str]]:
@@ -2084,6 +2118,10 @@ def run(args: argparse.Namespace) -> int:
         watchdog=watchdog,
         default_deadline_s=(
             default_deadline_ms / 1000.0 if default_deadline_ms else None
+        ),
+        econ_accelerator=(
+            args.econ_accelerator
+            or os.environ.get("KVMINI_ECON_ACCELERATOR") or None
         ),
     )
     if watchdog and args.watchdog_min_s is not None:

@@ -117,7 +117,7 @@ def test_chart_template_covers_multihost_and_quant():
 
 def test_dashboards_valid_and_tpu_native():
     files = sorted((REPO / "dashboards").glob("*.json"))
-    assert len(files) == 8
+    assert len(files) == 9
     uids = set()
     for f in files:
         d = json.loads(f.read_text())
@@ -130,7 +130,7 @@ def test_dashboards_valid_and_tpu_native():
         assert "DCGM" not in text and "nvidia" not in text.lower(), (
             f"{f.name} references GPU metrics"
         )
-    assert len(uids) == 8  # unique dashboard uids
+    assert len(uids) == 9  # unique dashboard uids
 
 
 def test_run_timeline_dashboard_uses_windowed_duty():
@@ -210,6 +210,24 @@ def test_utilization_dashboard_queries_tpu_metrics():
     d = (REPO / "dashboards" / "tpu-utilization.json").read_text()
     assert "accelerator_duty_cycle" in d
     assert "accelerator_memory_used" in d
+
+
+def test_cost_energy_dashboard_queries_econ_gauges():
+    """The cost/energy board (docs/ECONOMICS.md) must query the live
+    econ rail the runtime actually emits — the $/1K-tok gauge beside
+    the fleet's marginal-replica attribution, the Wh and $/hr lanes,
+    and the implied-ratio sanity panel that recomputes $/1K-tok from
+    usd_per_hour / (3.6 x tokens_per_sec) so a derivation drift is
+    visible on the board itself."""
+    d = (REPO / "dashboards" / "cost-energy.json").read_text()
+    assert "kvmini_tpu_econ_usd_per_1k_tokens" in d
+    assert "kvmini_tpu_econ_marginal_replica_usd_per_1k_tokens" in d
+    assert "kvmini_tpu_econ_wh_per_1k_tokens" in d
+    assert "kvmini_tpu_econ_usd_per_hour" in d
+    assert "kvmini_tpu_econ_tokens_per_sec" in d
+    assert "rate(kvmini_tpu_busy_seconds_total" in d
+    assert ("kvmini_tpu_econ_usd_per_hour / (3.6 * "
+            "kvmini_tpu_econ_tokens_per_sec)") in d
 
 
 # -- matrix sheet ------------------------------------------------------------

@@ -1,0 +1,457 @@
+"""Live cost & energy rail (docs/ECONOMICS.md): `make econ-smoke`.
+
+Covers the online attribution end to end, JAX-free: the rolling-window
+derivation (costs/live.py) and its agreement with the post-hoc estimator
+on a steady run, the loud pricing-sheet validation, the degenerate
+energy-integration edge cases, the live cost budget riding the burn-rate
+machinery, both economics event rules pos+neg (detector-level and
+through the real scrape->sample->detector path against the mock
+server's scripted /metrics), the typed `Results.economics` block, and
+the cost-aware autoscaling A/B: the marginal-replica shed, vetoed by
+queue pressure and by an SLO breach.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+import pytest
+
+from kserve_vllm_mini_tpu.analysis.telemetry import economics_block
+from kserve_vllm_mini_tpu.autoscale.controller import (
+    PolicyConfig,
+    Signals,
+    desired_replicas,
+)
+from kserve_vllm_mini_tpu.core.rundir import RequestRecord, RunDir
+from kserve_vllm_mini_tpu.core.schema import validate_economics
+from kserve_vllm_mini_tpu.costs.estimator import estimate_cost
+from kserve_vllm_mini_tpu.costs.live import (
+    LiveEconomics,
+    hourly_usd,
+    marginal_replica_usd_per_1k_tokens,
+    usd_per_1k_tokens,
+)
+from kserve_vllm_mini_tpu.costs.pricing import load_pricing
+from kserve_vllm_mini_tpu.energy.collector import integrate_energy
+from kserve_vllm_mini_tpu.monitor.burnrate import BURN_CAP, burn_rates
+from kserve_vllm_mini_tpu.monitor.events import EventDetector
+from kserve_vllm_mini_tpu.monitor.sampler import MonitorConfig, RunMonitor
+from tests.mock_server import MockServer, scripted_metrics
+
+
+# -- rolling-window derivation (costs/live.py) -------------------------------
+
+def test_live_window_absent_until_token_progress():
+    """Absent-not-zero: no gauges until the window holds two samples AND
+    tokens moved — an idle priced engine must not export $0/1K-tok."""
+    econ = LiveEconomics(accelerator="v5e", chips=1)
+    assert econ.observe(0.0, 0.0, 0.0) == {}          # one sample: no delta
+    assert econ.observe(1.0, 0.5, 0.0) == {}          # busy but zero tokens
+    snap = econ.observe(2.0, 1.5, 100.0)              # tokens moved
+    assert snap["usd_per_1k_tokens"] > 0.0
+    assert snap["tokens_per_sec"] == pytest.approx(50.0)  # 100 tok / 2 s
+
+
+def test_live_derivation_closed():
+    """The exported $/1K-tok must equal usd_per_hour / (3.6 x tok/s) —
+    the same closure core/schema.validate_economics enforces."""
+    econ = LiveEconomics(accelerator="v5e", chips=4, window_s=60.0)
+    econ.observe(0.0, 0.0, 0.0)
+    snap = econ.observe(10.0, 8.0, 2000.0)
+    # sheet: v5e @ 1.20/chip-hr x 4 chips x (1 + 0.15 overhead)
+    assert snap["usd_per_hour"] == pytest.approx(1.20 * 4 * 1.15)
+    assert snap["usd_per_1k_tokens"] == pytest.approx(
+        snap["usd_per_hour"] / (3.6 * snap["tokens_per_sec"])
+    )
+    assert snap["duty"] == pytest.approx(0.8)
+    assert snap["power_provenance_measured"] == 0.0   # modeled chain
+
+
+def test_live_counter_reset_yields_absent_not_negative():
+    econ = LiveEconomics(accelerator="v5e")
+    econ.observe(0.0, 0.0, 500.0)
+    assert econ.observe(1.0, 0.5, 20.0) == {}         # token counter reset
+
+
+def test_live_measured_watts_provenance():
+    econ = LiveEconomics(accelerator="v5e", watts_fn=lambda: 300.0)
+    econ.observe(0.0, 0.0, 0.0)
+    snap = econ.observe(3600.0, 1800.0, 1_000_000.0)
+    assert snap["watts"] == 300.0
+    assert snap["power_provenance_measured"] == 1.0
+    # 300 W for 1 h over 1M tokens -> 0.3 Wh/1K-tok
+    assert snap["wh_per_1k_tokens"] == pytest.approx(0.3)
+
+
+def test_marginal_replica_is_least_productive():
+    # the marginal attribution prices the SLOWEST healthy replica's tokens
+    assert marginal_replica_usd_per_1k_tokens(
+        [100.0, 2.0, 0.0], 1.38
+    ) == pytest.approx(usd_per_1k_tokens(1.38, 2.0))
+    # no replica with token progress: absent, never $0
+    assert marginal_replica_usd_per_1k_tokens([0.0, 0.0], 1.38) is None
+    assert marginal_replica_usd_per_1k_tokens([], 1.38) is None
+
+
+# -- loud pricing-sheet validation (costs/pricing.py) ------------------------
+
+def test_pricing_unknown_top_key_is_loud(tmp_path):
+    sheet = tmp_path / "cost.yaml"
+    sheet.write_text("tpu_chip_hourli:\n  default: 1.5\n")  # typo
+    with pytest.raises(SystemExit, match="tpu_chip_hourli"):
+        load_pricing(sheet)
+
+
+def test_pricing_non_numeric_price_is_loud(tmp_path):
+    sheet = tmp_path / "cost.yaml"
+    sheet.write_text("tpu_chip_hourly:\n  default: '1,20'\n")
+    with pytest.raises(SystemExit, match="1,20"):
+        load_pricing(sheet)
+
+
+def test_pricing_missing_default_is_loud(tmp_path):
+    sheet = tmp_path / "cost.yaml"
+    sheet.write_text("tpu_chip_hourly:\n  v5e: 1.20\n")
+    with pytest.raises(SystemExit, match="default"):
+        load_pricing(sheet)
+
+
+def test_pricing_default_sheet_still_loads():
+    pricing = load_pricing()
+    price, key = pricing.chip_price("v5e-8")
+    assert key == "v5e" and price == 1.20
+    rate, _ = hourly_usd(pricing, "v5e", 1)
+    assert rate == pytest.approx(1.20 * 1.15)
+
+
+# -- degenerate energy integration (energy/collector.py) ---------------------
+
+def _run_with_power(tmp_path, samples):
+    rd = RunDir.create(tmp_path, "run")
+    t0 = 1_700_000_000.0
+    rd.write_requests([
+        RequestRecord(request_id=f"r{i}", start_ts=t0 + i,
+                      end_ts=t0 + i + 0.5, tokens_out=50, ok=True,
+                      status_code=200)
+        for i in range(4)
+    ])
+    rd.write_power({"samples": samples, "provenance": "measured",
+                    "interval_s": 1.0})
+    return rd
+
+
+def test_energy_single_sample_is_zero_with_note(tmp_path):
+    doc = integrate_energy(
+        _run_with_power(tmp_path, [{"t": 1_700_000_001.0, "watts": 200.0}])
+    )
+    assert doc["energy_wh"] == 0.0
+    assert "single power sample" in doc["note"]
+
+
+def test_energy_duplicate_timestamps_zero_with_note(tmp_path):
+    t = 1_700_000_001.0
+    doc = integrate_energy(_run_with_power(
+        tmp_path,
+        [{"t": t, "watts": 200.0}, {"t": t, "watts": 250.0}],
+    ))
+    assert doc["energy_wh"] == 0.0
+    assert "duplicate ticks" in doc["note"]
+
+
+def test_energy_unsorted_samples_never_negative(tmp_path):
+    t0 = 1_700_000_000.0
+    doc = integrate_energy(_run_with_power(
+        tmp_path,
+        [{"t": t0 + 3.0, "watts": 100.0}, {"t": t0 + 1.0, "watts": 100.0}],
+    ))
+    assert doc["energy_wh"] >= 0.0
+    assert "note" not in doc          # a real span: no degenerate flag
+
+
+# -- live cost budget on the burn-rate machinery (monitor/burnrate.py) -------
+
+def test_cost_budget_burns_when_sampler_injects_gauge():
+    """cost_per_1k_tokens_max is live ONLY when the window carries the
+    injected econ gauge (monitor/sampler.py) — absent otherwise."""
+    budgets = {"cost_per_1k_tokens_max": 0.10}
+    assert burn_rates({"p95_ms": 50.0}, budgets) == {}
+    rates = burn_rates({"cost_per_1k_tokens": 0.25}, budgets)
+    assert rates["cost_per_1k_tokens_max"] == pytest.approx(2.5)
+
+
+def test_cost_budget_zero_caps_at_burn_cap():
+    # max-direction budget at 0: any spend is infinite burn, capped so
+    # the JSONL stays strict-JSON (no Infinity)
+    rates = burn_rates({"cost_per_1k_tokens": 0.01},
+                       {"cost_per_1k_tokens_max": 0.0})
+    assert rates["cost_per_1k_tokens_max"] == BURN_CAP
+    json.dumps(rates)
+
+
+def test_min_direction_budget_at_value_zero_caps():
+    rates = burn_rates({"tokens_per_sec": 0.0}, {"tokens_per_sec_min": 100.0})
+    assert rates["tokens_per_sec_min"] == BURN_CAP
+
+
+# -- economics event rules pos+neg (monitor/events.py) -----------------------
+
+def _econ_sample(t, **runtime):
+    return {"t": float(t), "runtime": {k: float(v) for k, v in runtime.items()}}
+
+
+def test_cost_burn_fires_after_n_over_budget_samples():
+    det = EventDetector(warmup_s=0.0, cost_budget_usd_per_1k_tok=0.10,
+                        cost_burn_samples=3)
+    fired = []
+    for i in range(5):
+        fired += det.observe(_econ_sample(i, econ_usd_per_1k_tokens=0.25))
+    assert [e.type for e in fired] == ["cost_burn_exceeded"]
+    assert fired[0].t == 2.0                          # 3rd consecutive
+    assert fired[0].data["burn_rate"] == pytest.approx(2.5)
+
+
+def test_cost_burn_run_resets_under_budget_and_without_budget():
+    det = EventDetector(warmup_s=0.0, cost_budget_usd_per_1k_tok=0.10,
+                        cost_burn_samples=3)
+    fired = []
+    costs = [0.25, 0.25, 0.05, 0.25, 0.25]            # dip resets the run
+    for i, c in enumerate(costs):
+        fired += det.observe(_econ_sample(i, econ_usd_per_1k_tokens=c))
+    assert fired == []
+    # no budget configured: the rule is inert however pricey the tokens
+    inert = EventDetector(warmup_s=0.0)
+    for i in range(5):
+        assert inert.observe(_econ_sample(i, econ_usd_per_1k_tokens=9.9)) == []
+
+
+def test_cost_burn_immune_during_warmup():
+    # cold-start windows price the first tokens absurdly high by
+    # construction; the warmup must absorb them
+    det = EventDetector(warmup_s=10.0, cost_budget_usd_per_1k_tok=0.10,
+                        cost_burn_samples=2)
+    fired = []
+    for i in range(6):
+        fired += det.observe(_econ_sample(i, econ_usd_per_1k_tokens=5.0))
+    assert fired == []
+
+
+def test_replica_unprofitable_fires_with_two_live():
+    det = EventDetector(warmup_s=0.0, cost_budget_usd_per_1k_tok=0.10,
+                        unprofitable_samples=3)
+    fired = []
+    for i in range(4):
+        fired += det.observe(_econ_sample(
+            i, econ_marginal_replica_usd_per_1k_tokens=0.40,
+            fleet_replicas_live=2,
+        ))
+    assert [e.type for e in fired] == ["replica_unprofitable"]
+    assert fired[0].data["replicas_live"] == 2.0
+
+
+def test_replica_unprofitable_never_on_last_replica():
+    # scaling to zero is an availability decision, not an economics one
+    det = EventDetector(warmup_s=0.0, cost_budget_usd_per_1k_tok=0.10,
+                        unprofitable_samples=2)
+    fired = []
+    for i in range(6):
+        fired += det.observe(_econ_sample(
+            i, econ_marginal_replica_usd_per_1k_tokens=0.40,
+            fleet_replicas_live=1,
+        ))
+    assert fired == []
+
+
+def test_econ_events_fire_via_scripted_mock_metrics(tmp_path):
+    """The REAL scrape -> sample -> detector path: a mock /metrics serving
+    an over-budget $/1K-tok gauge and an over-budget marginal-replica
+    gauge with 2 replicas live must raise BOTH economics events."""
+    async def main():
+        script = scripted_metrics(
+            rates={"kvmini_tpu_decode_tokens_total": 100.0,
+                   "kvmini_tpu_busy_seconds_total": 0.9},
+            base={"kvmini_tpu_econ_usd_per_1k_tokens": 0.25,
+                  "kvmini_tpu_econ_usd_per_hour": 1.38,
+                  "kvmini_tpu_econ_tokens_per_sec": 1.5,
+                  "kvmini_tpu_econ_wh_per_1k_tokens": 2.0,
+                  "kvmini_tpu_econ_marginal_replica_usd_per_1k_tokens": 0.40,
+                  "kvmini_tpu_fleet_replicas_live": 2.0},
+        )
+        async with MockServer(metrics_script=script) as srv:
+            mon = RunMonitor(
+                tmp_path / "timeline.jsonl", endpoint=srv.url,
+                cfg=MonitorConfig(interval_s=0.08, warmup_s=0.0,
+                                  cost_budget_usd_per_1k_tok=0.05,
+                                  cost_burn_samples=3,
+                                  unprofitable_samples=3),
+            )
+            mon.start()
+            await asyncio.sleep(1.0)
+            return mon.stop()
+
+    summary = asyncio.run(main())
+    types = {e["type"] for e in summary["events"]}
+    assert "cost_burn_exceeded" in types
+    assert "replica_unprofitable" in types
+    # the econ gauges rode into the timeline samples (prefix-stripped)
+    with (tmp_path / "timeline.jsonl").open() as f:
+        rows = [json.loads(line) for line in f]
+    assert any(
+        "econ_usd_per_1k_tokens" in (r.get("runtime") or {}) for r in rows
+    )
+
+
+# -- Results.economics block + validator (telemetry/schema) ------------------
+
+_ENGINE_GAUGES = {
+    "kvmini_tpu_econ_usd_per_hour": 1.38,
+    "kvmini_tpu_econ_tokens_per_sec": 50.0,
+    "kvmini_tpu_econ_usd_per_1k_tokens": 1.38 / (3.6 * 50.0),
+    "kvmini_tpu_econ_wh_per_1k_tokens": 1.1,
+}
+
+
+def test_economics_block_from_scrape_validates():
+    doc = economics_block("http://x", runtime_metrics=_ENGINE_GAUGES)
+    block = doc["economics"]
+    assert block["source"] == "metrics:scrape"
+    assert validate_economics(block) == []
+
+
+def test_economics_block_absent_on_unpriced_engine():
+    # a CPU backend exports no econ_* series: NO block, never $0
+    assert economics_block(
+        "http://x", runtime_metrics={"kvmini_tpu_duty_cycle": 0.5}
+    ) == {}
+    assert economics_block(None) == {}
+
+
+def test_validate_economics_closure_and_fleet_exemption():
+    skewed = {
+        # fleet totals: label-SUM of price/rate, but the MEAN of ratios —
+        # legitimately different from the ratio of sums on a skewed fleet
+        "usd_per_hour": 2.76, "tokens_per_sec": 102.0,
+        "usd_per_1k_tokens": 0.12,
+        "marginal_replica_usd_per_1k_tokens": 0.22,
+        "source": "metrics:scrape",
+    }
+    assert validate_economics(skewed) == []
+    single = dict(skewed)
+    del single["marginal_replica_usd_per_1k_tokens"]
+    errs = validate_economics(single)
+    assert errs and "does not match" in errs[0]
+
+
+def test_validate_economics_rejects_zero_hourly():
+    # a block that exists but prices the deployment at $0/hr is a
+    # pricing-sheet failure, not a cheap fleet
+    assert validate_economics({"usd_per_hour": 0.0})
+    assert validate_economics({"usd_per_hour": -1.0})
+    assert validate_economics("nope")
+
+
+# -- live vs post-hoc agreement (acceptance: within 10%) ---------------------
+
+def test_live_agrees_with_posthoc_estimator_on_steady_run(tmp_path):
+    """Same pricing sheet, same window: the rolling-window gauge and the
+    whole-run estimator must price a steady run within 10% of each other
+    (docs/ECONOMICS.md 'Reconciling live vs post-hoc')."""
+    pricing = load_pricing()
+    t0 = 1_700_000_000.0
+    duration, n, toks_each = 60.0, 120, 50
+    rd = RunDir.create(tmp_path, "steady")
+    rd.write_requests([
+        RequestRecord(request_id=f"r{i:04d}",
+                      start_ts=t0 + i * (duration / n),
+                      end_ts=t0 + i * (duration / n) + duration / n,
+                      tokens_out=toks_each, ok=True, status_code=200)
+        for i in range(n)
+    ])
+    post = estimate_cost(rd, pricing, chips=1, accelerator="v5e",
+                         cpu_cores=0.0, memory_gib=0.0, merge=False)
+
+    live = LiveEconomics(accelerator="v5e", chips=1, pricing=pricing,
+                         window_s=duration * 2)
+    total_tokens = float(n * toks_each)
+    for k in range(13):                                # one sample per 5 s
+        t = t0 + duration * k / 12.0
+        live.observe(t, 0.8 * (t - t0), total_tokens * k / 12.0)
+    snap = live.snapshot()
+    assert snap, "steady window must price"
+    assert snap["usd_per_1k_tokens"] == pytest.approx(
+        post["cost_per_1k_tokens"], rel=0.10
+    )
+
+
+# -- cost-aware autoscaling A/B (autoscale/controller.py) --------------------
+
+_BUDGET = 0.10
+_COST_CFG = PolicyConfig(cost_aware=True, cost_budget_usd_per_1k_tok=_BUDGET)
+_PLAIN_CFG = PolicyConfig()
+
+
+def test_cost_aware_sheds_marginal_replica_plain_policy_holds():
+    over = Signals(duty_cycle=0.4, queue_depth=0.0,
+                   marginal_usd_per_1k_tok=0.40)
+    assert desired_replicas(2, over, _COST_CFG) == 1   # cost-aware: shed
+    assert desired_replicas(2, over, _PLAIN_CFG) == 2  # A/B: plain holds
+    # one replica per step, even from a bigger fleet (each shed re-prices)
+    assert desired_replicas(4, over, _COST_CFG) == 3
+
+
+def test_queue_pressure_vetoes_the_shed():
+    pressured = Signals(duty_cycle=0.4, queue_depth=9.0,   # 4.5/replica > 4
+                        marginal_usd_per_1k_tok=0.40)
+    assert desired_replicas(2, pressured, _COST_CFG) >= 2
+
+
+def test_slo_breach_vetoes_the_shed():
+    # a replica that keeps the fleet inside its latency budget is worth
+    # running at a loss: cost never outranks the SLO
+    breached = Signals(duty_cycle=0.4, queue_depth=0.0,
+                       marginal_usd_per_1k_tok=0.40, slo_breached=True)
+    assert desired_replicas(2, breached, _COST_CFG) >= 2
+
+
+def test_cost_rule_inert_without_signal_and_never_below_one():
+    no_rail = Signals(duty_cycle=0.4, queue_depth=0.0)  # marginal is None
+    assert desired_replicas(2, no_rail, _COST_CFG) == 2
+    over = Signals(duty_cycle=0.4, queue_depth=0.0,
+                   marginal_usd_per_1k_tok=0.40)
+    assert desired_replicas(1, over, _COST_CFG) == 1    # last replica stays
+
+
+def test_fleet_signals_derive_marginal_from_per_replica_scrape(monkeypatch):
+    """A 2-replica mock fleet: one warm, one nearly idle. The aggregated
+    signal must carry the idle replica's price as the marginal, and a
+    simulated cost-aware step must shed it while queue pressure holds."""
+    from kserve_vllm_mini_tpu.analysis import telemetry
+    from kserve_vllm_mini_tpu.autoscale import controller as mod
+
+    per_url = {
+        "http://warm": {"kvmini_tpu_duty_cycle": 0.5,
+                        "kvmini_tpu_queue_depth": 0.0,
+                        "kvmini_tpu_econ_usd_per_hour": 1.38,
+                        "kvmini_tpu_econ_tokens_per_sec": 100.0,
+                        "kvmini_tpu_econ_usd_per_1k_tokens":
+                            usd_per_1k_tokens(1.38, 100.0)},
+        "http://idle": {"kvmini_tpu_duty_cycle": 0.5,
+                        "kvmini_tpu_queue_depth": 0.0,
+                        "kvmini_tpu_econ_usd_per_hour": 1.38,
+                        "kvmini_tpu_econ_tokens_per_sec": 2.0,
+                        "kvmini_tpu_econ_usd_per_1k_tokens":
+                            usd_per_1k_tokens(1.38, 2.0)},
+    }
+    monkeypatch.setattr(telemetry, "scrape_runtime_metrics",
+                        lambda url, timeout_s=5.0: per_url[url])
+    sig = mod.fleet_signals(["http://warm", "http://idle"])
+    assert sig.valid
+    assert sig.marginal_usd_per_1k_tok == pytest.approx(
+        usd_per_1k_tokens(1.38, 2.0)
+    )
+    # the idle replica prices its tokens at ~$0.19/1K: over budget -> shed
+    assert desired_replicas(2, sig, _COST_CFG) == 1
+    # ... unless the queue says it is about to be needed
+    sig.queue_depth = 9.0
+    assert desired_replicas(2, sig, _COST_CFG) >= 2

@@ -74,8 +74,12 @@ def test_burn_rates_hand_computed():
     assert rates["p95_ms_max"] == pytest.approx(1.5)       # 150/100
     assert rates["error_rate_max"] == pytest.approx(2.0)   # 0.02/0.01
     assert rates["throughput_rps_min"] == pytest.approx(2.0)  # 10/5
-    # cost budget is not live-computable -> absent, not zero
+    # the cost budget is live only when the sampler injected the scraped
+    # econ gauge into the window (docs/ECONOMICS.md); this window carries
+    # none -> absent, not zero
     assert "cost_per_1k_tokens_max" not in rates
+    with_cost = burn_rates({**stats, "cost_per_1k_tokens": 1.5}, budgets)
+    assert with_cost["cost_per_1k_tokens_max"] == pytest.approx(1.5)
 
 
 def test_burn_rates_on_budget_is_one_and_caps_stay_json():
