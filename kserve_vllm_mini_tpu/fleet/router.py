@@ -843,6 +843,10 @@ class FleetRouter:
             for reason in PLACEMENT_REASONS:
                 lines.append(
                     "kvmini_tpu_fleet_placements_total"
+                    # fixed PLACEMENT_REASONS vocabulary: 0 here means
+                    # "observed zero times", not "unmeasured" — the
+                    # legitimate enumerated-counter exception to
+                    # absent-not-zero (kvmini: contract-ok)
                     f"{{reason=\"{reason}\"}} {self.placements.get(reason, 0)}"
                 )
             # ratio/percentile gauges as ONE fleet-level mean each (over

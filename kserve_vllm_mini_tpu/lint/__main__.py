@@ -34,8 +34,9 @@ def main(argv: list[str] | None = None) -> int:
                     "lockstep determinism, metrics/schema drift, workload "
                     "surfacing, thread-safety/lock discipline, dtype-flow "
                     "numerics, buffer lifecycle, mesh/sharding consistency, "
-                    "exception-path resource safety). See docs/LINTING.md "
-                    "for the rule table.",
+                    "exception-path resource safety, wire-protocol "
+                    "conformance, absent-not-zero contract drift). See "
+                    "docs/LINTING.md for the rule table.",
     )
     ap.add_argument("paths", nargs="*", type=Path,
                     help="files/dirs to lint (default: kserve_vllm_mini_tpu/)")
@@ -112,10 +113,14 @@ def main(argv: list[str] | None = None) -> int:
                   file=sys.stderr)
             return 2
         try:
-            subset = changed_scan_paths(Path.cwd(), paths, args.changed)
+            subset, skipped = changed_scan_paths(Path.cwd(), paths,
+                                                 args.changed)
         except RuntimeError as e:
             print(f"kvmini-lint: --changed: {e}", file=sys.stderr)
             return 2
+        if skipped:
+            print(f"kvmini-lint: --changed: skipping {len(skipped)} "
+                  f"deleted/renamed file(s): {', '.join(skipped)}")
         if not subset:
             print(f"kvmini-lint: no python files changed vs {args.changed} "
                   "— nothing to lint")

@@ -1,0 +1,373 @@
+"""KVM111-KVM113 — the absent-not-zero drift family.
+
+Three repo-wide contracts that are prose in docs/ECONOMICS.md,
+docs/MONITORING.md, and docs/API.md, mechanized:
+
+- **KVM111 — fabricated-zero exports**: a ``.get(key, 0)`` / ``or 0``
+  default flowing into a ``/metrics`` exposition f-string or a
+  ``merge_into_results`` block fabricates a measurement. The
+  absent-not-zero rule ("never a $0/1K-tok on unpriced engines"):
+  an unmeasured surface must be absent — no line at all — not zero.
+  Enumerated counters genuinely at zero (a fixed label vocabulary
+  where 0 means "observed zero times", not "unknown") are the
+  legitimate exception: mark them ``# kvmini: contract-ok``.
+- **KVM112 — event-taxonomy drift**: the monitor's ``EVENT_TYPES``
+  tuple vs the detector ``Event(t, "<type>", ...)`` emit sites vs the
+  ``e.get("type") == ...`` consumers in report/charts vs the
+  docs/MONITORING.md rows — the KVM032 analog for events. An emit or
+  consumer naming a type outside the taxonomy fires, as does a
+  taxonomy member nothing emits or nothing documents.
+- **KVM113 — HTTP-surface drift**: server/router route registrations
+  (``add_get``/``add_post``) vs ``tests/mock_server.py``'s routes vs
+  the docs/API.md endpoint table vs in-repo client call sites
+  (fleet/chaos/analysis/...). A route a client calls that the mock
+  can't serve fires — the mock fleet must stay a faithful JAX-free
+  twin. Every ``_shed_response`` must keep the 429 + Retry-After
+  shape clients and the mock agree on.
+
+Suppress a deliberate divergence with ``# kvmini: contract-ok``.
+
+The cross-surface checks reason from absence, so they stand down on
+partial scans (``index.full_scan``) — the emitter/consumer may live in
+an unscanned module. The per-site checks (KVM111 zero defaults, the
+KVM113 shed shape) hold on any scan.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Optional
+
+from kserve_vllm_mini_tpu.lint.diagnostics import Diagnostic, Suppressions
+from kserve_vllm_mini_tpu.lint.facts import FactIndex, ModuleFacts, iter_scope
+from kserve_vllm_mini_tpu.lint.metrics_drift import (
+    EXPOSITION_PREFIX,
+    _docstring_nodes,
+    _first_const,
+)
+
+EVENT_TYPES_NAME = re.compile(r"EVENT_TYPES$")
+# event consumers filter `e.get("type")` in the monitor itself and the
+# report/chart layer; a generic dict "type" key elsewhere (JSON schema
+# specs, OpenAI tool payloads) is not an event read
+EVENT_CONSUMER_PATH = re.compile(r"(^|/)(monitor|report)/")
+ROUTE_REGISTRARS = {"add_get", "add_post"}
+SERVER_PATH = re.compile(r"(^|/)runtime/")
+ROUTER_PATH = re.compile(r"(^|/)fleet/")
+CLIENT_PATH = re.compile(r"(^|/)(fleet|chaos|analysis|loadgen|probes)/")
+MOCK_PATH = re.compile(r"(^|/)mock_server\.py$")
+SHED_FN = "_shed_response"
+
+
+def _is_zero(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Constant)
+            and isinstance(node.value, (int, float))
+            and not isinstance(node.value, bool) and node.value == 0)
+
+
+def _zero_default(node: ast.AST) -> Optional[str]:
+    """`x.get(k, 0)` -> "get-default"; `x or 0` -> "or-zero"; else None."""
+    if (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "get" and len(node.args) >= 2
+            and _is_zero(node.args[1])):
+        return "get-default"
+    if (isinstance(node, ast.BoolOp) and isinstance(node.op, ast.Or)
+            and node.values and _is_zero(node.values[-1])):
+        return "or-zero"
+    return None
+
+
+class ContractChecker:
+    def __init__(self, index: FactIndex,
+                 doc_texts: Optional[dict[str, str]] = None):
+        self.index = index
+        self.doc_texts = doc_texts or {}
+        self.diags: list[Diagnostic] = []
+
+    def run(self) -> list[Diagnostic]:
+        self._check_fabricated_zero()
+        self._check_shed_shape()
+        if self.index.full_scan:
+            self._check_event_taxonomy()
+            self._check_http_surfaces()
+        return self.diags
+
+    def _emit(self, mod: ModuleFacts, line: int, code: str, msg: str,
+              ctx: str) -> None:
+        if mod.suppressions.is_suppressed(line, code):
+            return
+        self.diags.append(Diagnostic(mod.path, line, code, msg, context=ctx))
+
+    # -- KVM111 -------------------------------------------------------------
+    def _check_fabricated_zero(self) -> None:
+        for mod in self.index.modules.values():
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.JoinedStr):
+                    head = _first_const(node)
+                    m = EXPOSITION_PREFIX.match(head or "")
+                    if not m:
+                        continue
+                    for sub in ast.walk(node):
+                        kind = _zero_default(sub)
+                        if kind is not None:
+                            self._emit(
+                                mod, sub.lineno, "KVM111",
+                                f"'{m.group(1)}' is exported with a "
+                                f"fabricated zero ({kind}) — absent-not-"
+                                "zero (docs/ECONOMICS.md): an unmeasured "
+                                "surface must be absent, never 0; gate on "
+                                "key presence, or mark a genuinely-zero "
+                                "enumerated counter `# kvmini: contract-ok`",
+                                m.group(1))
+                elif (isinstance(node, ast.Call)
+                      and isinstance(node.func, ast.Attribute)
+                      and node.func.attr == "merge_into_results"
+                      and node.args and isinstance(node.args[0], ast.Dict)):
+                    for k, v in zip(node.args[0].keys, node.args[0].values):
+                        kind = _zero_default(v)
+                        if kind is None or not (
+                                isinstance(k, ast.Constant)
+                                and isinstance(k.value, str)):
+                            continue
+                        self._emit(
+                            mod, v.lineno, "KVM111",
+                            f"results key '{k.value}' is written with a "
+                            f"fabricated zero ({kind}) — absent-not-zero: "
+                            "omit the key when the measurement is missing "
+                            "(gates/reports must see absence), or mark "
+                            "`# kvmini: contract-ok`",
+                            k.value)
+
+    # -- KVM112 -------------------------------------------------------------
+    def _check_event_taxonomy(self) -> None:
+        taxonomy: dict[str, tuple[ModuleFacts, int]] = {}
+        emits: dict[str, tuple[ModuleFacts, int]] = {}
+        consumers: dict[str, tuple[ModuleFacts, int]] = {}
+        for mod in self.index.modules.values():
+            is_consumer = bool(EVENT_CONSUMER_PATH.search(mod.path))
+            docstrings = _docstring_nodes(mod.tree)
+            for node in ast.walk(mod.tree):
+                if node in docstrings:
+                    continue
+                if isinstance(node, ast.Assign):
+                    if any(isinstance(t, ast.Name)
+                           and EVENT_TYPES_NAME.search(t.id)
+                           for t in node.targets) and isinstance(
+                               node.value, (ast.Tuple, ast.List)):
+                        for e in node.value.elts:
+                            if isinstance(e, ast.Constant) and isinstance(
+                                    e.value, str):
+                                taxonomy.setdefault(e.value, (mod, e.lineno))
+                elif isinstance(node, ast.Call):
+                    callee = (node.func.id if isinstance(node.func, ast.Name)
+                              else node.func.attr
+                              if isinstance(node.func, ast.Attribute)
+                              else None)
+                    # detector emit: Event(t, "<type>", ...) — arity
+                    # excludes threading/asyncio Event() construction
+                    if (callee == "Event" and len(node.args) >= 2
+                            and isinstance(node.args[1], ast.Constant)
+                            and isinstance(node.args[1].value, str)):
+                        emits.setdefault(node.args[1].value,
+                                         (mod, node.lineno))
+                elif isinstance(node, ast.Compare) and is_consumer:
+                    operands = [node.left, *node.comparators]
+                    if not any(self._is_type_read(o) for o in operands):
+                        continue
+                    for o in operands:
+                        if self._is_type_read(o):
+                            continue
+                        for c in ast.walk(o):
+                            if isinstance(c, ast.Constant) and isinstance(
+                                    c.value, str):
+                                consumers.setdefault(c.value,
+                                                     (mod, c.lineno))
+        if not taxonomy:
+            return
+        for tag, (mod, line) in sorted(emits.items()):
+            if tag not in taxonomy:
+                self._emit(
+                    mod, line, "KVM112",
+                    f"event type '{tag}' is emitted but missing from "
+                    "EVENT_TYPES — the monitor's taxonomy is the contract "
+                    "report/chart consumers filter on; add it to the tuple "
+                    "or mark `# kvmini: contract-ok`",
+                    tag)
+        for tag, (mod, line) in sorted(consumers.items()):
+            if tag not in taxonomy:
+                self._emit(
+                    mod, line, "KVM112",
+                    f"event type '{tag}' is consumed here but is not in "
+                    "EVENT_TYPES — no detector can ever emit it, so this "
+                    "branch is silently dead; fix the name or mark "
+                    "`# kvmini: contract-ok`",
+                    tag)
+        md_texts = {p: t for p, t in self.doc_texts.items()
+                    if p.endswith(".md")}
+        for tag, (mod, line) in sorted(taxonomy.items()):
+            if emits and tag not in emits:
+                self._emit(
+                    mod, line, "KVM112",
+                    f"event type '{tag}' is declared in EVENT_TYPES but no "
+                    "detector ever emits it — dead taxonomy row (or the "
+                    "emit site drifted); remove it or mark "
+                    "`# kvmini: contract-ok`",
+                    tag)
+            if md_texts and not any(
+                    re.search(rf"\b{re.escape(tag)}\b", text)
+                    for text in md_texts.values()):
+                self._emit(
+                    mod, line, "KVM112",
+                    f"event type '{tag}' is undocumented — add its row to "
+                    "the docs/MONITORING.md event table",
+                    tag)
+
+    @staticmethod
+    def _is_type_read(node: ast.AST) -> bool:
+        """`e.get("type")` / `e.get("type", d)` / `e["type"]`."""
+        if (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "get" and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and node.args[0].value == "type"):
+            return True
+        return (isinstance(node, ast.Subscript)
+                and isinstance(node.slice, ast.Constant)
+                and node.slice.value == "type")
+
+    # -- KVM113 -------------------------------------------------------------
+    def _mock_module(self) -> Optional[ModuleFacts]:
+        """The mock surface: an in-index mock_server module (fixture
+        scans), else the repo's tests/mock_server.py parsed standalone —
+        the package scan never includes tests/, but the twin contract is
+        exactly about that file."""
+        for mod in self.index.modules.values():
+            if MOCK_PATH.search(mod.path):
+                return mod
+        cand = self.index.root / "tests" / "mock_server.py"
+        try:
+            source = cand.read_text(encoding="utf-8")
+            tree = ast.parse(source)
+        except (OSError, SyntaxError):
+            return None
+        return ModuleFacts(
+            path="tests/mock_server.py", source=source, tree=tree,
+            suppressions=Suppressions.scan(source))
+
+    @staticmethod
+    def _routes(mod: ModuleFacts) -> tuple[dict[str, int], set[int]]:
+        """path -> first registration line, plus the registered-path
+        Constant node ids (so client-literal scans skip them)."""
+        out: dict[str, int] = {}
+        reg_nodes: set[int] = set()
+        for node in ast.walk(mod.tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ROUTE_REGISTRARS
+                    and node.args and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                out.setdefault(node.args[0].value, node.lineno)
+                reg_nodes.add(id(node.args[0]))
+        return out, reg_nodes
+
+    def _check_http_surfaces(self) -> None:
+        server_routes: dict[str, tuple[ModuleFacts, int]] = {}
+        router_routes: dict[str, tuple[ModuleFacts, int]] = {}
+        reg_node_ids: set[int] = set()
+        for mod in self.index.modules.values():
+            if MOCK_PATH.search(mod.path):
+                continue
+            routes, reg_nodes = self._routes(mod)
+            reg_node_ids |= reg_nodes
+            target = (server_routes if SERVER_PATH.search(mod.path)
+                      else router_routes if ROUTER_PATH.search(mod.path)
+                      else None)
+            if target is None:
+                continue
+            for path, line in routes.items():
+                target.setdefault(path, (mod, line))
+        mock = self._mock_module()
+        mock_routes = self._routes(mock)[0] if mock is not None else {}
+
+        # a route a client calls that the mock can't serve — the mock
+        # fleet silently 404s where the real fleet works
+        if mock is not None and server_routes:
+            seen: set[tuple[str, str]] = set()
+            for mod in self.index.modules.values():
+                if not CLIENT_PATH.search(mod.path):
+                    continue
+                docstrings = _docstring_nodes(mod.tree)
+                for node in ast.walk(mod.tree):
+                    if (not isinstance(node, ast.Constant)
+                            or not isinstance(node.value, str)
+                            or node in docstrings
+                            or id(node) in reg_node_ids):
+                        continue
+                    path = node.value
+                    if (path in server_routes and path not in mock_routes
+                            and (mod.path, path) not in seen):
+                        seen.add((mod.path, path))
+                        self._emit(
+                            mod, node.lineno, "KVM113",
+                            f"client calls '{path}' but tests/"
+                            "mock_server.py never registers it — the mock "
+                            "fleet 404s where the real server works, so "
+                            "the JAX-free suites can't cover this path; "
+                            "add the mock route or mark "
+                            "`# kvmini: contract-ok`",
+                            path)
+
+        # every registered endpoint belongs in the docs/API.md table
+        api_docs = {p: t for p, t in self.doc_texts.items()
+                    if p.endswith("API.md")}
+        if api_docs:
+            for path, (mod, line) in sorted({**router_routes,
+                                             **server_routes}.items()):
+                if not any(path in text for text in api_docs.values()):
+                    self._emit(
+                        mod, line, "KVM113",
+                        f"endpoint '{path}' is registered but missing from "
+                        "the docs/API.md endpoint table",
+                        path)
+
+        # a mock route no real server registers is a phantom surface —
+        # tests would pass against an API that doesn't exist
+        if mock is not None and (server_routes or router_routes):
+            for path, line in sorted(mock_routes.items()):
+                if path not in server_routes and path not in router_routes:
+                    self._emit(
+                        mock, line, "KVM113",
+                        f"mock route '{path}' has no real server/router "
+                        "registration — the twin serves an endpoint the "
+                        "fleet doesn't; remove it or mark "
+                        "`# kvmini: contract-ok`",
+                        path)
+
+    def _check_shed_shape(self) -> None:
+        """Every `_shed_response` keeps the 429 + Retry-After shape the
+        clients, the router, and the mock agree on (per-site — holds on
+        any scan)."""
+        for mod in self.index.modules.values():
+            for fn in mod.functions.values():
+                if fn.name != SHED_FN:
+                    continue
+                consts = {n.value for n in iter_scope(fn.node)
+                          if isinstance(n, ast.Constant)}
+                missing = [what for what, ok in
+                           (("status 429", 429 in consts),
+                            ("a Retry-After header", "Retry-After" in consts))
+                           if not ok]
+                if missing:
+                    line = getattr(fn.node, "lineno", 0)
+                    self._emit(
+                        mod, line, "KVM113",
+                        f"`{fn.qualname}` lacks {' and '.join(missing)} — "
+                        "the shed contract (docs/API.md) is a 429 with "
+                        "Retry-After so clients and the autoscaler "
+                        "back off instead of hammering",
+                        fn.qualname)
+
+
+def check(index: FactIndex,
+          doc_texts: Optional[dict[str, str]] = None) -> list[Diagnostic]:
+    return ContractChecker(index, doc_texts).run()

@@ -132,6 +132,28 @@ RULES: dict[str, Rule] = {
              "a `finally` block can raise before a pending release in "
              "the same block — whenever the raise fires, the release is "
              "skipped on exactly the failure path that needed it"),
+        Rule("KVM101", "lockstep-publish-replay-asymmetry", "protocol-ok",
+             "decision tag published into the lockstep stream with no "
+             "run_follower replay arm, or a replay arm nothing publishes"),
+        Rule("KVM102", "host-only-field-read", "protocol-ok",
+             "field stripped from the replay payload (_HOST_ONLY_FIELDS) "
+             "read inside a follower-replayed method — followers see None"),
+        Rule("KVM103", "handoff-version-unconsumed", "protocol-ok",
+             "KVHandoff(version=...) construction with no consume-side "
+             "version check covering that version"),
+        Rule("KVM104", "degrade-ladder-unsound", "protocol-ok",
+             "sticky degrade flag re-armed outside init/reset, or read "
+             "with no entry edge that ever sets it"),
+        Rule("KVM111", "fabricated-zero-export", "contract-ok",
+             ".get(key, 0) / `or 0` default flowing into a /metrics "
+             "exposition or results block — absent-not-zero violated"),
+        Rule("KVM112", "event-taxonomy-drift", "contract-ok",
+             "EVENT_TYPES vs detector emits vs report/chart consumers vs "
+             "docs/MONITORING.md rows out of sync"),
+        Rule("KVM113", "http-surface-drift", "contract-ok",
+             "server/router routes vs tests/mock_server.py vs docs/API.md "
+             "vs in-repo client call sites out of sync (incl. the "
+             "_shed_response 429 + Retry-After shape)"),
     ]
 }
 

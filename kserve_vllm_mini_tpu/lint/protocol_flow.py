@@ -1,0 +1,430 @@
+"""KVM101-KVM104 — replicated-state & wire-protocol conformance.
+
+The multihost lockstep stream and the disagg KV-handoff wire are the
+two protocol surfaces whose producer and consumer live in different
+modules — exactly where a one-sided edit compiles, passes unit tests,
+and diverges a pod hundreds of steps later. Four rules, all riding the
+shared fact index:
+
+- **KVM101 — publish/replay symmetry**: every decision tag published
+  into the lockstep stream (a tuple literal handed to the
+  ``on_decision`` callback or to a ``.publish(...)`` call) must have a
+  matching dispatch arm in ``run_follower``'s replay loop (a string
+  the follower compares the command opcode against), and vice versa.
+  An unknown tag on either side fires — this is the day-one guardrail
+  for ROADMAP item 1's ``("handoff",)`` decision.
+- **KVM102 — host-only field discipline**: fields the primary strips
+  from the replay payload (the module-level ``*_HOST_ONLY_FIELDS``
+  set: ``deadline_s``, trace ids, ...) must never be read inside
+  follower-replayed engine methods — followers see ``None`` and
+  diverge. Reads gated on ``self._lockstep`` (or on a local derived
+  from it) are the blessed split and exempt.
+- **KVM103 — version-negotiation completeness**: every
+  ``KVHandoff(version=...)`` construction must be covered by a
+  consume-side version check (a function comparing ``.version``) —
+  a new version constant with no consumer arm fires before the first
+  tombstone does.
+- **KVM104 — degrade-ladder soundness**: sticky degrade flags
+  (``self.*_degraded`` / ``self.*_disabled``, written with bool
+  literals) are terminal outside init/reset paths — a ``False``
+  re-arm elsewhere fires, as does a flag that is read but never set
+  (a ladder level with no entry edge).
+
+Suppress a deliberate asymmetry with ``# kvmini: protocol-ok`` (e.g. a
+decision tag published for stream-shape convention that lockstep never
+reaches, or a host-local telemetry field both sides agree to drop).
+
+All four rules reason from the ABSENCE of a fact on the far side of the
+protocol, so they stand down on partial scans (``index.full_scan``) —
+the missing arm may live in an unscanned module.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, Optional
+
+from kserve_vllm_mini_tpu.lint.diagnostics import Diagnostic
+from kserve_vllm_mini_tpu.lint.facts import (
+    FactIndex,
+    FunctionInfo,
+    ModuleFacts,
+    iter_scope,
+)
+
+PUBLISHER_PARAM = "on_decision"
+FOLLOWER_PREFIXES = ("run_follower", "run_replica")
+HOST_ONLY_SET = re.compile(r"HOST_ONLY_FIELDS$")
+VERSION_CONST = re.compile(r"HANDOFF_VERSION")
+STICKY_ATTR = re.compile(r"_(degraded|disabled)$")
+RESET_FN = re.compile(r"^(__init__$|_?reset|_?clear)")
+LOCKSTEP_ATTR = "_lockstep"
+
+
+def _tuple_tag(call: ast.Call) -> Optional[tuple[str, ast.AST]]:
+    """`cb(("retire", payload))` -> ("retire", <tuple node>)."""
+    if call.args and isinstance(call.args[0], ast.Tuple):
+        tup = call.args[0]
+        if tup.elts and isinstance(tup.elts[0], ast.Constant) and isinstance(
+                tup.elts[0].value, str):
+            return tup.elts[0].value, tup
+    return None
+
+
+def _mentions_lockstep(node: ast.AST) -> bool:
+    return any(
+        isinstance(n, ast.Attribute) and LOCKSTEP_ATTR in n.attr
+        for n in ast.walk(node))
+
+
+def _mentions_names(node: ast.AST, names: set[str]) -> bool:
+    return any(
+        isinstance(n, ast.Name) and n.id in names for n in ast.walk(node))
+
+
+class ProtocolChecker:
+    def __init__(self, index: FactIndex):
+        self.index = index
+        self.diags: list[Diagnostic] = []
+
+    def run(self) -> list[Diagnostic]:
+        if not self.index.full_scan:
+            return []
+        self._check_symmetry()
+        self._check_host_only_reads()
+        self._check_version_negotiation()
+        self._check_degrade_ladder()
+        return self.diags
+
+    def _emit(self, mod: ModuleFacts, line: int, code: str, msg: str,
+              ctx: str) -> None:
+        if mod.suppressions.is_suppressed(line, code):
+            return
+        self.diags.append(Diagnostic(mod.path, line, code, msg, context=ctx))
+
+    # -- KVM101 -------------------------------------------------------------
+    def _published_tags(self) -> list[tuple[ModuleFacts, int, str]]:
+        """Tuple-literal decisions entering the stream: calls of the
+        `on_decision` callback (inside publisher-threaded functions) and
+        `.publish((...))` attribute calls (the wire side)."""
+        out: list[tuple[ModuleFacts, int, str]] = []
+        for mod in self.index.modules.values():
+            for fn in mod.functions.values():
+                takes_publisher = PUBLISHER_PARAM in fn.params
+                for node in iter_scope(fn.node):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    f = node.func
+                    is_cb = (takes_publisher and isinstance(f, ast.Name)
+                             and f.id == PUBLISHER_PARAM)
+                    is_wire = isinstance(f, ast.Attribute) and f.attr == "publish"
+                    if not (is_cb or is_wire):
+                        continue
+                    tagged = _tuple_tag(node)
+                    if tagged is not None:
+                        out.append((mod, node.lineno, tagged[0]))
+        return out
+
+    def _replay_arms(self) -> list[tuple[ModuleFacts, FunctionInfo, int, str]]:
+        """String opcodes the follower dispatch loop compares against:
+        inside run_follower*/run_replica*, `op = cmd[0]` names compared
+        (==, or `in (...)` membership) to string constants."""
+        out: list[tuple[ModuleFacts, FunctionInfo, int, str]] = []
+        for mod in self.index.modules.values():
+            for fn in mod.functions.values():
+                if not fn.name.startswith(FOLLOWER_PREFIXES):
+                    continue
+                op_names: set[str] = set()
+                for node in iter_scope(fn.node):
+                    if isinstance(node, ast.Assign) and isinstance(
+                            node.value, ast.Subscript):
+                        sl = node.value.slice
+                        if isinstance(sl, ast.Constant) and sl.value == 0:
+                            op_names |= {t.id for t in node.targets
+                                         if isinstance(t, ast.Name)}
+                for node in iter_scope(fn.node):
+                    if not isinstance(node, ast.Compare):
+                        continue
+                    operands = [node.left, *node.comparators]
+                    if not any(isinstance(o, ast.Name) and o.id in op_names
+                               for o in operands):
+                        continue
+                    for o in operands:
+                        for c in ast.walk(o):
+                            if isinstance(c, ast.Constant) and isinstance(
+                                    c.value, str):
+                                out.append((mod, fn, node.lineno, c.value))
+        return out
+
+    def _check_symmetry(self) -> None:
+        published = self._published_tags()
+        arms = self._replay_arms()
+        # both-sides gate: a scan that sees only one end of the stream
+        # (a fixture with publishers but no follower) has nothing to
+        # compare symmetry against
+        if not published or not arms:
+            return
+        pub_tags = {t for _, _, t in published}
+        arm_tags = {t for _, _, _, t in arms}
+        seen: set[tuple[str, str]] = set()
+        for mod, line, tag in published:
+            if tag in arm_tags or (mod.path, tag) in seen:
+                continue
+            seen.add((mod.path, tag))
+            self._emit(
+                mod, line, "KVM101",
+                f"decision tag '{tag}' is published into the lockstep "
+                "stream but no run_follower replay loop has a dispatch arm "
+                "for it — followers hit the unknown-command path and the "
+                "pod diverges; add the arm or mark `# kvmini: protocol-ok`",
+                tag)
+        seen.clear()
+        for mod, fn, line, tag in arms:
+            if tag in pub_tags or (mod.path, tag) in seen:
+                continue
+            seen.add((mod.path, tag))
+            self._emit(
+                mod, line, "KVM101",
+                f"replay arm '{tag}' in `{fn.name}` matches a decision tag "
+                "nothing ever publishes — dead protocol surface or a "
+                "producer-side rename; publish it, delete the arm, or mark "
+                "`# kvmini: protocol-ok`",
+                tag)
+
+    # -- KVM102 -------------------------------------------------------------
+    def _host_only_fields(self) -> set[str]:
+        fields: set[str] = set()
+        for mod in self.index.modules.values():
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Assign):
+                    continue
+                if not any(isinstance(t, ast.Name) and HOST_ONLY_SET.search(t.id)
+                           for t in node.targets):
+                    continue
+                if isinstance(node.value, (ast.Set, ast.Tuple, ast.List)):
+                    fields |= {e.value for e in node.value.elts
+                               if isinstance(e, ast.Constant)
+                               and isinstance(e.value, str)}
+        return fields
+
+    def _replayed_closure(self) -> list[tuple[ModuleFacts, FunctionInfo]]:
+        """Follower-replayed class methods + their same-module callees —
+        the KVM022 scope: code both primary and followers execute."""
+        replayed = self.index.follower_replayed_methods()
+        out: list[tuple[ModuleFacts, FunctionInfo]] = []
+        work: list[tuple[ModuleFacts, FunctionInfo]] = []
+        for mod in self.index.modules.values():
+            for fn in mod.functions.values():
+                if fn.name in replayed and fn.class_name is not None:
+                    work.append((mod, fn))
+        seen: set[tuple[str, str]] = set()
+        while work:
+            mod, fn = work.pop()
+            if fn.key() in seen:
+                continue
+            seen.add(fn.key())
+            out.append((mod, fn))
+            for cs in self.index.call_sites(mod, fn):
+                for callee in cs.callees:
+                    if callee.path == mod.path and callee.key() not in seen:
+                        work.append((mod, callee))
+        return out
+
+    def _check_host_only_reads(self) -> None:
+        fields = self._host_only_fields()
+        if not fields:
+            return
+        for mod, fn in self._replayed_closure():
+            gated: set[str] = set()
+            for node in iter_scope(fn.node):
+                if isinstance(node, ast.Assign) and _mentions_lockstep(
+                        node.value):
+                    gated |= {t.id for t in node.targets
+                              if isinstance(t, ast.Name)}
+            reported: set[str] = set()
+
+            def flag_reads(node: ast.AST) -> None:
+                for n in ast.walk(node):
+                    if (isinstance(n, ast.Attribute)
+                            and isinstance(n.ctx, ast.Load)
+                            and n.attr in fields
+                            and not (isinstance(n.value, ast.Name)
+                                     and n.value.id == "self")
+                            and n.attr not in reported):
+                        reported.add(n.attr)
+                        self._emit(
+                            mod, n.lineno, "KVM102",
+                            f"host-only field '{n.attr}' read in "
+                            f"follower-replayed `{fn.name}` — the primary "
+                            "strips it from the replay payload "
+                            "(_HOST_ONLY_FIELDS), so followers see None "
+                            "and diverge; gate on self._lockstep or mark "
+                            "`# kvmini: protocol-ok`",
+                            f"{fn.qualname}:{n.attr}")
+
+            def scan(stmts: Iterable[ast.stmt]) -> None:
+                for stmt in stmts:
+                    if isinstance(stmt, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef, ast.ClassDef)):
+                        continue  # nested defs are their own FunctionInfo
+                    if isinstance(stmt, (ast.If, ast.While)):
+                        # a branch deciding on the lockstep mode (directly
+                        # or via a local derived from it) IS the blessed
+                        # host/replica split — its whole subtree is exempt
+                        if (_mentions_lockstep(stmt.test)
+                                or _mentions_names(stmt.test, gated)):
+                            continue
+                        flag_reads(stmt.test)
+                        scan(stmt.body)
+                        scan(stmt.orelse)
+                        continue
+                    blocks: list[list[ast.stmt]] = []
+                    exprs: list[ast.AST] = []
+                    for _, value in ast.iter_fields(stmt):
+                        if (isinstance(value, list) and value
+                                and isinstance(value[0], ast.stmt)):
+                            blocks.append(value)
+                        elif isinstance(value, ast.AST):
+                            exprs.append(value)
+                        elif isinstance(value, list):
+                            exprs += [v for v in value
+                                      if isinstance(v, ast.AST)]
+                    if not blocks and any(_mentions_lockstep(e)
+                                          for e in exprs):
+                        continue  # the statement itself handles the split
+                    for e in exprs:
+                        flag_reads(e)
+                    for b in blocks:
+                        scan(b)
+
+            scan(getattr(fn.node, "body", []))
+
+    # -- KVM103 -------------------------------------------------------------
+    def _version_exprs(self, value: ast.AST) -> list[tuple[str, object]]:
+        """Names/ints a `version=` kwarg can evaluate to, through IfExp."""
+        if isinstance(value, ast.IfExp):
+            return (self._version_exprs(value.body)
+                    + self._version_exprs(value.orelse))
+        if isinstance(value, ast.Name):
+            return [(value.id, value.id)]
+        if isinstance(value, ast.Attribute):
+            return [(value.attr, value.attr)]
+        if isinstance(value, ast.Constant) and isinstance(value.value, int):
+            return [(str(value.value), value.value)]
+        return []
+
+    def _check_version_negotiation(self) -> None:
+        producers: list[tuple[ModuleFacts, ast.Call, str]] = []
+        for mod in self.index.modules.values():
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = None
+                if isinstance(node.func, ast.Name):
+                    callee = node.func.id
+                elif isinstance(node.func, ast.Attribute):
+                    callee = node.func.attr
+                if callee is None or not callee.endswith("Handoff"):
+                    continue
+                for kw in node.keywords:
+                    if kw.arg == "version":
+                        producers.append((mod, node, callee))
+        if not producers:
+            return
+        # consumer coverage: any function that COMPARES a `.version`
+        # attribute negotiates; every name/int referenced in its scope is
+        # a covered arm (name-matching across modules — the producer's
+        # constant and the consumer's import share the constant's name)
+        covered_names: set[str] = set()
+        covered_ints: set[int] = set()
+        for mod in self.index.modules.values():
+            for fn in mod.functions.values():
+                negotiates = any(
+                    isinstance(n, ast.Compare) and any(
+                        isinstance(o, ast.Attribute) and o.attr == "version"
+                        for o in [n.left, *n.comparators])
+                    for n in iter_scope(fn.node))
+                if not negotiates:
+                    continue
+                for n in iter_scope(fn.node):
+                    if isinstance(n, ast.Name):
+                        covered_names.add(n.id)
+                    elif isinstance(n, ast.Attribute):
+                        covered_names.add(n.attr)
+                    elif isinstance(n, ast.Constant) and isinstance(
+                            n.value, int):
+                        covered_ints.add(n.value)
+        for mod, call, callee in producers:
+            kw = next(k for k in call.keywords if k.arg == "version")
+            for label, val in self._version_exprs(kw.value):
+                ok = (val in covered_names if isinstance(val, str)
+                      else val in covered_ints)
+                if ok:
+                    continue
+                self._emit(
+                    mod, call.lineno, "KVM103",
+                    f"`{callee}(version={label})` has no consume-side "
+                    "version check covering it — a reader that never "
+                    "negotiates this version tombstones or mis-parses the "
+                    "handoff; add the consumer arm or mark "
+                    "`# kvmini: protocol-ok`",
+                    f"{callee}:{label}")
+
+    # -- KVM104 -------------------------------------------------------------
+    def _check_degrade_ladder(self) -> None:
+        # sticky attr -> write/read sites, package-wide (self.<attr> only)
+        writes: dict[str, list[tuple[ModuleFacts, FunctionInfo, int, object]]] = {}
+        reads: dict[str, list[tuple[ModuleFacts, int]]] = {}
+        for mod in self.index.modules.values():
+            for fn in mod.functions.values():
+                for node in iter_scope(fn.node):
+                    if isinstance(node, ast.Assign):
+                        for t in node.targets:
+                            if (isinstance(t, ast.Attribute)
+                                    and isinstance(t.value, ast.Name)
+                                    and t.value.id == "self"
+                                    and STICKY_ATTR.search(t.attr)):
+                                val = (node.value.value
+                                       if isinstance(node.value, ast.Constant)
+                                       else node.value)
+                                writes.setdefault(t.attr, []).append(
+                                    (mod, fn, node.lineno, val))
+                    elif (isinstance(node, ast.Attribute)
+                          and isinstance(node.ctx, ast.Load)
+                          and isinstance(node.value, ast.Name)
+                          and node.value.id == "self"
+                          and STICKY_ATTR.search(node.attr)):
+                        reads.setdefault(node.attr, []).append(
+                            (mod, node.lineno))
+        for attr, sites in sorted(writes.items()):
+            # only bool-literal-written attrs are the sticky-ladder idiom;
+            # attrs holding richer state are out of scope
+            if not any(isinstance(v, bool) for _, _, _, v in sites):
+                continue
+            for mod, fn, line, val in sites:
+                if val is False and not RESET_FN.match(fn.name):
+                    self._emit(
+                        mod, line, "KVM104",
+                        f"sticky degrade flag `self.{attr}` is re-armed "
+                        f"(set False) in `{fn.name}` — degraded states are "
+                        "documented-terminal for the process; reset only "
+                        "on init/reset paths or mark `# kvmini: protocol-ok`",
+                        f"{attr}:rearm")
+            entered = any(
+                (val is True) or not isinstance(val, bool)
+                for _, _, _, val in sites)
+            if not entered and attr in reads:
+                mod, line = sorted(reads[attr],
+                                   key=lambda r: (r[0].path, r[1]))[0]
+                self._emit(
+                    mod, line, "KVM104",
+                    f"sticky degrade flag `self.{attr}` is read but no "
+                    "code path ever sets it — the ladder level has no "
+                    "entry edge (dead guard, or the degrade write was "
+                    "lost in a refactor)",
+                    f"{attr}:noentry")
+
+
+def check(index: FactIndex) -> list[Diagnostic]:
+    return ProtocolChecker(index).run()

@@ -11,22 +11,28 @@ from kserve_vllm_mini_tpu.lint import (
     baseline as baseline_mod,
     buffer_lifecycle,
     concurrency,
+    contract_flow,
     dtype_flow,
     jit_purity,
     lockstep,
     mesh_flow,
     metrics_drift,
+    protocol_flow,
     resource_paths,
     workload,
 )
-from kserve_vllm_mini_tpu.lint.diagnostics import RULES, Diagnostic
+from kserve_vllm_mini_tpu.lint.diagnostics import (
+    RULES,
+    SUPPRESSION_TOKENS,
+    Diagnostic,
+)
 from kserve_vllm_mini_tpu.lint.facts import FactIndex
 
 EXCLUDED_DIR_NAMES = {"__pycache__", ".git", "node_modules", ".venv"}
 
 # (family prefix, display name, checker) — `--family KVM05` selects by
-# prefix match on the family column; KVM03 is special-cased below because
-# the drift checker also consumes the docs/dashboards surfaces
+# prefix match on the family column; KVM03 and KVM11 are special-cased
+# below because those checkers also consume the docs/dashboards surfaces
 CHECKERS = (
     ("KVM01", "jit_purity", jit_purity.check),
     ("KVM02", "lockstep", lockstep.check),
@@ -36,13 +42,16 @@ CHECKERS = (
     ("KVM07", "buffer_lifecycle", buffer_lifecycle.check),
     ("KVM08", "mesh_flow", mesh_flow.check),
     ("KVM09", "resource_paths", resource_paths.check),
+    ("KVM10", "protocol_flow", protocol_flow.check),
 )
 METRICS_FAMILY = "KVM03"
+CONTRACT_FAMILY = "KVM11"
 
 # diagnostic code prefix -> the CHECKERS/timings display name, for the
 # per-family finding counts the --timing-out report carries
 FAMILY_NAMES = {family: name for family, name, _ in CHECKERS}
 FAMILY_NAMES[METRICS_FAMILY] = "metrics_drift"
+FAMILY_NAMES[CONTRACT_FAMILY] = "contract_flow"
 FAMILY_NAMES["KVM001"] = "stale_suppressions"
 
 
@@ -97,7 +106,7 @@ def normalize_families(families: Optional[Iterable[str]]) -> Optional[set[str]]:
         if not norm.startswith("KVM") or not any(
                 code.startswith(norm) for code in selectable):
             raise ValueError(
-                f"unknown rule family {f!r} (families: KVM01..KVM09, or a "
+                f"unknown rule family {f!r} (families: KVM01..KVM11, or a "
                 "full code like KVM051; KVM001 always rides along and is "
                 "not selectable)")
         out.add(norm)
@@ -189,7 +198,7 @@ def _rel(root: Path, p: Path) -> Path:
 
 
 def changed_scan_paths(root: Path, paths: list[Path],
-                       ref: str) -> list[Path]:
+                       ref: str) -> tuple[list[Path], list[str]]:
     """The `--changed` file set: python files under ``paths`` that differ
     from ``ref`` (``git diff --name-only``) or are untracked (``git
     ls-files --others`` — a brand-new module must never make the scan
@@ -198,7 +207,13 @@ def changed_scan_paths(root: Path, paths: list[Path],
     findings can change too. Git prints paths relative to the repo
     TOPLEVEL, not the cwd, so they are resolved against it. Raises
     RuntimeError when git cannot produce the diff (loud, never a
-    silently-empty scan)."""
+    silently-empty scan).
+
+    Returns ``(scan_paths, skipped)``: a deleted or renamed-away file
+    shows up in the diff but no longer exists on disk — it has nothing
+    to scan (its importers, which DO still exist, are picked up as
+    consumers), so it is reported in ``skipped`` (toplevel-relative
+    python paths) for the CLI's note instead of crashing the scan."""
     import subprocess
 
     def git(*args: str) -> str:
@@ -217,17 +232,24 @@ def changed_scan_paths(root: Path, paths: list[Path],
     listed = (git("diff", "--name-only", ref, "--")
               + git("ls-files", "--others", "--exclude-standard",
                     "--full-name"))
-    diff = {(toplevel / line.strip()).resolve()
-            for line in listed.splitlines() if line.strip()}
+    listed_rel = [line.strip() for line in listed.splitlines()
+                  if line.strip()]
+    skipped = sorted({
+        rel for rel in listed_rel
+        if rel.endswith(".py") and not (toplevel / rel).exists()
+    })
+    diff = {(toplevel / rel).resolve() for rel in listed_rel
+            if (toplevel / rel).exists()}
     scope = discover_py_files(paths)
     changed = [f for f in scope if f.resolve() in diff]
     if not changed:
-        return []
+        return [], skipped
     by_rel = {_rel(root, f).as_posix(): f for f in scope}
     changed_rel = {_rel(root, f).as_posix() for f in changed}
     consumer_rel = _reverse_import_deps(root, scope, changed_rel)
     return sorted(
-        {by_rel[r] for r in (changed_rel | consumer_rel) if r in by_rel})
+        {by_rel[r] for r in (changed_rel | consumer_rel) if r in by_rel}
+    ), skipped
 
 
 def _reverse_import_deps(root: Path, scope: list[Path],
@@ -316,7 +338,8 @@ def run_lint(
     # emitter modules provide
     full_scan = index.full_scan
     doc_texts: dict[str, str] = {}
-    if full_scan and _family_selected(families, METRICS_FAMILY):
+    if full_scan and (_family_selected(families, METRICS_FAMILY)
+                      or _family_selected(families, CONTRACT_FAMILY)):
         for doc in discover_doc_files(doc_paths or []):
             try:
                 doc_texts[_rel(root, doc).as_posix()] = doc.read_text(
@@ -335,10 +358,23 @@ def run_lint(
         t0 = time.perf_counter()
         diags += metrics_drift.check(index, doc_texts)
         timings["metrics_drift"] = time.perf_counter() - t0
+    if _family_selected(families, CONTRACT_FAMILY):
+        t0 = time.perf_counter()
+        diags += contract_flow.check(index, doc_texts)
+        timings["contract_flow"] = time.perf_counter() - t0
 
     # stale `# kvmini:` comments — only after every rule had its chance,
     # and only for the suppression tokens whose rules ran this pass
     active_tokens = _active_suppression_tokens(families)
+    if not index.full_scan:
+        # the KVM10x/11x families reason from the ABSENCE of a fact on
+        # the far side of a protocol and stand down entirely on subset
+        # scans — a protocol-ok on the publish side would read as stale
+        # whenever the follower module is out of scope. Their tokens
+        # can only be judged stale by a full scan.
+        if active_tokens is None:
+            active_tokens = set(SUPPRESSION_TOKENS)
+        active_tokens -= {"protocol-ok", "contract-ok"}
     for mod in index.modules.values():
         diags += mod.suppressions.stale(mod.path, active_tokens)
 

@@ -40,6 +40,8 @@ FAMILY_LEVELS = {
     "KVM07": "error",     # buffer lifecycle
     "KVM08": "error",     # mesh/sharding consistency (perf-silent wrongness)
     "KVM09": "error",     # exception-path resource safety
+    "KVM10": "error",     # wire-protocol conformance (divergence = corruption)
+    "KVM11": "warning",   # absent-not-zero contract drift
 }
 
 

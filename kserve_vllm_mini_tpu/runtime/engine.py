@@ -2265,15 +2265,16 @@ class Engine:
         tracing.MAX_REQUEST_SPANS of these per request — the recorder-
         overhead contract."""
         req = handle.request
+        # trace context is deliberately host-local telemetry: followers see
+        # None here and return before recording (kvmini: protocol-ok)
         if self.tracer is None or req.trace_id is None:
-            # trace_id is None only when tracing is off or on a multihost
-            # follower (trace context is host-only in the replay payload)
             return
         a = {"request_id": req.request_id}
         if attrs:
             a.update(attrs)
         self.tracer.record(
             name, req.trace_id, int(t0 * 1e9), int(t1 * 1e9),
+            # host-local span parentage, same None-gate (kvmini: protocol-ok)
             parent_span_id=req.parent_span_id, ok=ok, attrs=a,
         )
 
@@ -2642,7 +2643,8 @@ class Engine:
             return
         if on_decision is not None:
             # never reached in lockstep (chunked admission is gated off
-            # there), published for the decision-stream convention
+            # there), published for the decision-stream convention — no
+            # follower replay arm needed (kvmini: protocol-ok)
             on_decision(("prefill_chunk", st["handle"].request.request_id))
         if self._prefill_step(slot, st, self.ecfg.prefill_chunk):
             self._prefill_fifo.pop(0)
@@ -3022,6 +3024,8 @@ class Engine:
             # prefill below starts after it, exactly like the dense APC.
             reused = self._paged_admit_blocks(slot, req)
         adapter_idx = 0
+        # multihost submit refuses adapter requests outright, so in lockstep
+        # this branch is dead on both sides (kvmini: protocol-ok)
         if req.adapter is not None:
             if req.adapter not in self._lora_names:
                 # the registry is also checked at submit and unload refuses
@@ -3092,6 +3096,8 @@ class Engine:
         t0 = time.time()
         # first token: sampled from the prompt's last-position logits,
         # grammar-masked when the request is constrained
+        # multihost submit refuses constrained requests (req_payload has no
+        # constraint field), dead on both sides there (kvmini: protocol-ok)
         machine = req.constraint
         if machine is not None:
             # budget = tokens the slot can actually emit: the grammar must

@@ -1,0 +1,21 @@
+"""KVM101 seeded mutation: a decision published with no follower arm.
+
+Engine-shaped: the scheduler publishes through the lockstep on_decision
+closure, the follower (runtime/multihost.py in this tree) replays by
+dispatching on cmd[0]. "handoff" is published but never replayed;
+"dispatch" is replayed but never published.
+"""
+
+
+class Engine:
+    def _retire_one(self):
+        self.retired = True
+
+    def _dispatch_one(self, rid):
+        self.dispatched = rid
+
+    def _schedule_once(self, on_decision=None):
+        if on_decision is not None:
+            on_decision(("handoff", 1))
+        if on_decision is not None:
+            on_decision(("retire", 2))

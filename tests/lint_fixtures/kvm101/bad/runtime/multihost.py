@@ -1,0 +1,14 @@
+"""KVM101 seeded mutation, follower side: a dead replay arm.
+
+"dispatch" has an arm here but nothing on the primary publishes it;
+"handoff" is published by the engine but has no arm.
+"""
+
+
+def run_follower(engine, commands):
+    for cmd in commands:
+        op = cmd[0]
+        if op == "retire":
+            engine._retire_one()
+        elif op == "dispatch":
+            engine._dispatch_one(cmd[1])

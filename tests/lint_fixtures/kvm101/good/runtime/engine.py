@@ -1,0 +1,24 @@
+"""KVM101 good case: every published tag has a replay arm.
+
+The "stats_note" publish is deliberately one-sided — a host-local
+convention publish the follower ignores by design — and carries the
+protocol-ok annotation the checker must honour (and mark used).
+"""
+
+
+class Engine:
+    def _retire_one(self):
+        self.retired = True
+
+    def _dispatch_one(self, rid):
+        self.dispatched = rid
+
+    def _schedule_once(self, on_decision=None):
+        if on_decision is not None:
+            on_decision(("retire", 2))
+        if on_decision is not None:
+            on_decision(("dispatch", 3))
+        if on_decision is not None:
+            # decision-stream convention publish, no follower state to
+            # advance (kvmini: protocol-ok)
+            on_decision(("stats_note", 4))

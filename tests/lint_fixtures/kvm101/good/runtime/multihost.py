@@ -1,0 +1,10 @@
+"""KVM101 good case, follower side: arms mirror the publishes."""
+
+
+def run_follower(engine, commands):
+    for cmd in commands:
+        op = cmd[0]
+        if op == "retire":
+            engine._retire_one()
+        elif op == "dispatch":
+            engine._dispatch_one(cmd[1])

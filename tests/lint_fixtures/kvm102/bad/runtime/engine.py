@@ -1,0 +1,13 @@
+"""KVM102 seeded mutation: a host-only field read on the replay path.
+
+_admit_one is reached from run_follower, and the deadline check reads
+req.deadline_s without a lockstep gate — the follower sees None where
+the primary sees a float, so admission decisions diverge.
+"""
+
+
+class Engine:
+    def _admit_one(self, handle):
+        req = handle.request
+        if req.deadline_s is not None:
+            self.expired = True

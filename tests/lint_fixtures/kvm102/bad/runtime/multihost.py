@@ -1,0 +1,15 @@
+"""KVM102 fixture, follower side: declares the host-only contract.
+
+_HOST_ONLY_FIELDS mirrors runtime/multihost.py: fields req_payload
+strips before the admit decision crosses the wire, so follower-replayed
+code observing them diverges from the primary.
+"""
+
+_HOST_ONLY_FIELDS = {"deadline_s", "trace_id"}
+
+
+def run_follower(engine, commands):
+    for cmd in commands:
+        op = cmd[0]
+        if op == "admit":
+            engine._admit_one(cmd[1])

@@ -1,0 +1,18 @@
+"""KVM102 good case: host-only reads gated or annotated.
+
+The deadline read sits behind a `not self._lockstep` gate, so both
+hosts take the same branch in lockstep; the trace_id read is host-local
+telemetry and carries the protocol-ok annotation (used, not stale).
+"""
+
+
+class Engine:
+    def _admit_one(self, handle):
+        req = handle.request
+        if not self._lockstep and req.deadline_s is not None:
+            self.expired = True
+        # telemetry is host-local by design (kvmini: protocol-ok)
+        self._note(req.trace_id)
+
+    def _note(self, tid):
+        self.seen = tid

@@ -1,0 +1,10 @@
+"""KVM102 good case, follower side: same contract declaration."""
+
+_HOST_ONLY_FIELDS = {"deadline_s", "trace_id"}
+
+
+def run_follower(engine, commands):
+    for cmd in commands:
+        op = cmd[0]
+        if op == "admit":
+            engine._admit_one(cmd[1])

@@ -1,0 +1,23 @@
+"""KVM103 seeded mutation: handoff versions the consumer never accepts.
+
+The producer stamps KVHandoff(version=HANDOFF_VERSION_V3) and a raw
+version=4, but the consume path (runtime/engine.py) only compares
+against HANDOFF_VERSION — both handoffs would be rejected at runtime.
+"""
+
+HANDOFF_VERSION = 2
+HANDOFF_VERSION_V3 = 3
+
+
+class KVHandoff:
+    def __init__(self, version, payload=None):
+        self.version = version
+        self.payload = payload
+
+
+def make_v3(payload):
+    return KVHandoff(version=HANDOFF_VERSION_V3, payload=payload)
+
+
+def make_raw(payload):
+    return KVHandoff(version=4, payload=payload)

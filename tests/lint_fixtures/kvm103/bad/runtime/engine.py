@@ -1,0 +1,10 @@
+"""KVM103 fixture, consumer side: only HANDOFF_VERSION is negotiated."""
+
+from .disagg import HANDOFF_VERSION
+
+
+class Engine:
+    def _consume(self, ho):
+        if ho.version != HANDOFF_VERSION:
+            return None
+        return ho.payload

@@ -1,0 +1,21 @@
+"""KVM103 good case: every stamped version is negotiated downstream.
+
+Includes the conditional-version producer shape (IfExp) — both arms
+must be covered by the consumer's accept set.
+"""
+
+HANDOFF_VERSION = 2
+PAGED_HANDOFF_VERSION = 3
+
+
+class KVHandoff:
+    def __init__(self, version, payload=None):
+        self.version = version
+        self.payload = payload
+
+
+def make(payload, paged=False):
+    return KVHandoff(
+        version=PAGED_HANDOFF_VERSION if paged else HANDOFF_VERSION,
+        payload=payload,
+    )

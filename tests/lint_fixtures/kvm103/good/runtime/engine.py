@@ -1,0 +1,10 @@
+"""KVM103 good case, consumer side: accepts both negotiated versions."""
+
+from .disagg import HANDOFF_VERSION, PAGED_HANDOFF_VERSION
+
+
+class Engine:
+    def _consume(self, ho):
+        if ho.version not in (HANDOFF_VERSION, PAGED_HANDOFF_VERSION):
+            return None
+        return ho.payload

@@ -1,0 +1,25 @@
+"""KVM104 seeded mutations: an unsound degrade ladder.
+
+Two bugs: `_disagg_degraded` is re-armed back to False from a retry
+path (sticky flags are terminal outside init/reset — a flapping ladder
+re-enters the failure mode it just escaped), and `_tier_disabled` is
+read as a gate but no code path ever sets it True (a ladder level with
+no entry edge — dead config, or a lost write).
+"""
+
+
+class Engine:
+    def __init__(self):
+        self._disagg_degraded = False
+        self._tier_disabled = False
+
+    def _on_handoff_drop(self):
+        self._disagg_degraded = True
+
+    def _retry_path(self):
+        self._disagg_degraded = False
+
+    def _maybe_tier(self):
+        if self._tier_disabled:
+            return None
+        return 1

@@ -1,0 +1,31 @@
+"""KVM104 good case: a sound degrade ladder.
+
+Every sticky flag has an entry edge, re-arms live only on reset paths
+(name-matched: __init__ / reset* / clear*), and the one deliberate
+out-of-band re-arm — an explicit operator action — carries the
+protocol-ok annotation (used, not stale).
+"""
+
+
+class Engine:
+    def __init__(self):
+        self._disagg_degraded = False
+        self._tier_disabled = False
+
+    def _on_handoff_drop(self):
+        self._disagg_degraded = True
+
+    def _on_tier_thrash(self):
+        self._tier_disabled = True
+
+    def reset(self):
+        self._disagg_degraded = False
+
+    def _operator_rearm(self):
+        # explicit operator action re-enables the tier (kvmini: protocol-ok)
+        self._tier_disabled = False
+
+    def _maybe_tier(self):
+        if self._tier_disabled:
+            return None
+        return 1

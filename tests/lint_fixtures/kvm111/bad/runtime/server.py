@@ -1,0 +1,22 @@
+"""KVM111 seeded mutations: fabricated zeros in exported surfaces.
+
+Two in the /metrics exposition (a `.get(..., 0)` default and an
+`or 0` coalesce — both print 0.0 where the sample is absent, and a
+dashboard can't tell "measured zero" from "not measured") and one in a
+merge_into_results payload (a missing energy sample written as 0 Wh
+poisons the run artifact downstream attribution reads).
+"""
+
+
+def metrics_text(s):
+    lines = [
+        f"kvmini_tpu_econ_usd_per_1k_tokens {s.get('usd_per_1k', 0)}",
+        f"kvmini_tpu_tokens_per_sec {s['tokens_per_sec'] or 0}",
+    ]
+    return "\n".join(lines)
+
+
+def finalize(run_dir, doc):
+    run_dir.merge_into_results({
+        "energy_wh": doc.get("energy_wh", 0),
+    })

@@ -1,0 +1,26 @@
+"""KVM111 good case: absent stays absent.
+
+Optional samples are presence-gated (the metric line simply isn't
+emitted, and the results key simply isn't written), and the one
+legitimate zero-default — a fixed-vocabulary counter where 0 means
+"observed zero times" — carries the contract-ok annotation (used).
+"""
+
+
+def metrics_text(s):
+    lines = []
+    if "usd_per_1k" in s:
+        lines.append(f"kvmini_tpu_econ_usd_per_1k_tokens {s['usd_per_1k']}")
+    counts = {"miss": 0}
+    lines.append(
+        # fixed vocabulary: 0 means observed-zero-times (kvmini: contract-ok)
+        f"kvmini_tpu_lookups_total {counts.get('miss', 0)}"
+    )
+    return "\n".join(lines)
+
+
+def finalize(run_dir, doc):
+    out = {}
+    if "energy_wh" in doc:
+        out["energy_wh"] = doc["energy_wh"]
+    run_dir.merge_into_results(out)

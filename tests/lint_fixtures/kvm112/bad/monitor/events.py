@@ -1,0 +1,23 @@
+"""KVM112 seeded mutations, emitter side: taxonomy drift.
+
+"mystery_emit" is emitted but missing from EVENT_TYPES, and
+"ghost_event" sits in the taxonomy with no emit site anywhere and no
+row in the monitoring doc — a consumer filtering on it waits forever.
+"""
+
+EVENT_TYPES = ("decode_stall", "ghost_event")
+
+
+class Event:
+    def __init__(self, t, type_, detail=None):
+        self.t = t
+        self.type = type_
+        self.detail = detail
+
+
+def detect(samples):
+    out = []
+    for sample in samples:
+        out.append(Event(sample["t"], "decode_stall"))
+        out.append(Event(sample["t"], "mystery_emit"))
+    return out

@@ -1,0 +1,15 @@
+"""KVM112 seeded mutation, consumer side: filtering on a ghost type.
+
+"unknown_consumed" is matched against event["type"] but no emitter
+produces it and the taxonomy doesn't list it — the branch is dead.
+"""
+
+
+def render(events):
+    rows = []
+    for e in events:
+        if e.get("type") == "unknown_consumed":
+            rows.append(e)
+        if e.get("type") == "decode_stall":
+            rows.append(e)
+    return rows

@@ -1,0 +1,17 @@
+"""KVM112 good case, emitter side: taxonomy, emits, and docs agree."""
+
+EVENT_TYPES = ("decode_stall",)
+
+
+class Event:
+    def __init__(self, t, type_, detail=None):
+        self.t = t
+        self.type = type_
+        self.detail = detail
+
+
+def detect(samples):
+    out = []
+    for sample in samples:
+        out.append(Event(sample["t"], "decode_stall"))
+    return out

@@ -1,0 +1,16 @@
+"""KVM112 good case, consumer side: in-taxonomy filter plus one
+annotated foreign marker (an external tool's tag this report merely
+passes through — contract-ok, and the suppression must count as used).
+"""
+
+
+def render(events):
+    rows = []
+    for e in events:
+        if e.get("type") == "decode_stall":
+            rows.append(e)
+        # injected by the external capture tool, not ours to taxonomize
+        # (kvmini: contract-ok)
+        if e.get("type") == "external_marker":
+            rows.append(e)
+    return rows

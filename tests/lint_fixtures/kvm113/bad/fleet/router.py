@@ -1,0 +1,8 @@
+"""KVM113 seeded mutation, client side: proxying a path the mock
+fleet can't serve — every test that exercises this proxy 404s."""
+
+
+class Router:
+    async def proxy_models(self, sess, url):
+        async with sess.get(url + "/v1/models") as up:
+            return await up.json()

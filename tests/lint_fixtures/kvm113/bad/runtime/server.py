@@ -1,0 +1,25 @@
+"""KVM113 seeded mutations, server side.
+
+Two here: /v1/models is registered but absent from docs/API.md (an
+operator reading the doc doesn't know the surface exists), and
+_shed_response answers load-shed without the Retry-After header the
+documented 429 contract promises (clients back off blind).
+"""
+
+from aiohttp import web
+
+
+def make_app(engine):
+    async def chat(_request):
+        return web.json_response({"ok": True})
+
+    async def models(_request):
+        return web.json_response({"object": "list", "data": []})
+
+    def _shed_response():
+        return web.json_response({"error": "shed"}, status=429)
+
+    app = web.Application()
+    app.router.add_post("/v1/chat/completions", chat)
+    app.router.add_get("/v1/models", models)
+    return app

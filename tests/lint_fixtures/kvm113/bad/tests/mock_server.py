@@ -1,0 +1,20 @@
+"""KVM113 seeded mutation, mock side: a phantom route.
+
+/bogus exists only here — tests passing against it prove nothing
+about the real server, which would 404 the same request.
+"""
+
+from aiohttp import web
+
+
+def make_app():
+    async def chat(_request):
+        return web.json_response({"ok": True})
+
+    async def bogus(_request):
+        return web.json_response({})
+
+    app = web.Application()
+    app.router.add_post("/v1/chat/completions", chat)
+    app.router.add_get("/bogus", bogus)
+    return app

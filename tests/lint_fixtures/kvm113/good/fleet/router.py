@@ -1,0 +1,7 @@
+"""KVM113 good case, client side: the proxied path is mock-served."""
+
+
+class Router:
+    async def proxy_models(self, sess, url):
+        async with sess.get(url + "/v1/models") as up:
+            return await up.json()

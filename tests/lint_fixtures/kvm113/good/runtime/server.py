@@ -1,0 +1,24 @@
+"""KVM113 good case, server side: surfaces, mock, docs all agree,
+and the shed response carries the documented 429 + Retry-After shape."""
+
+from aiohttp import web
+
+
+def make_app(engine):
+    async def chat(_request):
+        return web.json_response({"ok": True})
+
+    async def models(_request):
+        return web.json_response({"object": "list", "data": []})
+
+    def _shed_response(retry_after):
+        return web.json_response(
+            {"error": "shed"},
+            status=429,
+            headers={"Retry-After": str(retry_after)},
+        )
+
+    app = web.Application()
+    app.router.add_post("/v1/chat/completions", chat)
+    app.router.add_get("/v1/models", models)
+    return app

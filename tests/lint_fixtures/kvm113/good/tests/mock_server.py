@@ -1,0 +1,16 @@
+"""KVM113 good case, mock side: routes mirror the real server."""
+
+from aiohttp import web
+
+
+def make_app():
+    async def chat(_request):
+        return web.json_response({"ok": True})
+
+    async def models(_request):
+        return web.json_response({"object": "list", "data": []})
+
+    app = web.Application()
+    app.router.add_post("/v1/chat/completions", chat)
+    app.router.add_get("/v1/models", models)
+    return app

@@ -532,8 +532,20 @@ def make_app(
             "bytes": len(blocks) * per_block, "exhausted": False,
         })
 
+    async def models(_request: web.Request) -> web.Response:
+        # same OpenAI list shape runtime/server.py serves — the fleet
+        # router proxies the first healthy replica's answer verbatim, so
+        # the mock fleet has to serve the endpoint too (KVM113)
+        return web.json_response({
+            "object": "list",
+            "data": [{"id": server_id or "mock-model", "object": "model",
+                      "created": int(t_app_start),
+                      "owned_by": "kvmini-tpu-mock"}],
+        })
+
     app = web.Application()
     app.router.add_post("/v1/chat/completions", chat)
+    app.router.add_get("/v1/models", models)
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/traces", traces)
     app.router.add_get("/healthz", healthz)

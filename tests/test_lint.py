@@ -11,7 +11,11 @@ time.time() inside a jitted fn (KVM013), the KVM05x seeded races
 Event.wait/join), and the KVM06x/07x seeded numerics/lifecycle bugs
 (bf16 x f32-scale upcast, dequant dropping the zero-point, the
 ops/quant.py sub-byte bitcast unpack, donated buffer read after
-dispatch, double-free of a KV block id).
+dispatch, double-free of a KV block id), and the KVM10x/11x protocol
+and contract mutations (a published decision with no replay arm, an
+ungated host-only field read, an unnegotiated handoff version, a
+degrade-flag re-arm, fabricated zeros in exported surfaces, event
+taxonomy drift, an HTTP surface the mock/docs don't mirror).
 
 The pin test runs the real linter over the real package against the
 committed lint-baseline.json: no new findings, no stale entries, no
@@ -101,6 +105,21 @@ CASES = [
     ("kvm092", {"KVM092": 1}),  # ISSUE seeded bug: double release on the
     #                             drain path (abort already released)
     ("kvm093", {"KVM093": 1}),  # finally re-raises past the pending release
+    ("kvm101", {"KVM101": 2}),  # ISSUE seeded mutation: published "handoff"
+    #                             with no replay arm + dead "dispatch" arm
+    ("kvm102", {"KVM102": 1}),  # ISSUE seeded mutation: ungated host-only
+    #                             deadline_s read on the replay path
+    ("kvm103", {"KVM103": 2}),  # ISSUE seeded mutation: handoff stamped with
+    #                             an unnegotiated constant + a raw int
+    ("kvm104", {"KVM104": 2}),  # ISSUE seeded mutation: False re-arm outside
+    #                             reset + sticky flag with no entry edge
+    ("kvm111", {"KVM111": 3}),  # ISSUE seeded mutation: fabricated zeros in
+    #                             /metrics (.get default, or-0) + results key
+    ("kvm112", {"KVM112": 4}),  # ISSUE seeded mutation: emit/consume drift
+    #                             vs EVENT_TYPES + an undocumented member
+    ("kvm113", {"KVM113": 4}),  # ISSUE seeded mutation: mockless client
+    #                             path, phantom mock route, undocumented
+    #                             endpoint, shed response sans Retry-After
 ]
 
 
@@ -113,6 +132,20 @@ def test_bad_fixture_produces_exactly_the_expected_diagnostics(rule, expected):
 def test_good_fixture_lints_clean(rule):
     diags = lint_fixture(rule, "good")
     assert diags == [], [d.render() for d in diags]
+
+
+def test_partial_scan_never_calls_protocol_suppressions_stale():
+    """The KVM10x/11x families stand down on subset scans (the missing
+    replay arm may live in an unscanned module) — so must the KVM001
+    staleness check for their tokens: a single-file scan of the publish
+    side cannot see the follower that makes its protocol-ok earn its
+    keep, and must not demand the annotation be deleted."""
+    publisher = FIXTURES / "kvm101" / "good" / "runtime" / "engine.py"
+    result = run_lint([publisher], root=REPO)
+    assert not result.parse_errors
+    assert result.diagnostics == [], [
+        d.render() for d in result.diagnostics
+    ]
 
 
 def test_every_rule_code_has_a_fixture():
@@ -449,6 +482,40 @@ def test_changed_mode_resolves_git_paths_from_a_subdirectory(tmp_path,
     assert any(f["path"].endswith("fresh.py") for f in doc["findings"])
 
 
+def test_changed_mode_skips_deleted_and_renamed_files(tmp_path, monkeypatch,
+                                                      capsys):
+    """A deleted (or renamed-away) tracked file shows in `git diff
+    --name-only` but no longer exists — the subset scan must skip it
+    with a note instead of handing run_lint a missing path, and still
+    lint the files that DO exist."""
+    (tmp_path / "doomed.py").write_text("x = 1\n")
+    (tmp_path / "kept.py").write_text(
+        "import jax\n\n\n@jax.jit\ndef f(x):\n    return x\n")
+    _git(tmp_path, "init", "-q")
+    _git(tmp_path, "config", "user.email", "lint@test")
+    _git(tmp_path, "config", "user.name", "lint test")
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-q", "-m", "seed")
+    (tmp_path / "doomed.py").unlink()
+    (tmp_path / "kept.py").write_text(
+        "import time\n\nimport jax\n\n\n"
+        "@jax.jit\ndef f(x):\n    return x * time.time()\n")
+    monkeypatch.chdir(tmp_path)
+    rc = lint_main([".", "--changed", "HEAD", "--no-baseline"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "skipping 1 deleted/renamed file(s): doomed.py" in out
+    assert "KVM013" in out and "kept.py" in out
+
+    # ONLY deletions in the diff: empty subset, clean exit, note intact
+    (tmp_path / "kept.py").unlink()
+    rc = lint_main([".", "--changed", "HEAD", "--no-baseline"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "skipping 2 deleted/renamed file(s): doomed.py, kept.py" in out
+    assert "nothing to lint" in out
+
+
 def test_partial_scan_never_invents_mesh_findings(tmp_path, monkeypatch,
                                                   capsys):
     """Subset-vs-full soundness for the absence-based mesh rules: helper
@@ -538,16 +605,17 @@ def test_live_codebase_matches_baseline_exactly():
     )
     assert not [d for d in result.diagnostics if d.code == "KVM001"], (
         "stale `# kvmini:` suppressions in the live tree (dtype-ok/"
-        "buffer-ok/mesh-ok/resource-ok included — KVM001 tracks every token)"
+        "buffer-ok/mesh-ok/resource-ok/protocol-ok/contract-ok included — "
+        "KVM001 tracks every token)"
     )
-    # every family ran and reported its wall time — all TEN timing
+    # every family ran and reported its wall time — all TWELVE timing
     # entries, the `--timing` surface CI uploads to attribute speed drift
     assert {"facts", "jit_purity", "lockstep", "workload", "concurrency",
             "metrics_drift", "dtype_flow", "buffer_lifecycle",
-            "mesh_flow", "resource_paths"} <= set(result.timings)
-    # 20s: ~9s idle on this box with all TEN families (KVM08x/09x added
-    # ~1.2s combined; ~12s under full-suite load — a 12s pin would flake
-    # the same way the 10s one did). lint-timing.json (CI artifact, now
-    # with per-family finding counts) still names the checker if one of
-    # them regresses.
+            "mesh_flow", "resource_paths", "protocol_flow",
+            "contract_flow"} <= set(result.timings)
+    # 20s: ~13s idle on this box with all TWELVE families (KVM10x/11x
+    # added ~3s combined; ~12s under full-suite load already flaked a
+    # 12s pin once). lint-timing.json (CI artifact, now with per-family
+    # finding counts) still names the checker if one of them regresses.
     assert elapsed < 20.0, f"kvmini-lint took {elapsed:.1f}s (budget 20s)"
