@@ -2,7 +2,7 @@
 # of native code — the TPU compute path is JAX/XLA compiled at runtime.
 PY ?= python
 
-.PHONY: help test test-fast test-policy lint lint-invariants lint-changed fmt smoke bench bench-smoke bench-proxy-smoke chaos-smoke fleet-smoke kv-economy-smoke econ-smoke trajectory dashboards-validate helm-lint airgap clean
+.PHONY: help test test-fast test-policy lint lint-invariants lint-changed fmt smoke bench bench-smoke bench-proxy-smoke chaos-smoke fleet-smoke fleet-trace-smoke kv-economy-smoke econ-smoke trajectory dashboards-validate helm-lint airgap clean
 
 help:
 	@grep -E '^[a-z-]+:' Makefile | sed 's/:.*//' | sort | uniq
@@ -99,6 +99,16 @@ chaos-smoke:  ## local-mode chaos matrix vs the mock server, no TPU, no cluster
 # resilience-table replica rows, all with no engine and no cluster.
 fleet-smoke:  ## fleet router/supervisor/actuator vs mock replicas, no TPU
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_fleet.py -q -m "not slow"
+
+# the fleet tracing acceptance gate (docs/TRACING.md "Fleet tracing"):
+# the router's fleet.route/fleet.proxy span rail, honest shed /
+# replica_lost terminal status, the bounded decision audit ring behind
+# GET /fleet/decisions, per-replica clock-offset stitching of client +
+# router + replica lanes into one schema-valid traces.json (one replica
+# clock-skewed, one forced re-placement), and the report's fleet lane —
+# all against JAX-free in-process mock replicas.
+fleet-trace-smoke:  ## router spans + decision audit + 3-lane stitch, no TPU
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_fleet_tracing.py -q -m "not slow"
 
 # the KV-block economy acceptance gate (docs/DISAGGREGATION.md v2,
 # docs/FLEET.md warm-from-sibling, docs/TROUBLESHOOTING.md host tier):

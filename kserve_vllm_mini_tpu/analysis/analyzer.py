@@ -171,23 +171,51 @@ def analyze_run(
     # server-side request traces (docs/TRACING.md): fetch /traces, merge
     # the server leg into runs/<id>/traces/traces.json joined by trace_id,
     # and summarize the queue/prefill/decode phases into phase_breakdown.
+    # A fleet-router endpoint stitches THREE lanes — client, router
+    # (fleet.route/fleet.proxy), and one lane per replica with its own
+    # clock offset — and joins the p99 outlier to its routing decision.
     # External engines without /traces degrade to the client-only doc.
     if endpoint:
         from kserve_vllm_mini_tpu.analysis import traces as traces_mod
 
-        server_doc = traces_mod.fetch_server_traces(endpoint)
-        if server_doc.get("resourceSpans"):
+        fleet_replicas = traces_mod.fetch_fleet_replicas(endpoint)
+        if fleet_replicas:
+            router_doc = traces_mod.fetch_server_traces(endpoint)
+            replica_docs = {
+                rid: traces_mod.fetch_server_traces(url)
+                for rid, url in fleet_replicas
+            }
             client_doc = run_dir.read_traces()
-            merged, matched = traces_mod.merge_server_traces(
-                client_doc, server_doc
+            merged, matched = traces_mod.merge_fleet_traces(
+                client_doc, router_doc, replica_docs
             )
             if matched:
                 run_dir.write_traces(merged)
                 pb = traces_mod.phase_breakdown(
-                    matched, merged.get("clockOffsetNanosEstimate")
+                    matched, merged.get("clockOffsetNanosEstimate"),
+                    source="fleet:/traces",
                 )
                 if pb:
                     update["phase_breakdown"] = pb
+            outlier = traces_mod.outlier_attribution(
+                records, traces_mod.fetch_fleet_decisions(endpoint)
+            )
+            if outlier:
+                update["routing_outlier"] = outlier
+        else:
+            server_doc = traces_mod.fetch_server_traces(endpoint)
+            if server_doc.get("resourceSpans"):
+                client_doc = run_dir.read_traces()
+                merged, matched = traces_mod.merge_server_traces(
+                    client_doc, server_doc
+                )
+                if matched:
+                    run_dir.write_traces(merged)
+                    pb = traces_mod.phase_breakdown(
+                        matched, merged.get("clockOffsetNanosEstimate")
+                    )
+                    if pb:
+                        update["phase_breakdown"] = pb
 
     io_probe = run_dir.read_io_probe()
     for key in ("network_rtt_p50_ms", "network_rtt_p95_ms", "storage_fetch_mbps"):

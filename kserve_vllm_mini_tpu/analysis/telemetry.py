@@ -569,6 +569,10 @@ FLEET_METRIC_KEYS = {
     "scale_ups": "kvmini_tpu_fleet_scale_ups_total",
     "scale_downs": "kvmini_tpu_fleet_scale_downs_total",
     "last_cold_start_s": "kvmini_tpu_fleet_last_cold_start_seconds",
+    # routing-latency rail (docs/TRACING.md "Fleet tracing"): cumulative
+    # fleet.route span wall + audit-ring eviction count
+    "route_seconds_total": "kvmini_tpu_fleet_route_seconds_total",
+    "decisions_dropped": "kvmini_tpu_fleet_decisions_dropped_total",
 }
 
 

@@ -28,17 +28,32 @@ import json
 import urllib.request
 from typing import Any, Optional
 
-from kserve_vllm_mini_tpu.runtime.tracing import SERVER_SCOPE, spans_from_otlp
+from kserve_vllm_mini_tpu.runtime.tracing import (
+    ROUTER_SCOPE,
+    SERVER_SCOPE,
+    spans_from_otlp,
+)
 
 SERVER_PHASE_SPANS = ("server.queue", "server.handoff", "server.prefill",
                       "server.decode")
 
+# router-lane phase spans (fleet/router.py): the placement+proxy window
+# and each per-attempt upstream call — phase keys "route" and "proxy"
+FLEET_PHASE_SPANS = ("fleet.route", "fleet.proxy")
+
+PHASE_SPANS = SERVER_PHASE_SPANS + FLEET_PHASE_SPANS
+
+# scopes the analyzer merges in (and must strip back out on re-analyze):
+# the server leg and the fleet-router leg each export under their own
+# scope so each lane replaces independently
+_MERGED_SCOPES = frozenset({SERVER_SCOPE, ROUTER_SCOPE})
+
 
 def _is_server_leg(rs: dict[str, Any]) -> bool:
     """A resourceSpans entry previously merged from a /traces export —
-    identified by the scope name every server-leg exporter stamps."""
+    identified by the scope names the server and router legs stamp."""
     return any(
-        (ss.get("scope") or {}).get("name") == SERVER_SCOPE
+        (ss.get("scope") or {}).get("name") in _MERGED_SCOPES
         for ss in rs.get("scopeSpans", []) or []
     )
 
@@ -46,8 +61,8 @@ def _is_server_leg(rs: dict[str, Any]) -> bool:
 def strip_server_leg(doc: dict[str, Any]) -> dict[str, Any]:
     """The client-only view of a (possibly already merged) traces doc.
     Re-running analyze on an existing run dir reads back the MERGED doc;
-    without this strip each re-run would append a duplicate server block
-    (and the offset estimate would key off stale spans)."""
+    without this strip each re-run would append duplicate server/router
+    blocks (and the offset estimates would key off stale spans)."""
     return {
         **doc,
         "resourceSpans": [
@@ -79,11 +94,14 @@ def _span_ns(span: dict[str, Any]) -> tuple[int, int]:
 
 
 def estimate_clock_offset_ns(
-    client_doc: dict[str, Any], server_doc: dict[str, Any]
+    client_doc: dict[str, Any], server_doc: dict[str, Any],
+    span_name: str = "server.queue",
 ) -> Optional[int]:
-    """min over joined traces of (server.queue.start - http.request.start);
+    """min over joined traces of (<span_name>.start - http.request.start);
     None when no trace appears in both legs. See the module docstring for
-    why min is the right statistic."""
+    why min is the right statistic. ``span_name`` is the other leg's
+    first-touch span: ``server.queue`` for a replica, ``fleet.route``
+    for the router lane."""
     client_http: dict[str, int] = {}
     for _svc, s in spans_from_otlp(client_doc):
         if s.get("name") == "http.request":
@@ -91,7 +109,7 @@ def estimate_clock_offset_ns(
     deltas = [
         _span_ns(s)[0] - client_http[s["traceId"]]
         for _svc, s in spans_from_otlp(server_doc)
-        if s.get("name") == "server.queue" and s.get("traceId") in client_http
+        if s.get("name") == span_name and s.get("traceId") in client_http
     ]
     return min(deltas) if deltas else None
 
@@ -164,17 +182,172 @@ def merge_server_traces(
     return merged, matched
 
 
+def fetch_fleet_replicas(
+    endpoint: str, timeout_s: float = 5.0
+) -> list[tuple[str, str]]:
+    """GET <endpoint>/fleet -> [(rid, url), ...], or [] when the endpoint
+    is not a fleet router (single engines, external stacks) — absence
+    degrades the stitch to the single-server merge, never fails it."""
+    url = endpoint.rstrip("/") + "/fleet"
+    try:
+        with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+            doc = json.loads(resp.read())
+    except Exception:
+        return []
+    if not isinstance(doc, dict):
+        return []
+    out: list[tuple[str, str]] = []
+    for r in doc.get("replicas") or []:
+        if isinstance(r, dict) and r.get("rid") and r.get("url"):
+            out.append((str(r["rid"]), str(r["url"])))
+    return out
+
+
+def fetch_fleet_decisions(
+    endpoint: str, timeout_s: float = 5.0
+) -> list[dict[str, Any]]:
+    """GET <endpoint>/fleet/decisions -> the routing audit entries, or []
+    off a non-router endpoint — same degrade rule as every fetch here."""
+    url = endpoint.rstrip("/") + "/fleet/decisions"
+    try:
+        with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+            doc = json.loads(resp.read())
+    except Exception:
+        return []
+    if not isinstance(doc, dict):
+        return []
+    return [d for d in doc.get("decisions") or [] if isinstance(d, dict)]
+
+
+def outlier_attribution(
+    records: list[Any], decisions: list[dict[str, Any]]
+) -> dict[str, Any]:
+    """Join the p99-latency request to its routing decision(s) by
+    trace_id: "why was the worst request slow" answered from the audit
+    ring — which replica won, what every candidate scored, and how many
+    times the request was re-placed. {} when the join is empty (no
+    trace ids, no matching audit entries, ring already evicted them)."""
+    ok = [r for r in records if r.ok and r.trace_id]
+    if not ok or not decisions:
+        return {}
+    by_latency = sorted(ok, key=lambda r: r.latency_ms)
+    outlier = by_latency[min(int(0.99 * len(by_latency)),
+                             len(by_latency) - 1)]
+    mine = [d for d in decisions
+            if d.get("type") == "placement"
+            and d.get("trace_id") == outlier.trace_id]
+    if not mine:
+        return {}
+    return {
+        "trace_id": outlier.trace_id,
+        "latency_ms": outlier.latency_ms,
+        "placements": len(mine),
+        "decisions": mine,
+    }
+
+
+def _lane_entry(service: str, scope: str,
+                spans: list[dict[str, Any]]) -> dict[str, Any]:
+    return {
+        "resource": {
+            "attributes": [
+                {"key": "service.name", "value": {"stringValue": service}}
+            ]
+        },
+        "scopeSpans": [{"scope": {"name": scope}, "spans": spans}],
+    }
+
+
+def merge_fleet_traces(
+    client_doc: dict[str, Any],
+    router_doc: dict[str, Any],
+    replica_docs: dict[str, dict[str, Any]],
+) -> tuple[dict[str, Any], list[dict[str, Any]]]:
+    """Three-lane stitch: (merged OTLP doc, matched router+server spans).
+
+    Lanes: client (loadgen), router (``fleet.route``/``fleet.proxy``
+    under ``ROUTER_SCOPE``), and one server lane PER replica — each
+    replica gets its OWN clock-offset estimate against the client clock
+    (``clockOffsetsNanosByReplica``), because the single min-offset
+    assumption of ``merge_server_traces`` is wrong the moment two
+    replicas' clocks disagree. Every merged server span is stamped with
+    a ``replica`` attribute so the report can shift each span by its own
+    replica's offset. The router's own offset lands as
+    ``clockOffsetNanosRouter``; the legacy ``clockOffsetNanosEstimate``
+    is kept as the min over replicas so single-lane consumers keep
+    working. IDEMPOTENT like the single-server merge: previously merged
+    server AND router legs are stripped and replaced."""
+    client_doc = strip_server_leg(client_doc)
+    client_ids = {
+        s.get("traceId") for _svc, s in spans_from_otlp(client_doc)
+    }
+    matched: list[dict[str, Any]] = []
+    entries: list[dict[str, Any]] = []
+
+    router_spans = [
+        s for _svc, s in spans_from_otlp(router_doc)
+        if s.get("traceId") in client_ids
+    ]
+    router_offset = estimate_clock_offset_ns(
+        client_doc, router_doc, span_name="fleet.route"
+    )
+    if router_spans:
+        entries.append(
+            _lane_entry("kvmini-tpu-router", ROUTER_SCOPE, router_spans)
+        )
+        matched += router_spans
+
+    offsets: dict[str, int] = {}
+    for rid in sorted(replica_docs):
+        doc = replica_docs[rid] or {}
+        spans: list[dict[str, Any]] = []
+        for _svc, s in spans_from_otlp(doc):
+            if s.get("traceId") in client_ids:
+                spans.append({
+                    **s,
+                    "attributes": list(s.get("attributes") or []) + [
+                        {"key": "replica",
+                         "value": {"stringValue": rid}}
+                    ],
+                })
+        if not spans:
+            continue
+        off = estimate_clock_offset_ns(client_doc, doc)
+        if off is not None:
+            offsets[rid] = off
+        entries.append(
+            _lane_entry(f"kvmini-tpu-runtime/{rid}", SERVER_SCOPE, spans)
+        )
+        matched += spans
+
+    merged = dict(client_doc)
+    merged["resourceSpans"] = (
+        list(client_doc.get("resourceSpans", []) or []) + entries
+    )
+    if offsets:
+        merged["clockOffsetsNanosByReplica"] = offsets
+        merged["clockOffsetNanosEstimate"] = min(offsets.values())
+    if router_offset is not None:
+        merged["clockOffsetNanosRouter"] = router_offset
+    return merged, matched
+
+
 def phase_breakdown(
     server_spans: list[dict[str, Any]],
     clock_offset_ns: Optional[int] = None,
+    source: str = "server:/traces",
 ) -> dict[str, Any]:
-    """Server phase spans -> the results.json ``phase_breakdown`` block:
+    """Phase spans -> the results.json ``phase_breakdown`` block:
     per-phase duration percentiles so the next perf PR knows whether
-    latency is queueing, prefill, or decode. {} when no phase spans."""
+    latency is queueing, prefill, decode — or, through a fleet router,
+    routing (``route``) and per-attempt proxying (``proxy``). {} when no
+    phase spans. Durations are same-clock intra-span deltas, so no
+    clock-offset correction applies to them; the offset rides along as
+    ``clock_offset_ms_est`` context only."""
     by_phase: dict[str, list[float]] = {}
     for s in server_spans:
         name = s.get("name", "")
-        if name not in SERVER_PHASE_SPANS:
+        if name not in PHASE_SPANS:
             continue
         start, end = _span_ns(s)
         if end < start:
@@ -201,5 +374,5 @@ def phase_breakdown(
     }
     if clock_offset_ns is not None:
         out["clock_offset_ms_est"] = clock_offset_ns / 1e6
-    out["source"] = "server:/traces"
+    out["source"] = source
     return out

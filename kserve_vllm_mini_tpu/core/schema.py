@@ -141,8 +141,17 @@ class Results:
     # server-side phase attribution (docs/TRACING.md): per-phase duration
     # stats from the runtime's /traces spans merged by the analyzer —
     # {"queue"|"prefill"|"decode": {count, mean_ms, p50_ms, p95_ms,
-    # max_ms}, "clock_offset_ms_est": ..., "source": "server:/traces"}
+    # max_ms}, "clock_offset_ms_est": ..., "source": "server:/traces"}.
+    # Runs through a fleet router also carry the router-lane phases
+    # "route" (placement+proxy window) and "proxy" (per-attempt upstream
+    # call), with source "fleet:/traces".
     phase_breakdown: Optional[dict[str, Any]] = None
+    # p99-outlier routing attribution (docs/TRACING.md "Fleet tracing"):
+    # the slowest request's trace_id joined to its placement decision(s)
+    # from the router's audit ring — {trace_id, latency_ms, placements,
+    # decisions: [...]}; absent for single-server runs and when the ring
+    # already evicted the run's entries.
+    routing_outlier: Optional[dict[str, Any]] = None
 
     # live-monitor summary (docs/MONITORING.md): rolling SLO burn-rates,
     # detected events, sampler accounting and abort info — the shape
@@ -300,6 +309,15 @@ TRACES_JSON_SCHEMA: dict[str, Any] = {
             },
         },
         "clockOffsetNanosEstimate": {"type": "integer"},
+        # fleet stitches (analysis/traces.merge_fleet_traces): one offset
+        # PER replica keyed by rid — two replicas' clocks can disagree,
+        # so a single estimate cannot shift both lanes correctly — plus
+        # the router's own offset against the client clock
+        "clockOffsetsNanosByReplica": {
+            "type": "object",
+            "additionalProperties": {"type": "integer"},
+        },
+        "clockOffsetNanosRouter": {"type": "integer"},
         "droppedSpans": {"type": "integer"},
     },
 }
@@ -433,6 +451,12 @@ TIMELINE_SAMPLE_SCHEMA: dict[str, Any] = {
             "type": "object", "additionalProperties": {"type": "number"}
         },
         "events": {"type": "array"},
+        # trace ids in flight at sample time (docs/MONITORING.md): rides
+        # TOP-level, not inside `loadgen` — that block's contract is a
+        # flat name->number map and must stay numeric
+        "inflight_trace_ids": {
+            "type": "array", "items": {"type": "string"}
+        },
     },
 }
 

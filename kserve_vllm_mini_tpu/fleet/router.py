@@ -39,11 +39,18 @@ import queue
 import threading
 import time
 import zlib
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
 from kserve_vllm_mini_tpu.analysis.telemetry import parse_prometheus_text
+from kserve_vllm_mini_tpu.runtime.tracing import (
+    ROUTER_SCOPE,
+    SpanRecorder,
+    new_span_id,
+    new_trace_id,
+    parse_traceparent,
+)
 
 # replica /metrics series the scoreboard folds into placement state
 _WAIT_METRIC = "kvmini_tpu_estimated_wait_seconds"
@@ -90,6 +97,8 @@ class RouterConfig:
     affinity_max_wait_s: float = 5.0   # affinity breaks past this load
     read_timeout_s: float = 120.0      # upstream silence -> failover
     connect_timeout_s: float = 2.0
+    trace_capacity: int = 4096         # router span ring (GET /traces)
+    decision_capacity: int = 1024      # audit ring (GET /fleet/decisions)
 
     def __post_init__(self) -> None:
         if self.policy not in ("cache_aware", "round_robin"):
@@ -273,9 +282,32 @@ class FleetRouter:
         self.reroutes = 0
         self.sheds = 0
         self.stream_errors = 0
+        # router span ring (GET /traces): same bounded/lock-free-by-
+        # contract recorder the engine uses; all writes happen on the one
+        # event loop, /traces renders from snapshot()
+        self.tracer = SpanRecorder(capacity=self.cfg.trace_capacity)
+        # routing decision audit ring (GET /fleet/decisions): per-decision
+        # explain — every candidate's score terms and why the winner won.
+        # Bounded deque, event-loop-owned like every other routing state.
+        self._decisions: "deque[dict[str, Any]]" = deque(
+            maxlen=max(int(self.cfg.decision_capacity), 1)
+        )
+        self.decisions_dropped = 0
+        self._decision_seq = 0
+        self.route_seconds_total = 0.0    # cumulative fleet.route wall
         self._client: Any = None          # aiohttp.ClientSession
         self._scoreboard_task: Any = None
         self._started = time.time()
+
+    def _audit(self, entry: dict[str, Any]) -> None:
+        """Append one decision-audit entry. All writers and the
+        /fleet/decisions reader run on the router's one event loop."""
+        self._decision_seq += 1  # kvmini: thread-ok — same loop
+        if len(self._decisions) == self._decisions.maxlen:
+            self.decisions_dropped += 1
+        self._decisions.append(
+            {"seq": self._decision_seq, "t": time.time(), **entry}
+        )
 
     # -- replica set + scoreboard -----------------------------------------
 
@@ -352,6 +384,11 @@ class FleetRouter:
             for s in [s for s, rid in self._sessions.items()
                       if rid == r.rid]:
                 del self._sessions[s]  # kvmini: thread-ok — same loop
+            # health flips land in the audit ring too: "why did traffic
+            # leave r0 at t?" is answerable from /fleet/decisions alone
+            self._audit({"type": "health", "rid": r.rid,
+                         "healthy": False,
+                         "scrape_failures": r.scrape_failures})
         r.healthy = False
 
     # -- placement ---------------------------------------------------------
@@ -362,39 +399,70 @@ class FleetRouter:
     def place(
         self, prompt: str, session: Optional[str],
         exclude: Optional[set[str]] = None,
+        trace_id: Optional[str] = None,
     ) -> tuple[Optional[ReplicaView], str]:
         """Pick a replica for this prompt; returns (view, reason) or
-        (None, "") when no healthy candidate remains."""
+        (None, "") when no healthy candidate remains. Every call lands
+        one explain entry in the decision audit ring: all candidates'
+        score terms plus why the winner won (GET /fleet/decisions)."""
         exclude = exclude or set()
         cands = sorted(
             (r for r in self._views.values()
              if r.healthy and r.rid not in exclude),
             key=lambda r: r.rid,
         )
+        hits = self._prefix.best(prompt)
+        plen = max(len(prompt), 1)
+        scores = [
+            (min(hits.get(r.rid, 0), plen) / plen
+             - self.cfg.load_weight * self._load(r))
+            for r in cands
+        ]
+        decision: dict[str, Any] = {
+            "type": "placement",
+            "trace_id": trace_id,
+            "policy": self.cfg.policy,
+            "prompt_chars": len(prompt),
+            "session": session,
+            "exclude": sorted(exclude),
+            "candidates": [
+                {
+                    "rid": r.rid,
+                    "score": round(score, 6),
+                    "matched_prefix_chars": min(hits.get(r.rid, 0), plen),
+                    "estimated_wait_s": round(r.est_wait_s, 4),
+                    "inflight": r.inflight,
+                }
+                for r, score in zip(cands, scores)
+            ],
+        }
+
+        def _decide(chosen: Optional[ReplicaView], reason: str
+                    ) -> tuple[Optional[ReplicaView], str]:
+            decision["chosen"] = chosen.rid if chosen is not None else None
+            decision["reason"] = reason or "no_candidate"
+            self._audit(decision)
+            return chosen, reason
+
         if not cands:
-            return None, ""
+            return _decide(None, "")
         if self.cfg.policy == "round_robin":
             self._rr += 1
-            return cands[self._rr % len(cands)], "round_robin"
+            return _decide(cands[self._rr % len(cands)], "round_robin")
         if session:
             rid = self._sessions.get(session)
             if rid is not None:
                 pinned = next((r for r in cands if r.rid == rid), None)
                 if (pinned is not None
                         and self._load(pinned) <= self.cfg.affinity_max_wait_s):
-                    return pinned, "affinity"
-        hits = self._prefix.best(prompt)
-        plen = max(len(prompt), 1)
+                    return _decide(pinned, "affinity")
         best: Optional[ReplicaView] = None
         best_score = 0.0
-        for r in cands:
-            score = (min(hits.get(r.rid, 0), plen) / plen
-                     - self.cfg.load_weight * self._load(r))
+        for r, score in zip(cands, scores):
             if best is None or score > best_score:
                 best, best_score = r, score
         assert best is not None
-        reason = "prefix" if hits.get(best.rid) else "load"
-        return best, reason
+        return _decide(best, "prefix" if hits.get(best.rid) else "load")
 
     def _record_success(self, prompt: str, session: Optional[str],
                         rid: str) -> None:
@@ -476,27 +544,92 @@ class FleetRouter:
             session = body.get("user") or request.headers.get("x-session-id")
             streaming = bool(body.get("stream", False))
             fwd_headers = {"Content-Type": "application/json"}
-            for h in ("traceparent", "x-request-deadline-ms"):
+            for h in ("x-request-deadline-ms",):
                 if h in request.headers:
                     fwd_headers[h] = request.headers[h]
+            # the router is a span-producing intermediate: the fleet.route
+            # span parents under the client's http.request span (incoming
+            # traceparent); each attempt gets a fleet.proxy child whose
+            # PRE-MINTED span id is rewritten into the outgoing
+            # traceparent, so replica server.* spans parent under the
+            # attempt that actually served them (docs/TRACING.md)
+            ctx = parse_traceparent(request.headers.get("traceparent"))
+            if ctx is not None:
+                trace_id, client_span_id = ctx
+            else:
+                # traceless client: the router becomes the trace root so
+                # the fleet lane still joins the replica leg by trace_id
+                trace_id, client_span_id = new_trace_id(), None
+            route_span_id = new_span_id()
+            route_start_ns = time.time_ns()
+            attempts = 0
+            last_place: dict[str, Any] = {}
+
+            def _finish_route(ok: bool, outcome: str) -> None:
+                end_ns = time.time_ns()
+                self.route_seconds_total += (end_ns - route_start_ns) / 1e9
+                self.tracer.record(
+                    "fleet.route", trace_id, route_start_ns, end_ns,
+                    parent_span_id=client_span_id, ok=ok,
+                    attrs={
+                        "outcome": outcome,
+                        "candidates": last_place.get("candidates", 0),
+                        "replica": last_place.get("rid", ""),
+                        "reason": last_place.get("reason", ""),
+                        "matched_prefix_chars":
+                            last_place.get("matched_prefix_chars", 0),
+                        "estimated_wait_s":
+                            last_place.get("estimated_wait_s", 0.0),
+                        "inflight": last_place.get("inflight", 0),
+                        "affinity_hit":
+                            last_place.get("reason") == "affinity",
+                        "reroutes": max(attempts - 1, 0),
+                    },
+                    kind=2, span_id=route_span_id,
+                )
+
             tried: set[str] = set()
             retry_hints: list[float] = []
             while True:
-                r, reason = self.place(prompt, session, exclude=tried)
+                r, reason = self.place(prompt, session, exclude=tried,
+                                       trace_id=trace_id)
                 if r is None:
                     if not any(v.healthy for v in self._views.values()):
+                        _finish_route(False, "no_healthy_replica")
                         return web.json_response(
                             {"error": {"message":
                                        "no healthy replica in the fleet"}},
                             status=503,
                         )
+                    # honest terminal status: the shed is the route
+                    # span's outcome, not a silent absence
+                    _finish_route(False, "shed")
                     return _shed_response(
                         "fleet overloaded: every replica shed or failed "
                         "this request", retry_hints,
                     )
                 tried.add(r.rid)
                 self.placements[reason] = self.placements.get(reason, 0) + 1
+                hits = self._prefix.best(prompt)
+                last_place = {
+                    "rid": r.rid, "reason": reason,
+                    "candidates": sum(
+                        1 for v in self._views.values()
+                        if v.healthy and v.rid not in (tried - {r.rid})
+                    ),
+                    "matched_prefix_chars":
+                        min(hits.get(r.rid, 0), max(len(prompt), 1)),
+                    "estimated_wait_s": round(r.est_wait_s, 4),
+                    "inflight": r.inflight,
+                }
                 r.inflight += 1
+                attempts += 1
+                attempt_sid = new_span_id()
+                fwd_headers["traceparent"] = (
+                    f"00-{trace_id}-{attempt_sid}-01"
+                )
+                attempt_start_ns = time.time_ns()
+                attempt: dict[str, Any] = {"outcome": "ok", "status": 0}
 
                 def on_success(rid=r.rid) -> None:
                     # recorded ONLY on clean completions (inside
@@ -507,22 +640,39 @@ class FleetRouter:
                 try:
                     resp = await _proxy_once(request, r, raw, fwd_headers,
                                              streaming, retry_hints,
-                                             on_success)
+                                             on_success, attempt)
                 finally:
                     r.inflight -= 1
+                    self.tracer.record(
+                        "fleet.proxy", trace_id, attempt_start_ns,
+                        time.time_ns(),
+                        parent_span_id=route_span_id,
+                        ok=attempt["outcome"] == "ok",
+                        attrs={"replica": r.rid, "attempt": attempts,
+                               "outcome": attempt["outcome"],
+                               "http.status_code": attempt["status"]},
+                        kind=3,  # SPAN_KIND_CLIENT: the router calling out
+                        span_id=attempt_sid,
+                    )
                 if resp is None:
                     # per-replica shed/failure absorbed: re-place before
                     # the client sees anything (fleet-level admission)
                     self.reroutes += 1
                     continue
+                _finish_route(attempt["outcome"] == "ok",
+                              attempt["outcome"])
                 return resp
 
         async def _proxy_once(request, r: ReplicaView, raw: bytes,
                               fwd_headers: dict[str, str], streaming: bool,
-                              retry_hints: list[float], on_success):
+                              retry_hints: list[float], on_success,
+                              attempt: dict[str, Any]):
             """One attempt against one replica. Returns a prepared
             response to hand the client, or None = absorb and re-place
-            (nothing was sent to the client yet)."""
+            (nothing was sent to the client yet). ``attempt`` is filled
+            with the honest outcome/status for this attempt's
+            ``fleet.proxy`` span (shed, unavailable, connect_fail,
+            replica_lost, upstream_error, ok)."""
             import aiohttp
             from aiohttp import web
 
@@ -534,11 +684,13 @@ class FleetRouter:
                     r.url + "/v1/chat/completions", data=raw,
                     headers=fwd_headers,
                 ) as up:
+                    attempt["status"] = up.status
                     if up.status == 429:
                         from kserve_vllm_mini_tpu.loadgen.adapters.base import (
                             parse_retry_after,
                         )
 
+                        attempt["outcome"] = "shed"
                         retry_hints.append(
                             parse_retry_after(up.headers.get("Retry-After"))
                         )
@@ -546,6 +698,7 @@ class FleetRouter:
                         return None
                     if up.status == 503:
                         # dead scheduler / draining replica: route around
+                        attempt["outcome"] = "unavailable"
                         await up.read()
                         self._mark_unhealthy(r)
                         return None
@@ -554,6 +707,8 @@ class FleetRouter:
                         payload = await up.read()
                         if up.status < 400:
                             on_success()
+                        else:
+                            attempt["outcome"] = "upstream_error"
                         return web.Response(
                             body=payload, status=up.status,
                             content_type=ctype.split(";")[0] or
@@ -580,9 +735,11 @@ class FleetRouter:
                     except (aiohttp.ClientError, asyncio.TimeoutError,
                             OSError) as e:
                         if not sent_bytes:
+                            attempt["outcome"] = "replica_lost"
                             self._mark_unhealthy(r)
                             return None  # re-place: client saw nothing
                         stream_clean = False
+                        attempt["outcome"] = "replica_lost"
                         self.stream_errors += 1
                         evt = {"error": {
                             "message": (
@@ -607,6 +764,7 @@ class FleetRouter:
             except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
                 # connect refused / reset before any response: the
                 # replica is gone or wedged — absorb and re-place
+                attempt["outcome"] = "connect_fail"
                 self._mark_unhealthy(r)
                 return None
 
@@ -680,6 +838,10 @@ class FleetRouter:
                 None, self.supervisor.scale_to, n
             )
             self._sync_replicas()
+            # scale actuations share the audit ring with placements:
+            # the decision log reads as one causal sequence
+            self._audit({"type": "scale", "requested": n,
+                         "replicas": applied})
             return web.json_response(
                 {"status": "ok", "replicas": applied}
             )
@@ -794,6 +956,25 @@ class FleetRouter:
                 )
             return web.json_response({"status": "ok", "wedged": victim.rid})
 
+        async def traces(_request):
+            # snapshot pattern: to_otlp copies the deque once at C level
+            # and renders off-ring — a slow /traces reader never blocks
+            # the proxy event loop's span appends
+            return web.json_response(
+                self.tracer.to_otlp(service_name="kvmini-tpu-router",
+                                    scope=ROUTER_SCOPE)
+            )
+
+        async def fleet_decisions(_request):
+            # list(deque) is one C-level copy; handlers and the audit
+            # writer share the one event loop anyway
+            return web.json_response({
+                # kvmini: thread-ok — same-loop reader of the audit ring
+                "decisions": list(self._decisions),
+                "dropped": self.decisions_dropped,  # kvmini: thread-ok
+                "capacity": self._decisions.maxlen,
+            })
+
         async def metrics(_request):
             views = sorted(self._views.values(), key=lambda v: v.rid)
             live = sum(1 for r in views if r.healthy)
@@ -838,6 +1019,15 @@ class FleetRouter:
                 "# TYPE kvmini_tpu_fleet_prefix_index_entries gauge",
                 "kvmini_tpu_fleet_prefix_index_entries "
                 f"{s['fleet_prefix_entries']}",
+                # cumulative fleet.route span wall time: divided by the
+                # placements rate it yields mean routing latency (the
+                # dashboards/fleet.json routing-latency panel)
+                "# TYPE kvmini_tpu_fleet_route_seconds_total counter",
+                "kvmini_tpu_fleet_route_seconds_total "
+                f"{self.route_seconds_total:.6f}",
+                "# TYPE kvmini_tpu_fleet_decisions_dropped_total counter",
+                "kvmini_tpu_fleet_decisions_dropped_total "
+                f"{self.decisions_dropped}",
                 "# TYPE kvmini_tpu_fleet_placements_total counter",
             ]
             for reason in PLACEMENT_REASONS:
@@ -906,7 +1096,9 @@ class FleetRouter:
         app.router.add_get("/v1/models", models)
         app.router.add_get("/healthz", healthz)
         app.router.add_get("/metrics", metrics)
+        app.router.add_get("/traces", traces)
         app.router.add_get("/fleet", fleet_get)
+        app.router.add_get("/fleet/decisions", fleet_decisions)
         app.router.add_post("/fleet/scale", fleet_scale)
         app.router.add_post("/fleet/chaos", fleet_chaos)
         return app

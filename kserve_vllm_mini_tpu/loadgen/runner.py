@@ -58,16 +58,24 @@ class LiveStats:
         self._events: deque[tuple[float, bool, float, float, int]] = deque(
             maxlen=max_events
         )
+        # trace ids currently in flight (docs/MONITORING.md): the monitor
+        # stamps these into detected events so an alert is clickable into
+        # the merged traces.json. Bounded by concurrency (a worker adds
+        # exactly one id per started request and discards it on done).
+        self._inflight_ids: set[str] = set()
 
-    def record_start(self) -> None:
+    def record_start(self, trace_id: str = "") -> None:
         with self._lock:
             self.started += 1
             self.inflight += 1
+            if trace_id:
+                self._inflight_ids.add(trace_id)
 
     def record_done(self, rec: RequestRecord) -> None:
         with self._lock:
             self.inflight -= 1
             self.completed += 1
+            self._inflight_ids.discard(rec.trace_id)
             if rec.shed:
                 self.shed += 1
             elif not rec.ok:
@@ -99,6 +107,13 @@ class LiveStats:
     def completions(self) -> list[tuple[float, bool, float, float, int]]:
         with self._lock:
             return list(self._events)
+
+    def inflight_trace_ids(self, limit: int = 8) -> list[str]:
+        """A bounded, sorted sample of the trace ids in flight right now
+        — what the monitor stamps into event payloads. Sorted so the
+        sample is deterministic for a given in-flight set."""
+        with self._lock:
+            return sorted(self._inflight_ids)[:limit]
 
 
 @dataclass
@@ -248,7 +263,7 @@ async def _worker(
         headers = dict(cfg.headers)
         headers["traceparent"] = traceparent(trace_id, http_span.span_id)
         if live is not None:
-            live.record_start()
+            live.record_start(trace_id)
         rec.start_ts = time.time()
         # 429-shed retry loop (docs/RESILIENCE.md): capped exponential
         # backoff with DETERMINISTIC per-request jitter (seeded from the

@@ -643,5 +643,13 @@ class EventDetector:
         for etype, evt in checks:
             if evt is not None and etype not in self._fired:
                 self._fired.add(etype)
+                # stamp the trace ids in flight at detection time into
+                # the payload (docs/MONITORING.md `inflight_trace_ids`
+                # data field): the event becomes clickable into the
+                # merged traces.json — which requests a replica_down or
+                # handoff_stall actually caught mid-flight
+                ids = sample.get("inflight_trace_ids")
+                if ids:
+                    evt.data["inflight_trace_ids"] = list(ids)
                 fired.append(evt)
         return fired

@@ -230,6 +230,15 @@ class RunMonitor:
             if "throughput_rps" in win:
                 lg["window_throughput_rps"] = round(win["throughput_rps"], 4)
             sample["loadgen"] = lg
+            # trace ids in flight at sample time (docs/MONITORING.md):
+            # TOP-level, not inside `loadgen` — that block's schema is a
+            # flat name->number map. The detector stamps these into any
+            # event fired off this tick, making alerts clickable into
+            # the merged traces.json.
+            ids_fn = getattr(self.live, "inflight_trace_ids", None)
+            ids = ids_fn() if callable(ids_fn) else []
+            if ids:
+                sample["inflight_trace_ids"] = ids
             # the live $/1K-tok comes from the runtime's economics gauge,
             # not from completions — inject it so a slo.json
             # cost_per_1k_tokens_max budget produces a LIVE burn rate

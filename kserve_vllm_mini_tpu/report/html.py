@@ -81,45 +81,86 @@ def _trace_viewer(run_dir: Optional[Path], results: dict[str, Any]) -> str:
     doc = json.loads(traces_path.read_text())
     from kserve_vllm_mini_tpu.runtime.tracing import spans_from_otlp
 
-    # two lanes: the loadgen's client spans and — when the analyzer merged
-    # the runtime's /traces leg (docs/TRACING.md) — the server's phase
-    # spans, clock-corrected onto the client timeline by the merge's
-    # offset estimate
+    # up to three lanes: the loadgen's client spans; the router's
+    # fleet.route/fleet.proxy spans when the run went through the fleet
+    # router; and the server's phase spans, clock-corrected onto the
+    # client timeline — per replica when the fleet merge estimated one
+    # offset per lane (docs/TRACING.md "Fleet tracing"), by the single
+    # merge estimate otherwise
     offset_ns = int(doc.get("clockOffsetNanosEstimate", 0) or 0)
-    client_spans, server_spans = [], []
+    router_offset_ns = int(doc.get("clockOffsetNanosRouter", 0) or 0)
+    replica_offsets = {
+        str(k): int(v)
+        for k, v in (doc.get("clockOffsetsNanosByReplica") or {}).items()
+    }
+
+    def _replica(s: dict) -> str:
+        for a in s.get("attributes") or []:
+            if a.get("key") == "replica":
+                return str((a.get("value") or {}).get("stringValue", ""))
+        return ""
+
+    def _srv_shift(s: dict) -> int:
+        return replica_offsets.get(_replica(s), offset_ns)
+
+    client_spans, fleet_spans, server_spans = [], [], []
     for svc, s in spans_from_otlp(doc):
         if s.get("traceId") != trace_id:
             continue
-        (server_spans if s.get("kind") == 2 else client_spans).append(s)
-    if not client_spans and not server_spans:
+        if str(s.get("name", "")).startswith("fleet."):
+            fleet_spans.append(s)
+        elif s.get("kind") == 2:
+            server_spans.append(s)
+        else:
+            client_spans.append(s)
+    if not client_spans and not fleet_spans and not server_spans:
         return ""
 
     def _ns(s: dict, key: str, shift: int = 0) -> int:
         return int(s.get(key, 0)) - shift
 
-    all_starts = [_ns(s, "startTimeUnixNano") for s in client_spans] + [
-        _ns(s, "startTimeUnixNano", offset_ns) for s in server_spans
-    ]
+    all_starts = (
+        [_ns(s, "startTimeUnixNano") for s in client_spans]
+        + [_ns(s, "startTimeUnixNano", router_offset_ns) for s in fleet_spans]
+        + [_ns(s, "startTimeUnixNano", _srv_shift(s)) for s in server_spans]
+    )
     t0 = min(all_starts)
     lines = [f"trace {trace_id}  (request {best['request_id']}, "
              f"{float(best['latency_ms']):.1f} ms ~ p95)"]
 
-    def _render(spans: list[dict], lane: str, shift: int) -> None:
-        for s in sorted(spans, key=lambda s: int(s["startTimeUnixNano"])):
+    def _render(pairs: list[tuple[dict, int]], lane: str) -> None:
+        for s, shift in sorted(pairs, key=lambda p: int(p[0]["startTimeUnixNano"])):
             start_ms = (_ns(s, "startTimeUnixNano", shift) - t0) / 1e6
             dur_ms = (int(s["endTimeUnixNano"]) - int(s["startTimeUnixNano"])) / 1e6
             indent = "  " if s.get("parentSpanId") else ""
+            rid = _replica(s)
+            name = s["name"] + (f" @{rid}" if rid else "")
             bar = "#" * max(
                 int(dur_ms / max(float(best["latency_ms"]), 1e-9) * 40), 1
             )
-            lines.append(f"{lane}{indent}{s['name']:<24} +{start_ms:8.1f}ms "
+            lines.append(f"{lane}{indent}{name:<24} +{start_ms:8.1f}ms "
                          f"{dur_ms:8.1f}ms  {bar}")
 
-    _render(client_spans, "", 0)
+    _render([(s, 0) for s in client_spans], "")
+    if fleet_spans:
+        lines.append("")
+        lines.append(
+            f"fleet lane (router clock offset est {router_offset_ns / 1e6:+.2f} ms)"
+        )
+        _render([(s, router_offset_ns) for s in fleet_spans], "  ")
     if server_spans:
         lines.append("")
-        lines.append(f"server lane (clock offset est {offset_ns / 1e6:+.2f} ms)")
-        _render(server_spans, "  ", offset_ns)
+        if replica_offsets:
+            offs = ", ".join(
+                f"{rid} {off / 1e6:+.2f} ms"
+                for rid, off in sorted(replica_offsets.items())
+            )
+            lines.append(f"server lane (per-replica clock offsets: {offs})")
+        else:
+            lines.append(
+                f"server lane (clock offset est {offset_ns / 1e6:+.2f} ms)"
+            )
+        _render([(s, _srv_shift(s)) for s in server_spans], "  ")
     return (
         "<section><h2>p95 request trace</h2>"
         f"<pre class='trace'>{html_mod.escape(chr(10).join(lines))}</pre></section>"
@@ -595,6 +636,22 @@ def _fleet_section(results: dict[str, Any]) -> str:
             f"last scale-up cold start {fl['last_cold_start_s']:.2f} s"
         )
     parts.append(f"<p>{html_mod.escape(' · '.join(facts))}</p>")
+    outlier = results.get("routing_outlier")
+    if isinstance(outlier, dict) and outlier.get("decisions"):
+        # the analyzer joined the p99-latency request back to its router
+        # decision(s) (docs/TRACING.md "Fleet tracing"): where it landed
+        # and why — two placement rows mean the request was re-placed
+        where = "; ".join(
+            f"{d.get('chosen', '?')} ({d.get('reason', '?')}, "
+            f"{len(d.get('candidates') or [])} candidate(s))"
+            for d in outlier["decisions"]
+        )
+        parts.append(
+            f"<p class='warn'>p99 outlier trace "
+            f"{html_mod.escape(str(outlier.get('trace_id', '?')))} "
+            f"({outlier.get('latency_ms', 0):.1f} ms) placed on: "
+            f"{html_mod.escape(where)}</p>"
+        )
     for e in ((results.get("monitor") or {}).get("events") or []):
         if isinstance(e, dict) and e.get("type") == "replica_down":
             parts.append(

@@ -48,6 +48,12 @@ PHASES = ("queue", "handoff", "prefill", "decode", "emit")
 # a run replaces the previously merged server leg instead of duplicating it
 SERVER_SCOPE = "kserve_vllm_mini_tpu.runtime"
 
+# OTLP scope name the fleet router's span ring exports under
+# (fleet/router.py GET /traces). A separate scope keeps the analyzer's
+# idempotent strip-and-replace working per LANE: re-stitching a run
+# replaces the router leg and the server leg independently.
+ROUTER_SCOPE = "kserve_vllm_mini_tpu.fleet"
+
 # histogram bucket upper bounds (seconds). Spans request-phase scales from
 # sub-ms queue waits on an idle engine to multi-second long decodes.
 PHASE_BUCKETS = (
@@ -109,8 +115,14 @@ def _otlp_attr(k: str, v: Any) -> dict[str, Any]:
 
 
 def span_to_otlp(rec: tuple) -> dict[str, Any]:
-    """One recorded span tuple -> OTLP/JSON span (SPAN_KIND_SERVER)."""
-    name, trace_id, span_id, parent_span_id, start_ns, end_ns, ok, attrs = rec
+    """One recorded span tuple -> OTLP/JSON span. Tuples are 8-wide
+    (legacy engine records, SPAN_KIND_SERVER implied) or 9-wide with an
+    explicit OTLP kind as the last element (the router's fleet.proxy
+    client-leg spans record kind 3)."""
+    name, trace_id, span_id, parent_span_id, start_ns, end_ns, ok, attrs = (
+        rec[:8]
+    )
+    kind = rec[8] if len(rec) > 8 else 2  # SPAN_KIND_SERVER default
     if end_ns < start_ns:
         # never-ended / clock-skewed record: clamp rather than export a
         # negative duration (same rule the client tracer applies at export)
@@ -120,7 +132,7 @@ def span_to_otlp(rec: tuple) -> dict[str, Any]:
         "spanId": span_id,
         **({"parentSpanId": parent_span_id} if parent_span_id else {}),
         "name": name,
-        "kind": 2,  # SPAN_KIND_SERVER
+        "kind": kind,
         "startTimeUnixNano": str(start_ns),
         "endTimeUnixNano": str(end_ns),
         "attributes": [_otlp_attr(k, v) for k, v in (attrs or {}).items()],
@@ -160,25 +172,37 @@ class SpanRecorder:
         parent_span_id: Optional[str] = None,
         ok: bool = True,
         attrs: Optional[dict[str, Any]] = None,
+        kind: int = 2,
+        span_id: Optional[str] = None,
     ) -> str:
-        """Append one completed span; returns its generated span id."""
-        sid = new_span_id()
+        """Append one completed span; returns its span id (generated when
+        ``span_id`` is not supplied — the router pre-mints attempt span
+        ids so it can rewrite the outgoing traceparent BEFORE the span's
+        end time is known)."""
+        sid = span_id or new_span_id()
         if len(self._spans) == self.capacity:
             self.dropped += 1
         self._spans.append(
-            (name, trace_id, sid, parent_span_id, start_ns, end_ns, ok, attrs)
+            (name, trace_id, sid, parent_span_id, start_ns, end_ns, ok,
+             attrs, kind)
         )
         return sid
 
     def snapshot(self) -> list[tuple]:
         return list(self._spans)
 
-    def to_otlp(self, service_name: str = "kvmini-tpu-runtime") -> dict[str, Any]:
+    def to_otlp(
+        self,
+        service_name: str = "kvmini-tpu-runtime",
+        scope: str = SERVER_SCOPE,
+    ) -> dict[str, Any]:
         """Same resourceSpans document shape as loadgen/tracing.py, so the
         analyzer merges both legs with one parser. Renders from snapshot():
         iterating the live deque directly would race the scheduler thread's
         appends (RuntimeError: deque mutated during iteration) — list(deque)
-        is one C-level copy and safe under the GIL."""
+        is one C-level copy and safe under the GIL. The router exports
+        under ``scope=ROUTER_SCOPE`` so the analyzer can strip/replace its
+        lane independently of the server leg."""
         return {
             "resourceSpans": [
                 {
@@ -192,7 +216,7 @@ class SpanRecorder:
                     },
                     "scopeSpans": [
                         {
-                            "scope": {"name": SERVER_SCOPE},
+                            "scope": {"name": scope},
                             "spans": [span_to_otlp(r) for r in self.snapshot()],
                         }
                     ],

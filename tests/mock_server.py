@@ -75,6 +75,7 @@ def make_app(
     pipeline_metrics: dict[str, float] | None = None,
     metrics_script=None,
     server_id: str | None = None,
+    clock_skew_ns: int = 0,
 ) -> web.Application:
     """``capabilities`` toggles OpenAI-dialect extras for parity-probe tests:
     any subset of {"tools", "parallel_tools", "json_mode", "logprobs",
@@ -93,7 +94,13 @@ def make_app(
     ``x-kvmini-mock-replica`` header so router-placement tests can see
     WHICH replica served without parsing logs; per-instance
     ``pipeline_metrics``/``metrics_script`` give each port its own
-    scripted /metrics."""
+    scripted /metrics.
+
+    ``clock_skew_ns`` shifts every recorded span timestamp by a fixed
+    offset — a replica whose wall clock disagrees with the client's, so
+    the analyzer's PER-replica clock-offset estimation
+    (docs/TRACING.md "Fleet tracing") is testable with two mock replicas
+    at different skews and no real clock drift."""
     stats = MockStats()
     caps = capabilities if capabilities is not None else {
         "tools", "parallel_tools", "json_mode", "logprobs",
@@ -129,6 +136,10 @@ def make_app(
         if trace_ctx is None:
             return
         tid, parent = trace_ctx
+        skew = int(clock_skew_ns)
+        t_arrive_ns += skew
+        t_first_ns += skew
+        t_done_ns += skew
         q_end = t_arrive_ns + max((t_first_ns - t_arrive_ns) // 4, 1)
         tracer.record("server.queue", tid, t_arrive_ns, q_end,
                       parent_span_id=parent,
@@ -430,7 +441,12 @@ def make_app(
                             content_type="text/plain")
 
     async def traces(_request: web.Request) -> web.Response:
-        return web.json_response(tracer.to_otlp())
+        # per-replica service identity: the fleet stitcher joins each
+        # replica's /traces doc to its rid, so every instance must say
+        # who it is (single-instance mocks keep the runtime's name)
+        svc = (f"kvmini-tpu-runtime/{server_id}" if server_id
+               else "kvmini-tpu-runtime")
+        return web.json_response(tracer.to_otlp(service_name=svc))
 
     async def faults_get(_request: web.Request) -> web.Response:
         return web.json_response({
@@ -629,6 +645,9 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--metrics-json", default=None,
                         help="JSON dict merged over the default /metrics "
                              "gauges (distinct per instance)")
+    parser.add_argument("--clock-skew-ns", type=int, default=0,
+                        help="shift every recorded span timestamp by this "
+                             "many ns (per-replica offset-estimation tests)")
     args = parser.parse_args(argv)
     overrides = json.loads(args.metrics_json) if args.metrics_json else None
     app = make_app(
@@ -636,6 +655,7 @@ def main(argv: list[str] | None = None) -> int:
         n_tokens=args.n_tokens,
         pipeline_metrics=overrides,
         server_id=args.server_id,
+        clock_skew_ns=args.clock_skew_ns,
     )
     web.run_app(app, host=args.host, port=args.port, print=None)
     return 0

@@ -204,6 +204,13 @@ def test_fleet_dashboard_queries_replica_labeled_series():
     assert "rate(kvmini_tpu_fleet_sheds_total" in d
     assert "kvmini_tpu_fleet_last_cold_start_seconds" in d
     assert "by (reason) (rate(kvmini_tpu_fleet_placements_total" in d
+    # routing-latency panel (docs/TRACING.md "Fleet tracing"): the mean
+    # fleet.route span is a derived RATE ratio — route wall over
+    # placements — and audit-ring evictions say when /fleet/decisions
+    # explains stopped covering the whole window
+    assert ("rate(kvmini_tpu_fleet_route_seconds_total[1m]) / "
+            "rate(kvmini_tpu_fleet_placements_total[1m])") in d
+    assert "rate(kvmini_tpu_fleet_decisions_dropped_total" in d
 
 
 def test_utilization_dashboard_queries_tpu_metrics():

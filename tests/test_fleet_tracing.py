@@ -1,0 +1,527 @@
+"""Fleet-scope distributed tracing (docs/TRACING.md "Fleet tracing"):
+the router's span rail (``fleet.route`` + per-attempt ``fleet.proxy``),
+the bounded decision audit ring behind GET /fleet/decisions, the
+three-lane stitch with PER-replica clock-offset estimation, and the e2e
+client -> router -> replica join with a forced re-placement and a
+clock-skewed replica. Everything runs JAX-free against in-process
+MockFleet replicas — the ``make fleet-trace-smoke`` gate."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kserve_vllm_mini_tpu.analysis import traces as traces_mod
+from kserve_vllm_mini_tpu.analysis.metrics import compute_latency_stats
+from kserve_vllm_mini_tpu.core.rundir import RunDir
+from kserve_vllm_mini_tpu.core.schema import validate_traces
+from kserve_vllm_mini_tpu.fleet.router import (
+    FleetRouter,
+    ReplicaView,
+    RouterConfig,
+    start_router,
+)
+from kserve_vllm_mini_tpu.loadgen.runner import LoadConfig, run_load_async
+from kserve_vllm_mini_tpu.loadgen.tracing import traceparent
+from kserve_vllm_mini_tpu.runtime.tracing import (
+    ROUTER_SCOPE,
+    SERVER_SCOPE,
+    new_span_id,
+    new_trace_id,
+    span_to_otlp,
+    spans_from_otlp,
+)
+from tests.mock_server import MockFleet
+
+# -- sync HTTP helpers (run via asyncio.to_thread inside MockFleet
+#    contexts: the mock replicas are served BY the test's event loop) ---------
+
+
+def _get_json(url: str, path: str, timeout: float = 10.0) -> dict:
+    with urllib.request.urlopen(url + path, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _post_json(url: str, path: str, body: dict, timeout: float = 10.0):
+    req = urllib.request.Request(
+        url + path, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def _chat_raw(url: str, content: str, headers: dict[str, str],
+              stream: bool = False, timeout: float = 30.0) -> bytes:
+    body = {"messages": [{"role": "user", "content": content}],
+            "max_tokens": 4, "stream": stream}
+    req = urllib.request.Request(
+        url + "/v1/chat/completions", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json", **headers},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.read()
+
+
+def _attr(span: dict, key: str, default=None):
+    for a in span.get("attributes") or []:
+        if a.get("key") == key:
+            v = a.get("value") or {}
+            return next(iter(v.values()), default)
+    return default
+
+
+def _router_with_views(views: list[ReplicaView],
+                       cfg: RouterConfig | None = None) -> FleetRouter:
+    r = FleetRouter(replicas=[(v.rid, v.url) for v in views], cfg=cfg)
+    r._views = {v.rid: v for v in views}
+    return r
+
+
+async def _wait_fleet_live(url: str, n: int, timeout_s: float = 10.0) -> None:
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        fleet = await asyncio.to_thread(_get_json, url, "/fleet")
+        if sum(1 for r in fleet["replicas"] if r["healthy"]) >= n:
+            return
+        await asyncio.sleep(0.1)
+    raise AssertionError(f"fleet never reached {n} healthy replicas")
+
+
+# -- router span ring (bounded, own scope) ------------------------------------
+
+
+def test_router_trace_ring_bounded_and_router_scoped():
+    """The router's SpanRecorder evicts like the engine's (never grows
+    past trace_capacity) and exports under ROUTER_SCOPE / the router
+    service name so the analyzer can strip its lane independently."""
+    router = FleetRouter(replicas=[("r0", "http://x0")],
+                         cfg=RouterConfig(trace_capacity=8))
+    tid = new_trace_id()
+    for i in range(20):
+        router.tracer.record("fleet.route", tid, i, i + 1, kind=2)
+    assert len(router.tracer) == 8
+    assert router.tracer.dropped == 12
+    doc = router.tracer.to_otlp(service_name="kvmini-tpu-router",
+                                scope=ROUTER_SCOPE)
+    assert validate_traces(doc) == []
+    rs = doc["resourceSpans"][0]
+    assert rs["scopeSpans"][0]["scope"]["name"] == ROUTER_SCOPE
+    svc = rs["resource"]["attributes"][0]["value"]["stringValue"]
+    assert svc == "kvmini-tpu-router"
+    assert doc["droppedSpans"] == 12
+
+
+def test_span_to_otlp_tolerates_legacy_8_tuples_and_kind_9_tuples():
+    """Engine records predate the kind element; the exporter must accept
+    both widths (legacy -> SPAN_KIND_SERVER) or every old ring would
+    break the moment the router's 9-tuples landed."""
+    tid, sid = new_trace_id(), new_span_id()
+    legacy = ("server.queue", tid, sid, None, 1, 2, True, None)
+    assert span_to_otlp(legacy)["kind"] == 2
+    client = ("fleet.proxy", tid, sid, None, 1, 2, True, None, 3)
+    assert span_to_otlp(client)["kind"] == 3
+
+
+# -- decision audit ring ------------------------------------------------------
+
+
+def test_decision_ring_explains_every_candidate():
+    """Every place() call lands ONE audit entry carrying ALL candidates'
+    score terms plus why the winner won — the /fleet/decisions explain
+    contract the p99 outlier attribution joins against."""
+    warm = ReplicaView(rid="r0", url="http://x0", est_wait_s=1.0)
+    idle = ReplicaView(rid="r1", url="http://x1", est_wait_s=0.0,
+                       inflight=2)
+    router = _router_with_views([warm, idle])
+    prompt = "sessionprefix-" * 16
+    router._prefix.record(prompt, "r0")
+    tid = new_trace_id()
+    picked, reason = router.place(prompt + " tail", None, trace_id=tid)
+    assert picked.rid == "r0" and reason == "prefix"
+
+    d = list(router._decisions)[-1]
+    assert d["type"] == "placement"
+    assert d["trace_id"] == tid
+    assert d["chosen"] == "r0" and d["reason"] == "prefix"
+    assert d["seq"] >= 1 and d["t"] > 0
+    by_rid = {c["rid"]: c for c in d["candidates"]}
+    assert set(by_rid) == {"r0", "r1"}
+    # score terms are per-candidate facts, not just the winner's
+    assert by_rid["r0"]["matched_prefix_chars"] > 0
+    assert by_rid["r1"]["matched_prefix_chars"] == 0
+    assert by_rid["r0"]["estimated_wait_s"] == 1.0
+    assert by_rid["r1"]["inflight"] == 2
+    assert by_rid["r0"]["score"] != by_rid["r1"]["score"]
+
+    # exclusion (a retry's tried set) narrows the candidate list
+    router.place("fresh", None, exclude={"r0"}, trace_id=tid)
+    d2 = list(router._decisions)[-1]
+    assert [c["rid"] for c in d2["candidates"]] == ["r1"]
+    assert d2["exclude"] == ["r0"]
+
+    # a no-candidate shed is still an explained decision
+    router.place("fresh", None, exclude={"r0", "r1"})
+    d3 = list(router._decisions)[-1]
+    assert d3["chosen"] is None and d3["reason"] == "no_candidate"
+
+
+def test_decision_ring_bounded_with_dropped_counter_and_monotonic_seq():
+    views = [ReplicaView(rid="r0", url="u0")]
+    router = _router_with_views(views, RouterConfig(decision_capacity=4))
+    for i in range(10):
+        router.place(f"prompt {i}", None)
+    entries = list(router._decisions)
+    assert len(entries) == 4
+    assert router.decisions_dropped == 6
+    seqs = [e["seq"] for e in entries]
+    assert seqs == sorted(seqs) and len(set(seqs)) == 4
+    assert seqs[-1] == 10  # seq keeps counting past evictions
+
+
+def test_health_flips_land_in_the_audit_ring():
+    v = ReplicaView(rid="r0", url="u0", scrape_failures=3)
+    router = _router_with_views([v])
+    router._mark_unhealthy(v)
+    kinds = [e["type"] for e in router._decisions]
+    assert kinds == ["health"]
+    h = list(router._decisions)[0]
+    assert h["rid"] == "r0" and h["healthy"] is False
+    assert h["scrape_failures"] == 3
+    # idempotent: re-marking an already-unhealthy replica audits nothing
+    router._mark_unhealthy(v)
+    assert len(router._decisions) == 1
+
+
+# -- three-lane stitch with per-replica offsets (synthetic, exact) -----------
+
+
+_B = 1_000_000_000_000  # synthetic epoch base, ns
+_MS = 1_000_000
+
+
+def _client_doc(entries: list[tuple[str, str, int]]) -> dict:
+    return {"resourceSpans": [{
+        "resource": {"attributes": [
+            {"key": "service.name",
+             "value": {"stringValue": "kvmini-tpu-loadgen"}}]},
+        "scopeSpans": [{"scope": {"name": "kvmini.loadgen"}, "spans": [
+            {"traceId": tid, "spanId": sid, "name": "http.request",
+             "startTimeUnixNano": str(t0),
+             "endTimeUnixNano": str(t0 + 50 * _MS),
+             "attributes": [], "kind": 3, "status": {"code": 1}}
+            for tid, sid, t0 in entries
+        ]}],
+    }]}
+
+
+def test_merge_fleet_traces_estimates_one_offset_per_replica():
+    """Two replicas at DIFFERENT skews (one negative): the single
+    min-offset of merge_server_traces is wrong for at least one of them
+    by construction; the fleet stitch must estimate per replica."""
+    t_a, sid_a = new_trace_id(), new_span_id()
+    t_b, sid_b = new_trace_id(), new_span_id()
+    client = _client_doc([(t_a, sid_a, _B), (t_b, sid_b, _B + 10 * _MS)])
+
+    def _replica_doc(tid: str, arrive_ns: int, skew_ns: int) -> dict:
+        from kserve_vllm_mini_tpu.runtime.tracing import SpanRecorder
+
+        rec = SpanRecorder(capacity=16)
+        q0 = arrive_ns + 5 * _MS + skew_ns
+        rec.record("server.queue", tid, q0, q0 + 2 * _MS)
+        rec.record("server.decode", tid, q0 + 2 * _MS, q0 + 20 * _MS)
+        return rec.to_otlp()
+
+    replica_docs = {
+        "r0": _replica_doc(t_a, _B, 3_000_000_000),       # +3 s skew
+        "r1": _replica_doc(t_b, _B + 10 * _MS, -1_000_000_000),  # -1 s
+    }
+
+    from kserve_vllm_mini_tpu.runtime.tracing import SpanRecorder
+
+    router_rec = SpanRecorder(capacity=16)
+    for tid, t0 in ((t_a, _B), (t_b, _B + 10 * _MS)):
+        router_rec.record("fleet.route", tid, t0 + 1 * _MS, t0 + 30 * _MS,
+                          kind=2)
+    router_doc = router_rec.to_otlp(service_name="kvmini-tpu-router",
+                                    scope=ROUTER_SCOPE)
+
+    merged, matched = traces_mod.merge_fleet_traces(client, router_doc,
+                                                    replica_docs)
+    assert validate_traces(merged) == []
+    # exact synthetic arithmetic: delta = queue.start - http.start
+    assert merged["clockOffsetsNanosByReplica"] == {
+        "r0": 3_000_000_000 + 5 * _MS,
+        "r1": -1_000_000_000 + 5 * _MS,
+    }
+    # legacy single estimate stays = min over replicas
+    assert merged["clockOffsetNanosEstimate"] == -1_000_000_000 + 5 * _MS
+    assert merged["clockOffsetNanosRouter"] == 1 * _MS
+
+    # every merged server span is stamped with its replica identity
+    for _svc, s in spans_from_otlp(merged):
+        if s["name"].startswith("server."):
+            assert _attr(s, "replica") in ("r0", "r1")
+    services = {
+        svc for svc, s in spans_from_otlp(merged)
+        if s["name"].startswith(("server.", "fleet."))
+    }
+    assert services == {"kvmini-tpu-router", "kvmini-tpu-runtime/r0",
+                        "kvmini-tpu-runtime/r1"}
+
+    # matched carries both lanes -> phase_breakdown grows fleet phases
+    pb = traces_mod.phase_breakdown(
+        matched, merged["clockOffsetNanosEstimate"], source="fleet:/traces")
+    assert {"route", "queue", "decode"} <= set(pb)
+    assert pb["route"]["count"] == 2
+    assert pb["source"] == "fleet:/traces"
+
+    # idempotent: re-stitching the merged doc replaces, never duplicates
+    merged2, matched2 = traces_mod.merge_fleet_traces(merged, router_doc,
+                                                      replica_docs)
+    assert len(matched2) == len(matched)
+    assert (sum(1 for _ in spans_from_otlp(merged2))
+            == sum(1 for _ in spans_from_otlp(merged)))
+    assert len(merged2["resourceSpans"]) == len(merged["resourceSpans"])
+
+
+# -- honest terminal status (live router over MockFleet) ---------------------
+
+
+def test_fleet_wide_shed_records_error_route_span():
+    """Every replica shedding -> the client's 429 AND an honest
+    fleet.route span: ok=False, outcome=shed, one fleet.proxy child per
+    absorbed attempt — the shed is the span's outcome, never a silent
+    absence in the trace."""
+
+    async def go():
+        async with MockFleet([{}, {}]) as fleet:
+            router = FleetRouter(replicas=fleet.replicas(),
+                                 cfg=RouterConfig(scrape_interval_s=0.2))
+            handle = start_router(router)
+            try:
+                await _wait_fleet_live(handle.url, 2)
+                for url in fleet.urls:
+                    await asyncio.to_thread(
+                        _post_json, url, "/faults",
+                        {"action": "arm", "name": "shed", "times": 0,
+                         "retry_after": 1})
+                tid, sid = new_trace_id(), new_span_id()
+
+                def _shed_request():
+                    with pytest.raises(urllib.error.HTTPError) as ei:
+                        _chat_raw(handle.url, "nowhere to go",
+                                  {"traceparent": traceparent(tid, sid)})
+                    assert ei.value.code == 429
+                    ei.value.read()
+
+                await asyncio.to_thread(_shed_request)
+                doc = await asyncio.to_thread(_get_json, handle.url,
+                                              "/traces")
+                return tid, sid, doc
+            finally:
+                handle.stop()
+
+    tid, sid, doc = asyncio.run(go())
+    spans = [s for _svc, s in spans_from_otlp(doc) if s["traceId"] == tid]
+    route = next(s for s in spans if s["name"] == "fleet.route")
+    assert route["status"]["code"] == 2          # honest error status
+    assert route["parentSpanId"] == sid          # under the client span
+    assert _attr(route, "outcome") == "shed"
+    assert int(_attr(route, "reroutes")) == 1    # two replicas tried
+    proxies = [s for s in spans if s["name"] == "fleet.proxy"]
+    assert len(proxies) == 2
+    for p in proxies:
+        assert p["parentSpanId"] == route["spanId"]
+        assert p["status"]["code"] == 2
+        assert _attr(p, "outcome") == "shed"
+        assert int(_attr(p, "http.status_code")) == 429
+        assert p["kind"] == 3                     # the router calling out
+
+
+def test_midstream_replica_loss_records_replica_lost_span():
+    """A replica dying mid-stream surfaces the honest replica_lost
+    terminal event to the client AND stamps outcome=replica_lost on the
+    attempt's fleet.proxy span; the placement that put the request there
+    stays joinable in the audit ring by trace_id."""
+
+    async def go():
+        async with MockFleet([{"token_delay_s": 0.01, "n_tokens": 8},
+                              {"token_delay_s": 0.01, "n_tokens": 8}]
+                             ) as fleet:
+            router = FleetRouter(replicas=fleet.replicas(),
+                                 cfg=RouterConfig(scrape_interval_s=0.2))
+            handle = start_router(router)
+            try:
+                await _wait_fleet_live(handle.url, 2)
+                # the cache-aware tie-break places fresh prompts on r0
+                await asyncio.to_thread(
+                    _post_json, fleet.urls[0], "/faults",
+                    {"action": "arm", "name": "sse_disconnect",
+                     "times": 1, "after_tokens": 1})
+                tid, sid = new_trace_id(), new_span_id()
+                data = await asyncio.to_thread(
+                    _chat_raw, handle.url, "stream me",
+                    {"traceparent": traceparent(tid, sid)}, True)
+                doc = await asyncio.to_thread(_get_json, handle.url,
+                                              "/traces")
+                decisions = await asyncio.to_thread(
+                    _get_json, handle.url, "/fleet/decisions")
+                return tid, data, doc, decisions
+            finally:
+                handle.stop()
+
+    tid, data, doc, decisions = asyncio.run(go())
+    assert b"replica_lost" in data               # honest terminal event
+    spans = [s for _svc, s in spans_from_otlp(doc) if s["traceId"] == tid]
+    route = next(s for s in spans if s["name"] == "fleet.route")
+    assert _attr(route, "outcome") == "replica_lost"
+    assert route["status"]["code"] == 2
+    proxy = next(s for s in spans if s["name"] == "fleet.proxy")
+    assert _attr(proxy, "outcome") == "replica_lost"
+    assert proxy["status"]["code"] == 2
+    assert _attr(proxy, "replica") == "r0"
+    placed = [d for d in decisions["decisions"]
+              if d["type"] == "placement" and d["trace_id"] == tid]
+    assert placed and placed[0]["chosen"] == "r0"
+    # a mid-stream loss with bytes already sent is NOT a health verdict:
+    # the stream died honestly, the scrape loop decides replica health
+    assert not any(d["type"] == "health" for d in decisions["decisions"])
+
+
+# -- e2e: loadgen -> router -> skewed replicas, stitched + rendered ----------
+
+
+SKEW_NS = 2_000_000_000  # r0's wall clock runs 2 s ahead of the client's
+
+
+def test_fleet_e2e_stitch_with_skew_replacement_and_report(tmp_path):
+    """The acceptance bench in miniature: a 2-replica fleet where r0 is
+    clock-skewed AND sheds exactly once (forcing one re-placement), the
+    loadgen drives through the router, and the analyzer-side stitch
+    produces ONE schema-valid traces.json whose parentage reads
+    http.request -> fleet.route -> fleet.proxy -> server.*, with one
+    offset per replica, fleet phases in phase_breakdown, the p99 request
+    joined to its routing decision, and a report that renders the fleet
+    lane."""
+
+    async def go():
+        async with MockFleet([
+            {"token_delay_s": 0.002, "clock_skew_ns": SKEW_NS},
+            {"token_delay_s": 0.002},
+        ]) as fleet:
+            router = FleetRouter(replicas=fleet.replicas(),
+                                 cfg=RouterConfig(scrape_interval_s=0.2))
+            handle = start_router(router)
+            try:
+                await _wait_fleet_live(handle.url, 2)
+                await asyncio.to_thread(
+                    _post_json, fleet.urls[0], "/faults",
+                    {"action": "arm", "name": "shed", "times": 1,
+                     "retry_after": 1})
+                rd = RunDir.create(tmp_path, run_id="fleet-trace-e2e")
+                cfg = LoadConfig(url=handle.url, num_requests=10,
+                                 concurrency=3, target_rps=300.0,
+                                 max_tokens=4, streaming=True)
+                records = await run_load_async(cfg, rd)
+                # exactly the analyzer's fleet branch, by hand
+                replicas = await asyncio.to_thread(
+                    traces_mod.fetch_fleet_replicas, handle.url)
+                router_doc = await asyncio.to_thread(
+                    traces_mod.fetch_server_traces, handle.url)
+                replica_docs = {}
+                for rid, url in replicas:
+                    replica_docs[rid] = await asyncio.to_thread(
+                        traces_mod.fetch_server_traces, url)
+                decisions = await asyncio.to_thread(
+                    traces_mod.fetch_fleet_decisions, handle.url)
+                return rd, records, replicas, router_doc, replica_docs, \
+                    decisions
+            finally:
+                handle.stop()
+
+    rd, records, replicas, router_doc, replica_docs, decisions = \
+        asyncio.run(go())
+    assert all(r.ok for r in records)            # the shed was absorbed
+    assert dict(replicas).keys() == {"r0", "r1"}
+
+    client_doc = rd.read_traces()
+    merged, matched = traces_mod.merge_fleet_traces(
+        client_doc, router_doc, replica_docs)
+    assert matched
+    assert validate_traces(merged) == []
+
+    http_span = {s["traceId"]: s for _svc, s in spans_from_otlp(client_doc)
+                 if s["name"] == "http.request"}
+    routes, proxies, server_q = {}, {}, {}
+    for _svc, s in spans_from_otlp(merged):
+        if s["name"] == "fleet.route":
+            routes[s["traceId"]] = s
+        elif s["name"] == "fleet.proxy":
+            proxies.setdefault(s["traceId"], []).append(s)
+        elif s["name"] == "server.queue":
+            server_q.setdefault(s["traceId"], []).append(s)
+
+    # full parentage chain on every request the loadgen traced
+    assert set(routes) == set(http_span)
+    for tid, route in routes.items():
+        assert route["parentSpanId"] == http_span[tid]["spanId"]
+        attempt_sids = set()
+        for p in proxies[tid]:
+            assert p["parentSpanId"] == route["spanId"]
+            attempt_sids.add(p["spanId"])
+        for q in server_q[tid]:
+            # the rewritten traceparent re-parented the replica's spans
+            # under the attempt that actually served them
+            assert q["parentSpanId"] in attempt_sids
+
+    # the re-placed request carries TWO attempt spans, first one honest
+    rerouted = [tid for tid, ps in proxies.items() if len(ps) == 2]
+    assert len(rerouted) == 1
+    two = sorted(proxies[rerouted[0]],
+                 key=lambda s: int(s["startTimeUnixNano"]))
+    assert two[0]["status"]["code"] == 2
+    assert _attr(two[0], "outcome") == "shed"
+    assert _attr(two[0], "replica") == "r0"
+    assert two[1]["status"]["code"] == 1
+    assert _attr(two[1], "replica") == "r1"
+    assert int(_attr(routes[rerouted[0]], "reroutes")) == 1
+
+    # per-replica clock offsets: r0 reads ~the injected 2 s skew, r1 ~0
+    offs = merged["clockOffsetsNanosByReplica"]
+    assert SKEW_NS <= offs["r0"] < SKEW_NS + 1_000_000_000
+    assert 0 <= offs["r1"] < 1_000_000_000
+    assert merged["clockOffsetNanosEstimate"] == min(offs.values())
+    assert 0 <= merged["clockOffsetNanosRouter"] < 1_000_000_000
+
+    pb = traces_mod.phase_breakdown(
+        matched, merged["clockOffsetNanosEstimate"], source="fleet:/traces")
+    assert {"route", "proxy", "queue", "prefill", "decode"} <= set(pb)
+    assert pb["route"]["count"] == len(records)
+    assert pb["proxy"]["count"] == len(records) + 1  # the absorbed shed
+    assert pb["source"] == "fleet:/traces"
+
+    # p99 outlier joined to its routing decision(s)
+    outlier = traces_mod.outlier_attribution(records, decisions)
+    assert outlier["trace_id"]
+    assert outlier["decisions"][0]["candidates"]
+    assert outlier["decisions"][0]["chosen"] in ("r0", "r1")
+
+    # the report renders the fleet lane off the stitched doc
+    from kserve_vllm_mini_tpu.report.html import generate_single_run_html
+
+    rd.write_traces(merged)
+    results = dict(compute_latency_stats(records))
+    results["model"] = "mock"
+    results["routing_outlier"] = outlier
+    results["fleet"] = {"replicas_live": 2, "replicas_desired": 2}
+    html = generate_single_run_html(results, run_dir=rd.path)
+    assert "fleet lane" in html
+    assert "fleet.route" in html
+    assert "per-replica clock offsets" in html
+    assert "p99 outlier trace" in html
