@@ -45,10 +45,12 @@ lint:
 	$(PY) -c "import yaml,glob;[list(yaml.safe_load_all(open(f))) for f in glob.glob('profiles/**/*.yaml',recursive=True)+glob.glob('policies/**/*.yaml',recursive=True)]"
 	$(PY) -c "import json,glob;[json.load(open(f)) for f in glob.glob('dashboards/*.json')]"
 
-lint-invariants:  ## kvmini-lint: jit purity, lockstep, metrics drift, thread safety, dtype flow, buffer lifecycle, mesh/sharding, resource safety
+lint-invariants:  ## kvmini-lint: jit purity, lockstep, metrics drift, thread safety, dtype flow, buffer lifecycle, mesh/sharding, resource safety, protocol/contract, async discipline, config surface
 	# gates on lint-baseline.json: new findings fail, fixed-but-still-
 	# listed entries fail too (ratchet toward an empty baseline).
-	# Rule table: docs/LINTING.md. JAX-free; runs in ~9s. --timing prints
+	# Rule table: docs/LINTING.md. JAX-free; runs in ~9s (families run
+	# in a thread pool sized to the CPU count; --jobs 1 forces the
+	# byte-identical serial path). --timing prints
 	# per-checker wall time so a budget regression names its checker;
 	# --timing-out writes the same report as the lint-timing.json
 	# artifact CI uploads; --sarif writes the code-scanning doc CI
@@ -58,10 +60,13 @@ lint-invariants:  ## kvmini-lint: jit purity, lockstep, metrics drift, thread sa
 
 # the fast pre-commit loop: lint only files changed vs REF (default HEAD)
 # plus their cross-file importers. Directory-scan-only surfaces (KVM032
-# docs drift) stay full-scan — run `make lint-invariants` before merging.
+# docs drift, KVM131-133 config-surface joins) stay full-scan — run
+# `make lint-invariants` before merging. FAMILY narrows to a comma list
+# of rule families (e.g. `make lint-changed FAMILY=KVM05,KVM12`).
 REF ?= HEAD
-lint-changed:  ## kvmini-lint over `git diff --name-only $(REF)` + importers
-	$(PY) -m kserve_vllm_mini_tpu.lint --changed $(REF)
+FAMILY ?=
+lint-changed:  ## kvmini-lint over `git diff --name-only $(REF)` + importers; FAMILY=KVM05,KVM12 narrows
+	$(PY) -m kserve_vllm_mini_tpu.lint --changed $(REF) $(if $(FAMILY),--family $(FAMILY))
 
 fmt:
 	$(PY) -m ruff format kserve_vllm_mini_tpu tests 2>/dev/null || true

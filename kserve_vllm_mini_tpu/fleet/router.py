@@ -181,7 +181,7 @@ class PrefixIndex:
         watchdog respawn reuses the rid with a COLD cache, and stale
         chains would route 'prefix'-scored traffic at an empty cache."""
         for h in [h for h, r in self._map.items() if r == rid]:
-            del self._map[h]  # kvmini: thread-ok — same loop (see record)
+            del self._map[h]
             self._depth.pop(h, None)
 
     def __len__(self) -> int:
@@ -302,7 +302,7 @@ class FleetRouter:
     def _audit(self, entry: dict[str, Any]) -> None:
         """Append one decision-audit entry. All writers and the
         /fleet/decisions reader run on the router's one event loop."""
-        self._decision_seq += 1  # kvmini: thread-ok — same loop
+        self._decision_seq += 1
         if len(self._decisions) == self._decisions.maxlen:
             self.decisions_dropped += 1
         self._decisions.append(
@@ -359,7 +359,7 @@ class FleetRouter:
             # the scoreboard task runs on the SAME event loop as every
             # handler (see _sync_replicas) — no second thread exists
             views = list(
-                self._views.values()  # kvmini: thread-ok — same loop
+                self._views.values()
             )
             if views:
                 await asyncio.gather(*(self._scrape_one(r) for r in views))
@@ -383,7 +383,7 @@ class FleetRouter:
             # the router's one loop
             for s in [s for s, rid in self._sessions.items()
                       if rid == r.rid]:
-                del self._sessions[s]  # kvmini: thread-ok — same loop
+                del self._sessions[s]
             # health flips land in the audit ring too: "why did traffic
             # leave r0 at t?" is answerable from /fleet/decisions alone
             self._audit({"type": "health", "rid": r.rid,
@@ -678,7 +678,7 @@ class FleetRouter:
 
             # the session is written once at app startup; handlers run
             # on the same event loop — no cross-thread access exists
-            client = self._client  # kvmini: thread-ok — same loop
+            client = self._client
             try:
                 async with client.post(
                     r.url + "/v1/chat/completions", data=raw,
@@ -969,9 +969,8 @@ class FleetRouter:
             # list(deque) is one C-level copy; handlers and the audit
             # writer share the one event loop anyway
             return web.json_response({
-                # kvmini: thread-ok — same-loop reader of the audit ring
                 "decisions": list(self._decisions),
-                "dropped": self.decisions_dropped,  # kvmini: thread-ok
+                "dropped": self.decisions_dropped,
                 "capacity": self._decisions.maxlen,
             })
 

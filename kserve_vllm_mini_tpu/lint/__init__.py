@@ -1,9 +1,11 @@
 """kvmini-lint — AST-based invariant checker for the repo's load-bearing
 conventions (docs/LINTING.md "Conventions kvmini-lint enforces").
 
-Nine checkers, all stdlib-``ast`` over a small cross-file fact index —
-deliberately JAX-free so the lint gate runs anywhere the harness layers
-do (same contract as loadgen/analysis: no ``runtime`` extra required):
+Thirteen checkers, all stdlib-``ast`` over one shared cross-file fact
+index (run in a thread pool sized to the CPU count; ``--jobs 1`` forces
+the byte-identical serial path) — deliberately JAX-free so the lint
+gate runs anywhere the harness layers do (same contract as
+loadgen/analysis: no ``runtime`` extra required):
 
 - **jit purity / static shapes** (KVM011-KVM015): no data-dependent
   Python control flow, wall clocks, host randomness, or host syncs
@@ -51,6 +53,27 @@ do (same contract as loadgen/analysis: no ``runtime`` extra required):
   path leaking an acquire, a double release on one path, and a
   ``finally`` re-raising past a pending release all fail
   (lint/resource_paths.py).
+- **wire-protocol conformance** (KVM101-KVM104): lockstep replay
+  symmetry (every published decision type needs a replay arm and vice
+  versa), host-only state reads on the replay path, handoff version
+  negotiation, and degrade-ladder re-arm discipline
+  (lint/protocol_flow.py).
+- **absent-not-zero contract drift** (KVM111-KVM113): fabricated zeros
+  on the metrics/results export path, event-taxonomy drift against
+  ``EVENT_TYPES``, and HTTP surface drift between the real server, the
+  mock, and docs/API.md (lint/contract_flow.py).
+- **asyncio event-loop discipline** (KVM121-KVM124): an event-loop-root
+  table (aiohttp handlers, lifecycle callbacks, task spawns,
+  ``asyncio.run`` targets) propagated through the call graph flags
+  blocking calls on the loop, fire-and-forget tasks, loop-affinity
+  violations (loop state also mutated by thread-rooted code without
+  ``call_soon_threadsafe`` routing), and read-modify-writes straddling
+  an ``await`` (lint/async_flow.py).
+- **config-surface drift** (KVM131-KVM134): the operator-visible knob
+  surface joined across env reads, ``*_ENV_KNOBS`` tables, argparse
+  flags, config dataclasses, and docs pages — undiscoverable knobs,
+  dead table entries, unreachable config fields, and cross-layer
+  default drift (lint/config_flow.py).
 
 CLI: ``python -m kserve_vllm_mini_tpu.lint [paths...]`` — see __main__.py.
 Suppressions: ``# kvmini: <token>`` line comments (diagnostics.RULES maps

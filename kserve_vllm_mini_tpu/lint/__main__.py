@@ -35,7 +35,8 @@ def main(argv: list[str] | None = None) -> int:
                     "surfacing, thread-safety/lock discipline, dtype-flow "
                     "numerics, buffer lifecycle, mesh/sharding consistency, "
                     "exception-path resource safety, wire-protocol "
-                    "conformance, absent-not-zero contract drift). See "
+                    "conformance, absent-not-zero contract drift, asyncio "
+                    "event-loop discipline, config-surface drift). See "
                     "docs/LINTING.md for the rule table.",
     )
     ap.add_argument("paths", nargs="*", type=Path,
@@ -48,11 +49,17 @@ def main(argv: list[str] | None = None) -> int:
                          "are skipped, same as any single-file scan; the "
                          "baseline gate is restricted to the scanned files.")
     ap.add_argument("--family", action="append", default=None,
-                    metavar="KVM0x",
-                    help="run only this rule family (repeatable; e.g. "
-                         "KVM05 for the concurrency rules, or a full code "
+                    metavar="KVM0x[,KVM0y]",
+                    help="run only these rule families (repeatable AND "
+                         "comma-separable; e.g. `--family KVM05,KVM12` for "
+                         "the two concurrency families, or a full code "
                          "like KVM051). The baseline gate and the KVM001 "
                          "stale-suppression check are filtered to match.")
+    ap.add_argument("--jobs", type=int, default=None, metavar="N",
+                    help="checker-family parallelism (default: one thread "
+                         "per selected family; `--jobs 1` forces the "
+                         "serial path — output is byte-identical either "
+                         "way, a test pins it)")
     ap.add_argument("--timing", action="store_true",
                     help="print per-checker wall time (the <10s budget "
                          "attribution surface; JSON output always carries "
@@ -85,8 +92,14 @@ def main(argv: list[str] | None = None) -> int:
         print(f"kvmini-lint: no such path: {missing[0]}", file=sys.stderr)
         return 2
 
+    family_args = None
+    if args.family is not None:
+        # `--family KVM05,KVM12` and `--family KVM05 --family KVM12` are
+        # the same request; split commas before validation
+        family_args = [part for f in args.family for part in f.split(",")
+                       if part.strip()]
     try:
-        families = normalize_families(args.family)
+        families = normalize_families(family_args)
     except ValueError as e:
         print(f"kvmini-lint: {e}", file=sys.stderr)
         return 2
@@ -130,7 +143,8 @@ def main(argv: list[str] | None = None) -> int:
     t0 = time.monotonic()
     result = run_lint(paths, doc_paths=docs, baseline_path=baseline_path,
                       families=families,
-                      baseline_scope_to_paths=args.changed is not None)
+                      baseline_scope_to_paths=args.changed is not None,
+                      jobs=args.jobs)
     dt = time.monotonic() - t0
 
     if args.sarif is not None:
@@ -139,6 +153,10 @@ def main(argv: list[str] | None = None) -> int:
     if args.timing_out is not None:
         args.timing_out.write_text(json.dumps({
             "elapsed_s": round(dt, 3),
+            # what the same run would have cost serially (sum of the
+            # per-family stage timings) — CI tracks serial-vs-parallel
+            # drift from one artifact instead of linting twice
+            "serial_equivalent_s": round(sum(result.timings.values()), 3),
             "timings": result.timings,
             "findings": len(result.diagnostics),
             # ms alone can't tell "fast because clean" from "fast because

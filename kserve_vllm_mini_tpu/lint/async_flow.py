@@ -1,0 +1,393 @@
+"""KVM121-KVM124 — asyncio event-loop discipline.
+
+The fleet router (fleet/router.py) is a large asyncio program: placement
+scoring, the tracing intermediate, the decision audit ring, and every
+HTTP handler all run on ONE event loop thread. Its thread-safety story
+used to rest on hand-written "event-loop-only" comments; this family
+checks the discipline those comments claimed, in four rules:
+
+- **KVM121 — blocking calls on the loop.** The event-loop-root table
+  (aiohttp ``router.add_*`` handlers, ``app.on_startup.append``
+  lifecycle callbacks, ``create_task``/``ensure_future`` targets,
+  ``asyncio.run``/``run_until_complete`` targets) is propagated through
+  the cross-file call graph; any reachable call to ``time.sleep``, sync
+  ``subprocess``, blocking HTTP (``requests``/sync ``httpx``/
+  ``urlopen``), ``socket.create_connection``, an un-timed
+  ``Lock.acquire``, or sync file IO (``open``/``read_text``/...) stalls
+  EVERY in-flight request on the loop at once. Callees handed to
+  ``run_in_executor``/``asyncio.to_thread`` are thread roots, so
+  reachability never crosses into them — the blessed offload pattern is
+  exempt by construction.
+- **KVM122 — fire-and-forget tasks.** A ``create_task``/
+  ``ensure_future`` whose handle is neither stored, awaited, returned,
+  passed on, nor given a done-callback: an exception inside the task is
+  swallowed silently (and CPython may garbage-collect the task
+  mid-flight). The router's respawn/scrape paths are exactly where a
+  silent death matters.
+- **KVM123 — loop-affinity violations.** Reusing the KVM05x access
+  facts (lint/concurrency.py): an attribute mutated by BOTH
+  loop-reachable code and thread-rooted code, with no common lock and
+  no ``call_soon_threadsafe`` routing. Routed designs pass by
+  construction — a ``call_soon_threadsafe(cb, ...)`` callback is itself
+  an event-loop root, so a thread that routes its writes has no
+  thread-side access left to flag. KVM051 defers these attribute sets
+  here: the right fix is loop routing, not "add a lock".
+- **KVM124 — read-modify-write straddling an await.** Loop state read
+  into a local before an ``await`` and written back (from that local)
+  after it — another task interleaves at the await and the update is
+  lost (the placement-scoreboard bug class). The single-statement form
+  (``self.total += await f()``) loads, awaits, then stores, and is
+  flagged too. The correct ``self.x += 1 ... await ... self.x -= 1``
+  pattern (each RMW atomic between awaits) is NOT flagged.
+
+Same under-approximation contract as KVM05x: unresolved targets
+contribute no roots, unattributed state contributes no findings.
+Suppress deliberate designs with ``# kvmini: async-ok`` plus a one-line
+justification (docs/LINTING.md); on subset scans the token's staleness
+is not judged — the registration that makes a function loop-reachable
+may live in an unscanned module.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from kserve_vllm_mini_tpu.lint.concurrency import (
+    ADMIN_EXECUTOR_METHODS,
+    DRIVER_ROOT,
+    LOOP_ROOT,
+    TASK_SPAWNERS,
+    THREAD_CTORS,
+    _LOCKISH_NAME,
+    _self_attr,
+    shared_facts,
+)
+from kserve_vllm_mini_tpu.lint.diagnostics import Diagnostic
+from kserve_vllm_mini_tpu.lint.facts import (
+    FactIndex,
+    FunctionInfo,
+    ModuleFacts,
+    _last_attr,
+    iter_scope,
+)
+
+# module-attribute calls that block the calling thread: receiver name ->
+# blocking attrs. (subprocess.Popen itself returns immediately and is
+# not listed; requests.Session() constructs without IO.)
+_BLOCKING_MODULE_CALLS = {
+    "time": {"sleep"},
+    "subprocess": {"run", "call", "check_call", "check_output"},
+    "requests": {"get", "post", "put", "delete", "head", "patch", "request"},
+    "httpx": {"get", "post", "put", "delete", "head", "patch", "request",
+              "stream"},
+    "socket": {"create_connection", "getaddrinfo", "gethostbyname"},
+}
+# sync file IO methods (pathlib / io objects) — "large" is not statically
+# knowable, so every loop-side sync read/write is surfaced; intentional
+# tiny reads annotate async-ok, real ones move to run_in_executor
+_BLOCKING_IO_METHODS = {"read_text", "write_text", "read_bytes",
+                        "write_bytes"}
+_THREADISH_PREFIXES = ("thread:", "pool:")
+
+
+def _threadish(roots: set[str]) -> set[str]:
+    return {r for r in roots
+            if r.startswith(_THREADISH_PREFIXES) or r == DRIVER_ROOT}
+
+
+class AsyncFlowChecker:
+    def __init__(self, index: FactIndex):
+        self.index = index
+        self.diags: list[Diagnostic] = []
+        # piggyback on the KVM05x fact phases: class facts, root labels
+        # (incl. the event-loop-root table), per-access records, and
+        # held-lock propagation — memoized per index, so whichever of
+        # KVM05x/KVM12x runs first builds them and the other reuses
+        self.cc = shared_facts(index)
+        self._offload_cache: dict[tuple[str, str], frozenset[int]] = {}
+        self.loop_keys = self._loop_reachable()
+
+    def _offloaded_nodes(self, fn: FunctionInfo) -> frozenset[int]:
+        """Node ids inside executor-offload argument subtrees of ``fn``.
+
+        ``run_in_executor(None, lambda: load_peft(...))`` wraps the
+        blocking work in a lambda, which has no FunctionInfo of its own —
+        without this exclusion the call edge out of the lambda body would
+        propagate loop context straight into the offloaded callee and
+        flag exactly the blessed pattern."""
+        key = fn.key()
+        cached = self._offload_cache.get(key)
+        if cached is None:
+            excluded: set[int] = set()
+            for node in iter_scope(fn.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                la = _last_attr(node.func)
+                if (la in ("run_in_executor", "to_thread", "submit")
+                        or la in ADMIN_EXECUTOR_METHODS
+                        or la in THREAD_CTORS):
+                    for sub in list(node.args) + [kw.value
+                                                  for kw in node.keywords]:
+                        excluded.update(id(x) for x in ast.walk(sub))
+            cached = frozenset(excluded)
+            self._offload_cache[key] = cached
+        return cached
+
+    def _loop_reachable(self) -> set[tuple[str, str]]:
+        """BFS from the event-loop roots through the call graph, stopping
+        at root boundaries (a function spawned as a thread/pool target
+        runs in ITS context, not the loop's) and never following a call
+        edge that originates inside an offload argument subtree."""
+        out: set[tuple[str, str]] = set()
+        work: list[FunctionInfo] = []
+        for fn, label in self.cc.raw_roots:
+            if label == LOOP_ROOT and fn.key() not in out:
+                out.add(fn.key())
+                work.append(fn)
+        while work:
+            fn = work.pop()
+            mod = self.index.modules.get(fn.path)
+            if mod is None:
+                continue
+            excluded = self._offloaded_nodes(fn)
+            seen_here: set[tuple[str, str]] = set()
+            for cs in self.index.call_sites(mod, fn):
+                if id(cs.node) in excluded:
+                    continue
+                for callee in self.cc._callees(mod, fn, cs.node):
+                    ck = callee.key()
+                    if (ck in seen_here or ck in out
+                            or ck in self.cc.root_targets):
+                        continue
+                    seen_here.add(ck)
+                    out.add(ck)
+                    work.append(callee)
+        return out
+
+    def _emit(self, mod: ModuleFacts, line: int, code: str, msg: str,
+              ctx: str) -> None:
+        if mod.suppressions.is_suppressed(line, code):
+            return
+        self.diags.append(Diagnostic(mod.path, line, code, msg, context=ctx))
+
+    # -- KVM121 ---------------------------------------------------------------
+
+    def _blocking_desc(self, mod: ModuleFacts, fn: FunctionInfo,
+                       call: ast.Call) -> Optional[str]:
+        f = call.func
+        if isinstance(f, ast.Attribute):
+            recv = f.value
+            if isinstance(recv, ast.Name):
+                if f.attr in _BLOCKING_MODULE_CALLS.get(recv.id, ()):
+                    return f"{recv.id}.{f.attr}"
+            if f.attr in _BLOCKING_IO_METHODS:
+                return f"{f.attr}()"
+            if f.attr == "acquire":
+                lock_attr = _self_attr(recv)
+                timed = bool(call.args) or any(
+                    kw.arg in ("timeout", "blocking") for kw in call.keywords)
+                if lock_attr is not None and not timed and fn.class_name:
+                    ci = self.cc.class_info(mod.path, fn.class_name)
+                    if (lock_attr in ci.lock_attrs
+                            or _LOCKISH_NAME.search(lock_attr)):
+                        return f"self.{lock_attr}.acquire()"
+            return None
+        if isinstance(f, ast.Name):
+            if f.id == "open":
+                return "open()"
+            if f.id == "urlopen":
+                return "urlopen()"
+            src = mod.from_imports.get(f.id)
+            if src is not None and f.id in _BLOCKING_MODULE_CALLS.get(
+                    src[0], ()):
+                return f"{src[0]}.{f.id}"
+        return None
+
+    def _check_blocking(self) -> None:
+        for rec in self.cc.call_records:
+            if rec.fn.key() not in self.loop_keys or rec.awaited:
+                continue
+            if id(rec.node) in self._offloaded_nodes(rec.fn):
+                continue  # inside a run_in_executor/to_thread argument
+            desc = self._blocking_desc(rec.mod, rec.fn, rec.node)
+            if desc is None:
+                continue
+            self._emit(
+                rec.mod, rec.node.lineno, "KVM121",
+                f"`{desc}` blocks the event loop (reachable from a "
+                f"loop root via `{rec.fn.name}`) — every in-flight "
+                "request on the loop stalls until it returns; use the "
+                "async equivalent, offload with "
+                "`loop.run_in_executor`/`asyncio.to_thread`, or mark "
+                "`# kvmini: async-ok`",
+                rec.fn.qualname)
+
+    # -- KVM122 ---------------------------------------------------------------
+
+    def _check_fire_and_forget(self) -> None:
+        for mod in self.index.modules.values():
+            for fn in mod.functions.values():
+                for node in iter_scope(fn.node):
+                    if not (isinstance(node, ast.Expr)
+                            and isinstance(node.value, ast.Call)):
+                        continue
+                    call = node.value
+                    name = _last_attr(call.func)
+                    if name not in TASK_SPAWNERS:
+                        continue
+                    # `t = create_task(...)` / `return ...` / an arg /
+                    # `create_task(...).add_done_callback(...)` are all
+                    # NOT bare-Expr spawns and never reach here
+                    self._emit(
+                        mod, node.lineno, "KVM122",
+                        f"`{name}(...)` handle is neither stored, "
+                        "awaited, nor given a done-callback — an "
+                        "exception inside the task vanishes silently "
+                        "(and the loop may GC the task mid-flight); "
+                        "keep the handle and await/cancel it, or chain "
+                        "`.add_done_callback` that surfaces the "
+                        "exception, or mark `# kvmini: async-ok`",
+                        fn.qualname)
+
+    # -- KVM123 ---------------------------------------------------------------
+
+    def _check_loop_affinity(self) -> None:
+        for (path, cls, attr), accs in sorted(self.cc.accesses.items()):
+            ci = self.cc.class_info(path, cls)
+            if attr in ci.threadsafe_attrs or attr in ci.thread_attrs:
+                continue
+            muts = [a for a in accs if a.mutation]
+            if not muts:
+                continue
+            roots: set[str] = set()
+            for a in accs:
+                roots |= self.cc._fn_labels(a.fn)
+            foreign = _threadish(roots)
+            if LOOP_ROOT not in roots or not foreign:
+                continue
+            guard_sets = [self.cc._guards(a) for a in accs]
+            if frozenset.intersection(*guard_sets):
+                continue  # one lock consistently guards every access
+            # anchor the thread-side access (the one that should be
+            # routed through call_soon_threadsafe), mutations first
+            thread_accs = [
+                a for a in accs
+                if _threadish(set(self.cc._fn_labels(a.fn)))
+            ]
+            anchor = min(
+                thread_accs or accs,
+                key=lambda a: (not a.mutation, a.mod.path, a.line))
+            self._emit(
+                anchor.mod, anchor.line, "KVM123",
+                f"`self.{attr}` is event-loop state "
+                f"(roots: {', '.join(sorted(roots))}) but thread-rooted "
+                "code touches it with no `call_soon_threadsafe` routing "
+                "and no common lock — the loop observes torn state; "
+                "route the thread-side access through "
+                "`loop.call_soon_threadsafe(...)` (or guard every "
+                "access with one lock), or mark `# kvmini: async-ok`",
+                f"{cls}.{attr}")
+
+    # -- KVM124 ---------------------------------------------------------------
+
+    def _check_straddled_rmw(self) -> None:
+        for key in sorted(self.loop_keys):
+            mod = self.index.modules.get(key[0])
+            if mod is None:
+                continue
+            fn = mod.functions.get(key[1])
+            if fn is None or not isinstance(fn.node, ast.AsyncFunctionDef):
+                continue
+            self._scan_rmw(mod, fn)
+
+    @staticmethod
+    def _reads_of_self(expr: ast.AST) -> set[str]:
+        out = set()
+        for n in ast.walk(expr):
+            a = _self_attr(n)
+            if a is not None:
+                out.add(a)
+        return out
+
+    @staticmethod
+    def _contains_await(expr: ast.AST) -> bool:
+        return any(isinstance(n, ast.Await) for n in ast.walk(expr))
+
+    @staticmethod
+    def _names_in(expr: ast.AST) -> set[str]:
+        return {n.id for n in ast.walk(expr) if isinstance(n, ast.Name)}
+
+    def _scan_rmw(self, mod: ModuleFacts, fn: FunctionInfo) -> None:
+        awaits: list[int] = []
+        binds: list[tuple[str, str, int]] = []  # (local, attr, line)
+        flagged: set[int] = set()
+
+        def flag(line: int, attr: str, detail: str) -> None:
+            if line in flagged:
+                return
+            flagged.add(line)
+            self._emit(
+                mod, line, "KVM124",
+                f"read-modify-write of `self.{attr}` straddles an await "
+                f"in `{fn.name}` ({detail}) — another task interleaves "
+                "at the await and this write clobbers its update; "
+                "recompute from current state after the await, or keep "
+                "the RMW atomic between awaits, or mark "
+                "`# kvmini: async-ok`",
+                fn.qualname)
+
+        # pass 1: collect every await and local<-self bind up front —
+        # iter_scope yields in reverse document order, so sequential
+        # accumulation would never see an await before the write it
+        # straddles; the bline < await < write line comparison below
+        # encodes the ordering instead
+        for node in iter_scope(fn.node):
+            if isinstance(node, ast.Await):
+                awaits.append(node.lineno)
+            elif isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        for battr in self._reads_of_self(node.value):
+                            binds.append((t.id, battr, node.lineno))
+
+        for node in iter_scope(fn.node):
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                value = node.value
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    attr = _self_attr(t)
+                    if attr is None:
+                        continue
+                    # single-statement form: the value awaits between
+                    # the implicit load and the store
+                    reads = self._reads_of_self(value) | (
+                        {attr} if isinstance(node, ast.AugAssign) else set())
+                    if attr in reads and self._contains_await(value):
+                        flag(node.lineno, attr,
+                             "the value awaits between load and store")
+                        continue
+                    # bound form: local read before an await, written
+                    # back (via that local) after it
+                    used = self._names_in(value)
+                    for local, battr, bline in binds:
+                        if (battr == attr and local in used
+                                and any(bline < la < node.lineno
+                                        for la in awaits)):
+                            flag(node.lineno, attr,
+                                 f"read into `{local}` at line {bline}")
+                            break
+
+    # -- driver ---------------------------------------------------------------
+
+    def run(self) -> list[Diagnostic]:
+        self._check_blocking()
+        self._check_fire_and_forget()
+        self._check_loop_affinity()
+        self._check_straddled_rmw()
+        return self.diags
+
+
+def check(index: FactIndex) -> list[Diagnostic]:
+    return AsyncFlowChecker(index).run()

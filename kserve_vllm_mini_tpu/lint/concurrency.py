@@ -8,9 +8,14 @@ layers:
 
 - **Thread-root discovery.** ``threading.Thread(target=...)`` /
   ``Timer`` spawn sites, ``executor.submit`` / ``run_in_executor`` /
-  ``asyncio.to_thread`` targets, and HTTP-handler registrations
-  (``router.add_get/add_post`` — aiohttp runs every handler on the
-  server's event-loop thread, one root labeled ``http-handler``).
+  ``asyncio.to_thread`` targets, and the event-loop-root table: aiohttp
+  handler registrations (``router.add_get/add_post``), lifecycle
+  callbacks (``app.on_startup.append``), created tasks
+  (``create_task``/``ensure_future``), ``asyncio.run`` /
+  ``run_until_complete`` targets, and ``call_soon(_threadsafe)``
+  callbacks — all coalesced into ONE ``event-loop`` root (aiohttp runs
+  them on the server's loop thread; one loop per process is the repo
+  convention, so loop-vs-loop access is never concurrent).
   Reachability through the cross-file call graph labels every function
   with the roots that can execute it; unreached functions carry the
   implicit ``main`` root. Roots that reach a follower-replayed engine
@@ -57,6 +62,7 @@ from __future__ import annotations
 
 import ast
 import re
+import threading
 from dataclasses import dataclass, field
 from typing import Iterable, Optional
 
@@ -87,6 +93,13 @@ MUTATOR_METHODS = {
 }
 HANDLER_REGISTRARS = {"add_get", "add_post", "add_put", "add_delete",
                       "add_patch", "add_head"}
+# aiohttp lifecycle hooks: `app.on_startup.append(fn)` — fn runs ON the
+# server's event loop, same execution context as the handlers
+LIFECYCLE_HOOKS = {"on_startup", "on_cleanup", "on_shutdown"}
+# spawn sites whose target coroutine/callback runs on the calling loop:
+# the task factories, plus the blessed thread->loop handoff primitives
+TASK_SPAWNERS = {"create_task", "ensure_future"}
+LOOP_CALLBACK_METHODS = {"call_soon_threadsafe", "call_soon"}
 TEARDOWN_NAME = re.compile(
     r"(^|_)(stop|shutdown|close|teardown|finalize|cleanup|exit)", re.I)
 # word-boundary match for a not-statically-typed lock name: a bare
@@ -95,7 +108,13 @@ TEARDOWN_NAME = re.compile(
 _LOCKISH_NAME = re.compile(r"(^|_)(lock|mutex)($|_)", re.I)
 MAIN_ROOT = "main"
 DRIVER_ROOT = "lockstep-driver"
-HTTP_ROOT = "http-handler"
+# ONE coalesced label for everything asyncio runs on a loop: aiohttp
+# handlers, lifecycle callbacks, created tasks, run_until_complete/
+# asyncio.run targets, and call_soon(_threadsafe) callbacks. The repo
+# convention is one loop per process (fleet/router.py's dedicated loop
+# thread), so loop-vs-loop access is never concurrent — a two-loop
+# design would be under-reported, the checker's stated direction.
+LOOP_ROOT = "event-loop"
 # functions named like this ARE replay drivers even though nothing spawns
 # them as threads in-package (the follower's main thread runs them) —
 # treat as pseudo-roots so they never pick up the generic `main` label
@@ -381,6 +400,9 @@ class ConcurrencyChecker:
         self._param_types: dict[tuple[str, str], dict[str, str]] = {}
         self.labels: dict[tuple[str, str], set[str]] = {}
         self.root_targets: set[tuple[str, str]] = set()
+        # pre-coalescing (fn, label) spawn facts — the KVM12x checker
+        # layers its event-loop analysis on these (lint/async_flow.py)
+        self.raw_roots: list[tuple[FunctionInfo, str]] = []
         self.entry_held: dict[tuple[str, str], Optional[frozenset[str]]] = {}
 
     # -- phase 0: class facts ------------------------------------------------
@@ -392,7 +414,7 @@ class ConcurrencyChecker:
         # pass 1: register every class first — annotations/ctors in module A
         # may reference classes defined in module B (scanned later)
         for mod in self.index.modules.values():
-            for node in ast.walk(mod.tree):
+            for node in mod.walk():
                 if isinstance(node, ast.ClassDef):
                     paths = self._class_defs.setdefault(node.name, [])
                     if mod.path not in paths:
@@ -401,7 +423,7 @@ class ConcurrencyChecker:
         for mod in self.index.modules.values():
             # class-body annotations (dataclass fields):
             # `done: threading.Event = field(...)`
-            for node in ast.walk(mod.tree):
+            for node in mod.walk():
                 if not isinstance(node, ast.ClassDef):
                     continue
                 ci = self.class_info(mod.path, node.name)
@@ -589,6 +611,17 @@ class ConcurrencyChecker:
             return []
         return self.index._resolve_expr(mod, fn, expr)
 
+    def _resolve_coro(self, mod: ModuleFacts, fn: FunctionInfo,
+                      expr: ast.AST) -> list[FunctionInfo]:
+        """A coroutine OBJECT argument (`create_task(self._scoreboard())`,
+        `loop.run_until_complete(boot())`) resolves through the inner
+        call's func — the called coroutine function is what the loop
+        runs. A bare name (an already-created coro bound locally) falls
+        back to plain target resolution."""
+        if isinstance(expr, ast.Call):
+            return self._resolve_target(mod, fn, expr.func)
+        return self._resolve_target(mod, fn, expr)
+
     def _discover_roots(self) -> list[tuple[FunctionInfo, str]]:
         roots: list[tuple[FunctionInfo, str]] = []
         for mod in self.index.modules.values():
@@ -630,16 +663,43 @@ class ConcurrencyChecker:
                     out.append((t, f"pool:{t.name}"))
             elif f.attr in HANDLER_REGISTRARS and len(node.args) > 1:
                 for t in self._resolve_target(mod, fn, node.args[1]):
-                    out.append((t, HTTP_ROOT))
+                    out.append((t, LOOP_ROOT))
             elif f.attr == "add_route" and len(node.args) > 2:
                 for t in self._resolve_target(mod, fn, node.args[2]):
-                    out.append((t, HTTP_ROOT))
+                    out.append((t, LOOP_ROOT))
+            elif (f.attr == "append" and node.args
+                  and isinstance(f.value, ast.Attribute)
+                  and f.value.attr in LIFECYCLE_HOOKS):
+                # app.on_startup.append(boot_cb): runs on the server loop
+                for t in self._resolve_target(mod, fn, node.args[0]):
+                    out.append((t, LOOP_ROOT))
+            elif f.attr in TASK_SPAWNERS and node.args:
+                for t in self._resolve_coro(mod, fn, node.args[0]):
+                    out.append((t, LOOP_ROOT))
+            elif f.attr in LOOP_CALLBACK_METHODS and node.args:
+                for t in self._resolve_target(mod, fn, node.args[0]):
+                    out.append((t, LOOP_ROOT))
+            elif f.attr == "run_until_complete" and node.args:
+                for t in self._resolve_coro(mod, fn, node.args[0]):
+                    out.append((t, LOOP_ROOT))
+            elif (f.attr == "run" and node.args
+                  and isinstance(f.value, ast.Name)
+                  and f.value.id == "asyncio"):
+                # asyncio.run(main()) — NOT subprocess.run, hence the
+                # explicit receiver check
+                for t in self._resolve_coro(mod, fn, node.args[0]):
+                    out.append((t, LOOP_ROOT))
             elif f.attr in ADMIN_EXECUTOR_METHODS and node.args:
                 for t in self._resolve_target(mod, fn, node.args[0]):
                     out.append((t, DRIVER_ROOT))
         if _last_attr(node.func) == "to_thread" and node.args:
             for t in self._resolve_target(mod, fn, node.args[0]):
                 out.append((t, f"pool:{t.name}"))
+        if (isinstance(node.func, ast.Name)
+                and node.func.id in TASK_SPAWNERS and node.args):
+            # from asyncio import create_task — the bare-name spelling
+            for t in self._resolve_coro(mod, fn, node.args[0]):
+                out.append((t, LOOP_ROOT))
         return out
 
     def _reach(self, start: FunctionInfo) -> set[tuple[str, str]]:
@@ -662,6 +722,7 @@ class ConcurrencyChecker:
 
     def _label_functions(self) -> None:
         raw_roots = self._discover_roots()
+        self.raw_roots = raw_roots
         self.root_targets = {fn.key() for fn, _ in raw_roots}
         replayed = self.index.follower_replayed_methods()
         reach_cache: dict[tuple[str, str], set[tuple[str, str]]] = {}
@@ -763,6 +824,21 @@ class ConcurrencyChecker:
             for a in accs:
                 roots |= self._fn_labels(a.fn)
             if len(roots) < 2:
+                continue
+            if LOOP_ROOT in roots and any(
+                    r.startswith(("thread:", "pool:")) or r == DRIVER_ROOT
+                    for r in roots):
+                # loop-vs-thread sharing is KVM123's jurisdiction
+                # (lint/async_flow.py): the right fix there is
+                # call_soon_threadsafe routing, not "add a lock", so a
+                # KVM051 here would prescribe the wrong remedy
+                continue
+            if roots <= {LOOP_ROOT, MAIN_ROOT}:
+                # event-loop + main are temporally exclusive: main-rooted
+                # code only coexists with a running loop by blocking in
+                # asyncio.run()/run_until_complete() (a loop run on a
+                # spawned thread carries a thread:/pool: root instead),
+                # so the CLI's read-after-run pattern cannot race
                 continue
             guard_sets = [self._guards(a) for a in accs]
             common = frozenset.intersection(*guard_sets)
@@ -1017,11 +1093,15 @@ class ConcurrencyChecker:
 
     # -- driver --------------------------------------------------------------
 
-    def run(self) -> list[Diagnostic]:
+    def run_facts(self) -> "ConcurrencyChecker":
         self._collect_class_facts()
         self._label_functions()
         self._scan_functions()
         self._propagate_held()
+        return self
+
+    def run(self) -> list[Diagnostic]:
+        self.run_facts()
         self._check_guarded_by()
         self._check_lock_order()
         self._check_primitives()
@@ -1029,5 +1109,29 @@ class ConcurrencyChecker:
         return self.diags
 
 
+_FACTS_LOCK = threading.Lock()
+
+
+def shared_facts(index: FactIndex) -> ConcurrencyChecker:
+    """The fact phases (class kinds, root labels incl. the event-loop
+    table, per-access records, held-lock propagation) memoized per index:
+    KVM05x and the KVM12x async-flow family both reason from these facts,
+    and on a full-package scan the phases cost more than either family's
+    checks. The lock makes the build once-only when the two families run
+    on concurrent checker threads; after it, every consumer is
+    read-only (the label/guard caches are idempotent inserts)."""
+    with _FACTS_LOCK:
+        cached = getattr(index, "_kvmini_concurrency_facts", None)
+        if cached is None:
+            cached = ConcurrencyChecker(index).run_facts()
+            index._kvmini_concurrency_facts = cached
+        return cached
+
+
 def check(index: FactIndex) -> list[Diagnostic]:
-    return ConcurrencyChecker(index).run()
+    c = shared_facts(index)
+    c._check_guarded_by()
+    c._check_lock_order()
+    c._check_primitives()
+    c._check_publication()
+    return c.diags

@@ -1,0 +1,406 @@
+"""KVM131-KVM134 — config-surface drift.
+
+The operator config surface spans five layers that nothing joins
+mechanically: ``*_ENV_KNOBS`` registration tables, ``KVMINI_*``
+``os.environ`` read sites, argparse flags, the config dataclasses
+(``EngineConfig``/``MonitorConfig``/``PolicyConfig``), and the docs
+pages. Every PR note promises "validated loudly, documented in
+_ENV_KNOBS" — this family turns that promise into checked facts:
+
+- **KVM131 — unregistered env knob.** An ``os.environ`` read of a
+  ``KVMINI_*`` key that no knob table registers and no docs page
+  mentions: the knob works but no operator can discover it.
+- **KVM132 — stale knob entry.** A knob-table key no read site
+  consumes and whose string literal appears nowhere outside the table
+  itself: the table documents a knob the code no longer honors.
+- **KVM133 — unsurfaced config field.** A config-dataclass field with
+  no CLI flag, no env knob, no profile-key/string plumbing, and no docs
+  mention — the field exists but no operator can set it. The dual
+  failure is also flagged: a field surfaced via CLI flag whose flag the
+  docs never mention.
+- **KVM134 — knob-default drift.** The same knob declared with
+  different defaults across argparse ``default=``, the env-parse
+  fallback, and the dataclass field default. Values are compared after
+  normalization (``"256"`` == ``256``, ``"true"`` == ``True``), so only
+  genuine drift fires.
+
+Join semantics follow the KVM032 full-scan contract: KVM131/132/133 are
+absence-based (their registration surface — tables, flags, docs — may
+live in an unscanned module), so they run only on full package scans
+where ``doc_texts`` is populated; KVM134 is presence-based (every
+compared default is in the scanned set) and runs on any scan. Suppress
+deliberate gaps with ``# kvmini: config-ok`` plus a one-line
+justification.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from collections import Counter
+from dataclasses import dataclass, field
+from typing import Optional
+
+from kserve_vllm_mini_tpu.lint.diagnostics import Diagnostic
+from kserve_vllm_mini_tpu.lint.facts import FactIndex, ModuleFacts
+
+ENV_PREFIX = "KVMINI_"
+CONFIG_CLASSES = {"EngineConfig", "MonitorConfig", "PolicyConfig"}
+
+
+@dataclass
+class EnvRead:
+    mod: ModuleFacts
+    line: int
+    key: str
+    fallback: object = None  # constant second arg of .get/getenv, if any
+    has_fallback: bool = False
+
+
+@dataclass
+class KnobTable:
+    mod: ModuleFacts
+    name: str
+    node: ast.Assign
+    keys: dict[str, int] = field(default_factory=dict)  # key -> line
+
+
+@dataclass
+class CliFlag:
+    mod: ModuleFacts
+    line: int
+    flag: str          # e.g. "--max-batch"
+    knob: str          # normalized: "max_batch"
+    default: object = None
+    has_default: bool = False
+
+
+@dataclass
+class ConfigField:
+    mod: ModuleFacts
+    cls: str
+    name: str
+    line: int
+    default: object = None
+    has_default: bool = False
+
+
+def _env_receiver(node: ast.AST) -> bool:
+    """True for the expression ``os.environ``."""
+    return (isinstance(node, ast.Attribute) and node.attr == "environ"
+            and isinstance(node.value, ast.Name) and node.value.id == "os")
+
+
+def _const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _norm_default(v: object) -> object:
+    """Collapse representation differences so only real drift compares
+    unequal: booleans and numeric strings to float, truthy/falsy words
+    to 1.0/0.0, other strings case-folded."""
+    if isinstance(v, bool):
+        return 1.0 if v else 0.0
+    if isinstance(v, (int, float)):
+        return float(v)
+    if isinstance(v, str):
+        s = v.strip().lower()
+        if s in ("true", "yes", "on", "1"):
+            return 1.0
+        # "" is the conventional unset/falsy env fallback
+        # (`os.environ.get(k, "") == "1"`), not a drifted default
+        if s in ("false", "no", "off", "0", ""):
+            return 0.0
+        try:
+            return float(s)
+        except ValueError:
+            return s
+    return v
+
+
+def _knob_of_env(key: str) -> str:
+    k = key
+    for prefix in (ENV_PREFIX, "BENCH_"):
+        if k.startswith(prefix):
+            k = k[len(prefix):]
+    return k.lower()
+
+
+class ConfigFlowChecker:
+    def __init__(self, index: FactIndex, doc_texts: dict[str, str]):
+        self.index = index
+        self.doc_text = "\n".join(doc_texts.values())
+        self.diags: list[Diagnostic] = []
+        self.env_reads: list[EnvRead] = []
+        self.tables: list[KnobTable] = []
+        self.flags: list[CliFlag] = []
+        self.fields: list[ConfigField] = []
+        self.str_constants: Counter[str] = Counter()  # across all modules
+
+    def _emit(self, mod: ModuleFacts, line: int, code: str, msg: str,
+              ctx: str) -> None:
+        if mod.suppressions.is_suppressed(line, code):
+            return
+        self.diags.append(Diagnostic(mod.path, line, code, msg, context=ctx))
+
+    # -- collection -----------------------------------------------------------
+
+    def _collect(self) -> None:
+        # a flat type-dispatch over every node in the package: the inner
+        # loop is hot (it sees ~every AST node once), so the common case
+        # (a constant, or nothing of interest) stays branch-one/branch-two
+        counts = self.str_constants
+        for mod in self.index.modules.values():
+            for node in mod.walk():
+                t = node.__class__
+                if t is ast.Constant:
+                    if node.value.__class__ is str:
+                        counts[node.value] += 1
+                elif t is ast.Call:
+                    self._collect_call(mod, node)
+                elif t is ast.Subscript:
+                    if _env_receiver(node.value):
+                        key = _const_str(node.slice)
+                        if key is not None:
+                            self.env_reads.append(
+                                EnvRead(mod, node.lineno, key))
+                elif t is ast.Compare:
+                    # "KEY" in os.environ
+                    if (len(node.ops) == 1
+                            and isinstance(node.ops[0], (ast.In, ast.NotIn))
+                            and _env_receiver(node.comparators[0])):
+                        key = _const_str(node.left)
+                        if key is not None:
+                            self.env_reads.append(
+                                EnvRead(mod, node.lineno, key))
+                elif t is ast.Assign:
+                    self._collect_table(mod, node)
+                elif t is ast.ClassDef:
+                    if node.name in CONFIG_CLASSES:
+                        self._collect_config_class(mod, node)
+
+    def _collect_call(self, mod: ModuleFacts, node: ast.Call) -> None:
+        f = node.func
+        if not isinstance(f, ast.Attribute):
+            return
+        is_get = f.attr == "get" and _env_receiver(f.value)
+        is_getenv = (f.attr == "getenv" and isinstance(f.value, ast.Name)
+                     and f.value.id == "os")
+        if is_get or is_getenv:
+            if not node.args:
+                return
+            key = _const_str(node.args[0])
+            if key is None:
+                return
+            rec = EnvRead(mod, node.lineno, key)
+            if len(node.args) > 1 and isinstance(node.args[1], ast.Constant):
+                rec.fallback = node.args[1].value
+                # `.get(k, "")` is the unset sentinel for membership-test
+                # parses (`.get(k, "").lower() in ("0", "false")`) — it
+                # is not the knob's default, so it never enters the
+                # KVM134 cross-layer join
+                rec.has_fallback = rec.fallback != ""
+            self.env_reads.append(rec)
+            return
+        if f.attr == "add_argument":
+            flags = [v for a in node.args
+                     if (v := _const_str(a)) is not None
+                     and v.startswith("--")]
+            default = None
+            has_default = False
+            for kw in node.keywords:
+                if kw.arg == "default" and isinstance(kw.value, ast.Constant):
+                    default = kw.value.value
+                    has_default = default is not None
+            for flag in flags:
+                self.flags.append(CliFlag(
+                    mod, node.lineno, flag,
+                    flag.lstrip("-").replace("-", "_"),
+                    default, has_default))
+
+    def _collect_table(self, mod: ModuleFacts, node: ast.Assign) -> None:
+        if not (len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id.endswith("ENV_KNOBS")
+                and isinstance(node.value, ast.Dict)):
+            return
+        table = KnobTable(mod, node.targets[0].id, node)
+        for k in node.value.keys:
+            key = _const_str(k) if k is not None else None
+            if key is not None:
+                table.keys[key] = k.lineno
+        self.tables.append(table)
+
+    def _collect_config_class(self, mod: ModuleFacts,
+                              node: ast.ClassDef) -> None:
+        for stmt in node.body:
+            if isinstance(stmt, ast.AnnAssign) and isinstance(
+                    stmt.target, ast.Name):
+                name, value = stmt.target.id, stmt.value
+            elif (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)):
+                name, value = stmt.targets[0].id, stmt.value
+            else:
+                continue
+            if name.startswith("_"):
+                continue
+            f = ConfigField(mod, node.name, name, stmt.lineno)
+            if isinstance(value, ast.Constant) and value.value is not None:
+                f.default = value.value
+                f.has_default = True
+            self.fields.append(f)
+
+    # -- KVM131 ---------------------------------------------------------------
+
+    def _check_unregistered(self) -> None:
+        registered = set()
+        for t in self.tables:
+            registered |= set(t.keys)
+        seen: set[tuple[str, str]] = set()
+        for r in sorted(self.env_reads,
+                        key=lambda r: (r.mod.path, r.line)):
+            if not r.key.startswith(ENV_PREFIX) or r.key in registered:
+                continue
+            if r.key in self.doc_text:
+                continue
+            if (r.mod.path, r.key) in seen:
+                continue  # one finding per (module, key)
+            seen.add((r.mod.path, r.key))
+            self._emit(
+                r.mod, r.line, "KVM131",
+                f"env knob `{r.key}` is read here but registered in no "
+                "`*_ENV_KNOBS` table and mentioned on no docs page — the "
+                "knob works but no operator can discover it; register "
+                "it (or document it in docs/API.md), or mark "
+                "`# kvmini: config-ok`",
+                r.key)
+
+    # -- KVM132 ---------------------------------------------------------------
+
+    def _check_stale_entries(self) -> None:
+        read_keys = {r.key for r in self.env_reads}
+        for t in self.tables:
+            in_table = Counter(
+                v for n in ast.walk(t.node)
+                if (v := _const_str(n)) is not None)
+            for key, line in sorted(t.keys.items()):
+                if key in read_keys:
+                    continue
+                # consumed indirectly (helper call, f-string join) if the
+                # literal appears anywhere outside the table assignment
+                if self.str_constants[key] > in_table[key]:
+                    continue
+                self._emit(
+                    t.mod, line, "KVM132",
+                    f"knob-table entry `{key}` in `{t.name}` has no read "
+                    "site — the table documents a knob the code no "
+                    "longer honors; delete the entry (or wire the read "
+                    "back up), or mark `# kvmini: config-ok`",
+                    key)
+
+    # -- KVM133 ---------------------------------------------------------------
+
+    def _mentioned_in_docs(self, *terms: str) -> bool:
+        for t in terms:
+            if re.search(rf"(?<![\w-]){re.escape(t)}(?![\w-])",
+                         self.doc_text):
+                return True
+        return False
+
+    def _check_unsurfaced(self) -> None:
+        env_knobs = {_knob_of_env(r.key) for r in self.env_reads
+                     if r.key.startswith((ENV_PREFIX, "BENCH_"))}
+        flag_knobs = {f.knob for f in self.flags}
+        for f in sorted(self.fields,
+                        key=lambda f: (f.mod.path, f.line)):
+            dashed = f.name.replace("_", "-")
+            via_cli = f.name in flag_knobs
+            via_env = f.name in env_knobs
+            # profile keys and dict-based plumbing surface the field as a
+            # string literal (beyond the dataclass declaration itself)
+            via_string = self.str_constants[f.name] > 0
+            in_docs = self._mentioned_in_docs(f.name, dashed)
+            if not (via_cli or via_env or via_string or in_docs):
+                self._emit(
+                    f.mod, f.line, "KVM133",
+                    f"`{f.cls}.{f.name}` has no CLI flag, env knob, "
+                    "profile key, or docs mention — the field exists but "
+                    "no operator can set it; surface it (or document "
+                    "why it is internal-only), or mark "
+                    "`# kvmini: config-ok`",
+                    f"{f.cls}.{f.name}")
+            elif via_cli and not self._mentioned_in_docs(
+                    f.name, dashed, f"--{dashed}"):
+                self._emit(
+                    f.mod, f.line, "KVM133",
+                    f"`{f.cls}.{f.name}` is settable via `--{dashed}` "
+                    "but the flag appears on no docs page — document it "
+                    "in docs/API.md, or mark `# kvmini: config-ok`",
+                    f"{f.cls}.{f.name}")
+
+    # -- KVM134 ---------------------------------------------------------------
+
+    def _check_default_drift(self) -> None:
+        # knob name -> list of (source-desc, raw value, mod, line)
+        sources: dict[str, list[tuple[str, object, ModuleFacts, int]]] = {}
+
+        def add(knob: str, desc: str, value: object, mod: ModuleFacts,
+                line: int) -> None:
+            sources.setdefault(knob, []).append((desc, value, mod, line))
+
+        for f in self.fields:
+            if f.has_default:
+                add(f.name, f"{f.cls} default", f.default, f.mod, f.line)
+        for fl in self.flags:
+            if fl.has_default:
+                add(fl.knob, f"argparse {fl.flag} default=", fl.default,
+                    fl.mod, fl.line)
+        for r in self.env_reads:
+            if r.has_fallback and r.key.startswith((ENV_PREFIX, "BENCH_")):
+                add(_knob_of_env(r.key), f"{r.key} fallback", r.fallback,
+                    r.mod, r.line)
+
+        for knob in sorted(sources):
+            entries = sources[knob]
+            # per-LAYER value sets: several tools may declare the same
+            # flag with tool-appropriate defaults (bench --seed 42 vs
+            # engine seed 0), so drift is judged between layers, and only
+            # when two layers share NO value at all — a name collision
+            # within one layer is not cross-layer drift
+            by_kind: dict[str, set[str]] = {}
+            for d, v, *_ in entries:
+                by_kind.setdefault(d.split(" ", 1)[0], set()).add(
+                    repr(_norm_default(v)))
+            kinds = sorted(by_kind)
+            if len(kinds) < 2:
+                continue  # drift needs two DIFFERENT declaration layers
+            if not any(by_kind[a].isdisjoint(by_kind[b])
+                       for i, a in enumerate(kinds) for b in kinds[i + 1:]):
+                continue
+            desc = "; ".join(f"{d} is {v!r}" for d, v, *_ in entries)
+            # anchor at the last-declared surface (the one most likely
+            # to have drifted from the canonical dataclass default)
+            _, _, mod, line = entries[-1]
+            self._emit(
+                mod, line, "KVM134",
+                f"knob `{knob}` declares different defaults across "
+                f"layers ({desc}) — which one wins depends on call "
+                "path; align them, or mark `# kvmini: config-ok`",
+                knob)
+
+    # -- driver ---------------------------------------------------------------
+
+    def run(self) -> list[Diagnostic]:
+        self._collect()
+        if self.index.full_scan:
+            self._check_unregistered()
+            self._check_stale_entries()
+            self._check_unsurfaced()
+        self._check_default_drift()
+        return self.diags
+
+
+def check(index: FactIndex, doc_texts: dict[str, str]) -> list[Diagnostic]:
+    return ConfigFlowChecker(index, doc_texts).run()

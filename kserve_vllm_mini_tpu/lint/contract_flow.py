@@ -102,7 +102,7 @@ class ContractChecker:
     # -- KVM111 -------------------------------------------------------------
     def _check_fabricated_zero(self) -> None:
         for mod in self.index.modules.values():
-            for node in ast.walk(mod.tree):
+            for node in mod.walk():
                 if isinstance(node, ast.JoinedStr):
                     head = _first_const(node)
                     m = EXPOSITION_PREFIX.match(head or "")
@@ -147,7 +147,7 @@ class ContractChecker:
         for mod in self.index.modules.values():
             is_consumer = bool(EVENT_CONSUMER_PATH.search(mod.path))
             docstrings = _docstring_nodes(mod.tree)
-            for node in ast.walk(mod.tree):
+            for node in mod.walk():
                 if node in docstrings:
                     continue
                 if isinstance(node, ast.Assign):
@@ -260,7 +260,7 @@ class ContractChecker:
         Constant node ids (so client-literal scans skip them)."""
         out: dict[str, int] = {}
         reg_nodes: set[int] = set()
-        for node in ast.walk(mod.tree):
+        for node in mod.walk():
             if (isinstance(node, ast.Call)
                     and isinstance(node.func, ast.Attribute)
                     and node.func.attr in ROUTE_REGISTRARS
@@ -297,7 +297,7 @@ class ContractChecker:
                 if not CLIENT_PATH.search(mod.path):
                     continue
                 docstrings = _docstring_nodes(mod.tree)
-                for node in ast.walk(mod.tree):
+                for node in mod.walk():
                     if (not isinstance(node, ast.Constant)
                             or not isinstance(node.value, str)
                             or node in docstrings

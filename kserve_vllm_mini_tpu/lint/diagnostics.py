@@ -154,6 +154,31 @@ RULES: dict[str, Rule] = {
              "server/router routes vs tests/mock_server.py vs docs/API.md "
              "vs in-repo client call sites out of sync (incl. the "
              "_shed_response 429 + Retry-After shape)"),
+        Rule("KVM121", "blocking-call-on-event-loop", "async-ok",
+             "blocking call (time.sleep, sync subprocess/HTTP, un-timed "
+             "Lock.acquire, sync file IO) reachable from code running on "
+             "the asyncio event loop — stalls every request on the loop"),
+        Rule("KVM122", "fire-and-forget-task", "async-ok",
+             "create_task/ensure_future handle neither stored, awaited, "
+             "nor given a done-callback — task exceptions vanish silently"),
+        Rule("KVM123", "loop-affinity-violation", "async-ok",
+             "state mutated by both event-loop code and thread-rooted code "
+             "without call_soon_threadsafe routing or a common lock"),
+        Rule("KVM124", "await-straddled-rmw", "async-ok",
+             "read-modify-write of loop state straddling an await (read "
+             "before the await, written after) — stale by interleaving"),
+        Rule("KVM131", "unregistered-env-knob", "config-ok",
+             "os.environ read of a KVMINI_* key registered in no knob "
+             "table and mentioned in no docs page"),
+        Rule("KVM132", "stale-knob-entry", "config-ok",
+             "knob-table entry whose env key no read site consumes"),
+        Rule("KVM133", "unsurfaced-config-field", "config-ok",
+             "EngineConfig/MonitorConfig/PolicyConfig field with no CLI "
+             "flag, env knob, or docs surface (no operator can set it) — "
+             "or a config flag undocumented in the docs"),
+        Rule("KVM134", "knob-default-drift", "config-ok",
+             "default-value drift between argparse default=, env-parse "
+             "fallback, and config-dataclass default for the same knob"),
     ]
 }
 

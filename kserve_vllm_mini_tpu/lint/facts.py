@@ -43,14 +43,27 @@ def iter_scope(fn_node: ast.AST):
     the outer scope's taint into the inner one). Lambdas are NOT excluded:
     they get no FunctionInfo of their own, so their (expression-only)
     bodies are checked as part of the enclosing scope — a `.item()` inside
-    an inline lambda is still a host sync at this site."""
-    stack = list(ast.iter_child_nodes(fn_node))
-    while stack:
-        n = stack.pop()
-        yield n
-        if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
-                              ast.ClassDef)):
-            stack.extend(ast.iter_child_nodes(n))
+    an inline lambda is still a host sync at this site.
+
+    The walk is memoized on the node: with fourteen checker families each
+    re-walking every function, generator re-walks were the single largest
+    cost in the full-package profile. The cached tuple preserves the
+    exact historical yield order (DFS, reversed child order), so findings
+    are byte-identical; the store is an idempotent single attribute
+    write, safe under concurrent checker threads."""
+    cached = getattr(fn_node, "_kvmini_scope", None)
+    if cached is None:
+        out = []
+        stack = list(ast.iter_child_nodes(fn_node))
+        while stack:
+            n = stack.pop()
+            out.append(n)
+            if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                stack.extend(ast.iter_child_nodes(n))
+        cached = tuple(out)
+        fn_node._kvmini_scope = cached
+    return cached
 
 
 def _last_attr(node: ast.AST) -> Optional[str]:
@@ -158,6 +171,17 @@ class ModuleFacts:
     # (class, attr) pairs, and (class, attr) dicts subscript-assigned
     jitted_names: set[str] = field(default_factory=set)
     jitted_attrs: set[tuple[str, str]] = field(default_factory=set)
+    # memoized full-tree walk — several families scan every module node;
+    # one materialized tuple replaces a dozen generator re-walks (same
+    # ast.walk BFS order, so findings are byte-identical). Idempotent
+    # single-attribute store: safe under concurrent checker threads.
+    _walk_cache: Optional[tuple] = field(default=None, repr=False,
+                                         compare=False)
+
+    def walk(self) -> tuple:
+        if self._walk_cache is None:
+            self._walk_cache = tuple(ast.walk(self.tree))
+        return self._walk_cache
 
 
 class _ModuleWalker(ast.NodeVisitor):

@@ -100,7 +100,7 @@ def _collect_module(mod: ModuleFacts, s: Surfaces) -> None:
     is_emitter = bool(EMITTER_PATH.search(mod.path))
     is_consumer = bool(CONSUMER_PATH.search(mod.path))
     docstrings = _docstring_nodes(mod.tree)
-    for node in ast.walk(mod.tree):
+    for node in mod.walk():
         if node in docstrings:
             continue  # prose examples aren't emitted/consumed names
         # exposition f-strings pair metric <-> stats key wherever they live

@@ -196,7 +196,7 @@ class ProtocolChecker:
     def _host_only_fields(self) -> set[str]:
         fields: set[str] = set()
         for mod in self.index.modules.values():
-            for node in ast.walk(mod.tree):
+            for node in mod.walk():
                 if not isinstance(node, ast.Assign):
                     continue
                 if not any(isinstance(t, ast.Name) and HOST_ONLY_SET.search(t.id)
@@ -317,7 +317,7 @@ class ProtocolChecker:
     def _check_version_negotiation(self) -> None:
         producers: list[tuple[ModuleFacts, ast.Call, str]] = []
         for mod in self.index.modules.values():
-            for node in ast.walk(mod.tree):
+            for node in mod.walk():
                 if not isinstance(node, ast.Call):
                     continue
                 callee = None

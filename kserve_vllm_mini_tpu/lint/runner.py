@@ -8,9 +8,11 @@ from pathlib import Path
 from typing import Iterable, Optional
 
 from kserve_vllm_mini_tpu.lint import (
+    async_flow,
     baseline as baseline_mod,
     buffer_lifecycle,
     concurrency,
+    config_flow,
     contract_flow,
     dtype_flow,
     jit_purity,
@@ -30,28 +32,32 @@ from kserve_vllm_mini_tpu.lint.facts import FactIndex
 
 EXCLUDED_DIR_NAMES = {"__pycache__", ".git", "node_modules", ".venv"}
 
-# (family prefix, display name, checker) — `--family KVM05` selects by
-# prefix match on the family column; KVM03 and KVM11 are special-cased
-# below because those checkers also consume the docs/dashboards surfaces
+# (family prefix, display name, checker, needs_docs) — `--family KVM05`
+# selects by prefix match on the family column; needs_docs checkers take
+# `(index, doc_texts)` because they join against the docs/dashboards
+# surfaces. Tuple order IS family-code order: both the timing table and
+# the parallel-run result concatenation follow it, so `--timing-out`
+# artifacts diff cleanly across runs and parallel output is byte-
+# identical to serial.
 CHECKERS = (
-    ("KVM01", "jit_purity", jit_purity.check),
-    ("KVM02", "lockstep", lockstep.check),
-    ("KVM04", "workload", workload.check),
-    ("KVM05", "concurrency", concurrency.check),
-    ("KVM06", "dtype_flow", dtype_flow.check),
-    ("KVM07", "buffer_lifecycle", buffer_lifecycle.check),
-    ("KVM08", "mesh_flow", mesh_flow.check),
-    ("KVM09", "resource_paths", resource_paths.check),
-    ("KVM10", "protocol_flow", protocol_flow.check),
+    ("KVM01", "jit_purity", jit_purity.check, False),
+    ("KVM02", "lockstep", lockstep.check, False),
+    ("KVM03", "metrics_drift", metrics_drift.check, True),
+    ("KVM04", "workload", workload.check, False),
+    ("KVM05", "concurrency", concurrency.check, False),
+    ("KVM06", "dtype_flow", dtype_flow.check, False),
+    ("KVM07", "buffer_lifecycle", buffer_lifecycle.check, False),
+    ("KVM08", "mesh_flow", mesh_flow.check, False),
+    ("KVM09", "resource_paths", resource_paths.check, False),
+    ("KVM10", "protocol_flow", protocol_flow.check, False),
+    ("KVM11", "contract_flow", contract_flow.check, True),
+    ("KVM12", "async_flow", async_flow.check, False),
+    ("KVM13", "config_flow", config_flow.check, True),
 )
-METRICS_FAMILY = "KVM03"
-CONTRACT_FAMILY = "KVM11"
 
 # diagnostic code prefix -> the CHECKERS/timings display name, for the
 # per-family finding counts the --timing-out report carries
-FAMILY_NAMES = {family: name for family, name, _ in CHECKERS}
-FAMILY_NAMES[METRICS_FAMILY] = "metrics_drift"
-FAMILY_NAMES[CONTRACT_FAMILY] = "contract_flow"
+FAMILY_NAMES = {family: name for family, name, _, _ in CHECKERS}
 FAMILY_NAMES["KVM001"] = "stale_suppressions"
 
 
@@ -106,7 +112,7 @@ def normalize_families(families: Optional[Iterable[str]]) -> Optional[set[str]]:
         if not norm.startswith("KVM") or not any(
                 code.startswith(norm) for code in selectable):
             raise ValueError(
-                f"unknown rule family {f!r} (families: KVM01..KVM11, or a "
+                f"unknown rule family {f!r} (families: KVM01..KVM13, or a "
                 "full code like KVM051; KVM001 always rides along and is "
                 "not selectable)")
         out.add(norm)
@@ -316,12 +322,21 @@ def run_lint(
     root: Optional[Path] = None,
     families: Optional[set[str]] = None,
     baseline_scope_to_paths: bool = False,
+    jobs: Optional[int] = None,
 ) -> LintResult:
     """``baseline_scope_to_paths``: restrict the baseline gate to entries
     for the scanned files — a `--changed` subset scan must not call an
     unscanned file's grandfathered finding stale (the full scan still
     ratchets it). Ordinary single-file scans keep whole-baseline
-    semantics: a fixed finding flags stale no matter which file you ran."""
+    semantics: a fixed finding flags stale no matter which file you ran.
+
+    ``jobs``: checker-family parallelism. ``1`` runs the families
+    serially in tuple order; ``None`` (the default) sizes a thread pool
+    to the selected family count. Every family is read-only over the one
+    shared FactIndex (the only writes — the call-site cache and the
+    used-suppression sets — are idempotent dict/set inserts, safe under
+    the GIL), and results are concatenated in CHECKERS order before the
+    final sort/dedup, so parallel output is byte-identical to serial."""
     root = (root or Path.cwd()).resolve()
     files = discover_py_files(paths)
     timings: dict[str, float] = {}
@@ -332,14 +347,15 @@ def run_lint(
     # partial scans — the missing fact may live in an unscanned module
     index.full_scan = bool(paths) and all(p.is_dir() for p in paths)
 
-    # cross-surface drift (KVM032 vs docs/dashboards) asserts over the
-    # WHOLE emitter set, so it only runs for directory scans — linting a
-    # single changed file must not fail on metrics that other (unscanned)
-    # emitter modules provide
+    # cross-surface drift (KVM032 vs docs/dashboards, KVM13x vs docs)
+    # asserts over the WHOLE emitter set, so it only runs for directory
+    # scans — linting a single changed file must not fail on metrics or
+    # knobs that other (unscanned) modules provide
     full_scan = index.full_scan
     doc_texts: dict[str, str] = {}
-    if full_scan and (_family_selected(families, METRICS_FAMILY)
-                      or _family_selected(families, CONTRACT_FAMILY)):
+    if full_scan and any(_family_selected(families, family)
+                         for family, _, _, needs_docs in CHECKERS
+                         if needs_docs):
         for doc in discover_doc_files(doc_paths or []):
             try:
                 doc_texts[_rel(root, doc).as_posix()] = doc.read_text(
@@ -347,21 +363,40 @@ def run_lint(
             except OSError:
                 continue
 
+    # one timed thunk per selected family; run serially or in a thread
+    # pool, then concatenate in tuple (= family-code) order — the
+    # downstream sort/dedup sees the same stream either way
+    selected = [(name, checker, needs_docs)
+                for family, name, checker, needs_docs in CHECKERS
+                if _family_selected(families, family)]
+
+    def run_one(name: str, checker, needs_docs: bool
+                ) -> tuple[list[Diagnostic], float]:
+        t = time.perf_counter()
+        found = (checker(index, doc_texts) if needs_docs
+                 else checker(index))
+        return found, time.perf_counter() - t
+
+    if jobs is None:
+        # one thread per family, capped at the core count — the checkers
+        # are pure-Python CPU work, so threads beyond the cores only add
+        # GIL contention (a single-core runner degrades ~20% with a full
+        # 13-thread pool; it runs the serial path instead)
+        import os
+
+        jobs = min(len(selected), os.cpu_count() or 1)
     diags: list[Diagnostic] = []
-    for family, name, checker in CHECKERS:
-        if not _family_selected(families, family):
-            continue
-        t0 = time.perf_counter()
-        diags += checker(index)
-        timings[name] = time.perf_counter() - t0
-    if _family_selected(families, METRICS_FAMILY):
-        t0 = time.perf_counter()
-        diags += metrics_drift.check(index, doc_texts)
-        timings["metrics_drift"] = time.perf_counter() - t0
-    if _family_selected(families, CONTRACT_FAMILY):
-        t0 = time.perf_counter()
-        diags += contract_flow.check(index, doc_texts)
-        timings["contract_flow"] = time.perf_counter() - t0
+    if jobs <= 1 or len(selected) <= 1:
+        results = [run_one(*task) for task in selected]
+    else:
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=jobs) as pool:
+            futures = [pool.submit(run_one, *task) for task in selected]
+            results = [f.result() for f in futures]
+    for (name, _, _), (found, dt) in zip(selected, results):
+        diags += found
+        timings[name] = dt
 
     # stale `# kvmini:` comments — only after every rule had its chance,
     # and only for the suppression tokens whose rules ran this pass
@@ -370,11 +405,14 @@ def run_lint(
         # the KVM10x/11x families reason from the ABSENCE of a fact on
         # the far side of a protocol and stand down entirely on subset
         # scans — a protocol-ok on the publish side would read as stale
-        # whenever the follower module is out of scope. Their tokens
-        # can only be judged stale by a full scan.
+        # whenever the follower module is out of scope. Likewise
+        # async-ok (the loop-root registration may be unscanned) and
+        # config-ok (the knob table/docs join is full-scan only). These
+        # tokens can only be judged stale by a full scan.
         if active_tokens is None:
             active_tokens = set(SUPPRESSION_TOKENS)
-        active_tokens -= {"protocol-ok", "contract-ok"}
+        active_tokens -= {"protocol-ok", "contract-ok",
+                          "async-ok", "config-ok"}
     for mod in index.modules.values():
         diags += mod.suppressions.stale(mod.path, active_tokens)
 

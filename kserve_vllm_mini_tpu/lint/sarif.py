@@ -42,6 +42,9 @@ FAMILY_LEVELS = {
     "KVM09": "error",     # exception-path resource safety
     "KVM10": "error",     # wire-protocol conformance (divergence = corruption)
     "KVM11": "warning",   # absent-not-zero contract drift
+    "KVM12": "error",     # asyncio event-loop discipline (a blocked loop
+    #                       stalls every in-flight request at once)
+    "KVM13": "warning",   # config-surface drift (operability, not bytes)
 }
 
 

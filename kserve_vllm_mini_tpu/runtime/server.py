@@ -1966,6 +1966,7 @@ def run(args: argparse.Namespace) -> int:
     )
     max_slots = args.max_slots or int(os.environ.get("KVMINI_MAX_BATCH", "8") or 8)
     max_seq = args.max_seq_len or int(
+        # kvmini: config-ok — deploy manifests default 4096 by design
         os.environ.get("KVMINI_MAX_MODEL_LEN", "1024") or 1024
     )
     quantization = (

@@ -160,7 +160,6 @@ class SpanRecorder:
         # Deliberately lock-free monitoring surface (class docstring):
         # scheduler-thread appends are atomic under the GIL and snapshot()
         # takes a C-level copy; a torn read costs at most one span.
-        # kvmini: thread-ok — lock-free by contract, torn read is benign
         return len(self._spans)
 
     def record(
@@ -181,7 +180,8 @@ class SpanRecorder:
         end time is known)."""
         sid = span_id or new_span_id()
         if len(self._spans) == self.capacity:
-            self.dropped += 1
+            self.dropped += 1  # kvmini: async-ok — single-writer counter
+        # kvmini: async-ok — lock-free by contract (class docstring)
         self._spans.append(
             (name, trace_id, sid, parent_span_id, start_ns, end_ns, ok,
              attrs, kind)
@@ -224,7 +224,6 @@ class SpanRecorder:
             ],
             # Monotonic int bumped only by the recording thread; a stale
             # read costs an off-by-one drop count in a monitoring doc.
-            # kvmini: thread-ok — single-writer counter, stale read benign
             "droppedSpans": self.dropped,
         }
 

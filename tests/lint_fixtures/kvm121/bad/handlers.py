@@ -1,0 +1,23 @@
+"""Seeded bug: blocking calls on the event loop (ISSUE KVM121) — the
+sync helper runs inline in a route handler, so every in-flight request
+stalls behind the sleep and the blocking HTTP read."""
+import time
+
+import requests
+from aiohttp import web
+
+
+def _refresh_views(url):
+    time.sleep(0.5)
+    return requests.get(url).json()
+
+
+async def handle_stats(request):
+    views = _refresh_views("http://replica:8000/stats")
+    return web.json_response(views)
+
+
+def make_app():
+    app = web.Application()
+    app.router.add_get("/stats", handle_stats)
+    return app

@@ -1,0 +1,12 @@
+"""Route wiring for the good handlers — kept in its own module so the
+stand-down test can scan handlers.py without its loop roots in view."""
+from aiohttp import web
+
+import handlers
+
+
+def make_app():
+    app = web.Application()
+    app.router.add_get("/stats", handlers.handle_stats)
+    app.router.add_post("/admin/drain", handlers.handle_drain)
+    return app

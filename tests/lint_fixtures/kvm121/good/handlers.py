@@ -1,0 +1,28 @@
+"""Same surface, loop kept clear: the pause awaits the async sleep, the
+sync HTTP read is offloaded to the default executor, and the one
+deliberately-blocking admin endpoint carries the annotated escape
+hatch. Registration lives in app.py — a single-file scan of this module
+sees no loop root, and must not call the token stale."""
+import asyncio
+import time
+
+import requests
+
+
+def _fetch_views(url):
+    return requests.get(url).json()
+
+
+async def handle_stats(request):
+    await asyncio.sleep(0.5)
+    loop = asyncio.get_running_loop()
+    views = await loop.run_in_executor(
+        None, lambda: _fetch_views("http://replica:8000/stats")
+    )
+    return views
+
+
+async def handle_drain(request):
+    # kvmini: async-ok — admin drain quiesces the loop by design
+    time.sleep(0.1)
+    return {"drained": True}

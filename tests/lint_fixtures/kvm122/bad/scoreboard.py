@@ -1,0 +1,22 @@
+"""Seeded bug: fire-and-forget tasks (ISSUE KVM122) — neither handle is
+stored, awaited, or given a done-callback, so a crash in either
+coroutine vanishes (and the task itself may be garbage-collected
+mid-flight)."""
+import asyncio
+
+
+class Scoreboard:
+    def __init__(self):
+        self._scores = {}
+
+    async def _refresh(self):
+        await asyncio.sleep(1.0)
+        self._scores["replica"] = 1
+
+    async def _evict(self):
+        await asyncio.sleep(5.0)
+        self._scores.clear()
+
+    def start(self):
+        asyncio.create_task(self._refresh())
+        asyncio.ensure_future(self._evict())

@@ -1,0 +1,30 @@
+"""Same spawns, handles kept: stored on the instance (so cancellation
+is possible at shutdown) and wired to a done-callback that surfaces the
+exception."""
+import asyncio
+
+
+class Scoreboard:
+    def __init__(self):
+        self._scores = {}
+        self._tasks = []
+
+    async def _refresh(self):
+        await asyncio.sleep(1.0)
+        self._scores["replica"] = 1
+
+    async def _evict(self):
+        await asyncio.sleep(5.0)
+        self._scores.clear()
+
+    def _log_exit(self, task):
+        if not task.cancelled() and task.exception() is not None:
+            raise task.exception()
+
+    def start(self):
+        refresh = asyncio.create_task(self._refresh())
+        refresh.add_done_callback(self._log_exit)
+        self._tasks.append(refresh)
+        evict = asyncio.ensure_future(self._evict())
+        evict.add_done_callback(self._log_exit)
+        self._tasks.append(evict)

@@ -1,0 +1,29 @@
+"""Seeded race: loop-affinity violation (ISSUE KVM123) — a scrape
+thread and an event-loop handler both mutate ``self.views`` with no
+call_soon_threadsafe routing and no lock."""
+import threading
+
+from aiohttp import web
+
+
+class ViewCache:
+    def __init__(self):
+        self.views = {}
+        self._thread = None
+
+    def _scrape_loop(self):
+        while True:
+            self.views["replica"] = {"depth": 1}
+
+    def start(self):
+        self._thread = threading.Thread(target=self._scrape_loop, daemon=True)
+        self._thread.start()
+
+    async def handle_reset(self, request):
+        self.views = {}
+        return web.json_response({"ok": True})
+
+    def make_app(self):
+        app = web.Application()
+        app.router.add_post("/reset", self.handle_reset)
+        return app

@@ -1,0 +1,36 @@
+"""Same shape, affinity respected: the scrape thread never touches loop
+state directly — it routes the write onto the loop through
+``call_soon_threadsafe``, so every mutation of ``self.views`` runs on
+the one event loop."""
+import threading
+
+from aiohttp import web
+
+
+class ViewCache:
+    def __init__(self, loop):
+        self.views = {}
+        self._loop = loop
+        self._thread = None
+
+    def _apply_view(self, rid, view):
+        self.views[rid] = view
+
+    def _scrape_loop(self):
+        while True:
+            self._loop.call_soon_threadsafe(
+                self._apply_view, "replica", {"depth": 1}
+            )
+
+    def start(self):
+        self._thread = threading.Thread(target=self._scrape_loop, daemon=True)
+        self._thread.start()
+
+    async def handle_reset(self, request):
+        self.views = {}
+        return web.json_response({"ok": True})
+
+    def make_app(self):
+        app = web.Application()
+        app.router.add_post("/reset", self.handle_reset)
+        return app

@@ -1,0 +1,24 @@
+"""Seeded bug: read-modify-write straddling an await (ISSUE KVM124) —
+the placement-scoreboard bug class. Another task interleaves at the
+await and the write-back clobbers its update."""
+import asyncio
+
+
+class Scoreboard:
+    def __init__(self):
+        self._total = 0
+        self._depth = 0
+        self._task = None
+
+    async def _fetch_delta(self):
+        await asyncio.sleep(0.1)
+        return 1
+
+    async def _account(self):
+        self._total += await self._fetch_delta()
+        depth = self._depth
+        await asyncio.sleep(0.1)
+        self._depth = depth + 1
+
+    def start(self):
+        self._task = asyncio.create_task(self._account())

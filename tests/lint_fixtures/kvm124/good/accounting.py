@@ -1,0 +1,24 @@
+"""Same accounting, RMW kept atomic: awaited values land in locals
+first, and every self-state update reads current state with no await
+between its load and its store."""
+import asyncio
+
+
+class Scoreboard:
+    def __init__(self):
+        self._total = 0
+        self._depth = 0
+        self._task = None
+
+    async def _fetch_delta(self):
+        await asyncio.sleep(0.1)
+        return 1
+
+    async def _account(self):
+        delta = await self._fetch_delta()
+        self._total += delta
+        await asyncio.sleep(0.1)
+        self._depth = self._depth + 1
+
+    def start(self):
+        self._task = asyncio.create_task(self._account())

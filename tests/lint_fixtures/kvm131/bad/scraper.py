@@ -1,0 +1,8 @@
+"""Seeded drift: a working env knob no operator can discover (ISSUE
+KVM131) — the read is live but the key is registered in no
+``*_ENV_KNOBS`` table and mentioned on no docs page."""
+import os
+
+
+def scrape_burst():
+    return int(os.environ.get("KVMINI_SCRAPE_BURST", "4"))

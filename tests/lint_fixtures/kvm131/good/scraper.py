@@ -1,0 +1,19 @@
+"""Same knob, registered: the key appears in the module's knob table,
+so the deploy layer and the docs generator can enumerate it. The legacy
+alias stays deliberately undiscoverable — annotated, not registered.
+KVM131 only runs on full scans, so a single-file scan must not call the
+token stale."""
+import os
+
+SCRAPER_ENV_KNOBS = {
+    "KVMINI_SCRAPE_BURST": "samples fetched per scrape tick",
+}
+
+
+def scrape_burst():
+    return int(os.environ.get("KVMINI_SCRAPE_BURST", "4"))
+
+
+def legacy_burst():
+    # kvmini: config-ok — pre-rename alias honored for one release
+    return int(os.environ.get("KVMINI_BURST", "0"))

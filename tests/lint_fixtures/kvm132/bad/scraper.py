@@ -1,0 +1,13 @@
+"""Seeded drift: a knob-table entry nothing consumes (ISSUE KVM132) —
+the read site for KVMINI_SCRAPE_DEPTH was deleted but its registration
+survived, so the table advertises a knob that does nothing."""
+import os
+
+SCRAPER_ENV_KNOBS = {
+    "KVMINI_SCRAPE_BURST": "samples fetched per scrape tick",
+    "KVMINI_SCRAPE_DEPTH": "queue-depth probe fanout",
+}
+
+
+def scrape_burst():
+    return int(os.environ.get("KVMINI_SCRAPE_BURST", "4"))

@@ -1,0 +1,16 @@
+"""Same table, every entry consumed: both registered knobs have a live
+read site."""
+import os
+
+SCRAPER_ENV_KNOBS = {
+    "KVMINI_SCRAPE_BURST": "samples fetched per scrape tick",
+    "KVMINI_SCRAPE_DEPTH": "queue-depth probe fanout",
+}
+
+
+def scrape_burst():
+    return int(os.environ.get("KVMINI_SCRAPE_BURST", "4"))
+
+
+def scrape_depth():
+    return int(os.environ.get("KVMINI_SCRAPE_DEPTH", "1"))

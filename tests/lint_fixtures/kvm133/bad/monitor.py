@@ -1,0 +1,16 @@
+"""Seeded drift: config fields no operator can reach (ISSUE KVM133) —
+``ring_capacity`` has no CLI flag, env knob, profile key, or docs
+mention at all; ``poll_interval`` IS settable via ``--poll-interval``
+but the flag appears on no docs page."""
+import argparse
+from dataclasses import dataclass
+
+
+@dataclass
+class MonitorConfig:
+    poll_interval: float = 1.0
+    ring_capacity: int = 4096
+
+
+def register(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument("--poll-interval", type=float, default=1.0)

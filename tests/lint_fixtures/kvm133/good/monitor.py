@@ -1,0 +1,14 @@
+"""Same fields, both surfaced: ``--poll-interval`` is documented and
+``ring_capacity`` has a docs mention explaining how to set it."""
+import argparse
+from dataclasses import dataclass
+
+
+@dataclass
+class MonitorConfig:
+    poll_interval: float = 1.0
+    ring_capacity: int = 4096
+
+
+def register(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument("--poll-interval", type=float, default=1.0)

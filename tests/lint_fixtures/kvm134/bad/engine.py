@@ -1,0 +1,15 @@
+"""Seeded drift: the CLI and the dataclass disagree on a default (ISSUE
+KVM134) — ``--queue-limit`` ships 256 while ``EngineConfig.queue_limit``
+ships 512, so the effective limit depends on which layer constructed the
+config."""
+import argparse
+from dataclasses import dataclass
+
+
+@dataclass
+class EngineConfig:
+    queue_limit: int = 512
+
+
+def register(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument("--queue-limit", type=int, default=256)

@@ -1,0 +1,13 @@
+"""Same knob, layers agreeing: the argparse default and the dataclass
+default are the same value, so every construction path lands on 512."""
+import argparse
+from dataclasses import dataclass
+
+
+@dataclass
+class EngineConfig:
+    queue_limit: int = 512
+
+
+def register(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument("--queue-limit", type=int, default=512)
